@@ -1,0 +1,120 @@
+"""Collector tests. Reference model: pkg/collector/{synthetic,pipeline}_test.go."""
+
+from datetime import datetime, timezone
+
+import pytest
+
+from tpuslo import collector, schema
+
+TS = datetime(2026, 7, 29, 12, 0, 0, tzinfo=timezone.utc)
+META = collector.SampleMeta(node="tpu-vm-0")
+
+
+class TestSynthetic:
+    def test_supported_scenarios_include_tpu_faults(self):
+        scenarios = collector.supported_synthetic_scenarios()
+        for name in (
+            "baseline",
+            "mixed",
+            "mixed_multi",
+            "ici_drop",
+            "hbm_pressure",
+            "xla_recompile_storm",
+            "host_offload_stall",
+            "tpu_mixed",
+        ):
+            assert name in scenarios
+
+    def test_unknown_scenario_raises(self):
+        with pytest.raises(ValueError):
+            collector.build_synthetic_sample("warp_core_breach", 0, TS, META)
+
+    def test_deterministic(self):
+        a = collector.generate_synthetic_samples("tpu_mixed", 8, TS, META)
+        b = collector.generate_synthetic_samples("tpu_mixed", 8, TS, META)
+        assert [s.to_dict() for s in a] == [s.to_dict() for s in b]
+
+    def test_mixed_rotates_fault_labels(self):
+        samples = collector.generate_synthetic_samples("tpu_mixed", 8, TS, META)
+        labels = [s.fault_label for s in samples[:4]]
+        assert labels == [
+            "ici_drop",
+            "hbm_pressure",
+            "xla_recompile_storm",
+            "host_offload_stall",
+        ]
+        assert samples[4].fault_label == "ici_drop"
+
+    def test_baseline_has_no_fault_label(self):
+        sample = collector.build_synthetic_sample("baseline", 0, TS, META)
+        assert sample.fault_label == ""
+        assert sample.ttft_ms == 340
+
+    def test_timestamps_advance_per_second(self):
+        samples = collector.generate_synthetic_samples("baseline", 3, TS, META)
+        deltas = [
+            (samples[i + 1].timestamp - samples[i].timestamp).total_seconds()
+            for i in range(2)
+        ]
+        assert deltas == [1.0, 1.0]
+
+    def test_count_must_be_positive(self):
+        with pytest.raises(ValueError):
+            collector.generate_synthetic_samples("baseline", 0, TS, META)
+
+    def test_raw_sample_round_trip(self):
+        sample = collector.build_synthetic_sample("hbm_pressure", 3, TS, META)
+        again = collector.RawSample.from_dict(sample.to_dict())
+        assert again.to_dict() == sample.to_dict()
+
+
+class TestNormalize:
+    def test_four_events_per_sample(self):
+        sample = collector.build_synthetic_sample("baseline", 0, TS, META)
+        events = collector.normalize_sample(sample)
+        assert [e.sli_name for e in events] == [
+            "ttft_ms",
+            "request_latency_ms",
+            "token_throughput_tps",
+            "error_rate",
+        ]
+        for event in events:
+            schema.validate(event.to_dict(), schema.SCHEMA_SLO_EVENT)
+
+    def test_baseline_statuses(self):
+        sample = collector.build_synthetic_sample("baseline", 0, TS, META)
+        by_sli = {e.sli_name: e.status for e in collector.normalize_sample(sample)}
+        # Baseline latency of 720ms sits just above the 700ms warning line,
+        # mirroring the reference's synthetic baseline.
+        assert by_sli == {
+            "ttft_ms": "ok",
+            "request_latency_ms": "warning",
+            "token_throughput_tps": "ok",
+            "error_rate": "ok",
+        }
+
+    def test_recompile_storm_breaches_ttft_not_throughput(self):
+        sample = collector.build_synthetic_sample("xla_recompile_storm", 0, TS, META)
+        by_sli = {e.sli_name: e.status for e in collector.normalize_sample(sample)}
+        assert by_sli["ttft_ms"] == "breach"
+        assert by_sli["token_throughput_tps"] == "warning"
+
+    def test_ici_drop_breaches_throughput(self):
+        sample = collector.build_synthetic_sample("ici_drop", 0, TS, META)
+        by_sli = {e.sli_name: e.status for e in collector.normalize_sample(sample)}
+        assert by_sli["token_throughput_tps"] == "breach"
+        assert by_sli["error_rate"] == "breach"
+
+    def test_threshold_boundaries(self):
+        assert collector.threshold_status(499.9, 500, 1000) == "ok"
+        assert collector.threshold_status(500, 500, 1000) == "warning"
+        assert collector.threshold_status(1000, 500, 1000) == "breach"
+        assert collector.inverse_threshold_status(31, 30, 10) == "ok"
+        assert collector.inverse_threshold_status(30, 30, 10) == "warning"
+        assert collector.inverse_threshold_status(10, 30, 10) == "breach"
+
+    def test_labels_carry_node_and_fault(self):
+        sample = collector.build_synthetic_sample("dns_latency", 0, TS, META)
+        event = collector.normalize_sample(sample)[0]
+        assert event.labels["node"] == "tpu-vm-0"
+        assert event.labels["fault_label"] == "dns_latency"

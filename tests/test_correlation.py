@@ -1,0 +1,276 @@
+"""Correlation-engine tests.
+
+Reference model: pkg/correlation/*_test.go +
+pkg/otel/processor/ebpfcorrelator tests.
+"""
+
+from datetime import datetime, timedelta, timezone
+from pathlib import Path
+
+import pytest
+
+from tpuslo import correlation, semconv
+from tpuslo.otel.processor.correlator import (
+    Correlator,
+    SpanRecord,
+    decompose_retrieval,
+    decompose_tpu,
+)
+
+TS = datetime(2026, 7, 29, 12, 0, 0, tzinfo=timezone.utc)
+GOLDEN = Path(__file__).parent.parent / "tpuslo/correlation/testdata/labeled_pairs.jsonl"
+
+
+def span(**kw):
+    kw.setdefault("timestamp", TS)
+    return correlation.SpanRef(**kw)
+
+
+def sigref(offset_ms=50, **kw):
+    kw.setdefault("signal", "dns_latency_ms")
+    kw.setdefault("timestamp", TS + timedelta(milliseconds=offset_ms))
+    kw.setdefault("value", 120.0)
+    return correlation.SignalRef(**kw)
+
+
+class TestMatchTiers:
+    def test_trace_id_exact(self):
+        d = correlation.match(
+            span(trace_id="t1"), sigref(trace_id="t1", offset_ms=1500)
+        )
+        assert (d.matched, d.confidence, d.tier) == (True, 1.0, "trace_id_exact")
+
+    def test_xla_launch_tier(self):
+        d = correlation.match(
+            span(program_id="jit_step", launch_id=42),
+            sigref(program_id="jit_step", launch_id=42, offset_ms=200),
+        )
+        assert (d.matched, d.confidence, d.tier) == (True, 0.95, "xla_launch")
+
+    def test_xla_launch_requires_250ms(self):
+        d = correlation.match(
+            span(program_id="jit_step", launch_id=42),
+            sigref(program_id="jit_step", launch_id=42, offset_ms=300),
+        )
+        assert not d.matched
+
+    def test_xla_launch_zero_is_valid_id(self):
+        d = correlation.match(
+            span(program_id="jit_step", launch_id=0),
+            sigref(program_id="jit_step", launch_id=0, offset_ms=10),
+        )
+        assert d.tier == "xla_launch"
+
+    def test_pod_pid_100ms(self):
+        d = correlation.match(
+            span(pod="p", pid=11), sigref(pod="p", pid=11, offset_ms=90)
+        )
+        assert (d.confidence, d.tier) == (0.9, "pod_pid_100ms")
+
+    def test_pod_conn_250ms(self):
+        d = correlation.match(
+            span(pod="p", conn_tuple="tcp:a->b"),
+            sigref(pod="p", conn_tuple="tcp:a->b", offset_ms=200),
+        )
+        assert (d.confidence, d.tier) == (0.8, "pod_conn_250ms")
+
+    def test_slice_host_250ms(self):
+        d = correlation.match(
+            span(slice_id="s0", host_index=1),
+            sigref(slice_id="s0", host_index=1, offset_ms=240),
+        )
+        assert (d.confidence, d.tier) == (0.75, "slice_host_250ms")
+
+    def test_service_node_500ms(self):
+        d = correlation.match(
+            span(service="svc", node="n0"),
+            sigref(service="svc", node="n0", offset_ms=400),
+        )
+        assert (d.confidence, d.tier) == (0.65, "service_node_500ms")
+
+    def test_tier_precedence_trace_over_xla(self):
+        d = correlation.match(
+            span(trace_id="t", program_id="p", launch_id=1),
+            sigref(trace_id="t", program_id="p", launch_id=1, offset_ms=10),
+        )
+        assert d.tier == "trace_id_exact"
+
+    def test_outside_global_window_no_match(self):
+        d = correlation.match(span(trace_id="t"), sigref(trace_id="t", offset_ms=2500))
+        assert not d.matched
+
+    def test_missing_timestamps_no_match(self):
+        d = correlation.match(
+            span(trace_id="t"), correlation.SignalRef(trace_id="t")
+        )
+        assert not d.matched
+
+
+class TestEnrichDNS:
+    def test_enriches_above_threshold(self):
+        attrs, decision = correlation.enrich_dns({}, span(trace_id="t"), sigref(trace_id="t"))
+        assert attrs[semconv.ATTR_DNS_LATENCY_MS] == 120.0
+        assert attrs[semconv.ATTR_CORRELATION_CONF] == 1.0
+        assert decision.matched
+
+    def test_below_threshold_untouched(self):
+        attrs, _ = correlation.enrich_dns(
+            {}, span(service="s", node="n"), sigref(service="s", node="n", offset_ms=400)
+        )
+        assert attrs == {}
+
+    def test_non_dns_signal_rejected(self):
+        attrs, decision = correlation.enrich_dns(
+            {}, span(trace_id="t"), sigref(trace_id="t", signal="cpu_steal_pct")
+        )
+        assert attrs == {} and not decision.matched
+
+
+class TestRetryStorm:
+    def test_storm_threshold(self):
+        det = correlation.RetryStormDetector(window_s=10, threshold=5)
+        for k in range(4):
+            assert not det.record("pod-a", TS + timedelta(seconds=k))
+        assert det.record("pod-a", TS + timedelta(seconds=4))
+        assert det.is_storm("pod-a", TS + timedelta(seconds=4))
+
+    def test_window_expiry(self):
+        det = correlation.RetryStormDetector(window_s=10, threshold=5)
+        for k in range(5):
+            det.record("pod-a", TS + timedelta(seconds=k))
+        assert not det.is_storm("pod-a", TS + timedelta(seconds=20))
+        assert det.count("pod-a", TS + timedelta(seconds=20)) == 0
+
+    def test_keys_isolated(self):
+        det = correlation.RetryStormDetector(threshold=2)
+        det.record("pod-a", TS)
+        det.record("pod-b", TS)
+        assert not det.is_storm("pod-a", TS)
+
+    def test_ici_storm_key(self):
+        det = correlation.RetryStormDetector(threshold=2)
+        key = correlation.ici_storm_key("v5e-8-s0", 3)
+        det.record(key, TS)
+        det.record(key, TS + timedelta(seconds=1))
+        assert det.active_keys(TS + timedelta(seconds=1)) == ["ici:v5e-8-s0:3"]
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            correlation.RetryStormDetector(window_s=0)
+        with pytest.raises(ValueError):
+            correlation.RetryStormDetector(threshold=0)
+
+
+class TestGoldenPairs:
+    @pytest.fixture(scope="class")
+    def report(self):
+        pairs = correlation.load_labeled_pairs(GOLDEN)
+        report, preds = correlation.evaluate_labeled_pairs(pairs)
+        return report, preds
+
+    def test_dataset_size(self, report):
+        assert report[0].sample_size >= 55
+
+    def test_precision_recall_gate(self, report):
+        gate = correlation.evaluate_gate(report[0], 0.90, 0.85)
+        assert gate.passed, gate.message
+
+    def test_achieved_perfect_on_golden(self, report):
+        assert report[0].precision == 1.0
+        assert report[0].recall == 1.0
+        assert report[0].tier_accuracy == 1.0
+
+    def test_gate_failure_messages(self, report):
+        gate = correlation.evaluate_gate(report[0], 1.01, 0.85)
+        assert not gate.passed and "precision" in gate.message
+
+    def test_covers_all_six_tiers(self):
+        pairs = correlation.load_labeled_pairs(GOLDEN)
+        tiers = {p.expected_tier for p in pairs if p.expected_tier}
+        assert tiers >= {
+            "trace_id_exact",
+            "xla_launch",
+            "pod_pid_100ms",
+            "pod_conn_250ms",
+            "slice_host_250ms",
+            "service_node_500ms",
+        }
+
+
+class TestProcessor:
+    def test_enrich_batch_with_fanout_cap(self):
+        correlator = Correlator(max_join_fanout=2)
+        signals = [
+            sigref(trace_id="t", signal="dns_latency_ms", value=100, offset_ms=10),
+            sigref(trace_id="t", signal="connect_latency_ms", value=50, offset_ms=20),
+            sigref(trace_id="t", signal="tls_handshake_ms", value=30, offset_ms=30),
+        ]
+        result = correlator.enrich_attributes({}, span(trace_id="t"), signals)
+        assert len(result.candidates) == 2
+        assert result.debug.fanout_dropped == 1
+        assert result.attributes[semconv.ATTR_CORRELATION_CONF] == 1.0
+
+    def test_unsupported_signal_counted(self):
+        correlator = Correlator()
+        result = correlator.enrich_attributes(
+            {}, span(trace_id="t"), [sigref(trace_id="t", signal="quantum_flux")]
+        )
+        assert result.debug.unsupported_type == 1
+        assert result.candidates == []
+
+    def test_low_confidence_counted(self):
+        correlator = Correlator()
+        result = correlator.enrich_attributes(
+            {},
+            span(service="s", node="n"),
+            [sigref(service="s", node="n", offset_ms=300)],
+        )
+        assert result.debug.low_confidence == 1
+
+    def test_tpu_signals_enrich_tpu_attrs(self):
+        correlator = Correlator()
+        result = correlator.enrich_attributes(
+            {},
+            span(program_id="jit_step", launch_id=7),
+            [
+                sigref(
+                    signal="hbm_alloc_stall_ms",
+                    program_id="jit_step",
+                    launch_id=7,
+                    value=45.0,
+                    offset_ms=100,
+                )
+            ],
+        )
+        assert result.attributes[semconv.ATTR_HBM_ALLOC_STALL_MS] == 45.0
+        assert result.attributes[semconv.ATTR_CORRELATION_CONF] == 0.95
+
+    def test_process_batch_decomposes(self):
+        correlator = Correlator()
+        spans = [
+            SpanRecord(trace_id="t", service="svc", timestamp=TS),
+        ]
+        signals = [
+            sigref(trace_id="t", signal="dns_latency_ms", value=40, offset_ms=5),
+            sigref(trace_id="t", signal="connect_latency_ms", value=30, offset_ms=6),
+            sigref(trace_id="t", signal="xla_compile_ms", value=700, offset_ms=7),
+        ]
+        batch = correlator.process_batch(spans, signals)
+        attrs = batch.spans[0].attributes
+        assert attrs[semconv.ATTR_RETRIEVAL_KERNEL_MS] == 70
+        assert attrs[semconv.ATTR_TPU_KERNEL_MS] == 700
+
+    def test_decompose_helpers_zero_safe(self):
+        attrs = {}
+        assert decompose_retrieval(attrs) == 0
+        assert decompose_tpu(attrs) == 0
+        assert attrs == {}
+
+    def test_max_value_wins_on_duplicate_attr(self):
+        correlator = Correlator()
+        signals = [
+            sigref(trace_id="t", signal="dns_latency_ms", value=100, offset_ms=10),
+            sigref(trace_id="t", signal="dns_latency_ms", value=250, offset_ms=20),
+        ]
+        result = correlator.enrich_attributes({}, span(trace_id="t"), signals)
+        assert result.attributes[semconv.ATTR_DNS_LATENCY_MS] == 250

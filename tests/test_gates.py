@@ -1,0 +1,315 @@
+"""Release-gate / benchmark / cdgate / prereq tests.
+
+Reference model: pkg/releasegate/gate_test.go, pkg/cdgate/gate_test.go,
+pkg/prereq/checker_test.go, pkg/benchmark/harness_test.go.
+"""
+
+import csv
+import json
+from datetime import datetime, timezone
+from pathlib import Path
+
+import pytest
+
+from tpuslo import attribution, benchmark, cdgate, prereq, releasegate
+from tpuslo.faultreplay import generate_fault_samples
+
+TS = datetime(2026, 7, 29, 12, 0, 0, tzinfo=timezone.utc)
+
+
+class TestStats:
+    def test_mean_stddev_cv(self):
+        values = [10.0, 12.0, 8.0, 10.0]
+        assert releasegate.mean(values) == 10.0
+        assert releasegate.coefficient_of_variance_pct([5.0, 5.0, 5.0]) == 0.0
+        assert releasegate.stddev([1.0]) == 0.0
+
+    def test_mann_whitney_identical_distributions(self):
+        x = [float(v) for v in range(1, 31)]
+        p = releasegate.mann_whitney_p_value(x, list(x))
+        assert p > 0.9
+
+    def test_mann_whitney_shifted_distributions(self):
+        x = [float(v) for v in range(1, 31)]
+        y = [float(v + 50) for v in range(1, 31)]
+        p = releasegate.mann_whitney_p_value(x, y)
+        assert p < 0.001
+
+    def test_mann_whitney_empty(self):
+        assert releasegate.mann_whitney_p_value([], [1.0]) == 1.0
+
+    def test_cliffs_delta_bounds(self):
+        assert releasegate.cliffs_delta([1, 2], [3, 4]) == -1.0
+        assert releasegate.cliffs_delta([3, 4], [1, 2]) == 1.0
+        assert releasegate.cliffs_delta([1, 2], [1, 2]) == 0.0
+        assert releasegate.cliffs_delta([], [1]) == 0.0
+
+    def test_bootstrap_deterministic(self):
+        cand = [float(v) for v in range(100, 130)]
+        base = [float(v) for v in range(100, 130)]
+        a = releasegate.bootstrap_delta_ci(cand, base, 0.95, 200, seed=42)
+        b = releasegate.bootstrap_delta_ci(cand, base, 0.95, 200, seed=42)
+        assert a == b
+
+    def test_bootstrap_detects_shift(self):
+        cand = [float(v + 100) for v in range(30)]
+        base = [float(v) for v in range(30)]
+        low, high = releasegate.bootstrap_delta_ci(cand, base, 0.95, 500, seed=42)
+        assert low > 0 and high >= low
+
+
+def write_run(
+    root: Path, scenario: str, run: str, ttft_shift: float = 0.0, cpu: float = 1.5
+):
+    run_dir = root / scenario / run
+    run_dir.mkdir(parents=True)
+    samples = generate_fault_samples(
+        scenario if scenario in ("dns_latency", "hbm_pressure") else "dns_latency",
+        40,
+        TS,
+    )
+    with open(run_dir / "raw_samples.jsonl", "w") as f:
+        for idx, s in enumerate(samples):
+            from tpuslo.collector.synthetic import RawSample
+
+            raw = RawSample(
+                timestamp=s.timestamp,
+                cluster="c",
+                namespace="n",
+                workload="w",
+                service="s",
+                node="tpu-vm-0",
+                request_id=s.request_id,
+                trace_id=s.trace_id,
+                ttft_ms=800.0 + (idx % 7) * 10 + ttft_shift,
+                request_latency_ms=1500.0,
+                token_throughput_tps=18.0 + (idx % 3),
+                error_rate=0.03,
+                fault_label=s.fault_label,
+            )
+            f.write(json.dumps(raw.to_dict()) + "\n")
+    with open(run_dir / "collector_overhead.csv", "w", newline="") as f:
+        writer = csv.writer(f)
+        writer.writerow(["node", "cpu_pct", "memory_mb"])
+        writer.writerow(["tpu-vm-0", f"{cpu}", "110"])
+        writer.writerow(["tpu-vm-1", f"{cpu + 0.3}", "115"])
+
+
+@pytest.fixture
+def artifact_tree(tmp_path):
+    candidate = tmp_path / "candidate"
+    baseline = tmp_path / "candidate" / "baseline"
+    for run in ("run-1", "run-2", "run-3"):
+        write_run(candidate, "dns_latency", run)
+        write_run(baseline, "dns_latency", run)
+    (baseline / "manifest.json").write_text(
+        json.dumps({"source_ref": "v0.9", "source_commit": "abc123"})
+    )
+    return candidate
+
+
+class TestReleaseGate:
+    def scenarios(self):
+        return ["dns_latency"]
+
+    def test_all_gates_pass_on_clean_tree(self, artifact_tree):
+        cfg = releasegate.Config(
+            candidate_root=str(artifact_tree),
+            scenarios=self.scenarios(),
+            candidate_commit="def456",
+        )
+        summary = releasegate.evaluate(cfg)
+        assert summary.passed, summary.failures
+        assert summary.overhead.max_node_p95_pct <= 3.0
+        assert summary.variance.scenarios[0].passed
+        sig = summary.significance.scenarios[0]
+        assert sig.minimum_samples_reached
+        assert sig.passed
+
+    def test_overhead_gate_fails_on_hot_node(self, tmp_path):
+        candidate = tmp_path / "candidate"
+        for run in ("run-1", "run-2", "run-3"):
+            write_run(candidate, "dns_latency", run, cpu=4.5)
+        cfg = releasegate.Config(
+            candidate_root=str(candidate), scenarios=self.scenarios()
+        )
+        summary = releasegate.evaluate(cfg)
+        assert not summary.overhead.passed
+        assert "p95 overhead" in summary.overhead.failure_reason
+
+    def test_variance_gate_fails_on_too_few_runs(self, tmp_path):
+        candidate = tmp_path / "candidate"
+        write_run(candidate, "dns_latency", "run-1")
+        cfg = releasegate.Config(
+            candidate_root=str(candidate), scenarios=self.scenarios()
+        )
+        summary = releasegate.evaluate(cfg)
+        assert not summary.variance.passed
+        assert "at least 3 runs" in summary.variance.scenarios[0].failure_reason
+
+    def test_significance_regression_detected(self, tmp_path):
+        candidate = tmp_path / "candidate"
+        baseline = candidate / "baseline"
+        for run in ("run-1", "run-2", "run-3"):
+            write_run(candidate, "dns_latency", run, ttft_shift=120.0)
+            write_run(baseline, "dns_latency", run)
+        (baseline / "manifest.json").write_text(
+            json.dumps({"source_ref": "v0.9", "source_commit": "abc123"})
+        )
+        cfg = releasegate.Config(
+            candidate_root=str(candidate),
+            scenarios=self.scenarios(),
+            candidate_commit="def456",
+        )
+        summary = releasegate.evaluate(cfg)
+        sig = summary.significance.scenarios[0]
+        assert sig.ttft_regression_pct > 5.0
+        assert sig.mann_whitney_p_value < 0.05
+        assert not sig.passed
+        assert not summary.passed
+
+    def test_same_source_baseline_informational(self, artifact_tree):
+        cfg = releasegate.Config(
+            candidate_root=str(artifact_tree),
+            scenarios=self.scenarios(),
+            candidate_commit="abc123",  # matches manifest source_commit
+        )
+        summary = releasegate.evaluate(cfg)
+        assert summary.baseline.same_source
+        assert summary.significance.scenarios[0].informational_only
+
+    def test_missing_required_manifest_fails(self, tmp_path):
+        candidate = tmp_path / "candidate"
+        for run in ("run-1", "run-2", "run-3"):
+            write_run(candidate, "dns_latency", run)
+        cfg = releasegate.Config(
+            candidate_root=str(candidate),
+            scenarios=self.scenarios(),
+            require_baseline_manifest=True,
+        )
+        summary = releasegate.evaluate(cfg)
+        assert not summary.baseline.passed
+
+    def test_config_normalization_defaults(self):
+        cfg = releasegate.Config().normalized()
+        assert cfg.max_overhead_pct == 3.0
+        assert cfg.bootstrap_seed == 42
+        # defaults only include scenarios faultinject can actually produce
+        assert "tpu_mixed" in cfg.scenarios
+        assert "tpu_mixed_multi" not in cfg.scenarios
+
+
+class TestBenchmarkHarness:
+    def test_bundle_files_and_summary(self, tmp_path):
+        opts = benchmark.Options(
+            output_dir=str(tmp_path / "bundle"), scenario="tpu_mixed", count=24
+        )
+        bundle = benchmark.generate_artifacts(opts)
+        for path in (
+            bundle.predictions_csv,
+            bundle.confusion_csv,
+            bundle.overhead_csv,
+            bundle.summary_json,
+            bundle.report_md,
+            bundle.provenance_json,
+        ):
+            assert Path(path).exists()
+        assert bundle.summary["accuracy"] == 1.0
+        assert bundle.summary["macro_f1"] >= 0.70
+        provenance = json.loads(Path(bundle.provenance_json).read_text())
+        assert provenance["seed"] == 42
+        assert provenance["measured_overhead"] is True
+
+    def test_bundle_from_input_jsonl(self, tmp_path):
+        samples = generate_fault_samples("mixed", 10, TS)
+        path = tmp_path / "input.jsonl"
+        with open(path, "w") as f:
+            attribution.dump_samples_jsonl(samples, f)
+        opts = benchmark.Options(
+            output_dir=str(tmp_path / "bundle"), input_samples=str(path)
+        )
+        bundle = benchmark.generate_artifacts(opts)
+        assert bundle.summary["sample_count"] == 10
+
+    def test_confusion_csv_well_formed(self, tmp_path):
+        opts = benchmark.Options(output_dir=str(tmp_path), scenario="ici_drop", count=5)
+        bundle = benchmark.generate_artifacts(opts)
+        rows = list(csv.DictReader(open(bundle.confusion_csv)))
+        assert rows[0]["actual"] == "tpu_ici"
+        assert rows[0]["predicted"] == "tpu_ici"
+        assert rows[0]["count"] == "5"
+
+
+class FakeQuerier:
+    def __init__(self, values):
+        self.values = values
+
+    def query(self, promql):
+        if promql not in self.values:
+            raise cdgate.QueryError("no data")
+        value = self.values[promql]
+        if isinstance(value, Exception):
+            raise value
+        return value
+
+
+class TestCDGate:
+    QUERIES = {"ttft_p95_ms": "q_ttft", "error_rate": "q_err", "burn_rate": "q_burn"}
+
+    def test_gate_passes_under_thresholds(self):
+        querier = FakeQuerier({"q_ttft": 420.0, "q_err": 0.01, "q_burn": 0.8})
+        report = cdgate.evaluate_slo_gate(querier, queries=self.QUERIES)
+        assert report.passed
+        assert all(c.passed for c in report.checks)
+
+    def test_gate_fails_on_breach(self):
+        querier = FakeQuerier({"q_ttft": 1200.0, "q_err": 0.01, "q_burn": 0.8})
+        report = cdgate.evaluate_slo_gate(querier, queries=self.QUERIES)
+        assert not report.passed
+        failed = [c for c in report.checks if not c.passed]
+        assert failed[0].name == "ttft_p95_ms"
+
+    def test_query_failure_counts(self):
+        querier = FakeQuerier(
+            {"q_ttft": cdgate.QueryError("boom"), "q_err": 0.01, "q_burn": 0.8}
+        )
+        report = cdgate.evaluate_slo_gate(querier, queries=self.QUERIES)
+        assert not report.passed
+        assert report.query_failures == 1
+
+
+class TestPrereq:
+    def test_parse_kernel_release(self):
+        assert prereq.parse_kernel_release("6.18.5-fc-v18") == (6, 18)
+        assert prereq.parse_kernel_release("5.15.0") == (5, 15)
+        with pytest.raises(ValueError):
+            prereq.parse_kernel_release("weird")
+
+    def test_evaluate_blockers_and_warnings(self):
+        snapshot = prereq.HostSnapshot(
+            kernel_release="6.1.0",
+            has_btf=True,
+            is_root=True,
+            bpftool="/usr/sbin/bpftool",
+            clang="",
+            accel_devices=["/dev/accel0"],
+            libtpu_path="/usr/lib/libtpu.so",
+            jax_available=True,
+        )
+        results = {r.name: r for r in prereq.evaluate(snapshot)}
+        assert results["kernel_version"].passed
+        assert results["btf_available"].passed
+        assert results["accel_devices"].passed
+        assert not results["clang"].passed
+        assert results["clang"].severity == prereq.SEVERITY_WARNING
+
+    def test_old_kernel_blocks(self):
+        snapshot = prereq.HostSnapshot(kernel_release="4.19.0")
+        results = {r.name: r for r in prereq.evaluate(snapshot)}
+        assert not results["kernel_version"].passed
+        assert results["kernel_version"].severity == prereq.SEVERITY_BLOCKER
+
+    def test_collect_snapshot_runs(self):
+        snapshot = prereq.collect_snapshot()
+        assert snapshot.kernel_release
+        assert snapshot.jax_available

@@ -1,0 +1,102 @@
+"""Safety-governor tests. Reference model: pkg/safety/*_test.go."""
+
+import pytest
+
+from tpuslo import safety
+
+
+class FakeSampler:
+    def __init__(self, samples):
+        self._samples = iter(samples)
+
+    def sample(self):
+        return next(self._samples)
+
+
+class TestOverheadGuard:
+    def test_first_evaluation_invalid(self):
+        guard = safety.OverheadGuard(
+            3.0,
+            sampler=FakeSampler([safety.CPUSample(100, 10000)]),
+            num_cpus=4,
+        )
+        result = guard.evaluate()
+        assert not result.valid
+        assert not result.over_budget
+
+    def test_within_budget(self):
+        guard = safety.OverheadGuard(
+            3.0,
+            sampler=FakeSampler(
+                [safety.CPUSample(100, 10000), safety.CPUSample(102, 10400)]
+            ),
+            num_cpus=4,
+        )
+        guard.evaluate()
+        result = guard.evaluate()
+        assert result.valid
+        # (2/400)*100*4 = 2.0%
+        assert result.cpu_pct == pytest.approx(2.0)
+        assert not result.over_budget
+
+    def test_over_budget(self):
+        guard = safety.OverheadGuard(
+            3.0,
+            sampler=FakeSampler(
+                [safety.CPUSample(100, 10000), safety.CPUSample(120, 10400)]
+            ),
+            num_cpus=4,
+        )
+        guard.evaluate()
+        result = guard.evaluate()
+        assert result.cpu_pct == pytest.approx(20.0)
+        assert result.over_budget
+
+    def test_counter_reset_invalid(self):
+        guard = safety.OverheadGuard(
+            3.0,
+            sampler=FakeSampler(
+                [safety.CPUSample(100, 10000), safety.CPUSample(50, 9000)]
+            ),
+            num_cpus=4,
+        )
+        guard.evaluate()
+        assert not guard.evaluate().valid
+
+    def test_rejects_nonpositive_budget(self):
+        with pytest.raises(ValueError):
+            safety.OverheadGuard(0)
+
+    def test_proc_sampler_reads_real_proc(self):
+        sample = safety.ProcCPUSampler().sample()
+        assert sample.total_ticks > 0
+        assert sample.proc_ticks >= 0
+
+
+class TestRateLimiter:
+    def test_burst_then_deny(self):
+        now = [0.0]
+        limiter = safety.RateLimiter(10, burst=5, clock=lambda: now[0])
+        assert all(limiter.allow() for _ in range(5))
+        assert not limiter.allow()
+
+    def test_refill_over_time(self):
+        now = [0.0]
+        limiter = safety.RateLimiter(10, burst=5, clock=lambda: now[0])
+        for _ in range(5):
+            limiter.allow()
+        now[0] = 0.25  # refills 2.5 tokens
+        assert limiter.allow()
+        assert limiter.allow()
+        assert not limiter.allow()
+
+    def test_capacity_capped(self):
+        now = [0.0]
+        limiter = safety.RateLimiter(10, burst=5, clock=lambda: now[0])
+        now[0] = 100.0
+        limiter.allow()
+        assert limiter.tokens == pytest.approx(4.0)
+
+    def test_rejects_zero_rate(self):
+        with pytest.raises(ValueError):
+            safety.RateLimiter(0)

@@ -1,0 +1,195 @@
+"""Contract-layer tests. Reference test model: pkg/schema/validator_test.go."""
+
+from datetime import datetime, timezone
+
+import pytest
+
+from tpuslo import schema
+
+TS = datetime(2026, 7, 29, 12, 0, 0, tzinfo=timezone.utc)
+
+
+def make_slo_event(**overrides):
+    ev = schema.SLOEvent(
+        event_id="req-0001-ttft_ms",
+        timestamp=TS,
+        cluster="tpu-cluster",
+        namespace="llm",
+        workload="rag-service",
+        service="rag-service",
+        request_id="req-0001",
+        sli_name="ttft_ms",
+        sli_value=340.0,
+        unit="ms",
+        status="ok",
+        trace_id="trace-0001",
+        labels={"source": "synthetic"},
+    )
+    for k, v in overrides.items():
+        setattr(ev, k, v)
+    return ev
+
+
+def make_probe_event(**overrides):
+    ev = schema.ProbeEventV1(
+        ts_unix_nano=int(TS.timestamp() * 1e9),
+        signal="dns_latency_ms",
+        node="tpu-vm-0",
+        namespace="llm",
+        pod="rag-service-abc",
+        container="rag",
+        pid=1234,
+        tid=1234,
+        value=12.0,
+        unit="ms",
+        status="ok",
+        conn_tuple=schema.ConnTuple("10.0.0.10", "10.0.0.53", 42424, 53, "udp"),
+    )
+    for k, v in overrides.items():
+        setattr(ev, k, v)
+    return ev
+
+
+class TestSLOEvent:
+    def test_valid_event_passes_contract(self):
+        schema.validate(make_slo_event().to_dict(), schema.SCHEMA_SLO_EVENT)
+
+    def test_timestamp_rfc3339_z_suffix(self):
+        payload = make_slo_event().to_dict()
+        assert payload["timestamp"] == "2026-07-29T12:00:00Z"
+
+    def test_bad_status_rejected(self):
+        payload = make_slo_event(status="exploded").to_dict()
+        with pytest.raises(schema.SchemaValidationError):
+            schema.validate(payload, schema.SCHEMA_SLO_EVENT)
+
+    def test_bad_sli_name_rejected(self):
+        payload = make_slo_event(sli_name="nonsense_sli").to_dict()
+        with pytest.raises(schema.SchemaValidationError):
+            schema.validate(payload, schema.SCHEMA_SLO_EVENT)
+
+    def test_missing_required_field_rejected(self):
+        payload = make_slo_event().to_dict()
+        del payload["cluster"]
+        with pytest.raises(schema.SchemaValidationError):
+            schema.validate(payload, schema.SCHEMA_SLO_EVENT)
+
+    def test_empty_trace_id_omitted(self):
+        payload = make_slo_event(trace_id="").to_dict()
+        assert "trace_id" not in payload
+        schema.validate(payload, schema.SCHEMA_SLO_EVENT)
+
+
+class TestProbeEvent:
+    def test_valid_probe_passes_contract(self):
+        schema.validate(make_probe_event().to_dict(), schema.SCHEMA_PROBE_EVENT)
+
+    def test_tpu_block_round_trips(self):
+        ev = make_probe_event(
+            signal="xla_compile_ms",
+            conn_tuple=None,
+            tpu=schema.TPURef(
+                chip="accel0",
+                slice_id="v5e-8-slice0",
+                host_index=0,
+                program_id="jit_train_step",
+                launch_id=17,
+                module_name="jit_train_step.17",
+            ),
+        )
+        payload = ev.to_dict()
+        assert payload["tpu"]["chip"] == "accel0"
+        assert payload["tpu"]["launch_id"] == 17
+        assert "ici_link" not in payload["tpu"]
+        schema.validate(payload, schema.SCHEMA_PROBE_EVENT)
+
+    def test_errno_and_confidence_serialised(self):
+        ev = make_probe_event(errno=110, confidence=0.9)
+        payload = ev.to_dict()
+        assert payload["errno"] == 110
+        assert payload["confidence"] == 0.9
+        schema.validate(payload, schema.SCHEMA_PROBE_EVENT)
+
+    def test_conn_tuple_key_is_canonical(self):
+        tup = schema.ConnTuple("1.2.3.4", "5.6.7.8", 1111, 443, "tcp")
+        assert tup.key() == "tcp:1.2.3.4:1111->5.6.7.8:443"
+
+    def test_invalid_status_rejected(self):
+        payload = make_probe_event(status="breach").to_dict()
+        with pytest.raises(schema.SchemaValidationError):
+            schema.validate(payload, schema.SCHEMA_PROBE_EVENT)
+
+    def test_negative_port_rejected(self):
+        payload = make_probe_event(
+            conn_tuple=schema.ConnTuple("1.2.3.4", "5.6.7.8", -1, 443, "tcp")
+        ).to_dict()
+        with pytest.raises(schema.SchemaValidationError):
+            schema.validate(payload, schema.SCHEMA_PROBE_EVENT)
+
+
+class TestIncidentAttribution:
+    def make(self, domain="network_dns"):
+        return schema.IncidentAttribution(
+            incident_id="inc-0001",
+            timestamp=TS,
+            cluster="tpu-cluster",
+            service="rag-service",
+            predicted_fault_domain=domain,
+            confidence=0.92,
+            evidence=[
+                schema.Evidence("dns_latency_ms", 220.0, "ebpf"),
+                schema.Evidence("fault_label", "dns_latency", "application"),
+            ],
+            slo_impact=schema.SLOImpact("ttft_ms", 2.4, 30),
+            trace_ids=["trace-0001"],
+            request_ids=["req-0001"],
+            fault_hypotheses=[
+                schema.FaultHypothesis("network_dns", 0.92, ["dns_latency_ms"]),
+                schema.FaultHypothesis("network_egress", 0.05, []),
+            ],
+        )
+
+    def test_valid_attribution_passes_contract(self):
+        schema.validate(self.make().to_dict(), schema.SCHEMA_INCIDENT_ATTRIBUTION)
+
+    @pytest.mark.parametrize(
+        "domain", ["tpu_ici", "tpu_hbm", "xla_compile", "host_offload"]
+    )
+    def test_tpu_fault_domains_accepted(self, domain):
+        schema.validate(
+            self.make(domain=domain).to_dict(), schema.SCHEMA_INCIDENT_ATTRIBUTION
+        )
+
+    def test_unknown_domain_rejected(self):
+        payload = self.make(domain="gpu_meltdown").to_dict()
+        with pytest.raises(schema.SchemaValidationError):
+            schema.validate(payload, schema.SCHEMA_INCIDENT_ATTRIBUTION)
+
+    def test_confidence_out_of_range_rejected(self):
+        bad = self.make()
+        bad.confidence = 1.7
+        with pytest.raises(schema.SchemaValidationError):
+            schema.validate(bad.to_dict(), schema.SCHEMA_INCIDENT_ATTRIBUTION)
+
+    def test_libtpu_evidence_source_accepted(self):
+        att = self.make(domain="tpu_hbm")
+        att.evidence = [schema.Evidence("hbm_alloc_stall_ms", 45.0, "libtpu")]
+        schema.validate(att.to_dict(), schema.SCHEMA_INCIDENT_ATTRIBUTION)
+
+
+class TestSchemaCompilation:
+    def test_all_schemas_compile(self):
+        for name in schema.ALL_SCHEMAS:
+            assert schema.load_schema(name)["$schema"]
+
+    def test_is_valid_nonraising(self):
+        assert not schema.is_valid({}, schema.SCHEMA_SLO_EVENT)
+
+
+class TestTimestamps:
+    def test_parse_round_trip(self):
+        assert schema.parse_rfc3339(schema.rfc3339(TS)) == TS
+
+    def test_naive_datetime_treated_as_utc(self):
+        naive = datetime(2026, 7, 29, 12, 0, 0)
+        assert schema.rfc3339(naive) == "2026-07-29T12:00:00Z"

@@ -1,0 +1,193 @@
+"""Signal-layer tests. Reference model: pkg/signals/*_test.go."""
+
+from datetime import datetime, timezone
+
+import pytest
+
+from tpuslo import collector, schema, signals
+
+TS = datetime(2026, 7, 29, 12, 0, 0, tzinfo=timezone.utc)
+META = signals.Metadata(
+    node="tpu-vm-0",
+    namespace="llm",
+    pod="rag-service-abc",
+    container="rag",
+    pid=1234,
+    tid=1234,
+    tpu_chip="accel0",
+    slice_id="v5e-8-slice0",
+)
+
+
+def make_sample(fault="baseline", idx=0):
+    return collector.build_synthetic_sample(fault, idx, TS, collector.SampleMeta())
+
+
+class TestConstants:
+    def test_signal_counts(self):
+        assert len(signals.CPU_SIGNALS) == 12
+        assert len(signals.TPU_SIGNALS) == 6
+        assert len(signals.ALL_SIGNALS) == 18
+
+    def test_mode_signal_sets(self):
+        assert len(signals.supported_signals_for_mode(signals.CAPABILITY_TPU_FULL)) == 18
+        assert len(signals.supported_signals_for_mode(signals.CAPABILITY_CORE_FULL)) == 12
+        assert signals.supported_signals_for_mode(signals.CAPABILITY_BCC_DEGRADED) == [
+            "dns_latency_ms",
+            "tcp_retransmits_total",
+        ]
+
+    def test_disable_order_covers_all_and_tpu_first(self):
+        order = signals.disable_order()
+        assert sorted(order) == sorted(signals.ALL_SIGNALS)
+        # All six TPU signals shed before any kernel probe.
+        assert set(order[:6]) == set(signals.TPU_SIGNALS)
+
+    def test_thresholds_and_units_complete(self):
+        for name in signals.ALL_SIGNALS:
+            assert name in signals.SIGNAL_THRESHOLDS
+            assert name in signals.SIGNAL_UNITS
+
+
+class TestGenerator:
+    def test_tpu_full_emits_18_events(self):
+        gen = signals.Generator(signals.CAPABILITY_TPU_FULL, enricher=None)
+        events = gen.generate(make_sample(), META)
+        assert len(events) == 18
+        for event in events:
+            schema.validate(event.to_dict(), schema.SCHEMA_PROBE_EVENT)
+
+    def test_capability_filters_requested_signals(self):
+        gen = signals.Generator(
+            signals.CAPABILITY_BCC_DEGRADED,
+            signal_set=["dns_latency_ms", "xla_compile_ms"],
+        )
+        assert gen.enabled_signals() == ["dns_latency_ms"]
+
+    def test_ici_drop_elevates_ici_signals(self):
+        gen = signals.Generator(signals.CAPABILITY_TPU_FULL)
+        events = {e.signal: e for e in gen.generate(make_sample("ici_drop"), META)}
+        assert events["ici_link_retries_total"].status == "error"
+        assert events["ici_collective_latency_ms"].status == "error"
+        assert events["dns_latency_ms"].status == "ok"
+
+    def test_recompile_storm_elevates_compile_signal(self):
+        gen = signals.Generator(signals.CAPABILITY_TPU_FULL)
+        events = {e.signal: e for e in gen.generate(make_sample("xla_recompile_storm"), META)}
+        assert events["xla_compile_ms"].status == "error"
+        assert events["xla_compile_ms"].value == 3200
+        assert events["runqueue_delay_ms"].status == "warning"
+
+    def test_tpu_events_carry_accelerator_identity(self):
+        gen = signals.Generator(signals.CAPABILITY_TPU_FULL)
+        events = {e.signal: e for e in gen.generate(make_sample("hbm_pressure", idx=6), META)}
+        hbm = events["hbm_alloc_stall_ms"]
+        assert hbm.tpu is not None
+        assert hbm.tpu.chip == "accel0"
+        assert hbm.tpu.slice_id == "v5e-8-slice0"
+        assert hbm.tpu.launch_id == 7  # collector-req-0007
+        assert events["dns_latency_ms"].tpu is None
+
+    def test_provider_throttle_sets_errno(self):
+        gen = signals.Generator(signals.CAPABILITY_CORE_FULL)
+        events = {e.signal: e for e in gen.generate(make_sample("provider_throttle"), META)}
+        assert events["connect_latency_ms"].errno == 110
+        assert events["dns_latency_ms"].errno is None
+
+    def test_disable_highest_cost_order(self):
+        gen = signals.Generator(signals.CAPABILITY_TPU_FULL)
+        shed = gen.disable_highest_cost()
+        assert shed == "ici_collective_latency_ms"
+        assert shed not in gen.enabled_signals()
+        # Exhaust the full set.
+        count = 1
+        while gen.disable_highest_cost() is not None:
+            count += 1
+        assert count == 18
+        assert gen.disable_highest_cost() is None
+        assert gen.generate(make_sample(), META) == []
+
+    def test_disable_specific_signal(self):
+        gen = signals.Generator(signals.CAPABILITY_TPU_FULL)
+        assert gen.disable("dns_latency_ms") is True
+        assert gen.disable("dns_latency_ms") is False
+
+    def test_static_enricher_fills_blanks(self):
+        enricher = signals.StaticMetadataEnricher(META)
+        gen = signals.Generator(signals.CAPABILITY_TPU_FULL, enricher=enricher)
+        events = gen.generate(make_sample(), signals.Metadata())
+        assert events[0].node == "tpu-vm-0"
+        assert events[0].pod == "rag-service-abc"
+
+
+class TestMetadata:
+    def test_parse_cgroup_identity(self):
+        content = (
+            "0::/kubepods.slice/kubepods-burstable.slice/"
+            "pod8f2b9c1a-1111-2222-3333-444455556666/"
+            "cri-containerd-0123456789abcdef0123456789abcdef.scope\n"
+        )
+        pod, container = signals.parse_cgroup_identity(content)
+        assert pod == "8f2b9c1a-1111-2222-3333-444455556666"
+        assert container == "0123456789abcdef0123456789abcdef"
+
+    def test_proc_enricher_missing_pid_noop(self, tmp_path):
+        enricher = signals.ProcMetadataEnricher(proc_root=str(tmp_path))
+        meta = signals.Metadata(pid=99999)
+        assert enricher.enrich(meta) == meta
+
+    def test_proc_enricher_reads_cgroup(self, tmp_path):
+        pid_dir = tmp_path / "4242"
+        pid_dir.mkdir()
+        (pid_dir / "cgroup").write_text(
+            "0::/kubepods/podaabbccdd-0000-1111-2222-333344445555/"
+            "0123456789abcdef0123456789abcdef\n"
+        )
+        enricher = signals.ProcMetadataEnricher(proc_root=str(tmp_path))
+        out = enricher.enrich(signals.Metadata(pid=4242))
+        assert out.pod == "aabbccdd-0000-1111-2222-333344445555"
+
+    def test_tpu_enricher_env(self, tmp_path):
+        (tmp_path / "accel0").touch()
+        (tmp_path / "accel1").touch()
+        enricher = signals.TPUMetadataEnricher(
+            dev_glob=str(tmp_path / "accel*"),
+            env={"TPU_WORKER_ID": "2", "MEGASCALE_SLICE_ID": "slice-7"},
+        )
+        out = enricher.enrich(signals.Metadata())
+        assert out.tpu_chip == "accel0"
+        assert out.slice_id == "slice-7"
+        assert out.host_index == 2
+        assert enricher.discover_chips() == ["accel0", "accel1"]
+
+
+class TestMode:
+    def test_detect_no_btf_degraded(self, tmp_path):
+        mode = signals.detect_capability_mode(
+            btf_path=str(tmp_path / "missing"),
+            accel_glob=str(tmp_path / "accel*"),
+            env={},
+        )
+        assert mode == signals.CAPABILITY_BCC_DEGRADED
+
+    def test_detect_btf_no_tpu_core_full(self, tmp_path):
+        btf = tmp_path / "vmlinux"
+        btf.touch()
+        mode = signals.detect_capability_mode(
+            btf_path=str(btf), accel_glob=str(tmp_path / "accel*"), env={}
+        )
+        assert mode == signals.CAPABILITY_CORE_FULL
+
+    def test_detect_tpu_full(self, tmp_path):
+        btf = tmp_path / "vmlinux"
+        btf.touch()
+        (tmp_path / "accel0").touch()
+        mode = signals.detect_capability_mode(
+            btf_path=str(btf), accel_glob=str(tmp_path / "accel*"), env={}
+        )
+        assert mode == signals.CAPABILITY_TPU_FULL
+
+    def test_parse_explicit_mode(self):
+        assert signals.parse_capability_mode("core_full") == "core_full"
+        with pytest.raises(ValueError):
+            signals.parse_capability_mode("quantum")

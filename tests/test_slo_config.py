@@ -1,0 +1,119 @@
+"""SLO math + config loader tests. Reference: pkg/slo, pkg/toolkitcfg tests."""
+
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from tpuslo import slo
+from tpuslo.config import default_config, load_config
+
+TS = datetime(2026, 7, 29, 12, 0, 0, tzinfo=timezone.utc)
+
+
+class TestSLOMath:
+    def test_ttft(self):
+        assert slo.ttft_ms(TS, TS + timedelta(milliseconds=250)) == 250.0
+
+    def test_ttft_order_enforced(self):
+        with pytest.raises(ValueError):
+            slo.ttft_ms(TS, TS - timedelta(seconds=1))
+        with pytest.raises(ValueError):
+            slo.ttft_ms(None, TS)
+
+    def test_tokens_per_second(self):
+        tps = slo.tokens_per_second(TS, TS + timedelta(seconds=2), 50)
+        assert tps == 25.0
+
+    def test_tokens_zero_window_returns_count(self):
+        assert slo.tokens_per_second(TS, TS, 7) == 7.0
+
+    def test_tokens_validation(self):
+        with pytest.raises(ValueError):
+            slo.tokens_per_second(TS, TS, 0)
+
+    def test_calculate_snapshot(self):
+        timing = slo.Timing(
+            request_start=TS,
+            first_token_at=TS + timedelta(milliseconds=300),
+            last_token_at=TS + timedelta(milliseconds=1300),
+            token_count=40,
+        )
+        snap = slo.calculate(timing, slo.RetrievalBreakdown(10, 20, 5))
+        assert snap.ttft_ms == 300.0
+        assert snap.tokens_per_s == 40.0
+        assert slo.total_retrieval_ms(snap.retrieval) == 35.0
+
+    def test_quantile_interpolation(self):
+        values = [10.0, 20.0, 30.0, 40.0]
+        assert slo.quantile(values, 0.5) == 25.0
+        assert slo.quantile(values, 0.0) == 10.0
+        assert slo.quantile(values, 1.0) == 40.0
+        assert slo.quantile([], 0.5) == 0.0
+        assert slo.quantile([5.0], 0.95) == 5.0
+
+    def test_aggregate(self):
+        snaps = [
+            slo.Snapshot(ttft_ms=float(v), tokens_per_s=float(100 - v))
+            for v in (100, 200, 300, 400, 500)
+        ]
+        agg = slo.aggregate(snaps)
+        assert agg.ttft_p50 == 300.0
+        assert agg.ttft_p95 == pytest.approx(480.0)
+        # negative throughputs are clamped to zero before aggregation
+        assert agg.tokens_per_s_p50 == 0.0
+        assert slo.aggregate([]) == slo.Percentiles()
+
+    def test_aggregate_clamps_negatives(self):
+        agg = slo.aggregate([slo.Snapshot(ttft_ms=-5.0, tokens_per_s=-1.0)])
+        assert agg.ttft_p50 == 0.0
+
+
+class TestToolkitConfig:
+    def test_defaults_validate_contract(self):
+        cfg = default_config()
+        assert cfg.safety.max_overhead_pct == 3.0
+        assert "xla_compile_ms" in cfg.signal_set
+        assert cfg.correlation.window_ms == 2000
+
+    def test_load_overrides_and_normalizes(self, tmp_path):
+        path = tmp_path / "toolkit.yaml"
+        path.write_text(
+            """
+apiVersion: toolkit.tpuslo.dev/v1alpha1
+kind: ToolkitConfig
+signal_set: [dns_latency_ms, xla_compile_ms]
+sampling:
+  events_per_second_limit: 500
+  burst_limit: 0
+correlation:
+  window_ms: 1000
+safety:
+  max_overhead_pct: 2.5
+webhook:
+  enabled: true
+  url: http://hooks.example/incident
+  format: pagerduty
+tpu:
+  slice_id: v5e-8-s0
+"""
+        )
+        cfg = load_config(str(path))
+        assert cfg.signal_set == ["dns_latency_ms", "xla_compile_ms"]
+        assert cfg.sampling.events_per_second_limit == 500
+        assert cfg.sampling.burst_limit == 20000  # zero -> default
+        assert cfg.correlation.window_ms == 1000
+        assert cfg.safety.max_overhead_pct == 2.5
+        assert cfg.webhook.enabled and cfg.webhook.format == "pagerduty"
+        assert cfg.tpu.slice_id == "v5e-8-s0"
+
+    def test_load_rejects_bad_schema(self, tmp_path):
+        path = tmp_path / "bad.yaml"
+        path.write_text("correlation:\n  window_ms: -5\n")
+        with pytest.raises(Exception):
+            load_config(str(path))
+
+    def test_load_rejects_non_mapping(self, tmp_path):
+        path = tmp_path / "list.yaml"
+        path.write_text("- a\n- b\n")
+        with pytest.raises(ValueError):
+            load_config(str(path))

@@ -1,0 +1,26 @@
+"""tpuslo — TPU-native SLO observability and fault-attribution toolkit.
+
+A three-stage pipeline for LLM inference services on TPU-VM hosts:
+
+1. **Collection** — low-level signals per node: the nine classic kernel
+   signals (DNS latency, TCP retransmits, runqueue delay, connect latency,
+   TLS handshake, CPU steal, memory reclaim, disk I/O, syscall latency)
+   plus TPU-native probes (uprobes on ``libtpu.so``, kprobes on the
+   ``/dev/accel*`` driver) capturing XLA-compile latency, HBM-allocation
+   stalls, ICI link retries, collective latency, and host-offload stalls.
+2. **Correlation** — tiered confidence join of signals to JAX/XLA
+   OpenTelemetry spans (trace-id exact, XLA launch-id, pod+pid, pod+conn,
+   slice+host, service+node).
+3. **Attribution** — naive-Bayes posterior over twelve fault domains
+   (network, compute, provider, retrieval + TPU domains ICI / HBM /
+   XLA-compile / host-offload) producing ranked fault hypotheses with
+   confusion-matrix evaluation and statistical release gates.
+
+Capability parity with the reference toolkit
+(ogulcanaydogan/llm-slo-ebpf-toolkit) is documented per-module via
+``Reference:`` docstring citations (file:line into /root/reference).
+"""
+
+__version__ = "0.1.0"
+
+TOOLKIT_NAME = "tpuslo"

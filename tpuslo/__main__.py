@@ -1,0 +1,39 @@
+"""Dispatcher for the eleven toolkit binaries: ``python -m tpuslo <name>``."""
+
+from __future__ import annotations
+
+import importlib
+import sys
+
+BINARIES = {
+    "agent": "tpuslo.cli.agent",
+    "collector": "tpuslo.cli.collector",
+    "attributor": "tpuslo.cli.attributor",
+    "benchgen": "tpuslo.cli.benchgen",
+    "faultreplay": "tpuslo.cli.faultreplay",
+    "faultinject": "tpuslo.cli.faultinject",
+    "correlationeval": "tpuslo.cli.correlationeval",
+    "m5gate": "tpuslo.cli.m5gate",
+    "sloctl": "tpuslo.cli.sloctl",
+    "loadgen": "tpuslo.cli.loadgen",
+    "schemavalidate": "tpuslo.cli.schemavalidate",
+}
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        names = "\n  ".join(sorted(BINARIES))
+        print(f"usage: python -m tpuslo <binary> [flags]\n\nbinaries:\n  {names}")
+        return 0 if argv else 2
+    name, rest = argv[0], argv[1:]
+    module_path = BINARIES.get(name)
+    if module_path is None:
+        print(f"tpuslo: unknown binary {name!r}", file=sys.stderr)
+        return 2
+    module = importlib.import_module(module_path)
+    return module.main(rest)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,354 @@
+"""Naive-Bayes multi-fault attribution over twelve fault domains.
+
+Reference: ``pkg/attribution/bayesian.go`` — uniform priors, a
+signal→domain likelihood table P(signal_elevated | domain), elevation
+thresholds equal to the generator's warning thresholds, log-space
+posterior with log-sum-exp normalization, likelihood clamp [0.01, 0.99],
+and evidence lists built from elevated signals with P ≥ 0.5.
+
+The TPU-native build extends the model with four accelerator fault
+domains (``tpu_ici``, ``tpu_hbm``, ``xla_compile``, ``host_offload``)
+and six TPU signal rows; the table encodes cross-domain bleed (HBM
+pressure spills to host offload, recompiles warm the host runqueue) so
+multi-fault coverage metrics stay meaningful.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+from tpuslo.attribution.mapper import FaultSample, build_attribution
+from tpuslo.schema import FaultHypothesis, IncidentAttribution
+
+# --- Fault domains ------------------------------------------------------
+DOMAIN_NETWORK_DNS = "network_dns"
+DOMAIN_NETWORK_EGRESS = "network_egress"
+DOMAIN_CPU_THROTTLE = "cpu_throttle"
+DOMAIN_MEMORY_PRESSURE = "memory_pressure"
+DOMAIN_PROVIDER_THROTTLE = "provider_throttle"
+DOMAIN_PROVIDER_ERROR = "provider_error"
+DOMAIN_RETRIEVAL_BACKEND = "retrieval_backend"
+DOMAIN_TPU_ICI = "tpu_ici"
+DOMAIN_TPU_HBM = "tpu_hbm"
+DOMAIN_XLA_COMPILE = "xla_compile"
+DOMAIN_HOST_OFFLOAD = "host_offload"
+DOMAIN_UNKNOWN = "unknown"
+
+ALL_DOMAINS: tuple[str, ...] = (
+    DOMAIN_NETWORK_DNS,
+    DOMAIN_NETWORK_EGRESS,
+    DOMAIN_CPU_THROTTLE,
+    DOMAIN_MEMORY_PRESSURE,
+    DOMAIN_PROVIDER_THROTTLE,
+    DOMAIN_PROVIDER_ERROR,
+    DOMAIN_RETRIEVAL_BACKEND,
+    DOMAIN_TPU_ICI,
+    DOMAIN_TPU_HBM,
+    DOMAIN_XLA_COMPILE,
+    DOMAIN_HOST_OFFLOAD,
+    DOMAIN_UNKNOWN,
+)
+
+TPU_DOMAINS: tuple[str, ...] = (
+    DOMAIN_TPU_ICI,
+    DOMAIN_TPU_HBM,
+    DOMAIN_XLA_COMPILE,
+    DOMAIN_HOST_OFFLOAD,
+)
+
+# A signal is "elevated" (counts as evidence) at its warning threshold;
+# kept in sync with tpuslo.signals.generator.SIGNAL_THRESHOLDS.
+SIGNAL_ELEVATION_THRESHOLDS: dict[str, float] = {
+    "dns_latency_ms": 40,
+    "tcp_retransmits_total": 2,
+    "runqueue_delay_ms": 10,
+    "connect_latency_ms": 80,
+    "tls_handshake_ms": 60,
+    "cpu_steal_pct": 2,
+    "cfs_throttled_ms": 40,
+    "mem_reclaim_latency_ms": 5,
+    "disk_io_latency_ms": 10,
+    "syscall_latency_ms": 50,
+    "connect_errors_total": 1,
+    "tls_handshake_fail_total": 1,
+    "xla_compile_ms": 500,
+    "hbm_alloc_stall_ms": 5,
+    "hbm_utilization_pct": 85,
+    "ici_link_retries_total": 5,
+    "ici_collective_latency_ms": 10,
+    "host_offload_stall_ms": 20,
+}
+
+
+def _row(
+    dns=0.10, egress=0.10, cpu=0.10, mem=0.10, pthr=0.10, perr=0.10,
+    retr=0.10, ici=0.05, hbm=0.05, xla=0.05, offload=0.05, unknown=0.10,
+) -> dict[str, float]:
+    return {
+        DOMAIN_NETWORK_DNS: dns,
+        DOMAIN_NETWORK_EGRESS: egress,
+        DOMAIN_CPU_THROTTLE: cpu,
+        DOMAIN_MEMORY_PRESSURE: mem,
+        DOMAIN_PROVIDER_THROTTLE: pthr,
+        DOMAIN_PROVIDER_ERROR: perr,
+        DOMAIN_RETRIEVAL_BACKEND: retr,
+        DOMAIN_TPU_ICI: ici,
+        DOMAIN_TPU_HBM: hbm,
+        DOMAIN_XLA_COMPILE: xla,
+        DOMAIN_HOST_OFFLOAD: offload,
+        DOMAIN_UNKNOWN: unknown,
+    }
+
+
+def default_priors() -> dict[str, float]:
+    """Uniform priors over the twelve domains."""
+    p = 1.0 / len(ALL_DOMAINS)
+    return {d: p for d in ALL_DOMAINS}
+
+
+def default_likelihoods() -> dict[str, dict[str, float]]:
+    """P(signal elevated | domain) for all 18 signals × 12 domains.
+
+    CPU-signal columns over the original eight domains follow the
+    reference table (``bayesian.go:67-190``); TPU columns/rows are
+    designed from the fault physiology in
+    ``tpuslo.signals.generator._FAULT_OVERRIDES``.
+    """
+    return {
+        "dns_latency_ms": _row(dns=0.95, egress=0.70, retr=0.15),
+        "tcp_retransmits_total": _row(dns=0.15, egress=0.90, perr=0.15),
+        "runqueue_delay_ms": _row(
+            cpu=0.90, mem=0.60, xla=0.45, hbm=0.10, offload=0.10
+        ),
+        "connect_latency_ms": _row(
+            dns=0.50, egress=0.85, pthr=0.75, perr=0.40, retr=0.30
+        ),
+        "tls_handshake_ms": _row(egress=0.30, pthr=0.80, perr=0.50, retr=0.20),
+        "cpu_steal_pct": _row(cpu=0.90, mem=0.20),
+        "cfs_throttled_ms": _row(cpu=0.85, mem=0.75, xla=0.15),
+        "mem_reclaim_latency_ms": _row(
+            dns=0.05, egress=0.05, cpu=0.15, mem=0.95, pthr=0.05, perr=0.05,
+            retr=0.05, unknown=0.05,
+        ),
+        "disk_io_latency_ms": _row(
+            dns=0.05, egress=0.05, mem=0.85, pthr=0.05, perr=0.05,
+            retr=0.30, offload=0.55, unknown=0.05,
+        ),
+        "syscall_latency_ms": _row(
+            egress=0.20, cpu=0.15, pthr=0.90, perr=0.60, retr=0.40,
+            offload=0.50,
+        ),
+        "connect_errors_total": _row(
+            egress=0.80, cpu=0.05, mem=0.05, pthr=0.60, perr=0.85, retr=0.15
+        ),
+        "tls_handshake_fail_total": _row(
+            dns=0.05, egress=0.70, cpu=0.05, mem=0.05, pthr=0.30, perr=0.60,
+            unknown=0.05,
+        ),
+        # --- TPU signal rows ------------------------------------------
+        # Compile latency is near-exclusive to recompile storms; HBM
+        # churn can force re-layout compiles occasionally.
+        "xla_compile_ms": _row(
+            dns=0.05, egress=0.05, cpu=0.10, mem=0.05, pthr=0.05, perr=0.05,
+            retr=0.05, ici=0.05, hbm=0.15, xla=0.95, offload=0.05,
+            unknown=0.05,
+        ),
+        # Allocation stalls: HBM exhaustion; spilling to host shows a
+        # weaker echo, as can compile-time buffer churn.
+        "hbm_alloc_stall_ms": _row(
+            dns=0.05, egress=0.05, cpu=0.05, mem=0.10, pthr=0.05, perr=0.05,
+            retr=0.05, ici=0.05, hbm=0.95, xla=0.20, offload=0.30,
+            unknown=0.05,
+        ),
+        "hbm_utilization_pct": _row(
+            dns=0.05, egress=0.05, cpu=0.05, mem=0.10, pthr=0.05, perr=0.05,
+            retr=0.05, ici=0.10, hbm=0.90, xla=0.15, offload=0.40,
+            unknown=0.10,
+        ),
+        "ici_link_retries_total": _row(
+            dns=0.05, egress=0.05, cpu=0.05, mem=0.05, pthr=0.05, perr=0.05,
+            retr=0.05, ici=0.95, hbm=0.05, xla=0.05, offload=0.05,
+            unknown=0.05,
+        ),
+        # Slow collectives: degraded ICI first; HBM pressure and host
+        # launch delay stretch collectives secondarily.
+        "ici_collective_latency_ms": _row(
+            dns=0.05, egress=0.05, cpu=0.15, mem=0.05, pthr=0.05, perr=0.05,
+            retr=0.05, ici=0.90, hbm=0.20, xla=0.10, offload=0.10,
+            unknown=0.05,
+        ),
+        # Host<->device stalls: offload path first; HBM pressure induces
+        # spilling which surfaces here too.
+        "host_offload_stall_ms": _row(
+            dns=0.05, egress=0.05, cpu=0.10, mem=0.20, pthr=0.05, perr=0.05,
+            retr=0.05, ici=0.15, hbm=0.55, xla=0.05, offload=0.95,
+            unknown=0.05,
+        ),
+    }
+
+
+@dataclass
+class Posterior:
+    """One domain's posterior probability with its supporting evidence."""
+
+    domain: str
+    posterior: float
+    evidence: list[str] = field(default_factory=list)
+
+
+def _clamp(p: float) -> float:
+    return min(0.99, max(0.01, p))
+
+
+class BayesianAttributor:
+    """Log-space naive Bayes over fault domains.
+
+    Reference: ``pkg/attribution/bayesian.go:218-343``.
+    """
+
+    def __init__(
+        self,
+        priors: dict[str, float] | None = None,
+        likelihoods: dict[str, dict[str, float]] | None = None,
+    ):
+        self.priors = priors or default_priors()
+        self.likelihoods = likelihoods or default_likelihoods()
+
+    def elevated_signals(self, signals: dict[str, float]) -> set[str]:
+        return {
+            name
+            for name, value in signals.items()
+            if name in SIGNAL_ELEVATION_THRESHOLDS
+            and value >= SIGNAL_ELEVATION_THRESHOLDS[name]
+        }
+
+    def _likelihood(self, signal: str, domain: str, elevated: bool) -> float:
+        row = self.likelihoods.get(signal)
+        if row is None:
+            return 0.5
+        p = row.get(domain, 0.5)
+        return _clamp(p if elevated else 1.0 - p)
+
+    def attribute(
+        self,
+        signals: dict[str, float],
+        observed: set[str] | None = None,
+    ) -> list[Posterior]:
+        """Posteriors over all domains, sorted descending.
+
+        ``observed`` restricts which likelihood rows enter the product;
+        signals outside it are treated as unobserved (factor skipped)
+        rather than not-elevated.  By default only signals present in
+        the input vector are observed — a deliberate departure from the
+        reference (which folds *absent* signals in as evidence of
+        health): in ``bcc_degraded`` or shed-probe operation most
+        signals are not collected at all, and counting them as healthy
+        systematically biases toward domains with small probe
+        footprints.  For full 18-signal vectors the two semantics
+        coincide.
+        """
+        if observed is None:
+            observed = set(signals)
+        elevated = self.elevated_signals(signals)
+
+        log_posteriors: dict[str, float] = {}
+        for domain in ALL_DOMAINS:
+            log_p = math.log(max(self.priors.get(domain, 0.0), 1e-10))
+            for signal in self.likelihoods:
+                if signal not in observed:
+                    continue
+                log_p += math.log(
+                    self._likelihood(signal, domain, signal in elevated)
+                )
+            log_posteriors[domain] = log_p
+
+        max_log = max(log_posteriors.values())
+        log_z = max_log + math.log(
+            sum(math.exp(lp - max_log) for lp in log_posteriors.values())
+        )
+
+        out = []
+        for domain in ALL_DOMAINS:
+            evidence = sorted(
+                s
+                for s in elevated
+                if self.likelihoods.get(s, {}).get(domain, 0.0) >= 0.5
+            )
+            out.append(
+                Posterior(
+                    domain=domain,
+                    posterior=math.exp(log_posteriors[domain] - log_z),
+                    evidence=evidence,
+                )
+            )
+        out.sort(key=lambda p: p.posterior, reverse=True)
+        return out
+
+    def attribute_sample(self, sample: FaultSample) -> IncidentAttribution:
+        """Full attribution envelope for one fault sample.
+
+        Without a signal vector this degrades to the rule-based mapping,
+        mirroring reference ``bayesian.go:315-343``.
+        """
+        base = build_attribution(sample)
+        if not sample.signals:
+            return base
+
+        posteriors = self.attribute(sample.signals)
+        hypotheses = {
+            p.domain: FaultHypothesis(p.domain, p.posterior, p.evidence)
+            for p in posteriors
+            if p.posterior >= 0.01
+        }
+
+        secondary = self._residual_posterior(sample.signals, posteriors[0])
+        if secondary is not None and (
+            secondary.domain not in hypotheses
+            or hypotheses[secondary.domain].posterior < secondary.posterior
+        ):
+            hypotheses[secondary.domain] = FaultHypothesis(
+                secondary.domain, secondary.posterior, secondary.evidence
+            )
+
+        base.fault_hypotheses = sorted(
+            hypotheses.values(), key=lambda h: h.posterior, reverse=True
+        )
+        base.predicted_fault_domain = posteriors[0].domain
+        base.confidence = posteriors[0].posterior
+        return base
+
+    def _residual_posterior(
+        self, signals: dict[str, float], top: Posterior
+    ) -> Posterior | None:
+        """Greedy explaining-away pass for concurrent faults.
+
+        Naive Bayes is a single-cause model: with two simultaneous
+        faults the posterior collapses onto whichever domain explains
+        more elevated signals, and the second fault vanishes from the
+        hypothesis list.  This pass re-attributes the elevated signals
+        the winning domain does *not* explain (likelihood < 0.5),
+        treating explained signals as unobserved, and surfaces the
+        winner as a secondary hypothesis damped by the remaining
+        probability mass (floored so a decisive top-1 can't erase a
+        clearly-present second fault).
+        """
+        elevated = self.elevated_signals(signals)
+        residual = {
+            s
+            for s in elevated
+            if self.likelihoods.get(s, {}).get(top.domain, 0.0) < 0.5
+        }
+        if not residual:
+            return None
+
+        ranked = self.attribute(signals, observed=residual)
+        winner = ranked[0]
+        if winner.domain in (top.domain, DOMAIN_UNKNOWN) or not winner.evidence:
+            return None
+        weight = max(1.0 - top.posterior, 0.1)
+        return Posterior(
+            domain=winner.domain,
+            posterior=winner.posterior * weight,
+            evidence=winner.evidence,
+        )

@@ -1,0 +1,7 @@
+from tpuslo.benchmark.harness import (
+    ArtifactBundle,
+    Options,
+    generate_artifacts,
+)
+
+__all__ = ["ArtifactBundle", "Options", "generate_artifacts"]

@@ -1,0 +1,19 @@
+from tpuslo.cdgate.gate import (
+    DEFAULT_QUERIES,
+    CheckResult,
+    GateReport,
+    HTTPQuerier,
+    PrometheusQuerier,
+    QueryError,
+    evaluate_slo_gate,
+)
+
+__all__ = [
+    "DEFAULT_QUERIES",
+    "CheckResult",
+    "GateReport",
+    "HTTPQuerier",
+    "PrometheusQuerier",
+    "QueryError",
+    "evaluate_slo_gate",
+]

@@ -1,0 +1,117 @@
+"""CD pipeline SLO gate backed by Prometheus instant queries.
+
+Reference: ``pkg/cdgate/gate.go:44-175`` — three PromQL checks (TTFT
+p95, error-rate ratio, burn rate) against configured thresholds;
+fail-open semantics are applied by the caller (``cmd/sloctl``).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Protocol
+
+DEFAULT_QUERIES = {
+    "ttft_p95_ms": (
+        "histogram_quantile(0.95, sum(rate(llm_slo_ttft_ms_bucket[5m])) by (le))"
+    ),
+    "error_rate": (
+        "sum(rate(llm_slo_requests_errors_total[5m])) "
+        "/ sum(rate(llm_slo_requests_total[5m]))"
+    ),
+    "burn_rate": "llm_slo_burn_rate",
+}
+
+
+class QueryError(RuntimeError):
+    pass
+
+
+class PrometheusQuerier(Protocol):
+    def query(self, promql: str) -> float: ...
+
+
+class HTTPQuerier:
+    """Instant-query client for the Prometheus HTTP API."""
+
+    def __init__(self, base_url: str, timeout_s: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+
+    def query(self, promql: str) -> float:
+        url = (
+            f"{self.base_url}/api/v1/query?"
+            + urllib.parse.urlencode({"query": promql})
+        )
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout_s) as resp:
+                payload = json.loads(resp.read())
+        except (urllib.error.URLError, json.JSONDecodeError) as exc:
+            raise QueryError(f"prometheus query failed: {exc}") from exc
+        if payload.get("status") != "success":
+            raise QueryError(f"prometheus returned status {payload.get('status')}")
+        results = payload.get("data", {}).get("result", [])
+        if not results:
+            raise QueryError(f"no samples for query: {promql}")
+        try:
+            return float(results[0]["value"][1])
+        except (KeyError, IndexError, TypeError, ValueError) as exc:
+            raise QueryError(f"malformed prometheus result: {exc}") from exc
+
+
+@dataclass
+class CheckResult:
+    name: str
+    query: str
+    value: float = 0.0
+    threshold: float = 0.0
+    passed: bool = False
+    error: str = ""
+
+
+@dataclass
+class GateReport:
+    passed: bool = True
+    checks: list[CheckResult] = field(default_factory=list)
+    query_failures: int = 0
+
+    def to_dict(self):
+        return {
+            "passed": self.passed,
+            "query_failures": self.query_failures,
+            "checks": [c.__dict__ for c in self.checks],
+        }
+
+
+def evaluate_slo_gate(
+    querier: PrometheusQuerier,
+    ttft_p95_ms: float = 800.0,
+    error_rate: float = 0.05,
+    burn_rate: float = 2.0,
+    queries: dict[str, str] | None = None,
+) -> GateReport:
+    """Run the three SLO checks; a query failure marks the gate failed
+    (caller may apply fail-open)."""
+    queries = queries or DEFAULT_QUERIES
+    thresholds = {
+        "ttft_p95_ms": ttft_p95_ms,
+        "error_rate": error_rate,
+        "burn_rate": burn_rate,
+    }
+    report = GateReport()
+    for name, threshold in thresholds.items():
+        check = CheckResult(name=name, query=queries[name], threshold=threshold)
+        try:
+            check.value = querier.query(check.query)
+            check.passed = check.value <= threshold
+        except QueryError as exc:
+            check.error = str(exc)
+            check.passed = False
+            report.query_failures += 1
+        if not check.passed:
+            report.passed = False
+        report.checks.append(check)
+    return report

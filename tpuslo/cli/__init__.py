@@ -1,0 +1,7 @@
+"""L9 CLI surface — eleven binaries behind one dispatcher.
+
+Reference: ``cmd/`` (agent, collector, attributor, benchgen,
+faultreplay, faultinject, correlationeval, m5gate, sloctl, loadgen,
+schemavalidate; ``docs/ARCHITECTURE.md:60-74``).  Invoke as
+``python -m tpuslo <binary> [flags]``.
+"""

@@ -1,0 +1,247 @@
+"""Node agent: the toolkit's ``serve()`` loop.
+
+Reference: ``cmd/agent/main.go`` — synthetic scenario → SLO + probe
+events → stdout/jsonl/OTLP, Prometheus metrics server on :2112,
+overhead-guard probe shedding, rate limiting with drop accounting,
+optional webhook attribution, ``--probe-smoke`` privilege check.
+
+The real-probe path swaps in behind ``--probe-source ring`` once the
+native loader is present (closing the reference's biggest gap: its
+ring-buffer consumer is never wired into the agent loop — SURVEY.md §0).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from tpuslo import attribution, webhook
+from tpuslo.cli.common import EventWriters, resolve_config, validate_probe, validate_slo
+from tpuslo.collector import (
+    SampleMeta,
+    build_synthetic_sample,
+    normalize_sample,
+    supported_synthetic_scenarios,
+)
+from tpuslo.collector.kernel import probe_smoke_check
+from tpuslo.metrics import AgentMetrics, start_metrics_server
+from tpuslo.safety import OverheadGuard, RateLimiter
+from tpuslo.signals import (
+    Generator,
+    Metadata,
+    StaticMetadataEnricher,
+    TPUMetadataEnricher,
+    parse_capability_mode,
+    profile_for_fault,
+)
+from datetime import datetime, timezone
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpuslo agent", description=__doc__)
+    p.add_argument("--config", default="", help="toolkit.yaml path")
+    p.add_argument(
+        "--scenario",
+        default="baseline",
+        choices=supported_synthetic_scenarios(),
+    )
+    p.add_argument("--interval-s", type=float, default=1.0)
+    p.add_argument("--count", type=int, default=0, help="0 = run forever")
+    p.add_argument("--event-kind", default="both", choices=["slo", "probe", "both"])
+    p.add_argument("--output", default="stdout", choices=["stdout", "jsonl", "otlp"])
+    p.add_argument("--jsonl-path", default="")
+    p.add_argument("--otlp-endpoint", default="")
+    p.add_argument("--capability-mode", default="auto")
+    p.add_argument("--signal-set", default="", help="comma-separated override")
+    p.add_argument("--metrics-port", type=int, default=2112, help="0 disables")
+    p.add_argument("--max-overhead-pct", type=float, default=0.0)
+    p.add_argument("--events-per-second", type=int, default=0)
+    p.add_argument("--webhook-url", default="")
+    p.add_argument("--webhook-secret", default="")
+    p.add_argument("--webhook-format", default="")
+    p.add_argument("--cluster", default="tpu-cluster")
+    p.add_argument("--namespace", default="llm")
+    p.add_argument("--workload", default="rag-service")
+    p.add_argument("--service", default="rag-service")
+    p.add_argument("--node", default="tpu-vm-0")
+    p.add_argument("--probe-smoke", action="store_true")
+    p.add_argument(
+        "--probe-source",
+        default="synthetic",
+        choices=["synthetic", "ring"],
+        help="ring = consume the native eBPF ring buffer",
+    )
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.probe_smoke:
+        result = probe_smoke_check()
+        print(f"probe-smoke: {'PASS' if result.ok else 'FAIL'}: {result.detail}")
+        return 0 if result.ok else 1
+
+    cfg = resolve_config(args.config)
+    mode = parse_capability_mode(args.capability_mode)
+    signal_set = (
+        [s.strip() for s in args.signal_set.split(",") if s.strip()]
+        if args.signal_set
+        else cfg.signal_set
+    )
+    max_overhead = args.max_overhead_pct or cfg.safety.max_overhead_pct
+    eps = args.events_per_second or cfg.sampling.events_per_second_limit
+
+    meta_template = Metadata(
+        node=args.node,
+        namespace=args.namespace,
+        pod=f"{args.workload}-agent",
+        container=args.workload,
+        pid=1,
+        tid=1,
+        slice_id=cfg.tpu.slice_id,
+        host_index=cfg.tpu.host_index,
+    )
+    enricher = StaticMetadataEnricher(
+        TPUMetadataEnricher(dev_glob=cfg.tpu.accel_device_glob).enrich(meta_template)
+    )
+    generator = Generator(mode, signal_set, enricher=enricher)
+
+    writers = EventWriters(
+        output=args.output,
+        jsonl_path=args.jsonl_path,
+        otlp_endpoint=args.otlp_endpoint or cfg.otlp.endpoint,
+    )
+
+    metrics = AgentMetrics()
+    metrics.up.set(1)
+    metrics.capability_mode.labels(mode=mode).set(1)
+    metrics.event_kind.labels(kind=args.event_kind).set(1)
+    metrics.set_enabled_signals(generator.enabled_signals())
+    server = None
+    if args.metrics_port:
+        server = start_metrics_server(metrics, args.metrics_port)
+        print(f"agent: metrics on :{args.metrics_port}/metrics", file=sys.stderr)
+
+    limiter = RateLimiter(eps, cfg.sampling.burst_limit)
+    guard = OverheadGuard(max_overhead)
+
+    webhook_url = args.webhook_url or (cfg.webhook.url if cfg.webhook.enabled else "")
+    hook = None
+    attributor = None
+    if webhook_url:
+        hook = webhook.Exporter(
+            webhook_url,
+            secret=args.webhook_secret or cfg.webhook.secret,
+            format=args.webhook_format or cfg.webhook.format,
+            timeout_ms=cfg.webhook.timeout_ms,
+        )
+        attributor = attribution.BayesianAttributor()
+
+    sample_meta = SampleMeta(
+        cluster=args.cluster,
+        namespace=args.namespace,
+        workload=args.workload,
+        service=args.service,
+        node=args.node,
+        slice_id=cfg.tpu.slice_id,
+        host_index=cfg.tpu.host_index,
+    )
+
+    def emit_one(idx: int) -> None:
+        now = datetime.now(timezone.utc)
+        sample = build_synthetic_sample(args.scenario, idx, now, sample_meta)
+
+        if args.event_kind in ("slo", "both"):
+            events = normalize_sample(sample)
+            valid = []
+            for event in events:
+                if validate_slo(event):
+                    valid.append(event)
+                else:
+                    metrics.dropped.labels(reason="schema").inc()
+            try:
+                writers.emit_slo(valid)
+                metrics.slo_events.inc(len(valid))
+            except Exception as exc:  # noqa: BLE001 — emit failures are drops
+                metrics.dropped.labels(reason="emit").inc(len(valid))
+                print(f"agent: slo emit failed: {exc}", file=sys.stderr)
+
+        if args.event_kind in ("probe", "both"):
+            probe_meta = Metadata(trace_id=sample.trace_id)
+            emitted = []
+            for event in generator.generate(sample, probe_meta):
+                if not limiter.allow():
+                    metrics.dropped.labels(reason="rate_limit").inc()
+                    continue
+                if not validate_probe(event):
+                    metrics.dropped.labels(reason="schema").inc()
+                    continue
+                emitted.append(event)
+            try:
+                writers.emit_probe(emitted)
+                for event in emitted:
+                    metrics.observe_probe(event.signal, event.value)
+            except Exception as exc:  # noqa: BLE001
+                metrics.dropped.labels(reason="emit").inc(len(emitted))
+                print(f"agent: probe emit failed: {exc}", file=sys.stderr)
+
+        if hook is not None and attributor is not None and sample.fault_label:
+            fault = attribution.FaultSample(
+                incident_id=f"agent-inc-{idx + 1:04d}",
+                timestamp=now,
+                cluster=args.cluster,
+                namespace=args.namespace,
+                service=args.service,
+                fault_label=sample.fault_label,
+                confidence=0.9,
+                burn_rate=2.0,
+                window_minutes=5,
+                request_id=sample.request_id,
+                trace_id=sample.trace_id,
+                # Full fault profile, independent of the currently-enabled
+                # probe set: shedding shouldn't starve attribution.
+                signals=profile_for_fault(sample.fault_label),
+            )
+            try:
+                hook.send(attributor.attribute_sample(fault))
+                metrics.webhook_sent.labels(outcome="ok").inc()
+            except webhook.WebhookError as exc:
+                metrics.webhook_sent.labels(outcome="error").inc()
+                print(f"agent: webhook failed: {exc}", file=sys.stderr)
+
+        result = guard.evaluate()
+        if result.valid:
+            metrics.cpu_overhead_pct.set(result.cpu_pct)
+            if result.over_budget:
+                shed = generator.disable_highest_cost()
+                if shed:
+                    print(
+                        f"agent: overhead {result.cpu_pct:.2f}% > "
+                        f"{max_overhead:.2f}%, disabled {shed}",
+                        file=sys.stderr,
+                    )
+                    metrics.set_enabled_signals(generator.enabled_signals())
+        metrics.mark_cycle()
+
+    idx = 0
+    try:
+        while True:
+            emit_one(idx)
+            idx += 1
+            if args.count and idx >= args.count:
+                break
+            time.sleep(args.interval_s)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        metrics.up.set(0)
+        writers.close()
+        if server is not None:
+            server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

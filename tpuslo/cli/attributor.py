@@ -1,0 +1,98 @@
+"""Attributor: fault-sample JSONL → attributions + summary + confusion CSV.
+
+Reference: ``cmd/attributor/main.go`` — mode bayes|rule, per-prediction
+schema validation, optional webhook delivery with ``--webhook-strict``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import sys
+from pathlib import Path
+
+from tpuslo import attribution, webhook
+from tpuslo.schema import SCHEMA_INCIDENT_ATTRIBUTION, validate
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpuslo attributor", description=__doc__)
+    p.add_argument("--input", required=True, help="fault samples JSONL")
+    p.add_argument("--output", default="attributions.jsonl")
+    p.add_argument("--summary", default="")
+    p.add_argument("--confusion", default="")
+    p.add_argument("--mode", default="bayes", choices=["bayes", "rule"])
+    p.add_argument("--webhook-url", default="")
+    p.add_argument("--webhook-secret", default="")
+    p.add_argument("--webhook-format", default="generic")
+    p.add_argument(
+        "--webhook-strict",
+        action="store_true",
+        help="fail the run if any webhook delivery fails",
+    )
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    try:
+        samples = attribution.load_samples_jsonl(args.input)
+    except (OSError, ValueError) as exc:
+        print(f"attributor: cannot load {args.input}: {exc}", file=sys.stderr)
+        return 2
+    predictions = attribution.build_attributions(samples, mode=args.mode)
+    for pred in predictions:
+        validate(pred.to_dict(), SCHEMA_INCIDENT_ATTRIBUTION)
+
+    with open(args.output, "w", encoding="utf-8") as f:
+        attribution.dump_attributions_jsonl(predictions, f)
+
+    f1 = attribution.macro_f1(samples, predictions)
+    summary = {
+        "sample_count": len(samples),
+        "mode": attribution.normalize_mode(args.mode),
+        "accuracy": attribution.accuracy(samples, predictions),
+        "partial_accuracy": attribution.partial_accuracy(samples, predictions),
+        "coverage_accuracy": attribution.coverage_accuracy(samples, predictions),
+        "macro_f1": f1.macro_f1,
+        "per_domain_f1": {s.domain: s.f1 for s in f1.per_domain},
+    }
+    if args.summary:
+        Path(args.summary).write_text(json.dumps(summary, indent=2) + "\n")
+
+    if args.confusion:
+        matrix = attribution.build_confusion_matrix(samples, predictions)
+        with open(args.confusion, "w", newline="", encoding="utf-8") as f:
+            writer = csv.writer(f)
+            writer.writerow(["actual", "predicted", "count"])
+            for (actual, predicted), count in sorted(matrix.items()):
+                writer.writerow([actual, predicted, count])
+
+    webhook_failures = 0
+    if args.webhook_url:
+        hook = webhook.Exporter(
+            args.webhook_url,
+            secret=args.webhook_secret,
+            format=args.webhook_format,
+        )
+        for pred in predictions:
+            try:
+                hook.send(pred)
+            except webhook.WebhookError as exc:
+                webhook_failures += 1
+                print(f"attributor: webhook failed: {exc}", file=sys.stderr)
+
+    print(
+        f"attributor: {len(predictions)} predictions, "
+        f"accuracy={summary['accuracy']:.4f} macro_f1={summary['macro_f1']:.4f}",
+        file=sys.stderr,
+    )
+    if webhook_failures and args.webhook_strict:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

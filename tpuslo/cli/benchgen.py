@@ -1,0 +1,48 @@
+"""Benchgen: wraps the benchmark artifact-bundle generator.
+
+Reference: ``cmd/benchgen/main.go``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tpuslo import benchmark
+from tpuslo.faultreplay import supported_scenarios
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpuslo benchgen", description=__doc__)
+    p.add_argument("--output-dir", default="artifacts/benchmark")
+    p.add_argument("--scenario", default="tpu_mixed", choices=supported_scenarios())
+    p.add_argument("--count", type=int, default=55)
+    p.add_argument("--mode", default="bayes", choices=["bayes", "rule"])
+    p.add_argument("--input", default="", help="fault samples JSONL override")
+    p.add_argument("--node", default="tpu-vm-0")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    bundle = benchmark.generate_artifacts(
+        benchmark.Options(
+            output_dir=args.output_dir,
+            scenario=args.scenario,
+            count=args.count,
+            mode=args.mode,
+            input_samples=args.input,
+            node=args.node,
+        )
+    )
+    print(
+        f"benchgen: bundle at {bundle.output_dir} "
+        f"(accuracy={bundle.summary['accuracy']:.4f}, "
+        f"macro_f1={bundle.summary['macro_f1']:.4f})",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,102 @@
+"""Collector: normalize raw samples into schema-validated SLO events.
+
+Reference: ``cmd/collector/main.go`` — input from file/stdin JSONL or
+the synthetic generator; stdout/jsonl/OTLP sinks.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from datetime import datetime, timezone
+
+from tpuslo.cli.common import EventWriters, validate_slo
+from tpuslo.collector import (
+    RawSample,
+    SampleMeta,
+    generate_synthetic_samples,
+    normalize_sample,
+    supported_synthetic_scenarios,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpuslo collector", description=__doc__)
+    p.add_argument("--input", default="", help="raw samples JSONL ('-' = stdin)")
+    p.add_argument(
+        "--scenario",
+        default="",
+        choices=[""] + supported_synthetic_scenarios(),
+        help="generate synthetic samples instead of reading input",
+    )
+    p.add_argument("--count", type=int, default=10)
+    p.add_argument("--output", default="stdout", choices=["stdout", "jsonl", "otlp"])
+    p.add_argument("--jsonl-path", default="")
+    p.add_argument("--otlp-endpoint", default="")
+    p.add_argument("--cluster", default="tpu-cluster")
+    p.add_argument("--namespace", default="llm")
+    p.add_argument("--workload", default="rag-service")
+    p.add_argument("--service", default="rag-service")
+    p.add_argument("--node", default="tpu-vm-0")
+    return p
+
+
+def load_input_samples(path: str) -> list[RawSample]:
+    stream = sys.stdin if path == "-" else open(path, encoding="utf-8")
+    try:
+        samples = []
+        for line in stream:
+            line = line.strip()
+            if line:
+                samples.append(RawSample.from_dict(json.loads(line)))
+        return samples
+    finally:
+        if stream is not sys.stdin:
+            stream.close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.scenario:
+        meta = SampleMeta(
+            cluster=args.cluster,
+            namespace=args.namespace,
+            workload=args.workload,
+            service=args.service,
+            node=args.node,
+        )
+        samples = generate_synthetic_samples(
+            args.scenario, args.count, datetime.now(timezone.utc), meta
+        )
+    elif args.input:
+        try:
+            samples = load_input_samples(args.input)
+        except (OSError, ValueError) as exc:
+            print(f"collector: cannot load {args.input}: {exc}", file=sys.stderr)
+            return 2
+    else:
+        print("collector: provide --input or --scenario", file=sys.stderr)
+        return 2
+
+    writers = EventWriters(
+        output=args.output,
+        jsonl_path=args.jsonl_path,
+        otlp_endpoint=args.otlp_endpoint,
+    )
+    emitted = dropped = 0
+    try:
+        for sample in samples:
+            events = [e for e in normalize_sample(sample) if validate_slo(e)]
+            dropped += 4 - len(events)
+            writers.emit_slo(events)
+            emitted += len(events)
+    finally:
+        writers.close()
+    print(f"collector: emitted {emitted} events, dropped {dropped}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

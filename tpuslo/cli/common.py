@@ -1,0 +1,104 @@
+"""Shared CLI plumbing: event sinks and config resolution."""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+from typing import IO, Any
+
+from tpuslo.config import ToolkitConfig, default_config, load_config
+from tpuslo.otel.exporters import ProbeEventExporter, SLOEventExporter
+from tpuslo.schema import (
+    SCHEMA_PROBE_EVENT,
+    SCHEMA_SLO_EVENT,
+    ProbeEventV1,
+    SLOEvent,
+    SchemaValidationError,
+    validate,
+)
+
+OUTPUT_STDOUT = "stdout"
+OUTPUT_JSONL = "jsonl"
+OUTPUT_OTLP = "otlp"
+
+
+class EventWriters:
+    """Multiplexed event sink: stdout JSON, JSONL file, or OTLP/HTTP.
+
+    Reference: ``cmd/agent/main.go:68-135`` (outputWriters).
+    Thread-safe for the agent's concurrent emit paths.
+    """
+
+    def __init__(
+        self,
+        output: str = OUTPUT_STDOUT,
+        jsonl_path: str = "",
+        otlp_endpoint: str = "",
+        stream: IO[str] | None = None,
+    ):
+        self.output = output
+        self._lock = threading.Lock()
+        self._stream = stream or sys.stdout
+        self._jsonl: IO[str] | None = None
+        self._slo_exporter: SLOEventExporter | None = None
+        self._probe_exporter: ProbeEventExporter | None = None
+        if output == OUTPUT_JSONL:
+            if not jsonl_path:
+                raise ValueError("jsonl output requires --jsonl-path")
+            self._jsonl = open(jsonl_path, "a", encoding="utf-8")
+        elif output == OUTPUT_OTLP:
+            if not otlp_endpoint:
+                raise ValueError("otlp output requires an endpoint")
+            self._slo_exporter = SLOEventExporter(otlp_endpoint)
+            self._probe_exporter = ProbeEventExporter(otlp_endpoint)
+        elif output != OUTPUT_STDOUT:
+            raise ValueError(f"unsupported output {output!r}")
+
+    def _write_line(self, payload: dict[str, Any]) -> None:
+        line = json.dumps(payload, separators=(",", ":"))
+        with self._lock:
+            sink = self._jsonl if self._jsonl is not None else self._stream
+            sink.write(line + "\n")
+            sink.flush()
+
+    def emit_slo(self, events: list[SLOEvent]) -> None:
+        if self._slo_exporter is not None:
+            self._slo_exporter.export_batch(events)
+            return
+        for event in events:
+            self._write_line({"kind": "slo", **event.to_dict()})
+
+    def emit_probe(self, events: list[ProbeEventV1]) -> None:
+        if self._probe_exporter is not None:
+            self._probe_exporter.export_batch(events)
+            return
+        for event in events:
+            self._write_line({"kind": "probe", **event.to_dict()})
+
+    def close(self) -> None:
+        if self._jsonl is not None:
+            self._jsonl.close()
+
+
+def resolve_config(path: str) -> ToolkitConfig:
+    """Config-file layer of the CLI > config > defaults precedence."""
+    if path:
+        return load_config(path)
+    return default_config()
+
+
+def validate_slo(event: SLOEvent) -> bool:
+    try:
+        validate(event.to_dict(), SCHEMA_SLO_EVENT)
+        return True
+    except SchemaValidationError:
+        return False
+
+
+def validate_probe(event: ProbeEventV1) -> bool:
+    try:
+        validate(event.to_dict(), SCHEMA_PROBE_EVENT)
+        return True
+    except SchemaValidationError:
+        return False

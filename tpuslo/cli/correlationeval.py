@@ -1,0 +1,69 @@
+"""Correlationeval: correlation quality gate on labeled pairs.
+
+Reference: ``cmd/correlationeval/main.go`` — defaults window=2000ms,
+threshold=0.7, gates P ≥ 0.90, R ≥ 0.85; exit 1 on gate failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import sys
+from pathlib import Path
+
+from tpuslo import correlation
+
+DEFAULT_DATASET = (
+    Path(__file__).resolve().parent.parent
+    / "correlation/testdata/labeled_pairs.jsonl"
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpuslo correlationeval", description=__doc__)
+    p.add_argument("--input", default=str(DEFAULT_DATASET))
+    p.add_argument("--window-ms", type=int, default=2000)
+    p.add_argument("--threshold", type=float, default=0.7)
+    p.add_argument("--min-precision", type=float, default=0.90)
+    p.add_argument("--min-recall", type=float, default=0.85)
+    p.add_argument("--report", default="", help="write JSON report here")
+    p.add_argument("--predictions", default="", help="write predictions CSV here")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    pairs = correlation.load_labeled_pairs(args.input)
+    report, predictions = correlation.evaluate_labeled_pairs(
+        pairs, args.window_ms, args.threshold
+    )
+    gate = correlation.evaluate_gate(report, args.min_precision, args.min_recall)
+
+    if args.report:
+        Path(args.report).write_text(json.dumps(report.to_dict(), indent=2) + "\n")
+    if args.predictions:
+        with open(args.predictions, "w", newline="", encoding="utf-8") as f:
+            writer = csv.DictWriter(
+                f,
+                fieldnames=[
+                    "case_id", "expected", "predicted", "confidence",
+                    "tier", "correct", "signal", "expected_tier",
+                ],
+            )
+            writer.writeheader()
+            for pred in predictions:
+                writer.writerow(pred.to_dict())
+
+    print(
+        f"correlationeval: n={report.sample_size} "
+        f"P={report.precision:.4f} R={report.recall:.4f} F1={report.f1:.4f} "
+        f"tier_acc={report.tier_accuracy:.4f} -> "
+        f"{'PASS' if gate.passed else 'FAIL'}: {gate.message}",
+        file=sys.stderr,
+    )
+    return 0 if gate.passed else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,65 @@
+"""Faultinject: scenario → raw-sample JSONL (collector input).
+
+Reference: ``cmd/faultinject/main.go``; TPU chaos scenarios (ici_drop,
+hbm_pressure, xla_recompile_storm, host_offload_stall) are first-class
+per BASELINE.json config 5.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from datetime import datetime, timezone
+
+from tpuslo.collector import (
+    SampleMeta,
+    generate_synthetic_samples,
+    supported_synthetic_scenarios,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpuslo faultinject", description=__doc__)
+    p.add_argument(
+        "--scenario", default="mixed", choices=supported_synthetic_scenarios()
+    )
+    p.add_argument("--count", type=int, default=40)
+    p.add_argument("--output", default="-")
+    p.add_argument("--start", default="", help="RFC3339 start timestamp")
+    p.add_argument("--cluster", default="tpu-cluster")
+    p.add_argument("--namespace", default="llm")
+    p.add_argument("--workload", default="rag-service")
+    p.add_argument("--service", default="rag-service")
+    p.add_argument("--node", default="tpu-vm-0")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    start = (
+        datetime.fromisoformat(args.start.replace("Z", "+00:00"))
+        if args.start
+        else datetime.now(timezone.utc)
+    )
+    meta = SampleMeta(
+        cluster=args.cluster,
+        namespace=args.namespace,
+        workload=args.workload,
+        service=args.service,
+        node=args.node,
+    )
+    samples = generate_synthetic_samples(args.scenario, args.count, start, meta)
+    sink = sys.stdout if args.output == "-" else open(args.output, "w", encoding="utf-8")
+    try:
+        for sample in samples:
+            sink.write(json.dumps(sample.to_dict(), separators=(",", ":")) + "\n")
+    finally:
+        if sink is not sys.stdout:
+            sink.close()
+    print(f"faultinject: wrote {len(samples)} raw samples", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,45 @@
+"""Faultreplay: deterministic fault-sample JSONL emitter.
+
+Reference: ``cmd/faultreplay/main.go``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from datetime import datetime, timezone
+
+from tpuslo import attribution, faultreplay
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpuslo faultreplay", description=__doc__)
+    p.add_argument(
+        "--scenario", default="mixed", choices=faultreplay.supported_scenarios()
+    )
+    p.add_argument("--count", type=int, default=55)
+    p.add_argument("--output", default="-", help="'-' = stdout")
+    p.add_argument("--start", default="", help="RFC3339 start timestamp")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    start = (
+        datetime.fromisoformat(args.start.replace("Z", "+00:00"))
+        if args.start
+        else datetime.now(timezone.utc)
+    )
+    samples = faultreplay.generate_fault_samples(args.scenario, args.count, start)
+    sink = sys.stdout if args.output == "-" else open(args.output, "w", encoding="utf-8")
+    try:
+        count = attribution.dump_samples_jsonl(samples, sink)
+    finally:
+        if sink is not sys.stdout:
+            sink.close()
+    print(f"faultreplay: wrote {count} samples", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,65 @@
+"""Loadgen: deterministic request-trace JSONL generator.
+
+Reference: ``cmd/loadgen/main.go`` — request profiles with expected
+TTFT ranges; generates traces, does not drive HTTP.  The TPU-native
+build adds a ``context_128k`` profile for long-context serving.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+
+# profile -> (prompt_tokens, max_new_tokens, expected_ttft_ms_range)
+PROFILES = {
+    "chat_short": (64, 128, (150, 450)),
+    "rag_medium": (512, 256, (300, 800)),
+    "context_long": (4096, 512, (600, 1600)),
+    "context_128k": (131072, 512, (2500, 8000)),
+}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpuslo loadgen", description=__doc__)
+    p.add_argument("--profile", default="rag_medium", choices=sorted(PROFILES))
+    p.add_argument("--rps", type=float, default=2.0)
+    p.add_argument("--duration-s", type=float, default=30.0)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--output", default="-")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    prompt_tokens, max_new, ttft_range = PROFILES[args.profile]
+    rng = random.Random(args.seed)
+    count = max(1, int(args.rps * args.duration_s))
+    interval_ms = 1000.0 / args.rps
+
+    sink = sys.stdout if args.output == "-" else open(args.output, "w", encoding="utf-8")
+    try:
+        for idx in range(count):
+            jitter = rng.uniform(-0.2, 0.2) * interval_ms
+            record = {
+                "request_id": f"load-req-{idx + 1:05d}",
+                "trace_id": f"load-trace-{idx + 1:05d}",
+                "profile": args.profile,
+                "offset_ms": round(idx * interval_ms + jitter, 3),
+                "prompt_tokens": prompt_tokens,
+                "max_new_tokens": max_new,
+                "expected_ttft_ms_min": ttft_range[0],
+                "expected_ttft_ms_max": ttft_range[1],
+                "stream": True,
+            }
+            sink.write(json.dumps(record, separators=(",", ":")) + "\n")
+    finally:
+        if sink is not sys.stdout:
+            sink.close()
+    print(f"loadgen: wrote {count} request records", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

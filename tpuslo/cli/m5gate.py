@@ -1,0 +1,129 @@
+"""M5gate: run the B5/D3/E3 statistical release gates.
+
+Reference: ``cmd/m5gate/main.go`` — all stat knobs as flags, JSON + MD
+summaries, exit 1 on gate failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from tpuslo import releasegate
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpuslo m5gate", description=__doc__)
+    p.add_argument("--candidate-root", default="artifacts/weekly-benchmark")
+    p.add_argument("--baseline-root", default="")
+    p.add_argument("--baseline-manifest", default="")
+    p.add_argument("--candidate-ref", default="")
+    p.add_argument("--candidate-commit", default="")
+    p.add_argument("--require-baseline-manifest", action="store_true")
+    p.add_argument("--scenarios", default="", help="comma-separated override")
+    p.add_argument("--max-overhead-pct", type=float, default=3.0)
+    p.add_argument("--max-variance-pct", type=float, default=10.0)
+    p.add_argument("--min-runs", type=int, default=3)
+    p.add_argument("--regression-pct-limit", type=float, default=5.0)
+    p.add_argument("--alpha", type=float, default=0.05)
+    p.add_argument("--bootstrap-iterations", type=int, default=1000)
+    p.add_argument("--bootstrap-seed", type=int, default=42)
+    p.add_argument("--min-samples", type=int, default=30)
+    p.add_argument("--min-cliffs-delta", type=float, default=0.147)
+    p.add_argument("--summary-json", default="")
+    p.add_argument("--summary-md", default="")
+    return p
+
+
+def render_markdown(summary: releasegate.Summary) -> str:
+    lines = [
+        "# M5 release gate summary",
+        "",
+        f"**Overall: {'PASS' if summary.passed else 'FAIL'}**",
+        "",
+        f"- candidate: `{summary.candidate_root}`",
+        f"- baseline: `{summary.baseline_root}`",
+        "",
+        "## B5 overhead",
+        f"- pass: {summary.overhead.passed}",
+        f"- max node p95: {summary.overhead.max_node_p95_pct:.4f}% "
+        f"({summary.overhead.max_node_p95_node}) vs "
+        f"threshold {summary.overhead.threshold_pct:.2f}%",
+        f"- mean: {summary.overhead.mean_observed_pct:.4f}% over "
+        f"{summary.overhead.sample_count} samples",
+        "",
+        "## D3 rerun variance",
+        "",
+        "| scenario | runs | ttft CV% | tokens CV% | err CV% | pass |",
+        "|---|---|---|---|---|---|",
+    ]
+    for row in summary.variance.scenarios:
+        lines.append(
+            f"| {row.scenario} | {row.run_count} | {row.variance_pct:.2f} "
+            f"| {row.tokens_variance_pct:.2f} "
+            f"| {row.error_rate_variance_pct:.2f} | {row.passed} |"
+        )
+    lines += [
+        "",
+        "## E3 significance",
+        "",
+        "| scenario | n | regression % | p | CI95 | Cliff's δ | verdict |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for row in summary.significance.scenarios:
+        verdict = (
+            "informational"
+            if row.informational_only
+            else ("pass" if row.passed else "FAIL")
+        )
+        ci = f"[{row.bootstrap_delta_ci95[0]:.2f}, {row.bootstrap_delta_ci95[1]:.2f}]"
+        lines.append(
+            f"| {row.scenario} | {row.candidate_n}/{row.baseline_n} "
+            f"| {row.ttft_regression_pct:.2f} | {row.mann_whitney_p_value:.4f} "
+            f"| {ci} | {row.cliffs_delta:.3f} | {verdict} |"
+        )
+    if summary.failures:
+        lines += ["", "## Failures", ""]
+        lines += [f"- {f}" for f in summary.failures]
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    cfg = releasegate.Config(
+        candidate_root=args.candidate_root,
+        baseline_root=args.baseline_root,
+        baseline_manifest_path=args.baseline_manifest,
+        candidate_ref=args.candidate_ref,
+        candidate_commit=args.candidate_commit,
+        require_baseline_manifest=args.require_baseline_manifest,
+        scenarios=[s.strip() for s in args.scenarios.split(",") if s.strip()],
+        max_overhead_pct=args.max_overhead_pct,
+        max_variance_pct=args.max_variance_pct,
+        min_runs_per_scenario=args.min_runs,
+        regression_pct_limit=args.regression_pct_limit,
+        significance_alpha=args.alpha,
+        bootstrap_iterations=args.bootstrap_iterations,
+        bootstrap_seed=args.bootstrap_seed,
+        min_samples_per_scenario=args.min_samples,
+        min_cliffs_delta_for_failure=args.min_cliffs_delta,
+    )
+    summary = releasegate.evaluate(cfg)
+    if args.summary_json:
+        Path(args.summary_json).write_text(
+            json.dumps(summary.to_dict(), indent=2) + "\n"
+        )
+    if args.summary_md:
+        Path(args.summary_md).write_text(render_markdown(summary))
+    print(
+        f"m5gate: {'PASS' if summary.passed else 'FAIL'}"
+        + ("" if summary.passed else f" ({'; '.join(summary.failures)})"),
+        file=sys.stderr,
+    )
+    return 0 if summary.passed else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,139 @@
+"""Schemavalidate: compile all contracts and validate golden payloads.
+
+Reference: ``cmd/schemavalidate/main.go:32-146`` — compiles the four
+JSON schemas and validates golden sample payloads plus toolkit.yaml.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from datetime import datetime, timezone
+
+from tpuslo import schema
+from tpuslo.config import default_config
+from tpuslo.schema import (
+    ConnTuple,
+    Evidence,
+    FaultHypothesis,
+    IncidentAttribution,
+    ProbeEventV1,
+    SLOEvent,
+    SLOImpact,
+    TPURef,
+)
+
+TS = datetime(2026, 1, 1, tzinfo=timezone.utc)
+
+
+def golden_payloads() -> list[tuple[str, dict]]:
+    slo_event = SLOEvent(
+        event_id="golden-req-0001-ttft_ms",
+        timestamp=TS,
+        cluster="tpu-cluster",
+        namespace="llm",
+        workload="rag-service",
+        service="rag-service",
+        request_id="golden-req-0001",
+        trace_id="golden-trace-0001",
+        sli_name="ttft_ms",
+        sli_value=340.0,
+        unit="ms",
+        status="ok",
+        labels={"source": "synthetic"},
+    )
+    probe_event = ProbeEventV1(
+        ts_unix_nano=int(TS.timestamp() * 1e9),
+        signal="ici_collective_latency_ms",
+        node="tpu-vm-0",
+        namespace="llm",
+        pod="rag-service-abc",
+        container="rag",
+        pid=1234,
+        tid=1234,
+        value=55.0,
+        unit="ms",
+        status="error",
+        tpu=TPURef(
+            chip="accel0",
+            slice_id="v5e-8-s0",
+            host_index=0,
+            ici_link=2,
+            program_id="jit_decode_step",
+            launch_id=17,
+        ),
+    )
+    kernel_probe = ProbeEventV1(
+        ts_unix_nano=int(TS.timestamp() * 1e9),
+        signal="dns_latency_ms",
+        node="tpu-vm-0",
+        namespace="llm",
+        pod="rag-service-abc",
+        container="rag",
+        pid=1234,
+        tid=1234,
+        value=220.0,
+        unit="ms",
+        status="error",
+        conn_tuple=ConnTuple("10.0.0.10", "10.0.0.53", 42424, 53, "udp"),
+        errno=110,
+    )
+    incident = IncidentAttribution(
+        incident_id="golden-inc-0001",
+        timestamp=TS,
+        cluster="tpu-cluster",
+        namespace="llm",
+        service="rag-service",
+        predicted_fault_domain="tpu_hbm",
+        confidence=0.93,
+        evidence=[
+            Evidence("hbm_alloc_stall_ms", 60.0, "libtpu"),
+            Evidence("hbm_utilization_pct", 97.0, "libtpu"),
+        ],
+        slo_impact=SLOImpact("ttft_ms", 2.4, 30),
+        trace_ids=["golden-trace-0001"],
+        request_ids=["golden-req-0001"],
+        fault_hypotheses=[
+            FaultHypothesis("tpu_hbm", 0.93, ["hbm_alloc_stall_ms"]),
+            FaultHypothesis("host_offload", 0.05, []),
+        ],
+    )
+    return [
+        (schema.SCHEMA_SLO_EVENT, slo_event.to_dict()),
+        (schema.SCHEMA_PROBE_EVENT, probe_event.to_dict()),
+        (schema.SCHEMA_PROBE_EVENT, kernel_probe.to_dict()),
+        (schema.SCHEMA_INCIDENT_ATTRIBUTION, incident.to_dict()),
+        (schema.SCHEMA_TOOLKIT_CONFIG, default_config().to_dict()),
+    ]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    return argparse.ArgumentParser(prog="tpuslo schemavalidate", description=__doc__)
+
+
+def main(argv: list[str] | None = None) -> int:
+    build_parser().parse_args(argv)
+    failures = 0
+    for name in schema.ALL_SCHEMAS:
+        try:
+            schema.load_schema(name)
+            print(f"schema {name}: compiles")
+        except Exception as exc:  # noqa: BLE001
+            failures += 1
+            print(f"schema {name}: FAILED to compile: {exc}", file=sys.stderr)
+    for name, payload in golden_payloads():
+        try:
+            schema.validate(payload, name)
+            print(f"golden payload vs {name}: valid")
+        except schema.SchemaValidationError as exc:
+            failures += 1
+            print(f"golden payload vs {name}: INVALID: {exc}", file=sys.stderr)
+    if failures:
+        print(f"schemavalidate: {failures} failure(s)", file=sys.stderr)
+        return 1
+    print("schemavalidate: all contracts and golden payloads valid")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,98 @@
+"""sloctl: operator CLI — ``prereq check`` and ``cdgate check``.
+
+Reference: ``cmd/sloctl`` — prereq text/json with ``--strict``; cdgate
+thresholds with ``--fail-open`` post-processing
+(``cmd/sloctl/cdgate.go:92-95``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tpuslo import cdgate, prereq
+from tpuslo.cli.common import resolve_config
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpuslo sloctl", description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    pr = sub.add_parser("prereq", help="host prerequisite checks")
+    pr_sub = pr.add_subparsers(dest="subcommand", required=True)
+    pr_check = pr_sub.add_parser("check")
+    pr_check.add_argument("--format", default="text", choices=["text", "json"])
+    pr_check.add_argument(
+        "--strict", action="store_true", help="warnings also fail the check"
+    )
+
+    cd = sub.add_parser("cdgate", help="CD pipeline SLO gate")
+    cd_sub = cd.add_subparsers(dest="subcommand", required=True)
+    cd_check = cd_sub.add_parser("check")
+    cd_check.add_argument("--config", default="")
+    cd_check.add_argument("--prometheus-url", default="")
+    cd_check.add_argument("--ttft-p95-ms", type=float, default=0.0)
+    cd_check.add_argument("--error-rate", type=float, default=0.0)
+    cd_check.add_argument("--burn-rate", type=float, default=0.0)
+    cd_check.add_argument(
+        "--fail-open",
+        action="store_true",
+        help="treat query failures as pass (availability over strictness)",
+    )
+    cd_check.add_argument(
+        "--fail-closed",
+        action="store_true",
+        help="query failures fail the gate, overriding config fail_open",
+    )
+    return p
+
+
+def run_prereq(args) -> int:
+    snapshot = prereq.collect_snapshot()
+    results = prereq.evaluate(snapshot)
+    if args.format == "json":
+        print(json.dumps([r.to_dict() for r in results], indent=2))
+    else:
+        for r in results:
+            marker = "PASS" if r.passed else ("WARN" if r.severity != "blocker" else "FAIL")
+            print(f"[{marker:4s}] {r.name:18s} ({r.severity}): {r.detail}")
+    blockers = [r for r in results if not r.passed and r.severity == prereq.SEVERITY_BLOCKER]
+    warnings = [r for r in results if not r.passed and r.severity == prereq.SEVERITY_WARNING]
+    if blockers:
+        return 1
+    if warnings and args.strict:
+        return 1
+    return 0
+
+
+def run_cdgate(args) -> int:
+    cfg = resolve_config(args.config)
+    url = args.prometheus_url or cfg.cdgate.prometheus_url
+    report = cdgate.evaluate_slo_gate(
+        cdgate.HTTPQuerier(url),
+        ttft_p95_ms=args.ttft_p95_ms or cfg.cdgate.ttft_p95_ms,
+        error_rate=args.error_rate or cfg.cdgate.error_rate,
+        burn_rate=args.burn_rate or cfg.cdgate.burn_rate,
+    )
+    fail_open = (args.fail_open or cfg.cdgate.fail_open) and not args.fail_closed
+    # Fail-open: gate failures caused *only* by query errors pass.
+    effective_pass = report.passed
+    if not report.passed and fail_open:
+        hard_failures = [c for c in report.checks if not c.passed and not c.error]
+        if not hard_failures:
+            effective_pass = True
+            print("cdgate: query failures ignored (fail-open)", file=sys.stderr)
+    print(json.dumps(report.to_dict() | {"effective_pass": effective_pass}, indent=2))
+    return 0 if effective_pass else 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command == "prereq":
+        return run_prereq(args)
+    return run_cdgate(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,40 @@
+"""L1 collection runtime: synthetic spine + SLO normalization.
+
+The real-probe path (ring-buffer consumer, probe lifecycle manager)
+lives in :mod:`tpuslo.collector.ringbuf` and
+:mod:`tpuslo.collector.probe_manager`.
+"""
+
+from tpuslo.collector.pipeline import (
+    ERROR_RATE_THRESHOLDS,
+    LATENCY_THRESHOLDS,
+    THROUGHPUT_THRESHOLDS,
+    TTFT_THRESHOLDS,
+    inverse_threshold_status,
+    normalize_sample,
+    threshold_status,
+)
+from tpuslo.collector.synthetic import (
+    RawSample,
+    SampleMeta,
+    build_synthetic_sample,
+    generate_synthetic_samples,
+    supported_fault_labels,
+    supported_synthetic_scenarios,
+)
+
+__all__ = [
+    "ERROR_RATE_THRESHOLDS",
+    "LATENCY_THRESHOLDS",
+    "THROUGHPUT_THRESHOLDS",
+    "TTFT_THRESHOLDS",
+    "RawSample",
+    "SampleMeta",
+    "build_synthetic_sample",
+    "generate_synthetic_samples",
+    "inverse_threshold_status",
+    "normalize_sample",
+    "supported_fault_labels",
+    "supported_synthetic_scenarios",
+    "threshold_status",
+]

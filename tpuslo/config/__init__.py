@@ -1,0 +1,25 @@
+from tpuslo.config.toolkitcfg import (
+    CDGateConfig,
+    CorrelationConfig,
+    OTLPConfig,
+    SafetyConfig,
+    SamplingConfig,
+    ToolkitConfig,
+    TPUConfig,
+    WebhookConfig,
+    default_config,
+    load_config,
+)
+
+__all__ = [
+    "CDGateConfig",
+    "CorrelationConfig",
+    "OTLPConfig",
+    "SafetyConfig",
+    "SamplingConfig",
+    "ToolkitConfig",
+    "TPUConfig",
+    "WebhookConfig",
+    "default_config",
+    "load_config",
+]

@@ -1,0 +1,220 @@
+"""L12 config: YAML loader with zero-value→default normalization.
+
+Reference: ``pkg/toolkitcfg/config.go:11-170``; extended with a ``tpu``
+section for the accelerator probe surface.  CLI flags > config file >
+defaults, with the precedence implemented by each binary.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import yaml
+
+from tpuslo.schema import SCHEMA_TOOLKIT_CONFIG, validate
+
+API_VERSION = "toolkit.tpuslo.dev/v1alpha1"
+KIND = "ToolkitConfig"
+
+DEFAULT_SIGNAL_SET = [
+    "dns_latency_ms",
+    "tcp_retransmits_total",
+    "runqueue_delay_ms",
+    "connect_latency_ms",
+    "tls_handshake_ms",
+    "cpu_steal_pct",
+    "mem_reclaim_latency_ms",
+    "disk_io_latency_ms",
+    "syscall_latency_ms",
+    "xla_compile_ms",
+    "hbm_alloc_stall_ms",
+    "hbm_utilization_pct",
+    "ici_link_retries_total",
+    "ici_collective_latency_ms",
+    "host_offload_stall_ms",
+]
+
+
+@dataclass
+class SamplingConfig:
+    events_per_second_limit: int = 10000
+    burst_limit: int = 20000
+
+
+@dataclass
+class CorrelationConfig:
+    window_ms: int = 2000
+    enrichment_threshold: float = 0.7
+
+
+@dataclass
+class OTLPConfig:
+    endpoint: str = "http://otel-collector:4318/v1/logs"
+
+
+@dataclass
+class SafetyConfig:
+    max_overhead_pct: float = 3.0
+
+
+@dataclass
+class WebhookConfig:
+    enabled: bool = False
+    url: str = ""
+    secret: str = ""
+    format: str = "generic"
+    timeout_ms: int = 5000
+
+
+@dataclass
+class CDGateConfig:
+    enabled: bool = False
+    prometheus_url: str = "http://prometheus:9090"
+    ttft_p95_ms: float = 800.0
+    error_rate: float = 0.05
+    burn_rate: float = 2.0
+    fail_open: bool = True
+
+
+@dataclass
+class TPUConfig:
+    enabled: bool = True
+    libtpu_path: str = ""
+    accel_device_glob: str = "/dev/accel*"
+    slice_id: str = ""
+    host_index: int = 0
+
+
+@dataclass
+class ToolkitConfig:
+    api_version: str = API_VERSION
+    kind: str = KIND
+    signal_set: list[str] = field(default_factory=lambda: list(DEFAULT_SIGNAL_SET))
+    sampling: SamplingConfig = field(default_factory=SamplingConfig)
+    correlation: CorrelationConfig = field(default_factory=CorrelationConfig)
+    otlp: OTLPConfig = field(default_factory=OTLPConfig)
+    safety: SafetyConfig = field(default_factory=SafetyConfig)
+    webhook: WebhookConfig = field(default_factory=WebhookConfig)
+    cdgate: CDGateConfig = field(default_factory=CDGateConfig)
+    tpu: TPUConfig = field(default_factory=TPUConfig)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "apiVersion": self.api_version,
+            "kind": self.kind,
+            "signal_set": list(self.signal_set),
+            "sampling": {
+                "events_per_second_limit": self.sampling.events_per_second_limit,
+                "burst_limit": self.sampling.burst_limit,
+            },
+            "correlation": {
+                "window_ms": self.correlation.window_ms,
+                "enrichment_threshold": self.correlation.enrichment_threshold,
+            },
+            "otlp": {"endpoint": self.otlp.endpoint},
+            "safety": {"max_overhead_pct": self.safety.max_overhead_pct},
+            "webhook": {
+                "enabled": self.webhook.enabled,
+                "url": self.webhook.url,
+                "secret": self.webhook.secret,
+                "format": self.webhook.format,
+                "timeout_ms": self.webhook.timeout_ms,
+            },
+            "cdgate": {
+                "enabled": self.cdgate.enabled,
+                "prometheus_url": self.cdgate.prometheus_url,
+                "ttft_p95_ms": self.cdgate.ttft_p95_ms,
+                "error_rate": self.cdgate.error_rate,
+                "burn_rate": self.cdgate.burn_rate,
+                "fail_open": self.cdgate.fail_open,
+            },
+            "tpu": {
+                "enabled": self.tpu.enabled,
+                "libtpu_path": self.tpu.libtpu_path,
+                "accel_device_glob": self.tpu.accel_device_glob,
+                "slice_id": self.tpu.slice_id,
+                "host_index": self.tpu.host_index,
+            },
+        }
+
+
+def default_config() -> ToolkitConfig:
+    return ToolkitConfig()
+
+
+def _merge_section(target, raw: dict[str, Any], fields: dict[str, type]) -> None:
+    for name, caster in fields.items():
+        value = raw.get(name)
+        if value is None:
+            continue
+        # Zero/empty values fall back to defaults (reference normalize()).
+        if caster is not bool and (value == "" or value == 0):
+            continue
+        setattr(target, name, caster(value))
+
+
+def load_config(path: str) -> ToolkitConfig:
+    """Parse and normalize a toolkit config file; validates the contract."""
+    with open(path, encoding="utf-8") as f:
+        raw = yaml.safe_load(f) or {}
+    if not isinstance(raw, dict):
+        raise ValueError(f"config {path} must be a mapping")
+
+    cfg = default_config()
+    if raw.get("apiVersion"):
+        cfg.api_version = str(raw["apiVersion"])
+    if raw.get("kind"):
+        cfg.kind = str(raw["kind"])
+    if raw.get("signal_set"):
+        cfg.signal_set = [str(s) for s in raw["signal_set"]]
+
+    _merge_section(
+        cfg.sampling,
+        raw.get("sampling") or {},
+        {"events_per_second_limit": int, "burst_limit": int},
+    )
+    _merge_section(
+        cfg.correlation,
+        raw.get("correlation") or {},
+        {"window_ms": int, "enrichment_threshold": float},
+    )
+    _merge_section(cfg.otlp, raw.get("otlp") or {}, {"endpoint": str})
+    _merge_section(cfg.safety, raw.get("safety") or {}, {"max_overhead_pct": float})
+    _merge_section(
+        cfg.webhook,
+        raw.get("webhook") or {},
+        {
+            "enabled": bool,
+            "url": str,
+            "secret": str,
+            "format": str,
+            "timeout_ms": int,
+        },
+    )
+    _merge_section(
+        cfg.cdgate,
+        raw.get("cdgate") or {},
+        {
+            "enabled": bool,
+            "prometheus_url": str,
+            "ttft_p95_ms": float,
+            "error_rate": float,
+            "burn_rate": float,
+            "fail_open": bool,
+        },
+    )
+    _merge_section(
+        cfg.tpu,
+        raw.get("tpu") or {},
+        {
+            "enabled": bool,
+            "libtpu_path": str,
+            "accel_device_glob": str,
+            "slice_id": str,
+            "host_index": int,
+        },
+    )
+
+    validate(cfg.to_dict(), SCHEMA_TOOLKIT_CONFIG)
+    return cfg

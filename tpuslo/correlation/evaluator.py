@@ -1,0 +1,210 @@
+"""Correlation quality evaluation against labeled ground truth.
+
+Reference: ``pkg/correlation/evaluator.go`` — precision/recall/F1 +
+tier accuracy over a labeled-pairs JSONL dataset, with a CI gate
+(P ≥ 0.90, R ≥ 0.85).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from datetime import datetime, timezone
+from pathlib import Path
+from typing import Any
+
+from tpuslo.correlation.matcher import (
+    DEFAULT_ENRICHMENT_THRESHOLD,
+    DEFAULT_WINDOW_MS,
+    Decision,
+    SignalRef,
+    SpanRef,
+    match,
+)
+
+
+@dataclass
+class LabeledPair:
+    """One ground-truth span/signal pair."""
+
+    case_id: str
+    span: SpanRef
+    signal: SignalRef
+    expected_match: bool
+    expected_tier: str = ""
+
+    @classmethod
+    def from_dict(cls, raw: dict[str, Any]) -> "LabeledPair":
+        return cls(
+            case_id=raw.get("case_id", ""),
+            span=SpanRef.from_dict(raw.get("span", {})),
+            signal=SignalRef.from_dict(raw.get("signal", {})),
+            expected_match=bool(raw.get("expected_match", False)),
+            expected_tier=raw.get("expected_tier", ""),
+        )
+
+
+@dataclass
+class Prediction:
+    case_id: str
+    expected: bool
+    predicted: bool
+    confidence: float
+    tier: str
+    correct: bool
+    signal: str
+    expected_tier: str = ""
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "case_id": self.case_id,
+            "expected": self.expected,
+            "predicted": self.predicted,
+            "confidence": self.confidence,
+            "tier": self.tier,
+            "correct": self.correct,
+            "signal": self.signal,
+            "expected_tier": self.expected_tier,
+        }
+
+
+@dataclass
+class EvalReport:
+    sample_size: int = 0
+    true_positive: int = 0
+    false_positive: int = 0
+    false_negative: int = 0
+    true_negative: int = 0
+    precision: float = 0.0
+    recall: float = 0.0
+    f1: float = 0.0
+    tier_accuracy: float = 0.0
+    mean_confidence: float = 0.0
+    window_ms: int = DEFAULT_WINDOW_MS
+    threshold: float = DEFAULT_ENRICHMENT_THRESHOLD
+    generated_at: str = ""
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "generated_at": self.generated_at,
+            "sample_size": self.sample_size,
+            "true_positive": self.true_positive,
+            "false_positive": self.false_positive,
+            "false_negative": self.false_negative,
+            "true_negative": self.true_negative,
+            "precision": self.precision,
+            "recall": self.recall,
+            "f1": self.f1,
+            "tier_accuracy": self.tier_accuracy,
+            "mean_confidence": self.mean_confidence,
+            "window_ms": self.window_ms,
+            "threshold": self.threshold,
+        }
+
+
+@dataclass
+class GateResult:
+    passed: bool
+    message: str
+
+
+def load_labeled_pairs(path: str | Path) -> list[LabeledPair]:
+    pairs = []
+    with open(path, encoding="utf-8") as f:
+        for line_no, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                pairs.append(LabeledPair.from_dict(json.loads(line)))
+            except (json.JSONDecodeError, TypeError, ValueError) as exc:
+                raise ValueError(f"{path}:{line_no}: bad pair: {exc}") from exc
+    if not pairs:
+        raise ValueError(f"no labeled pairs loaded from {path}")
+    return pairs
+
+
+def evaluate_labeled_pairs(
+    pairs: list[LabeledPair],
+    window_ms: int = 0,
+    threshold: float = 0.0,
+) -> tuple[EvalReport, list[Prediction]]:
+    """Quality metrics for the matcher at a given threshold/window."""
+    window_ms = window_ms if window_ms > 0 else DEFAULT_WINDOW_MS
+    threshold = threshold if threshold > 0 else DEFAULT_ENRICHMENT_THRESHOLD
+
+    report = EvalReport(
+        sample_size=len(pairs),
+        window_ms=window_ms,
+        threshold=threshold,
+        generated_at=datetime.now(timezone.utc).isoformat(),
+    )
+    predictions: list[Prediction] = []
+    tier_correct = tier_comparable = 0
+    conf_sum = 0.0
+    conf_count = 0
+
+    for pair in pairs:
+        decision: Decision = match(pair.span, pair.signal, window_ms)
+        predicted = decision.matched and decision.confidence >= threshold
+        correct = predicted == pair.expected_match
+        predictions.append(
+            Prediction(
+                case_id=pair.case_id,
+                expected=pair.expected_match,
+                predicted=predicted,
+                confidence=decision.confidence,
+                tier=decision.tier,
+                correct=correct,
+                signal=pair.signal.signal,
+                expected_tier=pair.expected_tier,
+            )
+        )
+        if predicted:
+            conf_sum += decision.confidence
+            conf_count += 1
+        if pair.expected_match and predicted:
+            report.true_positive += 1
+        elif not pair.expected_match and predicted:
+            report.false_positive += 1
+        elif pair.expected_match and not predicted:
+            report.false_negative += 1
+        else:
+            report.true_negative += 1
+        if pair.expected_match and pair.expected_tier and predicted:
+            tier_comparable += 1
+            if pair.expected_tier == decision.tier:
+                tier_correct += 1
+
+    tp, fp, fn = report.true_positive, report.false_positive, report.false_negative
+    report.precision = tp / (tp + fp) if tp + fp else 0.0
+    report.recall = tp / (tp + fn) if tp + fn else 0.0
+    if report.precision + report.recall > 0:
+        report.f1 = (
+            2 * report.precision * report.recall
+            / (report.precision + report.recall)
+        )
+    if tier_comparable:
+        report.tier_accuracy = tier_correct / tier_comparable
+    if conf_count:
+        report.mean_confidence = conf_sum / conf_count
+    return report, predictions
+
+
+def evaluate_gate(
+    report: EvalReport, min_precision: float, min_recall: float
+) -> GateResult:
+    """CI gate verdict on a quality report."""
+    if report.precision < min_precision:
+        return GateResult(
+            False,
+            f"precision gate failed: got {report.precision:.4f} "
+            f"required {min_precision:.4f}",
+        )
+    if report.recall < min_recall:
+        return GateResult(
+            False,
+            f"recall gate failed: got {report.recall:.4f} "
+            f"required {min_recall:.4f}",
+        )
+    return GateResult(True, "correlation gate passed")

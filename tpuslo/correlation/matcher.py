@@ -1,0 +1,221 @@
+"""Tiered confidence matching of kernel/TPU signals to workload spans.
+
+Reference: ``pkg/correlation/dns.go:50-105`` — four tiers
+(trace_id=1.0, pod+pid≤100ms=0.9, pod+conn≤250ms=0.8,
+service+node≤500ms=0.65; enrichment threshold 0.70).
+
+The TPU-native build inserts two tiers:
+
+* ``xla_launch`` (0.95, ≤250ms) — join on XLA program + launch id.  TPU
+  work is submitted asynchronously, so wall-clock windows are too coarse
+  for per-step attribution; the launch id recovered by libtpu uprobes is
+  near-exact identity (only "near" because id reuse across processes is
+  possible after restarts).
+* ``slice_host`` (0.75, ≤250ms) — join on megascale slice + host index,
+  for driver-level events that carry no pod/pid identity; this replaces
+  pod+conn for cross-host correlation on multi-host pods (SURVEY.md
+  §2.5 "multi-host correlation").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from datetime import datetime, timedelta
+from typing import Any
+
+from tpuslo import semconv
+from tpuslo.schema import parse_rfc3339
+
+DEFAULT_WINDOW_MS = 2000
+DEFAULT_ENRICHMENT_THRESHOLD = 0.7
+
+TIER_TRACE_ID = "trace_id_exact"
+TIER_XLA_LAUNCH = "xla_launch"
+TIER_POD_PID = "pod_pid_100ms"
+TIER_POD_CONN = "pod_conn_250ms"
+TIER_SLICE_HOST = "slice_host_250ms"
+TIER_SERVICE_NODE = "service_node_500ms"
+
+TIER_CONFIDENCE = {
+    TIER_TRACE_ID: 1.0,
+    TIER_XLA_LAUNCH: 0.95,
+    TIER_POD_PID: 0.9,
+    TIER_POD_CONN: 0.8,
+    TIER_SLICE_HOST: 0.75,
+    TIER_SERVICE_NODE: 0.65,
+}
+
+
+def _ts(raw: Any) -> datetime | None:
+    if isinstance(raw, str):
+        return parse_rfc3339(raw)
+    return raw
+
+
+@dataclass
+class SpanRef:
+    """Minimal span metadata used for correlation."""
+
+    timestamp: datetime | None = None
+    trace_id: str = ""
+    service: str = ""
+    node: str = ""
+    pod: str = ""
+    pid: int = 0
+    conn_tuple: str = ""
+    # TPU identity (from JAX/XLA span attributes).
+    slice_id: str = ""
+    host_index: int = -1
+    program_id: str = ""
+    launch_id: int = -1
+
+    @classmethod
+    def from_dict(cls, raw: dict[str, Any]) -> "SpanRef":
+        return cls(
+            timestamp=_ts(raw.get("timestamp")),
+            trace_id=raw.get("trace_id", ""),
+            service=raw.get("service", ""),
+            node=raw.get("node", ""),
+            pod=raw.get("pod", ""),
+            pid=int(raw.get("pid", 0)),
+            conn_tuple=raw.get("conn_tuple", ""),
+            slice_id=raw.get("slice_id", ""),
+            host_index=int(raw.get("host_index", -1)),
+            program_id=raw.get("program_id", ""),
+            launch_id=int(raw.get("launch_id", -1)),
+        )
+
+
+@dataclass
+class SignalRef:
+    """Normalized signal metadata for correlation."""
+
+    signal: str = ""
+    timestamp: datetime | None = None
+    trace_id: str = ""
+    service: str = ""
+    node: str = ""
+    pod: str = ""
+    pid: int = 0
+    conn_tuple: str = ""
+    value: float = 0.0
+    slice_id: str = ""
+    host_index: int = -1
+    program_id: str = ""
+    launch_id: int = -1
+
+    @classmethod
+    def from_dict(cls, raw: dict[str, Any]) -> "SignalRef":
+        return cls(
+            signal=raw.get("signal", ""),
+            timestamp=_ts(raw.get("timestamp")),
+            trace_id=raw.get("trace_id", ""),
+            service=raw.get("service", ""),
+            node=raw.get("node", ""),
+            pod=raw.get("pod", ""),
+            pid=int(raw.get("pid", 0)),
+            conn_tuple=raw.get("conn_tuple", ""),
+            value=float(raw.get("value", 0.0)),
+            slice_id=raw.get("slice_id", ""),
+            host_index=int(raw.get("host_index", -1)),
+            program_id=raw.get("program_id", ""),
+            launch_id=int(raw.get("launch_id", -1)),
+        )
+
+
+@dataclass
+class Decision:
+    """One correlation result."""
+
+    matched: bool = False
+    confidence: float = 0.0
+    tier: str = ""
+
+
+def _within(a: datetime | None, b: datetime | None, window: timedelta) -> bool:
+    if a is None or b is None:
+        return False
+    return abs(a - b) <= window
+
+
+def match(span: SpanRef, signal: SignalRef, window_ms: int = 0) -> Decision:
+    """Compute confidence/tier for one span-signal pair."""
+    window = timedelta(milliseconds=window_ms if window_ms > 0 else DEFAULT_WINDOW_MS)
+    if not _within(span.timestamp, signal.timestamp, window):
+        return Decision()
+
+    if span.trace_id and span.trace_id == signal.trace_id:
+        return Decision(True, TIER_CONFIDENCE[TIER_TRACE_ID], TIER_TRACE_ID)
+
+    if (
+        span.program_id
+        and span.program_id == signal.program_id
+        and span.launch_id >= 0
+        and span.launch_id == signal.launch_id
+        and _within(span.timestamp, signal.timestamp, timedelta(milliseconds=250))
+    ):
+        return Decision(True, TIER_CONFIDENCE[TIER_XLA_LAUNCH], TIER_XLA_LAUNCH)
+
+    if (
+        span.pod
+        and span.pod == signal.pod
+        and span.pid > 0
+        and span.pid == signal.pid
+        and _within(span.timestamp, signal.timestamp, timedelta(milliseconds=100))
+    ):
+        return Decision(True, TIER_CONFIDENCE[TIER_POD_PID], TIER_POD_PID)
+
+    if (
+        span.pod
+        and span.pod == signal.pod
+        and span.conn_tuple
+        and span.conn_tuple == signal.conn_tuple
+        and _within(span.timestamp, signal.timestamp, timedelta(milliseconds=250))
+    ):
+        return Decision(True, TIER_CONFIDENCE[TIER_POD_CONN], TIER_POD_CONN)
+
+    if (
+        span.slice_id
+        and span.slice_id == signal.slice_id
+        and span.host_index >= 0
+        and span.host_index == signal.host_index
+        and _within(span.timestamp, signal.timestamp, timedelta(milliseconds=250))
+    ):
+        return Decision(True, TIER_CONFIDENCE[TIER_SLICE_HOST], TIER_SLICE_HOST)
+
+    if (
+        span.service
+        and span.service == signal.service
+        and span.node
+        and span.node == signal.node
+        and _within(span.timestamp, signal.timestamp, timedelta(milliseconds=500))
+    ):
+        return Decision(True, TIER_CONFIDENCE[TIER_SERVICE_NODE], TIER_SERVICE_NODE)
+
+    return Decision()
+
+
+def enrich_dns(
+    base: dict[str, float] | None,
+    span: SpanRef,
+    signal: SignalRef,
+    window_ms: int = 0,
+    threshold: float = 0.0,
+) -> tuple[dict[str, float], Decision]:
+    """Apply DNS attributes when confidence passes the threshold.
+
+    Reference: ``pkg/correlation/dns.go:79-105``.
+    """
+    base = dict(base or {})
+    threshold = threshold if threshold > 0 else DEFAULT_ENRICHMENT_THRESHOLD
+
+    decision = match(span, signal, window_ms)
+    if not decision.matched or decision.confidence < threshold:
+        return base, decision
+    if signal.signal != "dns_latency_ms":
+        return base, Decision()
+
+    out = dict(base)
+    out[semconv.ATTR_DNS_LATENCY_MS] = signal.value
+    out[semconv.ATTR_CORRELATION_CONF] = decision.confidence
+    return out, decision

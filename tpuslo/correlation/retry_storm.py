@@ -1,0 +1,72 @@
+"""Sliding-window retry-storm detection, per pod and per ICI link.
+
+Reference: ``pkg/correlation/retry_storm.go`` — 10s window, ≥5 TCP
+retransmit events flags a pod-level storm and emits
+``llm.ebpf.tcp.retry_storm=true`` on correlated spans.  The TPU-native
+build reuses the same detector keyed by ``slice:link`` for ICI
+link-retry bursts.
+"""
+
+from __future__ import annotations
+
+import threading
+from datetime import datetime, timedelta
+
+DEFAULT_STORM_WINDOW_S = 10.0
+DEFAULT_STORM_THRESHOLD = 5
+
+
+class RetryStormDetector:
+    """Counts events per key in a sliding window; thread-safe."""
+
+    def __init__(
+        self,
+        window_s: float = DEFAULT_STORM_WINDOW_S,
+        threshold: int = DEFAULT_STORM_THRESHOLD,
+    ):
+        if window_s <= 0:
+            raise ValueError("window_s must be > 0")
+        if threshold < 1:
+            raise ValueError("threshold must be >= 1")
+        self._window = timedelta(seconds=window_s)
+        self._threshold = threshold
+        self._events: dict[str, list[datetime]] = {}
+        self._lock = threading.Lock()
+
+    def _prune(self, key: str, now: datetime) -> list[datetime]:
+        cutoff = now - self._window
+        events = [ts for ts in self._events.get(key, []) if ts > cutoff]
+        if events:
+            self._events[key] = events
+        else:
+            # Drop empty keys so pod/conn churn can't grow the map forever.
+            self._events.pop(key, None)
+        return events
+
+    def record(self, key: str, ts: datetime) -> bool:
+        """Register one event; True if this pushes the key into storm."""
+        with self._lock:
+            self._events.setdefault(key, []).append(ts)
+            return len(self._prune(key, ts)) >= self._threshold
+
+    def is_storm(self, key: str, now: datetime) -> bool:
+        with self._lock:
+            return len(self._prune(key, now)) >= self._threshold
+
+    def count(self, key: str, now: datetime) -> int:
+        with self._lock:
+            return len(self._prune(key, now))
+
+    def active_keys(self, now: datetime) -> list[str]:
+        """All keys currently in storm state."""
+        with self._lock:
+            return sorted(
+                key
+                for key in list(self._events)
+                if len(self._prune(key, now)) >= self._threshold
+            )
+
+
+def ici_storm_key(slice_id: str, link: int) -> str:
+    """Canonical detector key for ICI link-retry bursts."""
+    return f"ici:{slice_id}:{link}"

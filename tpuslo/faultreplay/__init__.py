@@ -1,0 +1,13 @@
+from tpuslo.faultreplay.generator import (
+    MULTI_FAULT_PAIRS,
+    TPU_MULTI_FAULT_PAIRS,
+    generate_fault_samples,
+    supported_scenarios,
+)
+
+__all__ = [
+    "MULTI_FAULT_PAIRS",
+    "TPU_MULTI_FAULT_PAIRS",
+    "generate_fault_samples",
+    "supported_scenarios",
+]

@@ -1,0 +1,3 @@
+from tpuslo.metrics.registry import AgentMetrics, start_metrics_server
+
+__all__ = ["AgentMetrics", "start_metrics_server"]

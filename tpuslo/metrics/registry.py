@@ -1,0 +1,155 @@
+"""Agent Prometheus metrics + /metrics //healthz //readyz server.
+
+Reference: ``cmd/agent/main.go:154-249`` — heartbeat, up,
+cpu_overhead_pct, event-kind / capability / signal-enabled one-hot
+gauges, dropped-by-reason counter, DNS latency histogram, probe-event
+counter.  The TPU-native build adds a TPU-signal counter and an
+hbm-utilization gauge so dashboards can chart device pressure directly
+from the agent.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+from prometheus_client.exposition import CONTENT_TYPE_LATEST
+
+from tpuslo.signals import ALL_SIGNALS, TPU_SIGNALS
+
+
+class AgentMetrics:
+    """Registry of the node agent's operational series."""
+
+    def __init__(self, registry: CollectorRegistry | None = None):
+        self.registry = registry or CollectorRegistry()
+        self.heartbeat = Gauge(
+            "llm_slo_agent_heartbeat_timestamp_seconds",
+            "Unix time of the agent's last emit cycle",
+            registry=self.registry,
+        )
+        self.up = Gauge(
+            "llm_slo_agent_up", "1 while the agent loop is running",
+            registry=self.registry,
+        )
+        self.cpu_overhead_pct = Gauge(
+            "llm_slo_agent_cpu_overhead_pct",
+            "Agent self-measured CPU overhead percent",
+            registry=self.registry,
+        )
+        self.event_kind = Gauge(
+            "llm_slo_agent_event_kind",
+            "One-hot event kind selector",
+            ["kind"],
+            registry=self.registry,
+        )
+        self.capability_mode = Gauge(
+            "llm_slo_agent_capability_mode",
+            "One-hot capability mode",
+            ["mode"],
+            registry=self.registry,
+        )
+        self.signal_enabled = Gauge(
+            "llm_slo_agent_signal_enabled",
+            "1 when a signal probe is enabled",
+            ["signal"],
+            registry=self.registry,
+        )
+        self.dropped = Counter(
+            "llm_slo_agent_events_dropped_total",
+            "Events dropped by reason",
+            ["reason"],
+            registry=self.registry,
+        )
+        self.slo_events = Counter(
+            "llm_slo_agent_slo_events_total",
+            "SLO events emitted",
+            registry=self.registry,
+        )
+        self.probe_events = Counter(
+            "llm_slo_agent_probe_events_total",
+            "Probe events emitted",
+            ["signal"],
+            registry=self.registry,
+        )
+        self.dns_latency_ms = Histogram(
+            "llm_slo_agent_dns_latency_ms",
+            "Observed DNS latency signal values",
+            buckets=(5, 10, 25, 50, 100, 200, 400, 800),
+            registry=self.registry,
+        )
+        self.hbm_utilization_pct = Gauge(
+            "llm_tpu_agent_hbm_utilization_pct",
+            "Latest observed HBM utilization percent",
+            registry=self.registry,
+        )
+        self.tpu_events = Counter(
+            "llm_tpu_agent_probe_events_total",
+            "TPU-side probe events emitted",
+            registry=self.registry,
+        )
+        self.webhook_sent = Counter(
+            "llm_slo_agent_webhook_deliveries_total",
+            "Webhook deliveries by outcome",
+            ["outcome"],
+            registry=self.registry,
+        )
+
+    def set_enabled_signals(self, enabled: list[str]) -> None:
+        enabled_set = set(enabled)
+        for signal in ALL_SIGNALS:
+            self.signal_enabled.labels(signal=signal).set(
+                1.0 if signal in enabled_set else 0.0
+            )
+
+    def observe_probe(self, signal: str, value: float) -> None:
+        self.probe_events.labels(signal=signal).inc()
+        if signal == "dns_latency_ms":
+            self.dns_latency_ms.observe(value)
+        if signal == "hbm_utilization_pct":
+            self.hbm_utilization_pct.set(value)
+        if signal in TPU_SIGNALS:
+            self.tpu_events.inc()
+
+    def mark_cycle(self) -> None:
+        self.heartbeat.set(time.time())
+
+
+def start_metrics_server(
+    metrics: AgentMetrics, port: int, host: str = "0.0.0.0"
+) -> ThreadingHTTPServer:
+    """Serve /metrics, /healthz, /readyz on a daemon thread."""
+
+    registry = metrics.registry
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path.startswith("/metrics"):
+                body = generate_latest(registry)
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE_LATEST)
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path in ("/healthz", "/readyz"):
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(b"ok\n")
+            else:
+                self.send_response(404)
+                self.end_headers()
+
+        def log_message(self, *args):
+            pass
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server

@@ -1,0 +1,21 @@
+from tpuslo.prereq.checker import (
+    SEVERITY_BLOCKER,
+    SEVERITY_INFO,
+    SEVERITY_WARNING,
+    CheckResult,
+    HostSnapshot,
+    collect_snapshot,
+    evaluate,
+    parse_kernel_release,
+)
+
+__all__ = [
+    "SEVERITY_BLOCKER",
+    "SEVERITY_INFO",
+    "SEVERITY_WARNING",
+    "CheckResult",
+    "HostSnapshot",
+    "collect_snapshot",
+    "evaluate",
+    "parse_kernel_release",
+]

@@ -1,0 +1,179 @@
+"""Host prerequisite checks for running the agent with real probes.
+
+Reference: ``pkg/prereq/checker.go:56-216`` — kernel ≥ 5.15, BTF,
+bpftool, clang, root, kind, helm with blocker/warning severities.  The
+TPU-native build adds the accelerator surface: ``/dev/accel*`` nodes,
+``libtpu.so`` discovery, and an importable JAX for the demo workload.
+"""
+
+from __future__ import annotations
+
+import glob
+import importlib.util
+import os
+import platform
+import re
+import shutil
+from dataclasses import dataclass, field
+
+from tpuslo.signals.mode import BTF_PATH, find_libtpu
+
+SEVERITY_BLOCKER = "blocker"
+SEVERITY_WARNING = "warning"
+SEVERITY_INFO = "info"
+
+MIN_KERNEL = (5, 15)
+
+_KERNEL_RE = re.compile(r"^(\d+)\.(\d+)")
+
+
+def parse_kernel_release(release: str) -> tuple[int, int]:
+    """Extract (major, minor) from a uname release string."""
+    m = _KERNEL_RE.match(release.strip())
+    if not m:
+        raise ValueError(f"unparseable kernel release {release!r}")
+    return int(m.group(1)), int(m.group(2))
+
+
+@dataclass
+class HostSnapshot:
+    kernel_release: str = ""
+    has_btf: bool = False
+    is_root: bool = False
+    bpftool: str = ""
+    clang: str = ""
+    kind: str = ""
+    helm: str = ""
+    accel_devices: list[str] = field(default_factory=list)
+    libtpu_path: str = ""
+    jax_available: bool = False
+
+
+def collect_snapshot(
+    btf_path: str = BTF_PATH,
+    accel_glob: str = "/dev/accel*",
+    env: dict[str, str] | None = None,
+) -> HostSnapshot:
+    return HostSnapshot(
+        kernel_release=platform.release(),
+        has_btf=os.path.exists(btf_path),
+        is_root=(os.geteuid() == 0) if hasattr(os, "geteuid") else False,
+        bpftool=shutil.which("bpftool") or "",
+        clang=shutil.which("clang") or "",
+        kind=shutil.which("kind") or "",
+        helm=shutil.which("helm") or "",
+        accel_devices=sorted(glob.glob(accel_glob)),
+        libtpu_path=find_libtpu(env),
+        jax_available=importlib.util.find_spec("jax") is not None,
+    )
+
+
+@dataclass
+class CheckResult:
+    name: str
+    severity: str
+    passed: bool
+    detail: str
+
+    def to_dict(self):
+        return self.__dict__
+
+
+def evaluate(snapshot: HostSnapshot) -> list[CheckResult]:
+    """Evaluate prerequisite checks against a host snapshot."""
+    results: list[CheckResult] = []
+
+    try:
+        major, minor = parse_kernel_release(snapshot.kernel_release)
+        kernel_ok = (major, minor) >= MIN_KERNEL
+        detail = f"kernel {snapshot.kernel_release}"
+    except ValueError:
+        kernel_ok = False
+        detail = f"unparseable kernel release {snapshot.kernel_release!r}"
+    results.append(
+        CheckResult(
+            "kernel_version",
+            SEVERITY_BLOCKER,
+            kernel_ok,
+            detail + f" (required >= {MIN_KERNEL[0]}.{MIN_KERNEL[1]})",
+        )
+    )
+    results.append(
+        CheckResult(
+            "btf_available",
+            SEVERITY_BLOCKER,
+            snapshot.has_btf,
+            "BTF at /sys/kernel/btf/vmlinux enables CO-RE probes"
+            if snapshot.has_btf
+            else "no BTF: agent degrades to bcc_degraded signal set",
+        )
+    )
+    results.append(
+        CheckResult(
+            "root_privileges",
+            SEVERITY_BLOCKER,
+            snapshot.is_root,
+            "root (or CAP_BPF + CAP_SYS_ADMIN) required to attach probes",
+        )
+    )
+    results.append(
+        CheckResult(
+            "bpftool",
+            SEVERITY_WARNING,
+            bool(snapshot.bpftool),
+            snapshot.bpftool or "bpftool missing: probe smoke checks unavailable",
+        )
+    )
+    results.append(
+        CheckResult(
+            "clang",
+            SEVERITY_WARNING,
+            bool(snapshot.clang),
+            snapshot.clang or "clang missing: cannot rebuild eBPF objects locally",
+        )
+    )
+    results.append(
+        CheckResult(
+            "accel_devices",
+            SEVERITY_WARNING,
+            bool(snapshot.accel_devices),
+            ", ".join(snapshot.accel_devices)
+            or "no /dev/accel* nodes: TPU kprobes unavailable (core_full mode)",
+        )
+    )
+    results.append(
+        CheckResult(
+            "libtpu",
+            SEVERITY_WARNING,
+            bool(snapshot.libtpu_path),
+            snapshot.libtpu_path
+            or "libtpu.so not found: TPU uprobes unavailable",
+        )
+    )
+    results.append(
+        CheckResult(
+            "jax",
+            SEVERITY_WARNING,
+            snapshot.jax_available,
+            "jax importable for the demo workload"
+            if snapshot.jax_available
+            else "jax not importable: demo serving unavailable",
+        )
+    )
+    results.append(
+        CheckResult(
+            "kind",
+            SEVERITY_INFO,
+            bool(snapshot.kind),
+            snapshot.kind or "kind missing: local cluster smoke unavailable",
+        )
+    )
+    results.append(
+        CheckResult(
+            "helm",
+            SEVERITY_INFO,
+            bool(snapshot.helm),
+            snapshot.helm or "helm missing: chart install unavailable",
+        )
+    )
+    return results

@@ -1,0 +1,511 @@
+"""M5 GA statistical release gates: baseline provenance, B5 overhead,
+D3 rerun variance, E3 significance.
+
+Reference: ``pkg/releasegate/gate.go:140-946``.  Artifact layout::
+
+    <candidate_root>/<scenario>/<run-*>/raw_samples.jsonl
+    <candidate_root>/<scenario>/<run-*>/collector_overhead.csv
+    <baseline_root>/manifest.json  (+ same per-scenario layout)
+
+Gate semantics:
+  baseline — manifest provenance; candidate==baseline source downgrades
+             E3 comparisons to informational (same-source skip).
+  B5       — per-node p95 CPU overhead ≤ threshold AND mean ≤ threshold.
+  D3       — CV% of TTFT-p95 / tokens-p50 / error-mean across ≥3 runs
+             ≤ threshold.
+  E3       — TTFT-p95 regression fails only if pct > limit AND
+             Mann-Whitney p < α AND bootstrap CI95 low > 0 AND
+             |Cliff's δ| ≥ practical threshold, with n ≥ 30/scenario.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+from dataclasses import dataclass, field
+from datetime import datetime, timezone
+from pathlib import Path
+from typing import Any
+
+from tpuslo.collector.synthetic import RawSample
+from tpuslo.releasegate.stats import (
+    bootstrap_delta_ci,
+    cliffs_delta,
+    coefficient_of_variance_pct,
+    mann_whitney_p_value,
+    mean,
+    stddev,
+)
+from tpuslo.slo.calculator import quantile
+
+DEFAULT_SCENARIOS = [
+    "dns_latency",
+    "cpu_throttle",
+    "provider_throttle",
+    "memory_pressure",
+    "network_partition",
+    "ici_drop",
+    "hbm_pressure",
+    "xla_recompile_storm",
+    "host_offload_stall",
+    "mixed",
+    "mixed_multi",
+    "tpu_mixed",
+]
+
+
+@dataclass
+class Config:
+    candidate_root: str = "artifacts/weekly-benchmark"
+    baseline_root: str = ""
+    baseline_manifest_path: str = ""
+    candidate_ref: str = ""
+    candidate_commit: str = ""
+    require_baseline_manifest: bool = False
+    scenarios: list[str] = field(default_factory=list)
+    max_overhead_pct: float = 3.0
+    max_variance_pct: float = 10.0
+    min_runs_per_scenario: int = 3
+    regression_pct_limit: float = 5.0
+    significance_alpha: float = 0.05
+    bootstrap_iterations: int = 1000
+    bootstrap_seed: int = 42
+    min_samples_per_scenario: int = 30
+    min_cliffs_delta_for_failure: float = 0.147
+
+    def normalized(self) -> "Config":
+        cfg = Config(**self.__dict__)
+        if not cfg.baseline_root:
+            cfg.baseline_root = os.path.join(cfg.candidate_root, "baseline")
+        if not cfg.baseline_manifest_path:
+            cfg.baseline_manifest_path = os.path.join(cfg.baseline_root, "manifest.json")
+        if not cfg.scenarios:
+            cfg.scenarios = list(DEFAULT_SCENARIOS)
+        if cfg.max_overhead_pct <= 0:
+            cfg.max_overhead_pct = 3.0
+        if cfg.max_variance_pct <= 0:
+            cfg.max_variance_pct = 10.0
+        if cfg.min_runs_per_scenario <= 0:
+            cfg.min_runs_per_scenario = 3
+        if cfg.regression_pct_limit <= 0:
+            cfg.regression_pct_limit = 5.0
+        if not 0 < cfg.significance_alpha < 1:
+            cfg.significance_alpha = 0.05
+        if cfg.bootstrap_iterations <= 0:
+            cfg.bootstrap_iterations = 1000
+        if cfg.bootstrap_seed == 0:
+            cfg.bootstrap_seed = 42
+        if cfg.min_samples_per_scenario <= 0:
+            cfg.min_samples_per_scenario = 30
+        if cfg.min_cliffs_delta_for_failure <= 0:
+            cfg.min_cliffs_delta_for_failure = 0.147
+        return cfg
+
+
+@dataclass
+class BaselineGate:
+    passed: bool = True
+    manifest_required: bool = False
+    manifest_path: str = ""
+    source_ref: str = ""
+    source_commit: str = ""
+    candidate_ref: str = ""
+    candidate_commit: str = ""
+    same_source: bool = False
+    failure_reason: str = ""
+
+
+@dataclass
+class OverheadGate:
+    passed: bool = True
+    threshold_pct: float = 3.0
+    max_observed_pct: float = 0.0
+    mean_observed_pct: float = 0.0
+    sample_count: int = 0
+    files_checked: int = 0
+    node_p95_observed: dict[str, float] = field(default_factory=dict)
+    max_node_p95_pct: float = 0.0
+    max_node_p95_node: str = ""
+    failure_reason: str = ""
+
+
+@dataclass
+class ScenarioVariance:
+    scenario: str
+    run_count: int = 0
+    ttft_p95_values: list[float] = field(default_factory=list)
+    mean_ttft_p95: float = 0.0
+    stddev_ttft_p95: float = 0.0
+    variance_pct: float = 0.0
+    tokens_p50_values: list[float] = field(default_factory=list)
+    tokens_variance_pct: float = 0.0
+    error_rate_mean_values: list[float] = field(default_factory=list)
+    error_rate_variance_pct: float = 0.0
+    passed: bool = True
+    failure_reason: str = ""
+
+
+@dataclass
+class VarianceGate:
+    passed: bool = True
+    threshold_pct: float = 10.0
+    min_runs: int = 3
+    scenarios: list[ScenarioVariance] = field(default_factory=list)
+
+
+@dataclass
+class ScenarioSignificance:
+    scenario: str
+    candidate_n: int = 0
+    baseline_n: int = 0
+    candidate_ttft_p95: float = 0.0
+    baseline_ttft_p95: float = 0.0
+    ttft_regression_pct: float = 0.0
+    mann_whitney_p_value: float = 1.0
+    bootstrap_delta_ci95: tuple[float, float] = (0.0, 0.0)
+    cliffs_delta: float = 0.0
+    practical_effect_pass: bool = False
+    minimum_samples_reached: bool = False
+    informational_only: bool = False
+    passed: bool = True
+    failure_reason: str = ""
+
+
+@dataclass
+class SignificanceGate:
+    passed: bool = True
+    regression_pct_limit: float = 5.0
+    alpha: float = 0.05
+    bootstrap_iterations: int = 1000
+    min_samples_per_scenario: int = 30
+    min_cliffs_delta_for_failure: float = 0.147
+    scenarios: list[ScenarioSignificance] = field(default_factory=list)
+
+
+@dataclass
+class Summary:
+    generated_at: str = ""
+    candidate_root: str = ""
+    baseline_root: str = ""
+    scenarios: list[str] = field(default_factory=list)
+    baseline: BaselineGate = field(default_factory=BaselineGate)
+    overhead: OverheadGate = field(default_factory=OverheadGate)
+    variance: VarianceGate = field(default_factory=VarianceGate)
+    significance: SignificanceGate = field(default_factory=SignificanceGate)
+    passed: bool = False
+    failures: list[str] = field(default_factory=list)
+
+    def to_dict(self) -> dict[str, Any]:
+        def plain(obj):
+            if hasattr(obj, "__dataclass_fields__"):
+                return {k: plain(v) for k, v in obj.__dict__.items()}
+            if isinstance(obj, (list, tuple)):
+                return [plain(v) for v in obj]
+            if isinstance(obj, dict):
+                return {k: plain(v) for k, v in obj.items()}
+            return obj
+
+        return plain(self)
+
+
+def discover_runs(scenario_root: str | Path) -> list[str]:
+    root = Path(scenario_root)
+    if not root.is_dir():
+        return []
+    return sorted(str(p) for p in root.iterdir() if p.is_dir())
+
+
+def load_raw_samples(path: str | Path) -> list[RawSample]:
+    samples = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                samples.append(RawSample.from_dict(json.loads(line)))
+    return samples
+
+
+def load_overhead_csv(path: str | Path) -> list[tuple[str, float]]:
+    """Rows of (node, cpu_pct) from a collector_overhead.csv file."""
+    out = []
+    with open(path, newline="", encoding="utf-8") as f:
+        for row in csv.DictReader(f):
+            node = row.get("node", "")
+            cpu = row.get("cpu_pct", row.get("cpu", ""))
+            if node and cpu:
+                out.append((node, float(cpu)))
+    return out
+
+
+def evaluate(cfg: Config) -> Summary:
+    cfg = cfg.normalized()
+    summary = Summary(
+        generated_at=datetime.now(timezone.utc).isoformat(),
+        candidate_root=cfg.candidate_root,
+        baseline_root=cfg.baseline_root,
+        scenarios=list(cfg.scenarios),
+    )
+    summary.baseline = _evaluate_baseline(cfg)
+    summary.overhead = _evaluate_overhead(cfg)
+    summary.variance = _evaluate_variance(cfg)
+    summary.significance = _evaluate_significance(cfg, summary.baseline.same_source)
+    summary.passed = (
+        summary.baseline.passed
+        and summary.overhead.passed
+        and summary.variance.passed
+        and summary.significance.passed
+    )
+    if not summary.baseline.passed:
+        summary.failures.append(
+            "baseline gate failed: "
+            + (summary.baseline.failure_reason or "provenance validation failed")
+        )
+    if not summary.overhead.passed:
+        summary.failures.append(
+            "B5 overhead gate failed: " + summary.overhead.failure_reason
+        )
+    if not summary.variance.passed:
+        summary.failures.append("D3 rerun variance gate failed")
+    if not summary.significance.passed:
+        summary.failures.append("E3 significance gate failed")
+    return summary
+
+
+def _evaluate_baseline(cfg: Config) -> BaselineGate:
+    gate = BaselineGate(
+        manifest_required=cfg.require_baseline_manifest,
+        manifest_path=cfg.baseline_manifest_path,
+        candidate_ref=cfg.candidate_ref,
+        candidate_commit=cfg.candidate_commit,
+    )
+    manifest_path = Path(cfg.baseline_manifest_path)
+    if not manifest_path.exists():
+        if cfg.require_baseline_manifest:
+            gate.passed = False
+            gate.failure_reason = f"baseline manifest missing at {manifest_path}"
+        return gate
+    try:
+        manifest = json.loads(manifest_path.read_text())
+    except json.JSONDecodeError as exc:
+        gate.passed = False
+        gate.failure_reason = f"baseline manifest unreadable: {exc}"
+        return gate
+    gate.source_ref = manifest.get("source_ref", "")
+    gate.source_commit = manifest.get("source_commit", "")
+    gate.same_source = bool(
+        gate.source_commit
+        and cfg.candidate_commit
+        and gate.source_commit == cfg.candidate_commit
+    )
+    return gate
+
+
+def _evaluate_overhead(cfg: Config) -> OverheadGate:
+    gate = OverheadGate(threshold_pct=cfg.max_overhead_pct)
+    values: list[float] = []
+    by_node: dict[str, list[float]] = {}
+    for scenario in cfg.scenarios:
+        runs = discover_runs(Path(cfg.candidate_root) / scenario)
+        if not runs:
+            gate.passed = False
+            gate.failure_reason = f"no run directories found for scenario {scenario}"
+            return gate
+        for run_dir in runs:
+            path = Path(run_dir) / "collector_overhead.csv"
+            if not path.exists():
+                gate.passed = False
+                gate.failure_reason = f"missing {path}"
+                return gate
+            gate.files_checked += 1
+            for node, cpu in load_overhead_csv(path):
+                values.append(cpu)
+                by_node.setdefault(node, []).append(cpu)
+    if not values:
+        gate.passed = False
+        gate.failure_reason = f"no overhead values found in {cfg.candidate_root}"
+        return gate
+    gate.sample_count = len(values)
+    gate.max_observed_pct = max(values)
+    gate.mean_observed_pct = mean(values)
+    for node, node_values in by_node.items():
+        p95 = quantile(node_values, 0.95)
+        gate.node_p95_observed[node] = p95
+        if p95 > gate.max_node_p95_pct or not gate.max_node_p95_node:
+            gate.max_node_p95_pct = p95
+            gate.max_node_p95_node = node
+    gate.passed = (
+        gate.max_node_p95_pct <= gate.threshold_pct
+        and gate.mean_observed_pct <= gate.threshold_pct
+    )
+    if not gate.passed:
+        if gate.max_node_p95_pct > gate.threshold_pct:
+            gate.failure_reason = (
+                f"node {gate.max_node_p95_node} p95 overhead "
+                f"{gate.max_node_p95_pct:.4f} exceeds {gate.threshold_pct:.4f}"
+            )
+        else:
+            gate.failure_reason = (
+                f"mean overhead {gate.mean_observed_pct:.4f} exceeds "
+                f"{gate.threshold_pct:.4f}"
+            )
+    return gate
+
+
+def _scenario_metrics(run_dirs: list[str]) -> tuple[list[float], list[float], list[float], list[list[float]]]:
+    ttft_p95, tokens_p50, err_mean = [], [], []
+    pooled_ttft: list[list[float]] = []
+    for run_dir in run_dirs:
+        samples = load_raw_samples(Path(run_dir) / "raw_samples.jsonl")
+        ttft = [s.ttft_ms for s in samples]
+        tokens = [s.token_throughput_tps for s in samples]
+        errors = [s.error_rate for s in samples]
+        if not ttft or not tokens or not errors:
+            raise ValueError(f"empty metric series in {run_dir}")
+        ttft_p95.append(quantile(ttft, 0.95))
+        tokens_p50.append(quantile(tokens, 0.50))
+        err_mean.append(mean(errors))
+        pooled_ttft.append(ttft)
+    return ttft_p95, tokens_p50, err_mean, pooled_ttft
+
+
+def _evaluate_variance(cfg: Config) -> VarianceGate:
+    gate = VarianceGate(
+        threshold_pct=cfg.max_variance_pct, min_runs=cfg.min_runs_per_scenario
+    )
+    for scenario in cfg.scenarios:
+        runs = discover_runs(Path(cfg.candidate_root) / scenario)
+        row = ScenarioVariance(scenario=scenario, run_count=len(runs))
+        if len(runs) < cfg.min_runs_per_scenario:
+            row.passed = False
+            row.failure_reason = f"requires at least {cfg.min_runs_per_scenario} runs"
+            gate.passed = False
+            gate.scenarios.append(row)
+            continue
+        try:
+            ttft_p95, tokens_p50, err_mean, _ = _scenario_metrics(runs)
+        except (OSError, ValueError, json.JSONDecodeError) as exc:
+            row.passed = False
+            row.failure_reason = f"unreadable run artifacts: {exc}"
+            gate.passed = False
+            gate.scenarios.append(row)
+            continue
+        row.ttft_p95_values = ttft_p95
+        row.mean_ttft_p95 = mean(ttft_p95)
+        row.stddev_ttft_p95 = stddev(ttft_p95)
+        row.variance_pct = coefficient_of_variance_pct(ttft_p95)
+        row.tokens_p50_values = tokens_p50
+        row.tokens_variance_pct = coefficient_of_variance_pct(tokens_p50)
+        row.error_rate_mean_values = err_mean
+        row.error_rate_variance_pct = coefficient_of_variance_pct(err_mean)
+        row.passed = (
+            row.variance_pct <= cfg.max_variance_pct
+            and row.tokens_variance_pct <= cfg.max_variance_pct
+            and row.error_rate_variance_pct <= cfg.max_variance_pct
+        )
+        if not row.passed:
+            parts = []
+            if row.variance_pct > cfg.max_variance_pct:
+                parts.append(f"ttft variance {row.variance_pct:.4f}% exceeds limit")
+            if row.tokens_variance_pct > cfg.max_variance_pct:
+                parts.append(f"tokens variance {row.tokens_variance_pct:.4f}% exceeds limit")
+            if row.error_rate_variance_pct > cfg.max_variance_pct:
+                parts.append(
+                    f"error-rate variance {row.error_rate_variance_pct:.4f}% exceeds limit"
+                )
+            row.failure_reason = "; ".join(parts)
+            gate.passed = False
+        gate.scenarios.append(row)
+    return gate
+
+
+def _evaluate_significance(cfg: Config, same_source: bool) -> SignificanceGate:
+    gate = SignificanceGate(
+        regression_pct_limit=cfg.regression_pct_limit,
+        alpha=cfg.significance_alpha,
+        bootstrap_iterations=cfg.bootstrap_iterations,
+        min_samples_per_scenario=cfg.min_samples_per_scenario,
+        min_cliffs_delta_for_failure=cfg.min_cliffs_delta_for_failure,
+    )
+    for scenario in cfg.scenarios:
+        row = ScenarioSignificance(scenario=scenario)
+        candidate_runs = discover_runs(Path(cfg.candidate_root) / scenario)
+        baseline_runs = discover_runs(Path(cfg.baseline_root) / scenario)
+        if not candidate_runs or not baseline_runs:
+            # No baseline to compare against: informational skip.
+            row.informational_only = True
+            gate.scenarios.append(row)
+            continue
+        try:
+            _, _, _, cand_pooled = _scenario_metrics(candidate_runs)
+            _, _, _, base_pooled = _scenario_metrics(baseline_runs)
+        except (OSError, ValueError, json.JSONDecodeError) as exc:
+            row.passed = False
+            row.failure_reason = f"unreadable run artifacts: {exc}"
+            gate.passed = False
+            gate.scenarios.append(row)
+            continue
+        candidate = [v for run in cand_pooled for v in run]
+        baseline = [v for run in base_pooled for v in run]
+        row.candidate_n = len(candidate)
+        row.baseline_n = len(baseline)
+        row.candidate_ttft_p95 = quantile(candidate, 0.95)
+        row.baseline_ttft_p95 = quantile(baseline, 0.95)
+        if row.baseline_ttft_p95 > 0:
+            row.ttft_regression_pct = (
+                (row.candidate_ttft_p95 - row.baseline_ttft_p95)
+                / row.baseline_ttft_p95
+                * 100.0
+            )
+        row.minimum_samples_reached = (
+            row.candidate_n >= cfg.min_samples_per_scenario
+            and row.baseline_n >= cfg.min_samples_per_scenario
+        )
+        row.mann_whitney_p_value = mann_whitney_p_value(candidate, baseline)
+        row.bootstrap_delta_ci95 = bootstrap_delta_ci(
+            candidate,
+            baseline,
+            0.95,
+            cfg.bootstrap_iterations,
+            cfg.bootstrap_seed,
+        )
+        row.cliffs_delta = cliffs_delta(candidate, baseline)
+        row.practical_effect_pass = (
+            abs(row.cliffs_delta) >= cfg.min_cliffs_delta_for_failure
+        )
+        if same_source:
+            row.informational_only = True
+            gate.scenarios.append(row)
+            continue
+        if not row.minimum_samples_reached:
+            row.informational_only = True
+            row.failure_reason = (
+                f"insufficient samples (candidate={row.candidate_n}, "
+                f"baseline={row.baseline_n}, required={cfg.min_samples_per_scenario})"
+            )
+            gate.scenarios.append(row)
+            continue
+        ci_low, ci_high = row.bootstrap_delta_ci95
+        is_regression = (
+            row.ttft_regression_pct > cfg.regression_pct_limit
+            and row.mann_whitney_p_value < cfg.significance_alpha
+            and ci_low > 0
+        )
+        if is_regression and row.practical_effect_pass:
+            row.passed = False
+            row.failure_reason = (
+                f"ttft regression {row.ttft_regression_pct:.4f}% exceeds "
+                f"{cfg.regression_pct_limit:.4f}% with "
+                f"p={row.mann_whitney_p_value:.6f} "
+                f"CI95[{ci_low:.4f}, {ci_high:.4f}] and Cliff's delta "
+                f"{row.cliffs_delta:.4f}"
+            )
+            gate.passed = False
+        elif is_regression:
+            row.failure_reason = (
+                f"statistical regression detected but |Cliff's delta| "
+                f"{abs(row.cliffs_delta):.4f} < "
+                f"{cfg.min_cliffs_delta_for_failure:.4f} practical threshold"
+            )
+        gate.scenarios.append(row)
+    return gate

@@ -1,0 +1,113 @@
+"""Statistical primitives for the M5 release gates.
+
+Reference: ``pkg/releasegate/gate.go:816-946`` — Mann-Whitney U with tie
+correction and normal approximation (continuity-corrected), Cliff's
+delta, and a seeded bootstrap CI for quantile deltas.  Pure functions,
+deterministic under a fixed seed.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+
+from tpuslo.slo.calculator import quantile
+
+
+def mean(values: list[float]) -> float:
+    return sum(values) / len(values) if values else 0.0
+
+
+def stddev(values: list[float]) -> float:
+    """Population standard deviation."""
+    if len(values) < 2:
+        return 0.0
+    m = mean(values)
+    return math.sqrt(sum((v - m) ** 2 for v in values) / len(values))
+
+
+def coefficient_of_variance_pct(values: list[float]) -> float:
+    m = mean(values)
+    if m == 0:
+        return 0.0
+    return (stddev(values) / abs(m)) * 100.0
+
+
+def normal_cdf(z: float) -> float:
+    return 0.5 * (1.0 + math.erf(z / math.sqrt(2.0)))
+
+
+def mann_whitney_p_value(x: list[float], y: list[float]) -> float:
+    """Two-sided Mann-Whitney U p-value (normal approximation).
+
+    Ties get average ranks with the variance tie-correction term; the
+    z statistic is continuity-corrected by 0.5.
+    """
+    nx, ny = len(x), len(y)
+    if nx == 0 or ny == 0:
+        return 1.0
+
+    points = sorted(
+        [(v, 0) for v in x] + [(v, 1) for v in y], key=lambda p: p[0]
+    )
+    ranks = [0.0] * len(points)
+    tie_sum = 0.0
+    i = 0
+    while i < len(points):
+        j = i + 1
+        while j < len(points) and points[j][0] == points[i][0]:
+            j += 1
+        avg_rank = (i + 1 + j) / 2.0
+        for k in range(i, j):
+            ranks[k] = avg_rank
+        t = j - i
+        if t > 1:
+            tie_sum += t**3 - t
+        i = j
+
+    rank_x = sum(rank for rank, (_, group) in zip(ranks, points) if group == 0)
+    u1 = rank_x - nx * (nx + 1) / 2.0
+    u2 = nx * ny - u1
+    u = min(u1, u2)
+
+    n = nx + ny
+    mean_u = nx * ny / 2.0
+    variance_u = (nx * ny / 12.0) * ((n + 1.0) - tie_sum / (n * (n - 1.0)))
+    if variance_u <= 0:
+        return 1.0
+
+    z = u - mean_u
+    z = (z - 0.5) / math.sqrt(variance_u) if z > 0 else (z + 0.5) / math.sqrt(variance_u)
+    p = 2.0 * (1.0 - normal_cdf(abs(z)))
+    return min(max(p, 0.0), 1.0)
+
+
+def cliffs_delta(x: list[float], y: list[float]) -> float:
+    """Cliff's delta effect size in [-1, 1]."""
+    if not x or not y:
+        return 0.0
+    greater = sum(1 for xv in x for yv in y if xv > yv)
+    lower = sum(1 for xv in x for yv in y if xv < yv)
+    return (greater - lower) / (len(x) * len(y))
+
+
+def bootstrap_delta_ci(
+    candidate: list[float],
+    baseline: list[float],
+    quant: float,
+    iterations: int,
+    seed: int,
+) -> tuple[float, float]:
+    """Seeded bootstrap CI95 for quantile(candidate) - quantile(baseline)."""
+    if not candidate or not baseline or iterations < 10:
+        return 0.0, 0.0
+    rng = random.Random(seed)
+    deltas = []
+    for _ in range(iterations):
+        cand = [candidate[rng.randrange(len(candidate))] for _ in candidate]
+        base = [baseline[rng.randrange(len(baseline))] for _ in baseline]
+        deltas.append(quantile(cand, quant) - quantile(base, quant))
+    deltas.sort()
+    low_idx = max(0, math.floor(0.025 * (len(deltas) - 1)))
+    high_idx = min(len(deltas) - 1, math.ceil(0.975 * (len(deltas) - 1)))
+    return deltas[low_idx], deltas[high_idx]

@@ -1,0 +1,19 @@
+"""L3 safety governor: overhead guard + rate limiter."""
+
+from tpuslo.safety.overhead_guard import (
+    CPUSample,
+    CPUSampler,
+    OverheadGuard,
+    OverheadResult,
+    ProcCPUSampler,
+)
+from tpuslo.safety.rate_limiter import RateLimiter
+
+__all__ = [
+    "CPUSample",
+    "CPUSampler",
+    "OverheadGuard",
+    "OverheadResult",
+    "ProcCPUSampler",
+    "RateLimiter",
+]

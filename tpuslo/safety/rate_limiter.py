@@ -1,0 +1,44 @@
+"""Event-rate limiter for agent emit paths.
+
+Reference: ``pkg/safety/rate_limiter.go:9-39`` (per-second window).
+Implemented as a token bucket — identical steady-state behaviour with a
+configurable burst, and deterministic under an injected clock.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+
+class RateLimiter:
+    """Token bucket: ``events_per_second`` refill, ``burst`` capacity."""
+
+    def __init__(
+        self,
+        events_per_second: int,
+        burst: int | None = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if events_per_second < 1:
+            raise ValueError("events_per_second must be >= 1")
+        self._rate = float(events_per_second)
+        self._capacity = float(burst if burst and burst > 0 else events_per_second)
+        self._clock = clock
+        self._tokens = self._capacity
+        self._last = clock()
+
+    def allow(self, n: int = 1) -> bool:
+        """Consume ``n`` tokens if available; False means drop the event."""
+        now = self._clock()
+        elapsed = max(0.0, now - self._last)
+        self._last = now
+        self._tokens = min(self._capacity, self._tokens + elapsed * self._rate)
+        if self._tokens >= n:
+            self._tokens -= n
+            return True
+        return False
+
+    @property
+    def tokens(self) -> float:
+        return self._tokens

@@ -1,0 +1,49 @@
+"""L4 contract layer: canonical event types + JSON-schema validation."""
+
+from tpuslo.schema.types import (
+    ConnTuple,
+    Evidence,
+    FaultHypothesis,
+    IncidentAttribution,
+    ProbeEventV1,
+    SLOEvent,
+    SLOImpact,
+    TPURef,
+    parse_rfc3339,
+    rfc3339,
+)
+from tpuslo.schema.validator import (
+    ALL_SCHEMAS,
+    SCHEMA_INCIDENT_ATTRIBUTION,
+    SCHEMA_PROBE_EVENT,
+    SCHEMA_SLO_EVENT,
+    SCHEMA_TOOLKIT_CONFIG,
+    SchemaValidationError,
+    is_valid,
+    load_schema,
+    schema_path,
+    validate,
+)
+
+__all__ = [
+    "ConnTuple",
+    "Evidence",
+    "FaultHypothesis",
+    "IncidentAttribution",
+    "ProbeEventV1",
+    "SLOEvent",
+    "SLOImpact",
+    "TPURef",
+    "parse_rfc3339",
+    "rfc3339",
+    "ALL_SCHEMAS",
+    "SCHEMA_INCIDENT_ATTRIBUTION",
+    "SCHEMA_PROBE_EVENT",
+    "SCHEMA_SLO_EVENT",
+    "SCHEMA_TOOLKIT_CONFIG",
+    "SchemaValidationError",
+    "is_valid",
+    "load_schema",
+    "schema_path",
+    "validate",
+]

@@ -1,0 +1,77 @@
+"""JSON-schema validation for every pipeline emit site.
+
+Reference: ``pkg/schema/validator.go:13-41`` (``ValidateAgainstSchema``).
+Schemas are compiled once per process and cached; validation raises
+:class:`SchemaValidationError` with the full error list so emit sites can
+fail loudly during tests and count drops in production.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+from pathlib import Path
+from typing import Any
+
+import jsonschema
+
+CONTRACTS_DIR = Path(__file__).resolve().parent / "contracts"
+
+SCHEMA_SLO_EVENT = "v1/slo-event"
+SCHEMA_INCIDENT_ATTRIBUTION = "v1/incident-attribution"
+SCHEMA_PROBE_EVENT = "v1alpha1/probe-event"
+SCHEMA_TOOLKIT_CONFIG = "v1alpha1/toolkit-config"
+
+ALL_SCHEMAS = (
+    SCHEMA_SLO_EVENT,
+    SCHEMA_INCIDENT_ATTRIBUTION,
+    SCHEMA_PROBE_EVENT,
+    SCHEMA_TOOLKIT_CONFIG,
+)
+
+
+class SchemaValidationError(ValueError):
+    """Raised when a payload fails contract validation."""
+
+    def __init__(self, schema_name: str, errors: list[str]):
+        self.schema_name = schema_name
+        self.errors = errors
+        super().__init__(
+            f"payload failed {schema_name} contract: " + "; ".join(errors[:5])
+        )
+
+
+def schema_path(name: str) -> Path:
+    """Resolve a short schema name like ``v1/slo-event`` to its file."""
+    return CONTRACTS_DIR / f"{name}.schema.json"
+
+
+@functools.lru_cache(maxsize=None)
+def load_schema(name: str) -> dict[str, Any]:
+    return json.loads(schema_path(name).read_text())
+
+
+@functools.lru_cache(maxsize=None)
+def _validator(name: str) -> jsonschema.Validator:
+    schema = load_schema(name)
+    cls = jsonschema.validators.validator_for(schema)
+    cls.check_schema(schema)
+    return cls(schema, format_checker=jsonschema.FormatChecker())
+
+
+def validate(payload: dict[str, Any], schema_name: str) -> None:
+    """Validate one payload dict against a named contract.
+
+    Raises :class:`SchemaValidationError` on the first batch of failures.
+    """
+    errors = sorted(_validator(schema_name).iter_errors(payload), key=str)
+    if errors:
+        raise SchemaValidationError(
+            schema_name,
+            [f"{'/'.join(str(p) for p in e.absolute_path) or '<root>'}: {e.message}" for e in errors],
+    )
+
+
+def is_valid(payload: dict[str, Any], schema_name: str) -> bool:
+    """Non-raising variant used by drop accounting in hot loops."""
+    return _validator(schema_name).is_valid(payload)

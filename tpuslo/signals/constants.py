@@ -1,0 +1,140 @@
+"""Signal registry and capability modes.
+
+Reference: ``pkg/signals/constants.go:4-59`` defines twelve CPU-side
+signal keys, two capability modes (``core_full`` / ``bcc_degraded``) and
+the overhead disable order.  The TPU-native build adds six accelerator
+signals sourced from libtpu uprobes and ``/dev/accel*`` kprobes and a
+``tpu_full`` capability mode; TPU probes are shed *first* when the
+overhead guard trips (SURVEY.md §7 step 6).
+"""
+
+from __future__ import annotations
+
+# --- CPU-side kernel signals (reference parity) -------------------------
+SIGNAL_DNS_LATENCY_MS = "dns_latency_ms"
+SIGNAL_TCP_RETRANSMITS = "tcp_retransmits_total"
+SIGNAL_RUNQUEUE_DELAY_MS = "runqueue_delay_ms"
+SIGNAL_CONNECT_LATENCY_MS = "connect_latency_ms"
+SIGNAL_CONNECT_ERRORS = "connect_errors_total"
+SIGNAL_TLS_HANDSHAKE_MS = "tls_handshake_ms"
+SIGNAL_TLS_HANDSHAKE_FAILS = "tls_handshake_fail_total"
+SIGNAL_CPU_STEAL_PCT = "cpu_steal_pct"
+SIGNAL_CFS_THROTTLED_MS = "cfs_throttled_ms"
+SIGNAL_MEM_RECLAIM_LATENCY_MS = "mem_reclaim_latency_ms"
+SIGNAL_DISK_IO_LATENCY_MS = "disk_io_latency_ms"
+SIGNAL_SYSCALL_LATENCY_MS = "syscall_latency_ms"
+
+# --- TPU-side signals (TPU-native extension) ----------------------------
+# XLA program compile wall time, from uprobes on libtpu compile entry/exit.
+SIGNAL_XLA_COMPILE_MS = "xla_compile_ms"
+# Time a device allocation waited for HBM to free up (allocator uprobes).
+SIGNAL_HBM_ALLOC_STALL_MS = "hbm_alloc_stall_ms"
+# Fraction of device HBM in use, sampled from the allocator statistics.
+SIGNAL_HBM_UTILIZATION_PCT = "hbm_utilization_pct"
+# Per-window count of ICI link-level retries (driver counters).
+SIGNAL_ICI_LINK_RETRIES = "ici_link_retries_total"
+# Wall time of cross-chip collectives (all-reduce/all-gather launches).
+SIGNAL_ICI_COLLECTIVE_MS = "ici_collective_latency_ms"
+# Host<->device transfer stall (infeed/outfeed/offload wait), dma uprobes
+# plus /dev/accel* ioctl kprobe latency.
+SIGNAL_HOST_OFFLOAD_STALL_MS = "host_offload_stall_ms"
+
+CPU_SIGNALS: tuple[str, ...] = (
+    SIGNAL_DNS_LATENCY_MS,
+    SIGNAL_TCP_RETRANSMITS,
+    SIGNAL_RUNQUEUE_DELAY_MS,
+    SIGNAL_CONNECT_LATENCY_MS,
+    SIGNAL_CONNECT_ERRORS,
+    SIGNAL_TLS_HANDSHAKE_MS,
+    SIGNAL_TLS_HANDSHAKE_FAILS,
+    SIGNAL_CPU_STEAL_PCT,
+    SIGNAL_CFS_THROTTLED_MS,
+    SIGNAL_MEM_RECLAIM_LATENCY_MS,
+    SIGNAL_DISK_IO_LATENCY_MS,
+    SIGNAL_SYSCALL_LATENCY_MS,
+)
+
+TPU_SIGNALS: tuple[str, ...] = (
+    SIGNAL_XLA_COMPILE_MS,
+    SIGNAL_HBM_ALLOC_STALL_MS,
+    SIGNAL_HBM_UTILIZATION_PCT,
+    SIGNAL_ICI_LINK_RETRIES,
+    SIGNAL_ICI_COLLECTIVE_MS,
+    SIGNAL_HOST_OFFLOAD_STALL_MS,
+)
+
+ALL_SIGNALS: tuple[str, ...] = CPU_SIGNALS + TPU_SIGNALS
+
+# --- Capability modes ---------------------------------------------------
+# tpu_full     — TPU-VM host with libtpu + /dev/accel access: all signals.
+# core_full    — CO-RE capable kernel, no TPU probe surface: CPU signals.
+# bcc_degraded — no BTF; BCC fallback covers DNS + TCP retransmits only.
+CAPABILITY_TPU_FULL = "tpu_full"
+CAPABILITY_CORE_FULL = "core_full"
+CAPABILITY_BCC_DEGRADED = "bcc_degraded"
+
+CAPABILITY_MODES = (
+    CAPABILITY_TPU_FULL,
+    CAPABILITY_CORE_FULL,
+    CAPABILITY_BCC_DEGRADED,
+)
+
+_BCC_SIGNAL_SET: tuple[str, ...] = (
+    SIGNAL_DNS_LATENCY_MS,
+    SIGNAL_TCP_RETRANSMITS,
+)
+
+# Disable order when the overhead guard trips.  TPU uprobes are shed
+# before kernel probes: high-rate libtpu call sites (collective launches,
+# allocator hits) dominate event volume on a busy chip, and losing TPU
+# depth degrades attribution less than losing the kernel spine entirely.
+# The CPU tail mirrors reference ``constants.go:46-59``.
+HIGH_COST_DISABLE_ORDER: tuple[str, ...] = (
+    SIGNAL_ICI_COLLECTIVE_MS,
+    SIGNAL_HBM_ALLOC_STALL_MS,
+    SIGNAL_HOST_OFFLOAD_STALL_MS,
+    SIGNAL_XLA_COMPILE_MS,
+    SIGNAL_HBM_UTILIZATION_PCT,
+    SIGNAL_ICI_LINK_RETRIES,
+    SIGNAL_TLS_HANDSHAKE_MS,
+    SIGNAL_SYSCALL_LATENCY_MS,
+    SIGNAL_RUNQUEUE_DELAY_MS,
+    SIGNAL_DISK_IO_LATENCY_MS,
+    SIGNAL_CONNECT_LATENCY_MS,
+    SIGNAL_MEM_RECLAIM_LATENCY_MS,
+    SIGNAL_CPU_STEAL_PCT,
+    SIGNAL_DNS_LATENCY_MS,
+    SIGNAL_TCP_RETRANSMITS,
+    SIGNAL_CFS_THROTTLED_MS,
+    SIGNAL_CONNECT_ERRORS,
+    SIGNAL_TLS_HANDSHAKE_FAILS,
+)
+
+
+def required_minimum_signals() -> list[str]:
+    """The six required baseline signals (reference ``constants.go:62-71``)."""
+    return [
+        SIGNAL_DNS_LATENCY_MS,
+        SIGNAL_TCP_RETRANSMITS,
+        SIGNAL_RUNQUEUE_DELAY_MS,
+        SIGNAL_CONNECT_LATENCY_MS,
+        SIGNAL_TLS_HANDSHAKE_MS,
+        SIGNAL_CPU_STEAL_PCT,
+    ]
+
+
+def supported_signals_for_mode(mode: str) -> list[str]:
+    """Signal set available under a capability mode.
+
+    Reference: ``pkg/signals/constants.go:74-82``.
+    """
+    if mode == CAPABILITY_BCC_DEGRADED:
+        return list(_BCC_SIGNAL_SET)
+    if mode == CAPABILITY_CORE_FULL:
+        return list(CPU_SIGNALS)
+    return list(ALL_SIGNALS)
+
+
+def disable_order() -> list[str]:
+    """Preferred shed order when overhead exceeds budget."""
+    return list(HIGH_COST_DISABLE_ORDER)

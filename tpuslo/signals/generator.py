@@ -1,0 +1,346 @@
+"""Per-fault synthetic signal profiles and probe-event fan-out.
+
+Reference: ``pkg/signals/generator.go`` — a capability-filtered generator
+expands one request sample into one normalized probe event per enabled
+signal, with values drawn from a fault-label → signal-profile table and
+statuses from per-signal warn/error thresholds
+(``generator.go:203-289``).  The TPU-native build extends both tables
+with the six accelerator signals and stamps TPU events with accelerator
+identity (:class:`tpuslo.schema.TPURef`) so the XLA correlation tier can
+join them to spans.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Iterable
+
+from tpuslo.collector.synthetic import RawSample
+from tpuslo.schema import ConnTuple, ProbeEventV1, TPURef
+from tpuslo.signals import constants as sig
+from tpuslo.signals.metadata import Metadata, MetadataEnricher
+
+# Per-signal (warning, error) status thresholds.
+# CPU rows: reference ``generator.go:203-242``; TPU rows: designed from
+# v5e serving envelopes (a >2s compile or >20ms HBM stall is pathological).
+SIGNAL_THRESHOLDS: dict[str, tuple[float, float]] = {
+    sig.SIGNAL_DNS_LATENCY_MS: (40, 120),
+    sig.SIGNAL_TCP_RETRANSMITS: (2, 5),
+    sig.SIGNAL_RUNQUEUE_DELAY_MS: (10, 25),
+    sig.SIGNAL_CONNECT_LATENCY_MS: (80, 180),
+    sig.SIGNAL_CONNECT_ERRORS: (1, 3),
+    sig.SIGNAL_TLS_HANDSHAKE_MS: (60, 160),
+    sig.SIGNAL_TLS_HANDSHAKE_FAILS: (1, 3),
+    sig.SIGNAL_CPU_STEAL_PCT: (2, 8),
+    sig.SIGNAL_CFS_THROTTLED_MS: (40, 120),
+    sig.SIGNAL_MEM_RECLAIM_LATENCY_MS: (5, 20),
+    sig.SIGNAL_DISK_IO_LATENCY_MS: (10, 50),
+    sig.SIGNAL_SYSCALL_LATENCY_MS: (50, 200),
+    sig.SIGNAL_XLA_COMPILE_MS: (500, 2000),
+    sig.SIGNAL_HBM_ALLOC_STALL_MS: (5, 20),
+    sig.SIGNAL_HBM_UTILIZATION_PCT: (85, 95),
+    sig.SIGNAL_ICI_LINK_RETRIES: (5, 20),
+    sig.SIGNAL_ICI_COLLECTIVE_MS: (10, 30),
+    sig.SIGNAL_HOST_OFFLOAD_STALL_MS: (20, 80),
+}
+
+SIGNAL_UNITS: dict[str, str] = {
+    sig.SIGNAL_DNS_LATENCY_MS: "ms",
+    sig.SIGNAL_TCP_RETRANSMITS: "count",
+    sig.SIGNAL_RUNQUEUE_DELAY_MS: "ms",
+    sig.SIGNAL_CONNECT_LATENCY_MS: "ms",
+    sig.SIGNAL_CONNECT_ERRORS: "count",
+    sig.SIGNAL_TLS_HANDSHAKE_MS: "ms",
+    sig.SIGNAL_TLS_HANDSHAKE_FAILS: "count",
+    sig.SIGNAL_CPU_STEAL_PCT: "pct",
+    sig.SIGNAL_CFS_THROTTLED_MS: "ms",
+    sig.SIGNAL_MEM_RECLAIM_LATENCY_MS: "ms",
+    sig.SIGNAL_DISK_IO_LATENCY_MS: "ms",
+    sig.SIGNAL_SYSCALL_LATENCY_MS: "ms",
+    sig.SIGNAL_XLA_COMPILE_MS: "ms",
+    sig.SIGNAL_HBM_ALLOC_STALL_MS: "ms",
+    sig.SIGNAL_HBM_UTILIZATION_PCT: "pct",
+    sig.SIGNAL_ICI_LINK_RETRIES: "count",
+    sig.SIGNAL_ICI_COLLECTIVE_MS: "ms",
+    sig.SIGNAL_HOST_OFFLOAD_STALL_MS: "ms",
+}
+
+# Signals that carry a network flow tuple.
+_CONN_TUPLE_SIGNALS = frozenset(
+    {
+        sig.SIGNAL_DNS_LATENCY_MS,
+        sig.SIGNAL_TCP_RETRANSMITS,
+        sig.SIGNAL_CONNECT_LATENCY_MS,
+        sig.SIGNAL_CONNECT_ERRORS,
+        sig.SIGNAL_TLS_HANDSHAKE_MS,
+        sig.SIGNAL_TLS_HANDSHAKE_FAILS,
+    }
+)
+
+# Healthy baseline values; CPU rows mirror reference ``generator.go:244-261``.
+_BASE_PROFILE: dict[str, float] = {
+    sig.SIGNAL_DNS_LATENCY_MS: 12,
+    sig.SIGNAL_TCP_RETRANSMITS: 0.2,
+    sig.SIGNAL_RUNQUEUE_DELAY_MS: 4,
+    sig.SIGNAL_CONNECT_LATENCY_MS: 18,
+    sig.SIGNAL_CONNECT_ERRORS: 0,
+    sig.SIGNAL_TLS_HANDSHAKE_MS: 22,
+    sig.SIGNAL_TLS_HANDSHAKE_FAILS: 0,
+    sig.SIGNAL_CPU_STEAL_PCT: 0.6,
+    sig.SIGNAL_CFS_THROTTLED_MS: 5,
+    sig.SIGNAL_MEM_RECLAIM_LATENCY_MS: 0.5,
+    sig.SIGNAL_DISK_IO_LATENCY_MS: 2,
+    sig.SIGNAL_SYSCALL_LATENCY_MS: 5,
+    sig.SIGNAL_XLA_COMPILE_MS: 0,
+    sig.SIGNAL_HBM_ALLOC_STALL_MS: 0.2,
+    sig.SIGNAL_HBM_UTILIZATION_PCT: 62,
+    sig.SIGNAL_ICI_LINK_RETRIES: 0,
+    sig.SIGNAL_ICI_COLLECTIVE_MS: 3.5,
+    sig.SIGNAL_HOST_OFFLOAD_STALL_MS: 1.5,
+}
+
+# Fault label -> (signal overrides, connect errno).
+# CPU rows mirror reference ``generator.go:263-289``.  TPU rows encode
+# how each accelerator fault manifests across the probe surface:
+#   ici_drop            — link retries + collective latency explode; a
+#                         degraded link also backs up the launch queue.
+#   hbm_pressure        — allocator stalls + near-full HBM; the runtime
+#                         starts spilling to host, so offload stall
+#                         creeps into warning.
+#   xla_recompile_storm — compile wall-time dominates; compiles burn
+#                         host CPU so the runqueue warms up.
+#   host_offload_stall  — host<->device transfers stall; feeding from
+#                         disk drags disk/syscall latency with it.
+_FAULT_OVERRIDES: dict[str, tuple[dict[str, float], int]] = {
+    "baseline": ({}, 0),
+    "dns_latency": (
+        {
+            sig.SIGNAL_DNS_LATENCY_MS: 220,
+            sig.SIGNAL_CONNECT_LATENCY_MS: 130,
+        },
+        0,
+    ),
+    "cpu_throttle": (
+        {
+            sig.SIGNAL_RUNQUEUE_DELAY_MS: 28,
+            sig.SIGNAL_CPU_STEAL_PCT: 9,
+            sig.SIGNAL_CFS_THROTTLED_MS: 170,
+        },
+        0,
+    ),
+    "memory_pressure": (
+        {
+            sig.SIGNAL_RUNQUEUE_DELAY_MS: 14,
+            sig.SIGNAL_CFS_THROTTLED_MS: 90,
+            sig.SIGNAL_MEM_RECLAIM_LATENCY_MS: 25,
+            sig.SIGNAL_DISK_IO_LATENCY_MS: 60,
+        },
+        0,
+    ),
+    "provider_throttle": (
+        {
+            # Backoff at the provider edge: accepts and handshakes slow
+            # past their warning lines while reads block on rate limits.
+            sig.SIGNAL_CONNECT_LATENCY_MS: 95,
+            sig.SIGNAL_TLS_HANDSHAKE_MS: 70,
+            sig.SIGNAL_CONNECT_ERRORS: 1,
+            sig.SIGNAL_SYSCALL_LATENCY_MS: 250,
+        },
+        110,
+    ),
+    "network_partition": (
+        {
+            sig.SIGNAL_CONNECT_LATENCY_MS: 350,
+            sig.SIGNAL_CONNECT_ERRORS: 3,
+            sig.SIGNAL_TCP_RETRANSMITS: 12,
+            sig.SIGNAL_DNS_LATENCY_MS: 180,
+            sig.SIGNAL_TLS_HANDSHAKE_FAILS: 2,
+        },
+        113,
+    ),
+    "ici_drop": (
+        {
+            sig.SIGNAL_ICI_LINK_RETRIES: 45,
+            sig.SIGNAL_ICI_COLLECTIVE_MS: 55,
+            sig.SIGNAL_HOST_OFFLOAD_STALL_MS: 8,
+        },
+        0,
+    ),
+    "hbm_pressure": (
+        {
+            sig.SIGNAL_HBM_ALLOC_STALL_MS: 60,
+            sig.SIGNAL_HBM_UTILIZATION_PCT: 97,
+            sig.SIGNAL_HOST_OFFLOAD_STALL_MS: 25,
+        },
+        0,
+    ),
+    "xla_recompile_storm": (
+        {
+            sig.SIGNAL_XLA_COMPILE_MS: 3200,
+            sig.SIGNAL_RUNQUEUE_DELAY_MS: 12,
+        },
+        0,
+    ),
+    "host_offload_stall": (
+        {
+            sig.SIGNAL_HOST_OFFLOAD_STALL_MS: 120,
+            sig.SIGNAL_DISK_IO_LATENCY_MS: 40,
+            sig.SIGNAL_SYSCALL_LATENCY_MS: 80,
+        },
+        0,
+    ),
+    "mixed_multi": (
+        {
+            # Concurrent network partition + provider throttle.
+            sig.SIGNAL_CONNECT_LATENCY_MS: 350,
+            sig.SIGNAL_CONNECT_ERRORS: 3,
+            sig.SIGNAL_TCP_RETRANSMITS: 12,
+            sig.SIGNAL_DNS_LATENCY_MS: 180,
+            sig.SIGNAL_TLS_HANDSHAKE_FAILS: 2,
+            sig.SIGNAL_TLS_HANDSHAKE_MS: 70,
+            sig.SIGNAL_SYSCALL_LATENCY_MS: 250,
+        },
+        110,
+    ),
+}
+
+
+def profile_for_fault(fault_label: str) -> dict[str, float]:
+    """Full signal→value map for a fault label (base + overrides)."""
+    overrides, _ = _FAULT_OVERRIDES.get(fault_label or "baseline", ({}, 0))
+    profile = dict(_BASE_PROFILE)
+    profile.update(overrides)
+    return profile
+
+
+def errno_for_fault(fault_label: str) -> int:
+    return _FAULT_OVERRIDES.get(fault_label or "baseline", ({}, 0))[1]
+
+
+def signal_status(signal: str, value: float) -> str:
+    """Map a signal value to ok/warning/error via per-signal thresholds."""
+    thresholds = SIGNAL_THRESHOLDS.get(signal)
+    if thresholds is None:
+        return "ok"
+    warning, error = thresholds
+    if value >= error:
+        return "error"
+    if value >= warning:
+        return "warning"
+    return "ok"
+
+
+_REQ_NUM = re.compile(r"(\d+)$")
+
+
+def _launch_id_for(sample: RawSample) -> int:
+    """Deterministic synthetic XLA launch id derived from request identity."""
+    match = _REQ_NUM.search(sample.request_id or "")
+    return int(match.group(1)) if match else 0
+
+
+class Generator:
+    """Capability-filtered probe-event generator.
+
+    Reference: ``pkg/signals/generator.go:27-155``.  Thread-safe: the
+    agent's shedding loop disables signals concurrently with generation.
+    """
+
+    def __init__(
+        self,
+        mode: str,
+        signal_set: Iterable[str] | None = None,
+        enricher: MetadataEnricher | None = None,
+    ):
+        self._mode = mode
+        self._enricher = enricher
+        self._lock = threading.Lock()
+        self._enabled: set[str] = set()
+        self.set_signals(signal_set or [])
+
+    @property
+    def mode(self) -> str:
+        return self._mode
+
+    def set_signals(self, signal_set: Iterable[str]) -> None:
+        """Replace enabled probes at runtime, filtered by capability."""
+        allowed = set(sig.supported_signals_for_mode(self._mode))
+        requested = set(signal_set)
+        with self._lock:
+            self._enabled = (requested & allowed) if requested else allowed
+
+    def enabled_signals(self) -> list[str]:
+        with self._lock:
+            return sorted(self._enabled)
+
+    def disable(self, signal: str) -> bool:
+        with self._lock:
+            if signal not in self._enabled:
+                return False
+            self._enabled.discard(signal)
+            return True
+
+    def disable_highest_cost(self) -> str | None:
+        """Shed the next signal in the high-cost disable order."""
+        with self._lock:
+            for candidate in sig.HIGH_COST_DISABLE_ORDER:
+                if candidate in self._enabled:
+                    self._enabled.discard(candidate)
+                    return candidate
+        return None
+
+    def generate(self, sample: RawSample, meta: Metadata) -> list[ProbeEventV1]:
+        """Expand one sample into normalized probe events, one per signal."""
+        with self._lock:
+            enabled = set(self._enabled)
+        if not enabled:
+            return []
+
+        if self._enricher is not None:
+            meta = self._enricher.enrich(meta)
+
+        profile = profile_for_fault(sample.fault_label)
+        errno = errno_for_fault(sample.fault_label)
+        tuple_ = ConnTuple("10.244.0.10", "10.244.0.53", 42424, 443, "tcp")
+        ts_ns = int(sample.timestamp.timestamp() * 1e9)
+        launch_id = _launch_id_for(sample)
+
+        out: list[ProbeEventV1] = []
+        for signal in sig.ALL_SIGNALS:
+            if signal not in enabled:
+                continue
+            value = profile[signal]
+            event = ProbeEventV1(
+                ts_unix_nano=ts_ns,
+                signal=signal,
+                node=meta.node,
+                namespace=meta.namespace,
+                pod=meta.pod,
+                container=meta.container,
+                pid=meta.pid,
+                tid=meta.tid,
+                value=value,
+                unit=SIGNAL_UNITS[signal],
+                status=signal_status(signal, value),
+                trace_id=meta.trace_id,
+                span_id=meta.span_id,
+            )
+            if signal in _CONN_TUPLE_SIGNALS:
+                event.conn_tuple = tuple_
+                if errno and signal in (
+                    sig.SIGNAL_CONNECT_LATENCY_MS,
+                    sig.SIGNAL_CONNECT_ERRORS,
+                ):
+                    event.errno = errno
+            if signal in sig.TPU_SIGNALS:
+                event.tpu = TPURef(
+                    chip=meta.tpu_chip or "accel0",
+                    slice_id=meta.slice_id,
+                    host_index=meta.host_index,
+                    ici_link=0 if signal == sig.SIGNAL_ICI_LINK_RETRIES else -1,
+                    program_id=meta.xla_program_id,
+                    launch_id=launch_id,
+                )
+            out.append(event)
+        return out

@@ -1,0 +1,23 @@
+from tpuslo.webhook.exporter import (
+    FORMAT_GENERIC,
+    FORMAT_OPSGENIE,
+    FORMAT_PAGERDUTY,
+    Exporter,
+    WebhookError,
+    compute_hmac,
+    verify_hmac,
+)
+from tpuslo.webhook.opsgenie import build_opsgenie_payload
+from tpuslo.webhook.pagerduty import build_pagerduty_payload
+
+__all__ = [
+    "FORMAT_GENERIC",
+    "FORMAT_OPSGENIE",
+    "FORMAT_PAGERDUTY",
+    "Exporter",
+    "WebhookError",
+    "build_opsgenie_payload",
+    "build_pagerduty_payload",
+    "compute_hmac",
+    "verify_hmac",
+]

@@ -1,0 +1,108 @@
+"""HMAC-signed incident webhook delivery with retry/backoff.
+
+Reference: ``pkg/webhook/exporter.go:63-140`` — exponential backoff over
+3 attempts, 4xx non-retryable, ``X-Webhook-Signature: sha256=<hex>``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as hmac_mod
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Callable
+
+from tpuslo.schema import IncidentAttribution
+from tpuslo.webhook.opsgenie import build_opsgenie_payload
+from tpuslo.webhook.pagerduty import build_pagerduty_payload
+
+FORMAT_GENERIC = "generic"
+FORMAT_PAGERDUTY = "pagerduty"
+FORMAT_OPSGENIE = "opsgenie"
+
+USER_AGENT = "tpuslo/webhook"
+
+
+class WebhookError(RuntimeError):
+    def __init__(self, message: str, retryable: bool = True):
+        super().__init__(message)
+        self.retryable = retryable
+
+
+def compute_hmac(payload: bytes, secret: str) -> str:
+    mac = hmac_mod.new(secret.encode(), payload, hashlib.sha256)
+    return "sha256=" + mac.hexdigest()
+
+
+def verify_hmac(payload: bytes, secret: str, signature: str) -> bool:
+    return hmac_mod.compare_digest(compute_hmac(payload, secret), signature)
+
+
+class Exporter:
+    """Delivers incident attributions to an HTTP webhook endpoint."""
+
+    def __init__(
+        self,
+        url: str,
+        secret: str = "",
+        format: str = FORMAT_GENERIC,
+        timeout_ms: int = 5000,
+        max_retry: int = 3,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.url = url
+        self.secret = secret
+        self.format = format or FORMAT_GENERIC
+        self.timeout_s = (timeout_ms if timeout_ms > 0 else 5000) / 1000.0
+        self.max_retry = max_retry
+        self._sleep = sleep
+
+    def build_payload(self, attr: IncidentAttribution) -> bytes:
+        if self.format == FORMAT_PAGERDUTY:
+            return build_pagerduty_payload(attr)
+        if self.format == FORMAT_OPSGENIE:
+            return build_opsgenie_payload(attr)
+        return json.dumps(attr.to_dict()).encode()
+
+    def send(self, attr: IncidentAttribution) -> None:
+        """Deliver one attribution; raises WebhookError on final failure."""
+        payload = self.build_payload(attr)
+        last_error: WebhookError | None = None
+        for attempt in range(self.max_retry):
+            if attempt > 0:
+                self._sleep(float(1 << (attempt - 1)))
+            try:
+                self._post(payload)
+                return
+            except WebhookError as exc:
+                last_error = exc
+                if not exc.retryable:
+                    raise
+        raise WebhookError(
+            f"webhook delivery failed after {self.max_retry} attempts: {last_error}"
+        )
+
+    def _post(self, payload: bytes) -> None:
+        headers = {
+            "Content-Type": "application/json",
+            "User-Agent": USER_AGENT,
+        }
+        if self.secret:
+            headers["X-Webhook-Signature"] = compute_hmac(payload, self.secret)
+        req = urllib.request.Request(
+            self.url, data=payload, headers=headers, method="POST"
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                resp.read()
+                status = resp.status
+        except urllib.error.HTTPError as exc:
+            status = exc.code
+        except urllib.error.URLError as exc:
+            raise WebhookError(f"http post failed: {exc.reason}") from exc
+        if status >= 500:
+            raise WebhookError(f"server error: HTTP {status}")
+        if status >= 400:
+            raise WebhookError(f"client error: HTTP {status}", retryable=False)

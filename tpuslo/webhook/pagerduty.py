@@ -1,0 +1,40 @@
+"""PagerDuty Events API v2 payload builder.
+
+Reference: ``pkg/webhook/pagerduty.go:29-61`` — severity escalates to
+``critical`` at confidence ≥ 0.8.
+"""
+
+from __future__ import annotations
+
+import json
+
+from tpuslo.schema import IncidentAttribution
+
+
+def build_pagerduty_payload(attr: IncidentAttribution) -> bytes:
+    severity = "critical" if attr.confidence >= 0.8 else "warning"
+    evidence = "; ".join(f"{e.signal}={e.value}" for e in attr.evidence)
+    burn_rate = attr.slo_impact.burn_rate if attr.slo_impact else 0.0
+    payload = {
+        "routing_key": "",
+        "event_action": "trigger",
+        "payload": {
+            "summary": (
+                f"[{attr.service}] {attr.predicted_fault_domain} fault detected "
+                f"(confidence={attr.confidence:.2f})"
+            ),
+            "source": f"{attr.cluster}/{attr.service}",
+            "severity": severity,
+            "timestamp": attr.timestamp.strftime("%Y-%m-%dT%H:%M:%S.000+0000"),
+            "component": attr.service,
+            "group": attr.cluster,
+            "custom_details": {
+                "incident_id": attr.incident_id,
+                "fault_domain": attr.predicted_fault_domain,
+                "confidence": f"{attr.confidence:.4f}",
+                "evidence": evidence,
+                "burn_rate": f"{burn_rate:.2f}",
+            },
+        },
+    }
+    return json.dumps(payload).encode()
