@@ -1,0 +1,9 @@
+"""Deterministic streaming RAG chat service — the observed workload.
+
+Reference: ``demo/rag-service`` (Go, llama.cpp backend).  This build
+serves a JAX Llama model (:mod:`tpuslo.models.serve`) with a
+deterministic stub fallback, streams NDJSON tokens, records OTel-style
+spans (``chat.request`` → ``chat.retrieval`` → ``chat.generation``),
+exports Prometheus histograms, and demonstrates span self-correlation
+against kernel/TPU signals via the toolkit correlator.
+"""

@@ -1,0 +1,3 @@
+from demo.rag_service.server import main
+
+raise SystemExit(main())

@@ -1,0 +1,127 @@
+"""HTTP layer for the RAG demo: /chat (NDJSON stream), /metrics,
+/healthz, /spans.
+
+Reference: ``demo/rag-service/main.go:272-295,346-481``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from prometheus_client import generate_latest
+from prometheus_client.exposition import CONTENT_TYPE_LATEST
+
+from demo.rag_service.service import PROFILES, JaxBackend, RagService, StubBackend
+
+
+def make_handler(service: RagService):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args):
+            pass
+
+        def _json(self, code: int, payload) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path.startswith("/metrics"):
+                body = generate_latest(service.metrics.registry)
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE_LATEST)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path in ("/healthz", "/readyz"):
+                self._json(200, {"status": "ok", "backend": service.backend.name})
+            elif self.path.startswith("/spans"):
+                self._json(200, {"spans": service.recorder.recent()})
+            else:
+                self._json(404, {"error": "not found"})
+
+        def do_POST(self):
+            if self.path != "/chat":
+                self._json(404, {"error": "not found"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length) or b"{}")
+                query = payload.get("query", "")
+                profile = payload.get("profile", "rag_medium")
+                stream = bool(payload.get("stream", True))
+                if profile not in PROFILES:
+                    raise ValueError(f"unknown profile {profile!r}")
+            except (ValueError, json.JSONDecodeError) as exc:
+                service.metrics.errors.inc()
+                self._json(400, {"error": str(exc)})
+                return
+
+            events = service.chat(query, profile)
+            if not stream:
+                tokens, summary = [], None
+                for event in events:
+                    if event["type"] == "token":
+                        tokens.append(event["token"])
+                    else:
+                        summary = event
+                self._json(200, {"tokens": tokens, **(summary or {})})
+                return
+
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            try:
+                for event in events:
+                    chunk = (json.dumps(event) + "\n").encode()
+                    self.wfile.write(f"{len(chunk):x}\r\n".encode())
+                    self.wfile.write(chunk + b"\r\n")
+                    self.wfile.flush()
+                self.wfile.write(b"0\r\n\r\n")
+            except (BrokenPipeError, ConnectionResetError):
+                service.metrics.errors.inc()
+
+    return Handler
+
+
+def serve(service: RagService, port: int, host: str = "0.0.0.0") -> ThreadingHTTPServer:
+    server = ThreadingHTTPServer((host, port), make_handler(service))
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="rag-service", description=__doc__)
+    parser.add_argument("--port", type=int, default=18080)
+    parser.add_argument("--backend", default="stub", choices=["stub", "jax"])
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--node", default="tpu-vm-0")
+    args = parser.parse_args(argv)
+
+    backend = JaxBackend() if args.backend == "jax" else StubBackend()
+    service = RagService(backend=backend, seed=args.seed, node=args.node)
+    server = serve(service, args.port)
+    print(
+        f"rag-service: backend={backend.name} listening on :{args.port} "
+        f"(/chat /metrics /spans /healthz)",
+        file=sys.stderr,
+    )
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

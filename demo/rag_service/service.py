@@ -1,0 +1,332 @@
+"""RAG demo service internals.
+
+Reference parity map (``demo/rag-service/main.go``):
+  * pluggable ``llmBackend`` (stub | llama_cpp)  → stub | jax
+  * ``/chat`` NDJSON streaming with warmup+cadence → same wire format
+  * ``simulateRetrieval`` seeded DNS/net/vectordb sleeps → same
+  * inline ``EnrichDNSAttributes`` self-correlation demo → same, via
+    :class:`tpuslo.otel.processor.correlator.Correlator`
+  * Prometheus histograms ``llm_slo_ttft_ms`` etc. → same series names
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+import uuid
+import zlib
+from dataclasses import dataclass, field
+from datetime import datetime, timezone
+from typing import Any, Iterator
+
+from prometheus_client import CollectorRegistry, Counter, Histogram
+
+from tpuslo import semconv
+from tpuslo.correlation.matcher import SignalRef, SpanRef
+from tpuslo.otel.processor.correlator import Correlator
+from tpuslo.slo.calculator import RetrievalBreakdown
+
+# --- request profiles ---------------------------------------------------
+# (dns_ms, network_ms, vectordb_ms, max_new_tokens, warmup_ms, cadence_ms)
+PROFILES: dict[str, tuple[float, float, float, int, float, float]] = {
+    "chat_short": (2, 6, 10, 24, 40, 12),
+    "rag_medium": (4, 14, 30, 48, 80, 16),
+    "context_long": (6, 22, 60, 64, 220, 22),
+    "context_128k": (8, 30, 120, 64, 900, 30),
+}
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_span_id: str = ""
+    start_ns: int = 0
+    end_ns: int = 0
+    attributes: dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_span_id": self.parent_span_id,
+            "start_ns": self.start_ns,
+            "end_ns": self.end_ns,
+            "duration_ms": (self.end_ns - self.start_ns) / 1e6,
+            "attributes": self.attributes,
+        }
+
+
+class SpanRecorder:
+    """In-process tracer: ring buffer of finished spans + JSONL sink."""
+
+    def __init__(self, capacity: int = 512, sink=None):
+        self._spans: list[Span] = []
+        self._capacity = capacity
+        self._lock = threading.Lock()
+        self._sink = sink
+
+    def record(self, span: Span) -> None:
+        with self._lock:
+            self._spans.append(span)
+            if len(self._spans) > self._capacity:
+                self._spans = self._spans[-self._capacity:]
+        if self._sink is not None:
+            self._sink.write(json.dumps(span.to_dict()) + "\n")
+            self._sink.flush()
+
+    def recent(self, n: int = 50) -> list[dict[str, Any]]:
+        with self._lock:
+            return [s.to_dict() for s in self._spans[-n:]]
+
+
+class StubBackend:
+    """Deterministic token stream with warmup + cadence pacing.
+
+    Reference: the stub ``llmBackend`` that CI pins for determinism
+    (``demo/llama-cpp/README.md:22-24``).
+    """
+
+    name = "stub"
+    WORDS = (
+        "the", "model", "served", "from", "tpu", "pods", "streams",
+        "tokens", "with", "stable", "cadence", "and", "low", "latency",
+    )
+
+    def generate(
+        self, prompt: str, max_new_tokens: int, warmup_ms: float, cadence_ms: float
+    ) -> Iterator[str]:
+        # crc32, not hash(): hash() is salted per process and would break
+        # the cross-run determinism CI relies on.
+        rng = random.Random(zlib.crc32(prompt.encode()))
+        time.sleep(warmup_ms / 1000.0)
+        for _ in range(max_new_tokens):
+            yield self.WORDS[rng.randrange(len(self.WORDS))]
+            time.sleep(cadence_ms / 1000.0)
+
+
+class JaxBackend:
+    """Real JAX Llama decode via :class:`tpuslo.models.serve.ServeEngine`."""
+
+    name = "jax"
+
+    def __init__(self, engine=None):
+        if engine is None:
+            from tpuslo.models.serve import ServeEngine
+
+            engine = ServeEngine()
+            engine.warmup()
+        self.engine = engine
+
+    def generate(
+        self, prompt: str, max_new_tokens: int, warmup_ms: float, cadence_ms: float
+    ) -> Iterator[str]:
+        del warmup_ms, cadence_ms  # real compute sets the pace
+        for event in self.engine.generate(prompt, max_new_tokens=max_new_tokens):
+            yield f"tok{event.token_id}"
+
+
+class DemoMetrics:
+    def __init__(self, registry: CollectorRegistry | None = None):
+        self.registry = registry or CollectorRegistry()
+        buckets_ms = (25, 50, 100, 200, 400, 800, 1600, 3200)
+        self.ttft_ms = Histogram(
+            "llm_slo_ttft_ms", "Time to first token (ms)",
+            buckets=buckets_ms, registry=self.registry,
+        )
+        self.request_latency_ms = Histogram(
+            "llm_slo_request_latency_ms", "Full request latency (ms)",
+            buckets=buckets_ms, registry=self.registry,
+        )
+        self.tokens_per_sec = Histogram(
+            "llm_slo_tokens_per_sec", "Decode throughput",
+            buckets=(1, 5, 10, 20, 40, 80, 160), registry=self.registry,
+        )
+        self.retrieval_ms = Histogram(
+            "llm_slo_retrieval_latency_ms", "Simulated retrieval latency (ms)",
+            buckets=(5, 10, 25, 50, 100, 250), registry=self.registry,
+        )
+        self.requests = Counter(
+            "llm_slo_requests_total", "Requests", ["profile", "backend"],
+            registry=self.registry,
+        )
+        self.errors = Counter(
+            "llm_slo_requests_errors_total", "Request errors",
+            registry=self.registry,
+        )
+
+
+@dataclass
+class ChatResult:
+    request_id: str
+    trace_id: str
+    tokens: list[str]
+    ttft_ms: float
+    latency_ms: float
+    tokens_per_sec: float
+    retrieval: RetrievalBreakdown
+    correlation_attrs: dict[str, float]
+
+
+class RagService:
+    """Backend-agnostic chat pipeline; HTTP layer lives in server.py."""
+
+    def __init__(
+        self,
+        backend=None,
+        metrics: DemoMetrics | None = None,
+        recorder: SpanRecorder | None = None,
+        seed: int = 42,
+        service_name: str = "rag-service",
+        node: str = "tpu-vm-0",
+        sleep=time.sleep,
+    ):
+        self.backend = backend or StubBackend()
+        self.metrics = metrics or DemoMetrics()
+        self.recorder = recorder or SpanRecorder()
+        self.correlator = Correlator()
+        self.seed = seed
+        self.service_name = service_name
+        self.node = node
+        self._sleep = sleep
+
+    def _simulate_retrieval(self, profile: str, request_seed: int) -> RetrievalBreakdown:
+        """Seeded DNS/network/vectordb sleeps.
+
+        Reference: ``demo/rag-service/main.go:641-671``.
+        """
+        dns_ms, net_ms, vdb_ms, *_ = PROFILES[profile]
+        rng = random.Random(self.seed ^ request_seed)
+        jitter = lambda v: v * rng.uniform(0.8, 1.2)  # noqa: E731
+        breakdown = RetrievalBreakdown(
+            dns_ms=jitter(dns_ms),
+            network_ms=jitter(net_ms),
+            vectordb_ms=jitter(vdb_ms),
+        )
+        self._sleep(
+            (breakdown.dns_ms + breakdown.network_ms + breakdown.vectordb_ms)
+            / 1000.0
+        )
+        return breakdown
+
+    def chat(self, query: str, profile: str = "rag_medium") -> Iterator[dict]:
+        """Run one chat request; yields NDJSON-able event dicts.
+
+        Event stream: {"type":"token",...}* then {"type":"summary",...}.
+        """
+        if profile not in PROFILES:
+            raise ValueError(f"unknown profile {profile!r}")
+        _, _, _, max_new, warmup_ms, cadence_ms = PROFILES[profile]
+        request_id = f"req-{uuid.uuid4().hex[:12]}"
+        trace_id = uuid.uuid4().hex
+        request_seed = int(trace_id[:8], 16)
+        self.metrics.requests.labels(profile=profile, backend=self.backend.name).inc()
+
+        t0 = time.perf_counter()
+        t0_ns = time.time_ns()
+        root = Span("chat.request", trace_id, uuid.uuid4().hex[:16], start_ns=t0_ns)
+
+        # --- retrieval span --------------------------------------------
+        retr_span = Span(
+            "chat.retrieval", trace_id, uuid.uuid4().hex[:16],
+            parent_span_id=root.span_id, start_ns=time.time_ns(),
+        )
+        retrieval = self._simulate_retrieval(profile, request_seed)
+        retr_span.end_ns = time.time_ns()
+        retr_span.attributes = {
+            semconv.ATTR_RETRIEVAL_DNS_MS: retrieval.dns_ms,
+            semconv.ATTR_RETRIEVAL_NETWORK_MS: retrieval.network_ms,
+            semconv.ATTR_RETRIEVAL_VECTORDB_MS: retrieval.vectordb_ms,
+        }
+
+        # Self-correlation demo: join a synthetic DNS kernel signal onto
+        # the retrieval span (reference ``main.go:408-441``).
+        now = datetime.now(timezone.utc)
+        span_ref = SpanRef(
+            timestamp=now, trace_id=trace_id,
+            service=self.service_name, node=self.node,
+        )
+        signal_ref = SignalRef(
+            signal="dns_latency_ms", timestamp=now, trace_id=trace_id,
+            service=self.service_name, node=self.node,
+            value=retrieval.dns_ms,
+        )
+        attrs, _decision = self.correlator.enrich_dns_attributes(
+            dict(retr_span.attributes), span_ref, signal_ref
+        )
+        retr_span.attributes = attrs
+        self.recorder.record(retr_span)
+        self.metrics.retrieval_ms.observe(
+            retrieval.dns_ms + retrieval.network_ms + retrieval.vectordb_ms
+        )
+
+        # --- generation span -------------------------------------------
+        gen_span = Span(
+            "chat.generation", trace_id, uuid.uuid4().hex[:16],
+            parent_span_id=root.span_id, start_ns=time.time_ns(),
+        )
+        tokens: list[str] = []
+        first_token_at = last_token_at = None
+        for token in self.backend.generate(query, max_new, warmup_ms, cadence_ms):
+            ts = time.perf_counter()
+            if first_token_at is None:
+                first_token_at = ts
+            last_token_at = ts
+            tokens.append(token)
+            yield {
+                "type": "token",
+                "request_id": request_id,
+                "index": len(tokens) - 1,
+                "token": token,
+            }
+        gen_span.end_ns = time.time_ns()
+
+        ttft_ms = ((first_token_at or time.perf_counter()) - t0) * 1000.0
+        latency_ms = (time.perf_counter() - t0) * 1000.0
+        window_s = (
+            (last_token_at - first_token_at)
+            if first_token_at and last_token_at
+            else 0.0
+        )
+        tps = len(tokens) / window_s if window_s > 0 else float(len(tokens))
+
+        gen_span.attributes = {
+            semconv.ATTR_SLO_TTFT_MS: ttft_ms,
+            semconv.ATTR_SLO_TOKENS_PER_SEC: tps,
+            "token_count": len(tokens),
+            "backend": self.backend.name,
+        }
+        self.recorder.record(gen_span)
+        root.end_ns = time.time_ns()
+        root.attributes = {"profile": profile, "request_id": request_id}
+        self.recorder.record(root)
+
+        self.metrics.ttft_ms.observe(ttft_ms)
+        self.metrics.request_latency_ms.observe(latency_ms)
+        self.metrics.tokens_per_sec.observe(tps)
+
+        yield {
+            "type": "summary",
+            "request_id": request_id,
+            "trace_id": trace_id,
+            "profile": profile,
+            "backend": self.backend.name,
+            "token_count": len(tokens),
+            "ttft_ms": round(ttft_ms, 3),
+            "latency_ms": round(latency_ms, 3),
+            "tokens_per_sec": round(tps, 3),
+            "retrieval": {
+                "dns_ms": round(retrieval.dns_ms, 3),
+                "network_ms": round(retrieval.network_ms, 3),
+                "vectordb_ms": round(retrieval.vectordb_ms, 3),
+            },
+            "correlation": {
+                k: round(v, 4)
+                for k, v in retr_span.attributes.items()
+                if k.startswith("llm.ebpf.")
+            },
+        }

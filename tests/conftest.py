@@ -3,17 +3,24 @@
 Multi-chip hardware is unavailable in CI; sharding tests run against
 ``--xla_force_host_platform_device_count=8`` (see SURVEY.md §4 rebuild
 translation: "kind becomes a CPU-only JAX substrate").
+
+Note: the TPU-tunnel sitecustomize imports jax at interpreter start, so
+environment variables alone are too late — the platform must be forced
+via ``jax.config`` before the backend initialises.
 """
 
 import os
 import sys
 from pathlib import Path
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))

@@ -1,0 +1,36 @@
+"""Demo model family: TPU-first JAX Llama (the observed workload)."""
+
+from tpuslo.models.llama import (
+    LlamaConfig,
+    decode_step,
+    forward,
+    init_kv_cache,
+    init_params,
+    llama3_8b,
+    llama3_70b,
+    llama_tiny,
+    loss_fn,
+    prefill,
+)
+from tpuslo.models.serve import ServeEngine, TokenEvent, decode_bytes, encode_bytes
+from tpuslo.models.train import build_sharded_train_step, make_optimizer, train_step
+
+__all__ = [
+    "LlamaConfig",
+    "decode_step",
+    "forward",
+    "init_kv_cache",
+    "init_params",
+    "llama3_8b",
+    "llama3_70b",
+    "llama_tiny",
+    "loss_fn",
+    "prefill",
+    "ServeEngine",
+    "TokenEvent",
+    "decode_bytes",
+    "encode_bytes",
+    "build_sharded_train_step",
+    "make_optimizer",
+    "train_step",
+]

@@ -1,0 +1,147 @@
+"""Llama serving engine: bucketed prefill + jitted one-token decode.
+
+The JAX backend behind the demo RAG service (replacing the reference's
+``demo/llama-cpp``).  TPU-first serving shape:
+
+* prompt lengths pad to power-of-two buckets so each bucket compiles
+  once and stays cached — no shape-driven recompile storms (the very
+  fault the toolkit attributes via ``xla_compile_ms``);
+* decode is one fixed-shape token step over a preallocated KV cache;
+* a byte-level tokenizer keeps the demo hermetic (no external vocab).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Callable, Iterator
+
+import jax
+import jax.numpy as jnp
+
+from tpuslo.models.llama import (
+    LlamaConfig,
+    decode_step,
+    init_kv_cache,
+    init_params,
+    llama_tiny,
+    prefill,
+)
+
+BOS = 256
+EOS = 257
+
+
+def encode_bytes(text: str, max_len: int) -> list[int]:
+    """Byte-level encode with BOS, truncated to max_len."""
+    ids = [BOS] + [b for b in text.encode("utf-8")]
+    return ids[:max_len]
+
+
+def decode_bytes(ids: list[int]) -> str:
+    return bytes(b for b in ids if 0 <= b < 256).decode("utf-8", errors="replace")
+
+
+def _bucket(n: int, buckets: tuple[int, ...]) -> int:
+    """Smallest bucket holding n; callers truncate to buckets[-1] first."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+@dataclass
+class TokenEvent:
+    token_id: int
+    index: int
+    ttft_ms: float | None = None
+
+
+class ServeEngine:
+    """Greedy streaming generation with per-bucket compiled prefill."""
+
+    def __init__(
+        self,
+        cfg: LlamaConfig | None = None,
+        params=None,
+        rng_seed: int = 0,
+        prefill_buckets: tuple[int, ...] = (32, 64, 128, 256),
+    ):
+        self.cfg = cfg or llama_tiny(max_seq_len=512)
+        self.params = (
+            params
+            if params is not None
+            else init_params(jax.random.PRNGKey(rng_seed), self.cfg)
+        )
+        self.prefill_buckets = tuple(
+            b for b in prefill_buckets if b <= self.cfg.max_seq_len
+        )
+        if not self.prefill_buckets:
+            # Config shorter than every requested bucket: one bucket at
+            # the model's own limit rather than crashing later.
+            self.prefill_buckets = (self.cfg.max_seq_len,)
+        # Donate the KV cache: decode updates it in place instead of
+        # copying (L, B, S_max, KV, HD) buffers every token.
+        self._prefill = jax.jit(partial(prefill, cfg=self.cfg), donate_argnums=(2,))
+        self._decode = jax.jit(partial(decode_step, cfg=self.cfg), donate_argnums=(2,))
+        self.compile_events: list[dict] = []
+
+    def warmup(self, bucket: int | None = None) -> float:
+        """Compile the decode step (and one prefill bucket); returns ms."""
+        start = time.perf_counter()
+        bucket = bucket or self.prefill_buckets[0]
+        tokens = jnp.zeros((1, bucket), jnp.int32)
+        cache = init_kv_cache(self.cfg, 1)
+        logits, cache = self._prefill(self.params, tokens, cache)
+        tok = jnp.argmax(logits, -1).astype(jnp.int32)
+        self._decode(self.params, tok, cache)
+        jax.block_until_ready(logits)
+        return (time.perf_counter() - start) * 1000.0
+
+    def generate(
+        self,
+        prompt: str,
+        max_new_tokens: int = 32,
+        stop_at_eos: bool = True,
+    ) -> Iterator[TokenEvent]:
+        """Greedy decode; yields one TokenEvent per generated token."""
+        request_start = time.perf_counter()
+        # Cap to the largest bucket so oversize prompts truncate instead
+        # of slipping through unpadded (which would compile per-length —
+        # the exact recompile storm bucketing exists to prevent).
+        max_prompt = min(
+            self.cfg.max_seq_len - max_new_tokens - 1, self.prefill_buckets[-1]
+        )
+        ids = encode_bytes(prompt, max_prompt)
+        bucket = _bucket(len(ids), self.prefill_buckets)
+        padded = ids + [0] * (bucket - len(ids))
+        tokens = jnp.asarray([padded], jnp.int32)
+
+        compile_start = time.perf_counter()
+        cache = init_kv_cache(self.cfg, 1)
+        logits, cache = self._prefill(
+            self.params, tokens, cache, true_length=jnp.asarray(len(ids), jnp.int32)
+        )
+        logits.block_until_ready()
+        prefill_ms = (time.perf_counter() - compile_start) * 1000.0
+        if prefill_ms > 100.0:
+            # A slow first hit on a bucket is (almost always) a compile.
+            self.compile_events.append(
+                {"bucket": bucket, "compile_ms": prefill_ms}
+            )
+
+        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        ttft_ms = (time.perf_counter() - request_start) * 1000.0
+        first = int(token[0])
+        yield TokenEvent(first, 0, ttft_ms=ttft_ms)
+        if stop_at_eos and first == EOS:
+            return
+
+        for idx in range(1, max_new_tokens):
+            logits, cache = self._decode(self.params, token, cache)
+            token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            value = int(token[0])
+            yield TokenEvent(value, idx)
+            if stop_at_eos and value == EOS:
+                return

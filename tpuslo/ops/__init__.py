@@ -1,0 +1,3 @@
+from tpuslo.ops.ring_attention import ring_attention, ring_attention_sharded
+
+__all__ = ["ring_attention", "ring_attention_sharded"]

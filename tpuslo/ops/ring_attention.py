@@ -1,0 +1,136 @@
+"""Ring attention: causal attention over a sequence-parallel mesh axis.
+
+Long-context serving/training shards the sequence across the ``sp``
+mesh axis; no device ever materialises the full (S × S) score matrix or
+the full KV.  KV blocks rotate around the ring with ``lax.ppermute``
+while each device folds incoming blocks into an online-softmax
+accumulator (flash-attention style: running max ``m``, normaliser
+``l``, weighted sum ``o``), so memory per device is O(S/p) and the
+collectives ride neighbour-to-neighbour ICI hops.
+
+The reference toolkit has no sequence parallelism at all (SURVEY.md
+§5 "long-context: absent"); this op is what makes the demo's
+``context_128k`` load profile servable.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # moved out of jax.experimental in newer releases
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+NEG_INF = -1e30
+
+
+def _block_attention(q, k, v, mask, m, l, o):
+    """Fold one KV block into the online-softmax accumulator.
+
+    q: (B, Sq, H, D); k/v: (B, Sk, H, D); mask: (Sq, Sk) bool.
+    m: (B, H, Sq) running max; l: (B, H, Sq) normaliser;
+    o: (B, Sq, H, D) running weighted sum.
+    """
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    scores = jnp.where(mask[None, None, :, :], scores, NEG_INF)
+
+    block_max = jnp.max(scores, axis=-1)
+    new_m = jnp.maximum(m, block_max)
+    # Rescale previous accumulator to the new max.
+    correction = jnp.exp(m - new_m)
+    p = jnp.exp(scores - new_m[..., None])
+    new_l = l * correction + jnp.sum(p, axis=-1)
+    pv = jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    new_o = o * correction.transpose(0, 2, 1)[..., None] + pv
+    return new_m, new_l, new_o
+
+
+def ring_attention(q, k, v, axis_name: str):
+    """Causal ring attention body; call inside shard_map over ``axis_name``.
+
+    q/k/v: (B, S_local, H, D) — the local sequence shard, already
+    RoPE-rotated with *global* positions.  Returns (B, S_local, H, D).
+    """
+    p_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    B, S, H, D = q.shape
+
+    qf = q.astype(jnp.float32)
+    # Derive the accumulators from q so they carry the same
+    # varying-over-axis type as the loop outputs (shard_map vma rule).
+    zero_bhq = jnp.einsum("bqhd->bhq", qf) * 0.0
+    m0 = zero_bhq + NEG_INF
+    l0 = zero_bhq
+    o0 = qf * 0.0
+
+    local_causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
+    full_mask = jnp.ones((S, S), jnp.bool_)
+    empty_mask = jnp.zeros((S, S), jnp.bool_)
+
+    def body(step, carry):
+        m, l, o, k_blk, v_blk = carry
+        src_idx = (my_idx - step) % p_size
+        # Causal block ordering: earlier blocks fully visible, own block
+        # lower-triangular, later blocks invisible.
+        mask = jnp.where(
+            src_idx < my_idx,
+            full_mask,
+            jnp.where(src_idx == my_idx, local_causal, empty_mask),
+        )
+        m, l, o = _block_attention(qf, k_blk, v_blk, mask, m, l, o)
+        # Rotate KV around the ring (neighbour hop on ICI).
+        perm = [(i, (i + 1) % p_size) for i in range(p_size)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return m, l, o, k_blk, v_blk
+
+    m, l, o, _, _ = lax.fori_loop(0, p_size, body, (m0, l0, o0, k, v))
+    # Guard fully-masked rows (an all-invisible block never occurs for
+    # causal q rows, but keep the division safe).
+    l = jnp.maximum(l, 1e-30)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = "sp"):
+    """shard_map wrapper: q/k/v (B, S, H, D) sharded over ``axis_name``."""
+    spec = P(None, axis_name, None, None)
+    fn = shard_map(
+        partial(ring_attention, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
+
+
+def reference_causal_attention(q, k, v):
+    """Single-device causal attention, for numerical comparison."""
+    scale = q.shape[-1] ** -0.5
+    S = q.shape[1]
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk",
+        q.astype(jnp.float32),
+        k.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+    scores = jnp.where(mask[None, None], scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", weights, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)

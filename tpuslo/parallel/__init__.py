@@ -1,0 +1,15 @@
+from tpuslo.parallel.mesh import (
+    MeshPlan,
+    batch_sharding,
+    make_mesh,
+    param_shardings,
+    plan_for_devices,
+)
+
+__all__ = [
+    "MeshPlan",
+    "batch_sharding",
+    "make_mesh",
+    "param_shardings",
+    "plan_for_devices",
+]

@@ -1,0 +1,102 @@
+"""Device-mesh construction and GSPMD sharding rules for the demo models.
+
+TPU-first scaling design (vs the reference, which has no parallelism —
+SURVEY.md §2.5): a ``jax.sharding.Mesh`` with axes
+
+* ``dp``   — data parallel (batch), gradients all-reduced over ICI;
+* ``fsdp`` — parameter/optimizer sharding along the feature axis
+             (ZeRO-style), all-gathered per layer by XLA;
+* ``tp``   — tensor parallel: attention heads and MLP hidden are
+             column-sharded, output projections row-sharded, so each
+             layer needs one ``psum`` on the row-parallel matmuls;
+* ``sp``   — sequence/context parallel for long sequences (ring
+             attention over ``ppermute``, see
+             :mod:`tpuslo.ops.ring_attention`).
+
+Shardings are declared with ``NamedSharding`` + ``PartitionSpec`` and
+handed to ``jax.jit`` — XLA GSPMD inserts the collectives; nothing here
+hand-schedules communication.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "fsdp", "tp")
+
+
+@dataclass(frozen=True)
+class MeshPlan:
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp * self.fsdp * self.tp
+
+
+def plan_for_devices(n: int) -> MeshPlan:
+    """Reasonable default factorization: tp innermost (fastest ICI hops),
+    then fsdp, then dp."""
+    tp = 1
+    for candidate in (8, 4, 2):
+        if n % candidate == 0:
+            tp = candidate
+            break
+    rest = n // tp
+    fsdp = 1
+    for candidate in (4, 2):
+        if rest % candidate == 0:
+            fsdp = candidate
+            break
+    dp = rest // fsdp
+    return MeshPlan(dp=dp, fsdp=fsdp, tp=tp)
+
+
+def make_mesh(plan: MeshPlan, devices: list | None = None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    if len(devices) < plan.n_devices:
+        raise ValueError(
+            f"plan needs {plan.n_devices} devices, have {len(devices)}"
+        )
+    grid = np.asarray(devices[: plan.n_devices]).reshape(
+        plan.dp, plan.fsdp, plan.tp
+    )
+    return Mesh(grid, AXES)
+
+
+def param_shardings(mesh: Mesh) -> dict:
+    """PartitionSpec tree matching ``tpuslo.models.llama.init_params``.
+
+    Column-parallel projections shard their output dim on ``tp`` and
+    input dim on ``fsdp``; row-parallel projections are transposed.
+    Layer-stacked leaves keep the leading layer axis unsharded so the
+    ``lax.scan`` body stays uniform.
+    """
+    ns = lambda spec: NamedSharding(mesh, spec)  # noqa: E731
+    return {
+        "embed": ns(P("tp", "fsdp")),
+        "layers": {
+            "attn_norm": ns(P(None, None)),
+            "wq": ns(P(None, "fsdp", "tp")),
+            "wk": ns(P(None, "fsdp", "tp")),
+            "wv": ns(P(None, "fsdp", "tp")),
+            "wo": ns(P(None, "tp", "fsdp")),
+            "mlp_norm": ns(P(None, None)),
+            "w1": ns(P(None, "fsdp", "tp")),
+            "w3": ns(P(None, "fsdp", "tp")),
+            "w2": ns(P(None, "tp", "fsdp")),
+        },
+        "final_norm": ns(P(None)),
+        "output": ns(P("fsdp", "tp")),
+    }
+
+
+def batch_sharding(mesh: Mesh, seq_axis: str | None = None) -> NamedSharding:
+    """Tokens/targets: batch over (dp, fsdp); optionally sequence over sp."""
+    return NamedSharding(mesh, P(("dp", "fsdp"), seq_axis))
