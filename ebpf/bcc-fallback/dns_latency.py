@@ -1,0 +1,24 @@
+#!/usr/bin/env python3
+"""BCC-degraded DNS latency fallback.
+
+Role parity with the reference's BCC placeholder
+(ebpf/bcc-fallback/dns_latency.py prints one JSON sample and exits;
+pkg/collector/bcc_fallback.go:37-49 is an explicit stub).  This
+fallback is honest about the same limitation: on hosts without BTF the
+toolkit degrades to the two-signal ``bcc_degraded`` set, and this
+script emits one well-formed sample per invocation so the wiring can be
+exercised end-to-end.  A real BCC program belongs here when a target
+fleet actually needs pre-BTF kernels.
+"""
+import json
+import sys
+import time
+
+sample = {
+    "signal": "dns_latency_ms",
+    "value_ms": 0.0,
+    "source": "bcc_fallback_stub",
+    "ts_unix_ns": time.time_ns(),
+}
+json.dump(sample, sys.stdout)
+print()

@@ -1,0 +1,14 @@
+#!/usr/bin/env python3
+"""BCC-degraded TCP retransmit fallback (stub; see dns_latency.py)."""
+import json
+import sys
+import time
+
+sample = {
+    "signal": "tcp_retransmits_total",
+    "value": 0,
+    "source": "bcc_fallback_stub",
+    "ts_unix_ns": time.time_ns(),
+}
+json.dump(sample, sys.stdout)
+print()

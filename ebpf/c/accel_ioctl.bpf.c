@@ -1,0 +1,63 @@
+/* SPDX-License-Identifier: GPL-2.0 */
+/*
+ * accel_ioctl.bpf.c — latency of ioctl calls into the TPU driver
+ * (/dev/accel*), the kernel-side view of host↔device submission and
+ * offload stalls.
+ *
+ * No reference counterpart (the reference observes no accelerator);
+ * this is the `/dev/accel*` kprobe surface called for by BASELINE.md.
+ * The TPU driver's file_operations ioctl handler is not a stable
+ * exported name across driver versions, so this program is attached by
+ * the loader to a symbol resolved from /proc/kallsyms at load time
+ * (candidates in config/libtpu-symbols.yaml, e.g. the vfio-pci or
+ * Google accel driver ioctl entry).  Latency floor 20µs: fast-path
+ * doorbell ioctls are noise; the signal is submission *stalls*.
+ */
+#include "tpuslo_common.bpf.h"
+
+#define IOCTL_FLOOR_NS (20ULL * 1000ULL)
+
+struct accel_call {
+	__u64 start_ns;
+	__u64 cmd;
+};
+
+struct {
+	__uint(type, BPF_MAP_TYPE_HASH);
+	__uint(max_entries, 8192);
+	__type(key, __u64);
+	__type(value, struct accel_call);
+} accel_calls SEC(".maps");
+
+SEC("kprobe")
+int BPF_KPROBE(accel_ioctl_begin, struct file *file, unsigned int cmd)
+{
+	__u64 id = bpf_get_current_pid_tgid();
+	struct accel_call call = {
+		.start_ns = bpf_ktime_get_ns(),
+		.cmd = cmd,
+	};
+
+	bpf_map_update_elem(&accel_calls, &id, &call, BPF_ANY);
+	return 0;
+}
+
+SEC("kretprobe")
+int BPF_KRETPROBE(accel_ioctl_done, long ret)
+{
+	__u64 id = bpf_get_current_pid_tgid();
+	struct accel_call *call = bpf_map_lookup_elem(&accel_calls, &id);
+
+	if (!call)
+		return 0;
+	__u64 delta = bpf_ktime_get_ns() - call->start_ns;
+	__u64 cmd = call->cmd;
+
+	bpf_map_delete_elem(&accel_calls, &id);
+	if (delta < IOCTL_FLOOR_NS && ret >= 0)
+		return 0;
+	tpuslo_emit_value(TPUSLO_SIG_HOST_OFFLOAD, delta, cmd,
+			  TPUSLO_F_TPU | (ret < 0 ? TPUSLO_F_ERROR : 0),
+			  ret < 0 ? (__s16)ret : 0);
+	return 0;
+}

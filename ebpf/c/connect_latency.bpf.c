@@ -1,0 +1,55 @@
+/* SPDX-License-Identifier: GPL-2.0 */
+/*
+ * connect_latency.bpf.c — TCP connect() establishment latency and
+ * connect errors, IPv4 + IPv6.
+ *
+ * Signal parity with the reference's connect_latency probe
+ * (kprobe+kretprobe on tcp_v4_connect/tcp_v6_connect capturing the
+ * negated return as errno).  One entry/return pair per address family,
+ * both feeding the shared in-flight hash; the consumer splits
+ * err<0 events into the connect_errors counter signal.
+ */
+#include "tpuslo_common.bpf.h"
+
+static __always_inline int connect_begin(struct sock *sk, __u16 flags)
+{
+	__u64 id = bpf_get_current_pid_tgid();
+	struct tpuslo_inflight in = {};
+
+	in.start_ns = bpf_ktime_get_ns();
+	in.saddr4 = BPF_CORE_READ(sk, __sk_common.skc_rcv_saddr);
+	in.daddr4 = BPF_CORE_READ(sk, __sk_common.skc_daddr);
+	in.sport = BPF_CORE_READ(sk, __sk_common.skc_num);
+	in.dport = bpf_ntohs(BPF_CORE_READ(sk, __sk_common.skc_dport));
+	in.flags = TPUSLO_F_CONN | flags;
+	bpf_map_update_elem(&tpuslo_inflight_map, &id, &in, BPF_ANY);
+	return 0;
+}
+
+SEC("kprobe/tcp_v4_connect")
+int BPF_KPROBE(connect4_begin, struct sock *sk)
+{
+	return connect_begin(sk, 0);
+}
+
+SEC("kretprobe/tcp_v4_connect")
+int BPF_KRETPROBE(connect4_done, int ret)
+{
+	tpuslo_inflight_end(TPUSLO_SIG_CONNECT_LATENCY, 0,
+			    ret < 0 ? (__s16)ret : 0);
+	return 0;
+}
+
+SEC("kprobe/tcp_v6_connect")
+int BPF_KPROBE(connect6_begin, struct sock *sk)
+{
+	return connect_begin(sk, TPUSLO_F_IPV6);
+}
+
+SEC("kretprobe/tcp_v6_connect")
+int BPF_KRETPROBE(connect6_done, int ret)
+{
+	tpuslo_inflight_end(TPUSLO_SIG_CONNECT_LATENCY, 0,
+			    ret < 0 ? (__s16)ret : 0);
+	return 0;
+}

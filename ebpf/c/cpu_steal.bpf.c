@@ -1,0 +1,27 @@
+/* SPDX-License-Identifier: GPL-2.0 */
+/*
+ * cpu_steal.bpf.c — involuntary CPU wait, the kernel-side raw input to
+ * the cpu_steal_pct signal.
+ *
+ * Signal parity with the reference's cpu_steal probe (tracepoint
+ * sched:sched_stat_wait emitting raw wait ns with a 50µs floor; the
+ * reference documents pct aggregation as a userspace responsibility
+ * but never implements it — pkg/collector/ringbuf.go:211-215).  Here
+ * the contract is the same at the probe (raw ns out) and the gap is
+ * actually closed in the consumer: native/decode.cc aggregates wait
+ * ns over a sliding window into a percentage.
+ */
+#include "tpuslo_common.bpf.h"
+
+#define STEAL_FLOOR_NS (50ULL * 1000ULL)
+
+SEC("tracepoint/sched/sched_stat_wait")
+int cpu_steal_wait(struct trace_event_raw_sched_stat_template *ctx)
+{
+	__u64 wait_ns = ctx->delay;
+
+	if (wait_ns < STEAL_FLOOR_NS)
+		return 0;
+	tpuslo_emit_value(TPUSLO_SIG_CPU_STEAL, wait_ns, 0, 0, 0);
+	return 0;
+}

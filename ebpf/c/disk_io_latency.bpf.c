@@ -1,0 +1,60 @@
+/* SPDX-License-Identifier: GPL-2.0 */
+/*
+ * disk_io_latency.bpf.c — block request service latency keyed by
+ * (device, sector), so concurrent requests on the same queue are
+ * tracked independently.
+ *
+ * Signal parity with the reference's disk_io_latency probe
+ * (block:block_rq_issue/complete tracepoints, 500µs floor).  The
+ * completing event carries the device number in aux so the consumer
+ * can label per-device latencies (the reference drops the device).
+ */
+#include "tpuslo_common.bpf.h"
+
+#define DISK_FLOOR_NS (500ULL * 1000ULL)
+
+struct disk_req_key {
+	__u32 dev;
+	__u64 sector;
+};
+
+struct {
+	__uint(type, BPF_MAP_TYPE_HASH);
+	__uint(max_entries, 16384);
+	__type(key, struct disk_req_key);
+	__type(value, __u64);
+} disk_issue_ns SEC(".maps");
+
+SEC("tracepoint/block/block_rq_issue")
+int disk_issue(struct trace_event_raw_block_rq *ctx)
+{
+	struct disk_req_key key = {
+		.dev = ctx->dev,
+		.sector = ctx->sector,
+	};
+	__u64 now = bpf_ktime_get_ns();
+
+	bpf_map_update_elem(&disk_issue_ns, &key, &now, BPF_ANY);
+	return 0;
+}
+
+SEC("tracepoint/block/block_rq_complete")
+int disk_complete(struct trace_event_raw_block_rq_completion *ctx)
+{
+	struct disk_req_key key = {
+		.dev = ctx->dev,
+		.sector = ctx->sector,
+	};
+	__u64 *start = bpf_map_lookup_elem(&disk_issue_ns, &key);
+
+	if (!start)
+		return 0;
+	__u64 delta = bpf_ktime_get_ns() - *start;
+
+	bpf_map_delete_elem(&disk_issue_ns, &key);
+	if (delta < DISK_FLOOR_NS)
+		return 0;
+	tpuslo_emit_value(TPUSLO_SIG_DISK_IO, delta, (__u64)key.dev << 32,
+			  0, 0);
+	return 0;
+}

@@ -1,0 +1,45 @@
+/* SPDX-License-Identifier: GPL-2.0 */
+/*
+ * dns_latency.bpf.c — DNS round-trip latency per querying thread.
+ *
+ * Signal parity with the reference's dns_latency probe (kprobe pair on
+ * udp_sendmsg/udp_recvmsg filtered to dport 53); this implementation
+ * differs in closing the measurement at the *kretprobe* of
+ * udp_recvmsg — i.e. after the reply payload has actually been copied
+ * to the resolver — and in reusing the shared in-flight hash from
+ * tpuslo_common.bpf.h instead of a private map.
+ */
+#include "tpuslo_common.bpf.h"
+
+#define DNS_PORT 53
+
+SEC("kprobe/udp_sendmsg")
+int BPF_KPROBE(dns_query_start, struct sock *sk)
+{
+	__u16 dport_be = BPF_CORE_READ(sk, __sk_common.skc_dport);
+
+	if (bpf_ntohs(dport_be) != DNS_PORT)
+		return 0;
+
+	__u64 id = bpf_get_current_pid_tgid();
+	struct tpuslo_inflight in = {};
+
+	in.start_ns = bpf_ktime_get_ns();
+	in.saddr4 = BPF_CORE_READ(sk, __sk_common.skc_rcv_saddr);
+	in.daddr4 = BPF_CORE_READ(sk, __sk_common.skc_daddr);
+	in.sport = BPF_CORE_READ(sk, __sk_common.skc_num);
+	in.dport = DNS_PORT;
+	in.flags = TPUSLO_F_CONN;
+	bpf_map_update_elem(&tpuslo_inflight_map, &id, &in, BPF_ANY);
+	return 0;
+}
+
+SEC("kretprobe/udp_recvmsg")
+int BPF_KRETPROBE(dns_reply_done, int ret)
+{
+	/* Only threads that sent a DNS query have an in-flight entry, so
+	 * non-DNS UDP traffic falls through the lookup miss. */
+	tpuslo_inflight_end(TPUSLO_SIG_DNS_LATENCY, 0,
+			    ret < 0 ? (__s16)ret : 0);
+	return 0;
+}

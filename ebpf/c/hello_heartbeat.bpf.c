@@ -1,0 +1,36 @@
+/* SPDX-License-Identifier: GPL-2.0 */
+/*
+ * hello_heartbeat.bpf.c — end-to-end evidence probe: counts write(2)
+ * entries per task and periodically emits a TPUSLO_SIG_HELLO event so
+ * the full kernel→ringbuf→agent→Prometheus chain can be demonstrated
+ * on any host without privileges beyond BPF.
+ * Reference counterpart: ebpf/c/hello_sys_enter_write.bpf.c (per-comm
+ * syscall counter for e2e evidence); this variant rate-limits emission
+ * to one event per task per 2^10 hits instead of flooding the ring.
+ */
+#include "tpuslo_common.bpf.h"
+
+struct {
+	__uint(type, BPF_MAP_TYPE_HASH);
+	__uint(max_entries, 4096);
+	__type(key, __u32);
+	__type(value, __u64);
+} hello_counts SEC(".maps");
+
+SEC("tracepoint/syscalls/sys_enter_write")
+int hello_count_writes(void *ctx)
+{
+	__u32 pid = bpf_get_current_pid_tgid() >> 32;
+	__u64 one = 1, *count;
+
+	count = bpf_map_lookup_elem(&hello_counts, &pid);
+	if (!count) {
+		bpf_map_update_elem(&hello_counts, &pid, &one, BPF_ANY);
+		return 0;
+	}
+	__sync_fetch_and_add(count, 1);
+	/* Emit every 1024th hit so the heartbeat is visible but cheap. */
+	if ((*count & 0x3ff) == 0)
+		tpuslo_emit_value(TPUSLO_SIG_HELLO, *count, 0, 0, 0);
+	return 0;
+}

@@ -1,0 +1,104 @@
+/* SPDX-License-Identifier: GPL-2.0 */
+/*
+ * libtpu_uprobes.bpf.c — the TPU-side probe surface: user-space probes
+ * on libtpu.so covering XLA compilation, HBM allocation stalls, and
+ * cross-chip collective launches.
+ *
+ * This is the TPU-native replacement for the reference's
+ * network-centric uprobe (its only uprobe is SSL_do_handshake).  The
+ * design problem is different here: libtpu exports *many* interesting
+ * symbols and their names drift across releases (SURVEY.md §7 "hard
+ * parts": libtpu symbol stability).  So instead of one program per
+ * symbol, this object ships exactly three generic programs —
+ * span-begin, span-end, and counter-hit — and the loader
+ * (native/probe_manager.cc) attaches them to whatever symbols the
+ * symbol manifest (config/libtpu-symbols.yaml) resolves in the
+ * installed libtpu, passing a per-attachment cookie:
+ *
+ *   cookie = (signal_id << 48) | (symbol_fingerprint & 0xffffffffffff)
+ *
+ * The signal travels in the cookie, so adding a new libtpu release's
+ * symbol set is a manifest edit, not a BPF rebuild.  Span pairs are
+ * keyed by (pid_tgid, signal) so one thread can have an XLA compile
+ * and a collective in flight simultaneously.
+ */
+#include "tpuslo_common.bpf.h"
+
+#define COOKIE_SIGNAL(c) ((__u16)((c) >> 48))
+#define COOKIE_FPRINT(c) ((c) & 0xffffffffffffULL)
+
+struct tpu_span_key {
+	__u64 pid_tgid;
+	__u16 signal;
+};
+
+struct tpu_span_val {
+	__u64 start_ns;
+	__u64 fingerprint;
+};
+
+struct {
+	__uint(type, BPF_MAP_TYPE_HASH);
+	__uint(max_entries, 8192);
+	__type(key, struct tpu_span_key);
+	__type(value, struct tpu_span_val);
+} tpu_spans SEC(".maps");
+
+/* Span begin: XLA compile entry, HBM alloc slow-path entry, collective
+ * launch.  First argument (when the symbol takes one) is recorded so
+ * e.g. requested allocation bytes reach the consumer. */
+SEC("uprobe")
+int BPF_UPROBE(tpu_span_begin, unsigned long arg0)
+{
+	__u64 cookie = bpf_get_attach_cookie(ctx);
+	struct tpu_span_key key = {
+		.pid_tgid = bpf_get_current_pid_tgid(),
+		.signal = COOKIE_SIGNAL(cookie),
+	};
+	struct tpu_span_val val = {
+		.start_ns = bpf_ktime_get_ns(),
+		.fingerprint = arg0 ? (__u64)arg0 : COOKIE_FPRINT(cookie),
+	};
+
+	bpf_map_update_elem(&tpu_spans, &key, &val, BPF_ANY);
+	return 0;
+}
+
+SEC("uretprobe")
+int BPF_URETPROBE(tpu_span_end, long ret)
+{
+	__u64 cookie = bpf_get_attach_cookie(ctx);
+	struct tpu_span_key key = {
+		.pid_tgid = bpf_get_current_pid_tgid(),
+		.signal = COOKIE_SIGNAL(cookie),
+	};
+	struct tpu_span_val *val = bpf_map_lookup_elem(&tpu_spans, &key);
+
+	if (!val)
+		return 0;
+	__u64 delta = bpf_ktime_get_ns() - val->start_ns;
+	struct tpuslo_event *ev = tpuslo_reserve(key.signal);
+
+	if (ev) {
+		ev->value = delta;
+		ev->aux = val->fingerprint;
+		ev->flags = TPUSLO_F_TPU | (ret < 0 ? TPUSLO_F_ERROR : 0);
+		ev->err = ret < 0 ? (__s16)ret : 0;
+		bpf_ringbuf_submit(ev, 0);
+	}
+	bpf_map_delete_elem(&tpu_spans, &key);
+	return 0;
+}
+
+/* Counter hit: ICI link retry, or any other "it happened" symbol.  The
+ * consumer aggregates counts per window. */
+SEC("uprobe")
+int BPF_UPROBE(tpu_counter_hit, unsigned long arg0)
+{
+	__u64 cookie = bpf_get_attach_cookie(ctx);
+
+	tpuslo_emit_value(COOKIE_SIGNAL(cookie), 1,
+			  arg0 ? (__u64)arg0 : COOKIE_FPRINT(cookie),
+			  TPUSLO_F_TPU, 0);
+	return 0;
+}

@@ -1,0 +1,26 @@
+/* SPDX-License-Identifier: GPL-2.0 */
+/*
+ * mem_reclaim.bpf.c — direct-reclaim stall latency: how long an
+ * allocating task spent synchronously reclaiming memory.
+ *
+ * Signal parity with the reference's mem_reclaim probe (vmscan
+ * direct-reclaim begin/end tracepoints, 10µs floor), using the shared
+ * in-flight hash keyed by pid_tgid.
+ */
+#include "tpuslo_common.bpf.h"
+
+#define RECLAIM_FLOOR_NS (10ULL * 1000ULL)
+
+SEC("tracepoint/vmscan/mm_vmscan_direct_reclaim_begin")
+int reclaim_begin(void *ctx)
+{
+	tpuslo_inflight_begin(0);
+	return 0;
+}
+
+SEC("tracepoint/vmscan/mm_vmscan_direct_reclaim_end")
+int reclaim_end(void *ctx)
+{
+	tpuslo_inflight_end(TPUSLO_SIG_MEM_RECLAIM, RECLAIM_FLOOR_NS, 0);
+	return 0;
+}

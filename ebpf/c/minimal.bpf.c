@@ -1,0 +1,15 @@
+/* SPDX-License-Identifier: GPL-2.0 */
+/*
+ * minimal.bpf.c — CO-RE build-validation probe.  Exists so the build
+ * pipeline and the load smoke (scripts/ebpf-smoke.sh) have a program
+ * with zero kernel-structure dependencies: if this fails to compile or
+ * load, the toolchain or kernel is the problem, not a probe.
+ * Reference counterpart: ebpf/c/minimal.bpf.c (same role).
+ */
+#include "tpuslo_common.bpf.h"
+
+SEC("tracepoint/syscalls/sys_enter_write")
+int minimal_noop(void *ctx)
+{
+	return 0;
+}

@@ -1,0 +1,69 @@
+/* SPDX-License-Identifier: GPL-2.0 */
+/*
+ * runqueue_delay.bpf.c — time between a task becoming runnable and it
+ * being scheduled on a CPU.
+ *
+ * Signal parity with the reference's runqueue_delay probe (sched
+ * wakeup/wakeup_new/switch tracepoints, 100µs noise floor).  The
+ * wakeup timestamp is keyed by the woken task's pid (not the waker's
+ * pid_tgid), so this uses its own map rather than the shared
+ * pid_tgid-keyed in-flight hash.
+ */
+#include "tpuslo_common.bpf.h"
+
+#define RUNQ_FLOOR_NS (100ULL * 1000ULL) /* ignore <100µs scheduler noise */
+
+struct {
+	__uint(type, BPF_MAP_TYPE_HASH);
+	__uint(max_entries, 16384);
+	__type(key, __u32);
+	__type(value, __u64);
+} runq_wakeup_ns SEC(".maps");
+
+static __always_inline void mark_runnable(__u32 pid)
+{
+	__u64 now = bpf_ktime_get_ns();
+
+	bpf_map_update_elem(&runq_wakeup_ns, &pid, &now, BPF_ANY);
+}
+
+SEC("tracepoint/sched/sched_wakeup")
+int runq_wakeup(struct trace_event_raw_sched_wakeup_template *ctx)
+{
+	mark_runnable(ctx->pid);
+	return 0;
+}
+
+SEC("tracepoint/sched/sched_wakeup_new")
+int runq_wakeup_new(struct trace_event_raw_sched_wakeup_template *ctx)
+{
+	mark_runnable(ctx->pid);
+	return 0;
+}
+
+SEC("tracepoint/sched/sched_switch")
+int runq_switch_in(struct trace_event_raw_sched_switch *ctx)
+{
+	__u32 pid = ctx->next_pid;
+	__u64 *start = bpf_map_lookup_elem(&runq_wakeup_ns, &pid);
+
+	if (!start)
+		return 0;
+	__u64 delta = bpf_ktime_get_ns() - *start;
+
+	bpf_map_delete_elem(&runq_wakeup_ns, &pid);
+	if (delta < RUNQ_FLOOR_NS)
+		return 0;
+
+	struct tpuslo_event *ev = tpuslo_reserve(TPUSLO_SIG_RUNQ_DELAY);
+
+	if (!ev)
+		return 0;
+	ev->value = delta;
+	/* pid fields describe the *scheduled* task, not the current one. */
+	ev->pid = pid;
+	ev->tid = pid;
+	__builtin_memcpy(ev->comm, ctx->next_comm, TPUSLO_COMM_LEN);
+	bpf_ringbuf_submit(ev, 0);
+	return 0;
+}

@@ -1,0 +1,46 @@
+/* SPDX-License-Identifier: GPL-2.0 */
+/*
+ * syscall_latency.bpf.c — slow read/write syscalls (the I/O syscalls a
+ * serving process blocks on), 1ms floor.
+ *
+ * Signal parity with the reference's syscall_latency probe
+ * (kprobe+kretprobe on ksys_read/ksys_write with shared helpers).
+ * The syscall class (read=0, write=1) travels in aux so dashboards
+ * can split the two without extra signals.
+ */
+#include "tpuslo_common.bpf.h"
+
+#define SYSCALL_FLOOR_NS (1000ULL * 1000ULL)
+
+#define SYSCALL_CLASS_READ 0
+#define SYSCALL_CLASS_WRITE 1
+
+SEC("kprobe/ksys_read")
+int BPF_KPROBE(sys_read_begin)
+{
+	tpuslo_inflight_begin(SYSCALL_CLASS_READ);
+	return 0;
+}
+
+SEC("kretprobe/ksys_read")
+int BPF_KRETPROBE(sys_read_done, long ret)
+{
+	tpuslo_inflight_end(TPUSLO_SIG_SYSCALL_LATENCY, SYSCALL_FLOOR_NS,
+			    ret < 0 ? (__s16)ret : 0);
+	return 0;
+}
+
+SEC("kprobe/ksys_write")
+int BPF_KPROBE(sys_write_begin)
+{
+	tpuslo_inflight_begin(SYSCALL_CLASS_WRITE);
+	return 0;
+}
+
+SEC("kretprobe/ksys_write")
+int BPF_KRETPROBE(sys_write_done, long ret)
+{
+	tpuslo_inflight_end(TPUSLO_SIG_SYSCALL_LATENCY, SYSCALL_FLOOR_NS,
+			    ret < 0 ? (__s16)ret : 0);
+	return 0;
+}

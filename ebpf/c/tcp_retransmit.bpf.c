@@ -1,0 +1,27 @@
+/* SPDX-License-Identifier: GPL-2.0 */
+/*
+ * tcp_retransmit.bpf.c — one event per TCP retransmission, with the
+ * connection 4-tuple so the correlator can join on conn identity.
+ *
+ * Signal parity with the reference's tcp_retransmit probe (stateless
+ * tracepoint tcp:tcp_retransmit_skb counter); here the tuple is read
+ * from the tracepoint's stable ABI fields rather than the skb.
+ */
+#include "tpuslo_common.bpf.h"
+
+SEC("tracepoint/tcp/tcp_retransmit_skb")
+int tcp_retransmit_hit(struct trace_event_raw_tcp_event_sk_skb *ctx)
+{
+	struct tpuslo_event *ev = tpuslo_reserve(TPUSLO_SIG_TCP_RETRANSMIT);
+
+	if (!ev)
+		return 0;
+	ev->value = 1;
+	ev->sport = ctx->sport;
+	ev->dport = ctx->dport;
+	__builtin_memcpy(&ev->saddr4, ctx->saddr, 4);
+	__builtin_memcpy(&ev->daddr4, ctx->daddr, 4);
+	ev->flags = TPUSLO_F_CONN;
+	bpf_ringbuf_submit(ev, 0);
+	return 0;
+}

@@ -1,0 +1,31 @@
+/* SPDX-License-Identifier: GPL-2.0 */
+/*
+ * tls_handshake.bpf.c — TLS handshake wall time via user-space probes
+ * on the TLS library's handshake entry point.
+ *
+ * Signal parity with the reference's tls_handshake probe
+ * (uprobe+uretprobe on SSL_do_handshake; the library path is supplied
+ * by the loader at attach time, not hardcoded here).  The loader
+ * (native/probe_manager.cc) attaches this pair to whichever of
+ * SSL_do_handshake / SSL_connect / gnutls_handshake it resolves,
+ * passing the chosen symbol's hash as the attach cookie so the
+ * consumer can report which library was observed.
+ */
+#include "tpuslo_common.bpf.h"
+
+SEC("uprobe")
+int BPF_UPROBE(tls_handshake_begin)
+{
+	tpuslo_inflight_begin(bpf_get_attach_cookie(ctx));
+	return 0;
+}
+
+SEC("uretprobe")
+int BPF_URETPROBE(tls_handshake_done, long ret)
+{
+	/* OpenSSL returns 1 on success; anything else is a failure.  The
+	 * consumer maps err!=0 to the tls_handshake_fail counter. */
+	tpuslo_inflight_end(TPUSLO_SIG_TLS_HANDSHAKE, 0,
+			    ret == 1 ? 0 : 1);
+	return 0;
+}

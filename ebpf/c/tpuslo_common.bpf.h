@@ -1,0 +1,135 @@
+/* SPDX-License-Identifier: GPL-2.0 */
+/*
+ * tpuslo_common.bpf.h — shared probe-side plumbing: the ring buffer
+ * map, an event-reserve/submit helper, and a generic in-flight latency
+ * hash.  Every .bpf.c in this directory includes this header so the
+ * per-program files contain only hook logic.
+ *
+ * Counterpart of the reference's per-program boilerplate (each of
+ * ebpf/c/*.bpf.c re-declares its own ringbuf + maps); centralising it
+ * here is a deliberate divergence: one map definition, one submit
+ * path, and cookie-based signal dispatch for uprobes (see
+ * libtpu_uprobes.bpf.c).
+ */
+#ifndef TPUSLO_COMMON_BPF_H
+#define TPUSLO_COMMON_BPF_H
+
+#include "vmlinux.h"
+#include <bpf/bpf_helpers.h>
+#include <bpf/bpf_core_read.h>
+#include <bpf/bpf_tracing.h>
+#include <bpf/bpf_endian.h>
+
+#include "tpuslo_event.h"
+
+char LICENSE[] SEC("license") = "GPL";
+
+struct {
+	__uint(type, BPF_MAP_TYPE_RINGBUF);
+	__uint(max_entries, TPUSLO_RINGBUF_BYTES);
+} tpuslo_events SEC(".maps");
+
+/* Generic in-flight start-timestamp hash keyed by pid_tgid.  Single
+ * definition reused by every entry/return latency probe in one object;
+ * programs built as separate objects each get their own instance. */
+struct tpuslo_inflight {
+	__u64 start_ns;
+	__u64 aux;
+	__u32 saddr4;
+	__u32 daddr4;
+	__u16 sport;
+	__u16 dport;
+	__u16 flags;
+};
+
+struct {
+	__uint(type, BPF_MAP_TYPE_HASH);
+	__uint(max_entries, 10240);
+	__type(key, __u64);
+	__type(value, struct tpuslo_inflight);
+} tpuslo_inflight_map SEC(".maps");
+
+static __always_inline struct tpuslo_event *
+tpuslo_reserve(__u16 signal)
+{
+	struct tpuslo_event *ev;
+
+	ev = bpf_ringbuf_reserve(&tpuslo_events, sizeof(*ev), 0);
+	if (!ev)
+		return 0;
+	__u64 id = bpf_get_current_pid_tgid();
+	ev->ts_ns = bpf_ktime_get_ns();
+	ev->value = 0;
+	ev->aux = 0;
+	ev->pid = id >> 32;
+	ev->tid = (__u32)id;
+	ev->saddr4 = 0;
+	ev->daddr4 = 0;
+	ev->sport = 0;
+	ev->dport = 0;
+	ev->signal = signal;
+	ev->flags = 0;
+	ev->err = 0;
+	ev->_pad = 0;
+	bpf_get_current_comm(&ev->comm, sizeof(ev->comm));
+	return ev;
+}
+
+static __always_inline void
+tpuslo_emit_value(__u16 signal, __u64 value, __u64 aux, __u16 flags,
+		  __s16 err)
+{
+	struct tpuslo_event *ev = tpuslo_reserve(signal);
+
+	if (!ev)
+		return;
+	ev->value = value;
+	ev->aux = aux;
+	ev->flags = flags;
+	ev->err = err;
+	bpf_ringbuf_submit(ev, 0);
+}
+
+/* Entry half of an entry/return latency pair. */
+static __always_inline void
+tpuslo_inflight_begin(__u64 aux)
+{
+	__u64 id = bpf_get_current_pid_tgid();
+	struct tpuslo_inflight in = {};
+
+	in.start_ns = bpf_ktime_get_ns();
+	in.aux = aux;
+	bpf_map_update_elem(&tpuslo_inflight_map, &id, &in, BPF_ANY);
+}
+
+/* Return half: emit delta if above the per-signal noise floor. */
+static __always_inline void
+tpuslo_inflight_end(__u16 signal, __u64 floor_ns, __s16 err)
+{
+	__u64 id = bpf_get_current_pid_tgid();
+	struct tpuslo_inflight *in;
+	__u64 delta;
+
+	in = bpf_map_lookup_elem(&tpuslo_inflight_map, &id);
+	if (!in)
+		return;
+	delta = bpf_ktime_get_ns() - in->start_ns;
+	if (delta >= floor_ns || err) {
+		struct tpuslo_event *ev = tpuslo_reserve(signal);
+
+		if (ev) {
+			ev->value = delta;
+			ev->aux = in->aux;
+			ev->saddr4 = in->saddr4;
+			ev->daddr4 = in->daddr4;
+			ev->sport = in->sport;
+			ev->dport = in->dport;
+			ev->flags = in->flags | (err ? TPUSLO_F_ERROR : 0);
+			ev->err = err;
+			bpf_ringbuf_submit(ev, 0);
+		}
+	}
+	bpf_map_delete_elem(&tpuslo_inflight_map, &id);
+}
+
+#endif /* TPUSLO_COMMON_BPF_H */

@@ -1,0 +1,101 @@
+/* SPDX-License-Identifier: Apache-2.0 */
+/*
+ * tpuslo_event.h — shared wire format between every tpuslo probe
+ * (kernel eBPF programs and userspace emitters) and the native
+ * consumer runtime (native/).
+ *
+ * Counterpart of the reference's shared ring-buffer event
+ * (ebpf/c/llm_slo_event.h:5-42 declares one packed struct + signal
+ * enum shared by all probes); this layout is a fresh design:
+ *
+ *   - one fixed-size 72-byte record, explicitly padded, no bitfields,
+ *     little-endian on every supported host (x86_64 / aarch64);
+ *   - `value` carries the signal's native unit (ns for latencies,
+ *     count for counters, basis points for percentages) — unit
+ *     normalization happens exactly once, in the consumer
+ *     (native/decode.cc), never in probe code;
+ *   - `aux` is a signal-scoped payload (XLA launch id, HBM bytes,
+ *     collective op kind, disk dev) so TPU probes need no extra
+ *     struct variants;
+ *   - TPU signals live in a separate numeric block (16+) so capability
+ *     filtering is a range check.
+ */
+#ifndef TPUSLO_EVENT_H
+#define TPUSLO_EVENT_H
+
+#ifdef __cplusplus
+#include <cstdint>
+typedef uint64_t tpuslo_u64;
+typedef uint32_t tpuslo_u32;
+typedef uint16_t tpuslo_u16;
+typedef int16_t tpuslo_s16;
+#else
+typedef unsigned long long tpuslo_u64;
+typedef unsigned int tpuslo_u32;
+typedef unsigned short tpuslo_u16;
+typedef short tpuslo_s16;
+#endif
+
+#define TPUSLO_COMM_LEN 16
+
+/* Ring buffer map shared by every probe program. */
+#define TPUSLO_RINGBUF_NAME "tpuslo_events"
+#define TPUSLO_RINGBUF_BYTES (512 * 1024)
+
+/* Signal identifiers.  CPU-side kernel signals are 1..15, TPU-side
+ * signals 16..31.  Keep in sync with tpuslo/signals/constants.py. */
+enum tpuslo_signal_id {
+	TPUSLO_SIG_NONE = 0,
+	/* CPU-side kernel signals (value unit noted per signal). */
+	TPUSLO_SIG_DNS_LATENCY = 1,     /* ns  */
+	TPUSLO_SIG_TCP_RETRANSMIT = 2,  /* count */
+	TPUSLO_SIG_RUNQ_DELAY = 3,      /* ns  */
+	TPUSLO_SIG_CONNECT_LATENCY = 4, /* ns; err<0 => connect_errors */
+	TPUSLO_SIG_TLS_HANDSHAKE = 5,   /* ns; err!=0 => handshake fail */
+	TPUSLO_SIG_CPU_STEAL = 6,       /* ns of involuntary wait; consumer
+	                                 * aggregates to pct over a window */
+	TPUSLO_SIG_MEM_RECLAIM = 7,     /* ns  */
+	TPUSLO_SIG_DISK_IO = 8,         /* ns; aux = (dev<<32)|rwflag */
+	TPUSLO_SIG_SYSCALL_LATENCY = 9, /* ns; aux = syscall class */
+	/* TPU-side signals (libtpu uprobes + accel driver kprobes). */
+	TPUSLO_SIG_XLA_COMPILE = 16,      /* ns; aux = program fingerprint */
+	TPUSLO_SIG_HBM_ALLOC_STALL = 17,  /* ns; aux = requested bytes */
+	TPUSLO_SIG_HBM_UTILIZATION = 18,  /* basis points (0..10000) */
+	TPUSLO_SIG_ICI_LINK_RETRY = 19,   /* count; aux = link index */
+	TPUSLO_SIG_ICI_COLLECTIVE = 20,   /* ns; aux = launch id */
+	TPUSLO_SIG_HOST_OFFLOAD = 21,     /* ns; aux = ioctl cmd */
+	/* Diagnostics. */
+	TPUSLO_SIG_HELLO = 31, /* heartbeat counter for e2e evidence */
+};
+
+/* Event flags. */
+#define TPUSLO_F_ERROR 0x0001   /* err field is meaningful */
+#define TPUSLO_F_CONN 0x0002    /* saddr/daddr/sport/dport are set */
+#define TPUSLO_F_IPV6 0x0004    /* addresses are truncated v6 (low 32) */
+#define TPUSLO_F_TPU 0x0008     /* emitted by a TPU-side probe */
+
+struct tpuslo_event {
+	tpuslo_u64 ts_ns;  /* bpf_ktime_get_ns() at emit */
+	tpuslo_u64 value;  /* signal-native unit, see enum comments */
+	tpuslo_u64 aux;    /* signal-scoped payload */
+	tpuslo_u32 pid;    /* tgid */
+	tpuslo_u32 tid;
+	tpuslo_u32 saddr4; /* network byte order; 0 when not a conn signal */
+	tpuslo_u32 daddr4;
+	tpuslo_u16 sport;  /* host byte order */
+	tpuslo_u16 dport;
+	tpuslo_u16 signal; /* enum tpuslo_signal_id */
+	tpuslo_u16 flags;  /* TPUSLO_F_* */
+	tpuslo_s16 err;    /* negated errno (or TLS/collective status) */
+	char comm[TPUSLO_COMM_LEN];
+	tpuslo_u16 _pad;   /* keep sizeof == 72 on all targets */
+} __attribute__((packed));
+
+#define TPUSLO_EVENT_BYTES 72
+
+#ifdef __cplusplus
+static_assert(sizeof(struct tpuslo_event) == TPUSLO_EVENT_BYTES,
+	      "tpuslo_event wire size drifted");
+#endif
+
+#endif /* TPUSLO_EVENT_H */

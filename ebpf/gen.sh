@@ -1,0 +1,55 @@
+#!/usr/bin/env bash
+# Build every tpuslo CO-RE probe object.  Requires clang >= 14 and a
+# BTF-enabled kernel (or a vmlinux.h supplied via VMLINUX_H).
+#
+# Role parity with the reference's bpf2go generation step
+# (ebpf/bpf2go/gen.sh dumps vmlinux.h and invokes bpf2go per program);
+# this build emits plain .bpf.o objects consumed by the C++ loader
+# (native/probe_manager.cc) via libbpf — no per-language binding
+# generation is needed.
+set -euo pipefail
+
+cd "$(dirname "$0")"
+OUT="${OUT:-build}"
+VMLINUX_H="${VMLINUX_H:-}"
+CLANG="${CLANG:-clang}"
+
+if ! command -v "$CLANG" >/dev/null 2>&1; then
+    echo "gen.sh: clang not found — eBPF objects can only be built on a" >&2
+    echo "probe-capable host (CI privileged runner / TPU-VM)." >&2
+    exit 2
+fi
+
+mkdir -p "$OUT"
+
+if [[ -z "$VMLINUX_H" ]]; then
+    VMLINUX_H="$OUT/vmlinux.h"
+    if [[ ! -s "$VMLINUX_H" ]]; then
+        if command -v bpftool >/dev/null 2>&1 && [[ -r /sys/kernel/btf/vmlinux ]]; then
+            bpftool btf dump file /sys/kernel/btf/vmlinux format c > "$VMLINUX_H"
+        else
+            echo "gen.sh: no vmlinux.h (need bpftool + /sys/kernel/btf/vmlinux," >&2
+            echo "or set VMLINUX_H=path)." >&2
+            exit 2
+        fi
+    fi
+fi
+
+ARCH="$(uname -m)"
+case "$ARCH" in
+    x86_64) TARGET_ARCH=__TARGET_ARCH_x86 ;;
+    aarch64) TARGET_ARCH=__TARGET_ARCH_arm64 ;;
+    *) echo "gen.sh: unsupported arch $ARCH" >&2; exit 2 ;;
+esac
+
+CFLAGS=(-O2 -g -Wall -Werror -target bpf -D"$TARGET_ARCH"
+        -I"$(dirname "$VMLINUX_H")" -Ic)
+
+built=0
+for src in c/*.bpf.c; do
+    obj="$OUT/$(basename "${src%.c}").o"
+    echo "  CLANG $src -> $obj"
+    "$CLANG" "${CFLAGS[@]}" -c "$src" -o "$obj"
+    built=$((built + 1))
+done
+echo "gen.sh: built $built probe objects in $OUT/"
