@@ -70,7 +70,9 @@ tpuslo_reserve(__u16 signal)
 	ev->signal = signal;
 	ev->flags = 0;
 	ev->err = 0;
-	ev->_pad = 0;
+	ev->_pad[0] = 0;
+	ev->_pad[1] = 0;
+	ev->_pad[2] = 0;
 	bpf_get_current_comm(&ev->comm, sizeof(ev->comm));
 	return ev;
 }

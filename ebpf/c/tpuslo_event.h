@@ -88,7 +88,7 @@ struct tpuslo_event {
 	tpuslo_u16 flags;  /* TPUSLO_F_* */
 	tpuslo_s16 err;    /* negated errno (or TLS/collective status) */
 	char comm[TPUSLO_COMM_LEN];
-	tpuslo_u16 _pad;   /* keep sizeof == 72 on all targets */
+	tpuslo_u16 _pad[3]; /* keep sizeof == 72 on all targets */
 } __attribute__((packed));
 
 #define TPUSLO_EVENT_BYTES 72

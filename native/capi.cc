@@ -1,0 +1,129 @@
+// SPDX-License-Identifier: Apache-2.0
+//
+// capi.cc — flat C ABI over the tpuslo native runtime, consumed by the
+// Python control plane through ctypes (tpuslo/collector/native.py).
+// Everything returns int status codes or opaque handles; the Sample
+// struct layout is mirrored exactly on the Python side.
+
+#include <cstdint>
+#include <cstring>
+
+#include "consumer.h"
+#include "probe_manager.h"
+#include "ring.h"
+
+using tpuslo::Consumer;
+using tpuslo::ProbeManager;
+using tpuslo::Ring;
+using tpuslo::Sample;
+
+extern "C" {
+
+// ---- userspace ring (producer side, tests / fallback emitters) ----
+
+void* tpuslo_ring_create(const char* path, uint64_t capacity) {
+  return Ring::Create(path, capacity);
+}
+
+void* tpuslo_ring_open(const char* path) { return Ring::Open(path); }
+
+int tpuslo_ring_write(void* ring, const void* data, uint32_t len) {
+  if (!ring) return -1;
+  return static_cast<Ring*>(ring)->Write(data, len) ? 0 : -1;
+}
+
+uint64_t tpuslo_ring_dropped(void* ring) {
+  return ring ? static_cast<Ring*>(ring)->dropped() : 0;
+}
+
+void tpuslo_ring_close(void* ring) { delete static_cast<Ring*>(ring); }
+
+// ---- consumer ----
+
+void* tpuslo_consumer_new(void) { return new Consumer(); }
+
+void tpuslo_consumer_free(void* c) { delete static_cast<Consumer*>(c); }
+
+int tpuslo_consumer_add_userspace(void* c, const char* path) {
+  if (!c) return -1;
+  return static_cast<Consumer*>(c)->AddUserspaceRing(path);
+}
+
+int tpuslo_consumer_add_kernel(void* c, int map_fd) {
+  if (!c) return -1;
+  return static_cast<Consumer*>(c)->AddKernelRingbuf(map_fd);
+}
+
+int tpuslo_consumer_poll(void* c, Sample* out, int max, int timeout_ms) {
+  if (!c || !out || max <= 0) return -1;
+  return static_cast<Consumer*>(c)->Poll(out, max, timeout_ms);
+}
+
+void tpuslo_consumer_configure_steal(void* c, uint64_t window_ns,
+                                     int ncpu) {
+  if (c) static_cast<Consumer*>(c)->ConfigureSteal(window_ns, ncpu);
+}
+
+uint64_t tpuslo_consumer_decode_errors(void* c) {
+  return c ? static_cast<Consumer*>(c)->decode_errors() : 0;
+}
+
+// ---- probe manager ----
+
+int tpuslo_pm_available(void) { return ProbeManager::Available() ? 1 : 0; }
+
+void* tpuslo_pm_new(void) { return new ProbeManager(); }
+
+void tpuslo_pm_free(void* pm) { delete static_cast<ProbeManager*>(pm); }
+
+int tpuslo_pm_load(void* pm, const char* name, const char* path) {
+  if (!pm) return -1;
+  return static_cast<ProbeManager*>(pm)->LoadObject(name, path);
+}
+
+int tpuslo_pm_ringbuf_fd(void* pm, const char* object) {
+  if (!pm) return -1;
+  return static_cast<ProbeManager*>(pm)->RingbufFd(object);
+}
+
+int tpuslo_pm_attach_auto(void* pm, const char* object) {
+  if (!pm) return -1;
+  return static_cast<ProbeManager*>(pm)->AttachAuto(object);
+}
+
+int tpuslo_pm_attach_kprobe(void* pm, const char* object,
+                            const char* program, const char* symbol,
+                            int retprobe) {
+  if (!pm) return -1;
+  return static_cast<ProbeManager*>(pm)->AttachKprobe(object, program,
+                                                      symbol, retprobe);
+}
+
+int tpuslo_pm_attach_uprobe(void* pm, const char* object,
+                            const char* program, const char* binary,
+                            uint64_t offset, int retprobe,
+                            uint64_t cookie) {
+  if (!pm) return -1;
+  return static_cast<ProbeManager*>(pm)->AttachUprobe(
+      object, program, binary, offset, retprobe, cookie);
+}
+
+int tpuslo_pm_detach_object(void* pm, const char* object) {
+  if (!pm) return -1;
+  return static_cast<ProbeManager*>(pm)->DetachObject(object);
+}
+
+const char* tpuslo_pm_last_error(void* pm) {
+  static thread_local char buf[256];
+  if (!pm) return "";
+  std::snprintf(buf, sizeof(buf), "%s",
+                static_cast<ProbeManager*>(pm)->last_error().c_str());
+  return buf;
+}
+
+// ---- misc ----
+
+int tpuslo_event_size(void) { return TPUSLO_EVENT_BYTES; }
+int tpuslo_sample_size(void) { return (int)sizeof(Sample); }
+
+}  // extern "C"

@@ -1,0 +1,105 @@
+// SPDX-License-Identifier: Apache-2.0
+#include "consumer.h"
+
+#include <unistd.h>
+
+#include <cstring>
+
+#include "libbpf_dyn.h"
+
+namespace tpuslo {
+
+struct Consumer::KernelRing {
+  ring_buffer* rb = nullptr;
+  Consumer* owner = nullptr;
+
+  ~KernelRing() {
+    const LibBpf* lib = LibBpf::Get();
+    if (rb && lib) lib->ring_buffer_free(rb);
+  }
+};
+
+namespace {
+
+int KernelSampleCb(void* ctx, void* data, size_t size) {
+  auto* consumer = static_cast<Consumer*>(ctx);
+  if (size < TPUSLO_EVENT_BYTES) return 0;
+  tpuslo_event ev;
+  std::memcpy(&ev, data, sizeof(ev));
+  consumer->Enqueue(ev);
+  return 0;
+}
+
+}  // namespace
+
+Consumer::Consumer()
+    : steal_(1000ull * 1000 * 1000,
+             (int)sysconf(_SC_NPROCESSORS_ONLN)) {}
+
+Consumer::~Consumer() = default;
+
+int Consumer::AddUserspaceRing(const std::string& path) {
+  Ring* r = Ring::Open(path);
+  if (!r) return -1;
+  rings_.emplace_back(r);
+  return (int)rings_.size() - 1;
+}
+
+int Consumer::AddKernelRingbuf(int map_fd) {
+  const LibBpf* lib = LibBpf::Get();
+  if (!lib) return -1;
+  auto kr = std::make_unique<KernelRing>();
+  kr->owner = this;
+  kr->rb = lib->ring_buffer_new(map_fd, KernelSampleCb, this, nullptr);
+  if (!kr->rb) return -1;
+  kernel_rings_.push_back(std::move(kr));
+  return (int)kernel_rings_.size() - 1;
+}
+
+void Consumer::Enqueue(const tpuslo_event& ev) {
+  Sample s;
+  if (DecodeEvent(ev, &steal_, &s)) {
+    queue_.push_back(s);
+  } else if (ev.signal != TPUSLO_SIG_CPU_STEAL) {
+    decode_errors_++;
+  }
+}
+
+int Consumer::Poll(Sample* out, int max, int timeout_ms) {
+  // Drain userspace rings fully (they are bounded and non-blocking).
+  uint8_t buf[256];
+  for (auto& ring : rings_) {
+    for (;;) {
+      int n = ring->Read(buf, sizeof(buf));
+      if (n <= 0) break;
+      if ((size_t)n < sizeof(tpuslo_event)) {
+        decode_errors_++;
+        continue;
+      }
+      tpuslo_event ev;
+      std::memcpy(&ev, buf, sizeof(ev));
+      Enqueue(ev);
+    }
+  }
+  // Kernel rings deliver through KernelSampleCb into queue_.
+  const LibBpf* lib = LibBpf::Get();
+  if (lib) {
+    for (auto& kr : kernel_rings_) {
+      lib->ring_buffer_poll(kr->rb, timeout_ms);
+    }
+  }
+
+  int produced = 0;
+  while (produced < max && !queue_.empty()) {
+    out[produced++] = queue_.front();
+    queue_.pop_front();
+  }
+  return produced;
+}
+
+void Consumer::ConfigureSteal(uint64_t window_ns, int ncpu) {
+  steal_.set_window_ns(window_ns);
+  steal_.set_ncpu(ncpu);
+}
+
+}  // namespace tpuslo

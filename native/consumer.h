@@ -1,0 +1,62 @@
+// SPDX-License-Identifier: Apache-2.0
+//
+// consumer.h — multiplexing event consumer: drains any number of
+// userspace rings (ring.h) and kernel BPF ring buffers (via libbpf,
+// loaded lazily with dlopen so unprivileged hosts need no libbpf) into
+// one stream of normalized Samples.
+//
+// Functional counterpart of the reference's RingBufConsumer
+// (pkg/collector/ringbuf.go:56-150: per-reader goroutines feeding one
+// channel); this design is poll-based instead of thread-per-reader —
+// the Python agent drives Poll() from its single loop, which keeps the
+// overhead-guard accounting honest (no hidden consumer threads).
+
+#pragma once
+
+#include <cstdint>
+#include <deque>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "decode.h"
+#include "ring.h"
+
+namespace tpuslo {
+
+class Consumer {
+ public:
+  Consumer();
+  ~Consumer();
+
+  // Attach a userspace ring by path. Returns reader index or -1.
+  int AddUserspaceRing(const std::string& path);
+
+  // Attach a kernel BPF ring buffer by map fd (from ProbeManager).
+  // Returns reader index, or -1 when libbpf is unavailable.
+  int AddKernelRingbuf(int map_fd);
+
+  // Drain up to `max` normalized samples into `out`.  Non-blocking
+  // for userspace rings; kernel rings are polled with `timeout_ms`
+  // (0 = do not block).  Returns the number of samples written.
+  int Poll(Sample* out, int max, int timeout_ms);
+
+  // cpu-steal aggregation knobs (see StealAggregator).
+  void ConfigureSteal(uint64_t window_ns, int ncpu);
+
+  uint64_t decode_errors() const { return decode_errors_; }
+
+  // Feed one raw wire event (kernel ringbuf callback / tests).
+  void Enqueue(const tpuslo_event& ev);
+
+ private:
+  struct KernelRing;
+
+  std::vector<std::unique_ptr<Ring>> rings_;
+  std::vector<std::unique_ptr<KernelRing>> kernel_rings_;
+  std::deque<Sample> queue_;
+  StealAggregator steal_;
+  uint64_t decode_errors_ = 0;
+};
+
+}  // namespace tpuslo

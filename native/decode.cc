@@ -1,0 +1,145 @@
+// SPDX-License-Identifier: Apache-2.0
+#include "decode.h"
+
+#include <arpa/inet.h>
+
+#include <cstdio>
+#include <cstring>
+
+namespace tpuslo {
+
+namespace {
+
+constexpr double kNsPerMs = 1e6;
+
+struct SignalInfo {
+  const char* name;
+  const char* unit;
+  bool ns_value;  // value is ns -> convert to ms
+};
+
+SignalInfo InfoFor(uint16_t id, int16_t err) {
+  switch (id) {
+    case TPUSLO_SIG_DNS_LATENCY:
+      return {"dns_latency_ms", "ms", true};
+    case TPUSLO_SIG_TCP_RETRANSMIT:
+      return {"tcp_retransmits_total", "count", false};
+    case TPUSLO_SIG_RUNQ_DELAY:
+      return {"runqueue_delay_ms", "ms", true};
+    case TPUSLO_SIG_CONNECT_LATENCY:
+      // Failed connects surface as the error-counter signal; the
+      // latency of a failed attempt is not a service latency.
+      if (err < 0) return {"connect_errors_total", "count", false};
+      return {"connect_latency_ms", "ms", true};
+    case TPUSLO_SIG_TLS_HANDSHAKE:
+      if (err != 0) return {"tls_handshake_fail_total", "count", false};
+      return {"tls_handshake_ms", "ms", true};
+    case TPUSLO_SIG_CPU_STEAL:
+      return {"cpu_steal_pct", "pct", false};
+    case TPUSLO_SIG_MEM_RECLAIM:
+      return {"mem_reclaim_latency_ms", "ms", true};
+    case TPUSLO_SIG_DISK_IO:
+      return {"disk_io_latency_ms", "ms", true};
+    case TPUSLO_SIG_SYSCALL_LATENCY:
+      return {"syscall_latency_ms", "ms", true};
+    case TPUSLO_SIG_XLA_COMPILE:
+      return {"xla_compile_ms", "ms", true};
+    case TPUSLO_SIG_HBM_ALLOC_STALL:
+      return {"hbm_alloc_stall_ms", "ms", true};
+    case TPUSLO_SIG_HBM_UTILIZATION:
+      return {"hbm_utilization_pct", "pct", false};
+    case TPUSLO_SIG_ICI_LINK_RETRY:
+      return {"ici_link_retries_total", "count", false};
+    case TPUSLO_SIG_ICI_COLLECTIVE:
+      return {"ici_collective_latency_ms", "ms", true};
+    case TPUSLO_SIG_HOST_OFFLOAD:
+      return {"host_offload_stall_ms", "ms", true};
+    case TPUSLO_SIG_HELLO:
+      return {"hello_heartbeat_total", "count", false};
+    default:
+      return {"", "", false};
+  }
+}
+
+void FormatConn(const tpuslo_event& ev, char* out, size_t cap) {
+  out[0] = '\0';
+  if (!(ev.flags & TPUSLO_F_CONN)) return;
+  char s[INET_ADDRSTRLEN] = "0.0.0.0";
+  char d[INET_ADDRSTRLEN] = "0.0.0.0";
+  struct in_addr a;
+  a.s_addr = ev.saddr4;
+  inet_ntop(AF_INET, &a, s, sizeof(s));
+  a.s_addr = ev.daddr4;
+  inet_ntop(AF_INET, &a, d, sizeof(d));
+  std::snprintf(out, cap, "%s:%u->%s:%u", s, ev.sport, d, ev.dport);
+}
+
+}  // namespace
+
+const char* SignalName(uint16_t id, int16_t err) {
+  return InfoFor(id, err).name;
+}
+
+const char* SignalUnit(uint16_t id, int16_t err) {
+  return InfoFor(id, err).unit;
+}
+
+bool StealAggregator::Add(const tpuslo_event& ev, Sample* out) {
+  if (window_start_ns_ == 0) window_start_ns_ = ev.ts_ns;
+  bool closed = false;
+  if (ev.ts_ns - window_start_ns_ >= window_ns_ && window_ns_ > 0) {
+    const uint64_t elapsed = ev.ts_ns - window_start_ns_;
+    std::memset(out, 0, sizeof(*out));
+    // Percentage of one-CPU-equivalent time the node spent in
+    // involuntary wait; /proc-based guards use the same convention
+    // (tpuslo/safety/overhead_guard.py).
+    out->value =
+        100.0 * (double)accum_wait_ns_ / ((double)elapsed * (double)ncpu_);
+    out->ts_ns = ev.ts_ns;
+    out->pid = ev.pid;
+    out->tid = ev.tid;
+    std::snprintf(out->signal, sizeof(out->signal), "%s",
+                  "cpu_steal_pct");
+    std::snprintf(out->unit, sizeof(out->unit), "%s", "pct");
+    std::memcpy(out->comm, ev.comm, TPUSLO_COMM_LEN);
+    closed = true;
+    window_start_ns_ = ev.ts_ns;
+    accum_wait_ns_ = 0;
+  }
+  accum_wait_ns_ += ev.value;
+  return closed;
+}
+
+bool DecodeEvent(const tpuslo_event& ev, StealAggregator* steal,
+                 Sample* out) {
+  if (ev.signal == TPUSLO_SIG_CPU_STEAL && steal != nullptr) {
+    return steal->Add(ev, out);
+  }
+  const SignalInfo info = InfoFor(ev.signal, ev.err);
+  if (info.name[0] == '\0') return false;
+
+  std::memset(out, 0, sizeof(*out));
+  out->ts_ns = ev.ts_ns;
+  out->aux = ev.aux;
+  out->pid = ev.pid;
+  out->tid = ev.tid;
+  out->err = ev.err;
+  out->flags = ev.flags;
+  if (ev.signal == TPUSLO_SIG_HBM_UTILIZATION) {
+    out->value = (double)ev.value / 100.0;  // basis points -> pct
+  } else if (info.ns_value) {
+    out->value = (double)ev.value / kNsPerMs;
+  } else if ((ev.signal == TPUSLO_SIG_CONNECT_LATENCY && ev.err < 0) ||
+             (ev.signal == TPUSLO_SIG_TLS_HANDSHAKE && ev.err != 0)) {
+    out->value = 1.0;  // one failure per event, whatever the latency was
+  } else {
+    out->value = (double)ev.value;
+  }
+  std::snprintf(out->signal, sizeof(out->signal), "%s", info.name);
+  std::snprintf(out->unit, sizeof(out->unit), "%s", info.unit);
+  FormatConn(ev, out->conn_tuple, sizeof(out->conn_tuple));
+  std::memcpy(out->comm, ev.comm, TPUSLO_COMM_LEN);
+  return true;
+}
+
+}  // namespace tpuslo

@@ -1,0 +1,71 @@
+// SPDX-License-Identifier: Apache-2.0
+//
+// decode.h — normalize raw `struct tpuslo_event` wire records into
+// flat, ctypes-friendly samples with schema units.
+//
+// This is the single place where units change: probes emit native
+// units (ns / count / basis points, see ebpf/c/tpuslo_event.h), this
+// layer emits the signal names and units the Python schema layer
+// (tpuslo/signals/constants.py) expects.  It also owns the stateful
+// cpu-steal aggregation: the kernel emits raw involuntary-wait ns and
+// the reference documented-but-never-implemented the percentage
+// aggregation in its consumer (pkg/collector/ringbuf.go:211-215); here
+// StealAggregator folds wait-ns over a sliding window into
+// cpu_steal_pct samples.
+
+#pragma once
+
+#include <cstdint>
+
+#include "../ebpf/c/tpuslo_event.h"
+
+namespace tpuslo {
+
+// Flat normalized sample, mirrored by ctypes in
+// tpuslo/collector/native.py — keep the two in sync.
+struct Sample {
+  double value;          // in `unit`
+  uint64_t ts_ns;
+  uint64_t aux;
+  uint32_t pid;
+  uint32_t tid;
+  int32_t err;
+  uint32_t flags;
+  char signal[40];       // python signal name, NUL-terminated
+  char unit[8];          // "ms" | "count" | "pct"
+  char conn_tuple[64];   // "saddr:sport->daddr:dport" or ""
+  char comm[TPUSLO_COMM_LEN];
+};
+
+// Windowed involuntary-wait -> percentage aggregation.
+class StealAggregator {
+ public:
+  StealAggregator(uint64_t window_ns, int ncpu)
+      : window_ns_(window_ns), ncpu_(ncpu < 1 ? 1 : ncpu) {}
+
+  // Feed one raw steal event.  Returns true and fills `out` when a
+  // window closed (out.value = percentage of one-CPU-equivalent time).
+  bool Add(const tpuslo_event& ev, Sample* out);
+
+  void set_window_ns(uint64_t w) { window_ns_ = w; }
+  void set_ncpu(int n) { ncpu_ = n < 1 ? 1 : n; }
+
+ private:
+  uint64_t window_ns_;
+  int ncpu_;
+  uint64_t window_start_ns_ = 0;
+  uint64_t accum_wait_ns_ = 0;
+};
+
+// Decode one wire event into a normalized sample.  Stateless except
+// for cpu-steal events, which are folded into `steal` and produce a
+// sample only at window boundaries.  Returns false when the event is
+// absorbed (steal accumulation) or unknown.
+bool DecodeEvent(const tpuslo_event& ev, StealAggregator* steal,
+                 Sample* out);
+
+// Exposed for tests: signal id -> python name / unit ("" if unknown).
+const char* SignalName(uint16_t id, int16_t err);
+const char* SignalUnit(uint16_t id, int16_t err);
+
+}  // namespace tpuslo

@@ -1,0 +1,52 @@
+// SPDX-License-Identifier: Apache-2.0
+#include "libbpf_dyn.h"
+
+#include <dlfcn.h>
+
+#include <mutex>
+
+namespace tpuslo {
+
+namespace {
+
+LibBpf* TryLoad() {
+  void* h = dlopen("libbpf.so.1", RTLD_NOW | RTLD_GLOBAL);
+  if (!h) h = dlopen("libbpf.so", RTLD_NOW | RTLD_GLOBAL);
+  if (!h) return nullptr;
+
+  auto* lib = new LibBpf();
+  auto resolve = [&](const char* name) { return dlsym(h, name); };
+#define BIND(field, sym)                                       \
+  lib->field = reinterpret_cast<decltype(lib->field)>(resolve(sym)); \
+  if (!lib->field) {                                           \
+    delete lib;                                                \
+    return nullptr;                                            \
+  }
+  BIND(object_open_file, "bpf_object__open_file");
+  BIND(object_load, "bpf_object__load");
+  BIND(object_close, "bpf_object__close");
+  BIND(object_next_program, "bpf_object__next_program");
+  BIND(program_name, "bpf_program__name");
+  BIND(program_attach, "bpf_program__attach");
+  BIND(program_attach_uprobe_opts, "bpf_program__attach_uprobe_opts");
+  BIND(program_attach_kprobe_opts, "bpf_program__attach_kprobe_opts");
+  BIND(link_destroy, "bpf_link__destroy");
+  BIND(object_find_map, "bpf_object__find_map_by_name");
+  BIND(map_fd, "bpf_map__fd");
+  BIND(ring_buffer_new, "ring_buffer__new");
+  BIND(ring_buffer_poll, "ring_buffer__poll");
+  BIND(ring_buffer_free, "ring_buffer__free");
+#undef BIND
+  return lib;
+}
+
+}  // namespace
+
+const LibBpf* LibBpf::Get() {
+  static std::once_flag once;
+  static LibBpf* instance = nullptr;
+  std::call_once(once, [] { instance = TryLoad(); });
+  return instance;
+}
+
+}  // namespace tpuslo

@@ -1,0 +1,74 @@
+// SPDX-License-Identifier: Apache-2.0
+//
+// libbpf_dyn.h — lazy dlopen binding to the subset of libbpf 1.x the
+// tpuslo runtime needs.  libbpf is deliberately NOT a link-time
+// dependency: the synthetic pipeline and all unit tests must run on
+// hosts without it (SURVEY.md §4's "testable without privileges"
+// requirement), and probe loading is only attempted on capable hosts.
+//
+// The opts structs are local mirrors of libbpf's — safe because
+// libbpf's opts ABI is forward-compatible by contract (leading `sz`
+// field gates which members the library reads).
+
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+namespace tpuslo {
+
+struct bpf_object;
+struct bpf_program;
+struct bpf_map;
+struct bpf_link;
+struct ring_buffer;
+
+typedef int (*ring_buffer_sample_fn)(void* ctx, void* data, size_t size);
+
+struct uprobe_opts {
+  size_t sz;
+  size_t ref_ctr_offset;
+  uint64_t bpf_cookie;
+  bool retprobe;
+  const char* func_name;
+  size_t : 0;
+};
+
+struct kprobe_opts {
+  size_t sz;
+  uint64_t bpf_cookie;
+  size_t offset;
+  bool retprobe;
+  int attach_mode;
+  size_t : 0;
+};
+
+struct LibBpf {
+  // Returns the process-wide binding, or nullptr when libbpf.so.1 is
+  // not present.
+  static const LibBpf* Get();
+
+  bpf_object* (*object_open_file)(const char* path, const void* opts);
+  int (*object_load)(bpf_object* obj);
+  void (*object_close)(bpf_object* obj);
+  bpf_program* (*object_next_program)(const bpf_object* obj,
+                                      bpf_program* prog);
+  const char* (*program_name)(const bpf_program* prog);
+  bpf_link* (*program_attach)(const bpf_program* prog);
+  bpf_link* (*program_attach_uprobe_opts)(const bpf_program* prog, int pid,
+                                          const char* binary_path,
+                                          size_t func_offset,
+                                          const uprobe_opts* opts);
+  bpf_link* (*program_attach_kprobe_opts)(const bpf_program* prog,
+                                          const char* func_name,
+                                          const kprobe_opts* opts);
+  int (*link_destroy)(bpf_link* link);
+  bpf_map* (*object_find_map)(const bpf_object* obj, const char* name);
+  int (*map_fd)(const bpf_map* map);
+  ring_buffer* (*ring_buffer_new)(int map_fd, ring_buffer_sample_fn fn,
+                                  void* ctx, const void* opts);
+  int (*ring_buffer_poll)(ring_buffer* rb, int timeout_ms);
+  void (*ring_buffer_free)(ring_buffer* rb);
+};
+
+}  // namespace tpuslo

@@ -1,0 +1,146 @@
+// SPDX-License-Identifier: Apache-2.0
+#include "probe_manager.h"
+
+#include <cerrno>
+#include <cstring>
+
+namespace tpuslo {
+
+ProbeManager::~ProbeManager() { DetachAll(); }
+
+bool ProbeManager::Available() { return LibBpf::Get() != nullptr; }
+
+int ProbeManager::LoadObject(const std::string& name,
+                             const std::string& path) {
+  const LibBpf* lib = LibBpf::Get();
+  if (!lib) {
+    last_error_ = "libbpf unavailable";
+    return -ENOSYS;
+  }
+  if (objects_.count(name)) {
+    last_error_ = "object already loaded: " + name;
+    return -EEXIST;
+  }
+  bpf_object* obj = lib->object_open_file(path.c_str(), nullptr);
+  if (!obj) {
+    last_error_ = "open failed: " + path;
+    return -EINVAL;
+  }
+  int rc = lib->object_load(obj);
+  if (rc != 0) {
+    last_error_ = "load failed: " + path;
+    lib->object_close(obj);
+    return rc;
+  }
+  objects_[name].obj = obj;
+  return 0;
+}
+
+int ProbeManager::RingbufFd(const std::string& object) {
+  const LibBpf* lib = LibBpf::Get();
+  auto it = objects_.find(object);
+  if (!lib || it == objects_.end()) return -1;
+  bpf_map* map = lib->object_find_map(it->second.obj, "tpuslo_events");
+  if (!map) return -1;
+  return lib->map_fd(map);
+}
+
+bpf_program* ProbeManager::FindProgram(const std::string& object,
+                                       const std::string& program) {
+  const LibBpf* lib = LibBpf::Get();
+  auto it = objects_.find(object);
+  if (!lib || it == objects_.end()) return nullptr;
+  bpf_program* prog = nullptr;
+  while ((prog = lib->object_next_program(it->second.obj, prog))) {
+    if (program == lib->program_name(prog)) return prog;
+  }
+  return nullptr;
+}
+
+int ProbeManager::AttachAuto(const std::string& object) {
+  const LibBpf* lib = LibBpf::Get();
+  auto it = objects_.find(object);
+  if (!lib || it == objects_.end()) {
+    last_error_ = "object not loaded: " + object;
+    return -ENOENT;
+  }
+  int attached = 0;
+  bpf_program* prog = nullptr;
+  while ((prog = lib->object_next_program(it->second.obj, prog))) {
+    bpf_link* link = lib->program_attach(prog);
+    if (!link) {
+      // Generic SEC("uprobe")/SEC("kprobe") programs have no attach
+      // target until AttachUprobe/AttachKprobe binds them — skipping
+      // here is expected, not an error.
+      continue;
+    }
+    it->second.links.push_back(link);
+    attached++;
+  }
+  return attached;
+}
+
+int ProbeManager::AttachKprobe(const std::string& object,
+                               const std::string& program,
+                               const std::string& symbol, bool retprobe) {
+  const LibBpf* lib = LibBpf::Get();
+  bpf_program* prog = FindProgram(object, program);
+  if (!lib || !prog) {
+    last_error_ = "program not found: " + object + "/" + program;
+    return -ENOENT;
+  }
+  kprobe_opts opts{};
+  opts.sz = sizeof(opts);
+  opts.retprobe = retprobe;
+  bpf_link* link =
+      lib->program_attach_kprobe_opts(prog, symbol.c_str(), &opts);
+  if (!link) {
+    last_error_ = "kprobe attach failed: " + symbol;
+    return -EINVAL;
+  }
+  objects_[object].links.push_back(link);
+  return 0;
+}
+
+int ProbeManager::AttachUprobe(const std::string& object,
+                               const std::string& program,
+                               const std::string& binary_path,
+                               uint64_t func_offset, bool retprobe,
+                               uint64_t cookie) {
+  const LibBpf* lib = LibBpf::Get();
+  bpf_program* prog = FindProgram(object, program);
+  if (!lib || !prog) {
+    last_error_ = "program not found: " + object + "/" + program;
+    return -ENOENT;
+  }
+  uprobe_opts opts{};
+  opts.sz = sizeof(opts);
+  opts.retprobe = retprobe;
+  opts.bpf_cookie = cookie;
+  bpf_link* link = lib->program_attach_uprobe_opts(
+      prog, /*pid=*/-1, binary_path.c_str(), func_offset, &opts);
+  if (!link) {
+    last_error_ = "uprobe attach failed: " + binary_path;
+    return -EINVAL;
+  }
+  objects_[object].links.push_back(link);
+  return 0;
+}
+
+int ProbeManager::DetachObject(const std::string& object) {
+  const LibBpf* lib = LibBpf::Get();
+  auto it = objects_.find(object);
+  if (!lib || it == objects_.end()) return -ENOENT;
+  for (bpf_link* link : it->second.links) lib->link_destroy(link);
+  int n = (int)it->second.links.size();
+  it->second.links.clear();
+  lib->object_close(it->second.obj);
+  objects_.erase(it);
+  return n;
+}
+
+void ProbeManager::DetachAll() {
+  while (!objects_.empty()) DetachObject(objects_.begin()->first);
+}
+
+}  // namespace tpuslo

@@ -1,0 +1,73 @@
+// SPDX-License-Identifier: Apache-2.0
+//
+// probe_manager.h — probe lifecycle: open/load CO-RE objects, attach
+// programs (auto by section, kprobe by resolved symbol, uprobe by
+// binary+offset with attach cookie), detach individually so the
+// overhead governor can shed probes in cost order.
+//
+// Functional counterpart of the reference's ProbeManager
+// (pkg/collector/probe_manager.go:25-185: register/attach-all/
+// overhead-driven disable), rebuilt around libbpf-C instead of
+// cilium/ebpf-Go, with two additions the TPU surface needs: attach
+// cookies (signal dispatch for the generic libtpu uprobes) and
+// symbol resolution hooks (kallsyms / ELF dynsym scans live in the
+// Python control plane; this layer takes resolved addresses).
+
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "libbpf_dyn.h"
+
+namespace tpuslo {
+
+class ProbeManager {
+ public:
+  ~ProbeManager();
+
+  // True when libbpf is loadable on this host.
+  static bool Available();
+
+  // Open+load one compiled object.  Returns 0, or a negative errno.
+  int LoadObject(const std::string& name, const std::string& path);
+
+  // Ring buffer map fd of a loaded object (-1 if absent).
+  int RingbufFd(const std::string& object);
+
+  // Attach every program in the object by its section definition
+  // (tracepoints, named kprobes).  Returns #attached or negative.
+  int AttachAuto(const std::string& object);
+
+  // Attach one program to a kernel symbol (accel ioctl surface).
+  int AttachKprobe(const std::string& object, const std::string& program,
+                   const std::string& symbol, bool retprobe);
+
+  // Attach one program to binary_path+offset with a cookie (libtpu /
+  // TLS uprobe surface).
+  int AttachUprobe(const std::string& object, const std::string& program,
+                   const std::string& binary_path, uint64_t func_offset,
+                   bool retprobe, uint64_t cookie);
+
+  // Detach all links of one object (probe shedding), or everything.
+  int DetachObject(const std::string& object);
+  void DetachAll();
+
+  const std::string& last_error() const { return last_error_; }
+
+ private:
+  struct Loaded {
+    bpf_object* obj = nullptr;
+    std::vector<bpf_link*> links;
+  };
+
+  bpf_program* FindProgram(const std::string& object,
+                           const std::string& program);
+
+  std::map<std::string, Loaded> objects_;
+  std::string last_error_;
+};
+
+}  // namespace tpuslo

@@ -1,0 +1,141 @@
+// SPDX-License-Identifier: Apache-2.0
+#include "ring.h"
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstring>
+
+namespace tpuslo {
+
+namespace {
+constexpr uint64_t Align8(uint64_t v) { return (v + 7) & ~7ULL; }
+}  // namespace
+
+Ring* Ring::Create(const std::string& path, uint64_t capacity) {
+  capacity = Align8(capacity < 4096 ? 4096 : capacity);
+  Ring* r = new Ring();
+  if (!r->Map(path, capacity, /*create=*/true)) {
+    delete r;
+    return nullptr;
+  }
+  return r;
+}
+
+Ring* Ring::Open(const std::string& path) {
+  Ring* r = new Ring();
+  if (!r->Map(path, 0, /*create=*/false)) {
+    delete r;
+    return nullptr;
+  }
+  return r;
+}
+
+bool Ring::Map(const std::string& path, uint64_t capacity, bool create) {
+  int flags = create ? (O_RDWR | O_CREAT | O_TRUNC) : O_RDWR;
+  fd_ = ::open(path.c_str(), flags, 0600);
+  if (fd_ < 0) return false;
+
+  if (!create) {
+    Header probe;
+    if (::pread(fd_, &probe, sizeof(probe), 0) != (ssize_t)sizeof(probe) ||
+        probe.magic != kMagic || probe.capacity == 0) {
+      ::close(fd_);
+      fd_ = -1;
+      return false;
+    }
+    capacity = probe.capacity;
+  }
+
+  map_bytes_ = kHeaderBytes + capacity;
+  if (create && ::ftruncate(fd_, (off_t)map_bytes_) != 0) {
+    ::close(fd_);
+    fd_ = -1;
+    return false;
+  }
+  base_ = ::mmap(nullptr, map_bytes_, PROT_READ | PROT_WRITE, MAP_SHARED,
+                 fd_, 0);
+  if (base_ == MAP_FAILED) {
+    base_ = nullptr;
+    ::close(fd_);
+    fd_ = -1;
+    return false;
+  }
+  hdr_ = reinterpret_cast<Header*>(base_);
+  data_ = reinterpret_cast<uint8_t*>(base_) + kHeaderBytes;
+  capacity_ = capacity;
+  if (create) {
+    hdr_->magic = kMagic;
+    hdr_->capacity = capacity;
+    hdr_->head.store(0, std::memory_order_relaxed);
+    hdr_->tail.store(0, std::memory_order_relaxed);
+  }
+  return true;
+}
+
+Ring::~Ring() {
+  if (base_) ::munmap(base_, map_bytes_);
+  if (fd_ >= 0) ::close(fd_);
+}
+
+bool Ring::Write(const void* data, uint32_t len) {
+  const uint64_t need = Align8(sizeof(uint32_t) + len);
+  uint64_t head = hdr_->head.load(std::memory_order_relaxed);
+  const uint64_t tail = hdr_->tail.load(std::memory_order_acquire);
+
+  uint64_t pos = head % capacity_;
+  uint64_t contiguous = capacity_ - pos;
+  uint64_t total = need;
+  // A record never straddles the end: emit a wrap marker and restart
+  // at offset 0 when the tail of the buffer is too small.
+  bool wrap = contiguous < need;
+  if (wrap) total = contiguous + need;
+
+  if (head + total - tail > capacity_) {
+    dropped_++;
+    return false;  // full: drop-newest keeps the consumer's view intact
+  }
+
+  if (wrap) {
+    if (contiguous >= sizeof(uint32_t)) {
+      uint32_t marker = kWrapMarker;
+      std::memcpy(data_ + pos, &marker, sizeof(marker));
+    }
+    head += contiguous;
+    pos = 0;
+  }
+  std::memcpy(data_ + pos, &len, sizeof(len));
+  std::memcpy(data_ + pos + sizeof(uint32_t), data, len);
+  hdr_->head.store(head + need, std::memory_order_release);
+  return true;
+}
+
+int Ring::Read(void* out, uint32_t cap) {
+  uint64_t tail = hdr_->tail.load(std::memory_order_relaxed);
+  const uint64_t head = hdr_->head.load(std::memory_order_acquire);
+  if (tail == head) return 0;
+
+  uint64_t pos = tail % capacity_;
+  uint64_t contiguous = capacity_ - pos;
+  if (contiguous < sizeof(uint32_t)) {
+    hdr_->tail.store(tail + contiguous, std::memory_order_release);
+    return Read(out, cap);
+  }
+  uint32_t len;
+  std::memcpy(&len, data_ + pos, sizeof(len));
+  if (len == kWrapMarker) {
+    hdr_->tail.store(tail + contiguous, std::memory_order_release);
+    return Read(out, cap);
+  }
+  const uint64_t need = Align8(sizeof(uint32_t) + len);
+  if (len == 0 || need > capacity_ || contiguous < need) return -1;
+
+  uint32_t copy = len < cap ? len : cap;
+  std::memcpy(out, data_ + pos + sizeof(uint32_t), copy);
+  hdr_->tail.store(tail + need, std::memory_order_release);
+  return (int)len;
+}
+
+}  // namespace tpuslo

@@ -1,0 +1,73 @@
+// SPDX-License-Identifier: Apache-2.0
+//
+// ring.h — single-producer/single-consumer shared-memory ring buffer
+// with kernel-ringbuf-compatible record framing.
+//
+// Two transports feed the tpuslo consumer:
+//   1. the kernel BPF ring buffer (privileged hosts, via libbpf), and
+//   2. this userspace ring (tests, BCC fallback, synthetic injectors).
+// Both deliver length-framed records of `struct tpuslo_event`, so the
+// decode path (decode.cc) is identical and the whole consumer stack is
+// unit-testable without privileges — the property the reference's
+// design derives from hand-packed byte buffers in its ringbuf tests
+// (SURVEY.md §4 "fake/fixture seams"), promoted here to a real
+// file-backed transport.
+//
+// Layout of the backing file:
+//   [header page: magic, capacity, head, tail]
+//   [data: capacity bytes, 8-byte-aligned records of u32 len + payload]
+// A len of kWrapMarker means "skip to start of data".
+
+#pragma once
+
+#include <atomic>
+#include <cstddef>
+#include <cstdint>
+#include <string>
+
+namespace tpuslo {
+
+class Ring {
+ public:
+  static constexpr uint64_t kMagic = 0x7470752d736c6f31ULL;  // "tpu-slo1"
+  static constexpr uint32_t kWrapMarker = 0xffffffffu;
+  static constexpr size_t kHeaderBytes = 4096;
+
+  // Create (truncating) a ring of `capacity` data bytes at `path`.
+  static Ring* Create(const std::string& path, uint64_t capacity);
+  // Attach to an existing ring.
+  static Ring* Open(const std::string& path);
+
+  ~Ring();
+
+  // Producer side: append one record. Returns false when full.
+  bool Write(const void* data, uint32_t len);
+
+  // Consumer side: copy the next record into `out` (up to `cap` bytes).
+  // Returns the record length, 0 when empty, or -1 on corruption.
+  int Read(void* out, uint32_t cap);
+
+  uint64_t capacity() const { return capacity_; }
+  uint64_t dropped() const { return dropped_; }
+
+ private:
+  struct Header {
+    uint64_t magic;
+    uint64_t capacity;
+    std::atomic<uint64_t> head;  // producer cursor (monotonic)
+    std::atomic<uint64_t> tail;  // consumer cursor (monotonic)
+  };
+
+  Ring() = default;
+  bool Map(const std::string& path, uint64_t capacity, bool create);
+
+  Header* hdr_ = nullptr;
+  uint8_t* data_ = nullptr;
+  uint64_t capacity_ = 0;
+  uint64_t dropped_ = 0;
+  void* base_ = nullptr;
+  size_t map_bytes_ = 0;
+  int fd_ = -1;
+};
+
+}  // namespace tpuslo

@@ -1,0 +1,90 @@
+"""End-to-end agent test of the real-probe (ring) path.
+
+Drives the full chain — userspace ring producer → native consumer →
+schema envelope → JSONL writer — through the actual agent CLI loop,
+proving the ring path is wired into the agent (the gap the reference
+never closed: SURVEY.md §0).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from tpuslo.collector import native
+
+pytestmark = pytest.mark.skipif(
+    not native.runtime_available(), reason="native runtime not buildable"
+)
+
+
+def test_agent_ring_mode_end_to_end(tmp_path):
+    from tpuslo.cli import agent
+    from tpuslo.collector.ringbuf import RingWriter
+
+    ring_path = str(tmp_path / "agent.buf")
+    out_path = str(tmp_path / "probes.jsonl")
+
+    writer = RingWriter(ring_path)
+
+    def produce():
+        # Give the agent a moment to attach the ring, then emit a mix of
+        # CPU and TPU wire events.
+        time.sleep(0.3)
+        writer.write_event(
+            signal=native.SIG_DNS_LATENCY,
+            value=3_000_000,
+            ts_ns=time.time_ns(),
+            pid=11,
+        )
+        writer.write_event(
+            signal=native.SIG_XLA_COMPILE,
+            value=60_000_000,
+            ts_ns=time.time_ns(),
+            pid=12,
+            aux=99,
+            flags=native.F_TPU,
+        )
+        writer.write_event(
+            signal=native.SIG_ICI_COLLECTIVE,
+            value=4_000_000,
+            ts_ns=time.time_ns(),
+            pid=12,
+            aux=1234,
+            flags=native.F_TPU,
+        )
+
+    producer = threading.Thread(target=produce)
+    producer.start()
+    rc = agent.main(
+        [
+            "--probe-source", "ring",
+            "--ring-path", ring_path,
+            "--event-kind", "probe",
+            "--output", "jsonl",
+            "--jsonl-path", out_path,
+            "--count", "4",
+            "--interval-s", "0.2",
+            "--metrics-port", "0",
+            "--signal-set", "dns_latency_ms,xla_compile_ms,"
+            "ici_collective_latency_ms",
+        ]
+    )
+    producer.join()
+    writer.close()
+    assert rc == 0
+
+    events = [json.loads(line) for line in open(out_path, encoding="utf-8")]
+    by_signal = {e["signal"]: e for e in events}
+    assert "dns_latency_ms" in by_signal
+    assert by_signal["dns_latency_ms"]["value"] == pytest.approx(3.0)
+    assert by_signal["dns_latency_ms"]["pid"] == 11
+    assert "xla_compile_ms" in by_signal
+    assert by_signal["xla_compile_ms"]["value"] == pytest.approx(60.0)
+    assert "ici_collective_latency_ms" in by_signal
+    assert (
+        by_signal["ici_collective_latency_ms"]["tpu"]["launch_id"] == 1234
+    )

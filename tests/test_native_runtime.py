@@ -1,0 +1,251 @@
+"""Native runtime tests: ring transport, decode, aggregation, lifecycle.
+
+These exercise the C++ consumer stack through the same userspace-ring
+transport the BCC fallback and injectors use — the privilege-free seam
+that mirrors the reference's hand-packed ringbuf decode tests
+(pkg/collector/ringbuf_test.go), but through the real native code.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tpuslo.collector import native
+
+pytestmark = pytest.mark.skipif(
+    not native.runtime_available(), reason="native runtime not buildable"
+)
+
+
+@pytest.fixture()
+def ring(tmp_path):
+    from tpuslo.collector.ringbuf import RingBufConsumer, RingWriter
+
+    path = str(tmp_path / "ring.buf")
+    writer = RingWriter(path, capacity=1 << 16)
+    consumer = RingBufConsumer(steal_window_ms=1000, ncpu=1)
+    consumer.add_userspace_ring(path)
+    yield writer, consumer
+    writer.close()
+    consumer.close()
+
+
+def test_sizes_agree():
+    lib = native.load_runtime()
+    assert lib.tpuslo_event_size() == 72
+    import ctypes
+
+    assert ctypes.sizeof(native.WireEvent) == 72
+
+
+def test_latency_event_ns_to_ms(ring):
+    writer, consumer = ring
+    assert writer.write_event(
+        signal=native.SIG_DNS_LATENCY,
+        value=2_500_000,  # 2.5ms in ns
+        ts_ns=1000,
+        pid=42,
+        tid=43,
+        comm=b"resolver",
+    )
+    samples = consumer.poll()
+    assert len(samples) == 1
+    s = samples[0]
+    assert s.signal == "dns_latency_ms"
+    assert s.value == pytest.approx(2.5)
+    assert s.unit == "ms"
+    assert (s.pid, s.tid) == (42, 43)
+    assert s.comm == "resolver"
+
+
+def test_conn_tuple_formatting(ring):
+    writer, consumer = ring
+    import socket
+    import struct
+
+    saddr = struct.unpack("<I", socket.inet_aton("10.0.0.1"))[0]
+    daddr = struct.unpack("<I", socket.inet_aton("10.0.0.53"))[0]
+    writer.write_event(
+        signal=native.SIG_DNS_LATENCY,
+        value=1_000_000,
+        saddr4=saddr,
+        daddr4=daddr,
+        sport=42424,
+        dport=53,
+        flags=native.F_CONN,
+    )
+    (s,) = consumer.poll()
+    assert s.conn_tuple == "10.0.0.1:42424->10.0.0.53:53"
+
+
+def test_connect_error_becomes_counter(ring):
+    writer, consumer = ring
+    writer.write_event(
+        signal=native.SIG_CONNECT_LATENCY,
+        value=5_000_000,
+        err=-111,  # ECONNREFUSED
+        flags=native.F_ERROR,
+    )
+    (s,) = consumer.poll()
+    assert s.signal == "connect_errors_total"
+    assert s.value == 1.0
+    assert s.unit == "count"
+    assert s.err == -111
+
+
+def test_tls_failure_becomes_counter(ring):
+    writer, consumer = ring
+    writer.write_event(signal=native.SIG_TLS_HANDSHAKE, value=900_000, err=1)
+    (s,) = consumer.poll()
+    assert s.signal == "tls_handshake_fail_total"
+    assert s.value == 1.0
+
+
+def test_cpu_steal_window_aggregation(ring):
+    writer, consumer = ring
+    # 100ms of involuntary wait spread over a 1s window on 1 CPU -> 10%.
+    base = 1_000_000_000
+    for i in range(10):
+        writer.write_event(
+            signal=native.SIG_CPU_STEAL,
+            value=10_000_000,  # 10ms each
+            ts_ns=base + i * 100_000_000,
+        )
+    # Window-closing event (past 1s since first).
+    writer.write_event(
+        signal=native.SIG_CPU_STEAL, value=0, ts_ns=base + 1_100_000_000
+    )
+    samples = [s for s in consumer.poll() if s.signal == "cpu_steal_pct"]
+    assert len(samples) == 1
+    assert samples[0].value == pytest.approx(100.0 / 1100.0 * 100, rel=0.01)
+    assert samples[0].unit == "pct"
+
+
+def test_hbm_utilization_basis_points(ring):
+    writer, consumer = ring
+    writer.write_event(
+        signal=native.SIG_HBM_UTILIZATION, value=8725, flags=native.F_TPU
+    )
+    (s,) = consumer.poll()
+    assert s.signal == "hbm_utilization_pct"
+    assert s.value == pytest.approx(87.25)
+    assert s.is_tpu
+
+
+def test_tpu_collective_carries_launch_id(ring):
+    writer, consumer = ring
+    writer.write_event(
+        signal=native.SIG_ICI_COLLECTIVE,
+        value=3_000_000,
+        aux=777,
+        flags=native.F_TPU,
+    )
+    (s,) = consumer.poll()
+    assert s.signal == "ici_collective_latency_ms"
+    assert s.aux == 777
+
+
+def test_ring_wraparound_many_events(ring):
+    writer, consumer = ring
+    total = 0
+    for round_ in range(5):
+        for i in range(300):
+            assert writer.write_event(
+                signal=native.SIG_RUNQ_DELAY, value=1_000_000, ts_ns=i
+            )
+            total += 1
+        drained = 0
+        while True:
+            batch = consumer.poll()
+            if not batch:
+                break
+            drained += len(batch)
+        assert drained == 300
+    assert writer.dropped == 0
+    assert consumer.decode_errors == 0
+
+
+def test_ring_backpressure_drops_newest(tmp_path):
+    from tpuslo.collector.ringbuf import RingWriter
+
+    writer = RingWriter(str(tmp_path / "tiny.buf"), capacity=4096)
+    wrote = 0
+    for _ in range(200):
+        if writer.write_event(signal=native.SIG_RUNQ_DELAY, value=1):
+            wrote += 1
+    assert wrote < 200
+    assert writer.dropped == 200 - wrote
+    writer.close()
+
+
+def test_unknown_signal_counts_decode_error(ring):
+    writer, consumer = ring
+    writer.write_event(signal=200, value=1)
+    assert consumer.poll() == []
+    assert consumer.decode_errors == 1
+
+
+def test_to_probe_event_bridges_schema(ring):
+    from tpuslo.cli.common import validate_probe
+    from tpuslo.collector.ringbuf import to_probe_event
+    from tpuslo.signals.metadata import Metadata
+
+    writer, consumer = ring
+    writer.write_event(
+        signal=native.SIG_XLA_COMPILE,
+        value=45_000_000,
+        ts_ns=1_700_000_000_000_000_000,
+        pid=7,
+        aux=12345,
+        flags=native.F_TPU,
+    )
+    (s,) = consumer.poll()
+    meta = Metadata(
+        node="tpu-vm-0", namespace="llm", pod="serve-0", container="serve",
+        pid=1, tid=1, tpu_chip="accel0", slice_id="slice-a", host_index=0,
+        xla_program_id="prog-1",
+    )
+    event = to_probe_event(s, meta)
+    assert event is not None
+    assert event.signal == "xla_compile_ms"
+    assert event.value == pytest.approx(45.0)
+    assert event.pid == 7  # sample pid wins over template
+    assert event.tpu is not None and event.tpu.chip == "accel0"
+    assert validate_probe(event)
+
+
+def test_hello_heartbeat_roundtrip(tmp_path):
+    from tpuslo.collector.hello_tracer import HelloTracer
+    from tpuslo.collector.ringbuf import RingBufConsumer
+
+    path = str(tmp_path / "hello.buf")
+    tracer = HelloTracer(path, interval_s=60.0)
+    consumer = RingBufConsumer()
+    try:
+        assert tracer.beat_once()
+        assert tracer.beat_once()
+        consumer.add_userspace_ring(path)
+        samples = consumer.poll()
+        assert [s.value for s in samples] == [1.0, 2.0]
+        assert all(s.signal == "hello_heartbeat_total" for s in samples)
+    finally:
+        tracer.stop()
+        consumer.close()
+
+
+def test_bcc_fallback_forwards_stub_samples(tmp_path):
+    from tpuslo.collector.bcc_fallback import BCCFallback
+    from tpuslo.collector.ringbuf import RingBufConsumer
+
+    path = str(tmp_path / "bcc.buf")
+    fallback = BCCFallback(path)
+    consumer = RingBufConsumer()
+    consumer.add_userspace_ring(path)
+    try:
+        forwarded = fallback.run_once()
+        assert forwarded == 2  # dns stub + tcp stub
+        signals = {s.signal for s in consumer.poll()}
+        assert signals == {"dns_latency_ms", "tcp_retransmits_total"}
+    finally:
+        fallback.close()
+        consumer.close()

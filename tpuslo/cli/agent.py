@@ -72,6 +72,17 @@ def build_parser() -> argparse.ArgumentParser:
         choices=["synthetic", "ring"],
         help="ring = consume the native eBPF ring buffer",
     )
+    p.add_argument(
+        "--ring-path",
+        default="",
+        help="extra userspace ring to consume (injectors/fallback); "
+        "ring mode only",
+    )
+    p.add_argument(
+        "--hello",
+        action="store_true",
+        help="emit hello heartbeat events through the ring (e2e evidence)",
+    )
     return p
 
 
@@ -225,14 +236,20 @@ def main(argv: list[str] | None = None) -> int:
                     metrics.set_enabled_signals(generator.enabled_signals())
         metrics.mark_cycle()
 
-    idx = 0
     try:
-        while True:
-            emit_one(idx)
-            idx += 1
-            if args.count and idx >= args.count:
-                break
-            time.sleep(args.interval_s)
+        if args.probe_source == "ring":
+            _run_ring_loop(
+                args, cfg, mode, signal_set, enricher, writers, metrics,
+                limiter, guard,
+            )
+        else:
+            idx = 0
+            while True:
+                emit_one(idx)
+                idx += 1
+                if args.count and idx >= args.count:
+                    break
+                time.sleep(args.interval_s)
     except KeyboardInterrupt:
         pass
     finally:
@@ -241,6 +258,136 @@ def main(argv: list[str] | None = None) -> int:
         if server is not None:
             server.shutdown()
     return 0
+
+
+def _run_ring_loop(
+    args, cfg, mode, signal_set, enricher, writers, metrics, limiter, guard
+) -> None:
+    """The real-probe path: ringbuf → normalize → schema → emit.
+
+    This is the loop the reference scaffolded but never closed (its
+    RingBufConsumer/ProbeManager have no caller outside tests —
+    SURVEY.md §0).  Degradation is graceful and reported: no libbpf or
+    no privileges → the kernel surface is skipped but userspace rings
+    (BCC fallback, injectors, hello tracer, HBM sampler) still flow.
+    """
+    import tempfile
+
+    from tpuslo.collector.hbm_sampler import HBMSampler
+    from tpuslo.collector.hello_tracer import HelloTracer
+    from tpuslo.collector.probe_manager import ProbeManager
+    from tpuslo.collector.ringbuf import RingBufConsumer, to_probe_event
+    from tpuslo.signals import constants as sigconst
+
+    pm = ProbeManager(guard=guard)
+    report = pm.attach_all(signal_set)
+    attached = report.attached_signals
+    print(
+        f"agent: ring mode, {len(attached)}/{len(signal_set)} signals "
+        f"attached ({mode})",
+        file=sys.stderr,
+    )
+    for r in report.results:
+        if not r.attached:
+            print(
+                f"agent:   {r.signal}: {r.status} {r.detail}".rstrip(),
+                file=sys.stderr,
+            )
+    metrics.set_enabled_signals(attached)
+
+    consumer = RingBufConsumer(
+        steal_window_ms=1000,
+        batch=cfg.sampling.burst_limit or 256,
+    )
+    for fd in pm.ringbuf_fds():
+        consumer.add_kernel_ringbuf(fd)
+
+    # Userspace side-channel ring: hello tracer + HBM sampler share it,
+    # plus whatever external producer --ring-path points at.
+    tracer = None
+    sampler = None
+    side_ring = args.ring_path
+    if not side_ring and (args.hello or sigconst.SIGNAL_HBM_UTILIZATION_PCT
+                          in signal_set):
+        side_ring = tempfile.mktemp(prefix="tpuslo-ring-", suffix=".buf")
+    if args.hello and side_ring:
+        tracer = HelloTracer(side_ring, interval_s=5.0)
+        tracer.start()
+    if side_ring and sigconst.SIGNAL_HBM_UTILIZATION_PCT in signal_set:
+        try:
+            sampler = HBMSampler(side_ring)
+        except Exception:  # noqa: BLE001 — sampler is best-effort
+            sampler = None
+    if side_ring:
+        try:
+            consumer.add_userspace_ring(side_ring)
+        except Exception as exc:  # noqa: BLE001
+            print(f"agent: side ring attach failed: {exc}", file=sys.stderr)
+
+    meta_template = Metadata(
+        node=args.node,
+        namespace=args.namespace,
+        pod=f"{args.workload}-agent",
+        container=args.workload,
+        pid=1,
+        tid=1,
+    )
+
+    if args.event_kind == "slo":
+        print(
+            "agent: ring mode emits probe events only "
+            "(SLO events come from the observed workload)",
+            file=sys.stderr,
+        )
+
+    cycles = 0
+    try:
+        while True:
+            if sampler is not None:
+                sampler.sample_once()
+            for sample in consumer.poll(timeout_ms=int(args.interval_s * 500)):
+                event = to_probe_event(sample, meta_template, enricher)
+                if event is None:
+                    if sample.signal == "hello_heartbeat_total":
+                        metrics.mark_cycle()
+                    continue
+                if not limiter.allow():
+                    metrics.dropped.labels(reason="rate_limit").inc()
+                    continue
+                if not validate_probe(event):
+                    metrics.dropped.labels(reason="schema").inc()
+                    continue
+                try:
+                    writers.emit_probe([event])
+                    metrics.observe_probe(event.signal, event.value)
+                except Exception as exc:  # noqa: BLE001
+                    metrics.dropped.labels(reason="emit").inc()
+                    print(f"agent: probe emit failed: {exc}", file=sys.stderr)
+
+            result = guard.evaluate()
+            if result.valid:
+                metrics.cpu_overhead_pct.set(result.cpu_pct)
+                if result.over_budget:
+                    shed = pm.shed_highest_cost()
+                    if shed:
+                        print(
+                            f"agent: overhead {result.cpu_pct:.2f}%, "
+                            f"detached {shed}",
+                            file=sys.stderr,
+                        )
+                        metrics.set_enabled_signals(pm.attached_signals)
+            metrics.mark_cycle()
+            cycles += 1
+            if args.count and cycles >= args.count:
+                break
+            time.sleep(args.interval_s)
+    finally:
+        if tracer is not None:
+            tracer.stop()
+        if sampler is not None:
+            sampler.close()
+        consumer.close()
+        pm.detach_all()
 
 
 if __name__ == "__main__":

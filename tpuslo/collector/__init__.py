@@ -1,8 +1,13 @@
 """L1 collection runtime: synthetic spine + SLO normalization.
 
-The real-probe path (ring-buffer consumer, probe lifecycle manager)
-lives in :mod:`tpuslo.collector.ringbuf` and
-:mod:`tpuslo.collector.probe_manager`.
+The real-probe path (ring-buffer consumer, probe lifecycle manager,
+BCC fallback, hello tracer, HBM sampler) lives in the sibling modules
+:mod:`tpuslo.collector.ringbuf`, :mod:`tpuslo.collector.probe_manager`,
+:mod:`tpuslo.collector.bcc_fallback`,
+:mod:`tpuslo.collector.hello_tracer` and
+:mod:`tpuslo.collector.hbm_sampler`; the ctypes bridge to the native
+C++ runtime is :mod:`tpuslo.collector.native`.  These import lazily so
+the synthetic spine works without a built native library.
 """
 
 from tpuslo.collector.pipeline import (

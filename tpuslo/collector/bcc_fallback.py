@@ -1,0 +1,83 @@
+"""BCC degraded-mode fallback runner.
+
+Reference: ``pkg/collector/bcc_fallback.go:14-49`` — a declared-stub
+fallback for pre-BTF kernels covering only DNS latency and TCP
+retransmits.  This implementation actually runs the fallback scripts
+(``ebpf/bcc-fallback/*.py``) and forwards their JSONL samples into a
+userspace ring, so the degraded path exercises the *same* consumer and
+normalization stack as the real-probe path.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import time
+from pathlib import Path
+
+from tpuslo.collector import native
+from tpuslo.collector.ringbuf import RingWriter
+
+_SCRIPT_DIR = Path(__file__).resolve().parent.parent.parent / "ebpf" / "bcc-fallback"
+
+_SIGNAL_IDS = {
+    "dns_latency_ms": native.SIG_DNS_LATENCY,
+    "tcp_retransmits_total": native.SIG_TCP_RETRANSMIT,
+}
+
+
+class BCCFallback:
+    """Runs the BCC scripts and bridges their output into a ring."""
+
+    def __init__(self, ring_path: str, script_dir: str | Path = _SCRIPT_DIR):
+        self._script_dir = Path(script_dir)
+        self._writer = RingWriter(ring_path)
+        self.samples_forwarded = 0
+
+    @property
+    def supported_signals(self) -> list[str]:
+        return list(_SIGNAL_IDS)
+
+    def run_once(self, timeout_s: float = 10.0) -> int:
+        """Invoke each fallback script once, forwarding its samples."""
+        forwarded = 0
+        for script in sorted(self._script_dir.glob("*.py")):
+            try:
+                proc = subprocess.run(
+                    ["python3", str(script)],
+                    capture_output=True,
+                    timeout=timeout_s,
+                    text=True,
+                )
+            except (subprocess.SubprocessError, OSError):
+                continue
+            for line in proc.stdout.splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    sample = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                forwarded += self._forward(sample)
+        self.samples_forwarded += forwarded
+        return forwarded
+
+    def _forward(self, sample: dict) -> int:
+        signal = sample.get("signal", "")
+        sig_id = _SIGNAL_IDS.get(signal)
+        if sig_id is None:
+            return 0
+        if signal.endswith("_ms"):
+            value = int(float(sample.get("value_ms", 0.0)) * 1e6)  # ms→ns
+        else:
+            value = int(sample.get("value", 0))
+        ok = self._writer.write_event(
+            signal=sig_id,
+            value=value,
+            ts_ns=int(sample.get("ts_unix_ns", time.time_ns())),
+        )
+        return 1 if ok else 0
+
+    def close(self) -> None:
+        self._writer.close()

@@ -1,0 +1,81 @@
+"""HBM utilization sampler — a level, not an event.
+
+``hbm_utilization_pct`` deliberately has no probe
+(config/libtpu-symbols.yaml): allocator call sites only see deltas, so
+utilization is sampled from device runtime statistics and injected into
+the same ring the probes feed, keeping one consumer path.
+
+Sources, in priority order:
+1. a JSON stats file exported by the serving runtime
+   (``TPUSLO_HBM_STATS_PATH``; tpuslo.models.serve writes one), with
+   ``bytes_in_use`` / ``bytes_limit`` keys;
+2. live JAX device stats (``device.memory_stats()``) when this process
+   owns a TPU — used by self-observing demo deployments.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from tpuslo.collector import native
+from tpuslo.collector.ringbuf import RingWriter
+
+
+def read_stats(path: str | None = None) -> tuple[int, int] | None:
+    """Return (bytes_in_use, bytes_limit) or None."""
+    stats_path = path or os.environ.get("TPUSLO_HBM_STATS_PATH", "")
+    if stats_path and os.path.exists(stats_path):
+        try:
+            with open(stats_path, "r", encoding="utf-8") as fh:
+                raw = json.load(fh)
+            return int(raw["bytes_in_use"]), int(raw["bytes_limit"])
+        except (OSError, ValueError, KeyError):
+            return None
+    try:
+        import jax
+
+        devices = [d for d in jax.devices() if d.platform == "tpu"]
+        if not devices:
+            return None
+        stats = devices[0].memory_stats() or {}
+        in_use = stats.get("bytes_in_use")
+        limit = stats.get("bytes_limit") or stats.get(
+            "bytes_reservable_limit"
+        )
+        if in_use is None or not limit:
+            return None
+        return int(in_use), int(limit)
+    except Exception:  # noqa: BLE001 — no TPU / no jax is a normal miss
+        return None
+
+
+class HBMSampler:
+    """Periodically writes utilization basis points into a ring."""
+
+    def __init__(self, ring_path: str, stats_path: str | None = None):
+        self._writer = RingWriter(ring_path)
+        self._stats_path = stats_path
+        self.samples = 0
+
+    def sample_once(self) -> bool:
+        stats = read_stats(self._stats_path)
+        if stats is None:
+            return False
+        in_use, limit = stats
+        basis_points = min(int(10000 * in_use / limit), 10000)
+        ok = self._writer.write_event(
+            signal=native.SIG_HBM_UTILIZATION,
+            value=basis_points,
+            ts_ns=time.time_ns(),
+            pid=os.getpid(),
+            flags=native.F_TPU,
+            comm=b"hbm_sampler",
+        )
+        if ok:
+            self.samples += 1
+        return ok
+
+    def close(self) -> None:
+        self._writer.close()

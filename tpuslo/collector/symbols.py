@@ -1,0 +1,192 @@
+"""Attach-point resolution: ELF dynamic symbols and kernel kallsyms.
+
+The TPU probe surface is symbol-unstable (libtpu is a C++ library whose
+mangled exports drift across releases; the accel driver's ioctl handler
+is not an exported stable name).  ``config/libtpu-symbols.yaml`` lists
+candidate patterns per signal; this module resolves them against the
+installed binaries so the loader can attach the *generic* BPF programs
+(``ebpf/c/libtpu_uprobes.bpf.c``) to whatever is actually present.
+
+No reference counterpart — the reference hardcodes its single uprobe
+symbol (SSL_do_handshake) in the Go attach call.  Implemented without
+external ELF libraries: a minimal 64-bit little-endian ELF reader
+covering exactly what uprobe attachment needs (dynsym names and their
+file offsets).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+import struct
+from dataclasses import dataclass
+from pathlib import Path
+
+_ELF_MAGIC = b"\x7fELF"
+_SHT_DYNSYM = 11
+_SHT_SYMTAB = 2
+_PT_LOAD = 1
+_STT_FUNC = 2
+
+
+@dataclass
+class ResolvedSymbol:
+    """One attachable symbol."""
+
+    name: str
+    address: int       # st_value (virtual address in the object)
+    file_offset: int   # uprobe attach offset (file-relative)
+    size: int
+
+
+class ElfError(ValueError):
+    pass
+
+
+def _read_struct(fmt: str, data: bytes, off: int):
+    return struct.unpack_from(fmt, data, off)
+
+
+def elf_function_symbols(path: str | os.PathLike) -> list[ResolvedSymbol]:
+    """All function symbols from .dynsym (and .symtab when present)."""
+    data = Path(path).read_bytes()
+    if data[:4] != _ELF_MAGIC:
+        raise ElfError(f"not an ELF file: {path}")
+    if data[4] != 2 or data[5] != 1:
+        raise ElfError("only 64-bit little-endian ELF is supported")
+
+    (e_shoff,) = _read_struct("<Q", data, 0x28)
+    (e_phoff,) = _read_struct("<Q", data, 0x20)
+    e_phentsize, e_phnum = _read_struct("<HH", data, 0x36)
+    e_shentsize, e_shnum = _read_struct("<HH", data, 0x3A)
+
+    # PT_LOAD segments for vaddr -> file-offset translation.
+    loads: list[tuple[int, int, int]] = []  # (vaddr, offset, filesz)
+    for i in range(e_phnum):
+        base = e_phoff + i * e_phentsize
+        (p_type,) = _read_struct("<I", data, base)
+        if p_type != _PT_LOAD:
+            continue
+        p_offset, p_vaddr = _read_struct("<QQ", data, base + 0x08)
+        (p_filesz,) = _read_struct("<Q", data, base + 0x20)
+        loads.append((p_vaddr, p_offset, p_filesz))
+
+    def to_file_offset(vaddr: int) -> int:
+        for p_vaddr, p_offset, p_filesz in loads:
+            if p_vaddr <= vaddr < p_vaddr + p_filesz:
+                return vaddr - p_vaddr + p_offset
+        return vaddr  # non-PIE objects where vaddr == offset
+
+    out: list[ResolvedSymbol] = []
+    for i in range(e_shnum):
+        base = e_shoff + i * e_shentsize
+        (sh_type,) = _read_struct("<I", data, base + 0x04)
+        if sh_type not in (_SHT_DYNSYM, _SHT_SYMTAB):
+            continue
+        sh_link = _read_struct("<I", data, base + 0x28)[0]
+        sh_offset, sh_size = _read_struct("<QQ", data, base + 0x18)
+        (sh_entsize,) = _read_struct("<Q", data, base + 0x38)
+        if sh_entsize == 0:
+            continue
+        # Associated string table.
+        str_base = e_shoff + sh_link * e_shentsize
+        str_offset, str_size = _read_struct("<QQ", data, str_base + 0x18)
+        strtab = data[str_offset : str_offset + str_size]
+
+        for off in range(sh_offset, sh_offset + sh_size, sh_entsize):
+            st_name, st_info = _read_struct("<IB", data, off)
+            if st_info & 0xF != _STT_FUNC:
+                continue
+            st_value, st_size = _read_struct("<QQ", data, off + 8)
+            if st_value == 0 or st_name == 0:
+                continue
+            end = strtab.find(b"\0", st_name)
+            name = strtab[st_name:end].decode(errors="replace")
+            out.append(
+                ResolvedSymbol(
+                    name=name,
+                    address=st_value,
+                    file_offset=to_file_offset(st_value),
+                    size=st_size,
+                )
+            )
+    return out
+
+
+def resolve_elf_symbol(
+    path: str | os.PathLike, patterns: list[str]
+) -> ResolvedSymbol | None:
+    """First function symbol matching any pattern (case-insensitive
+    substring), in pattern priority order."""
+    try:
+        symbols = elf_function_symbols(path)
+    except (OSError, ElfError):
+        return None
+    lowered = [(s, s.name.lower()) for s in symbols]
+    for pattern in patterns:
+        needle = pattern.lower()
+        for sym, name in lowered:
+            if needle in name:
+                return sym
+    return None
+
+
+def resolve_kernel_symbol(
+    patterns: list[str], kallsyms: str = "/proc/kallsyms"
+) -> str | None:
+    """First kernel text symbol matching any pattern, by priority."""
+    try:
+        with open(kallsyms, "r", encoding="ascii", errors="replace") as fh:
+            lines = fh.readlines()
+    except OSError:
+        return None
+    names = []
+    for line in lines:
+        parts = line.split()
+        if len(parts) >= 3 and parts[1].lower() == "t":
+            names.append(parts[2])
+    for pattern in patterns:
+        needle = pattern.lower()
+        for name in names:
+            if needle in name.lower():
+                return name
+    return None
+
+
+def find_libtpu(paths: list[str] | None = None) -> str | None:
+    """Locate the installed libtpu.so (TPUSLO_LIBTPU_PATH overrides)."""
+    override = os.environ.get("TPUSLO_LIBTPU_PATH")
+    if override and os.path.exists(override):
+        return override
+    for pattern in paths or (
+        "/lib/libtpu.so",
+        "/usr/lib/libtpu.so",
+        "/usr/local/lib/python3*/site-packages/libtpu/libtpu.so",
+    ):
+        for hit in sorted(glob.glob(pattern)):
+            if os.path.exists(hit):
+                return hit
+    return None
+
+
+def find_tls_library() -> str | None:
+    """Locate a TLS library for the handshake uprobe."""
+    candidates = []
+    for pattern in (
+        "/usr/lib/*/libssl.so.3",
+        "/usr/lib/*/libssl.so.1.1",
+        "/lib/*/libssl.so.3",
+        "/usr/lib/*/libgnutls.so.30",
+    ):
+        candidates.extend(sorted(glob.glob(pattern)))
+    return candidates[0] if candidates else None
+
+
+def fingerprint(name: str) -> int:
+    """Stable 48-bit FNV-1a of a symbol name — the cookie payload that
+    lets the consumer report which candidate symbol was attached."""
+    h = 0xCBF29CE484222325
+    for byte in name.encode():
+        h = ((h ^ byte) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h & 0xFFFFFFFFFFFF
