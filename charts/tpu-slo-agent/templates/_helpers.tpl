@@ -1,0 +1,18 @@
+{{- define "tpu-slo-agent.name" -}}
+{{- default .Chart.Name | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "tpu-slo-agent.labels" -}}
+app.kubernetes.io/name: {{ include "tpu-slo-agent.name" . }}
+app.kubernetes.io/part-of: tpu-slo-toolkit
+app.kubernetes.io/version: {{ .Chart.AppVersion | quote }}
+helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version }}
+{{- end -}}
+
+{{- define "tpu-slo-agent.serviceAccountName" -}}
+{{- if .Values.serviceAccount.create -}}
+{{- default (include "tpu-slo-agent.name" .) .Values.serviceAccount.name -}}
+{{- else -}}
+{{- default "default" .Values.serviceAccount.name -}}
+{{- end -}}
+{{- end -}}

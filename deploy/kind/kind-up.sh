@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Bring up the 3-node kind cluster and deploy the full stack in
+# min-capability (synthetic) mode.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+CLUSTER="${CLUSTER:-tpuslo}"
+
+if ! command -v kind >/dev/null; then
+    echo "kind-up: kind not installed" >&2
+    exit 2
+fi
+
+if ! kind get clusters | grep -qx "$CLUSTER"; then
+    kind create cluster --name "$CLUSTER" --config kind-config.yaml
+fi
+
+kubectl apply -k ../k8s/min-capability/
+kubectl apply -k ../observability/
+echo "kind-up: cluster '$CLUSTER' ready; agent in min-capability mode"

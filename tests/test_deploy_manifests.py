@@ -1,0 +1,100 @@
+"""Deploy-layer contract tests: manifests parse, reference each other
+consistently, and keep the privileged/min-capability split honest."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import yaml
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_all(path: Path) -> list[dict]:
+    with open(path, "r", encoding="utf-8") as fh:
+        return [d for d in yaml.safe_load_all(fh) if d]
+
+
+def _all_yaml_files() -> list[Path]:
+    out = []
+    for root in ("deploy", "config"):
+        out.extend(sorted((REPO / root).rglob("*.yaml")))
+    return out
+
+
+def test_every_manifest_parses():
+    files = _all_yaml_files()
+    assert len(files) >= 12
+    for path in files:
+        docs = _load_all(path)
+        assert docs, f"{path} is empty"
+
+
+def test_daemonset_mounts_tpu_surface():
+    (ds,) = _load_all(REPO / "deploy/k8s/daemonset.yaml")
+    spec = ds["spec"]["template"]["spec"]
+    assert spec["hostPID"] is True
+    container = spec["containers"][0]
+    caps = container["securityContext"]["capabilities"]["add"]
+    assert "BPF" in caps
+    mounts = {m["name"]: m for m in container["volumeMounts"]}
+    assert "dev-accel" in mounts           # /dev/accel* probe surface
+    assert "libtpu" in mounts              # uprobe target ELF
+    assert mounts["sys"]["readOnly"] is True
+    volumes = {v["name"] for v in spec["volumes"]}
+    assert {"bpffs", "modules", "config"} <= volumes
+
+
+def test_min_capability_overlay_drops_privileges():
+    (patch,) = _load_all(
+        REPO / "deploy/k8s/min-capability/daemonset-patch.yaml"
+    )
+    spec = patch["spec"]["template"]["spec"]
+    assert spec["hostPID"] is False
+    sc = spec["containers"][0]["securityContext"]
+    assert sc["privileged"] is False
+    assert sc["capabilities"]["drop"] == ["ALL"]
+    (cm,) = _load_all(
+        REPO / "deploy/k8s/min-capability/configmap-patch.yaml"
+    )
+    assert cm["data"]["AGENT_PROBE_SOURCE"] == "synthetic"
+    degraded_cfg = yaml.safe_load(cm["data"]["toolkit.yaml"])
+    assert degraded_cfg["signal_set"] == [
+        "dns_latency_ms", "tcp_retransmits_total",
+    ]
+
+
+def test_default_config_matches_loader_schema():
+    from tpuslo.config import toolkitcfg
+
+    cfg = toolkitcfg.load_config(str(REPO / "config/toolkit.yaml"))
+    assert cfg.safety.max_overhead_pct == 3.0
+    assert "xla_compile_ms" in cfg.signal_set
+    assert len(cfg.signal_set) == 15
+
+
+def test_alert_rules_cover_tpu_fault_domains():
+    docs = _load_all(REPO / "deploy/observability/prometheus-alerts.yaml")
+    rules_yaml = yaml.safe_load(docs[0]["data"]["tpuslo-alerts.yaml"])
+    alerts = [
+        r["alert"]
+        for group in rules_yaml["groups"]
+        for r in group["rules"]
+    ]
+    assert len(alerts) >= 8
+    domains = {
+        r["labels"].get("fault_domain")
+        for group in rules_yaml["groups"]
+        for r in group["rules"]
+        if "fault_domain" in r.get("labels", {})
+    }
+    assert {"network_dns", "tpu_ici", "tpu_hbm"} <= domains
+
+
+def test_helm_values_parse_and_mirror_defaults():
+    values = yaml.safe_load(
+        (REPO / "charts/tpu-slo-agent/values.yaml").read_text()
+    )
+    assert values["agent"]["probeSource"] == "ring"
+    assert len(values["config"]["signalSet"]) == 15
+    assert values["config"]["maxOverheadPct"] == 3.0
