@@ -1,0 +1,173 @@
+#!/usr/bin/env python3
+"""Regenerate the checked-in Grafana dashboards.
+
+Keeping the panel definitions in code (rather than hand-edited JSON)
+keeps the four dashboards structurally consistent; run this after
+editing and commit the JSON outputs.  Panel inventory mirrors the
+reference's four dashboards / 17 panels (dashboards/README.md there)
+re-keyed to the tpuslo metric names and TPU signals.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+OUT = Path(__file__).resolve().parent
+
+
+def panel(
+    title: str,
+    exprs: list[tuple[str, str]],
+    x: int,
+    y: int,
+    w: int = 12,
+    h: int = 8,
+    kind: str = "timeseries",
+    unit: str = "",
+) -> dict:
+    p = {
+        "title": title,
+        "type": kind,
+        "datasource": {"type": "prometheus", "uid": "${datasource}"},
+        "gridPos": {"x": x, "y": y, "w": w, "h": h},
+        "fieldConfig": {
+            "defaults": {"unit": unit or "short"},
+            "overrides": [],
+        },
+        "targets": [
+            {"expr": expr, "legendFormat": legend, "refId": chr(65 + i)}
+            for i, (expr, legend) in enumerate(exprs)
+        ],
+    }
+    return p
+
+
+def dashboard(uid: str, title: str, panels: list[dict]) -> dict:
+    for i, p in enumerate(panels):
+        p["id"] = i + 1
+    return {
+        "uid": uid,
+        "title": title,
+        "tags": ["tpu-slo"],
+        "timezone": "utc",
+        "schemaVersion": 39,
+        "refresh": "30s",
+        "time": {"from": "now-1h", "to": "now"},
+        "templating": {
+            "list": [
+                {
+                    "name": "datasource",
+                    "type": "datasource",
+                    "query": "prometheus",
+                    "current": {"text": "Prometheus", "value": "Prometheus"},
+                }
+            ]
+        },
+        "panels": panels,
+    }
+
+
+TTFT_P95 = (
+    'histogram_quantile(0.95, sum(rate(llm_slo_ttft_ms_bucket[5m])) by (le))'
+)
+
+slo_overview = dashboard(
+    "tpuslo-slo-overview",
+    "TPU SLO / Overview",
+    [
+        panel("TTFT p50/p95/p99 (ms)", [
+            ('histogram_quantile(0.50, sum(rate(llm_slo_ttft_ms_bucket[5m])) by (le))', "p50"),
+            (TTFT_P95, "p95"),
+            ('histogram_quantile(0.99, sum(rate(llm_slo_ttft_ms_bucket[5m])) by (le))', "p99"),
+        ], 0, 0, unit="ms"),
+        panel("Tokens per second (p50)", [
+            ('histogram_quantile(0.50, sum(rate(llm_slo_tokens_per_sec_bucket[5m])) by (le))', "tokens/s p50"),
+        ], 12, 0),
+        panel("Request rate by profile", [
+            ('sum(rate(llm_slo_requests_total[5m])) by (profile)', "{{profile}}"),
+        ], 0, 8, unit="reqps"),
+        panel("Error rate", [
+            ('sum(rate(llm_slo_requests_errors_total[5m])) / sum(rate(llm_slo_requests_total[5m]))', "error ratio"),
+        ], 12, 8, unit="percentunit"),
+        panel("Retrieval latency p95 (ms)", [
+            ('histogram_quantile(0.95, sum(rate(llm_slo_retrieval_latency_ms_bucket[5m])) by (le))', "retrieval p95"),
+        ], 0, 16, unit="ms"),
+    ],
+)
+
+kernel_correlation = dashboard(
+    "tpuslo-kernel-correlation",
+    "TPU SLO / Kernel + TPU Correlation",
+    [
+        panel("Kernel DNS latency p95 (agent, ms)", [
+            ('histogram_quantile(0.95, sum(rate(llm_slo_agent_dns_latency_ms_bucket[5m])) by (le))', "dns p95"),
+        ], 0, 0, unit="ms"),
+        panel("Probe events by signal", [
+            ('sum(rate(llm_slo_agent_probe_events_total[5m])) by (signal)', "{{signal}}"),
+        ], 12, 0),
+        panel("HBM utilization (%)", [
+            ('max(llm_slo_agent_hbm_utilization_pct) by (instance)', "{{instance}}"),
+        ], 0, 8, unit="percent"),
+        panel("TPU events by signal (xla/hbm/ici)", [
+            ('sum(rate(llm_slo_agent_tpu_events_total[5m])) by (signal)', "{{signal}}"),
+        ], 12, 8),
+        panel("TTFT p95 vs DNS p95 overlay", [
+            (TTFT_P95, "ttft p95 (ms)"),
+            ('histogram_quantile(0.95, sum(rate(llm_slo_agent_dns_latency_ms_bucket[5m])) by (le))', "kernel dns p95 (ms)"),
+        ], 0, 16, w=24, unit="ms"),
+    ],
+)
+
+incident_lab = dashboard(
+    "tpuslo-incident-lab",
+    "TPU SLO / Incident Lab",
+    [
+        panel("Enabled signals (one-hot)", [
+            ('llm_slo_agent_signal_enabled', "{{signal}}"),
+        ], 0, 0),
+        panel("Agent CPU overhead (%)", [
+            ('llm_slo_agent_cpu_overhead_pct', "{{instance}}"),
+        ], 12, 0, unit="percent"),
+        panel("Events dropped by reason", [
+            ('sum(rate(llm_slo_agent_events_dropped_total[5m])) by (reason)', "{{reason}}"),
+        ], 0, 8),
+        panel("Webhook deliveries", [
+            ('sum(rate(llm_slo_agent_webhook_deliveries_total[5m])) by (outcome)', "{{outcome}}"),
+        ], 12, 8),
+    ],
+)
+
+evidence_e2e = dashboard(
+    "tpuslo-evidence-e2e",
+    "TPU SLO / E2E Evidence",
+    [
+        panel("Agent up", [('llm_slo_agent_up', "{{instance}}")],
+              0, 0, w=8, kind="stat"),
+        panel("Heartbeat age (s)", [
+            ('time() - llm_slo_agent_heartbeat_timestamp_seconds', "{{instance}}"),
+        ], 8, 0, w=8, kind="stat", unit="s"),
+        panel("Capability mode", [
+            ('llm_slo_agent_capability_mode', "{{mode}}"),
+        ], 16, 0, w=8, kind="stat"),
+        panel("SLO + probe event throughput", [
+            ('sum(rate(llm_slo_agent_slo_events_total[5m]))', "slo events/s"),
+            ('sum(rate(llm_slo_agent_probe_events_total[5m]))', "probe events/s"),
+        ], 0, 8, w=24),
+    ],
+)
+
+FILES = {
+    "slo-overview.json": slo_overview,
+    "tpu-kernel-correlation.json": kernel_correlation,
+    "incident-lab.json": incident_lab,
+    "evidence-e2e.json": evidence_e2e,
+}
+
+if __name__ == "__main__":
+    total = 0
+    for name, dash in FILES.items():
+        (OUT / name).write_text(json.dumps(dash, indent=2) + "\n")
+        total += len(dash["panels"])
+        print(f"wrote {name} ({len(dash['panels'])} panels)")
+    print(f"{len(FILES)} dashboards, {total} panels")
