@@ -1,0 +1,22 @@
+# Agent image: Python control plane + C++ native runtime + CO-RE probe
+# objects (built at image build time so the DaemonSet needs no
+# toolchain on the node).
+FROM python:3.11-slim-bookworm AS build
+RUN apt-get update && apt-get install -y --no-install-recommends \
+    g++ make clang llvm libbpf-dev bpftool && rm -rf /var/lib/apt/lists/*
+WORKDIR /src
+COPY . .
+RUN make native
+# CO-RE objects need the *target* kernel's BTF only at load time, not
+# build time; compile against the packaged vmlinux.h when present.
+RUN ./ebpf/gen.sh || echo "probe objects skipped (no BTF in builder)"
+
+FROM python:3.11-slim-bookworm
+RUN apt-get update && apt-get install -y --no-install-recommends \
+    libbpf1 && rm -rf /var/lib/apt/lists/*
+WORKDIR /app
+COPY --from=build /src /app
+RUN pip install --no-cache-dir .
+ENV TPUSLO_RUNTIME_LIB=/app/native/libtpuslo_runtime.so
+ENTRYPOINT ["python", "-m", "tpuslo"]
+CMD ["agent", "--help"]

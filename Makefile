@@ -1,0 +1,103 @@
+# tpu-slo-toolkit build/test/gate entry points.
+# Role parity with the reference Makefile (build/test/schema-validate/
+# correlation-gate/m5 targets), re-keyed to the Python+C++ toolchain.
+
+PY ?= python
+ARTIFACTS ?= artifacts
+
+.PHONY: all test test-fast native ebpf lint schema-validate \
+	correlation-gate fault-smoke replay-smoke ebpf-smoke bench \
+	m5-candidate m5-gate helm-lint dashboards clean
+
+all: native test
+
+# ---- build ------------------------------------------------------------
+
+native:
+	$(MAKE) -C native
+
+ebpf:
+	./ebpf/gen.sh
+
+# ---- test -------------------------------------------------------------
+
+test: native
+	$(PY) -m pytest tests/ -q
+
+test-fast: native
+	$(PY) -m pytest tests/ -q -x
+
+lint:
+	$(PY) -m compileall -q tpuslo demo tests bench.py __graft_entry__.py
+
+# ---- gates (mirror the reference CI steps) ----------------------------
+
+schema-validate:
+	$(PY) -m tpuslo schemavalidate
+
+correlation-gate:
+	$(PY) -m tpuslo correlationeval --min-precision 0.90 --min-recall 0.85
+
+fault-smoke:
+	mkdir -p $(ARTIFACTS)/smoke
+	$(PY) -m tpuslo faultinject --scenario dns_latency --count 5 \
+		--output $(ARTIFACTS)/smoke/raw_samples.jsonl
+	$(PY) -m tpuslo collector --input $(ARTIFACTS)/smoke/raw_samples.jsonl \
+		--output jsonl --jsonl-path $(ARTIFACTS)/smoke/slo_events.jsonl
+	@test -s $(ARTIFACTS)/smoke/slo_events.jsonl && echo "fault-smoke: OK"
+
+replay-smoke:
+	mkdir -p $(ARTIFACTS)/replay
+	$(PY) -m tpuslo faultreplay --scenario tpu_mixed_multi --count 10 \
+		--output $(ARTIFACTS)/replay/replay.jsonl
+	$(PY) -m tpuslo attributor --input $(ARTIFACTS)/replay/replay.jsonl \
+		--output $(ARTIFACTS)/replay/attributions.jsonl \
+		--summary $(ARTIFACTS)/replay/summary.json \
+		--confusion $(ARTIFACTS)/replay/confusion.csv
+	@test -s $(ARTIFACTS)/replay/attributions.jsonl && echo "replay-smoke: OK"
+
+ebpf-smoke:
+	./scripts/ebpf-smoke.sh
+
+# ---- benchmark + release gates ---------------------------------------
+
+bench:
+	$(PY) bench.py
+
+# Build the m5 candidate tree: 7 scenarios x 3 reruns of benchmark
+# bundles (reference Makefile m5-candidate-rebuild).
+M5_SCENARIOS ?= dns_latency network_partition cpu_throttle ici_drop \
+	hbm_pressure xla_recompile_storm tpu_mixed_multi
+M5_RUNS ?= 1 2 3
+
+m5-candidate:
+	@for s in $(M5_SCENARIOS); do \
+	  inj=$$s; [ $$s = tpu_mixed_multi ] && inj=tpu_mixed; \
+	  for r in $(M5_RUNS); do \
+	    out=$(ARTIFACTS)/m5/$$s/run$$r; mkdir -p $$out; \
+	    $(PY) -m tpuslo faultinject --scenario $$inj --count 30 \
+	        --start 2026-01-0$${r}T00:00:00Z \
+	        --output $$out/raw_samples.jsonl || exit 1; \
+	    $(PY) -m tpuslo benchgen --scenario $$s --count 30 \
+	        --output-dir $$out --node bench-node-$$r || exit 1; \
+	  done; \
+	done
+	@echo "m5-candidate: artifacts under $(ARTIFACTS)/m5"
+
+m5-gate:
+	$(PY) -m tpuslo m5gate --candidate-root $(ARTIFACTS)/m5 \
+		--scenarios "$(shell echo $(M5_SCENARIOS) | tr ' ' ',')" \
+		--summary-json $(ARTIFACTS)/m5/gate.json \
+		--summary-md $(ARTIFACTS)/m5/gate.md
+
+# ---- misc -------------------------------------------------------------
+
+helm-lint:
+	helm lint charts/tpu-slo-agent
+
+dashboards:
+	cd dashboards && $(PY) generate.py
+
+clean:
+	$(MAKE) -C native clean
+	rm -rf $(ARTIFACTS) ebpf/build

@@ -1,0 +1,75 @@
+#!/usr/bin/env python3
+"""Real HBM-pressure injector.
+
+Allocates live device buffers until the requested fraction of HBM is
+held, then sits on them for the fault window.  Co-located serving
+traffic sees allocator stalls / OOM-retry behaviour; the toolkit's
+hbm_utilization_pct sampler and hbm_alloc_stall_ms probe are the
+expected witnesses.
+
+Deterministic: allocation sizes derive from the device's reported
+bytes_limit, not timing.
+
+Usage: hbm_pressure.py [--fraction 0.85] [--hold-s 60] [--report out.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--fraction", type=float, default=0.85)
+    p.add_argument("--hold-s", type=float, default=60.0)
+    p.add_argument("--chunk-mb", type=int, default=256)
+    p.add_argument("--report", default="")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    device = jax.devices()[0]
+    stats = device.memory_stats() or {}
+    limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+    if not limit:
+        print(json.dumps({
+            "injector": "hbm_pressure", "real": False,
+            "reason": "device reports no memory stats",
+        }))
+        return 2
+
+    target = int(limit * args.fraction)
+    chunk_elems = args.chunk_mb * 1024 * 1024  # 1 byte per int8 element
+    held = []
+    held_bytes = int(stats.get("bytes_in_use", 0))
+    while held_bytes < target:
+        buf = jax.device_put(
+            jnp.zeros((chunk_elems,), jnp.int8), device
+        )
+        buf.block_until_ready()
+        held.append(buf)
+        held_bytes += chunk_elems
+
+    report = {
+        "injector": "hbm_pressure",
+        "real": True,
+        "backend": jax.default_backend(),
+        "bytes_limit": int(limit),
+        "held_bytes": held_bytes,
+        "fraction": round(held_bytes / limit, 4),
+        "hold_s": args.hold_s,
+    }
+    print(json.dumps(report))
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=2)
+    time.sleep(args.hold_s)
+    del held
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

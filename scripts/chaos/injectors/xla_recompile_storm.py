@@ -1,0 +1,61 @@
+#!/usr/bin/env python3
+"""Real XLA recompile-storm injector.
+
+Unlike the CPU-era faults (tc netem, stress pods), TPU faults need
+TPU-native injectors (SURVEY.md §7 "realistic-but-deterministic TPU
+fault injection").  A recompile storm is the easy one: jit a function
+and feed it a new shape every step, forcing a fresh XLA compilation
+each time.  Run next to the serving demo on the same chip to create
+genuine compile-queue contention; the toolkit's xla_compile_ms probe
+(or the demo's self-reported compile spans) should light up.
+
+Usage: xla_recompile_storm.py [--steps 30] [--base 128] [--report out.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--base", type=int, default=128)
+    p.add_argument("--report", default="")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x):
+        return jnp.tanh(x @ x.T).sum()
+
+    compile_ms = []
+    for i in range(args.steps):
+        # A never-repeating shape defeats the compile cache every step.
+        n = args.base + i
+        x = jnp.ones((n, n), jnp.bfloat16)
+        t0 = time.perf_counter()
+        step(x).block_until_ready()
+        compile_ms.append((time.perf_counter() - t0) * 1000.0)
+
+    report = {
+        "injector": "xla_recompile_storm",
+        "real": True,
+        "steps": args.steps,
+        "backend": jax.default_backend(),
+        "compile_ms_p50": sorted(compile_ms)[len(compile_ms) // 2],
+        "compile_ms_max": max(compile_ms),
+    }
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=2)
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

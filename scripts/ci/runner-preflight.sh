@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Decide which CI lane a runner supports.  Prints one of:
+#   tpu        — TPU-VM with /dev/accel* and libtpu (full probe lane)
+#   privileged — BPF-capable Linux, no TPU (kernel probe lane)
+#   synthetic  — anything else (synthetic-spine lane)
+# Role parity with the reference's runner detection (scripts/ci/*).
+set -euo pipefail
+
+has_bpf() {
+    python -m tpuslo agent --probe-smoke >/dev/null 2>&1
+}
+
+has_tpu() {
+    ls /dev/accel* >/dev/null 2>&1 || ls /dev/vfio/* >/dev/null 2>&1
+}
+
+if has_tpu && has_bpf; then
+    echo tpu
+elif has_bpf; then
+    echo privileged
+else
+    echo synthetic
+fi

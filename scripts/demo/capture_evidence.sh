@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+# Capture the chain-of-evidence bundle: agent metrics, demo SLIs, an
+# attribution run, and (when a cluster is present) Prometheus/Grafana
+# assertions.  Role parity with the reference's
+# scripts/demo/capture_evidence.sh; see
+# docs/demos/e2e-evidence-runbook.md for the narrative this feeds.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+OUT="${OUT:-artifacts/evidence}"
+mkdir -p "$OUT"
+
+echo "== 1/4 agent (synthetic, 5 cycles) -> probe events"
+python -m tpuslo agent --scenario tpu_mixed --count 5 --interval-s 0.1 \
+    --event-kind both --output jsonl --jsonl-path "$OUT/agent_events.jsonl" \
+    --metrics-port 0 2> "$OUT/agent_stderr.log"
+wc -l "$OUT/agent_events.jsonl"
+
+echo "== 2/4 demo serving sample (stub backend)"
+python - <<'EOF'
+import json
+from demo.rag_service.service import RagService
+
+svc = RagService(sleep=lambda s: None)  # stub backend, no real sleeps
+events = list(svc.chat("what is the SLO evidence chain?", profile="chat_short"))
+summary = [e for e in events if e.get("type") == "summary"][-1]
+with open("artifacts/evidence/demo_chat.json", "w") as fh:
+    json.dump(summary, fh, indent=2)
+print("demo chat ok:", summary.get("ttft_ms"), "ms TTFT")
+EOF
+
+echo "== 3/4 attribution on a mixed-fault replay"
+python -m tpuslo faultreplay --scenario tpu_mixed_multi --count 20 \
+    --output "$OUT/replay.jsonl"
+python -m tpuslo attributor --input "$OUT/replay.jsonl" \
+    --output "$OUT/attributions.jsonl" --summary "$OUT/summary.json" \
+    --confusion "$OUT/confusion.csv"
+
+echo "== 4/4 cluster assertions (optional)"
+if command -v kubectl >/dev/null 2>&1 && kubectl get ns tpu-slo >/dev/null 2>&1; then
+    kubectl -n tpu-slo get ds tpu-slo-agent -o wide | tee "$OUT/daemonset.txt"
+    kubectl get --raw \
+        "/api/v1/namespaces/tpu-slo-observability/services/prometheus:9090/proxy/api/v1/query?query=llm_slo_agent_up" \
+        | tee "$OUT/prometheus_agent_up.json"
+else
+    echo "no cluster; skipped" | tee "$OUT/cluster_skipped.txt"
+fi
+
+echo "evidence bundle in $OUT"

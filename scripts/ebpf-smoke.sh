@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# eBPF reality check: privilege probe + build + load of the minimal
+# CO-RE object.  Role parity with the reference's smoke
+# (scripts/ebpf-smoke.sh: agent --probe-smoke + bpftool prog loadall).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== 1/3 privilege probe (bpf syscall)"
+python -m tpuslo agent --probe-smoke
+
+echo "== 2/3 build probe objects"
+./ebpf/gen.sh
+
+echo "== 3/3 load minimal object"
+if command -v bpftool >/dev/null 2>&1; then
+    mount_point=/sys/fs/bpf/tpuslo-smoke
+    sudo mkdir -p "$mount_point" 2>/dev/null || mkdir -p "$mount_point"
+    bpftool prog loadall ebpf/build/minimal.bpf.o "$mount_point"
+    bpftool prog show pinned "$mount_point/minimal_noop" >/dev/null
+    rm -rf "$mount_point"
+    echo "ebpf-smoke: minimal object loaded + unloaded OK"
+else
+    echo "ebpf-smoke: bpftool missing; skipping load step" >&2
+    exit 2
+fi

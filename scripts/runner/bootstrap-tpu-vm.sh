@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Bootstrap an ephemeral self-hosted CI runner on a TPU-VM.
+# Role parity with the reference's runner bootstrap (scripts/runner/):
+# installs the probe toolchain, registers a GitHub Actions runner with
+# the labels the workflows target, and arranges teardown.
+#
+# Required env: GH_REPO (owner/name), GH_RUNNER_TOKEN.
+set -euo pipefail
+
+LABELS="${LABELS:-self-hosted,tpu-vm,ebpf-capable}"
+RUNNER_DIR="${RUNNER_DIR:-$HOME/actions-runner}"
+RUNNER_VERSION="${RUNNER_VERSION:-2.317.0}"
+
+echo "== toolchain"
+sudo apt-get update -qq
+sudo apt-get install -y -qq clang llvm libbpf-dev linux-headers-"$(uname -r)" \
+    bpftool build-essential python3-pip || true
+
+echo "== verify probe surface"
+ls /dev/accel* 2>/dev/null || echo "warning: no /dev/accel* (not a TPU-VM?)"
+test -r /sys/kernel/btf/vmlinux && echo "BTF: ok" || echo "warning: no BTF"
+
+echo "== actions runner"
+mkdir -p "$RUNNER_DIR" && cd "$RUNNER_DIR"
+if [ ! -x ./config.sh ]; then
+    curl -fsSL -o runner.tar.gz \
+        "https://github.com/actions/runner/releases/download/v${RUNNER_VERSION}/actions-runner-linux-x64-${RUNNER_VERSION}.tar.gz"
+    tar xzf runner.tar.gz
+fi
+./config.sh --unattended --replace \
+    --url "https://github.com/${GH_REPO:?set GH_REPO}" \
+    --token "${GH_RUNNER_TOKEN:?set GH_RUNNER_TOKEN}" \
+    --labels "$LABELS" \
+    --ephemeral
+exec ./run.sh

@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Observability-stack smoke: Prometheus scrapes the agent, alert rules
+# load, Grafana provisioning mounts.  Role parity with the reference's
+# observability-smoke.sh.
+set -euo pipefail
+
+ONS=tpu-slo-observability
+
+echo "== deploy stack"
+kubectl apply -k deploy/observability/
+kubectl -n "$ONS" rollout status deploy/prometheus --timeout=180s
+kubectl -n "$ONS" rollout status deploy/otel-collector --timeout=180s
+kubectl -n "$ONS" rollout status deploy/grafana --timeout=180s
+
+echo "== prometheus rule + target assertions"
+rules=$(kubectl get --raw \
+    "/api/v1/namespaces/$ONS/services/prometheus:9090/proxy/api/v1/rules")
+echo "$rules" | grep -q LLMSLOTTFTBurnRateHigh || {
+    echo "observability-smoke: alert rules not loaded" >&2; exit 1; }
+echo "  ok: alert rules loaded"
+
+up=$(kubectl get --raw \
+    "/api/v1/namespaces/$ONS/services/prometheus:9090/proxy/api/v1/query?query=llm_slo_agent_up")
+echo "$up" | grep -q '"status":"success"' || {
+    echo "observability-smoke: query failed" >&2; exit 1; }
+echo "  ok: agent_up queryable"
+
+echo "observability smoke: PASS"

@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# kind integration smoke: deploy the min-capability agent and assert
+# Prometheus metric strings through the API proxy.
+# Role parity with the reference's test/integration-kind/smoke.sh
+# (kubectl get --raw assertions on agent metrics).
+set -euo pipefail
+
+NS=tpu-slo
+
+echo "== deploy"
+kubectl apply -k deploy/k8s/min-capability/
+kubectl -n "$NS" rollout status ds/tpu-slo-agent --timeout=180s
+
+echo "== agent metrics assertions"
+pod=$(kubectl -n "$NS" get pods -l app.kubernetes.io/name=tpu-slo-agent \
+      -o jsonpath='{.items[0].metadata.name}')
+metrics=$(kubectl -n "$NS" exec "$pod" -- \
+          python -c "import urllib.request;print(urllib.request.urlopen('http://localhost:2112/metrics').read().decode())")
+
+for want in llm_slo_agent_up llm_slo_agent_heartbeat_timestamp_seconds \
+            llm_slo_agent_slo_events_total; do
+    echo "$metrics" | grep -q "$want" || {
+        echo "smoke: missing metric $want" >&2
+        exit 1
+    }
+    echo "  ok: $want"
+done
+
+echo "== event flow assertion (synthetic mode emits within 30s)"
+for _ in $(seq 30); do
+    count=$(echo "$metrics" | awk '/^llm_slo_agent_slo_events_total/ {print $2}')
+    [ -n "$count" ] && python -c "exit(0 if float('$count') > 0 else 1)" && break
+    sleep 1
+    metrics=$(kubectl -n "$NS" exec "$pod" -- \
+              python -c "import urllib.request;print(urllib.request.urlopen('http://localhost:2112/metrics').read().decode())")
+done
+python -c "exit(0 if float('${count:-0}') > 0 else 1)" \
+    || { echo "smoke: no SLO events emitted" >&2; exit 1; }
+
+echo "integration-kind smoke: PASS"

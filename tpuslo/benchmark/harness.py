@@ -6,10 +6,13 @@ summary JSON, markdown report, and provenance JSON (git SHA + seed).
 
 One deliberate departure: the reference emits *hardcoded* overhead and
 detection-delay rows (``harness.go:71-80,99``); this build measures
-them — CPU overhead via the delta-ticks guard sampled around the
-attribution loop, RSS from ``/proc/self/status``, and detection delay
-as measured per-sample attribution latency plus the 1s scenario
-cadence, reported at the median.
+them.  The overhead row is the *steady-state* figure the B5 gate is
+about: measured CPU seconds per attributed sample (delta-ticks guard
+around the loop), scaled to the agent's production cadence of one
+sample per second — i.e. what fraction of one second of host CPU the
+pipeline consumes per emitted sample.  RSS comes from
+``/proc/self/status``; detection delay is measured per-sample
+attribution latency plus half the scenario cadence, at the median.
 """
 
 from __future__ import annotations
@@ -100,6 +103,7 @@ def generate_artifacts(opts: Options) -> ArtifactBundle:
 
     guard = OverheadGuard(budget_pct=100.0)
     guard.evaluate()  # prime
+    loop_cpu_t0 = time.process_time()
 
     attributor = attribution.BayesianAttributor()
     predictions = []
@@ -114,8 +118,15 @@ def generate_artifacts(opts: Options) -> ArtifactBundle:
         validate(pred.to_dict(), SCHEMA_INCIDENT_ATTRIBUTION)
         predictions.append(pred)
 
-    overhead = guard.evaluate()
-    cpu_pct = overhead.cpu_pct if overhead.valid else 0.0
+    # Steady-state overhead: CPU seconds consumed per sample, against
+    # the agent's one-sample-per-second production cadence.  (The raw
+    # guard delta over this flat-out loop would measure "how fast can
+    # benchgen go", not agent overhead.)
+    loop_cpu_s = time.process_time() - loop_cpu_t0
+    cadence_s = SAMPLE_CADENCE_MS / 1000.0
+    cpu_pct = (
+        100.0 * (loop_cpu_s / len(samples)) / cadence_s if samples else 0.0
+    )
 
     # --- predictions CSV ------------------------------------------------
     predictions_csv = out / "incident_predictions.csv"
