@@ -92,7 +92,7 @@ def bench_serving() -> dict:
         # Warm generate (compiles the bucket), then timed run.
         list(engine.generate(prompt, max_new_tokens=8))
         t0 = time.perf_counter()
-        events = list(engine.generate(prompt, max_new_tokens=64))
+        events = list(engine.generate(prompt, max_new_tokens=256))
         elapsed = time.perf_counter() - t0
         ttft_ms = events[0].ttft_ms or 0.0
         decode_tokens = len(events) - 1

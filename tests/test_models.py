@@ -71,6 +71,32 @@ class TestDecode:
     def test_gqa_head_counts(self):
         assert CFG.n_heads % CFG.n_kv_heads == 0
 
+    def test_decode_chunk_matches_single_steps(self, params):
+        """One scanned chunk == the same greedy per-token step sequence."""
+        rng = jax.random.PRNGKey(7)
+        tokens = jax.random.randint(rng, (1, 8), 0, CFG.vocab_size)
+
+        cache = llama.init_kv_cache(CFG, 1)
+        last, cache = llama.prefill(params, tokens, cache, CFG)
+        tok = jnp.argmax(last, -1).astype(jnp.int32)
+        chunk, chunk_last, chunk_cache = llama.decode_chunk(
+            params, tok, cache, CFG, 6
+        )
+
+        cache = llama.init_kv_cache(CFG, 1)
+        _, cache = llama.prefill(params, tokens, cache, CFG)
+        singles = []
+        step_tok = tok
+        for _ in range(6):
+            logits, cache = llama.decode_step(params, step_tok, cache, CFG)
+            step_tok = jnp.argmax(logits, -1).astype(jnp.int32)
+            singles.append(int(step_tok[0]))
+
+        assert chunk.shape == (1, 6)
+        assert [int(t) for t in chunk[0]] == singles
+        assert int(chunk_last[0]) == singles[-1]
+        assert int(chunk_cache["length"]) == int(cache["length"])
+
     def test_padded_prefill_matches_unpadded(self, params):
         """Bucket padding must not change logits or cache length."""
         rng = jax.random.PRNGKey(4)
@@ -173,6 +199,36 @@ class TestServeEngine:
         long_prompt = "x" * 500
         events = list(engine.generate(long_prompt, max_new_tokens=2))
         assert len(events) >= 1  # no crash, no unpadded odd-length compile
+
+    def test_max_new_tokens_capped_to_cache_capacity(self):
+        """Requests past KV capacity cap cleanly instead of clamping
+        dynamic_update_slice writes onto the last cache slot."""
+        engine = ServeEngine(
+            cfg=llama.llama_tiny(max_seq_len=128), prefill_buckets=(32,)
+        )
+        events = list(
+            engine.generate("hello", max_new_tokens=10_000, stop_at_eos=False)
+        )
+        assert 1 <= len(events) <= 128
+
+    def test_no_dead_lookahead_dispatch(self):
+        """Exactly one decode chunk is dispatched when one suffices."""
+        engine = ServeEngine(cfg=llama.llama_tiny(max_seq_len=256))
+        calls = 0
+        orig = engine._decode_chunk
+
+        def counting(*a, **k):
+            nonlocal calls
+            calls += 1
+            return orig(*a, **k)
+
+        engine._decode_chunk = counting
+        chunk = engine.decode_chunk_size
+        events = list(
+            engine.generate("abc", max_new_tokens=chunk + 1, stop_at_eos=False)
+        )
+        assert len(events) == chunk + 1
+        assert calls == 1
 
     def test_tiny_max_seq_len_falls_back_to_single_bucket(self):
         engine = ServeEngine(cfg=llama.llama_tiny(max_seq_len=16))

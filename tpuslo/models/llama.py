@@ -318,6 +318,36 @@ def decode_step(
     return logits, cache
 
 
+def decode_chunk(
+    params: PyTree,
+    token: jax.Array,
+    cache: PyTree,
+    cfg: LlamaConfig,
+    num_tokens: int,
+) -> tuple[jax.Array, jax.Array, PyTree]:
+    """Greedy-decode ``num_tokens`` tokens in ONE device call.
+
+    Dispatch latency (host→device→host per step) dominates small-model
+    decode — through a remote-chip tunnel each one-token step is a full
+    network round-trip.  Scanning ``decode_step`` on device amortises
+    that to one round-trip per chunk.  token: (B,) → (tokens
+    (B, num_tokens), last token (B,), cache); the last token comes out
+    of the jit so chaining chunks needs no host-side slicing (eager
+    ``toks[:, -1]`` would compile a handful of tiny one-off programs).
+    """
+
+    def step(carry, _):
+        tok, kv = carry
+        logits, kv = decode_step(params, tok, kv, cfg)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (nxt, kv), nxt
+
+    (last, cache), toks = lax.scan(
+        step, (token, cache), None, length=num_tokens
+    )
+    return toks.swapaxes(0, 1), last, cache
+
+
 def loss_fn(
     params: PyTree, tokens: jax.Array, targets: jax.Array, cfg: LlamaConfig
 ) -> jax.Array:

@@ -22,7 +22,7 @@ import jax.numpy as jnp
 
 from tpuslo.models.llama import (
     LlamaConfig,
-    decode_step,
+    decode_chunk,
     init_kv_cache,
     init_params,
     llama_tiny,
@@ -67,6 +67,7 @@ class ServeEngine:
         params=None,
         rng_seed: int = 0,
         prefill_buckets: tuple[int, ...] = (32, 64, 128, 256),
+        decode_chunk_size: int = 64,
     ):
         self.cfg = cfg or llama_tiny(max_seq_len=512)
         self.params = (
@@ -81,10 +82,21 @@ class ServeEngine:
             # Config shorter than every requested bucket: one bucket at
             # the model's own limit rather than crashing later.
             self.prefill_buckets = (self.cfg.max_seq_len,)
+        # One device round-trip per chunk of greedy tokens, not per
+        # token — dispatch latency would otherwise dominate decode.
+        # Clamped so a smallest-bucket prompt plus two chunks (decode
+        # overshoot + pipeline lookahead) always fits the KV cache.
+        chunk_cap = (self.cfg.max_seq_len - self.prefill_buckets[0] - 1) // 2
+        self.decode_chunk_size = max(1, min(decode_chunk_size, chunk_cap))
         # Donate the KV cache: decode updates it in place instead of
         # copying (L, B, S_max, KV, HD) buffers every token.
         self._prefill = jax.jit(partial(prefill, cfg=self.cfg), donate_argnums=(2,))
-        self._decode = jax.jit(partial(decode_step, cfg=self.cfg), donate_argnums=(2,))
+        self._decode_chunk = jax.jit(
+            partial(
+                decode_chunk, cfg=self.cfg, num_tokens=self.decode_chunk_size
+            ),
+            donate_argnums=(2,),
+        )
         self.compile_events: list[dict] = []
 
     def warmup(self, bucket: int | None = None) -> float:
@@ -95,8 +107,8 @@ class ServeEngine:
         cache = init_kv_cache(self.cfg, 1)
         logits, cache = self._prefill(self.params, tokens, cache)
         tok = jnp.argmax(logits, -1).astype(jnp.int32)
-        self._decode(self.params, tok, cache)
-        jax.block_until_ready(logits)
+        toks, _last, _ = self._decode_chunk(self.params, tok, cache)
+        jax.block_until_ready(toks)
         return (time.perf_counter() - start) * 1000.0
 
     def generate(
@@ -107,11 +119,23 @@ class ServeEngine:
     ) -> Iterator[TokenEvent]:
         """Greedy decode; yields one TokenEvent per generated token."""
         request_start = time.perf_counter()
+        # Decode overshoots to a whole chunk, so the KV budget past the
+        # prompt is chunk-rounded; cap max_new_tokens so that budget
+        # plus at least a smallest-bucket prompt always fits the cache
+        # (dynamic_update_slice would otherwise clamp-and-corrupt the
+        # last slot silently).
+        chunk = self.decode_chunk_size
+        cap_tokens = (
+            (self.cfg.max_seq_len - self.prefill_buckets[0] - 1) // chunk
+        ) * chunk
+        max_new_tokens = max(1, min(max_new_tokens, cap_tokens))
+        reserved = ((max_new_tokens + chunk - 1) // chunk) * chunk + 1
         # Cap to the largest bucket so oversize prompts truncate instead
         # of slipping through unpadded (which would compile per-length —
         # the exact recompile storm bucketing exists to prevent).
-        max_prompt = min(
-            self.cfg.max_seq_len - max_new_tokens - 1, self.prefill_buckets[-1]
+        max_prompt = max(
+            1,
+            min(self.cfg.max_seq_len - reserved, self.prefill_buckets[-1]),
         )
         ids = encode_bytes(prompt, max_prompt)
         bucket = _bucket(len(ids), self.prefill_buckets)
@@ -132,16 +156,34 @@ class ServeEngine:
             )
 
         token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        # Dispatch the first decode chunk before the host-side read of
+        # the first token: jax dispatch is async, so the device starts
+        # decoding while TTFT is being measured and streamed.
+        toks = last = None
+        if max_new_tokens > 1:
+            toks, last, cache = self._decode_chunk(self.params, token, cache)
         ttft_ms = (time.perf_counter() - request_start) * 1000.0
         first = int(token[0])
         yield TokenEvent(first, 0, ttft_ms=ttft_ms)
         if stop_at_eos and first == EOS:
             return
 
-        for idx in range(1, max_new_tokens):
-            logits, cache = self._decode(self.params, token, cache)
-            token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            value = int(token[0])
-            yield TokenEvent(value, idx)
-            if stop_at_eos and value == EOS:
-                return
+        idx = 1
+        while idx < max_new_tokens:
+            # Issue chunk N+1 from the on-device last token of chunk N
+            # (only when tokens beyond this chunk are still needed),
+            # then read chunk N — the device computes ahead while the
+            # host streams, hiding the transfer round-trip.
+            next_toks = next_last = None
+            if idx + chunk < max_new_tokens:
+                next_toks, next_last, cache = self._decode_chunk(
+                    self.params, last, cache
+                )
+            for value in jax.device_get(toks[0]).tolist():
+                yield TokenEvent(int(value), idx)
+                idx += 1
+                if stop_at_eos and value == EOS:
+                    return
+                if idx >= max_new_tokens:
+                    return
+            toks, last = next_toks, next_last
