@@ -24,8 +24,8 @@ from tpuslo.cli import (
 
 
 class TestDispatcher:
-    def test_all_eleven_binaries_registered(self):
-        assert len(BINARIES) == 11
+    def test_all_twelve_binaries_registered(self):
+        assert len(BINARIES) == 12
 
     def test_unknown_binary_exit_2(self):
         assert dispatch(["warpdrive"]) == 2

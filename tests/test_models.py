@@ -235,6 +235,48 @@ class TestServeEngine:
         assert engine.prefill_buckets == (16,)
         assert engine.warmup() >= 0.0
 
+    def test_short_config_still_generates_requested_tokens(self):
+        """Capacity is per-request (decode starts at the prompt's true
+        length, not the bucket), so a single-bucket fallback config must
+        not silently cap generation at one token."""
+        engine = ServeEngine(cfg=llama.llama_tiny(max_seq_len=16))
+        events = list(
+            engine.generate("hi", max_new_tokens=8, stop_at_eos=False)
+        )
+        # prompt = BOS + 2 bytes = 3 ids; avail = 16-3-1 = 12; chunk = 7
+        # -> cap 7 tokens of the 8 requested.
+        assert len(events) == 7
+
+    def test_budget_of_exactly_one_chunk_uses_chunk_path(self):
+        engine = ServeEngine(
+            cfg=llama.llama_tiny(max_seq_len=32), prefill_buckets=(16,)
+        )
+        # 28-byte prompt -> 29 ids, truncated to max_prompt=16;
+        # avail = 32-16-1 = 15 = chunk -> chunked path, cap 15.
+        long_events = list(
+            engine.generate("x" * 28, max_new_tokens=64, stop_at_eos=False)
+        )
+        assert len(long_events) == 15
+        assert engine._decode_one is None  # tail path never compiled
+
+    def test_budget_below_one_chunk_falls_back_to_single_steps(self):
+        """A prompt that leaves less than one chunk of KV budget must
+        still serve the remaining slots (single-token tail path), not
+        round the request down to the prefill token."""
+        engine = ServeEngine(
+            cfg=llama.llama_tiny(max_seq_len=32), prefill_buckets=(24,)
+        )
+        # chunk = min(64, (32-2)//2) = 15; 24-id prompt -> avail =
+        # 32-24-1 = 7 < 15 -> tail path with cap 7.
+        events = list(
+            engine.generate("y" * 23, max_new_tokens=64, stop_at_eos=False)
+        )
+        assert len(events) == 7
+        assert engine._decode_one is not None
+        assert all(
+            0 <= e.token_id < engine.cfg.vocab_size for e in events
+        )
+
     def test_prompt_conditioning_not_poisoned_by_pads(self):
         """Different prompts shorter than the bucket must produce
         different first tokens conditioned on the real last byte."""

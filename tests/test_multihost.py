@@ -1,0 +1,226 @@
+"""Multi-host slice correlation tests.
+
+TPU-native addition (no reference counterpart): collective straggler
+attribution across a pod slice — SURVEY.md §2.5, BASELINE.json config 4.
+"""
+
+import json
+
+import pytest
+
+from tpuslo.correlation.multihost import (
+    CAUSE_COMPUTE,
+    CAUSE_ICI_LINK,
+    SliceJoiner,
+)
+from tpuslo.faultreplay.slice_streams import synthesize_slice_streams
+
+
+def ingest(joiner, streams):
+    for stream in streams:
+        joiner.add_all(stream)
+
+
+class TestStragglerAttribution:
+    def test_compute_straggler_detected(self):
+        streams = synthesize_slice_streams(
+            n_hosts=4, n_launches=6, straggler_host=2, straggler_delay_ms=50.0
+        )
+        joiner = SliceJoiner(expected_hosts=4)
+        ingest(joiner, streams)
+        incidents = joiner.incidents()
+        assert len(incidents) == 6
+        for inc in incidents:
+            assert inc.straggler_host == 2
+            assert inc.straggler_node == "host-2"
+            assert inc.cause == CAUSE_COMPUTE
+            assert inc.n_hosts == 4
+            assert inc.skew_ms > 40.0
+            assert inc.confidence >= 0.75
+
+    def test_ici_link_cause_from_retry_evidence(self):
+        streams = synthesize_slice_streams(
+            n_hosts=4, straggler_host=1, ici_link=3, link_retries_per_launch=5.0
+        )
+        joiner = SliceJoiner(expected_hosts=4)
+        ingest(joiner, streams)
+        incidents = joiner.incidents()
+        assert incidents
+        for inc in incidents:
+            assert inc.cause == CAUSE_ICI_LINK
+            assert inc.ici_link == 3
+            assert inc.link_retries >= 5.0
+            # Link corroboration raises confidence above the compute case.
+            assert inc.confidence > 0.85
+
+    def test_healthy_slice_produces_no_incidents(self):
+        streams = synthesize_slice_streams(straggler_delay_ms=0.0)
+        joiner = SliceJoiner()
+        ingest(joiner, streams)
+        assert joiner.incidents() == []
+
+    def test_small_absolute_skew_below_floor_ignored(self):
+        # 50% relative skew but only 2ms absolute: below the 5ms floor.
+        streams = synthesize_slice_streams(
+            base_latency_ms=2.0, straggler_delay_ms=2.0
+        )
+        joiner = SliceJoiner()
+        ingest(joiner, streams)
+        assert joiner.incidents() == []
+
+    def test_min_hosts_guards_partial_join(self):
+        streams = synthesize_slice_streams(n_hosts=4, straggler_delay_ms=50.0)
+        joiner = SliceJoiner()
+        joiner.add_all(streams[0])  # only one host's stream has arrived
+        assert joiner.incidents() == []
+
+    def test_partial_coverage_lowers_confidence(self):
+        streams = synthesize_slice_streams(
+            n_hosts=4, straggler_host=0, straggler_delay_ms=50.0
+        )
+        full = SliceJoiner(expected_hosts=4)
+        ingest(full, streams)
+        partial = SliceJoiner(expected_hosts=4)
+        ingest(partial, streams[:2])  # straggler + one punctual host
+        f = full.incidents()[0]
+        p = partial.incidents()[0]
+        assert p.straggler_host == f.straggler_host == 0
+        assert p.confidence < f.confidence
+
+    def test_events_without_slice_identity_skipped(self):
+        joiner = SliceJoiner()
+        assert not joiner.add({"signal": "dns_latency_ms", "value": 5.0})
+        assert not joiner.add(
+            {"signal": "ici_collective_latency_ms", "value": 5.0, "tpu": {}}
+        )
+        assert joiner.skipped == 2 and joiner.ingested == 0
+
+    def test_incident_dict_round_trips_json(self):
+        streams = synthesize_slice_streams(straggler_delay_ms=50.0, ici_link=1)
+        joiner = SliceJoiner(expected_hosts=4)
+        ingest(joiner, streams)
+        payload = json.loads(json.dumps(joiner.incidents()[0].to_dict()))
+        assert payload["cause"] == CAUSE_ICI_LINK
+        assert payload["ici_link"] == 1
+        assert set(payload["host_latencies_ms"]) == {"0", "1", "2", "3"}
+
+    def test_drain_reports_once_and_bounds_memory(self):
+        streams = synthesize_slice_streams(
+            n_hosts=4, n_launches=6, straggler_delay_ms=50.0, ici_link=1
+        )
+        joiner = SliceJoiner(expected_hosts=4)
+        ingest(joiner, streams)
+        first = joiner.drain()
+        assert len(first) == 6
+        assert joiner.drain() == []  # evicted: no duplicate reporting
+        assert not joiner._groups
+        # A fresh launch after the drain is still attributed.
+        late = synthesize_slice_streams(
+            n_hosts=4, n_launches=1, straggler_delay_ms=50.0,
+            start_unix_nano=1_700_000_100_000_000_000,
+        )
+        ingest(joiner, late)
+        assert len(joiner.drain()) == 1
+
+    def test_drain_keeps_groups_awaiting_hosts(self):
+        streams = synthesize_slice_streams(n_hosts=4, straggler_delay_ms=50.0)
+        joiner = SliceJoiner(expected_hosts=4)
+        joiner.add_all(streams[0])
+        assert joiner.drain(min_hosts=2) == []
+        assert joiner._groups  # kept for the late host streams
+        for stream in streams[1:]:
+            joiner.add_all(stream)
+        assert joiner.drain(min_hosts=2)
+
+    def test_drain_evicts_and_attributes_stale_groups_best_effort(self):
+        """A dead host agent must not grow drain() memory without bound:
+        groups stuck below expected_hosts age out past the pending
+        horizon and are attributed from whoever reported."""
+        streams = synthesize_slice_streams(
+            n_hosts=4, n_launches=5, straggler_host=1, straggler_delay_ms=50.0
+        )
+        joiner = SliceJoiner(expected_hosts=4, pending_horizon_ns=10)
+        # Host 3's agent "died": its stream never arrives.
+        for stream in streams[:3]:
+            joiner.add_all(stream)
+        drained = joiner.drain()
+        # Launches older than the horizon behind the newest observation
+        # are evicted + attributed from 3 hosts; the newest launch stays
+        # pending (host 3 could still report it).
+        assert len(drained) == 4
+        assert all(i.straggler_host == 1 and i.n_hosts == 3 for i in drained)
+        assert len(joiner._groups) == 1
+        full = SliceJoiner(expected_hosts=4)
+        for stream in streams:
+            full.add_all(stream)
+        # Best-effort attribution carries less confidence than complete.
+        assert drained[0].confidence < full.incidents()[0].confidence
+
+    def test_incidents_ranked_by_confidence_then_skew(self):
+        streams = synthesize_slice_streams(straggler_delay_ms=50.0)
+        joiner = SliceJoiner(expected_hosts=4)
+        ingest(joiner, streams)
+        incidents = joiner.incidents()
+        confs = [i.confidence for i in incidents]
+        assert confs == sorted(confs, reverse=True)
+
+
+class TestSliceCorrCLI:
+    def test_end_to_end_jsonl(self, tmp_path, capsys):
+        from tpuslo.cli.slicecorr import main
+
+        streams = synthesize_slice_streams(
+            n_hosts=4, straggler_host=3, straggler_delay_ms=60.0, ici_link=2
+        )
+        paths = []
+        for host, stream in enumerate(streams):
+            p = tmp_path / f"host{host}.jsonl"
+            p.write_text(
+                "".join(json.dumps(e) + "\n" for e in stream), encoding="utf-8"
+            )
+            paths.append(str(p))
+        out = tmp_path / "incidents.jsonl"
+        summary = tmp_path / "summary.json"
+        rc = main(
+            paths
+            + [
+                "--output",
+                str(out),
+                "--summary",
+                str(summary),
+                "--expected-hosts",
+                "4",
+            ]
+        )
+        assert rc == 0
+        incidents = [
+            json.loads(line) for line in out.read_text().splitlines() if line
+        ]
+        assert incidents and all(i["straggler_host"] == 3 for i in incidents)
+        meta = json.loads(summary.read_text())
+        assert meta["incidents"] == len(incidents)
+        assert meta["by_cause"] == {"ici_link": len(incidents)}
+
+    def test_stdin_dash_mixed_with_files(self, tmp_path, monkeypatch, capsys):
+        import io
+
+        from tpuslo.cli.slicecorr import main
+
+        streams = synthesize_slice_streams(
+            n_hosts=2, n_launches=2, straggler_host=0, straggler_delay_ms=50.0
+        )
+        p = tmp_path / "host0.jsonl"
+        p.write_text(
+            "".join(json.dumps(e) + "\n" for e in streams[0]), encoding="utf-8"
+        )
+        monkeypatch.setattr(
+            "sys.stdin",
+            io.StringIO("".join(json.dumps(e) + "\n" for e in streams[1])),
+        )
+        assert main([str(p), "-"]) == 0
+        lines = [
+            json.loads(l)
+            for l in capsys.readouterr().out.splitlines()
+            if l.strip()
+        ]
+        assert len(lines) == 2 and all(i["n_hosts"] == 2 for i in lines)

@@ -1,8 +1,9 @@
-"""Dispatcher for the eleven toolkit binaries: ``python -m tpuslo <name>``."""
+"""Dispatcher for the twelve toolkit binaries: ``python -m tpuslo <name>``."""
 
 from __future__ import annotations
 
 import importlib
+import os
 import sys
 
 BINARIES = {
@@ -17,6 +18,9 @@ BINARIES = {
     "sloctl": "tpuslo.cli.sloctl",
     "loadgen": "tpuslo.cli.loadgen",
     "schemavalidate": "tpuslo.cli.schemavalidate",
+    # TPU-native addition (no reference counterpart): multi-host
+    # collective straggler attribution across a pod slice.
+    "slicecorr": "tpuslo.cli.slicecorr",
 }
 
 
@@ -32,7 +36,22 @@ def main(argv: list[str] | None = None) -> int:
         print(f"tpuslo: unknown binary {name!r}", file=sys.stderr)
         return 2
     module = importlib.import_module(module_path)
-    return module.main(rest)
+    try:
+        return module.main(rest)
+    except BrokenPipeError:
+        # Downstream pipe closed early (e.g. `| head`).  Suppress the
+        # traceback and detach stdout so the exit-time flush doesn't
+        # raise again, but exit 141 (128+SIGPIPE) rather than 0: output
+        # may be truncated, and in a `cmd | head` pipeline the shell
+        # takes the pipeline status from `head` anyway.
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, sys.stdout.fileno())
+        try:
+            print(f"tpuslo {name}: broken pipe, output truncated", file=sys.stderr)
+        except BrokenPipeError:
+            # `2>&1 | head`: stderr shares the dead pipe.
+            os.dup2(devnull, sys.stderr.fileno())
+        return 141
 
 
 if __name__ == "__main__":

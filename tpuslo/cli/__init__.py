@@ -1,4 +1,4 @@
-"""L9 CLI surface — eleven binaries behind one dispatcher.
+"""L9 CLI surface — twelve binaries behind one dispatcher.
 
 Reference: ``cmd/`` (agent, collector, attributor, benchgen,
 faultreplay, faultinject, correlationeval, m5gate, sloctl, loadgen,

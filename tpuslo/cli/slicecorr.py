@@ -1,0 +1,110 @@
+"""Slicecorr: cross-host collective straggler attribution.
+
+Joins per-host agent probe-event JSONL streams for a TPU pod slice and
+attributes collective stragglers to a host (compute) or ICI link.
+
+TPU-native addition — no reference counterpart (the reference's 11
+binaries are all single-host; see SURVEY.md §2.5 "multi-host
+correlation" and BASELINE.json config 4).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tpuslo.correlation.multihost import (
+    DEFAULT_RETRY_THRESHOLD,
+    DEFAULT_RETRY_WINDOW_NS,
+    DEFAULT_SKEW_FLOOR_MS,
+    DEFAULT_SKEW_RATIO,
+    SliceJoiner,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpuslo slicecorr", description=__doc__)
+    p.add_argument(
+        "inputs",
+        nargs="*",
+        help="per-host probe-event JSONL files ('-' or empty = stdin)",
+    )
+    p.add_argument("--output", default="-", help="incidents JSONL ('-' = stdout)")
+    p.add_argument("--expected-hosts", type=int, default=0)
+    p.add_argument("--min-hosts", type=int, default=2)
+    p.add_argument("--skew-ratio", type=float, default=DEFAULT_SKEW_RATIO)
+    p.add_argument("--skew-floor-ms", type=float, default=DEFAULT_SKEW_FLOOR_MS)
+    p.add_argument("--retry-threshold", type=float, default=DEFAULT_RETRY_THRESHOLD)
+    p.add_argument("--retry-window-ns", type=int, default=DEFAULT_RETRY_WINDOW_NS)
+    p.add_argument(
+        "--summary", default="", help="optional summary JSON output path"
+    )
+    return p
+
+
+def _read_events(paths: list[str]):
+    for path in paths or ["-"]:
+        fh = sys.stdin if path == "-" else open(path, encoding="utf-8")
+        try:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    yield json.loads(line)
+        finally:
+            if fh is not sys.stdin:
+                fh.close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    joiner = SliceJoiner(
+        expected_hosts=args.expected_hosts,
+        skew_ratio=args.skew_ratio,
+        skew_floor_ms=args.skew_floor_ms,
+        retry_window_ns=args.retry_window_ns,
+        retry_threshold=args.retry_threshold,
+    )
+    # ValueError covers malformed JSONL (e.g. an agent killed mid-write
+    # truncating a line — exactly the crash-consistency shape this
+    # tool's inputs come from); same contract as attributor/collector.
+    try:
+        joiner.add_all(_read_events(args.inputs))
+        incidents = joiner.incidents(min_hosts=args.min_hosts)
+
+        sink = (
+            sys.stdout
+            if args.output == "-"
+            else open(args.output, "w", encoding="utf-8")
+        )
+        try:
+            for incident in incidents:
+                sink.write(json.dumps(incident.to_dict(), sort_keys=True) + "\n")
+        finally:
+            if sink is not sys.stdout:
+                sink.close()
+
+        summary = {
+            "ingested": joiner.ingested,
+            "skipped": joiner.skipped,
+            "incidents": len(incidents),
+            "by_cause": {},
+        }
+        for incident in incidents:
+            summary["by_cause"][incident.cause] = (
+                summary["by_cause"].get(incident.cause, 0) + 1
+            )
+        if args.summary:
+            with open(args.summary, "w", encoding="utf-8") as fh:
+                json.dump(summary, fh, indent=2, sort_keys=True)
+    except BrokenPipeError:
+        raise  # dispatcher-level handling (exit 141, no traceback)
+    except (OSError, ValueError) as exc:
+        print(f"slicecorr: {exc}", file=sys.stderr)
+        return 2
+    print(f"slicecorr: {json.dumps(summary, sort_keys=True)}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

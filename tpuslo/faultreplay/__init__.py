@@ -4,10 +4,12 @@ from tpuslo.faultreplay.generator import (
     generate_fault_samples,
     supported_scenarios,
 )
+from tpuslo.faultreplay.slice_streams import synthesize_slice_streams
 
 __all__ = [
     "MULTI_FAULT_PAIRS",
     "TPU_MULTI_FAULT_PAIRS",
     "generate_fault_samples",
     "supported_scenarios",
+    "synthesize_slice_streams",
 ]

@@ -84,9 +84,11 @@ class ServeEngine:
             self.prefill_buckets = (self.cfg.max_seq_len,)
         # One device round-trip per chunk of greedy tokens, not per
         # token — dispatch latency would otherwise dominate decode.
-        # Clamped so a smallest-bucket prompt plus two chunks (decode
-        # overshoot + pipeline lookahead) always fits the KV cache.
-        chunk_cap = (self.cfg.max_seq_len - self.prefill_buckets[0] - 1) // 2
+        # Decode writes start at the prompt's true length (pad slots in
+        # the prefill bucket are overwritten and masked), so capacity is
+        # per-request; the only init-time constraint is that one chunk
+        # fits a short-prompt request at all.
+        chunk_cap = (self.cfg.max_seq_len - 2) // 2
         self.decode_chunk_size = max(1, min(decode_chunk_size, chunk_cap))
         # Donate the KV cache: decode updates it in place instead of
         # copying (L, B, S_max, KV, HD) buffers every token.
@@ -97,7 +99,20 @@ class ServeEngine:
             ),
             donate_argnums=(2,),
         )
+        # Tail path for prompts that leave less than one chunk of KV
+        # budget: single-token chunks use every remaining slot instead
+        # of rounding the request down to the prefill token.  Compiled
+        # lazily — most traffic never needs it.
+        self._decode_one = None
         self.compile_events: list[dict] = []
+
+    def _decode_one_fn(self):
+        if self._decode_one is None:
+            self._decode_one = jax.jit(
+                partial(decode_chunk, cfg=self.cfg, num_tokens=1),
+                donate_argnums=(2,),
+            )
+        return self._decode_one
 
     def warmup(self, bucket: int | None = None) -> float:
         """Compile the decode step (and one prefill bucket); returns ms."""
@@ -119,25 +134,29 @@ class ServeEngine:
     ) -> Iterator[TokenEvent]:
         """Greedy decode; yields one TokenEvent per generated token."""
         request_start = time.perf_counter()
-        # Decode overshoots to a whole chunk, so the KV budget past the
-        # prompt is chunk-rounded; cap max_new_tokens so that budget
-        # plus at least a smallest-bucket prompt always fits the cache
-        # (dynamic_update_slice would otherwise clamp-and-corrupt the
-        # last slot silently).
         chunk = self.decode_chunk_size
-        cap_tokens = (
-            (self.cfg.max_seq_len - self.prefill_buckets[0] - 1) // chunk
-        ) * chunk
-        max_new_tokens = max(1, min(max_new_tokens, cap_tokens))
-        reserved = ((max_new_tokens + chunk - 1) // chunk) * chunk + 1
         # Cap to the largest bucket so oversize prompts truncate instead
         # of slipping through unpadded (which would compile per-length —
-        # the exact recompile storm bucketing exists to prevent).
+        # the exact recompile storm bucketing exists to prevent), and
+        # always leave room for at least one generated token.
         max_prompt = max(
-            1,
-            min(self.cfg.max_seq_len - reserved, self.prefill_buckets[-1]),
+            1, min(self.prefill_buckets[-1], self.cfg.max_seq_len - 2)
         )
         ids = encode_bytes(prompt, max_prompt)
+        # Decode overshoots to whole chunks and every chunk writes
+        # `chunk` KV slots starting at the prompt's true length, so the
+        # per-request budget past the prompt is chunk-rounded; beyond it
+        # dynamic_update_slice would clamp-and-corrupt the last slot
+        # silently.  Prompts that leave less than one chunk of budget
+        # fall back to single-token chunks so the remaining slots are
+        # still served rather than rounded away.
+        avail = self.cfg.max_seq_len - len(ids) - 1
+        if avail < chunk:
+            decode_fn, chunk = self._decode_one_fn(), 1
+        else:
+            decode_fn = self._decode_chunk
+        cap_tokens = max(1, (avail // chunk) * chunk)
+        max_new_tokens = max(1, min(max_new_tokens, cap_tokens))
         bucket = _bucket(len(ids), self.prefill_buckets)
         padded = ids + [0] * (bucket - len(ids))
         tokens = jnp.asarray([padded], jnp.int32)
@@ -161,7 +180,7 @@ class ServeEngine:
         # decoding while TTFT is being measured and streamed.
         toks = last = None
         if max_new_tokens > 1:
-            toks, last, cache = self._decode_chunk(self.params, token, cache)
+            toks, last, cache = decode_fn(self.params, token, cache)
         ttft_ms = (time.perf_counter() - request_start) * 1000.0
         first = int(token[0])
         yield TokenEvent(first, 0, ttft_ms=ttft_ms)
@@ -176,7 +195,7 @@ class ServeEngine:
             # host streams, hiding the transfer round-trip.
             next_toks = next_last = None
             if idx + chunk < max_new_tokens:
-                next_toks, next_last, cache = self._decode_chunk(
+                next_toks, next_last, cache = decode_fn(
                     self.params, last, cache
                 )
             for value in jax.device_get(toks[0]).tolist():
