@@ -97,7 +97,7 @@ def bench_serving() -> dict:
         ttft_ms = events[0].ttft_ms or 0.0
         decode_tokens = len(events) - 1
         decode_window = elapsed - ttft_ms / 1000.0
-        return {
+        out = {
             "backend": backend,
             "warmup_compile_ms": round(compile_ms, 2),
             "ttft_ms": round(ttft_ms, 3),
@@ -105,6 +105,24 @@ def bench_serving() -> dict:
                 decode_tokens / decode_window if decode_window > 0 else 0.0, 2
             ),
         }
+        # Zero-instrumentation span source: capture xprof over a short
+        # serve and count recovered XLA launch spans (program+run_id
+        # identity for the xla_launch correlation tier).  Device lanes
+        # exist only on accelerator backends; 0 on pure-CPU runs.
+        try:
+            import tempfile
+
+            from tpuslo.otel import xla_spans
+
+            with tempfile.TemporaryDirectory() as td:
+                with xla_spans.capture(td) as cap:
+                    list(engine.generate(prompt, max_new_tokens=32))
+                launches = list(cap.launches())
+            out["xprof_launch_spans"] = len(launches)
+            out["xprof_programs"] = len({s.program_id for s in launches})
+        except Exception as exc:  # noqa: BLE001 — span source is best-effort
+            out["xprof_error"] = str(exc)[:120]
+        return out
     except Exception as exc:  # noqa: BLE001 — bench must still print a line
         return {"backend": "unavailable", "error": str(exc)[:200]}
 

@@ -276,6 +276,17 @@ class TestServeEngine:
         assert all(
             0 <= e.token_id < engine.cfg.vocab_size for e in events
         )
+        # The tail compile is visible to compile telemetry.
+        assert any(
+            e.get("bucket") == "decode_tail" for e in engine.compile_events
+        )
+
+    def test_warmup_can_precompile_tail_path(self):
+        engine = ServeEngine(
+            cfg=llama.llama_tiny(max_seq_len=32), prefill_buckets=(24,)
+        )
+        engine.warmup(include_tail=True)
+        assert engine._decode_one is not None
 
     def test_prompt_conditioning_not_poisoned_by_pads(self):
         """Different prompts shorter than the bucket must produce

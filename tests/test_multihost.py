@@ -156,6 +156,53 @@ class TestStragglerAttribution:
         # Best-effort attribution carries less confidence than complete.
         assert drained[0].confidence < full.incidents()[0].confidence
 
+    def test_drain_learns_slice_membership_when_expected_unset(self):
+        """Without expected_hosts, completeness is the widest membership
+        the slice has demonstrated — a partial arrival must not be
+        evicted as 'complete' at min_hosts."""
+        streams = synthesize_slice_streams(
+            n_hosts=4, n_launches=2, straggler_host=3, straggler_delay_ms=50.0
+        )
+        joiner = SliceJoiner()  # expected_hosts unset
+        # Launch 0 fully arrives first: membership of 4 is demonstrated.
+        for stream in streams:
+            joiner.add(stream[0])
+        # Launch 1: only punctual hosts 0-1 have reported so far.
+        joiner.add(streams[0][1])
+        joiner.add(streams[1][1])
+        drained = joiner.drain(min_hosts=2)
+        assert len(drained) == 1 and drained[0].launch_id == 0
+        assert len(joiner._groups) == 1  # launch 1 kept, not judged healthy
+        # Stragglers' events land; next drain attributes launch 1 fully.
+        joiner.add(streams[2][1])
+        joiner.add(streams[3][1])
+        second = joiner.drain(min_hosts=2)
+        assert len(second) == 1
+        assert second[0].launch_id == 1 and second[0].straggler_host == 3
+
+    def test_drain_horizon_is_per_slice(self):
+        """A lagging slice must not be force-evicted because another
+        slice has newer observations."""
+        fresh = synthesize_slice_streams(
+            n_hosts=2, n_launches=1, straggler_delay_ms=0.0,
+            slice_id="slice-fresh",
+            start_unix_nano=2_000_000_000_000_000_000,
+        )
+        lagging = synthesize_slice_streams(
+            n_hosts=4, n_launches=1, straggler_delay_ms=50.0,
+            slice_id="slice-lag",
+            start_unix_nano=1_000_000_000_000_000_000,
+        )
+        joiner = SliceJoiner(expected_hosts=4)
+        for stream in fresh:
+            joiner.add_all(stream)
+        for stream in lagging[:3]:  # slice-lag still missing host 3
+            joiner.add_all(stream)
+        assert joiner.drain() == []  # not stale relative to its own slice
+        assert any(
+            g.slice_id == "slice-lag" for g in joiner._groups.values()
+        )
+
     def test_incidents_ranked_by_confidence_then_skew(self):
         streams = synthesize_slice_streams(straggler_delay_ms=50.0)
         joiner = SliceJoiner(expected_hosts=4)

@@ -1,0 +1,198 @@
+"""xprof span-source tests.
+
+The trace-viewer document fixture mirrors what ``jax.profiler.trace``
+emits on a TPU backend (device process with "XLA Modules"/"XLA Ops"
+lanes; module events named ``<module>(<fingerprint>)`` with a
+``run_id`` arg).  The CPU backend used in CI emits no device lanes, so
+parsing is unit-tested against the fixture and ``capture`` is driven as
+a smoke test only.
+"""
+
+import gzip
+import json
+import os
+
+import pytest
+
+from tpuslo.correlation import SpanRef, SignalRef, match
+from tpuslo.otel.xla_spans import (
+    MODULES_LANE,
+    OPS_LANE,
+    capture,
+    find_trace_files,
+    load_latest_trace,
+    load_latest_trace_by_host,
+    load_trace_file,
+    parse_trace_events,
+)
+
+ANCHOR_NS = 1_700_000_000_000_000_000
+
+
+def trace_doc():
+    return {
+        "displayTimeUnit": "ns",
+        "metadata": {"highres-ticks": True},
+        "traceEvents": [
+            {"ph": "M", "pid": 3, "name": "process_name",
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "M", "pid": 3, "tid": 2, "name": "thread_name",
+             "args": {"name": "XLA Modules"}},
+            {"ph": "M", "pid": 3, "tid": 3, "name": "thread_name",
+             "args": {"name": "XLA Ops"}},
+            {"ph": "M", "pid": 701, "tid": 9, "name": "thread_name",
+             "args": {"name": "python"}},
+            # Two launches of the same program, one of another.
+            {"ph": "X", "pid": 3, "tid": 2, "ts": 100.0, "dur": 5.0,
+             "name": "jit_train_step(1111)", "args": {"run_id": "42"}},
+            {"ph": "X", "pid": 3, "tid": 2, "ts": 300.0, "dur": 5.5,
+             "name": "jit_train_step(1111)", "args": {"run_id": "43"}},
+            {"ph": "X", "pid": 3, "tid": 2, "ts": 200.0, "dur": 1.0,
+             "name": "jit_prefill(2222)", "args": {"run_id": "7"}},
+            # Op-level event (excluded unless include_ops).
+            {"ph": "X", "pid": 3, "tid": 3, "ts": 101.0, "dur": 4.0,
+             "name": "fusion.1", "args": {"hlo_category": "fusion"}},
+            # Host-side python event: never a span.
+            {"ph": "X", "pid": 701, "tid": 9, "ts": 90.0, "dur": 50.0,
+             "name": "PjitFunction(train_step)"},
+        ],
+    }
+
+
+class TestParse:
+    def test_module_spans_with_identity(self):
+        spans = parse_trace_events(trace_doc())
+        assert [s.launch_id for s in spans] == [42, 7, 43]  # time-sorted
+        first = spans[0]
+        assert first.module_name == "jit_train_step"
+        assert first.program_id == "1111"
+        assert first.lane == MODULES_LANE
+        assert first.duration_us == 5.0
+
+    def test_ops_included_on_request_only(self):
+        assert all(
+            s.lane == MODULES_LANE for s in parse_trace_events(trace_doc())
+        )
+        with_ops = parse_trace_events(trace_doc(), include_ops=True)
+        ops = [s for s in with_ops if s.lane == OPS_LANE]
+        assert len(ops) == 1 and ops[0].hlo_category == "fusion"
+
+    def test_unparseable_module_name_keeps_raw_name(self):
+        doc = trace_doc()
+        doc["traceEvents"].append(
+            {"ph": "X", "pid": 3, "tid": 2, "ts": 400.0, "dur": 1.0,
+             "name": "weird-module", "args": {}}
+        )
+        span = [s for s in parse_trace_events(doc) if s.name == "weird-module"][0]
+        assert span.module_name == "weird-module"
+        assert span.program_id == "" and span.launch_id == -1
+
+    def test_span_ref_feeds_xla_launch_tier(self):
+        """The whole point: an xprof span joins a probe signal on the
+        exact-identity xla_launch tier with no instrumentation."""
+        span = parse_trace_events(trace_doc())[0]
+        ref_dict = span.to_span_ref_dict(
+            ANCHOR_NS, service="rag-demo", node="host-0"
+        )
+        span_ref = SpanRef.from_dict(ref_dict)
+        signal = SignalRef.from_dict(
+            {
+                "signal": "ici_collective_latency_ms",
+                "timestamp": ref_dict["timestamp"],
+                "program_id": "1111",
+                "launch_id": 42,
+                "value": 3.0,
+            }
+        )
+        decision = match(span_ref, signal)
+        assert decision.matched and decision.tier == "xla_launch"
+        assert decision.confidence == 0.95
+
+    def test_anchor_offsets_timestamp_by_trace_us(self):
+        spans = parse_trace_events(trace_doc())
+        a = SpanRef.from_dict(spans[0].to_span_ref_dict(ANCHOR_NS))
+        b = SpanRef.from_dict(spans[2].to_span_ref_dict(ANCHOR_NS))
+        delta_ms = (b.timestamp - a.timestamp).total_seconds() * 1000.0
+        assert delta_ms == pytest.approx(0.2, abs=1e-6)  # 300us - 100us
+
+
+class TestFiles:
+    def write_run(self, tmp_path, run, hosts):
+        d = tmp_path / "plugins" / "profile" / run
+        d.mkdir(parents=True)
+        for host in hosts:
+            with gzip.open(d / f"{host}.trace.json.gz", "wt") as fh:
+                json.dump(trace_doc(), fh)
+
+    def test_newest_run_first_and_multi_host(self, tmp_path):
+        self.write_run(tmp_path, "2026_01_01_00_00_00", ["hostA"])
+        self.write_run(tmp_path, "2026_02_02_00_00_00", ["hostA", "hostB"])
+        files = find_trace_files(str(tmp_path))
+        assert len(files) == 3
+        assert "2026_02_02_00_00_00" in files[0]
+        spans = load_latest_trace(str(tmp_path))
+        # Only the newest run, both host files: 3 module spans each.
+        assert len(spans) == 6
+
+    def test_load_single_file(self, tmp_path):
+        self.write_run(tmp_path, "r", ["vm"])
+        path = find_trace_files(str(tmp_path))[0]
+        assert len(load_trace_file(path)) == 3
+
+    def test_empty_dir(self, tmp_path):
+        assert find_trace_files(str(tmp_path)) == []
+        assert load_latest_trace(str(tmp_path)) == []
+        assert load_latest_trace_by_host(str(tmp_path)) == {}
+
+    def test_by_host_grouping_preserves_run_id_scope(self, tmp_path):
+        """Per-host grouping: run_id counters are per host file, so the
+        exact-identity join must never mix hosts."""
+        self.write_run(tmp_path, "r", ["hostA", "hostB"])
+        by_host = load_latest_trace_by_host(str(tmp_path))
+        assert set(by_host) == {"hostA", "hostB"}
+        assert all(len(spans) == 3 for spans in by_host.values())
+
+    def test_span_refs_by_host_labels_each_host(self, tmp_path):
+        self.write_run(tmp_path, "r", ["hostA", "hostB"])
+        cap = capture(str(tmp_path))
+        cap.anchor_unix_ns = ANCHOR_NS
+        cap.spans_by_host = load_latest_trace_by_host(str(tmp_path))
+        refs = cap.span_refs_by_host(
+            {
+                "hostA": {"node": "tpu-vm-0", "host_index": 0},
+                "hostB": {"node": "tpu-vm-1", "host_index": 1},
+            },
+            service="rag",
+            slice_id="slice-0",
+        )
+        assert refs["hostA"][0]["node"] == "tpu-vm-0"
+        assert refs["hostB"][0]["host_index"] == 1
+        assert refs["hostB"][0]["slice_id"] == "slice-0"
+
+    def test_span_refs_rejects_ambiguous_multi_host_labeling(self, tmp_path):
+        self.write_run(tmp_path, "r", ["hostA", "hostB"])
+        cap = capture(str(tmp_path))
+        cap.spans_by_host = load_latest_trace_by_host(str(tmp_path))
+        with pytest.raises(ValueError, match="span_refs_by_host"):
+            cap.span_refs(node="tpu-vm-0")
+
+
+class TestCaptureSmoke:
+    def test_capture_profiles_a_jit_region(self, tmp_path):
+        """CPU backend emits no device lanes, so this asserts the
+        plumbing (anchor recorded, trace written, parse succeeds) —
+        module-span recovery is exercised by the fixture tests above
+        and on real TPU by the serving benchmark."""
+        import jax
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda x: (x * 2).sum())
+        f(jnp.ones((8,))).block_until_ready()
+        with capture(str(tmp_path)) as cap:
+            f(jnp.ones((8,))).block_until_ready()
+        assert cap.anchor_unix_ns > 0
+        assert find_trace_files(str(tmp_path))
+        assert isinstance(cap.spans, list)
+        assert cap.span_refs(service="s") == [
+            r for r in cap.span_refs(service="s")
+        ]

@@ -165,6 +165,11 @@ class SliceJoiner:
         self.pending_horizon_ns = pending_horizon_ns
         self._groups: dict[tuple[str, str, int], LaunchGroup] = {}
         self._retries: dict[str, list[_RetryObservation]] = {}
+        # Highest distinct host_index count ever seen on one launch,
+        # per slice: the completeness proxy when expected_hosts is
+        # unset (a launch is only "everyone reported" once it matches
+        # the widest membership this slice has demonstrated).
+        self._seen_hosts: dict[str, int] = {}
         self.ingested = 0
         self.skipped = 0
 
@@ -195,6 +200,9 @@ class SliceJoiner:
                 node=event.get("node", ""),
                 latency_ms=float(event.get("value", 0.0)),
                 ts_unix_nano=int(event.get("ts_unix_nano", 0)),
+            )
+            self._seen_hosts[slice_id] = max(
+                self._seen_hosts.get(slice_id, 0), len(group.hosts)
             )
             self.ingested += 1
             return True
@@ -298,37 +306,41 @@ class SliceJoiner:
         """Streaming variant of :meth:`incidents`: report-once + evict.
 
         A group is *complete* — and therefore final, skewed or healthy —
-        once every expected host has reported (``expected_hosts`` when
-        set, else ``min_hosts`` as the caller's best proxy for slice
-        size).  Complete groups are evaluated and evicted; incomplete
-        ones are kept for late-arriving host streams, so a launch is
-        reported at most once and a straggler whose *stream* is also
-        lagging is still attributed when it finally lands.  Incomplete
-        groups older than ``pending_horizon_ns`` behind the slice's
-        newest observation (a host agent died mid-stream) are attributed
+        once every expected host has reported: ``expected_hosts`` when
+        set, else the widest membership this slice has demonstrated on
+        any launch so far (never below ``min_hosts``).  Complete groups
+        are evaluated and evicted; incomplete ones are kept for
+        late-arriving host streams, so a launch is reported at most
+        once and a straggler whose *stream* is also lagging is still
+        attributed when it finally lands.  Incomplete groups older than
+        ``pending_horizon_ns`` behind *their own slice's* newest
+        observation (a host agent died mid-stream) are attributed
         best-effort from whoever reported, then evicted — memory stays
         bounded even when a host stream stops.  Retry evidence older
         than twice the retry window behind the newest observation is
         pruned for the same reason.
         """
-        threshold = (
-            self.expected_hosts
-            if self.expected_hosts > 0
-            else max(2, min_hosts)
-        )
+
+        def threshold_for(slice_id: str) -> int:
+            if self.expected_hosts > 0:
+                return self.expected_hosts
+            return max(2, min_hosts, self._seen_hosts.get(slice_id, 0))
+
         complete: dict[tuple[str, str, int], LaunchGroup] = {}
-        newest = 0
+        newest_by_slice: dict[str, int] = {}
         for key, group in self._groups.items():
             for obs in group.hosts.values():
-                newest = max(newest, obs.ts_unix_nano)
-            if len(group.hosts) >= threshold:
+                newest_by_slice[group.slice_id] = max(
+                    newest_by_slice.get(group.slice_id, 0), obs.ts_unix_nano
+                )
+            if len(group.hosts) >= threshold_for(group.slice_id):
                 complete[key] = group
         stale = {
             key: group
             for key, group in self._groups.items()
             if key not in complete
             and max(o.ts_unix_nano for o in group.hosts.values())
-            < newest - self.pending_horizon_ns
+            < newest_by_slice[group.slice_id] - self.pending_horizon_ns
         }
         out = self._evaluate(complete.values(), min_hosts)
         out += self._evaluate(stale.values(), min_hosts)

@@ -108,15 +108,35 @@ class ServeEngine:
 
     def _decode_one_fn(self):
         if self._decode_one is None:
+            # First short-budget request pays this compile; record it
+            # so the engine's own compile telemetry (the recompile-storm
+            # signal this toolkit attributes) sees the TTFT spike.
+            start = time.perf_counter()
             self._decode_one = jax.jit(
                 partial(decode_chunk, cfg=self.cfg, num_tokens=1),
                 donate_argnums=(2,),
             )
+            tokens = jnp.zeros((1,), jnp.int32)
+            cache = init_kv_cache(self.cfg, 1)
+            toks, _last, _ = self._decode_one(self.params, tokens, cache)
+            jax.block_until_ready(toks)
+            self.compile_events.append(
+                {
+                    "bucket": "decode_tail",
+                    "compile_ms": (time.perf_counter() - start) * 1000.0,
+                }
+            )
         return self._decode_one
 
-    def warmup(self, bucket: int | None = None) -> float:
-        """Compile the decode step (and one prefill bucket); returns ms."""
+    def warmup(self, bucket: int | None = None, include_tail: bool = False) -> float:
+        """Compile the decode step (and one prefill bucket); returns ms.
+
+        ``include_tail`` also pre-compiles the single-token tail path
+        so the first near-capacity prompt doesn't absorb that compile.
+        """
         start = time.perf_counter()
+        if include_tail:
+            self._decode_one_fn()
         bucket = bucket or self.prefill_buckets[0]
         tokens = jnp.zeros((1, bucket), jnp.int32)
         cache = init_kv_cache(self.cfg, 1)

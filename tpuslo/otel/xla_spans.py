@@ -1,0 +1,292 @@
+"""xprof/JAX-profiler span source for the correlation engine.
+
+The reference correlates kernel signals against OTel spans exported by
+the *instrumented* demo app (`demo/rag-service/main.go:782-820`); spans
+exist only where someone added tracing calls.  On TPU there is a better
+span source that needs no instrumentation at all: the XLA profiler
+(xprof).  ``jax.profiler.trace`` writes a trace-viewer JSON whose
+"XLA Modules" lane carries one event per device execution of a compiled
+program, named ``<module>(<program fingerprint>)`` with a monotonically
+increasing ``run_id`` — precisely the ``program_id``/``launch_id``
+identity the ``xla_launch`` correlation tier joins on
+(`tpuslo/correlation/matcher.py`), recovered here from the device's own
+timeline instead of libtpu uprobes (SURVEY.md §5 "consider xprof/
+XLA-dump hooks as the tracing source").
+
+Two caveats the API shapes around:
+
+* trace timestamps are **microseconds relative to profiling start**
+  with no wall-clock anchor in the file, so :class:`capture` records
+  ``time.time_ns()`` on entry and anchors every span to it;
+* the profile directory layout is ``<dir>/plugins/profile/<run>/
+  <host>.trace.json.gz`` — one file per host, so multi-host pods get
+  per-host span streams that feed the same SliceJoiner/matcher path as
+  probe events.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+import time
+from dataclasses import dataclass, field
+from datetime import datetime, timezone
+from typing import Any, Iterator
+
+from tpuslo.schema import rfc3339
+
+# "jit_train_step(13839021870486437105)" -> module + fingerprint.
+_MODULE_RE = re.compile(r"^(?P<module>.+?)\((?P<fingerprint>\d+)\)$")
+
+MODULES_LANE = "XLA Modules"
+OPS_LANE = "XLA Ops"
+
+
+@dataclass
+class XLASpan:
+    """One device-side execution span recovered from an xprof trace."""
+
+    name: str
+    module_name: str = ""
+    program_id: str = ""
+    launch_id: int = -1
+    start_us: float = 0.0
+    duration_us: float = 0.0
+    device_pid: int = -1
+    lane: str = MODULES_LANE
+    hlo_category: str = ""
+    args: dict[str, Any] = field(default_factory=dict)
+
+    def to_span_ref_dict(
+        self,
+        anchor_unix_ns: int,
+        service: str = "",
+        node: str = "",
+        slice_id: str = "",
+        host_index: int = -1,
+    ) -> dict[str, Any]:
+        """SpanRef-compatible dict for the correlation matcher."""
+        ts_ns = anchor_unix_ns + int(self.start_us * 1_000)
+        out: dict[str, Any] = {
+            "timestamp": rfc3339(
+                datetime.fromtimestamp(ts_ns / 1e9, tz=timezone.utc)
+            ),
+            "service": service,
+            "node": node,
+            "program_id": self.program_id,
+            "launch_id": self.launch_id,
+            "duration_ms": self.duration_us / 1000.0,
+            "name": self.module_name or self.name,
+        }
+        if slice_id:
+            out["slice_id"] = slice_id
+        if host_index >= 0:
+            out["host_index"] = host_index
+        return out
+
+
+def _thread_lanes(events: list[dict[str, Any]]) -> dict[tuple[int, int], str]:
+    lanes: dict[tuple[int, int], str] = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            lanes[(e.get("pid", -1), e.get("tid", -1))] = e["args"].get("name", "")
+    return lanes
+
+
+def parse_trace_events(
+    data: dict[str, Any], include_ops: bool = False
+) -> list[XLASpan]:
+    """XLA device spans from one trace-viewer JSON document."""
+    events = data.get("traceEvents", [])
+    lanes = _thread_lanes(events)
+    spans: list[XLASpan] = []
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        lane = lanes.get((e.get("pid", -1), e.get("tid", -1)), "")
+        if lane != MODULES_LANE and not (include_ops and lane == OPS_LANE):
+            continue
+        args = e.get("args", {}) or {}
+        name = e.get("name", "")
+        span = XLASpan(
+            name=name,
+            start_us=float(e.get("ts", 0.0)),
+            duration_us=float(e.get("dur", 0.0)),
+            device_pid=int(e.get("pid", -1)),
+            lane=lane,
+            hlo_category=args.get("hlo_category", ""),
+            args=args,
+        )
+        if lane == MODULES_LANE:
+            m = _MODULE_RE.match(name)
+            if m:
+                span.module_name = m.group("module")
+                span.program_id = m.group("fingerprint")
+            else:
+                span.module_name = name
+            try:
+                span.launch_id = int(args.get("run_id", -1))
+            except (TypeError, ValueError):
+                span.launch_id = -1
+        spans.append(span)
+    spans.sort(key=lambda s: s.start_us)
+    return spans
+
+
+def find_trace_files(log_dir: str) -> list[str]:
+    """All per-host trace-viewer files under a profiler log dir, newest
+    profile run first, host files sorted within a run."""
+    runs = sorted(
+        glob.glob(os.path.join(log_dir, "plugins", "profile", "*")),
+        key=os.path.basename,
+        reverse=True,
+    )
+    out: list[str] = []
+    for run in runs:
+        out.extend(sorted(glob.glob(os.path.join(run, "*.trace.json.gz"))))
+    return out
+
+
+def load_trace_file(path: str, include_ops: bool = False) -> list[XLASpan]:
+    with gzip.open(path, "rt", encoding="utf-8") as fh:
+        return parse_trace_events(json.load(fh), include_ops=include_ops)
+
+
+def load_latest_trace_by_host(
+    log_dir: str, include_ops: bool = False
+) -> dict[str, list[XLASpan]]:
+    """Newest profile run's spans, keyed by host (trace-file stem).
+
+    Per-host grouping matters on multi-host pods: each host's file has
+    its own ``run_id`` counter, so merging hosts would collide the
+    exact-identity (program_id, launch_id) join.
+    """
+    files = find_trace_files(log_dir)
+    if not files:
+        return {}
+    run_dir = os.path.dirname(files[0])
+    out: dict[str, list[XLASpan]] = {}
+    for path in files:
+        if os.path.dirname(path) != run_dir:
+            break
+        host = os.path.basename(path).split(".")[0]
+        out.setdefault(host, []).extend(
+            load_trace_file(path, include_ops=include_ops)
+        )
+    for spans in out.values():
+        spans.sort(key=lambda s: s.start_us)
+    return out
+
+
+def load_latest_trace(log_dir: str, include_ops: bool = False) -> list[XLASpan]:
+    """Spans from the newest profile run, all hosts merged time-sorted.
+
+    Use :func:`load_latest_trace_by_host` on multi-host pods — merged
+    launch ids are only unique per host file.
+    """
+    spans: list[XLASpan] = []
+    for host_spans in load_latest_trace_by_host(
+        log_dir, include_ops=include_ops
+    ).values():
+        spans.extend(host_spans)
+    spans.sort(key=lambda s: s.start_us)
+    return spans
+
+
+class capture:
+    """Context manager: profile a workload region and yield its spans.
+
+    Wraps ``jax.profiler.trace`` and records the wall-clock anchor the
+    trace file lacks, so ``span_refs()`` emits absolute timestamps the
+    matcher can join against probe events::
+
+        with xla_spans.capture(tmpdir) as cap:
+            engine.generate(...)
+        refs = cap.span_refs(service="rag-demo", node="host-0")
+    """
+
+    def __init__(self, log_dir: str, include_ops: bool = False):
+        self.log_dir = log_dir
+        self.include_ops = include_ops
+        self.anchor_unix_ns = 0
+        self.spans: list[XLASpan] = []
+        self.spans_by_host: dict[str, list[XLASpan]] = {}
+        self._trace_cm = None
+
+    def __enter__(self) -> "capture":
+        import jax
+
+        self.anchor_unix_ns = time.time_ns()
+        self._trace_cm = jax.profiler.trace(self.log_dir)
+        self._trace_cm.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self._trace_cm.__exit__(exc_type, exc, tb)
+        if exc_type is None:
+            self.spans_by_host = load_latest_trace_by_host(
+                self.log_dir, include_ops=self.include_ops
+            )
+            self.spans = sorted(
+                (s for spans in self.spans_by_host.values() for s in spans),
+                key=lambda s: s.start_us,
+            )
+
+    def span_refs(
+        self,
+        service: str = "",
+        node: str = "",
+        slice_id: str = "",
+        host_index: int = -1,
+        modules_only: bool = True,
+    ) -> list[dict[str, Any]]:
+        """Single-host convenience; multi-host runs must label per host
+        (launch ids are only unique within one host's file)."""
+        if len(self.spans_by_host) > 1 and (node or host_index >= 0):
+            raise ValueError(
+                "multiple host trace files captured; use "
+                "span_refs_by_host() to label each host correctly"
+            )
+        return [
+            s.to_span_ref_dict(
+                self.anchor_unix_ns,
+                service=service,
+                node=node,
+                slice_id=slice_id,
+                host_index=host_index,
+            )
+            for s in self.spans
+            if (not modules_only) or s.lane == MODULES_LANE
+        ]
+
+    def span_refs_by_host(
+        self,
+        identities: dict[str, dict[str, Any]],
+        service: str = "",
+        slice_id: str = "",
+        modules_only: bool = True,
+    ) -> dict[str, list[dict[str, Any]]]:
+        """Per-host span refs; ``identities`` maps trace-file stem →
+        ``{"node": ..., "host_index": ...}`` labels."""
+        out: dict[str, list[dict[str, Any]]] = {}
+        for host, spans in self.spans_by_host.items():
+            ident = identities.get(host, {})
+            out[host] = [
+                s.to_span_ref_dict(
+                    self.anchor_unix_ns,
+                    service=service,
+                    node=ident.get("node", host),
+                    slice_id=slice_id,
+                    host_index=int(ident.get("host_index", -1)),
+                )
+                for s in spans
+                if (not modules_only) or s.lane == MODULES_LANE
+            ]
+        return out
+
+    def launches(self) -> Iterator[XLASpan]:
+        """Module-execution spans only (one per device launch)."""
+        return (s for s in self.spans if s.lane == MODULES_LANE)
