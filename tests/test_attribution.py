@@ -142,6 +142,82 @@ class TestBayesian:
                 assert 0.0 < p < 1.0
 
 
+class TestBatchParity:
+    """attribute_batch must be semantically identical to the scalar
+    attribute_sample path it replaces in build_attributions."""
+
+    def all_samples(self):
+        samples = attribution.load_samples_jsonl(GOLDEN)
+        for label in SINGLE_FAULTS:
+            samples.append(make_sample(label))
+        # Degenerate vectors: empty (rule fallback), unknown signal
+        # names, all-healthy values, single-signal.
+        samples.append(make_sample("dns_latency", signals={}))
+        samples.append(make_sample("dns_latency", signals={"nope_ms": 9.9}))
+        samples.append(
+            make_sample("cpu_throttle", signals={"dns_latency_ms": 1.0})
+        )
+        samples.append(
+            make_sample("hbm_pressure", signals={"hbm_alloc_stall_ms": 50.0})
+        )
+        return samples
+
+    def test_batch_matches_scalar_exactly(self):
+        attributor = attribution.BayesianAttributor()
+        samples = self.all_samples()
+        batch = attributor.attribute_batch(samples)
+        scalar = [attributor.attribute_sample(s) for s in samples]
+        assert len(batch) == len(scalar)
+        for b, s in zip(batch, scalar):
+            assert b.predicted_fault_domain == s.predicted_fault_domain
+            assert b.confidence == pytest.approx(s.confidence, abs=1e-12)
+            assert [h.domain for h in b.fault_hypotheses] == [
+                h.domain for h in s.fault_hypotheses
+            ]
+            for hb, hs in zip(b.fault_hypotheses, s.fault_hypotheses):
+                assert hb.posterior == pytest.approx(hs.posterior, abs=1e-12)
+                assert hb.evidence == hs.evidence
+
+    def test_batch_preserves_input_order(self):
+        attributor = attribution.BayesianAttributor()
+        samples = [
+            make_sample("dns_latency", signals={}),  # rule fallback
+            make_sample("ici_drop"),
+            make_sample("cpu_throttle", signals={}),
+            make_sample("hbm_pressure"),
+        ]
+        preds = attributor.attribute_batch(samples)
+        assert len(preds) == 4
+        assert preds[1].predicted_fault_domain == "tpu_ici"
+        assert preds[3].predicted_fault_domain == "tpu_hbm"
+
+    def test_batch_matches_scalar_with_incomplete_custom_table(self):
+        """Missing domains in a custom likelihood row default to 0.5 as
+        a likelihood factor but 0.0 for evidence/residual membership —
+        the batch path must honor both defaults."""
+        table = attribution.default_likelihoods()
+        table["dns_latency_ms"] = {
+            d: p
+            for d, p in table["dns_latency_ms"].items()
+            if d != "network_dns"
+        }
+        attributor = attribution.BayesianAttributor(likelihoods=table)
+        samples = [
+            make_sample("dns_latency"),
+            make_sample("network_partition"),
+        ]
+        batch = attributor.attribute_batch(samples)
+        scalar = [attributor.attribute_sample(s) for s in samples]
+        for b, s in zip(batch, scalar):
+            assert b.predicted_fault_domain == s.predicted_fault_domain
+            assert [(h.domain, h.evidence) for h in b.fault_hypotheses] == [
+                (h.domain, h.evidence) for h in s.fault_hypotheses
+            ]
+
+    def test_batch_empty(self):
+        assert attribution.BayesianAttributor().attribute_batch([]) == []
+
+
 class TestPipeline:
     def test_mode_dispatch(self):
         assert attribution.normalize_mode("RULE ") == "rule"

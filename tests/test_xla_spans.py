@@ -77,6 +77,13 @@ class TestParse:
         ops = [s for s in with_ops if s.lane == OPS_LANE]
         assert len(ops) == 1 and ops[0].hlo_category == "fusion"
 
+    def test_thread_metadata_without_args_is_skipped(self):
+        doc = trace_doc()
+        doc["traceEvents"].insert(
+            0, {"ph": "M", "pid": 9, "tid": 9, "name": "thread_name"}
+        )
+        assert len(parse_trace_events(doc)) == 3  # parse survives
+
     def test_unparseable_module_name_keeps_raw_name(self):
         doc = trace_doc()
         doc["traceEvents"].append(
@@ -150,6 +157,19 @@ class TestFiles:
         self.write_run(tmp_path, "r", ["hostA", "hostB"])
         by_host = load_latest_trace_by_host(str(tmp_path))
         assert set(by_host) == {"hostA", "hostB"}
+        assert all(len(spans) == 3 for spans in by_host.values())
+
+    def test_dotted_hostnames_stay_distinct(self, tmp_path):
+        self.write_run(
+            tmp_path,
+            "r",
+            ["worker.zone-a.internal", "worker.zone-b.internal"],
+        )
+        by_host = load_latest_trace_by_host(str(tmp_path))
+        assert set(by_host) == {
+            "worker.zone-a.internal",
+            "worker.zone-b.internal",
+        }
         assert all(len(spans) == 3 for spans in by_host.values())
 
     def test_span_refs_by_host_labels_each_host(self, tmp_path):

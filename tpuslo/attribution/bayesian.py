@@ -18,6 +18,8 @@ from __future__ import annotations
 import math
 from dataclasses import dataclass, field
 
+import numpy as np
+
 from tpuslo.attribution.mapper import FaultSample, build_attribution
 from tpuslo.schema import FaultHypothesis, IncidentAttribution
 
@@ -197,8 +199,41 @@ class Posterior:
     evidence: list[str] = field(default_factory=list)
 
 
+@dataclass
+class _Matrices:
+    """Dense numpy views of the likelihood table for the batch path."""
+
+    signals: list[str]
+    signal_index: dict[str, int]
+    log_lik: np.ndarray  # [S, D] log clamp(P(elev|domain))
+    log_not_lik: np.ndarray  # [S, D] log clamp(1 - P)
+    log_priors: np.ndarray  # [D]
+    thresholds: np.ndarray  # [S] (+inf where no elevation threshold)
+    supports: np.ndarray  # [S, D] raw P >= 0.5 (evidence membership)
+
+
 def _clamp(p: float) -> float:
     return min(0.99, max(0.01, p))
+
+
+def _sort_hypotheses(hypotheses) -> list[FaultHypothesis]:
+    """Deterministic hypothesis order: posterior desc, domain order.
+
+    Posteriors are rounded to 1e-9 for the comparison so the scalar and
+    vectorized paths (whose float summation orders differ in the last
+    ulps) rank exact ties identically.
+    """
+    return sorted(
+        hypotheses,
+        key=lambda h: (-round(h.posterior, 9), ALL_DOMAINS.index(h.domain)),
+    )
+
+
+def _softmax_rows(log_p: np.ndarray) -> np.ndarray:
+    """Row-wise softmax with the same log-sum-exp shift as the scalar path."""
+    shifted = log_p - log_p.max(axis=1, keepdims=True)
+    e = np.exp(shifted)
+    return e / e.sum(axis=1, keepdims=True)
 
 
 class BayesianAttributor:
@@ -214,6 +249,53 @@ class BayesianAttributor:
     ):
         self.priors = priors or default_priors()
         self.likelihoods = likelihoods or default_likelihoods()
+        self._mat: _Matrices | None = None
+
+    def _matrices(self) -> "_Matrices":
+        """Dense [signal × domain] views of the table, built lazily.
+
+        Priors/likelihoods are fixed after construction, so the build
+        happens once and every batch reuses it.
+        """
+        if self._mat is None:
+            signals = list(self.likelihoods)
+            # Likelihood factors default a missing domain to 0.5
+            # (scalar `_likelihood`), but evidence/residual membership
+            # defaults it to 0.0 (scalar `.get(domain, 0.0) >= 0.5`) —
+            # two different matrices, or incomplete custom tables
+            # diverge between the paths.
+            raw = np.array(
+                [
+                    [self.likelihoods[s].get(d, 0.5) for d in ALL_DOMAINS]
+                    for s in signals
+                ]
+            )
+            raw_support = np.array(
+                [
+                    [self.likelihoods[s].get(d, 0.0) for d in ALL_DOMAINS]
+                    for s in signals
+                ]
+            )
+            clamped = np.clip(raw, 0.01, 0.99)
+            self._mat = _Matrices(
+                signals=signals,
+                signal_index={s: i for i, s in enumerate(signals)},
+                log_lik=np.log(clamped),
+                log_not_lik=np.log(np.clip(1.0 - raw, 0.01, 0.99)),
+                log_priors=np.log(
+                    np.maximum(
+                        [self.priors.get(d, 0.0) for d in ALL_DOMAINS], 1e-10
+                    )
+                ),
+                thresholds=np.array(
+                    [
+                        SIGNAL_ELEVATION_THRESHOLDS.get(s, math.inf)
+                        for s in signals
+                    ]
+                ),
+                supports=raw_support >= 0.5,
+            )
+        return self._mat
 
     def elevated_signals(self, signals: dict[str, float]) -> set[str]:
         return {
@@ -311,12 +393,115 @@ class BayesianAttributor:
                 secondary.domain, secondary.posterior, secondary.evidence
             )
 
-        base.fault_hypotheses = sorted(
-            hypotheses.values(), key=lambda h: h.posterior, reverse=True
-        )
+        base.fault_hypotheses = _sort_hypotheses(hypotheses.values())
         base.predicted_fault_domain = posteriors[0].domain
         base.confidence = posteriors[0].posterior
         return base
+
+    def attribute_batch(
+        self, samples: list[FaultSample]
+    ) -> list[IncidentAttribution]:
+        """Vectorized :meth:`attribute_sample` over a batch.
+
+        Semantics are identical (parity-tested); the per-sample
+        18-signal × 12-domain log-likelihood accumulation and the
+        residual explaining-away pass each become one masked matmul
+        over the whole batch, so throughput scales with numpy rather
+        than Python dict lookups.
+        """
+        mat = self._matrices()
+        n_dom = len(ALL_DOMAINS)
+        out: list[IncidentAttribution | None] = [None] * len(samples)
+
+        rows = []  # (sample_pos, observed, values) for the bayes path
+        for pos, sample in enumerate(samples):
+            if not sample.signals:
+                out[pos] = build_attribution(sample)
+                continue
+            rows.append(pos)
+        if not rows:
+            return [a for a in out if a is not None]
+
+        n = len(rows)
+        n_sig = len(mat.signals)
+        observed = np.zeros((n, n_sig), dtype=bool)
+        values = np.zeros((n, n_sig))
+        for i, pos in enumerate(rows):
+            for name, value in samples[pos].signals.items():
+                idx = mat.signal_index.get(name)
+                if idx is not None:
+                    observed[i, idx] = True
+                    values[i, idx] = value
+        elevated = observed & (values >= mat.thresholds)
+
+        # [n, D] = Σ_s elevated·logP + Σ_s observed-but-healthy·log(1-P)
+        log_post = (
+            mat.log_priors
+            + elevated @ mat.log_lik
+            + (observed & ~elevated) @ mat.log_not_lik
+        )
+        posteriors = _softmax_rows(log_post)
+
+        # Residual explaining-away pass, one matmul for the batch: the
+        # residual signals are elevated by construction, so only the
+        # log-likelihood term appears (priors + R @ logL).
+        top_idx = posteriors.argmax(axis=1)
+        residual = elevated & ~mat.supports[:, top_idx].T
+        has_residual = residual.any(axis=1)
+        res_posteriors = np.zeros((n, n_dom))
+        if has_residual.any():
+            res_log = mat.log_priors + residual @ mat.log_lik
+            res_posteriors[has_residual] = _softmax_rows(
+                res_log[has_residual]
+            )
+
+        unknown_idx = ALL_DOMAINS.index(DOMAIN_UNKNOWN)
+        for i, pos in enumerate(rows):
+            sample = samples[pos]
+            elev_names = [
+                mat.signals[s] for s in np.flatnonzero(elevated[i])
+            ]
+
+            def evidence_for(d: int) -> list[str]:
+                return sorted(
+                    name
+                    for name in elev_names
+                    if mat.supports[mat.signal_index[name], d]
+                )
+
+            order = sorted(
+                range(n_dom), key=lambda d: posteriors[i, d], reverse=True
+            )
+            top = order[0]
+            hypotheses = {
+                ALL_DOMAINS[d]: FaultHypothesis(
+                    ALL_DOMAINS[d], float(posteriors[i, d]), evidence_for(d)
+                )
+                for d in order
+                if posteriors[i, d] >= 0.01
+            }
+
+            if has_residual[i]:
+                win = int(res_posteriors[i].argmax())
+                win_evidence = evidence_for(win)
+                if win not in (top, unknown_idx) and win_evidence:
+                    weight = max(1.0 - float(posteriors[i, top]), 0.1)
+                    sec_post = float(res_posteriors[i, win]) * weight
+                    name = ALL_DOMAINS[win]
+                    if (
+                        name not in hypotheses
+                        or hypotheses[name].posterior < sec_post
+                    ):
+                        hypotheses[name] = FaultHypothesis(
+                            name, sec_post, win_evidence
+                        )
+
+            base = build_attribution(sample)
+            base.fault_hypotheses = _sort_hypotheses(hypotheses.values())
+            base.predicted_fault_domain = ALL_DOMAINS[top]
+            base.confidence = float(posteriors[i, top])
+            out[pos] = base
+        return [a for a in out if a is not None]
 
     def _residual_posterior(
         self, signals: dict[str, float], top: Posterior

@@ -38,7 +38,9 @@ def build_attributions(
     if normalize_mode(mode) == MODE_RULE:
         return [build_attribution(s) for s in samples]
     attributor = attributor or BayesianAttributor()
-    return [attributor.attribute_sample(s) for s in samples]
+    # Vectorized path; parity with per-sample attribute_sample is
+    # covered by tests/test_attribution.py::TestBatchParity.
+    return attributor.attribute_batch(samples)
 
 
 def _actual_domain(sample: FaultSample) -> str:

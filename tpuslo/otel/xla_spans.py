@@ -92,7 +92,8 @@ def _thread_lanes(events: list[dict[str, Any]]) -> dict[tuple[int, int], str]:
     lanes: dict[tuple[int, int], str] = {}
     for e in events:
         if e.get("ph") == "M" and e.get("name") == "thread_name":
-            lanes[(e.get("pid", -1), e.get("tid", -1))] = e["args"].get("name", "")
+            args = e.get("args") or {}
+            lanes[(e.get("pid", -1), e.get("tid", -1))] = args.get("name", "")
     return lanes
 
 
@@ -172,7 +173,9 @@ def load_latest_trace_by_host(
     for path in files:
         if os.path.dirname(path) != run_dir:
             break
-        host = os.path.basename(path).split(".")[0]
+        # Strip the fixed suffix only: dotted hostnames must stay
+        # distinct or per-host run_id counters would collide.
+        host = os.path.basename(path)[: -len(".trace.json.gz")]
         out.setdefault(host, []).extend(
             load_trace_file(path, include_ops=include_ops)
         )
