@@ -214,6 +214,47 @@ class TestBatchParity:
                 (h.domain, h.evidence) for h in s.fault_hypotheses
             ]
 
+    def test_batch_parity_with_table_missing_a_thresholded_signal(self):
+        """An elevated signal with no likelihood row contributes no
+        factors but must still trigger the residual pass (scalar
+        counts it as unexplained by every domain)."""
+        table = attribution.default_likelihoods()
+        del table["syscall_latency_ms"]
+        attributor = attribution.BayesianAttributor(likelihoods=table)
+        sample = make_sample(
+            "network_partition",
+            signals={
+                "dns_latency_ms": 100.0,
+                "tcp_retransmits_total": 6.0,
+                "connect_latency_ms": 200.0,
+                "syscall_latency_ms": 120.0,  # elevated, tableless
+            },
+        )
+        b = attributor.attribute_batch([sample])[0]
+        s = attributor.attribute_sample(sample)
+        assert b.predicted_fault_domain == s.predicted_fault_domain
+        assert [(h.domain, h.evidence) for h in b.fault_hypotheses] == [
+            (h.domain, h.evidence) for h in s.fault_hypotheses
+        ]
+        for hb, hs in zip(b.fault_hypotheses, s.fault_hypotheses):
+            assert hb.posterior == pytest.approx(hs.posterior, abs=1e-12)
+
+    def test_batch_tracks_live_table_mutation(self):
+        """The scalar path reads priors/likelihoods live; the batch
+        path must not serve stale cached matrices."""
+        attributor = attribution.BayesianAttributor()
+        sample = make_sample("dns_latency")
+        before = attributor.attribute_batch([sample])[0]
+        attributor.likelihoods["dns_latency_ms"] = {
+            d: 0.01 for d in attribution.ALL_DOMAINS
+        }
+        after = attributor.attribute_batch([sample])[0]
+        scalar_after = attributor.attribute_sample(sample)
+        assert after.confidence == pytest.approx(
+            scalar_after.confidence, abs=1e-12
+        )
+        assert after.confidence != pytest.approx(before.confidence, abs=1e-6)
+
     def test_batch_empty(self):
         assert attribution.BayesianAttributor().attribute_batch([]) == []
 

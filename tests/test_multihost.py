@@ -248,6 +248,53 @@ class TestSliceCorrCLI:
         assert meta["incidents"] == len(incidents)
         assert meta["by_cause"] == {"ici_link": len(incidents)}
 
+    def test_xprof_dir_mode(self, tmp_path, capsys):
+        """slicecorr --xprof-dir runs the whole xprof -> collective
+        signals -> straggler pipeline from trace files on disk."""
+        import gzip
+
+        from tests.test_xla_spans import trace_doc_with_collectives
+        from tpuslo.cli.slicecorr import main
+
+        run = tmp_path / "plugins" / "profile" / "run1"
+        run.mkdir(parents=True)
+        for host, straggler in (("vm-0", False), ("vm-1", True), ("vm-2", False)):
+            with gzip.open(run / f"{host}.trace.json.gz", "wt") as fh:
+                json.dump(trace_doc_with_collectives(straggler=straggler), fh)
+        out = tmp_path / "inc.jsonl"
+        rc = main(
+            [
+                "--xprof-dir",
+                str(tmp_path),
+                "--slice-id",
+                "s9",
+                "--skew-floor-ms",
+                "0.1",
+                "--output",
+                str(out),
+            ]
+        )
+        assert rc == 0
+        incidents = [
+            json.loads(line) for line in out.read_text().splitlines() if line
+        ]
+        assert incidents
+        # vm-1 sorts to host_index 1 and waited least: the straggler.
+        assert all(i["straggler_host"] == 1 for i in incidents)
+        assert all(i["slice_id"] == "s9" for i in incidents)
+
+    def test_xprof_dir_without_traces_errors(self, tmp_path, capsys):
+        from tpuslo.cli.slicecorr import main
+
+        assert main(["--xprof-dir", str(tmp_path)]) == 2
+        assert "no xprof profile runs" in capsys.readouterr().err
+
+    def test_xprof_dir_and_jsonl_inputs_mutually_exclusive(self, tmp_path, capsys):
+        from tpuslo.cli.slicecorr import main
+
+        assert main(["some.jsonl", "--xprof-dir", str(tmp_path)]) == 2
+        assert "mutually exclusive" in capsys.readouterr().err
+
     def test_stdin_dash_mixed_with_files(self, tmp_path, monkeypatch, capsys):
         import io
 

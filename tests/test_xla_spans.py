@@ -123,6 +123,135 @@ class TestParse:
         assert delta_ms == pytest.approx(0.2, abs=1e-6)  # 300us - 100us
 
 
+def trace_doc_with_collectives(base_latency_us=400.0, straggler=False):
+    """Two module launches, each containing an all-reduce whose
+    duration encodes the collective wait (punctual hosts wait longer)."""
+    wait = 50.0 if straggler else base_latency_us
+    events = [
+        {"ph": "M", "pid": 3, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 3, "tid": 2, "name": "thread_name",
+         "args": {"name": "XLA Modules"}},
+        {"ph": "M", "pid": 3, "tid": 3, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+    ]
+    for launch in range(2):
+        t0 = 1000.0 * launch
+        events.append(
+            {"ph": "X", "pid": 3, "tid": 2, "ts": t0, "dur": 900.0,
+             "name": "jit_train_step(777)", "args": {"run_id": str(launch)}}
+        )
+        events.append(  # sync all-reduce inside the module
+            {"ph": "X", "pid": 3, "tid": 3, "ts": t0 + 10.0, "dur": wait,
+             "name": "all-reduce.3", "args": {"hlo_category": "all-reduce"}}
+        )
+        events.append(  # async pair caught by name fallback
+            {"ph": "X", "pid": 3, "tid": 3, "ts": t0 + 200.0, "dur": 20.0,
+             "name": "all-gather-start.1", "args": {"hlo_category": "fusion"}}
+        )
+        events.append(  # non-collective op: never extracted
+            {"ph": "X", "pid": 3, "tid": 3, "ts": t0 + 300.0, "dur": 99.0,
+             "name": "fusion.7", "args": {"hlo_category": "fusion"}}
+        )
+    # A collective outside any module span: skipped.
+    events.append(
+        {"ph": "X", "pid": 3, "tid": 3, "ts": 5000.0, "dur": 11.0,
+         "name": "all-reduce.9", "args": {"hlo_category": "all-reduce"}}
+    )
+    return events and {"traceEvents": events}
+
+
+class TestCollectiveExtraction:
+    def spans(self, straggler=False):
+        from tpuslo.otel.xla_spans import parse_trace_events
+
+        return parse_trace_events(
+            trace_doc_with_collectives(straggler=straggler), include_ops=True
+        )
+
+    def test_per_launch_totals_with_identity(self):
+        from tpuslo.otel.xla_spans import extract_collective_signals
+
+        events = extract_collective_signals(
+            self.spans(), ANCHOR_NS, node="host-0", slice_id="s0", host_index=0
+        )
+        assert len(events) == 2  # one per module launch
+        for launch, ev in enumerate(events):
+            assert ev["signal"] == "ici_collective_latency_ms"
+            assert ev["tpu"]["launch_id"] == launch
+            assert ev["tpu"]["program_id"] == "777"
+            assert ev["value"] == pytest.approx(0.42)  # (400+20)us in ms
+            assert ev["tpu"]["slice_id"] == "s0"
+
+    def test_events_validate_against_probe_schema(self):
+        from tpuslo import schema
+        from tpuslo.otel.xla_spans import extract_collective_signals
+
+        for ev in extract_collective_signals(
+            self.spans(), ANCHOR_NS, node="host-0"
+        ):
+            schema.validate(ev, schema.SCHEMA_PROBE_EVENT)
+
+    def test_orphan_collective_outside_modules_skipped(self):
+        from tpuslo.otel.xla_spans import extract_collective_signals
+
+        events = extract_collective_signals(self.spans(), ANCHOR_NS)
+        # Only two events (per launch); the ts=5000 orphan contributed
+        # to neither.
+        assert len(events) == 2
+        assert sum(e["value"] for e in events) == pytest.approx(0.84)
+
+    def test_multi_device_host_keeps_per_chip_containment(self):
+        """Two chips run the same launch concurrently: ops must pair
+        with their own device's module span (no double-counting), and
+        chips of one host aggregate into one event per launch."""
+        from tpuslo.otel.xla_spans import (
+            extract_collective_signals,
+            parse_trace_events,
+        )
+
+        doc = {"traceEvents": []}
+        for pid in (3, 4):  # two devices, overlapping in time
+            doc["traceEvents"] += [
+                {"ph": "M", "pid": pid, "tid": 2, "name": "thread_name",
+                 "args": {"name": "XLA Modules"}},
+                {"ph": "M", "pid": pid, "tid": 3, "name": "thread_name",
+                 "args": {"name": "XLA Ops"}},
+                {"ph": "X", "pid": pid, "tid": 2, "ts": 100.0, "dur": 500.0,
+                 "name": "jit_step(9)", "args": {"run_id": "0"}},
+                {"ph": "X", "pid": pid, "tid": 3, "ts": 150.0, "dur": 100.0,
+                 "name": "all-reduce.1",
+                 "args": {"hlo_category": "all-reduce"}},
+            ]
+        spans = parse_trace_events(doc, include_ops=True)
+        events = extract_collective_signals(spans, ANCHOR_NS, node="h")
+        assert len(events) == 1  # one launch, both chips aggregated
+        assert events[0]["value"] == pytest.approx(0.2)  # 100us x 2 chips
+        assert events[0]["tpu"]["launch_id"] == 0
+
+    def test_xprof_to_slicecorr_end_to_end(self):
+        """Real pipeline shape: per-host xprof traces -> collective
+        signals -> SliceJoiner names the straggler host."""
+        from tpuslo.correlation.multihost import SliceJoiner
+        from tpuslo.otel.xla_spans import extract_collective_signals_by_host
+
+        by_host = {
+            "vm-0": self.spans(),
+            "vm-1": self.spans(),
+            "vm-2": self.spans(straggler=True),  # enters late, waits less
+            "vm-3": self.spans(),
+        }
+        events = extract_collective_signals_by_host(
+            by_host, ANCHOR_NS, slice_id="slice-0"
+        )
+        joiner = SliceJoiner(expected_hosts=4, skew_floor_ms=0.1)
+        joiner.add_all(events)
+        incidents = joiner.incidents()
+        assert len(incidents) == 2  # both launches skewed
+        assert all(i.straggler_host == 2 for i in incidents)
+        assert all(i.cause == "compute_straggler" for i in incidents)
+
+
 class TestFiles:
     def write_run(self, tmp_path, run, hosts):
         d = tmp_path / "plugins" / "profile" / run

@@ -249,53 +249,51 @@ class BayesianAttributor:
     ):
         self.priors = priors or default_priors()
         self.likelihoods = likelihoods or default_likelihoods()
-        self._mat: _Matrices | None = None
 
     def _matrices(self) -> "_Matrices":
-        """Dense [signal × domain] views of the table, built lazily.
+        """Dense [signal × domain] views of the table.
 
-        Priors/likelihoods are fixed after construction, so the build
-        happens once and every batch reuses it.
+        Rebuilt on every batch — the build is microseconds against the
+        batch itself, and callers may mutate the public
+        ``priors``/``likelihoods`` dicts between calls (the scalar path
+        reads them live, so the batch path must too).
         """
-        if self._mat is None:
-            signals = list(self.likelihoods)
-            # Likelihood factors default a missing domain to 0.5
-            # (scalar `_likelihood`), but evidence/residual membership
-            # defaults it to 0.0 (scalar `.get(domain, 0.0) >= 0.5`) —
-            # two different matrices, or incomplete custom tables
-            # diverge between the paths.
-            raw = np.array(
+        signals = list(self.likelihoods)
+        # Likelihood factors default a missing domain to 0.5 (scalar
+        # `_likelihood`), but evidence/residual membership defaults it
+        # to 0.0 (scalar `.get(domain, 0.0) >= 0.5`) — two different
+        # matrices, or incomplete custom tables diverge between paths.
+        shape = (len(signals), len(ALL_DOMAINS))
+        raw = np.array(
+            [
+                [self.likelihoods[s].get(d, 0.5) for d in ALL_DOMAINS]
+                for s in signals
+            ]
+        ).reshape(shape)
+        raw_support = np.array(
+            [
+                [self.likelihoods[s].get(d, 0.0) for d in ALL_DOMAINS]
+                for s in signals
+            ]
+        ).reshape(shape)
+        return _Matrices(
+            signals=signals,
+            signal_index={s: i for i, s in enumerate(signals)},
+            log_lik=np.log(np.clip(raw, 0.01, 0.99)),
+            log_not_lik=np.log(np.clip(1.0 - raw, 0.01, 0.99)),
+            log_priors=np.log(
+                np.maximum(
+                    [self.priors.get(d, 0.0) for d in ALL_DOMAINS], 1e-10
+                )
+            ),
+            thresholds=np.array(
                 [
-                    [self.likelihoods[s].get(d, 0.5) for d in ALL_DOMAINS]
+                    SIGNAL_ELEVATION_THRESHOLDS.get(s, math.inf)
                     for s in signals
                 ]
-            )
-            raw_support = np.array(
-                [
-                    [self.likelihoods[s].get(d, 0.0) for d in ALL_DOMAINS]
-                    for s in signals
-                ]
-            )
-            clamped = np.clip(raw, 0.01, 0.99)
-            self._mat = _Matrices(
-                signals=signals,
-                signal_index={s: i for i, s in enumerate(signals)},
-                log_lik=np.log(clamped),
-                log_not_lik=np.log(np.clip(1.0 - raw, 0.01, 0.99)),
-                log_priors=np.log(
-                    np.maximum(
-                        [self.priors.get(d, 0.0) for d in ALL_DOMAINS], 1e-10
-                    )
-                ),
-                thresholds=np.array(
-                    [
-                        SIGNAL_ELEVATION_THRESHOLDS.get(s, math.inf)
-                        for s in signals
-                    ]
-                ),
-                supports=raw_support >= 0.5,
-            )
-        return self._mat
+            ),
+            supports=raw_support >= 0.5,
+        )
 
     def elevated_signals(self, signals: dict[str, float]) -> set[str]:
         return {
@@ -426,12 +424,21 @@ class BayesianAttributor:
         n_sig = len(mat.signals)
         observed = np.zeros((n, n_sig), dtype=bool)
         values = np.zeros((n, n_sig))
+        # Elevated signals missing from the likelihood table contribute
+        # no factors but DO trigger the scalar residual pass (they are
+        # unexplained by any domain); track them separately.
+        extra_trigger = np.zeros(n, dtype=bool)
         for i, pos in enumerate(rows):
             for name, value in samples[pos].signals.items():
                 idx = mat.signal_index.get(name)
                 if idx is not None:
                     observed[i, idx] = True
                     values[i, idx] = value
+                elif (
+                    name in SIGNAL_ELEVATION_THRESHOLDS
+                    and value >= SIGNAL_ELEVATION_THRESHOLDS[name]
+                ):
+                    extra_trigger[i] = True
         elevated = observed & (values >= mat.thresholds)
 
         # [n, D] = Σ_s elevated·logP + Σ_s observed-but-healthy·log(1-P)
@@ -447,7 +454,7 @@ class BayesianAttributor:
         # log-likelihood term appears (priors + R @ logL).
         top_idx = posteriors.argmax(axis=1)
         residual = elevated & ~mat.supports[:, top_idx].T
-        has_residual = residual.any(axis=1)
+        has_residual = residual.any(axis=1) | extra_trigger
         res_posteriors = np.zeros((n, n_dom))
         if has_residual.any():
             res_log = mat.log_priors + residual @ mat.log_lik

@@ -30,6 +30,20 @@ def build_parser() -> argparse.ArgumentParser:
         nargs="*",
         help="per-host probe-event JSONL files ('-' or empty = stdin)",
     )
+    p.add_argument(
+        "--xprof-dir",
+        default="",
+        help="profiler log dir: extract per-host collective signals "
+        "from the newest xprof run instead of reading JSONL "
+        "(requires a trace captured with ops; see tpuslo.otel.xla_spans)",
+    )
+    p.add_argument(
+        "--xprof-anchor-ns",
+        type=int,
+        default=0,
+        help="wall-clock ns of profiling start (0 = trace-relative)",
+    )
+    p.add_argument("--slice-id", default="slice-0")
     p.add_argument("--output", default="-", help="incidents JSONL ('-' = stdout)")
     p.add_argument("--expected-hosts", type=int, default=0)
     p.add_argument("--min-hosts", type=int, default=2)
@@ -69,7 +83,38 @@ def main(argv: list[str] | None = None) -> int:
     # truncating a line — exactly the crash-consistency shape this
     # tool's inputs come from); same contract as attributor/collector.
     try:
-        joiner.add_all(_read_events(args.inputs))
+        if args.xprof_dir:
+            if args.inputs:
+                print(
+                    "slicecorr: --xprof-dir and JSONL inputs are mutually "
+                    "exclusive",
+                    file=sys.stderr,
+                )
+                return 2
+            from tpuslo.otel.xla_spans import (
+                extract_collective_signals_by_host,
+                load_latest_trace_by_host,
+            )
+
+            by_host = load_latest_trace_by_host(
+                args.xprof_dir, include_ops=True
+            )
+            if not by_host:
+                # Silent zero-incidents here would read as "healthy".
+                print(
+                    f"slicecorr: no xprof profile runs under "
+                    f"{args.xprof_dir!r} (expected plugins/profile/"
+                    f"<run>/*.trace.json.gz)",
+                    file=sys.stderr,
+                )
+                return 2
+            joiner.add_all(
+                extract_collective_signals_by_host(
+                    by_host, args.xprof_anchor_ns, slice_id=args.slice_id
+                )
+            )
+        else:
+            joiner.add_all(_read_events(args.inputs))
         incidents = joiner.incidents(min_hosts=args.min_hosts)
 
         sink = (

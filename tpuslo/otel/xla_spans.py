@@ -26,6 +26,7 @@ Two caveats the API shapes around:
 
 from __future__ import annotations
 
+import bisect
 import glob
 import gzip
 import json
@@ -197,6 +198,155 @@ def load_latest_trace(log_dir: str, include_ops: bool = False) -> list[XLASpan]:
         spans.extend(host_spans)
     spans.sort(key=lambda s: s.start_us)
     return spans
+
+
+COLLECTIVE_MARKERS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+)
+
+
+def is_collective_op(span: XLASpan) -> bool:
+    """Does this ops-lane span belong to a cross-chip collective?
+
+    Matches the HLO category first (canonical), falling back to the op
+    name so async variants (``all-reduce-start``/``-done``) and fusions
+    that keep the collective in their name are caught.
+    """
+    if span.lane != OPS_LANE:
+        return False
+    hay = f"{span.hlo_category} {span.name}"
+    return any(marker in hay for marker in COLLECTIVE_MARKERS)
+
+
+def extract_collective_signals(
+    spans: list[XLASpan],
+    anchor_unix_ns: int,
+    node: str = "",
+    slice_id: str = "",
+    host_index: int = -1,
+    namespace: str = "llm-slo",
+    pod: str = "",
+    chip: str = "accel0",
+) -> list[dict[str, Any]]:
+    """``ici_collective_latency_ms`` probe events from one host's trace.
+
+    A second, eBPF-free source for the signal the libtpu uprobes
+    produce (``ebpf/c/libtpu_uprobes.bpf.c``): each collective op in
+    the XLA Ops lane is assigned to its enclosing module execution by
+    time containment, and per (module launch) the op durations are
+    summed into one event carrying the launch's exact
+    ``program_id``/``launch_id`` identity.  The straggler physics of
+    `tpuslo/correlation/multihost.py` carries over: punctual hosts
+    accumulate wait time *inside* collectives, the late host does not,
+    so per-launch totals joined across hosts by SliceJoiner still name
+    the straggler.  Requires a trace captured with ``include_ops=True``.
+    """
+    from tpuslo.signals.generator import signal_status
+
+    # Module launches grouped per device pid: multi-chip hosts run the
+    # same launch concurrently on every chip, so containment must pair
+    # an op with *its own device's* module span or collective time gets
+    # double-counted onto whichever chip sorts first.
+    mods_by_dev: dict[int, list[XLASpan]] = {}
+    for s in spans:
+        if s.lane == MODULES_LANE:
+            mods_by_dev.setdefault(s.device_pid, []).append(s)
+    starts_by_dev: dict[int, list[float]] = {}
+    for dev, mods in mods_by_dev.items():
+        mods.sort(key=lambda s: s.start_us)
+        starts_by_dev[dev] = [m.start_us for m in mods]
+
+    # One signal per launch per host: chips of one host aggregate by
+    # the launch's (program_id, launch_id) identity.
+    totals: dict[tuple[str, int], float] = {}
+    anchor_mod: dict[tuple[str, int], XLASpan] = {}
+    orphan = 0  # anonymous launches (no run_id) get unique keys
+    for op in spans:
+        if not is_collective_op(op):
+            continue
+        mods = mods_by_dev.get(op.device_pid, [])
+        idx = bisect.bisect_right(starts_by_dev.get(op.device_pid, []), op.start_us) - 1
+        if idx < 0:
+            continue
+        mod = mods[idx]
+        if not op.start_us < mod.start_us + mod.duration_us:
+            continue
+        if mod.launch_id >= 0:
+            key = (mod.program_id, mod.launch_id)
+        else:
+            orphan += 1
+            key = (f"{mod.program_id}#anon{orphan}", -1)
+        totals[key] = totals.get(key, 0.0) + op.duration_us / 1000.0
+        prior = anchor_mod.get(key)
+        if prior is None or mod.start_us < prior.start_us:
+            anchor_mod[key] = mod
+
+    out: list[dict[str, Any]] = []
+    for key, total_ms in sorted(
+        totals.items(), key=lambda kv: anchor_mod[kv[0]].start_us
+    ):
+        mod = anchor_mod[key]
+        tpu: dict[str, Any] = {"chip": chip}
+        if slice_id:
+            tpu["slice_id"] = slice_id
+        if host_index >= 0:
+            tpu["host_index"] = host_index
+        if mod.program_id:
+            tpu["program_id"] = mod.program_id
+        if mod.launch_id >= 0:
+            tpu["launch_id"] = mod.launch_id
+        if mod.module_name:
+            tpu["module_name"] = mod.module_name
+        out.append(
+            {
+                "ts_unix_nano": anchor_unix_ns + int(mod.start_us * 1_000),
+                "signal": "ici_collective_latency_ms",
+                "node": node,
+                "namespace": namespace,
+                "pod": pod or node,
+                "container": "xprof",
+                "pid": 0,
+                "tid": 0,
+                "value": round(total_ms, 4),
+                "unit": "ms",
+                "status": signal_status("ici_collective_latency_ms", total_ms),
+                "tpu": tpu,
+            }
+        )
+    return out
+
+
+def extract_collective_signals_by_host(
+    spans_by_host: dict[str, list[XLASpan]],
+    anchor_unix_ns: int,
+    identities: dict[str, dict[str, Any]] | None = None,
+    slice_id: str = "",
+    namespace: str = "llm-slo",
+) -> list[dict[str, Any]]:
+    """Flat event list over every host, ready for ``SliceJoiner.add_all``.
+
+    ``identities`` maps trace-file stem → ``{"node": ..,
+    "host_index": ..}``; hosts default to their stem and list position.
+    """
+    identities = identities or {}
+    out: list[dict[str, Any]] = []
+    for pos, (host, spans) in enumerate(sorted(spans_by_host.items())):
+        ident = identities.get(host, {})
+        out.extend(
+            extract_collective_signals(
+                spans,
+                anchor_unix_ns,
+                node=ident.get("node", host),
+                slice_id=slice_id,
+                host_index=int(ident.get("host_index", pos)),
+                namespace=namespace,
+            )
+        )
+    return out
 
 
 class capture:
