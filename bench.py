@@ -105,6 +105,19 @@ def bench_serving() -> dict:
                 decode_tokens / decode_window if decode_window > 0 else 0.0, 2
             ),
         }
+        # Aggregate throughput: batch-8 decode shares the MXU across
+        # requests (B=1 leaves the systolic array mostly idle).
+        prompts = [f"{prompt} #{i}" for i in range(8)]
+        engine.generate_batch(prompts, max_new_tokens=8, stop_at_eos=False)
+        t0 = time.perf_counter()
+        rows = engine.generate_batch(
+            prompts, max_new_tokens=128, stop_at_eos=False
+        )
+        batch_elapsed = time.perf_counter() - t0
+        total_tokens = sum(len(r) for r in rows)
+        out["batch8_aggregate_tokens_per_sec"] = round(
+            total_tokens / batch_elapsed if batch_elapsed > 0 else 0.0, 2
+        )
         # Zero-instrumentation span source: capture xprof over a short
         # serve and count recovered XLA launch spans (program+run_id
         # identity for the xla_launch correlation tier).  Device lanes

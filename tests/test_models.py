@@ -288,6 +288,43 @@ class TestServeEngine:
         engine.warmup(include_tail=True)
         assert engine._decode_one is not None
 
+    def test_generate_batch_matches_single_request_rows(self):
+        """Per-row cache lengths: each batched row must reproduce its
+        single-request greedy decode (no cross-row contamination, no
+        pad conditioning)."""
+        engine = ServeEngine(cfg=llama.llama_tiny(max_seq_len=256))
+        prompts = ["short", "a rather longer prompt with more bytes", "mid one"]
+        batch_out = engine.generate_batch(
+            prompts, max_new_tokens=12, stop_at_eos=False
+        )
+        for prompt, row in zip(prompts, batch_out):
+            single = [
+                e.token_id
+                for e in engine.generate(
+                    prompt, max_new_tokens=12, stop_at_eos=False
+                )
+            ]
+            assert row == single
+
+    def test_generate_batch_eos_trims_per_row(self):
+        engine = ServeEngine(cfg=llama.llama_tiny(max_seq_len=128))
+        out = engine.generate_batch(["a", "bb"], max_new_tokens=16)
+        assert len(out) == 2
+        for row in out:
+            assert 1 <= len(row) <= 16
+            if EOS in row:
+                assert row[-1] == EOS and row.count(EOS) == 1
+
+    def test_generate_batch_empty_and_padding(self):
+        engine = ServeEngine(cfg=llama.llama_tiny(max_seq_len=128))
+        assert engine.generate_batch([]) == []
+        out = engine.generate_batch(
+            ["x", "y", "z"], max_new_tokens=4, stop_at_eos=False
+        )
+        # 3 prompts pad to batch bucket 4 internally; only 3 returned.
+        assert len(out) == 3
+        assert all(len(row) == 4 for row in out)
+
     def test_prompt_conditioning_not_poisoned_by_pads(self):
         """Different prompts shorter than the bucket must produce
         different first tokens conditioned on the real last byte."""

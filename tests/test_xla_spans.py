@@ -229,6 +229,31 @@ class TestCollectiveExtraction:
         assert events[0]["value"] == pytest.approx(0.2)  # 100us x 2 chips
         assert events[0]["tpu"]["launch_id"] == 0
 
+    def test_anonymous_launch_ops_sum_into_one_event(self):
+        """Modules without run_id still aggregate all their collective
+        ops into a single per-launch event."""
+        from tpuslo.otel.xla_spans import (
+            extract_collective_signals,
+            parse_trace_events,
+        )
+
+        doc = {"traceEvents": [
+            {"ph": "M", "pid": 3, "tid": 2, "name": "thread_name",
+             "args": {"name": "XLA Modules"}},
+            {"ph": "M", "pid": 3, "tid": 3, "name": "thread_name",
+             "args": {"name": "XLA Ops"}},
+            {"ph": "X", "pid": 3, "tid": 2, "ts": 0.0, "dur": 1000.0,
+             "name": "jit_anon(5)", "args": {}},  # no run_id
+            {"ph": "X", "pid": 3, "tid": 3, "ts": 10.0, "dur": 5000.0 / 1000,
+             "name": "all-reduce.1", "args": {"hlo_category": "all-reduce"}},
+            {"ph": "X", "pid": 3, "tid": 3, "ts": 50.0, "dur": 5000.0 / 1000,
+             "name": "all-reduce.2", "args": {"hlo_category": "all-reduce"}},
+        ]}
+        spans = parse_trace_events(doc, include_ops=True)
+        events = extract_collective_signals(spans, ANCHOR_NS)
+        assert len(events) == 1
+        assert events[0]["value"] == pytest.approx(0.01)  # 2 x 5us in ms
+
     def test_xprof_to_slicecorr_end_to_end(self):
         """Real pipeline shape: per-host xprof traces -> collective
         signals -> SliceJoiner names the straggler host."""

@@ -145,7 +145,9 @@ def attention(
     mask: jax.Array,
     n_rep: int,
 ) -> jax.Array:
-    """GQA attention.  q: (B,S,H,hd); k/v: (B,T,KV,hd); mask: (S,T)."""
+    """GQA attention.  q: (B,S,H,hd); k/v: (B,T,KV,hd);
+    mask: (S,T) shared or (B,S,T) per-row (batched decode at
+    per-request cache lengths)."""
     if n_rep > 1:
         k = jnp.repeat(k, n_rep, axis=2)
         v = jnp.repeat(v, n_rep, axis=2)
@@ -153,7 +155,9 @@ def attention(
     logits = jnp.einsum(
         "bshd,bthd->bhst", q, k, preferred_element_type=jnp.float32
     ) * scale
-    logits = jnp.where(mask[None, None, :, :], logits, -1e30)
+    if mask.ndim == 2:
+        mask = mask[None]
+    logits = jnp.where(mask[:, None, :, :], logits, -1e30)
     weights = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum(
         "bhst,bthd->bshd", weights.astype(v.dtype), v,
@@ -252,7 +256,9 @@ def prefill(
     ``true_length - 1`` and the cache length is set to ``true_length``,
     so decode never conditions on pad positions (pad KV slots beyond
     the length are invisible under the decode mask and get overwritten
-    as generation advances).
+    as generation advances).  A scalar applies one length to every row;
+    a ``(B,)`` vector gives each row its own prompt length (batched
+    serving with heterogeneous prompts).
     """
     B, S = tokens.shape
     if true_length is None:
@@ -273,9 +279,8 @@ def prefill(
         "length": jnp.asarray(true_length, jnp.int32),
     }
     h = rms_norm(h, params["final_norm"], cfg.norm_eps)
-    h_last = jax.vmap(
-        lambda hb: lax.dynamic_index_in_dim(hb, true_length - 1, axis=0, keepdims=False)
-    )(h)
+    tl = jnp.broadcast_to(jnp.asarray(true_length, jnp.int32), (B,))
+    h_last = jnp.take_along_axis(h, (tl - 1)[:, None, None], axis=1)[:, 0]
     logits = _matmul(h_last, params["output"]).astype(jnp.float32)
     return logits, cache
 
@@ -283,15 +288,29 @@ def prefill(
 def decode_step(
     params: PyTree, token: jax.Array, cache: PyTree, cfg: LlamaConfig
 ) -> tuple[jax.Array, PyTree]:
-    """One-token decode.  token: (B,) int32 → logits (B, vocab)."""
+    """One-token decode.  token: (B,) int32 → logits (B, vocab).
+
+    ``cache["length"]`` may be a scalar (all rows at the same position
+    — single-request serving) or a ``(B,)`` vector (batched serving at
+    per-request cache lengths).  The branch is on the static ndim, so
+    each shape compiles its own specialized program.
+    """
     B = token.shape[0]
     pos = cache["length"]
-    positions = jnp.full((B, 1), pos, jnp.int32)
+    per_row = pos.ndim == 1
+    pos_vec = jnp.broadcast_to(pos, (B,))
+    positions = pos_vec[:, None]
     h = params["embed"][token[:, None]].astype(cfg.dtype)
     cos, sin = rope_frequencies(cfg, positions)
     H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     # Causal visibility over the preallocated cache: positions <= pos.
-    visible = (jnp.arange(cfg.max_seq_len) <= pos)[None, :]
+    if per_row:
+        visible = (
+            jnp.arange(cfg.max_seq_len)[None, :] <= pos_vec[:, None]
+        )[:, None, :]  # (B, 1, T)
+        rows = jnp.arange(B)
+    else:
+        visible = (jnp.arange(cfg.max_seq_len) <= pos)[None, :]
 
     def scan_step(h, inputs):
         layer, k_cache, v_cache = inputs
@@ -301,8 +320,13 @@ def decode_step(
         v = _matmul(x, layer["wv"]).reshape(B, 1, KV, HD)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
-        v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+        if per_row:
+            # Per-row write positions: scatter one slot per row.
+            k_cache = k_cache.at[rows, pos_vec].set(k[:, 0])
+            v_cache = v_cache.at[rows, pos_vec].set(v[:, 0])
+        else:
+            k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+            v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
         attn = attention(q, k_cache, v_cache, visible, H // KV)
         h = h + _matmul(attn.reshape(B, 1, H * HD), layer["wo"])
         x = rms_norm(h, layer["mlp_norm"], cfg.norm_eps)

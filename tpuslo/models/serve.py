@@ -146,6 +146,97 @@ class ServeEngine:
         jax.block_until_ready(toks)
         return (time.perf_counter() - start) * 1000.0
 
+    def _max_prompt(self) -> int:
+        """Longest accepted prompt: largest bucket, and always at least
+        one generated token's worth of KV room."""
+        return max(1, min(self.prefill_buckets[-1], self.cfg.max_seq_len - 2))
+
+    def _decode_budget(self, longest_prompt_len: int):
+        """(decode_fn, chunk, cap_tokens) for a request whose longest
+        prompt row has ``longest_prompt_len`` ids.
+
+        Decode overshoots to whole chunks and every chunk writes
+        ``chunk`` KV slots starting at each row's true length, so the
+        budget past the longest prompt is chunk-rounded; beyond it
+        dynamic_update_slice would clamp-and-corrupt the last slot
+        silently.  Under one chunk of budget, single-token chunks use
+        the remaining slots instead of rounding the request away.
+        """
+        chunk = self.decode_chunk_size
+        avail = self.cfg.max_seq_len - longest_prompt_len - 1
+        if avail < chunk:
+            return self._decode_one_fn(), 1, max(1, avail)
+        return self._decode_chunk, chunk, max(1, (avail // chunk) * chunk)
+
+    def generate_batch(
+        self,
+        prompts: list[str],
+        max_new_tokens: int = 32,
+        stop_at_eos: bool = True,
+        batch_buckets: tuple[int, ...] = (1, 2, 4, 8),
+    ) -> list[list[int]]:
+        """Throughput-oriented batched decode; one list of token ids
+        per prompt.
+
+        All prompts share one prefill bucket (sized by the longest) and
+        one decode stream; per-row prompt lengths ride the vector
+        ``cache["length"]`` path so shorter rows are not conditioned on
+        pad positions.  The batch dimension pads to ``batch_buckets``
+        so each (batch, bucket) pair compiles once.  Aggregate
+        tokens/sec scales with the batch on the MXU — decode at B=1
+        leaves almost the whole systolic array idle.
+        """
+        if not prompts:
+            return []
+        ids = [encode_bytes(p, self._max_prompt()) for p in prompts]
+        n_real = len(ids)
+        batch = _bucket(n_real, batch_buckets)
+        ids += [[BOS]] * (batch - n_real)
+
+        lens = [len(row) for row in ids]
+        bucket = _bucket(max(lens), self.prefill_buckets)
+        tokens = jnp.asarray(
+            [row + [0] * (bucket - len(row)) for row in ids], jnp.int32
+        )
+        # The row with the longest prompt bounds every row's budget.
+        decode_fn, chunk, cap_tokens = self._decode_budget(max(lens))
+        max_new_tokens = max(1, min(max_new_tokens, cap_tokens))
+
+        cache = init_kv_cache(self.cfg, batch)
+        logits, cache = self._prefill(
+            self.params, tokens, cache, true_length=jnp.asarray(lens, jnp.int32)
+        )
+        token = prefill_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        # Dispatch the first decode chunk before the host-side read of
+        # the prefill tokens, as generate() does: the device decodes
+        # while the host unpacks.
+        toks = None
+        if max_new_tokens > 1:
+            toks, token, cache = decode_fn(self.params, token, cache)
+        first = jax.device_get(prefill_token).tolist()
+        outputs = [[int(t)] for t in first]
+        done = [stop_at_eos and t == EOS for t in first]
+
+        produced = 1
+        while produced < max_new_tokens and not all(done[:n_real]):
+            # Pipeline: issue chunk N+1 from the on-device last token
+            # before reading chunk N, hiding the transfer round-trip.
+            next_toks = next_token = None
+            if produced + chunk < max_new_tokens:
+                next_toks, next_token, cache = decode_fn(
+                    self.params, token, cache
+                )
+            for row, values in enumerate(jax.device_get(toks).tolist()):
+                for value in values:
+                    if done[row] or len(outputs[row]) >= max_new_tokens:
+                        break
+                    outputs[row].append(int(value))
+                    if stop_at_eos and value == EOS:
+                        done[row] = True
+            produced += toks.shape[1]
+            toks, token = next_toks, next_token
+        return outputs[:n_real]
+
     def generate(
         self,
         prompt: str,
@@ -154,28 +245,11 @@ class ServeEngine:
     ) -> Iterator[TokenEvent]:
         """Greedy decode; yields one TokenEvent per generated token."""
         request_start = time.perf_counter()
-        chunk = self.decode_chunk_size
         # Cap to the largest bucket so oversize prompts truncate instead
         # of slipping through unpadded (which would compile per-length —
-        # the exact recompile storm bucketing exists to prevent), and
-        # always leave room for at least one generated token.
-        max_prompt = max(
-            1, min(self.prefill_buckets[-1], self.cfg.max_seq_len - 2)
-        )
-        ids = encode_bytes(prompt, max_prompt)
-        # Decode overshoots to whole chunks and every chunk writes
-        # `chunk` KV slots starting at the prompt's true length, so the
-        # per-request budget past the prompt is chunk-rounded; beyond it
-        # dynamic_update_slice would clamp-and-corrupt the last slot
-        # silently.  Prompts that leave less than one chunk of budget
-        # fall back to single-token chunks so the remaining slots are
-        # still served rather than rounded away.
-        avail = self.cfg.max_seq_len - len(ids) - 1
-        if avail < chunk:
-            decode_fn, chunk = self._decode_one_fn(), 1
-        else:
-            decode_fn = self._decode_chunk
-        cap_tokens = max(1, (avail // chunk) * chunk)
+        # the exact recompile storm bucketing exists to prevent).
+        ids = encode_bytes(prompt, self._max_prompt())
+        decode_fn, chunk, cap_tokens = self._decode_budget(len(ids))
         max_new_tokens = max(1, min(max_new_tokens, cap_tokens))
         bucket = _bucket(len(ids), self.prefill_buckets)
         padded = ids + [0] * (bucket - len(ids))

@@ -264,7 +264,6 @@ def extract_collective_signals(
     # the launch's (program_id, launch_id) identity.
     totals: dict[tuple[str, int], float] = {}
     anchor_mod: dict[tuple[str, int], XLASpan] = {}
-    orphan = 0  # anonymous launches (no run_id) get unique keys
     for op in spans:
         if not is_collective_op(op):
             continue
@@ -278,8 +277,13 @@ def extract_collective_signals(
         if mod.launch_id >= 0:
             key = (mod.program_id, mod.launch_id)
         else:
-            orphan += 1
-            key = (f"{mod.program_id}#anon{orphan}", -1)
+            # No run_id: key the anonymous launch by its own module
+            # span (device + start) so all its ops still sum into one
+            # event; without a launch id it cannot merge across chips.
+            key = (
+                f"{mod.program_id}#anon@{mod.device_pid}:{mod.start_us}",
+                -1,
+            )
         totals[key] = totals.get(key, 0.0) + op.duration_us / 1000.0
         prior = anchor_mod.get(key)
         if prior is None or mod.start_us < prior.start_us:
