@@ -325,6 +325,22 @@ class TestServeEngine:
         assert len(out) == 3
         assert all(len(row) == 4 for row in out)
 
+    def test_generate_batch_splits_past_largest_bucket(self):
+        """More prompts than the largest batch bucket must split into
+        sub-batches, each row still matching its single-request decode
+        (previously crashed: prefill traced n_real rows against a
+        bucket-sized KV cache)."""
+        engine = ServeEngine(cfg=llama.llama_tiny(max_seq_len=128))
+        prompts = [f"prompt number {i}" for i in range(10)]
+        out = engine.generate_batch(prompts, max_new_tokens=6, stop_at_eos=False)
+        assert len(out) == 10
+        for prompt, row in ((prompts[0], out[0]), (prompts[9], out[9])):
+            single = [
+                e.token_id
+                for e in engine.generate(prompt, max_new_tokens=6, stop_at_eos=False)
+            ]
+            assert row == single
+
     def test_prompt_conditioning_not_poisoned_by_pads(self):
         """Different prompts shorter than the bucket must produce
         different first tokens conditioned on the real last byte."""

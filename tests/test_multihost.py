@@ -6,7 +6,6 @@ attribution across a pod slice — SURVEY.md §2.5, BASELINE.json config 4.
 
 import json
 
-import pytest
 
 from tpuslo.correlation.multihost import (
     CAUSE_COMPUTE,
@@ -202,6 +201,72 @@ class TestStragglerAttribution:
         assert any(
             g.slice_id == "slice-lag" for g in joiner._groups.values()
         )
+
+    @staticmethod
+    def _collective(launch, host, ts, value, slice_id="s"):
+        return {
+            "signal": "ici_collective_latency_ms",
+            "node": f"host-{host}",
+            "ts_unix_nano": ts,
+            "value": value,
+            "tpu": {
+                "slice_id": slice_id,
+                "host_index": host,
+                "program_id": "prog",
+                "launch_id": launch,
+            },
+        }
+
+    @staticmethod
+    def _retry(host, link, ts, value, slice_id="s"):
+        return {
+            "signal": "ici_link_retries_total",
+            "node": f"host-{host}",
+            "ts_unix_nano": ts,
+            "value": value,
+            "tpu": {
+                "slice_id": slice_id,
+                "host_index": host,
+                "ici_link": link,
+            },
+        }
+
+    def test_drain_retry_evidence_outlives_pending_groups(self):
+        """Link-retry corroboration must survive as long as any group
+        that may reference it is still pending: a stale group drained
+        several calls after its retries arrived is still attributed to
+        the ICI link, not misreported as compute_straggler."""
+        joiner = SliceJoiner(
+            expected_hosts=4, retry_window_ns=100, pending_horizon_ns=5_000
+        )
+        # Launch 1: host 1 is the straggler (shortest observed wall
+        # time) and shows link retries right at its observation.
+        joiner.add(self._collective(1, 0, ts=1_000, value=100.0))
+        joiner.add(self._collective(1, 1, ts=1_040, value=10.0))
+        joiner.add(self._retry(1, link=2, ts=1_040, value=5.0))
+        # A later, unrelated retry advances the newest-retry clock; a
+        # prune horizon of 2*retry_window would now drop the launch-1
+        # evidence even though launch 1 is still pending.
+        joiner.add(self._retry(0, link=0, ts=4_000, value=1.0))
+        assert joiner.drain() == []  # launch 1 incomplete, not yet stale
+        # Newer slice activity pushes launch 1 past the pending horizon.
+        joiner.add(self._collective(2, 0, ts=9_000, value=10.0))
+        drained = joiner.drain()
+        assert len(drained) == 1
+        assert drained[0].cause == CAUSE_ICI_LINK
+        assert drained[0].straggler_host == 1
+        assert drained[0].ici_link == 2
+
+    def test_drain_counts_unattributable_single_host_groups(self):
+        """A stale single-reporter group cannot be attributed (skew is
+        relative); it must be evicted *visibly* via the counter."""
+        joiner = SliceJoiner(expected_hosts=4, pending_horizon_ns=10)
+        joiner.add(self._collective(1, 0, ts=100, value=10.0))
+        joiner.add(self._collective(2, 0, ts=10_000, value=10.0))
+        drained = joiner.drain()
+        assert drained == []
+        assert joiner.dropped_unattributable == 1
+        assert len(joiner._groups) == 1  # the newest launch stays pending
 
     def test_incidents_ranked_by_confidence_then_skew(self):
         streams = synthesize_slice_streams(straggler_delay_ms=50.0)

@@ -41,7 +41,10 @@ def build_parser() -> argparse.ArgumentParser:
         "--xprof-anchor-ns",
         type=int,
         default=0,
-        help="wall-clock ns of profiling start (0 = trace-relative)",
+        help="wall-clock ns of profiling start; 0 emits trace-relative "
+        "timestamps, internally consistent for the launch-id join but "
+        "NOT time-joinable with wall-clock agent JSONL or retry "
+        "evidence",
     )
     p.add_argument("--slice-id", default="slice-0")
     p.add_argument("--output", default="-", help="incidents JSONL ('-' = stdout)")
@@ -108,6 +111,14 @@ def main(argv: list[str] | None = None) -> int:
                     file=sys.stderr,
                 )
                 return 2
+            if args.xprof_anchor_ns == 0:
+                print(
+                    "slicecorr: --xprof-anchor-ns not set; emitting "
+                    "trace-relative timestamps (launch-id joins are "
+                    "valid, but incidents cannot be time-joined with "
+                    "wall-clock agent JSONL)",
+                    file=sys.stderr,
+                )
             joiner.add_all(
                 extract_collective_signals_by_host(
                     by_host, args.xprof_anchor_ns, slice_id=args.slice_id

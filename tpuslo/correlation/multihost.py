@@ -172,6 +172,10 @@ class SliceJoiner:
         self._seen_hosts: dict[str, int] = {}
         self.ingested = 0
         self.skipped = 0
+        # Stale groups evicted by drain() with too few hosts to
+        # attribute (single reporter): surfaced so a dead-pod diagnosis
+        # is not silently discarded.
+        self.dropped_unattributable = 0
 
     def add(self, event: dict[str, Any]) -> bool:
         """Ingest one probe-event dict; returns True if it was used."""
@@ -316,9 +320,13 @@ class SliceJoiner:
         ``pending_horizon_ns`` behind *their own slice's* newest
         observation (a host agent died mid-stream) are attributed
         best-effort from whoever reported, then evicted — memory stays
-        bounded even when a host stream stops.  Retry evidence older
-        than twice the retry window behind the newest observation is
-        pruned for the same reason.
+        bounded even when a host stream stops.  Attribution needs at
+        least two reporting hosts (skew is relative); a stale group
+        with a single reporter cannot be attributed and is evicted
+        counted under ``dropped_unattributable``.  Retry evidence is
+        pruned against the *pending horizon* (never less than twice the
+        retry window) behind the newest observation, so link-retry
+        corroboration outlives any group that may still reference it.
         """
 
         def threshold_for(slice_id: str) -> int:
@@ -345,6 +353,9 @@ class SliceJoiner:
         out = self._evaluate(complete.values(), min_hosts)
         out += self._evaluate(stale.values(), min_hosts)
         out.sort(key=lambda i: (-i.confidence, -i.skew_ms, i.launch_id))
+        for group in stale.values():
+            if len(group.hosts) < max(2, min_hosts):
+                self.dropped_unattributable += 1
         for key in complete:
             del self._groups[key]
         for key in stale:
@@ -353,9 +364,8 @@ class SliceJoiner:
             if not observations:
                 del self._retries[slice_id]
                 continue
-            horizon = (
-                max(o.ts_unix_nano for o in observations)
-                - 2 * self.retry_window_ns
+            horizon = max(o.ts_unix_nano for o in observations) - max(
+                self.pending_horizon_ns, 2 * self.retry_window_ns
             )
             kept = [o for o in observations if o.ts_unix_nano >= horizon]
             if kept:

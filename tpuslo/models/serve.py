@@ -13,9 +13,9 @@ The JAX backend behind the demo RAG service (replacing the reference's
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from functools import partial
-from typing import Callable, Iterator
+from typing import Iterator
 
 import jax
 import jax.numpy as jnp
@@ -188,6 +188,22 @@ class ServeEngine:
         """
         if not prompts:
             return []
+        if len(prompts) > batch_buckets[-1]:
+            # Oversized requests split into largest-bucket sub-batches:
+            # _bucket clamps to buckets[-1], so one oversize pass would
+            # prefill more real rows than the KV cache has.
+            cap = batch_buckets[-1]
+            outputs: list[list[int]] = []
+            for i in range(0, len(prompts), cap):
+                outputs.extend(
+                    self.generate_batch(
+                        prompts[i : i + cap],
+                        max_new_tokens=max_new_tokens,
+                        stop_at_eos=stop_at_eos,
+                        batch_buckets=batch_buckets,
+                    )
+                )
+            return outputs
         ids = [encode_bytes(p, self._max_prompt()) for p in prompts]
         n_real = len(ids)
         batch = _bucket(n_real, batch_buckets)
