@@ -13,6 +13,8 @@ attribution).
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 from datetime import datetime, timezone
 
@@ -76,68 +78,156 @@ def bench_pipeline() -> dict:
     }
 
 
-def bench_serving() -> dict:
-    """Measured JAX Llama decode on whatever accelerator is attached."""
+def _chip_holder_diagnostics() -> list[str]:
+    """Other live python processes that could hold the exclusive chip.
+
+    The axon TPU backend grants one process at a time; a leaked trainer
+    or serve process makes every later init fail/hang, which is what
+    round 1 silently recorded as ``backend: unavailable``.
+    """
+    import subprocess
+
+    me = str(os.getpid())
+    holders: list[str] = []
     try:
-        import jax
+        ps = subprocess.run(
+            ["ps", "-eo", "pid,etime,args"],
+            capture_output=True,
+            text=True,
+            timeout=10,
+        )
+        for line in ps.stdout.splitlines()[1:]:
+            fields = line.split(None, 2)
+            if len(fields) < 3:
+                continue
+            pid, _etime, cmd = fields
+            if pid == me or "python" not in cmd:
+                continue
+            if "serving_bench" in cmd or "import jax" in cmd:
+                holders.append(line.strip()[:160])
+    except Exception:  # noqa: BLE001 - diagnostics only
+        pass
+    return holders
 
-        from tpuslo.models.llama import llama_tiny
-        from tpuslo.models.serve import ServeEngine
 
-        backend = jax.default_backend()
-        engine = ServeEngine(cfg=llama_tiny(max_seq_len=512))
-        compile_ms = engine.warmup()
+def _run_serving_subprocess(args: list[str], timeout_s: int) -> dict:
+    """One serving_bench child run; parses its SERVING_BENCH JSON line."""
+    import subprocess
 
-        prompt = "benchmark the tpu serving path with a stable prompt"
-        # Warm generate (compiles the bucket), then timed run.
-        list(engine.generate(prompt, max_new_tokens=8))
-        t0 = time.perf_counter()
-        events = list(engine.generate(prompt, max_new_tokens=256))
-        elapsed = time.perf_counter() - t0
-        ttft_ms = events[0].ttft_ms or 0.0
-        decode_tokens = len(events) - 1
-        decode_window = elapsed - ttft_ms / 1000.0
-        out = {
-            "backend": backend,
-            "warmup_compile_ms": round(compile_ms, 2),
-            "ttft_ms": round(ttft_ms, 3),
-            "decode_tokens_per_sec": round(
-                decode_tokens / decode_window if decode_window > 0 else 0.0, 2
-            ),
+    cmd = [sys.executable, "-m", "tpuslo.benchmark.serving_bench", *args]
+    try:
+        proc = subprocess.run(
+            cmd,
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return {
+            "backend": "unavailable",
+            "error": f"serving bench timed out after {timeout_s}s "
+            "(TPU backend init hang?)",
         }
-        # Aggregate throughput: batch-8 decode shares the MXU across
-        # requests (B=1 leaves the systolic array mostly idle).
-        prompts = [f"{prompt} #{i}" for i in range(8)]
-        engine.generate_batch(prompts, max_new_tokens=8, stop_at_eos=False)
-        t0 = time.perf_counter()
-        rows = engine.generate_batch(
-            prompts, max_new_tokens=128, stop_at_eos=False
-        )
-        batch_elapsed = time.perf_counter() - t0
-        total_tokens = sum(len(r) for r in rows)
-        out["batch8_aggregate_tokens_per_sec"] = round(
-            total_tokens / batch_elapsed if batch_elapsed > 0 else 0.0, 2
-        )
-        # Zero-instrumentation span source: capture xprof over a short
-        # serve and count recovered XLA launch spans (program+run_id
-        # identity for the xla_launch correlation tier).  Device lanes
-        # exist only on accelerator backends; 0 on pure-CPU runs.
-        try:
-            import tempfile
+    for line in proc.stdout.splitlines():
+        if line.startswith("SERVING_BENCH:"):
+            try:
+                return json.loads(line[len("SERVING_BENCH:") :])
+            except json.JSONDecodeError as exc:
+                return {"backend": "unavailable", "error": f"bad JSON: {exc}"}
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+    return {
+        "backend": "unavailable",
+        "error": " | ".join(tail[-3:])[:400] or f"rc={proc.returncode}",
+    }
 
-            from tpuslo.otel import xla_spans
 
-            with tempfile.TemporaryDirectory() as td:
-                with xla_spans.capture(td) as cap:
-                    list(engine.generate(prompt, max_new_tokens=32))
-                launches = list(cap.launches())
-            out["xprof_launch_spans"] = len(launches)
-            out["xprof_programs"] = len({s.program_id for s in launches})
-        except Exception as exc:  # noqa: BLE001 — span source is best-effort
-            out["xprof_error"] = str(exc)[:120]
-        return out
+def _probe_backend(timeout_s: int) -> dict:
+    """Cheap subprocess probe: can the TPU backend initialize at all?
+
+    Separated from the full bench so a down chip costs one short
+    timeout, not the full bench budget — the backend hang mode observed
+    here blocks ``jax.devices()`` indefinitely (no error), so only a
+    subprocess + kill bounds it.
+    """
+    import subprocess
+
+    code = (
+        "import json, jax\n"
+        "d = jax.devices()[0]\n"
+        "print('PROBE:' + json.dumps({'platform': d.platform,"
+        " 'device_kind': d.device_kind}))\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return {
+            "ok": False,
+            "error": f"backend init hang (>{timeout_s}s in jax.devices())",
+        }
+    for line in proc.stdout.splitlines():
+        if line.startswith("PROBE:"):
+            try:
+                info = json.loads(line[len("PROBE:") :])
+            except json.JSONDecodeError:
+                break
+            info["ok"] = info.get("platform") != "cpu"
+            if not info["ok"]:
+                info["error"] = "backend resolved to cpu, not the TPU"
+                info["retryable"] = False
+            return info
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+    return {"ok": False, "error": " | ".join(tail[-2:])[:300]}
+
+
+def bench_serving() -> dict:
+    """Measured JAX Llama serving on the real chip, with MFU.
+
+    Probe -> full bench -> retry -> honest CPU fallback.  Every stage
+    runs in a subprocess so a hung TPU-backend init (observed: tunnel
+    down => ``jax.devices()`` blocks forever) cannot wedge the whole
+    bench; failures are reported loudly with stale-chip-holder
+    diagnostics instead of silently degrading (round-1 weak spot #2).
+    """
+    try:
+        probe = _probe_backend(timeout_s=240)
+        if not probe.get("ok"):
+            holders = _chip_holder_diagnostics()
+            retry_probe = {"ok": False, "error": "not retried (deterministic)"}
+            if probe.get("retryable", True):
+                # Hang/transient init failures can clear; "resolved to
+                # cpu" (no TPU attached at all) cannot.
+                time.sleep(15.0)
+                retry_probe = _probe_backend(timeout_s=180)
+            if not retry_probe.get("ok"):
+                fallback = _run_serving_subprocess(
+                    ["--platform", "cpu", "--model", "llama_tiny"], timeout_s=600
+                )
+                fallback["backend"] = "cpu_fallback"
+                fallback["tpu_error"] = str(probe.get("error", "?"))[:300]
+                fallback["tpu_retry_error"] = str(retry_probe.get("error", "?"))[:300]
+                if holders:
+                    fallback["chip_holder_candidates"] = holders
+                return fallback
+            probe = retry_probe
+
+        # Chip is up: full bench gets the long budget (weights init +
+        # ~5 compiles on a 3B-class model through the remote-compile
+        # tunnel).
+        result = _run_serving_subprocess(["--platform", "auto"], timeout_s=1500)
+        if result.get("backend") in (None, "unavailable"):
+            result["probe"] = probe
+            holders = _chip_holder_diagnostics()
+            if holders:
+                result["chip_holder_candidates"] = holders
+        return result
     except Exception as exc:  # noqa: BLE001 — bench must still print a line
-        return {"backend": "unavailable", "error": str(exc)[:200]}
+        return {"backend": "unavailable", "error": str(exc)[:300]}
 
 
 def main() -> int:

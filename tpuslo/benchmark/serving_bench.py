@@ -1,0 +1,311 @@
+"""Single-chip serving benchmark with MFU, runnable as a subprocess.
+
+Driver-visible TPU performance evidence (the reference publishes
+measured headline numbers, ``/root/reference/README.md:331-341``; the
+TPU rebuild must do the same honestly on real hardware):
+
+* picks the **largest Llama config that fits the chip's HBM** in bf16
+  (3B-class on a 16 GB v5e) instead of the CI-tiny model;
+* reports TTFT, decode tokens/s at batch 1 and batch 8, prefill
+  tokens/s, and **MFU** (``tokens/s x FLOPs_per_token /
+  chip_peak_FLOPs`` with ``FLOPs_per_token = 2 x n_params``);
+* proves the ``xla_launch`` correlation tier on real device data: an
+  xprof capture over the serve recovers module-lane launch spans and
+  ops-lane device-time signals, and the two streams are joined through
+  ``tpuslo.correlation.matcher`` on (program_id, launch_id) identity.
+
+Run as ``python -m tpuslo.benchmark.serving_bench [--platform auto|cpu]
+[--model auto|llama32_3b|llama32_1b|llama_tiny]``; prints one line
+``SERVING_BENCH:{json}``.  ``bench.py`` shells out to this module so a
+hung TPU-backend init (observed: ``jax.devices()`` on an unavailable
+tunnel blocks forever) times out in the child instead of wedging the
+driver's bench run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+from typing import Any
+
+# Peak dense bf16 FLOP/s per chip, keyed by substrings of
+# ``device.device_kind`` / the PALLAS_AXON_TPU_GEN env (public cloud
+# specs: v4 275T, v5e 197T, v5p 459T, v6e 918T).
+PEAK_BF16_FLOPS = {
+    "v6e": 918e12,
+    "v5p": 459e12,
+    "v5e": 197e12,
+    "v5litepod": 197e12,
+    "v5 lite": 197e12,
+    "v4": 275e12,
+}
+
+# HBM per chip when memory_stats() is unavailable.
+DEFAULT_HBM_BYTES = {
+    "v6e": 32e9,
+    "v5p": 95e9,
+    "v5e": 16e9,
+    "v5litepod": 16e9,
+    "v5 lite": 16e9,
+    "v4": 32e9,
+}
+
+
+def _lookup(table: dict[str, float], *keys: str) -> float | None:
+    for key in keys:
+        key = (key or "").lower()
+        for marker, value in table.items():
+            if marker in key:
+                return value
+    return None
+
+
+def _pick_model(bytes_limit: float | None) -> str:
+    """Largest config whose bf16 params + KV/workspace headroom fit."""
+    from tpuslo.models.llama import llama32_1b, llama32_3b, param_count
+
+    if not bytes_limit:
+        return "llama_tiny"
+    for name, cfg in (("llama32_3b", llama32_3b()), ("llama32_1b", llama32_1b())):
+        need = param_count(cfg) * 2 * 1.15 + 2.5e9  # weights + KV/logits/workspace
+        if need < bytes_limit:
+            return name
+    return "llama_tiny"
+
+
+def _make_config(name: str):
+    from tpuslo.models import llama
+
+    if name == "llama32_3b":
+        return llama.llama32_3b(max_seq_len=1024)
+    if name == "llama32_1b":
+        return llama.llama32_1b(max_seq_len=1024)
+    return llama.llama_tiny(max_seq_len=512)
+
+
+def _signal_ref_from_probe(event: dict[str, Any]):
+    """Flatten a probe event's nested ``tpu`` block for the matcher."""
+    from datetime import datetime, timezone
+
+    from tpuslo.correlation.matcher import SignalRef
+    from tpuslo.schema import rfc3339
+
+    tpu = event.get("tpu") or {}
+    return SignalRef.from_dict(
+        {
+            "signal": event.get("signal", ""),
+            "timestamp": rfc3339(
+                datetime.fromtimestamp(
+                    event.get("ts_unix_nano", 0) / 1e9, tz=timezone.utc
+                )
+            ),
+            "node": event.get("node", ""),
+            "pod": event.get("pod", ""),
+            "pid": event.get("pid", 0),
+            "value": event.get("value", 0.0),
+            "slice_id": tpu.get("slice_id", ""),
+            "host_index": tpu.get("host_index", -1),
+            "program_id": tpu.get("program_id", ""),
+            "launch_id": tpu.get("launch_id", -1),
+        }
+    )
+
+
+def _xla_launch_join(engine, prompt: str, node: str) -> dict[str, Any]:
+    """Capture xprof over a serve and join launches to device-time
+    signals through the ``xla_launch`` matcher tier."""
+    from tpuslo.correlation.matcher import (
+        TIER_XLA_LAUNCH,
+        SpanRef,
+        match,
+    )
+    from tpuslo.otel import xla_spans
+
+    with tempfile.TemporaryDirectory() as td:
+        with xla_spans.capture(td, include_ops=True) as cap:
+            list(engine.generate(prompt, max_new_tokens=32, stop_at_eos=False))
+        launches = list(cap.launches())
+        out: dict[str, Any] = {
+            "xprof_launch_spans": len(launches),
+            "xprof_programs": len({s.program_id for s in launches}),
+        }
+        if not launches:
+            return out
+        span_refs = [
+            SpanRef.from_dict(r)
+            for r in cap.span_refs(service="serving-bench", node=node)
+        ]
+        signals = [
+            _signal_ref_from_probe(e)
+            for e in xla_spans.extract_device_time_signals(
+                cap.spans, cap.anchor_unix_ns, node=node
+            )
+        ]
+        out["device_time_signals"] = len(signals)
+        matched = 0
+        by_identity = {(s.program_id, s.launch_id): s for s in signals}
+        for span in span_refs:
+            signal = by_identity.get((span.program_id, span.launch_id))
+            if signal is None:
+                continue
+            decision = match(span, signal)
+            if decision.matched and decision.tier == TIER_XLA_LAUNCH:
+                matched += 1
+        out["xla_launch_matches"] = matched
+        out["xla_launch_join_rate"] = round(matched / len(span_refs), 4)
+        return out
+
+
+def run(platform: str = "auto", model: str = "auto") -> dict[str, Any]:
+    t_bench = time.perf_counter()
+    if platform == "cpu":
+        # Same ordering as tests/conftest.py: force the platform BEFORE
+        # the first backend touch or the pinned axon tunnel can hang.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    devices = jax.devices()
+    dev = devices[0]
+    out: dict[str, Any] = {
+        "backend": jax.default_backend(),
+        "device_kind": dev.device_kind,
+        "platform": dev.platform,
+    }
+    tpu_gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
+    if tpu_gen:
+        out["tpu_gen"] = tpu_gen
+
+    bytes_limit: float | None = None
+    try:
+        stats = dev.memory_stats() or {}
+        bytes_limit = float(stats.get("bytes_limit", 0)) or None
+        if bytes_limit:
+            out["hbm_bytes_limit"] = int(bytes_limit)
+    except Exception:  # noqa: BLE001 - not all backends expose stats
+        pass
+    if bytes_limit is None and dev.platform != "cpu":
+        bytes_limit = _lookup(DEFAULT_HBM_BYTES, dev.device_kind, tpu_gen)
+
+    peak_flops = (
+        _lookup(PEAK_BF16_FLOPS, dev.device_kind, tpu_gen)
+        if dev.platform != "cpu"
+        else None
+    )
+    if peak_flops:
+        out["peak_bf16_flops"] = peak_flops
+
+    if model == "auto":
+        model = _pick_model(bytes_limit) if dev.platform != "cpu" else "llama_tiny"
+    out["model"] = model
+    cfg = _make_config(model)
+
+    from tpuslo.models.llama import init_kv_cache, init_params, param_count
+    from tpuslo.models.serve import ServeEngine
+
+    n_params = param_count(cfg)
+    out["n_params"] = n_params
+    flops_per_token = 2.0 * n_params
+
+    t0 = time.perf_counter()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    jax.block_until_ready(params)
+    out["init_params_s"] = round(time.perf_counter() - t0, 2)
+
+    engine = ServeEngine(cfg=cfg, params=params)
+    out["warmup_compile_ms"] = round(engine.warmup(), 1)
+
+    def mfu(tokens_per_sec: float) -> float | None:
+        if not peak_flops:
+            return None
+        return round(tokens_per_sec * flops_per_token / peak_flops, 5)
+
+    # --- batch-1 latency path ------------------------------------------
+    prompt = "benchmark the tpu serving path with a stable prompt"
+    list(engine.generate(prompt, max_new_tokens=8, stop_at_eos=False))
+    n_b1 = 128
+    t0 = time.perf_counter()
+    events = list(engine.generate(prompt, max_new_tokens=n_b1, stop_at_eos=False))
+    elapsed = time.perf_counter() - t0
+    ttft_s = (events[0].ttft_ms or 0.0) / 1000.0
+    decode_window = max(elapsed - ttft_s, 1e-9)
+    b1_tps = (len(events) - 1) / decode_window
+    out["ttft_ms"] = round(ttft_s * 1000.0, 2)
+    out["decode_tokens_per_sec"] = round(b1_tps, 2)
+    out["mfu_decode_b1"] = mfu(b1_tps)
+
+    # --- batch-8 throughput path ---------------------------------------
+    prompts = [f"{prompt} #{i}" for i in range(8)]
+    engine.generate_batch(prompts, max_new_tokens=8, stop_at_eos=False)
+    n_b8 = 64
+    t0 = time.perf_counter()
+    rows = engine.generate_batch(prompts, max_new_tokens=n_b8, stop_at_eos=False)
+    batch_elapsed = max(time.perf_counter() - t0, 1e-9)
+    total_tokens = sum(len(r) for r in rows)
+    b8_tps = total_tokens / batch_elapsed
+    out["batch8_aggregate_tokens_per_sec"] = round(b8_tps, 2)
+    out["mfu_decode_b8"] = mfu(b8_tps)
+
+    # --- prefill throughput (compute-bound: the MFU that shows the MXU) -
+    bucket = engine.prefill_buckets[-1]
+    import jax.numpy as jnp
+
+    tokens = jnp.zeros((8, bucket), jnp.int32)
+    cache = init_kv_cache(cfg, 8)
+    logits, cache = engine._prefill(params, tokens, cache)  # compile
+    jax.block_until_ready(logits)
+    # Time only the prefill computation: the cache is donated, so each
+    # rep needs a fresh one, but its allocation/zero-fill is not
+    # prefill work and must stay outside the timed window.
+    reps = 3
+    prefill_elapsed = 0.0
+    for _ in range(reps):
+        cache = init_kv_cache(cfg, 8)
+        jax.block_until_ready(cache)
+        t0 = time.perf_counter()
+        logits, cache = engine._prefill(params, tokens, cache)
+        jax.block_until_ready((logits, cache))
+        prefill_elapsed += time.perf_counter() - t0
+    prefill_elapsed = max(prefill_elapsed, 1e-9)
+    prefill_tps = reps * 8 * bucket / prefill_elapsed
+    out["prefill_bucket"] = bucket
+    out["prefill_tokens_per_sec"] = round(prefill_tps, 1)
+    out["mfu_prefill"] = mfu(prefill_tps)
+
+    # --- xla_launch tier on real trace data ----------------------------
+    try:
+        out.update(_xla_launch_join(engine, prompt, node=os.uname().nodename))
+    except Exception as exc:  # noqa: BLE001 - span source is best-effort
+        out["xprof_error"] = str(exc)[:200]
+
+    try:
+        stats = dev.memory_stats() or {}
+        if stats.get("bytes_in_use"):
+            out["hbm_bytes_in_use"] = int(stats["bytes_in_use"])
+    except Exception:  # noqa: BLE001
+        pass
+    out["elapsed_s"] = round(time.perf_counter() - t_bench, 1)
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="serving_bench")
+    parser.add_argument("--platform", choices=("auto", "cpu"), default="auto")
+    parser.add_argument(
+        "--model",
+        choices=("auto", "llama32_3b", "llama32_1b", "llama_tiny"),
+        default="auto",
+    )
+    args = parser.parse_args(argv)
+    result = run(platform=args.platform, model=args.model)
+    print("SERVING_BENCH:" + json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

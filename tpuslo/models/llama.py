@@ -59,6 +59,47 @@ def llama3_70b() -> LlamaConfig:
     )
 
 
+def llama32_3b(max_seq_len: int = 2048) -> LlamaConfig:
+    """Llama-3.2-3B-class config: the largest of the family that fits a
+    single v5e chip (16 GB HBM) in bf16 with untied embeddings and KV
+    cache headroom (~7.2 GB params)."""
+    return LlamaConfig(
+        dim=3072,
+        n_layers=28,
+        n_heads=24,
+        n_kv_heads=8,
+        ffn_dim=8192,
+        max_seq_len=max_seq_len,
+    )
+
+
+def llama32_1b(max_seq_len: int = 2048) -> LlamaConfig:
+    """Llama-3.2-1B-class config (~1.5 B params untied, ~3 GB bf16)."""
+    return LlamaConfig(
+        dim=2048,
+        n_layers=16,
+        n_heads=32,
+        n_kv_heads=8,
+        ffn_dim=8192,
+        max_seq_len=max_seq_len,
+    )
+
+
+def param_count(cfg: LlamaConfig) -> int:
+    """Exact parameter count of :func:`init_params` for this config."""
+    D, F, L = cfg.dim, cfg.ffn_dim, cfg.n_layers
+    H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    per_layer = (
+        2 * D  # attn_norm + mlp_norm
+        + D * H * HD  # wq
+        + 2 * D * KV * HD  # wk, wv
+        + H * HD * D  # wo
+        + 2 * D * F  # w1, w3
+        + F * D  # w2
+    )
+    return 2 * cfg.vocab_size * D + D + L * per_layer
+
+
 def llama_tiny(max_seq_len: int = 256) -> LlamaConfig:
     """Tiny config for CI / compile checks / CPU-mesh dry runs."""
     return LlamaConfig(

@@ -222,6 +222,108 @@ def is_collective_op(span: XLASpan) -> bool:
     return any(marker in hay for marker in COLLECTIVE_MARKERS)
 
 
+def _sum_ops_by_launch(
+    spans: list[XLASpan], op_filter: "Callable[[XLASpan], bool]"
+) -> tuple[dict[tuple[str, int], float], dict[tuple[str, int], XLASpan]]:
+    """Sum filtered ops-lane durations into their enclosing launches.
+
+    Returns ``(totals_ms, anchor_mod)`` keyed by the launch's
+    ``(program_id, launch_id)`` identity.  Module launches are grouped
+    per device pid: multi-chip hosts run the same launch concurrently on
+    every chip, so containment must pair an op with *its own device's*
+    module span or op time gets double-counted onto whichever chip
+    sorts first.
+    """
+    mods_by_dev: dict[int, list[XLASpan]] = {}
+    for s in spans:
+        if s.lane == MODULES_LANE:
+            mods_by_dev.setdefault(s.device_pid, []).append(s)
+    starts_by_dev: dict[int, list[float]] = {}
+    for dev, mods in mods_by_dev.items():
+        mods.sort(key=lambda s: s.start_us)
+        starts_by_dev[dev] = [m.start_us for m in mods]
+
+    # One signal per launch per host: chips of one host aggregate by
+    # the launch's (program_id, launch_id) identity.
+    totals: dict[tuple[str, int], float] = {}
+    anchor_mod: dict[tuple[str, int], XLASpan] = {}
+    for op in spans:
+        if op.lane != OPS_LANE or not op_filter(op):
+            continue
+        mods = mods_by_dev.get(op.device_pid, [])
+        idx = bisect.bisect_right(starts_by_dev.get(op.device_pid, []), op.start_us) - 1
+        if idx < 0:
+            continue
+        mod = mods[idx]
+        if not op.start_us < mod.start_us + mod.duration_us:
+            continue
+        if mod.launch_id >= 0:
+            key = (mod.program_id, mod.launch_id)
+        else:
+            # No run_id: key the anonymous launch by its own module
+            # span (device + start) so all its ops still sum into one
+            # event; without a launch id it cannot merge across chips.
+            key = (
+                f"{mod.program_id}#anon@{mod.device_pid}:{mod.start_us}",
+                -1,
+            )
+        totals[key] = totals.get(key, 0.0) + op.duration_us / 1000.0
+        prior = anchor_mod.get(key)
+        if prior is None or mod.start_us < prior.start_us:
+            anchor_mod[key] = mod
+    return totals, anchor_mod
+
+
+def _launch_signal_events(
+    totals: dict[tuple[str, int], float],
+    anchor_mod: dict[tuple[str, int], XLASpan],
+    signal: str,
+    anchor_unix_ns: int,
+    node: str,
+    slice_id: str,
+    host_index: int,
+    namespace: str,
+    pod: str,
+    chip: str,
+) -> list[dict[str, Any]]:
+    """Per-launch probe events from aggregated op totals."""
+    from tpuslo.signals.generator import signal_status
+
+    out: list[dict[str, Any]] = []
+    for key, total_ms in sorted(
+        totals.items(), key=lambda kv: anchor_mod[kv[0]].start_us
+    ):
+        mod = anchor_mod[key]
+        tpu: dict[str, Any] = {"chip": chip}
+        if slice_id:
+            tpu["slice_id"] = slice_id
+        if host_index >= 0:
+            tpu["host_index"] = host_index
+        if mod.program_id:
+            tpu["program_id"] = mod.program_id
+        if mod.launch_id >= 0:
+            tpu["launch_id"] = mod.launch_id
+        if mod.module_name:
+            tpu["module_name"] = mod.module_name
+        out.append(
+            {
+                "ts_unix_nano": anchor_unix_ns + int(mod.start_us * 1_000),
+                "signal": signal,
+                "node": node,
+                "namespace": namespace,
+                "pod": pod or node,
+                "container": "xprof",
+                "pid": 0,
+                "tid": 0,
+                "value": round(total_ms, 4),
+                "unit": "ms",
+                "status": signal_status(signal, total_ms),
+                "tpu": tpu,
+            }
+        )
+    return out
+
+
 def extract_collective_signals(
     spans: list[XLASpan],
     anchor_unix_ns: int,
@@ -245,83 +347,56 @@ def extract_collective_signals(
     so per-launch totals joined across hosts by SliceJoiner still name
     the straggler.  Requires a trace captured with ``include_ops=True``.
     """
-    from tpuslo.signals.generator import signal_status
+    totals, anchor_mod = _sum_ops_by_launch(spans, is_collective_op)
+    return _launch_signal_events(
+        totals,
+        anchor_mod,
+        "ici_collective_latency_ms",
+        anchor_unix_ns,
+        node,
+        slice_id,
+        host_index,
+        namespace,
+        pod,
+        chip,
+    )
 
-    # Module launches grouped per device pid: multi-chip hosts run the
-    # same launch concurrently on every chip, so containment must pair
-    # an op with *its own device's* module span or collective time gets
-    # double-counted onto whichever chip sorts first.
-    mods_by_dev: dict[int, list[XLASpan]] = {}
-    for s in spans:
-        if s.lane == MODULES_LANE:
-            mods_by_dev.setdefault(s.device_pid, []).append(s)
-    starts_by_dev: dict[int, list[float]] = {}
-    for dev, mods in mods_by_dev.items():
-        mods.sort(key=lambda s: s.start_us)
-        starts_by_dev[dev] = [m.start_us for m in mods]
 
-    # One signal per launch per host: chips of one host aggregate by
-    # the launch's (program_id, launch_id) identity.
-    totals: dict[tuple[str, int], float] = {}
-    anchor_mod: dict[tuple[str, int], XLASpan] = {}
-    for op in spans:
-        if not is_collective_op(op):
-            continue
-        mods = mods_by_dev.get(op.device_pid, [])
-        idx = bisect.bisect_right(starts_by_dev.get(op.device_pid, []), op.start_us) - 1
-        if idx < 0:
-            continue
-        mod = mods[idx]
-        if not op.start_us < mod.start_us + mod.duration_us:
-            continue
-        if mod.launch_id >= 0:
-            key = (mod.program_id, mod.launch_id)
-        else:
-            # No run_id: key the anonymous launch by its own module
-            # span (device + start) so all its ops still sum into one
-            # event; without a launch id it cannot merge across chips.
-            key = (
-                f"{mod.program_id}#anon@{mod.device_pid}:{mod.start_us}",
-                -1,
-            )
-        totals[key] = totals.get(key, 0.0) + op.duration_us / 1000.0
-        prior = anchor_mod.get(key)
-        if prior is None or mod.start_us < prior.start_us:
-            anchor_mod[key] = mod
+def extract_device_time_signals(
+    spans: list[XLASpan],
+    anchor_unix_ns: int,
+    node: str = "",
+    slice_id: str = "",
+    host_index: int = -1,
+    namespace: str = "llm-slo",
+    pod: str = "",
+    chip: str = "accel0",
+) -> list[dict[str, Any]]:
+    """``xla_device_time_ms`` probe events: per-launch device compute time.
 
-    out: list[dict[str, Any]] = []
-    for key, total_ms in sorted(
-        totals.items(), key=lambda kv: anchor_mod[kv[0]].start_us
-    ):
-        mod = anchor_mod[key]
-        tpu: dict[str, Any] = {"chip": chip}
-        if slice_id:
-            tpu["slice_id"] = slice_id
-        if host_index >= 0:
-            tpu["host_index"] = host_index
-        if mod.program_id:
-            tpu["program_id"] = mod.program_id
-        if mod.launch_id >= 0:
-            tpu["launch_id"] = mod.launch_id
-        if mod.module_name:
-            tpu["module_name"] = mod.module_name
-        out.append(
-            {
-                "ts_unix_nano": anchor_unix_ns + int(mod.start_us * 1_000),
-                "signal": "ici_collective_latency_ms",
-                "node": node,
-                "namespace": namespace,
-                "pod": pod or node,
-                "container": "xprof",
-                "pid": 0,
-                "tid": 0,
-                "value": round(total_ms, 4),
-                "unit": "ms",
-                "status": signal_status("ici_collective_latency_ms", total_ms),
-                "tpu": tpu,
-            }
-        )
-    return out
+    Sums *every* XLA Ops-lane event into its enclosing module launch —
+    the single-chip analog of :func:`extract_collective_signals` (which
+    filters to collectives and is empty on one chip).  Each event
+    carries the launch's exact ``program_id``/``launch_id`` identity, so
+    the ``xla_launch`` correlation tier
+    (`tpuslo/correlation/matcher.py`) can join it against module-lane
+    span refs from the same or another span source — the
+    zero-instrumentation per-step attribution feed.  Requires a trace
+    captured with ``include_ops=True``.
+    """
+    totals, anchor_mod = _sum_ops_by_launch(spans, lambda _op: True)
+    return _launch_signal_events(
+        totals,
+        anchor_mod,
+        "xla_device_time_ms",
+        anchor_unix_ns,
+        node,
+        slice_id,
+        host_index,
+        namespace,
+        pod,
+        chip,
+    )
 
 
 def extract_collective_signals_by_host(
