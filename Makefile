@@ -28,7 +28,8 @@ test-fast: native
 	$(PY) -m pytest tests/ -q -x
 
 lint:
-	$(PY) -m compileall -q tpuslo demo tests bench.py __graft_entry__.py
+	$(PY) -m compileall -q tpuslo demo tests tools bench.py __graft_entry__.py
+	$(PY) tools/lint.py
 
 # ---- gates (mirror the reference CI steps) ----------------------------
 

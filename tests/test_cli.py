@@ -7,7 +7,6 @@ Reference model: the reference CI's no-cluster smoke tests
 import json
 import urllib.request
 
-import pytest
 
 from tpuslo.__main__ import BINARIES, main as dispatch
 from tpuslo.cli import (

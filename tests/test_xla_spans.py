@@ -10,7 +10,6 @@ a smoke test only.
 
 import gzip
 import json
-import os
 
 import pytest
 

@@ -19,7 +19,6 @@ from __future__ import annotations
 
 import csv
 import json
-import os
 import subprocess
 import time
 from dataclasses import dataclass, field
@@ -28,7 +27,6 @@ from pathlib import Path
 from typing import Any
 
 from tpuslo import attribution
-from tpuslo.attribution import FaultSample
 from tpuslo.faultreplay import generate_fault_samples
 from tpuslo.releasegate.stats import mean
 from tpuslo.safety import OverheadGuard

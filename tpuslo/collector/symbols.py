@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import glob
 import os
-import re
 import struct
 from dataclasses import dataclass
 from pathlib import Path

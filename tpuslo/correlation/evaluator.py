@@ -8,7 +8,7 @@ tier accuracy over a labeled-pairs JSONL dataset, with a CI gate
 from __future__ import annotations
 
 import json
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from datetime import datetime, timezone
 from pathlib import Path
 from typing import Any
