@@ -1,0 +1,233 @@
+#!/usr/bin/env python3
+"""Probe host compatibility for the TPU probe surface → JSON report.
+
+TPU-native analogue of the reference CI's kernel-compat probing
+(`/root/reference/scripts/ci/kernel_compat_probe.sh:1`,
+`check_runner_profiles.sh:1`): instead of kernel header/BTF checks for
+nine CPU probes, the risk here is **symbol drift** — the libtpu/driver
+attach points in `config/libtpu-symbols.yaml` move across releases
+(SURVEY.md §7 hard part #1).  This script records, for one host:
+
+* kernel release, BTF availability, bpf(2) usability hints;
+* installed libtpu (path, soname, size, mtime, package version when a
+  pip dist-info is present) or its absence;
+* per-signal manifest resolution: which candidate symbol matched, or
+  UNRESOLVED / NO_LIBRARY;
+* the JAX TPU generation advertised by the environment.
+
+Output is one JSON document (stdout or ``--output``); exit code 0 even
+when symbols are unresolved — the *matrix* judges aggregate status, a
+single host's report is data, not a verdict (pass ``--strict`` to exit
+1 on unresolved signals for gate use).  Feed one or more reports to
+``scripts/ci/render_compat_report.py`` to produce
+``docs/compatibility.md``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import platform
+import sys
+from datetime import datetime, timezone
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+sys.path.insert(0, str(REPO_ROOT))
+
+
+def _load_manifest(path: Path) -> dict:
+    """Minimal YAML subset reader for the symbols manifest.
+
+    PyYAML is available in dev images but not guaranteed on bare
+    TPU-VM runners; the manifest uses only nested maps + flat string
+    lists, which this parser covers.  Falls back to PyYAML when
+    importable.
+    """
+    try:
+        import yaml
+
+        return yaml.safe_load(path.read_text())
+    except ImportError:
+        pass
+
+    # Meaningful lines as (indent, text); comments/blanks dropped.
+    lines: list[tuple[int, str]] = []
+    for raw in path.read_text().splitlines():
+        if raw.lstrip().startswith("#"):
+            continue
+        # Inline comments: the manifest quotes no '#' characters.
+        stripped = raw.split(" #", 1)[0].rstrip()
+        if not stripped.strip():
+            continue
+        lines.append((len(stripped) - len(stripped.lstrip()), stripped.strip()))
+
+    def parse_block(start: int, indent: int) -> tuple[object, int]:
+        """Parse the block whose items sit at exactly ``indent``."""
+        if start < len(lines) and lines[start][1].startswith("- "):
+            items: list[str] = []
+            i = start
+            while i < len(lines) and lines[i][0] == indent and lines[i][1].startswith("- "):
+                items.append(lines[i][1][2:].strip().strip("'\""))
+                i += 1
+            return items, i
+        mapping: dict = {}
+        i = start
+        while i < len(lines) and lines[i][0] == indent:
+            line = lines[i][1]
+            key, _, rest = line.partition(":")
+            key = key.strip()
+            rest = rest.strip()
+            if rest:
+                mapping[key] = rest.strip("'\"")
+                i += 1
+            else:
+                i += 1
+                if i < len(lines) and lines[i][0] > indent:
+                    child, i = parse_block(i, lines[i][0])
+                    mapping[key] = child
+                else:
+                    mapping[key] = {}
+        return mapping, i
+
+    root, _ = parse_block(0, lines[0][0] if lines else 0)
+    return root if isinstance(root, dict) else {}
+
+
+def probe_kernel() -> dict:
+    info = {
+        "release": platform.release(),
+        "machine": platform.machine(),
+        "btf_vmlinux": os.path.exists("/sys/kernel/btf/vmlinux"),
+        "bpf_syscall_likely": os.path.exists("/proc/sys/kernel/unprivileged_bpf_disabled"),
+        "debugfs_tracing": os.path.exists("/sys/kernel/debug/tracing")
+        or os.path.exists("/sys/kernel/tracing"),
+    }
+    try:
+        with open("/proc/sys/kernel/unprivileged_bpf_disabled") as fh:
+            info["unprivileged_bpf_disabled"] = fh.read().strip()
+    except OSError:
+        pass
+    return info
+
+
+def probe_accel_devices() -> dict:
+    return {
+        "accel_nodes": sorted(glob.glob("/dev/accel*")),
+        "vfio_nodes": sorted(glob.glob("/dev/vfio/*")),
+        "tpu_gen_env": os.environ.get("PALLAS_AXON_TPU_GEN", ""),
+    }
+
+
+def probe_libtpu(manifest: dict) -> dict:
+    from tpuslo.collector import symbols
+
+    paths = list((manifest.get("library") or {}).get("paths") or [])
+    env_path = os.environ.get("TPUSLO_LIBTPU_PATH")
+    if env_path:
+        paths.insert(0, env_path)
+    expanded: list[str] = []
+    for p in paths:
+        expanded.extend(sorted(glob.glob(p)) or [p])
+    found = symbols.find_libtpu(expanded)
+    out: dict = {"searched": expanded, "path": found}
+    if not found:
+        # pip-installed libtpu advertises itself via dist-info even
+        # when the .so sits in a wheel-specific directory.
+        for dist in sorted(
+            glob.glob(
+                os.path.join(
+                    os.path.dirname(os.__file__), "..", "**", "libtpu*"
+                ),
+                recursive=True,
+            )
+        ):
+            out.setdefault("hints", []).append(dist)
+        return out
+    st = os.stat(found)
+    out["size_bytes"] = st.st_size
+    out["mtime"] = datetime.fromtimestamp(st.st_mtime, tz=timezone.utc).isoformat()
+    for meta in sorted(glob.glob(os.path.join(os.path.dirname(found), "..", "*.dist-info", "METADATA"))):
+        try:
+            for line in open(meta, encoding="utf-8"):
+                if line.startswith("Version:"):
+                    out["package_version"] = line.split(":", 1)[1].strip()
+                    break
+        except OSError:
+            continue
+    return out
+
+
+def resolve_signals(manifest: dict, libtpu_path: str | None) -> dict:
+    from tpuslo.collector import symbols
+
+    report: dict = {}
+    for signal, spec in (manifest.get("signals") or {}).items():
+        kind = spec.get("kind", "span")
+        candidates = list(spec.get("candidates") or [])
+        entry = {"kind": kind, "candidates": candidates}
+        if kind == "kprobe_ioctl":
+            try:
+                hit = symbols.resolve_kernel_symbol(candidates)
+            except OSError:
+                hit = None
+            entry["resolved"] = hit or "UNRESOLVED"
+        elif libtpu_path is None:
+            entry["resolved"] = "NO_LIBRARY"
+        else:
+            try:
+                hit = symbols.resolve_elf_symbol(libtpu_path, candidates)
+                entry["resolved"] = hit.name if hit else "UNRESOLVED"
+            except Exception as exc:  # noqa: BLE001 - ELF parse errors are data
+                entry["resolved"] = f"ERROR: {exc}"[:120]
+        report[signal] = entry
+    return report
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="libtpu_compat_probe")
+    parser.add_argument("--manifest", default=str(REPO_ROOT / "config/libtpu-symbols.yaml"))
+    parser.add_argument("--output", default="-", help="report path ('-' = stdout)")
+    parser.add_argument("--label", default=platform.node(), help="host/matrix label")
+    parser.add_argument(
+        "--strict",
+        action="store_true",
+        help="exit 1 if any manifest signal is unresolved (gate mode)",
+    )
+    args = parser.parse_args(argv)
+
+    manifest = _load_manifest(Path(args.manifest))
+    libtpu = probe_libtpu(manifest)
+    signals = resolve_signals(manifest, libtpu.get("path"))
+    report = {
+        "label": args.label,
+        "probed_at": datetime.now(timezone.utc).isoformat(),
+        "kernel": probe_kernel(),
+        "accel": probe_accel_devices(),
+        "libtpu": libtpu,
+        "signals": signals,
+        "summary": {
+            "total": len(signals),
+            "resolved": sum(
+                1
+                for s in signals.values()
+                if s["resolved"] not in ("UNRESOLVED", "NO_LIBRARY")
+                and not str(s["resolved"]).startswith("ERROR")
+            ),
+        },
+    }
+    payload = json.dumps(report, indent=2, sort_keys=True)
+    if args.output == "-":
+        print(payload)
+    else:
+        Path(args.output).write_text(payload + "\n")
+        print(f"libtpu_compat_probe: wrote {args.output}", file=sys.stderr)
+    if args.strict and report["summary"]["resolved"] < report["summary"]["total"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
