@@ -1,0 +1,73 @@
+# Ephemeral TPU-VM self-hosted CI runner.
+#
+# Role parity with /root/reference/infra/runner/aws/main.tf:1 (EC2 +
+# cloud-init runner), re-grounded on GCP TPU-VMs: the runner must carry
+# a real /dev/accel* device, libtpu, and an eBPF-capable kernel so the
+# libtpu-compat-matrix and nightly integration workflows exercise the
+# true probe surface.  The startup script delegates to the repo's
+# scripts/runner/bootstrap-tpu-vm.sh (single source of truth for
+# toolchain + runner registration); teardown is the VM's lifecycle —
+# the runner registers --ephemeral and the VM is disposable.
+
+terraform {
+  required_version = ">= 1.5.0"
+  required_providers {
+    google = {
+      source  = "hashicorp/google"
+      version = ">= 5.30.0"
+    }
+  }
+}
+
+provider "google" {
+  project = var.project
+  zone    = var.zone
+}
+
+resource "google_service_account" "runner" {
+  account_id   = "${var.name}-sa"
+  display_name = "tpuslo CI runner (minimal: logging + monitoring only)"
+}
+
+resource "google_project_iam_member" "runner_log_writer" {
+  project = var.project
+  role    = "roles/logging.logWriter"
+  member  = "serviceAccount:${google_service_account.runner.email}"
+}
+
+resource "google_tpu_v2_vm" "runner" {
+  name             = var.name
+  zone             = var.zone
+  accelerator_type = var.accelerator_type
+  runtime_version  = var.runtime_version
+
+  network_config {
+    network             = var.network
+    enable_external_ips = true
+  }
+
+  scheduling_config {
+    preemptible = var.preemptible
+  }
+
+  service_account {
+    email = google_service_account.runner.email
+    scope = ["https://www.googleapis.com/auth/cloud-platform"]
+  }
+
+  metadata = {
+    # TPU-VM runtimes execute startup-script on first boot; it fetches
+    # nothing from this module beyond the templated registration env
+    # and then defers to the in-repo bootstrap script.
+    startup-script = templatefile("${path.module}/startup.sh.tftpl", {
+      gh_repo         = var.gh_repo
+      gh_runner_token = var.gh_runner_token
+      runner_labels   = join(",", var.runner_labels)
+    })
+  }
+
+  labels = {
+    role    = "ci-runner"
+    toolkit = "tpu-slo"
+  }
+}

@@ -1,0 +1,14 @@
+output "runner_name" {
+  value       = google_tpu_v2_vm.runner.name
+  description = "Provisioned TPU-VM runner name"
+}
+
+output "runner_zone" {
+  value       = google_tpu_v2_vm.runner.zone
+  description = "Zone the runner landed in"
+}
+
+output "service_account" {
+  value       = google_service_account.runner.email
+  description = "Runner service account (minimal roles)"
+}

@@ -1,0 +1,65 @@
+# Inputs for the ephemeral TPU-VM CI runner.
+#
+# TPU-native counterpart of the reference's AWS runner module
+# (/root/reference/infra/runner/aws/main.tf:1): same role — provision a
+# privileged, eBPF-capable self-hosted GitHub Actions runner — but on a
+# GCP TPU-VM so the libtpu/accel probe surface and a real chip are
+# present for the compat matrix and nightly integration lanes.
+
+variable "project" {
+  description = "GCP project id"
+  type        = string
+}
+
+variable "zone" {
+  description = "TPU zone (must offer the accelerator_type)"
+  type        = string
+  default     = "us-west4-8a"
+}
+
+variable "name" {
+  description = "Runner VM name"
+  type        = string
+  default     = "tpuslo-ci-runner"
+}
+
+variable "accelerator_type" {
+  description = "TPU accelerator type for the runner"
+  type        = string
+  default     = "v5litepod-1"
+}
+
+variable "runtime_version" {
+  description = "TPU VM runtime image"
+  type        = string
+  default     = "v2-alpha-tpuv5-lite"
+}
+
+variable "gh_repo" {
+  description = "GitHub repository (owner/name) the runner registers to"
+  type        = string
+}
+
+variable "gh_runner_token" {
+  description = "GitHub Actions runner registration token (short-lived)"
+  type        = string
+  sensitive   = true
+}
+
+variable "runner_labels" {
+  description = "Labels the CI workflows target"
+  type        = list(string)
+  default     = ["self-hosted", "tpu-vm", "ebpf-capable"]
+}
+
+variable "preemptible" {
+  description = "Run the TPU VM preemptibly (ephemeral CI runners tolerate eviction)"
+  type        = bool
+  default     = true
+}
+
+variable "network" {
+  description = "VPC network for the runner"
+  type        = string
+  default     = "default"
+}

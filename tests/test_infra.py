@@ -1,0 +1,88 @@
+"""Static sanity gates for the Terraform runner module.
+
+The dev/CI container has no terraform binary (real validation is the
+``terraform-validate`` job in ci.yml, which runs on GitHub's runners);
+these checks catch the mechanical drift that survives until then:
+undeclared/unused variables, unbalanced blocks, and a startup template
+whose placeholders don't match what main.tf passes in.
+"""
+
+import re
+from pathlib import Path
+
+MODULE = Path(__file__).resolve().parent.parent / "infra" / "runner" / "gcp"
+
+
+def _read(name: str) -> str:
+    return (MODULE / name).read_text(encoding="utf-8")
+
+
+def _strip_comments(text: str) -> str:
+    return "\n".join(line.split("#", 1)[0] for line in text.splitlines())
+
+
+class TestRunnerModule:
+    def test_files_present(self):
+        for name in ("main.tf", "variables.tf", "outputs.tf", "startup.sh.tftpl"):
+            assert (MODULE / name).is_file(), name
+
+    def test_braces_balanced(self):
+        for name in ("main.tf", "variables.tf", "outputs.tf"):
+            text = _strip_comments(_read(name))
+            assert text.count("{") == text.count("}"), name
+
+    def test_every_used_variable_is_declared(self):
+        declared = set(
+            re.findall(r'variable\s+"([a-z0-9_]+)"', _read("variables.tf"))
+        )
+        used = set(re.findall(r"var\.([a-z0-9_]+)", _read("main.tf")))
+        assert used <= declared, f"undeclared: {used - declared}"
+
+    def test_every_declared_variable_is_used(self):
+        declared = set(
+            re.findall(r'variable\s+"([a-z0-9_]+)"', _read("variables.tf"))
+        )
+        used = set(re.findall(r"var\.([a-z0-9_]+)", _read("main.tf")))
+        assert declared <= used, f"dead variables: {declared - used}"
+
+    def test_startup_template_placeholders_match_templatefile_args(self):
+        # templatefile(...) { gh_repo = ..., gh_runner_token = ..., ... }
+        main = _read("main.tf")
+        call = re.search(
+            r"templatefile\([^)]*startup\.sh\.tftpl[^{]*\{(.*?)\n\s*\}\)",
+            main,
+            re.S,
+        )
+        assert call, "templatefile call for startup.sh.tftpl not found"
+        passed = set(re.findall(r"([a-z0-9_]+)\s*=", call.group(1)))
+        template = _read("startup.sh.tftpl")
+        # ${name} placeholders; $${...} would be literal-escaped.
+        placeholders = {
+            m
+            for m in re.findall(r"(?<!\$)\$\{([a-z0-9_]+)\}", template)
+            # Shell vars rendered at runtime are upper-case by
+            # convention in this template; terraform placeholders are
+            # lower-case.
+            if m.islower()
+        }
+        assert placeholders <= passed, f"unfed placeholders: {placeholders - passed}"
+        assert passed <= placeholders, f"unused template args: {passed - placeholders}"
+
+    def test_runner_labels_cover_workflow_targets(self):
+        """The labels the workflows schedule on must be provisioned."""
+        default = re.search(
+            r'variable\s+"runner_labels".*?default\s*=\s*\[(.*?)\]',
+            _read("variables.tf"),
+            re.S,
+        )
+        assert default
+        labels = set(re.findall(r'"([^"]+)"', default.group(1)))
+        assert {"self-hosted", "tpu-vm"} <= labels
+
+    def test_sensitive_token_is_marked(self):
+        block = re.search(
+            r'variable\s+"gh_runner_token"\s*\{(.*?)\n\}',
+            _read("variables.tf"),
+            re.S,
+        )
+        assert block and "sensitive" in block.group(1)
