@@ -98,3 +98,25 @@ def test_helm_values_parse_and_mirror_defaults():
     assert values["agent"]["probeSource"] == "ring"
     assert len(values["config"]["signalSet"]) == 15
     assert values["config"]["maxOverheadPct"] == 3.0
+
+
+def test_helm_test_hook_references_resolve():
+    """No helm binary in this image; statically check the chart test
+    hook only uses helpers that _helpers.tpl defines, targets the
+    Service name templates/service.yaml actually renders, and greps a
+    metric the agent registry actually exports."""
+    import re
+
+    chart = REPO / "charts/tpu-slo-agent"
+    hook = (chart / "templates/tests/test-connection.yaml").read_text()
+    helpers = (chart / "templates/_helpers.tpl").read_text()
+    defined = set(re.findall(r'define\s+"([^"]+)"', helpers))
+    used = set(re.findall(r'include\s+"([^"]+)"', hook))
+    assert used <= defined, f"undefined helpers: {used - defined}"
+    # Service is <name>-metrics (templates/service.yaml).
+    assert '-metrics:' in hook
+    assert '"helm.sh/hook": test' in hook
+    metric = re.search(r"grep -q \"\^(\w+)", hook).group(1)
+    registry = (REPO / "tpuslo/metrics/registry.py").read_text()
+    assert metric in registry, f"hook greps unknown metric {metric}"
+    assert (chart / ".helmignore").is_file()
