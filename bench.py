@@ -110,7 +110,9 @@ def _chip_holder_diagnostics() -> list[str]:
     return holders
 
 
-def _run_serving_subprocess(args: list[str], timeout_s: int) -> dict:
+def _run_serving_subprocess(
+    args: list[str], timeout_s: int, env_extra: dict | None = None
+) -> dict:
     """One serving_bench child run; parses its SERVING_BENCH JSON line."""
     import subprocess
 
@@ -122,6 +124,7 @@ def _run_serving_subprocess(args: list[str], timeout_s: int) -> dict:
             text=True,
             timeout=timeout_s,
             cwd=os.path.dirname(os.path.abspath(__file__)),
+            env={**os.environ, **(env_extra or {})},
         )
     except subprocess.TimeoutExpired:
         return {
@@ -221,7 +224,20 @@ def bench_serving() -> dict:
         # tunnel).
         result = _run_serving_subprocess(["--platform", "auto"], timeout_s=1500)
         if result.get("backend") in (None, "unavailable"):
+            # The flash-attention pallas kernel is the newest lowering
+            # risk on the tunneled backend; one retry without it
+            # separates "kernel can't lower" from "chip went away".
+            retry = _run_serving_subprocess(
+                ["--platform", "auto"],
+                timeout_s=1200,
+                env_extra={"TPUSLO_FLASH_ATTENTION": "0"},
+            )
+            if retry.get("backend") not in (None, "unavailable"):
+                retry["flash_attention"] = "disabled (first attempt failed)"
+                retry["first_attempt_error"] = str(result.get("error", "?"))[:300]
+                return retry
             result["probe"] = probe
+            result["flash_off_retry_error"] = str(retry.get("error", "?"))[:200]
             holders = _chip_holder_diagnostics()
             if holders:
                 result["chip_holder_candidates"] = holders

@@ -212,6 +212,12 @@ def run(platform: str = "auto", model: str = "auto") -> dict[str, Any]:
     out["n_params"] = n_params
     flops_per_token = 2.0 * n_params
 
+    from tpuslo.models.llama import _use_flash_attention
+
+    out["flash_attention"] = _use_flash_attention(
+        (8, 256, cfg.n_heads, cfg.head_dim), cfg.n_kv_heads
+    )
+
     t0 = time.perf_counter()
     params = init_params(jax.random.PRNGKey(0), cfg)
     jax.block_until_ready(params)

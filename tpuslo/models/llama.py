@@ -20,6 +20,7 @@ stack.  Design choices are TPU-native, not a port:
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from functools import partial
 from typing import Any
@@ -29,6 +30,31 @@ import jax.numpy as jnp
 from jax import lax
 
 PyTree = Any
+
+
+def _use_flash_attention(q_shape, n_kv_heads: int) -> bool:
+    """Route full-sequence causal attention through the Pallas kernel.
+
+    On accelerator backends the fused kernel avoids the (B, H, S, T)
+    logits materialization; on CPU the XLA path stays default (the
+    kernel would run in the slow interpreter).  ``TPUSLO_FLASH_ATTENTION``
+    overrides: ``0`` forces the XLA path everywhere, ``1`` forces the
+    kernel even on CPU (interpret mode — tests/debugging).
+    """
+    from tpuslo.ops.flash_attention import flash_eligible
+
+    override = os.environ.get("TPUSLO_FLASH_ATTENTION", "")
+    if override == "0" or not flash_eligible(q_shape, n_kv_heads):
+        return False
+    if override == "1":
+        return True
+    try:
+        # TPU-family backends only ("axon" is the tunneled TPU plugin);
+        # the kernel uses pltpu memory spaces and would fail to lower
+        # on GPU, where the XLA path already works.
+        return jax.default_backend() in ("tpu", "axon")
+    except RuntimeError:  # pragma: no cover - no backend at all
+        return False
 
 
 @dataclass(frozen=True)
@@ -214,11 +240,16 @@ def _layer_body(
     cos: jax.Array,
     sin: jax.Array,
     mask: jax.Array,
+    causal: bool = False,
 ) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
     """One transformer layer; returns (hidden, (rotated_k, v)).
 
     Shared by full forward and prefill so the layer math exists once;
     forward discards the KV output (XLA dead-code-eliminates it).
+    ``causal=True`` asserts that ``mask`` is the full causal tril —
+    callers own that invariant — and unlocks the fused flash-attention
+    path (inferring it from mask rank would silently mis-route any
+    future 2-D non-tril mask).
     """
     B, S, D = h.shape
     H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -229,7 +260,15 @@ def _layer_body(
     v = _matmul(x, layer["wv"]).reshape(B, S, KV, HD)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    attn = attention(q, k, v, mask, H // KV)
+    if causal and _use_flash_attention(q.shape, KV):
+        from tpuslo.ops.flash_attention import flash_attention
+
+        attn = flash_attention(
+            q, k, v, causal=True,
+            interpret=jax.default_backend() == "cpu",
+        )
+    else:
+        attn = attention(q, k, v, mask, H // KV)
     h = h + _matmul(attn.reshape(B, S, H * HD), layer["wo"])
 
     x = rms_norm(h, layer["mlp_norm"], cfg.norm_eps)
@@ -258,7 +297,9 @@ def forward(
     cos, sin = rope_frequencies(cfg, positions)
     mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
 
-    body = partial(_layer_body, cfg)
+    # causal bound via partial (not a call kwarg) so jax.checkpoint
+    # never sees it as a traceable argument.
+    body = partial(_layer_body, cfg, causal=True)
     if remat:
         body = jax.checkpoint(body, static_argnums=())
 
@@ -310,7 +351,7 @@ def prefill(
     mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
 
     def scan_step(h, layer):
-        return _layer_body(cfg, h, layer, cos, sin, mask)
+        return _layer_body(cfg, h, layer, cos, sin, mask, causal=True)
 
     h, (ks, vs) = lax.scan(scan_step, h, params["layers"])
 

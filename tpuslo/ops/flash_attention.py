@@ -1,0 +1,273 @@
+"""Fused causal attention (flash-style) as a Pallas TPU kernel.
+
+The serving/training hot op.  The XLA path in ``tpuslo/models/llama.py``
+materializes the full ``(B, H, S, T)`` logits tensor in HBM; at long
+sequence length that tensor dominates HBM traffic (S=4096, H=24 in
+bf16 ~= 1.6 GB per layer forward).  This kernel computes attention one
+``(block_q, block_k)`` tile at a time with the online-softmax
+recurrence, so HBM traffic is O(S * D) per head instead of O(S^2):
+
+* grid ``(B, H, S/block_q, S/block_k)`` — the last dimension is the
+  innermost sequential loop on TPU, so VMEM scratch (running max,
+  normalizer, output accumulator) carries across k-blocks of one
+  q-block;
+* tiles feed the MXU via ``dot_general`` with fp32 accumulation,
+  mask/softmax/rescale run on the VPU, everything stays in VMEM;
+* causal structure is exploited twice: fully-masked k-blocks are
+  skipped via ``pl.when`` (half the FLOPs), and the epilogue runs at
+  the *last relevant* k-block of each q-block;
+* grouped-query attention comes free through the k/v ``index_map``
+  (``h // n_rep`` — no ``jnp.repeat`` materialization at all, unlike
+  the XLA path).
+
+No reference counterpart (the reference is an observability toolkit;
+its LLM is an external llama.cpp binary) — this is the TPU-native
+compute path of the demo workload, per the rebuild brief's "pallas
+kernels for the hot ops".
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+NEG_INF = float("-inf")
+
+
+def _flash_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    m_scratch,
+    l_scratch,
+    acc_scratch,
+    *,
+    scale: float,
+    block_q: int,
+    block_k: int,
+    num_k_blocks: int,
+    causal: bool,
+):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    if causal:
+        # Last k-block this q-block can see; also the epilogue block.
+        last_k = lax.div(q_start + block_q - 1, block_k)
+        relevant = ki <= last_k
+    else:
+        last_k = num_k_blocks - 1
+        relevant = True
+
+    @pl.when(relevant)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)  # (block_q, d)
+        k = k_ref[0, 0].astype(jnp.float32)  # (block_k, d)
+        v = v_ref[0, 0].astype(jnp.float32)  # (block_k, d)
+
+        s = (
+            lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # (block_q, block_k)
+        if causal:
+            qpos = q_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = k_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+
+        m_prev = m_scratch[:, 0]  # (block_q,)
+        l_prev = l_scratch[:, 0]
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # exp(-inf - -inf) would be NaN; fully-masked rows keep m=-inf
+        # only before any unmasked block, and causal rows always see
+        # the diagonal, so guard alpha for the first iteration only.
+        alpha = jnp.where(m_prev == NEG_INF, 0.0, jnp.exp(m_prev - m_new))
+        p = jnp.exp(s - m_new[:, None])
+        if causal:
+            p = jnp.where(qpos >= kpos, p, 0.0)
+
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1)
+        acc_scratch[:] = acc_scratch[:] * alpha[:, None] + lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scratch[:] = jnp.broadcast_to(m_new[:, None], m_scratch.shape)
+        l_scratch[:] = jnp.broadcast_to(l_new[:, None], l_scratch.shape)
+
+    @pl.when(ki == last_k)
+    def _epilogue():
+        l_final = l_scratch[:, 0]
+        # Unmasked rows always have l >= exp(0) contributions; the
+        # guard only protects hypothetical fully-masked rows.
+        denom = jnp.where(l_final == 0.0, 1.0, l_final)
+        o_ref[0, 0] = (acc_scratch[:] / denom[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused attention.  q: (B, S, H, D); k/v: (B, S, KV, D) with
+    H % KV == 0 (GQA).  Returns (B, S, H, D) in q's dtype.
+
+    Requirements (checked by :func:`flash_eligible`): S divisible by
+    the block sizes, D a multiple of the 128-lane tile.  Use
+    ``interpret=True`` to run/test on CPU.
+
+    Differentiable: the forward pass is the fused kernel; the backward
+    pass (training path, under ``jax.checkpoint`` remat in
+    ``tpuslo/models/llama.py``) recomputes attention with standard XLA
+    ops — it materializes per-layer (B, H, S, S) probabilities like the
+    plain path, trading backward HBM for not hand-maintaining a second
+    kernel.  Serving (prefill) never differentiates and keeps the full
+    O(S*D) win.
+    """
+    return _flash(q, k, v, causal, block_q, block_k, interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_forward_kernel(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = _flash_forward_kernel(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, residuals, d_out):
+    q, k, v = residuals
+    B, S, H, D = q.shape
+    KV = k.shape[2]
+    n_rep = H // KV
+    scale = D**-0.5
+
+    qf = q.astype(jnp.float32)
+    kf = jnp.repeat(k, n_rep, axis=2).astype(jnp.float32)
+    vf = jnp.repeat(v, n_rep, axis=2).astype(jnp.float32)
+    do = d_out.astype(jnp.float32)
+
+    s = jnp.einsum("bshd,bthd->bhst", qf, kf) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)  # (B, H, S, T)
+
+    dv_rep = jnp.einsum("bhst,bshd->bthd", p, do)
+    dp = jnp.einsum("bshd,bthd->bhst", do, vf)
+    ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+    dq = jnp.einsum("bhst,bthd->bshd", ds, kf) * scale
+    dk_rep = jnp.einsum("bhst,bshd->bthd", ds, qf) * scale
+
+    # Fold grouped heads back onto their shared kv head.
+    dk = dk_rep.reshape(B, S, KV, n_rep, D).sum(axis=3)
+    dv = dv_rep.reshape(B, S, KV, n_rep, D).sum(axis=3)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _flash_forward_kernel(q, k, v, causal, block_q, block_k, interpret):
+    B, S, H, D = q.shape
+    KV = k.shape[2]
+    n_rep = H // KV
+    if S % block_q or S % block_k:
+        raise ValueError(f"seq {S} not divisible by blocks {block_q}/{block_k}")
+    scale = D**-0.5
+
+    # (B, H, S, D) layout: heads become grid rows, sequence tiles.
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    num_q = S // block_q
+    num_k = S // block_k
+    kernel = functools.partial(
+        _flash_kernel,
+        scale=scale,
+        block_q=block_q,
+        block_k=block_k,
+        num_k_blocks=num_k,
+        causal=causal,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, H, num_q, num_k),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, D),
+                lambda b, h, qi, ki, n_rep=n_rep: (b, h // n_rep, ki, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, D),
+                lambda b, h, qi, ki, n_rep=n_rep: (b, h // n_rep, ki, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        scratch_shapes=[
+            _vmem((block_q, 128), jnp.float32),
+            _vmem((block_q, 128), jnp.float32),
+            _vmem((block_q, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)
+
+
+def _vmem(shape, dtype):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.VMEM(shape, dtype)
+
+
+def flash_eligible(
+    q_shape: tuple[int, ...],
+    kv_heads: int,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> bool:
+    """Can :func:`flash_attention` handle this full-sequence causal
+    attention?  (Decode's per-row cache masks and ragged shapes fall
+    back to the XLA path.)"""
+    if len(q_shape) != 4:
+        return False
+    _, S, H, D = q_shape
+    return (
+        S % block_q == 0
+        and S % block_k == 0
+        and D % 128 == 0
+        and H % kv_heads == 0
+    )
