@@ -1,0 +1,82 @@
+"""Pipeline parallelism: forward parity with the plain model, grads."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from tpuslo.models.llama import forward, init_params, llama_tiny
+from tpuslo.parallel.pipeline import (
+    pipelined_forward,
+    pipelined_loss,
+    place_pipeline_params,
+)
+
+
+def _mesh(pp: int) -> Mesh:
+    return Mesh(np.array(jax.devices()[:pp]), ("pp",))
+
+
+@pytest.mark.parametrize("pp,n_mb", [(2, 4), (4, 2), (8, 4)])
+def test_pipelined_forward_matches_plain(pp, n_mb):
+    cfg = llama_tiny(max_seq_len=32)  # n_layers=2 -> pad via pp<=... use 8 layers
+    cfg = type(cfg)(**{**cfg.__dict__, "n_layers": 8})
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab_size
+    )
+
+    plain = forward(params, tokens, cfg, remat=False)
+
+    mesh = _mesh(pp)
+    placed = place_pipeline_params(params, mesh)
+    piped = jax.jit(
+        lambda p, t: pipelined_forward(p, t, cfg, mesh, n_microbatches=n_mb)
+    )(placed, tokens)
+
+    err = float(jnp.max(jnp.abs(plain - piped)))
+    assert err < 2e-2, f"pp={pp} n_mb={n_mb} parity error {err}"
+
+
+def test_pipelined_loss_grad_flows():
+    cfg = type(llama_tiny(max_seq_len=32))(
+        **{**llama_tiny(max_seq_len=32).__dict__, "n_layers": 4}
+    )
+    mesh = _mesh(4)
+    params = place_pipeline_params(
+        init_params(jax.random.PRNGKey(0), cfg), mesh
+    )
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    loss, grads = jax.jit(
+        jax.value_and_grad(
+            lambda p: pipelined_loss(p, tokens, targets, cfg, mesh, n_microbatches=2)
+        )
+    )(params)
+    assert np.isfinite(float(loss))
+    g_norm = float(
+        jnp.sqrt(
+            sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in jax.tree.leaves(grads)
+            )
+        )
+    )
+    assert np.isfinite(g_norm) and g_norm > 0.0
+    # Every stage's layer shard must receive gradient (the pipeline
+    # visits all layers).
+    wq_g = grads["layers"]["wq"].astype(jnp.float32)
+    per_layer = jnp.sum(jnp.square(wq_g), axis=(1, 2))
+    assert float(jnp.min(per_layer)) > 0.0
+
+
+def test_pipeline_rejects_indivisible_layers():
+    cfg = llama_tiny(max_seq_len=32)  # 2 layers
+    mesh = _mesh(4)
+    # Unplaced params: the shape check must fire before any device_put.
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.zeros((4, 8), jnp.int32)
+    with pytest.raises(ValueError, match="not divisible"):
+        pipelined_forward(params, tokens, cfg, mesh)

@@ -1,0 +1,232 @@
+"""Mixture-of-experts MLP with expert parallelism (the ``ep`` axis).
+
+The observed-workload stack's MoE block (Mixtral-class models): top-k
+routing with capacity-bucketed dispatch, experts sharded across the
+``ep`` mesh axis, tokens exchanged with ``lax.all_to_all`` so each
+device only ever computes its local experts.  TPU-first design notes:
+
+* dispatch/combine are **one-hot einsums against static-capacity
+  buffers** — no dynamic shapes, no sorting; XLA lowers them to
+  MXU-friendly matmuls and the program never recompiles as routing
+  changes;
+* the token exchange is two ``all_to_all`` collectives over ``ep``
+  (dispatch and return), which ride ICI when ``ep`` maps to the
+  fast mesh dimension;
+* over-capacity tokens are *dropped* (standard GShard semantics): the
+  combine weights for dropped tokens are zero so they fall back to the
+  residual path in a transformer block.
+
+The reference toolkit has no parallelism of any kind (SURVEY.md §2.5);
+this op plus :mod:`tpuslo.parallel.pipeline` complete the
+dp/fsdp/tp/sp/pp/ep set for the demo workload.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # moved out of jax.experimental in newer releases
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+PyTree = Any
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    dim: int = 64
+    ffn_dim: int = 128
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 2.0
+    dtype: Any = jnp.bfloat16
+
+    def capacity(self, n_tokens: int) -> int:
+        """Per-expert token capacity for a local batch of ``n_tokens``."""
+        cap = int(self.capacity_factor * self.top_k * n_tokens / self.n_experts)
+        return max(cap, 1)
+
+
+def init_moe_params(rng: jax.Array, cfg: MoEConfig) -> PyTree:
+    """Router + expert-stacked SwiGLU weights (leading expert axis)."""
+    k_router, k1, k2, k3 = jax.random.split(rng, 4)
+    E, D, F = cfg.n_experts, cfg.dim, cfg.ffn_dim
+
+    def dense(key, shape, fan_in):
+        scale = fan_in**-0.5
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(
+            cfg.dtype
+        )
+
+    return {
+        # Router stays fp32: tiny, and routing decisions are precision-
+        # sensitive (a bf16 tie flips expert assignment between backends).
+        "router": (
+            jax.random.normal(k_router, (D, E), jnp.float32) * D**-0.5
+        ),
+        "w1": dense(k1, (E, D, F), D),
+        "w3": dense(k3, (E, D, F), D),
+        "w2": dense(k2, (E, F, D), F),
+    }
+
+
+def _routing(
+    params: PyTree, x: jax.Array, cfg: MoEConfig, capacity: int
+) -> tuple[jax.Array, jax.Array]:
+    """Dispatch/combine tensors for local tokens x: (T, D).
+
+    Returns ``dispatch`` (T, E, C) bool and ``combine`` (T, E, C) fp32.
+    Position-in-expert is assigned greedily by (k, token) priority: all
+    first choices ahead of all second choices, tokens in order — the
+    GShard tie-break, deterministic under jit.
+    """
+    T = x.shape[0]
+    E, K = cfg.n_experts, cfg.top_k
+
+    logits = x.astype(jnp.float32) @ params["router"]  # (T, E)
+    gate_vals, expert_idx = lax.top_k(logits, K)  # (T, K)
+    gates = jax.nn.softmax(gate_vals, axis=-1)  # renormalised over top-k
+
+    # (K, T, E) one-hot assignment, priority-ordered k-major.
+    onehot = jax.nn.one_hot(expert_idx.T, E, dtype=jnp.int32)  # (K, T, E)
+    flat = onehot.reshape(K * T, E)
+    # Position of each (k, token) within its expert's capacity buffer.
+    pos = jnp.cumsum(flat, axis=0) - flat  # (K*T, E)
+    pos = jnp.sum(pos * flat, axis=-1).reshape(K, T)  # (K, T)
+    kept = pos < capacity
+
+    pos_onehot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)  # (K, T, C)
+    # (K, T, E, C): expert one-hot x position one-hot, masked by capacity.
+    slots = (
+        onehot.astype(jnp.float32)[..., None]
+        * pos_onehot[:, :, None, :]
+        * kept.astype(jnp.float32)[..., None, None]
+    )
+    dispatch = jnp.sum(slots, axis=0)  # (T, E, C) — slots are disjoint
+    combine = jnp.sum(slots * gates.T[..., None, None], axis=0)
+    return dispatch, combine
+
+
+def _expert_ffn(params: PyTree, x: jax.Array, cfg: MoEConfig) -> jax.Array:
+    """Stacked SwiGLU over the leading expert axis.  x: (E, C, D)."""
+    x = x.astype(cfg.dtype)
+
+    def mm(a, w):  # (E, C, D) x (E, D, F) -> (E, C, F)
+        return lax.dot_general(
+            a, w, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+
+    gate = jax.nn.silu(mm(x, params["w1"]))
+    up = mm(x, params["w3"])
+    return mm((gate * up).astype(cfg.dtype), params["w2"]).astype(jnp.float32)
+
+
+def moe_mlp(params: PyTree, x: jax.Array, cfg: MoEConfig) -> jax.Array:
+    """Single-device MoE MLP.  x: (T, D) → (T, D).
+
+    The dense reference for the sharded path (same dispatch semantics,
+    including capacity drops).
+    """
+    capacity = cfg.capacity(x.shape[0])
+    dispatch, combine = _routing(params, x, cfg, capacity)
+    xe = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    out = _expert_ffn(params, xe, cfg)  # (E, C, D)
+    y = jnp.einsum("tec,ecd->td", combine, out)
+    return y.astype(x.dtype)
+
+
+def _moe_shard_body(
+    params: PyTree, x: jax.Array, cfg: MoEConfig, axis_name: str
+) -> jax.Array:
+    """shard_map body: tokens and experts both sharded over ``axis_name``.
+
+    x: (T_local, D); params["w*"]: (E_local, ...) — the local expert
+    shard.  Router weights are replicated.
+    """
+    ep = lax.psum(1, axis_name)
+    T = x.shape[0]
+    E_local = params["w1"].shape[0]
+
+    # Routing is local: each device routes its own tokens against the
+    # full expert table.  Capacity is per-expert *per source shard* so
+    # buffer shapes stay static.
+    capacity = cfg.capacity(T)
+    dispatch, combine = _routing(params, x, cfg, capacity)
+    xe = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    # (E, C, D) -> (ep, E_local, C, D): group by owning shard.
+    xe = xe.reshape(ep, E_local, capacity, -1)
+
+    # Dispatch exchange: after all_to_all the leading axis indexes the
+    # *source* shard; each device holds every shard's tokens for its
+    # local experts.
+    xe = lax.all_to_all(xe, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    # (ep, E_local, C, D) — leading axis now indexes the source shard.
+    # Flatten (source, capacity) into one slot axis per local expert;
+    # the transpose keeps slots grouped by source so the return trip
+    # can route them back.
+    xe = xe.transpose(1, 0, 2, 3).reshape(E_local, ep * capacity, -1)
+    out = _expert_ffn(params, xe, cfg)  # (E_local, ep*C, D)
+    out = out.reshape(E_local, ep, capacity, -1).transpose(1, 0, 2, 3)
+    # Return exchange: send each source shard its tokens back.
+    out = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    out = out.reshape(cfg.n_experts, capacity, -1)  # (E, C, D) local view
+    y = jnp.einsum("tec,ecd->td", combine, out)
+    return y.astype(x.dtype)
+
+
+def moe_params_specs(axis_name: str = "ep") -> PyTree:
+    """PartitionSpecs for :func:`init_moe_params` under expert sharding."""
+    return {
+        "router": P(None, None),
+        "w1": P(axis_name, None, None),
+        "w3": P(axis_name, None, None),
+        "w2": P(axis_name, None, None),
+    }
+
+
+def moe_mlp_sharded(
+    params: PyTree,
+    x: jax.Array,
+    cfg: MoEConfig,
+    mesh: Mesh,
+    axis_name: str = "ep",
+) -> jax.Array:
+    """Expert-parallel MoE MLP.  x: (T, D) sharded over tokens.
+
+    ``cfg.n_experts`` must be divisible by the ``axis_name`` mesh size,
+    and T by the same (token sharding).
+    """
+    ep = mesh.shape[axis_name]
+    if cfg.n_experts % ep:
+        raise ValueError(
+            f"n_experts={cfg.n_experts} not divisible by ep={ep}"
+        )
+    if x.shape[0] % ep:
+        raise ValueError(f"tokens={x.shape[0]} not divisible by ep={ep}")
+    fn = shard_map(
+        partial(_moe_shard_body, cfg=cfg, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(moe_params_specs(axis_name), P(axis_name, None)),
+        out_specs=P(axis_name, None),
+    )
+    return fn(params, x)
+
+
+def place_moe_params(params: PyTree, mesh: Mesh, axis_name: str = "ep") -> PyTree:
+    """Device-put the expert shards according to the ep layout."""
+    specs = moe_params_specs(axis_name)
+    return jax.tree.map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        params,
+        specs,
+        is_leaf=lambda v: isinstance(v, jax.Array),
+    )

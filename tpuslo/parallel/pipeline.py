@@ -1,0 +1,220 @@
+"""Pipeline parallelism (the ``pp`` axis): GPipe microbatch schedule.
+
+Layers are stacked along a leading axis (see
+``tpuslo.models.llama.init_params``), so pipeline-stage assignment is
+just sharding that axis over the ``pp`` mesh dimension — stage *i*
+holds layers ``[i*L/pp, (i+1)*L/pp)``.  The schedule is a single
+``lax.scan`` over ``n_microbatches + pp - 1`` ticks; each tick every
+stage runs its local layer stack on its current microbatch and hands
+the activations to the next stage with ``lax.ppermute`` (one
+neighbour ICI hop).  The whole schedule is reverse-differentiable —
+``scan``/``ppermute``/``psum`` all carry transpose rules, so
+``jax.grad`` through :func:`pipelined_loss` yields the standard GPipe
+backward pipeline without hand-written bubbles.
+
+TPU-first notes:
+
+* static trip count and static microbatch shapes — one compile, no
+  bubbles beyond the algorithmic ``pp - 1``;
+* embedding/final-norm/head are computed replicated (they are tiny
+  next to the layer stack and keeping them replicated avoids two
+  extra boundary collectives);
+* activations cross stages in the model dtype (bf16 on TPU), so each
+  hop moves ``mb x S x D x 2`` bytes.
+
+The reference has no parallelism at all (SURVEY.md §2.5); together
+with dp/fsdp/tp (``tpuslo.parallel.mesh``), sp
+(``tpuslo.ops.ring_attention``) and ep (``tpuslo.ops.moe``) this
+completes the strategy set for the observed workload.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpuslo.models.llama import LlamaConfig, _layer_body, rms_norm, rope_frequencies, _matmul
+
+try:  # moved out of jax.experimental in newer releases
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+PyTree = Any
+
+
+def pipeline_param_specs(axis_name: str = "pp") -> PyTree:
+    """PartitionSpec tree for ``init_params``: layer axis over ``pp``."""
+    layer = P(axis_name, None, None)
+    return {
+        "embed": P(None, None),
+        "layers": {
+            "attn_norm": P(axis_name, None),
+            "wq": layer,
+            "wk": layer,
+            "wv": layer,
+            "wo": layer,
+            "mlp_norm": P(axis_name, None),
+            "w1": layer,
+            "w3": layer,
+            "w2": layer,
+        },
+        "final_norm": P(None),
+        "output": P(None, None),
+    }
+
+
+def place_pipeline_params(params: PyTree, mesh: Mesh, axis_name: str = "pp") -> PyTree:
+    specs = pipeline_param_specs(axis_name)
+    return jax.tree.map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        params,
+        specs,
+    )
+
+
+def _stage_stack(cfg: LlamaConfig, h, local_layers, cos, sin, mask, remat):
+    """Run this stage's layer shard on one microbatch."""
+    body = partial(_layer_body, cfg, causal=True)
+    if remat:
+        body = jax.checkpoint(body, static_argnums=())
+
+    def scan_step(carry, layer):
+        carry, _kv = body(carry, layer, cos, sin, mask)
+        return carry, None
+
+    h, _ = lax.scan(scan_step, h, local_layers)
+    return h
+
+
+def _pipeline_body(
+    params: PyTree,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    axis_name: str,
+    n_microbatches: int,
+    remat: bool,
+) -> jax.Array:
+    """shard_map body → logits (B, S, vocab), replicated."""
+    pp = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    M = n_microbatches
+    B, S = tokens.shape
+    mb = B // M
+
+    h = params["embed"][tokens].astype(cfg.dtype)  # replicated compute
+    h_mb = h.reshape(M, mb, S, -1)
+    positions = jnp.broadcast_to(jnp.arange(S), (mb, S))
+    cos, sin = rope_frequencies(cfg, positions)
+    mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+
+    fwd = partial(
+        _stage_stack, cfg, local_layers=params["layers"], cos=cos, sin=sin,
+        mask=mask, remat=remat,
+    )
+
+    def tick(carry, t):
+        buf, outputs = carry
+        # Stage 0 injects microbatch t (clamped: late ticks re-inject the
+        # last microbatch, whose output is never collected).
+        inject = lax.dynamic_index_in_dim(
+            h_mb, jnp.minimum(t, M - 1), 0, keepdims=False
+        )
+        buf = jnp.where(stage == 0, inject, buf)
+        processed = fwd(buf)
+        # Last stage collects finished microbatch t - (pp - 1).
+        out_idx = t - (pp - 1)
+        collected = lax.dynamic_update_index_in_dim(
+            outputs, processed.astype(jnp.float32), jnp.clip(out_idx, 0, M - 1), 0
+        )
+        take = jnp.logical_and(stage == pp - 1, out_idx >= 0)
+        outputs = jnp.where(take, collected, outputs)
+        # Hand activations to the next stage (ring hop; the wraparound
+        # pp-1 -> 0 link carries data stage 0 overwrites via inject).
+        perm = [(i, (i + 1) % pp) for i in range(pp)]
+        buf = lax.ppermute(processed, axis_name, perm)
+        return (buf, outputs), None
+
+    # The carry becomes stage-varying after the first tick (axis_index /
+    # ppermute); the initial zeros must carry the same varying-over-pp
+    # type or scan rejects the carry (shard_map vma rule).
+    buf0 = lax.pcast(
+        jnp.zeros((mb, S, h.shape[-1]), cfg.dtype), (axis_name,), to="varying"
+    )
+    out0 = lax.pcast(
+        jnp.zeros((M, mb, S, h.shape[-1]), jnp.float32), (axis_name,), to="varying"
+    )
+    (_, outputs), _ = lax.scan(
+        tick, (buf0, out0), jnp.arange(M + pp - 1)
+    )
+
+    # Only the last stage holds real outputs; psum replicates them so
+    # the (replicated) head below sees identical values everywhere.
+    outputs = lax.psum(
+        jnp.where(stage == pp - 1, outputs, jnp.zeros_like(outputs)),
+        axis_name,
+    )
+    h = outputs.reshape(B, S, -1).astype(cfg.dtype)
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps)
+    return _matmul(h, params["output"]).astype(jnp.float32)
+
+
+def pipelined_forward(
+    params: PyTree,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    n_microbatches: int = 4,
+    axis_name: str = "pp",
+    remat: bool = False,
+) -> jax.Array:
+    """Full-sequence forward through the pipeline → logits (B, S, V).
+
+    Requires ``cfg.n_layers % mesh.shape[axis_name] == 0`` and
+    ``tokens.shape[0] % n_microbatches == 0``.
+    """
+    pp = mesh.shape[axis_name]
+    if cfg.n_layers % pp:
+        raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={pp}")
+    if tokens.shape[0] % n_microbatches:
+        raise ValueError(
+            f"batch={tokens.shape[0]} not divisible by "
+            f"n_microbatches={n_microbatches}"
+        )
+    fn = shard_map(
+        partial(
+            _pipeline_body,
+            cfg=cfg,
+            axis_name=axis_name,
+            n_microbatches=n_microbatches,
+            remat=remat,
+        ),
+        mesh=mesh,
+        in_specs=(pipeline_param_specs(axis_name), P(None, None)),
+        out_specs=P(None, None, None),
+    )
+    return fn(params, tokens)
+
+
+def pipelined_loss(
+    params: PyTree,
+    tokens: jax.Array,
+    targets: jax.Array,
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    n_microbatches: int = 4,
+    axis_name: str = "pp",
+    remat: bool = True,
+) -> jax.Array:
+    """Mean next-token cross-entropy through the pipeline (grad-able)."""
+    logits = pipelined_forward(
+        params, tokens, cfg, mesh, n_microbatches, axis_name, remat
+    )
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
