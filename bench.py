@@ -220,9 +220,9 @@ def bench_serving() -> dict:
             probe = retry_probe
 
         # Chip is up: full bench gets the long budget (weights init +
-        # ~5 compiles on a 3B-class model through the remote-compile
-        # tunnel).
-        result = _run_serving_subprocess(["--platform", "auto"], timeout_s=1500)
+        # ~5 compiles on a 3B-class model plus the int8 llama3-8b lane,
+        # all through the remote-compile tunnel).
+        result = _run_serving_subprocess(["--platform", "auto"], timeout_s=2100)
         if result.get("backend") in (None, "unavailable"):
             # The flash-attention pallas kernel is the newest lowering
             # risk on the tunneled backend; one retry without it

@@ -1,0 +1,104 @@
+"""int8 weight-only quantization: numerics, memory layout, serving."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpuslo.models.llama import (
+    forward,
+    init_params,
+    init_params_quantized,
+    llama_tiny,
+    prefill,
+    decode_step,
+    init_kv_cache,
+    quantize_params,
+    quantized_bytes,
+    param_count,
+)
+from tpuslo.models.serve import ServeEngine
+
+
+def _cfg():
+    return llama_tiny(max_seq_len=64)
+
+
+def test_quantized_init_matches_two_step_path():
+    cfg = _cfg()
+    rng = jax.random.PRNGKey(3)
+    two_step = quantize_params(init_params(rng, cfg))
+    leafwise = init_params_quantized(rng, cfg)
+    flat_a = jax.tree.leaves(two_step)
+    flat_b = jax.tree.leaves(leafwise)
+    assert len(flat_a) == len(flat_b)
+    for a, b in zip(flat_a, flat_b):
+        assert a.shape == b.shape and a.dtype == b.dtype
+        if a.dtype == jnp.int8:
+            # Agreement to one quantization step: XLA may round
+            # exact-.5 boundaries differently across fusion contexts.
+            diff = np.abs(
+                np.asarray(a).astype(np.int32) - np.asarray(b).astype(np.int32)
+            )
+            assert diff.max() <= 1
+            assert (diff != 0).mean() < 1e-3
+        else:
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_quantized_forward_close_to_dense():
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    qparams = quantize_params(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+
+    dense = forward(params, tokens, cfg, remat=False)
+    quant = forward(qparams, tokens, cfg, remat=False)
+
+    rel = float(
+        jnp.linalg.norm(dense - quant) / jnp.maximum(jnp.linalg.norm(dense), 1e-9)
+    )
+    assert rel < 0.05, f"relative logits error {rel}"
+
+
+def test_quantized_prefill_decode_consistent_with_dense():
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    qparams = quantize_params(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (1, 8), 0, cfg.vocab_size)
+
+    logits_d, cache_d = prefill(params, tokens, init_kv_cache(cfg, 1), cfg)
+    logits_q, cache_q = prefill(qparams, tokens, init_kv_cache(cfg, 1), cfg)
+    tok_d = jnp.argmax(logits_d, -1).astype(jnp.int32)
+    step_d, _ = decode_step(params, tok_d, cache_d, cfg)
+    step_q, _ = decode_step(qparams, tok_d, cache_q, cfg)
+    rel = float(
+        jnp.linalg.norm(step_d - step_q)
+        / jnp.maximum(jnp.linalg.norm(step_d), 1e-9)
+    )
+    assert rel < 0.05, f"decode-step relative error {rel}"
+
+
+def test_quantized_serve_engine_generates():
+    engine = ServeEngine(cfg=_cfg(), quantize=True)
+    assert engine.quantized
+    events = list(engine.generate("hello quant", max_new_tokens=6, stop_at_eos=False))
+    assert len(events) == 6
+    assert events[0].ttft_ms is not None
+    rows = engine.generate_batch(["a", "bb"], max_new_tokens=4, stop_at_eos=False)
+    assert [len(r) for r in rows] == [4, 4]
+
+
+def test_quantized_bytes_accounting():
+    cfg = _cfg()
+    n = param_count(cfg)
+    qb = quantized_bytes(cfg)
+    assert n < qb < 1.1 * n  # int8 body + small fp32 scale/norm overhead
+
+
+def test_int8_leaves_really_int8():
+    cfg = _cfg()
+    q = quantize_params(init_params(jax.random.PRNGKey(0), cfg))
+    assert q["layers"]["w1"]["q"].dtype == jnp.int8
+    assert q["embed"]["q"].dtype == jnp.int8
+    assert q["output"]["q"].dtype == jnp.int8
+    assert q["layers"]["attn_norm"].dtype == cfg.dtype

@@ -140,33 +140,157 @@ def llama_tiny(max_seq_len: int = 256) -> LlamaConfig:
     )
 
 
+def _dense_init(key, shape, fan_in, dtype):
+    scale = fan_in**-0.5
+    return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
+
+
+def _param_layout(cfg: LlamaConfig):
+    """(embed, layer-leaves, output) init specs shared by the bf16 and
+    quantized initialisers so both produce identical trees/numerics."""
+    L, D, F = cfg.n_layers, cfg.dim, cfg.ffn_dim
+    H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    layer_dense = {
+        "wq": ((L, D, H * HD), D),
+        "wk": ((L, D, KV * HD), D),
+        "wv": ((L, D, KV * HD), D),
+        "wo": ((L, H * HD, D), H * HD),
+        "w1": ((L, D, F), D),
+        "w3": ((L, D, F), D),
+        "w2": ((L, F, D), F),
+    }
+    return ((cfg.vocab_size, D), D), layer_dense, ((D, cfg.vocab_size), D)
+
+
 def init_params(rng: jax.Array, cfg: LlamaConfig) -> PyTree:
     """Initialise parameters with layer-stacked leaves."""
     k_embed, k_layers, k_out = jax.random.split(rng, 3)
-
-    def dense(key, shape, fan_in):
-        scale = fan_in**-0.5
-        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(cfg.dtype)
-
-    L, D, F = cfg.n_layers, cfg.dim, cfg.ffn_dim
-    H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    (e_shape, e_fan), layer_dense, (o_shape, o_fan) = _param_layout(cfg)
+    L, D = cfg.n_layers, cfg.dim
     keys = jax.random.split(k_layers, 7)
-    return {
-        "embed": dense(k_embed, (cfg.vocab_size, D), D),
-        "layers": {
-            "attn_norm": jnp.ones((L, D), cfg.dtype),
-            "wq": dense(keys[0], (L, D, H * HD), D),
-            "wk": dense(keys[1], (L, D, KV * HD), D),
-            "wv": dense(keys[2], (L, D, KV * HD), D),
-            "wo": dense(keys[3], (L, H * HD, D), H * HD),
-            "mlp_norm": jnp.ones((L, D), cfg.dtype),
-            "w1": dense(keys[4], (L, D, F), D),
-            "w3": dense(keys[5], (L, D, F), D),
-            "w2": dense(keys[6], (L, F, D), F),
-        },
-        "final_norm": jnp.ones((D,), cfg.dtype),
-        "output": dense(k_out, (D, cfg.vocab_size), D),
+    order = ("wq", "wk", "wv", "wo", "w1", "w3", "w2")
+    layers = {
+        name: _dense_init(keys[i], *layer_dense[name], cfg.dtype)
+        for i, name in enumerate(order)
     }
+    layers["attn_norm"] = jnp.ones((L, D), cfg.dtype)
+    layers["mlp_norm"] = jnp.ones((L, D), cfg.dtype)
+    return {
+        "embed": _dense_init(k_embed, e_shape, e_fan, cfg.dtype),
+        "layers": layers,
+        "final_norm": jnp.ones((D,), cfg.dtype),
+        "output": _dense_init(k_out, o_shape, o_fan, cfg.dtype),
+    }
+
+
+# --- int8 weight-only quantization -------------------------------------
+#
+# Decode is HBM-bandwidth-bound: every generated token re-reads the full
+# weight set, so int8 weights double decode tokens/s and halve the HBM
+# footprint (llama3-8b fits a single 16 GB v5e chip).  Symmetric
+# per-output-channel scales; the matmul computes (x @ q_bf16) * s, which
+# is exactly dequantize-then-matmul because scales are per output
+# channel, while the MXU still sees a dense bf16 operand converted
+# on-the-fly from int8 HBM reads.
+
+
+@jax.jit
+def _quantize_leaf(w: jax.Array) -> dict:
+    """{"q": int8, "s": f32} with scales over the contracting axis (-2).
+
+    For matmul weights (.., D, F) the contracting dim is -2, giving one
+    scale per output channel.  The embedding (V, D) uses the same rule —
+    per-feature scales over the vocab axis — so dequantized rows are
+    ``q[tokens] * s``.
+
+    jitted so the fp32 upcast fuses into the rounding kernel — the only
+    materialized buffers are the bf16 input and int8 output, which is
+    what lets 8B-class leaves quantize inside a 16 GB chip.
+    """
+    w32 = w.astype(jnp.float32)
+    s = jnp.max(jnp.abs(w32), axis=-2) / 127.0
+    s = jnp.maximum(s, 1e-12)
+    q = jnp.clip(jnp.round(w32 / s[..., None, :]), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": s}
+
+
+_QUANT_LAYER_LEAVES = ("wq", "wk", "wv", "wo", "w1", "w3", "w2")
+
+
+def quantize_params(params: PyTree) -> PyTree:
+    """Quantize all matmul weights of an ``init_params`` tree to int8.
+
+    Norm scales stay in the model dtype (tiny, precision-sensitive).
+    """
+    layers = dict(params["layers"])
+    for name in _QUANT_LAYER_LEAVES:
+        layers[name] = _quantize_leaf(layers[name])
+    return {
+        "embed": _quantize_leaf(params["embed"]),
+        "layers": layers,
+        "final_norm": params["final_norm"],
+        "output": _quantize_leaf(params["output"]),
+    }
+
+
+def init_params_quantized(rng: jax.Array, cfg: LlamaConfig) -> PyTree:
+    """Init + quantize leaf-by-leaf, freeing each bf16 leaf immediately.
+
+    ``quantize_params(init_params(rng, cfg))`` needs the full bf16 tree
+    resident (16 GB for llama3-8b — over a v5e chip's HBM); this path
+    peaks at int8-total + one bf16 leaf, which is what makes 8B-class
+    serving possible on a single chip.  Same key-split structure as the
+    two-step path; values agree to within one quantization step (XLA
+    may round exact-.5 boundaries differently across fusion contexts).
+    """
+    k_embed, k_layers, k_out = jax.random.split(rng, 3)
+    (e_shape, e_fan), layer_dense, (o_shape, o_fan) = _param_layout(cfg)
+    L, D = cfg.n_layers, cfg.dim
+    keys = jax.random.split(k_layers, 7)
+
+    @partial(jax.jit, static_argnums=(1, 2))
+    def dense_q(key, shape, fan_in):
+        # One fused executable per leaf: RNG -> scale -> round -> int8.
+        # The bf16 intermediate lives only inside the program, and one
+        # dispatch per leaf keeps remote-tunnel round-trips bounded.
+        # The barrier stops XLA from folding the f32->bf16->f32 convert
+        # chain, which would quantize from unrounded f32 values and
+        # diverge from quantize_params(init_params(...)).
+        w = lax.optimization_barrier(_dense_init(key, shape, fan_in, cfg.dtype))
+        return _quantize_leaf(w)
+
+    order = ("wq", "wk", "wv", "wo", "w1", "w3", "w2")
+    layers = {
+        name: dense_q(keys[i], *layer_dense[name])
+        for i, name in enumerate(order)
+    }
+    layers["attn_norm"] = jnp.ones((L, D), cfg.dtype)
+    layers["mlp_norm"] = jnp.ones((L, D), cfg.dtype)
+    return {
+        "embed": dense_q(k_embed, e_shape, e_fan),
+        "layers": layers,
+        "final_norm": jnp.ones((D,), cfg.dtype),
+        "output": dense_q(k_out, o_shape, o_fan),
+    }
+
+
+def quantized_bytes(cfg: LlamaConfig) -> int:
+    """HBM bytes for an ``init_params_quantized`` tree.
+
+    int8 weight bodies + fp32 per-output-channel scales (one per output
+    channel of each matmul weight, per dim of the embedding) + the
+    norm vectors in the model dtype (2 bytes).
+    """
+    D, F, L = cfg.dim, cfg.ffn_dim, cfg.n_layers
+    H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    n = param_count(cfg)
+    norm_params = L * 2 * D + D
+    scale_params = (
+        L * (H * HD + 2 * KV * HD + D + 2 * F + D)  # wq wk wv wo w1 w3 w2
+        + D  # embed (scales over vocab axis -> one per dim)
+        + cfg.vocab_size  # output head
+    )
+    return (n - norm_params) + 4 * scale_params + 2 * norm_params
 
 
 def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
@@ -195,14 +319,37 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     ).astype(x.dtype)
 
 
-def _matmul(x: jax.Array, w: jax.Array) -> jax.Array:
-    """bf16 matmul with fp32 accumulation on the MXU."""
+def _matmul(x: jax.Array, w) -> jax.Array:
+    """bf16 matmul with fp32 accumulation on the MXU.
+
+    ``w`` is either a dense array or an int8 quant dict {"q", "s"}; the
+    quantized path reads int8 from HBM (half the decode bandwidth),
+    converts to the activation dtype on the fly, and folds the
+    per-output-channel scale into the fp32 accumulator output.
+    """
+    if isinstance(w, dict):
+        out = lax.dot_general(
+            x,
+            w["q"].astype(x.dtype),
+            (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return (out * w["s"]).astype(x.dtype)
     return lax.dot_general(
         x,
         w,
         (((x.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     ).astype(x.dtype)
+
+
+def _embed_lookup(params: PyTree, tokens: jax.Array, dtype) -> jax.Array:
+    """Embedding gather for dense or quantized embedding tables."""
+    e = params["embed"]
+    if isinstance(e, dict):
+        rows = e["q"][tokens].astype(jnp.float32) * e["s"]
+        return rows.astype(dtype)
+    return e[tokens].astype(dtype)
 
 
 def attention(
@@ -293,7 +440,7 @@ def forward(
     B, S = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S), (B, S))
-    h = params["embed"][tokens].astype(cfg.dtype)
+    h = _embed_lookup(params, tokens, cfg.dtype)
     cos, sin = rope_frequencies(cfg, positions)
     mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
 
@@ -346,7 +493,7 @@ def prefill(
     if true_length is None:
         true_length = jnp.asarray(S, jnp.int32)
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
-    h = params["embed"][tokens].astype(cfg.dtype)
+    h = _embed_lookup(params, tokens, cfg.dtype)
     cos, sin = rope_frequencies(cfg, positions)
     mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
 
@@ -382,7 +529,7 @@ def decode_step(
     per_row = pos.ndim == 1
     pos_vec = jnp.broadcast_to(pos, (B,))
     positions = pos_vec[:, None]
-    h = params["embed"][token[:, None]].astype(cfg.dtype)
+    h = _embed_lookup(params, token[:, None], cfg.dtype)
     cos, sin = rope_frequencies(cfg, positions)
     H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     # Causal visibility over the preallocated cache: positions <= pos.

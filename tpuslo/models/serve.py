@@ -25,8 +25,10 @@ from tpuslo.models.llama import (
     decode_chunk,
     init_kv_cache,
     init_params,
+    init_params_quantized,
     llama_tiny,
     prefill,
+    quantize_params,
 )
 
 BOS = 256
@@ -68,13 +70,22 @@ class ServeEngine:
         rng_seed: int = 0,
         prefill_buckets: tuple[int, ...] = (32, 64, 128, 256),
         decode_chunk_size: int = 64,
+        quantize: bool = False,
     ):
         self.cfg = cfg or llama_tiny(max_seq_len=512)
-        self.params = (
-            params
-            if params is not None
-            else init_params(jax.random.PRNGKey(rng_seed), self.cfg)
-        )
+        if params is None:
+            params = (
+                # Leaf-wise init+quantize: peak HBM = int8 tree + one
+                # bf16 leaf, which is what fits 8B-class weights on a
+                # single chip.
+                init_params_quantized(jax.random.PRNGKey(rng_seed), self.cfg)
+                if quantize
+                else init_params(jax.random.PRNGKey(rng_seed), self.cfg)
+            )
+        elif quantize and not isinstance(params.get("output"), dict):
+            params = quantize_params(params)
+        self.quantized = isinstance(params.get("output"), dict)
+        self.params = params
         self.prefill_buckets = tuple(
             b for b in prefill_buckets if b <= self.cfg.max_seq_len
         )
