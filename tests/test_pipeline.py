@@ -1,5 +1,7 @@
 """Pipeline parallelism: forward parity with the plain model, grads."""
 
+from dataclasses import replace
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -20,8 +22,7 @@ def _mesh(pp: int) -> Mesh:
 
 @pytest.mark.parametrize("pp,n_mb", [(2, 4), (4, 2), (8, 4)])
 def test_pipelined_forward_matches_plain(pp, n_mb):
-    cfg = llama_tiny(max_seq_len=32)  # n_layers=2 -> pad via pp<=... use 8 layers
-    cfg = type(cfg)(**{**cfg.__dict__, "n_layers": 8})
+    cfg = replace(llama_tiny(max_seq_len=32), n_layers=8)
     params = init_params(jax.random.PRNGKey(0), cfg)
     tokens = jax.random.randint(
         jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab_size
@@ -40,9 +41,7 @@ def test_pipelined_forward_matches_plain(pp, n_mb):
 
 
 def test_pipelined_loss_grad_flows():
-    cfg = type(llama_tiny(max_seq_len=32))(
-        **{**llama_tiny(max_seq_len=32).__dict__, "n_layers": 4}
-    )
+    cfg = replace(llama_tiny(max_seq_len=32), n_layers=4)
     mesh = _mesh(4)
     params = place_pipeline_params(
         init_params(jax.random.PRNGKey(0), cfg), mesh

@@ -1,21 +1,29 @@
-"""Demo model family: TPU-first JAX Llama (the observed workload)."""
+"""Demo model families: TPU-first JAX Llama + Mixtral (observed workloads)."""
 
+from tpuslo.models import mixtral
 from tpuslo.models.llama import (
     LlamaConfig,
     decode_step,
     forward,
     init_kv_cache,
     init_params,
+    init_params_quantized,
     llama3_8b,
     llama3_70b,
     llama_tiny,
     loss_fn,
     prefill,
+    quantize_params,
+    quantized_bytes,
 )
 from tpuslo.models.serve import ServeEngine, TokenEvent, decode_bytes, encode_bytes
 from tpuslo.models.train import build_sharded_train_step, make_optimizer, train_step
 
 __all__ = [
+    "mixtral",
+    "init_params_quantized",
+    "quantize_params",
+    "quantized_bytes",
     "LlamaConfig",
     "decode_step",
     "forward",

@@ -1,0 +1,299 @@
+"""Mixtral-class sparse-MoE transformer: the second demo model family.
+
+Same attention stack as :mod:`tpuslo.models.llama` (GQA + RoPE +
+RMSNorm, layer-stacked params, one ``lax.scan`` over layers) with the
+dense SwiGLU MLP swapped for a top-k mixture of experts
+(:mod:`tpuslo.ops.moe`).  Training shards experts over the ``ep`` mesh
+axis while the batch rides ``dp`` — the standard Mixtral-style layout —
+via :func:`build_moe_train_step`.
+
+The toolkit observes this workload for MoE-specific fault shapes:
+expert-imbalance shows up as HBM-pressure skew across hosts, and the
+all_to_all dispatch is ICI-sensitive (an ``ici_drop`` fault hits MoE
+models ~2x harder than dense ones — exactly the differential the
+attribution engine keys on).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpuslo.models.llama import (
+    LlamaConfig,
+    _dense_init,
+    _embed_lookup,
+    _matmul,
+    apply_rope,
+    attention,
+    rms_norm,
+    rope_frequencies,
+)
+from tpuslo.ops.moe import MoEConfig, _expert_ffn, _routing
+
+PyTree = Any
+
+
+@dataclass(frozen=True)
+class MixtralConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14336
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 2.0
+    max_seq_len: int = 8192
+    rope_theta: float = 1000000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def moe(self) -> MoEConfig:
+        return MoEConfig(
+            dim=self.dim,
+            ffn_dim=self.ffn_dim,
+            n_experts=self.n_experts,
+            top_k=self.top_k,
+            capacity_factor=self.capacity_factor,
+            dtype=self.dtype,
+        )
+
+    def attn_cfg(self) -> LlamaConfig:
+        """Attention-relevant view for the shared llama helpers."""
+        return LlamaConfig(
+            vocab_size=self.vocab_size,
+            dim=self.dim,
+            n_layers=self.n_layers,
+            n_heads=self.n_heads,
+            n_kv_heads=self.n_kv_heads,
+            ffn_dim=self.ffn_dim,
+            max_seq_len=self.max_seq_len,
+            rope_theta=self.rope_theta,
+            norm_eps=self.norm_eps,
+            dtype=self.dtype,
+        )
+
+
+def mixtral_8x7b() -> MixtralConfig:
+    return MixtralConfig()
+
+
+def mixtral_tiny(max_seq_len: int = 128) -> MixtralConfig:
+    return MixtralConfig(
+        vocab_size=512,
+        dim=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        ffn_dim=128,
+        n_experts=4,
+        top_k=2,
+        capacity_factor=2.0,
+        max_seq_len=max_seq_len,
+        rope_theta=10000.0,
+    )
+
+
+def param_count(cfg: MixtralConfig) -> int:
+    D, F, L, E = cfg.dim, cfg.ffn_dim, cfg.n_layers, cfg.n_experts
+    H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    per_layer = (
+        2 * D  # norms
+        + D * H * HD
+        + 2 * D * KV * HD
+        + H * HD * D
+        + D * E  # router
+        + E * 3 * D * F  # experts (w1, w3, w2)
+    )
+    return 2 * cfg.vocab_size * D + D + L * per_layer
+
+
+def init_params(rng: jax.Array, cfg: MixtralConfig) -> PyTree:
+    """Layer-stacked tree; expert weights carry (L, E, ...) leaves."""
+    k_embed, k_attn, k_moe, k_out = jax.random.split(rng, 4)
+    L, D, F, E = cfg.n_layers, cfg.dim, cfg.ffn_dim, cfg.n_experts
+    H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    ka = jax.random.split(k_attn, 4)
+    km = jax.random.split(k_moe, 4)
+    return {
+        "embed": _dense_init(k_embed, (cfg.vocab_size, D), D, cfg.dtype),
+        "layers": {
+            "attn_norm": jnp.ones((L, D), cfg.dtype),
+            "wq": _dense_init(ka[0], (L, D, H * HD), D, cfg.dtype),
+            "wk": _dense_init(ka[1], (L, D, KV * HD), D, cfg.dtype),
+            "wv": _dense_init(ka[2], (L, D, KV * HD), D, cfg.dtype),
+            "wo": _dense_init(ka[3], (L, H * HD, D), H * HD, cfg.dtype),
+            "mlp_norm": jnp.ones((L, D), cfg.dtype),
+            "router": (
+                jax.random.normal(km[0], (L, D, E), jnp.float32) * D**-0.5
+            ),
+            "w1": _dense_init(km[1], (L, E, D, F), D, cfg.dtype),
+            "w3": _dense_init(km[2], (L, E, D, F), D, cfg.dtype),
+            "w2": _dense_init(km[3], (L, E, F, D), F, cfg.dtype),
+        },
+        "final_norm": jnp.ones((D,), cfg.dtype),
+        "output": _dense_init(k_out, (D, cfg.vocab_size), D, cfg.dtype),
+    }
+
+
+def _moe_block(layer: PyTree, x: jax.Array, cfg: MixtralConfig) -> jax.Array:
+    """Dense (single-device) MoE block over (B, S, D) hidden states."""
+    B, S, D = x.shape
+    flat = x.reshape(B * S, D)
+    moe_cfg = cfg.moe()
+    moe_params = {
+        "router": layer["router"],
+        "w1": layer["w1"],
+        "w3": layer["w3"],
+        "w2": layer["w2"],
+    }
+    capacity = moe_cfg.capacity(flat.shape[0])
+    dispatch, combine = _routing(moe_params, flat, moe_cfg, capacity)
+    xe = jnp.einsum("tec,td->ecd", dispatch, flat.astype(jnp.float32))
+    out = _expert_ffn(moe_params, xe, moe_cfg)
+    y = jnp.einsum("tec,ecd->td", combine, out)
+    return y.astype(x.dtype).reshape(B, S, D)
+
+
+def _layer_body(cfg: MixtralConfig, h, layer, cos, sin, mask):
+    B, S, D = h.shape
+    H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    x = rms_norm(h, layer["attn_norm"], cfg.norm_eps)
+    q = _matmul(x, layer["wq"]).reshape(B, S, H, HD)
+    k = _matmul(x, layer["wk"]).reshape(B, S, KV, HD)
+    v = _matmul(x, layer["wv"]).reshape(B, S, KV, HD)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    attn = attention(q, k, v, mask, H // KV)
+    h = h + _matmul(attn.reshape(B, S, H * HD), layer["wo"])
+
+    x = rms_norm(h, layer["mlp_norm"], cfg.norm_eps)
+    h = h + _moe_block(layer, x, cfg)
+    return h
+
+
+def forward(
+    params: PyTree,
+    tokens: jax.Array,
+    cfg: MixtralConfig,
+    remat: bool = True,
+) -> jax.Array:
+    """Full-sequence forward → logits (B, S, vocab)."""
+    B, S = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    h = _embed_lookup(params, tokens, cfg.dtype)
+    cos, sin = rope_frequencies(cfg.attn_cfg(), positions)
+    mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+
+    body = partial(_layer_body, cfg)
+    if remat:
+        body = jax.checkpoint(body, static_argnums=())
+
+    def scan_step(carry, layer):
+        return body(carry, layer, cos, sin, mask), None
+
+    h, _ = lax.scan(scan_step, h, params["layers"])
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps)
+    return _matmul(h, params["output"]).astype(jnp.float32)
+
+
+def loss_fn(params, tokens, targets, cfg: MixtralConfig) -> jax.Array:
+    logits = forward(params, tokens, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def param_shardings(mesh: Mesh) -> PyTree:
+    """dp x ep layout: expert leaves shard their expert axis over ep;
+    attention weights replicate (tiny next to experts at 8x sparsity)."""
+    ns = lambda spec: NamedSharding(mesh, spec)  # noqa: E731
+    rep2, rep3 = ns(P(None, None)), ns(P(None, None, None))
+    return {
+        "embed": rep2,
+        "layers": {
+            "attn_norm": rep2,
+            "wq": rep3,
+            "wk": rep3,
+            "wv": rep3,
+            "wo": rep3,
+            "mlp_norm": rep2,
+            "router": rep3,
+            "w1": ns(P(None, "ep", None, None)),
+            "w3": ns(P(None, "ep", None, None)),
+            "w2": ns(P(None, "ep", None, None)),
+        },
+        "final_norm": ns(P(None)),
+        "output": rep2,
+    }
+
+
+def build_moe_train_step(mesh: Mesh, cfg: MixtralConfig, optimizer=None):
+    """AdamW step jitted over a (dp, ep) mesh.
+
+    GSPMD keeps expert weights resident on their ep shard and inserts
+    the token exchanges; gradients psum over dp.  Returns
+    ``(step_fn, init_fn)`` like the llama builder.
+    """
+    import optax
+
+    optimizer = optimizer or optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
+    p_shard = param_shardings(mesh)
+    b_shard = NamedSharding(mesh, P("dp", None))
+
+    params_abstract = jax.eval_shape(partial(init_params, cfg=cfg),
+                                     jax.random.PRNGKey(0))
+    by_shape: dict[tuple, NamedSharding] = {}
+    jax.tree.map(
+        lambda shard, leaf: by_shape.setdefault(leaf.shape, shard),
+        p_shard, params_abstract,
+    )
+    opt_abstract = jax.eval_shape(optimizer.init, params_abstract)
+    replicated = NamedSharding(mesh, P())
+    opt_shard = jax.tree.map(
+        lambda leaf: by_shape.get(leaf.shape, replicated), opt_abstract
+    )
+
+    def train_step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets, cfg)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    def init(rng):
+        params = init_params(rng, cfg)
+        return params, optimizer.init(params)
+
+    init_sharded = jax.jit(init, out_shardings=(p_shard, opt_shard))
+    step = jax.jit(
+        train_step,
+        in_shardings=(p_shard, opt_shard, b_shard, b_shard),
+        out_shardings=(p_shard, opt_shard, None),
+        donate_argnums=(0, 1),
+    )
+    return step, init_sharded
+
+
+__all__ = [
+    "MixtralConfig",
+    "mixtral_8x7b",
+    "mixtral_tiny",
+    "param_count",
+    "init_params",
+    "forward",
+    "loss_fn",
+    "param_shardings",
+    "build_moe_train_step",
+]

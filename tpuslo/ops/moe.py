@@ -161,7 +161,11 @@ def _moe_shard_body(
     # buffer shapes stay static.
     capacity = cfg.capacity(T)
     dispatch, combine = _routing(params, x, cfg, capacity)
-    xe = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    # Exchange in the model dtype: bf16 tokens over ICI, not fp32
+    # (the expert FFN casts to cfg.dtype on entry anyway).
+    xe = jnp.einsum(
+        "tec,td->ecd", dispatch, x.astype(jnp.float32)
+    ).astype(cfg.dtype)
     # (E, C, D) -> (ep, E_local, C, D): group by owning shard.
     xe = xe.reshape(ep, E_local, capacity, -1)
 
@@ -174,8 +178,12 @@ def _moe_shard_body(
     # the transpose keeps slots grouped by source so the return trip
     # can route them back.
     xe = xe.transpose(1, 0, 2, 3).reshape(E_local, ep * capacity, -1)
-    out = _expert_ffn(params, xe, cfg)  # (E_local, ep*C, D)
-    out = out.reshape(E_local, ep, capacity, -1).transpose(1, 0, 2, 3)
+    out = _expert_ffn(params, xe, cfg)  # (E_local, ep*C, D) fp32
+    out = (
+        out.astype(cfg.dtype)  # bf16 for the return hop too
+        .reshape(E_local, ep, capacity, -1)
+        .transpose(1, 0, 2, 3)
+    )
     # Return exchange: send each source shard its tokens back.
     out = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0, tiled=False)
     out = out.reshape(cfg.n_experts, capacity, -1)  # (E, C, D) local view
