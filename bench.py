@@ -19,20 +19,32 @@ import time
 from datetime import datetime, timezone
 
 
-def bench_attribution() -> dict:
-    from tpuslo import attribution
+TPU_FAULT_SCENARIOS = (
+    "ici_drop",
+    "hbm_pressure",
+    "xla_recompile_storm",
+    "host_offload_stall",
+)
+
+
+def _fault_samples(count_per_scenario: int = 25, multi: int = 0) -> list:
+    """Deterministic TPU-fault sample set shared by the attribution
+    benchmarks (headline + robustness sweep)."""
     from tpuslo.faultreplay import generate_fault_samples
 
     start = datetime(2026, 1, 1, tzinfo=timezone.utc)
     samples = []
-    for scenario in (
-        "ici_drop",
-        "hbm_pressure",
-        "xla_recompile_storm",
-        "host_offload_stall",
-    ):
-        samples.extend(generate_fault_samples(scenario, 25, start))
-    samples.extend(generate_fault_samples("tpu_mixed_multi", 20, start))
+    for scenario in TPU_FAULT_SCENARIOS:
+        samples.extend(generate_fault_samples(scenario, count_per_scenario, start))
+    if multi:
+        samples.extend(generate_fault_samples("tpu_mixed_multi", multi, start))
+    return samples
+
+
+def bench_attribution() -> dict:
+    from tpuslo import attribution
+
+    samples = _fault_samples(25, multi=20)
 
     t0 = time.perf_counter()
     predictions = attribution.build_attributions(samples, mode="bayes")
@@ -46,6 +58,76 @@ def bench_attribution() -> dict:
         "coverage_accuracy": attribution.coverage_accuracy(samples, predictions),
         "samples": len(samples),
         "attributions_per_sec": len(samples) / elapsed if elapsed > 0 else 0.0,
+    }
+
+
+def bench_attribution_robustness() -> dict:
+    """Macro-F1 under signal corruption — the non-saturated counterpart
+    to the headline metric.
+
+    The clean-generator headline sits at 1.0 because the synthetic
+    profiles and the Bayes table are co-designed; this sweep multiplies
+    every signal by lognormal noise and drops signals entirely with
+    probability growing with sigma, so the curve shows where attribution
+    actually degrades (and guards against regressions hiding under a
+    saturated clean score).
+    """
+    import copy
+
+    import numpy as np
+
+    from tpuslo import attribution
+
+    samples = _fault_samples(25)
+    sweep = {}
+    for sigma in (0.1, 0.25, 0.5, 1.0):
+        rs = np.random.RandomState(42)
+        noisy = []
+        for sample in samples:
+            s = copy.deepcopy(sample)
+            sig = s.signals
+            for key, value in list(sig.items()):
+                if rs.rand() < 0.15 * sigma:
+                    sig[key] = 0.0  # dropped probe (shedding / ring loss)
+                else:
+                    sig[key] = float(value) * float(
+                        np.exp(rs.normal(0.0, sigma))
+                    )
+            noisy.append(s)
+        predictions = attribution.build_attributions(noisy, mode="bayes")
+        report = attribution.macro_f1(noisy, predictions)
+        sweep[str(sigma)] = round(report.macro_f1, 4)
+    return {"noise_macro_f1": sweep}
+
+
+def bench_agent_overhead() -> dict:
+    """Measured CPU cost of one agent emit cycle, as pct of a 1 Hz
+    cadence — the honest analog of the reference's hardcoded 2.2%
+    overhead row (BASELINE gate: <=3% host CPU)."""
+    from tpuslo import collector, signals
+    from tpuslo.cli.common import validate_probe
+
+    meta = signals.Metadata(
+        node="bench", namespace="llm", pod="bench", container="bench",
+        pid=1, tid=1, tpu_chip="accel0",
+    )
+    gen = signals.Generator(signals.CAPABILITY_TPU_FULL)
+    start = datetime(2026, 1, 1, tzinfo=timezone.utc)
+    samples = collector.generate_synthetic_samples(
+        "tpu_mixed", 100, start, collector.SampleMeta()
+    )
+    # Warm caches (schema compilation etc.) before measuring.
+    for event in gen.generate(samples[0], meta):
+        validate_probe(event)
+    cpu0 = time.process_time()
+    for sample in samples:
+        for event in gen.generate(sample, meta):
+            validate_probe(event)
+    cpu_per_cycle = (time.process_time() - cpu0) / len(samples)
+    pct = cpu_per_cycle * 100.0  # of a 1-second DaemonSet tick
+    return {
+        "agent_cpu_pct_at_1hz": round(pct, 3),
+        "meets_3pct_gate": pct <= 3.0,
     }
 
 
@@ -248,6 +330,8 @@ def bench_serving() -> dict:
 
 def main() -> int:
     attribution_result = bench_attribution()
+    robustness_result = bench_attribution_robustness()
+    overhead_result = bench_agent_overhead()
     pipeline_result = bench_pipeline()
     serving_result = bench_serving()
 
@@ -264,6 +348,8 @@ def main() -> int:
                     k: round(v, 4) if isinstance(v, float) else v
                     for k, v in attribution_result.items()
                 },
+                "robustness": robustness_result,
+                "overhead": overhead_result,
                 "pipeline": {
                     k: round(v, 2) if isinstance(v, float) else v
                     for k, v in pipeline_result.items()

@@ -1,0 +1,82 @@
+"""Checkpoint/resume: roundtrips (dense, quantized, sharded), rotation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpuslo.models.checkpoint import (
+    TrainCheckpointer,
+    abstract_like,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from tpuslo.models.llama import init_params, llama_tiny, quantize_params
+
+
+def _trees_equal(a, b):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+        assert la.dtype == lb.dtype
+
+
+def test_save_restore_roundtrip(tmp_path):
+    cfg = llama_tiny(max_seq_len=32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, params)
+    restored = restore_checkpoint(path)
+    _trees_equal(params, restored)
+
+
+def test_overwrite_guard(tmp_path):
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, {"x": jnp.arange(4)})
+    with pytest.raises(FileExistsError):
+        save_checkpoint(path, {"x": jnp.arange(4)})
+    save_checkpoint(path, {"x": jnp.arange(8)}, overwrite=True)
+    assert restore_checkpoint(path)["x"].shape == (8,)
+
+
+def test_quantized_tree_roundtrip(tmp_path):
+    cfg = llama_tiny(max_seq_len=32)
+    qparams = quantize_params(init_params(jax.random.PRNGKey(0), cfg))
+    path = str(tmp_path / "q")
+    save_checkpoint(path, qparams)
+    restored = restore_checkpoint(path)
+    assert restored["layers"]["w1"]["q"].dtype == jnp.int8
+    _trees_equal(qparams, restored)
+
+
+def test_sharded_restore(tmp_path):
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("tp",))
+    sharding = NamedSharding(mesh, P(None, "tp"))
+    x = jax.device_put(
+        jnp.arange(64, dtype=jnp.float32).reshape(4, 16), sharding
+    )
+    tree = {"w": x}
+    path = str(tmp_path / "sharded")
+    save_checkpoint(path, tree)
+
+    abstract = abstract_like(tree, {"w": sharding})
+    restored = restore_checkpoint(path, abstract)
+    assert restored["w"].sharding == sharding
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.asarray(x))
+
+
+def test_train_checkpointer_rotation_and_resume(tmp_path):
+    cfg = llama_tiny(max_seq_len=32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    with TrainCheckpointer(str(tmp_path / "mgr"), max_to_keep=2) as ckpt:
+        for step in (1, 2, 3):
+            scaled = jax.tree.map(lambda w: w * step, params)
+            ckpt.save(step, scaled, opt_state={"count": jnp.asarray(step)})
+        ckpt._mgr.wait_until_finished()
+        assert ckpt.latest_step() == 3
+        restored = ckpt.restore()
+        assert int(restored["opt_state"]["count"]) == 3
+        _trees_equal(restored["params"], jax.tree.map(lambda w: w * 3, params))
+        # keep-N rotation: step 1 evicted
+        steps = sorted(ckpt._mgr.all_steps())
+        assert steps == [2, 3]

@@ -361,3 +361,24 @@ class TestServeEngine:
         assert all(0 <= e.token_id < engine.cfg.vocab_size for e in events)
         if any(e.token_id == EOS for e in events):
             assert events[-1].token_id == EOS
+
+
+def test_optimizer_state_shardings_path_matching():
+    """Same-shaped params with different shardings resolve by path."""
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from tpuslo.parallel.mesh import optimizer_state_shardings
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "tp"))
+    shard_a = NamedSharding(mesh, P("tp", None))
+    shard_b = NamedSharding(mesh, P(None, "tp"))
+    p_shard = {"wa": shard_a, "wb": shard_b}  # identical shapes
+    leaf = jax.ShapeDtypeStruct((8, 8), jnp.float32)
+    opt_abstract = (
+        {"mu": {"wa": leaf, "wb": leaf}, "count": jax.ShapeDtypeStruct((), jnp.int32)},
+    )
+    opt_shard = optimizer_state_shardings(opt_abstract, p_shard, mesh)
+    assert opt_shard[0]["mu"]["wa"] == shard_a
+    assert opt_shard[0]["mu"]["wb"] == shard_b
+    assert opt_shard[0]["count"] == NamedSharding(mesh, P())

@@ -1,6 +1,6 @@
 """Demo model families: TPU-first JAX Llama + Mixtral (observed workloads)."""
 
-from tpuslo.models import mixtral
+from tpuslo.models import checkpoint, mixtral
 from tpuslo.models.llama import (
     LlamaConfig,
     decode_step,
@@ -20,6 +20,7 @@ from tpuslo.models.serve import ServeEngine, TokenEvent, decode_bytes, encode_by
 from tpuslo.models.train import build_sharded_train_step, make_optimizer, train_step
 
 __all__ = [
+    "checkpoint",
     "mixtral",
     "init_params_quantized",
     "quantize_params",

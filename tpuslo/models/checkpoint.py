@@ -1,0 +1,126 @@
+"""Checkpoint/resume for the demo model stack (orbax-backed).
+
+The reference has no checkpoint mechanism at all (SURVEY.md §5
+"checkpoint/resume: none") — its only resume-like artifact is the
+benchmark baseline manifest.  The TPU rebuild's model stack is a real
+training/serving workload, so it gets a real one:
+
+* sharding-aware: restore takes an abstract target tree (shapes +
+  ``NamedSharding``), so on a multi-host mesh each process reads only
+  its own shards — no host ever materialises the full tree;
+* quantization-aware: int8 ``{"q", "s"}`` leaves round-trip unchanged;
+* rotating retention via ``ocp.CheckpointManager`` (keep-N), async save
+  so the train loop overlaps the next step with the write.
+
+The *toolkit* observes checkpoint activity rather than performing it:
+host-offload stalls during checkpoint writes are exactly the
+``host_offload_stall`` fault domain in the attribution table.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Any
+
+import jax
+
+PyTree = Any
+
+
+def _ocp():
+    """Lazy orbax import: checkpointing is optional and the package
+    import must not fail where orbax isn't installed."""
+    import orbax.checkpoint as ocp
+
+    return ocp
+
+
+def save_checkpoint(path: str, tree: PyTree, overwrite: bool = False) -> None:
+    """Blocking single-tree save (params or (params, opt_state, ...))."""
+    path = os.path.abspath(path)
+    if os.path.exists(path):
+        if not overwrite:
+            raise FileExistsError(path)
+        shutil.rmtree(path)
+    ckptr = _ocp().StandardCheckpointer()
+    ckptr.save(path, tree)
+    ckptr.wait_until_finished()
+
+
+def restore_checkpoint(path: str, abstract_tree: PyTree | None = None) -> PyTree:
+    """Restore a tree saved by :func:`save_checkpoint`.
+
+    ``abstract_tree`` (e.g. from :func:`abstract_like` with shardings
+    attached) makes the restore sharding-aware; without it leaves come
+    back host-local fully replicated.
+    """
+    path = os.path.abspath(path)
+    ckptr = _ocp().StandardCheckpointer()
+    if abstract_tree is None:
+        return ckptr.restore(path)
+    return ckptr.restore(path, abstract_tree)
+
+
+class TrainCheckpointer:
+    """Rotating keep-N checkpoint manager for a training loop.
+
+    ``save(step, params, opt_state)`` is async — the device can run the
+    next step while the previous state streams to disk; call ``close()``
+    (or use as a context manager) to drain pending writes.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        ocp = _ocp()
+        self._mgr = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, enable_async_checkpointing=True
+            ),
+        )
+
+    def save(self, step: int, params: PyTree, opt_state: PyTree | None = None):
+        tree = {"params": params}
+        if opt_state is not None:
+            tree["opt_state"] = opt_state
+        self._mgr.save(step, args=_ocp().args.StandardSave(tree))
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(
+        self, step: int | None = None, abstract: PyTree | None = None
+    ) -> dict:
+        step = step if step is not None else self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError("no checkpoint steps in manager directory")
+        if abstract is None:
+            return self._mgr.restore(step)
+        return self._mgr.restore(
+            step, args=_ocp().args.StandardRestore(abstract)
+        )
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def abstract_like(tree: PyTree, shardings: PyTree | None = None) -> PyTree:
+    """Abstract (shape/dtype[/sharding]) view of a concrete tree, for
+    sharding-aware restore on a fresh process."""
+    abstract = jax.tree.map(
+        lambda leaf: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype), tree
+    )
+    if shardings is None:
+        return abstract
+    return jax.tree.map(
+        lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+        abstract,
+        shardings,
+    )

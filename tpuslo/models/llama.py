@@ -380,8 +380,8 @@ def attention(
     return out.astype(q.dtype)
 
 
-def _layer_body(
-    cfg: LlamaConfig,
+def attention_block(
+    cfg,
     h: jax.Array,
     layer: PyTree,
     cos: jax.Array,
@@ -389,14 +389,14 @@ def _layer_body(
     mask: jax.Array,
     causal: bool = False,
 ) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
-    """One transformer layer; returns (hidden, (rotated_k, v)).
+    """Pre-norm GQA attention + residual; returns (hidden, (k, v)).
 
-    Shared by full forward and prefill so the layer math exists once;
-    forward discards the KV output (XLA dead-code-eliminates it).
-    ``causal=True`` asserts that ``mask`` is the full causal tril —
-    callers own that invariant — and unlocks the fused flash-attention
-    path (inferring it from mask rank would silently mis-route any
-    future 2-D non-tril mask).
+    Shared by the Llama layer, prefill, and the Mixtral family (``cfg``
+    is duck-typed: any config with n_heads/n_kv_heads/head_dim/norm_eps
+    works).  ``causal=True`` asserts that ``mask`` is the full causal
+    tril — callers own that invariant — and unlocks the fused
+    flash-attention path (inferring it from mask rank would silently
+    mis-route any future 2-D non-tril mask).
     """
     B, S, D = h.shape
     H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -416,13 +416,29 @@ def _layer_body(
         )
     else:
         attn = attention(q, k, v, mask, H // KV)
-    h = h + _matmul(attn.reshape(B, S, H * HD), layer["wo"])
+    return h + _matmul(attn.reshape(B, S, H * HD), layer["wo"]), (k, v)
 
+
+def _layer_body(
+    cfg: LlamaConfig,
+    h: jax.Array,
+    layer: PyTree,
+    cos: jax.Array,
+    sin: jax.Array,
+    mask: jax.Array,
+    causal: bool = False,
+) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
+    """One transformer layer; returns (hidden, (rotated_k, v)).
+
+    Shared by full forward and prefill so the layer math exists once;
+    forward discards the KV output (XLA dead-code-eliminates it).
+    """
+    h, kv = attention_block(cfg, h, layer, cos, sin, mask, causal=causal)
     x = rms_norm(h, layer["mlp_norm"], cfg.norm_eps)
     gate = jax.nn.silu(_matmul(x, layer["w1"]).astype(jnp.float32))
     up = _matmul(x, layer["w3"]).astype(jnp.float32)
     h = h + _matmul((gate * up).astype(cfg.dtype), layer["w2"])
-    return h, (k, v)
+    return h, kv
 
 
 def forward(

@@ -30,12 +30,12 @@ from tpuslo.models.llama import (
     _dense_init,
     _embed_lookup,
     _matmul,
-    apply_rope,
-    attention,
+    attention_block,
     rms_norm,
     rope_frequencies,
 )
-from tpuslo.ops.moe import MoEConfig, _expert_ffn, _routing
+from tpuslo.ops.moe import MoEConfig, moe_mlp
+from tpuslo.parallel.mesh import optimizer_state_shardings
 
 PyTree = Any
 
@@ -51,6 +51,7 @@ class MixtralConfig:
     n_experts: int = 8
     top_k: int = 2
     capacity_factor: float = 2.0
+    router_aux_coef: float = 0.01
     max_seq_len: int = 8192
     rope_theta: float = 1000000.0
     norm_eps: float = 1e-5
@@ -148,40 +149,35 @@ def init_params(rng: jax.Array, cfg: MixtralConfig) -> PyTree:
     }
 
 
-def _moe_block(layer: PyTree, x: jax.Array, cfg: MixtralConfig) -> jax.Array:
-    """Dense (single-device) MoE block over (B, S, D) hidden states."""
+def _moe_block(
+    layer: PyTree, x: jax.Array, cfg: MixtralConfig
+) -> tuple[jax.Array, jax.Array]:
+    """MoE block over (B, S, D) hidden states → (output, aux_loss).
+
+    Delegates to :func:`tpuslo.ops.moe.moe_mlp` so dispatch/drop
+    semantics have one source of truth.
+    """
     B, S, D = x.shape
-    flat = x.reshape(B * S, D)
-    moe_cfg = cfg.moe()
     moe_params = {
         "router": layer["router"],
         "w1": layer["w1"],
         "w3": layer["w3"],
         "w2": layer["w2"],
     }
-    capacity = moe_cfg.capacity(flat.shape[0])
-    dispatch, combine = _routing(moe_params, flat, moe_cfg, capacity)
-    xe = jnp.einsum("tec,td->ecd", dispatch, flat.astype(jnp.float32))
-    out = _expert_ffn(moe_params, xe, moe_cfg)
-    y = jnp.einsum("tec,ecd->td", combine, out)
-    return y.astype(x.dtype).reshape(B, S, D)
+    y, aux = moe_mlp(moe_params, x.reshape(B * S, D), cfg.moe(), return_aux=True)
+    return y.reshape(B, S, D), aux
 
 
 def _layer_body(cfg: MixtralConfig, h, layer, cos, sin, mask):
-    B, S, D = h.shape
-    H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    x = rms_norm(h, layer["attn_norm"], cfg.norm_eps)
-    q = _matmul(x, layer["wq"]).reshape(B, S, H, HD)
-    k = _matmul(x, layer["wk"]).reshape(B, S, KV, HD)
-    v = _matmul(x, layer["wv"]).reshape(B, S, KV, HD)
-    q = apply_rope(q, cos, sin)
-    k = apply_rope(k, cos, sin)
-    attn = attention(q, k, v, mask, H // KV)
-    h = h + _matmul(attn.reshape(B, S, H * HD), layer["wo"])
+    """One Mixtral layer → (hidden, router aux loss).
 
+    Attention (incl. the flash-attention routing) is shared with the
+    Llama family via :func:`tpuslo.models.llama.attention_block`.
+    """
+    h, _kv = attention_block(cfg, h, layer, cos, sin, mask, causal=True)
     x = rms_norm(h, layer["mlp_norm"], cfg.norm_eps)
-    h = h + _moe_block(layer, x, cfg)
-    return h
+    y, aux = _moe_block(layer, x, cfg)
+    return h + y, aux
 
 
 def forward(
@@ -189,8 +185,13 @@ def forward(
     tokens: jax.Array,
     cfg: MixtralConfig,
     remat: bool = True,
-) -> jax.Array:
-    """Full-sequence forward → logits (B, S, vocab)."""
+    return_aux: bool = False,
+):
+    """Full-sequence forward → logits (B, S, vocab).
+
+    ``return_aux=True`` also returns the mean router load-balancing
+    loss across layers (train loops must add it to the objective).
+    """
     B, S = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
     h = _embed_lookup(params, tokens, cfg.dtype)
@@ -202,18 +203,28 @@ def forward(
         body = jax.checkpoint(body, static_argnums=())
 
     def scan_step(carry, layer):
-        return body(carry, layer, cos, sin, mask), None
+        carry, aux = body(carry, layer, cos, sin, mask)
+        return carry, aux
 
-    h, _ = lax.scan(scan_step, h, params["layers"])
+    h, aux_per_layer = lax.scan(scan_step, h, params["layers"])
     h = rms_norm(h, params["final_norm"], cfg.norm_eps)
-    return _matmul(h, params["output"]).astype(jnp.float32)
+    logits = _matmul(h, params["output"]).astype(jnp.float32)
+    if return_aux:
+        return logits, jnp.mean(aux_per_layer)
+    return logits
 
 
 def loss_fn(params, tokens, targets, cfg: MixtralConfig) -> jax.Array:
-    logits = forward(params, tokens, cfg)
+    """Cross-entropy + router load-balancing auxiliary loss.
+
+    Without the aux term top-k routing collapses onto the early-winning
+    experts and the rest stop receiving gradient (Switch Transformer
+    §2.2 — standard coefficient 1e-2).
+    """
+    logits, aux = forward(params, tokens, cfg, return_aux=True)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    return jnp.mean(nll) + cfg.router_aux_coef * aux
 
 
 def param_shardings(mesh: Mesh) -> PyTree:
@@ -255,16 +266,8 @@ def build_moe_train_step(mesh: Mesh, cfg: MixtralConfig, optimizer=None):
 
     params_abstract = jax.eval_shape(partial(init_params, cfg=cfg),
                                      jax.random.PRNGKey(0))
-    by_shape: dict[tuple, NamedSharding] = {}
-    jax.tree.map(
-        lambda shard, leaf: by_shape.setdefault(leaf.shape, shard),
-        p_shard, params_abstract,
-    )
     opt_abstract = jax.eval_shape(optimizer.init, params_abstract)
-    replicated = NamedSharding(mesh, P())
-    opt_shard = jax.tree.map(
-        lambda leaf: by_shape.get(leaf.shape, replicated), opt_abstract
-    )
+    opt_shard = optimizer_state_shardings(opt_abstract, p_shard, mesh)
 
     def train_step(params, opt_state, tokens, targets):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets, cfg)

@@ -15,10 +15,13 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tpuslo.models.llama import LlamaConfig, forward, init_params
-from tpuslo.parallel.mesh import batch_sharding, param_shardings
+from tpuslo.parallel.mesh import (
+    batch_sharding,
+    optimizer_state_shardings,
+    param_shardings,
+)
 
 PyTree = Any
 
@@ -42,26 +45,12 @@ def train_step(params, opt_state, tokens, targets, cfg: LlamaConfig, optimizer):
 
 
 def _optimizer_state_shardings(mesh, cfg: LlamaConfig, optimizer, p_shard):
-    """Sharding tree for the optimizer state.
-
-    AdamW's mu/nu mirror the parameter tree leaf-for-leaf (same shapes),
-    so each state leaf inherits the sharding of the same-shaped param;
-    scalars (step counts) are replicated.  Shape collisions are safe
-    here because same-shaped params share a sharding rule by design.
-    """
+    """AdamW mu/nu mirror the param tree; match shardings by tree-path
+    suffix (collision-proof — see mesh.optimizer_state_shardings)."""
     params_abstract = jax.eval_shape(partial(init_params, cfg=cfg),
                                      jax.random.PRNGKey(0))
-    by_shape: dict[tuple, NamedSharding] = {}
-    jax.tree.map(
-        lambda shard, leaf: by_shape.setdefault(leaf.shape, shard),
-        p_shard,
-        params_abstract,
-    )
     opt_abstract = jax.eval_shape(optimizer.init, params_abstract)
-    replicated = NamedSharding(mesh, P())
-    return jax.tree.map(
-        lambda leaf: by_shape.get(leaf.shape, replicated), opt_abstract
-    )
+    return optimizer_state_shardings(opt_abstract, p_shard, mesh)
 
 
 def build_sharded_train_step(mesh, cfg: LlamaConfig, optimizer=None):

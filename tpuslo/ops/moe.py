@@ -78,20 +78,39 @@ def init_moe_params(rng: jax.Array, cfg: MoEConfig) -> PyTree:
     }
 
 
+def router_logits(params: PyTree, x: jax.Array) -> jax.Array:
+    """fp32 router logits (T, E) for tokens x: (T, D)."""
+    return x.astype(jnp.float32) @ params["router"]
+
+
+def load_balancing_loss(logits: jax.Array, n_experts: int) -> jax.Array:
+    """Switch-Transformer auxiliary loss: ``E * sum_e f_e * P_e``.
+
+    ``f_e`` is the fraction of tokens whose top-1 choice is expert e,
+    ``P_e`` the mean router probability of e.  Minimised (=1.0) at
+    uniform routing; without it top-k training collapses onto one or
+    two experts and the rest stop receiving gradient.
+    """
+    probs = jax.nn.softmax(logits, axis=-1)
+    top1 = jnp.argmax(logits, axis=-1)
+    f = jnp.mean(jax.nn.one_hot(top1, n_experts, dtype=jnp.float32), axis=0)
+    p = jnp.mean(probs, axis=0)
+    return n_experts * jnp.sum(f * p)
+
+
 def _routing(
-    params: PyTree, x: jax.Array, cfg: MoEConfig, capacity: int
+    logits: jax.Array, cfg: MoEConfig, capacity: int
 ) -> tuple[jax.Array, jax.Array]:
-    """Dispatch/combine tensors for local tokens x: (T, D).
+    """Dispatch/combine tensors from router logits (T, E).
 
     Returns ``dispatch`` (T, E, C) bool and ``combine`` (T, E, C) fp32.
     Position-in-expert is assigned greedily by (k, token) priority: all
     first choices ahead of all second choices, tokens in order — the
     GShard tie-break, deterministic under jit.
     """
-    T = x.shape[0]
+    T = logits.shape[0]
     E, K = cfg.n_experts, cfg.top_k
 
-    logits = x.astype(jnp.float32) @ params["router"]  # (T, E)
     gate_vals, expert_idx = lax.top_k(logits, K)  # (T, K)
     gates = jax.nn.softmax(gate_vals, axis=-1)  # renormalised over top-k
 
@@ -130,18 +149,25 @@ def _expert_ffn(params: PyTree, x: jax.Array, cfg: MoEConfig) -> jax.Array:
     return mm((gate * up).astype(cfg.dtype), params["w2"]).astype(jnp.float32)
 
 
-def moe_mlp(params: PyTree, x: jax.Array, cfg: MoEConfig) -> jax.Array:
+def moe_mlp(
+    params: PyTree, x: jax.Array, cfg: MoEConfig, return_aux: bool = False
+):
     """Single-device MoE MLP.  x: (T, D) → (T, D).
 
     The dense reference for the sharded path (same dispatch semantics,
-    including capacity drops).
+    including capacity drops).  ``return_aux=True`` additionally returns
+    the :func:`load_balancing_loss` for this block — training loops must
+    add it (scaled) to the objective or routing collapses.
     """
     capacity = cfg.capacity(x.shape[0])
-    dispatch, combine = _routing(params, x, cfg, capacity)
+    logits = router_logits(params, x)
+    dispatch, combine = _routing(logits, cfg, capacity)
     xe = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
     out = _expert_ffn(params, xe, cfg)  # (E, C, D)
-    y = jnp.einsum("tec,ecd->td", combine, out)
-    return y.astype(x.dtype)
+    y = jnp.einsum("tec,ecd->td", combine, out).astype(x.dtype)
+    if return_aux:
+        return y, load_balancing_loss(logits, cfg.n_experts)
+    return y
 
 
 def _moe_shard_body(
@@ -160,7 +186,7 @@ def _moe_shard_body(
     # full expert table.  Capacity is per-expert *per source shard* so
     # buffer shapes stay static.
     capacity = cfg.capacity(T)
-    dispatch, combine = _routing(params, x, cfg, capacity)
+    dispatch, combine = _routing(router_logits(params, x), cfg, capacity)
     # Exchange in the model dtype: bf16 tokens over ICI, not fp32
     # (the expert FFN casts to cfg.dtype on entry anyway).
     xe = jnp.einsum(

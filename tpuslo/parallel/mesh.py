@@ -100,3 +100,37 @@ def param_shardings(mesh: Mesh) -> dict:
 def batch_sharding(mesh: Mesh, seq_axis: str | None = None) -> NamedSharding:
     """Tokens/targets: batch over (dp, fsdp); optionally sequence over sp."""
     return NamedSharding(mesh, P(("dp", "fsdp"), seq_axis))
+
+
+def optimizer_state_shardings(opt_abstract, p_shard, mesh: Mesh):
+    """Sharding tree for an optax state mirroring a sharded param tree.
+
+    Optimizer moments (mu/nu) replicate the param tree structurally, so
+    each state leaf whose tree-path *suffix* matches a param path gets
+    that param's sharding (e.g. ``(0, 'mu', 'layers', 'w1')`` matches
+    param path ``('layers', 'w1')``); scalars and other state leaves
+    replicate.  Path-based matching is collision-proof where
+    shape-keyed lookup is not: two same-shaped params with different
+    shardings resolve by name, not by first-registered shape.
+    """
+    from jax.tree_util import keystr, tree_flatten_with_path, tree_map_with_path
+
+    def norm(path):
+        return tuple(keystr((k,)) for k in path)
+
+    by_path = {
+        norm(path): shard
+        for path, shard in tree_flatten_with_path(
+            p_shard, is_leaf=lambda v: isinstance(v, NamedSharding)
+        )[0]
+    }
+    replicated = NamedSharding(mesh, P())
+
+    def lookup(path, _leaf):
+        keys = norm(path)
+        for i in range(len(keys)):
+            if keys[i:] in by_path:
+                return by_path[keys[i:]]
+        return replicated
+
+    return tree_map_with_path(lookup, opt_abstract)
