@@ -116,9 +116,36 @@ class JaxBackend:
 
     def __init__(self, engine=None):
         if engine is None:
+            import os
+
             from tpuslo.models.serve import ServeEngine
 
-            engine = ServeEngine()
+            mesh = None
+            cfg = None
+            tp = int(os.environ.get("TPUSLO_SERVE_TP", "0") or 0)
+            if tp > 1:
+                # Tensor-parallel serving over tp local devices (v5e-8
+                # hosts run tp=8 for 70B-class models).  ServeEngine
+                # additionally validates that tp divides the config's
+                # head counts.
+                import jax
+                import numpy as np
+                from jax.sharding import Mesh
+
+                devices = jax.devices()
+                if len(devices) < tp:
+                    raise ValueError(
+                        f"TPUSLO_SERVE_TP={tp} but only {len(devices)} "
+                        "devices are visible"
+                    )
+                mesh = Mesh(np.array(devices[:tp]), ("tp",))
+            model = os.environ.get("TPUSLO_SERVE_MODEL", "")
+            if model:
+                from tpuslo.models import llama
+
+                cfg = getattr(llama, model)()
+            quantize = os.environ.get("TPUSLO_SERVE_INT8", "") == "1"
+            engine = ServeEngine(cfg=cfg, mesh=mesh, quantize=quantize)
             engine.warmup()
         self.engine = engine
 

@@ -35,6 +35,64 @@ BOS = 256
 EOS = 257
 
 
+def serve_param_shardings(params, mesh):
+    """NamedSharding tree for serving params (dense or int8 quant).
+
+    Megatron-style tensor parallelism over the ``tp`` mesh axis:
+    column-parallel projections (wq/wk/wv/w1/w3) shard their output
+    dim, row-parallel ones (wo/w2) their input dim (XLA inserts the one
+    psum per block), embedding shards the vocab axis and the head its
+    output vocab.  Quant leaves ``{"q", "s"}`` shard q like the dense
+    weight and s like q's output axis (q's spec minus the contracting
+    -2 entry).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    col = P(None, None, "tp")  # (L, D, out) — shard out
+    row = P(None, "tp", None)  # (L, in, D) — shard in
+    specs = {
+        "embed": P("tp", None),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": col,
+            "wk": col,
+            "wv": col,
+            "wo": row,
+            "mlp_norm": P(None, None),
+            "w1": col,
+            "w3": col,
+            "w2": row,
+        },
+        "final_norm": P(None),
+        "output": P(None, "tp"),
+    }
+
+    def build(spec, leaf):
+        if isinstance(leaf, dict):  # {"q", "s"} quant leaf
+            s_spec = P(*(tuple(spec)[:-2] + tuple(spec)[-1:]))  # drop contracting axis
+            return {
+                "q": NamedSharding(mesh, spec),
+                "s": NamedSharding(mesh, s_spec),
+            }
+        return NamedSharding(mesh, spec)
+
+    return jax.tree.map(
+        build, specs, params,
+        is_leaf=lambda v: isinstance(v, P),
+    )
+
+
+def kv_cache_shardings(mesh):
+    """KV cache (L, B, S, KV, HD): shard KV heads over tp."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return {
+        "k": NamedSharding(mesh, P(None, None, None, "tp", None)),
+        "v": NamedSharding(mesh, P(None, None, None, "tp", None)),
+        "length": NamedSharding(mesh, P()),
+    }
+
+
 def encode_bytes(text: str, max_len: int) -> list[int]:
     """Byte-level encode with BOS, truncated to max_len."""
     ids = [BOS] + [b for b in text.encode("utf-8")]
@@ -71,19 +129,47 @@ class ServeEngine:
         prefill_buckets: tuple[int, ...] = (32, 64, 128, 256),
         decode_chunk_size: int = 64,
         quantize: bool = False,
+        mesh=None,
     ):
         self.cfg = cfg or llama_tiny(max_seq_len=512)
-        if params is None:
-            params = (
-                # Leaf-wise init+quantize: peak HBM = int8 tree + one
-                # bf16 leaf, which is what fits 8B-class weights on a
-                # single chip.
-                init_params_quantized(jax.random.PRNGKey(rng_seed), self.cfg)
-                if quantize
-                else init_params(jax.random.PRNGKey(rng_seed), self.cfg)
+        self.mesh = mesh
+        if mesh is not None:
+            tp = mesh.shape.get("tp", 1)
+            if self.cfg.n_kv_heads % tp or self.cfg.n_heads % tp:
+                raise ValueError(
+                    f"tp={tp} must divide n_kv_heads={self.cfg.n_kv_heads} "
+                    f"and n_heads={self.cfg.n_heads} (pick a larger config "
+                    "or a smaller tp)"
+                )
+            self._cache_shardings = kv_cache_shardings(mesh)
+        init_fn = partial(
+            init_params_quantized if quantize else init_params, cfg=self.cfg
+        )
+        if params is None and mesh is not None:
+            # Initialize DIRECTLY into the tp shardings: jit with
+            # out_shardings lets each device produce only its own
+            # shard, so no device ever holds the full tree — this is
+            # what makes 70B-class serving over a v5e-8 possible
+            # (int8 70B ~70 GB over 8 x 16 GB chips).
+            abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(rng_seed))
+            shardings = serve_param_shardings(abstract, mesh)
+            params = jax.jit(init_fn, out_shardings=shardings)(
+                jax.random.PRNGKey(rng_seed)
             )
-        elif quantize and not isinstance(params.get("output"), dict):
-            params = quantize_params(params)
+        elif params is None:
+            # Leaf-wise init+quantize: peak HBM = int8 tree + one
+            # bf16 leaf, which is what fits 8B-class weights on a
+            # single chip.
+            params = init_fn(jax.random.PRNGKey(rng_seed))
+        else:
+            # Caller-supplied params must fit wherever they currently
+            # live; with a mesh they are resharded onto it.
+            if quantize and not isinstance(params.get("output"), dict):
+                params = quantize_params(params)
+            if mesh is not None:
+                params = jax.device_put(
+                    params, serve_param_shardings(params, mesh)
+                )
         self.quantized = isinstance(params.get("output"), dict)
         self.params = params
         self.prefill_buckets = tuple(
@@ -117,6 +203,13 @@ class ServeEngine:
         self._decode_one = None
         self.compile_events: list[dict] = []
 
+
+    def _new_cache(self, batch: int):
+        cache = init_kv_cache(self.cfg, batch)
+        if self.mesh is not None:
+            cache = jax.device_put(cache, self._cache_shardings)
+        return cache
+
     def _decode_one_fn(self):
         if self._decode_one is None:
             # First short-budget request pays this compile; record it
@@ -128,7 +221,7 @@ class ServeEngine:
                 donate_argnums=(2,),
             )
             tokens = jnp.zeros((1,), jnp.int32)
-            cache = init_kv_cache(self.cfg, 1)
+            cache = self._new_cache(1)
             toks, _last, _ = self._decode_one(self.params, tokens, cache)
             jax.block_until_ready(toks)
             self.compile_events.append(
@@ -150,7 +243,7 @@ class ServeEngine:
             self._decode_one_fn()
         bucket = bucket or self.prefill_buckets[0]
         tokens = jnp.zeros((1, bucket), jnp.int32)
-        cache = init_kv_cache(self.cfg, 1)
+        cache = self._new_cache(1)
         logits, cache = self._prefill(self.params, tokens, cache)
         tok = jnp.argmax(logits, -1).astype(jnp.int32)
         toks, _last, _ = self._decode_chunk(self.params, tok, cache)
@@ -229,7 +322,7 @@ class ServeEngine:
         decode_fn, chunk, cap_tokens = self._decode_budget(max(lens))
         max_new_tokens = max(1, min(max_new_tokens, cap_tokens))
 
-        cache = init_kv_cache(self.cfg, batch)
+        cache = self._new_cache(batch)
         logits, cache = self._prefill(
             self.params, tokens, cache, true_length=jnp.asarray(lens, jnp.int32)
         )
@@ -283,7 +376,7 @@ class ServeEngine:
         tokens = jnp.asarray([padded], jnp.int32)
 
         compile_start = time.perf_counter()
-        cache = init_kv_cache(self.cfg, 1)
+        cache = self._new_cache(1)
         logits, cache = self._prefill(
             self.params, tokens, cache, true_length=jnp.asarray(len(ids), jnp.int32)
         )
