@@ -143,6 +143,14 @@ class JaxBackend:
             if model:
                 from tpuslo.models import llama
 
+                valid = (
+                    "llama_tiny", "llama32_1b", "llama32_3b",
+                    "llama3_8b", "llama3_70b",
+                )
+                if model not in valid:
+                    raise ValueError(
+                        f"TPUSLO_SERVE_MODEL={model!r}: expected one of {valid}"
+                    )
                 cfg = getattr(llama, model)()
             quantize = os.environ.get("TPUSLO_SERVE_INT8", "") == "1"
             engine = ServeEngine(cfg=cfg, mesh=mesh, quantize=quantize)

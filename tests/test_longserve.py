@@ -1,0 +1,95 @@
+"""Long-context sp serving: parity with the single-device engine."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from tpuslo.models.llama import (
+    decode_step,
+    init_kv_cache,
+    init_params,
+    llama_tiny,
+    prefill,
+)
+from tpuslo.models.longserve import sp_generate, sp_prefill, sp_decode_step
+
+
+def _mesh(sp: int) -> Mesh:
+    return Mesh(np.array(jax.devices()[:sp]), ("sp",))
+
+
+def _cfg(max_seq_len=256):
+    return llama_tiny(max_seq_len=max_seq_len)
+
+
+def _ref_last_logits(params, tokens, cfg):
+    cache = init_kv_cache(cfg, tokens.shape[0])
+    logits, cache = prefill(params, tokens, cache, cfg)
+    return logits, cache
+
+
+@pytest.mark.parametrize("sp", [2, 4])
+def test_sp_prefill_matches_plain(sp):
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, cfg.vocab_size)
+
+    ref, _ = _ref_last_logits(params, tokens, cfg)
+    got, cache = sp_prefill(params, tokens, cfg, _mesh(sp))
+    err = float(jnp.max(jnp.abs(ref - got)))
+    assert err < 5e-2, f"sp={sp} prefill logits error {err}"
+    assert int(cache["tail_len"]) == 0
+    assert cache["k_ctx"].shape[2] == 64
+
+
+def test_sp_decode_matches_plain_chain():
+    cfg = _cfg()
+    sp = 4
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, cfg.vocab_size)
+
+    # Reference: plain prefill + 6 decode steps.
+    ref_logits, ref_cache = _ref_last_logits(params, tokens, cfg)
+    ref_tokens = [jnp.argmax(ref_logits, -1).astype(jnp.int32)]
+    for _ in range(5):
+        logits, ref_cache = decode_step(params, ref_tokens[-1], ref_cache, cfg)
+        ref_tokens.append(jnp.argmax(logits, -1).astype(jnp.int32))
+    ref_seq = jnp.stack(ref_tokens, axis=1)
+
+    got_seq = sp_generate(params, tokens, cfg, _mesh(sp), max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(ref_seq), np.asarray(got_seq))
+
+
+def test_sp_decode_logits_close():
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 32), 0, cfg.vocab_size)
+    mesh = _mesh(2)
+
+    ref_logits, ref_cache = _ref_last_logits(params, tokens, cfg)
+    tok = jnp.argmax(ref_logits, -1).astype(jnp.int32)
+    ref_step, _ = decode_step(params, tok, ref_cache, cfg)
+
+    sp_logits, sp_cache = sp_prefill(params, tokens, cfg, mesh)
+    got_step, sp_cache = sp_decode_step(params, tok, sp_cache, cfg, mesh)
+    assert int(sp_cache["tail_len"]) == 1
+    err = float(jnp.max(jnp.abs(ref_step - got_step)))
+    assert err < 5e-2, f"decode logits error {err}"
+
+
+def test_sp_prefill_rejects_indivisible():
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.zeros((1, 30), jnp.int32)
+    with pytest.raises(ValueError, match="not divisible"):
+        sp_prefill(params, tokens, cfg, _mesh(4))
+
+
+def test_sp_tail_budget_guard():
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.zeros((1, 32), jnp.int32)
+    with pytest.raises(ValueError, match="tail_max"):
+        sp_generate(params, tokens, cfg, _mesh(2), max_new_tokens=8, tail_max=8)

@@ -1,0 +1,325 @@
+"""Long-context serving: sequence-parallel prefill + distributed decode.
+
+The serving-side counterpart of :mod:`tpuslo.ops.ring_attention` (which
+covers training).  A 128k-token context does not fit one chip's HBM as
+KV cache, and prefill attention over it is O(S²); both shard over the
+``sp`` mesh axis:
+
+* **Prefill** (context ingestion): tokens shard over sequence; every
+  layer runs ring attention (KV blocks rotate neighbour-to-neighbour
+  over ICI, online-softmax accumulation), so no device ever holds more
+  than S/p of the context or an (S × S) score tile.  The context KV
+  cache is left sharded in place — device i owns positions
+  ``[i·S/p, (i+1)·S/p)``.
+* **Decode**: the new token's query attends to (a) the local context
+  shard — each device computes a partial online-softmax accumulator
+  ``(m, l, o)`` over its own KV block, merged across the mesh with one
+  ``pmax``/``psum`` pair — and (b) a small **replicated tail buffer**
+  holding the generated tokens (bounded by ``tail_max``, a few k at
+  most: tail memory is negligible next to the sharded context).  New
+  KV appends to the tail on every device; no resharding, no gather of
+  the long context, ever.
+
+This split (sharded frozen context + replicated growing tail) keeps
+every decode-step shape static — XLA compiles the step once — and the
+only cross-chip traffic per token is the two scalar-field collectives.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpuslo.models.llama import (
+    LlamaConfig,
+    _embed_lookup,
+    _matmul,
+    apply_rope,
+    rms_norm,
+    rope_frequencies,
+)
+from tpuslo.ops.ring_attention import ring_attention
+
+try:  # moved out of jax.experimental in newer releases
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+PyTree = Any
+NEG_INF = -1e30
+
+
+def init_sp_cache(cfg: LlamaConfig, batch: int, ctx_len: int, tail_max: int):
+    """Sequence-parallel cache: sharded context + replicated tail."""
+    L, KV, HD = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+    return {
+        "k_ctx": jnp.zeros((L, batch, ctx_len, KV, HD), cfg.dtype),
+        "v_ctx": jnp.zeros((L, batch, ctx_len, KV, HD), cfg.dtype),
+        "k_tail": jnp.zeros((L, batch, tail_max, KV, HD), cfg.dtype),
+        "v_tail": jnp.zeros((L, batch, tail_max, KV, HD), cfg.dtype),
+        "tail_len": jnp.zeros((), jnp.int32),
+    }
+
+
+def sp_cache_shardings(mesh: Mesh, axis_name: str = "sp"):
+    ctx = NamedSharding(mesh, P(None, None, axis_name, None, None))
+    rep = NamedSharding(mesh, P())
+    return {
+        "k_ctx": ctx,
+        "v_ctx": ctx,
+        "k_tail": rep,
+        "v_tail": rep,
+        "tail_len": rep,
+    }
+
+
+def _sp_prefill_body(params, tokens, cfg: LlamaConfig, axis_name: str):
+    """shard_map body.  tokens: (B, S_local) — the local context shard.
+
+    Returns (last-position logits (B, vocab), ks (L,B,S_local,KV,HD),
+    vs (..)) with the KV left sharded in place.
+    """
+    p = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, S_loc = tokens.shape
+    H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    positions = idx * S_loc + jnp.broadcast_to(jnp.arange(S_loc), (B, S_loc))
+    h = _embed_lookup(params, tokens, cfg.dtype)
+    cos, sin = rope_frequencies(cfg, positions)
+
+    def layer_step(h, layer):
+        x = rms_norm(h, layer["attn_norm"], cfg.norm_eps)
+        q = _matmul(x, layer["wq"]).reshape(B, S_loc, H, HD)
+        k = _matmul(x, layer["wk"]).reshape(B, S_loc, KV, HD)
+        v = _matmul(x, layer["wv"]).reshape(B, S_loc, KV, HD)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        # GQA: ring attention is MHA-shaped; expand KV heads once here.
+        n_rep = H // KV
+        k_full = jnp.repeat(k, n_rep, axis=2)
+        v_full = jnp.repeat(v, n_rep, axis=2)
+        attn = ring_attention(q, k_full, v_full, axis_name)
+        h = h + _matmul(attn.reshape(B, S_loc, H * HD), layer["wo"])
+        x = rms_norm(h, layer["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(_matmul(x, layer["w1"]).astype(jnp.float32))
+        up = _matmul(x, layer["w3"]).astype(jnp.float32)
+        h = h + _matmul((gate * up).astype(cfg.dtype), layer["w2"])
+        return h, (k, v)
+
+    h, (ks, vs) = lax.scan(layer_step, h, params["layers"])
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps)
+    # Last global position lives on the last shard; psum broadcasts.
+    h_last = jnp.where(idx == p - 1, h[:, -1, :], jnp.zeros_like(h[:, -1, :]))
+    h_last = lax.psum(h_last, axis_name)
+    logits = _matmul(h_last, params["output"]).astype(jnp.float32)
+    return logits, ks, vs
+
+
+def sp_prefill(
+    params: PyTree,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    tail_max: int = 512,
+    axis_name: str = "sp",
+):
+    """Ingest a long context.  tokens: (B, S) with S % sp == 0.
+
+    Returns (last-token logits, sp cache) — context KV sharded, tail
+    empty.
+    """
+    sp = mesh.shape[axis_name]
+    B, S = tokens.shape
+    if S % sp:
+        raise ValueError(f"context length {S} not divisible by sp={sp}")
+    fn = shard_map(
+        partial(_sp_prefill_body, cfg=cfg, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(P(), P(None, axis_name)),
+        out_specs=(P(), P(None, None, axis_name, None, None),
+                   P(None, None, axis_name, None, None)),
+    )
+    logits, ks, vs = fn(params, tokens)
+    # Build the cache around the sharded KV the prefill just produced —
+    # allocating a zero context buffer only to overwrite it would cost
+    # a full context cache worth of HBM at 128k scale.
+    L, KV, HD = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+    rep = NamedSharding(mesh, P())
+    tail_shape = (L, B, tail_max, KV, HD)
+    cache = {
+        "k_ctx": ks,
+        "v_ctx": vs,
+        "k_tail": jax.device_put(jnp.zeros(tail_shape, cfg.dtype), rep),
+        "v_tail": jax.device_put(jnp.zeros(tail_shape, cfg.dtype), rep),
+        "tail_len": jax.device_put(jnp.zeros((), jnp.int32), rep),
+    }
+    return logits, cache
+
+
+def _partial_attention(q, k, v, valid):
+    """Online-softmax partials for q (B,1,H,HD) over k/v (B,T,KV,HD).
+
+    valid: (T,) bool — which KV rows participate.  Returns m, l, o with
+    shapes (B,H), (B,H), (B,H,HD) in fp32.
+    """
+    B, _, H, HD = q.shape
+    KV = k.shape[2]
+    n_rep = H // KV
+    if n_rep > 1:
+        k = jnp.repeat(k, n_rep, axis=2)
+        v = jnp.repeat(v, n_rep, axis=2)
+    scale = HD**-0.5
+    scores = jnp.einsum(
+        "bqhd,bthd->bhqt", q.astype(jnp.float32), k.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )[:, :, 0, :] * scale  # (B, H, T)
+    scores = jnp.where(valid[None, None, :], scores, NEG_INF)
+    m = jnp.max(scores, axis=-1)  # (B, H)
+    e = jnp.exp(scores - m[..., None])
+    l = jnp.sum(e, axis=-1)
+    o = jnp.einsum("bht,bthd->bhd", e, v.astype(jnp.float32))
+    return m, l, o
+
+
+def _merge_partials(m1, l1, o1, m2, l2, o2):
+    m = jnp.maximum(m1, m2)
+    c1 = jnp.exp(m1 - m)
+    c2 = jnp.exp(m2 - m)
+    return m, l1 * c1 + l2 * c2, o1 * c1[..., None] + o2 * c2[..., None]
+
+
+def _sp_decode_body(params, token, cache, cfg: LlamaConfig, axis_name: str):
+    """One decode step.  token: (B,) replicated; context KV sharded."""
+    idx = lax.axis_index(axis_name)
+    B = token.shape[0]
+    H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    S_loc = cache["k_ctx"].shape[2]
+    tail_max = cache["k_tail"].shape[2]
+    ctx_total = lax.psum(S_loc, axis_name)
+
+    tail_len = cache["tail_len"]
+    pos = ctx_total + tail_len  # global position of the new token
+    positions = jnp.broadcast_to(pos, (B,))[:, None]
+    h = _embed_lookup(params, token[:, None], cfg.dtype)
+    cos, sin = rope_frequencies(cfg, positions)
+
+    ctx_valid = jnp.ones((S_loc,), jnp.bool_)  # context fully visible
+    tail_valid = jnp.arange(tail_max) < tail_len
+
+    def layer_step(h, inputs):
+        layer, k_ctx, v_ctx, k_tail, v_tail = inputs
+        x = rms_norm(h, layer["attn_norm"], cfg.norm_eps)
+        q = _matmul(x, layer["wq"]).reshape(B, 1, H, HD)
+        k = _matmul(x, layer["wk"]).reshape(B, 1, KV, HD)
+        v = _matmul(x, layer["wv"]).reshape(B, 1, KV, HD)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+
+        # Partial over the local context shard, merged across the mesh
+        # (pmax/psum with online-softmax correction), then merged with
+        # the replicated tail partial computed identically everywhere.
+        m_c, l_c, o_c = _partial_attention(q, k_ctx, v_ctx, ctx_valid)
+        m_g = lax.pmax(m_c, axis_name)
+        corr = jnp.exp(m_c - m_g)
+        l_g = lax.psum(l_c * corr, axis_name)
+        o_g = lax.psum(o_c * corr[..., None], axis_name)
+
+        # Tail includes the CURRENT token: causal self-attention always
+        # sees itself.  Write first, then attend.
+        k_tail = lax.dynamic_update_slice(
+            k_tail, k, (0, tail_len, 0, 0)
+        )
+        v_tail = lax.dynamic_update_slice(
+            v_tail, v, (0, tail_len, 0, 0)
+        )
+        now_valid = jnp.arange(tail_max) < (tail_len + 1)
+        m_t, l_t, o_t = _partial_attention(q, k_tail, v_tail, now_valid)
+
+        m, l, o = _merge_partials(m_g, l_g, o_g, m_t, l_t, o_t)
+        out = (o / jnp.maximum(l, 1e-30)[..., None]).astype(cfg.dtype)
+        h = h + _matmul(out.reshape(B, 1, H * HD), layer["wo"])
+        x = rms_norm(h, layer["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(_matmul(x, layer["w1"]).astype(jnp.float32))
+        up = _matmul(x, layer["w3"]).astype(jnp.float32)
+        h = h + _matmul((gate * up).astype(cfg.dtype), layer["w2"])
+        return h, (k_tail, v_tail)
+
+    h, (k_tails, v_tails) = lax.scan(
+        layer_step,
+        h,
+        (params["layers"], cache["k_ctx"], cache["v_ctx"],
+         cache["k_tail"], cache["v_tail"]),
+    )
+    cache = {
+        "k_ctx": cache["k_ctx"],
+        "v_ctx": cache["v_ctx"],
+        "k_tail": k_tails,
+        "v_tail": v_tails,
+        "tail_len": tail_len + 1,
+    }
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps)
+    logits = _matmul(h[:, 0, :], params["output"]).astype(jnp.float32)
+    return logits, cache
+
+
+def sp_decode_step(
+    params: PyTree,
+    token: jax.Array,
+    cache: PyTree,
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    axis_name: str = "sp",
+):
+    """One distributed decode step → (logits (B, vocab), cache)."""
+    ctx_spec = P(None, None, axis_name, None, None)
+    cache_specs = {
+        "k_ctx": ctx_spec,
+        "v_ctx": ctx_spec,
+        "k_tail": P(),
+        "v_tail": P(),
+        "tail_len": P(),
+    }
+    fn = shard_map(
+        partial(_sp_decode_body, cfg=cfg, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(P(), P(), cache_specs),
+        out_specs=(P(), cache_specs),
+    )
+    return fn(params, token, cache)
+
+
+def sp_generate(
+    params: PyTree,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    max_new_tokens: int,
+    tail_max: int | None = None,
+    axis_name: str = "sp",
+) -> jax.Array:
+    """Greedy long-context generation → (B, max_new_tokens) int32."""
+    tail_max = tail_max or max(64, max_new_tokens + 1)
+    if max_new_tokens >= tail_max:
+        raise ValueError(
+            f"max_new_tokens={max_new_tokens} needs tail_max > itself"
+        )
+    logits, cache = sp_prefill(
+        params, tokens, cfg, mesh, tail_max=tail_max, axis_name=axis_name
+    )
+    step = jax.jit(
+        partial(sp_decode_step, cfg=cfg, mesh=mesh, axis_name=axis_name),
+        donate_argnums=(2,),
+    )
+    token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    out = [token]
+    for _ in range(max_new_tokens - 1):
+        logits, cache = step(params, token, cache)
+        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        out.append(token)
+    return jnp.stack(out, axis=1)

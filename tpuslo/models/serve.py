@@ -134,7 +134,11 @@ class ServeEngine:
         self.cfg = cfg or llama_tiny(max_seq_len=512)
         self.mesh = mesh
         if mesh is not None:
-            tp = mesh.shape.get("tp", 1)
+            if "tp" not in mesh.axis_names:
+                raise ValueError(
+                    f"serving mesh must have a 'tp' axis, got {mesh.axis_names}"
+                )
+            tp = mesh.shape["tp"]
             if self.cfg.n_kv_heads % tp or self.cfg.n_heads % tp:
                 raise ValueError(
                     f"tp={tp} must divide n_kv_heads={self.cfg.n_kv_heads} "
