@@ -1,0 +1,104 @@
+"""Data pipeline: determinism, shapes, sharded prefetch, e2e train."""
+
+import jax
+import numpy as np
+import pytest
+
+from tpuslo.models.data import (
+    corpus_stream,
+    prefetch_to_device,
+    tokenize_corpus,
+    window_batches,
+)
+
+CORPUS = [f"document {i}: the quick brown fox jumps over the lazy dog" for i in range(40)]
+
+
+def test_tokenize_bos_separators():
+    toks = tokenize_corpus(["ab", "c"])
+    assert toks.tolist() == [256, 97, 98, 256, 99]
+
+
+def test_window_batches_shapes_and_shift():
+    toks = tokenize_corpus(CORPUS)
+    tokens, targets = next(window_batches(toks, batch=4, seq_len=16))
+    assert tokens.shape == targets.shape == (4, 16)
+    np.testing.assert_array_equal(tokens[:, 1:], targets[:, :-1])
+
+
+def test_deterministic_replay():
+    toks = tokenize_corpus(CORPUS)
+    a = [t.sum() for t, _ in window_batches(toks, 2, 16, seed=7)]
+    b = [t.sum() for t, _ in window_batches(toks, 2, 16, seed=7)]
+    c = [t.sum() for t, _ in window_batches(toks, 2, 16, seed=8)]
+    assert a == b
+    assert a != c
+
+
+def test_small_corpus_rejected():
+    with pytest.raises(ValueError, match="windows"):
+        next(window_batches(tokenize_corpus(["x"]), batch=4, seq_len=128))
+
+
+def test_prefetch_yields_device_arrays():
+    toks = tokenize_corpus(CORPUS)
+    stream = prefetch_to_device(window_batches(toks, 2, 16))
+    tokens, targets = next(stream)
+    assert isinstance(tokens, jax.Array)
+    assert tokens.shape == (2, 16)
+    count = 1 + sum(1 for _ in stream)
+    assert count == len(list(window_batches(toks, 2, 16)))
+
+
+def test_sharded_prefetch_and_train_step():
+    from tpuslo.models.llama import llama_tiny
+    from tpuslo.models.train import build_sharded_train_step
+    from tpuslo.parallel.mesh import MeshPlan, batch_sharding, make_mesh
+
+    cfg = llama_tiny(max_seq_len=64)
+    mesh = make_mesh(MeshPlan(dp=2, fsdp=2, tp=2))
+    step, init = build_sharded_train_step(mesh, cfg)
+    params, opt_state = init(jax.random.PRNGKey(0))
+
+    losses = []
+    for tokens, targets in corpus_stream(
+        CORPUS, batch=4, seq_len=32, sharding=batch_sharding(mesh), epochs=1
+    ):
+        assert tokens.sharding.spec == batch_sharding(mesh).spec
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+        losses.append(float(loss))
+        if len(losses) >= 4:
+            break
+    assert all(np.isfinite(l) for l in losses)
+    # Tiny model on a repetitive corpus: loss must drop across steps.
+    assert losses[-1] < losses[0]
+
+
+def test_prefetch_propagates_worker_errors():
+    def bad_batches():
+        yield (np.zeros((2, 4), np.int32), np.zeros((2, 4), np.int32))
+        raise RuntimeError("host pipeline exploded")
+
+    stream = prefetch_to_device(bad_batches())
+    next(stream)
+    with pytest.raises(RuntimeError, match="exploded"):
+        next(stream)
+
+
+def test_prefetch_close_stops_worker():
+    import threading
+
+    before = threading.active_count()
+    toks = tokenize_corpus(CORPUS)
+    stream = prefetch_to_device(window_batches(toks, 2, 16, epochs=100))
+    next(stream)
+    stream.close()
+    # The worker must exit (not stay blocked on a full queue) shortly
+    # after close; poll briefly.
+    import time
+
+    for _ in range(50):
+        if threading.active_count() <= before:
+            break
+        time.sleep(0.05)
+    assert threading.active_count() <= before

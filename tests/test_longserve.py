@@ -93,3 +93,16 @@ def test_sp_tail_budget_guard():
     tokens = jnp.zeros((1, 32), jnp.int32)
     with pytest.raises(ValueError, match="tail_max"):
         sp_generate(params, tokens, cfg, _mesh(2), max_new_tokens=8, tail_max=8)
+
+
+def test_sp_decode_tail_full_raises():
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.zeros((1, 32), jnp.int32)
+    mesh = _mesh(2)
+    _, cache = sp_prefill(params, tokens, cfg, mesh, tail_max=2)
+    tok = jnp.zeros((1,), jnp.int32)
+    _, cache = sp_decode_step(params, tok, cache, cfg, mesh)
+    _, cache = sp_decode_step(params, tok, cache, cfg, mesh)
+    with pytest.raises(ValueError, match="tail buffer full"):
+        sp_decode_step(params, tok, cache, cfg, mesh)

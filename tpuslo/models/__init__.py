@@ -1,6 +1,6 @@
 """Demo model families: TPU-first JAX Llama + Mixtral (observed workloads)."""
 
-from tpuslo.models import checkpoint, mixtral
+from tpuslo.models import checkpoint, data, longserve, mixtral, trainer
 from tpuslo.models.llama import (
     LlamaConfig,
     decode_step,
@@ -21,7 +21,10 @@ from tpuslo.models.train import build_sharded_train_step, make_optimizer, train_
 
 __all__ = [
     "checkpoint",
+    "data",
+    "longserve",
     "mixtral",
+    "trainer",
     "init_params_quantized",
     "quantize_params",
     "quantized_bytes",

@@ -1,0 +1,161 @@
+"""Training data pipeline: deterministic corpus → device-prefetched batches.
+
+The reference ships only a request-trace generator (`cmd/loadgen`) —
+it has no training path at all.  The TPU rebuild's train loop needs
+one, built TPU-first:
+
+* **byte-level tokenization** on the host (matches the serving
+  tokenizer in :mod:`tpuslo.models.serve`: ids 0-255 are bytes, 256 is
+  BOS), packed into fixed ``(batch, seq_len)`` windows — static shapes,
+  no padding-driven recompiles;
+* **double-buffered prefetch**: a background thread stages the next
+  batch onto the device (optionally with the train step's batch
+  sharding) while the current step runs, so host tokenization and the
+  host→device copy hide behind device compute;
+* deterministic: a seeded permutation over windows per epoch — the
+  same seed replays the same stream, which is what makes loss curves
+  comparable across the benchmark matrix.
+"""
+
+from __future__ import annotations
+
+import threading
+from queue import Queue
+from typing import Iterator
+
+import jax
+import numpy as np
+
+BOS = 256
+
+
+def tokenize_corpus(texts: list[str]) -> np.ndarray:
+    """Byte-tokenize and concatenate a corpus with BOS separators."""
+    out: list[int] = []
+    for text in texts:
+        out.append(BOS)
+        out.extend(text.encode("utf-8"))
+    return np.asarray(out, dtype=np.int32)
+
+
+def window_batches(
+    tokens: np.ndarray,
+    batch: int,
+    seq_len: int,
+    seed: int = 0,
+    epochs: int = 1,
+) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    """Yield (tokens, targets) pairs of shape (batch, seq_len).
+
+    The corpus is cut into non-overlapping ``seq_len + 1`` windows
+    (inputs and next-token targets share a window, shifted by one);
+    each epoch visits all full windows in a seeded permutation.
+    """
+    stride = seq_len + 1
+    n_windows = len(tokens) // stride
+    if n_windows < batch:
+        raise ValueError(
+            f"corpus has {n_windows} windows of {stride}; need >= {batch}"
+        )
+    windows = tokens[: n_windows * stride].reshape(n_windows, stride)
+    rng = np.random.RandomState(seed)
+    for _ in range(epochs):
+        order = rng.permutation(n_windows)
+        for start in range(0, n_windows - batch + 1, batch):
+            sel = windows[order[start : start + batch]]
+            yield sel[:, :-1].copy(), sel[:, 1:].copy()
+
+
+def prefetch_to_device(
+    batches: Iterator[tuple[np.ndarray, np.ndarray]],
+    sharding=None,
+    depth: int = 2,
+) -> Iterator[tuple[jax.Array, jax.Array]]:
+    """Stage ``depth`` batches ahead on the device.
+
+    A daemon thread pulls host batches and ``device_put``s them
+    (optionally with the train step's batch sharding so multi-chip
+    training never funnels through one device).  jax transfers are
+    async; the bounded queue is the backpressure.
+
+    Worker exceptions re-raise in the consumer (a device_put failure
+    must not masquerade as a clean end of stream), and closing the
+    generator early (``.close()`` / ``break`` + GC) unblocks and ends
+    the worker instead of leaking it with pinned device batches.
+    """
+    queue: Queue = Queue(maxsize=depth)
+    stop = threading.Event()
+
+    def put(item) -> bool:
+        """Blocking put that gives up when the consumer is gone."""
+        while not stop.is_set():
+            try:
+                queue.put(item, timeout=0.1)
+                return True
+            except Exception:  # queue.Full
+                continue
+        return False
+
+    def worker():
+        try:
+            for host_tokens, host_targets in batches:
+                if stop.is_set():
+                    return
+                if sharding is not None:
+                    pair = (
+                        jax.device_put(host_tokens, sharding),
+                        jax.device_put(host_targets, sharding),
+                    )
+                else:
+                    pair = (
+                        jax.device_put(host_tokens),
+                        jax.device_put(host_targets),
+                    )
+                if not put(("item", pair)):
+                    return
+            put(("done", None))
+        except BaseException as exc:  # noqa: BLE001 - re-raised by consumer
+            put(("error", exc))
+
+    thread = threading.Thread(target=worker, daemon=True)
+    thread.start()
+    try:
+        while True:
+            kind, payload = queue.get()
+            if kind == "done":
+                return
+            if kind == "error":
+                raise payload
+            yield payload
+    finally:
+        stop.set()
+        # Drain so a worker blocked on put() wakes and exits.
+        while not queue.empty():
+            try:
+                queue.get_nowait()
+            except Exception:  # queue.Empty
+                break
+
+
+def corpus_stream(
+    texts: list[str],
+    batch: int,
+    seq_len: int,
+    sharding=None,
+    seed: int = 0,
+    epochs: int = 1,
+    skip: int = 0,
+) -> Iterator[tuple[jax.Array, jax.Array]]:
+    """tokenize → window → shuffle → prefetch, in one call.
+
+    ``skip`` fast-forwards past already-consumed batches ON THE HOST —
+    before any device transfer — which is what checkpoint resume wants
+    (skipping after prefetch would stage and discard every batch).
+    """
+    import itertools
+
+    tokens = tokenize_corpus(texts)
+    host = window_batches(tokens, batch, seq_len, seed=seed, epochs=epochs)
+    if skip:
+        host = itertools.islice(host, skip, None)
+    return prefetch_to_device(host, sharding=sharding)

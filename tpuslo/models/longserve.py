@@ -54,28 +54,44 @@ PyTree = Any
 NEG_INF = -1e30
 
 
+def _tail_buffers(cfg: LlamaConfig, batch: int, tail_max: int):
+    L, KV, HD = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+    shape = (L, batch, tail_max, KV, HD)
+    return {
+        "k_tail": jnp.zeros(shape, cfg.dtype),
+        "v_tail": jnp.zeros(shape, cfg.dtype),
+        "tail_len": jnp.zeros((), jnp.int32),
+    }
+
+
 def init_sp_cache(cfg: LlamaConfig, batch: int, ctx_len: int, tail_max: int):
     """Sequence-parallel cache: sharded context + replicated tail."""
     L, KV, HD = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
     return {
         "k_ctx": jnp.zeros((L, batch, ctx_len, KV, HD), cfg.dtype),
         "v_ctx": jnp.zeros((L, batch, ctx_len, KV, HD), cfg.dtype),
-        "k_tail": jnp.zeros((L, batch, tail_max, KV, HD), cfg.dtype),
-        "v_tail": jnp.zeros((L, batch, tail_max, KV, HD), cfg.dtype),
-        "tail_len": jnp.zeros((), jnp.int32),
+        **_tail_buffers(cfg, batch, tail_max),
+    }
+
+
+def sp_cache_specs(axis_name: str = "sp"):
+    """The ONE definition of the sp-cache partition layout."""
+    ctx = P(None, None, axis_name, None, None)
+    return {
+        "k_ctx": ctx,
+        "v_ctx": ctx,
+        "k_tail": P(),
+        "v_tail": P(),
+        "tail_len": P(),
     }
 
 
 def sp_cache_shardings(mesh: Mesh, axis_name: str = "sp"):
-    ctx = NamedSharding(mesh, P(None, None, axis_name, None, None))
-    rep = NamedSharding(mesh, P())
-    return {
-        "k_ctx": ctx,
-        "v_ctx": ctx,
-        "k_tail": rep,
-        "v_tail": rep,
-        "tail_len": rep,
-    }
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        sp_cache_specs(axis_name),
+        is_leaf=lambda v: isinstance(v, P),
+    )
 
 
 def _sp_prefill_body(params, tokens, cfg: LlamaConfig, axis_name: str):
@@ -100,11 +116,9 @@ def _sp_prefill_body(params, tokens, cfg: LlamaConfig, axis_name: str):
         v = _matmul(x, layer["wv"]).reshape(B, S_loc, KV, HD)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        # GQA: ring attention is MHA-shaped; expand KV heads once here.
-        n_rep = H // KV
-        k_full = jnp.repeat(k, n_rep, axis=2)
-        v_full = jnp.repeat(v, n_rep, axis=2)
-        attn = ring_attention(q, k_full, v_full, axis_name)
+        # GQA-aware ring: KV rotates at KV-head width (1/n_rep of the
+        # ICI bytes) and expands locally per block.
+        attn = ring_attention(q, k, v, axis_name, n_rep=H // KV)
         h = h + _matmul(attn.reshape(B, S_loc, H * HD), layer["wo"])
         x = rms_norm(h, layer["mlp_norm"], cfg.norm_eps)
         gate = jax.nn.silu(_matmul(x, layer["w1"]).astype(jnp.float32))
@@ -149,17 +163,9 @@ def sp_prefill(
     # Build the cache around the sharded KV the prefill just produced —
     # allocating a zero context buffer only to overwrite it would cost
     # a full context cache worth of HBM at 128k scale.
-    L, KV, HD = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
     rep = NamedSharding(mesh, P())
-    tail_shape = (L, B, tail_max, KV, HD)
-    cache = {
-        "k_ctx": ks,
-        "v_ctx": vs,
-        "k_tail": jax.device_put(jnp.zeros(tail_shape, cfg.dtype), rep),
-        "v_tail": jax.device_put(jnp.zeros(tail_shape, cfg.dtype), rep),
-        "tail_len": jax.device_put(jnp.zeros((), jnp.int32), rep),
-    }
-    return logits, cache
+    tail = jax.device_put(_tail_buffers(cfg, B, tail_max), rep)
+    return logits, {"k_ctx": ks, "v_ctx": vs, **tail}
 
 
 def _partial_attention(q, k, v, valid):
@@ -210,7 +216,6 @@ def _sp_decode_body(params, token, cache, cfg: LlamaConfig, axis_name: str):
     cos, sin = rope_frequencies(cfg, positions)
 
     ctx_valid = jnp.ones((S_loc,), jnp.bool_)  # context fully visible
-    tail_valid = jnp.arange(tail_max) < tail_len
 
     def layer_step(h, inputs):
         layer, k_ctx, v_ctx, k_tail, v_tail = inputs
@@ -276,15 +281,24 @@ def sp_decode_step(
     mesh: Mesh,
     axis_name: str = "sp",
 ):
-    """One distributed decode step → (logits (B, vocab), cache)."""
-    ctx_spec = P(None, None, axis_name, None, None)
-    cache_specs = {
-        "k_ctx": ctx_spec,
-        "v_ctx": ctx_spec,
-        "k_tail": P(),
-        "v_tail": P(),
-        "tail_len": P(),
-    }
+    """One distributed decode step → (logits (B, vocab), cache).
+
+    The tail buffer must have a free slot: when ``tail_len`` is
+    concrete (eager callers) a full tail raises; under jit the caller
+    owns the budget (``sp_generate`` enforces it up front).
+    """
+    try:
+        tail_len = int(cache["tail_len"])
+        tail_max = int(cache["k_tail"].shape[2])
+        if tail_len >= tail_max:
+            raise ValueError(
+                f"tail buffer full ({tail_len}/{tail_max}): re-prefill or "
+                "raise tail_max — writes past the end would silently "
+                "corrupt the last slot"
+            )
+    except (TypeError, jax.errors.TracerArrayConversionError):
+        pass  # traced: budget enforced by the caller
+    cache_specs = sp_cache_specs(axis_name)
     fn = shard_map(
         partial(_sp_decode_body, cfg=cfg, axis_name=axis_name),
         mesh=mesh,

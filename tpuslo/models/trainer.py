@@ -1,0 +1,111 @@
+"""Training loop: data pipeline + sharded step + checkpoint/resume.
+
+Composes the three framework pieces end-to-end (the reference has no
+training story at all — SURVEY.md §2.5/§5):
+
+* :mod:`tpuslo.models.data` — deterministic device-prefetched batches;
+* :mod:`tpuslo.models.train` — dp/fsdp/tp-sharded AdamW step;
+* :mod:`tpuslo.models.checkpoint` — rotating orbax checkpoints.
+
+Resume is **bit-exact**: the data stream is a seeded permutation and
+the checkpoint carries (params, opt_state, step), so an interrupted
+run continued from its last checkpoint produces the same loss curve as
+an uninterrupted one — the property the rerun-variance gate (D3)
+assumes when comparing training-shaped benchmark runs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+
+from tpuslo.models.checkpoint import TrainCheckpointer, abstract_like
+from tpuslo.models.data import corpus_stream
+from tpuslo.models.llama import LlamaConfig
+from tpuslo.models.train import build_sharded_train_step
+from tpuslo.parallel.mesh import batch_sharding
+
+PyTree = Any
+
+
+@dataclass(frozen=True)
+class TrainerConfig:
+    steps: int = 100
+    batch: int = 8
+    seq_len: int = 128
+    seed: int = 0
+    ckpt_every: int = 0  # 0 = no checkpointing
+    ckpt_keep: int = 3
+
+
+def train(
+    cfg: LlamaConfig,
+    mesh,
+    texts: list[str],
+    tcfg: TrainerConfig,
+    checkpoint_dir: str | None = None,
+) -> dict:
+    """Run (or resume) a training session; returns
+    ``{"losses", "first_step", "last_step"}``.
+
+    With ``checkpoint_dir`` set and a checkpoint present, training
+    resumes from the latest step: params/opt_state restore into their
+    mesh shardings and the data stream fast-forwards past consumed
+    batches.
+    """
+    step_fn, init_fn = build_sharded_train_step(mesh, cfg)
+    start_step = 0
+    ckpt = None
+    if checkpoint_dir and tcfg.ckpt_every:
+        ckpt = TrainCheckpointer(checkpoint_dir, max_to_keep=tcfg.ckpt_keep)
+
+    if ckpt is not None and ckpt.latest_step() is not None:
+        start_step = int(ckpt.latest_step())
+        # Restore directly into the training shardings WITHOUT running
+        # the initializer: eval_shape on the jitted init preserves the
+        # out_shardings, so no params/opt-state values ever materialize
+        # just to be overwritten (that would double peak HBM on resume).
+        p_abs, o_abs = init_fn.eval_shape(jax.random.PRNGKey(tcfg.seed))
+        abstract = {
+            "params": abstract_like(
+                p_abs, jax.tree.map(lambda leaf: leaf.sharding, p_abs)
+            ),
+            "opt_state": abstract_like(
+                o_abs, jax.tree.map(lambda leaf: leaf.sharding, o_abs)
+            ),
+        }
+        restored = ckpt.restore(start_step, abstract=abstract)
+        params, opt_state = restored["params"], restored["opt_state"]
+    else:
+        params, opt_state = init_fn(jax.random.PRNGKey(tcfg.seed))
+
+    # Deterministic stream: skip already-consumed batches on the host
+    # (before any device transfer), then prefetch ahead of the step.
+    stream = corpus_stream(
+        texts,
+        batch=tcfg.batch,
+        seq_len=tcfg.seq_len,
+        sharding=batch_sharding(mesh),
+        seed=tcfg.seed,
+        epochs=10_000,  # effectively unbounded; the loop bounds steps
+        skip=start_step,
+    )
+
+    losses: list[float] = []
+    step = start_step
+    try:
+        for tokens, targets in stream:
+            if step >= tcfg.steps:
+                break
+            params, opt_state, loss = step_fn(params, opt_state, tokens, targets)
+            step += 1
+            losses.append(float(loss))
+            if ckpt is not None and step % tcfg.ckpt_every == 0:
+                ckpt.save(step, params, opt_state=opt_state)
+    finally:
+        stream.close()  # unblock + end the prefetch worker
+        if ckpt is not None:
+            ckpt.close()
+    return {"losses": losses, "first_step": start_step, "last_step": step}

@@ -57,11 +57,17 @@ def _block_attention(q, k, v, mask, m, l, o):
     return new_m, new_l, new_o
 
 
-def ring_attention(q, k, v, axis_name: str):
+def ring_attention(q, k, v, axis_name: str, n_rep: int = 1):
     """Causal ring attention body; call inside shard_map over ``axis_name``.
 
-    q/k/v: (B, S_local, H, D) — the local sequence shard, already
-    RoPE-rotated with *global* positions.  Returns (B, S_local, H, D).
+    q: (B, S_local, H, D); k/v: (B, S_local, H/n_rep, D) — the local
+    sequence shard, already RoPE-rotated with *global* positions.
+    Returns (B, S_local, H, D).
+
+    ``n_rep > 1`` is GQA: KV blocks rotate around the ring at KV-head
+    width (1/n_rep of the bytes) and are repeated to full head count
+    locally, right before each block's score computation — ICI traffic
+    stays at the minimum the model defines.
     """
     p_size = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
@@ -89,7 +95,9 @@ def ring_attention(q, k, v, axis_name: str):
             full_mask,
             jnp.where(src_idx == my_idx, local_causal, empty_mask),
         )
-        m, l, o = _block_attention(qf, k_blk, v_blk, mask, m, l, o)
+        k_full = jnp.repeat(k_blk, n_rep, axis=2) if n_rep > 1 else k_blk
+        v_full = jnp.repeat(v_blk, n_rep, axis=2) if n_rep > 1 else v_blk
+        m, l, o = _block_attention(qf, k_full, v_full, mask, m, l, o)
         # Rotate KV around the ring (neighbour hop on ICI).
         perm = [(i, (i + 1) % p_size) for i in range(p_size)]
         k_blk = lax.ppermute(k_blk, axis_name, perm)
