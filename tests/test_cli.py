@@ -23,8 +23,8 @@ from tpuslo.cli import (
 
 
 class TestDispatcher:
-    def test_all_twelve_binaries_registered(self):
-        assert len(BINARIES) == 12
+    def test_all_binaries_registered(self):
+        assert len(BINARIES) == 13  # 11 reference parity + slicecorr + train
 
     def test_unknown_binary_exit_2(self):
         assert dispatch(["warpdrive"]) == 2
@@ -235,3 +235,20 @@ class TestAgentCLI:
         out = capsys.readouterr().out
         assert "probe-smoke:" in out
         assert rc in (0, 1)  # depends on host privileges
+
+
+class TestTrain:
+    def test_train_cli_steps_and_summary(self, capsys):
+        # conftest already forces the 8-device CPU mesh.
+        rc = dispatch(
+            ["train", "--steps", "2", "--batch", "4", "--seq-len", "32"]
+        )
+        assert rc == 0
+        lines = [
+            json.loads(line)
+            for line in capsys.readouterr().out.strip().splitlines()
+        ]
+        assert [l["step"] for l in lines[:-1]] == [1, 2]
+        summary = lines[-1]
+        assert summary["done"] and summary["last_step"] == 2
+        assert summary["mesh"]["dp"] * summary["mesh"]["fsdp"] * summary["mesh"]["tp"] == 8

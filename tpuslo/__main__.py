@@ -1,4 +1,4 @@
-"""Dispatcher for the twelve toolkit binaries: ``python -m tpuslo <name>``."""
+"""Dispatcher for the toolkit binaries: ``python -m tpuslo <name>``."""
 
 from __future__ import annotations
 
@@ -18,9 +18,11 @@ BINARIES = {
     "sloctl": "tpuslo.cli.sloctl",
     "loadgen": "tpuslo.cli.loadgen",
     "schemavalidate": "tpuslo.cli.schemavalidate",
-    # TPU-native addition (no reference counterpart): multi-host
-    # collective straggler attribution across a pod slice.
+    # TPU-native additions (no reference counterpart): multi-host
+    # collective straggler attribution across a pod slice, and demo
+    # training runs with checkpoint/resume.
     "slicecorr": "tpuslo.cli.slicecorr",
+    "train": "tpuslo.cli.train",
 }
 
 

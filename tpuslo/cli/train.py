@@ -1,0 +1,118 @@
+"""``tpuslo train`` — demo training runs with checkpoint/resume.
+
+The operator surface over :mod:`tpuslo.models.trainer`: a deterministic
+training session on the demo Llama family, sharded over whatever mesh
+the host offers (dp/fsdp/tp factorization via
+:func:`tpuslo.parallel.mesh.plan_for_devices`), emitting one JSON line
+per step so the agent/collector pipeline can observe loss progress and
+checkpoint-write stalls (the ``host_offload_stall`` fault domain).
+
+No reference counterpart — the reference has no training path at all
+(SURVEY.md §2.5); this exists because the TPU rebuild's observed
+workload includes training-shaped jobs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tpuslo train",
+        description="deterministic demo training with checkpoint/resume",
+    )
+    parser.add_argument(
+        "--model",
+        choices=("llama_tiny", "llama32_1b", "llama32_3b"),
+        default="llama_tiny",
+    )
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--seq-len", type=int, default=128)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--corpus", help="text file, one document per line")
+    parser.add_argument("--checkpoint-dir", default="")
+    parser.add_argument("--ckpt-every", type=int, default=0)
+    parser.add_argument(
+        "--cpu-mesh",
+        type=int,
+        default=0,
+        metavar="N",
+        help="force an N-device virtual CPU mesh (tests/CI)",
+    )
+    args = parser.parse_args(argv)
+
+    if args.cpu_mesh:
+        import os
+        import re
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        flag = f"--xla_force_host_platform_device_count={args.cpu_mesh}"
+        if "xla_force_host_platform_device_count" in flags:
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", flag, flags
+            )
+        else:
+            flags = f"{flags} {flag}".strip()
+        os.environ["XLA_FLAGS"] = flags
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    from tpuslo.models import llama
+    from tpuslo.models.trainer import TrainerConfig, train
+    from tpuslo.parallel.mesh import make_mesh, plan_for_devices
+
+    cfg = getattr(llama, args.model)(max_seq_len=max(args.seq_len, 64))
+    plan = plan_for_devices(len(jax.devices()))
+    mesh = make_mesh(plan)
+
+    if args.corpus:
+        with open(args.corpus, encoding="utf-8") as fh:
+            texts = [line.rstrip("\n") for line in fh if line.strip()]
+    else:
+        texts = [
+            f"synthetic document {i}: the five boxing wizards jump quickly"
+            for i in range(200)
+        ]
+
+    tcfg = TrainerConfig(
+        steps=args.steps,
+        batch=args.batch,
+        seq_len=args.seq_len,
+        seed=args.seed,
+        ckpt_every=args.ckpt_every,
+    )
+    result = train(
+        cfg, mesh, texts, tcfg,
+        checkpoint_dir=args.checkpoint_dir or None,
+    )
+    for i, loss in enumerate(result["losses"]):
+        print(
+            json.dumps(
+                {"step": result["first_step"] + i + 1, "loss": round(loss, 6)}
+            )
+        )
+    print(
+        json.dumps(
+            {
+                "done": True,
+                "model": args.model,
+                "mesh": {"dp": plan.dp, "fsdp": plan.fsdp, "tp": plan.tp},
+                "first_step": result["first_step"],
+                "last_step": result["last_step"],
+                "final_loss": round(result["losses"][-1], 6)
+                if result["losses"]
+                else None,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
