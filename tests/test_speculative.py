@@ -1,0 +1,132 @@
+"""Speculative decoding: exactness vs plain greedy, acceptance stats."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpuslo.models.llama import (
+    init_kv_cache,
+    init_params,
+    llama_tiny,
+    prefill,
+    decode_step,
+    verify_chunk,
+)
+from tpuslo.models.serve import ServeEngine
+from tpuslo.models.speculative import SpeculativeEngine
+
+
+def _cfg():
+    return llama_tiny(max_seq_len=256)
+
+
+def _plain_greedy(engine: ServeEngine, prompt: str, n: int) -> list[int]:
+    return [
+        e.token_id
+        for e in engine.generate(prompt, max_new_tokens=n, stop_at_eos=False)
+    ]
+
+
+def test_verify_chunk_matches_stepwise_decode():
+    """Scoring K tokens in one pass == K sequential decode steps."""
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, cfg.vocab_size)
+
+    logits0, cache_a = prefill(params, prompt, init_kv_cache(cfg, 1), cfg)
+    _, cache_b = prefill(params, prompt, init_kv_cache(cfg, 1), cfg)
+    chunk = jax.random.randint(jax.random.PRNGKey(2), (1, 4), 0, cfg.vocab_size)
+
+    # Reference: sequential decode steps.
+    step_logits = []
+    for i in range(4):
+        logits, cache_a = decode_step(params, chunk[:, i], cache_a, cfg)
+        step_logits.append(logits)
+    ref = jnp.stack(step_logits, axis=1)  # (1, 4, V)
+
+    got, cache_b = verify_chunk(params, chunk, cache_b, cfg)
+    err = float(jnp.max(jnp.abs(ref - got)))
+    assert err < 5e-2, f"verify_chunk diverges from stepwise decode: {err}"
+    assert int(cache_b["length"]) == 8  # caller advances length
+
+
+@pytest.mark.parametrize("k", [1, 3, 4])
+def test_speculative_equals_plain_greedy_self_draft(k):
+    """Draft == target: every proposal accepted, output identical."""
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    target = ServeEngine(cfg=cfg, params=params)
+    draft = ServeEngine(cfg=cfg, params=params)
+    spec = SpeculativeEngine(target, draft, k=k)
+
+    want = _plain_greedy(ServeEngine(cfg=cfg, params=params), "speculate!", 24)
+    got = spec.generate("speculate!", max_new_tokens=24, stop_at_eos=False)
+    assert got == want
+    assert spec.acceptance_rate > 0.9  # self-draft: near-total acceptance
+
+
+def test_speculative_equals_plain_greedy_different_draft():
+    """Weak draft (different seed): rejections happen, output STILL
+    identical to the target-only stream — the exactness guarantee."""
+    cfg = _cfg()
+    t_params = init_params(jax.random.PRNGKey(0), cfg)
+    d_params = init_params(jax.random.PRNGKey(99), cfg)
+    target = ServeEngine(cfg=cfg, params=t_params)
+    draft = ServeEngine(cfg=cfg, params=d_params)
+    spec = SpeculativeEngine(target, draft, k=4)
+
+    want = _plain_greedy(ServeEngine(cfg=cfg, params=t_params), "exactness", 24)
+    got = spec.generate("exactness", max_new_tokens=24, stop_at_eos=False)
+    assert got == want
+    # An unrelated draft should see some rejections.
+    assert spec.acceptance_rate < 1.0
+    assert spec.rounds > 0
+
+
+def test_speculative_respects_max_tokens():
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    spec = SpeculativeEngine(
+        ServeEngine(cfg=cfg, params=params),
+        ServeEngine(cfg=cfg, params=params),
+        k=4,
+    )
+    out = spec.generate("bounded", max_new_tokens=7, stop_at_eos=False)
+    assert len(out) == 7
+
+
+def test_bad_k_rejected():
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    engine = ServeEngine(cfg=cfg, params=params)
+    with pytest.raises(ValueError, match="k must be"):
+        SpeculativeEngine(engine, engine, k=0)
+
+
+def test_speculative_tail_matches_stepwise_near_capacity():
+    """With fewer than k+1 free KV slots, the plain-decode tail keeps
+    the output identical to stepwise target-only greedy decoding."""
+    from tpuslo.models.serve import encode_bytes
+
+    cfg = llama_tiny(max_seq_len=64)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = "y" * 56  # 57 ids after BOS: 7 free slots, k+1 = 5
+
+    # Stepwise reference: prefill then greedy decode to the last slot.
+    ref_engine = ServeEngine(cfg=cfg, params=params)
+    ids = encode_bytes(prompt, ref_engine._max_prompt())
+    logits, cache = ref_engine.prefill_ids(ids)
+    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    ref = [int(tok[0])]
+    while int(cache["length"]) < cfg.max_seq_len - 1:
+        logits, cache = decode_step(params, tok, cache, cfg)
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        ref.append(int(tok[0]))
+
+    spec = SpeculativeEngine(
+        ServeEngine(cfg=cfg, params=params),
+        ServeEngine(cfg=cfg, params=params),
+        k=4,
+    )
+    got = spec.generate(prompt, max_new_tokens=len(ref), stop_at_eos=False)
+    assert got == ref

@@ -1,6 +1,6 @@
 """Demo model families: TPU-first JAX Llama + Mixtral (observed workloads)."""
 
-from tpuslo.models import checkpoint, data, longserve, mixtral, trainer
+from tpuslo.models import checkpoint, data, longserve, mixtral, speculative, trainer
 from tpuslo.models.llama import (
     LlamaConfig,
     decode_step,
@@ -24,6 +24,7 @@ __all__ = [
     "data",
     "longserve",
     "mixtral",
+    "speculative",
     "trainer",
     "init_params_quantized",
     "quantize_params",

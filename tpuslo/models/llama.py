@@ -538,24 +538,24 @@ def decode_step(
     ``cache["length"]`` may be a scalar (all rows at the same position
     — single-request serving) or a ``(B,)`` vector (batched serving at
     per-request cache lengths).  The branch is on the static ndim, so
-    each shape compiles its own specialized program.
+    each shape compiles its own specialized program.  The scalar path
+    is :func:`verify_chunk` at K=1 (one shared layer body).
     """
     B = token.shape[0]
     pos = cache["length"]
-    per_row = pos.ndim == 1
+    if pos.ndim == 0:
+        logits, cache = verify_chunk(params, token[:, None], cache, cfg)
+        return logits[:, 0], {**cache, "length": pos + 1}
     pos_vec = jnp.broadcast_to(pos, (B,))
     positions = pos_vec[:, None]
     h = _embed_lookup(params, token[:, None], cfg.dtype)
     cos, sin = rope_frequencies(cfg, positions)
     H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     # Causal visibility over the preallocated cache: positions <= pos.
-    if per_row:
-        visible = (
-            jnp.arange(cfg.max_seq_len)[None, :] <= pos_vec[:, None]
-        )[:, None, :]  # (B, 1, T)
-        rows = jnp.arange(B)
-    else:
-        visible = (jnp.arange(cfg.max_seq_len) <= pos)[None, :]
+    visible = (
+        jnp.arange(cfg.max_seq_len)[None, :] <= pos_vec[:, None]
+    )[:, None, :]  # (B, 1, T)
+    rows = jnp.arange(B)
 
     def scan_step(h, inputs):
         layer, k_cache, v_cache = inputs
@@ -565,13 +565,9 @@ def decode_step(
         v = _matmul(x, layer["wv"]).reshape(B, 1, KV, HD)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        if per_row:
-            # Per-row write positions: scatter one slot per row.
-            k_cache = k_cache.at[rows, pos_vec].set(k[:, 0])
-            v_cache = v_cache.at[rows, pos_vec].set(v[:, 0])
-        else:
-            k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
-            v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+        # Per-row write positions: scatter one slot per row.
+        k_cache = k_cache.at[rows, pos_vec].set(k[:, 0])
+        v_cache = v_cache.at[rows, pos_vec].set(v[:, 0])
         attn = attention(q, k_cache, v_cache, visible, H // KV)
         h = h + _matmul(attn.reshape(B, 1, H * HD), layer["wo"])
         x = rms_norm(h, layer["mlp_norm"], cfg.norm_eps)
@@ -584,6 +580,61 @@ def decode_step(
     cache = {"k": ks, "v": vs, "length": pos + 1}
     h = rms_norm(h, params["final_norm"], cfg.norm_eps)
     logits = _matmul(h[:, 0, :], params["output"]).astype(jnp.float32)
+    return logits, cache
+
+
+def verify_chunk(
+    params: PyTree,
+    tokens: jax.Array,
+    cache: PyTree,
+    cfg: LlamaConfig,
+) -> tuple[jax.Array, PyTree]:
+    """Score K tokens in one pass: logits at every position.
+
+    tokens: (B, K) — the next K sequence tokens starting at the cache's
+    current (scalar) ``length``.  Returns (logits (B, K, vocab), cache)
+    with the chunk's KV written at positions ``length .. length+K-1``
+    and ``length`` left UNCHANGED: the caller decides how many
+    positions were accepted (speculative decoding) and advances
+    ``cache["length"]`` itself.  KV slots past the accepted length are
+    invisible under the decode mask and get overwritten as generation
+    proceeds — the same stale-slot discipline as bucketed prefill.
+    """
+    B, K = tokens.shape
+    start = cache["length"]  # scalar: verify runs on the shared path
+    positions = jnp.broadcast_to(start + jnp.arange(K), (B, K))
+    h = _embed_lookup(params, tokens, cfg.dtype)
+    cos, sin = rope_frequencies(cfg, positions)
+    H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    # Causal over the whole cache: key j visible to chunk row i iff
+    # j <= start + i.  (K, S_max), shared across batch rows.
+    key_pos = jnp.arange(cfg.max_seq_len)
+    mask = key_pos[None, :] <= (start + jnp.arange(K))[:, None]
+
+    def scan_step(h, inputs):
+        layer, k_cache, v_cache = inputs
+        x = rms_norm(h, layer["attn_norm"], cfg.norm_eps)
+        q = _matmul(x, layer["wq"]).reshape(B, K, H, HD)
+        k = _matmul(x, layer["wk"]).reshape(B, K, KV, HD)
+        v = _matmul(x, layer["wv"]).reshape(B, K, KV, HD)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        k_cache = lax.dynamic_update_slice(k_cache, k, (0, start, 0, 0))
+        v_cache = lax.dynamic_update_slice(v_cache, v, (0, start, 0, 0))
+        attn = attention(q, k_cache, v_cache, mask, H // KV)
+        h = h + _matmul(attn.reshape(B, K, H * HD), layer["wo"])
+        x = rms_norm(h, layer["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(_matmul(x, layer["w1"]).astype(jnp.float32))
+        up = _matmul(x, layer["w3"]).astype(jnp.float32)
+        h = h + _matmul((gate * up).astype(cfg.dtype), layer["w2"])
+        return h, (k_cache, v_cache)
+
+    h, (ks, vs) = lax.scan(
+        scan_step, h, (params["layers"], cache["k"], cache["v"])
+    )
+    cache = {"k": ks, "v": vs, "length": start}
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps)
+    logits = _matmul(h, params["output"]).astype(jnp.float32)
     return logits, cache
 
 

@@ -361,6 +361,22 @@ class ServeEngine:
             toks, token = next_toks, next_token
         return outputs[:n_real]
 
+    def prefill_ids(self, ids: list[int]):
+        """Bucketed single-row prefill of already-encoded ids.
+
+        Returns (logits (1, vocab), cache with ``length=len(ids)``).
+        The shared prompt-ingestion path for :meth:`generate` and the
+        speculative engine.
+        """
+        bucket = _bucket(len(ids), self.prefill_buckets)
+        padded = ids + [0] * (bucket - len(ids))
+        tokens = jnp.asarray([padded], jnp.int32)
+        cache = self._new_cache(1)
+        return self._prefill(
+            self.params, tokens, cache,
+            true_length=jnp.asarray(len(ids), jnp.int32),
+        )
+
     def generate(
         self,
         prompt: str,
@@ -375,21 +391,16 @@ class ServeEngine:
         ids = encode_bytes(prompt, self._max_prompt())
         decode_fn, chunk, cap_tokens = self._decode_budget(len(ids))
         max_new_tokens = max(1, min(max_new_tokens, cap_tokens))
-        bucket = _bucket(len(ids), self.prefill_buckets)
-        padded = ids + [0] * (bucket - len(ids))
-        tokens = jnp.asarray([padded], jnp.int32)
 
         compile_start = time.perf_counter()
-        cache = self._new_cache(1)
-        logits, cache = self._prefill(
-            self.params, tokens, cache, true_length=jnp.asarray(len(ids), jnp.int32)
-        )
+        logits, cache = self.prefill_ids(ids)
         logits.block_until_ready()
         prefill_ms = (time.perf_counter() - compile_start) * 1000.0
         if prefill_ms > 100.0:
             # A slow first hit on a bucket is (almost always) a compile.
             self.compile_events.append(
-                {"bucket": bucket, "compile_ms": prefill_ms}
+                {"bucket": _bucket(len(ids), self.prefill_buckets),
+                 "compile_ms": prefill_ms}
             )
 
         token = jnp.argmax(logits, axis=-1).astype(jnp.int32)

@@ -1,0 +1,164 @@
+"""Speculative decoding: a draft model proposes, the target verifies.
+
+Greedy speculative decoding with the exactness guarantee: the emitted
+token stream is **identical** to decoding the target model alone —
+speculation only changes how many target forward passes are needed, not
+the output.  Each round:
+
+1. the draft greedily proposes ``k`` tokens (one chunked decode on the
+   small model);
+2. the target scores the chunk ``[current, d1..dk]`` in ONE forward
+   (:func:`tpuslo.models.llama.verify_chunk` — K+1 positions, MXU-batched,
+   the same FLOPs as one prefill row instead of k+1 decode steps);
+3. the longest prefix of draft tokens matching the target's greedy
+   choices is accepted, plus the target's own next token — so every
+   round emits between 1 and k+1 tokens for a single target pass.
+
+Rollback is O(1): rejected positions' KV stays in the cache but
+``length`` is set to the accepted frontier, making stale slots
+invisible (the bucketed-prefill discipline).  Decode on the target is
+weight-bandwidth-bound, so with an acceptance rate ``a`` the expected
+speedup is ``(1 + a·k') / (cost_verify/cost_decode + k·cost_draft/...)``
+≈ the accepted-tokens-per-round for a draft ≪ target.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from tpuslo.models.llama import decode_chunk, decode_step, verify_chunk
+from tpuslo.models.serve import EOS, ServeEngine, encode_bytes
+
+
+class SpeculativeEngine:
+    """Greedy speculative serving over two :class:`ServeEngine`s.
+
+    ``target`` and ``draft`` must share the tokenizer (they do — the
+    byte tokenizer is model-independent); the draft should be a much
+    smaller config for real speedup, but any pair is *correct*.
+    """
+
+    def __init__(self, target: ServeEngine, draft: ServeEngine, k: int = 4):
+        if k < 1:
+            raise ValueError("k must be >= 1")
+        self.target = target
+        self.draft = draft
+        self.k = k
+        # Donate the caches (as ServeEngine does): the previous cache
+        # reference is dropped after every call, and un-donated decode
+        # would copy both full (L, B, S_max, KV, HD) cache pairs per
+        # round.
+        self._verify = jax.jit(
+            partial(verify_chunk, cfg=target.cfg), donate_argnums=(2,)
+        )
+        self._draft_chunk = jax.jit(
+            partial(decode_chunk, cfg=draft.cfg, num_tokens=k),
+            donate_argnums=(2,),
+        )
+        self._draft_step = jax.jit(
+            partial(decode_step, cfg=draft.cfg), donate_argnums=(2,)
+        )
+        self._target_step = jax.jit(
+            partial(decode_step, cfg=target.cfg), donate_argnums=(2,)
+        )
+        self.rounds = 0
+        self.accepted_draft_tokens = 0
+        self.emitted_tokens = 0
+
+    @property
+    def acceptance_rate(self) -> float:
+        """Accepted draft tokens per proposed draft token."""
+        proposed = self.rounds * self.k
+        return self.accepted_draft_tokens / proposed if proposed else 0.0
+
+    def generate(
+        self, prompt: str, max_new_tokens: int = 32, stop_at_eos: bool = True
+    ) -> list[int]:
+        """Greedy generation; returns the emitted token ids.
+
+        Exactness guarantee (tested): the stream equals greedy
+        decoding of the *target model alone* — prefill then stepwise
+        argmax — for as many tokens as the KV budget allows.  Near
+        capacity the engine falls back to plain single-token target
+        steps, so the guarantee holds all the way to the last free
+        cache slot.
+        """
+        t, d = self.target, self.draft
+        max_prompt = min(t._max_prompt(), d._max_prompt())
+        ids = encode_bytes(prompt, max_prompt)
+
+        logits_t, cache_t = t.prefill_ids(ids)
+        _logits_d, cache_d = d.prefill_ids(ids)
+
+        current = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)  # (1,)
+        out = [int(current[0])]
+        if stop_at_eos and out[-1] == EOS:
+            return out
+
+        # Budget: each round writes k+1 target KV slots from `start`.
+        limit = min(t.cfg.max_seq_len, d.cfg.max_seq_len) - (self.k + 1)
+        while len(out) < max_new_tokens and int(cache_t["length"]) < limit:
+            draft_toks, _last, cache_d = self._draft_chunk(
+                d.params, current, cache_d
+            )
+            chunk = jnp.concatenate([current[:, None], draft_toks], axis=1)
+            logits, cache_t = self._verify(t.params, chunk, cache_t)
+            target_pred = jnp.argmax(logits, axis=-1)  # (1, k+1)
+
+            # Longest accepted prefix: draft_toks[i] must equal the
+            # target's greedy choice after chunk position i.
+            matches = jax.device_get(
+                draft_toks[0] == target_pred[0, : self.k]
+            )
+            n = 0
+            while n < self.k and matches[n]:
+                n += 1
+            emitted = jax.device_get(
+                jnp.concatenate([draft_toks[0, :n], target_pred[0, n : n + 1]])
+            ).tolist()
+
+            start = int(cache_t["length"])
+            cache_t["length"] = jnp.asarray(start + n + 1, jnp.int32)
+            # Draft wrote KV for [current, d1..d_{k-1}] at
+            # start..start+k-1.  On a full accept (n == k) the frontier
+            # includes d_k, whose KV the draft never produced — one
+            # extra draft decode step fills position start+k (leaving a
+            # hole would make every later draft proposal attend to
+            # zeros there).
+            if n == self.k:
+                cache_d["length"] = jnp.asarray(start + self.k, jnp.int32)
+                _, cache_d = self._draft_step(
+                    d.params, draft_toks[:, -1], cache_d
+                )
+            else:
+                cache_d["length"] = jnp.asarray(start + n + 1, jnp.int32)
+
+            self.rounds += 1
+            self.accepted_draft_tokens += n
+            current = jnp.asarray([emitted[-1]], jnp.int32)
+            for token in emitted:
+                out.append(int(token))
+                if stop_at_eos and token == EOS:
+                    self.emitted_tokens = len(out)
+                    return out[:max_new_tokens]
+                if len(out) >= max_new_tokens:
+                    break
+
+        # Tail: fewer than k+1 free KV slots left — finish with plain
+        # single-token target decode so near-capacity requests still
+        # match the target-only greedy stream instead of silently
+        # stopping early.
+        while (
+            len(out) < max_new_tokens
+            and int(cache_t["length"]) < t.cfg.max_seq_len - 1
+        ):
+            logits, cache_t = self._target_step(t.params, current, cache_t)
+            current = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            out.append(int(current[0]))
+            if stop_at_eos and out[-1] == EOS:
+                break
+        self.emitted_tokens = len(out)
+        return out[:max_new_tokens]
