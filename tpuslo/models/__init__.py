@@ -1,6 +1,14 @@
 """Demo model families: TPU-first JAX Llama + Mixtral (observed workloads)."""
 
-from tpuslo.models import checkpoint, data, longserve, mixtral, speculative, trainer
+from tpuslo.models import (
+    batching,
+    checkpoint,
+    data,
+    longserve,
+    mixtral,
+    speculative,
+    trainer,
+)
 from tpuslo.models.llama import (
     LlamaConfig,
     decode_step,
@@ -20,6 +28,7 @@ from tpuslo.models.serve import ServeEngine, TokenEvent, decode_bytes, encode_by
 from tpuslo.models.train import build_sharded_train_step, make_optimizer, train_step
 
 __all__ = [
+    "batching",
     "checkpoint",
     "data",
     "longserve",

@@ -99,8 +99,13 @@ class SpeculativeEngine:
             return out
 
         # Budget: each round writes k+1 target KV slots from `start`.
+        # The frontier is tracked host-side (always a host-set value
+        # after prefill), so rounds never block on a device read of
+        # `length` — through a remote-chip tunnel every avoided sync is
+        # a network round-trip.
+        start = len(ids)
         limit = min(t.cfg.max_seq_len, d.cfg.max_seq_len) - (self.k + 1)
-        while len(out) < max_new_tokens and int(cache_t["length"]) < limit:
+        while len(out) < max_new_tokens and start < limit:
             draft_toks, _last, cache_d = self._draft_chunk(
                 d.params, current, cache_d
             )
@@ -108,19 +113,15 @@ class SpeculativeEngine:
             logits, cache_t = self._verify(t.params, chunk, cache_t)
             target_pred = jnp.argmax(logits, axis=-1)  # (1, k+1)
 
+            # One fused device read per round: proposals + target picks.
             # Longest accepted prefix: draft_toks[i] must equal the
             # target's greedy choice after chunk position i.
-            matches = jax.device_get(
-                draft_toks[0] == target_pred[0, : self.k]
-            )
+            drafts, preds = jax.device_get((draft_toks[0], target_pred[0]))
             n = 0
-            while n < self.k and matches[n]:
+            while n < self.k and drafts[n] == preds[n]:
                 n += 1
-            emitted = jax.device_get(
-                jnp.concatenate([draft_toks[0, :n], target_pred[0, n : n + 1]])
-            ).tolist()
+            emitted = [int(x) for x in drafts[:n]] + [int(preds[n])]
 
-            start = int(cache_t["length"])
             cache_t["length"] = jnp.asarray(start + n + 1, jnp.int32)
             # Draft wrote KV for [current, d1..d_{k-1}] at
             # start..start+k-1.  On a full accept (n == k) the frontier
@@ -138,6 +139,7 @@ class SpeculativeEngine:
 
             self.rounds += 1
             self.accepted_draft_tokens += n
+            start += n + 1
             current = jnp.asarray([emitted[-1]], jnp.int32)
             for token in emitted:
                 out.append(int(token))
@@ -153,10 +155,11 @@ class SpeculativeEngine:
         # stopping early.
         while (
             len(out) < max_new_tokens
-            and int(cache_t["length"]) < t.cfg.max_seq_len - 1
+            and start < t.cfg.max_seq_len - 1
         ):
             logits, cache_t = self._target_step(t.params, current, cache_t)
             current = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            start += 1
             out.append(int(current[0]))
             if stop_at_eos and out[-1] == EOS:
                 break
