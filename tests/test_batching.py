@@ -88,3 +88,28 @@ def test_budget_capped_near_capacity():
     assert len(results[rid]) == 128 - 121 - 1  # capped to avail
     # Parity with the single-request engine, which applies the same cap.
     assert results[rid] == _plain(params, long_prompt, 50)
+
+
+def test_mid_range_budget_matches_single_request_cap():
+    """The chunk-rounded budget cap must match ServeEngine exactly."""
+    cfg = llama_tiny(max_seq_len=512)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    engine = ContinuousBatchingEngine(cfg=cfg, params=params, max_slots=1)
+    rid = engine.submit("x", max_new_tokens=500, stop_at_eos=False)
+    results = engine.run()
+    plain_engine = ServeEngine(cfg=cfg, params=params)
+    plain = [
+        e.token_id
+        for e in plain_engine.generate("x", max_new_tokens=500, stop_at_eos=False)
+    ]
+    assert len(results[rid]) == len(plain)
+    assert results[rid] == plain
+
+
+def test_instant_requests_never_dispatch_decode():
+    params = init_params(jax.random.PRNGKey(0), _cfg())
+    engine = ContinuousBatchingEngine(cfg=_cfg(), params=params, max_slots=2)
+    ids = [engine.submit(f"i{n}", max_new_tokens=1, stop_at_eos=False) for n in range(3)]
+    results = engine.run()
+    assert engine.steps == 0  # all three completed at admission
+    assert set(results) == set(ids)

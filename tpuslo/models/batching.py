@@ -93,9 +93,6 @@ class ContinuousBatchingEngine:
         if max_slots < 1:
             raise ValueError("max_slots must be >= 1")
         self.max_slots = max_slots
-        self.prefill_buckets = tuple(
-            b for b in prefill_buckets if b <= self.cfg.max_seq_len
-        ) or (self.cfg.max_seq_len,)
 
         # Prompt ingestion delegates to a ServeEngine sharing the same
         # params: one bucketed-prefill pipeline (and one set of compile
@@ -135,11 +132,12 @@ class ContinuousBatchingEngine:
 
     def _admit(self, slot: int, req: _Request) -> None:
         ids = encode_bytes(req.prompt, self._ingest._max_prompt())
-        # Cap to remaining KV capacity — past it the per-row scatter
-        # would drop out-of-bounds writes and decode against a wrong
-        # context silently (ServeEngine._decode_budget's warning).
-        avail = self.cfg.max_seq_len - len(ids) - 1
-        req.max_new_tokens = max(1, min(req.max_new_tokens, avail))
+        # The exact budget single-request serving applies (chunk-rounded
+        # KV cap): the parity contract requires identical truncation,
+        # and past raw capacity the per-row scatter would drop
+        # out-of-bounds writes and silently decode on a wrong context.
+        _fn, _chunk, cap_tokens = self._ingest._decode_budget(len(ids))
+        req.max_new_tokens = max(1, min(req.max_new_tokens, cap_tokens))
         logits, row_cache = self._ingest.prefill_ids(ids)
         first = int(jnp.argmax(logits, axis=-1)[0])
         req.tokens.append(first)
@@ -147,20 +145,21 @@ class ContinuousBatchingEngine:
             req.done = True
             self.results[req.request_id] = req.tokens
             return
-        # Row cache length is a scalar; the batched cache wants it as
-        # the slot's vector entry.
-        row = {
-            "k": row_cache["k"],
-            "v": row_cache["v"],
-            "length": row_cache["length"],
-        }
-        self._cache = self._inject(self._cache, row, jnp.asarray(slot, jnp.int32))
+        # _inject_row turns the row's scalar length into the slot's
+        # vector entry.
+        self._cache = self._inject(
+            self._cache, row_cache, jnp.asarray(slot, jnp.int32)
+        )
         self._tokens = self._tokens.at[slot].set(first)
         self._slots[slot] = req
 
     def _fill_slots(self) -> None:
         for slot in range(self.max_slots):
-            if self._slots[slot] is None and self._queue:
+            # Keep admitting into this slot until something occupies it
+            # (instantly-completing requests leave it free) or the
+            # queue drains — afterwards the queue is empty unless every
+            # slot is busy.
+            while self._slots[slot] is None and self._queue:
                 self._admit(slot, self._queue.pop(0))
 
     # -- stepping --------------------------------------------------------
@@ -171,7 +170,10 @@ class ContinuousBatchingEngine:
         Returns True while any work remains.
         """
         self._fill_slots()
-        if not any(self._slots) and not self._queue:
+        if not any(self._slots):
+            # _fill_slots drains the queue unless slots are busy, so no
+            # active slot means no work at all — never dispatch a
+            # decode whose outputs nobody reads.
             return False
         logits, self._cache = self._step(self.params, self._tokens, self._cache)
         next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)

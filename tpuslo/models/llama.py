@@ -583,6 +583,57 @@ def decode_step(
     return logits, cache
 
 
+@dataclass(frozen=True)
+class SamplingConfig:
+    """Decode-time sampling; hashable so it can be a jit-static arg.
+
+    ``temperature == 0`` is greedy argmax (the default everywhere).
+    ``top_k``/``top_p`` restrict the candidate set before the
+    categorical draw; both compose (k first, then nucleus).
+    """
+
+    temperature: float = 0.0
+    top_k: int = 0  # 0 = no top-k restriction
+    top_p: float = 1.0
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature <= 0.0
+
+
+GREEDY = SamplingConfig()
+
+
+def sample_from_logits(
+    logits: jax.Array, key: jax.Array, sampling: SamplingConfig
+) -> jax.Array:
+    """Draw token ids (B,) from logits (B, V) under ``sampling``.
+
+    Pure and jittable with ``sampling`` static; the greedy case never
+    touches the RNG, so greedy paths stay bit-identical to argmax.
+    """
+    if sampling.greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / jnp.maximum(sampling.temperature, 1e-6)
+    if sampling.top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[..., -sampling.top_k, None]
+        logits = jnp.where(logits >= kth, logits, -jnp.inf)
+    if sampling.top_p < 1.0:
+        sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Keep tokens whose cumulative mass (exclusive of self) < p;
+        # the first token survives even at top_p=0 (otherwise every
+        # logit masks to -inf and the draw degenerates).
+        keep = (cum - probs) < sampling.top_p
+        keep = keep.at[..., 0].set(True)
+        min_kept = jnp.min(
+            jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits >= min_kept, logits, -jnp.inf)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
 def verify_chunk(
     params: PyTree,
     tokens: jax.Array,
@@ -644,8 +695,10 @@ def decode_chunk(
     cache: PyTree,
     cfg: LlamaConfig,
     num_tokens: int,
+    sampling: SamplingConfig = GREEDY,
+    rng: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array, PyTree]:
-    """Greedy-decode ``num_tokens`` tokens in ONE device call.
+    """Decode ``num_tokens`` tokens in ONE device call.
 
     Dispatch latency (host→device→host per step) dominates small-model
     decode — through a remote-chip tunnel each one-token step is a full
@@ -654,16 +707,23 @@ def decode_chunk(
     (B, num_tokens), last token (B,), cache); the last token comes out
     of the jit so chaining chunks needs no host-side slicing (eager
     ``toks[:, -1]`` would compile a handful of tiny one-off programs).
+
+    ``sampling`` (static) selects greedy argmax or
+    temperature/top-k/top-p sampling; the RNG key folds per step so a
+    chunk draws independent samples.
     """
+    if not sampling.greedy and rng is None:
+        raise ValueError("non-greedy sampling needs an rng key")
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
 
-    def step(carry, _):
-        tok, kv = carry
+    def step(carry, i):
+        tok, kv, key = carry
         logits, kv = decode_step(params, tok, kv, cfg)
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return (nxt, kv), nxt
+        nxt = sample_from_logits(logits, jax.random.fold_in(key, i), sampling)
+        return (nxt, kv, key), nxt
 
-    (last, cache), toks = lax.scan(
-        step, (token, cache), None, length=num_tokens
+    (last, cache, _), toks = lax.scan(
+        step, (token, cache, rng), jnp.arange(num_tokens)
     )
     return toks.swapaxes(0, 1), last, cache
 

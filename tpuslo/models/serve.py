@@ -21,7 +21,9 @@ import jax
 import jax.numpy as jnp
 
 from tpuslo.models.llama import (
+    GREEDY,
     LlamaConfig,
+    SamplingConfig,
     decode_chunk,
     init_kv_cache,
     init_params,
@@ -29,6 +31,7 @@ from tpuslo.models.llama import (
     llama_tiny,
     prefill,
     quantize_params,
+    sample_from_logits,
 )
 
 BOS = 256
@@ -199,6 +202,7 @@ class ServeEngine:
                 decode_chunk, cfg=self.cfg, num_tokens=self.decode_chunk_size
             ),
             donate_argnums=(2,),
+            static_argnames=("sampling",),
         )
         # Tail path for prompts that leave less than one chunk of KV
         # budget: single-token chunks use every remaining slot instead
@@ -223,6 +227,7 @@ class ServeEngine:
             self._decode_one = jax.jit(
                 partial(decode_chunk, cfg=self.cfg, num_tokens=1),
                 donate_argnums=(2,),
+                static_argnames=("sampling",),
             )
             tokens = jnp.zeros((1,), jnp.int32)
             cache = self._new_cache(1)
@@ -382,8 +387,18 @@ class ServeEngine:
         prompt: str,
         max_new_tokens: int = 32,
         stop_at_eos: bool = True,
+        sampling: SamplingConfig | None = None,
+        seed: int = 0,
     ) -> Iterator[TokenEvent]:
-        """Greedy decode; yields one TokenEvent per generated token."""
+        """Decode one TokenEvent per generated token.
+
+        Greedy by default; pass ``sampling=SamplingConfig(temperature=…,
+        top_k=…, top_p=…)`` for stochastic decoding (``seed`` makes the
+        stream reproducible).  The first token comes from the prefill
+        logits and follows the same sampling rule.
+        """
+        sampling = sampling or GREEDY
+        rng = jax.random.PRNGKey(seed)
         request_start = time.perf_counter()
         # Cap to the largest bucket so oversize prompts truncate instead
         # of slipping through unpadded (which would compile per-length —
@@ -403,13 +418,25 @@ class ServeEngine:
                  "compile_ms": prefill_ms}
             )
 
-        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        token = sample_from_logits(
+            logits, jax.random.fold_in(rng, 0), sampling
+        )
         # Dispatch the first decode chunk before the host-side read of
         # the first token: jax dispatch is async, so the device starts
         # decoding while TTFT is being measured and streamed.
+        # Greedy keeps rng=None so the call signature (and jit cache
+        # entry) is identical to warmup's — a non-None key here would
+        # silently retrace on the first real request.
+        def chunk_rng(i):
+            return None if sampling.greedy else jax.random.fold_in(rng, i)
+
         toks = last = None
+        chunk_idx = 1
         if max_new_tokens > 1:
-            toks, last, cache = decode_fn(self.params, token, cache)
+            toks, last, cache = decode_fn(
+                self.params, token, cache,
+                sampling=sampling, rng=chunk_rng(chunk_idx),
+            )
         ttft_ms = (time.perf_counter() - request_start) * 1000.0
         first = int(token[0])
         yield TokenEvent(first, 0, ttft_ms=ttft_ms)
@@ -424,8 +451,10 @@ class ServeEngine:
             # host streams, hiding the transfer round-trip.
             next_toks = next_last = None
             if idx + chunk < max_new_tokens:
+                chunk_idx += 1
                 next_toks, next_last, cache = decode_fn(
-                    self.params, last, cache
+                    self.params, last, cache,
+                    sampling=sampling, rng=chunk_rng(chunk_idx),
                 )
             for value in jax.device_get(toks[0]).tolist():
                 yield TokenEvent(int(value), idx)
