@@ -113,3 +113,20 @@ def test_instant_requests_never_dispatch_decode():
     results = engine.run()
     assert engine.steps == 0  # all three completed at admission
     assert set(results) == set(ids)
+
+
+def test_stats_reflect_lifecycle():
+    params = init_params(jax.random.PRNGKey(0), _cfg())
+    engine = ContinuousBatchingEngine(cfg=_cfg(), params=params, max_slots=2)
+    assert engine.stats() == {
+        "active_slots": 0, "max_slots": 2, "occupancy": 0.0,
+        "queued": 0, "steps": 0, "completed": 0,
+    }
+    engine.submit("a", max_new_tokens=6, stop_at_eos=False)
+    engine.submit("b", max_new_tokens=6, stop_at_eos=False)
+    engine.step()
+    mid = engine.stats()
+    assert mid["active_slots"] == 2 and mid["occupancy"] == 1.0
+    engine.run()
+    done = engine.stats()
+    assert done["active_slots"] == 0 and done["completed"] == 2

@@ -193,6 +193,20 @@ class ContinuousBatchingEngine:
                 self._slots[slot] = None
         return bool(self._queue) or any(self._slots)
 
+    def stats(self) -> dict[str, int | float]:
+        """Scheduler telemetry for the SLO pipeline: slot occupancy is
+        the serving-efficiency SLI (empty lanes waste the
+        weight-bandwidth-bound decode dispatch)."""
+        active = sum(1 for s in self._slots if s is not None)
+        return {
+            "active_slots": active,
+            "max_slots": self.max_slots,
+            "occupancy": active / self.max_slots,
+            "queued": len(self._queue),
+            "steps": self.steps,
+            "completed": len(self.results),
+        }
+
     def run(self) -> dict[int, list[int]]:
         """Drive until every submitted request completes; returns all
         finished results (cumulative across calls).
