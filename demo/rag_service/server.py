@@ -15,7 +15,13 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from prometheus_client import generate_latest
 from prometheus_client.exposition import CONTENT_TYPE_LATEST
 
-from demo.rag_service.service import PROFILES, JaxBackend, RagService, StubBackend
+from demo.rag_service.service import (
+    PROFILES,
+    JaxBackend,
+    JaxBatchedBackend,
+    RagService,
+    StubBackend,
+)
 
 
 def make_handler(service: RagService):
@@ -103,12 +109,18 @@ def serve(service: RagService, port: int, host: str = "0.0.0.0") -> ThreadingHTT
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="rag-service", description=__doc__)
     parser.add_argument("--port", type=int, default=18080)
-    parser.add_argument("--backend", default="stub", choices=["stub", "jax"])
+    parser.add_argument(
+        "--backend", default="stub", choices=["stub", "jax", "jax_batched"]
+    )
     parser.add_argument("--seed", type=int, default=42)
     parser.add_argument("--node", default="tpu-vm-0")
     args = parser.parse_args(argv)
 
-    backend = JaxBackend() if args.backend == "jax" else StubBackend()
+    backend = {
+        "jax": JaxBackend,
+        "jax_batched": JaxBatchedBackend,
+        "stub": StubBackend,
+    }[args.backend]()
     service = RagService(backend=backend, seed=args.seed, node=args.node)
     server = serve(service, args.port)
     print(

@@ -109,6 +109,49 @@ class StubBackend:
             time.sleep(cadence_ms / 1000.0)
 
 
+def _serve_env_config():
+    """(cfg, mesh, quantize) from the TPUSLO_SERVE_* env knobs.
+
+    Shared by every JAX-backed demo backend so the knobs mean the same
+    thing everywhere.
+    """
+    import os
+
+    mesh = None
+    cfg = None
+    tp = int(os.environ.get("TPUSLO_SERVE_TP", "0") or 0)
+    if tp > 1:
+        # Tensor-parallel serving over tp local devices (v5e-8 hosts
+        # run tp=8 for 70B-class models).  ServeEngine additionally
+        # validates that tp divides the config's head counts.
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        devices = jax.devices()
+        if len(devices) < tp:
+            raise ValueError(
+                f"TPUSLO_SERVE_TP={tp} but only {len(devices)} "
+                "devices are visible"
+            )
+        mesh = Mesh(np.array(devices[:tp]), ("tp",))
+    model = os.environ.get("TPUSLO_SERVE_MODEL", "")
+    if model:
+        from tpuslo.models import llama
+
+        valid = (
+            "llama_tiny", "llama32_1b", "llama32_3b",
+            "llama3_8b", "llama3_70b",
+        )
+        if model not in valid:
+            raise ValueError(
+                f"TPUSLO_SERVE_MODEL={model!r}: expected one of {valid}"
+            )
+        cfg = getattr(llama, model)()
+    quantize = os.environ.get("TPUSLO_SERVE_INT8", "") == "1"
+    return cfg, mesh, quantize
+
+
 class JaxBackend:
     """Real JAX Llama decode via :class:`tpuslo.models.serve.ServeEngine`."""
 
@@ -116,43 +159,9 @@ class JaxBackend:
 
     def __init__(self, engine=None):
         if engine is None:
-            import os
-
             from tpuslo.models.serve import ServeEngine
 
-            mesh = None
-            cfg = None
-            tp = int(os.environ.get("TPUSLO_SERVE_TP", "0") or 0)
-            if tp > 1:
-                # Tensor-parallel serving over tp local devices (v5e-8
-                # hosts run tp=8 for 70B-class models).  ServeEngine
-                # additionally validates that tp divides the config's
-                # head counts.
-                import jax
-                import numpy as np
-                from jax.sharding import Mesh
-
-                devices = jax.devices()
-                if len(devices) < tp:
-                    raise ValueError(
-                        f"TPUSLO_SERVE_TP={tp} but only {len(devices)} "
-                        "devices are visible"
-                    )
-                mesh = Mesh(np.array(devices[:tp]), ("tp",))
-            model = os.environ.get("TPUSLO_SERVE_MODEL", "")
-            if model:
-                from tpuslo.models import llama
-
-                valid = (
-                    "llama_tiny", "llama32_1b", "llama32_3b",
-                    "llama3_8b", "llama3_70b",
-                )
-                if model not in valid:
-                    raise ValueError(
-                        f"TPUSLO_SERVE_MODEL={model!r}: expected one of {valid}"
-                    )
-                cfg = getattr(llama, model)()
-            quantize = os.environ.get("TPUSLO_SERVE_INT8", "") == "1"
+            cfg, mesh, quantize = _serve_env_config()
             engine = ServeEngine(cfg=cfg, mesh=mesh, quantize=quantize)
             engine.warmup()
         self.engine = engine
@@ -163,6 +172,64 @@ class JaxBackend:
         del warmup_ms, cadence_ms  # real compute sets the pace
         for event in self.engine.generate(prompt, max_new_tokens=max_new_tokens):
             yield f"tok{event.token_id}"
+
+
+class JaxBatchedBackend:
+    """Continuous-batching JAX backend: concurrent requests share one
+    slot pool (:class:`tpuslo.models.batching.ContinuousBatchingEngine`).
+
+    Handler threads cooperate on one lock: whoever holds it advances
+    the whole batch one step, so simultaneous requests ride the same
+    weight-bandwidth-bound decode dispatches.  Tokens stream once the
+    request completes (batched decode has no per-token stream point).
+    """
+
+    name = "jax_batched"
+
+    def __init__(self, engine=None, max_slots: int = 4):
+        if engine is None:
+            from tpuslo.models.batching import ContinuousBatchingEngine
+
+            cfg, mesh, quantize = _serve_env_config()
+            if mesh is not None:
+                raise ValueError(
+                    "TPUSLO_SERVE_TP is not supported by jax_batched yet; "
+                    "use --backend jax for tensor-parallel serving"
+                )
+            engine = ContinuousBatchingEngine(
+                cfg=cfg, max_slots=max_slots, quantize=quantize
+            )
+            # Front-load the prefill-bucket and per-row decode compiles
+            # (JaxBackend's warmup() equivalent).
+            engine.submit("warmup", max_new_tokens=2, stop_at_eos=False)
+            engine.run()
+            engine.results.clear()
+        self.engine = engine
+        self._lock = threading.Lock()
+
+    def generate(
+        self, prompt: str, max_new_tokens: int, warmup_ms: float, cadence_ms: float
+    ) -> Iterator[str]:
+        del warmup_ms, cadence_ms  # real compute sets the pace
+        with self._lock:
+            rid = self.engine.submit(
+                prompt, max_new_tokens=max_new_tokens, stop_at_eos=True
+            )
+        while True:
+            with self._lock:
+                if rid in self.engine.results:
+                    tokens = self.engine.results.pop(rid)
+                    break
+                if not self.engine.pending(rid):
+                    # Another thread's step() raised mid-admission and
+                    # dropped our request: surface it, don't spin.
+                    raise RuntimeError(
+                        f"request {rid} lost by the batching engine "
+                        "(admission failure in a concurrent step?)"
+                    )
+                self.engine.step()
+        for token in tokens:
+            yield f"tok{token}"
 
 
 class DemoMetrics:

@@ -169,3 +169,54 @@ class TestGraftEntry:
         ge = importlib.import_module("__graft_entry__")
         ge.dryrun_multichip(8)
         assert "ok on 8 devices" in capsys.readouterr().out
+
+
+def test_jax_batched_backend_concurrent_requests():
+    """Concurrent handlers share the slot pool; every request finishes
+    and the lock discipline never deadlocks."""
+    import threading
+
+    from demo.rag_service.service import JaxBatchedBackend, RagService
+    from tpuslo.models.batching import ContinuousBatchingEngine
+    from tpuslo.models.llama import init_params, llama_tiny
+
+    import jax
+
+    cfg = llama_tiny(max_seq_len=128)
+    engine = ContinuousBatchingEngine(
+        cfg=cfg, params=init_params(jax.random.PRNGKey(0), cfg), max_slots=2
+    )
+    backend = JaxBatchedBackend(engine=engine)
+    service = RagService(backend=backend, seed=1)
+
+    outputs: dict[int, list] = {}
+
+    def drive(i):
+        outputs[i] = list(service.chat(f"query {i}", profile="chat_short"))
+
+    errors: list[BaseException] = []
+
+    def safe_drive(i):
+        try:
+            drive(i)
+        except BaseException as exc:  # noqa: BLE001 - surfaced below
+            errors.append(exc)
+
+    threads = [
+        threading.Thread(target=safe_drive, args=(i,), daemon=True)
+        for i in range(3)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        # Daemon + liveness check: a lock-discipline regression fails
+        # the test instead of hanging pytest at interpreter exit.
+        assert not t.is_alive(), "batched backend deadlocked"
+    assert not errors, errors
+    assert len(outputs) == 3
+    for i, events in outputs.items():
+        kinds = [e.get("type") for e in events]
+        assert "token" in kinds and kinds[-1] == "summary", i
+        summary = events[-1]
+        assert summary["backend"] == "jax_batched"

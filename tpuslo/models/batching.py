@@ -81,15 +81,18 @@ class ContinuousBatchingEngine:
         max_slots: int = 4,
         rng_seed: int = 0,
         prefill_buckets: tuple[int, ...] = (32, 64, 128, 256),
+        quantize: bool = False,
     ):
-        from tpuslo.models.llama import init_params
+        from tpuslo.models.llama import init_params, init_params_quantized
 
         self.cfg = cfg or llama_tiny(max_seq_len=512)
-        self.params = (
-            params
-            if params is not None
-            else init_params(jax.random.PRNGKey(rng_seed), self.cfg)
-        )
+        if params is None:
+            params = (
+                init_params_quantized(jax.random.PRNGKey(rng_seed), self.cfg)
+                if quantize
+                else init_params(jax.random.PRNGKey(rng_seed), self.cfg)
+            )
+        self.params = params
         if max_slots < 1:
             raise ValueError("max_slots must be >= 1")
         self.max_slots = max_slots
@@ -192,6 +195,12 @@ class ContinuousBatchingEngine:
                 self.results[req.request_id] = req.tokens
                 self._slots[slot] = None
         return bool(self._queue) or any(self._slots)
+
+    def pending(self, request_id: int) -> bool:
+        """True while the request is queued or occupying a slot."""
+        return any(r.request_id == request_id for r in self._queue) or any(
+            r is not None and r.request_id == request_id for r in self._slots
+        )
 
     def stats(self) -> dict[str, int | float]:
         """Scheduler telemetry for the SLO pipeline: slot occupancy is
