@@ -10,11 +10,9 @@ import argparse
 import json
 import sys
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
 
-from prometheus_client import generate_latest
-from prometheus_client.exposition import CONTENT_TYPE_LATEST
-
+from demo.common import DemoHTTPHandler, serve_threaded
 from demo.rag_service.service import (
     PROFILES,
     JaxBackend,
@@ -23,44 +21,29 @@ from demo.rag_service.service import (
     StubBackend,
 )
 
+DEFAULT_CORPUS = str(Path(__file__).resolve().parent / "fixtures/corpus.json")
+
 
 def make_handler(service: RagService):
-    class Handler(BaseHTTPRequestHandler):
-        protocol_version = "HTTP/1.1"
-
-        def log_message(self, *args):
-            pass
-
-        def _json(self, code: int, payload) -> None:
-            body = json.dumps(payload).encode()
-            self.send_response(code)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-
+    class Handler(DemoHTTPHandler):
         def do_GET(self):
             if self.path.startswith("/metrics"):
-                body = generate_latest(service.metrics.registry)
-                self.send_response(200)
-                self.send_header("Content-Type", CONTENT_TYPE_LATEST)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self.send_metrics(service.metrics.registry)
             elif self.path in ("/healthz", "/readyz"):
-                self._json(200, {"status": "ok", "backend": service.backend.name})
+                self.send_json(
+                    200, {"status": "ok", "backend": service.backend.name}
+                )
             elif self.path.startswith("/spans"):
-                self._json(200, {"spans": service.recorder.recent()})
+                self.send_json(200, {"spans": service.recorder.recent()})
             else:
-                self._json(404, {"error": "not found"})
+                self.send_json(404, {"error": "not found"})
 
         def do_POST(self):
             if self.path != "/chat":
-                self._json(404, {"error": "not found"})
+                self.send_json(404, {"error": "not found"})
                 return
             try:
-                length = int(self.headers.get("Content-Length", 0))
-                payload = json.loads(self.rfile.read(length) or b"{}")
+                payload = self.read_json_body()
                 query = payload.get("query", "")
                 profile = payload.get("profile", "rag_medium")
                 stream = bool(payload.get("stream", True))
@@ -68,7 +51,7 @@ def make_handler(service: RagService):
                     raise ValueError(f"unknown profile {profile!r}")
             except (ValueError, json.JSONDecodeError) as exc:
                 service.metrics.errors.inc()
-                self._json(400, {"error": str(exc)})
+                self.send_json(400, {"error": str(exc)})
                 return
 
             events = service.chat(query, profile)
@@ -79,7 +62,7 @@ def make_handler(service: RagService):
                         tokens.append(event["token"])
                     else:
                         summary = event
-                self._json(200, {"tokens": tokens, **(summary or {})})
+                self.send_json(200, {"tokens": tokens, **(summary or {})})
                 return
 
             self.send_response(200)
@@ -99,11 +82,8 @@ def make_handler(service: RagService):
     return Handler
 
 
-def serve(service: RagService, port: int, host: str = "0.0.0.0") -> ThreadingHTTPServer:
-    server = ThreadingHTTPServer((host, port), make_handler(service))
-    thread = threading.Thread(target=server.serve_forever, daemon=True)
-    thread.start()
-    return server
+def serve(service: RagService, port: int, host: str = "0.0.0.0"):
+    return serve_threaded(make_handler(service), port, host)
 
 
 def main(argv=None) -> int:
@@ -114,6 +94,13 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--seed", type=int, default=42)
     parser.add_argument("--node", default="tpu-vm-0")
+    parser.add_argument(
+        "--retrieval",
+        default="simulated",
+        choices=["simulated", "vectordb"],
+        help="vectordb = measured in-process search over --corpus",
+    )
+    parser.add_argument("--corpus", default=DEFAULT_CORPUS)
     args = parser.parse_args(argv)
 
     backend = {
@@ -121,7 +108,20 @@ def main(argv=None) -> int:
         "jax_batched": JaxBatchedBackend,
         "stub": StubBackend,
     }[args.backend]()
-    service = RagService(backend=backend, seed=args.seed, node=args.node)
+    vector_store = None
+    if args.retrieval == "vectordb":
+        from demo.vectordb import VectorStore
+
+        vector_store = VectorStore.from_corpus(args.corpus)
+        # Compile the (bucket, k) search fn now so the first request's
+        # measured vectordb_ms is search time, not jit time.
+        vector_store.search("warmup", k=3)
+    service = RagService(
+        backend=backend,
+        seed=args.seed,
+        node=args.node,
+        vector_store=vector_store,
+    )
     server = serve(service, args.port)
     print(
         f"rag-service: backend={backend.name} listening on :{args.port} "

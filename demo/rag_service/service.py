@@ -180,8 +180,10 @@ class JaxBatchedBackend:
 
     Handler threads cooperate on one lock: whoever holds it advances
     the whole batch one step, so simultaneous requests ride the same
-    weight-bandwidth-bound decode dispatches.  Tokens stream once the
-    request completes (batched decode has no per-token stream point).
+    weight-bandwidth-bound decode dispatches.  Tokens stream per decode
+    step via ``partial_tokens`` so TTFT and tokens/s reflect the real
+    decode cadence (a completion-time burst would make the tokens/s SLI
+    meaningless).
     """
 
     name = "jax_batched"
@@ -215,21 +217,39 @@ class JaxBatchedBackend:
             rid = self.engine.submit(
                 prompt, max_new_tokens=max_new_tokens, stop_at_eos=True
             )
-        while True:
+        emitted = 0
+        try:
+            while True:
+                with self._lock:
+                    done = rid in self.engine.results
+                    tokens = self.engine.partial_tokens(rid)
+                    if tokens is None:
+                        # Another thread's step() raised mid-admission
+                        # and dropped our request: surface it, don't
+                        # spin.
+                        raise RuntimeError(
+                            f"request {rid} lost by the batching engine "
+                            "(admission failure in a concurrent step?)"
+                        )
+                    if done:
+                        self.engine.results.pop(rid)
+                    elif len(tokens) == emitted:
+                        self.engine.step()
+                        tokens = self.engine.partial_tokens(rid) or tokens
+                        done = rid in self.engine.results
+                        if done:
+                            self.engine.results.pop(rid)
+                for token in tokens[emitted:]:
+                    yield f"tok{token}"
+                emitted = len(tokens)
+                if done:
+                    return
+        finally:
+            # Client disconnects close this generator mid-stream
+            # (GeneratorExit at a yield): release the slot/queue entry
+            # and any unowned result so ghosts don't accumulate.
             with self._lock:
-                if rid in self.engine.results:
-                    tokens = self.engine.results.pop(rid)
-                    break
-                if not self.engine.pending(rid):
-                    # Another thread's step() raised mid-admission and
-                    # dropped our request: surface it, don't spin.
-                    raise RuntimeError(
-                        f"request {rid} lost by the batching engine "
-                        "(admission failure in a concurrent step?)"
-                    )
-                self.engine.step()
-        for token in tokens:
-            yield f"tok{token}"
+                self.engine.cancel(rid)
 
 
 class DemoMetrics:
@@ -286,6 +306,7 @@ class RagService:
         service_name: str = "rag-service",
         node: str = "tpu-vm-0",
         sleep=time.sleep,
+        vector_store=None,
     ):
         self.backend = backend or StubBackend()
         self.metrics = metrics or DemoMetrics()
@@ -295,25 +316,34 @@ class RagService:
         self.service_name = service_name
         self.node = node
         self._sleep = sleep
+        # Optional demo.vectordb.VectorStore: the vectordb retrieval
+        # phase becomes a measured search instead of a seeded sleep.
+        self.vector_store = vector_store
 
-    def _simulate_retrieval(self, profile: str, request_seed: int) -> RetrievalBreakdown:
-        """Seeded DNS/network/vectordb sleeps.
+    def _simulate_retrieval(
+        self, profile: str, request_seed: int, query: str = ""
+    ) -> tuple[RetrievalBreakdown, list]:
+        """Seeded DNS/network sleeps; vectordb phase is a seeded sleep
+        by default, or a *measured* search when a vector store is
+        attached.
 
-        Reference: ``demo/rag-service/main.go:641-671``.
+        Reference: ``demo/rag-service/main.go:641-671`` (all-simulated).
         """
         dns_ms, net_ms, vdb_ms, *_ = PROFILES[profile]
         rng = random.Random(self.seed ^ request_seed)
         jitter = lambda v: v * rng.uniform(0.8, 1.2)  # noqa: E731
-        breakdown = RetrievalBreakdown(
-            dns_ms=jitter(dns_ms),
-            network_ms=jitter(net_ms),
-            vectordb_ms=jitter(vdb_ms),
-        )
-        self._sleep(
-            (breakdown.dns_ms + breakdown.network_ms + breakdown.vectordb_ms)
-            / 1000.0
-        )
-        return breakdown
+        dns = jitter(dns_ms)
+        net = jitter(net_ms)
+        hits: list = []
+        if self.vector_store is not None and len(self.vector_store):
+            self._sleep((dns + net) / 1000.0)
+            t0 = time.perf_counter()
+            hits = self.vector_store.search(query or "llm slo", k=3)
+            vdb = (time.perf_counter() - t0) * 1000.0
+        else:
+            vdb = jitter(vdb_ms)
+            self._sleep((dns + net + vdb) / 1000.0)
+        return RetrievalBreakdown(dns_ms=dns, network_ms=net, vectordb_ms=vdb), hits
 
     def chat(self, query: str, profile: str = "rag_medium") -> Iterator[dict]:
         """Run one chat request; yields NDJSON-able event dicts.
@@ -337,13 +367,17 @@ class RagService:
             "chat.retrieval", trace_id, uuid.uuid4().hex[:16],
             parent_span_id=root.span_id, start_ns=time.time_ns(),
         )
-        retrieval = self._simulate_retrieval(profile, request_seed)
+        retrieval, hits = self._simulate_retrieval(profile, request_seed, query)
         retr_span.end_ns = time.time_ns()
         retr_span.attributes = {
             semconv.ATTR_RETRIEVAL_DNS_MS: retrieval.dns_ms,
             semconv.ATTR_RETRIEVAL_NETWORK_MS: retrieval.network_ms,
             semconv.ATTR_RETRIEVAL_VECTORDB_MS: retrieval.vectordb_ms,
         }
+        if hits:
+            retr_span.attributes["retrieval.doc_ids"] = ",".join(
+                h.doc_id for h in hits
+            )
 
         # Self-correlation demo: join a synthetic DNS kernel signal onto
         # the retrieval span (reference ``main.go:408-441``).
@@ -425,6 +459,11 @@ class RagService:
                 "dns_ms": round(retrieval.dns_ms, 3),
                 "network_ms": round(retrieval.network_ms, 3),
                 "vectordb_ms": round(retrieval.vectordb_ms, 3),
+                **(
+                    {"doc_ids": [h.doc_id for h in hits]}
+                    if hits
+                    else {}
+                ),
             },
             "correlation": {
                 k: round(v, 4)

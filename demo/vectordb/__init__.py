@@ -1,0 +1,6 @@
+"""Real vector store for the RAG demo (reference placeholder:
+``/root/reference/demo/vectordb/README.md``)."""
+
+from demo.vectordb.store import SearchHit, VectorStore, embed_text, embed_texts
+
+__all__ = ["SearchHit", "VectorStore", "embed_text", "embed_texts"]

@@ -1,0 +1,3 @@
+from demo.vectordb.server import main
+
+raise SystemExit(main())

@@ -130,3 +130,55 @@ def test_stats_reflect_lifecycle():
     engine.run()
     done = engine.stats()
     assert done["active_slots"] == 0 and done["completed"] == 2
+
+
+def test_partial_tokens_streams_per_step():
+    """partial_tokens exposes tokens as decode advances (the streaming
+    seam the demo backend uses for honest TTFT/tokens-per-sec SLIs)."""
+    params = init_params(jax.random.PRNGKey(0), _cfg())
+    engine = ContinuousBatchingEngine(cfg=_cfg(), params=params, max_slots=2)
+    rid = engine.submit("stream me", max_new_tokens=6, stop_at_eos=False)
+    assert engine.partial_tokens(rid) == []  # queued
+    seen = 0
+    grew = 0
+    while rid not in engine.results:
+        engine.step()
+        now = len(engine.partial_tokens(rid))
+        if now > seen:
+            grew += 1
+        assert now >= seen
+        seen = now
+    assert grew >= 2  # tokens appeared incrementally, not in one burst
+    assert engine.partial_tokens(rid) == engine.results[rid]
+    assert engine.partial_tokens(99999) is None
+
+
+def test_cancel_releases_queue_slot_and_results():
+    params = init_params(jax.random.PRNGKey(0), _cfg())
+    engine = ContinuousBatchingEngine(cfg=_cfg(), params=params, max_slots=1)
+    keep = engine.submit("keep", max_new_tokens=4, stop_at_eos=False)
+    drop = engine.submit("drop", max_new_tokens=4, stop_at_eos=False)  # queued
+    engine.step()
+    engine.cancel(drop)
+    assert engine.partial_tokens(drop) is None
+    engine.run()
+    assert keep in engine.results and drop not in engine.results
+    # cancel after completion is idempotent and clears the result
+    engine.cancel(keep)
+    assert keep not in engine.results
+
+
+def test_backend_generator_close_cancels_request():
+    """A client disconnect (generator close) must not leave a ghost
+    request decoding or an unowned entry in results."""
+    from demo.rag_service.service import JaxBatchedBackend
+
+    params = init_params(jax.random.PRNGKey(0), _cfg())
+    engine = ContinuousBatchingEngine(cfg=_cfg(), params=params, max_slots=2)
+    backend = JaxBatchedBackend(engine=engine)
+    gen = backend.generate("disconnect me", 8, 0, 0)
+    next(gen)  # request admitted and producing
+    gen.close()  # BrokenPipeError path in server.py
+    assert not any(engine._slots), "cancelled request still holds a slot"
+    engine.run()
+    assert engine.results == {}, "ghost result left behind after disconnect"

@@ -120,3 +120,26 @@ def test_helm_test_hook_references_resolve():
     registry = (REPO / "tpuslo/metrics/registry.py").read_text()
     assert metric in registry, f"hook greps unknown metric {metric}"
     assert (chart / ".helmignore").is_file()
+
+
+def test_rag_demo_manifests():
+    """Demo workload ships deployable manifests (reference
+    demo/rag-service/k8s)."""
+    k8s = REPO / "demo/rag_service/k8s"
+    (dep,) = _load_all(k8s / "deployment.yaml")
+    (svc,) = _load_all(k8s / "service.yaml")
+    (kus,) = _load_all(k8s / "kustomization.yaml")
+    assert dep["kind"] == "Deployment"
+    container = dep["spec"]["template"]["spec"]["containers"][0]
+    port = container["ports"][0]["containerPort"]
+    assert port == 18080
+    assert svc["spec"]["ports"][0]["targetPort"] == "http"
+    assert dep["spec"]["selector"]["matchLabels"] == svc["spec"]["selector"]
+    assert set(kus["resources"]) == {"deployment.yaml", "service.yaml"}
+    # backend choices in the manifest must exist in the server CLI
+    server = (REPO / "demo/rag_service/server.py").read_text()
+    backend = next(
+        e["value"] for e in container["env"] if e["name"] == "LLM_BACKEND"
+    )
+    assert f'"{backend}"' in server
+    assert (REPO / "demo/rag_service/Dockerfile").is_file()

@@ -1,0 +1,181 @@
+"""Vector store demo component (reference placeholder: demo/vectordb)."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import numpy as np
+
+from demo.vectordb import VectorStore, embed_text, embed_texts
+from demo.vectordb.store import _bucket
+
+
+def test_embed_deterministic_and_normalized():
+    a = embed_text("time to first token")
+    b = embed_text("time to first token")
+    np.testing.assert_array_equal(a, b)
+    assert abs(float(np.linalg.norm(a)) - 1.0) < 1e-5
+    assert embed_texts([]).shape == (0, 256)
+
+
+def test_embed_distinguishes_topics():
+    dns = embed_text("dns resolution latency for the retrieval query")
+    hbm = embed_text("hbm allocation stalls and memory defragmentation")
+    assert float(dns @ hbm) < 0.9
+
+
+def test_bucket_rounding():
+    assert _bucket(1) == 8
+    assert _bucket(8) == 8
+    assert _bucket(9) == 16
+    assert _bucket(100) == 128
+
+
+def test_search_ranks_matching_doc_first():
+    store = VectorStore()
+    store.add("dns", "DNS resolution latency adds to time to first token")
+    store.add("hbm", "HBM pressure shows up as allocation stalls")
+    store.add("ici", "ICI link retries slow down collectives in the slice")
+    hits = store.search("what causes dns latency in retrieval", k=2)
+    assert len(hits) == 2
+    assert hits[0].doc_id == "dns"
+    assert hits[0].score >= hits[1].score
+
+
+def test_search_empty_store_and_k_clamping():
+    store = VectorStore()
+    assert store.search("anything") == []
+    store.add("only", "a single document about tpu serving")
+    hits = store.search("tpu serving", k=5)
+    assert [h.doc_id for h in hits] == ["only"]
+
+
+def test_search_batch_and_bucket_growth():
+    store = VectorStore()
+    store.add_many([(f"d{i}", f"document number {i} about topic {i}") for i in range(20)])
+    assert len(store) == 20
+    results = store.search_batch(["document number 7", "document number 13"], k=3)
+    assert len(results) == 2
+    assert results[0][0].doc_id == "d7"
+    assert results[1][0].doc_id == "d13"
+
+
+def test_from_corpus_fixture():
+    store = VectorStore.from_corpus("demo/rag_service/fixtures/corpus.json")
+    assert len(store) >= 10
+    hits = store.search("time to first token latency", k=3)
+    assert hits[0].doc_id == "doc-ttft"
+
+
+def test_http_server_roundtrip():
+    from demo.vectordb.server import serve
+
+    store = VectorStore()
+    store.add("doc-a", "tcp retransmits inflate network latency")
+    server = serve(store, port=0, host="127.0.0.1")
+    port = server.server_address[1]
+    base = f"http://127.0.0.1:{port}"
+    try:
+        with urllib.request.urlopen(f"{base}/healthz", timeout=5) as resp:
+            assert json.load(resp)["docs"] == 1
+
+        req = urllib.request.Request(
+            f"{base}/add",
+            data=json.dumps(
+                {"id": "doc-b", "text": "tls handshake latency spikes"}
+            ).encode(),
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert json.load(resp)["docs"] == 2
+
+        req = urllib.request.Request(
+            f"{base}/search",
+            data=json.dumps({"query": "tls handshake", "k": 1}).encode(),
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            payload = json.load(resp)
+        assert payload["hits"][0]["id"] == "doc-b"
+        assert payload["latency_ms"] >= 0
+
+        with urllib.request.urlopen(f"{base}/metrics", timeout=5) as resp:
+            metrics = resp.read().decode()
+        assert "vectordb_searches_total" in metrics
+    finally:
+        server.shutdown()
+
+
+def test_rag_service_with_vector_store():
+    from demo.rag_service.service import RagService, StubBackend
+
+    store = VectorStore.from_corpus("demo/rag_service/fixtures/corpus.json")
+    service = RagService(
+        backend=StubBackend(), sleep=lambda s: None, vector_store=store
+    )
+    events = list(service.chat("why is time to first token slow", "chat_short"))
+    summary = events[-1]
+    assert summary["type"] == "summary"
+    assert summary["retrieval"]["doc_ids"][0] == "doc-ttft"
+    # vectordb phase is measured, not the seeded sleep value
+    assert summary["retrieval"]["vectordb_ms"] >= 0
+    retr_span = next(
+        s for s in service.recorder.recent() if s["name"] == "chat.retrieval"
+    )
+    assert "retrieval.doc_ids" in retr_span["attributes"]
+
+
+def test_numpy_fallback_matches_jax_path(monkeypatch):
+    """The demo image ships without jax; search must degrade to the
+    numpy exact path with identical ranking."""
+    store = VectorStore.from_corpus("demo/rag_service/fixtures/corpus.json")
+    jax_hits = store.search("time to first token latency", k=3)
+
+    def no_jax(*a, **k):
+        raise ImportError("jax not installed")
+
+    monkeypatch.setattr(VectorStore, "_search_jax", no_jax)
+    np_hits = store.search("time to first token latency", k=3)
+    assert [h.doc_id for h in np_hits] == [h.doc_id for h in jax_hits]
+    np.testing.assert_allclose(
+        [h.score for h in np_hits], [h.score for h in jax_hits], rtol=1e-5
+    )
+
+
+def test_add_after_search_invalidates_matrix_cache():
+    store = VectorStore()
+    store.add("a", "alpha document about tpu scheduling")
+    assert store.search("tpu scheduling", k=1)[0].doc_id == "a"
+    store.add("b", "beta document about dns resolution")
+    assert store.search("dns resolution", k=1)[0].doc_id == "b"
+
+
+def test_http_server_rejects_malformed_search():
+    import urllib.error
+
+    from demo.vectordb.server import serve
+
+    store = VectorStore()
+    store.add("doc-a", "a document")
+    server = serve(store, port=0, host="127.0.0.1")
+    port = server.server_address[1]
+    try:
+        for bad in (
+            {"query": "x", "k": "abc"},
+            {"query": 5},
+            {"query": "x", "k": 0},
+            {},
+        ):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/search",
+                data=json.dumps(bad).encode(),
+                method="POST",
+            )
+            try:
+                urllib.request.urlopen(req, timeout=5)
+                raise AssertionError(f"{bad} should 400")
+            except urllib.error.HTTPError as err:
+                assert err.code == 400, bad
+    finally:
+        server.shutdown()

@@ -196,11 +196,38 @@ class ContinuousBatchingEngine:
                 self._slots[slot] = None
         return bool(self._queue) or any(self._slots)
 
-    def pending(self, request_id: int) -> bool:
-        """True while the request is queued or occupying a slot."""
-        return any(r.request_id == request_id for r in self._queue) or any(
-            r is not None and r.request_id == request_id for r in self._slots
-        )
+    def cancel(self, request_id: int) -> None:
+        """Abandon a request wherever it lives: queue, slot, or results.
+
+        Idempotent.  Streaming handlers call this from a ``finally`` so
+        a client disconnect can't leave a ghost request decoding to its
+        token budget and parking an unowned entry in ``results``.
+        Freeing the slot mid-flight is safe: ``_fill_slots`` re-admits
+        into it and ``_admit`` overwrites the cache rows.
+        """
+        self.results.pop(request_id, None)
+        self._queue = [r for r in self._queue if r.request_id != request_id]
+        for slot, req in enumerate(self._slots):
+            if req is not None and req.request_id == request_id:
+                self._slots[slot] = None
+
+    def partial_tokens(self, request_id: int) -> list[int] | None:
+        """Copy of the tokens produced so far for a request.
+
+        Streaming handlers poll this between step() calls to emit
+        tokens as the batch decodes instead of waiting for completion.
+        Returns ``[]`` while queued, the accumulated tokens while in a
+        slot or finished, ``None`` for an unknown/lost request.
+        """
+        if request_id in self.results:
+            return list(self.results[request_id])
+        for req in self._slots:
+            if req is not None and req.request_id == request_id:
+                return list(req.tokens)
+        for req in self._queue:
+            if req.request_id == request_id:
+                return []
+        return None
 
     def stats(self) -> dict[str, int | float]:
         """Scheduler telemetry for the SLO pipeline: slot occupancy is
