@@ -12,6 +12,7 @@ Reference parity map (``demo/rag-service/main.go``):
 from __future__ import annotations
 
 import json
+import os
 import random
 import threading
 import time
@@ -115,8 +116,6 @@ def _serve_env_config():
     Shared by every JAX-backed demo backend so the knobs mean the same
     thing everywhere.
     """
-    import os
-
     mesh = None
     cfg = None
     tp = int(os.environ.get("TPUSLO_SERVE_TP", "0") or 0)
@@ -170,7 +169,12 @@ class JaxBackend:
         self, prompt: str, max_new_tokens: int, warmup_ms: float, cadence_ms: float
     ) -> Iterator[str]:
         del warmup_ms, cadence_ms  # real compute sets the pace
-        for event in self.engine.generate(prompt, max_new_tokens=max_new_tokens):
+        # Optional shared system prompt rides the KV prefix cache: its
+        # prefill cost is paid once, not per request.
+        prefix = os.environ.get("TPUSLO_SYSTEM_PROMPT") or None
+        for event in self.engine.generate(
+            prompt, max_new_tokens=max_new_tokens, prefix=prefix
+        ):
             yield f"tok{event.token_id}"
 
 

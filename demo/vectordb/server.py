@@ -88,9 +88,16 @@ def make_handler(store: VectorStore, metrics: VectorDBMetrics):
             elif self.path == "/add":
                 doc_id = payload.get("id", "")
                 text = payload.get("text", "")
-                if not doc_id or not text:
+                if (
+                    not isinstance(doc_id, str)
+                    or not isinstance(text, str)
+                    or not doc_id
+                    or not text
+                ):
                     metrics.errors.inc()
-                    self.send_json(400, {"error": "id and text required"})
+                    self.send_json(
+                        400, {"error": "id and text must be non-empty strings"}
+                    )
                     return
                 store.add(doc_id, text)
                 self.send_json(200, {"status": "ok", "docs": len(store)})

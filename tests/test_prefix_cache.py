@@ -1,0 +1,100 @@
+"""Prefix caching: shared-prompt KV reuse must be exact.
+
+The cached-prefix path (prefill prefix once, clone the KV snapshot,
+suffix-only chunked prefill via ``verify_chunk``) must produce the
+same stream as prefilling ``prefix + prompt`` from scratch.
+"""
+
+import jax
+
+from tpuslo.models.llama import init_params, llama_tiny
+from tpuslo.models.serve import ServeEngine
+
+PREFIX = "system: you are a terse tpu slo assistant. answer briefly. "
+
+
+def _engine(max_seq_len=256):
+    cfg = llama_tiny(max_seq_len=max_seq_len)
+    return ServeEngine(cfg=cfg, params=init_params(jax.random.PRNGKey(0), cfg))
+
+
+def _tokens(engine, prompt, **kw):
+    return [
+        e.token_id
+        for e in engine.generate(prompt, max_new_tokens=12, stop_at_eos=False, **kw)
+    ]
+
+
+def test_prefix_path_matches_full_prefill():
+    engine = _engine()
+    user = "what drives ttft?"
+    full = _tokens(engine, PREFIX + user)
+    cached = _tokens(engine, user, prefix=PREFIX)
+    assert cached == full
+    # Second request reuses the snapshot (same result, cache populated).
+    assert PREFIX in engine._prefix_cache
+    assert _tokens(engine, user, prefix=PREFIX) == full
+
+
+def test_prefix_snapshot_survives_donation():
+    """The suffix-prefill jit donates its cache input; generation must
+    clone the snapshot, never consume it."""
+    engine = _engine()
+    a = _tokens(engine, "first request", prefix=PREFIX)
+    b = _tokens(engine, "second request", prefix=PREFIX)
+    assert a != b  # different suffixes, sanity
+    assert _tokens(engine, "first request", prefix=PREFIX) == a
+
+
+def test_prefix_with_empty_suffix():
+    engine = _engine()
+    assert _tokens(engine, "", prefix=PREFIX) == _tokens(engine, PREFIX)
+
+
+def test_prefix_cache_fifo_eviction():
+    engine = _engine()
+    engine.prefix_cache_max = 2
+    for i in range(3):
+        engine.cache_prefix(f"prefix {i} ")
+    assert "prefix 0 " not in engine._prefix_cache
+    assert "prefix 2 " in engine._prefix_cache
+    assert len(engine._prefix_cache) == 2
+
+
+def test_prefix_respects_decode_budget():
+    """Long prefix + oversize suffix must clamp the suffix and the
+    token budget instead of overrunning the KV cache."""
+    engine = _engine(max_seq_len=128)
+    long_prefix = "p" * 100  # 101 ids with BOS
+    events = list(
+        engine.generate(
+            "q" * 50, max_new_tokens=64, stop_at_eos=False, prefix=long_prefix
+        )
+    )
+    entry = engine._prefix_cache[long_prefix]
+    # suffix clamps to max_seq_len - 2 - prefix, budget to what's left
+    room = engine.cfg.max_seq_len - 2 - len(entry.ids)
+    assert room > 0
+    assert 1 <= len(events) <= engine.cfg.max_seq_len - len(entry.ids) - room
+
+
+def test_different_prefixes_do_not_collide():
+    engine = _engine()
+    p1, p2 = "alpha system prompt. ", "beta system prompt. "
+    user = "same user question"
+    out1 = _tokens(engine, user, prefix=p1)
+    out2 = _tokens(engine, user, prefix=p2)
+    assert out1 == _tokens(engine, p1 + user)
+    assert out2 == _tokens(engine, p2 + user)
+
+
+def test_prefix_near_capacity_exact():
+    """Reviewer repro: prefix 101 ids in a 128-slot cache, 20-byte
+    suffix pads to bucket 32 -> 101+32 > 128.  The write must clamp the
+    bucket, not the start; the stream stays exact vs full prefill."""
+    engine = _engine(max_seq_len=128)
+    prefix = "p" * 100
+    user = "q" * 20
+    full = _tokens(engine, prefix + user)
+    cached = _tokens(engine, user, prefix=prefix)
+    assert cached == full

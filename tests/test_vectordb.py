@@ -179,3 +179,28 @@ def test_http_server_rejects_malformed_search():
                 assert err.code == 400, bad
     finally:
         server.shutdown()
+
+
+def test_http_server_rejects_malformed_add():
+    import urllib.error
+
+    from demo.vectordb.server import serve
+
+    store = VectorStore()
+    server = serve(store, port=0, host="127.0.0.1")
+    port = server.server_address[1]
+    try:
+        for bad in ({"id": "x", "text": 123}, {"id": 5, "text": "t"}, {"id": "x"}):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/add",
+                data=json.dumps(bad).encode(),
+                method="POST",
+            )
+            try:
+                urllib.request.urlopen(req, timeout=5)
+                raise AssertionError(f"{bad} should 400")
+            except urllib.error.HTTPError as err:
+                assert err.code == 400, bad
+        assert len(store) == 0
+    finally:
+        server.shutdown()

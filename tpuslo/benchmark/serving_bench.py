@@ -165,6 +165,35 @@ def _decode_only_tps(engine, batch: int, chunk_calls: int = 2) -> float:
     return batch * produced / elapsed
 
 
+def _prefix_lane(engine) -> dict[str, Any]:
+    """TTFT with and without the KV prefix cache.
+
+    A ~200-token shared preamble plus a short user suffix: the cached
+    path prefills only the suffix bucket, so its TTFT drop against the
+    full-prompt prefill is the prefix-cache win.
+    """
+    prefix = "shared system preamble for the slo assistant. " * 5  # ~230B
+    user = "summarize the incident"
+
+    def ttft(prompt: str, **kw) -> float:
+        events = list(
+            engine.generate(prompt, max_new_tokens=8, stop_at_eos=False, **kw)
+        )
+        return events[0].ttft_ms or 0.0
+
+    ttft(prefix + user)  # warm the full-prompt bucket compile
+    full_ms = min(ttft(prefix + user) for _ in range(3))
+    engine.cache_prefix(prefix)
+    ttft(user, prefix=prefix)  # warm the suffix bucket compile
+    cached_ms = min(ttft(user, prefix=prefix) for _ in range(3))
+    return {
+        "prefix_bytes": len(prefix),
+        "ttft_full_ms": round(full_ms, 2),
+        "ttft_cached_prefix_ms": round(cached_ms, 2),
+        "ttft_speedup": round(full_ms / max(cached_ms, 1e-9), 2),
+    }
+
+
 def _signal_ref_from_probe(event: dict[str, Any]):
     """Flatten a probe event's nested ``tpu`` block for the matcher."""
     from datetime import datetime, timezone
@@ -315,6 +344,12 @@ def run(platform: str = "auto", model: str = "auto") -> dict[str, Any]:
     out["ttft_ms"] = round(ttft_ms, 2)
     out["decode_tokens_per_sec"] = round(b1_tps, 2)
     out["mfu_decode_b1"] = mfu(b1_tps)
+
+    # --- prefix caching: TTFT with a cached shared prefix --------------
+    try:
+        out["prefix_cache"] = _prefix_lane(engine)
+    except Exception as exc:  # noqa: BLE001 - additive lane
+        out["prefix_cache"] = {"error": str(exc)[:200]}
 
     # --- batch-8 throughput path ---------------------------------------
     prompts = [f"{prompt} #{i}" for i in range(8)]

@@ -32,10 +32,51 @@ from tpuslo.models.llama import (
     prefill,
     quantize_params,
     sample_from_logits,
+    verify_chunk,
 )
 
 BOS = 256
 EOS = 257
+
+
+def suffix_prefill(params, tokens, cache, true_length, cfg):
+    """Append a (padded) suffix to a cache already holding a prefix.
+
+    The chunked-prefill half of prefix caching: ``verify_chunk`` scores
+    the suffix against the full cache (prefix KV included) and writes
+    its KV at the cache's current scalar ``length``; this wrapper then
+    gathers the next-token logits at the suffix's true last position
+    and advances ``length`` past it.  Pad slots beyond ``true_length``
+    hold stale KV but sit past ``length``, so decode masks them and
+    overwrites them as generation proceeds — the same discipline as
+    bucketed prefill.
+
+    The caller must guarantee ``cache["length"] + tokens.shape[1] <=
+    max_seq_len``: ``verify_chunk`` writes the whole (padded) chunk at
+    the cache's current length, and ``dynamic_update_slice`` would
+    otherwise clamp the write start backwards — silently overwriting
+    the tail of the cached prefix and desyncing KV positions from the
+    mask/RoPE.
+    """
+    logits, cache = verify_chunk(params, tokens, cache, cfg)
+    B = tokens.shape[0]
+    tl = jnp.broadcast_to(jnp.asarray(true_length, jnp.int32), (B,))
+    last = jnp.take_along_axis(logits, (tl - 1)[:, None, None], axis=1)[:, 0]
+    cache = {
+        **cache,
+        "length": cache["length"] + jnp.asarray(true_length, jnp.int32),
+    }
+    return last, cache
+
+
+@dataclass
+class PrefixEntry:
+    """Cached KV snapshot of a shared prompt prefix (system prompt)."""
+
+    text: str
+    ids: list[int]
+    cache: dict  # full KV snapshot; cloned before every use
+    logits: jax.Array  # next-token logits after the prefix alone
 
 
 def serve_param_shardings(params, mesh):
@@ -210,6 +251,15 @@ class ServeEngine:
         # lazily — most traffic never needs it.
         self._decode_one = None
         self.compile_events: list[dict] = []
+        # Prefix caching: KV snapshots of shared prompt prefixes keyed
+        # by text; suffix-only prefill skips recomputing the shared part
+        # (TTFT win grows with prefix length).  Bounded FIFO — each
+        # entry pins a full-size KV snapshot in HBM.
+        self._prefix_cache: dict[str, PrefixEntry] = {}
+        self.prefix_cache_max = 4
+        self._suffix_prefill = jax.jit(
+            partial(suffix_prefill, cfg=self.cfg), donate_argnums=(2,)
+        )
 
 
     def _new_cache(self, batch: int):
@@ -382,6 +432,38 @@ class ServeEngine:
             true_length=jnp.asarray(len(ids), jnp.int32),
         )
 
+    def cache_prefix(self, text: str) -> PrefixEntry:
+        """Prefill a shared prefix once; later requests reuse its KV.
+
+        Classic prefix caching (system prompts, few-shot preambles):
+        the prefix pays one bucketed prefill ever, then each request
+        clones the snapshot and prefills only its suffix against the
+        cached KV, so TTFT scales with the suffix — not the full
+        prompt.  Bounded FIFO eviction (each snapshot pins a full KV
+        buffer in HBM).
+        """
+        entry = self._prefix_cache.get(text)
+        if entry is not None:
+            return entry
+        # Leave room for at least one suffix token + one generated one.
+        ids = encode_bytes(text, self._max_prompt() - 1)
+        logits, cache = self.prefill_ids(ids)
+        logits.block_until_ready()
+        entry = PrefixEntry(text=text, ids=ids, cache=cache, logits=logits)
+        if len(self._prefix_cache) >= self.prefix_cache_max:
+            self._prefix_cache.pop(next(iter(self._prefix_cache)))
+        self._prefix_cache[text] = entry
+        return entry
+
+    def _clone_cache(self, cache):
+        """Fresh device buffers so donated consumers can't free the
+        prefix snapshot."""
+        return {
+            "k": jnp.copy(cache["k"]),
+            "v": jnp.copy(cache["v"]),
+            "length": jnp.copy(cache["length"]),
+        }
+
     def generate(
         self,
         prompt: str,
@@ -389,32 +471,70 @@ class ServeEngine:
         stop_at_eos: bool = True,
         sampling: SamplingConfig | None = None,
         seed: int = 0,
+        prefix: str | None = None,
     ) -> Iterator[TokenEvent]:
         """Decode one TokenEvent per generated token.
 
         Greedy by default; pass ``sampling=SamplingConfig(temperature=…,
         top_k=…, top_p=…)`` for stochastic decoding (``seed`` makes the
         stream reproducible).  The first token comes from the prefill
-        logits and follows the same sampling rule.
+        logits and follows the same sampling rule.  ``prefix`` names a
+        shared prompt prefix served from the KV prefix cache (the
+        effective prompt is ``prefix + prompt``; only the suffix is
+        prefilled per request).
         """
         sampling = sampling or GREEDY
         rng = jax.random.PRNGKey(seed)
         request_start = time.perf_counter()
-        # Cap to the largest bucket so oversize prompts truncate instead
-        # of slipping through unpadded (which would compile per-length —
-        # the exact recompile storm bucketing exists to prevent).
-        ids = encode_bytes(prompt, self._max_prompt())
-        decode_fn, chunk, cap_tokens = self._decode_budget(len(ids))
+        entry = suffix_ids = None
+        if prefix:
+            entry = self.cache_prefix(prefix)
+            room = min(
+                self.prefill_buckets[-1],
+                self.cfg.max_seq_len - 2 - len(entry.ids),
+            )
+            suffix_ids = list(prompt.encode("utf-8"))[: max(0, room)]
+            total_len = len(entry.ids) + len(suffix_ids)
+        else:
+            # Cap to the largest bucket so oversize prompts truncate
+            # instead of slipping through unpadded (which would compile
+            # per-length — the exact recompile storm bucketing exists
+            # to prevent).
+            ids = encode_bytes(prompt, self._max_prompt())
+            total_len = len(ids)
+        decode_fn, chunk, cap_tokens = self._decode_budget(total_len)
         max_new_tokens = max(1, min(max_new_tokens, cap_tokens))
 
         compile_start = time.perf_counter()
-        logits, cache = self.prefill_ids(ids)
+        if entry is not None:
+            cache = self._clone_cache(entry.cache)
+            if suffix_ids:
+                bucket = _bucket(len(suffix_ids), self.prefill_buckets)
+                # Near-capacity prefixes: the padded bucket must not
+                # write past the cache end (dynamic_update_slice would
+                # clamp the start backwards, corrupting prefix KV).
+                # The clamped odd shape compiles at most once per
+                # cached prefix; `room` guarantees it still holds the
+                # whole suffix.
+                bucket = min(bucket, self.cfg.max_seq_len - len(entry.ids))
+                padded = suffix_ids + [0] * (bucket - len(suffix_ids))
+                logits, cache = self._suffix_prefill(
+                    self.params,
+                    jnp.asarray([padded], jnp.int32),
+                    cache,
+                    jnp.asarray(len(suffix_ids), jnp.int32),
+                )
+            else:
+                logits = entry.logits
+        else:
+            logits, cache = self.prefill_ids(ids)
         logits.block_until_ready()
         prefill_ms = (time.perf_counter() - compile_start) * 1000.0
         if prefill_ms > 100.0:
             # A slow first hit on a bucket is (almost always) a compile.
+            size = len(suffix_ids) if entry is not None else total_len
             self.compile_events.append(
-                {"bucket": _bucket(len(ids), self.prefill_buckets),
+                {"bucket": _bucket(max(size, 1), self.prefill_buckets),
                  "compile_ms": prefill_ms}
             )
 
