@@ -24,7 +24,8 @@ from tpuslo.cli import (
 
 class TestDispatcher:
     def test_all_binaries_registered(self):
-        assert len(BINARIES) == 13  # 11 reference parity + slicecorr + train
+        # 11 reference parity + slicecorr + train + icibench
+        assert len(BINARIES) == 14
 
     def test_unknown_binary_exit_2(self):
         assert dispatch(["warpdrive"]) == 2

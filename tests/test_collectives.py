@@ -1,0 +1,107 @@
+"""Active ICI collective prober: measured collectives + probe events."""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpuslo.cli.common import validate_probe
+from tpuslo.parallel.collectives import (
+    CollectiveProbe,
+    _collective_fn,
+    bench_collectives,
+    probes_to_events,
+)
+
+
+def _mesh(n=8):
+    return Mesh(np.array(jax.devices()[:n]), ("probe",))
+
+
+def _sharded_ones(mesh, rows_per_dev=8, cols=8):
+    n = mesh.shape["probe"]
+    x = np.ones((n * rows_per_dev, cols), np.float32)
+    return jax.device_put(x, NamedSharding(mesh, P("probe", None)))
+
+
+def test_collective_fns_compute_correctly():
+    mesh = _mesh()
+    n = mesh.shape["probe"]
+    x = _sharded_ones(mesh)
+
+    summed = _collective_fn("psum", mesh, "probe")(x)
+    np.testing.assert_allclose(np.asarray(summed), n)
+
+    gathered = _collective_fn("all_gather", mesh, "probe")(x)
+    assert gathered.shape == (n * x.shape[0], x.shape[1])
+
+    scattered = _collective_fn("reduce_scatter", mesh, "probe")(x)
+    assert scattered.shape == (x.shape[0] // n, x.shape[1])
+    np.testing.assert_allclose(np.asarray(scattered), n)
+
+    permuted = _collective_fn("ppermute", mesh, "probe")(x)
+    assert permuted.shape == x.shape
+
+    with pytest.raises(ValueError, match="unknown collective"):
+        _collective_fn("alltofoo", mesh, "probe")
+
+
+def test_bench_collectives_shapes_and_quantiles():
+    probes = bench_collectives(
+        mesh=_mesh(), payload_bytes=64 * 1024, reps=3
+    )
+    assert [p.op for p in probes] == [
+        "psum", "all_gather", "reduce_scatter", "ppermute"
+    ]
+    for p in probes:
+        assert p.n_devices == 8
+        assert p.payload_bytes_per_device == 64 * 1024
+        assert p.reps == 3
+        assert 0 < p.min_ms <= p.p50_ms <= p.p95_ms
+        assert p.to_dict()["op"] == p.op
+
+
+def test_probe_events_schema_and_identity():
+    probes = [
+        CollectiveProbe(
+            op="psum", n_devices=8, payload_bytes_per_device=1024,
+            reps=5, mean_ms=2.0, p50_ms=1.8, p95_ms=2.5, min_ms=1.5,
+        ),
+        CollectiveProbe(
+            op="all_gather", n_devices=8, payload_bytes_per_device=1024,
+            reps=5, mean_ms=40.0, p50_ms=38.0, p95_ms=45.0, min_ms=30.0,
+        ),
+    ]
+    events = probes_to_events(probes, slice_id="slice-0", host_index=1)
+    assert len(events) == 2
+    for event in events:
+        assert validate_probe(event)
+        assert event.signal == "ici_collective_latency_ms"
+        assert event.tpu.slice_id == "slice-0"
+    assert events[0].tpu.module_name == "collective:psum"
+    assert events[0].status == "ok"  # p95 2.5ms under the 10ms warning
+    assert events[1].status == "error"  # p95 45ms over the 30ms error
+
+
+def test_icibench_cli_writes_jsonl(tmp_path):
+    from tpuslo.cli.icibench import main
+
+    out = tmp_path / "ici.jsonl"
+    rc = main(
+        [
+            "--payload-kb", "64", "--reps", "2", "--ops", "psum,ppermute",
+            "--output", str(out), "--slice-id", "slice-7",
+        ]
+    )
+    assert rc == 0
+    lines = [json.loads(l) for l in out.read_text().splitlines()]
+    assert len(lines) == 2
+    assert {l["tpu"]["module_name"] for l in lines} == {
+        "collective:psum", "collective:ppermute"
+    }
+    assert all(l["signal"] == "ici_collective_latency_ms" for l in lines)
+    assert all(l["tpu"]["slice_id"] == "slice-7" for l in lines)

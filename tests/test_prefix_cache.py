@@ -98,3 +98,11 @@ def test_prefix_near_capacity_exact():
     full = _tokens(engine, prefix + user)
     cached = _tokens(engine, user, prefix=prefix)
     assert cached == full
+
+
+def test_prefix_cache_disabled_retention_still_serves():
+    engine = _engine()
+    engine.prefix_cache_max = 0
+    out = _tokens(engine, "user q", prefix=PREFIX)
+    assert engine._prefix_cache == {}
+    assert out == _tokens(engine, PREFIX + "user q")

@@ -23,6 +23,7 @@ BINARIES = {
     # training runs with checkpoint/resume.
     "slicecorr": "tpuslo.cli.slicecorr",
     "train": "tpuslo.cli.train",
+    "icibench": "tpuslo.cli.icibench",
 }
 
 

@@ -1,0 +1,96 @@
+"""`tpuslo icibench` — active ICI collective latency prober.
+
+Runs measured XLA collectives over the device mesh and emits
+schema-validated ``ici_collective_latency_ms`` probe events (JSONL),
+plus a human summary on stderr.  TPU-native addition with no reference
+counterpart: the reference's signals are all passive kernel probes;
+TPU interconnect health benefits from an active prober that works even
+when the serving workload is idle.
+
+    # real devices (one chip: collectives compile to on-chip no-ops)
+    python -m tpuslo icibench --reps 10
+
+    # 8-device virtual CPU mesh (CI / laptops)
+    python -m tpuslo icibench --force-cpu-devices 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tpuslo.cli.common import validate_probe
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="icibench", description=__doc__)
+    p.add_argument("--payload-kb", type=int, default=1024)
+    p.add_argument("--reps", type=int, default=20)
+    p.add_argument(
+        "--ops", default="psum,all_gather,reduce_scatter,ppermute",
+        help="comma-separated collective ops to probe",
+    )
+    p.add_argument("--output", default="-", help="'-' for stdout or a JSONL path")
+    p.add_argument("--node", default="tpu-vm-0")
+    p.add_argument("--namespace", default="llm")
+    p.add_argument("--slice-id", default="")
+    p.add_argument("--host-index", type=int, default=-1)
+    p.add_argument(
+        "--force-cpu-devices", type=int, default=0,
+        help="N>0 probes an N-device virtual CPU mesh (no TPU touched)",
+    )
+    args = p.parse_args(argv)
+
+    if args.force_cpu_devices > 0:
+        # Must happen before the first jax backend touch; jax.config
+        # (not the JAX_PLATFORMS env var) per the tunnel-hang gotcha.
+        import os
+
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.force_cpu_devices}"
+        ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from tpuslo.parallel.collectives import bench_collectives, probes_to_events
+
+    ops = tuple(o.strip() for o in args.ops.split(",") if o.strip())
+    probes = bench_collectives(
+        payload_bytes=args.payload_kb * 1024, reps=args.reps, ops=ops
+    )
+    events = probes_to_events(
+        probes,
+        node=args.node,
+        namespace=args.namespace,
+        slice_id=args.slice_id,
+        host_index=args.host_index,
+    )
+
+    sink = sys.stdout if args.output == "-" else open(args.output, "w")
+    try:
+        for probe, event in zip(probes, events):
+            payload = event.to_dict()
+            if not validate_probe(event):
+                print(
+                    f"icibench: schema-invalid event for {probe.op}",
+                    file=sys.stderr,
+                )
+                return 1
+            sink.write(json.dumps(payload) + "\n")
+            print(
+                f"icibench: {probe.op:>14} n={probe.n_devices} "
+                f"payload={probe.payload_bytes_per_device >> 10}KiB/dev "
+                f"p50={probe.p50_ms:.3f}ms p95={probe.p95_ms:.3f}ms",
+                file=sys.stderr,
+            )
+    finally:
+        if sink is not sys.stdout:
+            sink.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

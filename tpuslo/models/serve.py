@@ -450,9 +450,12 @@ class ServeEngine:
         logits, cache = self.prefill_ids(ids)
         logits.block_until_ready()
         entry = PrefixEntry(text=text, ids=ids, cache=cache, logits=logits)
-        if len(self._prefix_cache) >= self.prefix_cache_max:
-            self._prefix_cache.pop(next(iter(self._prefix_cache)))
-        self._prefix_cache[text] = entry
+        if self.prefix_cache_max > 0:
+            while len(self._prefix_cache) >= self.prefix_cache_max:
+                self._prefix_cache.pop(next(iter(self._prefix_cache)))
+            self._prefix_cache[text] = entry
+        # prefix_cache_max <= 0 disables retention: the entry still
+        # serves this request, it just isn't snapshotted for the next.
         return entry
 
     def _clone_cache(self, cache):
@@ -508,6 +511,7 @@ class ServeEngine:
         compile_start = time.perf_counter()
         if entry is not None:
             cache = self._clone_cache(entry.cache)
+            compiled_bucket = 0  # no prefill shape ran (empty suffix)
             if suffix_ids:
                 bucket = _bucket(len(suffix_ids), self.prefill_buckets)
                 # Near-capacity prefixes: the padded bucket must not
@@ -517,6 +521,7 @@ class ServeEngine:
                 # cached prefix; `room` guarantees it still holds the
                 # whole suffix.
                 bucket = min(bucket, self.cfg.max_seq_len - len(entry.ids))
+                compiled_bucket = bucket
                 padded = suffix_ids + [0] * (bucket - len(suffix_ids))
                 logits, cache = self._suffix_prefill(
                     self.params,
@@ -527,15 +532,18 @@ class ServeEngine:
             else:
                 logits = entry.logits
         else:
+            compiled_bucket = _bucket(total_len, self.prefill_buckets)
             logits, cache = self.prefill_ids(ids)
         logits.block_until_ready()
         prefill_ms = (time.perf_counter() - compile_start) * 1000.0
-        if prefill_ms > 100.0:
-            # A slow first hit on a bucket is (almost always) a compile.
-            size = len(suffix_ids) if entry is not None else total_len
+        if prefill_ms > 100.0 and compiled_bucket:
+            # A slow first hit on a bucket is (almost always) a
+            # compile.  compiled_bucket is the shape that actually ran
+            # (suffix buckets clamp near capacity), so recompile
+            # attribution never charges a bucket for a shape it never
+            # compiled.
             self.compile_events.append(
-                {"bucket": _bucket(max(size, 1), self.prefill_buckets),
-                 "compile_ms": prefill_ms}
+                {"bucket": compiled_bucket, "compile_ms": prefill_ms}
             )
 
         token = sample_from_logits(

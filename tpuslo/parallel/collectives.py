@@ -1,0 +1,192 @@
+"""Active ICI collective prober: measured XLA collectives over a mesh.
+
+The toolkit's passive sources (libtpu uprobes, xprof device lanes)
+observe the *workload's* collectives; this is the active counterpart —
+a blackbox prober that launches small psum / all_gather /
+reduce_scatter / ppermute rounds over the device mesh and reports their
+wall latency as real ``ici_collective_latency_ms`` probe events.  Role
+parity: the reference's agent actively creates a BPF map as its
+privilege probe (``pkg/collector/kernel.go:18-39``); here the active
+check exercises the interconnect itself, so a degrading ICI link shows
+up even when the serving workload is idle.
+
+TPU-first mechanics: each op is one ``shard_map``-wrapped collective
+jitted over a 1-D mesh axis, compiled once per (op, shape) and timed
+over committed sharded inputs — what's measured is the collective
+dispatch + ICI transfer, not host padding or transfer-in.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from datetime import datetime, timezone
+
+import numpy as np
+
+from tpuslo.schema import ProbeEventV1, TPURef
+from tpuslo.signals.constants import SIGNAL_ICI_COLLECTIVE_MS
+from tpuslo.signals.generator import signal_status
+
+DEFAULT_OPS = ("psum", "all_gather", "reduce_scatter", "ppermute")
+
+
+@dataclass(frozen=True)
+class CollectiveProbe:
+    """One measured collective: latency quantiles over ``reps`` rounds."""
+
+    op: str
+    n_devices: int
+    payload_bytes_per_device: int
+    reps: int
+    mean_ms: float
+    p50_ms: float
+    p95_ms: float
+    min_ms: float
+
+    def to_dict(self) -> dict:
+        return {
+            "op": self.op,
+            "n_devices": self.n_devices,
+            "payload_bytes_per_device": self.payload_bytes_per_device,
+            "reps": self.reps,
+            "mean_ms": round(self.mean_ms, 4),
+            "p50_ms": round(self.p50_ms, 4),
+            "p95_ms": round(self.p95_ms, 4),
+            "min_ms": round(self.min_ms, 4),
+        }
+
+
+def _collective_fn(op: str, mesh, axis: str):
+    """shard_map-wrapped collective over the 1-D probe axis."""
+    import jax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+    n = mesh.shape[axis]
+    if op == "psum":
+        body = lambda x: lax.psum(x, axis)  # noqa: E731
+        out_spec = P(axis, None)
+    elif op == "all_gather":
+        body = lambda x: lax.all_gather(x, axis, tiled=True)  # noqa: E731
+        out_spec = P(axis, None)
+    elif op == "reduce_scatter":
+        body = lambda x: lax.psum_scatter(x, axis, tiled=True)  # noqa: E731
+        out_spec = P(axis, None)
+    elif op == "ppermute":
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        body = lambda x: lax.ppermute(x, axis, perm)  # noqa: E731
+        out_spec = P(axis, None)
+    else:
+        raise ValueError(f"unknown collective op {op!r}")
+    return jax.jit(
+        shard_map(body, mesh=mesh, in_specs=P(axis, None), out_specs=out_spec)
+    )
+
+
+def bench_collectives(
+    mesh=None,
+    payload_bytes: int = 1 << 20,
+    reps: int = 20,
+    ops: tuple[str, ...] = DEFAULT_OPS,
+) -> list[CollectiveProbe]:
+    """Measure each collective op over the mesh; one probe per op.
+
+    ``payload_bytes`` is the per-device shard size.  The first (compile)
+    round is discarded; quantiles come from the remaining ``reps``
+    timed rounds, each synced with ``block_until_ready``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    if mesh is None:
+        devices = jax.devices()
+        mesh = Mesh(np.array(devices), ("probe",))
+    axis = mesh.axis_names[0]
+    n = mesh.shape[axis]
+
+    cols = 256
+    # Per-device rows rounded to a multiple of n: tiled psum_scatter
+    # splits the shard's leading dim across the axis again.
+    rows_per_dev = max(n, (payload_bytes // (4 * cols) // n) * n)
+    x_host = np.ones((n * rows_per_dev, cols), np.float32)
+    x = jax.device_put(x_host, NamedSharding(mesh, P(axis, None)))
+
+    out: list[CollectiveProbe] = []
+    for op in ops:
+        fn = _collective_fn(op, mesh, axis)
+        jax.block_until_ready(fn(x))  # compile round, discarded
+        samples_ms: list[float] = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(x))
+            samples_ms.append((time.perf_counter() - t0) * 1000.0)
+        arr = np.asarray(samples_ms)
+        out.append(
+            CollectiveProbe(
+                op=op,
+                n_devices=n,
+                payload_bytes_per_device=rows_per_dev * cols * 4,
+                reps=reps,
+                mean_ms=float(arr.mean()),
+                p50_ms=float(np.percentile(arr, 50)),
+                p95_ms=float(np.percentile(arr, 95)),
+                min_ms=float(arr.min()),
+            )
+        )
+    return out
+
+
+def probes_to_events(
+    probes: list[CollectiveProbe],
+    node: str = "tpu-vm-0",
+    namespace: str = "llm",
+    pod: str = "icibench",
+    container: str = "icibench",
+    slice_id: str = "",
+    host_index: int = -1,
+    chip: str = "accel0",
+    now: datetime | None = None,
+) -> list[ProbeEventV1]:
+    """One ``ici_collective_latency_ms`` probe event per measured op.
+
+    The op rides ``tpu.module_name`` (it names the probe's compiled HLO
+    module) so the correlation/attribution layers can split by
+    collective kind without schema changes.
+    """
+    import os
+
+    now = now or datetime.now(timezone.utc)
+    ts = int(now.timestamp() * 1e9)
+    events = []
+    for probe in probes:
+        value = probe.p95_ms
+        events.append(
+            ProbeEventV1(
+                ts_unix_nano=ts,
+                signal=SIGNAL_ICI_COLLECTIVE_MS,
+                node=node,
+                namespace=namespace,
+                pod=pod,
+                container=container,
+                pid=os.getpid(),
+                tid=os.getpid(),
+                value=value,
+                unit="ms",
+                status=signal_status(SIGNAL_ICI_COLLECTIVE_MS, value),
+                tpu=TPURef(
+                    chip=chip,
+                    slice_id=slice_id,
+                    host_index=host_index,
+                    program_id="icibench",
+                    module_name=f"collective:{probe.op}",
+                ),
+            )
+        )
+    return events
