@@ -105,3 +105,106 @@ def test_icibench_cli_writes_jsonl(tmp_path):
     }
     assert all(l["signal"] == "ici_collective_latency_ms" for l in lines)
     assert all(l["tpu"]["slice_id"] == "slice-7" for l in lines)
+
+
+def test_active_prober_interval_and_disable():
+    from tpuslo.parallel.collectives import ActiveICIProber
+
+    logs = []
+    prober = ActiveICIProber(
+        interval_s=100.0, payload_kb=16, reps=1, log=logs.append,
+        slice_id="s0", host_index=0,
+    )
+    events = prober.maybe_probe(now_monotonic=10.0)
+    assert len(events) == 4
+    assert all(validate_probe(e) for e in events)
+    # Not due again until interval elapses.
+    assert prober.maybe_probe(now_monotonic=50.0) == []
+    assert prober.maybe_probe(now_monotonic=111.0) != []
+
+    # A failing probe disables the prober after one log line.
+    broken = ActiveICIProber(interval_s=1.0, log=logs.append)
+
+    def boom(**kw):
+        raise RuntimeError("backend gone")
+
+    import tpuslo.parallel.collectives as mod
+
+    orig = mod.CollectiveSuite
+    mod.CollectiveSuite = boom
+    try:
+        assert broken.maybe_probe(0.0) == []
+        assert broken._disabled
+        assert any("disabled" in line for line in logs)
+        mod.CollectiveSuite = orig
+        assert broken.maybe_probe(1000.0) == []  # stays off
+    finally:
+        mod.CollectiveSuite = orig
+
+
+def test_agent_emits_ici_probe_events(tmp_path):
+    from tpuslo.cli.agent import main
+
+    out = tmp_path / "agent.jsonl"
+    rc = main(
+        [
+            "--scenario", "baseline", "--count", "2", "--interval-s", "0.01",
+            "--output", "jsonl", "--jsonl-path", str(out),
+            "--event-kind", "probe", "--metrics-port", "0",
+            "--max-overhead-pct", "1000",
+            "--ici-probe-interval-s", "3600",
+            "--ici-probe-payload-kb", "16",
+        ]
+    )
+    assert rc == 0
+    events = [json.loads(l) for l in out.read_text().splitlines()]
+    ici = [
+        e for e in events
+        if e.get("tpu", {}).get("program_id") == "icibench"
+    ]
+    # One probe round (4 collectives) on the first cycle only.
+    assert len(ici) == 4
+    assert {e["tpu"]["module_name"] for e in ici} == {
+        "collective:psum", "collective:all_gather",
+        "collective:reduce_scatter", "collective:ppermute",
+    }
+
+
+def test_suite_reuses_compiled_programs():
+    from tpuslo.parallel.collectives import ActiveICIProber, CollectiveSuite
+
+    prober = ActiveICIProber(interval_s=0.0, payload_kb=16, reps=1)
+    assert prober._suite is None
+    prober.maybe_probe(0.0)
+    suite = prober._suite
+    assert isinstance(suite, CollectiveSuite)
+    prober.maybe_probe(1.0)
+    assert prober._suite is suite  # same compiled suite, no rebuild
+
+
+def test_icibench_rejects_unknown_ops(capsys):
+    from tpuslo.cli.icibench import main
+
+    assert main(["--ops", "psumm"]) == 2
+    assert "unknown ops" in capsys.readouterr().err
+    assert main(["--ops", ""]) == 2
+
+
+def test_agent_warns_ici_probe_with_slo_kind(tmp_path, capsys):
+    from tpuslo.cli.agent import main
+
+    out = tmp_path / "slo.jsonl"
+    rc = main(
+        [
+            "--scenario", "baseline", "--count", "1", "--interval-s", "0.01",
+            "--output", "jsonl", "--jsonl-path", str(out),
+            "--event-kind", "slo", "--metrics-port", "0",
+            "--ici-probe-interval-s", "60",
+        ]
+    )
+    assert rc == 0
+    assert "--event-kind probe|both" in capsys.readouterr().err
+    assert all(
+        json.loads(l).get("kind") != "probe"
+        for l in out.read_text().splitlines()
+    )

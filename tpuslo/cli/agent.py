@@ -83,6 +83,15 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="emit hello heartbeat events through the ring (e2e evidence)",
     )
+    p.add_argument(
+        "--ici-probe-interval-s",
+        type=float,
+        default=0.0,
+        help="run the active ICI collective prober every N seconds "
+        "(0 disables; needs exclusive device access — the chip must "
+        "not be held by a serving workload)",
+    )
+    p.add_argument("--ici-probe-payload-kb", type=int, default=256)
     return p
 
 
@@ -160,6 +169,26 @@ def main(argv: list[str] | None = None) -> int:
         host_index=cfg.tpu.host_index,
     )
 
+    ici_prober = None
+    if args.ici_probe_interval_s > 0 and args.event_kind == "slo":
+        print(
+            "agent: --ici-probe-interval-s needs --event-kind probe|both "
+            "(probe events are the prober's output); disabled",
+            file=sys.stderr,
+        )
+    elif args.ici_probe_interval_s > 0:
+        from tpuslo.parallel.collectives import ActiveICIProber
+
+        ici_prober = ActiveICIProber(
+            interval_s=args.ici_probe_interval_s,
+            node=args.node,
+            namespace=args.namespace,
+            slice_id=cfg.tpu.slice_id,
+            host_index=cfg.tpu.host_index,
+            payload_kb=args.ici_probe_payload_kb,
+            log=lambda msg: print(f"agent: {msg}", file=sys.stderr),
+        )
+
     def emit_one(idx: int) -> None:
         now = datetime.now(timezone.utc)
         sample = build_synthetic_sample(args.scenario, idx, now, sample_meta)
@@ -181,8 +210,13 @@ def main(argv: list[str] | None = None) -> int:
 
         if args.event_kind in ("probe", "both"):
             probe_meta = Metadata(trace_id=sample.trace_id)
+            generated = list(generator.generate(sample, probe_meta))
+            if ici_prober is not None:
+                # Measured collectives ride the same validation /
+                # rate-limit / emit path as every other probe signal.
+                generated.extend(ici_prober.maybe_probe(time.monotonic()))
             emitted = []
-            for event in generator.generate(sample, probe_meta):
+            for event in generated:
                 if not limiter.allow():
                     metrics.dropped.labels(reason="rate_limit").inc()
                     continue

@@ -42,6 +42,19 @@ def main(argv: list[str] | None = None) -> int:
     )
     args = p.parse_args(argv)
 
+    ops = tuple(o.strip() for o in args.ops.split(",") if o.strip())
+    from tpuslo.parallel.collectives import DEFAULT_OPS
+
+    unknown = [o for o in ops if o not in DEFAULT_OPS]
+    if unknown or not ops:
+        # Fail before any jax backend init (which can be slow or hang).
+        print(
+            f"icibench: unknown ops {unknown or '(none given)'}; "
+            f"valid: {', '.join(DEFAULT_OPS)}",
+            file=sys.stderr,
+        )
+        return 2
+
     if args.force_cpu_devices > 0:
         # Must happen before the first jax backend touch; jax.config
         # (not the JAX_PLATFORMS env var) per the tunnel-hang gotcha.
@@ -57,7 +70,6 @@ def main(argv: list[str] | None = None) -> int:
 
     from tpuslo.parallel.collectives import bench_collectives, probes_to_events
 
-    ops = tuple(o.strip() for o in args.ops.split(",") if o.strip())
     probes = bench_collectives(
         payload_bytes=args.payload_kb * 1024, reps=args.reps, ops=ops
     )
