@@ -107,11 +107,14 @@ kernel_correlation = dashboard(
             ('sum(rate(llm_slo_agent_probe_events_total[5m])) by (signal)', "{{signal}}"),
         ], 12, 0),
         panel("HBM utilization (%)", [
-            ('max(llm_slo_agent_hbm_utilization_pct) by (instance)', "{{instance}}"),
+            ('max(llm_tpu_agent_hbm_utilization_pct) by (instance)', "{{instance}}"),
         ], 0, 8, unit="percent"),
-        panel("TPU events by signal (xla/hbm/ici)", [
-            ('sum(rate(llm_slo_agent_tpu_events_total[5m])) by (signal)', "{{signal}}"),
+        panel("TPU probe events by signal (xla/hbm/ici/offload)", [
+            ('sum(rate(llm_slo_agent_probe_events_total{signal=~"xla_.*|hbm_.*|ici_.*|host_offload.*"}[5m])) by (signal)', "{{signal}}"),
         ], 12, 8),
+        panel("ICI collective latency p95 (ms, passive + active prober)", [
+            ('histogram_quantile(0.95, sum(rate(llm_tpu_agent_ici_collective_ms_bucket[5m])) by (le))', "collective p95"),
+        ], 0, 24, unit="ms"),
         panel("TTFT p95 vs DNS p95 overlay", [
             (TTFT_P95, "ttft p95 (ms)"),
             ('histogram_quantile(0.95, sum(rate(llm_slo_agent_dns_latency_ms_bucket[5m])) by (le))', "kernel dns p95 (ms)"),

@@ -22,7 +22,7 @@ from dataclasses import dataclass, field
 from datetime import datetime, timezone
 from typing import Any, Iterator
 
-from prometheus_client import CollectorRegistry, Counter, Histogram
+from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram
 
 from tpuslo import semconv
 from tpuslo.correlation.matcher import SignalRef, SpanRef
@@ -276,6 +276,18 @@ class DemoMetrics:
             "llm_slo_retrieval_latency_ms", "Simulated retrieval latency (ms)",
             buckets=(5, 10, 25, 50, 100, 250), registry=self.registry,
         )
+        # The LLMSLOCorrelationDegraded alert watches this: it must
+        # track the confidence of every span<->signal join the service
+        # performs, not exist only as a span attribute.  Labeled so no
+        # series exists before the first join — an unlabeled gauge
+        # exports 0.0 from startup and would fire the avg()<0.70 alert
+        # on a healthy idle service.
+        self.correlation_confidence = Gauge(
+            "llm_slo_correlation_confidence",
+            "Confidence of the latest kernel-signal span correlation",
+            ["signal"],
+            registry=self.registry,
+        )
         self.requests = Counter(
             "llm_slo_requests_total", "Requests", ["profile", "backend"],
             registry=self.registry,
@@ -399,6 +411,11 @@ class RagService:
             dict(retr_span.attributes), span_ref, signal_ref
         )
         retr_span.attributes = attrs
+        confidence = attrs.get(semconv.ATTR_CORRELATION_CONF)
+        if confidence is not None:
+            self.metrics.correlation_confidence.labels(
+                signal="dns_latency_ms"
+            ).set(float(confidence))
         self.recorder.record(retr_span)
         self.metrics.retrieval_ms.observe(
             retrieval.dns_ms + retrieval.network_ms + retrieval.vectordb_ms

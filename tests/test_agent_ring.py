@@ -88,3 +88,37 @@ def test_agent_ring_mode_end_to_end(tmp_path):
     assert (
         by_signal["ici_collective_latency_ms"]["tpu"]["launch_id"] == 1234
     )
+
+
+def test_agent_ring_mode_runs_ici_prober(tmp_path):
+    """Ring mode (the production path) must run the active prober too,
+    not just the synthetic loop."""
+    import json
+
+    from tpuslo.cli import agent
+
+    out_path = str(tmp_path / "probes.jsonl")
+    rc = agent.main(
+        [
+            "--probe-source", "ring",
+            "--ring-path", str(tmp_path / "empty.buf"),
+            "--event-kind", "probe",
+            "--output", "jsonl",
+            "--jsonl-path", out_path,
+            "--count", "2",
+            "--interval-s", "0.05",
+            "--metrics-port", "0",
+            "--max-overhead-pct", "1000",
+            "--ici-probe-interval-s", "3600",
+            "--ici-probe-payload-kb", "16",
+        ]
+    )
+    assert rc == 0
+    events = [
+        json.loads(l) for l in open(out_path).read().splitlines()
+    ]
+    ici = [
+        e for e in events
+        if e.get("tpu", {}).get("program_id") == "icibench"
+    ]
+    assert len(ici) == 4  # one probe round, four collectives

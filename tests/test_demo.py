@@ -220,3 +220,20 @@ def test_jax_batched_backend_concurrent_requests():
         assert "token" in kinds and kinds[-1] == "summary", i
         summary = events[-1]
         assert summary["backend"] == "jax_batched"
+
+
+def test_correlation_confidence_gauge_exported():
+    """LLMSLOCorrelationDegraded alerts on this gauge; the service must
+    set it on every self-correlation join."""
+    from prometheus_client import generate_latest
+
+    from demo.rag_service.service import RagService, StubBackend
+
+    service = RagService(backend=StubBackend(), sleep=lambda s: None)
+    list(service.chat("q", "chat_short"))
+    text = generate_latest(service.metrics.registry).decode()
+    line = next(
+        l for l in text.splitlines()
+        if l.startswith("llm_slo_correlation_confidence{")
+    )
+    assert float(line.split()[-1]) >= 0.7

@@ -143,3 +143,30 @@ def test_rag_demo_manifests():
     )
     assert f'"{backend}"' in server
     assert (REPO / "demo/rag_service/Dockerfile").is_file()
+
+
+def test_observability_metric_names_resolve():
+    """Every metric the dashboards/alerts query must be declared by the
+    agent registry or the demo service — this drifted once (dashboards
+    queried llm_slo_agent_hbm_utilization_pct; the registry exports
+    llm_tpu_agent_hbm_utilization_pct)."""
+    import re
+
+    scanned = [
+        REPO / "dashboards/generate.py",
+        REPO / "deploy/observability/prometheus-alerts.yaml",
+        *sorted((REPO / "test/incident-lab/scenarios").glob("*.yaml")),
+    ]
+    queries = "".join(p.read_text() for p in scanned)
+    declared = (
+        (REPO / "tpuslo/metrics/registry.py").read_text()
+        + (REPO / "demo/rag_service/service.py").read_text()
+    )
+    referenced = set(re.findall(r"llm_[a-z0-9_]+", queries))
+    assert len(referenced) >= 8
+    for name in sorted(referenced):
+        base = re.sub(r"_(bucket|count|sum)$", "", name)
+        candidates = {base, re.sub(r"_total$", "", base)}
+        assert any(c in declared for c in candidates), (
+            f"dashboard/alert references undeclared metric {name}"
+        )

@@ -274,7 +274,7 @@ def main(argv: list[str] | None = None) -> int:
         if args.probe_source == "ring":
             _run_ring_loop(
                 args, cfg, mode, signal_set, enricher, writers, metrics,
-                limiter, guard,
+                limiter, guard, ici_prober=ici_prober,
             )
         else:
             idx = 0
@@ -295,7 +295,8 @@ def main(argv: list[str] | None = None) -> int:
 
 
 def _run_ring_loop(
-    args, cfg, mode, signal_set, enricher, writers, metrics, limiter, guard
+    args, cfg, mode, signal_set, enricher, writers, metrics, limiter, guard,
+    ici_prober=None,
 ) -> None:
     """The real-probe path: ringbuf → normalize → schema → emit.
 
@@ -374,6 +375,20 @@ def _run_ring_loop(
             file=sys.stderr,
         )
 
+    def emit_probe_event(event) -> None:
+        if not limiter.allow():
+            metrics.dropped.labels(reason="rate_limit").inc()
+            return
+        if not validate_probe(event):
+            metrics.dropped.labels(reason="schema").inc()
+            return
+        try:
+            writers.emit_probe([event])
+            metrics.observe_probe(event.signal, event.value)
+        except Exception as exc:  # noqa: BLE001
+            metrics.dropped.labels(reason="emit").inc()
+            print(f"agent: probe emit failed: {exc}", file=sys.stderr)
+
     cycles = 0
     try:
         while True:
@@ -385,18 +400,12 @@ def _run_ring_loop(
                     if sample.signal == "hello_heartbeat_total":
                         metrics.mark_cycle()
                     continue
-                if not limiter.allow():
-                    metrics.dropped.labels(reason="rate_limit").inc()
-                    continue
-                if not validate_probe(event):
-                    metrics.dropped.labels(reason="schema").inc()
-                    continue
-                try:
-                    writers.emit_probe([event])
-                    metrics.observe_probe(event.signal, event.value)
-                except Exception as exc:  # noqa: BLE001
-                    metrics.dropped.labels(reason="emit").inc()
-                    print(f"agent: probe emit failed: {exc}", file=sys.stderr)
+                emit_probe_event(event)
+            if ici_prober is not None:
+                # Active interconnect probe rides the same emit path as
+                # kernel-ring events (synthetic loop does the same).
+                for event in ici_prober.maybe_probe(time.monotonic()):
+                    emit_probe_event(event)
 
             result = guard.evaluate()
             if result.valid:

@@ -91,6 +91,13 @@ class AgentMetrics:
             "Latest observed HBM utilization percent",
             registry=self.registry,
         )
+        self.ici_collective_ms = Histogram(
+            "llm_tpu_agent_ici_collective_ms",
+            "Observed ICI collective latency signal values "
+            "(passive uprobe or active icibench prober)",
+            buckets=(0.5, 1, 2.5, 5, 10, 20, 40, 80),
+            registry=self.registry,
+        )
         self.tpu_events = Counter(
             "llm_tpu_agent_probe_events_total",
             "TPU-side probe events emitted",
@@ -116,6 +123,8 @@ class AgentMetrics:
             self.dns_latency_ms.observe(value)
         if signal == "hbm_utilization_pct":
             self.hbm_utilization_pct.set(value)
+        if signal == "ici_collective_latency_ms":
+            self.ici_collective_ms.observe(value)
         if signal in TPU_SIGNALS:
             self.tpu_events.inc()
 
