@@ -164,16 +164,17 @@ class JaxBackend:
             engine = ServeEngine(cfg=cfg, mesh=mesh, quantize=quantize)
             engine.warmup()
         self.engine = engine
+        # Resolved once like every other TPUSLO_SERVE_* knob: the
+        # shared system prompt rides the KV prefix cache, so its
+        # prefill cost is paid once, not per request.
+        self.system_prompt = os.environ.get("TPUSLO_SYSTEM_PROMPT") or None
 
     def generate(
         self, prompt: str, max_new_tokens: int, warmup_ms: float, cadence_ms: float
     ) -> Iterator[str]:
         del warmup_ms, cadence_ms  # real compute sets the pace
-        # Optional shared system prompt rides the KV prefix cache: its
-        # prefill cost is paid once, not per request.
-        prefix = os.environ.get("TPUSLO_SYSTEM_PROMPT") or None
         for event in self.engine.generate(
-            prompt, max_new_tokens=max_new_tokens, prefix=prefix
+            prompt, max_new_tokens=max_new_tokens, prefix=self.system_prompt
         ):
             yield f"tok{event.token_id}"
 
@@ -212,6 +213,7 @@ class JaxBatchedBackend:
             engine.results.clear()
         self.engine = engine
         self._lock = threading.Lock()
+        self.system_prompt = os.environ.get("TPUSLO_SYSTEM_PROMPT") or None
 
     def generate(
         self, prompt: str, max_new_tokens: int, warmup_ms: float, cadence_ms: float
@@ -219,7 +221,10 @@ class JaxBatchedBackend:
         del warmup_ms, cadence_ms  # real compute sets the pace
         with self._lock:
             rid = self.engine.submit(
-                prompt, max_new_tokens=max_new_tokens, stop_at_eos=True
+                prompt,
+                max_new_tokens=max_new_tokens,
+                stop_at_eos=True,
+                prefix=self.system_prompt,
             )
         emitted = 0
         try:

@@ -182,3 +182,25 @@ def test_backend_generator_close_cancels_request():
     assert not any(engine._slots), "cancelled request still holds a slot"
     engine.run()
     assert engine.results == {}, "ghost result left behind after disconnect"
+
+
+def test_prefix_requests_match_single_request_serving():
+    """submit(prefix=...) must equal the plain engine on prefix+prompt,
+    including when mixed with non-prefix requests mid-flight."""
+    params = init_params(jax.random.PRNGKey(0), _cfg())
+    engine = ContinuousBatchingEngine(cfg=_cfg(), params=params, max_slots=2)
+    sys_prompt = "system: terse answers only. "
+    a = engine.submit("what is ttft?", max_new_tokens=8,
+                      stop_at_eos=False, prefix=sys_prompt)
+    b = engine.submit("plain request", max_new_tokens=8, stop_at_eos=False)
+    for _ in range(3):
+        engine.step()
+    c = engine.submit("second prefixed", max_new_tokens=6,
+                      stop_at_eos=False, prefix=sys_prompt)
+    results = engine.run()
+
+    assert results[a] == _plain(params, sys_prompt + "what is ttft?", 8)
+    assert results[b] == _plain(params, "plain request", 8)
+    assert results[c] == _plain(params, sys_prompt + "second prefixed", 6)
+    # One snapshot serves both prefixed requests.
+    assert list(engine._ingest._prefix_cache) == [sys_prompt]

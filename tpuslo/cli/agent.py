@@ -170,7 +170,13 @@ def main(argv: list[str] | None = None) -> int:
     )
 
     ici_prober = None
-    if args.ici_probe_interval_s > 0 and args.event_kind == "slo":
+    if (
+        args.ici_probe_interval_s > 0
+        and args.event_kind == "slo"
+        and args.probe_source != "ring"
+    ):
+        # Ring mode emits probe events regardless of event_kind, so the
+        # guard only applies to the synthetic loop.
         print(
             "agent: --ici-probe-interval-s needs --event-kind probe|both "
             "(probe events are the prober's output); disabled",

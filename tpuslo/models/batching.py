@@ -38,7 +38,7 @@ from tpuslo.models.llama import (
     init_kv_cache,
     llama_tiny,
 )
-from tpuslo.models.serve import BOS, EOS, encode_bytes
+from tpuslo.models.serve import BOS, EOS
 
 PyTree = Any
 
@@ -62,6 +62,7 @@ class _Request:
     prompt: str
     max_new_tokens: int
     stop_at_eos: bool
+    prefix: str | None = None
     tokens: list[int] = field(default_factory=list)
     done: bool = False
 
@@ -125,23 +126,35 @@ class ContinuousBatchingEngine:
     # -- submission ------------------------------------------------------
 
     def submit(
-        self, prompt: str, max_new_tokens: int = 32, stop_at_eos: bool = True
+        self,
+        prompt: str,
+        max_new_tokens: int = 32,
+        stop_at_eos: bool = True,
+        prefix: str | None = None,
     ) -> int:
-        """Enqueue a request; returns its id (see ``results``)."""
-        req = _Request(self._next_id, prompt, max_new_tokens, stop_at_eos)
+        """Enqueue a request; returns its id (see ``results``).
+
+        ``prefix`` rides the shared ingest engine's KV prefix cache
+        (the effective prompt is ``prefix + prompt``; only the suffix
+        prefills at admission).
+        """
+        req = _Request(
+            self._next_id, prompt, max_new_tokens, stop_at_eos, prefix=prefix
+        )
         self._next_id += 1
         self._queue.append(req)
         return req.request_id
 
     def _admit(self, slot: int, req: _Request) -> None:
-        ids = encode_bytes(req.prompt, self._ingest._max_prompt())
+        logits, row_cache, total_len = self._ingest.ingest_prompt(
+            req.prompt, req.prefix
+        )
         # The exact budget single-request serving applies (chunk-rounded
         # KV cap): the parity contract requires identical truncation,
         # and past raw capacity the per-row scatter would drop
         # out-of-bounds writes and silently decode on a wrong context.
-        _fn, _chunk, cap_tokens = self._ingest._decode_budget(len(ids))
+        _fn, _chunk, cap_tokens = self._ingest._decode_budget(total_len)
         req.max_new_tokens = max(1, min(req.max_new_tokens, cap_tokens))
-        logits, row_cache = self._ingest.prefill_ids(ids)
         first = int(jnp.argmax(logits, axis=-1)[0])
         req.tokens.append(first)
         if (req.stop_at_eos and first == EOS) or req.max_new_tokens <= 1:

@@ -467,29 +467,15 @@ class ServeEngine:
             "length": jnp.copy(cache["length"]),
         }
 
-    def generate(
-        self,
-        prompt: str,
-        max_new_tokens: int = 32,
-        stop_at_eos: bool = True,
-        sampling: SamplingConfig | None = None,
-        seed: int = 0,
-        prefix: str | None = None,
-    ) -> Iterator[TokenEvent]:
-        """Decode one TokenEvent per generated token.
+    def ingest_prompt(self, prompt: str, prefix: str | None = None):
+        """(logits, single-row cache, total_len): the shared prompt
+        ingestion for streaming and continuous-batching serving.
 
-        Greedy by default; pass ``sampling=SamplingConfig(temperature=…,
-        top_k=…, top_p=…)`` for stochastic decoding (``seed`` makes the
-        stream reproducible).  The first token comes from the prefill
-        logits and follows the same sampling rule.  ``prefix`` names a
-        shared prompt prefix served from the KV prefix cache (the
-        effective prompt is ``prefix + prompt``; only the suffix is
-        prefilled per request).
+        Plain path: bucketed prefill of the whole prompt.  With
+        ``prefix``: clone the cached prefix KV and chunk-prefill only
+        the suffix (:meth:`cache_prefix`).  Slow first hits on a shape
+        are recorded in ``compile_events`` either way.
         """
-        sampling = sampling or GREEDY
-        rng = jax.random.PRNGKey(seed)
-        request_start = time.perf_counter()
-        entry = suffix_ids = None
         if prefix:
             entry = self.cache_prefix(prefix)
             room = min(
@@ -498,18 +484,7 @@ class ServeEngine:
             )
             suffix_ids = list(prompt.encode("utf-8"))[: max(0, room)]
             total_len = len(entry.ids) + len(suffix_ids)
-        else:
-            # Cap to the largest bucket so oversize prompts truncate
-            # instead of slipping through unpadded (which would compile
-            # per-length — the exact recompile storm bucketing exists
-            # to prevent).
-            ids = encode_bytes(prompt, self._max_prompt())
-            total_len = len(ids)
-        decode_fn, chunk, cap_tokens = self._decode_budget(total_len)
-        max_new_tokens = max(1, min(max_new_tokens, cap_tokens))
-
-        compile_start = time.perf_counter()
-        if entry is not None:
+            compile_start = time.perf_counter()
             cache = self._clone_cache(entry.cache)
             compiled_bucket = 0  # no prefill shape ran (empty suffix)
             if suffix_ids:
@@ -532,6 +507,13 @@ class ServeEngine:
             else:
                 logits = entry.logits
         else:
+            # Cap to the largest bucket so oversize prompts truncate
+            # instead of slipping through unpadded (which would compile
+            # per-length — the exact recompile storm bucketing exists
+            # to prevent).
+            ids = encode_bytes(prompt, self._max_prompt())
+            total_len = len(ids)
+            compile_start = time.perf_counter()
             compiled_bucket = _bucket(total_len, self.prefill_buckets)
             logits, cache = self.prefill_ids(ids)
         logits.block_until_ready()
@@ -545,6 +527,33 @@ class ServeEngine:
             self.compile_events.append(
                 {"bucket": compiled_bucket, "compile_ms": prefill_ms}
             )
+        return logits, cache, total_len
+
+    def generate(
+        self,
+        prompt: str,
+        max_new_tokens: int = 32,
+        stop_at_eos: bool = True,
+        sampling: SamplingConfig | None = None,
+        seed: int = 0,
+        prefix: str | None = None,
+    ) -> Iterator[TokenEvent]:
+        """Decode one TokenEvent per generated token.
+
+        Greedy by default; pass ``sampling=SamplingConfig(temperature=…,
+        top_k=…, top_p=…)`` for stochastic decoding (``seed`` makes the
+        stream reproducible).  The first token comes from the prefill
+        logits and follows the same sampling rule.  ``prefix`` names a
+        shared prompt prefix served from the KV prefix cache (the
+        effective prompt is ``prefix + prompt``; only the suffix is
+        prefilled per request).
+        """
+        sampling = sampling or GREEDY
+        rng = jax.random.PRNGKey(seed)
+        request_start = time.perf_counter()
+        logits, cache, total_len = self.ingest_prompt(prompt, prefix)
+        decode_fn, chunk, cap_tokens = self._decode_budget(total_len)
+        max_new_tokens = max(1, min(max_new_tokens, cap_tokens))
 
         token = sample_from_logits(
             logits, jax.random.fold_in(rng, 0), sampling
