@@ -251,10 +251,11 @@ class TestServeEngine:
         engine = ServeEngine(
             cfg=llama.llama_tiny(max_seq_len=32), prefill_buckets=(16,)
         )
-        # 28-byte prompt -> 29 ids, truncated to max_prompt=16;
-        # avail = 32-16-1 = 15 = chunk -> chunked path, cap 15.
+        # 15-byte prompt -> 16 ids (chunked ingestion no longer
+        # truncates at the bucket); avail = 32-16-1 = 15 = chunk ->
+        # chunked path, cap 15.
         long_events = list(
-            engine.generate("x" * 28, max_new_tokens=64, stop_at_eos=False)
+            engine.generate("x" * 15, max_new_tokens=64, stop_at_eos=False)
         )
         assert len(long_events) == 15
         assert engine._decode_one is None  # tail path never compiled

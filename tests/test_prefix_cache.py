@@ -106,3 +106,93 @@ def test_prefix_cache_disabled_retention_still_serves():
     out = _tokens(engine, "user q", prefix=PREFIX)
     assert engine._prefix_cache == {}
     assert out == _tokens(engine, PREFIX + "user q")
+
+
+def _engine_with_buckets(buckets, max_seq_len=256):
+    from tpuslo.models.serve import ServeEngine
+
+    cfg = llama_tiny(max_seq_len=max_seq_len)
+    return ServeEngine(
+        cfg=cfg,
+        params=init_params(jax.random.PRNGKey(0), cfg),
+        prefill_buckets=buckets,
+    )
+
+
+def test_chunked_prefill_matches_single_shot():
+    """A prompt longer than the largest bucket ingests chunked and must
+    match an engine whose bucket covers it in one shot."""
+    small = _engine_with_buckets((32, 64))
+    big = _engine_with_buckets((32, 64, 128, 256))
+    prompt = "x" * 150  # 151 ids: chunked as 64 + 64 + 32 on `small`
+    out_small = [
+        e.token_id
+        for e in small.generate(prompt, max_new_tokens=10, stop_at_eos=False)
+    ]
+    out_big = [
+        e.token_id
+        for e in big.generate(prompt, max_new_tokens=10, stop_at_eos=False)
+    ]
+    assert out_small == out_big
+
+
+def test_long_prompt_not_truncated_at_bucket():
+    """Streaming ingestion accepts prompts up to KV capacity instead of
+    truncating at the largest bucket."""
+    engine = _engine_with_buckets((32, 64))
+    prompt = "y" * 150  # 151 ids with BOS, largest bucket is 64
+    logits, cache, total_len = engine.ingest_prompt(prompt)
+    assert total_len == 151
+    assert int(cache["length"]) == 151
+    assert logits.shape[0] == 1
+    # Capacity cap still applies.
+    capped = engine.ingest_prompt("y" * 400)[2]
+    assert capped == engine.cfg.max_seq_len - 2
+
+
+def test_long_prefix_chunked_and_long_suffix():
+    """Prefixes and suffixes longer than the largest bucket both ride
+    the chunked path, exactly."""
+    small = _engine_with_buckets((32, 64))
+    big = _engine_with_buckets((32, 64, 128, 256))
+    long_prefix = "p" * 100
+    long_user = "q" * 80
+    cached = [e.token_id for e in small.generate(
+        long_user, max_new_tokens=8, stop_at_eos=False, prefix=long_prefix)]
+    full = [e.token_id for e in big.generate(
+        long_prefix + long_user, max_new_tokens=8, stop_at_eos=False)]
+    assert cached == full
+
+
+def test_batching_long_prompt_parity():
+    from tpuslo.models.batching import ContinuousBatchingEngine
+
+    cfg = llama_tiny(max_seq_len=256)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    engine = ContinuousBatchingEngine(
+        cfg=cfg, params=params, max_slots=2, prefill_buckets=(32, 64)
+    )
+    rid = engine.submit("z" * 150, max_new_tokens=8, stop_at_eos=False)
+    results = engine.run()
+    stream = _engine_with_buckets((32, 64))
+    stream.params = params
+    expect = [e.token_id for e in stream.generate(
+        "z" * 150, max_new_tokens=8, stop_at_eos=False)]
+    assert results[rid] == expect
+
+
+def test_compile_telemetry_first_hit_only():
+    """Steady-state chunks above the 100ms heuristic must not inflate
+    the recompile-storm signal — only a shape's first hit records."""
+    engine = _engine_with_buckets((32, 64))
+    engine.compile_events.clear()
+    engine._seen_shapes.clear()
+    engine._record_compile("suffix", 64, 500.0)
+    engine._record_compile("suffix", 64, 500.0)
+    engine._record_compile("prefill", 64, 500.0)  # distinct program
+    engine._record_compile("suffix", 32, 50.0)  # fast first hit: no event
+    engine._record_compile("suffix", 32, 500.0)  # already seen
+    assert engine.compile_events == [
+        {"bucket": 64, "compile_ms": 500.0},
+        {"bucket": 64, "compile_ms": 500.0},
+    ]

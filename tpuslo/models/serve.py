@@ -256,6 +256,10 @@ class ServeEngine:
         # (TTFT win grows with prefix length).  Bounded FIFO — each
         # entry pins a full-size KV snapshot in HBM.
         self._prefix_cache: dict[str, PrefixEntry] = {}
+        # Shapes that have already executed once: compile telemetry
+        # records only first hits (steady-state chunks of a large model
+        # can exceed the 100ms heuristic without any compile).
+        self._seen_shapes: set[tuple[str, int]] = set()
         self.prefix_cache_max = 4
         self._suffix_prefill = jax.jit(
             partial(suffix_prefill, cfg=self.cfg), donate_argnums=(2,)
@@ -344,7 +348,9 @@ class ServeEngine:
         All prompts share one prefill bucket (sized by the longest) and
         one decode stream; per-row prompt lengths ride the vector
         ``cache["length"]`` path so shorter rows are not conditioned on
-        pad positions.  The batch dimension pads to ``batch_buckets``
+        pad positions.  Prompts truncate at the largest bucket (the
+        single-shot shared prefill has no chunked path yet — streaming
+        ``generate``/``ingest_prompt`` accepts up to full KV capacity).  The batch dimension pads to ``batch_buckets``
         so each (batch, bucket) pair compiles once.  Aggregate
         tokens/sec scales with the batch on the MXU — decode at B=1
         leaves almost the whole systolic array idle.
@@ -445,9 +451,10 @@ class ServeEngine:
         entry = self._prefix_cache.get(text)
         if entry is not None:
             return entry
-        # Leave room for at least one suffix token + one generated one.
-        ids = encode_bytes(text, self._max_prompt() - 1)
-        logits, cache = self.prefill_ids(ids)
+        # Leave room for at least one suffix token + one generated one;
+        # prefixes longer than the largest bucket ingest chunked.
+        ids = encode_bytes(text, max(1, self.cfg.max_seq_len - 3))
+        logits, cache = self._ingest_ids(ids)
         logits.block_until_ready()
         entry = PrefixEntry(text=text, ids=ids, cache=cache, logits=logits)
         if self.prefix_cache_max > 0:
@@ -467,66 +474,93 @@ class ServeEngine:
             "length": jnp.copy(cache["length"]),
         }
 
+    def _record_compile(self, kind: str, bucket: int, elapsed_ms: float) -> None:
+        """First slow hit on a shape is (almost always) a compile;
+        later hits of the same shape are steady-state compute and must
+        not inflate the recompile-storm signal."""
+        first_hit = (kind, bucket) not in self._seen_shapes
+        self._seen_shapes.add((kind, bucket))
+        if first_hit and elapsed_ms > 100.0:
+            self.compile_events.append(
+                {"bucket": bucket, "compile_ms": elapsed_ms}
+            )
+
+    def _append_ids(self, cache, ids: list[int], start: int):
+        """Chunk-prefill ``ids`` into a cache holding ``start`` tokens.
+
+        Each chunk pads to an existing prefill bucket (clamped so the
+        write never crosses the cache end — ``dynamic_update_slice``
+        would clamp the start backwards and corrupt earlier KV), so
+        arbitrarily long ingestion reuses the same handful of compiled
+        shapes.  Returns (next-token logits, cache).
+        """
+        logits = None
+        pos = 0
+        while pos < len(ids):
+            take = min(self.prefill_buckets[-1], len(ids) - pos)
+            bucket = _bucket(take, self.prefill_buckets)
+            bucket = min(bucket, self.cfg.max_seq_len - (start + pos))
+            take = min(take, bucket)
+            chunk = ids[pos : pos + take] + [0] * (bucket - take)
+            t0 = time.perf_counter()
+            logits, cache = self._suffix_prefill(
+                self.params,
+                jnp.asarray([chunk], jnp.int32),
+                cache,
+                jnp.asarray(take, jnp.int32),
+            )
+            logits.block_until_ready()
+            self._record_compile(
+                "suffix", bucket, (time.perf_counter() - t0) * 1000.0
+            )
+            pos += take
+        return logits, cache
+
+    def _ingest_ids(self, ids: list[int]):
+        """Head prefill on the largest bucket + chunked appends, with
+        first-hit compile telemetry.  Shared by plain-prompt ingestion
+        and prefix snapshot building."""
+        head = ids[: self.prefill_buckets[-1]]
+        t0 = time.perf_counter()
+        logits, cache = self.prefill_ids(head)
+        logits.block_until_ready()
+        self._record_compile(
+            "prefill",
+            _bucket(len(head), self.prefill_buckets),
+            (time.perf_counter() - t0) * 1000.0,
+        )
+        if len(ids) > len(head):
+            logits, cache = self._append_ids(cache, ids[len(head):], len(head))
+        return logits, cache
+
     def ingest_prompt(self, prompt: str, prefix: str | None = None):
         """(logits, single-row cache, total_len): the shared prompt
         ingestion for streaming and continuous-batching serving.
 
-        Plain path: bucketed prefill of the whole prompt.  With
-        ``prefix``: clone the cached prefix KV and chunk-prefill only
-        the suffix (:meth:`cache_prefix`).  Slow first hits on a shape
-        are recorded in ``compile_events`` either way.
+        Prompts up to the full KV capacity ingest as a head prefill on
+        the largest bucket plus chunked appends (``_append_ids``) — no
+        per-length shapes, so long prompts cannot cause the recompile
+        storms the toolkit attributes.  With ``prefix``, the cached
+        prefix KV is cloned and only the suffix ingests
+        (:meth:`cache_prefix`).
         """
         if prefix:
             entry = self.cache_prefix(prefix)
-            room = min(
-                self.prefill_buckets[-1],
-                self.cfg.max_seq_len - 2 - len(entry.ids),
-            )
+            room = self.cfg.max_seq_len - 2 - len(entry.ids)
             suffix_ids = list(prompt.encode("utf-8"))[: max(0, room)]
             total_len = len(entry.ids) + len(suffix_ids)
-            compile_start = time.perf_counter()
             cache = self._clone_cache(entry.cache)
-            compiled_bucket = 0  # no prefill shape ran (empty suffix)
             if suffix_ids:
-                bucket = _bucket(len(suffix_ids), self.prefill_buckets)
-                # Near-capacity prefixes: the padded bucket must not
-                # write past the cache end (dynamic_update_slice would
-                # clamp the start backwards, corrupting prefix KV).
-                # The clamped odd shape compiles at most once per
-                # cached prefix; `room` guarantees it still holds the
-                # whole suffix.
-                bucket = min(bucket, self.cfg.max_seq_len - len(entry.ids))
-                compiled_bucket = bucket
-                padded = suffix_ids + [0] * (bucket - len(suffix_ids))
-                logits, cache = self._suffix_prefill(
-                    self.params,
-                    jnp.asarray([padded], jnp.int32),
-                    cache,
-                    jnp.asarray(len(suffix_ids), jnp.int32),
+                logits, cache = self._append_ids(
+                    cache, suffix_ids, len(entry.ids)
                 )
             else:
                 logits = entry.logits
         else:
-            # Cap to the largest bucket so oversize prompts truncate
-            # instead of slipping through unpadded (which would compile
-            # per-length — the exact recompile storm bucketing exists
-            # to prevent).
-            ids = encode_bytes(prompt, self._max_prompt())
+            ids = encode_bytes(prompt, max(1, self.cfg.max_seq_len - 2))
             total_len = len(ids)
-            compile_start = time.perf_counter()
-            compiled_bucket = _bucket(total_len, self.prefill_buckets)
-            logits, cache = self.prefill_ids(ids)
+            logits, cache = self._ingest_ids(ids)
         logits.block_until_ready()
-        prefill_ms = (time.perf_counter() - compile_start) * 1000.0
-        if prefill_ms > 100.0 and compiled_bucket:
-            # A slow first hit on a bucket is (almost always) a
-            # compile.  compiled_bucket is the shape that actually ran
-            # (suffix buckets clamp near capacity), so recompile
-            # attribution never charges a bucket for a shape it never
-            # compiled.
-            self.compile_events.append(
-                {"bucket": compiled_bucket, "compile_ms": prefill_ms}
-            )
         return logits, cache, total_len
 
     def generate(
