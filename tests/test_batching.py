@@ -204,3 +204,18 @@ def test_prefix_requests_match_single_request_serving():
     assert results[c] == _plain(params, sys_prompt + "second prefixed", 6)
     # One snapshot serves both prefixed requests.
     assert list(engine._ingest._prefix_cache) == [sys_prompt]
+
+
+def test_near_capacity_admission_skips_tail_compile():
+    """A near-capacity prompt must not compile the single-token tail
+    decode fn the batching engine never uses."""
+    cfg = llama_tiny(max_seq_len=128)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    engine = ContinuousBatchingEngine(
+        cfg=cfg, params=params, max_slots=1, prefill_buckets=(32, 64)
+    )
+    rid = engine.submit("w" * 120, max_new_tokens=50, stop_at_eos=False)
+    results = engine.run()
+    assert engine._ingest._decode_one is None  # tail fn never built
+    # Budget equals what streaming serving grants for the same prompt.
+    assert len(results[rid]) == engine._ingest.decode_cap_tokens(121)

@@ -153,7 +153,10 @@ class ContinuousBatchingEngine:
         # KV cap): the parity contract requires identical truncation,
         # and past raw capacity the per-row scatter would drop
         # out-of-bounds writes and silently decode on a wrong context.
-        _fn, _chunk, cap_tokens = self._ingest._decode_budget(total_len)
+        # decode_cap_tokens (not _decode_budget) so a near-capacity
+        # prompt never compiles the single-token tail fn batching
+        # doesn't use.
+        cap_tokens = self._ingest.decode_cap_tokens(total_len)
         req.max_new_tokens = max(1, min(req.max_new_tokens, cap_tokens))
         first = int(jnp.argmax(logits, axis=-1)[0])
         req.tokens.append(first)

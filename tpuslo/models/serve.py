@@ -318,6 +318,17 @@ class ServeEngine:
         one generated token's worth of KV room."""
         return max(1, min(self.prefill_buckets[-1], self.cfg.max_seq_len - 2))
 
+    def decode_cap_tokens(self, longest_prompt_len: int) -> int:
+        """Token cap :meth:`_decode_budget` grants, without
+        materializing (and possibly compiling) the decode fn — the
+        continuous-batching engine decodes per-row itself and needs
+        only the cap."""
+        chunk = self.decode_chunk_size
+        avail = self.cfg.max_seq_len - longest_prompt_len - 1
+        if avail < chunk:
+            return max(1, avail)
+        return max(1, (avail // chunk) * chunk)
+
     def _decode_budget(self, longest_prompt_len: int):
         """(decode_fn, chunk, cap_tokens) for a request whose longest
         prompt row has ``longest_prompt_len`` ids.
@@ -329,11 +340,10 @@ class ServeEngine:
         silently.  Under one chunk of budget, single-token chunks use
         the remaining slots instead of rounding the request away.
         """
-        chunk = self.decode_chunk_size
-        avail = self.cfg.max_seq_len - longest_prompt_len - 1
-        if avail < chunk:
-            return self._decode_one_fn(), 1, max(1, avail)
-        return self._decode_chunk, chunk, max(1, (avail // chunk) * chunk)
+        cap = self.decode_cap_tokens(longest_prompt_len)
+        if self.cfg.max_seq_len - longest_prompt_len - 1 < self.decode_chunk_size:
+            return self._decode_one_fn(), 1, cap
+        return self._decode_chunk, self.decode_chunk_size, cap
 
     def generate_batch(
         self,
@@ -502,6 +512,7 @@ class ServeEngine:
             bucket = min(bucket, self.cfg.max_seq_len - (start + pos))
             take = min(take, bucket)
             chunk = ids[pos : pos + take] + [0] * (bucket - take)
+            first_hit = ("suffix", bucket) not in self._seen_shapes
             t0 = time.perf_counter()
             logits, cache = self._suffix_prefill(
                 self.params,
@@ -509,10 +520,15 @@ class ServeEngine:
                 cache,
                 jnp.asarray(take, jnp.int32),
             )
-            logits.block_until_ready()
-            self._record_compile(
-                "suffix", bucket, (time.perf_counter() - t0) * 1000.0
-            )
+            if first_hit:
+                # Block only to time a possible compile; steady-state
+                # chunks stay async so the host preps chunk N+1 while
+                # the device runs chunk N (they serialize on the cache
+                # dependency anyway).
+                logits.block_until_ready()
+                self._record_compile(
+                    "suffix", bucket, (time.perf_counter() - t0) * 1000.0
+                )
             pos += take
         return logits, cache
 
@@ -521,14 +537,15 @@ class ServeEngine:
         first-hit compile telemetry.  Shared by plain-prompt ingestion
         and prefix snapshot building."""
         head = ids[: self.prefill_buckets[-1]]
+        head_bucket = _bucket(len(head), self.prefill_buckets)
+        first_hit = ("prefill", head_bucket) not in self._seen_shapes
         t0 = time.perf_counter()
         logits, cache = self.prefill_ids(head)
-        logits.block_until_ready()
-        self._record_compile(
-            "prefill",
-            _bucket(len(head), self.prefill_buckets),
-            (time.perf_counter() - t0) * 1000.0,
-        )
+        if first_hit:
+            logits.block_until_ready()
+            self._record_compile(
+                "prefill", head_bucket, (time.perf_counter() - t0) * 1000.0
+            )
         if len(ids) > len(head):
             logits, cache = self._append_ids(cache, ids[len(head):], len(head))
         return logits, cache
