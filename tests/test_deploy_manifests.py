@@ -170,3 +170,28 @@ def test_observability_metric_names_resolve():
         assert any(c in declared for c in candidates), (
             f"dashboard/alert references undeclared metric {name}"
         )
+
+
+def test_agent_args_exist_in_cli():
+    """Every --flag the DaemonSets pass must exist in the agent parser
+    (an env/values knob pointing at a removed flag crashlooms)."""
+    import re
+
+    from tpuslo.cli.agent import build_parser
+
+    known = set()
+    for action in build_parser()._actions:
+        known.update(action.option_strings)
+    sources = [
+        (REPO / "deploy/k8s/daemonset.yaml").read_text(),
+        (REPO / "charts/tpu-slo-agent/templates/daemonset.yaml").read_text(),
+    ]
+    for text in sources:
+        for flag in re.findall(r"(--[a-z][a-z0-9-]*)=", text):
+            assert flag in known, f"daemonset passes unknown flag {flag}"
+    # The kustomize daemonset's env indirections must be defined in the
+    # configmap.
+    ds = (REPO / "deploy/k8s/daemonset.yaml").read_text()
+    cm = (REPO / "deploy/k8s/configmap.yaml").read_text()
+    for var in re.findall(r"\$\((AGENT_[A-Z_]+)\)", ds):
+        assert f"{var}:" in cm, f"daemonset references undefined env {var}"

@@ -105,28 +105,52 @@ def test_bad_k_rejected():
 
 def test_speculative_tail_matches_stepwise_near_capacity():
     """With fewer than k+1 free KV slots, the plain-decode tail keeps
-    the output identical to stepwise target-only greedy decoding."""
-    from tpuslo.models.serve import encode_bytes
-
+    the output identical to the target-only greedy stream, including
+    its chunk-rounded token budget."""
     cfg = llama_tiny(max_seq_len=64)
     params = init_params(jax.random.PRNGKey(0), cfg)
-    prompt = "y" * 56  # 57 ids after BOS: 7 free slots, k+1 = 5
+    prompt = "y" * 56  # 57 ids after BOS: 6-token budget, k+1 = 5
 
-    # Stepwise reference: prefill then greedy decode to the last slot.
     ref_engine = ServeEngine(cfg=cfg, params=params)
-    ids = encode_bytes(prompt, ref_engine._max_prompt())
-    logits, cache = ref_engine.prefill_ids(ids)
-    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    ref = [int(tok[0])]
-    while int(cache["length"]) < cfg.max_seq_len - 1:
-        logits, cache = decode_step(params, tok, cache, cfg)
-        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        ref.append(int(tok[0]))
+    ref = _plain_greedy(ref_engine, prompt, 32)  # budget-clamped
+    assert len(ref) == ref_engine.decode_cap_tokens(57)
 
     spec = SpeculativeEngine(
         ServeEngine(cfg=cfg, params=params),
         ServeEngine(cfg=cfg, params=params),
         k=4,
     )
-    got = spec.generate(prompt, max_new_tokens=len(ref), stop_at_eos=False)
+    got = spec.generate(prompt, max_new_tokens=32, stop_at_eos=False)
     assert got == ref
+
+
+def test_speculative_long_prompt_chunked_ingestion():
+    """Prompts past the largest prefill bucket ride chunked ingestion
+    in BOTH engines; exactness vs target-only greedy still holds."""
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    draft_params = init_params(jax.random.PRNGKey(7), cfg)
+    target = ServeEngine(cfg=cfg, params=params, prefill_buckets=(32, 64))
+    draft = ServeEngine(cfg=cfg, params=draft_params, prefill_buckets=(32, 64))
+    spec = SpeculativeEngine(target, draft, k=3)
+
+    prompt = "z" * 150  # 151 ids > largest bucket (64)
+    plain = ServeEngine(cfg=cfg, params=params, prefill_buckets=(32, 64))
+    want = _plain_greedy(plain, prompt, 16)
+    got = spec.generate(prompt, max_new_tokens=16, stop_at_eos=False)
+    assert got == want
+
+
+def test_speculative_near_capacity_exact():
+    """Reviewer repro: 61-id prompt in a 64-slot cache with k=4 must
+    ingest fully (no k-dependent truncation) and match target-only
+    greedy via the single-step tail."""
+    cfg = llama_tiny(max_seq_len=64)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    target = ServeEngine(cfg=cfg, params=params)
+    draft = ServeEngine(cfg=cfg, params=init_params(jax.random.PRNGKey(7), cfg))
+    spec = SpeculativeEngine(target, draft, k=4)
+    prompt = "y" * 60
+    want = _plain_greedy(ServeEngine(cfg=cfg, params=params), prompt, 8)
+    got = spec.generate(prompt, max_new_tokens=8, stop_at_eos=False)
+    assert got == want

@@ -341,7 +341,7 @@ class ServeEngine:
         the remaining slots instead of rounding the request away.
         """
         cap = self.decode_cap_tokens(longest_prompt_len)
-        if self.cfg.max_seq_len - longest_prompt_len - 1 < self.decode_chunk_size:
+        if cap < self.decode_chunk_size:
             return self._decode_one_fn(), 1, cap
         return self._decode_chunk, self.decode_chunk_size, cap
 
@@ -513,6 +513,11 @@ class ServeEngine:
             take = min(take, bucket)
             chunk = ids[pos : pos + take] + [0] * (bucket - take)
             first_hit = ("suffix", bucket) not in self._seen_shapes
+            if first_hit:
+                # Drain the async predecessor chunks BEFORE timing, or
+                # the recorded "compile" would include their queued
+                # compute (a phantom recompile-storm signal).
+                jax.block_until_ready(cache)
             t0 = time.perf_counter()
             logits, cache = self._suffix_prefill(
                 self.params,

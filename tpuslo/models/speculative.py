@@ -87,11 +87,30 @@ class SpeculativeEngine:
         cache slot.
         """
         t, d = self.target, self.draft
-        max_prompt = min(t._max_prompt(), d._max_prompt())
+        # Chunked ingestion (head prefill + bucket appends) lifts the
+        # prompt cap to joint KV capacity; both engines must ingest the
+        # IDENTICAL id sequence or their caches desync, so encode once
+        # with the joint cap instead of per-engine ingest_prompt.
+        # Cap at joint capacity minus the prefill token + one decode
+        # slot (NOT minus k: the tail fallback already handles prompts
+        # too long for a speculative round, and extra truncation would
+        # break exactness vs the target-only stream near capacity).
+        max_prompt = max(1, min(t.cfg.max_seq_len, d.cfg.max_seq_len) - 2)
         ids = encode_bytes(prompt, max_prompt)
 
-        logits_t, cache_t = t.prefill_ids(ids)
-        _logits_d, cache_d = d.prefill_ids(ids)
+        logits_t, cache_t = t._ingest_ids(ids)
+        _logits_d, cache_d = d._ingest_ids(ids)
+        # Same emission budget the target-only engine would grant, so
+        # the streams are identical (not merely prefix-compatible) at
+        # every capacity.
+        max_new_tokens = max(
+            1,
+            min(
+                max_new_tokens,
+                t.decode_cap_tokens(len(ids)),
+                d.decode_cap_tokens(len(ids)),
+            ),
+        )
 
         current = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)  # (1,)
         out = [int(current[0])]
