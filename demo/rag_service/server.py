@@ -17,6 +17,7 @@ from demo.rag_service.service import (
     PROFILES,
     JaxBackend,
     JaxBatchedBackend,
+    JaxMoEBackend,
     RagService,
     StubBackend,
 )
@@ -90,7 +91,9 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="rag-service", description=__doc__)
     parser.add_argument("--port", type=int, default=18080)
     parser.add_argument(
-        "--backend", default="stub", choices=["stub", "jax", "jax_batched"]
+        "--backend",
+        default="stub",
+        choices=["stub", "jax", "jax_batched", "jax_moe"],
     )
     parser.add_argument("--seed", type=int, default=42)
     parser.add_argument("--node", default="tpu-vm-0")
@@ -106,6 +109,7 @@ def main(argv=None) -> int:
     backend = {
         "jax": JaxBackend,
         "jax_batched": JaxBatchedBackend,
+        "jax_moe": JaxMoEBackend,
         "stub": StubBackend,
     }[args.backend]()
     vector_store = None

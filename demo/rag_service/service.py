@@ -179,6 +179,28 @@ class JaxBackend:
             yield f"tok{event.token_id}"
 
 
+class JaxMoEBackend:
+    """Second model family behind the same demo: Mixtral-class MoE via
+    :class:`tpuslo.models.mixtral.MoEServeEngine` (greedy streaming)."""
+
+    name = "jax_moe"
+
+    def __init__(self, engine=None):
+        if engine is None:
+            from tpuslo.models.mixtral import MoEServeEngine
+
+            engine = MoEServeEngine()
+            engine.warmup()
+        self.engine = engine
+
+    def generate(
+        self, prompt: str, max_new_tokens: int, warmup_ms: float, cadence_ms: float
+    ) -> Iterator[str]:
+        del warmup_ms, cadence_ms  # real compute sets the pace
+        for event in self.engine.generate(prompt, max_new_tokens=max_new_tokens):
+            yield f"tok{event.token_id}"
+
+
 class JaxBatchedBackend:
     """Continuous-batching JAX backend: concurrent requests share one
     slot pool (:class:`tpuslo.models.batching.ContinuousBatchingEngine`).

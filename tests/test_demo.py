@@ -237,3 +237,13 @@ def test_correlation_confidence_gauge_exported():
         if l.startswith("llm_slo_correlation_confidence{")
     )
     assert float(line.split()[-1]) >= 0.7
+
+
+def test_jax_moe_backend_streams():
+    from demo.rag_service.service import JaxMoEBackend, RagService
+
+    service = RagService(backend=JaxMoEBackend(), sleep=lambda s: None)
+    events = list(service.chat("moe demo request", "chat_short"))
+    assert events[-1]["type"] == "summary"
+    assert events[-1]["backend"] == "jax_moe"
+    assert events[-1]["token_count"] > 0

@@ -419,6 +419,13 @@ def attention_block(
     return h + _matmul(attn.reshape(B, S, H * HD), layer["wo"]), (k, v)
 
 
+def _dense_mlp(cfg, layer: PyTree, x: jax.Array) -> jax.Array:
+    """SwiGLU MLP on normalized hidden states (the dense families)."""
+    gate = jax.nn.silu(_matmul(x, layer["w1"]).astype(jnp.float32))
+    up = _matmul(x, layer["w3"]).astype(jnp.float32)
+    return _matmul((gate * up).astype(cfg.dtype), layer["w2"])
+
+
 def _layer_body(
     cfg: LlamaConfig,
     h: jax.Array,
@@ -427,18 +434,19 @@ def _layer_body(
     sin: jax.Array,
     mask: jax.Array,
     causal: bool = False,
+    mlp_fn=None,
 ) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
     """One transformer layer; returns (hidden, (rotated_k, v)).
 
     Shared by full forward and prefill so the layer math exists once;
     forward discards the KV output (XLA dead-code-eliminates it).
+    ``mlp_fn(layer, x)`` swaps the MLP — the Mixtral family serves
+    through these exact cache semantics with only the MLP replaced.
     """
     h, kv = attention_block(cfg, h, layer, cos, sin, mask, causal=causal)
     x = rms_norm(h, layer["mlp_norm"], cfg.norm_eps)
-    gate = jax.nn.silu(_matmul(x, layer["w1"]).astype(jnp.float32))
-    up = _matmul(x, layer["w3"]).astype(jnp.float32)
-    h = h + _matmul((gate * up).astype(cfg.dtype), layer["w2"])
-    return h, kv
+    y = _dense_mlp(cfg, layer, x) if mlp_fn is None else mlp_fn(layer, x)
+    return h + y, kv
 
 
 def forward(
@@ -493,6 +501,7 @@ def prefill(
     cache: PyTree,
     cfg: LlamaConfig,
     true_length: jax.Array | None = None,
+    mlp_fn=None,
 ) -> tuple[jax.Array, PyTree]:
     """Process the (possibly pad-bucketed) prompt and fill the cache.
 
@@ -514,7 +523,9 @@ def prefill(
     mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
 
     def scan_step(h, layer):
-        return _layer_body(cfg, h, layer, cos, sin, mask, causal=True)
+        return _layer_body(
+            cfg, h, layer, cos, sin, mask, causal=True, mlp_fn=mlp_fn
+        )
 
     h, (ks, vs) = lax.scan(scan_step, h, params["layers"])
 
@@ -639,6 +650,7 @@ def verify_chunk(
     tokens: jax.Array,
     cache: PyTree,
     cfg: LlamaConfig,
+    mlp_fn=None,
 ) -> tuple[jax.Array, PyTree]:
     """Score K tokens in one pass: logits at every position.
 
@@ -675,9 +687,8 @@ def verify_chunk(
         attn = attention(q, k_cache, v_cache, mask, H // KV)
         h = h + _matmul(attn.reshape(B, K, H * HD), layer["wo"])
         x = rms_norm(h, layer["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu(_matmul(x, layer["w1"]).astype(jnp.float32))
-        up = _matmul(x, layer["w3"]).astype(jnp.float32)
-        h = h + _matmul((gate * up).astype(cfg.dtype), layer["w2"])
+        y = _dense_mlp(cfg, layer, x) if mlp_fn is None else mlp_fn(layer, x)
+        h = h + y
         return h, (k_cache, v_cache)
 
     h, (ks, vs) = lax.scan(

@@ -227,6 +227,199 @@ def loss_fn(params, tokens, targets, cfg: MixtralConfig) -> jax.Array:
     return jnp.mean(nll) + cfg.router_aux_coef * aux
 
 
+# --- KV-cache serving path ---------------------------------------------
+#
+# The llama family's prefill / verify_chunk with the dense SwiGLU
+# swapped for the MoE block via their ``mlp_fn`` hook — the cache
+# layout, mask discipline, and stale-slot semantics have ONE source of
+# truth (llama.py); ``llama.init_kv_cache(cfg.attn_cfg(), batch)``
+# allocates the cache.
+#
+# Routing caveat: incremental decode equals full-sequence ``forward``
+# only while routing is drop-free, i.e. ``capacity_factor >=
+# n_experts / top_k`` (per-expert capacity cf*k*N/E must cover the
+# worst case of all N tokens picking one expert).  ``mixtral_tiny``
+# (E=4, k=2, cf=2.0) satisfies it; ``mixtral_8x7b`` (E=8, k=2) would
+# need cf >= 4 — at the default cf=2, overflow drops can make
+# incremental and full-sequence outputs diverge.
+
+
+def _serving_mlp_fn(cfg: MixtralConfig):
+    """mlp_fn hook for the llama serving paths: MoE, aux discarded."""
+    return lambda layer, x: _moe_block(layer, x, cfg)[0]
+
+
+def prefill(
+    params: PyTree,
+    tokens: jax.Array,
+    cache: PyTree,
+    cfg: MixtralConfig,
+    true_length: jax.Array | None = None,
+) -> tuple[jax.Array, PyTree]:
+    """Bucketed prompt ingestion (llama.prefill with the MoE MLP)."""
+    from tpuslo.models import llama
+
+    return llama.prefill(
+        params, tokens, cache, cfg, true_length=true_length,
+        mlp_fn=_serving_mlp_fn(cfg),
+    )
+
+
+def verify_chunk(
+    params: PyTree,
+    tokens: jax.Array,
+    cache: PyTree,
+    cfg: MixtralConfig,
+) -> tuple[jax.Array, PyTree]:
+    """Score K tokens against the cache (llama.verify_chunk + MoE)."""
+    from tpuslo.models import llama
+
+    return llama.verify_chunk(
+        params, tokens, cache, cfg, mlp_fn=_serving_mlp_fn(cfg)
+    )
+
+
+def decode_step(
+    params: PyTree, token: jax.Array, cache: PyTree, cfg: MixtralConfig
+) -> tuple[jax.Array, PyTree]:
+    """One-token greedy decode over the scalar-length cache."""
+    logits, cache = verify_chunk(params, token[:, None], cache, cfg)
+    return logits[:, 0], {**cache, "length": cache["length"] + 1}
+
+
+def decode_chunk(
+    params: PyTree,
+    token: jax.Array,
+    cache: PyTree,
+    cfg: MixtralConfig,
+    num_tokens: int,
+) -> tuple[jax.Array, jax.Array, PyTree]:
+    """Greedy-decode ``num_tokens`` in one device call (llama
+    decode_chunk's dispatch-amortization, MoE body)."""
+
+    def step(carry, _):
+        tok, kv = carry
+        logits, kv = decode_step(params, tok, kv, cfg)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (nxt, kv), nxt
+
+    (last, cache), toks = lax.scan(
+        step, (token, cache), None, length=num_tokens
+    )
+    return jnp.swapaxes(toks, 0, 1), last, cache
+
+
+class MoEServeEngine:
+    """Greedy streaming serving for the Mixtral family.
+
+    The compact counterpart of :class:`tpuslo.models.serve.ServeEngine`
+    (bucketed prefill, chunked decode, TokenEvent stream with TTFT);
+    sampling / prefix caching / batching stay llama-engine features.
+    """
+
+    def __init__(
+        self,
+        cfg: MixtralConfig | None = None,
+        params: PyTree | None = None,
+        rng_seed: int = 0,
+        prefill_buckets: tuple[int, ...] = (32, 64, 128),
+        decode_chunk_size: int = 16,
+    ):
+        from tpuslo.models.llama import init_kv_cache
+
+        self.cfg = cfg or mixtral_tiny(max_seq_len=256)
+        self.params = params if params is not None else init_params(
+            jax.random.PRNGKey(rng_seed), self.cfg
+        )
+        self.prefill_buckets = tuple(
+            b for b in prefill_buckets if b <= self.cfg.max_seq_len
+        ) or (self.cfg.max_seq_len,)
+        self.decode_chunk_size = max(
+            1, min(decode_chunk_size, (self.cfg.max_seq_len - 2) // 2)
+        )
+        self._init_cache = lambda batch: init_kv_cache(
+            self.cfg.attn_cfg(), batch
+        )
+        self._prefill = jax.jit(
+            partial(prefill, cfg=self.cfg), donate_argnums=(2,)
+        )
+        self._decode = jax.jit(
+            partial(decode_chunk, cfg=self.cfg, num_tokens=self.decode_chunk_size),
+            donate_argnums=(2,),
+        )
+
+    def warmup(self) -> float:
+        import time
+
+        start = time.perf_counter()
+        bucket = self.prefill_buckets[0]
+        tokens = jnp.zeros((1, bucket), jnp.int32)
+        # Same call signature as generate (true_length passed as a
+        # traced scalar) or the first real request would retrace.
+        logits, cache = self._prefill(
+            self.params, tokens, self._init_cache(1),
+            true_length=jnp.asarray(bucket, jnp.int32),
+        )
+        tok = jnp.argmax(logits, -1).astype(jnp.int32)
+        toks, _last, _ = self._decode(self.params, tok, cache)
+        jax.block_until_ready(toks)
+        return (time.perf_counter() - start) * 1000.0
+
+    def generate(self, prompt: str, max_new_tokens: int = 32, stop_at_eos: bool = True):
+        import time
+
+        from tpuslo.models.serve import EOS, TokenEvent, _bucket, encode_bytes
+
+        request_start = time.perf_counter()
+        chunk = self.decode_chunk_size
+        # Prompt cap leaves at least one whole decode chunk of KV room:
+        # decode always dispatches full chunks, and a partial chunk past
+        # capacity would clamp-and-corrupt the last cache slot (llama's
+        # engine has a single-token tail path for this; the MoE engine
+        # keeps the simpler invariant).
+        max_prompt = max(
+            1, min(self.prefill_buckets[-1], self.cfg.max_seq_len - chunk - 1)
+        )
+        ids = encode_bytes(prompt, max_prompt)
+        avail = self.cfg.max_seq_len - len(ids) - 1
+        max_new_tokens = max(1, min(max_new_tokens, (avail // chunk) * chunk))
+
+        bucket = _bucket(len(ids), self.prefill_buckets)
+        tokens = jnp.asarray([ids + [0] * (bucket - len(ids))], jnp.int32)
+        logits, cache = self._prefill(
+            self.params, tokens, self._init_cache(1),
+            true_length=jnp.asarray(len(ids), jnp.int32),
+        )
+        # TTFT must include the prefill compute, not just its async
+        # dispatch — block before taking the timestamp.
+        logits.block_until_ready()
+        token = jnp.argmax(logits, -1).astype(jnp.int32)
+        toks = last = None
+        if max_new_tokens > 1:
+            toks, last, cache = self._decode(self.params, token, cache)
+        ttft_ms = (time.perf_counter() - request_start) * 1000.0
+        first = int(token[0])
+        yield TokenEvent(first, 0, ttft_ms=ttft_ms)
+        if stop_at_eos and first == EOS:
+            return
+
+        idx = 1
+        while idx < max_new_tokens:
+            next_toks = next_last = None
+            if idx + chunk < max_new_tokens:
+                next_toks, next_last, cache = self._decode(
+                    self.params, last, cache
+                )
+            for value in jax.device_get(toks[0]).tolist():
+                yield TokenEvent(int(value), idx)
+                idx += 1
+                if stop_at_eos and value == EOS:
+                    return
+                if idx >= max_new_tokens:
+                    return
+            toks, last = next_toks, next_last
+
+
 def param_shardings(mesh: Mesh) -> PyTree:
     """dp x ep layout: expert leaves shard their expert axis over ep;
     attention weights replicate (tiny next to experts at 8x sparsity)."""
@@ -291,11 +484,16 @@ def build_moe_train_step(mesh: Mesh, cfg: MixtralConfig, optimizer=None):
 
 __all__ = [
     "MixtralConfig",
+    "MoEServeEngine",
     "mixtral_8x7b",
     "mixtral_tiny",
     "param_count",
     "init_params",
     "forward",
+    "prefill",
+    "verify_chunk",
+    "decode_step",
+    "decode_chunk",
     "loss_fn",
     "param_shardings",
     "build_moe_train_step",
