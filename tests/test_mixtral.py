@@ -131,3 +131,31 @@ class TestMoEServing:
                                      max_new_tokens=12, stop_at_eos=False)
         ]
         assert again == [e.token_id for e in events]
+
+
+def test_mixtral_2b6_sized_for_one_chip_and_drop_free():
+    from tpuslo.models.mixtral import mixtral_2b6, param_count
+
+    cfg = mixtral_2b6()
+    # Drop-free routing is what makes the serving numbers honest.
+    assert cfg.capacity_factor >= cfg.n_experts / cfg.top_k
+    n = param_count(cfg)
+    assert 2e9 < n < 4e9  # bf16 weights fit 16 GB with headroom
+    assert cfg.dim % cfg.n_heads == 0
+    assert cfg.n_heads % cfg.n_kv_heads == 0
+
+
+def test_active_param_count_below_total():
+    from tpuslo.models.mixtral import (
+        active_param_count,
+        mixtral_2b6,
+        param_count,
+    )
+
+    cfg = mixtral_2b6()
+    active = active_param_count(cfg)
+    total = param_count(cfg)
+    assert active < total
+    # Expert weights dominate: active ~ total - (E-k)/E * experts.
+    experts = cfg.n_layers * cfg.n_experts * 3 * cfg.dim * cfg.ffn_dim
+    assert active == total - experts + experts * cfg.top_k // cfg.n_experts

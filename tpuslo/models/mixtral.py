@@ -91,6 +91,27 @@ def mixtral_8x7b() -> MixtralConfig:
     return MixtralConfig()
 
 
+def mixtral_2b6(max_seq_len: int = 1024) -> MixtralConfig:
+    """~2.6B-param MoE sized for a single 16 GB chip in bf16.
+
+    E=4 / top_k=2 / cf=2.0 keeps routing drop-free (cf >= E/k), so
+    serving equals the full forward — the honest configuration for
+    measured single-chip MoE numbers.
+    """
+    return MixtralConfig(
+        vocab_size=32000,
+        dim=2048,
+        n_layers=16,
+        n_heads=16,
+        n_kv_heads=4,
+        ffn_dim=5632,
+        n_experts=4,
+        top_k=2,
+        capacity_factor=2.0,
+        max_seq_len=max_seq_len,
+    )
+
+
 def mixtral_tiny(max_seq_len: int = 128) -> MixtralConfig:
     return MixtralConfig(
         vocab_size=512,
@@ -119,6 +140,16 @@ def param_count(cfg: MixtralConfig) -> int:
         + E * 3 * D * F  # experts (w1, w3, w2)
     )
     return 2 * cfg.vocab_size * D + D + L * per_layer
+
+
+def active_param_count(cfg: MixtralConfig) -> int:
+    """Params a decoded token actually routes through: everything
+    except the (n_experts - top_k) unrouted experts per layer.  The
+    honest numerator for MoE decode MFU (total params would overstate
+    utilization by ~n_experts/top_k on the expert-dominated weights)."""
+    expert = cfg.n_layers * cfg.n_experts * 3 * cfg.dim * cfg.ffn_dim
+    routed = cfg.n_layers * cfg.top_k * 3 * cfg.dim * cfg.ffn_dim
+    return param_count(cfg) - expert + routed
 
 
 def init_params(rng: jax.Array, cfg: MixtralConfig) -> PyTree:
@@ -486,7 +517,9 @@ __all__ = [
     "MixtralConfig",
     "MoEServeEngine",
     "mixtral_8x7b",
+    "mixtral_2b6",
     "mixtral_tiny",
+    "active_param_count",
     "param_count",
     "init_params",
     "forward",
