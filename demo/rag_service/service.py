@@ -143,8 +143,13 @@ def _serve_env_config():
             "llama3_8b", "llama3_70b",
         )
         if model not in valid:
+            hint = (
+                " (mixtral_* configs serve via --backend jax_moe)"
+                if model.startswith("mixtral")
+                else ""
+            )
             raise ValueError(
-                f"TPUSLO_SERVE_MODEL={model!r}: expected one of {valid}"
+                f"TPUSLO_SERVE_MODEL={model!r}: expected one of {valid}{hint}"
             )
         cfg = getattr(llama, model)()
     quantize = os.environ.get("TPUSLO_SERVE_INT8", "") == "1"
@@ -187,9 +192,22 @@ class JaxMoEBackend:
 
     def __init__(self, engine=None):
         if engine is None:
+            from tpuslo.models import mixtral
             from tpuslo.models.mixtral import MoEServeEngine
 
-            engine = MoEServeEngine()
+            cfg = None
+            model = os.environ.get("TPUSLO_SERVE_MODEL", "")
+            if model.startswith("mixtral"):
+                # Same env knob as the llama backends; mixtral_* names
+                # route here (e.g. TPUSLO_SERVE_MODEL=mixtral_2b6 on a
+                # real chip).
+                valid = ("mixtral_tiny", "mixtral_2b6", "mixtral_8x7b")
+                if model not in valid:
+                    raise ValueError(
+                        f"TPUSLO_SERVE_MODEL={model!r}: expected one of {valid}"
+                    )
+                cfg = getattr(mixtral, model)()
+            engine = MoEServeEngine(cfg=cfg)
             engine.warmup()
         self.engine = engine
 

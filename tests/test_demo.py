@@ -247,3 +247,26 @@ def test_jax_moe_backend_streams():
     assert events[-1]["type"] == "summary"
     assert events[-1]["backend"] == "jax_moe"
     assert events[-1]["token_count"] > 0
+
+
+def test_jax_moe_backend_model_env(monkeypatch):
+    from tpuslo.models.mixtral import mixtral_tiny
+
+    monkeypatch.setenv("TPUSLO_SERVE_MODEL", "mixtral_tiny")
+    from demo.rag_service.service import JaxMoEBackend
+
+    backend = JaxMoEBackend()
+    assert backend.engine.cfg == mixtral_tiny()  # env default, 128 ctx
+
+
+def test_serve_model_env_validation_messages(monkeypatch):
+    import pytest
+
+    from demo.rag_service.service import JaxMoEBackend, _serve_env_config
+
+    monkeypatch.setenv("TPUSLO_SERVE_MODEL", "mixtral_2b6")
+    with pytest.raises(ValueError, match="jax_moe"):
+        _serve_env_config()  # llama backends point at the MoE backend
+    monkeypatch.setenv("TPUSLO_SERVE_MODEL", "mixtral2b6")  # typo
+    with pytest.raises(ValueError, match="mixtral_tiny"):
+        JaxMoEBackend()

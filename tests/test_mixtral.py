@@ -145,17 +145,24 @@ def test_mixtral_2b6_sized_for_one_chip_and_drop_free():
     assert cfg.n_heads % cfg.n_kv_heads == 0
 
 
-def test_active_param_count_below_total():
+def test_active_param_count_against_real_leaves():
+    """Pin both counts against the actual init_params leaf sizes (an
+    independent derivation, not the formula restated)."""
     from tpuslo.models.mixtral import (
         active_param_count,
-        mixtral_2b6,
+        init_params,
+        mixtral_tiny,
         param_count,
     )
 
-    cfg = mixtral_2b6()
-    active = active_param_count(cfg)
-    total = param_count(cfg)
-    assert active < total
-    # Expert weights dominate: active ~ total - (E-k)/E * experts.
-    experts = cfg.n_layers * cfg.n_experts * 3 * cfg.dim * cfg.ffn_dim
-    assert active == total - experts + experts * cfg.top_k // cfg.n_experts
+    cfg = mixtral_tiny()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    leaf_total = sum(x.size for x in jax.tree.leaves(params))
+    assert param_count(cfg) == leaf_total
+    # Active = all leaves minus the unrouted experts' share of the
+    # (L, E, ...) expert leaves.
+    layers = params["layers"]
+    expert_leaves = sum(layers[k].size for k in ("w1", "w3", "w2"))
+    unrouted = expert_leaves * (cfg.n_experts - cfg.top_k) // cfg.n_experts
+    assert active_param_count(cfg) == leaf_total - unrouted
+    assert active_param_count(cfg) < param_count(cfg)
