@@ -197,14 +197,22 @@ class JaxMoEBackend:
 
             cfg = None
             model = os.environ.get("TPUSLO_SERVE_MODEL", "")
-            if model.startswith("mixtral"):
+            if model:
                 # Same env knob as the llama backends; mixtral_* names
                 # route here (e.g. TPUSLO_SERVE_MODEL=mixtral_2b6 on a
-                # real chip).
+                # real chip).  Anything else is a wrong-backend mistake
+                # — silently serving the tiny default would hand out
+                # toy-model latency numbers.
                 valid = ("mixtral_tiny", "mixtral_2b6", "mixtral_8x7b")
                 if model not in valid:
+                    hint = (
+                        " (llama_* configs serve via --backend jax|jax_batched)"
+                        if model.startswith("llama")
+                        else ""
+                    )
                     raise ValueError(
-                        f"TPUSLO_SERVE_MODEL={model!r}: expected one of {valid}"
+                        f"TPUSLO_SERVE_MODEL={model!r}: expected one of "
+                        f"{valid}{hint}"
                     )
                 cfg = getattr(mixtral, model)()
             engine = MoEServeEngine(cfg=cfg)

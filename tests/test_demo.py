@@ -270,3 +270,13 @@ def test_serve_model_env_validation_messages(monkeypatch):
     monkeypatch.setenv("TPUSLO_SERVE_MODEL", "mixtral2b6")  # typo
     with pytest.raises(ValueError, match="mixtral_tiny"):
         JaxMoEBackend()
+
+
+def test_jax_moe_backend_rejects_llama_model_env(monkeypatch):
+    import pytest
+
+    from demo.rag_service.service import JaxMoEBackend
+
+    monkeypatch.setenv("TPUSLO_SERVE_MODEL", "llama3_8b")
+    with pytest.raises(ValueError, match="jax_batched"):
+        JaxMoEBackend()

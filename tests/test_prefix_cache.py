@@ -196,3 +196,26 @@ def test_compile_telemetry_first_hit_only():
         {"bucket": 64, "compile_ms": 500.0},
         {"bucket": 64, "compile_ms": 500.0},
     ]
+
+
+def test_generate_batch_with_prefix_matches_streaming():
+    """Batched prefix serving (tiled snapshot + vector-length suffix
+    pass) must equal the per-request streaming path row for row."""
+    import pytest
+
+    engine = _engine()
+    prompts = ["first question", "a second, longer question", "third"]
+    batch_out = engine.generate_batch(
+        prompts, max_new_tokens=10, stop_at_eos=False, prefix=PREFIX
+    )
+    for prompt, out in zip(prompts, batch_out):
+        single = [
+            e.token_id
+            for e in engine.generate(
+                prompt, max_new_tokens=10, stop_at_eos=False, prefix=PREFIX
+            )
+        ]
+        assert out == single, prompt
+
+    with pytest.raises(ValueError, match="non-empty"):
+        engine.generate_batch(["ok", ""], prefix=PREFIX)

@@ -39,32 +39,36 @@ BOS = 256
 EOS = 257
 
 
-def suffix_prefill(params, tokens, cache, true_length, cfg):
-    """Append a (padded) suffix to a cache already holding a prefix.
+def suffix_prefill(params, tokens, kv, start, true_length, cfg):
+    """Append a (padded) suffix to KV already holding ``start`` tokens.
 
     The chunked-prefill half of prefix caching: ``verify_chunk`` scores
     the suffix against the full cache (prefix KV included) and writes
-    its KV at the cache's current scalar ``length``; this wrapper then
-    gathers the next-token logits at the suffix's true last position
-    and advances ``length`` past it.  Pad slots beyond ``true_length``
-    hold stale KV but sit past ``length``, so decode masks them and
-    overwrites them as generation proceeds — the same discipline as
-    bucketed prefill.
+    its KV at ``start``; this wrapper then gathers the next-token
+    logits at the suffix's true last position and returns a cache with
+    ``length = start + true_length`` (``true_length`` may be a scalar
+    or a per-row vector — batched prefix serving).  Pad slots beyond
+    ``true_length`` hold stale KV but sit past ``length``, so decode
+    masks them and overwrites them as generation proceeds — the same
+    discipline as bucketed prefill.
 
-    The caller must guarantee ``cache["length"] + tokens.shape[1] <=
+    ``kv`` carries only the donated ``{"k", "v"}`` buffers; ``start``
+    rides separately so a scalar-in / vector-out length never blocks
+    donation.  The caller must guarantee ``start + tokens.shape[1] <=
     max_seq_len``: ``verify_chunk`` writes the whole (padded) chunk at
-    the cache's current length, and ``dynamic_update_slice`` would
-    otherwise clamp the write start backwards — silently overwriting
-    the tail of the cached prefix and desyncing KV positions from the
-    mask/RoPE.
+    ``start``, and ``dynamic_update_slice`` would otherwise clamp the
+    write start backwards — silently overwriting the tail of the
+    cached prefix and desyncing KV positions from the mask/RoPE.
     """
+    cache = {"k": kv["k"], "v": kv["v"], "length": jnp.asarray(start, jnp.int32)}
     logits, cache = verify_chunk(params, tokens, cache, cfg)
     B = tokens.shape[0]
     tl = jnp.broadcast_to(jnp.asarray(true_length, jnp.int32), (B,))
     last = jnp.take_along_axis(logits, (tl - 1)[:, None, None], axis=1)[:, 0]
     cache = {
         **cache,
-        "length": cache["length"] + jnp.asarray(true_length, jnp.int32),
+        "length": jnp.asarray(start, jnp.int32)
+        + jnp.asarray(true_length, jnp.int32),
     }
     return last, cache
 
@@ -351,6 +355,7 @@ class ServeEngine:
         max_new_tokens: int = 32,
         stop_at_eos: bool = True,
         batch_buckets: tuple[int, ...] = (1, 2, 4, 8),
+        prefix: str | None = None,
     ) -> list[list[int]]:
         """Throughput-oriented batched decode; one list of token ids
         per prompt.
@@ -364,9 +369,20 @@ class ServeEngine:
         so each (batch, bucket) pair compiles once.  Aggregate
         tokens/sec scales with the batch on the MXU — decode at B=1
         leaves almost the whole systolic array idle.
+
+        ``prefix`` serves a shared prompt prefix from the KV prefix
+        cache: the snapshot is tiled across the batch rows and only the
+        per-row suffixes prefill (one suffix pass at the shared prefix
+        length with per-row true lengths).  Rows must have non-empty
+        suffixes in prefix mode.
         """
         if not prompts:
             return []
+        if prefix and any(not p for p in prompts):
+            raise ValueError(
+                "generate_batch(prefix=...) needs non-empty per-row "
+                "suffixes; use generate() for prefix-only requests"
+            )
         if len(prompts) > batch_buckets[-1]:
             # Oversized requests split into largest-bucket sub-batches:
             # _bucket clamps to buckets[-1], so one oversize pass would
@@ -380,27 +396,55 @@ class ServeEngine:
                         max_new_tokens=max_new_tokens,
                         stop_at_eos=stop_at_eos,
                         batch_buckets=batch_buckets,
+                        prefix=prefix,
                     )
                 )
             return outputs
-        ids = [encode_bytes(p, self._max_prompt()) for p in prompts]
+        if prefix:
+            entry = self.cache_prefix(prefix)
+            start = len(entry.ids)
+            room = min(
+                self.prefill_buckets[-1], self.cfg.max_seq_len - 2 - start
+            )
+            ids = [list(p.encode("utf-8"))[: max(1, room)] for p in prompts]
+        else:
+            entry = None
+            start = 0
+            ids = [encode_bytes(p, self._max_prompt()) for p in prompts]
         n_real = len(ids)
         batch = _bucket(n_real, batch_buckets)
-        ids += [[BOS]] * (batch - n_real)
+        ids += [[0 if prefix else BOS]] * (batch - n_real)
 
         lens = [len(row) for row in ids]
         bucket = _bucket(max(lens), self.prefill_buckets)
+        bucket = min(bucket, self.cfg.max_seq_len - start)
         tokens = jnp.asarray(
             [row + [0] * (bucket - len(row)) for row in ids], jnp.int32
         )
         # The row with the longest prompt bounds every row's budget.
-        decode_fn, chunk, cap_tokens = self._decode_budget(max(lens))
+        decode_fn, chunk, cap_tokens = self._decode_budget(start + max(lens))
         max_new_tokens = max(1, min(max_new_tokens, cap_tokens))
 
-        cache = self._new_cache(batch)
-        logits, cache = self._prefill(
-            self.params, tokens, cache, true_length=jnp.asarray(lens, jnp.int32)
-        )
+        if entry is not None:
+            # Tile the single-row snapshot across the batch; the suffix
+            # pass writes at the shared prefix length with per-row true
+            # lengths, the same vector-length contract as bucketed
+            # prefill at position 0.
+            kv = {
+                "k": jnp.repeat(entry.cache["k"], batch, axis=1),
+                "v": jnp.repeat(entry.cache["v"], batch, axis=1),
+            }
+            logits, cache = self._suffix_prefill(
+                self.params, tokens, kv,
+                jnp.asarray(start, jnp.int32),
+                jnp.asarray(lens, jnp.int32),
+            )
+        else:
+            cache = self._new_cache(batch)
+            logits, cache = self._prefill(
+                self.params, tokens, cache,
+                true_length=jnp.asarray(lens, jnp.int32),
+            )
         token = prefill_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         # Dispatch the first decode chunk before the host-side read of
         # the prefill tokens, as generate() does: the device decodes
@@ -522,7 +566,8 @@ class ServeEngine:
             logits, cache = self._suffix_prefill(
                 self.params,
                 jnp.asarray([chunk], jnp.int32),
-                cache,
+                {"k": cache["k"], "v": cache["v"]},
+                jnp.asarray(start + pos, jnp.int32),
                 jnp.asarray(take, jnp.int32),
             )
             if first_hit:
