@@ -282,13 +282,19 @@ def bench_serving() -> dict:
     try:
         probe = _probe_backend(timeout_s=240)
         if not probe.get("ok"):
-            holders = _chip_holder_diagnostics()
             retry_probe = {"ok": False, "error": "not retried (deterministic)"}
             if probe.get("retryable", True):
                 # Hang/transient init failures can clear; "resolved to
-                # cpu" (no TPU attached at all) cannot.
-                time.sleep(15.0)
+                # cpu" (no TPU attached at all) cannot.  A wedged
+                # remote lease (killed holder) can take minutes to
+                # release, so the backoff is generous before giving up.
+                time.sleep(120.0)
                 retry_probe = _probe_backend(timeout_s=180)
+                if not retry_probe.get("ok") and retry_probe.get(
+                    "retryable", True
+                ):
+                    time.sleep(180.0)
+                    retry_probe = _probe_backend(timeout_s=180)
             if not retry_probe.get("ok"):
                 fallback = _run_serving_subprocess(
                     ["--platform", "cpu", "--model", "llama_tiny"], timeout_s=600
@@ -296,6 +302,10 @@ def bench_serving() -> dict:
                 fallback["backend"] = "cpu_fallback"
                 fallback["tpu_error"] = str(probe.get("error", "?"))[:300]
                 fallback["tpu_retry_error"] = str(retry_probe.get("error", "?"))[:300]
+                # Capture holders AFTER the retries: minutes-old
+                # diagnostics would point operators at processes that
+                # already exited.
+                holders = _chip_holder_diagnostics()
                 if holders:
                     fallback["chip_holder_candidates"] = holders
                 return fallback

@@ -115,6 +115,9 @@ kernel_correlation = dashboard(
         panel("ICI collective latency p95 (ms, passive + active prober)", [
             ('histogram_quantile(0.95, sum(rate(llm_tpu_agent_ici_collective_ms_bucket[5m])) by (le))', "collective p95"),
         ], 0, 24, unit="ms"),
+        panel("Correlation confidence (alert floor 0.70)", [
+            ('avg(llm_slo_correlation_confidence) by (signal)', "{{signal}}"),
+        ], 12, 24),
         panel("TTFT p95 vs DNS p95 overlay", [
             (TTFT_P95, "ttft p95 (ms)"),
             ('histogram_quantile(0.95, sum(rate(llm_slo_agent_dns_latency_ms_bucket[5m])) by (le))', "kernel dns p95 (ms)"),
