@@ -101,11 +101,9 @@ def test_indivisible_tp_rejected():
         ServeEngine(cfg=cfg, mesh=_tp_mesh(4))
 
 
-def test_llama3_70b_int8_tp8_program_lowers():
-    """The 70B-over-v5e-8 claim, compile-validated without weights:
-    the int8 tp=8 prefill program traces and lowers against abstract
-    shapes, so the shardings and layer math are consistent at full
-    scale (allocation-free — eval_shape + jit.lower only)."""
+def _llama70b_abstract_setup():
+    """(mesh, cfg, abstract_params, shardings, cache_abstract) for the
+    allocation-free 70B int8 tp=8 compile tests."""
     from dataclasses import replace
     from functools import partial
 
@@ -113,31 +111,43 @@ def test_llama3_70b_int8_tp8_program_lowers():
         init_kv_cache,
         init_params_quantized,
         llama3_70b,
-        prefill,
     )
     from tpuslo.models.serve import kv_cache_shardings
 
     mesh = _tp_mesh(8)
     cfg = replace(llama3_70b(), max_seq_len=256)
-    assert cfg.n_heads % 8 == 0 and cfg.n_kv_heads % 8 == 0
-
     abstract_params = jax.eval_shape(
         partial(init_params_quantized, cfg=cfg), jax.random.PRNGKey(0)
     )
+    shardings = serve_param_shardings(abstract_params, mesh)
+    cache_abstract = jax.eval_shape(lambda: init_kv_cache(cfg, 1))
+    return mesh, cfg, abstract_params, shardings, kv_cache_shardings(mesh), cache_abstract
+
+
+def test_llama3_70b_int8_tp8_program_lowers():
+    """The 70B-over-v5e-8 claim, compile-validated without weights:
+    the int8 tp=8 prefill program traces and lowers against abstract
+    shapes, so the shardings and layer math are consistent at full
+    scale (allocation-free — eval_shape + jit.lower only)."""
+    from tpuslo.models.llama import prefill
+
+    _mesh, cfg, abstract_params, shardings, kv_shard, cache_abstract = (
+        _llama70b_abstract_setup()
+    )
+    assert cfg.n_heads % 8 == 0 and cfg.n_kv_heads % 8 == 0
     n_bytes = sum(
         x.size * x.dtype.itemsize for x in jax.tree.leaves(abstract_params)
     )
     assert n_bytes > 60e9  # ~70 GB of int8 weights: needs all 8 chips
 
-    shardings = serve_param_shardings(abstract_params, mesh)
-    cache_abstract = jax.eval_shape(lambda: init_kv_cache(cfg, 1))
     tokens = jax.ShapeDtypeStruct((1, 64), jnp.int32)
+
     def prefill_pos(params, toks, cache, true_length):
         return prefill(params, toks, cache, cfg, true_length=true_length)
 
     lowered = jax.jit(
         prefill_pos,
-        in_shardings=(shardings, None, kv_cache_shardings(mesh), None),
+        in_shardings=(shardings, None, kv_shard, None),
     ).lower(
         abstract_params,
         tokens,
@@ -150,4 +160,29 @@ def test_llama3_70b_int8_tp8_program_lowers():
     # that would reject an inconsistent tp spec; .lower() alone would
     # stay green on a spec real hardware rejects.
     compiled = lowered.compile()
+    assert compiled is not None
+
+
+def test_llama3_70b_int8_tp8_decode_chunk_compiles():
+    """The decode half of the 70B-over-v5e-8 claim: the int8 tp=8
+    chunked-decode program compiles against abstract shapes (GSPMD runs
+    at compile; allocation-free)."""
+    from tpuslo.models.llama import decode_chunk
+
+    _mesh, cfg, abstract_params, shardings, kv_shard, cache_abstract = (
+        _llama70b_abstract_setup()
+    )
+    token = jax.ShapeDtypeStruct((1,), jnp.int32)
+
+    def decode_pos(params, tok, cache):
+        return decode_chunk(params, tok, cache, cfg, num_tokens=8)
+
+    compiled = (
+        jax.jit(
+            decode_pos,
+            in_shardings=(shardings, None, kv_shard),
+        )
+        .lower(abstract_params, token, cache_abstract)
+        .compile()
+    )
     assert compiled is not None
