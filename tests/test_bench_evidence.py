@@ -1,0 +1,119 @@
+"""TPU-evidence persistence: serving_bench captures must survive a dead
+tunnel into the driver-visible bench artifact.
+
+The tunnel relay died before the driver's final capture in rounds 1-2,
+so ``BENCH_r0{1,2}.json`` carried zero TPU serving numbers despite real
+same-session measurements.  These tests pin the persistence contract:
+a successful TPU run is written (atomically, with provenance) to a
+committed artifact, and ``bench.py``'s fallback branch embeds that
+artifact verbatim as ``serving_tpu_last_capture``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from tpuslo.benchmark.serving_bench import (
+    LATEST_CAPTURE_PATH,
+    load_last_tpu_capture,
+    persist_tpu_capture,
+)
+
+
+def test_persist_skips_non_tpu_results(tmp_path):
+    path = str(tmp_path / "latest.json")
+    assert not persist_tpu_capture({"backend": "cpu_fallback"}, path=path)
+    assert not persist_tpu_capture({"backend": "unavailable"}, path=path)
+    assert not os.path.exists(path)
+
+
+def _complete_capture(**overrides):
+    cap = {
+        "backend": "tpu",
+        "device_kind": "TPU v5 lite",
+        "ttft_ms": 78.4,
+        "decode_tokens_per_sec": 84.6,
+        "mfu_prefill": 0.62,
+        "xprof_launch_spans": 18,
+    }
+    cap.update(overrides)
+    return cap
+
+
+def test_persist_refuses_degraded_capture(tmp_path):
+    """A run missing MFU or xprof evidence (flaky xprof, unknown chip)
+    must not clobber the last complete committed capture."""
+    path = str(tmp_path / "latest.json")
+    assert persist_tpu_capture(_complete_capture(), path=path)
+    assert not persist_tpu_capture(
+        _complete_capture(xprof_launch_spans=None), path=path
+    )
+    degraded = _complete_capture()
+    del degraded["mfu_prefill"]
+    assert not persist_tpu_capture(degraded, path=path)
+    artifact = load_last_tpu_capture(path=path)
+    assert artifact["capture"]["xprof_launch_spans"] == 18
+
+
+def test_persist_and_load_round_trip(tmp_path):
+    path = str(tmp_path / "latest.json")
+    result = _complete_capture()
+    assert persist_tpu_capture(result, path=path)
+    artifact = load_last_tpu_capture(path=path)
+    assert artifact is not None
+    assert artifact["capture"] == result
+    prov = artifact["provenance"]
+    assert prov["captured_at"]
+    assert "serving_bench" in prov["capture_command"]
+    assert "git_sha" in prov
+
+
+def test_persist_overwrites_previous_capture(tmp_path):
+    path = str(tmp_path / "latest.json")
+    persist_tpu_capture(_complete_capture(ttft_ms=1.0), path=path)
+    persist_tpu_capture(_complete_capture(ttft_ms=2.0), path=path)
+    artifact = load_last_tpu_capture(path=path)
+    assert artifact["capture"]["ttft_ms"] == 2.0
+
+
+def test_load_missing_and_corrupt(tmp_path):
+    assert load_last_tpu_capture(path=str(tmp_path / "absent.json")) is None
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert load_last_tpu_capture(path=str(bad)) is None
+    # Valid JSON but wrong shape is rejected too.
+    bad.write_text('["list"]')
+    assert load_last_tpu_capture(path=str(bad)) is None
+
+
+def test_committed_artifact_carries_tpu_evidence():
+    """The repo always ships a last-known-good TPU capture with the
+    fields the driver artifact needs (ttft / tok/s / MFU / xprof)."""
+    artifact = load_last_tpu_capture()
+    assert artifact is not None, LATEST_CAPTURE_PATH
+    cap = artifact["capture"]
+    assert cap["backend"] == "tpu"
+    assert cap["device_kind"]
+    assert cap["ttft_ms"] > 0
+    assert cap["decode_tokens_per_sec"] > 0
+    assert cap["mfu_prefill"] > 0
+    assert cap["xprof_launch_spans"] > 0
+    assert artifact["provenance"]["captured_at"]
+
+
+def test_bench_fallback_embeds_last_capture():
+    import bench
+
+    result = {"backend": "cpu_fallback", "tpu_error": "relay dead"}
+    bench._attach_last_tpu_capture(result)
+    embedded = result.get("serving_tpu_last_capture")
+    assert embedded is not None
+    assert embedded["capture"]["backend"] == "tpu"
+    assert embedded["provenance"]["captured_at"]
+
+
+def test_committed_artifact_is_valid_json_file():
+    with open(LATEST_CAPTURE_PATH) as fh:
+        artifact = json.load(fh)
+    assert set(artifact) == {"provenance", "capture"}

@@ -557,6 +557,123 @@ def _bench_int8(bytes_limit, peak_flops, dev) -> dict[str, Any]:
     return res
 
 
+def _default_capture_path() -> str:
+    """Resolve the committed capture artifact path.
+
+    Env override first (pip installs where ``__file__`` lands in
+    site-packages), then the repo checkout containing this module, then
+    the working directory.
+    """
+    env = os.environ.get("TPUSLO_TPU_CAPTURE_PATH")
+    if env:
+        return env
+    rel = os.path.join("docs", "benchmarks", "reports",
+                       "serving_tpu_latest.json")
+    repo = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    if os.path.isdir(os.path.join(repo, "docs", "benchmarks")):
+        return os.path.join(repo, rel)
+    return os.path.join(os.getcwd(), rel)
+
+
+LATEST_CAPTURE_PATH = _default_capture_path()
+
+# A capture must carry the full evidence set before it may replace the
+# committed artifact: the artifact's whole job is to present complete
+# TPU proof (latency, throughput, MFU, xprof correlation) when the live
+# path is down, so a degraded run (xprof flake, unknown device_kind)
+# keeps the last complete capture instead of clobbering it.
+_REQUIRED_CAPTURE_FIELDS = (
+    "device_kind",
+    "ttft_ms",
+    "decode_tokens_per_sec",
+    "mfu_prefill",
+    "xprof_launch_spans",
+)
+
+
+def persist_tpu_capture(result: dict[str, Any], path: str | None = None) -> bool:
+    """Persist a successful real-TPU capture to a committed artifact.
+
+    The tunnel relay that reaches the chip has died before the driver's
+    final ``bench.py`` capture in two consecutive rounds, leaving the
+    driver-visible artifact with ``cpu_fallback`` despite real same-day
+    TPU measurements.  Persisting every successful TPU run here (git
+    SHA + UTC timestamp + raw sub-measurements) lets ``bench.py``'s
+    fallback branch embed provenance-stamped TPU evidence instead of
+    losing it.  Atomic write (temp + rename) so a crash mid-dump cannot
+    truncate the previous good capture.
+    """
+    if result.get("backend") != "tpu":
+        return False
+    if not all(result.get(field) for field in _REQUIRED_CAPTURE_FIELDS):
+        return False
+    path = path or LATEST_CAPTURE_PATH
+    import datetime
+    import subprocess
+
+    sha = "unknown"
+    try:
+        proc = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(path),
+        )
+        if proc.returncode == 0:
+            sha = proc.stdout.strip()
+    except Exception:  # noqa: BLE001 - provenance best-effort
+        pass
+    artifact = {
+        "provenance": {
+            "captured_at": datetime.datetime.now(datetime.timezone.utc)
+            .isoformat(timespec="seconds"),
+            "capture_command": "python -m tpuslo.benchmark.serving_bench "
+            "--platform auto",
+            "git_sha": sha,
+            "source": "live run (auto-persisted by serving_bench on a "
+            "successful TPU capture)",
+            "note": "Last successful real-TPU capture; bench.py embeds "
+            "this verbatim as serving_tpu_last_capture when the tunnel "
+            "is down at driver capture time.",
+        },
+        "capture": result,
+    }
+    tmp = None
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(path), suffix=".tmp"
+        )
+        with os.fdopen(fd, "w") as fh:
+            json.dump(artifact, fh, indent=2)
+            fh.write("\n")
+        os.replace(tmp, path)
+        tmp = None
+        return True
+    except OSError:
+        return False
+    finally:
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
+def load_last_tpu_capture(path: str | None = None) -> dict[str, Any] | None:
+    """Read the persisted capture artifact (or None if absent/corrupt)."""
+    path = path or LATEST_CAPTURE_PATH
+    try:
+        with open(path) as fh:
+            artifact = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(artifact, dict) or "capture" not in artifact:
+        return None
+    return artifact
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="serving_bench")
     parser.add_argument("--platform", choices=("auto", "cpu"), default="auto")
@@ -565,8 +682,17 @@ def main(argv: list[str] | None = None) -> int:
         choices=("auto", "llama3_8b", "llama32_3b", "llama32_1b", "llama_tiny"),
         default="auto",
     )
+    parser.add_argument(
+        "--no-persist", action="store_true",
+        help="skip writing docs/benchmarks/reports/serving_tpu_latest.json "
+        "on a successful TPU capture",
+    )
     args = parser.parse_args(argv)
     result = run(platform=args.platform, model=args.model)
+    if not args.no_persist and persist_tpu_capture(result):
+        result["persisted_to"] = os.path.relpath(
+            LATEST_CAPTURE_PATH, os.getcwd()
+        )
     print("SERVING_BENCH:" + json.dumps(result))
     return 0
 
