@@ -1,0 +1,176 @@
+"""int8 KV cache: exactness vs bf16 KV, capacity arithmetic, and parity
+across every serving path (streaming, batched, continuous batching,
+prefix cache).
+
+VERDICT r02 ranked int8 KV + paged KV as the highest-leverage deferred
+perf items: KV reads bound decode at batch > 1 and long context, so
+halving KV bytes halves that traffic and nearly doubles the contexts
+per HBM byte.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpuslo.models import kv_cache as kvc
+from tpuslo.models.llama import (
+    init_kv_cache,
+    init_params,
+    kv_cache_bytes,
+    llama3_8b,
+    llama_tiny,
+    prefill,
+)
+from tpuslo.models.serve import ServeEngine
+
+
+CFG = llama_tiny(max_seq_len=128)
+
+
+def test_quantize_roundtrip_error_small():
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (2, 16, 4, 32), jnp.bfloat16)
+    out = kvc.kv_load(kvc.quantize_kv(x), jnp.float32)
+    ref = x.astype(jnp.float32)
+    err = jnp.max(jnp.abs(out - ref))
+    # Symmetric int8 with per-(pos, head) scales: worst case one half
+    # quantization step = amax/254 per head.
+    bound = jnp.max(jnp.abs(ref)) / 254.0 * 1.5 + 1e-6
+    assert float(err) <= float(bound)
+
+
+def test_quantize_zero_input_safe():
+    qs = kvc.quantize_kv(jnp.zeros((1, 4, 2, 8), jnp.bfloat16))
+    assert not jnp.any(jnp.isnan(kvc.kv_load(qs)))
+
+
+def test_kv_bytes_capacity_gain():
+    """int8 KV stores ~2x the context per HBM byte (exact ratio
+    2 / (1 + 4/head_dim) — scales cost 4 bytes per position*head)."""
+    cfg = llama3_8b()
+    dense = kv_cache_bytes(cfg, 8)
+    quant = kv_cache_bytes(cfg, 8, kv_dtype="int8")
+    ratio = dense / quant
+    assert ratio == pytest.approx(2.0 / (1.0 + 4.0 / cfg.head_dim))
+    assert ratio > 1.9
+
+
+def test_init_kv_cache_int8_structure():
+    cache = init_kv_cache(CFG, 2, kv_dtype="int8")
+    assert cache["k"]["q"].dtype == jnp.int8
+    assert cache["k"]["s"].dtype == jnp.float32
+    assert cache["k"]["q"].shape == (
+        CFG.n_layers, 2, CFG.max_seq_len, CFG.n_kv_heads, CFG.head_dim
+    )
+    assert cache["k"]["s"].shape == cache["k"]["q"].shape[:-1]
+
+
+def test_init_kv_cache_rejects_unknown_dtype():
+    with pytest.raises(ValueError):
+        init_kv_cache(CFG, 1, kv_dtype="fp4")
+
+
+def test_prefill_logits_close_to_bf16_kv():
+    """Exactness vs the bf16 cache: prefill writes through the
+    quantized representation; next-token logits must agree within
+    quantization tolerance."""
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 256)
+    logits_ref, cache_ref = prefill(
+        params, tokens, init_kv_cache(CFG, 2), CFG
+    )
+    logits_q, cache_q = prefill(
+        params, tokens, init_kv_cache(CFG, 2, kv_dtype="int8"), CFG
+    )
+    # Prefill logits come from the hidden states, not the cache — they
+    # are identical; the cache CONTENTS differ by quantization.
+    assert jnp.allclose(logits_ref, logits_q, atol=1e-5)
+    k_deq = kvc.kv_load(cache_q["k"], jnp.float32)[:, :, :32]
+    k_ref = cache_ref["k"].astype(jnp.float32)[:, :, :32]
+    assert float(jnp.max(jnp.abs(k_deq - k_ref))) < 0.05
+    assert float(jnp.mean(jnp.abs(k_deq - k_ref))) < 0.005
+
+
+def test_decode_logits_close_to_bf16_kv():
+    """Teacher-forced decode: feeding the SAME token sequence through
+    int8-KV and bf16-KV caches, per-step logits must stay within
+    quantization tolerance (a random-init model has near-tied logits,
+    so exact greedy-argmax equality over a long horizon is not a sound
+    contract — logit closeness is)."""
+    from tpuslo.models.llama import decode_step
+
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 0, 256)
+    logits_ref, cache_ref = prefill(
+        params, tokens, init_kv_cache(CFG, 1), CFG
+    )
+    logits_q, cache_q = prefill(
+        params, tokens, init_kv_cache(CFG, 1, kv_dtype="int8"), CFG
+    )
+    forced = jax.random.randint(jax.random.PRNGKey(2), (12,), 0, 256)
+    scale = float(jnp.std(logits_ref))
+    for i in range(12):
+        tok = forced[i][None]
+        logits_ref, cache_ref = decode_step(params, tok, cache_ref, CFG)
+        logits_q, cache_q = decode_step(params, tok, cache_q, CFG)
+        err = float(jnp.max(jnp.abs(logits_ref - logits_q)))
+        assert err < 0.15 * scale, (i, err, scale)
+
+
+def test_generate_batch_int8_matches_single():
+    """The vector-length decode path (per-row scatter writes) under
+    int8 must equal the scalar path under int8 — same quantized values
+    either way."""
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    eng = ServeEngine(cfg=CFG, params=params, kv_dtype="int8")
+    prompts = ["alpha", "beta longer prompt"]
+    batched = eng.generate_batch(prompts, max_new_tokens=8)
+    for prompt, row in zip(prompts, batched):
+        single = [e.token_id for e in eng.generate(prompt, max_new_tokens=8)]
+        assert row == single
+
+
+def test_prefix_cache_int8():
+    """Prefix snapshots (clone + tile across batch) work on the dict
+    representation."""
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    eng = ServeEngine(cfg=CFG, params=params, kv_dtype="int8")
+    prefix = "system: answer briefly. "
+    full = [
+        e.token_id
+        for e in eng.generate("query one", max_new_tokens=8, prefix=prefix)
+    ]
+    plain = [
+        e.token_id
+        for e in eng.generate(prefix + "query one", max_new_tokens=8)
+    ]
+    assert full == plain
+    rows = eng.generate_batch(
+        ["query one", "query two"], max_new_tokens=8, prefix=prefix
+    )
+    assert rows[0] == full
+
+
+def test_continuous_batching_int8_parity():
+    from tpuslo.models.batching import ContinuousBatchingEngine
+
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    eng = ContinuousBatchingEngine(
+        cfg=CFG, params=params, max_slots=2, kv_dtype="int8"
+    )
+    ids = [
+        eng.submit("first request", max_new_tokens=8),
+        eng.submit("second", max_new_tokens=8),
+        eng.submit("third request overflows slots", max_new_tokens=8),
+    ]
+    results = eng.run()
+    single = ServeEngine(cfg=CFG, params=params, kv_dtype="int8")
+    for rid, prompt in zip(
+        ids, ["first request", "second", "third request overflows slots"]
+    ):
+        expect = [
+            e.token_id for e in single.generate(prompt, max_new_tokens=8)
+        ]
+        assert results[rid] == expect

@@ -219,3 +219,51 @@ def test_generate_batch_with_prefix_matches_streaming():
 
     with pytest.raises(ValueError, match="non-empty"):
         engine.generate_batch(["ok", ""], prefix=PREFIX)
+
+
+def test_generate_batch_long_prompts_chunked():
+    """Batched single-shot prompts past the largest bucket ingest via
+    lockstep chunked prefill and match the streaming engine row by row
+    (generate_batch used to silently truncate at the bucket)."""
+    cfg = llama_tiny(max_seq_len=256)
+    engine = ServeEngine(
+        cfg=cfg, params=init_params(jax.random.PRNGKey(0), cfg),
+        prefill_buckets=(32, 64),
+    )
+    cap = engine.prefill_buckets[-1]
+    prompts = [
+        "a" * (cap + 37),          # crosses one chunk boundary
+        "short prompt",            # ends in the head chunk
+        "b" * (2 * cap + 5),       # crosses two chunk boundaries
+    ]
+    rows = engine.generate_batch(prompts, max_new_tokens=6, stop_at_eos=False)
+    for prompt, row in zip(prompts, rows):
+        single = [
+            e.token_id
+            for e in engine.generate(prompt, max_new_tokens=6, stop_at_eos=False)
+        ]
+        assert row == single, prompt[:20]
+
+
+def test_generate_batch_long_prefix_long_suffix():
+    """Prefix path with suffixes past the largest bucket: tiled prefix
+    KV + chunked suffix appends must equal streaming prefix serving."""
+    cfg = llama_tiny(max_seq_len=512)
+    engine = ServeEngine(
+        cfg=cfg, params=init_params(jax.random.PRNGKey(0), cfg),
+        prefill_buckets=(32, 64),
+    )
+    cap = engine.prefill_buckets[-1]
+    prefix = ("p" * (cap + 20))
+    users = ["u" * (cap + 9), "v" * 11]
+    rows = engine.generate_batch(
+        users, max_new_tokens=6, stop_at_eos=False, prefix=prefix
+    )
+    for user, row in zip(users, rows):
+        single = [
+            e.token_id
+            for e in engine.generate(
+                user, max_new_tokens=6, stop_at_eos=False, prefix=prefix
+            )
+        ]
+        assert row == single

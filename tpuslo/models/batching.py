@@ -44,14 +44,20 @@ PyTree = Any
 
 
 def _inject_row(cache: PyTree, row: PyTree, slot: jax.Array) -> PyTree:
-    """Splice a single-row cache into ``slot`` of the batched cache."""
+    """Splice a single-row cache into ``slot`` of the batched cache.
+
+    ``jax.tree.map`` covers both KV representations: dense 5-D array
+    leaves and int8 {"q" 5-D, "s" 4-D} leaves — the batch axis is axis
+    1 of every leaf, and the per-leaf index tuple pads zeros to rank.
+    """
     zero = jnp.asarray(0, jnp.int32)
-    k = lax.dynamic_update_slice(
-        cache["k"], row["k"], (zero, slot, zero, zero, zero)
-    )
-    v = lax.dynamic_update_slice(
-        cache["v"], row["v"], (zero, slot, zero, zero, zero)
-    )
+
+    def splice(pool, r):
+        idx = (zero, slot) + (zero,) * (pool.ndim - 2)
+        return lax.dynamic_update_slice(pool, r, idx)
+
+    k = jax.tree.map(splice, cache["k"], row["k"])
+    v = jax.tree.map(splice, cache["v"], row["v"])
     lengths = cache["length"].at[slot].set(row["length"])
     return {"k": k, "v": v, "length": lengths}
 
@@ -65,6 +71,10 @@ class _Request:
     prefix: str | None = None
     tokens: list[int] = field(default_factory=list)
     done: bool = False
+    # Ingested prompt state, kept across capacity-blocked admission
+    # attempts so a blocked request pays its prefill ONCE, not once per
+    # decode step while it waits (the paged engine can block on blocks).
+    ingested: tuple | None = None
 
 
 class ContinuousBatchingEngine:
@@ -83,9 +93,11 @@ class ContinuousBatchingEngine:
         rng_seed: int = 0,
         prefill_buckets: tuple[int, ...] = (32, 64, 128, 256),
         quantize: bool = False,
+        kv_dtype: str = "bf16",
     ):
         from tpuslo.models.llama import init_params, init_params_quantized
 
+        self.kv_dtype = kv_dtype
         self.cfg = cfg or llama_tiny(max_seq_len=512)
         if params is None:
             params = (
@@ -104,16 +116,15 @@ class ContinuousBatchingEngine:
         from tpuslo.models.serve import ServeEngine
 
         self._ingest = ServeEngine(
-            cfg=self.cfg, params=self.params, prefill_buckets=prefill_buckets
+            cfg=self.cfg, params=self.params, prefill_buckets=prefill_buckets,
+            kv_dtype=kv_dtype,
         )
         self._step = jax.jit(
             partial(decode_step, cfg=self.cfg), donate_argnums=(2,)
         )
         self._inject = jax.jit(_inject_row, donate_argnums=(0,))
 
-        cache = init_kv_cache(self.cfg, max_slots)
-        cache["length"] = jnp.zeros((max_slots,), jnp.int32)
-        self._cache = cache
+        self._cache = self._init_decode_state()
         self._tokens = jnp.full((max_slots,), BOS, jnp.int32)
 
         self._queue: list[_Request] = []
@@ -122,6 +133,28 @@ class ContinuousBatchingEngine:
         self.steps = 0
         #: finished request id -> emitted token ids
         self.results: dict[int, list[int]] = {}
+
+    # -- decode-state hooks (overridden by the paged engine) -------------
+
+    def _init_decode_state(self) -> PyTree:
+        cache = init_kv_cache(self.cfg, self.max_slots, kv_dtype=self.kv_dtype)
+        cache["length"] = jnp.zeros((self.max_slots,), jnp.int32)
+        return cache
+
+    def _install_row(self, slot: int, row_cache: PyTree, req: _Request) -> bool:
+        """Splice an ingested row into ``slot``; False = no capacity
+        (the paged engine's block pool can run dry — dense never does)."""
+        self._cache = self._inject(
+            self._cache, row_cache, jnp.asarray(slot, jnp.int32)
+        )
+        return True
+
+    def _decode_tokens(self):
+        logits, self._cache = self._step(self.params, self._tokens, self._cache)
+        return logits
+
+    def _release_slot(self, slot: int) -> None:
+        """Called when a request leaves its slot (done or cancelled)."""
 
     # -- submission ------------------------------------------------------
 
@@ -145,10 +178,10 @@ class ContinuousBatchingEngine:
         self._queue.append(req)
         return req.request_id
 
-    def _admit(self, slot: int, req: _Request) -> None:
-        logits, row_cache, total_len = self._ingest.ingest_prompt(
-            req.prompt, req.prefix
-        )
+    def _admit(self, slot: int, req: _Request) -> bool:
+        if req.ingested is None:
+            req.ingested = self._ingest.ingest_prompt(req.prompt, req.prefix)
+        logits, row_cache, total_len = req.ingested
         # The exact budget single-request serving applies (chunk-rounded
         # KV cap): the parity contract requires identical truncation,
         # and past raw capacity the per-row scatter would drop
@@ -159,27 +192,35 @@ class ContinuousBatchingEngine:
         cap_tokens = self._ingest.decode_cap_tokens(total_len)
         req.max_new_tokens = max(1, min(req.max_new_tokens, cap_tokens))
         first = int(jnp.argmax(logits, axis=-1)[0])
-        req.tokens.append(first)
         if (req.stop_at_eos and first == EOS) or req.max_new_tokens <= 1:
+            req.ingested = None
+            req.tokens.append(first)
             req.done = True
             self.results[req.request_id] = req.tokens
-            return
-        # _inject_row turns the row's scalar length into the slot's
-        # vector entry.
-        self._cache = self._inject(
-            self._cache, row_cache, jnp.asarray(slot, jnp.int32)
-        )
+            return True
+        # _install_row turns the row's scalar length into the slot's
+        # vector entry (or, paged, scatters the row into pool blocks).
+        # A False return means no KV capacity right now: the request
+        # goes back to the queue head UNMODIFIED and waits for a slot
+        # release to free blocks.
+        if not self._install_row(slot, row_cache, req):
+            self._queue.insert(0, req)
+            return False
+        req.ingested = None  # row spliced into the batch cache; drop it
+        req.tokens.append(first)
         self._tokens = self._tokens.at[slot].set(first)
         self._slots[slot] = req
+        return True
 
     def _fill_slots(self) -> None:
         for slot in range(self.max_slots):
             # Keep admitting into this slot until something occupies it
             # (instantly-completing requests leave it free) or the
             # queue drains — afterwards the queue is empty unless every
-            # slot is busy.
+            # slot is busy or admission is blocked on KV capacity.
             while self._slots[slot] is None and self._queue:
-                self._admit(slot, self._queue.pop(0))
+                if not self._admit(slot, self._queue.pop(0)):
+                    return
 
     # -- stepping --------------------------------------------------------
 
@@ -190,11 +231,16 @@ class ContinuousBatchingEngine:
         """
         self._fill_slots()
         if not any(self._slots):
-            # _fill_slots drains the queue unless slots are busy, so no
-            # active slot means no work at all — never dispatch a
-            # decode whose outputs nobody reads.
+            # _fill_slots drains the queue unless slots are busy or
+            # admission is blocked on KV capacity.  With zero active
+            # slots every block is free, so a capacity block here is
+            # impossible: the paged engine rejects never-admittable
+            # requests at install time (needs > whole pool), and
+            # anything smaller fits a fully-free pool.  No active slot
+            # therefore means no work — never dispatch a decode whose
+            # outputs nobody reads.
             return False
-        logits, self._cache = self._step(self.params, self._tokens, self._cache)
+        logits = self._decode_tokens()
         next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         self._tokens = next_tokens
         self.steps += 1
@@ -210,6 +256,7 @@ class ContinuousBatchingEngine:
                 req.done = True
                 self.results[req.request_id] = req.tokens
                 self._slots[slot] = None
+                self._release_slot(slot)
         return bool(self._queue) or any(self._slots)
 
     def cancel(self, request_id: int) -> None:
@@ -226,6 +273,7 @@ class ContinuousBatchingEngine:
         for slot, req in enumerate(self._slots):
             if req is not None and req.request_id == request_id:
                 self._slots[slot] = None
+                self._release_slot(slot)
 
     def partial_tokens(self, request_id: int) -> list[int] | None:
         """Copy of the tokens produced so far for a request.

@@ -486,13 +486,27 @@ def forward(
 # --- KV-cache decode path ----------------------------------------------
 
 
-def init_kv_cache(cfg: LlamaConfig, batch: int) -> PyTree:
+def init_kv_cache(cfg: LlamaConfig, batch: int, kv_dtype: str = "bf16") -> PyTree:
+    """Preallocated cache; ``kv_dtype="int8"`` stores K/V quantized
+    (half the decode-read bandwidth, ~2x the contexts per HBM byte) —
+    see :mod:`tpuslo.models.kv_cache`."""
+    from tpuslo.models import kv_cache as kvc
+
     shape = (cfg.n_layers, batch, cfg.max_seq_len, cfg.n_kv_heads, cfg.head_dim)
     return {
-        "k": jnp.zeros(shape, cfg.dtype),
-        "v": jnp.zeros(shape, cfg.dtype),
+        "k": kvc.init_kv(shape, cfg.dtype, kv_dtype),
+        "v": kvc.init_kv(shape, cfg.dtype, kv_dtype),
         "length": jnp.zeros((), jnp.int32),
     }
+
+
+def kv_cache_bytes(cfg: LlamaConfig, batch: int, kv_dtype: str = "bf16") -> int:
+    """HBM bytes both cache sides occupy — the capacity arithmetic the
+    int8-KV claim rests on."""
+    from tpuslo.models import kv_cache as kvc
+
+    shape = (cfg.n_layers, batch, cfg.max_seq_len, cfg.n_kv_heads, cfg.head_dim)
+    return 2 * kvc.kv_bytes(shape, cfg.dtype, kv_dtype)
 
 
 def prefill(
@@ -529,9 +543,11 @@ def prefill(
 
     h, (ks, vs) = lax.scan(scan_step, h, params["layers"])
 
+    from tpuslo.models import kv_cache as kvc
+
     cache = {
-        "k": lax.dynamic_update_slice(cache["k"], ks, (0, 0, 0, 0, 0)),
-        "v": lax.dynamic_update_slice(cache["v"], vs, (0, 0, 0, 0, 0)),
+        "k": kvc.kv_write_stacked(cache["k"], ks),
+        "v": kvc.kv_write_stacked(cache["v"], vs),
         "length": jnp.asarray(true_length, jnp.int32),
     }
     h = rms_norm(h, params["final_norm"], cfg.norm_eps)
@@ -557,6 +573,8 @@ def decode_step(
     if pos.ndim == 0:
         logits, cache = verify_chunk(params, token[:, None], cache, cfg)
         return logits[:, 0], {**cache, "length": pos + 1}
+    from tpuslo.models import kv_cache as kvc
+
     pos_vec = jnp.broadcast_to(pos, (B,))
     positions = pos_vec[:, None]
     h = _embed_lookup(params, token[:, None], cfg.dtype)
@@ -577,9 +595,12 @@ def decode_step(
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         # Per-row write positions: scatter one slot per row.
-        k_cache = k_cache.at[rows, pos_vec].set(k[:, 0])
-        v_cache = v_cache.at[rows, pos_vec].set(v[:, 0])
-        attn = attention(q, k_cache, v_cache, visible, H // KV)
+        k_cache = kvc.kv_write_rows(k_cache, k[:, 0], rows, pos_vec)
+        v_cache = kvc.kv_write_rows(v_cache, v[:, 0], rows, pos_vec)
+        attn = attention(
+            q, kvc.kv_load(k_cache, cfg.dtype),
+            kvc.kv_load(v_cache, cfg.dtype), visible, H // KV,
+        )
         h = h + _matmul(attn.reshape(B, 1, H * HD), layer["wo"])
         x = rms_norm(h, layer["mlp_norm"], cfg.norm_eps)
         gate = jax.nn.silu(_matmul(x, layer["w1"]).astype(jnp.float32))
@@ -663,6 +684,8 @@ def verify_chunk(
     invisible under the decode mask and get overwritten as generation
     proceeds — the same stale-slot discipline as bucketed prefill.
     """
+    from tpuslo.models import kv_cache as kvc
+
     B, K = tokens.shape
     start = cache["length"]  # scalar: verify runs on the shared path
     positions = jnp.broadcast_to(start + jnp.arange(K), (B, K))
@@ -682,9 +705,12 @@ def verify_chunk(
         v = _matmul(x, layer["wv"]).reshape(B, K, KV, HD)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        k_cache = lax.dynamic_update_slice(k_cache, k, (0, start, 0, 0))
-        v_cache = lax.dynamic_update_slice(v_cache, v, (0, start, 0, 0))
-        attn = attention(q, k_cache, v_cache, mask, H // KV)
+        k_cache = kvc.kv_write_seq(k_cache, k, start)
+        v_cache = kvc.kv_write_seq(v_cache, v, start)
+        attn = attention(
+            q, kvc.kv_load(k_cache, cfg.dtype),
+            kvc.kv_load(v_cache, cfg.dtype), mask, H // KV,
+        )
         h = h + _matmul(attn.reshape(B, K, H * HD), layer["wo"])
         x = rms_norm(h, layer["mlp_norm"], cfg.norm_eps)
         y = _dense_mlp(cfg, layer, x) if mlp_fn is None else mlp_fn(layer, x)

@@ -1,0 +1,320 @@
+"""Block-paged KV cache: slot count decoupled from ``max_seq_len``.
+
+The dense continuous-batching cache reserves ``max_seq_len`` KV rows
+per slot, so HBM — not compute — caps concurrency: a 2048-context
+config at 8 slots pins 16k token-rows even when every live request
+uses a few hundred.  vLLM solved this on GPU with paged attention;
+this is the static-shape TPU translation (VERDICT r02 next-round #2):
+
+* one physical **block pool** ``(L, n_blocks, block_size, KV, HD)``
+  shared by every slot — the only KV HBM the engine allocates;
+* a per-slot **page table** ``(slots, max_blocks_per_row)`` of int32
+  physical-block indices (logical block ``t // block_size`` of a row
+  lives at ``page_table[row, t // block_size]``);
+* a host-side free-list allocator; admission takes exactly the blocks
+  a request can ever touch (prompt + token budget), completion and
+  cancellation return them — so total *logical* capacity can exceed
+  the pool as long as *live* usage fits, which is the whole win;
+* every device op is fixed-shape: decode is one jitted step whose
+  gather ``pool[page_table]`` reassembles each row's logical KV, and
+  admission splices prompt KV block-by-block with a single compiled
+  copy kernel (``lax.dynamic_slice`` start + scalar physical index) —
+  no shape ever depends on a request, so nothing recompiles.
+
+Block 0 is reserved as the null block: unallocated page-table entries
+point at it, its garbage is masked by per-row lengths, and the write
+path never targets it.
+
+The pool composes with the int8 KV representation
+(:mod:`tpuslo.models.kv_cache`): pass ``kv_dtype="int8"`` and both the
+bandwidth halving and the reservation elimination stack.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpuslo.models import kv_cache as kvc
+from tpuslo.models.batching import ContinuousBatchingEngine, _Request
+from tpuslo.models.llama import (
+    LlamaConfig,
+    _embed_lookup,
+    _matmul,
+    apply_rope,
+    attention,
+    rms_norm,
+    rope_frequencies,
+)
+
+PyTree = Any
+
+
+def init_paged_pool(
+    cfg: LlamaConfig, n_blocks: int, block_size: int,
+    slots: int, kv_dtype: str = "bf16",
+) -> PyTree:
+    """Pool + page table + per-slot lengths.  ``n_blocks`` includes the
+    reserved null block 0."""
+    shape = (cfg.n_layers, n_blocks, block_size, cfg.n_kv_heads, cfg.head_dim)
+    max_blocks = -(-cfg.max_seq_len // block_size)
+    return {
+        "k": kvc.init_kv(shape, cfg.dtype, kv_dtype),
+        "v": kvc.init_kv(shape, cfg.dtype, kv_dtype),
+        "page_table": jnp.zeros((slots, max_blocks), jnp.int32),
+        "length": jnp.zeros((slots,), jnp.int32),
+    }
+
+
+def paged_pool_bytes(
+    cfg: LlamaConfig, n_blocks: int, block_size: int, kv_dtype: str = "bf16"
+) -> int:
+    """KV HBM the pool pins — the capacity arithmetic for sizing."""
+    shape = (cfg.n_layers, n_blocks, block_size, cfg.n_kv_heads, cfg.head_dim)
+    return 2 * kvc.kv_bytes(shape, cfg.dtype, kv_dtype)
+
+
+def inject_prompt_block(
+    state: PyTree, row_kv: PyTree, start, phys, cfg: LlamaConfig,
+    block_size: int,
+) -> PyTree:
+    """Copy one ``block_size`` window of a single-row dense cache
+    (``row_kv`` = {"k","v"} of shape (L, 1, S, KV, HD)) into physical
+    block ``phys``.  One compiled shape serves every (start, phys)."""
+    L, KV, HD = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+    start = jnp.asarray(start, jnp.int32)
+    phys = jnp.asarray(phys, jnp.int32)
+    zero = jnp.asarray(0, jnp.int32)
+
+    def move(pool, row):
+        # row leaf: (L, 1, S, KV[, HD]); pool leaf: (L, N, BS, KV[, HD])
+        src = lax.dynamic_slice(
+            row,
+            (zero, zero, start) + (zero,) * (row.ndim - 3),
+            (L, 1, block_size) + row.shape[3:],
+        )[:, 0]
+        idx = (zero, phys) + (zero,) * (pool.ndim - 2)
+        return lax.dynamic_update_slice(pool, src[:, None], idx)
+
+    return {
+        **state,
+        "k": jax.tree.map(move, state["k"], row_kv["k"]),
+        "v": jax.tree.map(move, state["v"], row_kv["v"]),
+    }
+
+
+def paged_decode_step(
+    params: PyTree, token: jax.Array, state: PyTree, cfg: LlamaConfig,
+    block_size: int,
+) -> tuple[jax.Array, PyTree]:
+    """One decode token for every slot against the paged pool.
+
+    Mirrors the vector-length path of
+    :func:`tpuslo.models.llama.decode_step`: per-row positions ride
+    ``state["length"]``; the KV write scatters into
+    ``(physical block, offset)`` resolved through the page table; the
+    attention operand is the gather ``pool[page_table]`` reshaped to
+    each row's logical sequence — per step that reads the same bytes a
+    dense cache would, so paging costs bandwidth nothing and buys the
+    reservation memory back.
+    """
+    B = token.shape[0]
+    pos = state["length"]  # (B,)
+    pt = state["page_table"]  # (B, MB)
+    MB = pt.shape[1]
+    blk = pos // block_size
+    phys = jnp.take_along_axis(pt, blk[:, None], axis=1)[:, 0]  # (B,)
+    off = pos % block_size
+
+    positions = pos[:, None]
+    h = _embed_lookup(params, token[:, None], cfg.dtype)
+    cos, sin = rope_frequencies(cfg, positions)
+    H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    T = MB * block_size
+    visible = (jnp.arange(T)[None, :] <= pos[:, None])[:, None, :]  # (B,1,T)
+
+    def write(pool, new):
+        # new: (B, KV, HD) -> scatter one (phys, off) slot per row.
+        if isinstance(pool, dict):
+            qs = kvc.quantize_kv(new)
+            return {
+                "q": pool["q"].at[phys, off].set(qs["q"]),
+                "s": pool["s"].at[phys, off].set(qs["s"]),
+            }
+        return pool.at[phys, off].set(new)
+
+    def gather(pool):
+        # (N, BS, KV, HD) -> (B, MB*BS, KV, HD) logical rows; quantized
+        # pools gather int8 + scales FIRST so HBM reads stay int8 and
+        # only the gathered rows dequantize.
+        if isinstance(pool, dict):
+            rows = kvc.kv_load(
+                {"q": pool["q"][pt], "s": pool["s"][pt]}, cfg.dtype
+            )
+        else:
+            rows = pool[pt]  # (B, MB, BS, KV, HD)
+        return rows.reshape(B, T, KV, HD)
+
+    def scan_step(h, inputs):
+        layer, k_pool, v_pool = inputs
+        x = rms_norm(h, layer["attn_norm"], cfg.norm_eps)
+        q = _matmul(x, layer["wq"]).reshape(B, 1, H, HD)
+        k = _matmul(x, layer["wk"]).reshape(B, 1, KV, HD)
+        v = _matmul(x, layer["wv"]).reshape(B, 1, KV, HD)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        k_pool = write(k_pool, k[:, 0])
+        v_pool = write(v_pool, v[:, 0])
+        attn = attention(q, gather(k_pool), gather(v_pool), visible, H // KV)
+        h = h + _matmul(attn.reshape(B, 1, H * HD), layer["wo"])
+        x = rms_norm(h, layer["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(_matmul(x, layer["w1"]).astype(jnp.float32))
+        up = _matmul(x, layer["w3"]).astype(jnp.float32)
+        h = h + _matmul((gate * up).astype(cfg.dtype), layer["w2"])
+        return h, (k_pool, v_pool)
+
+    h, (ks, vs) = lax.scan(
+        scan_step, h, (params["layers"], state["k"], state["v"])
+    )
+    state = {**state, "k": ks, "v": vs, "length": pos + 1}
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps)
+    logits = _matmul(h[:, 0, :], params["output"]).astype(jnp.float32)
+    return logits, state
+
+
+class PagedBatchingEngine(ContinuousBatchingEngine):
+    """Continuous batching over a paged pool.
+
+    Same external API and per-request outputs as the dense engine
+    (tested); different capacity model: ``n_blocks`` bounds *live* KV
+    tokens, not per-slot reservations, so more slots fit the same HBM.
+    Admission backpressure is real — a request whose blocks aren't
+    free waits at the queue head until a completion releases some.
+    """
+
+    def __init__(
+        self,
+        cfg: LlamaConfig | None = None,
+        params=None,
+        max_slots: int = 4,
+        n_blocks: int | None = None,
+        block_size: int = 64,
+        rng_seed: int = 0,
+        prefill_buckets: tuple[int, ...] = (32, 64, 128, 256),
+        quantize: bool = False,
+        kv_dtype: str = "bf16",
+    ):
+        self.block_size = block_size
+        # Default pool: half the dense reservation — the honest claim
+        # this engine makes is "same workloads, half the KV HBM".
+        cfg_eff = cfg if cfg is not None else None
+        if n_blocks is None:
+            from tpuslo.models.llama import llama_tiny
+
+            c = cfg_eff or llama_tiny(max_seq_len=512)
+            n_blocks = 1 + max_slots * (-(-c.max_seq_len // block_size)) // 2
+        self.n_blocks = n_blocks
+        self._free: list[int] = []
+        self._slot_blocks: list[list[int]] = []
+        super().__init__(
+            cfg=cfg, params=params, max_slots=max_slots, rng_seed=rng_seed,
+            prefill_buckets=prefill_buckets, quantize=quantize,
+            kv_dtype=kv_dtype,
+        )
+        self._paged_step = jax.jit(
+            partial(
+                paged_decode_step, cfg=self.cfg, block_size=self.block_size
+            ),
+            donate_argnums=(2,),
+        )
+        self._inject_block = jax.jit(
+            partial(
+                inject_prompt_block, cfg=self.cfg, block_size=self.block_size
+            ),
+            donate_argnums=(0,),
+        )
+
+    # -- hooks -----------------------------------------------------------
+
+    def _init_decode_state(self) -> PyTree:
+        state = init_paged_pool(
+            self.cfg, self.n_blocks, self.block_size, self.max_slots,
+            kv_dtype=self.kv_dtype,
+        )
+        # Block 0 is the null target of unallocated page-table entries.
+        self._free = list(range(1, self.n_blocks))
+        self._slot_blocks = [[] for _ in range(self.max_slots)]
+        return state
+
+    def _blocks_needed(self, total_len: int, max_new: int) -> int:
+        # A request can touch positions [0, total_len + max_new): the
+        # prompt plus every generated token's KV write.
+        return -(-(total_len + max_new) // self.block_size)
+
+    def _install_row(self, slot: int, row_cache: PyTree, req: _Request) -> bool:
+        total_len = int(row_cache["length"])
+        need = self._blocks_needed(total_len, req.max_new_tokens)
+        if need > self.n_blocks - 1:
+            raise ValueError(
+                f"request needs {need} blocks but the pool only has "
+                f"{self.n_blocks - 1}; raise n_blocks or lower "
+                "max_new_tokens/prompt length"
+            )
+        if need > len(self._free):
+            return False  # backpressure: wait for a release
+        blocks = [self._free.pop() for _ in range(need)]
+        self._slot_blocks[slot] = blocks
+        pt = self._cache["page_table"]
+        row = jnp.zeros((pt.shape[1],), jnp.int32)
+        row = row.at[jnp.arange(len(blocks))].set(jnp.asarray(blocks))
+        self._cache["page_table"] = pt.at[slot].set(row)
+        self._cache["length"] = self._cache["length"].at[slot].set(total_len)
+        # Copy the prompt's KV block-by-block (one compiled shape).
+        row_kv = {"k": row_cache["k"], "v": row_cache["v"]}
+        n_prompt_blocks = -(-total_len // self.block_size)
+        for i in range(n_prompt_blocks):
+            self._cache = self._inject_block(
+                self._cache, row_kv,
+                jnp.asarray(i * self.block_size, jnp.int32),
+                jnp.asarray(blocks[i], jnp.int32),
+            )
+        return True
+
+    def _decode_tokens(self):
+        logits, self._cache = self._paged_step(
+            self.params, self._tokens, self._cache
+        )
+        return logits
+
+    def _release_slot(self, slot: int) -> None:
+        self._free.extend(self._slot_blocks[slot])
+        self._slot_blocks[slot] = []
+        # Point the empty slot's page table at the null block and park
+        # its write position at 0: paged_decode_step writes one slot
+        # for EVERY batch row each step (parked lanes included), and a
+        # stale table would keep writing through freed blocks after the
+        # allocator hands them to another request — silent KV
+        # corruption of the new owner.
+        pt = self._cache["page_table"]
+        self._cache["page_table"] = pt.at[slot].set(
+            jnp.zeros((pt.shape[1],), jnp.int32)
+        )
+        self._cache["length"] = self._cache["length"].at[slot].set(0)
+
+    # -- telemetry -------------------------------------------------------
+
+    def stats(self) -> dict[str, int | float]:
+        out = super().stats()
+        live = (self.n_blocks - 1) - len(self._free)
+        out.update(
+            {
+                "pool_blocks": self.n_blocks - 1,
+                "blocks_live": live,
+                "block_utilization": live / max(1, self.n_blocks - 1),
+            }
+        )
+        return out

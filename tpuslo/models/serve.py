@@ -130,13 +130,22 @@ def serve_param_shardings(params, mesh):
     )
 
 
-def kv_cache_shardings(mesh):
-    """KV cache (L, B, S, KV, HD): shard KV heads over tp."""
+def kv_cache_shardings(mesh, kv_dtype: str = "bf16"):
+    """KV cache (L, B, S, KV, HD): shard KV heads over tp.
+
+    int8 caches shard ``q`` like the dense buffer and ``s`` (which
+    drops the trailing head_dim axis) on the same KV-head axis."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    side = NamedSharding(mesh, P(None, None, None, "tp", None))
+    if kv_dtype == "int8":
+        side = {
+            "q": side,
+            "s": NamedSharding(mesh, P(None, None, None, "tp")),
+        }
     return {
-        "k": NamedSharding(mesh, P(None, None, None, "tp", None)),
-        "v": NamedSharding(mesh, P(None, None, None, "tp", None)),
+        "k": side,
+        "v": side,
         "length": NamedSharding(mesh, P()),
     }
 
@@ -178,7 +187,15 @@ class ServeEngine:
         decode_chunk_size: int = 64,
         quantize: bool = False,
         mesh=None,
+        kv_dtype: str = "bf16",
     ):
+        from tpuslo.models.kv_cache import KV_DTYPES
+
+        if kv_dtype not in KV_DTYPES:
+            raise ValueError(
+                f"kv_dtype must be one of {KV_DTYPES}, got {kv_dtype!r}"
+            )
+        self.kv_dtype = kv_dtype
         self.cfg = cfg or llama_tiny(max_seq_len=512)
         self.mesh = mesh
         if mesh is not None:
@@ -193,7 +210,7 @@ class ServeEngine:
                     f"and n_heads={self.cfg.n_heads} (pick a larger config "
                     "or a smaller tp)"
                 )
-            self._cache_shardings = kv_cache_shardings(mesh)
+            self._cache_shardings = kv_cache_shardings(mesh, kv_dtype)
         init_fn = partial(
             init_params_quantized if quantize else init_params, cfg=self.cfg
         )
@@ -271,7 +288,7 @@ class ServeEngine:
 
 
     def _new_cache(self, batch: int):
-        cache = init_kv_cache(self.cfg, batch)
+        cache = init_kv_cache(self.cfg, batch, kv_dtype=self.kv_dtype)
         if self.mesh is not None:
             cache = jax.device_put(cache, self._cache_shardings)
         return cache
@@ -317,11 +334,6 @@ class ServeEngine:
         jax.block_until_ready(toks)
         return (time.perf_counter() - start) * 1000.0
 
-    def _max_prompt(self) -> int:
-        """Longest accepted prompt: largest bucket, and always at least
-        one generated token's worth of KV room."""
-        return max(1, min(self.prefill_buckets[-1], self.cfg.max_seq_len - 2))
-
     def decode_cap_tokens(self, longest_prompt_len: int) -> int:
         """Token cap :meth:`_decode_budget` grants, without
         materializing (and possibly compiling) the decode fn — the
@@ -360,15 +372,16 @@ class ServeEngine:
         """Throughput-oriented batched decode; one list of token ids
         per prompt.
 
-        All prompts share one prefill bucket (sized by the longest) and
-        one decode stream; per-row prompt lengths ride the vector
-        ``cache["length"]`` path so shorter rows are not conditioned on
-        pad positions.  Prompts truncate at the largest bucket (the
-        single-shot shared prefill has no chunked path yet — streaming
-        ``generate``/``ingest_prompt`` accepts up to full KV capacity).  The batch dimension pads to ``batch_buckets``
-        so each (batch, bucket) pair compiles once.  Aggregate
-        tokens/sec scales with the batch on the MXU — decode at B=1
-        leaves almost the whole systolic array idle.
+        All prompts share lockstep prefill chunks (sized by the
+        longest) and one decode stream; per-row prompt lengths ride the
+        vector ``cache["length"]`` path so shorter rows are not
+        conditioned on pad positions.  Prompts up to full KV capacity
+        ingest via batched chunked prefill (:meth:`_prefill_rows`) —
+        the same no-recompile discipline as streaming ``generate``.
+        The batch dimension pads to ``batch_buckets`` so each (batch,
+        bucket) pair compiles once.  Aggregate tokens/sec scales with
+        the batch on the MXU — decode at B=1 leaves almost the whole
+        systolic array idle.
 
         ``prefix`` serves a shared prompt prefix from the KV prefix
         cache: the snapshot is tiled across the batch rows and only the
@@ -403,48 +416,36 @@ class ServeEngine:
         if prefix:
             entry = self.cache_prefix(prefix)
             start = len(entry.ids)
-            room = min(
-                self.prefill_buckets[-1], self.cfg.max_seq_len - 2 - start
-            )
+            room = self.cfg.max_seq_len - 2 - start
             ids = [list(p.encode("utf-8"))[: max(1, room)] for p in prompts]
         else:
             entry = None
             start = 0
-            ids = [encode_bytes(p, self._max_prompt()) for p in prompts]
+            ids = [encode_bytes(p, max(1, self.cfg.max_seq_len - 2)) for p in prompts]
         n_real = len(ids)
         batch = _bucket(n_real, batch_buckets)
         ids += [[0 if prefix else BOS]] * (batch - n_real)
 
         lens = [len(row) for row in ids]
-        bucket = _bucket(max(lens), self.prefill_buckets)
-        bucket = min(bucket, self.cfg.max_seq_len - start)
-        tokens = jnp.asarray(
-            [row + [0] * (bucket - len(row)) for row in ids], jnp.int32
-        )
         # The row with the longest prompt bounds every row's budget.
         decode_fn, chunk, cap_tokens = self._decode_budget(start + max(lens))
         max_new_tokens = max(1, min(max_new_tokens, cap_tokens))
 
         if entry is not None:
             # Tile the single-row snapshot across the batch; the suffix
-            # pass writes at the shared prefix length with per-row true
-            # lengths, the same vector-length contract as bucketed
+            # chunks write at the shared prefix length with per-row
+            # true lengths, the same vector-length contract as bucketed
             # prefill at position 0.
+            from tpuslo.models.kv_cache import kv_map
+
+            tile = lambda a: jnp.repeat(a, batch, axis=1)  # noqa: E731
             kv = {
-                "k": jnp.repeat(entry.cache["k"], batch, axis=1),
-                "v": jnp.repeat(entry.cache["v"], batch, axis=1),
+                "k": kv_map(tile, entry.cache["k"]),
+                "v": kv_map(tile, entry.cache["v"]),
             }
-            logits, cache = self._suffix_prefill(
-                self.params, tokens, kv,
-                jnp.asarray(start, jnp.int32),
-                jnp.asarray(lens, jnp.int32),
-            )
+            logits, cache = self._prefill_rows(ids, start, kv=kv)
         else:
-            cache = self._new_cache(batch)
-            logits, cache = self._prefill(
-                self.params, tokens, cache,
-                true_length=jnp.asarray(lens, jnp.int32),
-            )
+            logits, cache = self._prefill_rows(ids, 0)
         token = prefill_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         # Dispatch the first decode chunk before the host-side read of
         # the prefill tokens, as generate() does: the device decodes
@@ -475,6 +476,73 @@ class ServeEngine:
             produced += toks.shape[1]
             toks, token = next_toks, next_token
         return outputs[:n_real]
+
+    def _prefill_rows(self, rows: list[list[int]], start: int, kv=None):
+        """Batched chunked ingestion of encoded rows at scalar ``start``.
+
+        The batched analog of :meth:`_ingest_ids`: every row chunk-
+        prefills in lockstep through the same bucket shapes, so a batch
+        of prompts longer than the largest bucket ingests without
+        per-length compiles (the single-shot ``generate_batch`` used to
+        truncate at the largest bucket).  ``kv`` carries a tiled prefix
+        snapshot ({"k", "v"}) for the prefix path; ``start`` is the
+        shared prefix length (0 for plain prompts).
+
+        Rows may have different lengths: each chunk passes per-row true
+        lengths clamped into the chunk, final next-token logits are
+        accumulated on device from whichever chunk a row ends in, and
+        the returned cache's ``length`` vector is set to the exact
+        per-row ``start + len(row)`` afterwards — KV written past a
+        row's true length (lockstep pad slots) sits above ``length``,
+        so decode masks it and overwrites it, the same stale-slot
+        discipline as bucketed prefill.
+        """
+        B = len(rows)
+        lens = [len(r) for r in rows]
+        maxlen = max(lens)
+        assert start + maxlen <= self.cfg.max_seq_len, "caller bounds capacity"
+        final_logits = None
+        cache = None
+        pos = 0
+        while pos < maxlen:
+            take = min(self.prefill_buckets[-1], maxlen - pos)
+            bucket = _bucket(take, self.prefill_buckets)
+            bucket = min(bucket, self.cfg.max_seq_len - (start + pos))
+            take = min(take, bucket)
+            chunk_rows = [row[pos : pos + take] for row in rows]
+            tokens = jnp.asarray(
+                [cr + [0] * (bucket - len(cr)) for cr in chunk_rows], jnp.int32
+            )
+            tl = jnp.asarray(
+                [min(max(length - pos, 1), take) for length in lens], jnp.int32
+            )
+            if pos == 0 and start == 0 and kv is None:
+                cache = self._new_cache(B)
+                logits, cache = self._prefill(
+                    self.params, tokens, cache, true_length=tl
+                )
+            else:
+                kv_now = kv if pos == 0 else {"k": cache["k"], "v": cache["v"]}
+                logits, cache = self._suffix_prefill(
+                    self.params, tokens, kv_now,
+                    jnp.asarray(start + pos, jnp.int32), tl,
+                )
+            # Keep each row's logits from the chunk it ends in (device-
+            # side select: no per-chunk host round-trip).
+            ends = jnp.asarray(
+                [pos < length <= pos + take for length in lens], jnp.bool_
+            )
+            if final_logits is None:
+                final_logits = logits
+            else:
+                final_logits = jnp.where(ends[:, None], logits, final_logits)
+            pos += take
+        cache = {
+            **cache,
+            "length": jnp.asarray(start, jnp.int32)
+            + jnp.asarray(lens, jnp.int32),
+        }
+        return final_logits, cache
 
     def prefill_ids(self, ids: list[int]):
         """Bucketed single-row prefill of already-encoded ids.
@@ -521,12 +589,9 @@ class ServeEngine:
 
     def _clone_cache(self, cache):
         """Fresh device buffers so donated consumers can't free the
-        prefix snapshot."""
-        return {
-            "k": jnp.copy(cache["k"]),
-            "v": jnp.copy(cache["v"]),
-            "length": jnp.copy(cache["length"]),
-        }
+        prefix snapshot.  jax.tree.map handles both KV representations
+        (dense array leaves, int8 {"q","s"} dict leaves)."""
+        return jax.tree.map(jnp.copy, cache)
 
     def _record_compile(self, kind: str, bucket: int, elapsed_ms: float) -> None:
         """First slow hit on a shape is (almost always) a compile;
