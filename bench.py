@@ -72,32 +72,40 @@ def bench_attribution_robustness() -> dict:
     actually degrades (and guards against regressions hiding under a
     saturated clean score).
     """
-    import copy
-
-    import numpy as np
-
     from tpuslo import attribution
+    from tpuslo.attribution.calibrate import (
+        calibrated_attributor,
+        corrupt,
+        heldout_report,
+    )
 
     samples = _fault_samples(25)
+    # Calibrated path (VERDICT r02 next-round #4): soft graded evidence
+    # over an empirically fitted likelihood table, validated on held-out
+    # noise seeds, a held-out noise family (gamma), and fault profiles
+    # the generator never emits.  Bar: >=0.85 macro-F1 at sigma=0.5
+    # (reference methodology's single-fault threshold).  One corruption
+    # protocol (calibrate.corrupt, seed 42 — the same draw sequence as
+    # the r01/r02 inline sweep) for both attributors.
+    attributor = calibrated_attributor()
     sweep = {}
+    calibrated = {}
     for sigma in (0.1, 0.25, 0.5, 1.0):
-        rs = np.random.RandomState(42)
-        noisy = []
-        for sample in samples:
-            s = copy.deepcopy(sample)
-            sig = s.signals
-            for key, value in list(sig.items()):
-                if rs.rand() < 0.15 * sigma:
-                    sig[key] = 0.0  # dropped probe (shedding / ring loss)
-                else:
-                    sig[key] = float(value) * float(
-                        np.exp(rs.normal(0.0, sigma))
-                    )
-            noisy.append(s)
+        noisy = corrupt(samples, sigma, seed=42)
         predictions = attribution.build_attributions(noisy, mode="bayes")
-        report = attribution.macro_f1(noisy, predictions)
-        sweep[str(sigma)] = round(report.macro_f1, 4)
-    return {"noise_macro_f1": sweep}
+        sweep[str(sigma)] = round(
+            attribution.macro_f1(noisy, predictions).macro_f1, 4
+        )
+        predictions = attributor.attribute_batch(noisy)
+        calibrated[str(sigma)] = round(
+            attribution.macro_f1(noisy, predictions).macro_f1, 4
+        )
+
+    return {
+        "noise_macro_f1": sweep,
+        "calibrated_noise_macro_f1": calibrated,
+        "calibrated_heldout": heldout_report(attributor).to_dict(),
+    }
 
 
 def bench_agent_overhead() -> dict:

@@ -1,0 +1,159 @@
+"""Noise-calibrated attribution: soft evidence, fitted likelihoods,
+held-out validation (VERDICT r02 next-round #4).
+
+The acceptance bar comes from the reference methodology's single-fault
+threshold (macro-F1 >= 0.85,
+``/root/reference/docs/benchmarks/llm-slo-attribution-accuracy.md``
+Success Thresholds), applied at sigma=0.5 noise on held-out seeds.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tpuslo import attribution
+from tpuslo.attribution import bayesian as B
+from tpuslo.attribution import calibrate as C
+
+
+class TestSoftEvidence:
+    def test_weight_half_at_warning_threshold(self):
+        assert B.soft_evidence_weight("dns_latency_ms", 40.0) == pytest.approx(0.5)
+
+    def test_weight_grows_with_value(self):
+        w_low = B.soft_evidence_weight("dns_latency_ms", 45.0)
+        w_err = B.soft_evidence_weight("dns_latency_ms", 120.0)
+        w_deep = B.soft_evidence_weight("dns_latency_ms", 500.0)
+        assert 0.5 < w_low < w_err < w_deep < 1.0
+
+    def test_weight_zero_for_nonpositive_and_unknown(self):
+        assert B.soft_evidence_weight("dns_latency_ms", 0.0) == 0.0
+        assert B.soft_evidence_weight("dns_latency_ms", -3.0) == 0.0
+        assert B.soft_evidence_weight("not_a_signal", 99.0) == 0.0
+
+    def test_extreme_values_saturate_without_overflow(self):
+        import math
+
+        hi = B.soft_evidence_weight("dns_latency_ms", 1e12)
+        lo = B.soft_evidence_weight("dns_latency_ms", 1e-12)
+        assert math.isfinite(hi) and math.isfinite(lo)
+        assert 0.0 <= lo < 0.01 and 0.99 < hi <= 1.0
+
+    def test_hard_mode_unchanged_by_soft_params(self):
+        """Default construction is hard mode — reference parity paths
+        (elevation thresholds, binary evidence) must be untouched."""
+        attributor = B.BayesianAttributor()
+        assert attributor.evidence == "hard"
+
+    def test_invalid_evidence_mode_rejected(self):
+        with pytest.raises(ValueError):
+            B.BayesianAttributor(evidence="fuzzy")
+
+    def test_soft_zero_value_is_unobserved_not_healthy(self):
+        """A dropped continuous probe (exact 0.0) must not count as
+        negative evidence; a zero counter still counts as healthy."""
+        attributor = B.BayesianAttributor(evidence="soft")
+        observed, _ = attributor._observed_and_weights(
+            {"dns_latency_ms": 0.0, "tcp_retransmits_total": 0.0}
+        )
+        assert "dns_latency_ms" not in observed
+        assert "tcp_retransmits_total" in observed
+
+    def test_soft_batch_matches_scalar(self):
+        from datetime import datetime, timezone
+
+        from tpuslo.faultreplay import generate_fault_samples
+
+        start = datetime(2026, 1, 1, tzinfo=timezone.utc)
+        samples = []
+        for scenario in C.TPU_SCENARIOS:
+            samples.extend(generate_fault_samples(scenario, 3, start))
+        samples = C.corrupt(samples, 0.5, 11)
+        attributor = B.BayesianAttributor(evidence="soft")
+        batch = attributor.attribute_batch(samples)
+        scalar = [attributor.attribute_sample(s) for s in samples]
+        for b, s in zip(batch, scalar):
+            assert b.predicted_fault_domain == s.predicted_fault_domain
+            assert b.confidence == pytest.approx(s.confidence, abs=1e-9)
+            assert [h.domain for h in b.fault_hypotheses] == [
+                h.domain for h in s.fault_hypotheses
+            ]
+
+
+class TestCalibration:
+    def test_fit_is_deterministic(self):
+        t1 = C.fit_likelihoods()
+        t2 = C.fit_likelihoods()
+        assert t1 == t2
+
+    def test_fit_recalibrates_noisy_healthy_signal(self):
+        """hbm_utilization_pct (healthy 62, warning 85) crosses its
+        threshold often under noise; the fitted healthy columns must be
+        far above the hand-set 0.05 — that miscalibration was the r02
+        robustness collapse."""
+        table = C.fit_likelihoods()
+        hand = B.default_likelihoods()
+        assert (
+            table["hbm_utilization_pct"][B.DOMAIN_NETWORK_DNS]
+            > hand["hbm_utilization_pct"][B.DOMAIN_NETWORK_DNS] + 0.05
+        )
+
+    def test_fitted_sharpness_matches_shipped_default(self):
+        assert C.fit_sharpness() == B.DEFAULT_EVIDENCE_SHARPNESS
+
+    def test_heldout_noise_beats_bar_at_sigma_05(self):
+        """The acceptance bar: >= 0.85 macro-F1 at sigma=0.5 on held-out
+        noise — for BOTH the training noise family (held-out seed) and
+        the held-out gamma family."""
+        report = C.heldout_report()
+        assert report.clean >= 0.99
+        assert report.lognormal["0.5"] >= 0.85
+        assert report.gamma["0.5"] >= 0.85
+
+    def test_heldout_beats_hard_mode_everywhere(self):
+        """The calibrated attributor must dominate the hard-threshold
+        attributor across the sweep (the point of calibrating)."""
+        hard = B.BayesianAttributor()
+        soft = C.calibrated_attributor()
+        hard_rep = C.heldout_report(hard)
+        soft_rep = C.heldout_report(soft)
+        for sigma in ("0.25", "0.5", "1.0"):
+            assert soft_rep.lognormal[sigma] >= hard_rep.lognormal[sigma]
+            assert soft_rep.variant_profiles[sigma] >= (
+                hard_rep.variant_profiles[sigma] - 1e-9
+            )
+
+    def test_variant_profiles_clean_perfect(self):
+        """Profiles the generator never emits (milder magnitudes) must
+        attribute perfectly when clean — proof the fit generalizes
+        beyond the training magnitudes."""
+        attributor = C.calibrated_attributor()
+        samples = C.variant_samples(10)
+        predictions = attributor.attribute_batch(samples)
+        assert attribution.macro_f1(samples, predictions).macro_f1 == 1.0
+
+    def test_cli_calibrated_evidence(self, tmp_path):
+        from tpuslo.cli.attributor import main
+
+        from tpuslo.faultreplay import generate_fault_samples
+        from datetime import datetime, timezone
+        import json
+
+        start = datetime(2026, 1, 1, tzinfo=timezone.utc)
+        samples = C.corrupt(
+            generate_fault_samples("tpu_mixed", 8, start), 0.5, 5
+        )
+        inp = tmp_path / "samples.jsonl"
+        inp.write_text(
+            "\n".join(json.dumps(s.to_dict()) for s in samples) + "\n"
+        )
+        out = tmp_path / "attr.jsonl"
+        summary = tmp_path / "summary.json"
+        rc = main(
+            [
+                "--input", str(inp), "--output", str(out),
+                "--summary", str(summary), "--evidence", "calibrated",
+            ]
+        )
+        assert rc == 0
+        assert json.loads(summary.read_text())["macro_f1"] >= 0.85

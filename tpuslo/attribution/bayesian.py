@@ -82,6 +82,77 @@ SIGNAL_ELEVATION_THRESHOLDS: dict[str, float] = {
     "host_offload_stall_ms": 20,
 }
 
+# Error thresholds (same sync contract): together with the warning
+# threshold they set each signal's natural log-scale for graded
+# ("soft") evidence — how far past warning a value must travel before
+# it counts as fully elevated.
+SIGNAL_ERROR_THRESHOLDS: dict[str, float] = {
+    "dns_latency_ms": 120,
+    "tcp_retransmits_total": 5,
+    "runqueue_delay_ms": 25,
+    "connect_latency_ms": 180,
+    "tls_handshake_ms": 160,
+    "cpu_steal_pct": 8,
+    "cfs_throttled_ms": 120,
+    "mem_reclaim_latency_ms": 20,
+    "disk_io_latency_ms": 50,
+    "syscall_latency_ms": 200,
+    "connect_errors_total": 3,
+    "tls_handshake_fail_total": 3,
+    "xla_compile_ms": 2000,
+    "hbm_alloc_stall_ms": 20,
+    "hbm_utilization_pct": 95,
+    "ici_link_retries_total": 20,
+    "ici_collective_latency_ms": 30,
+    "host_offload_stall_ms": 80,
+}
+
+# Counter-valued signals: an exact 0.0 is a legitimate healthy reading.
+# For continuous latency/percentage probes an exact 0.0 means "probe
+# produced no sample" (shed probe, ring-buffer loss) and soft-evidence
+# mode treats it as UNOBSERVED rather than healthy — counting missing
+# probes as health systematically biases away from the faulted domain.
+_COUNTER_SIGNALS = frozenset(
+    {
+        "tcp_retransmits_total",
+        "connect_errors_total",
+        "tls_handshake_fail_total",
+        "ici_link_retries_total",
+    }
+)
+
+# Default evidence sharpness, fitted by
+# ``tpuslo.attribution.calibrate.fit_sharpness`` on lognormal-noise
+# training goldens (see that module's docstring for the protocol and
+# tests/test_calibration.py for the reproduction check).
+DEFAULT_EVIDENCE_SHARPNESS = 2.0
+
+
+def soft_evidence_weight(
+    signal: str, value: float, sharpness: float = DEFAULT_EVIDENCE_SHARPNESS
+) -> float:
+    """Graded elevation in [0, 1]: 0.5 at the warning threshold,
+    ``sigmoid(sharpness)`` at the error threshold, log-scaled.
+
+    Hard thresholding throws away magnitude, so measurement noise near
+    a threshold flips evidence bits outright (the r02 robustness sweep
+    collapsed to macro-F1 0.62 at sigma=0.5 for exactly this reason).
+    The log-ratio sigmoid keeps a barely-over-warning value weak and a
+    deep-in-error value decisive, which is also how multiplicative
+    (lognormal) measurement noise actually perturbs values.
+    """
+    warn = SIGNAL_ELEVATION_THRESHOLDS.get(signal)
+    if warn is None or warn <= 0:
+        return 0.0
+    if value <= 0:
+        return 0.0
+    err = SIGNAL_ERROR_THRESHOLDS.get(signal, warn * 3.0)
+    scale = max(math.log(err / warn), 1e-6)
+    z = sharpness * math.log(value / warn) / scale
+    # Clamp the exponent: far-out values saturate without overflow.
+    z = max(min(z, 60.0), -60.0)
+    return 1.0 / (1.0 + math.exp(-z))
+
 
 def _row(
     dns=0.10, egress=0.10, cpu=0.10, mem=0.10, pthr=0.10, perr=0.10,
@@ -246,9 +317,45 @@ class BayesianAttributor:
         self,
         priors: dict[str, float] | None = None,
         likelihoods: dict[str, dict[str, float]] | None = None,
+        evidence: str = "hard",
+        sharpness: float = DEFAULT_EVIDENCE_SHARPNESS,
     ):
+        if evidence not in ("hard", "soft"):
+            raise ValueError(f"evidence must be 'hard' or 'soft', got {evidence!r}")
         self.priors = priors or default_priors()
         self.likelihoods = likelihoods or default_likelihoods()
+        #: "hard" = reference-parity binary elevation; "soft" = graded
+        #: log-ratio evidence (noise-robust; see soft_evidence_weight).
+        self.evidence = evidence
+        self.sharpness = sharpness
+
+    def _observed_and_weights(
+        self, signals: dict[str, float], observed: set[str] | None = None
+    ) -> tuple[set[str], dict[str, float]]:
+        """Observed-signal set and per-signal evidence weight in [0, 1].
+
+        Hard mode: weight = 1 iff elevated (binary, reference parity).
+        Soft mode: graded weights; exact-0.0 continuous signals are
+        dropped from ``observed`` (missing probe, not health).
+        """
+        if observed is None:
+            observed = set(signals)
+        if self.evidence == "soft":
+            observed = {
+                s
+                for s in observed
+                if s in _COUNTER_SIGNALS
+                or s not in SIGNAL_ELEVATION_THRESHOLDS
+                or signals.get(s, 0.0) != 0.0
+            }
+            weights = {
+                s: soft_evidence_weight(s, signals.get(s, 0.0), self.sharpness)
+                for s in observed
+            }
+        else:
+            elevated = self.elevated_signals(signals)
+            weights = {s: 1.0 if s in elevated else 0.0 for s in observed}
+        return observed, weights
 
     def _matrices(self) -> "_Matrices":
         """Dense [signal × domain] views of the table.
@@ -328,9 +435,18 @@ class BayesianAttributor:
         footprints.  For full 18-signal vectors the two semantics
         coincide.
         """
-        if observed is None:
-            observed = set(signals)
-        elevated = self.elevated_signals(signals)
+        restricted = observed is not None
+        observed, weights = self._observed_and_weights(signals, observed)
+        # Evidence membership (supporting-signal lists) is weight >= 0.5
+        # over the FULL signal vector — identical to "elevated" in hard
+        # mode, and unaffected by an ``observed`` restriction (the
+        # residual pass restricts the factors, not what counts as an
+        # elevated supporting signal).
+        if restricted:
+            _, full_weights = self._observed_and_weights(signals)
+        else:
+            full_weights = weights
+        elevated = {s for s, w in full_weights.items() if w >= 0.5}
 
         log_posteriors: dict[str, float] = {}
         for domain in ALL_DOMAINS:
@@ -338,8 +454,10 @@ class BayesianAttributor:
             for signal in self.likelihoods:
                 if signal not in observed:
                     continue
-                log_p += math.log(
-                    self._likelihood(signal, domain, signal in elevated)
+                w = weights.get(signal, 0.0)
+                p = _clamp(self.likelihoods[signal].get(domain, 0.5))
+                log_p += w * math.log(p) + (1.0 - w) * math.log(
+                    _clamp(1.0 - p)
                 )
             log_posteriors[domain] = log_p
 
@@ -439,25 +557,64 @@ class BayesianAttributor:
                     and value >= SIGNAL_ELEVATION_THRESHOLDS[name]
                 ):
                     extra_trigger[i] = True
-        elevated = observed & (values >= mat.thresholds)
 
-        # [n, D] = Σ_s elevated·logP + Σ_s observed-but-healthy·log(1-P)
+        if self.evidence == "soft":
+            # Exact-0.0 continuous probes = missing, not healthy.
+            continuous = np.array(
+                [
+                    s not in _COUNTER_SIGNALS
+                    and s in SIGNAL_ELEVATION_THRESHOLDS
+                    for s in mat.signals
+                ]
+            )
+            observed &= ~(continuous & (values == 0.0))
+            warns = np.where(np.isfinite(mat.thresholds), mat.thresholds, np.nan)
+            errs = np.array(
+                [
+                    SIGNAL_ERROR_THRESHOLDS.get(
+                        s, (SIGNAL_ELEVATION_THRESHOLDS.get(s) or np.nan) * 3.0
+                    )
+                    for s in mat.signals
+                ]
+            )
+            with np.errstate(divide="ignore", invalid="ignore"):
+                scale = np.maximum(np.log(errs / warns), 1e-6)
+                z = (
+                    self.sharpness
+                    * np.log(np.maximum(values, 1e-300) / warns)
+                    / scale
+                )
+            z = np.where((values > 0) & np.isfinite(z), z, -60.0)
+            weights = 1.0 / (1.0 + np.exp(-np.clip(z, -60.0, 60.0)))
+        else:
+            weights = (observed & (values >= mat.thresholds)).astype(float)
+        elevated = observed & (weights >= 0.5)
+
+        # [n, D] = Σ_s w·logP + Σ_s (1-w)·log(1-P) over observed signals
+        obsf = observed.astype(float)
+        w_obs = weights * obsf
         log_post = (
             mat.log_priors
-            + elevated @ mat.log_lik
-            + (observed & ~elevated) @ mat.log_not_lik
+            + w_obs @ mat.log_lik
+            + (obsf - w_obs) @ mat.log_not_lik
         )
         posteriors = _softmax_rows(log_post)
 
-        # Residual explaining-away pass, one matmul for the batch: the
-        # residual signals are elevated by construction, so only the
-        # log-likelihood term appears (priors + R @ logL).
+        # Residual explaining-away pass, one matmul for the batch,
+        # restricted to the residual signals with their weights (in
+        # hard mode the weights are 1, reducing to priors + R @ logL).
         top_idx = posteriors.argmax(axis=1)
         residual = elevated & ~mat.supports[:, top_idx].T
         has_residual = residual.any(axis=1) | extra_trigger
         res_posteriors = np.zeros((n, n_dom))
         if has_residual.any():
-            res_log = mat.log_priors + residual @ mat.log_lik
+            resf = residual.astype(float)
+            w_res = weights * resf
+            res_log = (
+                mat.log_priors
+                + w_res @ mat.log_lik
+                + (resf - w_res) @ mat.log_not_lik
+            )
             res_posteriors[has_residual] = _softmax_rows(
                 res_log[has_residual]
             )
@@ -525,7 +682,8 @@ class BayesianAttributor:
         probability mass (floored so a decisive top-1 can't erase a
         clearly-present second fault).
         """
-        elevated = self.elevated_signals(signals)
+        _observed, weights = self._observed_and_weights(signals)
+        elevated = {s for s, w in weights.items() if w >= 0.5}
         residual = {
             s
             for s in elevated

@@ -1,0 +1,317 @@
+"""Noise calibration for the Bayesian attributor.
+
+The hand-set likelihood table (``bayesian.default_likelihoods``) encodes
+P(signal elevated | domain) for *clean* measurements; under real
+measurement noise those probabilities are different — a healthy
+``hbm_utilization_pct`` of 62 crosses its 85 warning line in ~26% of
+lognormal sigma=0.5 draws, so the hand-set 0.05 "healthy" columns are
+badly miscalibrated and the r02 robustness sweep collapsed (macro-F1
+0.62 at sigma=0.5 vs the reference methodology's >=0.85 single-fault
+bar, ``/root/reference/docs/benchmarks/llm-slo-attribution-accuracy.md``).
+
+This module fits the table *empirically*: generate noisy training
+replicas of every single-fault scenario, take each signal's mean soft
+evidence weight per domain as the calibrated P(signal | domain), and
+serve the result through a soft-evidence
+(:func:`~tpuslo.attribution.bayesian.soft_evidence_weight`) attributor.
+
+Validation is held out three ways (``heldout_report``):
+
+* a **noise seed** never used in training;
+* a **different noise family** (gamma-multiplicative instead of the
+  lognormal the fit saw);
+* **variant fault profiles** with magnitudes the generator never emits
+  (milder/harsher faults), so the score cannot come from memorizing
+  ``tpuslo.signals.generator._FAULT_OVERRIDES``.
+
+Everything is deterministic (seeded numpy) and cheap (<1 s), so the
+calibrated attributor is fitted on demand rather than shipped as a
+frozen artifact — the fit itself is reproducible and tested.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from datetime import datetime, timezone
+
+import numpy as np
+
+from tpuslo.attribution import bayesian as B
+from tpuslo.attribution.mapper import FaultSample, map_fault_label
+from tpuslo.attribution.pipeline import macro_f1
+
+#: Single-fault scenarios used for fitting — one per attributable domain
+#: the synthetic spine can produce.
+TRAIN_SCENARIOS: tuple[str, ...] = (
+    "ici_drop",
+    "hbm_pressure",
+    "xla_recompile_storm",
+    "host_offload_stall",
+    "dns_latency",
+    "cpu_throttle",
+    "memory_pressure",
+    "provider_throttle",
+    "network_partition",
+)
+
+TPU_SCENARIOS: tuple[str, ...] = TRAIN_SCENARIOS[:4]
+
+#: Held-out fault profiles (signal -> value) with magnitudes deliberately
+#: different from ``tpuslo.signals.generator._FAULT_OVERRIDES`` — milder
+#: faults sitting between warning and error thresholds, plus different
+#: secondary-signal mixes.  Used only for evaluation, never fitting.
+VARIANT_PROFILES: dict[str, dict[str, float]] = {
+    "ici_drop": {
+        "ici_link_retries_total": 12.0,
+        "ici_collective_latency_ms": 18.0,
+        "host_offload_stall_ms": 4.0,
+    },
+    "hbm_pressure": {
+        "hbm_alloc_stall_ms": 14.0,
+        "hbm_utilization_pct": 91.0,
+        "host_offload_stall_ms": 30.0,
+        "mem_reclaim_latency_ms": 2.0,
+    },
+    "xla_recompile_storm": {
+        "xla_compile_ms": 900.0,
+        "runqueue_delay_ms": 16.0,
+        "cpu_steal_pct": 1.5,
+    },
+    "host_offload_stall": {
+        "host_offload_stall_ms": 45.0,
+        "disk_io_latency_ms": 22.0,
+        "syscall_latency_ms": 120.0,
+        "hbm_utilization_pct": 70.0,
+    },
+}
+
+
+def _base_samples(scenarios, count: int) -> list[FaultSample]:
+    from tpuslo.faultreplay import generate_fault_samples
+
+    start = datetime(2026, 1, 1, tzinfo=timezone.utc)
+    out: list[FaultSample] = []
+    for scenario in scenarios:
+        out.extend(generate_fault_samples(scenario, count, start))
+    return out
+
+
+def variant_samples(count: int = 25) -> list[FaultSample]:
+    """Held-out TPU-fault samples built from :data:`VARIANT_PROFILES`."""
+    from tpuslo.signals.generator import profile_for_fault
+
+    start = datetime(2026, 2, 1, tzinfo=timezone.utc)
+    out: list[FaultSample] = []
+    for label, overrides in VARIANT_PROFILES.items():
+        base = profile_for_fault("baseline")
+        for idx in range(count):
+            signals = dict(base)
+            signals.update(overrides)
+            out.append(
+                FaultSample(
+                    incident_id=f"variant-{label}-{idx:04d}",
+                    timestamp=start,
+                    cluster="local",
+                    namespace="default",
+                    service="chat",
+                    fault_label=label,
+                    expected_domain=map_fault_label(label),
+                    signals=signals,
+                    confidence=0.9,
+                    burn_rate=2.0,
+                    window_minutes=5,
+                    request_id=f"variant-req-{idx:04d}",
+                    trace_id=f"variant-trace-{idx:04d}",
+                )
+            )
+    return out
+
+
+def corrupt(
+    samples: list[FaultSample],
+    sigma: float,
+    seed: int,
+    noise: str = "lognormal",
+    drop_rate: float = 0.15,
+) -> list[FaultSample]:
+    """Noisy replicas: multiplicative noise + probe drops (value -> 0).
+
+    ``lognormal`` mirrors the bench sweep; ``gamma`` is the held-out
+    family (same mean, heavier left tail) so validation shows the fit
+    did not overfit the lognormal shape.
+    """
+    rs = np.random.RandomState(seed)
+    out: list[FaultSample] = []
+    for sample in samples:
+        s = copy.deepcopy(sample)
+        for key, value in list(s.signals.items()):
+            if rs.rand() < drop_rate * sigma:
+                s.signals[key] = 0.0
+            elif noise == "gamma":
+                # Mean-1 multiplicative gamma with variance sigma^2.
+                shape = 1.0 / max(sigma, 1e-6) ** 2
+                s.signals[key] = float(value) * float(
+                    rs.gamma(shape, 1.0 / shape)
+                )
+            else:
+                s.signals[key] = float(value) * float(
+                    np.exp(rs.normal(0.0, sigma))
+                )
+        out.append(s)
+    return out
+
+
+def fit_likelihoods(
+    sharpness: float = B.DEFAULT_EVIDENCE_SHARPNESS,
+    seed: int = 7,
+    sigmas: tuple[float, ...] = (0.25, 0.5),
+    count: int = 40,
+    scenarios: tuple[str, ...] = TRAIN_SCENARIOS,
+) -> dict[str, dict[str, float]]:
+    """Empirical likelihood table from noisy training goldens.
+
+    Each P(signal | domain) cell becomes the mean soft evidence weight
+    of that signal over the domain's noisy replicas — i.e. the
+    probability (in expectation) that the signal actually testifies
+    under the modeled noise.  Domains without a training scenario
+    (provider_error, retrieval_backend, unknown) keep their hand-set
+    columns.
+    """
+    table = {s: dict(row) for s, row in B.default_likelihoods().items()}
+    acc: dict[str, dict[str, list[float]]] = {}
+    for sigma in sigmas:
+        train = corrupt(
+            _base_samples(scenarios, count), sigma,
+            seed + int(sigma * 1000),
+        )
+        for sample in train:
+            domain = sample.expected_domain or map_fault_label(
+                sample.fault_label
+            )
+            for name, value in sample.signals.items():
+                if name not in table:
+                    continue
+                if value == 0.0 and name not in B._COUNTER_SIGNALS:
+                    continue  # dropped probe: unobserved, not healthy
+                weight = B.soft_evidence_weight(name, value, sharpness)
+                acc.setdefault(domain, {}).setdefault(name, []).append(weight)
+    for domain, sigs in acc.items():
+        for name, weights in sigs.items():
+            table[name][domain] = float(
+                np.clip(np.mean(weights), 0.02, 0.98)
+            )
+    return table
+
+
+#: Incident-conditional prior scale for the ``unknown`` domain: the
+#: attributor runs on incident samples (burn rate >= 2 — an SLO burn IS
+#: in progress), so "no attributable cause" is a priori rarer than any
+#: specific fault.  Without this, a single dropped pathognomonic probe
+#: (e.g. ``xla_compile_ms`` zeroed by shedding) sends the sample to
+#: ``unknown`` even when the healthy co-signals rule out every
+#: competing domain.
+UNKNOWN_PRIOR_SCALE = 0.25
+
+
+def calibrated_priors() -> dict[str, float]:
+    priors = B.default_priors()
+    priors[B.DOMAIN_UNKNOWN] *= UNKNOWN_PRIOR_SCALE
+    total = sum(priors.values())
+    return {d: p / total for d, p in priors.items()}
+
+
+def calibrated_attributor(
+    sharpness: float = B.DEFAULT_EVIDENCE_SHARPNESS,
+    seed: int = 7,
+) -> B.BayesianAttributor:
+    """Soft-evidence attributor over the empirically fitted table."""
+    return B.BayesianAttributor(
+        priors=calibrated_priors(),
+        likelihoods=fit_likelihoods(sharpness=sharpness, seed=seed),
+        evidence="soft",
+        sharpness=sharpness,
+    )
+
+
+def fit_sharpness(
+    grid: tuple[float, ...] = (1.0, 1.5, 2.0, 3.0, 4.0),
+    seed: int = 9,
+    sigmas: tuple[float, ...] = (0.25, 0.5),
+    count: int = 25,
+) -> float:
+    """Pick the evidence sharpness by training-noise macro-F1.
+
+    Selection runs on training-seed noise only (seed 9 lineage —
+    disjoint from both the fit seeds and the held-out eval seed 42);
+    ties break toward the smallest (least confident) sharpness.
+    ``bayesian.DEFAULT_EVIDENCE_SHARPNESS`` records the result.
+    """
+    best_k, best_score = grid[0], -1.0
+    base = _base_samples(TPU_SCENARIOS, count)
+    for k in grid:
+        attributor = B.BayesianAttributor(
+            priors=calibrated_priors(),
+            likelihoods=fit_likelihoods(sharpness=k),
+            evidence="soft",
+            sharpness=k,
+        )
+        scores = []
+        for sigma in sigmas:
+            noisy = corrupt(base, sigma, seed + int(sigma * 100))
+            predictions = attributor.attribute_batch(noisy)
+            scores.append(macro_f1(noisy, predictions).macro_f1)
+        mean = sum(scores) / len(scores)
+        if mean > best_score + 1e-9:
+            best_k, best_score = k, mean
+    return best_k
+
+
+@dataclass
+class HeldoutReport:
+    """Macro-F1 of an attributor across the held-out validation axes."""
+
+    clean: float
+    lognormal: dict[str, float] = field(default_factory=dict)
+    gamma: dict[str, float] = field(default_factory=dict)
+    variant_profiles: dict[str, float] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "clean": self.clean,
+            "lognormal": self.lognormal,
+            "gamma": self.gamma,
+            "variant_profiles": self.variant_profiles,
+        }
+
+
+def heldout_report(
+    attributor: B.BayesianAttributor | None = None,
+    sigmas: tuple[float, ...] = (0.25, 0.5, 1.0),
+    count: int = 25,
+    seed: int = 42,
+) -> HeldoutReport:
+    """Evaluate on held-out noise seed, noise family, and profiles.
+
+    ``seed=42`` matches the bench sweep and is disjoint from the
+    training seeds (7 + 1000*sigma).
+    """
+    attributor = attributor or calibrated_attributor()
+
+    def score(samples: list[FaultSample]) -> float:
+        predictions = attributor.attribute_batch(samples)
+        return round(macro_f1(samples, predictions).macro_f1, 4)
+
+    base = _base_samples(TPU_SCENARIOS, count)
+    variants = variant_samples(count)
+    report = HeldoutReport(clean=score(base))
+    for sigma in sigmas:
+        key = str(sigma)
+        report.lognormal[key] = score(corrupt(base, sigma, seed))
+        report.gamma[key] = score(
+            corrupt(base, sigma, seed + 1, noise="gamma")
+        )
+        report.variant_profiles[key] = score(
+            corrupt(variants, sigma, seed + 2)
+        )
+    return report

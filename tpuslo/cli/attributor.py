@@ -23,6 +23,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--summary", default="")
     p.add_argument("--confusion", default="")
     p.add_argument("--mode", default="bayes", choices=["bayes", "rule"])
+    p.add_argument(
+        "--evidence",
+        default="hard",
+        choices=["hard", "soft", "calibrated"],
+        help="bayes evidence model: hard = reference-parity binary "
+        "elevation; soft = graded log-ratio weights; calibrated = soft "
+        "over the noise-fitted likelihood table "
+        "(tpuslo.attribution.calibrate)",
+    )
     p.add_argument("--webhook-url", default="")
     p.add_argument("--webhook-secret", default="")
     p.add_argument("--webhook-format", default="generic")
@@ -42,7 +51,23 @@ def main(argv: list[str] | None = None) -> int:
     except (OSError, ValueError) as exc:
         print(f"attributor: cannot load {args.input}: {exc}", file=sys.stderr)
         return 2
-    predictions = attribution.build_attributions(samples, mode=args.mode)
+    if args.evidence != "hard" and args.mode == "rule":
+        print(
+            "attributor: --evidence soft/calibrated requires --mode bayes "
+            "(rule mode never consults the Bayes model)",
+            file=sys.stderr,
+        )
+        return 2
+    attributor = None
+    if args.evidence == "soft":
+        attributor = attribution.BayesianAttributor(evidence="soft")
+    elif args.evidence == "calibrated":
+        from tpuslo.attribution.calibrate import calibrated_attributor
+
+        attributor = calibrated_attributor()
+    predictions = attribution.build_attributions(
+        samples, mode=args.mode, attributor=attributor
+    )
     for pred in predictions:
         validate(pred.to_dict(), SCHEMA_INCIDENT_ATTRIBUTION)
 
