@@ -1,24 +1,247 @@
 #!/usr/bin/env python3
-"""BCC-degraded DNS latency fallback.
+"""BCC-degraded DNS latency tracer — real measurements, two tiers.
 
-Role parity with the reference's BCC placeholder
-(ebpf/bcc-fallback/dns_latency.py prints one JSON sample and exits;
-pkg/collector/bcc_fallback.go:37-49 is an explicit stub).  This
-fallback is honest about the same limitation: on hosts without BTF the
-toolkit degrades to the two-signal ``bcc_degraded`` set, and this
-script emits one well-formed sample per invocation so the wiring can be
-exercised end-to-end.  A real BCC program belongs here when a target
-fleet actually needs pre-BTF kernels.
+Replaces the one-static-sample stub (role parity target:
+``/root/reference/ebpf/bcc-fallback/dns_latency.py:1-20`` — the
+reference never measured anything here).  Mirrors the two-tier design
+of ``tcp_retransmits.py``; ``--mode auto`` picks the best available:
+
+1. **bcc** — kprobes on ``udp_sendmsg``/``udp_recvmsg`` (the same
+   hook pair as the CO-RE program ``ebpf/c/dns_latency.bpf.c``):
+   stamp on a dport-53 send keyed by pid_tgid, delta on the matching
+   receive return.  Needs root + the ``bcc`` Python package — exactly
+   the pre-BTF hosts this fallback exists for.
+2. **resolver probe** — procfs has no DNS counter, so tier 2 is a
+   timed resolver self-probe: a minimal A-record query built with
+   stdlib ``struct``, sent over UDP to the configured resolver
+   (``/etc/resolv.conf`` or ``--resolver``), round-trip measured.
+   A live end-to-end latency of the exact path the DNS signal
+   describes — no privileges, no dependencies.
+
+Sample shape (what ``tpuslo/collector/bcc_fallback.py`` forwards)::
+
+    {"signal": "dns_latency_ms", "value_ms": 1.82,
+     "source": "resolver_probe", "ts_unix_ns": ...}
 """
+
+import argparse
 import json
+import struct
 import sys
 import time
 
-sample = {
-    "signal": "dns_latency_ms",
-    "value_ms": 0.0,
-    "source": "bcc_fallback_stub",
-    "ts_unix_ns": time.time_ns(),
+BPF_TEXT = r"""
+#include <uapi/linux/ptrace.h>
+#include <linux/socket.h>
+#include <linux/in.h>
+#include <net/sock.h>
+
+struct start_val {
+    u64 ts;
+    u64 sk;
+};
+BPF_HASH(start, u64, struct start_val);
+BPF_HASH(recv_sk, u64, u64);
+BPF_ARRAY(sum_ns, u64, 1);
+BPF_ARRAY(count, u64, 1);
+
+int kprobe__udp_sendmsg(struct pt_regs *ctx, struct sock *sk,
+                        struct msghdr *msg) {
+    // Connected sockets carry the port on the sock; unconnected
+    // sendto() clients (the common resolver shape) carry it in
+    // msg->msg_name instead — check both.
+    u16 dport = sk->__sk_common.skc_dport;
+    if (dport != htons(53)) {
+        struct sockaddr_in *sin =
+            (struct sockaddr_in *)msg->msg_name;
+        u16 name_port = 0;
+        if (sin)
+            bpf_probe_read_kernel(&name_port, sizeof(name_port),
+                                  &sin->sin_port);
+        if (name_port != htons(53))
+            return 0;
+    }
+    u64 id = bpf_get_current_pid_tgid();
+    struct start_val val = {};
+    val.ts = bpf_ktime_get_ns();
+    val.sk = (u64)sk;
+    start.update(&id, &val);
+    return 0;
 }
-json.dump(sample, sys.stdout)
-print()
+
+int kprobe__udp_recvmsg(struct pt_regs *ctx, struct sock *sk) {
+    // Record which socket this thread's receive is on, so the return
+    // probe only closes a DNS timing when the receive happened on the
+    // SAME socket that sent the query (a recv on statsd/syslog must
+    // not consume the stamp).
+    u64 id = bpf_get_current_pid_tgid();
+    u64 skp = (u64)sk;
+    recv_sk.update(&id, &skp);
+    return 0;
+}
+
+int kretprobe__udp_recvmsg(struct pt_regs *ctx) {
+    u64 id = bpf_get_current_pid_tgid();
+    u64 *skp = recv_sk.lookup(&id);
+    if (skp)
+        recv_sk.delete(&id);
+    struct start_val *val = start.lookup(&id);
+    if (!val)
+        return 0;
+    if (!skp || *skp != val->sk)
+        return 0;
+    u64 delta = bpf_ktime_get_ns() - val->ts;
+    start.delete(&id);
+    int zero = 0;
+    u64 *s = sum_ns.lookup(&zero);
+    u64 *c = count.lookup(&zero);
+    if (s) { __sync_fetch_and_add(s, delta); }
+    if (c) { __sync_fetch_and_add(c, 1); }
+    return 0;
+}
+"""
+
+
+def emit(value_ms: float, source: str, extra: dict | None = None) -> None:
+    sample = {
+        "signal": "dns_latency_ms",
+        "value_ms": round(value_ms, 3),
+        "source": source,
+        "ts_unix_ns": time.time_ns(),
+    }
+    if extra:
+        sample.update(extra)
+    json.dump(sample, sys.stdout)
+    print(flush=True)
+
+
+def build_query(qname: str, txid: int = 0x1234) -> bytes:
+    """Minimal RD A-record query, stdlib only."""
+    header = struct.pack(">HHHHHH", txid, 0x0100, 1, 0, 0, 0)
+    question = b"".join(
+        bytes([len(label)]) + label.encode("ascii")
+        for label in qname.strip(".").split(".")
+    ) + b"\x00"
+    return header + question + struct.pack(">HH", 1, 1)  # QTYPE=A, QCLASS=IN
+
+
+def default_resolver(path: str = "/etc/resolv.conf") -> str:
+    try:
+        with open(path, encoding="ascii") as fh:
+            for line in fh:
+                parts = line.split()
+                if len(parts) >= 2 and parts[0] == "nameserver":
+                    return parts[1]
+    except OSError:
+        pass
+    return "127.0.0.53"
+
+
+def run_resolver_probe(
+    interval_s: float, count: int, resolver: str, qname: str,
+    timeout_s: float, port: int = 53,
+) -> int:
+    import socket
+
+    query = build_query(qname)
+    emitted = 0
+    for i in range(count):
+        if i:
+            time.sleep(interval_s)
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.settimeout(timeout_s)
+        t0 = time.perf_counter()
+        try:
+            sock.sendto(query, (resolver, port))
+            sock.recvfrom(4096)
+            emit(
+                (time.perf_counter() - t0) * 1000.0,
+                "resolver_probe",
+                {"resolver": resolver, "qname": qname},
+            )
+            emitted += 1
+        except OSError as exc:
+            # Probe-infrastructure failure (dead resolver, refused
+            # port) must NOT masquerade as a measured DNS latency: the
+            # forwarding bridge keys on the signal name and would
+            # carry a fabricated 2000 ms reading into attribution,
+            # biasing every incident toward network_dns.  A distinct
+            # signal name keeps the failure visible without entering
+            # the dns_latency_ms stream.
+            json.dump(
+                {
+                    "signal": "dns_probe_error",
+                    "value": 1,
+                    "source": "resolver_probe_failed",
+                    "resolver": resolver,
+                    "qname": qname,
+                    "error": str(exc)[:120],
+                    "ts_unix_ns": time.time_ns(),
+                },
+                sys.stdout,
+            )
+            print(flush=True)
+            print(
+                f"dns_latency: resolver probe to {resolver} failed: {exc}",
+                file=sys.stderr,
+            )
+            emitted += 1
+        finally:
+            sock.close()
+    return 0 if emitted else 1
+
+
+def run_bcc(interval_s: float, count: int) -> int:
+    from bcc import BPF  # raises ImportError when BCC is absent
+
+    bpf = BPF(text=BPF_TEXT)
+    prev_sum = prev_count = 0
+    for _ in range(count):
+        time.sleep(interval_s)
+        cur_sum = sum(v.value for v in bpf["sum_ns"].values())
+        cur_count = sum(v.value for v in bpf["count"].values())
+        d_sum, d_count = cur_sum - prev_sum, cur_count - prev_count
+        prev_sum, prev_count = cur_sum, cur_count
+        if d_count > 0:
+            emit(
+                d_sum / d_count / 1e6, "bcc_kprobe",
+                {"lookups": int(d_count), "interval_s": round(interval_s, 3)},
+            )
+        else:
+            # No DNS traffic this interval: an honest zero-lookup
+            # sample, not a fabricated latency.
+            emit(0.0, "bcc_kprobe_idle", {"lookups": 0})
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--interval-s", type=float, default=0.5)
+    parser.add_argument("--count", type=int, default=1)
+    parser.add_argument(
+        "--mode", choices=("auto", "bcc", "resolver"), default="auto"
+    )
+    parser.add_argument("--resolver", default="")
+    parser.add_argument("--resolver-port", type=int, default=53)
+    parser.add_argument("--qname", default="example.com")
+    parser.add_argument("--timeout-s", type=float, default=2.0)
+    args = parser.parse_args(argv)
+
+    if args.mode in ("auto", "bcc"):
+        try:
+            return run_bcc(args.interval_s, args.count)
+        except Exception as exc:  # noqa: BLE001 - fall through to probe
+            if args.mode == "bcc":
+                print(f"bcc unavailable: {exc}", file=sys.stderr)
+                return 1
+            print(f"bcc unavailable ({exc}); using resolver probe",
+                  file=sys.stderr)
+    return run_resolver_probe(
+        args.interval_s, args.count,
+        args.resolver or default_resolver(), args.qname, args.timeout_s,
+        port=args.resolver_port,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

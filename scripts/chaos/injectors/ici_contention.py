@@ -1,0 +1,83 @@
+#!/usr/bin/env python3
+"""Real ICI-domain injector (thin CLI over tpuslo.chaos.ici_contention).
+
+Two mechanisms, both measured (non-synthetic):
+
+* ``--mode contention`` — a background compute storm contends the
+  device the collective prober measures; ``ici_collective_latency_ms``
+  degrades for real (device-queue contention; link-level drops need
+  platform tooling and are out of scope, recorded honestly in the
+  report's ``mechanism`` field).
+* ``--mode straggler`` — N OS processes rendezvous over a localhost
+  TCP barrier; one host is delayed; per-host measured waits feed
+  SliceJoiner, which must attribute the delayed host.
+* ``--mode both`` (default) runs the two in sequence.
+
+Usage: ici_contention.py [--mode both] [--reps 10] [--hosts 3]
+                         [--delay-ms 150] [--launches 6]
+                         [--force-cpu-devices N] [--report out.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--mode", choices=("contention", "straggler", "both"),
+                   default="both")
+    p.add_argument("--reps", type=int, default=10)
+    p.add_argument("--payload-kb", type=int, default=512)
+    p.add_argument("--hosts", type=int, default=3)
+    p.add_argument("--delay-ms", type=float, default=150.0)
+    p.add_argument("--launches", type=int, default=6)
+    p.add_argument(
+        "--force-cpu-devices", type=int, default=0,
+        help="N>0 probes an N-device virtual CPU mesh (no TPU touched)",
+    )
+    p.add_argument("--report", default="")
+    args = p.parse_args()
+
+    report: dict = {"injector": "ici_contention", "real": True}
+    if args.mode in ("contention", "both"):
+        if args.force_cpu_devices > 0:
+            import os
+
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={args.force_cpu_devices}"
+            )
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        from tpuslo.chaos import contention_injection
+
+        report["contention"] = contention_injection(
+            reps=args.reps, payload_kb=args.payload_kb
+        )
+    if args.mode in ("straggler", "both"):
+        from tpuslo.chaos import run_straggler_injection
+
+        report["straggler"] = run_straggler_injection(
+            n_hosts=args.hosts, launches=args.launches,
+            delay_ms=args.delay_ms,
+        )
+
+    print(json.dumps(report))
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=2)
+
+    ok = True
+    if "straggler" in report:
+        ok &= report["straggler"]["correct_attributions"] > 0
+    if "contention" in report:
+        ok &= report["contention"]["degradation"] > 1.0
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,10 +8,12 @@
 # REAL_INJECTORS=true switches to tc-netem/CPU-stress, per-scenario
 # injector_metadata.json).  The TPU matrix keeps the CPU-era real
 # injectors where they still apply (tc netem for dns/network) and adds
-# TPU-native real injectors: a JAX recompile storm and an HBM squatter
-# (scripts/chaos/injectors/).  ici_drop has no safe real injector —
-# deliberately: link-level fault injection needs platform tooling — so
-# it is always synthetic and marked as such.
+# TPU-native real injectors: a JAX recompile storm, an HBM squatter,
+# and an ICI injector (scripts/chaos/injectors/ici_contention.py) with
+# two measured mechanisms — device-queue contention of the collective
+# prober, and a delayed-host TCP-barrier straggler attributed by
+# SliceJoiner.  Link-level drops still need platform tooling; the
+# injector report's "mechanism" field records what was actually done.
 set -euo pipefail
 cd "$(dirname "$0")/../.."
 
@@ -47,6 +49,12 @@ inject_real() {
                 --report "$dir/injector_report.json" \
                 && echo jax || echo failed
             ;;
+        ici_drop)
+            python scripts/chaos/injectors/ici_contention.py --mode both \
+                --report "$dir/injector_report.json" \
+                ${ICI_CPU_DEVICES:+--force-cpu-devices "$ICI_CPU_DEVICES"} \
+                && echo jax+barrier || echo failed
+            ;;
         *)
             echo none
             ;;
@@ -72,7 +80,7 @@ for scenario in $SCENARIOS; do
     echo "== scenario: $scenario"
 
     injector=synthetic
-    if [ "$REAL_INJECTORS" = "true" ] && [ "$scenario" != "ici_drop" ]; then
+    if [ "$REAL_INJECTORS" = "true" ]; then
         injector="$(inject_real "$scenario" "$dir" | tail -1)"
         [ "$injector" = "failed" ] && injector=synthetic
     fi

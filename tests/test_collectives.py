@@ -28,6 +28,7 @@ def _sharded_ones(mesh, rows_per_dev=8, cols=8):
     return jax.device_put(x, NamedSharding(mesh, P("probe", None)))
 
 
+@pytest.mark.slow
 def test_collective_fns_compute_correctly():
     mesh = _mesh()
     n = mesh.shape["probe"]
@@ -50,6 +51,7 @@ def test_collective_fns_compute_correctly():
         _collective_fn("alltofoo", mesh, "probe")
 
 
+@pytest.mark.slow
 def test_bench_collectives_shapes_and_quantiles():
     probes = bench_collectives(
         mesh=_mesh(), payload_bytes=64 * 1024, reps=3
@@ -65,6 +67,7 @@ def test_bench_collectives_shapes_and_quantiles():
         assert p.to_dict()["op"] == p.op
 
 
+@pytest.mark.slow
 def test_probe_events_schema_and_identity():
     probes = [
         CollectiveProbe(
@@ -87,6 +90,7 @@ def test_probe_events_schema_and_identity():
     assert events[1].status == "error"  # p95 45ms over the 30ms error
 
 
+@pytest.mark.slow
 def test_icibench_cli_writes_jsonl(tmp_path):
     from tpuslo.cli.icibench import main
 
@@ -107,6 +111,7 @@ def test_icibench_cli_writes_jsonl(tmp_path):
     assert all(l["tpu"]["slice_id"] == "slice-7" for l in lines)
 
 
+@pytest.mark.slow
 def test_active_prober_interval_and_disable():
     from tpuslo.parallel.collectives import ActiveICIProber
 
@@ -142,6 +147,7 @@ def test_active_prober_interval_and_disable():
         mod.CollectiveSuite = orig
 
 
+@pytest.mark.slow
 def test_agent_emits_ici_probe_events(tmp_path):
     from tpuslo.cli.agent import main
 
@@ -170,6 +176,7 @@ def test_agent_emits_ici_probe_events(tmp_path):
     }
 
 
+@pytest.mark.slow
 def test_suite_reuses_compiled_programs():
     from tpuslo.parallel.collectives import ActiveICIProber, CollectiveSuite
 
@@ -190,6 +197,7 @@ def test_icibench_rejects_unknown_ops(capsys):
     assert main(["--ops", ""]) == 2
 
 
+@pytest.mark.slow
 def test_agent_warns_ici_probe_with_slo_kind(tmp_path, capsys):
     from tpuslo.cli.agent import main
 
@@ -208,3 +216,30 @@ def test_agent_warns_ici_probe_with_slo_kind(tmp_path, capsys):
         json.loads(l).get("kind") != "probe"
         for l in out.read_text().splitlines()
     )
+
+
+def test_prober_timeout_disables_instead_of_stalling():
+    """A wedged backend HANGS (no exception) in suite build/measure;
+    the prober's worker-thread join(timeout) must disable it and
+    return, not stall the agent emit loop (ADVICE r02 #1)."""
+    import threading
+    import time as _time
+
+    from tpuslo.parallel.collectives import ActiveICIProber
+
+    logs = []
+    prober = ActiveICIProber(interval_s=1.0, log=logs.append, timeout_s=0.3)
+    release = threading.Event()
+
+    def hang():
+        release.wait(30.0)  # simulates jax.devices() blocking forever
+
+    prober._probe_once = hang
+    t0 = _time.perf_counter()
+    assert prober.maybe_probe(0.0) == []
+    elapsed = _time.perf_counter() - t0
+    release.set()
+    assert elapsed < 5.0  # returned at the join timeout, not the hang
+    assert prober._disabled
+    assert any("hang" in line for line in logs)
+    assert prober.maybe_probe(1000.0) == []  # stays off

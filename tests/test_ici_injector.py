@@ -1,0 +1,84 @@
+"""Real ICI-domain fault injection (VERDICT r02 next-round #3).
+
+The tpu_ici domain was the one fault domain with synthetic-only
+evidence.  These tests drive both measured mechanisms end-to-end:
+the delayed-host barrier straggler (SliceJoiner must name the delayed
+host from real measured waits) and the device-contention collective
+degradation (attributor must name tpu_ici from the real signal).
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from tpuslo.chaos import run_straggler_injection
+
+
+def test_straggler_attributed_from_real_waits():
+    report = run_straggler_injection(
+        n_hosts=3, launches=5, delay_ms=120.0, delayed_host=1,
+        in_process=True,
+    )
+    assert report["real"] is True
+    assert report["events_measured"] == 15
+    assert report["correct_attributions"] == 5
+    assert report["top_confidence"] >= 0.7
+    for incident in report["incidents"]:
+        assert incident["straggler_host"] == 1
+        assert incident["cause"] == "compute_straggler"
+        # Real physics: the delayed host sails through the barrier, the
+        # others wait ~delay_ms.
+        lat = incident["host_latencies_ms"]
+        assert lat["1"] < 20.0
+        assert lat["0"] > 100.0 and lat["2"] > 100.0
+
+
+def test_straggler_different_delayed_host():
+    report = run_straggler_injection(
+        n_hosts=2, launches=3, delay_ms=100.0, delayed_host=0,
+        in_process=True,
+    )
+    assert report["correct_attributions"] == 3
+    assert all(i["straggler_host"] == 0 for i in report["incidents"])
+
+
+def test_straggler_subprocess_mode():
+    """The deployment shape: one OS process per host, events over
+    stdout JSONL, joined by the parent."""
+    report = run_straggler_injection(
+        n_hosts=2, launches=2, delay_ms=80.0, delayed_host=1,
+        in_process=False,
+    )
+    assert report["correct_attributions"] == 2
+    assert report["top_confidence"] >= 0.7
+
+
+@pytest.mark.slow
+def test_contention_degrades_measured_collectives():
+    import jax
+
+    if jax.default_backend() != "cpu":  # pragma: no cover - CI is cpu
+        pytest.skip("contention smoke runs on the CPU mesh")
+    from tpuslo.chaos import contention_injection
+
+    report = contention_injection(reps=5, payload_kb=256, storm_size=512)
+    assert report["real"] is True
+    assert report["mechanism"] == "device_contention"
+    assert report["degradation"] > 1.0
+    assert report["events"], "measured probe events must be emitted"
+    assert report["attribution"]["predicted_domain"] == "tpu_ici"
+    assert report["attribution"]["from_real_signals"] is True
+
+
+def test_injector_script_help():
+    """The CLI wrapper must at least parse (the matrix calls it)."""
+    proc = subprocess.run(
+        [sys.executable, "scripts/chaos/injectors/ici_contention.py", "--help"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0
+    assert "straggler" in proc.stdout

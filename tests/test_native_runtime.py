@@ -235,7 +235,7 @@ def test_hello_heartbeat_roundtrip(tmp_path):
         consumer.close()
 
 
-def test_bcc_fallback_forwards_stub_samples(tmp_path):
+def test_bcc_fallback_forwards_measured_samples(tmp_path):
     from tpuslo.collector.bcc_fallback import BCCFallback
     from tpuslo.collector.ringbuf import RingBufConsumer
 
@@ -248,7 +248,7 @@ def test_bcc_fallback_forwards_stub_samples(tmp_path):
         # loaded CI host (subprocess start + the tracer's sampling
         # window), flaking this test without any real defect.
         forwarded = fallback.run_once(timeout_s=60.0)
-        assert forwarded == 2  # dns stub + live tcp tracer
+        assert forwarded == 2  # live dns probe + live tcp tracer
         signals = {s.signal for s in consumer.poll()}
         assert signals == {"dns_latency_ms", "tcp_retransmits_total"}
     finally:
@@ -444,3 +444,130 @@ def test_multi_ring_fanin_concurrent(tmp_path):
     for values in by_ring.values():
         assert values == sorted(values)
         assert len(values) == per_ring
+
+
+def _load_dns_tracer():
+    import importlib.util
+    from pathlib import Path
+
+    path = (
+        Path(__file__).resolve().parent.parent
+        / "ebpf"
+        / "bcc-fallback"
+        / "dns_latency.py"
+    )
+    spec = importlib.util.spec_from_file_location("dns_latency", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class _FakeResolver:
+    """Minimal UDP DNS responder on 127.0.0.1: echoes a valid header."""
+
+    def __enter__(self):
+        import socket
+        import struct
+        import threading
+
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind(("127.0.0.1", 0))
+        self.port = self.sock.getsockname()[1]
+        self.sock.settimeout(10.0)
+
+        def serve():
+            try:
+                while True:
+                    data, addr = self.sock.recvfrom(4096)
+                    txid = struct.unpack(">H", data[:2])[0]
+                    # QR=1 response, RD+RA, zero counts but the query's id.
+                    reply = struct.pack(">HHHHHH", txid, 0x8180, 1, 0, 0, 0)
+                    self.sock.sendto(reply + data[12:], addr)
+            except OSError:
+                return
+
+        self.thread = threading.Thread(target=serve, daemon=True)
+        self.thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.sock.close()
+
+
+class TestDNSLatencyTracer:
+    """The bcc_degraded DNS tracer measures, it doesn't stub
+    (the reference's is a one-static-sample placeholder)."""
+
+    def test_query_builder_wellformed(self):
+        mod = _load_dns_tracer()
+        q = mod.build_query("tpu.example.com")
+        assert q[:2] == b"\x12\x34"  # txid
+        assert b"\x03tpu\x07example\x03com\x00" in q
+        assert q.endswith(b"\x00\x01\x00\x01")  # A, IN
+
+    def test_default_resolver_parses_resolv_conf(self, tmp_path):
+        mod = _load_dns_tracer()
+        conf = tmp_path / "resolv.conf"
+        conf.write_text("# comment\nsearch local\nnameserver 10.9.8.7\n")
+        assert mod.default_resolver(str(conf)) == "10.9.8.7"
+        assert mod.default_resolver(str(tmp_path / "absent")) == "127.0.0.53"
+
+    def test_resolver_probe_measures_live_roundtrip(self, capsys):
+        mod = _load_dns_tracer()
+        with _FakeResolver() as fake:
+            rc = mod.run_resolver_probe(
+                0.01, 3, "127.0.0.1", "example.com", 5.0, port=fake.port
+            )
+        assert rc == 0
+        lines = [
+            json.loads(line)
+            for line in capsys.readouterr().out.strip().splitlines()
+        ]
+        assert len(lines) == 3
+        for sample in lines:
+            assert sample["signal"] == "dns_latency_ms"
+            assert sample["source"] == "resolver_probe"
+            assert sample["value_ms"] > 0.0  # live nonzero measurement
+            assert sample["resolver"] == "127.0.0.1"
+
+    def test_dead_resolver_never_fabricates_latency(self, capsys):
+        """Probe-infrastructure failure must not enter the
+        dns_latency_ms stream (it would read as a real 16x-threshold
+        DNS incident); it surfaces as a distinct dns_probe_error
+        sample the forwarding bridge drops."""
+        mod = _load_dns_tracer()
+        rc = mod.run_resolver_probe(
+            0.01, 1, "127.0.0.1", "example.com", 0.2, port=9
+        )
+        assert rc == 0
+        sample = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert sample["signal"] == "dns_probe_error"
+        assert sample["source"] == "resolver_probe_failed"
+        assert "value_ms" not in sample
+
+    def test_auto_mode_subprocess_emits_live_sample(self):
+        import subprocess
+        import sys
+        from pathlib import Path
+
+        script = (
+            Path(__file__).resolve().parent.parent
+            / "ebpf"
+            / "bcc-fallback"
+            / "dns_latency.py"
+        )
+        with _FakeResolver() as fake:
+            proc = subprocess.run(
+                [
+                    sys.executable, str(script),
+                    "--resolver", "127.0.0.1",
+                    "--resolver-port", str(fake.port),
+                ],
+                capture_output=True, text=True, timeout=60,
+            )
+        assert proc.returncode == 0
+        sample = json.loads(proc.stdout.strip().splitlines()[-1])
+        # bcc on a BCC host, the resolver probe everywhere else — never
+        # the old stub.
+        assert sample["source"] in ("bcc_kprobe", "resolver_probe")
+        assert sample["value_ms"] > 0.0

@@ -369,3 +369,48 @@ class TestCaptureSmoke:
         assert cap.span_refs(service="s") == [
             r for r in cap.span_refs(service="s")
         ]
+
+
+class TestLaunchMatchBreakdown:
+    """Every unmatched launch span gets an explained reason (the r02
+    report's 0.556 join rate was unexplained — VERDICT weak #2)."""
+
+    def _spans(self):
+        from tpuslo.otel.xla_spans import parse_trace_events
+
+        return parse_trace_events(trace_doc(), include_ops=True)
+
+    def test_classifies_launches_without_ops(self):
+        from tpuslo.otel.xla_spans import launch_match_breakdown
+
+        report = launch_match_breakdown(self._spans())
+        # fusion.1 at ts=101 falls inside launch run_id=42 only; the
+        # other two launches have no contained ops.
+        assert report["launches"] == 3
+        assert report["launches_with_ops"] == 1
+        assert report["unmatched_count"] == 2
+        assert report["reasons"] == {"no_contained_ops": 2}
+        # Of the launches WITH ops, all carry exact identity -> the
+        # xla_launch tier can serve 100% of its real denominator.
+        assert report["substantive_join_rate"] == 1.0
+        unmatched_ids = {u["launch_id"] for u in report["unmatched"]}
+        assert unmatched_ids == {43, 7}
+
+    def test_no_ops_lane_reason(self):
+        from tpuslo.otel.xla_spans import (
+            launch_match_breakdown,
+            parse_trace_events,
+        )
+
+        spans = parse_trace_events(trace_doc(), include_ops=False)
+        report = launch_match_breakdown(spans)
+        assert report["launches"] == 3
+        assert report["launches_with_ops"] == 0
+        assert report["reasons"] == {"no_ops_lane": 3}
+
+    def test_empty_trace(self):
+        from tpuslo.otel.xla_spans import launch_match_breakdown
+
+        report = launch_match_breakdown([])
+        assert report["launches"] == 0
+        assert report["substantive_join_rate"] == 0.0

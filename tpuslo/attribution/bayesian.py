@@ -435,17 +435,16 @@ class BayesianAttributor:
         footprints.  For full 18-signal vectors the two semantics
         coincide.
         """
-        restricted = observed is not None
-        observed, weights = self._observed_and_weights(signals, observed)
-        # Evidence membership (supporting-signal lists) is weight >= 0.5
-        # over the FULL signal vector — identical to "elevated" in hard
-        # mode, and unaffected by an ``observed`` restriction (the
-        # residual pass restricts the factors, not what counts as an
-        # elevated supporting signal).
-        if restricted:
-            _, full_weights = self._observed_and_weights(signals)
+        # One pass over the full vector; an ``observed`` restriction
+        # (the residual pass) narrows which factors enter the product,
+        # not what counts as an elevated supporting signal — evidence
+        # membership (weight >= 0.5) always reads the full weights.
+        full_observed, full_weights = self._observed_and_weights(signals)
+        if observed is None:
+            observed, weights = full_observed, full_weights
         else:
-            full_weights = weights
+            observed = {s for s in observed if s in full_observed}
+            weights = {s: full_weights[s] for s in observed}
         elevated = {s for s, w in full_weights.items() if w >= 0.5}
 
         log_posteriors: dict[str, float] = {}

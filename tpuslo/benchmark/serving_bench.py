@@ -404,6 +404,18 @@ def _xla_launch_join(engine, prompt: str, node: str) -> dict[str, Any]:
                 matched += 1
         out["xla_launch_matches"] = matched
         out["xla_launch_join_rate"] = round(matched / len(span_refs), 4)
+        # Explain the denominator (r02 reported 0.556 with no breakdown):
+        # helper programs without device ops can never join; the
+        # substantive rate is over launches that have ops at all.
+        breakdown = xla_spans.launch_match_breakdown(cap.spans)
+        out["xla_launch_join_rate_substantive"] = breakdown[
+            "substantive_join_rate"
+        ]
+        out["xla_launch_unmatched"] = {
+            "count": breakdown["unmatched_count"],
+            "reasons": breakdown["reasons"],
+            "examples": breakdown["unmatched"][:6],
+        }
         return out
 
 

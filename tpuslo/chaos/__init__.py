@@ -1,0 +1,15 @@
+"""Real fault injectors (importable cores).
+
+The chaos scripts under ``scripts/chaos/injectors/`` are thin CLI
+wrappers over this package so the injection logic is unit-testable —
+the reference keeps its injectors as opaque shell
+(``/root/reference/scripts/chaos/run_fault_matrix.sh:118-167``); the
+TPU rebuild's injectors are Python because the faults themselves are
+JAX-level (device contention, HBM squatting, recompile storms).
+"""
+
+from tpuslo.chaos.ici_contention import (  # noqa: F401
+    BarrierHostResult,
+    contention_injection,
+    run_straggler_injection,
+)

@@ -81,26 +81,54 @@ def main(argv: list[str] | None = None) -> int:
         host_index=args.host_index,
     )
 
-    sink = sys.stdout if args.output == "-" else open(args.output, "w")
-    try:
-        for probe, event in zip(probes, events):
-            payload = event.to_dict()
-            if not validate_probe(event):
-                print(
-                    f"icibench: schema-invalid event for {probe.op}",
-                    file=sys.stderr,
-                )
-                return 1
-            sink.write(json.dumps(payload) + "\n")
+    # Validate EVERY event before writing ANY output: a mid-loop abort
+    # used to leave a partial JSONL artifact that downstream consumers
+    # (CI line-count check, weekly artifact upload) could read as a
+    # complete capture.
+    lines = []
+    for probe, event in zip(probes, events):
+        if not validate_probe(event):
             print(
-                f"icibench: {probe.op:>14} n={probe.n_devices} "
-                f"payload={probe.payload_bytes_per_device >> 10}KiB/dev "
-                f"p50={probe.p50_ms:.3f}ms p95={probe.p95_ms:.3f}ms",
+                f"icibench: schema-invalid event for {probe.op}; "
+                "no output written",
                 file=sys.stderr,
             )
-    finally:
-        if sink is not sys.stdout:
-            sink.close()
+            return 1
+        lines.append(json.dumps(event.to_dict()) + "\n")
+
+    if args.output == "-":
+        sys.stdout.writelines(lines)
+    else:
+        # Temp file + atomic rename: the artifact either exists complete
+        # or not at all.
+        import os
+        import tempfile
+
+        out_dir = os.path.dirname(os.path.abspath(args.output)) or "."
+        fd, tmp = tempfile.mkstemp(dir=out_dir, suffix=".tmp")
+        try:
+            # mkstemp creates 0600; match what plain open() would have
+            # produced so cross-user artifact consumers keep working.
+            umask = os.umask(0)
+            os.umask(umask)
+            os.fchmod(fd, 0o666 & ~umask)
+            with os.fdopen(fd, "w") as fh:
+                fh.writelines(lines)
+            os.replace(tmp, args.output)
+            tmp = None
+        finally:
+            if tmp is not None:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+    for probe in probes:
+        print(
+            f"icibench: {probe.op:>14} n={probe.n_devices} "
+            f"payload={probe.payload_bytes_per_device >> 10}KiB/dev "
+            f"p50={probe.p50_ms:.3f}ms p95={probe.p95_ms:.3f}ms",
+            file=sys.stderr,
+        )
     return 0
 
 

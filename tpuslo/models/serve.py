@@ -506,8 +506,9 @@ class ServeEngine:
         pos = 0
         while pos < maxlen:
             take = min(self.prefill_buckets[-1], maxlen - pos)
-            bucket = _bucket(take, self.prefill_buckets)
-            bucket = min(bucket, self.cfg.max_seq_len - (start + pos))
+            bucket = self._chunk_bucket(
+                take, self.cfg.max_seq_len - (start + pos)
+            )
             take = min(take, bucket)
             chunk_rows = [row[pos : pos + take] for row in rows]
             tokens = jnp.asarray(
@@ -593,6 +594,25 @@ class ServeEngine:
         (dense array leaves, int8 {"q","s"} dict leaves)."""
         return jax.tree.map(jnp.copy, cache)
 
+    def _chunk_bucket(self, take: int, remaining: int) -> int:
+        """Chunk bucket that never crosses the cache end while reusing
+        standard shapes.
+
+        The natural bucket is clamped to ``remaining`` KV slots; a raw
+        clamp would compile a one-off shape per distinct near-capacity
+        length (a recompile source inside the very engine whose
+        bucketing exists to prevent recompile storms), so the clamp
+        rounds DOWN to the largest standard bucket that fits and lets a
+        smaller follow-up chunk take the rest.  Only a tail shorter
+        than every bucket still compiles a one-off shape (and shows up
+        in compile telemetry).
+        """
+        bucket = _bucket(take, self.prefill_buckets)
+        if bucket <= remaining:
+            return bucket
+        fitting = [b for b in self.prefill_buckets if b <= remaining]
+        return fitting[-1] if fitting else remaining
+
     def _record_compile(self, kind: str, bucket: int, elapsed_ms: float) -> None:
         """First slow hit on a shape is (almost always) a compile;
         later hits of the same shape are steady-state compute and must
@@ -617,8 +637,9 @@ class ServeEngine:
         pos = 0
         while pos < len(ids):
             take = min(self.prefill_buckets[-1], len(ids) - pos)
-            bucket = _bucket(take, self.prefill_buckets)
-            bucket = min(bucket, self.cfg.max_seq_len - (start + pos))
+            bucket = self._chunk_bucket(
+                take, self.cfg.max_seq_len - (start + pos)
+            )
             take = min(take, bucket)
             chunk = ids[pos : pos + take] + [0] * (bucket - take)
             first_hit = ("suffix", bucket) not in self._seen_shapes

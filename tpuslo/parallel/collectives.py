@@ -188,6 +188,7 @@ class ActiveICIProber:
         payload_kb: int = 256,
         reps: int = 5,
         log=None,
+        timeout_s: float = 120.0,
     ):
         self.interval_s = interval_s
         self.node = node
@@ -196,31 +197,68 @@ class ActiveICIProber:
         self.host_index = host_index
         self.payload_kb = payload_kb
         self.reps = reps
+        self.timeout_s = timeout_s
         self._next_due = 0.0  # first cycle probes immediately
         self._disabled = False
         self._suite: CollectiveSuite | None = None
         self._log = log or (lambda msg: None)
 
+    def _probe_once(self) -> tuple["CollectiveSuite", list[CollectiveProbe]]:
+        """Build-or-reuse the suite and measure; returns both WITHOUT
+        publishing to ``self._suite`` — the caller publishes only after
+        a successful timed join, so a worker that outlives its timeout
+        cannot re-attach a handle the timeout path already dropped."""
+        suite = self._suite
+        if suite is None:
+            # One-time compile + device_put; later intervals only
+            # replay the compiled programs (OverheadGuard would
+            # otherwise see a recompile burst every interval and
+            # shed unrelated passive probes).
+            suite = CollectiveSuite(payload_bytes=self.payload_kb * 1024)
+        return suite, suite.measure(self.reps)
+
     def maybe_probe(self, now_monotonic: float) -> list[ProbeEventV1]:
         if self._disabled or now_monotonic < self._next_due:
             return []
         self._next_due = now_monotonic + self.interval_s
-        try:
-            if self._suite is None:
-                # One-time compile + device_put; later intervals only
-                # replay the compiled programs (OverheadGuard would
-                # otherwise see a recompile burst every interval and
-                # shed unrelated passive probes).
-                self._suite = CollectiveSuite(
-                    payload_bytes=self.payload_kb * 1024
-                )
-            probes = self._suite.measure(self.reps)
-        except Exception as exc:  # noqa: BLE001 - device unavailable
+        # The documented failure mode of an unreachable device backend
+        # is a HANG in backend init (the axon plugin retries forever —
+        # no exception for try/except to catch), so the build+measure
+        # runs in a worker thread with a join timeout: a wedged tunnel
+        # disables the prober instead of stalling the whole agent emit
+        # loop (passive probes, heartbeat, metrics).  The leaked daemon
+        # thread parks forever inside the backend; the suite handle is
+        # dropped so no later cycle touches it.
+        import threading
+
+        box: dict[str, object] = {}
+
+        def worker():
+            try:
+                result = self._probe_once()
+                if result is not None:
+                    box["suite"], box["probes"] = result
+            except Exception as exc:  # noqa: BLE001 - device unavailable
+                box["error"] = exc
+
+        thread = threading.Thread(target=worker, daemon=True)
+        thread.start()
+        thread.join(timeout=self.timeout_s)
+        if thread.is_alive():
             self._disabled = True
-            self._log(f"ici prober disabled: {exc}")
+            self._suite = None
+            self._log(
+                f"ici prober disabled: probe exceeded {self.timeout_s}s "
+                "(backend hang — tunnel down?)"
+            )
             return []
+        if "error" in box or "probes" not in box:
+            self._disabled = True
+            self._log(f"ici prober disabled: {box.get('error', 'no result')}")
+            return []
+        self._suite = box["suite"]  # type: ignore[assignment]
         return probes_to_events(
-            probes,
+            box["probes"],  # type: ignore[arg-type]
             node=self.node,
             namespace=self.namespace,
             slice_id=self.slice_id,
