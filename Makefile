@@ -24,8 +24,10 @@ ebpf:
 test: native
 	$(PY) -m pytest tests/ -q
 
+# Sub-2-minute gate on one CPU: skips the compile-heavy model/serving
+# modules (marked slow); full coverage stays in `make test`.
 test-fast: native
-	$(PY) -m pytest tests/ -q -x
+	$(PY) -m pytest tests/ -q -x -m "not slow"
 
 lint:
 	$(PY) -m compileall -q tpuslo demo tests tools bench.py __graft_entry__.py

@@ -219,3 +219,7 @@ def test_near_capacity_admission_skips_tail_compile():
     assert engine._ingest._decode_one is None  # tail fn never built
     # Budget equals what streaming serving grants for the same prompt.
     assert len(results[rid]) == engine._ingest.decode_cap_tokens(121)
+
+# Compile-heavy module: excluded from the sub-2-minute fast gate
+# (`make test-fast` / pytest -m "not slow"); the full suite runs it.
+pytestmark = pytest.mark.slow

@@ -49,6 +49,7 @@ def test_quantized_tree_roundtrip(tmp_path):
     _trees_equal(qparams, restored)
 
 
+@pytest.mark.slow
 def test_sharded_restore(tmp_path):
     mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("tp",))
     sharding = NamedSharding(mesh, P(None, "tp"))
@@ -65,6 +66,7 @@ def test_sharded_restore(tmp_path):
     np.testing.assert_array_equal(np.asarray(restored["w"]), np.asarray(x))
 
 
+@pytest.mark.slow
 def test_train_checkpointer_rotation_and_resume(tmp_path):
     cfg = llama_tiny(max_seq_len=32)
     params = init_params(jax.random.PRNGKey(0), cfg)

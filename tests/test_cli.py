@@ -4,6 +4,7 @@ Reference model: the reference CI's no-cluster smoke tests
 (fault-inject→collector pipe, replay→benchgen, correlation gate).
 """
 
+import pytest
 import json
 import urllib.request
 
@@ -238,6 +239,7 @@ class TestAgentCLI:
         assert rc in (0, 1)  # depends on host privileges
 
 
+@pytest.mark.slow
 class TestTrain:
     def test_train_cli_steps_and_summary(self, capsys):
         # conftest already forces the 8-device CPU mesh.

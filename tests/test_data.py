@@ -40,6 +40,7 @@ def test_small_corpus_rejected():
         next(window_batches(tokenize_corpus(["x"]), batch=4, seq_len=128))
 
 
+@pytest.mark.slow
 def test_prefetch_yields_device_arrays():
     toks = tokenize_corpus(CORPUS)
     stream = prefetch_to_device(window_batches(toks, 2, 16))
@@ -50,6 +51,7 @@ def test_prefetch_yields_device_arrays():
     assert count == len(list(window_batches(toks, 2, 16)))
 
 
+@pytest.mark.slow
 def test_sharded_prefetch_and_train_step():
     from tpuslo.models.llama import llama_tiny
     from tpuslo.models.train import build_sharded_train_step

@@ -152,6 +152,7 @@ class TestSpanRecorder:
         assert names == ["s2", "s3", "s4"]
 
 
+@pytest.mark.slow
 class TestGraftEntry:
     def test_entry_compiles(self):
         import importlib
@@ -171,6 +172,7 @@ class TestGraftEntry:
         assert "ok on 8 devices" in capsys.readouterr().out
 
 
+@pytest.mark.slow
 def test_jax_batched_backend_concurrent_requests():
     """Concurrent handlers share the slot pool; every request finishes
     and the lock discipline never deadlocks."""
@@ -239,6 +241,7 @@ def test_correlation_confidence_gauge_exported():
     assert float(line.split()[-1]) >= 0.7
 
 
+@pytest.mark.slow
 def test_jax_moe_backend_streams():
     from demo.rag_service.service import JaxMoEBackend, RagService
 
@@ -249,6 +252,7 @@ def test_jax_moe_backend_streams():
     assert events[-1]["token_count"] > 0
 
 
+@pytest.mark.slow
 def test_jax_moe_backend_model_env(monkeypatch):
     from tpuslo.models.mixtral import mixtral_tiny
 
@@ -272,6 +276,7 @@ def test_serve_model_env_validation_messages(monkeypatch):
         JaxMoEBackend()
 
 
+@pytest.mark.slow
 def test_jax_moe_backend_rejects_llama_model_env(monkeypatch):
     import pytest
 

@@ -5,6 +5,7 @@ on real TPU it runs compiled, here every test uses interpret=True via
 the TPUSLO_FLASH_ATTENTION=1 override or direct calls.
 """
 
+import pytest
 import jax
 import jax.numpy as jnp
 
@@ -165,3 +166,7 @@ class TestModelIntegration:
         flat = jax.tree_util.tree_leaves(grads)
         assert all(jnp.all(jnp.isfinite(g)) for g in flat)
         assert any(float(jnp.max(jnp.abs(g))) > 0 for g in flat)
+
+# Compile-heavy module: excluded from the sub-2-minute fast gate
+# (`make test-fast` / pytest -m "not slow"); the full suite runs it.
+pytestmark = pytest.mark.slow

@@ -174,3 +174,7 @@ def test_continuous_batching_int8_parity():
             e.token_id for e in single.generate(prompt, max_new_tokens=8)
         ]
         assert results[rid] == expect
+
+# Compile-heavy module: excluded from the sub-2-minute fast gate
+# (`make test-fast` / pytest -m "not slow"); the full suite runs it.
+pytestmark = pytest.mark.slow

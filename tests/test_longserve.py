@@ -106,3 +106,7 @@ def test_sp_decode_tail_full_raises():
     _, cache = sp_decode_step(params, tok, cache, cfg, mesh)
     with pytest.raises(ValueError, match="tail buffer full"):
         sp_decode_step(params, tok, cache, cfg, mesh)
+
+# Compile-heavy module: excluded from the sub-2-minute fast gate
+# (`make test-fast` / pytest -m "not slow"); the full suite runs it.
+pytestmark = pytest.mark.slow

@@ -2,6 +2,7 @@
 
 from dataclasses import replace
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -199,3 +200,7 @@ def test_mixtral_8x7b_train_step_compiles_dp_ep():
 
     compiled = step.lower(abstract, abstract_opt, tokens, tokens).compile()
     assert compiled is not None
+
+# Compile-heavy module: excluded from the sub-2-minute fast gate
+# (`make test-fast` / pytest -m "not slow"); the full suite runs it.
+pytestmark = pytest.mark.slow

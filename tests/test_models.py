@@ -383,3 +383,7 @@ def test_optimizer_state_shardings_path_matching():
     assert opt_shard[0]["mu"]["wa"] == shard_a
     assert opt_shard[0]["mu"]["wb"] == shard_b
     assert opt_shard[0]["count"] == NamedSharding(mesh, P())
+
+# Compile-heavy module: excluded from the sub-2-minute fast gate
+# (`make test-fast` / pytest -m "not slow"); the full suite runs it.
+pytestmark = pytest.mark.slow

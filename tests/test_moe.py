@@ -133,3 +133,7 @@ def test_moe_mlp_return_aux():
     y, aux = moe_mlp(params, x, cfg, return_aux=True)
     assert y.shape == x.shape
     assert float(aux) >= 1.0 - 1e-3  # lower bound at perfect balance
+
+# Compile-heavy module: excluded from the sub-2-minute fast gate
+# (`make test-fast` / pytest -m "not slow"); the full suite runs it.
+pytestmark = pytest.mark.slow

@@ -201,3 +201,7 @@ def test_pool_structure():
     assert state["length"].shape == (4,)
     q = init_paged_pool(CFG, 9, 16, 4, kv_dtype="int8")
     assert q["k"]["q"].dtype == jnp.int8
+
+# Compile-heavy module: excluded from the sub-2-minute fast gate
+# (`make test-fast` / pytest -m "not slow"); the full suite runs it.
+pytestmark = pytest.mark.slow

@@ -79,3 +79,7 @@ def test_pipeline_rejects_indivisible_layers():
     tokens = jnp.zeros((4, 8), jnp.int32)
     with pytest.raises(ValueError, match="not divisible"):
         pipelined_forward(params, tokens, cfg, mesh)
+
+# Compile-heavy module: excluded from the sub-2-minute fast gate
+# (`make test-fast` / pytest -m "not slow"); the full suite runs it.
+pytestmark = pytest.mark.slow

@@ -5,6 +5,7 @@ suffix-only chunked prefill via ``verify_chunk``) must produce the
 same stream as prefilling ``prefix + prompt`` from scratch.
 """
 
+import pytest
 import jax
 
 from tpuslo.models.llama import init_params, llama_tiny
@@ -267,3 +268,7 @@ def test_generate_batch_long_prefix_long_suffix():
             )
         ]
         assert row == single
+
+# Compile-heavy module: excluded from the sub-2-minute fast gate
+# (`make test-fast` / pytest -m "not slow"); the full suite runs it.
+pytestmark = pytest.mark.slow

@@ -1,5 +1,6 @@
 """int8 weight-only quantization: numerics, memory layout, serving."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -102,3 +103,7 @@ def test_int8_leaves_really_int8():
     assert q["embed"]["q"].dtype == jnp.int8
     assert q["output"]["q"].dtype == jnp.int8
     assert q["layers"]["attn_norm"].dtype == cfg.dtype
+
+# Compile-heavy module: excluded from the sub-2-minute fast gate
+# (`make test-fast` / pytest -m "not slow"); the full suite runs it.
+pytestmark = pytest.mark.slow

@@ -75,6 +75,7 @@ def test_temperature_flattens_distribution():
     assert (counts_hot > 0).sum() >= 3  # hot spreads over most tokens
 
 
+@pytest.mark.slow
 class TestServeEngineSampling:
     def _engine(self):
         cfg = llama_tiny(max_seq_len=128)

@@ -1,6 +1,7 @@
 """Tensor-parallel serving: sharded engine matches the single-device one."""
 
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -186,3 +187,7 @@ def test_llama3_70b_int8_tp8_decode_chunk_compiles():
         .compile()
     )
     assert compiled is not None
+
+# Compile-heavy module: excluded from the sub-2-minute fast gate
+# (`make test-fast` / pytest -m "not slow"); the full suite runs it.
+pytestmark = pytest.mark.slow

@@ -154,3 +154,7 @@ def test_speculative_near_capacity_exact():
     want = _plain_greedy(ServeEngine(cfg=cfg, params=params), prompt, 8)
     got = spec.generate(prompt, max_new_tokens=8, stop_at_eos=False)
     assert got == want
+
+# Compile-heavy module: excluded from the sub-2-minute fast gate
+# (`make test-fast` / pytest -m "not slow"); the full suite runs it.
+pytestmark = pytest.mark.slow

@@ -1,5 +1,6 @@
 """Trainer: loss descent, checkpointed resume is bit-exact."""
 
+import pytest
 import numpy as np
 
 from tpuslo.models.llama import llama_tiny
@@ -53,3 +54,7 @@ def test_resume_matches_uninterrupted(tmp_path):
 
     resumed = first["losses"] + second["losses"]
     np.testing.assert_allclose(resumed, full, rtol=1e-5, atol=1e-6)
+
+# Compile-heavy module: excluded from the sub-2-minute fast gate
+# (`make test-fast` / pytest -m "not slow"); the full suite runs it.
+pytestmark = pytest.mark.slow
