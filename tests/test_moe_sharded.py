@@ -1,0 +1,168 @@
+"""Tensor-parallel MoE serving (VERDICT r02 next-round #10): expert
+weights sharded over tp, decode matching the single-device engine, and
+the 8x7B class compile-validated at tp=8 without materializing weights
+(the dense 70B discipline of tests/test_serve_sharded.py).
+"""
+
+from functools import partial
+
+import pytest
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from tpuslo.models.mixtral import (
+    MoEServeEngine,
+    init_params,
+    mixtral_8x7b,
+    mixtral_tiny,
+    tp_serve_param_shardings,
+)
+
+
+def _tp_mesh(tp: int) -> Mesh:
+    return Mesh(np.array(jax.devices()[:tp]), ("tp",))
+
+
+def _cfg():
+    # 4 q heads / 2 kv heads / ffn 128: tp=2 divides all three.
+    return mixtral_tiny(max_seq_len=128)
+
+
+def test_tp_moe_generation_matches_single_device():
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    plain = MoEServeEngine(cfg=cfg, params=params)
+    sharded = MoEServeEngine(cfg=cfg, params=params, mesh=_tp_mesh(2))
+    out_plain = [
+        e.token_id for e in plain.generate("tp moe", 12, stop_at_eos=False)
+    ]
+    out_shard = [
+        e.token_id for e in sharded.generate("tp moe", 12, stop_at_eos=False)
+    ]
+    assert len(out_shard) == 12
+    # Greedy argmax over near-identical logits (psum reassociation):
+    # allow a rare late flip but the prefix must agree.
+    assert out_plain[:8] == out_shard[:8]
+
+
+def test_tp_moe_prefill_logits_match():
+    from tpuslo.models.mixtral import prefill
+
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    plain = MoEServeEngine(cfg=cfg, params=params)
+    sharded = MoEServeEngine(cfg=cfg, params=params, mesh=_tp_mesh(2))
+    tokens = jnp.zeros((1, 32), jnp.int32).at[0, :4].set(
+        jnp.asarray([256, 104, 105, 33])
+    )
+    tl = jnp.asarray(4, jnp.int32)
+    lp, _ = plain._prefill(
+        plain.params, tokens, plain._init_cache(1), true_length=tl
+    )
+    ls, _ = sharded._prefill(
+        sharded.params, tokens, sharded._init_cache(1), true_length=tl
+    )
+    assert float(jnp.max(jnp.abs(lp - ls))) < 5e-2
+
+
+def test_tp_moe_mesh_init_shards_expert_leaves():
+    """params=None + mesh: experts initialize directly into shards."""
+    engine = MoEServeEngine(cfg=_cfg(), mesh=_tp_mesh(2))
+    w1 = engine.params["layers"]["w1"]
+    assert w1.sharding.spec == (None, None, None, "tp")
+    events = list(engine.generate("sharded moe", 4, stop_at_eos=False))
+    assert len(events) == 4
+
+
+def test_tp_moe_indivisible_rejected():
+    cfg = mixtral_tiny()  # n_kv_heads=2
+    with pytest.raises(ValueError, match="must divide"):
+        MoEServeEngine(cfg=cfg, mesh=_tp_mesh(4))
+    mesh = Mesh(np.array(jax.devices()[:2]), ("dp",))
+    with pytest.raises(ValueError, match="tp"):
+        MoEServeEngine(cfg=cfg, mesh=mesh)
+
+
+def _mixtral8x7b_abstract_setup():
+    from dataclasses import replace
+
+    from tpuslo.models.llama import init_kv_cache
+
+    from tpuslo.models.serve import kv_cache_shardings
+
+    mesh = _tp_mesh(8)
+    cfg = replace(mixtral_8x7b(), max_seq_len=256)
+    abstract_params = jax.eval_shape(
+        partial(init_params, cfg=cfg), jax.random.PRNGKey(0)
+    )
+    shardings = tp_serve_param_shardings(mesh)
+    cache_abstract = jax.eval_shape(
+        lambda: init_kv_cache(cfg.attn_cfg(), 1)
+    )
+    return mesh, cfg, abstract_params, shardings, kv_cache_shardings(mesh), cache_abstract
+
+
+def test_mixtral_8x7b_tp8_prefill_compiles():
+    """The 8x7B-over-v5e-8 serving claim, compile-validated without
+    weights: GSPMD partitioning runs at .compile(), which is the step
+    that rejects inconsistent expert shardings."""
+    from tpuslo.models.mixtral import prefill
+
+    _mesh, cfg, abstract_params, shardings, kv_shard, cache_abstract = (
+        _mixtral8x7b_abstract_setup()
+    )
+    assert cfg.n_heads % 8 == 0 and cfg.n_kv_heads % 8 == 0
+    assert cfg.ffn_dim % 8 == 0
+    n_bytes = sum(
+        x.size * x.dtype.itemsize for x in jax.tree.leaves(abstract_params)
+    )
+    assert n_bytes > 80e9  # ~47B params bf16: needs the full v5e-8
+
+    tokens = jax.ShapeDtypeStruct((1, 64), jnp.int32)
+
+    def prefill_pos(params, toks, cache, true_length):
+        return prefill(params, toks, cache, cfg, true_length=true_length)
+
+    compiled = (
+        jax.jit(
+            prefill_pos,
+            in_shardings=(shardings, None, kv_shard, None),
+        )
+        .lower(
+            abstract_params,
+            tokens,
+            cache_abstract,
+            jax.ShapeDtypeStruct((), jnp.int32),
+        )
+        .compile()
+    )
+    assert compiled is not None
+
+
+def test_mixtral_8x7b_tp8_decode_chunk_compiles():
+    from tpuslo.models.mixtral import decode_chunk
+
+    _mesh, cfg, abstract_params, shardings, kv_shard, cache_abstract = (
+        _mixtral8x7b_abstract_setup()
+    )
+    token = jax.ShapeDtypeStruct((1,), jnp.int32)
+
+    def decode_pos(params, tok, cache):
+        return decode_chunk(params, tok, cache, cfg, num_tokens=4)
+
+    compiled = (
+        jax.jit(
+            decode_pos,
+            in_shardings=(shardings, None, kv_shard),
+        )
+        .lower(abstract_params, token, cache_abstract)
+        .compile()
+    )
+    assert compiled is not None
+
+
+# Compile-heavy module: excluded from the sub-2-minute fast gate
+# (`make test-fast` / pytest -m "not slow"); the full suite runs it.
+pytestmark = pytest.mark.slow

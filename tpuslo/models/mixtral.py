@@ -355,10 +355,44 @@ class MoEServeEngine:
         rng_seed: int = 0,
         prefill_buckets: tuple[int, ...] = (32, 64, 128),
         decode_chunk_size: int = 16,
+        mesh: Mesh | None = None,
     ):
         from tpuslo.models.llama import init_kv_cache
 
         self.cfg = cfg or mixtral_tiny(max_seq_len=256)
+        self.mesh = mesh
+        self._cache_shardings = None
+        if mesh is not None:
+            from tpuslo.models.serve import kv_cache_shardings
+
+            if "tp" not in mesh.axis_names:
+                raise ValueError(
+                    f"MoE serving mesh must have a 'tp' axis, got "
+                    f"{mesh.axis_names}"
+                )
+            tp = mesh.shape["tp"]
+            if (
+                self.cfg.n_kv_heads % tp
+                or self.cfg.n_heads % tp
+                or self.cfg.ffn_dim % tp
+            ):
+                raise ValueError(
+                    f"tp={tp} must divide n_kv_heads="
+                    f"{self.cfg.n_kv_heads}, n_heads={self.cfg.n_heads} "
+                    f"and ffn_dim={self.cfg.ffn_dim}"
+                )
+            self._cache_shardings = kv_cache_shardings(mesh)
+            shardings = tp_serve_param_shardings(mesh)
+            if params is None:
+                # Initialize DIRECTLY into the tp shardings — no device
+                # ever holds the full expert tree (the 8x7B-over-v5e-8
+                # path, mirroring the dense 70B init discipline).
+                params = jax.jit(
+                    partial(init_params, cfg=self.cfg),
+                    out_shardings=shardings,
+                )(jax.random.PRNGKey(rng_seed))
+            else:
+                params = jax.device_put(params, shardings)
         self.params = params if params is not None else init_params(
             jax.random.PRNGKey(rng_seed), self.cfg
         )
@@ -368,9 +402,14 @@ class MoEServeEngine:
         self.decode_chunk_size = max(
             1, min(decode_chunk_size, (self.cfg.max_seq_len - 2) // 2)
         )
-        self._init_cache = lambda batch: init_kv_cache(
-            self.cfg.attn_cfg(), batch
-        )
+
+        def init_cache(batch):
+            cache = init_kv_cache(self.cfg.attn_cfg(), batch)
+            if self._cache_shardings is not None:
+                cache = jax.device_put(cache, self._cache_shardings)
+            return cache
+
+        self._init_cache = init_cache
         self._prefill = jax.jit(
             partial(prefill, cfg=self.cfg), donate_argnums=(2,)
         )
@@ -451,6 +490,38 @@ class MoEServeEngine:
             toks, last = next_toks, next_last
 
 
+def tp_serve_param_shardings(mesh: Mesh) -> PyTree:
+    """Tensor-parallel SERVING layout over a ``tp`` axis (8x7B class).
+
+    Megatron-style TP *within every expert*: w1/w3 shard their per-
+    expert hidden dim, w2 its contracting dim (one psum per MoE block),
+    attention shards like the dense llama serving layout
+    (:func:`tpuslo.models.serve.serve_param_shardings`).  Unlike the
+    dp x ep TRAINING layout (:func:`param_shardings`), no token ever
+    changes device — routing stays local, which is the serving-latency-
+    friendly choice — and every device holds 1/tp of EVERY expert, so
+    the 8x7B class (~47 GB bf16, ~24 GB int8) spreads over a v5e-8.
+    """
+    ns = lambda spec: NamedSharding(mesh, spec)  # noqa: E731
+    return {
+        "embed": ns(P("tp", None)),
+        "layers": {
+            "attn_norm": ns(P(None, None)),
+            "wq": ns(P(None, None, "tp")),
+            "wk": ns(P(None, None, "tp")),
+            "wv": ns(P(None, None, "tp")),
+            "wo": ns(P(None, "tp", None)),
+            "mlp_norm": ns(P(None, None)),
+            "router": ns(P(None, None, None)),
+            "w1": ns(P(None, None, None, "tp")),
+            "w3": ns(P(None, None, None, "tp")),
+            "w2": ns(P(None, None, "tp", None)),
+        },
+        "final_norm": ns(P(None)),
+        "output": ns(P(None, "tp")),
+    }
+
+
 def param_shardings(mesh: Mesh) -> PyTree:
     """dp x ep layout: expert leaves shard their expert axis over ep;
     attention weights replicate (tiny next to experts at 8x sparsity)."""
@@ -529,5 +600,6 @@ __all__ = [
     "decode_chunk",
     "loss_fn",
     "param_shardings",
+    "tp_serve_param_shardings",
     "build_moe_train_step",
 ]
