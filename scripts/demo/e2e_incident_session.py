@@ -1,0 +1,301 @@
+#!/usr/bin/env python3
+"""In-session end-to-end incident evidence, no cluster required.
+
+VERDICT r02 next-round #7: the kind/nightly integration can't run in
+this environment (no root, no k8s), so this script drives the same
+chain in one scripted session and commits the artifacts — mirroring
+the reference's evidence runbook
+(``/root/reference/docs/demos/e2e-evidence-runbook.md:1-12``):
+
+1. **RAG service live traffic** — real ``demo.vectordb`` retrieval
+   (jitted cosine top-k), spans recorded, span<->signal self-
+   correlation (trace tier, confidence 1.0), Prometheus scrape.
+2. **Agent, real ring loop** — the unprivileged userspace-ring path:
+   hello tracer heartbeats + the BCC fallback's live measurements
+   (resolver self-probe DNS latency, procfs TCP retransmits) flow
+   ringbuf -> normalize -> schema -> JSONL.
+3. **Real fault injection** — the ICI injector's two measured
+   mechanisms: a compute storm degrading the collective prober on the
+   8-device CPU mesh, and a delayed-host TCP-barrier straggler.
+4. **Correlation** — SliceJoiner attributes the delayed host from the
+   real per-host waits (confidence ~0.90 >= 0.7).
+5. **Attribution** — the calibrated Bayesian attributor names
+   ``tpu_ici`` top-1 from the REAL contended measurement (no synthetic
+   profile anywhere in the fault path).
+
+Usage: python scripts/demo/e2e_incident_session.py [--out DIR]
+Writes the bundle + README.md; exits nonzero if any evidence bar
+(correlation >= 0.7, top-1 == tpu_ici) fails.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def phase_service(out: Path) -> dict:
+    """Live RAG traffic with real vectordb retrieval; spans + scrape."""
+    from prometheus_client import generate_latest
+
+    from demo.rag_service.service import RagService
+    from demo.vectordb import VectorStore
+
+    store = VectorStore()
+    corpus = json.loads(
+        (REPO / "demo" / "rag_service" / "fixtures" / "corpus.json").read_text()
+    )
+    for doc in corpus:
+        store.add(doc["id"], doc["text"])
+
+    svc = RagService(sleep=lambda s: None, vector_store=store)
+    summaries = []
+    for i, (query, profile) in enumerate(
+        [
+            ("what drives ttft on a v5e?", "chat_short"),
+            ("attribute the slo burn", "rag_medium"),
+            ("long context ingestion cost", "context_long"),
+            ("which expert is hot?", "rag_medium"),
+        ]
+    ):
+        events = list(svc.chat(query, profile=profile))
+        summaries.append([e for e in events if e.get("type") == "summary"][-1])
+
+    spans = svc.recorder.recent(n=10_000)
+    (out / "service_spans.jsonl").write_text(
+        "".join(json.dumps(s) + "\n" for s in spans)
+    )
+    (out / "service_requests.json").write_text(json.dumps(summaries, indent=2))
+    (out / "service_metrics.prom").write_bytes(
+        generate_latest(svc.metrics.registry)
+    )
+    confidences = [
+        s["attributes"].get("llm.ebpf.correlation_confidence")
+        for s in spans
+        if s["name"] == "chat.retrieval"
+    ]
+    retrieval_hits = summaries[1].get("retrieval", {})
+    return {
+        "requests": len(summaries),
+        "spans": len(spans),
+        "span_signal_confidences": confidences,
+        # 0.0 when no span carried a confidence: the verdict fails
+        # loudly instead of the script crashing on min() of nothing.
+        "min_confidence": min(
+            (c for c in confidences if c is not None), default=0.0
+        ),
+        "vectordb_backed": bool(len(store)),
+        "sample_retrieval": retrieval_hits,
+    }
+
+
+def phase_agent_ring(out: Path) -> dict:
+    """Real ring-loop agent run (userspace rings, unprivileged).
+
+    Kernel CO-RE objects aren't buildable here (no clang) — degradation
+    the agent reports per signal — so the LIVE measurements come from
+    the BCC-degraded tier: the DNS resolver self-probe and the procfs
+    TCP retransmit counter, forwarded into a userspace ring the agent
+    consumes through the same ringbuf -> normalize -> schema path the
+    kernel probes use.
+    """
+    import tempfile
+
+    from tpuslo.collector.bcc_fallback import BCCFallback
+
+    ring_path = os.path.join(tempfile.gettempdir(), "tpuslo-e2e.ring")
+    if os.path.exists(ring_path):
+        os.unlink(ring_path)
+    # Ring consumers attach at the writer's head (they see only events
+    # written AFTER attach), so the agent starts first and the live
+    # measurements are produced while it polls.
+    events_path = out / "agent_events.jsonl"
+    agent = subprocess.Popen(
+        [
+            sys.executable, "-m", "tpuslo", "agent",
+            "--probe-source", "ring",
+            "--ring-path", ring_path,
+            "--count", "12", "--interval-s", "1.0",
+            "--event-kind", "probe",
+            "--output", "jsonl", "--jsonl-path", str(events_path),
+            "--metrics-port", "0",
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, cwd=REPO,
+        # Bound the HBM sampler's live-device probe: with the tunnel
+        # down jax.devices() hangs, and the sampler's one-shot timeout
+        # (then permanent disable) keeps the ring loop flowing.
+        env={**os.environ, "TPUSLO_HBM_PROBE_TIMEOUT_S": "5"},
+    )
+    time.sleep(3.0)  # let the agent attach its consumers
+    fallback = BCCFallback(ring_path)
+    forwarded = fallback.run_once(timeout_s=60.0)
+    forwarded += fallback.run_once(timeout_s=60.0)
+    fallback.close()
+    try:
+        _out, err = agent.communicate(timeout=300)
+        rc = agent.returncode
+    except subprocess.TimeoutExpired:
+        agent.kill()
+        _out, err = agent.communicate()
+        rc = -9
+    proc = type("P", (), {"returncode": rc, "stderr": err})()
+    (out / "agent_stderr.log").write_text(proc.stderr)
+    events = []
+    if events_path.exists():
+        events = [
+            json.loads(line)
+            for line in events_path.read_text().splitlines()
+            if line.strip()
+        ]
+    signals = sorted({e.get("signal") for e in events})
+    live_dns = [
+        e["value"] for e in events if e.get("signal") == "dns_latency_ms"
+    ]
+    return {
+        "rc": proc.returncode,
+        "bcc_samples_forwarded": forwarded,
+        "events": len(events),
+        "signals": signals,
+        "live_dns_latency_ms": live_dns[:5],
+    }
+
+
+def phase_injection(out: Path) -> dict:
+    """Real ICI-domain injection on the virtual 8-device CPU mesh."""
+    from tpuslo.chaos import contention_injection, run_straggler_injection
+
+    contention = contention_injection(reps=6, payload_kb=256, storm_size=640)
+    straggler = run_straggler_injection(
+        n_hosts=3, launches=6, delay_ms=150.0, delayed_host=1,
+    )
+    (out / "injector_report.json").write_text(
+        json.dumps({"contention": contention, "straggler": straggler}, indent=2)
+    )
+    (out / "straggler_incidents.jsonl").write_text(
+        "".join(json.dumps(i) + "\n" for i in straggler["incidents"])
+    )
+    return {
+        "contention_degradation": contention["degradation"],
+        "contention_attribution": contention["attribution"],
+        "straggler_correct": straggler["correct_attributions"],
+        "straggler_launches": straggler["launches"],
+        "straggler_confidence": straggler["top_confidence"],
+    }
+
+
+def phase_attribution(out: Path) -> dict:
+    """Attributor CLI over the REAL measured fault (plus context)."""
+    report = json.loads((out / "injector_report.json").read_text())
+    cont = report["contention"]
+    # One fault sample from the real contended measurement; signals are
+    # the measured collective p95 only — nothing synthetic.
+    sample = {
+        "incident_id": "e2e-session-ici",
+        "timestamp": "2026-07-30T00:00:00Z",
+        "cluster": "local",
+        "namespace": "llm",
+        "service": "rag-service",
+        "fault_label": "ici_drop",
+        "expected_domain": "tpu_ici",
+        "signals": {
+            "ici_collective_latency_ms": cont["contended_p95_ms"],
+        },
+        "confidence": 0.9,
+        "burn_rate": 2.0,
+        "window_minutes": 5,
+        "request_id": "e2e-req-1",
+        "trace_id": "e2e-trace-1",
+    }
+    samples_path = out / "fault_samples.jsonl"
+    samples_path.write_text(json.dumps(sample) + "\n")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tpuslo", "attributor",
+            "--input", str(samples_path),
+            "--output", str(out / "attributions.jsonl"),
+            "--summary", str(out / "attribution_summary.json"),
+            "--evidence", "calibrated",
+        ],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    prediction = json.loads(
+        (out / "attributions.jsonl").read_text().splitlines()[0]
+    )
+    return {
+        "rc": proc.returncode,
+        "top1": prediction["predicted_fault_domain"],
+        "confidence": prediction["confidence"],
+        "evidence": prediction["fault_hypotheses"][0]["evidence"],
+    }
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--out", default=str(REPO / "docs" / "demos" / "e2e-session-r3")
+    )
+    args = parser.parse_args()
+    out = Path(args.out)
+    out.mkdir(parents=True, exist_ok=True)
+
+    t0 = time.perf_counter()
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    print("== 1/4 RAG service live traffic (vectordb retrieval)")
+    service = phase_service(out)
+    print(f"   {service['requests']} requests, {service['spans']} spans, "
+          f"min correlation confidence {service['min_confidence']}")
+
+    print("== 2/4 agent real ring loop (userspace rings)")
+    agent = phase_agent_ring(out)
+    print(f"   {agent['events']} live events, signals {agent['signals']}")
+
+    print("== 3/4 real ICI injection (contention + straggler)")
+    injection = phase_injection(out)
+    print(f"   contention x{injection['contention_degradation']}, "
+          f"straggler {injection['straggler_correct']}/"
+          f"{injection['straggler_launches']} @ "
+          f"{injection['straggler_confidence']}")
+
+    print("== 4/4 attribution from the real measurement")
+    attribution = phase_attribution(out)
+    print(f"   top-1 {attribution['top1']} @ {attribution['confidence']:.3f}")
+
+    verdicts = {
+        "span_signal_correlation_ge_0.7": service["min_confidence"] >= 0.7,
+        "straggler_correlation_ge_0.7": injection["straggler_confidence"] >= 0.7,
+        "straggler_names_delayed_host": injection["straggler_correct"]
+        == injection["straggler_launches"],
+        "top1_domain_correct": attribution["top1"] == "tpu_ici",
+        "agent_ring_loop_emitted": agent["events"] > 0,
+    }
+    session = {
+        "elapsed_s": round(time.perf_counter() - t0, 1),
+        "service": service,
+        "agent": agent,
+        "injection": injection,
+        "attribution": attribution,
+        "verdicts": verdicts,
+        "pass": all(verdicts.values()),
+    }
+    (out / "session.json").write_text(json.dumps(session, indent=2))
+    print(json.dumps({"pass": session["pass"], **verdicts}, indent=2))
+    return 0 if session["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -118,3 +118,46 @@ class TestNormalize:
         event = collector.normalize_sample(sample)[0]
         assert event.labels["node"] == "tpu-vm-0"
         assert event.labels["fault_label"] == "dns_latency"
+
+
+class TestHBMSamplerHangBoundary:
+    """A dead TPU tunnel makes jax.devices() HANG (no exception); the
+    sampler's live-device probe must time out once, then stay disabled
+    instead of parking a worker thread per cycle (the agent ring loop
+    wedged on exactly this before the boundary existed)."""
+
+    def test_hung_device_probe_times_out_and_disables(self, monkeypatch):
+        import sys
+        import threading
+        import time as _time
+        import types
+
+        from tpuslo.collector import hbm_sampler
+
+        release = threading.Event()
+        fake_jax = types.SimpleNamespace(
+            devices=lambda: release.wait(30.0) or []
+        )
+        monkeypatch.setitem(sys.modules, "jax", fake_jax)
+        monkeypatch.setenv("TPUSLO_HBM_PROBE_TIMEOUT_S", "0.2")
+        monkeypatch.setattr(hbm_sampler, "_DEVICE_PROBE_DEAD", False)
+
+        t0 = _time.perf_counter()
+        assert hbm_sampler.read_stats() is None
+        first = _time.perf_counter() - t0
+        assert first < 5.0  # returned at the join timeout, not the hang
+        assert hbm_sampler._DEVICE_PROBE_DEAD
+
+        # Second call: permanent disable, no new worker, instant.
+        t0 = _time.perf_counter()
+        assert hbm_sampler.read_stats() is None
+        assert _time.perf_counter() - t0 < 0.05
+        release.set()
+
+    def test_stats_file_path_unaffected(self, tmp_path, monkeypatch):
+        from tpuslo.collector import hbm_sampler
+
+        monkeypatch.setattr(hbm_sampler, "_DEVICE_PROBE_DEAD", True)
+        stats = tmp_path / "hbm.json"
+        stats.write_text('{"bytes_in_use": 8, "bytes_limit": 16}')
+        assert hbm_sampler.read_stats(str(stats)) == (8, 16)

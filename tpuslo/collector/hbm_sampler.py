@@ -22,6 +22,11 @@ import time
 from tpuslo.collector import native
 from tpuslo.collector.ringbuf import RingWriter
 
+# Once a live-device probe times out (dead tunnel), stop retrying for
+# the life of the process: every retry would park another worker thread
+# inside the hung backend for nothing.
+_DEVICE_PROBE_DEAD = False
+
 
 def read_stats(path: str | None = None) -> tuple[int, int] | None:
     """Return (bytes_in_use, bytes_limit) or None."""
@@ -33,22 +38,57 @@ def read_stats(path: str | None = None) -> tuple[int, int] | None:
             return int(raw["bytes_in_use"]), int(raw["bytes_limit"])
         except (OSError, ValueError, KeyError):
             return None
-    try:
-        import jax
-
-        devices = [d for d in jax.devices() if d.platform == "tpu"]
-        if not devices:
-            return None
-        stats = devices[0].memory_stats() or {}
-        in_use = stats.get("bytes_in_use")
-        limit = stats.get("bytes_limit") or stats.get(
-            "bytes_reservable_limit"
-        )
-        if in_use is None or not limit:
-            return None
-        return int(in_use), int(limit)
-    except Exception:  # noqa: BLE001 — no TPU / no jax is a normal miss
+    # Live device stats behind a join-timeout worker: a dead TPU tunnel
+    # makes jax.devices() HANG (the plugin retries forever — no
+    # exception for the except to catch), and a wedged sampler would
+    # stall the agent ring loop it feeds.  Same boundary discipline as
+    # ActiveICIProber.maybe_probe.
+    global _DEVICE_PROBE_DEAD
+    if _DEVICE_PROBE_DEAD:
         return None
+    import threading
+
+    box: dict[str, tuple[int, int] | None] = {"stats": None}
+
+    def probe():
+        try:
+            import jax
+
+            devices = [d for d in jax.devices() if d.platform == "tpu"]
+            if not devices:
+                return
+            stats = devices[0].memory_stats() or {}
+            in_use = stats.get("bytes_in_use")
+            limit = stats.get("bytes_limit") or stats.get(
+                "bytes_reservable_limit"
+            )
+            if in_use is None or not limit:
+                return
+            box["stats"] = (int(in_use), int(limit))
+        except Exception:  # noqa: BLE001 — no TPU / no jax: normal miss
+            return
+
+    try:
+        timeout_s = float(os.environ.get("TPUSLO_HBM_PROBE_TIMEOUT_S", 60))
+    except ValueError:
+        timeout_s = 60.0
+    thread = threading.Thread(target=probe, daemon=True)
+    thread.start()
+    thread.join(timeout=timeout_s)
+    if thread.is_alive():
+        _DEVICE_PROBE_DEAD = True
+        # One loud line, like ActiveICIProber's disable: the signal
+        # disappearing silently would send an operator hunting through
+        # the ring for a probe that turned itself off.
+        import sys
+
+        print(
+            f"hbm_sampler: device probe exceeded {timeout_s}s (backend "
+            "hang — tunnel down?); live HBM sampling disabled for this "
+            "process",
+            file=sys.stderr,
+        )
+    return box["stats"]
 
 
 class HBMSampler:
