@@ -9,7 +9,6 @@ degradation (attributor must name tpu_ici from the real signal).
 
 from __future__ import annotations
 
-import json
 import subprocess
 import sys
 
