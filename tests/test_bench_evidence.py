@@ -117,3 +117,63 @@ def test_committed_artifact_is_valid_json_file():
     with open(LATEST_CAPTURE_PATH) as fh:
         artifact = json.load(fh)
     assert set(artifact) == {"provenance", "capture"}
+
+
+def test_dead_relay_short_circuits_probe_ladder(monkeypatch):
+    """With every relay port closed, bench_serving must skip the
+    ~15-minute probe/backoff ladder, fall back immediately, and still
+    embed the last TPU capture."""
+    import bench
+
+    monkeypatch.setattr(bench, "_relay_known_dead", lambda: True)
+    calls = {"probe": 0}
+
+    def no_probe(timeout_s):
+        calls["probe"] += 1
+        return {"ok": False}
+
+    monkeypatch.setattr(bench, "_probe_backend", no_probe)
+    monkeypatch.setattr(
+        bench, "_run_serving_subprocess",
+        lambda args, timeout_s, env_extra=None: {"backend": "cpu"},
+    )
+    result = bench.bench_serving()
+    assert calls["probe"] == 0  # ladder skipped entirely
+    assert result["backend"] == "cpu_fallback"
+    assert "relay" in result["tpu_error"]
+    assert result["serving_tpu_last_capture"]["capture"]["backend"] == "tpu"
+
+
+def test_failed_cpu_child_keeps_unavailable_backend(monkeypatch):
+    """A timed-out CPU child must NOT be relabeled cpu_fallback — the
+    artifact would claim CPU numbers that don't exist."""
+    import bench
+
+    monkeypatch.setattr(
+        bench, "_run_serving_subprocess",
+        lambda args, timeout_s, env_extra=None: {
+            "backend": "unavailable", "error": "timed out",
+        },
+    )
+    fallback = bench._cpu_fallback("relay dead")
+    assert fallback["backend"] == "unavailable"
+    assert fallback["tpu_error"] == "relay dead"
+
+
+def test_relay_check_only_applies_to_tunneled_backend(monkeypatch):
+    """Direct-attached TPU hosts (JAX_PLATFORMS unset/tpu) must never
+    short-circuit on missing relay ports — their probe path works."""
+    import bench
+
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    assert bench._relay_known_dead() is False
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    assert bench._relay_known_dead() is False
+    # Tunneled mode: the answer is a fast socket truth either way.
+    import time
+
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    t0 = time.perf_counter()
+    value = bench._relay_known_dead()
+    assert isinstance(value, bool)
+    assert time.perf_counter() - t0 < 10.0
