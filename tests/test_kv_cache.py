@@ -178,3 +178,36 @@ def test_continuous_batching_int8_parity():
 # Compile-heavy module: excluded from the sub-2-minute fast gate
 # (`make test-fast` / pytest -m "not slow"); the full suite runs it.
 pytestmark = pytest.mark.slow
+
+
+def test_moe_engine_int8_kv():
+    """The MoE engine rides the same polymorphic KV representation
+    (its serving paths are llama's with the MLP swapped)."""
+    from tpuslo.models.mixtral import MoEServeEngine, mixtral_tiny
+
+    cfg = mixtral_tiny(max_seq_len=128)
+    eng = MoEServeEngine(cfg=cfg, kv_dtype="int8", prefill_buckets=(16, 32))
+    out = [
+        e.token_id for e in eng.generate("moe int8", 8, stop_at_eos=False)
+    ]
+    assert len(out) == 8
+    with pytest.raises(ValueError):
+        MoEServeEngine(cfg=cfg, kv_dtype="fp4")
+
+
+def test_speculative_with_int8_kv_engines():
+    """Speculative decoding composes: both target and draft engines on
+    int8 KV must equal plain int8-KV greedy (the acceptance rule
+    compares logits from the same quantized caches)."""
+    from tpuslo.models.speculative import SpeculativeEngine
+
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    target = ServeEngine(cfg=CFG, params=params, kv_dtype="int8")
+    draft = ServeEngine(cfg=CFG, params=params, kv_dtype="int8")
+    spec = SpeculativeEngine(target=target, draft=draft, k=3)
+    out = list(spec.generate("spec int8", 10, stop_at_eos=False))
+    plain = ServeEngine(cfg=CFG, params=params, kv_dtype="int8")
+    expect = [
+        e.token_id for e in plain.generate("spec int8", 10, stop_at_eos=False)
+    ]
+    assert out == expect

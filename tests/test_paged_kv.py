@@ -205,3 +205,20 @@ def test_pool_structure():
 # Compile-heavy module: excluded from the sub-2-minute fast gate
 # (`make test-fast` / pytest -m "not slow"); the full suite runs it.
 pytestmark = pytest.mark.slow
+
+
+def test_paged_with_prefix_cache():
+    """Prefix-cached admission into the paged pool: the ingest engine's
+    snapshot + suffix path feeds block injection unchanged."""
+    eng = PagedBatchingEngine(
+        cfg=CFG, params=PARAMS, max_slots=2, block_size=16
+    )
+    prefix = "system: terse answers. "
+    rid = eng.submit("what is ttft?", max_new_tokens=8, prefix=prefix)
+    results = eng.run()
+    single = ServeEngine(cfg=CFG, params=PARAMS)
+    expect = [
+        e.token_id
+        for e in single.generate("what is ttft?", max_new_tokens=8, prefix=prefix)
+    ]
+    assert results[rid] == expect

@@ -39,6 +39,15 @@ PyTree = Any
 KV_DTYPES = ("bf16", "int8")
 
 
+def validate_kv_dtype(kv_dtype: str) -> str:
+    """One source of truth for the engine constructors' dtype guard."""
+    if kv_dtype not in KV_DTYPES:
+        raise ValueError(
+            f"kv_dtype must be one of {KV_DTYPES}, got {kv_dtype!r}"
+        )
+    return kv_dtype
+
+
 def quantize_kv(x: jax.Array) -> dict:
     """bf16 ``(..., KV, HD)`` -> {"q": int8, "s": f32 over HD}."""
     x32 = x.astype(jnp.float32)
@@ -94,13 +103,11 @@ def kv_write_rows(kv, new: jax.Array, rows: jax.Array, pos: jax.Array) -> PyTree
 
 def init_kv(shape: tuple[int, ...], dtype, kv_dtype: str) -> PyTree:
     """One cache side (k or v) of logical shape ``(..., S, KV, HD)``."""
-    if kv_dtype == "int8":
+    if validate_kv_dtype(kv_dtype) == "int8":
         return {
             "q": jnp.zeros(shape, jnp.int8),
             "s": jnp.zeros(shape[:-1], jnp.float32),
         }
-    if kv_dtype != "bf16":
-        raise ValueError(f"kv_dtype must be one of {KV_DTYPES}, got {kv_dtype!r}")
     return jnp.zeros(shape, dtype)
 
 

@@ -356,9 +356,12 @@ class MoEServeEngine:
         prefill_buckets: tuple[int, ...] = (32, 64, 128),
         decode_chunk_size: int = 16,
         mesh: Mesh | None = None,
+        kv_dtype: str = "bf16",
     ):
+        from tpuslo.models.kv_cache import validate_kv_dtype
         from tpuslo.models.llama import init_kv_cache
 
+        self.kv_dtype = validate_kv_dtype(kv_dtype)
         self.cfg = cfg or mixtral_tiny(max_seq_len=256)
         self.mesh = mesh
         self._cache_shardings = None
@@ -381,7 +384,7 @@ class MoEServeEngine:
                     f"{self.cfg.n_kv_heads}, n_heads={self.cfg.n_heads} "
                     f"and ffn_dim={self.cfg.ffn_dim}"
                 )
-            self._cache_shardings = kv_cache_shardings(mesh)
+            self._cache_shardings = kv_cache_shardings(mesh, kv_dtype)
             shardings = tp_serve_param_shardings(mesh)
             if params is None:
                 # Initialize DIRECTLY into the tp shardings — no device
@@ -404,7 +407,9 @@ class MoEServeEngine:
         )
 
         def init_cache(batch):
-            cache = init_kv_cache(self.cfg.attn_cfg(), batch)
+            cache = init_kv_cache(
+                self.cfg.attn_cfg(), batch, kv_dtype=self.kv_dtype
+            )
             if self._cache_shardings is not None:
                 cache = jax.device_put(cache, self._cache_shardings)
             return cache

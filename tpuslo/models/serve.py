@@ -189,13 +189,9 @@ class ServeEngine:
         mesh=None,
         kv_dtype: str = "bf16",
     ):
-        from tpuslo.models.kv_cache import KV_DTYPES
+        from tpuslo.models.kv_cache import validate_kv_dtype
 
-        if kv_dtype not in KV_DTYPES:
-            raise ValueError(
-                f"kv_dtype must be one of {KV_DTYPES}, got {kv_dtype!r}"
-            )
-        self.kv_dtype = kv_dtype
+        self.kv_dtype = validate_kv_dtype(kv_dtype)
         self.cfg = cfg or llama_tiny(max_seq_len=512)
         self.mesh = mesh
         if mesh is not None:
