@@ -276,19 +276,12 @@ def _relay_known_dead() -> bool:
     tunneled backend (``JAX_PLATFORMS=axon``) AND no relay port
     accepts connections.  Direct-attached TPU VMs (no tunnel, no relay
     ports) never short-circuit — their probe path is already
-    subprocess+timeout bounded.
+    subprocess+timeout bounded.  One source of truth: the chaos
+    injectors guard on the same check.
     """
-    import socket
+    from tpuslo.chaos.backend_guard import tunneled_backend_unreachable
 
-    if os.environ.get("JAX_PLATFORMS", "") != "axon":
-        return False
-    for port in (8082, 8092, 8102):
-        try:
-            with socket.create_connection(("127.0.0.1", port), timeout=2):
-                return False  # something listens: let the real probe decide
-        except OSError:
-            continue
-    return True
+    return tunneled_backend_unreachable()
 
 
 def _cpu_fallback(tpu_error: str, timeout_s: int = 900) -> dict:

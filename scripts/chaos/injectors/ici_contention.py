@@ -22,7 +22,15 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+
+# Direct script execution puts scripts/chaos/injectors first on
+# sys.path; the package lives at the repo root three levels up.
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))))
+)
 
 
 def main() -> int:
@@ -44,8 +52,6 @@ def main() -> int:
     report: dict = {"injector": "ici_contention", "real": True}
     if args.mode in ("contention", "both"):
         if args.force_cpu_devices > 0:
-            import os
-
             os.environ["XLA_FLAGS"] = (
                 os.environ.get("XLA_FLAGS", "")
                 + f" --xla_force_host_platform_device_count={args.force_cpu_devices}"
@@ -53,11 +59,23 @@ def main() -> int:
             import jax
 
             jax.config.update("jax_platforms", "cpu")
-        from tpuslo.chaos import contention_injection
+        from tpuslo.chaos.backend_guard import fail_fast_report
 
-        report["contention"] = contention_injection(
-            reps=args.reps, payload_kb=args.payload_kb
+        # Without --force-cpu-devices the contention suite touches the
+        # configured backend; on a dead tunnel that HANGS in init.  The
+        # straggler mechanism below needs no backend and still runs.
+        guard = (
+            None if args.force_cpu_devices > 0
+            else fail_fast_report("ici_contention")
         )
+        if guard is not None:
+            report["contention"] = guard
+        else:
+            from tpuslo.chaos import contention_injection
+
+            report["contention"] = contention_injection(
+                reps=args.reps, payload_kb=args.payload_kb
+            )
     if args.mode in ("straggler", "both"):
         from tpuslo.chaos import run_straggler_injection
 
@@ -74,7 +92,7 @@ def main() -> int:
     ok = True
     if "straggler" in report:
         ok &= report["straggler"]["correct_attributions"] > 0
-    if "contention" in report:
+    if "contention" in report and "degradation" in report["contention"]:
         ok &= report["contention"]["degradation"] > 1.0
     return 0 if ok else 1
 

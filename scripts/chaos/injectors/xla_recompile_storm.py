@@ -16,7 +16,14 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
 import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))))
+)
 
 
 def main() -> int:
@@ -25,6 +32,15 @@ def main() -> int:
     p.add_argument("--base", type=int, default=128)
     p.add_argument("--report", default="")
     args = p.parse_args()
+
+    from tpuslo.chaos.backend_guard import fail_fast_report
+
+    # jax.devices() would hang forever on a dead tunnel and wedge the
+    # whole fault matrix inside this injector.
+    guard = fail_fast_report("xla_recompile_storm", args.report)
+    if guard is not None:
+        print(json.dumps(guard))
+        return 2
 
     import jax
     import jax.numpy as jnp

@@ -81,3 +81,45 @@ def test_injector_script_help():
     )
     assert proc.returncode == 0
     assert "straggler" in proc.stdout
+
+
+class TestBackendGuard:
+    """Chaos injectors must fail fast (not hang in jax.devices()) when
+    the tunneled backend's relay is down — the fault matrix wedged
+    inside hbm_pressure.py on exactly this before the guard."""
+
+    def test_guard_only_applies_to_tunneled_backend(self, monkeypatch):
+        from tpuslo.chaos.backend_guard import tunneled_backend_unreachable
+
+        monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+        assert tunneled_backend_unreachable() is False
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        assert tunneled_backend_unreachable() is False
+
+    def test_jax_injectors_fail_fast_when_unreachable(self, tmp_path):
+        import json as _json
+        import os
+        import time
+
+        # The force flag makes the guard deterministic regardless of
+        # what happens to be listening on the relay ports locally.
+        env = {**os.environ, "TPUSLO_FORCE_BACKEND_UNREACHABLE": "1"}
+        for script in ("hbm_pressure.py", "xla_recompile_storm.py"):
+            report_path = tmp_path / f"{script}.report.json"
+            t0 = time.perf_counter()
+            proc = subprocess.run(
+                [
+                    sys.executable, f"scripts/chaos/injectors/{script}",
+                    "--report", str(report_path),
+                ],
+                capture_output=True, text=True, timeout=120, env=env,
+            )
+            elapsed = time.perf_counter() - t0
+            assert proc.returncode == 2, proc.stderr
+            report = _json.loads(proc.stdout.strip().splitlines()[-1])
+            assert report["real"] is False
+            assert "unreachable" in report["reason"]
+            # The machine-readable reason survives into the matrix's
+            # per-scenario report file too.
+            assert _json.loads(report_path.read_text())["real"] is False
+            assert elapsed < 60.0  # failed fast, did not hang

@@ -1,0 +1,58 @@
+"""Dead-tunnel guard for chaos injectors.
+
+On the tunneled axon backend a dead relay makes ``jax.devices()`` hang
+forever (the plugin retries, never raises), which wedged the whole
+fault matrix inside the first injector that touched the backend.  The
+guard is the same cheap truth ``bench.py`` uses: tunneled mode
+(``JAX_PLATFORMS=axon``) with every relay port refusing connections
+means the backend is unreachable — fail fast with an honest report so
+the matrix records ``injector: synthetic`` and moves on.  Direct-
+attached TPU hosts (no tunnel) never trip the guard.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+
+_RELAY_PORTS = (8082, 8092, 8102)
+
+
+def tunneled_backend_unreachable() -> bool:
+    """True only when BOTH hold: the session is configured for the
+    tunneled backend AND no relay port accepts connections.
+    ``TPUSLO_FORCE_BACKEND_UNREACHABLE=1`` forces True (deterministic
+    tests; operators forcing the synthetic lane)."""
+    if os.environ.get("TPUSLO_FORCE_BACKEND_UNREACHABLE", "") == "1":
+        return True
+    if os.environ.get("JAX_PLATFORMS", "") != "axon":
+        return False
+    for port in _RELAY_PORTS:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=2):
+                return False
+        except OSError:
+            continue
+    return True
+
+
+def fail_fast_report(name: str, report_path: str = "") -> dict | None:
+    """The injector guard: an honesty report dict when the backend is
+    unreachable (also written to ``report_path`` so the fault matrix
+    keeps the machine-readable reason), None when it's safe to proceed.
+    """
+    if not tunneled_backend_unreachable():
+        return None
+    report = {
+        "injector": name,
+        "real": False,
+        "reason": "tunneled backend unreachable (relay down)",
+    }
+    if report_path:
+        try:
+            with open(report_path, "w", encoding="utf-8") as fh:
+                json.dump(report, fh, indent=2)
+        except OSError:
+            pass
+    return report
