@@ -1,0 +1,100 @@
+"""Real multi-process distributed collectives (the DCN-analog path).
+
+N OS processes join one jax.distributed runtime (gloo CPU collectives)
+and measure cross-process psum launches — the actual multi-host shape
+the single-process virtual mesh cannot exercise.  The straggler test's
+physics is real: the collective blocks the punctual hosts until the
+delayed one arrives.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tpuslo.parallel.distributed import run_distributed_probe
+
+pytestmark = pytest.mark.slow  # two jax processes per test
+
+
+def test_cross_process_collectives_measured():
+    report = run_distributed_probe(n_processes=2, launches=3)
+    assert report["errors"] == []
+    assert report["events_measured"] == 6  # 3 launches x 2 hosts
+    assert report["mechanism"] == "jax_distributed_gloo"
+    # Healthy run: no straggler incidents (skew under the floor).
+    assert report["incidents"] == []
+
+
+def test_delayed_host_stalls_the_collective_and_is_attributed():
+    report = run_distributed_probe(
+        n_processes=2, launches=4, delay_ms=200.0, delayed_host=1
+    )
+    assert report["errors"] == []
+    assert report["correct_attributions"] == 4
+    assert report["top_confidence"] >= 0.7
+    incident = report["incidents"][0]
+    # REAL collective physics: the punctual host measured ~the delay
+    # (it was blocked inside psum), the delayed host sailed through.
+    lat = incident["host_latencies_ms"]
+    assert lat["0"] > 150.0
+    assert lat["1"] < 50.0
+
+
+def test_icibench_multiprocess_cli(tmp_path):
+    import json
+    import subprocess
+    import sys
+
+    from tpuslo.schema import SCHEMA_PROBE_EVENT, validate
+
+    out = tmp_path / "dist_events.jsonl"
+    report_path = tmp_path / "dist_report.json"
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tpuslo", "icibench",
+            "--multiprocess", "2", "--reps", "2",
+            "--delay-host", "0", "--delay-ms", "120",
+            "--output", str(out), "--report", str(report_path),
+        ],
+        capture_output=True, text=True, timeout=420,
+    )
+    assert proc.returncode == 0, proc.stderr
+    # Same output contract as the single-process path: schema-valid
+    # probe-event JSONL (4 = 2 launches x 2 hosts).
+    events = [json.loads(line) for line in out.read_text().splitlines()]
+    assert len(events) == 4
+    for event in events:
+        validate(event, SCHEMA_PROBE_EVENT)
+        assert event["signal"] == "ici_collective_latency_ms"
+    report = json.loads(report_path.read_text())
+    assert report["correct_attributions"] == 2
+    assert "events" not in report  # summary only; events live in --output
+    assert "cross-process events" in proc.stderr
+
+
+def test_icibench_multiprocess_flag_validation(tmp_path):
+    import subprocess
+    import sys
+
+    # Out-of-range delay host: exit 2, nothing written.
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tpuslo", "icibench",
+            "--multiprocess", "2", "--delay-host", "2",
+            "--output", str(tmp_path / "x.jsonl"),
+        ],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 2
+    assert "out of range" in proc.stderr
+    assert not (tmp_path / "x.jsonl").exists()
+    # Invalid --ops still rejected in multiprocess mode.
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tpuslo", "icibench",
+            "--multiprocess", "2", "--ops", "bogus",
+        ],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 2
+    assert "unknown ops" in proc.stderr

@@ -12,6 +12,11 @@ when the serving workload is idle.
 
     # 8-device virtual CPU mesh (CI / laptops)
     python -m tpuslo icibench --force-cpu-devices 8
+
+    # REAL cross-process collectives: N OS processes in one
+    # jax.distributed runtime (the DCN-analog multi-host path);
+    # optionally delay one host and let SliceJoiner attribute it
+    python -m tpuslo icibench --multiprocess 2 --delay-host 1
 """
 
 from __future__ import annotations
@@ -23,13 +28,41 @@ import sys
 from tpuslo.cli.common import validate_probe
 
 
+def _write_jsonl(lines: list[str], output: str) -> None:
+    """'-' → stdout; else temp file + atomic rename (artifact exists
+    complete or not at all), matching plain open()'s permissions."""
+    if output == "-":
+        sys.stdout.writelines(lines)
+        return
+    import os
+    import tempfile
+
+    out_dir = os.path.dirname(os.path.abspath(output)) or "."
+    fd, tmp = tempfile.mkstemp(dir=out_dir, suffix=".tmp")
+    try:
+        umask = os.umask(0)
+        os.umask(umask)
+        os.fchmod(fd, 0o666 & ~umask)
+        with os.fdopen(fd, "w") as fh:
+            fh.writelines(lines)
+        os.replace(tmp, output)
+        tmp = None
+    finally:
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="icibench", description=__doc__)
     p.add_argument("--payload-kb", type=int, default=1024)
     p.add_argument("--reps", type=int, default=20)
     p.add_argument(
         "--ops", default="psum,all_gather,reduce_scatter,ppermute",
-        help="comma-separated collective ops to probe",
+        help="comma-separated collective ops to probe "
+        "(--multiprocess measures psum only)",
     )
     p.add_argument("--output", default="-", help="'-' for stdout or a JSONL path")
     p.add_argument("--node", default="tpu-vm-0")
@@ -40,20 +73,43 @@ def main(argv: list[str] | None = None) -> int:
         "--force-cpu-devices", type=int, default=0,
         help="N>0 probes an N-device virtual CPU mesh (no TPU touched)",
     )
+    p.add_argument(
+        "--multiprocess", type=int, default=0,
+        help="N>1 probes REAL cross-process collectives: N OS processes "
+        "join one jax.distributed runtime (gloo) and measure psum "
+        "launches over the global mesh — the DCN-analog multi-host path",
+    )
+    p.add_argument(
+        "--delay-host", type=int, default=-1,
+        help="with --multiprocess: delay this host per launch so the "
+        "collective genuinely stalls the punctual hosts; SliceJoiner "
+        "must attribute it",
+    )
+    p.add_argument("--delay-ms", type=float, default=150.0)
+    p.add_argument(
+        "--report", default="",
+        help="with --multiprocess: also write the straggler-join report "
+        "(incidents, attribution verdicts) as JSON here",
+    )
     args = p.parse_args(argv)
 
+    # Flag validation happens BEFORE any jax backend init (which can be
+    # slow or hang) and regardless of mode — the multiprocess path must
+    # not silently accept flags the single-process path rejects.
     ops = tuple(o.strip() for o in args.ops.split(",") if o.strip())
     from tpuslo.parallel.collectives import DEFAULT_OPS
 
     unknown = [o for o in ops if o not in DEFAULT_OPS]
     if unknown or not ops:
-        # Fail before any jax backend init (which can be slow or hang).
         print(
             f"icibench: unknown ops {unknown or '(none given)'}; "
             f"valid: {', '.join(DEFAULT_OPS)}",
             file=sys.stderr,
         )
         return 2
+
+    if args.multiprocess > 1:
+        return _run_multiprocess(args, ops)
 
     if args.force_cpu_devices > 0:
         # Must happen before the first jax backend touch; jax.config
@@ -96,32 +152,7 @@ def main(argv: list[str] | None = None) -> int:
             return 1
         lines.append(json.dumps(event.to_dict()) + "\n")
 
-    if args.output == "-":
-        sys.stdout.writelines(lines)
-    else:
-        # Temp file + atomic rename: the artifact either exists complete
-        # or not at all.
-        import os
-        import tempfile
-
-        out_dir = os.path.dirname(os.path.abspath(args.output)) or "."
-        fd, tmp = tempfile.mkstemp(dir=out_dir, suffix=".tmp")
-        try:
-            # mkstemp creates 0600; match what plain open() would have
-            # produced so cross-user artifact consumers keep working.
-            umask = os.umask(0)
-            os.umask(umask)
-            os.fchmod(fd, 0o666 & ~umask)
-            with os.fdopen(fd, "w") as fh:
-                fh.writelines(lines)
-            os.replace(tmp, args.output)
-            tmp = None
-        finally:
-            if tmp is not None:
-                try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
+    _write_jsonl(lines, args.output)
     for probe in probes:
         print(
             f"icibench: {probe.op:>14} n={probe.n_devices} "
@@ -130,6 +161,62 @@ def main(argv: list[str] | None = None) -> int:
             file=sys.stderr,
         )
     return 0
+
+
+def _run_multiprocess(args, ops) -> int:
+    """Cross-process collective probe; same output contract as the
+    single-process path (schema-validated probe-event JSONL)."""
+    from tpuslo.schema import SCHEMA_PROBE_EVENT, SchemaValidationError, validate
+
+    if args.delay_host >= args.multiprocess:
+        print(
+            f"icibench: --delay-host {args.delay_host} is out of range "
+            f"for --multiprocess {args.multiprocess} (hosts are "
+            f"0..{args.multiprocess - 1})",
+            file=sys.stderr,
+        )
+        return 2
+    if set(ops) != {"psum"} and tuple(ops) != (
+        "psum", "all_gather", "reduce_scatter", "ppermute",
+    ):
+        print(
+            "icibench: --multiprocess measures psum only; other --ops "
+            "are ignored",
+            file=sys.stderr,
+        )
+
+    from tpuslo.parallel.distributed import run_distributed_probe
+
+    report = run_distributed_probe(
+        n_processes=args.multiprocess,
+        launches=args.reps,
+        payload_kb=args.payload_kb,
+        delay_ms=args.delay_ms if args.delay_host >= 0 else 0.0,
+        delayed_host=args.delay_host,
+    )
+    lines = []
+    for event_dict in report["events"]:
+        try:
+            validate(event_dict, SCHEMA_PROBE_EVENT)
+        except SchemaValidationError:
+            print(
+                "icibench: schema-invalid cross-process event; "
+                "no output written",
+                file=sys.stderr,
+            )
+            return 1
+        lines.append(json.dumps(event_dict) + "\n")
+    _write_jsonl(lines, args.output)
+    if args.report:
+        summary = {k: v for k, v in report.items() if k != "events"}
+        _write_jsonl([json.dumps(summary) + "\n"], args.report)
+    print(
+        f"icibench: {report['events_measured']} cross-process events "
+        f"over {args.multiprocess} hosts, "
+        f"{len(report['incidents'])} straggler incidents",
+        file=sys.stderr,
+    )
+    return 0 if not report["errors"] else 1
 
 
 if __name__ == "__main__":

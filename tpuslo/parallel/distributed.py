@@ -1,0 +1,213 @@
+"""Multi-process distributed collective prober (the DCN analog).
+
+The virtual single-process mesh (``tpuslo/parallel/collectives.py``)
+exercises XLA's collective *lowering*; this module exercises the
+actual multi-host shape: N OS processes join one
+``jax.distributed`` runtime (coordinator + gloo CPU collectives — the
+same topology a v5e pod's hosts form over ICI/DCN, minus the silicon),
+run measured cross-process ``psum`` launches over the global mesh, and
+emit per-host ``ici_collective_latency_ms`` probe events carrying
+(slice, host, program, launch) identity.
+
+The straggler physics is REAL here, not simulated: a cross-process
+collective blocks every participant until the last one arrives, so
+when one host is delayed the punctual hosts' measured latency inflates
+by the delay while the straggler itself sails through — exactly the
+signature :class:`tpuslo.correlation.multihost.SliceJoiner` attributes
+(the fastest host is the one everybody waited for).
+
+``tpuslo icibench --multiprocess N`` fronts this; tests drive it with
+2–3 processes on CI.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+from typing import Any
+
+PROGRAM_ID = "dist_psum"
+
+
+def _free_port() -> int:
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+
+
+def worker_main(argv: list[str] | None = None) -> int:
+    """One distributed host: join the runtime, measure collectives.
+
+    Prints one ProbeEventV1 JSON per launch on stdout.  Must run in its
+    own process (jax.distributed.initialize is once-per-process).
+    """
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--process-id", type=int, required=True)
+    p.add_argument("--num-processes", type=int, required=True)
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--launches", type=int, default=5)
+    p.add_argument("--payload-kb", type=int, default=256)
+    p.add_argument("--delay-ms", type=float, default=0.0)
+    p.add_argument("--delayed-host", type=int, default=-1)
+    p.add_argument("--slice-id", default="dist-slice")
+    args = p.parse_args(argv)
+
+    import jax
+
+    # Force the CPU platform BEFORE any backend touch (the pinned axon
+    # tunnel would hang), then the cross-process gloo collectives.
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # noqa: BLE001 - newer jax versions default this
+        pass
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{args.port}",
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+    )
+
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from tpuslo.schema import ProbeEventV1, TPURef
+
+    mesh = Mesh(np.array(jax.devices()), ("hosts",))
+    n = jax.device_count()
+    cols = 256
+    rows = max(n, (args.payload_kb * 1024 // (4 * cols) // n) * n)
+    x_local = np.ones((rows // n * jax.local_device_count(), cols), np.float32)
+    from jax.experimental import multihost_utils
+
+    x = multihost_utils.host_local_array_to_global_array(
+        x_local, mesh, P("hosts", None)
+    )
+
+    @jax.jit
+    def allreduce(v):
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map(
+            lambda s: jax.lax.psum(s, "hosts"),
+            mesh=mesh,
+            in_specs=P("hosts", None),
+            out_specs=P(None, None),
+        )(v)
+
+    jax.block_until_ready(allreduce(x))  # compile round
+
+    me = args.process_id
+    for launch in range(args.launches):
+        if me == args.delayed_host and args.delay_ms > 0:
+            time.sleep(args.delay_ms / 1000.0)
+        t0 = time.perf_counter()
+        jax.block_until_ready(allreduce(x))
+        wait_ms = (time.perf_counter() - t0) * 1000.0
+        event = ProbeEventV1(
+            ts_unix_nano=time.time_ns(),
+            signal="ici_collective_latency_ms",
+            node=f"dist-host-{me}",
+            namespace="llm",
+            pod=f"agent-{me}",
+            container="agent",
+            pid=os.getpid(),
+            tid=me,
+            value=wait_ms,
+            unit="ms",
+            status="ok",
+            tpu=TPURef(
+                chip="accel0",
+                slice_id=args.slice_id,
+                host_index=me,
+                ici_link=-1,
+                program_id=PROGRAM_ID,
+                launch_id=launch,
+            ),
+        )
+        print(json.dumps(event.to_dict()), flush=True)
+    return 0
+
+
+def run_distributed_probe(
+    n_processes: int = 2,
+    launches: int = 5,
+    payload_kb: int = 256,
+    delay_ms: float = 0.0,
+    delayed_host: int = -1,
+    timeout_s: float = 420.0,
+) -> dict[str, Any]:
+    """Spawn the workers, collect per-host events, join stragglers.
+
+    Returns a report with every measured event, the SliceJoiner
+    incidents, and (when a host was delayed) whether the join named it.
+    """
+    port = _free_port()
+    procs = []
+    for pid in range(n_processes):
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable, "-m", "tpuslo.parallel.distributed",
+                    "--process-id", str(pid),
+                    "--num-processes", str(n_processes),
+                    "--port", str(port),
+                    "--launches", str(launches),
+                    "--payload-kb", str(payload_kb),
+                    "--delay-ms", str(delay_ms),
+                    "--delayed-host", str(delayed_host),
+                ],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    events: list[dict] = []
+    errors: list[str] = []
+    for proc in procs:
+        try:
+            out, err = proc.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, err = proc.communicate()
+            errors.append("worker timeout")
+        if proc.returncode != 0:
+            errors.append((err or "")[-300:])
+        for line in (out or "").splitlines():
+            if line.strip().startswith("{"):
+                events.append(json.loads(line))
+
+    from tpuslo.correlation.multihost import SliceJoiner
+
+    joiner = SliceJoiner(expected_hosts=n_processes)
+    joiner.add_all(events)
+    incidents = [i.to_dict() for i in joiner.incidents(min_hosts=n_processes)]
+    report: dict[str, Any] = {
+        "mechanism": "jax_distributed_gloo",
+        "real": True,
+        "n_processes": n_processes,
+        "launches": launches,
+        "events_measured": len(events),
+        "events": events,
+        "errors": errors,
+        "incidents": incidents,
+    }
+    if delayed_host >= 0:
+        correct = [
+            i for i in incidents if i["straggler_host"] == delayed_host
+        ]
+        report["delayed_host"] = delayed_host
+        report["correct_attributions"] = len(correct)
+        report["top_confidence"] = max(
+            (i["confidence"] for i in correct), default=0.0
+        )
+    return report
+
+
+if __name__ == "__main__":
+    sys.exit(worker_main())
