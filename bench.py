@@ -400,16 +400,17 @@ def _bench_serving_live() -> dict:
             probe = retry_probe
 
         # Chip is up: full bench gets the long budget (weights init +
-        # ~5 compiles on a 3B-class model plus the int8 llama3-8b lane,
-        # all through the remote-compile tunnel).
-        result = _run_serving_subprocess(["--platform", "auto"], timeout_s=2100)
+        # ~5 compiles on a 3B-class model, the int8 llama3-8b lane, and
+        # the round-3 kv/prefix lanes — two more engine warmups — all
+        # through the remote-compile tunnel).
+        result = _run_serving_subprocess(["--platform", "auto"], timeout_s=3000)
         if result.get("backend") in (None, "unavailable"):
             # The flash-attention pallas kernel is the newest lowering
             # risk on the tunneled backend; one retry without it
             # separates "kernel can't lower" from "chip went away".
             retry = _run_serving_subprocess(
                 ["--platform", "auto"],
-                timeout_s=1200,
+                timeout_s=1500,
                 env_extra={"TPUSLO_FLASH_ATTENTION": "0"},
             )
             if retry.get("backend") not in (None, "unavailable"):
