@@ -98,3 +98,56 @@ def test_icibench_multiprocess_flag_validation(tmp_path):
     )
     assert proc.returncode == 2
     assert "unknown ops" in proc.stderr
+
+
+def test_crashed_worker_kills_survivors_under_one_deadline(monkeypatch):
+    """If one worker crashes, the survivors block forever inside the
+    collective; the probe must kill them as soon as the crash is seen
+    (one SHARED deadline), not stack N sequential timeouts."""
+    import time
+    import types
+
+    from tpuslo.parallel import distributed as dist
+
+    class FakeProc:
+        def __init__(self, rc, out="", err="", exits_after=0.0):
+            self._rc = rc
+            self._out, self._err = out, err
+            self._born = time.monotonic()
+            self._exits_after = exits_after
+            self.killed = False
+            self.returncode = None
+
+        def poll(self):
+            if self.killed:
+                self.returncode = -9
+                return self.returncode
+            if time.monotonic() - self._born >= self._exits_after:
+                self.returncode = self._rc
+                return self.returncode
+            return None
+
+        def kill(self):
+            self.killed = True
+            self.returncode = -9
+
+        def communicate(self, timeout=None):
+            return self._out, self._err
+
+    crasher = FakeProc(rc=1, err="boom: gloo rendezvous failed",
+                       exits_after=0.1)
+    hung = FakeProc(rc=0, exits_after=3600.0)  # would block forever
+    procs = iter([crasher, hung])
+    fake_subprocess = types.SimpleNamespace(
+        Popen=lambda *a, **k: next(procs), PIPE=-1
+    )
+    monkeypatch.setattr(dist, "subprocess", fake_subprocess)
+
+    t0 = time.monotonic()
+    report = dist.run_distributed_probe(n_processes=2, timeout_s=300.0)
+    elapsed = time.monotonic() - t0
+
+    assert elapsed < 10.0  # NOT 300s, and never N*300s
+    assert hung.killed
+    assert any("peer exited nonzero" in e for e in report["errors"])
+    assert any("boom" in e for e in report["errors"])

@@ -123,3 +123,31 @@ class TestBackendGuard:
             # per-scenario report file too.
             assert _json.loads(report_path.read_text())["real"] is False
             assert elapsed < 60.0  # failed fast, did not hang
+
+
+def test_recv_exact_reassembles_short_reads():
+    """TCP may deliver any prefix per recv(); the barrier protocol must
+    reassemble the full 8-byte message (a short read used to make the
+    coordinator bail early, wedging every host at the rendezvous)."""
+    import socket
+    import threading
+
+    from tpuslo.chaos.ici_contention import _MSG, _recv_exact
+
+    a, b = socket.socketpair()
+    payload = _MSG.pack(3, 7)
+
+    def dribble():
+        for i in range(len(payload)):
+            a.sendall(payload[i:i + 1])
+        a.close()
+
+    t = threading.Thread(target=dribble)
+    t.start()
+    raw = _recv_exact(b, _MSG.size)
+    assert raw == payload
+    assert _MSG.unpack(raw) == (3, 7)
+    # EOF mid-message reports None, not a partial buffer.
+    assert _recv_exact(b, _MSG.size) is None
+    t.join()
+    b.close()

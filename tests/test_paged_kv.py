@@ -222,3 +222,56 @@ def test_paged_with_prefix_cache():
         for e in single.generate("what is ttft?", max_new_tokens=8, prefix=prefix)
     ]
     assert results[rid] == expect
+
+
+def test_misaligned_block_size_rejected():
+    """max_seq_len not a block multiple would make the last prompt
+    block's dynamic_slice clamp and copy a SHIFTED window (silent KV
+    corruption) — the engine must refuse the config up front."""
+    with pytest.raises(ValueError, match="multiple"):
+        PagedBatchingEngine(
+            cfg=CFG, params=PARAMS, max_slots=2, block_size=24
+        )
+
+
+def test_block_size_beyond_max_seq_len_rejected():
+    with pytest.raises(ValueError, match="exceeds"):
+        PagedBatchingEngine(
+            cfg=CFG, params=PARAMS, max_slots=2,
+            block_size=CFG.max_seq_len * 2,
+        )
+
+
+def test_parked_lane_past_table_width_writes_only_null_block():
+    """Parked (released) lanes keep decoding — the batch is fixed
+    shape — and their lengths keep climbing.  Once length walks past
+    the page-table width (MB * block_size positions; unreachable from
+    the engine API, whose requests die at max_seq_len, but inevitable
+    for a lane parked across many drained requests), the block lookup
+    must clamp to the zeroed table entry so every KV write still lands
+    in the masked null block 0 — never in a live block, on any backend,
+    regardless of the gather's out-of-bounds semantics."""
+    import numpy as np
+
+    from tpuslo.models.paged_kv import init_paged_pool, paged_decode_step
+
+    bs = 16
+    mb = CFG.max_seq_len // bs  # page-table width (8)
+    state = init_paged_pool(CFG, n_blocks=5, block_size=bs, slots=2)
+    # Both lanes parked: zeroed page tables, length 0 — the steady
+    # state after their requests released.  Run well past MB * bs.
+    token = jnp.zeros((2,), jnp.int32)
+    steps = mb * bs + 12
+    step = jax.jit(
+        lambda p, t, s: paged_decode_step(p, t, s, CFG, bs),
+        donate_argnums=(2,),
+    )
+    for _ in range(steps):
+        logits, state = step(PARAMS, token, state)
+    assert int(state["length"][0]) == steps  # clamp, not a freeze
+    assert jnp.isfinite(logits).all()
+    # Every write of every step hit null block 0: blocks 1..4 are
+    # untouched (init_paged_pool zero-fills the pool).
+    k = np.asarray(state["k"])
+    assert np.abs(k[:, 1:]).max() == 0.0
+    assert np.abs(k[:, 0]).max() > 0.0  # the writes really happened

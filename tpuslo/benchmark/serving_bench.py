@@ -734,19 +734,10 @@ def persist_tpu_capture(result: dict[str, Any], path: str | None = None) -> bool
         return False
     path = path or LATEST_CAPTURE_PATH
     import datetime
-    import subprocess
 
-    sha = "unknown"
-    try:
-        proc = subprocess.run(
-            ["git", "rev-parse", "--short", "HEAD"],
-            capture_output=True, text=True, timeout=10,
-            cwd=os.path.dirname(path),
-        )
-        if proc.returncode == 0:
-            sha = proc.stdout.strip()
-    except Exception:  # noqa: BLE001 - provenance best-effort
-        pass
+    from tpuslo.utils import git_short_sha
+
+    sha = git_short_sha(os.path.dirname(path))
     artifact = {
         "provenance": {
             "captured_at": datetime.datetime.now(datetime.timezone.utc)
@@ -762,26 +753,13 @@ def persist_tpu_capture(result: dict[str, Any], path: str | None = None) -> bool
         },
         "capture": result,
     }
-    tmp = None
+    from tpuslo.utils import write_json_atomic
+
     try:
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        fd, tmp = tempfile.mkstemp(
-            dir=os.path.dirname(path), suffix=".tmp"
-        )
-        with os.fdopen(fd, "w") as fh:
-            json.dump(artifact, fh, indent=2)
-            fh.write("\n")
-        os.replace(tmp, path)
-        tmp = None
+        write_json_atomic(path, artifact)
         return True
     except OSError:
         return False
-    finally:
-        if tmp is not None:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
 
 
 def load_last_tpu_capture(path: str | None = None) -> dict[str, Any] | None:

@@ -161,6 +161,23 @@ def contention_injection(
 # --------------------------------------------------------------------------
 
 
+def _recv_exact(conn: socket.socket, n: int) -> bytes | None:
+    """Read exactly ``n`` bytes or None on EOF.
+
+    TCP recv() may return any prefix of the requested size; a short
+    read of the 8-byte barrier message would make the coordinator
+    return early (hosts then block forever at the rendezvous) or trip
+    the host-side length assert mid-injection.
+    """
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
 @dataclass
 class BarrierHostResult:
     """One host's measured barrier waits, as probe-event dicts."""
@@ -180,8 +197,8 @@ def _barrier_coordinator(
     try:
         for launch in range(launches):
             for conn in conns:
-                raw = conn.recv(_MSG.size)
-                if len(raw) != _MSG.size:
+                raw = _recv_exact(conn, _MSG.size)
+                if raw is None:
                     return
                 _host, got = _MSG.unpack(raw)
                 assert got == launch, (got, launch)
@@ -218,8 +235,8 @@ def barrier_host(
                 time.sleep(delay_ms / 1000.0)
             t0 = time.perf_counter()
             sock.sendall(_MSG.pack(host_index, launch))
-            raw = sock.recv(_MSG.size)
-            assert len(raw) == _MSG.size
+            raw = _recv_exact(sock, _MSG.size)
+            assert raw is not None, "coordinator closed mid-barrier"
             wait_ms = (time.perf_counter() - t0) * 1000.0
             event = ProbeEventV1(
                 ts_unix_nano=int(time.time() * 1e9),

@@ -29,30 +29,14 @@ from tpuslo.cli.common import validate_probe
 
 
 def _write_jsonl(lines: list[str], output: str) -> None:
-    """'-' → stdout; else temp file + atomic rename (artifact exists
-    complete or not at all), matching plain open()'s permissions."""
+    """'-' → stdout; else atomic write (artifact exists complete or
+    not at all)."""
     if output == "-":
         sys.stdout.writelines(lines)
         return
-    import os
-    import tempfile
+    from tpuslo.utils import write_text_atomic
 
-    out_dir = os.path.dirname(os.path.abspath(output)) or "."
-    fd, tmp = tempfile.mkstemp(dir=out_dir, suffix=".tmp")
-    try:
-        umask = os.umask(0)
-        os.umask(umask)
-        os.fchmod(fd, 0o666 & ~umask)
-        with os.fdopen(fd, "w") as fh:
-            fh.writelines(lines)
-        os.replace(tmp, output)
-        tmp = None
-    finally:
-        if tmp is not None:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
+    write_text_atomic(output, "".join(lines))
 
 
 def main(argv: list[str] | None = None) -> int:

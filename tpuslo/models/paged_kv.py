@@ -22,8 +22,10 @@ this is the static-shape TPU translation (VERDICT r02 next-round #2):
   no shape ever depends on a request, so nothing recompiles.
 
 Block 0 is reserved as the null block: unallocated page-table entries
-point at it, its garbage is masked by per-row lengths, and the write
-path never targets it.
+point at it and its garbage is masked by per-row lengths.  Parked
+(released) lanes still decode every step — the batch is fixed-shape —
+and their KV writes land in the null block through their zeroed page
+tables, which is exactly why no live request may ever be mapped to it.
 
 The pool composes with the int8 KV representation
 (:mod:`tpuslo.models.kv_cache`): pass ``kv_dtype="int8"`` and both the
@@ -126,7 +128,13 @@ def paged_decode_step(
     pos = state["length"]  # (B,)
     pt = state["page_table"]  # (B, MB)
     MB = pt.shape[1]
-    blk = pos // block_size
+    # Parked lanes keep incrementing their length each step (the batch
+    # is fixed-shape), so their logical block index eventually walks
+    # past the page-table width; clamp it so the lookup stays in-bounds
+    # by construction instead of leaning on take_along_axis's implicit
+    # index clipping.  A clamped parked lane resolves to its zeroed
+    # table entry — the masked null block — never to live KV.
+    blk = jnp.minimum(pos // block_size, MB - 1)
     phys = jnp.take_along_axis(pt, blk[:, None], axis=1)[:, 0]  # (B,)
     off = pos % block_size
 
@@ -209,13 +217,31 @@ class PagedBatchingEngine(ContinuousBatchingEngine):
         kv_dtype: str = "bf16",
     ):
         self.block_size = block_size
+        from tpuslo.models.llama import llama_tiny
+
+        # The effective config, resolved BEFORE the (expensive) dense
+        # engine init so a bad block geometry fails fast — the default
+        # mirrors ContinuousBatchingEngine's.
+        c = cfg if cfg is not None else llama_tiny(max_seq_len=512)
+        # inject_prompt_block copies aligned block_size windows out of a
+        # (L, 1, max_seq_len, ...) dense row; if max_seq_len is not a
+        # block multiple, the last window's dynamic_slice start clamps
+        # and silently copies a SHIFTED window into the physical block —
+        # wrong prompt KV, wrong tokens, no error.  Refuse the config.
+        if block_size > c.max_seq_len:
+            raise ValueError(
+                f"block_size={block_size} exceeds max_seq_len="
+                f"{c.max_seq_len}"
+            )
+        if c.max_seq_len % block_size != 0:
+            raise ValueError(
+                f"max_seq_len={c.max_seq_len} must be a multiple "
+                f"of block_size={block_size}: the prompt-KV splice copies "
+                "aligned windows and a ragged tail would be copied shifted"
+            )
         # Default pool: half the dense reservation — the honest claim
         # this engine makes is "same workloads, half the KV HBM".
-        cfg_eff = cfg if cfg is not None else None
         if n_blocks is None:
-            from tpuslo.models.llama import llama_tiny
-
-            c = cfg_eff or llama_tiny(max_seq_len=512)
             n_blocks = 1 + max_slots * (-(-c.max_seq_len // block_size)) // 2
         self.n_blocks = n_blocks
         self._free: list[int] = []

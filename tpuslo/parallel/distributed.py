@@ -169,14 +169,49 @@ def run_distributed_probe(
         )
     events: list[dict] = []
     errors: list[str] = []
-    for proc in procs:
-        try:
-            out, err = proc.communicate(timeout=timeout_s)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-            out, err = proc.communicate()
-            errors.append("worker timeout")
-        if proc.returncode != 0:
+    # One SHARED deadline across every worker.  If a worker crashes, the
+    # survivors block forever inside the cross-process psum; sequential
+    # per-proc communicate(timeout) calls would stack to N*timeout_s of
+    # wall clock before reporting.  A drain thread per worker keeps the
+    # PIPEs flowing (a chatty worker would wedge on a full 64 KB pipe if
+    # the parent only polled); the main loop watches exit codes and the
+    # moment any worker exits nonzero kills the rest — they can never
+    # complete once a collective participant is gone.
+    import threading
+
+    outputs: list[tuple[str, str]] = [("", "")] * len(procs)
+
+    def _drain(i: int) -> None:
+        outputs[i] = procs[i].communicate()
+
+    drains = [
+        threading.Thread(target=_drain, args=(i,), daemon=True)
+        for i in range(len(procs))
+    ]
+    for t in drains:
+        t.start()
+    deadline = time.monotonic() + timeout_s
+    pending = set(range(len(procs)))
+    peer_failed = False
+    while pending and not peer_failed and time.monotonic() < deadline:
+        for i in list(pending):
+            if procs[i].poll() is None:
+                continue
+            pending.discard(i)
+            if procs[i].returncode != 0:
+                peer_failed = True
+        if pending and not peer_failed:
+            time.sleep(0.05)
+    for i in list(pending):
+        procs[i].kill()
+        errors.append(
+            "worker killed (peer exited nonzero)" if peer_failed
+            else "worker timeout"
+        )
+    for t in drains:
+        t.join(timeout=30.0)
+    for proc, (out, err) in zip(procs, outputs):
+        if proc.returncode is not None and proc.returncode != 0:
             errors.append((err or "")[-300:])
         for line in (out or "").splitlines():
             if line.strip().startswith("{"):

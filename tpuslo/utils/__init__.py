@@ -1,0 +1,64 @@
+"""Shared small utilities: atomic artifact writes and git provenance.
+
+Every committed artifact writer in the toolkit (the driver bench's
+full report, the persisted TPU serving capture, icibench's event
+JSONL) needs the same two things: a temp-file + rename write so a
+crash mid-dump can never truncate the previous good artifact, and a
+short git SHA to stamp provenance.  One implementation here; the
+callers were drifting copies before round 4.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import tempfile
+from typing import Any
+
+
+def write_text_atomic(path: str, text: str) -> None:
+    """Write ``text`` to ``path`` via temp file + atomic rename.
+
+    The artifact exists complete or not at all; permissions match what
+    a plain ``open(path, "w")`` would have produced (mkstemp defaults
+    to 0600, which would make committed artifacts unreadable in
+    containers that drop privileges).  Raises ``OSError`` on failure.
+    """
+    out_dir = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(out_dir, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=out_dir, suffix=".tmp")
+    try:
+        umask = os.umask(0)
+        os.umask(umask)
+        os.fchmod(fd, 0o666 & ~umask)
+        with os.fdopen(fd, "w") as fh:
+            fh.write(text)
+        os.replace(tmp, path)
+        tmp = None
+    finally:
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
+def write_json_atomic(path: str, payload: Any, indent: int | None = 2) -> None:
+    """Atomic JSON dump (see :func:`write_text_atomic`)."""
+    write_text_atomic(path, json.dumps(payload, indent=indent) + "\n")
+
+
+def git_short_sha(cwd: str | None = None) -> str:
+    """Short HEAD SHA of the repo containing ``cwd`` ("unknown" when
+    git is unavailable — provenance is best-effort, never fatal)."""
+    try:
+        proc = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10, cwd=cwd,
+        )
+        if proc.returncode == 0:
+            return proc.stdout.strip()
+    except Exception:  # noqa: BLE001 - provenance best-effort
+        pass
+    return "unknown"
