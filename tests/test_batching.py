@@ -223,3 +223,35 @@ def test_near_capacity_admission_skips_tail_compile():
 # Compile-heavy module: excluded from the sub-2-minute fast gate
 # (`make test-fast` / pytest -m "not slow"); the full suite runs it.
 pytestmark = pytest.mark.slow
+
+
+def test_request_timings_record_queue_delay_and_e2e():
+    """Lifecycle SLIs: every completed request reports a queue delay
+    (submit -> slot admission) and e2e latency; queued-behind requests
+    must show strictly later admission than the first wave."""
+    params = init_params(jax.random.PRNGKey(0), _cfg())
+    eng = ContinuousBatchingEngine(cfg=_cfg(), params=params, max_slots=2)
+    ids = [
+        eng.submit(f"request {i}", max_new_tokens=6, stop_at_eos=False)
+        for i in range(5)
+    ]
+    eng.run()
+    timings = eng.request_timings()
+    assert sorted(timings) == sorted(ids)
+    for t in timings.values():
+        assert t["queue_delay_s"] >= 0.0
+        assert t["e2e_s"] >= t["queue_delay_s"]
+    # With 2 slots, request 4 cannot be admitted before a completion.
+    first_wave = min(timings[i]["queue_delay_s"] for i in ids[:2])
+    assert timings[ids[4]]["queue_delay_s"] > first_wave
+
+
+def test_instant_request_timings_complete():
+    """max_new_tokens=1 requests finish at admission; their record must
+    still carry both SLIs."""
+    params = init_params(jax.random.PRNGKey(0), _cfg())
+    eng = ContinuousBatchingEngine(cfg=_cfg(), params=params, max_slots=2)
+    rid = eng.submit("one token", max_new_tokens=1, stop_at_eos=False)
+    eng.run()
+    t = eng.request_timings()[rid]
+    assert t["e2e_s"] >= 0.0 and t["queue_delay_s"] >= 0.0

@@ -266,3 +266,14 @@ def test_full_report_roundtrip(tmp_path):
         == 78.43
     )
     assert payload["git_sha"]
+
+
+def test_percentile_nearest_rank():
+    from tpuslo.benchmark.serving_bench import _percentile
+
+    values = [float(v) for v in range(1, 101)]
+    assert _percentile(values, 0.50) == 50.0
+    assert _percentile(values, 0.95) == 95.0
+    assert _percentile([7.0], 0.95) == 7.0
+    assert _percentile([], 0.95) == 0.0
+    assert _percentile([3.0, 1.0, 2.0], 0.50) == 2.0

@@ -55,6 +55,17 @@ DEFAULT_HBM_BYTES = {
 }
 
 
+def _percentile(values: list[float], q: float) -> float:
+    """Nearest-rank percentile (deterministic, no numpy dependency)."""
+    import math
+
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    rank = max(1, math.ceil(q * len(ordered)))
+    return ordered[min(rank, len(ordered)) - 1]
+
+
 def _lookup(table: dict[str, float], *keys: str) -> float | None:
     for key in keys:
         key = (key or "").lower()
@@ -253,7 +264,34 @@ def _long_prompt_lane(engine) -> dict[str, Any]:
     }
 
 
-def _bench_kv_lanes(cfg, params, buckets, mfu) -> dict[str, Any]:
+def _paged_cpu_config():
+    """Weight-bandwidth-bound config for the CPU paged lane.
+
+    The paged engine's capacity win converts to throughput only where
+    stepping 2B rows costs less than 2x stepping B — the regime TPU
+    decode always lives in (weights stream from HBM once per step
+    regardless of batch).  llama_tiny's weights fit in cache, so on
+    CPU it is compute-bound and batch scaling is linear: the round-3
+    lane measured 0.96 and said nothing about the feature.  ~100M
+    params in f32 (394 MB, far past LLC) reproduces the bandwidth-
+    bound regime on CPU: measured here, batch 4 -> 8 costs ~1.4x, not
+    2x.  f32 because XLA's CPU bf16 is emulated (2x slower than f32).
+    """
+    import jax.numpy as jnp
+
+    from tpuslo.models.llama import LlamaConfig
+
+    return LlamaConfig(
+        vocab_size=2048, dim=1024, n_layers=6, n_heads=8, n_kv_heads=4,
+        ffn_dim=4096, max_seq_len=512, rope_theta=10000.0,
+        dtype=jnp.float32,
+    )
+
+
+def _bench_kv_lanes(
+    cfg, params, buckets, mfu,
+    paged_cfg=None, paged_params=None, paged_buckets=None,
+) -> dict[str, Any]:
     """int8-KV decode and paged-vs-dense continuous batching lanes.
 
     The two VERDICT-r02 deferred perf items, measured side by side:
@@ -261,15 +299,18 @@ def _bench_kv_lanes(cfg, params, buckets, mfu) -> dict[str, Any]:
     * ``int8_kv``: batch-8 decode-only tokens/s with the quantized KV
       representation (KV reads are the marginal bandwidth at batch 8,
       so this is where int8 KV shows up) + the capacity arithmetic;
-    * ``paged``: request throughput of the paged continuous-batching
-      engine at 2x the slots of the dense engine **at equal KV HBM**
-      (the pool is sized to the dense engine's reservation) — the
-      capacity win converted into aggregate tokens/s.
+    * ``paged``: the paged continuous-batching engine at 2x the slots
+      of the dense engine **at equal KV HBM** (the pool is sized to
+      the dense engine's reservation), on a queue-bound workload —
+      aggregate tokens/s AND admission-queue delay p50/p95.  The lane
+      may run a different (bandwidth-bound) config than the main
+      bench model — see ``_paged_cpu_config`` — recorded in the
+      output's ``model`` fields.
     """
     import jax  # noqa: F401 - device sync via the engines
 
     from tpuslo.models.batching import ContinuousBatchingEngine
-    from tpuslo.models.llama import kv_cache_bytes
+    from tpuslo.models.llama import kv_cache_bytes, param_count
     from tpuslo.models.paged_kv import PagedBatchingEngine
     from tpuslo.models.serve import ServeEngine
 
@@ -289,46 +330,83 @@ def _bench_kv_lanes(cfg, params, buckets, mfu) -> dict[str, Any]:
     }
     del engine8
 
-    def drive(engine, n_requests: int, max_new: int) -> float:
-        prompts = [
-            f"{BENCH_PROMPT} request {i} with some extra context"
-            for i in range(n_requests)
-        ]
-        for p in prompts:
-            engine.submit(p, max_new_tokens=max_new, stop_at_eos=False)
+    pcfg = paged_cfg if paged_cfg is not None else cfg
+    pparams = paged_params if paged_params is not None else params
+    pbuckets = paged_buckets if paged_buckets is not None else buckets
+
+    # Queue-bound workload (VERDICT r03 #3): 4x more requests than the
+    # dense engine has slots, mixed prompt and decode lengths.  The
+    # paged engine's capacity win is CONCURRENCY at equal KV HBM, so
+    # the honest comparison is a workload where concurrency is the
+    # bottleneck — reported as aggregate tokens/s AND admission-queue
+    # delay (in a compute-saturated system extra concurrency moves
+    # neither; in the bandwidth-bound decode regime it moves both).
+    dense_slots, bs = 4, 64
+    n_req = 4 * dense_slots
+    new_tokens = [(24, 48, 72)[i % 3] for i in range(n_req)]
+    prompts = [
+        f"{BENCH_PROMPT} request {i}" + " ctx" * ((i * 5) % 20)
+        for i in range(n_req)
+    ]
+
+    def drive(engine) -> dict[str, float]:
+        for p, m in zip(prompts, new_tokens):
+            engine.submit(p, max_new_tokens=m, stop_at_eos=False)
         t0 = time.perf_counter()
         results = engine.run()
         elapsed = max(time.perf_counter() - t0, 1e-9)
         total = sum(len(v) for v in results.values())
-        return total / elapsed
+        timings = engine.request_timings().values()
+        queue = [t["queue_delay_s"] * 1e3 for t in timings]
+        e2e = [t["e2e_s"] * 1e3 for t in timings if "e2e_s" in t]
+        return {
+            "tokens_per_sec": total / elapsed,
+            "queue_delay_p50_ms": _percentile(queue, 0.50),
+            "queue_delay_p95_ms": _percentile(queue, 0.95),
+            "e2e_p95_ms": _percentile(e2e, 0.95),
+        }
 
-    dense_slots, bs, n_req, max_new = 4, 64, 12, 24
     dense = ContinuousBatchingEngine(
-        cfg=cfg, params=params, max_slots=dense_slots, prefill_buckets=buckets
+        cfg=pcfg, params=pparams, max_slots=dense_slots,
+        prefill_buckets=pbuckets,
     )
-    dense_tps = drive(dense, n_req, max_new)
-    dense_bytes = kv_cache_bytes(cfg, dense_slots)
+    d = drive(dense)
+    dense_bytes = kv_cache_bytes(pcfg, dense_slots)
     del dense
 
     # Paged pool sized to the DENSE engine's KV reservation, double the
     # slots: same HBM, twice the concurrency.
-    n_blocks = 1 + dense_slots * (-(-cfg.max_seq_len // bs))
+    n_blocks = 1 + dense_slots * (-(-pcfg.max_seq_len // bs))
     paged = PagedBatchingEngine(
-        cfg=cfg, params=params, max_slots=2 * dense_slots, n_blocks=n_blocks,
-        block_size=bs, prefill_buckets=buckets,
+        cfg=pcfg, params=pparams, max_slots=2 * dense_slots,
+        n_blocks=n_blocks, block_size=bs, prefill_buckets=pbuckets,
     )
-    paged_tps = drive(paged, n_req, max_new)
+    p = drive(paged)
     from tpuslo.models.paged_kv import paged_pool_bytes
 
     out["paged"] = {
+        "model_n_params": param_count(pcfg),
+        "model_dtype": getattr(pcfg.dtype, "__name__", str(pcfg.dtype)),
         "dense_slots": dense_slots,
         "paged_slots": 2 * dense_slots,
+        "n_requests": n_req,
+        "new_tokens_mix": sorted(set(new_tokens)),
         "kv_hbm_bytes": dense_bytes,
-        "paged_pool_bytes": paged_pool_bytes(cfg, n_blocks, bs),
-        "dense_requests_per_min": round(dense_tps * 60.0 / max_new, 1),
-        "dense_tokens_per_sec": round(dense_tps, 2),
-        "paged_tokens_per_sec": round(paged_tps, 2),
-        "throughput_ratio": round(paged_tps / max(dense_tps, 1e-9), 2),
+        "paged_pool_bytes": paged_pool_bytes(pcfg, n_blocks, bs),
+        "dense_tokens_per_sec": round(d["tokens_per_sec"], 2),
+        "paged_tokens_per_sec": round(p["tokens_per_sec"], 2),
+        "throughput_ratio": round(
+            p["tokens_per_sec"] / max(d["tokens_per_sec"], 1e-9), 2
+        ),
+        "dense_queue_delay_p50_ms": round(d["queue_delay_p50_ms"], 1),
+        "dense_queue_delay_p95_ms": round(d["queue_delay_p95_ms"], 1),
+        "paged_queue_delay_p50_ms": round(p["queue_delay_p50_ms"], 1),
+        "paged_queue_delay_p95_ms": round(p["queue_delay_p95_ms"], 1),
+        "queue_delay_p95_ratio": round(
+            d["queue_delay_p95_ms"] / max(p["queue_delay_p95_ms"], 1e-9), 2
+        ),
+        "dense_e2e_p95_ms": round(d["e2e_p95_ms"], 1),
+        "paged_e2e_p95_ms": round(p["e2e_p95_ms"], 1),
     }
     del paged
     return out
@@ -557,10 +635,25 @@ def run(platform: str = "auto", model: str = "auto") -> dict[str, Any]:
     out["mfu_prefill"] = mfu(prefill_tps)
 
     # --- KV representations: int8 KV + paged pool ----------------------
+    paged_kw: dict[str, Any] = {}
     try:
-        out["kv"] = _bench_kv_lanes(cfg, params, buckets, mfu)
+        if dev.platform == "cpu":
+            # llama_tiny fits in cache -> compute-bound -> batch scaling
+            # is linear and the paged comparison measures nothing.  Run
+            # the paged lane on a weight-bandwidth-bound config (the
+            # TPU decode regime); on TPU the main model already is one.
+            pcfg = _paged_cpu_config()
+            paged_kw = {
+                "paged_cfg": pcfg,
+                "paged_params": init_params(jax.random.PRNGKey(0), pcfg),
+                "paged_buckets": (64,),
+            }
+        out["kv"] = _bench_kv_lanes(cfg, params, buckets, mfu, **paged_kw)
     except Exception as exc:  # noqa: BLE001 - additive lane
         out["kv"] = {"error": str(exc)[:300]}
+    finally:
+        if paged_kw:
+            _free_params(paged_kw["paged_params"])
 
     # --- xla_launch tier on real trace data ----------------------------
     try:

@@ -75,6 +75,12 @@ class _Request:
     # attempts so a blocked request pays its prefill ONCE, not once per
     # decode step while it waits (the paged engine can block on blocks).
     ingested: tuple | None = None
+    # Lifecycle timestamps (perf_counter seconds): admission-queue
+    # delay and end-to-end latency are the SLIs that separate a
+    # capacity-bound scheduler from a compute-bound one.
+    submitted_s: float | None = None
+    admitted_s: float | None = None
+    completed_s: float | None = None
 
 
 class ContinuousBatchingEngine:
@@ -133,6 +139,8 @@ class ContinuousBatchingEngine:
         self.steps = 0
         #: finished request id -> emitted token ids
         self.results: dict[int, list[int]] = {}
+        #: finished request id -> lifecycle record (for timing SLIs)
+        self._finished: dict[int, _Request] = {}
 
     # -- decode-state hooks (overridden by the paged engine) -------------
 
@@ -171,14 +179,19 @@ class ContinuousBatchingEngine:
         (the effective prompt is ``prefix + prompt``; only the suffix
         prefills at admission).
         """
+        import time
+
         req = _Request(
             self._next_id, prompt, max_new_tokens, stop_at_eos, prefix=prefix
         )
+        req.submitted_s = time.perf_counter()
         self._next_id += 1
         self._queue.append(req)
         return req.request_id
 
     def _admit(self, slot: int, req: _Request) -> bool:
+        import time
+
         if req.ingested is None:
             req.ingested = self._ingest.ingest_prompt(req.prompt, req.prefix)
         logits, row_cache, total_len = req.ingested
@@ -196,7 +209,9 @@ class ContinuousBatchingEngine:
             req.ingested = None
             req.tokens.append(first)
             req.done = True
+            req.admitted_s = req.completed_s = time.perf_counter()
             self.results[req.request_id] = req.tokens
+            self._finished[req.request_id] = req
             return True
         # _install_row turns the row's scalar length into the slot's
         # vector entry (or, paged, scatters the row into pool blocks).
@@ -207,6 +222,7 @@ class ContinuousBatchingEngine:
             self._queue.insert(0, req)
             return False
         req.ingested = None  # row spliced into the batch cache; drop it
+        req.admitted_s = time.perf_counter()
         req.tokens.append(first)
         self._tokens = self._tokens.at[slot].set(first)
         self._slots[slot] = req
@@ -245,6 +261,8 @@ class ContinuousBatchingEngine:
         self._tokens = next_tokens
         self.steps += 1
         values = jax.device_get(next_tokens).tolist()
+        import time
+
         for slot, req in enumerate(self._slots):
             if req is None:
                 continue  # parked lane: decoded garbage, discarded
@@ -254,7 +272,9 @@ class ContinuousBatchingEngine:
                 req.tokens
             ) >= req.max_new_tokens:
                 req.done = True
+                req.completed_s = time.perf_counter()
                 self.results[req.request_id] = req.tokens
+                self._finished[req.request_id] = req
                 self._slots[slot] = None
                 self._release_slot(slot)
         return bool(self._queue) or any(self._slots)
@@ -292,6 +312,24 @@ class ContinuousBatchingEngine:
             if req.request_id == request_id:
                 return []
         return None
+
+    def request_timings(self) -> dict[int, dict[str, float]]:
+        """Per-completed-request lifecycle SLIs.
+
+        ``queue_delay_s`` is submit -> admission into a decode slot
+        (what a capacity-starved scheduler inflates; the paged engine
+        exists to shrink it at equal KV HBM) and ``e2e_s`` is submit ->
+        final token.
+        """
+        out: dict[int, dict[str, float]] = {}
+        for rid, req in self._finished.items():
+            if req.submitted_s is None or req.admitted_s is None:
+                continue
+            record = {"queue_delay_s": req.admitted_s - req.submitted_s}
+            if req.completed_s is not None:
+                record["e2e_s"] = req.completed_s - req.submitted_s
+            out[rid] = record
+        return out
 
     def stats(self) -> dict[str, int | float]:
         """Scheduler telemetry for the SLO pipeline: slot occupancy is
