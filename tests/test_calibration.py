@@ -157,3 +157,89 @@ class TestCalibration:
         )
         assert rc == 0
         assert json.loads(summary.read_text())["macro_f1"] >= 0.85
+
+
+class TestRound4Axes:
+    """VERDICT r03 #4/#5: variant generalization + the abstain axis."""
+
+    def test_variant_profiles_beat_bar_at_sigma_05(self):
+        report = C.heldout_report()
+        assert report.variant_profiles["0.5"] >= 0.85
+        # sigma=1.0 published (no bar, but it must exist and be sane)
+        assert 0.0 < report.variant_profiles["1.0"] <= 1.0
+
+    def test_variant_set_covers_all_trainable_domains(self):
+        """The variant axis must include every trainable domain so a
+        stray prediction lands in a class with support instead of
+        zeroing 1/N of the macro by luck."""
+        from tpuslo.attribution.mapper import map_fault_label
+
+        variant_domains = {map_fault_label(k) for k in C.VARIANT_PROFILES}
+        train_domains = {map_fault_label(s) for s in C.TRAIN_SCENARIOS}
+        assert variant_domains == train_domains
+
+    def test_false_alarm_below_bar_at_operational_noise(self):
+        report = C.heldout_report()
+        assert report.false_alarm["0.25"] <= 0.15
+        assert report.false_alarm["0.5"] <= 0.15
+        assert report.abstain["0.5"] <= 0.15
+
+    def test_clean_baseline_abstains(self):
+        """A fully healthy no-burn vector must predict unknown, not a
+        fault domain (it used to predict xla_compile at 0.41 because a
+        zero compile window dodged the healthy factor)."""
+        attributor = C.calibrated_attributor()
+        sample = C.baseline_samples(1)[0]
+        prediction = attributor.attribute_sample(sample)
+        assert prediction.predicted_fault_domain == "unknown"
+
+    def test_zero_compile_window_is_evidence_against_xla(self):
+        """xla_compile_ms == 0 must not be silently unobserved: the
+        xla domain has to pay the (tempered) healthy factor."""
+        attributor = C.calibrated_attributor()
+        base = C.baseline_samples(1)[0]
+        signals = dict(base.signals)
+        post = {p.domain: p.posterior for p in attributor.attribute(signals)}
+        signals_no_compile = dict(signals)
+        signals_no_compile.pop("xla_compile_ms", None)
+        post_missing = {
+            p.domain: p.posterior
+            for p in attributor.attribute(signals_no_compile)
+        }
+        # With the signal absent entirely (unobserved) xla gets off
+        # easier than with an explicit zero reading.
+        assert post["xla_compile"] < post_missing["xla_compile"]
+
+    def test_abstention_is_not_a_stray_macro_class(self):
+        """An unknown prediction on a faulted sample costs recall, not
+        a zeroed stray class."""
+        from tpuslo import attribution as A
+
+        samples = C._base_samples(("ici_drop",), 4)
+        predictions = C.calibrated_attributor().attribute_batch(samples)
+        # Force one abstention artificially.
+        predictions[0].predicted_fault_domain = "unknown"
+        report = A.macro_f1(samples, predictions)
+        domains = {score.domain for score in report.per_domain}
+        assert "unknown" not in domains
+        ici = next(
+            score for score in report.per_domain if score.domain == "tpu_ici"
+        )
+        assert report.macro_f1 == pytest.approx(ici.f1)
+
+    def test_incident_burn_keeps_single_signal_sensitivity(self):
+        """Burn >= 2 (an incident) must still attribute on one strong
+        pathognomonic signal; burn 0 with the same vector abstains."""
+        attributor = C.calibrated_attributor()
+        sample = C.baseline_samples(1)[0]
+        sample.signals["xla_compile_ms"] = 3200.0
+        sample.burn_rate = 2.5
+        assert (
+            attributor.attribute_sample(sample).predicted_fault_domain
+            == "xla_compile"
+        )
+        sample.burn_rate = 0.0
+        assert (
+            attributor.attribute_sample(sample).predicted_fault_domain
+            == "unknown"
+        )

@@ -121,11 +121,63 @@ _COUNTER_SIGNALS = frozenset(
     }
 )
 
+# Event-driven signals where 0.0 is ALSO a legitimate reading ("no such
+# event this window"), not only a dropped probe: a window with zero
+# compiles is real evidence against a recompile storm.  Treating a zero
+# here as unobserved let the xla_compile domain dodge its pathognomonic
+# healthy factor entirely and win NO-FAULT vectors by default (measured
+# false-alarm rate on noisy healthy baselines: 100%).  Zeros on these
+# get the same drop-mixture treatment as zero counters.
+_ZERO_AMBIGUOUS_SIGNALS = _COUNTER_SIGNALS | {"xla_compile_ms"}
+
+# Drop-mixture prior for a zero xla_compile_ms reading.  Unlike the
+# counters (whose faulted profiles emit tens of events per window, so a
+# zero under a fault is almost surely a drop), a compile storm's zero
+# is STILL most plausibly a dropped probe — but a healthy serving
+# window legitimately compiles nothing, so the healthy mass must stay
+# substantial or no-fault windows get attributed to xla_compile by
+# default (measured 100% false-alarm before this model).
+COMPILE_ZERO_DROP_PRIOR = 0.5
+
+# Soft-mode abstention floor: name a fault domain only when some
+# observed signal's evidence weight reaches this value; otherwise
+# predict ``unknown``.  0.5 is the warning threshold itself (abstain
+# only with NO elevated evidence); higher values trade false alarms on
+# noisy no-fault windows against abstentions on weakly-evidenced
+# faults.  Selected on training noise (calibrate protocol, seed 9
+# lineage) against the reference methodology's bars (false alarm <= 15%
+# on noisy baselines, abstain <= 15% single-fault).
+ABSTAIN_MIN_TOP_WEIGHT = 0.5
+
+# An SLO burn rate at or past this marks the sample as an INCIDENT —
+# the regime the attributor is built for (and the justification for
+# UNKNOWN_PRIOR_SCALE in calibrate: during a burn, "no attributable
+# cause" is a priori rare).  Samples WITHOUT a burn carry no
+# corroboration that anything is wrong, and every modeled fault
+# elevates at least two signals — so on no-burn samples a domain is
+# named only with >= 2 elevated signals; a single noisy spike abstains.
+# This is what holds the false-alarm rate on noisy no-fault windows
+# under the methodology's 15% bar without desensitizing incidents.
+INCIDENT_BURN_RATE = 2.0
+NO_BURN_MIN_ELEVATED = 2
+
+# Probability that a zero-valued counter reading is a dropped probe
+# rather than a true zero, used by soft-evidence mode to temper the
+# healthy factor of zero counters (drop mixture).  Matches the shedding
+# drop-rate baseline the calibration corruption protocol models
+# (calibrate.corrupt drop_rate=0.15).
+COUNTER_ZERO_DROP_PRIOR = 0.15
+
 # Default evidence sharpness, fitted by
 # ``tpuslo.attribution.calibrate.fit_sharpness`` on lognormal-noise
-# training goldens (see that module's docstring for the protocol and
-# tests/test_calibration.py for the reproduction check).
-DEFAULT_EVIDENCE_SHARPNESS = 2.0
+# training goldens — all nine domains, canonical + mild magnitude
+# families, multiple seeds (see that function's docstring for the
+# protocol and tests/test_calibration.py for the reproduction check).
+# Round 4's protocol (full-domain, multi-seed) selects a gentler
+# sigmoid than round 3's TPU-only single-seed run did (2.0): crisp
+# weights amplified single noisy borderline signals, which is what
+# kept the variant-profile held-out axis at 0.79.
+DEFAULT_EVIDENCE_SHARPNESS = 1.0
 
 
 def soft_evidence_weight(
@@ -344,7 +396,7 @@ class BayesianAttributor:
             observed = {
                 s
                 for s in observed
-                if s in _COUNTER_SIGNALS
+                if s in _ZERO_AMBIGUOUS_SIGNALS
                 or s not in SIGNAL_ELEVATION_THRESHOLDS
                 or signals.get(s, 0.0) != 0.0
             }
@@ -455,6 +507,22 @@ class BayesianAttributor:
                     continue
                 w = weights.get(signal, 0.0)
                 p = _clamp(self.likelihoods[signal].get(domain, 0.5))
+                if (
+                    self.evidence == "soft"
+                    and signal in _ZERO_AMBIGUOUS_SIGNALS
+                    and signals.get(signal, 0.0) == 0.0
+                ):
+                    # Ambiguous zero: drop mixture, not full healthy
+                    # credit (see COUNTER_ZERO_DROP_PRIOR).
+                    p_drop = (
+                        COUNTER_ZERO_DROP_PRIOR
+                        if signal in _COUNTER_SIGNALS
+                        else COMPILE_ZERO_DROP_PRIOR
+                    )
+                    log_p += math.log(
+                        p_drop + (1.0 - p_drop) * _clamp(1.0 - p)
+                    )
+                    continue
                 log_p += w * math.log(p) + (1.0 - w) * math.log(
                     _clamp(1.0 - p)
                 )
@@ -511,6 +579,26 @@ class BayesianAttributor:
         base.fault_hypotheses = _sort_hypotheses(hypotheses.values())
         base.predicted_fault_domain = posteriors[0].domain
         base.confidence = posteriors[0].posterior
+        if self.evidence == "soft":
+            _observed, w = self._observed_and_weights(sample.signals)
+            top_weight = max(w.values(), default=0.0)
+            n_elevated = sum(v >= 0.5 for v in w.values())
+            min_elevated = (
+                1 if sample.burn_rate >= INCIDENT_BURN_RATE
+                else NO_BURN_MIN_ELEVATED
+            )
+            if (
+                top_weight < ABSTAIN_MIN_TOP_WEIGHT
+                or n_elevated < min_elevated
+            ):
+                # Abstain (same rule as the batch path): no elevated
+                # evidence means no testimony for any fault.
+                base.predicted_fault_domain = DOMAIN_UNKNOWN
+                base.confidence = next(
+                    p.posterior
+                    for p in posteriors
+                    if p.domain == DOMAIN_UNKNOWN
+                )
         return base
 
     def attribute_batch(
@@ -561,7 +649,7 @@ class BayesianAttributor:
             # Exact-0.0 continuous probes = missing, not healthy.
             continuous = np.array(
                 [
-                    s not in _COUNTER_SIGNALS
+                    s not in _ZERO_AMBIGUOUS_SIGNALS
                     and s in SIGNAL_ELEVATION_THRESHOLDS
                     for s in mat.signals
                 ]
@@ -597,6 +685,35 @@ class BayesianAttributor:
             + w_obs @ mat.log_lik
             + (obsf - w_obs) @ mat.log_not_lik
         )
+        if self.evidence == "soft":
+            # A zero COUNTER is ambiguous: legitimately healthy, or a
+            # dropped probe (shedding, ring loss) that zeroed it.  Full
+            # healthy credit lets one dropped pathognomonic counter
+            # (ici_link_retries under 15% shedding) overwhelm the rest
+            # of the evidence and strand the sample in a wrong domain.
+            # Replace the healthy factor with the drop mixture
+            # P(0 | domain) = p_drop + (1 - p_drop) (1 - P(elev|domain)).
+            ambiguous = np.array(
+                [s in _ZERO_AMBIGUOUS_SIGNALS for s in mat.signals]
+            )
+            zero_counter = (
+                observed & ambiguous[None, :] & (values == 0.0)
+            ).astype(float)
+            if zero_counter.any():
+                not_lik = np.exp(mat.log_not_lik)
+                p_drop = np.array(
+                    [
+                        COUNTER_ZERO_DROP_PRIOR
+                        if s in _COUNTER_SIGNALS
+                        else COMPILE_ZERO_DROP_PRIOR
+                        for s in mat.signals
+                    ]
+                )[:, None]
+                adj = (
+                    np.log(p_drop + (1.0 - p_drop) * not_lik)
+                    - mat.log_not_lik
+                )
+                log_post = log_post + zero_counter @ adj
         posteriors = _softmax_rows(log_post)
 
         # Residual explaining-away pass, one matmul for the batch,
@@ -663,6 +780,23 @@ class BayesianAttributor:
             base.fault_hypotheses = _sort_hypotheses(hypotheses.values())
             base.predicted_fault_domain = ALL_DOMAINS[top]
             base.confidence = float(posteriors[i, top])
+            top_weight = float((weights[i] * observed[i]).max(initial=0.0))
+            n_elevated = int(elevated[i].sum())
+            min_elevated = (
+                1 if sample.burn_rate >= INCIDENT_BURN_RATE
+                else NO_BURN_MIN_ELEVATED
+            )
+            if self.evidence == "soft" and (
+                top_weight < ABSTAIN_MIN_TOP_WEIGHT
+                or n_elevated < min_elevated
+            ):
+                # Abstain: without sufficiently elevated evidence there
+                # is no real testimony FOR any fault — a domain winning
+                # purely on prior geometry and healthy-factor
+                # asymmetries is a false alarm (measured 100% on noisy
+                # no-fault baselines before this rule).
+                base.predicted_fault_domain = DOMAIN_UNKNOWN
+                base.confidence = float(posteriors[i, unknown_idx])
             out[pos] = base
         return [a for a in out if a is not None]
 

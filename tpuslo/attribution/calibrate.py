@@ -15,14 +15,23 @@ evidence weight per domain as the calibrated P(signal | domain), and
 serve the result through a soft-evidence
 (:func:`~tpuslo.attribution.bayesian.soft_evidence_weight`) attributor.
 
-Validation is held out three ways (``heldout_report``):
+Fitting draws from the canonical fault profiles AND a sampled-magnitude
+family (:func:`sampled_magnitude_samples` — severities log-uniform from
+the warning line to the canonical point), so the table learns each
+domain's testimony across severities rather than memorizing magnitudes.
+
+Validation is held out four ways (``heldout_report``):
 
 * a **noise seed** never used in training;
 * a **different noise family** (gamma-multiplicative instead of the
   lognormal the fit saw);
-* **variant fault profiles** with magnitudes the generator never emits
-  (milder/harsher faults), so the score cannot come from memorizing
-  ``tpuslo.signals.generator._FAULT_OVERRIDES``.
+* **variant fault profiles** over ALL nine trainable domains with
+  magnitudes the generator never emits (milder faults, different
+  secondary mixes), so the score cannot come from memorizing
+  ``tpuslo.signals.generator._FAULT_OVERRIDES``;
+* the **abstain axis**: false-alarm rate on noisy NO-FAULT baselines
+  and abstention rate on noisy faulted samples (methodology bars:
+  both <= 15%).
 
 Everything is deterministic (seeded numpy) and cheap (<1 s), so the
 calibrated attributor is fitted on demand rather than shipped as a
@@ -61,6 +70,15 @@ TPU_SCENARIOS: tuple[str, ...] = TRAIN_SCENARIOS[:4]
 #: different from ``tpuslo.signals.generator._FAULT_OVERRIDES`` — milder
 #: faults sitting between warning and error thresholds, plus different
 #: secondary-signal mixes.  Used only for evaluation, never fitting.
+#:
+#: Round 4 expands the set from the 4 TPU domains to ALL 9 trainable
+#: domains: with TPU-only variants, a single noisy sample straying into
+#: a non-TPU class zeroed 1/5 of the macro (absent classes score F1 0),
+#: so the axis measured stray-class luck more than generalization.
+#: Full-domain coverage is the stronger validation — every plausible
+#: stray lands in a class with support, and the CPU-side domains'
+#: generalization gets measured at all.  (Round-3 comparability: the
+#: TPU-only number can be recomputed by filtering to the 4 TPU labels.)
 VARIANT_PROFILES: dict[str, dict[str, float]] = {
     "ici_drop": {
         "ici_link_retries_total": 12.0,
@@ -83,6 +101,36 @@ VARIANT_PROFILES: dict[str, dict[str, float]] = {
         "disk_io_latency_ms": 22.0,
         "syscall_latency_ms": 120.0,
         "hbm_utilization_pct": 70.0,
+    },
+    "dns_latency": {
+        # Mild resolution stall; connect rides it (the generator's DNS
+        # fault is on the connect path), at a different dns:connect
+        # ratio than the canonical profile.
+        "dns_latency_ms": 70.0,
+        "connect_latency_ms": 95.0,
+    },
+    "cpu_throttle": {
+        "runqueue_delay_ms": 14.0,
+        "cpu_steal_pct": 3.5,
+        "cfs_throttled_ms": 60.0,
+    },
+    "memory_pressure": {
+        "mem_reclaim_latency_ms": 9.0,
+        "disk_io_latency_ms": 18.0,
+        "runqueue_delay_ms": 11.0,
+    },
+    "provider_throttle": {
+        "connect_latency_ms": 90.0,
+        "tls_handshake_ms": 65.0,
+        "connect_errors_total": 1.0,
+        "syscall_latency_ms": 80.0,
+    },
+    "network_partition": {
+        "connect_latency_ms": 200.0,
+        "tcp_retransmits_total": 4.0,
+        "dns_latency_ms": 60.0,
+        "connect_errors_total": 2.0,
+        "tls_handshake_fail_total": 1.0,
     },
 }
 
@@ -126,6 +174,108 @@ def variant_samples(count: int = 25) -> list[FaultSample]:
                 )
             )
     return out
+
+
+def sampled_magnitude_samples(
+    scenarios: tuple[str, ...], count: int, seed: int
+) -> list[FaultSample]:
+    """Training replicas with fault magnitudes DRAWN, not canonical.
+
+    For every fault signal the magnitude is log-uniform over
+    [min(canonical, warning), max(canonical, error)] — the span from
+    "barely warning" mild faults to the generator's canonical point.
+    Fitting over this family teaches each P(signal | domain) the
+    domain's testimony across severities instead of memorizing
+    ``_FAULT_OVERRIDES``'s exact magnitudes, which is what left the
+    variant-profile held-out axis at 0.787 (VERDICT r03 #4): profiles
+    between warning and error were effectively out of distribution.
+    """
+    from tpuslo.signals.generator import profile_for_fault
+
+    rs = np.random.RandomState(seed)
+    start = datetime(2026, 1, 15, tzinfo=timezone.utc)
+    base = profile_for_fault("baseline")
+    out: list[FaultSample] = []
+    for label in scenarios:
+        canonical = profile_for_fault(label)
+        overrides = {
+            k: v for k, v in canonical.items() if v != base.get(k)
+        }
+        for idx in range(count):
+            signals = dict(base)
+            for name, value in overrides.items():
+                warn = B.SIGNAL_ELEVATION_THRESHOLDS.get(name, value)
+                err = B.SIGNAL_ERROR_THRESHOLDS.get(name, value)
+                lo = max(min(float(value), float(warn)), 1e-3)
+                if float(value) >= float(warn):
+                    # Signature signal: mild-to-canonical/error span.
+                    hi = max(float(value), float(err))
+                else:
+                    # Sub-warning co-signal (e.g. ici_drop's mild
+                    # host_offload creep): vary it up to the warning
+                    # line only — stretching it to the error threshold
+                    # would teach the domain a strongly-elevated
+                    # co-signal its faults do not actually produce.
+                    hi = float(warn)
+                draw = float(
+                    np.exp(rs.uniform(np.log(lo), np.log(max(hi, lo))))
+                )
+                # Counter signals are integral in the schema's spirit;
+                # keep at least 1 so the evidence is observed.
+                signals[name] = max(1.0, round(draw)) if name in (
+                    B._COUNTER_SIGNALS
+                ) else draw
+            out.append(
+                FaultSample(
+                    incident_id=f"magsample-{label}-{idx:04d}",
+                    timestamp=start,
+                    cluster="local",
+                    namespace="default",
+                    service="chat",
+                    fault_label=label,
+                    expected_domain=map_fault_label(label),
+                    signals=signals,
+                    confidence=0.9,
+                    burn_rate=2.0,
+                    window_minutes=5,
+                    request_id=f"magsample-req-{idx:04d}",
+                    trace_id=f"magsample-trace-{idx:04d}",
+                )
+            )
+    return out
+
+
+def baseline_samples(count: int = 25) -> list[FaultSample]:
+    """No-fault samples (healthy signal vector) for the abstain axis.
+
+    The attributor's correct answer on these is ``unknown`` — any
+    specific fault domain is a false alarm.  They carry burn_rate 0
+    (no SLO burn in progress), which is exactly the regime the
+    incident-conditional ``UNKNOWN_PRIOR_SCALE`` does NOT model; the
+    false-alarm measurement is what justifies (or retires) that knob.
+    """
+    from tpuslo.signals.generator import profile_for_fault
+
+    start = datetime(2026, 3, 1, tzinfo=timezone.utc)
+    base = profile_for_fault("baseline")
+    return [
+        FaultSample(
+            incident_id=f"baseline-{idx:04d}",
+            timestamp=start,
+            cluster="local",
+            namespace="default",
+            service="chat",
+            fault_label="baseline",
+            expected_domain=B.DOMAIN_UNKNOWN,
+            signals=dict(base),
+            confidence=0.9,
+            burn_rate=0.0,
+            window_minutes=5,
+            request_id=f"baseline-req-{idx:04d}",
+            trace_id=f"baseline-trace-{idx:04d}",
+        )
+        for idx in range(count)
+    ]
 
 
 def corrupt(
@@ -181,10 +331,10 @@ def fit_likelihoods(
     table = {s: dict(row) for s, row in B.default_likelihoods().items()}
     acc: dict[str, dict[str, list[float]]] = {}
     for sigma in sigmas:
-        train = corrupt(
-            _base_samples(scenarios, count), sigma,
-            seed + int(sigma * 1000),
+        pool = _base_samples(scenarios, count) + sampled_magnitude_samples(
+            scenarios, count, seed + 17 + int(sigma * 1000)
         )
+        train = corrupt(pool, sigma, seed + int(sigma * 1000))
         for sample in train:
             domain = sample.expected_domain or map_fault_label(
                 sample.fault_label
@@ -192,7 +342,7 @@ def fit_likelihoods(
             for name, value in sample.signals.items():
                 if name not in table:
                     continue
-                if value == 0.0 and name not in B._COUNTER_SIGNALS:
+                if value == 0.0 and name not in B._ZERO_AMBIGUOUS_SIGNALS:
                     continue  # dropped probe: unobserved, not healthy
                 weight = B.soft_evidence_weight(name, value, sharpness)
                 acc.setdefault(domain, {}).setdefault(name, []).append(weight)
@@ -238,17 +388,28 @@ def fit_sharpness(
     grid: tuple[float, ...] = (1.0, 1.5, 2.0, 3.0, 4.0),
     seed: int = 9,
     sigmas: tuple[float, ...] = (0.25, 0.5),
-    count: int = 25,
+    count: int = 15,
+    n_seeds: int = 3,
 ) -> float:
     """Pick the evidence sharpness by training-noise macro-F1.
 
-    Selection runs on training-seed noise only (seed 9 lineage —
-    disjoint from both the fit seeds and the held-out eval seed 42);
-    ties break toward the smallest (least confident) sharpness.
-    ``bayesian.DEFAULT_EVIDENCE_SHARPNESS`` records the result.
+    Selection protocol (round 4 — see VERDICT r03 #4's selection
+    pitfalls): ALL nine trainable domains (the attributor serves all
+    of them, and a TPU-only selection set picked a sharpness that
+    generalized worse), the canonical training profiles PLUS the mild
+    magnitude-sampled family (mildness robustness is an explicit goal,
+    and it is training data), and several noise seeds per sigma (a
+    single seed's draw luck dominated the comparison — observed swings
+    of 0.13 macro between seeds at the same sharpness).  Seeds are the
+    9-lineage — disjoint from both the fit seeds (7-lineage) and the
+    held-out eval seed 42.  Ties break toward the smallest (least
+    confident) sharpness.  ``bayesian.DEFAULT_EVIDENCE_SHARPNESS``
+    records the result.
     """
     best_k, best_score = grid[0], -1.0
-    base = _base_samples(TPU_SCENARIOS, count)
+    pool = _base_samples(TRAIN_SCENARIOS, count) + sampled_magnitude_samples(
+        TRAIN_SCENARIOS, count, seed * 101
+    )
     for k in grid:
         attributor = B.BayesianAttributor(
             priors=calibrated_priors(),
@@ -258,9 +419,12 @@ def fit_sharpness(
         )
         scores = []
         for sigma in sigmas:
-            noisy = corrupt(base, sigma, seed + int(sigma * 100))
-            predictions = attributor.attribute_batch(noisy)
-            scores.append(macro_f1(noisy, predictions).macro_f1)
+            for rep in range(n_seeds):
+                noisy = corrupt(
+                    pool, sigma, seed + int(sigma * 100) + 7 * rep
+                )
+                predictions = attributor.attribute_batch(noisy)
+                scores.append(macro_f1(noisy, predictions).macro_f1)
         mean = sum(scores) / len(scores)
         if mean > best_score + 1e-9:
             best_k, best_score = k, mean
@@ -269,12 +433,22 @@ def fit_sharpness(
 
 @dataclass
 class HeldoutReport:
-    """Macro-F1 of an attributor across the held-out validation axes."""
+    """Macro-F1 of an attributor across the held-out validation axes,
+    plus the abstain/false-alarm axis (VERDICT r03 #5):
+
+    * ``false_alarm`` — fraction of noisy NO-FAULT baselines attributed
+      to a specific fault domain (correct answer: unknown).  Reference
+      methodology bar: <= 15%.
+    * ``abstain`` — fraction of noisy single-fault samples the
+      attributor sent to ``unknown`` instead of naming a domain.
+    """
 
     clean: float
     lognormal: dict[str, float] = field(default_factory=dict)
     gamma: dict[str, float] = field(default_factory=dict)
     variant_profiles: dict[str, float] = field(default_factory=dict)
+    false_alarm: dict[str, float] = field(default_factory=dict)
+    abstain: dict[str, float] = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return {
@@ -282,6 +456,8 @@ class HeldoutReport:
             "lognormal": self.lognormal,
             "gamma": self.gamma,
             "variant_profiles": self.variant_profiles,
+            "false_alarm": self.false_alarm,
+            "abstain": self.abstain,
         }
 
 
@@ -304,14 +480,33 @@ def heldout_report(
 
     base = _base_samples(TPU_SCENARIOS, count)
     variants = variant_samples(count)
+    healthy = baseline_samples(count * 4)
     report = HeldoutReport(clean=score(base))
     for sigma in sigmas:
         key = str(sigma)
-        report.lognormal[key] = score(corrupt(base, sigma, seed))
+        noisy_base = corrupt(base, sigma, seed)
+        faulted_preds = attributor.attribute_batch(noisy_base)
+        report.lognormal[key] = round(
+            macro_f1(noisy_base, faulted_preds).macro_f1, 4
+        )
         report.gamma[key] = score(
             corrupt(base, sigma, seed + 1, noise="gamma")
         )
         report.variant_profiles[key] = score(
             corrupt(variants, sigma, seed + 2)
+        )
+        noisy_healthy = corrupt(healthy, sigma, seed + 3)
+        healthy_preds = attributor.attribute_batch(noisy_healthy)
+        report.abstain[key] = round(
+            sum(
+                p.predicted_fault_domain == B.DOMAIN_UNKNOWN
+                for p in faulted_preds
+            ) / max(len(faulted_preds), 1), 4
+        )
+        report.false_alarm[key] = round(
+            sum(
+                p.predicted_fault_domain != B.DOMAIN_UNKNOWN
+                for p in healthy_preds
+            ) / max(len(healthy_preds), 1), 4
         )
     return report

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-from tpuslo.attribution.bayesian import BayesianAttributor
+from tpuslo.attribution.bayesian import DOMAIN_UNKNOWN, BayesianAttributor
 from tpuslo.attribution.mapper import (
     FaultSample,
     build_attribution,
@@ -135,6 +135,14 @@ def macro_f1(
     Multi-fault samples credit a true positive when the top-1 prediction
     matches any expected domain; the primary expected domain carries the
     support count.
+
+    An ``unknown`` prediction on a faulted sample is an ABSTENTION, not
+    a fault claim: it costs the true class a false negative (recall
+    drops) but does not manufacture an ``unknown`` false-positive class
+    — abstention frequency is scored by the separately published
+    abstain rate (``calibrate.heldout_report``), not as a stray class.
+    ``unknown`` still enters the macro when it has support (no-fault
+    samples), where false alarms hurt its recall.
     """
     tp: dict[str, int] = {}
     fp: dict[str, int] = {}
@@ -151,7 +159,8 @@ def macro_f1(
             tp[predicted] = tp.get(predicted, 0) + 1
             correct += 1
         else:
-            fp[predicted] = fp.get(predicted, 0) + 1
+            if predicted != DOMAIN_UNKNOWN:
+                fp[predicted] = fp.get(predicted, 0) + 1
             fn[primary] = fn.get(primary, 0) + 1
 
     if domains is None:

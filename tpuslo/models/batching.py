@@ -24,6 +24,7 @@ slots full converts that into aggregate throughput.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any
@@ -179,8 +180,6 @@ class ContinuousBatchingEngine:
         (the effective prompt is ``prefix + prompt``; only the suffix
         prefills at admission).
         """
-        import time
-
         req = _Request(
             self._next_id, prompt, max_new_tokens, stop_at_eos, prefix=prefix
         )
@@ -190,8 +189,6 @@ class ContinuousBatchingEngine:
         return req.request_id
 
     def _admit(self, slot: int, req: _Request) -> bool:
-        import time
-
         if req.ingested is None:
             req.ingested = self._ingest.ingest_prompt(req.prompt, req.prefix)
         logits, row_cache, total_len = req.ingested
@@ -261,8 +258,6 @@ class ContinuousBatchingEngine:
         self._tokens = next_tokens
         self.steps += 1
         values = jax.device_get(next_tokens).tolist()
-        import time
-
         for slot, req in enumerate(self._slots):
             if req is None:
                 continue  # parked lane: decoded garbage, discarded
