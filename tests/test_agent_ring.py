@@ -122,3 +122,67 @@ def test_agent_ring_mode_runs_ici_prober(tmp_path):
         if e.get("tpu", {}).get("program_id") == "icibench"
     ]
     assert len(ici) == 4  # one probe round, four collectives
+
+
+def test_agent_ring_mode_stamps_multihost_identity(tmp_path):
+    """--slice-id/--host-index/--xla-program-id flow into every TPU
+    event's TPURef — what slicecorr joins per-host agent streams on
+    (the multi-host e2e session's fan-out path)."""
+    from tpuslo.cli import agent
+    from tpuslo.collector.ringbuf import RingWriter
+
+    ring_path = str(tmp_path / "agent.buf")
+    out_path = str(tmp_path / "probes.jsonl")
+    writer = RingWriter(ring_path)
+
+    def produce():
+        time.sleep(0.3)
+        for launch in range(3):
+            writer.write_event(
+                signal=native.SIG_ICI_COLLECTIVE,
+                value=int(25.0 * 1e6),  # 25 ms as ns
+                ts_ns=time.time_ns(),
+                aux=launch,
+                tid=1,
+                flags=native.F_TPU,
+            )
+
+    producer = threading.Thread(target=produce)
+    producer.start()
+    rc = agent.main(
+        [
+            "--probe-source", "ring",
+            "--ring-path", ring_path,
+            "--count", "8",
+            "--interval-s", "0.15",
+            "--output", "jsonl",
+            "--jsonl-path", out_path,
+            "--node", "dist-host-1",
+            "--slice-id", "test-slice",
+            "--host-index", "1",
+            "--xla-program-id", "dist_psum",
+            "--signal-set", "ici_collective_latency_ms",
+            "--capability-mode", "tpu_full",
+            "--metrics-port", "0",
+            "--max-overhead-pct", "1000",
+        ]
+    )
+    producer.join()
+    assert rc == 0
+    events = [
+        json.loads(line)
+        for line in open(out_path).read().splitlines()
+        if line.strip()
+    ]
+    collectives = [
+        e for e in events if e["signal"] == "ici_collective_latency_ms"
+    ]
+    assert len(collectives) == 3
+    launches = set()
+    for event in collectives:
+        assert event["tpu"]["slice_id"] == "test-slice"
+        assert event["tpu"]["host_index"] == 1
+        assert event["tpu"]["program_id"] == "dist_psum"
+        assert abs(event["value"] - 25.0) < 1e-6
+        launches.add(event["tpu"]["launch_id"])
+    assert launches == {0, 1, 2}

@@ -277,3 +277,29 @@ def test_percentile_nearest_rank():
     assert _percentile([7.0], 0.95) == 7.0
     assert _percentile([], 0.95) == 0.0
     assert _percentile([3.0, 1.0, 2.0], 0.50) == 2.0
+
+
+def test_batch_saturation_lane_structure():
+    """Curve points carry tokens/s + KV fraction; the Pallas decision
+    publishes both arithmetic terms (HBM fraction, attention-vs-weight
+    MACs) so the build trigger is checkable."""
+    import jax
+
+    from tpuslo.benchmark.serving_bench import _batch_saturation_lane
+    from tpuslo.models.llama import init_params, llama_tiny
+
+    cfg = llama_tiny(max_seq_len=128)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    out = _batch_saturation_lane(
+        cfg, params, batches=(1, 2), block_size=32, timed_steps=2
+    )
+    assert [p["batch"] for p in out["curve"]] == [1, 2]
+    for point in out["curve"]:
+        assert point["tokens_per_sec"] > 0
+        assert 0 <= point["kv_read_fraction"] <= 1
+    assert 0 < out["flagship_kv_read_fraction_b2"] < 1
+    assert out["flagship_attn_vs_weight_macs"]["2"] > (
+        out["flagship_attn_vs_weight_macs"]["1"]
+    )
+    assert "decision_arithmetic" in out
+    assert "no-build" in out["pallas_decode_attention_decision"]

@@ -66,6 +66,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--service", default="rag-service")
     p.add_argument("--node", default="tpu-vm-0")
     p.add_argument("--probe-smoke", action="store_true")
+    # Multi-host identity for the ring loop's TPU events: a DaemonSet
+    # agent knows which slice/host it runs on; SliceJoiner joins
+    # per-host streams on exactly this identity.
+    p.add_argument("--slice-id", default="", help="TPU slice identity")
+    p.add_argument(
+        "--host-index", type=int, default=0,
+        help="this host's index within the slice",
+    )
+    p.add_argument(
+        "--xla-program-id", default="",
+        help="program identity stamped on collective probe events",
+    )
+    p.add_argument("--tpu-chip", default="accel0")
     p.add_argument(
         "--probe-source",
         default="synthetic",
@@ -372,6 +385,10 @@ def _run_ring_loop(
         container=args.workload,
         pid=1,
         tid=1,
+        tpu_chip=args.tpu_chip,
+        slice_id=args.slice_id,
+        host_index=args.host_index,
+        xla_program_id=args.xla_program_id,
     )
 
     if args.event_kind == "slo":

@@ -56,7 +56,31 @@ def worker_main(argv: list[str] | None = None) -> int:
     p.add_argument("--delay-ms", type=float, default=0.0)
     p.add_argument("--delayed-host", type=int, default=-1)
     p.add_argument("--slice-id", default="dist-slice")
+    p.add_argument(
+        "--ring-path", default="",
+        help="also write each measured event into this userspace ring "
+        "(the host's agent consumes it — the DaemonSet fan-out shape)",
+    )
+    p.add_argument(
+        "--hold-before-init-s", type=float, default=0.0,
+        help="pause between ring creation and jax.distributed init so "
+        "an orchestrator can attach per-host consumers first",
+    )
     args = p.parse_args(argv)
+
+    ring = None
+    if args.ring_path:
+        # Create the ring BEFORE the (slow) jax.distributed init and
+        # announce it: the consumer (this host's agent) attaches at the
+        # writer's HEAD, so it must be attached before the first
+        # measured launch — which cannot happen until every worker has
+        # joined the runtime and compiled, seconds from now.
+        from tpuslo.collector.ringbuf import RingWriter
+
+        ring = RingWriter(args.ring_path)
+        print(f"RING_READY:{args.ring_path}", flush=True)
+    if args.hold_before_init_s > 0:
+        time.sleep(args.hold_before_init_s)
 
     import jax
 
@@ -131,6 +155,23 @@ def worker_main(argv: list[str] | None = None) -> int:
             ),
         )
         print(json.dumps(event.to_dict()), flush=True)
+        if ring is not None:
+            # Wire format: ns value for _ms signals (native decode
+            # divides back), launch identity in aux, F_TPU so the
+            # consumer lifts it into a TPURef.
+            from tpuslo.collector import native
+
+            ring.write_event(
+                signal=native.SIG_ICI_COLLECTIVE,
+                value=int(wait_ms * 1e6),
+                ts_ns=event.ts_unix_nano,
+                aux=launch,
+                pid=os.getpid(),
+                tid=me,
+                flags=native.F_TPU,
+            )
+    if ring is not None:
+        ring.close()
     return 0
 
 
