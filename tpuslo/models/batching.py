@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from functools import partial
+from functools import lru_cache, partial
 from typing import Any
 
 import jax
@@ -61,6 +61,16 @@ def _inject_row(cache: PyTree, row: PyTree, slot: jax.Array) -> PyTree:
     v = jax.tree.map(splice, cache["v"], row["v"])
     lengths = cache["length"].at[slot].set(row["length"])
     return {"k": k, "v": v, "length": lengths}
+
+
+# Shared jitted kernels (see serve.py's shared-kernel note): one
+# compile cache per config across every engine instance.
+_SHARED_INJECT = jax.jit(_inject_row, donate_argnums=(0,))
+
+
+@lru_cache(maxsize=32)
+def _shared_batch_step_fn(cfg):
+    return jax.jit(partial(decode_step, cfg=cfg), donate_argnums=(2,))
 
 
 @dataclass
@@ -126,10 +136,8 @@ class ContinuousBatchingEngine:
             cfg=self.cfg, params=self.params, prefill_buckets=prefill_buckets,
             kv_dtype=kv_dtype,
         )
-        self._step = jax.jit(
-            partial(decode_step, cfg=self.cfg), donate_argnums=(2,)
-        )
-        self._inject = jax.jit(_inject_row, donate_argnums=(0,))
+        self._step = _shared_batch_step_fn(self.cfg)
+        self._inject = _SHARED_INJECT
 
         self._cache = self._init_decode_state()
         self._tokens = jnp.full((max_slots,), BOS, jnp.int32)

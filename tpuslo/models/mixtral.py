@@ -17,7 +17,7 @@ attribution engine keys on).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
+from functools import lru_cache, partial
 from typing import Any
 
 import jax
@@ -340,6 +340,19 @@ def decode_chunk(
     return jnp.swapaxes(toks, 0, 1), last, cache
 
 
+@lru_cache(maxsize=32)
+def _shared_moe_prefill_fn(cfg):
+    return jax.jit(partial(prefill, cfg=cfg), donate_argnums=(2,))
+
+
+@lru_cache(maxsize=32)
+def _shared_moe_decode_fn(cfg, num_tokens: int):
+    return jax.jit(
+        partial(decode_chunk, cfg=cfg, num_tokens=num_tokens),
+        donate_argnums=(2,),
+    )
+
+
 class MoEServeEngine:
     """Greedy streaming serving for the Mixtral family.
 
@@ -415,13 +428,9 @@ class MoEServeEngine:
             return cache
 
         self._init_cache = init_cache
-        self._prefill = jax.jit(
-            partial(prefill, cfg=self.cfg), donate_argnums=(2,)
-        )
-        self._decode = jax.jit(
-            partial(decode_chunk, cfg=self.cfg, num_tokens=self.decode_chunk_size),
-            donate_argnums=(2,),
-        )
+        # Shared jitted kernels (see serve.py's shared-kernel note).
+        self._prefill = _shared_moe_prefill_fn(self.cfg)
+        self._decode = _shared_moe_decode_fn(self.cfg, self.decode_chunk_size)
 
     def warmup(self) -> float:
         import time

@@ -37,7 +37,7 @@ bandwidth halving and the reservation elimination stack.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Any
 
 import jax
@@ -250,6 +250,23 @@ def paged_decode_step(
     return logits, state
 
 
+# Shared jitted kernels (see serve.py's shared-kernel note).
+@lru_cache(maxsize=32)
+def _shared_paged_step_fn(cfg, block_size: int):
+    return jax.jit(
+        partial(paged_decode_step, cfg=cfg, block_size=block_size),
+        donate_argnums=(2,),
+    )
+
+
+@lru_cache(maxsize=32)
+def _shared_inject_block_fn(cfg, block_size: int):
+    return jax.jit(
+        partial(inject_prompt_block, cfg=cfg, block_size=block_size),
+        donate_argnums=(0,),
+    )
+
+
 class PagedBatchingEngine(ContinuousBatchingEngine):
     """Continuous batching over a paged pool.
 
@@ -307,17 +324,9 @@ class PagedBatchingEngine(ContinuousBatchingEngine):
             prefill_buckets=prefill_buckets, quantize=quantize,
             kv_dtype=kv_dtype,
         )
-        self._paged_step = jax.jit(
-            partial(
-                paged_decode_step, cfg=self.cfg, block_size=self.block_size
-            ),
-            donate_argnums=(2,),
-        )
-        self._inject_block = jax.jit(
-            partial(
-                inject_prompt_block, cfg=self.cfg, block_size=self.block_size
-            ),
-            donate_argnums=(0,),
+        self._paged_step = _shared_paged_step_fn(self.cfg, self.block_size)
+        self._inject_block = _shared_inject_block_fn(
+            self.cfg, self.block_size
         )
 
     # -- hooks -----------------------------------------------------------

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass
-from functools import partial
+from functools import lru_cache, partial
 from typing import Iterator
 
 import jax
@@ -71,6 +71,141 @@ def suffix_prefill(params, tokens, kv, start, true_length, cfg):
         + jnp.asarray(true_length, jnp.int32),
     }
     return last, cache
+
+
+def forced_logits(engine, ids: list[int]):
+    """Next-token logits after teacher-forcing ``ids`` through the
+    engine's own (possibly sharded) prefill path.  Returns f32
+    ``(vocab,)``.  Works for both the dense :class:`ServeEngine`
+    (``prefill_ids``) and the MoE engine (bucketed ``_prefill``)."""
+    if len(ids) > engine.prefill_buckets[-1]:
+        raise ValueError(
+            f"forced sequence of {len(ids)} ids exceeds the largest "
+            f"prefill bucket {engine.prefill_buckets[-1]}; parity "
+            "checking past one bucket is not supported"
+        )
+    if hasattr(engine, "prefill_ids"):
+        logits, _cache = engine.prefill_ids(list(ids))
+        return logits[0].astype(jnp.float32)
+    bucket = _bucket(len(ids), engine.prefill_buckets)
+    tokens = jnp.asarray([list(ids) + [0] * (bucket - len(ids))], jnp.int32)
+    logits, _cache = engine._prefill(
+        engine.params, tokens, engine._init_cache(1),
+        true_length=jnp.asarray(len(ids), jnp.int32),
+    )
+    return logits[0].astype(jnp.float32)
+
+
+def _generation_prompt_ids(engine, prompt: str) -> list[int]:
+    """The exact prompt ids ``engine.generate`` would decode from —
+    truncation rules differ between the dense and MoE engines, and a
+    parity check teacher-forcing a DIFFERENT context than the one that
+    produced the tokens would silently verify nothing."""
+    if hasattr(engine, "prefill_ids"):
+        return encode_bytes(prompt, max(1, engine.cfg.max_seq_len - 2))
+    chunk = engine.decode_chunk_size
+    max_prompt = max(
+        1,
+        min(engine.prefill_buckets[-1], engine.cfg.max_seq_len - chunk - 1),
+    )
+    return encode_bytes(prompt, max_prompt)
+
+
+def stream_parity(
+    sharded,
+    plain,
+    prompt: str,
+    max_new_tokens: int = 6,
+    atol: float = 7.5e-2,
+) -> dict:
+    """Unconditional tensor-parallel parity evidence in LOGIT space.
+
+    Token-prefix comparisons (rounds 1-3) had to stop short of the
+    full stream because psum reassociation can flip a near-tied argmax
+    on a random-init model.  This pins the entire stream instead:
+    teacher-force the sharded engine's tokens through BOTH engines'
+    prefill paths and require per-position logits within ``atol``; a
+    token divergence is only accepted when the unsharded logits' top-2
+    margin at that position is under ``2*atol`` — a genuine tie, where
+    greedy argmax is not a well-defined function of the model.
+
+    Returns a report dict; ``ok`` is the unconditional verdict.
+    """
+    s_tokens = [
+        e.token_id
+        for e in sharded.generate(prompt, max_new_tokens, stop_at_eos=False)
+    ]
+    p_tokens = [
+        e.token_id
+        for e in plain.generate(prompt, max_new_tokens, stop_at_eos=False)
+    ]
+    ids = _generation_prompt_ids(plain, prompt)
+    sharded_ids = _generation_prompt_ids(sharded, prompt)
+    if sharded_ids != ids:
+        raise ValueError(
+            "engines truncate the prompt differently; parity over "
+            "mismatched contexts is meaningless"
+        )
+    ok = True
+    max_diff = 0.0
+    diverged_at = None
+    tie_margin = None
+    for k in range(len(s_tokens)):
+        forced = ids + s_tokens[:k]
+        ls = forced_logits(sharded, forced)
+        lp = forced_logits(plain, forced)
+        diff = float(jnp.max(jnp.abs(ls - lp)))
+        max_diff = max(max_diff, diff)
+        if diff >= atol:
+            ok = False
+        if (
+            diverged_at is None
+            and k < len(p_tokens)
+            and s_tokens[k] != p_tokens[k]
+        ):
+            diverged_at = k
+            top2 = jnp.sort(lp)[-2:]
+            tie_margin = float(top2[1] - top2[0])
+            if tie_margin >= 2 * atol:
+                ok = False  # a decisive margin must not flip
+    return {
+        "ok": ok,
+        "tokens_sharded": s_tokens,
+        "tokens_plain": p_tokens,
+        "max_logit_diff": round(max_diff, 5),
+        "diverged_at": diverged_at,
+        "tie_margin": None if tie_margin is None else round(tie_margin, 5),
+    }
+
+
+# --- shared jitted kernels ------------------------------------------------
+#
+# One jitted callable per (config, static args), shared by every engine
+# instance: jax's executable cache is keyed by the jit wrapper's
+# identity, so per-instance ``jax.jit(partial(...))`` wrappers recompile
+# identical programs for every engine built over the same config.
+# LlamaConfig is frozen (hashable); sharded and unsharded engines share
+# a wrapper safely — argument shardings key separate executable entries
+# inside it.
+
+
+@lru_cache(maxsize=32)
+def _shared_prefill_fn(cfg):
+    return jax.jit(partial(prefill, cfg=cfg), donate_argnums=(2,))
+
+
+@lru_cache(maxsize=32)
+def _shared_decode_chunk_fn(cfg, num_tokens: int):
+    return jax.jit(
+        partial(decode_chunk, cfg=cfg, num_tokens=num_tokens),
+        donate_argnums=(2,),
+        static_argnames=("sampling",),
+    )
+
+
+@lru_cache(maxsize=32)
+def _shared_suffix_prefill_fn(cfg):
+    return jax.jit(partial(suffix_prefill, cfg=cfg), donate_argnums=(2,))
 
 
 @dataclass
@@ -253,14 +388,15 @@ class ServeEngine:
         chunk_cap = (self.cfg.max_seq_len - 2) // 2
         self.decode_chunk_size = max(1, min(decode_chunk_size, chunk_cap))
         # Donate the KV cache: decode updates it in place instead of
-        # copying (L, B, S_max, KV, HD) buffers every token.
-        self._prefill = jax.jit(partial(prefill, cfg=self.cfg), donate_argnums=(2,))
-        self._decode_chunk = jax.jit(
-            partial(
-                decode_chunk, cfg=self.cfg, num_tokens=self.decode_chunk_size
-            ),
-            donate_argnums=(2,),
-            static_argnames=("sampling",),
+        # copying (L, B, S_max, KV, HD) buffers every token.  The
+        # jitted callables are MEMOIZED per config (LlamaConfig is
+        # frozen/hashable): every engine over the same config shares
+        # one compile cache instead of re-tracing per instance — the
+        # compile time that made multi-engine benches and the test
+        # suite's slow lane grow round over round.
+        self._prefill = _shared_prefill_fn(self.cfg)
+        self._decode_chunk = _shared_decode_chunk_fn(
+            self.cfg, self.decode_chunk_size
         )
         # Tail path for prompts that leave less than one chunk of KV
         # budget: single-token chunks use every remaining slot instead
@@ -278,9 +414,7 @@ class ServeEngine:
         # can exceed the 100ms heuristic without any compile).
         self._seen_shapes: set[tuple[str, int]] = set()
         self.prefix_cache_max = 4
-        self._suffix_prefill = jax.jit(
-            partial(suffix_prefill, cfg=self.cfg), donate_argnums=(2,)
-        )
+        self._suffix_prefill = _shared_suffix_prefill_fn(self.cfg)
 
 
     def _new_cache(self, batch: int):
@@ -294,22 +428,21 @@ class ServeEngine:
             # First short-budget request pays this compile; record it
             # so the engine's own compile telemetry (the recompile-storm
             # signal this toolkit attributes) sees the TTFT spike.
+            # With shared kernels the callable may already be warm
+            # (another engine over this config compiled it), so only a
+            # genuinely slow first hit is recorded — the same >100 ms
+            # heuristic _record_compile uses.
             start = time.perf_counter()
-            self._decode_one = jax.jit(
-                partial(decode_chunk, cfg=self.cfg, num_tokens=1),
-                donate_argnums=(2,),
-                static_argnames=("sampling",),
-            )
+            self._decode_one = _shared_decode_chunk_fn(self.cfg, 1)
             tokens = jnp.zeros((1,), jnp.int32)
             cache = self._new_cache(1)
             toks, _last, _ = self._decode_one(self.params, tokens, cache)
             jax.block_until_ready(toks)
-            self.compile_events.append(
-                {
-                    "bucket": "decode_tail",
-                    "compile_ms": (time.perf_counter() - start) * 1000.0,
-                }
-            )
+            elapsed_ms = (time.perf_counter() - start) * 1000.0
+            if elapsed_ms > 100.0:
+                self.compile_events.append(
+                    {"bucket": "decode_tail", "compile_ms": elapsed_ms}
+                )
         return self._decode_one
 
     def warmup(self, bucket: int | None = None, include_tail: bool = False) -> float:
