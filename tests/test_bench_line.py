@@ -302,4 +302,4 @@ def test_batch_saturation_lane_structure():
         out["flagship_attn_vs_weight_macs"]["1"]
     )
     assert "decision_arithmetic" in out
-    assert "no-build" in out["pallas_decode_attention_decision"]
+    assert "XLA path at batch <= 8" in out["pallas_decode_attention_decision"]

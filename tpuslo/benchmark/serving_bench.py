@@ -400,9 +400,12 @@ def _batch_saturation_lane(
             str(b): round(attn_vs_weight_macs(flagship, b), 3)
             for b in batches
         },
-        "pallas_decode_attention_decision": "no-build at batch <= 8 "
-        "(measured tokens/s peak); build before serving batch >= 16 "
-        "becomes a target",
+        "pallas_decode_attention_decision": "XLA path at batch <= 8 "
+        "(measured tokens/s peak); the block-sparse kernel is BUILT "
+        "and opt-in (tpuslo/ops/paged_attention.py, "
+        "PagedBatchingEngine(pallas_attention=True) or "
+        "TPUSLO_PAGED_PALLAS=1) for batch >= 16 — interpret-mode "
+        "parity-tested, awaiting a live chip for measurement",
         "decision_arithmetic": (
             f"two terms: (a) KV HBM reads a fused kernel could hide "
             f"are {f_fraction:.0%} of per-step bytes on the flagship "

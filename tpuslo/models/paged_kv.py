@@ -111,6 +111,41 @@ def inject_prompt_block(
     }
 
 
+def pool_visibility_mask(
+    page_table: jax.Array, lengths: jax.Array, n_blocks: int,
+    block_size: int,
+) -> jax.Array:
+    """Per-lane ownership+causality mask over the physical pool.
+
+    ``(B, n_blocks * block_size)`` bool: pool slot (n, s) is visible to
+    lane b iff lane b owns physical block n as logical block j (via its
+    page table) and the absolute position ``j*block_size + s`` is at or
+    before the lane's current length (its own just-written token is
+    visible: position == length).  The ownership map is built by
+    scattering column indices through the page table; every unallocated
+    entry points at null block 0, so column 0 collects arbitrary
+    duplicates — overwritten with -1 (the allocator never hands block 0
+    to a live request).  Single source of truth for both the XLA
+    physical-pool attention and the Pallas kernel's parity reference.
+    """
+    B, MB = page_table.shape
+    lane = jnp.arange(B, dtype=jnp.int32)[:, None]
+    logical = jnp.broadcast_to(
+        jnp.arange(MB, dtype=jnp.int32)[None, :], (B, MB)
+    )
+    inv = jnp.full((B, n_blocks), -1, jnp.int32).at[
+        lane, page_table
+    ].set(logical)
+    inv = inv.at[:, 0].set(-1)
+    abs_pos = inv[:, :, None] * block_size + jnp.arange(
+        block_size, dtype=jnp.int32
+    )[None, None, :]  # (B, N, BS)
+    visible = (
+        (inv[:, :, None] >= 0) & (abs_pos <= lengths[:, None, None])
+    )
+    return visible.reshape(B, n_blocks * block_size)
+
+
 def _pool_attention(
     q: jax.Array, k: jax.Array, v: jax.Array, visible: jax.Array,
     n_rep: int,
@@ -150,7 +185,7 @@ def _pool_attention(
 
 def paged_decode_step(
     params: PyTree, token: jax.Array, state: PyTree, cfg: LlamaConfig,
-    block_size: int,
+    block_size: int, pallas: bool = False,
 ) -> tuple[jax.Array, PyTree]:
     """One decode token for every slot against the paged pool.
 
@@ -162,6 +197,13 @@ def paged_decode_step(
     ownership mask (:func:`_pool_attention`) — no per-lane gather, so
     the pool's KV bytes are read once per step for ALL lanes instead
     of being copied out per lane.
+
+    ``pallas=True`` swaps in the block-sparse Pallas kernel
+    (:mod:`tpuslo.ops.paged_attention`): each lane reads only its own
+    blocks through scalar-prefetched page-table indices — O(lane
+    context) instead of O(pool) per lane, the recorded prerequisite
+    for batch >= 16 serving (see the batch-saturation lane's decision
+    arithmetic).
     """
     B = token.shape[0]
     pos = state["length"]  # (B,)
@@ -182,28 +224,9 @@ def paged_decode_step(
     cos, sin = rope_frequencies(cfg, positions)
     H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
-    # Ownership map, shared by every layer: inv[b, n] = logical block
-    # index of physical block n for lane b, -1 when unowned.  Built by
-    # scattering column indices through the page table; every
-    # unallocated table entry points at null block 0, so column 0
-    # collects arbitrary duplicates — overwritten with -1 below (the
-    # allocator never hands block 0 to a live request).
     # Pool leaves are (L, N, BS, ...) outside the scan: N is axis 1.
     n_blocks = jax.tree.leaves(state["k"])[0].shape[1]
-    lane = jnp.arange(B, dtype=jnp.int32)[:, None]
-    logical = jnp.broadcast_to(
-        jnp.arange(MB, dtype=jnp.int32)[None, :], (B, MB)
-    )
-    inv = jnp.full((B, n_blocks), -1, jnp.int32).at[lane, pt].set(logical)
-    inv = inv.at[:, 0].set(-1)
-    # Absolute position of pool slot (n, s) for lane b, causally masked
-    # against the lane's current length (its own just-written token is
-    # visible: position == pos).
-    abs_pos = inv[:, :, None] * block_size + jnp.arange(
-        block_size, dtype=jnp.int32
-    )[None, None, :]  # (B, N, BS)
-    visible = ((inv[:, :, None] >= 0) & (abs_pos <= pos[:, None, None]))
-    visible = visible.reshape(B, n_blocks * block_size)
+    visible = pool_visibility_mask(pt, pos, n_blocks, block_size)
 
     def write(pool, new):
         # new: (B, KV, HD) -> scatter one (phys, off) slot per row.
@@ -231,9 +254,19 @@ def paged_decode_step(
         k = apply_rope(k, cos, sin)
         k_pool = write(k_pool, k[:, 0])
         v_pool = write(v_pool, v[:, 0])
-        attn = _pool_attention(
-            q[:, 0], load(k_pool), load(v_pool), visible, H // KV
-        )
+        if pallas:
+            from tpuslo.ops.paged_attention import paged_decode_attention
+
+            attn = paged_decode_attention(
+                q[:, 0], k_pool, v_pool, pt, pos,
+                block_size=block_size,
+                out_dtype=cfg.dtype,
+                interpret=jax.default_backend() != "tpu",
+            )
+        else:
+            attn = _pool_attention(
+                q[:, 0], load(k_pool), load(v_pool), visible, H // KV
+            )
         h = h + _matmul(attn.reshape(B, 1, H * HD), layer["wo"])
         x = rms_norm(h, layer["mlp_norm"], cfg.norm_eps)
         gate = jax.nn.silu(_matmul(x, layer["w1"]).astype(jnp.float32))
@@ -252,9 +285,11 @@ def paged_decode_step(
 
 # Shared jitted kernels (see serve.py's shared-kernel note).
 @lru_cache(maxsize=32)
-def _shared_paged_step_fn(cfg, block_size: int):
+def _shared_paged_step_fn(cfg, block_size: int, pallas: bool = False):
     return jax.jit(
-        partial(paged_decode_step, cfg=cfg, block_size=block_size),
+        partial(
+            paged_decode_step, cfg=cfg, block_size=block_size, pallas=pallas
+        ),
         donate_argnums=(2,),
     )
 
@@ -288,7 +323,15 @@ class PagedBatchingEngine(ContinuousBatchingEngine):
         prefill_buckets: tuple[int, ...] = (32, 64, 128, 256),
         quantize: bool = False,
         kv_dtype: str = "bf16",
+        pallas_attention: bool | None = None,
     ):
+        import os
+
+        if pallas_attention is None:
+            pallas_attention = os.environ.get(
+                "TPUSLO_PAGED_PALLAS", ""
+            ) == "1"
+        self.pallas_attention = pallas_attention
         self.block_size = block_size
         from tpuslo.models.llama import llama_tiny
 
@@ -324,7 +367,9 @@ class PagedBatchingEngine(ContinuousBatchingEngine):
             prefill_buckets=prefill_buckets, quantize=quantize,
             kv_dtype=kv_dtype,
         )
-        self._paged_step = _shared_paged_step_fn(self.cfg, self.block_size)
+        self._paged_step = _shared_paged_step_fn(
+            self.cfg, self.block_size, pallas=self.pallas_attention
+        )
         self._inject_block = _shared_inject_block_fn(
             self.cfg, self.block_size
         )

@@ -1,0 +1,221 @@
+"""Block-sparse paged decode attention as a Pallas TPU kernel.
+
+The batch-saturation lane (``serving_bench._batch_saturation_lane``)
+closed the round-3 Pallas deferral with arithmetic: the XLA
+physical-pool attention in :mod:`tpuslo.models.paged_kv` scores
+O(B * pool) rows per step — every lane against every pool block, with
+masking doing the ownership — which is 39% of the weight matmul MACs
+at batch 8 on the flagship and 156% at batch 32 (the measured curve's
+b=32 regression).  This kernel is the recorded prerequisite for
+serving at batch >= 16: each lane reads ONLY ITS OWN blocks.
+
+Design (the vLLM-style paged attention pattern, TPU-native):
+
+* grid ``(B, KV, MB)`` — lane x kv-head x logical block, the block
+  dimension innermost so VMEM scratch (online-softmax running max,
+  normalizer, accumulator) carries across one lane's blocks;
+* the page table and per-lane lengths ride SCALAR PREFETCH
+  (``pltpu.PrefetchScalarGridSpec``): the K/V BlockSpec index maps
+  look up ``page_table[b, j]`` to fetch the lane's physical block —
+  data-dependent block indices, the thing plain BlockSpecs cannot do;
+* blocks past the lane's length are skipped outright via ``pl.when``
+  (not just masked): per-step work is O(lane's live context), so the
+  O(B*pool) term the arithmetic flagged is gone;
+* grouped-query attention comes from the q layout ``(B, KV, n_rep,
+  HD)`` — each program scores its kv-head's ``n_rep`` query heads
+  against one physical block;
+* int8 pools dequantize IN the kernel: the q/scale leaves are passed
+  as separate refs, so HBM traffic stays int8 and only the VMEM tile
+  widens to f32.
+
+Off by default in the engine (the measured curve says XLA wins at the
+b<=8 operating point); enable with ``PagedBatchingEngine(
+pallas_attention=True)`` or ``TPUSLO_PAGED_PALLAS=1`` for b>=16
+serving.  ``interpret=True`` runs the same kernel on CPU (tests).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+NEG_INF = float("-inf")
+
+
+def _paged_kernel(
+    pt_ref,  # scalar prefetch: (B, MB) int32 page table
+    len_ref,  # scalar prefetch: (B,) int32 lane lengths
+    q_ref,  # (1, 1, n_rep, HD)
+    k_ref,  # (1, BS, 1, HD) — the lane's j-th physical block
+    v_ref,
+    o_ref,  # (1, 1, n_rep, HD)
+    m_scratch,
+    l_scratch,
+    acc_scratch,
+    *,
+    scale: float,
+    block_size: int,
+    num_blocks: int,
+    k_scale_ref=None,
+    v_scale_ref=None,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    pos = len_ref[b]
+    # A lane's live context occupies logical blocks [0, pos // BS]; its
+    # current token sits at pos and is visible (wrote its KV already).
+    relevant = j * block_size <= pos
+
+    @pl.when(relevant)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)  # (n_rep, HD)
+        k = k_ref[0, :, 0].astype(jnp.float32)  # (BS, HD)
+        v = v_ref[0, :, 0].astype(jnp.float32)
+        if k_scale_ref is not None:
+            k = k * k_scale_ref[0, :, 0].astype(jnp.float32)[:, None]
+            v = v * v_scale_ref[0, :, 0].astype(jnp.float32)[:, None]
+
+        s = (
+            lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # (n_rep, BS)
+        abs_pos = j * block_size + lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        s = jnp.where(abs_pos <= pos, s, NEG_INF)
+
+        m_prev = m_scratch[:, 0]
+        l_prev = l_scratch[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        alpha = jnp.where(m_prev == NEG_INF, 0.0, jnp.exp(m_prev - m_new))
+        p = jnp.where(abs_pos <= pos, jnp.exp(s - m_new[:, None]), 0.0)
+        l_scratch[:] = jnp.broadcast_to(
+            (alpha * l_prev + jnp.sum(p, axis=-1))[:, None], l_scratch.shape
+        )
+        acc_scratch[:] = acc_scratch[:] * alpha[:, None] + lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scratch[:] = jnp.broadcast_to(m_new[:, None], m_scratch.shape)
+
+    @pl.when(j == num_blocks - 1)
+    def _epilogue():
+        l_final = l_scratch[:, 0]
+        denom = jnp.where(l_final == 0.0, 1.0, l_final)
+        o_ref[0, 0] = (acc_scratch[:] / denom[:, None]).astype(o_ref.dtype)
+
+
+def paged_decode_attention(
+    q: jax.Array,
+    k_pool,
+    v_pool,
+    page_table: jax.Array,
+    lengths: jax.Array,
+    *,
+    block_size: int,
+    out_dtype=None,
+    interpret: bool = False,
+) -> jax.Array:
+    """One decode query per lane against its own pool blocks.
+
+    q: ``(B, H, HD)``; pools: ``(N, BS, KV, HD)`` arrays or int8
+    ``{"q": (N, BS, KV, HD) int8, "s": (N, BS, KV) scales}``;
+    page_table: ``(B, MB)`` int32 physical indices (0 = null block);
+    lengths: ``(B,)`` current per-lane positions (the step's token is
+    at ``lengths`` and already written).  Returns ``(B, H, HD)``.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, HD = q.shape
+    quantized = isinstance(k_pool, dict)
+    kq = k_pool["q"] if quantized else k_pool
+    KV = kq.shape[2]
+    n_rep = H // KV
+    MB = page_table.shape[1]
+    if out_dtype is None:
+        out_dtype = q.dtype
+
+    # (B, KV, n_rep, HD): kv-head becomes a grid row, its grouped query
+    # heads stay together in one block.
+    qt = q.reshape(B, KV, n_rep, HD)
+
+    def q_index(b, g, j, pt, lens):
+        return (b, g, 0, 0)
+
+    def _live_block(b, j, pt, lens):
+        # Clamp to the lane's last LIVE block: pl.when skips only the
+        # COMPUTE of out-of-range iterations, not the pipeline's block
+        # copy — without the clamp Pallas would DMA every ALLOCATED
+        # block (the request's whole token budget) per step.  Repeating
+        # the previous index lets the pipeline elide the fetch, which
+        # is what makes per-step HBM O(lane's live context).
+        return pt[b, jnp.minimum(j, lens[b] // block_size)]
+
+    def kv_index(b, g, j, pt, lens):
+        return (_live_block(b, j, pt, lens), 0, g, 0)
+
+    def scale_index(b, g, j, pt, lens):
+        return (_live_block(b, j, pt, lens), 0, g)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, n_rep, HD), q_index),
+        pl.BlockSpec((1, block_size, 1, HD), kv_index),
+        pl.BlockSpec((1, block_size, 1, HD), kv_index),
+    ]
+    operands = [qt, kq, v_pool["q"] if quantized else v_pool]
+    if not quantized:
+        kernel = functools.partial(
+            _paged_kernel,
+            scale=HD**-0.5,
+            block_size=block_size,
+            num_blocks=MB,
+        )
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, block_size, 1), scale_index),
+            pl.BlockSpec((1, block_size, 1), scale_index),
+        ]
+        operands += [k_pool["s"], v_pool["s"]]
+
+        def kernel(pt, lens, q_r, k_r, v_r, ks_r, vs_r, o_r, m, l, acc):  # noqa: E501
+            return _paged_kernel(
+                pt, lens, q_r, k_r, v_r, o_r, m, l, acc,
+                scale=HD**-0.5,
+                block_size=block_size,
+                num_blocks=MB,
+                k_scale_ref=ks_r,
+                v_scale_ref=vs_r,
+            )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, KV, MB),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, n_rep, HD), q_index),
+        scratch_shapes=[
+            pltpu.VMEM((n_rep, 128), jnp.float32),
+            pltpu.VMEM((n_rep, 128), jnp.float32),
+            pltpu.VMEM((n_rep, HD), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KV, n_rep, HD), out_dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32), *operands)
+    return out.reshape(B, H, HD)
