@@ -111,31 +111,36 @@ class ContinuousBatchingEngine:
         prefill_buckets: tuple[int, ...] = (32, 64, 128, 256),
         quantize: bool = False,
         kv_dtype: str = "bf16",
+        mesh=None,
     ):
         from tpuslo.models.llama import init_params, init_params_quantized
 
         self.kv_dtype = kv_dtype
         self.cfg = cfg or llama_tiny(max_seq_len=512)
-        if params is None:
+        self.mesh = mesh
+        if params is None and mesh is None:
             params = (
                 init_params_quantized(jax.random.PRNGKey(rng_seed), self.cfg)
                 if quantize
                 else init_params(jax.random.PRNGKey(rng_seed), self.cfg)
             )
-        self.params = params
         if max_slots < 1:
             raise ValueError("max_slots must be >= 1")
         self.max_slots = max_slots
 
         # Prompt ingestion delegates to a ServeEngine sharing the same
         # params: one bucketed-prefill pipeline (and one set of compile
-        # caches) for both serving styles.
+        # caches) for both serving styles.  With a mesh, the ingest
+        # engine owns the Megatron sharding (shard-direct init when no
+        # params were passed) and this engine adopts its params.
         from tpuslo.models.serve import ServeEngine
 
         self._ingest = ServeEngine(
-            cfg=self.cfg, params=self.params, prefill_buckets=prefill_buckets,
-            kv_dtype=kv_dtype,
+            cfg=self.cfg, params=params, prefill_buckets=prefill_buckets,
+            kv_dtype=kv_dtype, mesh=mesh, rng_seed=rng_seed,
+            quantize=quantize and params is None,
         )
+        self.params = params = self._ingest.params
         self._step = _shared_batch_step_fn(self.cfg)
         self._inject = _SHARED_INJECT
 
@@ -156,6 +161,12 @@ class ContinuousBatchingEngine:
     def _init_decode_state(self) -> PyTree:
         cache = init_kv_cache(self.cfg, self.max_slots, kv_dtype=self.kv_dtype)
         cache["length"] = jnp.zeros((self.max_slots,), jnp.int32)
+        if self.mesh is not None:
+            from tpuslo.models.serve import kv_cache_shardings
+
+            cache = jax.device_put(
+                cache, kv_cache_shardings(self.mesh, self.kv_dtype)
+            )
         return cache
 
     def _install_row(self, slot: int, row_cache: PyTree, req: _Request) -> bool:

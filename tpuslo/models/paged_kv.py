@@ -58,6 +58,23 @@ from tpuslo.models.llama import (
 PyTree = Any
 
 
+def paged_pool_shardings(mesh, kv_dtype: str = "bf16"):
+    """Pool (L, N, BS, KV, HD): shard KV heads over tp — each chip
+    holds its heads' slice of every physical block, so block
+    allocation stays a host-side free list while the KV bytes scale
+    with the mesh.  The KV-head axis sits at the same rank position as
+    the dense cache's, so the k/v/length specs are exactly the serve
+    engine's; only the (replicated) page table is new."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpuslo.models.serve import kv_cache_shardings
+
+    return {
+        **kv_cache_shardings(mesh, kv_dtype),
+        "page_table": NamedSharding(mesh, P()),
+    }
+
+
 def init_paged_pool(
     cfg: LlamaConfig, n_blocks: int, block_size: int,
     slots: int, kv_dtype: str = "bf16",
@@ -323,14 +340,23 @@ class PagedBatchingEngine(ContinuousBatchingEngine):
         prefill_buckets: tuple[int, ...] = (32, 64, 128, 256),
         quantize: bool = False,
         kv_dtype: str = "bf16",
+        mesh=None,
         pallas_attention: bool | None = None,
     ):
         import os
 
         if pallas_attention is None:
-            pallas_attention = os.environ.get(
+            # Env opt-in applies to single-device pools only: a
+            # fleet-wide TPUSLO_PAGED_PALLAS=1 must not break tp
+            # engines, whose path is the XLA physical-pool attention.
+            pallas_attention = mesh is None and os.environ.get(
                 "TPUSLO_PAGED_PALLAS", ""
             ) == "1"
+        if pallas_attention and mesh is not None:
+            raise ValueError(
+                "pallas_attention currently supports single-device pools "
+                "only; the tp path uses the XLA physical-pool attention"
+            )
         self.pallas_attention = pallas_attention
         self.block_size = block_size
         from tpuslo.models.llama import llama_tiny
@@ -365,7 +391,7 @@ class PagedBatchingEngine(ContinuousBatchingEngine):
         super().__init__(
             cfg=cfg, params=params, max_slots=max_slots, rng_seed=rng_seed,
             prefill_buckets=prefill_buckets, quantize=quantize,
-            kv_dtype=kv_dtype,
+            kv_dtype=kv_dtype, mesh=mesh,
         )
         self._paged_step = _shared_paged_step_fn(
             self.cfg, self.block_size, pallas=self.pallas_attention
@@ -381,6 +407,10 @@ class PagedBatchingEngine(ContinuousBatchingEngine):
             self.cfg, self.n_blocks, self.block_size, self.max_slots,
             kv_dtype=self.kv_dtype,
         )
+        if self.mesh is not None:
+            state = jax.device_put(
+                state, paged_pool_shardings(self.mesh, self.kv_dtype)
+            )
         # Block 0 is the null target of unallocated page-table entries.
         self._free = list(range(1, self.n_blocks))
         self._slot_blocks = [[] for _ in range(self.max_slots)]
