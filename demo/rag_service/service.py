@@ -110,6 +110,16 @@ class StubBackend:
             time.sleep(cadence_ms / 1000.0)
 
 
+def _batched_env_config():
+    """(paged, kv_dtype) from the batching-engine TPUSLO_SERVE_* knobs —
+    parsed here, next to the other serve knobs, so they mean the same
+    thing for every backend that grows a batched path."""
+    return (
+        os.environ.get("TPUSLO_SERVE_PAGED", "") == "1",
+        os.environ.get("TPUSLO_SERVE_KV", "bf16"),
+    )
+
+
 def _serve_env_config():
     """(cfg, mesh, quantize) from the TPUSLO_SERVE_* env knobs.
 
@@ -229,7 +239,10 @@ class JaxMoEBackend:
 
 class JaxBatchedBackend:
     """Continuous-batching JAX backend: concurrent requests share one
-    slot pool (:class:`tpuslo.models.batching.ContinuousBatchingEngine`).
+    slot pool (:class:`tpuslo.models.batching.ContinuousBatchingEngine`,
+    or the paged pool / tensor-parallel variants — ``TPUSLO_SERVE_PAGED=1``
+    serves through :class:`~tpuslo.models.paged_kv.PagedBatchingEngine`,
+    composing with ``TPUSLO_SERVE_TP`` and ``TPUSLO_SERVE_KV=int8``).
 
     Handler threads cooperate on one lock: whoever holds it advances
     the whole batch one step, so simultaneous requests ride the same
@@ -243,16 +256,21 @@ class JaxBatchedBackend:
 
     def __init__(self, engine=None, max_slots: int = 4):
         if engine is None:
-            from tpuslo.models.batching import ContinuousBatchingEngine
-
             cfg, mesh, quantize = _serve_env_config()
-            if mesh is not None:
-                raise ValueError(
-                    "TPUSLO_SERVE_TP is not supported by jax_batched yet; "
-                    "use --backend jax for tensor-parallel serving"
-                )
-            engine = ContinuousBatchingEngine(
-                cfg=cfg, max_slots=max_slots, quantize=quantize
+            paged, kv_dtype = _batched_env_config()
+            if paged:
+                # Paged pool: concurrency decoupled from max_seq_len at
+                # equal KV HBM; composes with int8 KV and the tp mesh.
+                from tpuslo.models.paged_kv import PagedBatchingEngine
+
+                engine_cls = PagedBatchingEngine
+            else:
+                from tpuslo.models.batching import ContinuousBatchingEngine
+
+                engine_cls = ContinuousBatchingEngine
+            engine = engine_cls(
+                cfg=cfg, max_slots=max_slots, quantize=quantize,
+                mesh=mesh, kv_dtype=kv_dtype,
             )
             # Front-load the prefill-bucket and per-row decode compiles
             # (JaxBackend's warmup() equivalent).

@@ -285,3 +285,46 @@ def test_jax_moe_backend_rejects_llama_model_env(monkeypatch):
     monkeypatch.setenv("TPUSLO_SERVE_MODEL", "llama3_8b")
     with pytest.raises(ValueError, match="jax_batched"):
         JaxMoEBackend()
+
+
+@pytest.mark.slow
+def test_jax_batched_backend_paged_tp(monkeypatch):
+    """TPUSLO_SERVE_PAGED=1 + TPUSLO_SERVE_TP=2: the demo service runs
+    concurrent requests through the tensor-parallel PAGED engine —
+    the full round-4 serving composition behind the observed workload."""
+    import threading
+
+    from demo.rag_service.service import JaxBatchedBackend, RagService
+    from tpuslo.models.paged_kv import PagedBatchingEngine
+
+    monkeypatch.setenv("TPUSLO_SERVE_PAGED", "1")
+    monkeypatch.setenv("TPUSLO_SERVE_TP", "2")
+    monkeypatch.setenv("TPUSLO_SERVE_MODEL", "llama_tiny")
+    backend = JaxBatchedBackend(max_slots=2)
+    assert isinstance(backend.engine, PagedBatchingEngine)
+    assert backend.engine.mesh is not None
+
+    service = RagService(backend=backend, seed=1)
+    outputs: dict[int, list] = {}
+    errors: list[BaseException] = []
+
+    def drive(i):
+        try:
+            outputs[i] = list(service.chat(f"query {i}", profile="chat_short"))
+        except BaseException as exc:  # noqa: BLE001 - surfaced below
+            errors.append(exc)
+
+    threads = [
+        threading.Thread(target=drive, args=(i,), daemon=True)
+        for i in range(3)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+        assert not t.is_alive(), "paged tp backend deadlocked"
+    assert not errors, errors
+    assert len(outputs) == 3
+    for i, events in outputs.items():
+        kinds = [e.get("type") for e in events]
+        assert "token" in kinds and kinds[-1] == "summary", i
