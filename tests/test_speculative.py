@@ -158,3 +158,25 @@ def test_speculative_near_capacity_exact():
 # Compile-heavy module: excluded from the sub-2-minute fast gate
 # (`make test-fast` / pytest -m "not slow"); the full suite runs it.
 pytestmark = pytest.mark.slow
+
+
+def test_speculative_with_tensor_parallel_target():
+    """Speculative decoding composes with a tensor-parallel int8-KV
+    target: the contract (output == the TARGET engine's own greedy
+    stream) holds exactly, because both paths run the same sharded
+    program."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    target = ServeEngine(
+        cfg=cfg, params=params, mesh=mesh, kv_dtype="int8"
+    )
+    draft = ServeEngine(cfg=cfg, params=params)  # same cfg: any pair is correct
+    spec = SpeculativeEngine(target, draft, k=3)
+    prompt = "speculative over tp"
+    expect = _plain_greedy(target, prompt, 12)
+    got = spec.generate(prompt, max_new_tokens=12, stop_at_eos=False)
+    assert got == expect

@@ -1,0 +1,69 @@
+"""tpuslo.utils: atomic artifact writes + git provenance."""
+
+from __future__ import annotations
+
+import json
+import os
+import stat
+
+from tpuslo.utils import git_short_sha, write_json_atomic, write_text_atomic
+
+
+def test_write_text_atomic_creates_dirs_and_content(tmp_path):
+    path = tmp_path / "nested" / "dir" / "artifact.txt"
+    write_text_atomic(str(path), "hello\n")
+    assert path.read_text() == "hello\n"
+
+
+def test_write_json_atomic_roundtrip(tmp_path):
+    path = tmp_path / "artifact.json"
+    write_json_atomic(str(path), {"a": [1, 2], "b": "x"})
+    assert json.loads(path.read_text()) == {"a": [1, 2], "b": "x"}
+
+
+def test_atomic_write_replaces_not_truncates(tmp_path):
+    """A failed dump must never leave a truncated artifact: the old
+    content survives any tmp-file path, and a successful write fully
+    replaces it."""
+    path = tmp_path / "artifact.json"
+    write_json_atomic(str(path), {"generation": 1})
+    write_json_atomic(str(path), {"generation": 2})
+    assert json.loads(path.read_text()) == {"generation": 2}
+    # No stray temp files left behind.
+    assert [p.name for p in tmp_path.iterdir()] == ["artifact.json"]
+
+
+def test_failed_dump_preserves_previous_artifact(tmp_path):
+    """The actual crash-safety property: a serialization failure
+    mid-write leaves the previous good artifact intact (a plain
+    truncating open() would have destroyed it)."""
+    import pytest
+
+    path = tmp_path / "artifact.json"
+    write_json_atomic(str(path), {"generation": 1})
+    with pytest.raises(TypeError):
+        write_json_atomic(str(path), {"bad": object()})
+    assert json.loads(path.read_text()) == {"generation": 1}
+    assert [p.name for p in tmp_path.iterdir()] == ["artifact.json"]
+
+
+def test_permissions_match_plain_open(tmp_path):
+    """mkstemp defaults to 0600; the helper must honor the umask like
+    plain open() so committed artifacts stay readable in containers
+    that drop privileges."""
+    atomic = tmp_path / "atomic.txt"
+    plain = tmp_path / "plain.txt"
+    write_text_atomic(str(atomic), "x")
+    with open(plain, "w") as fh:
+        fh.write("x")
+    assert stat.S_IMODE(os.stat(atomic).st_mode) == stat.S_IMODE(
+        os.stat(plain).st_mode
+    )
+
+
+def test_git_short_sha_in_repo_and_outside(tmp_path):
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sha = git_short_sha(repo_root)
+    assert sha != "unknown" and 6 <= len(sha) <= 16
+    # Outside any repo: best-effort "unknown", never an exception.
+    assert git_short_sha(str(tmp_path)) == "unknown"
