@@ -204,3 +204,110 @@ def test_mixtral_8x7b_train_step_compiles_dp_ep():
 # Compile-heavy module: excluded from the sub-2-minute fast gate
 # (`make test-fast` / pytest -m "not slow"); the full suite runs it.
 pytestmark = pytest.mark.slow
+
+
+class TestMoEContinuousBatching:
+    """MoE family gets the full llama scheduler via the mlp_fn hook."""
+
+    def _setup(self, max_slots=2):
+        from tpuslo.models.mixtral import (
+            MoEContinuousBatchingEngine,
+            MoEServeEngine,
+            init_params,
+            mixtral_tiny,
+        )
+
+        cfg = mixtral_tiny(max_seq_len=128)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        batched = MoEContinuousBatchingEngine(
+            cfg=cfg, params=params, max_slots=max_slots,
+            prefill_buckets=(16, 32), decode_chunk_size=4,
+        )
+        single = MoEServeEngine(
+            cfg=cfg, params=params, prefill_buckets=(16, 32),
+            decode_chunk_size=4,
+        )
+        return batched, single
+
+    def _single_stream(self, single, prompt, n):
+        return [
+            e.token_id
+            for e in single.generate(prompt, max_new_tokens=n,
+                                     stop_at_eos=False)
+        ]
+
+    def test_requests_match_single_request_serving(self):
+        batched, single = self._setup()
+        prompts = ["moe batch one", "a second longer moe request", "third"]
+        ids = [batched.submit(p, max_new_tokens=8, stop_at_eos=False)
+               for p in prompts]
+        results = batched.run()
+        for rid, prompt in zip(ids, prompts):
+            assert results[rid] == self._single_stream(single, prompt, 8), (
+                prompt
+            )
+
+    def test_more_requests_than_slots_queue_and_reuse(self):
+        batched, single = self._setup(max_slots=2)
+        prompts = [f"moe queued request {i}" for i in range(5)]
+        ids = [batched.submit(p, max_new_tokens=6, stop_at_eos=False)
+               for p in prompts]
+        results = batched.run()
+        assert len(results) == 5
+        for rid, prompt in zip(ids, prompts):
+            assert results[rid] == self._single_stream(single, prompt, 6)
+
+    def test_prefix_rejected_at_submit(self):
+        """Rejection happens at submit — an admission-time raise would
+        strand every in-flight request in the batch."""
+        batched, _single = self._setup()
+        ok = batched.submit("fine", max_new_tokens=2, stop_at_eos=False)
+        with pytest.raises(ValueError, match="prefix"):
+            batched.submit("x", max_new_tokens=2, prefix="sys: ")
+        results = batched.run()
+        assert ok in results  # the good request was unharmed
+
+    def test_request_timings_present(self):
+        batched, _single = self._setup()
+        rid = batched.submit("timed moe", max_new_tokens=4,
+                             stop_at_eos=False)
+        batched.run()
+        timing = batched.request_timings()[rid]
+        assert timing["e2e_s"] >= timing["queue_delay_s"] >= 0.0
+
+
+def test_moe_batched_tensor_parallel_matches_single_device():
+    """MoE continuous batching composes with the tp mesh: sharded
+    batched decode equals the unsharded single-request MoE stream."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from tpuslo.models.mixtral import (
+        MoEContinuousBatchingEngine,
+        MoEServeEngine,
+        init_params,
+        mixtral_tiny,
+    )
+
+    cfg = mixtral_tiny(max_seq_len=128)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    batched = MoEContinuousBatchingEngine(
+        cfg=cfg, params=params, max_slots=2,
+        prefill_buckets=(16, 32), decode_chunk_size=4, mesh=mesh,
+    )
+    single = MoEServeEngine(
+        cfg=cfg, params=params, prefill_buckets=(16, 32),
+        decode_chunk_size=4,
+    )
+    prompts = ["tp moe batch", "second tp moe request"]
+    ids = [batched.submit(p, max_new_tokens=6, stop_at_eos=False)
+           for p in prompts]
+    results = batched.run()
+    for rid, prompt in zip(ids, prompts):
+        expect = [
+            e.token_id
+            for e in single.generate(prompt, max_new_tokens=6,
+                                     stop_at_eos=False)
+        ]
+        assert results[rid] == expect, prompt

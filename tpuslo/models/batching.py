@@ -112,13 +112,15 @@ class ContinuousBatchingEngine:
         quantize: bool = False,
         kv_dtype: str = "bf16",
         mesh=None,
+        ingest=None,
+        step_fn=None,
     ):
         from tpuslo.models.llama import init_params, init_params_quantized
 
         self.kv_dtype = kv_dtype
         self.cfg = cfg or llama_tiny(max_seq_len=512)
         self.mesh = mesh
-        if params is None and mesh is None:
+        if params is None and mesh is None and ingest is None:
             params = (
                 init_params_quantized(jax.random.PRNGKey(rng_seed), self.cfg)
                 if quantize
@@ -133,15 +135,25 @@ class ContinuousBatchingEngine:
         # caches) for both serving styles.  With a mesh, the ingest
         # engine owns the Megatron sharding (shard-direct init when no
         # params were passed) and this engine adopts its params.
-        from tpuslo.models.serve import ServeEngine
+        # ``ingest``/``step_fn`` are the model-family extension points:
+        # another family (the MoE engine) supplies its own prompt
+        # ingester and jitted per-row decode and inherits the whole
+        # scheduler unchanged.
+        if ingest is None:
+            from tpuslo.models.serve import ServeEngine
 
-        self._ingest = ServeEngine(
-            cfg=self.cfg, params=params, prefill_buckets=prefill_buckets,
-            kv_dtype=kv_dtype, mesh=mesh, rng_seed=rng_seed,
-            quantize=quantize and params is None,
-        )
+            ingest = ServeEngine(
+                cfg=self.cfg, params=params,
+                prefill_buckets=prefill_buckets,
+                kv_dtype=kv_dtype, mesh=mesh, rng_seed=rng_seed,
+                quantize=quantize and params is None,
+            )
+        self._ingest = ingest
         self.params = params = self._ingest.params
-        self._step = _shared_batch_step_fn(self.cfg)
+        self._step = (
+            step_fn if step_fn is not None
+            else _shared_batch_step_fn(self.cfg)
+        )
         self._inject = _SHARED_INJECT
 
         self._cache = self._init_decode_state()

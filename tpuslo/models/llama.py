@@ -558,7 +558,8 @@ def prefill(
 
 
 def decode_step(
-    params: PyTree, token: jax.Array, cache: PyTree, cfg: LlamaConfig
+    params: PyTree, token: jax.Array, cache: PyTree, cfg: LlamaConfig,
+    mlp_fn=None,
 ) -> tuple[jax.Array, PyTree]:
     """One-token decode.  token: (B,) int32 → logits (B, vocab).
 
@@ -567,11 +568,15 @@ def decode_step(
     per-request cache lengths).  The branch is on the static ndim, so
     each shape compiles its own specialized program.  The scalar path
     is :func:`verify_chunk` at K=1 (one shared layer body).
+    ``mlp_fn`` swaps the dense MLP for another block body (the MoE
+    family rides this hook, same as prefill/verify_chunk).
     """
     B = token.shape[0]
     pos = cache["length"]
     if pos.ndim == 0:
-        logits, cache = verify_chunk(params, token[:, None], cache, cfg)
+        logits, cache = verify_chunk(
+            params, token[:, None], cache, cfg, mlp_fn=mlp_fn
+        )
         return logits[:, 0], {**cache, "length": pos + 1}
     from tpuslo.models import kv_cache as kvc
 
@@ -603,9 +608,8 @@ def decode_step(
         )
         h = h + _matmul(attn.reshape(B, 1, H * HD), layer["wo"])
         x = rms_norm(h, layer["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu(_matmul(x, layer["w1"]).astype(jnp.float32))
-        up = _matmul(x, layer["w3"]).astype(jnp.float32)
-        h = h + _matmul((gate * up).astype(cfg.dtype), layer["w2"])
+        y = _dense_mlp(cfg, layer, x) if mlp_fn is None else mlp_fn(layer, x)
+        h = h + y
         return h, (k_cache, v_cache)
 
     h, (ks, vs) = lax.scan(scan_step, h, (params["layers"], cache["k"], cache["v"]))

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpuslo.models.batching import ContinuousBatchingEngine
 from tpuslo.models.llama import (
     LlamaConfig,
     _dense_init,
@@ -341,6 +342,18 @@ def decode_chunk(
 
 
 @lru_cache(maxsize=32)
+def _shared_moe_batch_step_fn(cfg):
+    """Per-row vector-length decode with the MoE block body (llama's
+    batched decode_step through the mlp_fn hook)."""
+    from tpuslo.models import llama
+
+    return jax.jit(
+        partial(llama.decode_step, cfg=cfg, mlp_fn=_serving_mlp_fn(cfg)),
+        donate_argnums=(2,),
+    )
+
+
+@lru_cache(maxsize=32)
 def _shared_moe_prefill_fn(cfg):
     return jax.jit(partial(prefill, cfg=cfg), donate_argnums=(2,))
 
@@ -449,34 +462,57 @@ class MoEServeEngine:
         jax.block_until_ready(toks)
         return (time.perf_counter() - start) * 1000.0
 
-    def generate(self, prompt: str, max_new_tokens: int = 32, stop_at_eos: bool = True):
-        import time
+    def ingest_prompt(self, prompt: str, prefix: str | None = None):
+        """(last-position logits, single-row cache, prompt length) —
+        the continuous-batching admission contract
+        (:meth:`tpuslo.models.serve.ServeEngine.ingest_prompt`).  The
+        MoE engine has no prefix cache; prefix requests fail loudly
+        rather than silently serving without the shared prefix."""
+        if prefix:
+            raise ValueError(
+                "the MoE engine has no prefix cache; submit without "
+                "prefix= or serve the llama family"
+            )
+        from tpuslo.models.serve import _bucket, encode_bytes
 
-        from tpuslo.models.serve import EOS, TokenEvent, _bucket, encode_bytes
-
-        request_start = time.perf_counter()
         chunk = self.decode_chunk_size
-        # Prompt cap leaves at least one whole decode chunk of KV room:
-        # decode always dispatches full chunks, and a partial chunk past
-        # capacity would clamp-and-corrupt the last cache slot (llama's
-        # engine has a single-token tail path for this; the MoE engine
-        # keeps the simpler invariant).
         max_prompt = max(
             1, min(self.prefill_buckets[-1], self.cfg.max_seq_len - chunk - 1)
         )
         ids = encode_bytes(prompt, max_prompt)
-        avail = self.cfg.max_seq_len - len(ids) - 1
-        max_new_tokens = max(1, min(max_new_tokens, (avail // chunk) * chunk))
-
         bucket = _bucket(len(ids), self.prefill_buckets)
         tokens = jnp.asarray([ids + [0] * (bucket - len(ids))], jnp.int32)
         logits, cache = self._prefill(
             self.params, tokens, self._init_cache(1),
             true_length=jnp.asarray(len(ids), jnp.int32),
         )
-        # TTFT must include the prefill compute, not just its async
-        # dispatch — block before taking the timestamp.
         logits.block_until_ready()
+        return logits, cache, len(ids)
+
+    def decode_cap_tokens(self, longest_prompt_len: int) -> int:
+        """Same budget rule as :meth:`generate`: full decode chunks
+        only (the MoE engine has no single-token tail path).  The
+        prompt cap in :meth:`ingest_prompt` guarantees at least one
+        whole chunk of room."""
+        chunk = self.decode_chunk_size
+        avail = self.cfg.max_seq_len - longest_prompt_len - 1
+        return max(1, (avail // chunk) * chunk)
+
+    def generate(self, prompt: str, max_new_tokens: int = 32, stop_at_eos: bool = True):
+        import time
+
+        from tpuslo.models.serve import EOS, TokenEvent
+
+        request_start = time.perf_counter()
+        chunk = self.decode_chunk_size
+        # One ingestion path (ingest_prompt) for streaming and batched
+        # serving: prompt cap, bucket pad, prefill, and the blocking
+        # read (TTFT must include the prefill compute, not just its
+        # async dispatch) all live there.
+        logits, cache, total_len = self.ingest_prompt(prompt)
+        max_new_tokens = max(
+            1, min(max_new_tokens, self.decode_cap_tokens(total_len))
+        )
         token = jnp.argmax(logits, -1).astype(jnp.int32)
         toks = last = None
         if max_new_tokens > 1:
@@ -600,6 +636,7 @@ def build_moe_train_step(mesh: Mesh, cfg: MixtralConfig, optimizer=None):
 
 __all__ = [
     "MixtralConfig",
+    "MoEContinuousBatchingEngine",
     "MoEServeEngine",
     "mixtral_8x7b",
     "mixtral_2b6",
@@ -617,3 +654,53 @@ __all__ = [
     "tp_serve_param_shardings",
     "build_moe_train_step",
 ]
+
+
+class MoEContinuousBatchingEngine(ContinuousBatchingEngine):
+    """Continuous batching for the MoE family.
+
+    The llama scheduler unchanged — slot pool, mid-flight admission,
+    per-row cache lengths, backpressure, request SLIs — with the MoE
+    block body riding the ``mlp_fn`` hook of the batched decode step
+    and :class:`MoEServeEngine` as the prompt ingester.  Per-request
+    output equals the single-request MoE stream (tested).
+    """
+
+    def __init__(
+        self,
+        cfg: MixtralConfig | None = None,
+        params: PyTree | None = None,
+        max_slots: int = 4,
+        rng_seed: int = 0,
+        prefill_buckets: tuple[int, ...] = (32, 64, 128),
+        decode_chunk_size: int = 16,
+        kv_dtype: str = "bf16",
+        mesh: Mesh | None = None,
+    ):
+        cfg = cfg or mixtral_tiny(max_seq_len=256)
+        ingest = MoEServeEngine(
+            cfg=cfg, params=params, rng_seed=rng_seed,
+            prefill_buckets=prefill_buckets,
+            decode_chunk_size=decode_chunk_size,
+            kv_dtype=kv_dtype, mesh=mesh,
+        )
+        super().__init__(
+            cfg=cfg, max_slots=max_slots, rng_seed=rng_seed,
+            prefill_buckets=prefill_buckets, kv_dtype=kv_dtype, mesh=mesh,
+            ingest=ingest, step_fn=_shared_moe_batch_step_fn(cfg),
+        )
+
+    def submit(self, prompt, max_new_tokens=32, stop_at_eos=True,
+               prefix=None):
+        # Reject at SUBMIT, not at admission: an admission-time raise
+        # inside run() would strand every in-flight request in the
+        # batch to fail one bad submit.
+        if prefix:
+            raise ValueError(
+                "the MoE engine has no prefix cache; submit without "
+                "prefix= or serve the llama family"
+            )
+        return super().submit(
+            prompt, max_new_tokens=max_new_tokens,
+            stop_at_eos=stop_at_eos,
+        )
