@@ -575,6 +575,8 @@ def _digest_robustness(robustness: dict) -> dict:
             "gamma_0.5": (heldout.get("gamma") or {}).get("0.5"),
             "variants_0.5": (heldout.get("variant_profiles") or {}).get("0.5"),
             "variants_1.0": (heldout.get("variant_profiles") or {}).get("1.0"),
+            "full_domain_0.5": (heldout.get("full_domain") or {}).get("0.5"),
+            "full_domain_1.0": (heldout.get("full_domain") or {}).get("1.0"),
         },
     }
     for key in ("false_alarm_rate", "abstain_rate"):

@@ -311,3 +311,48 @@ def test_moe_batched_tensor_parallel_matches_single_device():
                                      stop_at_eos=False)
         ]
         assert results[rid] == expect, prompt
+
+
+def test_moe_batched_int8_kv_matches_single_device():
+    """The int8 KV half of the composition claim: batched int8-KV MoE
+    equals the single-request int8-KV MoE stream."""
+    from tpuslo.models.mixtral import (
+        MoEContinuousBatchingEngine,
+        MoEServeEngine,
+        init_params,
+        mixtral_tiny,
+    )
+
+    cfg = mixtral_tiny(max_seq_len=128)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    batched = MoEContinuousBatchingEngine(
+        cfg=cfg, params=params, max_slots=2,
+        prefill_buckets=(16, 32), decode_chunk_size=4, kv_dtype="int8",
+    )
+    single = MoEServeEngine(
+        cfg=cfg, params=params, prefill_buckets=(16, 32),
+        decode_chunk_size=4, kv_dtype="int8",
+    )
+    prompts = ["int8 moe batch", "another int8 request"]
+    ids = [batched.submit(p, max_new_tokens=6, stop_at_eos=False)
+           for p in prompts]
+    results = batched.run()
+    for rid, prompt in zip(ids, prompts):
+        expect = [
+            e.token_id
+            for e in single.generate(prompt, max_new_tokens=6,
+                                     stop_at_eos=False)
+        ]
+        assert results[rid] == expect, prompt
+
+
+def test_moe_batched_refuses_droppy_routing():
+    from tpuslo.models.mixtral import (
+        MoEContinuousBatchingEngine,
+        mixtral_tiny,
+    )
+    from dataclasses import replace
+
+    droppy = replace(mixtral_tiny(max_seq_len=128), capacity_factor=1.0)
+    with pytest.raises(ValueError, match="drop-free"):
+        MoEContinuousBatchingEngine(cfg=droppy, max_slots=2)

@@ -678,6 +678,20 @@ class MoEContinuousBatchingEngine(ContinuousBatchingEngine):
         mesh: Mesh | None = None,
     ):
         cfg = cfg or mixtral_tiny(max_seq_len=256)
+        # Batched decode feeds EVERY slot row (live requests + parked
+        # garbage lanes) through one router-capacity pool, so with
+        # droppy routing (capacity_factor < n_experts/top_k) a
+        # request's expert drops would depend on which other requests
+        # share the step — silently breaking the single-request parity
+        # this engine promises.  Refuse, like prefix and paged block
+        # geometry: drop-free routing is the batched-MoE contract.
+        if cfg.capacity_factor < cfg.n_experts / cfg.top_k:
+            raise ValueError(
+                f"batched MoE serving requires drop-free routing: "
+                f"capacity_factor={cfg.capacity_factor} < n_experts/top_k="
+                f"{cfg.n_experts / cfg.top_k}; raise capacity_factor or "
+                "serve single-request via MoEServeEngine"
+            )
         ingest = MoEServeEngine(
             cfg=cfg, params=params, rng_seed=rng_seed,
             prefill_buckets=prefill_buckets,
