@@ -275,3 +275,190 @@ def test_parked_lane_past_table_width_writes_only_null_block():
     k = np.asarray(state["k"])
     assert np.abs(k[:, 1:]).max() == 0.0
     assert np.abs(k[:, 0]).max() > 0.0  # the writes really happened
+
+
+# -- shared prefix blocks (block-granular copy-on-write) ----------------
+
+
+def _single_prefix_stream(prompt, prefix, n=8, kv_dtype="bf16"):
+    eng = ServeEngine(cfg=CFG, params=PARAMS, kv_dtype=kv_dtype)
+    return [
+        e.token_id
+        for e in eng.generate(prompt, max_new_tokens=n, prefix=prefix)
+    ]
+
+
+def test_shared_prefix_token_parity():
+    """Concurrent requests naming the same prefix share its full pool
+    blocks — and still produce exactly the single-request streams,
+    interleaved with a plain (no-prefix) request."""
+    eng = PagedBatchingEngine(
+        cfg=CFG, params=PARAMS, max_slots=3, block_size=16
+    )
+    prefix = "system: answer tersely and truthfully. "  # BOS + 39 bytes = 40 ids: 2 full blocks
+    suffixes = ["what is ttft?", "define mfu", "name one tpu signal"]
+    ids = [eng.submit(s, max_new_tokens=8, prefix=prefix) for s in suffixes]
+    plain = eng.submit("no prefix here", max_new_tokens=8)
+    results = eng.run()
+    for rid, s in zip(ids, suffixes):
+        assert results[rid] == _single_prefix_stream(s, prefix), s
+    assert results[plain] == _single_stream("no prefix here")
+    stats = eng.stats()
+    assert stats["shared_prefix_blocks"] == 40 // 16
+    assert stats["shared_prefixes"] == 1
+    assert stats["prefix_reuse_hits"] >= 2  # 2nd and 3rd reused the KV
+
+
+def test_shared_prefix_capacity_win():
+    """The point of sharing: a pool that fits only ONE unshared request
+    runs TWO concurrently once the prefix blocks are shared."""
+    prefix = "P" * 31  # BOS + 31 bytes = 32 ids -> 2 full blocks of 16
+    kwargs = dict(
+        cfg=CFG, params=PARAMS, max_slots=2, block_size=16, n_blocks=5
+    )
+    # 32 prefix + 8 suffix + 8 new = 48 positions -> 3 blocks plain,
+    # 1 private with sharing; the 4-block pool fits 3 + 1 shared but
+    # not 3 + 3 unshared.
+    unshared = PagedBatchingEngine(**kwargs, share_prefixes=False)
+    for s in ("aaaaaaaa", "bbbbbbbb"):
+        unshared.submit(s, max_new_tokens=8, prefix=prefix)
+    unshared.step()
+    assert unshared.stats()["active_slots"] == 1  # capacity-blocked
+
+    shared = PagedBatchingEngine(**kwargs)
+    ids = [
+        shared.submit(s, max_new_tokens=8, prefix=prefix)
+        for s in ("aaaaaaaa", "bbbbbbbb")
+    ]
+    shared.step()
+    assert shared.stats()["active_slots"] == 2
+    results = shared.run()
+    for rid, s in zip(ids, ("aaaaaaaa", "bbbbbbbb")):
+        assert results[rid] == _single_prefix_stream(s, prefix), s
+    # Both engines finish with identical streams either way.
+    assert unshared.run()[0] == results[ids[0]]
+
+
+def test_shared_prefix_warm_reuse_and_eviction():
+    """Completed requests leave the prefix blocks warm (refs 0, still
+    allocated); the next same-prefix request reuses them without a
+    copy; admission pressure evicts idle prefixes LRU-first."""
+    eng = PagedBatchingEngine(
+        cfg=CFG, params=PARAMS, max_slots=2, block_size=16, n_blocks=8
+    )
+    free0 = len(eng._free)  # 7
+    prefix = "S" * 32  # BOS + 32 bytes = 33 ids: 2 full blocks
+    eng.submit("first", max_new_tokens=4, prefix=prefix)
+    eng.run()
+    # Private blocks returned; the 2 shared blocks stay warm.
+    assert len(eng._free) == free0 - 2
+    entry = eng._shared_prefixes[prefix]
+    assert entry.refs == 0 and entry.populated
+    hits0 = eng.prefix_reuse_hits
+    eng.submit("second", max_new_tokens=4, prefix=prefix)
+    eng.run()
+    assert eng.prefix_reuse_hits == hits0 + 1
+    # A request that needs more blocks than remain free forces the
+    # idle prefix out and succeeds.
+    big = eng.submit("z" * 60, max_new_tokens=40)  # 61+40=101 -> 7 blocks
+    results = eng.run()
+    assert results[big] == _single_stream("z" * 60, n=40)
+    assert prefix not in eng._shared_prefixes
+    assert len(eng._free) == free0
+
+
+def test_shared_prefix_never_evicted_while_referenced():
+    """A prefix with live references is pinned: a too-big request
+    blocks (backpressure) instead of evicting mapped blocks."""
+    prefix = "Q" * 32
+    eng = PagedBatchingEngine(
+        cfg=CFG, params=PARAMS, max_slots=2, block_size=16, n_blocks=6
+    )
+    # 33 prefix + 8 suffix + 24 new = 65 positions -> 5 blocks:
+    # 2 shared + 3 private; fills the whole 5-block pool.
+    a = eng.submit("aaaaaaaa", max_new_tokens=24, prefix=prefix)
+    eng.step()
+    assert eng.stats()["active_slots"] == 1
+    b = eng.submit("y" * 40, max_new_tokens=24)  # 41+24=65 -> 5 blocks > 1 free
+    eng.step()
+    assert prefix in eng._shared_prefixes  # pinned, not evicted
+    assert eng.stats()["queued"] == 1
+    results = eng.run()
+    assert results[a] == _single_prefix_stream("aaaaaaaa", prefix, n=24)
+    assert results[b] == _single_stream("y" * 40, n=24)
+
+
+def test_shared_prefix_two_prefixes_isolated():
+    eng = PagedBatchingEngine(
+        cfg=CFG, params=PARAMS, max_slots=2, block_size=16
+    )
+    p1, p2 = "alpha " * 6, "omega " * 6  # BOS + 36 bytes = 37 ids each: 2 full blocks
+    r1 = eng.submit("one", max_new_tokens=8, prefix=p1)
+    r2 = eng.submit("two", max_new_tokens=8, prefix=p2)
+    results = eng.run()
+    assert results[r1] == _single_prefix_stream("one", p1)
+    assert results[r2] == _single_prefix_stream("two", p2)
+    assert eng.stats()["shared_prefixes"] == 2
+
+
+def test_shared_prefix_int8_compose():
+    eng = PagedBatchingEngine(
+        cfg=CFG, params=PARAMS, max_slots=2, block_size=16,
+        kv_dtype="int8",
+    )
+    prefix = "system: terse. " * 3  # BOS + 45 bytes = 46 ids: 2 full blocks
+    ids = [
+        eng.submit(s, max_new_tokens=8, prefix=prefix)
+        for s in ("left", "right")
+    ]
+    results = eng.run()
+    for rid, s in zip(ids, ("left", "right")):
+        assert results[rid] == _single_prefix_stream(
+            s, prefix, kv_dtype="int8"
+        ), s
+    assert eng.prefix_reuse_hits == 1
+
+
+def test_eviction_never_victimizes_the_prefix_being_admitted():
+    """Review regression: with two warm idle prefixes filling the pool,
+    admitting against one of them must evict the OTHER — not the very
+    prefix being reused (which would discard warm KV and, before the
+    fix, could leave admission blocked at zero active slots, silently
+    dropping the request)."""
+    eng = PagedBatchingEngine(
+        cfg=CFG, params=PARAMS, max_slots=2, block_size=16, n_blocks=5
+    )
+    p, q = "P" * 15, "Q" * 15  # 16 ids each -> 1 full block
+    eng.submit("aaaaaaaa", max_new_tokens=8, prefix=p)
+    eng.run()
+    eng.submit("bbbbbbbb", max_new_tokens=8, prefix=q)
+    eng.run()
+    # Both prefixes warm (1 block each), 2 of 4 pool blocks free.
+    assert len(eng._free) == 2
+    assert p in eng._shared_prefixes and q in eng._shared_prefixes
+    hits0 = eng.prefix_reuse_hits
+    # 16 prefix + 20 suffix + 28 new = 64 positions -> 4 blocks:
+    # 1 shared + 3 private; private_need 3 > 2 free, so eviction must
+    # run — and must pick q, not the p it is admitting against.
+    rid = eng.submit("c" * 20, max_new_tokens=28, prefix=p)
+    results = eng.run()
+    assert results[rid] == _single_prefix_stream("c" * 20, p, n=28)
+    assert eng.prefix_reuse_hits == hits0 + 1  # p's KV was NOT discarded
+    assert p in eng._shared_prefixes and q not in eng._shared_prefixes
+
+
+def test_never_admittable_raises_even_with_warm_share():
+    """plain_need > pool is never admittable regardless of sharing —
+    the shared blocks occupy the pool too.  Must raise, not hang or
+    silently drop."""
+    eng = PagedBatchingEngine(
+        cfg=CFG, params=PARAMS, max_slots=2, block_size=16, n_blocks=5
+    )
+    prefix = "R" * 31  # 2 full blocks
+    eng.submit("warm", max_new_tokens=4, prefix=prefix)
+    eng.run()
+    assert prefix in eng._shared_prefixes
+    # 32 + 9 + 40 = 81 positions -> 6 blocks > the 4-block pool.
+    eng.submit("overflow", max_new_tokens=40, prefix=prefix)
+    with pytest.raises(ValueError, match="blocks"):
+        eng.run()

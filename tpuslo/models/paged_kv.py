@@ -30,6 +30,23 @@ point at it and its garbage is masked by per-row lengths.  Parked
 and their KV writes land in the null block through their zeroed page
 tables, which is exactly why no live request may ever be mapped to it.
 
+Requests that name a shared ``prefix`` (system prompt, few-shot
+preamble) additionally share the prefix's *full* physical blocks
+read-only across every concurrent request — block-granular
+copy-on-write: the first request to install a prefix populates
+``prefix_len // block_size`` pool blocks once and registers them; every
+later request's page table simply points at them, paying only its
+private suffix/decode blocks.  Sharing is safe by construction: decode
+writes target block ``pos // block_size`` with ``pos >= total_len >
+n_shared * block_size``, which always resolves through a *private*
+page-table entry, so a shared block is never written after population.
+Released requests decref the registry; idle (refcount-0) prefixes stay
+cached for reuse and are evicted LRU-first only when admission needs
+their blocks.  Populating a fresh prefix costs exactly as many blocks
+as an unshared install (the shared span plus the private rest is the
+plain block count), so sharing is free for the first request and a pure
+capacity win from the second on.
+
 The pool composes with the int8 KV representation
 (:mod:`tpuslo.models.kv_cache`): pass ``kv_dtype="int8"`` and both the
 bandwidth halving and the reservation elimination stack.
@@ -37,6 +54,7 @@ bandwidth halving and the reservation elimination stack.
 
 from __future__ import annotations
 
+from dataclasses import dataclass, field
 from functools import lru_cache, partial
 from typing import Any
 
@@ -319,6 +337,28 @@ def _shared_inject_block_fn(cfg, block_size: int):
     )
 
 
+@dataclass
+class _SharedPrefix:
+    """Registry entry for one shared prompt prefix's pool blocks.
+
+    ``blocks`` are the prefix's FULL blocks only (the ragged tail block
+    also holds per-request prompt tokens, so it is never shareable);
+    ``n_tokens == len(blocks) * block_size`` is the shared span.
+    ``refs`` counts live slots whose page tables point at the blocks —
+    eviction is legal only at zero.  ``populated`` flips once the first
+    installer has copied the prefix KV in; until then later installers
+    must copy too (admission can interleave with population only in
+    one thread here, but the flag keeps the invariant explicit).
+    """
+
+    key: str
+    blocks: list[int] = field(default_factory=list)
+    n_tokens: int = 0
+    refs: int = 0
+    populated: bool = False
+    last_use: int = 0
+
+
 class PagedBatchingEngine(ContinuousBatchingEngine):
     """Continuous batching over a paged pool.
 
@@ -342,6 +382,7 @@ class PagedBatchingEngine(ContinuousBatchingEngine):
         kv_dtype: str = "bf16",
         mesh=None,
         pallas_attention: bool | None = None,
+        share_prefixes: bool = True,
     ):
         import os
 
@@ -388,6 +429,15 @@ class PagedBatchingEngine(ContinuousBatchingEngine):
         self.n_blocks = n_blocks
         self._free: list[int] = []
         self._slot_blocks: list[list[int]] = []
+        # Shared-prefix block registry (see module docstring): prefix
+        # text -> _SharedPrefix.  Host-side only, like the free list.
+        self.share_prefixes = share_prefixes
+        self._shared_prefixes: dict[str, _SharedPrefix] = {}
+        self._slot_prefix: list[str | None] = []
+        self._prefix_len_cache: dict[str, int] = {}
+        self._prefix_clock = 0
+        #: admissions that reused an already-populated shared prefix
+        self.prefix_reuse_hits = 0
         super().__init__(
             cfg=cfg, params=params, max_slots=max_slots, rng_seed=rng_seed,
             prefill_buckets=prefill_buckets, quantize=quantize,
@@ -414,6 +464,8 @@ class PagedBatchingEngine(ContinuousBatchingEngine):
         # Block 0 is the null target of unallocated page-table entries.
         self._free = list(range(1, self.n_blocks))
         self._slot_blocks = [[] for _ in range(self.max_slots)]
+        self._shared_prefixes = {}
+        self._slot_prefix = [None] * self.max_slots
         return state
 
     def _blocks_needed(self, total_len: int, max_new: int) -> int:
@@ -421,33 +473,123 @@ class PagedBatchingEngine(ContinuousBatchingEngine):
         # prompt plus every generated token's KV write.
         return -(-(total_len + max_new) // self.block_size)
 
+    def _prefix_full_blocks(self, prefix: str) -> int:
+        """FULL blocks of the tokenized prefix — the shareable span.
+
+        The count comes from the ingest engine's own
+        :meth:`~tpuslo.models.serve.ServeEngine.cache_prefix` entry —
+        the REAL tokenization whose KV lands in the blocks — memoized
+        per prefix text so backpressured admission retries don't
+        re-resolve it every decode step.
+        """
+        n = self._prefix_len_cache.get(prefix)
+        if n is None:
+            n = len(self._ingest.cache_prefix(prefix).ids)
+            # Bounded FIFO like the ingest engine's prefix cache: a
+            # long-lived server seeing many distinct (multi-KB) prefix
+            # strings must not accumulate them all forever.
+            while len(self._prefix_len_cache) >= 64:
+                self._prefix_len_cache.pop(
+                    next(iter(self._prefix_len_cache))
+                )
+            self._prefix_len_cache[prefix] = n
+        return n // self.block_size
+
+    def _evict_idle_prefixes(self, need: int, keep: str | None = None) -> None:
+        """Reclaim refcount-0 shared prefixes, LRU-first, until ``need``
+        free blocks exist (or no idle prefix remains).  Entries with
+        live references are never touched — their blocks are mapped in
+        active page tables — and neither is ``keep``, the prefix the
+        current admission is about to reuse (it sits at refs 0 until
+        the admission succeeds).  If even reclaiming EVERY eligible
+        prefix cannot reach ``need``, nothing is evicted: admission
+        will backpressure regardless, and discarding warm KV would
+        only force a pointless re-prefill later."""
+        idle = [
+            s
+            for s in self._shared_prefixes.values()
+            if s.refs == 0 and s.key != keep
+        ]
+        if len(self._free) + sum(len(s.blocks) for s in idle) < need:
+            return
+        idle.sort(key=lambda s: s.last_use)
+        for victim in idle:
+            if len(self._free) >= need:
+                break
+            self._free.extend(victim.blocks)
+            del self._shared_prefixes[victim.key]
+
     def _install_row(self, slot: int, row_cache: PyTree, req: _Request) -> bool:
         total_len = int(row_cache["length"])
-        need = self._blocks_needed(total_len, req.max_new_tokens)
-        if need > self.n_blocks - 1:
+        plain_need = self._blocks_needed(total_len, req.max_new_tokens)
+
+        # Admissibility does not depend on sharing: shared blocks
+        # occupy the pool too, so a request always needs plain_need
+        # pool blocks in total (n_shared shared + the private rest) —
+        # sharing only changes how many of them must be NEWLY free.
+        # plain_need <= pool is therefore exactly the always-eventually-
+        # admittable condition, with or without a prefix.
+        if plain_need > self.n_blocks - 1:
             raise ValueError(
-                f"request needs {need} blocks but the pool only has "
+                f"request needs {plain_need} blocks but the pool only has "
                 f"{self.n_blocks - 1}; raise n_blocks or lower "
                 "max_new_tokens/prompt length"
             )
+        share: _SharedPrefix | None = None
+        n_shared = 0
+        if self.share_prefixes and req.prefix:
+            n_full = self._prefix_full_blocks(req.prefix)
+            if n_full > 0:
+                share = self._shared_prefixes.get(req.prefix)
+                n_shared = n_full
+        private_need = plain_need - n_shared
+        need = private_need if (share is not None and share.populated) else plain_need
         if need > len(self._free):
-            return False  # backpressure: wait for a release
-        blocks = [self._free.pop() for _ in range(need)]
+            self._evict_idle_prefixes(
+                need, keep=share.key if share is not None else None
+            )
+            if need > len(self._free):
+                return False  # backpressure: wait for a release
+        populate_shared = n_shared > 0 and (
+            share is None or not share.populated
+        )
+        if n_shared > 0 and share is None:
+            share = _SharedPrefix(
+                key=req.prefix,
+                blocks=[self._free.pop() for _ in range(n_shared)],
+                n_tokens=n_shared * self.block_size,
+            )
+            self._shared_prefixes[req.prefix] = share
+        blocks = [self._free.pop() for _ in range(private_need)]
         self._slot_blocks[slot] = blocks
+        if share is not None:
+            share.refs += 1
+            self._prefix_clock += 1
+            share.last_use = self._prefix_clock
+            self._slot_prefix[slot] = share.key
+            if share.populated:
+                self.prefix_reuse_hits += 1
+        table = (share.blocks if share is not None else []) + blocks
         pt = self._cache["page_table"]
         row = jnp.zeros((pt.shape[1],), jnp.int32)
-        row = row.at[jnp.arange(len(blocks))].set(jnp.asarray(blocks))
+        row = row.at[jnp.arange(len(table))].set(jnp.asarray(table))
         self._cache["page_table"] = pt.at[slot].set(row)
         self._cache["length"] = self._cache["length"].at[slot].set(total_len)
         # Copy the prompt's KV block-by-block (one compiled shape).
+        # Already-populated shared blocks are skipped — that skip is the
+        # admission-bandwidth half of the sharing win.
         row_kv = {"k": row_cache["k"], "v": row_cache["v"]}
         n_prompt_blocks = -(-total_len // self.block_size)
         for i in range(n_prompt_blocks):
+            if i < n_shared and not populate_shared:
+                continue
             self._cache = self._inject_block(
                 self._cache, row_kv,
                 jnp.asarray(i * self.block_size, jnp.int32),
-                jnp.asarray(blocks[i], jnp.int32),
+                jnp.asarray(table[i], jnp.int32),
             )
+        if populate_shared:
+            share.populated = True
         return True
 
     def _decode_tokens(self):
@@ -459,6 +601,15 @@ class PagedBatchingEngine(ContinuousBatchingEngine):
     def _release_slot(self, slot: int) -> None:
         self._free.extend(self._slot_blocks[slot])
         self._slot_blocks[slot] = []
+        key = self._slot_prefix[slot]
+        if key is not None:
+            self._slot_prefix[slot] = None
+            share = self._shared_prefixes.get(key)
+            if share is not None:
+                # Blocks stay registered at refs == 0 (warm for the next
+                # request with this prefix); _evict_idle_prefixes
+                # reclaims them only under admission pressure.
+                share.refs = max(0, share.refs - 1)
         # Point the empty slot's page table at the null block and park
         # its write position at 0: paged_decode_step writes one slot
         # for EVERY batch row each step (parked lanes included), and a
@@ -476,11 +627,17 @@ class PagedBatchingEngine(ContinuousBatchingEngine):
     def stats(self) -> dict[str, int | float]:
         out = super().stats()
         live = (self.n_blocks - 1) - len(self._free)
+        shared = sum(
+            len(s.blocks) for s in self._shared_prefixes.values()
+        )
         out.update(
             {
                 "pool_blocks": self.n_blocks - 1,
                 "blocks_live": live,
                 "block_utilization": live / max(1, self.n_blocks - 1),
+                "shared_prefix_blocks": shared,
+                "shared_prefixes": len(self._shared_prefixes),
+                "prefix_reuse_hits": self.prefix_reuse_hits,
             }
         )
         return out
