@@ -93,6 +93,12 @@ slo_overview = dashboard(
         panel("Retrieval latency p95 (ms)", [
             ('histogram_quantile(0.95, sum(rate(llm_slo_retrieval_latency_ms_bucket[5m])) by (le))', "retrieval p95"),
         ], 0, 16, unit="ms"),
+        panel("Serving scheduler (occupancy / queue / pool)", [
+            ('llm_slo_engine_stat{stat="occupancy"}', "slot occupancy"),
+            ('llm_slo_engine_stat{stat="queued"}', "queued requests"),
+            ('llm_slo_engine_stat{stat="block_utilization"}', "paged-pool utilization"),
+            ('llm_slo_engine_stat{stat="shared_prefix_blocks"}', "shared prefix blocks"),
+        ], 12, 16),
     ],
 )
 

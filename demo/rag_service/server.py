@@ -29,6 +29,7 @@ def make_handler(service: RagService):
     class Handler(DemoHTTPHandler):
         def do_GET(self):
             if self.path.startswith("/metrics"):
+                service.refresh_engine_stats()
                 self.send_metrics(service.metrics.registry)
             elif self.path in ("/healthz", "/readyz"):
                 self.send_json(

@@ -279,7 +279,25 @@ class JaxBatchedBackend:
             engine.results.clear()
         self.engine = engine
         self._lock = threading.Lock()
+        self._last_stats: dict[str, float] = dict(engine.stats())
         self.system_prompt = os.environ.get("TPUSLO_SYSTEM_PROMPT") or None
+
+    def scheduler_stats(self) -> dict[str, float]:
+        """Engine scheduler stats for the /metrics scrape path.
+
+        The engine's host-side bookkeeping is mutated under the step
+        lock, which a handler thread can hold for seconds (a cold
+        prefill-bucket compile).  A scrape must not miss its timeout
+        exactly while the service is busy, so this tries the lock
+        briefly and falls back to the last-known snapshot — stale-but-
+        present beats absent for the SLIs this exports.
+        """
+        if self._lock.acquire(timeout=0.05):
+            try:
+                self._last_stats = dict(self.engine.stats())
+            finally:
+                self._lock.release()
+        return self._last_stats
 
     def generate(
         self, prompt: str, max_new_tokens: int, warmup_ms: float, cadence_ms: float
@@ -363,6 +381,20 @@ class DemoMetrics:
             "llm_slo_requests_total", "Requests", ["profile", "backend"],
             registry=self.registry,
         )
+        # Serving-scheduler SLIs (batched backends): one labeled gauge
+        # refreshed from ``engine.stats()`` at scrape time, so every
+        # stat the engine publishes (occupancy, queue depth, paged
+        # block utilization, shared-prefix reuse, ...) becomes a series
+        # without this class chasing the engines' telemetry surface.
+        # Empty-lane decode dispatches and admission-queue growth are
+        # exactly the serving-efficiency signals the SLO pipeline
+        # attributes, so they must be scrapeable, not just in logs.
+        self.engine_stat = Gauge(
+            "llm_slo_engine_stat",
+            "Batching-engine scheduler stat (labeled by stats() key)",
+            ["stat"],
+            registry=self.registry,
+        )
         self.errors = Counter(
             "llm_slo_requests_errors_total", "Request errors",
             registry=self.registry,
@@ -406,6 +438,26 @@ class RagService:
         # Optional demo.vectordb.VectorStore: the vectordb retrieval
         # phase becomes a measured search instead of a seeded sleep.
         self.vector_store = vector_store
+
+    def refresh_engine_stats(self) -> dict[str, float]:
+        """Pull the backend's scheduler stats into the labeled gauge.
+
+        Called by the /metrics handler at scrape time so Prometheus
+        sees the CURRENT queue depth / occupancy / pool state, not a
+        snapshot from the last completed request.  Backends without a
+        batching engine (stub, single-request jax) export nothing.
+        """
+        stats_fn = getattr(self.backend, "scheduler_stats", None)
+        if stats_fn is None:
+            return {}
+        stats = {
+            k: float(v)
+            for k, v in stats_fn().items()
+            if isinstance(v, (int, float))
+        }
+        for key, value in stats.items():
+            self.metrics.engine_stat.labels(stat=key).set(value)
+        return stats
 
     def _simulate_retrieval(
         self, profile: str, request_seed: int, query: str = ""

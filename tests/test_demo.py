@@ -328,3 +328,42 @@ def test_jax_batched_backend_paged_tp(monkeypatch):
     for i, events in outputs.items():
         kinds = [e.get("type") for e in events]
         assert "token" in kinds and kinds[-1] == "summary", i
+
+
+def test_engine_scheduler_stats_exported():
+    """The /metrics scrape path must surface the batching engine's
+    scheduler stats (occupancy, queue depth, paged pool/prefix state)
+    as the labeled llm_slo_engine_stat gauge — the serving-efficiency
+    SLIs exist to be scraped, not just returned from stats()."""
+    from prometheus_client import generate_latest
+
+    from demo.rag_service.service import JaxBatchedBackend, RagService
+    from tpuslo.models.llama import init_params, llama_tiny
+    from tpuslo.models.paged_kv import PagedBatchingEngine
+
+    import jax
+
+    cfg = llama_tiny(max_seq_len=128)
+    engine = PagedBatchingEngine(
+        cfg=cfg, params=init_params(jax.random.PRNGKey(0), cfg),
+        max_slots=2, block_size=16,
+    )
+    backend = JaxBatchedBackend(engine=engine)
+    service = RagService(backend=backend, seed=1)
+    list(service.chat("a query", profile="chat_short"))
+    stats = service.refresh_engine_stats()
+    # Scheduler + paged-pool + shared-prefix families all present.
+    for key in (
+        "occupancy", "queued", "completed",
+        "block_utilization", "pool_blocks",
+        "shared_prefix_blocks", "prefix_reuse_hits",
+    ):
+        assert key in stats, key
+    text = generate_latest(service.metrics.registry).decode()
+    assert 'llm_slo_engine_stat{stat="occupancy"}' in text
+    assert 'llm_slo_engine_stat{stat="block_utilization"}' in text
+    # Stub backends have no engine: refresh is a no-op, not an error.
+    from demo.rag_service.service import StubBackend
+
+    plain = RagService(backend=StubBackend(), sleep=lambda s: None)
+    assert plain.refresh_engine_stats() == {}

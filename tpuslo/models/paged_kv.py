@@ -342,8 +342,8 @@ class _SharedPrefix:
     """Registry entry for one shared prompt prefix's pool blocks.
 
     ``blocks`` are the prefix's FULL blocks only (the ragged tail block
-    also holds per-request prompt tokens, so it is never shareable);
-    ``n_tokens == len(blocks) * block_size`` is the shared span.
+    also holds per-request prompt tokens, so it is never shareable —
+    the shared span is ``len(blocks) * block_size`` tokens).
     ``refs`` counts live slots whose page tables point at the blocks —
     eviction is legal only at zero.  ``populated`` flips once the first
     installer has copied the prefix KV in; until then later installers
@@ -353,7 +353,6 @@ class _SharedPrefix:
 
     key: str
     blocks: list[int] = field(default_factory=list)
-    n_tokens: int = 0
     refs: int = 0
     populated: bool = False
     last_use: int = 0
@@ -557,7 +556,6 @@ class PagedBatchingEngine(ContinuousBatchingEngine):
             share = _SharedPrefix(
                 key=req.prefix,
                 blocks=[self._free.pop() for _ in range(n_shared)],
-                n_tokens=n_shared * self.block_size,
             )
             self._shared_prefixes[req.prefix] = share
         blocks = [self._free.pop() for _ in range(private_need)]
