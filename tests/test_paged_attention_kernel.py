@@ -147,3 +147,37 @@ def test_engine_pallas_path_token_parity():
             margin = float(top2[1] - top2[0])
             assert margin < 0.15, (prompt, k, g, e, margin)
             break  # contexts differ after a flip; later tokens may too
+
+
+def test_pallas_shared_prefix_token_parity():
+    """Shared prefix blocks through the Pallas kernel: several lanes'
+    page tables point at the SAME physical blocks for the prefix span;
+    each lane's scalar-prefetched block walk must still read them
+    correctly (and produce the single-request streams)."""
+    from tpuslo.models.llama import init_params, llama_tiny
+    from tpuslo.models.paged_kv import PagedBatchingEngine
+    from tpuslo.models.serve import ServeEngine
+
+    cfg = llama_tiny(max_seq_len=128)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    eng = PagedBatchingEngine(
+        cfg=cfg, params=params, max_slots=3, block_size=16,
+        pallas_attention=True,
+    )
+    prefix = "system: pallas shared prefix. "  # BOS + 30 bytes: 1 full block
+    suffixes = ["kernel one", "kernel two", "kernel three"]
+    ids = [
+        eng.submit(s, max_new_tokens=8, stop_at_eos=False, prefix=prefix)
+        for s in suffixes
+    ]
+    results = eng.run()
+    assert eng.prefix_reuse_hits >= 2
+    single = ServeEngine(cfg=cfg, params=params)
+    for rid, s in zip(ids, suffixes):
+        expect = [
+            e.token_id
+            for e in single.generate(
+                s, max_new_tokens=8, stop_at_eos=False, prefix=prefix
+            )
+        ]
+        assert results[rid] == expect, s

@@ -112,3 +112,38 @@ def test_pallas_with_mesh_rejected():
             cfg=CFG, params=PARAMS, max_slots=2, block_size=16,
             mesh=_tp_mesh(2), pallas_attention=True,
         )
+
+
+def test_tp_paged_shared_prefix_parity():
+    """Shared prefix blocks x tensor parallelism: the registry and
+    page tables are host-side, the pool's KV heads sharded — sharing
+    must be transparent to the tp path and keep single-device parity."""
+    from tpuslo.models.serve import ServeEngine
+
+    prefix = "system: shared preamble for tp. "  # BOS + 32 bytes: 2 full blocks
+    suffixes = ["tp one", "tp two", "tp three"]
+    sharded = PagedBatchingEngine(
+        cfg=CFG, params=PARAMS, max_slots=2, block_size=16,
+        mesh=_tp_mesh(2),
+    )
+    ids = [
+        sharded.submit(s, max_new_tokens=8, stop_at_eos=False, prefix=prefix)
+        for s in suffixes
+    ]
+    results = sharded.run()
+    assert sharded.prefix_reuse_hits >= 1
+    assert sharded.stats()["shared_prefixes"] == 1
+    plain = PagedBatchingEngine(
+        cfg=CFG, params=PARAMS, max_slots=2, block_size=16
+    )
+    single = ServeEngine(cfg=CFG, params=PARAMS)
+    for rid, s in zip(ids, suffixes):
+        expect = [
+            e.token_id
+            for e in single.generate(
+                s, max_new_tokens=8, stop_at_eos=False, prefix=prefix
+            )
+        ]
+        got = results[rid]
+        assert len(got) == len(expect)
+        _assert_stream_close(plain, prefix + s, got, expect)
