@@ -356,3 +356,136 @@ def test_moe_batched_refuses_droppy_routing():
     droppy = replace(mixtral_tiny(max_seq_len=128), capacity_factor=1.0)
     with pytest.raises(ValueError, match="drop-free"):
         MoEContinuousBatchingEngine(cfg=droppy, max_slots=2)
+
+
+class TestMoEPagedBatching:
+    """Paged pool x MoE: the serving matrix's last cell.  Same parity
+    contract as the llama paged engine — per-request output equals the
+    single-request MoE stream — plus the allocator behaviors the pool
+    brings (backpressure, release, capacity win at equal HBM)."""
+
+    def _setup(self, max_slots=2, n_blocks=None, block_size=16,
+               kv_dtype="bf16"):
+        from tpuslo.models.mixtral import (
+            MoEPagedBatchingEngine,
+            MoEServeEngine,
+            init_params,
+            mixtral_tiny,
+        )
+
+        cfg = mixtral_tiny(max_seq_len=128)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        paged = MoEPagedBatchingEngine(
+            cfg=cfg, params=params, max_slots=max_slots,
+            n_blocks=n_blocks, block_size=block_size,
+            prefill_buckets=(16, 32), decode_chunk_size=4,
+            kv_dtype=kv_dtype,
+        )
+        single = MoEServeEngine(
+            cfg=cfg, params=params, prefill_buckets=(16, 32),
+            decode_chunk_size=4, kv_dtype=kv_dtype,
+        )
+        return paged, single
+
+    def _single_stream(self, single, prompt, n):
+        return [
+            e.token_id
+            for e in single.generate(prompt, max_new_tokens=n,
+                                     stop_at_eos=False)
+        ]
+
+    def test_matches_single_request_moe_serving(self):
+        paged, single = self._setup()
+        prompts = ["moe paged one", "a second longer moe request", "third"]
+        ids = [paged.submit(p, max_new_tokens=8, stop_at_eos=False)
+               for p in prompts]
+        results = paged.run()
+        for rid, prompt in zip(ids, prompts):
+            assert results[rid] == self._single_stream(single, prompt, 8), (
+                prompt
+            )
+        assert len(paged._free) == paged.n_blocks - 1  # all returned
+
+    def test_block_backpressure_then_progress(self):
+        # 17 ids + 28 new = 45 positions -> 3 blocks of 16; pool of 4
+        # fits one request at a time; the second waits, then completes.
+        paged, single = self._setup(max_slots=2, n_blocks=5)
+        prompts = ["moe pressure one!", "moe pressure two!"]
+        ids = [paged.submit(p, max_new_tokens=28, stop_at_eos=False)
+               for p in prompts]
+        paged.step()
+        assert paged.stats()["active_slots"] == 1
+        results = paged.run()
+        for rid, prompt in zip(ids, prompts):
+            assert results[rid] == self._single_stream(single, prompt, 28), (
+                prompt
+            )
+
+    def test_int8_kv_compose(self):
+        paged, single = self._setup(kv_dtype="int8")
+        prompts = ["moe paged int8", "second int8 moe"]
+        ids = [paged.submit(p, max_new_tokens=6, stop_at_eos=False)
+               for p in prompts]
+        results = paged.run()
+        for rid, prompt in zip(ids, prompts):
+            assert results[rid] == self._single_stream(single, prompt, 6), (
+                prompt
+            )
+
+    def test_prefix_rejected_at_submit(self):
+        import pytest
+
+        paged, _ = self._setup()
+        with pytest.raises(ValueError, match="prefix"):
+            paged.submit("moe", prefix="system: nope")
+
+    def test_droppy_routing_rejected(self):
+        import dataclasses
+
+        import pytest
+
+        from tpuslo.models.mixtral import MoEPagedBatchingEngine, mixtral_tiny
+
+        droppy = dataclasses.replace(
+            mixtral_tiny(max_seq_len=128), capacity_factor=1.0
+        )
+        with pytest.raises(ValueError, match="drop-free"):
+            MoEPagedBatchingEngine(cfg=droppy)
+
+
+def test_moe_paged_tp_matches_single_device():
+    """MoE paged pool x tensor parallelism: the pool's KV heads shard
+    over the tp mesh while the MoE block body and the host-side block
+    allocator ride unchanged."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from tpuslo.models.mixtral import (
+        MoEPagedBatchingEngine,
+        MoEServeEngine,
+        init_params,
+        mixtral_tiny,
+    )
+
+    cfg = mixtral_tiny(max_seq_len=128)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    paged = MoEPagedBatchingEngine(
+        cfg=cfg, params=params, max_slots=2, block_size=16,
+        prefill_buckets=(16, 32), decode_chunk_size=4, mesh=mesh,
+    )
+    single = MoEServeEngine(
+        cfg=cfg, params=params, prefill_buckets=(16, 32),
+        decode_chunk_size=4,
+    )
+    prompts = ["tp moe paged", "second tp moe paged request"]
+    ids = [paged.submit(p, max_new_tokens=6, stop_at_eos=False)
+           for p in prompts]
+    results = paged.run()
+    for rid, prompt in zip(ids, prompts):
+        expect = [
+            e.token_id
+            for e in single.generate(prompt, max_new_tokens=6,
+                                     stop_at_eos=False)
+        ]
+        assert results[rid] == expect, prompt

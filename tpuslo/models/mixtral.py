@@ -26,6 +26,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpuslo.models.batching import ContinuousBatchingEngine
+from tpuslo.models.paged_kv import PagedBatchingEngine
 from tpuslo.models.llama import (
     LlamaConfig,
     _dense_init,
@@ -637,6 +638,7 @@ def build_moe_train_step(mesh: Mesh, cfg: MixtralConfig, optimizer=None):
 __all__ = [
     "MixtralConfig",
     "MoEContinuousBatchingEngine",
+    "MoEPagedBatchingEngine",
     "MoEServeEngine",
     "mixtral_8x7b",
     "mixtral_2b6",
@@ -656,7 +658,54 @@ __all__ = [
 ]
 
 
-class MoEContinuousBatchingEngine(ContinuousBatchingEngine):
+class _MoEBatchedContract:
+    """Shared contract of the batched MoE engines (dense and paged).
+
+    Batched decode feeds EVERY slot row (live requests + parked garbage
+    lanes) through one router-capacity pool, so with droppy routing
+    (capacity_factor < n_experts/top_k) a request's expert drops would
+    depend on which other requests share the step — silently breaking
+    the single-request parity both engines promise.  Drop-free routing
+    is therefore refused up front, and ``prefix`` is rejected at
+    SUBMIT (not admission, where a raise inside run() would strand
+    every in-flight request): the MoE family has no prefix cache.
+    """
+
+    @staticmethod
+    def _require_drop_free(cfg: MixtralConfig) -> MixtralConfig:
+        if cfg.capacity_factor < cfg.n_experts / cfg.top_k:
+            raise ValueError(
+                f"batched MoE serving requires drop-free routing: "
+                f"capacity_factor={cfg.capacity_factor} < n_experts/top_k="
+                f"{cfg.n_experts / cfg.top_k}; raise capacity_factor or "
+                "serve single-request via MoEServeEngine"
+            )
+        return cfg
+
+    @staticmethod
+    def _make_ingest(cfg, params, rng_seed, prefill_buckets,
+                     decode_chunk_size, kv_dtype, mesh):
+        return MoEServeEngine(
+            cfg=cfg, params=params, rng_seed=rng_seed,
+            prefill_buckets=prefill_buckets,
+            decode_chunk_size=decode_chunk_size,
+            kv_dtype=kv_dtype, mesh=mesh,
+        )
+
+    def submit(self, prompt, max_new_tokens=32, stop_at_eos=True,
+               prefix=None):
+        if prefix:
+            raise ValueError(
+                "the MoE engine has no prefix cache; submit without "
+                "prefix= or serve the llama family"
+            )
+        return super().submit(
+            prompt, max_new_tokens=max_new_tokens,
+            stop_at_eos=stop_at_eos,
+        )
+
+
+class MoEContinuousBatchingEngine(_MoEBatchedContract, ContinuousBatchingEngine):
     """Continuous batching for the MoE family.
 
     The llama scheduler unchanged — slot pool, mid-flight admission,
@@ -677,26 +726,10 @@ class MoEContinuousBatchingEngine(ContinuousBatchingEngine):
         kv_dtype: str = "bf16",
         mesh: Mesh | None = None,
     ):
-        cfg = cfg or mixtral_tiny(max_seq_len=256)
-        # Batched decode feeds EVERY slot row (live requests + parked
-        # garbage lanes) through one router-capacity pool, so with
-        # droppy routing (capacity_factor < n_experts/top_k) a
-        # request's expert drops would depend on which other requests
-        # share the step — silently breaking the single-request parity
-        # this engine promises.  Refuse, like prefix and paged block
-        # geometry: drop-free routing is the batched-MoE contract.
-        if cfg.capacity_factor < cfg.n_experts / cfg.top_k:
-            raise ValueError(
-                f"batched MoE serving requires drop-free routing: "
-                f"capacity_factor={cfg.capacity_factor} < n_experts/top_k="
-                f"{cfg.n_experts / cfg.top_k}; raise capacity_factor or "
-                "serve single-request via MoEServeEngine"
-            )
-        ingest = MoEServeEngine(
-            cfg=cfg, params=params, rng_seed=rng_seed,
-            prefill_buckets=prefill_buckets,
-            decode_chunk_size=decode_chunk_size,
-            kv_dtype=kv_dtype, mesh=mesh,
+        cfg = self._require_drop_free(cfg or mixtral_tiny(max_seq_len=256))
+        ingest = self._make_ingest(
+            cfg, params, rng_seed, prefill_buckets, decode_chunk_size,
+            kv_dtype, mesh,
         )
         super().__init__(
             cfg=cfg, max_slots=max_slots, rng_seed=rng_seed,
@@ -704,17 +737,62 @@ class MoEContinuousBatchingEngine(ContinuousBatchingEngine):
             ingest=ingest, step_fn=_shared_moe_batch_step_fn(cfg),
         )
 
-    def submit(self, prompt, max_new_tokens=32, stop_at_eos=True,
-               prefix=None):
-        # Reject at SUBMIT, not at admission: an admission-time raise
-        # inside run() would strand every in-flight request in the
-        # batch to fail one bad submit.
-        if prefix:
-            raise ValueError(
-                "the MoE engine has no prefix cache; submit without "
-                "prefix= or serve the llama family"
-            )
-        return super().submit(
-            prompt, max_new_tokens=max_new_tokens,
-            stop_at_eos=stop_at_eos,
+
+@lru_cache(maxsize=32)
+def _shared_moe_paged_step_fn(cfg, block_size: int):
+    """Paged decode with the MoE block body: paged_decode_step's
+    mlp_fn hook, same discipline as :func:`_shared_moe_batch_step_fn`."""
+    from tpuslo.models.paged_kv import paged_decode_step
+
+    return jax.jit(
+        partial(
+            paged_decode_step, cfg=cfg, block_size=block_size,
+            mlp_fn=_serving_mlp_fn(cfg),
+        ),
+        donate_argnums=(2,),
+    )
+
+
+class MoEPagedBatchingEngine(_MoEBatchedContract, PagedBatchingEngine):
+    """Paged-pool continuous batching for the MoE family.
+
+    Completes the serving matrix's last cell: {dense, paged} x {llama,
+    MoE} x {bf16, int8 KV} x {single-device, tp mesh}.  The llama paged
+    engine's allocator, page tables, admission backpressure and
+    physical-pool attention are inherited unchanged; only the block
+    body differs (``paged_decode_step``'s ``mlp_fn`` hook) and the
+    prompt ingester is :class:`MoEServeEngine`.  The drop-free routing
+    guard and prefix rejection ride :class:`_MoEBatchedContract`;
+    prefix caching (and therefore shared prefix blocks) stays a
+    llama-family feature.
+    """
+
+    def __init__(
+        self,
+        cfg: MixtralConfig | None = None,
+        params: PyTree | None = None,
+        max_slots: int = 4,
+        n_blocks: int | None = None,
+        block_size: int = 64,
+        rng_seed: int = 0,
+        prefill_buckets: tuple[int, ...] = (32, 64, 128),
+        decode_chunk_size: int = 16,
+        kv_dtype: str = "bf16",
+        mesh: Mesh | None = None,
+    ):
+        cfg = self._require_drop_free(cfg or mixtral_tiny(max_seq_len=256))
+        ingest = self._make_ingest(
+            cfg, params, rng_seed, prefill_buckets, decode_chunk_size,
+            kv_dtype, mesh,
+        )
+        super().__init__(
+            cfg=cfg, max_slots=max_slots, n_blocks=n_blocks,
+            block_size=block_size, rng_seed=rng_seed,
+            prefill_buckets=prefill_buckets, kv_dtype=kv_dtype, mesh=mesh,
+            ingest=ingest,
+            paged_step_fn=_shared_moe_paged_step_fn(cfg, block_size),
+            # The Pallas decode kernel itself is family-agnostic, but
+            # the MoE step factory doesn't thread the flag; the XLA
+            # physical-pool attention is this family's only path.
+            pallas_attention=False,
         )

@@ -66,6 +66,7 @@ from tpuslo.models import kv_cache as kvc
 from tpuslo.models.batching import ContinuousBatchingEngine, _Request
 from tpuslo.models.llama import (
     LlamaConfig,
+    _dense_mlp,
     _embed_lookup,
     _matmul,
     apply_rope,
@@ -220,7 +221,7 @@ def _pool_attention(
 
 def paged_decode_step(
     params: PyTree, token: jax.Array, state: PyTree, cfg: LlamaConfig,
-    block_size: int, pallas: bool = False,
+    block_size: int, pallas: bool = False, mlp_fn=None,
 ) -> tuple[jax.Array, PyTree]:
     """One decode token for every slot against the paged pool.
 
@@ -239,6 +240,10 @@ def paged_decode_step(
     context) instead of O(pool) per lane, the recorded prerequisite
     for batch >= 16 serving (see the batch-saturation lane's decision
     arithmetic).
+
+    ``mlp_fn(layer, x)`` swaps the dense MLP for another block body —
+    the MoE family rides this hook, exactly as in the dense
+    :func:`tpuslo.models.llama.decode_step`.
     """
     B = token.shape[0]
     pos = state["length"]  # (B,)
@@ -304,9 +309,9 @@ def paged_decode_step(
             )
         h = h + _matmul(attn.reshape(B, 1, H * HD), layer["wo"])
         x = rms_norm(h, layer["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu(_matmul(x, layer["w1"]).astype(jnp.float32))
-        up = _matmul(x, layer["w3"]).astype(jnp.float32)
-        h = h + _matmul((gate * up).astype(cfg.dtype), layer["w2"])
+        h = h + (
+            _dense_mlp(cfg, layer, x) if mlp_fn is None else mlp_fn(layer, x)
+        )
         return h, (k_pool, v_pool)
 
     h, (ks, vs) = lax.scan(
@@ -382,6 +387,8 @@ class PagedBatchingEngine(ContinuousBatchingEngine):
         mesh=None,
         pallas_attention: bool | None = None,
         share_prefixes: bool = True,
+        ingest=None,
+        paged_step_fn=None,
     ):
         import os
 
@@ -437,13 +444,25 @@ class PagedBatchingEngine(ContinuousBatchingEngine):
         self._prefix_clock = 0
         #: admissions that reused an already-populated shared prefix
         self.prefix_reuse_hits = 0
+        # ``paged_step_fn`` is the family extension point (mirrors the
+        # dense engine's ``step_fn``): another family supplies its own
+        # jitted paged decode — the MoE engine rides paged_decode_step's
+        # mlp_fn hook — and inherits allocator/scheduler/sharing intact.
+        # Forwarded as the base class's step_fn so ``self._step`` is the
+        # ONE decode callable (the dense fallback the base would build
+        # otherwise reads llama layer keys a paged pool / MoE params
+        # tree doesn't have — wrong-but-latent until someone calls it).
+        step = (
+            paged_step_fn
+            if paged_step_fn is not None
+            else _shared_paged_step_fn(
+                c, block_size, pallas=pallas_attention
+            )
+        )
         super().__init__(
             cfg=cfg, params=params, max_slots=max_slots, rng_seed=rng_seed,
             prefill_buckets=prefill_buckets, quantize=quantize,
-            kv_dtype=kv_dtype, mesh=mesh,
-        )
-        self._paged_step = _shared_paged_step_fn(
-            self.cfg, self.block_size, pallas=self.pallas_attention
+            kv_dtype=kv_dtype, mesh=mesh, ingest=ingest, step_fn=step,
         )
         self._inject_block = _shared_inject_block_fn(
             self.cfg, self.block_size
@@ -590,11 +609,6 @@ class PagedBatchingEngine(ContinuousBatchingEngine):
             share.populated = True
         return True
 
-    def _decode_tokens(self):
-        logits, self._cache = self._paged_step(
-            self.params, self._tokens, self._cache
-        )
-        return logits
 
     def _release_slot(self, slot: int) -> None:
         self._free.extend(self._slot_blocks[slot])
