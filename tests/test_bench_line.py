@@ -303,3 +303,40 @@ def test_batch_saturation_lane_structure():
     )
     assert "decision_arithmetic" in out
     assert "XLA path at batch <= 8" in out["pallas_decode_attention_decision"]
+
+
+def test_pallas_decision_measured_branches():
+    """With measured *_pallas points (a real chip) the decision states
+    the measured crossover; without them it keeps the interpret-mode
+    status."""
+    from tpuslo.benchmark.serving_bench import _pallas_decision
+
+    unmeasured = [{"batch": 8, "tokens_per_sec": 100.0}]
+    assert "awaiting a live chip" in _pallas_decision(unmeasured, 512)
+
+    all_failed = [
+        {"batch": 8, "tokens_per_sec": 100.0, "pallas_error": "lowering"},
+    ]
+    decision = _pallas_decision(all_failed, 512)
+    assert "FAILED" in decision and "lowering" in decision
+
+    partial_failure = [
+        {"batch": 8, "tokens_per_sec": 100.0, "tokens_per_sec_pallas": 90.0},
+        {"batch": 32, "tokens_per_sec": 80.0, "pallas_error": "oom"},
+    ]
+    decision = _pallas_decision(partial_failure, 512)
+    assert "MEASURED" in decision
+    assert "FAILED at batches [32]" in decision and "oom" in decision
+
+    kernel_wins = [
+        {"batch": 8, "tokens_per_sec": 100.0, "tokens_per_sec_pallas": 90.0},
+        {"batch": 32, "tokens_per_sec": 80.0, "tokens_per_sec_pallas": 160.0},
+    ]
+    decision = _pallas_decision(kernel_wins, 512)
+    assert "MEASURED" in decision and "[32]" in decision
+
+    xla_wins = [
+        {"batch": 8, "tokens_per_sec": 100.0, "tokens_per_sec_pallas": 90.0},
+    ]
+    decision = _pallas_decision(xla_wins, 512)
+    assert "MEASURED" in decision and "XLA masked-pool path wins" in decision

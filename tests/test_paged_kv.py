@@ -242,6 +242,23 @@ def test_block_size_beyond_max_seq_len_rejected():
         )
 
 
+def test_moe_paged_engine_fails_fast_on_bad_geometry(monkeypatch):
+    """The MoE subclass builds its (expensive) ingest engine before
+    ``super().__init__``; the geometry check must come first so a bad
+    block size never reaches param init / jit setup.  Ordering is
+    asserted directly: the ingest factory must not be called."""
+    from tpuslo.models.mixtral import MoEPagedBatchingEngine, mixtral_tiny
+
+    def boom(*a, **k):  # pragma: no cover - failure path
+        raise AssertionError("ingest built before geometry validation")
+
+    monkeypatch.setattr(MoEPagedBatchingEngine, "_make_ingest", boom)
+    with pytest.raises(ValueError, match="multiple"):
+        MoEPagedBatchingEngine(
+            cfg=mixtral_tiny(max_seq_len=96), block_size=64
+        )
+
+
 def test_parked_lane_past_table_width_writes_only_null_block():
     """Parked (released) lanes keep decoding — the batch is fixed
     shape — and their lengths keep climbing.  Once length walks past

@@ -490,6 +490,21 @@ class MoEServeEngine:
         logits.block_until_ready()
         return logits, cache, len(ids)
 
+    def prefill_ids(self, ids: list[int]):
+        """Bucketed single-row prefill of already-encoded ids — the
+        same contract as :meth:`tpuslo.models.serve.ServeEngine.
+        prefill_ids` (logits (1, vocab), cache with length=len(ids)).
+        Parity harnesses teacher-force divergent streams through this
+        to check whether a token flip was a genuine near-tie."""
+        from tpuslo.models.serve import _bucket
+
+        bucket = _bucket(len(ids), self.prefill_buckets)
+        tokens = jnp.asarray([ids + [0] * (bucket - len(ids))], jnp.int32)
+        return self._prefill(
+            self.params, tokens, self._init_cache(1),
+            true_length=jnp.asarray(len(ids), jnp.int32),
+        )
+
     def decode_cap_tokens(self, longest_prompt_len: int) -> int:
         """Same budget rule as :meth:`generate`: full decode chunks
         only (the MoE engine has no single-token tail path).  The
@@ -781,6 +796,9 @@ class MoEPagedBatchingEngine(_MoEBatchedContract, PagedBatchingEngine):
         mesh: Mesh | None = None,
     ):
         cfg = self._require_drop_free(cfg or mixtral_tiny(max_seq_len=256))
+        # Fail fast on bad block geometry BEFORE the expensive MoE
+        # ingest build — same contract the base __init__ documents.
+        PagedBatchingEngine.validate_block_geometry(cfg, block_size)
         ingest = self._make_ingest(
             cfg, params, rng_seed, prefill_buckets, decode_chunk_size,
             kv_dtype, mesh,

@@ -373,6 +373,31 @@ class PagedBatchingEngine(ContinuousBatchingEngine):
     free waits at the queue head until a completion releases some.
     """
 
+    @staticmethod
+    def validate_block_geometry(cfg, block_size: int) -> None:
+        """Refuse block geometries the prompt-KV splice cannot honor.
+
+        inject_prompt_block copies aligned block_size windows out of a
+        (L, 1, max_seq_len, ...) dense row; if max_seq_len is not a
+        block multiple, the last window's dynamic_slice start clamps
+        and silently copies a SHIFTED window into the physical block —
+        wrong prompt KV, wrong tokens, no error.  Exposed as a
+        staticmethod so subclasses that build expensive state before
+        ``super().__init__`` (the MoE family's ingest engine) can fail
+        fast on the same check.
+        """
+        if block_size > cfg.max_seq_len:
+            raise ValueError(
+                f"block_size={block_size} exceeds max_seq_len="
+                f"{cfg.max_seq_len}"
+            )
+        if cfg.max_seq_len % block_size != 0:
+            raise ValueError(
+                f"max_seq_len={cfg.max_seq_len} must be a multiple "
+                f"of block_size={block_size}: the prompt-KV splice copies "
+                "aligned windows and a ragged tail would be copied shifted"
+            )
+
     def __init__(
         self,
         cfg: LlamaConfig | None = None,
@@ -412,22 +437,7 @@ class PagedBatchingEngine(ContinuousBatchingEngine):
         # engine init so a bad block geometry fails fast — the default
         # mirrors ContinuousBatchingEngine's.
         c = cfg if cfg is not None else llama_tiny(max_seq_len=512)
-        # inject_prompt_block copies aligned block_size windows out of a
-        # (L, 1, max_seq_len, ...) dense row; if max_seq_len is not a
-        # block multiple, the last window's dynamic_slice start clamps
-        # and silently copies a SHIFTED window into the physical block —
-        # wrong prompt KV, wrong tokens, no error.  Refuse the config.
-        if block_size > c.max_seq_len:
-            raise ValueError(
-                f"block_size={block_size} exceeds max_seq_len="
-                f"{c.max_seq_len}"
-            )
-        if c.max_seq_len % block_size != 0:
-            raise ValueError(
-                f"max_seq_len={c.max_seq_len} must be a multiple "
-                f"of block_size={block_size}: the prompt-KV splice copies "
-                "aligned windows and a ragged tail would be copied shifted"
-            )
+        self.validate_block_geometry(c, block_size)
         # Default pool: half the dense reservation — the honest claim
         # this engine makes is "same workloads, half the KV HBM".
         if n_blocks is None:
@@ -608,7 +618,6 @@ class PagedBatchingEngine(ContinuousBatchingEngine):
         if populate_shared:
             share.populated = True
         return True
-
 
     def _release_slot(self, slot: int) -> None:
         self._free.extend(self._slot_blocks[slot])
