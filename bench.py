@@ -524,6 +524,9 @@ def _digest_serving(serving: dict) -> dict:
     int8 = serving.get("int8") or {}
     if int8.get("decode_tokens_per_sec") is not None:
         d["int8_8b_tokens_per_sec"] = int8["decode_tokens_per_sec"]
+    spec = serving.get("speculative") or {}
+    if spec.get("verify_speedup") is not None:
+        d["spec_verify_speedup"] = spec["verify_speedup"]
     for key in ("error", "tpu_error"):
         if serving.get(key):
             d[key] = str(serving[key])[:120]

@@ -305,6 +305,29 @@ def test_batch_saturation_lane_structure():
     assert "XLA path at batch <= 8" in out["pallas_decode_attention_decision"]
 
 
+def test_speculative_lane_structure():
+    """The lane publishes the three per-round costs plus the derived
+    verify speedup / breakeven acceptance / projected speedups, and the
+    projection is monotone in acceptance."""
+    import jax
+
+    from tpuslo.benchmark.serving_bench import _speculative_lane
+    from tpuslo.models.llama import init_params, llama_tiny
+
+    cfg = llama_tiny(max_seq_len=128)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    out = _speculative_lane(cfg, params, k=2, timed_steps=2)
+    for key in ("t_decode_ms", "t_verify_ms", "t_draft_chunk_ms"):
+        assert out[key] > 0
+    assert out["verify_speedup"] > 0
+    assert out["draft_n_params"] < sum(
+        x.size for x in jax.tree.leaves(params)
+    )
+    speedups = [out["projected_speedup"][a] for a in ("0.6", "0.8", "1.0")]
+    assert speedups == sorted(speedups)
+    assert "identical" in out["exactness"]
+
+
 def test_pallas_decision_measured_branches():
     """With measured *_pallas points (a real chip) the decision states
     the measured crossover; without them it keeps the interpret-mode

@@ -489,3 +489,21 @@ def test_moe_paged_tp_matches_single_device():
                                      stop_at_eos=False)
         ]
         assert results[rid] == expect, prompt
+
+
+def test_generation_prompt_ids_uses_moe_cap_despite_prefill_ids():
+    """Regression: MoEServeEngine now has prefill_ids, so the old
+    hasattr-based dense/MoE dispatch in _generation_prompt_ids would
+    teacher-force a LONGER context than the MoE engine ever decoded
+    from.  The cap must come from the engine's own rule."""
+    from tpuslo.models.mixtral import MoEServeEngine, mixtral_tiny
+    from tpuslo.models.serve import _generation_prompt_ids
+
+    cfg = mixtral_tiny(max_seq_len=32)
+    moe = MoEServeEngine(
+        cfg=cfg, prefill_buckets=(32,), decode_chunk_size=4
+    )
+    assert hasattr(moe, "prefill_ids")
+    assert moe.generation_prompt_cap() == 27  # min(32, 32 - 4 - 1)
+    ids = _generation_prompt_ids(moe, "x" * 100)
+    assert len(ids) == 27

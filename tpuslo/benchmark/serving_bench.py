@@ -288,6 +288,112 @@ def _paged_cpu_config():
     )
 
 
+def _speculative_lane(
+    cfg, params, k: int = 4, timed_steps: int = 12
+) -> dict[str, Any]:
+    """Speculative-decoding mechanics on the current platform.
+
+    Random-init weights make draft/target token agreement chance-level,
+    so an end-to-end acceptance-driven speedup would be noise here (the
+    exactness guarantee and acceptance accounting are unit-tested in
+    tests/test_speculative.py).  What IS hardware truth, and what this
+    lane measures, are the three per-round costs the speculative
+    speedup formula is built from:
+
+    * ``t_decode_ms`` — one sequential decode step on the target (the
+      baseline cost per token);
+    * ``t_verify_ms`` — ONE verify_chunk pass scoring k+1 positions
+      (the MXU-batched term that makes speculation pay: k+1 positions
+      for roughly one weight stream);
+    * ``t_draft_chunk_ms`` — k draft tokens in one device call from a
+      depth-pruned self-speculative draft (target config with half the
+      layers — the pairing that needs no second checkpoint).
+
+    Published derivatives: ``verify_speedup`` = (k+1)*t_decode/t_verify,
+    ``breakeven_acceptance`` where round cost equals plain decode, and
+    ``projected_speedup`` at acceptance 0.6/0.8/1.0 —
+    speedup(a) = (1 + a*k) * t_decode / (t_draft_chunk + t_verify).
+    """
+    from dataclasses import replace
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpuslo.models.llama import (
+        decode_chunk,
+        decode_step,
+        init_kv_cache,
+        init_params,
+        param_count,
+        verify_chunk,
+    )
+
+    start_len = min(64, cfg.max_seq_len // 2)
+
+    def mid_cache(p_cfg):
+        cache = init_kv_cache(p_cfg, 1)
+        return {**cache, "length": jnp.asarray(start_len, jnp.int32)}
+
+    def time_loop(fn, p, first_args) -> float:
+        """ms per call; fn donates and returns the cache."""
+        out = fn(p, *first_args)  # compile
+        jax.block_until_ready(out)
+        cache = out[-1]
+        t0 = time.perf_counter()
+        for _ in range(timed_steps):
+            out = fn(p, *first_args[:-1], cache)
+            cache = out[-1]
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / timed_steps * 1e3
+
+    tok = jnp.zeros((1,), jnp.int32)
+    chunk = jnp.zeros((1, k + 1), jnp.int32)
+
+    step_fn = jax.jit(partial(decode_step, cfg=cfg), donate_argnums=(2,))
+    t_decode = time_loop(step_fn, params, (tok, mid_cache(cfg)))
+
+    # verify_chunk leaves ``length`` unchanged, so looping on the
+    # returned cache re-scores the same k+1 window every iteration.
+    verify_fn = jax.jit(partial(verify_chunk, cfg=cfg), donate_argnums=(2,))
+    t_verify = time_loop(verify_fn, params, (chunk, mid_cache(cfg)))
+
+    draft_cfg = replace(cfg, n_layers=max(1, cfg.n_layers // 2))
+    draft_params = init_params(jax.random.PRNGKey(11), draft_cfg)
+    draft_fn = jax.jit(
+        partial(decode_chunk, cfg=draft_cfg, num_tokens=k),
+        donate_argnums=(2,),
+    )
+    try:
+        t_draft = time_loop(
+            draft_fn, draft_params, (tok, mid_cache(draft_cfg))
+        )
+    finally:
+        _free_params(draft_params)
+
+    round_cost = t_draft + t_verify
+    projected = {
+        str(a): round((1 + a * k) * t_decode / round_cost, 3)
+        for a in (0.6, 0.8, 1.0)
+    }
+    return {
+        "k": k,
+        "draft": f"self-speculative: target with n_layers="
+        f"{draft_cfg.n_layers} of {cfg.n_layers}",
+        "draft_n_params": param_count(draft_cfg),
+        "t_decode_ms": round(t_decode, 3),
+        "t_verify_ms": round(t_verify, 3),
+        "t_draft_chunk_ms": round(t_draft, 3),
+        "verify_speedup": round((k + 1) * t_decode / t_verify, 3),
+        "breakeven_acceptance": round(
+            (round_cost / t_decode - 1) / k, 3
+        ),
+        "projected_speedup": projected,
+        "exactness": "emitted stream identical to target-only greedy "
+        "(unit-tested: tests/test_speculative.py)",
+    }
+
+
 def _pallas_decision(curve: list, ctx: int) -> str:
     """Build/no-build verdict for the block-sparse decode kernel.
 
@@ -937,6 +1043,12 @@ def run(platform: str = "auto", model: str = "auto") -> dict[str, Any]:
     out["prefill_bucket"] = bucket
     out["prefill_tokens_per_sec"] = round(prefill_tps, 1)
     out["mfu_prefill"] = mfu(prefill_tps)
+
+    # --- speculative decoding mechanics ---------------------------------
+    try:
+        out["speculative"] = _speculative_lane(cfg, params)
+    except Exception as exc:  # noqa: BLE001 - additive lane
+        out["speculative"] = {"error": str(exc)[:200]}
 
     # --- KV representations: int8 KV + paged pool ----------------------
     paged_kw: dict[str, Any] = {}

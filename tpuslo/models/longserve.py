@@ -94,13 +94,18 @@ def sp_cache_shardings(mesh: Mesh, axis_name: str = "sp"):
     )
 
 
-def _sp_prefill_body(params, tokens, cfg: LlamaConfig, axis_name: str):
+def _sp_prefill_body(
+    params, tokens, true_length, cfg: LlamaConfig, axis_name: str
+):
     """shard_map body.  tokens: (B, S_local) — the local context shard.
 
-    Returns (last-position logits (B, vocab), ks (L,B,S_local,KV,HD),
-    vs (..)) with the KV left sharded in place.
+    Returns (logits (B, vocab) at position ``true_length - 1``,
+    ks (L,B,S_local,KV,HD), vs (..)) with the KV left sharded in
+    place.  ``true_length`` covers pad-bucketed prompts (the serving
+    handoff in :mod:`tpuslo.models.sp_serve`): the selected position
+    can live on ANY shard, and pad KV past it stays masked by the
+    consumer's ``length`` discipline.
     """
-    p = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     B, S_loc = tokens.shape
     H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -128,11 +133,63 @@ def _sp_prefill_body(params, tokens, cfg: LlamaConfig, axis_name: str):
 
     h, (ks, vs) = lax.scan(layer_step, h, params["layers"])
     h = rms_norm(h, params["final_norm"], cfg.norm_eps)
-    # Last global position lives on the last shard; psum broadcasts.
-    h_last = jnp.where(idx == p - 1, h[:, -1, :], jnp.zeros_like(h[:, -1, :]))
-    h_last = lax.psum(h_last, axis_name)
+    # Position ``true_length - 1`` lives on exactly one shard: every
+    # device computes its clipped candidate row, masks it unless local,
+    # and one psum replicates the real row everywhere.
+    tl = jnp.broadcast_to(jnp.asarray(true_length, jnp.int32), (B,))
+    local_pos = tl - 1 - idx * S_loc  # (B,)
+    in_range = (local_pos >= 0) & (local_pos < S_loc)
+    clipped = jnp.clip(local_pos, 0, S_loc - 1)
+    h_last = jnp.take_along_axis(h, clipped[:, None, None], axis=1)[:, 0]
+    h_last = lax.psum(
+        jnp.where(in_range[:, None], h_last, jnp.zeros_like(h_last)),
+        axis_name,
+    )
     logits = _matmul(h_last, params["output"]).astype(jnp.float32)
     return logits, ks, vs
+
+
+def sp_prefill_raw(
+    params: PyTree,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    axis_name: str = "sp",
+    true_length: jax.Array | None = None,
+):
+    """Ring-attention prefill, returning the sharded KV leaves.
+
+    ``(logits (B, vocab) at true_length - 1, ks, vs (L, B, S, KV, HD)
+    sequence-sharded on the mesh)``.  Shared machinery: the
+    long-context path (:func:`sp_prefill`) keeps the KV sharded and
+    decodes distributed; the serving handoff
+    (:func:`tpuslo.models.sp_serve.sp_prefill_into_cache`) gathers it
+    into a dense cache for the ordinary decode engine.
+    """
+    sp = mesh.shape[axis_name]
+    B, S = tokens.shape
+    if S % sp:
+        raise ValueError(f"context length {S} not divisible by sp={sp}")
+    if true_length is None:
+        true_length = jnp.asarray(S, jnp.int32)
+    # Host-level API (never called under jit): an out-of-range length
+    # would make every shard's row-selection mask false and the psum
+    # return output-projection-of-zero — plausible-looking garbage
+    # logits.  Refuse it loudly instead.
+    tl_arr = jnp.asarray(true_length, jnp.int32)
+    if not bool(jnp.all((tl_arr >= 1) & (tl_arr <= S))):
+        raise ValueError(
+            f"true_length {true_length} outside [1, {S}] — logits "
+            "would silently come from a zero hidden state"
+        )
+    fn = shard_map(
+        partial(_sp_prefill_body, cfg=cfg, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(P(), P(None, axis_name), P()),
+        out_specs=(P(), P(None, None, axis_name, None, None),
+                   P(None, None, axis_name, None, None)),
+    )
+    return fn(params, tokens, jnp.asarray(true_length, jnp.int32))
 
 
 def sp_prefill(
@@ -148,18 +205,8 @@ def sp_prefill(
     Returns (last-token logits, sp cache) — context KV sharded, tail
     empty.
     """
-    sp = mesh.shape[axis_name]
-    B, S = tokens.shape
-    if S % sp:
-        raise ValueError(f"context length {S} not divisible by sp={sp}")
-    fn = shard_map(
-        partial(_sp_prefill_body, cfg=cfg, axis_name=axis_name),
-        mesh=mesh,
-        in_specs=(P(), P(None, axis_name)),
-        out_specs=(P(), P(None, None, axis_name, None, None),
-                   P(None, None, axis_name, None, None)),
-    )
-    logits, ks, vs = fn(params, tokens)
+    B = tokens.shape[0]
+    logits, ks, vs = sp_prefill_raw(params, tokens, cfg, mesh, axis_name)
     # Build the cache around the sharded KV the prefill just produced —
     # allocating a zero context buffer only to overwrite it would cost
     # a full context cache worth of HBM at 128k scale.

@@ -476,11 +476,7 @@ class MoEServeEngine:
             )
         from tpuslo.models.serve import _bucket, encode_bytes
 
-        chunk = self.decode_chunk_size
-        max_prompt = max(
-            1, min(self.prefill_buckets[-1], self.cfg.max_seq_len - chunk - 1)
-        )
-        ids = encode_bytes(prompt, max_prompt)
+        ids = encode_bytes(prompt, self.generation_prompt_cap())
         bucket = _bucket(len(ids), self.prefill_buckets)
         tokens = jnp.asarray([ids + [0] * (bucket - len(ids))], jnp.int32)
         logits, cache = self._prefill(
@@ -489,6 +485,16 @@ class MoEServeEngine:
         )
         logits.block_until_ready()
         return logits, cache, len(ids)
+
+    def generation_prompt_cap(self) -> int:
+        """Max prompt ids :meth:`generate` decodes from: the MoE
+        engine budgets a whole decode chunk after the prompt (it has
+        no single-token tail path), unlike the dense engine's
+        ``max_seq_len - 2``."""
+        chunk = self.decode_chunk_size
+        return max(
+            1, min(self.prefill_buckets[-1], self.cfg.max_seq_len - chunk - 1)
+        )
 
     def prefill_ids(self, ids: list[int]):
         """Bucketed single-row prefill of already-encoded ids — the

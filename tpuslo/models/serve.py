@@ -100,15 +100,11 @@ def _generation_prompt_ids(engine, prompt: str) -> list[int]:
     """The exact prompt ids ``engine.generate`` would decode from —
     truncation rules differ between the dense and MoE engines, and a
     parity check teacher-forcing a DIFFERENT context than the one that
-    produced the tokens would silently verify nothing."""
-    if hasattr(engine, "prefill_ids"):
-        return encode_bytes(prompt, max(1, engine.cfg.max_seq_len - 2))
-    chunk = engine.decode_chunk_size
-    max_prompt = max(
-        1,
-        min(engine.prefill_buckets[-1], engine.cfg.max_seq_len - chunk - 1),
-    )
-    return encode_bytes(prompt, max_prompt)
+    produced the tokens would silently verify nothing.  Each engine
+    states its own rule via ``generation_prompt_cap`` (a hasattr probe
+    on ``prefill_ids`` used to stand in for "dense vs MoE" — it broke
+    the moment the MoE engine grew a ``prefill_ids`` of its own)."""
+    return encode_bytes(prompt, engine.generation_prompt_cap())
 
 
 def stream_parity(
@@ -674,6 +670,13 @@ class ServeEngine:
         }
         return final_logits, cache
 
+    def generation_prompt_cap(self) -> int:
+        """Max prompt ids :meth:`generate` decodes from (the dense
+        engine's truncation rule; the MoE engine overrides with its
+        chunk-budget rule).  Parity harnesses teacher-force exactly
+        this many ids."""
+        return max(1, self.cfg.max_seq_len - 2)
+
     def prefill_ids(self, ids: list[int]):
         """Bucketed single-row prefill of already-encoded ids.
 
@@ -842,6 +845,57 @@ class ServeEngine:
             ids = encode_bytes(prompt, max(1, self.cfg.max_seq_len - 2))
             total_len = len(ids)
             logits, cache = self._ingest_ids(ids)
+        logits.block_until_ready()
+        return logits, cache, total_len
+
+    def ingest_prompt_sp(
+        self, prompt: str, sp_mesh, axis_name: str = "sp",
+        pad_quantum: int = 64,
+    ):
+        """Long-prompt ingestion over a sequence-parallel mesh.
+
+        The single-device path ingests past the largest bucket by
+        serial chunked appends (:meth:`ingest_prompt`); this path runs
+        ONE :func:`tpuslo.models.sp_serve.sp_prefill` over the mesh —
+        ring attention, O(S/p) activations per device — and installs
+        the KV into an ordinary dense cache, so decode continues on
+        the engine's normal loop.  Same return contract as
+        :meth:`ingest_prompt`: (logits, single-row cache, total_len).
+
+        The padded length snaps to ``axis_size * pad_quantum`` so
+        prompt lengths share compiled shapes (the bucketed-prefill
+        discipline — per-length shapes would be a recompile storm, the
+        exact failure mode the toolkit attributes).  bf16 dense caches
+        only: the sp handoff targets the single-device decode path
+        (compose tp/int8 by resharding after install if needed).
+        """
+        if self.mesh is not None or self.kv_dtype != "bf16" or self.quantized:
+            raise ValueError(
+                "ingest_prompt_sp targets the single-device bf16 decode "
+                "path; serve tp/int8 engines through ingest_prompt"
+            )
+        from tpuslo.models.sp_serve import sp_prefill_into_cache
+
+        n_sp = sp_mesh.shape[axis_name]
+        quantum = n_sp * pad_quantum
+        ids = encode_bytes(prompt, max(1, self.cfg.max_seq_len - 2))
+        total_len = len(ids)
+        # Snap to the quantum ladder, clipped to the largest sp-aligned
+        # length the cache can hold.
+        aligned_cap = (self.cfg.max_seq_len // n_sp) * n_sp
+        padded = min(-(-total_len // quantum) * quantum, aligned_cap)
+        if padded < total_len:
+            raise ValueError(
+                f"cfg.max_seq_len={self.cfg.max_seq_len} cannot hold a "
+                f"{total_len}-id prompt at sp axis {n_sp} (aligned "
+                f"capacity {aligned_cap})"
+            )
+        tokens = jnp.asarray([ids + [0] * (padded - total_len)], jnp.int32)
+        logits, cache = sp_prefill_into_cache(
+            self.params, tokens, self._new_cache(1), self.cfg, sp_mesh,
+            axis_name=axis_name,
+            true_length=jnp.asarray(total_len, jnp.int32),
+        )
         logits.block_until_ready()
         return logits, cache, total_len
 

@@ -1,0 +1,71 @@
+"""Sequence-parallel prefill handoff: ring attention fills a dense cache.
+
+Two long-prompt regimes, one prefill implementation
+(:func:`tpuslo.models.longserve.sp_prefill_raw` — ring attention over
+the ``sp`` mesh axis, O(S/p) activations per device):
+
+* **Context exceeds one chip's KV** (the 128k case):
+  :mod:`tpuslo.models.longserve` keeps the KV sharded in place and
+  decodes distributed (partial-attention merge per token).
+* **Context fits one chip, but prefill latency hurts** (this module):
+  prefill is the O(S²) compute-bound phase, so sharding it over sp
+  cuts long-prompt TTFT ~p×, while decode — one token, latency-bound,
+  no use for sp — continues on the ordinary single-device engine.
+  The KV all-gathers into the dense cache layout exactly once, at the
+  handoff boundary.
+
+The reference toolkit has no sequence parallelism anywhere (SURVEY.md
+§5 "long-context: absent"); its demo's ``context_long`` profile just
+inflates simulated latencies (``/root/reference/demo/rag-service/
+main.go:688-696``).  Here both long-context regimes are real served
+paths.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpuslo.models.llama import LlamaConfig, PyTree
+from tpuslo.models.longserve import sp_prefill_raw
+
+# Re-exported: the raw sharded prefill IS this module's compute path.
+sp_prefill = sp_prefill_raw
+
+
+def sp_prefill_into_cache(
+    params: PyTree,
+    tokens: jax.Array,
+    cache: PyTree,
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    axis_name: str = "sp",
+    true_length: jax.Array | None = None,
+) -> tuple[jax.Array, PyTree]:
+    """:func:`sp_prefill_raw` with the dense-cache contract of
+    :func:`tpuslo.models.llama.prefill`: writes the prompt KV into
+    ``cache`` (bf16 dense layout), sets ``length``, returns the logits
+    the decode loop continues from.  ``true_length`` covers
+    pad-bucketed prompts (pad KV past it is masked by the decode
+    discipline).  The all-gather to the dense layout happens here,
+    once — the handoff point between the sharded prefill and the
+    unsharded decode engine.
+    """
+    from tpuslo.models import kv_cache as kvc
+
+    B, S = tokens.shape
+    if true_length is None:
+        true_length = jnp.asarray(S, jnp.int32)
+    logits, ks, vs = sp_prefill_raw(
+        params, tokens, cfg, mesh, axis_name, true_length=true_length
+    )
+    replicated = NamedSharding(mesh, P())
+    ks = jax.device_put(ks, replicated)
+    vs = jax.device_put(vs, replicated)
+    cache = {
+        "k": kvc.kv_write_stacked(cache["k"], ks),
+        "v": kvc.kv_write_stacked(cache["v"], vs),
+        "length": jnp.asarray(true_length, jnp.int32),
+    }
+    return logits, cache
