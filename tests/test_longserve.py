@@ -110,3 +110,67 @@ def test_sp_decode_tail_full_raises():
 # Compile-heavy module: excluded from the sub-2-minute fast gate
 # (`make test-fast` / pytest -m "not slow"); the full suite runs it.
 pytestmark = pytest.mark.slow
+
+
+def test_sp_int8_context_kv_structure_and_bytes():
+    """int8 context: dict leaves, ~half the context HBM, tail bf16."""
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (1, 64), 0, cfg.vocab_size
+    )
+    _, cache = sp_prefill(params, tokens, cfg, _mesh(4), kv_dtype="int8")
+    assert set(cache["k_ctx"].keys()) == {"q", "s"}
+    assert cache["k_ctx"]["q"].dtype == jnp.int8
+    assert cache["k_tail"].dtype == cfg.dtype  # tail stays bf16
+    bf16_bytes = (
+        np.prod(cache["k_ctx"]["q"].shape)
+        * jnp.dtype(cfg.dtype).itemsize
+    )
+    int8_bytes = cache["k_ctx"]["q"].nbytes + cache["k_ctx"]["s"].nbytes
+    assert int8_bytes < 0.8 * bf16_bytes
+
+
+def test_sp_int8_context_decode_close_to_bf16():
+    """Quantizing the frozen context must not meaningfully move the
+    decode logits (per-row int8 scales: worst-case rounding is
+    scale/2)."""
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(2), (1, 32), 0, cfg.vocab_size
+    )
+    mesh = _mesh(4)
+    logits_bf, cache_bf = sp_prefill(params, tokens, cfg, mesh)
+    logits_i8, cache_i8 = sp_prefill(
+        params, tokens, cfg, mesh, kv_dtype="int8"
+    )
+    # Prefill logits are computed pre-quantization: identical paths.
+    assert float(jnp.max(jnp.abs(logits_bf - logits_i8))) < 1e-5
+
+    tok = jnp.argmax(logits_bf, -1).astype(jnp.int32)
+    for _ in range(3):
+        lb, cache_bf = sp_decode_step(params, tok, cache_bf, cfg, mesh)
+        li, cache_i8 = sp_decode_step(params, tok, cache_i8, cfg, mesh)
+        assert float(jnp.max(jnp.abs(lb - li))) < 0.25
+        tok = jnp.argmax(lb, -1).astype(jnp.int32)
+
+
+def test_sp_generate_int8_runs():
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(3), (1, 32), 0, cfg.vocab_size
+    )
+    out = sp_generate(
+        params, tokens, cfg, _mesh(2), max_new_tokens=4, kv_dtype="int8"
+    )
+    assert out.shape == (1, 4)
+
+
+def test_sp_prefill_rejects_unknown_kv_dtype():
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.zeros((1, 32), jnp.int32)
+    with pytest.raises(ValueError):
+        sp_prefill(params, tokens, cfg, _mesh(2), kv_dtype="fp8")

@@ -64,19 +64,18 @@ def _tail_buffers(cfg: LlamaConfig, batch: int, tail_max: int):
     }
 
 
-def init_sp_cache(cfg: LlamaConfig, batch: int, ctx_len: int, tail_max: int):
-    """Sequence-parallel cache: sharded context + replicated tail."""
-    L, KV, HD = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
-    return {
-        "k_ctx": jnp.zeros((L, batch, ctx_len, KV, HD), cfg.dtype),
-        "v_ctx": jnp.zeros((L, batch, ctx_len, KV, HD), cfg.dtype),
-        **_tail_buffers(cfg, batch, tail_max),
-    }
+def _ctx_spec(axis_name: str, int8: bool):
+    """Partition layout of one context-KV leaf (dict when int8: the
+    scale tensor has one fewer trailing dim)."""
+    full = P(None, None, axis_name, None, None)
+    if int8:
+        return {"q": full, "s": P(None, None, axis_name, None)}
+    return full
 
 
-def sp_cache_specs(axis_name: str = "sp"):
+def sp_cache_specs(axis_name: str = "sp", int8: bool = False):
     """The ONE definition of the sp-cache partition layout."""
-    ctx = P(None, None, axis_name, None, None)
+    ctx = _ctx_spec(axis_name, int8)
     return {
         "k_ctx": ctx,
         "v_ctx": ctx,
@@ -86,16 +85,19 @@ def sp_cache_specs(axis_name: str = "sp"):
     }
 
 
-def sp_cache_shardings(mesh: Mesh, axis_name: str = "sp"):
+def sp_cache_shardings(
+    mesh: Mesh, axis_name: str = "sp", int8: bool = False
+):
     return jax.tree.map(
         lambda spec: NamedSharding(mesh, spec),
-        sp_cache_specs(axis_name),
+        sp_cache_specs(axis_name, int8),
         is_leaf=lambda v: isinstance(v, P),
     )
 
 
 def _sp_prefill_body(
-    params, tokens, true_length, cfg: LlamaConfig, axis_name: str
+    params, tokens, true_length, cfg: LlamaConfig, axis_name: str,
+    kv_dtype: str = "bf16",
 ):
     """shard_map body.  tokens: (B, S_local) — the local context shard.
 
@@ -146,6 +148,10 @@ def _sp_prefill_body(
         axis_name,
     )
     logits = _matmul(h_last, params["output"]).astype(jnp.float32)
+    if kv_dtype == "int8":
+        from tpuslo.models import kv_cache as kvc
+
+        ks, vs = kvc.quantize_kv(ks), kvc.quantize_kv(vs)
     return logits, ks, vs
 
 
@@ -156,6 +162,7 @@ def sp_prefill_raw(
     mesh: Mesh,
     axis_name: str = "sp",
     true_length: jax.Array | None = None,
+    kv_dtype: str = "bf16",
 ):
     """Ring-attention prefill, returning the sharded KV leaves.
 
@@ -165,7 +172,13 @@ def sp_prefill_raw(
     decodes distributed; the serving handoff
     (:func:`tpuslo.models.sp_serve.sp_prefill_into_cache`) gathers it
     into a dense cache for the ordinary decode engine.
+    ``kv_dtype="int8"`` quantizes the context KV per device before it
+    leaves the shard_map (the context is frozen after prefill), so the
+    returned leaves are ``{"q", "s"}`` dicts at half the HBM.
     """
+    from tpuslo.models.kv_cache import validate_kv_dtype
+
+    kv_dtype = validate_kv_dtype(kv_dtype)
     sp = mesh.shape[axis_name]
     B, S = tokens.shape
     if S % sp:
@@ -182,12 +195,15 @@ def sp_prefill_raw(
             f"true_length {true_length} outside [1, {S}] — logits "
             "would silently come from a zero hidden state"
         )
+    ctx = _ctx_spec(axis_name, kv_dtype == "int8")
     fn = shard_map(
-        partial(_sp_prefill_body, cfg=cfg, axis_name=axis_name),
+        partial(
+            _sp_prefill_body, cfg=cfg, axis_name=axis_name,
+            kv_dtype=kv_dtype,
+        ),
         mesh=mesh,
         in_specs=(P(), P(None, axis_name), P()),
-        out_specs=(P(), P(None, None, axis_name, None, None),
-                   P(None, None, axis_name, None, None)),
+        out_specs=(P(), ctx, ctx),
     )
     return fn(params, tokens, jnp.asarray(true_length, jnp.int32))
 
@@ -199,14 +215,18 @@ def sp_prefill(
     mesh: Mesh,
     tail_max: int = 512,
     axis_name: str = "sp",
+    kv_dtype: str = "bf16",
 ):
     """Ingest a long context.  tokens: (B, S) with S % sp == 0.
 
-    Returns (last-token logits, sp cache) — context KV sharded, tail
+    Returns (last-token logits, sp cache) — context KV sharded (int8
+    when ``kv_dtype="int8"``: ~2× the context per device HBM), tail
     empty.
     """
     B = tokens.shape[0]
-    logits, ks, vs = sp_prefill_raw(params, tokens, cfg, mesh, axis_name)
+    logits, ks, vs = sp_prefill_raw(
+        params, tokens, cfg, mesh, axis_name, kv_dtype=kv_dtype
+    )
     # Build the cache around the sharded KV the prefill just produced —
     # allocating a zero context buffer only to overwrite it would cost
     # a full context cache worth of HBM at 128k scale.
@@ -252,7 +272,12 @@ def _sp_decode_body(params, token, cache, cfg: LlamaConfig, axis_name: str):
     idx = lax.axis_index(axis_name)
     B = token.shape[0]
     H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    S_loc = cache["k_ctx"].shape[2]
+    k_ctx_leaf = (
+        cache["k_ctx"]["q"]
+        if isinstance(cache["k_ctx"], dict)
+        else cache["k_ctx"]
+    )
+    S_loc = k_ctx_leaf.shape[2]
     tail_max = cache["k_tail"].shape[2]
     ctx_total = lax.psum(S_loc, axis_name)
 
@@ -276,7 +301,14 @@ def _sp_decode_body(params, token, cache, cfg: LlamaConfig, axis_name: str):
         # Partial over the local context shard, merged across the mesh
         # (pmax/psum with online-softmax correction), then merged with
         # the replicated tail partial computed identically everywhere.
-        m_c, l_c, o_c = _partial_attention(q, k_ctx, v_ctx, ctx_valid)
+        # int8 contexts dequantize here; the dequant fuses into the
+        # score einsum under jit, so HBM reads stay int8.
+        from tpuslo.models import kv_cache as kvc
+
+        m_c, l_c, o_c = _partial_attention(
+            q, kvc.kv_load(k_ctx, cfg.dtype), kvc.kv_load(v_ctx, cfg.dtype),
+            ctx_valid,
+        )
         m_g = lax.pmax(m_c, axis_name)
         corr = jnp.exp(m_c - m_g)
         l_g = lax.psum(l_c * corr, axis_name)
@@ -345,7 +377,9 @@ def sp_decode_step(
             )
     except (TypeError, jax.errors.TracerArrayConversionError):
         pass  # traced: budget enforced by the caller
-    cache_specs = sp_cache_specs(axis_name)
+    cache_specs = sp_cache_specs(
+        axis_name, int8=isinstance(cache["k_ctx"], dict)
+    )
     fn = shard_map(
         partial(_sp_decode_body, cfg=cfg, axis_name=axis_name),
         mesh=mesh,
@@ -363,6 +397,7 @@ def sp_generate(
     max_new_tokens: int,
     tail_max: int | None = None,
     axis_name: str = "sp",
+    kv_dtype: str = "bf16",
 ) -> jax.Array:
     """Greedy long-context generation → (B, max_new_tokens) int32."""
     tail_max = tail_max or max(64, max_new_tokens + 1)
@@ -371,7 +406,8 @@ def sp_generate(
             f"max_new_tokens={max_new_tokens} needs tail_max > itself"
         )
     logits, cache = sp_prefill(
-        params, tokens, cfg, mesh, tail_max=tail_max, axis_name=axis_name
+        params, tokens, cfg, mesh, tail_max=tail_max, axis_name=axis_name,
+        kv_dtype=kv_dtype,
     )
     step = jax.jit(
         partial(sp_decode_step, cfg=cfg, mesh=mesh, axis_name=axis_name),
