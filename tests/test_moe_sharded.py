@@ -166,3 +166,61 @@ def test_mixtral_8x7b_tp8_decode_chunk_compiles():
 # Compile-heavy module: excluded from the sub-2-minute fast gate
 # (`make test-fast` / pytest -m "not slow"); the full suite runs it.
 pytestmark = pytest.mark.slow
+
+
+def _ep_mesh(ep: int = 2) -> Mesh:
+    return Mesh(np.array(jax.devices()[:ep]), ("ep",))
+
+
+def test_ep_moe_serving_stream_parity():
+    """Expert-parallel serving: experts shard whole over ep, tokens
+    never move (one psum per MoE block at the combine einsum); the
+    stream must match the single-device engine in logit space."""
+    from tpuslo.models.serve import stream_parity
+
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    plain = MoEServeEngine(cfg=cfg, params=params)
+    sharded = MoEServeEngine(cfg=cfg, params=params, mesh=_ep_mesh(2))
+    parity = stream_parity(sharded, plain, "ep moe serving")
+    assert parity["ok"], parity
+
+
+def test_ep_moe_mesh_init_shards_expert_leaves_only():
+    """params=None + ep mesh: experts initialize sharded on axis 1,
+    attention stays replicated, and generation runs."""
+    engine = MoEServeEngine(cfg=_cfg(), mesh=_ep_mesh(2))
+    w1 = engine.params["layers"]["w1"]
+    assert w1.sharding.spec == (None, "ep", None, None)
+    wq = engine.params["layers"]["wq"]
+    assert all(s is None for s in wq.sharding.spec)
+    events = list(engine.generate("ep moe", 4, stop_at_eos=False))
+    assert len(events) == 4
+
+
+def test_ep_moe_indivisible_expert_count_rejected():
+    import pytest
+
+    cfg = mixtral_tiny()  # n_experts=4
+    with pytest.raises(ValueError, match="divide n_experts"):
+        MoEServeEngine(cfg=cfg, mesh=Mesh(
+            np.array(jax.devices()[:3]), ("ep",)
+        ))
+
+
+def test_moe_mesh_without_tp_or_ep_rejected():
+    import pytest
+
+    with pytest.raises(ValueError, match="'tp' or 'ep'"):
+        MoEServeEngine(cfg=_cfg(), mesh=Mesh(
+            np.array(jax.devices()[:2]), ("dp",)
+        ))
+
+
+def test_moe_mesh_with_both_tp_and_ep_rejected():
+    import pytest
+
+    with pytest.raises(ValueError, match="not both"):
+        MoEServeEngine(cfg=_cfg(), mesh=Mesh(
+            np.array(jax.devices()[:4]).reshape(2, 2), ("tp", "ep")
+        ))

@@ -395,28 +395,51 @@ class MoEServeEngine:
         if mesh is not None:
             from tpuslo.models.serve import kv_cache_shardings
 
-            if "tp" not in mesh.axis_names:
+            if "tp" in mesh.axis_names and "ep" in mesh.axis_names:
+                # A combined layout would need expert leaves sharded on
+                # BOTH axes; silently picking one would replicate the
+                # experts over the other axis and quietly multiply
+                # their HBM by its size.
                 raise ValueError(
-                    f"MoE serving mesh must have a 'tp' axis, got "
-                    f"{mesh.axis_names}"
+                    "MoE serving supports a 'tp' OR an 'ep' mesh axis, "
+                    "not both; build a 1-axis mesh for the layout you "
+                    "want"
                 )
-            tp = mesh.shape["tp"]
-            if (
-                self.cfg.n_kv_heads % tp
-                or self.cfg.n_heads % tp
-                or self.cfg.ffn_dim % tp
-            ):
+            if "tp" in mesh.axis_names:
+                tp = mesh.shape["tp"]
+                if (
+                    self.cfg.n_kv_heads % tp
+                    or self.cfg.n_heads % tp
+                    or self.cfg.ffn_dim % tp
+                ):
+                    raise ValueError(
+                        f"tp={tp} must divide n_kv_heads="
+                        f"{self.cfg.n_kv_heads}, n_heads={self.cfg.n_heads} "
+                        f"and ffn_dim={self.cfg.ffn_dim}"
+                    )
+                self._cache_shardings = kv_cache_shardings(mesh, kv_dtype)
+                shardings = tp_serve_param_shardings(mesh)
+            elif "ep" in mesh.axis_names:
+                ep = mesh.shape["ep"]
+                if self.cfg.n_experts % ep:
+                    raise ValueError(
+                        f"ep={ep} must divide n_experts="
+                        f"{self.cfg.n_experts}"
+                    )
+                # Experts shard whole; everything else (including the
+                # KV cache) is replicated.
+                self._cache_shardings = NamedSharding(mesh, P())
+                shardings = ep_serve_param_shardings(mesh)
+            else:
                 raise ValueError(
-                    f"tp={tp} must divide n_kv_heads="
-                    f"{self.cfg.n_kv_heads}, n_heads={self.cfg.n_heads} "
-                    f"and ffn_dim={self.cfg.ffn_dim}"
+                    f"MoE serving mesh must have a 'tp' or 'ep' axis, "
+                    f"got {mesh.axis_names}"
                 )
-            self._cache_shardings = kv_cache_shardings(mesh, kv_dtype)
-            shardings = tp_serve_param_shardings(mesh)
             if params is None:
-                # Initialize DIRECTLY into the tp shardings — no device
-                # ever holds the full expert tree (the 8x7B-over-v5e-8
-                # path, mirroring the dense 70B init discipline).
+                # Initialize DIRECTLY into the selected shardings (tp
+                # or ep) — no device ever holds the full expert tree
+                # (the 8x7B-over-v5e-8 path, mirroring the dense 70B
+                # init discipline).
                 params = jax.jit(
                     partial(init_params, cfg=self.cfg),
                     out_shardings=shardings,
@@ -591,6 +614,46 @@ def tp_serve_param_shardings(mesh: Mesh) -> PyTree:
         },
         "final_norm": ns(P(None)),
         "output": ns(P(None, "tp")),
+    }
+
+
+def ep_serve_param_shardings(mesh: Mesh) -> PyTree:
+    """Expert-parallel SERVING layout over an ``ep`` axis.
+
+    Experts shard WHOLE over ep — each device holds ``E/ep`` complete
+    experts; attention, embeddings, router and the KV cache stay
+    replicated.  Tokens never move: the dispatch einsum partitions over
+    the expert axis and XLA inserts ONE psum at the combine einsum per
+    MoE block — no all_to_all on the latency path, and each device
+    streams only its own experts' weights per token.  This divides the
+    decode weight-bandwidth (the serving bottleneck) AND the expert
+    HBM by ep, at the cost of replicated attention.
+
+    Contrast: :func:`tp_serve_param_shardings` slices *inside* every
+    expert (every device touches every expert's weights);
+    :func:`param_shardings` is the dp x ep TRAINING layout; and
+    :func:`tpuslo.ops.moe.moe_mlp_sharded` is the all_to_all
+    throughput path for token-sharded batches.
+    """
+    ns = lambda spec: NamedSharding(mesh, spec)  # noqa: E731
+    rep = ns(P())
+    return {
+        "embed": rep,
+        "layers": {
+            "attn_norm": rep,
+            "wq": rep,
+            "wk": rep,
+            "wv": rep,
+            "wo": rep,
+            "mlp_norm": rep,
+            "router": rep,
+            # (L, E, D, F) / (L, E, F, D): experts are axis 1.
+            "w1": ns(P(None, "ep", None, None)),
+            "w3": ns(P(None, "ep", None, None)),
+            "w2": ns(P(None, "ep", None, None)),
+        },
+        "final_norm": rep,
+        "output": rep,
     }
 
 
