@@ -224,3 +224,54 @@ def test_moe_mesh_with_both_tp_and_ep_rejected():
         MoEServeEngine(cfg=_cfg(), mesh=Mesh(
             np.array(jax.devices()[:4]).reshape(2, 2), ("tp", "ep")
         ))
+
+
+def test_ep_moe_continuous_batching_matches_plain():
+    """The whole batched scheduler rides ep unchanged: replicated
+    caches, experts sharded, per-request streams identical."""
+    from tpuslo.models.mixtral import MoEContinuousBatchingEngine
+
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    plain = MoEServeEngine(
+        cfg=cfg, params=params, prefill_buckets=(16, 32),
+        decode_chunk_size=4,
+    )
+    batched = MoEContinuousBatchingEngine(
+        cfg=cfg, params=params, max_slots=2, prefill_buckets=(16, 32),
+        decode_chunk_size=4, mesh=_ep_mesh(2),
+    )
+    prompts = ["ep batch one", "ep batch two"]
+    rids = [batched.submit(p, max_new_tokens=5, stop_at_eos=False)
+            for p in prompts]
+    results = batched.run()
+    for rid, prompt in zip(rids, prompts):
+        expect = [
+            e.token_id
+            for e in plain.generate(prompt, max_new_tokens=5,
+                                    stop_at_eos=False)
+        ]
+        assert results[rid] == expect, prompt
+
+
+def test_ep_moe_paged_engine_matches_plain():
+    from tpuslo.models.mixtral import MoEPagedBatchingEngine
+
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    plain = MoEServeEngine(
+        cfg=cfg, params=params, prefill_buckets=(16, 32),
+        decode_chunk_size=4,
+    )
+    paged = MoEPagedBatchingEngine(
+        cfg=cfg, params=params, max_slots=2, block_size=16,
+        prefill_buckets=(16, 32), decode_chunk_size=4, mesh=_ep_mesh(2),
+    )
+    rid = paged.submit("ep paged moe", max_new_tokens=5, stop_at_eos=False)
+    results = paged.run()
+    expect = [
+        e.token_id
+        for e in plain.generate("ep paged moe", max_new_tokens=5,
+                                stop_at_eos=False)
+    ]
+    assert results[rid] == expect

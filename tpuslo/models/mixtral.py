@@ -426,9 +426,10 @@ class MoEServeEngine:
                         f"ep={ep} must divide n_experts="
                         f"{self.cfg.n_experts}"
                     )
-                # Experts shard whole; everything else (including the
-                # KV cache) is replicated.
-                self._cache_shardings = NamedSharding(mesh, P())
+                # Experts shard whole; the cache replicates via the
+                # same helper every mesh path uses (it returns the
+                # replicated layout for tp-less meshes).
+                self._cache_shardings = kv_cache_shardings(mesh, kv_dtype)
                 shardings = ep_serve_param_shardings(mesh)
             else:
                 raise ValueError(
@@ -631,30 +632,15 @@ def ep_serve_param_shardings(mesh: Mesh) -> PyTree:
 
     Contrast: :func:`tp_serve_param_shardings` slices *inside* every
     expert (every device touches every expert's weights);
-    :func:`param_shardings` is the dp x ep TRAINING layout; and
     :func:`tpuslo.ops.moe.moe_mlp_sharded` is the all_to_all
-    throughput path for token-sharded batches.
+    throughput path for token-sharded batches.  The LAYOUT coincides
+    with the dp x ep training placement (:func:`param_shardings` —
+    experts on ep, everything else replicated), so this delegates; the
+    two names exist because the serving rationale (latency: no token
+    movement, one psum) is independent of the training one (capacity:
+    dp gradients psum over replicated attention).
     """
-    ns = lambda spec: NamedSharding(mesh, spec)  # noqa: E731
-    rep = ns(P())
-    return {
-        "embed": rep,
-        "layers": {
-            "attn_norm": rep,
-            "wq": rep,
-            "wk": rep,
-            "wv": rep,
-            "wo": rep,
-            "mlp_norm": rep,
-            "router": rep,
-            # (L, E, D, F) / (L, E, F, D): experts are axis 1.
-            "w1": ns(P(None, "ep", None, None)),
-            "w3": ns(P(None, "ep", None, None)),
-            "w2": ns(P(None, "ep", None, None)),
-        },
-        "final_norm": rep,
-        "output": rep,
-    }
+    return param_shardings(mesh)
 
 
 def param_shardings(mesh: Mesh) -> PyTree:

@@ -262,17 +262,21 @@ def serve_param_shardings(params, mesh):
 
 
 def kv_cache_shardings(mesh, kv_dtype: str = "bf16"):
-    """KV cache (L, B, S, KV, HD): shard KV heads over tp.
+    """KV cache (L, B, S, KV, HD): KV heads shard over the mesh's
+    ``tp`` axis when it has one; serving meshes without tp (the MoE
+    family's expert-parallel layout) replicate the cache — attention
+    is replicated there by design.
 
     int8 caches shard ``q`` like the dense buffer and ``s`` (which
     drops the trailing head_dim axis) on the same KV-head axis."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    side = NamedSharding(mesh, P(None, None, None, "tp", None))
+    tp = "tp" if "tp" in mesh.axis_names else None
+    side = NamedSharding(mesh, P(None, None, None, tp, None))
     if kv_dtype == "int8":
         side = {
             "q": side,
-            "s": NamedSharding(mesh, P(None, None, None, "tp")),
+            "s": NamedSharding(mesh, P(None, None, None, tp)),
         }
     return {
         "k": side,
