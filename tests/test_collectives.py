@@ -243,3 +243,26 @@ def test_prober_timeout_disables_instead_of_stalling():
     assert prober._disabled
     assert any("hang" in line for line in logs)
     assert prober.maybe_probe(1000.0) == []  # stays off
+
+
+def test_multi_slice_mesh_and_batch_layout():
+    """Pure layout (no compile): dcn factors out first, axes order
+    puts dcn outermost, and the batch splits over every data axis."""
+    import pytest
+    from jax.sharding import PartitionSpec as P
+
+    from tpuslo.parallel.mesh import (
+        batch_sharding,
+        make_mesh,
+        plan_for_devices,
+    )
+
+    plan = plan_for_devices(8, slices=2)
+    assert (plan.dcn, plan.n_devices) == (2, 8)
+    mesh = make_mesh(plan)
+    assert mesh.axis_names == ("dcn", "dp", "fsdp", "tp")
+    spec = batch_sharding(mesh).spec
+    assert spec == P(("dcn", "dp", "fsdp"), None)
+
+    with pytest.raises(ValueError, match="not divisible"):
+        plan_for_devices(8, slices=3)

@@ -31,18 +31,36 @@ AXES = ("dp", "fsdp", "tp")
 
 @dataclass(frozen=True)
 class MeshPlan:
+    """Device factorization.  ``dcn > 1`` adds an OUTER multi-slice
+    axis: pure data parallelism across TPU slices connected by DCN
+    (data-center network, ~10-100x slower than ICI).  The axis order
+    makes the bandwidth economics structural: fsdp all-gathers and tp
+    psums ride the inner (ICI) axes because slices replicate the model;
+    the ONLY collective that crosses DCN is the once-per-step gradient
+    all-reduce — the canonical multi-slice layout (each slice trains a
+    full model replica; scale slices for global batch)."""
+
     dp: int = 1
     fsdp: int = 1
     tp: int = 1
+    dcn: int = 1
 
     @property
     def n_devices(self) -> int:
-        return self.dp * self.fsdp * self.tp
+        return self.dcn * self.dp * self.fsdp * self.tp
 
 
-def plan_for_devices(n: int) -> MeshPlan:
+def plan_for_devices(n: int, slices: int = 1) -> MeshPlan:
     """Reasonable default factorization: tp innermost (fastest ICI hops),
-    then fsdp, then dp."""
+    then fsdp, then dp; ``slices > 1`` factors a dcn axis out first
+    (each slice gets the single-slice plan for its own chips)."""
+    if slices > 1:
+        if n % slices:
+            raise ValueError(f"{n} devices not divisible by {slices} slices")
+        inner = plan_for_devices(n // slices)
+        return MeshPlan(
+            dp=inner.dp, fsdp=inner.fsdp, tp=inner.tp, dcn=slices
+        )
     tp = 1
     for candidate in (8, 4, 2):
         if n % candidate == 0:
@@ -64,6 +82,14 @@ def make_mesh(plan: MeshPlan, devices: list | None = None) -> Mesh:
         raise ValueError(
             f"plan needs {plan.n_devices} devices, have {len(devices)}"
         )
+    if plan.dcn > 1:
+        # Multi-slice: the dcn axis is OUTERMOST so a contiguous run of
+        # device ids (one slice's chips) forms each inner submesh —
+        # inner-axis collectives never leave the slice.
+        grid = np.asarray(devices[: plan.n_devices]).reshape(
+            plan.dcn, plan.dp, plan.fsdp, plan.tp
+        )
+        return Mesh(grid, ("dcn", *AXES))
     grid = np.asarray(devices[: plan.n_devices]).reshape(
         plan.dp, plan.fsdp, plan.tp
     )
@@ -98,8 +124,14 @@ def param_shardings(mesh: Mesh) -> dict:
 
 
 def batch_sharding(mesh: Mesh, seq_axis: str | None = None) -> NamedSharding:
-    """Tokens/targets: batch over (dp, fsdp); optionally sequence over sp."""
-    return NamedSharding(mesh, P(("dp", "fsdp"), seq_axis))
+    """Tokens/targets: batch over every data axis the mesh carries
+    (dcn slices, dp, fsdp); optionally sequence over sp.  Params never
+    shard on dcn, so splitting the batch over it is what makes the
+    cross-slice gradient psum the only DCN collective."""
+    data_axes = tuple(
+        a for a in ("dcn", "dp", "fsdp") if a in mesh.axis_names
+    )
+    return NamedSharding(mesh, P(data_axes, seq_axis))
 
 
 def optimizer_state_shardings(opt_abstract, p_shard, mesh: Mesh):
