@@ -275,3 +275,46 @@ def test_ep_moe_paged_engine_matches_plain():
                                 stop_at_eos=False)
     ]
     assert results[rid] == expect
+
+
+def test_moe_sp_generate_matches_dense_chain():
+    """Long-context MoE: ring prefill + distributed decode with the MoE
+    block through the mlp_fn hook matches plain prefill + decode_step
+    greedy on the same tokens."""
+    from tpuslo.models import mixtral
+    from tpuslo.models.llama import init_kv_cache
+
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(5), (1, 32), 0, cfg.vocab_size
+    )
+
+    cache = init_kv_cache(cfg.attn_cfg(), 1)
+    logits, cache = mixtral.prefill(params, tokens, cache, cfg)
+    ref = [jnp.argmax(logits, -1).astype(jnp.int32)]
+    for _ in range(4):
+        logits, cache = mixtral.decode_step(params, ref[-1], cache, cfg)
+        ref.append(jnp.argmax(logits, -1).astype(jnp.int32))
+    ref_seq = jnp.stack(ref, axis=1)
+
+    out = mixtral.sp_generate(
+        params, tokens, cfg, Mesh(np.array(jax.devices()[:4]), ("sp",)),
+        max_new_tokens=5,
+    )
+    assert jnp.array_equal(out, ref_seq), (out, ref_seq)
+
+
+def test_moe_sp_generate_rejects_droppy_config():
+    import pytest
+
+    from tpuslo.models import mixtral
+
+    cfg = mixtral_tiny()
+    droppy = cfg.__class__(**{**cfg.__dict__, "capacity_factor": 1.0})
+    with pytest.raises(ValueError, match="capacity_factor"):
+        mixtral.sp_generate(
+            init_params(jax.random.PRNGKey(0), droppy),
+            jnp.zeros((1, 32), jnp.int32), droppy,
+            Mesh(np.array(jax.devices()[:4]), ("sp",)), max_new_tokens=2,
+        )

@@ -37,6 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpuslo.models.llama import (
     LlamaConfig,
+    _dense_mlp,
     _embed_lookup,
     _matmul,
     apply_rope,
@@ -97,7 +98,7 @@ def sp_cache_shardings(
 
 def _sp_prefill_body(
     params, tokens, true_length, cfg: LlamaConfig, axis_name: str,
-    kv_dtype: str = "bf16",
+    kv_dtype: str = "bf16", mlp_fn=None,
 ):
     """shard_map body.  tokens: (B, S_local) — the local context shard.
 
@@ -128,9 +129,9 @@ def _sp_prefill_body(
         attn = ring_attention(q, k, v, axis_name, n_rep=H // KV)
         h = h + _matmul(attn.reshape(B, S_loc, H * HD), layer["wo"])
         x = rms_norm(h, layer["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu(_matmul(x, layer["w1"]).astype(jnp.float32))
-        up = _matmul(x, layer["w3"]).astype(jnp.float32)
-        h = h + _matmul((gate * up).astype(cfg.dtype), layer["w2"])
+        h = h + (
+            _dense_mlp(cfg, layer, x) if mlp_fn is None else mlp_fn(layer, x)
+        )
         return h, (k, v)
 
     h, (ks, vs) = lax.scan(layer_step, h, params["layers"])
@@ -163,6 +164,7 @@ def sp_prefill_raw(
     axis_name: str = "sp",
     true_length: jax.Array | None = None,
     kv_dtype: str = "bf16",
+    mlp_fn=None,
 ):
     """Ring-attention prefill, returning the sharded KV leaves.
 
@@ -199,7 +201,7 @@ def sp_prefill_raw(
     fn = shard_map(
         partial(
             _sp_prefill_body, cfg=cfg, axis_name=axis_name,
-            kv_dtype=kv_dtype,
+            kv_dtype=kv_dtype, mlp_fn=mlp_fn,
         ),
         mesh=mesh,
         in_specs=(P(), P(None, axis_name), P()),
@@ -216,6 +218,7 @@ def sp_prefill(
     tail_max: int = 512,
     axis_name: str = "sp",
     kv_dtype: str = "bf16",
+    mlp_fn=None,
 ):
     """Ingest a long context.  tokens: (B, S) with S % sp == 0.
 
@@ -225,7 +228,8 @@ def sp_prefill(
     """
     B = tokens.shape[0]
     logits, ks, vs = sp_prefill_raw(
-        params, tokens, cfg, mesh, axis_name, kv_dtype=kv_dtype
+        params, tokens, cfg, mesh, axis_name, kv_dtype=kv_dtype,
+        mlp_fn=mlp_fn,
     )
     # Build the cache around the sharded KV the prefill just produced —
     # allocating a zero context buffer only to overwrite it would cost
@@ -267,7 +271,9 @@ def _merge_partials(m1, l1, o1, m2, l2, o2):
     return m, l1 * c1 + l2 * c2, o1 * c1[..., None] + o2 * c2[..., None]
 
 
-def _sp_decode_body(params, token, cache, cfg: LlamaConfig, axis_name: str):
+def _sp_decode_body(
+    params, token, cache, cfg: LlamaConfig, axis_name: str, mlp_fn=None
+):
     """One decode step.  token: (B,) replicated; context KV sharded."""
     idx = lax.axis_index(axis_name)
     B = token.shape[0]
@@ -329,9 +335,9 @@ def _sp_decode_body(params, token, cache, cfg: LlamaConfig, axis_name: str):
         out = (o / jnp.maximum(l, 1e-30)[..., None]).astype(cfg.dtype)
         h = h + _matmul(out.reshape(B, 1, H * HD), layer["wo"])
         x = rms_norm(h, layer["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu(_matmul(x, layer["w1"]).astype(jnp.float32))
-        up = _matmul(x, layer["w3"]).astype(jnp.float32)
-        h = h + _matmul((gate * up).astype(cfg.dtype), layer["w2"])
+        h = h + (
+            _dense_mlp(cfg, layer, x) if mlp_fn is None else mlp_fn(layer, x)
+        )
         return h, (k_tail, v_tail)
 
     h, (k_tails, v_tails) = lax.scan(
@@ -359,6 +365,7 @@ def sp_decode_step(
     cfg: LlamaConfig,
     mesh: Mesh,
     axis_name: str = "sp",
+    mlp_fn=None,
 ):
     """One distributed decode step → (logits (B, vocab), cache).
 
@@ -381,7 +388,7 @@ def sp_decode_step(
         axis_name, int8=isinstance(cache["k_ctx"], dict)
     )
     fn = shard_map(
-        partial(_sp_decode_body, cfg=cfg, axis_name=axis_name),
+        partial(_sp_decode_body, cfg=cfg, axis_name=axis_name, mlp_fn=mlp_fn),
         mesh=mesh,
         in_specs=(P(), P(), cache_specs),
         out_specs=(P(), cache_specs),
@@ -398,6 +405,7 @@ def sp_generate(
     tail_max: int | None = None,
     axis_name: str = "sp",
     kv_dtype: str = "bf16",
+    mlp_fn=None,
 ) -> jax.Array:
     """Greedy long-context generation → (B, max_new_tokens) int32."""
     tail_max = tail_max or max(64, max_new_tokens + 1)
@@ -407,10 +415,13 @@ def sp_generate(
         )
     logits, cache = sp_prefill(
         params, tokens, cfg, mesh, tail_max=tail_max, axis_name=axis_name,
-        kv_dtype=kv_dtype,
+        kv_dtype=kv_dtype, mlp_fn=mlp_fn,
     )
     step = jax.jit(
-        partial(sp_decode_step, cfg=cfg, mesh=mesh, axis_name=axis_name),
+        partial(
+            sp_decode_step, cfg=cfg, mesh=mesh, axis_name=axis_name,
+            mlp_fn=mlp_fn,
+        ),
         donate_argnums=(2,),
     )
     token = jnp.argmax(logits, axis=-1).astype(jnp.int32)

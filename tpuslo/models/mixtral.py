@@ -586,6 +586,33 @@ class MoEServeEngine:
             toks, last = next_toks, next_last
 
 
+def sp_generate(
+    params: PyTree,
+    tokens: jax.Array,
+    cfg: MixtralConfig,
+    mesh: Mesh,
+    max_new_tokens: int,
+    **kwargs,
+) -> jax.Array:
+    """Long-context MoE generation over an ``sp`` mesh.
+
+    :func:`tpuslo.models.longserve.sp_generate` with the MoE block
+    riding the same ``mlp_fn`` hook as every other llama-family path.
+    Routing is positionwise, so it runs shard-local on each device's
+    sequence slice; the config must be drop-free
+    (``capacity_factor >= n_experts / top_k``) so per-shard capacity
+    buffers can never drop a token that the single-device path keeps —
+    the same contract the batched MoE engines enforce.
+    """
+    from tpuslo.models import longserve
+
+    cfg = _MoEBatchedContract._require_drop_free(cfg)
+    return longserve.sp_generate(
+        params, tokens, cfg, mesh, max_new_tokens,
+        mlp_fn=_serving_mlp_fn(cfg), **kwargs,
+    )
+
+
 def tp_serve_param_shardings(mesh: Mesh) -> PyTree:
     """Tensor-parallel SERVING layout over a ``tp`` axis (8x7B class).
 
@@ -724,6 +751,8 @@ __all__ = [
     "loss_fn",
     "param_shardings",
     "tp_serve_param_shardings",
+    "ep_serve_param_shardings",
+    "sp_generate",
     "build_moe_train_step",
 ]
 

@@ -82,18 +82,15 @@ def make_mesh(plan: MeshPlan, devices: list | None = None) -> Mesh:
         raise ValueError(
             f"plan needs {plan.n_devices} devices, have {len(devices)}"
         )
-    if plan.dcn > 1:
-        # Multi-slice: the dcn axis is OUTERMOST so a contiguous run of
-        # device ids (one slice's chips) forms each inner submesh —
-        # inner-axis collectives never leave the slice.
-        grid = np.asarray(devices[: plan.n_devices]).reshape(
-            plan.dcn, plan.dp, plan.fsdp, plan.tp
-        )
-        return Mesh(grid, ("dcn", *AXES))
-    grid = np.asarray(devices[: plan.n_devices]).reshape(
-        plan.dp, plan.fsdp, plan.tp
+    # Multi-slice: the dcn axis is OUTERMOST so a contiguous run of
+    # device ids (one slice's chips) forms each inner submesh —
+    # inner-axis collectives never leave the slice.
+    shape, names = (
+        ((plan.dcn, plan.dp, plan.fsdp, plan.tp), ("dcn", *AXES))
+        if plan.dcn > 1
+        else ((plan.dp, plan.fsdp, plan.tp), AXES)
     )
-    return Mesh(grid, AXES)
+    return Mesh(np.asarray(devices[: plan.n_devices]).reshape(shape), names)
 
 
 def param_shardings(mesh: Mesh) -> dict:
