@@ -64,6 +64,7 @@ enum tpuslo_signal_id {
 	TPUSLO_SIG_ICI_LINK_RETRY = 19,   /* count; aux = link index */
 	TPUSLO_SIG_ICI_COLLECTIVE = 20,   /* ns; aux = launch id */
 	TPUSLO_SIG_HOST_OFFLOAD = 21,     /* ns; aux = ioctl cmd */
+	TPUSLO_SIG_DCN_TRANSFER = 22,     /* ns; aux = transfer id */
 	/* Diagnostics. */
 	TPUSLO_SIG_HELLO = 31, /* heartbeat counter for e2e evidence */
 };

@@ -54,6 +54,8 @@ SignalInfo InfoFor(uint16_t id, int16_t err) {
       return {"ici_collective_latency_ms", "ms", true};
     case TPUSLO_SIG_HOST_OFFLOAD:
       return {"host_offload_stall_ms", "ms", true};
+    case TPUSLO_SIG_DCN_TRANSFER:
+      return {"dcn_transfer_latency_ms", "ms", true};
     case TPUSLO_SIG_HELLO:
       return {"hello_heartbeat_total", "count", false};
     default:

@@ -135,7 +135,7 @@ class TestBayesian:
 
     def test_likelihood_table_covers_all_domains_and_signals(self):
         table = attribution.default_likelihoods()
-        assert len(table) == 18
+        assert len(table) == 19
         for row in table.values():
             assert set(row) == set(attribution.ALL_DOMAINS)
             for p in row.values():
@@ -380,3 +380,43 @@ class TestIO:
         path.write_text('{"incident_id": "x"\n')
         with pytest.raises(ValueError, match="bad.jsonl:1"):
             attribution.load_samples_jsonl(path)
+
+
+class TestDCNDomain:
+    """Round-4 multi-slice fault domain: cross-slice DCN degradation
+    must attribute to tpu_dcn and stay separable from its two nearest
+    neighbours (ici_drop shares the collective symptom, network
+    partition shares the retransmit symptom)."""
+
+    def test_dcn_scenario_attributes_to_tpu_dcn(self):
+        from datetime import datetime, timezone
+
+        from tpuslo import attribution
+        from tpuslo.faultreplay import generate_fault_samples
+
+        start = datetime(2026, 1, 1, tzinfo=timezone.utc)
+        for scenario, expect in (
+            ("dcn_degradation", "tpu_dcn"),
+            ("ici_drop", "tpu_ici"),
+            ("network_partition", "network_egress"),
+        ):
+            samples = generate_fault_samples(scenario, 10, start)
+            preds = attribution.build_attributions(samples, mode="bayes")
+            domains = {p.predicted_fault_domain for p in preds}
+            assert domains == {expect}, (scenario, domains)
+
+    def test_dcn_evidence_names_the_transfer_signal(self):
+        from datetime import datetime, timezone
+
+        from tpuslo import attribution
+        from tpuslo.faultreplay import generate_fault_samples
+
+        start = datetime(2026, 1, 1, tzinfo=timezone.utc)
+        samples = generate_fault_samples("dcn_degradation", 3, start)
+        preds = attribution.build_attributions(samples, mode="bayes")
+        for p in preds:
+            assert any(
+                e.signal == "dcn_transfer_latency_ms"
+                and e.source == "megascale"
+                for e in p.evidence
+            ), p.evidence

@@ -70,7 +70,7 @@ def test_default_config_matches_loader_schema():
     cfg = toolkitcfg.load_config(str(REPO / "config/toolkit.yaml"))
     assert cfg.safety.max_overhead_pct == 3.0
     assert "xla_compile_ms" in cfg.signal_set
-    assert len(cfg.signal_set) == 15
+    assert len(cfg.signal_set) == 16
 
 
 def test_alert_rules_cover_tpu_fault_domains():
@@ -96,7 +96,7 @@ def test_helm_values_parse_and_mirror_defaults():
         (REPO / "charts/tpu-slo-agent/values.yaml").read_text()
     )
     assert values["agent"]["probeSource"] == "ring"
-    assert len(values["config"]["signalSet"]) == 15
+    assert len(values["config"]["signalSet"]) == 16
     assert values["config"]["maxOverheadPct"] == 3.0
 
 

@@ -36,6 +36,7 @@ def test_manifest_parses_and_covers_tpu_signals():
         "ici_collective_latency_ms",
         "ici_link_retries_total",
         "host_offload_stall_ms",
+        "dcn_transfer_latency_ms",
     }
     for spec in manifest["signals"].values():
         assert spec["kind"] in ("span", "counter", "kprobe_ioctl")

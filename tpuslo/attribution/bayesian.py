@@ -6,9 +6,9 @@ thresholds equal to the generator's warning thresholds, log-space
 posterior with log-sum-exp normalization, likelihood clamp [0.01, 0.99],
 and evidence lists built from elevated signals with P ≥ 0.5.
 
-The TPU-native build extends the model with four accelerator fault
-domains (``tpu_ici``, ``tpu_hbm``, ``xla_compile``, ``host_offload``)
-and six TPU signal rows; the table encodes cross-domain bleed (HBM
+The TPU-native build extends the model with five accelerator fault
+domains (``tpu_ici``, ``tpu_dcn``, ``tpu_hbm``, ``xla_compile``, ``host_offload``)
+and seven TPU signal rows; the table encodes cross-domain bleed (HBM
 pressure spills to host offload, recompiles warm the host runqueue) so
 multi-fault coverage metrics stay meaningful.
 """
@@ -32,6 +32,7 @@ DOMAIN_PROVIDER_THROTTLE = "provider_throttle"
 DOMAIN_PROVIDER_ERROR = "provider_error"
 DOMAIN_RETRIEVAL_BACKEND = "retrieval_backend"
 DOMAIN_TPU_ICI = "tpu_ici"
+DOMAIN_TPU_DCN = "tpu_dcn"
 DOMAIN_TPU_HBM = "tpu_hbm"
 DOMAIN_XLA_COMPILE = "xla_compile"
 DOMAIN_HOST_OFFLOAD = "host_offload"
@@ -46,6 +47,7 @@ ALL_DOMAINS: tuple[str, ...] = (
     DOMAIN_PROVIDER_ERROR,
     DOMAIN_RETRIEVAL_BACKEND,
     DOMAIN_TPU_ICI,
+    DOMAIN_TPU_DCN,
     DOMAIN_TPU_HBM,
     DOMAIN_XLA_COMPILE,
     DOMAIN_HOST_OFFLOAD,
@@ -54,6 +56,7 @@ ALL_DOMAINS: tuple[str, ...] = (
 
 TPU_DOMAINS: tuple[str, ...] = (
     DOMAIN_TPU_ICI,
+    DOMAIN_TPU_DCN,
     DOMAIN_TPU_HBM,
     DOMAIN_XLA_COMPILE,
     DOMAIN_HOST_OFFLOAD,
@@ -80,6 +83,7 @@ SIGNAL_ELEVATION_THRESHOLDS: dict[str, float] = {
     "ici_link_retries_total": 5,
     "ici_collective_latency_ms": 10,
     "host_offload_stall_ms": 20,
+    "dcn_transfer_latency_ms": 25,
 }
 
 # Error thresholds (same sync contract): together with the warning
@@ -105,6 +109,7 @@ SIGNAL_ERROR_THRESHOLDS: dict[str, float] = {
     "ici_link_retries_total": 20,
     "ici_collective_latency_ms": 30,
     "host_offload_stall_ms": 80,
+    "dcn_transfer_latency_ms": 80,
 }
 
 # Counter-valued signals: an exact 0.0 is a legitimate healthy reading.
@@ -170,7 +175,7 @@ COUNTER_ZERO_DROP_PRIOR = 0.15
 
 # Default evidence sharpness, fitted by
 # ``tpuslo.attribution.calibrate.fit_sharpness`` on lognormal-noise
-# training goldens — all nine domains, canonical + mild magnitude
+# training goldens — all ten trainable domains, canonical + mild magnitude
 # families, multiple seeds (see that function's docstring for the
 # protocol and tests/test_calibration.py for the reproduction check).
 # Round 4's protocol (full-domain, multi-seed) selects a gentler
@@ -208,7 +213,8 @@ def soft_evidence_weight(
 
 def _row(
     dns=0.10, egress=0.10, cpu=0.10, mem=0.10, pthr=0.10, perr=0.10,
-    retr=0.10, ici=0.05, hbm=0.05, xla=0.05, offload=0.05, unknown=0.10,
+    retr=0.10, ici=0.05, dcn=0.05, hbm=0.05, xla=0.05, offload=0.05,
+    unknown=0.10,
 ) -> dict[str, float]:
     return {
         DOMAIN_NETWORK_DNS: dns,
@@ -219,6 +225,7 @@ def _row(
         DOMAIN_PROVIDER_ERROR: perr,
         DOMAIN_RETRIEVAL_BACKEND: retr,
         DOMAIN_TPU_ICI: ici,
+        DOMAIN_TPU_DCN: dcn,
         DOMAIN_TPU_HBM: hbm,
         DOMAIN_XLA_COMPILE: xla,
         DOMAIN_HOST_OFFLOAD: offload,
@@ -227,13 +234,13 @@ def _row(
 
 
 def default_priors() -> dict[str, float]:
-    """Uniform priors over the twelve domains."""
+    """Uniform priors over the thirteen domains."""
     p = 1.0 / len(ALL_DOMAINS)
     return {d: p for d in ALL_DOMAINS}
 
 
 def default_likelihoods() -> dict[str, dict[str, float]]:
-    """P(signal elevated | domain) for all 18 signals × 12 domains.
+    """P(signal elevated | domain) for all 19 signals × 13 domains.
 
     CPU-signal columns over the original eight domains follow the
     reference table (``bayesian.go:67-190``); TPU columns/rows are
@@ -242,7 +249,7 @@ def default_likelihoods() -> dict[str, dict[str, float]]:
     """
     return {
         "dns_latency_ms": _row(dns=0.95, egress=0.70, retr=0.15),
-        "tcp_retransmits_total": _row(dns=0.15, egress=0.90, perr=0.15),
+        "tcp_retransmits_total": _row(dns=0.15, egress=0.90, perr=0.15, dcn=0.60),
         "runqueue_delay_ms": _row(
             cpu=0.90, mem=0.60, xla=0.45, hbm=0.10, offload=0.10
         ),
@@ -300,7 +307,7 @@ def default_likelihoods() -> dict[str, dict[str, float]]:
         # launch delay stretch collectives secondarily.
         "ici_collective_latency_ms": _row(
             dns=0.05, egress=0.05, cpu=0.15, mem=0.05, pthr=0.05, perr=0.05,
-            retr=0.05, ici=0.90, hbm=0.20, xla=0.10, offload=0.10,
+            retr=0.05, ici=0.90, dcn=0.55, hbm=0.20, xla=0.10, offload=0.10,
             unknown=0.05,
         ),
         # Host<->device stalls: offload path first; HBM pressure induces
@@ -308,6 +315,14 @@ def default_likelihoods() -> dict[str, dict[str, float]]:
         "host_offload_stall_ms": _row(
             dns=0.05, egress=0.05, cpu=0.10, mem=0.20, pthr=0.05, perr=0.05,
             retr=0.05, ici=0.15, hbm=0.55, xla=0.05, offload=0.95,
+            unknown=0.05,
+        ),
+        # Cross-slice transfer stalls are pathognomonic for DCN
+        # degradation; a badly degraded ICI link can echo here weakly
+        # when its slice straggles the cross-slice phase.
+        "dcn_transfer_latency_ms": _row(
+            dns=0.05, egress=0.10, cpu=0.05, mem=0.05, pthr=0.05, perr=0.05,
+            retr=0.05, ici=0.10, dcn=0.95, hbm=0.05, xla=0.05, offload=0.05,
             unknown=0.05,
         ),
     }
@@ -484,7 +499,7 @@ class BayesianAttributor:
         health): in ``bcc_degraded`` or shed-probe operation most
         signals are not collected at all, and counting them as healthy
         systematically biases toward domains with small probe
-        footprints.  For full 18-signal vectors the two semantics
+        footprints.  For full 19-signal vectors the two semantics
         coincide.
         """
         # One pass over the full vector; an ``observed`` restriction
@@ -607,7 +622,7 @@ class BayesianAttributor:
         """Vectorized :meth:`attribute_sample` over a batch.
 
         Semantics are identical (parity-tested); the per-sample
-        18-signal × 12-domain log-likelihood accumulation and the
+        19-signal × 13-domain log-likelihood accumulation and the
         residual explaining-away pass each become one masked matmul
         over the whole batch, so throughput scales with numpy rather
         than Python dict lookups.

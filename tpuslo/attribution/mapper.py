@@ -31,6 +31,7 @@ _LABEL_TO_DOMAIN: dict[str, str] = {
     "retrieval_slowdown": "retrieval_backend",
     # TPU fault labels.
     "ici_drop": "tpu_ici",
+    "dcn_degradation": "tpu_dcn",
     "hbm_pressure": "tpu_hbm",
     "xla_recompile_storm": "xla_compile",
     "host_offload_stall": "host_offload",
@@ -39,6 +40,7 @@ _LABEL_TO_DOMAIN: dict[str, str] = {
 # Evidence source per TPU signal family for envelope annotations.
 _TPU_EVIDENCE: dict[str, tuple[str, str, float]] = {
     "ici_drop": ("ici_link_retries_total", "accel_driver", 45.0),
+    "dcn_degradation": ("dcn_transfer_latency_ms", "megascale", 140.0),
     "hbm_pressure": ("hbm_alloc_stall_ms", "libtpu", 60.0),
     "xla_recompile_storm": ("xla_compile_ms", "libtpu", 3200.0),
     "host_offload_stall": ("host_offload_stall_ms", "libtpu", 120.0),

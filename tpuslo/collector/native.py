@@ -40,6 +40,7 @@ SIG_HBM_UTILIZATION = 18
 SIG_ICI_LINK_RETRY = 19
 SIG_ICI_COLLECTIVE = 20
 SIG_HOST_OFFLOAD = 21
+SIG_DCN_TRANSFER = 22
 SIG_HELLO = 31
 
 # Flags — mirror of TPUSLO_F_*.

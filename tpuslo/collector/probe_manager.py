@@ -48,6 +48,7 @@ SIGNAL_IDS = {
     sig.SIGNAL_ICI_LINK_RETRIES: native.SIG_ICI_LINK_RETRY,
     sig.SIGNAL_ICI_COLLECTIVE_MS: native.SIG_ICI_COLLECTIVE,
     sig.SIGNAL_HOST_OFFLOAD_STALL_MS: native.SIG_HOST_OFFLOAD,
+    sig.SIGNAL_DCN_TRANSFER_MS: native.SIG_DCN_TRANSFER,
 }
 
 #: Kernel-signal object files (attach-auto via their SEC definitions).

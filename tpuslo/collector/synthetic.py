@@ -4,7 +4,7 @@ Every pipeline stage downstream of collection can run on these
 deterministic scenario samples with zero privileges and zero hardware;
 the real-probe path (``tpuslo.collector.ringbuf``) swaps in on capable
 hosts.  Reference: ``pkg/collector/synthetic.go:17-130``; the TPU-native
-build adds four accelerator fault scenarios (``ici_drop``,
+build adds five accelerator fault scenarios (``ici_drop``, ``dcn_degradation``,
 ``hbm_pressure``, ``xla_recompile_storm``, ``host_offload_stall``) and a
 ``tpu_mixed`` rotation per BASELINE.json config 5.
 """
@@ -110,6 +110,7 @@ _SCENARIO_SEQUENCE: dict[str, tuple[str, ...]] = {
     "hbm_pressure": ("hbm_pressure",),
     "xla_recompile_storm": ("xla_recompile_storm",),
     "host_offload_stall": ("host_offload_stall",),
+    "dcn_degradation": ("dcn_degradation",),
     "mixed": (
         "provider_throttle",
         "dns_latency",
@@ -148,6 +149,10 @@ _FAULT_SLO_PROFILE: dict[str, tuple[float, float, float, float]] = {
     "hbm_pressure": (950, 2500, 6, 0.08),
     "xla_recompile_storm": (2600, 3400, 24, 0.01),
     "host_offload_stall": (1500, 2600, 15, 0.02),
+    # dcn_degradation — cross-slice phases stall per step: throughput
+    # sags and stragglers time some requests out, but single-slice
+    # serving paths stay clean so the error rate is moderate.
+    "dcn_degradation": (900, 2400, 9, 0.06),
     "mixed_multi": (1450, 4200, 2, 0.31),
 }
 

@@ -33,6 +33,7 @@ DEFAULT_SIGNAL_SET = [
     "ici_link_retries_total",
     "ici_collective_latency_ms",
     "host_offload_stall_ms",
+    "dcn_transfer_latency_ms",
 ]
 
 

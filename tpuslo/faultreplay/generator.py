@@ -22,6 +22,7 @@ _SCENARIO_LABELS: dict[str, tuple[str, ...]] = {
     "memory_pressure": ("memory_pressure",),
     "network_partition": ("network_partition",),
     "ici_drop": ("ici_drop",),
+    "dcn_degradation": ("dcn_degradation",),
     "hbm_pressure": ("hbm_pressure",),
     "xla_recompile_storm": ("xla_recompile_storm",),
     "host_offload_stall": ("host_offload_stall",),

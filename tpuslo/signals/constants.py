@@ -2,7 +2,7 @@
 
 Reference: ``pkg/signals/constants.go:4-59`` defines twelve CPU-side
 signal keys, two capability modes (``core_full`` / ``bcc_degraded``) and
-the overhead disable order.  The TPU-native build adds six accelerator
+the overhead disable order.  The TPU-native build adds seven accelerator
 signals sourced from libtpu uprobes and ``/dev/accel*`` kprobes and a
 ``tpu_full`` capability mode; TPU probes are shed *first* when the
 overhead guard trips (SURVEY.md §7 step 6).
@@ -38,6 +38,11 @@ SIGNAL_ICI_COLLECTIVE_MS = "ici_collective_latency_ms"
 # Host<->device transfer stall (infeed/outfeed/offload wait), dma uprobes
 # plus /dev/accel* ioctl kprobe latency.
 SIGNAL_HOST_OFFLOAD_STALL_MS = "host_offload_stall_ms"
+# Wall time of the cross-slice (DCN) transfer phase inside multi-slice
+# collectives, from megascale transfer uprobes.  Distinct from the ICI
+# signals: DCN rides the data-center ethernet fabric between slices, so
+# its failure physiology pairs with TCP retransmits, not link retries.
+SIGNAL_DCN_TRANSFER_MS = "dcn_transfer_latency_ms"
 
 CPU_SIGNALS: tuple[str, ...] = (
     SIGNAL_DNS_LATENCY_MS,
@@ -61,6 +66,7 @@ TPU_SIGNALS: tuple[str, ...] = (
     SIGNAL_ICI_LINK_RETRIES,
     SIGNAL_ICI_COLLECTIVE_MS,
     SIGNAL_HOST_OFFLOAD_STALL_MS,
+    SIGNAL_DCN_TRANSFER_MS,
 )
 
 ALL_SIGNALS: tuple[str, ...] = CPU_SIGNALS + TPU_SIGNALS
@@ -90,6 +96,7 @@ _BCC_SIGNAL_SET: tuple[str, ...] = (
 # depth degrades attribution less than losing the kernel spine entirely.
 # The CPU tail mirrors reference ``constants.go:46-59``.
 HIGH_COST_DISABLE_ORDER: tuple[str, ...] = (
+    SIGNAL_DCN_TRANSFER_MS,
     SIGNAL_ICI_COLLECTIVE_MS,
     SIGNAL_HBM_ALLOC_STALL_MS,
     SIGNAL_HOST_OFFLOAD_STALL_MS,

@@ -43,6 +43,7 @@ SIGNAL_THRESHOLDS: dict[str, tuple[float, float]] = {
     sig.SIGNAL_ICI_LINK_RETRIES: (5, 20),
     sig.SIGNAL_ICI_COLLECTIVE_MS: (10, 30),
     sig.SIGNAL_HOST_OFFLOAD_STALL_MS: (20, 80),
+    sig.SIGNAL_DCN_TRANSFER_MS: (25, 80),
 }
 
 SIGNAL_UNITS: dict[str, str] = {
@@ -64,6 +65,7 @@ SIGNAL_UNITS: dict[str, str] = {
     sig.SIGNAL_ICI_LINK_RETRIES: "count",
     sig.SIGNAL_ICI_COLLECTIVE_MS: "ms",
     sig.SIGNAL_HOST_OFFLOAD_STALL_MS: "ms",
+    sig.SIGNAL_DCN_TRANSFER_MS: "ms",
 }
 
 # Signals that carry a network flow tuple.
@@ -98,6 +100,7 @@ _BASE_PROFILE: dict[str, float] = {
     sig.SIGNAL_ICI_LINK_RETRIES: 0,
     sig.SIGNAL_ICI_COLLECTIVE_MS: 3.5,
     sig.SIGNAL_HOST_OFFLOAD_STALL_MS: 1.5,
+    sig.SIGNAL_DCN_TRANSFER_MS: 8.0,
 }
 
 # Fault label -> (signal overrides, connect errno).
@@ -187,6 +190,19 @@ _FAULT_OVERRIDES: dict[str, tuple[dict[str, float], int]] = {
             sig.SIGNAL_HOST_OFFLOAD_STALL_MS: 120,
             sig.SIGNAL_DISK_IO_LATENCY_MS: 40,
             sig.SIGNAL_SYSCALL_LATENCY_MS: 80,
+        },
+        0,
+    ),
+    # dcn_degradation — the cross-slice transfer phase stalls: the DCN
+    # fabric is ethernet, so retransmits climb with it and whole-
+    # collective latency warms up, but ICI link retries stay clean
+    # (that is the separator from ici_drop) and there are no connect/
+    # DNS symptoms (the separator from network_partition).
+    "dcn_degradation": (
+        {
+            sig.SIGNAL_DCN_TRANSFER_MS: 140,
+            sig.SIGNAL_TCP_RETRANSMITS: 6,
+            sig.SIGNAL_ICI_COLLECTIVE_MS: 18,
         },
         0,
     ),
