@@ -115,8 +115,8 @@ kernel_correlation = dashboard(
         panel("HBM utilization (%)", [
             ('max(llm_tpu_agent_hbm_utilization_pct) by (instance)', "{{instance}}"),
         ], 0, 8, unit="percent"),
-        panel("TPU probe events by signal (xla/hbm/ici/offload)", [
-            ('sum(rate(llm_slo_agent_probe_events_total{signal=~"xla_.*|hbm_.*|ici_.*|host_offload.*"}[5m])) by (signal)', "{{signal}}"),
+        panel("TPU probe events by signal (xla/hbm/ici/dcn/offload)", [
+            ('sum(rate(llm_slo_agent_probe_events_total{signal=~"xla_.*|hbm_.*|ici_.*|host_offload.*|dcn_.*"}[5m])) by (signal)', "{{signal}}"),
         ], 12, 8),
         panel("ICI collective latency p95 (ms, passive + active prober)", [
             ('histogram_quantile(0.95, sum(rate(llm_tpu_agent_ici_collective_ms_bucket[5m])) by (le))', "collective p95"),
