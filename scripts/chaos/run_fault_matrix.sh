@@ -20,7 +20,7 @@ cd "$(dirname "$0")/../.."
 OUT="${OUT:-artifacts/chaos}"
 REAL_INJECTORS="${REAL_INJECTORS:-false}"
 COUNT="${COUNT:-30}"
-SCENARIOS="${SCENARIOS:-dns_latency network_partition cpu_throttle ici_drop hbm_pressure xla_recompile_storm}"
+SCENARIOS="${SCENARIOS:-dns_latency network_partition cpu_throttle ici_drop dcn_degradation hbm_pressure xla_recompile_storm}"
 
 mkdir -p "$OUT"
 
@@ -54,6 +54,16 @@ inject_real() {
                 --report "$dir/injector_report.json" \
                 ${ICI_CPU_DEVICES:+--force-cpu-devices "$ICI_CPU_DEVICES"} \
                 && echo jax+barrier || echo failed
+            ;;
+        dcn_degradation)
+            # Real cross-slice measurement: 2 gloo processes as 2
+            # slices, one delayed — the punctual host's measured
+            # dcn_transfer component carries the stall while the
+            # intra-slice rounds stay clean.
+            python -m tpuslo icibench --multiprocess 2 --n-slices 2 \
+                --delay-host 1 --reps "$COUNT" \
+                --report "$dir/injector_report.json" >/dev/null \
+                && echo gloo_two_slice || echo failed
             ;;
         *)
             echo none

@@ -151,3 +151,81 @@ def test_crashed_worker_kills_survivors_under_one_deadline(monkeypatch):
     assert hung.killed
     assert any("peer exited nonzero" in e for e in report["errors"])
     assert any("boom" in e for e in report["errors"])
+
+
+def test_two_slice_probe_measures_dcn_component():
+    """2 hosts as 2 slices: the global round crosses slices, so with a
+    delayed host the punctual host's measured dcn_transfer component
+    carries the stall while intra-slice rounds (single-host psum) stay
+    clean — the dcn fault physiology, measured over a real IPC
+    collective, not simulated."""
+    report = run_distributed_probe(
+        n_processes=2, launches=3, delay_ms=200.0, delayed_host=1,
+        n_slices=2,
+    )
+    assert report["errors"] == []
+    assert report["n_slices"] == 2
+    assert report["dcn_events"] == 6  # 3 launches x 2 hosts
+    dcn = [
+        e for e in report["events"]
+        if e["signal"] == "dcn_transfer_latency_ms"
+    ]
+    intra = [
+        e for e in report["events"]
+        if e["signal"] == "ici_collective_latency_ms"
+    ]
+    # Punctual host 0: the cross-slice round absorbed the delay.
+    host0_dcn = [e["value"] for e in dcn if e["tpu"]["host_index"] == 0]
+    assert max(host0_dcn) > 150.0
+    # Intra rounds are slice-local (here: single host) — clean.
+    assert all(e["value"] < 50.0 for e in intra)
+    # Per-slice identity rides the events.
+    slices = {e["tpu"]["slice_id"] for e in dcn}
+    assert slices == {"dist-slice-0", "dist-slice-1"}
+    # SliceJoiner attributes the delayed host over the cross-slice
+    # group, names its slice, and blames the DCN path (no ICI link
+    # evidence applies across slices).
+    assert report["correct_attributions"] == 3
+    incident = report["incidents"][0]
+    assert incident["cause"] == "dcn_path"
+    assert incident["straggler_slice"] == "dist-slice-1"
+
+
+def test_icibench_rejects_misaligned_slices(tmp_path):
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpuslo", "icibench",
+         "--multiprocess", "2", "--n-slices", "3"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 2
+    assert "must divide" in proc.stderr
+
+
+def test_four_host_two_slice_attribution_is_slice_level():
+    """The review scenario: with 2 hosts per slice, the delayed host's
+    intra-slice PEER absorbs the stall intra-slice, so every host of
+    the delayed slice shows a near-zero dcn component.  The dcn verdict
+    must therefore be slice-level (lowest mean component), and the
+    per-host verdict comes from the intra-slice ICI group, which the
+    right-sized min_hosts no longer suppresses."""
+    report = run_distributed_probe(
+        n_processes=4, launches=2, delay_ms=200.0, delayed_host=1,
+        n_slices=2,
+    )
+    assert report["errors"] == []
+    dcn_incidents = [
+        i for i in report["incidents"] if i["cause"] == "dcn_path"
+    ]
+    assert dcn_incidents, report["incidents"]
+    for i in dcn_incidents:
+        assert i["straggler_slice"] == "dist-slice-0"  # host 1's slice
+    intra_incidents = [
+        i for i in report["incidents"]
+        if i["cause"] != "dcn_path" and i["slice_id"] == "dist-slice-0"
+    ]
+    assert intra_incidents, report["incidents"]
+    for i in intra_incidents:
+        assert i["straggler_host"] == 1

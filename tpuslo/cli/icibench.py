@@ -71,6 +71,13 @@ def main(argv: list[str] | None = None) -> int:
     )
     p.add_argument("--delay-ms", type=float, default=150.0)
     p.add_argument(
+        "--n-slices", type=int, default=1,
+        help="with --multiprocess: partition hosts into slices; each "
+        "launch measures intra-slice AND global rounds and emits the "
+        "difference as dcn_transfer_latency_ms (the measured "
+        "cross-slice component)",
+    )
+    p.add_argument(
         "--report", default="",
         help="with --multiprocess: also write the straggler-join report "
         "(incidents, attribution verdicts) as JSON here",
@@ -152,6 +159,14 @@ def _run_multiprocess(args, ops) -> int:
     single-process path (schema-validated probe-event JSONL)."""
     from tpuslo.schema import SCHEMA_PROBE_EVENT, SchemaValidationError, validate
 
+    if args.n_slices > 1 and args.multiprocess % args.n_slices:
+        print(
+            f"icibench: --n-slices {args.n_slices} must divide "
+            f"--multiprocess {args.multiprocess} (slices are process "
+            "groups)",
+            file=sys.stderr,
+        )
+        return 2
     if args.delay_host >= args.multiprocess:
         print(
             f"icibench: --delay-host {args.delay_host} is out of range "
@@ -177,6 +192,7 @@ def _run_multiprocess(args, ops) -> int:
         payload_kb=args.payload_kb,
         delay_ms=args.delay_ms if args.delay_host >= 0 else 0.0,
         delayed_host=args.delay_host,
+        n_slices=args.n_slices,
     )
     lines = []
     for event_dict in report["events"]:

@@ -39,6 +39,7 @@ from dataclasses import dataclass, field
 from typing import Any, Iterable
 
 from tpuslo.signals.constants import (
+    SIGNAL_DCN_TRANSFER_MS,
     SIGNAL_ICI_COLLECTIVE_MS,
     SIGNAL_ICI_LINK_RETRIES,
 )
@@ -61,6 +62,13 @@ DEFAULT_RETRY_THRESHOLD = 3.0
 
 CAUSE_COMPUTE = "compute_straggler"
 CAUSE_ICI_LINK = "ici_link"
+# Cross-slice (DCN-path) stall: the skewed group is a dcn_transfer
+# stream spanning slices, so the blame is the straggler's DCN path —
+# ICI link evidence does not apply.
+CAUSE_DCN = "dcn_path"
+# Group key namespace for cross-slice dcn_transfer joins: the group
+# spans slices by construction, so it cannot key on one slice_id.
+CROSS_SLICE = "cross-slice"
 
 
 @dataclass
@@ -71,6 +79,7 @@ class HostObservation:
     node: str
     latency_ms: float
     ts_unix_nano: int
+    slice_id: str = ""  # filled for cross-slice (dcn) observations
 
 
 @dataclass
@@ -105,6 +114,7 @@ class StragglerIncident:
     ici_link: int = -1
     link_retries: float = 0.0
     host_latencies_ms: dict[int, float] = field(default_factory=dict)
+    straggler_slice: str = ""  # set for cross-slice (dcn) incidents
 
     def to_dict(self) -> dict[str, Any]:
         out: dict[str, Any] = {
@@ -125,6 +135,8 @@ class StragglerIncident:
         if self.cause == CAUSE_ICI_LINK:
             out["ici_link"] = self.ici_link
             out["link_retries"] = self.link_retries
+        if self.straggler_slice:
+            out["straggler_slice"] = self.straggler_slice
         return out
 
 
@@ -211,6 +223,36 @@ class SliceJoiner:
             self.ingested += 1
             return True
 
+        if signal == SIGNAL_DCN_TRANSFER_MS:
+            # Cross-slice transfer component: the launch group spans
+            # slices, so it keys on (program, launch) alone under the
+            # CROSS_SLICE namespace; each observation remembers its
+            # own slice for the incident verdict.
+            launch_id = int(tpu.get("launch_id", -1))
+            program_id = tpu.get("program_id", "")
+            if launch_id < 0:
+                self.skipped += 1
+                return False
+            key = (CROSS_SLICE, program_id, launch_id)
+            group = self._groups.get(key)
+            if group is None:
+                group = self._groups[key] = LaunchGroup(
+                    slice_id=CROSS_SLICE, program_id=program_id,
+                    launch_id=launch_id,
+                )
+            group.hosts[host_index] = HostObservation(
+                host_index=host_index,
+                node=event.get("node", ""),
+                latency_ms=float(event.get("value", 0.0)),
+                ts_unix_nano=int(event.get("ts_unix_nano", 0)),
+                slice_id=slice_id,
+            )
+            self._seen_hosts[CROSS_SLICE] = max(
+                self._seen_hosts.get(CROSS_SLICE, 0), len(group.hosts)
+            )
+            self.ingested += 1
+            return True
+
         if signal == SIGNAL_ICI_LINK_RETRIES:
             self._retries.setdefault(slice_id, []).append(
                 _RetryObservation(
@@ -269,12 +311,38 @@ class SliceJoiner:
             if skew < self.skew_floor_ms or ratio < self.skew_ratio:
                 continue
 
-            link, retries = self._link_evidence(
-                group.slice_id, fastest.host_index, fastest.ts_unix_nano
-            )
-            cause = (
-                CAUSE_ICI_LINK if retries >= self.retry_threshold else CAUSE_COMPUTE
-            )
+            if group.slice_id == CROSS_SLICE:
+                # dcn_transfer group: the stall is on the straggler
+                # SLICE's DCN path.  Cross-slice data can only name the
+                # slice — every host of the straggler slice shows a
+                # near-zero dcn component (the delayed host slept, its
+                # intra peers absorbed the stall intra-slice), so the
+                # within-slice pick would be jitter.  The verdict is
+                # the slice with the lowest mean component; the
+                # reported host is its lowest representative, and the
+                # intra-slice ICI groups carry the per-host verdict.
+                by_slice: dict[str, list[HostObservation]] = {}
+                for o in obs:
+                    by_slice.setdefault(o.slice_id, []).append(o)
+                slice_means = {
+                    sid: sum(o.latency_ms for o in rows) / len(rows)
+                    for sid, rows in by_slice.items()
+                }
+                straggler_sid = min(slice_means, key=slice_means.get)
+                fastest = min(
+                    by_slice[straggler_sid], key=lambda o: o.latency_ms
+                )
+                link, retries = -1, 0.0
+                cause = CAUSE_DCN
+            else:
+                link, retries = self._link_evidence(
+                    group.slice_id, fastest.host_index, fastest.ts_unix_nano
+                )
+                cause = (
+                    CAUSE_ICI_LINK
+                    if retries >= self.retry_threshold
+                    else CAUSE_COMPUTE
+                )
             completeness = 1.0
             if self.expected_hosts > 0:
                 completeness = min(1.0, len(group.hosts) / self.expected_hosts)
@@ -301,6 +369,11 @@ class SliceJoiner:
                     host_latencies_ms={
                         o.host_index: o.latency_ms for o in obs
                     },
+                    straggler_slice=(
+                        fastest.slice_id
+                        if group.slice_id == CROSS_SLICE
+                        else ""
+                    ),
                 )
             )
         out.sort(key=lambda i: (-i.confidence, -i.skew_ms, i.launch_id))

@@ -57,6 +57,13 @@ def worker_main(argv: list[str] | None = None) -> int:
     p.add_argument("--delayed-host", type=int, default=-1)
     p.add_argument("--slice-id", default="dist-slice")
     p.add_argument(
+        "--n-slices", type=int, default=1,
+        help="partition the hosts into this many slices: each launch "
+        "then measures an intra-slice round AND a global round, and "
+        "the difference is emitted as dcn_transfer_latency_ms — the "
+        "cross-slice component, measured, not simulated",
+    )
+    p.add_argument(
         "--ring-path", default="",
         help="also write each measured event into this userspace ring "
         "(the host's agent consumes it — the DaemonSet fan-out shape)",
@@ -102,74 +109,148 @@ def worker_main(argv: list[str] | None = None) -> int:
 
     from tpuslo.schema import ProbeEventV1, TPURef
 
-    mesh = Mesh(np.array(jax.devices()), ("hosts",))
     n = jax.device_count()
+    n_slices = max(1, args.n_slices)
+    if args.num_processes % n_slices:
+        raise SystemExit(
+            f"--n-slices {n_slices} must divide --num-processes "
+            f"{args.num_processes}: slices are process groups"
+        )
+    per_proc = n // args.num_processes
+    if n % n_slices or (n // n_slices) % max(per_proc, 1):
+        raise SystemExit(
+            f"--n-slices {n_slices} does not align to process "
+            f"boundaries ({n} devices, {per_proc} per process): a "
+            "host's devices must not straddle two slices"
+        )
     cols = 256
     rows = max(n, (args.payload_kb * 1024 // (4 * cols) // n) * n)
     x_local = np.ones((rows // n * jax.local_device_count(), cols), np.float32)
     from jax.experimental import multihost_utils
+    from jax.experimental.shard_map import shard_map
 
-    x = multihost_utils.host_local_array_to_global_array(
-        x_local, mesh, P("hosts", None)
-    )
+    if n_slices > 1:
+        # Two-level mesh: contiguous process-id runs form each slice
+        # (the same layout MeshPlan's dcn axis uses).  The intra round
+        # psums over the slice-local axis only; the global round
+        # crosses slices — its excess over intra IS the cross-slice
+        # transfer component.
+        mesh = Mesh(
+            np.array(jax.devices()).reshape(n_slices, n // n_slices),
+            ("slice", "host"),
+        )
+        spec = P(("slice", "host"), None)
+        x = multihost_utils.host_local_array_to_global_array(
+            x_local, mesh, spec
+        )
 
-    @jax.jit
-    def allreduce(v):
-        from jax.experimental.shard_map import shard_map
+        @jax.jit
+        def intra_reduce(v):
+            return shard_map(
+                lambda s: jax.lax.psum(s, "host"),
+                mesh=mesh, in_specs=spec, out_specs=P("slice", None),
+            )(v)
 
-        return shard_map(
-            lambda s: jax.lax.psum(s, "hosts"),
-            mesh=mesh,
-            in_specs=P("hosts", None),
-            out_specs=P(None, None),
-        )(v)
+        @jax.jit
+        def allreduce(v):
+            return shard_map(
+                lambda s: jax.lax.psum(s, ("slice", "host")),
+                mesh=mesh, in_specs=spec, out_specs=P(None, None),
+            )(v)
+
+        jax.block_until_ready(intra_reduce(x))  # compile round
+    else:
+        mesh = Mesh(np.array(jax.devices()), ("hosts",))
+        spec = P("hosts", None)
+        x = multihost_utils.host_local_array_to_global_array(
+            x_local, mesh, spec
+        )
+        intra_reduce = None
+
+        @jax.jit
+        def allreduce(v):
+            return shard_map(
+                lambda s: jax.lax.psum(s, "hosts"),
+                mesh=mesh, in_specs=spec, out_specs=P(None, None),
+            )(v)
 
     jax.block_until_ready(allreduce(x))  # compile round
 
     me = args.process_id
+    my_slice = me * n_slices // args.num_processes
+    slice_id = (
+        f"{args.slice_id}-{my_slice}" if n_slices > 1 else args.slice_id
+    )
     for launch in range(args.launches):
         if me == args.delayed_host and args.delay_ms > 0:
             time.sleep(args.delay_ms / 1000.0)
+        intra_ms = 0.0
+        if intra_reduce is not None:
+            # Intra round first: slice-local psum (a delayed host only
+            # stalls its own slice's peers here).
+            t0 = time.perf_counter()
+            jax.block_until_ready(intra_reduce(x))
+            intra_ms = (time.perf_counter() - t0) * 1000.0
         t0 = time.perf_counter()
         jax.block_until_ready(allreduce(x))
         wait_ms = (time.perf_counter() - t0) * 1000.0
-        event = ProbeEventV1(
-            ts_unix_nano=time.time_ns(),
-            signal="ici_collective_latency_ms",
-            node=f"dist-host-{me}",
-            namespace="llm",
-            pod=f"agent-{me}",
-            container="agent",
-            pid=os.getpid(),
-            tid=me,
-            value=wait_ms,
-            unit="ms",
-            status="ok",
-            tpu=TPURef(
-                chip="accel0",
-                slice_id=args.slice_id,
-                host_index=me,
-                ici_link=-1,
-                program_id=PROGRAM_ID,
-                launch_id=launch,
-            ),
-        )
-        print(json.dumps(event.to_dict()), flush=True)
-        if ring is not None:
-            # Wire format: ns value for _ms signals (native decode
-            # divides back), launch identity in aux, F_TPU so the
-            # consumer lifts it into a TPURef.
-            from tpuslo.collector import native
+        def emit(signal_name: str, value_ms: float, native_sig: int) -> None:
+            """One measured reading: ProbeEventV1 on stdout + ring.
 
-            ring.write_event(
-                signal=native.SIG_ICI_COLLECTIVE,
-                value=int(wait_ms * 1e6),
-                ts_ns=event.ts_unix_nano,
-                aux=launch,
+            Ring wire format: ns value for _ms signals (native decode
+            divides back), launch identity in aux, F_TPU so the
+            consumer lifts it into a TPURef.
+            """
+            event = ProbeEventV1(
+                ts_unix_nano=time.time_ns(),
+                signal=signal_name,
+                node=f"dist-host-{me}",
+                namespace="llm",
+                pod=f"agent-{me}",
+                container="agent",
                 pid=os.getpid(),
                 tid=me,
-                flags=native.F_TPU,
+                value=value_ms,
+                unit="ms",
+                status="ok",
+                tpu=TPURef(
+                    chip="accel0",
+                    slice_id=slice_id,
+                    host_index=me,
+                    ici_link=-1,
+                    program_id=PROGRAM_ID,
+                    launch_id=launch,
+                ),
             )
+            print(json.dumps(event.to_dict()), flush=True)
+            if ring is not None:
+                from tpuslo.collector import native
+
+                ring.write_event(
+                    signal=native_sig,
+                    value=int(value_ms * 1e6),
+                    ts_ns=event.ts_unix_nano,
+                    aux=launch,
+                    pid=os.getpid(),
+                    tid=me,
+                    flags=native.F_TPU,
+                )
+
+        from tpuslo.collector import native as _native
+
+        if intra_reduce is not None:
+            # The global round's excess over the slice-local round is
+            # the measured cross-slice (DCN-path) component; the intra
+            # round is the slice-local collective reading.
+            emit(
+                "dcn_transfer_latency_ms",
+                max(0.0, wait_ms - intra_ms),
+                _native.SIG_DCN_TRANSFER,
+            )
+            wait_ms = intra_ms
+        emit(
+            "ici_collective_latency_ms", wait_ms, _native.SIG_ICI_COLLECTIVE
+        )
     if ring is not None:
         ring.close()
     return 0
@@ -182,6 +263,7 @@ def run_distributed_probe(
     delay_ms: float = 0.0,
     delayed_host: int = -1,
     timeout_s: float = 420.0,
+    n_slices: int = 1,
 ) -> dict[str, Any]:
     """Spawn the workers, collect per-host events, join stragglers.
 
@@ -202,6 +284,7 @@ def run_distributed_probe(
                     "--payload-kb", str(payload_kb),
                     "--delay-ms", str(delay_ms),
                     "--delayed-host", str(delayed_host),
+                    "--n-slices", str(n_slices),
                 ],
                 stdout=subprocess.PIPE,
                 stderr=subprocess.PIPE,
@@ -262,17 +345,30 @@ def run_distributed_probe(
 
     joiner = SliceJoiner(expected_hosts=n_processes)
     joiner.add_all(events)
-    incidents = [i.to_dict() for i in joiner.incidents(min_hosts=n_processes)]
+    # With slicing, the intra-slice ICI groups can only ever hold
+    # n_processes/n_slices hosts — size the completeness guard to the
+    # smallest legitimate group so they are not silently suppressed.
+    min_hosts = max(2, n_processes // n_slices)
+    incidents = [i.to_dict() for i in joiner.incidents(min_hosts=min_hosts)]
     report: dict[str, Any] = {
         "mechanism": "jax_distributed_gloo",
         "real": True,
         "n_processes": n_processes,
+        "n_slices": n_slices,
         "launches": launches,
         "events_measured": len(events),
         "events": events,
         "errors": errors,
         "incidents": incidents,
     }
+    if n_slices > 1:
+        dcn = [
+            e["value"] for e in events
+            if e.get("signal") == "dcn_transfer_latency_ms"
+        ]
+        if dcn:
+            report["dcn_transfer_ms_max"] = round(max(dcn), 2)
+            report["dcn_events"] = len(dcn)
     if delayed_host >= 0:
         correct = [
             i for i in incidents if i["straggler_host"] == delayed_host
