@@ -65,11 +65,22 @@ def _wait_for(path: Path, marker: str, timeout_s: float) -> bool:
     return False
 
 
-def phase_fanout(out: Path, workdir: Path) -> dict:
-    """Workers + one live agent per host, rings in between."""
+def phase_fanout(
+    out: Path, workdir: Path, n_slices: int = 1, tag: str = ""
+) -> dict:
+    """Workers + one live agent per host, rings in between.
+
+    ``n_slices=2`` is the DCN leg: the workers partition into slices,
+    measure intra + global rounds, and the agents consume (and stamp
+    per-slice identity on) the measured dcn_transfer component too.
+    """
     env = {**os.environ}
     env.pop("JAX_PLATFORMS", None)  # workers force cpu via jax.config
     port = _free_port()
+
+    signal_set = "ici_collective_latency_ms"
+    if n_slices > 1:
+        signal_set += ",dcn_transfer_latency_ms"
 
     workers = []
     worker_logs = []
@@ -87,6 +98,7 @@ def phase_fanout(out: Path, workdir: Path) -> dict:
                     "--delay-ms", str(DELAY_MS),
                     "--delayed-host", str(DELAYED_HOST),
                     "--slice-id", SLICE_ID,
+                    "--n-slices", str(n_slices),
                     "--ring-path", str(workdir / f"ring_{host}.buf"),
                     "--hold-before-init-s", "6",
                 ],
@@ -108,7 +120,7 @@ def phase_fanout(out: Path, workdir: Path) -> dict:
     agents = []
     agent_jsonls = []
     for host in range(N_HOSTS):
-        jsonl = out / f"agent_host{host}.jsonl"
+        jsonl = out / f"agent_host{host}{tag}.jsonl"
         agent_jsonls.append(jsonl)
         agents.append(
             subprocess.Popen(
@@ -121,10 +133,13 @@ def phase_fanout(out: Path, workdir: Path) -> dict:
                     "--output", "jsonl",
                     "--jsonl-path", str(jsonl),
                     "--node", f"dist-host-{host}",
-                    "--slice-id", SLICE_ID,
+                    "--slice-id", (
+                        f"{SLICE_ID}-{host * n_slices // N_HOSTS}"
+                        if n_slices > 1 else SLICE_ID
+                    ),
                     "--host-index", str(host),
                     "--xla-program-id", PROGRAM_ID,
-                    "--signal-set", "ici_collective_latency_ms",
+                    "--signal-set", signal_set,
                     "--capability-mode", "tpu_full",
                     "--metrics-port", "0",
                     "--max-overhead-pct", "1000",
@@ -153,11 +168,11 @@ def phase_fanout(out: Path, workdir: Path) -> dict:
         per_host_events.append(events)
 
     for host in range(N_HOSTS):
-        (out / f"worker_host{host}.out").write_text(
+        (out / f"worker_host{host}{tag}.out").write_text(
             worker_logs[host].read_text(errors="replace")
         )
         err = (workdir / f"agent_{host}.err").read_text(errors="replace")
-        (out / f"agent_host{host}.stderr.log").write_text(err)
+        (out / f"agent_host{host}{tag}.stderr.log").write_text(err)
 
     return {
         "rings_ready": rings_ready,
@@ -251,6 +266,94 @@ def phase_attribution(out: Path) -> dict:
     return result
 
 
+def phase_dcn_leg(out: Path) -> dict:
+    """The DCN leg: same fan-out with 2 slices; slice-level verdicts.
+
+    Every measured dcn_transfer event flowed worker -> ring -> live
+    agent before the join, exactly like the ici leg.
+    """
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="e2e-mh-dcn-") as td:
+        fanout = phase_fanout(out, Path(td), n_slices=2, tag="_dcn")
+
+    incidents_path = out / "dcn_incidents.jsonl"
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tpuslo", "slicecorr",
+            *fanout["agent_jsonls"],
+            "--expected-hosts", str(N_HOSTS),
+            "--min-hosts", str(N_HOSTS),
+            "--output", str(incidents_path),
+            "--summary", str(out / "dcn_slicecorr_summary.json"),
+        ],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    incidents = [
+        json.loads(line)
+        for line in incidents_path.read_text().splitlines()
+        if line.strip()
+    ] if incidents_path.exists() else []
+    dcn_incidents = [i for i in incidents if i.get("cause") == "dcn_path"]
+    delayed_slice = f"{SLICE_ID}-{DELAYED_HOST * 2 // N_HOSTS}"
+    correct = [
+        i for i in dcn_incidents
+        if i.get("straggler_slice") == delayed_slice
+    ]
+
+    # Attribution from the measured cross-slice component (agent-
+    # emitted events, not the injector's own numbers).
+    from datetime import datetime, timezone
+
+    from tpuslo.attribution.calibrate import calibrated_attributor
+    from tpuslo.attribution.mapper import FaultSample
+    from tpuslo.signals.generator import profile_for_fault
+
+    waits = [
+        lat
+        for i in dcn_incidents
+        for host, lat in i["host_latencies_ms"].items()
+        if int(host) != DELAYED_HOST
+    ]
+    signals = dict(profile_for_fault("baseline"))
+    if waits:
+        signals["dcn_transfer_latency_ms"] = max(waits)
+    sample = FaultSample(
+        incident_id="e2e-multihost-dcn-0001",
+        timestamp=datetime.now(timezone.utc),
+        cluster="local",
+        namespace="llm",
+        service="dist-psum",
+        fault_label="",
+        expected_domain="",
+        signals=signals,
+        confidence=0.9,
+        burn_rate=2.5,
+        window_minutes=5,
+        request_id="e2e-req-dcn-0001",
+        trace_id="e2e-trace-dcn-0001",
+    )
+    prediction = calibrated_attributor().attribute_sample(sample)
+    result = {
+        "rc": proc.returncode,
+        "fanout": {
+            k: v for k, v in fanout.items() if k != "agent_jsonls"
+        },
+        "dcn_incidents": len(dcn_incidents),
+        "correct_slice_verdicts": len(correct),
+        "delayed_slice": delayed_slice,
+        "top_confidence": max(
+            (i.get("confidence", 0.0) for i in correct), default=0.0
+        ),
+        "predicted_domain": prediction.predicted_fault_domain,
+        "attr_confidence": round(prediction.confidence, 4),
+        "measured_dcn_ms": round(max(waits), 2) if waits else 0.0,
+        "from_agent_emitted_events": True,
+    }
+    (out / "dcn_attribution.json").write_text(json.dumps(result, indent=2))
+    return result
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument(
@@ -267,6 +370,7 @@ def main() -> int:
         fanout = phase_fanout(out, workdir)
     corr = phase_slicecorr(out, fanout["agent_jsonls"])
     attribution = phase_attribution(out)
+    dcn = phase_dcn_leg(out)
 
     verdicts = {
         "rings_ready": fanout["rings_ready"],
@@ -280,6 +384,10 @@ def main() -> int:
         "join_confidence": corr["top_confidence"] >= 0.7,
         "attribution_top1_tpu_ici": attribution["predicted_domain"]
         == "tpu_ici",
+        "dcn_slice_verdicts": dcn["dcn_incidents"] >= 1
+        and dcn["correct_slice_verdicts"] == dcn["dcn_incidents"],
+        "dcn_attribution_top1_tpu_dcn": dcn["predicted_domain"]
+        == "tpu_dcn",
     }
     session = {
         "generated_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
@@ -290,6 +398,7 @@ def main() -> int:
         "fanout": {k: v for k, v in fanout.items() if k != "agent_jsonls"},
         "slicecorr": corr,
         "attribution": attribution,
+        "dcn_leg": dcn,
         "verdicts": verdicts,
         "pass": all(verdicts.values()),
     }
@@ -313,6 +422,11 @@ def main() -> int:
         f"{corr['top_confidence']:.2f})\n"
         f"- attribution: {attribution['predicted_domain']} @ "
         f"{attribution['confidence']}\n"
+        f"- DCN leg (2 slices): {dcn['dcn_incidents']} slice-level "
+        f"verdicts, {dcn['correct_slice_verdicts']} naming "
+        f"{dcn['delayed_slice']} @ {dcn['top_confidence']:.2f}; "
+        f"attribution {dcn['predicted_domain']} from the measured "
+        f"{dcn['measured_dcn_ms']:.0f} ms cross-slice component\n"
         f"- verdicts: {json.dumps(verdicts)}\n\n"
         "Regenerate: `python scripts/demo/e2e_multihost_session.py`\n"
     )

@@ -186,3 +186,34 @@ def test_agent_ring_mode_stamps_multihost_identity(tmp_path):
         assert abs(event["value"] - 25.0) < 1e-6
         launches.add(event["tpu"]["launch_id"])
     assert launches == {0, 1, 2}
+
+
+def test_ring_consumer_lifts_launch_id_for_dcn_events():
+    """aux -> launch_id must lift for BOTH collective signals: the
+    cross-slice joiner keys dcn_transfer groups on (program, launch),
+    so a dropped launch id silently disables slice-level verdicts."""
+    import tempfile
+
+    from tpuslo.collector import native
+    from tpuslo.collector.ringbuf import RingBufConsumer, RingWriter
+
+    path = tempfile.mktemp(suffix=".buf")
+    consumer = RingBufConsumer()
+    writer = RingWriter(path)
+    consumer.add_userspace_ring(path)
+    writer.write_event(
+        signal=native.SIG_DCN_TRANSFER, value=int(33.0e6), ts_ns=5,
+        aux=7, pid=1, tid=0, flags=native.F_TPU,
+    )
+    samples = list(consumer.poll())
+    assert samples and samples[0].signal == "dcn_transfer_latency_ms"
+    from tpuslo.collector.ringbuf import to_probe_event
+    from tpuslo.signals import Metadata
+
+    meta = Metadata(
+        node="n", namespace="llm", pod="p", container="c", pid=1, tid=0,
+        tpu_chip="accel0", slice_id="s-0", host_index=0,
+        xla_program_id="prog",
+    )
+    event = to_probe_event(samples[0], meta)
+    assert event.tpu.launch_id == 7

@@ -226,7 +226,8 @@ def to_probe_event(
         from tpuslo.schema import TPURef
 
         # aux is signal-scoped (ebpf/c/tpuslo_event.h): launch id for
-        # collectives, link index for link retries.
+        # collectives (intra-slice and cross-slice), link index for
+        # link retries.
         event.tpu = TPURef(
             chip=meta.tpu_chip,
             slice_id=meta.slice_id,
@@ -234,7 +235,8 @@ def to_probe_event(
             program_id=meta.xla_program_id,
             launch_id=(
                 sample.aux
-                if sample.signal == "ici_collective_latency_ms"
+                if sample.signal
+                in ("ici_collective_latency_ms", "dcn_transfer_latency_ms")
                 else -1
             ),
             ici_link=(
