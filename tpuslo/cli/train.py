@@ -37,6 +37,11 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument("--ckpt-every", type=int, default=0)
     parser.add_argument(
+        "--slices", type=int, default=1,
+        help="multi-slice plan: factor a dcn data-parallel axis out "
+        "first (cross-slice gradient psum is the only DCN collective)",
+    )
+    parser.add_argument(
         "--cpu-mesh",
         type=int,
         default=0,
@@ -68,7 +73,7 @@ def main(argv: list[str] | None = None) -> int:
     from tpuslo.parallel.mesh import make_mesh, plan_for_devices
 
     cfg = getattr(llama, args.model)(max_seq_len=max(args.seq_len, 64))
-    plan = plan_for_devices(len(jax.devices()))
+    plan = plan_for_devices(len(jax.devices()), slices=args.slices)
     mesh = make_mesh(plan)
 
     if args.corpus:
@@ -102,7 +107,10 @@ def main(argv: list[str] | None = None) -> int:
             {
                 "done": True,
                 "model": args.model,
-                "mesh": {"dp": plan.dp, "fsdp": plan.fsdp, "tp": plan.tp},
+                "mesh": {
+                    "dcn": plan.dcn, "dp": plan.dp,
+                    "fsdp": plan.fsdp, "tp": plan.tp,
+                },
                 "first_step": result["first_step"],
                 "last_step": result["last_step"],
                 "final_loss": round(result["losses"][-1], 6)
