@@ -26,8 +26,13 @@ def main(argv: list[str] | None = None) -> int:
     )
     parser.add_argument(
         "--model",
-        choices=("llama_tiny", "llama32_1b", "llama32_3b"),
+        choices=(
+            "llama_tiny", "llama32_1b", "llama32_3b",
+            "mixtral_tiny", "mixtral_2b6",
+        ),
         default="llama_tiny",
+        help="mixtral_* trains the MoE family over a dp x ep mesh "
+        "(experts sharded; GSPMD token exchanges)",
     )
     parser.add_argument("--steps", type=int, default=20)
     parser.add_argument("--batch", type=int, default=8)
@@ -68,13 +73,37 @@ def main(argv: list[str] | None = None) -> int:
         jax.config.update("jax_platforms", "cpu")
     import jax
 
-    from tpuslo.models import llama
     from tpuslo.models.trainer import TrainerConfig, train
     from tpuslo.parallel.mesh import make_mesh, plan_for_devices
 
-    cfg = getattr(llama, args.model)(max_seq_len=max(args.seq_len, 64))
-    plan = plan_for_devices(len(jax.devices()), slices=args.slices)
-    mesh = make_mesh(plan)
+    step_builder = None
+    if args.model.startswith("mixtral"):
+        import math
+
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from tpuslo.models import mixtral
+
+        if args.slices > 1:
+            parser.error("--slices applies to the llama dp/fsdp/tp plan")
+        cfg = getattr(mixtral, args.model)(max_seq_len=max(args.seq_len, 64))
+        n = len(jax.devices())
+        ep = math.gcd(n, cfg.n_experts)
+        dp = n // ep
+        mesh = Mesh(np.array(jax.devices()).reshape(dp, ep), ("dp", "ep"))
+        mesh_summary = {"dp": dp, "ep": ep}
+        step_builder = mixtral.build_moe_train_step
+    else:
+        from tpuslo.models import llama
+
+        cfg = getattr(llama, args.model)(max_seq_len=max(args.seq_len, 64))
+        plan = plan_for_devices(len(jax.devices()), slices=args.slices)
+        mesh = make_mesh(plan)
+        mesh_summary = {
+            "dcn": plan.dcn, "dp": plan.dp,
+            "fsdp": plan.fsdp, "tp": plan.tp,
+        }
 
     if args.corpus:
         with open(args.corpus, encoding="utf-8") as fh:
@@ -95,6 +124,7 @@ def main(argv: list[str] | None = None) -> int:
     result = train(
         cfg, mesh, texts, tcfg,
         checkpoint_dir=args.checkpoint_dir or None,
+        step_builder=step_builder,
     )
     for i, loss in enumerate(result["losses"]):
         print(
@@ -107,10 +137,7 @@ def main(argv: list[str] | None = None) -> int:
             {
                 "done": True,
                 "model": args.model,
-                "mesh": {
-                    "dcn": plan.dcn, "dp": plan.dp,
-                    "fsdp": plan.fsdp, "tp": plan.tp,
-                },
+                "mesh": mesh_summary,
                 "first_step": result["first_step"],
                 "last_step": result["last_step"],
                 "final_loss": round(result["losses"][-1], 6)

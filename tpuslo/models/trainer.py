@@ -41,11 +41,12 @@ class TrainerConfig:
 
 
 def train(
-    cfg: LlamaConfig,
+    cfg,  # LlamaConfig | MixtralConfig — any config its step_builder accepts
     mesh,
     texts: list[str],
     tcfg: TrainerConfig,
     checkpoint_dir: str | None = None,
+    step_builder=None,
 ) -> dict:
     """Run (or resume) a training session; returns
     ``{"losses", "first_step", "last_step"}``.
@@ -54,8 +55,16 @@ def train(
     resumes from the latest step: params/opt_state restore into their
     mesh shardings and the data stream fast-forwards past consumed
     batches.
+
+    ``step_builder(mesh, cfg) -> (step_fn, init_fn)`` selects the
+    model family: the default is the llama dp/fsdp/tp builder; the MoE
+    family passes :func:`tpuslo.models.mixtral.build_moe_train_step`
+    (dp x ep mesh) — checkpoint/resume and the data stream are
+    family-agnostic because both builders share the jitted
+    (step_fn, init_fn with out_shardings) contract.
     """
-    step_fn, init_fn = build_sharded_train_step(mesh, cfg)
+    builder = step_builder or build_sharded_train_step
+    step_fn, init_fn = builder(mesh, cfg)
     start_step = 0
     ckpt = None
     if checkpoint_dir and tcfg.ckpt_every:
