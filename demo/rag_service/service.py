@@ -225,7 +225,34 @@ class JaxMoEBackend:
                         f"{valid}{hint}"
                     )
                 cfg = getattr(mixtral, model)()
-            engine = MoEServeEngine(cfg=cfg)
+            mesh = None
+            tp = int(os.environ.get("TPUSLO_SERVE_TP", "0") or 0)
+            ep = int(os.environ.get("TPUSLO_SERVE_EP", "0") or 0)
+            if tp > 1 and ep > 1:
+                raise ValueError(
+                    "set TPUSLO_SERVE_TP or TPUSLO_SERVE_EP, not both "
+                    "(MoE serving takes a single-axis mesh)"
+                )
+            width = tp if tp > 1 else ep
+            if width > 1:
+                # tp slices inside every expert; ep shards experts
+                # whole (tokens never move, one psum per MoE block).
+                import jax
+                import numpy as np
+                from jax.sharding import Mesh
+
+                devices = jax.devices()
+                if len(devices) < width:
+                    raise ValueError(
+                        f"TPUSLO_SERVE_{'TP' if tp > 1 else 'EP'}="
+                        f"{width} but only {len(devices)} devices are "
+                        "visible"
+                    )
+                mesh = Mesh(
+                    np.array(devices[:width]),
+                    ("tp",) if tp > 1 else ("ep",),
+                )
+            engine = MoEServeEngine(cfg=cfg, mesh=mesh)
             engine.warmup()
         self.engine = engine
 

@@ -367,3 +367,30 @@ def test_engine_scheduler_stats_exported():
 
     plain = RagService(backend=StubBackend(), sleep=lambda s: None)
     assert plain.refresh_engine_stats() == {}
+
+
+def test_moe_backend_ep_mesh_env_knob(monkeypatch):
+    """TPUSLO_SERVE_EP=2 serves the MoE backend expert-parallel; the
+    stream matches the single-device engine (greedy, same seed)."""
+    from demo.rag_service.service import JaxMoEBackend
+
+    monkeypatch.setenv("TPUSLO_SERVE_EP", "2")
+    ep_backend = JaxMoEBackend()
+    monkeypatch.delenv("TPUSLO_SERVE_EP")
+    plain = JaxMoEBackend()
+    ep_toks = list(ep_backend.generate("demo ep moe", 6, 0.0, 0.0))
+    plain_toks = list(plain.generate("demo ep moe", 6, 0.0, 0.0))
+    assert ep_toks == plain_toks
+    w1 = ep_backend.engine.params["layers"]["w1"]
+    assert "ep" in str(w1.sharding.spec)
+
+
+def test_moe_backend_rejects_both_mesh_knobs(monkeypatch):
+    import pytest
+
+    from demo.rag_service.service import JaxMoEBackend
+
+    monkeypatch.setenv("TPUSLO_SERVE_TP", "2")
+    monkeypatch.setenv("TPUSLO_SERVE_EP", "2")
+    with pytest.raises(ValueError, match="not both"):
+        JaxMoEBackend()
