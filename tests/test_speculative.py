@@ -180,3 +180,81 @@ def test_speculative_with_tensor_parallel_target():
     expect = _plain_greedy(target, prompt, 12)
     got = spec.generate(prompt, max_new_tokens=12, stop_at_eos=False)
     assert got == expect
+
+
+class TestBatchedSpeculative:
+    """generate_batch: per-row streams identical to target-only greedy,
+    with per-row acceptance divergence riding vector-length verify."""
+
+    def _engines(self, draft_seed=0):
+        cfg = llama_tiny(max_seq_len=128)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        target = ServeEngine(cfg=cfg, params=params,
+                             prefill_buckets=(32, 64))
+        draft = ServeEngine(
+            cfg=cfg, params=init_params(jax.random.PRNGKey(draft_seed), cfg),
+            prefill_buckets=(32, 64),
+        )
+        return target, draft
+
+    def test_batch_matches_target_only_greedy_per_row(self):
+        target, draft = self._engines(draft_seed=7)  # weak draft: rejections
+        spec = SpeculativeEngine(target, draft, k=3)
+        prompts = ["first spec row", "a different second row",
+                   "and a third one"]
+        batch = spec.generate_batch(prompts, max_new_tokens=10,
+                                    stop_at_eos=False)
+        assert spec.acceptance_rate < 1.0  # unrelated draft: rejections
+        for prompt, row in zip(prompts, batch):
+            expect = [
+                e.token_id
+                for e in target.generate(prompt, max_new_tokens=10,
+                                         stop_at_eos=False)
+            ]
+            assert row == expect, prompt
+
+    def test_batch_matches_single_row_speculative(self):
+        target, draft = self._engines(draft_seed=7)
+        spec = SpeculativeEngine(target, draft, k=3)
+        batch = spec.generate_batch(
+            ["row with its own pace", "short"], max_new_tokens=8,
+            stop_at_eos=False,
+        )
+        single = SpeculativeEngine(target, draft, k=3)
+        for prompt, row in zip(["row with its own pace", "short"], batch):
+            assert row == single.generate(prompt, max_new_tokens=8,
+                                          stop_at_eos=False)
+
+    def test_self_draft_batch_accepts_nearly_everything(self):
+        target, _ = self._engines()
+        draft = ServeEngine(cfg=target.cfg, params=target.params,
+                            prefill_buckets=(32, 64))
+        spec = SpeculativeEngine(target, draft, k=4)
+        batch = spec.generate_batch(["same model drafts", "twice"],
+                                    max_new_tokens=12, stop_at_eos=False)
+        assert all(len(r) == 12 for r in batch)
+        assert spec.acceptance_rate > 0.9
+
+    def test_heterogeneous_lengths_near_capacity_no_truncation(self):
+        """A long row hitting the speculative window limit must not
+        truncate a short row's stream: guards range over ACTIVE rows
+        and finished rows' frontiers freeze.  (Regression: start.max()
+        over all rows ended the loops when the fastest/longest row ran
+        out of window, returning a truncated prefix for slow rows.)"""
+        target, _ = self._engines()
+        draft = ServeEngine(cfg=target.cfg, params=target.params,
+                            prefill_buckets=(32, 64))
+        spec = SpeculativeEngine(target, draft, k=4)  # full accepts
+        long_prompt = "x" * 99
+        short_prompt = "short row"
+        batch = spec.generate_batch(
+            [long_prompt, short_prompt], max_new_tokens=24,
+            stop_at_eos=False,
+        )
+        for prompt, row in zip([long_prompt, short_prompt], batch):
+            expect = [
+                e.token_id
+                for e in target.generate(prompt, max_new_tokens=24,
+                                         stop_at_eos=False)
+            ]
+            assert row == expect, (prompt[:12], len(row), len(expect))

@@ -101,6 +101,23 @@ def kv_write_rows(kv, new: jax.Array, rows: jax.Array, pos: jax.Array) -> PyTree
     return kv.at[rows, pos].set(new)
 
 
+def kv_write_rows_seq(
+    kv, new: jax.Array, rows: jax.Array, pos: jax.Array
+) -> PyTree:
+    """Scatter a ``(B, K, KV, HD)`` chunk per row starting at per-row
+    positions (batched speculative verify: every row scores K
+    positions from its own cache frontier)."""
+    K = new.shape[1]
+    idx = pos[:, None] + jnp.arange(K)[None, :]  # (B, K)
+    if isinstance(kv, dict):
+        qs = quantize_kv(new)
+        return {
+            "q": kv["q"].at[rows[:, None], idx].set(qs["q"]),
+            "s": kv["s"].at[rows[:, None], idx].set(qs["s"]),
+        }
+    return kv.at[rows[:, None], idx].set(new)
+
+
 def init_kv(shape: tuple[int, ...], dtype, kv_dtype: str) -> PyTree:
     """One cache side (k or v) of logical shape ``(..., S, KV, HD)``."""
     if validate_kv_dtype(kv_dtype) == "int8":

@@ -680,26 +680,44 @@ def verify_chunk(
     """Score K tokens in one pass: logits at every position.
 
     tokens: (B, K) — the next K sequence tokens starting at the cache's
-    current (scalar) ``length``.  Returns (logits (B, K, vocab), cache)
+    current ``length``.  Returns (logits (B, K, vocab), cache)
     with the chunk's KV written at positions ``length .. length+K-1``
     and ``length`` left UNCHANGED: the caller decides how many
     positions were accepted (speculative decoding) and advances
     ``cache["length"]`` itself.  KV slots past the accepted length are
     invisible under the decode mask and get overwritten as generation
     proceeds — the same stale-slot discipline as bucketed prefill.
+
+    ``length`` may be a scalar (all rows at one frontier — the shared
+    single-stream path) or a ``(B,)`` vector (batched speculative
+    decoding: every row verifies K positions from its OWN frontier);
+    the branch is on the static ndim, mirroring :func:`decode_step`.
     """
     from tpuslo.models import kv_cache as kvc
 
     B, K = tokens.shape
-    start = cache["length"]  # scalar: verify runs on the shared path
-    positions = jnp.broadcast_to(start + jnp.arange(K), (B, K))
+    start = cache["length"]
+    key_pos = jnp.arange(cfg.max_seq_len)
+    if start.ndim == 0:
+        positions = jnp.broadcast_to(start + jnp.arange(K), (B, K))
+        # Causal over the whole cache: key j visible to chunk row i iff
+        # j <= start + i.  (K, S_max), shared across batch rows.
+        mask = key_pos[None, :] <= (start + jnp.arange(K))[:, None]
+
+        def write(kv, new):
+            return kvc.kv_write_seq(kv, new, start)
+    else:
+        pos_vec = jnp.broadcast_to(start, (B,))
+        positions = pos_vec[:, None] + jnp.arange(K)[None, :]  # (B, K)
+        mask = key_pos[None, None, :] <= positions[:, :, None]  # (B, K, S)
+        rows = jnp.arange(B)
+
+        def write(kv, new):
+            return kvc.kv_write_rows_seq(kv, new, rows, pos_vec)
+
     h = _embed_lookup(params, tokens, cfg.dtype)
     cos, sin = rope_frequencies(cfg, positions)
     H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    # Causal over the whole cache: key j visible to chunk row i iff
-    # j <= start + i.  (K, S_max), shared across batch rows.
-    key_pos = jnp.arange(cfg.max_seq_len)
-    mask = key_pos[None, :] <= (start + jnp.arange(K))[:, None]
 
     def scan_step(h, inputs):
         layer, k_cache, v_cache = inputs
@@ -709,8 +727,8 @@ def verify_chunk(
         v = _matmul(x, layer["wv"]).reshape(B, K, KV, HD)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        k_cache = kvc.kv_write_seq(k_cache, k, start)
-        v_cache = kvc.kv_write_seq(v_cache, v, start)
+        k_cache = write(k_cache, k)
+        v_cache = write(v_cache, v)
         attn = attention(
             q, kvc.kv_load(k_cache, cfg.dtype),
             kvc.kv_load(v_cache, cfg.dtype), mask, H // KV,

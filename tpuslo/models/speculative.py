@@ -184,3 +184,134 @@ class SpeculativeEngine:
                 break
         self.emitted_tokens = len(out)
         return out[:max_new_tokens]
+
+    def generate_batch(
+        self,
+        prompts: list[str],
+        max_new_tokens: int = 32,
+        stop_at_eos: bool = True,
+    ) -> list[list[int]]:
+        """Batched speculative decoding: one stream per prompt, each
+        provably identical to the target-only greedy stream.
+
+        Rows verify from their OWN cache frontiers (the vector-length
+        :func:`tpuslo.models.llama.verify_chunk` path), so per-row
+        acceptance counts diverge freely while every device call stays
+        fixed-shape.  Per round the whole batch pays ONE draft chunk +
+        ONE verify + ONE draft fill step; rows that accepted fewer
+        draft tokens simply advance their frontier less.  The fill
+        step's write lands past the frontier of partially-accepting
+        rows and is therefore invisible/overwritable — the same
+        stale-slot discipline the single-stream path leans on.
+        """
+        import numpy as np
+
+        if not prompts:
+            return []
+        t, d = self.target, self.draft
+        max_prompt = max(1, min(t.cfg.max_seq_len, d.cfg.max_seq_len) - 2)
+        ids = [encode_bytes(p, max_prompt) for p in prompts]
+        B = len(ids)
+
+        logits_t, cache_t = t._prefill_rows(ids, 0)
+        _logits_d, cache_d = d._prefill_rows(ids, 0)
+        lens = np.asarray([len(row) for row in ids], np.int32)
+        # The longest row bounds every row's budget (the same rule as
+        # ServeEngine.generate_batch), keeping the loop uniform.
+        max_new_tokens = max(
+            1,
+            min(
+                max_new_tokens,
+                t.decode_cap_tokens(int(lens.max())),
+                d.decode_cap_tokens(int(lens.max())),
+            ),
+        )
+
+        first = jax.device_get(
+            jnp.argmax(logits_t, axis=-1).astype(jnp.int32)
+        )
+        outputs = [[int(v)] for v in first]
+        done = [stop_at_eos and o[-1] == EOS for o in outputs]
+        current = jnp.asarray(first, jnp.int32)
+        start = lens.copy()
+        limit = min(t.cfg.max_seq_len, d.cfg.max_seq_len) - (self.k + 1)
+
+        def active_mask() -> "np.ndarray":
+            return np.asarray(
+                [
+                    not done[r] and len(outputs[r]) < max_new_tokens
+                    for r in range(B)
+                ]
+            )
+
+        # Loop guards range over ACTIVE rows only, and finished rows'
+        # frontiers freeze: a fast-accepting (or done) row must not
+        # burn the shared budget and truncate slow rows below their
+        # granted max_new_tokens — each row's stream is promised
+        # identical to the target-only greedy stream.
+        while True:
+            mask = active_mask()
+            if not mask.any() or int(start[mask].max()) >= limit:
+                break
+            cache_d = {**cache_d, "length": jnp.asarray(start)}
+            cache_t = {**cache_t, "length": jnp.asarray(start)}
+            draft_toks, _last, cache_d = self._draft_chunk(
+                d.params, current, cache_d
+            )
+            chunk = jnp.concatenate([current[:, None], draft_toks], axis=1)
+            logits, cache_t = self._verify(t.params, chunk, cache_t)
+            target_pred = jnp.argmax(logits, axis=-1)  # (B, k+1)
+            drafts, preds = jax.device_get((draft_toks, target_pred))
+
+            accepted = np.zeros(B, np.int32)
+            emitted_last = np.array(jax.device_get(current), np.int32, copy=True)
+            for r in range(B):
+                if not mask[r]:
+                    continue
+                n = 0
+                while n < self.k and drafts[r, n] == preds[r, n]:
+                    n += 1
+                accepted[r] = n
+                emitted = [int(v) for v in drafts[r, :n]] + [int(preds[r, n])]
+                emitted_last[r] = emitted[-1]
+                for token in emitted:
+                    if done[r] or len(outputs[r]) >= max_new_tokens:
+                        break
+                    outputs[r].append(token)
+                    if stop_at_eos and token == EOS:
+                        done[r] = True
+                self.rounds += 1
+                self.accepted_draft_tokens += n
+
+            # Draft fill: rows that accepted everything need d_k's KV
+            # at start+k (the draft only wrote through start+k-1); run
+            # the step for EVERY row at that position — the write is
+            # invisible to rows whose next-round frontier sits below
+            # it, by the stale-slot discipline.
+            cache_d = {**cache_d, "length": jnp.asarray(start + self.k)}
+            _, cache_d = self._draft_step(d.params, draft_toks[:, -1], cache_d)
+
+            # Frontiers advance for active rows only (frozen rows keep
+            # re-decoding their frozen window; outputs ignored).
+            start = start + np.where(mask, accepted + 1, 0).astype(np.int32)
+            current = jnp.asarray(emitted_last, jnp.int32)
+
+        # Tail: finish near-capacity rows with plain batched target
+        # steps at per-row frontiers.
+        while True:
+            mask = active_mask() & (start < t.cfg.max_seq_len - 1)
+            if not mask.any():
+                break
+            cache_t = {**cache_t, "length": jnp.asarray(start)}
+            logits, cache_t = self._target_step(t.params, current, cache_t)
+            current = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            start = start + np.where(mask, 1, 0).astype(np.int32)
+            for r, value in enumerate(jax.device_get(current).tolist()):
+                if not mask[r] or len(outputs[r]) >= max_new_tokens:
+                    continue
+                outputs[r].append(int(value))
+                if stop_at_eos and value == EOS:
+                    done[r] = True
+
+        self.emitted_tokens += sum(len(o) for o in outputs)
+        return [o[:max_new_tokens] for o in outputs]

@@ -23,7 +23,6 @@ import jax
 
 from tpuslo.models.checkpoint import TrainCheckpointer, abstract_like
 from tpuslo.models.data import corpus_stream
-from tpuslo.models.llama import LlamaConfig
 from tpuslo.models.train import build_sharded_train_step
 from tpuslo.parallel.mesh import batch_sharding
 
