@@ -18,6 +18,7 @@ from demo.rag_service.service import (
     JaxBackend,
     JaxBatchedBackend,
     JaxMoEBackend,
+    JaxSpecBackend,
     RagService,
     StubBackend,
 )
@@ -94,7 +95,7 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--backend",
         default="stub",
-        choices=["stub", "jax", "jax_batched", "jax_moe"],
+        choices=["stub", "jax", "jax_batched", "jax_moe", "jax_spec"],
     )
     parser.add_argument("--seed", type=int, default=42)
     parser.add_argument("--node", default="tpu-vm-0")
@@ -111,6 +112,7 @@ def main(argv=None) -> int:
         "jax": JaxBackend,
         "jax_batched": JaxBatchedBackend,
         "jax_moe": JaxMoEBackend,
+        "jax_spec": JaxSpecBackend,
         "stub": StubBackend,
     }[args.backend]()
     vector_store = None

@@ -194,6 +194,68 @@ class JaxBackend:
             yield f"tok{event.token_id}"
 
 
+class JaxSpecBackend:
+    """Speculative serving behind the demo: a depth-pruned draft
+    proposes, the full target verifies — the stream is identical to
+    the target-only greedy stream, so this backend changes LATENCY
+    only (and is therefore a clean A/B for the toolkit's TTFT SLIs).
+
+    Knobs: the usual ``TPUSLO_SERVE_MODEL`` / ``TPUSLO_SERVE_INT8``
+    pick the target; ``TPUSLO_SERVE_SPEC_K`` (default 4) sets the
+    proposal depth; ``TPUSLO_SERVE_DRAFT_LAYERS`` overrides the
+    draft's depth (default: half the target's layers).
+    """
+
+    name = "jax_spec"
+
+    def __init__(self, engine=None):
+        if engine is None:
+            from dataclasses import replace
+
+            from tpuslo.models.serve import ServeEngine
+            from tpuslo.models.speculative import SpeculativeEngine
+
+            cfg, mesh, quantize = _serve_env_config()
+            if mesh is not None:
+                raise ValueError(
+                    "jax_spec serves single-device; unset TPUSLO_SERVE_TP "
+                    "(the speculative engine composes with a tp TARGET "
+                    "via the library API)"
+                )
+            if os.environ.get("TPUSLO_SYSTEM_PROMPT"):
+                raise ValueError(
+                    "jax_spec has no prefix-cache support yet; unset "
+                    "TPUSLO_SYSTEM_PROMPT (silently serving without the "
+                    "system prompt would break the identical-stream "
+                    "contract vs --backend jax)"
+                )
+            target = ServeEngine(cfg=cfg, quantize=quantize)
+            target.warmup()
+            t_cfg = target.cfg
+            draft_layers = int(
+                os.environ.get("TPUSLO_SERVE_DRAFT_LAYERS", "0") or 0
+            ) or max(1, t_cfg.n_layers // 2)
+            if not 1 <= draft_layers <= t_cfg.n_layers:
+                raise ValueError(
+                    f"TPUSLO_SERVE_DRAFT_LAYERS={draft_layers} outside "
+                    f"[1, {t_cfg.n_layers}]"
+                )
+            draft = ServeEngine(cfg=replace(t_cfg, n_layers=draft_layers))
+            draft.warmup()
+            k = int(os.environ.get("TPUSLO_SERVE_SPEC_K", "4") or 4)
+            engine = SpeculativeEngine(target, draft, k=k)
+        self.engine = engine
+
+    def generate(
+        self, prompt: str, max_new_tokens: int, warmup_ms: float, cadence_ms: float
+    ) -> Iterator[str]:
+        del warmup_ms, cadence_ms  # real compute sets the pace
+        for token_id in self.engine.stream(
+            prompt, max_new_tokens=max_new_tokens
+        ):
+            yield f"tok{token_id}"
+
+
 class JaxMoEBackend:
     """Second model family behind the same demo: Mixtral-class MoE via
     :class:`tpuslo.models.mixtral.MoEServeEngine` (greedy streaming)."""

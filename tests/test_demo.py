@@ -394,3 +394,28 @@ def test_moe_backend_rejects_both_mesh_knobs(monkeypatch):
     monkeypatch.setenv("TPUSLO_SERVE_EP", "2")
     with pytest.raises(ValueError, match="not both"):
         JaxMoEBackend()
+
+
+def test_jax_spec_backend_matches_jax_backend_stream(monkeypatch):
+    """The speculative demo backend must stream the IDENTICAL token
+    text as the plain jax backend (speculation is latency-only)."""
+    from demo.rag_service.service import JaxBackend, JaxSpecBackend
+
+    monkeypatch.delenv("TPUSLO_SYSTEM_PROMPT", raising=False)
+    plain = JaxBackend()
+    spec = JaxSpecBackend()
+    prompt = "speculative demo stream"
+    expect = list(plain.generate(prompt, 8, 0.0, 0.0))
+    got = list(spec.generate(prompt, 8, 0.0, 0.0))
+    assert got == expect
+    assert spec.engine.rounds > 0
+
+
+def test_jax_spec_backend_rejects_tp(monkeypatch):
+    import pytest
+
+    from demo.rag_service.service import JaxSpecBackend
+
+    monkeypatch.setenv("TPUSLO_SERVE_TP", "2")
+    with pytest.raises(ValueError, match="single-device"):
+        JaxSpecBackend()

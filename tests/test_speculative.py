@@ -258,3 +258,41 @@ class TestBatchedSpeculative:
                                          stop_at_eos=False)
             ]
             assert row == expect, (prompt[:12], len(row), len(expect))
+
+    def test_batch_pads_to_buckets_and_returns_real_rows(self):
+        """3 prompts pad to the 4-bucket (each shape compiles once);
+        only the real rows come back, streams unaffected."""
+        target, draft = self._engines(draft_seed=7)
+        spec = SpeculativeEngine(target, draft, k=2)
+        prompts = ["one", "two", "three"]
+        batch = spec.generate_batch(prompts, max_new_tokens=5,
+                                    stop_at_eos=False)
+        assert len(batch) == 3
+        for prompt, row in zip(prompts, batch):
+            expect = [
+                e.token_id
+                for e in target.generate(prompt, max_new_tokens=5,
+                                         stop_at_eos=False)
+            ]
+            assert row == expect
+
+
+def test_stream_yields_first_token_before_full_generation():
+    """stream() is a real generator: the first token arrives without
+    decoding the rest (the demo backend's TTFT depends on it)."""
+    cfg = llama_tiny(max_seq_len=128)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    target = ServeEngine(cfg=cfg, params=params, prefill_buckets=(32,))
+    draft = ServeEngine(cfg=cfg, params=params, prefill_buckets=(32,))
+    spec = SpeculativeEngine(target, draft, k=3)
+    gen = spec.stream("stream me", max_new_tokens=64, stop_at_eos=False)
+    first = next(gen)
+    assert isinstance(first, int)
+    assert spec.emitted_tokens == 1  # nothing decoded past the prefill
+    rest = list(gen)
+    expect = [
+        e.token_id
+        for e in target.generate("stream me", max_new_tokens=64,
+                                 stop_at_eos=False)
+    ]
+    assert [first] + rest == expect  # capacity-capped, same budget rule

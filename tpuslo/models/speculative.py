@@ -86,6 +86,17 @@ class SpeculativeEngine:
         steps, so the guarantee holds all the way to the last free
         cache slot.
         """
+        return list(
+            self.stream(prompt, max_new_tokens, stop_at_eos=stop_at_eos)
+        )
+
+    def stream(
+        self, prompt: str, max_new_tokens: int = 32, stop_at_eos: bool = True
+    ):
+        """Generator form of :meth:`generate`: tokens yield as emitted
+        (the first right after the target prefill, then 1..k+1 per
+        round), so a streaming server's TTFT measures prefill latency —
+        not whole-generation latency."""
         t, d = self.target, self.draft
         # Chunked ingestion (head prefill + bucket appends) lifts the
         # prompt cap to joint KV capacity; both engines must ingest the
@@ -113,9 +124,12 @@ class SpeculativeEngine:
         )
 
         current = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)  # (1,)
-        out = [int(current[0])]
-        if stop_at_eos and out[-1] == EOS:
-            return out
+        first = int(current[0])
+        emitted_count = 1
+        self.emitted_tokens += 1
+        yield first
+        if (stop_at_eos and first == EOS) or max_new_tokens <= 1:
+            return
 
         # Budget: each round writes k+1 target KV slots from `start`.
         # The frontier is tracked host-side (always a host-set value
@@ -124,7 +138,7 @@ class SpeculativeEngine:
         # a network round-trip.
         start = len(ids)
         limit = min(t.cfg.max_seq_len, d.cfg.max_seq_len) - (self.k + 1)
-        while len(out) < max_new_tokens and start < limit:
+        while emitted_count < max_new_tokens and start < limit:
             draft_toks, _last, cache_d = self._draft_chunk(
                 d.params, current, cache_d
             )
@@ -161,11 +175,12 @@ class SpeculativeEngine:
             start += n + 1
             current = jnp.asarray([emitted[-1]], jnp.int32)
             for token in emitted:
-                out.append(int(token))
+                emitted_count += 1
+                self.emitted_tokens += 1
+                yield int(token)
                 if stop_at_eos and token == EOS:
-                    self.emitted_tokens = len(out)
-                    return out[:max_new_tokens]
-                if len(out) >= max_new_tokens:
+                    return
+                if emitted_count >= max_new_tokens:
                     break
 
         # Tail: fewer than k+1 free KV slots left — finish with plain
@@ -173,23 +188,25 @@ class SpeculativeEngine:
         # match the target-only greedy stream instead of silently
         # stopping early.
         while (
-            len(out) < max_new_tokens
+            emitted_count < max_new_tokens
             and start < t.cfg.max_seq_len - 1
         ):
             logits, cache_t = self._target_step(t.params, current, cache_t)
             current = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             start += 1
-            out.append(int(current[0]))
-            if stop_at_eos and out[-1] == EOS:
-                break
-        self.emitted_tokens = len(out)
-        return out[:max_new_tokens]
+            emitted_count += 1
+            self.emitted_tokens += 1
+            value = int(current[0])
+            yield value
+            if stop_at_eos and value == EOS:
+                return
 
     def generate_batch(
         self,
         prompts: list[str],
         max_new_tokens: int = 32,
         stop_at_eos: bool = True,
+        batch_buckets: tuple[int, ...] = (1, 2, 4, 8),
     ) -> list[list[int]]:
         """Batched speculative decoding: one stream per prompt, each
         provably identical to the target-only greedy stream.
@@ -209,9 +226,30 @@ class SpeculativeEngine:
         if not prompts:
             return []
         t, d = self.target, self.draft
+        if len(prompts) > batch_buckets[-1]:
+            # Oversized requests split into largest-bucket sub-batches
+            # (the ServeEngine.generate_batch discipline).
+            cap = batch_buckets[-1]
+            outputs: list[list[int]] = []
+            for i in range(0, len(prompts), cap):
+                outputs.extend(
+                    self.generate_batch(
+                        prompts[i : i + cap],
+                        max_new_tokens=max_new_tokens,
+                        stop_at_eos=stop_at_eos,
+                        batch_buckets=batch_buckets,
+                    )
+                )
+            return outputs
         max_prompt = max(1, min(t.cfg.max_seq_len, d.cfg.max_seq_len) - 2)
         ids = [encode_bytes(p, max_prompt) for p in prompts]
-        B = len(ids)
+        n_real = len(ids)
+        # Pad the batch to a compile bucket so each shape compiles once
+        # (four jitted programs specialize on B); pad rows start done.
+        from tpuslo.models.serve import _bucket
+
+        B = _bucket(n_real, batch_buckets)
+        ids = ids + [[ids[0][0]]] * (B - n_real)
 
         logits_t, cache_t = t._prefill_rows(ids, 0)
         _logits_d, cache_d = d._prefill_rows(ids, 0)
@@ -231,7 +269,10 @@ class SpeculativeEngine:
             jnp.argmax(logits_t, axis=-1).astype(jnp.int32)
         )
         outputs = [[int(v)] for v in first]
-        done = [stop_at_eos and o[-1] == EOS for o in outputs]
+        done = [
+            r >= n_real or (stop_at_eos and outputs[r][-1] == EOS)
+            for r in range(B)
+        ]
         current = jnp.asarray(first, jnp.int32)
         start = lens.copy()
         limit = min(t.cfg.max_seq_len, d.cfg.max_seq_len) - (self.k + 1)
@@ -313,5 +354,5 @@ class SpeculativeEngine:
                 if stop_at_eos and value == EOS:
                     done[r] = True
 
-        self.emitted_tokens += sum(len(o) for o in outputs)
-        return [o[:max_new_tokens] for o in outputs]
+        self.emitted_tokens += sum(len(o) for o in outputs[:n_real])
+        return [o[:max_new_tokens] for o in outputs[:n_real]]
