@@ -200,6 +200,12 @@ class JaxSpecBackend:
     the target-only greedy stream, so this backend changes LATENCY
     only (and is therefore a clean A/B for the toolkit's TTFT SLIs).
 
+    Honesty note: the latency WIN requires trained weights (layer-skip
+    drafts track trained targets; truncating a random-init model's
+    depth decorrelates its features, measured acceptance ~0.13 on the
+    demo's random weights).  On random weights this backend exercises
+    the machinery and the identical-stream contract, not the speedup.
+
     Knobs: the usual ``TPUSLO_SERVE_MODEL`` / ``TPUSLO_SERVE_INT8``
     pick the target; ``TPUSLO_SERVE_SPEC_K`` (default 4) sets the
     proposal depth; ``TPUSLO_SERVE_DRAFT_LAYERS`` overrides the
@@ -222,13 +228,6 @@ class JaxSpecBackend:
                     "(the speculative engine composes with a tp TARGET "
                     "via the library API)"
                 )
-            if os.environ.get("TPUSLO_SYSTEM_PROMPT"):
-                raise ValueError(
-                    "jax_spec has no prefix-cache support yet; unset "
-                    "TPUSLO_SYSTEM_PROMPT (silently serving without the "
-                    "system prompt would break the identical-stream "
-                    "contract vs --backend jax)"
-                )
             target = ServeEngine(cfg=cfg, quantize=quantize)
             target.warmup()
             t_cfg = target.cfg
@@ -240,18 +239,40 @@ class JaxSpecBackend:
                     f"TPUSLO_SERVE_DRAFT_LAYERS={draft_layers} outside "
                     f"[1, {t_cfg.n_layers}]"
                 )
-            draft = ServeEngine(cfg=replace(t_cfg, n_layers=draft_layers))
+            # TRUE depth-pruned self-speculation: the draft reuses the
+            # target's embeddings/output head and its FIRST
+            # draft_layers transformer layers (sliced from the stacked
+            # leaves) — an independently initialized small model would
+            # agree with the target at chance level and make
+            # speculation strictly slower.
+            import jax as _jax
+
+            draft_params = {
+                **target.params,
+                "layers": _jax.tree.map(
+                    lambda leaf: leaf[:draft_layers],
+                    target.params["layers"],
+                ),
+            }
+            draft = ServeEngine(
+                cfg=replace(t_cfg, n_layers=draft_layers),
+                params=draft_params,
+            )
             draft.warmup()
             k = int(os.environ.get("TPUSLO_SERVE_SPEC_K", "4") or 4)
             engine = SpeculativeEngine(target, draft, k=k)
         self.engine = engine
+        # Same shared-system-prompt semantics as the other jax
+        # backends: the speculative stream with prefix= matches the
+        # target-only prefix stream id-for-id.
+        self.system_prompt = os.environ.get("TPUSLO_SYSTEM_PROMPT") or None
 
     def generate(
         self, prompt: str, max_new_tokens: int, warmup_ms: float, cadence_ms: float
     ) -> Iterator[str]:
         del warmup_ms, cadence_ms  # real compute sets the pace
         for token_id in self.engine.stream(
-            prompt, max_new_tokens=max_new_tokens
+            prompt, max_new_tokens=max_new_tokens, prefix=self.system_prompt
         ):
             yield f"tok{token_id}"
 

@@ -411,6 +411,20 @@ def test_jax_spec_backend_matches_jax_backend_stream(monkeypatch):
     assert spec.engine.rounds > 0
 
 
+def test_jax_spec_backend_system_prompt_matches_jax(monkeypatch):
+    """With a shared system prompt, the speculative stream still
+    matches the plain jax backend id-for-id."""
+    from demo.rag_service.service import JaxBackend, JaxSpecBackend
+
+    monkeypatch.setenv("TPUSLO_SYSTEM_PROMPT", "demo system preamble")
+    plain = JaxBackend()
+    spec = JaxSpecBackend()
+    prompt = "user question"
+    assert list(spec.generate(prompt, 6, 0.0, 0.0)) == list(
+        plain.generate(prompt, 6, 0.0, 0.0)
+    )
+
+
 def test_jax_spec_backend_rejects_tp(monkeypatch):
     import pytest
 

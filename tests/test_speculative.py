@@ -296,3 +296,25 @@ def test_stream_yields_first_token_before_full_generation():
                                  stop_at_eos=False)
     ]
     assert [first] + rest == expect  # capacity-capped, same budget rule
+
+
+def test_stream_with_prefix_matches_target_prefix_stream():
+    """prefix= mirrors ServeEngine.generate(prefix=...)'s id-level
+    truncation rules: identical stream, rejections and all."""
+    cfg = llama_tiny(max_seq_len=128)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    target = ServeEngine(cfg=cfg, params=params, prefill_buckets=(32, 64))
+    draft = ServeEngine(
+        cfg=cfg, params=init_params(jax.random.PRNGKey(7), cfg),
+        prefill_buckets=(32, 64),
+    )
+    spec = SpeculativeEngine(target, draft, k=3)
+    prefix = "shared system preamble for speculation"
+    expect = [
+        e.token_id
+        for e in target.generate("user ask", max_new_tokens=10,
+                                 stop_at_eos=False, prefix=prefix)
+    ]
+    got = spec.generate("user ask", max_new_tokens=10,
+                        stop_at_eos=False, prefix=prefix)
+    assert got == expect

@@ -368,17 +368,20 @@ def _speculative_lane(
     verify_fn = jax.jit(partial(verify_chunk, cfg=cfg), donate_argnums=(2,))
     t_verify = time_loop(verify_fn, params, (chunk, mid_cache(cfg)))
 
+    # Batched round costs (generate_batch's operating point): vector
+    # cache frontiers, same one-pass verify — the per-position cost
+    # drop is what makes batched speculation pay on the MXU.  Timed
+    # BEFORE the draft weights exist, so peak HBM stays lower and a
+    # failure here cannot leak them.
+    t_decode_b8 = time_loop(step_fn, params, (tok_b, mid_cache_b(cfg)))
+    t_verify_b8 = time_loop(verify_fn, params, (chunk_b, mid_cache_b(cfg)))
+
     draft_cfg = replace(cfg, n_layers=max(1, cfg.n_layers // 2))
     draft_params = init_params(jax.random.PRNGKey(11), draft_cfg)
     draft_fn = jax.jit(
         partial(decode_chunk, cfg=draft_cfg, num_tokens=k),
         donate_argnums=(2,),
     )
-    # Batched round costs (generate_batch's operating point): vector
-    # cache frontiers, same one-pass verify — the per-position cost
-    # drop is what makes batched speculation pay on the MXU.
-    t_decode_b8 = time_loop(step_fn, params, (tok_b, mid_cache_b(cfg)))
-    t_verify_b8 = time_loop(verify_fn, params, (chunk_b, mid_cache_b(cfg)))
     try:
         t_draft = time_loop(
             draft_fn, draft_params, (tok, mid_cache(draft_cfg))

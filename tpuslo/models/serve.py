@@ -285,6 +285,22 @@ def kv_cache_shardings(mesh, kv_dtype: str = "bf16"):
     }
 
 
+def prefix_prompt_ids(
+    prefix: str, prompt: str, max_seq_len: int
+) -> tuple[list[int], list[int]]:
+    """The ONE definition of prefix+suffix id-level truncation.
+
+    (prefix_ids, suffix_ids) exactly as ``cache_prefix`` +
+    ``ingest_prompt(prefix=...)`` produce them; the speculative engine
+    shares this helper so its prefix stream stays bit-identical to the
+    target-only prefix stream (any rule change lands in both paths).
+    """
+    prefix_ids = encode_bytes(prefix, max(1, max_seq_len - 3))
+    room = max_seq_len - 2 - len(prefix_ids)
+    suffix_ids = list(prompt.encode("utf-8"))[: max(0, room)]
+    return prefix_ids, suffix_ids
+
+
 def encode_bytes(text: str, max_len: int) -> list[int]:
     """Byte-level encode with BOS, truncated to max_len."""
     ids = [BOS] + [b for b in text.encode("utf-8")]
@@ -712,7 +728,8 @@ class ServeEngine:
             return entry
         # Leave room for at least one suffix token + one generated one;
         # prefixes longer than the largest bucket ingest chunked.
-        ids = encode_bytes(text, max(1, self.cfg.max_seq_len - 3))
+        # (Truncation rule owned by prefix_prompt_ids.)
+        ids, _ = prefix_prompt_ids(text, "", self.cfg.max_seq_len)
         logits, cache = self._ingest_ids(ids)
         logits.block_until_ready()
         entry = PrefixEntry(text=text, ids=ids, cache=cache, logits=logits)
@@ -835,8 +852,9 @@ class ServeEngine:
         """
         if prefix:
             entry = self.cache_prefix(prefix)
-            room = self.cfg.max_seq_len - 2 - len(entry.ids)
-            suffix_ids = list(prompt.encode("utf-8"))[: max(0, room)]
+            _, suffix_ids = prefix_prompt_ids(
+                prefix, prompt, self.cfg.max_seq_len
+            )
             total_len = len(entry.ids) + len(suffix_ids)
             cache = self._clone_cache(entry.cache)
             if suffix_ids:

@@ -75,7 +75,11 @@ class SpeculativeEngine:
         return self.accepted_draft_tokens / proposed if proposed else 0.0
 
     def generate(
-        self, prompt: str, max_new_tokens: int = 32, stop_at_eos: bool = True
+        self,
+        prompt: str,
+        max_new_tokens: int = 32,
+        stop_at_eos: bool = True,
+        prefix: str | None = None,
     ) -> list[int]:
         """Greedy generation; returns the emitted token ids.
 
@@ -87,16 +91,32 @@ class SpeculativeEngine:
         cache slot.
         """
         return list(
-            self.stream(prompt, max_new_tokens, stop_at_eos=stop_at_eos)
+            self.stream(
+                prompt, max_new_tokens, stop_at_eos=stop_at_eos,
+                prefix=prefix,
+            )
         )
 
     def stream(
-        self, prompt: str, max_new_tokens: int = 32, stop_at_eos: bool = True
+        self,
+        prompt: str,
+        max_new_tokens: int = 32,
+        stop_at_eos: bool = True,
+        prefix: str | None = None,
     ):
         """Generator form of :meth:`generate`: tokens yield as emitted
         (the first right after the target prefill, then 1..k+1 per
         round), so a streaming server's TTFT measures prefill latency —
-        not whole-generation latency."""
+        not whole-generation latency.
+
+        ``prefix`` mirrors :meth:`ServeEngine.generate`'s prefix
+        semantics (same id-level truncation rules, so the stream is
+        identical to the target-only prefix stream).  Correctness
+        first: both engines ingest ``prefix + suffix`` as one sequence
+        — the TARGET side reuses its KV prefix cache via
+        :meth:`ServeEngine.cache_prefix` when available is future
+        work, the draft must re-prefill either way.
+        """
         t, d = self.target, self.draft
         # Chunked ingestion (head prefill + bucket appends) lifts the
         # prompt cap to joint KV capacity; both engines must ingest the
@@ -106,8 +126,19 @@ class SpeculativeEngine:
         # slot (NOT minus k: the tail fallback already handles prompts
         # too long for a speculative round, and extra truncation would
         # break exactness vs the target-only stream near capacity).
-        max_prompt = max(1, min(t.cfg.max_seq_len, d.cfg.max_seq_len) - 2)
-        ids = encode_bytes(prompt, max_prompt)
+        joint_seq = min(t.cfg.max_seq_len, d.cfg.max_seq_len)
+        if prefix:
+            # The SHARED truncation helper keeps this bit-identical to
+            # ServeEngine.generate(prefix=...) (serve.prefix_prompt_ids
+            # is the one definition of the rules).
+            from tpuslo.models.serve import prefix_prompt_ids
+
+            prefix_ids, suffix_ids = prefix_prompt_ids(
+                prefix, prompt, joint_seq
+            )
+            ids = prefix_ids + suffix_ids
+        else:
+            ids = encode_bytes(prompt, max(1, joint_seq - 2))
 
         logits_t, cache_t = t._ingest_ids(ids)
         _logits_d, cache_d = d._ingest_ids(ids)
