@@ -21,6 +21,6 @@ Capability parity with the reference toolkit
 ``Reference:`` docstring citations (file:line into /root/reference).
 """
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"
 
 TOOLKIT_NAME = "tpuslo"
