@@ -513,6 +513,8 @@ class ServeEngine:
         stop_at_eos: bool = True,
         batch_buckets: tuple[int, ...] = (1, 2, 4, 8),
         prefix: str | None = None,
+        sampling: SamplingConfig | None = None,
+        seed: int = 0,
     ) -> list[list[int]]:
         """Throughput-oriented batched decode; one list of token ids
         per prompt.
@@ -541,13 +543,22 @@ class ServeEngine:
                 "generate_batch(prefix=...) needs non-empty per-row "
                 "suffixes; use generate() for prefix-only requests"
             )
+        sampling = sampling or GREEDY
         if len(prompts) > batch_buckets[-1]:
             # Oversized requests split into largest-bucket sub-batches:
             # _bucket clamps to buckets[-1], so one oversize pass would
-            # prefill more real rows than the KV cache has.
+            # prefill more real rows than the KV cache has.  Sub-batch
+            # seeds fold (seed, slice index) through the PRNG — linear
+            # arithmetic would collide derived seeds with plain user
+            # seeds and other slices' derivations.
             cap = batch_buckets[-1]
             outputs: list[list[int]] = []
             for i in range(0, len(prompts), cap):
+                sub_seed = int(
+                    jax.random.fold_in(
+                        jax.random.PRNGKey(seed), i // cap + 1
+                    )[1]
+                )
                 outputs.extend(
                     self.generate_batch(
                         prompts[i : i + cap],
@@ -555,9 +566,12 @@ class ServeEngine:
                         stop_at_eos=stop_at_eos,
                         batch_buckets=batch_buckets,
                         prefix=prefix,
+                        sampling=sampling,
+                        seed=sub_seed,
                     )
                 )
             return outputs
+        rng = jax.random.PRNGKey(seed)
         if prefix:
             entry = self.cache_prefix(prefix)
             start = len(entry.ids)
@@ -591,13 +605,26 @@ class ServeEngine:
             logits, cache = self._prefill_rows(ids, start, kv=kv)
         else:
             logits, cache = self._prefill_rows(ids, 0)
-        token = prefill_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        token = prefill_token = sample_from_logits(
+            logits, jax.random.fold_in(rng, 0), sampling
+        )
         # Dispatch the first decode chunk before the host-side read of
         # the prefill tokens, as generate() does: the device decodes
-        # while the host unpacks.
+        # while the host unpacks.  Greedy keeps rng=None so the call
+        # signature matches warmup's jit cache entry (the same
+        # discipline as generate()); stochastic rows share one key per
+        # chunk — reproducibility is batch-level (same seed + prompts
+        # => same outputs), not row-equal to the streaming path.
+        def chunk_rng(i):
+            return None if sampling.greedy else jax.random.fold_in(rng, i)
+
+        chunk_idx = 1
         toks = None
         if max_new_tokens > 1:
-            toks, token, cache = decode_fn(self.params, token, cache)
+            toks, token, cache = decode_fn(
+                self.params, token, cache,
+                sampling=sampling, rng=chunk_rng(chunk_idx),
+            )
         first = jax.device_get(prefill_token).tolist()
         outputs = [[int(t)] for t in first]
         done = [stop_at_eos and t == EOS for t in first]
@@ -608,8 +635,10 @@ class ServeEngine:
             # before reading chunk N, hiding the transfer round-trip.
             next_toks = next_token = None
             if produced + chunk < max_new_tokens:
+                chunk_idx += 1
                 next_toks, next_token, cache = decode_fn(
-                    self.params, token, cache
+                    self.params, token, cache,
+                    sampling=sampling, rng=chunk_rng(chunk_idx),
                 )
             for row, values in enumerate(jax.device_get(toks).tolist()):
                 for value in values:
