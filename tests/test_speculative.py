@@ -318,3 +318,28 @@ def test_stream_with_prefix_matches_target_prefix_stream():
     got = spec.generate("user ask", max_new_tokens=10,
                         stop_at_eos=False, prefix=prefix)
     assert got == expect
+
+
+def test_generate_batch_with_prefix_matches_target_prefix_streams():
+    """Batched speculation under a shared system prompt: every row
+    equals the target-only prefix stream (same shared truncation
+    helper as the single-row path)."""
+    cfg = llama_tiny(max_seq_len=128)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    target = ServeEngine(cfg=cfg, params=params, prefill_buckets=(32, 64))
+    draft = ServeEngine(
+        cfg=cfg, params=init_params(jax.random.PRNGKey(7), cfg),
+        prefill_buckets=(32, 64),
+    )
+    spec = SpeculativeEngine(target, draft, k=3)
+    prefix = "shared batched preamble"
+    prompts = ["first ask", "second ask"]
+    batch = spec.generate_batch(prompts, max_new_tokens=8,
+                                stop_at_eos=False, prefix=prefix)
+    for prompt, row in zip(prompts, batch):
+        expect = [
+            e.token_id
+            for e in target.generate(prompt, max_new_tokens=8,
+                                     stop_at_eos=False, prefix=prefix)
+        ]
+        assert row == expect, prompt

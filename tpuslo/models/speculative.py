@@ -238,6 +238,7 @@ class SpeculativeEngine:
         max_new_tokens: int = 32,
         stop_at_eos: bool = True,
         batch_buckets: tuple[int, ...] = (1, 2, 4, 8),
+        prefix: str | None = None,
     ) -> list[list[int]]:
         """Batched speculative decoding: one stream per prompt, each
         provably identical to the target-only greedy stream.
@@ -269,11 +270,27 @@ class SpeculativeEngine:
                         max_new_tokens=max_new_tokens,
                         stop_at_eos=stop_at_eos,
                         batch_buckets=batch_buckets,
+                        prefix=prefix,
                     )
                 )
             return outputs
-        max_prompt = max(1, min(t.cfg.max_seq_len, d.cfg.max_seq_len) - 2)
-        ids = [encode_bytes(p, max_prompt) for p in prompts]
+        joint_seq = min(t.cfg.max_seq_len, d.cfg.max_seq_len)
+        if prefix:
+            # Shared truncation helper — per-row streams must equal the
+            # target-only prefix streams id-for-id (correctness-first:
+            # both engines re-prefill prefix+suffix; snapshot reuse on
+            # the target side is future work, as in stream()).
+            from tpuslo.models.serve import prefix_prompt_ids
+
+            ids = []
+            for p in prompts:
+                prefix_ids, suffix_ids = prefix_prompt_ids(
+                    prefix, p, joint_seq
+                )
+                ids.append(prefix_ids + suffix_ids)
+        else:
+            max_prompt = max(1, joint_seq - 2)
+            ids = [encode_bytes(p, max_prompt) for p in prompts]
         n_real = len(ids)
         # Pad the batch to a compile bucket so each shape compiles once
         # (four jitted programs specialize on B); pad rows start done.
