@@ -166,6 +166,24 @@ def _serve_env_config():
     return cfg, mesh, quantize
 
 
+def _sampling_env_config():
+    """SamplingConfig from TPUSLO_SERVE_TEMPERATURE / _TOP_K / _TOP_P,
+    or None (greedy) when none are set.  Shared by the jax backends so
+    the knobs mean the same thing everywhere."""
+    temp = os.environ.get("TPUSLO_SERVE_TEMPERATURE", "")
+    top_k = os.environ.get("TPUSLO_SERVE_TOP_K", "")
+    top_p = os.environ.get("TPUSLO_SERVE_TOP_P", "")
+    if not (temp or top_k or top_p):
+        return None
+    from tpuslo.models.llama import SamplingConfig
+
+    return SamplingConfig(
+        temperature=float(temp or 1.0),
+        top_k=int(top_k or 0),
+        top_p=float(top_p or 1.0),
+    )
+
+
 class JaxBackend:
     """Real JAX Llama decode via :class:`tpuslo.models.serve.ServeEngine`."""
 
@@ -179,6 +197,7 @@ class JaxBackend:
             engine = ServeEngine(cfg=cfg, mesh=mesh, quantize=quantize)
             engine.warmup()
         self.engine = engine
+        self.sampling = _sampling_env_config()
         # Resolved once like every other TPUSLO_SERVE_* knob: the
         # shared system prompt rides the KV prefix cache, so its
         # prefill cost is paid once, not per request.
@@ -189,7 +208,8 @@ class JaxBackend:
     ) -> Iterator[str]:
         del warmup_ms, cadence_ms  # real compute sets the pace
         for event in self.engine.generate(
-            prompt, max_new_tokens=max_new_tokens, prefix=self.system_prompt
+            prompt, max_new_tokens=max_new_tokens, prefix=self.system_prompt,
+            sampling=self.sampling,
         ):
             yield f"tok{event.token_id}"
 

@@ -433,3 +433,22 @@ def test_jax_spec_backend_rejects_tp(monkeypatch):
     monkeypatch.setenv("TPUSLO_SERVE_TP", "2")
     with pytest.raises(ValueError, match="single-device"):
         JaxSpecBackend()
+
+
+def test_jax_backend_sampling_env_knobs(monkeypatch):
+    """TPUSLO_SERVE_TEMPERATURE/_TOP_K turn on stochastic decoding;
+    unset knobs keep the bit-identical greedy default."""
+    from demo.rag_service.service import JaxBackend
+
+    monkeypatch.delenv("TPUSLO_SYSTEM_PROMPT", raising=False)
+    greedy = JaxBackend()
+    assert greedy.sampling is None
+    base = list(greedy.generate("sampled demo", 8, 0.0, 0.0))
+    assert list(greedy.generate("sampled demo", 8, 0.0, 0.0)) == base
+
+    monkeypatch.setenv("TPUSLO_SERVE_TEMPERATURE", "1.3")
+    monkeypatch.setenv("TPUSLO_SERVE_TOP_K", "50")
+    warm = JaxBackend(engine=greedy.engine)
+    assert warm.sampling is not None and warm.sampling.top_k == 50
+    sampled = list(warm.generate("sampled demo", 8, 0.0, 0.0))
+    assert len(sampled) == len(base)
