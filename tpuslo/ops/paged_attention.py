@@ -11,9 +11,18 @@ serving at batch >= 16: each lane reads ONLY ITS OWN blocks.
 
 Design (the vLLM-style paged attention pattern, TPU-native):
 
-* grid ``(B, KV, MB)`` — lane x kv-head x logical block, the block
-  dimension innermost so VMEM scratch (online-softmax running max,
-  normalizer, accumulator) carries across one lane's blocks;
+* grid ``(B, MB)`` — lane x logical block, the block dimension
+  innermost so VMEM scratch (online-softmax running max, normalizer,
+  accumulator) carries across one lane's blocks;
+* each fetched K/V block carries ALL kv heads — block shape
+  ``(1, BS, KV, HD)`` — so its trailing two dims equal the array dims
+  ``(KV, HD)``, satisfying the Mosaic tiling rule (the last two block
+  dims must be divisible by (8, 128) or equal the array's); the
+  round-4 live capture proved the per-head layout ``(1, BS, 1, HD)``
+  fails TPU lowering at every batch on exactly that rule.  The
+  all-head block is also the better DMA: ``pool[phys]`` is one
+  contiguous region, and one fetch serves every kv head (the per-head
+  grid re-fetched it KV times);
 * the page table and per-lane lengths ride SCALAR PREFETCH
   (``pltpu.PrefetchScalarGridSpec``): the K/V BlockSpec index maps
   look up ``page_table[b, j]`` to fetch the lane's physical block —
@@ -22,11 +31,18 @@ Design (the vLLM-style paged attention pattern, TPU-native):
   (not just masked): per-step work is O(lane's live context), so the
   O(B*pool) term the arithmetic flagged is gone;
 * grouped-query attention comes from the q layout ``(B, KV, n_rep,
-  HD)`` — each program scores its kv-head's ``n_rep`` query heads
-  against one physical block;
+  HD)`` — the kernel unrolls a Python loop over the KV heads, each
+  iteration scoring that head's ``n_rep`` query rows against its
+  slice of the fetched block (all ops stay 2D, the shape Mosaic
+  vectorizes best);
 * int8 pools dequantize IN the kernel: the q/scale leaves are passed
   as separate refs, so HBM traffic stays int8 and only the VMEM tile
   widens to f32.
+
+``tests/test_pallas_tpu_lowering.py`` runs the REAL Mosaic TPU
+lowering (via ``jax.export`` cross-platform export) on CPU for the
+flagship decode shapes, so tiling violations fail in CI without a
+chip — ``interpret=True`` alone never exercises the tiling rule.
 
 Off by default in the engine (the measured curve says XLA wins at the
 b<=8 operating point); enable with ``PagedBatchingEngine(
@@ -49,22 +65,24 @@ NEG_INF = float("-inf")
 def _paged_kernel(
     pt_ref,  # scalar prefetch: (B, MB) int32 page table
     len_ref,  # scalar prefetch: (B,) int32 lane lengths
-    q_ref,  # (1, 1, n_rep, HD)
-    k_ref,  # (1, BS, 1, HD) — the lane's j-th physical block
+    q_ref,  # (1, KV, n_rep, HD)
+    k_ref,  # (1, BS, KV, HD) — the lane's j-th physical block
     v_ref,
-    o_ref,  # (1, 1, n_rep, HD)
-    m_scratch,
-    l_scratch,
-    acc_scratch,
+    o_ref,  # (1, KV, n_rep, HD)
+    m_scratch,  # (KV * n_rep, 128) f32 — running max, lane-broadcast
+    l_scratch,  # (KV * n_rep, 128) f32 — running normalizer
+    acc_scratch,  # (KV * n_rep, HD) f32
     *,
     scale: float,
     block_size: int,
     num_blocks: int,
+    n_kv: int,
+    n_rep: int,
     k_scale_ref=None,
     v_scale_ref=None,
 ):
     b = pl.program_id(0)
-    j = pl.program_id(2)
+    j = pl.program_id(1)
 
     @pl.when(j == 0)
     def _init():
@@ -79,44 +97,55 @@ def _paged_kernel(
 
     @pl.when(relevant)
     def _body():
-        q = q_ref[0, 0].astype(jnp.float32)  # (n_rep, HD)
-        k = k_ref[0, :, 0].astype(jnp.float32)  # (BS, HD)
-        v = v_ref[0, :, 0].astype(jnp.float32)
-        if k_scale_ref is not None:
-            k = k * k_scale_ref[0, :, 0].astype(jnp.float32)[:, None]
-            v = v * v_scale_ref[0, :, 0].astype(jnp.float32)[:, None]
+        # One softmax-state row group per kv head; the head loop is a
+        # Python unroll (KV is static), so every op below is 2D.
+        for g in range(n_kv):
+            rows = slice(g * n_rep, (g + 1) * n_rep)
+            q = q_ref[0, g].astype(jnp.float32)  # (n_rep, HD)
+            k = k_ref[0, :, g].astype(jnp.float32)  # (BS, HD)
+            v = v_ref[0, :, g].astype(jnp.float32)
+            if k_scale_ref is not None:
+                k = k * k_scale_ref[0, :, g : g + 1].astype(jnp.float32)
+                v = v * v_scale_ref[0, :, g : g + 1].astype(jnp.float32)
 
-        s = (
-            lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())),
+            s = (
+                lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                * scale
+            )  # (n_rep, BS)
+            abs_pos = j * block_size + lax.broadcasted_iota(
+                jnp.int32, s.shape, 1
+            )
+            in_range = abs_pos <= pos
+            s = jnp.where(in_range, s, NEG_INF)
+
+            m_prev = m_scratch[rows, :1]  # (n_rep, 1)
+            l_prev = l_scratch[rows, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.where(
+                m_prev == NEG_INF, 0.0, jnp.exp(m_prev - m_new)
+            )
+            p = jnp.where(in_range, jnp.exp(s - m_new), 0.0)
+            l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+            l_scratch[rows] = jnp.broadcast_to(
+                l_new, (n_rep, l_scratch.shape[-1])
+            )
+            acc_scratch[rows] = acc_scratch[rows] * alpha + lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-            * scale
-        )  # (n_rep, BS)
-        abs_pos = j * block_size + lax.broadcasted_iota(
-            jnp.int32, s.shape, 1
-        )
-        s = jnp.where(abs_pos <= pos, s, NEG_INF)
-
-        m_prev = m_scratch[:, 0]
-        l_prev = l_scratch[:, 0]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
-        alpha = jnp.where(m_prev == NEG_INF, 0.0, jnp.exp(m_prev - m_new))
-        p = jnp.where(abs_pos <= pos, jnp.exp(s - m_new[:, None]), 0.0)
-        l_scratch[:] = jnp.broadcast_to(
-            (alpha * l_prev + jnp.sum(p, axis=-1))[:, None], l_scratch.shape
-        )
-        acc_scratch[:] = acc_scratch[:] * alpha[:, None] + lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        m_scratch[:] = jnp.broadcast_to(m_new[:, None], m_scratch.shape)
+            m_scratch[rows] = jnp.broadcast_to(
+                m_new, (n_rep, m_scratch.shape[-1])
+            )
 
     @pl.when(j == num_blocks - 1)
     def _epilogue():
-        l_final = l_scratch[:, 0]
+        l_final = l_scratch[:, :1]  # (KV * n_rep, 1)
         denom = jnp.where(l_final == 0.0, 1.0, l_final)
-        o_ref[0, 0] = (acc_scratch[:] / denom[:, None]).astype(o_ref.dtype)
+        out = (acc_scratch[:] / denom).astype(o_ref.dtype)
+        o_ref[0] = out.reshape(n_kv, n_rep, out.shape[-1])
 
 
 def paged_decode_attention(
@@ -149,12 +178,12 @@ def paged_decode_attention(
     if out_dtype is None:
         out_dtype = q.dtype
 
-    # (B, KV, n_rep, HD): kv-head becomes a grid row, its grouped query
-    # heads stay together in one block.
+    # (B, KV, n_rep, HD): trailing block dims (n_rep, HD) equal the
+    # array dims, so any GQA group width is tile-legal.
     qt = q.reshape(B, KV, n_rep, HD)
 
-    def q_index(b, g, j, pt, lens):
-        return (b, g, 0, 0)
+    def q_index(b, j, pt, lens):
+        return (b, 0, 0, 0)
 
     def _live_block(b, j, pt, lens):
         # Clamp to the lane's last LIVE block: pl.when skips only the
@@ -165,51 +194,51 @@ def paged_decode_attention(
         # is what makes per-step HBM O(lane's live context).
         return pt[b, jnp.minimum(j, lens[b] // block_size)]
 
-    def kv_index(b, g, j, pt, lens):
-        return (_live_block(b, j, pt, lens), 0, g, 0)
+    def kv_index(b, j, pt, lens):
+        return (_live_block(b, j, pt, lens), 0, 0, 0)
 
-    def scale_index(b, g, j, pt, lens):
-        return (_live_block(b, j, pt, lens), 0, g)
+    def scale_index(b, j, pt, lens):
+        return (_live_block(b, j, pt, lens), 0, 0)
 
     in_specs = [
-        pl.BlockSpec((1, 1, n_rep, HD), q_index),
-        pl.BlockSpec((1, block_size, 1, HD), kv_index),
-        pl.BlockSpec((1, block_size, 1, HD), kv_index),
+        pl.BlockSpec((1, KV, n_rep, HD), q_index),
+        pl.BlockSpec((1, block_size, KV, HD), kv_index),
+        pl.BlockSpec((1, block_size, KV, HD), kv_index),
     ]
     operands = [qt, kq, v_pool["q"] if quantized else v_pool]
+    common = dict(
+        scale=HD**-0.5,
+        block_size=block_size,
+        num_blocks=MB,
+        n_kv=KV,
+        n_rep=n_rep,
+    )
     if not quantized:
-        kernel = functools.partial(
-            _paged_kernel,
-            scale=HD**-0.5,
-            block_size=block_size,
-            num_blocks=MB,
-        )
+        kernel = functools.partial(_paged_kernel, **common)
     if quantized:
         in_specs += [
-            pl.BlockSpec((1, block_size, 1), scale_index),
-            pl.BlockSpec((1, block_size, 1), scale_index),
+            pl.BlockSpec((1, block_size, KV), scale_index),
+            pl.BlockSpec((1, block_size, KV), scale_index),
         ]
         operands += [k_pool["s"], v_pool["s"]]
 
         def kernel(pt, lens, q_r, k_r, v_r, ks_r, vs_r, o_r, m, l, acc):  # noqa: E501
             return _paged_kernel(
                 pt, lens, q_r, k_r, v_r, o_r, m, l, acc,
-                scale=HD**-0.5,
-                block_size=block_size,
-                num_blocks=MB,
                 k_scale_ref=ks_r,
                 v_scale_ref=vs_r,
+                **common,
             )
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(B, KV, MB),
+        grid=(B, MB),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, 1, n_rep, HD), q_index),
+        out_specs=pl.BlockSpec((1, KV, n_rep, HD), q_index),
         scratch_shapes=[
-            pltpu.VMEM((n_rep, 128), jnp.float32),
-            pltpu.VMEM((n_rep, 128), jnp.float32),
-            pltpu.VMEM((n_rep, HD), jnp.float32),
+            pltpu.VMEM((KV * n_rep, 128), jnp.float32),
+            pltpu.VMEM((KV * n_rep, 128), jnp.float32),
+            pltpu.VMEM((KV * n_rep, HD), jnp.float32),
         ],
     )
     out = pl.pallas_call(
