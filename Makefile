@@ -19,6 +19,11 @@ native:
 ebpf:
 	./ebpf/gen.sh
 
+# Frontend verification of every probe against -target bpf via the
+# libclang wheel (works without a clang driver; see tools/ docstring).
+ebpf-check:
+	$(PY) tools/ebpf_frontend_check.py --write
+
 # ---- test -------------------------------------------------------------
 
 test: native

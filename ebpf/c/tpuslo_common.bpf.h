@@ -6,7 +6,7 @@
  * per-program files contain only hook logic.
  *
  * Counterpart of the reference's per-program boilerplate (each of
- * ebpf/c/*.bpf.c re-declares its own ringbuf + maps); centralising it
+ * its probe sources re-declares its own ringbuf + maps); centralising
  * here is a deliberate divergence: one map definition, one submit
  * path, and cookie-based signal dispatch for uprobes (see
  * libtpu_uprobes.bpf.c).

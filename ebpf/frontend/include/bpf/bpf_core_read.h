@@ -1,0 +1,19 @@
+/* SPDX-License-Identifier: GPL-2.0 */
+/*
+ * Minimal CO-RE read surface for the frontend check.  The vmlinux
+ * types are declared preserve_access_index, so a direct member access
+ * IS a CO-RE-relocated access under clang -target bpf; BPF_CORE_READ
+ * reduces to that for the non-pointer-chasing accessors the tpuslo
+ * probes use (single dotted paths, no pointer hops).  Real builds use
+ * libbpf's bpf_core_read.h, whose variadic form also chases pointers
+ * through bpf_probe_read_kernel.
+ */
+#ifndef __TPUSLO_BPF_CORE_READ_MIN_H__
+#define __TPUSLO_BPF_CORE_READ_MIN_H__
+
+#define BPF_CORE_READ(src, accessor) ((src)->accessor)
+
+#define bpf_core_read(dst, sz, src) \
+	bpf_probe_read_kernel(dst, sz, (const void *)(src))
+
+#endif /* __TPUSLO_BPF_CORE_READ_MIN_H__ */

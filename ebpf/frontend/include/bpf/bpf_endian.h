@@ -1,0 +1,12 @@
+/* SPDX-License-Identifier: GPL-2.0 */
+/* Minimal endian helpers for the frontend check (BPF targets here are
+ * little-endian x86 hosts).  Real builds use libbpf's bpf_endian.h. */
+#ifndef __TPUSLO_BPF_ENDIAN_MIN_H__
+#define __TPUSLO_BPF_ENDIAN_MIN_H__
+
+#define bpf_ntohs(x) __builtin_bswap16(x)
+#define bpf_htons(x) __builtin_bswap16(x)
+#define bpf_ntohl(x) __builtin_bswap32(x)
+#define bpf_htonl(x) __builtin_bswap32(x)
+
+#endif /* __TPUSLO_BPF_ENDIAN_MIN_H__ */

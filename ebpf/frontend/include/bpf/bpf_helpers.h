@@ -1,0 +1,39 @@
+/* SPDX-License-Identifier: GPL-2.0 */
+/*
+ * Minimal libbpf helper surface for the frontend check (see
+ * ../vmlinux.h header comment).  Declarations follow the public BPF
+ * helper ABI (helper IDs are stable kernel UAPI); only the helpers
+ * the tpuslo probes call are declared.  Real builds use libbpf's
+ * bpf_helpers.h (ebpf/gen.sh).
+ */
+#ifndef __TPUSLO_BPF_HELPERS_MIN_H__
+#define __TPUSLO_BPF_HELPERS_MIN_H__
+
+#define SEC(name) __attribute__((section(name), used))
+
+#ifndef __always_inline
+#define __always_inline inline __attribute__((always_inline))
+#endif
+
+/* BTF map-definition DSL: the field TYPES carry the configuration. */
+#define __uint(name, val) int (*name)[val]
+#define __type(name, val) typeof(val) *name
+#define __array(name, val) typeof(val) *name[]
+
+static void *(*bpf_map_lookup_elem)(void *map, const void *key) = (void *)1;
+static long (*bpf_map_update_elem)(void *map, const void *key,
+				   const void *value, __u64 flags) = (void *)2;
+static long (*bpf_map_delete_elem)(void *map, const void *key) = (void *)3;
+static __u64 (*bpf_ktime_get_ns)(void) = (void *)5;
+static __u64 (*bpf_get_current_pid_tgid)(void) = (void *)14;
+static long (*bpf_get_current_comm)(void *buf, __u32 size_of_buf) =
+	(void *)16;
+static long (*bpf_probe_read_kernel)(void *dst, __u32 size,
+				     const void *unsafe_ptr) = (void *)113;
+static void *(*bpf_ringbuf_reserve)(void *ringbuf, __u64 size,
+				    __u64 flags) = (void *)131;
+static void (*bpf_ringbuf_submit)(void *data, __u64 flags) = (void *)132;
+static void (*bpf_ringbuf_discard)(void *data, __u64 flags) = (void *)133;
+static __u64 (*bpf_get_attach_cookie)(void *ctx) = (void *)174;
+
+#endif /* __TPUSLO_BPF_HELPERS_MIN_H__ */

@@ -1,0 +1,75 @@
+/* SPDX-License-Identifier: GPL-2.0 */
+/*
+ * Minimal tracing-macro surface for the frontend check: the
+ * BPF_KPROBE / BPF_KRETPROBE / BPF_UPROBE / BPF_URETPROBE wrapper
+ * contract (typed-argument probe bodies over a pt_regs context),
+ * x86-64 calling convention.  Follows the public libbpf macro
+ * behavior — each macro argument is one full parameter declaration;
+ * the generated wrapper extracts PT_REGS_PARMn/RC and casts through
+ * (void *) with -Wint-conversion suppressed, exactly the shape probe
+ * authors program against.  Real builds use libbpf's bpf_tracing.h.
+ */
+#ifndef __TPUSLO_BPF_TRACING_MIN_H__
+#define __TPUSLO_BPF_TRACING_MIN_H__
+
+#define PT_REGS_PARM1(x) ((x)->di)
+#define PT_REGS_PARM2(x) ((x)->si)
+#define PT_REGS_PARM3(x) ((x)->dx)
+#define PT_REGS_PARM4(x) ((x)->cx)
+#define PT_REGS_PARM5(x) ((x)->r8)
+#define PT_REGS_RC(x) ((x)->ax)
+#define PT_REGS_IP(x) ((x)->ip)
+
+#define ___tpuslo_concat(a, b) a##b
+#define ___tpuslo_apply(fn, n) ___tpuslo_concat(fn, n)
+#define ___tpuslo_nth(_, _1, _2, _3, _4, _5, N, ...) N
+#define ___tpuslo_narg(...) ___tpuslo_nth(_, ##__VA_ARGS__, 5, 4, 3, 2, 1, 0)
+
+#define ___tpuslo_kprobe_args0() ctx
+#define ___tpuslo_kprobe_args1(x) \
+	___tpuslo_kprobe_args0(), (void *)PT_REGS_PARM1(ctx)
+#define ___tpuslo_kprobe_args2(x, args...) \
+	___tpuslo_kprobe_args1(args), (void *)PT_REGS_PARM2(ctx)
+#define ___tpuslo_kprobe_args3(x, args...) \
+	___tpuslo_kprobe_args2(args), (void *)PT_REGS_PARM3(ctx)
+#define ___tpuslo_kprobe_args4(x, args...) \
+	___tpuslo_kprobe_args3(args), (void *)PT_REGS_PARM4(ctx)
+#define ___tpuslo_kprobe_args5(x, args...) \
+	___tpuslo_kprobe_args4(args), (void *)PT_REGS_PARM5(ctx)
+#define ___tpuslo_kprobe_args(args...) \
+	___tpuslo_apply(___tpuslo_kprobe_args, ___tpuslo_narg(args))(args)
+
+#define BPF_KPROBE(name, args...)					\
+name(struct pt_regs *ctx);						\
+static __always_inline int ____##name(struct pt_regs *ctx, ##args);	\
+int name(struct pt_regs *ctx)						\
+{									\
+	_Pragma("GCC diagnostic push")					\
+	_Pragma("GCC diagnostic ignored \"-Wint-conversion\"")		\
+	return ____##name(___tpuslo_kprobe_args(args));			\
+	_Pragma("GCC diagnostic pop")					\
+}									\
+static __always_inline int ____##name(struct pt_regs *ctx, ##args)
+
+#define ___tpuslo_kretprobe_args0() ctx
+#define ___tpuslo_kretprobe_args1(x) \
+	___tpuslo_kretprobe_args0(), (void *)PT_REGS_RC(ctx)
+#define ___tpuslo_kretprobe_args(args...) \
+	___tpuslo_apply(___tpuslo_kretprobe_args, ___tpuslo_narg(args))(args)
+
+#define BPF_KRETPROBE(name, args...)					\
+name(struct pt_regs *ctx);						\
+static __always_inline int ____##name(struct pt_regs *ctx, ##args);	\
+int name(struct pt_regs *ctx)						\
+{									\
+	_Pragma("GCC diagnostic push")					\
+	_Pragma("GCC diagnostic ignored \"-Wint-conversion\"")		\
+	return ____##name(___tpuslo_kretprobe_args(args));		\
+	_Pragma("GCC diagnostic pop")					\
+}									\
+static __always_inline int ____##name(struct pt_regs *ctx, ##args)
+
+#define BPF_UPROBE(name, args...) BPF_KPROBE(name, ##args)
+#define BPF_URETPROBE(name, args...) BPF_KRETPROBE(name, ##args)
+
+#endif /* __TPUSLO_BPF_TRACING_MIN_H__ */

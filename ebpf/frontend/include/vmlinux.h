@@ -1,0 +1,163 @@
+/* SPDX-License-Identifier: GPL-2.0 */
+/*
+ * Minimal kernel-type surface for FRONTEND checking of the probe
+ * programs (tools/ebpf_frontend_check.py) — NOT a generated vmlinux.h.
+ *
+ * This image has no clang driver and no kernel BTF, so full
+ * CO-RE object compilation cannot happen here; what can is the real
+ * clang-18 frontend (parse + semantic analysis, via the libclang
+ * wheel) against `-target bpf`.  This header supplies exactly the
+ * types the 13 programs in ebpf/c/ reference, shaped like their
+ * kernel counterparts and marked preserve_access_index the way a real
+ * vmlinux.h is, so member access typechecks under the same CO-RE
+ * rules.  On a clang-capable host, `ebpf/gen.sh` uses a real
+ * bpftool-generated vmlinux.h instead; this file is never shipped
+ * into a load path.
+ */
+#ifndef __TPUSLO_VMLINUX_MIN_H__
+#define __TPUSLO_VMLINUX_MIN_H__
+
+typedef unsigned char __u8;
+typedef signed char __s8;
+typedef unsigned short __u16;
+typedef short __s16;
+typedef unsigned int __u32;
+typedef int __s32;
+typedef unsigned long long __u64;
+typedef long long __s64;
+typedef __u16 __be16;
+typedef __u32 __be32;
+typedef _Bool bool;
+typedef __s32 pid_t;
+typedef __u64 sector_t;
+
+enum {
+	BPF_ANY = 0,
+	BPF_NOEXIST = 1,
+	BPF_EXIST = 2,
+};
+
+enum bpf_map_type {
+	BPF_MAP_TYPE_HASH = 1,
+	BPF_MAP_TYPE_ARRAY = 2,
+	BPF_MAP_TYPE_PERCPU_HASH = 5,
+	BPF_MAP_TYPE_RINGBUF = 27,
+};
+
+#ifndef __ksym_structs_no_preserve
+#pragma clang attribute push (__attribute__((preserve_access_index)), apply_to = record)
+#endif
+
+/* x86-64 register file as BPF tracing sees it (BPF_KPROBE arg
+ * extraction; field order is irrelevant to the frontend). */
+struct pt_regs {
+	unsigned long r15;
+	unsigned long r14;
+	unsigned long r13;
+	unsigned long r12;
+	unsigned long bp;
+	unsigned long bx;
+	unsigned long r11;
+	unsigned long r10;
+	unsigned long r9;
+	unsigned long r8;
+	unsigned long ax;
+	unsigned long cx;
+	unsigned long dx;
+	unsigned long si;
+	unsigned long di;
+	unsigned long orig_ax;
+	unsigned long ip;
+	unsigned long cs;
+	unsigned long flags;
+	unsigned long sp;
+	unsigned long ss;
+};
+
+struct sock_common {
+	__be32 skc_daddr;
+	__be32 skc_rcv_saddr;
+	__be16 skc_dport;
+	__u16 skc_num;
+	__u16 skc_family;
+};
+
+struct sock {
+	struct sock_common __sk_common;
+};
+
+struct file {
+	unsigned int f_flags;
+};
+
+struct trace_entry {
+	unsigned short type;
+	unsigned char flags;
+	unsigned char preempt_count;
+	int pid;
+};
+
+struct trace_event_raw_sched_wakeup_template {
+	struct trace_entry ent;
+	char comm[16];
+	pid_t pid;
+	int prio;
+	int target_cpu;
+};
+
+struct trace_event_raw_sched_switch {
+	struct trace_entry ent;
+	char prev_comm[16];
+	pid_t prev_pid;
+	int prev_prio;
+	long prev_state;
+	char next_comm[16];
+	pid_t next_pid;
+	int next_prio;
+};
+
+struct trace_event_raw_sched_stat_template {
+	struct trace_entry ent;
+	char comm[16];
+	pid_t pid;
+	__u64 delay;
+};
+
+struct trace_event_raw_block_rq {
+	struct trace_entry ent;
+	__u32 dev;
+	sector_t sector;
+	unsigned int nr_sector;
+	unsigned int bytes;
+	char rwbs[8];
+	char comm[16];
+};
+
+struct trace_event_raw_block_rq_completion {
+	struct trace_entry ent;
+	__u32 dev;
+	sector_t sector;
+	unsigned int nr_sector;
+	int error;
+	char rwbs[8];
+};
+
+struct trace_event_raw_tcp_event_sk_skb {
+	struct trace_entry ent;
+	const void *skbaddr;
+	const void *skaddr;
+	int state;
+	__u16 sport;
+	__u16 dport;
+	__u16 family;
+	__u8 saddr[4];
+	__u8 daddr[4];
+	__u8 saddr_v6[16];
+	__u8 daddr_v6[16];
+};
+
+#ifndef __ksym_structs_no_preserve
+#pragma clang attribute pop
+#endif
+
+#endif /* __TPUSLO_VMLINUX_MIN_H__ */

@@ -305,6 +305,67 @@ def _prefix_lane(engine) -> dict[str, Any]:
         "ttft_speedup": round(full_ms / max(cached_ms, 1e-9), 2),
     }
 
+    # --- b1 decomposition: where does TTFT actually go? ----------------
+    # The r4 live capture measured ttft_speedup 0.99 at b1 on the chip
+    # (vs 2.84 at b8, 2.07 on CPU) with NO explanation (VERDICT r4 weak
+    # #4).  Decompose: time the INGEST alone (prefill/append, synced
+    # inside ingest_prompt) for both paths, so the report can say
+    # whether TTFT is ingest-bound (prefix caching must show) or
+    # overhead-bound (fixed per-request cost — dispatch round trips,
+    # first decode step, stream setup — swallows the saved ingest; on
+    # the tunneled backend the r4 capture's TTFT was ~135-170 ms FLAT
+    # from 50-id to 1022-id prompts, pointing here).
+    from tpuslo.models.serve import _bucket, prefix_prompt_ids
+
+    _, suffix_ids = prefix_prompt_ids(prefix, user, engine.cfg.max_seq_len)
+    out["suffix_ids"] = len(suffix_ids)
+    out["suffix_bucket"] = _bucket(len(suffix_ids), engine.prefill_buckets)
+    out["full_bucket"] = _bucket(
+        len(prefix + user) + 1, engine.prefill_buckets
+    )
+
+    def ingest_only_ms(fn) -> float:
+        t0 = time.perf_counter()
+        fn()
+        return (time.perf_counter() - t0) * 1000.0
+
+    compiles0 = len(engine.compile_events)
+    ingest_full = min(
+        ingest_only_ms(lambda: engine.ingest_prompt(prefix + user))
+        for _ in range(3)
+    )
+    ingest_cached = min(
+        ingest_only_ms(lambda: engine.ingest_prompt(user, prefix=prefix))
+        for _ in range(3)
+    )
+    out["ingest_full_ms"] = round(ingest_full, 2)
+    out["ingest_cached_ms"] = round(ingest_cached, 2)
+    out["lane_compile_events"] = len(engine.compile_events) - compiles0
+    overhead = full_ms - ingest_full
+    out["ttft_fixed_overhead_ms"] = round(overhead, 2)
+    saved = ingest_full - ingest_cached
+    if saved <= 0.15 * full_ms:
+        out["b1_verdict"] = (
+            f"overhead-bound: ingest saves only {saved:.0f} ms while "
+            f"~{overhead:.0f} ms of TTFT is fixed per-request cost, so "
+            "no prefix-cache b1 speedup is arithmetically possible at "
+            "this operating point; the feature's b1 value needs longer "
+            "prefixes or lower dispatch latency, and its measured value "
+            "is batched (batch8_speedup)"
+        )
+    elif cached_ms <= full_ms - 0.5 * saved:
+        out["b1_verdict"] = (
+            f"ingest-bound and delivering: {saved:.0f} ms saved ingest "
+            f"shows up in TTFT ({full_ms:.0f} -> {cached_ms:.0f} ms)"
+        )
+    else:
+        out["b1_verdict"] = (
+            f"anomaly: ingest saves {saved:.0f} ms but TTFT moved only "
+            f"{full_ms - cached_ms:.0f} ms — overhead between ingest "
+            "and first token is absorbing the win; profile the decode "
+            "step + stream setup on this backend"
+        )
+
     # Batch-8 single-shot: shared-prefix prefill vs full-prompt prefill.
     users = [f"{user} #{i}" for i in range(8)]
     fulls = [prefix + u for u in users]
@@ -605,6 +666,9 @@ def _speculative_measured_lane(
         )
         first = last = None
         try:
+            # Losses stay device arrays inside the loop: a float() per
+            # step would force a host sync per step (hundreds of tunnel
+            # round-trips on the remote-chip backend).
             for i, (tokens, targets) in enumerate(stream):
                 if i >= steps:
                     break
@@ -612,16 +676,16 @@ def _speculative_measured_lane(
                     params, opt_state, tokens, targets
                 )
                 if first is None:
-                    first = float(loss)
-                last = float(loss)
+                    first = loss
+                last = loss
         finally:
             stream.close()
         del opt_state
         trained[name] = params
         lane[name] = {
             "n_params": param_count(cfg_i),
-            "loss_first": round(first, 4),
-            "loss_last": round(last, 4),
+            "loss_first": round(float(first), 4),
+            "loss_last": round(float(last), 4),
         }
     lane["cost_ratio"] = round(
         lane["target"]["n_params"] / lane["draft"]["n_params"], 1
