@@ -168,7 +168,11 @@ class TestGraftEntry:
         import importlib
 
         ge = importlib.import_module("__graft_entry__")
-        ge.dryrun_multichip(8)
+        # serving=False: the five serving-matrix parity cells are each
+        # covered by dedicated tests (test_serve_sharded /
+        # test_moe_sharded / test_paged_sharded) on this same substrate;
+        # the driver runs the full matrix every round.
+        ge.dryrun_multichip(8, serving=False)
         assert "ok on 8 devices" in capsys.readouterr().out
 
 

@@ -27,7 +27,7 @@ only cross-chip traffic per token is the two scalar-field collectives.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Any
 
 import jax
@@ -197,8 +197,24 @@ def sp_prefill_raw(
             f"true_length {true_length} outside [1, {S}] — logits "
             "would silently come from a zero hidden state"
         )
+    fn = _sp_prefill_fn(cfg, mesh, axis_name, kv_dtype, mlp_fn)
+    return fn(params, tokens, jnp.asarray(true_length, jnp.int32))
+
+
+@lru_cache(maxsize=32)
+def _sp_prefill_fn(cfg, mesh, axis_name, kv_dtype, mlp_fn):
+    """Memoized shard_map-wrapped prefill body.
+
+    A fresh ``shard_map(partial(...))`` per call is a NEW function
+    object, so jax's dispatch cache misses and every call re-traces and
+    re-compiles the whole ring — measured as the dominant cost of the
+    sp test files (and it would hit every production prefill the same
+    way).  Keyed by (cfg, mesh, axis, dtype, mlp_fn): all hashable,
+    equal-valued meshes hash equal, so even freshly-built meshes reuse
+    the compiled ring.
+    """
     ctx = _ctx_spec(axis_name, kv_dtype == "int8")
-    fn = shard_map(
+    return shard_map(
         partial(
             _sp_prefill_body, cfg=cfg, axis_name=axis_name,
             kv_dtype=kv_dtype, mlp_fn=mlp_fn,
@@ -207,7 +223,6 @@ def sp_prefill_raw(
         in_specs=(P(), P(None, axis_name), P()),
         out_specs=(P(), ctx, ctx),
     )
-    return fn(params, tokens, jnp.asarray(true_length, jnp.int32))
 
 
 def sp_prefill(
@@ -384,16 +399,25 @@ def sp_decode_step(
             )
     except (TypeError, jax.errors.TracerArrayConversionError):
         pass  # traced: budget enforced by the caller
-    cache_specs = sp_cache_specs(
-        axis_name, int8=isinstance(cache["k_ctx"], dict)
+    fn = _sp_decode_fn(
+        cfg, mesh, axis_name, mlp_fn,
+        isinstance(cache["k_ctx"], dict),
     )
-    fn = shard_map(
+    return fn(params, token, cache)
+
+
+@lru_cache(maxsize=32)
+def _sp_decode_fn(cfg, mesh, axis_name, mlp_fn, int8: bool):
+    """Memoized decode-step shard_map (same rationale as
+    :func:`_sp_prefill_fn` — a per-call closure defeats the dispatch
+    cache and recompiles the ring every step)."""
+    cache_specs = sp_cache_specs(axis_name, int8=int8)
+    return shard_map(
         partial(_sp_decode_body, cfg=cfg, axis_name=axis_name, mlp_fn=mlp_fn),
         mesh=mesh,
         in_specs=(P(), P(), cache_specs),
         out_specs=(P(), cache_specs),
     )
-    return fn(params, token, cache)
 
 
 def sp_generate(
@@ -417,13 +441,7 @@ def sp_generate(
         params, tokens, cfg, mesh, tail_max=tail_max, axis_name=axis_name,
         kv_dtype=kv_dtype, mlp_fn=mlp_fn,
     )
-    step = jax.jit(
-        partial(
-            sp_decode_step, cfg=cfg, mesh=mesh, axis_name=axis_name,
-            mlp_fn=mlp_fn,
-        ),
-        donate_argnums=(2,),
-    )
+    step = _sp_generate_step(cfg, mesh, axis_name, mlp_fn)
     token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     out = [token]
     for _ in range(max_new_tokens - 1):
@@ -431,3 +449,16 @@ def sp_generate(
         token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         out.append(token)
     return jnp.stack(out, axis=1)
+
+
+@lru_cache(maxsize=32)
+def _sp_generate_step(cfg, mesh, axis_name, mlp_fn):
+    """Memoized jitted decode step for :func:`sp_generate` (one compile
+    per (cfg, mesh) instead of one per generate call)."""
+    return jax.jit(
+        partial(
+            sp_decode_step, cfg=cfg, mesh=mesh, axis_name=axis_name,
+            mlp_fn=mlp_fn,
+        ),
+        donate_argnums=(2,),
+    )

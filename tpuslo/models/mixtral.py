@@ -277,8 +277,15 @@ def loss_fn(params, tokens, targets, cfg: MixtralConfig) -> jax.Array:
 # incremental and full-sequence outputs diverge.
 
 
+@lru_cache(maxsize=16)
 def _serving_mlp_fn(cfg: MixtralConfig):
-    """mlp_fn hook for the llama serving paths: MoE, aux discarded."""
+    """mlp_fn hook for the llama serving paths: MoE, aux discarded.
+
+    Memoized so equal configs return the IDENTICAL function object —
+    downstream jit/shard_map caches (longserve's memoized builders, the
+    engines' shared kernels) key on mlp_fn identity, and a fresh lambda
+    per call would recompile the whole path every time.
+    """
     return lambda layer, x: _moe_block(layer, x, cfg)[0]
 
 
@@ -699,8 +706,15 @@ def build_moe_train_step(mesh: Mesh, cfg: MixtralConfig, optimizer=None):
 
     GSPMD keeps expert weights resident on their ep shard and inserts
     the token exchanges; gradients psum over dp.  Returns
-    ``(step_fn, init_fn)`` like the llama builder.
+    ``(step_fn, init_fn)`` like the llama builder.  Memoized like the
+    llama builder (tpuslo.models.train): equal (mesh, cfg) callers
+    share one compiled step instead of recompiling per session.
     """
+    return _cached_moe_train_step(mesh, cfg, optimizer)
+
+
+@lru_cache(maxsize=16)
+def _cached_moe_train_step(mesh: Mesh, cfg: MixtralConfig, optimizer):
     import optax
 
     optimizer = optimizer or optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)

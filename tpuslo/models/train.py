@@ -9,7 +9,7 @@ HBM bounded.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Any
 
 import jax
@@ -58,7 +58,19 @@ def build_sharded_train_step(mesh, cfg: LlamaConfig, optimizer=None):
 
     Returns ``(step_fn, init_fn)``; ``init_fn(rng)`` produces params and
     optimizer state already placed according to the mesh plan.
+
+    Memoized on ``(mesh, cfg, optimizer)``: a fresh ``jax.jit`` per
+    call is a new function object, so two sessions over the same mesh
+    plan and config would compile the identical step twice.  Equal-
+    valued meshes/configs hash equal; ``optimizer=None`` (the common
+    case) resolves to the default optimizer INSIDE the cached builder
+    so every default caller shares one entry.
     """
+    return _cached_sharded_train_step(mesh, cfg, optimizer)
+
+
+@lru_cache(maxsize=32)
+def _cached_sharded_train_step(mesh, cfg: LlamaConfig, optimizer):
     optimizer = optimizer or make_optimizer()
     p_shard = param_shardings(mesh)
     b_shard = batch_sharding(mesh)

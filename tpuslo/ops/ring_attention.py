@@ -15,7 +15,7 @@ The reference toolkit has no sequence parallelism at all (SURVEY.md
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -114,14 +114,22 @@ def ring_attention(q, k, v, axis_name: str, n_rep: int = 1):
 
 def ring_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = "sp"):
     """shard_map wrapper: q/k/v (B, S, H, D) sharded over ``axis_name``."""
+    return _ring_fn(mesh, axis_name)(q, k, v)
+
+
+@lru_cache(maxsize=16)
+def _ring_fn(mesh: Mesh, axis_name: str):
+    """Memoized shard_map wrapper — a per-call closure is a new
+    function object, so jax's dispatch cache would re-trace and
+    re-compile the ring on every call (equal-valued meshes hash equal,
+    so freshly-built meshes still hit)."""
     spec = P(None, axis_name, None, None)
-    fn = shard_map(
+    return shard_map(
         partial(ring_attention, axis_name=axis_name),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
     )
-    return fn(q, k, v)
 
 
 def reference_causal_attention(q, k, v):
