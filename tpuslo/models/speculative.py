@@ -24,13 +24,28 @@ speedup is ``(1 + a·k') / (cost_verify/cost_decode + k·cost_draft/...)``
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
 
-from tpuslo.models.llama import decode_chunk, decode_step, verify_chunk
-from tpuslo.models.serve import EOS, ServeEngine, encode_bytes
+from tpuslo.models.llama import decode_step, verify_chunk
+from tpuslo.models.serve import (
+    EOS,
+    ServeEngine,
+    _shared_decode_chunk_fn,
+    encode_bytes,
+)
+
+
+@lru_cache(maxsize=32)
+def _shared_verify_fn(cfg):
+    return jax.jit(partial(verify_chunk, cfg=cfg), donate_argnums=(2,))
+
+
+@lru_cache(maxsize=32)
+def _shared_decode_step_fn(cfg):
+    return jax.jit(partial(decode_step, cfg=cfg), donate_argnums=(2,))
 
 
 class SpeculativeEngine:
@@ -50,20 +65,13 @@ class SpeculativeEngine:
         # Donate the caches (as ServeEngine does): the previous cache
         # reference is dropped after every call, and un-donated decode
         # would copy both full (L, B, S_max, KV, HD) cache pairs per
-        # round.
-        self._verify = jax.jit(
-            partial(verify_chunk, cfg=target.cfg), donate_argnums=(2,)
-        )
-        self._draft_chunk = jax.jit(
-            partial(decode_chunk, cfg=draft.cfg, num_tokens=k),
-            donate_argnums=(2,),
-        )
-        self._draft_step = jax.jit(
-            partial(decode_step, cfg=draft.cfg), donate_argnums=(2,)
-        )
-        self._target_step = jax.jit(
-            partial(decode_step, cfg=target.cfg), donate_argnums=(2,)
-        )
+        # round.  All four kernels come from memoized builders (the
+        # serve.py shared-kernel discipline): a fresh jax.jit per
+        # engine would recompile for every engine over the same configs.
+        self._verify = _shared_verify_fn(target.cfg)
+        self._draft_chunk = _shared_decode_chunk_fn(draft.cfg, k)
+        self._draft_step = _shared_decode_step_fn(draft.cfg)
+        self._target_step = _shared_decode_step_fn(target.cfg)
         self.rounds = 0
         self.accepted_draft_tokens = 0
         self.emitted_tokens = 0
