@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from functools import lru_cache, partial
+
 from typing import Any
 
 import jax
@@ -35,14 +35,12 @@ from jax import lax
 
 from tpuslo.models.llama import (
     LlamaConfig,
-    decode_step,
     init_kv_cache,
     llama_tiny,
 )
 from tpuslo.models.serve import BOS, EOS
 
 PyTree = Any
-
 
 def _inject_row(cache: PyTree, row: PyTree, slot: jax.Array) -> PyTree:
     """Splice a single-row cache into ``slot`` of the batched cache.
@@ -62,16 +60,13 @@ def _inject_row(cache: PyTree, row: PyTree, slot: jax.Array) -> PyTree:
     lengths = cache["length"].at[slot].set(row["length"])
     return {"k": k, "v": v, "length": lengths}
 
-
 # Shared jitted kernels (see serve.py's shared-kernel note): one
 # compile cache per config across every engine instance.
 _SHARED_INJECT = jax.jit(_inject_row, donate_argnums=(0,))
 
-
-@lru_cache(maxsize=32)
-def _shared_batch_step_fn(cfg):
-    return jax.jit(partial(decode_step, cfg=cfg), donate_argnums=(2,))
-
+# decode_step's shared compile lives in serve.py so the speculative
+# engine and this one reuse a SINGLE cache for the same program.
+from tpuslo.models.serve import _shared_decode_step_fn as _shared_batch_step_fn  # noqa: E402,E501
 
 @dataclass
 class _Request:
@@ -92,7 +87,6 @@ class _Request:
     submitted_s: float | None = None
     admitted_s: float | None = None
     completed_s: float | None = None
-
 
 class ContinuousBatchingEngine:
     """Greedy continuous-batching server over one Llama model.

@@ -25,6 +25,7 @@ from tpuslo.models.llama import (
     LlamaConfig,
     SamplingConfig,
     decode_chunk,
+    decode_step,
     init_kv_cache,
     init_params,
     init_params_quantized,
@@ -202,6 +203,14 @@ def _shared_decode_chunk_fn(cfg, num_tokens: int):
 @lru_cache(maxsize=32)
 def _shared_suffix_prefill_fn(cfg):
     return jax.jit(partial(suffix_prefill, cfg=cfg), donate_argnums=(2,))
+
+
+@lru_cache(maxsize=32)
+def _shared_decode_step_fn(cfg):
+    """One decode_step compile per config — shared by the batching and
+    speculative engines (each had a byte-identical private builder,
+    which meant two compiles of the same program in one process)."""
+    return jax.jit(partial(decode_step, cfg=cfg), donate_argnums=(2,))
 
 
 @dataclass

@@ -29,11 +29,12 @@ from functools import lru_cache, partial
 import jax
 import jax.numpy as jnp
 
-from tpuslo.models.llama import decode_step, verify_chunk
+from tpuslo.models.llama import verify_chunk
 from tpuslo.models.serve import (
     EOS,
     ServeEngine,
     _shared_decode_chunk_fn,
+    _shared_decode_step_fn,
     encode_bytes,
 )
 
@@ -41,11 +42,6 @@ from tpuslo.models.serve import (
 @lru_cache(maxsize=32)
 def _shared_verify_fn(cfg):
     return jax.jit(partial(verify_chunk, cfg=cfg), donate_argnums=(2,))
-
-
-@lru_cache(maxsize=32)
-def _shared_decode_step_fn(cfg):
-    return jax.jit(partial(decode_step, cfg=cfg), donate_argnums=(2,))
 
 
 class SpeculativeEngine:
