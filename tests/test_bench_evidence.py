@@ -268,12 +268,46 @@ def test_speculative_measured_lane_trains_and_measures():
     counts keep CI cheap; the bench uses deeper recipes."""
     from tpuslo.benchmark.serving_bench import _speculative_measured_lane
 
+    # Cheap config pair: the target is the suite-wide llama_tiny (its
+    # serve/train compiles are shared with dozens of other tests); the
+    # draft is a 1-layer dim-32 config whose compiles are tiny.
+    from tpuslo.models.llama import LlamaConfig, llama_tiny
+
     lane = _speculative_measured_lane(
-        k=2, target_steps=6, draft_steps=6, n_tokens=6
+        k=2, target_steps=6, draft_steps=6, n_tokens=6,
+        target_cfg=llama_tiny(max_seq_len=256),
+        draft_cfg=LlamaConfig(
+            vocab_size=512, dim=32, n_layers=1, n_heads=2, n_kv_heads=1,
+            ffn_dim=64, max_seq_len=256, rope_theta=10000.0,
+        ),
     )
     assert lane["parity_ok"] is True
     assert 0.0 <= lane["acceptance_rate"] <= 1.0
     assert lane["measured_speedup"] > 0
     assert lane["target"]["loss_last"] < lane["target"]["loss_first"]
     assert lane["draft"]["loss_last"] < lane["draft"]["loss_first"]
-    assert lane["cost_ratio"] > 8
+    assert lane["cost_ratio"] > 2
+
+
+def test_speculative_measured_lane_default_configs_are_sound():
+    """The bench's default config pair stays constructible and keeps
+    the cost ratio speculation needs (the training itself is covered
+    by the injected-config lane test + the real bench)."""
+    import inspect
+
+    from tpuslo.benchmark.serving_bench import _speculative_measured_lane
+    from tpuslo.models.llama import param_count
+
+    src = inspect.getsource(_speculative_measured_lane)
+    # Reconstruct the defaults exactly as the lane builds them.
+    from tpuslo.models.llama import LlamaConfig, llama_tiny
+
+    target = LlamaConfig(
+        vocab_size=512, dim=192, n_layers=4, n_heads=8, n_kv_heads=4,
+        ffn_dim=384, max_seq_len=256, rope_theta=10000.0,
+    )
+    draft = llama_tiny(max_seq_len=256)
+    assert "dim=192" in src  # drift guard: lane default matches this test
+    assert target.dim % target.n_heads == 0
+    assert target.n_heads % target.n_kv_heads == 0
+    assert param_count(target) / param_count(draft) > 8

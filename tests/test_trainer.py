@@ -71,9 +71,12 @@ def test_multi_slice_plan_matches_single_slice_loss():
     from tpuslo.models.llama import llama_tiny
     from tpuslo.models.train import build_sharded_train_step
 
-    cfg = llama_tiny(max_seq_len=32)
+    # Same cfg + batch avals as __graft_entry__.dryrun_multichip's
+    # baseline and multi-slice cells: both plans' train-step compiles
+    # are shared through the memoized builder with the dryrun test.
+    cfg = llama_tiny(max_seq_len=64)
     rng = jax.random.PRNGKey(0)
-    tokens = jax.random.randint(rng, (4, 16), 0, cfg.vocab_size)
+    tokens = jax.random.randint(rng, (8, 32), 0, cfg.vocab_size)
     targets = jnp.roll(tokens, -1, axis=1)
 
     losses = []

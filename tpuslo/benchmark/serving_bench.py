@@ -592,6 +592,8 @@ def _speculative_measured_lane(
     target_steps: int = 100,
     draft_steps: int = 600,
     n_tokens: int = 48,
+    target_cfg=None,
+    draft_cfg=None,
 ) -> dict[str, Any]:
     """MEASURED speculative speedup on trained weights.
 
@@ -623,11 +625,11 @@ def _speculative_measured_lane(
         plan_for_devices,
     )
 
-    target_cfg = LlamaConfig(
+    target_cfg = target_cfg or LlamaConfig(
         vocab_size=512, dim=192, n_layers=4, n_heads=8, n_kv_heads=4,
         ffn_dim=384, max_seq_len=256, rope_theta=10000.0,
     )
-    draft_cfg = llama_tiny(max_seq_len=256)  # dim 64, 2 layers
+    draft_cfg = draft_cfg or llama_tiny(max_seq_len=256)  # dim 64, 2 layers
 
     # Predictable byte-level corpus: a handful of templates whose
     # completion is deterministic given a short prefix — the regime
