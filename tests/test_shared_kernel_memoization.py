@@ -1,0 +1,103 @@
+"""The shared-kernel discipline: equal keys -> IDENTICAL callables.
+
+Round 5 found that fresh closures per call (new function objects)
+silently defeat jax's dispatch cache — every call re-traced and
+re-compiled (sp serving recompiled the ring per prefill/step; the
+suite paid hundreds of seconds).  These tests lock the fix: the
+memoized builders must return the *same object* for equal-valued keys,
+including meshes built fresh from the same devices (Mesh hashes by
+value) and MoE mlp_fn hooks (a fresh lambda per call was the round's
+sneakiest cache-killer).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from tpuslo.models.llama import llama_tiny
+
+pytestmark = pytest.mark.slow  # builds touch jit machinery
+
+
+def _fresh_mesh(n: int = 2, axis: str = "sp") -> Mesh:
+    return Mesh(np.array(jax.devices()[:n]), (axis,))
+
+
+def test_sp_builders_share_across_fresh_equal_meshes():
+    from tpuslo.models.longserve import (
+        _sp_decode_fn,
+        _sp_generate_step,
+        _sp_prefill_fn,
+    )
+
+    cfg = llama_tiny(max_seq_len=256)
+    a, b = _fresh_mesh(), _fresh_mesh()
+    # jax interns Mesh instances (equal construction may return the
+    # SAME object); either way the builders must key by value.
+    assert a == b
+    assert _sp_prefill_fn(cfg, a, "sp", "bf16", None) is _sp_prefill_fn(
+        cfg, b, "sp", "bf16", None
+    )
+    assert _sp_decode_fn(cfg, a, "sp", None, False) is _sp_decode_fn(
+        cfg, b, "sp", None, False
+    )
+    assert _sp_generate_step(cfg, a, "sp", None) is _sp_generate_step(
+        cfg, b, "sp", None
+    )
+
+
+def test_ring_attention_builder_shares_across_fresh_meshes():
+    from tpuslo.ops.ring_attention import _ring_fn
+
+    assert _ring_fn(_fresh_mesh(), "sp") is _ring_fn(_fresh_mesh(), "sp")
+
+
+def test_train_step_builders_share_across_equal_keys():
+    from tpuslo.models.mixtral import build_moe_train_step, mixtral_tiny
+    from tpuslo.models.train import build_sharded_train_step
+    from tpuslo.parallel.mesh import MeshPlan, make_mesh
+
+    cfg = llama_tiny(max_seq_len=64)
+    step_a, init_a = build_sharded_train_step(
+        make_mesh(MeshPlan(dp=2, fsdp=2, tp=2)), cfg
+    )
+    step_b, init_b = build_sharded_train_step(
+        make_mesh(MeshPlan(dp=2, fsdp=2, tp=2)), cfg
+    )
+    assert step_a is step_b and init_a is init_b
+
+    mcfg = mixtral_tiny(max_seq_len=64)
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "ep"))
+    mesh2 = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "ep"))
+    ma, _ = build_moe_train_step(mesh, mcfg)
+    mb, _ = build_moe_train_step(mesh2, mcfg)
+    assert ma is mb
+
+
+def test_moe_serving_mlp_fn_is_identity_stable():
+    """The mlp_fn hook keys downstream jit caches by IDENTITY; a fresh
+    lambda per call recompiles the whole serving path."""
+    from tpuslo.models.mixtral import _serving_mlp_fn, mixtral_tiny
+
+    cfg = mixtral_tiny(max_seq_len=64)
+    assert _serving_mlp_fn(cfg) is _serving_mlp_fn(
+        mixtral_tiny(max_seq_len=64)
+    )
+
+
+def test_engine_shared_kernels_are_single_caches():
+    """decode_step's shared compile lives ONCE (serve.py): the batching
+    and speculative engines must resolve to the same builder."""
+    from tpuslo.models.batching import _shared_batch_step_fn
+    from tpuslo.models.serve import _shared_decode_step_fn
+    from tpuslo.models.speculative import (
+        _shared_decode_step_fn as spec_step_fn,
+    )
+
+    assert _shared_batch_step_fn is _shared_decode_step_fn
+    assert spec_step_fn is _shared_decode_step_fn
+    cfg = llama_tiny(max_seq_len=256)
+    assert _shared_decode_step_fn(cfg) is _shared_decode_step_fn(cfg)
