@@ -415,11 +415,14 @@ def _bench_serving_live() -> dict:
                 return fallback
             probe = retry_probe
 
-        # Chip is up: full bench gets the long budget (weights init +
-        # ~5 compiles on a 3B-class model, the int8 llama3-8b lane, and
-        # the round-3 kv/prefix lanes — two more engine warmups — all
-        # through the remote-compile tunnel).
-        result = _run_serving_subprocess(["--platform", "auto"], timeout_s=3000)
+        # Chip is up: full bench gets the long budget.  The r4 live
+        # capture took 2064 s; round 5 adds the measured-speculation,
+        # bandwidth, and prefix-decomposition lanes (~200 s on the
+        # tunnel) plus per-lane transient retries (a moe/int8 retry is
+        # a full re-init).  A timeout kill here loses the WHOLE capture
+        # (persist runs at subprocess end), so the budget carries real
+        # headroom.
+        result = _run_serving_subprocess(["--platform", "auto"], timeout_s=3600)
         if result.get("backend") in (None, "unavailable"):
             # The flash-attention pallas kernel is the newest lowering
             # risk on the tunneled backend; one retry without it
