@@ -311,3 +311,58 @@ def test_speculative_measured_lane_default_configs_are_sound():
     assert target.dim % target.n_heads == 0
     assert target.n_heads % target.n_kv_heads == 0
     assert param_count(target) / param_count(draft) > 8
+
+
+def test_checkpoint_sidecar_never_clobbers_main(tmp_path, monkeypatch):
+    """Progressive persistence semantics: the mid-run checkpoint lives
+    in a SIDECAR; a newer surviving sidecar wins at load time (fresh
+    partial beats stale complete) but the main artifact's complete
+    lanes are never physically overwritten by a partial."""
+    import time as _time
+
+    from tpuslo.benchmark import serving_bench as sb
+
+    main_path = str(tmp_path / "latest.json")
+    side_path = main_path + ".checkpoint"
+    monkeypatch.setattr(sb, "LATEST_CAPTURE_PATH", main_path)
+    monkeypatch.setattr(sb, "CHECKPOINT_CAPTURE_PATH", side_path)
+
+    complete = _complete_capture()
+    complete["moe"] = {"decode_tokens_per_sec": 100.0}
+    assert persist_tpu_capture(complete, path=main_path)
+
+    _time.sleep(1.1)  # captured_at has second resolution
+    checkpoint = _complete_capture(ttft_ms=50.0)
+    checkpoint["partial"] = "checkpoint before the moe/int8 lanes"
+    assert persist_tpu_capture(checkpoint, path=side_path)
+
+    # Newer sidecar wins, marker intact; main artifact untouched.
+    loaded = sb.load_last_tpu_capture()
+    assert loaded["capture"]["partial"]
+    assert loaded["capture"]["ttft_ms"] == 50.0
+    on_disk = sb.load_last_tpu_capture(path=main_path)
+    assert on_disk["capture"]["moe"]["decode_tokens_per_sec"] == 100.0
+
+    # A later COMPLETE run supersedes: final persisted + sidecar gone.
+    _time.sleep(1.1)
+    final = _complete_capture(ttft_ms=60.0)
+    assert persist_tpu_capture(final, path=main_path)
+    os.unlink(side_path)
+    loaded = sb.load_last_tpu_capture()
+    assert "partial" not in loaded["capture"]
+    assert loaded["capture"]["ttft_ms"] == 60.0
+
+
+def test_digest_carries_partial_marker():
+    """bench.py's compact line must never present a checkpoint as a
+    complete capture."""
+    import bench
+
+    artifact = {
+        "provenance": {"captured_at": "2026-07-31", "git_sha": "abc"},
+        "capture": _complete_capture(
+            partial="checkpoint before the moe/int8 lanes"
+        ),
+    }
+    digest = bench._digest_tpu_evidence(artifact)
+    assert "partial" in digest

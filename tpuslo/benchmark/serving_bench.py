@@ -1278,7 +1278,11 @@ def _xla_launch_join(engine, prompt: str, node: str) -> dict[str, Any]:
         return out
 
 
-def run(platform: str = "auto", model: str = "auto") -> dict[str, Any]:
+def run(
+    platform: str = "auto",
+    model: str = "auto",
+    checkpoint_persist: bool = False,
+) -> dict[str, Any]:
     t_bench = time.perf_counter()
     if platform == "cpu":
         # Same ordering as tests/conftest.py: force the platform BEFORE
@@ -1480,6 +1484,32 @@ def run(platform: str = "auto", model: str = "auto") -> dict[str, Any]:
 
     # --- MoE + int8 lanes ----------------------------------------------
     if dev.platform != "cpu":
+        if checkpoint_persist:
+            # Progressive persistence to a SIDECAR (never the main
+            # artifact — a partial must not clobber the last COMPLETE
+            # capture's moe/int8 evidence): the heaviest lanes are
+            # still ahead (MoE + int8-8B re-inits — exactly where the
+            # r4 tunnel flap hit), and a mid-lane death should cost
+            # those lanes, not the whole capture.  A clean finish
+            # removes the sidecar; loaders prefer a surviving sidecar
+            # only when it is NEWER than the main artifact.  Note the
+            # checkpoint can itself be refused (e.g. the xprof lane
+            # errored and xprof_launch_spans is missing) — say so.
+            partial = dict(out)
+            partial["elapsed_s"] = round(time.perf_counter() - t_bench, 1)
+            partial["partial"] = (
+                "checkpoint before the moe/int8 lanes (process died "
+                "before the final persist if this marker survives)"
+            )
+            if persist_tpu_capture(partial, path=CHECKPOINT_CAPTURE_PATH):
+                print("serving_bench: checkpoint persisted", file=sys.stderr)
+            else:
+                print(
+                    "serving_bench: checkpoint REFUSED (incomplete "
+                    "fields — a death in the remaining lanes loses the "
+                    "capture)",
+                    file=sys.stderr,
+                )
         # Drop the bf16 lane's device buffers first (weights 7.2 GB +
         # ~1 GB batch-8 KV on the 3B config) — both remaining lanes
         # need the chip's headroom.
@@ -1629,6 +1659,10 @@ def _default_capture_path() -> str:
 
 
 LATEST_CAPTURE_PATH = _default_capture_path()
+# Sidecar for the mid-run checkpoint: never clobbers the main artifact
+# (a partial capture must not replace a complete one); a clean run
+# deletes it, and loaders prefer it only when NEWER than the main.
+CHECKPOINT_CAPTURE_PATH = LATEST_CAPTURE_PATH + ".checkpoint"
 
 # A capture must carry the full evidence set before it may replace the
 # committed artifact: the artifact's whole job is to present complete
@@ -1690,9 +1724,7 @@ def persist_tpu_capture(result: dict[str, Any], path: str | None = None) -> bool
         return False
 
 
-def load_last_tpu_capture(path: str | None = None) -> dict[str, Any] | None:
-    """Read the persisted capture artifact (or None if absent/corrupt)."""
-    path = path or LATEST_CAPTURE_PATH
+def _read_capture(path: str) -> dict[str, Any] | None:
     try:
         with open(path) as fh:
             artifact = json.load(fh)
@@ -1701,6 +1733,27 @@ def load_last_tpu_capture(path: str | None = None) -> dict[str, Any] | None:
     if not isinstance(artifact, dict) or "capture" not in artifact:
         return None
     return artifact
+
+
+def load_last_tpu_capture(path: str | None = None) -> dict[str, Any] | None:
+    """Read the persisted capture artifact (or None if absent/corrupt).
+
+    When a mid-run checkpoint sidecar survived (the producing run died
+    in its tail lanes) and is NEWER than the main artifact, it wins —
+    fresh-at-HEAD partial evidence beats stale complete evidence, and
+    its ``capture.partial`` marker keeps the status visible downstream.
+    """
+    if path is not None:
+        return _read_capture(path)
+    main_artifact = _read_capture(LATEST_CAPTURE_PATH)
+    sidecar = _read_capture(CHECKPOINT_CAPTURE_PATH)
+    if sidecar is None:
+        return main_artifact
+    if main_artifact is None:
+        return sidecar
+    main_at = (main_artifact.get("provenance") or {}).get("captured_at", "")
+    side_at = (sidecar.get("provenance") or {}).get("captured_at", "")
+    return sidecar if side_at > main_at else main_artifact
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -1717,11 +1770,19 @@ def main(argv: list[str] | None = None) -> int:
         "on a successful TPU capture",
     )
     args = parser.parse_args(argv)
-    result = run(platform=args.platform, model=args.model)
+    result = run(
+        platform=args.platform, model=args.model,
+        checkpoint_persist=not args.no_persist,
+    )
     if not args.no_persist and persist_tpu_capture(result):
         result["persisted_to"] = os.path.relpath(
             LATEST_CAPTURE_PATH, os.getcwd()
         )
+        # The run completed: the mid-run checkpoint is superseded.
+        try:
+            os.unlink(CHECKPOINT_CAPTURE_PATH)
+        except OSError:
+            pass
     print("SERVING_BENCH:" + json.dumps(result))
     return 0
 
