@@ -35,10 +35,11 @@ test: native
 # dedup in the dryrun test, memoized shard_map/jit builders, jitted
 # test decode loops, shared compile keys across heavy tests) reversed
 # the curve: measured clean 1294 s @ 715 tests (r4: 1435 s @ 699).
-# Budget = measured + ~8% noise margin on a 1-CPU box; ratchets DOWN
-# as more sharing lands (target: 1000).  Override for slow runners:
+# Budget = measured + noise margin on a 1-CPU box (+~100 s for the
+# late-round on-chip-session rehearsal guard); ratchets DOWN as more
+# sharing lands (target: 1000).  Override for slow runners:
 #   make test-timed TEST_BUDGET_S=1800
-TEST_BUDGET_S ?= 1400
+TEST_BUDGET_S ?= 1450
 test-timed: native
 	@start=$$(date +%s); \
 	$(PY) -m pytest tests/ -q || exit 1; \

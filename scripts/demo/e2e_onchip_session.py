@@ -153,7 +153,10 @@ def main() -> int:
     # at the writer's HEAD, and every event is written after this
     # point.  400 cycles ≈ 2.5 min of consumption — ~5x the expected
     # serve+storm window on the chip.
-    agent_cycles = 400
+    # Rehearsal storms take seconds (tiny model, local CPU), so the
+    # consumption window shrinks with them — otherwise the run spends
+    # minutes watching the agent idle out its cycle budget.
+    agent_cycles = 90 if args.rehearse else 400
     agent_jsonl = out / "agent_onchip.jsonl"
     agent = _spawn_agent(ring_path, agent_jsonl, count=agent_cycles)
     time.sleep(2.0)
