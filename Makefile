@@ -7,7 +7,7 @@ ARTIFACTS ?= artifacts
 
 .PHONY: all test test-fast native ebpf lint schema-validate \
 	correlation-gate fault-smoke replay-smoke ebpf-smoke bench \
-	m5-candidate m5-gate helm-lint dashboards clean
+	bench-smoke m5-candidate m5-gate helm-lint dashboards clean
 
 all: native test
 
@@ -93,6 +93,12 @@ ebpf-smoke:
 
 bench:
 	$(PY) bench.py
+
+# Seconds-scale spine check: bench_pipeline on a small sample count,
+# asserting nonzero throughput and that the fast-path validator (not
+# per-event jsonschema) is actually engaged.
+bench-smoke:
+	$(PY) -m pytest tests/test_bench_smoke.py -q
 
 # Build the m5 candidate tree: 7 scenarios x 3 reruns of benchmark
 # bundles (reference Makefile m5-candidate-rebuild).

@@ -1,0 +1,222 @@
+"""``match_batch`` parity with the pairwise ``match`` across all tiers.
+
+The batched matcher answers each span with per-tier hash indexes and
+timestamp-sorted window probes; the pairwise matcher is the semantic
+ground truth.  Parity contract: for every span, ``match_batch`` returns
+the highest-confidence pairwise decision over all signals, keeping the
+first (lowest-index) signal on ties — including the inclusive /
+exclusive window edges at 100, 250 and 500 ms and the global window.
+"""
+
+import random
+from datetime import datetime, timedelta, timezone
+
+from tpuslo.correlation.matcher import (
+    Decision,
+    SignalRef,
+    SpanRef,
+    match,
+    match_batch,
+)
+
+TS = datetime(2026, 7, 29, 12, 0, 0, tzinfo=timezone.utc)
+
+
+def span(**kw) -> SpanRef:
+    kw.setdefault("timestamp", TS)
+    return SpanRef(**kw)
+
+
+def sigref(offset_ms=0.0, **kw) -> SignalRef:
+    kw.setdefault("signal", "dns_latency_ms")
+    kw.setdefault("timestamp", TS + timedelta(milliseconds=offset_ms))
+    return SignalRef(**kw)
+
+
+def best_pairwise(
+    s: SpanRef, sigs: list[SignalRef], window_ms: int = 0
+) -> tuple[int, Decision]:
+    """Reference semantics: first strict-maximum pairwise decision."""
+    best, best_i = Decision(), -1
+    for i, candidate in enumerate(sigs):
+        d = match(s, candidate, window_ms)
+        if d.matched and d.confidence > best.confidence:
+            best, best_i = d, i
+    return best_i, best
+
+
+def assert_parity(spans, sigs, window_ms=0):
+    results = match_batch(spans, sigs, window_ms)
+    assert len(results) == len(spans)
+    for i, result in enumerate(results):
+        expect_i, expect = best_pairwise(spans[i], sigs, window_ms)
+        assert result.span_index == i
+        assert result.signal_index == expect_i, (
+            i, result, expect_i, expect
+        )
+        assert result.decision == expect, (i, result.decision, expect)
+
+
+class TestTierParity:
+    def test_each_tier_individually(self):
+        cases = [
+            (span(trace_id="t1"), sigref(trace_id="t1", offset_ms=1500)),
+            (
+                span(program_id="jit_step", launch_id=42),
+                sigref(program_id="jit_step", launch_id=42, offset_ms=200),
+            ),
+            (span(pod="p", pid=11), sigref(pod="p", pid=11, offset_ms=90)),
+            (
+                span(pod="p", conn_tuple="tcp:a->b"),
+                sigref(pod="p", conn_tuple="tcp:a->b", offset_ms=200),
+            ),
+            (
+                span(slice_id="s0", host_index=1),
+                sigref(slice_id="s0", host_index=1, offset_ms=240),
+            ),
+            (
+                span(service="svc", node="n0"),
+                sigref(service="svc", node="n0", offset_ms=400),
+            ),
+        ]
+        for sp, sg in cases:
+            assert_parity([sp], [sg])
+        # All spans against all signals at once.
+        assert_parity([c[0] for c in cases], [c[1] for c in cases])
+
+    def test_window_edges_inclusive_and_exclusive(self):
+        # Each tier window edge, exactly on it and 1ms past it, on both
+        # sides of the span timestamp.
+        tier_spans = {
+            100: span(pod="p", pid=11),
+            250: span(pod="p", conn_tuple="c"),
+            500: span(service="svc", node="n0"),
+            2000: span(trace_id="t"),
+        }
+        tier_signal = {
+            100: dict(pod="p", pid=11),
+            250: dict(pod="p", conn_tuple="c"),
+            500: dict(service="svc", node="n0"),
+            2000: dict(trace_id="t"),
+        }
+        for edge, sp in tier_spans.items():
+            sigs = [
+                sigref(offset_ms=sign * (edge + delta), **tier_signal[edge])
+                for sign in (1, -1)
+                for delta in (0, 1, -1)
+            ]
+            assert_parity([sp], sigs)
+            for sig in sigs:
+                assert_parity([sp], [sig])
+
+    def test_xla_launch_250ms_edge(self):
+        sp = span(program_id="jit", launch_id=5)
+        sigs = [
+            sigref(program_id="jit", launch_id=5, offset_ms=offset)
+            for offset in (249, 250, 251, -250, -251)
+        ]
+        assert_parity([sp], sigs)
+
+    def test_slice_host_250ms_edge(self):
+        sp = span(slice_id="s", host_index=0)
+        sigs = [
+            sigref(slice_id="s", host_index=0, offset_ms=offset)
+            for offset in (250, 251, -250, -251)
+        ]
+        assert_parity([sp], sigs)
+
+    def test_custom_window_truncates_tier_windows(self):
+        # A global window below a tier window clips that tier (the
+        # pairwise matcher checks the global window first).
+        sp = span(pod="p", conn_tuple="c", trace_id="t")
+        sigs = [
+            sigref(pod="p", conn_tuple="c", offset_ms=200),
+            sigref(trace_id="t", offset_ms=180),
+            sigref(trace_id="t", offset_ms=120),
+        ]
+        for window_ms in (50, 150, 190, 250, 2000):
+            assert_parity([sp], sigs, window_ms)
+
+    def test_tie_keeps_first_signal(self):
+        sp = span(pod="p", pid=3)
+        sigs = [
+            sigref(pod="p", pid=3, offset_ms=80),
+            sigref(pod="p", pid=3, offset_ms=10),  # closer but later index
+        ]
+        results = match_batch([sp], sigs)
+        assert results[0].signal_index == 0
+        assert_parity([sp], sigs)
+
+    def test_higher_tier_on_later_signal_wins(self):
+        sp = span(pod="p", pid=3, trace_id="t")
+        sigs = [
+            sigref(pod="p", pid=3, offset_ms=10),
+            sigref(trace_id="t", offset_ms=1900),
+        ]
+        results = match_batch([sp], sigs)
+        assert results[0].signal_index == 1
+        assert results[0].decision.tier == "trace_id_exact"
+        assert_parity([sp], sigs)
+
+    def test_missing_timestamps_and_empty_inputs(self):
+        assert match_batch([], []) == []
+        no_ts_span = SpanRef(trace_id="t")
+        no_ts_sig = SignalRef(trace_id="t")
+        assert_parity([no_ts_span], [sigref(trace_id="t")])
+        assert_parity([span(trace_id="t")], [no_ts_sig])
+        results = match_batch([span(trace_id="t")], [no_ts_sig])
+        assert results[0].signal_index == -1
+
+    def test_empty_identity_never_joins(self):
+        # Empty strings / sentinel ints must not form index keys that
+        # join with other empties (pairwise requires truthy span fields).
+        assert_parity(
+            [span(), span(pod="p"), span(pid=5), span(launch_id=0)],
+            [sigref(), sigref(pod="p"), sigref(pid=5), sigref(launch_id=0)],
+        )
+
+
+class TestPropertyParity:
+    def test_randomized_corpus(self):
+        rng = random.Random(20260803)
+        pods = ["", "pod-a", "pod-b"]
+        traces = ["", "t1", "t2"]
+        programs = ["", "jit_step"]
+        services = ["", "rag"]
+        nodes = ["", "n0", "n1"]
+        conns = ["", "tcp:a->b"]
+        slices = ["", "s0"]
+        # Offsets clustered on the tier edges where parity is hardest.
+        edges = [0, 1, 50, 99, 100, 101, 249, 250, 251, 499, 500, 501,
+                 1999, 2000, 2001]
+
+        def random_fields():
+            return dict(
+                trace_id=rng.choice(traces),
+                pod=rng.choice(pods),
+                pid=rng.choice([0, 1, 2]),
+                conn_tuple=rng.choice(conns),
+                slice_id=rng.choice(slices),
+                host_index=rng.choice([-1, 0, 1]),
+                program_id=rng.choice(programs),
+                launch_id=rng.choice([-1, 0, 7]),
+                service=rng.choice(services),
+                node=rng.choice(nodes),
+            )
+
+        spans = [
+            span(
+                timestamp=TS + timedelta(milliseconds=rng.choice(edges)),
+                **random_fields(),
+            )
+            for _ in range(60)
+        ]
+        sigs = [
+            sigref(
+                offset_ms=rng.choice([1, -1]) * rng.choice(edges),
+                **random_fields(),
+            )
+            for _ in range(120)
+        ]
+        for window_ms in (0, 120, 300, 5000):
+            assert_parity(spans, sigs, window_ms)

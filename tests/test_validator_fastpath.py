@@ -1,0 +1,191 @@
+"""Fast-path validator parity: structural checks == full jsonschema.
+
+The probe spine validates with a hand-rolled structural fast path and
+falls back to the precompiled jsonschema validator on anything it cannot
+prove valid (tpuslo/schema/fastpath.py).  These tests lock in the
+contract on a corpus of valid and malformed events:
+
+* combined fast+fallback result is exactly jsonschema's verdict, and
+* the fast path alone never accepts a payload jsonschema rejects
+  (false positives would ship contract-breaking events).
+"""
+
+from datetime import datetime, timezone
+
+from tpuslo import collector, signals
+from tpuslo.schema import (
+    SCHEMA_PROBE_EVENT,
+    VALIDATION_COUNTERS,
+    ConnTuple,
+    ProbeEventV1,
+    TPURef,
+    fast_probe_event_valid,
+    fast_probe_payload_valid,
+    is_valid,
+    validate_probe_event,
+    validate_probe_payload,
+)
+
+
+def _event(**overrides) -> ProbeEventV1:
+    base = dict(
+        ts_unix_nano=1_700_000_000_000_000_000,
+        signal="dns_latency_ms",
+        node="node-0",
+        namespace="llm",
+        pod="rag-0",
+        container="rag",
+        pid=41,
+        tid=42,
+        value=12.5,
+        unit="ms",
+        status="ok",
+    )
+    base.update(overrides)
+    return ProbeEventV1(**base)
+
+
+def _generated_corpus() -> list[ProbeEventV1]:
+    """Real generator output across every fault scenario: conn tuples,
+    errno-carrying connect signals, and TPU identity blocks included."""
+    meta = signals.Metadata(
+        node="n0", namespace="llm", pod="p0", container="c0",
+        pid=7, tid=8, tpu_chip="accel0", slice_id="slice-0",
+        xla_program_id="jit_step",
+    )
+    gen = signals.Generator(signals.CAPABILITY_TPU_FULL)
+    start = datetime(2026, 1, 1, tzinfo=timezone.utc)
+    events: list[ProbeEventV1] = []
+    for scenario in ("baseline", "tpu_mixed", "network_partition"):
+        samples = collector.generate_synthetic_samples(
+            scenario, 4, start, collector.SampleMeta()
+        )
+        events.extend(gen.generate_batch(samples, meta))
+    return events
+
+
+_MALFORMED_EVENTS = [
+    _event(status="bogus"),
+    _event(status=""),
+    _event(ts_unix_nano=-1),
+    _event(ts_unix_nano=True),
+    _event(pid=-1),
+    _event(tid=-2),
+    _event(value="12.5"),
+    _event(value=None),
+    _event(signal=123),
+    _event(unit=None),
+    _event(errno="ECONNREFUSED"),
+    _event(errno=True),
+    _event(confidence=1.5),
+    _event(confidence=-0.1),
+    _event(confidence="high"),
+    # Malformed conn_tuple blocks.
+    _event(conn_tuple=ConnTuple("a", "b", -1, 443, "tcp")),
+    _event(conn_tuple=ConnTuple("a", "b", 70000, 443, "tcp")),
+    _event(conn_tuple=ConnTuple("a", "b", 1, 65536, "tcp")),
+    _event(conn_tuple=ConnTuple(1, "b", 10, 443, "tcp")),
+    _event(conn_tuple=ConnTuple("a", "b", "10", 443, "tcp")),
+    _event(conn_tuple=ConnTuple("a", "b", 10, 443, None)),
+]
+
+_VALID_EVENTS = [
+    _event(),
+    _event(trace_id="t" * 32, span_id="s" * 16),
+    _event(errno=111, conn_tuple=ConnTuple("10.0.0.1", "10.0.0.2", 1, 65535, "tcp")),
+    _event(confidence=0.0),
+    _event(confidence=1.0),
+    _event(value=0),
+    _event(tpu=TPURef()),
+    _event(tpu=TPURef(chip="accel0", launch_id=0, host_index=0, ici_link=0)),
+    # Negative TPU ints are omitted by to_dict, so they stay valid.
+    _event(tpu=TPURef(chip="accel1", launch_id=-1, host_index=-5)),
+    # A TPU signal with NO tpu block: the schema keeps the block
+    # optional, so both paths must accept it.
+    _event(signal="xla_compile_ms"),
+]
+
+
+class TestObjectParity:
+    def test_generated_corpus_all_fastpath(self):
+        for event in _generated_corpus():
+            assert fast_probe_event_valid(event), event
+            assert is_valid(event.to_dict(), SCHEMA_PROBE_EVENT), event
+
+    def test_valid_corpus_parity(self):
+        for event in _VALID_EVENTS:
+            assert validate_probe_event(event) is True, event
+            assert is_valid(event.to_dict(), SCHEMA_PROBE_EVENT), event
+
+    def test_malformed_corpus_parity(self):
+        for event in _MALFORMED_EVENTS:
+            expected = is_valid(event.to_dict(), SCHEMA_PROBE_EVENT)
+            assert validate_probe_event(event) is expected, event
+            # No false positives: the fast path may only say True when
+            # jsonschema agrees.
+            if fast_probe_event_valid(event):
+                assert expected, event
+
+    def test_malformed_corpus_actually_malformed(self):
+        # Guard the corpus itself: every entry must be a jsonschema
+        # reject, or the parity assertions above prove nothing.
+        for event in _MALFORMED_EVENTS:
+            assert not is_valid(event.to_dict(), SCHEMA_PROBE_EVENT), event
+
+
+class TestPayloadParity:
+    def _payloads(self) -> list:
+        payloads = [e.to_dict() for e in _generated_corpus() + _VALID_EVENTS]
+        base = _event().to_dict()
+        # Structural damage jsonschema must catch: missing required
+        # keys, unknown keys, and sub-object violations.
+        for key in base:
+            broken = dict(base)
+            del broken[key]
+            payloads.append(broken)
+        payloads.append({**base, "surprise": 1})
+        payloads.append({**base, "conn_tuple": {}})
+        payloads.append(
+            {**base, "conn_tuple": {"src_ip": "a", "dst_ip": "b"}}
+        )
+        conn = ConnTuple("a", "b", 1, 2, "tcp").to_dict()
+        payloads.append({**base, "conn_tuple": {**conn, "extra": 1}})
+        payloads.append({**base, "conn_tuple": {**conn, "src_port": "1"}})
+        payloads.append({**base, "tpu": {"chip": 5}})
+        payloads.append({**base, "tpu": {"launch_id": -1}})
+        payloads.append({**base, "tpu": {"host_index": True}})
+        payloads.append({**base, "tpu": {"unknown": "x"}})
+        payloads.append({**base, "errno": 1.5})
+        payloads.append({**base, "pid": True})
+        payloads.append({**base, "value": True})
+        payloads.append({**base, "status": "breach"})
+        payloads.append({**base, "tpu": {}})  # valid: all keys optional
+        return payloads
+
+    def test_payload_corpus_parity(self):
+        for payload in self._payloads():
+            expected = is_valid(payload, SCHEMA_PROBE_EVENT)
+            assert validate_probe_payload(payload) is expected, payload
+            if fast_probe_payload_valid(payload):
+                assert expected, payload
+
+
+class TestCounters:
+    def test_fastpath_and_fallback_counted(self):
+        VALIDATION_COUNTERS.reset()
+        assert not VALIDATION_COUNTERS.engaged
+        assert validate_probe_event(_event())
+        assert VALIDATION_COUNTERS.engaged
+        assert VALIDATION_COUNTERS.fastpath_valid == 1
+
+        assert not validate_probe_event(_event(status="bogus"))
+        snap = VALIDATION_COUNTERS.snapshot()
+        assert snap["fastpath_fallback"] == 1
+        assert snap["slowpath_invalid"] == 1
+
+        # A jsonschema-valid shape the fast path cannot prove (float
+        # with integral value is a jsonschema "integer").
+        assert validate_probe_event(_event(pid=1.0))
+        snap = VALIDATION_COUNTERS.snapshot()
+        assert snap["fastpath_fallback"] == 2
+        assert snap["slowpath_valid"] == 1

@@ -10,12 +10,12 @@ from typing import IO, Any
 from tpuslo.config import ToolkitConfig, default_config, load_config
 from tpuslo.otel.exporters import ProbeEventExporter, SLOEventExporter
 from tpuslo.schema import (
-    SCHEMA_PROBE_EVENT,
     SCHEMA_SLO_EVENT,
     ProbeEventV1,
     SLOEvent,
     SchemaValidationError,
     validate,
+    validate_probe_event,
 )
 
 OUTPUT_STDOUT = "stdout"
@@ -56,25 +56,40 @@ class EventWriters:
             raise ValueError(f"unsupported output {output!r}")
 
     def _write_line(self, payload: dict[str, Any]) -> None:
-        line = json.dumps(payload, separators=(",", ":"))
+        self._write_batch([payload])
+
+    def _write_batch(self, payloads: list[dict[str, Any]]) -> None:
+        """Serialize once per payload, then one buffered write + flush.
+
+        Per-event write/flush under the lock was the export-side
+        bottleneck on the probe spine; a flush per *batch* keeps the
+        durability contract at the emit-cycle granularity the agent
+        actually operates at.
+        """
+        if not payloads:
+            return
+        dumps = json.dumps
+        block = "".join(
+            dumps(payload, separators=(",", ":")) + "\n" for payload in payloads
+        )
         with self._lock:
             sink = self._jsonl if self._jsonl is not None else self._stream
-            sink.write(line + "\n")
+            sink.write(block)
             sink.flush()
 
     def emit_slo(self, events: list[SLOEvent]) -> None:
         if self._slo_exporter is not None:
             self._slo_exporter.export_batch(events)
             return
-        for event in events:
-            self._write_line({"kind": "slo", **event.to_dict()})
+        self._write_batch([{"kind": "slo", **event.to_dict()} for event in events])
 
     def emit_probe(self, events: list[ProbeEventV1]) -> None:
         if self._probe_exporter is not None:
             self._probe_exporter.export_batch(events)
             return
-        for event in events:
-            self._write_line({"kind": "probe", **event.to_dict()})
+        self._write_batch(
+            [{"kind": "probe", **event.to_dict()} for event in events]
+        )
 
     def close(self) -> None:
         if self._jsonl is not None:
@@ -97,8 +112,7 @@ def validate_slo(event: SLOEvent) -> bool:
 
 
 def validate_probe(event: ProbeEventV1) -> bool:
-    try:
-        validate(event.to_dict(), SCHEMA_PROBE_EVENT)
-        return True
-    except SchemaValidationError:
-        return False
+    # Structural fast path on the known ProbeEventV1 shape; precompiled
+    # jsonschema fallback keeps the answer exactly contract-equal (see
+    # tpuslo/schema/fastpath.py and tests/test_validator_fastpath.py).
+    return validate_probe_event(event)

@@ -157,7 +157,7 @@ def main(argv: list[str] | None = None) -> int:
 def _run_multiprocess(args, ops) -> int:
     """Cross-process collective probe; same output contract as the
     single-process path (schema-validated probe-event JSONL)."""
-    from tpuslo.schema import SCHEMA_PROBE_EVENT, SchemaValidationError, validate
+    from tpuslo.schema import validate_probe_payload
 
     if args.n_slices > 1 and args.multiprocess % args.n_slices:
         print(
@@ -196,9 +196,10 @@ def _run_multiprocess(args, ops) -> int:
     )
     lines = []
     for event_dict in report["events"]:
-        try:
-            validate(event_dict, SCHEMA_PROBE_EVENT)
-        except SchemaValidationError:
+        # Dict-level hot-path validation (structural fast path with a
+        # jsonschema fallback): high-rep probe runs emit thousands of
+        # events per report.
+        if not validate_probe_payload(event_dict):
             print(
                 "icibench: schema-invalid cross-process event; "
                 "no output written",

@@ -19,9 +19,10 @@ The TPU-native build inserts two tiers:
 
 from __future__ import annotations
 
+from bisect import bisect_left, bisect_right
 from dataclasses import dataclass
 from datetime import datetime, timedelta
-from typing import Any
+from typing import Any, Callable, Sequence
 
 from tpuslo import semconv
 from tpuslo.schema import parse_rfc3339
@@ -52,7 +53,7 @@ def _ts(raw: Any) -> datetime | None:
     return raw
 
 
-@dataclass
+@dataclass(slots=True)
 class SpanRef:
     """Minimal span metadata used for correlation."""
 
@@ -86,7 +87,7 @@ class SpanRef:
         )
 
 
-@dataclass
+@dataclass(slots=True)
 class SignalRef:
     """Normalized signal metadata for correlation."""
 
@@ -123,7 +124,7 @@ class SignalRef:
         )
 
 
-@dataclass
+@dataclass(slots=True)
 class Decision:
     """One correlation result."""
 
@@ -205,17 +206,192 @@ def enrich_dns(
     """Apply DNS attributes when confidence passes the threshold.
 
     Reference: ``pkg/correlation/dns.go:79-105``.
+
+    Every path copies the caller's mapping exactly once (the returned
+    dict is always safe to mutate; the input is never touched).
     """
-    base = dict(base or {})
     threshold = threshold if threshold > 0 else DEFAULT_ENRICHMENT_THRESHOLD
 
     decision = match(span, signal, window_ms)
     if not decision.matched or decision.confidence < threshold:
-        return base, decision
+        return dict(base or {}), decision
     if signal.signal != "dns_latency_ms":
-        return base, Decision()
+        return dict(base or {}), Decision()
 
-    out = dict(base)
+    out = dict(base or {})
     out[semconv.ATTR_DNS_LATENCY_MS] = signal.value
     out[semconv.ATTR_CORRELATION_CONF] = decision.confidence
     return out, decision
+
+
+# --- batched correlation -------------------------------------------------
+#
+# The pairwise loop is O(spans x signals) with a timedelta allocation per
+# probe; at agent batch sizes (hundreds of spans x thousands of signals)
+# it dominates the correlation stage.  ``match_batch`` builds one hash
+# index per tier over the signal set (exact join key -> timestamp-sorted
+# postings) and answers each span with bisect window probes: O(n + m)
+# index build plus O(log m + k) per span per tier.
+#
+# Timestamps are reduced to integer microseconds relative to a per-batch
+# reference so window-edge comparisons are exact (floats at epoch
+# magnitude cannot represent every microsecond, and the 100/250/500 ms
+# tier edges are inclusive).  tests/test_match_batch.py proves parity
+# with the pairwise ``match`` across all six tiers and window edges.
+
+_US = timedelta(microseconds=1)
+
+# (tier, tier window in ms or None for the global window only,
+#  span join key, signal join key).  Order = descending confidence, which
+# makes "first tier with any candidate" equal to the pairwise maximum:
+# if a higher tier had a candidate for this span, no lower-tier posting
+# can out-score it, and within the winning tier every in-window posting
+# has exactly that pairwise tier (higher-tier keys for this span came up
+# empty).
+_TIER_SPECS: tuple[
+    tuple[
+        str,
+        int | None,
+        Callable[[SpanRef], Any],
+        Callable[[SignalRef], Any],
+    ],
+    ...,
+] = (
+    (
+        TIER_TRACE_ID,
+        None,
+        lambda s: s.trace_id if s.trace_id else None,
+        lambda s: s.trace_id if s.trace_id else None,
+    ),
+    (
+        TIER_XLA_LAUNCH,
+        250,
+        lambda s: (s.program_id, s.launch_id)
+        if s.program_id and s.launch_id >= 0
+        else None,
+        lambda s: (s.program_id, s.launch_id)
+        if s.program_id and s.launch_id >= 0
+        else None,
+    ),
+    (
+        TIER_POD_PID,
+        100,
+        lambda s: (s.pod, s.pid) if s.pod and s.pid > 0 else None,
+        lambda s: (s.pod, s.pid) if s.pod and s.pid > 0 else None,
+    ),
+    (
+        TIER_POD_CONN,
+        250,
+        lambda s: (s.pod, s.conn_tuple) if s.pod and s.conn_tuple else None,
+        lambda s: (s.pod, s.conn_tuple) if s.pod and s.conn_tuple else None,
+    ),
+    (
+        TIER_SLICE_HOST,
+        250,
+        lambda s: (s.slice_id, s.host_index)
+        if s.slice_id and s.host_index >= 0
+        else None,
+        lambda s: (s.slice_id, s.host_index)
+        if s.slice_id and s.host_index >= 0
+        else None,
+    ),
+    (
+        TIER_SERVICE_NODE,
+        500,
+        lambda s: (s.service, s.node) if s.service and s.node else None,
+        lambda s: (s.service, s.node) if s.service and s.node else None,
+    ),
+)
+
+
+@dataclass(slots=True)
+class BatchMatch:
+    """Best correlation for one span out of a signal batch.
+
+    ``signal_index`` is -1 when no signal matched; otherwise it is the
+    lowest index among the signals tied at the winning confidence —
+    i.e. exactly the signal a first-strict-maximum pairwise scan with
+    :func:`match` would have kept.
+    """
+
+    span_index: int
+    signal_index: int
+    decision: Decision
+
+
+def match_batch(
+    spans: Sequence[SpanRef],
+    signals: Sequence[SignalRef],
+    window_ms: int = 0,
+) -> list[BatchMatch]:
+    """Best-match correlation of a span batch against a signal batch.
+
+    Returns one :class:`BatchMatch` per span, in span order.  For every
+    span the decision equals the highest-confidence pairwise
+    ``match(span, signal, window_ms)`` over all signals (first maximum
+    on ties).  Timestamps must be consistently naive or consistently
+    timezone-aware across the batch, like the pairwise matcher itself.
+    """
+    global_ms = window_ms if window_ms > 0 else DEFAULT_WINDOW_MS
+
+    ref: datetime | None = None
+    for signal in signals:
+        if signal.timestamp is not None:
+            ref = signal.timestamp
+            break
+    if ref is None:
+        return [BatchMatch(i, -1, Decision()) for i in range(len(spans))]
+
+    # One pass over the signals builds all six tier indexes:
+    # key -> [(microseconds-from-ref, signal index), ...], sorted.
+    indexes: list[dict[Any, list[tuple[int, int]]]] = [
+        {} for _ in _TIER_SPECS
+    ]
+    for idx, signal in enumerate(signals):
+        ts = signal.timestamp
+        if ts is None:
+            continue
+        ts_us = (ts - ref) // _US
+        for tier_pos, (_, _, _, signal_key) in enumerate(_TIER_SPECS):
+            key = signal_key(signal)
+            if key is not None:
+                indexes[tier_pos].setdefault(key, []).append((ts_us, idx))
+    for index in indexes:
+        for postings in index.values():
+            postings.sort()
+
+    out: list[BatchMatch] = []
+    for span_index, span in enumerate(spans):
+        if span.timestamp is None:
+            out.append(BatchMatch(span_index, -1, Decision()))
+            continue
+        span_us = (span.timestamp - ref) // _US
+        best_index = -1
+        best_tier = ""
+        for tier_pos, (tier, tier_ms, span_key, _) in enumerate(_TIER_SPECS):
+            key = span_key(span)
+            if key is None:
+                continue
+            postings = indexes[tier_pos].get(key)
+            if not postings:
+                continue
+            w_us = (
+                global_ms if tier_ms is None else min(global_ms, tier_ms)
+            ) * 1000
+            lo = bisect_left(postings, (span_us - w_us, -1))
+            hi = bisect_right(postings, (span_us + w_us, len(signals)))
+            if lo < hi:
+                best_index = min(idx for _, idx in postings[lo:hi])
+                best_tier = tier
+                break
+        if best_index < 0:
+            out.append(BatchMatch(span_index, -1, Decision()))
+        else:
+            out.append(
+                BatchMatch(
+                    span_index,
+                    best_index,
+                    Decision(True, TIER_CONFIDENCE[best_tier], best_tier),
+                )
+            )
+    return out

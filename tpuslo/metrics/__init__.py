@@ -1,3 +1,9 @@
 from tpuslo.metrics.registry import AgentMetrics, start_metrics_server
+from tpuslo.schema.fastpath import VALIDATION_COUNTERS, ValidationCounters
 
-__all__ = ["AgentMetrics", "start_metrics_server"]
+__all__ = [
+    "AgentMetrics",
+    "start_metrics_server",
+    "VALIDATION_COUNTERS",
+    "ValidationCounters",
+]

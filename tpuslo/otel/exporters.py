@@ -102,10 +102,13 @@ class SLOEventExporter(_BaseExporter):
         super().__init__(endpoint, service_name, scope_name, timeout_s)
 
     def export_batch(self, events: list[SLOEvent]) -> None:
-        self._post([self._record(e) for e in events])
-
-    def _record(self, event: SLOEvent) -> dict:
+        # One observation timestamp per batch: the whole batch is
+        # observed by this call, and it keeps the hot loop clock-free.
         now_ns = time.time_ns()
+        self._post([self._record(e, now_ns) for e in events])
+
+    def _record(self, event: SLOEvent, now_ns: int | None = None) -> dict:
+        now_ns = now_ns if now_ns is not None else time.time_ns()
         ts_ns = int(event.timestamp.timestamp() * 1e9) if event.timestamp else now_ns
         attrs = [
             _str_attr("event.id", event.event_id),
@@ -145,10 +148,11 @@ class ProbeEventExporter(_BaseExporter):
         super().__init__(endpoint, service_name, scope_name, timeout_s)
 
     def export_batch(self, events: list[ProbeEventV1]) -> None:
-        self._post([self._record(e) for e in events])
-
-    def _record(self, event: ProbeEventV1) -> dict:
         now_ns = time.time_ns()
+        self._post([self._record(e, now_ns) for e in events])
+
+    def _record(self, event: ProbeEventV1, now_ns: int | None = None) -> dict:
+        now_ns = now_ns if now_ns is not None else time.time_ns()
         attrs = [
             _str_attr("signal", event.signal),
             _str_attr("node", event.node),

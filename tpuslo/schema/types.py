@@ -171,7 +171,7 @@ class IncidentAttribution:
         return out
 
 
-@dataclass
+@dataclass(slots=True)
 class ConnTuple:
     """One network flow tuple observed by probes.
 
@@ -195,7 +195,7 @@ class ConnTuple:
         )
 
 
-@dataclass
+@dataclass(slots=True)
 class TPURef:
     """Accelerator identity attached to TPU-side probe events.
 
@@ -241,7 +241,7 @@ class TPURef:
         return out
 
 
-@dataclass
+@dataclass(slots=True)
 class ProbeEventV1:
     """Normalized probe envelope emitted by the node agent.
 

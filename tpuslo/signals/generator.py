@@ -308,55 +308,121 @@ class Generator:
 
     def generate(self, sample: RawSample, meta: Metadata) -> list[ProbeEventV1]:
         """Expand one sample into normalized probe events, one per signal."""
+        return self.generate_batch([sample], meta)
+
+    def generate_batch(
+        self, samples: Iterable[RawSample], meta: Metadata
+    ) -> list[ProbeEventV1]:
+        """Expand a sample batch, in sample order then signal order.
+
+        The hot-path twin of :meth:`generate`: the enabled-signal set and
+        metadata enrichment are snapshotted once per batch (one lock
+        acquisition, one enricher call), per-signal templates
+        (unit / conn-tuple membership / errno eligibility / ICI link)
+        are precomputed, and the per-fault value+status pairs are cached
+        per distinct fault label rather than rebuilt per sample.
+        """
         with self._lock:
-            enabled = set(self._enabled)
+            enabled = self._enabled.copy()
         if not enabled:
             return []
 
         if self._enricher is not None:
             meta = self._enricher.enrich(meta)
 
-        profile = profile_for_fault(sample.fault_label)
-        errno = errno_for_fault(sample.fault_label)
-        tuple_ = ConnTuple("10.244.0.10", "10.244.0.53", 42424, 443, "tcp")
-        ts_ns = int(sample.timestamp.timestamp() * 1e9)
-        launch_id = _launch_id_for(sample)
+        # Per-batch signal templates: (signal, unit, is_conn, takes_errno,
+        # ici_link or None when the signal carries no TPU block).
+        templates = [
+            (
+                signal,
+                SIGNAL_UNITS[signal],
+                signal in _CONN_TUPLE_SIGNALS,
+                signal
+                in (sig.SIGNAL_CONNECT_LATENCY_MS, sig.SIGNAL_CONNECT_ERRORS),
+                (0 if signal == sig.SIGNAL_ICI_LINK_RETRIES else -1)
+                if signal in sig.TPU_SIGNALS
+                else None,
+            )
+            for signal in sig.ALL_SIGNALS
+            if signal in enabled
+        ]
+        conn_tuple = ConnTuple("10.244.0.10", "10.244.0.53", 42424, 443, "tcp")
+        node, namespace, pod = meta.node, meta.namespace, meta.pod
+        container, pid, tid = meta.container, meta.pid, meta.tid
+        trace_id, span_id = meta.trace_id, meta.span_id
+        chip = meta.tpu_chip or "accel0"
+
+        # (value, status) per enabled signal, keyed by fault label: a
+        # batch usually carries a handful of labels across hundreds of
+        # samples, so threshold lookups happen once per label.
+        fault_rows: dict[str, tuple[tuple[float, str], ...]] = {}
 
         out: list[ProbeEventV1] = []
-        for signal in sig.ALL_SIGNALS:
-            if signal not in enabled:
-                continue
-            value = profile[signal]
-            event = ProbeEventV1(
-                ts_unix_nano=ts_ns,
-                signal=signal,
-                node=meta.node,
-                namespace=meta.namespace,
-                pod=meta.pod,
-                container=meta.container,
-                pid=meta.pid,
-                tid=meta.tid,
-                value=value,
-                unit=SIGNAL_UNITS[signal],
-                status=signal_status(signal, value),
-                trace_id=meta.trace_id,
-                span_id=meta.span_id,
-            )
-            if signal in _CONN_TUPLE_SIGNALS:
-                event.conn_tuple = tuple_
-                if errno and signal in (
-                    sig.SIGNAL_CONNECT_LATENCY_MS,
-                    sig.SIGNAL_CONNECT_ERRORS,
-                ):
-                    event.errno = errno
-            if signal in sig.TPU_SIGNALS:
-                event.tpu = TPURef(
-                    chip=meta.tpu_chip or "accel0",
-                    slice_id=meta.slice_id,
-                    host_index=meta.host_index,
-                    ici_link=0 if signal == sig.SIGNAL_ICI_LINK_RETRIES else -1,
-                    program_id=meta.xla_program_id,
-                    launch_id=launch_id,
+        for sample in samples:
+            label = sample.fault_label
+            rows = fault_rows.get(label)
+            if rows is None:
+                profile = profile_for_fault(label)
+                rows = tuple(
+                    (profile[signal], signal_status(signal, profile[signal]))
+                    for signal, _, _, _, _ in templates
                 )
-            out.append(event)
+                fault_rows[label] = rows
+            errno = errno_for_fault(label)
+            ts_ns = int(sample.timestamp.timestamp() * 1e9)
+            launch_id = _launch_id_for(sample)
+            # TPU identity is per sample (launch id), shared across the
+            # sample's TPU events except the ICI-link variant.
+            tpu_ref = ici_ref = None
+
+            for (signal, unit, is_conn, takes_errno, ici_link), (
+                value,
+                status,
+            ) in zip(templates, rows):
+                event = ProbeEventV1(
+                    ts_unix_nano=ts_ns,
+                    signal=signal,
+                    node=node,
+                    namespace=namespace,
+                    pod=pod,
+                    container=container,
+                    pid=pid,
+                    tid=tid,
+                    value=value,
+                    unit=unit,
+                    status=status,
+                    trace_id=trace_id,
+                    span_id=span_id,
+                )
+                if is_conn:
+                    event.conn_tuple = conn_tuple
+                    if errno and takes_errno:
+                        event.errno = errno
+                if ici_link is not None:
+                    if ici_link >= 0:
+                        if ici_ref is None:
+                            ici_ref = self._tpu_ref(
+                                chip, meta, launch_id, ici_link
+                            )
+                        event.tpu = ici_ref
+                    else:
+                        if tpu_ref is None:
+                            tpu_ref = self._tpu_ref(
+                                chip, meta, launch_id, ici_link
+                            )
+                        event.tpu = tpu_ref
+                out.append(event)
         return out
+
+    @staticmethod
+    def _tpu_ref(
+        chip: str, meta: Metadata, launch_id: int, ici_link: int
+    ) -> TPURef:
+        return TPURef(
+            chip=chip,
+            slice_id=meta.slice_id,
+            host_index=meta.host_index,
+            ici_link=ici_link,
+            program_id=meta.xla_program_id,
+            launch_id=launch_id,
+        )
