@@ -7,7 +7,8 @@ ARTIFACTS ?= artifacts
 
 .PHONY: all test test-fast native ebpf lint schema-validate \
 	correlation-gate fault-smoke replay-smoke ebpf-smoke bench \
-	bench-smoke m5-candidate m5-gate helm-lint dashboards clean
+	bench-smoke chaos-smoke chaos-demo m5-candidate m5-gate helm-lint \
+	dashboards clean
 
 all: native test
 
@@ -99,6 +100,26 @@ bench:
 # per-event jsonschema) is actually engaged.
 bench-smoke:
 	$(PY) -m pytest tests/test_bench_smoke.py -q
+
+# Fault-injection suite: real agent loop vs a scripted flaky OTLP sink
+# (refuse/5xx/4xx/hang), proving zero-loss spool+replay and breaker
+# recovery.  chaos tests are also marked slow, so the tier-1
+# `-m 'not slow'` lane never runs them implicitly.
+chaos-smoke:
+	$(PY) -m pytest tests/ -q -m chaos
+
+# Watchable version of the same story: collector dies mid-run, the
+# agent spools, the breaker trips, recovery replays the outage window
+# (see the delivery[...] summary lines + docs/runbooks/degraded-delivery.md).
+chaos-demo:
+	mkdir -p $(ARTIFACTS)/chaos-spool
+	$(PY) -m tpuslo agent --config config/chaos-demo.yaml \
+		--scenario tpu_mixed --count 25 \
+		--interval-s 0.1 --event-kind both \
+		--chaos-sink 'ok:6,refuse:8,ok' \
+		--spool-dir $(ARTIFACTS)/chaos-spool \
+		--capability-mode tpu_full --metrics-port 0 \
+		--max-overhead-pct 1000
 
 # Build the m5 candidate tree: 7 scenarios x 3 reruns of benchmark
 # bundles (reference Makefile m5-candidate-rebuild).

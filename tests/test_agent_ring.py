@@ -188,6 +188,35 @@ def test_agent_ring_mode_stamps_multihost_identity(tmp_path):
     assert launches == {0, 1, 2}
 
 
+def test_owned_side_ring_created_securely_and_cleaned_up(
+    tmp_path, monkeypatch
+):
+    """When the agent owns the side ring (no --ring-path), the file is
+    created via mkstemp (not the race-prone mktemp) and removed on
+    exit."""
+    import tempfile
+
+    from tpuslo.cli import agent
+
+    monkeypatch.setattr(tempfile, "tempdir", str(tmp_path))
+    rc = agent.main(
+        [
+            "--probe-source", "ring",
+            "--hello",
+            "--event-kind", "probe",
+            "--output", "jsonl",
+            "--jsonl-path", str(tmp_path / "probes.jsonl"),
+            "--count", "2",
+            "--interval-s", "0.05",
+            "--metrics-port", "0",
+            "--max-overhead-pct", "1000",
+            "--signal-set", "dns_latency_ms",
+        ]
+    )
+    assert rc == 0
+    assert not list(tmp_path.glob("tpuslo-ring-*.buf"))
+
+
 def test_ring_consumer_lifts_launch_id_for_dcn_events():
     """aux -> launch_id must lift for BOTH collective signals: the
     cross-slice joiner keys dcn_transfer groups on (program, launch),

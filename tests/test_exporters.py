@@ -21,6 +21,10 @@ class StubHandler(BaseHTTPRequestHandler):
     status_code = 202
 
     def do_POST(self):
+        if self.server.hang_s:
+            import time
+
+            time.sleep(self.server.hang_s)
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length)
         self.server.requests.append(
@@ -41,6 +45,7 @@ def stub_server():
     server.requests = []
     server.status_codes = []
     server.default_status = 202
+    server.hang_s = 0.0
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
     yield server
@@ -129,6 +134,18 @@ class TestSLOEventExporter:
         with pytest.raises(ExportError):
             SLOEventExporter("").export_batch([make_slo_event()])
 
+    def test_retryability_classification(self, stub_server):
+        exporter = SLOEventExporter(
+            f"http://127.0.0.1:{stub_server.server_port}/v1/logs"
+        )
+        # 429 (rate limiting) is retryable per OTLP/HTTP; 400 is poison.
+        for code, retryable in ((429, True), (408, True), (400, False),
+                                (500, True)):
+            stub_server.status_codes = [code]
+            with pytest.raises(ExportError) as err:
+                exporter.export_batch([make_slo_event()])
+            assert err.value.retryable is retryable, code
+
 
 class TestProbeEventExporter:
     def test_tpu_attributes_exported(self, stub_server):
@@ -166,10 +183,50 @@ class TestWebhook:
         exporter = webhook.Exporter(
             f"http://127.0.0.1:{stub_server.server_port}/hook",
             sleep=sleeps.append,
+            rng=lambda: 1.0,  # pin full jitter to its upper bound
         )
         exporter.send(make_attr())
         assert len(stub_server.requests) == 2
         assert sleeps == [1.0]
+
+    def test_backoff_jitter_and_cap(self, stub_server):
+        # 5 attempts with rng pinned high: un-capped exponential would
+        # sleep [1, 2, 4, 8]; the default 8s cap must clamp the tail,
+        # and jitter must scale the whole delay.
+        stub_server.status_codes = [500] * 5
+        sleeps = []
+        exporter = webhook.Exporter(
+            f"http://127.0.0.1:{stub_server.server_port}/hook",
+            max_retry=5,
+            max_delay_s=4.0,
+            sleep=sleeps.append,
+            rng=lambda: 0.5,
+        )
+        with pytest.raises(webhook.WebhookError, match="after 5 attempts"):
+            exporter.send(make_attr())
+        assert sleeps == [0.5, 1.0, 2.0, 2.0]  # 0.5 * min(4, 2^n)
+
+    def test_timeout_is_retryable(self, stub_server):
+        # A hang past the client timeout must classify as an explicitly
+        # retryable WebhookError, not an opaque URLError string.
+        stub_server.hang_s = 0.5
+        exporter = webhook.Exporter(
+            f"http://127.0.0.1:{stub_server.server_port}/hook",
+            timeout_ms=100,
+            max_retry=1,
+            sleep=lambda _: None,
+        )
+        with pytest.raises(webhook.WebhookError, match="timed out"):
+            exporter.send(make_attr())
+
+    def test_429_is_retryable(self, stub_server):
+        stub_server.status_codes = [429, 202]
+        exporter = webhook.Exporter(
+            f"http://127.0.0.1:{stub_server.server_port}/hook",
+            sleep=lambda _: None,
+        )
+        exporter.send(make_attr())  # throttled once, then delivered
+        assert len(stub_server.requests) == 2
 
     def test_4xx_not_retried(self, stub_server):
         stub_server.status_codes = [400]

@@ -163,3 +163,61 @@ def test_check_overhead_sheds_in_cost_order(tmp_path):
     shed = pm.check_overhead()
     assert shed == sig.SIGNAL_ICI_COLLECTIVE_MS  # TPU probe goes first
     assert sig.SIGNAL_DNS_LATENCY_MS in pm.attached_signals
+    assert pm.shed_signals == [sig.SIGNAL_ICI_COLLECTIVE_MS]
+
+
+def test_restore_one_reattaches_last_shed(tmp_path, monkeypatch):
+    from tpuslo.collector.probe_manager import AttachReport, AttachResult
+
+    pm = ProbeManager(obj_dir=tmp_path, guard=_TrippedGuard())
+    pm._attached = {
+        sig.SIGNAL_DNS_LATENCY_MS: "h1",
+        sig.SIGNAL_ICI_COLLECTIVE_MS: "h2",
+        sig.SIGNAL_XLA_COMPILE_MS: "h3",
+    }
+    assert pm.check_overhead() == sig.SIGNAL_ICI_COLLECTIVE_MS
+    assert pm.check_overhead() == sig.SIGNAL_XLA_COMPILE_MS
+    assert pm.shed_signals == [
+        sig.SIGNAL_ICI_COLLECTIVE_MS, sig.SIGNAL_XLA_COMPILE_MS,
+    ]
+
+    # Stub the native attach: restore re-plans exactly the popped
+    # signal and succeeds.
+    def fake_attach_all(signal_names):
+        report = AttachReport()
+        for name in signal_names:
+            pm._attached[name] = f"restored:{name}"
+            report.results.append(
+                AttachResult(signal=name, attached=True, status="attached")
+            )
+        return report
+
+    monkeypatch.setattr(pm, "attach_all", fake_attach_all)
+    # Reverse cost order: the last-shed (cheapest) probe comes back first.
+    assert pm.restore_one() == sig.SIGNAL_XLA_COMPILE_MS
+    assert pm.shed_signals == [sig.SIGNAL_ICI_COLLECTIVE_MS]
+    assert pm.restore_one() == sig.SIGNAL_ICI_COLLECTIVE_MS
+    assert pm.restore_one() is None
+
+
+def test_restore_one_keeps_signal_on_failed_reattach(tmp_path, monkeypatch):
+    from tpuslo.collector.probe_manager import AttachReport, AttachResult
+
+    pm = ProbeManager(obj_dir=tmp_path, guard=_TrippedGuard())
+    pm._attached = {sig.SIGNAL_ICI_COLLECTIVE_MS: "h2"}
+    pm.check_overhead()
+
+    def failing_attach_all(signal_names):
+        report = AttachReport()
+        report.results.append(
+            AttachResult(
+                signal=signal_names[0], attached=False, status="no_symbol",
+            )
+        )
+        return report
+
+    monkeypatch.setattr(pm, "attach_all", failing_attach_all)
+    # libtpu vanished: the signal stays shed for a later retry instead
+    # of being forgotten.
+    assert pm.restore_one() is None
+    assert pm.shed_signals == [sig.SIGNAL_ICI_COLLECTIVE_MS]

@@ -73,6 +73,52 @@ class TestOverheadGuard:
         assert sample.proc_ticks >= 0
 
 
+class TestShedRecoveryPolicy:
+    @staticmethod
+    def result(cpu_pct, budget_pct=3.0, valid=True):
+        return safety.OverheadResult(
+            cpu_pct=cpu_pct,
+            budget_pct=budget_pct,
+            over_budget=cpu_pct > budget_pct,
+            valid=valid,
+        )
+
+    def test_restores_after_n_consecutive_under_budget_cycles(self):
+        policy = safety.ShedRecoveryPolicy(cycles=3, headroom_factor=0.8)
+        assert not policy.note(self.result(1.0))
+        assert not policy.note(self.result(1.0))
+        assert policy.note(self.result(1.0))
+        # Streak restarts after each authorized restore (one-at-a-time ramp).
+        assert policy.streak == 0
+        assert not policy.note(self.result(1.0))
+
+    def test_over_budget_resets_streak(self):
+        policy = safety.ShedRecoveryPolicy(cycles=2)
+        assert not policy.note(self.result(1.0))
+        assert not policy.note(self.result(9.0))  # breach
+        assert not policy.note(self.result(1.0))
+        assert policy.note(self.result(1.0))
+
+    def test_headroom_hysteresis_blocks_borderline_cycles(self):
+        # 2.5% is under the 3% budget but above the 2.4% (0.8x) recovery
+        # line: restoring there would flap straight back into shedding.
+        policy = safety.ShedRecoveryPolicy(cycles=1, headroom_factor=0.8)
+        assert not policy.note(self.result(2.5))
+        assert policy.note(self.result(2.3))
+
+    def test_invalid_samples_do_not_break_streak(self):
+        policy = safety.ShedRecoveryPolicy(cycles=2)
+        assert not policy.note(self.result(1.0))
+        assert not policy.note(self.result(0.0, valid=False))
+        assert policy.note(self.result(1.0))
+
+    def test_rejects_bad_params(self):
+        with pytest.raises(ValueError):
+            safety.ShedRecoveryPolicy(cycles=0)
+        with pytest.raises(ValueError):
+            safety.ShedRecoveryPolicy(headroom_factor=1.5)
+
+
 class TestRateLimiter:
     def test_burst_then_deny(self):
         now = [0.0]

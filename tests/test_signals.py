@@ -112,6 +112,27 @@ class TestGenerator:
         assert gen.disable("dns_latency_ms") is True
         assert gen.disable("dns_latency_ms") is False
 
+    def test_restore_one_reverses_shed_order(self):
+        gen = signals.Generator(signals.CAPABILITY_TPU_FULL)
+        first = gen.disable_highest_cost()
+        second = gen.disable_highest_cost()
+        assert gen.shed_signals() == [first, second]
+        # Reverse cost order: the cheapest still-shed probe returns
+        # first, ramping cost back gradually.
+        assert gen.restore_one() == second
+        assert second in gen.enabled_signals()
+        assert gen.restore_one() == first
+        assert gen.restore_one() is None
+        assert gen.shed_signals() == []
+
+    def test_restore_skips_manually_disabled_signals(self):
+        gen = signals.Generator(signals.CAPABILITY_TPU_FULL)
+        shed = gen.disable_highest_cost()
+        gen.set_signals(["dns_latency_ms"])  # operator override
+        # The override supersedes shed history: nothing to restore.
+        assert gen.restore_one() is None
+        assert shed not in gen.enabled_signals()
+
     def test_static_enricher_fills_blanks(self):
         enricher = signals.StaticMetadataEnricher(META)
         gen = signals.Generator(signals.CAPABILITY_TPU_FULL, enricher=enricher)

@@ -25,8 +25,9 @@ from tpuslo.collector import (
     supported_synthetic_scenarios,
 )
 from tpuslo.collector.kernel import probe_smoke_check
+from tpuslo.delivery import DeliveryOptions
 from tpuslo.metrics import AgentMetrics, start_metrics_server
-from tpuslo.safety import OverheadGuard, RateLimiter
+from tpuslo.safety import OverheadGuard, RateLimiter, ShedRecoveryPolicy
 from tpuslo.signals import (
     Generator,
     Metadata,
@@ -97,6 +98,30 @@ def build_parser() -> argparse.ArgumentParser:
         help="emit hello heartbeat events through the ring (e2e evidence)",
     )
     p.add_argument(
+        "--spool-dir",
+        default="",
+        help="enable resilient delivery: batches that cannot reach a "
+        "network sink are spooled here and replayed on recovery "
+        "(config: delivery.spool_dir)",
+    )
+    p.add_argument(
+        "--restore-after-cycles",
+        type=int,
+        default=0,
+        help="re-enable one shed probe signal after this many "
+        "consecutive under-budget guard cycles "
+        "(0 = config delivery.restore_after_cycles)",
+    )
+    p.add_argument(
+        "--chaos-sink",
+        default="",
+        metavar="SCHEDULE",
+        help="start an in-process fault-injection OTLP sink and point "
+        "the exporters at it; SCHEDULE is behavior[:count],... with "
+        "behaviors ok|refuse|5xx|4xx|hang|flap (e.g. 'ok:3,refuse:6,ok') "
+        "— demo/chaos harness, implies --output otlp",
+    )
+    p.add_argument(
         "--ici-probe-interval-s",
         type=float,
         default=0.0,
@@ -108,7 +133,9 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def main(argv: list[str] | None = None) -> int:
+def main(
+    argv: list[str] | None = None, metrics: AgentMetrics | None = None
+) -> int:
     args = build_parser().parse_args(argv)
 
     if args.probe_smoke:
@@ -126,6 +153,27 @@ def main(argv: list[str] | None = None) -> int:
     max_overhead = args.max_overhead_pct or cfg.safety.max_overhead_pct
     eps = args.events_per_second or cfg.sampling.events_per_second_limit
 
+    chaos_server = None
+    otlp_endpoint = args.otlp_endpoint or cfg.otlp.endpoint
+    if args.chaos_sink:
+        from tpuslo.delivery.faultsink import FaultInjectingHTTPServer
+
+        chaos_server = FaultInjectingHTTPServer(args.chaos_sink).start()
+        otlp_endpoint = chaos_server.endpoint
+        if args.output != "otlp":
+            print(
+                "agent: --chaos-sink implies --output otlp", file=sys.stderr
+            )
+            args.output = "otlp"
+        print(f"agent: chaos sink on {otlp_endpoint}", file=sys.stderr)
+
+    spool_dir = args.spool_dir or cfg.delivery.spool_dir
+    delivery_opts = (
+        DeliveryOptions.from_config(cfg.delivery, spool_dir=spool_dir)
+        if spool_dir
+        else None
+    )
+
     meta_template = Metadata(
         node=args.node,
         namespace=args.namespace,
@@ -141,13 +189,15 @@ def main(argv: list[str] | None = None) -> int:
     )
     generator = Generator(mode, signal_set, enricher=enricher)
 
+    metrics = metrics or AgentMetrics()
     writers = EventWriters(
         output=args.output,
         jsonl_path=args.jsonl_path,
-        otlp_endpoint=args.otlp_endpoint or cfg.otlp.endpoint,
+        otlp_endpoint=otlp_endpoint,
+        delivery=delivery_opts,
+        observer_factory=metrics.delivery_observer,
     )
 
-    metrics = AgentMetrics()
     metrics.up.set(1)
     metrics.capability_mode.labels(mode=mode).set(1)
     metrics.event_kind.labels(kind=args.event_kind).set(1)
@@ -159,10 +209,14 @@ def main(argv: list[str] | None = None) -> int:
 
     limiter = RateLimiter(eps, cfg.sampling.burst_limit)
     guard = OverheadGuard(max_overhead)
+    recovery = ShedRecoveryPolicy(
+        cycles=args.restore_after_cycles or cfg.delivery.restore_after_cycles
+    )
 
     webhook_url = args.webhook_url or (cfg.webhook.url if cfg.webhook.enabled else "")
     hook = None
     attributor = None
+    webhook_channel = None
     if webhook_url:
         hook = webhook.Exporter(
             webhook_url,
@@ -171,6 +225,16 @@ def main(argv: list[str] | None = None) -> int:
             timeout_ms=cfg.webhook.timeout_ms,
         )
         attributor = attribution.BayesianAttributor()
+        if delivery_opts is not None:
+            # Incident delivery rides its own channel: the agent loop
+            # never blocks on webhook retries/backoff again.
+            from tpuslo.delivery.sinks import WebhookSink
+
+            webhook_channel = delivery_opts.build_channel(
+                "webhook",
+                WebhookSink(hook),
+                observer=metrics.delivery_observer("webhook"),
+            )
 
     sample_meta = SampleMeta(
         cluster=args.cluster,
@@ -268,17 +332,27 @@ def main(argv: list[str] | None = None) -> int:
                 # probe set: shedding shouldn't starve attribution.
                 signals=profile_for_fault(sample.fault_label),
             )
-            try:
-                hook.send(attributor.attribute_sample(fault))
-                metrics.webhook_sent.labels(outcome="ok").inc()
-            except webhook.WebhookError as exc:
-                metrics.webhook_sent.labels(outcome="error").inc()
-                print(f"agent: webhook failed: {exc}", file=sys.stderr)
+            attr = attributor.attribute_sample(fault)
+            if webhook_channel is not None:
+                import json as json_mod
+
+                webhook_channel.submit(
+                    "incident", [json_mod.loads(hook.build_payload(attr))]
+                )
+                metrics.webhook_sent.labels(outcome="queued").inc()
+            else:
+                try:
+                    hook.send(attr)
+                    metrics.webhook_sent.labels(outcome="ok").inc()
+                except webhook.WebhookError as exc:
+                    metrics.webhook_sent.labels(outcome="error").inc()
+                    print(f"agent: webhook failed: {exc}", file=sys.stderr)
 
         result = guard.evaluate()
         if result.valid:
             metrics.cpu_overhead_pct.set(result.cpu_pct)
             if result.over_budget:
+                recovery.note(result)  # breaks any under-budget streak
                 shed = generator.disable_highest_cost()
                 if shed:
                     print(
@@ -287,13 +361,24 @@ def main(argv: list[str] | None = None) -> int:
                         file=sys.stderr,
                     )
                     metrics.set_enabled_signals(generator.enabled_signals())
+            elif recovery.note(result):
+                restored = generator.restore_one()
+                if restored:
+                    print(
+                        f"agent: overhead {result.cpu_pct:.2f}% under "
+                        f"budget for {recovery.cycles} cycles, "
+                        f"re-enabled {restored}",
+                        file=sys.stderr,
+                    )
+                    metrics.signals_restored.labels(signal=restored).inc()
+                    metrics.set_enabled_signals(generator.enabled_signals())
         metrics.mark_cycle()
 
     try:
         if args.probe_source == "ring":
             _run_ring_loop(
                 args, cfg, mode, signal_set, enricher, writers, metrics,
-                limiter, guard, ici_prober=ici_prober,
+                limiter, guard, recovery, ici_prober=ici_prober,
             )
         else:
             idx = 0
@@ -307,7 +392,23 @@ def main(argv: list[str] | None = None) -> int:
         pass
     finally:
         metrics.up.set(0)
+        if webhook_channel is not None:
+            webhook_channel.close()
         writers.close()
+        for channel in (
+            writers.delivery_channels
+            + ([webhook_channel] if webhook_channel else [])
+        ):
+            snap = channel.snapshot()
+            print(
+                "agent: delivery[{sink}]: delivered={delivered_events} "
+                "spooled={spooled_events} replayed={replayed_events} "
+                "dead_lettered={dead_lettered_events} retries={retries} "
+                "breaker={breaker} spool_bytes={spool_bytes}".format(**snap),
+                file=sys.stderr,
+            )
+        if chaos_server is not None:
+            chaos_server.stop()
         if server is not None:
             server.shutdown()
     return 0
@@ -315,7 +416,7 @@ def main(argv: list[str] | None = None) -> int:
 
 def _run_ring_loop(
     args, cfg, mode, signal_set, enricher, writers, metrics, limiter, guard,
-    ici_prober=None,
+    recovery, ici_prober=None,
 ) -> None:
     """The real-probe path: ringbuf → normalize → schema → emit.
 
@@ -325,6 +426,7 @@ def _run_ring_loop(
     no privileges → the kernel surface is skipped but userspace rings
     (BCC fallback, injectors, hello tracer, HBM sampler) still flow.
     """
+    import os
     import tempfile
 
     from tpuslo.collector.hbm_sampler import HBMSampler
@@ -353,17 +455,27 @@ def _run_ring_loop(
         steal_window_ms=1000,
         batch=cfg.sampling.burst_limit or 256,
     )
+    known_fds: set[int] = set()
     for fd in pm.ringbuf_fds():
         consumer.add_kernel_ringbuf(fd)
+        known_fds.add(fd)
 
     # Userspace side-channel ring: hello tracer + HBM sampler share it,
     # plus whatever external producer --ring-path points at.
     tracer = None
     sampler = None
     side_ring = args.ring_path
+    side_ring_owned = False
     if not side_ring and (args.hello or sigconst.SIGNAL_HBM_UTILIZATION_PCT
                           in signal_set):
-        side_ring = tempfile.mktemp(prefix="tpuslo-ring-", suffix=".buf")
+        # mkstemp (not the race-prone, deprecated mktemp): the path is
+        # created 0600 and owned by us; the ring producer re-initializes
+        # it in place (O_TRUNC) before the consumer maps it.
+        fd, side_ring = tempfile.mkstemp(
+            prefix="tpuslo-ring-", suffix=".buf"
+        )
+        os.close(fd)
+        side_ring_owned = True
     if args.hello and side_ring:
         tracer = HelloTracer(side_ring, interval_s=5.0)
         tracer.start()
@@ -434,6 +546,7 @@ def _run_ring_loop(
             if result.valid:
                 metrics.cpu_overhead_pct.set(result.cpu_pct)
                 if result.over_budget:
+                    recovery.note(result)  # breaks the recovery streak
                     shed = pm.shed_highest_cost()
                     if shed:
                         print(
@@ -442,6 +555,34 @@ def _run_ring_loop(
                             file=sys.stderr,
                         )
                         metrics.set_enabled_signals(pm.attached_signals)
+                        # Detach closed that object's ring fd; forget it
+                        # so a restored probe reusing the fd number
+                        # re-registers with the consumer.
+                        known_fds &= set(pm.ringbuf_fds())
+                elif recovery.note(result):
+                    restored = pm.restore_one()
+                    if restored:
+                        print(
+                            f"agent: overhead {result.cpu_pct:.2f}% under "
+                            f"budget for {recovery.cycles} cycles, "
+                            f"re-attached {restored}",
+                            file=sys.stderr,
+                        )
+                        metrics.signals_restored.labels(
+                            signal=restored
+                        ).inc()
+                        metrics.set_enabled_signals(pm.attached_signals)
+                        for fd in pm.ringbuf_fds():
+                            if fd in known_fds:
+                                continue
+                            try:
+                                consumer.add_kernel_ringbuf(fd)
+                                known_fds.add(fd)
+                            except Exception as exc:  # noqa: BLE001
+                                print(
+                                    f"agent: ring re-add failed: {exc}",
+                                    file=sys.stderr,
+                                )
             metrics.mark_cycle()
             cycles += 1
             if args.count and cycles >= args.count:
@@ -454,6 +595,11 @@ def _run_ring_loop(
             sampler.close()
         consumer.close()
         pm.detach_all()
+        if side_ring_owned:
+            try:
+                os.unlink(side_ring)
+            except OSError:
+                pass
 
 
 if __name__ == "__main__":

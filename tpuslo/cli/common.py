@@ -5,9 +5,10 @@ from __future__ import annotations
 import json
 import sys
 import threading
-from typing import IO, Any
+from typing import IO, Any, Callable
 
 from tpuslo.config import ToolkitConfig, default_config, load_config
+from tpuslo.delivery import DeliveryChannel, DeliveryObserver, DeliveryOptions
 from tpuslo.otel.exporters import ProbeEventExporter, SLOEventExporter
 from tpuslo.schema import (
     SCHEMA_SLO_EVENT,
@@ -28,6 +29,13 @@ class EventWriters:
 
     Reference: ``cmd/agent/main.go:68-135`` (outputWriters).
     Thread-safe for the agent's concurrent emit paths.
+
+    With ``delivery`` enabled (a spool dir is configured), the OTLP
+    network sinks route through per-sink :class:`DeliveryChannel`\\ s:
+    ``emit_*`` becomes non-blocking and loss-free (queue → retry →
+    breaker → disk spool → replay) instead of raising on sink failure.
+    Local sinks (stdout/JSONL) stay synchronous — they fail only with
+    the node itself.
     """
 
     def __init__(
@@ -36,6 +44,8 @@ class EventWriters:
         jsonl_path: str = "",
         otlp_endpoint: str = "",
         stream: IO[str] | None = None,
+        delivery: DeliveryOptions | None = None,
+        observer_factory: Callable[[str], DeliveryObserver] | None = None,
     ):
         self.output = output
         self._lock = threading.Lock()
@@ -43,6 +53,9 @@ class EventWriters:
         self._jsonl: IO[str] | None = None
         self._slo_exporter: SLOEventExporter | None = None
         self._probe_exporter: ProbeEventExporter | None = None
+        self._slo_channel: DeliveryChannel | None = None
+        self._probe_channel: DeliveryChannel | None = None
+        self._closed = False
         if output == OUTPUT_JSONL:
             if not jsonl_path:
                 raise ValueError("jsonl output requires --jsonl-path")
@@ -52,6 +65,22 @@ class EventWriters:
                 raise ValueError("otlp output requires an endpoint")
             self._slo_exporter = SLOEventExporter(otlp_endpoint)
             self._probe_exporter = ProbeEventExporter(otlp_endpoint)
+            if delivery is not None and delivery.enabled:
+                from tpuslo.delivery.sinks import OTLPRecordSink
+
+                observer_factory = observer_factory or (
+                    lambda name: DeliveryObserver()
+                )
+                self._slo_channel = delivery.build_channel(
+                    "otlp-slo",
+                    OTLPRecordSink(self._slo_exporter),
+                    observer=observer_factory("otlp-slo"),
+                )
+                self._probe_channel = delivery.build_channel(
+                    "otlp-probe",
+                    OTLPRecordSink(self._probe_exporter),
+                    observer=observer_factory("otlp-probe"),
+                )
         elif output != OUTPUT_STDOUT:
             raise ValueError(f"unsupported output {output!r}")
 
@@ -78,12 +107,24 @@ class EventWriters:
             sink.flush()
 
     def emit_slo(self, events: list[SLOEvent]) -> None:
+        if self._slo_channel is not None:
+            if events:
+                self._slo_channel.submit(
+                    "slo", self._slo_exporter.to_records(events)
+                )
+            return
         if self._slo_exporter is not None:
             self._slo_exporter.export_batch(events)
             return
         self._write_batch([{"kind": "slo", **event.to_dict()} for event in events])
 
     def emit_probe(self, events: list[ProbeEventV1]) -> None:
+        if self._probe_channel is not None:
+            if events:
+                self._probe_channel.submit(
+                    "probe", self._probe_exporter.to_records(events)
+                )
+            return
         if self._probe_exporter is not None:
             self._probe_exporter.export_batch(events)
             return
@@ -91,9 +132,36 @@ class EventWriters:
             [{"kind": "probe", **event.to_dict()} for event in events]
         )
 
+    @property
+    def delivery_channels(self) -> list[DeliveryChannel]:
+        return [c for c in (self._slo_channel, self._probe_channel) if c]
+
+    def flush(self, timeout_s: float = 10.0) -> bool:
+        """Drain delivery queues and flush the active local stream."""
+        ok = True
+        for channel in self.delivery_channels:
+            ok = channel.flush(timeout_s) and ok
+        with self._lock:
+            sink = self._jsonl if self._jsonl is not None else self._stream
+            if not sink.closed:
+                sink.flush()
+        return ok
+
     def close(self) -> None:
+        """Flush then release every sink; safe to call more than once."""
+        if self._closed:
+            return
+        self._closed = True
+        for channel in self.delivery_channels:
+            channel.close()
+        for exporter in (self._slo_exporter, self._probe_exporter):
+            if exporter is not None:
+                exporter.close()
         if self._jsonl is not None:
+            self._jsonl.flush()
             self._jsonl.close()
+        elif self._stream is not sys.stdout and not self._stream.closed:
+            self._stream.flush()
 
 
 def resolve_config(path: str) -> ToolkitConfig:

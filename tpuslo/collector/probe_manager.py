@@ -156,6 +156,7 @@ class ProbeManager:
         self._lib = None
         self._pm = None
         self._attached: dict[str, str] = {}  # signal -> object handle name
+        self._shed: list[str] = []  # guard-shed signals, shed order
 
     # ---- availability ------------------------------------------------
 
@@ -414,7 +415,32 @@ class ProbeManager:
         for candidate in self._disable_order:
             if candidate in self._attached:
                 self.detach_signal(candidate)
+                self._shed.append(candidate)
                 return candidate
+        return None
+
+    @property
+    def shed_signals(self) -> list[str]:
+        """Guard-shed signals awaiting restore, in shed order."""
+        return list(self._shed)
+
+    def restore_one(self) -> str | None:
+        """Re-attach the most recently shed signal (reverse cost order).
+
+        A failed re-attach (symbols vanished, privileges dropped) keeps
+        the signal on the shed list so a later recovery window retries
+        it; returns the restored signal or None.
+        """
+        while self._shed:
+            signal = self._shed[-1]
+            if signal in self._attached:
+                self._shed.pop()  # already back (external attach)
+                continue
+            report = self.attach_all([signal])
+            if signal in report.attached_signals:
+                self._shed.pop()
+                return signal
+            return None
         return None
 
     def check_overhead(self) -> str | None:

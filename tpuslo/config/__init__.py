@@ -1,5 +1,6 @@
 from tpuslo.config.toolkitcfg import (
     CDGateConfig,
+    DeliveryConfig,
     CorrelationConfig,
     OTLPConfig,
     SafetyConfig,
@@ -13,6 +14,7 @@ from tpuslo.config.toolkitcfg import (
 
 __all__ = [
     "CDGateConfig",
+    "DeliveryConfig",
     "CorrelationConfig",
     "OTLPConfig",
     "SafetyConfig",

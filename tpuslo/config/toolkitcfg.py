@@ -79,6 +79,22 @@ class CDGateConfig:
 
 
 @dataclass
+class DeliveryConfig:
+    """Resilient-delivery knobs; ``spool_dir`` enables the subsystem."""
+
+    spool_dir: str = ""
+    queue_max: int = 512
+    max_attempts: int = 4
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    breaker_failure_threshold: int = 5
+    breaker_open_duration_s: float = 10.0
+    spool_max_bytes: int = 64 * 1024 * 1024
+    spool_max_age_s: float = 24 * 3600.0
+    restore_after_cycles: int = 30
+
+
+@dataclass
 class TPUConfig:
     enabled: bool = True
     libtpu_path: str = ""
@@ -98,6 +114,7 @@ class ToolkitConfig:
     safety: SafetyConfig = field(default_factory=SafetyConfig)
     webhook: WebhookConfig = field(default_factory=WebhookConfig)
     cdgate: CDGateConfig = field(default_factory=CDGateConfig)
+    delivery: DeliveryConfig = field(default_factory=DeliveryConfig)
     tpu: TPUConfig = field(default_factory=TPUConfig)
 
     def to_dict(self) -> dict[str, Any]:
@@ -129,6 +146,20 @@ class ToolkitConfig:
                 "error_rate": self.cdgate.error_rate,
                 "burn_rate": self.cdgate.burn_rate,
                 "fail_open": self.cdgate.fail_open,
+            },
+            "delivery": {
+                "spool_dir": self.delivery.spool_dir,
+                "queue_max": self.delivery.queue_max,
+                "max_attempts": self.delivery.max_attempts,
+                "base_delay_s": self.delivery.base_delay_s,
+                "max_delay_s": self.delivery.max_delay_s,
+                "breaker_failure_threshold":
+                    self.delivery.breaker_failure_threshold,
+                "breaker_open_duration_s":
+                    self.delivery.breaker_open_duration_s,
+                "spool_max_bytes": self.delivery.spool_max_bytes,
+                "spool_max_age_s": self.delivery.spool_max_age_s,
+                "restore_after_cycles": self.delivery.restore_after_cycles,
             },
             "tpu": {
                 "enabled": self.tpu.enabled,
@@ -203,6 +234,22 @@ def load_config(path: str) -> ToolkitConfig:
             "error_rate": float,
             "burn_rate": float,
             "fail_open": bool,
+        },
+    )
+    _merge_section(
+        cfg.delivery,
+        raw.get("delivery") or {},
+        {
+            "spool_dir": str,
+            "queue_max": int,
+            "max_attempts": int,
+            "base_delay_s": float,
+            "max_delay_s": float,
+            "breaker_failure_threshold": int,
+            "breaker_open_duration_s": float,
+            "spool_max_bytes": int,
+            "spool_max_age_s": float,
+            "restore_after_cycles": int,
         },
     )
     _merge_section(

@@ -1,0 +1,41 @@
+"""L6 resilient delivery: queue + spool + breaker + replay per sink.
+
+Telemetry must survive exactly the incidents it attributes: every
+network sink (OTLP logs, incident webhook) routes through a
+:class:`DeliveryChannel` so a collector outage degrades to disk
+spooling instead of dropped evidence, and recovery replays the outage
+window.  :mod:`tpuslo.delivery.faultsink` is the matching chaos
+harness.
+"""
+
+from tpuslo.delivery.breaker import (
+    STATE_CLOSED,
+    STATE_HALF_OPEN,
+    STATE_OPEN,
+    STATE_VALUES,
+    CircuitBreaker,
+)
+from tpuslo.delivery.channel import (
+    DeliveryChannel,
+    DeliveryObserver,
+    Sink,
+    SinkError,
+    full_jitter_delay,
+)
+from tpuslo.delivery.options import DeliveryOptions
+from tpuslo.delivery.spool import DiskSpool
+
+__all__ = [
+    "STATE_CLOSED",
+    "STATE_HALF_OPEN",
+    "STATE_OPEN",
+    "STATE_VALUES",
+    "CircuitBreaker",
+    "DeliveryChannel",
+    "DeliveryObserver",
+    "DeliveryOptions",
+    "DiskSpool",
+    "Sink",
+    "SinkError",
+    "full_jitter_delay",
+]

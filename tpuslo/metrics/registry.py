@@ -109,6 +109,74 @@ class AgentMetrics:
             ["outcome"],
             registry=self.registry,
         )
+        # ---- resilient-delivery series (tpuslo.delivery) -------------
+        self.delivery_queue_depth = Gauge(
+            "llm_slo_agent_delivery_queue_depth",
+            "Batches queued in memory for a sink (incl. in-flight)",
+            ["sink"],
+            registry=self.registry,
+        )
+        self.delivery_spool_bytes = Gauge(
+            "llm_slo_agent_delivery_spool_bytes",
+            "Bytes spooled to disk awaiting replay, per sink",
+            ["sink"],
+            registry=self.registry,
+        )
+        self.delivery_breaker_state = Gauge(
+            "llm_slo_agent_delivery_breaker_state",
+            "Circuit-breaker state per sink (0=closed 1=half-open 2=open)",
+            ["sink"],
+            registry=self.registry,
+        )
+        self.delivery_breaker_transitions = Counter(
+            "llm_slo_agent_delivery_breaker_transitions_total",
+            "Circuit-breaker state transitions per sink, by entered state",
+            ["sink", "state"],
+            registry=self.registry,
+        )
+        self.delivery_delivered = Counter(
+            "llm_slo_agent_delivery_delivered_events_total",
+            "Events delivered to a sink (live + replayed)",
+            ["sink"],
+            registry=self.registry,
+        )
+        self.delivery_retries = Counter(
+            "llm_slo_agent_delivery_retries_total",
+            "Sink send retries",
+            ["sink"],
+            registry=self.registry,
+        )
+        self.delivery_spooled = Counter(
+            "llm_slo_agent_delivery_spooled_events_total",
+            "Events written to the disk spool (not drops: replay pending)",
+            ["sink"],
+            registry=self.registry,
+        )
+        self.delivery_replayed = Counter(
+            "llm_slo_agent_delivery_replayed_events_total",
+            "Spooled events successfully replayed to a sink",
+            ["sink"],
+            registry=self.registry,
+        )
+        self.delivery_dead_letters = Counter(
+            "llm_slo_agent_delivery_dead_letter_events_total",
+            "Events written to the dead-letter file, by reason class",
+            ["sink", "reason"],
+            registry=self.registry,
+        )
+        self.delivery_truncated = Counter(
+            "llm_slo_agent_delivery_spool_truncated_batches_total",
+            "Spooled batches evicted by the size/age caps (lost evidence)",
+            ["sink"],
+            registry=self.registry,
+        )
+        self.signals_restored = Counter(
+            "llm_slo_agent_signals_restored_total",
+            "Shed probe signals re-enabled after sustained under-budget "
+            "guard cycles",
+            ["signal"],
+            registry=self.registry,
+        )
 
     def set_enabled_signals(self, enabled: list[str]) -> None:
         enabled_set = set(enabled)
@@ -130,6 +198,60 @@ class AgentMetrics:
 
     def mark_cycle(self) -> None:
         self.heartbeat.set(time.time())
+
+    def delivery_observer(self, sink: str) -> "_PromDeliveryObserver":
+        """Observer adapter wiring one DeliveryChannel to this registry
+        (duck-typed against tpuslo.delivery.DeliveryObserver)."""
+        return _PromDeliveryObserver(self, sink)
+
+
+_BREAKER_STATE_VALUES = {"closed": 0, "half_open": 1, "open": 2}
+
+
+class _PromDeliveryObserver:
+    """Per-sink bridge from delivery-channel callbacks to Prometheus."""
+
+    def __init__(self, metrics: AgentMetrics, sink: str):
+        self._m = metrics
+        self._sink = sink
+        # Touch the per-sink series so dashboards see explicit zeros.
+        metrics.delivery_queue_depth.labels(sink=sink).set(0)
+        metrics.delivery_spool_bytes.labels(sink=sink).set(0)
+        metrics.delivery_breaker_state.labels(sink=sink).set(0)
+
+    def queue_depth(self, depth: int) -> None:
+        self._m.delivery_queue_depth.labels(sink=self._sink).set(depth)
+
+    def spool_bytes(self, n: int) -> None:
+        self._m.delivery_spool_bytes.labels(sink=self._sink).set(n)
+
+    def breaker_state(self, state: str) -> None:
+        self._m.delivery_breaker_state.labels(sink=self._sink).set(
+            _BREAKER_STATE_VALUES.get(state, 2)
+        )
+        self._m.delivery_breaker_transitions.labels(
+            sink=self._sink, state=state
+        ).inc()
+
+    def delivered(self, kind: str, events: int) -> None:
+        self._m.delivery_delivered.labels(sink=self._sink).inc(events)
+
+    def retried(self, events: int) -> None:
+        self._m.delivery_retries.labels(sink=self._sink).inc()
+
+    def spooled(self, kind: str, events: int) -> None:
+        self._m.delivery_spooled.labels(sink=self._sink).inc(events)
+
+    def replayed(self, events: int) -> None:
+        self._m.delivery_replayed.labels(sink=self._sink).inc(events)
+
+    def dead_lettered(self, kind: str, events: int, reason: str) -> None:
+        self._m.delivery_dead_letters.labels(
+            sink=self._sink, reason=reason
+        ).inc(events)
+
+    def truncated(self, batches: int) -> None:
+        self._m.delivery_truncated.labels(sink=self._sink).inc(batches)
 
 
 def start_metrics_server(

@@ -9,6 +9,7 @@ and (TPU-native) accelerator-identity attributes.
 
 from __future__ import annotations
 
+import http.client
 import json
 import time
 import urllib.error
@@ -21,7 +22,12 @@ DEFAULT_TIMEOUT_S = 5.0
 
 
 class ExportError(RuntimeError):
-    pass
+    """OTLP export failure; ``retryable`` feeds the delivery layer's
+    retry / dead-letter verdict (4xx = poison payload, never retried)."""
+
+    def __init__(self, message: str, retryable: bool = True):
+        super().__init__(message)
+        self.retryable = retryable
 
 
 def _str_attr(key: str, value: str) -> dict:
@@ -61,7 +67,7 @@ class _BaseExporter:
         if not records:
             return
         if not self.endpoint:
-            raise ExportError("otlp endpoint is required")
+            raise ExportError("otlp endpoint is required", retryable=False)
         payload = {
             "resourceLogs": [
                 {
@@ -89,9 +95,36 @@ class _BaseExporter:
                 if not 200 <= resp.status < 300:
                     raise ExportError(f"otlp endpoint returned status {resp.status}")
         except urllib.error.HTTPError as exc:
-            raise ExportError(f"otlp endpoint returned status {exc.code}") from exc
+            # 4xx = the payload itself is rejected; resending the same
+            # bytes can never succeed, so mark it non-retryable — except
+            # 429/408, which the OTLP/HTTP spec defines as retryable
+            # (rate limiting / request timeout, not poison).
+            raise ExportError(
+                f"otlp endpoint returned status {exc.code}",
+                retryable=(
+                    not 400 <= exc.code < 500 or exc.code in (408, 429)
+                ),
+            ) from exc
+        except TimeoutError as exc:
+            raise ExportError(
+                f"otlp post timed out after {self.timeout_s:.1f}s"
+            ) from exc
         except urllib.error.URLError as exc:
             raise ExportError(f"otlp post failed: {exc.reason}") from exc
+        except (http.client.HTTPException, OSError) as exc:
+            # e.g. BadStatusLine / RemoteDisconnected when the endpoint
+            # drops the connection mid-exchange: an outage, not poison.
+            raise ExportError(f"otlp post failed: {exc!r}") from exc
+
+    def post_records(self, records: list[dict]) -> None:
+        """Ship pre-built OTLP log records (the delivery-channel path:
+        records are built at submit time, spooled as plain JSON, and
+        posted verbatim on delivery/replay)."""
+        self._post(records)
+
+    def close(self) -> None:
+        """Stateless HTTP exporter: nothing pending, present for the
+        EventWriters close contract."""
 
 
 class SLOEventExporter(_BaseExporter):
@@ -101,11 +134,14 @@ class SLOEventExporter(_BaseExporter):
                  scope_name: str = "tpuslo/collector", timeout_s: float = DEFAULT_TIMEOUT_S):
         super().__init__(endpoint, service_name, scope_name, timeout_s)
 
-    def export_batch(self, events: list[SLOEvent]) -> None:
+    def to_records(self, events: list[SLOEvent]) -> list[dict]:
         # One observation timestamp per batch: the whole batch is
         # observed by this call, and it keeps the hot loop clock-free.
         now_ns = time.time_ns()
-        self._post([self._record(e, now_ns) for e in events])
+        return [self._record(e, now_ns) for e in events]
+
+    def export_batch(self, events: list[SLOEvent]) -> None:
+        self._post(self.to_records(events))
 
     def _record(self, event: SLOEvent, now_ns: int | None = None) -> dict:
         now_ns = now_ns if now_ns is not None else time.time_ns()
@@ -147,9 +183,12 @@ class ProbeEventExporter(_BaseExporter):
                  scope_name: str = "tpuslo/agent", timeout_s: float = DEFAULT_TIMEOUT_S):
         super().__init__(endpoint, service_name, scope_name, timeout_s)
 
-    def export_batch(self, events: list[ProbeEventV1]) -> None:
+    def to_records(self, events: list[ProbeEventV1]) -> list[dict]:
         now_ns = time.time_ns()
-        self._post([self._record(e, now_ns) for e in events])
+        return [self._record(e, now_ns) for e in events]
+
+    def export_batch(self, events: list[ProbeEventV1]) -> None:
+        self._post(self.to_records(events))
 
     def _record(self, event: ProbeEventV1, now_ns: int | None = None) -> dict:
         now_ns = now_ns if now_ns is not None else time.time_ns()

@@ -1,4 +1,4 @@
-"""L3 safety governor: overhead guard + rate limiter."""
+"""L3 safety governor: overhead guard + rate limiter + shed recovery."""
 
 from tpuslo.safety.overhead_guard import (
     CPUSample,
@@ -8,6 +8,7 @@ from tpuslo.safety.overhead_guard import (
     ProcCPUSampler,
 )
 from tpuslo.safety.rate_limiter import RateLimiter
+from tpuslo.safety.recovery import ShedRecoveryPolicy
 
 __all__ = [
     "CPUSample",
@@ -16,4 +17,5 @@ __all__ = [
     "OverheadResult",
     "ProcCPUSampler",
     "RateLimiter",
+    "ShedRecoveryPolicy",
 ]

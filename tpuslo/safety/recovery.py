@@ -1,0 +1,55 @@
+"""Shed recovery: makes overhead-driven degradation two-way.
+
+The overhead guard sheds probes when the agent busts its CPU budget,
+but the reference design never re-enables them — one transient spike
+permanently blinds the costliest signals.  This policy watches guard
+results and, after N *consecutive* cycles comfortably under budget
+(budget × headroom_factor, so recovery doesn't flap against the shed
+threshold), authorizes re-enabling one shed signal.  Callers restore in
+reverse shed order (cheapest first) and the streak restarts after every
+restore, ramping probes back one at a time.
+"""
+
+from __future__ import annotations
+
+from tpuslo.safety.overhead_guard import OverheadResult
+
+
+class ShedRecoveryPolicy:
+    """Counts consecutive under-budget guard cycles with hysteresis."""
+
+    def __init__(self, cycles: int = 30, headroom_factor: float = 0.8):
+        if cycles < 1:
+            raise ValueError("cycles must be >= 1")
+        if not 0 < headroom_factor <= 1:
+            raise ValueError("headroom_factor must be in (0, 1]")
+        self.cycles = cycles
+        self.headroom_factor = headroom_factor
+        self._streak = 0
+
+    @property
+    def streak(self) -> int:
+        return self._streak
+
+    def reset(self) -> None:
+        self._streak = 0
+
+    def note(self, result: OverheadResult) -> bool:
+        """Feed one guard evaluation; True authorizes one restore.
+
+        Invalid samples (first cycle, counter resets) neither extend
+        nor break the streak — they carry no overhead signal.
+        """
+        if not result.valid:
+            return False
+        if (
+            result.over_budget
+            or result.cpu_pct > result.budget_pct * self.headroom_factor
+        ):
+            self._streak = 0
+            return False
+        self._streak += 1
+        if self._streak >= self.cycles:
+            self._streak = 0
+            return True
+        return False

@@ -273,6 +273,7 @@ class Generator:
         self._enricher = enricher
         self._lock = threading.Lock()
         self._enabled: set[str] = set()
+        self._shed: list[str] = []  # guard-shed signals, shed order
         self.set_signals(signal_set or [])
 
     @property
@@ -285,6 +286,7 @@ class Generator:
         requested = set(signal_set)
         with self._lock:
             self._enabled = (requested & allowed) if requested else allowed
+            self._shed.clear()  # a new set supersedes shed history
 
     def enabled_signals(self) -> list[str]:
         with self._lock:
@@ -303,7 +305,26 @@ class Generator:
             for candidate in sig.HIGH_COST_DISABLE_ORDER:
                 if candidate in self._enabled:
                     self._enabled.discard(candidate)
+                    self._shed.append(candidate)
                     return candidate
+        return None
+
+    def shed_signals(self) -> list[str]:
+        """Guard-shed signals awaiting restore, in shed order."""
+        with self._lock:
+            return list(self._shed)
+
+    def restore_one(self) -> str | None:
+        """Re-enable the most recently shed signal (reverse cost order:
+        the cheapest still-shed probe comes back first).  Degradation is
+        no longer one-way — see tpuslo.safety.ShedRecoveryPolicy."""
+        with self._lock:
+            while self._shed:
+                signal = self._shed.pop()
+                if signal in self._enabled:
+                    continue  # re-enabled out of band (set_signals race)
+                self._enabled.add(signal)
+                return signal
         return None
 
     def generate(self, sample: RawSample, meta: Metadata) -> list[ProbeEventV1]:
