@@ -7,7 +7,8 @@ ARTIFACTS ?= artifacts
 
 .PHONY: all test test-fast native ebpf lint schema-validate \
 	correlation-gate fault-smoke replay-smoke ebpf-smoke bench \
-	bench-smoke chaos-smoke chaos-demo m5-candidate m5-gate helm-lint \
+	bench-smoke chaos-smoke chaos-demo chaos-telemetry-smoke \
+	chaos-telemetry-sweep m5-candidate m5-gate helm-lint \
 	dashboards clean
 
 all: native test
@@ -107,6 +108,23 @@ bench-smoke:
 # `-m 'not slow'` lane never runs them implicitly.
 chaos-smoke:
 	$(PY) -m pytest tests/ -q -m chaos
+
+# Source-side telemetry chaos (PR 2 broke the sink; this breaks the
+# SOURCE): seeded low-intensity chaos sweep through the ingest gate —
+# skew correction, dedup, quarantine, watermark — under the same
+# `chaos` pytest marker (also slow, so tier-1 never runs it
+# implicitly).  See docs/runbooks/telemetry-quality.md.
+chaos-telemetry-smoke:
+	$(PY) -m pytest tests/test_chaos_telemetry.py -q -m chaos
+
+# Full chaos-sweep release gate: macro-F1 vs chaos intensity, ingest
+# gate on vs off; fails unless degradation is graceful (moderate chaos
+# within 5% of the clean baseline, gated strictly above ungated).
+chaos-telemetry-sweep:
+	mkdir -p $(ARTIFACTS)/chaos-telemetry
+	$(PY) -m tpuslo m5gate --chaos-sweep \
+		--summary-json $(ARTIFACTS)/chaos-telemetry/sweep.json \
+		--summary-md $(ARTIFACTS)/chaos-telemetry/sweep.md
 
 # Watchable version of the same story: collector dies mid-run, the
 # agent spools, the breaker trips, recovery replays the outage window

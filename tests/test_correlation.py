@@ -99,11 +99,78 @@ class TestMatchTiers:
         d = correlation.match(span(trace_id="t"), sigref(trace_id="t", offset_ms=2500))
         assert not d.matched
 
-    def test_missing_timestamps_no_match(self):
+    def test_missing_timestamp_trace_join_capped(self):
+        # Exact trace identity survives a missing timestamp, but the
+        # un-anchored join must stay below the enrichment threshold —
+        # it can never report the windowed tier's full 1.0.
         d = correlation.match(
             span(trace_id="t"), correlation.SignalRef(trace_id="t")
         )
+        assert d.matched
+        assert d.tier == correlation.TIER_TRACE_ID
+        assert d.confidence == correlation.MISSING_TS_CONFIDENCE
+        assert d.confidence < correlation.DEFAULT_ENRICHMENT_THRESHOLD
+
+    def test_missing_timestamp_non_trace_no_match(self):
+        d = correlation.match(
+            span(pod="p", pid=3),
+            correlation.SignalRef(pod="p", pid=3),
+        )
         assert not d.matched
+
+    def test_unparseable_timestamp_counted_not_crashed(self):
+        from tpuslo.metrics import REJECTION_COUNTERS
+
+        REJECTION_COUNTERS.reset()
+        ref = correlation.SignalRef.from_dict(
+            {"signal": "dns_latency_ms", "timestamp": "not-a-time"}
+        )
+        assert ref.timestamp is None
+        ref = correlation.SignalRef.from_dict(
+            {"signal": "dns_latency_ms", "timestamp": 12345}
+        )
+        assert ref.timestamp is None
+        snap = REJECTION_COUNTERS.snapshot("matcher")
+        assert snap == {
+            "matcher.unparseable_timestamp": 1,
+            "matcher.bad_timestamp_type": 1,
+        }
+        REJECTION_COUNTERS.reset()
+
+    def test_signal_ref_from_probe_dict(self):
+        ref = correlation.SignalRef.from_probe_dict(
+            {
+                "ts_unix_nano": 1_700_000_000_000_000_000,
+                "signal": "ici_collective_latency_ms",
+                "node": "host-1",
+                "pod": "p",
+                "pid": 4,
+                "value": 7.5,
+                "trace_id": "t",
+                "tpu": {
+                    "slice_id": "s0",
+                    "host_index": 1,
+                    "program_id": "pg",
+                    "launch_id": 9,
+                },
+            }
+        )
+        assert ref.timestamp is not None
+        assert (ref.slice_id, ref.host_index, ref.launch_id) == ("s0", 1, 9)
+        # Corrupt fields degrade to the missing-timestamp path.
+        ref = correlation.SignalRef.from_probe_dict(
+            {"ts_unix_nano": "soon", "signal": "dns_latency_ms"}
+        )
+        assert ref.timestamp is None
+
+    def test_missing_timestamp_never_enriches(self):
+        attrs, decision = correlation.enrich_dns(
+            {}, span(trace_id="t"), correlation.SignalRef(
+                signal="dns_latency_ms", trace_id="t", value=120.0
+            )
+        )
+        assert decision.matched
+        assert semconv.ATTR_DNS_LATENCY_MS not in attrs
 
 
 class TestEnrichDNS:

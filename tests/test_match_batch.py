@@ -8,10 +8,12 @@ first (lowest-index) signal on ties — including the inclusive /
 exclusive window edges at 100, 250 and 500 ms and the global window.
 """
 
+import dataclasses
 import random
 from datetime import datetime, timedelta, timezone
 
 from tpuslo.correlation.matcher import (
+    MISSING_TS_CONFIDENCE,
     Decision,
     SignalRef,
     SpanRef,
@@ -164,8 +166,76 @@ class TestTierParity:
         no_ts_sig = SignalRef(trace_id="t")
         assert_parity([no_ts_span], [sigref(trace_id="t")])
         assert_parity([span(trace_id="t")], [no_ts_sig])
+        # Trace identity joins across a missing timestamp — at the
+        # capped confidence, never the windowed tier's 1.0.
         results = match_batch([span(trace_id="t")], [no_ts_sig])
-        assert results[0].signal_index == -1
+        assert results[0].signal_index == 0
+        assert results[0].decision.confidence == MISSING_TS_CONFIDENCE
+        # A span with no timestamp joins the earliest trace-matching
+        # signal, also capped.
+        results = match_batch(
+            [no_ts_span], [sigref(pod="x"), sigref(trace_id="t")]
+        )
+        assert results[0].signal_index == 1
+        assert results[0].decision.confidence == MISSING_TS_CONFIDENCE
+        # A windowed lower-tier match beats the capped trace fallback.
+        results = match_batch(
+            [span(trace_id="t", pod="p", pid=3)],
+            [no_ts_sig, sigref(pod="p", pid=3, offset_ms=10)],
+        )
+        assert results[0].signal_index == 1
+        assert results[0].decision.tier == "pod_pid_100ms"
+        assert_parity(
+            [span(trace_id="t", pod="p", pid=3)],
+            [no_ts_sig, sigref(pod="p", pid=3, offset_ms=10)],
+        )
+
+    def test_duplicate_signals_keep_parity_and_first_index(self):
+        # At-least-once delivery: exact duplicates in the signal batch
+        # must not change any span's decision, and ties resolve to the
+        # earliest copy, exactly like a pairwise first-maximum scan.
+        sp = span(trace_id="t", pod="p", pid=3)
+        base = [
+            sigref(trace_id="t", offset_ms=5),
+            sigref(pod="p", pid=3, offset_ms=10),
+        ]
+        duplicated = base + [dataclasses.replace(s) for s in base] + base
+        results = match_batch([sp], duplicated)
+        assert results[0].signal_index == 0
+        assert results[0].decision.tier == "trace_id_exact"
+        assert_parity([sp], duplicated)
+
+    def test_reordered_signals_keep_parity(self):
+        # Arrival order must not matter: shuffles of one signal batch
+        # all agree with pairwise match on every span's confidence and
+        # tier (the winning index follows the permuted position of the
+        # same best candidate set).
+        rng = random.Random(42)
+        spans = [
+            span(
+                trace_id=f"t-{i}",
+                pod="p",
+                pid=i + 1,
+                timestamp=TS + timedelta(milliseconds=i * 7),
+            )
+            for i in range(12)
+        ]
+        sigs = [
+            sigref(trace_id=f"t-{i}", offset_ms=i * 7 + 3)
+            for i in range(12)
+        ] + [
+            sigref(pod="p", pid=i + 1, offset_ms=i * 7 + 60)
+            for i in range(12)
+        ]
+        baseline = {
+            r.span_index: r.decision for r in match_batch(spans, sigs)
+        }
+        for _ in range(5):
+            shuffled = list(sigs)
+            rng.shuffle(shuffled)
+            assert_parity(spans, shuffled)
+            for result in match_batch(spans, shuffled):
+                assert result.decision == baseline[result.span_index]
 
     def test_empty_identity_never_joins(self):
         # Empty strings / sentinel ints must not form index keys that

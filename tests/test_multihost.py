@@ -277,6 +277,84 @@ class TestStragglerAttribution:
         assert confs == sorted(confs, reverse=True)
 
 
+class TestIngestRobustness:
+    def test_incident_stability_under_shuffled_add_order(self):
+        # Launch-group joins are exact identity, so the attributed
+        # incident set must be invariant to arbitrary interleavings of
+        # the per-host streams (the DaemonSet gives no ordering
+        # guarantee whatsoever).
+        import random
+
+        streams = synthesize_slice_streams(
+            n_hosts=4, n_launches=8, straggler_host=1,
+            straggler_delay_ms=45.0, ici_link=3,
+            link_retries_per_launch=4.0,
+        )
+        flat = [event for stream in streams for event in stream]
+        reference = SliceJoiner(expected_hosts=4)
+        reference.add_all(flat)
+        expected = [i.to_dict() for i in reference.incidents()]
+        assert expected, "scenario must attribute something"
+
+        rng = random.Random(7)
+        for _ in range(5):
+            shuffled = list(flat)
+            rng.shuffle(shuffled)
+            joiner = SliceJoiner(expected_hosts=4)
+            joiner.add_all(shuffled)
+            assert [i.to_dict() for i in joiner.incidents()] == expected
+
+    def test_skips_are_reason_classed(self):
+        from tpuslo.correlation.multihost import (
+            SKIP_BAD_FIELD_TYPE,
+            SKIP_MISSING_LAUNCH_ID,
+            SKIP_MISSING_SLICE_IDENTITY,
+            SKIP_UNMATCHED_SIGNAL,
+        )
+
+        joiner = SliceJoiner()
+        assert not joiner.add({"signal": "ici_collective_latency_ms"})
+        assert not joiner.add(
+            {
+                "signal": "ici_collective_latency_ms",
+                "tpu": {"slice_id": "s0", "host_index": 0},
+            }
+        )
+        assert not joiner.add(
+            {
+                "signal": "dns_latency_ms",
+                "tpu": {"slice_id": "s0", "host_index": 0},
+            }
+        )
+        assert not joiner.add(
+            {
+                "signal": "ici_collective_latency_ms",
+                "tpu": {"slice_id": "s0", "host_index": "corrupt"},
+            }
+        )
+        assert joiner.skipped == 4
+        assert joiner.skipped_by_reason == {
+            SKIP_MISSING_SLICE_IDENTITY: 1,
+            SKIP_MISSING_LAUNCH_ID: 1,
+            SKIP_UNMATCHED_SIGNAL: 1,
+            SKIP_BAD_FIELD_TYPE: 1,
+        }
+
+    def test_corrupt_value_does_not_abort_stream(self):
+        joiner = SliceJoiner()
+        bad = {
+            "signal": "ici_collective_latency_ms",
+            "value": {"nested": "dict"},
+            "tpu": {
+                "slice_id": "s0", "host_index": 0, "launch_id": 1,
+                "program_id": "p",
+            },
+        }
+        assert not joiner.add(bad)
+        good = dict(bad, value=5.0)
+        assert joiner.add(good)
+
+
 class TestSliceCorrCLI:
     def test_end_to_end_jsonl(self, tmp_path, capsys):
         from tpuslo.cli.slicecorr import main

@@ -9,7 +9,8 @@ faults (BASELINE.md).
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field, replace
+from typing import Any
 
 from tpuslo.attribution.bayesian import DOMAIN_UNKNOWN, BayesianAttributor
 from tpuslo.attribution.mapper import (
@@ -185,3 +186,332 @@ def macro_f1(
     macro = sum(s.f1 for s in scores) / len(scores) if scores else 0.0
     micro = correct / len(predictions) if predictions else 0.0
     return F1Report(per_domain=scores, macro_f1=macro, micro_accuracy=micro)
+
+
+# --- chaos-sweep evaluation ----------------------------------------------
+#
+# Measures graceful degradation of the source→correlation→attribution
+# path as a *gated property*: synthesize the per-host probe-event
+# stream a DaemonSet would emit for a replay scenario, corrupt it with
+# a seeded ChaosStream at increasing intensity, reconstruct per-
+# incident signal vectors from the surviving events, attribute, and
+# score macro-F1 — once through the TelemetryGate and once without it.
+# The pass bar: with the gate, moderate chaos costs at most
+# ``rel_tolerance`` of the clean baseline, and the gate strictly beats
+# the ungated path at every non-zero intensity.
+
+# Window for assigning a surviving event back to an incident by
+# (corrected) timestamp.  The pod_pid tier's 100 ms: per-step
+# attribution granularity (matcher.py's rationale for the tight
+# tiers).  Wider than residual skew after correction, narrower than
+# moderate chaos skew, so *uncorrected* clock skew is what mis-bins
+# evidence — exactly the ARGUS failure mode under test.
+CHAOS_ASSIGN_WINDOW_MS = 100
+
+
+def synthesize_probe_events(
+    samples: list[FaultSample],
+    hosts: int = 4,
+    slice_id: str = "slice-0",
+    program_id: str = "jit_sweep_step",
+) -> list[dict[str, Any]]:
+    """Per-host probe-event dicts for a replay scenario.
+
+    Mirrors what N DaemonSet agents on one slice would emit: every host
+    observes each collective launch (``ici_collective_latency_ms`` and
+    ``dcn_transfer_latency_ms`` carry the launch-group identity the
+    skew estimator needs), while each remaining signal of a sample's
+    fault profile is observed by exactly one host, round-robin — on a
+    multi-host pod the evidence for one incident is spread across
+    hosts' clocks, which is precisely why uncorrected skew mis-bins it.
+    """
+    from tpuslo.signals.constants import (
+        SIGNAL_DCN_TRANSFER_MS,
+        SIGNAL_ICI_COLLECTIVE_MS,
+        TPU_SIGNALS,
+    )
+    from tpuslo.signals.generator import SIGNAL_UNITS, signal_status
+
+    sync_signals = (SIGNAL_ICI_COLLECTIVE_MS, SIGNAL_DCN_TRANSFER_MS)
+    out: list[dict[str, Any]] = []
+    for launch_id, sample in enumerate(samples):
+        ts_ns = int(sample.timestamp.timestamp() * 1e9)
+        plain = [
+            (signal, value)
+            for signal, value in sorted(sample.signals.items())
+            if signal not in sync_signals
+        ]
+        for host in range(hosts):
+            for signal in sync_signals:
+                value = sample.signals.get(signal)
+                if value is None:
+                    continue
+                out.append(
+                    {
+                        "ts_unix_nano": ts_ns,
+                        "signal": signal,
+                        "node": f"host-{host}",
+                        "namespace": sample.namespace,
+                        "pod": f"{sample.service}-agent-{host}",
+                        "container": sample.service,
+                        "pid": 1,
+                        "tid": 1,
+                        "value": float(value),
+                        "unit": SIGNAL_UNITS[signal],
+                        "status": signal_status(signal, float(value)),
+                        "trace_id": sample.trace_id,
+                        "tpu": {
+                            "slice_id": slice_id,
+                            "host_index": host,
+                            "program_id": program_id,
+                            "launch_id": launch_id,
+                        },
+                    }
+                )
+        for position, (signal, value) in enumerate(plain):
+            host = (position + launch_id) % hosts
+            event: dict[str, Any] = {
+                "ts_unix_nano": ts_ns,
+                "signal": signal,
+                "node": f"host-{host}",
+                "namespace": sample.namespace,
+                "pod": f"{sample.service}-agent-{host}",
+                "container": sample.service,
+                "pid": 1,
+                "tid": 1,
+                "value": float(value),
+                "unit": SIGNAL_UNITS.get(signal, "ms"),
+                "status": signal_status(signal, float(value)),
+                "trace_id": sample.trace_id,
+            }
+            if signal in TPU_SIGNALS:
+                event["tpu"] = {
+                    "slice_id": slice_id,
+                    "host_index": host,
+                    "program_id": program_id,
+                    "launch_id": launch_id,
+                }
+            out.append(event)
+    return out
+
+
+def reconstruct_samples(
+    samples: list[FaultSample],
+    events: list[dict[str, Any]],
+    window_ms: int = CHAOS_ASSIGN_WINDOW_MS,
+) -> list[FaultSample]:
+    """Rebuild per-incident signal vectors from surviving events.
+
+    The consumer model is deliberately naive — it is the *ungated*
+    pipeline under evaluation, so it takes events at face value:
+    an event is assigned to the nearest incident within ``window_ms``
+    of its timestamp; count-unit signals accumulate (duplicates
+    double-count), everything else keeps the maximum; an unparseable
+    value coerces to 0.0 (observed-but-quiet, which testifies
+    *against* the true fault — the cost of not quarantining).
+    """
+    from tpuslo.signals.generator import SIGNAL_UNITS
+
+    from bisect import bisect_left
+
+    window_ns = window_ms * 1_000_000
+    # Bisect over the (sorted) incident timeline: nearest incident is
+    # one of the two neighbours of the insertion point.
+    order = sorted(
+        range(len(samples)),
+        key=lambda i: samples[i].timestamp,
+    )
+    sorted_ts = [
+        int(samples[i].timestamp.timestamp() * 1e9) for i in order
+    ]
+    rebuilt: list[dict[str, float]] = [{} for _ in samples]
+    for event in events:
+        ts = event.get("ts_unix_nano")
+        if type(ts) is not int or ts <= 0:
+            continue
+        pos = bisect_left(sorted_ts, ts)
+        best, best_delta = -1, window_ns + 1
+        for neighbour in (pos - 1, pos):
+            if 0 <= neighbour < len(sorted_ts):
+                delta = abs(ts - sorted_ts[neighbour])
+                if delta < best_delta:
+                    best, best_delta = order[neighbour], delta
+        if best < 0 or best_delta > window_ns:
+            continue
+        signal = event.get("signal")
+        if not isinstance(signal, str) or signal not in SIGNAL_UNITS:
+            continue
+        try:
+            value = float(event.get("value", 0.0))
+        except (TypeError, ValueError):
+            value = 0.0
+        signals = rebuilt[best]
+        if SIGNAL_UNITS[signal] == "count":
+            signals[signal] = signals.get(signal, 0.0) + value
+        else:
+            signals[signal] = max(signals.get(signal, 0.0), value)
+    return [
+        replace(sample, signals=signals)
+        for sample, signals in zip(samples, rebuilt)
+    ]
+
+
+@dataclass
+class ChaosSweepPoint:
+    """Macro-F1 at one chaos intensity, gated vs ungated."""
+
+    intensity: float
+    gated_macro_f1: float
+    ungated_macro_f1: float
+    gate_snapshot: dict[str, Any] = field(default_factory=dict)
+    chaos_snapshot: dict[str, int] = field(default_factory=dict)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "intensity": self.intensity,
+            "gated_macro_f1": round(self.gated_macro_f1, 4),
+            "ungated_macro_f1": round(self.ungated_macro_f1, 4),
+            "gate": self.gate_snapshot,
+            "chaos": self.chaos_snapshot,
+        }
+
+
+@dataclass
+class ChaosSweepReport:
+    """Gate verdict over a full intensity sweep."""
+
+    scenario: str
+    count: int
+    seed: int
+    hosts: int
+    baseline_macro_f1: float
+    rel_tolerance: float
+    moderate_intensity: float
+    points: list[ChaosSweepPoint] = field(default_factory=list)
+    failures: list[str] = field(default_factory=list)
+
+    @property
+    def passed(self) -> bool:
+        return not self.failures
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "scenario": self.scenario,
+            "count": self.count,
+            "seed": self.seed,
+            "hosts": self.hosts,
+            "baseline_macro_f1": round(self.baseline_macro_f1, 4),
+            "rel_tolerance": self.rel_tolerance,
+            "moderate_intensity": self.moderate_intensity,
+            "points": [p.to_dict() for p in self.points],
+            "passed": self.passed,
+            "failures": list(self.failures),
+        }
+
+
+def run_chaos_sweep(
+    scenario: str = "tpu_mixed",
+    count: int = 60,
+    seed: int = 1337,
+    intensities: tuple[float, ...] = (0.0, 0.5, 1.0, 2.0),
+    hosts: int = 4,
+    rel_tolerance: float = 0.05,
+    moderate_intensity: float = 1.0,
+    dedup_window: int = 8192,
+    watermark_lateness_ms: int = 2000,
+) -> ChaosSweepReport:
+    """Sweep chaos intensities; score gated vs ungated macro-F1.
+
+    Fully deterministic for a given ``seed``: the fault-sample stream,
+    the chaos perturbations and the attributor are all seeded or
+    deterministic, so the report is reproducible evidence, not a
+    flake.
+    """
+    from datetime import datetime, timezone
+
+    from tpuslo.faultreplay import generate_fault_samples
+    from tpuslo.ingest import GateConfig, TelemetryGate
+
+    start = datetime(2026, 1, 1, tzinfo=timezone.utc)
+    samples = generate_fault_samples(scenario, count, start)
+    clean_events = synthesize_probe_events(samples, hosts=hosts)
+    attributor = BayesianAttributor()
+
+    def score(events: list[dict[str, Any]]) -> float:
+        rebuilt = reconstruct_samples(samples, events)
+        predictions = attributor.attribute_batch(rebuilt)
+        return macro_f1(samples, predictions).macro_f1
+
+    baseline = score(clean_events)
+    report = ChaosSweepReport(
+        scenario=scenario,
+        count=count,
+        seed=seed,
+        hosts=hosts,
+        baseline_macro_f1=baseline,
+        rel_tolerance=rel_tolerance,
+        moderate_intensity=moderate_intensity,
+    )
+
+    from tpuslo.chaos.telemetry import ChaosScenario, ChaosStream
+
+    for intensity in intensities:
+        chaos_cfg = ChaosScenario.at_intensity(intensity, seed=seed)
+        # One perturbation pass; gated and ungated score the identical
+        # stream, so the comparison isolates the gate.
+        chaos = ChaosStream(chaos_cfg)
+        chaotic = list(chaos.stream(clean_events))
+
+        gate = TelemetryGate(
+            GateConfig(
+                dedup_window=dedup_window,
+                watermark_lateness_ms=watermark_lateness_ms,
+            )
+        )
+        batch = gate.admit_all(chaotic)
+        gated_f1 = score(batch.all_events())
+        ungated_f1 = score(chaotic)
+        report.points.append(
+            ChaosSweepPoint(
+                intensity=intensity,
+                gated_macro_f1=gated_f1,
+                ungated_macro_f1=ungated_f1,
+                gate_snapshot=gate.snapshot(),
+                chaos_snapshot=chaos.snapshot(),
+            )
+        )
+
+    floor = baseline * (1.0 - rel_tolerance)
+    for point in report.points:
+        if point.intensity == 0.0:
+            continue
+        if point.gated_macro_f1 < point.ungated_macro_f1:
+            report.failures.append(
+                f"intensity {point.intensity:g}: gated macro-F1 "
+                f"{point.gated_macro_f1:.4f} worse than ungated "
+                f"{point.ungated_macro_f1:.4f}"
+            )
+        elif (
+            point.ungated_macro_f1 < floor
+            and point.gated_macro_f1 <= point.ungated_macro_f1
+        ):
+            # Wherever chaos actually hurt the ungated path, the gate
+            # must strictly beat it; at intensities too gentle to
+            # degrade anything, a tie at the ceiling is the best
+            # possible outcome, not a failure.
+            report.failures.append(
+                f"intensity {point.intensity:g}: gated macro-F1 "
+                f"{point.gated_macro_f1:.4f} not strictly better than "
+                f"degraded ungated {point.ungated_macro_f1:.4f}"
+            )
+        if (
+            point.intensity <= moderate_intensity
+            and point.gated_macro_f1 < floor
+        ):
+            report.failures.append(
+                f"intensity {point.intensity:g}: gated macro-F1 "
+                f"{point.gated_macro_f1:.4f} below "
+                f"{100 * (1 - rel_tolerance):.0f}% of the no-chaos "
+                f"baseline {baseline:.4f}"
+            )
+    return report

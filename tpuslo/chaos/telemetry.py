@@ -1,0 +1,226 @@
+"""Source-side telemetry chaos: scripted corruption of probe streams.
+
+The delivery chaos harness (PR 2, ``delivery/faultsink.py``) breaks the
+*sink*; this module breaks the *source* — it perturbs the probe-event
+stream itself the way real DaemonSet telemetry breaks: per-host clock
+skew (constant plus drift), reordering in flight, duplicate delivery,
+field corruption, and outright drops.  Every perturbation is driven by
+one seeded ``random.Random``, so a scenario replays bit-identically —
+the chaos sweep (``tpuslo m5gate --chaos-sweep``) and the unit tests
+depend on that determinism.
+
+Corruption is always **schema-breaking** (a string value, a negative
+timestamp, a bogus status, a missing required field): schema-*valid*
+poison is indistinguishable from real telemetry by construction and
+belongs to the attribution robustness story, not the ingest gate's.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, replace
+from typing import Any, Iterable, Iterator
+
+# Moderate chaos per the acceptance bar: skew <= 250 ms, 5% dup,
+# 5% reorder, 1% corrupt (intensity 1.0 scales exactly to this).
+MODERATE_SKEW_MS = 250.0
+MODERATE_DRIFT_MS_PER_S = 2.0
+MODERATE_DUP_RATE = 0.05
+MODERATE_REORDER_RATE = 0.05
+MODERATE_CORRUPT_RATE = 0.01
+MODERATE_DROP_RATE = 0.01
+
+_CORRUPT_MODES = (
+    "string_value",
+    "negative_ts",
+    "bogus_status",
+    "drop_required_field",
+    "float_pid",
+)
+
+
+@dataclass(frozen=True)
+class ChaosScenario:
+    """One seeded, replayable chaos configuration.
+
+    ``skew_ms`` is the maximum per-host constant offset; host 0 (the
+    coordinator) keeps a true clock, odd hosts run ahead, even hosts
+    behind, each at a distinct fraction of ``skew_ms`` (a shared
+    offset would be invisible to correlation).  ``drift_ms_per_s``
+    accumulates with stream time on top.
+    """
+
+    seed: int = 1337
+    skew_ms: float = 0.0
+    drift_ms_per_s: float = 0.0
+    dup_rate: float = 0.0
+    reorder_rate: float = 0.0
+    reorder_depth: int = 8
+    corrupt_rate: float = 0.0
+    drop_rate: float = 0.0
+
+    @classmethod
+    def at_intensity(
+        cls, intensity: float, seed: int = 1337
+    ) -> "ChaosScenario":
+        """The moderate profile scaled linearly; 1.0 == moderate."""
+        return cls(
+            seed=seed,
+            skew_ms=MODERATE_SKEW_MS * intensity,
+            drift_ms_per_s=MODERATE_DRIFT_MS_PER_S * intensity,
+            dup_rate=min(0.5, MODERATE_DUP_RATE * intensity),
+            reorder_rate=min(0.5, MODERATE_REORDER_RATE * intensity),
+            corrupt_rate=min(0.5, MODERATE_CORRUPT_RATE * intensity),
+            drop_rate=min(0.5, MODERATE_DROP_RATE * intensity),
+        )
+
+    def with_seed(self, seed: int) -> "ChaosScenario":
+        return replace(self, seed=seed)
+
+
+class ChaosStream:
+    """Seeded fault injector over an iterable of probe-event dicts.
+
+    Never mutates source dicts (perturbed events are copies).  Counters
+    (``skewed`` / ``duplicated`` / ``reordered`` / ``corrupted`` /
+    ``dropped``) record exactly what was injected, so tests can assert
+    the gate's accounting against ground truth.
+    """
+
+    def __init__(self, scenario: ChaosScenario):
+        self.scenario = scenario
+        self._rng = random.Random(scenario.seed)
+        self.emitted = 0
+        self.skewed = 0
+        self.duplicated = 0
+        self.reordered = 0
+        self.corrupted = 0
+        self.dropped = 0
+
+    # ---- per-host skew -------------------------------------------------
+
+    def _host_of(self, event: dict[str, Any]) -> int:
+        tpu = event.get("tpu")
+        if isinstance(tpu, dict):
+            try:
+                host = int(tpu.get("host_index", -1))
+            except (TypeError, ValueError):
+                host = -1
+            if host >= 0:
+                return host
+        # No TPU identity: derive a stable pseudo-host from the node
+        # name so CPU-side signals from the same agent skew together.
+        node = str(event.get("node", ""))
+        digits = "".join(ch for ch in node if ch.isdigit())
+        return int(digits) if digits else 0
+
+    def _offset_ns(self, host: int, elapsed_s: float) -> int:
+        if host == 0:
+            return 0
+        # Distinct offsets per host (a shared offset would be invisible
+        # to correlation), all within +-skew_ms: host 1 runs a full
+        # skew ahead, host 2 three quarters behind, host 3 half ahead…
+        sign = 1 if host % 2 else -1
+        fraction = max(0.25, 1.0 - 0.25 * (host - 1))
+        offset_ms = (
+            self.scenario.skew_ms * fraction
+            + self.scenario.drift_ms_per_s * elapsed_s
+        )
+        return int(sign * offset_ms * 1e6)
+
+    # ---- corruption ----------------------------------------------------
+
+    def _corrupt(self, event: dict[str, Any]) -> dict[str, Any]:
+        mode = self._rng.choice(_CORRUPT_MODES)
+        out = dict(event)
+        if mode == "string_value":
+            out["value"] = f"garbled-{self._rng.randrange(1_000_000)}"
+        elif mode == "negative_ts":
+            out["ts_unix_nano"] = -abs(int(out.get("ts_unix_nano", 1)))
+        elif mode == "bogus_status":
+            out["status"] = "definitely-not-a-status"
+        elif mode == "drop_required_field":
+            out.pop(self._rng.choice(("signal", "status", "value")), None)
+        elif mode == "float_pid":
+            out["pid"] = float(out.get("pid", 0)) + 0.5
+        return out
+
+    # ---- the stream ----------------------------------------------------
+
+    def stream(
+        self, events: Iterable[dict[str, Any]]
+    ) -> Iterator[dict[str, Any]]:
+        """Yield the perturbed stream (one pass, bounded buffering)."""
+        scenario = self.scenario
+        rng = self._rng
+        first_ts: int | None = None
+        # Held-back events for reordering: (release_at_index, event).
+        held: list[tuple[int, dict[str, Any]]] = []
+        index = 0
+
+        def releases(now: int) -> list[dict[str, Any]]:
+            nonlocal held
+            due = [e for at, e in held if at <= now]
+            if due:
+                held = [(at, e) for at, e in held if at > now]
+                self.emitted += len(due)
+            return due
+
+        for event in events:
+            index += 1
+            if rng.random() < scenario.drop_rate:
+                self.dropped += 1
+                yield from releases(index)
+                continue
+
+            out = dict(event)
+            ts = out.get("ts_unix_nano")
+            if type(ts) is int and ts > 0:
+                if first_ts is None:
+                    first_ts = ts
+                offset = self._offset_ns(
+                    self._host_of(out), (ts - first_ts) / 1e9
+                )
+                if offset:
+                    out["ts_unix_nano"] = ts + offset
+                    self.skewed += 1
+
+            if rng.random() < scenario.corrupt_rate:
+                out = self._corrupt(out)
+                self.corrupted += 1
+
+            duplicate = rng.random() < scenario.dup_rate
+            if duplicate:
+                self.duplicated += 1
+
+            if rng.random() < scenario.reorder_rate:
+                depth = rng.randrange(1, max(2, scenario.reorder_depth + 1))
+                held.append((index + depth, out))
+                self.reordered += 1
+                if duplicate:
+                    yield dict(out)
+                    self.emitted += 1
+            else:
+                yield out
+                self.emitted += 1
+                if duplicate:
+                    yield dict(out)
+                    self.emitted += 1
+            yield from releases(index)
+
+        # Flush whatever is still held back, oldest first.
+        for _, event in sorted(held, key=lambda pair: pair[0]):
+            yield event
+            self.emitted += 1
+
+    __call__ = stream
+
+    def snapshot(self) -> dict[str, int]:
+        return {
+            "emitted": self.emitted,
+            "skewed": self.skewed,
+            "duplicated": self.duplicated,
+            "reordered": self.reordered,
+            "corrupted": self.corrupted,
+            "dropped": self.dropped,
+        }

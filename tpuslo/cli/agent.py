@@ -130,7 +130,71 @@ def build_parser() -> argparse.ArgumentParser:
         "not be held by a serving workload)",
     )
     p.add_argument("--ici-probe-payload-kb", type=int, default=256)
+    p.add_argument(
+        "--chaos-telemetry",
+        type=float,
+        default=0.0,
+        metavar="INTENSITY",
+        help="perturb the probe stream at the source with seeded skew/"
+        "reorder/dup/corrupt/drop chaos (1.0 = moderate: skew<=250ms, "
+        "5%% dup, 5%% reorder, 1%% corrupt); pairs with the ingest "
+        "gate (config ingest:) to rehearse telemetry-quality incidents",
+    )
+    p.add_argument("--chaos-telemetry-seed", type=int, default=1337)
+    p.add_argument(
+        "--stats-interval-cycles",
+        type=int,
+        default=30,
+        help="emit a periodic stats line (drops, rejections by reason, "
+        "gate counters) every N cycles; 0 disables",
+    )
     return p
+
+
+def _gate_pipeline(events, chaos_stream, gate, metrics):
+    """Dict-level chaos + ingest-gate pass over generated probe events.
+
+    Chaos perturbs what the "wire" carries; the gate re-admits it.
+    Events the gate quarantined/deduplicated never come back; a
+    payload the gate passed through untouched keeps its original
+    typed event (no lossy rebuild on the gate-only hot path — both
+    chaos and the gate copy on write, so dict identity is the
+    "untouched" proof).  A rebuild failure (corrupt event with no
+    gate to stop it) is an accounted drop, never a crash.
+    """
+    from tpuslo.schema import ProbeEventV1
+
+    pairs = [(event, event.to_dict()) for event in events]
+    original_by_payload = {id(payload): event for event, payload in pairs}
+    payloads = [payload for _, payload in pairs]
+    if chaos_stream is not None:
+        payloads = list(chaos_stream.stream(payloads))
+    if gate is not None:
+        payloads = gate.admit_all(payloads).all_events()
+    out = []
+    for payload in payloads:
+        original = original_by_payload.get(id(payload))
+        if original is not None:
+            out.append(original)
+            continue
+        try:
+            out.append(ProbeEventV1.from_dict(payload))
+        except (TypeError, ValueError, KeyError):
+            metrics.dropped.labels(reason="malformed").inc()
+    return out
+
+
+def _print_stats(gate) -> None:
+    """Periodic stats line: every silent drop, made loud."""
+    from tpuslo.metrics import REJECTION_COUNTERS, VALIDATION_COUNTERS
+
+    parts = [f"validation={VALIDATION_COUNTERS.snapshot()}"]
+    rejections = REJECTION_COUNTERS.snapshot()
+    if rejections:
+        parts.append(f"rejections={rejections}")
+    if gate is not None:
+        parts.append(f"gate={gate.snapshot()}")
+    print("agent: stats: " + " ".join(parts), file=sys.stderr)
 
 
 def main(
@@ -174,6 +238,62 @@ def main(
         else None
     )
 
+    metrics = metrics or AgentMetrics()
+
+    chaos_stream = None
+    if args.chaos_telemetry > 0 and args.probe_source == "ring":
+        # Ring events arrive one at a time from the kernel; the chaos
+        # stream's reorder/dup buffering only makes sense on the
+        # synthetic batch loop.  Refusing loudly beats a banner that
+        # implies a drill which never runs.
+        print(
+            "agent: --chaos-telemetry applies to the synthetic loop "
+            "only; ignored with --probe-source ring",
+            file=sys.stderr,
+        )
+    elif args.chaos_telemetry > 0:
+        from tpuslo.chaos.telemetry import ChaosScenario, ChaosStream
+
+        chaos_stream = ChaosStream(
+            ChaosScenario.at_intensity(
+                args.chaos_telemetry, seed=args.chaos_telemetry_seed
+            )
+        )
+        print(
+            f"agent: telemetry chaos at intensity "
+            f"{args.chaos_telemetry:g} (seed {args.chaos_telemetry_seed})",
+            file=sys.stderr,
+        )
+
+    gate = None
+    if cfg.ingest.enabled:
+        # Always-on once configured: the gate is the admission point
+        # for everything the agent emits downstream.
+        from tpuslo.ingest import GateConfig, TelemetryGate
+
+        gate = TelemetryGate(
+            GateConfig(
+                dedup_window=cfg.ingest.dedup_window,
+                watermark_lateness_ms=cfg.ingest.watermark_lateness_ms,
+                coordinator_host=cfg.ingest.coordinator_host,
+                min_skew_samples=cfg.ingest.min_skew_samples,
+                skew_correction=cfg.ingest.skew_correction,
+                quarantine_dir=cfg.ingest.quarantine_dir,
+                quarantine_max_bytes=cfg.ingest.quarantine_max_bytes,
+                quarantine_max_age_s=cfg.ingest.quarantine_max_age_s,
+            ),
+            observer=metrics.ingest_observer(),
+        )
+        print(
+            "agent: ingest gate on"
+            + (
+                f" (quarantine: {cfg.ingest.quarantine_dir})"
+                if cfg.ingest.quarantine_dir
+                else ""
+            ),
+            file=sys.stderr,
+        )
+
     meta_template = Metadata(
         node=args.node,
         namespace=args.namespace,
@@ -189,7 +309,6 @@ def main(
     )
     generator = Generator(mode, signal_set, enricher=enricher)
 
-    metrics = metrics or AgentMetrics()
     writers = EventWriters(
         output=args.output,
         jsonl_path=args.jsonl_path,
@@ -298,6 +417,10 @@ def main(
                 # Measured collectives ride the same validation /
                 # rate-limit / emit path as every other probe signal.
                 generated.extend(ici_prober.maybe_probe(time.monotonic()))
+            if chaos_stream is not None or gate is not None:
+                generated = _gate_pipeline(
+                    generated, chaos_stream, gate, metrics
+                )
             emitted = []
             for event in generated:
                 if not limiter.allow():
@@ -348,6 +471,12 @@ def main(
                     metrics.webhook_sent.labels(outcome="error").inc()
                     print(f"agent: webhook failed: {exc}", file=sys.stderr)
 
+        if (
+            args.stats_interval_cycles
+            and (idx + 1) % args.stats_interval_cycles == 0
+        ):
+            _print_stats(gate)
+
         result = guard.evaluate()
         if result.valid:
             metrics.cpu_overhead_pct.set(result.cpu_pct)
@@ -378,7 +507,7 @@ def main(
         if args.probe_source == "ring":
             _run_ring_loop(
                 args, cfg, mode, signal_set, enricher, writers, metrics,
-                limiter, guard, recovery, ici_prober=ici_prober,
+                limiter, guard, recovery, ici_prober=ici_prober, gate=gate,
             )
         else:
             idx = 0
@@ -392,6 +521,14 @@ def main(
         pass
     finally:
         metrics.up.set(0)
+        _print_stats(gate)
+        if gate is not None:
+            gate.close()
+        if chaos_stream is not None:
+            print(
+                f"agent: chaos-telemetry: {chaos_stream.snapshot()}",
+                file=sys.stderr,
+            )
         if webhook_channel is not None:
             webhook_channel.close()
         writers.close()
@@ -416,7 +553,7 @@ def main(
 
 def _run_ring_loop(
     args, cfg, mode, signal_set, enricher, writers, metrics, limiter, guard,
-    recovery, ici_prober=None,
+    recovery, ici_prober=None, gate=None,
 ) -> None:
     """The real-probe path: ringbuf → normalize → schema → emit.
 
@@ -514,6 +651,25 @@ def _run_ring_loop(
         if not limiter.allow():
             metrics.dropped.labels(reason="rate_limit").inc()
             return
+        if gate is not None:
+            # Real-probe events are exactly the skewed/duplicated/
+            # corrupt surface the gate exists for; late events are
+            # still emitted (downstream consumers run the re-match).
+            from tpuslo.ingest import ADMITTED, LATE
+            from tpuslo.schema import ProbeEventV1
+
+            payload = event.to_dict()
+            outcome, gated = gate.admit(payload)
+            if outcome not in (ADMITTED, LATE):
+                return
+            if gated is not payload:
+                # The gate copies only when it skew-corrected the
+                # timestamp; everything else keeps the typed event.
+                try:
+                    event = ProbeEventV1.from_dict(gated)
+                except (TypeError, ValueError, KeyError):
+                    metrics.dropped.labels(reason="malformed").inc()
+                    return
         if not validate_probe(event):
             metrics.dropped.labels(reason="schema").inc()
             return
@@ -585,6 +741,11 @@ def _run_ring_loop(
                                 )
             metrics.mark_cycle()
             cycles += 1
+            if (
+                args.stats_interval_cycles
+                and cycles % args.stats_interval_cycles == 0
+            ):
+                _print_stats(gate)
             if args.count and cycles >= args.count:
                 break
             time.sleep(args.interval_s)

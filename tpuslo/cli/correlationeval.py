@@ -2,6 +2,12 @@
 
 Reference: ``cmd/correlationeval/main.go`` — defaults window=2000ms,
 threshold=0.7, gates P ≥ 0.90, R ≥ 0.85; exit 1 on gate failure.
+
+``--chaos-intensity`` perturbs the *signal* side of every pair before
+evaluation (seeded skew within the moderate chaos envelope, plus
+timestamp loss at the corruption rate), measuring how the matcher's
+robustness changes — the missing-timestamp confidence cap and the
+global window are what keep precision from collapsing here.
 """
 
 from __future__ import annotations
@@ -9,7 +15,10 @@ from __future__ import annotations
 import argparse
 import csv
 import json
+import random
 import sys
+from dataclasses import replace
+from datetime import timedelta
 from pathlib import Path
 
 from tpuslo import correlation
@@ -29,12 +38,56 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--min-recall", type=float, default=0.85)
     p.add_argument("--report", default="", help="write JSON report here")
     p.add_argument("--predictions", default="", help="write predictions CSV here")
+    p.add_argument(
+        "--chaos-intensity",
+        type=float,
+        default=0.0,
+        help="perturb signal timestamps before evaluating "
+        "(1.0 = moderate: skew<=250ms, 1%% timestamp loss)",
+    )
+    p.add_argument("--chaos-seed", type=int, default=1337)
     return p
+
+
+def chaos_pairs(
+    pairs: list[correlation.LabeledPair], intensity: float, seed: int
+) -> list[correlation.LabeledPair]:
+    """Seeded timestamp perturbation of the signal side of each pair."""
+    from tpuslo.chaos.telemetry import (
+        MODERATE_CORRUPT_RATE,
+        MODERATE_SKEW_MS,
+    )
+
+    rng = random.Random(seed)
+    skew_ms = MODERATE_SKEW_MS * intensity
+    loss_rate = min(0.5, MODERATE_CORRUPT_RATE * intensity)
+    out = []
+    for pair in pairs:
+        signal = pair.signal
+        if signal.timestamp is not None:
+            if rng.random() < loss_rate:
+                signal = replace(signal, timestamp=None)
+            elif skew_ms:
+                offset = rng.uniform(-skew_ms, skew_ms)
+                signal = replace(
+                    signal,
+                    timestamp=signal.timestamp
+                    + timedelta(milliseconds=offset),
+                )
+        out.append(replace(pair, signal=signal))
+    return out
 
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     pairs = correlation.load_labeled_pairs(args.input)
+    if args.chaos_intensity > 0:
+        pairs = chaos_pairs(pairs, args.chaos_intensity, args.chaos_seed)
+        print(
+            f"correlationeval: chaos intensity {args.chaos_intensity:g} "
+            f"(seed {args.chaos_seed}) applied to signal timestamps",
+            file=sys.stderr,
+        )
     report, predictions = correlation.evaluate_labeled_pairs(
         pairs, args.window_ms, args.threshold
     )

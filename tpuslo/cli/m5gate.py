@@ -2,6 +2,13 @@
 
 Reference: ``cmd/m5gate/main.go`` — all stat knobs as flags, JSON + MD
 summaries, exit 1 on gate failure.
+
+``--chaos-sweep`` runs the telemetry-chaos release gate instead: the
+source→correlation→attribution path is replayed under seeded chaos at
+increasing intensity, with and without the ingest gate, and the run
+fails unless degradation is graceful (gated macro-F1 within tolerance
+of the clean baseline at moderate chaos, strictly better than the
+ungated path at every swept intensity).
 """
 
 from __future__ import annotations
@@ -34,7 +41,97 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--min-cliffs-delta", type=float, default=0.147)
     p.add_argument("--summary-json", default="")
     p.add_argument("--summary-md", default="")
+    # ---- telemetry chaos-sweep gate ----------------------------------
+    p.add_argument(
+        "--chaos-sweep",
+        action="store_true",
+        help="run the telemetry chaos-sweep gate instead of B5/D3/E3",
+    )
+    p.add_argument("--chaos-scenario", default="tpu_mixed")
+    p.add_argument("--chaos-count", type=int, default=60)
+    p.add_argument("--chaos-seed", type=int, default=1337)
+    p.add_argument(
+        "--chaos-intensities",
+        default="0,0.5,1,2",
+        help="comma-separated chaos intensities (1.0 = moderate: "
+        "skew<=250ms, 5%% dup, 5%% reorder, 1%% corrupt)",
+    )
+    p.add_argument("--chaos-hosts", type=int, default=4)
+    p.add_argument(
+        "--chaos-rel-tolerance",
+        type=float,
+        default=0.05,
+        help="max relative macro-F1 loss vs the no-chaos baseline "
+        "allowed at up-to-moderate intensities with the gate on",
+    )
     return p
+
+
+def render_chaos_markdown(report) -> str:
+    lines = [
+        "# Telemetry chaos-sweep gate",
+        "",
+        f"**Overall: {'PASS' if report.passed else 'FAIL'}**",
+        "",
+        f"- scenario: `{report.scenario}` x{report.count} "
+        f"(seed {report.seed}, {report.hosts} hosts)",
+        f"- no-chaos baseline macro-F1: {report.baseline_macro_f1:.4f}",
+        f"- tolerance at <= moderate intensity: "
+        f"{100 * report.rel_tolerance:.0f}% relative",
+        "",
+        "| intensity | gated F1 | ungated F1 | quarantined | dup | "
+        "late | skew-corrected |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for point in report.points:
+        gate = point.gate_snapshot
+        lines.append(
+            f"| {point.intensity:g} | {point.gated_macro_f1:.4f} "
+            f"| {point.ungated_macro_f1:.4f} "
+            f"| {gate.get('quarantined', 0)} "
+            f"| {gate.get('duplicates', 0)} "
+            f"| {gate.get('late_admitted', 0)} "
+            f"| {gate.get('skew_corrected', 0)} |"
+        )
+    if report.failures:
+        lines += ["", "## Failures", ""]
+        lines += [f"- {f}" for f in report.failures]
+    return "\n".join(lines) + "\n"
+
+
+def run_chaos_gate(args) -> int:
+    from tpuslo.attribution.pipeline import run_chaos_sweep
+
+    intensities = tuple(
+        float(v) for v in args.chaos_intensities.split(",") if v.strip()
+    )
+    report = run_chaos_sweep(
+        scenario=args.chaos_scenario,
+        count=args.chaos_count,
+        seed=args.chaos_seed,
+        intensities=intensities,
+        hosts=args.chaos_hosts,
+        rel_tolerance=args.chaos_rel_tolerance,
+    )
+    if args.summary_json:
+        Path(args.summary_json).write_text(
+            json.dumps(report.to_dict(), indent=2) + "\n"
+        )
+    if args.summary_md:
+        Path(args.summary_md).write_text(render_chaos_markdown(report))
+    for point in report.points:
+        print(
+            f"m5gate: chaos intensity {point.intensity:g}: "
+            f"gated F1={point.gated_macro_f1:.4f} "
+            f"ungated F1={point.ungated_macro_f1:.4f}",
+            file=sys.stderr,
+        )
+    print(
+        f"m5gate: chaos-sweep {'PASS' if report.passed else 'FAIL'}"
+        + ("" if report.passed else f" ({'; '.join(report.failures)})"),
+        file=sys.stderr,
+    )
+    return 0 if report.passed else 1
 
 
 def render_markdown(summary: releasegate.Summary) -> str:
@@ -92,6 +189,8 @@ def render_markdown(summary: releasegate.Summary) -> str:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.chaos_sweep:
+        return run_chaos_gate(args)
     cfg = releasegate.Config(
         candidate_root=args.candidate_root,
         baseline_root=args.baseline_root,

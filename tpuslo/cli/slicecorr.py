@@ -57,6 +57,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--summary", default="", help="optional summary JSON output path"
     )
+    p.add_argument(
+        "--gate",
+        action="store_true",
+        help="route events through the telemetry ingest gate "
+        "(dedup, quarantine, clock-skew correction, watermark) "
+        "before joining",
+    )
+    p.add_argument(
+        "--quarantine-dir",
+        default="",
+        help="with --gate: write malformed events here (capped JSONL)",
+    )
+    p.add_argument("--watermark-lateness-ms", type=int, default=2000)
+    p.add_argument(
+        "--coordinator-host",
+        type=int,
+        default=0,
+        help="host index whose clock anchors skew correction",
+    )
     return p
 
 
@@ -82,6 +101,17 @@ def main(argv: list[str] | None = None) -> int:
         retry_window_ns=args.retry_window_ns,
         retry_threshold=args.retry_threshold,
     )
+    gate = None
+    if args.gate:
+        from tpuslo.ingest import GateConfig, TelemetryGate
+
+        gate = TelemetryGate(
+            GateConfig(
+                watermark_lateness_ms=args.watermark_lateness_ms,
+                coordinator_host=args.coordinator_host,
+                quarantine_dir=args.quarantine_dir,
+            )
+        )
     # ValueError covers malformed JSONL (e.g. an agent killed mid-write
     # truncating a line — exactly the crash-consistency shape this
     # tool's inputs come from); same contract as attributor/collector.
@@ -119,13 +149,19 @@ def main(argv: list[str] | None = None) -> int:
                     "wall-clock agent JSONL)",
                     file=sys.stderr,
                 )
-            joiner.add_all(
-                extract_collective_signals_by_host(
-                    by_host, args.xprof_anchor_ns, slice_id=args.slice_id
-                )
+            events = extract_collective_signals_by_host(
+                by_host, args.xprof_anchor_ns, slice_id=args.slice_id
             )
         else:
-            joiner.add_all(_read_events(args.inputs))
+            events = _read_events(args.inputs)
+        if gate is None:
+            joiner.add_all(events)
+        else:
+            # Launch-id joins are exact identity, so late events still
+            # join — the gate's contribution here is dedup, quarantine
+            # and putting every host's evidence on one clock.
+            batch = gate.admit_all(events)
+            joiner.add_all(batch.all_events())
         incidents = joiner.incidents(min_hosts=args.min_hosts)
 
         sink = (
@@ -143,9 +179,15 @@ def main(argv: list[str] | None = None) -> int:
         summary = {
             "ingested": joiner.ingested,
             "skipped": joiner.skipped,
+            "skipped_by_reason": dict(
+                sorted(joiner.skipped_by_reason.items())
+            ),
             "incidents": len(incidents),
             "by_cause": {},
         }
+        if gate is not None:
+            summary["gate"] = gate.snapshot()
+            gate.close()
         for incident in incidents:
             summary["by_cause"][incident.cause] = (
                 summary["by_cause"].get(incident.cause, 0) + 1

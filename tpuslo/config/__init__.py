@@ -2,6 +2,7 @@ from tpuslo.config.toolkitcfg import (
     CDGateConfig,
     DeliveryConfig,
     CorrelationConfig,
+    IngestConfig,
     OTLPConfig,
     SafetyConfig,
     SamplingConfig,
@@ -16,6 +17,7 @@ __all__ = [
     "CDGateConfig",
     "DeliveryConfig",
     "CorrelationConfig",
+    "IngestConfig",
     "OTLPConfig",
     "SafetyConfig",
     "SamplingConfig",

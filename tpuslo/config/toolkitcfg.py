@@ -95,6 +95,28 @@ class DeliveryConfig:
 
 
 @dataclass
+class IngestConfig:
+    """Telemetry ingest-gate knobs (``tpuslo.ingest.TelemetryGate``).
+
+    ``enabled`` flips to True whenever an ``ingest:`` section is
+    present in the config file — the gate is always-on once the
+    operator has described it.  Like every other section, explicit
+    zero/empty values fall back to these defaults (the reference
+    ``normalize()`` convention) — there is no "0 means strict" knob.
+    """
+
+    enabled: bool = False
+    dedup_window: int = 4096
+    watermark_lateness_ms: int = 2000
+    coordinator_host: int = 0
+    min_skew_samples: int = 3
+    skew_correction: bool = True
+    quarantine_dir: str = ""
+    quarantine_max_bytes: int = 8 * 1024 * 1024
+    quarantine_max_age_s: float = 24 * 3600.0
+
+
+@dataclass
 class TPUConfig:
     enabled: bool = True
     libtpu_path: str = ""
@@ -115,6 +137,7 @@ class ToolkitConfig:
     webhook: WebhookConfig = field(default_factory=WebhookConfig)
     cdgate: CDGateConfig = field(default_factory=CDGateConfig)
     delivery: DeliveryConfig = field(default_factory=DeliveryConfig)
+    ingest: IngestConfig = field(default_factory=IngestConfig)
     tpu: TPUConfig = field(default_factory=TPUConfig)
 
     def to_dict(self) -> dict[str, Any]:
@@ -160,6 +183,17 @@ class ToolkitConfig:
                 "spool_max_bytes": self.delivery.spool_max_bytes,
                 "spool_max_age_s": self.delivery.spool_max_age_s,
                 "restore_after_cycles": self.delivery.restore_after_cycles,
+            },
+            "ingest": {
+                "enabled": self.ingest.enabled,
+                "dedup_window": self.ingest.dedup_window,
+                "watermark_lateness_ms": self.ingest.watermark_lateness_ms,
+                "coordinator_host": self.ingest.coordinator_host,
+                "min_skew_samples": self.ingest.min_skew_samples,
+                "skew_correction": self.ingest.skew_correction,
+                "quarantine_dir": self.ingest.quarantine_dir,
+                "quarantine_max_bytes": self.ingest.quarantine_max_bytes,
+                "quarantine_max_age_s": self.ingest.quarantine_max_age_s,
             },
             "tpu": {
                 "enabled": self.tpu.enabled,
@@ -252,6 +286,25 @@ def load_config(path: str) -> ToolkitConfig:
             "restore_after_cycles": int,
         },
     )
+    if "ingest" in raw:
+        # Presence of the section turns the gate on (the operator
+        # described it); an explicit ``enabled: false`` still wins.
+        cfg.ingest.enabled = True
+        _merge_section(
+            cfg.ingest,
+            raw.get("ingest") or {},
+            {
+                "enabled": bool,
+                "dedup_window": int,
+                "watermark_lateness_ms": int,
+                "coordinator_host": int,
+                "min_skew_samples": int,
+                "skew_correction": bool,
+                "quarantine_dir": str,
+                "quarantine_max_bytes": int,
+                "quarantine_max_age_s": float,
+            },
+        )
     _merge_section(
         cfg.tpu,
         raw.get("tpu") or {},

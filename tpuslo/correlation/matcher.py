@@ -21,14 +21,22 @@ from __future__ import annotations
 
 from bisect import bisect_left, bisect_right
 from dataclasses import dataclass
-from datetime import datetime, timedelta
+from datetime import datetime, timedelta, timezone
 from typing import Any, Callable, Sequence
 
 from tpuslo import semconv
+from tpuslo.metrics.rejections import REJECTION_COUNTERS
 from tpuslo.schema import parse_rfc3339
 
 DEFAULT_WINDOW_MS = 2000
 DEFAULT_ENRICHMENT_THRESHOLD = 0.7
+
+# Confidence for an exact trace-id join when either timestamp is
+# missing or unparseable: the identity is exact but un-anchored in
+# time, so it must not clear the 0.7 enrichment threshold and must not
+# shadow any tier that *did* pass its window check (lowest tier is
+# service_node at 0.65).
+MISSING_TS_CONFIDENCE = 0.6
 
 TIER_TRACE_ID = "trace_id_exact"
 TIER_XLA_LAUNCH = "xla_launch"
@@ -48,9 +56,23 @@ TIER_CONFIDENCE = {
 
 
 def _ts(raw: Any) -> datetime | None:
+    """Parse a raw timestamp; unparseable inputs are None, not a crash.
+
+    Rejections are tallied (``matcher.unparseable_timestamp`` /
+    ``matcher.bad_timestamp_type``) instead of silently discarded: a
+    corrupt timestamp downgrades the pair to the missing-timestamp
+    path, it does not abort the whole batch.
+    """
+    if raw is None or isinstance(raw, datetime):
+        return raw
     if isinstance(raw, str):
-        return parse_rfc3339(raw)
-    return raw
+        try:
+            return parse_rfc3339(raw)
+        except ValueError:
+            REJECTION_COUNTERS.note("matcher", "unparseable_timestamp")
+            return None
+    REJECTION_COUNTERS.note("matcher", "bad_timestamp_type")
+    return None
 
 
 @dataclass(slots=True)
@@ -123,6 +145,51 @@ class SignalRef:
             launch_id=int(raw.get("launch_id", -1)),
         )
 
+    @classmethod
+    def from_probe_dict(cls, event: dict[str, Any]) -> "SignalRef":
+        """Build a SignalRef from a ``ProbeEventV1``-shaped dict.
+
+        The agent's JSONL rows carry ``ts_unix_nano`` (not an RFC3339
+        ``timestamp``) and nest TPU identity under ``tpu``; this is the
+        adapter the ingest gate's late re-match pass uses.  A missing
+        or non-positive ``ts_unix_nano`` yields a None timestamp (the
+        capped-confidence path), never a crash.
+        """
+        ts_raw = event.get("ts_unix_nano")
+        timestamp = None
+        if type(ts_raw) is int and ts_raw > 0:
+            timestamp = datetime.fromtimestamp(ts_raw / 1e9, tz=timezone.utc)
+        conn = event.get("conn_tuple")
+        conn_key = ""
+        if isinstance(conn, dict):
+            conn_key = (
+                f"{conn.get('protocol', '')}:"
+                f"{conn.get('src_ip', '')}:{conn.get('src_port', 0)}"
+                f"->{conn.get('dst_ip', '')}:{conn.get('dst_port', 0)}"
+            )
+        tpu = event.get("tpu") or {}
+        try:
+            pid = int(event.get("pid", 0))
+            host_index = int(tpu.get("host_index", -1))
+            launch_id = int(tpu.get("launch_id", -1))
+            value = float(event.get("value", 0.0))
+        except (TypeError, ValueError):
+            pid, host_index, launch_id, value = 0, -1, -1, 0.0
+        return cls(
+            signal=str(event.get("signal", "")),
+            timestamp=timestamp,
+            trace_id=str(event.get("trace_id", "")),
+            node=str(event.get("node", "")),
+            pod=str(event.get("pod", "")),
+            pid=pid,
+            conn_tuple=conn_key,
+            value=value,
+            slice_id=str(tpu.get("slice_id", "")),
+            host_index=host_index,
+            program_id=str(tpu.get("program_id", "")),
+            launch_id=launch_id,
+        )
+
 
 @dataclass(slots=True)
 class Decision:
@@ -140,12 +207,24 @@ def _within(a: datetime | None, b: datetime | None, window: timedelta) -> bool:
 
 
 def match(span: SpanRef, signal: SignalRef, window_ms: int = 0) -> Decision:
-    """Compute confidence/tier for one span-signal pair."""
+    """Compute confidence/tier for one span-signal pair.
+
+    A trace-id join with a missing timestamp on either side still
+    matches (the identity is exact), but at
+    :data:`MISSING_TS_CONFIDENCE` — below every windowed tier and below
+    the enrichment threshold, so an un-anchored join can never claim
+    the full 1.0 the windowed trace tier earns.
+    """
     window = timedelta(milliseconds=window_ms if window_ms > 0 else DEFAULT_WINDOW_MS)
+    trace_match = bool(span.trace_id) and span.trace_id == signal.trace_id
+    if span.timestamp is None or signal.timestamp is None:
+        if trace_match:
+            return Decision(True, MISSING_TS_CONFIDENCE, TIER_TRACE_ID)
+        return Decision()
     if not _within(span.timestamp, signal.timestamp, window):
         return Decision()
 
-    if span.trace_id and span.trace_id == signal.trace_id:
+    if trace_match:
         return Decision(True, TIER_CONFIDENCE[TIER_TRACE_ID], TIER_TRACE_ID)
 
     if (
@@ -334,13 +413,45 @@ def match_batch(
     """
     global_ms = window_ms if window_ms > 0 else DEFAULT_WINDOW_MS
 
+    # Missing-timestamp trace joins (pairwise MISSING_TS_CONFIDENCE):
+    # a span with no timestamp matches any signal sharing its trace id;
+    # a span WITH a timestamp falls back to trace-matching signals that
+    # themselves lack one — but only when no windowed tier matched,
+    # because 0.6 is below every windowed tier's confidence.
+    trace_min_any: dict[str, int] = {}
+    trace_min_no_ts: dict[str, int] = {}
+    for idx, signal in enumerate(signals):
+        if not signal.trace_id:
+            continue
+        trace_min_any.setdefault(signal.trace_id, idx)
+        if signal.timestamp is None:
+            trace_min_no_ts.setdefault(signal.trace_id, idx)
+
+    def _missing_ts_match(span_index: int, lookup: dict[str, int]) -> BatchMatch:
+        idx = lookup.get(spans[span_index].trace_id, -1) if spans[
+            span_index
+        ].trace_id else -1
+        if idx < 0:
+            return BatchMatch(span_index, -1, Decision())
+        return BatchMatch(
+            span_index,
+            idx,
+            Decision(True, MISSING_TS_CONFIDENCE, TIER_TRACE_ID),
+        )
+
     ref: datetime | None = None
     for signal in signals:
         if signal.timestamp is not None:
             ref = signal.timestamp
             break
     if ref is None:
-        return [BatchMatch(i, -1, Decision()) for i in range(len(spans))]
+        return [
+            _missing_ts_match(
+                i,
+                trace_min_any if spans[i].timestamp is None else trace_min_no_ts,
+            )
+            for i in range(len(spans))
+        ]
 
     # One pass over the signals builds all six tier indexes:
     # key -> [(microseconds-from-ref, signal index), ...], sorted.
@@ -363,7 +474,7 @@ def match_batch(
     out: list[BatchMatch] = []
     for span_index, span in enumerate(spans):
         if span.timestamp is None:
-            out.append(BatchMatch(span_index, -1, Decision()))
+            out.append(_missing_ts_match(span_index, trace_min_any))
             continue
         span_us = (span.timestamp - ref) // _US
         best_index = -1
@@ -385,7 +496,7 @@ def match_batch(
                 best_tier = tier
                 break
         if best_index < 0:
-            out.append(BatchMatch(span_index, -1, Decision()))
+            out.append(_missing_ts_match(span_index, trace_min_no_ts))
         else:
             out.append(
                 BatchMatch(

@@ -38,11 +38,20 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Iterable
 
+from tpuslo.metrics.rejections import REJECTION_COUNTERS
 from tpuslo.signals.constants import (
     SIGNAL_DCN_TRANSFER_MS,
     SIGNAL_ICI_COLLECTIVE_MS,
     SIGNAL_ICI_LINK_RETRIES,
 )
+
+# Reason classes for events the joiner cannot use.  ``skipped`` stays as
+# the aggregate for backwards compatibility; the per-reason map is what
+# turns a silent False return into a triageable summary line.
+SKIP_MISSING_SLICE_IDENTITY = "missing_slice_identity"
+SKIP_MISSING_LAUNCH_ID = "missing_launch_id"
+SKIP_UNMATCHED_SIGNAL = "unmatched_signal"
+SKIP_BAD_FIELD_TYPE = "bad_field_type"
 
 # A launch group is "skewed" when (max-min)/max exceeds this ratio AND
 # the absolute skew exceeds the floor — both guards are needed because
@@ -184,27 +193,49 @@ class SliceJoiner:
         self._seen_hosts: dict[str, int] = {}
         self.ingested = 0
         self.skipped = 0
+        self.skipped_by_reason: dict[str, int] = {}
         # Stale groups evicted by drain() with too few hosts to
         # attribute (single reporter): surfaced so a dead-pod diagnosis
         # is not silently discarded.
         self.dropped_unattributable = 0
 
+    def _skip(self, reason: str) -> bool:
+        self.skipped += 1
+        self.skipped_by_reason[reason] = (
+            self.skipped_by_reason.get(reason, 0) + 1
+        )
+        REJECTION_COUNTERS.note("slice_joiner", reason)
+        return False
+
     def add(self, event: dict[str, Any]) -> bool:
-        """Ingest one probe-event dict; returns True if it was used."""
+        """Ingest one probe-event dict; returns True if it was used.
+
+        Every False is reason-classed (``skipped_by_reason`` plus the
+        process-wide ``slice_joiner.*`` rejection counters) — a missing
+        identity field is a telemetry-quality fact, not a silent drop.
+        """
         tpu = event.get("tpu") or {}
-        slice_id = tpu.get("slice_id", "")
-        host_index = int(tpu.get("host_index", -1))
-        signal = event.get("signal", "")
-        if not slice_id or host_index < 0:
-            self.skipped += 1
-            return False
+        if not isinstance(tpu, dict):
+            return self._skip(SKIP_BAD_FIELD_TYPE)
+        try:
+            slice_id = tpu.get("slice_id", "")
+            host_index = int(tpu.get("host_index", -1))
+            signal = event.get("signal", "")
+            if not slice_id or host_index < 0:
+                return self._skip(SKIP_MISSING_SLICE_IDENTITY)
+            launch_id = int(tpu.get("launch_id", -1))
+            ici_link = int(tpu.get("ici_link", -1))
+            value = float(event.get("value", 0.0))
+            ts_unix_nano = int(event.get("ts_unix_nano", 0))
+        except (TypeError, ValueError):
+            # Corrupt field types (a string host_index, a dict value)
+            # must not abort the whole stream one bad row in.
+            return self._skip(SKIP_BAD_FIELD_TYPE)
 
         if signal == SIGNAL_ICI_COLLECTIVE_MS:
-            launch_id = int(tpu.get("launch_id", -1))
             program_id = tpu.get("program_id", "")
             if launch_id < 0:
-                self.skipped += 1
-                return False
+                return self._skip(SKIP_MISSING_LAUNCH_ID)
             key = (slice_id, program_id, launch_id)
             group = self._groups.get(key)
             if group is None:
@@ -214,8 +245,8 @@ class SliceJoiner:
             group.hosts[host_index] = HostObservation(
                 host_index=host_index,
                 node=event.get("node", ""),
-                latency_ms=float(event.get("value", 0.0)),
-                ts_unix_nano=int(event.get("ts_unix_nano", 0)),
+                latency_ms=value,
+                ts_unix_nano=ts_unix_nano,
             )
             self._seen_hosts[slice_id] = max(
                 self._seen_hosts.get(slice_id, 0), len(group.hosts)
@@ -228,11 +259,9 @@ class SliceJoiner:
             # slices, so it keys on (program, launch) alone under the
             # CROSS_SLICE namespace; each observation remembers its
             # own slice for the incident verdict.
-            launch_id = int(tpu.get("launch_id", -1))
             program_id = tpu.get("program_id", "")
             if launch_id < 0:
-                self.skipped += 1
-                return False
+                return self._skip(SKIP_MISSING_LAUNCH_ID)
             key = (CROSS_SLICE, program_id, launch_id)
             group = self._groups.get(key)
             if group is None:
@@ -243,8 +272,8 @@ class SliceJoiner:
             group.hosts[host_index] = HostObservation(
                 host_index=host_index,
                 node=event.get("node", ""),
-                latency_ms=float(event.get("value", 0.0)),
-                ts_unix_nano=int(event.get("ts_unix_nano", 0)),
+                latency_ms=value,
+                ts_unix_nano=ts_unix_nano,
                 slice_id=slice_id,
             )
             self._seen_hosts[CROSS_SLICE] = max(
@@ -257,16 +286,15 @@ class SliceJoiner:
             self._retries.setdefault(slice_id, []).append(
                 _RetryObservation(
                     host_index=host_index,
-                    ici_link=int(tpu.get("ici_link", -1)),
-                    value=float(event.get("value", 0.0)),
-                    ts_unix_nano=int(event.get("ts_unix_nano", 0)),
+                    ici_link=ici_link,
+                    value=value,
+                    ts_unix_nano=ts_unix_nano,
                 )
             )
             self.ingested += 1
             return True
 
-        self.skipped += 1
-        return False
+        return self._skip(SKIP_UNMATCHED_SIGNAL)
 
     def add_all(self, events: Iterable[dict[str, Any]]) -> int:
         return sum(1 for e in events if self.add(e))

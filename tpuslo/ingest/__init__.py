@@ -1,0 +1,52 @@
+"""Hardened telemetry ingest: the gate in front of correlation.
+
+Real DaemonSet telemetry arrives skewed, reordered, duplicated and
+occasionally corrupt — exactly the failure modes ARGUS and CrossTrace
+identify as the dominant source of cross-host mis-joins (PAPERS.md).
+``TelemetryGate`` sits between raw probe-event streams and the
+consumers that join them (``match_batch``, ``SliceJoiner.add_all``,
+attribution reconstruction) and makes the path degrade gracefully:
+
+* event-id **dedup** over a bounded LRU window,
+* malformed-event **quarantine** to a capped JSONL spool with reason
+  classes (reusing the PR 1 fast-path validator's outcome),
+* per-host **clock-skew estimation** from overlapping collective
+  launch groups, with timestamp correction,
+* a **watermark** that admits bounded out-of-order events and routes
+  late arrivals to a low-confidence re-match pass instead of dropping
+  them.
+"""
+
+from tpuslo.ingest.gate import (
+    ADMITTED,
+    DUPLICATE,
+    LATE,
+    LATE_CONFIDENCE_CAP,
+    QUARANTINED,
+    GateBatch,
+    GateConfig,
+    GateObserver,
+    LateEvent,
+    TelemetryGate,
+    rematch_late,
+)
+from tpuslo.ingest.quarantine import Quarantine
+from tpuslo.ingest.skew import ClockSkewEstimator
+from tpuslo.ingest.watermark import Watermark
+
+__all__ = [
+    "ADMITTED",
+    "DUPLICATE",
+    "LATE",
+    "LATE_CONFIDENCE_CAP",
+    "QUARANTINED",
+    "GateBatch",
+    "GateConfig",
+    "GateObserver",
+    "LateEvent",
+    "TelemetryGate",
+    "rematch_late",
+    "Quarantine",
+    "ClockSkewEstimator",
+    "Watermark",
+]

@@ -1,0 +1,344 @@
+"""TelemetryGate: the hardened admission point for probe-event streams.
+
+Sits in front of every consumer that joins raw telemetry
+(``match_batch``, ``SliceJoiner.add_all``, attribution reconstruction)
+and applies, in order:
+
+1. **Structural validation** — the PR 1 fast-path validator's
+   "definitely valid / jsonschema fallback / reject" outcome
+   (:func:`tpuslo.schema.fastpath.validate_probe_payload`).  Rejects
+   are quarantined with a reason class, never silently dropped.
+2. **Deduplication** — at-least-once delivery (the spool replay
+   contract, retransmitting exporters) means exact duplicates are
+   normal; a bounded LRU window of event identities absorbs them.
+3. **Clock-skew correction** — per-node offsets estimated from
+   overlapping collective launch groups against the coordinator host
+   (:class:`tpuslo.ingest.skew.ClockSkewEstimator`); admitted events
+   get their ``ts_unix_nano`` corrected onto the coordinator's clock.
+4. **Watermark admission** — bounded out-of-order events are admitted;
+   events behind the low watermark are *late*: still returned (with
+   their lag) so the caller can route them through
+   :func:`rematch_late`, which caps correlation confidence below the
+   enrichment threshold unless a timestamp re-check passes.
+
+The gate never mutates caller-owned dicts: corrected events are
+shallow copies with a new ``ts_unix_nano``.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Iterable
+
+from tpuslo.correlation.matcher import (
+    DEFAULT_WINDOW_MS,
+    BatchMatch,
+    Decision,
+    SignalRef,
+    SpanRef,
+    match_batch,
+)
+from tpuslo.ingest.quarantine import (
+    DEFAULT_MAX_AGE_S,
+    DEFAULT_MAX_BYTES,
+    Quarantine,
+)
+from tpuslo.ingest.skew import (
+    DEFAULT_COORDINATOR_HOST,
+    DEFAULT_MIN_SAMPLES,
+    ClockSkewEstimator,
+)
+from tpuslo.ingest.watermark import Watermark
+from tpuslo.metrics.rejections import REJECTION_COUNTERS
+from tpuslo.schema.fastpath import (
+    REJECT_BAD_FIELD_TYPE,
+    REJECT_MISSING_FIELD,
+    REJECT_NOT_OBJECT,
+    REJECT_SCHEMA,
+    classify_probe_payload_reject,
+    validate_probe_payload,
+)
+from tpuslo.signals.constants import (
+    SIGNAL_DCN_TRANSFER_MS,
+    SIGNAL_ICI_COLLECTIVE_MS,
+)
+
+# Outcome labels for admit().
+ADMITTED = "admitted"
+DUPLICATE = "duplicate"
+QUARANTINED = "quarantined"
+LATE = "late"
+
+# Quarantine reason classes (defined beside the fast-path rules they
+# mirror — tpuslo/schema/fastpath.py — so the two cannot drift apart
+# unreviewed).
+REASON_NOT_OBJECT = REJECT_NOT_OBJECT
+REASON_MISSING_FIELD = REJECT_MISSING_FIELD
+REASON_BAD_FIELD_TYPE = REJECT_BAD_FIELD_TYPE
+REASON_SCHEMA_REJECT = REJECT_SCHEMA
+
+# Confidence ceiling for late-admitted events that fail the timestamp
+# re-check: strictly below the 0.70 enrichment threshold, so a stale
+# or id-reused event can never silently enrich a span.
+LATE_CONFIDENCE_CAP = 0.65
+
+# Signals whose completion is a cross-host synchronization point —
+# the only timestamps the skew estimator may learn from.
+_SYNC_SIGNALS = frozenset({SIGNAL_ICI_COLLECTIVE_MS, SIGNAL_DCN_TRANSFER_MS})
+
+@dataclass
+class GateConfig:
+    """Knobs for one :class:`TelemetryGate` (config: ``ingest:``)."""
+
+    dedup_window: int = 4096
+    watermark_lateness_ms: int = DEFAULT_WINDOW_MS
+    coordinator_host: int = DEFAULT_COORDINATOR_HOST
+    min_skew_samples: int = DEFAULT_MIN_SAMPLES
+    skew_correction: bool = True
+    quarantine_dir: str = ""
+    quarantine_max_bytes: int = DEFAULT_MAX_BYTES
+    quarantine_max_age_s: float = DEFAULT_MAX_AGE_S
+
+
+class GateObserver:
+    """No-op observer; the agent bridges these to Prometheus."""
+
+    def admitted(self) -> None: ...
+
+    def duplicate(self) -> None: ...
+
+    def quarantined(self, reason: str) -> None: ...
+
+    def late(self, lag_ns: int) -> None: ...
+
+    def skew_offsets(self, offsets_ms: dict[str, float]) -> None: ...
+
+    def watermark_lag_ms(self, lag_ms: float) -> None: ...
+
+
+@dataclass
+class LateEvent:
+    """One watermark-late event plus how far behind the head it was."""
+
+    event: dict[str, Any]
+    lag_ns: int
+
+
+@dataclass
+class GateBatch:
+    """Outcome of one ``admit_all`` call."""
+
+    admitted: list[dict[str, Any]] = field(default_factory=list)
+    late: list[LateEvent] = field(default_factory=list)
+
+    def all_events(self) -> list[dict[str, Any]]:
+        """Admitted plus late, in admission order within each class."""
+        return self.admitted + [entry.event for entry in self.late]
+
+
+def _event_key(event: dict[str, Any]) -> tuple:
+    """Stable identity for dedup.
+
+    Probe events carry no explicit event id (that's an SLOEvent
+    field), so identity is the full natural key: an exact duplicate —
+    spool replay, exporter retransmit, chaos dup — reproduces every
+    component; two genuinely distinct events differ in at least one.
+    """
+    tpu = event.get("tpu")
+    tpu = tpu if isinstance(tpu, dict) else {}
+    return (
+        event.get("ts_unix_nano"),
+        event.get("signal"),
+        event.get("node"),
+        event.get("pod"),
+        event.get("pid"),
+        event.get("tid"),
+        event.get("value"),
+        event.get("trace_id", ""),
+        tpu.get("host_index", -1),
+        tpu.get("launch_id", -1),
+        tpu.get("ici_link", -1),
+    )
+
+
+class TelemetryGate:
+    """Validation → dedup → skew correction → watermark, with stats."""
+
+    def __init__(
+        self,
+        config: GateConfig | None = None,
+        quarantine: Quarantine | None = None,
+        observer: GateObserver | None = None,
+    ):
+        self.config = config or GateConfig()
+        if quarantine is None and self.config.quarantine_dir:
+            quarantine = Quarantine(
+                self.config.quarantine_dir,
+                max_bytes=self.config.quarantine_max_bytes,
+                max_age_s=self.config.quarantine_max_age_s,
+            )
+        self.quarantine = quarantine
+        self._observer = observer or GateObserver()
+        self._dedup: OrderedDict[tuple, None] = OrderedDict()
+        self._dedup_window = max(1, self.config.dedup_window)
+        self.skew = ClockSkewEstimator(
+            coordinator_host=self.config.coordinator_host,
+            min_samples=self.config.min_skew_samples,
+        )
+        self.watermark = Watermark(
+            lateness_ns=self.config.watermark_lateness_ms * 1_000_000
+        )
+        self._observed_groups = 0
+        self.admitted = 0
+        self.duplicates = 0
+        self.quarantined = 0
+        self.quarantined_by_reason: dict[str, int] = {}
+        self.late_admitted = 0
+        self.skew_corrected = 0
+        self.last_lag_ns = 0
+
+    # ---- admission ----------------------------------------------------
+
+    def admit(
+        self, event: dict[str, Any]
+    ) -> tuple[str, dict[str, Any] | None]:
+        """Gate one raw probe-event dict.
+
+        Returns ``(outcome, event)`` where outcome is one of
+        :data:`ADMITTED` / :data:`LATE` (event is the possibly
+        skew-corrected copy) or :data:`DUPLICATE` / :data:`QUARANTINED`
+        (event is None).
+        """
+        if not validate_probe_payload(event):
+            reason = classify_probe_payload_reject(event)
+            self.quarantined += 1
+            self.quarantined_by_reason[reason] = (
+                self.quarantined_by_reason.get(reason, 0) + 1
+            )
+            REJECTION_COUNTERS.note("ingest_gate", reason)
+            if self.quarantine is not None:
+                self.quarantine.put(event, reason)
+            self._observer.quarantined(reason)
+            return QUARANTINED, None
+
+        key = _event_key(event)
+        if key in self._dedup:
+            self._dedup.move_to_end(key)
+            self.duplicates += 1
+            self._observer.duplicate()
+            return DUPLICATE, None
+        self._dedup[key] = None
+        if len(self._dedup) > self._dedup_window:
+            self._dedup.popitem(last=False)
+
+        ts = int(event["ts_unix_nano"])
+        if self.config.skew_correction:
+            if event.get("signal") in _SYNC_SIGNALS:
+                self.skew.observe(event)
+                if self.skew.groups_observed != self._observed_groups:
+                    # New offset evidence landed: refresh the gauges on
+                    # the per-event path too (ring mode never batches).
+                    self._observed_groups = self.skew.groups_observed
+                    self._observer.skew_offsets(self.skew.offsets_ms())
+            corrected = self.skew.correct(str(event.get("node", "")), ts)
+            if corrected != ts:
+                event = {**event, "ts_unix_nano": corrected}
+                ts = corrected
+                self.skew_corrected += 1
+
+        in_order = self.watermark.admit(ts)
+        lag = self.watermark.lag_ns(ts)
+        self.last_lag_ns = lag
+        self._observer.watermark_lag_ms(lag / 1e6)
+        if in_order:
+            self.admitted += 1
+            self._observer.admitted()
+            return ADMITTED, event
+        self.late_admitted += 1
+        self._observer.late(lag)
+        return LATE, event
+
+    def admit_all(self, events: Iterable[dict[str, Any]]) -> GateBatch:
+        """Gate a stream; duplicates/quarantined are consumed here."""
+        batch = GateBatch()
+        for event in events:
+            outcome, gated = self.admit(event)
+            if outcome == ADMITTED:
+                batch.admitted.append(gated)
+            elif outcome == LATE:
+                batch.late.append(LateEvent(gated, self.last_lag_ns))
+        self._observer.skew_offsets(self.skew.offsets_ms())
+        return batch
+
+    # ---- reporting ----------------------------------------------------
+
+    def snapshot(self) -> dict[str, Any]:
+        return {
+            "admitted": self.admitted,
+            "duplicates": self.duplicates,
+            "quarantined": self.quarantined,
+            "quarantined_by_reason": dict(
+                sorted(self.quarantined_by_reason.items())
+            ),
+            "late_admitted": self.late_admitted,
+            "skew_corrected": self.skew_corrected,
+            "skew_offsets_ms": {
+                node: round(ms, 3)
+                for node, ms in self.skew.offsets_ms().items()
+            },
+            "watermark_ns": self.watermark.watermark_ns,
+        }
+
+    def close(self) -> None:
+        if self.quarantine is not None:
+            self.quarantine.close()
+
+
+def rematch_late(
+    spans: list[SpanRef],
+    late: list[LateEvent],
+    window_ms: int = 0,
+    cap: float = LATE_CONFIDENCE_CAP,
+    max_lag_ns: int | None = None,
+) -> list[BatchMatch]:
+    """Low-confidence re-match pass for watermark-late events.
+
+    Late events still correlate — dropping them is how evidence of the
+    very incident that delayed them gets lost — but their timestamps
+    are suspect by construction (the producer clock or the delivery
+    path already misbehaved).  The **timestamp re-check** restores full
+    tier confidence only when both sides carry timestamps, the pairwise
+    window still holds on the (skew-corrected) values, and the event's
+    watermark lag is at most one correlation window *beyond* the
+    admission lateness (2x the window by default — a late event lags
+    more than the lateness bound by definition, so the re-check bound
+    must sit beyond it); anything staler is indistinguishable from
+    trace/launch id reuse after a restart and is capped below the
+    enrichment threshold.
+    """
+    if max_lag_ns is None:
+        max_lag_ns = (
+            2 * (window_ms if window_ms > 0 else DEFAULT_WINDOW_MS)
+            * 1_000_000
+        )
+    signals = [SignalRef.from_probe_dict(entry.event) for entry in late]
+    out: list[BatchMatch] = []
+    for result in match_batch(spans, signals, window_ms):
+        decision = result.decision
+        if decision.matched and result.signal_index >= 0:
+            span = spans[result.span_index]
+            signal = signals[result.signal_index]
+            recheck_ok = (
+                span.timestamp is not None
+                and signal.timestamp is not None
+                and late[result.signal_index].lag_ns <= max_lag_ns
+            )
+            if not recheck_ok and decision.confidence > cap:
+                result = BatchMatch(
+                    result.span_index,
+                    result.signal_index,
+                    Decision(True, cap, decision.tier),
+                )
+        out.append(result)
+    return out

@@ -1,0 +1,81 @@
+"""Reason-classed quarantine for malformed probe events.
+
+Rejected events are evidence, not garbage: a corrupt-event storm is a
+diagnosable incident (a broken producer, a torn ring buffer, an
+attacker), and triage needs the actual bytes.  Each quarantined event
+is appended as one JSONL record ``{"reason": ..., "event": ...}``.
+
+Storage rides :class:`tpuslo.delivery.spool.DiskSpool` — the same
+segmented, size/age-capped WAL the delivery layer uses — so a storm
+truncates oldest segments instead of filling the disk, with truncation
+counted (never silent).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable
+
+from tpuslo.delivery.spool import DiskSpool
+
+DEFAULT_MAX_BYTES = 8 * 1024 * 1024
+DEFAULT_MAX_AGE_S = 24 * 3600.0
+_SEGMENT_BYTES = 64 * 1024
+
+
+class Quarantine:
+    """Capped JSONL quarantine directory with per-reason accounting."""
+
+    def __init__(
+        self,
+        directory: str | os.PathLike,
+        max_bytes: int = DEFAULT_MAX_BYTES,
+        max_age_s: float = DEFAULT_MAX_AGE_S,
+        on_truncate: Callable[[int], None] | None = None,
+    ):
+        self._spool = DiskSpool(
+            directory,
+            segment_max_bytes=_SEGMENT_BYTES,
+            max_bytes=max_bytes,
+            max_age_s=max_age_s,
+            on_truncate=self._note_truncated,
+        )
+        self._on_truncate = on_truncate
+        self.by_reason: dict[str, int] = {}
+        self.truncated = 0
+
+    def _note_truncated(self, records: int) -> None:
+        self.truncated += records
+        if self._on_truncate is not None:
+            self._on_truncate(records)
+
+    def put(self, event: Any, reason: str) -> None:
+        """Quarantine one rejected event under a reason class.
+
+        Unserializable payloads are stored as their ``repr`` — the
+        quarantine must never raise back into the ingest hot path for
+        the very malformedness it exists to capture.
+        """
+        self.by_reason[reason] = self.by_reason.get(reason, 0) + 1
+        try:
+            try:
+                self._spool.append({"reason": reason, "event": event})
+            except (TypeError, ValueError):
+                self._spool.append(
+                    {"reason": reason, "event_repr": repr(event)}
+                )
+        except OSError:
+            # Disk trouble while quarantining (either append): the
+            # count above already recorded the rejection; losing the
+            # body is survivable.
+            pass
+
+    def pending_bytes(self) -> int:
+        return self._spool.pending_bytes()
+
+    def drain(self, handler: Callable[[dict[str, Any]], None]) -> int:
+        """Replay quarantined records oldest-first (triage tooling)."""
+        return self._spool.drain(handler)
+
+    def close(self) -> None:
+        self._spool.close()

@@ -1,0 +1,143 @@
+"""Per-host clock-skew estimation from overlapping launch groups.
+
+A multi-host TPU pod gives the ingest layer a free clock reference:
+every cross-chip collective is a synchronization point, and **all
+participating hosts finish it together** (the collective completes when
+the last input arrives and the result is exchanged — the same physics
+``SliceJoiner`` uses for straggler attribution).  So for one
+``(slice_id, program_id, launch_id)`` group, the *finish* timestamps
+recorded by different hosts should agree up to jitter; a systematic
+per-host difference against the coordinator host is clock skew, not
+physics.
+
+Offsets are estimated from collective events (they carry the
+launch-group identity) but keyed by **node**, because skew is a
+property of the host's clock: once ``node-3`` is known to run 180 ms
+ahead of the coordinator, every event it emits — DNS latency and HBM
+stalls included — gets the same correction.
+
+The estimator keeps a sliding window of per-launch offsets per node and
+reports the **median** (robust to stragglers: a late-entering host
+observes a short wall time but still finishes with everyone else, so
+launch-group finish skew stays small next to a drifting clock).  A
+sliding window rather than a global median lets the estimate track
+slow drift.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict, deque
+from statistics import median
+from typing import Any
+
+DEFAULT_COORDINATOR_HOST = 0
+DEFAULT_MIN_SAMPLES = 3
+DEFAULT_WINDOW_SAMPLES = 128
+# Launch groups awaiting the coordinator's observation; bounded so a
+# stream that never delivers the coordinator's view cannot grow state.
+_MAX_PENDING_GROUPS = 1024
+
+
+class ClockSkewEstimator:
+    """Median pairwise offset of each node against the coordinator."""
+
+    def __init__(
+        self,
+        coordinator_host: int = DEFAULT_COORDINATOR_HOST,
+        min_samples: int = DEFAULT_MIN_SAMPLES,
+        window_samples: int = DEFAULT_WINDOW_SAMPLES,
+    ):
+        self.coordinator_host = coordinator_host
+        self.min_samples = max(1, min_samples)
+        self._samples: dict[str, deque[int]] = {}
+        self._window = max(self.min_samples, window_samples)
+        self.coordinator_node: str = ""
+        # group key -> {host_index: (ts_unix_nano, node)}; insertion-
+        # ordered so overflow evicts the oldest group first.
+        self._pending: OrderedDict[
+            tuple[str, str, int], dict[int, tuple[int, str]]
+        ] = OrderedDict()
+        self.groups_observed = 0
+
+    def observe(self, event: dict[str, Any]) -> None:
+        """Feed one probe-event dict; only launch-group members count.
+
+        Events without full ``(slice_id, program_id, launch_id,
+        host_index)`` identity are ignored — skew evidence must be an
+        exact-identity join, never a timestamp guess.  The caller is
+        expected to feed only synchronization-point signals
+        (collective / cross-slice transfer completions); other
+        launch-stamped events do not finish simultaneously across
+        hosts.
+        """
+        tpu = event.get("tpu")
+        if not isinstance(tpu, dict):
+            return
+        try:
+            host = int(tpu.get("host_index", -1))
+            launch_id = int(tpu.get("launch_id", -1))
+            ts = int(event.get("ts_unix_nano", 0))
+        except (TypeError, ValueError):
+            return
+        slice_id = tpu.get("slice_id", "")
+        program_id = tpu.get("program_id", "")
+        node = event.get("node", "")
+        if host < 0 or launch_id < 0 or not slice_id or not node or ts <= 0:
+            return
+        if host == self.coordinator_host:
+            self.coordinator_node = str(node)
+
+        key = (str(slice_id), str(program_id), launch_id)
+        group = self._pending.get(key)
+        if group is None:
+            if len(self._pending) >= _MAX_PENDING_GROUPS:
+                self._pending.popitem(last=False)
+            group = self._pending[key] = {}
+        group[host] = (ts, str(node))
+
+        coord = group.get(self.coordinator_host)
+        if coord is None:
+            return
+        coord_ts = coord[0]
+        # Coordinator view present: every other host in the group
+        # yields one offset sample (its clock minus the coordinator's).
+        for other, (other_ts, other_node) in group.items():
+            if other == self.coordinator_host:
+                continue
+            samples = self._samples.get(other_node)
+            if samples is None:
+                samples = self._samples[other_node] = deque(
+                    maxlen=self._window
+                )
+            samples.append(other_ts - coord_ts)
+        self.groups_observed += 1
+        # Re-keep only the coordinator entry: late host observations of
+        # the same launch still pair against it without re-sampling the
+        # hosts already seen.
+        self._pending[key] = {self.coordinator_host: coord}
+
+    def offset_ns(self, node: str) -> int:
+        """Estimated clock offset of ``node`` vs the coordinator.
+
+        Zero until ``min_samples`` launch groups have paired the node
+        with the coordinator — under-evidenced correction is worse than
+        none.
+        """
+        if node == self.coordinator_node:
+            return 0
+        samples = self._samples.get(node)
+        if samples is None or len(samples) < self.min_samples:
+            return 0
+        return int(median(samples))
+
+    def correct(self, node: str, ts_unix_nano: int) -> int:
+        """Skew-correct one timestamp onto the coordinator's clock."""
+        return ts_unix_nano - self.offset_ns(node)
+
+    def offsets_ms(self) -> dict[str, float]:
+        """Current per-node offset estimates in milliseconds."""
+        return {
+            node: self.offset_ns(node) / 1e6
+            for node in sorted(self._samples)
+            if len(self._samples[node]) >= self.min_samples
+        }

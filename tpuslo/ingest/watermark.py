@@ -1,0 +1,48 @@
+"""Low-watermark admission for bounded out-of-order event streams.
+
+Streaming-systems discipline (the Flink/Beam watermark, applied to
+probe events): the stream's *watermark* trails the maximum event
+timestamp seen by an allowed-lateness bound.  Events at or above the
+watermark are admitted in (bounded) order; events below it are **late**
+— not dropped, but flagged so the caller can route them to a
+low-confidence re-match pass (``tpuslo.ingest.gate.rematch_late``)
+instead of letting a stale timestamp silently win a full-confidence
+window join.
+"""
+
+from __future__ import annotations
+
+DEFAULT_LATENESS_NS = 2_000_000_000  # matcher's global window (2 s)
+
+
+class Watermark:
+    """Tracks ``max(ts) - lateness`` over a monotone-ish event stream."""
+
+    def __init__(self, lateness_ns: int = DEFAULT_LATENESS_NS):
+        self.lateness_ns = max(0, lateness_ns)
+        self._max_ts = 0
+        self.admitted = 0
+        self.late = 0
+
+    @property
+    def watermark_ns(self) -> int:
+        """Current low watermark (0 until the first event)."""
+        if self._max_ts == 0:
+            return 0
+        return self._max_ts - self.lateness_ns
+
+    def lag_ns(self, ts_unix_nano: int) -> int:
+        """How far behind the stream head a timestamp sits (>= 0)."""
+        return max(0, self._max_ts - ts_unix_nano)
+
+    def admit(self, ts_unix_nano: int) -> bool:
+        """Advance the watermark; True = in order (within lateness)."""
+        if ts_unix_nano >= self._max_ts:
+            self._max_ts = ts_unix_nano
+            self.admitted += 1
+            return True
+        if ts_unix_nano >= self.watermark_ns:
+            self.admitted += 1
+            return True
+        self.late += 1
+        return False

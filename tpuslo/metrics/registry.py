@@ -177,6 +177,39 @@ class AgentMetrics:
             ["signal"],
             registry=self.registry,
         )
+        # ---- ingest-gate series (tpuslo.ingest) ----------------------
+        self.ingest_admitted = Counter(
+            "llm_slo_agent_ingest_admitted_events_total",
+            "Events admitted through the telemetry gate in order",
+            registry=self.registry,
+        )
+        self.ingest_duplicates = Counter(
+            "llm_slo_agent_ingest_duplicate_events_total",
+            "Events suppressed by the gate's dedup window",
+            registry=self.registry,
+        )
+        self.ingest_quarantined = Counter(
+            "llm_slo_agent_ingest_quarantined_events_total",
+            "Malformed events quarantined by the gate, by reason class",
+            ["reason"],
+            registry=self.registry,
+        )
+        self.ingest_late_admitted = Counter(
+            "llm_slo_agent_ingest_late_admitted_events_total",
+            "Events admitted behind the watermark (low-confidence path)",
+            registry=self.registry,
+        )
+        self.ingest_clock_skew_ms = Gauge(
+            "llm_slo_agent_ingest_clock_skew_ms",
+            "Estimated per-node clock offset vs the coordinator host",
+            ["node"],
+            registry=self.registry,
+        )
+        self.ingest_watermark_lag_ms = Gauge(
+            "llm_slo_agent_ingest_watermark_lag_ms",
+            "Lag of the most recent event behind the stream head",
+            registry=self.registry,
+        )
 
     def set_enabled_signals(self, enabled: list[str]) -> None:
         enabled_set = set(enabled)
@@ -203,6 +236,11 @@ class AgentMetrics:
         """Observer adapter wiring one DeliveryChannel to this registry
         (duck-typed against tpuslo.delivery.DeliveryObserver)."""
         return _PromDeliveryObserver(self, sink)
+
+    def ingest_observer(self) -> "_PromIngestObserver":
+        """Observer adapter wiring a TelemetryGate to this registry
+        (duck-typed against tpuslo.ingest.GateObserver)."""
+        return _PromIngestObserver(self)
 
 
 _BREAKER_STATE_VALUES = {"closed": 0, "half_open": 1, "open": 2}
@@ -252,6 +290,34 @@ class _PromDeliveryObserver:
 
     def truncated(self, batches: int) -> None:
         self._m.delivery_truncated.labels(sink=self._sink).inc(batches)
+
+
+class _PromIngestObserver:
+    """Bridge from telemetry-gate callbacks to Prometheus."""
+
+    def __init__(self, metrics: AgentMetrics):
+        self._m = metrics
+        # Touch the scalar series so dashboards see explicit zeros.
+        metrics.ingest_watermark_lag_ms.set(0)
+
+    def admitted(self) -> None:
+        self._m.ingest_admitted.inc()
+
+    def duplicate(self) -> None:
+        self._m.ingest_duplicates.inc()
+
+    def quarantined(self, reason: str) -> None:
+        self._m.ingest_quarantined.labels(reason=reason).inc()
+
+    def late(self, lag_ns: int) -> None:
+        self._m.ingest_late_admitted.inc()
+
+    def skew_offsets(self, offsets_ms: dict[str, float]) -> None:
+        for node, offset_ms in offsets_ms.items():
+            self._m.ingest_clock_skew_ms.labels(node=node).set(offset_ms)
+
+    def watermark_lag_ms(self, lag_ms: float) -> None:
+        self._m.ingest_watermark_lag_ms.set(lag_ms)
 
 
 def start_metrics_server(

@@ -44,6 +44,8 @@ _REQUIRED_KEYS = (
     "unit",
     "status",
 )
+# Public aliases for consumers that classify rejections (ingest gate).
+REQUIRED_PROBE_KEYS = _REQUIRED_KEYS
 _ALLOWED_KEYS = frozenset(_REQUIRED_KEYS) | {
     "conn_tuple",
     "trace_id",
@@ -270,6 +272,42 @@ def validate_probe_event(event: ProbeEventV1) -> bool:
     else:
         counters.slowpath_invalid += 1
     return ok
+
+
+# Reason classes for payloads the combined validator rejected.  Kept
+# beside the rules they mirror so a new/tightened fast-path rule and
+# its classification are edited (and reviewed) together.
+REJECT_NOT_OBJECT = "not_object"
+REJECT_MISSING_FIELD = "missing_field"
+REJECT_BAD_FIELD_TYPE = "bad_field_type"
+REJECT_SCHEMA = "schema_reject"
+
+
+def classify_probe_payload_reject(payload: Any) -> str:
+    """Why a payload failed validation (call only after a reject).
+
+    Coarser than jsonschema's error list — these are quarantine-triage
+    buckets, not error messages: framing bugs (``not_object``),
+    producer version skew (``missing_field``), corruption
+    (``bad_field_type``), and everything structurally typed but
+    contract-violating (``schema_reject``).
+    """
+    if type(payload) is not dict:
+        return REJECT_NOT_OBJECT
+    if any(key not in payload for key in _REQUIRED_KEYS):
+        return REJECT_MISSING_FIELD
+    ts = payload.get("ts_unix_nano")
+    checks = (
+        _is_int(ts) and ts >= 0,
+        all(type(payload.get(key)) is str for key in _STR_KEYS),
+        _is_int(payload.get("pid")) and payload.get("pid", -1) >= 0,
+        _is_int(payload.get("tid")) and payload.get("tid", -1) >= 0,
+        _is_num(payload.get("value")),
+        payload.get("status") in _STATUSES,
+    )
+    if not all(checks):
+        return REJECT_BAD_FIELD_TYPE
+    return REJECT_SCHEMA
 
 
 def validate_probe_payload(payload: dict[str, Any]) -> bool:

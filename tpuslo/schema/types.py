@@ -187,6 +187,16 @@ class ConnTuple:
     def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)
 
+    @classmethod
+    def from_dict(cls, raw: dict[str, Any]) -> "ConnTuple":
+        return cls(
+            src_ip=str(raw.get("src_ip", "")),
+            dst_ip=str(raw.get("dst_ip", "")),
+            src_port=int(raw.get("src_port", 0)),
+            dst_port=int(raw.get("dst_port", 0)),
+            protocol=str(raw.get("protocol", "")),
+        )
+
     def key(self) -> str:
         """Canonical string form used by correlation tier joins."""
         return (
@@ -239,6 +249,18 @@ class TPURef:
         if self.module_name:
             out["module_name"] = self.module_name
         return out
+
+    @classmethod
+    def from_dict(cls, raw: dict[str, Any]) -> "TPURef":
+        return cls(
+            chip=str(raw.get("chip", "")),
+            slice_id=str(raw.get("slice_id", "")),
+            host_index=int(raw.get("host_index", -1)),
+            ici_link=int(raw.get("ici_link", -1)),
+            program_id=str(raw.get("program_id", "")),
+            launch_id=int(raw.get("launch_id", -1)),
+            module_name=str(raw.get("module_name", "")),
+        )
 
 
 @dataclass(slots=True)
@@ -296,3 +318,37 @@ class ProbeEventV1:
             if tpu:
                 out["tpu"] = tpu
         return out
+
+    @classmethod
+    def from_dict(cls, raw: dict[str, Any]) -> "ProbeEventV1":
+        """Inverse of :meth:`to_dict` for schema-shaped payloads.
+
+        Raises ``TypeError`` / ``ValueError`` / ``KeyError`` on
+        malformed input — callers on ingest paths (the agent's chaos /
+        gate loop, JSONL consumers) catch and account for the drop.
+        """
+        conn = raw.get("conn_tuple")
+        tpu = raw.get("tpu")
+        return cls(
+            ts_unix_nano=int(raw["ts_unix_nano"]),
+            signal=str(raw["signal"]),
+            node=str(raw["node"]),
+            namespace=str(raw["namespace"]),
+            pod=str(raw["pod"]),
+            container=str(raw["container"]),
+            pid=int(raw["pid"]),
+            tid=int(raw["tid"]),
+            value=float(raw["value"]),
+            unit=str(raw["unit"]),
+            status=str(raw["status"]),
+            conn_tuple=ConnTuple.from_dict(conn) if conn else None,
+            trace_id=str(raw.get("trace_id", "")),
+            span_id=str(raw.get("span_id", "")),
+            errno=int(raw["errno"]) if raw.get("errno") is not None else None,
+            confidence=(
+                float(raw["confidence"])
+                if raw.get("confidence") is not None
+                else None
+            ),
+            tpu=TPURef.from_dict(tpu) if tpu else None,
+        )
