@@ -8,8 +8,8 @@ ARTIFACTS ?= artifacts
 .PHONY: all test test-fast native ebpf lint schema-validate \
 	correlation-gate fault-smoke replay-smoke ebpf-smoke bench \
 	bench-smoke chaos-smoke chaos-demo chaos-telemetry-smoke \
-	chaos-telemetry-sweep m5-candidate m5-gate helm-lint \
-	dashboards clean
+	chaos-telemetry-sweep crash-smoke crash-sweep m5-candidate \
+	m5-gate helm-lint dashboards clean
 
 all: native test
 
@@ -125,6 +125,23 @@ chaos-telemetry-sweep:
 	$(PY) -m tpuslo m5gate --chaos-sweep \
 		--summary-json $(ARTIFACTS)/chaos-telemetry/sweep.json \
 		--summary-md $(ARTIFACTS)/chaos-telemetry/sweep.md
+
+# Crash chaos (PR 2 broke the sink, PR 3 broke the source; this kills
+# the AGENT): one seeded kill -9 / restart cycle proving no torn line
+# replays, no cycle is lost, no webhook alert duplicates, and the
+# restart resumes warm from the state snapshot.  Same chaos pytest
+# marker (also slow, so tier-1 never runs it implicitly).
+crash-smoke:
+	$(PY) -m pytest tests/test_crash_runtime.py -q -m chaos
+
+# Full crash-sweep release gate: seeds x kill points of SIGKILL/restart
+# audits (see docs/evidence/crash-sweep.md + docs/runbooks/crash-recovery.md).
+crash-sweep:
+	mkdir -p $(ARTIFACTS)/crash
+	$(PY) -m tpuslo m5gate --crash-sweep \
+		--crash-root $(ARTIFACTS)/crash \
+		--summary-json $(ARTIFACTS)/crash/sweep.json \
+		--summary-md $(ARTIFACTS)/crash/sweep.md
 
 # Watchable version of the same story: collector dies mid-run, the
 # agent spools, the breaker trips, recovery replays the outage window

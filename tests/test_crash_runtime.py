@@ -1,0 +1,60 @@
+"""Crash chaos: kill -9 the real agent mid-run, restart, audit.
+
+One seeded kill/restart cycle from the ``tpuslo.chaos.crash`` harness
+(the full seeds × kill-points sweep runs via ``m5gate --crash-sweep``
+/ ``make crash-sweep``).  SIGKILL is the one failure mode no in-process
+test can fake: no atexit, no finally, no flush — whatever survives is
+exactly what was already durable.
+
+Marked ``chaos`` (run via ``make crash-smoke``) and ``slow`` (kept out
+of the tier-1 ``-m 'not slow'`` lane: real subprocesses, real signals,
+wall-clock cycles).
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from tpuslo.chaos.crash import run_crash_cycle
+
+pytestmark = [pytest.mark.chaos, pytest.mark.slow]
+
+
+def test_seeded_kill_restart_cycle(tmp_path):
+    result = run_crash_cycle(
+        tmp_path / "crash", seed=1337, kill_point=0.5, count=14,
+        interval_s=0.05,
+    )
+    assert result.passed, result.failures
+
+    # The three crash-safety contracts, stated explicitly:
+    assert result.torn_lines_replayed == 0
+    assert result.lost_cycles == 0
+    assert result.duplicate_alerts == 0
+
+    # And the warm-restore evidence: the restarted agent resumed from
+    # the snapshot with the ingest state intact.
+    assert result.resumed_cycle >= 1
+    assert "progress" in result.restored_components
+    assert "gate" in result.restored_components
+    assert "breakers" in result.restored_components
+    assert result.restored_watermark_ns > 0
+
+    # At-least-once overlap stays inside the post-snapshot window.
+    assert result.duplicate_event_lines <= 11
+
+
+def test_kill_mid_run_leaves_loadable_snapshot(tmp_path):
+    """The snapshot a SIGKILL leaves behind is complete, never torn —
+    the mkstemp + fsync + os.replace contract observed from outside."""
+    result = run_crash_cycle(
+        tmp_path / "crash", seed=7, kill_point=0.3, count=12,
+        interval_s=0.05,
+    )
+    assert result.passed, result.failures
+    snapshot_path = tmp_path / "crash" / "state" / "agent-state.json"
+    snapshot = json.loads(snapshot_path.read_text())
+    assert snapshot["schema_version"] == 1
+    assert "progress" in snapshot["components"]

@@ -1,0 +1,512 @@
+"""Crash-safe runtime state: StateStore, snapshot hooks, tear repair.
+
+Covers the PR 4 tentpole's durable-state layer at unit level (the
+subprocess kill/restart story lives in tests/test_crash_runtime.py):
+atomic snapshot write/read with staleness and version guards, the
+per-component export/restore registry, the dedup-digest parity
+contract for a restarted ingest gate, breaker/limiter/watermark/skew
+state portability, and the torn-line repairs for every append-mode
+write path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from tpuslo.delivery.breaker import (
+    STATE_CLOSED,
+    STATE_HALF_OPEN,
+    STATE_OPEN,
+    CircuitBreaker,
+)
+from tpuslo.delivery.spool import DiskSpool
+from tpuslo.ingest import GateConfig, TelemetryGate
+from tpuslo.ingest.skew import ClockSkewEstimator
+from tpuslo.ingest.watermark import Watermark
+from tpuslo.runtime import (
+    RESTORE_COLD,
+    RESTORE_CORRUPT,
+    RESTORE_RESTORED,
+    RESTORE_STALE,
+    RESTORE_VERSION,
+    AgentRuntime,
+    StateStore,
+    repair_jsonl_tail,
+)
+from tpuslo.safety import RateLimiter
+
+
+class FakeClock:
+    def __init__(self, now: float = 1000.0):
+        self.now = now
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+
+# ---- StateStore --------------------------------------------------------
+
+
+class TestStateStore:
+    def test_save_load_roundtrip(self, tmp_path):
+        store = StateStore(tmp_path / "state.json")
+        assert store.save({"a": {"x": 1}, "b": [1, 2]})
+        outcome, components, age = store.load()
+        assert outcome == RESTORE_RESTORED
+        assert components == {"a": {"x": 1}, "b": [1, 2]}
+        assert age >= 0.0
+
+    def test_missing_snapshot_is_cold(self, tmp_path):
+        store = StateStore(tmp_path / "state.json")
+        outcome, components, _ = store.load()
+        assert outcome == RESTORE_COLD
+        assert components == {}
+
+    def test_corrupt_snapshot(self, tmp_path):
+        path = tmp_path / "state.json"
+        path.write_text('{"schema_version": 1, "saved_at": 12')
+        outcome, components, _ = StateStore(path).load()
+        assert outcome == RESTORE_CORRUPT
+        assert components == {}
+
+    def test_version_mismatch(self, tmp_path):
+        path = tmp_path / "state.json"
+        path.write_text(
+            json.dumps(
+                {"schema_version": 999, "saved_at": 1.0, "components": {}}
+            )
+        )
+        outcome, _, _ = StateStore(path).load()
+        assert outcome == RESTORE_VERSION
+
+    def test_stale_snapshot(self, tmp_path):
+        clock = FakeClock()
+        store = StateStore(
+            tmp_path / "state.json", max_age_s=60.0, walltime=clock
+        )
+        store.save({"a": 1})
+        clock.advance(61.0)
+        outcome, components, age = store.load()
+        assert outcome == RESTORE_STALE
+        assert components == {}
+        assert age > 60.0
+
+    def test_atomic_write_leaves_no_temp_files(self, tmp_path):
+        store = StateStore(tmp_path / "state.json")
+        for i in range(5):
+            store.save({"i": i})
+        leftovers = [
+            name for name in os.listdir(tmp_path) if name != "state.json"
+        ]
+        assert leftovers == []
+
+    def test_maybe_save_respects_interval(self, tmp_path):
+        clock = FakeClock()
+        store = StateStore(
+            tmp_path / "state.json", interval_s=10.0, walltime=clock
+        )
+        assert store.maybe_save(lambda: {"n": 1})
+        assert not store.maybe_save(lambda: {"n": 2})
+        clock.advance(10.0)
+        assert store.maybe_save(lambda: {"n": 3})
+        assert store.load()[1] == {"n": 3}
+
+    def test_interval_zero_saves_every_call(self, tmp_path):
+        store = StateStore(tmp_path / "state.json", interval_s=0.0)
+        assert store.maybe_save(lambda: {"n": 1})
+        assert store.maybe_save(lambda: {"n": 2})
+        assert store.saves == 2
+
+    def test_unserializable_state_is_counted_not_raised(self, tmp_path):
+        store = StateStore(tmp_path / "state.json")
+        assert not store.save({"bad": object()})
+        assert store.save_errors == 1
+
+
+# ---- AgentRuntime ------------------------------------------------------
+
+
+class TestAgentRuntime:
+    def test_export_restore_roundtrip(self, tmp_path):
+        store = StateStore(tmp_path / "state.json")
+        runtime = AgentRuntime(store)
+        state = {"value": 7}
+        runtime.register(
+            "comp", lambda: dict(state), lambda s: state.update(s)
+        )
+        runtime.snapshot_now()
+
+        state2 = {"value": 0}
+        runtime2 = AgentRuntime(StateStore(tmp_path / "state.json"))
+        runtime2.register(
+            "comp", lambda: dict(state2), lambda s: state2.update(s)
+        )
+        assert runtime2.restore() == RESTORE_RESTORED
+        assert state2 == {"value": 7}
+        assert runtime2.restored_components == ["comp"]
+
+    def test_late_registration_applies_pending_state(self, tmp_path):
+        store = StateStore(tmp_path / "state.json")
+        AgentRuntime(store).store.save({"late": {"v": 3}})
+
+        runtime = AgentRuntime(StateStore(tmp_path / "state.json"))
+        assert runtime.restore() == RESTORE_RESTORED
+        assert runtime.restored_components == []
+        seen = {}
+        runtime.register("late", lambda: seen, lambda s: seen.update(s))
+        assert seen == {"v": 3}
+        assert runtime.restored_components == ["late"]
+
+    def test_restore_isolates_component_failures(self, tmp_path):
+        StateStore(tmp_path / "state.json").save(
+            {"good": {"v": 1}, "bad": {"v": 2}}
+        )
+        runtime = AgentRuntime(StateStore(tmp_path / "state.json"))
+        good = {}
+
+        def explode(state):
+            raise RuntimeError("boom")
+
+        runtime.register("bad", lambda: {}, explode)
+        runtime.register("good", lambda: good, lambda s: good.update(s))
+        assert runtime.restore() == RESTORE_RESTORED
+        assert good == {"v": 1}
+        assert runtime.restore_errors == ["bad"]
+
+    def test_cold_start_flag_skips_restore(self, tmp_path):
+        StateStore(tmp_path / "state.json").save({"c": {"v": 1}})
+        runtime = AgentRuntime(StateStore(tmp_path / "state.json"))
+        target = {}
+        runtime.register("c", lambda: target, lambda s: target.update(s))
+        assert runtime.restore(cold_start=True) == "forced_cold"
+        assert target == {}
+
+    def test_disabled_runtime_is_cold(self):
+        runtime = AgentRuntime(None)
+        assert runtime.restore() == RESTORE_COLD
+        assert not runtime.maybe_snapshot()
+        assert not runtime.snapshot_now()
+
+    def test_export_isolates_exporter_failures(self, tmp_path):
+        runtime = AgentRuntime(StateStore(tmp_path / "state.json"))
+        runtime.register("ok", lambda: {"v": 1}, lambda s: None)
+
+        def explode():
+            raise RuntimeError("export boom")
+
+        runtime.register("broken", explode, lambda s: None)
+        assert runtime.snapshot_now()
+        _, components, _ = runtime.store.load()
+        assert components == {"ok": {"v": 1}}
+
+
+# ---- component snapshot hooks -----------------------------------------
+
+
+class TestRateLimiterState:
+    def test_budget_survives_restart(self):
+        clock = FakeClock()
+        limiter = RateLimiter(10, burst=10, clock=clock)
+        for _ in range(7):
+            assert limiter.allow()
+        exported = limiter.export_state()
+
+        limiter2 = RateLimiter(10, burst=10, clock=clock)
+        limiter2.restore_state(exported)
+        assert limiter2.tokens == limiter.tokens
+
+    def test_restore_clamps_to_capacity(self):
+        limiter = RateLimiter(10, burst=10, clock=FakeClock())
+        limiter.restore_state({"tokens": 99999.0})
+        assert limiter.tokens == 10.0
+        limiter.restore_state({"tokens": -5})
+        assert limiter.tokens == 0.0
+        limiter.restore_state({"tokens": "junk"})  # ignored, no raise
+        assert limiter.tokens == 0.0
+
+
+class TestBreakerState:
+    def test_open_breaker_keeps_remaining_cooldown(self):
+        clock = FakeClock()
+        breaker = CircuitBreaker(
+            failure_threshold=2, open_duration_s=10.0, clock=clock
+        )
+        breaker.record_failure()
+        breaker.record_failure()
+        assert breaker.state == STATE_OPEN
+        clock.advance(4.0)
+        exported = breaker.export_state()
+        assert 5.9 <= exported["open_remaining_s"] <= 6.0
+
+        clock2 = FakeClock(5000.0)
+        restored = CircuitBreaker(
+            failure_threshold=2, open_duration_s=10.0, clock=clock2
+        )
+        restored.restore_state(exported)
+        assert restored.state == STATE_OPEN
+        assert not restored.allow()
+        clock2.advance(6.1)
+        assert restored.state == STATE_HALF_OPEN
+        assert restored.allow()  # half-open probe slot
+
+    def test_closed_breaker_restores_closed(self):
+        breaker = CircuitBreaker(clock=FakeClock())
+        exported = breaker.export_state()
+        restored = CircuitBreaker(clock=FakeClock())
+        restored.record_failure()
+        restored.restore_state(exported)
+        assert restored.state == STATE_CLOSED
+        assert restored.allow()
+
+    def test_garbage_state_is_ignored(self):
+        breaker = CircuitBreaker(clock=FakeClock())
+        breaker.restore_state({"state": "bogus"})
+        assert breaker.state == STATE_CLOSED
+
+
+class TestWatermarkState:
+    def test_restore_resumes_head(self):
+        wm = Watermark(lateness_ns=1000)
+        wm.admit(5_000)
+        exported = wm.export_state()
+
+        wm2 = Watermark(lateness_ns=1000)
+        wm2.restore_state(exported)
+        assert wm2.watermark_ns == 4_000
+        assert wm2.admit(4_500)
+        assert not wm2.admit(100)  # behind the restored watermark: late
+
+    def test_restore_never_moves_backwards(self):
+        wm = Watermark(lateness_ns=1000)
+        wm.admit(9_000)
+        wm.restore_state({"max_ts": 5_000})
+        assert wm.watermark_ns == 8_000
+
+
+class TestSkewState:
+    @staticmethod
+    def _collective(node: str, host: int, launch: int, ts: int) -> dict:
+        return {
+            "ts_unix_nano": ts,
+            "signal": "ici_collective_latency_ms",
+            "node": node,
+            "tpu": {
+                "slice_id": "slice-a",
+                "program_id": "prog",
+                "host_index": host,
+                "launch_id": launch,
+            },
+        }
+
+    def test_offsets_survive_restart(self):
+        est = ClockSkewEstimator(min_samples=3)
+        for launch in range(4):
+            base = 1_000_000_000 + launch * 10_000_000
+            est.observe(self._collective("node-0", 0, launch, base))
+            est.observe(
+                self._collective("node-1", 1, launch, base + 250_000)
+            )
+        assert est.offset_ns("node-1") == 250_000
+
+        est2 = ClockSkewEstimator(min_samples=3)
+        est2.restore_state(est.export_state())
+        assert est2.offset_ns("node-1") == 250_000
+        assert est2.coordinator_node == "node-0"
+        # Live evidence keeps accumulating on top of the restored window.
+        base = 2_000_000_000
+        est2.observe(self._collective("node-0", 0, 99, base))
+        est2.observe(self._collective("node-1", 1, 99, base + 250_000))
+        assert est2.offset_ns("node-1") == 250_000
+
+
+# ---- gate dedup-digest parity (satellite: restart vs uninterrupted) ----
+
+
+def _probe(i: int, ts: int) -> dict:
+    return {
+        "ts_unix_nano": ts,
+        "signal": "dns_latency_ms",
+        "node": "node-a",
+        "namespace": "llm",
+        "pod": f"pod-{i % 3}",
+        "container": "svc",
+        "pid": 10 + i,
+        "tid": 10 + i,
+        "value": float(i),
+        "unit": "ms",
+        "status": "ok",
+    }
+
+
+class TestGateDedupDigestParity:
+    def test_restarted_gate_rejects_pre_crash_window(self):
+        base = 1_700_000_000_000_000_000
+        first = [_probe(i, base + i * 1_000_000) for i in range(40)]
+        second = [_probe(i, base + (40 + i) * 1_000_000) for i in range(40)]
+        # The replayed tail: exact duplicates of the last pre-crash
+        # events (spool replay / exporter retransmit across the crash).
+        replayed = [dict(e) for e in first[-10:]]
+
+        # Uninterrupted reference run.
+        ref = TelemetryGate(GateConfig(skew_correction=False))
+        for event in first + replayed + second:
+            ref.admit(event)
+
+        # Crash between `first` and the replay: state crosses via
+        # export/restore only.
+        gate1 = TelemetryGate(GateConfig(skew_correction=False))
+        for event in first:
+            gate1.admit(event)
+        exported = gate1.export_state()
+
+        gate2 = TelemetryGate(GateConfig(skew_correction=False))
+        gate2.restore_state(exported)
+        outcomes = [gate2.admit(dict(e))[0] for e in replayed]
+        assert outcomes == ["duplicate"] * len(replayed)
+        for event in second:
+            outcome, _ = gate2.admit(event)
+            assert outcome == "admitted"
+
+        # Parity: the split run admits and deduplicates exactly what
+        # the uninterrupted run did.
+        assert gate1.admitted + gate2.admitted == ref.admitted
+        assert gate1.duplicates + gate2.duplicates == ref.duplicates
+
+    def test_restored_watermark_flags_stale_replays_late(self):
+        base = 1_700_000_000_000_000_000
+        gate1 = TelemetryGate(
+            GateConfig(skew_correction=False, watermark_lateness_ms=1)
+        )
+        for i in range(10):
+            gate1.admit(_probe(i, base + i * 50_000_000))
+        exported = gate1.export_state()
+
+        gate2 = TelemetryGate(
+            GateConfig(skew_correction=False, watermark_lateness_ms=1)
+        )
+        gate2.restore_state(exported)
+        # A *new* event carrying a pre-crash-era timestamp (not an
+        # exact duplicate) must be late, not silently in-order.
+        stale = _probe(99, base)
+        outcome, _ = gate2.admit(stale)
+        assert outcome == "late"
+
+    def test_restored_digests_age_out_after_one_window(self):
+        """The inherited digest set (and its per-event digest cost)
+        drops once a full window of live identities has accumulated —
+        matching the bounded-LRU aging an uninterrupted gate applies."""
+        base = 1_700_000_000_000_000_000
+        gate1 = TelemetryGate(
+            GateConfig(skew_correction=False, dedup_window=8)
+        )
+        for i in range(8):
+            gate1.admit(_probe(i, base + i * 1_000_000))
+        exported = gate1.export_state()
+
+        gate2 = TelemetryGate(
+            GateConfig(skew_correction=False, dedup_window=8)
+        )
+        gate2.restore_state(exported)
+        assert gate2._restored_digests
+        for i in range(8):  # one full window of fresh admissions
+            gate2.admit(_probe(100 + i, base + (100 + i) * 1_000_000))
+        assert not gate2._restored_digests
+        # Pre-crash identities older than the window now re-admit,
+        # exactly as the LRU would have aged them in one process.
+        outcome, _ = gate2.admit(_probe(0, base))
+        assert outcome in ("admitted", "late")
+
+    def test_digest_export_is_bounded_by_window(self):
+        gate = TelemetryGate(
+            GateConfig(skew_correction=False, dedup_window=16)
+        )
+        base = 1_700_000_000_000_000_000
+        for i in range(100):
+            gate.admit(_probe(i, base + i * 1_000_000))
+        exported = gate.export_state()
+        assert len(exported["dedup_digests"]) <= 16
+
+
+# ---- torn-line repair (satellite: kill-mid-write atomicity audit) ------
+
+
+class TestTornLineRepair:
+    def test_torn_tail_is_truncated_once(self, tmp_path):
+        path = tmp_path / "events.jsonl"
+        path.write_text('{"a": 1}\n{"b": 2}\n{"torn": ')
+        trimmed = repair_jsonl_tail(path)
+        assert trimmed == len('{"torn": ')
+        assert path.read_text() == '{"a": 1}\n{"b": 2}\n'
+        assert repair_jsonl_tail(path) == 0  # idempotent
+
+    def test_clean_missing_and_empty_files(self, tmp_path):
+        clean = tmp_path / "clean.jsonl"
+        clean.write_text('{"a": 1}\n')
+        assert repair_jsonl_tail(clean) == 0
+        assert repair_jsonl_tail(tmp_path / "missing.jsonl") == 0
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("")
+        assert repair_jsonl_tail(empty) == 0
+
+    def test_torn_single_line_file_truncates_to_empty(self, tmp_path):
+        path = tmp_path / "one.jsonl"
+        path.write_text('{"only": ')
+        assert repair_jsonl_tail(path) == len('{"only": ')
+        assert path.read_text() == ""
+
+    def test_writers_repair_on_append_reopen(self, tmp_path):
+        from tpuslo.cli.common import EventWriters
+
+        path = tmp_path / "out.jsonl"
+        path.write_text('{"kind": "probe", "ok": true}\n{"kind": "pr')
+        writers = EventWriters(output="jsonl", jsonl_path=str(path))
+        try:
+            assert writers.jsonl_repaired_bytes == len('{"kind": "pr')
+        finally:
+            writers.close()
+        for line in path.read_text().splitlines():
+            json.loads(line)  # every surviving line parses
+
+
+class TestSpoolTornLines:
+    """Kill-mid-write on the spool: torn records are skipped exactly once."""
+
+    def _spool_with_tear(self, tmp_path) -> DiskSpool:
+        spool = DiskSpool(tmp_path / "spool", segment_max_bytes=1 << 20)
+        for i in range(5):
+            spool.append({"seq": i})
+        spool.seal()
+        segment = sorted((tmp_path / "spool").glob("seg-*.jsonl"))[0]
+        raw = segment.read_bytes()
+        segment.write_bytes(raw[: len(raw) - 9])  # tear the final record
+        return spool
+
+    def test_torn_record_never_replayed(self, tmp_path):
+        spool = self._spool_with_tear(tmp_path)
+        replayed: list[dict] = []
+        spool.drain(replayed.append)
+        assert [r["seq"] for r in replayed] == [0, 1, 2, 3]
+
+    def test_torn_record_never_seen_twice(self, tmp_path):
+        spool = self._spool_with_tear(tmp_path)
+        first: list[dict] = []
+        spool.drain(first.append)
+        second: list[dict] = []
+        spool.drain(second.append)
+        assert len(first) == 4
+        assert second == []  # drained segments are gone, tear included
+
+    def test_reopened_spool_skips_tear_and_appends_cleanly(self, tmp_path):
+        self._spool_with_tear(tmp_path).close()
+        # Next incarnation adopts the directory; the tear stays isolated
+        # in its own (sealed) segment and new appends open a new one.
+        spool2 = DiskSpool(tmp_path / "spool", segment_max_bytes=1 << 20)
+        spool2.append({"seq": 100})
+        spool2.seal()
+        replayed: list[dict] = []
+        spool2.drain(replayed.append)
+        assert [r["seq"] for r in replayed] == [0, 1, 2, 3, 100]

@@ -1,0 +1,320 @@
+"""ProbeSupervisor: heartbeats, restarts, backoff, flap shed, hold-down.
+
+Includes the supervisor ↔ overhead-guard interplay contract: a signal
+the supervisor shed for flapping must not be immediately restored by
+``ShedRecoveryPolicy``-authorized ``restore_one`` calls, and the
+restore order stays reverse-cost when the hold-down expires.
+"""
+
+from __future__ import annotations
+
+from tpuslo.runtime import ProbeSupervisor, SupervisorConfig
+from tpuslo.runtime.supervisor import (
+    ACTION_FLAP_SHED,
+    ACTION_RESTART_FAILED,
+    ACTION_RESTARTED,
+    REASON_FLAPPING,
+)
+from tpuslo.safety import ShedRecoveryPolicy
+from tpuslo.safety.overhead_guard import OverheadResult
+
+
+class FakeClock:
+    def __init__(self, now: float = 1000.0):
+        self.now = now
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+
+def make_supervisor(
+    clock,
+    restart_ok=True,
+    heartbeat_timeout_s=10.0,
+    flap_restarts=3,
+    flap_window_s=100.0,
+    flap_holddown_s=300.0,
+):
+    calls = {"restarts": [], "sheds": []}
+
+    def restart(signal):
+        calls["restarts"].append(signal)
+        return restart_ok
+
+    def shed(signal, reason):
+        calls["sheds"].append((signal, reason))
+
+    supervisor = ProbeSupervisor(
+        config=SupervisorConfig(
+            heartbeat_timeout_s=heartbeat_timeout_s,
+            restart_backoff_base_s=1.0,
+            restart_backoff_cap_s=60.0,
+            flap_restarts=flap_restarts,
+            flap_window_s=flap_window_s,
+            flap_holddown_s=flap_holddown_s,
+        ),
+        restart=restart,
+        shed=shed,
+        clock=clock,
+    )
+    return supervisor, calls
+
+
+class TestHeartbeat:
+    def test_fresh_heartbeat_means_no_action(self):
+        clock = FakeClock()
+        supervisor, calls = make_supervisor(clock)
+        supervisor.watch(["dns_latency_ms"])
+        clock.advance(5.0)
+        supervisor.beat("dns_latency_ms")
+        clock.advance(5.0)
+        assert supervisor.evaluate() == []
+        assert calls["restarts"] == []
+        assert supervisor.heartbeat_age_s("dns_latency_ms") == 5.0
+
+    def test_beat_on_unwatched_signal_is_ignored(self):
+        supervisor, _ = make_supervisor(FakeClock())
+        supervisor.beat("never_watched")  # no raise
+        assert supervisor.heartbeat_age_s("never_watched") == 0.0
+
+    def test_dead_probe_is_restarted(self):
+        clock = FakeClock()
+        supervisor, calls = make_supervisor(clock)
+        supervisor.watch(["dns_latency_ms", "tcp_retransmits_total"])
+        supervisor.beat("dns_latency_ms")  # proven alive once
+        clock.advance(11.0)
+        supervisor.beat("tcp_retransmits_total")
+        events = supervisor.evaluate()
+        assert [e.action for e in events] == [ACTION_RESTARTED]
+        assert calls["restarts"] == ["dns_latency_ms"]
+        # A successful restart grants a fresh heartbeat window.
+        assert supervisor.evaluate() == []
+
+    def test_unproven_quiet_probe_is_never_restarted(self):
+        """A signal that legitimately emits nothing (zero retransmits
+        on a healthy network) must not be churned or flap-shed."""
+        clock = FakeClock()
+        supervisor, calls = make_supervisor(clock)
+        supervisor.watch(["tcp_retransmits_total"])
+        for _ in range(50):
+            clock.advance(60.0)
+            assert supervisor.evaluate() == []
+        assert calls["restarts"] == []
+        assert calls["sheds"] == []
+
+
+class TestBackoff:
+    def test_failed_restarts_back_off_exponentially(self):
+        clock = FakeClock()
+        supervisor, calls = make_supervisor(clock, restart_ok=False)
+        supervisor.watch(["dns_latency_ms"])
+        supervisor.beat("dns_latency_ms")
+        clock.advance(11.0)
+        assert supervisor.evaluate()[0].action == ACTION_RESTART_FAILED
+        assert supervisor.evaluate() == []  # inside 1s backoff
+        clock.advance(1.0)
+        assert supervisor.evaluate()[0].action == ACTION_RESTART_FAILED
+        clock.advance(1.0)
+        assert supervisor.evaluate() == []  # backoff doubled to 2s
+        clock.advance(1.0)
+        assert supervisor.evaluate()[0].action == ACTION_RESTART_FAILED
+        assert len(calls["restarts"]) == 3
+        assert supervisor.restarts_total == 3
+
+
+class TestFlapShed:
+    def test_k_restarts_in_window_sheds_with_reason(self):
+        clock = FakeClock()
+        supervisor, calls = make_supervisor(clock, restart_ok=True)
+        supervisor.watch(["dns_latency_ms"])
+        supervisor.beat("dns_latency_ms")
+        # Probe "recovers" after each restart, then dies again: the
+        # flap pattern a dead-probe counter alone cannot see.
+        for _ in range(3):
+            clock.advance(11.0)
+            events = supervisor.evaluate()
+            assert events and events[0].action == ACTION_RESTARTED
+        clock.advance(11.0)
+        events = supervisor.evaluate()
+        assert [e.action for e in events] == [ACTION_FLAP_SHED]
+        assert calls["sheds"] == [("dns_latency_ms", REASON_FLAPPING)]
+        assert supervisor.shed_reasons == {
+            "dns_latency_ms": REASON_FLAPPING
+        }
+        assert supervisor.flap_sheds_total == 1
+        # Shed probes are no longer supervised (no restart storms).
+        clock.advance(50.0)
+        assert supervisor.evaluate() == []
+
+    def test_old_restarts_age_out_of_the_window(self):
+        clock = FakeClock()
+        supervisor, calls = make_supervisor(
+            clock, restart_ok=True, flap_window_s=30.0
+        )
+        supervisor.watch(["dns_latency_ms"])
+        supervisor.beat("dns_latency_ms")
+        for _ in range(6):
+            clock.advance(40.0)  # each restart falls out of the window
+            events = supervisor.evaluate()
+            assert [e.action for e in events] == [ACTION_RESTARTED]
+        assert calls["sheds"] == []
+
+
+class TestHoldDown:
+    def _flap_shed_signal(self, clock, supervisor):
+        supervisor.watch(["dns_latency_ms"])
+        supervisor.beat("dns_latency_ms")
+        for _ in range(3):
+            clock.advance(11.0)
+            supervisor.evaluate()
+        clock.advance(11.0)
+        supervisor.evaluate()
+
+    def test_may_restore_blocks_until_holddown_expires(self):
+        clock = FakeClock()
+        supervisor, _ = make_supervisor(clock, flap_holddown_s=300.0)
+        self._flap_shed_signal(clock, supervisor)
+        assert not supervisor.may_restore("dns_latency_ms")
+        clock.advance(299.0)
+        assert not supervisor.may_restore("dns_latency_ms")
+        clock.advance(2.0)
+        assert supervisor.may_restore("dns_latency_ms")
+        assert supervisor.shed_reasons == {}  # hold-down cleared
+
+    def test_unheld_signals_are_always_restorable(self):
+        supervisor, _ = make_supervisor(FakeClock())
+        assert supervisor.may_restore("anything")
+
+    def test_note_restored_resumes_supervision(self):
+        clock = FakeClock()
+        supervisor, _ = make_supervisor(clock)
+        self._flap_shed_signal(clock, supervisor)
+        clock.advance(301.0)
+        supervisor.note_restored("dns_latency_ms")
+        assert "dns_latency_ms" in supervisor.snapshot()["watched"]
+
+    def test_holddown_survives_snapshot_restore(self):
+        clock = FakeClock()
+        supervisor, _ = make_supervisor(clock, flap_holddown_s=300.0)
+        self._flap_shed_signal(clock, supervisor)
+        clock.advance(100.0)
+        exported = supervisor.export_state()
+
+        clock2 = FakeClock(90_000.0)  # a different monotonic epoch
+        restored, _ = make_supervisor(clock2, flap_holddown_s=300.0)
+        restored.restore_state(exported)
+        assert not restored.may_restore("dns_latency_ms")
+        assert restored.shed_reasons == {
+            "dns_latency_ms": REASON_FLAPPING
+        }
+        clock2.advance(201.0)  # 100s already served before the crash
+        assert restored.may_restore("dns_latency_ms")
+
+
+class TestRecoveryPolicyInterplay:
+    """Flap hold-down outranks the overhead-guard recovery streak."""
+
+    @staticmethod
+    def _under_budget() -> OverheadResult:
+        return OverheadResult(
+            valid=True, cpu_pct=0.5, budget_pct=3.0, over_budget=False
+        )
+
+    def test_flap_shed_is_not_restored_by_recovery_streak(self):
+        """The agent-loop contract, end to end against a fake manager:
+
+        guard-shed signals restore in reverse cost order as streaks
+        authorize them, but a flap-shed signal parks the restore until
+        its hold-down expires — and then restores last-shed-first.
+        """
+        clock = FakeClock()
+        supervisor, _ = make_supervisor(clock, flap_holddown_s=300.0)
+
+        # Fake ProbeManager shed machinery: shed order cheap→costly is
+        # [syscall (guard), dns (flap), tcp (guard)]; restore pops the
+        # tail (reverse cost order).
+        shed_list = ["syscall_latency_ms"]
+
+        def flap_shed(signal, reason):
+            shed_list.append(signal)
+
+        supervisor._shed = flap_shed
+        supervisor.watch(["dns_latency_ms"])
+        supervisor.beat("dns_latency_ms")
+        for _ in range(3):
+            clock.advance(11.0)
+            supervisor.evaluate()
+        clock.advance(11.0)
+        supervisor.evaluate()
+        shed_list.append("tcp_retransmits_total")  # later guard shed
+        assert shed_list == [
+            "syscall_latency_ms",
+            "dns_latency_ms",
+            "tcp_retransmits_total",
+        ]
+
+        recovery = ShedRecoveryPolicy(cycles=2)
+        restored_order = []
+        for _ in range(40):
+            clock.advance(1.0)
+            if not recovery.note(self._under_budget()):
+                continue
+            candidate = shed_list[-1] if shed_list else None
+            if candidate is None:
+                continue
+            if not supervisor.may_restore(candidate):
+                continue  # held down: the streak is spent, not the shed
+            restored_order.append(shed_list.pop())
+            supervisor.note_restored(candidate)
+
+        # tcp restores immediately; dns is held (clock only advanced
+        # ~40s into the 300s hold-down) and blocks syscall behind it —
+        # restore order stays strictly reverse-cost, never reordered
+        # around the hold.
+        assert restored_order == ["tcp_retransmits_total"]
+        assert shed_list == ["syscall_latency_ms", "dns_latency_ms"]
+
+        # Hold-down expiry releases the rest, still reverse-cost.
+        clock.advance(400.0)
+        for _ in range(40):
+            clock.advance(1.0)
+            if not recovery.note(self._under_budget()):
+                continue
+            candidate = shed_list[-1] if shed_list else None
+            if candidate is None:
+                continue
+            if not supervisor.may_restore(candidate):
+                continue
+            restored_order.append(shed_list.pop())
+            supervisor.note_restored(candidate)
+        assert restored_order == [
+            "tcp_retransmits_total",
+            "dns_latency_ms",
+            "syscall_latency_ms",
+        ]
+
+    def test_recovery_streak_is_consumed_by_a_held_candidate(self):
+        """A blocked restore does not bank the authorization."""
+        clock = FakeClock()
+        supervisor, _ = make_supervisor(clock, flap_holddown_s=300.0)
+        supervisor.watch(["dns_latency_ms"])
+        supervisor.beat("dns_latency_ms")
+        for _ in range(3):
+            clock.advance(11.0)
+            supervisor.evaluate()
+        clock.advance(11.0)
+        supervisor.evaluate()
+
+        recovery = ShedRecoveryPolicy(cycles=3)
+        authorized = 0
+        for _ in range(9):
+            if recovery.note(self._under_budget()):
+                authorized += 1
+                assert not supervisor.may_restore("dns_latency_ms")
+        # Three full streaks authorized; none restored; streak state
+        # reset each time (no instant restore after expiry mid-streak).
+        assert authorized == 3
+        assert recovery.streak == 0

@@ -1,0 +1,452 @@
+"""Crash chaos harness: SIGKILL the agent mid-run, restart, audit.
+
+The delivery chaos harness (PR 2) broke the *sink*; the telemetry
+chaos harness (PR 3) broke the *source*; this one kills the **agent
+process itself** — ``kill -9``, no drain, no atexit, at a seeded cycle
+point — then restarts it against the same state dir and audits the
+combined evidence for the three crash-safety contracts:
+
+1. **No torn line is ever replayed**: the restarted run's output file
+   parses line-for-line (the pre-crash tail tear was repaired, not
+   welded into the next record).
+2. **No event is lost beyond the dedup window**: every synthetic cycle
+   appears in the combined output; re-emitted overlap from the
+   post-snapshot window is bounded and absorbed downstream.
+3. **No duplicate webhook alert**: the restored alert high-water mark
+   keeps incident pages at-most-once across the restart.
+
+Everything runs against real subprocesses and real SIGKILL — the one
+failure mode a unit test cannot fake — and the report doubles as the
+``m5gate --crash-sweep`` release-gate evidence
+(docs/evidence/crash-sweep.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import shutil
+import signal
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+DEFAULT_SEEDS = (1, 2, 3, 4, 5)
+DEFAULT_KILL_POINTS = (0.25, 0.5, 0.8)
+DEFAULT_COUNT = 16
+DEFAULT_INTERVAL_S = 0.05
+_STARTUP_TIMEOUT_S = 90.0
+_RUN_TIMEOUT_S = 120.0
+
+_CRASH_CONFIG = """\
+apiVersion: toolkit.tpuslo.dev/v1alpha1
+kind: ToolkitConfig
+signal_set: [dns_latency_ms, tcp_retransmits_total]
+sampling: {events_per_second_limit: 10000, burst_limit: 20000}
+correlation: {window_ms: 2000, enrichment_threshold: 0.7}
+otlp: {endpoint: "http://unused-placeholder:4318/v1/logs"}
+safety: {max_overhead_pct: 1000.0}
+ingest:
+  dedup_window: 8192
+  watermark_lateness_ms: 60000
+"""
+
+
+class _AlertCollector(ThreadingHTTPServer):
+    """Minimal webhook receiver recording every incident id it sees."""
+
+    def __init__(self):
+        self.incident_ids: list[str] = []
+        self._lock = threading.Lock()
+        collector = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                try:
+                    incident = json.loads(body).get("incident_id", "")
+                except (ValueError, AttributeError):
+                    incident = ""
+                with collector._lock:
+                    collector.incident_ids.append(str(incident))
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def log_message(self, *args):
+                pass
+
+        super().__init__(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(
+            target=self.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self.server_address[1]}/"
+
+    def stop(self) -> None:
+        self.shutdown()
+        self.server_close()
+
+
+@dataclass
+class CrashRunResult:
+    """One seeded kill/restart cycle's audited outcome."""
+
+    seed: int
+    kill_point: float
+    kill_cycle: int
+    resumed_cycle: int
+    torn_lines_replayed: int
+    lost_cycles: int
+    duplicate_alerts: int
+    duplicate_event_lines: int
+    alerts_total: int
+    restored_components: list[str]
+    restored_watermark_ns: int
+    snapshot_age_s: float
+    failures: list[str] = field(default_factory=list)
+
+    @property
+    def passed(self) -> bool:
+        return not self.failures
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "seed": self.seed,
+            "kill_point": self.kill_point,
+            "kill_cycle": self.kill_cycle,
+            "resumed_cycle": self.resumed_cycle,
+            "torn_lines_replayed": self.torn_lines_replayed,
+            "lost_cycles": self.lost_cycles,
+            "duplicate_alerts": self.duplicate_alerts,
+            "duplicate_event_lines": self.duplicate_event_lines,
+            "alerts_total": self.alerts_total,
+            "restored_components": list(self.restored_components),
+            "restored_watermark_ns": self.restored_watermark_ns,
+            "snapshot_age_s": self.snapshot_age_s,
+            "passed": self.passed,
+            "failures": list(self.failures),
+        }
+
+
+@dataclass
+class CrashSweepReport:
+    """Aggregate verdict across seeds × kill points."""
+
+    count: int
+    interval_s: float
+    runs: list[CrashRunResult] = field(default_factory=list)
+
+    @property
+    def passed(self) -> bool:
+        return bool(self.runs) and all(r.passed for r in self.runs)
+
+    @property
+    def failures(self) -> list[str]:
+        out = []
+        for run in self.runs:
+            for failure in run.failures:
+                out.append(
+                    f"seed {run.seed} @ {run.kill_point:g}: {failure}"
+                )
+        return out
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "count": self.count,
+            "interval_s": self.interval_s,
+            "passed": self.passed,
+            "failures": self.failures,
+            "runs": [r.to_dict() for r in self.runs],
+        }
+
+
+def _agent_cmd(
+    config: str, jsonl: str, state_dir: str, count: int,
+    interval_s: float, webhook_url: str,
+) -> list[str]:
+    return [
+        sys.executable, "-m", "tpuslo", "agent",
+        "--config", config,
+        "--scenario", "dns_latency",
+        "--count", str(count),
+        "--interval-s", str(interval_s),
+        "--event-kind", "both",
+        "--output", "jsonl",
+        "--jsonl-path", jsonl,
+        "--capability-mode", "bcc_degraded",
+        "--metrics-port", "0",
+        "--max-overhead-pct", "1000",
+        "--state-dir", state_dir,
+        "--snapshot-interval-s", "0",
+        "--webhook-url", webhook_url,
+        "--stats-interval-cycles", "0",
+    ]
+
+
+def _cycle_of(payload: dict[str, Any]) -> int:
+    """Synthetic cycle index from an emitted event's trace identity."""
+    trace = str(payload.get("trace_id", ""))
+    if trace.startswith("collector-trace-"):
+        try:
+            return int(trace.rsplit("-", 1)[-1]) - 1
+        except ValueError:
+            return -1
+    return -1
+
+
+def _distinct_cycles(jsonl_path: str) -> tuple[set[int], int, list[tuple]]:
+    """Parse an output file: (cycles seen, unparseable lines, identities)."""
+    cycles: set[int] = set()
+    torn = 0
+    identities: list[tuple] = []
+    try:
+        with open(jsonl_path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    payload = json.loads(line)
+                except json.JSONDecodeError:
+                    torn += 1
+                    continue
+                cycle = _cycle_of(payload)
+                if cycle >= 0:
+                    cycles.add(cycle)
+                identities.append(
+                    (
+                        payload.get("kind"),
+                        payload.get("trace_id", ""),
+                        payload.get("signal", payload.get("event_id", "")),
+                    )
+                )
+    except OSError:
+        pass
+    return cycles, torn, identities
+
+
+def _wait_for_cycle(
+    jsonl_path: str, cycle: int, timeout_s: float
+) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        cycles, _, _ = _distinct_cycles(jsonl_path)
+        if cycles and max(cycles) >= cycle:
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def run_crash_cycle(
+    workdir: str,
+    seed: int = 1,
+    kill_point: float = 0.5,
+    count: int = DEFAULT_COUNT,
+    interval_s: float = DEFAULT_INTERVAL_S,
+) -> CrashRunResult:
+    """One kill -9 / restart cycle against a fresh state dir."""
+    rng = random.Random(seed)
+    # A fresh workdir every time: a stale events.jsonl from a previous
+    # sweep would satisfy _wait_for_cycle instantly (killing the agent
+    # during startup) and a stale snapshot would corrupt the audit.
+    workdir = os.fspath(workdir)
+    if os.path.isdir(workdir):
+        shutil.rmtree(workdir)
+    os.makedirs(workdir, exist_ok=True)
+    config = os.path.join(workdir, "toolkit.yaml")
+    with open(config, "w", encoding="utf-8") as fh:
+        fh.write(_CRASH_CONFIG)
+    jsonl = os.path.join(workdir, "events.jsonl")
+    state_dir = os.path.join(workdir, "state")
+    kill_cycle = max(1, min(count - 2, int(count * kill_point)
+                            + rng.randint(-1, 1)))
+
+    collector = _AlertCollector()
+    result = CrashRunResult(
+        seed=seed,
+        kill_point=kill_point,
+        kill_cycle=kill_cycle,
+        resumed_cycle=-1,
+        torn_lines_replayed=0,
+        lost_cycles=0,
+        duplicate_alerts=0,
+        duplicate_event_lines=0,
+        alerts_total=0,
+        restored_components=[],
+        restored_watermark_ns=0,
+        snapshot_age_s=-1.0,
+    )
+    cmd = _agent_cmd(
+        config, jsonl, state_dir, count, interval_s, collector.endpoint
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        # ---- run 1: killed hard at the target cycle -------------------
+        proc = subprocess.Popen(
+            cmd, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        try:
+            if not _wait_for_cycle(
+                jsonl, kill_cycle, _STARTUP_TIMEOUT_S
+            ):
+                result.failures.append(
+                    f"run 1 never reached cycle {kill_cycle}"
+                )
+                return result
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+
+        snapshot_path = os.path.join(state_dir, "agent-state.json")
+        if not os.path.exists(snapshot_path):
+            result.failures.append("no snapshot survived the kill")
+            return result
+
+        # ---- run 2: warm restart to completion ------------------------
+        run2 = subprocess.run(
+            cmd, env=env, capture_output=True, text=True,
+            timeout=_RUN_TIMEOUT_S,
+        )
+        if run2.returncode != 0:
+            result.failures.append(
+                f"restarted agent exited {run2.returncode}"
+            )
+            return result
+        for line in run2.stderr.splitlines():
+            if "runtime: snapshot restored" in line:
+                if "components:" in line:
+                    names = line.split("components:", 1)[1]
+                    names = names.split(")", 1)[0]
+                    result.restored_components = [
+                        n.strip() for n in names.split(",") if n.strip()
+                    ]
+                if "(age " in line:
+                    try:
+                        result.snapshot_age_s = float(
+                            line.split("(age ", 1)[1].split("s", 1)[0]
+                        )
+                    except (ValueError, IndexError):
+                        pass
+                if "resuming at cycle" in line:
+                    try:
+                        result.resumed_cycle = int(
+                            line.rsplit("cycle", 1)[1].strip()
+                        )
+                    except (ValueError, IndexError):
+                        pass
+
+        # ---- audit ----------------------------------------------------
+        cycles, torn, identities = _distinct_cycles(jsonl)
+        result.torn_lines_replayed = torn
+        expected = set(range(count))
+        result.lost_cycles = len(expected - cycles)
+        seen: set[tuple] = set()
+        for identity in identities:
+            if identity in seen:
+                result.duplicate_event_lines += 1
+            seen.add(identity)
+
+        result.alerts_total = len(collector.incident_ids)
+        result.duplicate_alerts = len(collector.incident_ids) - len(
+            set(collector.incident_ids)
+        )
+
+        with open(snapshot_path, encoding="utf-8") as fh:
+            final_snapshot = json.load(fh)
+        components = final_snapshot.get("components", {})
+        result.restored_watermark_ns = int(
+            ((components.get("gate") or {}).get("watermark") or {}).get(
+                "max_ts", 0
+            )
+        )
+
+        # ---- contracts -----------------------------------------------
+        if result.torn_lines_replayed:
+            result.failures.append(
+                f"{result.torn_lines_replayed} torn line(s) in the "
+                "combined output (tear replayed/welded)"
+            )
+        if result.lost_cycles:
+            result.failures.append(
+                f"{result.lost_cycles} cycle(s) lost across the restart"
+            )
+        if result.duplicate_alerts:
+            result.failures.append(
+                f"{result.duplicate_alerts} duplicate webhook alert(s)"
+            )
+        if result.resumed_cycle < 1:
+            result.failures.append(
+                "restarted agent did not resume from the snapshot"
+            )
+        if "progress" not in result.restored_components:
+            result.failures.append("progress state was not restored")
+        if "gate" not in result.restored_components:
+            result.failures.append("ingest-gate state was not restored")
+        if result.restored_watermark_ns <= 0:
+            result.failures.append(
+                "final snapshot carries no ingest watermark"
+            )
+        # At-least-once overlap is bounded by the post-snapshot window:
+        # with a snapshot every cycle, at most the cycle in flight at
+        # the kill is re-emitted.  Eleven lines ≈ two full cycles of
+        # the two-signal scenario — anything beyond means the restored
+        # progress watermark was not honored and the restart replayed
+        # history the dedup window has to absorb.
+        if result.duplicate_event_lines > 11:
+            result.failures.append(
+                f"{result.duplicate_event_lines} duplicated event "
+                "lines — restart replayed beyond the post-snapshot "
+                "window"
+            )
+    finally:
+        collector.stop()
+    return result
+
+
+def run_crash_sweep(
+    root: str,
+    seeds: tuple[int, ...] = DEFAULT_SEEDS,
+    kill_points: tuple[float, ...] = DEFAULT_KILL_POINTS,
+    count: int = DEFAULT_COUNT,
+    interval_s: float = DEFAULT_INTERVAL_S,
+    log=None,
+) -> CrashSweepReport:
+    """Seeds × kill points, each a fresh kill/restart audit."""
+    report = CrashSweepReport(count=count, interval_s=interval_s)
+    for seed in seeds:
+        for kill_point in kill_points:
+            workdir = os.path.join(
+                root, f"seed{seed}-kp{int(kill_point * 100):03d}"
+            )
+            result = run_crash_cycle(
+                workdir,
+                seed=seed,
+                kill_point=kill_point,
+                count=count,
+                interval_s=interval_s,
+            )
+            report.runs.append(result)
+            if log is not None:
+                verdict = "PASS" if result.passed else "FAIL"
+                log(
+                    f"crash-sweep: seed {seed} @ {kill_point:g}: "
+                    f"{verdict} (killed @{result.kill_cycle}, resumed "
+                    f"@{result.resumed_cycle}, dup_lines="
+                    f"{result.duplicate_event_lines}, alerts="
+                    f"{result.alerts_total})"
+                )
+    return report

@@ -148,6 +148,34 @@ def build_parser() -> argparse.ArgumentParser:
         help="emit a periodic stats line (drops, rejections by reason, "
         "gate counters) every N cycles; 0 disables",
     )
+    p.add_argument(
+        "--state-dir",
+        default="",
+        help="enable the crash-safe runtime: periodic atomic snapshots "
+        "of agent state (watermark, skew, dedup digest, breaker/shed "
+        "state, limiter budget) land here and are restored on restart "
+        "(config: runtime.state_dir)",
+    )
+    p.add_argument(
+        "--snapshot-interval-s",
+        type=float,
+        default=-1.0,
+        help="seconds between periodic snapshots; 0 = every cycle, "
+        "-1 = config runtime.snapshot_interval_s",
+    )
+    p.add_argument(
+        "--cold-start",
+        action="store_true",
+        help="ignore any on-disk snapshot and start cold (operator "
+        "escape hatch for a poisoned snapshot)",
+    )
+    p.add_argument(
+        "--drain-timeout-s",
+        type=float,
+        default=0.0,
+        help="deadline for the graceful SIGTERM/SIGINT drain sequence "
+        "(0 = config runtime.drain_timeout_s)",
+    )
     return p
 
 
@@ -294,6 +322,46 @@ def main(
             file=sys.stderr,
         )
 
+    # ---- crash-safe runtime: durable snapshots + warm restore --------
+    from tpuslo.runtime import AgentRuntime, StateStore
+
+    runtime_observer = metrics.runtime_observer()
+    state_dir = args.state_dir or cfg.runtime.state_dir
+    store = None
+    if state_dir:
+        snapshot_interval = (
+            args.snapshot_interval_s
+            if args.snapshot_interval_s >= 0
+            else cfg.runtime.snapshot_interval_s
+        )
+        import os as os_mod
+
+        store = StateStore(
+            os_mod.path.join(state_dir, "agent-state.json"),
+            interval_s=snapshot_interval,
+            max_age_s=cfg.runtime.snapshot_max_age_s,
+            observer=runtime_observer,
+        )
+    runtime = AgentRuntime(
+        store,
+        observer=runtime_observer,
+        log=lambda msg: print(f"agent: {msg}", file=sys.stderr),
+    )
+    # Loop progress: the synthetic loop resumes at next_cycle instead
+    # of re-emitting from zero; alert_cycle is the webhook high-water
+    # mark (alerts are at-most-once across restarts).
+    progress = {"next_cycle": 0, "alert_cycle": -1}
+    runtime.register(
+        "progress",
+        lambda: dict(progress),
+        lambda s: progress.update(
+            next_cycle=int(s.get("next_cycle", 0)),
+            alert_cycle=int(s.get("alert_cycle", -1)),
+        ),
+    )
+    if gate is not None:
+        runtime.register("gate", gate.export_state, gate.restore_state)
+
     meta_template = Metadata(
         node=args.node,
         namespace=args.namespace,
@@ -331,6 +399,14 @@ def main(
     recovery = ShedRecoveryPolicy(
         cycles=args.restore_after_cycles or cfg.delivery.restore_after_cycles
     )
+    runtime.register(
+        "limiter", limiter.export_state, limiter.restore_state
+    )
+    runtime.register(
+        "generator_shed",
+        lambda: {"signals": generator.shed_signals()},
+        lambda s: generator.import_shed(list(s.get("signals") or [])),
+    )
 
     webhook_url = args.webhook_url or (cfg.webhook.url if cfg.webhook.enabled else "")
     hook = None
@@ -354,6 +430,23 @@ def main(
                 WebhookSink(hook),
                 observer=metrics.delivery_observer("webhook"),
             )
+
+    def _all_channels():
+        return writers.delivery_channels + (
+            [webhook_channel] if webhook_channel is not None else []
+        )
+
+    def _export_breakers():
+        return {
+            ch.name: ch.breaker.export_state() for ch in _all_channels()
+        }
+
+    def _restore_breakers(state):
+        for ch in _all_channels():
+            if isinstance(state.get(ch.name), dict):
+                ch.breaker.restore_state(state[ch.name])
+
+    runtime.register("breakers", _export_breakers, _restore_breakers)
 
     sample_meta = SampleMeta(
         cluster=args.cluster,
@@ -438,7 +531,25 @@ def main(
                 metrics.dropped.labels(reason="emit").inc(len(emitted))
                 print(f"agent: probe emit failed: {exc}", file=sys.stderr)
 
-        if hook is not None and attributor is not None and sample.fault_label:
+        if (
+            hook is not None
+            and attributor is not None
+            and sample.fault_label
+            and idx <= progress["alert_cycle"]
+        ):
+            # This cycle's alert was already sent by a previous
+            # incarnation (restored high-water mark): re-emitting it
+            # would page twice for one incident.
+            metrics.webhook_sent.labels(outcome="deduped").inc()
+        elif hook is not None and attributor is not None and sample.fault_label:
+            # At-most-once across restarts: persist the high-water mark
+            # *before* the send, so a crash in between loses (at worst)
+            # one alert instead of duplicating it — downstream pagers
+            # treat duplicate incidents as new pages, lost ones re-fire
+            # on the next burn window.
+            progress["alert_cycle"] = idx
+            if runtime.enabled:
+                runtime.snapshot_now()
             fault = attribution.FaultSample(
                 incident_id=f"agent-inc-{idx + 1:04d}",
                 timestamp=now,
@@ -502,36 +613,99 @@ def main(
                     metrics.signals_restored.labels(signal=restored).inc()
                     metrics.set_enabled_signals(generator.enabled_signals())
         metrics.mark_cycle()
+        # Progress advances only after the cycle's events hit the
+        # writers: a crash replays from the last durable cycle (at-
+        # least-once; the restored dedup digest absorbs the overlap).
+        progress["next_cycle"] = idx + 1
+        if runtime.enabled:
+            runtime.maybe_snapshot()
+            age = runtime.store.age_s()
+            if age != float("inf"):
+                metrics.runtime_snapshot_age_seconds.set(age)
 
+    # Warm restore happens after every component registered its hooks;
+    # ring-loop components (ProbeManager shed list, supervisor) apply
+    # their restored sections at late registration inside the loop.
+    restore_outcome = runtime.restore(cold_start=args.cold_start)
+    if runtime.enabled:
+        detail = ""
+        if restore_outcome == "restored":
+            detail = (
+                f" (age {runtime.restored_age_s:.1f}s, components: "
+                f"{','.join(runtime.restored_components) or 'none'})"
+            )
+        print(
+            f"agent: runtime: snapshot {restore_outcome}{detail}; "
+            f"resuming at cycle {progress['next_cycle']}",
+            file=sys.stderr,
+        )
+
+    from tpuslo.runtime import (
+        DrainController,
+        DrainSignal,
+        install_drain_handler,
+    )
+
+    # SIGTERM takes exactly the KeyboardInterrupt path: one drain
+    # sequence for Ctrl-C and for a Kubernetes pod termination.
+    restore_handlers = install_drain_handler()
+    drain_timeout = args.drain_timeout_s or cfg.runtime.drain_timeout_s
+    drain_reason = "completed"
     try:
         if args.probe_source == "ring":
             _run_ring_loop(
                 args, cfg, mode, signal_set, enricher, writers, metrics,
                 limiter, guard, recovery, ici_prober=ici_prober, gate=gate,
+                runtime=runtime, runtime_observer=runtime_observer,
             )
         else:
-            idx = 0
-            while True:
+            idx = progress["next_cycle"]
+            while not args.count or idx < args.count:
                 emit_one(idx)
                 idx += 1
                 if args.count and idx >= args.count:
                     break
                 time.sleep(args.interval_s)
     except KeyboardInterrupt:
-        pass
+        drain_reason = "sigint"
+    except DrainSignal as sig:
+        drain_reason = f"signal_{sig.signum}"
     finally:
+        restore_handlers()
+        drain = DrainController(
+            drain_reason,
+            deadline_s=drain_timeout,
+            log=lambda msg: print(f"agent: {msg}", file=sys.stderr),
+        )
         metrics.up.set(0)
         _print_stats(gate)
-        if gate is not None:
-            gate.close()
         if chaos_stream is not None:
             print(
                 f"agent: chaos-telemetry: {chaos_stream.snapshot()}",
                 file=sys.stderr,
             )
+        # Generation stopped above; now push queued batches out (or to
+        # the spool), snapshot, and release sinks — all on one deadline.
         if webhook_channel is not None:
-            webhook_channel.close()
-        writers.close()
+            drain.step(
+                "flush_webhook",
+                lambda budget: webhook_channel.close(
+                    flush_timeout_s=budget
+                ),
+            )
+        drain.step(
+            "flush_writers",
+            lambda budget: writers.close(flush_timeout_s=budget),
+        )
+        if runtime.enabled:
+            drain.step(
+                "final_snapshot", lambda budget: runtime.snapshot_now()
+            )
+        if gate is not None:
+            drain.step("close_gate", lambda budget: gate.close())
+        report = drain.finish()
+        runtime_observer.drain(report.outcome, report.duration_s)
+        print(f"agent: drain: {report.summary()}", file=sys.stderr)
         for channel in (
             writers.delivery_channels
             + ([webhook_channel] if webhook_channel else [])
@@ -553,7 +727,8 @@ def main(
 
 def _run_ring_loop(
     args, cfg, mode, signal_set, enricher, writers, metrics, limiter, guard,
-    recovery, ici_prober=None, gate=None,
+    recovery, ici_prober=None, gate=None, runtime=None,
+    runtime_observer=None,
 ) -> None:
     """The real-probe path: ringbuf → normalize → schema → emit.
 
@@ -596,6 +771,67 @@ def _run_ring_loop(
     for fd in pm.ringbuf_fds():
         consumer.add_kernel_ringbuf(fd)
         known_fds.add(fd)
+
+    def _sync_ring_fds() -> None:
+        """Re-register new ring fds; forget fds closed by a detach."""
+        nonlocal known_fds
+        current = set(pm.ringbuf_fds())
+        for fd in current - known_fds:
+            try:
+                consumer.add_kernel_ringbuf(fd)
+                known_fds.add(fd)
+            except Exception as exc:  # noqa: BLE001
+                print(f"agent: ring re-add failed: {exc}", file=sys.stderr)
+        known_fds &= current
+
+    # ---- probe supervision (tpuslo.runtime.ProbeSupervisor) ----------
+    from tpuslo.runtime import (
+        ProbeSupervisor,
+        RuntimeObserver,
+        SupervisorConfig,
+    )
+
+    if runtime_observer is None:
+        runtime_observer = RuntimeObserver()
+
+    def _restart_probe(signal: str) -> bool:
+        pm.detach_signal(signal)
+        restarted = signal in pm.attach_all([signal]).attached_signals
+        _sync_ring_fds()
+        return restarted
+
+    def _flap_shed(signal: str, reason: str) -> None:
+        # Route through the shed list so restore_one can bring the
+        # signal back (reverse cost order) once the hold-down expires.
+        pm.import_shed([signal])
+        _sync_ring_fds()
+        metrics.set_enabled_signals(pm.attached_signals)
+        runtime_observer.flap_shed(signal)
+
+    supervisor = ProbeSupervisor(
+        config=SupervisorConfig(
+            heartbeat_timeout_s=cfg.runtime.supervisor_heartbeat_timeout_s,
+            flap_restarts=cfg.runtime.supervisor_flap_restarts,
+            flap_window_s=cfg.runtime.supervisor_flap_window_s,
+            flap_holddown_s=cfg.runtime.supervisor_flap_holddown_s,
+        ),
+        restart=_restart_probe,
+        shed=_flap_shed,
+        log=lambda msg: print(f"agent: {msg}", file=sys.stderr),
+    )
+    supervisor.watch(attached)
+    if runtime is not None:
+        # Late registration: a restored "supervisor"/"pm_shed" section
+        # pending from main's restore pass applies here.
+        runtime.register(
+            "supervisor", supervisor.export_state, supervisor.restore_state
+        )
+        runtime.register(
+            "pm_shed",
+            lambda: {"signals": pm.shed_signals},
+            lambda s: pm.import_shed(list(s.get("signals") or [])),
+        )
+        metrics.set_enabled_signals(pm.attached_signals)
 
     # Userspace side-channel ring: hello tracer + HBM sampler share it,
     # plus whatever external producer --ring-path points at.
@@ -686,6 +922,7 @@ def _run_ring_loop(
             if sampler is not None:
                 sampler.sample_once()
             for sample in consumer.poll(timeout_ms=int(args.interval_s * 500)):
+                supervisor.beat(sample.signal)
                 event = to_probe_event(sample, meta_template, enricher)
                 if event is None:
                     if sample.signal == "hello_heartbeat_total":
@@ -697,6 +934,15 @@ def _run_ring_loop(
                 # kernel-ring events (synthetic loop does the same).
                 for event in ici_prober.maybe_probe(time.monotonic()):
                     emit_probe_event(event)
+
+            for action in supervisor.evaluate():
+                if action.action == "restarted":
+                    runtime_observer.probe_restarted(action.signal)
+                print(
+                    f"agent: supervisor: {action.signal} "
+                    f"{action.action} {action.detail}".rstrip(),
+                    file=sys.stderr,
+                )
 
             result = guard.evaluate()
             if result.valid:
@@ -710,13 +956,29 @@ def _run_ring_loop(
                             f"detached {shed}",
                             file=sys.stderr,
                         )
+                        supervisor.forget(shed)
                         metrics.set_enabled_signals(pm.attached_signals)
                         # Detach closed that object's ring fd; forget it
                         # so a restored probe reusing the fd number
                         # re-registers with the consumer.
                         known_fds &= set(pm.ringbuf_fds())
                 elif recovery.note(result):
-                    restored = pm.restore_one()
+                    shed_list = pm.shed_signals
+                    candidate = shed_list[-1] if shed_list else None
+                    if candidate is not None and not supervisor.may_restore(
+                        candidate
+                    ):
+                        # Flap hold-down outranks the overhead-guard
+                        # recovery streak: quiet CPU cycles say nothing
+                        # about why the supervisor shed a flapping probe.
+                        print(
+                            f"agent: restore of {candidate} held down "
+                            "(flapping)",
+                            file=sys.stderr,
+                        )
+                        restored = None
+                    else:
+                        restored = pm.restore_one()
                     if restored:
                         print(
                             f"agent: overhead {result.cpu_pct:.2f}% under "
@@ -724,22 +986,20 @@ def _run_ring_loop(
                             f"re-attached {restored}",
                             file=sys.stderr,
                         )
+                        supervisor.note_restored(restored)
                         metrics.signals_restored.labels(
                             signal=restored
                         ).inc()
                         metrics.set_enabled_signals(pm.attached_signals)
-                        for fd in pm.ringbuf_fds():
-                            if fd in known_fds:
-                                continue
-                            try:
-                                consumer.add_kernel_ringbuf(fd)
-                                known_fds.add(fd)
-                            except Exception as exc:  # noqa: BLE001
-                                print(
-                                    f"agent: ring re-add failed: {exc}",
-                                    file=sys.stderr,
-                                )
+                        _sync_ring_fds()
             metrics.mark_cycle()
+            if runtime is not None and runtime.enabled:
+                runtime.maybe_snapshot()
+                age = runtime.store.age_s()
+                if age != float("inf"):
+                    # Kept current even across failed saves: the
+                    # staleness alert must fire exactly then.
+                    metrics.runtime_snapshot_age_seconds.set(age)
             cycles += 1
             if (
                 args.stats_interval_cycles

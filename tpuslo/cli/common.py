@@ -5,6 +5,7 @@ from __future__ import annotations
 import json
 import sys
 import threading
+import time
 from typing import IO, Any, Callable
 
 from tpuslo.config import ToolkitConfig, default_config, load_config
@@ -56,9 +57,16 @@ class EventWriters:
         self._slo_channel: DeliveryChannel | None = None
         self._probe_channel: DeliveryChannel | None = None
         self._closed = False
+        self.jsonl_repaired_bytes = 0
         if output == OUTPUT_JSONL:
             if not jsonl_path:
                 raise ValueError("jsonl output requires --jsonl-path")
+            # A previous incarnation killed mid-write leaves a torn
+            # final line; appending to it would weld two records into
+            # one corrupt mid-file line.  Truncate the tear first.
+            from tpuslo.runtime import repair_jsonl_tail
+
+            self.jsonl_repaired_bytes = repair_jsonl_tail(jsonl_path)
             self._jsonl = open(jsonl_path, "a", encoding="utf-8")
         elif output == OUTPUT_OTLP:
             if not otlp_endpoint:
@@ -147,13 +155,23 @@ class EventWriters:
                 sink.flush()
         return ok
 
-    def close(self) -> None:
-        """Flush then release every sink; safe to call more than once."""
+    def close(self, flush_timeout_s: float = 10.0) -> None:
+        """Flush then release every sink; safe to call more than once.
+
+        ``flush_timeout_s`` bounds the final flush of ALL delivery
+        channels together (one deadline, not one per channel — the
+        drain path shares it with the rest of shutdown); batches still
+        queued when it expires are spilled to the spool, never
+        dropped.
+        """
         if self._closed:
             return
         self._closed = True
+        deadline = time.monotonic() + flush_timeout_s
         for channel in self.delivery_channels:
-            channel.close()
+            channel.close(
+                flush_timeout_s=max(0.0, deadline - time.monotonic())
+            )
         for exporter in (self._slo_exporter, self._probe_exporter):
             if exporter is not None:
                 exporter.close()

@@ -9,6 +9,13 @@ increasing intensity, with and without the ingest gate, and the run
 fails unless degradation is graceful (gated macro-F1 within tolerance
 of the clean baseline at moderate chaos, strictly better than the
 ungated path at every swept intensity).
+
+``--crash-sweep`` runs the kill -9 crash/restart gate: the agent
+subprocess is SIGKILLed at seeded cycle points and restarted against
+the same state dir; the run fails unless zero torn JSONL lines are
+replayed, zero cycles are lost, zero webhook alerts duplicate, and
+the restart resumes warm from the snapshot
+(``tpuslo.chaos.crash``, evidence in docs/evidence/crash-sweep.md).
 """
 
 from __future__ import annotations
@@ -64,7 +71,83 @@ def build_parser() -> argparse.ArgumentParser:
         help="max relative macro-F1 loss vs the no-chaos baseline "
         "allowed at up-to-moderate intensities with the gate on",
     )
+    # ---- crash chaos-sweep gate ---------------------------------------
+    p.add_argument(
+        "--crash-sweep",
+        action="store_true",
+        help="run the kill -9 crash/restart gate instead of B5/D3/E3: "
+        "SIGKILL the agent subprocess at seeded cycle points, restart "
+        "it, and fail unless zero torn lines are replayed, zero cycles "
+        "are lost, and zero webhook alerts duplicate",
+    )
+    p.add_argument("--crash-root", default="artifacts/crash")
+    p.add_argument("--crash-seeds", default="1,2,3,4,5")
+    p.add_argument("--crash-kill-points", default="0.25,0.5,0.8")
+    p.add_argument("--crash-count", type=int, default=16)
+    p.add_argument("--crash-interval-s", type=float, default=0.05)
     return p
+
+
+def render_crash_markdown(report) -> str:
+    lines = [
+        "# Crash chaos-sweep gate (kill -9 / restart)",
+        "",
+        f"**Overall: {'PASS' if report.passed else 'FAIL'}**",
+        "",
+        f"- {report.count} cycles per run at {report.interval_s:g}s "
+        "interval; agent killed with SIGKILL at the kill cycle, then "
+        "restarted against the same state dir",
+        "- contracts: 0 torn lines replayed, 0 cycles lost, "
+        "0 duplicate webhook alerts, warm resume from the snapshot",
+        "",
+        "| seed | kill pt | killed @ | resumed @ | torn replayed | "
+        "lost | dup alerts | dup lines | restored | pass |",
+        "|---|---|---|---|---|---|---|---|---|---|",
+    ]
+    for run in report.runs:
+        lines.append(
+            f"| {run.seed} | {run.kill_point:g} | {run.kill_cycle} "
+            f"| {run.resumed_cycle} | {run.torn_lines_replayed} "
+            f"| {run.lost_cycles} | {run.duplicate_alerts} "
+            f"| {run.duplicate_event_lines} "
+            f"| {','.join(run.restored_components) or '-'} "
+            f"| {run.passed} |"
+        )
+    if report.failures:
+        lines += ["", "## Failures", ""]
+        lines += [f"- {f}" for f in report.failures]
+    return "\n".join(lines) + "\n"
+
+
+def run_crash_gate(args) -> int:
+    from tpuslo.chaos.crash import run_crash_sweep
+
+    seeds = tuple(
+        int(v) for v in args.crash_seeds.split(",") if v.strip()
+    )
+    kill_points = tuple(
+        float(v) for v in args.crash_kill_points.split(",") if v.strip()
+    )
+    report = run_crash_sweep(
+        args.crash_root,
+        seeds=seeds,
+        kill_points=kill_points,
+        count=args.crash_count,
+        interval_s=args.crash_interval_s,
+        log=lambda msg: print(f"m5gate: {msg}", file=sys.stderr),
+    )
+    if args.summary_json:
+        Path(args.summary_json).write_text(
+            json.dumps(report.to_dict(), indent=2) + "\n"
+        )
+    if args.summary_md:
+        Path(args.summary_md).write_text(render_crash_markdown(report))
+    print(
+        f"m5gate: crash-sweep {'PASS' if report.passed else 'FAIL'}"
+        + ("" if report.passed else f" ({'; '.join(report.failures)})"),
+        file=sys.stderr,
+    )
+    return 0 if report.passed else 1
 
 
 def render_chaos_markdown(report) -> str:
@@ -189,6 +272,8 @@ def render_markdown(summary: releasegate.Summary) -> str:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.crash_sweep:
+        return run_crash_gate(args)
     if args.chaos_sweep:
         return run_chaos_gate(args)
     cfg = releasegate.Config(

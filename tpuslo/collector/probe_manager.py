@@ -424,6 +424,24 @@ class ProbeManager:
         """Guard-shed signals awaiting restore, in shed order."""
         return list(self._shed)
 
+    def import_shed(self, signals: list[str]) -> list[str]:
+        """Adopt a restored shed list (oldest-shed first).
+
+        Attached signals are detached (the previous incarnation shed
+        them for a reason that survives the restart); signals that
+        never attached this run are still recorded so ``restore_one``
+        retries them in reverse cost order once recovery authorizes it.
+        """
+        imported: list[str] = []
+        for signal in signals:
+            if signal in self._shed:
+                continue
+            if signal in self._attached:
+                self.detach_signal(signal)
+            self._shed.append(signal)
+            imported.append(signal)
+        return imported
+
     def restore_one(self) -> str | None:
         """Re-attach the most recently shed signal (reverse cost order).
 

@@ -117,6 +117,25 @@ class IngestConfig:
 
 
 @dataclass
+class RuntimeConfig:
+    """Crash-safe runtime knobs (``tpuslo.runtime``).
+
+    ``state_dir`` enables the subsystem: durable snapshots, warm
+    restore, and supervised drain all hang off it.  The drain handler
+    and probe supervisor are always on — they need no disk.
+    """
+
+    state_dir: str = ""
+    snapshot_interval_s: float = 5.0
+    snapshot_max_age_s: float = 300.0
+    drain_timeout_s: float = 10.0
+    supervisor_heartbeat_timeout_s: float = 30.0
+    supervisor_flap_restarts: int = 3
+    supervisor_flap_window_s: float = 120.0
+    supervisor_flap_holddown_s: float = 300.0
+
+
+@dataclass
 class TPUConfig:
     enabled: bool = True
     libtpu_path: str = ""
@@ -138,6 +157,7 @@ class ToolkitConfig:
     cdgate: CDGateConfig = field(default_factory=CDGateConfig)
     delivery: DeliveryConfig = field(default_factory=DeliveryConfig)
     ingest: IngestConfig = field(default_factory=IngestConfig)
+    runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
     tpu: TPUConfig = field(default_factory=TPUConfig)
 
     def to_dict(self) -> dict[str, Any]:
@@ -194,6 +214,20 @@ class ToolkitConfig:
                 "quarantine_dir": self.ingest.quarantine_dir,
                 "quarantine_max_bytes": self.ingest.quarantine_max_bytes,
                 "quarantine_max_age_s": self.ingest.quarantine_max_age_s,
+            },
+            "runtime": {
+                "state_dir": self.runtime.state_dir,
+                "snapshot_interval_s": self.runtime.snapshot_interval_s,
+                "snapshot_max_age_s": self.runtime.snapshot_max_age_s,
+                "drain_timeout_s": self.runtime.drain_timeout_s,
+                "supervisor_heartbeat_timeout_s":
+                    self.runtime.supervisor_heartbeat_timeout_s,
+                "supervisor_flap_restarts":
+                    self.runtime.supervisor_flap_restarts,
+                "supervisor_flap_window_s":
+                    self.runtime.supervisor_flap_window_s,
+                "supervisor_flap_holddown_s":
+                    self.runtime.supervisor_flap_holddown_s,
             },
             "tpu": {
                 "enabled": self.tpu.enabled,
@@ -305,6 +339,20 @@ def load_config(path: str) -> ToolkitConfig:
                 "quarantine_max_age_s": float,
             },
         )
+    _merge_section(
+        cfg.runtime,
+        raw.get("runtime") or {},
+        {
+            "state_dir": str,
+            "snapshot_interval_s": float,
+            "snapshot_max_age_s": float,
+            "drain_timeout_s": float,
+            "supervisor_heartbeat_timeout_s": float,
+            "supervisor_flap_restarts": int,
+            "supervisor_flap_window_s": float,
+            "supervisor_flap_holddown_s": float,
+        },
+    )
     _merge_section(
         cfg.tpu,
         raw.get("tpu") or {},

@@ -123,3 +123,56 @@ class CircuitBreaker:
             ):
                 self._opened_at = self._clock()
                 self._set_state_locked(STATE_OPEN)
+
+    # ---- snapshot hooks (tpuslo.runtime.StateStore) -------------------
+
+    def export_state(self) -> dict:
+        """Restart-portable breaker state.
+
+        The monotonic ``opened_at`` instant cannot cross a process
+        boundary, so an open breaker exports its *remaining* cooldown
+        instead; half-open exports as open with no remaining cooldown
+        (the restarted worker immediately re-probes — the conservative
+        reading of an interrupted probe).
+        """
+        with self._lock:
+            self._maybe_half_open_locked()
+            remaining = 0.0
+            if self._state == STATE_OPEN:
+                remaining = max(
+                    0.0,
+                    self._open_duration_s
+                    - (self._clock() - self._opened_at),
+                )
+            return {
+                "state": self._state,
+                "consecutive_failures": self._consecutive_failures,
+                "open_remaining_s": remaining,
+            }
+
+    def restore_state(self, state: dict) -> None:
+        """Adopt a previous incarnation's breaker verdict.
+
+        A restored open breaker keeps sink traffic off for its
+        remaining cooldown — a restart must not turn one crash into a
+        retry storm against a sink that was already refusing.
+        """
+        restored = state.get("state")
+        if restored not in STATE_VALUES:
+            return
+        with self._lock:
+            self._consecutive_failures = int(
+                state.get("consecutive_failures", 0)
+            )
+            if restored == STATE_CLOSED:
+                self._set_state_locked(STATE_CLOSED)
+                return
+            remaining = max(0.0, float(state.get("open_remaining_s", 0.0)))
+            # Backdate opened_at so exactly `remaining` cooldown is left;
+            # an expired (or half-open) cooldown re-probes on first allow().
+            self._opened_at = (
+                self._clock() - self._open_duration_s + remaining
+            )
+            self._probes_in_flight = 0
+            self._set_state_locked(STATE_OPEN)
+            self._maybe_half_open_locked()

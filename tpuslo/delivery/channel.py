@@ -212,6 +212,7 @@ class DeliveryChannel:
         batches may ride out a shutdown on disk but are never silently
         dropped with the daemon worker.
         """
+        deadline = time.monotonic() + flush_timeout_s
         with self._cond:
             if self._closed:
                 return
@@ -221,7 +222,12 @@ class DeliveryChannel:
             self._stop = True
             self._cond.notify_all()
         if self._worker is not None:
-            self._worker.join(timeout=flush_timeout_s)
+            # One deadline covers flush AND join: a hung sink must not
+            # get a second full budget out of the worker join (the
+            # drain sequence shares this bound with the final snapshot).
+            self._worker.join(
+                timeout=max(0.0, deadline - time.monotonic())
+            )
         with self._cond:
             leftover = list(self._queue)
             self._queue.clear()

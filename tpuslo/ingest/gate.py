@@ -27,6 +27,7 @@ shallow copies with a new ``ts_unix_nano``.
 
 from __future__ import annotations
 
+import hashlib
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Iterable
@@ -162,6 +163,18 @@ def _event_key(event: dict[str, Any]) -> tuple:
     )
 
 
+def _key_digest(key: tuple) -> int:
+    """Stable 64-bit digest of a dedup key, portable across processes.
+
+    ``hash()`` is salted per interpreter (PYTHONHASHSEED), so the
+    snapshot carries blake2b digests instead: a restarted agent must
+    recognize the pre-crash window's identities, and 64 bits keeps the
+    collision odds negligible at window sizes (4096² / 2⁶⁵ ≈ 1e-12).
+    """
+    h = hashlib.blake2b(repr(key).encode("utf-8"), digest_size=8)
+    return int.from_bytes(h.digest(), "big")
+
+
 class TelemetryGate:
     """Validation → dedup → skew correction → watermark, with stats."""
 
@@ -182,6 +195,17 @@ class TelemetryGate:
         self._observer = observer or GateObserver()
         self._dedup: OrderedDict[tuple, None] = OrderedDict()
         self._dedup_window = max(1, self.config.dedup_window)
+        # Digests restored from a pre-crash snapshot: identities seen
+        # by the previous incarnation.  Checked only while non-empty,
+        # so the steady-state hot path never pays the digest cost.
+        # A dict (insertion-ordered) rather than a set: re-export after
+        # a second crash must truncate oldest-first, like the LRU.
+        # Dropped wholesale once a full window of live identities has
+        # accumulated — by then the LRU itself covers everything the
+        # window semantics promise, and the hot path stops paying the
+        # per-event digest cost.
+        self._restored_digests: dict[int, None] = {}
+        self._admissions_since_restore = 0
         self.skew = ClockSkewEstimator(
             coordinator_host=self.config.coordinator_host,
             min_samples=self.config.min_skew_samples,
@@ -228,6 +252,19 @@ class TelemetryGate:
             self.duplicates += 1
             self._observer.duplicate()
             return DUPLICATE, None
+        if self._restored_digests:
+            if _key_digest(key) in self._restored_digests:
+                # Seen by the pre-crash incarnation: a spool replay or
+                # re-emitted cycle crossing the restart boundary.
+                self.duplicates += 1
+                self._observer.duplicate()
+                return DUPLICATE, None
+            self._admissions_since_restore += 1
+            if self._admissions_since_restore >= self._dedup_window:
+                # The live LRU now spans a full window: the inherited
+                # digests have aged out of the dedup contract, and the
+                # hot path stops paying for them.
+                self._restored_digests.clear()
         self._dedup[key] = None
         if len(self._dedup) > self._dedup_window:
             self._dedup.popitem(last=False)
@@ -293,6 +330,40 @@ class TelemetryGate:
     def close(self) -> None:
         if self.quarantine is not None:
             self.quarantine.close()
+
+    # ---- snapshot hooks (tpuslo.runtime.StateStore) -------------------
+
+    def export_state(self) -> dict[str, Any]:
+        """Compact restartable gate state: dedup digest + skew + head.
+
+        The dedup window is exported as 64-bit digests (not full keys):
+        ~32 KB for the default 4096-entry window, enough for a restarted
+        gate to reject every duplicate from the pre-crash window.
+        """
+        digests = [_key_digest(key) for key in self._dedup]
+        if self._restored_digests:
+            # Keep inherited identities that are still inside one
+            # window's worth of history; both lists run oldest-first,
+            # so the truncation evicts oldest-first like the LRU.
+            merged = list(self._restored_digests) + digests
+            digests = merged[-self._dedup_window:]
+        # Deliberately no gate counters: they are per-process
+        # operational stats (Prometheus owns their lifetime), and this
+        # payload is serialized + fsynced on the snapshot hot path.
+        return {
+            "dedup_digests": digests,
+            "watermark": self.watermark.export_state(),
+            "skew": self.skew.export_state(),
+        }
+
+    def restore_state(self, state: dict[str, Any]) -> None:
+        digests = state.get("dedup_digests") or []
+        for digest in digests[-self._dedup_window:]:
+            self._restored_digests[int(digest)] = None
+        if isinstance(state.get("watermark"), dict):
+            self.watermark.restore_state(state["watermark"])
+        if isinstance(state.get("skew"), dict):
+            self.skew.restore_state(state["skew"])
 
 
 def rematch_late(

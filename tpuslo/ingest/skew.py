@@ -141,3 +141,42 @@ class ClockSkewEstimator:
             for node in sorted(self._samples)
             if len(self._samples[node]) >= self.min_samples
         }
+
+    # ---- snapshot hooks (tpuslo.runtime.StateStore) -------------------
+
+    def export_state(self) -> dict[str, Any]:
+        """Per-node offset evidence, portable across restarts.
+
+        Pending launch groups are deliberately not exported: they are
+        sub-second joins against in-flight collectives, stale by the
+        time any restart completes.  The sample windows are what make
+        a restarted agent correct timestamps from its first event.
+        """
+        return {
+            "coordinator_node": self.coordinator_node,
+            "groups_observed": self.groups_observed,
+            "samples": {
+                node: list(samples)
+                for node, samples in self._samples.items()
+            },
+        }
+
+    def restore_state(self, state: dict[str, Any]) -> None:
+        self.coordinator_node = str(
+            state.get("coordinator_node", self.coordinator_node)
+        )
+        self.groups_observed += int(state.get("groups_observed", 0))
+        for node, values in (state.get("samples") or {}).items():
+            samples = self._samples.get(str(node))
+            if samples is None:
+                samples = self._samples[str(node)] = deque(
+                    maxlen=self._window
+                )
+            # Restored (older) evidence first, so live samples keep
+            # evicting it as the window refills.
+            fresh = list(samples)
+            samples.clear()
+            for value in values:
+                samples.append(int(value))
+            for value in fresh:
+                samples.append(value)

@@ -12,6 +12,8 @@ window join.
 
 from __future__ import annotations
 
+from typing import Any
+
 DEFAULT_LATENESS_NS = 2_000_000_000  # matcher's global window (2 s)
 
 
@@ -46,3 +48,23 @@ class Watermark:
             return True
         self.late += 1
         return False
+
+    # ---- snapshot hooks (tpuslo.runtime.StateStore) -------------------
+
+    def export_state(self) -> dict[str, Any]:
+        return {
+            "max_ts": self._max_ts,
+            "admitted": self.admitted,
+            "late": self.late,
+        }
+
+    def restore_state(self, state: dict[str, Any]) -> None:
+        """Resume the watermark where the previous incarnation left it.
+
+        Only moves forward: a restored head behind live traffic (the
+        snapshot predates events already seen this run) must not drag
+        the watermark backwards and re-admit stale history.
+        """
+        self._max_ts = max(self._max_ts, int(state.get("max_ts", 0)))
+        self.admitted += int(state.get("admitted", 0))
+        self.late += int(state.get("late", 0))

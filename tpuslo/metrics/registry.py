@@ -210,6 +210,54 @@ class AgentMetrics:
             "Lag of the most recent event behind the stream head",
             registry=self.registry,
         )
+        # ---- crash-safe runtime series (tpuslo.runtime) --------------
+        self.runtime_snapshot_age_seconds = Gauge(
+            "llm_slo_agent_runtime_snapshot_age_seconds",
+            "Seconds since the last durable state snapshot was written",
+            registry=self.registry,
+        )
+        self.runtime_snapshot_bytes = Gauge(
+            "llm_slo_agent_runtime_snapshot_bytes",
+            "Size of the last durable state snapshot",
+            registry=self.registry,
+        )
+        self.runtime_snapshot_saves = Counter(
+            "llm_slo_agent_runtime_snapshot_saves_total",
+            "Durable state snapshot writes, by outcome",
+            ["outcome"],
+            registry=self.registry,
+        )
+        self.runtime_snapshot_restores = Counter(
+            "llm_slo_agent_runtime_snapshot_restores_total",
+            "Startup snapshot restore attempts, by outcome "
+            "(restored/cold/stale/corrupt/version/forced_cold)",
+            ["outcome"],
+            registry=self.registry,
+        )
+        self.runtime_probe_restarts = Counter(
+            "llm_slo_agent_runtime_probe_restarts_total",
+            "Dead probes restarted by the supervisor",
+            ["signal"],
+            registry=self.registry,
+        )
+        self.runtime_flap_sheds = Counter(
+            "llm_slo_agent_runtime_flap_sheds_total",
+            "Signals shed by the supervisor for restart flapping",
+            ["signal"],
+            registry=self.registry,
+        )
+        self.runtime_drains = Counter(
+            "llm_slo_agent_runtime_drains_total",
+            "Graceful drain sequences, by outcome "
+            "(clean/deadline_exceeded/step_error)",
+            ["outcome"],
+            registry=self.registry,
+        )
+        self.runtime_drain_duration_seconds = Gauge(
+            "llm_slo_agent_runtime_drain_duration_seconds",
+            "Wall time of the last graceful drain sequence",
+            registry=self.registry,
+        )
 
     def set_enabled_signals(self, enabled: list[str]) -> None:
         enabled_set = set(enabled)
@@ -241,6 +289,11 @@ class AgentMetrics:
         """Observer adapter wiring a TelemetryGate to this registry
         (duck-typed against tpuslo.ingest.GateObserver)."""
         return _PromIngestObserver(self)
+
+    def runtime_observer(self) -> "_PromRuntimeObserver":
+        """Observer adapter wiring the crash-safe runtime to this
+        registry (duck-typed against tpuslo.runtime.RuntimeObserver)."""
+        return _PromRuntimeObserver(self)
 
 
 _BREAKER_STATE_VALUES = {"closed": 0, "half_open": 1, "open": 2}
@@ -318,6 +371,36 @@ class _PromIngestObserver:
 
     def watermark_lag_ms(self, lag_ms: float) -> None:
         self._m.ingest_watermark_lag_ms.set(lag_ms)
+
+
+class _PromRuntimeObserver:
+    """Bridge from crash-safe runtime callbacks to Prometheus."""
+
+    def __init__(self, metrics: AgentMetrics):
+        self._m = metrics
+        metrics.runtime_snapshot_age_seconds.set(0)
+        metrics.runtime_snapshot_bytes.set(0)
+
+    def snapshot_saved(self, size_bytes: int) -> None:
+        self._m.runtime_snapshot_saves.labels(outcome="ok").inc()
+        self._m.runtime_snapshot_bytes.set(size_bytes)
+        self._m.runtime_snapshot_age_seconds.set(0)
+
+    def snapshot_save_failed(self) -> None:
+        self._m.runtime_snapshot_saves.labels(outcome="error").inc()
+
+    def snapshot_restored(self, outcome: str, age_s: float) -> None:
+        self._m.runtime_snapshot_restores.labels(outcome=outcome).inc()
+
+    def probe_restarted(self, signal: str) -> None:
+        self._m.runtime_probe_restarts.labels(signal=signal).inc()
+
+    def flap_shed(self, signal: str) -> None:
+        self._m.runtime_flap_sheds.labels(signal=signal).inc()
+
+    def drain(self, outcome: str, duration_s: float) -> None:
+        self._m.runtime_drains.labels(outcome=outcome).inc()
+        self._m.runtime_drain_duration_seconds.set(duration_s)
 
 
 def start_metrics_server(

@@ -42,3 +42,23 @@ class RateLimiter:
     @property
     def tokens(self) -> float:
         return self._tokens
+
+    # ---- snapshot hooks (tpuslo.runtime.StateStore) -------------------
+
+    def export_state(self) -> dict:
+        return {"tokens": self._tokens}
+
+    def restore_state(self, state: dict) -> None:
+        """Resume the previous incarnation's budget (clamped).
+
+        Without this a crash-looping agent gets a full burst allowance
+        on every restart — the restart loop itself would defeat the
+        limiter.  Restoring the spent budget keeps the token bucket an
+        invariant of the *node*, not the process.
+        """
+        try:
+            tokens = float(state.get("tokens", self._capacity))
+        except (TypeError, ValueError):
+            return
+        self._tokens = min(self._capacity, max(0.0, tokens))
+        self._last = self._clock()

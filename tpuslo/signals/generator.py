@@ -327,6 +327,25 @@ class Generator:
                 return signal
         return None
 
+    def import_shed(self, signals: Iterable[str]) -> list[str]:
+        """Adopt a restored shed list (oldest-shed first).
+
+        A restarted agent must not re-enable probes its previous
+        incarnation shed for overhead: the CPU pressure that forced the
+        shed does not reset with the process.  Signals are re-shed in
+        the recorded order so ``restore_one`` still ramps back cheapest
+        first.  Returns the signals actually re-shed (unknown or
+        already-shed names are skipped).
+        """
+        imported: list[str] = []
+        with self._lock:
+            for signal in signals:
+                if signal in self._enabled:
+                    self._enabled.discard(signal)
+                    self._shed.append(signal)
+                    imported.append(signal)
+        return imported
+
     def generate(self, sample: RawSample, meta: Metadata) -> list[ProbeEventV1]:
         """Expand one sample into normalized probe events, one per signal."""
         return self.generate_batch([sample], meta)
