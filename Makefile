@@ -8,8 +8,8 @@ ARTIFACTS ?= artifacts
 .PHONY: all test test-fast native ebpf lint schema-validate \
 	correlation-gate fault-smoke replay-smoke ebpf-smoke bench \
 	bench-smoke chaos-smoke chaos-demo chaos-telemetry-smoke \
-	chaos-telemetry-sweep crash-smoke crash-sweep m5-candidate \
-	m5-gate helm-lint dashboards clean
+	chaos-telemetry-sweep crash-smoke crash-sweep obs-smoke \
+	metrics-drift m5-candidate m5-gate helm-lint dashboards clean
 
 all: native test
 
@@ -133,6 +133,19 @@ chaos-telemetry-sweep:
 # marker (also slow, so tier-1 never runs it implicitly).
 crash-smoke:
 	$(PY) -m pytest tests/test_crash_runtime.py -q -m chaos
+
+# Self-observability smoke: tracer span trees + tail sampling + OTLP
+# trace payloads, the metrics HTTP server (/metrics //healthz //readyz),
+# the agent --trace e2e path, and the metrics drift gate.
+obs-smoke:
+	$(PY) -m pytest tests/test_obs_tracer.py tests/test_metrics_server.py \
+		tests/test_agent_trace.py -q
+	$(PY) tools/metrics_drift_check.py
+
+# Every AgentMetrics series must be referenced by a dashboard or a doc;
+# orphans fail (see tools/metrics_drift_check.py).
+metrics-drift:
+	$(PY) tools/metrics_drift_check.py
 
 # Full crash-sweep release gate: seeds x kill points of SIGKILL/restart
 # audits (see docs/evidence/crash-sweep.md + docs/runbooks/crash-recovery.md).

@@ -169,11 +169,69 @@ evidence_e2e = dashboard(
     ],
 )
 
+agent_selfobs = dashboard(
+    "tpuslo-agent-self-observability",
+    "TPU SLO / Agent Self-Observability",
+    [
+        # --- the pipeline observing itself (tpuslo.obs) --------------
+        panel("Cycle stage latency p99 (ms, by stage)", [
+            ('histogram_quantile(0.99, sum(rate(llm_slo_agent_cycle_stage_ms_bucket[5m])) by (le, stage))', "{{stage}} p99"),
+        ], 0, 0, unit="ms"),
+        panel("Cycle duration p50/p99 (ms)", [
+            ('histogram_quantile(0.50, sum(rate(llm_slo_agent_cycle_ms_bucket[5m])) by (le))', "cycle p50"),
+            ('histogram_quantile(0.99, sum(rate(llm_slo_agent_cycle_ms_bucket[5m])) by (le))', "cycle p99"),
+        ], 12, 0, unit="ms"),
+        panel("Self-trace sampling verdicts (tail-based)", [
+            ('sum(rate(llm_slo_agent_trace_cycles_total[5m])) by (verdict)', "{{verdict}}"),
+        ], 0, 8),
+        panel("Tracer overhead (% of cycle, budget 5%)", [
+            ('llm_slo_agent_trace_overhead_pct', "{{instance}}"),
+        ], 12, 8, w=6, unit="percent"),
+        panel("Spans exported /s", [
+            ('sum(rate(llm_slo_agent_trace_spans_exported_total[5m]))', "spans/s"),
+        ], 18, 8, w=6),
+        # --- delivery plane health -----------------------------------
+        panel("Delivery queue depth / spool bytes (by sink)", [
+            ('llm_slo_agent_delivery_queue_depth', "queue {{sink}}"),
+            ('llm_slo_agent_delivery_spool_bytes', "spool B {{sink}}"),
+        ], 0, 16),
+        panel("Delivered / spooled / replayed / retries (events/s)", [
+            ('sum(rate(llm_slo_agent_delivery_delivered_events_total[5m])) by (sink)', "delivered {{sink}}"),
+            ('sum(rate(llm_slo_agent_delivery_spooled_events_total[5m])) by (sink)', "spooled {{sink}}"),
+            ('sum(rate(llm_slo_agent_delivery_replayed_events_total[5m])) by (sink)', "replayed {{sink}}"),
+            ('sum(rate(llm_slo_agent_delivery_retries_total[5m])) by (sink)', "retries {{sink}}"),
+        ], 12, 16),
+        panel("Breaker state (0 closed / 1 half-open / 2 open)", [
+            ('llm_slo_agent_delivery_breaker_state', "{{sink}}"),
+        ], 0, 24, w=8),
+        panel("Dead letters + spool truncation (lost evidence)", [
+            ('sum(rate(llm_slo_agent_delivery_dead_letter_events_total[5m])) by (sink, reason)', "{{sink}}/{{reason}}"),
+            ('sum(rate(llm_slo_agent_delivery_spool_truncated_batches_total[5m])) by (sink)', "truncated {{sink}}"),
+        ], 8, 24, w=8),
+        panel("Agent identity (event kind one-hot)", [
+            ('llm_slo_agent_event_kind', "{{kind}}"),
+        ], 16, 24, w=8, kind="stat"),
+        # --- crash-safe runtime --------------------------------------
+        panel("Snapshot age / drain duration (s)", [
+            ('llm_slo_agent_runtime_snapshot_age_seconds', "snapshot age"),
+            ('llm_slo_agent_runtime_drain_duration_seconds', "last drain"),
+        ], 0, 32, unit="s"),
+        panel("Snapshot saves by outcome + size", [
+            ('sum(rate(llm_slo_agent_runtime_snapshot_saves_total[5m])) by (outcome)', "{{outcome}}"),
+            ('llm_slo_agent_runtime_snapshot_bytes', "bytes"),
+        ], 12, 32),
+        panel("TPU probe event rate (all TPU signals)", [
+            ('sum(rate(llm_tpu_agent_probe_events_total[5m]))', "tpu events/s"),
+        ], 0, 40, w=24),
+    ],
+)
+
 FILES = {
     "slo-overview.json": slo_overview,
     "tpu-kernel-correlation.json": kernel_correlation,
     "incident-lab.json": incident_lab,
     "evidence-e2e.json": evidence_e2e,
+    "agent-self-observability.json": agent_selfobs,
 }
 
 if __name__ == "__main__":

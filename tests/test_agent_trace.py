@@ -1,0 +1,315 @@
+"""End-to-end self-observability: `agent --trace` produces OTLP trace
+payloads with >=6 stage spans per cycle, routes them through the
+delivery layer, records incident provenance, and `sloctl explain`
+prints the full causal chain.  Also covers the agent-wired /readyz."""
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from tpuslo.cli.agent import main as agent_main
+from tpuslo.cli.sloctl import main as sloctl_main
+from tpuslo.metrics import AgentMetrics
+
+
+class _CaptureHandler(BaseHTTPRequestHandler):
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        self.server.requests.append({"path": self.path, "body": body})
+        self.send_response(200)
+        self.end_headers()
+        self.wfile.write(b"{}")
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture
+def capture_server():
+    server = HTTPServer(("127.0.0.1", 0), _CaptureHandler)
+    server.requests = []
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield server
+    server.shutdown()
+    server.server_close()
+
+
+def trace_spans(server):
+    spans = []
+    for req in server.requests:
+        if req["path"] != "/v1/traces":
+            continue
+        payload = json.loads(req["body"])
+        for rs in payload["resourceSpans"]:
+            for scope in rs["scopeSpans"]:
+                spans.extend(scope["spans"])
+    return spans
+
+
+class TestAgentTraceE2E:
+    def test_every_cycle_ships_a_trace_with_stage_spans(
+        self, capture_server, tmp_path
+    ):
+        endpoint = (
+            f"http://127.0.0.1:{capture_server.server_address[1]}/v1/logs"
+        )
+        rc = agent_main(
+            [
+                "--scenario", "tpu_mixed", "--count", "4",
+                "--interval-s", "0.01", "--event-kind", "both",
+                "--output", "otlp", "--otlp-endpoint", endpoint,
+                "--metrics-port", "0", "--max-overhead-pct", "1000",
+                "--trace", "--trace-sample-rate", "1.0",
+                "--spool-dir", str(tmp_path / "spool"),
+                "--provenance-path", str(tmp_path / "prov.jsonl"),
+            ],
+            metrics=AgentMetrics(),
+        )
+        assert rc == 0
+        spans = trace_spans(capture_server)
+        roots = [s for s in spans if "parentSpanId" not in s]
+        assert len(roots) == 4  # one trace per cycle at sample_rate 1.0
+        for root in roots:
+            children = [
+                s
+                for s in spans
+                if s.get("parentSpanId") == root["spanId"]
+                and s["traceId"] == root["traceId"]
+            ]
+            assert len(children) >= 6
+            names = {c["name"] for c in children}
+            assert {
+                "generate", "ingest_gate", "validate", "correlate",
+                "attribute", "deliver", "snapshot",
+            } <= names
+            for child in children:
+                assert (
+                    int(child["endTimeUnixNano"])
+                    >= int(child["startTimeUnixNano"])
+                )
+
+    def test_slow_and_error_cycles_always_sampled(
+        self, capture_server, tmp_path
+    ):
+        endpoint = (
+            f"http://127.0.0.1:{capture_server.server_address[1]}/v1/logs"
+        )
+        # sample_rate 0 + an absurdly low slow budget: every cycle is a
+        # "slow" cycle, so tail sampling must keep all of them.
+        rc = agent_main(
+            [
+                "--scenario", "baseline", "--count", "3",
+                "--interval-s", "0.01", "--event-kind", "probe",
+                "--output", "otlp", "--otlp-endpoint", endpoint,
+                "--metrics-port", "0", "--max-overhead-pct", "1000",
+                "--trace", "--trace-sample-rate", "0.0",
+                "--trace-slow-ms", "0.0001",
+            ],
+            metrics=AgentMetrics(),
+        )
+        assert rc == 0
+        roots = [
+            s for s in trace_spans(capture_server)
+            if "parentSpanId" not in s
+        ]
+        assert len(roots) == 3
+        by_key = {
+            a["key"]: a["value"] for a in roots[0]["attributes"]
+        }
+        assert by_key["sampling"] == {"stringValue": "kept_slow"}
+
+    def test_incident_cycles_always_sampled(self, capture_server, tmp_path):
+        port = capture_server.server_address[1]
+        # sample_rate 0 + huge slow budget: nothing qualifies for
+        # sampling EXCEPT the force-keep on incident cycles, whose
+        # provenance records point at these traces.
+        rc = agent_main(
+            [
+                "--scenario", "tpu_mixed", "--count", "3",
+                "--interval-s", "0.01", "--event-kind", "both",
+                "--output", "otlp",
+                "--otlp-endpoint", f"http://127.0.0.1:{port}/v1/logs",
+                "--metrics-port", "0", "--max-overhead-pct", "1000",
+                "--trace", "--trace-sample-rate", "0.0",
+                "--trace-slow-ms", "1000000",
+                "--provenance-path", str(tmp_path / "prov.jsonl"),
+                "--webhook-url", f"http://127.0.0.1:{port}/hook",
+            ],
+            metrics=AgentMetrics(),
+        )
+        assert rc == 0
+        roots = {
+            s["traceId"]: s
+            for s in trace_spans(capture_server)
+            if "parentSpanId" not in s
+        }
+        assert len(roots) == 3  # every tpu_mixed cycle is a fault cycle
+        records = [
+            json.loads(line)
+            for line in (tmp_path / "prov.jsonl").read_text().splitlines()
+        ]
+        for rec in records:
+            assert rec["trace_id"] in roots, (
+                "provenance must point at an exported trace"
+            )
+            assert rec["delivery"]["outcome"] == "ok"
+
+    def test_provenance_chain_recorded_and_explained(
+        self, tmp_path, capsys
+    ):
+        prov = tmp_path / "prov.jsonl"
+        rc = agent_main(
+            [
+                "--scenario", "tpu_mixed", "--count", "4",
+                "--interval-s", "0.01", "--event-kind", "both",
+                "--output", "jsonl",
+                "--jsonl-path", str(tmp_path / "events.jsonl"),
+                "--metrics-port", "0", "--max-overhead-pct", "1000",
+                "--trace", "--provenance-path", str(prov),
+                # A webhook makes fault cycles produce incidents; the
+                # dead port exercises the delivery-outcome recording.
+                "--webhook-url", "http://127.0.0.1:9/hook",
+            ],
+            metrics=AgentMetrics(),
+        )
+        assert rc == 0
+        assert prov.exists()
+        records = [
+            json.loads(line)
+            for line in prov.read_text().splitlines()
+            if line
+        ]
+        assert records  # tpu_mixed injects a fault every cycle
+        rec = records[0]
+        assert rec["trace_id"] and rec["root_span_id"]
+        assert rec["predicted_fault_domain"]
+        assert rec["events"], "supporting probe events must be recorded"
+        assert rec["events"][0]["tier"] == "trace_id_exact"
+        assert rec["correlation"]["matched"] >= 1
+        assert rec["delivery"]["outcome"] == "error"  # dead webhook port
+        # Finalized at cycle end: ALL stages present, including the two
+        # most likely to explain a slow incident cycle.
+        assert {"deliver", "snapshot"} <= set(rec["stages_ms"])
+
+        # sloctl explain renders the full chain from the same file.
+        rc = sloctl_main(
+            ["explain", rec["incident_id"], "--provenance", str(prov)]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert f"incident {rec['incident_id']}" in out
+        assert "1. probe events" in out
+        assert "2. correlation:" in out
+        assert "3. fault-domain posterior:" in out
+        assert "4. alert delivery: outcome=error" in out
+
+    def test_explain_lists_and_rejects_unknown(self, tmp_path, capsys):
+        prov = tmp_path / "prov.jsonl"
+        agent_main(
+            [
+                "--scenario", "tpu_mixed", "--count", "2",
+                "--interval-s", "0.01", "--event-kind", "both",
+                "--output", "jsonl",
+                "--jsonl-path", str(tmp_path / "events.jsonl"),
+                "--metrics-port", "0", "--max-overhead-pct", "1000",
+                "--trace", "--provenance-path", str(prov),
+                "--webhook-url", "http://127.0.0.1:9/hook",
+            ],
+            metrics=AgentMetrics(),
+        )
+        rc = sloctl_main(["explain", "--provenance", str(prov)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "agent-inc-0001" in out
+        rc = sloctl_main(
+            ["explain", "agent-inc-9999", "--provenance", str(prov)]
+        )
+        err = capsys.readouterr().err
+        assert rc == 1
+        assert "agent-inc-9999" in err
+
+    def test_explain_missing_log_fails_cleanly(self, tmp_path, capsys):
+        rc = sloctl_main(
+            [
+                "explain", "x",
+                "--provenance", str(tmp_path / "absent.jsonl"),
+            ]
+        )
+        assert rc == 1
+        assert "no provenance records" in capsys.readouterr().err
+
+    def test_trace_off_by_default_costs_nothing(self, tmp_path):
+        metrics = AgentMetrics()
+        rc = agent_main(
+            [
+                "--scenario", "baseline", "--count", "2",
+                "--interval-s", "0.01", "--event-kind", "probe",
+                "--output", "jsonl",
+                "--jsonl-path", str(tmp_path / "events.jsonl"),
+                "--metrics-port", "0", "--max-overhead-pct", "1000",
+            ],
+            metrics=metrics,
+        )
+        assert rc == 0
+        # No trace verdicts recorded: the tracer never engaged.
+        samples = [
+            s
+            for m in metrics.trace_cycles.collect()
+            for s in m.samples
+            if s.name.endswith("_total")
+        ]
+        assert sum(s.value for s in samples) == 0
+
+
+class TestAgentReadyz:
+    def test_readyz_reflects_running_agent(self, tmp_path):
+        # Pick a free port first (the agent binds 0.0.0.0:port itself).
+        import socket
+
+        with socket.socket() as sk:
+            sk.bind(("127.0.0.1", 0))
+            port = sk.getsockname()[1]
+
+        done = threading.Event()
+        rcs: list[int] = []
+
+        def run():
+            rcs.append(
+                agent_main(
+                    [
+                        "--scenario", "baseline", "--count", "60",
+                        "--interval-s", "0.05", "--event-kind", "probe",
+                        "--output", "jsonl",
+                        "--jsonl-path", str(tmp_path / "e.jsonl"),
+                        "--metrics-port", str(port),
+                        "--max-overhead-pct", "1000",
+                    ],
+                    metrics=AgentMetrics(),
+                )
+            )
+            done.set()
+
+        thread = threading.Thread(target=run, daemon=True)
+        thread.start()
+        status = None
+        body = b""
+        for _ in range(100):
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/readyz", timeout=2
+                ) as resp:
+                    status, body = resp.status, resp.read()
+                break
+            except OSError:
+                import time
+
+                time.sleep(0.05)
+        assert status == 200
+        assert body == b"ok\n"
+        done.wait(timeout=30)
+        assert rcs == [0]

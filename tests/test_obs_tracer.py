@@ -1,0 +1,450 @@
+"""Self-tracing unit tests: span trees, tail sampling, the overhead
+gate, OTLP trace payloads, and the incident provenance log."""
+
+import json
+
+import pytest
+
+from tpuslo.obs import (
+    CYCLE_STAGES,
+    DROPPED,
+    KEPT_ERROR,
+    KEPT_PROBABILISTIC,
+    KEPT_SLOW,
+    EvidenceEvent,
+    ProvenanceLog,
+    ProvenanceRecord,
+    SelfTracer,
+    SpanExporter,
+    TracerConfig,
+    format_chain,
+    load_records,
+    new_span_id,
+    new_trace_id,
+    probe_event_id,
+    span_to_record,
+    trace_endpoint_from_logs,
+)
+
+
+def run_cycle(tracer, stages=CYCLE_STAGES, fail_stage=None, **attrs):
+    with tracer.cycle("agent.cycle", **attrs) as tr:
+        for name in stages:
+            with tr.stage(name, stage_attr=name) as sp:
+                if name == fail_stage:
+                    raise RuntimeError("stage boom")
+                sp.set(batch=3)
+    return tr
+
+
+class TestTracerSpans:
+    def test_ids_are_hex_and_unique(self):
+        tids = {new_trace_id() for _ in range(64)}
+        sids = {new_span_id() for _ in range(64)}
+        assert len(tids) == 64 and len(sids) == 64
+        assert all(len(t) == 32 and int(t, 16) >= 0 for t in tids)
+        assert all(len(s) == 16 and int(s, 16) >= 0 for s in sids)
+
+    def test_cycle_builds_root_plus_stage_children(self):
+        exported = []
+        tracer = SelfTracer(
+            TracerConfig(enabled=True, sample_rate=1.0),
+            on_export=exported.append,
+        )
+        run_cycle(tracer, cycle=7)
+        assert len(exported) == 1
+        spans = exported[0]
+        root, children = spans[0], spans[1:]
+        assert root.name == "agent.cycle"
+        assert root.attributes["cycle"] == 7
+        assert len(children) == len(CYCLE_STAGES) >= 6
+        assert [s.name for s in children] == list(CYCLE_STAGES)
+        for child in children:
+            assert child.trace_id == root.trace_id
+            assert child.parent_span_id == root.span_id
+            assert child.span_id and child.span_id != root.span_id
+            assert child.end_unix_nano >= child.start_unix_nano
+            assert child.attributes["batch"] == 3
+        assert root.end_unix_nano >= children[-1].end_unix_nano
+
+    def test_disabled_tracer_records_nothing(self):
+        exported = []
+        tracer = SelfTracer(
+            TracerConfig(enabled=False), on_export=exported.append
+        )
+        tr = run_cycle(tracer)
+        assert exported == []
+        assert tracer.stats["cycles"] == 0
+        assert tr.trace_id == ""  # the shared null cycle
+
+    def test_stage_timings_are_ordered(self):
+        exported = []
+        tracer = SelfTracer(
+            TracerConfig(enabled=True, sample_rate=1.0),
+            on_export=exported.append,
+        )
+        run_cycle(tracer)
+        spans = exported[0]
+        starts = [s.start_unix_nano for s in spans[1:]]
+        assert starts == sorted(starts)
+
+
+class TestTailSampling:
+    def test_slow_cycles_always_kept(self):
+        exported = []
+        tracer = SelfTracer(
+            TracerConfig(enabled=True, sample_rate=0.0, slow_cycle_ms=0.0),
+            on_export=exported.append,
+        )
+        for _ in range(5):
+            run_cycle(tracer)
+        assert tracer.stats[KEPT_SLOW] == 5
+        assert len(exported) == 5
+
+    def test_error_cycles_always_kept_and_marked(self):
+        exported = []
+        tracer = SelfTracer(
+            TracerConfig(
+                enabled=True, sample_rate=0.0, slow_cycle_ms=1e9
+            ),
+            on_export=exported.append,
+        )
+        with pytest.raises(RuntimeError):
+            run_cycle(tracer, fail_stage="validate")
+        assert tracer.stats[KEPT_ERROR] == 1
+        root = exported[0][0]
+        assert root.status == "error"
+        failed = [s for s in exported[0][1:] if s.name == "validate"]
+        assert failed and failed[0].status == "error"
+
+    def test_probabilistic_sampling_uses_rng(self):
+        kept = SelfTracer(
+            TracerConfig(enabled=True, sample_rate=0.5, slow_cycle_ms=1e9),
+            rng=lambda: 0.4,
+        )
+        dropped = SelfTracer(
+            TracerConfig(enabled=True, sample_rate=0.5, slow_cycle_ms=1e9),
+            rng=lambda: 0.6,
+        )
+        run_cycle(kept)
+        run_cycle(dropped)
+        assert kept.stats[KEPT_PROBABILISTIC] == 1
+        assert dropped.stats[DROPPED] == 1
+
+    def test_dropped_cycles_skip_span_ids_and_export(self):
+        exported = []
+        tracer = SelfTracer(
+            TracerConfig(enabled=True, sample_rate=0.0, slow_cycle_ms=1e9),
+            on_export=exported.append,
+        )
+        tr = run_cycle(tracer)
+        assert exported == []
+        # Dropped cycles keep only the lightweight stage records — no
+        # Span materialization, no ids.
+        assert all(not getattr(s, "span_id", "") for s in tr.spans)
+        assert all(s.duration_ms >= 0 for s in tr.spans)
+
+    def test_export_failure_is_counted_not_raised(self):
+        def boom(spans):
+            raise OSError("sink down")
+
+        tracer = SelfTracer(
+            TracerConfig(enabled=True, sample_rate=1.0), on_export=boom
+        )
+        run_cycle(tracer)
+        assert tracer.stats["export_errors"] == 1
+
+
+class TestForcedKeep:
+    def test_mark_keep_forces_sampling(self):
+        exported = []
+        tracer = SelfTracer(
+            TracerConfig(enabled=True, sample_rate=0.0, slow_cycle_ms=1e9),
+            on_export=exported.append,
+        )
+        with tracer.cycle("agent.cycle") as tr:
+            with tr.stage("attribute"):
+                tr.mark_keep()  # e.g. this cycle produced an incident
+        from tpuslo.obs import KEPT_FORCED
+
+        assert tracer.stats[KEPT_FORCED] == 1
+        assert len(exported) == 1
+        # The forced-kept spans carry real ids: the provenance pointer
+        # recorded mid-cycle must resolve to this exported trace.
+        assert all(s.span_id for s in exported[0])
+
+    def test_null_cycle_mark_keep_is_noop(self):
+        tracer = SelfTracer(TracerConfig(enabled=False))
+        with tracer.cycle("agent.cycle") as tr:
+            tr.mark_keep()
+        assert tracer.stats["cycles"] == 0
+
+    def test_no_export_callback_counts_nothing_exported(self):
+        tracer = SelfTracer(
+            TracerConfig(enabled=True, sample_rate=1.0)
+        )  # kept every cycle, but there is nowhere to ship spans
+        run_cycle(tracer)
+        assert tracer.stats["spans_exported"] == 0
+
+
+class TestOverheadGate:
+    def _overloaded_tracer(self, **overrides):
+        cfg = dict(
+            enabled=True,
+            sample_rate=0.0,
+            slow_cycle_ms=1e9,
+            max_overhead_pct=0.000001,
+            overhead_grace_cycles=3,
+        )
+        cfg.update(overrides)
+        return SelfTracer(TracerConfig(**cfg))
+
+    def test_sustained_overhead_degrades_to_metrics_only(self):
+        tracer = self._overloaded_tracer()
+        # Near-empty cycles: bookkeeping dwarfs the body, the EMA
+        # breaches the (absurdly low) budget, and the gate trips.
+        for _ in range(10):
+            run_cycle(tracer)
+        assert tracer.degraded
+        # Metrics-only, not metrics-off: cycles keep being timed and
+        # the observer keeps firing — only span sampling stops.
+        assert tracer.enabled
+        before = tracer.stats["cycles"]
+        run_cycle(tracer)
+        assert tracer.stats["cycles"] == before + 1
+        assert tracer.stats[DROPPED] >= 1
+
+    def test_degraded_tracer_still_keeps_error_cycles(self):
+        exported = []
+        tracer = self._overloaded_tracer()
+        tracer._on_export = exported.append
+        for _ in range(10):
+            run_cycle(tracer)
+        assert tracer.degraded
+        exported.clear()
+        with pytest.raises(RuntimeError):
+            run_cycle(tracer, fail_stage="deliver")
+        assert len(exported) == 1
+
+    def test_degraded_tracer_still_keeps_forced_incident_cycles(self):
+        exported = []
+        tracer = self._overloaded_tracer()
+        tracer._on_export = exported.append
+        for _ in range(10):
+            run_cycle(tracer)
+        assert tracer.degraded
+        exported.clear()
+        with tracer.cycle("agent.cycle") as tr:
+            with tr.stage("attribute"):
+                tr.mark_keep()  # incident: the provenance pointer
+        assert len(exported) == 1  # must resolve even while degraded
+
+    def test_degradation_heals_when_overhead_recovers(self):
+        import time as time_mod
+
+        tracer = self._overloaded_tracer(overhead_grace_cycles=2)
+        for _ in range(5):
+            run_cycle(tracer)
+        assert tracer.degraded
+        # Raise the budget and run cycles with a real body: the EMA
+        # falls under half the budget and export re-arms.
+        tracer.config.max_overhead_pct = 1e9
+        for _ in range(10):
+            with tracer.cycle("agent.cycle") as tr:
+                with tr.stage("generate"):
+                    time_mod.sleep(0.001)
+        assert not tracer.degraded
+
+    def test_healthy_overhead_does_not_degrade(self):
+        tracer = SelfTracer(
+            TracerConfig(
+                enabled=True, sample_rate=0.0, max_overhead_pct=1e9
+            )
+        )
+        for _ in range(20):
+            run_cycle(tracer)
+        assert not tracer.degraded
+        assert tracer.snapshot()["overhead_pct"] >= 0.0
+
+
+class TestBackgroundSpanPoster:
+    class _Exporter:
+        def __init__(self, fail=False):
+            self.fail = fail
+            self.posted = []
+
+        def post_records(self, records):
+            if self.fail:
+                raise OSError("endpoint down")
+            self.posted.append(records)
+
+    def test_posts_in_background(self):
+        import time as time_mod
+
+        from tpuslo.obs import BackgroundSpanPoster
+
+        exporter = self._Exporter()
+        poster = BackgroundSpanPoster(exporter)
+        poster.submit([{"traceId": "a"}])
+        poster.close(timeout_s=5.0)
+        assert exporter.posted == [[{"traceId": "a"}]]
+        assert poster.stats["posted"] == 1
+        _ = time_mod  # imported for parity with other tests
+
+    def test_failures_counted_not_raised(self):
+        from tpuslo.obs import BackgroundSpanPoster
+
+        poster = BackgroundSpanPoster(self._Exporter(fail=True))
+        poster.submit([{"traceId": "a"}])
+        poster.close(timeout_s=5.0)
+        assert poster.stats["errors"] == 1
+
+    def test_full_queue_drops_oldest(self):
+        from tpuslo.obs import BackgroundSpanPoster
+
+        exporter = self._Exporter()
+        poster = BackgroundSpanPoster(exporter, queue_max=2)
+        # Freeze the worker so the queue actually fills.
+        import threading
+
+        gate = threading.Event()
+        orig = exporter.post_records
+        exporter.post_records = lambda r: (gate.wait(5), orig(r))
+        poster.submit([{"n": 0}])  # worker grabs this and blocks
+        import time as time_mod
+
+        time_mod.sleep(0.1)
+        for n in (1, 2, 3):
+            poster.submit([{"n": n}])
+        gate.set()
+        poster.close(timeout_s=5.0)
+        assert poster.stats["dropped"] >= 1
+        posted = [r[0]["n"] for r in exporter.posted]
+        assert 3 in posted  # the freshest batch survived
+
+
+class TestSpanExporter:
+    def test_trace_endpoint_derivation(self):
+        assert (
+            trace_endpoint_from_logs("http://otel:4318/v1/logs")
+            == "http://otel:4318/v1/traces"
+        )
+        assert (
+            trace_endpoint_from_logs("http://otel:4318")
+            == "http://otel:4318/v1/traces"
+        )
+        assert trace_endpoint_from_logs("") == ""
+
+    def test_otlp_record_shape(self):
+        exported = []
+        tracer = SelfTracer(
+            TracerConfig(enabled=True, sample_rate=1.0),
+            on_export=exported.append,
+        )
+        run_cycle(tracer, cycle=1)
+        spans = exported[0]
+        records = [span_to_record(s) for s in spans]
+        root = records[0]
+        assert root["traceId"] == spans[0].trace_id
+        assert root["spanId"] == spans[0].span_id
+        assert "parentSpanId" not in root
+        assert root["kind"] == 1
+        assert int(root["endTimeUnixNano"]) >= int(root["startTimeUnixNano"])
+        assert root["status"]["code"] == 1
+        child = records[1]
+        assert child["parentSpanId"] == spans[0].span_id
+        attr_keys = {a["key"] for a in child["attributes"]}
+        assert {"stage_attr", "batch"} <= attr_keys
+        # Typed attribute values, not stringified everything.
+        by_key = {a["key"]: a["value"] for a in child["attributes"]}
+        assert by_key["batch"] == {"intValue": "3"}
+
+    def test_envelope_is_resource_spans(self):
+        exporter = SpanExporter("http://collector/v1/traces")
+        envelope = exporter._envelope([{"traceId": "x"}])
+        scope = envelope["resourceSpans"][0]["scopeSpans"][0]
+        assert scope["scope"]["name"] == "tpuslo/obs"
+        assert scope["spans"] == [{"traceId": "x"}]
+        resource = envelope["resourceSpans"][0]["resource"]
+        assert resource["attributes"][0]["key"] == "service.name"
+
+
+def make_record(incident="inc-1") -> ProvenanceRecord:
+    return ProvenanceRecord(
+        incident_id=incident,
+        recorded_at="2026-08-01T00:00:00Z",
+        cycle=4,
+        trace_id="t" * 32,
+        root_span_id="s" * 16,
+        fault_label="hbm_pressure",
+        predicted_fault_domain="tpu_hbm",
+        confidence=0.93,
+        posterior={"tpu_hbm": 0.93, "host_offload": 0.05},
+        events=[
+            EvidenceEvent(
+                event_id=probe_event_id("hbm_alloc_stall_ms", 123),
+                signal="hbm_alloc_stall_ms",
+                value=60.0,
+                tier="trace_id_exact",
+                confidence=1.0,
+            )
+        ],
+        correlation={
+            "window_ms": 2000,
+            "total": 16,
+            "matched": 14,
+            "best_tier": "trace_id_exact",
+        },
+        delivery={"outcome": "queued", "channel": "delivery_channel"},
+        stages_ms={"generate": 0.4, "deliver": 1.2},
+    )
+
+
+class TestProvenance:
+    def test_roundtrip_and_last_record_wins(self, tmp_path):
+        path = str(tmp_path / "prov.jsonl")
+        log = ProvenanceLog(path)
+        first = make_record()
+        log.record(first)
+        second = make_record()
+        second.confidence = 0.99
+        log.record(second)
+        log.record(make_record("inc-2"))
+        log.close()
+        records = load_records(path)
+        assert set(records) == {"inc-1", "inc-2"}
+        assert records["inc-1"].confidence == 0.99
+        assert records["inc-1"].events[0].tier == "trace_id_exact"
+        assert records["inc-1"].stages_ms["deliver"] == 1.2
+
+    def test_torn_tail_is_skipped(self, tmp_path):
+        path = tmp_path / "prov.jsonl"
+        log = ProvenanceLog(str(path))
+        log.record(make_record())
+        log.close()
+        with open(path, "a", encoding="utf-8") as fh:
+            fh.write('{"incident_id": "torn', )
+        records = load_records(str(path))
+        assert set(records) == {"inc-1"}
+
+    def test_missing_file_is_empty(self, tmp_path):
+        assert load_records(str(tmp_path / "nope.jsonl")) == {}
+
+    def test_format_chain_prints_causal_steps(self):
+        text = format_chain(make_record())
+        assert "incident inc-1" in text
+        assert "predicted: tpu_hbm (confidence 0.930)" in text
+        assert "hbm_alloc_stall_ms@123" in text
+        assert "tier=trace_id_exact" in text
+        assert "14/16 events matched within 2000 ms" in text
+        assert "tpu_hbm=0.930" in text
+        assert "outcome=queued" in text
+        assert "generate=0.40ms" in text
+
+    def test_attribution_block_carries_pointers(self):
+        rec = make_record()
+        block = rec.attribution_block()
+        assert block["trace_id"] == rec.trace_id
+        assert block["root_span_id"] == rec.root_span_id
+        assert block["probe_event_ids"] == ["hbm_alloc_stall_ms@123"]
+        json.dumps(block)  # webhook payloads must serialize

@@ -25,6 +25,7 @@ from tpuslo.collector import (
     supported_synthetic_scenarios,
 )
 from tpuslo.collector.kernel import probe_smoke_check
+from tpuslo.correlation.matcher import SignalRef
 from tpuslo.delivery import DeliveryOptions
 from tpuslo.metrics import AgentMetrics, start_metrics_server
 from tpuslo.safety import OverheadGuard, RateLimiter, ShedRecoveryPolicy
@@ -176,6 +177,43 @@ def build_parser() -> argparse.ArgumentParser:
         help="deadline for the graceful SIGTERM/SIGINT drain sequence "
         "(0 = config runtime.drain_timeout_s)",
     )
+    p.add_argument(
+        "--trace",
+        action="store_true",
+        help="self-trace every agent cycle: a root span with child "
+        "spans per pipeline stage (generate/ingest-gate/validate/"
+        "correlate/attribute/deliver/snapshot), tail-sampled so slow "
+        "and error cycles are always kept, exported as OTLP traces "
+        "through the delivery layer (config: observability.enabled)",
+    )
+    p.add_argument(
+        "--trace-endpoint",
+        default="",
+        help="OTLP/HTTP traces endpoint; empty derives the /v1/traces "
+        "sibling of the logs endpoint when --output otlp "
+        "(config: observability.trace_endpoint)",
+    )
+    p.add_argument(
+        "--trace-sample-rate",
+        type=float,
+        default=-1.0,
+        help="probability of keeping a fast, error-free cycle "
+        "(-1 = config observability.sample_rate)",
+    )
+    p.add_argument(
+        "--trace-slow-ms",
+        type=float,
+        default=0.0,
+        help="cycle-duration budget: cycles at or past it are always "
+        "sampled (0 = config observability.slow_cycle_ms)",
+    )
+    p.add_argument(
+        "--provenance-path",
+        default="",
+        help="incident provenance JSONL (read by `sloctl explain`); "
+        "empty = config observability.provenance_path, falling back "
+        "to <state-dir>/provenance.jsonl",
+    )
     return p
 
 
@@ -212,8 +250,10 @@ def _gate_pipeline(events, chaos_stream, gate, metrics):
     return out
 
 
-def _print_stats(gate) -> None:
-    """Periodic stats line: every silent drop, made loud."""
+def _print_stats(gate, metrics: AgentMetrics | None = None) -> None:
+    """Periodic stats line: every silent drop, made loud — and, with
+    the self-tracer's histograms populated, per-stage p50/p99 so "why
+    is the loop slow" is answerable from the log alone."""
     from tpuslo.metrics import REJECTION_COUNTERS, VALIDATION_COUNTERS
 
     parts = [f"validation={VALIDATION_COUNTERS.snapshot()}"]
@@ -222,7 +262,53 @@ def _print_stats(gate) -> None:
         parts.append(f"rejections={rejections}")
     if gate is not None:
         parts.append(f"gate={gate.snapshot()}")
+    if metrics is not None:
+        stages = metrics.stage_quantiles()
+        if stages:
+            parts.append(
+                "stage_ms="
+                + ",".join(
+                    f"{name}:{est.get('p50', 0.0):.2f}/{est.get('p99', 0.0):.2f}"
+                    for name, est in sorted(stages.items())
+                )
+                + " (p50/p99)"
+            )
     print("agent: stats: " + " ".join(parts), file=sys.stderr)
+
+
+def _signal_ref(event, ts_cache: dict | None = None):
+    """ProbeEventV1 → correlation SignalRef without a dict round-trip.
+
+    ``ts_cache`` memoizes the ns→datetime conversion: every probe
+    event in one synthetic cycle carries the same sample timestamp,
+    and this runs once per emitted event inside the correlate stage
+    whose latency the tracer is measuring.
+    """
+    ts = None
+    if event.ts_unix_nano > 0:
+        if ts_cache is not None:
+            ts = ts_cache.get(event.ts_unix_nano)
+        if ts is None:
+            ts = datetime.fromtimestamp(
+                event.ts_unix_nano / 1e9, tz=timezone.utc
+            )
+            if ts_cache is not None:
+                ts_cache[event.ts_unix_nano] = ts
+    tpu = event.tpu
+    return SignalRef(
+        signal=event.signal,
+        timestamp=ts,
+        trace_id=event.trace_id,
+        node=event.node,
+        pod=event.pod,
+        pid=event.pid,
+        conn_tuple=event.conn_tuple.key() if event.conn_tuple else "",
+        value=event.value,
+        slice_id=tpu.slice_id if tpu else "",
+        host_index=tpu.host_index if tpu else -1,
+        program_id=tpu.program_id if tpu else "",
+        launch_id=tpu.launch_id if tpu else -1,
+    )
 
 
 def main(
@@ -385,14 +471,112 @@ def main(
         observer_factory=metrics.delivery_observer,
     )
 
+    # ---- self-observability: cycle spans + incident provenance -------
+    from tpuslo.obs import (
+        ProvenanceLog,
+        SelfTracer,
+        SpanExporter,
+        TracerConfig,
+        trace_endpoint_from_logs,
+    )
+
+    obs_cfg = cfg.observability
+    obs_enabled = args.trace or obs_cfg.enabled
+    span_exporter = None
+    trace_channel = None
+    trace_poster = None
+    if obs_enabled:
+        trace_endpoint = (
+            args.trace_endpoint
+            or obs_cfg.trace_endpoint
+            or (
+                trace_endpoint_from_logs(otlp_endpoint)
+                if args.output == "otlp"
+                else ""
+            )
+        )
+        if trace_endpoint:
+            span_exporter = SpanExporter(trace_endpoint)
+            if delivery_opts is not None:
+                # The agent's own telemetry rides the same resilience
+                # rails as everyone else's: spool, breaker, retry.
+                from tpuslo.delivery.sinks import OTLPRecordSink
+
+                trace_channel = delivery_opts.build_channel(
+                    "otlp-traces",
+                    OTLPRecordSink(span_exporter),
+                    observer=metrics.delivery_observer("otlp-traces"),
+                )
+            else:
+                # No delivery layer: a synchronous POST in the cycle's
+                # finish path would stall the loop for the exporter
+                # timeout whenever the endpoint is down — hand batches
+                # to a bounded background worker instead (best-effort,
+                # drop-oldest, accounted).
+                from tpuslo.obs import BackgroundSpanPoster
+
+                trace_poster = BackgroundSpanPoster(span_exporter)
+
+    def _export_spans(spans) -> None:
+        records = span_exporter.to_records(spans)
+        if trace_channel is not None:
+            trace_channel.submit("trace", records)
+        else:
+            trace_poster.submit(records)
+
+    tracer = SelfTracer(
+        TracerConfig(
+            enabled=obs_enabled,
+            sample_rate=(
+                args.trace_sample_rate
+                if args.trace_sample_rate >= 0
+                else obs_cfg.sample_rate
+            ),
+            slow_cycle_ms=args.trace_slow_ms or obs_cfg.slow_cycle_ms,
+            max_overhead_pct=obs_cfg.max_overhead_pct,
+        ),
+        observer=metrics.trace_observer(),
+        # No endpoint = metrics-only tracing: pass no export callback
+        # at all, so neither stats nor the spans-exported counter can
+        # report spans that never leave the process.
+        on_export=_export_spans if span_exporter is not None else None,
+        log=lambda msg: print(f"agent: {msg}", file=sys.stderr),
+    )
+    provenance_path = args.provenance_path or obs_cfg.provenance_path
+    if not provenance_path and obs_enabled and (
+        args.state_dir or cfg.runtime.state_dir
+    ):
+        import os as _os
+
+        provenance_path = _os.path.join(
+            args.state_dir or cfg.runtime.state_dir, "provenance.jsonl"
+        )
+    provenance_log = (
+        ProvenanceLog(provenance_path)
+        if obs_enabled and provenance_path
+        else None
+    )
+    if obs_enabled:
+        print(
+            "agent: self-tracing on (sample_rate="
+            f"{tracer.config.sample_rate:g}, slow>="
+            f"{tracer.config.slow_cycle_ms:g}ms, "
+            + (
+                f"endpoint={span_exporter.endpoint}"
+                if span_exporter
+                else "metrics-only"
+            )
+            + (
+                f", provenance={provenance_path}" if provenance_log else ""
+            )
+            + ")",
+            file=sys.stderr,
+        )
+
     metrics.up.set(1)
     metrics.capability_mode.labels(mode=mode).set(1)
     metrics.event_kind.labels(kind=args.event_kind).set(1)
     metrics.set_enabled_signals(generator.enabled_signals())
-    server = None
-    if args.metrics_port:
-        server = start_metrics_server(metrics, args.metrics_port)
-        print(f"agent: metrics on :{args.metrics_port}/metrics", file=sys.stderr)
 
     limiter = RateLimiter(eps, cfg.sampling.burst_limit)
     guard = OverheadGuard(max_overhead)
@@ -432,9 +616,11 @@ def main(
             )
 
     def _all_channels():
-        return writers.delivery_channels + (
-            [webhook_channel] if webhook_channel is not None else []
-        )
+        return writers.delivery_channels + [
+            ch
+            for ch in (webhook_channel, trace_channel)
+            if ch is not None
+        ]
 
     def _export_breakers():
         return {
@@ -447,6 +633,51 @@ def main(
                 ch.breaker.restore_state(state[ch.name])
 
     runtime.register("breakers", _export_breakers, _restore_breakers)
+
+    # ---- real readiness: /readyz tells the truth ---------------------
+    from tpuslo.metrics import Readiness
+
+    readiness = Readiness()
+    readiness_state = {"draining": False}
+    readiness.add_check(
+        "drain",
+        lambda: (not readiness_state["draining"], "drain in progress"),
+    )
+
+    def _breakers_ready():
+        channels = _all_channels()
+        if channels and all(
+            ch.breaker.state == "open" for ch in channels
+        ):
+            return False, (
+                f"all {len(channels)} delivery breakers open "
+                "(every sink unreachable)"
+            )
+        return True, "ok"
+
+    readiness.add_check("breakers", _breakers_ready)
+    if store is not None:
+
+        def _snapshot_fresh():
+            age = store.age_s()
+            max_age = cfg.runtime.snapshot_max_age_s
+            if age != float("inf") and max_age > 0 and age > max_age:
+                return False, (
+                    f"state snapshot stale ({age:.0f}s > {max_age:.0f}s)"
+                )
+            return True, "ok"
+
+        readiness.add_check("snapshot", _snapshot_fresh)
+
+    server = None
+    if args.metrics_port:
+        server = start_metrics_server(
+            metrics, args.metrics_port, readiness=readiness
+        )
+        print(
+            f"agent: metrics on :{args.metrics_port}/metrics",
+            file=sys.stderr,
+        )
 
     sample_meta = SampleMeta(
         cluster=args.cluster,
@@ -484,144 +715,379 @@ def main(
             log=lambda msg: print(f"agent: {msg}", file=sys.stderr),
         )
 
+    from tpuslo.correlation.matcher import SpanRef
+    from tpuslo.correlation.matcher import match as corr_match
+    from tpuslo.obs import (
+        EvidenceEvent,
+        ProvenanceRecord,
+        probe_event_id,
+    )
+    from tpuslo.schema import rfc3339
+
+    def _correlation_summary(decisions) -> dict:
+        matched = [d for _, d in decisions if d.matched]
+        best = max(matched, key=lambda d: d.confidence, default=None)
+        return {
+            "window_ms": cfg.correlation.window_ms,
+            "total": len(decisions),
+            "matched": len(matched),
+            "best_tier": best.tier if best else "none",
+        }
+
     def emit_one(idx: int) -> None:
         now = datetime.now(timezone.utc)
-        sample = build_synthetic_sample(args.scenario, idx, now, sample_meta)
-
-        if args.event_kind in ("slo", "both"):
-            events = normalize_sample(sample)
-            valid = []
-            for event in events:
-                if validate_slo(event):
-                    valid.append(event)
-                else:
-                    metrics.dropped.labels(reason="schema").inc()
-            try:
-                writers.emit_slo(valid)
-                metrics.slo_events.inc(len(valid))
-            except Exception as exc:  # noqa: BLE001 — emit failures are drops
-                metrics.dropped.labels(reason="emit").inc(len(valid))
-                print(f"agent: slo emit failed: {exc}", file=sys.stderr)
-
-        if args.event_kind in ("probe", "both"):
-            probe_meta = Metadata(trace_id=sample.trace_id)
-            generated = list(generator.generate(sample, probe_meta))
-            if ici_prober is not None:
-                # Measured collectives ride the same validation /
-                # rate-limit / emit path as every other probe signal.
-                generated.extend(ici_prober.maybe_probe(time.monotonic()))
-            if chaos_stream is not None or gate is not None:
-                generated = _gate_pipeline(
-                    generated, chaos_stream, gate, metrics
+        with tracer.cycle(
+            "agent.cycle", cycle=idx, scenario=args.scenario
+        ) as tr:
+            # ---- generate: synthetic sample → SLO + probe events -----
+            with tr.stage("generate") as sp:
+                sample = build_synthetic_sample(
+                    args.scenario, idx, now, sample_meta
                 )
-            emitted = []
-            for event in generated:
-                if not limiter.allow():
-                    metrics.dropped.labels(reason="rate_limit").inc()
-                    continue
-                if not validate_probe(event):
-                    metrics.dropped.labels(reason="schema").inc()
-                    continue
-                emitted.append(event)
-            try:
-                writers.emit_probe(emitted)
-                for event in emitted:
-                    metrics.observe_probe(event.signal, event.value)
-            except Exception as exc:  # noqa: BLE001
-                metrics.dropped.labels(reason="emit").inc(len(emitted))
-                print(f"agent: probe emit failed: {exc}", file=sys.stderr)
+                slo_events = (
+                    normalize_sample(sample)
+                    if args.event_kind in ("slo", "both")
+                    else []
+                )
+                generated: list = []
+                if args.event_kind in ("probe", "both"):
+                    probe_meta = Metadata(trace_id=sample.trace_id)
+                    generated = list(generator.generate(sample, probe_meta))
+                    if ici_prober is not None:
+                        # Measured collectives ride the same validation /
+                        # rate-limit / emit path as every other signal.
+                        generated.extend(
+                            ici_prober.maybe_probe(time.monotonic())
+                        )
+                sp.set(
+                    slo_events=len(slo_events),
+                    probe_events=len(generated),
+                    fault_label=sample.fault_label or "",
+                )
 
-        if (
-            hook is not None
-            and attributor is not None
-            and sample.fault_label
-            and idx <= progress["alert_cycle"]
-        ):
-            # This cycle's alert was already sent by a previous
-            # incarnation (restored high-water mark): re-emitting it
-            # would page twice for one incident.
-            metrics.webhook_sent.labels(outcome="deduped").inc()
-        elif hook is not None and attributor is not None and sample.fault_label:
-            # At-most-once across restarts: persist the high-water mark
-            # *before* the send, so a crash in between loses (at worst)
-            # one alert instead of duplicating it — downstream pagers
-            # treat duplicate incidents as new pages, lost ones re-fire
-            # on the next burn window.
-            progress["alert_cycle"] = idx
-            if runtime.enabled:
-                runtime.snapshot_now()
-            fault = attribution.FaultSample(
-                incident_id=f"agent-inc-{idx + 1:04d}",
-                timestamp=now,
-                cluster=args.cluster,
-                namespace=args.namespace,
-                service=args.service,
-                fault_label=sample.fault_label,
-                confidence=0.9,
-                burn_rate=2.0,
-                window_minutes=5,
-                request_id=sample.request_id,
-                trace_id=sample.trace_id,
-                # Full fault profile, independent of the currently-enabled
-                # probe set: shedding shouldn't starve attribution.
-                signals=profile_for_fault(sample.fault_label),
+            # ---- ingest gate: chaos + admission --------------------
+            with tr.stage("ingest_gate") as sp:
+                gated = generated
+                if generated and (
+                    chaos_stream is not None or gate is not None
+                ):
+                    gated = _gate_pipeline(
+                        generated, chaos_stream, gate, metrics
+                    )
+                sp.set(
+                    events_in=len(generated),
+                    events_out=len(gated),
+                    gate_enabled=gate is not None,
+                )
+
+            # ---- validate: schema + rate limit ---------------------
+            with tr.stage("validate") as sp:
+                valid_slo = []
+                slo_rejects = 0
+                for event in slo_events:
+                    if validate_slo(event):
+                        valid_slo.append(event)
+                    else:
+                        slo_rejects += 1
+                        metrics.dropped.labels(reason="schema").inc()
+                emitted = []
+                rate_dropped = schema_dropped = 0
+                for event in gated:
+                    if not limiter.allow():
+                        rate_dropped += 1
+                        metrics.dropped.labels(reason="rate_limit").inc()
+                        continue
+                    if not validate_probe(event):
+                        schema_dropped += 1
+                        metrics.dropped.labels(reason="schema").inc()
+                        continue
+                    emitted.append(event)
+                sp.set(
+                    slo_valid=len(valid_slo),
+                    slo_rejected=slo_rejects,
+                    probe_valid=len(emitted),
+                    rate_limited=rate_dropped,
+                    schema_rejected=schema_dropped,
+                )
+
+            # ---- correlate: probe events vs this cycle's trace -----
+            # Per-event tier/confidence decisions feed the incident
+            # provenance chain — their only consumer — so the matcher
+            # runs exactly on the cycles that will attribute (fault
+            # label + webhook) with observability on; every other
+            # cycle records the stage as skipped and pays nothing.
+            incident_fault = (
+                hook is not None
+                and attributor is not None
+                and sample.fault_label
             )
-            attr = attributor.attribute_sample(fault)
-            if webhook_channel is not None:
-                import json as json_mod
+            decisions: list = []
+            with tr.stage("correlate") as sp:
+                if (
+                    incident_fault
+                    and emitted
+                    and (tracer.enabled or provenance_log is not None)
+                ):
+                    span_ref = SpanRef(
+                        timestamp=now,
+                        trace_id=sample.trace_id,
+                        service=args.service,
+                        node=args.node,
+                    )
+                    ts_cache: dict = {}
+                    decisions = [
+                        (
+                            event,
+                            corr_match(
+                                span_ref,
+                                _signal_ref(event, ts_cache),
+                                cfg.correlation.window_ms,
+                            ),
+                        )
+                        for event in emitted
+                    ]
+                    matched = [d for _, d in decisions if d.matched]
+                    best = max(
+                        matched, key=lambda d: d.confidence, default=None
+                    )
+                    sp.set(
+                        total=len(emitted),
+                        matched=len(matched),
+                        best_tier=best.tier if best else "",
+                        window_ms=cfg.correlation.window_ms,
+                    )
+                else:
+                    sp.set(total=len(emitted), skipped=True)
 
-                webhook_channel.submit(
-                    "incident", [json_mod.loads(hook.build_payload(attr))]
+            # ---- attribute: fault cycles → incident posterior ------
+            attr = None
+            prov_rec = None
+            webhook_outcome = ""
+            with tr.stage("attribute") as sp:
+                if incident_fault and idx <= progress["alert_cycle"]:
+                    # Already alerted by a previous incarnation
+                    # (restored high-water mark): re-emitting would
+                    # page twice for one incident.
+                    webhook_outcome = "deduped"
+                    sp.set(deduped=True)
+                elif incident_fault:
+                    fault = attribution.FaultSample(
+                        incident_id=f"agent-inc-{idx + 1:04d}",
+                        timestamp=now,
+                        cluster=args.cluster,
+                        namespace=args.namespace,
+                        service=args.service,
+                        fault_label=sample.fault_label,
+                        confidence=0.9,
+                        burn_rate=2.0,
+                        window_minutes=5,
+                        request_id=sample.request_id,
+                        trace_id=sample.trace_id,
+                        # Full fault profile, independent of the
+                        # currently-enabled probe set: shedding
+                        # shouldn't starve attribution.
+                        signals=profile_for_fault(sample.fault_label),
+                    )
+                    attr = attributor.attribute_sample(fault)
+                    if tracer.enabled or provenance_log is not None:
+                        supporting = {
+                            s
+                            for h in attr.fault_hypotheses
+                            for s in h.evidence
+                        }
+                        prov_rec = ProvenanceRecord(
+                            incident_id=attr.incident_id,
+                            recorded_at=rfc3339(now),
+                            cycle=idx,
+                            trace_id=tr.trace_id,
+                            root_span_id=(
+                                tr.root.span_id if tr.root else ""
+                            ),
+                            fault_label=sample.fault_label,
+                            predicted_fault_domain=(
+                                attr.predicted_fault_domain
+                            ),
+                            confidence=attr.confidence,
+                            posterior={
+                                h.domain: round(h.posterior, 6)
+                                for h in attr.fault_hypotheses[:5]
+                            },
+                            events=[
+                                EvidenceEvent(
+                                    event_id=probe_event_id(
+                                        ev.signal, ev.ts_unix_nano
+                                    ),
+                                    signal=ev.signal,
+                                    value=ev.value,
+                                    tier=dec.tier,
+                                    confidence=dec.confidence,
+                                )
+                                for ev, dec in decisions
+                                if ev.signal in supporting or dec.matched
+                            ],
+                            correlation=_correlation_summary(decisions),
+                        )
+                        attr.provenance = prov_rec.attribution_block()
+                        # The provenance record points at this cycle's
+                        # trace — force tail sampling to keep it, or
+                        # the pointer would dangle for ~95% of
+                        # incidents at the default sample rate.
+                        tr.mark_keep()
+                    sp.set(
+                        incident_id=attr.incident_id,
+                        domain=attr.predicted_fault_domain,
+                        confidence=round(attr.confidence, 4),
+                    )
+                else:
+                    sp.set(skipped=True)
+
+            # ---- deliver: writers + webhook ------------------------
+            with tr.stage("deliver") as sp:
+                if args.event_kind in ("slo", "both"):
+                    try:
+                        writers.emit_slo(valid_slo)
+                        metrics.slo_events.inc(len(valid_slo))
+                    except Exception as exc:  # noqa: BLE001 — drops
+                        metrics.dropped.labels(reason="emit").inc(
+                            len(valid_slo)
+                        )
+                        print(
+                            f"agent: slo emit failed: {exc}",
+                            file=sys.stderr,
+                        )
+                if args.event_kind in ("probe", "both"):
+                    try:
+                        writers.emit_probe(emitted)
+                        for event in emitted:
+                            metrics.observe_probe(event.signal, event.value)
+                    except Exception as exc:  # noqa: BLE001
+                        metrics.dropped.labels(reason="emit").inc(
+                            len(emitted)
+                        )
+                        print(
+                            f"agent: probe emit failed: {exc}",
+                            file=sys.stderr,
+                        )
+                if webhook_outcome == "deduped":
+                    metrics.webhook_sent.labels(outcome="deduped").inc()
+                elif attr is not None:
+                    # At-most-once across restarts: persist the high-
+                    # water mark *before* the send, so a crash in
+                    # between loses (at worst) one alert instead of
+                    # duplicating it — downstream pagers treat
+                    # duplicate incidents as new pages, lost ones
+                    # re-fire on the next burn window.
+                    progress["alert_cycle"] = idx
+                    if runtime.enabled:
+                        runtime.snapshot_now()
+                    if webhook_channel is not None:
+                        import json as json_mod
+
+                        webhook_channel.submit(
+                            "incident",
+                            [json_mod.loads(hook.build_payload(attr))],
+                        )
+                        metrics.webhook_sent.labels(outcome="queued").inc()
+                        webhook_outcome = "queued"
+                    else:
+                        try:
+                            hook.send(attr)
+                            metrics.webhook_sent.labels(outcome="ok").inc()
+                            webhook_outcome = "ok"
+                        except webhook.WebhookError as exc:
+                            metrics.webhook_sent.labels(
+                                outcome="error"
+                            ).inc()
+                            webhook_outcome = "error"
+                            print(
+                                f"agent: webhook failed: {exc}",
+                                file=sys.stderr,
+                            )
+                sp.set(
+                    slo=len(valid_slo),
+                    probe=len(emitted),
+                    webhook=webhook_outcome or "none",
                 )
-                metrics.webhook_sent.labels(outcome="queued").inc()
-            else:
-                try:
-                    hook.send(attr)
-                    metrics.webhook_sent.labels(outcome="ok").inc()
-                except webhook.WebhookError as exc:
-                    metrics.webhook_sent.labels(outcome="error").inc()
-                    print(f"agent: webhook failed: {exc}", file=sys.stderr)
+                if prov_rec is not None:
+                    prov_rec.delivery = {
+                        "outcome": webhook_outcome or "none",
+                        "channel": (
+                            "delivery_channel"
+                            if webhook_channel is not None
+                            else "direct"
+                        ),
+                    }
 
-        if (
-            args.stats_interval_cycles
-            and (idx + 1) % args.stats_interval_cycles == 0
-        ):
-            _print_stats(gate)
+            # ---- snapshot: stats, overhead guard, durable state ----
+            with tr.stage("snapshot") as sp:
+                if (
+                    args.stats_interval_cycles
+                    and (idx + 1) % args.stats_interval_cycles == 0
+                ):
+                    _print_stats(gate, metrics)
+                result = guard.evaluate()
+                if result.valid:
+                    metrics.cpu_overhead_pct.set(result.cpu_pct)
+                    if result.over_budget:
+                        recovery.note(result)  # breaks the streak
+                        shed = generator.disable_highest_cost()
+                        if shed:
+                            print(
+                                f"agent: overhead {result.cpu_pct:.2f}% > "
+                                f"{max_overhead:.2f}%, disabled {shed}",
+                                file=sys.stderr,
+                            )
+                            metrics.set_enabled_signals(
+                                generator.enabled_signals()
+                            )
+                    elif recovery.note(result):
+                        restored = generator.restore_one()
+                        if restored:
+                            print(
+                                f"agent: overhead {result.cpu_pct:.2f}% "
+                                f"under budget for {recovery.cycles} "
+                                f"cycles, re-enabled {restored}",
+                                file=sys.stderr,
+                            )
+                            metrics.signals_restored.labels(
+                                signal=restored
+                            ).inc()
+                            metrics.set_enabled_signals(
+                                generator.enabled_signals()
+                            )
+                metrics.mark_cycle()
+                # Progress advances only after the cycle's events hit
+                # the writers: a crash replays from the last durable
+                # cycle (at-least-once; the restored dedup digest
+                # absorbs the overlap).
+                progress["next_cycle"] = idx + 1
+                snapshot_age = -1.0
+                if runtime.enabled:
+                    runtime.maybe_snapshot()
+                    age = runtime.store.age_s()
+                    if age != float("inf"):
+                        metrics.runtime_snapshot_age_seconds.set(age)
+                        snapshot_age = age
+                sp.set(
+                    snapshot_age_s=round(snapshot_age, 3),
+                    breakers_open=sum(
+                        1
+                        for ch in _all_channels()
+                        if ch.breaker.state == "open"
+                    ),
+                )
 
-        result = guard.evaluate()
-        if result.valid:
-            metrics.cpu_overhead_pct.set(result.cpu_pct)
-            if result.over_budget:
-                recovery.note(result)  # breaks any under-budget streak
-                shed = generator.disable_highest_cost()
-                if shed:
-                    print(
-                        f"agent: overhead {result.cpu_pct:.2f}% > "
-                        f"{max_overhead:.2f}%, disabled {shed}",
-                        file=sys.stderr,
-                    )
-                    metrics.set_enabled_signals(generator.enabled_signals())
-            elif recovery.note(result):
-                restored = generator.restore_one()
-                if restored:
-                    print(
-                        f"agent: overhead {result.cpu_pct:.2f}% under "
-                        f"budget for {recovery.cycles} cycles, "
-                        f"re-enabled {restored}",
-                        file=sys.stderr,
-                    )
-                    metrics.signals_restored.labels(signal=restored).inc()
-                    metrics.set_enabled_signals(generator.enabled_signals())
-        metrics.mark_cycle()
-        # Progress advances only after the cycle's events hit the
-        # writers: a crash replays from the last durable cycle (at-
-        # least-once; the restored dedup digest absorbs the overlap).
-        progress["next_cycle"] = idx + 1
-        if runtime.enabled:
-            runtime.maybe_snapshot()
-            age = runtime.store.age_s()
-            if age != float("inf"):
-                metrics.runtime_snapshot_age_seconds.set(age)
+            # Provenance is finalized after the last stage CM closed,
+            # so stages_ms covers the full cycle — deliver and snapshot
+            # included (the two stages most likely to explain a slow
+            # incident cycle).
+            if prov_rec is not None:
+                prov_rec.stages_ms = {
+                    s.name: round(s.duration_ms, 4)
+                    for s in getattr(tr, "spans", [])
+                }
+                if provenance_log is not None:
+                    provenance_log.record(prov_rec)
 
     # Warm restore happens after every component registered its hooks;
     # ring-loop components (ProbeManager shed list, supervisor) apply
@@ -657,6 +1123,7 @@ def main(
                 args, cfg, mode, signal_set, enricher, writers, metrics,
                 limiter, guard, recovery, ici_prober=ici_prober, gate=gate,
                 runtime=runtime, runtime_observer=runtime_observer,
+                self_tracer=tracer,
             )
         else:
             idx = progress["next_cycle"]
@@ -672,18 +1139,24 @@ def main(
         drain_reason = f"signal_{sig.signum}"
     finally:
         restore_handlers()
+        readiness_state["draining"] = True  # /readyz flips to 503 first
         drain = DrainController(
             drain_reason,
             deadline_s=drain_timeout,
             log=lambda msg: print(f"agent: {msg}", file=sys.stderr),
         )
         metrics.up.set(0)
-        _print_stats(gate)
+        _print_stats(gate, metrics)
         if chaos_stream is not None:
             print(
                 f"agent: chaos-telemetry: {chaos_stream.snapshot()}",
                 file=sys.stderr,
             )
+        if obs_enabled:
+            snap = dict(tracer.snapshot())
+            if trace_poster is not None:
+                snap["direct_poster"] = dict(trace_poster.stats)
+            print(f"agent: self-trace: {snap}", file=sys.stderr)
         # Generation stopped above; now push queued batches out (or to
         # the spool), snapshot, and release sinks — all on one deadline.
         if webhook_channel is not None:
@@ -697,6 +1170,20 @@ def main(
             "flush_writers",
             lambda budget: writers.close(flush_timeout_s=budget),
         )
+        if trace_channel is not None:
+            drain.step(
+                "flush_traces",
+                lambda budget: trace_channel.close(flush_timeout_s=budget),
+            )
+        if trace_poster is not None:
+            drain.step(
+                "flush_traces",
+                lambda budget: trace_poster.close(timeout_s=budget),
+            )
+        if provenance_log is not None:
+            drain.step(
+                "close_provenance", lambda budget: provenance_log.close()
+            )
         if runtime.enabled:
             drain.step(
                 "final_snapshot", lambda budget: runtime.snapshot_now()
@@ -709,6 +1196,7 @@ def main(
         for channel in (
             writers.delivery_channels
             + ([webhook_channel] if webhook_channel else [])
+            + ([trace_channel] if trace_channel else [])
         ):
             snap = channel.snapshot()
             print(
@@ -728,7 +1216,7 @@ def main(
 def _run_ring_loop(
     args, cfg, mode, signal_set, enricher, writers, metrics, limiter, guard,
     recovery, ici_prober=None, gate=None, runtime=None,
-    runtime_observer=None,
+    runtime_observer=None, self_tracer=None,
 ) -> None:
     """The real-probe path: ringbuf → normalize → schema → emit.
 
@@ -916,96 +1404,141 @@ def _run_ring_loop(
             metrics.dropped.labels(reason="emit").inc()
             print(f"agent: probe emit failed: {exc}", file=sys.stderr)
 
+    if self_tracer is None:
+        from tpuslo.obs import SelfTracer, TracerConfig
+
+        self_tracer = SelfTracer(TracerConfig(enabled=False))
+
     cycles = 0
     try:
         while True:
-            if sampler is not None:
-                sampler.sample_once()
-            for sample in consumer.poll(timeout_ms=int(args.interval_s * 500)):
-                supervisor.beat(sample.signal)
-                event = to_probe_event(sample, meta_template, enricher)
-                if event is None:
-                    if sample.signal == "hello_heartbeat_total":
-                        metrics.mark_cycle()
-                    continue
-                emit_probe_event(event)
-            if ici_prober is not None:
-                # Active interconnect probe rides the same emit path as
-                # kernel-ring events (synthetic loop does the same).
-                for event in ici_prober.maybe_probe(time.monotonic()):
-                    emit_probe_event(event)
+            # Ring cycles get a shallower span tree than the synthetic
+            # loop (gate/validate/deliver happen per-event inside the
+            # consumer drain), but the same root span + tail sampling.
+            with self_tracer.cycle(
+                "agent.cycle", cycle=cycles, loop="ring"
+            ) as tr:
+                with tr.stage("generate") as sp:
+                    if sampler is not None:
+                        sampler.sample_once()
+                    polled = list(
+                        consumer.poll(
+                            timeout_ms=int(args.interval_s * 500)
+                        )
+                    )
+                    sp.set(samples=len(polled))
+                with tr.stage("deliver") as sp:
+                    emitted_n = 0
+                    for sample in polled:
+                        supervisor.beat(sample.signal)
+                        event = to_probe_event(
+                            sample, meta_template, enricher
+                        )
+                        if event is None:
+                            if sample.signal == "hello_heartbeat_total":
+                                metrics.mark_cycle()
+                            continue
+                        emit_probe_event(event)
+                        emitted_n += 1
+                    if ici_prober is not None:
+                        # Active interconnect probe rides the same emit
+                        # path as kernel-ring events.
+                        for event in ici_prober.maybe_probe(
+                            time.monotonic()
+                        ):
+                            emit_probe_event(event)
+                            emitted_n += 1
+                    sp.set(events=emitted_n)
 
-            for action in supervisor.evaluate():
-                if action.action == "restarted":
-                    runtime_observer.probe_restarted(action.signal)
-                print(
-                    f"agent: supervisor: {action.signal} "
-                    f"{action.action} {action.detail}".rstrip(),
-                    file=sys.stderr,
-                )
+                with tr.stage("supervise") as sp:
+                    restarts = 0
+                    for action in supervisor.evaluate():
+                        if action.action == "restarted":
+                            restarts += 1
+                            runtime_observer.probe_restarted(action.signal)
+                        print(
+                            f"agent: supervisor: {action.signal} "
+                            f"{action.action} {action.detail}".rstrip(),
+                            file=sys.stderr,
+                        )
+                    sp.set(restarts=restarts)
 
-            result = guard.evaluate()
-            if result.valid:
-                metrics.cpu_overhead_pct.set(result.cpu_pct)
-                if result.over_budget:
-                    recovery.note(result)  # breaks the recovery streak
-                    shed = pm.shed_highest_cost()
-                    if shed:
-                        print(
-                            f"agent: overhead {result.cpu_pct:.2f}%, "
-                            f"detached {shed}",
-                            file=sys.stderr,
-                        )
-                        supervisor.forget(shed)
-                        metrics.set_enabled_signals(pm.attached_signals)
-                        # Detach closed that object's ring fd; forget it
-                        # so a restored probe reusing the fd number
-                        # re-registers with the consumer.
-                        known_fds &= set(pm.ringbuf_fds())
-                elif recovery.note(result):
-                    shed_list = pm.shed_signals
-                    candidate = shed_list[-1] if shed_list else None
-                    if candidate is not None and not supervisor.may_restore(
-                        candidate
-                    ):
-                        # Flap hold-down outranks the overhead-guard
-                        # recovery streak: quiet CPU cycles say nothing
-                        # about why the supervisor shed a flapping probe.
-                        print(
-                            f"agent: restore of {candidate} held down "
-                            "(flapping)",
-                            file=sys.stderr,
-                        )
-                        restored = None
-                    else:
-                        restored = pm.restore_one()
-                    if restored:
-                        print(
-                            f"agent: overhead {result.cpu_pct:.2f}% under "
-                            f"budget for {recovery.cycles} cycles, "
-                            f"re-attached {restored}",
-                            file=sys.stderr,
-                        )
-                        supervisor.note_restored(restored)
-                        metrics.signals_restored.labels(
-                            signal=restored
-                        ).inc()
-                        metrics.set_enabled_signals(pm.attached_signals)
-                        _sync_ring_fds()
-            metrics.mark_cycle()
-            if runtime is not None and runtime.enabled:
-                runtime.maybe_snapshot()
-                age = runtime.store.age_s()
-                if age != float("inf"):
-                    # Kept current even across failed saves: the
-                    # staleness alert must fire exactly then.
-                    metrics.runtime_snapshot_age_seconds.set(age)
+                with tr.stage("guard") as sp:
+                    result = guard.evaluate()
+                    if result.valid:
+                        metrics.cpu_overhead_pct.set(result.cpu_pct)
+                        sp.set(cpu_pct=round(result.cpu_pct, 3))
+                        if result.over_budget:
+                            recovery.note(result)  # breaks the streak
+                            shed = pm.shed_highest_cost()
+                            if shed:
+                                print(
+                                    f"agent: overhead "
+                                    f"{result.cpu_pct:.2f}%, "
+                                    f"detached {shed}",
+                                    file=sys.stderr,
+                                )
+                                supervisor.forget(shed)
+                                metrics.set_enabled_signals(
+                                    pm.attached_signals
+                                )
+                                # Detach closed that object's ring fd;
+                                # forget it so a restored probe reusing
+                                # the fd number re-registers.
+                                known_fds &= set(pm.ringbuf_fds())
+                        elif recovery.note(result):
+                            shed_list = pm.shed_signals
+                            candidate = (
+                                shed_list[-1] if shed_list else None
+                            )
+                            if (
+                                candidate is not None
+                                and not supervisor.may_restore(candidate)
+                            ):
+                                # Flap hold-down outranks the overhead-
+                                # guard recovery streak: quiet CPU
+                                # cycles say nothing about why the
+                                # supervisor shed a flapping probe.
+                                print(
+                                    f"agent: restore of {candidate} "
+                                    "held down (flapping)",
+                                    file=sys.stderr,
+                                )
+                                restored = None
+                            else:
+                                restored = pm.restore_one()
+                            if restored:
+                                print(
+                                    f"agent: overhead "
+                                    f"{result.cpu_pct:.2f}% under "
+                                    f"budget for {recovery.cycles} "
+                                    f"cycles, re-attached {restored}",
+                                    file=sys.stderr,
+                                )
+                                supervisor.note_restored(restored)
+                                metrics.signals_restored.labels(
+                                    signal=restored
+                                ).inc()
+                                metrics.set_enabled_signals(
+                                    pm.attached_signals
+                                )
+                                _sync_ring_fds()
+
+                with tr.stage("snapshot") as sp:
+                    metrics.mark_cycle()
+                    if runtime is not None and runtime.enabled:
+                        runtime.maybe_snapshot()
+                        age = runtime.store.age_s()
+                        if age != float("inf"):
+                            # Kept current even across failed saves:
+                            # the staleness alert must fire then.
+                            metrics.runtime_snapshot_age_seconds.set(age)
             cycles += 1
             if (
                 args.stats_interval_cycles
                 and cycles % args.stats_interval_cycles == 0
             ):
-                _print_stats(gate)
+                _print_stats(gate, metrics)
             if args.count and cycles >= args.count:
                 break
             time.sleep(args.interval_s)

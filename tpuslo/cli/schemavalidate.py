@@ -97,6 +97,13 @@ def golden_payloads() -> list[tuple[str, dict]]:
             FaultHypothesis("tpu_hbm", 0.93, ["hbm_alloc_stall_ms"]),
             FaultHypothesis("host_offload", 0.05, []),
         ],
+        # Self-observability pointer (ISSUE 5): producing cycle's trace
+        # + supporting probe events; full chain via `sloctl explain`.
+        provenance={
+            "trace_id": "0af7651916cd43dd8448eb211c80319c",
+            "root_span_id": "b7ad6b7169203331",
+            "probe_event_ids": ["hbm_alloc_stall_ms@1767225600000000000"],
+        },
     )
     return [
         (schema.SCHEMA_SLO_EVENT, slo_event.to_dict()),

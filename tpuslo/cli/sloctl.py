@@ -1,8 +1,12 @@
-"""sloctl: operator CLI — ``prereq check`` and ``cdgate check``.
+"""sloctl: operator CLI — ``prereq check``, ``cdgate check`` and
+``explain <incident>``.
 
 Reference: ``cmd/sloctl`` — prereq text/json with ``--strict``; cdgate
 thresholds with ``--fail-open`` post-processing
-(``cmd/sloctl/cdgate.go:92-95``).
+(``cmd/sloctl/cdgate.go:92-95``).  ``explain`` is the self-observability
+addition: it prints the recorded provenance chain behind one incident
+page (probe events → correlation tier/confidence → fault-domain
+posterior → alert delivery outcome) from the agent's provenance log.
 """
 
 from __future__ import annotations
@@ -44,6 +48,30 @@ def build_parser() -> argparse.ArgumentParser:
         "--fail-closed",
         action="store_true",
         help="query failures fail the gate, overriding config fail_open",
+    )
+
+    ex = sub.add_parser(
+        "explain",
+        help="print the recorded provenance chain behind one incident",
+    )
+    ex.add_argument(
+        "incident_id",
+        nargs="?",
+        default="",
+        help="incident id (e.g. agent-inc-0005); omit to list known ids",
+    )
+    ex.add_argument("--config", default="")
+    ex.add_argument(
+        "--provenance",
+        default="",
+        help="provenance JSONL written by `agent --trace` (default: "
+        "config observability.provenance_path, then "
+        "<runtime.state_dir>/provenance.jsonl)",
+    )
+    ex.add_argument(
+        "--json",
+        action="store_true",
+        help="emit the raw provenance record instead of the chain text",
     )
     return p
 
@@ -87,10 +115,63 @@ def run_cdgate(args) -> int:
     return 0 if effective_pass else 1
 
 
+def run_explain(args) -> int:
+    import os
+
+    from tpuslo.obs import format_chain, load_records
+
+    path = args.provenance
+    if not path:
+        cfg = resolve_config(args.config)
+        path = cfg.observability.provenance_path
+        if not path and cfg.runtime.state_dir:
+            path = os.path.join(cfg.runtime.state_dir, "provenance.jsonl")
+    if not path:
+        print(
+            "sloctl explain: no provenance log — pass --provenance or "
+            "set observability.provenance_path (the agent writes it "
+            "when self-tracing is enabled)",
+            file=sys.stderr,
+        )
+        return 1
+    records = load_records(path)
+    if not records:
+        print(
+            f"sloctl explain: no provenance records in {path}",
+            file=sys.stderr,
+        )
+        return 1
+    if not args.incident_id:
+        for incident_id in sorted(records):
+            rec = records[incident_id]
+            print(
+                f"{incident_id}  {rec.predicted_fault_domain}"
+                f"  confidence={rec.confidence:.3f}"
+                f"  delivery={rec.delivery.get('outcome', '?')}"
+            )
+        return 0
+    rec = records.get(args.incident_id)
+    if rec is None:
+        known = ", ".join(sorted(records)[:10])
+        print(
+            f"sloctl explain: incident {args.incident_id!r} not in "
+            f"{path} (known: {known})",
+            file=sys.stderr,
+        )
+        return 1
+    if args.json:
+        print(json.dumps(rec.to_dict(), indent=2))
+    else:
+        print(format_chain(rec))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     if args.command == "prereq":
         return run_prereq(args)
+    if args.command == "explain":
+        return run_explain(args)
     return run_cdgate(args)
 
 

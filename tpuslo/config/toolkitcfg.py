@@ -117,6 +117,34 @@ class IngestConfig:
 
 
 @dataclass
+class ObservabilityConfig:
+    """Self-tracing knobs (``tpuslo.obs``).
+
+    ``enabled`` flips to True whenever an ``observability:`` section is
+    present in the config file (same presence-implies-on convention as
+    ``ingest:``); an explicit ``enabled: false`` still wins.  The agent
+    CLI's ``--trace`` flag overrides everything.
+    """
+
+    enabled: bool = False
+    #: OTLP/HTTP traces endpoint; empty derives the sibling
+    #: ``/v1/traces`` of the configured logs endpoint.
+    trace_endpoint: str = ""
+    #: Probability of keeping a fast, error-free cycle (tail sampling
+    #: always keeps slow/error cycles).
+    sample_rate: float = 0.05
+    #: Cycle-duration budget (the p99 target): cycles at or past it are
+    #: always sampled.
+    slow_cycle_ms: float = 250.0
+    #: Measured tracer-overhead budget as percent of cycle time; a
+    #: sustained breach degrades tracing to metrics-only.
+    max_overhead_pct: float = 5.0
+    #: Incident provenance JSONL path (``sloctl explain`` reads it);
+    #: empty falls back to ``<runtime.state_dir>/provenance.jsonl``.
+    provenance_path: str = ""
+
+
+@dataclass
 class RuntimeConfig:
     """Crash-safe runtime knobs (``tpuslo.runtime``).
 
@@ -157,6 +185,9 @@ class ToolkitConfig:
     cdgate: CDGateConfig = field(default_factory=CDGateConfig)
     delivery: DeliveryConfig = field(default_factory=DeliveryConfig)
     ingest: IngestConfig = field(default_factory=IngestConfig)
+    observability: ObservabilityConfig = field(
+        default_factory=ObservabilityConfig
+    )
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
     tpu: TPUConfig = field(default_factory=TPUConfig)
 
@@ -214,6 +245,14 @@ class ToolkitConfig:
                 "quarantine_dir": self.ingest.quarantine_dir,
                 "quarantine_max_bytes": self.ingest.quarantine_max_bytes,
                 "quarantine_max_age_s": self.ingest.quarantine_max_age_s,
+            },
+            "observability": {
+                "enabled": self.observability.enabled,
+                "trace_endpoint": self.observability.trace_endpoint,
+                "sample_rate": self.observability.sample_rate,
+                "slow_cycle_ms": self.observability.slow_cycle_ms,
+                "max_overhead_pct": self.observability.max_overhead_pct,
+                "provenance_path": self.observability.provenance_path,
             },
             "runtime": {
                 "state_dir": self.runtime.state_dir,
@@ -337,6 +376,22 @@ def load_config(path: str) -> ToolkitConfig:
                 "quarantine_dir": str,
                 "quarantine_max_bytes": int,
                 "quarantine_max_age_s": float,
+            },
+        )
+    if "observability" in raw:
+        # Presence of the section turns self-tracing on (the operator
+        # described it); an explicit ``enabled: false`` still wins.
+        cfg.observability.enabled = True
+        _merge_section(
+            cfg.observability,
+            raw.get("observability") or {},
+            {
+                "enabled": bool,
+                "trace_endpoint": str,
+                "sample_rate": float,
+                "slow_cycle_ms": float,
+                "max_overhead_pct": float,
+                "provenance_path": str,
             },
         )
     _merge_section(

@@ -1,9 +1,14 @@
-from tpuslo.metrics.registry import AgentMetrics, start_metrics_server
+from tpuslo.metrics.registry import (
+    AgentMetrics,
+    Readiness,
+    start_metrics_server,
+)
 from tpuslo.metrics.rejections import REJECTION_COUNTERS, RejectionCounters
 from tpuslo.schema.fastpath import VALIDATION_COUNTERS, ValidationCounters
 
 __all__ = [
     "AgentMetrics",
+    "Readiness",
     "start_metrics_server",
     "REJECTION_COUNTERS",
     "RejectionCounters",

@@ -258,6 +258,40 @@ class AgentMetrics:
             "Wall time of the last graceful drain sequence",
             registry=self.registry,
         )
+        # ---- self-observability series (tpuslo.obs) ------------------
+        self.cycle_stage_ms = Histogram(
+            "llm_slo_agent_cycle_stage_ms",
+            "Per-stage latency of the agent's own pipeline cycle "
+            "(generate/ingest_gate/validate/correlate/attribute/"
+            "deliver/snapshot); exemplars carry the cycle trace_id",
+            ["stage"],
+            buckets=(0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250),
+            registry=self.registry,
+        )
+        self.cycle_ms = Histogram(
+            "llm_slo_agent_cycle_ms",
+            "End-to-end latency of one agent emit cycle",
+            buckets=(0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000),
+            registry=self.registry,
+        )
+        self.trace_cycles = Counter(
+            "llm_slo_agent_trace_cycles_total",
+            "Self-traced cycles by tail-sampling verdict "
+            "(kept_slow/kept_error/kept_probabilistic/dropped)",
+            ["verdict"],
+            registry=self.registry,
+        )
+        self.trace_spans_exported = Counter(
+            "llm_slo_agent_trace_spans_exported_total",
+            "Self-tracing spans handed to the export path",
+            registry=self.registry,
+        )
+        self.trace_overhead_pct = Gauge(
+            "llm_slo_agent_trace_overhead_pct",
+            "Measured self-tracing overhead as percent of cycle time "
+            "(EMA; the tracer degrades to metrics-only past its budget)",
+            registry=self.registry,
+        )
 
     def set_enabled_signals(self, enabled: list[str]) -> None:
         enabled_set = set(enabled)
@@ -277,8 +311,59 @@ class AgentMetrics:
         if signal in TPU_SIGNALS:
             self.tpu_events.inc()
 
-    def mark_cycle(self) -> None:
+    def mark_cycle(self, duration_ms: float | None = None) -> None:
+        """Heartbeat plus (when known) the cycle-duration observation —
+        the stats line and dashboards read the same histogram, so the
+        two can no longer drift apart."""
         self.heartbeat.set(time.time())
+        if duration_ms is not None:
+            self.cycle_ms.observe(duration_ms)
+
+    def stage_quantiles(
+        self, quantiles: tuple[float, ...] = (0.5, 0.99)
+    ) -> dict[str, dict[str, float]]:
+        """Per-stage latency quantiles estimated from the
+        ``cycle_stage_ms`` histogram buckets (linear interpolation —
+        the same estimate PromQL's histogram_quantile produces).
+
+        Returns ``{stage: {"p50": ..., "p99": ..., "count": ...}}`` for
+        stages with at least one observation.
+        """
+        # stage -> sorted [(le, cumulative_count)]
+        buckets: dict[str, list[tuple[float, float]]] = {}
+        for metric in self.cycle_stage_ms.collect():
+            for sample in metric.samples:
+                if not sample.name.endswith("_bucket"):
+                    continue
+                stage = sample.labels.get("stage", "")
+                le = float(sample.labels.get("le", "inf").replace("+Inf", "inf"))
+                buckets.setdefault(stage, []).append((le, sample.value))
+        out: dict[str, dict[str, float]] = {}
+        for stage, rows in buckets.items():
+            rows.sort(key=lambda r: r[0])
+            total = rows[-1][1] if rows else 0.0
+            if total <= 0:
+                continue
+            est = {"count": total}
+            for q in quantiles:
+                rank = q * total
+                lo_bound, lo_count = 0.0, 0.0
+                value = rows[-1][0]
+                for le, cum in rows:
+                    if cum >= rank:
+                        if le == float("inf"):
+                            value = lo_bound
+                        elif cum == lo_count:
+                            value = le
+                        else:
+                            value = lo_bound + (le - lo_bound) * (
+                                (rank - lo_count) / (cum - lo_count)
+                            )
+                        break
+                    lo_bound, lo_count = le, cum
+                est[f"p{int(q * 100)}"] = value
+            out[stage] = est
+        return out
 
     def delivery_observer(self, sink: str) -> "_PromDeliveryObserver":
         """Observer adapter wiring one DeliveryChannel to this registry
@@ -294,6 +379,11 @@ class AgentMetrics:
         """Observer adapter wiring the crash-safe runtime to this
         registry (duck-typed against tpuslo.runtime.RuntimeObserver)."""
         return _PromRuntimeObserver(self)
+
+    def trace_observer(self) -> "_PromTraceObserver":
+        """Observer adapter wiring a SelfTracer to this registry
+        (duck-typed against tpuslo.obs.TraceObserver)."""
+        return _PromTraceObserver(self)
 
 
 _BREAKER_STATE_VALUES = {"closed": 0, "half_open": 1, "open": 2}
@@ -403,10 +493,119 @@ class _PromRuntimeObserver:
         self._m.runtime_drain_duration_seconds.set(duration_s)
 
 
+class _PromTraceObserver:
+    """Bridge from self-tracer callbacks to Prometheus.
+
+    One batched callback per cycle: histogram children are cached (a
+    ``labels()`` lookup costs microseconds) and exemplars — which cost
+    another few microseconds per observation — are attached only for
+    cycles the tail sampler kept, i.e. exactly the ones whose trace_id
+    actually resolves to an exported trace.  Dropped cycles still feed
+    every histogram, so p50/p99 stay unbiased at any sample rate.
+    """
+
+    def __init__(self, metrics: AgentMetrics):
+        self._m = metrics
+        self._children: dict[str, object] = {}
+        metrics.trace_overhead_pct.set(0)
+
+    @staticmethod
+    def _observe(histogram, ms: float, trace_id: str) -> None:
+        try:
+            histogram.observe(ms, exemplar={"trace_id": trace_id})
+        except (TypeError, ValueError):
+            # Exemplar-less prometheus_client, or an exemplar the
+            # client rejects: the observation must still land.
+            histogram.observe(ms)
+
+    def _stage_child(self, stage: str):
+        child = self._children.get(stage)
+        if child is None:
+            child = self._m.cycle_stage_ms.labels(stage=stage)
+            self._children[stage] = child
+        return child
+
+    def cycle_complete(
+        self, root, stage_spans, verdict: str, observe_stages: bool = True
+    ) -> None:
+        kept = verdict != "dropped"
+        trace_id = root.trace_id
+        if observe_stages:
+            for span in stage_spans:
+                child = self._stage_child(span.name)
+                if kept:
+                    self._observe(child, span.duration_ms, trace_id)
+                else:
+                    child.observe(span.duration_ms)
+            if kept:
+                self._observe(
+                    self._m.cycle_ms, root.duration_ms, trace_id
+                )
+            else:
+                self._m.cycle_ms.observe(root.duration_ms)
+        counter = self._children.get(verdict)
+        if counter is None:
+            counter = self._m.trace_cycles.labels(verdict=verdict)
+            self._children[verdict] = counter
+        counter.inc()
+
+    def spans_exported(self, count: int) -> None:
+        # Fired by the tracer only when a batch actually reached the
+        # export callback: a kept-but-exporterless cycle must not show
+        # a healthy span-export rate on the dashboard.
+        self._m.trace_spans_exported.inc(count)
+
+    def overhead_pct(self, pct: float) -> None:
+        self._m.trace_overhead_pct.set(pct)
+
+
+class Readiness:
+    """Aggregated readiness for ``/readyz``: every registered check must
+    pass, and failures explain themselves in the response body.
+
+    Checks are callables returning ``(ok, detail)``; a check that
+    raises counts as not-ready with the exception as the detail (a
+    broken check must fail loud, not report ready).
+    """
+
+    def __init__(self):
+        self._checks: list[tuple[str, object]] = []
+        self._lock = threading.Lock()
+
+    def add_check(self, name: str, fn) -> None:
+        with self._lock:
+            self._checks.append((name, fn))
+
+    def evaluate(self) -> tuple[bool, str]:
+        reasons = []
+        with self._lock:
+            checks = list(self._checks)
+        for name, fn in checks:
+            try:
+                ok, detail = fn()
+            except Exception as exc:  # noqa: BLE001 — see class docstring
+                ok, detail = False, f"check raised {exc!r}"
+            if not ok:
+                reasons.append(f"{name}: {detail}")
+        if reasons:
+            return False, "; ".join(reasons)
+        return True, "ok"
+
+
 def start_metrics_server(
-    metrics: AgentMetrics, port: int, host: str = "0.0.0.0"
+    metrics: AgentMetrics,
+    port: int,
+    host: str = "0.0.0.0",
+    readiness: Readiness | None = None,
 ) -> ThreadingHTTPServer:
-    """Serve /metrics, /healthz, /readyz on a daemon thread."""
+    """Serve /metrics, /healthz, /readyz on a daemon thread.
+
+    ``/healthz`` is liveness: 200 while the process serves requests.
+    ``/readyz`` is readiness: with a :class:`Readiness` wired in it
+    returns 503 + the failing reasons (drain in progress, all breakers
+    open, stale snapshot) instead of the unconditional 200 a load
+    balancer would happily route traffic at.
+    """
 
     registry = metrics.registry
 
@@ -418,13 +617,25 @@ def start_metrics_server(
                 self.send_header("Content-Type", CONTENT_TYPE_LATEST)
                 self.end_headers()
                 self.wfile.write(body)
-            elif self.path in ("/healthz", "/readyz"):
-                self.send_response(200)
-                self.end_headers()
-                self.wfile.write(b"ok\n")
+            elif self.path == "/healthz":
+                self._plain(200, "ok\n")
+            elif self.path == "/readyz":
+                if readiness is None:
+                    self._plain(200, "ok\n")
+                    return
+                ready, reason = readiness.evaluate()
+                self._plain(200 if ready else 503, reason + "\n")
             else:
                 self.send_response(404)
                 self.end_headers()
+
+        def _plain(self, code: int, body: str) -> None:
+            payload = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
 
         def log_message(self, *args):
             pass

@@ -1,0 +1,64 @@
+"""Self-observability: the pipeline tracing its own stages.
+
+``tracer``      — cycle/stage spans, tail-based sampling, overhead gate.
+``export``      — hand-rolled OTLP/HTTP traces exporter (DeliveryChannel
+                  compatible via ``post_records``).
+``provenance``  — incident → evidence causal-chain log for
+                  ``sloctl explain``.
+"""
+
+from tpuslo.obs.export import (
+    BackgroundSpanPoster,
+    SpanExporter,
+    span_to_record,
+    trace_endpoint_from_logs,
+)
+from tpuslo.obs.provenance import (
+    EvidenceEvent,
+    ProvenanceLog,
+    ProvenanceRecord,
+    format_chain,
+    load_records,
+    probe_event_id,
+)
+from tpuslo.obs.tracer import (
+    CYCLE_STAGES,
+    DROPPED,
+    KEPT_ERROR,
+    KEPT_FORCED,
+    KEPT_PROBABILISTIC,
+    KEPT_SLOW,
+    CycleTrace,
+    SelfTracer,
+    Span,
+    TraceObserver,
+    TracerConfig,
+    new_span_id,
+    new_trace_id,
+)
+
+__all__ = [
+    "BackgroundSpanPoster",
+    "CYCLE_STAGES",
+    "DROPPED",
+    "KEPT_ERROR",
+    "KEPT_FORCED",
+    "KEPT_PROBABILISTIC",
+    "KEPT_SLOW",
+    "CycleTrace",
+    "EvidenceEvent",
+    "ProvenanceLog",
+    "ProvenanceRecord",
+    "SelfTracer",
+    "Span",
+    "SpanExporter",
+    "TraceObserver",
+    "TracerConfig",
+    "format_chain",
+    "load_records",
+    "new_span_id",
+    "new_trace_id",
+    "probe_event_id",
+    "span_to_record",
+    "trace_endpoint_from_logs",
+]

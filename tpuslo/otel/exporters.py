@@ -63,12 +63,10 @@ class _BaseExporter:
         self.scope_name = scope_name
         self.timeout_s = timeout_s if timeout_s > 0 else DEFAULT_TIMEOUT_S
 
-    def _post(self, records: list[dict]) -> None:
-        if not records:
-            return
-        if not self.endpoint:
-            raise ExportError("otlp endpoint is required", retryable=False)
-        payload = {
+    def _envelope(self, records: list[dict]) -> dict:
+        """OTLP envelope around pre-built records; subclasses that ship
+        a different signal (traces) override only this."""
+        return {
             "resourceLogs": [
                 {
                     "resource": {
@@ -83,7 +81,13 @@ class _BaseExporter:
                 }
             ]
         }
-        body = json.dumps(payload).encode()
+
+    def _post(self, records: list[dict]) -> None:
+        if not records:
+            return
+        if not self.endpoint:
+            raise ExportError("otlp endpoint is required", retryable=False)
+        body = json.dumps(self._envelope(records)).encode()
         req = urllib.request.Request(
             self.endpoint,
             data=body,

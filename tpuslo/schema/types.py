@@ -147,6 +147,10 @@ class IncidentAttribution:
     trace_ids: list[str] = field(default_factory=list)
     request_ids: list[str] = field(default_factory=list)
     fault_hypotheses: list[FaultHypothesis] = field(default_factory=list)
+    #: Self-observability pointer: the producing cycle's trace/span ids
+    #: and supporting probe-event ids (full chain in the provenance log,
+    #: rendered by ``sloctl explain``).
+    provenance: dict[str, Any] | None = None
 
     def to_dict(self) -> dict[str, Any]:
         out: dict[str, Any] = {
@@ -168,6 +172,8 @@ class IncidentAttribution:
             out["request_ids"] = list(self.request_ids)
         if self.fault_hypotheses:
             out["fault_hypotheses"] = [h.to_dict() for h in self.fault_hypotheses]
+        if self.provenance:
+            out["provenance"] = dict(self.provenance)
         return out
 
 
