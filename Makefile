@@ -5,7 +5,8 @@
 PY ?= python
 ARTIFACTS ?= artifacts
 
-.PHONY: all test test-fast native ebpf lint schema-validate \
+.PHONY: all test test-fast native ebpf lint lint-changed \
+	racecheck-smoke schema-validate \
 	correlation-gate fault-smoke replay-smoke ebpf-smoke bench \
 	bench-smoke chaos-smoke chaos-demo chaos-telemetry-smoke \
 	chaos-telemetry-sweep crash-smoke crash-sweep obs-smoke \
@@ -58,9 +59,26 @@ test-timed: native
 test-fast: native
 	$(PY) -m pytest tests/ -q -x -m "not slow"
 
+# tpulint v2 (tpuslo/analysis/): contract-aware semantic rules (schema/
+# config/metrics drift, lock discipline, hot-path purity, exception
+# accounting) + the TPL00x style tier.  Zero-delta against the committed
+# .tpulint-baseline.json; see docs/static-analysis.md.
 lint:
 	$(PY) -m compileall -q tpuslo demo tests tools bench.py __graft_entry__.py
-	$(PY) tools/lint.py
+	$(PY) -m tpuslo.analysis
+
+# Fast pre-commit loop: file-level rules scoped to git-changed .py files
+# (repo-contract rules still run — they are cross-file by nature).
+lint-changed:
+	$(PY) -m tpuslo.analysis --changed
+
+# Dynamic lock-order race detector over the threaded suites (delivery /
+# runtime / obs) plus its own seeded AB/BA inversion test.  The conftest
+# wraps threading.Lock/RLock when TPUSLO_RACECHECK=1 and fails the
+# session on any cross-thread order inversion or sleep-under-lock.
+# (Suite list: tpuslo/analysis/racecheck.py SMOKE_SUITES.)
+racecheck-smoke:
+	TPUSLO_RACECHECK=1 $(PY) -m tpuslo m5gate --racecheck-smoke
 
 # ---- gates (mirror the reference CI steps) ----------------------------
 
@@ -189,7 +207,9 @@ m5-candidate:
 	done
 	@echo "m5-candidate: artifacts under $(ARTIFACTS)/m5"
 
-m5-gate:
+# Release candidates fail on new lint findings or lock-order races
+# before the statistical gates even run (ISSUE 6).
+m5-gate: lint racecheck-smoke
 	$(PY) -m tpuslo m5gate --candidate-root $(ARTIFACTS)/m5 \
 		--scenarios "$(shell echo $(M5_SCENARIOS) | tr ' ' ',')" \
 		--summary-json $(ARTIFACTS)/m5/gate.json \

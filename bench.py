@@ -160,6 +160,37 @@ def bench_agent_overhead() -> dict:
     }
 
 
+def bench_analyzer() -> dict:
+    """tpulint v2 full-repo run: must finish < 30 s on the 1-CPU box.
+
+    The lint gate (``make lint``, also a ``make m5-gate`` prerequisite)
+    is only tenable as a mandatory step while it stays cheap; this
+    bench measures the real cost and hard-fails past the budget so a
+    slow rule gets caught by the bench rather than by everyone's
+    pre-commit loop.  Parses once per file and shares the tree across
+    rules, so the wall time tracks repo size, not rule count.
+    """
+    from pathlib import Path
+
+    from tpuslo.analysis import run_analysis
+
+    t0 = time.perf_counter()
+    result = run_analysis(Path(__file__).resolve().parent)
+    wall_s = time.perf_counter() - t0
+    out = {
+        "analyzer_wall_s": round(wall_s, 2),
+        "analyzer_files": result.files_scanned,
+        "analyzer_findings": len(result.findings),
+        "meets_30s_lint_gate": wall_s < 30.0,
+    }
+    if not out["meets_30s_lint_gate"]:
+        raise SystemExit(
+            f"bench_analyzer: full lint run took {wall_s:.1f}s "
+            "(>= 30s budget) — profile the rules before shipping"
+        )
+    return out
+
+
 def bench_tracer_overhead(
     cycles: int = 200, passes: int = 4, repeats: int = 3
 ) -> dict:
@@ -969,6 +1000,8 @@ def main() -> int:
     overhead_result = bench_agent_overhead()
     # Self-tracing regression gate (ISSUE 5): <5% of cycle throughput.
     overhead_result.update(bench_tracer_overhead())
+    # Static-analysis cost gate (ISSUE 6): full tpulint run < 30 s.
+    overhead_result.update(bench_analyzer())
     pipeline_result = bench_pipeline()
     serving_result = bench_serving()
 

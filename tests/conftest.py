@@ -24,3 +24,32 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# --- dynamic lock-order race checking (make racecheck-smoke) -------------
+# TPUSLO_RACECHECK=1 wraps threading.Lock/RLock in order-tracking proxies
+# (tpuslo/analysis/racecheck.py); the session fails if any cross-thread
+# acquisition-order inversion or lock-held-across-sleep was recorded.
+# Installed after the jax import so third-party import-time lock usage
+# stays untracked — the toolkit's locks are created per-instance inside
+# tests and are tracked either way.
+_RACECHECK = os.environ.get("TPUSLO_RACECHECK", "") == "1"
+if _RACECHECK:
+    from tpuslo.analysis import racecheck as _racecheck
+
+    _racecheck.install()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _racecheck_gate():
+    """Fail the session on recorded lock-order violations."""
+    yield
+    if _RACECHECK:
+        reg = _racecheck.registry()
+        if reg.violations:
+            pytest.fail(
+                f"racecheck recorded {len(reg.violations)} violation(s):\n"
+                + reg.report(),
+                pytrace=False,
+            )

@@ -1,0 +1,309 @@
+"""tpulint v2 framework: suppressions, baseline, CLI, self-hosting.
+
+Rule-specific fixtures live in tests/test_analysis_rules.py; this file
+covers the machinery every rule rides on.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+from tpuslo.analysis import (
+    Baseline,
+    Finding,
+    run_analysis,
+)
+from tpuslo.analysis.__main__ import main as lint_main
+from tpuslo.analysis.rules_style import StyleRules
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _write(tmp_path: Path, rel: str, source: str) -> Path:
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(source, encoding="utf-8")
+    return path
+
+
+class TestSuppression:
+    def test_inline_disable_suppresses_only_that_code(self, tmp_path):
+        _write(
+            tmp_path,
+            "pkg/mod.py",
+            "import os  # tpulint: disable=TPL001\n"
+            "import sys\n",
+        )
+        result = run_analysis(tmp_path, paths=["pkg"], rules=[StyleRules()])
+        assert [f.code for f in result.findings] == ["TPL001"]
+        assert "sys" in result.findings[0].message
+        assert result.suppressed == 1
+
+    def test_disable_on_preceding_line(self, tmp_path):
+        _write(
+            tmp_path,
+            "pkg/mod.py",
+            "def f(x):\n"
+            "    # tpulint: disable=TPL006\n"
+            "    return x == None\n",
+        )
+        result = run_analysis(tmp_path, paths=["pkg"], rules=[StyleRules()])
+        assert result.findings == []
+        assert result.suppressed == 1
+
+    def test_file_level_disable(self, tmp_path):
+        _write(
+            tmp_path,
+            "pkg/mod.py",
+            "# tpulint: disable-file=TPL001\n"
+            "import os\n"
+            "import sys\n",
+        )
+        result = run_analysis(tmp_path, paths=["pkg"], rules=[StyleRules()])
+        assert result.findings == []
+        assert result.suppressed == 2
+
+    def test_unrelated_code_not_suppressed(self, tmp_path):
+        _write(
+            tmp_path,
+            "pkg/mod.py",
+            "import os  # tpulint: disable=TPL999\n",
+        )
+        result = run_analysis(tmp_path, paths=["pkg"], rules=[StyleRules()])
+        assert [f.code for f in result.findings] == ["TPL001"]
+
+
+class TestBaseline:
+    def test_round_trip_zero_delta(self, tmp_path):
+        """write-baseline then re-run: everything baselined, exit 0."""
+        _write(tmp_path, "pkg/mod.py", "import os\nx = 1 == None\n")
+        result = run_analysis(tmp_path, paths=["pkg"], rules=[StyleRules()])
+        assert len(result.findings) == 2
+
+        baseline_path = tmp_path / "baseline.json"
+        Baseline.from_findings(result.findings).save(baseline_path)
+        loaded = Baseline.load(baseline_path)
+        new, baselined, stale = loaded.split(result.findings)
+        assert new == []
+        assert len(baselined) == 2
+        assert stale == []
+        # Every generated entry demands a justification.
+        raw = json.loads(baseline_path.read_text())
+        assert all(e["reason"] for e in raw["entries"])
+
+    def test_new_finding_escapes_baseline(self, tmp_path):
+        _write(tmp_path, "pkg/mod.py", "import os\n")
+        first = run_analysis(tmp_path, paths=["pkg"], rules=[StyleRules()])
+        baseline = Baseline.from_findings(first.findings)
+
+        _write(tmp_path, "pkg/mod.py", "import os\nimport sys\n")
+        second = run_analysis(tmp_path, paths=["pkg"], rules=[StyleRules()])
+        new, baselined, stale = baseline.split(second.findings)
+        assert [f.message for f in new] == ["unused import 'sys'"]
+        assert len(baselined) == 1
+
+    def test_stale_entries_reported(self, tmp_path):
+        baseline = Baseline(
+            entries=[
+                {
+                    "path": "pkg/gone.py",
+                    "code": "TPL001",
+                    "message": "unused import 'os'",
+                    "reason": "historical",
+                }
+            ]
+        )
+        new, baselined, stale = baseline.split([])
+        assert new == [] and baselined == []
+        assert len(stale) == 1
+
+    def test_line_shift_does_not_invalidate_baseline(self, tmp_path):
+        _write(tmp_path, "pkg/mod.py", "import os\n")
+        first = run_analysis(tmp_path, paths=["pkg"], rules=[StyleRules()])
+        baseline = Baseline.from_findings(first.findings)
+        # Same finding, three lines lower.
+        _write(tmp_path, "pkg/mod.py", "'''doc'''\n\n\nimport os\n")
+        second = run_analysis(tmp_path, paths=["pkg"], rules=[StyleRules()])
+        new, baselined, _ = baseline.split(second.findings)
+        assert new == []
+        assert len(baselined) == 1
+
+
+class TestCLI:
+    def test_clean_tree_exits_zero(self, tmp_path, capsys):
+        _write(tmp_path, "pkg/mod.py", "import os\n\nprint(os.name)\n")
+        rc = lint_main(["--root", str(tmp_path), "pkg", "--no-baseline"])
+        assert rc == 0
+
+    def test_findings_exit_one_with_human_output(self, tmp_path, capsys):
+        _write(tmp_path, "pkg/mod.py", "import os\n")
+        rc = lint_main(["--root", str(tmp_path), "pkg", "--no-baseline"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "pkg/mod.py:1: TPL001" in out
+
+    def test_json_output(self, tmp_path, capsys):
+        _write(tmp_path, "pkg/mod.py", "import os\n")
+        rc = lint_main(
+            ["--root", str(tmp_path), "pkg", "--no-baseline", "--json"]
+        )
+        assert rc == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["findings"][0]["code"] == "TPL001"
+        assert payload["files_scanned"] == 1
+
+    def test_write_baseline_then_gate_is_clean(self, tmp_path, capsys):
+        _write(tmp_path, "pkg/mod.py", "import os\n")
+        baseline = tmp_path / "bl.json"
+        assert (
+            lint_main(
+                [
+                    "--root", str(tmp_path), "pkg",
+                    "--baseline", str(baseline), "--write-baseline",
+                ]
+            )
+            == 0
+        )
+        assert (
+            lint_main(
+                ["--root", str(tmp_path), "pkg", "--baseline", str(baseline)]
+            )
+            == 0
+        )
+
+    def test_zero_files_scanned_fails_closed(self, tmp_path, capsys):
+        """Running from a wrong root must not report a green gate."""
+        rc = lint_main(["--root", str(tmp_path), "nonexistent-dir"])
+        assert rc == 2
+        assert "refusing" in capsys.readouterr().err
+
+    def test_write_baseline_preserves_reasons(self, tmp_path, capsys):
+        _write(tmp_path, "pkg/mod.py", "import os\n")
+        baseline = tmp_path / "bl.json"
+        lint_main(
+            [
+                "--root", str(tmp_path), "pkg",
+                "--baseline", str(baseline), "--write-baseline",
+            ]
+        )
+        raw = json.loads(baseline.read_text())
+        raw["entries"][0]["reason"] = "vendored shim, import is the API"
+        baseline.write_text(json.dumps(raw))
+        lint_main(
+            [
+                "--root", str(tmp_path), "pkg",
+                "--baseline", str(baseline), "--write-baseline",
+            ]
+        )
+        raw = json.loads(baseline.read_text())
+        assert raw["entries"][0]["reason"] == (
+            "vendored shim, import is the API"
+        )
+
+    def test_list_rules_covers_semantic_families(self, capsys):
+        assert lint_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for code in (
+            "TPL001", "TPL101", "TPL102", "TPL110", "TPL111",
+            "TPL120", "TPL121", "TPL130", "TPL140", "TPL150",
+        ):
+            assert code in out
+
+    def test_syntax_error_is_tpl000(self, tmp_path, capsys):
+        _write(tmp_path, "pkg/mod.py", "def broken(:\n")
+        rc = lint_main(["--root", str(tmp_path), "pkg", "--no-baseline"])
+        assert rc == 1
+        assert "TPL000" in capsys.readouterr().out
+
+
+class TestScopedRuns:
+    """Git-scoped runs (--changed) must still run repo-contract rules
+    over their anchor files — a schema edit with no .py change in the
+    diff cannot sneak past `make lint-changed`."""
+
+    def test_contract_rules_run_with_scoped_file_set(self, tmp_path):
+        from tpuslo.analysis.rules_contracts import SchemaDriftRule
+
+        # Mirror the repo layout in tmp: contracts + a drifted types.py
+        # (one ProbeEventV1 field removed), but scope the run to an
+        # UNRELATED changed file.
+        contracts_src = REPO / "tpuslo" / "schema" / "contracts"
+        contracts_dst = tmp_path / "tpuslo" / "schema" / "contracts"
+        import shutil
+
+        shutil.copytree(contracts_src, contracts_dst)
+        types_src = (REPO / "tpuslo" / "schema" / "types.py").read_text(
+            encoding="utf-8"
+        )
+        _write(
+            tmp_path,
+            "tpuslo/schema/types.py",
+            types_src.replace("    ts_unix_nano: int\n", "", 1),
+        )
+        unrelated = _write(tmp_path, "tpuslo/other.py", "X = 1\n")
+
+        result = run_analysis(
+            tmp_path, files=[unrelated], rules=[SchemaDriftRule()]
+        )
+        assert any(
+            f.code == "TPL101" and "ts_unix_nano" in f.message
+            for f in result.findings
+        ), result.findings
+
+    def test_anchor_file_suppressions_honored_in_scoped_run(self, tmp_path):
+        from tpuslo.analysis.rules_contracts import MetricsDriftRule
+
+        _write(
+            tmp_path,
+            "tpuslo/metrics/registry.py",
+            '# tpulint: disable-file=TPL150\n'
+            'NAME = "llm_slo_agent_never_documented_total"\n',
+        )
+        unrelated = _write(tmp_path, "tpuslo/other.py", "X = 1\n")
+        result = run_analysis(
+            tmp_path, files=[unrelated], rules=[MetricsDriftRule()]
+        )
+        assert result.findings == []
+        assert result.suppressed == 1
+
+    def test_missing_manifest_file_is_a_finding(self, tmp_path):
+        """A deleted/renamed hot-path module must surface as a finding,
+        not silently drop the protection.  (The manifest marker makes
+        tmp_path count as the governed repo.)"""
+        from tpuslo.analysis.rules_hotpath import HotPathPurityRule
+
+        _write(tmp_path, "tpuslo/analysis/hotpaths.py", "# manifest\n")
+        result = run_analysis(
+            tmp_path,
+            files=[_write(tmp_path, "tpuslo/other.py", "X = 1\n")],
+            rules=[HotPathPurityRule()],
+        )
+        assert any(
+            f.code == "TPL120" and "missing or unparseable" in f.message
+            for f in result.findings
+        ), result.findings
+
+
+class TestSelfHost:
+    def test_repo_is_clean_against_committed_baseline(self):
+        """`make lint` parity: the committed tree has zero non-baselined
+        findings — the analyzer gates the repo that contains it."""
+        result = run_analysis(REPO)
+        baseline = Baseline.load(REPO / ".tpulint-baseline.json")
+        new, _, stale = baseline.split(result.findings)
+        assert new == [], "\n".join(f.render() for f in new)
+        assert stale == [], f"stale baseline entries: {stale}"
+
+    def test_full_run_under_bench_budget(self):
+        """The bench.py gate (< 30 s) with slack for a loaded CI box —
+        the lint gate only stays mandatory while it stays cheap."""
+        t0 = time.perf_counter()
+        run_analysis(REPO)
+        assert time.perf_counter() - t0 < 30.0
+
+    def test_finding_render_and_fingerprint(self):
+        f = Finding("a/b.py", 3, "TPL001", "unused import 'os'")
+        assert f.render() == "a/b.py:3: TPL001 unused import 'os'"
+        assert f.fingerprint() == ("a/b.py", "TPL001", "unused import 'os'")

@@ -1,0 +1,601 @@
+"""tpulint v2 semantic rules: positive + negative fixtures per family.
+
+Each rule family (schema drift, config drift, metrics drift, lock
+discipline, hot-path purity, exception discipline, style tier) gets at
+least one fixture that provokes the finding and one that stays clean.
+Repo-contract rules are also run against the real tree (they must be
+clean — the analyzer self-hosts) and against in-memory mutated sources
+anchored to the real contracts, so the fixtures cannot drift from the
+schemas they check.
+"""
+
+from __future__ import annotations
+
+import ast
+import textwrap
+from pathlib import Path
+
+from tpuslo.analysis import FileContext, RepoContext, run_analysis
+from tpuslo.analysis.rules_contracts import (
+    ConfigDriftRule,
+    MetricsDriftRule,
+    SchemaDriftRule,
+)
+from tpuslo.analysis.rules_except import ExceptionDisciplineRule
+from tpuslo.analysis.rules_hotpath import HotPathPurityRule
+from tpuslo.analysis.rules_locks import LockDisciplineRule
+from tpuslo.analysis.rules_style import StyleRules
+
+REPO = Path(__file__).resolve().parent.parent
+TYPES_REL = "tpuslo/schema/types.py"
+CFG_REL = "tpuslo/config/toolkitcfg.py"
+
+
+def _ctx(rel: str, source: str) -> FileContext:
+    return FileContext(REPO / rel, rel, textwrap.dedent(source))
+
+
+def _mutated_repo(rel: str, transform) -> RepoContext:
+    """RepoContext over the real repo with one file's source rewritten
+    in memory — contract JSONs stay the committed ones."""
+    source = (REPO / rel).read_text(encoding="utf-8")
+    return RepoContext(REPO, [FileContext(REPO / rel, rel, transform(source))])
+
+
+class TestStyleTier:
+    def test_codes_fire_on_fixture(self, tmp_path):
+        (tmp_path / "mod.py").write_text(
+            textwrap.dedent(
+                """
+                import os
+                def f(x=[]):
+                    return x == None
+                try:
+                    pass
+                except:
+                    pass
+                """
+            )
+        )
+        result = run_analysis(
+            tmp_path, paths=["mod.py"], rules=[StyleRules()]
+        )
+        codes = {f.code for f in result.findings}
+        assert {"TPL001", "TPL003", "TPL004", "TPL006"} <= codes
+
+    def test_clean_module(self, tmp_path):
+        (tmp_path / "mod.py").write_text(
+            "import os\n\n\ndef f(x=None):\n    return x is None or os.name\n"
+        )
+        result = run_analysis(
+            tmp_path, paths=["mod.py"], rules=[StyleRules()]
+        )
+        assert result.findings == []
+
+
+class TestSchemaDrift:
+    def test_real_tree_is_clean(self):
+        repo = RepoContext(
+            REPO,
+            [
+                FileContext(
+                    REPO / TYPES_REL,
+                    TYPES_REL,
+                    (REPO / TYPES_REL).read_text(encoding="utf-8"),
+                )
+            ],
+        )
+        assert list(SchemaDriftRule().check_repo(repo)) == []
+
+    def test_dropped_field_is_both_direction_drift(self):
+        """Deleting ProbeEventV1.ts_unix_nano must flag the orphaned
+        contract property (contract->dataclass direction)."""
+        repo = _mutated_repo(
+            TYPES_REL, lambda s: s.replace("    ts_unix_nano: int\n", "", 1)
+        )
+        findings = list(SchemaDriftRule().check_repo(repo))
+        assert any(
+            f.code == "TPL101"
+            and "'ts_unix_nano'" in f.message
+            and "ProbeEventV1" in f.message
+            for f in findings
+        )
+
+    def test_extra_field_is_dataclass_to_contract_drift(self):
+        repo = _mutated_repo(
+            TYPES_REL,
+            lambda s: s.replace(
+                "    unit: str\n",
+                "    unit: str\n    totally_new_field: str = \"\"\n",
+                1,
+            ),
+        )
+        findings = list(SchemaDriftRule().check_repo(repo))
+        assert any(
+            f.code == "TPL101" and "totally_new_field" in f.message
+            for f in findings
+        )
+
+    def test_type_mismatch_detected(self):
+        repo = _mutated_repo(
+            TYPES_REL, lambda s: s.replace("    pid: int\n", "    pid: str\n", 1)
+        )
+        findings = list(SchemaDriftRule().check_repo(repo))
+        assert any(
+            f.code == "TPL101" and "pid" in f.message and "incompatible"
+            in f.message
+            for f in findings
+        )
+
+    def test_conditional_required_emission_is_tpl102(self):
+        """Re-introduce the pre-PR slo_impact drift: required by the
+        contract, emitted only when set."""
+
+        def transform(source: str) -> str:
+            source = source.replace(
+                "    slo_impact: SLOImpact\n",
+                "    slo_impact: SLOImpact | None = None\n",
+            )
+            return source.replace(
+                '            "slo_impact": self.slo_impact.to_dict(),\n'
+                "        }\n",
+                "        }\n"
+                "        if self.slo_impact is not None:\n"
+                '            out["slo_impact"] = self.slo_impact.to_dict()\n',
+                1,
+            )
+
+        repo = _mutated_repo(TYPES_REL, transform)
+        findings = list(SchemaDriftRule().check_repo(repo))
+        assert any(
+            f.code == "TPL102" and "slo_impact" in f.message
+            for f in findings
+        )
+
+
+class TestConfigDrift:
+    def test_real_tree_is_clean(self):
+        repo = RepoContext(
+            REPO,
+            [
+                FileContext(
+                    REPO / CFG_REL,
+                    CFG_REL,
+                    (REPO / CFG_REL).read_text(encoding="utf-8"),
+                )
+            ],
+        )
+        assert list(ConfigDriftRule().check_repo(repo)) == []
+
+    def test_dropped_dataclass_field_flags_schema_key(self):
+        repo = _mutated_repo(
+            CFG_REL,
+            lambda s: s.replace("    burst_limit: int = 20000\n", "", 1),
+        )
+        findings = list(ConfigDriftRule().check_repo(repo))
+        assert any(
+            f.code == "TPL140" and "sampling.burst_limit" in f.message
+            for f in findings
+        )
+
+    def test_unvalidated_new_field_flagged(self):
+        repo = _mutated_repo(
+            CFG_REL,
+            lambda s: s.replace(
+                "    burst_limit: int = 20000\n",
+                "    burst_limit: int = 20000\n    new_knob: int = 1\n",
+                1,
+            ),
+        )
+        findings = list(ConfigDriftRule().check_repo(repo))
+        assert any(
+            f.code == "TPL140"
+            and "sampling.new_knob" in f.message
+            and "schema" in f.message
+            for f in findings
+        )
+
+    def test_key_not_read_by_loader_flagged(self):
+        repo = _mutated_repo(
+            CFG_REL,
+            lambda s: s.replace('"burst_limit": int', '"burst_limitx": int', 1),
+        )
+        findings = list(ConfigDriftRule().check_repo(repo))
+        assert any(
+            f.code == "TPL140"
+            and "sampling.burst_limit" in f.message
+            and "merge" in f.message
+            for f in findings
+        )
+
+
+class TestMetricsDrift:
+    def test_orphan_series_flagged(self, tmp_path):
+        reg = tmp_path / "tpuslo" / "metrics"
+        reg.mkdir(parents=True)
+        (reg / "registry.py").write_text(
+            'NAME = "llm_slo_agent_totally_orphaned_total"\n'
+        )
+        (tmp_path / "docs").mkdir()
+        (tmp_path / "docs" / "x.md").write_text("nothing relevant\n")
+        (tmp_path / "dashboards").mkdir()
+        (tmp_path / "dashboards" / "generate.py").write_text("panels = []\n")
+        result = run_analysis(
+            tmp_path,
+            paths=["tpuslo"],
+            rules=[MetricsDriftRule()],
+        )
+        assert [f.code for f in result.findings] == ["TPL150"]
+        assert "totally_orphaned" in result.findings[0].message
+
+    def test_referenced_series_clean(self, tmp_path):
+        reg = tmp_path / "tpuslo" / "metrics"
+        reg.mkdir(parents=True)
+        (reg / "registry.py").write_text(
+            'NAME = "llm_slo_agent_referenced_total"\n'
+        )
+        (tmp_path / "docs").mkdir()
+        (tmp_path / "docs" / "x.md").write_text(
+            "llm_slo_agent_referenced_total is charted\n"
+        )
+        result = run_analysis(
+            tmp_path, paths=["tpuslo"], rules=[MetricsDriftRule()]
+        )
+        assert result.findings == []
+
+    def test_real_tree_is_clean(self):
+        repo = RepoContext(REPO, [])
+        assert list(MetricsDriftRule().check_repo(repo)) == []
+
+
+_LOCK_FIXTURE_UNGUARDED = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+
+        def safe_inc(self):
+            with self._lock:
+                self.count += 1
+
+        def racy_inc(self):
+            self.count += 1
+"""
+
+_LOCK_FIXTURE_CLEAN = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+
+        def inc(self):
+            with self._lock:
+                self.count += 1
+
+        def _drain_locked(self):
+            self.count = 0
+
+        def read(self):
+            with self._lock:
+                return self.count
+"""
+
+_LOCK_FIXTURE_DEADLOCK = """
+    import threading
+
+    class TwoLocks:
+        def __init__(self):
+            self._la = threading.Lock()
+            self._lb = threading.Lock()
+
+        def forward(self):
+            with self._la:
+                with self._lb:
+                    pass
+
+        def backward(self):
+            with self._lb:
+                with self._la:
+                    pass
+"""
+
+_LOCK_FIXTURE_SELF_DEADLOCK = """
+    import threading
+
+    class Reentry:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def outer(self):
+            with self._lock:
+                self.inner()
+
+        def inner(self):
+            with self._lock:
+                pass
+"""
+
+_LOCK_FIXTURE_CROSS_CLASS = """
+    import threading
+
+    class Inner:
+        def __init__(self):
+            self._ilock = threading.Lock()
+            self._peer = Outer()
+
+        def poke(self):
+            with self._ilock:
+                self._peer.touch()
+
+    class Outer:
+        def __init__(self):
+            self._olock = threading.Lock()
+            self._inner = Inner()
+
+        def drive(self):
+            with self._olock:
+                self._inner.poke()
+
+        def touch(self):
+            with self._olock:
+                pass
+"""
+
+
+def _lock_findings(source: str) -> list:
+    ctx = _ctx("tpuslo/fixture_mod.py", source)
+    return list(LockDisciplineRule().check_repo(RepoContext(REPO, [ctx])))
+
+
+class TestLockDiscipline:
+    def test_unguarded_write_flagged(self):
+        findings = _lock_findings(_LOCK_FIXTURE_UNGUARDED)
+        assert len(findings) == 1
+        f = findings[0]
+        assert f.code == "TPL110"
+        assert "Counter.count" in f.message
+
+    def test_guarded_and_locked_convention_clean(self):
+        assert _lock_findings(_LOCK_FIXTURE_CLEAN) == []
+
+    def test_init_writes_exempt(self):
+        # The clean fixture writes count in __init__ without the lock.
+        assert _lock_findings(_LOCK_FIXTURE_CLEAN) == []
+
+    def test_synthetic_ab_ba_cycle_flagged(self):
+        findings = _lock_findings(_LOCK_FIXTURE_DEADLOCK)
+        cycles = [f for f in findings if f.code == "TPL111"]
+        assert cycles, findings
+        assert "TwoLocks._la" in cycles[0].message
+        assert "TwoLocks._lb" in cycles[0].message
+
+    def test_self_reacquire_through_call_is_deadlock(self):
+        findings = _lock_findings(_LOCK_FIXTURE_SELF_DEADLOCK)
+        assert any(
+            f.code == "TPL111" and "re-acquired" in f.message
+            for f in findings
+        )
+
+    def test_cross_class_cycle_flagged(self):
+        findings = _lock_findings(_LOCK_FIXTURE_CROSS_CLASS)
+        assert any(
+            f.code == "TPL111"
+            and "Outer._olock" in f.message
+            and "Inner._ilock" in f.message
+            for f in findings
+        ), findings
+
+    def test_out_of_scope_paths_ignored(self):
+        ctx = _ctx("tests/fixture_mod.py", _LOCK_FIXTURE_UNGUARDED)
+        rule = LockDisciplineRule()
+        assert list(rule.check_repo(RepoContext(REPO, [ctx]))) == []
+
+    def test_real_tree_is_clean(self):
+        files = [
+            FileContext(p, p.relative_to(REPO).as_posix(),
+                        p.read_text(encoding="utf-8"))
+            for p in sorted((REPO / "tpuslo").rglob("*.py"))
+        ]
+        findings = list(
+            LockDisciplineRule().check_repo(RepoContext(REPO, files))
+        )
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+
+class TestHotPathPurity:
+    def test_real_manifest_is_clean(self):
+        files = [
+            FileContext(p, p.relative_to(REPO).as_posix(),
+                        p.read_text(encoding="utf-8"))
+            for p in sorted((REPO / "tpuslo").rglob("*.py"))
+        ]
+        findings = list(
+            HotPathPurityRule().check_repo(RepoContext(REPO, files))
+        )
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_forbidden_call_in_hot_function_flagged(self):
+        rel = "tpuslo/correlation/matcher.py"
+        source = (
+            "import json\n"
+            "def match_batch(spans, signals, window_ms=0):\n"
+            "    json.dumps(spans)\n"
+            "    return []\n"
+        )
+        repo = RepoContext(REPO, [FileContext(REPO / rel, rel, source)])
+        findings = [
+            f
+            for f in HotPathPurityRule().check_repo(repo)
+            if f.code == "TPL120" and f.path == rel
+        ]
+        assert any("json.dumps" in f.message for f in findings)
+
+    def test_renamed_manifest_entry_flagged(self):
+        rel = "tpuslo/correlation/matcher.py"
+        source = "def renamed():\n    pass\n"
+        repo = RepoContext(REPO, [FileContext(REPO / rel, rel, source)])
+        findings = list(HotPathPurityRule().check_repo(repo))
+        assert any(
+            "match_batch" in f.message and "manifest" in f.message
+            for f in findings
+        )
+
+    def test_unslotted_hot_dataclass_flagged(self):
+        rel = "tpuslo/obs/tracer.py"
+        source = (
+            "from dataclasses import dataclass\n"
+            "@dataclass\n"
+            "class Span:\n"
+            "    name: str = ''\n"
+        )
+        repo = RepoContext(REPO, [FileContext(REPO / rel, rel, source)])
+        findings = [
+            f
+            for f in HotPathPurityRule().check_repo(repo)
+            if f.code == "TPL121" and f.path == rel
+        ]
+        assert any("Span" in f.message for f in findings)
+
+    def test_dunder_slots_in_body_satisfies(self):
+        rel = "tpuslo/obs/tracer.py"
+        source = (
+            "from dataclasses import dataclass\n"
+            "@dataclass\n"
+            "class Span:\n"
+            "    __slots__ = ('name',)\n"
+            "    name: str = ''\n"
+        )
+        repo = RepoContext(REPO, [FileContext(REPO / rel, rel, source)])
+        assert not [
+            f
+            for f in HotPathPurityRule().check_repo(repo)
+            if f.code == "TPL121" and f.path == rel
+        ]
+
+
+class TestExceptionDiscipline:
+    def _findings(self, rel: str, body: str) -> list:
+        return list(
+            ExceptionDisciplineRule().check_file(_ctx(rel, body))
+        )
+
+    def test_silent_pass_flagged(self):
+        findings = self._findings(
+            "tpuslo/delivery/fixture.py",
+            """
+            def emit():
+                try:
+                    send()
+                except Exception:
+                    pass
+            """,
+        )
+        assert [f.code for f in findings] == ["TPL130"]
+
+    def test_silent_return_flagged(self):
+        findings = self._findings(
+            "tpuslo/obs/fixture.py",
+            """
+            def emit():
+                try:
+                    send()
+                except Exception:
+                    return None
+            """,
+        )
+        assert [f.code for f in findings] == ["TPL130"]
+
+    def test_counter_increment_satisfies(self):
+        findings = self._findings(
+            "tpuslo/delivery/fixture.py",
+            """
+            def emit(stats):
+                try:
+                    send()
+                except Exception:
+                    stats["errors"] += 1
+            """,
+        )
+        assert findings == []
+
+    def test_reraise_satisfies(self):
+        findings = self._findings(
+            "tpuslo/runtime/fixture.py",
+            """
+            def emit():
+                try:
+                    send()
+                except Exception:
+                    raise
+            """,
+        )
+        assert findings == []
+
+    def test_narrow_type_exempt(self):
+        findings = self._findings(
+            "tpuslo/delivery/fixture.py",
+            """
+            def emit():
+                try:
+                    send()
+                except OSError:
+                    pass
+            """,
+        )
+        assert findings == []
+
+    def test_non_agent_plane_exempt(self):
+        findings = self._findings(
+            "tpuslo/models/fixture.py",
+            """
+            def emit():
+                try:
+                    send()
+                except Exception:
+                    pass
+            """,
+        )
+        assert findings == []
+
+    def test_agent_plane_tree_is_clean(self):
+        files = [
+            FileContext(p, p.relative_to(REPO).as_posix(),
+                        p.read_text(encoding="utf-8"))
+            for p in sorted((REPO / "tpuslo").rglob("*.py"))
+        ]
+        rule = ExceptionDisciplineRule()
+        findings = [f for ctx in files for f in rule.check_file(ctx)]
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+
+class TestHotpathManifestIntegrity:
+    def test_manifest_entries_resolve_in_real_tree(self):
+        """Every manifest entry must point at a real function/class —
+        guards against silent staleness after refactors."""
+        from tpuslo.analysis.hotpaths import HOT_DATACLASSES, HOT_FUNCTIONS
+
+        for rel, qualname in HOT_FUNCTIONS:
+            tree = ast.parse((REPO / rel).read_text(encoding="utf-8"))
+            names = set()
+            for node in tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    names.add(node.name)
+                elif isinstance(node, ast.ClassDef):
+                    names.update(
+                        f"{node.name}.{sub.name}"
+                        for sub in node.body
+                        if isinstance(
+                            sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                        )
+                    )
+            assert qualname in names, f"{rel}:{qualname} missing"
+        for rel, clsname in HOT_DATACLASSES:
+            tree = ast.parse((REPO / rel).read_text(encoding="utf-8"))
+            assert any(
+                isinstance(n, ast.ClassDef) and n.name == clsname
+                for n in ast.walk(tree)
+            ), f"{rel}:{clsname} missing"

@@ -1,271 +1,23 @@
-"""tpulint: stdlib AST linter for the toolkit (no external deps).
+"""Thin shim: tpulint v1 entry point -> tpuslo.analysis (tpulint v2).
 
-The image the toolkit builds in has no ruff/flake8/pyflakes and network
-installs are disallowed, so ``make lint`` runs this instead of the
-byte-compile-only check it used to be (the reference pins golangci-lint
-via ``.golangci.yml``; this is the rebuild's equivalent gate).  Checks
-target real defect classes, each with a stable code:
-
-* TPL001 unused import
-* TPL002 duplicate top-level definition (same name bound twice in one
-  scope by def/class — the later silently shadows the earlier)
-* TPL003 bare ``except:`` (swallows KeyboardInterrupt/SystemExit)
-* TPL004 mutable default argument (list/dict/set literal)
-* TPL005 f-string without any placeholder
-* TPL006 comparison to None/True/False with ``==``/``!=``
-* TPL007 ``assert`` on a non-empty tuple (always true)
-* TPL008 redefinition of a function parameter by an inner def/class
-* TPL009 ``except`` binding a name that is never used and not re-raised
-
-Usage: ``python tools/lint.py [paths...]`` (defaults to the repo's
-Python trees).  Exits 1 if any finding is reported.
+The linter grew into a contract-aware subsystem under
+``tpuslo/analysis/`` (stable TPL codes, suppressions, baseline,
+semantic rules — see docs/static-analysis.md).  This path survives for
+muscle memory and old scripts; ``make lint`` calls the module directly.
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
-DEFAULT_PATHS = ("tpuslo", "demo", "tests", "tools", "bench.py", "__graft_entry__.py")
-
-# Names that "use" an import implicitly when re-exported.
-_DUNDER_ALL = "__all__"
-
-
-class _FileLint(ast.NodeVisitor):
-    def __init__(self, path: str, tree: ast.Module, source: str):
-        self.path = path
-        self.tree = tree
-        self.source = source
-        self.findings: list[tuple[int, str, str]] = []
-        # import name -> (lineno, asname or top-level module name)
-        self.imports: dict[str, int] = {}
-        self.used_names: set[str] = set()
-        self.exported: set[str] = set()
-
-    def report(self, lineno: int, code: str, message: str) -> None:
-        self.findings.append((lineno, code, message))
-
-    # --- collection -----------------------------------------------------
-
-    def visit_Import(self, node: ast.Import) -> None:
-        for alias in node.names:
-            name = alias.asname or alias.name.split(".")[0]
-            self.imports.setdefault(name, node.lineno)
-
-    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
-        if node.module == "__future__":
-            return  # compiler directives, not bindings
-        for alias in node.names:
-            if alias.name == "*":
-                continue
-            name = alias.asname or alias.name
-            self.imports.setdefault(name, node.lineno)
-
-    def visit_Name(self, node: ast.Name) -> None:
-        if isinstance(node.ctx, ast.Load):
-            self.used_names.add(node.id)
-        self.generic_visit(node)
-
-    def visit_Attribute(self, node: ast.Attribute) -> None:
-        # foo.bar uses foo.
-        self.generic_visit(node)
-
-    def visit_Assign(self, node: ast.Assign) -> None:
-        for target in node.targets:
-            if isinstance(target, ast.Name) and target.id == _DUNDER_ALL:
-                value = node.value
-                if isinstance(value, (ast.List, ast.Tuple)):
-                    for elt in value.elts:
-                        if isinstance(elt, ast.Constant) and isinstance(
-                            elt.value, str
-                        ):
-                            self.exported.add(elt.value)
-        self.generic_visit(node)
-
-    # --- per-node checks ------------------------------------------------
-
-    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
-        if node.type is None:
-            self.report(node.lineno, "TPL003", "bare except:")
-        if node.name:
-            used = False
-            reraised = False
-            for child in ast.walk(ast.Module(body=node.body, type_ignores=[])):
-                if isinstance(child, ast.Name) and child.id == node.name:
-                    used = True
-                if isinstance(child, ast.Raise) and child.exc is None:
-                    reraised = True
-            if not used and not reraised:
-                self.report(
-                    node.lineno,
-                    "TPL009",
-                    f"exception bound as {node.name!r} but never used",
-                )
-        self.generic_visit(node)
-
-    def _check_defaults(self, node: ast.FunctionDef | ast.AsyncFunctionDef) -> None:
-        for default in [*node.args.defaults, *node.args.kw_defaults]:
-            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
-                self.report(
-                    default.lineno,
-                    "TPL004",
-                    f"mutable default argument in {node.name}()",
-                )
-
-    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
-        self._check_defaults(node)
-        self._check_param_shadowing(node)
-        self.generic_visit(node)
-
-    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
-        self._check_defaults(node)
-        self._check_param_shadowing(node)
-        self.generic_visit(node)
-
-    def _check_param_shadowing(
-        self, node: ast.FunctionDef | ast.AsyncFunctionDef
-    ) -> None:
-        params = {
-            a.arg
-            for a in [
-                *node.args.posonlyargs,
-                *node.args.args,
-                *node.args.kwonlyargs,
-                *([node.args.vararg] if node.args.vararg else []),
-                *([node.args.kwarg] if node.args.kwarg else []),
-            ]
-        }
-        for child in node.body:
-            if isinstance(
-                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
-            ) and child.name in params:
-                self.report(
-                    child.lineno,
-                    "TPL008",
-                    f"inner {child.name!r} shadows parameter of {node.name}()",
-                )
-
-    def visit_JoinedStr(self, node: ast.JoinedStr) -> None:
-        if not any(isinstance(v, ast.FormattedValue) for v in node.values):
-            self.report(node.lineno, "TPL005", "f-string without placeholders")
-        self.generic_visit(node)
-
-    def visit_FormattedValue(self, node: ast.FormattedValue) -> None:
-        # Visit only the value: a format spec is itself a JoinedStr
-        # (f"{x:.2f}") and must not trip the placeholder check.
-        self.visit(node.value)
-
-    def visit_Compare(self, node: ast.Compare) -> None:
-        for op, comparator in zip(node.ops, node.comparators):
-            if (
-                isinstance(op, (ast.Eq, ast.NotEq))
-                and isinstance(comparator, ast.Constant)
-                and comparator.value is None
-            ):
-                self.report(
-                    node.lineno,
-                    "TPL006",
-                    "comparison to None with ==/!= (use is/is not)",
-                )
-        self.generic_visit(node)
-
-    def visit_Assert(self, node: ast.Assert) -> None:
-        if isinstance(node.test, ast.Tuple) and node.test.elts:
-            self.report(
-                node.lineno, "TPL007", "assert on a tuple is always true"
-            )
-        self.generic_visit(node)
-
-    # --- module-level checks --------------------------------------------
-
-    def check_duplicate_defs(self) -> None:
-        scopes: list[tuple[str, list[ast.stmt]]] = [("module", self.tree.body)]
-        for scope_name, body in scopes:
-            seen: dict[str, int] = {}
-            for stmt in body:
-                if isinstance(stmt, ast.ClassDef):
-                    scopes.append((stmt.name, stmt.body))
-                if isinstance(
-                    stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
-                ):
-                    # Decorated re-bindings (@overload, @property+setter,
-                    # @functools.singledispatch registrations) are
-                    # legitimate double bindings.
-                    if stmt.decorator_list:
-                        continue
-                    if stmt.name in seen:
-                        self.report(
-                            stmt.lineno,
-                            "TPL002",
-                            f"{stmt.name!r} already defined at line "
-                            f"{seen[stmt.name]} in {scope_name}",
-                        )
-                    seen[stmt.name] = stmt.lineno
-
-    def check_unused_imports(self) -> None:
-        is_init = self.path.endswith("__init__.py")
-        for name, lineno in sorted(self.imports.items(), key=lambda kv: kv[1]):
-            if name.startswith("_"):
-                continue
-            if name in self.used_names or name in self.exported:
-                continue
-            if is_init:
-                # Package __init__ re-exports are the module's API even
-                # without __all__; only flag when __all__ exists and
-                # omits the name (then it is truly dead).
-                if not self.exported:
-                    continue
-            # A bare docstring mention ("``np``") is not a use; but
-            # conftest-style side-effect imports are annotated inline.
-            if f"# noqa: unused ({name})" in self.source:
-                continue
-            self.report(lineno, "TPL001", f"unused import {name!r}")
-
-    def run(self) -> list[tuple[int, str, str]]:
-        self.visit(self.tree)
-        self.check_duplicate_defs()
-        self.check_unused_imports()
-        return sorted(self.findings)
-
-
-def lint_file(path: Path) -> list[str]:
-    source = path.read_text(encoding="utf-8")
-    try:
-        tree = ast.parse(source, filename=str(path))
-    except SyntaxError as exc:
-        return [f"{path}:{exc.lineno}: TPL000 syntax error: {exc.msg}"]
-    findings = _FileLint(str(path), tree, source).run()
-    return [
-        f"{path}:{lineno}: {code} {message}" for lineno, code, message in findings
-    ]
-
-
-def iter_py_files(paths: list[str]) -> list[Path]:
-    out: list[Path] = []
-    for raw in paths:
-        p = Path(raw)
-        if p.is_dir():
-            out.extend(sorted(p.rglob("*.py")))
-        elif p.suffix == ".py":
-            out.append(p)
-    return out
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
 def main(argv: list[str] | None = None) -> int:
-    args = (argv if argv is not None else sys.argv[1:]) or list(DEFAULT_PATHS)
-    problems: list[str] = []
-    files = iter_py_files(args)
-    for path in files:
-        problems.extend(lint_file(path))
-    for line in problems:
-        print(line)
-    print(
-        f"tpulint: {len(files)} files, {len(problems)} findings",
-        file=sys.stderr,
-    )
-    return 1 if problems else 0
+    from tpuslo.analysis.__main__ import main as analysis_main
+
+    return analysis_main(argv)
 
 
 if __name__ == "__main__":

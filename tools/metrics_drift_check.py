@@ -1,66 +1,38 @@
 #!/usr/bin/env python3
-"""Metrics drift check: every AgentMetrics series must be observable.
+"""Thin shim: metrics drift gate -> tpulint rule TPL150.
 
-A series that no dashboard panel and no doc ever references is dead
-weight at best and a silent observability gap at worst — someone added
-the instrument but nobody can see it.  This gate extracts every metric
-name registered in ``tpuslo/metrics/registry.py`` and fails if any is
-referenced by neither ``dashboards/*.json`` nor ``docs/**/*.md``.
-
-Run via ``make metrics-drift`` (part of ``make obs-smoke``).
+The check (every AgentMetrics series must be referenced by a dashboard
+or a doc) now lives in ``tpuslo.analysis.rules_contracts.MetricsDriftRule``
+and runs as part of ``make lint``; this entry point keeps
+``make metrics-drift`` / ``make obs-smoke`` working standalone.
 """
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
-REGISTRY = REPO / "tpuslo" / "metrics" / "registry.py"
-
-# Metric families declared as string literals in the registry.
-_NAME_RE = re.compile(r'"(llm_(?:slo|tpu)_[a-z0-9_]+)"')
-
-
-def registered_series() -> list[str]:
-    names = sorted(set(_NAME_RE.findall(REGISTRY.read_text(encoding="utf-8"))))
-    if not names:
-        raise SystemExit(
-            f"metrics-drift: no metric names found in {REGISTRY} — "
-            "did the registry move?"
-        )
-    return names
-
-
-def reference_corpus() -> str:
-    chunks = []
-    for path in sorted((REPO / "dashboards").glob("*.json")):
-        chunks.append(path.read_text(encoding="utf-8"))
-    # generate.py is the dashboards' source of truth; a panel defined
-    # there counts even before the JSON is regenerated.
-    chunks.append((REPO / "dashboards" / "generate.py").read_text(encoding="utf-8"))
-    for path in sorted((REPO / "docs").rglob("*.md")):
-        chunks.append(path.read_text(encoding="utf-8"))
-    return "\n".join(chunks)
+sys.path.insert(0, str(REPO))
 
 
 def main() -> int:
-    series = registered_series()
-    corpus = reference_corpus()
-    orphans = [name for name in series if name not in corpus]
-    print(
-        f"metrics-drift: {len(series)} series registered, "
-        f"{len(series) - len(orphans)} referenced in dashboards/ or docs/"
+    from tpuslo.analysis import run_analysis
+    from tpuslo.analysis.rules_contracts import MetricsDriftRule
+
+    result = run_analysis(
+        REPO,
+        paths=["tpuslo/metrics/registry.py"],
+        rules=[MetricsDriftRule()],
     )
-    if orphans:
-        print("metrics-drift: ORPHANED series (no dashboard or doc "
-              "references them):")
-        for name in orphans:
-            print(f"  - {name}")
+    for finding in result.findings:
+        print(finding.render())
+    if result.findings:
         print(
-            "metrics-drift: add a panel (dashboards/generate.py) or a "
-            "runbook reference, or delete the series.",
+            "metrics-drift: ORPHANED series — add a panel "
+            "(dashboards/generate.py) or a runbook reference, or delete "
+            "the series.",
+            file=sys.stderr,
         )
         return 1
     print("metrics-drift: OK — no orphans")

@@ -1,0 +1,40 @@
+"""tpulint v2: contract-aware static analysis + dynamic race checking.
+
+Static half (``python -m tpuslo.analysis`` / ``make lint``): a rule
+framework with stable TPL codes over the repo's real invariants —
+schema/dataclass drift, lock discipline, hot-path purity, exception
+accounting, config and metrics drift — plus the generic TPL00x style
+tier ported from tpulint v1.  Dynamic half
+(:mod:`tpuslo.analysis.racecheck`, ``TPUSLO_RACECHECK=1``): a
+lock-order race detector that wraps ``threading.Lock``/``RLock`` and
+fails CI on cross-thread acquisition-order inversions.
+"""
+
+from tpuslo.analysis.core import (
+    BASELINE_FILENAME,
+    DEFAULT_PATHS,
+    AnalysisResult,
+    Baseline,
+    FileContext,
+    Finding,
+    RepoContext,
+    Rule,
+    changed_py_files,
+    run_analysis,
+)
+from tpuslo.analysis.rules import ALL_RULES, rule_catalog
+
+__all__ = [
+    "ALL_RULES",
+    "AnalysisResult",
+    "BASELINE_FILENAME",
+    "Baseline",
+    "DEFAULT_PATHS",
+    "FileContext",
+    "Finding",
+    "RepoContext",
+    "Rule",
+    "changed_py_files",
+    "rule_catalog",
+    "run_analysis",
+]

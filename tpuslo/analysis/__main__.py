@@ -1,0 +1,149 @@
+"""CLI for the analyzer: ``python -m tpuslo.analysis [paths...]``.
+
+Exit codes: 0 clean (or fully baselined), 1 non-baselined findings,
+2 usage/configuration error.  ``make lint`` runs this over the repo's
+default trees with the committed baseline; ``make lint-changed`` scopes
+the file-level rules to git-changed files (repo-contract rules always
+run — they are cheap and cross-file by nature).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from tpuslo.analysis.core import (
+    BASELINE_FILENAME,
+    Baseline,
+    changed_py_files,
+    run_analysis,
+)
+from tpuslo.analysis.rules import rule_catalog
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpuslo.analysis", description=__doc__
+    )
+    p.add_argument("paths", nargs="*", help="files/dirs (default: repo trees)")
+    p.add_argument("--root", default=".", help="repo root (default: cwd)")
+    p.add_argument("--json", action="store_true", help="machine output")
+    p.add_argument(
+        "--baseline",
+        default="",
+        help=f"baseline file (default: <root>/{BASELINE_FILENAME})",
+    )
+    p.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report every finding, ignoring the baseline",
+    )
+    p.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write current findings as the new baseline and exit 0 "
+        "(each entry still needs a human-written reason)",
+    )
+    p.add_argument(
+        "--changed",
+        action="store_true",
+        help="lint only git-changed .py files (plus repo-contract rules)",
+    )
+    p.add_argument("--list-rules", action="store_true")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for row in rule_catalog():
+            print(f"{row['code']:7s} {row['name']:20s} {row['rationale']}")
+        return 0
+
+    root = Path(args.root).resolve()
+    files = None
+    if args.changed:
+        files = changed_py_files(root)
+        if not files:
+            print("tpulint: no changed python files", file=sys.stderr)
+
+    result = run_analysis(
+        root, paths=args.paths or None, files=files
+    )
+    if result.files_scanned == 0 and not args.changed:
+        # Fail closed: a gate that scanned nothing (wrong --root, cwd
+        # outside the repo) must not report a green lint run.
+        print(
+            f"tpulint: no python files found under {root} — wrong "
+            "--root or cwd? refusing to pass an empty gate",
+            file=sys.stderr,
+        )
+        return 2
+
+    baseline_path = Path(args.baseline) if args.baseline else (
+        root / BASELINE_FILENAME
+    )
+    if args.write_baseline:
+        regenerated = Baseline.from_findings(result.findings)
+        # Preserve human-written justifications for entries that are
+        # still live — regeneration must not reset them to TODO.
+        existing = {
+            (e.get("path", ""), e.get("code", ""), e.get("message", "")):
+                e.get("reason", "")
+            for e in Baseline.load(baseline_path).entries
+        }
+        for entry in regenerated.entries:
+            kept = existing.get(
+                (entry["path"], entry["code"], entry["message"])
+            )
+            if kept and not kept.startswith("TODO"):
+                entry["reason"] = kept
+        regenerated.save(baseline_path)
+        print(
+            f"tpulint: wrote {len(regenerated.entries)} entries to "
+            f"{baseline_path}",
+            file=sys.stderr,
+        )
+        return 0
+
+    baseline = (
+        Baseline()
+        if args.no_baseline
+        else Baseline.load(baseline_path)
+    )
+    new, baselined, stale = baseline.split(result.findings)
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "files_scanned": result.files_scanned,
+                    "suppressed": result.suppressed,
+                    "baselined": len(baselined),
+                    "stale_baseline_entries": stale,
+                    "findings": [f.to_dict() for f in new],
+                },
+                indent=2,
+            )
+        )
+    else:
+        for finding in new:
+            print(finding.render())
+        for entry in stale:
+            print(
+                f"tpulint: stale baseline entry ({entry.get('code')} "
+                f"{entry.get('path')}): remove it from {baseline_path.name}",
+                file=sys.stderr,
+            )
+    print(
+        f"tpulint: {result.files_scanned} files, {len(new)} findings "
+        f"({len(baselined)} baselined, {result.suppressed} suppressed)",
+        file=sys.stderr,
+    )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

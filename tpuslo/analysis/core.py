@@ -1,0 +1,450 @@
+"""tpulint v2 core: rule framework, suppressions, baseline, engine.
+
+The toolkit's static analyzer grew out of ``tools/lint.py`` (a generic
+AST style pass) into a contract-aware subsystem: rules know the repo's
+real invariants — JSON-schema ↔ dataclass parity, lock discipline for
+the agent's threaded classes, hot-path purity, exception accounting,
+config drift.  The framework provides what every rule shares:
+
+* stable codes (``TPL0xx`` style ports, ``TPL1xx`` semantic rules);
+* per-finding suppression via ``# tpulint: disable=TPL110[,TPL111]``
+  on the finding line or the line directly above, and file-level
+  ``# tpulint: disable-file=TPL130`` directives;
+* a committed baseline file (``.tpulint-baseline.json``) for
+  grandfathered findings — the gate is zero-delta against it, and
+  every entry must carry a ``reason``;
+* human (``path:line: CODE message``) and ``--json`` output.
+
+No external dependencies: the CI image has no ruff/flake8, so the
+analyzer is stdlib-AST only (the reference repo pins golangci-lint for
+the same role).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+import subprocess
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Iterable, Iterator
+
+DEFAULT_PATHS = (
+    "tpuslo",
+    "demo",
+    "tests",
+    "tools",
+    "bench.py",
+    "__graft_entry__.py",
+)
+
+BASELINE_FILENAME = ".tpulint-baseline.json"
+
+_DISABLE_RE = re.compile(r"#\s*tpulint:\s*disable=([A-Z0-9,\s]+)")
+_DISABLE_FILE_RE = re.compile(r"#\s*tpulint:\s*disable-file=([A-Z0-9,\s]+)")
+
+
+@dataclass(slots=True)
+class Finding:
+    """One analyzer finding, stable across reruns.
+
+    ``path`` is repo-relative POSIX so baselines survive checkouts in
+    different directories; ``message`` must avoid volatile content
+    (absolute paths, timestamps) for the same reason.
+    """
+
+    path: str
+    line: int
+    code: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+    def fingerprint(self) -> tuple[str, str, str]:
+        """Line-number-free identity used by baseline matching."""
+        return (self.path, self.code, self.message)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "code": self.code,
+            "message": self.message,
+        }
+
+
+class FileContext:
+    """One parsed source file shared by every file-scoped rule.
+
+    Parsing once per file (instead of once per rule) is what keeps the
+    full-repo run inside the bench.py < 30 s gate on the 1-CPU box.
+    """
+
+    def __init__(self, path: Path, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree: ast.Module | None = None
+        self.parse_error: SyntaxError | None = None
+        try:
+            self.tree = ast.parse(source, filename=rel)
+        except SyntaxError as exc:
+            self.parse_error = exc
+        self._file_disabled: set[str] | None = None
+        self._line_disabled: dict[int, set[str]] | None = None
+
+    # --- suppression ----------------------------------------------------
+
+    def _scan_directives(self) -> None:
+        file_disabled: set[str] = set()
+        line_disabled: dict[int, set[str]] = {}
+        for lineno, line in enumerate(self.lines, start=1):
+            if "tpulint" not in line:
+                continue
+            m = _DISABLE_FILE_RE.search(line)
+            if m:
+                file_disabled.update(_parse_codes(m.group(1)))
+            m = _DISABLE_RE.search(line)
+            if m:
+                codes = _parse_codes(m.group(1))
+                # A trailing directive governs its own line; a
+                # standalone comment line governs the line below it.
+                targets = (
+                    (lineno, lineno + 1)
+                    if line.lstrip().startswith("#")
+                    else (lineno,)
+                )
+                for target in targets:
+                    line_disabled.setdefault(target, set()).update(codes)
+        self._file_disabled = file_disabled
+        self._line_disabled = line_disabled
+
+    def suppressed(self, finding: Finding) -> bool:
+        if self._file_disabled is None:
+            self._scan_directives()
+        assert self._file_disabled is not None
+        assert self._line_disabled is not None
+        if finding.code in self._file_disabled or "ALL" in self._file_disabled:
+            return True
+        codes = self._line_disabled.get(finding.line)
+        return bool(codes and (finding.code in codes or "ALL" in codes))
+
+
+def _parse_codes(raw: str) -> set[str]:
+    return {c.strip().upper() for c in raw.split(",") if c.strip()}
+
+
+class RepoContext:
+    """The full analyzed tree plus lazily-loaded repo artifacts.
+
+    Repo-scoped rules (schema drift, config drift, metrics drift,
+    cross-class lock graphs) need more than one file; they read the
+    contracts and registries through here so the engine stays the only
+    component that touches the filesystem layout.
+    """
+
+    def __init__(self, root: Path, files: list[FileContext]):
+        self.root = root
+        self.files = files
+        self.by_rel = {f.rel: f for f in files}
+
+    def read_json(self, rel: str) -> Any | None:
+        path = self.root / rel
+        try:
+            return json.loads(path.read_text(encoding="utf-8"))
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def read_text(self, rel: str) -> str | None:
+        try:
+            return (self.root / rel).read_text(encoding="utf-8")
+        except OSError:
+            return None
+
+    def glob_text(self, pattern: str) -> Iterator[tuple[str, str]]:
+        for path in sorted(self.root.glob(pattern)):
+            try:
+                yield (
+                    path.relative_to(self.root).as_posix(),
+                    path.read_text(encoding="utf-8"),
+                )
+            except OSError:
+                continue
+
+
+class Rule:
+    """Base class: a rule owns one or more stable TPL codes.
+
+    ``check_file`` runs once per analyzed file; ``check_repo`` once per
+    run (for contract rules that compare artifacts across files).
+    Override whichever applies — the defaults are empty.
+    """
+
+    #: Primary code; ``codes`` lists every code the rule can emit.
+    code: str = ""
+    codes: tuple[str, ...] = ()
+    name: str = ""
+    rationale: str = ""
+    #: Repo-relative files (or ``dir/`` prefixes) a repo-scoped rule
+    #: needs in context even when the scanned set is git-scoped
+    #: (``--changed``): the engine loads missing anchors from disk so
+    #: contract rules genuinely always run, and suppressions inside
+    #: anchor files are honored on every run.
+    repo_anchors: tuple[str, ...] = ()
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def check_repo(self, repo: RepoContext) -> Iterable[Finding]:
+        return ()
+
+
+# --- baseline ------------------------------------------------------------
+
+
+@dataclass(slots=True)
+class Baseline:
+    """Committed grandfathered findings; the gate is zero-delta.
+
+    Matching is by (path, code, message) fingerprint, not line number —
+    unrelated edits above a finding must not invalidate the baseline.
+    Every entry carries a ``reason`` explaining why it is allowed to
+    stay; ``stale`` entries (no longer matched by any finding) are
+    reported so the file shrinks over time instead of fossilizing.
+    """
+
+    entries: list[dict[str, str]] = field(default_factory=list)
+
+    @classmethod
+    def load(cls, path: Path) -> "Baseline":
+        try:
+            raw = json.loads(path.read_text(encoding="utf-8"))
+        except (OSError, json.JSONDecodeError):
+            return cls()
+        entries = raw.get("entries") if isinstance(raw, dict) else None
+        return cls(entries=list(entries or []))
+
+    def save(self, path: Path) -> None:
+        payload = {
+            "version": 1,
+            "comment": (
+                "tpulint baseline: grandfathered findings. The lint gate "
+                "is zero-delta against this file; every entry needs a "
+                "reason and should be burned down, not added to."
+            ),
+            "entries": self.entries,
+        }
+        path.write_text(json.dumps(payload, indent=2) + "\n", encoding="utf-8")
+
+    def _keys(self) -> set[tuple[str, str, str]]:
+        return {
+            (e.get("path", ""), e.get("code", ""), e.get("message", ""))
+            for e in self.entries
+        }
+
+    def split(
+        self, findings: list[Finding]
+    ) -> tuple[list[Finding], list[Finding], list[dict[str, str]]]:
+        """(new, baselined, stale-entries) partition of a run's output."""
+        keys = self._keys()
+        new: list[Finding] = []
+        matched: list[Finding] = []
+        seen: set[tuple[str, str, str]] = set()
+        for finding in findings:
+            fp = finding.fingerprint()
+            if fp in keys:
+                matched.append(finding)
+                seen.add(fp)
+            else:
+                new.append(finding)
+        stale = [
+            e
+            for e in self.entries
+            if (e.get("path", ""), e.get("code", ""), e.get("message", ""))
+            not in seen
+        ]
+        return new, matched, stale
+
+    @classmethod
+    def from_findings(cls, findings: list[Finding]) -> "Baseline":
+        return cls(
+            entries=[
+                {
+                    "path": f.path,
+                    "code": f.code,
+                    "message": f.message,
+                    "reason": "TODO: justify or fix",
+                }
+                for f in findings
+            ]
+        )
+
+
+# --- engine --------------------------------------------------------------
+
+_SKIP_DIR_PARTS = frozenset({"__pycache__", ".git", "node_modules"})
+
+
+def iter_py_files(root: Path, paths: Iterable[str]) -> list[Path]:
+    out: list[Path] = []
+    for raw in paths:
+        p = Path(raw)
+        if not p.is_absolute():
+            p = root / p
+        if p.is_dir():
+            out.extend(
+                f
+                for f in sorted(p.rglob("*.py"))
+                if not _SKIP_DIR_PARTS.intersection(f.parts)
+            )
+        elif p.suffix == ".py" and p.exists():
+            out.append(p)
+    return out
+
+
+def changed_py_files(root: Path) -> list[Path]:
+    """Python files touched vs HEAD (staged, unstaged, untracked) —
+    the ``make lint-changed`` scope."""
+    cmds = (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    )
+    names: set[str] = set()
+    for cmd in cmds:
+        try:
+            proc = subprocess.run(
+                cmd, cwd=root, capture_output=True, text=True, timeout=30
+            )
+        except (OSError, subprocess.SubprocessError):
+            continue
+        if proc.returncode == 0:
+            names.update(
+                line.strip()
+                for line in proc.stdout.splitlines()
+                if line.strip().endswith(".py")
+            )
+    return [root / n for n in sorted(names) if (root / n).exists()]
+
+
+@dataclass(slots=True)
+class AnalysisResult:
+    findings: list[Finding]
+    suppressed: int
+    files_scanned: int
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "files_scanned": self.files_scanned,
+            "suppressed": self.suppressed,
+            "findings": [f.to_dict() for f in self.findings],
+        }
+
+
+def run_analysis(
+    root: Path,
+    paths: Iterable[str] | None = None,
+    rules: Iterable[Rule] | None = None,
+    files: list[Path] | None = None,
+) -> AnalysisResult:
+    """Parse once, run every rule, apply suppressions, sort stably.
+
+    ``files`` overrides path discovery (the ``--changed`` scope);
+    repo-scoped rules still see the full context they need because
+    each declares ``repo_anchors`` — the engine loads any anchor file
+    missing from the scanned set, file-scoped rules run only over the
+    requested files.
+    """
+    from tpuslo.analysis.rules import ALL_RULES
+
+    root = root.resolve()
+    active_rules = list(rules) if rules is not None else list(ALL_RULES)
+    file_paths = (
+        list(files)
+        if files is not None
+        else iter_py_files(root, paths or DEFAULT_PATHS)
+    )
+
+    contexts: list[FileContext] = []
+    findings: list[Finding] = []
+
+    def load(path: Path, report_errors: bool) -> FileContext | None:
+        try:
+            source = path.read_text(encoding="utf-8")
+        except OSError as exc:
+            if report_errors:
+                findings.append(
+                    Finding(
+                        _rel(root, path),
+                        1,
+                        "TPL000",
+                        f"unreadable: {exc.strerror}",
+                    )
+                )
+            return None
+        ctx = FileContext(path, _rel(root, path), source)
+        if ctx.parse_error is not None:
+            if report_errors:
+                findings.append(
+                    Finding(
+                        ctx.rel,
+                        ctx.parse_error.lineno or 1,
+                        "TPL000",
+                        f"syntax error: {ctx.parse_error.msg}",
+                    )
+                )
+            return None
+        return ctx
+
+    for path in file_paths:
+        ctx = load(path, report_errors=True)
+        if ctx is not None:
+            contexts.append(ctx)
+
+    # Anchor files repo rules need beyond the scanned set (the
+    # git-scoped mode): loaded for RepoContext only — file-scoped
+    # rules still run over exactly the requested files.
+    anchors: list[FileContext] = []
+    have = {c.rel for c in contexts}
+    for rule in active_rules:
+        for anchor in rule.repo_anchors:
+            if anchor.endswith("/"):
+                anchor_files = iter_py_files(root, [anchor.rstrip("/")])
+            else:
+                anchor_files = [root / anchor]
+            for path in anchor_files:
+                rel = _rel(root, path)
+                if rel in have or not path.exists():
+                    continue
+                have.add(rel)
+                ctx = load(path, report_errors=False)
+                if ctx is not None:
+                    anchors.append(ctx)
+
+    repo = RepoContext(root, contexts + anchors)
+    for rule in active_rules:
+        for ctx in contexts:
+            findings.extend(rule.check_file(ctx))
+        findings.extend(rule.check_repo(repo))
+
+    kept: list[Finding] = []
+    suppressed = 0
+    for finding in findings:
+        ctx = repo.by_rel.get(finding.path)
+        if ctx is not None and ctx.suppressed(finding):
+            suppressed += 1
+            continue
+        kept.append(finding)
+    kept.sort(key=lambda f: (f.path, f.line, f.code, f.message))
+    return AnalysisResult(
+        findings=kept, suppressed=suppressed, files_scanned=len(file_paths)
+    )
+
+
+def _rel(root: Path, path: Path) -> str:
+    try:
+        return path.resolve().relative_to(root).as_posix()
+    except ValueError:
+        return path.as_posix()

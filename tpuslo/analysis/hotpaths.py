@@ -1,0 +1,55 @@
+"""Hot-path manifest: the functions the TPL12x purity rules guard.
+
+These are the per-event / per-cycle code paths that PR 1 and PR 5
+measured and optimized (fast-path validation, batched generation,
+indexed correlation, tracer stage records); a stray ``json.dumps`` or
+``time.time`` here silently undoes that work.  Registering a function
+makes two invariants machine-checked:
+
+* **TPL120** — its body (including nested defs) must not call the
+  known hot-path poisons: ``logging``/logger calls, ``print``,
+  ``json.dumps``/``json.dump``, ``copy.deepcopy``, ``time.time`` /
+  ``time.time_ns`` (use ``perf_counter_ns``; wall-clock anchoring
+  belongs on the cold side), or ``os.urandom`` (~10 µs/call — use the
+  seeded ``random`` instance the tracer keeps).
+* **TPL121** — the dataclasses it allocates per event (listed in
+  ``HOT_DATACLASSES``) must declare ``slots`` — a per-event ``__dict__``
+  costs both allocation time and cache locality.
+
+When a new function joins the hot path (columnar spine, fleet
+aggregator ingest), add it here in the same PR that optimizes it —
+the manifest is the contract that the optimization stays real.
+"""
+
+from __future__ import annotations
+
+#: (repo-relative module path, dotted qualname within the module).
+HOT_FUNCTIONS: tuple[tuple[str, str], ...] = (
+    # Structural fast-path validation (PR 1): runs once per probe event.
+    ("tpuslo/schema/fastpath.py", "fast_probe_event_valid"),
+    ("tpuslo/schema/fastpath.py", "fast_probe_payload_valid"),
+    ("tpuslo/schema/fastpath.py", "validate_probe_event"),
+    ("tpuslo/schema/fastpath.py", "validate_probe_payload"),
+    # Batched probe-event generation (PR 1): per sample x signal.
+    ("tpuslo/signals/generator.py", "Generator.generate_batch"),
+    # Indexed correlation (PR 1): per span x tier.
+    ("tpuslo/correlation/matcher.py", "match_batch"),
+    # Self-tracer stage records (PR 5): 8+ per agent cycle; these CMs
+    # were hand-rolled specifically to stay under the overhead gate.
+    ("tpuslo/obs/tracer.py", "_StageCM.__init__"),
+    ("tpuslo/obs/tracer.py", "_StageCM.__exit__"),
+    ("tpuslo/obs/tracer.py", "CycleTrace.stage"),
+)
+
+#: (repo-relative module path, dataclass name) pairs that are allocated
+#: on the paths above and must declare ``slots``.
+HOT_DATACLASSES: tuple[tuple[str, str], ...] = (
+    ("tpuslo/schema/types.py", "ProbeEventV1"),
+    ("tpuslo/schema/types.py", "ConnTuple"),
+    ("tpuslo/schema/types.py", "TPURef"),
+    ("tpuslo/obs/tracer.py", "Span"),
+    ("tpuslo/correlation/matcher.py", "SpanRef"),
+    ("tpuslo/correlation/matcher.py", "SignalRef"),
+    ("tpuslo/correlation/matcher.py", "Decision"),
+    ("tpuslo/correlation/matcher.py", "BatchMatch"),
+)

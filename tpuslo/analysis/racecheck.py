@@ -1,0 +1,340 @@
+"""Dynamic lock-order race detector (``TPUSLO_RACECHECK=1``).
+
+The static TPL111 rule sees the acquisition orders the AST admits;
+this module checks the orders that actually *execute*.  When installed
+it replaces ``threading.Lock``/``RLock`` with tracked wrappers that
+record, per thread, the stack of held locks at every acquisition.
+Two failure patterns are detected:
+
+* **Order inversion (AB/BA).**  Acquiring B while holding A adds edge
+  A→B to a global acquisition-order graph.  If the edge closes a cycle
+  (some thread ever acquired A while holding B, directly or
+  transitively), both acquisition stacks are recorded as a violation —
+  the classic latent deadlock that only fires under the right
+  scheduler interleaving.
+
+* **Lock held across a blocking call.**  ``time.sleep`` is patched to
+  flag sleeping while holding any tracked lock — the pattern that
+  turns a slow sink into a stalled agent loop (the delivery layer's
+  contract is that backoff sleeps and network sends happen outside
+  every lock).
+
+Violations are recorded, not raised: raising inside an arbitrary
+worker thread would vanish into daemon-thread teardown.  The pytest
+wiring (``tests/conftest.py``) fails the session if any violation was
+recorded; ``make racecheck-smoke`` runs the delivery/runtime/obs
+suites this way.
+
+The wrappers are Condition-compatible: ``threading.Condition(lock)``
+binds the wrapper's ``acquire``/``release``, so waits release and
+re-acquire through the tracking.  (A Condition over a tracked *RLock*
+delegates ``_release_save``/``_acquire_restore`` to the raw lock and
+bypasses hold tracking during the wait window — acceptable: the repo
+builds conditions over plain Locks.)
+
+Unit tests drive :class:`RaceCheckRegistry` directly with explicitly
+wrapped locks, so provoked inversions never pollute the global
+install's registry.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+from dataclasses import dataclass
+
+import _thread
+
+ENV_FLAG = "TPUSLO_RACECHECK"
+
+#: The threaded suites `make racecheck-smoke` / `m5gate --racecheck-smoke`
+#: run under the detector, plus its own seeded-inversion tests.
+SMOKE_SUITES = (
+    "tests/test_delivery.py",
+    "tests/test_runtime_drain.py",
+    "tests/test_runtime_state.py",
+    "tests/test_runtime_supervisor.py",
+    "tests/test_obs_tracer.py",
+    "tests/test_racecheck.py",
+)
+
+#: Raw lock factory immune to the monkeypatch (the registry's own
+#: synchronization must not recurse into the tracker).
+_raw_lock = _thread.allocate_lock
+
+_real_lock_factory = threading.Lock
+_real_rlock_factory = threading.RLock
+_real_sleep = time.sleep
+
+
+@dataclass(slots=True)
+class Violation:
+    kind: str  # "order_inversion" | "blocked_while_locked"
+    detail: str
+    stack: str
+    other_stack: str = ""
+
+    def render(self) -> str:
+        out = f"racecheck: {self.kind}: {self.detail}\n--- stack:\n{self.stack}"
+        if self.other_stack:
+            out += f"--- conflicting acquisition stack:\n{self.other_stack}"
+        return out
+
+
+@dataclass(slots=True)
+class _Edge:
+    stack: str
+    thread: str
+
+
+class RaceCheckRegistry:
+    """Global acquisition-order graph + per-thread held-lock stacks."""
+
+    def __init__(self, max_violations: int = 64):
+        self._mu = _raw_lock()
+        #: src lock id -> dst lock id -> first-seen edge info
+        self._graph: dict[int, dict[int, _Edge]] = {}
+        self._names: dict[int, str] = {}
+        #: Strong refs to every lock whose id entered the order graph:
+        #: CPython recycles ids after GC, so an unpinned graph would
+        #: conflate a dead test's locks with fresh allocations and fail
+        #: the session gate with spurious inversions.  Bounded by the
+        #: number of distinct locks that ever nested — not by total
+        #: lock churn.
+        self._refs: dict[int, object] = {}
+        self._tls = threading.local()
+        self.violations: list[Violation] = []
+        self._max_violations = max_violations
+
+    # --- held-stack bookkeeping ----------------------------------------
+
+    def _held(self) -> list:
+        """Per-thread stack of HELD LOCK OBJECTS (strong refs while
+        held, so their ids cannot be recycled mid-hold)."""
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = []
+            self._tls.held = held
+        return held
+
+    def name_of(self, lock_id: int) -> str:
+        return self._names.get(lock_id, f"lock-{lock_id:#x}")
+
+    def on_acquired(self, lock, name: str) -> None:
+        lock_id = id(lock)
+        held = self._held()
+        stack = None
+        with self._mu:
+            if lock_id not in self._refs:
+                # Not pinned: id may belong to a new lock — (re)name it.
+                self._names[lock_id] = name
+            for src_lock in held:
+                src = id(src_lock)
+                if src == lock_id:
+                    continue
+                edges = self._graph.setdefault(src, {})
+                if lock_id not in edges:
+                    if stack is None:
+                        stack = "".join(traceback.format_stack(limit=12))
+                    edges[lock_id] = _Edge(
+                        stack, threading.current_thread().name
+                    )
+                    self._refs[src] = src_lock
+                    self._refs[lock_id] = lock
+                    self._check_cycle_locked(src, lock_id)
+        held.append(lock)
+
+    def on_released(self, lock) -> None:
+        held = self._held()
+        # Out-of-order release (lock A released while B still held) is
+        # legal Python; remove the newest matching entry.
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                return
+
+    def held_any(self) -> list:
+        return list(self._held())
+
+    # --- detection ------------------------------------------------------
+
+    def _check_cycle_locked(self, src: int, dst: int) -> None:
+        """After adding src→dst: a dst→…→src path means an inversion."""
+        seen = {dst}
+        stack = [dst]
+        while stack:
+            node = stack.pop()
+            for nxt in self._graph.get(node, ()):
+                if nxt == src:
+                    edge = self._graph[src][dst]
+                    back = self._graph[node][src]
+                    self._record_locked(
+                        Violation(
+                            "order_inversion",
+                            f"{self.name_of(src)} -> {self.name_of(dst)} "
+                            f"inverts an existing "
+                            f"{self.name_of(dst)} ~> {self.name_of(src)} "
+                            f"order (thread {edge.thread} vs "
+                            f"{back.thread})",
+                            edge.stack,
+                            back.stack,
+                        )
+                    )
+                    return
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append(nxt)
+
+    def note_blocking(self, what: str) -> None:
+        held = self._held()
+        if not held:
+            return
+        names = ", ".join(self.name_of(id(h)) for h in held)
+        with self._mu:
+            self._record_locked(
+                Violation(
+                    "blocked_while_locked",
+                    f"{what} while holding [{names}]",
+                    "".join(traceback.format_stack(limit=12)),
+                )
+            )
+
+    def _record_locked(self, violation: Violation) -> None:
+        if len(self.violations) < self._max_violations:
+            self.violations.append(violation)
+
+    def reset(self) -> None:
+        with self._mu:
+            self._graph.clear()
+            self._refs.clear()
+            self.violations.clear()
+
+    def report(self) -> str:
+        return "\n\n".join(v.render() for v in self.violations)
+
+
+class TrackedLock:
+    """Order-tracking wrapper around a raw ``threading.Lock``."""
+
+    _reentrant = False
+
+    def __init__(
+        self,
+        registry: RaceCheckRegistry,
+        name: str = "",
+        _factory=None,
+    ):
+        self._inner = (_factory or _real_lock_factory)()
+        self._registry = registry
+        self._name = name or f"Lock@{id(self._inner):#x}"
+        self._depth = 0  # only the RLock subclass ever exceeds 1
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            if self._depth == 0:
+                self._registry.on_acquired(self, self._name)
+            if self._reentrant:
+                self._depth += 1
+            else:
+                self._depth = 1
+        return got
+
+    def release(self) -> None:
+        if self._depth > 0:
+            self._depth -= 1
+            if self._depth == 0:
+                self._registry.on_released(self)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.release()
+
+    def __getattr__(self, item):
+        # Condition() introspects _is_owned/_release_save/_acquire_restore
+        # on RLocks; delegate anything we don't track.
+        return getattr(self._inner, item)
+
+
+class TrackedRLock(TrackedLock):
+    _reentrant = True
+
+    def __init__(self, registry: RaceCheckRegistry, name: str = ""):
+        super().__init__(registry, name, _factory=_real_rlock_factory)
+        self._name = name or f"RLock@{id(self._inner):#x}"
+
+
+# --- global install -------------------------------------------------------
+
+_GLOBAL = RaceCheckRegistry()
+_installed = False
+
+
+def registry() -> RaceCheckRegistry:
+    return _GLOBAL
+
+
+def enabled_by_env() -> bool:
+    return os.environ.get(ENV_FLAG, "") == "1"
+
+
+def _caller_name() -> str:
+    """Identify a lock by its allocation site — the stable name the
+    inversion report needs (ids recycle, source lines do not)."""
+    for frame in reversed(traceback.extract_stack(limit=8)[:-2]):
+        if "racecheck" not in (frame.filename or ""):
+            return f"{os.path.basename(frame.filename)}:{frame.lineno}"
+    return "unknown"
+
+
+def _tracked_lock_factory() -> TrackedLock:
+    return TrackedLock(_GLOBAL, f"Lock({_caller_name()})")
+
+
+def _tracked_rlock_factory() -> TrackedRLock:
+    return TrackedRLock(_GLOBAL, f"RLock({_caller_name()})")
+
+
+def _tracked_sleep(seconds: float) -> None:
+    # Sub-millisecond sleeps are scheduler yields, not blocking waits.
+    if seconds >= 0.001:
+        _GLOBAL.note_blocking(f"time.sleep({seconds!r})")
+    _real_sleep(seconds)
+
+
+def install() -> None:
+    """Patch ``threading.Lock``/``RLock`` and ``time.sleep``.
+
+    Locks created *after* install are tracked; pre-existing locks
+    (interpreter internals, already-imported libraries binding
+    ``from threading import Lock``) keep working untracked.
+    """
+    global _installed
+    if _installed:
+        return
+    threading.Lock = _tracked_lock_factory  # type: ignore[misc]
+    threading.RLock = _tracked_rlock_factory  # type: ignore[misc]
+    time.sleep = _tracked_sleep
+    _installed = True
+
+
+def uninstall() -> None:
+    global _installed
+    if not _installed:
+        return
+    threading.Lock = _real_lock_factory  # type: ignore[misc]
+    threading.RLock = _real_rlock_factory  # type: ignore[misc]
+    time.sleep = _real_sleep
+    _installed = False
+
+
+def installed() -> bool:
+    return _installed

@@ -1,0 +1,39 @@
+"""Rule registry: the analyzer's active rule set, in emission order.
+
+New rules register here; see CONTRIBUTING.md "Adding a lint rule" and
+docs/static-analysis.md for the catalog.
+"""
+
+from __future__ import annotations
+
+from tpuslo.analysis.core import Rule
+from tpuslo.analysis.rules_contracts import (
+    ConfigDriftRule,
+    MetricsDriftRule,
+    SchemaDriftRule,
+)
+from tpuslo.analysis.rules_except import ExceptionDisciplineRule
+from tpuslo.analysis.rules_hotpath import HotPathPurityRule
+from tpuslo.analysis.rules_locks import LockDisciplineRule
+from tpuslo.analysis.rules_style import StyleRules
+
+ALL_RULES: tuple[Rule, ...] = (
+    StyleRules(),
+    SchemaDriftRule(),
+    ConfigDriftRule(),
+    MetricsDriftRule(),
+    LockDisciplineRule(),
+    HotPathPurityRule(),
+    ExceptionDisciplineRule(),
+)
+
+
+def rule_catalog() -> list[dict[str, str]]:
+    """(code, name, rationale) rows for --list-rules and the docs."""
+    rows = []
+    for rule in ALL_RULES:
+        for code in rule.codes:
+            rows.append(
+                {"code": code, "name": rule.name, "rationale": rule.rationale}
+            )
+    return rows

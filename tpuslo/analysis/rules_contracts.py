@@ -1,0 +1,584 @@
+"""TPL101/TPL102/TPL140/TPL150: repo-contract drift rules.
+
+The toolkit's boundaries are JSON contracts: every emitted event
+crosses a schema in ``tpuslo/schema/contracts/``, every config file is
+validated against the ``v1alpha1`` toolkit-config schema, and every
+metric series is supposed to be visible on a dashboard.  Each of those
+contracts has two sides that can silently drift apart; these rules
+re-derive both sides (dataclass AST vs schema JSON, loader AST vs
+schema JSON, registry text vs dashboards/docs) on every lint run.
+
+* **TPL101** — schema ↔ dataclass drift: every contract property must
+  be a dataclass field and vice versa, with compatible types.
+* **TPL102** — required-emission drift: a *required* contract property
+  must be emitted unconditionally by the dataclass's ``to_dict``;
+  an omit-when-falsy emission of a required key produces payloads the
+  contract rejects.
+* **TPL140** — config drift: every key in the toolkit-config schema
+  must be read by ``toolkitcfg.py`` (dataclass field + merge-section
+  read + ``to_dict`` emission) and vice versa.
+* **TPL150** — metrics drift: every series registered in
+  ``AgentMetrics`` must be referenced by a dashboard or a doc
+  (formerly ``tools/metrics_drift_check.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Any, Iterable
+
+from tpuslo.analysis.core import Finding, RepoContext, Rule
+
+_TYPES_REL = "tpuslo/schema/types.py"
+_CFG_REL = "tpuslo/config/toolkitcfg.py"
+_REGISTRY_REL = "tpuslo/metrics/registry.py"
+
+#: dataclass name -> (schema file, JSON-pointer-ish path to its
+#: (sub)schema inside that file).  Nested envelope types are checked
+#: against the exact subschema their parent embeds.
+SCHEMA_BINDINGS: dict[str, tuple[str, tuple[str, ...]]] = {
+    "SLOEvent": ("tpuslo/schema/contracts/v1/slo-event.schema.json", ()),
+    "IncidentAttribution": (
+        "tpuslo/schema/contracts/v1/incident-attribution.schema.json",
+        (),
+    ),
+    "Evidence": (
+        "tpuslo/schema/contracts/v1/incident-attribution.schema.json",
+        ("properties", "evidence", "items"),
+    ),
+    "SLOImpact": (
+        "tpuslo/schema/contracts/v1/incident-attribution.schema.json",
+        ("properties", "slo_impact"),
+    ),
+    "FaultHypothesis": (
+        "tpuslo/schema/contracts/v1/incident-attribution.schema.json",
+        ("properties", "fault_hypotheses", "items"),
+    ),
+    "ProbeEventV1": (
+        "tpuslo/schema/contracts/v1alpha1/probe-event.schema.json",
+        (),
+    ),
+    "ConnTuple": (
+        "tpuslo/schema/contracts/v1alpha1/probe-event.schema.json",
+        ("properties", "conn_tuple"),
+    ),
+    "TPURef": (
+        "tpuslo/schema/contracts/v1alpha1/probe-event.schema.json",
+        ("properties", "tpu"),
+    ),
+}
+
+#: Python annotation (normalized) -> acceptable JSON-schema type names.
+_PY_TO_JSON: dict[str, frozenset[str]] = {
+    "str": frozenset({"string"}),
+    "int": frozenset({"integer", "number"}),
+    "float": frozenset({"number"}),
+    "bool": frozenset({"boolean"}),
+    "datetime": frozenset({"string"}),  # rfc3339-serialized
+}
+
+
+@dataclass(slots=True)
+class _Field:
+    name: str
+    annotation: str
+    has_default: bool
+    lineno: int
+
+
+def _dataclass_fields(node: ast.ClassDef) -> list[_Field]:
+    fields: list[_Field] = []
+    for stmt in node.body:
+        if isinstance(stmt, ast.AnnAssign) and isinstance(
+            stmt.target, ast.Name
+        ):
+            if isinstance(stmt.annotation, ast.Constant):
+                annotation = str(stmt.annotation.value)
+            else:
+                annotation = ast.unparse(stmt.annotation)
+            fields.append(
+                _Field(
+                    stmt.target.id,
+                    annotation,
+                    stmt.value is not None,
+                    stmt.lineno,
+                )
+            )
+    return fields
+
+
+def _normalize_annotation(annotation: str) -> str:
+    out = annotation.replace('"', "").replace("'", "").strip()
+    for suffix in (" | None", "| None"):
+        if out.endswith(suffix):
+            out = out[: -len(suffix)].strip()
+    return out
+
+
+def _json_types_for(annotation: str) -> frozenset[str] | None:
+    """Acceptable JSON types for a field annotation; None = unchecked."""
+    norm = _normalize_annotation(annotation)
+    if norm in _PY_TO_JSON:
+        return _PY_TO_JSON[norm]
+    if norm.startswith(("dict[", "Dict[")) or norm == "dict":
+        return frozenset({"object"})
+    if norm.startswith(("list[", "List[")) or norm == "list":
+        return frozenset({"array"})
+    if norm in SCHEMA_BINDINGS:  # nested envelope dataclass
+        return frozenset({"object"})
+    return None  # Any / unknown: no claim
+
+
+def _unconditional_to_dict_keys(cls_node: ast.ClassDef) -> set[str] | None:
+    """Keys ``to_dict`` emits on every call; None = cannot analyze.
+
+    Unconditional means: a string key of a dict literal assigned or
+    returned at the *top level* of ``to_dict`` (not nested under an
+    ``if``), or a top-level ``out["key"] = ...`` store.  A
+    ``dataclasses.asdict(self)`` body emits every field.
+    """
+    to_dict = next(
+        (
+            stmt
+            for stmt in cls_node.body
+            if isinstance(stmt, ast.FunctionDef) and stmt.name == "to_dict"
+        ),
+        None,
+    )
+    if to_dict is None:
+        return None
+    for sub in ast.walk(to_dict):
+        if (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr == "asdict"
+        ) or (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Name)
+            and sub.func.id == "asdict"
+        ):
+            return {f.name for f in _dataclass_fields(cls_node)}
+    keys: set[str] = set()
+
+    def dict_keys(node: ast.expr) -> None:
+        if isinstance(node, ast.Dict):
+            for key in node.keys:
+                if isinstance(key, ast.Constant) and isinstance(
+                    key.value, str
+                ):
+                    keys.add(key.value)
+
+    for stmt in to_dict.body:  # top level only: ifs are conditional
+        if isinstance(stmt, ast.Assign):
+            dict_keys(stmt.value)
+            for target in stmt.targets:
+                if isinstance(target, ast.Subscript) and isinstance(
+                    target.slice, ast.Constant
+                ):
+                    if isinstance(target.slice.value, str):
+                        keys.add(target.slice.value)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            dict_keys(stmt.value)
+        elif isinstance(stmt, ast.Return) and stmt.value is not None:
+            dict_keys(stmt.value)
+    return keys
+
+
+def _walk_pointer(schema: Any, pointer: tuple[str, ...]) -> Any | None:
+    node = schema
+    for part in pointer:
+        if not isinstance(node, dict):
+            return None
+        node = node.get(part)
+    return node if isinstance(node, dict) else None
+
+
+class SchemaDriftRule(Rule):
+    code = "TPL101"
+    codes = ("TPL101", "TPL102")
+    repo_anchors = (_TYPES_REL,)
+    name = "schema-drift"
+    rationale = (
+        "the dataclasses in tpuslo/schema/types.py and the JSON "
+        "contracts under tpuslo/schema/contracts/ must agree in both "
+        "directions"
+    )
+
+    def check_repo(self, repo: RepoContext) -> Iterable[Finding]:
+        ctx = repo.by_rel.get(_TYPES_REL)
+        if ctx is None or ctx.tree is None:
+            return ()
+        findings: list[Finding] = []
+        class_nodes = {
+            node.name: node
+            for node in ast.walk(ctx.tree)
+            if isinstance(node, ast.ClassDef)
+        }
+        schema_cache: dict[str, Any] = {}
+        for cls_name, (schema_rel, pointer) in SCHEMA_BINDINGS.items():
+            cls_node = class_nodes.get(cls_name)
+            if cls_node is None:
+                findings.append(
+                    Finding(
+                        _TYPES_REL,
+                        1,
+                        "TPL101",
+                        f"contract-bound dataclass {cls_name} missing "
+                        f"from {_TYPES_REL} (bound to {schema_rel})",
+                    )
+                )
+                continue
+            if schema_rel not in schema_cache:
+                schema_cache[schema_rel] = repo.read_json(schema_rel)
+            schema = schema_cache[schema_rel]
+            if schema is None:
+                findings.append(
+                    Finding(
+                        _TYPES_REL,
+                        cls_node.lineno,
+                        "TPL101",
+                        f"contract {schema_rel} for {cls_name} is "
+                        "missing or invalid JSON",
+                    )
+                )
+                continue
+            sub = _walk_pointer(schema, pointer)
+            if sub is None:
+                findings.append(
+                    Finding(
+                        _TYPES_REL,
+                        cls_node.lineno,
+                        "TPL101",
+                        f"subschema {'/'.join(pointer) or '<root>'} for "
+                        f"{cls_name} not found in {schema_rel}",
+                    )
+                )
+                continue
+            findings.extend(self._check_class(cls_name, cls_node, sub))
+        return findings
+
+    @staticmethod
+    def _check_class(
+        cls_name: str, cls_node: ast.ClassDef, schema: dict
+    ) -> list[Finding]:
+        findings: list[Finding] = []
+        properties: dict = schema.get("properties") or {}
+        required = set(schema.get("required") or ())
+        fields = _dataclass_fields(cls_node)
+        by_name = {f.name: f for f in fields}
+
+        for prop in sorted(properties):
+            if prop not in by_name:
+                findings.append(
+                    Finding(
+                        _TYPES_REL,
+                        cls_node.lineno,
+                        "TPL101",
+                        f"contract property {prop!r} has no field on "
+                        f"{cls_name}",
+                    )
+                )
+        for f in fields:
+            if f.name not in properties:
+                findings.append(
+                    Finding(
+                        _TYPES_REL,
+                        f.lineno,
+                        "TPL101",
+                        f"{cls_name}.{f.name} is not a property of its "
+                        "contract (extend the schema before the field)",
+                    )
+                )
+                continue
+            expected = _json_types_for(f.annotation)
+            if expected is None:
+                continue
+            declared = properties[f.name].get("type")
+            declared_set = (
+                {declared}
+                if isinstance(declared, str)
+                else set(declared or ())
+            )
+            declared_set.discard("null")
+            if declared_set and not declared_set & expected:
+                findings.append(
+                    Finding(
+                        _TYPES_REL,
+                        f.lineno,
+                        "TPL101",
+                        f"{cls_name}.{f.name}: annotation "
+                        f"{f.annotation!r} is incompatible with contract "
+                        f"type {sorted(declared_set)}",
+                    )
+                )
+
+        emitted = _unconditional_to_dict_keys(cls_node)
+        if emitted is not None:
+            for prop in sorted(required):
+                if prop in by_name and prop not in emitted:
+                    findings.append(
+                        Finding(
+                            _TYPES_REL,
+                            by_name[prop].lineno,
+                            "TPL102",
+                            f"{cls_name}.{prop} is required by the "
+                            "contract but to_dict emits it conditionally "
+                            "(payload can fail validation)",
+                        )
+                    )
+        return findings
+
+
+# --- TPL140: config drift ------------------------------------------------
+
+_SPECIAL_TOP_LEVEL = {"apiVersion", "kind", "signal_set"}
+
+
+class ConfigDriftRule(Rule):
+    code = "TPL140"
+    codes = ("TPL140",)
+    repo_anchors = (_CFG_REL,)
+    name = "config-drift"
+    rationale = (
+        "every key in the v1alpha1 toolkit-config schema must be read "
+        "by toolkitcfg.py and vice versa"
+    )
+
+    def check_repo(self, repo: RepoContext) -> Iterable[Finding]:
+        ctx = repo.by_rel.get(_CFG_REL)
+        if ctx is None or ctx.tree is None:
+            return ()
+        schema = repo.read_json(
+            "tpuslo/schema/contracts/v1alpha1/toolkit-config.schema.json"
+        )
+        if schema is None:
+            return (
+                Finding(
+                    _CFG_REL,
+                    1,
+                    "TPL140",
+                    "toolkit-config schema missing or invalid JSON",
+                ),
+            )
+        findings: list[Finding] = []
+        top_props: dict = schema.get("properties") or {}
+
+        class_nodes = {
+            n.name: n
+            for n in ast.walk(ctx.tree)
+            if isinstance(n, ast.ClassDef)
+        }
+        toolkit = class_nodes.get("ToolkitConfig")
+        if toolkit is None:
+            return (
+                Finding(_CFG_REL, 1, "TPL140", "ToolkitConfig not found"),
+            )
+        #: section name -> section dataclass fields
+        section_fields: dict[str, dict[str, _Field]] = {}
+        section_lines: dict[str, int] = {}
+        for f in _dataclass_fields(toolkit):
+            norm = _normalize_annotation(f.annotation)
+            section_cls = class_nodes.get(norm)
+            if section_cls is not None and norm.endswith("Config"):
+                section_fields[f.name] = {
+                    sf.name: sf for sf in _dataclass_fields(section_cls)
+                }
+                section_lines[f.name] = section_cls.lineno
+
+        merge_keys = self._merge_section_keys(ctx.tree)
+        to_dict_keys = self._to_dict_section_keys(toolkit)
+
+        # Schema sections <-> loader sections.
+        for section, prop in sorted(top_props.items()):
+            if section in _SPECIAL_TOP_LEVEL:
+                continue
+            keys = set((prop.get("properties") or {}))
+            fields = section_fields.get(section)
+            if fields is None:
+                findings.append(
+                    Finding(
+                        _CFG_REL,
+                        toolkit.lineno,
+                        "TPL140",
+                        f"schema section {section!r} has no dataclass "
+                        "field on ToolkitConfig",
+                    )
+                )
+                continue
+            line = section_lines.get(section, toolkit.lineno)
+            for key in sorted(keys - set(fields)):
+                findings.append(
+                    Finding(
+                        _CFG_REL,
+                        line,
+                        "TPL140",
+                        f"schema key {section}.{key} is not a field of "
+                        "its config dataclass (never loaded)",
+                    )
+                )
+            for key in sorted(set(fields) - keys):
+                findings.append(
+                    Finding(
+                        _CFG_REL,
+                        fields[key].lineno,
+                        "TPL140",
+                        f"config field {section}.{key} is absent from "
+                        "the toolkit-config schema (never validated)",
+                    )
+                )
+            read = merge_keys.get(section)
+            if read is not None:
+                for key in sorted(keys - read):
+                    findings.append(
+                        Finding(
+                            _CFG_REL,
+                            line,
+                            "TPL140",
+                            f"schema key {section}.{key} is not read by "
+                            "load_config's merge for that section",
+                        )
+                    )
+            emitted = to_dict_keys.get(section)
+            if emitted is not None:
+                for key in sorted(keys - emitted):
+                    findings.append(
+                        Finding(
+                            _CFG_REL,
+                            line,
+                            "TPL140",
+                            f"schema key {section}.{key} is not emitted "
+                            "by ToolkitConfig.to_dict",
+                        )
+                    )
+        for section in sorted(set(section_fields) - set(top_props)):
+            findings.append(
+                Finding(
+                    _CFG_REL,
+                    section_lines.get(section, toolkit.lineno),
+                    "TPL140",
+                    f"config section {section!r} is absent from the "
+                    "toolkit-config schema",
+                )
+            )
+        return findings
+
+    @staticmethod
+    def _merge_section_keys(tree: ast.Module) -> dict[str, set[str]]:
+        """Section -> keys passed to ``_merge_section(cfg.<s>, .., {..})``."""
+        out: dict[str, set[str]] = {}
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "_merge_section"
+                and len(node.args) >= 3
+            ):
+                continue
+            target = node.args[0]
+            if not (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "cfg"
+            ):
+                continue
+            keys_arg = node.args[2]
+            if isinstance(keys_arg, ast.Dict):
+                out.setdefault(target.attr, set()).update(
+                    k.value
+                    for k in keys_arg.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                )
+        return out
+
+    @staticmethod
+    def _to_dict_section_keys(
+        toolkit: ast.ClassDef,
+    ) -> dict[str, set[str]]:
+        to_dict = next(
+            (
+                stmt
+                for stmt in toolkit.body
+                if isinstance(stmt, ast.FunctionDef)
+                and stmt.name == "to_dict"
+            ),
+            None,
+        )
+        if to_dict is None:
+            return {}
+        out: dict[str, set[str]] = {}
+        for node in ast.walk(to_dict):
+            if not isinstance(node, ast.Dict):
+                continue
+            for key, value in zip(node.keys, node.values):
+                if (
+                    isinstance(key, ast.Constant)
+                    and isinstance(key.value, str)
+                    and isinstance(value, ast.Dict)
+                ):
+                    out[key.value] = {
+                        k.value
+                        for k in value.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)
+                    }
+        return out
+
+
+# --- TPL150: metrics drift -----------------------------------------------
+
+_METRIC_NAME_RE = re.compile(r'"(llm_(?:slo|tpu)_[a-z0-9_]+)"')
+
+
+class MetricsDriftRule(Rule):
+    code = "TPL150"
+    codes = ("TPL150",)
+    repo_anchors = (_REGISTRY_REL,)
+    name = "metrics-drift"
+    rationale = (
+        "every AgentMetrics series must be referenced by a dashboard "
+        "or a doc — an unobservable series is a silent gap"
+    )
+
+    def check_repo(self, repo: RepoContext) -> Iterable[Finding]:
+        registry = repo.read_text(_REGISTRY_REL)
+        if registry is None:
+            return ()
+        series: dict[str, int] = {}
+        for lineno, line in enumerate(registry.splitlines(), start=1):
+            for name in _METRIC_NAME_RE.findall(line):
+                series.setdefault(name, lineno)
+        if not series:
+            return (
+                Finding(
+                    _REGISTRY_REL,
+                    1,
+                    "TPL150",
+                    "no metric names found — did the registry move?",
+                ),
+            )
+        chunks: list[str] = []
+        for _, text in repo.glob_text("dashboards/*.json"):
+            chunks.append(text)
+        # generate.py is the dashboards' source of truth; a panel
+        # defined there counts even before the JSON is regenerated.
+        gen = repo.read_text("dashboards/generate.py")
+        if gen is not None:
+            chunks.append(gen)
+        for _, text in repo.glob_text("docs/**/*.md"):
+            chunks.append(text)
+        corpus = "\n".join(chunks)
+        return [
+            Finding(
+                _REGISTRY_REL,
+                lineno,
+                "TPL150",
+                f"series {name} is referenced by no dashboard or doc "
+                "(add a panel in dashboards/generate.py, a runbook "
+                "reference, or delete the series)",
+            )
+            for name, lineno in sorted(series.items())
+            if name not in corpus
+        ]

@@ -1,0 +1,116 @@
+"""TPL130: exception discipline inside the agent plane.
+
+PRs 2–5 built the agent's error accounting on one contract: every
+failure is *counted somewhere* — a metrics counter, a dead-letter
+file, a quarantine dir, a log line — or it propagates.  A broad
+``except Exception`` whose body does nothing silently erases a failure
+class from every dashboard and every chaos sweep; the crash harness
+can then no longer distinguish "handled" from "lost".
+
+The rule flags ``except Exception`` / ``except BaseException`` / bare
+``except`` handlers in agent-plane modules whose body performs no
+action at all (only ``pass``/``...``/``continue``/``break``/bare or
+constant ``return``).  Any call, assignment, or raise counts as
+routing the failure somewhere.  Narrowing the exception type
+(``except OSError``) also satisfies the rule — an anticipated, typed
+miss is a decision; a swallowed ``Exception`` is a blind spot.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tpuslo.analysis.core import FileContext, Finding, Rule
+
+#: The agent plane: the modules whose failures feed the loss-accounting
+#: contract.  Research/serving code (models/, benchmark/, ops/,
+#: parallel/) is exempt — best-effort probing of optional backends is
+#: its normal mode.
+AGENT_PLANE_PREFIXES = (
+    "tpuslo/cli/",
+    "tpuslo/delivery/",
+    "tpuslo/ingest/",
+    "tpuslo/obs/",
+    "tpuslo/runtime/",
+    "tpuslo/collector/",
+    "tpuslo/safety/",
+    "tpuslo/metrics/",
+    "tpuslo/signals/",
+    "tpuslo/correlation/",
+    "tpuslo/attribution/",
+    "tpuslo/webhook/",
+    "tpuslo/chaos/",
+    "tpuslo/schema/",
+    "tpuslo/config/",
+    "tpuslo/utils/",
+    "tpuslo/otel/",
+    "tpuslo/slo/",
+    "tpuslo/releasegate/",
+    "tpuslo/cdgate/",
+    "tpuslo/faultreplay/",
+    "tpuslo/prereq/",
+)
+
+_BROAD = frozenset({"Exception", "BaseException"})
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    node = handler.type
+    if node is None:
+        return True
+    if isinstance(node, ast.Name):
+        return node.id in _BROAD
+    if isinstance(node, ast.Tuple):
+        return any(
+            isinstance(e, ast.Name) and e.id in _BROAD for e in node.elts
+        )
+    return False
+
+
+def _is_silent(body: list[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring / ellipsis
+        if isinstance(stmt, (ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Return) and (
+            stmt.value is None or isinstance(stmt.value, ast.Constant)
+        ):
+            continue
+        return False
+    return True
+
+
+class ExceptionDisciplineRule(Rule):
+    code = "TPL130"
+    codes = ("TPL130",)
+    name = "exception-discipline"
+    rationale = (
+        "agent-plane failures must be counted, routed, or re-raised — "
+        "never silently swallowed"
+    )
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.tree is None or not ctx.rel.startswith(
+            AGENT_PLANE_PREFIXES
+        ):
+            return ()
+        findings: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if _is_broad(node) and _is_silent(node.body):
+                findings.append(
+                    Finding(
+                        ctx.rel,
+                        node.lineno,
+                        "TPL130",
+                        "broad except silently swallows the failure: "
+                        "re-raise, count it, or route it to a "
+                        "dead-letter/quarantine path (or narrow the type)",
+                    )
+                )
+        return findings

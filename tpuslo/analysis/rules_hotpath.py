@@ -1,0 +1,195 @@
+"""TPL120/TPL121: hot-path purity, driven by the hotpaths manifest.
+
+See :mod:`tpuslo.analysis.hotpaths` for what is registered and why.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tpuslo.analysis.core import Finding, RepoContext, Rule
+from tpuslo.analysis.hotpaths import HOT_DATACLASSES, HOT_FUNCTIONS
+
+_LOGGER_NAMES = frozenset({"logger", "log", "LOGGER", "LOG", "_LOG", "_LOGGER"})
+_LOGGER_METHODS = frozenset(
+    {"debug", "info", "warning", "warn", "error", "exception", "critical"}
+)
+
+
+def _forbidden_call(node: ast.Call) -> str | None:
+    """Human-readable name of a banned hot-path call, or None."""
+    func = node.func
+    if isinstance(func, ast.Name):
+        if func.id == "print":
+            return "print"
+        if func.id == "deepcopy":
+            return "deepcopy"
+        if func.id == "urandom":
+            return "urandom"
+        return None
+    if not isinstance(func, ast.Attribute):
+        return None
+    owner = func.value
+    if not isinstance(owner, ast.Name):
+        return None
+    base, attr = owner.id, func.attr
+    if base == "json" and attr in ("dumps", "dump"):
+        return f"json.{attr}"
+    if base == "copy" and attr == "deepcopy":
+        return "copy.deepcopy"
+    if base == "time" and attr in ("time", "time_ns"):
+        return f"time.{attr}"
+    if base == "os" and attr == "urandom":
+        return "os.urandom"
+    if base == "logging":
+        return f"logging.{attr}"
+    if base in _LOGGER_NAMES and attr in _LOGGER_METHODS:
+        return f"{base}.{attr}"
+    return None
+
+
+def _function_index(
+    tree: ast.Module,
+) -> dict[str, ast.FunctionDef | ast.AsyncFunctionDef]:
+    """Map ``qualname`` (``func`` or ``Class.method``) -> def node."""
+    index: dict[str, ast.FunctionDef | ast.AsyncFunctionDef] = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            index[node.name] = node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    index[f"{node.name}.{sub.name}"] = sub
+    return index
+
+
+def _dataclass_has_slots(node: ast.ClassDef) -> bool:
+    for deco in node.decorator_list:
+        if isinstance(deco, ast.Call):
+            for kw in deco.keywords:
+                if (
+                    kw.arg == "slots"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True
+                ):
+                    return True
+    # __slots__ declared in the class body also satisfies the contract
+    # (plain classes on the hot path use it directly).
+    for stmt in node.body:
+        if isinstance(stmt, ast.Assign):
+            for target in stmt.targets:
+                if isinstance(target, ast.Name) and target.id == "__slots__":
+                    return True
+    return False
+
+
+_MANIFEST_REL = "tpuslo/analysis/hotpaths.py"
+
+
+class HotPathPurityRule(Rule):
+    code = "TPL120"
+    codes = ("TPL120", "TPL121")
+    #: Manifest files are loaded on every run (incl. git-scoped), so a
+    #: deleted or renamed hot-path module is a finding, never a skip.
+    repo_anchors = tuple(
+        sorted(
+            {rel for rel, _ in HOT_FUNCTIONS}
+            | {rel for rel, _ in HOT_DATACLASSES}
+        )
+    )
+    name = "hot-path-purity"
+    rationale = (
+        "manifest-registered hot functions must stay free of known "
+        "per-event poisons and allocate only slotted dataclasses"
+    )
+
+    def check_repo(self, repo: RepoContext) -> Iterable[Finding]:
+        if not (repo.root / _MANIFEST_REL).exists():
+            # The manifest governs the repo that contains it; on a
+            # foreign root (fixture trees) there is nothing to enforce.
+            return ()
+        findings: list[Finding] = []
+        for rel, qualname in HOT_FUNCTIONS:
+            ctx = repo.by_rel.get(rel)
+            if ctx is None or ctx.tree is None:
+                findings.append(
+                    Finding(
+                        _MANIFEST_REL,
+                        1,
+                        "TPL120",
+                        f"manifest entry {rel}:{qualname} points at a "
+                        "missing or unparseable file — update the "
+                        "hotpaths manifest with the move",
+                    )
+                )
+                continue
+            node = _function_index(ctx.tree).get(qualname)
+            if node is None:
+                findings.append(
+                    Finding(
+                        _MANIFEST_REL,
+                        1,
+                        "TPL120",
+                        f"manifest entry {rel}:{qualname} not found — "
+                        "update the hotpaths manifest with the rename",
+                    )
+                )
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    banned = _forbidden_call(sub)
+                    if banned is not None:
+                        findings.append(
+                            Finding(
+                                rel,
+                                sub.lineno,
+                                "TPL120",
+                                f"hot path {qualname} calls {banned} "
+                                "(per-event cost; see docs/hot-path.md)",
+                            )
+                        )
+        for rel, clsname in HOT_DATACLASSES:
+            ctx = repo.by_rel.get(rel)
+            if ctx is None or ctx.tree is None:
+                findings.append(
+                    Finding(
+                        _MANIFEST_REL,
+                        1,
+                        "TPL121",
+                        f"manifest dataclass {rel}:{clsname} points at "
+                        "a missing or unparseable file — update the "
+                        "hotpaths manifest with the move",
+                    )
+                )
+                continue
+            cls_node = next(
+                (
+                    n
+                    for n in ast.walk(ctx.tree)
+                    if isinstance(n, ast.ClassDef) and n.name == clsname
+                ),
+                None,
+            )
+            if cls_node is None:
+                findings.append(
+                    Finding(
+                        _MANIFEST_REL,
+                        1,
+                        "TPL121",
+                        f"manifest dataclass {rel}:{clsname} not found — "
+                        "update the hotpaths manifest with the rename",
+                    )
+                )
+                continue
+            if not _dataclass_has_slots(cls_node):
+                findings.append(
+                    Finding(
+                        rel,
+                        cls_node.lineno,
+                        "TPL121",
+                        f"hot-path dataclass {clsname} must declare "
+                        "slots (per-event __dict__ allocation)",
+                    )
+                )
+        return findings

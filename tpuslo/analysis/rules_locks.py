@@ -1,0 +1,492 @@
+"""TPL110/TPL111: lock discipline for the agent plane's threaded classes.
+
+The delivery channel, breaker, spool, metrics registry, and generator
+all share mutable state between the agent loop and worker threads
+behind ad-hoc ``threading.Lock``/``RLock`` instances.  Two invariants
+are machine-checked here:
+
+* **TPL110 — unguarded write.**  For every class that creates a lock,
+  an attribute that is *ever* written under ``with self._lock`` (or
+  inside a ``*_locked``-suffixed method, the repo's held-by-contract
+  naming convention) is considered lock-protected; any write to it
+  outside a lock context is a data race waiting for a scheduler to
+  find it.  ``__init__`` is exempt — construction happens-before
+  publication of ``self``.
+
+* **TPL111 — lock-order cycle.**  A static acquisition graph is built
+  across methods and classes: holding lock A while (transitively,
+  through self-calls and calls on members whose class is known to own
+  locks) acquiring lock B adds edge A→B.  A cycle in the graph is a
+  potential AB/BA deadlock; a self-edge on a non-reentrant ``Lock``
+  is a guaranteed one.  The dynamic counterpart is
+  ``tpuslo.analysis.racecheck``, which checks the orders that actually
+  execute.
+
+``threading.Condition(self._lock)`` aliases the condition attribute to
+the wrapped lock, so ``with self._cond`` counts as holding
+``self._lock`` (they are the same underlying lock).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Iterable
+
+from tpuslo.analysis.core import FileContext, Finding, RepoContext, Rule
+
+#: Only toolkit code is in scope — tests construct ad-hoc lock fixtures
+#: that would drown the signal.
+_SCOPE_PREFIX = "tpuslo/"
+
+_LOCK_FACTORIES = {"Lock", "RLock"}
+
+
+@dataclass(slots=True)
+class _AttrWrite:
+    attr: str
+    lineno: int
+    held: tuple[str, ...]  # canonical lock attrs held at the write
+
+
+@dataclass(slots=True)
+class _Acquire:
+    lock: str  # canonical own-lock attr
+    lineno: int
+
+
+@dataclass(slots=True)
+class _HeldCall:
+    held_lock: str  # canonical own-lock attr held at the call site
+    lineno: int
+    #: ("self", method) or ("member", attr, method)
+    target: tuple[str, ...]
+
+
+@dataclass(slots=True)
+class _MethodInfo:
+    name: str
+    direct_acquires: list[_Acquire] = field(default_factory=list)
+    held_calls: list[_HeldCall] = field(default_factory=list)
+    #: plain self-calls made while holding nothing (for transitive
+    #: acquisition resolution)
+    plain_self_calls: list[str] = field(default_factory=list)
+
+
+@dataclass
+class _ClassInfo:
+    rel: str
+    name: str
+    lineno: int
+    #: canonical lock attr -> "Lock" | "RLock"
+    locks: dict[str, str] = field(default_factory=dict)
+    #: alias attr (Condition wrapper) -> canonical lock attr
+    aliases: dict[str, str] = field(default_factory=dict)
+    writes: list[_AttrWrite] = field(default_factory=list)
+    methods: dict[str, _MethodInfo] = field(default_factory=dict)
+    #: member attr -> class name it is constructed from (``self._spool =
+    #: DiskSpool(...)``) for cross-class edges
+    member_classes: dict[str, str] = field(default_factory=dict)
+
+    def canonical(self, attr: str) -> str | None:
+        if attr in self.locks:
+            return attr
+        return self.aliases.get(attr)
+
+
+def _self_attr(node: ast.expr) -> str | None:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _written_attr(target: ast.expr) -> str | None:
+    """Attribute name written by an assignment target.
+
+    ``self.x = ...`` and ``self.x[...] = ...`` / ``self.x[...] += ...``
+    both count as writes to ``x`` — mutating a lock-protected dict's
+    slots races exactly like rebinding the attribute.
+    """
+    attr = _self_attr(target)
+    if attr is not None:
+        return attr
+    if isinstance(target, ast.Subscript):
+        return _self_attr(target.value)
+    return None
+
+
+def _is_lock_ctor(node: ast.expr) -> str | None:
+    """'Lock'/'RLock' when node is ``threading.Lock()``-style call."""
+    if not isinstance(node, ast.Call):
+        return None
+    func = node.func
+    if (
+        isinstance(func, ast.Attribute)
+        and isinstance(func.value, ast.Name)
+        and func.value.id == "threading"
+        and func.attr in _LOCK_FACTORIES
+    ):
+        return func.attr
+    return None
+
+
+def _is_condition_ctor(node: ast.expr) -> ast.Call | None:
+    if not isinstance(node, ast.Call):
+        return None
+    func = node.func
+    if (
+        isinstance(func, ast.Attribute)
+        and isinstance(func.value, ast.Name)
+        and func.value.id == "threading"
+        and func.attr == "Condition"
+    ):
+        return node
+    return None
+
+
+class _MethodScanner(ast.NodeVisitor):
+    """One pass over a method body tracking the held-lock stack."""
+
+    def __init__(self, cls: _ClassInfo, method: _MethodInfo, in_init: bool):
+        self.cls = cls
+        self.method = method
+        self.in_init = in_init
+        self.held: list[str] = []
+        if not in_init and method.name.endswith("_locked"):
+            # Held-by-contract: *_locked methods run with the class's
+            # (single) lock held; multi-lock classes are left alone —
+            # the convention cannot name which lock is meant.
+            if len(cls.locks) == 1:
+                self.held.append(next(iter(cls.locks)))
+
+    # --- lock/alias discovery ------------------------------------------
+
+    def _scan_assign_value(self, attr: str, value: ast.expr) -> None:
+        kind = _is_lock_ctor(value)
+        if kind is not None:
+            self.cls.locks[attr] = kind
+            return
+        cond = _is_condition_ctor(value)
+        if cond is not None:
+            if cond.args:
+                inner = _self_attr(cond.args[0])
+                if inner is not None:
+                    self.cls.aliases[attr] = inner
+                    return
+            # Bare Condition() owns a private RLock.
+            self.cls.locks[attr] = "RLock"
+            return
+        if self.in_init and isinstance(value, ast.Call):
+            func = value.func
+            if isinstance(func, ast.Name):
+                self.cls.member_classes[attr] = func.id
+            elif isinstance(func, ast.Attribute):
+                self.cls.member_classes[attr] = func.attr
+
+    # --- traversal ------------------------------------------------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for target in node.targets:
+            attr = _self_attr(target)
+            if attr is not None:
+                self._scan_assign_value(attr, node.value)
+            self._note_write(target, node.lineno)
+        self.generic_visit(node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            attr = _self_attr(node.target)
+            if attr is not None:
+                self._scan_assign_value(attr, node.value)
+            self._note_write(node.target, node.lineno)
+            self.generic_visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._note_write(node.target, node.lineno)
+        self.generic_visit(node.value)
+
+    def _note_write(self, target: ast.expr, lineno: int) -> None:
+        if self.in_init:
+            return
+        attr = _written_attr(target)
+        if attr is None or self.cls.canonical(attr) is not None:
+            return
+        self.cls.writes.append(_AttrWrite(attr, lineno, tuple(self.held)))
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired: list[str] = []
+        for item in node.items:
+            attr = _self_attr(item.context_expr)
+            if attr is None:
+                continue
+            lock = self.cls.canonical(attr)
+            if lock is None:
+                continue
+            if not self.in_init:
+                self.method.direct_acquires.append(
+                    _Acquire(lock, node.lineno)
+                )
+                if self.held:
+                    # Explicit nested acquisition: edge via a pseudo
+                    # self-call so the graph builder sees it uniformly.
+                    self.method.held_calls.append(
+                        _HeldCall(
+                            self.held[-1],
+                            node.lineno,
+                            ("lock", lock),
+                        )
+                    )
+            acquired.append(lock)
+        self.held.extend(acquired)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in acquired:
+            self.held.pop()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if not self.in_init:
+            func = node.func
+            callee = None
+            if isinstance(func, ast.Attribute):
+                owner = func.value
+                if isinstance(owner, ast.Name) and owner.id == "self":
+                    callee = ("self", func.attr)
+                else:
+                    member = _self_attr(owner)
+                    if member is not None:
+                        callee = ("member", member, func.attr)
+            if callee is not None:
+                if self.held:
+                    self.method.held_calls.append(
+                        _HeldCall(self.held[-1], node.lineno, callee)
+                    )
+                elif callee[0] == "self":
+                    self.method.plain_self_calls.append(callee[1])
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # Nested defs (closures, callbacks) may run long after the
+        # lock is released: analyze their bodies as unguarded.
+        saved = self.held
+        self.held = []
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        saved = self.held
+        self.held = []
+        self.visit(node.body)
+        self.held = saved
+
+
+def _collect_classes(files: Iterable[FileContext]) -> list[_ClassInfo]:
+    classes: list[_ClassInfo] = []
+    for ctx in files:
+        if ctx.tree is None or not ctx.rel.startswith(_SCOPE_PREFIX):
+            continue
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            cls = _ClassInfo(ctx.rel, node.name, node.lineno)
+            methods = [
+                stmt
+                for stmt in node.body
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ]
+            # Two passes: lock attrs may be created in __init__ after
+            # other methods are defined textually, and canonical-name
+            # resolution needs the full lock/alias map first.
+            for meth in methods:
+                if meth.name != "__init__":
+                    continue
+                info = cls.methods.setdefault(
+                    meth.name, _MethodInfo(meth.name)
+                )
+                scanner = _MethodScanner(cls, info, in_init=True)
+                for stmt in meth.body:
+                    scanner.visit(stmt)
+            if not cls.locks:
+                # Locks assigned outside __init__ (rare) still count.
+                for meth in methods:
+                    for sub in ast.walk(meth):
+                        if isinstance(sub, ast.Assign):
+                            for target in sub.targets:
+                                attr = _self_attr(target)
+                                if attr is None:
+                                    continue
+                                kind = _is_lock_ctor(sub.value)
+                                if kind is not None:
+                                    cls.locks[attr] = kind
+            if not cls.locks:
+                continue
+            for meth in methods:
+                if meth.name == "__init__":
+                    continue
+                info = cls.methods.setdefault(
+                    meth.name, _MethodInfo(meth.name)
+                )
+                scanner = _MethodScanner(cls, info, in_init=False)
+                for stmt in meth.body:
+                    scanner.visit(stmt)
+            classes.append(cls)
+    return classes
+
+
+class LockDisciplineRule(Rule):
+    code = "TPL110"
+    codes = ("TPL110", "TPL111")
+    #: Cross-class lock graphs need the whole toolkit tree even on
+    #: git-scoped runs (an AB edge and its BA inversion can live in
+    #: files the diff never touched).
+    repo_anchors = (_SCOPE_PREFIX,)
+    name = "lock-discipline"
+    rationale = (
+        "attributes written under a lock anywhere must always be "
+        "written under it; lock-acquisition cycles deadlock"
+    )
+
+    def check_repo(self, repo: RepoContext) -> Iterable[Finding]:
+        classes = _collect_classes(repo.files)
+        findings: list[Finding] = []
+        findings.extend(self._check_unguarded_writes(classes))
+        findings.extend(self._check_lock_graph(classes))
+        return findings
+
+    # --- TPL110 ---------------------------------------------------------
+
+    @staticmethod
+    def _check_unguarded_writes(
+        classes: list[_ClassInfo],
+    ) -> list[Finding]:
+        findings: list[Finding] = []
+        for cls in classes:
+            # *_locked-method writes count as protected via the held
+            # tuple (the scanner seeds held for single-lock classes).
+            protected: set[str] = {
+                w.attr for w in cls.writes if w.held
+            }
+            for write in cls.writes:
+                if write.attr in protected and not write.held:
+                    findings.append(
+                        Finding(
+                            cls.rel,
+                            write.lineno,
+                            "TPL110",
+                            f"{cls.name}.{write.attr} is written under "
+                            f"a lock elsewhere but written here without "
+                            f"one (data race)",
+                        )
+                    )
+        return findings
+
+    # --- TPL111 ---------------------------------------------------------
+
+    @staticmethod
+    def _check_lock_graph(classes: list[_ClassInfo]) -> list[Finding]:
+        by_name: dict[str, _ClassInfo] = {}
+        for cls in classes:
+            by_name.setdefault(cls.name, cls)
+
+        def node_id(cls: _ClassInfo, lock: str) -> str:
+            return f"{cls.name}.{lock}"
+
+        # Transitive lock acquisitions per (class, method).
+        memo: dict[tuple[str, str], set[str]] = {}
+
+        def acquires(cls: _ClassInfo, method: str, depth: int = 0) -> set[str]:
+            key = (cls.name, method)
+            if key in memo:
+                return memo[key]
+            memo[key] = set()  # cycle guard
+            info = cls.methods.get(method)
+            if info is None or depth > 6:
+                return set()
+            out = {node_id(cls, a.lock) for a in info.direct_acquires}
+            for callee in info.plain_self_calls:
+                out |= acquires(cls, callee, depth + 1)
+            for call in info.held_calls:
+                # Locks acquired under a held lock are still part of
+                # this method's transitive acquisition set.
+                out |= _callee_acquires(cls, call, depth + 1)
+            memo[key] = out
+            return out
+
+        def _callee_acquires(
+            cls: _ClassInfo, call: _HeldCall, depth: int
+        ) -> set[str]:
+            target = call.target
+            if target[0] == "lock":
+                return {node_id(cls, target[1])}
+            if target[0] == "self":
+                return acquires(cls, target[1], depth)
+            if target[0] == "member":
+                member_cls = by_name.get(
+                    cls.member_classes.get(target[1], "")
+                )
+                if member_cls is not None:
+                    return acquires(member_cls, target[2], depth)
+            return set()
+
+        edges: dict[tuple[str, str], tuple[str, int]] = {}
+        for cls in classes:
+            for info in cls.methods.values():
+                for call in info.held_calls:
+                    src = node_id(cls, call.held_lock)
+                    for dst in _callee_acquires(cls, call, 0):
+                        edges.setdefault(
+                            (src, dst), (cls.rel, call.lineno)
+                        )
+
+        findings: list[Finding] = []
+        # Self-edge on a non-reentrant Lock: guaranteed deadlock.
+        for (src, dst), (rel, lineno) in sorted(edges.items()):
+            if src == dst:
+                cls_name, lock = src.rsplit(".", 1)
+                owner = by_name.get(cls_name)
+                if owner is not None and owner.locks.get(lock) == "Lock":
+                    findings.append(
+                        Finding(
+                            rel,
+                            lineno,
+                            "TPL111",
+                            f"non-reentrant lock {src} re-acquired while "
+                            f"already held (guaranteed deadlock)",
+                        )
+                    )
+
+        # Cross-lock cycles: DFS over the digraph.
+        graph: dict[str, set[str]] = {}
+        for src, dst in edges:
+            if src != dst:
+                graph.setdefault(src, set()).add(dst)
+        reported: set[tuple[str, ...]] = set()
+        for start in sorted(graph):
+            stack = [(start, [start])]
+            while stack:
+                node, path = stack.pop()
+                for nxt in sorted(graph.get(node, ())):
+                    if nxt == start and len(path) > 1:
+                        cycle = tuple(sorted(path))
+                        if cycle in reported:
+                            continue
+                        reported.add(cycle)
+                        rel, lineno = edges[(path[-1], start)]
+                        findings.append(
+                            Finding(
+                                rel,
+                                lineno,
+                                "TPL111",
+                                "lock-order cycle (potential deadlock): "
+                                + " -> ".join(path + [start]),
+                            )
+                        )
+                    elif nxt not in path and len(path) < 8:
+                        stack.append((nxt, path + [nxt]))
+        return findings

@@ -1,0 +1,228 @@
+"""TPL001–TPL009: generic AST style/defect rules.
+
+Ported from the original ``tools/lint.py`` (tpulint v1) into the rule
+framework; the codes and semantics are unchanged so existing inline
+``# noqa: unused (name)`` annotations and developer muscle memory keep
+working.  These are the non-semantic tier — the TPL1xx rules carry the
+repo-contract knowledge.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tpuslo.analysis.core import FileContext, Finding, Rule
+
+_DUNDER_ALL = "__all__"
+
+
+class _StyleVisitor(ast.NodeVisitor):
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.findings: list[Finding] = []
+        self.imports: dict[str, int] = {}
+        self.used_names: set[str] = set()
+        self.exported: set[str] = set()
+
+    def report(self, lineno: int, code: str, message: str) -> None:
+        self.findings.append(Finding(self.ctx.rel, lineno, code, message))
+
+    # --- collection -----------------------------------------------------
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            name = alias.asname or alias.name.split(".")[0]
+            self.imports.setdefault(name, node.lineno)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "__future__":
+            return  # compiler directives, not bindings
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            name = alias.asname or alias.name
+            self.imports.setdefault(name, node.lineno)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self.used_names.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for target in node.targets:
+            if isinstance(target, ast.Name) and target.id == _DUNDER_ALL:
+                value = node.value
+                if isinstance(value, (ast.List, ast.Tuple)):
+                    for elt in value.elts:
+                        if isinstance(elt, ast.Constant) and isinstance(
+                            elt.value, str
+                        ):
+                            self.exported.add(elt.value)
+        self.generic_visit(node)
+
+    # --- per-node checks ------------------------------------------------
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self.report(node.lineno, "TPL003", "bare except:")
+        if node.name:
+            used = False
+            reraised = False
+            for child in ast.walk(ast.Module(body=node.body, type_ignores=[])):
+                if isinstance(child, ast.Name) and child.id == node.name:
+                    used = True
+                if isinstance(child, ast.Raise) and child.exc is None:
+                    reraised = True
+            if not used and not reraised:
+                self.report(
+                    node.lineno,
+                    "TPL009",
+                    f"exception bound as {node.name!r} but never used",
+                )
+        self.generic_visit(node)
+
+    def _check_defaults(
+        self, node: ast.FunctionDef | ast.AsyncFunctionDef
+    ) -> None:
+        for default in [*node.args.defaults, *node.args.kw_defaults]:
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                self.report(
+                    default.lineno,
+                    "TPL004",
+                    f"mutable default argument in {node.name}()",
+                )
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._check_defaults(node)
+        self._check_param_shadowing(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._check_defaults(node)
+        self._check_param_shadowing(node)
+        self.generic_visit(node)
+
+    def _check_param_shadowing(
+        self, node: ast.FunctionDef | ast.AsyncFunctionDef
+    ) -> None:
+        params = {
+            a.arg
+            for a in [
+                *node.args.posonlyargs,
+                *node.args.args,
+                *node.args.kwonlyargs,
+                *([node.args.vararg] if node.args.vararg else []),
+                *([node.args.kwarg] if node.args.kwarg else []),
+            ]
+        }
+        for child in node.body:
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ) and child.name in params:
+                self.report(
+                    child.lineno,
+                    "TPL008",
+                    f"inner {child.name!r} shadows parameter of {node.name}()",
+                )
+
+    def visit_JoinedStr(self, node: ast.JoinedStr) -> None:
+        if not any(isinstance(v, ast.FormattedValue) for v in node.values):
+            self.report(node.lineno, "TPL005", "f-string without placeholders")
+        self.generic_visit(node)
+
+    def visit_FormattedValue(self, node: ast.FormattedValue) -> None:
+        # Visit only the value: a format spec is itself a JoinedStr
+        # (f"{x:.2f}") and must not trip the placeholder check.
+        self.visit(node.value)
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        for op, comparator in zip(node.ops, node.comparators):
+            if (
+                isinstance(op, (ast.Eq, ast.NotEq))
+                and isinstance(comparator, ast.Constant)
+                and comparator.value is None
+            ):
+                self.report(
+                    node.lineno,
+                    "TPL006",
+                    "comparison to None with ==/!= (use is/is not)",
+                )
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        if isinstance(node.test, ast.Tuple) and node.test.elts:
+            self.report(
+                node.lineno, "TPL007", "assert on a tuple is always true"
+            )
+        self.generic_visit(node)
+
+    # --- module-level checks --------------------------------------------
+
+    def check_duplicate_defs(self, tree: ast.Module) -> None:
+        scopes: list[tuple[str, list[ast.stmt]]] = [("module", tree.body)]
+        for scope_name, body in scopes:
+            seen: dict[str, int] = {}
+            for stmt in body:
+                if isinstance(stmt, ast.ClassDef):
+                    scopes.append((stmt.name, stmt.body))
+                if isinstance(
+                    stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                ):
+                    # Decorated re-bindings (@overload, @property+setter,
+                    # @functools.singledispatch registrations) are
+                    # legitimate double bindings.
+                    if stmt.decorator_list:
+                        continue
+                    if stmt.name in seen:
+                        self.report(
+                            stmt.lineno,
+                            "TPL002",
+                            f"{stmt.name!r} already defined at line "
+                            f"{seen[stmt.name]} in {scope_name}",
+                        )
+                    seen[stmt.name] = stmt.lineno
+
+    def check_unused_imports(self) -> None:
+        is_init = self.ctx.rel.endswith("__init__.py")
+        for name, lineno in sorted(self.imports.items(), key=lambda kv: kv[1]):
+            if name.startswith("_"):
+                continue
+            if name in self.used_names or name in self.exported:
+                continue
+            if is_init:
+                # Package __init__ re-exports are the module's API even
+                # without __all__; only flag when __all__ exists and
+                # omits the name (then it is truly dead).
+                if not self.exported:
+                    continue
+            # Conftest-style side-effect imports are annotated inline.
+            if f"# noqa: unused ({name})" in self.ctx.source:
+                continue
+            self.report(lineno, "TPL001", f"unused import {name!r}")
+
+
+class StyleRules(Rule):
+    code = "TPL001"
+    codes = (
+        "TPL001",
+        "TPL002",
+        "TPL003",
+        "TPL004",
+        "TPL005",
+        "TPL006",
+        "TPL007",
+        "TPL008",
+        "TPL009",
+    )
+    name = "style"
+    rationale = "generic defect classes ported from tpulint v1"
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.tree is None:
+            return ()
+        visitor = _StyleVisitor(ctx)
+        visitor.visit(ctx.tree)
+        visitor.check_duplicate_defs(ctx.tree)
+        visitor.check_unused_imports()
+        return visitor.findings

@@ -16,6 +16,13 @@ the same state dir; the run fails unless zero torn JSONL lines are
 replayed, zero cycles are lost, zero webhook alerts duplicate, and
 the restart resumes warm from the snapshot
 (``tpuslo.chaos.crash``, evidence in docs/evidence/crash-sweep.md).
+
+``--lint`` runs the tpulint v2 analyzer (``tpuslo.analysis``) with the
+committed baseline and fails on any new finding; ``--racecheck-smoke``
+runs the threaded suites under the dynamic lock-order race detector.
+``make m5-gate`` runs both before the statistical gates, so a release
+candidate with a fresh lint finding or a lock-order inversion never
+reaches the benchmark comparison.
 """
 
 from __future__ import annotations
@@ -79,6 +86,19 @@ def build_parser() -> argparse.ArgumentParser:
         "SIGKILL the agent subprocess at seeded cycle points, restart "
         "it, and fail unless zero torn lines are replayed, zero cycles "
         "are lost, and zero webhook alerts duplicate",
+    )
+    # ---- static-analysis + racecheck gates (ISSUE 6) -----------------
+    p.add_argument(
+        "--lint",
+        action="store_true",
+        help="run the tpulint v2 analyzer (zero-delta vs the committed "
+        "baseline) instead of the statistical gates",
+    )
+    p.add_argument(
+        "--racecheck-smoke",
+        action="store_true",
+        help="run the delivery/runtime/obs suites under the dynamic "
+        "lock-order race detector (TPUSLO_RACECHECK=1)",
     )
     p.add_argument("--crash-root", default="artifacts/crash")
     p.add_argument("--crash-seeds", default="1,2,3,4,5")
@@ -270,8 +290,38 @@ def render_markdown(summary: releasegate.Summary) -> str:
     return "\n".join(lines) + "\n"
 
 
+def run_lint_gate() -> int:
+    from tpuslo.analysis.__main__ import main as lint_main
+
+    rc = lint_main([])
+    print(f"m5gate: lint {'PASS' if rc == 0 else 'FAIL'}", file=sys.stderr)
+    return rc
+
+
+def run_racecheck_gate() -> int:
+    import os
+    import subprocess
+
+    from tpuslo.analysis.racecheck import ENV_FLAG, SMOKE_SUITES
+
+    env = dict(os.environ, **{ENV_FLAG: "1"})
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", *SMOKE_SUITES, "-q"], env=env
+    )
+    print(
+        f"m5gate: racecheck-smoke "
+        f"{'PASS' if proc.returncode == 0 else 'FAIL'}",
+        file=sys.stderr,
+    )
+    return proc.returncode
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.lint:
+        return run_lint_gate()
+    if args.racecheck_smoke:
+        return run_racecheck_gate()
     if args.crash_sweep:
         return run_crash_gate(args)
     if args.chaos_sweep:

@@ -27,6 +27,13 @@ from tpuslo.collector.ringbuf import RingWriter
 # inside the hung backend for nothing.
 _DEVICE_PROBE_DEAD = False
 
+# Most recent live-probe failure reason (repr of the exception), or
+# None while probes succeed / have not run.  "No TPU / no jax" is this
+# probe's normal miss, so nothing is printed — but the reason stays
+# inspectable (tests, triage of a missing HBM signal) instead of being
+# swallowed.
+LAST_PROBE_ERROR: str | None = None
+
 
 def read_stats(path: str | None = None) -> tuple[int, int] | None:
     """Return (bytes_in_use, bytes_limit) or None."""
@@ -51,6 +58,10 @@ def read_stats(path: str | None = None) -> tuple[int, int] | None:
     box: dict[str, tuple[int, int] | None] = {"stats": None}
 
     def probe():
+        global LAST_PROBE_ERROR
+        # Reset up front so the no-stats early returns below don't
+        # leave a previous run's exception misattributed to this miss.
+        LAST_PROBE_ERROR = None
         try:
             import jax
 
@@ -65,7 +76,11 @@ def read_stats(path: str | None = None) -> tuple[int, int] | None:
             if in_use is None or not limit:
                 return
             box["stats"] = (int(in_use), int(limit))
-        except Exception:  # noqa: BLE001 — no TPU / no jax: normal miss
+        except Exception as exc:  # noqa: BLE001 — no TPU / no jax is
+            # this probe's normal miss, but the reason must not vanish:
+            # record it so a real backend bug is distinguishable from
+            # "no accelerator" when triaging a missing HBM signal.
+            LAST_PROBE_ERROR = repr(exc)
             return
 
     try:

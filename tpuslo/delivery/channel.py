@@ -156,6 +156,16 @@ class DeliveryChannel:
         with self._lock:
             return len(self._queue) + self._inflight
 
+    def _bump(self, key: str, n: int = 1) -> None:
+        """Count one stats event under the channel lock.
+
+        The worker thread and the producing loop both mutate ``stats``;
+        GIL-atomicity of dict increments is an implementation accident,
+        not a contract (tpulint TPL110 enforces the lock).
+        """
+        with self._lock:
+            self.stats[key] += n
+
     def submit(self, kind: str, payloads: list[dict]) -> None:
         """Accept one batch; never blocks on the sink.
 
@@ -164,16 +174,23 @@ class DeliveryChannel:
         """
         if not payloads:
             return
+        spill = False
         with self._cond:
             if self._closed:
                 raise RuntimeError(f"delivery channel {self.name} is closed")
             self.stats["submitted_events"] += len(payloads)
             if self._worker is not None and len(self._queue) >= self._queue_max:
-                self._spool_batch(kind, payloads)
-                return
-            self._queue.append((kind, payloads))
-            self._observer.queue_depth(len(self._queue) + self._inflight)
-            self._cond.notify()
+                spill = True
+            else:
+                self._queue.append((kind, payloads))
+                self._observer.queue_depth(len(self._queue) + self._inflight)
+                self._cond.notify()
+        if spill:
+            # Outside the lock: the spill path appends to the disk
+            # spool (its own lock) and bumps stats — doing either under
+            # self._cond would nest lock acquisitions for no benefit.
+            self._spool_batch(kind, payloads)
+            return
         if self._worker is None:
             self.pump()
 
@@ -265,14 +282,14 @@ class DeliveryChannel:
                 try:
                     self._idle_replay()
                 except Exception:  # noqa: BLE001 — worker must survive
-                    self.stats["worker_errors"] += 1
+                    self._bump("worker_errors")
                 continue
             kind, payloads = batch
             try:
                 self._process(kind, payloads)
             except Exception:  # noqa: BLE001 — a dying worker would
                 # stall delivery forever; count it and keep draining.
-                self.stats["worker_errors"] += 1
+                self._bump("worker_errors")
             finally:
                 with self._cond:
                     self._inflight -= 1
@@ -315,7 +332,7 @@ class DeliveryChannel:
                     return
                 self._breaker.record_failure()
                 attempt += 1
-                self.stats["retries"] += 1
+                self._bump("retries")
                 self._observer.retried(len(payloads))
                 if attempt >= self._max_attempts:
                     self._spool_batch(kind, payloads)
@@ -332,7 +349,7 @@ class DeliveryChannel:
                 self._dead_letter(kind, payloads, "sink_exception", repr(exc))
                 return
             self._breaker.record_success()
-            self.stats["delivered_events"] += len(payloads)
+            self._bump("delivered_events", len(payloads))
             self._observer.delivered(kind, len(payloads))
             if self._spool.pending_bytes():
                 try:
@@ -354,7 +371,7 @@ class DeliveryChannel:
             # but the loss must still be counted, not crash the worker.
             self._dead_letter(kind, payloads, "spool_error", repr(exc))
             return
-        self.stats["spooled_events"] += len(payloads)
+        self._bump("spooled_events", len(payloads))
         self._observer.spooled(kind, len(payloads))
         self._observer.spool_bytes(self._spool.pending_bytes())
 
@@ -380,8 +397,8 @@ class DeliveryChannel:
                     return  # poison: skip and keep draining
                 raise
             contacted += 1
-            self.stats["replayed_events"] += len(payloads)
-            self.stats["delivered_events"] += len(payloads)
+            self._bump("replayed_events", len(payloads))
+            self._bump("delivered_events", len(payloads))
             self._observer.replayed(len(payloads))
             self._observer.delivered(kind, len(payloads))
 
@@ -409,11 +426,11 @@ class DeliveryChannel:
                 fh.write(json.dumps(record, separators=(",", ":")) + "\n")
         except OSError:
             pass  # the counter below still records the loss
-        self.stats["dead_lettered_events"] += len(payloads)
+        self._bump("dead_lettered_events", len(payloads))
         self._observer.dead_lettered(kind, len(payloads), reason)
 
     def _on_truncate(self, batches: int) -> None:
-        self.stats["truncated_batches"] += batches
+        self._bump("truncated_batches", batches)
         self._observer.truncated(batches)
 
     # ---- introspection ------------------------------------------------

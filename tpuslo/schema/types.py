@@ -141,8 +141,10 @@ class IncidentAttribution:
     service: str
     predicted_fault_domain: str
     confidence: float
+    #: Required by the contract (tpulint TPL102): an attribution with
+    #: no burn impact is not a reportable incident.
+    slo_impact: SLOImpact
     evidence: list[Evidence] = field(default_factory=list)
-    slo_impact: SLOImpact | None = None
     namespace: str = ""
     trace_ids: list[str] = field(default_factory=list)
     request_ids: list[str] = field(default_factory=list)
@@ -161,9 +163,8 @@ class IncidentAttribution:
             "predicted_fault_domain": self.predicted_fault_domain,
             "confidence": self.confidence,
             "evidence": [e.to_dict() for e in self.evidence],
+            "slo_impact": self.slo_impact.to_dict(),
         }
-        if self.slo_impact is not None:
-            out["slo_impact"] = self.slo_impact.to_dict()
         if self.namespace:
             out["namespace"] = self.namespace
         if self.trace_ids:

@@ -59,6 +59,9 @@ def git_short_sha(cwd: str | None = None) -> str:
         )
         if proc.returncode == 0:
             return proc.stdout.strip()
-    except Exception:  # noqa: BLE001 - provenance best-effort
+    except (OSError, subprocess.SubprocessError):
+        # git missing (FileNotFoundError) or hung (TimeoutExpired) —
+        # the two ways `git rev-parse` actually fails.  Anything else
+        # should surface instead of hiding behind "unknown".
         pass
     return "unknown"

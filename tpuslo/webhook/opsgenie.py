@@ -15,7 +15,7 @@ def build_opsgenie_payload(attr: IncidentAttribution) -> bytes:
     priority = "P3"
     if attr.confidence >= 0.8:
         priority = "P2"
-    burn_rate = attr.slo_impact.burn_rate if attr.slo_impact else 0.0
+    burn_rate = attr.slo_impact.burn_rate
     if burn_rate >= 3.0:
         priority = "P1"
     evidence = "; ".join(f"{e.signal}={e.value}" for e in attr.evidence)

@@ -14,7 +14,7 @@ from tpuslo.schema import IncidentAttribution
 def build_pagerduty_payload(attr: IncidentAttribution) -> bytes:
     severity = "critical" if attr.confidence >= 0.8 else "warning"
     evidence = "; ".join(f"{e.signal}={e.value}" for e in attr.evidence)
-    burn_rate = attr.slo_impact.burn_rate if attr.slo_impact else 0.0
+    burn_rate = attr.slo_impact.burn_rate
     payload = {
         "routing_key": "",
         "event_action": "trigger",
