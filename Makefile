@@ -10,6 +10,7 @@ ARTIFACTS ?= artifacts
 	correlation-gate fault-smoke replay-smoke ebpf-smoke bench \
 	bench-smoke chaos-smoke chaos-demo chaos-telemetry-smoke \
 	chaos-telemetry-sweep crash-smoke crash-sweep obs-smoke \
+	burn-smoke burn-sweep \
 	metrics-drift m5-candidate m5-gate helm-lint dashboards clean
 
 all: native test
@@ -165,6 +166,23 @@ obs-smoke:
 metrics-drift:
 	$(PY) tools/metrics_drift_check.py
 
+# Error-budget / burn-rate engine smoke: window math, alert state
+# machine, snapshot round trips, loadgen --slo-out offline replay, and
+# the hot-path lint assertion (sloengine stays TPL120/121-clean).
+burn-smoke:
+	$(PY) -m pytest tests/test_sloengine.py tests/test_burn_sweep.py -q
+
+# Full burn-scenario release gate: seeded traffic shapes (steady /
+# fast-burn / slow-burn / latency regression / flapping /
+# tenant-isolated / kill-restart) replayed through the engine;
+# fails on any missed, spurious, late, or duplicated alert
+# (see docs/runbooks/error-budget.md).
+burn-sweep:
+	mkdir -p $(ARTIFACTS)/burn
+	$(PY) -m tpuslo m5gate --burn-sweep \
+		--summary-json $(ARTIFACTS)/burn/sweep.json \
+		--summary-md $(ARTIFACTS)/burn/sweep.md
+
 # Full crash-sweep release gate: seeds x kill points of SIGKILL/restart
 # audits (see docs/evidence/crash-sweep.md + docs/runbooks/crash-recovery.md).
 crash-sweep:
@@ -207,9 +225,10 @@ m5-candidate:
 	done
 	@echo "m5-candidate: artifacts under $(ARTIFACTS)/m5"
 
-# Release candidates fail on new lint findings or lock-order races
-# before the statistical gates even run (ISSUE 6).
-m5-gate: lint racecheck-smoke
+# Release candidates fail on new lint findings, lock-order races, or
+# burn-alert contract violations before the statistical gates even run
+# (ISSUEs 6 + 7).
+m5-gate: lint racecheck-smoke burn-smoke burn-sweep
 	$(PY) -m tpuslo m5gate --candidate-root $(ARTIFACTS)/m5 \
 		--scenarios "$(shell echo $(M5_SCENARIOS) | tr ' ' ',')" \
 		--summary-json $(ARTIFACTS)/m5/gate.json \

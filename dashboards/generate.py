@@ -226,12 +226,52 @@ agent_selfobs = dashboard(
     ],
 )
 
+error_budget = dashboard(
+    "tpuslo-error-budget",
+    "TPU SLO / Error Budget + Burn Rate",
+    [
+        # --- budget headline (tpuslo.sloengine) ----------------------
+        panel("Error budget remaining (by tenant / objective)", [
+            ('llm_slo_agent_slo_budget_remaining', "{{tenant}}/{{objective}}"),
+        ], 0, 0, unit="percentunit"),
+        panel("Burn alert state (0 ok / 1 slow_burn / 2 fast_burn)", [
+            ('llm_slo_agent_slo_alert_state', "{{tenant}}/{{objective}}"),
+        ], 12, 0),
+        # --- the two SRE burn rules ----------------------------------
+        panel("Fast-burn windows: burn rate 5m + 1h (page at 14.4x)", [
+            ('llm_slo_agent_slo_burn_rate{window="5m"}', "{{tenant}}/{{objective}} 5m"),
+            ('llm_slo_agent_slo_burn_rate{window="1h"}', "{{tenant}}/{{objective}} 1h"),
+        ], 0, 8),
+        panel("Slow-burn windows: burn rate 30m + 6h (ticket at 6x)", [
+            ('llm_slo_agent_slo_burn_rate{window="30m"}', "{{tenant}}/{{objective}} 30m"),
+            ('llm_slo_agent_slo_burn_rate{window="6h"}', "{{tenant}}/{{objective}} 6h"),
+        ], 12, 8),
+        # --- stream + alert flow -------------------------------------
+        panel("Request outcomes folded into the SLI stream (/s)", [
+            ('sum(rate(llm_slo_agent_slo_request_outcomes_total[5m])) by (tenant, status)', "{{tenant}}/{{status}}"),
+        ], 0, 16),
+        panel("Alert transitions (page / ticket / resolve)", [
+            ('sum(increase(llm_slo_agent_slo_alert_transitions_total[1h])) by (tenant, objective, severity)', "{{tenant}}/{{objective}} {{severity}}"),
+        ], 12, 16),
+        panel("Worst budget remaining (headline)", [
+            ('min(llm_slo_agent_slo_budget_remaining)', "worst budget"),
+        ], 0, 24, w=8, kind="stat", unit="percentunit"),
+        panel("Budgets currently burning", [
+            ('count(llm_slo_agent_slo_alert_state > 0) or vector(0)', "alerting"),
+        ], 8, 24, w=8, kind="stat"),
+        panel("Max burn rate (any tenant / objective / window)", [
+            ('max(llm_slo_agent_slo_burn_rate)', "max burn"),
+        ], 16, 24, w=8, kind="stat"),
+    ],
+)
+
 FILES = {
     "slo-overview.json": slo_overview,
     "tpu-kernel-correlation.json": kernel_correlation,
     "incident-lab.json": incident_lab,
     "evidence-e2e.json": evidence_e2e,
     "agent-self-observability.json": agent_selfobs,
+    "error-budget.json": error_budget,
 }
 
 if __name__ == "__main__":

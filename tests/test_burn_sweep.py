@@ -1,0 +1,130 @@
+"""Burn-scenario sweep gate tests: the seeded traffic shapes, the
+contract checks they enforce, and the m5gate CLI entry point."""
+
+import json
+
+from tpuslo.cli import m5gate
+from tpuslo.sloengine import SEVERITY_PAGE, SEVERITY_TICKET
+from tpuslo.sloengine.sweep import (
+    Phase,
+    Scenario,
+    default_scenarios,
+    run_burn_sweep,
+    run_scenario,
+    synthesize_outcomes,
+)
+
+
+class TestScenarioSynthesis:
+    def test_deterministic_per_seed(self):
+        scenario = default_scenarios()[0]
+        a = synthesize_outcomes(scenario, 7)
+        b = synthesize_outcomes(scenario, 7)
+        c = synthesize_outcomes(scenario, 8)
+        assert [o.to_dict() for o in a] == [o.to_dict() for o in b]
+        assert [o.to_dict() for o in a] != [o.to_dict() for o in c]
+
+    def test_quiet_tenants_interleaved(self):
+        scenario = next(
+            s for s in default_scenarios()
+            if s.name == "tenant_isolated"
+        )
+        outcomes = synthesize_outcomes(scenario, 1)
+        tenants = {o.tenant for o in outcomes}
+        assert tenants == {"tenant-a", "tenant-b"}
+
+    def test_expected_sets_cover_all_scenarios(self):
+        names = {s.name for s in default_scenarios()}
+        assert {
+            "steady", "fast_burn", "slow_burn", "latency_regression",
+            "flapping", "tenant_isolated", "restart_resume",
+        } <= names
+
+
+class TestSweepGate:
+    def test_full_sweep_passes(self):
+        report = run_burn_sweep(seed=1337)
+        assert report.passed, report.failures
+        by_name = {r.name: r for r in report.runs}
+        # Fast page landed at the crossing evaluation, not later.
+        fast = by_name["fast_burn"]
+        assert fast.fast_crossing_eval_s > 0
+        assert fast.fast_fired_eval_s == fast.fast_crossing_eval_s
+        # Flapping fired each severity at most once.
+        flap = by_name["flapping"]
+        severities = [f["severity"] for f in flap.fired]
+        assert severities.count(SEVERITY_PAGE) == 1
+        assert severities.count(SEVERITY_TICKET) == 1
+        # Isolation: nothing fired for the quiet tenant.
+        isolated = by_name["tenant_isolated"]
+        assert all(
+            f["tenant"] == "tenant-a" for f in isolated.fired
+        )
+
+    def test_sweep_stable_across_seeds(self):
+        for seed in (7, 42):
+            report = run_burn_sweep(seed=seed)
+            assert report.passed, (seed, report.failures)
+
+    def test_missed_alert_fails_the_gate(self):
+        # A steady shape with a bogus expectation must FAIL (recall).
+        scenario = Scenario(
+            name="expect_ghost",
+            phases=[Phase(duration_s=3600, error_rate=0.002)],
+            expected={("tenant-a", "availability", SEVERITY_PAGE)},
+        )
+        run = run_scenario(scenario, seed=1)
+        assert not run.passed
+        assert any("never fired" in f for f in run.failures)
+
+    def test_spurious_alert_fails_the_gate(self):
+        # A burning shape with an empty expectation must FAIL
+        # (precision).
+        scenario = Scenario(
+            name="unexpected_burn",
+            phases=[
+                Phase(duration_s=3600, error_rate=0.002),
+                Phase(duration_s=5400, error_rate=0.25),
+            ],
+            expected=set(),
+        )
+        run = run_scenario(scenario, seed=1)
+        assert not run.passed
+        assert any("unexpected alert" in f for f in run.failures)
+
+    def test_report_round_trips_to_json(self):
+        report = run_burn_sweep(
+            seed=1,
+            scenarios=[
+                Scenario(
+                    name="tiny",
+                    phases=[Phase(duration_s=600)],
+                    expected=set(),
+                )
+            ],
+        )
+        encoded = json.loads(json.dumps(report.to_dict()))
+        assert encoded["passed"] is True
+        assert encoded["runs"][0]["name"] == "tiny"
+
+
+class TestM5GateCLI:
+    def test_burn_sweep_mode_writes_summaries(self, tmp_path, capsys):
+        summary_json = tmp_path / "sweep.json"
+        summary_md = tmp_path / "sweep.md"
+        rc = m5gate.main(
+            [
+                "--burn-sweep",
+                "--summary-json", str(summary_json),
+                "--summary-md", str(summary_md),
+            ]
+        )
+        assert rc == 0
+        report = json.loads(summary_json.read_text())
+        assert report["passed"] is True
+        assert len(report["runs"]) == 7
+        md = summary_md.read_text()
+        assert "Error-budget burn-scenario gate" in md
+        assert "PASS" in md
+        err = capsys.readouterr().err
+        assert "burn-sweep PASS" in err

@@ -264,6 +264,78 @@ class TestWebhook:
         attr.confidence = 0.5
         assert json.loads(webhook.build_opsgenie_payload(attr))["priority"] == "P3"
 
+    def test_severity_escalates_on_fast_burn(self):
+        # A low-confidence incident still pages critical/P1 while a
+        # fast-burn budget alert is active: budget exhaustion outranks
+        # classifier certainty.
+        attr = make_attr()
+        attr.confidence = 0.5
+        attr.slo_impact.burn_rate = 1.0
+        attr.slo_burn = {
+            "evaluated_at": "2026-07-29T12:00:00Z",
+            "max_burn_rate": 25.0,
+            "alerting": [
+                {
+                    "tenant": "gold",
+                    "objective": "availability",
+                    "state": "fast_burn",
+                    "burn_rates": {"1h": 25.0, "5m": 30.0},
+                    "budget_remaining": 0.1,
+                }
+            ],
+        }
+        pd = json.loads(webhook.build_pagerduty_payload(attr))
+        assert pd["payload"]["severity"] == "critical"
+        assert pd["payload"]["custom_details"]["burning_budgets"] == [
+            "gold/availability=fast_burn"
+        ]
+        og = json.loads(webhook.build_opsgenie_payload(attr))
+        assert og["priority"] == "P1"
+        assert "gold/availability=fast_burn" in og["details"]["burning_budgets"]
+
+    def test_slow_burn_alone_does_not_escalate_pagerduty(self):
+        attr = make_attr()
+        attr.confidence = 0.5
+        attr.slo_impact.burn_rate = 1.0
+        attr.slo_burn = {
+            "alerting": [
+                {
+                    "tenant": "gold",
+                    "objective": "availability",
+                    "state": "slow_burn",
+                    "burn_rates": {"6h": 8.0, "30m": 8.0},
+                    "budget_remaining": 0.6,
+                }
+            ],
+        }
+        pd = json.loads(webhook.build_pagerduty_payload(attr))
+        assert pd["payload"]["severity"] == "warning"
+
+    def test_slo_burn_rides_generic_payload_and_contract(self):
+        from tpuslo.schema import SCHEMA_INCIDENT_ATTRIBUTION, validate
+
+        attr = make_attr()
+        attr.slo_burn = {
+            "evaluated_at": "2026-07-29T12:00:00Z",
+            "max_burn_rate": 25.0,
+            "alerting": [
+                {
+                    "tenant": "gold",
+                    "objective": "ttft",
+                    "state": "fast_burn",
+                    "burn_rates": {"1h": 25.0},
+                    "budget_remaining": 0.0,
+                }
+            ],
+        }
+        payload = attr.to_dict()
+        validate(payload, SCHEMA_INCIDENT_ATTRIBUTION)
+        assert payload["slo_burn"]["alerting"][0]["tenant"] == "gold"
+        # Absent burn context stays absent (optional field).
+        bare = make_attr().to_dict()
+        assert "slo_burn" not in bare
+        validate(bare, SCHEMA_INCIDENT_ATTRIBUTION)
+
     def test_pagerduty_format_sent_via_exporter(self, stub_server):
         exporter = webhook.Exporter(
             f"http://127.0.0.1:{stub_server.server_port}/hook",

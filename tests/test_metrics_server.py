@@ -175,3 +175,94 @@ class TestStageQuantiles:
         }
         assert samples["llm_slo_agent_cycle_ms_count"] == 1
         assert samples["llm_slo_agent_cycle_ms_sum"] == 12.5
+
+
+class TestSLOBurnSeries:
+    """The burn engine's slo_* series: registration, observer bridge,
+    and a real scrape carrying every series the error-budget dashboard
+    references."""
+
+    def test_slo_series_registered_on_agent_metrics(self):
+        metrics = AgentMetrics()
+        for attr in (
+            "slo_request_outcomes",
+            "slo_budget_remaining",
+            "slo_burn_rate",
+            "slo_alert_state",
+            "slo_alert_transitions",
+        ):
+            assert hasattr(metrics, attr)
+
+    def test_observer_bridges_engine_callbacks(self):
+        metrics = AgentMetrics()
+        observer = metrics.slo_observer()
+        observer.outcome("gold", "ok")
+        observer.outcome("gold", "ok")
+        observer.outcome("gold", "error")
+        observer.burn_rate("gold", "availability", "5m", 16.2)
+        observer.budget_remaining("gold", "availability", 0.25)
+        observer.alert_state("gold", "availability", 2)
+        observer.transition("gold", "availability", "page")
+        samples = {
+            (s.name, tuple(sorted(s.labels.items()))): s.value
+            for metric in metrics.registry.collect()
+            for s in metric.samples
+        }
+        assert samples[(
+            "llm_slo_agent_slo_request_outcomes_total",
+            (("status", "ok"), ("tenant", "gold")),
+        )] == 2
+        assert samples[(
+            "llm_slo_agent_slo_burn_rate",
+            (("objective", "availability"), ("tenant", "gold"),
+             ("window", "5m")),
+        )] == 16.2
+        assert samples[(
+            "llm_slo_agent_slo_budget_remaining",
+            (("objective", "availability"), ("tenant", "gold")),
+        )] == 0.25
+        assert samples[(
+            "llm_slo_agent_slo_alert_state",
+            (("objective", "availability"), ("tenant", "gold")),
+        )] == 2
+        assert samples[(
+            "llm_slo_agent_slo_alert_transitions_total",
+            (("objective", "availability"), ("severity", "page"),
+             ("tenant", "gold")),
+        )] == 1
+
+    def test_scrape_exposes_burn_series(self, server_env):
+        metrics, _, base = server_env
+        from tpuslo.sloengine import (
+            BurnEngine,
+            EngineConfig,
+            RequestOutcome,
+        )
+
+        engine = BurnEngine(
+            EngineConfig(), observer=metrics.slo_observer()
+        )
+        t0 = 1_700_000_000
+        for i in range(720):
+            engine.record(
+                RequestOutcome(
+                    tenant="gold",
+                    ts_unix_nano=(t0 + i * 5) * 1_000_000_000,
+                    ttft_ms=100.0,
+                    tpot_ms=30.0,
+                    tokens=64,
+                    status="error",
+                )
+            )
+        engine.evaluate(t0 + 3600)
+        status, _, body = fetch(base + "/metrics")
+        assert status == 200
+        text = body.decode()
+        for series in (
+            'llm_slo_agent_slo_request_outcomes_total{status="error",tenant="gold"}',
+            'llm_slo_agent_slo_budget_remaining{objective="availability",tenant="gold"}',
+            'llm_slo_agent_slo_burn_rate{objective="availability",tenant="gold",window="1h"}',
+            'llm_slo_agent_slo_alert_state{objective="availability",tenant="gold"} 2.0',
+            'llm_slo_agent_slo_alert_transitions_total{objective="availability",severity="page",tenant="gold"}',
+        ):
+            assert series in text, series

@@ -67,6 +67,53 @@ class TestSLOMath:
         agg = slo.aggregate([slo.Snapshot(ttft_ms=-5.0, tokens_per_s=-1.0)])
         assert agg.ttft_p50 == 0.0
 
+    def test_aggregate_is_total_on_empty(self):
+        # No caller special-casing: every percentile reads zero.
+        agg = slo.aggregate([])
+        assert agg == slo.Percentiles()
+        assert agg.ttft_p99 == 0.0
+        assert agg.retrieval_p95_ms == 0.0
+
+    def test_aggregate_single_snapshot_is_exact(self):
+        snap = slo.Snapshot(
+            ttft_ms=123.0,
+            tokens_per_s=45.0,
+            retrieval=slo.RetrievalBreakdown(5.0, 3.0, 2.0),
+        )
+        agg = slo.aggregate([snap])
+        assert agg.ttft_p50 == agg.ttft_p95 == agg.ttft_p99 == 123.0
+        assert agg.tokens_per_s_p50 == agg.tokens_per_s_p95 == 45.0
+        assert agg.retrieval_p95_ms == 10.0
+
+    def test_quantile_clamps_out_of_range_q(self):
+        values = [1.0, 2.0, 3.0]
+        assert slo.quantile(values, -0.5) == 1.0
+        assert slo.quantile(values, 1.5) == 3.0
+
+    def test_quantile_nan_free_under_ties(self):
+        import math
+
+        ties = [50.0] * 7
+        for q in (0.0, 0.25, 0.5, 0.95, 1.0):
+            value = slo.quantile(ties, q)
+            assert value == 50.0
+            assert not math.isnan(value)
+
+    def test_quantile_drops_nan_inputs(self):
+        import math
+
+        poisoned = [10.0, float("nan"), 30.0]
+        assert slo.quantile(poisoned, 0.5) == 20.0
+        assert slo.quantile([float("nan")], 0.5) == 0.0
+        agg = slo.aggregate(
+            [
+                slo.Snapshot(ttft_ms=float("nan"), tokens_per_s=1.0),
+                slo.Snapshot(ttft_ms=100.0, tokens_per_s=1.0),
+            ]
+        )
+        assert agg.ttft_p50 == 100.0
+        assert not math.isnan(agg.ttft_p99)
+
 
 class TestToolkitConfig:
     def test_defaults_validate_contract(self):
@@ -105,6 +152,58 @@ tpu:
         assert cfg.safety.max_overhead_pct == 2.5
         assert cfg.webhook.enabled and cfg.webhook.format == "pagerduty"
         assert cfg.tpu.slice_id == "v5e-8-s0"
+
+    def test_slo_section_presence_implies_on(self, tmp_path):
+        path = tmp_path / "toolkit.yaml"
+        path.write_text(
+            """
+slo:
+  availability_target: 0.995
+  ttft_objective_ms: 600
+  tenants:
+    gold:
+      availability_target: 0.999
+      ttft_objective_ms: 400
+"""
+        )
+        cfg = load_config(str(path))
+        assert cfg.slo.enabled
+        assert cfg.slo.availability_target == 0.995
+        assert cfg.slo.ttft_objective_ms == 600.0
+        assert cfg.slo.bucket_s == 10  # untouched default
+        assert cfg.slo.tenants == {
+            "gold": {
+                "availability_target": 0.999,
+                "ttft_objective_ms": 400.0,
+            }
+        }
+
+    def test_slo_explicit_disable_wins(self, tmp_path):
+        path = tmp_path / "toolkit.yaml"
+        path.write_text("slo:\n  enabled: false\n")
+        cfg = load_config(str(path))
+        assert not cfg.slo.enabled
+
+    def test_slo_absent_stays_off_and_defaults_validate(self, tmp_path):
+        path = tmp_path / "toolkit.yaml"
+        path.write_text("correlation:\n  window_ms: 1500\n")
+        cfg = load_config(str(path))
+        assert not cfg.slo.enabled
+        assert cfg.slo.fast_burn_threshold == 14.4
+        assert cfg.slo.slow_burn_threshold == 6.0
+        # Round trip: the emitted dict revalidates against the contract.
+        from tpuslo.schema import SCHEMA_TOOLKIT_CONFIG, validate
+
+        validate(cfg.to_dict(), SCHEMA_TOOLKIT_CONFIG)
+
+    def test_slo_rejects_bad_tenant_override_type(self, tmp_path):
+        path = tmp_path / "toolkit.yaml"
+        path.write_text(
+            "slo:\n  tenants:\n    gold:\n      "
+            "availability_target: not-a-number\n"
+        )
+        with pytest.raises(Exception):
+            load_config(str(path))
 
     def test_load_rejects_bad_schema(self, tmp_path):
         path = tmp_path / "bad.yaml"

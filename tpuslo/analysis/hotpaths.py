@@ -39,6 +39,12 @@ HOT_FUNCTIONS: tuple[tuple[str, str], ...] = (
     ("tpuslo/obs/tracer.py", "_StageCM.__init__"),
     ("tpuslo/obs/tracer.py", "_StageCM.__exit__"),
     ("tpuslo/obs/tracer.py", "CycleTrace.stage"),
+    # Burn-engine SLI fold (ISSUE 7): once per request outcome; ring
+    # arithmetic only — time arrives with the outcome, never from the
+    # wall clock, and windows roll forward in O(1) amortized.
+    ("tpuslo/sloengine/stream.py", "TenantWindows.record"),
+    ("tpuslo/sloengine/stream.py", "TenantWindows.roll_to"),
+    ("tpuslo/sloengine/engine.py", "BurnEngine.record"),
 )
 
 #: (repo-relative module path, dataclass name) pairs that are allocated
@@ -52,4 +58,5 @@ HOT_DATACLASSES: tuple[tuple[str, str], ...] = (
     ("tpuslo/correlation/matcher.py", "SignalRef"),
     ("tpuslo/correlation/matcher.py", "Decision"),
     ("tpuslo/correlation/matcher.py", "BatchMatch"),
+    ("tpuslo/sloengine/stream.py", "RequestOutcome"),
 )

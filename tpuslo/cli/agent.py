@@ -214,6 +214,21 @@ def build_parser() -> argparse.ArgumentParser:
         "empty = config observability.provenance_path, falling back "
         "to <state-dir>/provenance.jsonl",
     )
+    p.add_argument(
+        "--burn-engine",
+        action="store_true",
+        help="run the SLO error-budget / burn-rate engine over the "
+        "per-request SLI stream with default targets (config: slo: "
+        "section — presence implies on); burn state feeds Prometheus, "
+        "incident payloads, provenance, and `sloctl budget`",
+    )
+    p.add_argument(
+        "--tenant",
+        default="",
+        help="tenant identity stamped on this agent's request outcomes "
+        "(default: --namespace); per-tenant SLO targets come from "
+        "config slo.tenants",
+    )
     return p
 
 
@@ -250,7 +265,9 @@ def _gate_pipeline(events, chaos_stream, gate, metrics):
     return out
 
 
-def _print_stats(gate, metrics: AgentMetrics | None = None) -> None:
+def _print_stats(
+    gate, metrics: AgentMetrics | None = None, burn_engine=None
+) -> None:
     """Periodic stats line: every silent drop, made loud — and, with
     the self-tracer's histograms populated, per-stage p50/p99 so "why
     is the loop slow" is answerable from the log alone."""
@@ -262,6 +279,8 @@ def _print_stats(gate, metrics: AgentMetrics | None = None) -> None:
         parts.append(f"rejections={rejections}")
     if gate is not None:
         parts.append(f"gate={gate.snapshot()}")
+    if burn_engine is not None:
+        parts.append(f"burn={burn_engine.snapshot()}")
     if metrics is not None:
         stages = metrics.stage_quantiles()
         if stages:
@@ -408,6 +427,42 @@ def main(
             file=sys.stderr,
         )
 
+    # ---- SLO error-budget / burn-rate engine -------------------------
+    burn_engine = None
+    tenant = args.tenant or args.namespace
+    if (args.burn_engine or cfg.slo.enabled) and args.probe_source == "ring":
+        # The SLI stream comes from the synthetic loop's per-request
+        # samples; ring mode emits probe events only (SLO events come
+        # from the observed workload).  Refusing loudly beats a "burn
+        # engine on" banner over an engine that can never record.
+        print(
+            "agent: the burn engine needs the synthetic SLO loop; "
+            "ignored with --probe-source ring",
+            file=sys.stderr,
+        )
+    elif args.burn_engine or cfg.slo.enabled:
+        from tpuslo.collector.pipeline import ERROR_RATE_THRESHOLDS
+        from tpuslo.sloengine import (
+            BurnEngine,
+            EngineConfig,
+            RequestOutcome,
+        )
+
+        burn_engine = BurnEngine(
+            EngineConfig.from_toolkit(cfg.slo),
+            observer=metrics.slo_observer(),
+        )
+        print(
+            "agent: burn engine on (tenant="
+            f"{tenant}, availability>="
+            f"{burn_engine.config.availability_target:g}, "
+            f"ttft<={burn_engine.config.ttft_objective_ms:g}ms@"
+            f"{burn_engine.config.ttft_target:g}, fast "
+            f"{burn_engine.config.fast_burn_threshold:g}x/1h+5m, slow "
+            f"{burn_engine.config.slow_burn_threshold:g}x/6h+30m)",
+            file=sys.stderr,
+        )
+
     # ---- crash-safe runtime: durable snapshots + warm restore --------
     from tpuslo.runtime import AgentRuntime, StateStore
 
@@ -447,6 +502,14 @@ def main(
     )
     if gate is not None:
         runtime.register("gate", gate.export_state, gate.restore_state)
+    if burn_engine is not None:
+        # Budgets survive crash-restart: the rings, alert states and
+        # counters ride the same snapshot as everything else.
+        runtime.register(
+            "sloengine",
+            burn_engine.export_state,
+            burn_engine.restore_state,
+        )
 
     meta_template = Metadata(
         node=args.node,
@@ -810,6 +873,56 @@ def main(
                     schema_rejected=schema_dropped,
                 )
 
+            # ---- burn: fold the request outcome into the SLI stream
+            # and run the multi-window burn rules.  A transition here
+            # is the alert; sustained burns only move the gauges.
+            burn_transitions: list = []
+            if burn_engine is not None:
+                with tr.stage("burn") as sp:
+                    tps = sample.token_throughput_tps
+                    burn_engine.record(
+                        RequestOutcome(
+                            tenant=tenant,
+                            ts_unix_nano=int(now.timestamp() * 1e9),
+                            ttft_ms=sample.ttft_ms,
+                            tpot_ms=(1000.0 / tps if tps > 0 else 0.0),
+                            tokens=max(
+                                1,
+                                int(
+                                    tps
+                                    * sample.request_latency_ms
+                                    / 1000.0
+                                ),
+                            ),
+                            status=(
+                                "error"
+                                if sample.error_rate
+                                >= ERROR_RATE_THRESHOLDS[1]
+                                else "ok"
+                            ),
+                            request_id=sample.request_id,
+                        )
+                    )
+                    burn_transitions = burn_engine.evaluate(
+                        now.timestamp()
+                    )
+                    for transition in burn_transitions:
+                        print(
+                            "agent: burn alert: "
+                            f"{transition.severity} "
+                            f"{transition.tenant}/"
+                            f"{transition.objective} "
+                            f"{transition.from_state}->"
+                            f"{transition.to_state} "
+                            f"(burn {transition.burn_long:.1f}x long / "
+                            f"{transition.burn_short:.1f}x short)",
+                            file=sys.stderr,
+                        )
+                    sp.set(
+                        transitions=len(burn_transitions),
+                        alerting=burn_engine.policy.alerting_count(),
+                    )
+
             # ---- correlate: probe events vs this cycle's trace -----
             # Per-event tier/confidence decisions feed the incident
             # provenance chain — their only consumer — so the matcher
@@ -871,6 +984,23 @@ def main(
                     webhook_outcome = "deduped"
                     sp.set(deduped=True)
                 elif incident_fault:
+                    # The burn engine supplies the customer-impact
+                    # denominator: slo_impact carries the real max
+                    # active burn instead of a placeholder, so webhook
+                    # severity escalates on fast burns.
+                    active_burns = (
+                        burn_engine.active_burns()
+                        if burn_engine is not None
+                        else []
+                    )
+                    incident_burn = max(
+                        2.0,
+                        (
+                            burn_engine.max_active_burn(active_burns)
+                            if burn_engine is not None
+                            else 0.0
+                        ),
+                    )
                     fault = attribution.FaultSample(
                         incident_id=f"agent-inc-{idx + 1:04d}",
                         timestamp=now,
@@ -879,7 +1009,7 @@ def main(
                         service=args.service,
                         fault_label=sample.fault_label,
                         confidence=0.9,
-                        burn_rate=2.0,
+                        burn_rate=incident_burn,
                         window_minutes=5,
                         request_id=sample.request_id,
                         trace_id=sample.trace_id,
@@ -889,6 +1019,15 @@ def main(
                         signals=profile_for_fault(sample.fault_label),
                     )
                     attr = attributor.attribute_sample(fault)
+                    if active_burns:
+                        # The incident records which budgets were
+                        # burning when it fired — the page's "how bad
+                        # is this" context.
+                        attr.slo_burn = {
+                            "evaluated_at": rfc3339(now),
+                            "max_burn_rate": round(incident_burn, 4),
+                            "alerting": active_burns,
+                        }
                     if tracer.enabled or provenance_log is not None:
                         supporting = {
                             s
@@ -926,6 +1065,7 @@ def main(
                                 if ev.signal in supporting or dec.matched
                             ],
                             correlation=_correlation_summary(decisions),
+                            burning=active_burns,
                         )
                         attr.provenance = prov_rec.attribution_block()
                         # The provenance record points at this cycle's
@@ -1024,7 +1164,7 @@ def main(
                     args.stats_interval_cycles
                     and (idx + 1) % args.stats_interval_cycles == 0
                 ):
-                    _print_stats(gate, metrics)
+                    _print_stats(gate, metrics, burn_engine)
                 result = guard.evaluate()
                 if result.valid:
                     metrics.cpu_overhead_pct.set(result.cpu_pct)
@@ -1146,7 +1286,7 @@ def main(
             log=lambda msg: print(f"agent: {msg}", file=sys.stderr),
         )
         metrics.up.set(0)
-        _print_stats(gate, metrics)
+        _print_stats(gate, metrics, burn_engine)
         if chaos_stream is not None:
             print(
                 f"agent: chaos-telemetry: {chaos_stream.snapshot()}",

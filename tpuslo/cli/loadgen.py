@@ -2,7 +2,11 @@
 
 Reference: ``cmd/loadgen/main.go`` — request profiles with expected
 TTFT ranges; generates traces, does not drive HTTP.  The TPU-native
-build adds a ``context_128k`` profile for long-context serving.
+build adds a ``context_128k`` profile for long-context serving, and
+``--slo-out`` emits a parallel ``RequestOutcome`` JSONL (the burn
+engine's SLI stream) so error-budget scenarios can be rehearsed
+offline: ``loadgen --slo-out out.jsonl --error-rate 0.3
+--error-after-s 1800`` then ``sloctl budget --replay out.jsonl``.
 """
 
 from __future__ import annotations
@@ -11,6 +15,7 @@ import argparse
 import json
 import random
 import sys
+from datetime import datetime, timezone
 
 # profile -> (prompt_tokens, max_new_tokens, expected_ttft_ms_range)
 PROFILES = {
@@ -20,6 +25,9 @@ PROFILES = {
     "context_128k": (131072, 512, (2500, 8000)),
 }
 
+#: Deterministic default stream epoch for --slo-out timestamps.
+DEFAULT_START = "2026-01-01T00:00:00Z"
+
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tpuslo loadgen", description=__doc__)
@@ -28,6 +36,44 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--duration-s", type=float, default=30.0)
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--output", default="-")
+    p.add_argument(
+        "--slo-out",
+        default="",
+        help="also emit one RequestOutcome JSONL line per request "
+        "(tenant/ttft/tpot/tokens/status) — the burn engine's SLI "
+        "stream, replayable via `sloctl budget --replay`",
+    )
+    p.add_argument(
+        "--tenant",
+        default="default",
+        help="tenant stamped on --slo-out outcomes",
+    )
+    p.add_argument(
+        "--error-rate",
+        type=float,
+        default=0.0,
+        help="fraction of requests marked status=error on --slo-out",
+    )
+    p.add_argument(
+        "--error-after-s",
+        type=float,
+        default=0.0,
+        help="errors only start this many seconds into the run "
+        "(clean warm-up, then burn — a one-command burn scenario)",
+    )
+    p.add_argument(
+        "--slow-ttft-rate",
+        type=float,
+        default=0.0,
+        help="fraction of requests with TTFT 2-4x past the profile's "
+        "expected max (latency-objective burn on --slo-out)",
+    )
+    p.add_argument(
+        "--start",
+        default=DEFAULT_START,
+        help="RFC3339 epoch of the generated stream (deterministic "
+        "timestamps; the burn engine runs on event time)",
+    )
     return p
 
 
@@ -37,16 +83,24 @@ def main(argv: list[str] | None = None) -> int:
     rng = random.Random(args.seed)
     count = max(1, int(args.rps * args.duration_s))
     interval_ms = 1000.0 / args.rps
+    start = datetime.fromisoformat(
+        args.start.replace("Z", "+00:00")
+    ).astimezone(timezone.utc)
+    base_ns = int(start.timestamp() * 1e9)
 
     sink = sys.stdout if args.output == "-" else open(args.output, "w", encoding="utf-8")
+    slo_sink = (
+        open(args.slo_out, "w", encoding="utf-8") if args.slo_out else None
+    )
     try:
         for idx in range(count):
             jitter = rng.uniform(-0.2, 0.2) * interval_ms
+            offset_ms = round(idx * interval_ms + jitter, 3)
             record = {
                 "request_id": f"load-req-{idx + 1:05d}",
                 "trace_id": f"load-trace-{idx + 1:05d}",
                 "profile": args.profile,
-                "offset_ms": round(idx * interval_ms + jitter, 3),
+                "offset_ms": offset_ms,
                 "prompt_tokens": prompt_tokens,
                 "max_new_tokens": max_new,
                 "expected_ttft_ms_min": ttft_range[0],
@@ -54,10 +108,42 @@ def main(argv: list[str] | None = None) -> int:
                 "stream": True,
             }
             sink.write(json.dumps(record, separators=(",", ":")) + "\n")
+            if slo_sink is not None:
+                in_error_window = (
+                    offset_ms / 1000.0 >= args.error_after_s
+                )
+                error = (
+                    in_error_window and rng.random() < args.error_rate
+                )
+                slow = rng.random() < args.slow_ttft_rate
+                ttft_ms = (
+                    rng.uniform(2.0 * ttft_range[1], 4.0 * ttft_range[1])
+                    if slow
+                    else rng.uniform(*ttft_range)
+                )
+                outcome = {
+                    "tenant": args.tenant,
+                    "ts_unix_nano": base_ns + int(offset_ms * 1e6),
+                    "ttft_ms": round(ttft_ms, 3),
+                    "tpot_ms": round(rng.uniform(20.0, 60.0), 3),
+                    "tokens": max_new,
+                    "status": "error" if error else "ok",
+                    "request_id": record["request_id"],
+                }
+                slo_sink.write(
+                    json.dumps(outcome, separators=(",", ":")) + "\n"
+                )
     finally:
         if sink is not sys.stdout:
             sink.close()
-    print(f"loadgen: wrote {count} request records", file=sys.stderr)
+        if slo_sink is not None:
+            slo_sink.close()
+    print(
+        f"loadgen: wrote {count} request records"
+        + (f" + {count} slo outcomes to {args.slo_out}"
+           if args.slo_out else ""),
+        file=sys.stderr,
+    )
     return 0
 
 

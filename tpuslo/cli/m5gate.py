@@ -23,6 +23,13 @@ runs the threaded suites under the dynamic lock-order race detector.
 ``make m5-gate`` runs both before the statistical gates, so a release
 candidate with a fresh lint finding or a lock-order inversion never
 reaches the benchmark comparison.
+
+``--burn-sweep`` runs the error-budget burn-scenario gate
+(``tpuslo.sloengine.sweep``): seeded synthetic traffic shapes (steady,
+fast-burn, slow-burn, latency regression, flapping, tenant-isolated,
+kill/restart) replayed through the burn engine, asserting alert
+precision/recall, page promptness, zero flap-induced duplicates,
+tenant isolation, and snapshot/restore equivalence.
 """
 
 from __future__ import annotations
@@ -100,6 +107,18 @@ def build_parser() -> argparse.ArgumentParser:
         help="run the delivery/runtime/obs suites under the dynamic "
         "lock-order race detector (TPUSLO_RACECHECK=1)",
     )
+    # ---- error-budget burn-scenario gate (tpuslo.sloengine) -----------
+    p.add_argument(
+        "--burn-sweep",
+        action="store_true",
+        help="run the burn-scenario gate instead of B5/D3/E3: seeded "
+        "traffic shapes through the error-budget engine, asserting "
+        "alert precision/recall, promptness, dedup, tenant isolation "
+        "and snapshot/restore equivalence",
+    )
+    p.add_argument("--burn-seed", type=int, default=1337)
+    p.add_argument("--burn-bucket-s", type=int, default=10)
+    p.add_argument("--burn-eval-interval-s", type=float, default=30.0)
     p.add_argument("--crash-root", default="artifacts/crash")
     p.add_argument("--crash-seeds", default="1,2,3,4,5")
     p.add_argument("--crash-kill-points", default="0.25,0.5,0.8")
@@ -164,6 +183,67 @@ def run_crash_gate(args) -> int:
         Path(args.summary_md).write_text(render_crash_markdown(report))
     print(
         f"m5gate: crash-sweep {'PASS' if report.passed else 'FAIL'}"
+        + ("" if report.passed else f" ({'; '.join(report.failures)})"),
+        file=sys.stderr,
+    )
+    return 0 if report.passed else 1
+
+
+def render_burn_markdown(report) -> str:
+    lines = [
+        "# Error-budget burn-scenario gate",
+        "",
+        f"**Overall: {'PASS' if report.passed else 'FAIL'}**",
+        "",
+        f"- seed {report.seed}, evaluation every "
+        f"{report.eval_interval_s:g}s of event time",
+        "- contracts: alert precision + recall per scenario, fast page "
+        "within one evaluation of the windows crossing, zero "
+        "flap-induced duplicate transitions, tenant isolation, "
+        "snapshot/restore equivalence",
+        "",
+        "| scenario | outcomes | evals | alerts | fast crossed @ | "
+        "page fired @ | pass |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for run in report.runs:
+        crossed = (
+            f"{run.fast_crossing_eval_s:.0f}s"
+            if run.fast_crossing_eval_s >= 0
+            else "-"
+        )
+        fired = (
+            f"{run.fast_fired_eval_s:.0f}s"
+            if run.fast_fired_eval_s >= 0
+            else "-"
+        )
+        lines.append(
+            f"| {run.name} | {run.outcomes} | {run.evaluations} "
+            f"| {len(run.fired)} | {crossed} | {fired} | {run.passed} |"
+        )
+    if report.failures:
+        lines += ["", "## Failures", ""]
+        lines += [f"- {f}" for f in report.failures]
+    return "\n".join(lines) + "\n"
+
+
+def run_burn_gate(args) -> int:
+    from tpuslo.sloengine.sweep import run_burn_sweep
+
+    report = run_burn_sweep(
+        seed=args.burn_seed,
+        bucket_s=args.burn_bucket_s,
+        eval_interval_s=args.burn_eval_interval_s,
+        log=lambda msg: print(f"m5gate: {msg}", file=sys.stderr),
+    )
+    if args.summary_json:
+        Path(args.summary_json).write_text(
+            json.dumps(report.to_dict(), indent=2) + "\n"
+        )
+    if args.summary_md:
+        Path(args.summary_md).write_text(render_burn_markdown(report))
+    print(
+        f"m5gate: burn-sweep {'PASS' if report.passed else 'FAIL'}"
         + ("" if report.passed else f" ({'; '.join(report.failures)})"),
         file=sys.stderr,
     )
@@ -322,6 +402,8 @@ def main(argv: list[str] | None = None) -> int:
         return run_lint_gate()
     if args.racecheck_smoke:
         return run_racecheck_gate()
+    if args.burn_sweep:
+        return run_burn_gate(args)
     if args.crash_sweep:
         return run_crash_gate(args)
     if args.chaos_sweep:

@@ -1,5 +1,5 @@
-"""sloctl: operator CLI — ``prereq check``, ``cdgate check`` and
-``explain <incident>``.
+"""sloctl: operator CLI — ``prereq check``, ``cdgate check``,
+``explain <incident>`` and ``budget``.
 
 Reference: ``cmd/sloctl`` — prereq text/json with ``--strict``; cdgate
 thresholds with ``--fail-open`` post-processing
@@ -7,6 +7,10 @@ thresholds with ``--fail-open`` post-processing
 addition: it prints the recorded provenance chain behind one incident
 page (probe events → correlation tier/confidence → fault-domain
 posterior → alert delivery outcome) from the agent's provenance log.
+``budget`` renders the burn engine's per-tenant error-budget table
+(windowed SLI, budget remaining, burn rates, alert state) from the
+agent's durable state snapshot — or replays a ``RequestOutcome`` JSONL
+(``loadgen --slo-out``) through a fresh engine offline.
 """
 
 from __future__ import annotations
@@ -73,6 +77,37 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="emit the raw provenance record instead of the chain text",
     )
+
+    bu = sub.add_parser(
+        "budget",
+        help="per-tenant error-budget / burn-rate table from the "
+        "agent's state snapshot (or an offline outcome replay)",
+    )
+    bu.add_argument("--config", default="")
+    bu.add_argument(
+        "--state",
+        default="",
+        help="agent state snapshot path (default "
+        "<runtime.state_dir>/agent-state.json)",
+    )
+    bu.add_argument(
+        "--replay",
+        default="",
+        help="RequestOutcome JSONL (loadgen --slo-out) to replay "
+        "through a fresh engine instead of reading agent state",
+    )
+    bu.add_argument("--tenant", default="", help="filter to one tenant")
+    bu.add_argument(
+        "--json",
+        action="store_true",
+        help="emit the budget table as JSON",
+    )
+    bu.add_argument(
+        "--watch",
+        action="store_true",
+        help="re-read the snapshot and re-render every --interval-s",
+    )
+    bu.add_argument("--interval-s", type=float, default=2.0)
     return p
 
 
@@ -166,12 +201,172 @@ def run_explain(args) -> int:
     return 0
 
 
+def _render_budget_table(statuses, tenant_filter: str = "") -> str:
+    """Fixed-width per-(tenant, objective) budget table."""
+    rows = [
+        (
+            "TENANT", "OBJECTIVE", "TARGET", "SLI(1h)", "BUDGET",
+            "5m", "30m", "1h", "6h", "STATE",
+        )
+    ]
+    for stat in statuses:
+        if tenant_filter and stat.tenant != tenant_filter:
+            continue
+        burns = stat.burn_rates
+        rows.append(
+            (
+                stat.tenant,
+                stat.objective,
+                f"{stat.target:.3%}",
+                f"{stat.sli.get('1h', 1.0):.3%}",
+                f"{stat.budget_remaining:.1%}",
+                f"{burns.get('5m', 0.0):.1f}x",
+                f"{burns.get('30m', 0.0):.1f}x",
+                f"{burns.get('1h', 0.0):.1f}x",
+                f"{burns.get('6h', 0.0):.1f}x",
+                stat.alert_state,
+            )
+        )
+    if len(rows) == 1:
+        return "(no tenants observed)"
+    widths = [
+        max(len(row[col]) for row in rows) for col in range(len(rows[0]))
+    ]
+    return "\n".join(
+        "  ".join(cell.ljust(width) for cell, width in zip(row, widths))
+        for row in rows
+    )
+
+
+def _budget_engine_from_state(cfg, state_path: str):
+    """(engine, saved_at) from one durable agent snapshot, or None."""
+    import os
+
+    from tpuslo.sloengine import BurnEngine, EngineConfig
+
+    path = state_path
+    if not path and cfg.runtime.state_dir:
+        path = os.path.join(cfg.runtime.state_dir, "agent-state.json")
+    if not path:
+        return None, "no state path — pass --state or set runtime.state_dir"
+    try:
+        with open(path, encoding="utf-8") as fh:
+            snapshot = json.load(fh)
+    except OSError as exc:
+        return None, f"cannot read {path}: {exc.strerror or exc}"
+    except json.JSONDecodeError:
+        return None, f"corrupt snapshot {path}"
+    section = (snapshot.get("components") or {}).get("sloengine")
+    if not isinstance(section, dict):
+        return None, (
+            f"snapshot {path} has no sloengine section — is the burn "
+            "engine enabled (config slo: / agent --burn-engine)?"
+        )
+    engine = BurnEngine(EngineConfig.from_toolkit(cfg.slo))
+    engine.restore_state(section)
+    saved_at = float(snapshot.get("saved_at", 0.0))
+    # Roll the rings forward to the snapshot time so the table shows
+    # the windows as of the last save — policy-free: a display read
+    # must not advance clear streaks or fire transitions the agent's
+    # own durable state never saw.
+    engine.roll_to(saved_at)
+    return engine, ""
+
+
+def run_budget(args) -> int:
+    import time as time_mod
+
+    from tpuslo.sloengine import (
+        BurnEngine,
+        EngineConfig,
+        load_outcomes,
+        replay_outcomes,
+    )
+
+    cfg = resolve_config(args.config)
+    if args.replay:
+        engine = BurnEngine(EngineConfig.from_toolkit(cfg.slo))
+        try:
+            transitions = replay_outcomes(
+                engine, load_outcomes(args.replay)
+            )
+        except OSError as exc:
+            print(
+                f"sloctl budget: cannot read {args.replay}: "
+                f"{exc.strerror or exc}",
+                file=sys.stderr,
+            )
+            return 1
+        statuses = [
+            s
+            for s in engine.status()
+            if not args.tenant or s.tenant == args.tenant
+        ]
+        transitions = [
+            t
+            for t in transitions
+            if not args.tenant or t.tenant == args.tenant
+        ]
+        if args.json:
+            print(
+                json.dumps(
+                    {
+                        "budgets": [s.to_dict() for s in statuses],
+                        "transitions": [
+                            t.to_dict() for t in transitions
+                        ],
+                    },
+                    indent=2,
+                )
+            )
+        else:
+            print(_render_budget_table(statuses, args.tenant))
+            for t in transitions:
+                print(
+                    f"transition: {t.severity} {t.tenant}/{t.objective} "
+                    f"{t.from_state}->{t.to_state} at +{t.at_s:.0f}s "
+                    f"(burn {t.burn_long:.1f}x/{t.burn_short:.1f}x)"
+                )
+        return 0
+
+    while True:
+        engine, err = _budget_engine_from_state(cfg, args.state)
+        if engine is None:
+            print(f"sloctl budget: {err}", file=sys.stderr)
+            return 1
+        statuses = engine.status()
+        if args.json:
+            print(
+                json.dumps(
+                    {
+                        "budgets": [
+                            s.to_dict()
+                            for s in statuses
+                            if not args.tenant or s.tenant == args.tenant
+                        ]
+                    },
+                    indent=2,
+                )
+            )
+        else:
+            print(_render_budget_table(statuses, args.tenant))
+        if not args.watch:
+            return 0
+        try:
+            time_mod.sleep(max(0.1, args.interval_s))
+        except KeyboardInterrupt:
+            return 0
+        print()
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     if args.command == "prereq":
         return run_prereq(args)
     if args.command == "explain":
         return run_explain(args)
+    if args.command == "budget":
+        return run_budget(args)
     return run_cdgate(args)
 
 

@@ -145,6 +145,39 @@ class ObservabilityConfig:
 
 
 @dataclass
+class SLOConfig:
+    """Error-budget / burn-rate engine knobs (``tpuslo.sloengine``).
+
+    ``enabled`` flips to True whenever an ``slo:`` section is present
+    in the config file (presence-implies-on, like ``ingest:``); an
+    explicit ``enabled: false`` still wins.  Targets are the default
+    per-tenant objectives; ``tenants`` holds per-tenant overrides
+    (``tenant -> {availability_target, ttft_objective_ms, ...}``).
+    """
+
+    enabled: bool = False
+    #: Ring-buffer bucket resolution for the sliding windows.
+    bucket_s: int = 10
+    #: Budget-ledger window (also the ring horizon); 6h demo-scale
+    #: stand-in for the classic 30d period.
+    budget_window_s: int = 21600
+    availability_target: float = 0.99
+    ttft_objective_ms: float = 800.0
+    ttft_target: float = 0.95
+    tpot_objective_ms: float = 120.0
+    tpot_target: float = 0.95
+    #: Multi-window thresholds: fast = 1h+5m page, slow = 6h+30m ticket.
+    fast_burn_threshold: float = 14.4
+    slow_burn_threshold: float = 6.0
+    #: Hysteresis: clearing needs burn < threshold * this ratio ...
+    clear_hysteresis: float = 0.5
+    #: ... for this many consecutive evaluations.
+    clear_cycles: int = 6
+    max_tenants: int = 64
+    tenants: dict[str, dict[str, float]] = field(default_factory=dict)
+
+
+@dataclass
 class RuntimeConfig:
     """Crash-safe runtime knobs (``tpuslo.runtime``).
 
@@ -188,6 +221,7 @@ class ToolkitConfig:
     observability: ObservabilityConfig = field(
         default_factory=ObservabilityConfig
     )
+    slo: SLOConfig = field(default_factory=SLOConfig)
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
     tpu: TPUConfig = field(default_factory=TPUConfig)
 
@@ -254,6 +288,25 @@ class ToolkitConfig:
                 "max_overhead_pct": self.observability.max_overhead_pct,
                 "provenance_path": self.observability.provenance_path,
             },
+            "slo": {
+                "enabled": self.slo.enabled,
+                "bucket_s": self.slo.bucket_s,
+                "budget_window_s": self.slo.budget_window_s,
+                "availability_target": self.slo.availability_target,
+                "ttft_objective_ms": self.slo.ttft_objective_ms,
+                "ttft_target": self.slo.ttft_target,
+                "tpot_objective_ms": self.slo.tpot_objective_ms,
+                "tpot_target": self.slo.tpot_target,
+                "fast_burn_threshold": self.slo.fast_burn_threshold,
+                "slow_burn_threshold": self.slo.slow_burn_threshold,
+                "clear_hysteresis": self.slo.clear_hysteresis,
+                "clear_cycles": self.slo.clear_cycles,
+                "max_tenants": self.slo.max_tenants,
+                "tenants": {
+                    tenant: dict(overrides)
+                    for tenant, overrides in self.slo.tenants.items()
+                },
+            },
             "runtime": {
                 "state_dir": self.runtime.state_dir,
                 "snapshot_interval_s": self.runtime.snapshot_interval_s,
@@ -280,6 +333,34 @@ class ToolkitConfig:
 
 def default_config() -> ToolkitConfig:
     return ToolkitConfig()
+
+
+def _tenant_overrides(raw: Any) -> dict[str, dict[str, float]]:
+    """Normalize the ``slo.tenants`` override map: tenant -> numeric
+    partial targets.  A malformed block fails loud here — the contract
+    validation only ever sees the normalized dict, so this caster is
+    the type gate for raw operator input."""
+    if not isinstance(raw, dict):
+        raise ValueError("slo.tenants must be a mapping")
+    out: dict[str, dict[str, float]] = {}
+    for tenant, overrides in raw.items():
+        if not isinstance(overrides, dict):
+            raise ValueError(
+                f"slo.tenants[{tenant!r}] must be a mapping of "
+                "target overrides"
+            )
+        numeric: dict[str, float] = {}
+        for key, value in overrides.items():
+            if isinstance(value, bool) or not isinstance(
+                value, (int, float)
+            ):
+                raise ValueError(
+                    f"slo.tenants[{tenant!r}].{key} must be a number"
+                )
+            numeric[str(key)] = float(value)
+        if numeric:
+            out[str(tenant)] = numeric
+    return out
 
 
 def _merge_section(target, raw: dict[str, Any], fields: dict[str, type]) -> None:
@@ -392,6 +473,30 @@ def load_config(path: str) -> ToolkitConfig:
                 "slow_cycle_ms": float,
                 "max_overhead_pct": float,
                 "provenance_path": str,
+            },
+        )
+    if "slo" in raw:
+        # Presence of the section turns the burn engine on (the
+        # operator described it); an explicit ``enabled: false`` wins.
+        cfg.slo.enabled = True
+        _merge_section(
+            cfg.slo,
+            raw.get("slo") or {},
+            {
+                "enabled": bool,
+                "bucket_s": int,
+                "budget_window_s": int,
+                "availability_target": float,
+                "ttft_objective_ms": float,
+                "ttft_target": float,
+                "tpot_objective_ms": float,
+                "tpot_target": float,
+                "fast_burn_threshold": float,
+                "slow_burn_threshold": float,
+                "clear_hysteresis": float,
+                "clear_cycles": int,
+                "max_tenants": int,
+                "tenants": _tenant_overrides,
             },
         )
     _merge_section(

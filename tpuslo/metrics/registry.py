@@ -258,6 +258,42 @@ class AgentMetrics:
             "Wall time of the last graceful drain sequence",
             registry=self.registry,
         )
+        # ---- error-budget / burn-rate series (tpuslo.sloengine) ------
+        self.slo_request_outcomes = Counter(
+            "llm_slo_agent_slo_request_outcomes_total",
+            "Request outcomes folded into the burn engine's SLI stream",
+            ["tenant", "status"],
+            registry=self.registry,
+        )
+        self.slo_budget_remaining = Gauge(
+            "llm_slo_agent_slo_budget_remaining",
+            "Fraction of the error budget left over the budget window, "
+            "per tenant and objective (availability/ttft/tpot)",
+            ["tenant", "objective"],
+            registry=self.registry,
+        )
+        self.slo_burn_rate = Gauge(
+            "llm_slo_agent_slo_burn_rate",
+            "Error-budget burn rate per sliding window "
+            "(1.0 = spending exactly the whole budget over the window)",
+            ["tenant", "objective", "window"],
+            registry=self.registry,
+        )
+        self.slo_alert_state = Gauge(
+            "llm_slo_agent_slo_alert_state",
+            "Burn alert state per tenant/objective "
+            "(0=ok 1=slow_burn 2=fast_burn)",
+            ["tenant", "objective"],
+            registry=self.registry,
+        )
+        self.slo_alert_transitions = Counter(
+            "llm_slo_agent_slo_alert_transitions_total",
+            "Burn alert state transitions by severity "
+            "(page/ticket/resolve) — one per sustained burn, not one "
+            "per evaluation cycle",
+            ["tenant", "objective", "severity"],
+            registry=self.registry,
+        )
         # ---- self-observability series (tpuslo.obs) ------------------
         self.cycle_stage_ms = Histogram(
             "llm_slo_agent_cycle_stage_ms",
@@ -384,6 +420,11 @@ class AgentMetrics:
         """Observer adapter wiring a SelfTracer to this registry
         (duck-typed against tpuslo.obs.TraceObserver)."""
         return _PromTraceObserver(self)
+
+    def slo_observer(self) -> "_PromSLOObserver":
+        """Observer adapter wiring a BurnEngine to this registry
+        (duck-typed against tpuslo.sloengine.SLOObserver)."""
+        return _PromSLOObserver(self)
 
 
 _BREAKER_STATE_VALUES = {"closed": 0, "half_open": 1, "open": 2}
@@ -557,6 +598,57 @@ class _PromTraceObserver:
 
     def overhead_pct(self, pct: float) -> None:
         self._m.trace_overhead_pct.set(pct)
+
+
+class _PromSLOObserver:
+    """Bridge from burn-engine callbacks to Prometheus.
+
+    ``outcome`` runs once per request on the engine's record path, so
+    its labelled child is cached — a ``labels()`` lookup per request
+    is the kind of cost the TPL120 manifest exists to keep out.
+    """
+
+    def __init__(self, metrics: AgentMetrics):
+        self._m = metrics
+        self._outcome_children: dict[tuple[str, str], object] = {}
+
+    def outcome(self, tenant: str, status: str) -> None:
+        key = (tenant, status)
+        child = self._outcome_children.get(key)
+        if child is None:
+            child = self._m.slo_request_outcomes.labels(
+                tenant=tenant, status=status
+            )
+            self._outcome_children[key] = child
+        child.inc()
+
+    def burn_rate(
+        self, tenant: str, objective: str, window: str, rate: float
+    ) -> None:
+        self._m.slo_burn_rate.labels(
+            tenant=tenant, objective=objective, window=window
+        ).set(rate)
+
+    def budget_remaining(
+        self, tenant: str, objective: str, remaining: float
+    ) -> None:
+        self._m.slo_budget_remaining.labels(
+            tenant=tenant, objective=objective
+        ).set(remaining)
+
+    def alert_state(
+        self, tenant: str, objective: str, level: int
+    ) -> None:
+        self._m.slo_alert_state.labels(
+            tenant=tenant, objective=objective
+        ).set(level)
+
+    def transition(
+        self, tenant: str, objective: str, severity: str
+    ) -> None:
+        self._m.slo_alert_transitions.labels(
+            tenant=tenant, objective=objective, severity=severity
+        ).inc()
 
 
 class Readiness:

@@ -78,6 +78,10 @@ class ProvenanceRecord:
     delivery: dict[str, Any] = field(default_factory=dict)
     #: Per-stage durations (ms) of the producing cycle.
     stages_ms: dict[str, float] = field(default_factory=dict)
+    #: Error budgets burning when the incident fired (burn-engine
+    #: ``active_burns()`` entries: tenant/objective/state/burn_rates/
+    #: budget_remaining).
+    burning: list[dict[str, Any]] = field(default_factory=list)
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -94,6 +98,7 @@ class ProvenanceRecord:
             "correlation": dict(self.correlation),
             "delivery": dict(self.delivery),
             "stages_ms": dict(self.stages_ms),
+            "burning": [dict(b) for b in self.burning],
         }
 
     @classmethod
@@ -122,6 +127,11 @@ class ProvenanceRecord:
                 str(k): float(v)
                 for k, v in (raw.get("stages_ms") or {}).items()
             },
+            burning=[
+                dict(b)
+                for b in (raw.get("burning") or [])
+                if isinstance(b, dict)
+            ],
         )
 
     def attribution_block(self) -> dict[str, Any]:
@@ -236,6 +246,21 @@ def format_chain(rec: ProvenanceRecord) -> str:
         lines.append(f"  3. fault-domain posterior: {chain}")
     else:
         lines.append("  3. fault-domain posterior: (not recorded)")
+
+    if rec.burning:
+        for burn in rec.burning:
+            rates = burn.get("burn_rates") or {}
+            rate_text = " ".join(
+                f"{window}={rate:.1f}x"
+                for window, rate in sorted(rates.items())
+            )
+            lines.append(
+                "  budget burning: "
+                f"{burn.get('tenant', '?')}/{burn.get('objective', '?')} "
+                f"state={burn.get('state', '?')} "
+                f"remaining={burn.get('budget_remaining', 0.0):.1%}"
+                + (f" ({rate_text})" if rate_text else "")
+            )
 
     delivery = rec.delivery
     if delivery:

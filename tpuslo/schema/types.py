@@ -153,6 +153,11 @@ class IncidentAttribution:
     #: and supporting probe-event ids (full chain in the provenance log,
     #: rendered by ``sloctl explain``).
     provenance: dict[str, Any] | None = None
+    #: Error-budget context from the burn engine: which budgets were
+    #: burning when the incident fired (``alerting`` entries carry
+    #: tenant/objective/state/burn_rates/budget_remaining).  Webhook
+    #: severity escalates on a fast burn.
+    slo_burn: dict[str, Any] | None = None
 
     def to_dict(self) -> dict[str, Any]:
         out: dict[str, Any] = {
@@ -175,6 +180,8 @@ class IncidentAttribution:
             out["fault_hypotheses"] = [h.to_dict() for h in self.fault_hypotheses]
         if self.provenance:
             out["provenance"] = dict(self.provenance)
+        if self.slo_burn:
+            out["slo_burn"] = dict(self.slo_burn)
         return out
 
 

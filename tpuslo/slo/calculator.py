@@ -93,11 +93,19 @@ def total_retrieval_ms(b: RetrievalBreakdown) -> float:
 
 
 def quantile(values: list[float], q: float) -> float:
-    """Linear-interpolation quantile (matches reference semantics)."""
-    if not values:
+    """Linear-interpolation quantile (matches reference semantics).
+
+    Total over every input: an empty list is 0.0, a single element is
+    that exact value at every q, q is clamped to [0, 1], and NaN
+    elements are dropped before sorting (one poisoned snapshot must
+    not make sort order — and therefore every percentile — undefined).
+    Ties interpolate between equal values, so the result is NaN-free
+    whenever the retained inputs are.
+    """
+    ordered = sorted(v for v in values if not math.isnan(v))
+    if not ordered:
         return 0.0
     q = min(max(q, 0.0), 1.0)
-    ordered = sorted(values)
     if len(ordered) == 1:
         return ordered[0]
     pos = q * (len(ordered) - 1)
@@ -110,7 +118,12 @@ def quantile(values: list[float], q: float) -> float:
 
 
 def aggregate(items: list[Snapshot]) -> Percentiles:
-    """Percentile summaries over snapshots."""
+    """Percentile summaries over snapshots.
+
+    Total: an empty snapshot list yields all-zero percentiles and a
+    single snapshot yields its exact values — callers never need to
+    special-case either.
+    """
     if not items:
         return Percentiles()
     ttft = [max(s.ttft_ms, 0.0) for s in items]

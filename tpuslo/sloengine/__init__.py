@@ -1,0 +1,66 @@
+"""SLO error-budget and burn-rate engine.
+
+The top layer of the observability stack (ROADMAP item 5): a streaming
+per-request SLI pipeline (``RequestOutcome`` → ring-buffer sliding
+windows), per-tenant error budgets, Google-SRE-style multi-window
+multi-burn-rate alerting with hysteresis, and a seeded burn-scenario
+sweep gate.  Burn state feeds outgoing ``IncidentAttribution`` payloads
+(severity + customer-impact denominator for the Bayesian attribution),
+the provenance chain, Prometheus, and ``sloctl budget``.
+"""
+
+from tpuslo.sloengine.alerts import (
+    SEVERITY_PAGE,
+    SEVERITY_RESOLVE,
+    SEVERITY_TICKET,
+    STATE_FAST,
+    STATE_OK,
+    STATE_SLOW,
+    AlertPolicy,
+    AlertTransition,
+    BurnRule,
+    state_level,
+)
+from tpuslo.sloengine.budget import (
+    OBJECTIVES,
+    BudgetStatus,
+    TenantTargets,
+    resolve_targets,
+)
+from tpuslo.sloengine.engine import (
+    BurnEngine,
+    EngineConfig,
+    SLOObserver,
+    load_outcomes,
+    replay_outcomes,
+)
+from tpuslo.sloengine.stream import (
+    WINDOWS,
+    RequestOutcome,
+    TenantWindows,
+)
+
+__all__ = [
+    "SEVERITY_PAGE",
+    "SEVERITY_RESOLVE",
+    "SEVERITY_TICKET",
+    "STATE_FAST",
+    "STATE_OK",
+    "STATE_SLOW",
+    "AlertPolicy",
+    "AlertTransition",
+    "BurnRule",
+    "state_level",
+    "OBJECTIVES",
+    "BudgetStatus",
+    "TenantTargets",
+    "resolve_targets",
+    "BurnEngine",
+    "EngineConfig",
+    "SLOObserver",
+    "load_outcomes",
+    "replay_outcomes",
+    "WINDOWS",
+    "RequestOutcome",
+    "TenantWindows",
+]

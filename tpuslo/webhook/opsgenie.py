@@ -18,6 +18,13 @@ def build_opsgenie_payload(attr: IncidentAttribution) -> bytes:
     burn_rate = attr.slo_impact.burn_rate
     if burn_rate >= 3.0:
         priority = "P1"
+    # Burn-engine escalation: an active fast-burn page outranks the
+    # confidence tiers — the budget is draining now.
+    if any(
+        entry.get("state") == "fast_burn"
+        for entry in (attr.slo_burn or {}).get("alerting", [])
+    ):
+        priority = "P1"
     evidence = "; ".join(f"{e.signal}={e.value}" for e in attr.evidence)
     payload = {
         "message": f"[{attr.service}] {attr.predicted_fault_domain} fault detected",
@@ -37,4 +44,10 @@ def build_opsgenie_payload(attr: IncidentAttribution) -> bytes:
         },
         "entity": attr.service,
     }
+    if attr.slo_burn:
+        payload["details"]["burning_budgets"] = "; ".join(
+            f"{entry.get('tenant', '?')}/{entry.get('objective', '?')}"
+            f"={entry.get('state', '?')}"
+            for entry in attr.slo_burn.get("alerting", [])
+        )
     return json.dumps(payload).encode()

@@ -1,7 +1,11 @@
 """PagerDuty Events API v2 payload builder.
 
 Reference: ``pkg/webhook/pagerduty.go:29-61`` — severity escalates to
-``critical`` at confidence ≥ 0.8.
+``critical`` at confidence ≥ 0.8.  The burn engine adds a second
+escalation path: an incident that fires while a fast-burn page is
+active (or whose SLO impact burns at page rate) is ``critical``
+regardless of attribution confidence — budget exhaustion outranks
+classifier certainty.
 """
 
 from __future__ import annotations
@@ -10,9 +14,26 @@ import json
 
 from tpuslo.schema import IncidentAttribution
 
+#: Burn rate at which severity escalates regardless of confidence —
+#: the fast-burn page threshold (SRE 1h+5m rule).
+FAST_BURN_ESCALATION = 14.4
+
+
+def _fast_burning(attr: IncidentAttribution) -> bool:
+    if attr.slo_impact.burn_rate >= FAST_BURN_ESCALATION:
+        return True
+    for entry in (attr.slo_burn or {}).get("alerting", []):
+        if entry.get("state") == "fast_burn":
+            return True
+    return False
+
 
 def build_pagerduty_payload(attr: IncidentAttribution) -> bytes:
-    severity = "critical" if attr.confidence >= 0.8 else "warning"
+    severity = (
+        "critical"
+        if attr.confidence >= 0.8 or _fast_burning(attr)
+        else "warning"
+    )
     evidence = "; ".join(f"{e.signal}={e.value}" for e in attr.evidence)
     burn_rate = attr.slo_impact.burn_rate
     payload = {
@@ -37,4 +58,10 @@ def build_pagerduty_payload(attr: IncidentAttribution) -> bytes:
             },
         },
     }
+    if attr.slo_burn:
+        payload["payload"]["custom_details"]["burning_budgets"] = [
+            f"{entry.get('tenant', '?')}/{entry.get('objective', '?')}"
+            f"={entry.get('state', '?')}"
+            for entry in attr.slo_burn.get("alerting", [])
+        ]
     return json.dumps(payload).encode()
