@@ -8,7 +8,8 @@ ARTIFACTS ?= artifacts
 .PHONY: all test test-fast native ebpf lint lint-changed \
 	racecheck-smoke schema-validate \
 	correlation-gate fault-smoke replay-smoke ebpf-smoke bench \
-	bench-smoke chaos-smoke chaos-demo chaos-telemetry-smoke \
+	bench-smoke bench-columnar-smoke bench-columnar-full \
+	chaos-smoke chaos-demo chaos-telemetry-smoke \
 	chaos-telemetry-sweep crash-smoke crash-sweep obs-smoke \
 	burn-smoke burn-sweep \
 	metrics-drift m5-candidate m5-gate helm-lint dashboards clean
@@ -121,6 +122,18 @@ bench:
 bench-smoke:
 	$(PY) -m pytest tests/test_bench_smoke.py -q
 
+# Columnar spine smoke (ISSUE 8): row-vs-columnar parity at every
+# stage plus result-shape checks on a toy batch — fast, runs in
+# m5-gate.  The gate-scale run (columnar >= 1M events/s, matcher
+# >= 10x the row path; bench.py hard-fails below the floors) is the
+# slow-marked bench-columnar-full.
+bench-columnar-smoke:
+	$(PY) -m pytest tests/test_bench_columnar.py tests/test_columnar_parity.py \
+		-q -m 'not slow'
+
+bench-columnar-full:
+	$(PY) -m pytest tests/test_bench_columnar.py -q
+
 # Fault-injection suite: real agent loop vs a scripted flaky OTLP sink
 # (refuse/5xx/4xx/hang), proving zero-loss spool+replay and breaker
 # recovery.  chaos tests are also marked slow, so the tier-1
@@ -225,10 +238,10 @@ m5-candidate:
 	done
 	@echo "m5-candidate: artifacts under $(ARTIFACTS)/m5"
 
-# Release candidates fail on new lint findings, lock-order races, or
-# burn-alert contract violations before the statistical gates even run
-# (ISSUEs 6 + 7).
-m5-gate: lint racecheck-smoke burn-smoke burn-sweep
+# Release candidates fail on new lint findings, lock-order races,
+# burn-alert contract violations, or row-vs-columnar divergence before
+# the statistical gates even run (ISSUEs 6 + 7 + 8).
+m5-gate: lint racecheck-smoke burn-smoke burn-sweep bench-columnar-smoke
 	$(PY) -m tpuslo m5gate --candidate-root $(ARTIFACTS)/m5 \
 		--scenarios "$(shell echo $(M5_SCENARIOS) | tr ' ' ',')" \
 		--summary-json $(ARTIFACTS)/m5/gate.json \

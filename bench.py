@@ -358,98 +358,329 @@ def bench_tracer_overhead(
     }
 
 
-def bench_pipeline(sample_count: int = 200) -> dict:
-    """Synthetic spine throughput: samples -> probe events -> validate.
+# Columnar release floors (ISSUE 8): the gated spine must clear these
+# on the full bench run or bench.py hard-fails.  Enforced only at
+# gate-scale sample counts — tiny smoke batches can't amortize fixed
+# numpy overheads and would gate on noise.
+COLUMNAR_EVENTS_PER_SEC_FLOOR = 1_000_000
+COLUMNAR_MATCHER_SPEEDUP_FLOOR = 10.0
+COLUMNAR_GATE_MIN_SAMPLES = 1000
 
-    End-to-end rate uses the batched hot path (``generate_batch`` + the
-    structural fast-path validator); ``validations_per_sec`` and
-    ``matcher_pairs_per_sec`` isolate the two stages this PR optimized
-    so the speedup stays visible in the BENCH trajectory.
+
+def bench_pipeline(sample_count: int = 2000, repeats: int = 4) -> dict:
+    """Row vs columnar spine throughput, measured on the SAME path.
+
+    BENCH_r05 reported ``probe_events_per_sec`` at 11.4k while the PR-1
+    micro-bench claimed ~220k — the two numbers measured different
+    paths (generate+validate of typed events vs whatever the driver box
+    ran).  This bench now measures, explicitly and for BOTH
+    representations, the path the agent actually runs and the gates
+    apply to:
+
+        generate -> (to payload, row only) -> TelemetryGate admission
+        (validation + dedup + skew + watermark)
+
+    over a time-advancing stream of ``repeats`` batches (a repeated
+    batch would pathologically stress dedup's carry window), best of
+    ``repeats`` passes.  ``serialize_events_per_sec`` and
+    ``matcher_pairs_per_sec`` are reported per representation the same
+    way, and row-vs-columnar parity is asserted in-run (admitted
+    counts, matcher decisions, serialized bytes, posterior rankings) so
+    a fast-but-wrong kernel cannot post a number.
     """
+    import json as json_mod
+
     from datetime import datetime, timedelta, timezone
 
+    import numpy as np
+
     from tpuslo import collector, signals
-    from tpuslo.cli.common import validate_probe
-    from tpuslo.correlation.matcher import SignalRef, SpanRef, match_batch
+    from tpuslo.columnar.gate import ColumnarGate
+    from tpuslo.columnar.match import (
+        match_columns,
+        signal_columns_from_batch,
+        span_columns,
+    )
+    from tpuslo.columnar.posterior import jax_available, log_posterior_batch
+    from tpuslo.columnar.schema import to_rows
+    from tpuslo.columnar.serialize import serialize_jsonl
+    from tpuslo.correlation.matcher import SpanRef, match_batch
+    from tpuslo.ingest.gate import GateConfig, TelemetryGate
 
     meta = signals.Metadata(
         node="bench", namespace="llm", pod="bench", container="bench",
-        pid=1, tid=1, tpu_chip="accel0",
+        pid=1, tid=1, tpu_chip="accel0", slice_id="slice-0",
+        host_index=1, xla_program_id="jit_step",
     )
     gen = signals.Generator(signals.CAPABILITY_TPU_FULL)
     start = datetime(2026, 1, 1, tzinfo=timezone.utc)
-    samples = collector.generate_synthetic_samples(
-        "tpu_mixed", sample_count, start, collector.SampleMeta()
+    passes = max(1, repeats)
+    batches_per_pass = 3
+    pass_streams = [
+        [
+            collector.generate_synthetic_samples(
+                "tpu_mixed", sample_count,
+                start + timedelta(
+                    seconds=(p * batches_per_pass + b) * sample_count
+                ),
+                collector.SampleMeta(),
+            )
+            for b in range(batches_per_pass)
+        ]
+        for p in range(passes)
+    ]
+    pass_trace_ids = [
+        [[s.trace_id for s in batch] for batch in streams]
+        for streams in pass_streams
+    ]
+    streams = pass_streams[0]
+
+    # Warm caches (schema compilation, numpy pools) before measuring.
+    warm_gate = TelemetryGate(GateConfig())
+    warm_gate.admit_all(
+        [e.to_dict() for e in gen.generate_batch(streams[0][:5], meta)]
     )
-    # Warm caches (schema compilation etc.) before measuring.
-    warm = gen.generate_batch(samples[:1], meta)
-    for event in warm:
-        validate_probe(event)
+    ColumnarGate(GateConfig()).admit_batch(
+        gen.generate_batch_columnar(streams[0][:5], meta)
+    )
 
+    # ---- probe spine: generate -> gate ----------------------------------
+    # Best pass of `passes`, each over its own time-advancing stream
+    # (re-admitting identical events would stress the dedup carry
+    # window into a shape no real stream has).  The columnar passes
+    # run first and with the collector paused: the row path churns
+    # millions of short-lived objects whose GC cycles would otherwise
+    # land inside the columnar timing windows.
+    import gc
+
+    col_elapsed = 1e30
+    col_admitted = 0
+    col_batches: list = []
+    gc.collect()
+    gc.disable()
+    try:
+        for streams_p, tids_p in zip(pass_streams, pass_trace_ids):
+            col_gate = ColumnarGate(GateConfig())
+            t0 = time.perf_counter()
+            admitted = 0
+            batches = []
+            for batch, tids in zip(streams_p, tids_p):
+                cb = gen.generate_batch_columnar(
+                    batch, meta, trace_ids=tids
+                )
+                result = col_gate.admit_batch(cb)
+                admitted += len(result.admitted)
+                batches.append(result.admitted)
+            col_elapsed = min(col_elapsed, time.perf_counter() - t0)
+            col_admitted, col_batches = admitted, batches
+    finally:
+        gc.enable()
+
+    # One row pass is enough: the row number is the comparison
+    # baseline, not a gated floor, and a pass costs seconds at ~50k/s.
+    row_gate = TelemetryGate(GateConfig())
     t0 = time.perf_counter()
-    generated = gen.generate_batch(samples, meta)
-    events = 0
-    for event in generated:
-        if validate_probe(event):
-            events += 1
-    elapsed = time.perf_counter() - t0
+    row_admitted = row_events_total = 0
+    for batch in pass_streams[-1]:
+        events = gen.generate_batch(batch, meta)
+        row_events_total += len(events)
+        gated = row_gate.admit_all([e.to_dict() for e in events])
+        row_admitted += len(gated.admitted)
+    row_elapsed = time.perf_counter() - t0
+    parity_gate = row_admitted == col_admitted == row_events_total
 
+    # Generation parity spot check (full equality on a slice).
+    parity_generate = (
+        gen.generate_batch(streams[0][:20], meta)
+        == to_rows(gen.generate_batch_columnar(streams[0][:20], meta))
+    )
+
+    # ---- serialize: payload dicts + json.dumps vs column templates ------
+    events = gen.generate_batch(streams[0], meta)
+    dumps = json_mod.dumps
     t0 = time.perf_counter()
-    for event in generated:
-        validate_probe(event)
-    validate_elapsed = time.perf_counter() - t0
+    row_block = "".join(
+        dumps(e.to_dict(), separators=(",", ":")) + "\n" for e in events
+    )
+    row_ser_elapsed = time.perf_counter() - t0
+    cbatch = col_batches[0]
+    t0 = time.perf_counter()
+    col_block = serialize_jsonl(cbatch)
+    col_ser_elapsed = time.perf_counter() - t0
+    parity_serialize = col_block == "".join(
+        dumps(e.to_dict(), separators=(",", ":")) + "\n"
+        for e in to_rows(cbatch)
+    )
 
-    # Batched correlation: spans x signals spread across all six tiers.
-    n_spans = min(sample_count, 200)
+    # ---- matcher: six-tier join, spans x signal batch -------------------
+    # Spans anchor to the SIGNAL batch's own time base: cbatch comes
+    # from the last measured pass, whose stream starts pass-offset
+    # seconds after `start` — anchoring at `start` would put every
+    # span outside every tier window and gate the matcher on an
+    # all-miss corpus.
+    span_base = datetime.fromtimestamp(
+        int(cbatch.column("ts_unix_nano").min()) / 1e9, tz=timezone.utc
+    )
+    n_spans = min(500, max(50, sample_count // 4))
     spans = [
         SpanRef(
-            timestamp=start + timedelta(milliseconds=10 * i),
-            trace_id=f"trace-{i}" if i % 6 == 0 else "",
-            program_id="jit_step" if i % 6 == 1 else "",
-            launch_id=i if i % 6 == 1 else -1,
-            pod=f"pod-{i % 16}" if i % 6 in (2, 3) else "",
-            pid=(i % 50) + 1 if i % 6 == 2 else 0,
-            conn_tuple=f"tcp:a->{i % 16}" if i % 6 == 3 else "",
-            slice_id="slice-0" if i % 6 == 4 else "",
-            host_index=i % 4 if i % 6 == 4 else -1,
-            service="rag" if i % 6 == 5 else "",
-            node=f"node-{i % 8}" if i % 6 == 5 else "",
+            timestamp=span_base
+            + timedelta(milliseconds=(i * 9901) % (sample_count * 1000)),
+            trace_id=(
+                f"collector-trace-{(i % sample_count) + 1:04d}"
+                if i % 3 == 0 else ""
+            ),
+            program_id="jit_step" if i % 3 == 1 else "",
+            launch_id=(i % sample_count) + 1 if i % 3 == 1 else -1,
+            pod="bench" if i % 3 == 2 else "",
+            pid=1 if i % 3 == 2 else 0,
         )
         for i in range(n_spans)
     ]
-    sigrefs = [
-        SignalRef(
-            signal="dns_latency_ms",
-            timestamp=start + timedelta(milliseconds=10 * (j % n_spans) + 40),
-            trace_id=f"trace-{j % n_spans}" if j % 6 == 0 else "",
-            program_id="jit_step" if j % 6 == 1 else "",
-            launch_id=j % n_spans if j % 6 == 1 else -1,
-            pod=f"pod-{j % 16}" if j % 6 in (2, 3) else "",
-            pid=(j % 50) + 1 if j % 6 == 2 else 0,
-            conn_tuple=f"tcp:a->{j % 16}" if j % 6 == 3 else "",
-            slice_id="slice-0" if j % 6 == 4 else "",
-            host_index=j % 4 if j % 6 == 4 else -1,
-            service="rag" if j % 6 == 5 else "",
-            node=f"node-{j % 8}" if j % 6 == 5 else "",
-        )
-        for j in range(5 * n_spans)
-    ]
-    t0 = time.perf_counter()
-    matches = match_batch(spans, sigrefs)
-    match_elapsed = time.perf_counter() - t0
-    pairs = len(spans) * len(sigrefs)
+    from tpuslo.cli.agent import _signal_ref
 
-    return {
-        "probe_events": events,
-        "probe_events_per_sec": events / elapsed if elapsed > 0 else 0.0,
-        "validations_per_sec": (
-            len(generated) / validate_elapsed if validate_elapsed > 0 else 0.0
+    ts_cache: dict = {}
+    sigrefs = [_signal_ref(e, ts_cache) for e in to_rows(cbatch)]
+    pairs = len(spans) * len(sigrefs)
+    row_match_elapsed = 1e30
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        row_matches = match_batch(spans, sigrefs)
+        row_match_elapsed = min(
+            row_match_elapsed, time.perf_counter() - t0
+        )
+    col_match_elapsed = 1e30
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        sig_cols = signal_columns_from_batch(cbatch)
+        span_cols = span_columns(spans, cbatch.pool)
+        col_matches = match_columns(span_cols, sig_cols)
+        col_match_elapsed = min(
+            col_match_elapsed, time.perf_counter() - t0
+        )
+    col_as_rows = col_matches.to_batch_matches()
+    parity_match = all(
+        (a.signal_index, a.decision) == (b.signal_index, b.decision)
+        for a, b in zip(row_matches, col_as_rows)
+    )
+
+    # ---- posterior: the jittable log-likelihood contraction -------------
+    from tpuslo.attribution.calibrate import calibrated_attributor
+
+    attributor = calibrated_attributor()
+    mats = attributor._matrices().kernel
+    rng = np.random.default_rng(8)
+    n_rows = max(1024, sample_count)
+    n_sig = len(attributor.likelihoods)
+    values = np.abs(rng.lognormal(2.0, 1.5, (n_rows, n_sig)))
+    values[rng.random((n_rows, n_sig)) < 0.2] = 0.0
+    observed = rng.random((n_rows, n_sig)) < 0.9
+
+    def posterior_rate(use_jax: bool) -> tuple[float, np.ndarray]:
+        best = 1e30
+        post = None
+        for _ in range(max(1, repeats)):
+            t0 = time.perf_counter()
+            post, _w, _o = log_posterior_batch(
+                values, observed, mats,
+                soft=True, sharpness=attributor.sharpness,
+                use_jax=use_jax,
+            )
+            best = min(best, time.perf_counter() - t0)
+        return n_rows / best, post
+
+    np_rate, np_post = posterior_rate(False)
+    jit_rate = 0.0
+    parity_posterior = True
+    if jax_available():
+        jit_rate, jit_post = posterior_rate(True)
+        parity_posterior = bool(
+            np.allclose(np_post, jit_post, atol=1e-9)
+            and (np_post.argmax(axis=1) == jit_post.argmax(axis=1)).all()
+        )
+
+    row_rate = row_admitted / row_elapsed if row_elapsed > 0 else 0.0
+    col_rate = col_admitted / col_elapsed if col_elapsed > 0 else 0.0
+    row_match_rate = (
+        pairs / row_match_elapsed if row_match_elapsed > 0 else 0.0
+    )
+    col_match_rate = (
+        pairs / col_match_elapsed if col_match_elapsed > 0 else 0.0
+    )
+    matcher_speedup = (
+        col_match_rate / row_match_rate if row_match_rate > 0 else 0.0
+    )
+    gate_scale = sample_count >= COLUMNAR_GATE_MIN_SAMPLES
+    events_gate_met = col_rate >= COLUMNAR_EVENTS_PER_SEC_FLOOR
+    matcher_gate_met = matcher_speedup >= COLUMNAR_MATCHER_SPEEDUP_FLOOR
+    parity_all = (
+        parity_generate
+        and parity_gate
+        and parity_match
+        and parity_serialize
+        and parity_posterior
+    )
+
+    result = {
+        # Legacy trajectory keys = the row path, now explicitly the
+        # generate->gate spine.
+        "probe_events": row_admitted,
+        "probe_events_per_sec": row_rate,
+        "matcher_pairs_per_sec": row_match_rate,
+        "matcher_matches": sum(
+            1 for m in row_matches if m.decision.matched
         ),
-        "matcher_pairs_per_sec": (
-            pairs / match_elapsed if match_elapsed > 0 else 0.0
-        ),
-        "matcher_matches": sum(1 for m in matches if m.decision.matched),
+        "row": {
+            "probe_events_per_sec": row_rate,
+            "serialize_events_per_sec": (
+                len(events) / row_ser_elapsed
+                if row_ser_elapsed > 0 else 0.0
+            ),
+            "matcher_pairs_per_sec": row_match_rate,
+        },
+        "columnar": {
+            "probe_events": col_admitted,
+            "probe_events_per_sec": col_rate,
+            "serialize_events_per_sec": (
+                len(cbatch) / col_ser_elapsed
+                if col_ser_elapsed > 0 else 0.0
+            ),
+            "matcher_pairs_per_sec": col_match_rate,
+            "matcher_speedup": matcher_speedup,
+            "posterior_samples_per_sec": np_rate,
+            "posterior_samples_per_sec_jit": jit_rate,
+            "jit_available": jax_available(),
+        },
+        "parity": {
+            "generate": parity_generate,
+            "gate_admitted": parity_gate,
+            "matcher": parity_match,
+            "serialize": parity_serialize,
+            "posterior": parity_posterior,
+            "all": parity_all,
+        },
+        "columnar_gates": {
+            "events_per_sec_floor": COLUMNAR_EVENTS_PER_SEC_FLOOR,
+            "matcher_speedup_floor": COLUMNAR_MATCHER_SPEEDUP_FLOOR,
+            "enforced": gate_scale,
+            "events_gate_met": events_gate_met,
+            "matcher_gate_met": matcher_gate_met,
+        },
     }
+    if not parity_all:
+        raise SystemExit(
+            "bench_pipeline: row-vs-columnar parity failed "
+            f"({result['parity']}) — a columnar kernel diverged"
+        )
+    if gate_scale and not (events_gate_met and matcher_gate_met):
+        raise SystemExit(
+            "bench_pipeline: columnar floors not met — "
+            f"events/s {col_rate:,.0f} (floor "
+            f"{COLUMNAR_EVENTS_PER_SEC_FLOOR:,}), matcher speedup "
+            f"{matcher_speedup:.1f}x (floor "
+            f"{COLUMNAR_MATCHER_SPEEDUP_FLOOR:.0f}x)"
+        )
+    return result
 
 
 def _chip_holder_diagnostics() -> list[str]:
@@ -851,6 +1082,56 @@ def _digest_tpu_evidence(artifact: dict) -> dict:
     return d
 
 
+def _round_floats(obj, digits: int):
+    if isinstance(obj, dict):
+        return {k: _round_floats(v, digits) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_round_floats(v, digits) for v in obj]
+    if isinstance(obj, float):
+        return round(obj, digits)
+    return obj
+
+
+def _digest_pipeline(pipeline: dict) -> dict:
+    """Compact row/columnar digest: the gated numbers side by side."""
+    row = pipeline.get("row") or {}
+    col = pipeline.get("columnar") or {}
+    gates = pipeline.get("columnar_gates") or {}
+    parity = pipeline.get("parity") or {}
+    return {
+        "probe_events": pipeline.get("probe_events"),
+        # Legacy trajectory key (BENCH_r01..r05 continuity) = row path.
+        "probe_events_per_sec": round(
+            pipeline.get("probe_events_per_sec", 0.0), 1
+        ),
+        "row_events_per_sec": round(
+            row.get("probe_events_per_sec", 0.0), 1
+        ),
+        "columnar_events_per_sec": round(
+            col.get("probe_events_per_sec", 0.0), 1
+        ),
+        "row_serialize_per_sec": round(
+            row.get("serialize_events_per_sec", 0.0), 1
+        ),
+        "columnar_serialize_per_sec": round(
+            col.get("serialize_events_per_sec", 0.0), 1
+        ),
+        "matcher_pairs_per_sec": round(
+            row.get("matcher_pairs_per_sec", 0.0), 1
+        ),
+        "columnar_matcher_speedup": round(
+            col.get("matcher_speedup", 0.0), 2
+        ),
+        "posterior_jit_per_sec": round(
+            col.get("posterior_samples_per_sec_jit", 0.0), 1
+        ),
+        "columnar_gates_met": bool(
+            gates.get("events_gate_met") and gates.get("matcher_gate_met")
+        ),
+        "parity_ok": bool(parity.get("all")),
+    }
+
+
 def _digest_robustness(robustness: dict) -> dict:
     """Summary of the robustness sweep: the judged numbers only."""
     heldout = robustness.get("calibrated_heldout") or {}
@@ -881,19 +1162,39 @@ def _digest_robustness(robustness: dict) -> dict:
 
 
 def _truncate_strings(obj, limit: int):
+    """Shorten long strings at a word boundary with a visible marker.
+
+    BENCH_r05 shipped diagnostics cut mid-word ("accepts co",
+    "successful TP") because the old writer sliced every string to a
+    hard 60 bytes the moment the line went over budget.  Truncation now
+    (a) backs up to the last word boundary so no word is ever split,
+    and (b) appends ``…`` so a shortened diagnostic can't be misread
+    as the full message.
+    """
     if isinstance(obj, dict):
         return {k: _truncate_strings(v, limit) for k, v in obj.items()}
     if isinstance(obj, list):
         return [_truncate_strings(v, limit) for v in obj]
     if isinstance(obj, str) and len(obj) > limit:
-        return obj[:limit]
+        cut = obj[:limit]
+        space = cut.rfind(" ")
+        if space > limit // 2:
+            cut = cut[:space]
+        return cut.rstrip() + "…"
     return obj
 
 
 def compact_line(result: dict, max_bytes: int = MAX_LINE_BYTES) -> str:
     """Serialize the driver line, enforcing the byte cap with a drop
     ladder (least- to most-essential) so the headline metric and TPU
-    evidence survive any realistic worst case."""
+    evidence survive any realistic worst case.
+
+    Embedded diagnostics (``serving.tpu_error``,
+    ``tpu_evidence.source``) are kept whole as long as the line fits;
+    when it doesn't, they shorten progressively at word boundaries
+    (200 → 120 → 60 chars, interleaved with the structural drops)
+    instead of being sliced mid-word up front.
+    """
     compact = dict(result)
 
     def dumps() -> str:
@@ -904,15 +1205,17 @@ def compact_line(result: dict, max_bytes: int = MAX_LINE_BYTES) -> str:
 
     if size() <= max_bytes:
         return dumps()
-    compact = _truncate_strings(compact, 60)
+    compact = _truncate_strings(compact, 200)
     drops = (
         ("overhead", "sampled_cycles"),
         ("overhead", "cycles_per_sec_tracing_off"),
         ("overhead", "cycles_per_sec_tracing_on"),
+        (None, 120),
         ("serving", "error"),
         ("serving", "tpu_error"),
         ("robustness", "bayes_macro_f1"),
         ("robustness", "calibrated_micro"),
+        (None, 60),
         ("tpu_evidence", "source"),
         ("attribution", "partial_accuracy"),
         ("attribution", "coverage_accuracy"),
@@ -922,7 +1225,9 @@ def compact_line(result: dict, max_bytes: int = MAX_LINE_BYTES) -> str:
     for section, key in drops:
         if size() <= max_bytes:
             break
-        if key is None:
+        if section is None:
+            compact = _truncate_strings(compact, key)
+        elif key is None:
             compact.pop(section, None)
         elif isinstance(compact.get(section), dict):
             compact[section].pop(key, None)
@@ -960,10 +1265,7 @@ def build_result(
         },
         "robustness": robustness_result,
         "overhead": overhead_result,
-        "pipeline": {
-            k: round(v, 2) if isinstance(v, float) else v
-            for k, v in pipeline_result.items()
-        },
+        "pipeline": _round_floats(pipeline_result, 2),
         "serving": serving_result,
     }
     compact = {
@@ -974,7 +1276,7 @@ def build_result(
         "attribution": full["attribution"],
         "robustness": _digest_robustness(robustness_result),
         "overhead": overhead_result,
-        "pipeline": full["pipeline"],
+        "pipeline": _digest_pipeline(pipeline_result),
         "serving": _digest_serving(serving_result),
     }
     if serving_result.get("backend") == "tpu":

@@ -17,6 +17,7 @@ from pathlib import Path
 
 from tpuslo.analysis import FileContext, RepoContext, run_analysis
 from tpuslo.analysis.rules_contracts import (
+    ColumnarDtypeDriftRule,
     ConfigDriftRule,
     MetricsDriftRule,
     SchemaDriftRule,
@@ -150,6 +151,105 @@ class TestSchemaDrift:
         assert any(
             f.code == "TPL102" and "slo_impact" in f.message
             for f in findings
+        )
+
+
+COLUMNAR_REL = "tpuslo/columnar/schema.py"
+
+
+def _columnar_repo(
+    columnar_transform=None, types_transform=None
+) -> RepoContext:
+    """Both TPL103 anchors in context, one (or both) mutated in memory."""
+    contexts = []
+    for rel, transform in (
+        (COLUMNAR_REL, columnar_transform),
+        (TYPES_REL, types_transform),
+    ):
+        source = (REPO / rel).read_text(encoding="utf-8")
+        if transform is not None:
+            source = transform(source)
+        contexts.append(FileContext(REPO / rel, rel, source))
+    return RepoContext(REPO, contexts)
+
+
+class TestColumnarDtypeDrift:
+    def test_real_tree_is_clean(self):
+        assert list(
+            ColumnarDtypeDriftRule().check_repo(_columnar_repo())
+        ) == []
+
+    def test_new_dataclass_field_without_column_flagged(self):
+        repo = _columnar_repo(
+            types_transform=lambda s: s.replace(
+                "    tid: int\n",
+                '    tid: int\n    brand_new: str = ""\n',
+                1,
+            )
+        )
+        findings = list(ColumnarDtypeDriftRule().check_repo(repo))
+        assert any(
+            f.code == "TPL103" and "brand_new" in f.message
+            and "no entry" in f.message
+            for f in findings
+        )
+
+    def test_stale_mapping_flagged(self):
+        repo = _columnar_repo(
+            types_transform=lambda s: s.replace(
+                "    span_id: str = \"\"\n", "", 1
+            )
+        )
+        findings = list(ColumnarDtypeDriftRule().check_repo(repo))
+        assert any(
+            f.code == "TPL103" and "span_id" in f.message
+            and "stale" in f.message
+            for f in findings
+        )
+
+    def test_mapped_column_missing_from_dtype_flagged(self):
+        repo = _columnar_repo(
+            columnar_transform=lambda s: s.replace(
+                '    ("span_id", "i4"),\n', "", 1
+            )
+        )
+        findings = list(ColumnarDtypeDriftRule().check_repo(repo))
+        assert any(
+            f.code == "TPL103" and "'span_id'" in f.message
+            and "missing from _DTYPE_FIELDS" in f.message
+            for f in findings
+        )
+
+    def test_unmapped_dtype_column_flagged(self):
+        repo = _columnar_repo(
+            columnar_transform=lambda s: s.replace(
+                '    ("span_id", "i4"),\n',
+                '    ("span_id", "i4"),\n    ("mystery_col", "i4"),\n',
+                1,
+            )
+        )
+        findings = list(ColumnarDtypeDriftRule().check_repo(repo))
+        assert any(
+            f.code == "TPL103" and "mystery_col" in f.message
+            and "unmapped" in f.message
+            for f in findings
+        )
+
+    def test_non_literal_declarations_flagged(self):
+        repo = _columnar_repo(
+            columnar_transform=lambda s: s.replace(
+                "_DTYPE_FIELDS: tuple[tuple[str, str], ...] = (",
+                "_DTYPE_FIELDS: tuple[tuple[str, str], ...] = tuple(x for x in (",
+                1,
+            ).replace(
+                '    ("tpu_module_name", "i4"),\n)',
+                '    ("tpu_module_name", "i4"),\n))',
+                1,
+            )
+        )
+        findings = list(ColumnarDtypeDriftRule().check_repo(repo))
+        assert any(
+            f.code == "TPL103" and "pure" in f.message for f in findings
         )
 
 

@@ -1,0 +1,73 @@
+"""Columnar bench gates (``make bench-columnar-smoke``).
+
+Fast lane: a small ``bench_pipeline`` run proving the measured path is
+alive, parity holds at every stage, and the result shape carries both
+row and columnar numbers side by side (floors are NOT enforced at toy
+batch sizes — fixed numpy overheads would gate on noise).
+
+Slow lane: the full gate-scale run; the floors the release gates on
+(columnar ≥ 1M events/s, matcher ≥ 10x the row path) must hold or
+``bench_pipeline`` itself hard-fails with SystemExit.
+"""
+
+import pytest
+
+import bench
+
+
+def test_bench_pipeline_smoke_shapes_and_parity():
+    result = bench.bench_pipeline(sample_count=60, repeats=1)
+
+    assert result["probe_events"] > 0
+    assert result["row"]["probe_events_per_sec"] > 0
+    assert result["columnar"]["probe_events_per_sec"] > 0
+    assert result["row"]["serialize_events_per_sec"] > 0
+    assert result["columnar"]["serialize_events_per_sec"] > 0
+    assert result["columnar"]["matcher_pairs_per_sec"] > 0
+    assert result["columnar"]["posterior_samples_per_sec"] > 0
+
+    # Parity is asserted in-run (bench_pipeline raises on divergence);
+    # the flags must also land in the artifact.
+    assert result["parity"]["all"] is True
+    for stage in ("generate", "gate_admitted", "matcher", "serialize"):
+        assert result["parity"][stage] is True
+
+    gates = result["columnar_gates"]
+    assert gates["events_per_sec_floor"] == bench.COLUMNAR_EVENTS_PER_SEC_FLOOR
+    assert gates["enforced"] is False  # toy batch: floors not binding
+
+
+def test_digest_pipeline_is_compact_and_named():
+    result = bench.bench_pipeline(sample_count=60, repeats=1)
+    digest = bench._digest_pipeline(result)
+    assert set(digest) >= {
+        "row_events_per_sec",
+        "columnar_events_per_sec",
+        "columnar_matcher_speedup",
+        "columnar_gates_met",
+        "parity_ok",
+    }
+    assert digest["parity_ok"] is True
+
+
+@pytest.mark.slow
+def test_bench_pipeline_full_run_meets_columnar_floors():
+    # bench_pipeline raises SystemExit itself if the floors regress;
+    # asserting the flags keeps the failure readable either way.
+    result = bench.bench_pipeline(sample_count=2000, repeats=4)
+    # The matcher corpus must actually correlate at gate scale (a
+    # time-anchor regression once measured the 10x floor on an
+    # all-miss corpus where parity held vacuously).
+    assert result["matcher_matches"] > 0
+    gates = result["columnar_gates"]
+    assert gates["enforced"] is True
+    assert gates["events_gate_met"] is True
+    assert gates["matcher_gate_met"] is True
+    assert (
+        result["columnar"]["probe_events_per_sec"]
+        >= bench.COLUMNAR_EVENTS_PER_SEC_FLOOR
+    )
+    assert (
+        result["columnar"]["matcher_speedup"]
+        >= bench.COLUMNAR_MATCHER_SPEEDUP_FLOOR
+    )

@@ -209,7 +209,46 @@ def test_typical_line_keeps_all_digests():
     assert parsed["serving"]["paged_throughput_ratio"] == 1.22
     assert parsed["serving"]["int8_kv_b8_tokens_per_sec"] == 60.1
     assert parsed["overhead"]["meets_3pct_gate"] is True
-    assert parsed["pipeline"]["probe_events_per_sec"] == 123456.78
+    # The pipeline digest rounds rates to one decimal.
+    assert parsed["pipeline"]["probe_events_per_sec"] == 123456.8
+
+
+def test_truncation_is_word_boundary_with_marker():
+    """BENCH_r05 regression: diagnostics were sliced mid-word
+    ("accepts co", "successful TP").  Shortened strings must now end at
+    a word boundary and carry a visible truncation marker."""
+    diagnostic = (
+        "tunnel relay down: no relay port (8082/8092/8102) accepts "
+        "connections, so jax.devices() would hang; skipped the "
+        "probe/backoff ladder"
+    )
+    for limit in (60, 120):
+        out = bench._truncate_strings({"tpu_error": diagnostic}, limit)[
+            "tpu_error"
+        ]
+        assert out.endswith("…")
+        body = out[:-1]
+        assert diagnostic.startswith(body)
+        # The cut lands on a word boundary: the next source character
+        # is the separator the truncation backed up to.
+        assert diagnostic[len(body)] == " "
+    # Under the limit: untouched, no marker.
+    assert bench._truncate_strings({"x": "short"}, 60) == {"x": "short"}
+
+
+def test_overbudget_line_keeps_diagnostics_whole_words():
+    serving = _worst_case_serving()
+    original_error = serving.get("tpu_error", "")
+    line = bench.compact_line(_build_compact(serving))
+    assert len(line.encode()) <= bench.MAX_LINE_BYTES
+    parsed = json.loads(line)
+    value = (parsed.get("serving") or {}).get("tpu_error")
+    if isinstance(value, str) and value != original_error:
+        # Shortened: must be a whole-word prefix with the marker.
+        assert value.endswith("…")
+        body = value[:-1]
+        assert original_error.startswith(body)
+        assert original_error[len(body)] == " "
 
 
 def test_live_tpu_line_stamps_live_evidence():

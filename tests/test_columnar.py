@@ -1,0 +1,212 @@
+"""Columnar schema units: dtype contract, pool, adapters, arena."""
+
+from datetime import datetime, timezone
+
+import numpy as np
+import pytest
+
+from tpuslo.columnar.schema import (
+    COLUMNS_FOR_FIELD,
+    PROBE_EVENT_DTYPE,
+    STRING_COLUMNS,
+    ColumnarBatch,
+    StringPool,
+    alloc_batch_columns,
+    empty_batch,
+    from_payloads,
+    from_rows,
+    to_payloads,
+    to_rows,
+)
+from tpuslo.schema import ConnTuple, ProbeEventV1, TPURef
+
+TS = int(datetime(2026, 1, 1, tzinfo=timezone.utc).timestamp() * 1e9)
+
+
+def _event(i: int = 0, **overrides) -> ProbeEventV1:
+    base = dict(
+        ts_unix_nano=TS + i,
+        signal="dns_latency_ms",
+        node="node-0",
+        namespace="llm",
+        pod="pod-1",
+        container="c",
+        pid=3,
+        tid=4,
+        value=12.5,
+        unit="ms",
+        status="ok",
+    )
+    base.update(overrides)
+    return ProbeEventV1(**base)
+
+
+class TestDtypeContract:
+    def test_every_dataclass_field_is_mapped(self):
+        import dataclasses
+
+        field_names = {f.name for f in dataclasses.fields(ProbeEventV1)}
+        assert field_names == set(COLUMNS_FOR_FIELD)
+
+    def test_every_mapped_column_exists_and_none_is_orphaned(self):
+        mapped = {c for cols in COLUMNS_FOR_FIELD.values() for c in cols}
+        assert mapped == set(PROBE_EVENT_DTYPE.names)
+
+    def test_string_columns_are_dtype_columns(self):
+        assert set(STRING_COLUMNS) <= set(PROBE_EVENT_DTYPE.names)
+
+
+class TestStringPool:
+    def test_code_zero_is_empty_string(self):
+        pool = StringPool()
+        assert pool.get(0) == ""
+        assert pool.intern("") == 0
+
+    def test_intern_is_stable_and_append_only(self):
+        pool = StringPool()
+        a = pool.intern("x")
+        b = pool.intern("y")
+        assert pool.intern("x") == a
+        assert (a, b) == (1, 2)
+        assert pool.strings == ["", "x", "y"]
+
+    def test_derived_caches_extend_after_growth(self):
+        pool = StringPool()
+        pool.intern("x")
+        h1 = pool.content_hashes()
+        e1 = list(pool.escaped())  # escaped() returns the live cache
+        pool.intern('needs "escaping"')
+        h2 = pool.content_hashes()
+        e2 = pool.escaped()
+        assert len(h2) == len(e2) == 3
+        assert list(h2[:2]) == list(h1)
+        assert e2[:2] == e1
+        assert e2[2] == '"needs \\"escaping\\""'
+
+
+class TestRowAdapters:
+    def test_round_trip_plain_event(self):
+        events = [_event(i) for i in range(5)]
+        assert to_rows(from_rows(events)) == events
+
+    def test_round_trip_full_envelopes(self):
+        events = [
+            _event(
+                0,
+                conn_tuple=ConnTuple("1.2.3.4", "5.6.7.8", 1, 2, "tcp"),
+                trace_id="t-1",
+                span_id="s-1",
+                errno=110,
+                confidence=0.5,
+                tpu=TPURef(
+                    chip="accel0",
+                    slice_id="sl",
+                    host_index=2,
+                    ici_link=0,
+                    program_id="jit",
+                    launch_id=7,
+                    module_name="mod",
+                ),
+            ),
+            _event(1, errno=0, confidence=0.0),  # present-but-zero
+            _event(2, tpu=TPURef()),  # empty tpu block
+        ]
+        back = to_rows(from_rows(events))
+        assert back == events
+        # errno=0 and confidence=0.0 are PRESENT (to_dict emits them).
+        assert back[1].errno == 0
+        assert back[1].confidence == 0.0
+
+    def test_value_normalizes_to_float(self):
+        back = to_rows(from_rows([_event(0, value=12)]))
+        assert back[0].value == 12.0
+        assert isinstance(back[0].value, float)
+
+    def test_payload_round_trip_matches_to_dict(self):
+        events = [
+            _event(0, trace_id="t", errno=7),
+            _event(
+                1,
+                conn_tuple=ConnTuple("1.2.3.4", "5.6.7.8", 1, 2, "udp"),
+                tpu=TPURef(chip="accel1", launch_id=3),
+            ),
+        ]
+        batch = from_rows(events)
+        expected = []
+        for e in to_rows(batch):  # float-normalized view
+            expected.append(e.to_dict())
+        assert to_payloads(batch) == expected
+
+    def test_from_payloads_separates_rejects_with_input_index(self):
+        good = _event(0).to_dict()
+        bad = {"nope": 1}
+        batch, rejects = from_payloads([good, bad, dict(good)])
+        assert len(batch) == 2
+        assert [i for i, _ in rejects] == [1]
+        assert rejects[0][1] is bad
+
+    def test_structured_round_trip(self):
+        events = [_event(i, trace_id=f"t{i}") for i in range(4)]
+        batch = from_rows(events)
+        packed = batch.to_structured()
+        assert packed.dtype == PROBE_EVENT_DTYPE
+        again = ColumnarBatch.from_structured(packed, batch.pool)
+        assert to_rows(again) == events
+
+
+class TestBatchOps:
+    def test_take_and_with_column_share_pool(self):
+        events = [_event(i) for i in range(6)]
+        batch = from_rows(events)
+        sub = batch.take(np.array([1, 3]))
+        assert sub.pool is batch.pool
+        assert to_rows(sub) == [events[1], events[3]]
+        ts = sub.column("ts_unix_nano") + 5
+        bumped = sub.with_column("ts_unix_nano", ts)
+        assert bumped.column("value") is sub.column("value")
+        assert to_rows(bumped)[0].ts_unix_nano == events[1].ts_unix_nano + 5
+
+    def test_empty_batch_defaults(self):
+        batch = empty_batch(3)
+        assert np.isnan(batch.column("confidence")).all()
+        assert (batch.column("tpu_launch_id") == -1).all()
+        assert len(empty_batch(0)) == 0
+
+    def test_arena_views_cover_every_dtype_field(self):
+        cols = alloc_batch_columns(17)
+        assert set(cols) == set(PROBE_EVENT_DTYPE.names)
+        for name, fmt in zip(
+            PROBE_EVENT_DTYPE.names,
+            (PROBE_EVENT_DTYPE[n] for n in PROBE_EVENT_DTYPE.names),
+        ):
+            assert cols[name].dtype == fmt
+            assert len(cols[name]) == 17
+        # Views must be writable and disjoint.
+        cols["ts_unix_nano"][:] = 7
+        cols["pid"][:] = 9
+        assert (cols["ts_unix_nano"] == 7).all()
+        assert (cols["pid"] == 9).all()
+
+
+class TestHotpathRegistration:
+    def test_columnar_kernels_are_lint_governed(self):
+        from tpuslo.analysis.hotpaths import HOT_DATACLASSES, HOT_FUNCTIONS
+
+        functions = {qual for _, qual in HOT_FUNCTIONS}
+        assert {
+            "columns_from_samples",
+            "ColumnarGate.admit_batch",
+            "match_columns",
+            "log_posterior_batch",
+            "serialize_jsonl",
+        } <= functions
+        classes = {name for _, name in HOT_DATACLASSES}
+        assert {"ColumnarBatch", "StringPool", "MatchColumns"} <= classes
+
+
+@pytest.mark.parametrize("n", [0, 1, 257])
+def test_from_rows_sizes(n):
+    events = [_event(i, trace_id=f"t{i % 7}") for i in range(n)]
+    batch = from_rows(events)
+    assert len(batch) == n
+    assert to_rows(batch) == events

@@ -1,0 +1,522 @@
+"""Seeded row-vs-columnar equivalence at every pipeline stage.
+
+The columnar spine (ISSUE 8) is only allowed to be fast because it is
+provably the same pipeline: these tests drive identical inputs through
+the row and columnar implementations of generate → gate → correlate →
+attribute → serialize and require identical outputs — including under
+the seeded chaos-telemetry stream (skew / dup / reorder / corrupt), so
+columnar admission is exactly as strict as the row gate.
+"""
+
+import json
+import random
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+import pytest
+
+from tpuslo import collector, signals
+from tpuslo.chaos.telemetry import ChaosScenario, ChaosStream
+from tpuslo.columnar.gate import ColumnarGate, dedup_hashes
+from tpuslo.columnar.match import (
+    match_batch_columnar,
+    match_columns,
+    signal_columns_from_batch,
+    span_columns,
+)
+from tpuslo.columnar.posterior import jax_available, log_posterior_batch
+from tpuslo.columnar.schema import from_payloads, from_rows, to_payloads, to_rows
+from tpuslo.columnar.serialize import serialize_jsonl
+from tpuslo.correlation.matcher import SignalRef, SpanRef, match_batch
+from tpuslo.ingest.gate import GateConfig, TelemetryGate
+
+START = datetime(2026, 1, 1, tzinfo=timezone.utc)
+
+
+def _generator() -> signals.Generator:
+    return signals.Generator(signals.CAPABILITY_TPU_FULL)
+
+
+def _meta(host: int = 0, node: str = "node-0") -> signals.Metadata:
+    return signals.Metadata(
+        node=node, namespace="llm", pod="pod-1", container="c",
+        pid=3, tid=4, tpu_chip="accel0", slice_id="slice-0",
+        host_index=host, xla_program_id="jit_step",
+    )
+
+
+def _multi_host_payloads(samples_per_host: int = 30) -> list[dict]:
+    gen = _generator()
+    payloads: list[dict] = []
+    for host in range(3):
+        samples = collector.generate_synthetic_samples(
+            "tpu_mixed", samples_per_host, START, collector.SampleMeta()
+        )
+        payloads.extend(
+            e.to_dict()
+            for e in gen.generate_batch(
+                samples, _meta(host, f"node-{host}")
+            )
+        )
+    payloads.sort(key=lambda p: p["ts_unix_nano"])
+    return payloads
+
+
+def _norm(payload: dict) -> dict:
+    out = dict(payload)
+    out["value"] = float(out["value"])  # columnar f8 normalization
+    return out
+
+
+def _assert_gate_parity(stream, config_kwargs=None, chunks=1):
+    row = TelemetryGate(GateConfig(**(config_kwargs or {})))
+    col = ColumnarGate(GateConfig(**(config_kwargs or {})))
+    n = len(stream)
+    for k in range(chunks):
+        chunk = stream[k * n // chunks:(k + 1) * n // chunks]
+        rb = row.admit_all([dict(p) for p in chunk])
+        cb = col.admit_payloads([dict(p) for p in chunk])
+        assert [_norm(p) for p in rb.admitted] == to_payloads(cb.admitted)
+        assert [
+            (_norm(entry.event), entry.lag_ns) for entry in rb.late
+        ] == list(zip(to_payloads(cb.late), cb.late_lag_ns.tolist()))
+    for attr in (
+        "admitted",
+        "duplicates",
+        "quarantined",
+        "late_admitted",
+        "skew_corrected",
+    ):
+        assert getattr(row, attr) == getattr(col, attr), attr
+    assert row.quarantined_by_reason == col.quarantined_by_reason
+    assert row.snapshot()["watermark_ns"] == col.snapshot()["watermark_ns"]
+    return row
+
+
+class TestGenerateParity:
+    def test_columnar_generation_equals_row_generation(self):
+        gen = _generator()
+        samples = collector.generate_synthetic_samples(
+            "tpu_mixed", 40, START, collector.SampleMeta()
+        )
+        rows = gen.generate_batch(samples, _meta())
+        assert rows == to_rows(gen.generate_batch_columnar(samples, _meta()))
+
+    def test_all_scenarios_and_shed_signals(self):
+        gen = _generator()
+        gen.disable_highest_cost()
+        for scenario in ("mixed", "baseline", "tpu_mixed", "mixed_multi"):
+            samples = collector.generate_synthetic_samples(
+                scenario, 12, START, collector.SampleMeta()
+            )
+            assert gen.generate_batch(samples, _meta()) == to_rows(
+                gen.generate_batch_columnar(samples, _meta())
+            )
+
+    def test_per_sample_trace_ids(self):
+        gen = _generator()
+        samples = collector.generate_synthetic_samples(
+            "tpu_mixed", 10, START, collector.SampleMeta()
+        )
+        batch = gen.generate_batch_columnar(
+            samples, _meta(), trace_ids=[s.trace_id for s in samples]
+        )
+        rows = to_rows(batch)
+        per_sample = len(rows) // len(samples)
+        for i, sample in enumerate(samples):
+            group = rows[i * per_sample:(i + 1) * per_sample]
+            assert {e.trace_id for e in group} == {sample.trace_id}
+
+
+class TestGateParity:
+    @pytest.mark.parametrize("seed", [7, 21, 1337])
+    def test_chaos_stream_admission(self, seed):
+        payloads = _multi_host_payloads()
+        chaos = ChaosStream(ChaosScenario.at_intensity(1.0, seed=seed))
+        stream = list(chaos.stream([dict(p) for p in payloads]))
+        row = _assert_gate_parity(stream)
+        assert row.quarantined > 0  # chaos corruption actually fired
+        assert row.skew_corrected > 0
+
+    def test_heavy_chaos_multi_batch(self):
+        payloads = _multi_host_payloads()
+        chaos = ChaosStream(ChaosScenario.at_intensity(3.0, seed=5))
+        stream = list(chaos.stream([dict(p) for p in payloads]))
+        row = _assert_gate_parity(stream, chunks=5)
+        assert row.duplicates > 0
+
+    def test_dense_duplicates_small_window(self):
+        payloads = _multi_host_payloads(10)
+        rng = random.Random(11)
+        stream = [
+            dict(payloads[rng.randrange(25)]) for _ in range(300)
+        ]
+        row = _assert_gate_parity(
+            stream, {"dedup_window": 8}, chunks=3
+        )
+        assert row.duplicates > 0
+
+    def test_out_of_order_late_routing(self):
+        payloads = _multi_host_payloads(15)
+        stream = payloads[40:] + payloads[:40]
+        row = _assert_gate_parity(stream)
+        assert row.late_admitted > 0
+
+    def test_dedup_hash_distinguishes_distinct_events(self):
+        gen = _generator()
+        samples = collector.generate_synthetic_samples(
+            "tpu_mixed", 50, START, collector.SampleMeta()
+        )
+        batch = gen.generate_batch_columnar(samples, _meta())
+        hashes = dedup_hashes(batch)
+        assert len(np.unique(hashes)) == len(batch)
+
+
+def _rand_ref(rng, cls, start):
+    kind = rng.randrange(8)
+    ts = (
+        None
+        if rng.random() < 0.1
+        else start + timedelta(microseconds=rng.randrange(0, 3_000_000))
+    )
+    kwargs = {"timestamp": ts}
+    if kind == 0 or rng.random() < 0.3:
+        kwargs["trace_id"] = f"trace-{rng.randrange(20)}"
+    if kind == 1:
+        kwargs["program_id"], kwargs["launch_id"] = "jit", rng.randrange(10)
+    if kind == 2:
+        kwargs["pod"], kwargs["pid"] = f"pod-{rng.randrange(5)}", rng.randrange(0, 8)
+    if kind == 3:
+        kwargs["pod"] = f"pod-{rng.randrange(5)}"
+        kwargs["conn_tuple"] = f"tcp:a->{rng.randrange(4)}"
+    if kind == 4:
+        kwargs["slice_id"] = f"sl-{rng.randrange(3)}"
+        kwargs["host_index"] = rng.randrange(-1, 4)
+    if kind == 5:
+        kwargs["service"], kwargs["node"] = "rag", f"n-{rng.randrange(4)}"
+    if cls is SignalRef:
+        kwargs["signal"] = "dns_latency_ms"
+        kwargs["value"] = 1.0
+    return cls(**kwargs)
+
+
+class TestMatcherParity:
+    def test_fuzzed_tiers_match_row_matcher(self):
+        rng = random.Random(42)
+        for _ in range(25):
+            spans = [
+                _rand_ref(rng, SpanRef, START)
+                for _ in range(rng.randrange(1, 50))
+            ]
+            sigs = [
+                _rand_ref(rng, SignalRef, START)
+                for _ in range(rng.randrange(0, 150))
+            ]
+            window = rng.choice([0, 50, 100, 250, 2000])
+            row = match_batch(spans, sigs, window)
+            col = match_batch_columnar(spans, sigs, window)
+            for a, b in zip(row, col):
+                assert (a.span_index, a.signal_index, a.decision) == (
+                    b.span_index,
+                    b.signal_index,
+                    b.decision,
+                )
+
+    @pytest.mark.parametrize(
+        "edge_us", [99_999, 100_000, 100_001, 250_000, 500_000, 500_001]
+    )
+    def test_window_edges_inclusive(self, edge_us):
+        spans = [SpanRef(timestamp=START, pod="p", pid=3)]
+        sigs = [
+            SignalRef(
+                signal="x",
+                timestamp=START + timedelta(microseconds=edge_us),
+                pod="p",
+                pid=3,
+            )
+        ]
+        assert (
+            match_batch(spans, sigs)[0].decision
+            == match_batch_columnar(spans, sigs)[0].decision
+        )
+
+    def test_missing_timestamp_trace_joins(self):
+        spans = [
+            SpanRef(timestamp=START, trace_id="t1"),
+            SpanRef(trace_id="t1"),
+            SpanRef(trace_id="zz"),
+            SpanRef(timestamp=START),
+        ]
+        sigs = [
+            SignalRef(signal="x", trace_id="t1"),
+            SignalRef(signal="y", trace_id="t1"),
+        ]
+        row = match_batch(spans, sigs)
+        col = match_batch_columnar(spans, sigs)
+        assert [(m.signal_index, m.decision) for m in row] == [
+            (m.signal_index, m.decision) for m in col
+        ]
+
+    def test_wide_ids_take_dense_rank_fallback(self):
+        spans = [SpanRef(timestamp=START, program_id="jit", launch_id=2**40)]
+        sigs = [
+            SignalRef(
+                signal="x", timestamp=START, program_id="jit",
+                launch_id=2**40,
+            )
+        ]
+        row = match_batch(spans, sigs)
+        col = match_batch_columnar(spans, sigs)
+        assert row[0].decision == col[0].decision
+        assert row[0].signal_index == col[0].signal_index
+
+    def test_batch_signals_match_signal_ref_path(self):
+        gen = _generator()
+        samples = collector.generate_synthetic_samples(
+            "tpu_mixed", 60, START, collector.SampleMeta()
+        )
+        batch = gen.generate_batch_columnar(
+            samples, _meta(), trace_ids=[s.trace_id for s in samples]
+        )
+        from tpuslo.cli.agent import _signal_ref
+
+        cache: dict = {}
+        refs = [_signal_ref(e, cache) for e in to_rows(batch)]
+        spans = [
+            SpanRef(
+                timestamp=START + timedelta(seconds=i),
+                trace_id=f"collector-trace-{i + 1:04d}" if i % 2 else "",
+                program_id="jit_step" if not i % 2 else "",
+                launch_id=i + 1 if not i % 2 else -1,
+            )
+            for i in range(40)
+        ]
+        row = match_batch(spans, refs)
+        sig_cols = signal_columns_from_batch(batch)
+        col = match_columns(
+            span_columns(spans, batch.pool), sig_cols
+        ).to_batch_matches()
+        assert [(m.signal_index, m.decision) for m in row] == [
+            (m.signal_index, m.decision) for m in col
+        ]
+
+
+class TestSerializeParity:
+    def test_byte_equality_with_row_serialization(self):
+        gen = _generator()
+        samples = collector.generate_synthetic_samples(
+            "tpu_mixed", 30, START, collector.SampleMeta()
+        )
+        meta = _meta()
+        meta = signals.Metadata(
+            node=meta.node, namespace=meta.namespace, pod='p"od\n',
+            container="c%s", pid=3, tid=4, tpu_chip="accel0",
+            slice_id=meta.slice_id, host_index=1,
+            xla_program_id=meta.xla_program_id,
+        )
+        batch = gen.generate_batch_columnar(
+            samples, meta, trace_ids=[s.trace_id for s in samples]
+        )
+        expected = "".join(
+            json.dumps(p, separators=(",", ":")) + "\n"
+            for p in to_payloads(batch)
+        )
+        assert serialize_jsonl(batch) == expected
+        expected_kind = "".join(
+            json.dumps({"kind": "probe", **p}, separators=(",", ":"))
+            + "\n"
+            for p in to_payloads(batch)
+        )
+        assert serialize_jsonl(batch, kind="probe") == expected_kind
+
+    def test_low_redundancy_direct_path(self):
+        rng = random.Random(3)
+        payloads = []
+        base = to_payloads(from_rows(to_rows(from_payloads(
+            _multi_host_payloads(4)
+        )[0])))
+        for p in base[:50]:
+            q = dict(p)
+            q["value"] = rng.random() * 100
+            q["pid"] = rng.randrange(1, 10_000)
+            if rng.random() < 0.5:
+                q["confidence"] = round(rng.random(), 4)
+            if rng.random() < 0.4:
+                q["errno"] = rng.randrange(0, 130)
+            payloads.append(q)
+        batch, rejects = from_payloads(payloads)
+        assert not rejects
+        assert serialize_jsonl(batch) == "".join(
+            json.dumps(p, separators=(",", ":")) + "\n"
+            for p in to_payloads(batch)
+        )
+
+    def test_empty_batch(self):
+        batch, _ = from_payloads([])
+        assert serialize_jsonl(batch) == ""
+
+
+class TestPosteriorParity:
+    def _batch_inputs(self, n=256, seed=4):
+        from tpuslo.attribution.calibrate import calibrated_attributor
+
+        attributor = calibrated_attributor()
+        mats = attributor._matrices().kernel
+        rng = np.random.default_rng(seed)
+        n_sig = len(attributor.likelihoods)
+        values = np.abs(rng.lognormal(2.0, 1.5, (n, n_sig)))
+        values[rng.random((n, n_sig)) < 0.2] = 0.0
+        observed = rng.random((n, n_sig)) < 0.9
+        return attributor, mats, values, observed
+
+    def test_scalar_vs_kernel_ranking(self):
+        from tpuslo.attribution.calibrate import calibrated_attributor
+        from tpuslo.faultreplay import generate_fault_samples
+
+        attributor = calibrated_attributor()
+        samples = []
+        for scenario in ("ici_drop", "hbm_pressure"):
+            samples.extend(
+                generate_fault_samples(scenario, 10, START)
+            )
+        batch = attributor.attribute_batch(samples, use_jax=False)
+        single = [attributor.attribute_sample(s) for s in samples]
+        assert [a.predicted_fault_domain for a in batch] == [
+            a.predicted_fault_domain for a in single
+        ]
+        for a, b in zip(batch, single):
+            assert a.confidence == pytest.approx(b.confidence, abs=1e-9)
+
+    @pytest.mark.skipif(not jax_available(), reason="jax not importable")
+    def test_numpy_vs_jit_kernel(self):
+        attributor, mats, values, observed = self._batch_inputs()
+        np_post, np_w, np_obs = log_posterior_batch(
+            values, observed, mats,
+            soft=True, sharpness=attributor.sharpness, use_jax=False,
+        )
+        jx_post, jx_w, jx_obs = log_posterior_batch(
+            values, observed, mats,
+            soft=True, sharpness=attributor.sharpness, use_jax=True,
+        )
+        assert np.allclose(np_post, jx_post, atol=1e-10)
+        assert (np_post.argmax(axis=1) == jx_post.argmax(axis=1)).all()
+        assert np.allclose(np_w, jx_w, atol=1e-12)
+        assert (np_obs == jx_obs).all()
+
+    @pytest.mark.skipif(not jax_available(), reason="jax not importable")
+    def test_jit_hard_mode(self):
+        attributor, mats, values, observed = self._batch_inputs(seed=9)
+        np_post, _, _ = log_posterior_batch(
+            values, observed, mats,
+            soft=False, sharpness=1.0, use_jax=False,
+        )
+        jx_post, _, _ = log_posterior_batch(
+            values, observed, mats,
+            soft=False, sharpness=1.0, use_jax=True,
+        )
+        assert np.allclose(np_post, jx_post, atol=1e-10)
+
+    def test_attribute_batch_use_jax_matches_numpy(self):
+        if not jax_available():
+            pytest.skip("jax not importable")
+        from tpuslo.attribution.calibrate import calibrated_attributor
+        from tpuslo.faultreplay import generate_fault_samples
+
+        attributor = calibrated_attributor()
+        samples = generate_fault_samples("xla_recompile_storm", 15, START)
+        a = attributor.attribute_batch(samples, use_jax=False)
+        b = attributor.attribute_batch(samples, use_jax=True)
+        assert [x.predicted_fault_domain for x in a] == [
+            x.predicted_fault_domain for x in b
+        ]
+        for x, y in zip(a, b):
+            assert x.confidence == pytest.approx(y.confidence, abs=1e-9)
+
+
+class TestAgentColumnarLoop:
+    def test_agent_columnar_emits_contract_valid_jsonl(self, tmp_path):
+        from tpuslo.cli import agent as agent_cli
+        from tpuslo.schema.fastpath import validate_probe_payload
+
+        out = tmp_path / "probe.jsonl"
+        rc = agent_cli.main(
+            [
+                "--columnar",
+                "--columnar-batch", "16",
+                "--count", "3",
+                "--interval-s", "0",
+                "--scenario", "tpu_mixed",
+                "--event-kind", "probe",
+                "--capability-mode", "tpu_full",
+                "--output", "jsonl",
+                "--jsonl-path", str(out),
+                "--metrics-port", "0",
+            ]
+        )
+        assert rc == 0
+        lines = out.read_text().splitlines()
+        # 3 cycles x 16 samples x one event per enabled signal (the
+        # enabled set depends on the resolved capability mode).
+        assert lines
+        assert len(lines) % (3 * 16) == 0
+        traces = set()
+        for line in lines:
+            payload = json.loads(line)
+            assert payload.pop("kind") == "probe"
+            assert validate_probe_payload(payload)
+            traces.add(payload.get("trace_id", ""))
+        # Per-sample trace identity survived the columnar path.
+        assert len(traces) == 3 * 16
+
+
+class TestEndToEndSpine:
+    """generate → gate → correlate → serialize, both paths, one stream."""
+
+    def test_full_pipeline_equivalence(self):
+        gen = _generator()
+        samples = collector.generate_synthetic_samples(
+            "tpu_mixed", 50, START, collector.SampleMeta()
+        )
+        meta = _meta()
+        trace_ids = [s.trace_id for s in samples]
+
+        # Row: generate -> dicts -> gate -> refs -> match -> serialize.
+        row_events = gen.generate_batch(samples, meta)
+        row_gate = TelemetryGate(GateConfig())
+        row_gated = row_gate.admit_all([e.to_dict() for e in row_events])
+
+        col_gate = ColumnarGate(GateConfig())
+        batch = gen.generate_batch_columnar(samples, meta)
+        col_result = col_gate.admit_batch(batch)
+
+        assert [
+            _norm(p) for p in row_gated.admitted
+        ] == to_payloads(col_result.admitted)
+
+        spans = [
+            SpanRef(
+                timestamp=START + timedelta(seconds=i),
+                service="rag-service",
+                node=meta.node,
+                trace_id=trace_ids[i],
+            )
+            for i in range(20)
+        ]
+        from tpuslo.correlation.matcher import SignalRef as _SR
+
+        refs = [
+            _SR.from_probe_dict(p) for p in row_gated.admitted
+        ]
+        row_match = match_batch(spans, refs)
+        col_match = match_columns(
+            span_columns(spans, col_result.admitted.pool),
+            signal_columns_from_batch(col_result.admitted),
+        ).to_batch_matches()
+        assert [(m.signal_index, m.decision) for m in row_match] == [
+            (m.signal_index, m.decision) for m in col_match
+        ]
+
+        assert serialize_jsonl(col_result.admitted) == "".join(
+            json.dumps(_norm(p), separators=(",", ":")) + "\n"
+            for p in row_gated.admitted
+        )

@@ -45,6 +45,19 @@ HOT_FUNCTIONS: tuple[tuple[str, str], ...] = (
     ("tpuslo/sloengine/stream.py", "TenantWindows.record"),
     ("tpuslo/sloengine/stream.py", "TenantWindows.roll_to"),
     ("tpuslo/sloengine/engine.py", "BurnEngine.record"),
+    # Columnar spine (ISSUE 8): the batch kernels behind the 1M-events/s
+    # gate.  serialize_jsonl is registered precisely because its row
+    # twin's cost IS json.dumps — strings escape once per pool entry
+    # via StringPool.escaped(), never per event.
+    ("tpuslo/columnar/generate.py", "columns_from_samples"),
+    ("tpuslo/columnar/gate.py", "dedup_hashes"),
+    ("tpuslo/columnar/gate.py", "ColumnarGate.admit_batch"),
+    ("tpuslo/columnar/gate.py", "ColumnarGate._dedup_batch"),
+    ("tpuslo/columnar/match.py", "signal_columns_from_batch"),
+    ("tpuslo/columnar/match.py", "match_columns"),
+    ("tpuslo/columnar/match.py", "_tier_probe"),
+    ("tpuslo/columnar/posterior.py", "log_posterior_batch"),
+    ("tpuslo/columnar/serialize.py", "serialize_jsonl"),
 )
 
 #: (repo-relative module path, dataclass name) pairs that are allocated
@@ -59,4 +72,11 @@ HOT_DATACLASSES: tuple[tuple[str, str], ...] = (
     ("tpuslo/correlation/matcher.py", "Decision"),
     ("tpuslo/correlation/matcher.py", "BatchMatch"),
     ("tpuslo/sloengine/stream.py", "RequestOutcome"),
+    # Columnar spine containers (ISSUE 8).
+    ("tpuslo/columnar/schema.py", "StringPool"),
+    ("tpuslo/columnar/schema.py", "ColumnarBatch"),
+    ("tpuslo/columnar/gate.py", "ColumnarGateBatch"),
+    ("tpuslo/columnar/match.py", "MatchColumns"),
+    ("tpuslo/columnar/match.py", "ColumnarMatches"),
+    ("tpuslo/columnar/posterior.py", "PosteriorMatrices"),
 )

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 from tpuslo.analysis.core import Rule
 from tpuslo.analysis.rules_contracts import (
+    ColumnarDtypeDriftRule,
     ConfigDriftRule,
     MetricsDriftRule,
     SchemaDriftRule,
@@ -20,6 +21,7 @@ from tpuslo.analysis.rules_style import StyleRules
 ALL_RULES: tuple[Rule, ...] = (
     StyleRules(),
     SchemaDriftRule(),
+    ColumnarDtypeDriftRule(),
     ConfigDriftRule(),
     MetricsDriftRule(),
     LockDisciplineRule(),

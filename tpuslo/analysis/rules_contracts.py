@@ -331,6 +331,189 @@ class SchemaDriftRule(Rule):
         return findings
 
 
+# --- TPL103: columnar dtype drift ---------------------------------------
+
+_COLUMNAR_REL = "tpuslo/columnar/schema.py"
+
+
+def _literal_tuple_pairs(node: ast.AST) -> list[tuple[str, str]] | None:
+    """Parse a ``((name, fmt), ...)`` literal; None if not that shape."""
+    if not isinstance(node, ast.Tuple):
+        return None
+    out: list[tuple[str, str]] = []
+    for elt in node.elts:
+        if not (
+            isinstance(elt, ast.Tuple)
+            and len(elt.elts) == 2
+            and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in elt.elts
+            )
+        ):
+            return None
+        out.append((elt.elts[0].value, elt.elts[1].value))
+    return out
+
+
+def _literal_columns_map(node: ast.AST) -> dict[str, tuple[str, ...]] | None:
+    """Parse a ``{"field": ("col", ...)}`` literal; None if not that."""
+    if not isinstance(node, ast.Dict):
+        return None
+    out: dict[str, tuple[str, ...]] = {}
+    for key, value in zip(node.keys, node.values):
+        if not (
+            isinstance(key, ast.Constant) and isinstance(key.value, str)
+        ):
+            return None
+        if not isinstance(value, ast.Tuple):
+            return None
+        cols = []
+        for e in value.elts:
+            if not (
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+            ):
+                return None
+            cols.append(e.value)
+        out[key.value] = tuple(cols)
+    return out
+
+
+class ColumnarDtypeDriftRule(Rule):
+    """TPL103: the columnar dtype must stay derived from ProbeEventV1.
+
+    ``tpuslo/columnar/schema.py`` declares the batch dtype
+    (``_DTYPE_FIELDS``) and the field→columns derivation map
+    (``COLUMNS_FOR_FIELD``) as pure literals precisely so this rule can
+    re-check, on every lint run, that
+
+    * every ``ProbeEventV1`` dataclass field is mapped to columns,
+    * every mapped field still exists on the dataclass,
+    * every mapped column exists in the dtype, and
+    * every dtype column is reachable from some field's mapping —
+
+    i.e. adding/renaming/dropping a probe-event field without the
+    matching columnar change (or vice versa) fails ``make lint``.
+    """
+
+    code = "TPL103"
+    codes = ("TPL103",)
+    repo_anchors = (_TYPES_REL, _COLUMNAR_REL)
+    name = "columnar-dtype-drift"
+    rationale = (
+        "the columnar batch dtype in tpuslo/columnar/schema.py is "
+        "derived from ProbeEventV1 and must track its fields in both "
+        "directions"
+    )
+
+    def check_repo(self, repo: RepoContext) -> Iterable[Finding]:
+        ctx = repo.by_rel.get(_COLUMNAR_REL)
+        types_ctx = repo.by_rel.get(_TYPES_REL)
+        if ctx is None or ctx.tree is None:
+            return ()
+        findings: list[Finding] = []
+        dtype_fields: list[tuple[str, str]] | None = None
+        columns_map: dict[str, tuple[str, ...]] | None = None
+        dtype_line = map_line = 1
+        for node in ctx.tree.body:
+            targets: list[ast.expr] = []
+            value: ast.expr | None = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            for target in targets:
+                if not isinstance(target, ast.Name):
+                    continue
+                if target.id == "_DTYPE_FIELDS":
+                    dtype_fields = _literal_tuple_pairs(value)
+                    dtype_line = node.lineno
+                elif target.id == "COLUMNS_FOR_FIELD":
+                    columns_map = _literal_columns_map(value)
+                    map_line = node.lineno
+        if dtype_fields is None or columns_map is None:
+            findings.append(
+                Finding(
+                    _COLUMNAR_REL,
+                    1,
+                    "TPL103",
+                    "_DTYPE_FIELDS / COLUMNS_FOR_FIELD must be pure "
+                    "literals (the dtype-sync check parses them from "
+                    "the AST)",
+                )
+            )
+            return findings
+
+        event_fields: list[_Field] = []
+        if types_ctx is not None and types_ctx.tree is not None:
+            for node in ast.walk(types_ctx.tree):
+                if (
+                    isinstance(node, ast.ClassDef)
+                    and node.name == "ProbeEventV1"
+                ):
+                    event_fields = _dataclass_fields(node)
+        if not event_fields:
+            findings.append(
+                Finding(
+                    _COLUMNAR_REL,
+                    1,
+                    "TPL103",
+                    f"ProbeEventV1 not found in {_TYPES_REL}; cannot "
+                    "check columnar dtype derivation",
+                )
+            )
+            return findings
+
+        field_names = {f.name for f in event_fields}
+        dtype_names = {name for name, _ in dtype_fields}
+        for f in event_fields:
+            if f.name not in columns_map:
+                findings.append(
+                    Finding(
+                        _COLUMNAR_REL,
+                        map_line,
+                        "TPL103",
+                        f"ProbeEventV1.{f.name} has no entry in "
+                        "COLUMNS_FOR_FIELD — extend the columnar dtype "
+                        "with the schema change",
+                    )
+                )
+        mapped_columns: set[str] = set()
+        for field_name, cols in columns_map.items():
+            if field_name not in field_names:
+                findings.append(
+                    Finding(
+                        _COLUMNAR_REL,
+                        map_line,
+                        "TPL103",
+                        f"COLUMNS_FOR_FIELD maps {field_name!r} which "
+                        "is not a ProbeEventV1 field (stale mapping)",
+                    )
+                )
+            for col in cols:
+                mapped_columns.add(col)
+                if col not in dtype_names:
+                    findings.append(
+                        Finding(
+                            _COLUMNAR_REL,
+                            map_line,
+                            "TPL103",
+                            f"COLUMNS_FOR_FIELD names column {col!r} "
+                            "missing from _DTYPE_FIELDS",
+                        )
+                    )
+        for name in sorted(dtype_names - mapped_columns):
+            findings.append(
+                Finding(
+                    _COLUMNAR_REL,
+                    dtype_line,
+                    "TPL103",
+                    f"dtype column {name!r} is not derived from any "
+                    "ProbeEventV1 field (unmapped column)",
+                )
+            )
+        return findings
+
+
 # --- TPL140: config drift ------------------------------------------------
 
 _SPECIAL_TOP_LEVEL = {"apiVersion", "kind", "signal_set"}

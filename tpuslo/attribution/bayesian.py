@@ -21,6 +21,10 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from tpuslo.attribution.mapper import FaultSample, build_attribution
+from tpuslo.columnar.posterior import (
+    PosteriorMatrices,
+    log_posterior_batch,
+)
 from tpuslo.schema import FaultHypothesis, IncidentAttribution
 
 # --- Fault domains ------------------------------------------------------
@@ -348,6 +352,7 @@ class _Matrices:
     log_priors: np.ndarray  # [D]
     thresholds: np.ndarray  # [S] (+inf where no elevation threshold)
     supports: np.ndarray  # [S, D] raw P >= 0.5 (evidence membership)
+    kernel: "PosteriorMatrices"  # columnar-kernel view of the same tables
 
 
 def _clamp(p: float) -> float:
@@ -450,23 +455,62 @@ class BayesianAttributor:
                 for s in signals
             ]
         ).reshape(shape)
+        log_lik = np.log(np.clip(raw, 0.01, 0.99))
+        log_not_lik = np.log(np.clip(1.0 - raw, 0.01, 0.99))
+        log_priors = np.log(
+            np.maximum(
+                [self.priors.get(d, 0.0) for d in ALL_DOMAINS], 1e-10
+            )
+        )
+        thresholds = np.array(
+            [SIGNAL_ELEVATION_THRESHOLDS.get(s, math.inf) for s in signals]
+        )
+        warns = np.where(np.isfinite(thresholds), thresholds, np.nan)
+        errs = np.array(
+            [
+                SIGNAL_ERROR_THRESHOLDS.get(
+                    s, (SIGNAL_ELEVATION_THRESHOLDS.get(s) or np.nan) * 3.0
+                )
+                for s in signals
+            ]
+        )
+        continuous = np.array(
+            [
+                s not in _ZERO_AMBIGUOUS_SIGNALS
+                and s in SIGNAL_ELEVATION_THRESHOLDS
+                for s in signals
+            ]
+        )
+        ambiguous = np.array(
+            [s in _ZERO_AMBIGUOUS_SIGNALS for s in signals]
+        )
+        p_drop = np.array(
+            [
+                COUNTER_ZERO_DROP_PRIOR
+                if s in _COUNTER_SIGNALS
+                else COMPILE_ZERO_DROP_PRIOR
+                for s in signals
+            ]
+        )[:, None]
         return _Matrices(
             signals=signals,
             signal_index={s: i for i, s in enumerate(signals)},
-            log_lik=np.log(np.clip(raw, 0.01, 0.99)),
-            log_not_lik=np.log(np.clip(1.0 - raw, 0.01, 0.99)),
-            log_priors=np.log(
-                np.maximum(
-                    [self.priors.get(d, 0.0) for d in ALL_DOMAINS], 1e-10
-                )
-            ),
-            thresholds=np.array(
-                [
-                    SIGNAL_ELEVATION_THRESHOLDS.get(s, math.inf)
-                    for s in signals
-                ]
-            ),
+            log_lik=log_lik,
+            log_not_lik=log_not_lik,
+            log_priors=log_priors,
+            thresholds=thresholds,
             supports=raw_support >= 0.5,
+            kernel=PosteriorMatrices(
+                log_priors=log_priors,
+                log_lik=log_lik,
+                log_not_lik=log_not_lik,
+                thresholds=thresholds,
+                warns=warns,
+                errs=errs,
+                continuous=continuous,
+                ambiguous=ambiguous,
+                p_drop=p_drop,
+            ),
         )
 
     def elevated_signals(self, signals: dict[str, float]) -> set[str]:
@@ -617,7 +661,9 @@ class BayesianAttributor:
         return base
 
     def attribute_batch(
-        self, samples: list[FaultSample]
+        self,
+        samples: list[FaultSample],
+        use_jax: bool | None = None,
     ) -> list[IncidentAttribution]:
         """Vectorized :meth:`attribute_sample` over a batch.
 
@@ -625,7 +671,9 @@ class BayesianAttributor:
         19-signal × 13-domain log-likelihood accumulation and the
         residual explaining-away pass each become one masked matmul
         over the whole batch, so throughput scales with numpy rather
-        than Python dict lookups.
+        than Python dict lookups.  The core contraction lives in
+        ``tpuslo.columnar.posterior`` and can run under ``jax.jit``
+        (``use_jax``: None = engagement policy, True/False = force).
         """
         mat = self._matrices()
         n_dom = len(ALL_DOMAINS)
@@ -660,76 +708,23 @@ class BayesianAttributor:
                 ):
                     extra_trigger[i] = True
 
-        if self.evidence == "soft":
-            # Exact-0.0 continuous probes = missing, not healthy.
-            continuous = np.array(
-                [
-                    s not in _ZERO_AMBIGUOUS_SIGNALS
-                    and s in SIGNAL_ELEVATION_THRESHOLDS
-                    for s in mat.signals
-                ]
-            )
-            observed &= ~(continuous & (values == 0.0))
-            warns = np.where(np.isfinite(mat.thresholds), mat.thresholds, np.nan)
-            errs = np.array(
-                [
-                    SIGNAL_ERROR_THRESHOLDS.get(
-                        s, (SIGNAL_ELEVATION_THRESHOLDS.get(s) or np.nan) * 3.0
-                    )
-                    for s in mat.signals
-                ]
-            )
-            with np.errstate(divide="ignore", invalid="ignore"):
-                scale = np.maximum(np.log(errs / warns), 1e-6)
-                z = (
-                    self.sharpness
-                    * np.log(np.maximum(values, 1e-300) / warns)
-                    / scale
-                )
-            z = np.where((values > 0) & np.isfinite(z), z, -60.0)
-            weights = 1.0 / (1.0 + np.exp(-np.clip(z, -60.0, 60.0)))
-        else:
-            weights = (observed & (values >= mat.thresholds)).astype(float)
-        elevated = observed & (weights >= 0.5)
-
-        # [n, D] = Σ_s w·logP + Σ_s (1-w)·log(1-P) over observed signals
-        obsf = observed.astype(float)
-        w_obs = weights * obsf
-        log_post = (
-            mat.log_priors
-            + w_obs @ mat.log_lik
-            + (obsf - w_obs) @ mat.log_not_lik
+        # Shared columnar kernel (tpuslo.columnar.posterior): graded
+        # weights, the (batch, signals) @ (signals, domains) log-
+        # likelihood contraction, ambiguous-zero drop mixture, and the
+        # softmax — numpy here by default (bit-stable with the scalar
+        # path), jax.jit for fleet-scale batches per the engagement
+        # policy.  Soft mode drops exact-0.0 continuous probes from
+        # ``observed`` (missing probe, not health), which is why the
+        # mask comes back out.
+        posteriors, weights, observed = log_posterior_batch(
+            values,
+            observed,
+            mat.kernel,
+            soft=self.evidence == "soft",
+            sharpness=self.sharpness,
+            use_jax=use_jax,
         )
-        if self.evidence == "soft":
-            # A zero COUNTER is ambiguous: legitimately healthy, or a
-            # dropped probe (shedding, ring loss) that zeroed it.  Full
-            # healthy credit lets one dropped pathognomonic counter
-            # (ici_link_retries under 15% shedding) overwhelm the rest
-            # of the evidence and strand the sample in a wrong domain.
-            # Replace the healthy factor with the drop mixture
-            # P(0 | domain) = p_drop + (1 - p_drop) (1 - P(elev|domain)).
-            ambiguous = np.array(
-                [s in _ZERO_AMBIGUOUS_SIGNALS for s in mat.signals]
-            )
-            zero_counter = (
-                observed & ambiguous[None, :] & (values == 0.0)
-            ).astype(float)
-            if zero_counter.any():
-                not_lik = np.exp(mat.log_not_lik)
-                p_drop = np.array(
-                    [
-                        COUNTER_ZERO_DROP_PRIOR
-                        if s in _COUNTER_SIGNALS
-                        else COMPILE_ZERO_DROP_PRIOR
-                        for s in mat.signals
-                    ]
-                )[:, None]
-                adj = (
-                    np.log(p_drop + (1.0 - p_drop) * not_lik)
-                    - mat.log_not_lik
-                )
-                log_post = log_post + zero_counter @ adj
-        posteriors = _softmax_rows(log_post)
+        elevated = observed & (weights >= 0.5)
 
         # Residual explaining-away pass, one matmul for the batch,
         # restricted to the residual signals with their weights (in

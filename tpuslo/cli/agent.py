@@ -37,7 +37,7 @@ from tpuslo.signals import (
     parse_capability_mode,
     profile_for_fault,
 )
-from datetime import datetime, timezone
+from datetime import datetime, timedelta, timezone
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -68,6 +68,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--service", default="rag-service")
     p.add_argument("--node", default="tpu-vm-0")
     p.add_argument("--probe-smoke", action="store_true")
+    p.add_argument(
+        "--columnar",
+        action="store_true",
+        help="batch loop on the columnar spine: each cycle generates "
+        "--columnar-batch samples straight into columns, gates them "
+        "vectorized, and serializes one JSONL block (fleet-scale "
+        "probe-event throughput; probe events only)",
+    )
+    p.add_argument(
+        "--columnar-batch",
+        type=int,
+        default=256,
+        help="samples per columnar cycle (each fans out to one probe "
+        "event per enabled signal)",
+    )
     # Multi-host identity for the ring loop's TPU events: a DaemonSet
     # agent knows which slice/host it runs on; SliceJoiner joins
     # per-host streams on exactly this identity.
@@ -374,6 +389,17 @@ def main(
     metrics = metrics or AgentMetrics()
 
     chaos_stream = None
+    if args.chaos_telemetry > 0 and args.columnar:
+        # The chaos stream perturbs payload dicts on the row loop's
+        # wire; the columnar loop never materializes per-event dicts,
+        # so a drill flag here would silently do nothing.  Refusing
+        # loudly beats an all-zero chaos snapshot that looks clean.
+        print(
+            "agent: --chaos-telemetry needs the row synthetic loop; "
+            "drop --columnar to rehearse telemetry chaos",
+            file=sys.stderr,
+        )
+        return 2
     if args.chaos_telemetry > 0 and args.probe_source == "ring":
         # Ring events arrive one at a time from the kernel; the chaos
         # stream's reorder/dup buffering only makes sense on the
@@ -399,9 +425,10 @@ def main(
         )
 
     gate = None
-    if cfg.ingest.enabled:
+    if cfg.ingest.enabled and not args.columnar:
         # Always-on once configured: the gate is the admission point
-        # for everything the agent emits downstream.
+        # for everything the agent emits downstream.  (The columnar
+        # loop builds its own vectorized gate from the same config.)
         from tpuslo.ingest import GateConfig, TelemetryGate
 
         gate = TelemetryGate(
@@ -1246,6 +1273,116 @@ def main(
             file=sys.stderr,
         )
 
+    # Which gate the drain-path stats line reports: the row gate by
+    # default, the columnar loop's vectorized gate when it builds one.
+    stats_gate = gate
+
+    def _run_columnar_loop() -> None:
+        """Fleet-scale batch loop on the columnar spine.
+
+        Each cycle expands ``--columnar-batch`` synthetic samples
+        straight into a :class:`~tpuslo.columnar.ColumnarBatch`
+        (per-sample trace identity preserved), pushes the batch through
+        the vectorized gate (same admission semantics as the row gate,
+        parity-tested), and serializes one JSONL block without
+        per-event dicts.  Probe events only — the SLO/burn/webhook
+        plumbing stays on the row loop, which this mode does not
+        replace.
+        """
+        import numpy as np
+
+        from tpuslo.columnar.gate import ColumnarGate
+        from tpuslo.columnar.schema import to_rows
+        from tpuslo.columnar.serialize import serialize_jsonl
+        from tpuslo.ingest import GateConfig as _GateConfig
+
+        nonlocal stats_gate
+        col_gate = None
+        if cfg.ingest.enabled:
+            col_gate = ColumnarGate(
+                _GateConfig(
+                    dedup_window=cfg.ingest.dedup_window,
+                    watermark_lateness_ms=cfg.ingest.watermark_lateness_ms,
+                    coordinator_host=cfg.ingest.coordinator_host,
+                    min_skew_samples=cfg.ingest.min_skew_samples,
+                    skew_correction=cfg.ingest.skew_correction,
+                    quarantine_dir=cfg.ingest.quarantine_dir,
+                    quarantine_max_bytes=cfg.ingest.quarantine_max_bytes,
+                    quarantine_max_age_s=cfg.ingest.quarantine_max_age_s,
+                )
+            )
+            stats_gate = col_gate
+            print("agent: columnar ingest gate on", file=sys.stderr)
+        batch_size = max(1, args.columnar_batch)
+        probe_counter = metrics.probe_events
+        stats_every = max(0, args.stats_interval_cycles)
+        # Sink capability is fixed for the process: local sinks take
+        # pre-serialized blocks, OTLP exporters need typed records —
+        # probe once instead of serializing a block per batch only to
+        # learn it cannot be used.
+        blocks_ok = writers.write_probe_block("")
+        idx = 0
+        emitted_total = 0
+        try:
+            while not args.count or idx < args.count:
+                now = datetime.now(timezone.utc)
+                samples = [
+                    build_synthetic_sample(
+                        args.scenario,
+                        idx * batch_size + j,
+                        now + timedelta(microseconds=j),
+                        sample_meta,
+                    )
+                    for j in range(batch_size)
+                ]
+                batch = generator.generate_batch_columnar(
+                    samples,
+                    Metadata(),
+                    trace_ids=[s.trace_id for s in samples],
+                )
+                if col_gate is not None:
+                    result = col_gate.admit_batch(batch)
+                    outgoing = [result.admitted, result.late]
+                else:
+                    outgoing = [batch]
+                for out in outgoing:
+                    if not len(out):
+                        continue
+                    emitted_total += len(out)
+                    if blocks_ok:
+                        writers.write_probe_block(
+                            serialize_jsonl(out, kind="probe")
+                        )
+                    else:
+                        # OTLP sinks need typed records: adapter
+                        # boundary, row objects only here.
+                        writers.emit_probe(to_rows(out))
+                    codes, counts = np.unique(
+                        out.column("signal"), return_counts=True
+                    )
+                    strings = out.pool.strings
+                    for code, count in zip(
+                        codes.tolist(), counts.tolist()
+                    ):
+                        probe_counter.labels(
+                            signal=strings[code]
+                        ).inc(count)
+                idx += 1
+                if stats_every and idx % stats_every == 0:
+                    _print_stats(col_gate, metrics)
+                if args.count and idx >= args.count:
+                    break
+                if args.interval_s > 0:
+                    time.sleep(args.interval_s)
+        finally:
+            print(
+                f"agent: columnar loop: {idx} cycles, "
+                f"{emitted_total} probe events emitted",
+                file=sys.stderr,
+            )
+            if col_gate is not None:
+                col_gate.close()
+
     from tpuslo.runtime import (
         DrainController,
         DrainSignal,
@@ -1265,6 +1402,8 @@ def main(
                 runtime=runtime, runtime_observer=runtime_observer,
                 self_tracer=tracer,
             )
+        elif args.columnar:
+            _run_columnar_loop()
         else:
             idx = progress["next_cycle"]
             while not args.count or idx < args.count:
@@ -1286,7 +1425,7 @@ def main(
             log=lambda msg: print(f"agent: {msg}", file=sys.stderr),
         )
         metrics.up.set(0)
-        _print_stats(gate, metrics, burn_engine)
+        _print_stats(stats_gate, metrics, burn_engine)
         if chaos_stream is not None:
             print(
                 f"agent: chaos-telemetry: {chaos_stream.snapshot()}",

@@ -140,6 +140,26 @@ class EventWriters:
             [{"kind": "probe", **event.to_dict()} for event in events]
         )
 
+    def write_probe_block(self, block: str) -> bool:
+        """Emit a pre-serialized JSONL block (columnar fast path).
+
+        The columnar spine serializes whole batches without building
+        per-event dicts (``tpuslo.columnar.serialize``); local sinks
+        take the block as-is with the usual one-write-one-flush
+        contract.  Returns False when the active sink is OTLP — those
+        exporters need typed records, so the caller must fall back to
+        the ``to_rows`` adapter + :meth:`emit_probe`.
+        """
+        if self._probe_channel is not None or self._probe_exporter is not None:
+            return False
+        if not block:
+            return True
+        with self._lock:
+            sink = self._jsonl if self._jsonl is not None else self._stream
+            sink.write(block)
+            sink.flush()
+        return True
+
     @property
     def delivery_channels(self) -> list[DeliveryChannel]:
         return [c for c in (self._slo_channel, self._probe_channel) if c]

@@ -1,0 +1,54 @@
+"""Columnar event spine: batch representation for millions of events/s.
+
+The row-at-a-time pipeline (one ``ProbeEventV1`` dataclass per probe
+observation) tops out in the hundreds of thousands of events per second
+— every stage pays Python attribute access, dict churn and allocator
+traffic per event.  This package moves the hot pipeline onto **numpy
+structured arrays** with a stable dtype derived from ``ProbeEventV1``
+(:data:`~tpuslo.columnar.schema.PROBE_EVENT_DTYPE`), so generate →
+gate → correlate → attribute are array programs:
+
+* :mod:`tpuslo.columnar.schema` — the dtype, the per-batch
+  :class:`StringPool` (dictionary-encoded string columns), and the
+  row-path adapters ``from_rows`` / ``to_rows`` / ``from_payloads``.
+* :mod:`tpuslo.columnar.generate` — batched synthetic generation that
+  writes columns directly (no per-event dataclass).
+* :mod:`tpuslo.columnar.gate` — vectorized TelemetryGate semantics
+  (validation masks, windowed dedup, skew segments, watermark prefix
+  max) with parity to the row gate.
+* :mod:`tpuslo.columnar.match` — the tier join as sort + searchsorted
+  over integer-µs timestamp columns with per-tier key packing.
+* :mod:`tpuslo.columnar.posterior` — the naive-Bayes posterior as one
+  ``(batch, signals) @ (signals, domains)`` log-likelihood product,
+  JAX-jittable (numpy otherwise).
+* :mod:`tpuslo.columnar.serialize` — column → JSONL lines without
+  intermediate per-event dicts (strings JSON-escaped once per distinct
+  pool entry, not once per event).
+
+Row-path APIs stay authoritative at the boundaries: every kernel here
+is parity-tested against its row twin on seeded scenarios
+(tests/test_columnar_parity.py), and ``to_rows``/``to_payloads`` are
+the only ways out of the columnar world.
+"""
+
+from tpuslo.columnar.schema import (
+    COLUMNS_FOR_FIELD,
+    PROBE_EVENT_DTYPE,
+    ColumnarBatch,
+    StringPool,
+    from_payloads,
+    from_rows,
+    to_payloads,
+    to_rows,
+)
+
+__all__ = [
+    "COLUMNS_FOR_FIELD",
+    "PROBE_EVENT_DTYPE",
+    "ColumnarBatch",
+    "StringPool",
+    "from_payloads",
+    "from_rows",
+    "to_payloads",
+    "to_rows",
+]

@@ -1,0 +1,620 @@
+"""Vectorized TelemetryGate semantics over columnar batches.
+
+Same admission pipeline as :class:`tpuslo.ingest.gate.TelemetryGate`
+— validation → dedup → skew correction → watermark — with each stage
+restated as array work:
+
+* **Validation** — batches built by :mod:`tpuslo.columnar.generate`
+  are contract-valid by dtype construction; batches entering from the
+  wire go through ``from_payloads`` (the row validator per dict — the
+  ingest boundary) via :meth:`ColumnarGate.admit_payloads`.  A residual
+  vectorized mask still guards value ranges on ``admit_batch`` so a
+  hand-built batch cannot smuggle, e.g., a negative timestamp past the
+  watermark math.
+* **Dedup** — the row gate's natural-key LRU, with keys replaced by a
+  64-bit content hash computed vectorized (string columns hash once
+  per distinct pool entry); the LRU window/refresh semantics are
+  identical, run over the hash array.
+* **Skew** — sync-signal rows feed the shared
+  :class:`~tpuslo.ingest.skew.ClockSkewEstimator` in stream order (they
+  are ~2 of 19 signals); offsets apply to everything else as one
+  gather + subtract per segment between offset changes.
+* **Watermark** — the sequential ``max(ts) - lateness`` admission
+  becomes a prefix-maximum (``np.maximum.accumulate``) with the
+  previous batch's head carried in.
+
+Parity with the row gate on identical streams — admit / late /
+duplicate / quarantine decisions, corrected timestamps, lag values —
+is locked in by tests/test_columnar_parity.py, including under the
+seeded chaos-telemetry stream.
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_left, bisect_right, insort
+from dataclasses import dataclass, field
+from typing import Any, Iterable
+
+import numpy as np
+
+from tpuslo.columnar.schema import ColumnarBatch, from_payloads
+from tpuslo.ingest.gate import GateConfig
+from tpuslo.ingest.quarantine import Quarantine
+from tpuslo.ingest.skew import ClockSkewEstimator
+from tpuslo.metrics.rejections import REJECTION_COUNTERS
+from tpuslo.schema.fastpath import classify_probe_payload_reject
+from tpuslo.signals.constants import (
+    SIGNAL_DCN_TRANSFER_MS,
+    SIGNAL_ICI_COLLECTIVE_MS,
+)
+
+_SYNC_SIGNALS = (SIGNAL_ICI_COLLECTIVE_MS, SIGNAL_DCN_TRANSFER_MS)
+
+# splitmix64 finalizer constants for the dedup row hash, plus one
+# distinct odd multiplier per key component (multiply-xor combine).
+_MIX_1 = np.uint64(0xBF58476D1CE4E5B9)
+_MIX_2 = np.uint64(0x94D049BB133111EB)
+_PART_MULS = (
+    np.uint64(0x9E3779B97F4A7C15),
+    np.uint64(0xC2B2AE3D27D4EB4F),
+    np.uint64(0x165667B19E3779F9),
+    np.uint64(0xD6E8FEB86659FD93),
+    np.uint64(0xA5CB9243F2CED4C5),
+    np.uint64(0x8CB92BA72F3D8DD7),
+    np.uint64(0xEB44ACCAB455D165),
+    np.uint64(0x9FB21C651E98DF25),
+    np.uint64(0x2545F4914F6CDD1D),
+    np.uint64(0x5851F42D4C957F2D),
+    np.uint64(0x14057B7EF767814F),
+)
+
+
+def dedup_hashes(batch: ColumnarBatch) -> np.ndarray:
+    """64-bit content hash of each row's natural dedup key.
+
+    Mirrors the row gate's ``_event_key`` components: (ts, signal,
+    node, pod, pid, tid, value, trace_id, tpu host/launch/link).
+    String components hash by content (via the pool), so hashes are
+    stable across batches and pools.  Components combine by
+    multiply-xor with distinct odd constants plus one splitmix64
+    finalizer — cheap per column, and a collision (which would falsely
+    deduplicate) needs a multi-field difference that cancels mod 2⁶⁴:
+    ~2⁻⁶⁴ per pair on non-adversarial telemetry, the same order of
+    risk the crash-restore digest path already accepts.
+    """
+    c = batch.columns
+    strh = batch.pool.content_hashes()
+    has_tpu = c["has_tpu"]
+    parts = (
+        c["ts_unix_nano"].astype(np.uint64),
+        strh[c["signal"]],
+        strh[c["node"]],
+        strh[c["pod"]],
+        c["pid"].astype(np.uint64),
+        c["tid"].astype(np.uint64),
+        c["value"].view(np.uint64),
+        strh[c["trace_id"]],
+        np.where(has_tpu, c["tpu_host_index"], -1).astype(np.uint64),
+        np.where(has_tpu, c["tpu_launch_id"], -1).astype(np.uint64),
+        np.where(has_tpu, c["tpu_ici_link"], -1).astype(np.uint64),
+    )
+    h = parts[0] * _PART_MULS[0]
+    for part, mul in zip(parts[1:], _PART_MULS[1:]):
+        h = h ^ (part * mul)
+    h = (h ^ (h >> np.uint64(30))) * _MIX_1
+    h = (h ^ (h >> np.uint64(27))) * _MIX_2
+    return h ^ (h >> np.uint64(31))
+
+
+class _Fenwick:
+    """Prefix-sum tree over a fixed index range (dedup dup-candidates)."""
+
+    __slots__ = ("tree", "size")
+
+    def __init__(self, size: int, ones: bool = False):
+        self.size = size
+        if ones:
+            # Closed form of a Fenwick built over all-ones: node i
+            # covers i & (-i) entries.
+            self.tree = [0] + [i & (-i) for i in range(1, size + 1)]
+        else:
+            self.tree = [0] * (size + 1)
+
+    def update(self, i: int, delta: int) -> None:
+        i += 1
+        tree = self.tree
+        while i <= self.size:
+            tree[i] += delta
+            i += i & (-i)
+
+    def prefix(self, i: int) -> int:
+        """Sum of entries [0, i)."""
+        total = 0
+        tree = self.tree
+        while i > 0:
+            total += tree[i]
+            i -= i & (-i)
+        return total
+
+
+@dataclass(slots=True)
+class ColumnarGateBatch:
+    """Outcome of one columnar admission pass.
+
+    ``admitted``/``late`` are row subsets of the input batch (shared
+    pool) with skew-corrected timestamps; ``late_lag_ns`` aligns with
+    ``late`` rows.  ``quarantined``/``duplicates`` report this call's
+    counts (events consumed by the gate, like the row API).
+    """
+
+    admitted: ColumnarBatch
+    late: ColumnarBatch
+    late_lag_ns: np.ndarray
+    quarantined: int = 0
+    duplicates: int = 0
+    quarantined_by_reason: dict[str, int] = field(default_factory=dict)
+
+
+class ColumnarGate:
+    """Validation → dedup → skew → watermark, vectorized per batch."""
+
+    def __init__(
+        self,
+        config: GateConfig | None = None,
+        quarantine: Quarantine | None = None,
+    ):
+        self.config = config or GateConfig()
+        if quarantine is None and self.config.quarantine_dir:
+            quarantine = Quarantine(
+                self.config.quarantine_dir,
+                max_bytes=self.config.quarantine_max_bytes,
+                max_age_s=self.config.quarantine_max_age_s,
+            )
+        self.quarantine = quarantine
+        # Insertion-ordered hash window (python dicts preserve insert
+        # order): equivalent to the row gate's OrderedDict LRU, driven
+        # in bulk by _dedup_batch.
+        self._dedup: dict[int, None] = {}
+        self._dedup_window = max(1, self.config.dedup_window)
+        self.skew = ClockSkewEstimator(
+            coordinator_host=self.config.coordinator_host,
+            min_samples=self.config.min_skew_samples,
+        )
+        # Watermark head, carried across batches (row gate: Watermark).
+        self._max_ts = 0
+        self.lateness_ns = max(
+            0, self.config.watermark_lateness_ms * 1_000_000
+        )
+        self.admitted = 0
+        self.duplicates = 0
+        self.quarantined = 0
+        self.quarantined_by_reason: dict[str, int] = {}
+        self.late_admitted = 0
+        self.skew_corrected = 0
+
+    # ---- admission ----------------------------------------------------
+
+    def admit_payloads(
+        self, events: Iterable[dict[str, Any]]
+    ) -> ColumnarGateBatch:
+        """Wire entry: validate dicts (row validator), then admit.
+
+        Structurally invalid payloads are quarantined with the same
+        reason classes as the row gate before the columns are built.
+        """
+        batch, rejects = from_payloads(events)
+        result = self.admit_batch(batch)
+        for _, payload in rejects:
+            reason = classify_probe_payload_reject(payload)
+            self.quarantined += 1
+            result.quarantined += 1
+            self.quarantined_by_reason[reason] = (
+                self.quarantined_by_reason.get(reason, 0) + 1
+            )
+            result.quarantined_by_reason[reason] = (
+                result.quarantined_by_reason.get(reason, 0) + 1
+            )
+            REJECTION_COUNTERS.note("ingest_gate", reason)
+            if self.quarantine is not None:
+                self.quarantine.put(payload, reason)
+        return result
+
+    def admit_batch(self, batch: ColumnarBatch) -> ColumnarGateBatch:
+        """Gate one columnar batch; rows keep their stream order.
+
+        The caller's batch is never mutated: filtered stages produce
+        row subsets (per-column fancy indexing), and skew correction
+        swaps in a fresh timestamp column while sharing every other
+        column.
+        """
+        n = len(batch)
+        empty = batch.take(np.zeros(0, np.int64))
+        if n == 0:
+            return ColumnarGateBatch(batch, empty, np.zeros(0, np.int64))
+
+        # --- residual structural guard (vectorized) -------------------
+        conf = batch.column("confidence")
+        valid = (
+            (batch.column("ts_unix_nano") >= 0)
+            & (batch.column("pid") >= 0)
+            & (batch.column("tid") >= 0)
+            & (np.isnan(conf) | ((conf >= 0.0) & (conf <= 1.0)))
+        )
+        result_quarantined: dict[str, int] = {}
+        n_bad = int(n - np.count_nonzero(valid))
+        if n_bad:
+            self.quarantined += n_bad
+            reason = "bad_field_type"
+            self.quarantined_by_reason[reason] = (
+                self.quarantined_by_reason.get(reason, 0) + n_bad
+            )
+            result_quarantined[reason] = n_bad
+            REJECTION_COUNTERS.note("ingest_gate", reason)
+            if self.quarantine is not None:
+                from tpuslo.columnar.schema import to_payloads
+
+                for payload in to_payloads(batch.take(~valid)):
+                    self.quarantine.put(payload, reason)
+            batch = batch.take(valid)
+            n = len(batch)
+
+        # --- dedup: LRU window over 64-bit content hashes -------------
+        dups = 0
+        if n:
+            keep = self._dedup_batch(batch)
+            dups = int(n - np.count_nonzero(keep))
+            if dups:
+                self.duplicates += dups
+                batch = batch.take(keep)
+                n = len(batch)
+        if n == 0:
+            return ColumnarGateBatch(
+                batch, batch, np.zeros(0, np.int64),
+                quarantined=n_bad,
+                duplicates=dups,
+                quarantined_by_reason=result_quarantined,
+            )
+
+        # --- skew: observe sync rows in order, apply per segment ------
+        ts = batch.column("ts_unix_nano")
+        if self.config.skew_correction:
+            corrected_ts = self._skew_correct(batch)
+            if corrected_ts is not None:
+                ts = corrected_ts
+                batch = batch.with_column("ts_unix_nano", ts)
+
+        # --- watermark: prefix max + lateness bound -------------------
+        run_max = np.maximum.accumulate(np.maximum(ts, self._max_ts))
+        max_before = np.empty(n, dtype=np.int64)
+        max_before[0] = self._max_ts
+        max_before[1:] = run_max[:-1]
+        in_order = ts >= max_before - self.lateness_ns
+        self._max_ts = int(run_max[-1])
+
+        n_late = int(n - np.count_nonzero(in_order))
+        if n_late == 0:
+            admitted = batch
+            late = batch.take(np.zeros(0, np.int64))
+            lag_late = np.zeros(0, dtype=np.int64)
+        else:
+            admitted = batch.take(in_order)
+            late_mask = ~in_order
+            late = batch.take(late_mask)
+            lag_late = np.maximum(0, run_max - ts)[late_mask]
+        self.admitted += n - n_late
+        self.late_admitted += n_late
+        return ColumnarGateBatch(
+            admitted=admitted,
+            late=late,
+            late_lag_ns=lag_late,
+            quarantined=n_bad,
+            duplicates=dups,
+            quarantined_by_reason=result_quarantined,
+        )
+
+    def _dedup_batch(self, batch: ColumnarBatch) -> np.ndarray:
+        """Row-LRU-equivalent dedup without maintaining a per-event LRU.
+
+        The row window is "the last W distinct keys by last touch", so
+        a key is a duplicate at position i iff the number of *other*
+        distinct keys whose latest touch falls after its own previous
+        touch is < W.  Touch counting vectorizes: one argsort finds
+        within-batch repeats and last occurrences, one searchsorted
+        finds hits against the carried window, and a prefix sum counts
+        the single-occurrence fresh keys (which can never be
+        duplicates and need no bookkeeping).  Only *candidate* rows —
+        repeats or carry hits, i.e. events that might actually be
+        duplicates — run through a small sequential loop with Fenwick
+        trees tracking which candidate/carry touches are still
+        "latest".  Eviction needs no bookkeeping at all: an evicted
+        key is exactly one with ≥ W fresher distinct keys, which the
+        count already expresses.  Decisions match the row gate event
+        for event (parity-tested, chaos dup storms included).
+        """
+        hashes = dedup_hashes(batch)
+        n = len(hashes)
+        keep = np.ones(n, dtype=bool)
+        window = self._dedup_window
+        carry = self._dedup  # dict key -> None, ordered oldest→newest
+
+        sort_idx = np.argsort(hashes)
+        sorted_h = hashes[sort_idx]
+        starts = np.empty(n, dtype=bool)
+        starts[0] = True
+        np.not_equal(sorted_h[1:], sorted_h[:-1], out=starts[1:])
+        group_starts = np.flatnonzero(starts)
+        first_pos = np.minimum.reduceat(sort_idx, group_starts)
+        last_pos = np.maximum.reduceat(sort_idx, group_starts)
+        repeated = np.ones(n, dtype=bool)
+        repeated[first_pos] = False
+
+        in_carry = np.zeros(n, dtype=bool)
+        carry_arr: np.ndarray | None = None
+        if carry:
+            carry_arr = np.fromiter(carry.keys(), np.uint64, len(carry))
+            carry_sorted = np.sort(carry_arr)
+            slot = np.searchsorted(carry_sorted, hashes)
+            slot[slot == len(carry_sorted)] = 0
+            in_carry = carry_sorted[slot] == hashes
+
+        cand = repeated | in_carry
+        # Prefix count of non-candidate touches: those keys' single
+        # touch stays their latest unless a later repeat moves it (the
+        # resolver's stale list corrects for that case).
+        fresh_prefix = np.cumsum(~cand)
+        cand_positions = np.flatnonzero(cand)
+        dups = 0
+        if len(cand_positions):
+            counts = np.diff(np.append(group_starts, n))
+            first_of = np.empty(n, dtype=np.int64)
+            first_of[sort_idx] = np.repeat(first_pos, counts)
+            dups = self._resolve_candidates(
+                hashes, keep, cand_positions, fresh_prefix, first_of,
+                carry_arr, window,
+            )
+
+        # --- next batch's carried window (vectorized rebuild) ---------
+        # Latest touch of every batch key = its last occurrence; carry
+        # keys untouched by this batch keep their old order below all
+        # batch keys.  The new window is the last W of that sequence.
+        u_vals = sorted_h[group_starts]
+        if carry_arr is not None:
+            slot2 = np.searchsorted(u_vals, carry_arr)
+            slot2[slot2 == len(u_vals)] = 0
+            touched = u_vals[slot2] == carry_arr
+            survivors = [
+                k for k, t in zip(carry.keys(), touched.tolist()) if not t
+            ]
+        else:
+            survivors = []
+        n_groups = len(group_starts)
+        if n_groups >= window:
+            sel = np.argpartition(last_pos, n_groups - window)[
+                n_groups - window:
+            ]
+            sel = sel[np.argsort(last_pos[sel])]
+            new_carry = dict.fromkeys(u_vals[sel].tolist())
+        else:
+            order = np.argsort(last_pos)
+            batch_keys = u_vals[order].tolist()
+            new_carry = dict.fromkeys(
+                survivors[max(0, len(survivors) + n_groups - window):]
+            )
+            new_carry.update(dict.fromkeys(batch_keys))
+        self._dedup = new_carry
+        return keep
+
+    def _resolve_candidates(
+        self,
+        hashes: np.ndarray,
+        keep: np.ndarray,
+        cand_positions: np.ndarray,
+        fresh_prefix: np.ndarray,
+        first_of: np.ndarray,
+        carry_arr: np.ndarray | None,
+        window: int,
+    ) -> int:
+        """Sequential dup resolution for the candidate rows only.
+
+        State per candidate key: its latest touch (a batch position, or
+        a virtual pre-batch slot for carried-window keys).  The
+        distinct-touch count over a range decomposes into
+
+        * non-candidate touches (static ``fresh_prefix`` cumsum), minus
+          the ``stale`` ones whose key was since re-touched,
+        * active candidate finals (Fenwick over candidate ranks),
+        * for virtual ``prev``, the carried keys in newer slots that
+          still hold their slot (Fenwick over carry slots).
+        """
+        hl = hashes.tolist()
+        n_carry = len(carry_arr) if carry_arr is not None else 0
+        carry_index: dict[int, int] = (
+            {h: i for i, h in enumerate(carry_arr.tolist())}
+            if carry_arr is not None
+            else {}
+        )
+        cand_list = cand_positions.tolist()
+        cand_rank = {p: r for r, p in enumerate(cand_list)}
+        cand_fen = _Fenwick(len(cand_list))
+        carry_fen = _Fenwick(n_carry, ones=True) if n_carry else None
+        # Non-candidate positions whose key's final moved to a later
+        # repeat: their fresh_prefix contribution is stale.  Sorted for
+        # bisect range counts; each position enters at most once.
+        stale: list[int] = []
+        # key -> latest touch: ("b", batch position) | ("c", carry slot)
+        latest: dict[int, tuple[str, int]] = {}
+        dups = 0
+        for rank, i in enumerate(cand_list):
+            h = hl[i]
+            prev = latest.get(h)
+            if prev is None:
+                slot = carry_index.get(h)
+                if slot is not None:
+                    prev = ("c", slot)
+                else:
+                    fp = int(first_of[i])
+                    if fp < i:
+                        prev = ("b", fp)
+            fresh_before = int(fresh_prefix[i - 1]) if i > 0 else 0
+            stale_before = bisect_left(stale, i)
+            if prev is None:
+                in_window = False
+            elif prev[0] == "b":
+                j = prev[1]
+                fresh = fresh_before - int(fresh_prefix[j])
+                stale_between = stale_before - bisect_right(stale, j)
+                lo_rank = bisect_right(cand_list, j)
+                cand_between = cand_fen.prefix(rank) - cand_fen.prefix(
+                    lo_rank
+                )
+                in_window = fresh - stale_between + cand_between < window
+            else:
+                slot = prev[1]
+                carry_newer = (
+                    carry_fen.prefix(n_carry) - carry_fen.prefix(slot + 1)
+                    if carry_fen is not None
+                    else 0
+                )
+                in_window = (
+                    carry_newer
+                    + fresh_before
+                    - stale_before
+                    + cand_fen.prefix(rank)
+                    < window
+                )
+            # Touch: this key's latest is now position i (dup or not).
+            if prev is not None:
+                if prev[0] == "b":
+                    j = prev[1]
+                    r = cand_rank.get(j)
+                    if r is not None:
+                        cand_fen.update(r, -1)
+                    else:
+                        insort(stale, j)
+                elif carry_fen is not None:
+                    carry_fen.update(prev[1], -1)
+            latest[h] = ("b", i)
+            cand_fen.update(rank, 1)
+            if in_window:
+                keep[i] = False
+                dups += 1
+        return dups
+
+    def _skew_correct(self, batch: ColumnarBatch) -> np.ndarray | None:
+        """Row-order-faithful skew pass; returns corrected ts or None.
+
+        Offsets only change when a sync-signal observation completes a
+        launch group against the coordinator, so the batch splits into
+        segments of constant offsets: qualifying sync rows stream
+        through the estimator one by one (a vectorized prefilter
+        replicates ``observe``'s guard clauses, so rows the estimator
+        would ignore — no tpu block, no slice identity — never pay the
+        call), and each segment's correction is one gather + subtract.
+        Segment offsets are captured AT their breakpoints (the
+        estimator keeps streaming past them); a sync row's own
+        correction uses the post-``observe`` offsets, exactly like the
+        row gate.
+        """
+        c = batch.columns
+        pool = batch.pool
+        sync_codes = [
+            pool._index[s] for s in _SYNC_SIGNALS if s in pool._index
+        ]
+        node_codes = c["node"]
+        ts_col = c["ts_unix_nano"]
+        n = len(batch)
+        sync_rows = np.zeros(0, dtype=np.int64)
+        if sync_codes:
+            sync_mask = np.isin(
+                c["signal"], np.array(sync_codes, np.int32)
+            )
+            if sync_mask.any():
+                # observe()'s guard clauses, vectorized: only rows with
+                # full launch-group identity can move the estimator.
+                sync_rows = np.flatnonzero(
+                    sync_mask
+                    & c["has_tpu"]
+                    & (c["tpu_host_index"] >= 0)
+                    & (c["tpu_launch_id"] >= 0)
+                    & (c["tpu_slice_id"] != 0)
+                    & (c["node"] != 0)
+                    & (ts_col > 0)
+                )
+
+        skew = self.skew
+        strings = pool.strings
+        # Offsets are only ever gathered at this batch's node codes;
+        # the pool itself can be large (per-sample trace ids).
+        node_code_list = np.unique(node_codes).tolist()
+
+        def _capture() -> np.ndarray:
+            offsets = np.zeros(len(strings), dtype=np.int64)
+            for code in node_code_list:
+                offsets[code] = skew.offset_ns(strings[code])
+            return offsets
+
+        segments: list[tuple[int, np.ndarray]] = [(0, _capture())]
+        if len(sync_rows):
+            observe_group = skew.observe_group
+            sync_list = sync_rows.tolist()
+            s_ts = ts_col[sync_rows].tolist()
+            s_node = c["node"][sync_rows].tolist()
+            s_host = c["tpu_host_index"][sync_rows].tolist()
+            s_launch = c["tpu_launch_id"][sync_rows].tolist()
+            s_slice = c["tpu_slice_id"][sync_rows].tolist()
+            s_prog = c["tpu_program_id"][sync_rows].tolist()
+            version = (skew.samples_observed, skew.coordinator_node)
+            for k, i in enumerate(sync_list):
+                observe_group(
+                    strings[s_slice[k]],
+                    strings[s_prog[k]],
+                    s_launch[k],
+                    s_host[k],
+                    strings[s_node[k]],
+                    s_ts[k],
+                )
+                now = (skew.samples_observed, skew.coordinator_node)
+                if now != version:
+                    version = now
+                    segments.append((i, _capture()))
+
+        out: np.ndarray | None = None
+        bounds = [start for start, _ in segments] + [n]
+        for (seg_start, offsets), seg_end in zip(segments, bounds[1:]):
+            if seg_start >= seg_end:
+                continue
+            if offsets.any():
+                if out is None:
+                    out = ts_col.astype(np.int64)
+                out[seg_start:seg_end] -= offsets[
+                    node_codes[seg_start:seg_end]
+                ]
+        if out is None:
+            return None
+        self.skew_corrected += int(np.count_nonzero(out != ts_col))
+        return out
+
+    # ---- reporting ----------------------------------------------------
+
+    def snapshot(self) -> dict[str, Any]:
+        return {
+            "admitted": self.admitted,
+            "duplicates": self.duplicates,
+            "quarantined": self.quarantined,
+            "quarantined_by_reason": dict(
+                sorted(self.quarantined_by_reason.items())
+            ),
+            "late_admitted": self.late_admitted,
+            "skew_corrected": self.skew_corrected,
+            "skew_offsets_ms": {
+                node: round(ms, 3)
+                for node, ms in self.skew.offsets_ms().items()
+            },
+            "watermark_ns": (
+                0 if self._max_ts == 0 else self._max_ts - self.lateness_ns
+            ),
+        }
+
+    def close(self) -> None:
+        if self.quarantine is not None:
+            self.quarantine.close()

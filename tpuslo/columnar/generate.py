@@ -1,0 +1,183 @@
+"""Batched synthetic generation straight into columns.
+
+The row twin (``Generator.generate_batch``) allocates one
+``ProbeEventV1`` (plus shared ``ConnTuple``/``TPURef``) per sample ×
+signal; at fleet scale that object churn IS the generation cost.  This
+kernel writes the batch's columns directly: per-*sample* work stays a
+small Python loop (timestamp, fault label, launch id — amortized over
+the ~19 signals each sample fans out to), per-*event* work is numpy
+``repeat``/``tile``/gather only.
+
+Event order matches the row path exactly — sample-major, then
+``ALL_SIGNALS`` order filtered by the enabled set — so
+``to_rows(columns_from_samples(...)) == generate_batch(...)``
+(tests/test_columnar_parity.py).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+import numpy as np
+
+from tpuslo.collector.synthetic import RawSample
+from tpuslo.columnar.schema import (
+    ColumnarBatch,
+    StringPool,
+    alloc_batch_columns,
+    empty_batch,
+)
+from tpuslo.signals import constants as sig
+from tpuslo.signals.generator import (
+    SIGNAL_UNITS,
+    _CONN_TUPLE_SIGNALS,
+    _REQ_NUM,
+    errno_for_fault,
+    profile_for_fault,
+    signal_status,
+)
+from tpuslo.signals.metadata import Metadata
+
+# The fixed synthetic flow tuple (row path: Generator.generate_batch).
+_CONN = ("10.244.0.10", "10.244.0.53", 42424, 443, "tcp")
+
+
+def columns_from_samples(
+    samples: Sequence[RawSample],
+    meta: Metadata,
+    enabled: Iterable[str],
+    trace_ids: Sequence[str] | None = None,
+) -> ColumnarBatch:
+    """Expand samples × enabled signals into one :class:`ColumnarBatch`.
+
+    ``trace_ids`` optionally overrides ``meta.trace_id`` per sample —
+    the agent's columnar loop stamps each sample's own trace identity,
+    which the one-meta row batch API cannot express.
+    """
+    samples = list(samples)
+    enabled = set(enabled)
+    ordered = [s for s in sig.ALL_SIGNALS if s in enabled]
+    n_samples, n_signals = len(samples), len(ordered)
+    if n_samples == 0 or n_signals == 0:
+        return empty_batch(0)
+
+    pool = StringPool()
+    intern = pool.intern
+
+    # --- per-signal template columns (length K) -----------------------
+    sig_codes = np.array([intern(s) for s in ordered], dtype=np.int32)
+    unit_codes = np.array(
+        [intern(SIGNAL_UNITS[s]) for s in ordered], dtype=np.int32
+    )
+    is_conn = np.array([s in _CONN_TUPLE_SIGNALS for s in ordered])
+    takes_errno = np.array(
+        [
+            s in (sig.SIGNAL_CONNECT_LATENCY_MS, sig.SIGNAL_CONNECT_ERRORS)
+            for s in ordered
+        ]
+    )
+    is_tpu = np.array([s in sig.TPU_SIGNALS for s in ordered])
+    ici_link = np.where(
+        np.array([s == sig.SIGNAL_ICI_LINK_RETRIES for s in ordered]), 0, -1
+    ).astype(np.int64)
+
+    # --- per-sample columns (length S) --------------------------------
+    # (value, status-code) rows cached per distinct fault label, like
+    # the row path's fault_rows cache.
+    label_cache: dict[str, tuple[int, int]] = {}
+    value_rows: list[np.ndarray] = []
+    status_rows: list[np.ndarray] = []
+    sample_label: list[int] = []
+    ts_ns: list[int] = []
+    launch: list[int] = []
+    errno_list: list[int] = []
+    launch_search = _REQ_NUM.search
+    for sample in samples:
+        label = sample.fault_label
+        cached = label_cache.get(label)
+        if cached is None:
+            profile = profile_for_fault(label)
+            value_rows.append(
+                np.array([profile[s] for s in ordered], dtype=np.float64)
+            )
+            status_rows.append(
+                np.array(
+                    [
+                        intern(signal_status(s, profile[s]))
+                        for s in ordered
+                    ],
+                    dtype=np.int32,
+                )
+            )
+            cached = (len(value_rows) - 1, errno_for_fault(label))
+            label_cache[label] = cached
+        sample_label.append(cached[0])
+        ts_ns.append(int(sample.timestamp.timestamp() * 1e9))
+        match = launch_search(sample.request_id or "")
+        launch.append(int(match.group(1)) if match else 0)
+        errno_list.append(cached[1])
+
+    sample_label_arr = np.array(sample_label, dtype=np.int64)
+    errno_arr = np.array(errno_list, dtype=np.int64)
+    if trace_ids is None:
+        trace_codes = np.full(n_samples, intern(meta.trace_id), np.int32)
+    else:
+        trace_codes = np.array(
+            [intern(t) for t in trace_ids], dtype=np.int32
+        )
+
+    # --- assemble the (S x K).ravel() event columns -------------------
+    # One arena allocation backs every column; per-sample values store
+    # through ``(S, K)`` broadcast views (no np.repeat/np.tile temps),
+    # per-signal templates through the transposed broadcast, constants
+    # through scalar fills.  Columns of an ABSENT optional envelope
+    # hold unspecified values and must only ever be read behind their
+    # presence flag (the adapters and kernels all do).
+    n = n_samples * n_signals
+    cols = alloc_batch_columns(n)
+
+    def by_sample(name: str, values: np.ndarray) -> None:
+        cols[name].reshape(n_samples, n_signals)[:] = values[:, None]
+
+    def by_signal(name: str, values: np.ndarray) -> None:
+        cols[name].reshape(n_samples, n_signals)[:] = values[None, :]
+
+    by_sample("ts_unix_nano", np.array(ts_ns, dtype=np.int64))
+    by_signal("signal", sig_codes)
+    cols["node"].fill(intern(meta.node))
+    cols["namespace"].fill(intern(meta.namespace))
+    cols["pod"].fill(intern(meta.pod))
+    cols["container"].fill(intern(meta.container))
+    cols["pid"].fill(meta.pid)
+    cols["tid"].fill(meta.tid)
+    np.take(
+        np.vstack(value_rows), sample_label_arr, axis=0,
+        out=cols["value"].reshape(n_samples, n_signals),
+    )
+    by_signal("unit", unit_codes)
+    np.take(
+        np.vstack(status_rows), sample_label_arr, axis=0,
+        out=cols["status"].reshape(n_samples, n_signals),
+    )
+    by_signal("has_conn", is_conn)
+    cols["conn_src_ip"].fill(intern(_CONN[0]))
+    cols["conn_dst_ip"].fill(intern(_CONN[1]))
+    cols["conn_src_port"].fill(_CONN[2])
+    cols["conn_dst_port"].fill(_CONN[3])
+    cols["conn_protocol"].fill(intern(_CONN[4]))
+    by_sample("trace_id", trace_codes)
+    cols["span_id"].fill(intern(meta.span_id))
+    cols["confidence"].fill(np.nan)
+    by_sample("errno", errno_arr)
+    has_errno = cols["has_errno"].reshape(n_samples, n_signals)
+    has_errno[:] = takes_errno[None, :]
+    has_errno &= (errno_arr != 0)[:, None]
+    by_signal("has_tpu", is_tpu)
+    cols["tpu_chip"].fill(intern(meta.tpu_chip or "accel0"))
+    cols["tpu_slice_id"].fill(intern(meta.slice_id))
+    cols["tpu_host_index"].fill(meta.host_index)
+    by_signal("tpu_ici_link", ici_link)
+    cols["tpu_program_id"].fill(intern(meta.xla_program_id))
+    by_sample("tpu_launch_id", np.array(launch, dtype=np.int64))
+    cols["tpu_module_name"].fill(0)
+    return ColumnarBatch(cols, pool, n)

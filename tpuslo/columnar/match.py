@@ -1,0 +1,457 @@
+"""Tier correlation as sort + searchsorted over integer-µs columns.
+
+The row ``match_batch`` builds six Python dict indexes per batch and
+answers each span with bisect probes — O(n + m) *Python-level* work.
+This kernel restates the join as array programs:
+
+* every tier's join key is a (pool code, integer id) pair,
+* signal postings sort once per tier by ``(key, ts)`` packed into a
+  single sortable ``int64`` when the component ranges fit (the normal
+  case; a dense-rank fallback covers pathological ranges),
+* every span's window ``[ts − w, ts + w]`` becomes two vectorized
+  ``searchsorted`` probes, and the winning posting (lowest original
+  signal index, the row tie-break) falls out of a
+  ``np.minimum.reduceat`` over the interleaved range bounds.
+
+Tiers resolve in descending confidence order exactly like the row
+matcher: the first tier with any in-window candidate wins.  The
+missing-timestamp trace joins (``MISSING_TS_CONFIDENCE``) are
+reproduced with first-occurrence scatter tables.  Parity with
+``match_batch`` across all tiers, tie-breaks and window edges is
+locked in by tests/test_columnar_parity.py.
+
+Timestamps: refs carry datetimes (µs-exact by construction, so any
+common reference gives exact µs differences); batch signals carry
+``ts_unix_nano // 1000`` — identical whenever producers stamp whole
+microseconds, which every toolkit producer does (sub-µs tails would
+round differently via the row path's float ``fromtimestamp``).
+"""
+
+from __future__ import annotations
+
+from datetime import datetime, timezone
+from typing import Sequence
+
+import numpy as np
+
+from tpuslo.columnar.schema import ColumnarBatch, StringPool
+from tpuslo.correlation.matcher import (
+    DEFAULT_WINDOW_MS,
+    MISSING_TS_CONFIDENCE,
+    TIER_CONFIDENCE,
+    TIER_POD_CONN,
+    TIER_POD_PID,
+    TIER_SERVICE_NODE,
+    TIER_SLICE_HOST,
+    TIER_TRACE_ID,
+    TIER_XLA_LAUNCH,
+    BatchMatch,
+    Decision,
+    SignalRef,
+    SpanRef,
+)
+
+_EPOCH = datetime(1970, 1, 1, tzinfo=timezone.utc)
+
+#: (tier name, tier window ms or None => global window).  Descending
+#: confidence, mirroring matcher._TIER_SPECS.
+TIER_ORDER: tuple[tuple[str, int | None], ...] = (
+    (TIER_TRACE_ID, None),
+    (TIER_XLA_LAUNCH, 250),
+    (TIER_POD_PID, 100),
+    (TIER_POD_CONN, 250),
+    (TIER_SLICE_HOST, 250),
+    (TIER_SERVICE_NODE, 500),
+)
+
+_MISSING_TS = np.int64(np.iinfo(np.int64).min)
+_MISSING_TIER = 6  # tier_idx for the MISSING_TS_CONFIDENCE trace join
+
+
+class MatchColumns:
+    """One side of the join: per-tier (code, id) keys + µs timestamps."""
+
+    __slots__ = ("n", "ts_us", "has_ts", "codes", "ids", "valid", "trace")
+
+    def __init__(
+        self,
+        n: int,
+        ts_us: np.ndarray,
+        has_ts: np.ndarray,
+        codes: list[np.ndarray],
+        ids: list[np.ndarray],
+        valid: list[np.ndarray],
+        trace: np.ndarray,
+    ):
+        self.n = n
+        self.ts_us = ts_us
+        self.has_ts = has_ts
+        self.codes = codes
+        self.ids = ids
+        self.valid = valid
+        self.trace = trace  # trace pool codes (0 = none)
+
+
+def _us_of(ts: datetime | None, ref: datetime | None) -> int:
+    """Exact µs offset of a datetime (µs-resolution by construction)."""
+    if ts is None:
+        return int(_MISSING_TS)
+    delta = ts - (ref if ref is not None else _EPOCH)
+    return (
+        delta.days * 86_400_000_000
+        + delta.seconds * 1_000_000
+        + delta.microseconds
+    )
+
+
+def _ref_columns(
+    refs: Sequence[SpanRef] | Sequence[SignalRef],
+    pool: StringPool,
+    ref_dt: datetime | None,
+) -> MatchColumns:
+    """SpanRef/SignalRef → columns adapter (row-speed boundary)."""
+    n = len(refs)
+    intern = pool.intern
+    ts_us = np.empty(n, dtype=np.int64)
+    codes = [np.zeros(n, dtype=np.int64) for _ in range(6)]
+    ids = [np.zeros(n, dtype=np.int64) for _ in range(6)]
+    v = np.zeros((6, n), dtype=bool)
+    for i, r in enumerate(refs):
+        ts_us[i] = _us_of(r.timestamp, ref_dt)
+        if r.trace_id:
+            codes[0][i] = intern(r.trace_id)
+            v[0, i] = True
+        if r.program_id and r.launch_id >= 0:
+            codes[1][i] = intern(r.program_id)
+            ids[1][i] = r.launch_id
+            v[1, i] = True
+        if r.pod and r.pid > 0:
+            codes[2][i] = intern(r.pod)
+            ids[2][i] = r.pid
+            v[2, i] = True
+        if r.pod and r.conn_tuple:
+            codes[3][i] = intern(r.pod)
+            ids[3][i] = intern(r.conn_tuple)
+            v[3, i] = True
+        if r.slice_id and r.host_index >= 0:
+            codes[4][i] = intern(r.slice_id)
+            ids[4][i] = r.host_index
+            v[4, i] = True
+        if r.service and r.node:
+            codes[5][i] = intern(r.service)
+            ids[5][i] = intern(r.node)
+            v[5, i] = True
+    return MatchColumns(
+        n, ts_us, ts_us != _MISSING_TS, codes, ids,
+        [v[t] for t in range(6)], codes[0],
+    )
+
+
+def span_columns(
+    spans: Sequence[SpanRef],
+    pool: StringPool,
+    ref_dt: datetime | None = None,
+) -> MatchColumns:
+    return _ref_columns(spans, pool, ref_dt)
+
+
+def signal_columns(
+    signals: Sequence[SignalRef],
+    pool: StringPool,
+    ref_dt: datetime | None = None,
+) -> MatchColumns:
+    return _ref_columns(signals, pool, ref_dt)
+
+
+def signal_columns_from_batch(batch: ColumnarBatch) -> MatchColumns:
+    """Vectorized signal side straight from a gated ColumnarBatch.
+
+    Field semantics mirror ``SignalRef.from_probe_dict``: no service
+    (probe events carry none, so the service_node tier never fires),
+    conn keys in the canonical ``proto:src:sport->dst:dport`` string
+    form (interned once per distinct flow, not per event).
+    """
+    c = batch.columns
+    pool = batch.pool
+    n = len(batch)
+    ts_ns = c["ts_unix_nano"]
+    has_ts = ts_ns > 0
+    ts_us = np.where(has_ts, ts_ns // 1000, _MISSING_TS)
+    zeros = np.zeros(n, dtype=np.int64)
+
+    trace = c["trace_id"].astype(np.int64)
+    v_trace = trace != 0
+
+    has_tpu = c["has_tpu"]
+    prog = np.where(has_tpu, c["tpu_program_id"], 0).astype(np.int64)
+    launch = c["tpu_launch_id"]
+    v_xla = (prog != 0) & (launch >= 0) & has_tpu
+
+    pod = c["pod"].astype(np.int64)
+    pid = c["pid"]
+    v_pp = (pod != 0) & (pid > 0)
+
+    has_conn = c["has_conn"]
+    v_pc = has_conn & (pod != 0)
+    conn_code = zeros
+    if v_pc.any():
+        # Canonical conn-key strings, one per distinct flow tuple.
+        mix = (
+            c["conn_src_ip"].astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)
+            ^ c["conn_dst_ip"].astype(np.uint64) * np.uint64(0xC2B2AE3D27D4EB4F)
+            ^ c["conn_src_port"].astype(np.uint64) * np.uint64(0x165667B19E3779F9)
+            ^ c["conn_dst_port"].astype(np.uint64) * np.uint64(0xD6E8FEB86659FD93)
+            ^ c["conn_protocol"].astype(np.uint64) * np.uint64(0xA5CB9243F2CED4C5)
+        )
+        mix = np.where(v_pc, mix, 0)
+        uniq, first_idx, inverse = np.unique(
+            mix, return_index=True, return_inverse=True
+        )
+        strings = pool.strings
+        codes_per_unique = np.zeros(len(uniq), dtype=np.int64)
+        src_l = c["conn_src_ip"][first_idx].tolist()
+        dst_l = c["conn_dst_ip"][first_idx].tolist()
+        sp_l = c["conn_src_port"][first_idx].tolist()
+        dp_l = c["conn_dst_port"][first_idx].tolist()
+        pr_l = c["conn_protocol"][first_idx].tolist()
+        for u in range(len(uniq)):
+            key = (
+                f"{strings[pr_l[u]]}:{strings[src_l[u]]}:{sp_l[u]}"
+                f"->{strings[dst_l[u]]}:{dp_l[u]}"
+            )
+            codes_per_unique[u] = pool.intern(key)
+        conn_code = codes_per_unique[inverse]
+
+    slice_id = np.where(has_tpu, c["tpu_slice_id"], 0).astype(np.int64)
+    host = c["tpu_host_index"]
+    v_sh = (slice_id != 0) & (host >= 0) & has_tpu
+
+    return MatchColumns(
+        n,
+        ts_us,
+        has_ts,
+        [trace, prog, pod, pod, slice_id, zeros],
+        [zeros, launch, pid, conn_code, host, zeros],
+        [v_trace, v_xla, v_pp, v_pc, v_sh, np.zeros(n, dtype=bool)],
+        trace,
+    )
+
+
+class ColumnarMatches:
+    """Kernel output: per-span winning signal index / tier / confidence."""
+
+    __slots__ = ("signal_idx", "tier_idx", "confidence")
+
+    def __init__(
+        self,
+        signal_idx: np.ndarray,
+        tier_idx: np.ndarray,
+        confidence: np.ndarray,
+    ):
+        self.signal_idx = signal_idx
+        self.tier_idx = tier_idx  # index into TIER_ORDER; 6 = missing-ts
+        self.confidence = confidence
+
+    def to_batch_matches(self) -> list[BatchMatch]:
+        out: list[BatchMatch] = []
+        sig = self.signal_idx.tolist()
+        tier = self.tier_idx.tolist()
+        conf = self.confidence.tolist()
+        for span_index in range(len(sig)):
+            t = tier[span_index]
+            if t < 0:
+                out.append(BatchMatch(span_index, -1, Decision()))
+            else:
+                name = (
+                    TIER_TRACE_ID if t == _MISSING_TIER else TIER_ORDER[t][0]
+                )
+                out.append(
+                    BatchMatch(
+                        span_index,
+                        sig[span_index],
+                        Decision(True, conf[span_index], name),
+                    )
+                )
+        return out
+
+
+def _first_by_code(
+    codes: np.ndarray, mask: np.ndarray, size: int
+) -> np.ndarray:
+    """table[code] = lowest index with that code (-1 when absent)."""
+    table = np.full(size, -1, dtype=np.int64)
+    idx = np.flatnonzero(mask)
+    if len(idx):
+        # np.unique's first-occurrence indexes are relative to the
+        # ascending-ordered selection, i.e. the lowest original index.
+        uniq, first = np.unique(codes[idx], return_index=True)
+        table[uniq] = idx[first]
+    return table
+
+
+def _tier_probe(
+    s_code: np.ndarray,
+    s_id: np.ndarray,
+    s_ts: np.ndarray,
+    sig_rows: np.ndarray,
+    p_code: np.ndarray,
+    p_id: np.ndarray,
+    p_ts: np.ndarray,
+    w_us: int,
+    n_signals: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """(found mask, min original signal index) for one tier's probes."""
+    ts_min = int(s_ts.min())
+    ts_span = int(s_ts.max()) - ts_min
+    ts_bits = max(int(ts_span + 1).bit_length(), 1)
+    code_max = int(s_code.max())
+    id_max = int(s_id.max())
+    code_bits = max(code_max.bit_length(), 1)
+    id_bits = max(id_max.bit_length(), 1)
+
+    probe_ok = (p_code <= code_max) & (p_id <= id_max) & (p_id >= 0)
+    lo_t = np.clip(p_ts - w_us - ts_min, 0, ts_span)
+    hi_t = np.clip(p_ts + w_us - ts_min, 0, ts_span)
+    probe_ok &= (p_ts - w_us <= ts_min + ts_span) & (
+        p_ts + w_us >= ts_min
+    )
+
+    if code_bits + id_bits + ts_bits <= 62:
+        # Fast path: one packed sort key, one argsort.
+        packed = (
+            ((s_code << id_bits) | s_id) << ts_bits
+        ) | (s_ts - ts_min)
+        base = ((p_code << id_bits) | p_id) << ts_bits
+    else:
+        # Wide components: densify (code, id) pairs to ranks first.
+        pair = (s_code << 32) ^ (s_id & 0xFFFFFFFF)
+        uk, inv = np.unique(pair, return_inverse=True)
+        rank_bits = max(len(uk).bit_length(), 1)
+        if rank_bits + ts_bits > 62:
+            raise OverflowError(
+                "timestamp spread too wide for packed tier join"
+            )
+        packed = (inv.astype(np.int64) << ts_bits) | (s_ts - ts_min)
+        p_pair = (p_code << 32) ^ (p_id & 0xFFFFFFFF)
+        rank = np.searchsorted(uk, p_pair)
+        rank_c = np.minimum(rank, len(uk) - 1)
+        probe_ok &= uk[rank_c] == p_pair
+        base = rank_c.astype(np.int64) << ts_bits
+
+    order = np.argsort(packed)
+    packed_sorted = packed[order]
+    sidx_sorted = sig_rows[order]
+    lo = np.searchsorted(packed_sorted, base + lo_t, side="left")
+    hi = np.searchsorted(packed_sorted, base + hi_t, side="right")
+    found = probe_ok & (lo < hi)
+    sidx_ext = np.append(sidx_sorted, np.int64(n_signals))
+    bounds = np.empty(2 * len(lo), dtype=np.int64)
+    bounds[0::2] = lo
+    bounds[1::2] = np.maximum(hi, lo)
+    win = np.minimum.reduceat(sidx_ext, bounds)[0::2]
+    return found, win
+
+
+def match_columns(
+    spans: MatchColumns,
+    signals: MatchColumns,
+    window_ms: int = 0,
+) -> ColumnarMatches:
+    """Best-match correlation, one decision per span (row parity)."""
+    global_ms = window_ms if window_ms > 0 else DEFAULT_WINDOW_MS
+    n_spans, n_signals = spans.n, signals.n
+    best_sig = np.full(n_spans, -1, dtype=np.int64)
+    best_tier = np.full(n_spans, -1, dtype=np.int8)
+    confidence = np.zeros(n_spans, dtype=np.float64)
+
+    if bool(signals.has_ts.any()):
+        unresolved = spans.has_ts.copy()
+        for tier_pos, (tier, tier_ms) in enumerate(TIER_ORDER):
+            if not unresolved.any():
+                break
+            sv = signals.valid[tier_pos] & signals.has_ts
+            if not sv.any():
+                continue
+            span_live = unresolved & spans.valid[tier_pos]
+            if not span_live.any():
+                continue
+            w_us = (
+                global_ms if tier_ms is None else min(global_ms, tier_ms)
+            ) * 1000
+            sig_rows = np.flatnonzero(sv)
+            span_rows = np.flatnonzero(span_live)
+            found, win = _tier_probe(
+                signals.codes[tier_pos][sig_rows],
+                signals.ids[tier_pos][sig_rows],
+                signals.ts_us[sig_rows],
+                sig_rows,
+                spans.codes[tier_pos][span_rows],
+                spans.ids[tier_pos][span_rows],
+                spans.ts_us[span_rows],
+                w_us,
+                n_signals,
+            )
+            hits = np.flatnonzero(found)
+            if len(hits):
+                rows = span_rows[hits]
+                best_sig[rows] = win[hits]
+                best_tier[rows] = tier_pos
+                confidence[rows] = TIER_CONFIDENCE[tier]
+                unresolved[rows] = False
+
+    # Missing-ts fallbacks (row: _missing_ts_match), built lazily.
+    no_ts_spans = ~spans.has_ts
+    if no_ts_spans.any():
+        size = max(
+            int(spans.trace.max(initial=0)),
+            int(signals.trace.max(initial=0)),
+        ) + 1
+        table = _first_by_code(signals.trace, signals.trace != 0, size)
+        codes = spans.trace[no_ts_spans]
+        hit = table[codes]
+        rows = np.flatnonzero(no_ts_spans)
+        ok = (codes != 0) & (hit >= 0)
+        best_sig[rows[ok]] = hit[ok]
+        best_tier[rows[ok]] = _MISSING_TIER
+        confidence[rows[ok]] = MISSING_TS_CONFIDENCE
+    fallback = spans.has_ts & (best_tier < 0)
+    if fallback.any() and bool((~signals.has_ts).any()):
+        size = max(
+            int(spans.trace.max(initial=0)),
+            int(signals.trace.max(initial=0)),
+        ) + 1
+        table = _first_by_code(
+            signals.trace, (signals.trace != 0) & ~signals.has_ts, size
+        )
+        codes = spans.trace[fallback]
+        hit = table[codes]
+        rows = np.flatnonzero(fallback)
+        ok = (codes != 0) & (hit >= 0)
+        best_sig[rows[ok]] = hit[ok]
+        best_tier[rows[ok]] = _MISSING_TIER
+        confidence[rows[ok]] = MISSING_TS_CONFIDENCE
+
+    return ColumnarMatches(best_sig, best_tier, confidence)
+
+
+def match_batch_columnar(
+    spans: Sequence[SpanRef],
+    signals: Sequence[SignalRef],
+    window_ms: int = 0,
+) -> list[BatchMatch]:
+    """Drop-in ``match_batch`` twin running on the columnar kernel.
+
+    Builds both column sets against one shared pool and the row
+    matcher's timestamp reference (first signal with a timestamp), so
+    naive and aware datetimes both work, then adapts the result back
+    to :class:`BatchMatch` rows.
+    """
+    ref_dt = None
+    for s in signals:
+        if s.timestamp is not None:
+            ref_dt = s.timestamp
+            break
+    pool = StringPool()
+    sp = span_columns(spans, pool, ref_dt)
+    sg = signal_columns(signals, pool, ref_dt)
+    return match_columns(sp, sg, window_ms).to_batch_matches()

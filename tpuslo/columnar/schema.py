@@ -1,0 +1,511 @@
+"""Columnar probe-event schema: stable dtype + dictionary-encoded strings.
+
+One :class:`ColumnarBatch` holds N probe events as a numpy structured
+array (:data:`PROBE_EVENT_DTYPE`) plus a :class:`StringPool`: every
+string-typed column stores an ``i4`` code into the pool, so equality
+joins, dedup hashing and JSON escaping touch each **distinct** string
+once per batch instead of once per event.
+
+The dtype is *derived from* ``ProbeEventV1`` and must stay derived:
+:data:`COLUMNS_FOR_FIELD` maps every dataclass field (including the
+nested ``conn_tuple``/``tpu`` envelopes, flattened) to its columns, and
+tpulint rule TPL103 re-checks the mapping against both the dataclass
+AST and the dtype literal on every run — adding a field to
+``ProbeEventV1`` without a column (or vice versa) fails ``make lint``.
+
+Representation notes:
+
+* Optional envelopes carry explicit presence flags (``has_conn``,
+  ``has_tpu``, ``has_errno``); ``confidence`` uses NaN as its absence
+  sentinel (a valid confidence is finite in [0, 1]).
+* ``value`` is always ``f8``.  The contract type is JSON ``number``, so
+  ``12`` and ``12.0`` are the same value; the columnar spine normalizes
+  to float on entry (row-path parity is therefore up to int→float
+  widening on ``value``).
+* TPU integer identity defaults to ``-1`` on rows without a ``tpu``
+  block, matching the row pipeline's "absent" convention.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Any, Iterable, Sequence
+
+import numpy as np
+
+from tpuslo.schema.fastpath import validate_probe_payload
+from tpuslo.schema.types import ConnTuple, ProbeEventV1, TPURef
+
+#: (column name, numpy format).  A PURE LITERAL — tpulint TPL103 parses
+#: this tuple from the AST to cross-check it against ``ProbeEventV1``;
+#: keep it free of computed entries.
+_DTYPE_FIELDS: tuple[tuple[str, str], ...] = (
+    ("ts_unix_nano", "i8"),
+    ("signal", "i4"),
+    ("node", "i4"),
+    ("namespace", "i4"),
+    ("pod", "i4"),
+    ("container", "i4"),
+    ("pid", "i8"),
+    ("tid", "i8"),
+    ("value", "f8"),
+    ("unit", "i4"),
+    ("status", "i4"),
+    ("has_conn", "?"),
+    ("conn_src_ip", "i4"),
+    ("conn_dst_ip", "i4"),
+    ("conn_src_port", "i4"),
+    ("conn_dst_port", "i4"),
+    ("conn_protocol", "i4"),
+    ("trace_id", "i4"),
+    ("span_id", "i4"),
+    ("has_errno", "?"),
+    ("errno", "i8"),
+    ("confidence", "f8"),
+    ("has_tpu", "?"),
+    ("tpu_chip", "i4"),
+    ("tpu_slice_id", "i4"),
+    ("tpu_host_index", "i8"),
+    ("tpu_ici_link", "i8"),
+    ("tpu_program_id", "i4"),
+    ("tpu_launch_id", "i8"),
+    ("tpu_module_name", "i4"),
+)
+
+#: ProbeEventV1 field -> the dtype columns that represent it (nested
+#: envelopes flattened with a prefix).  Also a pure literal for TPL103.
+COLUMNS_FOR_FIELD: dict[str, tuple[str, ...]] = {
+    "ts_unix_nano": ("ts_unix_nano",),
+    "signal": ("signal",),
+    "node": ("node",),
+    "namespace": ("namespace",),
+    "pod": ("pod",),
+    "container": ("container",),
+    "pid": ("pid",),
+    "tid": ("tid",),
+    "value": ("value",),
+    "unit": ("unit",),
+    "status": ("status",),
+    "conn_tuple": (
+        "has_conn",
+        "conn_src_ip",
+        "conn_dst_ip",
+        "conn_src_port",
+        "conn_dst_port",
+        "conn_protocol",
+    ),
+    "trace_id": ("trace_id",),
+    "span_id": ("span_id",),
+    "errno": ("has_errno", "errno"),
+    "confidence": ("confidence",),
+    "tpu": (
+        "has_tpu",
+        "tpu_chip",
+        "tpu_slice_id",
+        "tpu_host_index",
+        "tpu_ici_link",
+        "tpu_program_id",
+        "tpu_launch_id",
+        "tpu_module_name",
+    ),
+}
+
+PROBE_EVENT_DTYPE = np.dtype(list(_DTYPE_FIELDS))
+
+#: String-typed columns (codes into the batch pool), kept in one place
+#: so consumers (serializer, dedup hashing) can iterate them.
+STRING_COLUMNS: tuple[str, ...] = (
+    "signal",
+    "node",
+    "namespace",
+    "pod",
+    "container",
+    "unit",
+    "status",
+    "conn_src_ip",
+    "conn_dst_ip",
+    "conn_protocol",
+    "trace_id",
+    "span_id",
+    "tpu_chip",
+    "tpu_slice_id",
+    "tpu_program_id",
+    "tpu_module_name",
+)
+
+_U64 = (1 << 64) - 1
+
+
+class StringPool:
+    """Append-only intern table; code 0 is always the empty string.
+
+    Derived per-entry artifacts (content hashes for dedup, JSON-escaped
+    forms for serialization) are cached and extended lazily — the pool
+    only ever grows, so a cache is valid up to the length it was built
+    at.
+    """
+
+    __slots__ = ("strings", "_index", "_hashes", "_escaped")
+
+    def __init__(self) -> None:
+        self.strings: list[str] = [""]
+        self._index: dict[str, int] = {"": 0}
+        self._hashes: list[int] = []
+        self._escaped: list[str] = []
+
+    def __len__(self) -> int:
+        return len(self.strings)
+
+    def intern(self, value: str) -> int:
+        code = self._index.get(value)
+        if code is None:
+            code = len(self.strings)
+            self.strings.append(value)
+            self._index[value] = code
+        return code
+
+    def get(self, code: int) -> str:
+        return self.strings[code]
+
+    def content_hashes(self) -> np.ndarray:
+        """uint64 content hash of every entry (IN-process stability).
+
+        Builtin ``hash`` is salted per interpreter, which is fine here:
+        these feed the columnar gate's dedup window, whose lifetime is
+        one process (the row gate's crash-restore digests use blake2b
+        for exactly the opposite reason).
+        """
+        for i in range(len(self._hashes), len(self.strings)):
+            self._hashes.append(hash(self.strings[i]) & _U64)
+        return np.array(self._hashes, dtype=np.uint64)
+
+    def escaped(self) -> list[str]:
+        """JSON-escaped (quoted) form of every entry, escaped once each."""
+        for i in range(len(self._escaped), len(self.strings)):
+            self._escaped.append(json.dumps(self.strings[i]))
+        return self._escaped
+
+
+@dataclass(slots=True)
+class ColumnarBatch:
+    """N probe events as columns: one contiguous array per dtype field.
+
+    Physical layout is struct-of-arrays, NOT one structured ndarray:
+    a structured array interleaves fields row-major, so every column
+    write/read walks the full ~150-byte row stride — measured ~6x the
+    cost of the contiguous per-column layout on the generation path.
+    :data:`PROBE_EVENT_DTYPE` stays the authoritative schema (field
+    names, widths, and the TPL103 sync contract); ``to_structured`` /
+    ``from_structured`` convert to the packed record form for
+    interchange.
+
+    Columns are logically immutable once a batch is handed off —
+    stages that change values (e.g. the gate's skew correction)
+    replace the column, sharing the rest, rather than writing in
+    place.
+    """
+
+    columns: dict[str, np.ndarray]
+    pool: StringPool
+    n: int
+
+    def __len__(self) -> int:
+        return self.n
+
+    def column(self, name: str) -> np.ndarray:
+        return self.columns[name]
+
+    def take(self, indexes: np.ndarray) -> "ColumnarBatch":
+        """Row subset sharing this batch's pool (codes stay valid)."""
+        cols = {k: v[indexes] for k, v in self.columns.items()}
+        return ColumnarBatch(cols, self.pool, len(next(iter(cols.values()))))
+
+    def with_column(self, name: str, values: np.ndarray) -> "ColumnarBatch":
+        """Same rows with one column replaced (others shared, no copy)."""
+        cols = dict(self.columns)
+        cols[name] = values
+        return ColumnarBatch(cols, self.pool, self.n)
+
+    def to_structured(self) -> np.ndarray:
+        """Packed :data:`PROBE_EVENT_DTYPE` record array (copies)."""
+        out = np.empty(self.n, dtype=PROBE_EVENT_DTYPE)
+        for name in PROBE_EVENT_DTYPE.names:
+            out[name] = self.columns[name]
+        return out
+
+    @classmethod
+    def from_structured(
+        cls, data: np.ndarray, pool: StringPool
+    ) -> "ColumnarBatch":
+        cols = {
+            name: np.ascontiguousarray(data[name])
+            for name in PROBE_EVENT_DTYPE.names
+        }
+        return cls(cols, pool, len(data))
+
+
+def alloc_batch_columns(n: int) -> dict[str, np.ndarray]:
+    """Uninitialized column views over ONE backing buffer.
+
+    Allocating ~30 quarter-megabyte column arrays per batch and holding
+    them sends glibc down the mmap path (fresh pages, fault-on-touch)
+    on every batch; a single arena allocation pays one fault pass and
+    lets producers fill columns with broadcast stores.  Callers MUST
+    write every column (or use :func:`empty_batch`, which zeros).
+    """
+    offsets: list[tuple[str, np.dtype, int]] = []
+    off = 0
+    for name, fmt in _DTYPE_FIELDS:
+        dt = np.dtype(fmt)
+        size = dt.itemsize
+        off = (off + size - 1) // size * size
+        offsets.append((name, dt, off))
+        off += size * n
+    buf = np.empty(off, dtype=np.uint8)
+    return {
+        name: buf[start:start + dt.itemsize * n].view(dt)
+        for name, dt, start in offsets
+    }
+
+
+def empty_batch(n: int = 0, pool: StringPool | None = None) -> ColumnarBatch:
+    cols: dict[str, np.ndarray] = {}
+    for name, fmt in _DTYPE_FIELDS:
+        cols[name] = np.zeros(n, dtype=fmt)
+    if n:
+        cols["confidence"].fill(np.nan)
+        for name in ("tpu_host_index", "tpu_ici_link", "tpu_launch_id"):
+            cols[name].fill(-1)
+    return ColumnarBatch(cols, pool or StringPool(), n)
+
+
+def from_rows(
+    events: Sequence[ProbeEventV1], pool: StringPool | None = None
+) -> ColumnarBatch:
+    """Row adapter in: typed events → columns.
+
+    Per-event Python cost is inherent here — this is the boundary the
+    columnar pipeline exists to avoid; use it for interop and tests,
+    not inside hot loops.
+    """
+    batch = empty_batch(len(events), pool)
+    c = batch.columns
+    intern = batch.pool.intern
+    for i, ev in enumerate(events):
+        c["ts_unix_nano"][i] = ev.ts_unix_nano
+        c["signal"][i] = intern(ev.signal)
+        c["node"][i] = intern(ev.node)
+        c["namespace"][i] = intern(ev.namespace)
+        c["pod"][i] = intern(ev.pod)
+        c["container"][i] = intern(ev.container)
+        c["pid"][i] = ev.pid
+        c["tid"][i] = ev.tid
+        c["value"][i] = ev.value
+        c["unit"][i] = intern(ev.unit)
+        c["status"][i] = intern(ev.status)
+        conn = ev.conn_tuple
+        if conn is not None:
+            c["has_conn"][i] = True
+            c["conn_src_ip"][i] = intern(conn.src_ip)
+            c["conn_dst_ip"][i] = intern(conn.dst_ip)
+            c["conn_src_port"][i] = conn.src_port
+            c["conn_dst_port"][i] = conn.dst_port
+            c["conn_protocol"][i] = intern(conn.protocol)
+        c["trace_id"][i] = intern(ev.trace_id)
+        c["span_id"][i] = intern(ev.span_id)
+        if ev.errno is not None:
+            c["has_errno"][i] = True
+            c["errno"][i] = ev.errno
+        if ev.confidence is not None:
+            c["confidence"][i] = ev.confidence
+        tpu = ev.tpu
+        if tpu is not None:
+            c["has_tpu"][i] = True
+            c["tpu_chip"][i] = intern(tpu.chip)
+            c["tpu_slice_id"][i] = intern(tpu.slice_id)
+            c["tpu_host_index"][i] = tpu.host_index
+            c["tpu_ici_link"][i] = tpu.ici_link
+            c["tpu_program_id"][i] = intern(tpu.program_id)
+            c["tpu_launch_id"][i] = tpu.launch_id
+            c["tpu_module_name"][i] = intern(tpu.module_name)
+    return batch
+
+
+def _column_lists(batch: ColumnarBatch) -> dict[str, list]:
+    """Columns as python lists (one C-level conversion per column)."""
+    return {name: col.tolist() for name, col in batch.columns.items()}
+
+
+def to_rows(batch: ColumnarBatch) -> list[ProbeEventV1]:
+    """Row adapter out: columns → typed events (value widened to float)."""
+    strings = batch.pool.strings
+    c = _column_lists(batch)
+    out: list[ProbeEventV1] = []
+    for i in range(batch.n):
+        conn = None
+        if c["has_conn"][i]:
+            conn = ConnTuple(
+                src_ip=strings[c["conn_src_ip"][i]],
+                dst_ip=strings[c["conn_dst_ip"][i]],
+                src_port=c["conn_src_port"][i],
+                dst_port=c["conn_dst_port"][i],
+                protocol=strings[c["conn_protocol"][i]],
+            )
+        tpu = None
+        if c["has_tpu"][i]:
+            tpu = TPURef(
+                chip=strings[c["tpu_chip"][i]],
+                slice_id=strings[c["tpu_slice_id"][i]],
+                host_index=c["tpu_host_index"][i],
+                ici_link=c["tpu_ici_link"][i],
+                program_id=strings[c["tpu_program_id"][i]],
+                launch_id=c["tpu_launch_id"][i],
+                module_name=strings[c["tpu_module_name"][i]],
+            )
+        confidence = c["confidence"][i]
+        out.append(
+            ProbeEventV1(
+                ts_unix_nano=c["ts_unix_nano"][i],
+                signal=strings[c["signal"][i]],
+                node=strings[c["node"][i]],
+                namespace=strings[c["namespace"][i]],
+                pod=strings[c["pod"][i]],
+                container=strings[c["container"][i]],
+                pid=c["pid"][i],
+                tid=c["tid"][i],
+                value=c["value"][i],
+                unit=strings[c["unit"][i]],
+                status=strings[c["status"][i]],
+                conn_tuple=conn,
+                trace_id=strings[c["trace_id"][i]],
+                span_id=strings[c["span_id"][i]],
+                errno=c["errno"][i] if c["has_errno"][i] else None,
+                confidence=(
+                    None if confidence != confidence else confidence
+                ),
+                tpu=tpu,
+            )
+        )
+    return out
+
+
+def from_payloads(
+    payloads: Iterable[dict[str, Any]], pool: StringPool | None = None
+) -> tuple[ColumnarBatch, list[tuple[int, Any]]]:
+    """Wire adapter in: probe-event dicts → columns + rejects.
+
+    Every payload runs the same combined validator the row gate uses
+    (structural fast path, jsonschema fallback), so the accept set is
+    identical by construction; rejects come back as ``(input index,
+    payload)`` for quarantine classification.  Like :func:`from_rows`
+    this pays per-event Python cost — it is the ingest boundary for
+    streams that arrive as dicts, not a hot-loop citizen.
+    """
+    accepted: list[dict[str, Any]] = []
+    rejects: list[tuple[int, Any]] = []
+    for idx, payload in enumerate(payloads):
+        if validate_probe_payload(payload):
+            accepted.append(payload)
+        else:
+            rejects.append((idx, payload))
+    batch = empty_batch(len(accepted), pool)
+    c = batch.columns
+    intern = batch.pool.intern
+    for i, p in enumerate(accepted):
+        c["ts_unix_nano"][i] = p["ts_unix_nano"]
+        c["signal"][i] = intern(p["signal"])
+        c["node"][i] = intern(p["node"])
+        c["namespace"][i] = intern(p["namespace"])
+        c["pod"][i] = intern(p["pod"])
+        c["container"][i] = intern(p["container"])
+        c["pid"][i] = p["pid"]
+        c["tid"][i] = p["tid"]
+        c["value"][i] = p["value"]
+        c["unit"][i] = intern(p["unit"])
+        c["status"][i] = intern(p["status"])
+        conn = p.get("conn_tuple")
+        if conn is not None:
+            c["has_conn"][i] = True
+            c["conn_src_ip"][i] = intern(conn["src_ip"])
+            c["conn_dst_ip"][i] = intern(conn["dst_ip"])
+            c["conn_src_port"][i] = conn["src_port"]
+            c["conn_dst_port"][i] = conn["dst_port"]
+            c["conn_protocol"][i] = intern(conn["protocol"])
+        c["trace_id"][i] = intern(p.get("trace_id", ""))
+        c["span_id"][i] = intern(p.get("span_id", ""))
+        if p.get("errno") is not None:
+            c["has_errno"][i] = True
+            c["errno"][i] = p["errno"]
+        if p.get("confidence") is not None:
+            c["confidence"][i] = p["confidence"]
+        tpu = p.get("tpu")
+        if tpu is not None:
+            c["has_tpu"][i] = True
+            c["tpu_chip"][i] = intern(tpu.get("chip", ""))
+            c["tpu_slice_id"][i] = intern(tpu.get("slice_id", ""))
+            c["tpu_host_index"][i] = tpu.get("host_index", -1)
+            c["tpu_ici_link"][i] = tpu.get("ici_link", -1)
+            c["tpu_program_id"][i] = intern(tpu.get("program_id", ""))
+            c["tpu_launch_id"][i] = tpu.get("launch_id", -1)
+            c["tpu_module_name"][i] = intern(tpu.get("module_name", ""))
+    return batch, rejects
+
+
+def to_payloads(batch: ColumnarBatch) -> list[dict[str, Any]]:
+    """Columns → ``to_dict``-shaped payload dicts (same key order and
+    omission rules as ``ProbeEventV1.to_dict``)."""
+    strings = batch.pool.strings
+    c = _column_lists(batch)
+    out: list[dict[str, Any]] = []
+    for i in range(batch.n):
+        payload: dict[str, Any] = {
+            "ts_unix_nano": c["ts_unix_nano"][i],
+            "signal": strings[c["signal"][i]],
+            "node": strings[c["node"][i]],
+            "namespace": strings[c["namespace"][i]],
+            "pod": strings[c["pod"][i]],
+            "container": strings[c["container"][i]],
+            "pid": c["pid"][i],
+            "tid": c["tid"][i],
+            "value": c["value"][i],
+            "unit": strings[c["unit"][i]],
+            "status": strings[c["status"][i]],
+        }
+        if c["has_conn"][i]:
+            payload["conn_tuple"] = {
+                "src_ip": strings[c["conn_src_ip"][i]],
+                "dst_ip": strings[c["conn_dst_ip"][i]],
+                "src_port": c["conn_src_port"][i],
+                "dst_port": c["conn_dst_port"][i],
+                "protocol": strings[c["conn_protocol"][i]],
+            }
+        if c["trace_id"][i]:
+            payload["trace_id"] = strings[c["trace_id"][i]]
+        if c["span_id"][i]:
+            payload["span_id"] = strings[c["span_id"][i]]
+        if c["has_errno"][i]:
+            payload["errno"] = c["errno"][i]
+        confidence = c["confidence"][i]
+        if confidence == confidence:  # not NaN
+            payload["confidence"] = confidence
+        if c["has_tpu"][i]:
+            tpu: dict[str, Any] = {}
+            if c["tpu_chip"][i]:
+                tpu["chip"] = strings[c["tpu_chip"][i]]
+            if c["tpu_slice_id"][i]:
+                tpu["slice_id"] = strings[c["tpu_slice_id"][i]]
+            if c["tpu_host_index"][i] >= 0:
+                tpu["host_index"] = c["tpu_host_index"][i]
+            if c["tpu_ici_link"][i] >= 0:
+                tpu["ici_link"] = c["tpu_ici_link"][i]
+            if c["tpu_program_id"][i]:
+                tpu["program_id"] = strings[c["tpu_program_id"][i]]
+            if c["tpu_launch_id"][i] >= 0:
+                tpu["launch_id"] = c["tpu_launch_id"][i]
+            if c["tpu_module_name"][i]:
+                tpu["module_name"] = strings[c["tpu_module_name"][i]]
+            if tpu:
+                payload["tpu"] = tpu
+        out.append(payload)
+    return out

@@ -1,0 +1,205 @@
+"""Column → JSONL without per-event dicts or per-event json.dumps.
+
+``EventWriters`` serializes the row path with one ``json.dumps`` per
+event over a freshly-built dict — at fleet scale that dominates the
+deliver stage.  Here each batch serializes in one pass over the
+column lists:
+
+* every distinct string JSON-escapes **once per pool entry**
+  (:meth:`StringPool.escaped`), not once per event;
+* numbers format straight from the columns (``repr`` of a Python
+  float is exactly json.dumps' float form; ints are ints);
+* probe batches are hugely template-redundant — across a synthetic
+  fleet batch only ``ts_unix_nano``, ``trace_id`` and ``launch_id``
+  vary within a (signal, fault-profile) group — so rows group by a
+  vectorized shape hash and each distinct shape compiles ONCE into a
+  ``%``-format template; per event only the variable fields format.
+  Low-redundancy batches (arbitrary wire traffic) fall back to direct
+  per-row assembly.
+
+Byte parity — ``serialize_jsonl(batch)`` equals
+``"".join(json.dumps(p, separators=(",", ":")) + "\\n" for p in
+to_payloads(batch))`` — is locked in by tests/test_columnar_parity.py
+for both the template and the direct path.
+"""
+
+from __future__ import annotations
+
+from typing import IO
+
+import numpy as np
+
+from tpuslo.columnar.schema import ColumnarBatch
+
+# Columns that may vary inside one template group; everything else is
+# part of the shape hash.  (trace presence / launch presence DO shape
+# the template, so their flags join the hash.)
+_VARIABLE = ("ts_unix_nano", "trace_id", "tpu_launch_id")
+
+def _odd_constants(count: int) -> tuple[np.uint64, ...]:
+    """splitmix64-derived odd multipliers, one per hashed column."""
+    out = []
+    x = 0x9E3779B97F4A7C15
+    mask = (1 << 64) - 1
+    for _ in range(count):
+        x = (x + 0x9E3779B97F4A7C15) & mask
+        z = x
+        z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & mask
+        z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & mask
+        z ^= z >> 31
+        out.append(np.uint64(z | 1))
+    return tuple(out)
+
+
+# One multiplier per shape-hash part (30 dtype fields + 2 presence
+# flags covers it), plus a finalizer.
+_M = _odd_constants(40)
+
+
+def _shape_hash(batch: ColumnarBatch) -> np.ndarray:
+    c = batch.columns
+    parts = [
+        c[name].view(np.uint64)
+        if c[name].dtype == np.float64
+        else c[name].astype(np.uint64)
+        for name in c
+        if name not in _VARIABLE
+    ]
+    parts.append((c["trace_id"] != 0).astype(np.uint64))
+    parts.append(
+        (c["has_tpu"] & (c["tpu_launch_id"] >= 0)).astype(np.uint64)
+    )
+    h = parts[0] * _M[0]
+    for part, mul in zip(parts[1:], _M[1:]):
+        h = h ^ (part * mul)
+    h = (h ^ (h >> np.uint64(30))) * _M[-1]
+    return h ^ (h >> np.uint64(31))
+
+
+_SENT = "\x00"  # placeholder marker; json-escaped strings never hold it
+
+
+def _row_pieces(
+    c: dict[str, list], esc: list[str], i: int, kind_frag: str,
+    template: bool,
+) -> tuple[str, int]:
+    """One row as (text-or-template, case bitmask).
+
+    ``template=True`` renders a ``_SENT`` marker for each variable
+    field — always in (ts, trace?, launch?) order; the case bitmask
+    says which of trace (bit 0) / launch (bit 1) are present —
+    ``template=False`` renders the finished line for row ``i``.
+    """
+    e = lambda code: esc[code]  # noqa: E731 - tight per-field accessor
+    ts = _SENT if template else c["ts_unix_nano"][i]
+    head = (
+        f'{{{kind_frag}"ts_unix_nano":{ts},"signal":{e(c["signal"][i])}'
+        f',"node":{e(c["node"][i])},"namespace":{e(c["namespace"][i])}'
+        f',"pod":{e(c["pod"][i])},"container":{e(c["container"][i])}'
+        f',"pid":{c["pid"][i]},"tid":{c["tid"][i]}'
+        f',"value":{c["value"][i]!r}'
+        f',"unit":{e(c["unit"][i])},"status":{e(c["status"][i])}'
+    )
+    case = 0
+    if c["has_conn"][i]:
+        head += (
+            f',"conn_tuple":{{"src_ip":{e(c["conn_src_ip"][i])}'
+            f',"dst_ip":{e(c["conn_dst_ip"][i])}'
+            f',"src_port":{c["conn_src_port"][i]}'
+            f',"dst_port":{c["conn_dst_port"][i]}'
+            f',"protocol":{e(c["conn_protocol"][i])}}}'
+        )
+    if c["trace_id"][i]:
+        head += f',"trace_id":{_SENT}' if template else (
+            f',"trace_id":{esc[c["trace_id"][i]]}'
+        )
+        case |= 1
+    if c["span_id"][i]:
+        head += f',"span_id":{e(c["span_id"][i])}'
+    if c["has_errno"][i]:
+        head += f',"errno":{c["errno"][i]}'
+    conf = c["confidence"][i]
+    if conf == conf:  # not NaN
+        head += f',"confidence":{conf!r}'
+    if c["has_tpu"][i]:
+        tpu = ""
+        if c["tpu_chip"][i]:
+            tpu += f',"chip":{e(c["tpu_chip"][i])}'
+        if c["tpu_slice_id"][i]:
+            tpu += f',"slice_id":{e(c["tpu_slice_id"][i])}'
+        if c["tpu_host_index"][i] >= 0:
+            tpu += f',"host_index":{c["tpu_host_index"][i]}'
+        if c["tpu_ici_link"][i] >= 0:
+            tpu += f',"ici_link":{c["tpu_ici_link"][i]}'
+        if c["tpu_program_id"][i]:
+            tpu += f',"program_id":{e(c["tpu_program_id"][i])}'
+        if c["tpu_launch_id"][i] >= 0:
+            tpu += f',"launch_id":{_SENT}' if template else (
+                f',"launch_id":{c["tpu_launch_id"][i]}'
+            )
+            case |= 2
+        if c["tpu_module_name"][i]:
+            tpu += f',"module_name":{e(c["tpu_module_name"][i])}'
+        if tpu:
+            head += f',"tpu":{{{tpu[1:]}}}'
+    return head + "}\n", case
+
+
+def serialize_jsonl(batch: ColumnarBatch, kind: str = "") -> str:
+    """One JSONL block for the batch (optionally ``{"kind": ...}``-
+    prefixed like the agent's stdout/jsonl writers)."""
+    n = batch.n
+    if n == 0:
+        return ""
+    esc = batch.pool.escaped()
+    kind_frag = f'"kind":"{kind}",' if kind else ""
+
+    shapes = _shape_hash(batch)
+    uniq, first_idx, inverse = np.unique(
+        shapes, return_index=True, return_inverse=True
+    )
+    lines: list[str] = []
+    append = lines.append
+    if len(uniq) * 4 > n:
+        # Low redundancy: templates would compile nearly per row.
+        c = {name: col.tolist() for name, col in batch.columns.items()}
+        for i in range(n):
+            text, _ = _row_pieces(c, esc, i, kind_frag, template=False)
+            append(text)
+        return "".join(lines)
+
+    # One template per distinct shape, pre-split at its variable
+    # fields; per event only (ts, trace?, launch?) interleave.
+    reps = {
+        name: col[first_idx].tolist()
+        for name, col in batch.columns.items()
+    }
+    compiled = []
+    for u in range(len(uniq)):
+        text, case = _row_pieces(reps, esc, u, kind_frag, template=True)
+        compiled.append((text.split(_SENT), case))
+    ts = batch.columns["ts_unix_nano"].tolist()
+    trace = batch.columns["trace_id"].tolist()
+    launch = batch.columns["tpu_launch_id"].tolist()
+    inv = inverse.tolist()
+    for i in range(n):
+        segs, case = compiled[inv[i]]
+        if case == 0:
+            append(f"{segs[0]}{ts[i]}{segs[1]}")
+        elif case == 1:
+            append(f"{segs[0]}{ts[i]}{segs[1]}{esc[trace[i]]}{segs[2]}")
+        elif case == 2:
+            append(f"{segs[0]}{ts[i]}{segs[1]}{launch[i]}{segs[2]}")
+        else:
+            append(
+                f"{segs[0]}{ts[i]}{segs[1]}{esc[trace[i]]}"
+                f"{segs[2]}{launch[i]}{segs[3]}"
+            )
+    return "".join(lines)
+
+
+def write_jsonl(batch: ColumnarBatch, stream: IO[str], kind: str = "") -> int:
+    """Serialize + one buffered write; returns the byte count written."""
+    block = serialize_jsonl(batch, kind)
+    stream.write(block)
+    return len(block)

@@ -58,6 +58,11 @@ class ClockSkewEstimator:
             tuple[str, str, int], dict[int, tuple[int, str]]
         ] = OrderedDict()
         self.groups_observed = 0
+        #: Total offset samples recorded; unlike ``groups_observed``
+        #: this only moves when the per-node evidence (and therefore a
+        #: possible offset estimate) actually changed — the columnar
+        #: gate keys its segment breakpoints on it.
+        self.samples_observed = 0
 
     def observe(self, event: dict[str, Any]) -> None:
         """Feed one probe-event dict; only launch-group members count.
@@ -84,10 +89,29 @@ class ClockSkewEstimator:
         node = event.get("node", "")
         if host < 0 or launch_id < 0 or not slice_id or not node or ts <= 0:
             return
-        if host == self.coordinator_host:
-            self.coordinator_node = str(node)
+        self.observe_group(
+            str(slice_id), str(program_id), launch_id, host, str(node), ts
+        )
 
-        key = (str(slice_id), str(program_id), launch_id)
+    def observe_group(
+        self,
+        slice_id: str,
+        program_id: str,
+        launch_id: int,
+        host: int,
+        node: str,
+        ts: int,
+    ) -> None:
+        """Guard-free core of :meth:`observe` for pre-validated rows.
+
+        The columnar gate applies ``observe``'s guard clauses as one
+        vectorized mask and feeds the surviving rows here directly —
+        same state transitions, no per-event dict round trip.
+        """
+        if host == self.coordinator_host:
+            self.coordinator_node = node
+
+        key = (slice_id, program_id, launch_id)
         group = self._pending.get(key)
         if group is None:
             if len(self._pending) >= _MAX_PENDING_GROUPS:
@@ -110,6 +134,7 @@ class ClockSkewEstimator:
                     maxlen=self._window
                 )
             samples.append(other_ts - coord_ts)
+            self.samples_observed += 1
         self.groups_observed += 1
         # Re-keep only the coordinator entry: late host observations of
         # the same launch still pair against it without re-sampling the
@@ -166,6 +191,10 @@ class ClockSkewEstimator:
             state.get("coordinator_node", self.coordinator_node)
         )
         self.groups_observed += int(state.get("groups_observed", 0))
+        restored = sum(
+            len(v) for v in (state.get("samples") or {}).values()
+        )
+        self.samples_observed += int(restored)
         for node, values in (state.get("samples") or {}).items():
             samples = self._samples.get(str(node))
             if samples is None:

@@ -454,6 +454,26 @@ class Generator:
                 out.append(event)
         return out
 
+    def generate_batch_columnar(self, samples, meta: Metadata, trace_ids=None):
+        """Columnar twin of :meth:`generate_batch`: samples → columns.
+
+        Same snapshot semantics (one lock acquisition, one enricher
+        call per batch), but the expansion writes a
+        :class:`tpuslo.columnar.ColumnarBatch` directly — no per-event
+        dataclass.  ``trace_ids`` optionally stamps each sample's own
+        trace identity (the agent's columnar loop needs per-sample
+        traces; the row batch API carries one meta for the batch).
+        Parity with the row path is locked in by
+        tests/test_columnar_parity.py.
+        """
+        from tpuslo.columnar.generate import columns_from_samples
+
+        with self._lock:
+            enabled = self._enabled.copy()
+        if self._enricher is not None:
+            meta = self._enricher.enrich(meta)
+        return columns_from_samples(samples, meta, enabled, trace_ids)
+
     @staticmethod
     def _tpu_ref(
         chip: str, meta: Metadata, launch_id: int, ici_link: int
