@@ -11,7 +11,7 @@ ARTIFACTS ?= artifacts
 	bench-smoke bench-columnar-smoke bench-columnar-full \
 	chaos-smoke chaos-demo chaos-telemetry-smoke \
 	chaos-telemetry-sweep crash-smoke crash-sweep obs-smoke \
-	burn-smoke burn-sweep \
+	burn-smoke burn-sweep fleet-smoke fleet-sweep \
 	metrics-drift m5-candidate m5-gate helm-lint dashboards clean
 
 all: native test
@@ -196,6 +196,24 @@ burn-sweep:
 		--summary-json $(ARTIFACTS)/burn/sweep.json \
 		--summary-md $(ARTIFACTS)/burn/sweep.md
 
+# Fleet observability-plane smoke: wire contract round trips, hash-ring
+# placement, rollup merge invariants (no cross-tenant/cross-domain),
+# aggregator seq-dedup + failover absorb, and a small seeded simulator
+# run — seconds, runs in m5-gate.
+fleet-smoke:
+	$(PY) -m pytest tests/test_fleet.py -q -m 'not slow'
+
+# Full fleet-sweep release gate (slow): 1k simulated nodes over 4
+# aggregator shards — aggregate columnar ingest >= 5M events/s,
+# exactly one incident per injected fleet fault at the correct blast
+# radius under chaos, and a mid-sweep aggregator kill with zero lost
+# or duplicated incidents (see docs/runbooks/fleet-rollup.md).
+fleet-sweep:
+	mkdir -p $(ARTIFACTS)/fleet
+	$(PY) -m tpuslo m5gate --fleet-sweep \
+		--summary-json $(ARTIFACTS)/fleet/sweep.json \
+		--summary-md $(ARTIFACTS)/fleet/sweep.md
+
 # Full crash-sweep release gate: seeds x kill points of SIGKILL/restart
 # audits (see docs/evidence/crash-sweep.md + docs/runbooks/crash-recovery.md).
 crash-sweep:
@@ -239,9 +257,11 @@ m5-candidate:
 	@echo "m5-candidate: artifacts under $(ARTIFACTS)/m5"
 
 # Release candidates fail on new lint findings, lock-order races,
-# burn-alert contract violations, or row-vs-columnar divergence before
-# the statistical gates even run (ISSUEs 6 + 7 + 8).
-m5-gate: lint racecheck-smoke burn-smoke burn-sweep bench-columnar-smoke
+# burn-alert contract violations, row-vs-columnar divergence, or a
+# broken fleet plane before the statistical gates even run
+# (ISSUEs 6 + 7 + 8 + 9).
+m5-gate: lint racecheck-smoke burn-smoke burn-sweep bench-columnar-smoke \
+		fleet-smoke fleet-sweep
 	$(PY) -m tpuslo m5gate --candidate-root $(ARTIFACTS)/m5 \
 		--scenarios "$(shell echo $(M5_SCENARIOS) | tr ' ' ',')" \
 		--summary-json $(ARTIFACTS)/m5/gate.json \

@@ -358,6 +358,63 @@ def bench_tracer_overhead(
     }
 
 
+# Fleet-plane release floors (ISSUE 9): the sharded aggregator path
+# must clear these at gate scale (1k nodes / 4 shards) or bench.py
+# hard-fails.  Smaller smoke topologies report numbers without gating.
+FLEET_INGEST_EVENTS_PER_SEC_FLOOR = 5_000_000
+FLEET_ROLLUP_LATENCY_MS_CEILING = 2_000.0
+FLEET_GATE_MIN_NODES = 1000
+
+
+def bench_fleet(
+    nodes: int = 1000, shards: int = 4, events_per_node: int = 6000
+) -> dict:
+    """Aggregate fleet-ingest throughput over sharded aggregators.
+
+    One binary-transport shipment per simulated node is template-cloned
+    (generation ~free) and driven through the shard the hash ring
+    assigns; the number under test is the aggregator path — decode
+    (``np.frombuffer``) → seq dedup → merge (``concat_batches``) →
+    columnar gate → evidence fold — reported as total events over the
+    *slowest shard's* busy time, i.e. the wall time a parallel
+    deployment would see.  The rollup pass (window close + attribution
+    + cross-node collapse) is timed separately.
+    """
+    from tpuslo.fleet.simulator import FleetSimulator, FleetTopology
+
+    topology = FleetTopology.for_nodes(nodes)
+    sim = FleetSimulator(
+        topology, tuple(f"agg-{i}" for i in range(shards)), seed=1337
+    )
+    m = sim.measure_ingest(events_per_node)
+    result = {
+        "fleet_nodes": m.nodes,
+        "fleet_shards": m.shards,
+        "fleet_total_events": m.total_events,
+        "fleet_ingest_events_per_sec": round(m.events_per_sec, 1),
+        "fleet_per_shard_events_per_sec": {
+            k: round(v, 1)
+            for k, v in sorted(m.per_shard_events_per_sec.items())
+        },
+        "fleet_rollup_latency_ms": round(m.rollup_latency_ms, 2),
+        "fleet_ingest_floor": FLEET_INGEST_EVENTS_PER_SEC_FLOOR,
+        "fleet_rollup_ceiling_ms": FLEET_ROLLUP_LATENCY_MS_CEILING,
+        "fleet_gates_met": bool(
+            m.events_per_sec >= FLEET_INGEST_EVENTS_PER_SEC_FLOOR
+            and m.rollup_latency_ms <= FLEET_ROLLUP_LATENCY_MS_CEILING
+        ),
+    }
+    if nodes >= FLEET_GATE_MIN_NODES and not result["fleet_gates_met"]:
+        raise SystemExit(
+            "bench_fleet: fleet floors not met — ingest "
+            f"{m.events_per_sec:,.0f} events/s (floor "
+            f"{FLEET_INGEST_EVENTS_PER_SEC_FLOOR:,}), rollup "
+            f"{m.rollup_latency_ms:.1f} ms (ceiling "
+            f"{FLEET_ROLLUP_LATENCY_MS_CEILING:,.0f})"
+        )
+    return result
+
+
 # Columnar release floors (ISSUE 8): the gated spine must clear these
 # on the full bench run or bench.py hard-fails.  Enforced only at
 # gate-scale sample counts — tiny smoke batches can't amortize fixed
@@ -1129,7 +1186,19 @@ def _digest_pipeline(pipeline: dict) -> dict:
             gates.get("events_gate_met") and gates.get("matcher_gate_met")
         ),
         "parity_ok": bool(parity.get("all")),
-    }
+    } | (
+        {
+            "fleet_ingest_events_per_sec": round(
+                fleet.get("fleet_ingest_events_per_sec", 0.0), 1
+            ),
+            "fleet_rollup_latency_ms": round(
+                fleet.get("fleet_rollup_latency_ms", 0.0), 2
+            ),
+            "fleet_gates_met": bool(fleet.get("fleet_gates_met")),
+        }
+        if (fleet := pipeline.get("fleet") or {})
+        else {}
+    )
 
 
 def _digest_robustness(robustness: dict) -> dict:
@@ -1305,6 +1374,9 @@ def main() -> int:
     # Static-analysis cost gate (ISSUE 6): full tpulint run < 30 s.
     overhead_result.update(bench_analyzer())
     pipeline_result = bench_pipeline()
+    # Fleet observability plane (ISSUE 9): aggregate sharded-aggregator
+    # ingest + rollup latency, hard floors at gate scale.
+    pipeline_result["fleet"] = bench_fleet()
     serving_result = bench_serving()
 
     full, compact = build_result(

@@ -265,6 +265,42 @@ error_budget = dashboard(
     ],
 )
 
+fleet_overview = dashboard(
+    "tpuslo-fleet-overview",
+    "TPU SLO / Fleet Overview",
+    [
+        # --- ingest plane (sharded aggregators) ----------------------
+        panel("Shard ingest rate (events/s, by aggregator)", [
+            ('sum(rate(llm_slo_fleet_ingested_events_total[5m])) by (shard)', "{{shard}}"),
+        ], 0, 0),
+        panel("Aggregate fleet ingest (events/s, headline)", [
+            ('sum(rate(llm_slo_fleet_ingested_events_total[5m]))', "fleet events/s"),
+        ], 12, 0, w=6, kind="stat"),
+        panel("Ring rebalances (1h)", [
+            ('sum(increase(llm_slo_fleet_ring_rebalances_total[1h]))', "rebalances"),
+        ], 18, 0, w=6, kind="stat"),
+        # --- rollup plane --------------------------------------------
+        panel("Rollup latency p50/p99 (ms)", [
+            ('histogram_quantile(0.50, sum(rate(llm_slo_fleet_rollup_latency_ms_bucket[5m])) by (le))', "rollup p50"),
+            ('histogram_quantile(0.99, sum(rate(llm_slo_fleet_rollup_latency_ms_bucket[5m])) by (le))', "rollup p99"),
+        ], 0, 8, unit="ms"),
+        panel("Incidents open by blast radius", [
+            ('llm_slo_fleet_incidents_open', "{{blast_radius}}"),
+        ], 12, 8),
+        # --- fleet membership health ---------------------------------
+        panel("Nodes reporting vs stale", [
+            ('llm_slo_fleet_nodes_reporting', "reporting"),
+            ('llm_slo_fleet_nodes_stale', "stale"),
+        ], 0, 16),
+        panel("Stale nodes (triage threshold > 0)", [
+            ('llm_slo_fleet_nodes_stale', "stale nodes"),
+        ], 12, 16, w=6, kind="stat"),
+        panel("Fleet-radius incidents open (page immediately)", [
+            ('llm_slo_fleet_incidents_open{blast_radius="fleet"}', "fleet-wide"),
+        ], 18, 16, w=6, kind="stat"),
+    ],
+)
+
 FILES = {
     "slo-overview.json": slo_overview,
     "tpu-kernel-correlation.json": kernel_correlation,
@@ -272,6 +308,7 @@ FILES = {
     "evidence-e2e.json": evidence_e2e,
     "agent-self-observability.json": agent_selfobs,
     "error-budget.json": error_budget,
+    "fleet-overview.json": fleet_overview,
 }
 
 if __name__ == "__main__":

@@ -19,6 +19,7 @@ from tpuslo.analysis import FileContext, RepoContext, run_analysis
 from tpuslo.analysis.rules_contracts import (
     ColumnarDtypeDriftRule,
     ConfigDriftRule,
+    FleetWireDriftRule,
     MetricsDriftRule,
     SchemaDriftRule,
 )
@@ -250,6 +251,114 @@ class TestColumnarDtypeDrift:
         findings = list(ColumnarDtypeDriftRule().check_repo(repo))
         assert any(
             f.code == "TPL103" and "pure" in f.message for f in findings
+        )
+
+
+FLEET_WIRE_REL = "tpuslo/fleet/wire.py"
+
+
+def _fleet_repo(
+    wire_transform=None, columnar_transform=None, types_transform=None
+) -> RepoContext:
+    """All three TPL104 anchors in context, any mutated in memory."""
+    contexts = []
+    for rel, transform in (
+        (FLEET_WIRE_REL, wire_transform),
+        (COLUMNAR_REL, columnar_transform),
+        (TYPES_REL, types_transform),
+    ):
+        source = (REPO / rel).read_text(encoding="utf-8")
+        if transform is not None:
+            source = transform(source)
+        contexts.append(FileContext(REPO / rel, rel, source))
+    return RepoContext(REPO, contexts)
+
+
+class TestFleetWireDrift:
+    def test_real_tree_is_clean(self):
+        assert list(
+            FleetWireDriftRule().check_repo(_fleet_repo())
+        ) == []
+
+    def test_dropped_wire_column_flagged(self):
+        """Mutation test: remove one shipped column — the aggregator
+        would silently reconstruct batches without span identity."""
+        repo = _fleet_repo(
+            wire_transform=lambda s: s.replace('    "span_id",\n', "", 1)
+        )
+        findings = list(FleetWireDriftRule().check_repo(repo))
+        assert any(
+            f.code == "TPL104" and "'span_id'" in f.message
+            and "missing from WIRE_EVENT_COLUMNS" in f.message
+            for f in findings
+        )
+
+    def test_unknown_wire_column_flagged(self):
+        repo = _fleet_repo(
+            wire_transform=lambda s: s.replace(
+                '    "span_id",\n',
+                '    "span_id",\n    "mystery_wire_col",\n',
+                1,
+            )
+        )
+        findings = list(FleetWireDriftRule().check_repo(repo))
+        assert any(
+            f.code == "TPL104" and "mystery_wire_col" in f.message
+            and "not a PROBE_EVENT_DTYPE column" in f.message
+            for f in findings
+        )
+
+    def test_duplicate_wire_column_flagged(self):
+        repo = _fleet_repo(
+            wire_transform=lambda s: s.replace(
+                '    "span_id",\n', '    "span_id",\n    "span_id",\n', 1
+            )
+        )
+        findings = list(FleetWireDriftRule().check_repo(repo))
+        assert any(
+            f.code == "TPL104" and "listed twice" in f.message
+            for f in findings
+        )
+
+    def test_new_dtype_column_must_ship(self):
+        """A columnar-schema extension the wire contract misses is a
+        finding in BOTH directions (dtype side + field-derivation
+        side when mapped)."""
+        repo = _fleet_repo(
+            columnar_transform=lambda s: s.replace(
+                '    ("span_id", "i4"),\n',
+                '    ("span_id", "i4"),\n    ("new_col", "i4"),\n',
+                1,
+            ).replace(
+                '    "span_id": ("span_id",),\n',
+                '    "span_id": ("span_id", "new_col"),\n',
+                1,
+            )
+        )
+        findings = list(FleetWireDriftRule().check_repo(repo))
+        assert any(
+            f.code == "TPL104" and "'new_col'" in f.message
+            and "missing from WIRE_EVENT_COLUMNS" in f.message
+            for f in findings
+        )
+        assert any(
+            f.code == "TPL104" and "does not ship" in f.message
+            for f in findings
+        )
+
+    def test_non_literal_declaration_flagged(self):
+        repo = _fleet_repo(
+            wire_transform=lambda s: s.replace(
+                "WIRE_EVENT_COLUMNS: tuple[str, ...] = (",
+                "WIRE_EVENT_COLUMNS: tuple[str, ...] = tuple(x for x in (",
+                1,
+            ).replace(
+                '    "tpu_module_name",\n)', '    "tpu_module_name",\n))', 1
+            )
+        )
+        findings = list(FleetWireDriftRule().check_repo(repo))
+        assert any(
+            f.code == "TPL104" and "pure" in f.message for f in findings
         )
 
 

@@ -25,8 +25,8 @@ from tpuslo.cli import (
 
 class TestDispatcher:
     def test_all_binaries_registered(self):
-        # 11 reference parity + slicecorr + train + icibench
-        assert len(BINARIES) == 14
+        # 11 reference parity + slicecorr + train + icibench + fleetagg
+        assert len(BINARIES) == 15
 
     def test_unknown_binary_exit_2(self):
         assert dispatch(["warpdrive"]) == 2

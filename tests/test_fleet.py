@@ -1,0 +1,1015 @@
+"""Fleet observability plane: wire contract, hash ring, rollup
+invariants, aggregator dedup/failover, the seeded simulator, and the
+fleetagg / sloctl fleet CLIs.
+
+The cross-node dedup-under-chaos tests (per-host ChaosStream skew /
+dup / reorder at intensity 1.0 and 3.0) assert the two structural
+rollup invariants the sweep gates on: one injected fleet fault never
+splits into multiple incidents, and distinct (tenant, domain) faults
+never merge — seeded, so failures replay bit-identically.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from tpuslo.columnar.schema import (
+    ColumnarBatch,
+    concat_batches,
+    empty_batch,
+    from_rows,
+    to_rows,
+)
+from tpuslo.fleet.aggregator import AggregatorShard
+from tpuslo.fleet.ring import HashRing, node_key
+from tpuslo.fleet.rollup import (
+    BLAST_FLEET,
+    BLAST_NODE,
+    BLAST_POD,
+    BLAST_SLICE,
+    FleetIncident,
+    FleetRollup,
+    NodeIncident,
+    classify_blast_radius,
+)
+from tpuslo.fleet.simulator import (
+    EPOCH_NS,
+    FaultInjection,
+    FleetSimulator,
+    FleetTopology,
+    default_injection_plan,
+)
+from tpuslo.fleet.sweep import run_fleet_sweep, score_incidents
+from tpuslo.fleet.wire import (
+    FLEET_WIRE_VERSION,
+    WIRE_EVENT_COLUMNS,
+    ShipmentWriter,
+    WireContractError,
+    decode_shipment,
+    encode_shipment,
+    last_recorded_seq,
+    load_shipments,
+    parse_shipment_line,
+    shipment_json_line,
+)
+from tpuslo.schema.types import ProbeEventV1
+
+
+def _sample_batch(n: int = 8, node: str = "node-x") -> ColumnarBatch:
+    events = [
+        ProbeEventV1(
+            ts_unix_nano=EPOCH_NS + i * 1_000_000,
+            signal="dns_latency_ms",
+            node=node,
+            namespace="tenant-a",
+            pod=f"{node}-pod-0",
+            container="workload",
+            pid=100 + i,
+            tid=100 + i,
+            value=float(5 + i),
+            unit="ms",
+            status="ok",
+        )
+        for i in range(n)
+    ]
+    return from_rows(events)
+
+
+class TestWireContract:
+    def test_binary_round_trip(self):
+        batch = _sample_batch()
+        payload = encode_shipment(batch, "node-x", 7, slice_id="slice-1")
+        shipment = decode_shipment(payload)
+        assert shipment.node == "node-x"
+        assert shipment.seq == 7
+        assert shipment.slice_id == "slice-1"
+        assert shipment.events == batch.n
+        assert shipment.head_ns == int(
+            batch.column("ts_unix_nano").max()
+        )
+        assert to_rows(shipment.batch) == to_rows(batch)
+
+    def test_base64_jsonl_round_trip(self):
+        batch = _sample_batch()
+        payload = encode_shipment(
+            batch, "node-x", 0, transport="base64"
+        )
+        line = shipment_json_line(payload)
+        shipment = parse_shipment_line(line)
+        assert to_rows(shipment.batch) == to_rows(batch)
+
+    def test_binary_payload_not_json_safe(self):
+        payload = encode_shipment(_sample_batch(), "node-x", 0)
+        with pytest.raises(WireContractError):
+            shipment_json_line(payload)
+
+    def test_version_mismatch_refused(self):
+        payload = encode_shipment(_sample_batch(), "node-x", 0)
+        payload["wire_version"] = FLEET_WIRE_VERSION + 1
+        with pytest.raises(WireContractError, match="wire version"):
+            decode_shipment(payload)
+
+    def test_missing_node_refused(self):
+        payload = encode_shipment(_sample_batch(), "node-x", 0)
+        payload["node"] = ""
+        with pytest.raises(WireContractError, match="node identity"):
+            decode_shipment(payload)
+
+    def test_column_drift_refused(self):
+        payload = encode_shipment(_sample_batch(), "node-x", 0)
+        del payload["columns"]["span_id"]
+        with pytest.raises(WireContractError, match="column set drift"):
+            decode_shipment(payload)
+        payload = encode_shipment(_sample_batch(), "node-x", 0)
+        payload["columns"]["extra_col"] = b""
+        with pytest.raises(WireContractError, match="column set drift"):
+            decode_shipment(payload)
+
+    def test_truncated_buffer_refused(self):
+        payload = encode_shipment(_sample_batch(), "node-x", 0)
+        payload["columns"]["value"] = payload["columns"]["value"][:-4]
+        with pytest.raises(WireContractError, match="bytes"):
+            decode_shipment(payload)
+
+    def test_pool_code_out_of_range_refused(self):
+        batch = _sample_batch()
+        payload = encode_shipment(batch, "node-x", 0)
+        bad = batch.columns["signal"].copy()
+        bad[0] = len(batch.pool.strings) + 5
+        payload["columns"]["signal"] = bad.tobytes()
+        with pytest.raises(WireContractError, match="outside"):
+            decode_shipment(payload)
+
+    def test_pool_must_start_with_empty_string(self):
+        payload = encode_shipment(_sample_batch(), "node-x", 0)
+        payload["pool"] = ["not-empty"] + payload["pool"][1:]
+        with pytest.raises(WireContractError, match="pool"):
+            decode_shipment(payload)
+
+    def test_wire_columns_cover_dtype(self):
+        from tpuslo.columnar.schema import PROBE_EVENT_DTYPE
+
+        assert set(WIRE_EVENT_COLUMNS) == set(PROBE_EVENT_DTYPE.names)
+        assert len(WIRE_EVENT_COLUMNS) == len(
+            set(WIRE_EVENT_COLUMNS)
+        )
+
+    def test_bad_transport_refused(self):
+        """A corrupted line claiming an unknown transport, or binary
+        transport with non-bytes columns, must be a contract break —
+        not a TypeError out of np.frombuffer."""
+        payload = encode_shipment(
+            _sample_batch(), "node-x", 0, transport="base64"
+        )
+        payload["transport"] = "gzip"
+        with pytest.raises(WireContractError, match="transport"):
+            decode_shipment(payload)
+        payload = json.loads(
+            shipment_json_line(
+                encode_shipment(
+                    empty_batch(0), "node-x", 0, transport="base64"
+                )
+            )
+        )
+        payload["transport"] = "binary"  # columns are still str
+        with pytest.raises(WireContractError, match="bytes"):
+            decode_shipment(payload)
+
+    def test_last_recorded_seq_resumes_across_restart(self, tmp_path):
+        """The shipment log appends across agent restarts while the
+        aggregator dedups on seq: a restarted writer must resume the
+        node's monotonic sequence, not restart at 0."""
+        log = tmp_path / "ship.jsonl"
+        batch = _sample_batch(2)
+        writer = ShipmentWriter(str(log))
+        for seq in range(3):
+            writer.send(
+                "fleet",
+                [
+                    encode_shipment(
+                        batch, "node-x", seq, transport="base64"
+                    )
+                ],
+            )
+        writer.close()
+        # Another node's seqs and a torn tail must not confuse resume.
+        with open(log, "a", encoding="utf-8") as fh:
+            fh.write(
+                shipment_json_line(
+                    encode_shipment(
+                        batch, "node-y", 9, transport="base64"
+                    )
+                )
+            )
+            fh.write('{"node": "node-x", "seq": ')
+        assert last_recorded_seq(str(log), "node-x") == 2
+        assert last_recorded_seq(str(log), "node-y") == 9
+        assert last_recorded_seq(str(log), "node-z") == -1
+        assert last_recorded_seq(str(tmp_path / "absent"), "n") == -1
+
+    def test_writer_repairs_torn_tail_before_append(self, tmp_path):
+        """A predecessor killed mid-write leaves a torn half-line at
+        the log tail; appending onto it would weld the next shipment
+        into one corrupt line, losing both.  The writer must truncate
+        the tear on (re)open so every surviving line stays parseable."""
+        log = tmp_path / "ship.jsonl"
+        batch = _sample_batch(2)
+        with open(log, "w", encoding="utf-8") as fh:
+            fh.write(
+                shipment_json_line(
+                    encode_shipment(
+                        batch, "node-x", 0, transport="base64"
+                    )
+                )
+            )
+            fh.write('{"wire_version": 1, "node": "node-x", "seq"')
+        writer = ShipmentWriter(str(log))
+        writer.send(
+            "fleet",
+            [encode_shipment(batch, "node-x", 1, transport="base64")],
+        )
+        writer.close()
+        shipments = load_shipments(str(log))
+        assert [s.seq for s in shipments] == [0, 1]
+
+
+class TestConcatBatches:
+    def test_pool_recoding(self):
+        a = _sample_batch(3, node="node-a")
+        b = _sample_batch(4, node="node-b")
+        merged = concat_batches([a, b])
+        assert merged.n == 7
+        assert to_rows(merged) == to_rows(a) + to_rows(b)
+
+    def test_empty_and_single(self):
+        assert concat_batches([]).n == 0
+        a = _sample_batch(2)
+        assert concat_batches([empty_batch(0), a]) is a
+
+
+class TestHashRing:
+    def test_deterministic_across_instances(self):
+        keys = [(f"node-{i}", f"slice-{i % 4}") for i in range(200)]
+        a = HashRing(["agg-0", "agg-1", "agg-2"]).assignments(keys)
+        b = HashRing(["agg-0", "agg-1", "agg-2"]).assignments(keys)
+        assert a == b
+
+    def test_removal_only_rehomes_victims(self):
+        keys = [(f"node-{i}", f"slice-{i % 4}") for i in range(300)]
+        ring = HashRing(["agg-0", "agg-1", "agg-2"])
+        before = ring.assignments(keys)
+        ring.remove_shard("agg-1")
+        after = ring.assignments(keys)
+        for node, owner in before.items():
+            if owner != "agg-1":
+                assert after[node] == owner
+            else:
+                assert after[node] in ("agg-0", "agg-2")
+        assert ring.rebalances == 1
+
+    def test_spread_is_reasonable(self):
+        keys = [(f"node-{i}", f"slice-{i % 16}") for i in range(1000)]
+        ring = HashRing([f"agg-{i}" for i in range(4)])
+        counts: dict[str, int] = {}
+        for node, owner in ring.assignments(keys).items():
+            counts[owner] = counts.get(owner, 0) + 1
+        assert len(counts) == 4
+        assert max(counts.values()) / (1000 / 4) < 1.5
+
+    def test_export_restore_round_trip(self):
+        ring = HashRing(["agg-0", "agg-1"], vnodes=32)
+        ring.add_shard("agg-2")
+        state = ring.export_state()
+        other = HashRing([])
+        other.restore_state(state)
+        keys = [(f"node-{i}", "slice-0") for i in range(100)]
+        assert other.assignments(keys) == ring.assignments(keys)
+        assert other.rebalances == ring.rebalances
+
+    def test_empty_ring_lookup_raises(self):
+        with pytest.raises(LookupError):
+            HashRing([]).shard_for(node_key("n", "s"))
+
+
+def _node_incident(
+    node: str,
+    pod: str = "pod-0",
+    namespace: str = "tenant-a",
+    slice_id: str = "slice-0",
+    domain: str = "tpu_hbm",
+    ts: int = EPOCH_NS,
+    confidence: float = 0.9,
+) -> NodeIncident:
+    return NodeIncident(
+        node=node,
+        pod=pod,
+        namespace=namespace,
+        slice_id=slice_id,
+        domain=domain,
+        confidence=confidence,
+        ts_unix_nano=ts,
+    )
+
+
+class TestRollup:
+    def test_blast_radius_classification(self):
+        one_pod = [_node_incident("n0")]
+        assert classify_blast_radius(one_pod) == BLAST_POD
+        one_node = [
+            _node_incident("n0", pod="pod-0"),
+            _node_incident("n0", pod="pod-1"),
+        ]
+        assert classify_blast_radius(one_node) == BLAST_NODE
+        one_slice = [_node_incident("n0"), _node_incident("n1")]
+        assert classify_blast_radius(one_slice) == BLAST_SLICE
+        fleet = [
+            _node_incident("n0", slice_id="slice-0"),
+            _node_incident("n1", slice_id="slice-1"),
+        ]
+        assert classify_blast_radius(fleet) == BLAST_FLEET
+
+    def test_blast_radius_empty_slice_id_is_not_a_slice(self):
+        """Agents without --slice-id carry no slice identity: two such
+        nodes are slice radius (not fleet), and mixing set/unset must
+        not escalate either."""
+        no_ids = [
+            _node_incident("n0", slice_id=""),
+            _node_incident("n1", slice_id=""),
+        ]
+        assert classify_blast_radius(no_ids) == BLAST_SLICE
+        mixed = [
+            _node_incident("n0", slice_id="slice-0"),
+            _node_incident("n1", slice_id=""),
+        ]
+        assert classify_blast_radius(mixed) == BLAST_SLICE
+
+    def test_session_window_collapses_to_one_page(self):
+        rollup = FleetRollup(gap_ns=5_000_000_000)
+        rollup.observe(
+            _node_incident(f"n{i}", ts=EPOCH_NS + i * 1_000_000_000)
+            for i in range(4)
+        )
+        incidents = rollup.flush()
+        assert len(incidents) == 1
+        assert incidents[0].blast_radius == BLAST_SLICE
+        assert incidents[0].nodes == [f"n{i}" for i in range(4)]
+        assert len(incidents[0].members) == 4
+
+    def test_no_cross_tenant_merge(self):
+        rollup = FleetRollup()
+        rollup.observe(
+            [
+                _node_incident("n0", namespace="tenant-a"),
+                _node_incident("n1", namespace="tenant-b"),
+            ]
+        )
+        incidents = rollup.flush()
+        assert len(incidents) == 2
+        assert {i.namespace for i in incidents} == {
+            "tenant-a",
+            "tenant-b",
+        }
+
+    def test_no_cross_domain_merge(self):
+        rollup = FleetRollup()
+        rollup.observe(
+            [
+                _node_incident("n0", domain="tpu_hbm"),
+                _node_incident("n1", domain="network_dns"),
+            ]
+        )
+        incidents = rollup.flush()
+        assert len(incidents) == 2
+        assert {i.domain for i in incidents} == {
+            "tpu_hbm",
+            "network_dns",
+        }
+
+    def test_gap_splits_sessions(self):
+        rollup = FleetRollup(gap_ns=1_000_000_000)
+        rollup.observe([_node_incident("n0", ts=EPOCH_NS)])
+        emitted = rollup.observe(
+            [_node_incident("n1", ts=EPOCH_NS + 10_000_000_000)]
+        )
+        assert len(emitted) == 1  # first session closed by the gap
+        assert len(rollup.flush()) == 1
+
+    def test_out_of_order_straggler_does_not_merge_backward(self):
+        """fleetagg flushes shard 0's whole history before shard 1's:
+        a member 600s EARLIER than the open group is a distinct fault
+        and must open its own session, not extend the later group's
+        window backward into one merged page."""
+        rollup = FleetRollup(gap_ns=5_000_000_000)
+        rollup.observe(
+            [_node_incident("n0", ts=EPOCH_NS + 600_000_000_000)]
+        )
+        emitted = rollup.observe([_node_incident("n1", ts=EPOCH_NS)])
+        assert emitted == []  # the later group stays open
+        assert rollup.open_groups() == 2
+        incidents = rollup.flush()
+        assert len(incidents) == 2
+        assert sorted(i.window_start_ns for i in incidents) == [
+            EPOCH_NS,
+            EPOCH_NS + 600_000_000_000,
+        ]
+        assert all(len(i.members) == 1 for i in incidents)
+
+    def test_out_of_order_bridging_member_merges_sessions(self):
+        """A member landing between two open sessions within gap of
+        both bridges them into one fault (one page, all members)."""
+        gap = 5_000_000_000
+        rollup = FleetRollup(gap_ns=gap)
+        # Later member first (shard flush order), then a straggler
+        # 1.5 gaps earlier: two open sessions.
+        rollup.observe(
+            [_node_incident("n1", ts=EPOCH_NS + 15 * gap // 10)]
+        )
+        rollup.observe([_node_incident("n0", ts=EPOCH_NS)])
+        assert rollup.open_groups() == 2
+        # A member within gap of BOTH intervals bridges them.
+        rollup.observe(
+            [_node_incident("n2", ts=EPOCH_NS + 8 * gap // 10)]
+        )
+        assert rollup.open_groups() == 1
+        incidents = rollup.flush()
+        assert len(incidents) == 1
+        assert incidents[0].nodes == ["n0", "n1", "n2"]
+
+    def test_watermark_close(self):
+        rollup = FleetRollup(gap_ns=1_000_000_000)
+        rollup.observe([_node_incident("n0", ts=EPOCH_NS)])
+        assert rollup.close_up_to(EPOCH_NS) == []
+        closed = rollup.close_up_to(EPOCH_NS + 2_000_000_000)
+        assert len(closed) == 1
+        assert rollup.open_groups() == 0
+
+    def test_duplicate_member_keeps_best_confidence(self):
+        rollup = FleetRollup()
+        rollup.observe(
+            [
+                _node_incident("n0", confidence=0.6),
+                _node_incident("n0", confidence=0.9),
+                _node_incident("n0", confidence=0.7),
+            ]
+        )
+        incidents = rollup.flush()
+        assert len(incidents) == 1
+        assert len(incidents[0].members) == 1
+        assert incidents[0].confidence == pytest.approx(0.9)
+
+    def test_emission_idempotent_across_restore(self):
+        """Failover replay: a group already paged must not page again
+        after the emitted-id registry restores."""
+        rollup = FleetRollup()
+        rollup.observe([_node_incident("n0")])
+        state_open = rollup.export_state()
+        first = rollup.flush()
+        assert len(first) == 1
+        state_emitted = rollup.export_state()
+
+        # Restore the post-emission state, replay the same member.
+        other = FleetRollup()
+        other.restore_state(state_emitted)
+        other.observe([_node_incident("n0")])
+        assert other.flush() == []
+        assert other.duplicates_suppressed == 1
+
+        # Restoring the pre-emission state emits exactly once.
+        third = FleetRollup()
+        third.restore_state(state_open)
+        assert len(third.flush()) == 1
+
+    def test_emission_idempotent_under_window_shift(self):
+        """A failover-rebuilt group can re-bucket its earliest member
+        by one window: the registry must still suppress (gap-tolerant
+        window match, not an exact start_ns-derived id)."""
+        rollup = FleetRollup(gap_ns=5_000_000_000)
+        rollup.observe([_node_incident("n0", ts=EPOCH_NS)])
+        assert len(rollup.flush()) == 1
+        state = rollup.export_state()
+
+        other = FleetRollup(gap_ns=5_000_000_000)
+        other.restore_state(state)
+        # Rebuilt member lands one gap later — same fault, shifted id.
+        other.observe(
+            [_node_incident("n0", ts=EPOCH_NS + 4_000_000_000)]
+        )
+        assert other.flush() == []
+        assert other.duplicates_suppressed == 1
+
+        # A genuinely later fault (past the gap tolerance) still pages.
+        later = FleetRollup(gap_ns=5_000_000_000)
+        later.restore_state(state)
+        later.observe(
+            [_node_incident("n0", ts=EPOCH_NS + 20_000_000_000)]
+        )
+        assert len(later.flush()) == 1
+
+    def test_incident_dict_round_trip(self):
+        rollup = FleetRollup()
+        rollup.observe([_node_incident("n0")])
+        incident = rollup.flush()[0]
+        clone = FleetIncident.from_dict(
+            json.loads(json.dumps(incident.to_dict()))
+        )
+        assert clone == incident
+
+
+class TestAggregatorShard:
+    def test_seq_dedup_drops_replays(self):
+        shard = AggregatorShard("agg-0")
+        batch = _sample_batch()
+        p0 = encode_shipment(batch, "node-x", 0)
+        assert shard.ingest(p0) is True
+        assert shard.ingest(encode_shipment(batch, "node-x", 0)) is False
+        assert shard.ingest(encode_shipment(batch, "node-x", 1)) is True
+        assert shard.duplicate_shipments == 1
+        assert shard.shipments == 2
+
+    def test_fold_is_idempotent_under_duplication(self):
+        """Max-folding: re-delivered evidence cannot inflate it."""
+        shard_once = AggregatorShard("a", min_confidence=0.0)
+        shard_twice = AggregatorShard("b", min_confidence=0.0)
+        batch = _sample_batch()
+        shard_once.ingest(encode_shipment(batch, "node-x", 0))
+        shard_twice.ingest(encode_shipment(batch, "node-x", 0))
+        # Same evidence again under a fresh seq (spool re-send after
+        # failover lands as a NEW shipment, not a seq duplicate).
+        shard_twice.ingest(encode_shipment(batch, "node-x", 1))
+        once = shard_once.close_windows(flush=True)
+        twice = shard_twice.close_windows(flush=True)
+        assert [
+            (i.node, i.pod, i.domain, round(i.confidence, 6), i.signals)
+            for i in once
+        ] == [
+            (i.node, i.pod, i.domain, round(i.confidence, 6), i.signals)
+            for i in twice
+        ]
+
+    def test_watermark_ignores_stale_nodes(self):
+        shard = AggregatorShard("agg-0", stale_after_ns=10_000_000_000)
+        live = _sample_batch(4, node="node-live")
+        shard.ingest(encode_shipment(live, "node-live", 0))
+        # A node whose head is far behind the fleet head goes stale
+        # and must not freeze the watermark.
+        old_events = to_rows(_sample_batch(2, node="node-dead"))
+        for ev in old_events:
+            object.__setattr__(
+                ev, "ts_unix_nano", EPOCH_NS - 60_000_000_000
+            )
+        stale_batch = from_rows(old_events)
+        shard.ingest(encode_shipment(stale_batch, "node-dead", 0))
+        reporting, stale = shard.reporting_and_stale()
+        assert (reporting, stale) == (1, 1)
+        assert shard.watermark_ns() > EPOCH_NS - 60_000_000_000
+
+    def test_export_absorb_rehomes_node_state(self):
+        dead = AggregatorShard("dead", min_confidence=0.0)
+        batch = _sample_batch()
+        dead.ingest(encode_shipment(batch, "node-x", 3, slice_id="s0"))
+        state = dead.export_state()
+        assert "node-x" in state["nodes"]
+
+        heir = AggregatorShard("heir", min_confidence=0.0)
+        heir.absorb_node_state("node-x", state["nodes"]["node-x"])
+        assert heir.nodes["node-x"].seq == 3
+        assert heir.nodes["node-x"].slice_id == "s0"
+        # The replayed shipment is a seq duplicate on the heir.
+        assert heir.ingest(encode_shipment(batch, "node-x", 3)) is False
+        # The absorbed pending evidence attributes identically.
+        dead_incidents = dead.close_windows(flush=True)
+        heir_incidents = heir.close_windows(flush=True)
+        assert [
+            (i.node, i.pod, i.domain, round(i.confidence, 6))
+            for i in dead_incidents
+        ] == [
+            (i.node, i.pod, i.domain, round(i.confidence, 6))
+            for i in heir_incidents
+        ]
+
+
+class TestSimulatorCorrectness:
+    TOPOLOGY = FleetTopology(nodes=32, nodes_per_slice=8)
+
+    def test_kill_shard_rehomes_late_joining_node_spool(self):
+        """A node whose first shipment landed after the last durable
+        snapshot has spool entries but no snapshot fragment: failover
+        must still re-home it and re-send its whole spool, not drop
+        its events because the snapshot never saw the node."""
+        topo = FleetTopology(nodes=8, nodes_per_slice=4)
+        sim = FleetSimulator(topo, ("agg-0", "agg-1"), seed=7)
+        node_i = next(
+            i
+            for i in range(topo.nodes)
+            if sim._assignment[topo.node_name(i)] == "agg-0"
+        )
+        node = topo.node_name(node_i)
+        sim._ship(node_i, sim._events_for_round(node_i, 0, {}))
+        spooled = len(sim._node_spool[node])
+        assert spooled > 0
+        # The dead shard's last durable snapshot predates the node's
+        # first shipment — no fragment for it.
+        report = sim.kill_shard("agg-0", exported={"nodes": {}})
+        assert report["rehomed_nodes"] == 0
+        assert report["resent_shipments"] >= spooled
+        heir = sim.shards[sim._assignment[node]]
+        assert heir.nodes[node].seq == sim._node_seq[node]
+        assert heir.ingested_events > 0
+
+    def _run(self, chaos: float, seed: int = 11):
+        plan = default_injection_plan(self.TOPOLOGY)
+        sim = FleetSimulator(
+            self.TOPOLOGY,
+            ("agg-0", "agg-1"),
+            seed=seed,
+            chaos_intensity=chaos,
+        )
+        result = sim.run(24, plan)
+        return plan, result
+
+    def test_one_incident_per_injection_no_chaos(self):
+        plan, result = self._run(chaos=0.0)
+        matches, precision, recall, macro = score_incidents(
+            plan, result.incidents
+        )
+        assert precision == 1.0 and recall == 1.0 and macro == 1.0
+        assert len(result.incidents) == len(plan)
+        for match in matches:
+            assert match.exact, match.to_dict()
+
+    def test_dedup_under_moderate_chaos(self):
+        """Intensity 1.0 (skew<=250ms, 5% dup, 5% reorder, 1% corrupt
+        per host): one fault never splits, distinct domains never
+        merge — parity with the clean run's incident set."""
+        plan, clean = self._run(chaos=0.0)
+        _, chaotic = self._run(chaos=1.0)
+        _, precision, recall, _ = score_incidents(
+            plan, chaotic.incidents
+        )
+        assert precision == 1.0 and recall == 1.0
+        key = lambda i: (i.namespace, i.domain, i.blast_radius)  # noqa: E731
+        assert sorted(map(key, chaotic.incidents)) == sorted(
+            map(key, clean.incidents)
+        )
+
+    @pytest.mark.slow
+    def test_dedup_under_heavy_chaos(self):
+        """Intensity 3.0 triples skew/dup/reorder/corruption; the
+        structural invariants must still hold."""
+        plan, result = self._run(chaos=3.0)
+        _, precision, recall, _ = score_incidents(plan, result.incidents)
+        assert precision == 1.0 and recall == 1.0
+        # Cross-tenant / cross-domain probes stay separate pages.
+        by_key: dict[tuple[str, str], int] = {}
+        for incident in result.incidents:
+            k = (incident.namespace, incident.domain)
+            by_key[k] = by_key.get(k, 0) + 1
+        assert all(count == 1 for count in by_key.values()), by_key
+
+    @pytest.mark.slow
+    def test_failover_loses_and_duplicates_nothing(self):
+        report = run_fleet_sweep(
+            nodes=32,
+            shards=2,
+            seed=11,
+            chaos_intensity=1.0,
+            events_per_node=1000,
+            rounds=24,
+            min_ingest_events_per_sec=1.0,
+            max_rollup_latency_ms=60_000.0,
+        )
+        assert report.passed, report.failures
+        assert report.failover.get("rehomed_nodes", 0) > 0
+        assert report.failover.get("resent_shipments", 0) > 0
+        assert report.failover_lost == []
+        assert report.failover_duplicated == []
+
+
+class TestSimulatorThroughputLane:
+    def test_measure_ingest_counts_everything(self):
+        topology = FleetTopology(nodes=16, nodes_per_slice=4)
+        sim = FleetSimulator(topology, ("agg-0", "agg-1"), seed=3)
+        m = sim.measure_ingest(events_per_node=500)
+        assert m.nodes == 16
+        assert m.total_events > 0
+        assert m.admitted_events == m.total_events
+        assert m.events_per_sec > 0
+        assert set(m.per_shard_events_per_sec) == {"agg-0", "agg-1"}
+
+
+class TestFleetCLI:
+    def _write_shipments(
+        self, path, node: str, slice_id: str, n=3, seq_start=0
+    ):
+        from tpuslo.fleet.wire import ShipmentWriter
+
+        writer = ShipmentWriter(str(path))
+        for seq in range(seq_start, seq_start + n):
+            events = to_rows(_sample_batch(6, node=node))
+            for i, ev in enumerate(events):
+                object.__setattr__(
+                    ev,
+                    "ts_unix_nano",
+                    EPOCH_NS + seq * 1_000_000_000 + i * 1_000,
+                )
+            batch = from_rows(events)
+            writer.send(
+                "fleet",
+                [
+                    encode_shipment(
+                        batch,
+                        node,
+                        seq,
+                        transport="base64",
+                        slice_id=slice_id,
+                    )
+                ],
+            )
+        writer.close()
+
+    def test_fleetagg_end_to_end(self, tmp_path, capsys):
+        from tpuslo.cli.fleetagg import main as fleetagg_main
+
+        ship_a = tmp_path / "ship-a.jsonl"
+        ship_b = tmp_path / "ship-b.jsonl"
+        self._write_shipments(ship_a, "node-a", "slice-0")
+        self._write_shipments(ship_b, "node-b", "slice-0")
+        incidents_out = tmp_path / "incidents.jsonl"
+        prov_out = tmp_path / "prov.jsonl"
+        state_out = tmp_path / "state.json"
+        rc = fleetagg_main(
+            [
+                str(ship_a),
+                str(ship_b),
+                "--shards",
+                "2",
+                "--min-confidence",
+                "0.0",
+                "--incidents-out",
+                str(incidents_out),
+                "--provenance-out",
+                str(prov_out),
+                "--state-out",
+                str(state_out),
+                "--json",
+            ]
+        )
+        assert rc == 0
+        summary = json.loads(capsys.readouterr().out)
+        assert summary["shipments"] == 6
+        assert summary["rejected_shipments"] == 0
+        assert summary["nodes"] == 2
+        assert summary["ingested_events"] == summary["admitted_events"]
+        state = json.loads(state_out.read_text())
+        assert set(state["shards"]) == {"agg-0", "agg-1"}
+        if summary["incidents"]:
+            lines = [
+                json.loads(line)
+                for line in incidents_out.read_text().splitlines()
+            ]
+            assert len(lines) == summary["incidents"]
+            prov = [
+                json.loads(line)
+                for line in prov_out.read_text().splitlines()
+            ]
+            assert all(p["members"] for p in prov)
+
+    def test_fleetagg_restart_does_not_repage(self, tmp_path, capsys):
+        """--state-out carries the rollup's emitted-window registry:
+        a restarted aggregator replaying the same shipment log with
+        --restore-state must not page the same fault twice.  Re-runs
+        also rewrite (not append to) the provenance log, keeping it in
+        lockstep with --incidents-out."""
+        from tpuslo.cli.fleetagg import main as fleetagg_main
+
+        ship = tmp_path / "ship.jsonl"
+        self._write_shipments(ship, "node-a", "slice-0")
+        incidents_out = tmp_path / "incidents.jsonl"
+        prov_out = tmp_path / "prov.jsonl"
+        state_out = tmp_path / "state.json"
+        common = [
+            str(ship),
+            "--min-confidence",
+            "0.0",
+            "--incidents-out",
+            str(incidents_out),
+            "--provenance-out",
+            str(prov_out),
+            "--state-out",
+            str(state_out),
+            "--json",
+        ]
+        assert fleetagg_main(common) == 0
+        first = json.loads(capsys.readouterr().out)
+        assert first["incidents"] >= 1
+        first_prov = prov_out.read_text().splitlines()
+        assert len(first_prov) == first["incidents"]
+
+        # The fault is still ongoing: the shipment log grows while the
+        # aggregator restarts.  The replayed shipments seq-dedup; the
+        # new ones attribute, but their rollup window overlaps the
+        # already-paged one — no second page.
+        self._write_shipments(ship, "node-a", "slice-0", seq_start=3)
+        assert (
+            fleetagg_main(common + ["--restore-state", str(state_out)])
+            == 0
+        )
+        second = json.loads(capsys.readouterr().out)
+        assert second["incidents"] == 0
+        # Outputs are truncated per run, never accumulated.
+        assert incidents_out.read_text() == ""
+        assert prov_out.read_text() == ""
+
+    def test_fleetagg_rejects_contract_break_loudly(
+        self, tmp_path, capsys
+    ):
+        from tpuslo.cli.fleetagg import main as fleetagg_main
+
+        ship = tmp_path / "ship.jsonl"
+        self._write_shipments(ship, "node-a", "slice-0", n=1)
+        with open(ship, "a", encoding="utf-8") as fh:
+            fh.write('{"wire_version": 99, "node": "evil"}\n')
+        rc = fleetagg_main([str(ship), "--json"])
+        assert rc == 0
+        captured = capsys.readouterr()
+        summary = json.loads(captured.out)
+        assert summary["rejected_shipments"] == 1
+        assert "rejected" in captured.err
+
+    def test_sloctl_fleet_incidents_and_nodes(self, tmp_path, capsys):
+        from tpuslo.cli.sloctl import main as sloctl_main
+
+        incident = FleetIncident(
+            incident_id="fleet-tenant-a-tpu_hbm-1",
+            namespace="tenant-a",
+            domain="tpu_hbm",
+            blast_radius="slice",
+            window_start_ns=EPOCH_NS,
+            window_end_ns=EPOCH_NS + 1,
+            confidence=0.9,
+            nodes=["n0", "n1"],
+            slices=["slice-0"],
+            members=[{"incident_id": "n0/p@1"}],
+        )
+        incidents = tmp_path / "incidents.jsonl"
+        incidents.write_text(
+            json.dumps(incident.to_dict()) + "\n", encoding="utf-8"
+        )
+        rc = sloctl_main(
+            ["fleet", "incidents", "--incidents", str(incidents)]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "fleet-tenant-a-tpu_hbm-1" in out
+        assert "slice" in out
+
+        # Radius filter excludes it.
+        rc = sloctl_main(
+            [
+                "fleet",
+                "incidents",
+                "--incidents",
+                str(incidents),
+                "--radius",
+                "pod",
+            ]
+        )
+        assert rc == 0
+        assert "no fleet incidents" in capsys.readouterr().out
+
+        state = {
+            "shards": {
+                "agg-0": {
+                    "nodes": {
+                        "node-a": {
+                            "head_ns": EPOCH_NS,
+                            "seq": 4,
+                            "events": 24,
+                            "slice_id": "slice-0",
+                        }
+                    }
+                }
+            },
+            "snapshots": {"agg-0": {"watermark_ns": 0}},
+        }
+        state_path = tmp_path / "state.json"
+        state_path.write_text(json.dumps(state), encoding="utf-8")
+        rc = sloctl_main(["fleet", "nodes", "--state", str(state_path)])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "node-a" in out and "agg-0" in out
+
+    def test_explain_renders_members_block(self, tmp_path, capsys):
+        from tpuslo.cli.fleetagg import incident_provenance
+        from tpuslo.cli.sloctl import main as sloctl_main
+
+        incident = FleetIncident(
+            incident_id="fleet-tenant-a-tpu_hbm-1",
+            namespace="tenant-a",
+            domain="tpu_hbm",
+            blast_radius="slice",
+            window_start_ns=EPOCH_NS,
+            window_end_ns=EPOCH_NS + 1,
+            confidence=0.9,
+            nodes=["n0", "n1"],
+            slices=["slice-0"],
+            members=[
+                {
+                    "incident_id": "n0/p0@1",
+                    "node": "n0",
+                    "pod": "p0",
+                    "slice_id": "slice-0",
+                    "tier": "node_window",
+                    "confidence": 0.91,
+                },
+                {
+                    "incident_id": "n1/p0@1",
+                    "node": "n1",
+                    "pod": "p0",
+                    "slice_id": "slice-0",
+                    "tier": "node_window",
+                    "confidence": 0.87,
+                },
+            ],
+        )
+        prov = tmp_path / "prov.jsonl"
+        prov.write_text(
+            json.dumps(incident_provenance(incident)) + "\n",
+            encoding="utf-8",
+        )
+        rc = sloctl_main(
+            [
+                "explain",
+                "--provenance",
+                str(prov),
+                "fleet-tenant-a-tpu_hbm-1",
+            ]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "fleet rollup, blast radius: slice" in out
+        assert "members (2 contributing node incidents)" in out
+        assert "n0/p0@1" in out and "confidence=0.91" in out
+        assert "rollup window" in out
+
+
+class TestFleetMetricsBridge:
+    def test_fleet_observer_series(self):
+        from prometheus_client import generate_latest
+
+        from tpuslo.metrics import AgentMetrics
+
+        metrics = AgentMetrics()
+        observer = metrics.fleet_observer()
+        observer.ingested("agg-0", 1000)
+        observer.ingested("agg-0", 500)
+        observer.rollup_latency_ms(12.5)
+        observer.incidents_open("slice", 2)
+        observer.nodes(reporting=998, stale=2)
+        observer.rebalance()
+        text = generate_latest(metrics.registry).decode()
+        assert (
+            'llm_slo_fleet_ingested_events_total{shard="agg-0"} 1500.0'
+            in text
+        )
+        assert "llm_slo_fleet_rollup_latency_ms_bucket" in text
+        assert (
+            'llm_slo_fleet_incidents_open{blast_radius="slice"} 2.0'
+            in text
+        )
+        assert "llm_slo_fleet_nodes_reporting 998.0" in text
+        assert "llm_slo_fleet_nodes_stale 2.0" in text
+        assert "llm_slo_fleet_ring_rebalances_total 1.0" in text
+
+    def test_simulator_drives_observer(self):
+        from tpuslo.metrics import AgentMetrics
+
+        metrics = AgentMetrics()
+        topology = FleetTopology(nodes=8, nodes_per_slice=4)
+        sim = FleetSimulator(
+            topology,
+            ("agg-0", "agg-1"),
+            seed=5,
+            observer=metrics.fleet_observer(),
+        )
+        plan = [
+            FaultInjection(
+                name="node-mem",
+                label="memory_pressure",
+                namespace="tenant-b",
+                scope="node",
+                at_round=2,
+                target=1,
+            )
+        ]
+        result = sim.run(10, plan)
+        assert len(result.incidents) == 1
+        ingested = metrics.fleet_ingested_events.collect()[0]
+        total = sum(
+            s.value
+            for s in ingested.samples
+            if s.name.endswith("_total")
+        )
+        assert total > 0

@@ -15,6 +15,7 @@ BINARIES = {
     "faultinject": "tpuslo.cli.faultinject",
     "correlationeval": "tpuslo.cli.correlationeval",
     "m5gate": "tpuslo.cli.m5gate",
+    "fleetagg": "tpuslo.cli.fleetagg",
     "sloctl": "tpuslo.cli.sloctl",
     "loadgen": "tpuslo.cli.loadgen",
     "schemavalidate": "tpuslo.cli.schemavalidate",

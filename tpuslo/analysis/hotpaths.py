@@ -58,6 +58,14 @@ HOT_FUNCTIONS: tuple[tuple[str, str], ...] = (
     ("tpuslo/columnar/match.py", "_tier_probe"),
     ("tpuslo/columnar/posterior.py", "log_posterior_batch"),
     ("tpuslo/columnar/serialize.py", "serialize_jsonl"),
+    # Fleet aggregator ingest (ISSUE 9): the shard path behind the
+    # 5M-events/s aggregate gate.  decode_shipment stays frombuffer-
+    # only; the fold's Python cost is per distinct group, not per
+    # event — a stray per-event call here erases the sharding win.
+    ("tpuslo/fleet/wire.py", "decode_shipment"),
+    ("tpuslo/fleet/aggregator.py", "AggregatorShard.ingest"),
+    ("tpuslo/fleet/aggregator.py", "AggregatorShard._drain"),
+    ("tpuslo/fleet/aggregator.py", "AggregatorShard._fold"),
 )
 
 #: (repo-relative module path, dataclass name) pairs that are allocated
@@ -79,4 +87,7 @@ HOT_DATACLASSES: tuple[tuple[str, str], ...] = (
     ("tpuslo/columnar/match.py", "MatchColumns"),
     ("tpuslo/columnar/match.py", "ColumnarMatches"),
     ("tpuslo/columnar/posterior.py", "PosteriorMatrices"),
+    # Fleet plane containers (ISSUE 9).
+    ("tpuslo/fleet/wire.py", "Shipment"),
+    ("tpuslo/fleet/aggregator.py", "_NodeState"),
 )

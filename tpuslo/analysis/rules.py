@@ -10,6 +10,7 @@ from tpuslo.analysis.core import Rule
 from tpuslo.analysis.rules_contracts import (
     ColumnarDtypeDriftRule,
     ConfigDriftRule,
+    FleetWireDriftRule,
     MetricsDriftRule,
     SchemaDriftRule,
 )
@@ -22,6 +23,7 @@ ALL_RULES: tuple[Rule, ...] = (
     StyleRules(),
     SchemaDriftRule(),
     ColumnarDtypeDriftRule(),
+    FleetWireDriftRule(),
     ConfigDriftRule(),
     MetricsDriftRule(),
     LockDisciplineRule(),

@@ -514,6 +514,191 @@ class ColumnarDtypeDriftRule(Rule):
         return findings
 
 
+# --- TPL104: fleet wire-contract drift -----------------------------------
+
+_FLEET_WIRE_REL = "tpuslo/fleet/wire.py"
+
+
+def _literal_string_tuple(node: ast.AST) -> tuple[str, ...] | None:
+    """Parse a ``("a", "b", ...)`` literal; None if not that shape."""
+    if not isinstance(node, ast.Tuple):
+        return None
+    out: list[str] = []
+    for elt in node.elts:
+        if not (
+            isinstance(elt, ast.Constant) and isinstance(elt.value, str)
+        ):
+            return None
+        out.append(elt.value)
+    return tuple(out)
+
+
+class FleetWireDriftRule(Rule):
+    """TPL104: the fleet wire payload must track the columnar dtype.
+
+    ``tpuslo/fleet/wire.py`` declares the shipment column order
+    (``WIRE_EVENT_COLUMNS``) as a pure literal precisely so this rule
+    can re-check, on every lint run, that the node→aggregator wire
+    contract stays derivable from ``PROBE_EVENT_DTYPE`` — and, through
+    ``COLUMNS_FOR_FIELD``, from ``ProbeEventV1`` — in both directions:
+
+    * every wire column must exist in the columnar dtype,
+    * every dtype column must be on the wire (an aggregator
+      reconstructs FULL batches; a silently dropped column would
+      corrupt fleet attribution, not fail loudly),
+    * every ``ProbeEventV1`` field's derived columns must all ship,
+    * duplicate wire columns are findings —
+
+    the same drift-proofing shape as TPL103 one layer down.
+    """
+
+    code = "TPL104"
+    codes = ("TPL104",)
+    repo_anchors = (_TYPES_REL, _COLUMNAR_REL, _FLEET_WIRE_REL)
+    name = "fleet-wire-drift"
+    rationale = (
+        "the aggregator wire payload in tpuslo/fleet/wire.py is "
+        "derived from PROBE_EVENT_DTYPE / ProbeEventV1 and must track "
+        "them in both directions"
+    )
+
+    def check_repo(self, repo: RepoContext) -> Iterable[Finding]:
+        wire_ctx = repo.by_rel.get(_FLEET_WIRE_REL)
+        if wire_ctx is None or wire_ctx.tree is None:
+            return ()
+        findings: list[Finding] = []
+        wire_columns: tuple[str, ...] | None = None
+        wire_line = 1
+        for node in wire_ctx.tree.body:
+            targets: list[ast.expr] = []
+            value: ast.expr | None = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            for target in targets:
+                if (
+                    isinstance(target, ast.Name)
+                    and target.id == "WIRE_EVENT_COLUMNS"
+                ):
+                    wire_columns = _literal_string_tuple(value)
+                    wire_line = node.lineno
+        if wire_columns is None:
+            findings.append(
+                Finding(
+                    _FLEET_WIRE_REL,
+                    wire_line,
+                    "TPL104",
+                    "WIRE_EVENT_COLUMNS must be a pure string-tuple "
+                    "literal (the wire-contract check parses it from "
+                    "the AST)",
+                )
+            )
+            return findings
+
+        seen: set[str] = set()
+        for name in wire_columns:
+            if name in seen:
+                findings.append(
+                    Finding(
+                        _FLEET_WIRE_REL,
+                        wire_line,
+                        "TPL104",
+                        f"wire column {name!r} listed twice (decode "
+                        "would silently overwrite the first buffer)",
+                    )
+                )
+            seen.add(name)
+
+        # Dtype side (TPL103's literals, re-read here so TPL104 stays
+        # meaningful even when TPL103 is suppressed).
+        schema_ctx = repo.by_rel.get(_COLUMNAR_REL)
+        dtype_fields: list[tuple[str, str]] | None = None
+        columns_map: dict[str, tuple[str, ...]] | None = None
+        if schema_ctx is not None and schema_ctx.tree is not None:
+            for node in schema_ctx.tree.body:
+                targets = []
+                value = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif (
+                    isinstance(node, ast.AnnAssign)
+                    and node.value is not None
+                ):
+                    targets, value = [node.target], node.value
+                for target in targets:
+                    if not isinstance(target, ast.Name):
+                        continue
+                    if target.id == "_DTYPE_FIELDS":
+                        dtype_fields = _literal_tuple_pairs(value)
+                    elif target.id == "COLUMNS_FOR_FIELD":
+                        columns_map = _literal_columns_map(value)
+        if dtype_fields is None or columns_map is None:
+            findings.append(
+                Finding(
+                    _FLEET_WIRE_REL,
+                    wire_line,
+                    "TPL104",
+                    "cannot resolve _DTYPE_FIELDS / COLUMNS_FOR_FIELD "
+                    f"literals in {_COLUMNAR_REL}; the wire contract "
+                    "cannot be checked",
+                )
+            )
+            return findings
+
+        dtype_names = {name for name, _ in dtype_fields}
+        wire_set = set(wire_columns)
+        for name in wire_columns:
+            if name not in dtype_names:
+                findings.append(
+                    Finding(
+                        _FLEET_WIRE_REL,
+                        wire_line,
+                        "TPL104",
+                        f"wire column {name!r} is not a "
+                        "PROBE_EVENT_DTYPE column (not derivable from "
+                        "ProbeEventV1)",
+                    )
+                )
+        for name, _ in dtype_fields:
+            if name not in wire_set:
+                findings.append(
+                    Finding(
+                        _FLEET_WIRE_REL,
+                        wire_line,
+                        "TPL104",
+                        f"dtype column {name!r} missing from "
+                        "WIRE_EVENT_COLUMNS — aggregators would "
+                        "reconstruct batches without it",
+                    )
+                )
+
+        # ProbeEventV1 direction: every field's derived columns ship.
+        types_ctx = repo.by_rel.get(_TYPES_REL)
+        event_fields: list[_Field] = []
+        if types_ctx is not None and types_ctx.tree is not None:
+            for node in ast.walk(types_ctx.tree):
+                if (
+                    isinstance(node, ast.ClassDef)
+                    and node.name == "ProbeEventV1"
+                ):
+                    event_fields = _dataclass_fields(node)
+        for f in event_fields:
+            for col in columns_map.get(f.name, ()):
+                if col not in wire_set:
+                    findings.append(
+                        Finding(
+                            _FLEET_WIRE_REL,
+                            wire_line,
+                            "TPL104",
+                            f"ProbeEventV1.{f.name} derives column "
+                            f"{col!r} which the wire contract does "
+                            "not ship",
+                        )
+                    )
+        return findings
+
+
 # --- TPL140: config drift ------------------------------------------------
 
 _SPECIAL_TOP_LEVEL = {"apiVersion", "kind", "signal_set"}

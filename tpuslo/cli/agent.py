@@ -83,6 +83,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="samples per columnar cycle (each fans out to one probe "
         "event per enabled signal)",
     )
+    p.add_argument(
+        "--fleet-upstream",
+        default="",
+        help="ship gated columnar batches upward to the fleet "
+        "aggregators: append one base64-transport shipment per gated "
+        "batch (versioned wire contract, monotonic per-node seq) to "
+        "this JSONL log, which `tpuslo fleetagg` consumes; requires "
+        "--columnar",
+    )
     # Multi-host identity for the ring loop's TPU events: a DaemonSet
     # agent knows which slice/host it runs on; SliceJoiner joins
     # per-host streams on exactly this identity.
@@ -397,6 +406,16 @@ def main(
         print(
             "agent: --chaos-telemetry needs the row synthetic loop; "
             "drop --columnar to rehearse telemetry chaos",
+            file=sys.stderr,
+        )
+        return 2
+    if args.fleet_upstream and not args.columnar:
+        # Shipments are columnar batches by contract; the row loop has
+        # nothing to put on the fleet wire.  Refusing loudly beats an
+        # upstream log that never grows.
+        print(
+            "agent: --fleet-upstream ships gated columnar batches; "
+            "add --columnar",
             file=sys.stderr,
         )
         return 2
@@ -1316,6 +1335,44 @@ def main(
         batch_size = max(1, args.columnar_batch)
         probe_counter = metrics.probe_events
         stats_every = max(0, args.stats_interval_cycles)
+        shipper = None
+        shipment_seq = -1
+        ship_errors = 0
+        if args.fleet_upstream:
+            from tpuslo.fleet.wire import (
+                ShipmentWriter,
+                encode_shipment,
+                last_recorded_seq,
+            )
+
+            # Probe writability up front: a missing directory or
+            # unwritable path should refuse at startup, not crash the
+            # loop at the first gated batch.
+            try:
+                with open(
+                    args.fleet_upstream, "a", encoding="utf-8"
+                ):
+                    pass
+            except OSError as exc:
+                print(
+                    "agent: cannot write fleet upstream "
+                    f"{args.fleet_upstream}: {exc}",
+                    file=sys.stderr,
+                )
+                return 2
+            shipper = ShipmentWriter(args.fleet_upstream)
+            # The log appends across restarts and the aggregator dedups
+            # on seq: resume the node's sequence, never restart at 0.
+            shipment_seq = last_recorded_seq(
+                args.fleet_upstream, args.node
+            )
+            print(
+                f"agent: fleet upstream -> {args.fleet_upstream} "
+                f"(node {args.node}"
+                + (f", slice {args.slice_id}" if args.slice_id else "")
+                + ")",
+                file=sys.stderr,
+            )
         # Sink capability is fixed for the process: local sinks take
         # pre-serialized blocks, OTLP exporters need typed records —
         # probe once instead of serializing a block per batch only to
@@ -1349,6 +1406,33 @@ def main(
                     if not len(out):
                         continue
                     emitted_total += len(out)
+                    if shipper is not None:
+                        shipment_seq += 1
+                        try:
+                            shipper.send(
+                                "fleet",
+                                [
+                                    encode_shipment(
+                                        out,
+                                        args.node,
+                                        shipment_seq,
+                                        transport="base64",
+                                        slice_id=args.slice_id,
+                                    )
+                                ],
+                            )
+                        except OSError as exc:
+                            # Disk-full / rotated-away mid-run: the
+                            # local sinks must still get this batch;
+                            # the aggregator's seq gap shows the loss.
+                            ship_errors += 1
+                            if ship_errors == 1:
+                                print(
+                                    "agent: fleet upstream write "
+                                    f"failed ({exc}); local sinks "
+                                    "continue",
+                                    file=sys.stderr,
+                                )
                     if blocks_ok:
                         writers.write_probe_block(
                             serialize_jsonl(out, kind="probe")
@@ -1380,6 +1464,18 @@ def main(
                 f"{emitted_total} probe events emitted",
                 file=sys.stderr,
             )
+            if shipper is not None:
+                print(
+                    f"agent: fleet upstream: {shipper.shipments} "
+                    f"shipments, {shipper.events} events"
+                    + (
+                        f", {ship_errors} failed writes"
+                        if ship_errors
+                        else ""
+                    ),
+                    file=sys.stderr,
+                )
+                shipper.close()
             if col_gate is not None:
                 col_gate.close()
 

@@ -1,0 +1,316 @@
+"""fleetagg: aggregator-role binary of the fleet observability plane.
+
+One process hosts one or more :class:`~tpuslo.fleet.AggregatorShard`\\ s
+behind a consistent hash ring and consumes node-agent shipment logs
+(``agent --fleet-upstream`` output, the JSONL form of the TPL104-
+governed wire contract).  Each shipment decodes zero-copy, dedups by
+per-node sequence, merges, gates, and folds; closed windows attribute
+through the shared Bayesian posterior and collapse through the fleet
+rollup into one incident per (fault domain x blast radius).
+
+Outputs:
+
+* ``--incidents-out`` — fleet incidents as JSONL (``sloctl fleet
+  incidents`` renders the table).
+* ``--provenance-out`` — one ProvenanceRecord per fleet incident with
+  the ``members`` block (``sloctl explain`` drills a fleet page down
+  to its contributing node incidents).
+* ``--state-out`` — shard/node state snapshot (``sloctl fleet nodes``
+  renders per-node reporting/stale status; a restarted aggregator
+  absorbs it via the PR 4 runtime registry shape).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from datetime import datetime, timezone
+from typing import Any
+
+from tpuslo.fleet.aggregator import AggregatorShard
+from tpuslo.fleet.ring import HashRing
+from tpuslo.fleet.rollup import FleetIncident, FleetRollup
+from tpuslo.fleet.wire import WireContractError
+from tpuslo.ingest.gate import GateConfig
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpuslo fleetagg", description=__doc__
+    )
+    p.add_argument(
+        "inputs",
+        nargs="+",
+        help="shipment logs written by `agent --fleet-upstream`",
+    )
+    p.add_argument(
+        "--shards",
+        type=int,
+        default=1,
+        help="aggregator shards to host in this process (placement by "
+        "the same consistent hash ring the agents compute)",
+    )
+    p.add_argument("--shard-prefix", default="agg")
+    p.add_argument(
+        "--window-ns",
+        type=int,
+        default=2_000_000_000,
+        help="attribution window width",
+    )
+    p.add_argument(
+        "--rollup-gap-ns",
+        type=int,
+        default=5_000_000_000,
+        help="session gap closing a (tenant, domain) rollup group",
+    )
+    p.add_argument(
+        "--min-confidence",
+        type=float,
+        default=0.5,
+        help="attribution confidence floor for a node incident",
+    )
+    p.add_argument("--incidents-out", default="")
+    p.add_argument("--provenance-out", default="")
+    p.add_argument("--state-out", default="")
+    p.add_argument(
+        "--restore-state",
+        default="",
+        help="absorb a prior --state-out snapshot before ingesting "
+        "(failover re-home: each node fragment lands on whichever "
+        "shard the ring owns now)",
+    )
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="print the run summary as JSON instead of text",
+    )
+    return p
+
+
+def incident_provenance(incident: FleetIncident) -> dict[str, Any]:
+    """FleetIncident → ProvenanceRecord dict with the members block."""
+    from tpuslo.obs.provenance import ProvenanceRecord
+
+    return ProvenanceRecord(
+        incident_id=incident.incident_id,
+        recorded_at=datetime.now(timezone.utc).isoformat(
+            timespec="seconds"
+        ),
+        predicted_fault_domain=incident.domain,
+        confidence=incident.confidence,
+        correlation={
+            "tenant": incident.namespace,
+            "window_start_ns": incident.window_start_ns,
+            "window_end_ns": incident.window_end_ns,
+            "nodes": len(incident.nodes),
+            "slices": len(incident.slices),
+        },
+        members=[dict(m) for m in incident.members],
+        blast_radius=incident.blast_radius,
+    ).to_dict()
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.shards < 1:
+        print("fleetagg: --shards must be >= 1", file=sys.stderr)
+        return 2
+    shard_ids = [f"{args.shard_prefix}-{i}" for i in range(args.shards)]
+    ring = HashRing(shard_ids)
+    shards = {
+        sid: AggregatorShard(
+            sid,
+            gate_config=GateConfig(),
+            window_ns=args.window_ns,
+            min_confidence=args.min_confidence,
+        )
+        for sid in shard_ids
+    }
+    incidents: list[FleetIncident] = []
+    rollup = FleetRollup(
+        gap_ns=args.rollup_gap_ns, on_incident=incidents.append
+    )
+
+    if args.restore_state:
+        try:
+            with open(args.restore_state, encoding="utf-8") as fh:
+                snapshot = json.load(fh)
+        except (OSError, json.JSONDecodeError) as exc:
+            print(
+                f"fleetagg: cannot restore {args.restore_state}: {exc}",
+                file=sys.stderr,
+            )
+            return 1
+        rollup.restore_state(snapshot.get("rollup") or {})
+        restored = 0
+        for section in (snapshot.get("shards") or {}).values():
+            for node, fragment in (section.get("nodes") or {}).items():
+                slice_id = str(fragment.get("slice_id", ""))
+                owner = ring.shard_for_node(str(node), slice_id)
+                shards[owner].absorb_node_state(str(node), fragment)
+                restored += 1
+        print(
+            f"fleetagg: restored {restored} node fragments from "
+            f"{args.restore_state}",
+            file=sys.stderr,
+        )
+
+    shipments = 0
+    rejected = 0
+    for path in args.inputs:
+        try:
+            fh = open(path, encoding="utf-8")
+        except OSError as exc:
+            print(
+                f"fleetagg: cannot read {path}: {exc.strerror or exc}",
+                file=sys.stderr,
+            )
+            return 1
+        with fh:
+            for lineno, line in enumerate(fh, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                # Hand ingest the raw dict: its header peek drops seq
+                # duplicates (spool replays, a log listed twice)
+                # before paying the O(events) decode; a malformed
+                # shipment still raises the contract error from there.
+                try:
+                    raw = json.loads(line)
+                except json.JSONDecodeError as exc:
+                    rejected += 1
+                    print(
+                        f"fleetagg: {path}:{lineno}: rejected: {exc}",
+                        file=sys.stderr,
+                    )
+                    continue
+                node = (
+                    raw.get("node") if isinstance(raw, dict) else None
+                )
+                if not isinstance(node, str) or not node:
+                    rejected += 1
+                    print(
+                        f"fleetagg: {path}:{lineno}: rejected: "
+                        "not a shipment object (missing node)",
+                        file=sys.stderr,
+                    )
+                    continue
+                owner = ring.shard_for_node(
+                    node, str(raw.get("slice_id") or "")
+                )
+                try:
+                    shards[owner].ingest(raw)
+                except WireContractError as exc:
+                    rejected += 1
+                    print(
+                        f"fleetagg: {path}:{lineno}: rejected: {exc}",
+                        file=sys.stderr,
+                    )
+                    continue
+                shipments += 1
+
+    # End of logs == end of stream: flush every window and group.
+    # Shards flush their whole history one after another, so merge the
+    # per-shard node incidents into one time-ordered stream first —
+    # members of the same fault that hashed to different shards must
+    # coalesce before any session closes.
+    node_incidents = [
+        ni
+        for shard in shards.values()
+        for ni in shard.close_windows(flush=True)
+    ]
+    node_incidents.sort(key=lambda ni: ni.ts_unix_nano)
+    rollup.observe(node_incidents)
+    rollup.flush()
+
+    if args.incidents_out:
+        with open(args.incidents_out, "w", encoding="utf-8") as fh:
+            for incident in incidents:
+                fh.write(
+                    json.dumps(
+                        incident.to_dict(), separators=(",", ":")
+                    )
+                    + "\n"
+                )
+    if args.provenance_out:
+        with open(args.provenance_out, "w", encoding="utf-8") as fh:
+            for incident in incidents:
+                fh.write(
+                    json.dumps(
+                        incident_provenance(incident),
+                        separators=(",", ":"),
+                    )
+                    + "\n"
+                )
+    if args.state_out:
+        state = {
+            "saved_at": datetime.now(timezone.utc).isoformat(
+                timespec="seconds"
+            ),
+            "ring": ring.export_state(),
+            "rollup": rollup.export_state(),
+            "shards": {
+                sid: shard.export_state()
+                for sid, shard in shards.items()
+            },
+            "snapshots": {
+                sid: shard.snapshot()
+                for sid, shard in shards.items()
+            },
+        }
+        with open(args.state_out, "w", encoding="utf-8") as fh:
+            json.dump(state, fh, indent=2)
+            fh.write("\n")
+
+    summary = {
+        "shards": args.shards,
+        "shipments": shipments,
+        "rejected_shipments": rejected,
+        "duplicate_shipments": sum(
+            s.duplicate_shipments for s in shards.values()
+        ),
+        "ingested_events": sum(
+            s.ingested_events for s in shards.values()
+        ),
+        "admitted_events": sum(
+            s.admitted_events for s in shards.values()
+        ),
+        "nodes": sum(len(s.nodes) for s in shards.values()),
+        "incidents": len(incidents),
+        "incidents_by_radius": {
+            radius: sum(
+                1 for i in incidents if i.blast_radius == radius
+            )
+            for radius in sorted({i.blast_radius for i in incidents})
+        },
+    }
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(
+            "fleetagg: {shipments} shipments ({rejected} rejected, "
+            "{dups} seq-dups) from {nodes} nodes -> "
+            "{admitted}/{ingested} events admitted -> "
+            "{incidents} fleet incidents".format(
+                shipments=summary["shipments"],
+                rejected=summary["rejected_shipments"],
+                dups=summary["duplicate_shipments"],
+                nodes=summary["nodes"],
+                admitted=summary["admitted_events"],
+                ingested=summary["ingested_events"],
+                incidents=summary["incidents"],
+            )
+        )
+        for incident in incidents:
+            print(
+                f"  {incident.incident_id}: {incident.domain} "
+                f"[{incident.blast_radius}] tenant="
+                f"{incident.namespace} nodes={len(incident.nodes)} "
+                f"confidence={incident.confidence:.3f}"
+            )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -24,6 +24,15 @@ runs the threaded suites under the dynamic lock-order race detector.
 candidate with a fresh lint finding or a lock-order inversion never
 reaches the benchmark comparison.
 
+``--fleet-sweep`` runs the fleet observability-plane gate
+(``tpuslo.fleet.sweep``): 1k simulated nodes ship gated columnar
+batches over the versioned wire contract to sharded aggregators; the
+run fails unless the aggregate ingest floor holds, every injected
+fleet fault rolls up to exactly one incident at the correct blast
+radius (no cross-tenant/cross-domain merges), and killing one
+aggregator mid-sweep — ring re-home + snapshot restore + spool
+re-send — loses and duplicates zero incidents.
+
 ``--burn-sweep`` runs the error-budget burn-scenario gate
 (``tpuslo.sloengine.sweep``): seeded synthetic traffic shapes (steady,
 fast-burn, slow-burn, latency regression, flapping, tenant-isolated,
@@ -119,6 +128,35 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--burn-seed", type=int, default=1337)
     p.add_argument("--burn-bucket-s", type=int, default=10)
     p.add_argument("--burn-eval-interval-s", type=float, default=30.0)
+    # ---- fleet observability-plane gate (tpuslo.fleet) ----------------
+    p.add_argument(
+        "--fleet-sweep",
+        action="store_true",
+        help="run the fleet observability-plane gate instead of "
+        "B5/D3/E3: 1k simulated nodes over sharded aggregators must "
+        "sustain the aggregate columnar ingest floor, every injected "
+        "fleet fault must yield exactly one incident at the correct "
+        "blast radius, and killing one aggregator mid-sweep must lose "
+        "and duplicate zero incidents",
+    )
+    p.add_argument("--fleet-nodes", type=int, default=1000)
+    p.add_argument("--fleet-shards", type=int, default=4)
+    p.add_argument("--fleet-seed", type=int, default=1337)
+    p.add_argument("--fleet-chaos-intensity", type=float, default=1.0)
+    p.add_argument("--fleet-events-per-node", type=int, default=6000)
+    p.add_argument("--fleet-rounds", type=int, default=24)
+    p.add_argument(
+        "--fleet-min-ingest",
+        type=float,
+        default=5_000_000.0,
+        help="aggregate columnar ingest floor in events/s across all "
+        "shards (total events over the slowest shard's busy time)",
+    )
+    p.add_argument(
+        "--fleet-no-kill",
+        action="store_true",
+        help="skip the mid-sweep aggregator kill (failover contract)",
+    )
     p.add_argument("--crash-root", default="artifacts/crash")
     p.add_argument("--crash-seeds", default="1,2,3,4,5")
     p.add_argument("--crash-kill-points", default="0.25,0.5,0.8")
@@ -244,6 +282,83 @@ def run_burn_gate(args) -> int:
         Path(args.summary_md).write_text(render_burn_markdown(report))
     print(
         f"m5gate: burn-sweep {'PASS' if report.passed else 'FAIL'}"
+        + ("" if report.passed else f" ({'; '.join(report.failures)})"),
+        file=sys.stderr,
+    )
+    return 0 if report.passed else 1
+
+
+def render_fleet_markdown(report) -> str:
+    lines = [
+        "# Fleet observability-plane gate",
+        "",
+        f"**Overall: {'PASS' if report.passed else 'FAIL'}**",
+        "",
+        f"- {report.nodes} simulated nodes over {report.shards} "
+        f"aggregator shards (seed {report.seed}, chaos intensity "
+        f"{report.chaos_intensity:g})",
+        f"- aggregate ingest: {report.ingest_events_per_sec:,.0f} "
+        f"events/s (floor {report.min_ingest_events_per_sec:,.0f}); "
+        f"rollup {report.rollup_latency_ms:.1f} ms",
+        f"- page dedup: precision {report.precision:.3f} recall "
+        f"{report.recall:.3f} macro-F1 {report.macro_f1:.3f}",
+        "- failover: "
+        + (
+            "killed {killed}, re-homed {rehomed} nodes, re-sent "
+            "{resent} shipments, {rebalances} ring rebalance(s), "
+            "{suppressed} re-emitted window(s) suppressed".format(
+                killed=report.failover.get("killed", "?"),
+                rehomed=report.failover.get("rehomed_nodes", 0),
+                resent=report.failover.get("resent_shipments", 0),
+                rebalances=report.failover.get("ring_rebalances", 0),
+                suppressed=report.failover.get(
+                    "rollup_windows_suppressed", 0
+                ),
+            )
+            if report.failover
+            else "(skipped)"
+        )
+        + f" — lost {len(report.failover_lost)}, duplicated "
+        f"{len(report.failover_duplicated)}",
+        "",
+        "| injection | domain | tenant | expected radius | matched | "
+        "radius | exact |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for m in report.matches:
+        lines.append(
+            f"| {m.injection} | {m.domain} | {m.namespace} "
+            f"| {m.expected_blast_radius} | {m.matched_count} "
+            f"| {m.matched_blast_radius or '-'} | {m.exact} |"
+        )
+    if report.failures:
+        lines += ["", "## Failures", ""]
+        lines += [f"- {f}" for f in report.failures]
+    return "\n".join(lines) + "\n"
+
+
+def run_fleet_gate(args) -> int:
+    from tpuslo.fleet.sweep import run_fleet_sweep
+
+    report = run_fleet_sweep(
+        nodes=args.fleet_nodes,
+        shards=args.fleet_shards,
+        seed=args.fleet_seed,
+        chaos_intensity=args.fleet_chaos_intensity,
+        events_per_node=args.fleet_events_per_node,
+        rounds=args.fleet_rounds,
+        kill_shard=not args.fleet_no_kill,
+        min_ingest_events_per_sec=args.fleet_min_ingest,
+        log=lambda msg: print(f"m5gate: {msg}", file=sys.stderr),
+    )
+    if args.summary_json:
+        Path(args.summary_json).write_text(
+            json.dumps(report.to_dict(), indent=2) + "\n"
+        )
+    if args.summary_md:
+        Path(args.summary_md).write_text(render_fleet_markdown(report))
+    print(
+        f"m5gate: fleet-sweep {'PASS' if report.passed else 'FAIL'}"
         + ("" if report.passed else f" ({'; '.join(report.failures)})"),
         file=sys.stderr,
     )
@@ -404,6 +519,8 @@ def main(argv: list[str] | None = None) -> int:
         return run_racecheck_gate()
     if args.burn_sweep:
         return run_burn_gate(args)
+    if args.fleet_sweep:
+        return run_fleet_gate(args)
     if args.crash_sweep:
         return run_crash_gate(args)
     if args.chaos_sweep:

@@ -78,6 +78,49 @@ def build_parser() -> argparse.ArgumentParser:
         help="emit the raw provenance record instead of the chain text",
     )
 
+    fl = sub.add_parser(
+        "fleet",
+        help="fleet observability plane: rolled-up incidents and "
+        "per-node reporting status from fleetagg outputs",
+    )
+    fl_sub = fl.add_subparsers(dest="subcommand", required=True)
+    fl_inc = fl_sub.add_parser(
+        "incidents",
+        help="fleet incident table (one page per fault domain x "
+        "blast radius, with member-node counts)",
+    )
+    fl_inc.add_argument(
+        "--incidents",
+        default="",
+        help="fleet-incident JSONL written by "
+        "`fleetagg --incidents-out` (required)",
+    )
+    fl_inc.add_argument(
+        "--radius",
+        default="",
+        choices=["", "pod", "node", "slice", "fleet"],
+        help="filter to one blast radius",
+    )
+    fl_inc.add_argument("--tenant", default="", help="filter to one tenant")
+    fl_inc.add_argument("--json", action="store_true")
+    fl_nodes = fl_sub.add_parser(
+        "nodes",
+        help="per-node reporting/stale status across aggregator "
+        "shards",
+    )
+    fl_nodes.add_argument(
+        "--state",
+        default="",
+        help="aggregator state snapshot written by "
+        "`fleetagg --state-out` (required)",
+    )
+    fl_nodes.add_argument(
+        "--stale-only",
+        action="store_true",
+        help="show only nodes aged out of the watermark",
+    )
+    fl_nodes.add_argument("--json", action="store_true")
+
     bu = sub.add_parser(
         "budget",
         help="per-tenant error-budget / burn-rate table from the "
@@ -201,6 +244,164 @@ def run_explain(args) -> int:
     return 0
 
 
+def _render_table(rows: list[tuple[str, ...]]) -> str:
+    """Fixed-width table; first row is the header."""
+    widths = [
+        max(len(row[col]) for row in rows) for col in range(len(rows[0]))
+    ]
+    return "\n".join(
+        "  ".join(cell.ljust(width) for cell, width in zip(row, widths))
+        for row in rows
+    )
+
+
+def run_fleet(args) -> int:
+    from tpuslo.fleet.rollup import FleetIncident
+
+    if args.subcommand == "incidents":
+        if not args.incidents:
+            print(
+                "sloctl fleet incidents: pass --incidents "
+                "(fleetagg --incidents-out JSONL)",
+                file=sys.stderr,
+            )
+            return 1
+        incidents: list[FleetIncident] = []
+        try:
+            with open(args.incidents, encoding="utf-8") as fh:
+                for line in fh:
+                    line = line.strip()
+                    if line:
+                        incidents.append(
+                            FleetIncident.from_dict(json.loads(line))
+                        )
+        except (OSError, json.JSONDecodeError) as exc:
+            print(
+                f"sloctl fleet incidents: cannot read "
+                f"{args.incidents}: {exc}",
+                file=sys.stderr,
+            )
+            return 1
+        incidents = [
+            i
+            for i in incidents
+            if (not args.radius or i.blast_radius == args.radius)
+            and (not args.tenant or i.namespace == args.tenant)
+        ]
+        if args.json:
+            print(
+                json.dumps([i.to_dict() for i in incidents], indent=2)
+            )
+            return 0
+        if not incidents:
+            print("(no fleet incidents)")
+            return 0
+        rows = [
+            (
+                "INCIDENT", "DOMAIN", "RADIUS", "TENANT", "NODES",
+                "SLICES", "MEMBERS", "CONFIDENCE",
+            )
+        ]
+        for i in sorted(incidents, key=lambda x: x.window_start_ns):
+            rows.append(
+                (
+                    i.incident_id,
+                    i.domain,
+                    i.blast_radius,
+                    i.namespace,
+                    str(len(i.nodes)),
+                    str(len(i.slices)),
+                    str(len(i.members)),
+                    f"{i.confidence:.3f}",
+                )
+            )
+        print(_render_table(rows))
+        print(
+            f"{len(incidents)} fleet incidents — drill down with "
+            "`sloctl explain <incident>` on the fleetagg provenance "
+            "log"
+        )
+        return 0
+
+    # fleet nodes
+    if not args.state:
+        print(
+            "sloctl fleet nodes: pass --state "
+            "(fleetagg --state-out snapshot)",
+            file=sys.stderr,
+        )
+        return 1
+    try:
+        with open(args.state, encoding="utf-8") as fh:
+            state = json.load(fh)
+    except (OSError, json.JSONDecodeError) as exc:
+        print(
+            f"sloctl fleet nodes: cannot read {args.state}: {exc}",
+            file=sys.stderr,
+        )
+        return 1
+    shards = state.get("shards") or {}
+    snapshots = state.get("snapshots") or {}
+    node_rows = []
+    for shard_id in sorted(shards):
+        section = shards[shard_id] or {}
+        snap = snapshots.get(shard_id) or {}
+        watermark = int(snap.get("watermark_ns", 0))
+        nodes = section.get("nodes") or {}
+        heads = [
+            int(f.get("head_ns", 0)) for f in nodes.values()
+        ]
+        shard_head = max(heads) if heads else 0
+        for node in sorted(nodes):
+            fragment = nodes[node] or {}
+            head = int(fragment.get("head_ns", 0))
+            lag_ms = (shard_head - head) / 1e6
+            # Prefer the shard's own verdict (exported alongside the
+            # fragment); fall back to the watermark heuristic for
+            # state files written before the flag existed.
+            stale = bool(
+                fragment.get(
+                    "stale", bool(watermark and head < watermark)
+                )
+            )
+            node_rows.append(
+                {
+                    "node": node,
+                    "shard": shard_id,
+                    "slice_id": str(fragment.get("slice_id", "")),
+                    "seq": int(fragment.get("seq", -1)),
+                    "events": int(fragment.get("events", 0)),
+                    "head_lag_ms": round(lag_ms, 1),
+                    "stale": stale,
+                }
+            )
+    if args.stale_only:
+        node_rows = [r for r in node_rows if r["stale"]]
+    if args.json:
+        print(json.dumps(node_rows, indent=2))
+        return 0
+    if not node_rows:
+        print("(no nodes)" if not args.stale_only else "(no stale nodes)")
+        return 0
+    rows = [
+        ("NODE", "SHARD", "SLICE", "SEQ", "EVENTS", "LAG(ms)", "STALE")
+    ]
+    for r in node_rows:
+        rows.append(
+            (
+                r["node"],
+                r["shard"],
+                r["slice_id"],
+                str(r["seq"]),
+                str(r["events"]),
+                f"{r['head_lag_ms']:g}",
+                "yes" if r["stale"] else "-",
+            )
+        )
+    print(_render_table(rows))
+    return 0
+
+
 def _render_budget_table(statuses, tenant_filter: str = "") -> str:
     """Fixed-width per-(tenant, objective) budget table."""
     rows = [
@@ -229,13 +430,7 @@ def _render_budget_table(statuses, tenant_filter: str = "") -> str:
         )
     if len(rows) == 1:
         return "(no tenants observed)"
-    widths = [
-        max(len(row[col]) for row in rows) for col in range(len(rows[0]))
-    ]
-    return "\n".join(
-        "  ".join(cell.ljust(width) for cell, width in zip(row, widths))
-        for row in rows
-    )
+    return _render_table(rows)
 
 
 def _budget_engine_from_state(cfg, state_path: str):
@@ -367,6 +562,8 @@ def main(argv: list[str] | None = None) -> int:
         return run_explain(args)
     if args.command == "budget":
         return run_budget(args)
+    if args.command == "fleet":
+        return run_fleet(args)
     return run_cdgate(args)
 
 

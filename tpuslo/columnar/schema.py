@@ -168,6 +168,20 @@ class StringPool:
     def get(self, code: int) -> str:
         return self.strings[code]
 
+    @classmethod
+    def from_strings(cls, strings: list[str]) -> "StringPool":
+        """Rebuild a pool from an already-encoded entry list.
+
+        The fleet wire decoder ships the pool as a plain string list;
+        rebuilding it here keeps knowledge of the pool's private
+        layout (index, lazily-extended derived caches) in one place.
+        The caller guarantees entry 0 is ``""``.
+        """
+        pool = cls()
+        pool.strings = list(strings)
+        pool._index = {s: i for i, s in enumerate(pool.strings)}
+        return pool
+
     def content_hashes(self) -> np.ndarray:
         """uint64 content hash of every entry (IN-process stability).
 
@@ -278,6 +292,46 @@ def empty_batch(n: int = 0, pool: StringPool | None = None) -> ColumnarBatch:
         for name in ("tpu_host_index", "tpu_ici_link", "tpu_launch_id"):
             cols[name].fill(-1)
     return ColumnarBatch(cols, pool or StringPool(), n)
+
+
+def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
+    """Merge batches with independent pools into one shared-pool batch.
+
+    The fleet aggregators gate *merged* batches (one admission pass over
+    ~32 node shipments beats 32 small passes — the dedup carry-window
+    probe costs the same per batch regardless of its size), but each
+    shipment arrives with its own :class:`StringPool`.  Re-coding is one
+    gather per string column through an ``old code → new code`` table
+    built by interning each source pool once — per-*pool* work (tens of
+    entries), never per-event work.
+    """
+    batches = [b for b in batches if b.n]
+    if not batches:
+        return empty_batch(0)
+    if len(batches) == 1:
+        return batches[0]
+    pool = StringPool()
+    remaps = [
+        np.array(
+            [pool.intern(s) for s in b.pool.strings], dtype=np.int32
+        )
+        for b in batches
+    ]
+    total = sum(b.n for b in batches)
+    cols = alloc_batch_columns(total)
+    string_cols = set(STRING_COLUMNS)
+    for name, _ in _DTYPE_FIELDS:
+        out = cols[name]
+        off = 0
+        if name in string_cols:
+            for b, remap in zip(batches, remaps):
+                out[off:off + b.n] = remap[b.columns[name]]
+                off += b.n
+        else:
+            for b in batches:
+                out[off:off + b.n] = b.columns[name]
+                off += b.n
+    return ColumnarBatch(cols, pool, total)
 
 
 def from_rows(

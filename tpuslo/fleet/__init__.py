@@ -1,0 +1,87 @@
+"""Fleet observability plane: node agents → sharded aggregators.
+
+The per-node toolkit observes one host; this package scales the unit
+of observability to the fleet (ROADMAP item 1, ARGUS-style):
+
+* :mod:`tpuslo.fleet.wire` — versioned node→aggregator shipment
+  contract over zero-copy columnar blocks (TPL104-governed).
+* :mod:`tpuslo.fleet.ring` — consistent hash ring placing (node,
+  slice) arcs onto aggregator shards.
+* :mod:`tpuslo.fleet.aggregator` — sharded ingest: decode → merge →
+  gate → fold, per-node watermarks, windowed attribution.
+* :mod:`tpuslo.fleet.rollup` — cross-node incident rollup: one page
+  per (fault domain × blast radius) with member-node provenance.
+* :mod:`tpuslo.fleet.simulator` — seeded 1k-node fleet simulator.
+* :mod:`tpuslo.fleet.sweep` — the ``m5gate --fleet-sweep`` release
+  gate (throughput, page dedup, rollup macro-F1, shard failover).
+"""
+
+from tpuslo.fleet.aggregator import AggregatorShard, FleetObserver
+from tpuslo.fleet.ring import HashRing, node_key
+from tpuslo.fleet.rollup import (
+    BLAST_FLEET,
+    BLAST_NODE,
+    BLAST_POD,
+    BLAST_RADII,
+    BLAST_SLICE,
+    FleetIncident,
+    FleetRollup,
+    NodeIncident,
+    classify_blast_radius,
+)
+from tpuslo.fleet.simulator import (
+    FaultInjection,
+    FleetSimulator,
+    FleetTopology,
+    default_injection_plan,
+)
+from tpuslo.fleet.sweep import (
+    FleetSweepReport,
+    run_fleet_sweep,
+    score_incidents,
+)
+from tpuslo.fleet.wire import (
+    FLEET_WIRE_VERSION,
+    WIRE_EVENT_COLUMNS,
+    Shipment,
+    ShipmentWriter,
+    WireContractError,
+    decode_shipment,
+    encode_shipment,
+    load_shipments,
+    parse_shipment_line,
+    shipment_json_line,
+)
+
+__all__ = [
+    "AggregatorShard",
+    "FleetObserver",
+    "HashRing",
+    "node_key",
+    "BLAST_POD",
+    "BLAST_NODE",
+    "BLAST_SLICE",
+    "BLAST_FLEET",
+    "BLAST_RADII",
+    "FleetIncident",
+    "FleetRollup",
+    "NodeIncident",
+    "classify_blast_radius",
+    "FaultInjection",
+    "FleetSimulator",
+    "FleetTopology",
+    "default_injection_plan",
+    "FleetSweepReport",
+    "run_fleet_sweep",
+    "score_incidents",
+    "FLEET_WIRE_VERSION",
+    "WIRE_EVENT_COLUMNS",
+    "Shipment",
+    "ShipmentWriter",
+    "WireContractError",
+    "decode_shipment",
+    "encode_shipment",
+    "load_shipments",
+    "parse_shipment_line",
+    "shipment_json_line",
+]

@@ -1,0 +1,445 @@
+"""Aggregator shard: gated node shipments → per-node attributions.
+
+One shard owns an arc of the hash ring.  Its ingest path is columnar
+end to end:
+
+1. **Decode** — :func:`~tpuslo.fleet.wire.decode_shipment`
+   (``np.frombuffer``, no per-event work), then a per-node sequence
+   check: shipments replayed by the delivery spool or re-sent after a
+   failover re-home are dropped by ``seq`` before they cost anything.
+2. **Merge** — buffered shipments concatenate into one shard batch
+   (:func:`~tpuslo.columnar.schema.concat_batches`), because one gate
+   pass over ~32 shipments beats 32 small passes: the dedup
+   carry-window probe is per-batch, not per-event.
+3. **Gate** — the PR 8 :class:`~tpuslo.columnar.gate.ColumnarGate`
+   (validity + cross-node dedup + watermark) with skew correction OFF:
+   node agents gate — and skew-correct — before shipping, so the shard
+   trusts corrected timestamps and handles *residual* cross-node skew
+   with per-node watermarks instead of re-running the estimator.
+4. **Fold** — admitted rows fold into per-(window, namespace, node,
+   pod) signal accumulators with one packed-key sort + ``reduceat``
+   max per batch; per-Python cost is per *group*, never per event.
+   Max-folding makes the evidence idempotent: a duplicate observation
+   (chaos dup, failover overlap) cannot inflate it.
+
+Window close runs the shared Bayesian attributor over the closed
+accumulators and hands :class:`~tpuslo.fleet.rollup.NodeIncident`\\ s to
+the fleet rollup.  The shard's per-node state — heads, sequence
+numbers, pending evidence — is partitioned by node, so a killed
+shard's snapshot restores node by node into whichever shards the ring
+re-homes its arcs to.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, replace
+from datetime import datetime, timezone
+from typing import Any
+
+import numpy as np
+
+from tpuslo.columnar.gate import ColumnarGate
+from tpuslo.columnar.schema import ColumnarBatch, concat_batches
+from tpuslo.fleet.rollup import NodeIncident
+from tpuslo.fleet.wire import Shipment, decode_shipment
+from tpuslo.ingest.gate import GateConfig
+
+
+class FleetObserver:
+    """Duck-typed metrics bridge (see AgentMetrics.fleet_observer)."""
+
+    def ingested(self, shard: str, events: int) -> None: ...
+
+    def rollup_latency_ms(self, ms: float) -> None: ...
+
+    def incidents_open(self, blast_radius: str, count: int) -> None: ...
+
+    def nodes(self, reporting: int, stale: int) -> None: ...
+
+    def rebalance(self) -> None: ...
+
+
+@dataclass(slots=True)
+class _NodeState:
+    head_ns: int = 0
+    seq: int = -1
+    events: int = 0
+    slice_id: str = ""
+
+
+class AggregatorShard:
+    """One horizontally sharded aggregator."""
+
+    def __init__(
+        self,
+        shard_id: str,
+        gate_config: GateConfig | None = None,
+        window_ns: int = 2_000_000_000,
+        lateness_ns: int = 1_000_000_000,
+        stale_after_ns: int = 30_000_000_000,
+        min_confidence: float = 0.5,
+        coalesce_events: int = 131072,
+        attributor=None,
+        observer: FleetObserver | None = None,
+    ):
+        self.shard_id = shard_id
+        cfg = gate_config or GateConfig()
+        # Node agents already corrected skew before shipping; the shard
+        # must not re-estimate from its partial view of launch groups.
+        self.gate = ColumnarGate(replace(cfg, skew_correction=False))
+        self.window_ns = max(1, int(window_ns))
+        self.lateness_ns = max(0, int(lateness_ns))
+        self.stale_after_ns = max(1, int(stale_after_ns))
+        self.min_confidence = min_confidence
+        self.coalesce_events = max(1, int(coalesce_events))
+        self._attributor = attributor
+        self._observer = observer or FleetObserver()
+        self.nodes: dict[str, _NodeState] = {}
+        self._pending: list[ColumnarBatch] = []
+        self._pending_events = 0
+        #: bucket -> (namespace, node, pod) -> {signal: max value}
+        #: (slice identity is node metadata from the shipment header,
+        #: not part of the fold key — tpu and non-tpu rows of one pod
+        #: must land in ONE attribution vector)
+        self._acc: dict[
+            int, dict[tuple[str, str, str], dict[str, float]]
+        ] = {}
+        self.ingested_events = 0
+        self.admitted_events = 0
+        self.duplicate_shipments = 0
+        self.shipments = 0
+        self.busy_ns = 0
+
+    # ---- ingest -------------------------------------------------------
+
+    def ingest(self, shipment: Shipment | dict[str, Any]) -> bool:
+        """Accept one shipment; False when dropped as a seq duplicate."""
+        t0 = time.perf_counter_ns()
+        try:
+            if not isinstance(shipment, Shipment):
+                # Peek the header before paying the O(events) decode:
+                # spool replays and failover re-sends arrive as dicts
+                # and most of them are seq duplicates.  A malformed
+                # header falls through to decode_shipment, which
+                # raises the contract error loudly.
+                peek_node = shipment.get("node")
+                peek_state = (
+                    self.nodes.get(peek_node)
+                    if isinstance(peek_node, str)
+                    else None
+                )
+                if peek_state is not None:
+                    try:
+                        if int(shipment["seq"]) <= peek_state.seq:
+                            self.duplicate_shipments += 1
+                            return False
+                    except (KeyError, TypeError, ValueError):
+                        pass
+                shipment = decode_shipment(shipment)
+            state = self.nodes.get(shipment.node)
+            if state is None:
+                state = _NodeState()
+                self.nodes[shipment.node] = state
+            if shipment.seq <= state.seq:
+                self.duplicate_shipments += 1
+                return False
+            state.seq = shipment.seq
+            state.events += shipment.events
+            if shipment.slice_id:
+                state.slice_id = shipment.slice_id
+            if shipment.head_ns > state.head_ns:
+                state.head_ns = shipment.head_ns
+            self.shipments += 1
+            self.ingested_events += shipment.events
+            if shipment.events:
+                self._pending.append(shipment.batch)
+                self._pending_events += shipment.events
+                if self._pending_events >= self.coalesce_events:
+                    self._drain()
+            return True
+        finally:
+            self.busy_ns += time.perf_counter_ns() - t0
+
+    def _drain(self) -> None:
+        if not self._pending:
+            return
+        merged = concat_batches(self._pending)
+        self._pending = []
+        self._pending_events = 0
+        result = self.gate.admit_batch(merged)
+        for part in (result.admitted, result.late):
+            if len(part):
+                self.admitted_events += len(part)
+                self._fold(part)
+        self._observer.ingested(self.shard_id, len(merged))
+
+    # ---- evidence fold ------------------------------------------------
+
+    def _fold(self, batch: ColumnarBatch) -> None:
+        """Per-(window, tenant, node, pod) signal maxima, vectorized.
+
+        Rows sort once by a packed (bucket, namespace, node, pod,
+        signal) key (lexsort fallback when pool codes outgrow the
+        packing budget); ``np.maximum.reduceat`` collapses each group
+        to its max.  Only the distinct groups — tens per batch, not
+        the tens of thousands of rows — cross into Python dicts.
+        """
+        c = batch.columns
+        ts = c["ts_unix_nano"]
+        bucket = ts // self.window_ns
+        b_rel = bucket - bucket.min()
+        ns = c["namespace"].astype(np.int64)
+        node = c["node"].astype(np.int64)
+        pod = c["pod"].astype(np.int64)
+        sig = c["signal"].astype(np.int64)
+        bits = max(1, len(batch.pool)).bit_length()
+        span = int(b_rel.max()).bit_length() if len(b_rel) else 0
+        if 4 * bits + span <= 62:
+            key = (
+                (((b_rel << bits | ns) << bits | node) << bits | pod)
+                << bits
+            ) | sig
+            order = np.argsort(key, kind="stable")
+            sorted_parts = (key[order],)
+        else:
+            order = np.lexsort((sig, pod, node, ns, b_rel))
+            sorted_parts = tuple(
+                a[order] for a in (b_rel, ns, node, pod, sig)
+            )
+        n = batch.n
+        starts = np.zeros(n, dtype=bool)
+        starts[0] = True
+        for part in sorted_parts:
+            starts[1:] |= part[1:] != part[:-1]
+        start_idx = np.flatnonzero(starts)
+        maxima = np.maximum.reduceat(
+            c["value"][order], start_idx
+        ).tolist()
+        strings = batch.pool.strings
+        first = order[start_idx]
+        g_bucket = bucket[first].tolist()
+        g_ns = c["namespace"][first].tolist()
+        g_node = c["node"][first].tolist()
+        g_pod = c["pod"][first].tolist()
+        g_sig = c["signal"][first].tolist()
+        acc = self._acc
+        for i in range(len(start_idx)):
+            by_group = acc.setdefault(g_bucket[i], {})
+            gkey = (
+                strings[g_ns[i]],
+                strings[g_node[i]],
+                strings[g_pod[i]],
+            )
+            signals = by_group.get(gkey)
+            if signals is None:
+                signals = {}
+                by_group[gkey] = signals
+            name = strings[g_sig[i]]
+            value = maxima[i]
+            if value > signals.get(name, float("-inf")):
+                signals[name] = value
+
+    # ---- watermark + window close -------------------------------------
+
+    def fleet_head_ns(self) -> int:
+        heads = [s.head_ns for s in self.nodes.values()]
+        return max(heads) if heads else 0
+
+    def reporting_and_stale(self) -> tuple[int, int]:
+        head = self.fleet_head_ns()
+        stale = sum(
+            1
+            for s in self.nodes.values()
+            if head - s.head_ns > self.stale_after_ns
+        )
+        return len(self.nodes) - stale, stale
+
+    def watermark_ns(self) -> int:
+        """Min head over non-stale nodes, minus the lateness bound.
+
+        A node that stopped shipping must age out of the min — one
+        dead DaemonSet agent cannot be allowed to freeze the fleet's
+        rollup windows forever.
+        """
+        head = self.fleet_head_ns()
+        active = [
+            s.head_ns
+            for s in self.nodes.values()
+            if head - s.head_ns <= self.stale_after_ns
+        ]
+        if not active:
+            return 0
+        return min(active) - self.lateness_ns
+
+    def close_windows(
+        self, watermark_ns: int | None = None, flush: bool = False
+    ) -> list[NodeIncident]:
+        """Attribute every accumulator bucket behind the watermark."""
+        self._drain()
+        if watermark_ns is None:
+            watermark_ns = self.watermark_ns()
+        t0 = time.perf_counter_ns()
+        incidents: list[NodeIncident] = []
+        for bucket in sorted(self._acc):
+            end_ns = (bucket + 1) * self.window_ns
+            if not flush and end_ns > watermark_ns:
+                continue
+            incidents.extend(
+                self._attribute_bucket(bucket, self._acc.pop(bucket))
+            )
+        if incidents:
+            self._observer.rollup_latency_ms(
+                (time.perf_counter_ns() - t0) / 1e6
+            )
+        return incidents
+
+    def _attribute_bucket(
+        self,
+        bucket: int,
+        groups: dict[tuple[str, str, str], dict[str, float]],
+    ) -> list[NodeIncident]:
+        from tpuslo.attribution.bayesian import (
+            DOMAIN_UNKNOWN,
+            BayesianAttributor,
+        )
+        from tpuslo.attribution.mapper import FaultSample
+
+        if self._attributor is None:
+            self._attributor = BayesianAttributor()
+        start_ns = bucket * self.window_ns
+        when = datetime.fromtimestamp(start_ns / 1e9, tz=timezone.utc)
+        keys = sorted(groups)
+        samples = [
+            FaultSample(
+                incident_id=f"{node}/{pod}@{start_ns}",
+                timestamp=when,
+                cluster="fleet",
+                namespace=ns,
+                service="fleet",
+                fault_label="",
+                confidence=0.0,
+                burn_rate=0.0,
+                window_minutes=max(
+                    1, int(self.window_ns / 60_000_000_000)
+                ),
+                request_id="",
+                trace_id="",
+                signals=groups[key],
+            )
+            for key in keys
+            for ns, node, pod in (key,)
+        ]
+        predictions = self._attributor.attribute_batch(samples)
+        out: list[NodeIncident] = []
+        for key, prediction in zip(keys, predictions):
+            ns, node, pod = key
+            if prediction.predicted_fault_domain == DOMAIN_UNKNOWN:
+                continue
+            if prediction.confidence < self.min_confidence:
+                continue
+            out.append(
+                NodeIncident(
+                    node=node,
+                    pod=pod,
+                    namespace=ns,
+                    slice_id=self.nodes[node].slice_id
+                    if node in self.nodes
+                    else "",
+                    domain=prediction.predicted_fault_domain,
+                    confidence=prediction.confidence,
+                    ts_unix_nano=start_ns,
+                    signals=dict(groups[key]),
+                )
+            )
+        return out
+
+    # ---- reporting ----------------------------------------------------
+
+    def snapshot(self) -> dict[str, Any]:
+        reporting, stale = self.reporting_and_stale()
+        return {
+            "shard": self.shard_id,
+            "nodes": len(self.nodes),
+            "nodes_reporting": reporting,
+            "nodes_stale": stale,
+            "shipments": self.shipments,
+            "duplicate_shipments": self.duplicate_shipments,
+            "ingested_events": self.ingested_events,
+            "admitted_events": self.admitted_events,
+            "watermark_ns": self.watermark_ns(),
+            "open_windows": len(self._acc),
+            "gate": self.gate.snapshot(),
+        }
+
+    # ---- failover snapshot (PR 4 runtime registry) --------------------
+
+    def export_state(self) -> dict[str, Any]:
+        """Per-node-partitionable state for the runtime StateStore."""
+        self._drain()
+        pending: dict[str, list[dict[str, Any]]] = {}
+        for bucket, groups in self._acc.items():
+            for (ns, node, pod), signals in groups.items():
+                pending.setdefault(node, []).append(
+                    {
+                        "bucket": bucket,
+                        "namespace": ns,
+                        "pod": pod,
+                        "signals": dict(signals),
+                    }
+                )
+        # Stale is the aggregator's own predicate (head behind the
+        # shard's fleet head by more than stale_after); exporting it
+        # keeps `sloctl fleet nodes` in lockstep with the
+        # fleet_nodes_stale series instead of re-deriving a different
+        # rule from the watermark.
+        head = self.fleet_head_ns()
+        return {
+            "window_ns": self.window_ns,
+            "nodes": {
+                node: {
+                    "head_ns": state.head_ns,
+                    "seq": state.seq,
+                    "events": state.events,
+                    "slice_id": state.slice_id,
+                    "stale": head - state.head_ns > self.stale_after_ns,
+                    "pending": pending.get(node, []),
+                }
+                for node, state in self.nodes.items()
+            },
+        }
+
+    def absorb_node_state(
+        self, node: str, fragment: dict[str, Any]
+    ) -> None:
+        """Re-home one node's exported state onto this shard."""
+        state = self.nodes.get(node)
+        if state is None:
+            state = _NodeState()
+            self.nodes[node] = state
+        state.head_ns = max(
+            state.head_ns, int(fragment.get("head_ns", 0))
+        )
+        state.seq = max(state.seq, int(fragment.get("seq", -1)))
+        state.events += int(fragment.get("events", 0))
+        if fragment.get("slice_id"):
+            state.slice_id = str(fragment["slice_id"])
+        for entry in fragment.get("pending") or []:
+            bucket = int(entry["bucket"])
+            gkey = (
+                str(entry["namespace"]),
+                node,
+                str(entry["pod"]),
+            )
+            signals = self._acc.setdefault(bucket, {}).setdefault(
+                gkey, {}
+            )
+            for name, value in (entry.get("signals") or {}).items():
+                value = float(value)
+                if value > signals.get(name, float("-inf")):
+                    signals[name] = value
+
+    def restore_state(self, state: dict[str, Any]) -> None:
+        self.window_ns = int(state.get("window_ns", self.window_ns))
+        for node, fragment in (state.get("nodes") or {}).items():
+            self.absorb_node_state(str(node), fragment)

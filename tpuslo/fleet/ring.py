@@ -1,0 +1,116 @@
+"""Consistent hash ring: (node, slice) → aggregator shard.
+
+Placement must be stable (a node re-keys only when its arc's owner
+changes), deterministic across processes (agents and aggregators
+compute the same owner without coordination — hashes are blake2b, not
+the salted builtin), and cheap to rebalance (killing one shard re-homes
+only that shard's arcs).  Virtual nodes keep the load spread tight:
+with 64 vnodes per shard the max/mean node-count ratio over a 1k-node
+fleet stays within ~15%.
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_left
+from hashlib import blake2b
+from typing import Any, Iterable
+
+
+def _point(key: str) -> int:
+    return int.from_bytes(
+        blake2b(key.encode("utf-8"), digest_size=8).digest(), "big"
+    )
+
+
+def node_key(node: str, slice_id: str) -> str:
+    """The ring key the fleet plane hashes: one arc per (node, slice)."""
+    return f"{node}|{slice_id}"
+
+
+class HashRing:
+    """Sorted ring of vnode points; lookup is one bisect."""
+
+    def __init__(self, shards: Iterable[str], vnodes: int = 64):
+        self.vnodes = max(1, int(vnodes))
+        self._shards: list[str] = []
+        self._points: list[int] = []
+        self._owners: list[str] = []
+        self.rebalances = 0
+        for shard in shards:
+            self._insert(shard)
+
+    # ---- membership ---------------------------------------------------
+
+    @property
+    def shards(self) -> list[str]:
+        return list(self._shards)
+
+    def _insert(self, shard: str) -> None:
+        if shard in self._shards:
+            raise ValueError(f"shard {shard!r} already on the ring")
+        self._shards.append(shard)
+        for v in range(self.vnodes):
+            point = _point(f"{shard}#{v}")
+            at = bisect_left(self._points, point)
+            self._points.insert(at, point)
+            self._owners.insert(at, shard)
+
+    def add_shard(self, shard: str) -> None:
+        self._insert(shard)
+        self.rebalances += 1
+
+    def remove_shard(self, shard: str) -> None:
+        if shard not in self._shards:
+            raise ValueError(f"shard {shard!r} not on the ring")
+        self._shards.remove(shard)
+        keep = [
+            (p, o)
+            for p, o in zip(self._points, self._owners)
+            if o != shard
+        ]
+        self._points = [p for p, _ in keep]
+        self._owners = [o for _, o in keep]
+        self.rebalances += 1
+
+    # ---- lookup -------------------------------------------------------
+
+    def shard_for(self, key: str) -> str:
+        if not self._points:
+            raise LookupError("ring has no shards")
+        at = bisect_left(self._points, _point(key))
+        if at == len(self._points):
+            at = 0
+        return self._owners[at]
+
+    def shard_for_node(self, node: str, slice_id: str) -> str:
+        return self.shard_for(node_key(node, slice_id))
+
+    def assignments(
+        self, nodes: Iterable[tuple[str, str]]
+    ) -> dict[str, str]:
+        """Bulk node placement: ``{node: shard}`` for (node, slice)s."""
+        return {
+            node: self.shard_for_node(node, slice_id)
+            for node, slice_id in nodes
+        }
+
+    # ---- failover snapshot -------------------------------------------
+
+    def export_state(self) -> dict[str, Any]:
+        return {
+            "shards": list(self._shards),
+            "vnodes": self.vnodes,
+            "rebalances": self.rebalances,
+        }
+
+    def restore_state(self, state: dict[str, Any]) -> None:
+        shards = state.get("shards")
+        if not isinstance(shards, list):
+            raise ValueError("ring state missing shards")
+        self.vnodes = int(state.get("vnodes", self.vnodes))
+        self._shards = []
+        self._points = []
+        self._owners = []
+        for shard in shards:
+            self._insert(str(shard))
+        self.rebalances = int(state.get("rebalances", 0))

@@ -1,0 +1,381 @@
+"""Cross-node incident rollup: many node attributions → one fleet page.
+
+The per-node pipeline pages once per node; a slice-wide ICI fault on a
+64-node slice would page 64 times.  The rollup collapses per-node
+attributions into **fleet incidents** — one page per (fault domain ×
+blast radius), with member-node provenance so the page still drills
+down to kernel evidence (``sloctl explain`` renders the ``members``
+block).
+
+Merging is *session-windowed* per (namespace, fault domain): a node
+incident joins an open group when it falls within ``gap_ns`` of the
+group's [start, last] interval — on either side, because shards
+deliver their node incidents in shard order, not time order (fleetagg
+flushes shard 0's whole history before shard 1's) — and a group emits
+once the fleet watermark has passed its quiet period.  A member that
+bridges two open groups merges them.  Two invariants are structural,
+not heuristic:
+
+* **No cross-tenant merges** — namespace is part of the group key.
+* **No cross-domain merges** — the predicted fault domain is part of
+  the group key.
+
+Emission is idempotent: an emitted-window registry per (namespace,
+domain) — snapshot/restored across aggregator failover — refuses to
+page the same incident twice.  The registry matches on gap-tolerant
+window overlap rather than on the incident id: a failover-rebuilt
+group can legitimately re-bucket its earliest member by one window,
+which would shift an id derived from ``start_ns``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable
+
+BLAST_POD = "pod"
+BLAST_NODE = "node"
+BLAST_SLICE = "slice"
+BLAST_FLEET = "fleet"
+
+BLAST_RADII = (BLAST_POD, BLAST_NODE, BLAST_SLICE, BLAST_FLEET)
+
+
+@dataclass(slots=True)
+class NodeIncident:
+    """One per-(node, pod) attribution inside a rollup window."""
+
+    node: str
+    pod: str
+    namespace: str
+    slice_id: str
+    domain: str
+    confidence: float
+    ts_unix_nano: int
+    tier: str = "node_window"
+    signals: dict[str, float] = field(default_factory=dict)
+
+    @property
+    def incident_id(self) -> str:
+        return f"{self.node}/{self.pod}@{self.ts_unix_nano}"
+
+    def member_dict(self) -> dict[str, Any]:
+        return {
+            "incident_id": self.incident_id,
+            "node": self.node,
+            "pod": self.pod,
+            "slice_id": self.slice_id,
+            "tier": self.tier,
+            "confidence": round(self.confidence, 4),
+        }
+
+
+def classify_blast_radius(members: Iterable[NodeIncident]) -> str:
+    """Topological blast radius of a member set.
+
+    1 pod → pod; 1 node, >1 pods → node; >1 nodes on 1 slice → slice;
+    nodes spanning slices → fleet.  An empty ``slice_id`` (agent ran
+    without ``--slice-id``) carries no slice identity and must not
+    count as a slice — otherwise two such nodes classify as two
+    slices and escalate to fleet radius.
+    """
+    nodes: set[str] = set()
+    pods: set[str] = set()
+    slices: set[str] = set()
+    for m in members:
+        nodes.add(m.node)
+        pods.add(f"{m.node}/{m.pod}")
+        if m.slice_id:
+            slices.add(m.slice_id)
+    if len(slices) > 1:
+        return BLAST_FLEET
+    if len(nodes) > 1:
+        return BLAST_SLICE
+    if len(pods) > 1:
+        return BLAST_NODE
+    return BLAST_POD
+
+
+@dataclass(slots=True)
+class FleetIncident:
+    """One fleet page with member-node provenance."""
+
+    incident_id: str
+    namespace: str
+    domain: str
+    blast_radius: str
+    window_start_ns: int
+    window_end_ns: int
+    confidence: float
+    nodes: list[str]
+    slices: list[str]
+    members: list[dict[str, Any]]
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "incident_id": self.incident_id,
+            "namespace": self.namespace,
+            "domain": self.domain,
+            "blast_radius": self.blast_radius,
+            "window_start_ns": self.window_start_ns,
+            "window_end_ns": self.window_end_ns,
+            "confidence": round(self.confidence, 4),
+            "nodes": list(self.nodes),
+            "slices": list(self.slices),
+            "members": [dict(m) for m in self.members],
+        }
+
+    @classmethod
+    def from_dict(cls, raw: dict[str, Any]) -> "FleetIncident":
+        return cls(
+            incident_id=str(raw.get("incident_id", "")),
+            namespace=str(raw.get("namespace", "")),
+            domain=str(raw.get("domain", "")),
+            blast_radius=str(raw.get("blast_radius", "")),
+            window_start_ns=int(raw.get("window_start_ns", 0)),
+            window_end_ns=int(raw.get("window_end_ns", 0)),
+            confidence=float(raw.get("confidence", 0.0)),
+            nodes=[str(n) for n in raw.get("nodes") or []],
+            slices=[str(s) for s in raw.get("slices") or []],
+            members=[dict(m) for m in raw.get("members") or []],
+        )
+
+
+@dataclass(slots=True)
+class _Group:
+    """One open (namespace, domain) session window."""
+
+    namespace: str
+    domain: str
+    start_ns: int
+    last_ns: int
+    members: dict[str, NodeIncident]  # keyed by (node/pod), best kept
+
+
+class FleetRollup:
+    """Session-window collapse of node incidents into fleet pages."""
+
+    def __init__(
+        self,
+        gap_ns: int = 5_000_000_000,
+        on_incident: Callable[[FleetIncident], None] | None = None,
+    ):
+        self.gap_ns = max(1, int(gap_ns))
+        self._groups: dict[tuple[str, str], list[_Group]] = {}
+        #: (namespace, domain) → emitted [start_ns, last_ns] windows.
+        self._emitted_windows: dict[
+            tuple[str, str], list[tuple[int, int]]
+        ] = {}
+        self._on_incident = on_incident
+        self.incidents_emitted = 0
+        self.duplicates_suppressed = 0
+        self.members_folded = 0
+
+    # ---- ingest -------------------------------------------------------
+
+    def observe(self, incidents: Iterable[NodeIncident]) -> list[FleetIncident]:
+        """Fold node incidents; returns groups closed by arrival order.
+
+        A member far past a group's quiet period closes that group
+        immediately (arrival-driven close); watermark-driven close is
+        :meth:`close_up_to`.  A member EARLIER than every open group
+        (a straggler from a later-flushed shard) opens its own session
+        and closes nothing — temporally distinct faults must not merge
+        just because shard flush order interleaved them.
+        """
+        emitted: list[FleetIncident] = []
+        for ni in incidents:
+            key = (ni.namespace, ni.domain)
+            sessions = self._groups.setdefault(key, [])
+            ts = ni.ts_unix_nano
+            joinable = [
+                g
+                for g in sessions
+                if g.start_ns - self.gap_ns <= ts <= g.last_ns + self.gap_ns
+            ]
+            if joinable:
+                group = joinable[0]
+                for other in joinable[1:]:  # member bridges sessions
+                    for mk, m in other.members.items():
+                        prior = group.members.get(mk)
+                        if prior is None or m.confidence > prior.confidence:
+                            group.members[mk] = m
+                    group.start_ns = min(group.start_ns, other.start_ns)
+                    group.last_ns = max(group.last_ns, other.last_ns)
+                    sessions.remove(other)
+            else:
+                # Forward gap: sessions quiet relative to the new
+                # arrival close now.  Sessions LATER than ni stay open.
+                for stale in [
+                    g for g in sessions if g.last_ns + self.gap_ns < ts
+                ]:
+                    emitted.extend(self._emit(key, stale))
+                # _emit drops the key once its last session closes;
+                # re-anchor so the new session lands in the live dict.
+                sessions = self._groups.setdefault(key, [])
+                group = _Group(
+                    namespace=ni.namespace,
+                    domain=ni.domain,
+                    start_ns=ts,
+                    last_ns=ts,
+                    members={},
+                )
+                sessions.append(group)
+            member_key = f"{ni.node}/{ni.pod}"
+            prior = group.members.get(member_key)
+            if prior is None or ni.confidence > prior.confidence:
+                group.members[member_key] = ni
+            group.start_ns = min(group.start_ns, ts)
+            group.last_ns = max(group.last_ns, ts)
+            self.members_folded += 1
+        return emitted
+
+    def close_up_to(self, watermark_ns: int) -> list[FleetIncident]:
+        """Emit every group whose quiet period the watermark passed."""
+        emitted: list[FleetIncident] = []
+        for key in list(self._groups):
+            for group in list(self._groups.get(key, ())):
+                if group.last_ns + self.gap_ns <= watermark_ns:
+                    emitted.extend(self._emit(key, group))
+        return emitted
+
+    def flush(self) -> list[FleetIncident]:
+        """Emit every open group (end of stream / drain path)."""
+        emitted: list[FleetIncident] = []
+        for key in list(self._groups):
+            for group in list(self._groups.get(key, ())):
+                emitted.extend(self._emit(key, group))
+        return emitted
+
+    def open_groups(self) -> int:
+        return sum(len(sessions) for sessions in self._groups.values())
+
+    # ---- emission -----------------------------------------------------
+
+    def _emit(
+        self, key: tuple[str, str], group: _Group
+    ) -> list[FleetIncident]:
+        sessions = self._groups.get(key)
+        if sessions is not None:
+            try:
+                sessions.remove(group)
+            except ValueError:
+                pass
+            if not sessions:
+                del self._groups[key]
+        members = sorted(
+            group.members.values(), key=lambda m: (m.node, m.pod)
+        )
+        if not members:
+            return []
+        # Failover replay rebuilt a group already paged: suppress.  A
+        # re-homed close can shift the earliest member by one window,
+        # so the match is gap-tolerant window overlap per (namespace,
+        # domain), not an exact id — two windows within gap_ns would
+        # have merged into one group had a single aggregator seen both.
+        emitted_key = (group.namespace, group.domain)
+        for rec_start, rec_end in self._emitted_windows.get(
+            emitted_key, ()
+        ):
+            if (
+                group.start_ns <= rec_end + self.gap_ns
+                and group.last_ns >= rec_start - self.gap_ns
+            ):
+                self.duplicates_suppressed += 1
+                return []
+        self._emitted_windows.setdefault(emitted_key, []).append(
+            (group.start_ns, group.last_ns)
+        )
+        incident_id = (
+            f"fleet-{group.namespace}-{group.domain}-{group.start_ns}"
+        )
+        incident = FleetIncident(
+            incident_id=incident_id,
+            namespace=group.namespace,
+            domain=group.domain,
+            blast_radius=classify_blast_radius(members),
+            window_start_ns=group.start_ns,
+            window_end_ns=group.last_ns,
+            confidence=max(m.confidence for m in members),
+            nodes=sorted({m.node for m in members}),
+            slices=sorted({m.slice_id for m in members if m.slice_id}),
+            members=[m.member_dict() for m in members],
+        )
+        self.incidents_emitted += 1
+        if self._on_incident is not None:
+            self._on_incident(incident)
+        return [incident]
+
+    # ---- failover snapshot -------------------------------------------
+
+    def export_state(self) -> dict[str, Any]:
+        return {
+            "gap_ns": self.gap_ns,
+            "emitted_windows": [
+                [ns, domain, start, end]
+                for (ns, domain), windows in sorted(
+                    self._emitted_windows.items()
+                )
+                for start, end in windows
+            ],
+            "incidents_emitted": self.incidents_emitted,
+            "groups": [
+                {
+                    "namespace": g.namespace,
+                    "domain": g.domain,
+                    "start_ns": g.start_ns,
+                    "last_ns": g.last_ns,
+                    "members": [
+                        {
+                            "node": m.node,
+                            "pod": m.pod,
+                            "namespace": m.namespace,
+                            "slice_id": m.slice_id,
+                            "domain": m.domain,
+                            "confidence": m.confidence,
+                            "ts_unix_nano": m.ts_unix_nano,
+                            "tier": m.tier,
+                        }
+                        for m in g.members.values()
+                    ],
+                }
+                for sessions in self._groups.values()
+                for g in sessions
+            ],
+        }
+
+    def restore_state(self, state: dict[str, Any]) -> None:
+        self.gap_ns = int(state.get("gap_ns", self.gap_ns))
+        self._emitted_windows = {}
+        for ns, domain, start, end in state.get("emitted_windows") or []:
+            self._emitted_windows.setdefault(
+                (str(ns), str(domain)), []
+            ).append((int(start), int(end)))
+        self.incidents_emitted = int(state.get("incidents_emitted", 0))
+        self._groups = {}
+        for raw in state.get("groups") or []:
+            members = [
+                NodeIncident(
+                    node=str(m["node"]),
+                    pod=str(m["pod"]),
+                    namespace=str(m["namespace"]),
+                    slice_id=str(m["slice_id"]),
+                    domain=str(m["domain"]),
+                    confidence=float(m["confidence"]),
+                    ts_unix_nano=int(m["ts_unix_nano"]),
+                    tier=str(m.get("tier", "node_window")),
+                )
+                for m in raw.get("members") or []
+            ]
+            group = _Group(
+                namespace=str(raw["namespace"]),
+                domain=str(raw["domain"]),
+                start_ns=int(raw["start_ns"]),
+                last_ns=int(raw["last_ns"]),
+                members={
+                    f"{m.node}/{m.pod}": m for m in members
+                },
+            )
+            self._groups.setdefault(
+                (group.namespace, group.domain), []
+            ).append(group)

@@ -1,0 +1,724 @@
+"""Seeded 1k-node fleet simulator driving the observability plane.
+
+Two lanes, one topology:
+
+* **Correctness lane** (:meth:`FleetSimulator.run`) — every node runs a
+  real node-agent pipeline in miniature: per-round probe-event dicts
+  (heartbeats from healthy pods, full fault profiles from pods inside
+  an injection's blast scope), perturbed by a per-host seeded
+  :class:`~tpuslo.chaos.telemetry.ChaosStream`, gated by the node's own
+  :class:`~tpuslo.columnar.gate.ColumnarGate` (``admit_payloads`` — the
+  same quarantine/dedup/watermark semantics the agent runs), then
+  shipped over the wire contract to the shard the hash ring assigns.
+  Shards attribute closed windows; the rollup collapses node incidents
+  into fleet pages, which the sweep scores against the injected ground
+  truth.  Mid-run shard failover (kill + ring re-home + snapshot
+  restore + spool re-send) runs through the PR 4 StateStore.
+
+* **Throughput lane** (:meth:`FleetSimulator.measure_ingest`) — wire
+  shipments are minted by cloning one columnar template per node
+  (pool-swap for node/slice identity, fresh bytes only for the shifted
+  timestamp column), so generation cost cannot mask the number under
+  test: the shards' decode → merge → gate → fold path.  Aggregate
+  throughput is total events over the *slowest shard's* busy time —
+  the wall time a parallel deployment would see; shards here run
+  sequentially on one process.
+
+Everything is seeded: topology, injection plan, chaos, and shard
+placement replay bit-identically for a given seed.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable
+
+import numpy as np
+
+from tpuslo.attribution.mapper import map_fault_label
+from tpuslo.chaos.telemetry import ChaosScenario, ChaosStream
+from tpuslo.columnar.gate import ColumnarGate
+from tpuslo.fleet.aggregator import AggregatorShard, FleetObserver
+from tpuslo.fleet.ring import HashRing
+from tpuslo.fleet.rollup import (
+    BLAST_FLEET,
+    BLAST_NODE,
+    BLAST_POD,
+    BLAST_RADII,
+    BLAST_SLICE,
+    FleetIncident,
+    FleetRollup,
+)
+from tpuslo.fleet.wire import encode_shipment
+from tpuslo.ingest.gate import GateConfig
+from tpuslo.signals.constants import TPU_SIGNALS
+from tpuslo.signals.generator import (
+    SIGNAL_UNITS,
+    profile_for_fault,
+    signal_status,
+)
+
+#: Fixed simulation epoch (2026-01-01T00:00:00Z) — deterministic runs.
+EPOCH_NS = 1_767_225_600_000_000_000
+
+#: Heartbeat signal healthy pods emit each round: advances the node's
+#: head/watermark without accumulating attributable evidence (a single
+#: baseline reading attributes far below the incident floor).
+HEARTBEAT_SIGNAL = "runqueue_delay_ms"
+
+
+@dataclass(frozen=True)
+class FleetTopology:
+    """Node/slice/pod/tenant layout of the simulated fleet."""
+
+    nodes: int = 1000
+    nodes_per_slice: int = 64
+    pods_per_node: int = 4
+    tenants: tuple[str, ...] = ("tenant-a", "tenant-b")
+
+    @classmethod
+    def for_nodes(cls, nodes: int) -> "FleetTopology":
+        """Sweep/bench sizing: keep >= 4 slices even on small smoke
+        fleets so a fleet-scope injection can genuinely span slices.
+        One formula shared by the gate and the bench — they must
+        measure the same topology."""
+        return cls(
+            nodes=nodes, nodes_per_slice=min(64, max(2, nodes // 4))
+        )
+
+    def node_name(self, i: int) -> str:
+        return f"node-{i:04d}"
+
+    def slice_index(self, i: int) -> int:
+        return i // self.nodes_per_slice
+
+    def slice_name(self, i: int) -> str:
+        return f"slice-{self.slice_index(i):03d}"
+
+    def slices(self) -> int:
+        return (self.nodes + self.nodes_per_slice - 1) // self.nodes_per_slice
+
+    def pod_name(self, node_i: int, pod_j: int) -> str:
+        return f"{self.node_name(node_i)}-pod-{pod_j}"
+
+    def tenant_of(self, pod_j: int) -> str:
+        return self.tenants[pod_j % len(self.tenants)]
+
+    def tenant_pods(self, tenant: str) -> list[int]:
+        return [
+            j
+            for j in range(self.pods_per_node)
+            if self.tenant_of(j) == tenant
+        ]
+
+    def ring_keys(self) -> list[tuple[str, str]]:
+        return [
+            (self.node_name(i), self.slice_name(i))
+            for i in range(self.nodes)
+        ]
+
+
+@dataclass(frozen=True)
+class FaultInjection:
+    """One scripted fleet fault with its expected page."""
+
+    name: str
+    label: str
+    namespace: str
+    scope: str  # pod | node | slice | fleet
+    at_round: int
+    duration_rounds: int = 2
+    #: pod scope: (node index, pod index); node scope: node index;
+    #: slice scope: slice index; fleet scope: tuple of slice indexes.
+    target: Any = 0
+
+    @property
+    def domain(self) -> str:
+        return map_fault_label(self.label)
+
+    def expected_blast_radius(self) -> str:
+        return {
+            "pod": BLAST_POD,
+            "node": BLAST_NODE,
+            "slice": BLAST_SLICE,
+            "fleet": BLAST_FLEET,
+        }[self.scope]
+
+    def affected(
+        self, topology: FleetTopology
+    ) -> list[tuple[int, int]]:
+        """(node index, pod index) pairs inside the blast scope."""
+        tenant_pods = topology.tenant_pods(self.namespace)
+        if self.scope == "pod":
+            node_i, pod_j = self.target
+            return [(node_i, pod_j)]
+        if self.scope == "node":
+            return [(self.target, j) for j in tenant_pods]
+        if self.scope == "slice":
+            lo = self.target * topology.nodes_per_slice
+            hi = min(topology.nodes, lo + topology.nodes_per_slice)
+            return [(i, j) for i in range(lo, hi) for j in tenant_pods]
+        if self.scope == "fleet":
+            out = []
+            for slice_i in self.target:
+                lo = slice_i * topology.nodes_per_slice
+                hi = min(topology.nodes, lo + topology.nodes_per_slice)
+                out.extend(
+                    (i, j) for i in range(lo, hi) for j in tenant_pods
+                )
+            return out
+        raise ValueError(f"unknown scope {self.scope!r}")
+
+
+def default_injection_plan(
+    topology: FleetTopology, start_round: int = 3
+) -> list[FaultInjection]:
+    """The canonical sweep plan: one fault per blast radius, plus the
+    two merges that must NOT happen (cross-tenant and cross-domain
+    concurrency probes).
+
+    Distinct (namespace, domain) pairs throughout, so the ground truth
+    is exactly one fleet incident per injection.
+    """
+    t_a, t_b = topology.tenants[0], topology.tenants[1]
+    slices = topology.slices()
+    r = start_round
+    plan = [
+        FaultInjection(
+            name="pod-cpu", label="cpu_throttle", namespace=t_a,
+            scope="pod", at_round=r,
+            target=(1 % topology.nodes, topology.tenant_pods(t_a)[0]),
+        ),
+        FaultInjection(
+            name="node-mem", label="memory_pressure", namespace=t_b,
+            scope="node", at_round=r + 3,
+            target=2 % topology.nodes,
+        ),
+        FaultInjection(
+            name="slice-ici", label="ici_drop", namespace=t_a,
+            scope="slice", at_round=r + 6, target=0,
+        ),
+        FaultInjection(
+            name="fleet-hbm", label="hbm_pressure", namespace=t_b,
+            scope="fleet", at_round=r + 9,
+            target=tuple(range(min(3, slices))),
+        ),
+        # Cross-tenant probe: same domain, same instant, two tenants —
+        # exactly two pages or the rollup is merging across tenants.
+        FaultInjection(
+            name="xt-dns-a", label="dns_latency", namespace=t_a,
+            scope="node", at_round=r + 12, target=3 % topology.nodes,
+        ),
+        FaultInjection(
+            name="xt-dns-b", label="dns_latency", namespace=t_b,
+            scope="node", at_round=r + 12, target=4 % topology.nodes,
+        ),
+        # Cross-domain probe: same tenant, same instant, two domains.
+        FaultInjection(
+            name="xd-xla", label="xla_recompile_storm", namespace=t_a,
+            scope="node", at_round=r + 15, target=5 % topology.nodes,
+        ),
+        FaultInjection(
+            name="xd-dcn", label="dcn_degradation", namespace=t_a,
+            scope="node", at_round=r + 15, target=6 % topology.nodes,
+        ),
+    ]
+    return plan
+
+
+@dataclass
+class FleetRunResult:
+    """Outcome of one correctness-lane run."""
+
+    incidents: list[FleetIncident]
+    injections: list[FaultInjection]
+    rounds: int
+    shard_snapshots: dict[str, dict[str, Any]] = field(
+        default_factory=dict
+    )
+    rollup_duplicates_suppressed: int = 0
+    failover: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class IngestMeasurement:
+    """Outcome of one throughput-lane run."""
+
+    nodes: int
+    shards: int
+    total_events: int
+    admitted_events: int
+    events_per_sec: float
+    per_shard_events_per_sec: dict[str, float]
+    rollup_latency_ms: float
+    node_incidents: int
+
+
+class FleetSimulator:
+    """Seeded fleet: topology + ring + shards + rollup in one box."""
+
+    def __init__(
+        self,
+        topology: FleetTopology,
+        shard_ids: Iterable[str] = ("agg-0", "agg-1", "agg-2", "agg-3"),
+        seed: int = 1337,
+        chaos_intensity: float = 0.0,
+        round_s: float = 1.0,
+        window_ns: int = 2_000_000_000,
+        rollup_gap_ns: int = 5_000_000_000,
+        observer: FleetObserver | None = None,
+        node_dedup_window: int = 4096,
+        shard_gate_config: GateConfig | None = None,
+    ):
+        self.topology = topology
+        self.seed = seed
+        self.chaos_intensity = chaos_intensity
+        self.round_ns = int(round_s * 1e9)
+        self.window_ns = window_ns
+        self.observer = observer or FleetObserver()
+        self.ring = HashRing(shard_ids)
+        self.shards: dict[str, AggregatorShard] = {
+            sid: AggregatorShard(
+                sid,
+                gate_config=shard_gate_config,
+                window_ns=window_ns,
+                observer=self.observer,
+            )
+            for sid in shard_ids
+        }
+        self.rollup = FleetRollup(gap_ns=rollup_gap_ns)
+        self.incidents: list[FleetIncident] = []
+        self._node_gates: dict[str, ColumnarGate] = {}
+        self._node_chaos: dict[str, ChaosStream] = {}
+        self._node_seq: dict[str, int] = {}
+        #: Per-node shipment retention (the agent-side delivery spool):
+        #: re-sent after a shard failover for at-least-once delivery.
+        self._node_spool: dict[str, list[dict[str, Any]]] = {}
+        self._node_dedup_window = node_dedup_window
+        self._assignment = self.ring.assignments(topology.ring_keys())
+
+    # ---- node-agent plumbing -----------------------------------------
+
+    def _gate_for(self, node: str) -> ColumnarGate:
+        gate = self._node_gates.get(node)
+        if gate is None:
+            gate = ColumnarGate(
+                GateConfig(
+                    dedup_window=self._node_dedup_window,
+                    watermark_lateness_ms=2000,
+                )
+            )
+            self._node_gates[node] = gate
+        return gate
+
+    def _chaos_for(self, node: str, node_i: int) -> ChaosStream | None:
+        if self.chaos_intensity <= 0:
+            return None
+        chaos = self._node_chaos.get(node)
+        if chaos is None:
+            chaos = ChaosStream(
+                ChaosScenario.at_intensity(
+                    self.chaos_intensity, seed=self.seed + node_i
+                )
+            )
+            self._node_chaos[node] = chaos
+        return chaos
+
+    def _events_for_round(
+        self,
+        node_i: int,
+        round_i: int,
+        active: dict[tuple[int, int], FaultInjection],
+    ) -> list[dict[str, Any]]:
+        topo = self.topology
+        node = topo.node_name(node_i)
+        slice_id = topo.slice_name(node_i)
+        ts = EPOCH_NS + round_i * self.round_ns + (node_i % 997) * 1000
+        out: list[dict[str, Any]] = []
+        for pod_j in range(topo.pods_per_node):
+            pod = topo.pod_name(node_i, pod_j)
+            namespace = topo.tenant_of(pod_j)
+            injection = active.get((node_i, pod_j))
+            if injection is None:
+                value = 4.0
+                out.append(
+                    {
+                        "ts_unix_nano": ts + pod_j,
+                        "signal": HEARTBEAT_SIGNAL,
+                        "node": node,
+                        "namespace": namespace,
+                        "pod": pod,
+                        "container": "workload",
+                        "pid": 100 + pod_j,
+                        "tid": 100 + pod_j,
+                        "value": value,
+                        "unit": SIGNAL_UNITS[HEARTBEAT_SIGNAL],
+                        "status": signal_status(HEARTBEAT_SIGNAL, value),
+                    }
+                )
+                continue
+            profile = profile_for_fault(injection.label)
+            for k, (signal, value) in enumerate(sorted(profile.items())):
+                event: dict[str, Any] = {
+                    "ts_unix_nano": ts + pod_j * 100 + k,
+                    "signal": signal,
+                    "node": node,
+                    "namespace": namespace,
+                    "pod": pod,
+                    "container": "workload",
+                    "pid": 100 + pod_j,
+                    "tid": 100 + pod_j,
+                    "value": float(value),
+                    "unit": SIGNAL_UNITS.get(signal, "ms"),
+                    "status": signal_status(signal, float(value)),
+                }
+                if signal in TPU_SIGNALS:
+                    event["tpu"] = {
+                        "slice_id": slice_id,
+                        "host_index": node_i % topo.nodes_per_slice,
+                    }
+                out.append(event)
+        return out
+
+    def _ship(self, node_i: int, events: list[dict[str, Any]]) -> None:
+        """One node-agent cycle: chaos → gate → wire → shard."""
+        topo = self.topology
+        node = topo.node_name(node_i)
+        chaos = self._chaos_for(node, node_i)
+        if chaos is not None:
+            events = list(chaos.stream(events))
+        gate = self._gate_for(node)
+        result = gate.admit_payloads(events)
+        for part in (result.admitted, result.late):
+            if not len(part):
+                continue
+            seq = self._node_seq.get(node, -1) + 1
+            self._node_seq[node] = seq
+            payload = encode_shipment(
+                part, node, seq, slice_id=topo.slice_name(node_i)
+            )
+            self._node_spool.setdefault(node, []).append(payload)
+            self.shards[self._assignment[node]].ingest(payload)
+
+    # ---- watermarks + rollup ------------------------------------------
+
+    def fleet_watermark_ns(self) -> int:
+        marks = [
+            s.watermark_ns()
+            for s in self.shards.values()
+            if s.nodes
+        ]
+        return min(marks) if marks else 0
+
+    def _pump_rollup(self, flush: bool = False) -> None:
+        for shard in self.shards.values():
+            node_incidents = shard.close_windows(flush=flush)
+            self.incidents.extend(self.rollup.observe(node_incidents))
+        watermark = self.fleet_watermark_ns()
+        if flush:
+            self.incidents.extend(self.rollup.flush())
+        elif watermark:
+            self.incidents.extend(self.rollup.close_up_to(watermark))
+        # "Open" = emitted and not yet quiet for a full rollup gap
+        # past the fleet watermark; every radius is set each pump so a
+        # radius whose last incident resolved drops back to 0 instead
+        # of the gauge accumulating all incidents ever emitted.
+        open_by_radius: dict[str, int] = {r: 0 for r in BLAST_RADII}
+        for incident in self.incidents:
+            if (
+                watermark
+                and incident.window_end_ns + self.rollup.gap_ns
+                <= watermark
+            ):
+                continue  # resolved: quiet period passed fleet-wide
+            open_by_radius[incident.blast_radius] += 1
+        for radius, count in open_by_radius.items():
+            self.observer.incidents_open(radius, count)
+        reporting = stale = 0
+        for shard in self.shards.values():
+            r, s = shard.reporting_and_stale()
+            reporting += r
+            stale += s
+        self.observer.nodes(reporting, stale)
+
+    # ---- failover ------------------------------------------------------
+
+    def kill_shard(
+        self,
+        shard_id: str,
+        exported: dict[str, Any] | None = None,
+    ) -> dict[str, Any]:
+        """Kill one aggregator and re-home its nodes via the ring.
+
+        ``exported`` is the dead shard's last durable snapshot (from
+        the PR 4 StateStore); when None, the live state is used — the
+        sweep passes the *stale* snapshot plus spool re-sends to prove
+        the at-least-once path.  Returns a failover report.
+        """
+        if shard_id not in self.shards:
+            raise ValueError(f"unknown shard {shard_id!r}")
+        dead = self.shards.pop(shard_id)
+        state = exported if exported is not None else dead.export_state()
+        # Nodes the dead shard owned per the PRE-kill assignment: a
+        # node whose first shipment landed after the last durable
+        # snapshot has spool entries but no snapshot fragment, and its
+        # events would silently vanish if re-homing iterated only the
+        # snapshot's node set.
+        dead_nodes = {
+            node
+            for node, sid in self._assignment.items()
+            if sid == shard_id
+        }
+        self.ring.remove_shard(shard_id)
+        self.observer.rebalance()
+        topo = self.topology
+        self._assignment = self.ring.assignments(topo.ring_keys())
+        rehomed = 0
+        resent = 0
+        node_fragments = state.get("nodes") or {}
+        for node in sorted(dead_nodes | set(node_fragments)):
+            target = self._assignment.get(node)
+            if target is None:
+                continue
+            new_owner = self.shards[target]
+            fragment = node_fragments.get(node)
+            snap_seq = -1
+            if fragment is not None:
+                new_owner.absorb_node_state(node, fragment)
+                rehomed += 1
+                snap_seq = int(fragment.get("seq", -1))
+            # At-least-once: the agent-side spool re-sends everything
+            # past the snapshot's sequence point (the WHOLE spool for
+            # a node the snapshot never saw); the new owner's seq
+            # check and max-fold make the overlap harmless.
+            for payload in self._node_spool.get(node, []):
+                if payload["seq"] > snap_seq:
+                    new_owner.ingest(payload)
+                    resent += 1
+        return {
+            "killed": shard_id,
+            "rehomed_nodes": rehomed,
+            "resent_shipments": resent,
+            "ring_rebalances": self.ring.rebalances,
+        }
+
+    # ---- correctness lane ---------------------------------------------
+
+    def run(
+        self,
+        rounds: int,
+        injections: list[FaultInjection],
+        kill: tuple[int, str] | None = None,
+        runtime=None,
+        log: Callable[[str], None] | None = None,
+    ) -> FleetRunResult:
+        """Drive the fleet for ``rounds``; optionally kill a shard.
+
+        ``kill=(round, shard_id)`` SIGKILLs the shard after that
+        round's shipments: its object is dropped (nothing in-memory
+        survives), the last durable snapshot restores node fragments
+        into the ring's new owners, and the node spools re-send.
+        ``runtime`` is an :class:`~tpuslo.runtime.AgentRuntime`; when
+        provided, shard/ring/rollup state snapshots through it each
+        round exactly like the agent's own components.
+        """
+        topo = self.topology
+        failover: dict[str, Any] = {}
+        last_snapshot: dict[str, Any] = {}
+        if runtime is not None:
+            for sid, shard in self.shards.items():
+                runtime.register(
+                    f"fleet/{sid}",
+                    shard.export_state,
+                    shard.restore_state,
+                )
+            runtime.register(
+                "fleet/ring",
+                self.ring.export_state,
+                self.ring.restore_state,
+            )
+            runtime.register(
+                "fleet/rollup",
+                self.rollup.export_state,
+                self.rollup.restore_state,
+            )
+        for round_i in range(rounds):
+            # Snapshot BEFORE the round ships: the durable state a real
+            # crash would restore always lags the stream, so a kill
+            # must exercise the spool re-send path, not ride a
+            # conveniently fresh snapshot.
+            if runtime is not None:
+                components = runtime.export_components()
+                last_snapshot = components
+                runtime.snapshot_now()
+            active: dict[tuple[int, int], FaultInjection] = {}
+            for injection in injections:
+                if (
+                    injection.at_round
+                    <= round_i
+                    < injection.at_round + injection.duration_rounds
+                ):
+                    for pair in injection.affected(topo):
+                        active[pair] = injection
+            for node_i in range(topo.nodes):
+                self._ship(node_i, self._events_for_round(
+                    node_i, round_i, active
+                ))
+            if kill is not None and round_i == kill[0]:
+                shard_id = kill[1]
+                exported = (
+                    last_snapshot.get(f"fleet/{shard_id}")
+                    if last_snapshot
+                    else None
+                )
+                failover = self.kill_shard(shard_id, exported)
+                if runtime is not None:
+                    # The dead shard's nodes re-homed via the ring;
+                    # snapshots must stop carrying its stale fragments.
+                    runtime.deregister(f"fleet/{shard_id}")
+                if log:
+                    log(
+                        f"failover: killed {shard_id}, re-homed "
+                        f"{failover['rehomed_nodes']} nodes, re-sent "
+                        f"{failover['resent_shipments']} shipments"
+                    )
+            self._pump_rollup()
+        self._pump_rollup(flush=True)
+        return FleetRunResult(
+            incidents=list(self.incidents),
+            injections=list(injections),
+            rounds=rounds,
+            shard_snapshots={
+                sid: s.snapshot() for sid, s in self.shards.items()
+            },
+            rollup_duplicates_suppressed=(
+                self.rollup.duplicates_suppressed
+            ),
+            failover=failover,
+        )
+
+    # ---- throughput lane ----------------------------------------------
+
+    def build_node_payloads(
+        self, events_per_node: int
+    ) -> list[dict[str, Any]]:
+        """One binary-transport shipment per node, template-cloned.
+
+        The per-signal template batch is built once
+        (``columns_from_samples`` over synthetic samples); each node's
+        shipment reuses the template's column buffers verbatim except
+        the timestamp column (shifted per node) and the pool entries
+        carrying node/pod/slice identity.  Generation is thus ~free
+        and the measurement isolates the aggregator path.
+        """
+        from datetime import datetime, timedelta, timezone
+
+        from tpuslo.collector.synthetic import RawSample
+        from tpuslo.columnar.generate import columns_from_samples
+        from tpuslo.signals import constants as sig
+        from tpuslo.signals.metadata import Metadata
+
+        topo = self.topology
+        n_signals = len(sig.ALL_SIGNALS)
+        n_samples = max(1, events_per_node // n_signals)
+        start = datetime(2026, 1, 1, tzinfo=timezone.utc)
+        samples = [
+            RawSample(
+                timestamp=start + timedelta(milliseconds=i),
+                cluster="fleet",
+                namespace=topo.tenants[0],
+                workload="serving",
+                service="chat",
+                request_id=f"req-{i}",
+                trace_id=f"trace-{i}",
+                ttft_ms=100.0,
+                request_latency_ms=200.0,
+                token_throughput_tps=10.0,
+                error_rate=0.0,
+                fault_label="none",
+            )
+            for i in range(n_samples)
+        ]
+        meta = Metadata(
+            node="node-template",
+            namespace=topo.tenants[0],
+            pod="pod-template",
+            container="workload",
+            pid=1,
+            tid=1,
+            slice_id="slice-template",
+            host_index=0,
+        )
+        template = columns_from_samples(samples, meta, sig.ALL_SIGNALS)
+        base = encode_shipment(template, "node-template", 0)
+        # Pure lookups — the template metadata interned these already.
+        node_code = template.pool.intern("node-template")
+        pod_code = template.pool.intern("pod-template")
+        slice_code = template.pool.intern("slice-template")
+        ts_arr = template.columns["ts_unix_nano"]
+        payloads: list[dict[str, Any]] = []
+        for i in range(topo.nodes):
+            node = topo.node_name(i)
+            pool = list(base["pool"])
+            pool[node_code] = node
+            pool[pod_code] = topo.pod_name(i, 0)
+            pool[slice_code] = topo.slice_name(i)
+            columns = dict(base["columns"])
+            shifted = ts_arr + np.int64(i * 1_000)
+            columns["ts_unix_nano"] = shifted.tobytes()
+            payload = dict(base)
+            payload["node"] = node
+            payload["seq"] = 0
+            payload["head_ns"] = int(shifted[-1])
+            payload["slice_id"] = topo.slice_name(i)
+            payload["pool"] = pool
+            payload["columns"] = columns
+            payloads.append(payload)
+        return payloads
+
+    def measure_ingest(
+        self, events_per_node: int = 6000
+    ) -> IngestMeasurement:
+        """Drive one shipment per node; report aggregate throughput."""
+        payloads = self.build_node_payloads(events_per_node)
+        topo = self.topology
+        total = 0
+        for i, payload in enumerate(payloads):
+            shard = self.shards[self._assignment[topo.node_name(i)]]
+            shard.ingest(payload)
+            total += payload["events"]
+        # Final coalesce drain belongs to the measured path.
+        for shard in self.shards.values():
+            t0 = time.perf_counter_ns()
+            shard._drain()
+            shard.busy_ns += time.perf_counter_ns() - t0
+        busiest = max(s.busy_ns for s in self.shards.values())
+        per_shard = {
+            sid: (
+                s.ingested_events / (s.busy_ns / 1e9)
+                if s.busy_ns
+                else 0.0
+            )
+            for sid, s in self.shards.items()
+        }
+        t0 = time.perf_counter_ns()
+        groups = 0
+        for shard in self.shards.values():
+            node_incidents = shard.close_windows(flush=True)
+            groups += len(node_incidents)
+            self.incidents.extend(self.rollup.observe(node_incidents))
+        self.incidents.extend(self.rollup.flush())
+        rollup_ms = (time.perf_counter_ns() - t0) / 1e6
+        self.observer.rollup_latency_ms(rollup_ms)
+        admitted = sum(s.admitted_events for s in self.shards.values())
+        return IngestMeasurement(
+            nodes=topo.nodes,
+            shards=len(self.shards),
+            total_events=total,
+            admitted_events=admitted,
+            events_per_sec=total / (busiest / 1e9) if busiest else 0.0,
+            per_shard_events_per_sec=per_shard,
+            rollup_latency_ms=rollup_ms,
+            node_incidents=groups,
+        )

@@ -1,0 +1,357 @@
+"""Fleet-sweep release gate: throughput, page dedup, rollup, failover.
+
+Four contracts, one seeded run (``tpuslo m5gate --fleet-sweep``):
+
+1. **Aggregate ingest throughput** — 1k simulated nodes over 4 shards
+   must sustain the floor (default ≥ 5M events/s) on the columnar
+   path, measured as total events over the slowest shard's busy time.
+2. **Page-dedup correctness** — every injected fleet fault yields
+   exactly one incident at the correct blast radius (precision and
+   recall 1.0 against the seeded plan); the cross-tenant and
+   cross-domain concurrency probes must NOT merge.
+3. **Rollup macro-F1** — per-domain F1 of the rolled-up incident
+   domains against the injected ground truth.
+4. **Shard failover** — the chaos run repeats with one aggregator
+   killed mid-sweep (state restored from its PR 4 StateStore snapshot,
+   nodes re-homed via the hash ring, agent spools re-sent): the
+   incident set must equal the unkilled run's exactly — zero lost,
+   zero duplicated.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from tpuslo.fleet.rollup import FleetIncident
+from tpuslo.fleet.simulator import (
+    FaultInjection,
+    FleetSimulator,
+    FleetTopology,
+    default_injection_plan,
+)
+
+
+@dataclass
+class IncidentMatch:
+    """One injection scored against the rolled-up incident set."""
+
+    injection: str
+    domain: str
+    namespace: str
+    expected_blast_radius: str
+    matched_incident: str = ""
+    matched_blast_radius: str = ""
+    matched_count: int = 0
+
+    @property
+    def exact(self) -> bool:
+        return (
+            self.matched_count == 1
+            and self.matched_blast_radius == self.expected_blast_radius
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "injection": self.injection,
+            "domain": self.domain,
+            "namespace": self.namespace,
+            "expected_blast_radius": self.expected_blast_radius,
+            "matched_incident": self.matched_incident,
+            "matched_blast_radius": self.matched_blast_radius,
+            "matched_count": self.matched_count,
+            "exact": self.exact,
+        }
+
+
+def score_incidents(
+    injections: list[FaultInjection],
+    incidents: list[FleetIncident],
+) -> tuple[list[IncidentMatch], float, float, float]:
+    """(matches, precision, recall, macro_f1) vs the injected truth.
+
+    An incident matches an injection on (namespace, domain); precision
+    counts spurious incidents, recall counts missed injections, and a
+    split fault (two incidents for one injection) fails both via
+    ``matched_count``.
+    """
+    matches: list[IncidentMatch] = []
+    claimed: set[str] = set()
+    for injection in injections:
+        hits = [
+            inc
+            for inc in incidents
+            if inc.namespace == injection.namespace
+            and inc.domain == injection.domain
+        ]
+        match = IncidentMatch(
+            injection=injection.name,
+            domain=injection.domain,
+            namespace=injection.namespace,
+            expected_blast_radius=injection.expected_blast_radius(),
+            matched_count=len(hits),
+        )
+        if hits:
+            best = max(hits, key=lambda i: i.confidence)
+            match.matched_incident = best.incident_id
+            match.matched_blast_radius = best.blast_radius
+            claimed.update(i.incident_id for i in hits)
+        matches.append(match)
+    true_pos = sum(1 for m in matches if m.exact)
+    spurious = [
+        inc for inc in incidents if inc.incident_id not in claimed
+    ]
+    split_extras = sum(
+        max(0, m.matched_count - 1) for m in matches
+    )
+    predicted = true_pos + len(spurious) + split_extras + sum(
+        1 for m in matches if m.matched_count >= 1 and not m.exact
+    )
+    precision = true_pos / predicted if predicted else 0.0
+    recall = true_pos / len(matches) if matches else 0.0
+
+    # Per-domain F1 over the injected domains (macro average).
+    domains = sorted({m.domain for m in matches})
+    f1s = []
+    for domain in domains:
+        tp = sum(1 for m in matches if m.domain == domain and m.exact)
+        fn = sum(
+            1 for m in matches if m.domain == domain and not m.exact
+        )
+        fp = sum(1 for i in spurious if i.domain == domain)
+        p = tp / (tp + fp) if tp + fp else 0.0
+        r = tp / (tp + fn) if tp + fn else 0.0
+        f1s.append(2 * p * r / (p + r) if p + r else 0.0)
+    macro_f1 = sum(f1s) / len(f1s) if f1s else 0.0
+    return matches, precision, recall, macro_f1
+
+
+@dataclass
+class FleetSweepReport:
+    """Gate verdict for one fleet sweep."""
+
+    nodes: int
+    shards: int
+    seed: int
+    chaos_intensity: float
+    events_per_node: int
+    min_ingest_events_per_sec: float
+    max_rollup_latency_ms: float
+    ingest_events_per_sec: float = 0.0
+    per_shard_events_per_sec: dict[str, float] = field(
+        default_factory=dict
+    )
+    rollup_latency_ms: float = 0.0
+    matches: list[IncidentMatch] = field(default_factory=list)
+    incidents: list[dict[str, Any]] = field(default_factory=list)
+    precision: float = 0.0
+    recall: float = 0.0
+    macro_f1: float = 0.0
+    failover: dict[str, Any] = field(default_factory=dict)
+    failover_lost: list[str] = field(default_factory=list)
+    failover_duplicated: list[str] = field(default_factory=list)
+    failures: list[str] = field(default_factory=list)
+
+    @property
+    def passed(self) -> bool:
+        return not self.failures
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "nodes": self.nodes,
+            "shards": self.shards,
+            "seed": self.seed,
+            "chaos_intensity": self.chaos_intensity,
+            "events_per_node": self.events_per_node,
+            "min_ingest_events_per_sec": self.min_ingest_events_per_sec,
+            "max_rollup_latency_ms": self.max_rollup_latency_ms,
+            "ingest_events_per_sec": round(
+                self.ingest_events_per_sec
+            ),
+            "per_shard_events_per_sec": {
+                k: round(v)
+                for k, v in self.per_shard_events_per_sec.items()
+            },
+            "rollup_latency_ms": round(self.rollup_latency_ms, 3),
+            "matches": [m.to_dict() for m in self.matches],
+            "incidents": list(self.incidents),
+            "precision": round(self.precision, 4),
+            "recall": round(self.recall, 4),
+            "macro_f1": round(self.macro_f1, 4),
+            "failover": dict(self.failover),
+            "failover_lost": list(self.failover_lost),
+            "failover_duplicated": list(self.failover_duplicated),
+            "passed": self.passed,
+            "failures": list(self.failures),
+        }
+
+
+def _incident_keys(incidents: list[FleetIncident]) -> list[str]:
+    """Failover-comparable identity: the id minus its window-start
+    suffix (a re-homed window can legitimately re-bucket by one
+    window; the page identity is (namespace, domain))."""
+    return sorted(
+        f"{i.namespace}/{i.domain}/{i.blast_radius}" for i in incidents
+    )
+
+
+def run_fleet_sweep(
+    nodes: int = 1000,
+    shards: int = 4,
+    seed: int = 1337,
+    chaos_intensity: float = 1.0,
+    events_per_node: int = 6000,
+    rounds: int = 24,
+    kill_shard: bool = True,
+    min_ingest_events_per_sec: float = 5_000_000.0,
+    max_rollup_latency_ms: float = 2_000.0,
+    state_dir: str | None = None,
+    observer=None,
+    log: Callable[[str], None] | None = None,
+) -> FleetSweepReport:
+    """Run all four fleet contracts; deterministic for a given seed."""
+    shard_ids = [f"agg-{i}" for i in range(shards)]
+    topology = FleetTopology.for_nodes(nodes)
+    report = FleetSweepReport(
+        nodes=nodes,
+        shards=shards,
+        seed=seed,
+        chaos_intensity=chaos_intensity,
+        events_per_node=events_per_node,
+        min_ingest_events_per_sec=min_ingest_events_per_sec,
+        max_rollup_latency_ms=max_rollup_latency_ms,
+    )
+
+    # ---- phase 1: aggregate ingest throughput -------------------------
+    sim = FleetSimulator(
+        topology, shard_ids, seed=seed, observer=observer
+    )
+    measurement = sim.measure_ingest(events_per_node)
+    report.ingest_events_per_sec = measurement.events_per_sec
+    report.per_shard_events_per_sec = (
+        measurement.per_shard_events_per_sec
+    )
+    report.rollup_latency_ms = measurement.rollup_latency_ms
+    if log:
+        log(
+            f"ingest: {measurement.events_per_sec / 1e6:.2f}M events/s "
+            f"aggregate over {shards} shards "
+            f"({measurement.total_events} events), rollup "
+            f"{measurement.rollup_latency_ms:.1f} ms"
+        )
+    if measurement.events_per_sec < min_ingest_events_per_sec:
+        report.failures.append(
+            f"aggregate ingest {measurement.events_per_sec:,.0f} "
+            f"events/s below the "
+            f"{min_ingest_events_per_sec:,.0f} floor"
+        )
+    if measurement.rollup_latency_ms > max_rollup_latency_ms:
+        report.failures.append(
+            f"rollup latency {measurement.rollup_latency_ms:.1f} ms "
+            f"above the {max_rollup_latency_ms:.0f} ms ceiling"
+        )
+
+    # ---- phase 2: page-dedup correctness under chaos ------------------
+    plan = default_injection_plan(topology)
+    baseline_sim = FleetSimulator(
+        topology,
+        shard_ids,
+        seed=seed,
+        chaos_intensity=chaos_intensity,
+    )
+    baseline = baseline_sim.run(rounds, plan, log=log)
+    matches, precision, recall, macro = score_incidents(
+        plan, baseline.incidents
+    )
+    report.matches = matches
+    report.incidents = [i.to_dict() for i in baseline.incidents]
+    report.precision = precision
+    report.recall = recall
+    report.macro_f1 = macro
+    if log:
+        log(
+            f"rollup: {len(baseline.incidents)} incidents for "
+            f"{len(plan)} injections — precision {precision:.3f} "
+            f"recall {recall:.3f} macro-F1 {macro:.3f}"
+        )
+    if precision < 1.0 or recall < 1.0:
+        detail = "; ".join(
+            f"{m.injection}: matched {m.matched_count} "
+            f"(radius {m.matched_blast_radius or 'none'}, expected "
+            f"{m.expected_blast_radius})"
+            for m in matches
+            if not m.exact
+        )
+        report.failures.append(
+            f"page dedup not exact (precision {precision:.3f}, "
+            f"recall {recall:.3f}): {detail or 'spurious incidents'}"
+        )
+
+    # ---- phase 3: shard failover mid-sweep ----------------------------
+    if kill_shard and shards > 1:
+        from tpuslo.runtime import AgentRuntime, StateStore
+
+        def _failover(run_dir: str) -> None:
+            store = StateStore(
+                os.path.join(run_dir, "fleet-snapshot.json"),
+                interval_s=0.0,
+            )
+            runtime = AgentRuntime(store)
+            kill_round = rounds // 2
+            victim = shard_ids[seed % shards]
+            failover_sim = FleetSimulator(
+                topology,
+                shard_ids,
+                seed=seed,
+                chaos_intensity=chaos_intensity,
+            )
+            result = failover_sim.run(
+                rounds,
+                plan,
+                kill=(kill_round, victim),
+                runtime=runtime,
+                log=log,
+            )
+            report.failover = dict(result.failover)
+            # Re-homed closes re-emitting an already-paged window are
+            # suppressed by the rollup's emitted-window registry; the
+            # count is the failover-idempotence evidence.
+            report.failover["rollup_windows_suppressed"] = (
+                result.rollup_duplicates_suppressed
+            )
+            before = _incident_keys(baseline.incidents)
+            after = _incident_keys(result.incidents)
+            report.failover_lost = sorted(set(before) - set(after))
+            report.failover_duplicated = sorted(
+                k for k in set(after) if after.count(k) > before.count(k)
+            )
+            if report.failover_lost:
+                report.failures.append(
+                    "failover lost incidents: "
+                    + ", ".join(report.failover_lost)
+                )
+            if report.failover_duplicated:
+                report.failures.append(
+                    "failover duplicated incidents: "
+                    + ", ".join(report.failover_duplicated)
+                )
+            if log:
+                log(
+                    "failover: killed "
+                    f"{report.failover.get('killed', '?')}, "
+                    f"{report.failover['rollup_windows_suppressed']} "
+                    "re-emitted window(s) suppressed — lost "
+                    f"{len(report.failover_lost)}, duplicated "
+                    f"{len(report.failover_duplicated)}"
+                )
+
+        if state_dir:
+            _failover(state_dir)
+        else:
+            with tempfile.TemporaryDirectory(
+                prefix="fleet-sweep-"
+            ) as tmp:
+                _failover(tmp)
+    return report

@@ -1,0 +1,354 @@
+"""Fleet wire contract v1: node agent → aggregator shipment envelope.
+
+A *shipment* is one gated :class:`~tpuslo.columnar.ColumnarBatch` plus
+the header an aggregator needs to place it: the sending node, a
+monotonic per-node sequence number (the at-least-once dedup key across
+DeliveryChannel spool replays and shard failover re-sends), and the
+node's stream head.  Columns travel as raw little-endian buffers —
+``tobytes`` on encode, ``np.frombuffer`` on decode — so the columnar
+path stays zero-copy per column; the ``base64`` transport wraps the
+same buffers in ASCII for JSON carriers (the agent's
+``--fleet-upstream`` JSONL shipment log, webhook-style sinks).
+
+The payload layout is governed by :data:`WIRE_EVENT_COLUMNS`, a PURE
+LITERAL kept in lockstep with ``PROBE_EVENT_DTYPE``: tpulint rule
+TPL104 parses both literals (plus ``COLUMNS_FOR_FIELD``) from the AST
+on every run and fails ``make lint`` if the wire payload stops being
+derivable from ``ProbeEventV1`` in either direction — the same
+drift-proofing shape as TPL103 one layer down.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import re
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+
+from tpuslo.columnar.schema import (
+    PROBE_EVENT_DTYPE,
+    STRING_COLUMNS,
+    ColumnarBatch,
+    StringPool,
+)
+from tpuslo.runtime.statestore import repair_jsonl_tail
+
+#: Wire schema version; an aggregator refuses a shipment from a
+#: different major version instead of mis-decoding it.
+FLEET_WIRE_VERSION = 1
+
+#: Column order of the shipment payload.  A PURE LITERAL — tpulint
+#: TPL104 parses this tuple from the AST to cross-check it against
+#: ``_DTYPE_FIELDS`` (and, via ``COLUMNS_FOR_FIELD``, against
+#: ``ProbeEventV1``); keep it free of computed entries.
+WIRE_EVENT_COLUMNS: tuple[str, ...] = (
+    "ts_unix_nano",
+    "signal",
+    "node",
+    "namespace",
+    "pod",
+    "container",
+    "pid",
+    "tid",
+    "value",
+    "unit",
+    "status",
+    "has_conn",
+    "conn_src_ip",
+    "conn_dst_ip",
+    "conn_src_port",
+    "conn_dst_port",
+    "conn_protocol",
+    "trace_id",
+    "span_id",
+    "has_errno",
+    "errno",
+    "confidence",
+    "has_tpu",
+    "tpu_chip",
+    "tpu_slice_id",
+    "tpu_host_index",
+    "tpu_ici_link",
+    "tpu_program_id",
+    "tpu_launch_id",
+    "tpu_module_name",
+)
+
+_STRING_COLUMNS = frozenset(STRING_COLUMNS)
+
+
+class WireContractError(ValueError):
+    """A shipment that violates the fleet wire contract."""
+
+
+@dataclass(slots=True)
+class Shipment:
+    """One decoded node → aggregator transfer."""
+
+    node: str
+    seq: int
+    batch: ColumnarBatch
+    head_ns: int = 0
+    #: Node-level TPU slice identity (ring key + rollup blast radius);
+    #: header metadata, not a per-event column.
+    slice_id: str = ""
+
+    @property
+    def events(self) -> int:
+        return self.batch.n
+
+
+def encode_shipment(
+    batch: ColumnarBatch,
+    node: str,
+    seq: int,
+    transport: str = "binary",
+    slice_id: str = "",
+) -> dict[str, Any]:
+    """Batch → wire payload dict.
+
+    ``transport="binary"`` keeps raw column buffers (in-process /
+    binary carriers); ``"base64"`` produces a JSON-safe dict for the
+    shipment log and DeliveryChannel sinks.
+    """
+    if transport not in ("binary", "base64"):
+        raise WireContractError(f"unknown transport {transport!r}")
+    head = 0
+    if batch.n:
+        head = int(batch.column("ts_unix_nano").max())
+    columns: dict[str, Any] = {}
+    for name in WIRE_EVENT_COLUMNS:
+        raw = np.ascontiguousarray(batch.columns[name]).tobytes()
+        columns[name] = (
+            base64.b64encode(raw).decode("ascii")
+            if transport == "base64"
+            else raw
+        )
+    return {
+        "wire_version": FLEET_WIRE_VERSION,
+        "node": node,
+        "seq": int(seq),
+        "events": batch.n,
+        "head_ns": head,
+        "slice_id": slice_id,
+        "transport": transport,
+        "pool": list(batch.pool.strings),
+        "columns": columns,
+    }
+
+
+def decode_shipment(payload: dict[str, Any]) -> Shipment:
+    """Wire payload dict → :class:`Shipment`; loud on contract breaks.
+
+    Buffers decode through ``np.frombuffer`` (no copy on the binary
+    transport).  String-column codes are bounds-checked against the
+    shipped pool — a code past the pool would otherwise surface as an
+    IndexError deep inside the gate or the serializer.
+    """
+    version = payload.get("wire_version")
+    if version != FLEET_WIRE_VERSION:
+        raise WireContractError(
+            f"wire version {version!r} != {FLEET_WIRE_VERSION}"
+        )
+    node = payload.get("node")
+    if not isinstance(node, str) or not node:
+        raise WireContractError("shipment missing node identity")
+    try:
+        n = int(payload["events"])
+        seq = int(payload["seq"])
+    except (KeyError, TypeError, ValueError) as exc:
+        raise WireContractError(f"bad shipment header: {exc}") from exc
+    pool_strings = payload.get("pool")
+    if not isinstance(pool_strings, list) or not all(
+        isinstance(s, str) for s in pool_strings
+    ):
+        raise WireContractError("shipment pool must be a list of strings")
+    if not pool_strings or pool_strings[0] != "":
+        raise WireContractError("shipment pool must start with ''")
+    raw_columns = payload.get("columns")
+    if not isinstance(raw_columns, dict):
+        raise WireContractError("shipment missing columns")
+    missing = set(WIRE_EVENT_COLUMNS) - set(raw_columns)
+    extra = set(raw_columns) - set(WIRE_EVENT_COLUMNS)
+    if missing or extra:
+        raise WireContractError(
+            f"column set drift: missing={sorted(missing)} "
+            f"extra={sorted(extra)}"
+        )
+    transport = payload.get("transport", "binary")
+    if transport not in ("binary", "base64"):
+        raise WireContractError(f"unknown transport {transport!r}")
+    cols: dict[str, np.ndarray] = {}
+    pool_size = len(pool_strings)
+    for name in WIRE_EVENT_COLUMNS:
+        raw = raw_columns[name]
+        if transport == "base64":
+            try:
+                raw = base64.b64decode(raw, validate=True)
+            except (TypeError, ValueError) as exc:
+                raise WireContractError(
+                    f"column {name!r}: bad base64: {exc}"
+                ) from exc
+        elif not isinstance(raw, (bytes, bytearray, memoryview)):
+            # A corrupted line claiming binary transport must be a
+            # contract break, not a TypeError out of np.frombuffer.
+            raise WireContractError(
+                f"column {name!r}: binary transport needs bytes, "
+                f"got {type(raw).__name__}"
+            )
+        dt = PROBE_EVENT_DTYPE[name]
+        if len(raw) != dt.itemsize * n:
+            raise WireContractError(
+                f"column {name!r}: {len(raw)} bytes != "
+                f"{dt.itemsize * n} for {n} events"
+            )
+        col = np.frombuffer(raw, dtype=dt)
+        if name in _STRING_COLUMNS and n:
+            lo = int(col.min())
+            hi = int(col.max())
+            if lo < 0 or hi >= pool_size:
+                raise WireContractError(
+                    f"column {name!r}: code range [{lo}, {hi}] outside "
+                    f"pool of {pool_size}"
+                )
+        cols[name] = col
+    batch = ColumnarBatch(
+        cols, StringPool.from_strings(pool_strings), n
+    )
+    return Shipment(
+        node=node,
+        seq=seq,
+        batch=batch,
+        head_ns=int(payload.get("head_ns", 0)),
+        slice_id=str(payload.get("slice_id", "")),
+    )
+
+
+def shipment_json_line(payload: dict[str, Any]) -> str:
+    """One JSONL line for a ``base64``-transport shipment payload."""
+    if payload.get("transport") != "base64":
+        raise WireContractError(
+            "only base64-transport shipments are JSON-safe"
+        )
+    return json.dumps(payload, separators=(",", ":")) + "\n"
+
+
+def parse_shipment_line(line: str) -> Shipment:
+    """Inverse of :func:`shipment_json_line` (decode included)."""
+    try:
+        payload = json.loads(line)
+    except json.JSONDecodeError as exc:
+        raise WireContractError(f"bad shipment line: {exc}") from exc
+    return decode_shipment(payload)
+
+
+class ShipmentWriter:
+    """Append-only shipment log (``agent --fleet-upstream``).
+
+    Duck-typed as a delivery ``Sink`` (``send(kind, payloads)``), so the
+    agent can route it through a DeliveryChannel — bounded queue, retry,
+    breaker, disk spool — exactly like its other sinks, or call it
+    directly when delivery is not configured.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._fh = None
+        self.shipments = 0
+        self.events = 0
+
+    def send(self, kind: str, payloads: list[dict]) -> None:
+        if self._fh is None:
+            # A crashed predecessor (or our own failed write below)
+            # can leave a torn half-line at the tail; appending onto
+            # it would weld the next GOOD shipment into one corrupt
+            # line, losing both.  Repair before the first append.
+            repair_jsonl_tail(self.path)
+            self._fh = open(self.path, "a", encoding="utf-8")
+        wrote = 0
+        events = 0
+        try:
+            for payload in payloads:
+                self._fh.write(shipment_json_line(payload))
+                wrote += 1
+                events += int(payload.get("events", 0))
+            self._fh.flush()
+        except OSError:
+            # Disk-full / rotated-away mid-write: drop the handle so
+            # the next send re-opens through the tail repair above,
+            # confining the loss to the shipment(s) that failed.
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+            raise
+        self.shipments += wrote
+        self.events += events
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+_SEQ_RE = re.compile(r'"seq":(-?\d+)')
+
+
+def last_recorded_seq(path: str, node: str) -> int:
+    """Highest seq already written for ``node`` in a shipment log.
+
+    Returns -1 when the log is absent or carries nothing for the node.
+    ``agent --fleet-upstream`` appends across restarts while the
+    aggregator drops ``seq <= state.seq`` as duplicates — a restarted
+    agent must resume its monotonic per-node sequence from the log, or
+    every post-restart shipment is silently deduplicated away.
+    """
+    try:
+        fh = open(path, encoding="utf-8")
+    except OSError:
+        return -1
+    # Shipment lines carry kilobytes of base64 column payload; fully
+    # json.loads-ing each one makes restart O(total log bytes).  The
+    # envelope puts node and seq in the first few dozen bytes, so scan
+    # the header prefix and only fall back to a full parse for lines
+    # some other writer formatted differently.
+    needle = '"node":' + json.dumps(node)
+    last = -1
+    with fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            prefix = line[:256]
+            if needle in prefix:
+                m = _SEQ_RE.search(prefix)
+                if m:
+                    last = max(last, int(m.group(1)))
+                    continue
+            elif '"node":"' in prefix:
+                continue  # another node's shipment
+            try:
+                raw = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail from a crash mid-append
+            if raw.get("node") == node:
+                try:
+                    last = max(last, int(raw.get("seq", -1)))
+                except (TypeError, ValueError):
+                    continue
+    return last
+
+
+def load_shipments(path: str) -> list[Shipment]:
+    """Read a shipment log; raises :class:`WireContractError` on drift."""
+    out: list[Shipment] = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                out.append(parse_shipment_line(line))
+    return out

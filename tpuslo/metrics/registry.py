@@ -294,6 +294,45 @@ class AgentMetrics:
             ["tenant", "objective", "severity"],
             registry=self.registry,
         )
+        # ---- fleet observability plane (tpuslo.fleet) ----------------
+        self.fleet_ingested_events = Counter(
+            "llm_slo_fleet_ingested_events_total",
+            "Columnar probe events ingested by an aggregator shard "
+            "(decode -> merge -> gate path), per shard",
+            ["shard"],
+            registry=self.registry,
+        )
+        self.fleet_rollup_latency_ms = Histogram(
+            "llm_slo_fleet_rollup_latency_ms",
+            "Latency of one fleet rollup pass (window close + "
+            "attribution + cross-node collapse)",
+            buckets=(1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500),
+            registry=self.registry,
+        )
+        self.fleet_incidents_open = Gauge(
+            "llm_slo_fleet_incidents_open",
+            "Fleet incidents currently open, by blast radius "
+            "(pod/node/slice/fleet)",
+            ["blast_radius"],
+            registry=self.registry,
+        )
+        self.fleet_nodes_reporting = Gauge(
+            "llm_slo_fleet_nodes_reporting",
+            "Nodes whose stream head is within the staleness bound "
+            "of the fleet head",
+            registry=self.registry,
+        )
+        self.fleet_nodes_stale = Gauge(
+            "llm_slo_fleet_nodes_stale",
+            "Nodes aged out of the watermark min (stopped shipping)",
+            registry=self.registry,
+        )
+        self.fleet_ring_rebalances = Counter(
+            "llm_slo_fleet_ring_rebalances_total",
+            "Hash-ring membership changes (shard added or removed; "
+            "each re-homes only the changed shard's arcs)",
+            registry=self.registry,
+        )
         # ---- self-observability series (tpuslo.obs) ------------------
         self.cycle_stage_ms = Histogram(
             "llm_slo_agent_cycle_stage_ms",
@@ -426,6 +465,12 @@ class AgentMetrics:
         (duck-typed against tpuslo.sloengine.SLOObserver)."""
         return _PromSLOObserver(self)
 
+    def fleet_observer(self) -> "_PromFleetObserver":
+        """Observer adapter wiring aggregator shards / the fleet
+        simulator to this registry (duck-typed against
+        tpuslo.fleet.FleetObserver)."""
+        return _PromFleetObserver(self)
+
 
 _BREAKER_STATE_VALUES = {"closed": 0, "half_open": 1, "open": 2}
 
@@ -532,6 +577,45 @@ class _PromRuntimeObserver:
     def drain(self, outcome: str, duration_s: float) -> None:
         self._m.runtime_drains.labels(outcome=outcome).inc()
         self._m.runtime_drain_duration_seconds.set(duration_s)
+
+
+class _PromFleetObserver:
+    """Bridge from fleet-plane callbacks to Prometheus.
+
+    Per-shard counter children are cached: the aggregator calls
+    ``ingested`` once per merged drain (tens of thousands of events),
+    so a ``labels()`` dict lookup per call would be pure waste.
+    """
+
+    def __init__(self, metrics: AgentMetrics):
+        self._m = metrics
+        self._ingest_children: dict[str, object] = {}
+        metrics.fleet_nodes_reporting.set(0)
+        metrics.fleet_nodes_stale.set(0)
+        for radius in ("pod", "node", "slice", "fleet"):
+            metrics.fleet_incidents_open.labels(blast_radius=radius).set(0)
+
+    def ingested(self, shard: str, events: int) -> None:
+        child = self._ingest_children.get(shard)
+        if child is None:
+            child = self._m.fleet_ingested_events.labels(shard=shard)
+            self._ingest_children[shard] = child
+        child.inc(events)
+
+    def rollup_latency_ms(self, ms: float) -> None:
+        self._m.fleet_rollup_latency_ms.observe(ms)
+
+    def incidents_open(self, blast_radius: str, count: int) -> None:
+        self._m.fleet_incidents_open.labels(
+            blast_radius=blast_radius
+        ).set(count)
+
+    def nodes(self, reporting: int, stale: int) -> None:
+        self._m.fleet_nodes_reporting.set(reporting)
+        self._m.fleet_nodes_stale.set(stale)
+
+    def rebalance(self) -> None:
+        self._m.fleet_ring_rebalances.inc()
 
 
 class _PromTraceObserver:

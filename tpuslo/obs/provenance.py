@@ -82,6 +82,14 @@ class ProvenanceRecord:
     #: ``active_burns()`` entries: tenant/objective/state/burn_rates/
     #: budget_remaining).
     burning: list[dict[str, Any]] = field(default_factory=list)
+    #: Fleet rollup only: the contributing node incidents this page
+    #: collapsed (node/pod/slice, correlation tier, confidence) — a
+    #: fleet page still drills down to kernel evidence through its
+    #: members' own provenance chains.
+    members: list[dict[str, Any]] = field(default_factory=list)
+    #: Fleet rollup only: the blast radius of the collapsed page
+    #: (pod/node/slice/fleet); empty for single-node incidents.
+    blast_radius: str = ""
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -99,6 +107,8 @@ class ProvenanceRecord:
             "delivery": dict(self.delivery),
             "stages_ms": dict(self.stages_ms),
             "burning": [dict(b) for b in self.burning],
+            "members": [dict(m) for m in self.members],
+            "blast_radius": self.blast_radius,
         }
 
     @classmethod
@@ -132,6 +142,12 @@ class ProvenanceRecord:
                 for b in (raw.get("burning") or [])
                 if isinstance(b, dict)
             ],
+            members=[
+                dict(m)
+                for m in (raw.get("members") or [])
+                if isinstance(m, dict)
+            ],
+            blast_radius=str(raw.get("blast_radius", "")),
         )
 
     def attribution_block(self) -> dict[str, Any]:
@@ -203,11 +219,32 @@ def format_chain(rec: ProvenanceRecord) -> str:
     """Human-readable causal chain for ``sloctl explain``."""
     lines = [
         f"incident {rec.incident_id}"
-        + (f"  (cycle {rec.cycle})" if rec.cycle >= 0 else ""),
+        + (f"  (cycle {rec.cycle})" if rec.cycle >= 0 else "")
+        + (
+            f"  [fleet rollup, blast radius: {rec.blast_radius}]"
+            if rec.blast_radius
+            else ""
+        ),
         f"  predicted: {rec.predicted_fault_domain} "
         f"(confidence {rec.confidence:.3f})"
         + (f", injected fault label: {rec.fault_label}" if rec.fault_label else ""),
     ]
+    if rec.members:
+        lines.append(
+            f"  members ({len(rec.members)} contributing node "
+            "incidents):"
+        )
+        for m in rec.members:
+            where = m.get("incident_id") or (
+                f"{m.get('node', '?')}/{m.get('pod', '?')}"
+            )
+            slice_id = m.get("slice_id", "")
+            lines.append(
+                f"     - {where}"
+                + (f" slice={slice_id}" if slice_id else "")
+                + f" tier={m.get('tier', 'node_window')}"
+                + f" confidence={float(m.get('confidence', 0.0)):.2f}"
+            )
     if rec.trace_id:
         lines.append(
             f"  self-trace: trace_id={rec.trace_id} "
@@ -225,7 +262,7 @@ def format_chain(rec: ProvenanceRecord) -> str:
         lines.append("     (none recorded)")
 
     corr = rec.correlation
-    if corr:
+    if "matched" in corr or "total" in corr:
         lines.append(
             "  2. correlation: {matched}/{total} events matched within "
             "{window_ms} ms (best tier: {best_tier})".format(
@@ -233,6 +270,18 @@ def format_chain(rec: ProvenanceRecord) -> str:
                 total=corr.get("total", 0),
                 window_ms=corr.get("window_ms", "?"),
                 best_tier=corr.get("best_tier", "none"),
+            )
+        )
+    elif "window_start_ns" in corr:
+        # Fleet rollup: the correlation context is the merged window.
+        lines.append(
+            "  2. rollup window: [{start}, {end}] ns, tenant "
+            "{tenant}, {nodes} nodes over {slices} slices".format(
+                start=corr.get("window_start_ns", 0),
+                end=corr.get("window_end_ns", 0),
+                tenant=corr.get("tenant", "?"),
+                nodes=corr.get("nodes", 0),
+                slices=corr.get("slices", 0),
             )
         )
     else:

@@ -225,6 +225,16 @@ class AgentRuntime:
         if name in self._pending_state:
             self._apply(name, restore, self._pending_state.pop(name))
 
+    def deregister(self, name: str) -> None:
+        """Drop a component's hooks (e.g. a killed aggregator shard).
+
+        Later snapshots must not keep persisting the dead component's
+        pre-death state — a restore from such a snapshot would revive
+        state the system already migrated elsewhere.
+        """
+        self._exporters.pop(name, None)
+        self._restorers.pop(name, None)
+
     # ---- snapshot assembly --------------------------------------------
 
     def export_components(self) -> dict[str, Any]:
