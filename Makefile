@@ -6,7 +6,7 @@ PY ?= python
 ARTIFACTS ?= artifacts
 
 .PHONY: all test test-fast native ebpf lint lint-changed \
-	racecheck-smoke schema-validate \
+	racecheck-smoke jitcheck-smoke schema-validate \
 	correlation-gate fault-smoke replay-smoke ebpf-smoke bench \
 	bench-smoke bench-columnar-smoke bench-columnar-full \
 	chaos-smoke chaos-demo chaos-telemetry-smoke \
@@ -81,6 +81,15 @@ lint-changed:
 # (Suite list: tpuslo/analysis/racecheck.py SMOKE_SUITES.)
 racecheck-smoke:
 	TPUSLO_RACECHECK=1 $(PY) -m tpuslo m5gate --racecheck-smoke
+
+# Dynamic retrace/host-sync auditor over the serving lanes (speculative
+# decode + its own planted-churn tests).  The conftest hooks jax
+# compile events when TPUSLO_JITAUDIT=1; the serving loops self-declare
+# their post-warmup steady sections, and the session fails if a
+# steady-state decode loop ever triggers an XLA backend compile.
+# (Suite list: tpuslo/analysis/jitaudit.py SMOKE_SUITES.)
+jitcheck-smoke:
+	TPUSLO_JITAUDIT=1 $(PY) -m tpuslo m5gate --jitcheck-smoke
 
 # ---- gates (mirror the reference CI steps) ----------------------------
 
@@ -257,11 +266,11 @@ m5-candidate:
 	@echo "m5-candidate: artifacts under $(ARTIFACTS)/m5"
 
 # Release candidates fail on new lint findings, lock-order races,
-# burn-alert contract violations, row-vs-columnar divergence, or a
-# broken fleet plane before the statistical gates even run
-# (ISSUEs 6 + 7 + 8 + 9).
-m5-gate: lint racecheck-smoke burn-smoke burn-sweep bench-columnar-smoke \
-		fleet-smoke fleet-sweep
+# steady-state decode recompiles, burn-alert contract violations,
+# row-vs-columnar divergence, or a broken fleet plane before the
+# statistical gates even run (ISSUEs 6 + 7 + 8 + 9 + 10).
+m5-gate: lint racecheck-smoke jitcheck-smoke burn-smoke burn-sweep \
+		bench-columnar-smoke fleet-smoke fleet-sweep
 	$(PY) -m tpuslo m5gate --candidate-root $(ARTIFACTS)/m5 \
 		--scenarios "$(shell echo $(M5_SCENARIOS) | tr ' ' ',')" \
 		--summary-json $(ARTIFACTS)/m5/gate.json \

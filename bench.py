@@ -1062,6 +1062,54 @@ def write_full_report(result: dict, path: str | None = None) -> str | None:
     return path if rel.startswith("..") else rel
 
 
+# Trace-discipline release floors (ISSUE 10): the measured speculation
+# lane runs its timed streams under the jitaudit registry; a
+# steady-state recompile or host-sync churn there is the BENCH_r05
+# defect class (spec_measured_speedup 0.192 at acceptance 1.0) coming
+# back, regardless of what the wall-clock numbers say on the current
+# box.  Gated whenever the lane reports the counters.
+SPEC_RETRACE_CEILING = 0
+DECODE_HOST_SYNCS_PER_TOKEN_CEILING = 1.0
+# The speculative loop's own contract is ONE fused read per round
+# (~0.29/token at the lane defaults: k=4, 48 tokens, acceptance 1.0,
+# plus warm prefill uploads).  The counter is deterministic — syncs
+# are counted, not timed — so the ceiling sits just above the
+# measured value: a single extra per-round transfer (~+0.2/token)
+# breaches it, even when it neither recompiles (retrace gate silent)
+# nor reads device values (TPL160 silent).
+SPEC_HOST_SYNCS_PER_TOKEN_CEILING = 0.45
+
+
+def _gate_trace_discipline(serving_digest: dict) -> None:
+    retraces = serving_digest.get("spec_retrace_count")
+    if retraces is not None and retraces > SPEC_RETRACE_CEILING:
+        raise SystemExit(
+            f"bench: spec decode recompiled {retraces}x in steady "
+            "state (ceiling 0) — retrace churn is back; run "
+            "TPUSLO_JITAUDIT=1 pytest tests/test_jitaudit.py and "
+            "tpulint (TPL161) to find the defect"
+        )
+    syncs = serving_digest.get("decode_host_syncs_per_token")
+    if syncs is not None and syncs > DECODE_HOST_SYNCS_PER_TOKEN_CEILING:
+        raise SystemExit(
+            f"bench: decode does {syncs} host syncs per token "
+            f"(ceiling {DECODE_HOST_SYNCS_PER_TOKEN_CEILING}) — "
+            "per-token transfers are back; see docs/hot-path.md "
+            "'Trace discipline' and TPL160"
+        )
+    spec_syncs = serving_digest.get("spec_host_syncs_per_token")
+    if (
+        spec_syncs is not None
+        and spec_syncs > SPEC_HOST_SYNCS_PER_TOKEN_CEILING
+    ):
+        raise SystemExit(
+            f"bench: speculative decode does {spec_syncs} host syncs "
+            f"per token (ceiling {SPEC_HOST_SYNCS_PER_TOKEN_CEILING}) "
+            "— the one-fused-read-per-round contract is broken; see "
+            "docs/hot-path.md 'Trace discipline' and TPL160"
+        )
+
+
 def _digest_serving(serving: dict) -> dict:
     """~12-field digest of a serving result (live or fallback)."""
     d = {
@@ -1096,6 +1144,14 @@ def _digest_serving(serving: dict) -> dict:
     if measured.get("acceptance_rate") is not None:
         d["spec_measured_acceptance"] = measured["acceptance_rate"]
         d["spec_measured_speedup"] = measured.get("measured_speedup")
+    if measured.get("spec_retrace_count") is not None:
+        d["spec_retrace_count"] = measured["spec_retrace_count"]
+        d["decode_host_syncs_per_token"] = measured.get(
+            "decode_host_syncs_per_token"
+        )
+        d["spec_host_syncs_per_token"] = measured.get(
+            "spec_host_syncs_per_token"
+        )
     bw8 = serving.get("bw_decode_b8") or {}
     if bw8.get("hbm_bw_pct") is not None:
         d["decode_b8_hbm_bw_pct"] = bw8["hbm_bw_pct"]
@@ -1348,6 +1404,7 @@ def build_result(
         "pipeline": _digest_pipeline(pipeline_result),
         "serving": _digest_serving(serving_result),
     }
+    _gate_trace_discipline(compact["serving"])
     if serving_result.get("backend") == "tpu":
         # The live serving digest IS the TPU evidence; stamp it so the
         # artifact says so even without an embedded capture.

@@ -38,6 +38,19 @@ if _RACECHECK:
 
     _racecheck.install()
 
+# --- dynamic retrace auditing (make jitcheck-smoke) -----------------------
+# TPUSLO_JITAUDIT=1 hooks jax.monitoring compile events and wraps
+# jax.jit/device_get/jnp.asarray (tpuslo/analysis/jitaudit.py); serving
+# loops self-declare their post-warmup steady sections, and the session
+# fails if a steady-state decode loop ever triggered a backend compile.
+# Installed at conftest import so engines built inside tests get
+# per-function compile tracking from birth.
+_JITAUDIT = os.environ.get("TPUSLO_JITAUDIT", "") == "1"
+if _JITAUDIT:
+    from tpuslo.analysis import jitaudit as _jitaudit
+
+    _jitaudit.install()
+
 import pytest  # noqa: E402
 
 
@@ -51,5 +64,19 @@ def _racecheck_gate():
             pytest.fail(
                 f"racecheck recorded {len(reg.violations)} violation(s):\n"
                 + reg.report(),
+                pytrace=False,
+            )
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _jitaudit_gate():
+    """Fail the session on steady-state recompiles (retrace churn)."""
+    yield
+    if _JITAUDIT:
+        reg = _jitaudit.registry()
+        if reg.violations:
+            pytest.fail(
+                f"jitaudit recorded {len(reg.violations)} steady-state "
+                f"recompile(s):\n" + reg.report(),
                 pytrace=False,
             )

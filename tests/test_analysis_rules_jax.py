@@ -1,0 +1,667 @@
+"""TPL160-163 trace-discipline rules: fixtures + real-tree anchors.
+
+Mirrors test_analysis_rules.py's pattern: every code gets at least one
+fixture that provokes it and one that stays clean, the real JAX plane
+must self-host at zero findings (with the committed suppressions), and
+in-memory mutation tests anchored to the real ``speculative.py`` /
+``serve.py`` prove each rule fires both directions — a mutation that
+reintroduces the BENCH_r05 defect class must be caught, and the fixed
+tree must not be flagged.
+"""
+
+from __future__ import annotations
+
+import textwrap
+from pathlib import Path
+
+from tpuslo.analysis import FileContext, RepoContext, run_analysis
+from tpuslo.analysis.hotpaths import JAX_HOT_LOOPS, JAX_PLANE_PREFIXES
+from tpuslo.analysis.rules_jax import TraceDisciplineRule
+
+REPO = Path(__file__).resolve().parent.parent
+SPEC_REL = "tpuslo/models/speculative.py"
+SERVE_REL = "tpuslo/models/serve.py"
+FX_REL = "tpuslo/models/_tpl16x_fixture.py"
+
+
+def _ctx(rel: str, source: str) -> FileContext:
+    return FileContext(REPO / rel, rel, textwrap.dedent(source))
+
+
+def _plane_repo(*contexts: FileContext) -> RepoContext:
+    """RepoContext rooted at the real repo (the manifest exists there)
+    holding only the given in-memory plane files."""
+    return RepoContext(REPO, list(contexts))
+
+
+def _findings(rule: TraceDisciplineRule, repo: RepoContext, code: str):
+    return [f for f in rule.check_repo(repo) if f.code == code]
+
+
+def _fixture_rule(**kwargs) -> TraceDisciplineRule:
+    """Rule scoped to the fixture file only (no real hot loops), so
+    fixture trees never depend on the live manifest entries."""
+    kwargs.setdefault("hot_loops", ())
+    kwargs.setdefault("plane_prefixes", ("tpuslo/models/_tpl16x",))
+    return TraceDisciplineRule(**kwargs)
+
+
+def _mutated_repo(rel: str, transform) -> RepoContext:
+    source = (REPO / rel).read_text(encoding="utf-8")
+    return RepoContext(REPO, [FileContext(REPO / rel, rel, transform(source))])
+
+
+class TestTPL160HostSyncs:
+    def _rule(self, qualname: str = "decode_loop") -> TraceDisciplineRule:
+        return _fixture_rule(hot_loops=((FX_REL, qualname),))
+
+    def test_item_on_device_value_in_loop_flagged(self):
+        ctx = _ctx(
+            FX_REL,
+            """
+            import jax
+            import jax.numpy as jnp
+
+            def decode_loop(params, cache):
+                tok = jnp.zeros((1,))
+                out = []
+                for _ in range(8):
+                    tok = decode(params, tok, cache)
+                    out.append(tok.item())
+                return out
+            """,
+        )
+        found = _findings(self._rule(), _plane_repo(ctx), "TPL160")
+        assert len(found) == 1
+        assert ".item()" in found[0].message
+
+    def test_item_on_device_get_result_clean(self):
+        ctx = _ctx(
+            FX_REL,
+            """
+            import jax
+            import jax.numpy as jnp
+
+            def decode_loop(params, cache):
+                tok = jnp.zeros((1,))
+                out = []
+                for _ in range(8):
+                    tok = decode(params, tok, cache)
+                    host = jax.device_get(tok)
+                    out.append(host.item())
+                return out
+            """,
+        )
+        assert not _findings(self._rule(), _plane_repo(ctx), "TPL160")
+
+    def test_scalar_cast_of_device_name_flagged(self):
+        ctx = _ctx(
+            FX_REL,
+            """
+            import jax.numpy as jnp
+
+            def decode_loop(cache):
+                length = jnp.asarray(0, jnp.int32)
+                while True:
+                    length = step(cache, length)
+                    if int(length) > 8:
+                        break
+            """,
+        )
+        found = _findings(self._rule(), _plane_repo(ctx), "TPL160")
+        assert len(found) == 1
+        assert "int()" in found[0].message
+
+    def test_block_until_ready_in_loop_flagged(self):
+        ctx = _ctx(
+            FX_REL,
+            """
+            import jax
+
+            def decode_loop(cache):
+                for i in range(4):
+                    cache = step(cache)
+                    jax.block_until_ready(cache)
+            """,
+        )
+        found = _findings(self._rule(), _plane_repo(ctx), "TPL160")
+        assert len(found) == 1
+        assert "block_until_ready" in found[0].message
+
+    def test_np_asarray_of_device_value_flagged(self):
+        ctx = _ctx(
+            FX_REL,
+            """
+            import numpy as np
+            import jax.numpy as jnp
+
+            def decode_loop(cache):
+                toks = jnp.zeros((4,))
+                for i in range(4):
+                    toks = step(cache, toks)
+                    host = np.asarray(toks)
+            """,
+        )
+        found = _findings(self._rule(), _plane_repo(ctx), "TPL160")
+        assert len(found) == 1
+        assert "np.asarray" in found[0].message
+
+    def test_sync_outside_loop_clean(self):
+        ctx = _ctx(
+            FX_REL,
+            """
+            import jax
+            import jax.numpy as jnp
+
+            def decode_loop(cache):
+                toks = jnp.zeros((4,))
+                for i in range(4):
+                    toks = step(cache, toks)
+                return toks.tolist()
+            """,
+        )
+        assert not _findings(self._rule(), _plane_repo(ctx), "TPL160")
+
+    def test_nested_loop_hazard_reported_once(self):
+        """A sync inside a for nested in a while is walked by both
+        loops' traversals — it must still report exactly one finding
+        (one hazard, one suppression)."""
+        ctx = _ctx(
+            FX_REL,
+            """
+            import jax
+            import jax.numpy as jnp
+
+            def decode_loop(cache):
+                length = jnp.zeros(())
+                while True:
+                    for _ in range(4):
+                        length = step(cache, length)
+                        if int(length) > 8:
+                            return
+            """,
+        )
+        found = _findings(self._rule(), _plane_repo(ctx), "TPL160")
+        assert len(found) == 1
+
+    def test_missing_manifest_entry_is_finding(self):
+        rule = _fixture_rule(
+            hot_loops=((FX_REL, "renamed_away"),),
+        )
+        ctx = _ctx(FX_REL, "def decode_loop():\n    pass\n")
+        found = _findings(rule, _plane_repo(ctx), "TPL160")
+        assert len(found) == 1
+        assert "not found" in found[0].message
+
+    def test_missing_manifest_file_is_finding(self):
+        rule = _fixture_rule(
+            hot_loops=(("tpuslo/models/_gone.py", "decode_loop"),),
+        )
+        found = _findings(rule, _plane_repo(), "TPL160")
+        assert len(found) == 1
+        assert "missing" in found[0].message
+
+
+class TestTPL161Retrace:
+    def test_jit_inside_loop_flagged(self):
+        ctx = _ctx(
+            FX_REL,
+            """
+            import jax
+
+            def serve(chunks):
+                for chunk in chunks:
+                    fn = jax.jit(lambda x: x[:chunk])
+                    fn(chunk)
+            """,
+        )
+        found = _findings(_fixture_rule(), _plane_repo(ctx), "TPL161")
+        assert len(found) == 1
+        assert "inside a loop" in found[0].message
+
+    def test_jit_per_call_without_cache_flagged(self):
+        ctx = _ctx(
+            FX_REL,
+            """
+            import jax
+
+            def build_step(cfg):
+                return jax.jit(lambda p, t: step(p, t, cfg))
+            """,
+        )
+        found = _findings(_fixture_rule(), _plane_repo(ctx), "TPL161")
+        assert len(found) == 1
+        assert "recompile for every call" in found[0].message
+
+    def test_lru_cached_builder_clean(self):
+        ctx = _ctx(
+            FX_REL,
+            """
+            import jax
+            from functools import lru_cache
+
+            @lru_cache(maxsize=32)
+            def build_step(cfg):
+                return jax.jit(lambda p, t: step(p, t, cfg))
+            """,
+        )
+        assert not _findings(_fixture_rule(), _plane_repo(ctx), "TPL161")
+
+    def test_module_level_jit_clean(self):
+        ctx = _ctx(
+            FX_REL,
+            """
+            import jax
+
+            _step = jax.jit(lambda p, t: p + t)
+
+            @jax.jit
+            def other(x):
+                return x * 2
+            """,
+        )
+        assert not _findings(_fixture_rule(), _plane_repo(ctx), "TPL161")
+
+    def test_nested_bare_jit_def_flagged(self):
+        ctx = _ctx(
+            FX_REL,
+            """
+            import jax
+
+            def outer(cfg):
+                @jax.jit
+                def inner(x):
+                    return x + cfg.bias
+                return inner
+            """,
+        )
+        found = _findings(_fixture_rule(), _plane_repo(ctx), "TPL161")
+        assert len(found) == 1
+        assert "retraces per enclosing call" in found[0].message
+
+    def test_traced_branching_flagged_and_static_clean(self):
+        ctx = _ctx(
+            FX_REL,
+            """
+            import jax
+            from functools import partial
+
+            @jax.jit
+            def traced(x):
+                if x > 0:
+                    return x
+                return -x
+
+            @partial(jax.jit, static_argnums=(1,))
+            def mixed(x, flag):
+                if flag:
+                    return x * 2
+                return x
+
+            @jax.jit
+            def shape_based(x):
+                if x.ndim == 2:
+                    return x.sum(-1)
+                return x
+            """,
+        )
+        found = _findings(_fixture_rule(), _plane_repo(ctx), "TPL161")
+        assert len(found) == 1
+        assert "'x'" in found[0].message
+
+    def test_optional_arg_none_branch_clean(self):
+        """``if mask is None`` keys on pytree structure (part of the
+        jit cache key) — the canonical optional-argument idiom must
+        not be flagged as value-dependent branching."""
+        ctx = _ctx(
+            FX_REL,
+            """
+            import jax
+
+            @jax.jit
+            def f(x, mask=None):
+                if mask is None:
+                    return x
+                if mask is not None and x is not None:
+                    return x * mask
+                return x
+            """,
+        )
+        assert not _findings(_fixture_rule(), _plane_repo(ctx), "TPL161")
+
+    def test_non_literal_static_argnums_flagged(self):
+        ctx = _ctx(
+            FX_REL,
+            """
+            import jax
+
+            nums = (1, 2)
+            _fn = jax.jit(step, static_argnums=nums)
+            _ok = jax.jit(step, static_argnums=(1, 2))
+            """,
+        )
+        found = _findings(_fixture_rule(), _plane_repo(ctx), "TPL161")
+        assert len(found) == 1
+        assert "literal" in found[0].message
+
+    def test_non_literal_static_argnums_decorator_form_flagged(self):
+        """The decorator idiom must obey the same contract as the
+        call-form site (it takes a different AST route)."""
+        ctx = _ctx(
+            FX_REL,
+            """
+            import jax
+            from functools import partial
+
+            nums = (1,)
+
+            @partial(jax.jit, static_argnums=nums)
+            def step(params, n):
+                return params
+            """,
+        )
+        found = _findings(_fixture_rule(), _plane_repo(ctx), "TPL161")
+        assert len(found) == 1
+        assert "literal" in found[0].message
+
+
+class TestTPL162DtypeDrift:
+    def test_asarray_without_dtype_in_loop_flagged(self):
+        ctx = _ctx(
+            FX_REL,
+            """
+            import jax.numpy as jnp
+
+            def emit(rows):
+                for row in rows:
+                    yield jnp.asarray(row)
+            """,
+        )
+        found = _findings(_fixture_rule(), _plane_repo(ctx), "TPL162")
+        assert len(found) == 1
+        assert "dtype" in found[0].message
+
+    def test_asarray_with_dtype_clean(self):
+        ctx = _ctx(
+            FX_REL,
+            """
+            import jax.numpy as jnp
+
+            def emit(rows):
+                for row in rows:
+                    yield jnp.asarray(row, jnp.int32)
+                for row in rows:
+                    yield jnp.zeros((4,), dtype=jnp.float32)
+            """,
+        )
+        assert not _findings(_fixture_rule(), _plane_repo(ctx), "TPL162")
+
+    def test_ctor_outside_loop_clean(self):
+        ctx = _ctx(
+            FX_REL,
+            """
+            import jax.numpy as jnp
+
+            def once(rows):
+                return jnp.asarray(rows)
+            """,
+        )
+        assert not _findings(_fixture_rule(), _plane_repo(ctx), "TPL162")
+
+
+class TestTPL163DonationMisses:
+    def test_undonated_cache_param_flagged(self):
+        ctx = _ctx(
+            FX_REL,
+            """
+            import jax
+
+            def decode_step(params, tok, cache):
+                return tok, cache
+
+            _step = jax.jit(decode_step)
+            """,
+        )
+        found = _findings(_fixture_rule(), _plane_repo(ctx), "TPL163")
+        assert len(found) == 1
+        assert "cache" in found[0].message
+
+    def test_donated_cache_clean(self):
+        ctx = _ctx(
+            FX_REL,
+            """
+            import jax
+
+            def decode_step(params, tok, cache):
+                return tok, cache
+
+            _step = jax.jit(decode_step, donate_argnums=(2,))
+            """,
+        )
+        assert not _findings(_fixture_rule(), _plane_repo(ctx), "TPL163")
+
+    def test_no_donatable_param_clean(self):
+        ctx = _ctx(
+            FX_REL,
+            """
+            import jax
+
+            def score(params, tok):
+                return tok
+
+            _score = jax.jit(score)
+            """,
+        )
+        assert not _findings(_fixture_rule(), _plane_repo(ctx), "TPL163")
+
+    def test_undonated_cache_bare_decorator_flagged(self):
+        """``@jax.jit`` over a cache-threading def is the most common
+        jit idiom — the decorator route must not escape TPL163."""
+        ctx = _ctx(
+            FX_REL,
+            """
+            import jax
+
+            @jax.jit
+            def decode_step(params, tok, kv_cache):
+                return tok, kv_cache
+            """,
+        )
+        found = _findings(_fixture_rule(), _plane_repo(ctx), "TPL163")
+        assert len(found) == 1
+        assert "kv_cache" in found[0].message
+
+    def test_donated_partial_decorator_clean(self):
+        ctx = _ctx(
+            FX_REL,
+            """
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, donate_argnames=("kv_cache",))
+            def decode_step(params, tok, kv_cache):
+                return tok, kv_cache
+            """,
+        )
+        assert not _findings(_fixture_rule(), _plane_repo(ctx), "TPL163")
+
+    def test_partial_bound_cache_resolved(self):
+        ctx = _ctx(
+            FX_REL,
+            """
+            import jax
+            from functools import partial
+
+            def decode_step(params, tok, cache, cfg):
+                return tok, cache
+
+            _step = jax.jit(partial(decode_step, cfg=None))
+            """,
+        )
+        found = _findings(_fixture_rule(), _plane_repo(ctx), "TPL163")
+        assert len(found) == 1
+
+
+class TestRealTreeAnchors:
+    """The committed JAX plane self-hosts; mutations re-fire the rules."""
+
+    def test_real_plane_is_clean_with_suppressions(self):
+        result = run_analysis(
+            REPO,
+            paths=[p.rstrip("/") for p in JAX_PLANE_PREFIXES],
+            rules=[TraceDisciplineRule()],
+        )
+        assert result.findings == []
+        # The intentional sites (init-time one-shot jits, first-hit
+        # compile timing, dryrun-harness jits) are suppressed per line,
+        # not silently invisible.
+        assert result.suppressed >= 8
+
+    def test_hot_loop_manifest_points_at_real_functions(self):
+        contexts = []
+        for rel in sorted({rel for rel, _ in JAX_HOT_LOOPS}):
+            source = (REPO / rel).read_text(encoding="utf-8")
+            contexts.append(FileContext(REPO / rel, rel, source))
+        repo = RepoContext(REPO, contexts)
+        stale = [
+            f
+            for f in TraceDisciplineRule().check_repo(repo)
+            if f.path == "tpuslo/analysis/hotpaths.py"
+        ]
+        assert stale == []
+
+    def test_uncaching_spec_round_builder_fires_tpl161(self):
+        """Removing the lru_cache memoization reintroduces the
+        BENCH_r05 defect (a fresh jit wrapper per engine): TPL161."""
+        repo = _mutated_repo(
+            SPEC_REL, lambda s: s.replace("@lru_cache(maxsize=32)\n", "")
+        )
+        found = [
+            f
+            for f in TraceDisciplineRule().check_repo(repo)
+            if f.code == "TPL161" and f.path == SPEC_REL
+        ]
+        assert len(found) >= 2  # both memoized builders uncached
+
+    def test_dropping_donation_fires_tpl163(self):
+        repo = _mutated_repo(
+            SPEC_REL, lambda s: s.replace(", donate_argnums=(3, 4)", "")
+        )
+        found = [
+            f
+            for f in TraceDisciplineRule().check_repo(repo)
+            if f.code == "TPL163" and f.path == SPEC_REL
+        ]
+        assert len(found) == 2
+
+    def test_host_sync_in_stream_loop_fires_tpl160(self):
+        """Reintroducing a per-round scalar pull (the eager-emit-loop
+        defect) inside SpeculativeEngine.stream: TPL160."""
+        repo = _mutated_repo(
+            SPEC_REL,
+            lambda s: s.replace(
+                "            n = int(n_vec[0])",
+                "            n = int(current[0])",
+            ),
+        )
+        found = [
+            f
+            for f in TraceDisciplineRule().check_repo(repo)
+            if f.code == "TPL160" and f.path == SPEC_REL
+        ]
+        assert len(found) == 1
+        assert "int()" in found[0].message
+
+    def test_per_round_asarray_in_stream_fires_tpl162(self):
+        """The pre-fix emit loop uploaded a fresh scalar per round via
+        jnp.asarray without dtype; planting one back is TPL162."""
+
+        def transform(source: str) -> str:
+            return source.replace(
+                "            n = int(n_vec[0])",
+                "            cur = jnp.asarray(n_vec)\n"
+                "            n = int(n_vec[0])",
+            )
+
+        repo = _mutated_repo(SPEC_REL, transform)
+        found = [
+            f
+            for f in TraceDisciplineRule().check_repo(repo)
+            if f.code == "TPL162" and f.path == SPEC_REL
+        ]
+        assert len(found) == 1
+
+    def test_serve_steady_sync_fires_tpl160(self):
+        """A block_until_ready planted in ServeEngine.generate's chunk
+        loop (outside the suppressed first-hit sites): TPL160."""
+
+        def transform(source: str) -> str:
+            return source.replace(
+                "                chunk_values = jax.device_get(toks[0]).tolist()",
+                "                jax.block_until_ready(toks)\n"
+                "                chunk_values = jax.device_get(toks[0]).tolist()",
+            )
+
+        repo = _mutated_repo(SERVE_REL, transform)
+        # check_repo is pre-suppression: filter to the generate loop
+        # (the suppressed first-hit _append_ids sites also surface).
+        found = [
+            f
+            for f in TraceDisciplineRule().check_repo(repo)
+            if f.code == "TPL160"
+            and f.path == SERVE_REL
+            and "ServeEngine.generate " in f.message
+        ]
+        assert len(found) == 1
+        assert "block_until_ready" in found[0].message
+
+    def test_manifest_rename_reported_stale(self):
+        rule = TraceDisciplineRule(
+            hot_loops=((SPEC_REL, "SpeculativeEngine.streamed_away"),),
+        )
+        source = (REPO / SPEC_REL).read_text(encoding="utf-8")
+        repo = RepoContext(
+            REPO, [FileContext(REPO / SPEC_REL, SPEC_REL, source)]
+        )
+        found = [f for f in rule.check_repo(repo) if f.code == "TPL160"]
+        assert len(found) == 1
+        assert "streamed_away" in found[0].message
+
+    def test_fixture_tree_without_manifest_skipped(self, tmp_path):
+        """A repo without the hotpaths manifest (fixture trees) is not
+        governed — no spurious findings outside this repo."""
+        target = tmp_path / "models"
+        target.mkdir()
+        (target / "bad.py").write_text(
+            "import jax\n\n\ndef f(chunks):\n"
+            "    for c in chunks:\n"
+            "        jax.jit(lambda x: x)(c)\n",
+            encoding="utf-8",
+        )
+        ctx = FileContext(
+            target / "bad.py", "tpuslo/models/bad.py",
+            (target / "bad.py").read_text(encoding="utf-8"),
+        )
+        repo = RepoContext(tmp_path, [ctx])
+        assert list(TraceDisciplineRule().check_repo(repo)) == []
+
+
+class TestChangedRunAnchors:
+    def test_plane_prefixes_are_rule_anchors(self):
+        """tpulint --changed loads rule anchors; the whole JAX plane +
+        the manifest ride along, so touching any models/ops/parallel
+        file re-runs the TPL160s (the ISSUE 10 satellite)."""
+        anchors = TraceDisciplineRule.repo_anchors
+        for prefix in JAX_PLANE_PREFIXES:
+            assert prefix in anchors
+        assert "tpuslo/analysis/hotpaths.py" in anchors
+
+    def test_changed_scope_still_checks_hot_loops(self):
+        """A --changed-style run scoped to ONE plane file still
+        resolves every hot-loop manifest entry (anchors loaded)."""
+        result = run_analysis(
+            REPO,
+            rules=[TraceDisciplineRule()],
+            files=[REPO / SPEC_REL],
+        )
+        assert result.findings == []

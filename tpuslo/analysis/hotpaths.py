@@ -66,6 +66,15 @@ HOT_FUNCTIONS: tuple[tuple[str, str], ...] = (
     ("tpuslo/fleet/aggregator.py", "AggregatorShard.ingest"),
     ("tpuslo/fleet/aggregator.py", "AggregatorShard._drain"),
     ("tpuslo/fleet/aggregator.py", "AggregatorShard._fold"),
+    # Serving decode/verify kernels (ISSUE 10): the traced bodies the
+    # spec-decode and decode paths run per token/round.  They execute
+    # under jax tracing, where a stray print/json.dumps lands in every
+    # compile AND betrays a retrace; per-trace Python cost here is a
+    # compile-storm amplifier.
+    ("tpuslo/models/llama.py", "decode_step"),
+    ("tpuslo/models/llama.py", "verify_chunk"),
+    ("tpuslo/models/llama.py", "decode_chunk"),
+    ("tpuslo/models/speculative.py", "_spec_round_core"),
 )
 
 #: (repo-relative module path, dataclass name) pairs that are allocated
@@ -90,4 +99,33 @@ HOT_DATACLASSES: tuple[tuple[str, str], ...] = (
     # Fleet plane containers (ISSUE 9).
     ("tpuslo/fleet/wire.py", "Shipment"),
     ("tpuslo/fleet/aggregator.py", "_NodeState"),
+)
+
+#: The JAX plane the TPL16x trace-discipline rules govern: every file
+#: under these prefixes is scanned for retrace hazards (TPL161), dtype
+#: drift (TPL162) and donation misses (TPL163).
+JAX_PLANE_PREFIXES: tuple[str, ...] = (
+    "tpuslo/models/",
+    "tpuslo/ops/",
+    "tpuslo/parallel/",
+)
+
+#: Registered decode/verify hot loops: (repo-relative module path,
+#: dotted qualname).  Inside these functions' for/while bodies a host
+#: sync is a per-token (or per-round) cost — through a remote-chip
+#: tunnel, a full network round-trip — so **TPL160** flags the known
+#: sync constructs there: ``.item()``/``.tolist()`` on device arrays,
+#: ``int()``/``float()``/``bool()``/``np.asarray()`` on values produced
+#: by jnp/jax calls, and ``block_until_ready``.  The sanctioned read is
+#: ONE fused ``jax.device_get`` per loop iteration; results of
+#: ``device_get`` (and other host values) are exempt.  When a new
+#: serving loop is optimized, register it here in the same PR — the
+#: manifest is the contract that the dispatch discipline stays real.
+JAX_HOT_LOOPS: tuple[tuple[str, str], ...] = (
+    ("tpuslo/models/serve.py", "ServeEngine.generate"),
+    ("tpuslo/models/serve.py", "ServeEngine.generate_batch"),
+    ("tpuslo/models/serve.py", "ServeEngine._prefill_rows"),
+    ("tpuslo/models/serve.py", "ServeEngine._append_ids"),
+    ("tpuslo/models/speculative.py", "SpeculativeEngine.stream"),
+    ("tpuslo/models/speculative.py", "SpeculativeEngine.generate_batch"),
 )

@@ -1,0 +1,376 @@
+"""Dynamic retrace/host-sync auditor (``TPUSLO_JITAUDIT=1``).
+
+The static TPL160-163 rules (:mod:`tpuslo.analysis.rules_jax`) see the
+dispatch hazards the AST admits; this module counts the ones that
+actually *execute*.  When installed it hooks three layers:
+
+* **XLA compiles** via :mod:`jax.monitoring` duration events
+  (``/jax/core/compile/jaxpr_trace_duration`` and
+  ``backend_compile_duration``) — every trace and every backend
+  compile is recorded against the audit section active at that moment.
+* **Per-function compile counts** by wrapping ``jax.jit``: every
+  wrapper constructed after install reports its executable-cache
+  growth per call, so a retrace storm names the function that churns
+  (the BENCH_r05 spec-decode defect was a fresh ``jax.jit`` per chunk
+  — invisible in aggregate counters, obvious per function).
+* **Host-device traffic** by wrapping ``jax.device_get`` (fused
+  device→host reads) and ``jnp.asarray``/``jnp.array`` applied to
+  non-device values (host→device uploads — the per-round scalar churn
+  TPL160/162 flag statically).  Implicit syncs (``int(arr)``,
+  ``np.asarray(arr)``) bypass Python and cannot be intercepted; the
+  serving plane's contract is that every host read routes through ONE
+  fused ``device_get``, so the explicit counters are the meaningful
+  ones (and the static TPL160 pass rejects the implicit forms).
+
+**Steady-state sections** are the gate.  Code that has finished
+warmup declares it (:meth:`JitAuditRegistry.steady`, or conditional
+per-iteration ``push_section``/``pop_section`` as the serving loops
+do); any backend compile recorded inside a steady section
+is a violation.  :class:`tpuslo.models.speculative.SpeculativeEngine`
+and :meth:`tpuslo.models.serve.ServeEngine.generate` self-declare
+their post-warmup decode loops when the auditor is installed, so
+``make jitcheck-smoke`` (``TPUSLO_JITAUDIT=1`` over the serving
+suites — :data:`SMOKE_SUITES`, gated in ``tests/conftest.py``) fails
+the session if a steady-state decode loop ever recompiles — the
+dynamic counterpart of every TPL161 finding.  ``bench.py``'s measured
+speculation lane reads ``spec_retrace_count`` and
+``decode_host_syncs_per_token`` from the same registry as gated
+release counters.
+
+Violations are recorded, not raised (raising inside a monitoring
+callback would corrupt the compile in flight); the pytest wiring
+fails the session at teardown, mirroring ``racecheck``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass
+
+ENV_FLAG = "TPUSLO_JITAUDIT"
+
+#: The serving suites ``make jitcheck-smoke`` / ``m5gate
+#: --jitcheck-smoke`` run under the auditor: the speculative-decode
+#: exactness suite (whose engines self-declare steady sections) plus
+#: the auditor's own deterministic planted-churn tests.
+SMOKE_SUITES = (
+    "tests/test_speculative.py",
+    "tests/test_jitaudit.py",
+)
+
+_TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+@dataclass(slots=True)
+class CompileEvent:
+    section: str  # active section label ("" outside any section)
+    steady: bool
+    kind: str  # "trace" | "backend_compile"
+    duration_ms: float
+
+
+@dataclass(slots=True)
+class Violation:
+    section: str
+    detail: str
+
+    def render(self) -> str:
+        return f"jitaudit: steady-state recompile in [{self.section}]: {self.detail}"
+
+
+class JitAuditRegistry:
+    """Compile/transfer counters bucketed by audit section.
+
+    Sections nest (a stack); counters attribute to the innermost
+    label.  The registry is process-global when installed via
+    :func:`install`; unit tests construct their own and drive the
+    ``on_*`` hooks directly so provoked churn never pollutes the
+    session gate.
+    """
+
+    def __init__(self, max_violations: int = 64):
+        self._mu = threading.Lock()
+        # Sections are per-thread: jax compiles run on the calling
+        # thread, so a steady section opened by one serving loop must
+        # not claim (and fail on) another thread's legitimate
+        # first-hit compile.
+        self._tls = threading.local()
+        self.events: list[CompileEvent] = []
+        self.violations: list[Violation] = []
+        #: function name -> executable-cache entries compiled (from
+        #: wrapped ``jax.jit`` functions; aggregate events catch the
+        #: rest).
+        self.fn_compiles: dict[str, int] = {}
+        #: section label -> fused device->host reads / host->device
+        #: uploads observed while that section was innermost.
+        self.host_reads: dict[str, int] = {}
+        self.uploads: dict[str, int] = {}
+        self._max_violations = max_violations
+
+    # --- sections -------------------------------------------------------
+
+    def _stack(self) -> list[tuple[str, bool]]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def _current(self) -> tuple[str, bool]:
+        stack = self._stack()
+        return stack[-1] if stack else ("", False)
+
+    def push_section(self, label: str, steady: bool = False) -> None:
+        self._stack().append((label, steady))
+
+    def pop_section(self) -> None:
+        stack = self._stack()
+        if stack:
+            stack.pop()
+
+    @contextmanager
+    def section(self, label: str, steady: bool = False):
+        self.push_section(label, steady)
+        try:
+            yield self
+        finally:
+            self.pop_section()
+
+    def steady(self, label: str):
+        """A post-warmup region: any backend compile inside is a
+        violation (the loop's shapes are fixed; a recompile means
+        retrace churn — the BENCH_r05 5x-slowdown class)."""
+        return self.section(label, steady=True)
+
+    # --- hooks (called by the installed patches) -----------------------
+
+    def on_compile(self, kind: str, duration_ms: float) -> None:
+        with self._mu:
+            section, steady = self._current()
+            self.events.append(
+                CompileEvent(section, steady, kind, duration_ms)
+            )
+            if steady and kind == "backend_compile":
+                if len(self.violations) < self._max_violations:
+                    self.violations.append(
+                        Violation(
+                            section,
+                            f"XLA backend compile ({duration_ms:.1f} ms) "
+                            "after the loop declared steady state",
+                        )
+                    )
+
+    def on_fn_compiles(self, name: str, n: int) -> None:
+        with self._mu:
+            self.fn_compiles[name] = self.fn_compiles.get(name, 0) + n
+
+    def on_host_read(self) -> None:
+        with self._mu:
+            label = self._current()[0]
+            self.host_reads[label] = self.host_reads.get(label, 0) + 1
+
+    def on_upload(self) -> None:
+        with self._mu:
+            label = self._current()[0]
+            self.uploads[label] = self.uploads.get(label, 0) + 1
+
+    # --- reads ----------------------------------------------------------
+
+    def compile_count(self, kind: str = "backend_compile") -> int:
+        with self._mu:
+            return sum(1 for e in self.events if e.kind == kind)
+
+    def steady_compile_count(self) -> int:
+        """Backend compiles recorded inside steady sections — the
+        retrace count every serving gate floors at zero."""
+        with self._mu:
+            return sum(
+                1
+                for e in self.events
+                if e.steady and e.kind == "backend_compile"
+            )
+
+    def host_sync_count(self) -> int:
+        """Explicit host<->device round-trips: fused reads + uploads."""
+        with self._mu:
+            return sum(self.host_reads.values()) + sum(
+                self.uploads.values()
+            )
+
+    def reset(self) -> None:
+        with self._mu:
+            self.events.clear()
+            self.violations.clear()
+            self.fn_compiles.clear()
+            self.host_reads.clear()
+            self.uploads.clear()
+
+    def report(self) -> str:
+        lines = [v.render() for v in self.violations]
+        if self.fn_compiles:
+            top = sorted(
+                self.fn_compiles.items(), key=lambda kv: -kv[1]
+            )[:8]
+            lines.append(
+                "per-function compiles: "
+                + ", ".join(f"{name}={n}" for name, n in top)
+            )
+        return "\n".join(lines)
+
+
+# --- global install -------------------------------------------------------
+
+_GLOBAL = JitAuditRegistry()
+_installed = False
+_real_jit = None
+_real_device_get = None
+_real_asarray = None
+_real_array = None
+
+
+def registry() -> JitAuditRegistry:
+    return _GLOBAL
+
+
+def enabled_by_env() -> bool:
+    return os.environ.get(ENV_FLAG, "") == "1"
+
+
+def installed() -> bool:
+    return _installed
+
+
+class TrackedJitFunction:
+    """Call-through proxy over a real jit wrapper that reports
+    executable-cache growth per call (attributing compiles to the
+    function the static rules would name)."""
+
+    __slots__ = ("_fn", "_name", "_registry", "_last_size")
+
+    def __init__(self, fn, name: str, reg: JitAuditRegistry):
+        self._fn = fn
+        self._name = name
+        self._registry = reg
+        self._last_size = 0
+
+    def __call__(self, *args, **kwargs):
+        out = self._fn(*args, **kwargs)
+        try:
+            size = self._fn._cache_size()
+        except Exception:  # noqa: BLE001 - older jax: no cache probe
+            return out
+        if size > self._last_size:
+            self._registry.on_fn_compiles(
+                self._name, size - self._last_size
+            )
+            self._last_size = size
+        return out
+
+    def __getattr__(self, item):
+        return getattr(self._fn, item)
+
+
+def _on_duration(name: str, duration: float, **kwargs) -> None:
+    if name == _COMPILE_EVENT:
+        _GLOBAL.on_compile("backend_compile", duration * 1000.0)
+    elif name == _TRACE_EVENT:
+        _GLOBAL.on_compile("trace", duration * 1000.0)
+
+
+def _fn_label(fun) -> str:
+    qual = getattr(fun, "__qualname__", None) or getattr(
+        fun, "__name__", None
+    )
+    if qual:
+        return qual
+    inner = getattr(fun, "func", None)  # functools.partial
+    if inner is not None:
+        return f"partial({_fn_label(inner)})"
+    return type(fun).__name__
+
+
+def _tracked_jit(fun=None, **kwargs):
+    if fun is None:
+        # jax.jit(static_argnums=...) decorator-factory form.
+        return lambda f: _tracked_jit(f, **kwargs)
+    assert _real_jit is not None
+    return TrackedJitFunction(
+        _real_jit(fun, **kwargs), _fn_label(fun), _GLOBAL
+    )
+
+
+def _tracked_device_get(x):
+    _GLOBAL.on_host_read()
+    assert _real_device_get is not None
+    return _real_device_get(x)
+
+
+def _is_host_value(x) -> bool:
+    import jax
+
+    return not isinstance(x, (jax.Array, jax.core.Tracer))
+
+
+def _tracked_asarray(a, *args, **kwargs):
+    if _is_host_value(a):
+        _GLOBAL.on_upload()
+    assert _real_asarray is not None
+    return _real_asarray(a, *args, **kwargs)
+
+
+def _tracked_array(a, *args, **kwargs):
+    if _is_host_value(a):
+        _GLOBAL.on_upload()
+    assert _real_array is not None
+    return _real_array(a, *args, **kwargs)
+
+
+def install() -> None:
+    """Hook jax.monitoring + patch jit/device_get/asarray/array.
+
+    jit wrappers created *before* install keep working untracked (the
+    aggregate monitoring events still count their compiles); the
+    lru-cached serving kernels are tracked whenever the auditor is
+    installed before engine construction — which the smoke suites and
+    the bench lane guarantee by installing first.
+    """
+    global _installed, _real_jit, _real_device_get
+    global _real_asarray, _real_array
+    if _installed:
+        return
+    import jax
+    import jax.monitoring
+    import jax.numpy as jnp
+
+    jax.monitoring.register_event_duration_secs_listener(_on_duration)
+    _real_jit = jax.jit
+    _real_device_get = jax.device_get
+    _real_asarray = jnp.asarray
+    _real_array = jnp.array
+    jax.jit = _tracked_jit
+    jax.device_get = _tracked_device_get
+    jnp.asarray = _tracked_asarray
+    jnp.array = _tracked_array
+    _installed = True
+
+
+def uninstall() -> None:
+    global _installed
+    if not _installed:
+        return
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        from jax._src import monitoring as _mon
+
+        _mon._unregister_event_duration_listener_by_callback(_on_duration)
+    except Exception:  # noqa: BLE001 - private API moved: listener stays,
+        pass  # but it only appends to this registry, which is inert.
+    jax.jit = _real_jit
+    jax.device_get = _real_device_get
+    jnp.asarray = _real_asarray
+    jnp.array = _real_array
+    _installed = False

@@ -16,6 +16,7 @@ from tpuslo.analysis.rules_contracts import (
 )
 from tpuslo.analysis.rules_except import ExceptionDisciplineRule
 from tpuslo.analysis.rules_hotpath import HotPathPurityRule
+from tpuslo.analysis.rules_jax import TraceDisciplineRule
 from tpuslo.analysis.rules_locks import LockDisciplineRule
 from tpuslo.analysis.rules_style import StyleRules
 
@@ -28,6 +29,7 @@ ALL_RULES: tuple[Rule, ...] = (
     MetricsDriftRule(),
     LockDisciplineRule(),
     HotPathPurityRule(),
+    TraceDisciplineRule(),
     ExceptionDisciplineRule(),
 )
 

@@ -1,0 +1,713 @@
+"""TPL160-TPL163: trace discipline for the JAX serving plane.
+
+tpulint's earlier families machine-check the *agent* plane; this one
+checks the dispatch-layer invariants of the JAX plane the toolkit
+exists to observe (``tpuslo/models/``, ``tpuslo/ops/``,
+``tpuslo/parallel/`` — :data:`tpuslo.analysis.hotpaths.JAX_PLANE_PREFIXES`).
+BENCH_r05 showed why these must be *checked*, not hoped for: a
+perfect-acceptance speculative-decode path measured 5x SLOWER than
+plain decode (``spec_measured_speedup`` 0.192) purely from eager
+dispatch + host-sync churn per round.  Every static finding here has a
+dynamic counterpart in :mod:`tpuslo.analysis.jitaudit`.
+
+* **TPL160 — host-sync hazards in registered hot loops.**  Inside the
+  for/while bodies of the decode/verify loops registered in
+  :data:`tpuslo.analysis.hotpaths.JAX_HOT_LOOPS`: ``.item()`` /
+  ``.tolist()`` on values not provably host-side,
+  ``int()``/``float()``/``bool()``/``np.asarray()`` applied to values
+  produced by jnp/jax calls, and ``block_until_ready``.  Each is a
+  device->host round-trip per iteration; the sanctioned pattern is one
+  fused ``jax.device_get`` per iteration, whose results are exempt.
+
+* **TPL161 — retrace hazards.**  ``jax.jit`` constructed inside a
+  loop, or inside a function/method without a caching decorator
+  (``functools.lru_cache``/``cache``) — a fresh wrapper is a fresh
+  executable cache, so identical programs recompile per call; bare
+  ``@jax.jit`` defs nested in uncached functions; value-dependent
+  Python branching on a traced (non-static) parameter of a jitted
+  function; non-literal ``static_argnums``/``static_argnames``.
+
+* **TPL162 — dtype-promotion drift.**  ``jnp.asarray``/``jnp.array``/
+  ``jnp.zeros``/``jnp.ones``/``jnp.full``/``jnp.empty`` without an
+  explicit dtype inside a loop: weak-typed results re-key the jit
+  cache when promotion flips (x64 flags, int32/int64 hosts) and upload
+  a fresh scalar per iteration.
+
+* **TPL163 — donation misses.**  ``jax.jit`` over a function that
+  threads a KV cache / optimizer state (parameter named in
+  :data:`DONATABLE_PARAMS`) without ``donate_argnums``/
+  ``donate_argnames``: un-donated decode copies the full
+  (L, B, S_max, KV, HD) cache pair every step.
+
+All four are repo-scoped with the whole JAX plane as rule anchors, so
+``tpulint --changed`` runs them whenever any plane file is touched.
+Suppress intentional exceptions per line with ``# tpulint:
+disable=TPL16x`` plus a reason — see docs/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from tpuslo.analysis.core import FileContext, Finding, RepoContext, Rule
+from tpuslo.analysis.hotpaths import JAX_HOT_LOOPS, JAX_PLANE_PREFIXES
+from tpuslo.analysis.rules_hotpath import _function_index
+
+_MANIFEST_REL = "tpuslo/analysis/hotpaths.py"
+
+#: Parameter names that carry large mutable device state through a
+#: jitted step; threading one through undonated is a per-step copy.
+DONATABLE_PARAMS = frozenset(
+    {"cache", "kv", "kv_cache", "cache_t", "cache_d", "state", "opt_state"}
+)
+
+_CACHING_DECORATORS = frozenset({"lru_cache", "cache"})
+_SCALAR_CASTS = frozenset({"int", "float", "bool"})
+_DTYPE_CTORS = {
+    # name -> index of the positional arg that would carry the dtype
+    "asarray": 1,
+    "array": 1,
+    "zeros": 1,
+    "ones": 1,
+    "empty": 1,
+    "full": 2,
+}
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """``jax.device_get`` for Attribute chains, ``print`` for Names."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _root_name(node: ast.AST) -> str | None:
+    """Base Name of a Subscript/Attribute/unary chain (``x[0].T`` -> x)."""
+    while isinstance(node, (ast.Subscript, ast.Attribute, ast.Starred)):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _is_device_call(node: ast.AST) -> bool:
+    """A call whose result lives on device: jnp.*, jax.* (except the
+    explicit host reads), jax.random.*, lax.*, and method chains on
+    any of those (``jnp.argmax(...).astype(...)``)."""
+    if not isinstance(node, ast.Call):
+        return False
+    dotted = _dotted(node.func)
+    if not dotted:
+        if isinstance(node.func, ast.Attribute):
+            # Method on another call's result inherits its placement.
+            return _is_device_call(node.func.value)
+        return False
+    root = dotted.split(".", 1)[0]
+    if root == "jnp" or root == "lax":
+        return True
+    if root == "jax":
+        return dotted not in (
+            "jax.device_get",
+            "jax.block_until_ready",
+        )
+    return False
+
+
+def _is_host_call(node: ast.AST) -> bool:
+    """A call whose result is host-side: device_get, np.*, scalar
+    casts, list/len, and method chains on those
+    (``jax.device_get(x).tolist()``)."""
+    if not isinstance(node, ast.Call):
+        return False
+    dotted = _dotted(node.func)
+    if not dotted:
+        if isinstance(node.func, ast.Attribute):
+            return _is_host_call(node.func.value)
+        return False
+    if dotted == "jax.device_get" or dotted == "device_get":
+        return True
+    root = dotted.split(".", 1)[0]
+    if root in ("np", "numpy"):
+        return True
+    return dotted in ("int", "float", "bool", "list", "len", "tuple")
+
+
+def _assigned_names(target: ast.AST) -> Iterator[str]:
+    if isinstance(target, ast.Name):
+        yield target.id
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            yield from _assigned_names(elt)
+
+
+def _classify_names(
+    fn: ast.FunctionDef | ast.AsyncFunctionDef,
+) -> tuple[set[str], set[str]]:
+    """(device_names, host_names) assigned anywhere in ``fn``.
+
+    A name is *device* when any assignment binds it to a jnp/jax call
+    (device wins over host on conflict — flagging a sync on a
+    sometimes-device value is the safe direction); *host* when bound
+    from ``jax.device_get``/np/scalar casts.
+    """
+    device: set[str] = set()
+    host: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            targets: list[ast.AST] = list(node.targets)
+            value = node.value
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+            value = node.value
+            if value is None:
+                continue
+        else:
+            continue
+        if _is_device_call(value):
+            bucket = device
+        elif _is_host_call(value):
+            bucket = host
+        else:
+            continue
+        for target in targets:
+            bucket.update(_assigned_names(target))
+    return device, host - device
+
+
+def _provably_host(node: ast.AST, host: set[str], device: set[str]) -> bool:
+    """Receiver is a host-side value: rooted at a device_get/np call or
+    at a name only ever host-assigned."""
+    base = node
+    while isinstance(base, (ast.Subscript, ast.Attribute)):
+        base = base.value
+    if isinstance(base, ast.Call):
+        return _is_host_call(base)
+    if isinstance(base, ast.Name):
+        return base.id in host and base.id not in device
+    return False
+
+
+def _loop_bodies(
+    fn: ast.FunctionDef | ast.AsyncFunctionDef,
+) -> Iterator[ast.AST]:
+    """Every node inside a for/while loop of ``fn``, once — nested
+    loops are walked by their enclosing loop's traversal, so yielding
+    their own walk too would double-report each hazard."""
+    seen: set[int] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+            for child in node.body + node.orelse:
+                for sub in ast.walk(child):
+                    if id(sub) not in seen:
+                        seen.add(id(sub))
+                        yield sub
+
+
+def _jit_static_params(
+    fn: ast.FunctionDef | ast.AsyncFunctionDef,
+) -> tuple[bool, set[str]] | None:
+    """(is_jitted, static param names) when ``fn`` is decorated with
+    jax.jit (bare or via partial); None when it is not."""
+    for deco in fn.decorator_list:
+        if _dotted(deco) == "jax.jit":
+            return True, set()
+        if (
+            isinstance(deco, ast.Call)
+            and deco.args
+            and _dotted(deco.func) in ("partial", "functools.partial")
+            and _dotted(deco.args[0]) == "jax.jit"
+        ):
+            params = [a.arg for a in fn.args.args]
+            static: set[str] = set()
+            for kw in deco.keywords:
+                if kw.arg == "static_argnums":
+                    for idx in _literal_ints(kw.value):
+                        if 0 <= idx < len(params):
+                            static.add(params[idx])
+                elif kw.arg == "static_argnames":
+                    static.update(_literal_strs(kw.value))
+            return True, static
+    return None
+
+
+def _literal_ints(node: ast.AST) -> list[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            out.extend(_literal_ints(elt))
+        return out
+    return []
+
+
+def _literal_strs(node: ast.AST) -> list[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            out.extend(_literal_strs(elt))
+        return out
+    return []
+
+
+def _is_literal_argnums(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, str))
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_is_literal_argnums(e) for e in node.elts)
+    return False
+
+
+class _Scope:
+    """Ancestry walk: every node with its enclosing functions/loops."""
+
+    def __init__(self, tree: ast.Module):
+        #: node -> (enclosing defs outermost-first, inside_loop)
+        self.items: list[tuple[ast.AST, tuple[ast.AST, ...], bool]] = []
+        self._walk(tree, (), False)
+
+    def _walk(
+        self, node: ast.AST, defs: tuple[ast.AST, ...], in_loop: bool
+    ) -> None:
+        for child in ast.iter_child_nodes(node):
+            # The child itself is attributed to the ENCLOSING chain (a
+            # def is not nested inside itself); recursion then extends
+            # the chain for the child's own body.
+            self.items.append((child, defs, in_loop))
+            child_defs = defs
+            child_loop = in_loop
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child_defs = defs + (child,)
+                child_loop = False  # a nested def is a new call frame
+            elif isinstance(child, (ast.For, ast.While, ast.AsyncFor)):
+                child_loop = True
+            self._walk(child, child_defs, child_loop)
+
+
+def _has_caching_decorator(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
+    for deco in fn.decorator_list:
+        target = deco.func if isinstance(deco, ast.Call) else deco
+        dotted = _dotted(target) or ""
+        if dotted.split(".")[-1] in _CACHING_DECORATORS:
+            return True
+    return False
+
+
+class TraceDisciplineRule(Rule):
+    """TPL160-163 over the JAX plane; see the module docstring."""
+
+    code = "TPL160"
+    codes = ("TPL160", "TPL161", "TPL162", "TPL163")
+    name = "trace-discipline"
+    rationale = (
+        "the JAX serving plane must not host-sync inside registered "
+        "decode/verify loops, rebuild jit wrappers per call, drift "
+        "dtypes in hot loops, or thread KV caches undonated"
+    )
+    #: The whole plane rides along on --changed runs, so touching any
+    #: models/ops/parallel file re-checks every plane contract.
+    repo_anchors = JAX_PLANE_PREFIXES + (_MANIFEST_REL,)
+
+    def __init__(
+        self,
+        hot_loops: tuple[tuple[str, str], ...] = JAX_HOT_LOOPS,
+        plane_prefixes: tuple[str, ...] = JAX_PLANE_PREFIXES,
+    ):
+        self._hot_loops = hot_loops
+        self._plane = plane_prefixes
+
+    def check_repo(self, repo: RepoContext) -> Iterable[Finding]:
+        if not (repo.root / _MANIFEST_REL).exists():
+            # The manifest governs the repo that contains it (the
+            # hotpaths discipline); fixture trees have nothing to hold.
+            return ()
+        findings: list[Finding] = []
+        plane = [
+            f
+            for f in repo.files
+            if f.tree is not None and f.rel.startswith(self._plane)
+        ]
+        findings.extend(self._check_hot_loops(repo))
+        param_index = self._param_index(plane)
+        for ctx in plane:
+            findings.extend(self._check_file(ctx, param_index))
+        return findings
+
+    # --- TPL160: host syncs inside registered hot loops ----------------
+
+    def _check_hot_loops(self, repo: RepoContext) -> Iterator[Finding]:
+        indexes: dict[str, dict] = {}
+        for rel, qualname in self._hot_loops:
+            ctx = repo.by_rel.get(rel)
+            if ctx is None or ctx.tree is None:
+                yield Finding(
+                    _MANIFEST_REL,
+                    1,
+                    "TPL160",
+                    f"JAX_HOT_LOOPS entry {rel}:{qualname} points at a "
+                    "missing or unparseable file — update the manifest "
+                    "with the move",
+                )
+                continue
+            if rel not in indexes:
+                indexes[rel] = _function_index(ctx.tree)
+            fn = indexes[rel].get(qualname)
+            if fn is None:
+                yield Finding(
+                    _MANIFEST_REL,
+                    1,
+                    "TPL160",
+                    f"JAX_HOT_LOOPS entry {rel}:{qualname} not found — "
+                    "update the manifest with the rename",
+                )
+                continue
+            device, host = _classify_names(fn)
+            for node in _loop_bodies(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                yield from self._sync_hazard(
+                    ctx.rel, qualname, node, device, host
+                )
+
+    def _sync_hazard(
+        self,
+        rel: str,
+        qualname: str,
+        node: ast.Call,
+        device: set[str],
+        host: set[str],
+    ) -> Iterator[Finding]:
+        dotted = _dotted(node.func) or ""
+        if dotted in ("jax.block_until_ready",) or (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "block_until_ready"
+        ):
+            yield Finding(
+                rel,
+                node.lineno,
+                "TPL160",
+                f"hot loop {qualname} calls block_until_ready inside "
+                "the loop (a full device sync per iteration)",
+            )
+            return
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("item", "tolist")
+            and not node.args
+        ):
+            if not _provably_host(node.func.value, host, device):
+                yield Finding(
+                    rel,
+                    node.lineno,
+                    "TPL160",
+                    f"hot loop {qualname} calls .{node.func.attr}() on "
+                    "a value not provably host-side (device sync per "
+                    "iteration; read once via jax.device_get)",
+                )
+            return
+        if dotted in _SCALAR_CASTS and len(node.args) == 1:
+            root = _root_name(node.args[0])
+            if (root and root in device) or _is_device_call(node.args[0]):
+                yield Finding(
+                    rel,
+                    node.lineno,
+                    "TPL160",
+                    f"hot loop {qualname} calls {dotted}() on a device "
+                    "value (blocking scalar transfer per iteration; "
+                    "batch the read through jax.device_get)",
+                )
+            return
+        if dotted in ("np.asarray", "np.array", "numpy.asarray", "numpy.array"):
+            if node.args:
+                root = _root_name(node.args[0])
+                if (root and root in device) or _is_device_call(node.args[0]):
+                    yield Finding(
+                        rel,
+                        node.lineno,
+                        "TPL160",
+                        f"hot loop {qualname} materializes a device "
+                        f"array via {dotted} (host sync per iteration; "
+                        "use jax.device_get)",
+                    )
+
+    # --- file-scoped TPL161/162 + call sites for TPL163 -----------------
+
+    def _param_index(self, plane: list[FileContext]) -> dict[str, list[str]]:
+        """Top-level function name -> parameter names, plane-wide (for
+        resolving what a ``jax.jit(partial(f, ...))`` wraps)."""
+        index: dict[str, list[str]] = {}
+        for ctx in plane:
+            assert ctx.tree is not None
+            for node in ctx.tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    index[node.name] = [a.arg for a in node.args.args]
+        return index
+
+    def _check_file(
+        self, ctx: FileContext, param_index: dict[str, list[str]]
+    ) -> Iterator[Finding]:
+        assert ctx.tree is not None
+        scope = _Scope(ctx.tree)
+        local_defs = {
+            node.name: [a.arg for a in node.args.args]
+            for node, _defs, _loop in scope.items
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        for node, defs, in_loop in scope.items:
+            if isinstance(node, ast.Call) and _dotted(node.func) == "jax.jit":
+                yield from self._jit_site(
+                    ctx, node, defs, in_loop, local_defs, param_index
+                )
+            elif isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and defs:
+                jitted = _jit_static_params(node)
+                if jitted is not None and not any(
+                    _has_caching_decorator(d)
+                    for d in defs
+                    if isinstance(d, (ast.FunctionDef, ast.AsyncFunctionDef))
+                ):
+                    yield Finding(
+                        ctx.rel,
+                        node.lineno,
+                        "TPL161",
+                        f"@jax.jit def {node.name} nested in an uncached "
+                        "function retraces per enclosing call — hoist it "
+                        "or cache the builder with functools.lru_cache",
+                    )
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                jitted = _jit_static_params(node)
+                if jitted is not None:
+                    yield from self._traced_branching(ctx, node, jitted[1])
+                    yield from self._decorator_jit_site(ctx, node)
+            if in_loop and isinstance(node, ast.Call):
+                yield from self._dtype_drift(ctx, node)
+
+    def _jit_site(
+        self,
+        ctx: FileContext,
+        node: ast.Call,
+        defs: tuple[ast.AST, ...],
+        in_loop: bool,
+        local_defs: dict[str, list[str]],
+        param_index: dict[str, list[str]],
+    ) -> Iterator[Finding]:
+        if in_loop:
+            yield Finding(
+                ctx.rel,
+                node.lineno,
+                "TPL161",
+                "jax.jit constructed inside a loop — every iteration "
+                "builds a fresh wrapper with an empty executable cache "
+                "(guaranteed retrace); build once outside the loop",
+            )
+        elif defs and not any(
+            _has_caching_decorator(d)
+            for d in defs
+            if isinstance(d, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ):
+            fn_name = defs[-1].name if hasattr(defs[-1], "name") else "?"
+            yield Finding(
+                ctx.rel,
+                node.lineno,
+                "TPL161",
+                f"jax.jit constructed per call of {fn_name} — identical "
+                "programs recompile for every call; memoize the builder "
+                "with functools.lru_cache (the serve.py shared-kernel "
+                "discipline)",
+            )
+        for kw in node.keywords:
+            if kw.arg in ("static_argnums", "static_argnames"):
+                if not _is_literal_argnums(kw.value):
+                    yield Finding(
+                        ctx.rel,
+                        node.lineno,
+                        "TPL161",
+                        f"{kw.arg} must be a literal int/str (tuple): a "
+                        "computed value can vary between builds and "
+                        "silently re-key the jit cache",
+                    )
+        # TPL163: donation misses on cache-threading targets.
+        params = self._wrapped_params(node, local_defs, param_index)
+        donatable = sorted(DONATABLE_PARAMS.intersection(params or ()))
+        if donatable and not any(
+            kw.arg in ("donate_argnums", "donate_argnames")
+            for kw in node.keywords
+        ):
+            yield Finding(
+                ctx.rel,
+                node.lineno,
+                "TPL163",
+                "jax.jit threads large mutable state "
+                f"({', '.join(donatable)}) without donate_argnums — "
+                "un-donated steps copy the full buffers every call",
+            )
+
+    def _decorator_jit_site(
+        self,
+        ctx: FileContext,
+        fn: ast.FunctionDef | ast.AsyncFunctionDef,
+    ) -> Iterator[Finding]:
+        """TPL161/163 on decorator-form jits (bare ``@jax.jit`` and
+        ``@partial(jax.jit, ...)``) — the same contracts
+        :meth:`_jit_site` enforces on call-form sites, which never see
+        decorators (``@jax.jit`` is an Attribute, ``@partial(...)``'s
+        call target is partial)."""
+        for deco in fn.decorator_list:
+            keywords: list[ast.keyword] = []
+            if _dotted(deco) == "jax.jit":
+                pass  # bare form: no kwargs, donation still checkable
+            elif (
+                isinstance(deco, ast.Call)
+                and deco.args
+                and _dotted(deco.func) in ("partial", "functools.partial")
+                and _dotted(deco.args[0]) == "jax.jit"
+            ):
+                keywords = deco.keywords
+            else:
+                continue
+            for kw in keywords:
+                if kw.arg in ("static_argnums", "static_argnames"):
+                    if not _is_literal_argnums(kw.value):
+                        yield Finding(
+                            ctx.rel,
+                            fn.lineno,
+                            "TPL161",
+                            f"{kw.arg} must be a literal int/str (tuple)"
+                            ": a computed value can vary between builds "
+                            "and silently re-key the jit cache",
+                        )
+            donatable = sorted(
+                DONATABLE_PARAMS.intersection(
+                    a.arg for a in fn.args.args
+                )
+            )
+            if donatable and not any(
+                kw.arg in ("donate_argnums", "donate_argnames")
+                for kw in keywords
+            ):
+                yield Finding(
+                    ctx.rel,
+                    fn.lineno,
+                    "TPL163",
+                    "jax.jit threads large mutable state "
+                    f"({', '.join(donatable)}) without donate_argnums "
+                    "— un-donated steps copy the full buffers every "
+                    "call",
+                )
+
+    def _wrapped_params(
+        self,
+        node: ast.Call,
+        local_defs: dict[str, list[str]],
+        param_index: dict[str, list[str]],
+    ) -> list[str] | None:
+        if not node.args:
+            return None
+        target = node.args[0]
+        if (
+            isinstance(target, ast.Call)
+            and _dotted(target.func) in ("partial", "functools.partial")
+            and target.args
+        ):
+            bound = {kw.arg for kw in target.keywords if kw.arg}
+            inner = self._wrapped_name_params(
+                target.args[0], local_defs, param_index
+            )
+            if inner is None:
+                return None
+            return [p for p in inner if p not in bound]
+        if isinstance(target, ast.Lambda):
+            return [a.arg for a in target.args.args]
+        return self._wrapped_name_params(target, local_defs, param_index)
+
+    def _wrapped_name_params(
+        self,
+        target: ast.AST,
+        local_defs: dict[str, list[str]],
+        param_index: dict[str, list[str]],
+    ) -> list[str] | None:
+        name = None
+        if isinstance(target, ast.Name):
+            name = target.id
+        elif isinstance(target, ast.Attribute):
+            name = target.attr
+        if name is None:
+            return None
+        return local_defs.get(name) or param_index.get(name)
+
+    def _traced_branching(
+        self,
+        ctx: FileContext,
+        fn: ast.FunctionDef | ast.AsyncFunctionDef,
+        static: set[str],
+    ) -> Iterator[Finding]:
+        params = {a.arg for a in fn.args.args} - static
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            for name in self._bare_names_in_test(node.test):
+                if name in params:
+                    yield Finding(
+                        ctx.rel,
+                        node.lineno,
+                        "TPL161",
+                        f"Python branch on traced argument {name!r} "
+                        "inside a jitted function — value-dependent "
+                        "control flow retraces (or fails concretization)"
+                        "; use lax.cond/where or make it static",
+                    )
+
+    def _bare_names_in_test(self, test: ast.AST) -> Iterator[str]:
+        """Bare Name operands of a branch test — NOT attributes or
+        subscripts (``x.ndim``/``x.shape[0]`` branching is static and
+        legitimate), and NOT identity tests against None (``mask is
+        None`` keys on pytree structure, part of the jit cache key —
+        the canonical optional-argument idiom never retraces)."""
+        if isinstance(test, ast.Name):
+            yield test.id
+        elif isinstance(test, ast.BoolOp):
+            for value in test.values:
+                yield from self._bare_names_in_test(value)
+        elif isinstance(test, ast.UnaryOp):
+            yield from self._bare_names_in_test(test.operand)
+        elif isinstance(test, ast.Compare):
+            if all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+            ) and any(
+                isinstance(c, ast.Constant) and c.value is None
+                for c in [test.left, *test.comparators]
+            ):
+                return
+            for operand in [test.left, *test.comparators]:
+                if isinstance(operand, ast.Name):
+                    yield operand.id
+
+    def _dtype_drift(self, ctx: FileContext, node: ast.Call) -> Iterator[Finding]:
+        dotted = _dotted(node.func) or ""
+        if not dotted.startswith(("jnp.", "jax.numpy.")):
+            return
+        ctor = dotted.split(".")[-1]
+        dtype_pos = _DTYPE_CTORS.get(ctor)
+        if dtype_pos is None:
+            return
+        if len(node.args) > dtype_pos:
+            return
+        if any(kw.arg == "dtype" for kw in node.keywords):
+            return
+        yield Finding(
+            ctx.rel,
+            node.lineno,
+            "TPL162",
+            f"jnp.{ctor} without an explicit dtype inside a loop — "
+            "weak-typed results re-key the jit cache when promotion "
+            "flips and churn per-iteration uploads; pass dtype",
+        )

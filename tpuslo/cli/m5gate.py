@@ -116,6 +116,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="run the delivery/runtime/obs suites under the dynamic "
         "lock-order race detector (TPUSLO_RACECHECK=1)",
     )
+    p.add_argument(
+        "--jitcheck-smoke",
+        action="store_true",
+        help="run the serving suites under the dynamic retrace/"
+        "host-sync auditor (TPUSLO_JITAUDIT=1): the session fails if "
+        "a steady-state decode loop triggers an XLA backend compile",
+    )
     # ---- error-budget burn-scenario gate (tpuslo.sloengine) -----------
     p.add_argument(
         "--burn-sweep",
@@ -511,12 +518,32 @@ def run_racecheck_gate() -> int:
     return proc.returncode
 
 
+def run_jitcheck_gate() -> int:
+    import os
+    import subprocess
+
+    from tpuslo.analysis.jitaudit import ENV_FLAG, SMOKE_SUITES
+
+    env = dict(os.environ, **{ENV_FLAG: "1"})
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", *SMOKE_SUITES, "-q"], env=env
+    )
+    print(
+        f"m5gate: jitcheck-smoke "
+        f"{'PASS' if proc.returncode == 0 else 'FAIL'}",
+        file=sys.stderr,
+    )
+    return proc.returncode
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     if args.lint:
         return run_lint_gate()
     if args.racecheck_smoke:
         return run_racecheck_gate()
+    if args.jitcheck_smoke:
+        return run_jitcheck_gate()
     if args.burn_sweep:
         return run_burn_gate(args)
     if args.fleet_sweep:

@@ -249,6 +249,9 @@ def init_params_quantized(rng: jax.Array, cfg: LlamaConfig) -> PyTree:
     keys = jax.random.split(k_layers, 7)
 
     @partial(jax.jit, static_argnums=(1, 2))
+    # init-time one-shot: each (shape, fan) leaf compiles exactly
+    # once per model construction by design.
+    # tpulint: disable=TPL161
     def dense_q(key, shape, fan_in):
         # One fused executable per leaf: RNG -> scale -> round -> int8.
         # The bf16 intermediate lives only inside the program, and one

@@ -448,6 +448,9 @@ class MoEServeEngine:
                 # or ep) — no device ever holds the full expert tree
                 # (the 8x7B-over-v5e-8 path, mirroring the dense 70B
                 # init discipline).
+                # init-time one-shot jit: runs once per engine to
+                # materialize sharded params.
+                # tpulint: disable=TPL161
                 params = jax.jit(
                     partial(init_params, cfg=self.cfg),
                     out_shardings=shardings,

@@ -12,7 +12,9 @@ The JAX backend behind the demo RAG service (replacing the reference's
 
 from __future__ import annotations
 
+import sys
 import time
+from contextlib import contextmanager
 from dataclasses import dataclass
 from functools import lru_cache, partial
 from typing import Iterator
@@ -38,6 +40,38 @@ from tpuslo.models.llama import (
 
 BOS = 256
 EOS = 257
+
+
+def _audit_registry():
+    """The jitaudit registry when the auditor is loaded AND installed.
+
+    Resolved through ``sys.modules`` so the serving plane never imports
+    the static-analysis package (a layering inversion that would pull
+    the whole AST rule engine into every serving process): if nobody
+    imported ``tpuslo.analysis.jitaudit``, it cannot be installed.
+    """
+    mod = sys.modules.get("tpuslo.analysis.jitaudit")
+    if mod is not None and mod.installed():
+        return mod.registry()
+    return None
+
+
+@contextmanager
+def _steady_section(audit, label: str, warmed: bool):
+    """Steady-state audit section over a serving loop's dispatch +
+    fused read; a no-op before warmup (first iteration may first-hit
+    compile) or when auditing is off.  The ``with`` body must NOT span
+    generator yields: a suspended generator would attribute another
+    engine's legitimate first-hit compile to this loop.
+    """
+    if audit is None or not warmed:
+        yield
+        return
+    audit.push_section(label, steady=True)
+    try:
+        yield
+    finally:
+        audit.pop_section()
 
 
 def suffix_prefill(params, tokens, kv, start, true_length, cfg):
@@ -378,6 +412,9 @@ class ServeEngine:
             # (int8 70B ~70 GB over 8 x 16 GB chips).
             abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(rng_seed))
             shardings = serve_param_shardings(abstract, mesh)
+            # init-time one-shot jit: runs once per engine to
+            # materialize sharded params.
+            # tpulint: disable=TPL161
             params = jax.jit(init_fn, out_shardings=shardings)(
                 jax.random.PRNGKey(rng_seed)
             )
@@ -838,6 +875,9 @@ class ServeEngine:
                 # Drain the async predecessor chunks BEFORE timing, or
                 # the recorded "compile" would include their queued
                 # compute (a phantom recompile-storm signal).
+                # first-hit only: guarded by the per-shape seen set,
+                # never a steady-state sync.
+                # tpulint: disable=TPL160
                 jax.block_until_ready(cache)
             t0 = time.perf_counter()
             logits, cache = self._suffix_prefill(
@@ -852,6 +892,9 @@ class ServeEngine:
                 # chunks stay async so the host preps chunk N+1 while
                 # the device runs chunk N (they serialize on the cache
                 # dependency anyway).
+                # first-hit compile timing only; steady-state chunks
+                # stay async.
+                # tpulint: disable=TPL160
                 logits.block_until_ready()
                 self._record_compile(
                     "suffix", bucket, (time.perf_counter() - t0) * 1000.0
@@ -1011,19 +1054,32 @@ class ServeEngine:
             return
 
         idx = 1
+        # Post-warmup decode is fixed-shape: under the retrace auditor
+        # (TPUSLO_JITAUDIT=1) chunk dispatches after the first loop
+        # iteration run inside a steady-state section — iteration 1
+        # may first-hit-compile the chunk kernel and the fused-read
+        # getitem; any later backend compile is retrace churn and
+        # fails the session.  The section covers exactly the dispatch
+        # + fused read, NOT the yields (a suspended generator must not
+        # attribute another engine's first-hit compile to this loop).
+        audit = _audit_registry()
+        loop_iters = 0
         while idx < max_new_tokens:
             # Issue chunk N+1 from the on-device last token of chunk N
             # (only when tokens beyond this chunk are still needed),
             # then read chunk N — the device computes ahead while the
             # host streams, hiding the transfer round-trip.
-            next_toks = next_last = None
-            if idx + chunk < max_new_tokens:
-                chunk_idx += 1
-                next_toks, next_last, cache = decode_fn(
-                    self.params, last, cache,
-                    sampling=sampling, rng=chunk_rng(chunk_idx),
-                )
-            for value in jax.device_get(toks[0]).tolist():
+            with _steady_section(audit, "serve.generate", loop_iters >= 1):
+                next_toks = next_last = None
+                if idx + chunk < max_new_tokens:
+                    chunk_idx += 1
+                    next_toks, next_last, cache = decode_fn(
+                        self.params, last, cache,
+                        sampling=sampling, rng=chunk_rng(chunk_idx),
+                    )
+                chunk_values = jax.device_get(toks[0]).tolist()
+            loop_iters += 1
+            for value in chunk_values:
                 yield TokenEvent(int(value), idx)
                 idx += 1
                 if stop_at_eos and value == EOS:

@@ -84,6 +84,9 @@ def _collective_fn(op: str, mesh, axis: str):
         out_spec = P(axis, None)
     else:
         raise ValueError(f"unknown collective op {op!r}")
+    # built once per CollectiveSuite (the constructor compiles;
+    # measure() only replays), not per probe.
+    # tpulint: disable=TPL161
     return jax.jit(
         shard_map(body, mesh=mesh, in_specs=P(axis, None), out_specs=out_spec)
     )

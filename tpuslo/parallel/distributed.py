@@ -145,6 +145,9 @@ def worker_main(argv: list[str] | None = None) -> int:
         )
 
         @jax.jit
+        # dryrun harness: compiled once per process run, explicitly
+        # warmed before the timed launches.
+        # tpulint: disable=TPL161
         def intra_reduce(v):
             return shard_map(
                 lambda s: jax.lax.psum(s, "host"),
@@ -152,6 +155,9 @@ def worker_main(argv: list[str] | None = None) -> int:
             )(v)
 
         @jax.jit
+        # dryrun harness: compiled once per process run, explicitly
+        # warmed before the timed launches.
+        # tpulint: disable=TPL161
         def allreduce(v):
             return shard_map(
                 lambda s: jax.lax.psum(s, ("slice", "host")),
@@ -168,6 +174,9 @@ def worker_main(argv: list[str] | None = None) -> int:
         intra_reduce = None
 
         @jax.jit
+        # dryrun harness: compiled once per process run, explicitly
+        # warmed before the timed launches.
+        # tpulint: disable=TPL161
         def allreduce(v):
             return shard_map(
                 lambda s: jax.lax.psum(s, "hosts"),
