@@ -12,6 +12,7 @@ ARTIFACTS ?= artifacts
 	chaos-smoke chaos-demo chaos-telemetry-smoke \
 	chaos-telemetry-sweep crash-smoke crash-sweep obs-smoke \
 	burn-smoke burn-sweep fleet-smoke fleet-sweep \
+	remediation-smoke remediation-sweep \
 	metrics-drift m5-candidate m5-gate helm-lint dashboards clean
 
 all: native test
@@ -205,6 +206,27 @@ burn-sweep:
 		--summary-json $(ARTIFACTS)/burn/sweep.json \
 		--summary-md $(ARTIFACTS)/burn/sweep.md
 
+# Auto-remediation smoke: policy matching (cooldown / rate-limit /
+# budget edges), every action's apply/rollback round trip, verifier
+# confirm/rollback/hysteresis, engine export/restore parity, ownership
+# precedence vs the supervisor hold-down, and provenance completeness.
+remediation-smoke:
+	$(PY) -m pytest tests/test_remediation.py -q -m 'not slow'
+
+# Full auto-remediation release gate: seeded fault scenarios through
+# observe -> attribute -> remediate -> verify; fails on any action
+# against a healthy/low-confidence target, a verify that neither
+# confirms nor rolls back within the window budget, a storm that
+# escapes the dampers, a duplicate action across the mid-sweep kill,
+# or an action missing from the provenance chain
+# (see docs/runbooks/auto-remediation.md).
+remediation-sweep:
+	mkdir -p $(ARTIFACTS)/remediation
+	$(PY) -m tpuslo m5gate --remediation-sweep \
+		--remediation-provenance-dir $(ARTIFACTS)/remediation \
+		--summary-json $(ARTIFACTS)/remediation/sweep.json \
+		--summary-md $(ARTIFACTS)/remediation/sweep.md
+
 # Fleet observability-plane smoke: wire contract round trips, hash-ring
 # placement, rollup merge invariants (no cross-tenant/cross-domain),
 # aggregator seq-dedup + failover absorb, and a small seeded simulator
@@ -267,10 +289,12 @@ m5-candidate:
 
 # Release candidates fail on new lint findings, lock-order races,
 # steady-state decode recompiles, burn-alert contract violations,
-# row-vs-columnar divergence, or a broken fleet plane before the
-# statistical gates even run (ISSUEs 6 + 7 + 8 + 9 + 10).
+# row-vs-columnar divergence, a broken fleet plane, or a remediation
+# loop that acts imprecisely before the statistical gates even run
+# (ISSUEs 6 + 7 + 8 + 9 + 10 + 11).
 m5-gate: lint racecheck-smoke jitcheck-smoke burn-smoke burn-sweep \
-		bench-columnar-smoke fleet-smoke fleet-sweep
+		bench-columnar-smoke fleet-smoke fleet-sweep \
+		remediation-smoke remediation-sweep
 	$(PY) -m tpuslo m5gate --candidate-root $(ARTIFACTS)/m5 \
 		--scenarios "$(shell echo $(M5_SCENARIOS) | tr ' ' ',')" \
 		--summary-json $(ARTIFACTS)/m5/gate.json \

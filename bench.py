@@ -415,6 +415,90 @@ def bench_fleet(
     return result
 
 
+# Auto-remediation release contract (ISSUE 11): the action loop must
+# hold precision 1.0 (zero false actions) and mitigate within the
+# verifier's window budget of event time.
+REMEDIATION_FALSE_ACTION_CEILING = 0.0
+REMEDIATION_TIME_TO_MITIGATE_P99_CEILING_S = 600.0
+
+
+def bench_remediation(seeds: tuple[int, ...] = (1337, 7, 42)) -> dict:
+    """Time-to-mitigate distribution + false-action rate for the
+    observe → attribute → remediate → verify loop.
+
+    Runs the full seeded sweep per seed (every scenario: precision
+    probes, confirmed mitigations, a forced rollback, the storm, the
+    mid-sweep kill) and digests the loop's two headline numbers: how
+    fast a confirmed action's burn verifiably subsided (event-time
+    p50/p99 across all confirmed actions) and how often the loop acted
+    where it should not have (hard-gated at zero).
+    """
+    from tpuslo.remediation.sweep import run_remediation_sweep
+
+    eval_interval_s = 60.0
+    mitigate_times: list[float] = []
+    false_actions = 0
+    total_actions = 0
+    rolled_back = 0
+    all_passed = True
+    for seed in seeds:
+        report = run_remediation_sweep(
+            seed=seed, eval_interval_s=eval_interval_s
+        )
+        all_passed = all_passed and report.passed
+        for run in report.runs:
+            mitigate_times.extend(run.time_to_mitigate_s)
+            total_actions += len(run.actions)
+            rolled_back += sum(
+                1
+                for a in run.actions
+                if a["phase"] == "rolled_back"
+            )
+            false_actions += sum(
+                1 for f in run.failures if "unexpected action" in f
+            )
+    mitigate_times.sort()
+
+    def _quantile(q: float) -> float:
+        if not mitigate_times:
+            return 0.0
+        at = min(
+            len(mitigate_times) - 1, int(q * (len(mitigate_times) - 1))
+        )
+        return mitigate_times[at]
+
+    false_rate = false_actions / max(1, total_actions)
+    p99 = _quantile(0.99)
+    result = {
+        "remediation_seeds": list(seeds),
+        "remediation_actions": total_actions,
+        "remediation_confirmed": len(mitigate_times),
+        "remediation_rolled_back": rolled_back,
+        "remediation_time_to_mitigate_p50_s": round(_quantile(0.5), 1),
+        "remediation_time_to_mitigate_p99_s": round(p99, 1),
+        "remediation_false_action_rate": round(false_rate, 4),
+        "remediation_false_action_ceiling":
+            REMEDIATION_FALSE_ACTION_CEILING,
+        "remediation_mitigate_p99_ceiling_s":
+            REMEDIATION_TIME_TO_MITIGATE_P99_CEILING_S,
+        "remediation_gates_met": bool(
+            all_passed
+            and false_rate <= REMEDIATION_FALSE_ACTION_CEILING
+            and p99 <= REMEDIATION_TIME_TO_MITIGATE_P99_CEILING_S
+        ),
+    }
+    if not result["remediation_gates_met"]:
+        raise SystemExit(
+            "bench_remediation: action-loop contract not met — "
+            f"sweep passed={all_passed}, false-action rate "
+            f"{false_rate:.4f} (ceiling "
+            f"{REMEDIATION_FALSE_ACTION_CEILING}), time-to-mitigate "
+            f"p99 {p99:.0f}s (ceiling "
+            f"{REMEDIATION_TIME_TO_MITIGATE_P99_CEILING_S:.0f}s)"
+        )
+    return result
+
+
 # Columnar release floors (ISSUE 8): the gated spine must clear these
 # on the full bench run or bench.py hard-fails.  Enforced only at
 # gate-scale sample counts — tiny smoke batches can't amortize fixed
@@ -1254,6 +1338,23 @@ def _digest_pipeline(pipeline: dict) -> dict:
         }
         if (fleet := pipeline.get("fleet") or {})
         else {}
+    ) | (
+        {
+            "remediation_time_to_mitigate_p50_s": rem.get(
+                "remediation_time_to_mitigate_p50_s", 0.0
+            ),
+            "remediation_time_to_mitigate_p99_s": rem.get(
+                "remediation_time_to_mitigate_p99_s", 0.0
+            ),
+            "remediation_false_action_rate": rem.get(
+                "remediation_false_action_rate", 0.0
+            ),
+            "remediation_gates_met": bool(
+                rem.get("remediation_gates_met")
+            ),
+        }
+        if (rem := pipeline.get("remediation") or {})
+        else {}
     )
 
 
@@ -1434,6 +1535,9 @@ def main() -> int:
     # Fleet observability plane (ISSUE 9): aggregate sharded-aggregator
     # ingest + rollup latency, hard floors at gate scale.
     pipeline_result["fleet"] = bench_fleet()
+    # Auto-remediation loop (ISSUE 11): time-to-mitigate distribution
+    # + false-action rate, hard-gated at precision 1.0.
+    pipeline_result["remediation"] = bench_remediation()
     serving_result = bench_serving()
 
     full, compact = build_result(

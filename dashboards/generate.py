@@ -262,6 +262,20 @@ error_budget = dashboard(
         panel("Max burn rate (any tenant / objective / window)", [
             ('max(llm_slo_agent_slo_burn_rate)', "max burn"),
         ], 16, 24, w=8, kind="stat"),
+        # --- auto-remediation loop (tpuslo.remediation) --------------
+        panel("Remediation actions applied / rolled back (1h, by kind)", [
+            ('sum(increase(llm_slo_agent_remediation_actions_applied_total[1h])) by (action)', "{{action}} applied"),
+            ('sum(increase(llm_slo_agent_remediation_actions_rolled_back_total[1h])) by (action)', "{{action}} rolled back"),
+        ], 0, 32),
+        panel("Verify-or-rollback verdicts (1h)", [
+            ('sum(increase(llm_slo_agent_remediation_verify_outcomes_total[1h])) by (outcome)', "{{outcome}}"),
+        ], 12, 32),
+        panel("Remediation actions in flight (budget-bounded)", [
+            ('llm_slo_agent_remediation_actions_in_flight', "in flight"),
+        ], 0, 40, w=12, kind="stat"),
+        panel("Policy refusals by reason (held fire — precision evidence)", [
+            ('sum(increase(llm_slo_agent_remediation_refusals_total[1h])) by (reason)', "{{reason}}"),
+        ], 12, 40),
     ],
 )
 

@@ -1,0 +1,1093 @@
+"""Auto-remediation engine: policy matching (cooldown / rate-limit /
+budget edges), every action's apply/rollback round trip, verifier
+confirm/rollback/hysteresis, engine state-machine + crash-restart
+parity, shed-ownership precedence vs the supervisor hold-down, the
+provenance chain, the sloctl surfaces, and the seeded sweep gate.
+
+Style follows tests/test_fleet.py: unit tiers per module, seeded
+integration lanes, and regression tests for the review findings (the
+flap-shed precedence gap is satellite 2's named regression).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from tpuslo.delivery.breaker import (
+    STATE_CLOSED,
+    STATE_OPEN,
+    CircuitBreaker,
+)
+from tpuslo.fleet.aggregator import AggregatorShard
+from tpuslo.fleet.ring import HashRing
+from tpuslo.obs.provenance import (
+    ProvenanceLog,
+    ProvenanceRecord,
+    format_chain,
+    load_records,
+)
+from tpuslo.remediation import (
+    ACTION_BREAKER_TRIP,
+    ACTION_CORDON_NODE,
+    ACTION_DEMOTE_TENANT,
+    ACTION_PROBE_SHED,
+    ACTION_REHOME_SLICE,
+    PHASE_APPLY_FAILED,
+    PHASE_APPLYING,
+    PHASE_CONFIRMED,
+    PHASE_ROLLED_BACK,
+    PHASE_VERIFYING,
+    ActionBindings,
+    ActionRecord,
+    AttributionContext,
+    BreakerTripAction,
+    CordonNodeAction,
+    DemoteTenantAction,
+    DrainSnapshotAction,
+    ProbeShedAction,
+    RehomeSliceAction,
+    RemediationEngine,
+    RemediationPolicy,
+    VERDICT_CONFIRMED,
+    VERDICT_PENDING,
+    VERDICT_ROLLBACK,
+    VerifyPolicy,
+    VerifyState,
+    action_id_for,
+    default_rules,
+    observe_window,
+)
+from tpuslo.runtime.supervisor import ProbeSupervisor, SupervisorConfig
+from tpuslo.safety.recovery import (
+    OWNER_GUARD,
+    OWNER_REMEDIATION,
+    ShedOwnership,
+    ShedRecoveryPolicy,
+)
+from tpuslo.signals.generator import Generator
+from tpuslo.sloengine.engine import (
+    DEFAULT_ADMISSION_PRIORITY,
+    BurnEngine,
+    EngineConfig,
+)
+
+
+def _ctx(
+    domain: str = "tpu_hbm",
+    confidence: float = 0.95,
+    burn_state: str = "fast_burn",
+    incident: str = "inc-1",
+    tenant: str = "tenant-a",
+    **kw,
+) -> AttributionContext:
+    return AttributionContext(
+        incident_id=incident,
+        domain=domain,
+        confidence=confidence,
+        burn_state=burn_state,
+        tenant=tenant,
+        **kw,
+    )
+
+
+# ---------------------------------------------------------------------------
+# policy: matching + dampers
+# ---------------------------------------------------------------------------
+
+
+class TestPolicy:
+    def test_matches_high_confidence_fast_burn(self):
+        policy = RemediationPolicy()
+        decision = policy.decide(_ctx(), now_s=0.0, in_flight=0)
+        assert decision is not None
+        assert decision.action == ACTION_DEMOTE_TENANT
+        assert decision.target == "tenant-a"
+
+    def test_low_confidence_refused(self):
+        policy = RemediationPolicy()
+        assert policy.decide(
+            _ctx(confidence=0.5), now_s=0.0, in_flight=0
+        ) is None
+        assert policy.refusals.get("low_confidence", 0) == 1
+
+    def test_healthy_burn_state_refused(self):
+        policy = RemediationPolicy()
+        assert policy.decide(
+            _ctx(burn_state="ok"), now_s=0.0, in_flight=0
+        ) is None
+        assert policy.refusals.get("not_burning", 0) == 1
+
+    def test_unknown_domain_refused(self):
+        policy = RemediationPolicy()
+        assert policy.decide(
+            _ctx(domain="made_up"), now_s=0.0, in_flight=0
+        ) is None
+        assert policy.refusals.get("no_rule", 0) == 1
+
+    def test_global_budget_refused(self):
+        policy = RemediationPolicy(max_concurrent_actions=2)
+        assert policy.decide(_ctx(), now_s=0.0, in_flight=2) is None
+        assert policy.refusals.get("budget", 0) == 1
+
+    def test_cooldown_blocks_same_target(self):
+        policy = RemediationPolicy()
+        decision = policy.decide(_ctx(), now_s=0.0, in_flight=0)
+        policy.note_applied(decision.action, decision.target, 0.0)
+        # Same target inside the cooldown: refused.
+        assert policy.decide(
+            _ctx(incident="inc-2"), now_s=10.0, in_flight=0
+        ) is None
+        assert policy.refusals.get("cooldown", 0) == 1
+        # Past the cooldown it can act again.
+        assert policy.decide(
+            _ctx(incident="inc-3"), now_s=301.0, in_flight=0
+        ) is not None
+
+    def test_cooldown_does_not_block_other_target(self):
+        policy = RemediationPolicy()
+        policy.note_applied(ACTION_DEMOTE_TENANT, "tenant-a", 0.0)
+        assert policy.decide(
+            _ctx(incident="inc-2", tenant="tenant-b"),
+            now_s=10.0,
+            in_flight=0,
+        ) is not None
+
+    def test_rate_limit_per_kind(self):
+        policy = RemediationPolicy()
+        for i in range(3):
+            policy.note_applied(
+                ACTION_DEMOTE_TENANT, f"tenant-{i}", float(i)
+            )
+        assert policy.decide(
+            _ctx(incident="inc-x", tenant="tenant-z"),
+            now_s=10.0,
+            in_flight=0,
+        ) is None
+        assert policy.refusals.get("rate_limited", 0) == 1
+        # The window slides: an hour later the same kind can act.
+        assert policy.decide(
+            _ctx(incident="inc-y", tenant="tenant-z"),
+            now_s=3700.0,
+            in_flight=0,
+        ) is not None
+
+    def test_disabled_action_refused(self):
+        policy = RemediationPolicy(
+            disabled_actions=(ACTION_DEMOTE_TENANT,)
+        )
+        assert policy.decide(_ctx(), now_s=0.0, in_flight=0) is None
+        assert policy.refusals.get("disabled", 0) == 1
+
+    def test_node_slice_target_derivation(self):
+        policy = RemediationPolicy()
+        decision = policy.decide(
+            _ctx(domain="tpu_ici", node="n1", slice_id="s1"),
+            now_s=0.0,
+            in_flight=0,
+        )
+        assert decision.action == ACTION_CORDON_NODE
+        assert decision.target == "n1|s1"
+
+    def test_missing_node_target_refused(self):
+        policy = RemediationPolicy()
+        assert policy.decide(
+            _ctx(domain="tpu_ici"), now_s=0.0, in_flight=0
+        ) is None
+        assert policy.refusals.get("no_target", 0) == 1
+
+    def test_damper_state_round_trip(self):
+        policy = RemediationPolicy()
+        policy.note_applied(ACTION_DEMOTE_TENANT, "tenant-a", 100.0)
+        policy.decide(_ctx(burn_state="ok"), now_s=0.0, in_flight=0)
+        restored = RemediationPolicy()
+        restored.restore_state(policy.export_state())
+        assert restored.decisions == policy.decisions
+        # Cooldown survives the round trip.
+        assert restored.decide(
+            _ctx(incident="inc-2"), now_s=150.0, in_flight=0
+        ) is None
+        assert restored.refusals.get("cooldown", 0) == 1
+        # The pre-restart refusal counts carried over too.
+        assert restored.refusals.get("not_burning", 0) == 1
+
+
+# ---------------------------------------------------------------------------
+# actions: apply/rollback round trips against the real substrate
+# ---------------------------------------------------------------------------
+
+
+class TestActions:
+    def test_probe_shed_round_trip(self):
+        gen = Generator("tpu_full")
+        ownership = ShedOwnership()
+        action = ProbeShedAction(
+            "syscall_latency_ms", gen, ownership=ownership
+        )
+        assert action.apply().ok
+        assert "syscall_latency_ms" in gen.shed_signals()
+        assert ownership.owner_of("syscall_latency_ms") == (
+            OWNER_REMEDIATION
+        )
+        assert action.rollback().ok
+        assert "syscall_latency_ms" not in gen.shed_signals()
+        assert "syscall_latency_ms" in gen.enabled_signals()
+        assert ownership.owner_of("syscall_latency_ms") == ""
+
+    def test_probe_shed_refuses_foreign_shed(self):
+        gen = Generator("tpu_full")
+        ownership = ShedOwnership()
+        ownership.claim("syscall_latency_ms", OWNER_GUARD)
+        action = ProbeShedAction(
+            "syscall_latency_ms", gen, ownership=ownership
+        )
+        result = action.apply()
+        assert not result.ok
+        assert "guard" in result.detail
+
+    def test_probe_shed_refuses_untagged_existing_shed(self):
+        gen = Generator("tpu_full")
+        gen.import_shed(["syscall_latency_ms"])  # legacy untagged shed
+        ownership = ShedOwnership()
+        action = ProbeShedAction(
+            "syscall_latency_ms", gen, ownership=ownership
+        )
+        assert not action.apply().ok
+        # The refused apply must not leave a dangling claim behind.
+        assert ownership.owner_of("syscall_latency_ms") == ""
+
+    def test_probe_shed_rollback_respects_holddown(self):
+        gen = Generator("tpu_full")
+        ownership = ShedOwnership()
+        clock = [0.0]
+        supervisor = ProbeSupervisor(
+            SupervisorConfig(flap_holddown_s=300.0),
+            clock=lambda: clock[0],
+        )
+        action = ProbeShedAction(
+            "syscall_latency_ms",
+            gen,
+            ownership=ownership,
+            supervisor=supervisor,
+        )
+        assert action.apply().ok
+        # The supervisor flap-sheds the same signal while the
+        # remediation is in flight.
+        supervisor._held["syscall_latency_ms"] = 300.0
+        result = action.rollback()
+        assert result.ok and "held down" in result.detail
+        # The probe stays shed; ownership is released so the
+        # supervisor's machinery takes over.
+        assert "syscall_latency_ms" in gen.shed_signals()
+        assert ownership.owner_of("syscall_latency_ms") == ""
+
+    def test_breaker_trip_round_trip(self):
+        breaker = CircuitBreaker()
+        action = BreakerTripAction("otlp", breaker)
+        assert action.apply().ok
+        assert breaker.state == STATE_OPEN
+        assert action.rollback().ok
+        assert breaker.state == STATE_CLOSED
+
+    def test_breaker_family_trip_covers_every_otlp_channel(self):
+        """Review regression: the agent's OTLP path is one channel per
+        payload kind (otlp-slo/otlp-probe/otlp-traces) — a trip
+        targeting the "otlp" family must take the whole path offline,
+        and must not touch unrelated sinks."""
+        breakers = {
+            name: CircuitBreaker()
+            for name in (
+                "otlp-slo", "otlp-probe", "otlp-traces", "webhook",
+            )
+        }
+        bindings = ActionBindings(breakers=breakers)
+        action = bindings.build(ACTION_BREAKER_TRIP, "otlp")
+        assert action is not None
+        result = action.apply()
+        assert result.ok and "3 breaker(s)" in result.detail
+        for name in ("otlp-slo", "otlp-probe", "otlp-traces"):
+            assert breakers[name].state == STATE_OPEN, name
+        assert breakers["webhook"].state == STATE_CLOSED
+        assert action.rollback().ok
+        assert all(b.state == STATE_CLOSED for b in breakers.values())
+        # An unmatched family is unbound, not a silent no-op.
+        assert bindings.build(ACTION_BREAKER_TRIP, "nosuch") is None
+
+    def test_forced_close_clears_failure_streak(self):
+        breaker = CircuitBreaker(failure_threshold=2)
+        breaker.record_failure()
+        breaker.force_open()
+        breaker.force_close()
+        # A single failure off a stale streak must not re-open.
+        breaker.record_failure()
+        assert breaker.state == STATE_CLOSED
+
+    def test_cordon_round_trip(self):
+        ring = HashRing(["agg-0", "agg-1"], vnodes=8)
+        action = CordonNodeAction("n1", "s1", ring)
+        assert action.apply().ok
+        assert ring.is_cordoned("n1", "s1")
+        assert "n1" not in ring.assignments([("n1", "s1"), ("n2", "s1")])
+        assert not action.apply().ok  # idempotence guard
+        assert action.rollback().ok
+        assert not ring.is_cordoned("n1", "s1")
+        assert "n1" in ring.assignments([("n1", "s1")])
+
+    def test_cordon_survives_ring_snapshot(self):
+        ring = HashRing(["agg-0"], vnodes=8)
+        ring.cordon("n1", "s1")
+        restored = HashRing(["x"], vnodes=8)
+        restored.restore_state(ring.export_state())
+        assert restored.is_cordoned("n1", "s1")
+
+    def test_demote_tenant_round_trip(self):
+        burn = BurnEngine(EngineConfig())
+        action = DemoteTenantAction("tenant-a", burn)
+        assert action.apply().ok
+        assert burn.admission_priority("tenant-a") < (
+            DEFAULT_ADMISSION_PRIORITY
+        )
+        assert not action.apply().ok  # no stacked demotions
+        assert action.rollback().ok
+        assert burn.admission_priority("tenant-a") == (
+            DEFAULT_ADMISSION_PRIORITY
+        )
+        # Ensure-undone semantics: a second rollback is a clean no-op.
+        second = action.rollback()
+        assert second.ok and "nothing to undo" in second.detail
+
+    def test_demotion_survives_burn_snapshot(self):
+        burn = BurnEngine(EngineConfig())
+        burn.demote_tenant("tenant-a")
+        restored = BurnEngine(EngineConfig())
+        restored.restore_state(burn.export_state())
+        assert restored.demoted_tenants() == ["tenant-a"]
+        assert restored.admission_priority("tenant-a") < (
+            DEFAULT_ADMISSION_PRIORITY
+        )
+
+    def test_rehome_slice_round_trip(self):
+        source = AggregatorShard("agg-0")
+        target = AggregatorShard("agg-1")
+        for node, slice_id in (
+            ("n1", "s1"), ("n2", "s1"), ("n3", "s2"),
+        ):
+            source.absorb_node_state(
+                node,
+                {"head_ns": 10, "seq": 1, "events": 5,
+                 "slice_id": slice_id},
+            )
+        action = RehomeSliceAction("s1", source, target)
+        result = action.apply()
+        assert result.ok and "2 node(s)" in result.detail
+        assert set(target.nodes) == {"n1", "n2"}
+        assert set(source.nodes) == {"n3"}
+        assert action.rollback().ok
+        assert set(source.nodes) == {"n1", "n2", "n3"}
+
+    def test_rehome_moves_pending_evidence_off_the_source(self):
+        """Review regression: popping just the node state left the
+        pending window groups in the source accumulator, so both
+        shards emitted the re-homed slice's windows — duplicates."""
+        source = AggregatorShard("agg-0")
+        target = AggregatorShard("agg-1")
+        source.absorb_node_state(
+            "n1",
+            {
+                "head_ns": 10,
+                "seq": 1,
+                "events": 5,
+                "slice_id": "s1",
+                "pending": [
+                    {
+                        "bucket": 3,
+                        "namespace": "tenant-a",
+                        "pod": "pod-0",
+                        "signals": {"hbm_alloc_stall_ms": 40.0},
+                    }
+                ],
+            },
+        )
+        assert RehomeSliceAction("s1", source, target).apply().ok
+        # The target owns the evidence; the source forgot it entirely.
+        target_pending = target.export_state()["nodes"]["n1"]["pending"]
+        assert target_pending and target_pending[0]["bucket"] == 3
+        assert "n1" not in source.export_state()["nodes"]
+        assert source.export_state() == {"window_ns": source.window_ns,
+                                         "nodes": {}}
+
+    def test_drain_snapshot_runs_steps(self, tmp_path):
+        from tpuslo.runtime import AgentRuntime, StateStore
+
+        runtime = AgentRuntime(
+            StateStore(tmp_path / "state.json", interval_s=0)
+        )
+        runtime.register("c", lambda: {"x": 1}, lambda s: None)
+        ran = []
+        action = DrainSnapshotAction(
+            "agent",
+            runtime,
+            drain_steps=[("flush", lambda budget: ran.append(budget))],
+            deadline_s=2.0,
+        )
+        result = action.apply()
+        assert result.ok
+        assert len(ran) == 1
+        assert (tmp_path / "state.json").exists()
+        assert action.rollback().ok  # honest no-op
+
+    def test_bindings_build_unbound_kind_is_none(self):
+        bindings = ActionBindings()
+        assert bindings.build(ACTION_PROBE_SHED, "x") is None
+        assert bindings.build(ACTION_BREAKER_TRIP, "otlp") is None
+        assert bindings.build(ACTION_REHOME_SLICE, "s1") is None
+        assert bindings.build("unknown_kind", "x") is None
+
+
+# ---------------------------------------------------------------------------
+# shed ownership: the flap-shed precedence regression (satellite 2)
+# ---------------------------------------------------------------------------
+
+
+class TestShedOwnership:
+    def test_claim_release_owner(self):
+        ownership = ShedOwnership()
+        assert ownership.claim("sig", OWNER_REMEDIATION)
+        assert ownership.claim("sig", OWNER_REMEDIATION)  # re-claim ok
+        assert not ownership.claim("sig", OWNER_GUARD)
+        assert not ownership.release("sig", OWNER_GUARD)
+        assert ownership.release("sig", OWNER_REMEDIATION)
+        assert ownership.owner_of("sig") == ""
+
+    def test_guard_cannot_restore_remediation_shed(self):
+        """Satellite 2 regression: the overhead-guard recovery streak
+        must not restore a probe the remediation engine shed — the two
+        policies tugged-of-war before the ownership tag existed."""
+        gen = Generator("tpu_full")
+        ownership = ShedOwnership()
+        ProbeShedAction(
+            "syscall_latency_ms", gen, ownership=ownership
+        ).apply()
+        recovery = ShedRecoveryPolicy(cycles=1)
+        # The guard's restore path (agent loop) consults ownership
+        # before restore_one: remediation-owned sheds are skipped.
+        candidate = gen.shed_signals()[-1]
+        assert not ownership.may_restore(candidate, OWNER_GUARD)
+        # Untagged and guard-owned sheds remain restorable.
+        gen.disable_highest_cost()
+        untagged = gen.shed_signals()[-1]
+        assert untagged != "syscall_latency_ms"
+        assert ownership.may_restore(untagged, OWNER_GUARD)
+        del recovery  # streak semantics covered in test_safety
+
+    def test_supervisor_holddown_vetoes_every_owner(self):
+        ownership = ShedOwnership()
+        clock = [0.0]
+        supervisor = ProbeSupervisor(
+            SupervisorConfig(flap_holddown_s=100.0),
+            clock=lambda: clock[0],
+        )
+        supervisor._held["sig"] = 100.0
+        ownership.claim("sig", OWNER_REMEDIATION)
+        assert not ownership.may_restore(
+            "sig", OWNER_REMEDIATION, supervisor
+        )
+        assert not ownership.may_restore("sig", OWNER_GUARD, supervisor)
+        clock[0] = 101.0
+        assert ownership.may_restore(
+            "sig", OWNER_REMEDIATION, supervisor
+        )
+
+    def test_ownership_state_round_trip(self):
+        ownership = ShedOwnership()
+        ownership.claim("a", OWNER_REMEDIATION)
+        ownership.claim("b", OWNER_GUARD)
+        restored = ShedOwnership()
+        restored.restore_state(ownership.export_state())
+        assert restored.owner_of("a") == OWNER_REMEDIATION
+        assert restored.owner_of("b") == OWNER_GUARD
+
+
+# ---------------------------------------------------------------------------
+# verifier: confirm / rollback / hysteresis
+# ---------------------------------------------------------------------------
+
+
+class TestVerifier:
+    def test_confirms_on_sustained_subsidence(self):
+        policy = VerifyPolicy(windows=6, subside_streak=2,
+                              subside_below=3.0)
+        state = VerifyState()
+        assert observe_window(policy, state, 20.0) == VERDICT_PENDING
+        assert observe_window(policy, state, 1.0) == VERDICT_PENDING
+        assert observe_window(policy, state, 0.5) == VERDICT_CONFIRMED
+
+    def test_rolls_back_when_budget_exhausted(self):
+        policy = VerifyPolicy(windows=3, subside_streak=2)
+        state = VerifyState()
+        assert observe_window(policy, state, 20.0) == VERDICT_PENDING
+        assert observe_window(policy, state, 20.0) == VERDICT_PENDING
+        assert observe_window(policy, state, 20.0) == VERDICT_ROLLBACK
+
+    def test_hysteresis_bounce_resets_streak_without_failing(self):
+        policy = VerifyPolicy(windows=6, subside_streak=2,
+                              subside_below=3.0)
+        state = VerifyState()
+        observe_window(policy, state, 1.0)   # streak 1
+        observe_window(policy, state, 10.0)  # bounce: streak resets
+        assert state.streak == 0
+        observe_window(policy, state, 1.0)   # streak 1 again
+        assert observe_window(policy, state, 1.0) == VERDICT_CONFIRMED
+
+    def test_last_window_subsidence_still_confirms(self):
+        policy = VerifyPolicy(windows=4, subside_streak=2)
+        state = VerifyState()
+        observe_window(policy, state, 20.0)
+        observe_window(policy, state, 20.0)
+        observe_window(policy, state, 1.0)
+        # Window 4 is both the last budgeted window and the streak's
+        # second hit: confirm wins over exhaustion.
+        assert observe_window(policy, state, 1.0) == VERDICT_CONFIRMED
+
+
+# ---------------------------------------------------------------------------
+# engine: state machine, restart parity, provenance
+# ---------------------------------------------------------------------------
+
+
+def _engine(tmp_path, **kw) -> tuple[RemediationEngine, BurnEngine]:
+    burn = BurnEngine(EngineConfig())
+    bindings = ActionBindings(burn_engine=burn)
+    engine = RemediationEngine(
+        bindings=bindings,
+        verify=VerifyPolicy(windows=4, subside_streak=2),
+        provenance_log=ProvenanceLog(
+            os.fspath(tmp_path / "provenance.jsonl")
+        ),
+        **kw,
+    )
+    return engine, burn
+
+
+class TestEngine:
+    def test_consider_applies_and_verifies(self, tmp_path):
+        engine, burn = _engine(tmp_path)
+        rec = engine.consider(_ctx(), now_s=100.0)
+        assert rec is not None and rec.phase == PHASE_VERIFYING
+        assert burn.demoted_tenants() == ["tenant-a"]
+        assert engine.in_flight() == 1
+        resolved = []
+        for _ in range(3):
+            resolved += engine.tick(200.0, lambda r: 0.0)
+        assert [r.phase for r in resolved] == [PHASE_CONFIRMED]
+        assert engine.in_flight() == 0
+        # Confirmed actions stay applied.
+        assert burn.demoted_tenants() == ["tenant-a"]
+
+    def test_failed_verify_rolls_back_and_escalates(self, tmp_path):
+        engine, burn = _engine(tmp_path)
+        engine.consider(_ctx(), now_s=0.0)
+        resolved = []
+        for i in range(5):
+            resolved += engine.tick(float(i), lambda r: 50.0)
+        assert [r.phase for r in resolved] == [PHASE_ROLLED_BACK]
+        assert resolved[0].escalated
+        assert burn.demoted_tenants() == []
+
+    def test_same_incident_never_acts_twice(self, tmp_path):
+        engine, burn = _engine(tmp_path)
+        assert engine.consider(_ctx(), now_s=0.0) is not None
+        # A re-delivered attribution for the same incident: no-op even
+        # after the cooldown would have expired.
+        assert engine.consider(_ctx(), now_s=10_000.0) is None
+        assert engine.counters.applied == 1
+
+    def test_unbound_substrate_is_apply_failed(self, tmp_path):
+        engine = RemediationEngine(
+            bindings=ActionBindings(),  # nothing bound
+            provenance_log=None,
+        )
+        rec = engine.consider(_ctx(), now_s=0.0)
+        assert rec is not None and rec.phase == PHASE_APPLY_FAILED
+        assert engine.in_flight() == 0
+
+    def test_export_restore_parity_with_uninterrupted_run(
+        self, tmp_path
+    ):
+        """The restart run's records must equal the uninterrupted
+        run's, transition for transition (the crash-sweep contract)."""
+
+        def drive(engine, burn_seq, kill_at=None):
+            engine.consider(_ctx(), now_s=0.0)
+            out = []
+            for i, burn_rate in enumerate(burn_seq):
+                if i == kill_at:
+                    state = engine.export_state()
+                    burn2 = BurnEngine(EngineConfig())
+                    burn2.restore_state(
+                        engine.bindings.burn_engine.export_state()
+                    )
+                    engine = RemediationEngine(
+                        bindings=ActionBindings(burn_engine=burn2),
+                        verify=engine.verify,
+                    )
+                    engine.restore_state(state)
+                out += engine.tick(float(i + 1), lambda r: burn_rate)
+            return engine, out
+
+        burn_seq = [20.0, 2.0, 1.0, 0.5]
+        eng_a, resolved_a = drive(_engine(tmp_path)[0], burn_seq)
+        eng_b, resolved_b = drive(
+            _engine(tmp_path)[0], burn_seq, kill_at=2
+        )
+        assert [r.to_dict() for r in resolved_a] == [
+            r.to_dict() for r in resolved_b
+        ]
+        assert eng_b.counters.applied == 1
+        assert eng_b.counters.interrupted == 0
+
+    def test_interrupted_mid_apply_rolls_back_never_reapplies(
+        self, tmp_path
+    ):
+        """Kill between record registration and apply: the restored
+        engine cannot know whether the lever moved, so it rolls back
+        and escalates — and the id guard refuses a re-apply."""
+        engine, burn = _engine(tmp_path)
+        aid = action_id_for("inc-1", ACTION_DEMOTE_TENANT, "tenant-a")
+        state = {
+            "version": 1,
+            "records": [
+                ActionRecord(
+                    action_id=aid,
+                    incident_id="inc-1",
+                    kind=ACTION_DEMOTE_TENANT,
+                    target="tenant-a",
+                    phase=PHASE_APPLYING,
+                ).to_dict()
+            ],
+            "policy": {},
+            "counters": {},
+        }
+        engine.restore_state(state)
+        rec = engine._records[aid]
+        assert rec.phase == PHASE_ROLLED_BACK
+        assert rec.escalated
+        assert engine.counters.interrupted == 1
+        # The demotion never happened; rollback must not invent one.
+        assert burn.demoted_tenants() == []
+        # The id guard refuses the same decision forever.
+        assert engine.consider(_ctx(), now_s=10_000.0) is None
+
+    def test_provenance_chain_records_full_lifecycle(self, tmp_path):
+        engine, _ = _engine(tmp_path)
+        base = ProvenanceRecord(
+            incident_id="inc-1",
+            predicted_fault_domain="tpu_hbm",
+            confidence=0.95,
+        )
+        engine.consider(_ctx(), now_s=0.0, provenance=base)
+        for i in range(3):
+            engine.tick(float(i + 1), lambda r: 0.0)
+        chains = load_records(os.fspath(tmp_path / "provenance.jsonl"))
+        rec = chains["inc-1"]
+        assert rec.predicted_fault_domain == "tpu_hbm"
+        assert len(rec.remediation) == 1
+        entry = rec.remediation[0]
+        assert entry["kind"] == ACTION_DEMOTE_TENANT
+        assert entry["phase"] == PHASE_CONFIRMED
+        assert entry["verdict"] == VERDICT_CONFIRMED
+        # sloctl explain renders the block.
+        text = format_chain(rec)
+        assert "remediation" in text
+        assert "demote_tenant" in text
+
+    def test_synthesized_provenance_without_base_record(self, tmp_path):
+        engine, _ = _engine(tmp_path)
+        engine.consider(_ctx(), now_s=0.0)
+        chains = load_records(os.fspath(tmp_path / "provenance.jsonl"))
+        assert chains["inc-1"].remediation[0]["phase"] == (
+            PHASE_VERIFYING
+        )
+
+    def test_observer_bridge_counts(self, tmp_path):
+        calls = []
+
+        class Obs:
+            def applied(self, action):
+                calls.append(("applied", action))
+
+            def rolled_back(self, action):
+                calls.append(("rolled_back", action))
+
+            def verify_outcome(self, outcome):
+                calls.append(("verify", outcome))
+
+            def in_flight(self, count):
+                calls.append(("in_flight", count))
+
+            def refused(self, reason):
+                calls.append(("refused", reason))
+
+        engine, _ = _engine(tmp_path, observer=Obs())
+        engine.consider(_ctx(burn_state="ok"), now_s=0.0)
+        engine.consider(_ctx(), now_s=0.0)
+        for i in range(5):
+            engine.tick(float(i), lambda r: 50.0)
+        kinds = [c[0] for c in calls]
+        assert "refused" in kinds
+        assert ("applied", ACTION_DEMOTE_TENANT) in calls
+        assert ("verify", VERDICT_ROLLBACK) in calls
+        assert ("rolled_back", ACTION_DEMOTE_TENANT) in calls
+
+    def test_terminal_records_pruned_past_retention(self, tmp_path):
+        """Review regression: a long-running agent must not grow its
+        per-cycle scans and durable snapshot without bound — settled
+        records past the retention depth are pruned, in-flight never."""
+        from tpuslo.remediation.engine import MAX_TERMINAL_RECORDS
+
+        burn = BurnEngine(EngineConfig(max_tenants=2048))
+        engine = RemediationEngine(
+            policy=RemediationPolicy(
+                rules=default_rules(
+                    cooldown_s=0.0,
+                    rate_limit=100_000,
+                    rate_window_s=1.0,
+                ),
+                max_concurrent_actions=1,
+            ),
+            bindings=ActionBindings(burn_engine=burn),
+            verify=VerifyPolicy(windows=2, subside_streak=1),
+        )
+        total = MAX_TERMINAL_RECORDS + 40
+        for i in range(total):
+            rec = engine.consider(
+                _ctx(incident=f"inc-{i:04d}", tenant=f"t-{i:04d}"),
+                now_s=float(i),
+            )
+            assert rec is not None
+            engine.tick(float(i), lambda r: 0.0)  # instant confirm
+        assert len(engine.records()) == MAX_TERMINAL_RECORDS
+        ids = {r.action_id for r in engine.records()}
+        assert action_id_for(
+            "inc-0000", ACTION_DEMOTE_TENANT, "t-0000"
+        ) not in ids
+        assert action_id_for(
+            f"inc-{total - 1:04d}", ACTION_DEMOTE_TENANT,
+            f"t-{total - 1:04d}",
+        ) in ids
+        # Counters keep the full history even after pruning.
+        assert engine.counters.applied == total
+
+    def test_snapshot_counters(self, tmp_path):
+        engine, _ = _engine(tmp_path)
+        engine.consider(_ctx(), now_s=0.0)
+        snap = engine.snapshot()
+        assert snap["applied"] == 1
+        assert snap["in_flight"] == 1
+
+
+# ---------------------------------------------------------------------------
+# metrics + config wiring
+# ---------------------------------------------------------------------------
+
+
+class TestWiring:
+    def test_prometheus_observer_bridge(self):
+        from prometheus_client import generate_latest
+
+        from tpuslo.metrics import AgentMetrics
+
+        metrics = AgentMetrics()
+        obs = metrics.remediation_observer()
+        obs.applied(ACTION_DEMOTE_TENANT)
+        obs.rolled_back(ACTION_DEMOTE_TENANT)
+        obs.verify_outcome("rollback")
+        obs.in_flight(2)
+        obs.refused("low_confidence")
+        text = generate_latest(metrics.registry).decode()
+        assert (
+            'llm_slo_agent_remediation_actions_applied_total'
+            '{action="demote_tenant"} 1.0'
+        ) in text
+        assert (
+            "llm_slo_agent_remediation_actions_in_flight 2.0" in text
+        )
+        assert (
+            'llm_slo_agent_remediation_refusals_total'
+            '{reason="low_confidence"} 1.0'
+        ) in text
+
+    def test_config_presence_implies_on(self, tmp_path):
+        from tpuslo.config.toolkitcfg import load_config
+
+        path = tmp_path / "cfg.yaml"
+        path.write_text(
+            "signal_set: [dns_latency_ms]\n"
+            "sampling: {events_per_second_limit: 100}\n"
+            "correlation: {window_ms: 1000}\n"
+            "otlp: {endpoint: http://x/v1/logs}\n"
+            "safety: {max_overhead_pct: 3.0}\n"
+            "remediation:\n"
+            "  min_confidence: 0.9\n"
+            "  disabled_actions: [cordon_node]\n"
+        )
+        cfg = load_config(os.fspath(path))
+        assert cfg.remediation.enabled
+        assert cfg.remediation.min_confidence == 0.9
+        assert cfg.remediation.disabled_actions == ["cordon_node"]
+        # Explicit off still wins.
+        path.write_text(
+            path.read_text() + "  enabled: false\n"
+        )
+        assert not load_config(os.fspath(path)).remediation.enabled
+
+    def test_config_rejects_unknown_action_kind(self, tmp_path):
+        from tpuslo.config.toolkitcfg import load_config
+
+        path = tmp_path / "cfg.yaml"
+        path.write_text(
+            "signal_set: [dns_latency_ms]\n"
+            "sampling: {events_per_second_limit: 100}\n"
+            "correlation: {window_ms: 1000}\n"
+            "otlp: {endpoint: http://x/v1/logs}\n"
+            "safety: {max_overhead_pct: 3.0}\n"
+            "remediation: {disabled_actions: [typo]}\n"
+        )
+        with pytest.raises(ValueError, match="unknown action kind"):
+            load_config(os.fspath(path))
+
+    def test_default_rules_cover_known_domains_only(self):
+        from tpuslo.attribution.mapper import map_fault_label
+
+        known = {
+            map_fault_label(label)
+            for label in (
+                "hbm_pressure", "network_partition", "dns_latency",
+                "cpu_throttle", "xla_recompile_storm", "ici_drop",
+                "host_offload_stall",
+            )
+        }
+        for rule in default_rules():
+            assert rule.domain in known
+
+    def test_remediation_evaluate_path_is_lint_clean(self):
+        """The evaluate path is registered in the hot-path manifest, so
+        TPL120/121 govern it; the repo must self-host clean."""
+        from tpuslo.analysis.hotpaths import (
+            HOT_DATACLASSES,
+            HOT_FUNCTIONS,
+        )
+
+        functions = {qn for _, qn in HOT_FUNCTIONS}
+        assert "RemediationPolicy.decide" in functions
+        assert "RemediationEngine.consider" in functions
+        assert "RemediationEngine.tick" in functions
+        assert "observe_window" in functions
+        dataclasses = {name for _, name in HOT_DATACLASSES}
+        assert "ActionRecord" in dataclasses
+        assert "AttributionContext" in dataclasses
+
+
+# ---------------------------------------------------------------------------
+# sloctl surfaces
+# ---------------------------------------------------------------------------
+
+
+class TestSloctl:
+    def _snapshot_with_actions(self, tmp_path) -> str:
+        engine, _ = _engine(tmp_path)
+        engine.consider(_ctx(), now_s=100.0)
+        snapshot = {
+            "schema_version": 1,
+            "saved_at": 0.0,
+            "components": {"remediation": engine.export_state()},
+        }
+        path = tmp_path / "agent-state.json"
+        path.write_text(json.dumps(snapshot))
+        return os.fspath(path)
+
+    def test_remediation_list_table(self, tmp_path, capsys):
+        from tpuslo.cli.sloctl import main
+
+        state = self._snapshot_with_actions(tmp_path)
+        assert main(["remediation", "list", "--state", state]) == 0
+        out = capsys.readouterr().out
+        assert "demote_tenant" in out
+        assert "tenant-a" in out
+        assert "verifying" in out
+
+    def test_remediation_list_json(self, tmp_path, capsys):
+        from tpuslo.cli.sloctl import main
+
+        state = self._snapshot_with_actions(tmp_path)
+        assert main(
+            ["remediation", "list", "--state", state, "--json"]
+        ) == 0
+        records = json.loads(capsys.readouterr().out)
+        assert len(records) == 1
+        assert records[0]["kind"] == ACTION_DEMOTE_TENANT
+
+    def test_remediation_list_missing_section(self, tmp_path, capsys):
+        from tpuslo.cli.sloctl import main
+
+        path = tmp_path / "agent-state.json"
+        path.write_text(json.dumps({"components": {}}))
+        assert main(
+            ["remediation", "list", "--state", os.fspath(path)]
+        ) == 1
+        assert "no remediation section" in capsys.readouterr().err
+
+    def test_explain_renders_remediation_block(self, tmp_path, capsys):
+        from tpuslo.cli.sloctl import main
+
+        engine, _ = _engine(tmp_path)
+        engine.consider(_ctx(), now_s=0.0)
+        for i in range(3):
+            engine.tick(float(i + 1), lambda r: 0.0)
+        prov = os.fspath(tmp_path / "provenance.jsonl")
+        assert main(["explain", "inc-1", "--provenance", prov]) == 0
+        out = capsys.readouterr().out
+        assert "remediation (1 action(s))" in out
+        assert "demote_tenant on tenant-a" in out
+        assert "verdict=confirmed" in out
+
+
+# ---------------------------------------------------------------------------
+# the seeded sweep gate (fast path of the m5 gate)
+# ---------------------------------------------------------------------------
+
+
+class TestSweep:
+    def test_full_sweep_passes(self, tmp_path):
+        from tpuslo.remediation.sweep import run_remediation_sweep
+
+        report = run_remediation_sweep(
+            seed=1337, provenance_dir=os.fspath(tmp_path)
+        )
+        assert report.passed, report.failures
+        names = {run.name for run in report.runs}
+        # The acceptance criterion: >= 7 seeded fault scenarios.
+        assert len(names) >= 7
+        assert {
+            "healthy_quiet",
+            "low_confidence_held",
+            "false_positive_rollback",
+            "storm_rate_limited",
+            "restart_mid_verify",
+        } <= names
+
+    def test_sweep_precision_evidence(self, tmp_path):
+        from tpuslo.remediation.sweep import run_remediation_sweep
+
+        report = run_remediation_sweep(
+            seed=7, provenance_dir=os.fspath(tmp_path)
+        )
+        assert report.passed, report.failures
+        by_name = {run.name: run for run in report.runs}
+        assert by_name["healthy_quiet"].actions == []
+        assert by_name["low_confidence_held"].actions == []
+        assert by_name["low_confidence_held"].refusals.get(
+            "low_confidence", 0
+        ) > 0
+        # The storm stayed inside the dampers.
+        storm = by_name["storm_rate_limited"]
+        assert len(storm.actions) == 3
+        assert storm.max_in_flight <= 2
+
+    def test_sweep_mid_kill_no_duplicates(self, tmp_path):
+        from tpuslo.remediation.sweep import run_remediation_sweep
+
+        report = run_remediation_sweep(
+            seed=42, provenance_dir=os.fspath(tmp_path)
+        )
+        assert report.passed, report.failures
+        restart = next(
+            run for run in report.runs
+            if run.name == "restart_mid_verify"
+        )
+        assert len(restart.actions) == 1
+        assert restart.actions[0]["phase"] == PHASE_CONFIRMED
+
+    def test_sweep_provenance_chains_on_disk(self, tmp_path):
+        from tpuslo.remediation.sweep import run_remediation_sweep
+
+        report = run_remediation_sweep(
+            seed=1337, provenance_dir=os.fspath(tmp_path)
+        )
+        assert report.passed, report.failures
+        chains = load_records(
+            os.fspath(tmp_path / "demote_fast_burn.jsonl")
+        )
+        assert chains
+        rec = next(iter(chains.values()))
+        assert rec.remediation
+        assert rec.remediation[0]["verdict"] == VERDICT_CONFIRMED
+
+
+# ---------------------------------------------------------------------------
+# agent e2e: the action loop inside the real synthetic cycle
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestAgentE2E:
+    def test_agent_remediates_and_snapshots(self, tmp_path, capsys):
+        """An error-heavy synthetic run under --remediate: the burn
+        engine pages, the attribution fires, the engine acts, and the
+        action history lands in the durable snapshot + provenance."""
+        import threading
+
+        from tpuslo.cli import agent as agent_cli
+
+        state_dir = tmp_path / "state"
+        out = tmp_path / "events.jsonl"
+        argv = [
+            "--scenario", "hbm_pressure",
+            "--count", "60",
+            "--interval-s", "0.01",
+            "--metrics-port", "0",
+            "--event-kind", "both",
+            "--output", "jsonl",
+            "--jsonl-path", os.fspath(out),
+            "--webhook-url", "http://127.0.0.1:9/webhook",
+            "--burn-engine",
+            "--remediate",
+            "--state-dir", os.fspath(state_dir),
+            "--snapshot-interval-s", "0",
+            "--trace",
+            "--provenance-path",
+            os.fspath(tmp_path / "provenance.jsonl"),
+            "--max-overhead-pct", "1000",
+        ]
+        rc = {}
+        thread = threading.Thread(
+            target=lambda: rc.update(code=agent_cli.main(argv))
+        )
+        thread.start()
+        thread.join(timeout=120)
+        assert not thread.is_alive()
+
+        snapshot = json.loads(
+            (state_dir / "agent-state.json").read_text()
+        )
+        section = snapshot["components"].get("remediation")
+        assert isinstance(section, dict)
+        records = section.get("records") or []
+        assert records, "remediation engine never acted"
+        assert all(
+            r["kind"] == ACTION_DEMOTE_TENANT for r in records
+        )
+        assert "shed_ownership" in snapshot["components"]
+        # Every acted incident's provenance chain carries the block.
+        chains = load_records(
+            os.fspath(tmp_path / "provenance.jsonl")
+        )
+        acted = {r["incident_id"] for r in records}
+        chained = {
+            incident
+            for incident, rec in chains.items()
+            if rec.remediation
+        }
+        assert acted <= chained

@@ -66,6 +66,16 @@ HOT_FUNCTIONS: tuple[tuple[str, str], ...] = (
     ("tpuslo/fleet/aggregator.py", "AggregatorShard.ingest"),
     ("tpuslo/fleet/aggregator.py", "AggregatorShard._drain"),
     ("tpuslo/fleet/aggregator.py", "AggregatorShard._fold"),
+    # Remediation evaluate path (ISSUE 11): the decision + verify fold
+    # runs once per attributed incident / per in-flight action per
+    # evaluation window, inside the agent cycle the tracer budgets —
+    # time arrives as a parameter (never from the wall clock) and the
+    # bodies stay dict/deque arithmetic; provenance serialization lives
+    # on the cold side.
+    ("tpuslo/remediation/policy.py", "RemediationPolicy.decide"),
+    ("tpuslo/remediation/engine.py", "RemediationEngine.consider"),
+    ("tpuslo/remediation/engine.py", "RemediationEngine.tick"),
+    ("tpuslo/remediation/verifier.py", "observe_window"),
     # Serving decode/verify kernels (ISSUE 10): the traced bodies the
     # spec-decode and decode paths run per token/round.  They execute
     # under jax tracing, where a stray print/json.dumps lands in every
@@ -99,6 +109,12 @@ HOT_DATACLASSES: tuple[tuple[str, str], ...] = (
     # Fleet plane containers (ISSUE 9).
     ("tpuslo/fleet/wire.py", "Shipment"),
     ("tpuslo/fleet/aggregator.py", "_NodeState"),
+    # Remediation evaluate-path containers (ISSUE 11).
+    ("tpuslo/remediation/policy.py", "AttributionContext"),
+    ("tpuslo/remediation/policy.py", "RemediationRule"),
+    ("tpuslo/remediation/policy.py", "PolicyDecision"),
+    ("tpuslo/remediation/engine.py", "ActionRecord"),
+    ("tpuslo/remediation/verifier.py", "VerifyState"),
 )
 
 #: The JAX plane the TPL16x trace-discipline rules govern: every file

@@ -253,6 +253,16 @@ def build_parser() -> argparse.ArgumentParser:
         "(default: --namespace); per-tenant SLO targets come from "
         "config slo.tenants",
     )
+    p.add_argument(
+        "--remediate",
+        action="store_true",
+        help="run the auto-remediation engine over attributed "
+        "incidents (config: remediation: section — presence implies "
+        "on; needs the burn engine for its burn-state gate and verify "
+        "evidence): high-confidence attributions under active burn "
+        "apply reversible actions (probe shed, breaker trip, tenant "
+        "demotion), verified against the burn or rolled back",
+    )
     return p
 
 
@@ -788,6 +798,83 @@ def main(
             file=sys.stderr,
         )
 
+    # ---- auto-remediation engine: close the observe → act loop -------
+    remediation_engine = None
+    shed_ownership = None
+    if args.remediate or cfg.remediation.enabled:
+        if burn_engine is None:
+            # The policy gates on burn state and the verifier watches
+            # burn evidence; without the burn engine the loop would
+            # either act blind or never act.  Refusing loudly beats a
+            # "remediation on" banner over an engine that cannot
+            # verify.
+            print(
+                "agent: auto-remediation needs the burn engine "
+                "(--burn-engine / config slo:); disabled",
+                file=sys.stderr,
+            )
+        else:
+            from tpuslo.remediation import (
+                ActionBindings,
+                RemediationEngine,
+                RemediationPolicy,
+                VerifyPolicy,
+                default_rules,
+            )
+            from tpuslo.safety import ShedOwnership
+
+            shed_ownership = ShedOwnership()
+            remediation_engine = RemediationEngine(
+                policy=RemediationPolicy(
+                    rules=default_rules(
+                        min_confidence=cfg.remediation.min_confidence,
+                        cooldown_s=cfg.remediation.cooldown_s,
+                        rate_limit=cfg.remediation.rate_limit,
+                        rate_window_s=cfg.remediation.rate_window_s,
+                    ),
+                    max_concurrent_actions=(
+                        cfg.remediation.max_concurrent_actions
+                    ),
+                    disabled_actions=tuple(
+                        cfg.remediation.disabled_actions
+                    ),
+                ),
+                bindings=ActionBindings(
+                    probe_manager=generator,
+                    ownership=shed_ownership,
+                    breakers={
+                        ch.name: ch.breaker for ch in _all_channels()
+                    },
+                    runtime=runtime,
+                    burn_engine=burn_engine,
+                ),
+                verify=VerifyPolicy(
+                    windows=cfg.remediation.verify_windows,
+                    subside_streak=cfg.remediation.verify_streak,
+                    subside_below=cfg.remediation.verify_subside_below,
+                ),
+                observer=metrics.remediation_observer(),
+                provenance_log=provenance_log,
+                log=lambda msg: print(f"agent: {msg}", file=sys.stderr),
+            )
+            runtime.register(
+                "remediation",
+                remediation_engine.export_state,
+                remediation_engine.restore_state,
+            )
+            runtime.register(
+                "shed_ownership",
+                shed_ownership.export_state,
+                shed_ownership.restore_state,
+            )
+            print(
+                "agent: auto-remediation on (min confidence "
+                f"{cfg.remediation.min_confidence:g}, budget "
+                f"{cfg.remediation.max_concurrent_actions} concurrent, "
+                f"verify {cfg.remediation.verify_windows} windows)",
+                file=sys.stderr,
+            )
+
     sample_meta = SampleMeta(
         cluster=args.cluster,
         namespace=args.namespace,
@@ -1204,6 +1291,80 @@ def main(
                         ),
                     }
 
+            # ---- remediate: high-confidence attribution × burn ------
+            # → ranked reversible action, then verify-or-rollback.
+            if remediation_engine is not None:
+                with tr.stage("remediate") as sp:
+                    now_s = now.timestamp()
+                    if attr is not None:
+                        from tpuslo.remediation import (
+                            AttributionContext,
+                        )
+
+                        ctx = AttributionContext(
+                            incident_id=attr.incident_id,
+                            domain=attr.predicted_fault_domain,
+                            confidence=attr.confidence,
+                            burn_state=burn_engine.policy.state_of(
+                                tenant, "availability"
+                            ),
+                            burn_rate=burn_engine.max_active_burn(),
+                            tenant=tenant,
+                            node=args.node,
+                            slice_id=cfg.tpu.slice_id,
+                            at_s=now_s,
+                        )
+                        acted = remediation_engine.consider(
+                            ctx, now_s, provenance=prov_rec
+                        )
+                        if acted is not None:
+                            print(
+                                "agent: remediation: "
+                                f"{acted.kind} on {acted.target} "
+                                f"[{acted.phase}] for "
+                                f"{attr.incident_id} — {acted.detail}",
+                                file=sys.stderr,
+                            )
+
+                    def _verify_burn(rec) -> float:
+                        # Verify evidence: the fast-reacting 5m
+                        # availability burn of the acted tenant.
+                        watch = (
+                            rec.target
+                            if rec.kind == "demote_tenant"
+                            else tenant
+                        )
+                        for stat in burn_engine.status():
+                            if (
+                                stat.tenant == watch
+                                and stat.objective == "availability"
+                            ):
+                                return stat.burn_rates.get("5m", 0.0)
+                        return 0.0
+
+                    for settled in remediation_engine.tick(
+                        now_s, _verify_burn
+                    ):
+                        print(
+                            "agent: remediation: "
+                            f"{settled.kind} on {settled.target} "
+                            f"settled {settled.phase} after "
+                            f"{settled.windows_seen} window(s)"
+                            + (
+                                " — ESCALATED"
+                                if settled.escalated
+                                else ""
+                            ),
+                            file=sys.stderr,
+                        )
+                    snap = remediation_engine.snapshot()
+                    sp.set(
+                        in_flight=snap["in_flight"],
+                        applied=snap["applied"],
+                        confirmed=snap["confirmed"],
+                        rolled_back=snap["rolled_back"],
+                    )
+
             # ---- snapshot: stats, overhead guard, durable state ----
             with tr.stage("snapshot") as sp:
                 if (
@@ -1227,7 +1388,27 @@ def main(
                                 generator.enabled_signals()
                             )
                     elif recovery.note(result):
-                        restored = generator.restore_one()
+                        shed_list = generator.shed_signals()
+                        candidate = shed_list[-1] if shed_list else None
+                        if (
+                            candidate is not None
+                            and shed_ownership is not None
+                            and not shed_ownership.may_restore(
+                                candidate, "guard"
+                            )
+                        ):
+                            # Ownership precedence: the recovery streak
+                            # must not restore a probe the remediation
+                            # engine shed — its verifier owns that
+                            # lever until it confirms or rolls back.
+                            print(
+                                f"agent: restore of {candidate} held "
+                                "(remediation-owned shed)",
+                                file=sys.stderr,
+                            )
+                            restored = None
+                        else:
+                            restored = generator.restore_one()
                         if restored:
                             print(
                                 f"agent: overhead {result.cpu_pct:.2f}% "

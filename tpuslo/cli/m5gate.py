@@ -39,6 +39,16 @@ fast-burn, slow-burn, latency regression, flapping, tenant-isolated,
 kill/restart) replayed through the burn engine, asserting alert
 precision/recall, page promptness, zero flap-induced duplicates,
 tenant isolation, and snapshot/restore equivalence.
+
+``--remediation-sweep`` runs the auto-remediation action-loop gate
+(``tpuslo.remediation.sweep``): seeded fault injections (faultreplay →
+Bayesian attribution) under synthesized burn traffic drive the
+observe → attribute → remediate → verify loop, asserting action
+precision 1.0 (zero actions on healthy / low-confidence targets),
+burn verified subsided or rolled back within the window budget,
+rate-limit/budget damping under a mis-attribution storm, zero
+duplicate actions across a mid-sweep engine kill, and every action
+traceable end-to-end in the provenance chain.
 """
 
 from __future__ import annotations
@@ -135,6 +145,28 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--burn-seed", type=int, default=1337)
     p.add_argument("--burn-bucket-s", type=int, default=10)
     p.add_argument("--burn-eval-interval-s", type=float, default=30.0)
+    # ---- auto-remediation action-loop gate (tpuslo.remediation) -------
+    p.add_argument(
+        "--remediation-sweep",
+        action="store_true",
+        help="run the auto-remediation gate instead of B5/D3/E3: "
+        "seeded fault scenarios through the observe -> attribute -> "
+        "remediate -> verify loop, asserting action precision 1.0, "
+        "verify-or-rollback within the window budget, storm damping, "
+        "zero duplicate actions across a mid-sweep kill, and "
+        "end-to-end provenance",
+    )
+    p.add_argument("--remediation-seed", type=int, default=1337)
+    p.add_argument(
+        "--remediation-eval-interval-s", type=float, default=60.0
+    )
+    p.add_argument("--remediation-verify-windows", type=int, default=10)
+    p.add_argument(
+        "--remediation-provenance-dir",
+        default="",
+        help="directory for per-scenario provenance chains (default: "
+        "a temp dir)",
+    )
     # ---- fleet observability-plane gate (tpuslo.fleet) ----------------
     p.add_argument(
         "--fleet-sweep",
@@ -289,6 +321,75 @@ def run_burn_gate(args) -> int:
         Path(args.summary_md).write_text(render_burn_markdown(report))
     print(
         f"m5gate: burn-sweep {'PASS' if report.passed else 'FAIL'}"
+        + ("" if report.passed else f" ({'; '.join(report.failures)})"),
+        file=sys.stderr,
+    )
+    return 0 if report.passed else 1
+
+
+def render_remediation_markdown(report) -> str:
+    lines = [
+        "# Auto-remediation action-loop gate",
+        "",
+        f"**Overall: {'PASS' if report.passed else 'FAIL'}**",
+        "",
+        f"- seed {report.seed}, evaluation every "
+        f"{report.eval_interval_s:g}s of event time, verify window "
+        f"budget {report.verify_windows}",
+        "- contracts: action precision 1.0 (zero actions on healthy / "
+        "low-confidence targets), burn verified subsided or action "
+        "rolled back within the window budget, storm damping under "
+        "the global budget + rate limits, zero duplicate actions "
+        "across a mid-sweep kill, every action in the provenance "
+        "chain",
+        "",
+        "| scenario | evals | actions | confirmed | rolled back | "
+        "mitigate (s) | max in-flight | pass |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for run in report.runs:
+        confirmed = sum(
+            1 for a in run.actions if a["phase"] == "confirmed"
+        )
+        rolled = sum(
+            1 for a in run.actions if a["phase"] == "rolled_back"
+        )
+        mitigate = (
+            f"{max(run.time_to_mitigate_s):.0f}"
+            if run.time_to_mitigate_s
+            else "-"
+        )
+        lines.append(
+            f"| {run.name} | {run.evaluations} | {len(run.actions)} "
+            f"| {confirmed} | {rolled} | {mitigate} "
+            f"| {run.max_in_flight} | {run.passed} |"
+        )
+    if report.failures:
+        lines += ["", "## Failures", ""]
+        lines += [f"- {f}" for f in report.failures]
+    return "\n".join(lines) + "\n"
+
+
+def run_remediation_gate(args) -> int:
+    from tpuslo.remediation.sweep import run_remediation_sweep
+
+    report = run_remediation_sweep(
+        seed=args.remediation_seed,
+        eval_interval_s=args.remediation_eval_interval_s,
+        verify_windows=args.remediation_verify_windows,
+        provenance_dir=args.remediation_provenance_dir or None,
+        log=lambda msg: print(f"m5gate: {msg}", file=sys.stderr),
+    )
+    if args.summary_json:
+        Path(args.summary_json).write_text(
+            json.dumps(report.to_dict(), indent=2) + "\n"
+        )
+    if args.summary_md:
+        Path(args.summary_md).write_text(
+            render_remediation_markdown(report)
+        )
+    print(
+        f"m5gate: remediation-sweep {'PASS' if report.passed else 'FAIL'}"
         + ("" if report.passed else f" ({'; '.join(report.failures)})"),
         file=sys.stderr,
     )
@@ -546,6 +647,8 @@ def main(argv: list[str] | None = None) -> int:
         return run_jitcheck_gate()
     if args.burn_sweep:
         return run_burn_gate(args)
+    if args.remediation_sweep:
+        return run_remediation_gate(args)
     if args.fleet_sweep:
         return run_fleet_gate(args)
     if args.crash_sweep:

@@ -11,6 +11,9 @@ posterior → alert delivery outcome) from the agent's provenance log.
 (windowed SLI, budget remaining, burn rates, alert state) from the
 agent's durable state snapshot — or replays a ``RequestOutcome`` JSONL
 (``loadgen --slo-out``) through a fresh engine offline.
+``remediation list`` renders the auto-remediation action history from
+the same snapshot (``explain`` shows the remediation block inside each
+remediated incident's provenance chain).
 """
 
 from __future__ import annotations
@@ -120,6 +123,32 @@ def build_parser() -> argparse.ArgumentParser:
         help="show only nodes aged out of the watermark",
     )
     fl_nodes.add_argument("--json", action="store_true")
+
+    rem = sub.add_parser(
+        "remediation",
+        help="auto-remediation action history from the agent's durable "
+        "state snapshot",
+    )
+    rem_sub = rem.add_subparsers(dest="subcommand", required=True)
+    rem_list = rem_sub.add_parser(
+        "list",
+        help="action history table (id, kind, target, phase, verify "
+        "verdict, escalations); `sloctl explain <incident>` shows the "
+        "full chain behind each action",
+    )
+    rem_list.add_argument("--config", default="")
+    rem_list.add_argument(
+        "--state",
+        default="",
+        help="agent state snapshot path (default "
+        "<runtime.state_dir>/agent-state.json)",
+    )
+    rem_list.add_argument(
+        "--in-flight-only",
+        action="store_true",
+        help="show only actions still applying or verifying",
+    )
+    rem_list.add_argument("--json", action="store_true")
 
     bu = sub.add_parser(
         "budget",
@@ -402,6 +431,93 @@ def run_fleet(args) -> int:
     return 0
 
 
+def run_remediation(args) -> int:
+    import os
+
+    from tpuslo.remediation import TERMINAL_PHASES
+
+    cfg = resolve_config(args.config)
+    path = args.state
+    if not path and cfg.runtime.state_dir:
+        path = os.path.join(cfg.runtime.state_dir, "agent-state.json")
+    if not path:
+        print(
+            "sloctl remediation list: no state path — pass --state or "
+            "set runtime.state_dir",
+            file=sys.stderr,
+        )
+        return 1
+    try:
+        with open(path, encoding="utf-8") as fh:
+            snapshot = json.load(fh)
+    except OSError as exc:
+        print(
+            f"sloctl remediation list: cannot read {path}: "
+            f"{exc.strerror or exc}",
+            file=sys.stderr,
+        )
+        return 1
+    except json.JSONDecodeError:
+        print(
+            f"sloctl remediation list: corrupt snapshot {path}",
+            file=sys.stderr,
+        )
+        return 1
+    section = (snapshot.get("components") or {}).get("remediation")
+    if not isinstance(section, dict):
+        print(
+            f"sloctl remediation list: snapshot {path} has no "
+            "remediation section — is the engine enabled (config "
+            "remediation: / agent --remediate)?",
+            file=sys.stderr,
+        )
+        return 1
+    records = [
+        r for r in (section.get("records") or []) if isinstance(r, dict)
+    ]
+    if args.in_flight_only:
+        records = [
+            r for r in records if r.get("phase") not in TERMINAL_PHASES
+        ]
+    if args.json:
+        print(json.dumps(records, indent=2))
+        return 0
+    if not records:
+        print(
+            "(no remediation actions)"
+            if not args.in_flight_only
+            else "(no in-flight remediation actions)"
+        )
+        return 0
+    rows = [
+        (
+            "ACTION", "INCIDENT", "KIND", "TARGET", "PHASE",
+            "VERDICT", "WINDOWS", "ESCALATED",
+        )
+    ]
+    for r in sorted(
+        records, key=lambda r: float(r.get("applied_at_s", 0.0))
+    ):
+        rows.append(
+            (
+                str(r.get("action_id", "?")),
+                str(r.get("incident_id", "?")),
+                str(r.get("kind", "?")),
+                str(r.get("target", "?")),
+                str(r.get("phase", "?")),
+                str(r.get("verdict", "?")),
+                str(r.get("windows_seen", 0)),
+                "yes" if r.get("escalated") else "-",
+            )
+        )
+    print(_render_table(rows))
+    print(
+        f"{len(records)} remediation action(s) — drill down with "
+        "`sloctl explain <incident>`"
+    )
+    return 0
+
+
 def _render_budget_table(statuses, tenant_filter: str = "") -> str:
     """Fixed-width per-(tenant, objective) budget table."""
     rows = [
@@ -564,6 +680,8 @@ def main(argv: list[str] | None = None) -> int:
         return run_budget(args)
     if args.command == "fleet":
         return run_fleet(args)
+    if args.command == "remediation":
+        return run_remediation(args)
     return run_cdgate(args)
 
 

@@ -442,6 +442,24 @@ class ProbeManager:
             imported.append(signal)
         return imported
 
+    def restore_signal(self, signal: str) -> bool:
+        """Re-attach one specific shed signal (remediation rollback).
+
+        Like :meth:`restore_one`, a failed re-attach keeps the signal
+        on the shed list for a later retry; unlike it, this never
+        touches any other shed entry.
+        """
+        if signal not in self._shed:
+            return False
+        if signal in self._attached:
+            self._shed.remove(signal)  # already back (external attach)
+            return True
+        report = self.attach_all([signal])
+        if signal in report.attached_signals:
+            self._shed.remove(signal)
+            return True
+        return False
+
     def restore_one(self) -> str | None:
         """Re-attach the most recently shed signal (reverse cost order).
 

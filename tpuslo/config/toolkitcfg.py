@@ -178,6 +178,40 @@ class SLOConfig:
 
 
 @dataclass
+class RemediationConfig:
+    """Auto-remediation knobs (``tpuslo.remediation``).
+
+    ``enabled`` flips to True whenever a ``remediation:`` section is
+    present in the config file (presence-implies-on, like ``slo:``);
+    an explicit ``enabled: false`` still wins.  The engine needs the
+    burn engine (``slo:``) for its burn-state gate and verify
+    evidence.  ``disabled_actions`` disables individual action kinds
+    without turning the loop off.
+    """
+
+    enabled: bool = False
+    #: Confidence floor an attribution must clear before any rule acts.
+    min_confidence: float = 0.8
+    #: Global concurrent-actions budget (a mis-attribution storm can
+    #: hold at most this many levers at once).
+    max_concurrent_actions: int = 2
+    #: Per-(action, target) cooldown between applies.
+    cooldown_s: float = 300.0
+    #: Per-action-kind rate limit over ``rate_window_s``.
+    rate_limit: int = 3
+    rate_window_s: float = 3600.0
+    #: Verify-or-rollback: evaluation-window budget, consecutive
+    #: subsided windows to confirm, and the burn line that counts as
+    #: subsided (default = the slow rule's clearing line).
+    verify_windows: int = 6
+    verify_streak: int = 2
+    verify_subside_below: float = 3.0
+    #: Action kinds to refuse (e.g. ["cordon_node"]) — per-action off
+    #: switch without disabling the loop.
+    disabled_actions: list[str] = field(default_factory=list)
+
+
+@dataclass
 class RuntimeConfig:
     """Crash-safe runtime knobs (``tpuslo.runtime``).
 
@@ -222,6 +256,9 @@ class ToolkitConfig:
         default_factory=ObservabilityConfig
     )
     slo: SLOConfig = field(default_factory=SLOConfig)
+    remediation: RemediationConfig = field(
+        default_factory=RemediationConfig
+    )
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
     tpu: TPUConfig = field(default_factory=TPUConfig)
 
@@ -307,6 +344,22 @@ class ToolkitConfig:
                     for tenant, overrides in self.slo.tenants.items()
                 },
             },
+            "remediation": {
+                "enabled": self.remediation.enabled,
+                "min_confidence": self.remediation.min_confidence,
+                "max_concurrent_actions":
+                    self.remediation.max_concurrent_actions,
+                "cooldown_s": self.remediation.cooldown_s,
+                "rate_limit": self.remediation.rate_limit,
+                "rate_window_s": self.remediation.rate_window_s,
+                "verify_windows": self.remediation.verify_windows,
+                "verify_streak": self.remediation.verify_streak,
+                "verify_subside_below":
+                    self.remediation.verify_subside_below,
+                "disabled_actions": list(
+                    self.remediation.disabled_actions
+                ),
+            },
             "runtime": {
                 "state_dir": self.runtime.state_dir,
                 "snapshot_interval_s": self.runtime.snapshot_interval_s,
@@ -360,6 +413,25 @@ def _tenant_overrides(raw: Any) -> dict[str, dict[str, float]]:
             numeric[str(key)] = float(value)
         if numeric:
             out[str(tenant)] = numeric
+    return out
+
+
+def _action_kind_list(raw: Any) -> list[str]:
+    """Normalize ``remediation.disabled_actions``: a list of known
+    action-kind strings.  Unknown kinds fail loud — a typo here would
+    silently leave an action armed the operator meant to disable."""
+    from tpuslo.remediation.actions import ALL_ACTION_KINDS
+
+    if not isinstance(raw, list):
+        raise ValueError("remediation.disabled_actions must be a list")
+    out: list[str] = []
+    for kind in raw:
+        if str(kind) not in ALL_ACTION_KINDS:
+            raise ValueError(
+                f"remediation.disabled_actions: unknown action kind "
+                f"{kind!r} (known: {', '.join(ALL_ACTION_KINDS)})"
+            )
+        out.append(str(kind))
     return out
 
 
@@ -497,6 +569,26 @@ def load_config(path: str) -> ToolkitConfig:
                 "clear_cycles": int,
                 "max_tenants": int,
                 "tenants": _tenant_overrides,
+            },
+        )
+    if "remediation" in raw:
+        # Presence of the section arms the action loop (the operator
+        # described it); an explicit ``enabled: false`` still wins.
+        cfg.remediation.enabled = True
+        _merge_section(
+            cfg.remediation,
+            raw.get("remediation") or {},
+            {
+                "enabled": bool,
+                "min_confidence": float,
+                "max_concurrent_actions": int,
+                "cooldown_s": float,
+                "rate_limit": int,
+                "rate_window_s": float,
+                "verify_windows": int,
+                "verify_streak": int,
+                "verify_subside_below": float,
+                "disabled_actions": _action_kind_list,
             },
         )
     _merge_section(

@@ -102,6 +102,33 @@ class CircuitBreaker:
             if self._probes_in_flight > 0:
                 self._probes_in_flight -= 1
 
+    # ---- remediation surface ------------------------------------------
+
+    def force_open(self) -> None:
+        """Trip the breaker by decree (auto-remediation, operator).
+
+        A forced trip re-arms the full cooldown from now: the caller
+        has outside evidence (a network-partition attribution) that the
+        sink's path is bad, which outranks whatever consecutive-failure
+        count the breaker had accumulated on its own.
+        """
+        with self._lock:
+            self._opened_at = self._clock()
+            self._probes_in_flight = 0
+            self._set_state_locked(STATE_OPEN)
+
+    def force_close(self) -> None:
+        """Reset the breaker by decree (remediation rollback).
+
+        Clears the failure count too — the rollback's claim is that the
+        trip was wrong, so the breaker must not re-open on the next
+        single failure off a stale streak.
+        """
+        with self._lock:
+            self._consecutive_failures = 0
+            self._probes_in_flight = 0
+            self._set_state_locked(STATE_CLOSED)
+
     def record_success(self) -> None:
         with self._lock:
             self._consecutive_failures = 0

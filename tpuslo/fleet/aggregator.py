@@ -439,6 +439,25 @@ class AggregatorShard:
                 if value > signals.get(name, float("-inf")):
                     signals[name] = value
 
+    def drop_node(self, node: str) -> None:
+        """Forget one node entirely: its reporting state AND its
+        pending evidence groups.
+
+        The re-home path (remediation ``rehome_slice``) exports a
+        node's fragment, absorbs it on another shard, and must then
+        drop it HERE — popping just ``nodes[node]`` would leave the
+        accumulator groups behind and this shard's next
+        ``close_windows`` would emit duplicate incidents for windows
+        the new owner also emits.
+        """
+        self.nodes.pop(node, None)
+        for bucket in list(self._acc):
+            groups = self._acc[bucket]
+            for gkey in [k for k in groups if k[1] == node]:
+                del groups[gkey]
+            if not groups:
+                del self._acc[bucket]
+
     def restore_state(self, state: dict[str, Any]) -> None:
         self.window_ns = int(state.get("window_ns", self.window_ns))
         for node, fragment in (state.get("nodes") or {}).items():

@@ -35,6 +35,12 @@ class HashRing:
         self._shards: list[str] = []
         self._points: list[int] = []
         self._owners: list[str] = []
+        #: (node, slice) keys cordoned out of placement — the arc stays
+        #: on the ring (ownership math is untouched) but bulk placement
+        #: skips it, so the fleet plane stops assigning the node work
+        #: without re-homing anyone else.  Reversible by design: the
+        #: auto-remediation engine must be able to roll a cordon back.
+        self._cordoned: set[str] = set()
         self.rebalances = 0
         for shard in shards:
             self._insert(shard)
@@ -72,6 +78,34 @@ class HashRing:
         self._owners = [o for _, o in keep]
         self.rebalances += 1
 
+    # ---- cordon (remediation surface) ---------------------------------
+
+    def cordon(self, node: str, slice_id: str) -> bool:
+        """Take one (node, slice) arc out of placement; True when it
+        was not already cordoned."""
+        key = node_key(node, slice_id)
+        if key in self._cordoned:
+            return False
+        self._cordoned.add(key)
+        return True
+
+    def uncordon(self, node: str, slice_id: str) -> bool:
+        """Return a cordoned arc to placement; True when it was
+        actually cordoned."""
+        key = node_key(node, slice_id)
+        if key not in self._cordoned:
+            return False
+        self._cordoned.discard(key)
+        return True
+
+    def is_cordoned(self, node: str, slice_id: str) -> bool:
+        return node_key(node, slice_id) in self._cordoned
+
+    @property
+    def cordoned(self) -> list[str]:
+        """Cordoned (node, slice) keys, sorted for stable display."""
+        return sorted(self._cordoned)
+
     # ---- lookup -------------------------------------------------------
 
     def shard_for(self, key: str) -> str:
@@ -88,10 +122,16 @@ class HashRing:
     def assignments(
         self, nodes: Iterable[tuple[str, str]]
     ) -> dict[str, str]:
-        """Bulk node placement: ``{node: shard}`` for (node, slice)s."""
+        """Bulk node placement: ``{node: shard}`` for (node, slice)s.
+
+        Cordoned arcs are skipped — a cordoned node keeps its ring
+        position (uncordon restores the identical placement) but gets
+        no assignment while held out.
+        """
         return {
             node: self.shard_for_node(node, slice_id)
             for node, slice_id in nodes
+            if node_key(node, slice_id) not in self._cordoned
         }
 
     # ---- failover snapshot -------------------------------------------
@@ -101,6 +141,7 @@ class HashRing:
             "shards": list(self._shards),
             "vnodes": self.vnodes,
             "rebalances": self.rebalances,
+            "cordoned": sorted(self._cordoned),
         }
 
     def restore_state(self, state: dict[str, Any]) -> None:
@@ -114,3 +155,6 @@ class HashRing:
         for shard in shards:
             self._insert(str(shard))
         self.rebalances = int(state.get("rebalances", 0))
+        self._cordoned = {
+            str(key) for key in (state.get("cordoned") or [])
+        }

@@ -333,6 +333,43 @@ class AgentMetrics:
             "each re-homes only the changed shard's arcs)",
             registry=self.registry,
         )
+        # ---- auto-remediation series (tpuslo.remediation) ------------
+        self.remediation_actions_applied = Counter(
+            "llm_slo_agent_remediation_actions_applied_total",
+            "Remediation actions applied, by action kind "
+            "(probe_shed/breaker_trip/drain_snapshot/cordon_node/"
+            "rehome_slice/demote_tenant)",
+            ["action"],
+            registry=self.registry,
+        )
+        self.remediation_actions_rolled_back = Counter(
+            "llm_slo_agent_remediation_actions_rolled_back_total",
+            "Remediation actions rolled back (verify failed or apply "
+            "was interrupted by a restart), by action kind",
+            ["action"],
+            registry=self.registry,
+        )
+        self.remediation_verify_outcomes = Counter(
+            "llm_slo_agent_remediation_verify_outcomes_total",
+            "Verify-or-rollback verdicts (confirmed/rollback)",
+            ["outcome"],
+            registry=self.registry,
+        )
+        self.remediation_actions_in_flight = Gauge(
+            "llm_slo_agent_remediation_actions_in_flight",
+            "Remediation actions currently applying or verifying "
+            "(bounded by the global concurrent-actions budget)",
+            registry=self.registry,
+        )
+        self.remediation_refusals = Counter(
+            "llm_slo_agent_remediation_refusals_total",
+            "Attributions the policy declined to act on, by reason "
+            "(no_rule/low_confidence/not_burning/cooldown/"
+            "rate_limited/budget/no_target/disabled) — the precision "
+            "evidence",
+            ["reason"],
+            registry=self.registry,
+        )
         # ---- self-observability series (tpuslo.obs) ------------------
         self.cycle_stage_ms = Histogram(
             "llm_slo_agent_cycle_stage_ms",
@@ -470,6 +507,11 @@ class AgentMetrics:
         simulator to this registry (duck-typed against
         tpuslo.fleet.FleetObserver)."""
         return _PromFleetObserver(self)
+
+    def remediation_observer(self) -> "_PromRemediationObserver":
+        """Observer adapter wiring a RemediationEngine to this registry
+        (duck-typed against tpuslo.remediation.RemediationObserver)."""
+        return _PromRemediationObserver(self)
 
 
 _BREAKER_STATE_VALUES = {"closed": 0, "half_open": 1, "open": 2}
@@ -733,6 +775,32 @@ class _PromSLOObserver:
         self._m.slo_alert_transitions.labels(
             tenant=tenant, objective=objective, severity=severity
         ).inc()
+
+
+class _PromRemediationObserver:
+    """Bridge from remediation-engine callbacks to Prometheus."""
+
+    def __init__(self, metrics: AgentMetrics):
+        self._m = metrics
+
+    def applied(self, action: str) -> None:
+        self._m.remediation_actions_applied.labels(action=action).inc()
+
+    def rolled_back(self, action: str) -> None:
+        self._m.remediation_actions_rolled_back.labels(
+            action=action
+        ).inc()
+
+    def verify_outcome(self, outcome: str) -> None:
+        self._m.remediation_verify_outcomes.labels(
+            outcome=outcome
+        ).inc()
+
+    def in_flight(self, count: int) -> None:
+        self._m.remediation_actions_in_flight.set(count)
+
+    def refused(self, reason: str) -> None:
+        self._m.remediation_refusals.labels(reason=reason).inc()
 
 
 class Readiness:

@@ -90,6 +90,12 @@ class ProvenanceRecord:
     #: Fleet rollup only: the blast radius of the collapsed page
     #: (pod/node/slice/fleet); empty for single-node incidents.
     blast_radius: str = ""
+    #: Auto-remediation actions taken on this incident, in decision
+    #: order (``RemediationEngine`` action-record dicts: action id,
+    #: kind, target, phase, verify verdict, rollback detail).  The
+    #: engine re-records the full chain on every phase change, so the
+    #: last record per incident carries the complete action history.
+    remediation: list[dict[str, Any]] = field(default_factory=list)
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -109,6 +115,7 @@ class ProvenanceRecord:
             "burning": [dict(b) for b in self.burning],
             "members": [dict(m) for m in self.members],
             "blast_radius": self.blast_radius,
+            "remediation": [dict(r) for r in self.remediation],
         }
 
     @classmethod
@@ -148,6 +155,11 @@ class ProvenanceRecord:
                 if isinstance(m, dict)
             ],
             blast_radius=str(raw.get("blast_radius", "")),
+            remediation=[
+                dict(r)
+                for r in (raw.get("remediation") or [])
+                if isinstance(r, dict)
+            ],
         )
 
     def attribution_block(self) -> dict[str, Any]:
@@ -322,6 +334,27 @@ def format_chain(rec: ProvenanceRecord) -> str:
         )
     else:
         lines.append("  4. alert delivery: (not recorded)")
+
+    if rec.remediation:
+        lines.append(
+            f"  5. remediation ({len(rec.remediation)} action(s)):"
+        )
+        for action in rec.remediation:
+            verdict = action.get("verdict") or action.get("phase", "?")
+            lines.append(
+                f"     - {action.get('kind', '?')} on "
+                f"{action.get('target', '?')} "
+                f"[{action.get('action_id', '?')}] "
+                f"phase={action.get('phase', '?')} verdict={verdict}"
+            )
+            detail = action.get("detail", "")
+            if detail:
+                lines.append(f"       {detail}")
+            if action.get("escalated"):
+                lines.append(
+                    "       ESCALATED: verify failed or apply was "
+                    "interrupted — paged a human"
+                )
 
     if rec.stages_ms:
         stages = " ".join(

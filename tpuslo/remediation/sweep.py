@@ -1,0 +1,673 @@
+"""Seeded remediation sweep: the release gate for the action loop.
+
+Each scenario builds a miniature world — a real :class:`BurnEngine`
+fed synthesized per-request traffic, a real probe generator /
+circuit breaker / hash ring as action substrate, and a
+:class:`RemediationEngine` wired through :class:`ActionBindings` —
+then drives observe → attribute → remediate → verify on a synthetic
+clock (hours of event time, milliseconds of wall time).  Fault
+evidence comes through ``tpuslo.faultreplay`` samples attributed by
+the real :class:`BayesianAttributor`, so the confidence the policy
+gates on is the Bayesian posterior, not a scripted number.
+
+The contracts every run asserts (the ISSUE acceptance criteria):
+
+* **precision 1.0** — zero actions on healthy tenants, low-confidence
+  attributions, or burn-free incidents; an action only ever lands on
+  the scenario's injected target;
+* **time-to-mitigate** — every confirmed action's burn verifiably
+  subsided within the verifier's window budget;
+* **rollback on false positive** — when the burn does not subside the
+  action is rolled back, the substrate is restored, and the incident
+  escalates;
+* **zero duplicate actions across a mid-sweep agent kill** — the
+  restart scenario snapshots the engine mid-verify, rebuilds the
+  world from the exported state, and must end with exactly the same
+  single action as the uninterrupted run;
+* **provenance end-to-end** — every action id appears in the
+  provenance chain of the incident that triggered it, with its final
+  verdict.
+
+``m5gate --remediation-sweep`` and ``make remediation-sweep`` run
+this; evidence in docs/runbooks/auto-remediation.md.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import tempfile
+from dataclasses import dataclass, field
+from datetime import datetime, timezone
+from typing import Any, Callable
+
+from tpuslo.attribution.bayesian import BayesianAttributor
+from tpuslo.delivery.breaker import STATE_CLOSED, CircuitBreaker
+from tpuslo.faultreplay.generator import generate_fault_samples
+from tpuslo.fleet.ring import HashRing
+from tpuslo.obs.provenance import ProvenanceLog, load_records
+from tpuslo.remediation.actions import (
+    ACTION_BREAKER_TRIP,
+    ACTION_CORDON_NODE,
+    ACTION_DEMOTE_TENANT,
+    ACTION_PROBE_SHED,
+    ActionBindings,
+)
+from tpuslo.remediation.engine import (
+    PHASE_CONFIRMED,
+    PHASE_ROLLED_BACK,
+    PHASE_VERIFYING,
+    ActionRecord,
+    RemediationEngine,
+)
+from tpuslo.remediation.policy import (
+    AttributionContext,
+    RemediationPolicy,
+    default_rules,
+)
+from tpuslo.remediation.verifier import VerifyPolicy
+from tpuslo.safety.recovery import (
+    OWNER_GUARD,
+    OWNER_REMEDIATION,
+    ShedOwnership,
+)
+from tpuslo.signals.generator import Generator
+from tpuslo.sloengine.engine import BurnEngine, EngineConfig
+from tpuslo.sloengine.stream import RequestOutcome
+
+#: Synthetic stream epoch (event time; nothing reads the wall clock).
+BASE_TS_S = 1_750_000_000
+
+#: Domain the faultreplay scenario maps to, per sweep scenario.
+_SCENARIO_FAULT: dict[str, str] = {
+    "demote_fast_burn": "hbm_pressure",
+    "breaker_trip_partition": "network_partition",
+    "probe_shed_cpu": "cpu_throttle",
+    "cordon_ici": "ici_drop",
+    "false_positive_rollback": "hbm_pressure",
+    "low_confidence_held": "hbm_pressure",
+    "healthy_quiet": "hbm_pressure",
+    "storm_rate_limited": "hbm_pressure",
+    "restart_mid_verify": "hbm_pressure",
+}
+
+
+@dataclass
+class SweepScenario:
+    """One seeded world + its expected action contract."""
+
+    name: str
+    #: Expected (kind, target) applies — empty set means the precision
+    #: contract is "hold fire completely".
+    expected: set[tuple[str, str]] = field(default_factory=set)
+    #: Count-based alternative for storm scenarios where WHICH burning
+    #: tenants act first is seeded-noise-dependent: (kind, count), with
+    #: every target still required to be a burning tenant.
+    expected_kind_count: tuple[str, int] | None = None
+    #: Tenants whose traffic burns (the storm scenario burns many).
+    burning_tenants: tuple[str, ...] = ("tenant-a",)
+    #: Attribution confidence override; <0 uses the real posterior.
+    confidence_override: float = -1.0
+    #: Whether the applied action actually heals the traffic.
+    mitigates: bool = True
+    #: Suppress the burn phase entirely (healthy-world precision probe).
+    burn: bool = True
+    #: Kill + restore the engine mid-verify (duplicate-action probe).
+    restart_mid_verify: bool = False
+    #: Expected terminal phase for the primary action.
+    expect_phase: str = PHASE_CONFIRMED
+    #: Expected refusal reasons that must appear (held-fire evidence).
+    expect_refusals: tuple[str, ...] = ()
+
+
+def default_scenarios() -> list[SweepScenario]:
+    return [
+        SweepScenario(
+            name="healthy_quiet",
+            expected=set(),
+            burn=False,
+            expect_refusals=("not_burning",),
+        ),
+        SweepScenario(
+            name="low_confidence_held",
+            expected=set(),
+            confidence_override=0.4,
+            expect_refusals=("low_confidence",),
+        ),
+        SweepScenario(
+            name="demote_fast_burn",
+            expected={(ACTION_DEMOTE_TENANT, "tenant-a")},
+        ),
+        SweepScenario(
+            name="breaker_trip_partition",
+            expected={(ACTION_BREAKER_TRIP, "otlp")},
+        ),
+        SweepScenario(
+            name="probe_shed_cpu",
+            expected={(ACTION_PROBE_SHED, "syscall_latency_ms")},
+        ),
+        SweepScenario(
+            name="cordon_ici",
+            expected={(ACTION_CORDON_NODE, "node-07|slice-1")},
+        ),
+        SweepScenario(
+            name="false_positive_rollback",
+            expected={(ACTION_DEMOTE_TENANT, "tenant-a")},
+            mitigates=False,
+            expect_phase=PHASE_ROLLED_BACK,
+        ),
+        SweepScenario(
+            name="storm_rate_limited",
+            expected_kind_count=(ACTION_DEMOTE_TENANT, 3),
+            burning_tenants=tuple(f"tenant-{i:02d}" for i in range(10)),
+            expect_refusals=("budget", "rate_limited"),
+        ),
+        SweepScenario(
+            name="restart_mid_verify",
+            expected={(ACTION_DEMOTE_TENANT, "tenant-a")},
+            restart_mid_verify=True,
+        ),
+    ]
+
+
+@dataclass
+class _World:
+    """The action substrate one scenario binds to."""
+
+    burn: BurnEngine
+    generator: Generator
+    ownership: ShedOwnership
+    breaker: CircuitBreaker
+    ring: HashRing
+    engine: RemediationEngine
+
+
+def _build_world(
+    scenario: SweepScenario,
+    provenance_path: str,
+    verify: VerifyPolicy,
+    clock: list[float],
+) -> _World:
+    burn = BurnEngine(EngineConfig(bucket_s=10))
+    generator = Generator("tpu_full")
+    ownership = ShedOwnership()
+    # The breaker reads the scenario's advancing event time through
+    # the mutable clock box, so time-dependent breaker behavior
+    # (half-open after the cooldown) runs on the same synthetic clock
+    # as everything else.
+    breaker = CircuitBreaker(clock=lambda: clock[0])
+    ring = HashRing(["agg-0", "agg-1"], vnodes=16)
+    bindings = ActionBindings(
+        probe_manager=generator,
+        ownership=ownership,
+        breakers={"otlp": breaker},
+        ring=ring,
+        burn_engine=burn,
+    )
+    engine = RemediationEngine(
+        policy=RemediationPolicy(
+            rules=default_rules(), max_concurrent_actions=2
+        ),
+        bindings=bindings,
+        verify=verify,
+        provenance_log=ProvenanceLog(provenance_path),
+    )
+    return _World(
+        burn=burn,
+        generator=generator,
+        ownership=ownership,
+        breaker=breaker,
+        ring=ring,
+        engine=engine,
+    )
+
+
+def _attributed_contexts(
+    scenario: SweepScenario, seed: int
+) -> list[tuple[str, str, float, str, str]]:
+    """(incident_id, domain, confidence, node, slice) per injection.
+
+    The domain + confidence come from the real faultreplay →
+    BayesianAttributor path; the Bayesian posterior on a full fault
+    profile is the high-confidence evidence the policy gates on.
+    """
+    fault = _SCENARIO_FAULT[scenario.name]
+    samples = generate_fault_samples(
+        fault,
+        max(1, len(scenario.burning_tenants)),
+        start=datetime.fromtimestamp(BASE_TS_S, tz=timezone.utc),
+    )
+    attributor = BayesianAttributor()
+    out: list[tuple[str, str, float, str, str]] = []
+    for idx, sample in enumerate(samples):
+        attr = attributor.attribute_sample(sample)
+        confidence = (
+            scenario.confidence_override
+            if scenario.confidence_override >= 0
+            else attr.confidence
+        )
+        out.append(
+            (
+                f"{scenario.name}-inc-{idx:02d}",
+                attr.predicted_fault_domain,
+                confidence,
+                "node-07",
+                "slice-1",
+            )
+        )
+    return out
+
+
+@dataclass
+class RemediationScenarioRun:
+    """Verdict for one scenario."""
+
+    name: str
+    passed: bool
+    failures: list[str] = field(default_factory=list)
+    actions: list[dict[str, Any]] = field(default_factory=list)
+    refusals: dict[str, int] = field(default_factory=dict)
+    #: Event-time seconds from apply to confirmed, per confirmed action.
+    time_to_mitigate_s: list[float] = field(default_factory=list)
+    max_in_flight: int = 0
+    evaluations: int = 0
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "name": self.name,
+            "passed": self.passed,
+            "failures": list(self.failures),
+            "actions": list(self.actions),
+            "refusals": dict(self.refusals),
+            "time_to_mitigate_s": list(self.time_to_mitigate_s),
+            "max_in_flight": self.max_in_flight,
+            "evaluations": self.evaluations,
+        }
+
+
+@dataclass
+class RemediationSweepReport:
+    """The whole gate's verdict."""
+
+    passed: bool
+    seed: int
+    eval_interval_s: float
+    verify_windows: int
+    runs: list[RemediationScenarioRun] = field(default_factory=list)
+    failures: list[str] = field(default_factory=list)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "passed": self.passed,
+            "seed": self.seed,
+            "eval_interval_s": self.eval_interval_s,
+            "verify_windows": self.verify_windows,
+            "runs": [r.to_dict() for r in self.runs],
+            "failures": list(self.failures),
+        }
+
+
+def _record_traffic(
+    burn: BurnEngine,
+    rng: random.Random,
+    start_s: float,
+    interval_s: float,
+    tenants: dict[str, float],
+    request_interval_s: int = 5,
+) -> None:
+    """Fold one evaluation interval of per-tenant traffic."""
+    steps = max(1, int(interval_s) // request_interval_s)
+    for step in range(steps):
+        ts_s = start_s + step * request_interval_s
+        for tenant, error_rate in tenants.items():
+            error = rng.random() < error_rate
+            burn.record(
+                RequestOutcome(
+                    tenant=tenant,
+                    ts_unix_nano=int(ts_s) * 1_000_000_000,
+                    ttft_ms=rng.uniform(150.0, 450.0),
+                    tpot_ms=rng.uniform(20.0, 60.0),
+                    tokens=128,
+                    status="error" if error else "ok",
+                    request_id=f"rem-{tenant}-{int(ts_s)}",
+                )
+            )
+
+
+def _burn_lookup(world: _World, scenario: SweepScenario) -> Callable:
+    """Verify evidence: the short-window (5m) availability burn of the
+    action's tenant — the fast-reacting window, exactly the one the
+    multi-window alert design uses for quick recovery."""
+
+    def lookup(rec: ActionRecord) -> float:
+        tenant = (
+            rec.target
+            if rec.kind == ACTION_DEMOTE_TENANT
+            else scenario.burning_tenants[0]
+        )
+        for stat in world.burn.status():
+            if stat.tenant == tenant and stat.objective == "availability":
+                return stat.burn_rates.get("5m", 0.0)
+        return 0.0
+
+    return lookup
+
+
+def run_scenario(
+    scenario: SweepScenario,
+    seed: int,
+    provenance_dir: str,
+    eval_interval_s: float = 60.0,
+    verify_windows: int = 10,
+) -> RemediationScenarioRun:
+    rng = random.Random(seed)
+    verify = VerifyPolicy(windows=verify_windows, subside_streak=2)
+    provenance_path = os.path.join(
+        provenance_dir, f"{scenario.name}.jsonl"
+    )
+    # Truncate a previous run's chain: re-running the sweep must not
+    # read stale provenance.
+    open(provenance_path, "w", encoding="utf-8").close()
+    clock = [0.0]
+    world = _build_world(scenario, provenance_path, verify, clock)
+    contexts = _attributed_contexts(scenario, seed)
+    tenants = list(scenario.burning_tenants)
+
+    clean_rate = 0.002
+    burn_rate = 0.25
+    warmup_steps = int(3600 / eval_interval_s)
+    total_steps = warmup_steps + int(5400 / eval_interval_s)
+    mitigated: set[str] = set()
+    run = RemediationScenarioRun(name=scenario.name, passed=True)
+    restarted = False
+
+    def rates_now(step: int) -> dict[str, float]:
+        rates: dict[str, float] = {}
+        for tenant in tenants:
+            burning = scenario.burn and step >= warmup_steps
+            if scenario.mitigates and tenant in mitigated:
+                burning = False
+            rates[tenant] = burn_rate if burning else clean_rate
+        return rates
+
+    lookup = _burn_lookup(world, scenario)
+    now_s = float(BASE_TS_S)
+    for step in range(total_steps):
+        _record_traffic(
+            world.burn, rng, now_s, eval_interval_s, rates_now(step)
+        )
+        now_s += eval_interval_s
+        clock[0] = now_s - BASE_TS_S
+        world.burn.evaluate(now_s)
+        run.evaluations += 1
+
+        if step >= warmup_steps:
+            for idx, (
+                incident_id,
+                domain,
+                confidence,
+                node,
+                slice_id,
+            ) in enumerate(contexts):
+                tenant = tenants[idx % len(tenants)]
+                ctx = AttributionContext(
+                    incident_id=incident_id,
+                    domain=domain,
+                    confidence=confidence,
+                    burn_state=world.burn.policy.state_of(
+                        tenant, "availability"
+                    ),
+                    burn_rate=world.burn.max_active_burn(),
+                    tenant=tenant,
+                    node=node,
+                    slice_id=slice_id,
+                    at_s=now_s,
+                )
+                world.engine.consider(ctx, now_s)
+
+        resolved = world.engine.tick(now_s, lookup)
+        for rec in resolved:
+            if rec.phase == PHASE_CONFIRMED:
+                run.time_to_mitigate_s.append(
+                    rec.resolved_at_s - rec.applied_at_s
+                )
+
+        # Applied demotions heal the demoted tenant's traffic (that is
+        # what admission demotion is for); other kinds heal the
+        # primary tenant.
+        for rec in world.engine.records():
+            if rec.phase in (PHASE_VERIFYING, PHASE_CONFIRMED):
+                mitigated.add(
+                    rec.target
+                    if rec.kind == ACTION_DEMOTE_TENANT
+                    else tenants[0]
+                )
+        run.max_in_flight = max(
+            run.max_in_flight, world.engine.in_flight()
+        )
+
+        if (
+            scenario.restart_mid_verify
+            and not restarted
+            and world.engine.in_flight() > 0
+        ):
+            # Mid-sweep agent kill: snapshot every component, rebuild
+            # the whole world from the exports (fresh objects, exactly
+            # like a process restart), and keep going.
+            restarted = True
+            exports = {
+                "remediation": world.engine.export_state(),
+                "sloengine": world.burn.export_state(),
+                "ring": world.ring.export_state(),
+                "breaker": world.breaker.export_state(),
+                "ownership": world.ownership.export_state(),
+            }
+            world = _build_world(scenario, provenance_path, verify, clock)
+            world.burn.restore_state(exports["sloengine"])
+            world.ring.restore_state(exports["ring"])
+            world.breaker.restore_state(exports["breaker"])
+            world.ownership.restore_state(exports["ownership"])
+            world.engine.restore_state(exports["remediation"])
+            lookup = _burn_lookup(world, scenario)
+
+    _assert_contract(scenario, world, run, verify, provenance_path)
+    run.refusals = dict(world.engine.policy.refusals)
+    run.actions = [rec.to_dict() for rec in world.engine.records()]
+    run.passed = not run.failures
+    return run
+
+
+def _assert_contract(
+    scenario: SweepScenario,
+    world: _World,
+    run: RemediationScenarioRun,
+    verify: VerifyPolicy,
+    provenance_path: str,
+) -> None:
+    records = world.engine.records()
+    applied = [
+        rec
+        for rec in records
+        if rec.phase
+        in (PHASE_VERIFYING, PHASE_CONFIRMED, PHASE_ROLLED_BACK)
+    ]
+    applied_keys = {(rec.kind, rec.target) for rec in applied}
+
+    # Precision 1.0: exactly the expected actions, nothing else.
+    if scenario.expected_kind_count is not None:
+        kind, count = scenario.expected_kind_count
+        burning = set(scenario.burning_tenants)
+        for rec in applied:
+            if rec.kind != kind or rec.target not in burning:
+                run.failures.append(
+                    f"unexpected action ({rec.kind}, {rec.target})"
+                )
+        if len(applied) != count:
+            run.failures.append(
+                f"{len(applied)} actions applied, expected exactly "
+                f"{count} (dampers should cap the storm)"
+            )
+    else:
+        for key in applied_keys - scenario.expected:
+            run.failures.append(f"unexpected action {key}")
+        for key in scenario.expected - applied_keys:
+            run.failures.append(f"expected action {key} never applied")
+
+    # Zero duplicates: one record per (kind, target), one apply each.
+    seen: set[tuple[str, str]] = set()
+    for rec in applied:
+        key = (rec.kind, rec.target)
+        if key in seen:
+            run.failures.append(f"duplicate action {key}")
+        seen.add(key)
+    if scenario.restart_mid_verify:
+        if world.engine.counters.applied != len(scenario.expected):
+            run.failures.append(
+                "restart run applied "
+                f"{world.engine.counters.applied} actions, expected "
+                f"{len(scenario.expected)} (duplicate across kill?)"
+            )
+        if world.engine.counters.interrupted != 0:
+            run.failures.append(
+                "restart mid-verify must not count as interrupted "
+                "mid-apply"
+            )
+
+    # Verify-or-rollback within the window budget, and the expected
+    # terminal phase for every applied action.
+    for rec in applied:
+        if rec.phase == PHASE_VERIFYING:
+            run.failures.append(
+                f"action {rec.action_id} never settled "
+                f"({rec.windows_seen} windows seen)"
+            )
+            continue
+        if rec.phase != scenario.expect_phase:
+            run.failures.append(
+                f"action {rec.action_id} ended {rec.phase}, expected "
+                f"{scenario.expect_phase}"
+            )
+        if rec.windows_seen > verify.windows:
+            run.failures.append(
+                f"action {rec.action_id} took {rec.windows_seen} "
+                f"windows (budget {verify.windows})"
+            )
+
+    # Rollback restores the substrate and escalates.
+    if scenario.expect_phase == PHASE_ROLLED_BACK:
+        for rec in applied:
+            if not rec.escalated:
+                run.failures.append(
+                    f"rolled-back action {rec.action_id} did not "
+                    "escalate"
+                )
+        if world.burn.demoted_tenants():
+            run.failures.append(
+                "rollback left tenants demoted: "
+                f"{world.burn.demoted_tenants()}"
+            )
+
+    # Scenario-specific substrate checks.
+    if scenario.name == "breaker_trip_partition":
+        # On the live synthetic clock the tripped breaker ages into
+        # half-open after its cooldown (its own recovery probe — by
+        # design); "not closed" is the trip's lasting evidence.
+        if world.breaker.export_state().get("state") == STATE_CLOSED:
+            run.failures.append("breaker closed after confirmed trip")
+    if scenario.name == "probe_shed_cpu":
+        if "syscall_latency_ms" not in world.generator.shed_signals():
+            run.failures.append("probe not shed after confirmed action")
+        if world.ownership.owner_of("syscall_latency_ms") != (
+            OWNER_REMEDIATION
+        ):
+            run.failures.append("shed probe not remediation-owned")
+        if world.ownership.may_restore("syscall_latency_ms", OWNER_GUARD):
+            run.failures.append(
+                "guard recovery could restore a remediation-owned shed"
+            )
+    if scenario.name == "cordon_ici":
+        if not world.ring.is_cordoned("node-07", "slice-1"):
+            run.failures.append("node not cordoned after confirmed action")
+    if scenario.name == "storm_rate_limited":
+        if run.max_in_flight > world.engine.policy.max_concurrent_actions:
+            run.failures.append(
+                f"{run.max_in_flight} actions in flight exceeds the "
+                "global budget"
+            )
+
+    # Held-fire evidence: the refusal reasons the scenario expects.
+    for reason in scenario.expect_refusals:
+        if world.engine.policy.refusals.get(reason, 0) < 1:
+            run.failures.append(
+                f"expected refusal reason {reason!r} never counted"
+            )
+
+    # Provenance end-to-end: every action traceable in its incident's
+    # chain with its final verdict.
+    chains = load_records(provenance_path)
+    for rec in applied:
+        chain = chains.get(rec.incident_id)
+        entry = None
+        if chain is not None:
+            for candidate in chain.remediation:
+                if candidate.get("action_id") == rec.action_id:
+                    entry = candidate
+                    break
+        if entry is None:
+            run.failures.append(
+                f"action {rec.action_id} missing from the provenance "
+                "chain"
+            )
+        elif entry.get("phase") != rec.phase:
+            run.failures.append(
+                f"provenance phase {entry.get('phase')!r} != engine "
+                f"phase {rec.phase!r} for {rec.action_id}"
+            )
+
+
+def run_remediation_sweep(
+    seed: int = 1337,
+    eval_interval_s: float = 60.0,
+    verify_windows: int = 10,
+    provenance_dir: str | None = None,
+    scenarios: list[SweepScenario] | None = None,
+    log: Callable[[str], None] | None = None,
+) -> RemediationSweepReport:
+    """Run every scenario; the gate passes only if all of them do."""
+    if provenance_dir is None:
+        provenance_dir = tempfile.mkdtemp(prefix="remediation-sweep-")
+    os.makedirs(provenance_dir, exist_ok=True)
+    runs: list[RemediationScenarioRun] = []
+    failures: list[str] = []
+    for scenario in (
+        scenarios if scenarios is not None else default_scenarios()
+    ):
+        run = run_scenario(
+            scenario,
+            seed,
+            provenance_dir,
+            eval_interval_s=eval_interval_s,
+            verify_windows=verify_windows,
+        )
+        runs.append(run)
+        if log is not None:
+            settled = [
+                a
+                for a in run.actions
+                if a["phase"] in (PHASE_CONFIRMED, PHASE_ROLLED_BACK)
+            ]
+            log(
+                f"remediation-sweep: {run.name}: "
+                f"{'PASS' if run.passed else 'FAIL'} "
+                f"({len(settled)} action(s), "
+                f"{run.evaluations} evals)"
+            )
+        failures.extend(f"{run.name}: {f}" for f in run.failures)
+    return RemediationSweepReport(
+        passed=not failures,
+        seed=seed,
+        eval_interval_s=eval_interval_s,
+        verify_windows=verify_windows,
+        runs=runs,
+        failures=failures,
+    )

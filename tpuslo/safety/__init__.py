@@ -8,14 +8,22 @@ from tpuslo.safety.overhead_guard import (
     ProcCPUSampler,
 )
 from tpuslo.safety.rate_limiter import RateLimiter
-from tpuslo.safety.recovery import ShedRecoveryPolicy
+from tpuslo.safety.recovery import (
+    OWNER_GUARD,
+    OWNER_REMEDIATION,
+    ShedOwnership,
+    ShedRecoveryPolicy,
+)
 
 __all__ = [
     "CPUSample",
     "CPUSampler",
     "OverheadGuard",
     "OverheadResult",
+    "OWNER_GUARD",
+    "OWNER_REMEDIATION",
     "ProcCPUSampler",
     "RateLimiter",
+    "ShedOwnership",
     "ShedRecoveryPolicy",
 ]

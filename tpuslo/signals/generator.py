@@ -327,6 +327,17 @@ class Generator:
                 return signal
         return None
 
+    def restore_signal(self, signal: str) -> bool:
+        """Re-enable one specific shed signal (remediation rollback:
+        the engine must restore exactly the probe *it* shed, not
+        whatever happens to sit on top of the shed stack)."""
+        with self._lock:
+            if signal not in self._shed:
+                return False
+            self._shed.remove(signal)
+            self._enabled.add(signal)
+            return True
+
     def import_shed(self, signals: Iterable[str]) -> list[str]:
         """Adopt a restored shed list (oldest-shed first).
 

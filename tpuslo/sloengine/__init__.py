@@ -28,6 +28,8 @@ from tpuslo.sloengine.budget import (
     resolve_targets,
 )
 from tpuslo.sloengine.engine import (
+    DEFAULT_ADMISSION_PRIORITY,
+    DEMOTED_ADMISSION_PRIORITY,
     BurnEngine,
     EngineConfig,
     SLOObserver,
@@ -56,6 +58,8 @@ __all__ = [
     "TenantTargets",
     "resolve_targets",
     "BurnEngine",
+    "DEFAULT_ADMISSION_PRIORITY",
+    "DEMOTED_ADMISSION_PRIORITY",
     "EngineConfig",
     "SLOObserver",
     "load_outcomes",

@@ -36,6 +36,13 @@ from tpuslo.sloengine.stream import RequestOutcome, TenantWindows
 
 STATE_VERSION = 1
 
+#: Admission priority the serving scheduler consults per tenant
+#: (higher = admitted first).  Every tenant starts at the default; the
+#: auto-remediation engine demotes a burning tenant to the demoted
+#: value and restores it on rollback.
+DEFAULT_ADMISSION_PRIORITY = 100
+DEMOTED_ADMISSION_PRIORITY = 10
+
 
 class SLOObserver:
     """No-op observer; the agent bridges these to Prometheus."""
@@ -132,6 +139,8 @@ class BurnEngine:
         self.dropped_overflow = 0
         self.transitions_fired = 0
         self._last_eval_s = 0.0
+        # tenant -> demoted admission priority (absent = default).
+        self._admission: dict[str, int] = {}
 
     # ---- stream side (hot path) ---------------------------------------
 
@@ -284,6 +293,35 @@ class BurnEngine:
             best = max(best, rates.get(window, 0.0))
         return best
 
+    # ---- admission priority (remediation surface) ---------------------
+
+    def admission_priority(self, tenant: str) -> int:
+        """Priority the serving scheduler should admit this tenant at
+        (higher first); demoted tenants sort behind everyone else."""
+        return self._admission.get(
+            tenant or "default", DEFAULT_ADMISSION_PRIORITY
+        )
+
+    def demote_tenant(
+        self, tenant: str, priority: int = DEMOTED_ADMISSION_PRIORITY
+    ) -> bool:
+        """Demote one tenant's admission priority; False when already
+        demoted (the caller must not stack demotions it cannot
+        symmetrically restore)."""
+        tenant = tenant or "default"
+        if tenant in self._admission:
+            return False
+        self._admission[tenant] = int(priority)
+        return True
+
+    def restore_tenant(self, tenant: str) -> bool:
+        """Return a demoted tenant to the default admission priority;
+        False when it was not demoted."""
+        return self._admission.pop(tenant or "default", None) is not None
+
+    def demoted_tenants(self) -> list[str]:
+        return sorted(self._admission)
+
     def snapshot(self) -> dict[str, Any]:
         """Stats-line counters."""
         return {
@@ -308,6 +346,7 @@ class BurnEngine:
                 for tenant, windows in self._tenants.items()
             },
             "alerts": self.policy.export_state(),
+            "admission": dict(self._admission),
             "recorded": self.recorded,
             "transitions_fired": self.transitions_fired,
         }
@@ -334,6 +373,10 @@ class BurnEngine:
                 restored[tenant] = windows
         self._tenants = restored
         self.policy.restore_state(state.get("alerts") or {})
+        self._admission = {
+            str(tenant): int(priority)
+            for tenant, priority in (state.get("admission") or {}).items()
+        }
         self.recorded = int(state.get("recorded", 0))
         self.transitions_fired = int(state.get("transitions_fired", 0))
 
