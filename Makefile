@@ -13,6 +13,7 @@ ARTIFACTS ?= artifacts
 	chaos-telemetry-sweep crash-smoke crash-sweep obs-smoke \
 	burn-smoke burn-sweep fleet-smoke fleet-sweep \
 	remediation-smoke remediation-sweep \
+	frontdoor-smoke frontdoor-bench \
 	metrics-drift m5-candidate m5-gate helm-lint dashboards clean
 
 all: native test
@@ -227,6 +228,25 @@ remediation-sweep:
 		--summary-json $(ARTIFACTS)/remediation/sweep.json \
 		--summary-md $(ARTIFACTS)/remediation/sweep.md
 
+# Serving front-door smoke: per-slot stream parity vs the per-stream
+# speculative engine, admission/preemption/shed edges, prefix-aware
+# placement, burn-state demotion, and snapshot round trips — seconds,
+# runs in m5-gate.
+frontdoor-smoke:
+	$(PY) -m pytest tests/test_frontdoor.py -q -m 'not slow'
+
+# Full front-door release gate (slow): loadgen-driven bursty
+# multi-tenant traffic through the FrontDoorEngine must beat the same
+# streams served sequentially through the per-stream SpeculativeEngine
+# by >= 2x on goodput AND tokens/s, with zero steady-state recompiles
+# (jitaudit), host syncs/token under the serving ceiling, and
+# burn-aware admission observable (see docs/runbooks/serving-slo.md).
+frontdoor-bench:
+	mkdir -p $(ARTIFACTS)/frontdoor
+	JAX_PLATFORMS=$${JAX_PLATFORMS:-cpu} $(PY) -m tpuslo m5gate --frontdoor-bench \
+		--summary-json $(ARTIFACTS)/frontdoor/bench.json \
+		--summary-md $(ARTIFACTS)/frontdoor/bench.md
+
 # Fleet observability-plane smoke: wire contract round trips, hash-ring
 # placement, rollup merge invariants (no cross-tenant/cross-domain),
 # aggregator seq-dedup + failover absorb, and a small seeded simulator
@@ -289,12 +309,14 @@ m5-candidate:
 
 # Release candidates fail on new lint findings, lock-order races,
 # steady-state decode recompiles, burn-alert contract violations,
-# row-vs-columnar divergence, a broken fleet plane, or a remediation
-# loop that acts imprecisely before the statistical gates even run
-# (ISSUEs 6 + 7 + 8 + 9 + 10 + 11).
+# row-vs-columnar divergence, a broken fleet plane, a remediation
+# loop that acts imprecisely, or a serving front door that loses to
+# per-stream serving, before the statistical gates even run
+# (ISSUEs 6 + 7 + 8 + 9 + 10 + 11 + 12).
 m5-gate: lint racecheck-smoke jitcheck-smoke burn-smoke burn-sweep \
 		bench-columnar-smoke fleet-smoke fleet-sweep \
-		remediation-smoke remediation-sweep
+		remediation-smoke remediation-sweep \
+		frontdoor-smoke frontdoor-bench
 	$(PY) -m tpuslo m5gate --candidate-root $(ARTIFACTS)/m5 \
 		--scenarios "$(shell echo $(M5_SCENARIOS) | tr ' ' ',')" \
 		--summary-json $(ARTIFACTS)/m5/gate.json \

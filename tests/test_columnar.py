@@ -210,3 +210,102 @@ def test_from_rows_sizes(n):
     batch = from_rows(events)
     assert len(batch) == n
     assert to_rows(batch) == events
+
+
+class TestPosteriorJitAutoTuner:
+    """The measured engagement policy (ISSUE 12 satellite): auto mode
+    may only engage jit where a timed probe on the call's own inputs
+    says jit wins — the ROADMAP #5 regression (always-on jit at 0.63x
+    numpy on a 1-CPU host) cannot recur by construction."""
+
+    def _inputs(self, n_rows: int):
+        from tpuslo.attribution.calibrate import calibrated_attributor
+
+        attributor = calibrated_attributor()
+        mats = attributor._matrices().kernel
+        rng = np.random.default_rng(11)
+        n_sig = len(attributor.likelihoods)
+        values = np.abs(rng.lognormal(2.0, 1.5, (n_rows, n_sig)))
+        observed = rng.random((n_rows, n_sig)) < 0.9
+        return values, observed, mats, attributor.sharpness
+
+    def test_probe_bucket_rounds_down_to_measured_rows(self):
+        """Review regression: the probe slices the call's inputs to
+        the bucket, so the bucket must fit INSIDE the row count — an
+        upward round would cache a verdict for more rows than it
+        timed."""
+        from tpuslo.columnar.posterior import (
+            JIT_PROBE_MAX_ROWS,
+            _row_bucket,
+        )
+
+        assert _row_bucket(5000) == 4096
+        assert _row_bucket(4096) == 4096
+        assert _row_bucket(8191) == 4096
+        assert _row_bucket(1) == 1
+        assert _row_bucket(10 ** 9) == JIT_PROBE_MAX_ROWS
+
+    def test_below_floor_never_probes(self, monkeypatch):
+        from tpuslo.columnar import posterior
+
+        monkeypatch.delenv("TPUSLO_COLUMNAR_JIT", raising=False)
+        assert posterior.resolve_use_jax(100, None) is False
+        assert posterior.resolve_use_jax(
+            posterior.JIT_MIN_BATCH - 1, None
+        ) is False
+
+    def test_explicit_and_env_override_skip_probe(self, monkeypatch):
+        from tpuslo.columnar import posterior
+
+        assert posterior.resolve_use_jax(10, True) is True
+        assert posterior.resolve_use_jax(1 << 20, False) is False
+        monkeypatch.setenv("TPUSLO_COLUMNAR_JIT", "0")
+        assert posterior.resolve_use_jax(1 << 20, None) is False
+        monkeypatch.setenv("TPUSLO_COLUMNAR_JIT", "1")
+        assert posterior.resolve_use_jax(1 << 20, None) is True
+
+    def test_min_rows_env_moves_the_floor(self, monkeypatch):
+        from tpuslo.columnar import posterior
+
+        monkeypatch.delenv("TPUSLO_COLUMNAR_JIT", raising=False)
+        monkeypatch.setenv("TPUSLO_COLUMNAR_JIT_MIN_ROWS", "50000")
+        assert posterior.resolve_use_jax(8192, None) is False
+        assert posterior.resolve_use_jax(50000, None) is None
+
+    def test_auto_probe_caches_and_reports(self, monkeypatch):
+        from tpuslo.columnar import posterior
+        from tpuslo.columnar.posterior import log_posterior_batch
+
+        monkeypatch.delenv("TPUSLO_COLUMNAR_JIT", raising=False)
+        monkeypatch.delenv("TPUSLO_COLUMNAR_JIT_MIN_ROWS", raising=False)
+        monkeypatch.setattr(posterior, "_AUTO_PROBES", {})
+        values, observed, mats, sharpness = self._inputs(
+            posterior.JIT_MIN_BATCH
+        )
+        post, _w, _o = log_posterior_batch(
+            values, observed, mats, soft=True, sharpness=sharpness,
+            use_jax=None,
+        )
+        report = posterior.auto_report()
+        assert len(report["probes"]) == 1
+        (probe,) = report["probes"].values()
+        assert probe["rows"] == posterior.JIT_MIN_BATCH
+        assert probe["speedup"] > 0
+        # Whatever the probe decided, the auto result matches the path
+        # it chose (parity of the two kernels is asserted elsewhere).
+        expected, _w2, _o2 = log_posterior_batch(
+            values, observed, mats, soft=True, sharpness=sharpness,
+            use_jax=probe["jit_wins"],
+        )
+        assert np.allclose(post, expected, atol=1e-9)
+        # Second call reuses the cached verdict (no new probe entry).
+        log_posterior_batch(
+            values, observed, mats, soft=True, sharpness=sharpness,
+            use_jax=None,
+        )
+        assert len(posterior.auto_report()["probes"]) == 1
+        threshold = posterior.auto_threshold()
+        if probe["jit_wins"]:
+            assert threshold == posterior.JIT_MIN_BATCH
+        else:
+            assert threshold is None

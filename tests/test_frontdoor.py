@@ -1,0 +1,666 @@
+"""Serving front door: batched spec rounds in slots + SLO-aware
+admission (ISSUE 12).
+
+The exactness contract everything else leans on: every request served
+through the front door emits EXACTLY the stream the per-stream
+:class:`SpeculativeEngine` (and therefore the target-only greedy
+decoder) would emit — through batching, preemption/park-resume,
+prefix-cache placement, and a restart-mid-serve snapshot round trip.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from tpuslo.models.frontdoor import (
+    DEMOTED_PRIORITY,
+    FrontDoorEngine,
+    FrontDoorObserver,
+    SHED_BURNING,
+    SHED_DISPLACED,
+    SHED_QUEUE_FULL,
+)
+from tpuslo.models.llama import llama_tiny
+from tpuslo.models.serve import EOS, ServeEngine
+from tpuslo.models.speculative import SpeculativeEngine
+from tpuslo.sloengine.engine import (
+    BurnEngine,
+    DEFAULT_ADMISSION_PRIORITY,
+    DEMOTED_ADMISSION_PRIORITY,
+)
+from tpuslo.sloengine.stream import RequestOutcome
+
+
+@pytest.fixture(scope="module")
+def engines():
+    cfg = llama_tiny(max_seq_len=128)
+    target = ServeEngine(cfg=cfg, rng_seed=0)
+    # Same seed => self-draft: acceptance 1.0, fast deterministic tests.
+    draft = ServeEngine(cfg=cfg, rng_seed=0)
+    return target, draft
+
+
+@pytest.fixture(scope="module")
+def real_draft_engines():
+    cfg = llama_tiny(max_seq_len=128)
+    target = ServeEngine(cfg=cfg, rng_seed=0)
+    draft = ServeEngine(cfg=cfg, rng_seed=7)  # genuinely different model
+    return target, draft
+
+
+def spec_reference(engines, prompt, n, stop_at_eos=False, prefix=None):
+    spec = SpeculativeEngine(engines[0], engines[1], k=3)
+    return spec.generate(
+        prompt, max_new_tokens=n, stop_at_eos=stop_at_eos, prefix=prefix
+    )
+
+
+def make_burning_engine(tenant: str, now_s: float = 10_000.0) -> BurnEngine:
+    """A real BurnEngine with ``tenant`` in fast burn at ``now_s``."""
+    burn = BurnEngine()
+    for j in range(600):
+        ts = now_s - 1500.0 + j * 2.5
+        burn.record(
+            RequestOutcome(
+                tenant=tenant,
+                ts_unix_nano=int(ts * 1e9),
+                ttft_ms=50.0,
+                tpot_ms=10.0,
+                tokens=8,
+                status="error" if j % 2 == 0 else "ok",
+            )
+        )
+    burn.evaluate(now_s)
+    assert burn.tenant_burn_state(tenant) == "fast_burn"
+    return burn
+
+
+# ---- exactness ---------------------------------------------------------
+
+
+class TestStreamParity:
+    def test_matches_per_stream_speculative(self, engines):
+        fd = FrontDoorEngine(*engines, k=3, max_slots=2)
+        prompts = [f"hello world {i}" for i in range(5)]
+        ids = [
+            fd.submit(p, max_new_tokens=10, stop_at_eos=False)
+            for p in prompts
+        ]
+        results = fd.run()
+        for prompt, rid in zip(prompts, ids):
+            assert results[rid] == spec_reference(engines, prompt, 10)
+
+    @pytest.mark.parametrize("rounds_per_step", [1, 2, 3])
+    def test_multi_round_dispatch_parity(self, engines, rounds_per_step):
+        fd = FrontDoorEngine(
+            *engines, k=3, max_slots=2, rounds_per_step=rounds_per_step
+        )
+        prompts = [f"multi round {i}" for i in range(5)]
+        ids = [
+            fd.submit(p, max_new_tokens=11, stop_at_eos=False)
+            for p in prompts
+        ]
+        results = fd.run()
+        for prompt, rid in zip(prompts, ids):
+            assert results[rid] == spec_reference(engines, prompt, 11)
+
+    def test_real_draft_pair_parity(self, real_draft_engines):
+        """A draft that actually disagrees exercises partial-acceptance
+        frontiers across slots."""
+        fd = FrontDoorEngine(*real_draft_engines, k=3, max_slots=2)
+        prompts = [f"disagreeing draft {i}" for i in range(4)]
+        ids = [
+            fd.submit(p, max_new_tokens=12, stop_at_eos=False)
+            for p in prompts
+        ]
+        results = fd.run()
+        assert fd.acceptance_rate < 1.0  # the pair really disagrees
+        for prompt, rid in zip(prompts, ids):
+            assert results[rid] == spec_reference(
+                real_draft_engines, prompt, 12
+            )
+
+    def test_stop_at_eos_respected(self, engines):
+        fd = FrontDoorEngine(*engines, k=3, max_slots=2)
+        ids = [
+            fd.submit(f"eos probe {i}", max_new_tokens=16)
+            for i in range(3)
+        ]
+        results = fd.run()
+        for i, rid in enumerate(ids):
+            ref = spec_reference(
+                engines, f"eos probe {i}", 16, stop_at_eos=True
+            )
+            assert results[rid] == ref
+            assert EOS not in results[rid][:-1]
+
+    def test_mixed_budgets_and_more_requests_than_slots(self, engines):
+        fd = FrontDoorEngine(*engines, k=3, max_slots=2)
+        budgets = [3, 9, 1, 14, 6, 2, 11]
+        ids = [
+            fd.submit(f"budget {i}", max_new_tokens=b, stop_at_eos=False)
+            for i, b in enumerate(budgets)
+        ]
+        results = fd.run()
+        for i, (rid, budget) in enumerate(zip(ids, budgets)):
+            assert results[rid] == spec_reference(
+                engines, f"budget {i}", budget
+            )
+
+
+# ---- admission policy --------------------------------------------------
+
+
+class TestAdmissionPolicy:
+    def test_burn_demotion_changes_admission_order(self, engines):
+        """Satellite: a demoted tenant's queued request is passed over
+        by later-arriving default-priority requests."""
+        burn = BurnEngine()
+        burn.demote_tenant("lowly")
+        fd = FrontDoorEngine(
+            *engines, k=3, max_slots=1, burn_engine=burn
+        )
+        order: list[str] = []
+
+        class Obs(FrontDoorObserver):
+            def admitted(self, tenant: str) -> None:
+                order.append(tenant)
+
+        fd._observer = Obs()
+        fd.submit("first in line", tenant="lowly", max_new_tokens=4,
+                  stop_at_eos=False)
+        fd.submit("second in line", tenant="vip", max_new_tokens=4,
+                  stop_at_eos=False)
+        fd.submit("third in line", tenant="vip", max_new_tokens=4,
+                  stop_at_eos=False)
+        fd.run()
+        assert order == ["vip", "vip", "lowly"]
+
+    def test_fast_burn_state_deprioritizes_without_demotion(self, engines):
+        tenant = "burny"
+        burn = make_burning_engine(tenant)
+        fd = FrontDoorEngine(*engines, burn_engine=burn)
+        assert (
+            burn.admission_priority(tenant) == DEFAULT_ADMISSION_PRIORITY
+        )
+        assert fd.effective_priority(tenant) == DEMOTED_PRIORITY
+        assert (
+            fd.effective_priority("healthy") == DEFAULT_ADMISSION_PRIORITY
+        )
+
+    def test_full_queue_sheds_by_reason(self, engines):
+        """Satellite: every shed is counted under its reason."""
+        burn = BurnEngine()
+        burn.demote_tenant("lowly")
+        fd = FrontDoorEngine(
+            *engines, k=3, max_slots=1, max_queue=2, burn_engine=burn
+        )
+        keep = fd.submit("occupies the slot", max_new_tokens=30,
+                         stop_at_eos=False)
+        fd.step()  # admit into the slot; queue now empty
+        fd.submit("queued 1", tenant="lowly", max_new_tokens=4)
+        fd.submit("queued 2", tenant="lowly", max_new_tokens=4)
+        # Queue full; an equal-or-lower arrival sheds itself...
+        shed_low = fd.submit("refused", tenant="lowly", max_new_tokens=4)
+        assert shed_low is None
+        # ...while a higher-priority arrival displaces a queued one.
+        kept_hi = fd.submit("displaces", tenant="vip", max_new_tokens=4)
+        assert kept_hi is not None
+        counts = fd.shed_by_reason
+        assert counts[SHED_BURNING] == 1  # lowly refused while demoted
+        assert counts[SHED_DISPLACED] == 1
+        assert counts[SHED_QUEUE_FULL] == 0
+        # A default-priority arrival against a default-priority queue
+        # sheds under the plain reason.
+        fd2 = FrontDoorEngine(*engines, k=3, max_slots=1, max_queue=1)
+        fd2.submit("slot", max_new_tokens=30, stop_at_eos=False)
+        fd2.step()
+        fd2.submit("queued", max_new_tokens=4)
+        assert fd2.submit("refused", max_new_tokens=4) is None
+        assert fd2.shed_by_reason[SHED_QUEUE_FULL] == 1
+        assert keep in fd.run()
+
+    def test_shed_records_failed_outcome_for_shed_tenant(self, engines):
+        burn = BurnEngine()
+        burn.demote_tenant("lowly")
+        fd = FrontDoorEngine(
+            *engines, k=3, max_slots=1, max_queue=1, burn_engine=burn
+        )
+        fd.submit("slot", max_new_tokens=30, stop_at_eos=False)
+        fd.step()
+        fd.submit("queued", max_new_tokens=4)
+        before = burn.recorded
+        assert fd.submit("refused", tenant="lowly", max_new_tokens=4) is None
+        assert burn.recorded == before + 1
+
+    def test_preempted_slot_resumes_bit_identical(self, engines):
+        """Satellite: park-and-resume parity vs an uncontended run."""
+        burn = BurnEngine()
+        burn.demote_tenant("lowly")
+        fd = FrontDoorEngine(
+            *engines, k=3, max_slots=2, burn_engine=burn
+        )
+        low_ids = [
+            fd.submit(f"low stream {i}", tenant="lowly",
+                      max_new_tokens=24, stop_at_eos=False)
+            for i in range(2)
+        ]
+        for _ in range(2):
+            fd.step()
+        hi = fd.submit("high priority arrives", tenant="vip",
+                       max_new_tokens=8, stop_at_eos=False)
+        results = fd.run()
+        assert fd.preemptions >= 1
+        assert fd.resumes >= 1
+        for i, rid in enumerate(low_ids):
+            assert results[rid] == spec_reference(
+                engines, f"low stream {i}", 24
+            )
+        assert results[hi] == spec_reference(
+            engines, "high priority arrives", 8
+        )
+
+    def test_equal_priorities_never_preempt(self, engines):
+        fd = FrontDoorEngine(*engines, k=3, max_slots=1)
+        fd.submit("long runner", max_new_tokens=20, stop_at_eos=False)
+        fd.step()
+        fd.submit("same priority", max_new_tokens=4, stop_at_eos=False)
+        fd.run()
+        assert fd.preemptions == 0
+
+
+# ---- prefix-cache-aware placement --------------------------------------
+
+
+class TestPrefixPlacement:
+    PREFIX = "[system] You are a terse assistant."
+
+    def test_prefix_streams_match_reference(self, engines):
+        fd = FrontDoorEngine(*engines, k=3, max_slots=2)
+        prompts = [f" question {i}?" for i in range(4)]
+        ids = [
+            fd.submit(p, max_new_tokens=8, stop_at_eos=False,
+                      prefix=self.PREFIX)
+            for p in prompts
+        ]
+        results = fd.run()
+        for prompt, rid in zip(prompts, ids):
+            assert results[rid] == spec_reference(
+                engines, prompt, 8, prefix=self.PREFIX
+            )
+
+    def test_warm_prefix_admission_is_faster(self, engines):
+        """Satellite: the second same-prefix request reuses the KV
+        snapshot — its admission (suffix-only prefill) must beat the
+        cold one (full prefix build) by a wide margin."""
+        import time
+
+        target, draft = engines
+        prefix = "[system] a fresh prefix never cached before this test."
+        fd = FrontDoorEngine(target, draft, k=3, max_slots=1)
+        assert not fd._prefix_warm(prefix)
+
+        t0 = time.perf_counter()
+        fd.submit(" cold?", max_new_tokens=2, stop_at_eos=False,
+                  prefix=prefix)
+        fd.run()
+        cold_s = time.perf_counter() - t0
+        assert fd._prefix_warm(prefix)
+
+        best_warm_s = 1e30
+        for i in range(3):
+            t0 = time.perf_counter()
+            fd.submit(f" warm {i}?", max_new_tokens=2,
+                      stop_at_eos=False, prefix=prefix)
+            fd.run()
+            best_warm_s = min(best_warm_s, time.perf_counter() - t0)
+        assert best_warm_s < cold_s
+
+    def test_warm_prefix_requests_sort_together(self, engines):
+        """Queue order batches snapshot-reusing requests at equal
+        priority."""
+        target, draft = engines
+        fd = FrontDoorEngine(target, draft, k=3, max_slots=1)
+        warm_prefix = "[system] warm group prefix."
+        target.cache_prefix(warm_prefix)
+        draft.cache_prefix(warm_prefix)
+        order: list[int] = []
+
+        class Obs(FrontDoorObserver):
+            def admitted(self, tenant: str) -> None: ...
+
+        fd.submit("occupy", max_new_tokens=6, stop_at_eos=False)
+        fd.step()
+        cold = fd.submit(" cold", max_new_tokens=2, stop_at_eos=False,
+                         prefix="[system] cold group prefix.")
+        warm = fd.submit(" warm", max_new_tokens=2, stop_at_eos=False,
+                         prefix=warm_prefix)
+        fd._queue.sort(key=fd._order_key)
+        assert [r.request_id for r in fd._queue] == [warm, cold]
+        fd.run()
+
+
+# ---- burn-engine feedback ----------------------------------------------
+
+
+class TestOutcomeFeedback:
+    def test_completions_record_outcomes(self, engines):
+        burn = BurnEngine()
+        fd = FrontDoorEngine(*engines, k=3, max_slots=2,
+                             burn_engine=burn)
+        fd.submit("tenant a stream", tenant="a", max_new_tokens=6,
+                  stop_at_eos=False)
+        fd.submit("tenant b stream", tenant="b", max_new_tokens=6,
+                  stop_at_eos=False)
+        fd.run()
+        assert burn.recorded == 2
+        snapshot = burn.snapshot()
+        assert snapshot["tenants"] == 2
+
+
+# ---- lifecycle / telemetry ---------------------------------------------
+
+
+class TestLifecycle:
+    def test_bad_args_rejected(self, engines):
+        with pytest.raises(ValueError):
+            FrontDoorEngine(*engines, k=0)
+        with pytest.raises(ValueError):
+            FrontDoorEngine(*engines, max_slots=0)
+        with pytest.raises(ValueError):
+            FrontDoorEngine(*engines, max_queue=0)
+        with pytest.raises(ValueError):
+            FrontDoorEngine(*engines, rounds_per_step=0)
+
+    def test_priority_scale_is_the_sloengine_scale(self):
+        """Review regression: the front door must read the SAME
+        constants the remediation surface writes — a local mirror
+        would silently desync the fast-burn clamp from
+        demote_tenant."""
+        from tpuslo.models import frontdoor as fd_mod
+        from tpuslo.sloengine import engine as slo_mod
+
+        assert fd_mod.DEFAULT_PRIORITY is slo_mod.DEFAULT_ADMISSION_PRIORITY
+        assert fd_mod.DEMOTED_PRIORITY is slo_mod.DEMOTED_ADMISSION_PRIORITY
+
+    def test_cancel_completed_clears_both_result_surfaces(self, engines):
+        """Review regression: cancelling a COMPLETED request must drop
+        its timing record too — telemetry and results must agree."""
+        fd = FrontDoorEngine(*engines, k=3, max_slots=1)
+        rid = fd.submit("done then cancelled", max_new_tokens=6,
+                        stop_at_eos=False)
+        fd.run()
+        assert rid in fd.request_timings()
+        fd.cancel(rid)
+        assert rid not in fd.results
+        assert rid not in fd.request_timings()
+
+    def test_partial_tokens_and_cancel(self, engines):
+        fd = FrontDoorEngine(*engines, k=3, max_slots=1)
+        a = fd.submit("running stream", max_new_tokens=20,
+                      stop_at_eos=False)
+        b = fd.submit("queued stream", max_new_tokens=4,
+                      stop_at_eos=False)
+        fd.step()
+        assert len(fd.partial_tokens(a)) >= 1
+        assert fd.partial_tokens(b) == []
+        assert fd.partial_tokens(999) is None
+        fd.cancel(b)
+        assert fd.partial_tokens(b) is None
+        results = fd.run()
+        assert b not in results
+        assert a in results
+
+    def test_stats_and_timings(self, engines):
+        fd = FrontDoorEngine(*engines, k=3, max_slots=2)
+        rid = fd.submit("timed stream", max_new_tokens=8,
+                        stop_at_eos=False)
+        fd.run()
+        stats = fd.stats()
+        assert stats["completed"] == 1
+        assert stats["acceptance_rate"] == 1.0  # self-draft
+        assert stats["emitted_tokens"] == 8
+        timings = fd.request_timings()
+        record = timings[rid]
+        assert record["ttft_s"] >= 0.0
+        assert record["e2e_s"] >= record["ttft_s"]
+        assert record["tpot_s"] > 0.0
+        assert record["tenant"] == "default"
+
+    def test_instant_complete_requests_never_hold_slots(self, engines):
+        fd = FrontDoorEngine(*engines, k=3, max_slots=1)
+        ids = [
+            fd.submit(f"instant {i}", max_new_tokens=1,
+                      stop_at_eos=False)
+            for i in range(3)
+        ]
+        results = fd.run()
+        assert fd.rounds == 0  # nothing ever needed a decode round
+        for i, rid in enumerate(ids):
+            assert results[rid] == spec_reference(
+                engines, f"instant {i}", 1
+            )
+
+
+# ---- snapshot / restore -------------------------------------------------
+
+
+class TestSnapshotRestore:
+    def test_export_restore_round_trip_json_safe(self, engines):
+        fd = FrontDoorEngine(*engines, k=3, max_slots=2)
+        fd.submit("stream one", max_new_tokens=24, stop_at_eos=False)
+        fd.submit("stream two", max_new_tokens=24, stop_at_eos=False)
+        fd.submit("queued three", max_new_tokens=24, stop_at_eos=False)
+        fd.step()
+        state = json.loads(json.dumps(fd.export_state()))
+        fd2 = FrontDoorEngine(*engines, k=3, max_slots=2)
+        fd2.restore_state(state)
+        assert len(fd2._queue) == 3  # 2 in-flight + 1 queued
+        assert fd2._next_id == fd._next_id
+
+    def test_restart_mid_serve_through_agent_runtime(
+        self, engines, tmp_path
+    ):
+        """Satellite: kill mid-serve, restore via AgentRuntime, finish
+        — per-request streams equal the uninterrupted reference."""
+        from tpuslo.runtime.statestore import AgentRuntime, StateStore
+
+        store = StateStore(tmp_path / "state.json", interval_s=0.0)
+        runtime = AgentRuntime(store)
+        fd = FrontDoorEngine(*engines, k=3, max_slots=2)
+        runtime.register(
+            "frontdoor", fd.export_state, fd.restore_state
+        )
+        prompts = [f"restart stream {i}" for i in range(4)]
+        ids = [
+            fd.submit(p, max_new_tokens=18, stop_at_eos=False)
+            for p in prompts
+        ]
+        for _ in range(2):
+            fd.step()
+        assert runtime.snapshot_now()
+        del fd  # the "crash"
+
+        runtime2 = AgentRuntime(StateStore(tmp_path / "state.json"))
+        fd2 = FrontDoorEngine(*engines, k=3, max_slots=2)
+        runtime2.register(
+            "frontdoor", fd2.export_state, fd2.restore_state
+        )
+        assert runtime2.restore() == "restored"
+        results = fd2.run()
+        assert fd2.snapshot_resumes >= 1
+        for prompt, rid in zip(prompts, ids):
+            assert results[rid] == spec_reference(engines, prompt, 18)
+
+    def test_restore_rejects_unknown_version(self, engines):
+        fd = FrontDoorEngine(*engines, k=3, max_slots=2)
+        fd.restore_state({"version": 99, "queue": [{"request_id": 1}]})
+        assert fd._queue == []
+
+
+# ---- remediation end-to-end (satellite 5) ------------------------------
+
+
+@pytest.mark.slow
+def test_hbm_attribution_demotes_tenant_in_live_admission(engines):
+    """faultreplay → BayesianAttributor → remediation policy →
+    demote_tenant action → the LIVE front-door admission order changes.
+
+    The full PR 11 loop landing in the serving plane: nothing is
+    scripted — the posterior comes from a real hbm_pressure fault
+    profile, the policy gates on it plus real fast-burn state, the
+    action mutates the real BurnEngine, and the front door (which
+    consults that engine live) starts admitting the demoted tenant
+    last.
+    """
+    from datetime import datetime, timezone
+
+    from tpuslo.attribution.bayesian import BayesianAttributor
+    from tpuslo.faultreplay.generator import generate_fault_samples
+    from tpuslo.remediation.actions import ActionBindings
+    from tpuslo.remediation.engine import RemediationEngine
+    from tpuslo.remediation.policy import AttributionContext
+
+    tenant = "burny"
+    now_s = 10_000.0
+    burn = make_burning_engine(tenant, now_s)
+    fd = FrontDoorEngine(*engines, k=3, max_slots=1, burn_engine=burn)
+
+    # Before remediation: fast burn already deprioritizes, but the
+    # remediation surface itself is untouched.
+    assert burn.admission_priority(tenant) == DEFAULT_ADMISSION_PRIORITY
+
+    sample = generate_fault_samples(
+        "hbm_pressure", 1,
+        start=datetime.fromtimestamp(now_s, tz=timezone.utc),
+    )[0]
+    attribution = BayesianAttributor().attribute_sample(sample)
+    assert attribution.predicted_fault_domain == "tpu_hbm"
+
+    engine = RemediationEngine(bindings=ActionBindings(burn_engine=burn))
+    record = engine.consider(
+        AttributionContext(
+            incident_id="inc-e2e-hbm",
+            domain=attribution.predicted_fault_domain,
+            confidence=attribution.confidence,
+            burn_state=burn.tenant_burn_state(tenant),
+            burn_rate=burn.max_active_burn(),
+            tenant=tenant,
+            at_s=now_s,
+        ),
+        now_s,
+    )
+    assert record is not None and record.phase == "verifying"
+    assert burn.admission_priority(tenant) == DEMOTED_ADMISSION_PRIORITY
+
+    # The LIVE scheduling change: the demoted tenant queued first still
+    # serves last.
+    order: list[str] = []
+
+    class Obs(FrontDoorObserver):
+        def admitted(self, t: str) -> None:
+            order.append(t)
+
+    fd._observer = Obs()
+    fd.submit("demoted tenant request", tenant=tenant,
+              max_new_tokens=3, stop_at_eos=False)
+    fd.submit("healthy tenant request", tenant="healthy",
+              max_new_tokens=3, stop_at_eos=False)
+    fd.run()
+    assert order == ["healthy", tenant]
+
+
+# ---- loadgen traffic synthesis (satellite 1) ---------------------------
+
+
+class TestLoadgenTraffic:
+    def test_arrival_models_shape_offsets(self):
+        from tpuslo.cli.loadgen import arrival_offsets_ms
+        import random
+
+        rng = random.Random(7)
+        duration_ms = 10_000.0
+        for arrival in ("steady", "burst", "ramp", "poisson"):
+            offsets = arrival_offsets_ms(
+                arrival, 200, 10.0, random.Random(7)
+            )
+            assert len(offsets) == 200
+            assert offsets == sorted(offsets)
+            assert all(o >= 0 for o in offsets)
+        # burst packs each burst's traffic into the window head.
+        burst = arrival_offsets_ms("burst", 200, 10.0, rng)
+        in_heads = sum(
+            1 for o in burst if (o % 2500.0) <= 0.2 * 2500.0 + 1e-6
+        )
+        assert in_heads == len(burst)
+        with pytest.raises(ValueError):
+            arrival_offsets_ms("warble", 10, 1.0, rng)
+
+    def test_tenant_mix_weights(self):
+        from tpuslo.cli.loadgen import parse_tenant_mix, synthesize_requests
+
+        assert parse_tenant_mix("", 2) == [0.5, 0.5]
+        weights = parse_tenant_mix("70,20,10", 3)
+        assert abs(sum(weights) - 1.0) < 1e-9
+        assert weights[0] > weights[1] > weights[2]
+        # short lists pad with the last weight
+        assert len(parse_tenant_mix("5", 4)) == 4
+        with pytest.raises(ValueError):
+            parse_tenant_mix("1,2,3", 2)
+        with pytest.raises(ValueError):
+            parse_tenant_mix("0,1", 2)
+        # Review regressions: an empty entry must be a loud error, not
+        # a silent drop that shifts later weights onto the wrong
+        # tenants; an all-separator spec must not IndexError.
+        with pytest.raises(ValueError):
+            parse_tenant_mix("70,,10", 3)
+        with pytest.raises(ValueError):
+            parse_tenant_mix(",", 2)
+
+        records = synthesize_requests(
+            seed=3, rps=50, duration_s=4.0, tenants=3,
+            tenant_mix="80,15,5", arrival="poisson",
+        )
+        counts: dict[str, int] = {}
+        for r in records:
+            counts[r["tenant"]] = counts.get(r["tenant"], 0) + 1
+        assert counts["tenant-00"] > counts.get("tenant-02", 0)
+
+    def test_prefix_rate_marks_groups(self):
+        from tpuslo.cli.loadgen import synthesize_requests
+
+        records = synthesize_requests(
+            seed=5, rps=50, duration_s=4.0, tenants=2,
+            prefix_rate=0.5,
+        )
+        marked = [r for r in records if "prefix_group" in r]
+        assert 0 < len(marked) < len(records)
+        for r in marked:
+            assert r["prefix_group"] == f"{r['tenant']}/sys"
+        # deterministic across calls
+        again = synthesize_requests(
+            seed=5, rps=50, duration_s=4.0, tenants=2,
+            prefix_rate=0.5,
+        )
+        assert records == again
+
+    def test_cli_flags_round_trip(self, tmp_path):
+        from tpuslo.cli import loadgen
+
+        out = tmp_path / "reqs.jsonl"
+        rc = loadgen.main([
+            "--arrival", "burst", "--tenants", "3",
+            "--tenant-mix", "60,30,10", "--prefix-rate", "0.4",
+            "--rps", "20", "--duration-s", "2",
+            "--output", str(out),
+        ])
+        assert rc == 0
+        records = [
+            json.loads(line) for line in out.read_text().splitlines()
+        ]
+        assert len(records) == 40
+        tenants = {r["tenant"] for r in records}
+        assert tenants <= {"tenant-00", "tenant-01", "tenant-02"}
+        assert any("prefix_group" in r for r in records)

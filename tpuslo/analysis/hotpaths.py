@@ -85,6 +85,16 @@ HOT_FUNCTIONS: tuple[tuple[str, str], ...] = (
     ("tpuslo/models/llama.py", "verify_chunk"),
     ("tpuslo/models/llama.py", "decode_chunk"),
     ("tpuslo/models/speculative.py", "_spec_round_core"),
+    # Serving front door (ISSUE 12): the per-round-boundary scheduler
+    # paths.  step() runs once per fused multi-round dispatch and its
+    # emission loop touches every slot; the admission paths run per
+    # admitted request inside the serving loop — wall-clock reads are
+    # perf_counter-only (outcome timestamps derive from an init-time
+    # anchor), and a stray json.dumps/print here stalls every slot.
+    ("tpuslo/models/frontdoor.py", "FrontDoorEngine.step"),
+    ("tpuslo/models/frontdoor.py", "FrontDoorEngine._fill_slots"),
+    ("tpuslo/models/frontdoor.py", "FrontDoorEngine._admit"),
+    ("tpuslo/models/frontdoor.py", "FrontDoorEngine._admit_batch"),
 )
 
 #: (repo-relative module path, dataclass name) pairs that are allocated
@@ -115,6 +125,9 @@ HOT_DATACLASSES: tuple[tuple[str, str], ...] = (
     ("tpuslo/remediation/policy.py", "PolicyDecision"),
     ("tpuslo/remediation/engine.py", "ActionRecord"),
     ("tpuslo/remediation/verifier.py", "VerifyState"),
+    # Front-door slot/queue records (ISSUE 12): allocated per request,
+    # scanned per round boundary by the scheduler.
+    ("tpuslo/models/frontdoor.py", "FrontDoorRequest"),
 )
 
 #: The JAX plane the TPL16x trace-discipline rules govern: every file
@@ -144,4 +157,6 @@ JAX_HOT_LOOPS: tuple[tuple[str, str], ...] = (
     ("tpuslo/models/serve.py", "ServeEngine._append_ids"),
     ("tpuslo/models/speculative.py", "SpeculativeEngine.stream"),
     ("tpuslo/models/speculative.py", "SpeculativeEngine.generate_batch"),
+    ("tpuslo/models/frontdoor.py", "FrontDoorEngine.step"),
+    ("tpuslo/models/frontdoor.py", "FrontDoorEngine._admit"),
 )

@@ -7,12 +7,22 @@ build adds a ``context_128k`` profile for long-context serving, and
 engine's SLI stream) so error-budget scenarios can be rehearsed
 offline: ``loadgen --slo-out out.jsonl --error-rate 0.3
 --error-after-s 1800`` then ``sloctl budget --replay out.jsonl``.
+
+The front-door bench (ISSUE 12) drives its admission layer from this
+module's :func:`synthesize_requests`, so the arrival process is shaped
+here: ``--arrival steady|burst|ramp|poisson`` picks the inter-arrival
+model, ``--tenants N``/``--tenant-mix`` spreads requests over a
+multi-tenant population with weighted shares, and ``--prefix-rate``
+marks a fraction of each tenant's requests as sharing a per-tenant
+prompt prefix (``prefix_group``) — the signal prefix-cache-aware
+placement batches on.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import math
 import random
 import sys
 from datetime import datetime, timezone
@@ -25,8 +35,143 @@ PROFILES = {
     "context_128k": (131072, 512, (2500, 8000)),
 }
 
+#: Arrival processes the bench lanes can request by name.
+ARRIVALS = ("steady", "burst", "ramp", "poisson")
+
 #: Deterministic default stream epoch for --slo-out timestamps.
 DEFAULT_START = "2026-01-01T00:00:00Z"
+
+
+def parse_tenant_mix(spec: str, n_tenants: int) -> list[float]:
+    """Normalized tenant weights from a ``--tenant-mix`` spec.
+
+    ``spec`` is comma-separated positive weights (``"70,20,10"``);
+    empty means uniform.  Fewer weights than tenants pad with the last
+    weight; extras are an error (a silently-dropped weight would skew
+    the mix the bench asserts on).
+    """
+    if n_tenants < 1:
+        raise ValueError("--tenants must be >= 1")
+    if not spec:
+        weights = [1.0] * n_tenants
+    else:
+        # Every comma-separated entry must parse: silently dropping an
+        # empty one ('70,,10') would shift later weights onto the
+        # wrong tenants — the exact skew this function exists to
+        # prevent.
+        try:
+            weights = [float(w) for w in spec.split(",")]
+        except ValueError as exc:
+            raise ValueError(
+                f"--tenant-mix entry is not a number: {spec!r}"
+            ) from exc
+        if len(weights) > n_tenants:
+            raise ValueError(
+                f"--tenant-mix has {len(weights)} weights for "
+                f"{n_tenants} tenants"
+            )
+        if any(w <= 0 for w in weights):
+            raise ValueError("--tenant-mix weights must be positive")
+        weights += [weights[-1]] * (n_tenants - len(weights))
+    total = sum(weights)
+    return [w / total for w in weights]
+
+
+def arrival_offsets_ms(
+    arrival: str,
+    count: int,
+    duration_s: float,
+    rng: random.Random,
+) -> list[float]:
+    """Monotonic arrival offsets (ms) for ``count`` requests.
+
+    * ``steady`` — fixed interval with ±20% jitter (the legacy shape);
+    * ``burst`` — arrivals clump into square-wave bursts: 4 bursts
+      over the duration, each packing 1/4 of the traffic into the
+      first 20% of its window (the TTFT-p99 stressor);
+    * ``ramp`` — arrival rate grows linearly from ~0 to 2x the mean
+      (offsets follow sqrt(u): a warm-up then saturation);
+    * ``poisson`` — exponential inter-arrivals at the mean rate.
+    """
+    if count < 1:
+        return []
+    duration_ms = max(1.0, duration_s * 1000.0)
+    interval_ms = duration_ms / count
+    if arrival == "steady":
+        offsets = [
+            i * interval_ms + rng.uniform(-0.2, 0.2) * interval_ms
+            for i in range(count)
+        ]
+    elif arrival == "burst":
+        n_bursts = 4
+        window_ms = duration_ms / n_bursts
+        offsets = []
+        for i in range(count):
+            burst = i % n_bursts
+            offsets.append(
+                burst * window_ms
+                + rng.random() * 0.2 * window_ms
+            )
+    elif arrival == "ramp":
+        offsets = [
+            math.sqrt(rng.random()) * duration_ms for _ in range(count)
+        ]
+    elif arrival == "poisson":
+        t = 0.0
+        offsets = []
+        for _ in range(count):
+            t += rng.expovariate(1.0 / interval_ms)
+            offsets.append(t)
+    else:
+        raise ValueError(
+            f"unknown arrival model {arrival!r} (one of {ARRIVALS})"
+        )
+    return [round(v, 3) for v in sorted(max(0.0, o) for o in offsets)]
+
+
+def synthesize_requests(
+    profile: str = "rag_medium",
+    rps: float = 2.0,
+    duration_s: float = 30.0,
+    seed: int = 42,
+    arrival: str = "steady",
+    tenants: int = 1,
+    tenant_mix: str = "",
+    prefix_rate: float = 0.0,
+) -> list[dict]:
+    """Deterministic multi-tenant request records (offset-sorted).
+
+    Each record carries the legacy trace fields plus ``tenant`` and —
+    for the ``prefix_rate`` fraction of a tenant's requests —
+    ``prefix_group`` (``"<tenant>/sys"``): requests in one group share
+    a prompt prefix, the unit prefix caching snapshots once and the
+    front-door scheduler batches together.
+    """
+    prompt_tokens, max_new, ttft_range = PROFILES[profile]
+    rng = random.Random(seed)
+    count = max(1, int(rps * duration_s))
+    weights = parse_tenant_mix(tenant_mix, tenants)
+    tenant_names = [f"tenant-{i:02d}" for i in range(tenants)]
+    offsets = arrival_offsets_ms(arrival, count, duration_s, rng)
+    records = []
+    for idx, offset_ms in enumerate(offsets):
+        tenant = rng.choices(tenant_names, weights=weights)[0]
+        record = {
+            "request_id": f"load-req-{idx + 1:05d}",
+            "trace_id": f"load-trace-{idx + 1:05d}",
+            "profile": profile,
+            "offset_ms": offset_ms,
+            "tenant": tenant,
+            "prompt_tokens": prompt_tokens,
+            "max_new_tokens": max_new,
+            "expected_ttft_ms_min": ttft_range[0],
+            "expected_ttft_ms_max": ttft_range[1],
+            "stream": True,
+        }
+        if rng.random() < prefix_rate:
+            record["prefix_group"] = f"{tenant}/sys"
+        records.append(record)
+    return records
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -37,6 +182,36 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--output", default="-")
     p.add_argument(
+        "--arrival",
+        default="steady",
+        choices=ARRIVALS,
+        help="inter-arrival model: steady (jittered fixed rate), "
+        "burst (4 square-wave bursts — the TTFT-p99 stressor), ramp "
+        "(rate grows to 2x mean), poisson (exponential gaps)",
+    )
+    p.add_argument(
+        "--tenants",
+        type=int,
+        default=1,
+        help="number of synthetic tenants (tenant-00..); requests "
+        "spread per --tenant-mix",
+    )
+    p.add_argument(
+        "--tenant-mix",
+        default="",
+        help="comma-separated positive tenant weights, e.g. "
+        "'70,20,10' (default uniform; short lists pad with the last "
+        "weight)",
+    )
+    p.add_argument(
+        "--prefix-rate",
+        type=float,
+        default=0.0,
+        help="fraction of each tenant's requests stamped with a "
+        "shared prefix_group (prefix-cache-aware placement batches "
+        "these onto snapshot-reusing slots)",
+    )
+    p.add_argument(
         "--slo-out",
         default="",
         help="also emit one RequestOutcome JSONL line per request "
@@ -46,7 +221,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--tenant",
         default="default",
-        help="tenant stamped on --slo-out outcomes",
+        help="tenant stamped on --slo-out outcomes when --tenants is "
+        "1 (multi-tenant runs stamp each record's own tenant)",
     )
     p.add_argument(
         "--error-rate",
@@ -79,10 +255,18 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
-    prompt_tokens, max_new, ttft_range = PROFILES[args.profile]
-    rng = random.Random(args.seed)
-    count = max(1, int(args.rps * args.duration_s))
-    interval_ms = 1000.0 / args.rps
+    _prompt_tokens, max_new, ttft_range = PROFILES[args.profile]
+    rng = random.Random(args.seed ^ 0x5105)  # outcome noise stream
+    records = synthesize_requests(
+        profile=args.profile,
+        rps=args.rps,
+        duration_s=args.duration_s,
+        seed=args.seed,
+        arrival=args.arrival,
+        tenants=args.tenants,
+        tenant_mix=args.tenant_mix,
+        prefix_rate=args.prefix_rate,
+    )
     start = datetime.fromisoformat(
         args.start.replace("Z", "+00:00")
     ).astimezone(timezone.utc)
@@ -93,22 +277,12 @@ def main(argv: list[str] | None = None) -> int:
         open(args.slo_out, "w", encoding="utf-8") if args.slo_out else None
     )
     try:
-        for idx in range(count):
-            jitter = rng.uniform(-0.2, 0.2) * interval_ms
-            offset_ms = round(idx * interval_ms + jitter, 3)
-            record = {
-                "request_id": f"load-req-{idx + 1:05d}",
-                "trace_id": f"load-trace-{idx + 1:05d}",
-                "profile": args.profile,
-                "offset_ms": offset_ms,
-                "prompt_tokens": prompt_tokens,
-                "max_new_tokens": max_new,
-                "expected_ttft_ms_min": ttft_range[0],
-                "expected_ttft_ms_max": ttft_range[1],
-                "stream": True,
-            }
+        for record in records:
+            if args.tenants == 1:
+                record = {**record, "tenant": args.tenant}
             sink.write(json.dumps(record, separators=(",", ":")) + "\n")
             if slo_sink is not None:
+                offset_ms = record["offset_ms"]
                 in_error_window = (
                     offset_ms / 1000.0 >= args.error_after_s
                 )
@@ -122,7 +296,7 @@ def main(argv: list[str] | None = None) -> int:
                     else rng.uniform(*ttft_range)
                 )
                 outcome = {
-                    "tenant": args.tenant,
+                    "tenant": record["tenant"],
                     "ts_unix_nano": base_ns + int(offset_ms * 1e6),
                     "ttft_ms": round(ttft_ms, 3),
                     "tpot_ms": round(rng.uniform(20.0, 60.0), 3),
@@ -139,8 +313,8 @@ def main(argv: list[str] | None = None) -> int:
         if slo_sink is not None:
             slo_sink.close()
     print(
-        f"loadgen: wrote {count} request records"
-        + (f" + {count} slo outcomes to {args.slo_out}"
+        f"loadgen: wrote {len(records)} request records"
+        + (f" + {len(records)} slo outcomes to {args.slo_out}"
            if args.slo_out else ""),
         file=sys.stderr,
     )

@@ -49,6 +49,15 @@ burn verified subsided or rolled back within the window budget,
 rate-limit/budget damping under a mis-attribution storm, zero
 duplicate actions across a mid-sweep engine kill, and every action
 traceable end-to-end in the provenance chain.
+
+``--frontdoor-bench`` runs the serving front-door gate
+(``tpuslo.benchmark.frontdoor_bench``): loadgen-synthesized bursty
+multi-tenant traffic through the FrontDoorEngine (batched speculative
+rounds inside continuous-batching slots, SLO-aware admission) must
+deliver >= 2x the goodput and tokens/s of the same streams served
+sequentially through the per-stream SpeculativeEngine, with zero
+steady-state recompiles under jitaudit, host syncs per token within
+the serving ceiling, and the burn-aware admission observable.
 """
 
 from __future__ import annotations
@@ -166,6 +175,36 @@ def build_parser() -> argparse.ArgumentParser:
         default="",
         help="directory for per-scenario provenance chains (default: "
         "a temp dir)",
+    )
+    # ---- serving front-door gate (tpuslo.models.frontdoor) ------------
+    p.add_argument(
+        "--frontdoor-bench",
+        action="store_true",
+        help="run the serving front-door gate instead of B5/D3/E3: "
+        "loadgen-driven bursty multi-tenant traffic through the "
+        "FrontDoorEngine must deliver >= 2x the goodput AND tokens/s "
+        "of the same streams served sequentially through the "
+        "per-stream SpeculativeEngine, with zero steady-state "
+        "recompiles (jitaudit), host syncs per token under the "
+        "serving ceiling, and burn-aware admission observable "
+        "(burning tenant's goodput share drops, healthy p99 holds)",
+    )
+    p.add_argument("--frontdoor-seed", type=int, default=1337)
+    p.add_argument("--frontdoor-streams", type=int, default=192)
+    p.add_argument("--frontdoor-slots", type=int, default=16)
+    p.add_argument("--frontdoor-k", type=int, default=4)
+    p.add_argument("--frontdoor-tokens", type=int, default=96)
+    p.add_argument("--frontdoor-tenants", type=int, default=4)
+    p.add_argument("--frontdoor-arrival", default="burst")
+    p.add_argument("--frontdoor-passes", type=int, default=2)
+    p.add_argument("--frontdoor-rounds-per-step", type=int, default=3)
+    p.add_argument(
+        "--frontdoor-retries",
+        type=int,
+        default=1,
+        help="re-run the whole lane this many times if a wall-clock "
+        "gate fails (the lane times real serving on a possibly-"
+        "shared box; counter gates are deterministic either way)",
     )
     # ---- fleet observability-plane gate (tpuslo.fleet) ----------------
     p.add_argument(
@@ -368,6 +407,95 @@ def render_remediation_markdown(report) -> str:
         lines += ["", "## Failures", ""]
         lines += [f"- {f}" for f in report.failures]
     return "\n".join(lines) + "\n"
+
+
+def render_frontdoor_markdown(report: dict) -> str:
+    seq = report["sequential"]
+    fd = report["frontdoor"]
+    burn = report["burn_scenario"]
+    lines = [
+        "# Serving front-door gate (batched spec + SLO-aware admission)",
+        "",
+        f"**Overall: {'PASS' if report['passed'] else 'FAIL'}**",
+        "",
+        f"- seed {report['seed']}: {report['streams']} streams, "
+        f"{report['arrival']} arrival over {report['tenants']} tenants "
+        f"(mix {report['tenant_mix']}, prefix rate "
+        f"{report['prefix_rate']:g}), {report['max_new_tokens']} "
+        f"tokens each; front door at {report['max_slots']} slots, "
+        f"k={report['k']}",
+        f"- SLO (solo-calibrated): TTFT {report['slo']['ttft_ms']:g} ms, "
+        f"TPOT {report['slo']['tpot_ms']:g} ms",
+        "",
+        "| path | tok/s | goodput tok/s | TTFT p99 (ms) | TPOT p99 (ms) |",
+        "|---|---|---|---|---|",
+        f"| sequential per-stream spec | {seq['tokens_per_sec']:g} "
+        f"| {seq['goodput_tokens_per_sec']:g} | {seq['ttft_p99_ms']:g} "
+        f"| {seq['tpot_p99_ms']:g} |",
+        f"| front door | {fd['tokens_per_sec']:g} "
+        f"| {fd['goodput_tokens_per_sec']:g} | {fd['ttft_p99_ms']:g} "
+        f"| {fd['tpot_p99_ms']:g} |",
+        "",
+        f"- goodput speedup **{report['frontdoor_goodput_speedup']:g}x**"
+        f" / throughput **{report['frontdoor_throughput_speedup']:g}x** "
+        f"(floors {report['gates']['goodput_speedup_floor']:g}x)",
+        f"- steady-state recompiles {report['spec_retrace_count']} "
+        f"(ceiling 0), host syncs/token "
+        f"{report['frontdoor_host_syncs_per_token']:g} (ceiling "
+        f"{report['gates']['host_syncs_per_token_ceiling']:g})",
+        f"- burn scenario: tenant {burn['burning_tenant']} "
+        f"({burn['burn_state']}) submitted "
+        f"{burn['submitted_share']:.1%} of traffic, took "
+        f"{burn['goodput_share']:.1%} of goodput; healthy TTFT p99 "
+        f"{burn['healthy_ttft_p99_ms']:g} ms (hold bound "
+        f"{burn['healthy_hold_ms']:g} ms); shed {burn['shed']}",
+    ]
+    if report["failures"]:
+        lines += ["", "## Failures", ""]
+        lines += [f"- {f}" for f in report["failures"]]
+    return "\n".join(lines) + "\n"
+
+
+def run_frontdoor_gate(args) -> int:
+    from tpuslo.benchmark.frontdoor_bench import run_frontdoor_bench
+
+    log = lambda msg: print(f"m5gate: {msg}", file=sys.stderr)  # noqa: E731
+    report = None
+    for attempt in range(max(1, args.frontdoor_retries + 1)):
+        if attempt:
+            log("frontdoor-bench retrying (wall-clock gate failed)")
+        report = run_frontdoor_bench(
+            seed=args.frontdoor_seed,
+            streams=args.frontdoor_streams,
+            max_slots=args.frontdoor_slots,
+            k=args.frontdoor_k,
+            max_new_tokens=args.frontdoor_tokens,
+            tenants=args.frontdoor_tenants,
+            arrival=args.frontdoor_arrival,
+            passes=args.frontdoor_passes,
+            rounds_per_step=args.frontdoor_rounds_per_step,
+            log=log,
+        )
+        if report["passed"]:
+            break
+    if args.summary_json:
+        Path(args.summary_json).write_text(
+            json.dumps(report, indent=2, default=str) + "\n"
+        )
+    if args.summary_md:
+        Path(args.summary_md).write_text(
+            render_frontdoor_markdown(report)
+        )
+    print(
+        f"m5gate: frontdoor-bench {'PASS' if report['passed'] else 'FAIL'}"
+        + (
+            ""
+            if report["passed"]
+            else f" ({'; '.join(report['failures'])})"
+        ),
+        file=sys.stderr,
+    )
+    return 0 if report["passed"] else 1
 
 
 def run_remediation_gate(args) -> int:
@@ -649,6 +777,8 @@ def main(argv: list[str] | None = None) -> int:
         return run_burn_gate(args)
     if args.remediation_sweep:
         return run_remediation_gate(args)
+    if args.frontdoor_bench:
+        return run_frontdoor_gate(args)
     if args.fleet_sweep:
         return run_fleet_gate(args)
     if args.crash_sweep:

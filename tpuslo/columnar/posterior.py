@@ -16,11 +16,23 @@ numpy (bit-identical to the pre-refactor path), and
 JAX engagement policy: numpy is the default — correctness gates
 (calibrated heldout macro-F1) are certified on the f64 numpy path, and
 jit compilation costs ~100 ms per new batch shape.  ``use_jax=None``
-(auto) engages JAX only for batches of ≥ :data:`JIT_MIN_BATCH` rows
-when jax imports, under ``jax.experimental.enable_x64`` so the math
-stays f64; ``TPUSLO_COLUMNAR_JIT=1`` forces it on any size and ``=0``
-disables it.  tests/test_columnar_parity.py asserts numpy-vs-jit
-agreement (allclose + identical domain rankings) on seeded batches.
+(auto) considers JAX only for batches of ≥ :data:`JIT_MIN_BATCH` rows
+when jax imports — and then MEASURES before committing: the full bench
+report caught the jit path running *slower* than numpy at fleet batch
+sizes on the 1-CPU driver box (1.12M vs 1.77M samples/s, ROADMAP #5)
+while the same sizes win 2-3x here, so the crossover is box-dependent
+and a static threshold on either box mis-tunes the other.  The first
+auto call at each power-of-two row bucket times both kernels on the
+call's own inputs (jit timed post-compile) and engages jit for that
+bucket only when it wins by ≥ :data:`JIT_WIN_MARGIN`; the verdict is
+cached per (soft, sharpness, signals, bucket) for the process.  The
+math runs under ``jax.experimental.enable_x64`` so it stays f64;
+``TPUSLO_COLUMNAR_JIT=1`` forces jit on any size, ``=0`` disables it,
+and ``TPUSLO_COLUMNAR_JIT_MIN_ROWS=N`` moves the auto floor.
+tests/test_columnar_parity.py asserts numpy-vs-jit agreement (allclose
++ identical domain rankings) on seeded batches, and ``bench_pipeline``
+gates ``posterior_jit_speedup >= 1.0`` at the auto-selected threshold
+— the policy may only engage jit where jit wins.
 """
 
 from __future__ import annotations
@@ -31,9 +43,24 @@ from typing import Any
 
 import numpy as np
 
-#: Auto mode engages jax.jit at this batch size: below it, dispatch +
-#: possible retrace cost more than the matmul saves on a CPU host.
+#: Auto mode CONSIDERS jax.jit at this batch size: below it, dispatch
+#: + possible retrace cost more than the matmul saves on a CPU host,
+#: so the probe itself isn't worth paying.  Above it, a measured probe
+#: decides (see the module docstring).
 JIT_MIN_BATCH = 4096
+
+#: Auto-probe margin: jit must beat numpy by this factor on the timed
+#: probe before it engages for a bucket — hysteresis so a marginal win
+#: can't flap into a regression on a noisy box (and so the bench's
+#: ``posterior_jit_speedup >= 1.0`` gate holds with real headroom).
+JIT_WIN_MARGIN = 1.15
+
+#: Probe rows are capped here: timing fidelity saturates while probe
+#: cost keeps growing (numpy at 262k rows is ~1s on a laptop core).
+JIT_PROBE_MAX_ROWS = 65536
+
+#: (soft, sharpness, n_signals, row_bucket) -> jit wins there.
+_AUTO_PROBES: dict[tuple[bool, float, int, int], dict[str, Any]] = {}
 
 
 @dataclass(slots=True)
@@ -147,8 +174,39 @@ def jax_available() -> bool:
     return True
 
 
-def resolve_use_jax(n_rows: int, use_jax: bool | None) -> bool:
-    """Apply the engagement policy (arg > env > auto threshold)."""
+def _auto_min_rows() -> int:
+    env = os.environ.get("TPUSLO_COLUMNAR_JIT_MIN_ROWS", "")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return JIT_MIN_BATCH
+
+
+def _row_bucket(n_rows: int) -> int:
+    """Largest power-of-two probe bucket INSIDE ``n_rows`` (capped).
+
+    Rounding down matters: the probe slices the call's own inputs to
+    the bucket, so an upward-rounded bucket would time fewer rows
+    than the key it caches the verdict under — and near the crossover
+    that verdict would be applied to batches up to ~2x larger than
+    what was actually measured.
+    """
+    capped = max(1, min(n_rows, JIT_PROBE_MAX_ROWS))
+    bucket = 1
+    while bucket * 2 <= capped:
+        bucket <<= 1
+    return bucket
+
+
+def resolve_use_jax(n_rows: int, use_jax: bool | None) -> bool | None:
+    """Arg/env layer of the engagement policy.
+
+    True/False are final verdicts; ``None`` means "auto at probe-worthy
+    size" — :func:`log_posterior_batch` then consults (or runs) the
+    measured per-bucket probe, which needs the call's actual inputs.
+    """
     if use_jax is not None:
         return use_jax and jax_available()
     env = os.environ.get("TPUSLO_COLUMNAR_JIT", "")
@@ -156,7 +214,66 @@ def resolve_use_jax(n_rows: int, use_jax: bool | None) -> bool:
         return False
     if env == "1":
         return jax_available()
-    return n_rows >= JIT_MIN_BATCH and jax_available()
+    if n_rows < _auto_min_rows() or not jax_available():
+        return False
+    return None
+
+
+def _probe_auto(values, observed, mats, soft, sharpness) -> bool:
+    """Measure numpy vs jit on THIS call's inputs; cache per bucket.
+
+    The jit side is timed on its second run (the first pays the one-off
+    compile), the numpy side on its second run too (cache warmth
+    parity).  Probe cost is bounded: inputs are truncated to the probe
+    bucket, and each (soft, sharpness, signals, bucket) key probes once
+    per process.
+    """
+    import time
+
+    bucket = _row_bucket(len(values))
+    key = (bool(soft), float(sharpness), values.shape[1], bucket)
+    cached = _AUTO_PROBES.get(key)
+    if cached is not None:
+        return cached["jit_wins"]
+    sample = values[:bucket]
+    sample_obs = observed[:bucket]
+    timings = {}
+    for label, kernel in (("numpy", _numpy_kernel), ("jit", _jax_kernel)):
+        best = 1e30
+        for _ in range(2):
+            t0 = time.perf_counter()
+            kernel(sample, sample_obs, mats, soft, sharpness)
+            best = min(best, time.perf_counter() - t0)
+        timings[label] = best
+    speedup = timings["numpy"] / max(timings["jit"], 1e-12)
+    _AUTO_PROBES[key] = {
+        "jit_wins": speedup >= JIT_WIN_MARGIN,
+        "speedup": round(speedup, 3),
+        "rows": bucket,
+    }
+    return _AUTO_PROBES[key]["jit_wins"]
+
+
+def auto_report() -> dict[str, Any]:
+    """The tuner's current state, for bench/debug output."""
+    return {
+        "min_rows": _auto_min_rows(),
+        "win_margin": JIT_WIN_MARGIN,
+        "probes": {
+            f"rows={key[3]}": dict(result)
+            for key, result in sorted(_AUTO_PROBES.items())
+        },
+    }
+
+
+def auto_threshold() -> int | None:
+    """Smallest probed row bucket where jit won (None: jit never won —
+    auto mode stays on numpy everywhere it has measured)."""
+    winners = [
+        key[3] for key, result in _AUTO_PROBES.items()
+        if result["jit_wins"]
+    ]
+    return min(winners) if winners else None
 
 
 def log_posterior_batch(
@@ -174,6 +291,9 @@ def log_posterior_batch(
     ``observed`` comes back because soft mode drops exact-zero
     continuous probes from the observation set.
     """
-    if resolve_use_jax(len(values), use_jax):
+    verdict = resolve_use_jax(len(values), use_jax)
+    if verdict is None:
+        verdict = _probe_auto(values, observed, mats, soft, sharpness)
+    if verdict:
         return _jax_kernel(values, observed, mats, soft, sharpness)
     return _numpy_kernel(values, observed, mats, soft, sharpness)

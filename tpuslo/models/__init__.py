@@ -4,11 +4,13 @@ from tpuslo.models import (
     batching,
     checkpoint,
     data,
+    frontdoor,
     longserve,
     mixtral,
     speculative,
     trainer,
 )
+from tpuslo.models.frontdoor import FrontDoorEngine
 from tpuslo.models.llama import (
     LlamaConfig,
     decode_step,
@@ -31,6 +33,8 @@ __all__ = [
     "batching",
     "checkpoint",
     "data",
+    "frontdoor",
+    "FrontDoorEngine",
     "longserve",
     "mixtral",
     "speculative",

@@ -60,9 +60,64 @@ def _inject_row(cache: PyTree, row: PyTree, slot: jax.Array) -> PyTree:
     lengths = cache["length"].at[slot].set(row["length"])
     return {"k": k, "v": v, "length": lengths}
 
+def _inject_rows(pool: PyTree, rows: PyTree, slots: jax.Array) -> PyTree:
+    """Splice EVERY row of a batched cache into ``slots`` of the pool.
+
+    The front door's batched-admission kernel: one dispatch installs a
+    whole admission batch (lockstep-prefilled rows) instead of one
+    inject per request.  The unrolled writes land in REVERSE row
+    order, so callers alias PAD rows (the tail of a bucket-padded
+    batch) to a real row's slot — the real row writes later and wins.
+    """
+    zero = jnp.asarray(0, jnp.int32)
+
+    def splice(dst, src, i, slot):
+        src_idx = (zero, jnp.asarray(i, jnp.int32)) + (zero,) * (src.ndim - 2)
+        sizes = (src.shape[0], 1) + tuple(src.shape[2:])
+        row = lax.dynamic_slice(src, src_idx, sizes)
+        dst_idx = (zero, slot) + (zero,) * (dst.ndim - 2)
+        return lax.dynamic_update_slice(dst, row, dst_idx)
+
+    k, v, lengths = pool["k"], pool["v"], pool["length"]
+    for i in reversed(range(slots.shape[0])):
+        slot = slots[i]
+        k = jax.tree.map(
+            lambda dst, src, i=i, slot=slot: splice(dst, src, i, slot),
+            k, rows["k"],
+        )
+        v = jax.tree.map(
+            lambda dst, src, i=i, slot=slot: splice(dst, src, i, slot),
+            v, rows["v"],
+        )
+        lengths = lengths.at[slot].set(rows["length"][i])
+    return {"k": k, "v": v, "length": lengths}
+
+
+def _extract_row(pool: PyTree, slot: jax.Array) -> PyTree:
+    """Copy ``slot``'s row out of a batched cache as a single-row cache.
+
+    The inverse of :func:`_inject_row`, used by the front-door engine
+    to PARK a preempted slot: the row's KV (and scalar frontier) are
+    snapshotted so a later :func:`_inject_row` resumes the stream
+    bit-identically.  The pool is read, never donated — it keeps
+    serving the other slots.
+    """
+    zero = jnp.asarray(0, jnp.int32)
+
+    def take(leaf):
+        idx = (zero, slot) + (zero,) * (leaf.ndim - 2)
+        sizes = (leaf.shape[0], 1) + leaf.shape[2:]
+        return lax.dynamic_slice(leaf, idx, sizes)
+
+    k = jax.tree.map(take, pool["k"])
+    v = jax.tree.map(take, pool["v"])
+    return {"k": k, "v": v, "length": pool["length"][slot]}
+
 # Shared jitted kernels (see serve.py's shared-kernel note): one
 # compile cache per config across every engine instance.
 _SHARED_INJECT = jax.jit(_inject_row, donate_argnums=(0,))
+_SHARED_INJECT_ROWS = jax.jit(_inject_rows, donate_argnums=(0,))
+_SHARED_EXTRACT = jax.jit(_extract_row)
 
 # decode_step's shared compile lives in serve.py so the speculative
 # engine and this one reuse a SINGLE cache for the same program.

@@ -1,0 +1,894 @@
+"""One serving front door: batched speculative decoding inside
+continuous-batching slots, with SLO-aware admission.
+
+The toolkit's serving pieces finally compose (ROADMAP #2):
+
+* **Batched spec rounds across slots.**  The engine owns a fixed pool
+  of ``max_slots`` KV rows on BOTH a target and a draft model and
+  steps every occupied slot through ONE fused speculative round per
+  iteration — the memoized jitted :func:`tpuslo.models.speculative.
+  _spec_round_core` program (one executable per ``(cfg_t, cfg_d, k,
+  max_slots)``; the batch axis specializes the shapes) with donated
+  caches, per-slot acceptance frontiers and an active mask.  Slots
+  inject/retire only at round boundaries, so shapes never change and
+  steady-state rounds never retrace: one dispatch in, one fused
+  ``(drafts, preds, accepted)`` read out (jitaudit-sectioned, exactly
+  like the per-stream engine).  Per-slot output is provably identical
+  to the target-only greedy stream — the round kernel and its
+  stale-slot discipline are the ones :class:`~tpuslo.models.
+  speculative.SpeculativeEngine.generate_batch` already proves.
+
+* **SLO-aware admission.**  The scheduler consults the toolkit's OWN
+  :class:`~tpuslo.sloengine.engine.BurnEngine` live: a tenant's
+  effective priority is its remediation-surface ``admission_priority``
+  (PR 11's ``demote_tenant`` lands HERE, in the serving loop), further
+  demoted while the tenant's budget is in ``fast_burn``.  Under queue
+  pressure low-priority requests shed (counted by reason) and running
+  low-priority slots are PREEMPTED: the slot's KV rows are parked via
+  a jitted row extraction and later re-injected, resuming the stream
+  bit-identically.  Completed requests feed their outcomes back into
+  the burn engine — the SLO engine sits inside its own serving loop.
+
+* **Prefix-cache-aware placement.**  Queue order breaks priority ties
+  toward requests whose shared prefix already has a KV snapshot on
+  both engines, so same-prefix requests batch onto slots that reuse
+  the snapshot (suffix-only prefill; the TTFT delta is asserted in
+  tests/test_frontdoor.py).
+
+Crash-safety: the engine registers with the PR 4 ``AgentRuntime``
+(:meth:`FrontDoorEngine.export_state` / ``restore_state``).  KV does
+not ride the JSON snapshot; in-flight requests are persisted as their
+emitted-token prefix and resume by teacher-forcing ``prompt +
+emitted[:-1]`` back through prefill — greedy decoding makes the
+continuation identical to the uninterrupted stream.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpuslo.models.batching import (
+    _SHARED_EXTRACT,
+    _SHARED_INJECT,
+    _SHARED_INJECT_ROWS,
+)
+from tpuslo.models.llama import init_kv_cache
+from tpuslo.models.serve import (
+    BOS,
+    EOS,
+    ServeEngine,
+    _audit_registry,
+    _steady_section,
+)
+from tpuslo.models.speculative import (
+    _rehome_draft_cache,
+    _shared_spec_multi_round_fn,
+    joint_prompt_ids,
+)
+
+# The ONE admission-priority scale: the sloengine remediation surface
+# owns it (demote_tenant writes these values), the front door only
+# reads it — a local mirror would silently desync the fast-burn clamp
+# and the shed-reason classification from the remediation engine.
+from tpuslo.sloengine.engine import (  # noqa: E402
+    DEFAULT_ADMISSION_PRIORITY as DEFAULT_PRIORITY,
+    DEMOTED_ADMISSION_PRIORITY as DEMOTED_PRIORITY,
+)
+
+PyTree = Any
+
+#: Shed reasons (the precision evidence satellite tests count by):
+SHED_QUEUE_FULL = "queue_full"  # queue at capacity, arrival not better
+SHED_DISPLACED = "displaced"  # queued low-priority evicted for arrival
+SHED_BURNING = "queue_full_burning"  # arrival's tenant burning, queue full
+SHED_REASONS = (SHED_QUEUE_FULL, SHED_DISPLACED, SHED_BURNING)
+
+STATE_VERSION = 1
+
+
+@dataclass(slots=True)
+class FrontDoorRequest:
+    """One request's lifecycle through the front door (slotted: queue
+    scans and per-round emission touch these records on the hot path)."""
+
+    request_id: int
+    tenant: str
+    prompt: str
+    max_new_tokens: int
+    stop_at_eos: bool
+    prefix: str | None
+    submitted_s: float
+    tokens: list[int] = field(default_factory=list)
+    admitted_s: float | None = None
+    first_token_s: float | None = None
+    completed_s: float | None = None
+    preemptions: int = 0
+    resumed_from_snapshot: bool = False
+    #: Parked KV snapshot: (row_t, row_d, current_token, frontier).
+    parked: tuple | None = None
+
+    def persistable(self) -> dict[str, Any]:
+        """JSON-safe form for the runtime snapshot (KV never rides)."""
+        return {
+            "request_id": self.request_id,
+            "tenant": self.tenant,
+            "prompt": self.prompt,
+            "max_new_tokens": self.max_new_tokens,
+            "stop_at_eos": self.stop_at_eos,
+            "prefix": self.prefix,
+            "tokens": [int(t) for t in self.tokens],
+            "preemptions": self.preemptions,
+        }
+
+    @classmethod
+    def from_persisted(cls, raw: dict[str, Any]) -> "FrontDoorRequest":
+        req = cls(
+            request_id=int(raw["request_id"]),
+            tenant=str(raw.get("tenant", "default")),
+            prompt=str(raw.get("prompt", "")),
+            max_new_tokens=int(raw.get("max_new_tokens", 1)),
+            stop_at_eos=bool(raw.get("stop_at_eos", True)),
+            prefix=raw.get("prefix") or None,
+            submitted_s=time.perf_counter(),
+            tokens=[int(t) for t in raw.get("tokens", [])],
+            preemptions=int(raw.get("preemptions", 0)),
+        )
+        req.resumed_from_snapshot = bool(req.tokens)
+        return req
+
+
+class FrontDoorObserver:
+    """No-op observer; the bench/agent bridge these to metrics."""
+
+    def admitted(self, tenant: str) -> None: ...
+
+    def shed(self, tenant: str, reason: str) -> None: ...
+
+    def preempted(self, tenant: str) -> None: ...
+
+    def completed(self, tenant: str, tokens: int) -> None: ...
+
+
+class FrontDoorEngine:
+    """SLO-aware continuous batching over batched speculative rounds.
+
+    ``target``/``draft`` follow the :class:`SpeculativeEngine`
+    contract (shared byte tokenizer; draft much cheaper for real
+    speedup, any pair correct).  ``burn_engine`` is duck-typed
+    (``admission_priority``/``tenant_burn_state``/``record``); without
+    one every tenant serves at the default priority and no outcomes
+    are recorded.
+    """
+
+    def __init__(
+        self,
+        target: ServeEngine,
+        draft: ServeEngine,
+        k: int = 4,
+        max_slots: int = 4,
+        max_queue: int = 256,
+        rounds_per_step: int = 2,
+        burn_engine=None,
+        observer: FrontDoorObserver | None = None,
+    ):
+        if k < 1:
+            raise ValueError("k must be >= 1")
+        if max_slots < 1:
+            raise ValueError("max_slots must be >= 1")
+        if max_queue < 1:
+            raise ValueError("max_queue must be >= 1")
+        if rounds_per_step < 1:
+            raise ValueError("rounds_per_step must be >= 1")
+        self.target = target
+        self.draft = draft
+        self.k = k
+        self.max_slots = max_slots
+        self.max_queue = max_queue
+        self.rounds_per_step = rounds_per_step
+        self._burn = burn_engine
+        self._observer = observer or FrontDoorObserver()
+        # ONE memoized fused multi-round program per (cfg_t, cfg_d, k,
+        # rounds); the (max_slots,) batch axis keys its own executable
+        # inside it — i.e. one compile per (cfg_t, cfg_d, k,
+        # max_slots, rounds_per_step).  rounds_per_step chains that
+        # many spec rounds device-side per dispatch, so the host's
+        # fused read amortizes over rounds*(k+1) tokens per slot.
+        self._round = _shared_spec_multi_round_fn(
+            target.cfg, draft.cfg, k, rounds_per_step
+        )
+        self._inject = _SHARED_INJECT
+        self._inject_rows = _SHARED_INJECT_ROWS
+        self._extract = _SHARED_EXTRACT
+        # Admission-batch buckets: lockstep prefill + one fused
+        # multi-row inject compile once per (bucket, prompt-chunk
+        # shape) — the same power-of-two discipline as everything else.
+        buckets: list[int] = []
+        b = 1
+        while b < max_slots:
+            buckets.append(b)
+            b <<= 1
+        buckets.append(max_slots)
+        self._admit_buckets = tuple(buckets)
+        # Every dispatch writes KV for up to rounds*(k+1) tokens past
+        # the frontier; beyond this limit a row must already be done
+        # (admission clamps budgets so it always is).
+        self._joint_seq = min(
+            target.cfg.max_seq_len, draft.cfg.max_seq_len
+        )
+        self._limit = self._joint_seq - rounds_per_step * (k + 1)
+        self._cache_t = self._init_pool(target)
+        self._cache_d = _rehome_draft_cache(
+            target, draft, self._init_pool(draft)
+        )
+        self._tokens = jnp.full((max_slots,), BOS, jnp.int32)
+        # Host mirrors of the device-side frontiers/current tokens —
+        # maintained from values the emission loop already reads, so
+        # parking a slot needs no extra device sync.
+        self._start = np.ones(max_slots, np.int64)
+        self._current = np.full(max_slots, BOS, np.int64)
+        self._slots: list[FrontDoorRequest | None] = [None] * max_slots
+        self._queue: list[FrontDoorRequest] = []
+        self._next_id = 0
+        # Wall-clock anchor for burn-engine outcome timestamps: the hot
+        # path never reads the wall clock (TPL120) — event time derives
+        # from perf_counter deltas against this init-time anchor.
+        self._epoch_ns = time.time_ns()
+        self._epoch_pc = time.perf_counter()
+
+        self.rounds = 0
+        self.slot_rounds = 0
+        self.accepted_draft_tokens = 0
+        self.emitted_tokens = 0
+        self.preemptions = 0
+        self.resumes = 0
+        self.snapshot_resumes = 0
+        self.shed_by_reason: dict[str, int] = {r: 0 for r in SHED_REASONS}
+        #: request id -> shed reason (the caller-visible refusal record)
+        self.shed_requests: dict[int, str] = {}
+        #: finished request id -> emitted token ids
+        self.results: dict[int, list[int]] = {}
+        self._finished: dict[int, FrontDoorRequest] = {}
+
+    # ---- construction helpers -----------------------------------------
+
+    def _init_pool(self, engine: ServeEngine) -> PyTree:
+        pool = init_kv_cache(
+            engine.cfg, self.max_slots, kv_dtype=engine.kv_dtype
+        )
+        # Free lanes idle at frontier 1 (attention over one zero-KV
+        # position is well-defined; frontier 0 would be the only shape
+        # the round kernels never see elsewhere).
+        pool["length"] = jnp.ones((self.max_slots,), jnp.int32)
+        if engine.mesh is not None:
+            from tpuslo.models.serve import kv_cache_shardings
+
+            pool = jax.device_put(
+                pool, kv_cache_shardings(engine.mesh, engine.kv_dtype)
+            )
+        return pool
+
+    def _now_ns(self) -> int:
+        return self._epoch_ns + int(
+            (time.perf_counter() - self._epoch_pc) * 1e9
+        )
+
+    @property
+    def acceptance_rate(self) -> float:
+        proposed = self.slot_rounds * self.k
+        return self.accepted_draft_tokens / proposed if proposed else 0.0
+
+    # ---- admission policy ---------------------------------------------
+
+    def effective_priority(self, tenant: str) -> int:
+        """Live per-tenant priority: the remediation surface's
+        ``admission_priority`` (demote_tenant lands here), further
+        demoted while the tenant's budget is in fast burn."""
+        if self._burn is None:
+            return DEFAULT_PRIORITY
+        priority = int(self._burn.admission_priority(tenant))
+        if self._burn.tenant_burn_state(tenant) == "fast_burn":
+            priority = min(priority, DEMOTED_PRIORITY)
+        return priority
+
+    def _prefix_warm(self, prefix: str | None) -> bool:
+        return bool(prefix) and (
+            self.target.prefix_warm(prefix)
+            and self.draft.prefix_warm(prefix)
+        )
+
+    def _order_key(self, req: FrontDoorRequest):
+        """Queue order: priority first (live — a mid-run demotion
+        reorders the queue), then prefix-cache-aware placement (warm
+        prefixes batch together onto snapshot-reusing slots), then
+        arrival order."""
+        return (
+            -self.effective_priority(req.tenant),
+            0 if self._prefix_warm(req.prefix) else 1,
+            req.request_id,
+        )
+
+    def submit(
+        self,
+        prompt: str,
+        tenant: str = "default",
+        max_new_tokens: int = 32,
+        stop_at_eos: bool = True,
+        prefix: str | None = None,
+    ) -> int | None:
+        """Enqueue a request; returns its id, or ``None`` when shed.
+
+        Shedding is by live priority: a full queue refuses the arrival
+        (``queue_full``; ``queue_full_burning`` when its tenant is
+        demoted/burning — the burn engine's budget math throttles its
+        own traffic) unless a strictly lower-priority queued request
+        can be displaced instead (``displaced``).  Every shed is
+        recorded as a failed outcome against the shed tenant's budget
+        — load shedding is an availability hit for that tenant, never
+        for the tenants it protects.
+        """
+        req = FrontDoorRequest(
+            request_id=self._next_id,
+            tenant=tenant or "default",
+            prompt=prompt,
+            max_new_tokens=max_new_tokens,
+            stop_at_eos=stop_at_eos,
+            prefix=prefix,
+            submitted_s=time.perf_counter(),
+        )
+        self._next_id += 1
+        if len(self._queue) >= self.max_queue:
+            priority = self.effective_priority(req.tenant)
+            victim = max(self._queue, key=self._order_key)
+            if self.effective_priority(victim.tenant) < priority:
+                self._queue.remove(victim)
+                self._shed(victim, SHED_DISPLACED)
+            else:
+                reason = (
+                    SHED_BURNING
+                    if priority <= DEMOTED_PRIORITY
+                    else SHED_QUEUE_FULL
+                )
+                self._shed(req, reason)
+                return None
+        self._queue.append(req)
+        return req.request_id
+
+    def _shed(self, req: FrontDoorRequest, reason: str) -> None:
+        self.shed_by_reason[reason] = (
+            self.shed_by_reason.get(reason, 0) + 1
+        )
+        self.shed_requests[req.request_id] = reason
+        self._observer.shed(req.tenant, reason)
+        self._record_outcome(req, status="shed")
+
+    def _record_outcome(
+        self, req: FrontDoorRequest, status: str
+    ) -> None:
+        if self._burn is None:
+            return
+        from tpuslo.sloengine.stream import RequestOutcome
+
+        ttft_ms = 0.0
+        tpot_ms = 0.0
+        if (
+            req.first_token_s is not None
+            and req.submitted_s is not None
+        ):
+            ttft_ms = (req.first_token_s - req.submitted_s) * 1000.0
+        if (
+            req.completed_s is not None
+            and req.first_token_s is not None
+            and len(req.tokens) > 1
+        ):
+            tpot_ms = (
+                (req.completed_s - req.first_token_s)
+                / (len(req.tokens) - 1)
+                * 1000.0
+            )
+        self._burn.record(
+            RequestOutcome(
+                tenant=req.tenant,
+                ts_unix_nano=self._now_ns(),
+                ttft_ms=ttft_ms,
+                tpot_ms=tpot_ms,
+                tokens=len(req.tokens),
+                status=status,
+            )
+        )
+
+    # ---- slot lifecycle -----------------------------------------------
+
+    def _context_ids(self, req: FrontDoorRequest) -> tuple[list[int], list[int]]:
+        """(prefix_ids, full prompt ids) under the joint truncation."""
+        prefix_ids, suffix_ids = joint_prompt_ids(
+            self.target, self.draft, req.prompt, req.prefix
+        )
+        return prefix_ids, prefix_ids + suffix_ids
+
+    def _complete(self, req: FrontDoorRequest, now_s: float) -> None:
+        req.completed_s = now_s
+        self.results[req.request_id] = req.tokens
+        self._finished[req.request_id] = req
+        self.emitted_tokens += len(req.tokens)
+        self._observer.completed(req.tenant, len(req.tokens))
+        self._record_outcome(req, status="ok")
+
+    def _admit(self, slot: int, req: FrontDoorRequest) -> None:
+        """Place one request into ``slot`` at a round boundary.
+
+        Three entry paths: a PARKED request re-injects its KV snapshot
+        (bit-identical resume, no recompute); a snapshot-RESTORED
+        request teacher-forces ``prompt + emitted[:-1]`` back through
+        prefill; a fresh request ingests its prompt (prefix-cache
+        aware) and emits its first token from the prefill logits.
+        """
+        now_s = time.perf_counter()
+        if req.parked is not None:
+            row_t, row_d, current, start = req.parked
+            req.parked = None
+            self._install(slot, req, row_t, row_d, current, start)
+            self.resumes += 1
+            return
+
+        prefix_ids, ids = self._context_ids(req)
+        # Budget clamp: every round writes k+1 KV slots at the
+        # frontier, and the front door has no single-token tail path —
+        # the last emittable token must leave the round's write window
+        # inside the joint capacity.
+        cap = max(
+            1,
+            min(
+                self.target.decode_cap_tokens(len(ids)),
+                self.draft.decode_cap_tokens(len(ids)),
+                self._joint_seq
+                    - self.rounds_per_step * (self.k + 1)
+                    - len(ids),
+            ),
+        )
+        req.max_new_tokens = max(1, min(req.max_new_tokens, cap))
+
+        if req.tokens:
+            # Snapshot-restored mid-stream request: KV did not survive
+            # the restart; rebuild it by teacher-forcing the already-
+            # emitted prefix.  Greedy decode makes the continuation
+            # identical to the uninterrupted stream.
+            self.snapshot_resumes += 1
+            context = ids + [int(t) for t in req.tokens[:-1]]
+            current = int(req.tokens[-1])
+            req.admitted_s = req.admitted_s or now_s
+            req.first_token_s = req.first_token_s or now_s
+            if (
+                len(req.tokens) >= req.max_new_tokens
+                or (req.stop_at_eos and current == EOS)
+                or len(context) + 1 >= self._limit
+            ):
+                self._complete(req, now_s)
+                return
+            _logits, row_t = self.target.ingest_ids(
+                context, req.prefix, prefix_ids
+            )
+            _logits_d, row_d = self.draft.ingest_ids(
+                context, req.prefix, prefix_ids
+            )
+            self._install(
+                slot, req, row_t,
+                _rehome_draft_cache(self.target, self.draft, row_d),
+                current, len(context),
+            )
+            return
+
+        logits, row_t = self.target.ingest_ids(
+            ids, req.prefix, prefix_ids
+        )
+        _logits_d, row_d = self.draft.ingest_ids(
+            ids, req.prefix, prefix_ids
+        )
+        first = int(jnp.argmax(logits, axis=-1)[0])
+        req.admitted_s = now_s
+        req.first_token_s = now_s
+        req.tokens.append(first)
+        self._observer.admitted(req.tenant)
+        if (req.stop_at_eos and first == EOS) or req.max_new_tokens <= 1:
+            self._complete(req, now_s)
+            return
+        self._install(
+            slot, req, row_t,
+            _rehome_draft_cache(self.target, self.draft, row_d),
+            first, len(ids),
+        )
+
+    def _batchable(self, req: FrontDoorRequest) -> bool:
+        """Fresh plain-prompt requests lockstep-prefill together;
+        parked (KV snapshot), snapshot-restored (teacher-forced) and
+        prefix requests (snapshot clone + suffix append) each need
+        their own ingestion path and admit individually."""
+        return req.parked is None and not req.tokens and not req.prefix
+
+    def _admit_batch(
+        self, slots: list[int], reqs: list[FrontDoorRequest]
+    ) -> None:
+        """Admit a run of fresh requests in ONE lockstep batched
+        prefill per engine plus ONE fused multi-row inject per pool.
+
+        Per-request admission cost was the front door's residual
+        serial work (two bucketed prefills + two injects + a first-
+        token read each); batching folds an admission boundary's whole
+        run into ~5 dispatches and a single fused read, the same
+        amortization the round loop already has.  Pad rows (batch
+        bucket discipline) alias a real slot and are overwritten by
+        the reverse-ordered inject.
+        """
+        from tpuslo.models.serve import _bucket
+
+        now_s = time.perf_counter()
+        all_ids: list[list[int]] = []
+        for req in reqs:
+            _prefix_ids, ids = self._context_ids(req)
+            cap = max(
+                1,
+                min(
+                    self.target.decode_cap_tokens(len(ids)),
+                    self.draft.decode_cap_tokens(len(ids)),
+                    self._joint_seq
+                    - self.rounds_per_step * (self.k + 1)
+                    - len(ids),
+                ),
+            )
+            req.max_new_tokens = max(1, min(req.max_new_tokens, cap))
+            all_ids.append(ids)
+        bucket = _bucket(len(reqs), self._admit_buckets)
+        padded = all_ids + [[BOS]] * (bucket - len(reqs))
+        logits_t, rows_t = self.target._prefill_rows(padded, 0)
+        _logits_d, rows_d = self.draft._prefill_rows(padded, 0)
+        rows_d = _rehome_draft_cache(self.target, self.draft, rows_d)
+        firsts = [
+            int(v)
+            for v in jax.device_get(jnp.argmax(logits_t, axis=-1))
+        ]
+        # Pad rows alias the first real slot; the reverse-ordered
+        # fused inject writes them first, so the real row wins.
+        assignment = [
+            slots[i] if i < len(reqs) else slots[0]
+            for i in range(bucket)
+        ]
+        slots_vec = jnp.asarray(assignment, jnp.int32)
+        self._cache_t = self._inject_rows(
+            self._cache_t, rows_t, slots_vec
+        )
+        self._cache_d = self._inject_rows(
+            self._cache_d, rows_d, slots_vec
+        )
+        real_slots = np.asarray(slots[: len(reqs)], np.int32)
+        self._tokens = self._tokens.at[real_slots].set(
+            jnp.asarray(firsts[: len(reqs)], jnp.int32)
+        )
+        for i, req in enumerate(reqs):
+            first = firsts[i]
+            req.admitted_s = now_s
+            req.first_token_s = now_s
+            req.tokens.append(first)
+            self._observer.admitted(req.tenant)
+            if (
+                req.stop_at_eos and first == EOS
+            ) or req.max_new_tokens <= 1:
+                # Instant complete: the injected row simply becomes a
+                # parked lane until something overwrites it.
+                self._complete(req, now_s)
+                continue
+            self._slots[slots[i]] = req
+            self._start[slots[i]] = len(all_ids[i])
+            self._current[slots[i]] = first
+
+    def _install(
+        self,
+        slot: int,
+        req: FrontDoorRequest,
+        row_t: PyTree,
+        row_d: PyTree,
+        current: int,
+        start: int,
+    ) -> None:
+        slot_idx = jnp.asarray(slot, jnp.int32)
+        self._cache_t = self._inject(self._cache_t, row_t, slot_idx)
+        self._cache_d = self._inject(self._cache_d, row_d, slot_idx)
+        self._tokens = self._tokens.at[slot].set(current)
+        self._start[slot] = start
+        self._current[slot] = current
+        self._slots[slot] = req
+
+    def _park(self, slot: int) -> None:
+        """Preempt ``slot``: snapshot its KV rows + frontier and return
+        the request to the queue (it resumes bit-identically via
+        re-injection when scheduled again)."""
+        req = self._slots[slot]
+        if req is None:
+            return
+        slot_idx = jnp.asarray(slot, jnp.int32)
+        row_t = self._extract(self._cache_t, slot_idx)
+        row_d = self._extract(self._cache_d, slot_idx)
+        req.parked = (
+            row_t, row_d,
+            int(self._current[slot]), int(self._start[slot]),
+        )
+        req.preemptions += 1
+        self.preemptions += 1
+        self._slots[slot] = None
+        self._queue.append(req)
+        self._observer.preempted(req.tenant)
+
+    def _fill_slots(self) -> None:
+        """Admit (and, under pressure, preempt) at a round boundary.
+
+        Preemption fires only for a STRICTLY higher-priority queued
+        request than the lowest-priority running slot — equal
+        priorities never thrash, and each park+admit raises the
+        running-priority multiset, so the loop is bounded.
+        """
+        while self._queue:
+            free = [
+                i
+                for i, occupant in enumerate(self._slots)
+                if occupant is None
+            ]
+            if not free and self._burn is None:
+                # Uniform priorities (no burn engine): preemption can
+                # never fire, so a full house needs no queue sort —
+                # this boundary is a pure decode round.
+                return
+            self._queue.sort(key=self._order_key)
+            if free:
+                if self._batchable(self._queue[0]):
+                    run: list[FrontDoorRequest] = []
+                    while (
+                        self._queue
+                        and len(run) < len(free)
+                        and self._batchable(self._queue[0])
+                    ):
+                        run.append(self._queue.pop(0))
+                    self._admit_batch(free[: len(run)], run)
+                else:
+                    self._admit(free[0], self._queue.pop(0))
+                continue
+            head_priority = self.effective_priority(
+                self._queue[0].tenant
+            )
+            victim = min(
+                range(self.max_slots),
+                key=lambda s: (
+                    self.effective_priority(self._slots[s].tenant),
+                    -self._slots[s].request_id,
+                ),
+            )
+            victim_priority = self.effective_priority(
+                self._slots[victim].tenant
+            )
+            if head_priority <= victim_priority:
+                break
+            self._park(victim)
+
+    # ---- the round loop ------------------------------------------------
+
+    def step(self) -> bool:
+        """Admit waiting requests, then run ONE fused multi-round
+        dispatch across every occupied slot (fixed shapes, one fused
+        device read).  Returns True while any work remains."""
+        self._fill_slots()
+        mask = np.asarray(
+            [occupant is not None for occupant in self._slots]
+        )
+        if not mask.any():
+            return bool(self._queue)
+        audit = _audit_registry()
+        with _steady_section(audit, "frontdoor.step", self.rounds >= 1):
+            draft_toks, preds, accepted, current, cache_t, cache_d = (
+                self._round(
+                    self.target.params, self.draft.params,
+                    self._tokens, self._cache_t, self._cache_d,
+                    jnp.asarray(self._start, jnp.int32),
+                    jnp.asarray(mask, jnp.bool_),
+                )
+            )
+            drafts, picks, acc = jax.device_get(
+                (draft_toks, preds, accepted)
+            )
+        self._cache_t, self._cache_d = cache_t, cache_d
+        self._tokens = current
+        self.rounds += 1
+        now_s = time.perf_counter()
+        for slot, req in enumerate(self._slots):
+            if req is None:
+                continue
+            # Consume the dispatch's sub-rounds in order; a row that
+            # finishes mid-dispatch discards its remaining sub-rounds
+            # (the device decoded them as parked-lane garbage).  The
+            # host frontier/current mirrors advance only while the row
+            # continues, so a CONTINUING row's mirrors exactly match
+            # the device state — which is all parking needs.
+            done = False
+            for r in range(self.rounds_per_step):
+                n = int(acc[slot, r])
+                emitted = [int(v) for v in drafts[slot, r, :n]] + [
+                    int(picks[slot, r, n])
+                ]
+                self.slot_rounds += 1
+                self.accepted_draft_tokens += n
+                self._start[slot] += n + 1
+                self._current[slot] = emitted[-1]
+                for token in emitted:
+                    req.tokens.append(token)
+                    if req.stop_at_eos and token == EOS:
+                        done = True
+                        break
+                    if len(req.tokens) >= req.max_new_tokens:
+                        done = True
+                        break
+                if done:
+                    break
+            if not done and self._start[slot] >= self._limit:
+                # Defensive: admission clamps budgets so the frontier
+                # cannot cross the dispatch-write limit mid-request.
+                done = True
+            if done:
+                self._slots[slot] = None
+                self._complete(req, now_s)
+        return bool(self._queue) or any(
+            occupant is not None for occupant in self._slots
+        )
+
+    def run(self) -> dict[int, list[int]]:
+        """Drive until every admitted request completes; returns all
+        finished results (cumulative across calls)."""
+        while self.step():
+            pass
+        return self.results
+
+    def cancel(self, request_id: int) -> None:
+        """Abandon a request wherever it lives (idempotent).
+
+        A cancelled completed request leaves BOTH result surfaces
+        (``results`` and the timing records) — telemetry must never
+        report a request the results table says doesn't exist."""
+        self.results.pop(request_id, None)
+        self._finished.pop(request_id, None)
+        self._queue = [
+            r for r in self._queue if r.request_id != request_id
+        ]
+        for slot, req in enumerate(self._slots):
+            if req is not None and req.request_id == request_id:
+                self._slots[slot] = None
+
+    def partial_tokens(self, request_id: int) -> list[int] | None:
+        """Tokens produced so far (``[]`` while queued, ``None`` for
+        unknown/shed requests)."""
+        if request_id in self.results:
+            return list(self.results[request_id])
+        for req in self._slots:
+            if req is not None and req.request_id == request_id:
+                return list(req.tokens)
+        for req in self._queue:
+            if req.request_id == request_id:
+                return list(req.tokens)
+        return None
+
+    # ---- telemetry ------------------------------------------------------
+
+    def request_timings(self) -> dict[int, dict[str, float]]:
+        """Per-completed-request latency SLIs (seconds): queue delay,
+        TTFT, TPOT, end-to-end.  Snapshot-restored requests carry no
+        cross-process timestamps and are excluded."""
+        out: dict[int, dict[str, float]] = {}
+        for rid, req in self._finished.items():
+            if (
+                req.resumed_from_snapshot
+                or req.submitted_s is None
+                or req.admitted_s is None
+                or req.first_token_s is None
+            ):
+                continue
+            record = {
+                "queue_delay_s": req.admitted_s - req.submitted_s,
+                "ttft_s": req.first_token_s - req.submitted_s,
+                "tenant": req.tenant,
+                "tokens": float(len(req.tokens)),
+                "preemptions": float(req.preemptions),
+            }
+            if req.completed_s is not None:
+                record["e2e_s"] = req.completed_s - req.submitted_s
+                if len(req.tokens) > 1:
+                    record["tpot_s"] = (
+                        req.completed_s - req.first_token_s
+                    ) / (len(req.tokens) - 1)
+            out[rid] = record
+        return out
+
+    def stats(self) -> dict[str, Any]:
+        active = sum(1 for s in self._slots if s is not None)
+        return {
+            "active_slots": active,
+            "max_slots": self.max_slots,
+            "occupancy": active / self.max_slots,
+            "queued": len(self._queue),
+            "rounds": self.rounds,
+            "slot_rounds": self.slot_rounds,
+            "acceptance_rate": round(self.acceptance_rate, 4),
+            "completed": len(self.results),
+            "emitted_tokens": self.emitted_tokens,
+            "preemptions": self.preemptions,
+            "resumes": self.resumes,
+            "snapshot_resumes": self.snapshot_resumes,
+            "shed": dict(self.shed_by_reason),
+        }
+
+    # ---- snapshot / restore (crash-safe runtime) ------------------------
+
+    def export_state(self) -> dict[str, Any]:
+        """JSON-safe snapshot: queue + in-flight requests persist as
+        their emitted-token prefixes (parked/running KV cannot ride a
+        JSON snapshot; restore resumes them by re-prefill)."""
+        in_flight = [
+            req.persistable()
+            for req in self._slots
+            if req is not None
+        ]
+        return {
+            "version": STATE_VERSION,
+            "next_id": self._next_id,
+            "queue": [req.persistable() for req in self._queue],
+            "in_flight": in_flight,
+            "results": {
+                str(rid): [int(t) for t in tokens]
+                for rid, tokens in self.results.items()
+            },
+            "shed_by_reason": dict(self.shed_by_reason),
+            "shed_requests": {
+                str(rid): reason
+                for rid, reason in self.shed_requests.items()
+            },
+            "counters": {
+                "emitted_tokens": self.emitted_tokens,
+                "preemptions": self.preemptions,
+                "slot_rounds": self.slot_rounds,
+                "accepted_draft_tokens": self.accepted_draft_tokens,
+            },
+        }
+
+    def restore_state(self, state: dict[str, Any]) -> None:
+        if not isinstance(state, dict):
+            return
+        if int(state.get("version", -1)) != STATE_VERSION:
+            return
+        self._next_id = int(state.get("next_id", 0))
+        # In-flight requests re-enter the queue ahead of the waiting
+        # ones (they were already admitted once) and resume by
+        # teacher-forced re-prefill in _admit.
+        self._queue = [
+            FrontDoorRequest.from_persisted(raw)
+            for raw in (
+                list(state.get("in_flight") or [])
+                + list(state.get("queue") or [])
+            )
+            if isinstance(raw, dict)
+        ]
+        self.results = {
+            int(rid): [int(t) for t in tokens]
+            for rid, tokens in (state.get("results") or {}).items()
+        }
+        for reason, count in (state.get("shed_by_reason") or {}).items():
+            self.shed_by_reason[str(reason)] = int(count)
+        self.shed_requests = {
+            int(rid): str(reason)
+            for rid, reason in (state.get("shed_requests") or {}).items()
+        }
+        counters = state.get("counters") or {}
+        self.emitted_tokens = int(counters.get("emitted_tokens", 0))
+        self.preemptions = int(counters.get("preemptions", 0))
+        self.slot_rounds = int(counters.get("slot_rounds", 0))
+        self.accepted_draft_tokens = int(
+            counters.get("accepted_draft_tokens", 0)
+        )

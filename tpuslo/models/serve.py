@@ -920,6 +920,44 @@ class ServeEngine:
             logits, cache = self._append_ids(cache, ids[len(head):], len(head))
         return logits, cache
 
+    def ingest_ids(
+        self,
+        ids: list[int],
+        prefix: str | None = None,
+        prefix_ids: list[int] | None = None,
+    ):
+        """Public id-level ingestion: (next-token logits, single-row
+        cache holding ``len(ids)`` tokens).
+
+        The front-door engine ingests the SAME id sequence on both its
+        target and draft engines (the two-engine exactness contract),
+        so truncation happens at the caller — this path never encodes
+        or truncates.  When ``prefix``/``prefix_ids`` name a leading
+        span of ``ids``, the engine's KV prefix cache serves it: the
+        snapshot is cloned and only the tail prefills (the TTFT win
+        prefix-aware placement schedules for).  The reuse is taken only
+        when this engine's own cached truncation produced EXACTLY
+        ``prefix_ids`` — a draft with a shorter ``max_seq_len`` would
+        otherwise splice a differently-truncated prefix and desync from
+        the target.  Without a usable snapshot it falls back to plain
+        chunked ingestion of the full sequence.
+        """
+        if prefix and prefix_ids:
+            entry = self.cache_prefix(prefix)
+            if entry.ids == prefix_ids and ids[: len(prefix_ids)] == prefix_ids:
+                tail = ids[len(prefix_ids):]
+                if not tail:
+                    return entry.logits, self._clone_cache(entry.cache)
+                cache = self._clone_cache(entry.cache)
+                return self._append_ids(cache, tail, len(prefix_ids))
+        return self._ingest_ids(ids)
+
+    def prefix_warm(self, prefix: str) -> bool:
+        """True when ``prefix`` already has a KV snapshot cached — the
+        scheduler signal prefix-aware placement sorts on (a warm-prefix
+        request admits with suffix-only prefill cost)."""
+        return prefix in self._prefix_cache
+
     def ingest_prompt(self, prompt: str, prefix: str | None = None):
         """(logits, single-row cache, total_len): the shared prompt
         ingestion for streaming and continuous-batching serving.

@@ -140,6 +140,79 @@ def _shared_spec_round_batch_fn(cfg_t, cfg_d, k: int):
     return jax.jit(spec_round_batch, donate_argnums=(3, 4))
 
 
+@lru_cache(maxsize=32)
+def _shared_spec_multi_round_fn(cfg_t, cfg_d, k: int, rounds: int):
+    """Memoized MULTI-round program: ``rounds`` consecutive batched
+    speculative rounds chained on device in ONE dispatch.
+
+    The front-door engine reads after every dispatch (emission +
+    admission need the host), which serializes dispatch latency with
+    device compute; chaining rounds inside the program amortizes that
+    read over ``rounds * (k+1)`` tokens per slot.  The frontier chain
+    is purely device-side: round ``r+1`` starts from round ``r``'s
+    ``new_length`` (carried in the caches' own ``length``), so between
+    reads the host uploads nothing.  Semantics per active row are
+    EXACTLY ``rounds`` sequential :func:`_spec_round_core` calls — a
+    row that finishes mid-dispatch keeps decoding garbage for the
+    remaining sub-rounds (the parked-lane discipline; its per-row
+    scatter writes drop out of bounds, and the host discards tokens
+    past EOS/budget exactly as it would across two dispatches).
+
+    Returns stacked ``(drafts (B, rounds, k), preds (B, rounds, k+1),
+    accepted (B, rounds))`` plus the final carries.
+    """
+
+    def spec_multi_round(
+        params_t, params_d, current, cache_t, cache_d, start, active
+    ):
+        drafts_all, preds_all, accepted_all = [], [], []
+        for _ in range(rounds):
+            draft_toks, preds, accepted, current, cache_t, cache_d = (
+                _spec_round_core(
+                    params_t, params_d, current, cache_t, cache_d,
+                    start, active, k, cfg_t, cfg_d,
+                )
+            )
+            start = cache_t["length"]
+            drafts_all.append(draft_toks)
+            preds_all.append(preds)
+            accepted_all.append(accepted)
+        return (
+            jnp.stack(drafts_all, axis=1),
+            jnp.stack(preds_all, axis=1),
+            jnp.stack(accepted_all, axis=1),
+            current,
+            cache_t,
+            cache_d,
+        )
+
+    return jax.jit(spec_multi_round, donate_argnums=(3, 4))
+
+
+def joint_prompt_ids(
+    target: ServeEngine, draft: ServeEngine, prompt: str,
+    prefix: str | None = None,
+) -> tuple[list[int], list[int]]:
+    """(prefix_ids, suffix_ids) both engines must ingest IDENTICALLY.
+
+    The ONE definition of two-engine prompt truncation: target and
+    draft caches desync (and the exactness guarantee dies) unless both
+    ingest the same id sequence, so the cap is the JOINT KV capacity —
+    ``min`` of the two ``max_seq_len``s — minus the prefill token and
+    one decode slot.  Plain prompts come back as ``([], ids)``; prefix
+    requests split exactly as :func:`tpuslo.models.serve.
+    prefix_prompt_ids` does, so prefix streams stay bit-identical to
+    the target-only prefix streams.  Shared by
+    :class:`SpeculativeEngine` and the front-door engine.
+    """
+    joint_seq = min(target.cfg.max_seq_len, draft.cfg.max_seq_len)
+    if prefix:
+        from tpuslo.models.serve import prefix_prompt_ids
+
+        return prefix_prompt_ids(prefix, prompt, joint_seq)
+    return [], encode_bytes(prompt, max(1, joint_seq - 2))
+
+
 def _rehome_draft_cache(target: ServeEngine, draft: ServeEngine, cache_d):
     """Replicate an unsharded draft's KV cache onto the target's mesh.
 
@@ -242,24 +315,12 @@ class SpeculativeEngine:
         # Chunked ingestion (head prefill + bucket appends) lifts the
         # prompt cap to joint KV capacity; both engines must ingest the
         # IDENTICAL id sequence or their caches desync, so encode once
-        # with the joint cap instead of per-engine ingest_prompt.
-        # Cap at joint capacity minus the prefill token + one decode
-        # slot (NOT minus k: the tail fallback already handles prompts
-        # too long for a speculative round, and extra truncation would
+        # with the joint cap (joint_prompt_ids is the one definition —
+        # NOT minus k: the tail fallback already handles prompts too
+        # long for a speculative round, and extra truncation would
         # break exactness vs the target-only stream near capacity).
-        joint_seq = min(t.cfg.max_seq_len, d.cfg.max_seq_len)
-        if prefix:
-            # The SHARED truncation helper keeps this bit-identical to
-            # ServeEngine.generate(prefix=...) (serve.prefix_prompt_ids
-            # is the one definition of the rules).
-            from tpuslo.models.serve import prefix_prompt_ids
-
-            prefix_ids, suffix_ids = prefix_prompt_ids(
-                prefix, prompt, joint_seq
-            )
-            ids = prefix_ids + suffix_ids
-        else:
-            ids = encode_bytes(prompt, max(1, joint_seq - 2))
+        prefix_ids, suffix_ids = joint_prompt_ids(t, d, prompt, prefix)
+        ids = prefix_ids + suffix_ids
 
         logits_t, cache_t = t._ingest_ids(ids)
         _logits_d, cache_d = d._ingest_ids(ids)
@@ -393,23 +454,14 @@ class SpeculativeEngine:
                     )
                 )
             return outputs
-        joint_seq = min(t.cfg.max_seq_len, d.cfg.max_seq_len)
-        if prefix:
-            # Shared truncation helper — per-row streams must equal the
-            # target-only prefix streams id-for-id (correctness-first:
-            # both engines re-prefill prefix+suffix; snapshot reuse on
-            # the target side is future work, as in stream()).
-            from tpuslo.models.serve import prefix_prompt_ids
-
-            ids = []
-            for p in prompts:
-                prefix_ids, suffix_ids = prefix_prompt_ids(
-                    prefix, p, joint_seq
-                )
-                ids.append(prefix_ids + suffix_ids)
-        else:
-            max_prompt = max(1, joint_seq - 2)
-            ids = [encode_bytes(p, max_prompt) for p in prompts]
+        # Shared truncation helper — per-row streams must equal the
+        # target-only prefix streams id-for-id (correctness-first: both
+        # engines re-prefill prefix+suffix; snapshot reuse on the
+        # target side is future work, as in stream()).
+        ids = []
+        for p in prompts:
+            prefix_ids, suffix_ids = joint_prompt_ids(t, d, p, prefix)
+            ids.append(prefix_ids + suffix_ids)
         n_real = len(ids)
         # Pad the batch to a compile bucket so each shape compiles once
         # (four jitted programs specialize on B); pad rows start done.

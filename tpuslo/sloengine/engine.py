@@ -295,6 +295,18 @@ class BurnEngine:
 
     # ---- admission priority (remediation surface) ---------------------
 
+    def tenant_burn_state(self, tenant: str) -> str:
+        """Worst live alert state across this tenant's objectives
+        (``ok`` | ``slow_burn`` | ``fast_burn``) — the burn signal the
+        serving front door's admission layer deprioritizes on.  Pure
+        state-machine read: no windows roll, nothing mutates."""
+        worst = STATE_OK
+        for objective in OBJECTIVES:
+            state = self.policy.state_of(tenant or "default", objective)
+            if state_level(state) > state_level(worst):
+                worst = state
+        return worst
+
     def admission_priority(self, tenant: str) -> int:
         """Priority the serving scheduler should admit this tenant at
         (higher first); demoted tenants sort behind everyone else."""
