@@ -14,6 +14,7 @@ ARTIFACTS ?= artifacts
 	burn-smoke burn-sweep fleet-smoke fleet-sweep \
 	remediation-smoke remediation-sweep \
 	frontdoor-smoke frontdoor-bench \
+	deviceplane-smoke deviceplane-sweep \
 	metrics-drift m5-candidate m5-gate helm-lint dashboards clean
 
 all: native test
@@ -247,6 +248,24 @@ frontdoor-bench:
 		--summary-json $(ARTIFACTS)/frontdoor/bench.json \
 		--summary-md $(ARTIFACTS)/frontdoor/bench.md
 
+# Device-plane smoke: ledger bucket-sum/tier parity over seeded
+# synthetic-xprof traces, breakdown reason classes, roofline verdicts,
+# dispatch-ledger + front-door tracing — seconds, runs in m5-gate.
+deviceplane-smoke:
+	$(PY) -m pytest tests/test_deviceplane.py -q -m 'not slow'
+
+# Full device-plane release gate: the seeded synthetic-xprof lane
+# through the per-launch ledger (buckets sum to total device time,
+# substantive join >= 0.9, unexplained <= 0.1), roofline verdicts on
+# every serving-path attribution, and the calibrated heldout suite
+# with the preemption/noisy-neighbor domains at >= 0.96 macro-F1
+# (see docs/runbooks/device-plane.md).
+deviceplane-sweep:
+	mkdir -p $(ARTIFACTS)/deviceplane
+	JAX_PLATFORMS=$${JAX_PLATFORMS:-cpu} $(PY) -m tpuslo m5gate --deviceplane-sweep \
+		--summary-json $(ARTIFACTS)/deviceplane/sweep.json \
+		--summary-md $(ARTIFACTS)/deviceplane/sweep.md
+
 # Fleet observability-plane smoke: wire contract round trips, hash-ring
 # placement, rollup merge invariants (no cross-tenant/cross-domain),
 # aggregator seq-dedup + failover absorb, and a small seeded simulator
@@ -316,7 +335,8 @@ m5-candidate:
 m5-gate: lint racecheck-smoke jitcheck-smoke burn-smoke burn-sweep \
 		bench-columnar-smoke fleet-smoke fleet-sweep \
 		remediation-smoke remediation-sweep \
-		frontdoor-smoke frontdoor-bench
+		frontdoor-smoke frontdoor-bench \
+		deviceplane-smoke deviceplane-sweep
 	$(PY) -m tpuslo m5gate --candidate-root $(ARTIFACTS)/m5 \
 		--scenarios "$(shell echo $(M5_SCENARIOS) | tr ' ' ',')" \
 		--summary-json $(ARTIFACTS)/m5/gate.json \

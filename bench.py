@@ -1288,6 +1288,32 @@ DECODE_HOST_SYNCS_PER_TOKEN_CEILING = 1.0
 SPEC_HOST_SYNCS_PER_TOKEN_CEILING = 0.45
 
 
+# Device-plane floors (ISSUE 14): the seeded synthetic-xprof lane is
+# deterministic and platform-independent, so the ledger's acceptance
+# bars gate every bench run, not just on-chip captures.
+DEVICEPLANE_MIN_JOIN_RATE = 0.9
+DEVICEPLANE_MAX_UNEXPLAINED_SHARE = 0.1
+
+
+def _gate_deviceplane(serving_digest: dict) -> None:
+    rate = serving_digest.get("deviceplane_join_rate")
+    if rate is not None and rate < DEVICEPLANE_MIN_JOIN_RATE:
+        raise SystemExit(
+            f"bench: device-plane substantive join rate {rate} < "
+            f"{DEVICEPLANE_MIN_JOIN_RATE} on the seeded synthetic lane "
+            "— a join tier regressed; run m5gate --deviceplane-sweep "
+            "for the per-tier breakdown"
+        )
+    share = serving_digest.get("deviceplane_unexplained_share")
+    if share is not None and share > DEVICEPLANE_MAX_UNEXPLAINED_SHARE:
+        raise SystemExit(
+            f"bench: device-plane unexplained share {share} > "
+            f"{DEVICEPLANE_MAX_UNEXPLAINED_SHARE} on the seeded "
+            "synthetic lane — device time is leaking out of the "
+            "ledger buckets; see docs/runbooks/device-plane.md"
+        )
+
+
 def _gate_trace_discipline(serving_digest: dict) -> None:
     retraces = serving_digest.get("spec_retrace_count")
     if retraces is not None and retraces > SPEC_RETRACE_CEILING:
@@ -1363,6 +1389,12 @@ def _digest_serving(serving: dict) -> dict:
     bw8 = serving.get("bw_decode_b8") or {}
     if bw8.get("hbm_bw_pct") is not None:
         d["decode_b8_hbm_bw_pct"] = bw8["hbm_bw_pct"]
+    deviceplane = serving.get("deviceplane") or {}
+    if deviceplane.get("substantive_join_rate") is not None:
+        d["deviceplane_join_rate"] = deviceplane["substantive_join_rate"]
+        d["deviceplane_unexplained_share"] = deviceplane.get(
+            "unexplained_share"
+        )
     for key in ("error", "tpu_error"):
         if serving.get(key):
             d[key] = str(serving[key])[:120]
@@ -1654,6 +1686,7 @@ def build_result(
         "serving": _digest_serving(serving_result),
     }
     _gate_trace_discipline(compact["serving"])
+    _gate_deviceplane(compact["serving"])
     if serving_result.get("backend") == "tpu":
         # The live serving digest IS the TPU evidence; stamp it so the
         # artifact says so even without an embedded capture.

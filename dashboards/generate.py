@@ -128,6 +128,25 @@ kernel_correlation = dashboard(
             (TTFT_P95, "ttft p95 (ms)"),
             ('histogram_quantile(0.95, sum(rate(llm_slo_agent_dns_latency_ms_bucket[5m])) by (le))', "kernel dns p95 (ms)"),
         ], 0, 16, w=24, unit="ms"),
+        # --- device-plane ledger (tpuslo.deviceplane) ----------------
+        panel("Device time by ledger bucket (ms/s)", [
+            ('sum(rate(llm_slo_deviceplane_device_time_ms_total[5m])) by (bucket)', "{{bucket}}"),
+        ], 0, 32),
+        panel("Launch join rate (substantive gated >= 0.9)", [
+            ('llm_slo_deviceplane_join_rate', "{{kind}}"),
+        ], 12, 32),
+        panel("Unexplained device-time share (gate <= 0.1)", [
+            ('llm_slo_deviceplane_unexplained_share', "unexplained share"),
+        ], 0, 40, kind="stat"),
+        panel("Launches by join tier", [
+            ('sum(rate(llm_slo_deviceplane_launches_total[5m])) by (tier)', "{{tier}}"),
+        ], 12, 40),
+        panel("Front-door dispatch device-wait p95 (ms)", [
+            ('histogram_quantile(0.95, sum(rate(llm_slo_deviceplane_dispatch_device_wait_ms_bucket[5m])) by (le))', "device wait p95"),
+        ], 0, 48, unit="ms"),
+        panel("Roofline verdicts on serving attributions", [
+            ('sum(rate(llm_slo_deviceplane_roofline_verdicts_total[5m])) by (verdict)', "{{verdict}}"),
+        ], 12, 48),
     ],
 )
 

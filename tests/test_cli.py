@@ -186,9 +186,9 @@ class TestAgentCLI:
         kinds = {l["kind"] for l in lines}
         assert kinds == {"slo", "probe"}
         probes = [l for l in lines if l["kind"] == "probe"]
-        # default config signal_set covers 16 of the 19 signals
+        # default config signal_set covers 18 of the 21 signals
         # (the three counters are opt-in, mirroring the reference default)
-        assert len(probes) == 4 * 16
+        assert len(probes) == 4 * 18
         tpu_probes = [p for p in probes if "tpu" in p]
         assert tpu_probes and tpu_probes[0]["tpu"]["chip"]
 

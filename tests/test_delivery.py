@@ -610,18 +610,20 @@ class TestShedRestoreLifecycle:
             ],
         )
         err = capsys.readouterr().err
-        assert "disabled dcn_transfer_latency_ms" in err
-        assert "re-enabled dcn_transfer_latency_ms" in err
+        # The shed order's new head (ISSUE 14): the device-plane
+        # ledger signals shed before the probe-backed TPU signals.
+        assert "disabled device_idle_gap_ms" in err
+        assert "re-enabled device_idle_gap_ms" in err
         assert sample_value(
             metrics,
             "llm_slo_agent_signals_restored_total",
-            signal="dcn_transfer_latency_ms",
+            signal="device_idle_gap_ms",
         ) == 1
         # The signal is enabled again at the end of the run.
         assert sample_value(
             metrics,
             "llm_slo_agent_signal_enabled",
-            signal="dcn_transfer_latency_ms",
+            signal="device_idle_gap_ms",
         ) == 1
 
 

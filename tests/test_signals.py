@@ -26,11 +26,11 @@ def make_sample(fault="baseline", idx=0):
 class TestConstants:
     def test_signal_counts(self):
         assert len(signals.CPU_SIGNALS) == 12
-        assert len(signals.TPU_SIGNALS) == 7
-        assert len(signals.ALL_SIGNALS) == 19
+        assert len(signals.TPU_SIGNALS) == 9
+        assert len(signals.ALL_SIGNALS) == 21
 
     def test_mode_signal_sets(self):
-        assert len(signals.supported_signals_for_mode(signals.CAPABILITY_TPU_FULL)) == 19
+        assert len(signals.supported_signals_for_mode(signals.CAPABILITY_TPU_FULL)) == 21
         assert len(signals.supported_signals_for_mode(signals.CAPABILITY_CORE_FULL)) == 12
         assert signals.supported_signals_for_mode(signals.CAPABILITY_BCC_DEGRADED) == [
             "dns_latency_ms",
@@ -41,7 +41,7 @@ class TestConstants:
         order = signals.disable_order()
         assert sorted(order) == sorted(signals.ALL_SIGNALS)
         # All TPU signals shed before any kernel probe.
-        assert set(order[:7]) == set(signals.TPU_SIGNALS)
+        assert set(order[:9]) == set(signals.TPU_SIGNALS)
 
     def test_thresholds_and_units_complete(self):
         for name in signals.ALL_SIGNALS:
@@ -50,10 +50,10 @@ class TestConstants:
 
 
 class TestGenerator:
-    def test_tpu_full_emits_19_events(self):
+    def test_tpu_full_emits_21_events(self):
         gen = signals.Generator(signals.CAPABILITY_TPU_FULL, enricher=None)
         events = gen.generate(make_sample(), META)
-        assert len(events) == 19
+        assert len(events) == 21
         for event in events:
             schema.validate(event.to_dict(), schema.SCHEMA_PROBE_EVENT)
 
@@ -97,13 +97,13 @@ class TestGenerator:
     def test_disable_highest_cost_order(self):
         gen = signals.Generator(signals.CAPABILITY_TPU_FULL)
         shed = gen.disable_highest_cost()
-        assert shed == "dcn_transfer_latency_ms"
+        assert shed == "device_idle_gap_ms"
         assert shed not in gen.enabled_signals()
         # Exhaust the full set.
         count = 1
         while gen.disable_highest_cost() is not None:
             count += 1
-        assert count == 19
+        assert count == 21
         assert gen.disable_highest_cost() is None
         assert gen.generate(make_sample(), META) == []
 

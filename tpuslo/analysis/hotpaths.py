@@ -92,9 +92,19 @@ HOT_FUNCTIONS: tuple[tuple[str, str], ...] = (
     # perf_counter-only (outcome timestamps derive from an init-time
     # anchor), and a stray json.dumps/print here stalls every slot.
     ("tpuslo/models/frontdoor.py", "FrontDoorEngine.step"),
+    ("tpuslo/models/frontdoor.py", "FrontDoorEngine._step"),
     ("tpuslo/models/frontdoor.py", "FrontDoorEngine._fill_slots"),
     ("tpuslo/models/frontdoor.py", "FrontDoorEngine._admit"),
     ("tpuslo/models/frontdoor.py", "FrontDoorEngine._admit_batch"),
+    # Device-plane ledger (ISSUE 14): the fold runs over every span of
+    # a capture (thousands per trace) and inside gates/benches; the
+    # per-dispatch ledger note runs once per serving dispatch inside
+    # FrontDoorEngine._step — pure arithmetic, timestamps arrive as
+    # perf_counter deltas, serialization stays in to_dict on the cold
+    # side.
+    ("tpuslo/deviceplane/ledger.py", "build_ledger"),
+    ("tpuslo/deviceplane/ledger.py", "_contained_ops"),
+    ("tpuslo/deviceplane/dispatch.py", "DispatchLedger.note"),
 )
 
 #: (repo-relative module path, dataclass name) pairs that are allocated
@@ -128,6 +138,10 @@ HOT_DATACLASSES: tuple[tuple[str, str], ...] = (
     # Front-door slot/queue records (ISSUE 12): allocated per request,
     # scanned per round boundary by the scheduler.
     ("tpuslo/models/frontdoor.py", "FrontDoorRequest"),
+    # Device-plane ledger records (ISSUE 14): one per module launch.
+    ("tpuslo/deviceplane/ledger.py", "LaunchRecord"),
+    ("tpuslo/deviceplane/ledger.py", "DeviceWindow"),
+    ("tpuslo/deviceplane/ledger.py", "CompileEvent"),
 )
 
 #: The JAX plane the TPL16x trace-discipline rules govern: every file
@@ -157,6 +171,6 @@ JAX_HOT_LOOPS: tuple[tuple[str, str], ...] = (
     ("tpuslo/models/serve.py", "ServeEngine._append_ids"),
     ("tpuslo/models/speculative.py", "SpeculativeEngine.stream"),
     ("tpuslo/models/speculative.py", "SpeculativeEngine.generate_batch"),
-    ("tpuslo/models/frontdoor.py", "FrontDoorEngine.step"),
+    ("tpuslo/models/frontdoor.py", "FrontDoorEngine._step"),
     ("tpuslo/models/frontdoor.py", "FrontDoorEngine._admit"),
 )

@@ -35,6 +35,8 @@ _LABEL_TO_DOMAIN: dict[str, str] = {
     "hbm_pressure": "tpu_hbm",
     "xla_recompile_storm": "xla_compile",
     "host_offload_stall": "host_offload",
+    "preemption_eviction": "tpu_preemption",
+    "noisy_neighbor_cpu": "host_noisy_neighbor",
 }
 
 # Evidence source per TPU signal family for envelope annotations.
@@ -44,6 +46,10 @@ _TPU_EVIDENCE: dict[str, tuple[str, str, float]] = {
     "hbm_pressure": ("hbm_alloc_stall_ms", "libtpu", 60.0),
     "xla_recompile_storm": ("xla_compile_ms", "libtpu", 3200.0),
     "host_offload_stall": ("host_offload_stall_ms", "libtpu", 120.0),
+    "preemption_eviction": (
+        "device_eviction_events_total", "accel_driver", 4.0,
+    ),
+    "noisy_neighbor_cpu": ("cpu_steal_pct", "ebpf", 18.0),
 }
 
 

@@ -58,6 +58,18 @@ deliver >= 2x the goodput and tokens/s of the same streams served
 sequentially through the per-stream SpeculativeEngine, with zero
 steady-state recompiles under jitaudit, host syncs per token within
 the serving ceiling, and the burn-aware admission observable.
+
+``--deviceplane-sweep`` runs the device-plane truth gate
+(``tpuslo.deviceplane.sweep``): seeded synthetic-xprof traces with
+every real-capture join pathology (lane-split ops, anonymous warmups,
+dispatch-only helpers, idle/preemption gaps) are folded through the
+per-launch device-time ledger — buckets must sum to total device time,
+the substantive join rate must hold >= 0.9 and unexplained share
+<= 0.1; every serving-path attribution must carry a schema-valid
+roofline verdict (decode memory-bound, prefill compute-bound); and the
+calibrated heldout suite with the two device-plane fault domains
+(tpu_preemption, host_noisy_neighbor) must hold macro-F1 >= 0.96 at
+full-domain noise sigma 1.0.
 """
 
 from __future__ import annotations
@@ -205,6 +217,27 @@ def build_parser() -> argparse.ArgumentParser:
         help="re-run the whole lane this many times if a wall-clock "
         "gate fails (the lane times real serving on a possibly-"
         "shared box; counter gates are deterministic either way)",
+    )
+    # ---- device-plane truth gate (tpuslo.deviceplane) -----------------
+    p.add_argument(
+        "--deviceplane-sweep",
+        action="store_true",
+        help="run the device-plane truth gate instead of B5/D3/E3: "
+        "seeded synthetic-xprof traces through the per-launch "
+        "device-time ledger (buckets sum to total, substantive join "
+        ">= 0.9, unexplained <= 0.1), roofline verdicts on every "
+        "serving attribution, and the calibrated heldout suite with "
+        "the preemption + noisy-neighbor domains at >= 0.96 macro-F1",
+    )
+    p.add_argument("--deviceplane-seed", type=int, default=1337)
+    p.add_argument("--deviceplane-steps", type=int, default=24)
+    p.add_argument("--deviceplane-heldout-count", type=int, default=25)
+    p.add_argument(
+        "--deviceplane-skip-heldout",
+        action="store_true",
+        help="skip the heldout lane's noise sweep (the ledger and "
+        "roofline lanes still run, including the one shared "
+        "calibrated fit)",
     )
     # ---- fleet observability-plane gate (tpuslo.fleet) ----------------
     p.add_argument(
@@ -668,6 +701,104 @@ def run_chaos_gate(args) -> int:
     return 0 if report.passed else 1
 
 
+def render_deviceplane_markdown(report) -> str:
+    lines = [
+        "# Device-plane truth gate (ledger + roofline + heldout)",
+        "",
+        f"**Overall: {'PASS' if report.passed else 'FAIL'}**",
+        "",
+        f"- seed: {report.seed}",
+        "",
+        "## Ledger (synthetic-xprof lane)",
+        "",
+        "| variant | launches | substantive join | raw join | "
+        "unexplained share | idle gap (ms) | buckets sum |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for run in report.ledger_runs:
+        led = run["ledger"]
+        buckets = led["buckets_ms"]
+        lines.append(
+            f"| {run['variant']} | {led['launches']} "
+            f"| {led['substantive_join_rate']:.4f} "
+            f"| {led['raw_join_rate']:.4f} "
+            f"| {led['unexplained_share']:.4f} "
+            f"| {buckets.get('idle_gap', 0.0):.1f} "
+            f"| {led['bucket_sum_ms']:.1f}/{led['total_device_time_ms']:.1f} |"
+        )
+    decode = report.roofline.get("decode") or {}
+    prefill = report.roofline.get("prefill") or {}
+    attributions = report.roofline.get("attributions") or {}
+    lines += [
+        "",
+        "## Roofline",
+        "",
+        f"- decode: {decode.get('verdict', '?')} "
+        f"({decode.get('hbm_bw_pct', 0)}% of HBM roof, "
+        f"MFU {decode.get('mfu_pct', 0)}%)",
+        f"- prefill: {prefill.get('verdict', '?')} "
+        f"(MFU {prefill.get('mfu_pct', 0)}%, "
+        f"{prefill.get('hbm_bw_pct', 0)}% of HBM roof)",
+        f"- serving attributions with verdict: "
+        f"{attributions.get('with_verdict', 0)}/"
+        f"{attributions.get('total', 0)} "
+        f"(top-1 correct {attributions.get('top1_correct', 0)})",
+    ]
+    if report.heldout:
+        lines += [
+            "",
+            "## Heldout (with tpu_preemption + host_noisy_neighbor)",
+            "",
+            f"- full-domain macro-F1: {report.heldout.get('full_domain')}",
+            f"- new-domain F1 at sigma 1.0: "
+            f"{report.heldout.get('new_domain_f1')}",
+        ]
+    if report.failures:
+        lines += ["", "## Failures", ""]
+        lines += [f"- {f}" for f in report.failures]
+    return "\n".join(lines) + "\n"
+
+
+def run_deviceplane_gate(args) -> int:
+    from tpuslo.deviceplane.sweep import run_deviceplane_sweep
+
+    report = run_deviceplane_sweep(
+        seed=args.deviceplane_seed,
+        steps=args.deviceplane_steps,
+        heldout_count=args.deviceplane_heldout_count,
+        skip_heldout=args.deviceplane_skip_heldout,
+    )
+    if args.summary_json:
+        Path(args.summary_json).write_text(
+            json.dumps(report.to_dict(), indent=2) + "\n"
+        )
+    if args.summary_md:
+        Path(args.summary_md).write_text(
+            render_deviceplane_markdown(report)
+        )
+    for run in report.ledger_runs:
+        led = run["ledger"]
+        print(
+            f"m5gate: deviceplane {run['variant']}: substantive "
+            f"join {led['substantive_join_rate']:.4f} (raw "
+            f"{led['raw_join_rate']:.4f}), unexplained "
+            f"{led['unexplained_share']:.4f}",
+            file=sys.stderr,
+        )
+    if report.heldout:
+        print(
+            "m5gate: deviceplane heldout full-domain "
+            f"{report.heldout.get('full_domain')}",
+            file=sys.stderr,
+        )
+    print(
+        f"m5gate: deviceplane-sweep {'PASS' if report.passed else 'FAIL'}"
+        + ("" if report.passed else f" ({'; '.join(report.failures)})"),
+        file=sys.stderr,
+    )
+    return 0 if report.passed else 1
+
+
 def render_markdown(summary: releasegate.Summary) -> str:
     lines = [
         "# M5 release gate summary",
@@ -779,6 +910,8 @@ def main(argv: list[str] | None = None) -> int:
         return run_remediation_gate(args)
     if args.frontdoor_bench:
         return run_frontdoor_gate(args)
+    if args.deviceplane_sweep:
+        return run_deviceplane_gate(args)
     if args.fleet_sweep:
         return run_fleet_gate(args)
     if args.crash_sweep:

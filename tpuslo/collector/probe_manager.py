@@ -199,6 +199,20 @@ class ProbeManager:
                     )
                 )
                 continue
+            if name in (
+                sig.SIGNAL_DEVICE_IDLE_GAP_MS,
+                sig.SIGNAL_DEVICE_EVICTION_EVENTS,
+            ):
+                plans.append(
+                    ProbePlan(
+                        signal=name,
+                        kind="sampler",
+                        status="sampler",
+                        detail="sampled from the device-plane ledger "
+                        "(tpuslo/deviceplane/ledger.py)",
+                    )
+                )
+                continue
             if name in _KERNEL_OBJECTS:
                 obj = _KERNEL_OBJECTS[name]
                 plan = ProbePlan(signal=name, object_file=obj, kind="auto")

@@ -34,6 +34,8 @@ DEFAULT_SIGNAL_SET = [
     "ici_collective_latency_ms",
     "host_offload_stall_ms",
     "dcn_transfer_latency_ms",
+    "device_idle_gap_ms",
+    "device_eviction_events_total",
 ]
 
 

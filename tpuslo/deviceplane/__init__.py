@@ -1,0 +1,73 @@
+"""Device-plane truth: per-launch device-time ledger + roofline verdicts.
+
+The observability layer that accounts for every nanosecond of device
+time and attaches an actionable verdict to it (ROADMAP #3 / ISSUE 14):
+
+* :mod:`tpuslo.deviceplane.ledger` — tiered joins over xprof spans
+  (exact identity → compile-event attribution → thread-lane windowed
+  recovery → per-step frames) folding every module launch into exactly
+  one bucket (joined / helper / compile / idle-gap / unexplained), with
+  the buckets provably summing to total device time;
+* :mod:`tpuslo.deviceplane.roofline` — per-launch bytes/FLOP estimates
+  folded into a memory- vs compute-bound verdict against the chip's
+  public HBM and MXU roofs, attached to serving-path attributions;
+* :mod:`tpuslo.deviceplane.synthetic` — seeded synthetic-xprof traces
+  (trace-viewer JSON, parsed through the REAL
+  ``xla_spans.parse_trace_events`` path) so the ledger is gated
+  off-chip;
+* :mod:`tpuslo.deviceplane.sweep` — the release gate
+  (``m5gate --deviceplane-sweep``).
+"""
+
+from tpuslo.deviceplane.dispatch import DispatchLedger
+from tpuslo.deviceplane.ledger import (
+    BUCKET_COMPILE,
+    BUCKET_HELPER,
+    BUCKET_IDLE_GAP,
+    BUCKET_JOINED,
+    BUCKET_UNEXPLAINED,
+    TIER_COMPILE_EVENT,
+    TIER_FRAME,
+    TIER_IDENTITY,
+    TIER_LANE_WINDOW,
+    CompileEvent,
+    DeviceLedger,
+    DeviceWindow,
+    LaunchRecord,
+    build_ledger,
+)
+from tpuslo.deviceplane.roofline import (
+    VERDICT_COMPUTE_BOUND,
+    VERDICT_MEMORY_BOUND,
+    attach_roofline,
+    decode_step_cost,
+    roofline_verdict,
+)
+from tpuslo.deviceplane.sweep import DeviceplaneReport, run_deviceplane_sweep
+from tpuslo.deviceplane.synthetic import synthesize_xprof_trace
+
+__all__ = [
+    "BUCKET_COMPILE",
+    "BUCKET_HELPER",
+    "BUCKET_IDLE_GAP",
+    "BUCKET_JOINED",
+    "BUCKET_UNEXPLAINED",
+    "TIER_COMPILE_EVENT",
+    "TIER_FRAME",
+    "TIER_IDENTITY",
+    "TIER_LANE_WINDOW",
+    "CompileEvent",
+    "DeviceLedger",
+    "DeviceWindow",
+    "DeviceplaneReport",
+    "DispatchLedger",
+    "LaunchRecord",
+    "VERDICT_COMPUTE_BOUND",
+    "VERDICT_MEMORY_BOUND",
+    "attach_roofline",
+    "build_ledger",
+    "decode_step_cost",
+    "roofline_verdict",
+    "run_deviceplane_sweep",
+    "synthesize_xprof_trace",
+]

@@ -1,0 +1,156 @@
+"""Roofline verdicts: is this launch memory- or compute-bound?
+
+Decode MFU ~1% read as "terrible" in the round-4 evidence while the
+same number was ~30% of the HBM roof — the regressions that matter in
+serving are memory-bound, and an attribution that names a fault domain
+without saying WHICH roof the workload sits under leaves the operator
+guessing at the fix (more batch? fewer bytes? faster dispatch?).  This
+module folds per-launch bytes and FLOP estimates into a verdict
+against the chip's public roofs (v5e: 819 GB/s HBM, 197 TFLOP/s bf16)
+and attaches it to serving-path ``IncidentAttribution`` as the
+``roofline`` schema block ``sloctl explain`` renders.
+
+The verdict rule is the classical roofline: achieved fractions of each
+roof are compared — the binding roof is the one the launch uses the
+larger fraction of.  ``detail`` spells out the actionable reading
+(memory-bound decode leaves MFU meaningless; compute-bound prefill
+leaves bandwidth meaningless).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from tpuslo.deviceplane.ledger import DeviceLedger
+
+VERDICT_MEMORY_BOUND = "memory_bound"
+VERDICT_COMPUTE_BOUND = "compute_bound"
+
+#: v5e public roofs — the flagship serving chip of the evidence runs.
+#: Other chips resolve through the serving-bench tables at call time.
+V5E_PEAK_HBM_BW = 819e9
+V5E_PEAK_BF16_FLOPS = 197e12
+
+
+def peaks_for_chip(device_kind: str = "v5e") -> tuple[float, float]:
+    """(HBM bytes/s, bf16 FLOP/s) roofs for a device kind — resolved
+    through the serving bench's public-spec tables (single source)."""
+    from tpuslo.benchmark.serving_bench import (
+        PEAK_BF16_FLOPS,
+        PEAK_HBM_BW,
+        _lookup,
+    )
+
+    bw = _lookup(PEAK_HBM_BW, device_kind) or V5E_PEAK_HBM_BW
+    flops = _lookup(PEAK_BF16_FLOPS, device_kind) or V5E_PEAK_BF16_FLOPS
+    return bw, flops
+
+
+def decode_step_cost(
+    n_params: float,
+    kv_cache_bytes: float,
+    batch: int = 1,
+    param_bytes: float = 2.0,
+) -> tuple[float, float]:
+    """(bytes, FLOPs) one decode step must move/compute.
+
+    Bytes: weights stream once per step regardless of batch; the dense
+    cache reads its FULL allocation every step (same accounting as
+    ``serving_bench.decode_step_hbm_bytes``).  FLOPs: 2 MACs per
+    parameter per token, ``batch`` tokens per step.
+    """
+    step_bytes = n_params * param_bytes + kv_cache_bytes
+    step_flops = 2.0 * n_params * batch
+    return step_bytes, step_flops
+
+
+def roofline_verdict(
+    device_time_ms: float,
+    bytes_moved: float,
+    flops: float,
+    peak_bw: float = V5E_PEAK_HBM_BW,
+    peak_flops: float = V5E_PEAK_BF16_FLOPS,
+    launch_name: str = "",
+) -> dict[str, Any]:
+    """Fold one launch's cost estimate into a schema-ready verdict.
+
+    ``device_time_ms`` is the launch's measured device time (ledger
+    ``joined`` time for the program); ``bytes_moved``/``flops`` the
+    cost model's estimate for one execution.
+    """
+    seconds = max(device_time_ms, 1e-6) / 1e3
+    achieved_bw = bytes_moved / seconds
+    achieved_flops = flops / seconds
+    bw_frac = achieved_bw / peak_bw if peak_bw else 0.0
+    flop_frac = achieved_flops / peak_flops if peak_flops else 0.0
+    memory_bound = bw_frac >= flop_frac
+    verdict = VERDICT_MEMORY_BOUND if memory_bound else VERDICT_COMPUTE_BOUND
+    bound_pct = 100.0 * max(bw_frac, flop_frac)
+    if memory_bound:
+        detail = (
+            f"memory-bound: {100 * bw_frac:.1f}% of the "
+            f"{peak_bw / 1e9:.0f} GB/s HBM roof vs "
+            f"{100 * flop_frac:.1f}% MFU — MFU is the wrong lens here; "
+            "headroom means underfilled DMAs or dispatch overhead, and "
+            "the levers are bytes/step (quantized KV/weights) or batch"
+        )
+    else:
+        detail = (
+            f"compute-bound: {100 * flop_frac:.1f}% MFU vs "
+            f"{100 * bw_frac:.1f}% of the HBM roof — the MXU is the "
+            "wall; the levers are FLOPs/token (shorter context, "
+            "sparsity) or a bigger chip"
+        )
+    out: dict[str, Any] = {
+        "verdict": verdict,
+        "achieved_gb_per_sec": round(achieved_bw / 1e9, 2),
+        "peak_gb_per_sec": round(peak_bw / 1e9, 1),
+        "hbm_bw_pct": round(100.0 * bw_frac, 2),
+        "mfu_pct": round(100.0 * flop_frac, 2),
+        "bound_pct": round(bound_pct, 2),
+        "device_time_ms": round(device_time_ms, 4),
+        "detail": detail,
+    }
+    if launch_name:
+        out["launch"] = launch_name
+    return out
+
+
+def verdict_from_ledger(
+    ledger: DeviceLedger,
+    bytes_per_step: float,
+    flops_per_step: float,
+    program_id: str = "",
+    peak_bw: float = V5E_PEAK_HBM_BW,
+    peak_flops: float = V5E_PEAK_BF16_FLOPS,
+) -> dict[str, Any] | None:
+    """Roofline verdict for the ledger's serving program.
+
+    Uses the MEAN joined device time per launch of ``program_id`` (or
+    of every joined launch when unset) so one stalled step does not
+    masquerade as a bandwidth collapse; returns None when the ledger
+    joined nothing (no device-time denominator — never invent one).
+    """
+    times = [
+        rec.duration_us / 1e3
+        for rec in ledger.launches
+        if rec.bucket == "joined"
+        and (not program_id or rec.program_id == program_id)
+    ]
+    if not times:
+        return None
+    mean_ms = sum(times) / len(times)
+    name = program_id or "joined-launch-mean"
+    out = roofline_verdict(
+        mean_ms, bytes_per_step, flops_per_step,
+        peak_bw=peak_bw, peak_flops=peak_flops, launch_name=name,
+    )
+    out["launches"] = len(times)
+    return out
+
+
+def attach_roofline(attribution: Any, verdict: dict[str, Any]) -> Any:
+    """Attach a verdict block to an ``IncidentAttribution`` (the
+    ``roofline`` contract block, TPL101/102-governed)."""
+    attribution.roofline = dict(verdict)
+    return attribution

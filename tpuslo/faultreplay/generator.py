@@ -26,6 +26,8 @@ _SCENARIO_LABELS: dict[str, tuple[str, ...]] = {
     "hbm_pressure": ("hbm_pressure",),
     "xla_recompile_storm": ("xla_recompile_storm",),
     "host_offload_stall": ("host_offload_stall",),
+    "preemption_eviction": ("preemption_eviction",),
+    "noisy_neighbor_cpu": ("noisy_neighbor_cpu",),
     "mixed": (
         "provider_throttle",
         "dns_latency",

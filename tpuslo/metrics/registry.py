@@ -404,6 +404,51 @@ class AgentMetrics:
             "(EMA; the tracer degrades to metrics-only past its budget)",
             registry=self.registry,
         )
+        # ---- device-plane ledger series (tpuslo.deviceplane) ----------
+        self.deviceplane_device_time_ms = Counter(
+            "llm_slo_deviceplane_device_time_ms_total",
+            "Device time folded by the per-launch ledger, by bucket "
+            "(joined/helper/compile/idle_gap/unexplained) — the five "
+            "buckets sum to total observed device time",
+            ["bucket"],
+            registry=self.registry,
+        )
+        self.deviceplane_launches = Counter(
+            "llm_slo_deviceplane_launches_total",
+            "Module launches attributed by the ledger, by join tier "
+            "(identity/lane_window/compile_event/frame)",
+            ["tier"],
+            registry=self.registry,
+        )
+        self.deviceplane_join_rate = Gauge(
+            "llm_slo_deviceplane_join_rate",
+            "Launch->signal join rate from the last ledger fold, by "
+            "kind (raw = exact identity over ALL launches, reported "
+            "only; substantive = tiered rate over ops-bearing "
+            "launches, gated >= 0.9)",
+            ["kind"],
+            registry=self.registry,
+        )
+        self.deviceplane_unexplained_share = Gauge(
+            "llm_slo_deviceplane_unexplained_share",
+            "Share of device time the ledger could not attribute "
+            "(gated <= 0.1 on the synthetic lane)",
+            registry=self.registry,
+        )
+        self.deviceplane_dispatch_device_wait_ms = Histogram(
+            "llm_slo_deviceplane_dispatch_device_wait_ms",
+            "Per-dispatch device-busy proxy from the serving front "
+            "door (fused-read wait time)",
+            buckets=(0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500),
+            registry=self.registry,
+        )
+        self.deviceplane_roofline_verdicts = Counter(
+            "llm_slo_deviceplane_roofline_verdicts_total",
+            "Roofline verdicts attached to serving-path attributions, "
+            "by verdict (memory_bound/compute_bound)",
+            ["verdict"],
+            registry=self.registry,
+        )
 
     def set_enabled_signals(self, enabled: list[str]) -> None:
         enabled_set = set(enabled)
@@ -512,6 +557,11 @@ class AgentMetrics:
         """Observer adapter wiring a RemediationEngine to this registry
         (duck-typed against tpuslo.remediation.RemediationObserver)."""
         return _PromRemediationObserver(self)
+
+    def deviceplane_observer(self) -> "_PromDeviceplaneObserver":
+        """Observer adapter wiring device-plane ledger folds, serving
+        dispatches, and roofline attachments to this registry."""
+        return _PromDeviceplaneObserver(self)
 
 
 _BREAKER_STATE_VALUES = {"closed": 0, "half_open": 1, "open": 2}
@@ -801,6 +851,41 @@ class _PromRemediationObserver:
 
     def refused(self, reason: str) -> None:
         self._m.remediation_refusals.labels(reason=reason).inc()
+
+
+class _PromDeviceplaneObserver:
+    """Bridge from device-plane ledger folds to Prometheus."""
+
+    def __init__(self, metrics: AgentMetrics):
+        self._m = metrics
+
+    def ledger_folded(self, ledger) -> None:
+        """Publish one :class:`tpuslo.deviceplane.DeviceLedger` fold."""
+        for bucket, us in ledger.buckets_us.items():
+            self._m.deviceplane_device_time_ms.labels(bucket=bucket).inc(
+                us / 1000.0
+            )
+        for tier, count in ledger.tier_counts.items():
+            self._m.deviceplane_launches.labels(tier=tier).inc(count)
+        self._m.deviceplane_join_rate.labels(kind="raw").set(
+            ledger.raw_join_rate
+        )
+        self._m.deviceplane_join_rate.labels(kind="substantive").set(
+            ledger.substantive_join_rate
+        )
+        self._m.deviceplane_unexplained_share.set(
+            ledger.unexplained_share
+        )
+
+    def dispatch_observed(self, device_wait_ms: float) -> None:
+        self._m.deviceplane_dispatch_device_wait_ms.observe(
+            device_wait_ms
+        )
+
+    def roofline_attached(self, verdict: str) -> None:
+        self._m.deviceplane_roofline_verdicts.labels(
+            verdict=verdict
+        ).inc()
 
 
 class Readiness:

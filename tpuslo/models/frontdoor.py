@@ -53,6 +53,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpuslo.deviceplane.dispatch import DispatchLedger
 from tpuslo.models.batching import (
     _SHARED_EXTRACT,
     _SHARED_INJECT,
@@ -71,6 +72,7 @@ from tpuslo.models.speculative import (
     _shared_spec_multi_round_fn,
     joint_prompt_ids,
 )
+from tpuslo.obs.tracer import _NULL_CYCLE
 
 # The ONE admission-priority scale: the sloengine remediation surface
 # owns it (demote_tenant writes these values), the front door only
@@ -176,6 +178,7 @@ class FrontDoorEngine:
         rounds_per_step: int = 2,
         burn_engine=None,
         observer: FrontDoorObserver | None = None,
+        self_tracer=None,
     ):
         if k < 1:
             raise ValueError("k must be >= 1")
@@ -193,6 +196,13 @@ class FrontDoorEngine:
         self.rounds_per_step = rounds_per_step
         self._burn = burn_engine
         self._observer = observer or FrontDoorObserver()
+        # Self-observability (PR 5 machinery, no new tracer): when an
+        # obs SelfTracer is passed, every step() emits a root span with
+        # admit/dispatch/read/retire children, tail-sampled exactly
+        # like agent cycles.  The per-dispatch ledger runs either way —
+        # its device-wait proxy is 3 perf_counter reads per step.
+        self._tracer = self_tracer
+        self.dispatch_ledger = DispatchLedger()
         # ONE memoized fused multi-round program per (cfg_t, cfg_d, k,
         # rounds); the (max_slots,) batch axis keys its own executable
         # inside it — i.e. one compile per (cfg_t, cfg_d, k,
@@ -677,66 +687,106 @@ class FrontDoorEngine:
     def step(self) -> bool:
         """Admit waiting requests, then run ONE fused multi-round
         dispatch across every occupied slot (fixed shapes, one fused
-        device read).  Returns True while any work remains."""
-        self._fill_slots()
+        device read).  Returns True while any work remains.
+
+        With a ``self_tracer`` the step emits a root span with
+        admit/dispatch/read/retire children and the per-dispatch
+        ledger totals as span attrs, tail-sampled like agent cycles.
+        """
+        if self._tracer is not None:
+            with self._tracer.cycle(
+                "frontdoor.step",
+                queued=len(self._queue),
+                rounds=self.rounds,
+            ) as cycle:
+                return self._step(cycle)
+        return self._step(_NULL_CYCLE)
+
+    def _step(self, cycle) -> bool:
+        with cycle.stage("admit"):
+            self._fill_slots()
         mask = np.asarray(
             [occupant is not None for occupant in self._slots]
         )
         if not mask.any():
             return bool(self._queue)
         audit = _audit_registry()
+        t0 = time.perf_counter_ns()
         with _steady_section(audit, "frontdoor.step", self.rounds >= 1):
-            draft_toks, preds, accepted, current, cache_t, cache_d = (
-                self._round(
-                    self.target.params, self.draft.params,
-                    self._tokens, self._cache_t, self._cache_d,
-                    jnp.asarray(self._start, jnp.int32),
-                    jnp.asarray(mask, jnp.bool_),
+            with cycle.stage("dispatch"):
+                draft_toks, preds, accepted, current, cache_t, cache_d = (
+                    self._round(
+                        self.target.params, self.draft.params,
+                        self._tokens, self._cache_t, self._cache_d,
+                        jnp.asarray(self._start, jnp.int32),
+                        jnp.asarray(mask, jnp.bool_),
+                    )
                 )
-            )
-            drafts, picks, acc = jax.device_get(
-                (draft_toks, preds, accepted)
-            )
+            t1 = time.perf_counter_ns()
+            with cycle.stage("read"):
+                drafts, picks, acc = jax.device_get(
+                    (draft_toks, preds, accepted)
+                )
+            t2 = time.perf_counter_ns()
         self._cache_t, self._cache_d = cache_t, cache_d
         self._tokens = current
         self.rounds += 1
         now_s = time.perf_counter()
-        for slot, req in enumerate(self._slots):
-            if req is None:
-                continue
-            # Consume the dispatch's sub-rounds in order; a row that
-            # finishes mid-dispatch discards its remaining sub-rounds
-            # (the device decoded them as parked-lane garbage).  The
-            # host frontier/current mirrors advance only while the row
-            # continues, so a CONTINUING row's mirrors exactly match
-            # the device state — which is all parking needs.
-            done = False
-            for r in range(self.rounds_per_step):
-                n = int(acc[slot, r])
-                emitted = [int(v) for v in drafts[slot, r, :n]] + [
-                    int(picks[slot, r, n])
-                ]
-                self.slot_rounds += 1
-                self.accepted_draft_tokens += n
-                self._start[slot] += n + 1
-                self._current[slot] = emitted[-1]
-                for token in emitted:
-                    req.tokens.append(token)
-                    if req.stop_at_eos and token == EOS:
-                        done = True
+        appended = 0
+        with cycle.stage("retire") as retire:
+            for slot, req in enumerate(self._slots):
+                if req is None:
+                    continue
+                # Consume the dispatch's sub-rounds in order; a row that
+                # finishes mid-dispatch discards its remaining sub-rounds
+                # (the device decoded them as parked-lane garbage).  The
+                # host frontier/current mirrors advance only while the row
+                # continues, so a CONTINUING row's mirrors exactly match
+                # the device state — which is all parking needs.
+                done = False
+                for r in range(self.rounds_per_step):
+                    n = int(acc[slot, r])
+                    emitted = [int(v) for v in drafts[slot, r, :n]] + [
+                        int(picks[slot, r, n])
+                    ]
+                    self.slot_rounds += 1
+                    self.accepted_draft_tokens += n
+                    self._start[slot] += n + 1
+                    self._current[slot] = emitted[-1]
+                    for token in emitted:
+                        req.tokens.append(token)
+                        appended += 1
+                        if req.stop_at_eos and token == EOS:
+                            done = True
+                            break
+                        if len(req.tokens) >= req.max_new_tokens:
+                            done = True
+                            break
+                    if done:
                         break
-                    if len(req.tokens) >= req.max_new_tokens:
-                        done = True
-                        break
+                if not done and self._start[slot] >= self._limit:
+                    # Defensive: admission clamps budgets so the frontier
+                    # cannot cross the dispatch-write limit mid-request.
+                    done = True
                 if done:
-                    break
-            if not done and self._start[slot] >= self._limit:
-                # Defensive: admission clamps budgets so the frontier
-                # cannot cross the dispatch-write limit mid-request.
-                done = True
-            if done:
-                self._slots[slot] = None
-                self._complete(req, now_s)
+                    self._slots[slot] = None
+                    self._complete(req, now_s)
+            # Device-time truth per dispatch: the fused read blocks
+            # until the device finishes the chained rounds, so the
+            # read-wait is the device-busy proxy (see
+            # tpuslo.deviceplane.dispatch).  Totals ride the span —
+            # built only when a tracer is wired; the untraced hot loop
+            # pays the three perf_counter reads and nothing else.
+            self.dispatch_ledger.note(
+                t1 - t0, t2 - t1, appended, int(mask.sum())
+            )
+            if self._tracer is not None:
+                retire.set(
+                    **self.dispatch_ledger.last(),
+                    device_wait_ms_total=round(
+                        self.dispatch_ledger.device_wait_ms_total, 3
+                    ),
+                )
         return bool(self._queue) or any(
             occupant is not None for occupant in self._slots
         )
@@ -823,6 +873,7 @@ class FrontDoorEngine:
             "resumes": self.resumes,
             "snapshot_resumes": self.snapshot_resumes,
             "shed": dict(self.shed_by_reason),
+            "dispatch_ledger": self.dispatch_ledger.totals(),
         }
 
     # ---- snapshot / restore (crash-safe runtime) ------------------------

@@ -90,6 +90,10 @@ class ProvenanceRecord:
     #: Fleet rollup only: the blast radius of the collapsed page
     #: (pod/node/slice/fleet); empty for single-node incidents.
     blast_radius: str = ""
+    #: Device-plane roofline verdict for the serving program behind
+    #: this incident (tpuslo.deviceplane.roofline block: memory- vs
+    #: compute-bound, achieved vs peak bandwidth/MFU).
+    roofline: dict[str, Any] = field(default_factory=dict)
     #: Auto-remediation actions taken on this incident, in decision
     #: order (``RemediationEngine`` action-record dicts: action id,
     #: kind, target, phase, verify verdict, rollback detail).  The
@@ -115,6 +119,7 @@ class ProvenanceRecord:
             "burning": [dict(b) for b in self.burning],
             "members": [dict(m) for m in self.members],
             "blast_radius": self.blast_radius,
+            "roofline": dict(self.roofline),
             "remediation": [dict(r) for r in self.remediation],
         }
 
@@ -155,6 +160,7 @@ class ProvenanceRecord:
                 if isinstance(m, dict)
             ],
             blast_radius=str(raw.get("blast_radius", "")),
+            roofline=dict(raw.get("roofline") or {}),
             remediation=[
                 dict(r)
                 for r in (raw.get("remediation") or [])
@@ -307,6 +313,21 @@ def format_chain(rec: ProvenanceRecord) -> str:
         lines.append(f"  3. fault-domain posterior: {chain}")
     else:
         lines.append("  3. fault-domain posterior: (not recorded)")
+
+    if rec.roofline:
+        roof = rec.roofline
+        lines.append(
+            "  roofline: {verdict} — {bw:.1f} GB/s achieved "
+            "({bw_pct:.1f}% of HBM roof), MFU {mfu:.1f}%".format(
+                verdict=roof.get("verdict", "?"),
+                bw=float(roof.get("achieved_gb_per_sec", 0.0)),
+                bw_pct=float(roof.get("hbm_bw_pct", 0.0)),
+                mfu=float(roof.get("mfu_pct", 0.0)),
+            )
+        )
+        detail = roof.get("detail", "")
+        if detail:
+            lines.append(f"    {detail}")
 
     if rec.burning:
         for burn in rec.burning:

@@ -158,6 +158,11 @@ class IncidentAttribution:
     #: tenant/objective/state/burn_rates/budget_remaining).  Webhook
     #: severity escalates on a fast burn.
     slo_burn: dict[str, Any] | None = None
+    #: Device-plane roofline verdict (tpuslo.deviceplane.roofline):
+    #: memory- vs compute-bound for the serving program behind the
+    #: incident, with achieved vs peak HBM bandwidth and MFU — the
+    #: lens that says which lever actually fixes the regression.
+    roofline: dict[str, Any] | None = None
 
     def to_dict(self) -> dict[str, Any]:
         out: dict[str, Any] = {
@@ -182,6 +187,8 @@ class IncidentAttribution:
             out["provenance"] = dict(self.provenance)
         if self.slo_burn:
             out["slo_burn"] = dict(self.slo_burn)
+        if self.roofline:
+            out["roofline"] = dict(self.roofline)
         return out
 
 

@@ -43,6 +43,15 @@ SIGNAL_HOST_OFFLOAD_STALL_MS = "host_offload_stall_ms"
 # signals: DCN rides the data-center ethernet fabric between slices, so
 # its failure physiology pairs with TCP retransmits, not link retries.
 SIGNAL_DCN_TRANSFER_MS = "dcn_transfer_latency_ms"
+# Per-window device idle-gap time from the device-plane ledger
+# (tpuslo/deviceplane): wall time inside the observation window where
+# the chip ran NO launch at all.  A preempted/evicted device shows a
+# huge gap; a starved dispatch thread (noisy-neighbor host CPU) shows a
+# creeping one.  Sampled from the ledger, not probed.
+SIGNAL_DEVICE_IDLE_GAP_MS = "device_idle_gap_ms"
+# Per-window count of device preemption/eviction notices (maintenance
+# events, device re-init after the runtime lost the chip).
+SIGNAL_DEVICE_EVICTION_EVENTS = "device_eviction_events_total"
 
 CPU_SIGNALS: tuple[str, ...] = (
     SIGNAL_DNS_LATENCY_MS,
@@ -67,6 +76,8 @@ TPU_SIGNALS: tuple[str, ...] = (
     SIGNAL_ICI_COLLECTIVE_MS,
     SIGNAL_HOST_OFFLOAD_STALL_MS,
     SIGNAL_DCN_TRANSFER_MS,
+    SIGNAL_DEVICE_IDLE_GAP_MS,
+    SIGNAL_DEVICE_EVICTION_EVENTS,
 )
 
 ALL_SIGNALS: tuple[str, ...] = CPU_SIGNALS + TPU_SIGNALS
@@ -96,6 +107,10 @@ _BCC_SIGNAL_SET: tuple[str, ...] = (
 # depth degrades attribution less than losing the kernel spine entirely.
 # The CPU tail mirrors reference ``constants.go:46-59``.
 HIGH_COST_DISABLE_ORDER: tuple[str, ...] = (
+    # The device-plane ledger signals are sampled (no probe cost), but
+    # producing them requires an xprof/ledger pass — shed that first.
+    SIGNAL_DEVICE_IDLE_GAP_MS,
+    SIGNAL_DEVICE_EVICTION_EVENTS,
     SIGNAL_DCN_TRANSFER_MS,
     SIGNAL_ICI_COLLECTIVE_MS,
     SIGNAL_HBM_ALLOC_STALL_MS,

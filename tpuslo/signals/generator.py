@@ -44,6 +44,8 @@ SIGNAL_THRESHOLDS: dict[str, tuple[float, float]] = {
     sig.SIGNAL_ICI_COLLECTIVE_MS: (10, 30),
     sig.SIGNAL_HOST_OFFLOAD_STALL_MS: (20, 80),
     sig.SIGNAL_DCN_TRANSFER_MS: (25, 80),
+    sig.SIGNAL_DEVICE_IDLE_GAP_MS: (25, 100),
+    sig.SIGNAL_DEVICE_EVICTION_EVENTS: (1, 3),
 }
 
 SIGNAL_UNITS: dict[str, str] = {
@@ -66,6 +68,8 @@ SIGNAL_UNITS: dict[str, str] = {
     sig.SIGNAL_ICI_COLLECTIVE_MS: "ms",
     sig.SIGNAL_HOST_OFFLOAD_STALL_MS: "ms",
     sig.SIGNAL_DCN_TRANSFER_MS: "ms",
+    sig.SIGNAL_DEVICE_IDLE_GAP_MS: "ms",
+    sig.SIGNAL_DEVICE_EVICTION_EVENTS: "count",
 }
 
 # Signals that carry a network flow tuple.
@@ -101,6 +105,8 @@ _BASE_PROFILE: dict[str, float] = {
     sig.SIGNAL_ICI_COLLECTIVE_MS: 3.5,
     sig.SIGNAL_HOST_OFFLOAD_STALL_MS: 1.5,
     sig.SIGNAL_DCN_TRANSFER_MS: 8.0,
+    sig.SIGNAL_DEVICE_IDLE_GAP_MS: 2.0,
+    sig.SIGNAL_DEVICE_EVICTION_EVENTS: 0,
 }
 
 # Fault label -> (signal overrides, connect errno).
@@ -190,6 +196,36 @@ _FAULT_OVERRIDES: dict[str, tuple[dict[str, float], int]] = {
             sig.SIGNAL_HOST_OFFLOAD_STALL_MS: 120,
             sig.SIGNAL_DISK_IO_LATENCY_MS: 40,
             sig.SIGNAL_SYSCALL_LATENCY_MS: 80,
+        },
+        0,
+    ),
+    # preemption_eviction — the chip is preempted/evicted out from
+    # under the serving process: the runtime posts eviction notices and
+    # the device-plane ledger shows a massive idle gap while the host
+    # re-acquires the device.  The restart recompiles warm xla_compile
+    # only mildly (sub-warning — the separator from a recompile storm),
+    # and ICI/HBM stay clean (the separators from the fabric domains).
+    "preemption_eviction": (
+        {
+            sig.SIGNAL_DEVICE_EVICTION_EVENTS: 4,
+            sig.SIGNAL_DEVICE_IDLE_GAP_MS: 420,
+            sig.SIGNAL_XLA_COMPILE_MS: 380,
+            sig.SIGNAL_HOST_OFFLOAD_STALL_MS: 6,
+        },
+        0,
+    ),
+    # noisy_neighbor_cpu — another tenant's burst starves this host's
+    # vCPUs: steal and runqueue delay explode WITHOUT cgroup quota
+    # throttling (cfs_throttled stays at baseline — the separator from
+    # cpu_throttle, whose physiology is the quota).  The starved
+    # dispatch thread cannot feed the chip, so the ledger's idle gap
+    # creeps past warning — host-plane cause, device-plane symptom.
+    "noisy_neighbor_cpu": (
+        {
+            sig.SIGNAL_CPU_STEAL_PCT: 18,
+            sig.SIGNAL_RUNQUEUE_DELAY_MS: 32,
+            sig.SIGNAL_DEVICE_IDLE_GAP_MS: 60,
+            sig.SIGNAL_SYSCALL_LATENCY_MS: 70,
         },
         0,
     ),
