@@ -12,6 +12,7 @@ ARTIFACTS ?= artifacts
 	chaos-smoke chaos-demo chaos-telemetry-smoke \
 	chaos-telemetry-sweep crash-smoke crash-sweep obs-smoke \
 	burn-smoke burn-sweep fleet-smoke fleet-sweep \
+	federation-smoke federation-sweep \
 	remediation-smoke remediation-sweep \
 	frontdoor-smoke frontdoor-bench \
 	deviceplane-smoke deviceplane-sweep \
@@ -284,6 +285,28 @@ fleet-sweep:
 		--summary-json $(ARTIFACTS)/fleet/sweep.json \
 		--summary-md $(ARTIFACTS)/fleet/sweep.md
 
+# Federation-plane smoke: region wire envelope round trips,
+# backpressure hysteresis + sampler invariants (fault evidence never
+# sampled), online ring rebalancing under seeded churn, cross-cluster
+# rollup identity, region failover, and the fleetagg/sloctl federation
+# CLIs — seconds, runs in m5-gate.
+federation-smoke:
+	$(PY) -m pytest tests/test_federation.py -q -m 'not slow'
+
+# Full federation-sweep release gate (slow): 10k simulated nodes over
+# a two-level aggregator tree — aggregate ingest >= the 5M events/s
+# single-level floor, exactly one region incident per injected fault
+# (cross-cluster identity) under continuous node churn + rolling shard
+# restarts, a mid-sweep region-aggregator kill with zero lost or
+# duplicated incidents, and graceful degradation (counted by level,
+# bounded staleness) under forced ingest saturation
+# (see docs/runbooks/federation.md).
+federation-sweep:
+	mkdir -p $(ARTIFACTS)/federation
+	$(PY) -m tpuslo m5gate --federation-sweep \
+		--summary-json $(ARTIFACTS)/federation/sweep.json \
+		--summary-md $(ARTIFACTS)/federation/sweep.md
+
 # Full crash-sweep release gate: seeds x kill points of SIGKILL/restart
 # audits (see docs/evidence/crash-sweep.md + docs/runbooks/crash-recovery.md).
 crash-sweep:
@@ -328,12 +351,14 @@ m5-candidate:
 
 # Release candidates fail on new lint findings, lock-order races,
 # steady-state decode recompiles, burn-alert contract violations,
-# row-vs-columnar divergence, a broken fleet plane, a remediation
-# loop that acts imprecisely, or a serving front door that loses to
+# row-vs-columnar divergence, a broken fleet plane, a federation tree
+# that loses evidence under churn or saturation, a remediation loop
+# that acts imprecisely, or a serving front door that loses to
 # per-stream serving, before the statistical gates even run
-# (ISSUEs 6 + 7 + 8 + 9 + 10 + 11 + 12).
+# (ISSUEs 6 + 7 + 8 + 9 + 10 + 11 + 12 + 15).
 m5-gate: lint racecheck-smoke jitcheck-smoke burn-smoke burn-sweep \
 		bench-columnar-smoke fleet-smoke fleet-sweep \
+		federation-smoke federation-sweep \
 		remediation-smoke remediation-sweep \
 		frontdoor-smoke frontdoor-bench \
 		deviceplane-smoke deviceplane-sweep

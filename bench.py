@@ -415,6 +415,96 @@ def bench_fleet(
     return result
 
 
+# Federation-plane release floors (ISSUE 15): the two-level tree must
+# clear the PR 9 single-level ingest floor at bench scale — federating
+# must not cost throughput — and region pages must stay fresh.
+FEDERATION_INGEST_EVENTS_PER_SEC_FLOOR = 5_000_000
+FEDERATION_STALENESS_MS_CEILING = 30_000.0
+FEDERATION_GATE_MIN_NODES = 2000
+
+
+def bench_federation(
+    nodes: int = 2000,
+    clusters: int = 4,
+    shards_per_cluster: int = 2,
+    events_per_node: int = 3000,
+    rounds: int = 16,
+) -> dict:
+    """Two-level federation tree: aggregate ingest + rollup staleness.
+
+    Throughput lane: one template-cloned shipment per node driven
+    through the cluster the topology assigns, measured as total
+    events over the slowest shard's busy time across every cluster
+    (the two-level analogue of ``bench_fleet``).  Staleness lane: a
+    seeded correctness run under continuous churn reports the max
+    region-page staleness (region head past window end at emission) —
+    the number the saturation story bounds.
+    """
+    from tpuslo.federation.simulator import (
+        FederationSimulator,
+        FederationTopology,
+        build_churn_plan,
+        federation_injection_plan,
+    )
+
+    topology = FederationTopology.for_nodes(nodes, clusters=clusters)
+    sim = FederationSimulator(
+        topology, shards_per_cluster=shards_per_cluster, seed=1337
+    )
+    m = sim.measure_ingest(events_per_node)
+    # Staleness lane at a fixed reduced topology: the churn dynamics
+    # (watermark lag from leaves, coarsened cadence) are scale-free,
+    # and the full 10k run belongs to `m5gate --federation-sweep`.
+    stale_topology = FederationTopology.for_nodes(
+        min(nodes, 400), clusters=clusters
+    )
+    plan = federation_injection_plan(stale_topology)
+    churn = build_churn_plan(
+        stale_topology, rounds, plan, node_churn_per_round=2, seed=1337
+    )
+    stale_sim = FederationSimulator(
+        stale_topology, shards_per_cluster=shards_per_cluster, seed=1337
+    )
+    run = stale_sim.run(rounds, plan, churn=churn)
+    result = {
+        "federation_nodes": m.nodes,
+        "federation_clusters": m.clusters,
+        "federation_shards": m.shards,
+        "federation_total_events": m.total_events,
+        "federation_ingest_events_per_sec": round(m.events_per_sec, 1),
+        "federation_per_cluster_events_per_sec": {
+            k: round(v, 1)
+            for k, v in sorted(m.per_cluster_events_per_sec.items())
+        },
+        "federation_rollup_latency_ms": round(m.rollup_latency_ms, 2),
+        "federation_staleness_ms": round(run.max_staleness_ms, 2),
+        "federation_incidents": len(run.incidents),
+        "federation_moved_keys": stale_sim.moved_keys,
+        "federation_ingest_floor": (
+            FEDERATION_INGEST_EVENTS_PER_SEC_FLOOR
+        ),
+        "federation_staleness_ceiling_ms": (
+            FEDERATION_STALENESS_MS_CEILING
+        ),
+        "federation_gates_met": bool(
+            m.events_per_sec >= FEDERATION_INGEST_EVENTS_PER_SEC_FLOOR
+            and run.max_staleness_ms <= FEDERATION_STALENESS_MS_CEILING
+        ),
+    }
+    if (
+        nodes >= FEDERATION_GATE_MIN_NODES
+        and not result["federation_gates_met"]
+    ):
+        raise SystemExit(
+            "bench_federation: federation floors not met — ingest "
+            f"{m.events_per_sec:,.0f} events/s (floor "
+            f"{FEDERATION_INGEST_EVENTS_PER_SEC_FLOOR:,}), staleness "
+            f"{run.max_staleness_ms:.0f} ms (ceiling "
+            f"{FEDERATION_STALENESS_MS_CEILING:,.0f})"
+        )
+    return result
+
+
 def bench_frontdoor() -> dict:
     """Front-door serving gate (ISSUE 12): batched speculative rounds
     inside continuous-batching slots must beat the same streams served
@@ -1500,6 +1590,21 @@ def _digest_pipeline(pipeline: dict) -> dict:
         else {}
     ) | (
         {
+            "federation_ingest_events_per_sec": round(
+                fed.get("federation_ingest_events_per_sec", 0.0), 1
+            ),
+            "federation_staleness_ms": round(
+                fed.get("federation_staleness_ms", 0.0), 2
+            ),
+            "federation_moved_keys": fed.get("federation_moved_keys"),
+            "federation_gates_met": bool(
+                fed.get("federation_gates_met")
+            ),
+        }
+        if (fed := pipeline.get("federation") or {})
+        else {}
+    ) | (
+        {
             "remediation_time_to_mitigate_p50_s": rem.get(
                 "remediation_time_to_mitigate_p50_s", 0.0
             ),
@@ -1716,6 +1821,9 @@ def main() -> int:
     # Fleet observability plane (ISSUE 9): aggregate sharded-aggregator
     # ingest + rollup latency, hard floors at gate scale.
     pipeline_result["fleet"] = bench_fleet()
+    # Federation plane (ISSUE 15): two-level tree aggregate ingest +
+    # region-page staleness under churn, hard floors at bench scale.
+    pipeline_result["federation"] = bench_federation()
     # Auto-remediation loop (ISSUE 11): time-to-mitigate distribution
     # + false-action rate, hard-gated at precision 1.0.
     pipeline_result["remediation"] = bench_remediation()

@@ -331,6 +331,23 @@ fleet_overview = dashboard(
         panel("Fleet-radius incidents open (page immediately)", [
             ('llm_slo_fleet_incidents_open{blast_radius="fleet"}', "fleet-wide"),
         ], 18, 16, w=6, kind="stat"),
+        # --- federation tree (tpuslo.federation) ---------------------
+        panel("Region ingest (node incidents/s, by cluster)", [
+            ('sum(rate(llm_slo_fleet_federation_region_ingested_incidents_total[5m])) by (cluster)', "{{cluster}}"),
+        ], 0, 24),
+        panel("Backpressure level (0 none … 3 aggressive sampling)", [
+            ('llm_slo_fleet_federation_backpressure_level', "{{source}}"),
+        ], 12, 24),
+        panel("Rows sampled under saturation (1h, by level)", [
+            ('sum(increase(llm_slo_fleet_federation_sampled_rows_total[1h])) by (level)', "level {{level}}"),
+        ], 0, 32),
+        panel("Churn rebalances (1h, by kind)", [
+            ('sum(increase(llm_slo_fleet_federation_churn_rebalances_total[1h])) by (kind)', "{{kind}}"),
+        ], 12, 32, w=6),
+        panel("Incident staleness p50/p99 (ms)", [
+            ('histogram_quantile(0.50, sum(rate(llm_slo_fleet_federation_incident_staleness_ms_bucket[5m])) by (le))', "staleness p50"),
+            ('histogram_quantile(0.99, sum(rate(llm_slo_fleet_federation_incident_staleness_ms_bucket[5m])) by (le))', "staleness p99"),
+        ], 18, 32, w=6, unit="ms"),
     ],
 )
 

@@ -1,0 +1,1219 @@
+"""Federation plane: region wire contract, backpressure/sampling
+invariants, online ring rebalancing under churn, cross-cluster rollup
+identity, region failover, the seeded simulator/sweep, and the
+fleetagg/sloctl federation CLIs.
+
+The two load-bearing invariants get adversarial coverage: the
+adaptive sampler can structurally never touch a pod carrying fault
+evidence (so saturation cannot drop or split an incident), and a
+shard join/leave re-homes ONLY the moved arcs with in-flight window
+handoff (so churn mid-window neither loses nor duplicates evidence).
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from tpuslo.columnar.schema import from_rows
+from tpuslo.federation.backpressure import (
+    LEVEL_AGGRESSIVE,
+    LEVEL_COARSE,
+    LEVEL_NONE,
+    LEVEL_SAMPLE,
+    AdaptiveSampler,
+    PressureController,
+)
+from tpuslo.federation.cluster import ClusterAggregator
+from tpuslo.federation.region import RegionAggregator
+from tpuslo.federation.simulator import (
+    FederationSimulator,
+    FederationTopology,
+    build_churn_plan,
+    federation_injection_plan,
+)
+from tpuslo.federation.sweep import run_federation_sweep
+from tpuslo.federation.wire import (
+    REGION_WIRE_VERSION,
+    RegionWireError,
+    decode_region_envelope,
+    encode_region_envelope,
+    parse_region_envelope_line,
+    region_envelope_json_line,
+)
+from tpuslo.fleet.aggregator import AggregatorShard
+from tpuslo.fleet.ring import HashRing
+from tpuslo.fleet.rollup import NodeIncident
+from tpuslo.fleet.simulator import EPOCH_NS
+from tpuslo.fleet.sweep import score_incidents
+from tpuslo.fleet.wire import encode_shipment
+from tpuslo.schema.types import ProbeEventV1
+
+
+def _incident(
+    node: str = "node-0001",
+    cluster: str = "cluster-0",
+    domain: str = "tpu_hbm",
+    namespace: str = "tenant-b",
+    ts: int = EPOCH_NS,
+    confidence: float = 0.9,
+    slice_id: str = "slice-000",
+) -> NodeIncident:
+    return NodeIncident(
+        node=node,
+        pod=f"{node}-pod-1",
+        namespace=namespace,
+        slice_id=slice_id,
+        domain=domain,
+        confidence=confidence,
+        ts_unix_nano=ts,
+        signals={"hbm_used_bytes": 1.5e10},
+        cluster=cluster,
+    )
+
+
+def _status_batch(statuses: list[str], pods: list[str] | None = None):
+    """One batch with given per-row statuses (pod defaults per row)."""
+    pods = pods or [f"pod-{i}" for i in range(len(statuses))]
+    rows = [
+        ProbeEventV1(
+            ts_unix_nano=EPOCH_NS + i * 1000,
+            signal="runqueue_delay_ms",
+            node="node-x",
+            namespace="tenant-a",
+            pod=pods[i],
+            container="w",
+            pid=1,
+            tid=1,
+            value=4.0,
+            unit="ms",
+            status=statuses[i],
+        )
+        for i in range(len(statuses))
+    ]
+    return from_rows(rows)
+
+
+class TestRegionWire:
+    def test_round_trip(self):
+        incidents = [
+            _incident(),
+            _incident(node="node-0002", cluster="cluster-1"),
+        ]
+        payload = encode_region_envelope(
+            "cluster-0",
+            3,
+            incidents,
+            watermark_ns=EPOCH_NS + 5,
+            head_ns=EPOCH_NS + 9,
+            pressure_level=2,
+            sampled_rows={2: 17},
+        )
+        env = decode_region_envelope(payload)
+        assert env.cluster == "cluster-0"
+        assert env.seq == 3
+        assert env.watermark_ns == EPOCH_NS + 5
+        assert env.head_ns == EPOCH_NS + 9
+        assert env.pressure_level == 2
+        assert env.sampled_rows == {"2": 17}
+        assert env.incidents == incidents
+
+    def test_jsonl_round_trip(self):
+        payload = encode_region_envelope("cluster-0", 0, [_incident()])
+        line = region_envelope_json_line(payload)
+        env = parse_region_envelope_line(line)
+        assert env.incidents[0].cluster == "cluster-0"
+        assert env.incidents[0].signals == {"hbm_used_bytes": 1.5e10}
+
+    def test_version_mismatch_refused(self):
+        payload = encode_region_envelope("cluster-0", 0, [])
+        payload["region_wire_version"] = REGION_WIRE_VERSION + 1
+        with pytest.raises(RegionWireError, match="wire version"):
+            decode_region_envelope(payload)
+
+    def test_missing_cluster_refused(self):
+        payload = encode_region_envelope("cluster-0", 0, [])
+        payload["cluster"] = ""
+        with pytest.raises(RegionWireError, match="cluster identity"):
+            decode_region_envelope(payload)
+
+    def test_bad_incident_entry_refused(self):
+        payload = encode_region_envelope("cluster-0", 0, [_incident()])
+        del payload["incidents"][0]["domain"]
+        with pytest.raises(RegionWireError, match="bad incident"):
+            decode_region_envelope(payload)
+
+    def test_bad_header_and_incident_list_refused(self):
+        payload = encode_region_envelope("cluster-0", 0, [])
+        payload["seq"] = "not-a-seq"
+        with pytest.raises(RegionWireError, match="bad envelope header"):
+            decode_region_envelope(payload)
+        payload = encode_region_envelope("cluster-0", 0, [])
+        payload["incidents"] = "nope"
+        with pytest.raises(RegionWireError, match="incidents list"):
+            decode_region_envelope(payload)
+
+
+class TestPressureController:
+    def test_levels_rise_immediately(self):
+        ctl = PressureController(100)
+        assert ctl.observe(10) == LEVEL_NONE
+        assert ctl.observe(55) == LEVEL_COARSE
+        assert ctl.observe(80) == LEVEL_SAMPLE
+        assert ctl.observe(95) == LEVEL_AGGRESSIVE
+        assert ctl.observe(500) == LEVEL_AGGRESSIVE
+
+    def test_release_needs_consecutive_cool_readings(self):
+        ctl = PressureController(100, cool_observations=2)
+        ctl.observe(95)
+        assert ctl.level == LEVEL_AGGRESSIVE
+        # One cool reading is not enough; an interleaved warm reading
+        # resets the streak (hysteresis: the level cannot flap).
+        assert ctl.observe(10) == LEVEL_AGGRESSIVE
+        assert ctl.observe(80) == LEVEL_AGGRESSIVE
+        assert ctl.observe(10) == LEVEL_AGGRESSIVE
+        assert ctl.observe(10) == LEVEL_NONE
+
+    def test_oscillation_around_threshold_does_not_release(self):
+        ctl = PressureController(100, cool_observations=2)
+        ctl.observe(95)
+        # Just below the entry threshold but above the release margin:
+        # stays degraded forever.
+        for _ in range(10):
+            assert ctl.observe(85) == LEVEL_AGGRESSIVE
+
+    def test_degraded_observations_counted_by_level(self):
+        ctl = PressureController(100)
+        ctl.observe(55)
+        ctl.observe(60)
+        ctl.observe(95)
+        assert ctl.observations_by_level == {
+            LEVEL_COARSE: 2,
+            LEVEL_AGGRESSIVE: 1,
+        }
+
+    def test_state_round_trip(self):
+        ctl = PressureController(100)
+        ctl.observe(95)
+        ctl.observe(10)
+        clone = PressureController(100)
+        clone.restore_state(ctl.export_state())
+        assert clone.level == ctl.level
+        assert clone.observations_by_level == ctl.observations_by_level
+        # The cool streak survives: one more cool reading releases.
+        assert clone.observe(10) == LEVEL_NONE
+
+    def test_bad_thresholds_refused(self):
+        with pytest.raises(ValueError, match="ascending"):
+            PressureController(100, raise_at=(0.9, 0.5, 0.7))
+        with pytest.raises(ValueError, match="thresholds"):
+            PressureController(100, raise_at=(0.5, 0.9))
+
+
+class TestAdaptiveSampler:
+    def test_no_sampling_below_sample_level(self):
+        sampler = AdaptiveSampler()
+        batch = _status_batch(["ok"] * 8)
+        for level in (LEVEL_NONE, LEVEL_COARSE):
+            result = sampler.sample_batch(batch, level)
+            assert result.dropped_rows == 0
+            assert result.batch.n == 8
+
+    def test_non_ok_rows_never_sampled(self):
+        sampler = AdaptiveSampler()
+        batch = _status_batch(["warning"] * 4 + ["error"] * 4)
+        result = sampler.sample_batch(batch, LEVEL_AGGRESSIVE)
+        assert result.dropped_rows == 0
+        assert result.batch.n == 8
+
+    def test_fault_pod_rows_fully_protected(self):
+        # One pod carries a single warning row among ok rows: EVERY
+        # row of that pod survives aggressive sampling; only the
+        # wholly-healthy pods' rows are candidates.
+        statuses = ["ok", "warning", "ok", "ok"] + ["ok"] * 12
+        pods = ["pod-hot"] * 4 + [f"pod-{i}" for i in range(12)]
+        sampler = AdaptiveSampler()
+        result = sampler.sample_batch(
+            _status_batch(statuses, pods), LEVEL_AGGRESSIVE
+        )
+        kept = result.batch
+        strings = kept.pool.strings
+        kept_pods = [strings[c] for c in kept.columns["pod"]]
+        assert kept_pods.count("pod-hot") == 4
+        assert result.dropped_rows == 9  # 12 candidates, keep 1 in 4
+        assert sampler.sampled_rows_by_level == {LEVEL_AGGRESSIVE: 9}
+        assert sampler.sampled_batches_by_level == {LEVEL_AGGRESSIVE: 1}
+
+    def test_stride_by_level(self):
+        sampler = AdaptiveSampler()
+        result = sampler.sample_batch(
+            _status_batch(["ok"] * 16), LEVEL_SAMPLE
+        )
+        assert result.batch.n == 8  # 1 in 2 kept
+
+    def test_phase_persists_across_batches(self):
+        # A sparse stream of 1-row batches must still pass 1 in 4 rows
+        # at the aggressive stride, not lose every row to the batch
+        # boundary.
+        sampler = AdaptiveSampler()
+        kept = sum(
+            sampler.sample_batch(
+                _status_batch(["ok"]), LEVEL_AGGRESSIVE
+            ).batch.n
+            for _ in range(16)
+        )
+        assert kept == 4
+
+    def test_state_round_trip(self):
+        sampler = AdaptiveSampler()
+        sampler.sample_batch(_status_batch(["ok"] * 5), LEVEL_AGGRESSIVE)
+        clone = AdaptiveSampler()
+        clone.restore_state(sampler.export_state())
+        assert (
+            clone.sampled_rows_by_level == sampler.sampled_rows_by_level
+        )
+        a = sampler.sample_batch(
+            _status_batch(["ok"] * 7), LEVEL_AGGRESSIVE
+        )
+        b = clone.sample_batch(
+            _status_batch(["ok"] * 7), LEVEL_AGGRESSIVE
+        )
+        assert a.batch.n == b.batch.n  # same phase → same keeps
+
+
+class TestRingRebalance:
+    def test_seeded_churn_only_moved_keys_rehome(self):
+        """Satellite contract: under a seeded continuous join/leave
+        churn schedule, rehome_plan reports exactly the keys whose
+        owner changed — every other key keeps its owner — and
+        cordoned arcs never appear as rebalancing targets."""
+        import random
+
+        rng = random.Random(4242)
+        arcs = [
+            (f"node-{i:04d}", f"slice-{i // 16:03d}") for i in range(256)
+        ]
+        ring = HashRing([f"agg-{i}" for i in range(4)])
+        ring.cordon("node-0007", "slice-000")
+        ring.cordon("node-0133", "slice-008")
+        next_shard = 4
+        pool = [f"agg-{i}" for i in range(4)]
+        for _ in range(12):
+            prior = ring.assignments(arcs)
+            if rng.random() < 0.5 or len(pool) <= 2:
+                shard = f"agg-{next_shard}"
+                next_shard += 1
+                ring.add_shard(shard)
+                pool.append(shard)
+            else:
+                shard = pool.pop(rng.randrange(len(pool)))
+                ring.remove_shard(shard)
+            plan = ring.rehome_plan(arcs, prior)
+            after = ring.assignments(arcs)
+            # Exactly the moved keys: plan ∪ unchanged == all placed.
+            for node, owner in after.items():
+                if prior[node] != owner:
+                    assert plan[node] == (prior[node], owner)
+                else:
+                    assert node not in plan
+            # Cordoned arcs are never targets (never even placed).
+            assert "node-0007" not in plan
+            assert "node-0007" not in after
+            assert "node-0133" not in plan
+        # Sanity: churn actually moved keys at some point.
+        assert ring.rebalances == 12
+
+    def test_rehome_plan_fresh_joins_are_not_moves(self):
+        ring = HashRing(["agg-0", "agg-1"])
+        arcs = [("node-a", "s0"), ("node-b", "s0")]
+        prior = ring.assignments([("node-a", "s0")])
+        plan = ring.rehome_plan(arcs, prior)
+        assert "node-b" not in plan  # placement, not a re-home
+
+
+def _ship_events(shard_or_cluster, node: str, seq: int, values=None):
+    """One shipment of warning-level evidence for ``node``."""
+    values = values or [30.0, 31.0]
+    rows = [
+        ProbeEventV1(
+            ts_unix_nano=EPOCH_NS + seq * 1_000_000_000 + i,
+            signal="runqueue_delay_ms",
+            node=node,
+            namespace="tenant-a",
+            pod=f"{node}-pod-0",
+            container="w",
+            pid=1,
+            tid=1,
+            value=v,
+            unit="ms",
+            status="warning",
+        )
+        for i, v in enumerate(values)
+    ]
+    payload = encode_shipment(from_rows(rows), node, seq, slice_id="s0")
+    return shard_or_cluster.ingest(payload)
+
+
+class TestClusterAggregator:
+    def test_shard_handoff_moves_in_flight_windows(self):
+        """A node moving mid-window carries its open accumulator
+        groups: the window closes exactly once on exactly one shard
+        (no lost evidence, no duplicate incidents)."""
+        cluster = ClusterAggregator(
+            "cluster-0", ["agg-0", "agg-1"], min_confidence=0.0
+        )
+        for i in range(8):
+            _ship_events(cluster, f"node-{i:04d}", 0)
+        open_before = sum(
+            len(s._acc) for s in cluster.shards.values()
+        )
+        assert open_before == 0  # not drained yet (coalesce buffer)
+        plan = cluster.add_shard("agg-2")
+        moved_nodes = set(plan)
+        # Every moved node's state (incl. in-flight windows, drained
+        # by export_node) lives exactly once, on its new owner.
+        for node, (old, new) in plan.items():
+            assert node not in cluster.shards[old].nodes
+            assert node in cluster.shards[new].nodes
+        all_nodes = [
+            n
+            for s in cluster.shards.values()
+            for n in s.nodes
+        ]
+        assert len(all_nodes) == len(set(all_nodes)) == 8
+        incidents = [
+            ni
+            for s in cluster.shards.values()
+            for ni in s.close_windows(flush=True)
+        ]
+        assert len(incidents) == 8  # one per node, none lost/duped
+        assert cluster.churn_rebalances == {"shard_join": 1}
+        if moved_nodes:
+            assert {ni.node for ni in incidents} >= moved_nodes
+
+    def test_graceful_remove_hands_every_arc_over(self):
+        cluster = ClusterAggregator(
+            "cluster-0", ["agg-0", "agg-1", "agg-2"], min_confidence=0.0
+        )
+        for i in range(12):
+            _ship_events(cluster, f"node-{i:04d}", 0)
+        victim_nodes = set(cluster.shards["agg-1"].nodes)
+        moved = cluster.remove_shard("agg-1")
+        assert set(moved) == victim_nodes
+        assert "agg-1" not in cluster.shards
+        incidents = [
+            ni
+            for s in cluster.shards.values()
+            for ni in s.close_windows(flush=True)
+        ]
+        assert len(incidents) == 12
+
+    def test_remove_unknown_shard_refused(self):
+        cluster = ClusterAggregator("cluster-0", ["agg-0"])
+        with pytest.raises(ValueError, match="unknown shard"):
+            cluster.remove_shard("agg-9")
+
+    def test_close_and_ship_stamps_cluster_and_seq(self):
+        cluster = ClusterAggregator(
+            "cluster-0", ["agg-0"], min_confidence=0.0
+        )
+        _ship_events(cluster, "node-0000", 0)
+        first = cluster.close_and_ship(flush=True)
+        second = cluster.close_and_ship(flush=True)
+        assert first["seq"] == 0 and second["seq"] == 1
+        env = decode_region_envelope(first)
+        assert env.incidents, "flush should attribute the window"
+        assert all(
+            ni.cluster == "cluster-0" for ni in env.incidents
+        )
+        assert cluster.resend_since(-1) == [first, second]
+        assert cluster.resend_since(0) == [second]
+
+    def test_envelope_sampled_rows_is_per_envelope_delta(self):
+        # The wire contract says "since the last envelope": a region
+        # summing across envelopes must not overcount the cluster's
+        # cumulative sampling history.
+        cluster = ClusterAggregator(
+            "cluster-0", ["agg-0"], min_confidence=0.0
+        )
+        cluster.set_upstream_pressure(LEVEL_AGGRESSIVE)
+        cluster.sampler.sample_batch(
+            _status_batch(["ok"] * 9), LEVEL_AGGRESSIVE
+        )
+        first = cluster.close_and_ship(flush=True)
+        second = cluster.close_and_ship(flush=True)
+        dropped = cluster.sampler.sampled_rows_by_level[
+            LEVEL_AGGRESSIVE
+        ]
+        assert first["sampled_rows"] == {
+            str(LEVEL_AGGRESSIVE): dropped
+        }
+        assert second["sampled_rows"] == {}  # nothing new since
+        # The shipped cursor survives a snapshot round trip.
+        clone = ClusterAggregator(
+            "cluster-0", ["agg-0"], min_confidence=0.0
+        )
+        clone.restore_state(cluster.export_state())
+        assert clone.close_and_ship(flush=True)["sampled_rows"] == {}
+
+    def test_coarsen_responds_to_pressure(self):
+        cluster = ClusterAggregator(
+            "cluster-0", ["agg-0"], capacity_events=2
+        )
+        _ship_events(cluster, "node-0000", 0, values=[5.0, 6.0, 7.0])
+        signal = cluster.observe_pressure()
+        assert signal.level == LEVEL_AGGRESSIVE
+        base = cluster._base_coalesce["agg-0"]
+        assert (
+            cluster.shards["agg-0"].coalesce_events
+            == base << LEVEL_AGGRESSIVE
+        )
+        # Upstream pressure propagates into the effective level too.
+        calm = ClusterAggregator("cluster-1", ["agg-0"])
+        calm.set_upstream_pressure(LEVEL_SAMPLE)
+        assert calm.effective_level() == LEVEL_SAMPLE
+
+    def test_sampling_level_protects_fault_evidence_end_to_end(self):
+        cluster = ClusterAggregator(
+            "cluster-0",
+            ["agg-0"],
+            min_confidence=0.0,
+            capacity_events=1,
+        )
+        cluster.observe_pressure()  # backlog 0; force via upstream
+        cluster.set_upstream_pressure(LEVEL_AGGRESSIVE)
+        # Mixed shipment: one pod with warning evidence + 8 healthy
+        # pods.  Sampling drops only healthy-pod rows.
+        rows = []
+        for i in range(9):
+            status = "warning" if i == 0 else "ok"
+            rows.append(
+                ProbeEventV1(
+                    ts_unix_nano=EPOCH_NS + i,
+                    signal="runqueue_delay_ms",
+                    node="node-0000",
+                    namespace="tenant-a",
+                    pod=f"node-0000-pod-{i}",
+                    container="w",
+                    pid=1,
+                    tid=1,
+                    value=30.0 if i == 0 else 4.0,
+                    unit="ms",
+                    status=status,
+                )
+            )
+        payload = encode_shipment(
+            from_rows(rows), "node-0000", 0, slice_id="s0"
+        )
+        assert cluster.ingest(payload)
+        assert cluster.sampler.sampled_rows_by_level[
+            LEVEL_AGGRESSIVE
+        ] == 6  # 8 healthy rows → keep 2
+        shard = cluster.shards["agg-0"]
+        shard._drain()
+        acc_pods = {
+            key[2]
+            for groups in shard._acc.values()
+            for key in groups
+        }
+        assert "node-0000-pod-0" in acc_pods  # evidence survived
+
+
+class TestHealthyGroupSkip:
+    def _fold_groups(self, shard: AggregatorShard):
+        rows = [
+            ProbeEventV1(
+                ts_unix_nano=EPOCH_NS,
+                signal="runqueue_delay_ms",
+                node="node-h",
+                namespace="tenant-a",
+                pod="node-h-pod-0",
+                container="w",
+                pid=1,
+                tid=1,
+                value=4.0,
+                unit="ms",
+                status="ok",
+            ),
+            ProbeEventV1(
+                ts_unix_nano=EPOCH_NS + 1,
+                signal="runqueue_delay_ms",
+                node="node-f",
+                namespace="tenant-a",
+                pod="node-f-pod-0",
+                container="w",
+                pid=1,
+                tid=1,
+                value=30.0,
+                unit="ms",
+                status="warning",
+            ),
+        ]
+        batch = from_rows(rows)
+        shard.ingest(encode_shipment(batch, "node-h", 0))
+        return shard.close_windows(flush=True)
+
+    def test_skip_healthy_groups_counts_and_keeps_evidence(self):
+        shard = AggregatorShard(
+            "agg-0", min_confidence=0.0, skip_healthy_groups=True
+        )
+        incidents = self._fold_groups(shard)
+        assert shard.groups_skipped_healthy == 1
+        assert [ni.node for ni in incidents] == ["node-f"]
+        assert shard.snapshot()["groups_skipped_healthy"] == 1
+
+    def test_default_off_attributes_everything(self):
+        shard = AggregatorShard("agg-0", min_confidence=0.0)
+        incidents = self._fold_groups(shard)
+        assert shard.groups_skipped_healthy == 0
+        assert {ni.node for ni in incidents} == {"node-h", "node-f"}
+
+
+class TestRegionAggregator:
+    def test_cross_cluster_identity_is_one_incident(self):
+        region = RegionAggregator()
+        region.ingest(
+            encode_region_envelope(
+                "cluster-0",
+                0,
+                [_incident(node="node-0001", cluster="cluster-0")],
+                watermark_ns=EPOCH_NS + 60_000_000_000,
+            )
+        )
+        region.ingest(
+            encode_region_envelope(
+                "cluster-1",
+                0,
+                [
+                    _incident(
+                        node="node-0070",
+                        cluster="cluster-1",
+                        ts=EPOCH_NS + 1_000_000_000,
+                        slice_id="slice-001",
+                    )
+                ],
+                watermark_ns=EPOCH_NS + 60_000_000_000,
+            )
+        )
+        emitted = region.pump()
+        assert len(emitted) == 1
+        incident = emitted[0]
+        assert incident.region == "region-0"
+        assert incident.clusters == ["cluster-0", "cluster-1"]
+        assert incident.blast_radius == "fleet"  # two slices
+        member_clusters = {m["cluster"] for m in incident.members}
+        assert member_clusters == {"cluster-0", "cluster-1"}
+
+    def test_seq_dedup_per_cluster(self):
+        region = RegionAggregator()
+        payload = encode_region_envelope(
+            "cluster-0", 0, [_incident()]
+        )
+        assert region.ingest(payload)
+        assert not region.ingest(payload)  # replay
+        assert region.duplicate_envelopes == 1
+        assert region.ingested_incidents == 1
+
+    def test_out_of_order_cluster_flushes_still_coalesce(self):
+        # Cluster 1's envelope arrives first with a LATER timestamp;
+        # cluster 0's straggler is EARLIER.  pump() time-sorts before
+        # the rollup sees them, so they coalesce into one session.
+        region = RegionAggregator()
+        region.ingest(
+            encode_region_envelope(
+                "cluster-1",
+                0,
+                [
+                    _incident(
+                        node="node-0070",
+                        cluster="cluster-1",
+                        ts=EPOCH_NS + 3_000_000_000,
+                    )
+                ],
+            )
+        )
+        region.ingest(
+            encode_region_envelope(
+                "cluster-0",
+                0,
+                [_incident(node="node-0001", cluster="cluster-0")],
+            )
+        )
+        emitted = region.pump(flush=True)
+        assert len(emitted) == 1
+
+    def test_staleness_recorded_on_emission(self):
+        region = RegionAggregator()
+        region.ingest(
+            encode_region_envelope(
+                "cluster-0",
+                0,
+                [_incident()],
+                watermark_ns=EPOCH_NS + 60_000_000_000,
+                head_ns=EPOCH_NS + 12_000_000_000,
+            )
+        )
+        region.pump()
+        assert region.max_staleness_ms == pytest.approx(12_000.0)
+
+    def test_state_round_trip_preserves_pending_and_cursors(self):
+        region = RegionAggregator()
+        region.ingest(
+            encode_region_envelope("cluster-0", 4, [_incident()])
+        )
+        clone = RegionAggregator()
+        clone.restore_state(region.export_state())
+        assert clone.clusters["cluster-0"].seq == 4
+        # Pending (buffered, un-pumped) incidents survive the restore.
+        emitted = clone.pump(flush=True)
+        assert len(emitted) == 1
+        # And the emitted-window registry round-trips: re-building the
+        # same group after another restore pages zero times.
+        clone2 = RegionAggregator()
+        clone2.restore_state(clone.export_state())
+        clone2.ingest(
+            encode_region_envelope("cluster-0", 5, [_incident()])
+        )
+        assert clone2.pump(flush=True) == []
+        assert clone2.rollup.duplicates_suppressed == 1
+
+
+class TestFederationTopologyAndPlan:
+    def test_slices_stripe_across_clusters(self):
+        topo = FederationTopology.for_nodes(10000, clusters=4)
+        assert topo.cluster_index(0) == 0
+        assert topo.cluster_index(topo.nodes_per_slice) == 1
+        assert topo.cluster_index(2 * topo.nodes_per_slice) == 2
+        seen = {
+            topo.cluster_of_node(i)
+            for i in range(0, topo.nodes, topo.nodes_per_slice)
+        }
+        assert len(seen) == 4
+
+    def test_plan_fleet_scope_spans_clusters(self):
+        topo = FederationTopology.for_nodes(400, clusters=4)
+        plan = federation_injection_plan(topo)
+        fleet = next(p for p in plan if p.scope == "fleet")
+        clusters = {
+            topo.cluster_of_node(node_i)
+            for node_i, _ in fleet.affected(topo)
+        }
+        assert len(clusters) >= 2
+        # Distinct (namespace, domain) ground truth throughout.
+        pairs = [(p.namespace, p.domain) for p in plan]
+        assert len(pairs) == len(set(pairs))
+
+    def test_churn_plan_protects_fault_nodes(self):
+        topo = FederationTopology.for_nodes(200, clusters=2)
+        plan = federation_injection_plan(topo)
+        protected = {
+            node_i
+            for injection in plan
+            for node_i, _ in injection.affected(topo)
+        }
+        churn = build_churn_plan(
+            topo, 16, plan, node_churn_per_round=3, seed=99
+        )
+        leaves = {
+            e.node_i for e in churn if e.kind == "node_leave"
+        }
+        assert leaves and not (leaves & protected)
+        joins = {e.node_i for e in churn if e.kind == "node_join"}
+        assert joins and min(joins) >= topo.nodes
+        restarts = [e for e in churn if e.kind == "shard_down"]
+        assert restarts and all(
+            any(
+                u.kind == "shard_up"
+                and u.shard_id == d.shard_id
+                and u.round_i == d.round_i + 1
+                for u in churn
+            )
+            for d in restarts
+        )
+
+
+class TestFederationSimulator:
+    def test_churn_run_exact_dedup_cross_cluster(self):
+        topo = FederationTopology.for_nodes(64, clusters=2)
+        plan = federation_injection_plan(topo)
+        churn = build_churn_plan(
+            topo, 18, plan, node_churn_per_round=1, seed=7
+        )
+        sim = FederationSimulator(topo, shards_per_cluster=2, seed=7)
+        result = sim.run(18, plan, churn=churn)
+        _, precision, recall, _ = score_incidents(
+            plan, result.incidents
+        )
+        assert precision == 1.0 and recall == 1.0
+        fleet = [
+            i for i in result.incidents if i.blast_radius == "fleet"
+        ]
+        assert fleet and len(fleet[0].clusters) >= 2
+        assert result.churn["node_leave"] > 0
+        assert result.churn["shard_down"] == 2
+        assert sim.moved_keys > 0
+        assert all(i.region == "region-0" for i in result.incidents)
+
+    def test_region_kill_loses_and_duplicates_nothing(self, tmp_path):
+        from tpuslo.runtime import AgentRuntime, StateStore
+
+        topo = FederationTopology.for_nodes(64, clusters=2)
+        plan = federation_injection_plan(topo)
+        churn = build_churn_plan(
+            topo, 18, plan, node_churn_per_round=1, seed=7
+        )
+
+        def keys(incidents):
+            return sorted(
+                f"{i.namespace}/{i.domain}/{i.blast_radius}"
+                for i in incidents
+            )
+
+        baseline = FederationSimulator(
+            topo, shards_per_cluster=2, seed=7
+        ).run(18, plan, churn=churn)
+        runtime = AgentRuntime(
+            StateStore(str(tmp_path / "fed.json"), interval_s=0.0)
+        )
+        sim = FederationSimulator(topo, shards_per_cluster=2, seed=7)
+        result = sim.run(
+            18, plan, churn=churn, kill_region_at=9, runtime=runtime
+        )
+        assert result.failover["resent_envelopes"] > 0
+        assert keys(result.incidents) == keys(baseline.incidents)
+
+    def test_saturation_degrades_but_never_drops(self):
+        topo = FederationTopology.for_nodes(64, clusters=2)
+        plan = federation_injection_plan(topo)
+        sim = FederationSimulator(
+            topo,
+            shards_per_cluster=2,
+            seed=7,
+            cluster_capacity_events=200,
+            region_capacity_incidents=8,
+        )
+        result = sim.run(18, plan)
+        _, precision, recall, _ = score_incidents(
+            plan, result.incidents
+        )
+        assert precision == 1.0 and recall == 1.0
+        assert result.max_level_seen >= LEVEL_SAMPLE
+        assert sum(result.sampled_rows_by_level.values()) > 0
+        assert result.pressure_observations_by_level
+        assert result.max_staleness_ms < 30_000.0
+
+    def test_throughput_lane_template_cloned(self):
+        topo = FederationTopology.for_nodes(96, clusters=2)
+        sim = FederationSimulator(topo, shards_per_cluster=2, seed=7)
+        m = sim.measure_ingest(events_per_node=400)
+        assert m.nodes == 96
+        assert m.clusters == 2 and m.shards == 4
+        assert m.total_events > 0
+        assert m.admitted_events > 0
+        assert m.events_per_sec > 0
+        assert set(m.per_cluster_events_per_sec) == {
+            "cluster-0",
+            "cluster-1",
+        }
+
+
+class TestFederationSweep:
+    def test_small_sweep_passes_all_phases(self):
+        report = run_federation_sweep(
+            nodes=48,
+            clusters=2,
+            shards_per_cluster=2,
+            rounds=16,
+            events_per_node=400,
+            churn_per_round=1,
+            min_ingest_events_per_sec=1.0,  # smoke scale: no floor
+        )
+        assert report.passed, report.failures
+        assert report.precision == 1.0 and report.recall == 1.0
+        assert report.cross_cluster_members >= 2
+        assert report.failover.get("resent_envelopes", 0) >= 0
+        assert not report.failover_lost
+        assert not report.failover_duplicated
+        assert report.saturation["max_level_seen"] >= LEVEL_SAMPLE
+        assert report.saturation["precision"] == 1.0
+        d = report.to_dict()
+        assert d["passed"] is True
+        assert json.loads(json.dumps(d)) == d
+
+    def test_sweep_fails_loud_on_impossible_floor(self):
+        report = run_federation_sweep(
+            nodes=48,
+            clusters=2,
+            shards_per_cluster=2,
+            rounds=14,
+            events_per_node=400,
+            churn_per_round=0,
+            kill_region=False,
+            saturate=False,
+            min_ingest_events_per_sec=1e15,
+        )
+        assert not report.passed
+        assert any("below the" in f for f in report.failures)
+
+    @pytest.mark.slow
+    def test_m5gate_federation_cli_round_trip(self, tmp_path):
+        from tpuslo.cli.m5gate import main as m5gate_main
+
+        summary_json = tmp_path / "sweep.json"
+        summary_md = tmp_path / "sweep.md"
+        rc = m5gate_main(
+            [
+                "--federation-sweep",
+                "--federation-nodes", "48",
+                "--federation-clusters", "2",
+                "--federation-shards-per-cluster", "2",
+                "--federation-rounds", "16",
+                "--federation-events-per-node", "400",
+                "--federation-churn-rate", "1",
+                "--federation-min-ingest", "1",
+                "--summary-json", str(summary_json),
+                "--summary-md", str(summary_md),
+            ]
+        )
+        assert rc == 0
+        report = json.loads(summary_json.read_text())
+        assert report["passed"] is True
+        md = summary_md.read_text()
+        assert "Federation-plane gate" in md
+        assert "PASS" in md
+
+
+class TestFederationCLIs:
+    def _write_cluster_log(self, path, node, slice_id):
+        from tpuslo.fleet.wire import ShipmentWriter
+        from tpuslo.schema.types import TPURef
+        from tpuslo.signals.constants import TPU_SIGNALS
+        from tpuslo.signals.generator import (
+            SIGNAL_UNITS,
+            profile_for_fault,
+            signal_status,
+        )
+
+        rows = []
+        for k, (sig, val) in enumerate(
+            sorted(profile_for_fault("hbm_pressure").items())
+        ):
+            rows.append(
+                ProbeEventV1(
+                    ts_unix_nano=EPOCH_NS + k * 1000,
+                    signal=sig,
+                    node=node,
+                    namespace="tenant-b",
+                    pod=f"{node}-pod-1",
+                    container="w",
+                    pid=1,
+                    tid=1,
+                    value=float(val),
+                    unit=SIGNAL_UNITS.get(sig, "ms"),
+                    status=signal_status(sig, float(val)),
+                    tpu=TPURef(slice_id=slice_id, host_index=0)
+                    if sig in TPU_SIGNALS
+                    else None,
+                )
+            )
+        writer = ShipmentWriter(str(path))
+        writer.send(
+            "fleet",
+            [
+                encode_shipment(
+                    from_rows(rows),
+                    node,
+                    0,
+                    transport="base64",
+                    slice_id=slice_id,
+                )
+            ],
+        )
+        writer.close()
+
+    def test_fleetagg_federation_tree_end_to_end(
+        self, tmp_path, capsys
+    ):
+        from tpuslo.cli.fleetagg import main as fleetagg_main
+
+        c0 = tmp_path / "c0.jsonl"
+        c1 = tmp_path / "c1.jsonl"
+        self._write_cluster_log(c0, "node-0001", "slice-000")
+        self._write_cluster_log(c1, "node-0070", "slice-001")
+        r0 = tmp_path / "r0.jsonl"
+        r1 = tmp_path / "r1.jsonl"
+        s0 = tmp_path / "s0.json"
+        assert fleetagg_main(
+            [
+                str(c0), "--cluster-id", "cluster-0",
+                "--region-out", str(r0), "--state-out", str(s0),
+            ]
+        ) == 0
+        assert fleetagg_main(
+            [
+                str(c1), "--cluster-id", "cluster-1",
+                "--region-out", str(r1),
+            ]
+        ) == 0
+        capsys.readouterr()
+        incidents_out = tmp_path / "inc.jsonl"
+        provenance_out = tmp_path / "prov.jsonl"
+        region_state = tmp_path / "region.json"
+        rc = fleetagg_main(
+            [
+                "--region", str(r0), str(r1),
+                "--incidents-out", str(incidents_out),
+                "--provenance-out", str(provenance_out),
+                "--state-out", str(region_state),
+                "--json",
+            ]
+        )
+        assert rc == 0
+        summary = json.loads(capsys.readouterr().out)
+        assert summary["incidents"] == 1
+        assert summary["clusters"] == ["cluster-0", "cluster-1"]
+        incident = json.loads(
+            incidents_out.read_text().strip()
+        )
+        assert incident["region"] == "region-0"
+        assert incident["clusters"] == ["cluster-0", "cluster-1"]
+        assert incident["blast_radius"] == "fleet"
+        prov = json.loads(provenance_out.read_text().strip())
+        assert prov["correlation"]["clusters"] == [
+            "cluster-0",
+            "cluster-1",
+        ]
+        # Cluster state snapshot carries the cluster identity.
+        assert json.loads(s0.read_text())["cluster"] == "cluster-0"
+        # Re-running the region against the SAME envelopes from its
+        # saved state pages nothing twice (seq dedup).
+        capsys.readouterr()
+        rc = fleetagg_main(
+            [
+                "--region", str(r0), str(r1),
+                "--restore-state", str(region_state),
+                "--json",
+            ]
+        )
+        assert rc == 0
+        replay = json.loads(capsys.readouterr().out)
+        assert replay["incidents"] == 0
+        assert replay["duplicate_envelopes"] == 2
+
+    def test_fleetagg_region_flag_conflicts(self, capsys):
+        from tpuslo.cli.fleetagg import main as fleetagg_main
+
+        rc = fleetagg_main(
+            ["x.jsonl", "--region", "--cluster-id", "c0"]
+        )
+        assert rc == 2
+        assert "--region consumes" in capsys.readouterr().err
+
+    def test_fleetagg_region_out_requires_cluster_id(self, capsys):
+        # A fallback identity would collide across cluster runs at the
+        # region (shared seq cursor drops one cluster's envelope).
+        from tpuslo.cli.fleetagg import main as fleetagg_main
+
+        rc = fleetagg_main(["x.jsonl", "--region-out", "r.jsonl"])
+        assert rc == 2
+        assert "requires --cluster-id" in capsys.readouterr().err
+
+    def test_fleetagg_region_rejects_bad_envelopes(
+        self, tmp_path, capsys
+    ):
+        from tpuslo.cli.fleetagg import main as fleetagg_main
+
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text(
+            "not json\n"
+            + json.dumps({"region_wire_version": 99, "cluster": "c"})
+            + "\n"
+        )
+        rc = fleetagg_main(["--region", str(bad), "--json"])
+        assert rc == 0
+        out = capsys.readouterr()
+        assert json.loads(out.out)["rejected_envelopes"] == 2
+        assert "rejected" in out.err
+
+    def test_sloctl_region_cluster_scopes(self, tmp_path, capsys):
+        from tpuslo.cli.sloctl import main as sloctl_main
+        from tpuslo.fleet.rollup import FleetIncident
+
+        incidents = [
+            FleetIncident(
+                incident_id="fleet-tenant-a-tpu_hbm-1",
+                namespace="tenant-a",
+                domain="tpu_hbm",
+                blast_radius="fleet",
+                window_start_ns=EPOCH_NS,
+                window_end_ns=EPOCH_NS + 1,
+                confidence=0.9,
+                nodes=["node-0001", "node-0070"],
+                slices=["slice-000", "slice-001"],
+                members=[],
+                region="region-0",
+                clusters=["cluster-0", "cluster-1"],
+            ),
+            FleetIncident(
+                incident_id="fleet-tenant-b-tpu_ici-2",
+                namespace="tenant-b",
+                domain="tpu_ici",
+                blast_radius="slice",
+                window_start_ns=EPOCH_NS,
+                window_end_ns=EPOCH_NS + 1,
+                confidence=0.8,
+                nodes=["node-0099"],
+                slices=["slice-002"],
+                members=[],
+                region="region-1",
+                clusters=["cluster-2"],
+            ),
+        ]
+        path = tmp_path / "inc.jsonl"
+        path.write_text(
+            "".join(
+                json.dumps(i.to_dict()) + "\n" for i in incidents
+            )
+        )
+        rc = sloctl_main(
+            ["fleet", "incidents", "--incidents", str(path)]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "REGION" in out and "CLUSTERS" in out
+        assert "region-0" in out and "cluster-0,cluster-1" in out
+        # --region scope.
+        sloctl_main(
+            [
+                "fleet", "incidents", "--incidents", str(path),
+                "--region", "region-1",
+            ]
+        )
+        out = capsys.readouterr().out
+        assert "tpu_ici" in out and "tpu_hbm" not in out
+        # --cluster scope with --json parity.
+        sloctl_main(
+            [
+                "fleet", "incidents", "--incidents", str(path),
+                "--cluster", "cluster-1", "--json",
+            ]
+        )
+        rows = json.loads(capsys.readouterr().out)
+        assert [r["incident_id"] for r in rows] == [
+            "fleet-tenant-a-tpu_hbm-1"
+        ]
+        assert rows[0]["region"] == "region-0"
+
+    def test_sloctl_nodes_cluster_scope(self, tmp_path, capsys):
+        from tpuslo.cli.sloctl import main as sloctl_main
+
+        state = {
+            "cluster": "cluster-0",
+            "shards": {
+                "agg-0": {
+                    "nodes": {
+                        "node-0001": {
+                            "head_ns": EPOCH_NS,
+                            "seq": 3,
+                            "events": 21,
+                            "slice_id": "slice-000",
+                            "stale": False,
+                        }
+                    }
+                }
+            },
+            "snapshots": {"agg-0": {"watermark_ns": 0}},
+        }
+        path = tmp_path / "state.json"
+        path.write_text(json.dumps(state))
+        rc = sloctl_main(["fleet", "nodes", "--state", str(path)])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "CLUSTER" in out and "cluster-0" in out
+        # Matching filter keeps the row; a different cluster empties.
+        sloctl_main(
+            [
+                "fleet", "nodes", "--state", str(path),
+                "--cluster", "cluster-0", "--json",
+            ]
+        )
+        rows = json.loads(capsys.readouterr().out)
+        assert rows[0]["cluster"] == "cluster-0"
+        sloctl_main(
+            [
+                "fleet", "nodes", "--state", str(path),
+                "--cluster", "cluster-9",
+            ]
+        )
+        assert "(no nodes)" in capsys.readouterr().out
+
+
+class TestFederationMetricsBridge:
+    def test_observer_series(self):
+        from tpuslo.metrics.registry import AgentMetrics
+
+        metrics = AgentMetrics()
+        observer = metrics.federation_observer()
+        observer.region_ingested("cluster-0", 5)
+        observer.region_ingested("cluster-0", 3)
+        observer.backpressure_level("region-0", 2)
+        observer.sampled_rows(3, 17)
+        observer.churn_rebalance("shard_join", 4)
+        observer.incident_staleness_ms(1234.5)
+        from prometheus_client import generate_latest
+
+        scrape = generate_latest(metrics.registry).decode()
+        assert (
+            'llm_slo_fleet_federation_region_ingested_incidents_total'
+            '{cluster="cluster-0"} 8.0' in scrape
+        )
+        assert (
+            'llm_slo_fleet_federation_backpressure_level'
+            '{source="region-0"} 2.0' in scrape
+        )
+        assert (
+            'llm_slo_fleet_federation_sampled_rows_total'
+            '{level="3"} 17.0' in scrape
+        )
+        assert (
+            'llm_slo_fleet_federation_churn_rebalances_total'
+            '{kind="shard_join"} 1.0' in scrape
+        )
+        assert (
+            "llm_slo_fleet_federation_incident_staleness_ms_count 1.0"
+            in scrape
+        )
+
+    def test_simulator_drives_observer(self):
+        from tpuslo.metrics.registry import AgentMetrics
+
+        metrics = AgentMetrics()
+        topo = FederationTopology.for_nodes(48, clusters=2)
+        plan = federation_injection_plan(topo)
+        sim = FederationSimulator(
+            topo,
+            shards_per_cluster=2,
+            seed=7,
+            cluster_capacity_events=100,
+            observer=metrics.federation_observer(),
+        )
+        churn = build_churn_plan(
+            topo, 14, plan, node_churn_per_round=1, seed=7
+        )
+        sim.run(14, plan, churn=churn)
+        from prometheus_client import generate_latest
+
+        scrape = generate_latest(metrics.registry).decode()
+        assert (
+            "llm_slo_fleet_federation_region_ingested_incidents_total"
+            in scrape
+        )
+        assert (
+            'llm_slo_fleet_federation_churn_rebalances_total'
+            '{kind="shard_leave"}' in scrape
+        )
+        assert (
+            "llm_slo_fleet_federation_incident_staleness_ms_count"
+            in scrape
+        )

@@ -66,6 +66,15 @@ HOT_FUNCTIONS: tuple[tuple[str, str], ...] = (
     ("tpuslo/fleet/aggregator.py", "AggregatorShard.ingest"),
     ("tpuslo/fleet/aggregator.py", "AggregatorShard._drain"),
     ("tpuslo/fleet/aggregator.py", "AggregatorShard._fold"),
+    # Federation plane (ISSUE 15): the cluster/region ingest paths run
+    # per shipment / per envelope at 10k-node scale, and the adaptive
+    # sampler runs per decoded batch under saturation — exactly when
+    # the plane can least afford per-event Python or a stray
+    # json.dumps.  Pressure observation runs every pump.
+    ("tpuslo/federation/backpressure.py", "AdaptiveSampler.sample_batch"),
+    ("tpuslo/federation/backpressure.py", "PressureController.observe"),
+    ("tpuslo/federation/cluster.py", "ClusterAggregator.ingest"),
+    ("tpuslo/federation/region.py", "RegionAggregator.ingest"),
     # Remediation evaluate path (ISSUE 11): the decision + verify fold
     # runs once per attributed incident / per in-flight action per
     # evaluation window, inside the agent cycle the tracer budgets —
@@ -129,6 +138,11 @@ HOT_DATACLASSES: tuple[tuple[str, str], ...] = (
     # Fleet plane containers (ISSUE 9).
     ("tpuslo/fleet/wire.py", "Shipment"),
     ("tpuslo/fleet/aggregator.py", "_NodeState"),
+    # Federation-plane containers (ISSUE 15).
+    ("tpuslo/federation/wire.py", "RegionEnvelope"),
+    ("tpuslo/federation/backpressure.py", "PressureSignal"),
+    ("tpuslo/federation/backpressure.py", "SampleResult"),
+    ("tpuslo/federation/region.py", "_ClusterState"),
     # Remediation evaluate-path containers (ISSUE 11).
     ("tpuslo/remediation/policy.py", "AttributionContext"),
     ("tpuslo/remediation/policy.py", "RemediationRule"),

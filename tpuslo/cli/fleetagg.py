@@ -78,8 +78,39 @@ def build_parser() -> argparse.ArgumentParser:
         default="",
         help="absorb a prior --state-out snapshot before ingesting "
         "(failover re-home: each node fragment lands on whichever "
-        "shard the ring owns now)",
+        "shard the ring owns now; in --region mode, restore the "
+        "region rollup + per-cluster cursors)",
     )
+    # ---- federation tree (tpuslo.federation) --------------------------
+    p.add_argument(
+        "--cluster-id",
+        default="",
+        help="run as ONE cluster of the federation tree: emitted node "
+        "incidents carry this cluster identity and the state "
+        "snapshot is scoped to it (sloctl fleet nodes --cluster)",
+    )
+    p.add_argument(
+        "--region-out",
+        default="",
+        help="write this cluster's region-envelope JSONL (the "
+        "cluster->region wire hop; feed it to `fleetagg --region`)",
+    )
+    p.add_argument(
+        "--region-seq",
+        type=int,
+        default=0,
+        help="monotonic per-cluster envelope sequence for "
+        "--region-out (bump per run so the region's seq dedup "
+        "admits it)",
+    )
+    p.add_argument(
+        "--region",
+        action="store_true",
+        help="run as the REGION aggregator: inputs are region-envelope "
+        "JSONL logs written by per-cluster `fleetagg --region-out` "
+        "runs; incidents collapse with cross-cluster identity",
+    )
+    p.add_argument("--region-id", default="region-0")
     p.add_argument(
         "--json",
         action="store_true",
@@ -92,6 +123,16 @@ def incident_provenance(incident: FleetIncident) -> dict[str, Any]:
     """FleetIncident → ProvenanceRecord dict with the members block."""
     from tpuslo.obs.provenance import ProvenanceRecord
 
+    correlation = {
+        "tenant": incident.namespace,
+        "window_start_ns": incident.window_start_ns,
+        "window_end_ns": incident.window_end_ns,
+        "nodes": len(incident.nodes),
+        "slices": len(incident.slices),
+    }
+    if incident.region or incident.clusters:
+        correlation["region"] = incident.region
+        correlation["clusters"] = list(incident.clusters)
     return ProvenanceRecord(
         incident_id=incident.incident_id,
         recorded_at=datetime.now(timezone.utc).isoformat(
@@ -99,20 +140,160 @@ def incident_provenance(incident: FleetIncident) -> dict[str, Any]:
         ),
         predicted_fault_domain=incident.domain,
         confidence=incident.confidence,
-        correlation={
-            "tenant": incident.namespace,
-            "window_start_ns": incident.window_start_ns,
-            "window_end_ns": incident.window_end_ns,
-            "nodes": len(incident.nodes),
-            "slices": len(incident.slices),
-        },
+        correlation=correlation,
         members=[dict(m) for m in incident.members],
         blast_radius=incident.blast_radius,
     ).to_dict()
 
 
+def run_region(args) -> int:
+    """``fleetagg --region``: envelope logs → federated incidents."""
+    from tpuslo.federation.region import RegionAggregator
+    from tpuslo.federation.wire import RegionWireError
+
+    region = RegionAggregator(
+        region_id=args.region_id, rollup_gap_ns=args.rollup_gap_ns
+    )
+    if args.restore_state:
+        try:
+            with open(args.restore_state, encoding="utf-8") as fh:
+                snapshot = json.load(fh)
+        except (OSError, json.JSONDecodeError) as exc:
+            print(
+                f"fleetagg: cannot restore {args.restore_state}: {exc}",
+                file=sys.stderr,
+            )
+            return 1
+        region.restore_state(snapshot.get("region") or {})
+        print(
+            f"fleetagg: restored region state from "
+            f"{args.restore_state}",
+            file=sys.stderr,
+        )
+    rejected = 0
+    for path in args.inputs:
+        try:
+            fh = open(path, encoding="utf-8")
+        except OSError as exc:
+            print(
+                f"fleetagg: cannot read {path}: {exc.strerror or exc}",
+                file=sys.stderr,
+            )
+            return 1
+        with fh:
+            for lineno, line in enumerate(fh, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    raw = json.loads(line)
+                except json.JSONDecodeError as exc:
+                    rejected += 1
+                    print(
+                        f"fleetagg: {path}:{lineno}: rejected: {exc}",
+                        file=sys.stderr,
+                    )
+                    continue
+                try:
+                    region.ingest(raw)
+                except RegionWireError as exc:
+                    rejected += 1
+                    print(
+                        f"fleetagg: {path}:{lineno}: rejected: {exc}",
+                        file=sys.stderr,
+                    )
+    region.pump(flush=True)
+    incidents = region.incidents
+    if args.incidents_out:
+        with open(args.incidents_out, "w", encoding="utf-8") as fh:
+            for incident in incidents:
+                fh.write(
+                    json.dumps(
+                        incident.to_dict(), separators=(",", ":")
+                    )
+                    + "\n"
+                )
+    if args.provenance_out:
+        with open(args.provenance_out, "w", encoding="utf-8") as fh:
+            for incident in incidents:
+                fh.write(
+                    json.dumps(
+                        incident_provenance(incident),
+                        separators=(",", ":"),
+                    )
+                    + "\n"
+                )
+    if args.state_out:
+        state = {
+            "saved_at": datetime.now(timezone.utc).isoformat(
+                timespec="seconds"
+            ),
+            "region": region.export_state(),
+            "snapshot": region.snapshot(),
+        }
+        with open(args.state_out, "w", encoding="utf-8") as fh:
+            json.dump(state, fh, indent=2)
+            fh.write("\n")
+    snapshot = region.snapshot()
+    summary = {
+        "region": args.region_id,
+        "envelopes": region.envelopes,
+        "duplicate_envelopes": region.duplicate_envelopes,
+        "rejected_envelopes": rejected,
+        "clusters": sorted(region.clusters),
+        "node_incidents": region.ingested_incidents,
+        "incidents": len(incidents),
+        "max_staleness_ms": snapshot["max_staleness_ms"],
+    }
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(
+            "fleetagg: region {region}: {envelopes} envelopes "
+            "({dups} seq-dups, {rejected} rejected) from "
+            "{clusters} clusters -> {node_incidents} node incidents "
+            "-> {incidents} federated incidents".format(
+                region=summary["region"],
+                envelopes=summary["envelopes"],
+                dups=summary["duplicate_envelopes"],
+                rejected=summary["rejected_envelopes"],
+                clusters=len(summary["clusters"]),
+                node_incidents=summary["node_incidents"],
+                incidents=summary["incidents"],
+            )
+        )
+        for incident in incidents:
+            print(
+                f"  {incident.incident_id}: {incident.domain} "
+                f"[{incident.blast_radius}] tenant="
+                f"{incident.namespace} clusters="
+                f"{','.join(incident.clusters) or '-'} "
+                f"confidence={incident.confidence:.3f}"
+            )
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.region:
+        if args.region_out or args.cluster_id:
+            print(
+                "fleetagg: --region consumes envelopes; "
+                "--region-out/--cluster-id belong to cluster runs",
+                file=sys.stderr,
+            )
+            return 2
+        return run_region(args)
+    if args.region_out and not args.cluster_id:
+        # A fallback identity would collide across cluster runs at the
+        # region (shared seq cursor = one cluster's envelope silently
+        # dropped as a duplicate) and leave members unstamped.
+        print(
+            "fleetagg: --region-out requires --cluster-id (the "
+            "envelope's per-cluster identity and seq-dedup cursor)",
+            file=sys.stderr,
+        )
+        return 2
     if args.shards < 1:
         print("fleetagg: --shards must be >= 1", file=sys.stderr)
         return 2
@@ -221,6 +402,26 @@ def main(argv: list[str] | None = None) -> int:
         for ni in shard.close_windows(flush=True)
     ]
     node_incidents.sort(key=lambda ni: ni.ts_unix_nano)
+    if args.cluster_id:
+        for ni in node_incidents:
+            ni.cluster = args.cluster_id
+    if args.region_out:
+        from tpuslo.federation.wire import (
+            encode_region_envelope,
+            region_envelope_json_line,
+        )
+
+        marks = [s.watermark_ns() for s in shards.values() if s.nodes]
+        heads = [s.fleet_head_ns() for s in shards.values()]
+        envelope = encode_region_envelope(
+            args.cluster_id,
+            args.region_seq,
+            node_incidents,
+            watermark_ns=min(marks) if marks else 0,
+            head_ns=max(heads) if heads else 0,
+        )
+        with open(args.region_out, "w", encoding="utf-8") as fh:
+            fh.write(region_envelope_json_line(envelope))
     rollup.observe(node_incidents)
     rollup.flush()
 
@@ -248,6 +449,7 @@ def main(argv: list[str] | None = None) -> int:
             "saved_at": datetime.now(timezone.utc).isoformat(
                 timespec="seconds"
             ),
+            "cluster": args.cluster_id,
             "ring": ring.export_state(),
             "rollup": rollup.export_state(),
             "shards": {

@@ -33,6 +33,17 @@ radius (no cross-tenant/cross-domain merges), and killing one
 aggregator mid-sweep — ring re-home + snapshot restore + spool
 re-send — loses and duplicates zero incidents.
 
+``--federation-sweep`` runs the federation-plane gate
+(``tpuslo.federation.sweep``): 10k simulated nodes over a two-level
+aggregator tree (cluster shard rings → region rollup) must sustain
+the single-level aggregate ingest floor, collapse every injected
+fault to exactly one region incident with cross-cluster identity
+under continuous node churn + rolling shard restarts, survive a
+mid-sweep region-aggregator kill with zero lost/duplicated
+incidents, and — under forced ingest saturation — degrade batch
+granularity and sample low-severity rows (counted by level, bounded
+incident staleness) without ever dropping a gated fault's incident.
+
 ``--burn-sweep`` runs the error-budget burn-scenario gate
 (``tpuslo.sloengine.sweep``): seeded synthetic traffic shapes (steady,
 fast-burn, slow-burn, latency regression, flapping, tenant-isolated,
@@ -267,6 +278,63 @@ def build_parser() -> argparse.ArgumentParser:
         "--fleet-no-kill",
         action="store_true",
         help="skip the mid-sweep aggregator kill (failover contract)",
+    )
+    # ---- federation-plane gate (tpuslo.federation) ---------------------
+    p.add_argument(
+        "--federation-sweep",
+        action="store_true",
+        help="run the federation-plane gate instead of B5/D3/E3: 10k "
+        "simulated nodes over a two-level aggregator tree must "
+        "sustain the single-level ingest floor, collapse every "
+        "injected fault to exactly one region incident (the "
+        "fleet-scope fault spanning clusters) under continuous node "
+        "churn + rolling shard restarts, survive a mid-sweep region "
+        "kill with zero lost/duplicated incidents, and degrade "
+        "granularity — counted by level, bounded staleness, never "
+        "dropped evidence — under forced ingest saturation",
+    )
+    p.add_argument("--federation-nodes", type=int, default=10000)
+    p.add_argument("--federation-clusters", type=int, default=4)
+    p.add_argument(
+        "--federation-shards-per-cluster", type=int, default=4
+    )
+    p.add_argument("--federation-seed", type=int, default=1337)
+    p.add_argument(
+        "--federation-churn-rate",
+        type=int,
+        default=4,
+        help="node leaves+joins per round of the seeded churn "
+        "schedule (rolling shard restarts are always included)",
+    )
+    p.add_argument("--federation-rounds", type=int, default=18)
+    p.add_argument(
+        "--federation-events-per-node", type=int, default=600
+    )
+    p.add_argument("--federation-chaos-intensity", type=float, default=1.0)
+    p.add_argument(
+        "--federation-min-ingest",
+        type=float,
+        default=5_000_000.0,
+        help="aggregate ingest floor in events/s across every "
+        "cluster's shards (the PR 9 single-level floor — federation "
+        "must not cost throughput)",
+    )
+    p.add_argument(
+        "--federation-staleness-ceiling-ms",
+        type=float,
+        default=30_000.0,
+        help="max incident staleness (region head past window end at "
+        "emission), including under forced saturation",
+    )
+    p.add_argument(
+        "--federation-no-kill",
+        action="store_true",
+        help="skip the mid-sweep region-aggregator kill",
+    )
+    p.add_argument(
+        "--federation-no-saturate",
+        action="store_true",
+        help="skip the forced-saturation lane",
     )
     p.add_argument("--crash-root", default="artifacts/crash")
     p.add_argument("--crash-seeds", default="1,2,3,4,5")
@@ -634,6 +702,111 @@ def run_fleet_gate(args) -> int:
     return 0 if report.passed else 1
 
 
+def render_federation_markdown(report) -> str:
+    lines = [
+        "# Federation-plane gate (two-level tree, 10k nodes)",
+        "",
+        f"**Overall: {'PASS' if report.passed else 'FAIL'}**",
+        "",
+        f"- {report.nodes} simulated nodes over {report.clusters} "
+        f"clusters x {report.shards_per_cluster} shards (seed "
+        f"{report.seed}, churn {report.churn_per_round}/round)",
+        f"- aggregate ingest: {report.ingest_events_per_sec:,.0f} "
+        f"events/s (floor {report.min_ingest_events_per_sec:,.0f}); "
+        f"region rollup {report.rollup_latency_ms:.1f} ms",
+        f"- cross-cluster dedup under churn: precision "
+        f"{report.precision:.3f} recall {report.recall:.3f}; "
+        f"fleet-scope incident spans "
+        f"{report.cross_cluster_members} clusters; "
+        f"{report.moved_keys} arcs re-homed across "
+        f"{report.churn.get('shard_down', 0)} shard restarts, "
+        f"{report.churn.get('node_leave', 0)} leaves / "
+        f"{report.churn.get('node_join', 0)} joins; staleness "
+        f"{report.baseline_staleness_ms:.0f} ms "
+        f"(ceiling {report.max_staleness_ms:.0f})",
+        "- region failover: "
+        + (
+            "re-sent {resent} envelope(s) ({accepted} accepted), "
+            "{suppressed} re-emitted window(s) suppressed".format(
+                resent=report.failover.get("resent_envelopes", 0),
+                accepted=report.failover.get("accepted_resends", 0),
+                suppressed=report.failover.get(
+                    "rollup_windows_suppressed", 0
+                ),
+            )
+            if report.failover
+            else "(skipped)"
+        )
+        + f" — lost {len(report.failover_lost)}, duplicated "
+        f"{len(report.failover_duplicated)}",
+        "- saturation: "
+        + (
+            "level reached {level}, sampled by level {sampled}, "
+            "precision {p:.3f} recall {r:.3f}, staleness "
+            "{stale:.0f} ms".format(
+                level=report.saturation.get("max_level_seen", 0),
+                sampled=report.saturation.get(
+                    "sampled_rows_by_level", {}
+                ),
+                p=report.saturation.get("precision", 0.0),
+                r=report.saturation.get("recall", 0.0),
+                stale=report.saturation.get("max_staleness_ms", 0.0),
+            )
+            if report.saturation
+            else "(skipped)"
+        ),
+        "",
+        "| injection | domain | tenant | expected radius | matched | "
+        "radius | exact |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for m in report.matches:
+        lines.append(
+            f"| {m.injection} | {m.domain} | {m.namespace} "
+            f"| {m.expected_blast_radius} | {m.matched_count} "
+            f"| {m.matched_blast_radius or '-'} | {m.exact} |"
+        )
+    if report.failures:
+        lines += ["", "## Failures", ""]
+        lines += [f"- {f}" for f in report.failures]
+    return "\n".join(lines) + "\n"
+
+
+def run_federation_gate(args) -> int:
+    from tpuslo.federation.sweep import run_federation_sweep
+
+    report = run_federation_sweep(
+        nodes=args.federation_nodes,
+        clusters=args.federation_clusters,
+        shards_per_cluster=args.federation_shards_per_cluster,
+        seed=args.federation_seed,
+        churn_per_round=args.federation_churn_rate,
+        rounds=args.federation_rounds,
+        events_per_node=args.federation_events_per_node,
+        chaos_intensity=args.federation_chaos_intensity,
+        kill_region=not args.federation_no_kill,
+        saturate=not args.federation_no_saturate,
+        min_ingest_events_per_sec=args.federation_min_ingest,
+        max_staleness_ms=args.federation_staleness_ceiling_ms,
+        log=lambda msg: print(f"m5gate: {msg}", file=sys.stderr),
+    )
+    if args.summary_json:
+        Path(args.summary_json).write_text(
+            json.dumps(report.to_dict(), indent=2) + "\n"
+        )
+    if args.summary_md:
+        Path(args.summary_md).write_text(
+            render_federation_markdown(report)
+        )
+    print(
+        f"m5gate: federation-sweep "
+        f"{'PASS' if report.passed else 'FAIL'}"
+        + ("" if report.passed else f" ({'; '.join(report.failures)})"),
+        file=sys.stderr,
+    )
+    return 0 if report.passed else 1
+
+
 def render_chaos_markdown(report) -> str:
     lines = [
         "# Telemetry chaos-sweep gate",
@@ -914,6 +1087,8 @@ def main(argv: list[str] | None = None) -> int:
         return run_deviceplane_gate(args)
     if args.fleet_sweep:
         return run_fleet_gate(args)
+    if args.federation_sweep:
+        return run_federation_gate(args)
     if args.crash_sweep:
         return run_crash_gate(args)
     if args.chaos_sweep:

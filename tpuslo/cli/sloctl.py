@@ -105,6 +105,18 @@ def build_parser() -> argparse.ArgumentParser:
         help="filter to one blast radius",
     )
     fl_inc.add_argument("--tenant", default="", help="filter to one tenant")
+    fl_inc.add_argument(
+        "--region",
+        default="",
+        help="filter to incidents emitted by one region aggregator "
+        "(federation plane; `fleetagg --region` output)",
+    )
+    fl_inc.add_argument(
+        "--cluster",
+        default="",
+        help="filter to incidents with at least one member node "
+        "reporting through this cluster",
+    )
     fl_inc.add_argument("--json", action="store_true")
     fl_nodes = fl_sub.add_parser(
         "nodes",
@@ -121,6 +133,13 @@ def build_parser() -> argparse.ArgumentParser:
         "--stale-only",
         action="store_true",
         help="show only nodes aged out of the watermark",
+    )
+    fl_nodes.add_argument(
+        "--cluster",
+        default="",
+        help="filter to one cluster's nodes (federation plane; the "
+        "cluster identity a `fleetagg --cluster-id` state snapshot "
+        "carries)",
     )
     fl_nodes.add_argument("--json", action="store_true")
 
@@ -316,6 +335,8 @@ def run_fleet(args) -> int:
             for i in incidents
             if (not args.radius or i.blast_radius == args.radius)
             and (not args.tenant or i.namespace == args.tenant)
+            and (not args.region or i.region == args.region)
+            and (not args.cluster or args.cluster in i.clusters)
         ]
         if args.json:
             print(
@@ -327,8 +348,8 @@ def run_fleet(args) -> int:
             return 0
         rows = [
             (
-                "INCIDENT", "DOMAIN", "RADIUS", "TENANT", "NODES",
-                "SLICES", "MEMBERS", "CONFIDENCE",
+                "INCIDENT", "DOMAIN", "RADIUS", "TENANT", "REGION",
+                "CLUSTERS", "NODES", "SLICES", "MEMBERS", "CONFIDENCE",
             )
         ]
         for i in sorted(incidents, key=lambda x: x.window_start_ns):
@@ -338,6 +359,8 @@ def run_fleet(args) -> int:
                     i.domain,
                     i.blast_radius,
                     i.namespace,
+                    i.region or "-",
+                    ",".join(i.clusters) or "-",
                     str(len(i.nodes)),
                     str(len(i.slices)),
                     str(len(i.members)),
@@ -371,6 +394,7 @@ def run_fleet(args) -> int:
         return 1
     shards = state.get("shards") or {}
     snapshots = state.get("snapshots") or {}
+    state_cluster = str(state.get("cluster", ""))
     node_rows = []
     for shard_id in sorted(shards):
         section = shards[shard_id] or {}
@@ -396,6 +420,7 @@ def run_fleet(args) -> int:
             node_rows.append(
                 {
                     "node": node,
+                    "cluster": state_cluster,
                     "shard": shard_id,
                     "slice_id": str(fragment.get("slice_id", "")),
                     "seq": int(fragment.get("seq", -1)),
@@ -406,6 +431,10 @@ def run_fleet(args) -> int:
             )
     if args.stale_only:
         node_rows = [r for r in node_rows if r["stale"]]
+    if args.cluster:
+        node_rows = [
+            r for r in node_rows if r["cluster"] == args.cluster
+        ]
     if args.json:
         print(json.dumps(node_rows, indent=2))
         return 0
@@ -413,12 +442,16 @@ def run_fleet(args) -> int:
         print("(no nodes)" if not args.stale_only else "(no stale nodes)")
         return 0
     rows = [
-        ("NODE", "SHARD", "SLICE", "SEQ", "EVENTS", "LAG(ms)", "STALE")
+        (
+            "NODE", "CLUSTER", "SHARD", "SLICE", "SEQ", "EVENTS",
+            "LAG(ms)", "STALE",
+        )
     ]
     for r in node_rows:
         rows.append(
             (
                 r["node"],
+                r["cluster"] or "-",
                 r["shard"],
                 r["slice_id"],
                 str(r["seq"]),

@@ -1,0 +1,103 @@
+"""Federation plane: two-level aggregator tree to 10k nodes.
+
+The PR 9 fleet plane federates one level up (ROADMAP #4, ARGUS scale):
+cluster-level shard rings roll node shipments into attributed node
+incidents, region-level aggregators collapse them into fleet pages
+with cross-cluster incident identity, and a backpressure/adaptive-
+sampling loop degrades batch granularity — never incident
+correctness — when ingest saturates.
+
+* :mod:`tpuslo.federation.wire` — versioned cluster→region envelope
+  (seq-deduped, watermark- and pressure-carrying).
+* :mod:`tpuslo.federation.backpressure` — leveled pressure controller
+  with hysteresis + the low-severity-only adaptive sampler.
+* :mod:`tpuslo.federation.cluster` — cluster tier: shard ring reuse,
+  online rebalancing with in-flight window handoff, upstream spool.
+* :mod:`tpuslo.federation.region` — region tier: cross-cluster
+  rollup, staleness ledger, failover snapshot.
+* :mod:`tpuslo.federation.simulator` — seeded 10k-node simulator
+  (template-cloned heartbeats, real fault-node path, churn schedule).
+* :mod:`tpuslo.federation.sweep` — the ``m5gate --federation-sweep``
+  release gate (throughput, cross-cluster dedup, region kill,
+  graceful saturation).
+"""
+
+from tpuslo.federation.backpressure import (
+    LEVEL_AGGRESSIVE,
+    LEVEL_COARSE,
+    LEVEL_NAMES,
+    LEVEL_NONE,
+    LEVEL_SAMPLE,
+    MAX_LEVEL,
+    SAMPLE_STRIDES,
+    AdaptiveSampler,
+    PressureController,
+    PressureSignal,
+    SampleResult,
+)
+from tpuslo.federation.cluster import ClusterAggregator
+from tpuslo.federation.region import (
+    FederationObserver,
+    RegionAggregator,
+)
+from tpuslo.federation.simulator import (
+    ChurnEvent,
+    FederationIngestMeasurement,
+    FederationRunResult,
+    FederationSimulator,
+    FederationTopology,
+    build_churn_plan,
+    federation_injection_plan,
+)
+from tpuslo.federation.sweep import (
+    FederationSweepReport,
+    run_federation_sweep,
+)
+from tpuslo.federation.wire import (
+    REGION_WIRE_VERSION,
+    RegionEnvelope,
+    RegionWireError,
+    decode_region_envelope,
+    encode_region_envelope,
+    load_region_envelopes,
+    node_incident_from_wire,
+    node_incident_to_wire,
+    parse_region_envelope_line,
+    region_envelope_json_line,
+)
+
+__all__ = [
+    "LEVEL_NONE",
+    "LEVEL_COARSE",
+    "LEVEL_SAMPLE",
+    "LEVEL_AGGRESSIVE",
+    "LEVEL_NAMES",
+    "MAX_LEVEL",
+    "SAMPLE_STRIDES",
+    "AdaptiveSampler",
+    "PressureController",
+    "PressureSignal",
+    "SampleResult",
+    "ClusterAggregator",
+    "FederationObserver",
+    "RegionAggregator",
+    "ChurnEvent",
+    "FederationIngestMeasurement",
+    "FederationRunResult",
+    "FederationSimulator",
+    "FederationTopology",
+    "build_churn_plan",
+    "federation_injection_plan",
+    "FederationSweepReport",
+    "run_federation_sweep",
+    "REGION_WIRE_VERSION",
+    "RegionEnvelope",
+    "RegionWireError",
+    "decode_region_envelope",
+    "encode_region_envelope",
+    "load_region_envelopes",
+    "node_incident_from_wire",
+    "node_incident_to_wire",
+    "parse_region_envelope_line",
+    "region_envelope_json_line",
+]

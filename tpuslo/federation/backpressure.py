@@ -1,0 +1,253 @@
+"""Backpressure + adaptive sampling: degrade granularity, never truth.
+
+When federation ingest saturates, the plane must shed *resolution*,
+not *evidence*: ARGUS-scale clusters produce more telemetry than any
+fixed pipeline absorbs at peak, and a diagnosis plane that silently
+drops fault evidence under load is worse than one that pages late.
+The control loop here has three hard properties:
+
+1. **Degradation is leveled and counted.**  ``PressureController``
+   maps ingest backlog to one of four levels (none → coarse batches →
+   sample low-severity → aggressive sampling) with hysteresis, so the
+   level cannot flap per observation; every observation at a degraded
+   level is counted by level, so "how degraded were we" is always
+   answerable after the fact.
+2. **Sampling never touches fault evidence.**  ``AdaptiveSampler``
+   drops only status-``ok`` rows, and only from (node, pod) groups
+   whose batch carries *no* non-ok row at all — a pod with any
+   warning/error evidence keeps every row it emitted, so an incident
+   can neither vanish nor split because the plane was saturated.
+3. **Pressure flows downstream, facts flow upstream.**  Aggregators
+   publish a :class:`PressureSignal`; agents and cluster shards
+   respond (coarser shipment cadence, higher sampling stride), and
+   the resulting sampled-row counts ride the region envelope back up
+   (``federation/wire.py``) so the region reports measured
+   degradation, not a guess.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+
+from tpuslo.columnar.schema import ColumnarBatch
+
+#: Degradation levels, least to most degraded.  Level 1 coarsens batch
+#: granularity only (ship less often, bigger merges); levels 2 and 3
+#: additionally sample low-severity rows at the strides below.
+LEVEL_NONE = 0
+LEVEL_COARSE = 1
+LEVEL_SAMPLE = 2
+LEVEL_AGGRESSIVE = 3
+
+LEVEL_NAMES: dict[int, str] = {
+    LEVEL_NONE: "none",
+    LEVEL_COARSE: "coarse_batch",
+    LEVEL_SAMPLE: "sample_low",
+    LEVEL_AGGRESSIVE: "sample_aggressive",
+}
+
+#: Keep one in ``stride`` low-severity rows at each level.
+SAMPLE_STRIDES: dict[int, int] = {
+    LEVEL_NONE: 1,
+    LEVEL_COARSE: 1,
+    LEVEL_SAMPLE: 2,
+    LEVEL_AGGRESSIVE: 4,
+}
+
+MAX_LEVEL = LEVEL_AGGRESSIVE
+
+
+@dataclass(slots=True)
+class PressureSignal:
+    """One aggregator's published ingest-pressure fact."""
+
+    source: str
+    level: int
+    backlog_events: int
+    capacity_events: int
+
+
+class PressureController:
+    """Backlog → degradation level, with release hysteresis.
+
+    The level *rises* the moment utilization (backlog over capacity)
+    crosses a threshold — saturation must be answered now — but
+    *falls* only after ``cool_observations`` consecutive readings
+    below ``release_margin`` of the current level's entry threshold,
+    so a backlog oscillating around a threshold cannot flap the whole
+    fleet's shipping cadence.
+    """
+
+    def __init__(
+        self,
+        capacity_events: int,
+        raise_at: tuple[float, float, float] = (0.5, 0.75, 0.9),
+        release_margin: float = 0.6,
+        cool_observations: int = 2,
+    ):
+        if len(raise_at) != MAX_LEVEL:
+            raise ValueError(
+                f"raise_at needs {MAX_LEVEL} thresholds, got "
+                f"{len(raise_at)}"
+            )
+        if list(raise_at) != sorted(raise_at):
+            raise ValueError("raise_at thresholds must be ascending")
+        self.capacity_events = max(1, int(capacity_events))
+        self.raise_at = tuple(float(t) for t in raise_at)
+        self.release_margin = float(release_margin)
+        self.cool_observations = max(1, int(cool_observations))
+        self.level = LEVEL_NONE
+        self._cool = 0
+        #: Observations spent at each degraded level (the "how degraded
+        #: were we" evidence); level 0 observations are not degradation.
+        self.observations_by_level: dict[int, int] = {}
+        self.transitions = 0
+
+    def observe(self, backlog_events: int) -> int:
+        """Fold one backlog reading; returns the (possibly new) level."""
+        utilization = max(0, int(backlog_events)) / self.capacity_events
+        target = sum(
+            1 for threshold in self.raise_at if utilization >= threshold
+        )
+        if target >= self.level:
+            if target > self.level:
+                self.transitions += 1
+            self.level = target
+            self._cool = 0
+        else:
+            entry = self.raise_at[self.level - 1]
+            if utilization < entry * self.release_margin:
+                self._cool += 1
+                if self._cool >= self.cool_observations:
+                    self.level = target
+                    self._cool = 0
+                    self.transitions += 1
+            else:
+                self._cool = 0
+        if self.level > LEVEL_NONE:
+            self.observations_by_level[self.level] = (
+                self.observations_by_level.get(self.level, 0) + 1
+            )
+        return self.level
+
+    def signal(self, source: str, backlog_events: int) -> PressureSignal:
+        return PressureSignal(
+            source=source,
+            level=self.level,
+            backlog_events=int(backlog_events),
+            capacity_events=self.capacity_events,
+        )
+
+    def export_state(self) -> dict[str, Any]:
+        return {
+            "level": self.level,
+            "cool": self._cool,
+            "transitions": self.transitions,
+            "observations_by_level": {
+                str(k): v for k, v in self.observations_by_level.items()
+            },
+        }
+
+    def restore_state(self, state: dict[str, Any]) -> None:
+        self.level = int(state.get("level", 0))
+        self._cool = int(state.get("cool", 0))
+        self.transitions = int(state.get("transitions", 0))
+        self.observations_by_level = {
+            int(k): int(v)
+            for k, v in (state.get("observations_by_level") or {}).items()
+        }
+
+
+@dataclass(slots=True)
+class SampleResult:
+    """One sampling pass: the surviving batch + what it cost."""
+
+    batch: ColumnarBatch
+    dropped_rows: int
+
+
+class AdaptiveSampler:
+    """Deterministic low-severity row sampling for a degraded plane.
+
+    Only status-``ok`` rows from (node, pod) groups with *zero* non-ok
+    rows in the batch are candidates; candidates keep one row in
+    ``SAMPLE_STRIDES[level]`` by a persistent running phase, so a
+    sparse heartbeat stream still passes rows at the sampled rate
+    instead of losing every row to an unlucky batch boundary.
+    """
+
+    def __init__(self) -> None:
+        self._low_seen = 0
+        #: Rows sampled out, by the level that dropped them.
+        self.sampled_rows_by_level: dict[int, int] = {}
+        #: Batches that lost at least one row, by level.
+        self.sampled_batches_by_level: dict[int, int] = {}
+
+    def sample_batch(
+        self, batch: ColumnarBatch, level: int
+    ) -> SampleResult:
+        stride = SAMPLE_STRIDES.get(min(int(level), MAX_LEVEL), 1)
+        if stride <= 1 or batch.n == 0:
+            return SampleResult(batch=batch, dropped_rows=0)
+        strings = batch.pool.strings
+        ok_codes = np.flatnonzero(
+            np.fromiter(
+                (s == "ok" for s in strings), dtype=bool, count=len(strings)
+            )
+        )
+        low = np.isin(batch.columns["status"], ok_codes)
+        if not low.any():
+            return SampleResult(batch=batch, dropped_rows=0)
+        # Pods carrying any non-ok row are gated fault evidence: every
+        # row of theirs survives, or a saturated plane could thin the
+        # signal profile under an incident and split/miss the page.
+        pkey = (batch.columns["node"].astype(np.int64) << 32) | batch.columns[
+            "pod"
+        ].astype(np.int64)
+        hot = np.unique(pkey[~low])
+        candidates = np.flatnonzero(low & ~np.isin(pkey, hot))
+        if not len(candidates):
+            return SampleResult(batch=batch, dropped_rows=0)
+        phase = (self._low_seen + np.arange(len(candidates))) % stride
+        self._low_seen += len(candidates)
+        drop = candidates[phase != 0]
+        if not len(drop):
+            return SampleResult(batch=batch, dropped_rows=0)
+        keep = np.ones(batch.n, dtype=bool)
+        keep[drop] = False
+        dropped = int(len(drop))
+        self.sampled_rows_by_level[level] = (
+            self.sampled_rows_by_level.get(level, 0) + dropped
+        )
+        self.sampled_batches_by_level[level] = (
+            self.sampled_batches_by_level.get(level, 0) + 1
+        )
+        return SampleResult(batch=batch.take(keep), dropped_rows=dropped)
+
+    def export_state(self) -> dict[str, Any]:
+        return {
+            "low_seen": self._low_seen,
+            "sampled_rows_by_level": {
+                str(k): v for k, v in self.sampled_rows_by_level.items()
+            },
+            "sampled_batches_by_level": {
+                str(k): v
+                for k, v in self.sampled_batches_by_level.items()
+            },
+        }
+
+    def restore_state(self, state: dict[str, Any]) -> None:
+        self._low_seen = int(state.get("low_seen", 0))
+        self.sampled_rows_by_level = {
+            int(k): int(v)
+            for k, v in (state.get("sampled_rows_by_level") or {}).items()
+        }
+        self.sampled_batches_by_level = {
+            int(k): int(v)
+            for k, v in (
+                state.get("sampled_batches_by_level") or {}
+            ).items()
+        }

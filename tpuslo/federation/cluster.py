@@ -1,0 +1,386 @@
+"""Cluster tier of the federation tree: shards in, one envelope out.
+
+A :class:`ClusterAggregator` owns one cluster's consistent-hash ring
+of :class:`~tpuslo.fleet.aggregator.AggregatorShard`\\ s (the PR 9
+machinery, reused verbatim) and adds the three federation behaviors:
+
+* **Upstream rollup shipping** — closed windows attribute into
+  :class:`~tpuslo.fleet.rollup.NodeIncident`\\ s stamped with the
+  cluster identity and ship to the region inside a versioned
+  :mod:`~tpuslo.federation.wire` envelope with a monotonic per-cluster
+  ``seq``; a bounded envelope spool makes the cluster → region hop
+  at-least-once across a region-aggregator kill.
+* **Backpressure response** — the cluster publishes its own ingest
+  pressure (shard backlog over capacity) and honors the max of its
+  own level and the region's published level: shards coarsen batch
+  granularity (bigger coalesce merges), and at sampling levels the
+  decoded batches shed low-severity rows through the
+  :class:`~tpuslo.federation.backpressure.AdaptiveSampler` — which
+  structurally cannot touch a pod carrying fault evidence.
+* **Online ring rebalancing** — shard join/leave re-homes ONLY the
+  moved (node, slice) arcs (``HashRing.rehome_plan``), handing each
+  moved node's in-flight window state across with
+  ``export_node`` → ``absorb_node_state`` → ``drop_node`` so a window
+  open at the instant of churn closes exactly once on exactly one
+  shard.  Cordoned arcs (remediation holds) are never rebalancing
+  targets.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable
+
+from tpuslo.federation.backpressure import (
+    LEVEL_SAMPLE,
+    MAX_LEVEL,
+    AdaptiveSampler,
+    PressureController,
+    PressureSignal,
+)
+from tpuslo.federation.region import FederationObserver
+from tpuslo.federation.wire import encode_region_envelope
+from tpuslo.fleet.aggregator import AggregatorShard, FleetObserver
+from tpuslo.fleet.ring import HashRing
+from tpuslo.fleet.rollup import NodeIncident
+from tpuslo.fleet.wire import Shipment, decode_shipment
+from tpuslo.ingest.gate import GateConfig
+
+#: Spooled upstream envelopes kept for region-failover re-send; the
+#: region's durable snapshot cadence bounds how far back a restore can
+#: reach, so the spool needs depth, not history.
+MAX_SPOOLED_ENVELOPES = 512
+
+
+class ClusterAggregator:
+    """One cluster: shard ring + pressure loop + upstream shipping."""
+
+    def __init__(
+        self,
+        cluster_id: str,
+        shard_ids: Iterable[str],
+        *,
+        gate_config: GateConfig | None = None,
+        window_ns: int = 2_000_000_000,
+        lateness_ns: int = 1_000_000_000,
+        stale_after_ns: int = 30_000_000_000,
+        min_confidence: float = 0.5,
+        capacity_events: int = 200_000,
+        attributor=None,
+        observer: FederationObserver | None = None,
+        fleet_observer: FleetObserver | None = None,
+        skip_healthy_groups: bool = True,
+    ):
+        self.cluster_id = cluster_id
+        self._shard_kwargs = {
+            "gate_config": gate_config,
+            "window_ns": window_ns,
+            "lateness_ns": lateness_ns,
+            "stale_after_ns": stale_after_ns,
+            "min_confidence": min_confidence,
+            # Federation scale: healthy heartbeat groups skip the
+            # attributor (counted; see AggregatorShard) — a 10k-node
+            # region cannot afford 40k no-op attributions per window.
+            "skip_healthy_groups": skip_healthy_groups,
+        }
+        self._attributor = attributor
+        self._fleet_observer = fleet_observer
+        self.ring = HashRing(list(shard_ids))
+        self.shards: dict[str, AggregatorShard] = {
+            sid: self._new_shard(sid) for sid in self.ring.shards
+        }
+        self._base_coalesce = {
+            sid: shard.coalesce_events
+            for sid, shard in self.shards.items()
+        }
+        self.pressure = PressureController(capacity_events)
+        self.sampler = AdaptiveSampler()
+        self._observer = observer or FederationObserver()
+        #: Region-published level (downstream propagation); the
+        #: effective level is the max of this and our own.
+        self._upstream_level = 0
+        self._seq = -1
+        self._spool: list[dict[str, Any]] = []
+        #: Sampler counts already shipped upstream — the envelope
+        #: carries the per-envelope DELTA (the wire contract), not the
+        #: lifetime cumulative, or a region summing across envelopes
+        #: would overcount every level by its whole history.
+        self._shipped_sampled: dict[int, int] = {}
+        self.churn_rebalances: dict[str, int] = {}
+        self.shipments = 0
+        self.ingested_events = 0
+
+    def _new_shard(self, shard_id: str) -> AggregatorShard:
+        return AggregatorShard(
+            shard_id,
+            attributor=self._attributor,
+            observer=self._fleet_observer,
+            **self._shard_kwargs,
+        )
+
+    # ---- ingest --------------------------------------------------------
+
+    def effective_level(self) -> int:
+        return min(max(self.pressure.level, self._upstream_level), MAX_LEVEL)
+
+    def set_upstream_pressure(self, level: int) -> None:
+        self._upstream_level = max(0, min(int(level), MAX_LEVEL))
+
+    def ingest(self, payload: dict[str, Any] | Shipment) -> bool:
+        """Route one node shipment to its ring-assigned shard.
+
+        At sampling levels the batch is decoded here (the shard would
+        decode anyway) and low-severity rows shed before the shard
+        pays for gating them; the seq-duplicate peek still runs first
+        so spool replays stay cheap.
+        """
+        level = self.effective_level()
+        shipment = payload
+        if level >= LEVEL_SAMPLE:
+            if not isinstance(payload, Shipment):
+                if self._is_seq_duplicate(payload):
+                    # Let the owning shard account the duplicate
+                    # without paying the decode.
+                    node = str(payload.get("node", ""))
+                    owner = self.ring.shard_for_node(
+                        node, str(payload.get("slice_id") or "")
+                    )
+                    return self.shards[owner].ingest(payload)
+                shipment = decode_shipment(payload)
+            result = self.sampler.sample_batch(shipment.batch, level)
+            if result.dropped_rows:
+                self._observer.sampled_rows(level, result.dropped_rows)
+                shipment = Shipment(
+                    node=shipment.node,
+                    seq=shipment.seq,
+                    batch=result.batch,
+                    head_ns=shipment.head_ns,
+                    slice_id=shipment.slice_id,
+                )
+        node = (
+            shipment.node
+            if isinstance(shipment, Shipment)
+            else str(shipment.get("node", ""))
+        )
+        slice_id = (
+            shipment.slice_id
+            if isinstance(shipment, Shipment)
+            else str(shipment.get("slice_id") or "")
+        )
+        owner = self.ring.shard_for_node(node, slice_id)
+        shard = self.shards[owner]
+        accepted = shard.ingest(shipment)
+        if accepted:
+            self.shipments += 1
+            self.ingested_events += (
+                shipment.events
+                if isinstance(shipment, Shipment)
+                else int(shipment.get("events", 0))
+            )
+        return accepted
+
+    def _is_seq_duplicate(self, payload: dict[str, Any]) -> bool:
+        node = payload.get("node")
+        if not isinstance(node, str) or not node:
+            return False
+        owner = self.ring.shard_for_node(
+            node, str(payload.get("slice_id") or "")
+        )
+        state = self.shards[owner].nodes.get(node)
+        if state is None:
+            return False
+        try:
+            return int(payload["seq"]) <= state.seq
+        except (KeyError, TypeError, ValueError):
+            return False
+
+    # ---- backpressure loop ---------------------------------------------
+
+    def backlog_events(self) -> int:
+        return sum(s.backlog_events() for s in self.shards.values())
+
+    def observe_pressure(self) -> PressureSignal:
+        """Fold the current backlog; respond by coarsening granularity.
+
+        Shards widen their coalesce threshold by one power of two per
+        level — fewer, bigger gate passes — which is exactly the
+        degradation that costs resolution (latency to close) and never
+        correctness.  The published signal is what node agents consume
+        to coarsen their shipping cadence.
+        """
+        backlog = self.backlog_events()
+        self.pressure.observe(backlog)
+        level = self.effective_level()
+        for sid, shard in self.shards.items():
+            base = self._base_coalesce.get(sid, shard.coalesce_events)
+            shard.coalesce_events = base << level
+        self._observer.backpressure_level(self.cluster_id, level)
+        return self.pressure.signal(self.cluster_id, backlog)
+
+    # ---- upstream shipping ---------------------------------------------
+
+    def watermark_ns(self) -> int:
+        marks = [
+            s.watermark_ns() for s in self.shards.values() if s.nodes
+        ]
+        return min(marks) if marks else 0
+
+    def head_ns(self) -> int:
+        heads = [s.fleet_head_ns() for s in self.shards.values()]
+        return max(heads) if heads else 0
+
+    def close_and_ship(self, flush: bool = False) -> dict[str, Any]:
+        """Close attributable windows; encode one upstream envelope.
+
+        An envelope ships even when no windows closed: the cluster
+        watermark must keep advancing at the region or one quiet
+        cluster would freeze every cross-cluster session forever.
+        """
+        incidents: list[NodeIncident] = []
+        for shard in self.shards.values():
+            incidents.extend(shard.close_windows(flush=flush))
+        for incident in incidents:
+            incident.cluster = self.cluster_id
+        self._seq += 1
+        sampled_delta = {
+            level: count - self._shipped_sampled.get(level, 0)
+            for level, count in (
+                self.sampler.sampled_rows_by_level.items()
+            )
+            if count - self._shipped_sampled.get(level, 0) > 0
+        }
+        self._shipped_sampled = dict(
+            self.sampler.sampled_rows_by_level
+        )
+        payload = encode_region_envelope(
+            self.cluster_id,
+            self._seq,
+            incidents,
+            watermark_ns=self.watermark_ns(),
+            head_ns=self.head_ns(),
+            pressure_level=self.effective_level(),
+            sampled_rows=sampled_delta,
+        )
+        self._spool.append(payload)
+        if len(self._spool) > MAX_SPOOLED_ENVELOPES:
+            del self._spool[: -MAX_SPOOLED_ENVELOPES]
+        return payload
+
+    def resend_since(self, seq: int) -> list[dict[str, Any]]:
+        """Spooled envelopes past ``seq`` (region failover re-send)."""
+        return [p for p in self._spool if p["seq"] > seq]
+
+    # ---- online ring rebalancing ---------------------------------------
+
+    def known_arcs(self) -> list[tuple[str, str]]:
+        return [
+            (node, state.slice_id)
+            for shard in self.shards.values()
+            for node, state in shard.nodes.items()
+        ]
+
+    def _count_rebalance(self, kind: str, moved: int) -> None:
+        self.churn_rebalances[kind] = (
+            self.churn_rebalances.get(kind, 0) + 1
+        )
+        self._observer.churn_rebalance(kind, moved)
+
+    def add_shard(self, shard_id: str) -> dict[str, tuple[str, str]]:
+        """Join one shard; re-home only the arcs it now owns."""
+        arcs = self.known_arcs()
+        prior = self.ring.assignments(arcs)
+        self.ring.add_shard(shard_id)
+        shard = self._new_shard(shard_id)
+        self.shards[shard_id] = shard
+        self._base_coalesce[shard_id] = shard.coalesce_events
+        plan = self.ring.rehome_plan(arcs, prior)
+        for node, (old_owner, new_owner) in plan.items():
+            fragment = self.shards[old_owner].export_node(node)
+            if fragment is None:
+                continue
+            self.shards[new_owner].absorb_node_state(node, fragment)
+            self.shards[old_owner].drop_node(node)
+        self._count_rebalance("shard_join", len(plan))
+        return plan
+
+    def remove_shard(self, shard_id: str) -> dict[str, tuple[str, str]]:
+        """Graceful leave: hand every owned arc to its new owner.
+
+        This is the rolling-restart path — the leaving shard is alive
+        to export, so in-flight windows move losslessly.  (A *killed*
+        shard instead restores from its durable snapshot, the PR 9
+        failover path.)
+        """
+        if shard_id not in self.shards:
+            raise ValueError(f"unknown shard {shard_id!r}")
+        leaving = self.shards[shard_id]
+        moved: dict[str, tuple[str, str]] = {}
+        self.ring.remove_shard(shard_id)
+        for node in sorted(leaving.nodes):
+            fragment = leaving.export_node(node)
+            if fragment is None:
+                continue
+            new_owner = self.ring.shard_for_node(
+                node, str(fragment.get("slice_id") or "")
+            )
+            self.shards[new_owner].absorb_node_state(node, fragment)
+            moved[node] = (shard_id, new_owner)
+        del self.shards[shard_id]
+        self._base_coalesce.pop(shard_id, None)
+        self._count_rebalance("shard_leave", len(moved))
+        return moved
+
+    # ---- reporting / failover snapshot ---------------------------------
+
+    def snapshot(self) -> dict[str, Any]:
+        return {
+            "cluster": self.cluster_id,
+            "shards": {
+                sid: shard.snapshot()
+                for sid, shard in self.shards.items()
+            },
+            "upstream_seq": self._seq,
+            "pressure_level": self.effective_level(),
+            "sampled_rows_by_level": {
+                str(k): v
+                for k, v in self.sampler.sampled_rows_by_level.items()
+            },
+            "churn_rebalances": dict(self.churn_rebalances),
+        }
+
+    def export_state(self) -> dict[str, Any]:
+        return {
+            "cluster": self.cluster_id,
+            "upstream_seq": self._seq,
+            "shipped_sampled": {
+                str(k): v for k, v in self._shipped_sampled.items()
+            },
+            "ring": self.ring.export_state(),
+            "pressure": self.pressure.export_state(),
+            "sampler": self.sampler.export_state(),
+            "shards": {
+                sid: shard.export_state()
+                for sid, shard in self.shards.items()
+            },
+        }
+
+    def restore_state(self, state: dict[str, Any]) -> None:
+        self._seq = int(state.get("upstream_seq", self._seq))
+        self._shipped_sampled = {
+            int(k): int(v)
+            for k, v in (state.get("shipped_sampled") or {}).items()
+        }
+        if state.get("ring"):
+            self.ring.restore_state(state["ring"])
+        if state.get("pressure"):
+            self.pressure.restore_state(state["pressure"])
+        if state.get("sampler"):
+            self.sampler.restore_state(state["sampler"])
+        for sid, shard_state in (state.get("shards") or {}).items():
+            shard = self.shards.get(sid)
+            if shard is None:
+                shard = self._new_shard(sid)
+                self.shards[sid] = shard
+                self._base_coalesce[sid] = shard.coalesce_events
+                if sid not in self.ring.shards:
+                    self.ring.add_shard(sid)
+            shard.restore_state(shard_state)

@@ -1,0 +1,264 @@
+"""Region tier of the federation tree: cross-cluster incident identity.
+
+The :class:`RegionAggregator` is the root of the two-level tree.  It
+ingests :mod:`~tpuslo.federation.wire` envelopes from cluster
+aggregators (per-cluster seq dedup — the at-least-once hop), merges
+their node incidents into ONE time-ordered stream, and folds them
+through a region-stamped :class:`~tpuslo.fleet.rollup.FleetRollup`.
+Cross-cluster incident identity is structural, not configured: the
+rollup's session key is (namespace, fault domain), so the same fault
+domain × blast radius collapses to one :class:`FleetIncident` even
+when its member nodes reported through different clusters — the
+members block simply records which clusters contributed.
+
+The region also owns the top of the backpressure loop (its backlog of
+un-rolled incidents publishes a level every pump; clusters take the
+max of it and their own), and the *staleness* ledger: every emitted
+page records how far the region head had advanced past the page's
+window end, which is the price the plane paid — in observable
+lateness, never in lost evidence — for saturation-induced coarsening.
+
+Snapshot/restore rides the PR 4 runtime registry: a killed region
+aggregator restores its rollup state (including the emitted-window
+registry, so an in-flight fault does not page twice) and its
+per-cluster seq cursors; clusters re-send spooled envelopes past the
+restored cursor, and the seq dedup + emitted-window registry make the
+overlap harmless.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from tpuslo.federation.backpressure import PressureController
+from tpuslo.federation.wire import (
+    RegionEnvelope,
+    decode_region_envelope,
+    node_incident_from_wire,
+    node_incident_to_wire,
+)
+from tpuslo.fleet.rollup import FleetIncident, FleetRollup, NodeIncident
+
+
+class FederationObserver:
+    """Duck-typed metrics bridge (AgentMetrics.federation_observer)."""
+
+    def region_ingested(self, cluster: str, incidents: int) -> None: ...
+
+    def backpressure_level(self, source: str, level: int) -> None: ...
+
+    def sampled_rows(self, level: int, rows: int) -> None: ...
+
+    def churn_rebalance(self, kind: str, moved: int) -> None: ...
+
+    def incident_staleness_ms(self, ms: float) -> None: ...
+
+
+@dataclass(slots=True)
+class _ClusterState:
+    """Per-cluster ingest cursor at the region."""
+
+    seq: int = -1
+    watermark_ns: int = 0
+    head_ns: int = 0
+    envelopes: int = 0
+    incidents: int = 0
+    pressure_level: int = 0
+
+
+class RegionAggregator:
+    """Root aggregator: envelopes in, region-stamped fleet pages out."""
+
+    def __init__(
+        self,
+        region_id: str = "region-0",
+        rollup_gap_ns: int = 5_000_000_000,
+        capacity_incidents: int = 4096,
+        observer: FederationObserver | None = None,
+        on_incident: Callable[[FleetIncident], None] | None = None,
+    ):
+        self.region_id = region_id
+        self.rollup = FleetRollup(
+            gap_ns=rollup_gap_ns,
+            on_incident=on_incident,
+            region=region_id,
+        )
+        self.clusters: dict[str, _ClusterState] = {}
+        self._pending: list[NodeIncident] = []
+        self.pressure = PressureController(capacity_incidents)
+        self._observer = observer or FederationObserver()
+        self.incidents: list[FleetIncident] = []
+        self.envelopes = 0
+        self.duplicate_envelopes = 0
+        self.ingested_incidents = 0
+        self.max_staleness_ms = 0.0
+
+    # ---- ingest --------------------------------------------------------
+
+    def ingest(
+        self, payload: dict[str, Any] | RegionEnvelope
+    ) -> bool:
+        """Accept one envelope; False when dropped as a seq duplicate."""
+        if not isinstance(payload, RegionEnvelope):
+            # Peek the header before paying the per-incident decode:
+            # failover re-sends are mostly duplicates.
+            peek_cluster = payload.get("cluster")
+            state = (
+                self.clusters.get(peek_cluster)
+                if isinstance(peek_cluster, str)
+                else None
+            )
+            if state is not None:
+                try:
+                    if int(payload["seq"]) <= state.seq:
+                        self.duplicate_envelopes += 1
+                        return False
+                except (KeyError, TypeError, ValueError):
+                    pass
+            payload = decode_region_envelope(payload)
+        state = self.clusters.get(payload.cluster)
+        if state is None:
+            state = _ClusterState()
+            self.clusters[payload.cluster] = state
+        if payload.seq <= state.seq:
+            self.duplicate_envelopes += 1
+            return False
+        state.seq = payload.seq
+        state.envelopes += 1
+        state.incidents += len(payload.incidents)
+        state.pressure_level = payload.pressure_level
+        if payload.watermark_ns > state.watermark_ns:
+            state.watermark_ns = payload.watermark_ns
+        if payload.head_ns > state.head_ns:
+            state.head_ns = payload.head_ns
+        self._pending.extend(payload.incidents)
+        self.envelopes += 1
+        self.ingested_incidents += len(payload.incidents)
+        self._observer.region_ingested(
+            payload.cluster, len(payload.incidents)
+        )
+        return True
+
+    # ---- watermarks + rollup -------------------------------------------
+
+    def watermark_ns(self) -> int:
+        """Min cluster watermark: the region's session-close clock."""
+        marks = [
+            s.watermark_ns
+            for s in self.clusters.values()
+            if s.watermark_ns
+        ]
+        return min(marks) if marks else 0
+
+    def head_ns(self) -> int:
+        heads = [s.head_ns for s in self.clusters.values()]
+        return max(heads) if heads else 0
+
+    def pump(self, flush: bool = False) -> list[FleetIncident]:
+        """Fold buffered incidents; close quiet cross-cluster sessions.
+
+        Buffered incidents sort by timestamp before the rollup sees
+        them: clusters flush in cluster order, so members of one fault
+        that reported through different clusters must coalesce before
+        any session-close decision — the same discipline fleetagg
+        applies one level down.
+        """
+        self._pending.sort(key=lambda ni: ni.ts_unix_nano)
+        emitted = list(self.rollup.observe(self._pending))
+        self._pending = []
+        if flush:
+            emitted.extend(self.rollup.flush())
+        else:
+            watermark = self.watermark_ns()
+            if watermark:
+                emitted.extend(self.rollup.close_up_to(watermark))
+        head = self.head_ns()
+        for incident in emitted:
+            staleness_ms = max(
+                0.0, (head - incident.window_end_ns) / 1e6
+            )
+            if staleness_ms > self.max_staleness_ms:
+                self.max_staleness_ms = staleness_ms
+            self._observer.incident_staleness_ms(staleness_ms)
+        self.incidents.extend(emitted)
+        return emitted
+
+    def observe_pressure(self) -> int:
+        """Publish the region's own backlog as a downstream level."""
+        backlog = len(self._pending) + self.rollup.open_groups()
+        level = self.pressure.observe(backlog)
+        self._observer.backpressure_level(self.region_id, level)
+        return level
+
+    # ---- reporting / failover snapshot ---------------------------------
+
+    def snapshot(self) -> dict[str, Any]:
+        return {
+            "region": self.region_id,
+            "clusters": {
+                cid: {
+                    "seq": s.seq,
+                    "watermark_ns": s.watermark_ns,
+                    "head_ns": s.head_ns,
+                    "envelopes": s.envelopes,
+                    "incidents": s.incidents,
+                    "pressure_level": s.pressure_level,
+                }
+                for cid, s in sorted(self.clusters.items())
+            },
+            "envelopes": self.envelopes,
+            "duplicate_envelopes": self.duplicate_envelopes,
+            "ingested_incidents": self.ingested_incidents,
+            "incidents_emitted": self.rollup.incidents_emitted,
+            "open_groups": self.rollup.open_groups(),
+            "max_staleness_ms": round(self.max_staleness_ms, 3),
+            "pressure_level": self.pressure.level,
+        }
+
+    def export_state(self) -> dict[str, Any]:
+        return {
+            "region": self.region_id,
+            "rollup": self.rollup.export_state(),
+            "clusters": {
+                cid: {
+                    "seq": s.seq,
+                    "watermark_ns": s.watermark_ns,
+                    "head_ns": s.head_ns,
+                    "envelopes": s.envelopes,
+                    "incidents": s.incidents,
+                    "pressure_level": s.pressure_level,
+                }
+                for cid, s in self.clusters.items()
+            },
+            "pending": [
+                node_incident_to_wire(ni) for ni in self._pending
+            ],
+            "pressure": self.pressure.export_state(),
+            "max_staleness_ms": self.max_staleness_ms,
+        }
+
+    def restore_state(self, state: dict[str, Any]) -> None:
+        self.region_id = str(state.get("region", self.region_id))
+        self.rollup.region = self.region_id
+        if state.get("rollup"):
+            self.rollup.restore_state(state["rollup"])
+        self.clusters = {}
+        for cid, raw in (state.get("clusters") or {}).items():
+            self.clusters[str(cid)] = _ClusterState(
+                seq=int(raw.get("seq", -1)),
+                watermark_ns=int(raw.get("watermark_ns", 0)),
+                head_ns=int(raw.get("head_ns", 0)),
+                envelopes=int(raw.get("envelopes", 0)),
+                incidents=int(raw.get("incidents", 0)),
+                pressure_level=int(raw.get("pressure_level", 0)),
+            )
+        self._pending = [
+            node_incident_from_wire(raw)
+            for raw in (state.get("pending") or [])
+        ]
+        if state.get("pressure"):
+            self.pressure.restore_state(state["pressure"])
+        self.max_staleness_ms = float(
+            state.get("max_staleness_ms", 0.0)
+        )

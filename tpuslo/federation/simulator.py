@@ -1,0 +1,721 @@
+"""Seeded 10k-node federation simulator: two-level tree under churn.
+
+An order of magnitude past the PR 9 fleet lane (ARGUS diagnoses
+10,000-GPU clusters), which forces three structural changes this
+simulator exists to prove out:
+
+* **Template-cloned heartbeats.**  At 10k nodes the per-node Python
+  pipeline is the bottleneck, and it is not the thing under test for
+  healthy nodes: a healthy node's shipment is ``pods_per_node``
+  status-ok heartbeat rows.  Those clone from one columnar template
+  (pool swap for identity, fresh bytes only for the shifted timestamp
+  column), while every node inside a fault's blast scope still runs
+  the REAL agent path — event dicts, optional per-host chaos, its own
+  :class:`~tpuslo.columnar.gate.ColumnarGate`, the wire contract — so
+  the evidence that becomes incidents is never synthetic-shortcut.
+* **Continuous churn.**  A seeded schedule of node leaves/joins plus
+  rolling cluster-shard restarts runs every round: dead nodes age out
+  of watermarks instead of freezing them, joins place fresh arcs, and
+  each shard restart exercises the online-rebalance handoff
+  (``export_node`` → ``absorb_node_state`` → ``drop_node``) mid-window.
+* **Region failover.**  The region aggregator can be killed mid-run:
+  its object is dropped, the last durable snapshot (PR 4 runtime
+  registry) restores the rollup + per-cluster cursors, and cluster
+  envelope spools re-send past the restored seq — at-least-once on
+  the second hop, exactly-once pages via the emitted-window registry.
+
+Backpressure is live, not scripted: clusters publish their measured
+backlog level, node agents coarsen heartbeat cadence in response,
+cluster shards widen coalesce and (at sampling levels) shed
+low-severity rows — forced saturation is just a small configured
+capacity, and every degradation is counted by level.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import numpy as np
+
+from tpuslo.chaos.telemetry import ChaosScenario, ChaosStream
+from tpuslo.columnar.gate import ColumnarGate
+from tpuslo.columnar.schema import from_rows
+from tpuslo.federation.cluster import ClusterAggregator
+from tpuslo.federation.region import FederationObserver, RegionAggregator
+from tpuslo.fleet.aggregator import FleetObserver
+from tpuslo.fleet.rollup import FleetIncident
+from tpuslo.fleet.simulator import (
+    EPOCH_NS,
+    HEARTBEAT_SIGNAL,
+    FaultInjection,
+    FleetTopology,
+    build_template_payloads,
+    events_for_round,
+)
+from tpuslo.fleet.wire import encode_shipment
+from tpuslo.ingest.gate import GateConfig
+from tpuslo.schema.types import ProbeEventV1
+from tpuslo.signals.generator import SIGNAL_UNITS
+
+
+@dataclass(frozen=True)
+class FederationTopology(FleetTopology):
+    """Fleet layout plus the cluster tier of the federation tree.
+
+    Slices stripe across clusters (``slice_index % clusters``), so a
+    multi-slice fault naturally spans cluster boundaries — exactly the
+    shape the cross-cluster incident-identity contract must survive.
+    """
+
+    clusters: int = 4
+
+    @classmethod
+    def for_nodes(
+        cls, nodes: int, clusters: int = 4
+    ) -> "FederationTopology":
+        return cls(
+            nodes=nodes,
+            nodes_per_slice=min(64, max(2, nodes // 4)),
+            clusters=max(1, clusters),
+        )
+
+    def cluster_index(self, node_i: int) -> int:
+        return self.slice_index(node_i) % self.clusters
+
+    def cluster_name(self, i: int) -> str:
+        return f"cluster-{i}"
+
+    def cluster_of_node(self, node_i: int) -> str:
+        return self.cluster_name(self.cluster_index(node_i))
+
+    def first_node_of_slice(self, slice_i: int) -> int:
+        return slice_i * self.nodes_per_slice
+
+
+def federation_injection_plan(
+    topology: FederationTopology, start_round: int = 2
+) -> list[FaultInjection]:
+    """The canonical federation sweep plan.
+
+    Same distinct-(namespace, domain) discipline as the PR 9 plan —
+    ground truth is exactly one fleet incident per injection — plus
+    the federation-specific probes: the fleet-scope fault spans slices
+    in DIFFERENT clusters (cross-cluster identity must hold), and the
+    cross-tenant / cross-domain concurrency probes land in different
+    clusters too (the merges that must NOT happen, now across the
+    region hop).
+    """
+    t_a, t_b = topology.tenants[0], topology.tenants[1]
+    slices = topology.slices()
+    nodes = topology.nodes
+    r = start_round
+
+    def node_in_slice(slice_i: int, offset: int) -> int:
+        return min(
+            nodes - 1,
+            topology.first_node_of_slice(slice_i % slices) + offset,
+        )
+
+    return [
+        FaultInjection(
+            name="pod-cpu", label="cpu_throttle", namespace=t_a,
+            scope="pod", at_round=r,
+            target=(node_in_slice(0, 1), topology.tenant_pods(t_a)[0]),
+        ),
+        FaultInjection(
+            name="node-mem", label="memory_pressure", namespace=t_b,
+            scope="node", at_round=r + 2,
+            target=node_in_slice(1, 2),
+        ),
+        FaultInjection(
+            name="slice-ici", label="ici_drop", namespace=t_a,
+            scope="slice", at_round=r + 4, target=0,
+        ),
+        # Cross-cluster identity probe: one fault spanning slices that
+        # stripe to different clusters must page ONCE at the region.
+        FaultInjection(
+            name="fed-hbm", label="hbm_pressure", namespace=t_b,
+            scope="fleet", at_round=r + 6,
+            target=tuple(range(min(3, slices))),
+        ),
+        # Cross-tenant probe, cross-cluster flavored: same domain, same
+        # instant, two tenants in two clusters — exactly two pages.
+        FaultInjection(
+            name="xt-dns-a", label="dns_latency", namespace=t_a,
+            scope="node", at_round=r + 8, target=node_in_slice(0, 3),
+        ),
+        FaultInjection(
+            name="xt-dns-b", label="dns_latency", namespace=t_b,
+            scope="node", at_round=r + 8, target=node_in_slice(1, 4),
+        ),
+        # Cross-domain probe: same tenant, same instant, two domains in
+        # two clusters.
+        FaultInjection(
+            name="xd-xla", label="xla_recompile_storm", namespace=t_a,
+            scope="node", at_round=r + 10, target=node_in_slice(2, 5),
+        ),
+        FaultInjection(
+            name="xd-dcn", label="dcn_degradation", namespace=t_a,
+            scope="node", at_round=r + 10, target=node_in_slice(3, 6),
+        ),
+    ]
+
+
+@dataclass(frozen=True)
+class ChurnEvent:
+    """One scheduled churn action."""
+
+    round_i: int
+    kind: str  # node_leave | node_join | shard_down | shard_up
+    node_i: int = -1
+    cluster: str = ""
+    shard_id: str = ""
+
+
+def build_churn_plan(
+    topology: FederationTopology,
+    rounds: int,
+    injections: list[FaultInjection],
+    node_churn_per_round: int = 2,
+    seed: int = 1337,
+    rolling_restart: bool = True,
+) -> list[ChurnEvent]:
+    """Seeded continuous churn: leaves + joins every round, plus one
+    rolling restart of each cluster's first shard, staggered.
+
+    Nodes inside any injection's blast scope are protected from
+    leaving — ground truth must stay exact — which is also realistic:
+    the interesting failure mode is *healthy* capacity churning while
+    a fault is being diagnosed, not the faulty node conveniently
+    disappearing from the ground truth.
+    """
+    protected = {
+        node_i
+        for injection in injections
+        for node_i, _ in injection.affected(topology)
+    }
+    rng = random.Random(seed * 7919 + 13)
+    candidates = [
+        i for i in range(topology.nodes) if i not in protected
+    ]
+    events: list[ChurnEvent] = []
+    next_join = topology.nodes
+    for round_i in range(1, max(1, rounds - 2)):
+        for _ in range(max(0, node_churn_per_round)):
+            if candidates:
+                pick = candidates.pop(rng.randrange(len(candidates)))
+                events.append(
+                    ChurnEvent(round_i, "node_leave", node_i=pick)
+                )
+            events.append(
+                ChurnEvent(round_i, "node_join", node_i=next_join)
+            )
+            next_join += 1
+    if rolling_restart:
+        for ci in range(topology.clusters):
+            down = 2 + 2 * ci
+            if down + 1 >= rounds - 2:
+                break
+            cluster = topology.cluster_name(ci)
+            shard = f"{cluster}-agg-0"
+            events.append(
+                ChurnEvent(
+                    down, "shard_down", cluster=cluster, shard_id=shard
+                )
+            )
+            events.append(
+                ChurnEvent(
+                    down + 1, "shard_up", cluster=cluster, shard_id=shard
+                )
+            )
+    return events
+
+
+@dataclass
+class FederationRunResult:
+    """Outcome of one federation correctness-lane run."""
+
+    incidents: list[FleetIncident]
+    injections: list[FaultInjection]
+    rounds: int
+    region_snapshot: dict[str, Any] = field(default_factory=dict)
+    cluster_snapshots: dict[str, dict[str, Any]] = field(
+        default_factory=dict
+    )
+    failover: dict[str, Any] = field(default_factory=dict)
+    churn: dict[str, int] = field(default_factory=dict)
+    sampled_rows_by_level: dict[int, int] = field(default_factory=dict)
+    pressure_observations_by_level: dict[int, int] = field(
+        default_factory=dict
+    )
+    max_level_seen: int = 0
+    max_staleness_ms: float = 0.0
+    rollup_duplicates_suppressed: int = 0
+
+
+@dataclass
+class FederationIngestMeasurement:
+    """Outcome of one federation throughput-lane run."""
+
+    nodes: int
+    clusters: int
+    shards: int
+    total_events: int
+    admitted_events: int
+    events_per_sec: float
+    per_cluster_events_per_sec: dict[str, float]
+    rollup_latency_ms: float
+    region_incidents: int
+    max_staleness_ms: float
+
+
+class FederationSimulator:
+    """Seeded federation: clusters + region + churn in one box."""
+
+    def __init__(
+        self,
+        topology: FederationTopology,
+        shards_per_cluster: int = 2,
+        seed: int = 1337,
+        chaos_intensity: float = 0.0,
+        round_s: float = 1.0,
+        window_ns: int = 2_000_000_000,
+        rollup_gap_ns: int = 5_000_000_000,
+        stale_after_ns: int = 8_000_000_000,
+        cluster_capacity_events: int = 500_000,
+        region_capacity_incidents: int = 8192,
+        heartbeat_every: int = 2,
+        node_dedup_window: int = 4096,
+        observer: FederationObserver | None = None,
+        fleet_observer: FleetObserver | None = None,
+    ):
+        self.topology = topology
+        self.seed = seed
+        self.chaos_intensity = chaos_intensity
+        self.round_ns = int(round_s * 1e9)
+        self.window_ns = window_ns
+        self.rollup_gap_ns = rollup_gap_ns
+        self.heartbeat_every = max(1, int(heartbeat_every))
+        self.observer = observer or FederationObserver()
+        self._region_capacity = region_capacity_incidents
+        self.clusters: dict[str, ClusterAggregator] = {}
+        for ci in range(topology.clusters):
+            cid = topology.cluster_name(ci)
+            self.clusters[cid] = ClusterAggregator(
+                cid,
+                [f"{cid}-agg-{k}" for k in range(shards_per_cluster)],
+                window_ns=window_ns,
+                stale_after_ns=stale_after_ns,
+                capacity_events=cluster_capacity_events,
+                observer=self.observer,
+                fleet_observer=fleet_observer,
+            )
+        self.region = RegionAggregator(
+            region_id="region-0",
+            rollup_gap_ns=rollup_gap_ns,
+            capacity_incidents=region_capacity_incidents,
+            observer=self.observer,
+        )
+        self.incidents: list[FleetIncident] = []
+        self._node_gates: dict[str, ColumnarGate] = {}
+        self._node_chaos: dict[str, ChaosStream] = {}
+        self._node_seq: dict[str, int] = {}
+        self._node_dedup_window = node_dedup_window
+        self._alive: set[int] = set(range(topology.nodes))
+        self._hb_base: dict[str, Any] | None = None
+        self._hb_ts: np.ndarray | None = None
+        self._hb_codes: tuple[int, list[int]] | None = None
+        self._hb_cache: dict[int, tuple[str, str, list[str]]] = {}
+        self.max_level_seen = 0
+        self.churn_counts: dict[str, int] = {}
+        self.moved_keys = 0
+
+    # ---- heartbeat template (healthy-node fast path) -------------------
+
+    def _ensure_hb_template(self) -> None:
+        if self._hb_base is not None:
+            return
+        topo = self.topology
+        rows = [
+            ProbeEventV1(
+                ts_unix_nano=EPOCH_NS + pod_j,
+                signal=HEARTBEAT_SIGNAL,
+                node="node-template",
+                namespace=topo.tenant_of(pod_j),
+                pod=f"node-template-pod-{pod_j}",
+                container="workload",
+                pid=100 + pod_j,
+                tid=100 + pod_j,
+                value=4.0,
+                unit=SIGNAL_UNITS[HEARTBEAT_SIGNAL],
+                status="ok",
+            )
+            for pod_j in range(topo.pods_per_node)
+        ]
+        template = from_rows(rows)
+        self._hb_base = encode_shipment(template, "node-template", 0)
+        self._hb_ts = template.columns["ts_unix_nano"].copy()
+        node_code = template.pool.intern("node-template")
+        pod_codes = [
+            template.pool.intern(f"node-template-pod-{pod_j}")
+            for pod_j in range(topo.pods_per_node)
+        ]
+        self._hb_codes = (node_code, pod_codes)
+
+    def _hb_payload(self, node_i: int, round_i: int) -> dict[str, Any]:
+        self._ensure_hb_template()
+        topo = self.topology
+        cached = self._hb_cache.get(node_i)
+        if cached is None:
+            node_code, pod_codes = self._hb_codes
+            pool = list(self._hb_base["pool"])
+            node = topo.node_name(node_i)
+            pool[node_code] = node
+            for pod_j, code in enumerate(pod_codes):
+                pool[code] = topo.pod_name(node_i, pod_j)
+            cached = (node, topo.slice_name(node_i), pool)
+            self._hb_cache[node_i] = cached
+        node, slice_id, pool = cached
+        shift = np.int64(
+            round_i * self.round_ns + (node_i % 997) * 1000
+        )
+        shifted = self._hb_ts + shift
+        seq = self._node_seq.get(node, -1) + 1
+        self._node_seq[node] = seq
+        payload = dict(self._hb_base)
+        payload["node"] = node
+        payload["seq"] = seq
+        payload["head_ns"] = int(shifted[-1])
+        payload["slice_id"] = slice_id
+        payload["pool"] = pool
+        payload["columns"] = dict(self._hb_base["columns"])
+        payload["columns"]["ts_unix_nano"] = shifted.tobytes()
+        return payload
+
+    # ---- fault-node real path ------------------------------------------
+
+    def _gate_for(self, node: str) -> ColumnarGate:
+        gate = self._node_gates.get(node)
+        if gate is None:
+            gate = ColumnarGate(
+                GateConfig(
+                    dedup_window=self._node_dedup_window,
+                    watermark_lateness_ms=2000,
+                )
+            )
+            self._node_gates[node] = gate
+        return gate
+
+    def _chaos_for(self, node: str, node_i: int) -> ChaosStream | None:
+        if self.chaos_intensity <= 0:
+            return None
+        chaos = self._node_chaos.get(node)
+        if chaos is None:
+            chaos = ChaosStream(
+                ChaosScenario.at_intensity(
+                    self.chaos_intensity, seed=self.seed + node_i
+                )
+            )
+            self._node_chaos[node] = chaos
+        return chaos
+
+    def _ship_fault_node(
+        self,
+        node_i: int,
+        round_i: int,
+        active: dict[tuple[int, int], FaultInjection],
+    ) -> None:
+        topo = self.topology
+        node = topo.node_name(node_i)
+        events = events_for_round(
+            topo, node_i, round_i, self.round_ns, active
+        )
+        chaos = self._chaos_for(node, node_i)
+        if chaos is not None:
+            events = list(chaos.stream(events))
+        gate = self._gate_for(node)
+        result = gate.admit_payloads(events)
+        cluster = self.clusters[topo.cluster_of_node(node_i)]
+        for part in (result.admitted, result.late):
+            if not len(part):
+                continue
+            seq = self._node_seq.get(node, -1) + 1
+            self._node_seq[node] = seq
+            cluster.ingest(
+                encode_shipment(
+                    part, node, seq, slice_id=topo.slice_name(node_i)
+                )
+            )
+
+    # ---- churn ---------------------------------------------------------
+
+    def _apply_churn(self, events: list[ChurnEvent]) -> None:
+        for event in events:
+            self.churn_counts[event.kind] = (
+                self.churn_counts.get(event.kind, 0) + 1
+            )
+            if event.kind == "node_leave":
+                self._alive.discard(event.node_i)
+            elif event.kind == "node_join":
+                self._alive.add(event.node_i)
+            elif event.kind == "shard_down":
+                moved = self.clusters[event.cluster].remove_shard(
+                    event.shard_id
+                )
+                self.moved_keys += len(moved)
+            elif event.kind == "shard_up":
+                moved = self.clusters[event.cluster].add_shard(
+                    event.shard_id
+                )
+                self.moved_keys += len(moved)
+            else:
+                raise ValueError(f"unknown churn kind {event.kind!r}")
+
+    # ---- region failover -----------------------------------------------
+
+    def kill_region(
+        self, exported: dict[str, Any] | None = None
+    ) -> dict[str, Any]:
+        """Drop the region aggregator; restore from a durable snapshot.
+
+        ``exported`` is the last durable snapshot (PR 4 StateStore);
+        when None, the live state is used.  Cluster envelope spools
+        re-send everything past the restored per-cluster seq — the
+        stale snapshot plus re-sends proves the at-least-once hop.
+        """
+        state = (
+            exported
+            if exported is not None
+            else self.region.export_state()
+        )
+        fresh = RegionAggregator(
+            region_id=self.region.region_id,
+            rollup_gap_ns=self.rollup_gap_ns,
+            capacity_incidents=self._region_capacity,
+            observer=self.observer,
+        )
+        fresh.restore_state(state)
+        resent = accepted = 0
+        for cluster in self.clusters.values():
+            cursor = fresh.clusters.get(cluster.cluster_id)
+            since = cursor.seq if cursor is not None else -1
+            for payload in cluster.resend_since(since):
+                resent += 1
+                if fresh.ingest(payload):
+                    accepted += 1
+        self.region = fresh
+        return {
+            "killed": "region-0",
+            "restored_clusters": len(fresh.clusters),
+            "resent_envelopes": resent,
+            "accepted_resends": accepted,
+        }
+
+    # ---- correctness lane ----------------------------------------------
+
+    def run(
+        self,
+        rounds: int,
+        injections: list[FaultInjection],
+        churn: list[ChurnEvent] | None = None,
+        kill_region_at: int | None = None,
+        runtime=None,
+        log: Callable[[str], None] | None = None,
+    ) -> FederationRunResult:
+        """Drive the federation for ``rounds`` under optional churn.
+
+        ``runtime`` is an :class:`~tpuslo.runtime.AgentRuntime`; when
+        provided, the region and clusters snapshot through it each
+        round, and ``kill_region_at`` restores the region from the
+        *stale* pre-round snapshot exactly like a real crash would.
+        """
+        topo = self.topology
+        churn_by_round: dict[int, list[ChurnEvent]] = {}
+        for event in churn or []:
+            churn_by_round.setdefault(event.round_i, []).append(event)
+        failover: dict[str, Any] = {}
+        last_snapshot: dict[str, Any] = {}
+        if runtime is not None:
+            runtime.register(
+                "federation/region",
+                lambda: self.region.export_state(),
+                lambda state: self.region.restore_state(state),
+            )
+            for cid, cluster in self.clusters.items():
+                runtime.register(
+                    f"federation/{cid}",
+                    cluster.export_state,
+                    cluster.restore_state,
+                )
+        for round_i in range(rounds):
+            # Snapshot BEFORE the round's churn and shipments: the
+            # durable state a real crash restores always lags.
+            if runtime is not None:
+                last_snapshot = runtime.export_components()
+                runtime.snapshot_now()
+            self._apply_churn(churn_by_round.get(round_i, ()))
+            active: dict[tuple[int, int], FaultInjection] = {}
+            fault_nodes: set[int] = set()
+            for injection in injections:
+                if (
+                    injection.at_round
+                    <= round_i
+                    < injection.at_round + injection.duration_rounds
+                ):
+                    for pair in injection.affected(topo):
+                        active[pair] = injection
+                        fault_nodes.add(pair[0])
+            levels = {
+                cid: cluster.effective_level()
+                for cid, cluster in self.clusters.items()
+            }
+            for node_i in sorted(self._alive):
+                if node_i in fault_nodes:
+                    # Fault evidence never coarsens: a pressured agent
+                    # flushes anomalous batches at full cadence.
+                    self._ship_fault_node(node_i, round_i, active)
+                    continue
+                cid = topo.cluster_of_node(node_i)
+                cadence = self.heartbeat_every << min(levels[cid], 2)
+                if (round_i + node_i) % cadence == 0:
+                    self.clusters[cid].ingest(
+                        self._hb_payload(node_i, round_i)
+                    )
+            for cluster in self.clusters.values():
+                cluster.observe_pressure()
+                self.region.ingest(cluster.close_and_ship())
+            if kill_region_at is not None and round_i == kill_region_at:
+                # Kill AFTER the round's envelopes landed: everything
+                # the dying region ingested since the round-start
+                # snapshot exists only in its memory, so the restore is
+                # genuinely stale and the spool re-send must cover it.
+                exported = (
+                    last_snapshot.get("federation/region")
+                    if last_snapshot
+                    else None
+                )
+                failover = self.kill_region(exported)
+                if log:
+                    log(
+                        "region failover: restored "
+                        f"{failover['restored_clusters']} cluster "
+                        f"cursors, re-sent "
+                        f"{failover['resent_envelopes']} envelopes "
+                        f"({failover['accepted_resends']} accepted)"
+                    )
+            region_level = self.region.observe_pressure()
+            level_now = region_level
+            for cid, cluster in self.clusters.items():
+                cluster.set_upstream_pressure(region_level)
+                level_now = max(level_now, cluster.effective_level())
+            self.max_level_seen = max(self.max_level_seen, level_now)
+            self.incidents.extend(self.region.pump())
+        for cluster in self.clusters.values():
+            self.region.ingest(cluster.close_and_ship(flush=True))
+        self.incidents.extend(self.region.pump(flush=True))
+        sampled: dict[int, int] = {}
+        observations: dict[int, int] = {}
+        for cluster in self.clusters.values():
+            for level, count in (
+                cluster.sampler.sampled_rows_by_level.items()
+            ):
+                sampled[level] = sampled.get(level, 0) + count
+            for level, count in (
+                cluster.pressure.observations_by_level.items()
+            ):
+                observations[level] = (
+                    observations.get(level, 0) + count
+                )
+        for level, count in (
+            self.region.pressure.observations_by_level.items()
+        ):
+            observations[level] = observations.get(level, 0) + count
+        return FederationRunResult(
+            incidents=list(self.incidents),
+            injections=list(injections),
+            rounds=rounds,
+            region_snapshot=self.region.snapshot(),
+            cluster_snapshots={
+                cid: cluster.snapshot()
+                for cid, cluster in self.clusters.items()
+            },
+            failover=failover,
+            churn=dict(self.churn_counts),
+            sampled_rows_by_level=sampled,
+            pressure_observations_by_level=observations,
+            max_level_seen=self.max_level_seen,
+            max_staleness_ms=self.region.max_staleness_ms,
+            rollup_duplicates_suppressed=(
+                self.region.rollup.duplicates_suppressed
+            ),
+        )
+
+    # ---- throughput lane -----------------------------------------------
+
+    def measure_ingest(
+        self, events_per_node: int = 600
+    ) -> FederationIngestMeasurement:
+        """One template-cloned shipment per node; aggregate throughput.
+
+        Same measurement discipline as the PR 9 lane: total events
+        over the *slowest shard's* busy time — the wall time a
+        parallel deployment would see — now across every cluster's
+        shards, with the region hop timed separately as rollup
+        latency.
+        """
+        topo = self.topology
+        payloads = build_template_payloads(topo, events_per_node)
+        total = 0
+        for node_i, payload in enumerate(payloads):
+            cluster = self.clusters[topo.cluster_of_node(node_i)]
+            cluster.ingest(payload)
+            total += payload["events"]
+        all_shards = [
+            (cid, shard)
+            for cid, cluster in self.clusters.items()
+            for shard in cluster.shards.values()
+        ]
+        for _, shard in all_shards:
+            t0 = time.perf_counter_ns()
+            shard._drain()
+            shard.busy_ns += time.perf_counter_ns() - t0
+        busiest = max(shard.busy_ns for _, shard in all_shards)
+        per_cluster = {
+            cid: sum(
+                s.ingested_events for s in cluster.shards.values()
+            )
+            / (
+                max(
+                    s.busy_ns for s in cluster.shards.values()
+                )
+                / 1e9
+            )
+            if any(s.busy_ns for s in cluster.shards.values())
+            else 0.0
+            for cid, cluster in self.clusters.items()
+        }
+        t0 = time.perf_counter_ns()
+        for cluster in self.clusters.values():
+            self.region.ingest(cluster.close_and_ship(flush=True))
+        self.incidents.extend(self.region.pump(flush=True))
+        rollup_ms = (time.perf_counter_ns() - t0) / 1e6
+        admitted = sum(
+            shard.admitted_events for _, shard in all_shards
+        )
+        return FederationIngestMeasurement(
+            nodes=topo.nodes,
+            clusters=len(self.clusters),
+            shards=len(all_shards),
+            total_events=total,
+            admitted_events=admitted,
+            events_per_sec=(
+                total / (busiest / 1e9) if busiest else 0.0
+            ),
+            per_cluster_events_per_sec=per_cluster,
+            rollup_latency_ms=rollup_ms,
+            region_incidents=len(self.incidents),
+            max_staleness_ms=self.region.max_staleness_ms,
+        )

@@ -1,0 +1,380 @@
+"""Federation-sweep release gate: 10k nodes, churn, kill, saturation.
+
+Four contracts, one seeded run (``tpuslo m5gate --federation-sweep``):
+
+1. **Aggregate ingest throughput** — 10k simulated nodes over the
+   two-level tree must sustain at least the PR 9 single-level floor
+   (default ≥ 5M events/s) on the columnar path, measured as total
+   events over the slowest shard's busy time across every cluster.
+2. **Cross-cluster page dedup** — every injected fault yields exactly
+   one region incident at the correct blast radius (precision and
+   recall 1.0), under CONTINUOUS node churn and rolling shard
+   restarts; the fleet-scope fault's members must span multiple
+   clusters (the cross-cluster identity evidence), and the
+   cross-tenant / cross-domain probes must not merge across the
+   region hop.
+3. **Region failover** — the churn run repeats with the region
+   aggregator killed mid-sweep (stale snapshot restore + cluster
+   envelope-spool re-send): the incident set must equal the unkilled
+   run's exactly — zero lost, zero duplicated.
+4. **Graceful saturation** — with ingest capacity forced tiny, the
+   plane must actually degrade (backpressure level ≥ the sampling
+   tier, sampled rows counted by level), while STILL paging every
+   injected fault exactly once and keeping incident staleness under
+   the ceiling — resolution degrades, correctness never.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from tpuslo.federation.backpressure import LEVEL_SAMPLE
+from tpuslo.federation.simulator import (
+    FederationSimulator,
+    FederationTopology,
+    build_churn_plan,
+    federation_injection_plan,
+)
+from tpuslo.fleet.rollup import FleetIncident
+from tpuslo.fleet.sweep import IncidentMatch, score_incidents
+
+
+def _incident_keys(incidents: list[FleetIncident]) -> list[str]:
+    """Failover-comparable identity (namespace/domain/blast radius)."""
+    return sorted(
+        f"{i.namespace}/{i.domain}/{i.blast_radius}" for i in incidents
+    )
+
+
+@dataclass
+class FederationSweepReport:
+    """Gate verdict for one federation sweep."""
+
+    nodes: int
+    clusters: int
+    shards_per_cluster: int
+    seed: int
+    churn_per_round: int
+    rounds: int
+    events_per_node: int
+    min_ingest_events_per_sec: float
+    max_staleness_ms: float
+    ingest_events_per_sec: float = 0.0
+    per_cluster_events_per_sec: dict[str, float] = field(
+        default_factory=dict
+    )
+    rollup_latency_ms: float = 0.0
+    matches: list[IncidentMatch] = field(default_factory=list)
+    incidents: list[dict[str, Any]] = field(default_factory=list)
+    precision: float = 0.0
+    recall: float = 0.0
+    macro_f1: float = 0.0
+    cross_cluster_members: int = 0
+    churn: dict[str, int] = field(default_factory=dict)
+    moved_keys: int = 0
+    baseline_staleness_ms: float = 0.0
+    failover: dict[str, Any] = field(default_factory=dict)
+    failover_lost: list[str] = field(default_factory=list)
+    failover_duplicated: list[str] = field(default_factory=list)
+    saturation: dict[str, Any] = field(default_factory=dict)
+    failures: list[str] = field(default_factory=list)
+
+    @property
+    def passed(self) -> bool:
+        return not self.failures
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "nodes": self.nodes,
+            "clusters": self.clusters,
+            "shards_per_cluster": self.shards_per_cluster,
+            "seed": self.seed,
+            "churn_per_round": self.churn_per_round,
+            "rounds": self.rounds,
+            "events_per_node": self.events_per_node,
+            "min_ingest_events_per_sec": self.min_ingest_events_per_sec,
+            "max_staleness_ms": self.max_staleness_ms,
+            "ingest_events_per_sec": round(self.ingest_events_per_sec),
+            "per_cluster_events_per_sec": {
+                k: round(v)
+                for k, v in self.per_cluster_events_per_sec.items()
+            },
+            "rollup_latency_ms": round(self.rollup_latency_ms, 3),
+            "matches": [m.to_dict() for m in self.matches],
+            "incidents": list(self.incidents),
+            "precision": round(self.precision, 4),
+            "recall": round(self.recall, 4),
+            "macro_f1": round(self.macro_f1, 4),
+            "cross_cluster_members": self.cross_cluster_members,
+            "churn": dict(self.churn),
+            "moved_keys": self.moved_keys,
+            "baseline_staleness_ms": round(
+                self.baseline_staleness_ms, 3
+            ),
+            "failover": dict(self.failover),
+            "failover_lost": list(self.failover_lost),
+            "failover_duplicated": list(self.failover_duplicated),
+            "saturation": dict(self.saturation),
+            "passed": self.passed,
+            "failures": list(self.failures),
+        }
+
+
+def run_federation_sweep(
+    nodes: int = 10000,
+    clusters: int = 4,
+    shards_per_cluster: int = 4,
+    seed: int = 1337,
+    churn_per_round: int = 4,
+    rounds: int = 18,
+    events_per_node: int = 600,
+    chaos_intensity: float = 1.0,
+    kill_region: bool = True,
+    saturate: bool = True,
+    min_ingest_events_per_sec: float = 5_000_000.0,
+    max_staleness_ms: float = 30_000.0,
+    saturation_capacity_events: int = 2_000,
+    state_dir: str | None = None,
+    observer=None,
+    log: Callable[[str], None] | None = None,
+) -> FederationSweepReport:
+    """Run all four federation contracts; deterministic per seed."""
+    topology = FederationTopology.for_nodes(nodes, clusters=clusters)
+    plan = federation_injection_plan(topology)
+    churn = build_churn_plan(
+        topology,
+        rounds,
+        plan,
+        node_churn_per_round=churn_per_round,
+        seed=seed,
+    )
+    report = FederationSweepReport(
+        nodes=nodes,
+        clusters=clusters,
+        shards_per_cluster=shards_per_cluster,
+        seed=seed,
+        churn_per_round=churn_per_round,
+        rounds=rounds,
+        events_per_node=events_per_node,
+        min_ingest_events_per_sec=min_ingest_events_per_sec,
+        max_staleness_ms=max_staleness_ms,
+    )
+
+    def _sim(**overrides: Any) -> FederationSimulator:
+        kwargs: dict[str, Any] = dict(
+            shards_per_cluster=shards_per_cluster,
+            seed=seed,
+            observer=observer,
+        )
+        kwargs.update(overrides)
+        return FederationSimulator(topology, **kwargs)
+
+    # ---- phase 1: aggregate ingest throughput -------------------------
+    measurement = _sim().measure_ingest(events_per_node)
+    report.ingest_events_per_sec = measurement.events_per_sec
+    report.per_cluster_events_per_sec = (
+        measurement.per_cluster_events_per_sec
+    )
+    report.rollup_latency_ms = measurement.rollup_latency_ms
+    if log:
+        log(
+            f"ingest: {measurement.events_per_sec / 1e6:.2f}M events/s "
+            f"aggregate over {measurement.shards} shards in "
+            f"{measurement.clusters} clusters "
+            f"({measurement.total_events} events), region rollup "
+            f"{measurement.rollup_latency_ms:.1f} ms"
+        )
+    if measurement.events_per_sec < min_ingest_events_per_sec:
+        report.failures.append(
+            f"aggregate ingest {measurement.events_per_sec:,.0f} "
+            f"events/s below the "
+            f"{min_ingest_events_per_sec:,.0f} floor"
+        )
+
+    # ---- phase 2: cross-cluster dedup under continuous churn ----------
+    baseline_sim = _sim(chaos_intensity=chaos_intensity)
+    baseline = baseline_sim.run(rounds, plan, churn=churn, log=log)
+    matches, precision, recall, macro = score_incidents(
+        plan, baseline.incidents
+    )
+    report.matches = matches
+    report.incidents = [i.to_dict() for i in baseline.incidents]
+    report.precision = precision
+    report.recall = recall
+    report.macro_f1 = macro
+    report.churn = dict(baseline.churn)
+    report.moved_keys = baseline_sim.moved_keys
+    report.baseline_staleness_ms = baseline.max_staleness_ms
+    fleet_scope = [
+        i for i in baseline.incidents if i.blast_radius == "fleet"
+    ]
+    report.cross_cluster_members = max(
+        (len(i.clusters) for i in fleet_scope), default=0
+    )
+    if log:
+        log(
+            f"rollup: {len(baseline.incidents)} incidents for "
+            f"{len(plan)} injections under churn "
+            f"({report.churn.get('node_leave', 0)} leaves, "
+            f"{report.churn.get('node_join', 0)} joins, "
+            f"{report.moved_keys} arcs re-homed) — precision "
+            f"{precision:.3f} recall {recall:.3f}"
+        )
+    if precision < 1.0 or recall < 1.0:
+        detail = "; ".join(
+            f"{m.injection}: matched {m.matched_count} "
+            f"(radius {m.matched_blast_radius or 'none'}, expected "
+            f"{m.expected_blast_radius})"
+            for m in matches
+            if not m.exact
+        )
+        report.failures.append(
+            f"cross-cluster page dedup not exact (precision "
+            f"{precision:.3f}, recall {recall:.3f}): "
+            f"{detail or 'spurious incidents'}"
+        )
+    if report.cross_cluster_members < 2:
+        report.failures.append(
+            "fleet-scope incident did not span multiple clusters "
+            f"(clusters={report.cross_cluster_members}) — the "
+            "cross-cluster identity contract is unproven"
+        )
+    if baseline.max_staleness_ms > max_staleness_ms:
+        report.failures.append(
+            f"baseline incident staleness "
+            f"{baseline.max_staleness_ms:.0f} ms above the "
+            f"{max_staleness_ms:.0f} ms ceiling"
+        )
+
+    # ---- phase 3: region-aggregator kill mid-sweep --------------------
+    if kill_region:
+        from tpuslo.runtime import AgentRuntime, StateStore
+
+        def _failover(run_dir: str) -> None:
+            store = StateStore(
+                os.path.join(run_dir, "federation-snapshot.json"),
+                interval_s=0.0,
+            )
+            runtime = AgentRuntime(store)
+            failover_sim = _sim(chaos_intensity=chaos_intensity)
+            result = failover_sim.run(
+                rounds,
+                plan,
+                churn=churn,
+                kill_region_at=rounds // 2,
+                runtime=runtime,
+                log=log,
+            )
+            report.failover = dict(result.failover)
+            report.failover["rollup_windows_suppressed"] = (
+                result.rollup_duplicates_suppressed
+            )
+            before = _incident_keys(baseline.incidents)
+            after = _incident_keys(result.incidents)
+            report.failover_lost = sorted(set(before) - set(after))
+            report.failover_duplicated = sorted(
+                k
+                for k in set(after)
+                if after.count(k) > before.count(k)
+            )
+            if report.failover_lost:
+                report.failures.append(
+                    "region failover lost incidents: "
+                    + ", ".join(report.failover_lost)
+                )
+            if report.failover_duplicated:
+                report.failures.append(
+                    "region failover duplicated incidents: "
+                    + ", ".join(report.failover_duplicated)
+                )
+            if log:
+                log(
+                    "failover: killed region, re-sent "
+                    f"{report.failover.get('resent_envelopes', 0)} "
+                    "envelope(s), "
+                    f"{report.failover['rollup_windows_suppressed']} "
+                    "re-emitted window(s) suppressed — lost "
+                    f"{len(report.failover_lost)}, duplicated "
+                    f"{len(report.failover_duplicated)}"
+                )
+
+        if state_dir:
+            _failover(state_dir)
+        else:
+            with tempfile.TemporaryDirectory(
+                prefix="federation-sweep-"
+            ) as tmp:
+                _failover(tmp)
+
+    # ---- phase 4: forced saturation degrades, never drops -------------
+    if saturate:
+        saturated_sim = _sim(
+            chaos_intensity=chaos_intensity,
+            cluster_capacity_events=saturation_capacity_events,
+            region_capacity_incidents=64,
+        )
+        saturated = saturated_sim.run(rounds, plan, churn=churn)
+        s_matches, s_precision, s_recall, _ = score_incidents(
+            plan, saturated.incidents
+        )
+        sampled_total = sum(
+            saturated.sampled_rows_by_level.values()
+        )
+        report.saturation = {
+            "max_level_seen": saturated.max_level_seen,
+            "sampled_rows_by_level": {
+                str(k): v
+                for k, v in sorted(
+                    saturated.sampled_rows_by_level.items()
+                )
+            },
+            "pressure_observations_by_level": {
+                str(k): v
+                for k, v in sorted(
+                    saturated.pressure_observations_by_level.items()
+                )
+            },
+            "precision": round(s_precision, 4),
+            "recall": round(s_recall, 4),
+            "max_staleness_ms": round(saturated.max_staleness_ms, 3),
+        }
+        if log:
+            log(
+                f"saturation: level reached "
+                f"{saturated.max_level_seen}, "
+                f"{sampled_total} low-severity rows sampled — "
+                f"precision {s_precision:.3f} recall {s_recall:.3f}, "
+                f"staleness {saturated.max_staleness_ms:.0f} ms"
+            )
+        if saturated.max_level_seen < LEVEL_SAMPLE:
+            report.failures.append(
+                "forced saturation never reached the sampling tier "
+                f"(max level {saturated.max_level_seen}) — the "
+                "backpressure loop is not engaging"
+            )
+        if sampled_total <= 0:
+            report.failures.append(
+                "forced saturation sampled zero rows — degradation "
+                "is not being counted"
+            )
+        if s_precision < 1.0 or s_recall < 1.0:
+            detail = "; ".join(
+                f"{m.injection}: matched {m.matched_count}"
+                for m in s_matches
+                if not m.exact
+            )
+            report.failures.append(
+                "saturation dropped or split gated fault incidents "
+                f"(precision {s_precision:.3f}, recall "
+                f"{s_recall:.3f}): {detail or 'spurious incidents'}"
+            )
+        if saturated.max_staleness_ms > max_staleness_ms:
+            report.failures.append(
+                f"saturated incident staleness "
+                f"{saturated.max_staleness_ms:.0f} ms above the "
+                f"{max_staleness_ms:.0f} ms ceiling"
+            )
+    return report

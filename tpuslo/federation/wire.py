@@ -1,0 +1,196 @@
+"""Region wire contract v1: cluster aggregator → region envelope.
+
+The federation tree's second hop.  Node agents ship *events* to their
+cluster's aggregator shards over the fleet wire (``fleet/wire.py``);
+clusters ship *node incidents* — already gated, attributed, and
+collapsed by orders of magnitude — to the region aggregator inside a
+:class:`RegionEnvelope`.  The envelope extends the fleet contract's
+shape one level up:
+
+* **Versioned** — a region refuses an envelope from a different major
+  version instead of mis-decoding it (``REGION_WIRE_VERSION``).
+* **At-least-once** — a monotonic per-cluster ``seq`` is the dedup key
+  across cluster spool re-sends after a region-aggregator kill, same
+  role ``Shipment.seq`` plays per node one level down.
+* **Watermark-carrying** — the cluster's shard watermark rides along
+  so the region can close cross-cluster rollup sessions without
+  re-deriving per-node heads it never sees.
+* **Pressure-annotated** — the sender's current degradation level and
+  sampling counters ride upstream, so the region's view of "how
+  degraded is my ingest" is reported fact, not inference.
+
+Envelopes are JSON-safe by construction (incidents are small dicts,
+not column buffers), so one transport serves files, webhooks and the
+``fleetagg --region`` pipeline.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any
+
+from tpuslo.fleet.rollup import NodeIncident
+from tpuslo.fleet.wire import WireContractError
+
+#: Region wire schema version; bumped on incompatible envelope changes.
+REGION_WIRE_VERSION = 1
+
+
+class RegionWireError(WireContractError):
+    """An envelope that violates the region wire contract."""
+
+
+@dataclass(slots=True)
+class RegionEnvelope:
+    """One decoded cluster → region transfer."""
+
+    cluster: str
+    seq: int
+    incidents: list[NodeIncident]
+    #: The sending cluster's shard watermark (min over non-stale node
+    #: heads minus lateness): the region's session-close clock.
+    watermark_ns: int = 0
+    #: The cluster's newest observed event timestamp.
+    head_ns: int = 0
+    #: Sender's degradation level when this envelope was built.
+    pressure_level: int = 0
+    #: Low-severity rows sampled out cluster-side since the last
+    #: envelope, by level (stringified level -> count).
+    sampled_rows: dict[str, int] = field(default_factory=dict)
+
+
+def node_incident_to_wire(incident: NodeIncident) -> dict[str, Any]:
+    """NodeIncident → JSON-safe envelope entry."""
+    return {
+        "node": incident.node,
+        "pod": incident.pod,
+        "namespace": incident.namespace,
+        "slice_id": incident.slice_id,
+        "domain": incident.domain,
+        "confidence": incident.confidence,
+        "ts_unix_nano": incident.ts_unix_nano,
+        "tier": incident.tier,
+        "signals": dict(incident.signals),
+        "cluster": incident.cluster,
+    }
+
+
+def node_incident_from_wire(raw: dict[str, Any]) -> NodeIncident:
+    """Envelope entry → NodeIncident; loud on contract breaks."""
+    if not isinstance(raw, dict):
+        raise RegionWireError(
+            f"incident entry must be an object, got {type(raw).__name__}"
+        )
+    try:
+        return NodeIncident(
+            node=str(raw["node"]),
+            pod=str(raw["pod"]),
+            namespace=str(raw["namespace"]),
+            slice_id=str(raw.get("slice_id", "")),
+            domain=str(raw["domain"]),
+            confidence=float(raw["confidence"]),
+            ts_unix_nano=int(raw["ts_unix_nano"]),
+            tier=str(raw.get("tier", "node_window")),
+            signals={
+                str(k): float(v)
+                for k, v in (raw.get("signals") or {}).items()
+            },
+            cluster=str(raw.get("cluster", "")),
+        )
+    except (KeyError, TypeError, ValueError) as exc:
+        raise RegionWireError(f"bad incident entry: {exc}") from exc
+
+
+def encode_region_envelope(
+    cluster: str,
+    seq: int,
+    incidents: list[NodeIncident],
+    watermark_ns: int = 0,
+    head_ns: int = 0,
+    pressure_level: int = 0,
+    sampled_rows: dict[int, int] | None = None,
+) -> dict[str, Any]:
+    """Cluster rollup state → wire payload dict (JSON-safe)."""
+    return {
+        "region_wire_version": REGION_WIRE_VERSION,
+        "cluster": cluster,
+        "seq": int(seq),
+        "watermark_ns": int(watermark_ns),
+        "head_ns": int(head_ns),
+        "pressure_level": int(pressure_level),
+        "sampled_rows": {
+            str(level): int(count)
+            for level, count in (sampled_rows or {}).items()
+        },
+        "incidents": [node_incident_to_wire(i) for i in incidents],
+    }
+
+
+def decode_region_envelope(payload: dict[str, Any]) -> RegionEnvelope:
+    """Wire payload dict → :class:`RegionEnvelope`; loud on breaks."""
+    if not isinstance(payload, dict):
+        raise RegionWireError(
+            f"envelope must be an object, got {type(payload).__name__}"
+        )
+    version = payload.get("region_wire_version")
+    if version != REGION_WIRE_VERSION:
+        raise RegionWireError(
+            f"region wire version {version!r} != {REGION_WIRE_VERSION}"
+        )
+    cluster = payload.get("cluster")
+    if not isinstance(cluster, str) or not cluster:
+        raise RegionWireError("envelope missing cluster identity")
+    try:
+        seq = int(payload["seq"])
+        watermark_ns = int(payload.get("watermark_ns", 0))
+        head_ns = int(payload.get("head_ns", 0))
+        pressure_level = int(payload.get("pressure_level", 0))
+    except (KeyError, TypeError, ValueError) as exc:
+        raise RegionWireError(f"bad envelope header: {exc}") from exc
+    raw_incidents = payload.get("incidents")
+    if not isinstance(raw_incidents, list):
+        raise RegionWireError("envelope missing incidents list")
+    incidents = [node_incident_from_wire(raw) for raw in raw_incidents]
+    sampled: dict[str, int] = {}
+    for level, count in (payload.get("sampled_rows") or {}).items():
+        try:
+            sampled[str(level)] = int(count)
+        except (TypeError, ValueError) as exc:
+            raise RegionWireError(
+                f"bad sampled_rows entry {level!r}: {exc}"
+            ) from exc
+    return RegionEnvelope(
+        cluster=cluster,
+        seq=seq,
+        incidents=incidents,
+        watermark_ns=watermark_ns,
+        head_ns=head_ns,
+        pressure_level=pressure_level,
+        sampled_rows=sampled,
+    )
+
+
+def region_envelope_json_line(payload: dict[str, Any]) -> str:
+    """One JSONL line for an encoded region envelope."""
+    return json.dumps(payload, separators=(",", ":")) + "\n"
+
+
+def parse_region_envelope_line(line: str) -> RegionEnvelope:
+    """Inverse of :func:`region_envelope_json_line` (decode included)."""
+    try:
+        payload = json.loads(line)
+    except json.JSONDecodeError as exc:
+        raise RegionWireError(f"bad envelope line: {exc}") from exc
+    return decode_region_envelope(payload)
+
+
+def load_region_envelopes(path: str) -> list[RegionEnvelope]:
+    """Read an envelope log; raises :class:`RegionWireError` on drift."""
+    out: list[RegionEnvelope] = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                out.append(parse_region_envelope_line(line))
+    return out

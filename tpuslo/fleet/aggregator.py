@@ -82,6 +82,7 @@ class AggregatorShard:
         coalesce_events: int = 131072,
         attributor=None,
         observer: FleetObserver | None = None,
+        skip_healthy_groups: bool = False,
     ):
         self.shard_id = shard_id
         cfg = gate_config or GateConfig()
@@ -105,6 +106,17 @@ class AggregatorShard:
         self._acc: dict[
             int, dict[tuple[str, str, str], dict[str, float]]
         ] = {}
+        #: Federation-scale fast path: skip attributing accumulator
+        #: groups carrying zero non-ok evidence (every signal value
+        #: below its warning threshold per ``signal_status`` — the
+        #: same severity rule the adaptive sampler protects pods by).
+        #: A 10k-node fleet folds tens of thousands of healthy
+        #: heartbeat groups per window; attributing them buys nothing
+        #: (they resolve unknown / sub-floor) and costs the bucket
+        #: close its whole budget.  Off by default: the single-level
+        #: plane keeps PR 9 semantics bit-for-bit.
+        self.skip_healthy_groups = skip_healthy_groups
+        self.groups_skipped_healthy = 0
         self.ingested_events = 0
         self.admitted_events = 0
         self.duplicate_shipments = 0
@@ -310,6 +322,21 @@ class AggregatorShard:
         start_ns = bucket * self.window_ns
         when = datetime.fromtimestamp(start_ns / 1e9, tz=timezone.utc)
         keys = sorted(groups)
+        if self.skip_healthy_groups:
+            from tpuslo.signals.generator import signal_status
+
+            suspect = [
+                key
+                for key in keys
+                if any(
+                    signal_status(name, value) != "ok"
+                    for name, value in groups[key].items()
+                )
+            ]
+            self.groups_skipped_healthy += len(keys) - len(suspect)
+            keys = suspect
+            if not keys:
+                return []
         samples = [
             FaultSample(
                 incident_id=f"{node}/{pod}@{start_ns}",
@@ -367,12 +394,56 @@ class AggregatorShard:
             "duplicate_shipments": self.duplicate_shipments,
             "ingested_events": self.ingested_events,
             "admitted_events": self.admitted_events,
+            "groups_skipped_healthy": self.groups_skipped_healthy,
             "watermark_ns": self.watermark_ns(),
             "open_windows": len(self._acc),
             "gate": self.gate.snapshot(),
         }
 
     # ---- failover snapshot (PR 4 runtime registry) --------------------
+
+    def backlog_events(self) -> int:
+        """Ingest backlog: events buffered ahead of the next gate pass.
+
+        The federation backpressure loop reads this as the shard's
+        contribution to cluster ingest pressure — it is the work a
+        saturated shard has accepted but not yet paid for.
+        """
+        return self._pending_events
+
+    def export_node(self, node: str) -> dict[str, Any] | None:
+        """One node's re-homable fragment (state + in-flight windows).
+
+        The online-rebalance handoff unit: the new owner absorbs this
+        via :meth:`absorb_node_state`, the old owner then calls
+        :meth:`drop_node` — a node moving mid-window carries its open
+        accumulator groups with it, so the window closes exactly once
+        on exactly one shard.  Returns None for an unknown node.
+        """
+        state = self.nodes.get(node)
+        if state is None:
+            return None
+        self._drain()
+        pending = [
+            {
+                "bucket": bucket,
+                "namespace": ns,
+                "pod": pod,
+                "signals": dict(signals),
+            }
+            for bucket, groups in self._acc.items()
+            for (ns, g_node, pod), signals in groups.items()
+            if g_node == node
+        ]
+        head = self.fleet_head_ns()
+        return {
+            "head_ns": state.head_ns,
+            "seq": state.seq,
+            "events": state.events,
+            "slice_id": state.slice_id,
+            "stale": head - state.head_ns > self.stale_after_ns,
+            "pending": pending,
+        }
 
     def export_state(self) -> dict[str, Any]:
         """Per-node-partitionable state for the runtime StateStore."""

@@ -134,6 +134,28 @@ class HashRing:
             if node_key(node, slice_id) not in self._cordoned
         }
 
+    def rehome_plan(
+        self,
+        nodes: Iterable[tuple[str, str]],
+        prior: dict[str, str],
+    ) -> dict[str, tuple[str, str]]:
+        """Incremental rebalance: ``{node: (old, new)}`` vs ``prior``.
+
+        ``prior`` is the assignment map computed before a membership
+        change (``assignments()`` output).  Only keys whose owner
+        actually changed appear — the consistent-hash property that a
+        join/leave re-homes a 1/N fraction of the keyspace, made
+        checkable.  Cordoned arcs never appear (``assignments`` skips
+        them), so a node held out by the remediation engine can never
+        become a rebalancing target mid-churn; keys absent from
+        ``prior`` (fresh joins) are placements, not re-homes.
+        """
+        return {
+            node: (prior[node], new_owner)
+            for node, new_owner in self.assignments(nodes).items()
+            if node in prior and prior[node] != new_owner
+        }
+
     # ---- failover snapshot -------------------------------------------
 
     def export_state(self) -> dict[str, Any]:

@@ -54,13 +54,16 @@ class NodeIncident:
     ts_unix_nano: int
     tier: str = "node_window"
     signals: dict[str, float] = field(default_factory=dict)
+    #: Reporting cluster (federation plane): which cluster aggregator
+    #: attributed this node.  Empty on the single-level plane.
+    cluster: str = ""
 
     @property
     def incident_id(self) -> str:
         return f"{self.node}/{self.pod}@{self.ts_unix_nano}"
 
     def member_dict(self) -> dict[str, Any]:
-        return {
+        out = {
             "incident_id": self.incident_id,
             "node": self.node,
             "pod": self.pod,
@@ -68,6 +71,9 @@ class NodeIncident:
             "tier": self.tier,
             "confidence": round(self.confidence, 4),
         }
+        if self.cluster:
+            out["cluster"] = self.cluster
+        return out
 
 
 def classify_blast_radius(members: Iterable[NodeIncident]) -> str:
@@ -110,9 +116,14 @@ class FleetIncident:
     nodes: list[str]
     slices: list[str]
     members: list[dict[str, Any]]
+    #: Federation identity: the region that emitted this page and the
+    #: clusters its member nodes reported through.  Both empty on the
+    #: single-level plane, so PR 9 consumers see unchanged payloads.
+    region: str = ""
+    clusters: list[str] = field(default_factory=list)
 
     def to_dict(self) -> dict[str, Any]:
-        return {
+        out = {
             "incident_id": self.incident_id,
             "namespace": self.namespace,
             "domain": self.domain,
@@ -124,6 +135,10 @@ class FleetIncident:
             "slices": list(self.slices),
             "members": [dict(m) for m in self.members],
         }
+        if self.region or self.clusters:
+            out["region"] = self.region
+            out["clusters"] = list(self.clusters)
+        return out
 
     @classmethod
     def from_dict(cls, raw: dict[str, Any]) -> "FleetIncident":
@@ -138,6 +153,8 @@ class FleetIncident:
             nodes=[str(n) for n in raw.get("nodes") or []],
             slices=[str(s) for s in raw.get("slices") or []],
             members=[dict(m) for m in raw.get("members") or []],
+            region=str(raw.get("region", "")),
+            clusters=[str(c) for c in raw.get("clusters") or []],
         )
 
 
@@ -159,8 +176,14 @@ class FleetRollup:
         self,
         gap_ns: int = 5_000_000_000,
         on_incident: Callable[[FleetIncident], None] | None = None,
+        region: str = "",
     ):
         self.gap_ns = max(1, int(gap_ns))
+        #: Region identity stamped on emitted incidents (federation
+        #: plane); the session key stays (namespace, domain) so members
+        #: reporting through DIFFERENT clusters still collapse to one
+        #: page — cross-cluster incident identity is structural.
+        self.region = region
         self._groups: dict[tuple[str, str], list[_Group]] = {}
         #: (namespace, domain) → emitted [start_ns, last_ns] windows.
         self._emitted_windows: dict[
@@ -300,6 +323,8 @@ class FleetRollup:
             nodes=sorted({m.node for m in members}),
             slices=sorted({m.slice_id for m in members if m.slice_id}),
             members=[m.member_dict() for m in members],
+            region=self.region,
+            clusters=sorted({m.cluster for m in members if m.cluster}),
         )
         self.incidents_emitted += 1
         if self._on_incident is not None:
@@ -335,6 +360,7 @@ class FleetRollup:
                             "confidence": m.confidence,
                             "ts_unix_nano": m.ts_unix_nano,
                             "tier": m.tier,
+                            "cluster": m.cluster,
                         }
                         for m in g.members.values()
                     ],
@@ -364,6 +390,7 @@ class FleetRollup:
                     confidence=float(m["confidence"]),
                     ts_unix_nano=int(m["ts_unix_nano"]),
                     tier=str(m.get("tier", "node_window")),
+                    cluster=str(m.get("cluster", "")),
                 )
                 for m in raw.get("members") or []
             ]

@@ -227,6 +227,149 @@ def default_injection_plan(
     return plan
 
 
+def events_for_round(
+    topology: FleetTopology,
+    node_i: int,
+    round_i: int,
+    round_ns: int,
+    active: dict[tuple[int, int], "FaultInjection"],
+) -> list[dict[str, Any]]:
+    """One node-agent cycle's probe-event dicts for ``round_i``.
+
+    Healthy pods emit the heartbeat signal; pods inside an active
+    injection's blast scope emit the fault's full signal profile.
+    Shared by the 1k-node fleet lane and the 10k-node federation lane
+    so both synthesize the same evidence shape.
+    """
+    node = topology.node_name(node_i)
+    slice_id = topology.slice_name(node_i)
+    ts = EPOCH_NS + round_i * round_ns + (node_i % 997) * 1000
+    out: list[dict[str, Any]] = []
+    for pod_j in range(topology.pods_per_node):
+        pod = topology.pod_name(node_i, pod_j)
+        namespace = topology.tenant_of(pod_j)
+        injection = active.get((node_i, pod_j))
+        if injection is None:
+            value = 4.0
+            out.append(
+                {
+                    "ts_unix_nano": ts + pod_j,
+                    "signal": HEARTBEAT_SIGNAL,
+                    "node": node,
+                    "namespace": namespace,
+                    "pod": pod,
+                    "container": "workload",
+                    "pid": 100 + pod_j,
+                    "tid": 100 + pod_j,
+                    "value": value,
+                    "unit": SIGNAL_UNITS[HEARTBEAT_SIGNAL],
+                    "status": signal_status(HEARTBEAT_SIGNAL, value),
+                }
+            )
+            continue
+        profile = profile_for_fault(injection.label)
+        for k, (signal, value) in enumerate(sorted(profile.items())):
+            event: dict[str, Any] = {
+                "ts_unix_nano": ts + pod_j * 100 + k,
+                "signal": signal,
+                "node": node,
+                "namespace": namespace,
+                "pod": pod,
+                "container": "workload",
+                "pid": 100 + pod_j,
+                "tid": 100 + pod_j,
+                "value": float(value),
+                "unit": SIGNAL_UNITS.get(signal, "ms"),
+                "status": signal_status(signal, float(value)),
+            }
+            if signal in TPU_SIGNALS:
+                event["tpu"] = {
+                    "slice_id": slice_id,
+                    "host_index": node_i % topology.nodes_per_slice,
+                }
+            out.append(event)
+    return out
+
+
+def build_template_payloads(
+    topology: FleetTopology, events_per_node: int
+) -> list[dict[str, Any]]:
+    """One binary-transport shipment per node, template-cloned.
+
+    The per-signal template batch is built once
+    (``columns_from_samples`` over synthetic samples); each node's
+    shipment reuses the template's column buffers verbatim except the
+    timestamp column (shifted per node) and the pool entries carrying
+    node/pod/slice identity.  Generation is thus ~free and a
+    throughput measurement isolates the aggregator path — shared by
+    the 1k-node fleet lane and the 10k-node federation lane so both
+    measure the same shipment shape.
+    """
+    from datetime import datetime, timedelta, timezone
+
+    from tpuslo.collector.synthetic import RawSample
+    from tpuslo.columnar.generate import columns_from_samples
+    from tpuslo.signals import constants as sig
+    from tpuslo.signals.metadata import Metadata
+
+    n_signals = len(sig.ALL_SIGNALS)
+    n_samples = max(1, events_per_node // n_signals)
+    start = datetime(2026, 1, 1, tzinfo=timezone.utc)
+    samples = [
+        RawSample(
+            timestamp=start + timedelta(milliseconds=i),
+            cluster="fleet",
+            namespace=topology.tenants[0],
+            workload="serving",
+            service="chat",
+            request_id=f"req-{i}",
+            trace_id=f"trace-{i}",
+            ttft_ms=100.0,
+            request_latency_ms=200.0,
+            token_throughput_tps=10.0,
+            error_rate=0.0,
+            fault_label="none",
+        )
+        for i in range(n_samples)
+    ]
+    meta = Metadata(
+        node="node-template",
+        namespace=topology.tenants[0],
+        pod="pod-template",
+        container="workload",
+        pid=1,
+        tid=1,
+        slice_id="slice-template",
+        host_index=0,
+    )
+    template = columns_from_samples(samples, meta, sig.ALL_SIGNALS)
+    base = encode_shipment(template, "node-template", 0)
+    # Pure lookups — the template metadata interned these already.
+    node_code = template.pool.intern("node-template")
+    pod_code = template.pool.intern("pod-template")
+    slice_code = template.pool.intern("slice-template")
+    ts_arr = template.columns["ts_unix_nano"]
+    payloads: list[dict[str, Any]] = []
+    for i in range(topology.nodes):
+        node = topology.node_name(i)
+        pool = list(base["pool"])
+        pool[node_code] = node
+        pool[pod_code] = topology.pod_name(i, 0)
+        pool[slice_code] = topology.slice_name(i)
+        columns = dict(base["columns"])
+        shifted = ts_arr + np.int64(i * 1_000)
+        columns["ts_unix_nano"] = shifted.tobytes()
+        payload = dict(base)
+        payload["node"] = node
+        payload["seq"] = 0
+        payload["head_ns"] = int(shifted[-1])
+        payload["slice_id"] = topology.slice_name(i)
+        payload["pool"] = pool
+        payload["columns"] = columns
+        payloads.append(payload)
+    return payloads
+
+
 @dataclass
 class FleetRunResult:
     """Outcome of one correctness-lane run."""
@@ -331,55 +474,9 @@ class FleetSimulator:
         round_i: int,
         active: dict[tuple[int, int], FaultInjection],
     ) -> list[dict[str, Any]]:
-        topo = self.topology
-        node = topo.node_name(node_i)
-        slice_id = topo.slice_name(node_i)
-        ts = EPOCH_NS + round_i * self.round_ns + (node_i % 997) * 1000
-        out: list[dict[str, Any]] = []
-        for pod_j in range(topo.pods_per_node):
-            pod = topo.pod_name(node_i, pod_j)
-            namespace = topo.tenant_of(pod_j)
-            injection = active.get((node_i, pod_j))
-            if injection is None:
-                value = 4.0
-                out.append(
-                    {
-                        "ts_unix_nano": ts + pod_j,
-                        "signal": HEARTBEAT_SIGNAL,
-                        "node": node,
-                        "namespace": namespace,
-                        "pod": pod,
-                        "container": "workload",
-                        "pid": 100 + pod_j,
-                        "tid": 100 + pod_j,
-                        "value": value,
-                        "unit": SIGNAL_UNITS[HEARTBEAT_SIGNAL],
-                        "status": signal_status(HEARTBEAT_SIGNAL, value),
-                    }
-                )
-                continue
-            profile = profile_for_fault(injection.label)
-            for k, (signal, value) in enumerate(sorted(profile.items())):
-                event: dict[str, Any] = {
-                    "ts_unix_nano": ts + pod_j * 100 + k,
-                    "signal": signal,
-                    "node": node,
-                    "namespace": namespace,
-                    "pod": pod,
-                    "container": "workload",
-                    "pid": 100 + pod_j,
-                    "tid": 100 + pod_j,
-                    "value": float(value),
-                    "unit": SIGNAL_UNITS.get(signal, "ms"),
-                    "status": signal_status(signal, float(value)),
-                }
-                if signal in TPU_SIGNALS:
-                    event["tpu"] = {
-                        "slice_id": slice_id,
-                        "host_index": node_i % topo.nodes_per_slice,
-                    }
-                out.append(event)
-        return out
+        return events_for_round(
+            self.topology, node_i, round_i, self.round_ns, active
+        )
 
     def _ship(self, node_i: int, events: list[dict[str, Any]]) -> None:
         """One node-agent cycle: chaos → gate → wire → shard."""
@@ -603,79 +700,8 @@ class FleetSimulator:
     def build_node_payloads(
         self, events_per_node: int
     ) -> list[dict[str, Any]]:
-        """One binary-transport shipment per node, template-cloned.
-
-        The per-signal template batch is built once
-        (``columns_from_samples`` over synthetic samples); each node's
-        shipment reuses the template's column buffers verbatim except
-        the timestamp column (shifted per node) and the pool entries
-        carrying node/pod/slice identity.  Generation is thus ~free
-        and the measurement isolates the aggregator path.
-        """
-        from datetime import datetime, timedelta, timezone
-
-        from tpuslo.collector.synthetic import RawSample
-        from tpuslo.columnar.generate import columns_from_samples
-        from tpuslo.signals import constants as sig
-        from tpuslo.signals.metadata import Metadata
-
-        topo = self.topology
-        n_signals = len(sig.ALL_SIGNALS)
-        n_samples = max(1, events_per_node // n_signals)
-        start = datetime(2026, 1, 1, tzinfo=timezone.utc)
-        samples = [
-            RawSample(
-                timestamp=start + timedelta(milliseconds=i),
-                cluster="fleet",
-                namespace=topo.tenants[0],
-                workload="serving",
-                service="chat",
-                request_id=f"req-{i}",
-                trace_id=f"trace-{i}",
-                ttft_ms=100.0,
-                request_latency_ms=200.0,
-                token_throughput_tps=10.0,
-                error_rate=0.0,
-                fault_label="none",
-            )
-            for i in range(n_samples)
-        ]
-        meta = Metadata(
-            node="node-template",
-            namespace=topo.tenants[0],
-            pod="pod-template",
-            container="workload",
-            pid=1,
-            tid=1,
-            slice_id="slice-template",
-            host_index=0,
-        )
-        template = columns_from_samples(samples, meta, sig.ALL_SIGNALS)
-        base = encode_shipment(template, "node-template", 0)
-        # Pure lookups — the template metadata interned these already.
-        node_code = template.pool.intern("node-template")
-        pod_code = template.pool.intern("pod-template")
-        slice_code = template.pool.intern("slice-template")
-        ts_arr = template.columns["ts_unix_nano"]
-        payloads: list[dict[str, Any]] = []
-        for i in range(topo.nodes):
-            node = topo.node_name(i)
-            pool = list(base["pool"])
-            pool[node_code] = node
-            pool[pod_code] = topo.pod_name(i, 0)
-            pool[slice_code] = topo.slice_name(i)
-            columns = dict(base["columns"])
-            shifted = ts_arr + np.int64(i * 1_000)
-            columns["ts_unix_nano"] = shifted.tobytes()
-            payload = dict(base)
-            payload["node"] = node
-            payload["seq"] = 0
-            payload["head_ns"] = int(shifted[-1])
-            payload["slice_id"] = topo.slice_name(i)
-            payload["pool"] = pool
-            payload["columns"] = columns
-            payloads.append(payload)
-        return payloads
+        """One binary-transport shipment per node, template-cloned."""
+        return build_template_payloads(self.topology, events_per_node)
 
     def measure_ingest(
         self, events_per_node: int = 6000

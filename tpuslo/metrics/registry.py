@@ -333,6 +333,49 @@ class AgentMetrics:
             "each re-homes only the changed shard's arcs)",
             registry=self.registry,
         )
+        # ---- federation plane (tpuslo.federation) --------------------
+        self.federation_region_ingested = Counter(
+            "llm_slo_fleet_federation_region_ingested_incidents_total",
+            "Node incidents ingested by the region aggregator, per "
+            "source cluster (the cluster->region envelope hop)",
+            ["cluster"],
+            registry=self.registry,
+        )
+        self.federation_backpressure_level = Gauge(
+            "llm_slo_fleet_federation_backpressure_level",
+            "Current ingest-degradation level per aggregator "
+            "(0 none, 1 coarse batches, 2 sample low-severity, "
+            "3 aggressive sampling)",
+            ["source"],
+            registry=self.registry,
+        )
+        self.federation_sampled_rows = Counter(
+            "llm_slo_fleet_federation_sampled_rows_total",
+            "Low-severity rows sampled out under backpressure, by "
+            "the degradation level that dropped them (gated fault "
+            "evidence is structurally never sampled)",
+            ["level"],
+            registry=self.registry,
+        )
+        self.federation_churn_rebalances = Counter(
+            "llm_slo_fleet_federation_churn_rebalances_total",
+            "Online ring rebalances under churn, by kind "
+            "(shard_join/shard_leave); each re-homes only the moved "
+            "arcs with in-flight window handoff",
+            ["kind"],
+            registry=self.registry,
+        )
+        self.federation_incident_staleness_ms = Histogram(
+            "llm_slo_fleet_federation_incident_staleness_ms",
+            "How far the region head had advanced past an emitted "
+            "incident's window end — the resolution cost of "
+            "saturation-induced coarsening/sampling",
+            buckets=(
+                100, 250, 500, 1000, 2500, 5000, 10000, 20000,
+                30000, 60000,
+            ),
+            registry=self.registry,
+        )
         # ---- auto-remediation series (tpuslo.remediation) ------------
         self.remediation_actions_applied = Counter(
             "llm_slo_agent_remediation_actions_applied_total",
@@ -553,6 +596,13 @@ class AgentMetrics:
         tpuslo.fleet.FleetObserver)."""
         return _PromFleetObserver(self)
 
+    def federation_observer(self) -> "_PromFederationObserver":
+        """Observer adapter wiring the federation tree (region +
+        cluster aggregators, backpressure loop, churn rebalancer) to
+        this registry (duck-typed against
+        tpuslo.federation.FederationObserver)."""
+        return _PromFederationObserver(self)
+
     def remediation_observer(self) -> "_PromRemediationObserver":
         """Observer adapter wiring a RemediationEngine to this registry
         (duck-typed against tpuslo.remediation.RemediationObserver)."""
@@ -708,6 +758,49 @@ class _PromFleetObserver:
 
     def rebalance(self) -> None:
         self._m.fleet_ring_rebalances.inc()
+
+
+class _PromFederationObserver:
+    """Bridge from federation-tree callbacks to Prometheus.
+
+    Per-cluster counter children are cached like the fleet observer's:
+    region ingest fires once per envelope, sampling once per degraded
+    batch — a ``labels()`` dict lookup per call is avoidable waste.
+    """
+
+    def __init__(self, metrics: AgentMetrics):
+        self._m = metrics
+        self._ingest_children: dict[str, object] = {}
+        self._sampled_children: dict[int, object] = {}
+
+    def region_ingested(self, cluster: str, incidents: int) -> None:
+        child = self._ingest_children.get(cluster)
+        if child is None:
+            child = self._m.federation_region_ingested.labels(
+                cluster=cluster
+            )
+            self._ingest_children[cluster] = child
+        child.inc(incidents)
+
+    def backpressure_level(self, source: str, level: int) -> None:
+        self._m.federation_backpressure_level.labels(
+            source=source
+        ).set(level)
+
+    def sampled_rows(self, level: int, rows: int) -> None:
+        child = self._sampled_children.get(level)
+        if child is None:
+            child = self._m.federation_sampled_rows.labels(
+                level=str(level)
+            )
+            self._sampled_children[level] = child
+        child.inc(rows)
+
+    def churn_rebalance(self, kind: str, moved: int) -> None:
+        self._m.federation_churn_rebalances.labels(kind=kind).inc()
+
+    def incident_staleness_ms(self, ms: float) -> None:
+        self._m.federation_incident_staleness_ms.observe(ms)
 
 
 class _PromTraceObserver:
