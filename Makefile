@@ -15,6 +15,7 @@ ARTIFACTS ?= artifacts
 	federation-smoke federation-sweep \
 	remediation-smoke remediation-sweep \
 	frontdoor-smoke frontdoor-bench \
+	router-smoke router-bench \
 	deviceplane-smoke deviceplane-sweep \
 	metrics-drift m5-candidate m5-gate helm-lint dashboards clean
 
@@ -249,6 +250,25 @@ frontdoor-bench:
 		--summary-json $(ARTIFACTS)/frontdoor/bench.json \
 		--summary-md $(ARTIFACTS)/frontdoor/bench.md
 
+# Serving scale-out smoke: paged-vs-dense park/resume parity, router
+# placement policy (bounded-load affinity, burn steering, p2c), the
+# engine-kill drain/adopt path, loadgen prefix groups, and the
+# front-door Prometheus bridge — seconds, runs in m5-gate.
+router-smoke:
+	$(PY) -m pytest tests/test_router.py -q -m 'not slow'
+
+# Full serving scale-out release gate (slow): SLO-aware routing over
+# N replicated paged-KV front doors in a virtual-time harness —
+# aggregate goodput >= 0.8xN of one engine, bounded-load prefix
+# affinity beats random placement on TTFT p99, zero steady-state
+# recompiles per engine, and a mid-run engine kill loses zero
+# requests (see docs/runbooks/serving-scaleout.md).
+router-bench:
+	mkdir -p $(ARTIFACTS)/router
+	JAX_PLATFORMS=$${JAX_PLATFORMS:-cpu} $(PY) -m tpuslo m5gate --router-bench \
+		--summary-json $(ARTIFACTS)/router/bench.json \
+		--summary-md $(ARTIFACTS)/router/bench.md
+
 # Device-plane smoke: ledger bucket-sum/tier parity over seeded
 # synthetic-xprof traces, breakdown reason classes, roofline verdicts,
 # dispatch-ledger + front-door tracing — seconds, runs in m5-gate.
@@ -353,14 +373,16 @@ m5-candidate:
 # steady-state decode recompiles, burn-alert contract violations,
 # row-vs-columnar divergence, a broken fleet plane, a federation tree
 # that loses evidence under churn or saturation, a remediation loop
-# that acts imprecisely, or a serving front door that loses to
-# per-stream serving, before the statistical gates even run
-# (ISSUEs 6 + 7 + 8 + 9 + 10 + 11 + 12 + 15).
+# that acts imprecisely, a serving front door that loses to
+# per-stream serving, or a router tier that loses requests or
+# scaling across an engine kill, before the statistical gates even
+# run (ISSUEs 6 + 7 + 8 + 9 + 10 + 11 + 12 + 15 + 16).
 m5-gate: lint racecheck-smoke jitcheck-smoke burn-smoke burn-sweep \
 		bench-columnar-smoke fleet-smoke fleet-sweep \
 		federation-smoke federation-sweep \
 		remediation-smoke remediation-sweep \
 		frontdoor-smoke frontdoor-bench \
+		router-smoke router-bench \
 		deviceplane-smoke deviceplane-sweep
 	$(PY) -m tpuslo m5gate --candidate-root $(ARTIFACTS)/m5 \
 		--scenarios "$(shell echo $(M5_SCENARIOS) | tr ' ' ',')" \

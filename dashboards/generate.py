@@ -295,6 +295,21 @@ error_budget = dashboard(
         panel("Policy refusals by reason (held fire — precision evidence)", [
             ('sum(increase(llm_slo_agent_remediation_refusals_total[1h])) by (reason)', "{{reason}}"),
         ], 12, 40),
+        # --- serving front door (tpuslo.models.frontdoor) ------------
+        panel("Front-door admissions vs sheds (/s, by engine)", [
+            ('sum(rate(llm_slo_frontdoor_admitted_total[5m])) by (engine)', "admitted {{engine}}"),
+            ('sum(rate(llm_slo_frontdoor_shed_total[5m])) by (engine)', "shed {{engine}}"),
+        ], 0, 48),
+        panel("Sheds by tenant / reason (the availability hit ledger)", [
+            ('sum(increase(llm_slo_frontdoor_shed_total[1h])) by (tenant, reason)', "{{tenant}}/{{reason}}"),
+        ], 12, 48),
+        panel("Slot preemptions vs resumes (/s, by engine)", [
+            ('sum(rate(llm_slo_frontdoor_preemptions_total[5m])) by (engine)', "parked {{engine}}"),
+            ('sum(rate(llm_slo_frontdoor_resumes_total[5m])) by (engine)', "resumed {{engine}}"),
+        ], 0, 56),
+        panel("Completed tokens (/s, by tenant — goodput next to burn)", [
+            ('sum(rate(llm_slo_frontdoor_completed_tokens_total[5m])) by (tenant)', "{{tenant}}"),
+        ], 12, 56),
     ],
 )
 

@@ -1,0 +1,474 @@
+"""Serving scale-out: SLO-aware router over replicated front doors +
+paged-KV slots (ISSUE 16).
+
+Two exactness contracts on top of the PR 12 front door:
+
+* **Paged == dense.**  A front door with ``paged=True`` emits exactly
+  the token streams the dense-slot front door emits — through fresh
+  admission, warm-prefix admission and a forced preempt/park/resume
+  cycle — because decode runs the identical fused round; only the
+  park/resume copies change representation.
+
+* **The fleet == one engine.**  Any routing policy over replicated
+  engines yields the same per-request streams as a single engine (and
+  therefore the per-stream speculative reference), including across a
+  mid-run engine kill whose drained slots resume on siblings.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tpuslo.models.frontdoor import FrontDoorEngine, FrontDoorObserver
+from tpuslo.models.llama import llama_tiny
+from tpuslo.models.router import SLORouter, RouterDecision
+from tpuslo.models.speculative import SpeculativeEngine
+from tpuslo.sloengine.engine import BurnEngine
+
+
+@pytest.fixture(scope="module")
+def engines():
+    cfg = llama_tiny(max_seq_len=128)
+    from tpuslo.models.serve import ServeEngine
+
+    target = ServeEngine(cfg=cfg, rng_seed=0)
+    draft = ServeEngine(cfg=cfg, rng_seed=0)
+    return target, draft
+
+
+def spec_reference(engines, prompt, n, stop_at_eos=False, prefix=None):
+    spec = SpeculativeEngine(engines[0], engines[1], k=3)
+    return spec.generate(
+        prompt, max_new_tokens=n, stop_at_eos=stop_at_eos, prefix=prefix
+    )
+
+
+def make_frontdoor(engines, paged=False, **kw):
+    kw.setdefault("k", 3)
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("rounds_per_step", 1)
+    return FrontDoorEngine(*engines, paged=paged, block_size=32, **kw)
+
+
+def make_fleet(engines, n, paged=True, **kw):
+    return [make_frontdoor(engines, paged=paged, **kw) for _ in range(n)]
+
+
+# ---- paged-vs-dense parity (satellite) ---------------------------------
+
+
+class TestPagedParity:
+    def test_paged_streams_match_dense(self, engines):
+        prompts = [f"paged parity {i}" for i in range(6)]
+        dense = make_frontdoor(engines, paged=False)
+        paged = make_frontdoor(engines, paged=True)
+        dense_ids = [
+            dense.submit(p, max_new_tokens=10, stop_at_eos=False)
+            for p in prompts
+        ]
+        paged_ids = [
+            paged.submit(p, max_new_tokens=10, stop_at_eos=False)
+            for p in prompts
+        ]
+        dense_out, paged_out = dense.run(), paged.run()
+        for d_rid, p_rid in zip(dense_ids, paged_ids):
+            assert dense_out[d_rid] == paged_out[p_rid]
+
+    def test_paged_park_resume_cycle_bit_identical(self, engines):
+        """A preemption exercises the block-granular park + resume:
+        the resumed stream must continue exactly where it left off."""
+        burn = BurnEngine()
+        burn.demote_tenant("lowly")
+        fd = make_frontdoor(engines, paged=True, burn_engine=burn)
+        low_ids = [
+            fd.submit(f"low paged stream {i}", tenant="lowly",
+                      max_new_tokens=24, stop_at_eos=False)
+            for i in range(2)
+        ]
+        for _ in range(2):
+            fd.step()
+        hi = fd.submit("high priority paged", tenant="vip",
+                       max_new_tokens=8, stop_at_eos=False)
+        results = fd.run()
+        assert fd.paged_parks >= 1
+        assert fd.paged_resumes >= 1
+        assert fd.paged_fallback_parks == 0
+        for i, rid in enumerate(low_ids):
+            assert results[rid] == spec_reference(
+                engines, f"low paged stream {i}", 24
+            )
+        assert results[hi] == spec_reference(
+            engines, "high priority paged", 8
+        )
+        # every parked block returned to the free list
+        stats = fd.stats()["paged"]
+        assert stats["free_blocks"] == stats["pool_blocks"] - 1
+
+    def test_paged_warm_prefix_admission(self, engines):
+        prefix = "[system] paged prefix parity."
+        fd = make_frontdoor(engines, paged=True)
+        prompts = [f" q{i}?" for i in range(4)]
+        ids = [
+            fd.submit(p, max_new_tokens=8, stop_at_eos=False,
+                      prefix=prefix)
+            for p in prompts
+        ]
+        results = fd.run()
+        for prompt, rid in zip(prompts, ids):
+            assert results[rid] == spec_reference(
+                engines, prompt, 8, prefix=prefix
+            )
+
+    def test_pool_exhaustion_falls_back_to_dense_park(self, engines):
+        """A park pool too small for even one bucket must not fail the
+        preemption — it falls back to the full-row snapshot."""
+        burn = BurnEngine()
+        burn.demote_tenant("lowly")
+        fd = FrontDoorEngine(
+            *engines, k=3, max_slots=2, rounds_per_step=1,
+            paged=True, block_size=32, pool_blocks=1,
+            burn_engine=burn,
+        )
+        lo = fd.submit("fallback stream one two three", tenant="lowly",
+                       max_new_tokens=24, stop_at_eos=False)
+        fd.submit("second low", tenant="lowly",
+                  max_new_tokens=24, stop_at_eos=False)
+        for _ in range(2):
+            fd.step()
+        fd.submit("vip arrival", tenant="vip",
+                  max_new_tokens=8, stop_at_eos=False)
+        results = fd.run()
+        assert fd.paged_fallback_parks >= 1
+        assert results[lo] == spec_reference(
+            engines, "fallback stream one two three", 24
+        )
+
+
+# ---- routing policy ----------------------------------------------------
+
+
+class TestRoutingPolicy:
+    def test_affinity_routes_group_to_one_engine(self, engines):
+        router = SLORouter(make_fleet(engines, 3), seed=0)
+        homes = set()
+        for i in range(6):
+            gid = router.route(f" q{i}", max_new_tokens=4,
+                               prefix="grp-00/sys")
+            homes.add(router._placements[gid][0])
+            router.run()  # drain: queues stay under the overflow bound
+        assert len(homes) == 1  # one warm home, all requests follow it
+        assert router.affinity_hits == 5  # all but the cold fill
+
+    def test_hot_group_spills_past_overflow_bound(self, engines):
+        """Bounded-load affinity: once the warm home's queue exceeds
+        ``affinity_overflow × max_slots``, the group spills to a
+        sibling and becomes warm there too (replication under
+        pressure) instead of pinning its whole tail on one engine."""
+        router = SLORouter(make_fleet(engines, 3), seed=0)
+        gids = [
+            router.route(f" hot{i}", max_new_tokens=4,
+                         prefix="grp-00/sys")
+            for i in range(6)  # no stepping: queues only grow
+        ]
+        homes = {router._placements[g][0] for g in gids}
+        assert len(homes) > 1  # the overloaded home stopped attracting
+        warm_on = [
+            i for i in router.live_engines()
+            if "grp-00/sys" in router._warm[i]
+        ]
+        assert len(warm_on) == len(homes)  # spillover 2-homed the group
+        router.run()
+
+    def test_distinct_groups_spread_by_load(self, engines):
+        router = SLORouter(make_fleet(engines, 3), seed=0)
+        for g in range(3):
+            for i in range(2):
+                router.route(f" q{g}-{i}", max_new_tokens=4,
+                             prefix=f"grp-{g:02d}/sys")
+        # second request of each group lands warm on its group's home
+        warm = [d for d in router.decisions if d.warm_hit]
+        assert len(warm) == 3
+        # cold fills spread across the fleet instead of piling up
+        cold_homes = {
+            d.engine for d in router.decisions if not d.warm_hit
+        }
+        assert len(cold_homes) > 1
+        router.run()
+
+    def test_random_policy_never_counts_affinity(self, engines):
+        router = SLORouter(
+            make_fleet(engines, 3), policy="random", seed=3
+        )
+        for i in range(6):
+            router.route(f" q{i}", max_new_tokens=4,
+                         prefix="grp-00/sys")
+        assert router.affinity_hits == 0
+        router.run()
+
+    def test_fleet_streams_match_single_engine(self, engines):
+        prompts = [f"fleet parity {i}" for i in range(8)]
+        single = make_frontdoor(engines)
+        ref_ids = [
+            single.submit(p, max_new_tokens=8, stop_at_eos=False)
+            for p in prompts
+        ]
+        ref = single.run()
+        router = SLORouter(make_fleet(engines, 3), seed=0)
+        gids = [
+            router.route(p, max_new_tokens=8, stop_at_eos=False)
+            for p in prompts
+        ]
+        out = router.run()
+        for rid, gid in zip(ref_ids, gids):
+            assert out[gid] == ref[rid]
+
+    def test_burning_tenant_steers_off_contended_engine(self, engines):
+        burn = BurnEngine()
+        fleet = make_fleet(engines, 2, max_slots=1)
+        router = SLORouter(fleet, burn_engine=burn, seed=0)
+        # Occupy the warm home's only slot (contended: full house,
+        # but its queue is empty so affinity still holds for healthy
+        # tenants — burn steering, not overflow, must do the work).
+        router.route("occupy one", max_new_tokens=16,
+                     stop_at_eos=False, prefix="hot/sys")
+        contended = router._placements[0][0]
+        router.step()
+
+        class FakeBurn:
+            def tenant_burn_state(self, tenant):
+                return "fast_burn" if tenant == "burny" else "ok"
+
+        router._burn = FakeBurn()
+        gid = router.route("burning request", tenant="burny",
+                           max_new_tokens=4, prefix="hot/sys")
+        # Affinity says the contended engine; burn steering overrides.
+        assert router._placements[gid][0] != contended
+        router._burn = None
+        # A healthy tenant keeps following affinity onto that engine.
+        ok = router.route("healthy request", max_new_tokens=4,
+                          prefix="hot/sys")
+        assert router._placements[ok][0] == contended
+        router.run()
+
+    def test_shed_reconciliation_surfaces_global_ids(self, engines):
+        fleet = [
+            make_frontdoor(engines, max_slots=1, max_queue=1)
+        ]
+        router = SLORouter(fleet, seed=0)
+        kept = router.route("first", max_new_tokens=12,
+                            stop_at_eos=False)
+        router.step()  # first occupies the slot
+        router.route("second", max_new_tokens=4)  # fills the queue
+        refused = router.route("third", max_new_tokens=4)
+        assert refused is None
+        assert router.shed  # global-scope shed record exists
+        out = router.run()
+        assert kept in out
+
+    def test_decision_log_bounded_and_typed(self, engines):
+        router = SLORouter(make_fleet(engines, 2), seed=0)
+        router.route("decided", max_new_tokens=2)
+        dec = router.decisions[-1]
+        assert isinstance(dec, RouterDecision)
+        assert dec.engine in (0, 1)
+        assert RouterDecision.__slots__  # hot-path record stays slotted
+        router.run()
+
+
+# ---- rebalancing under failure -----------------------------------------
+
+
+class TestEngineKill:
+    def test_kill_loses_zero_requests_and_keeps_parity(self, engines):
+        """Mixed plain + prefixed traffic across a kill: every stream
+        matches the uninterrupted single-engine reference."""
+        specs = [
+            (f"kill parity {i}",
+             f"grp-{i % 2:02d}/sys" if i % 3 else None)
+            for i in range(9)
+        ]
+        single = make_frontdoor(engines)
+        ref_ids = [
+            single.submit(p, max_new_tokens=10, stop_at_eos=False,
+                          prefix=g)
+            for p, g in specs
+        ]
+        ref = single.run()
+        router = SLORouter(make_fleet(engines, 3), seed=0)
+        gids = [
+            router.route(p, max_new_tokens=10, stop_at_eos=False,
+                         prefix=g)
+            for p, g in specs
+        ]
+        for _ in range(2):
+            router.step()
+        victim = router.live_engines()[0]
+        moved = router.kill_engine(victim)
+        assert victim not in router.live_engines()
+        out = router.run()
+        assert len(out) == len(specs)  # zero lost across the kill
+        assert router.rebalanced == moved
+        for rid, gid in zip(ref_ids, gids):
+            assert out[gid] == ref[rid]
+
+    def test_kill_mid_run_stream_parity_no_prefix(self, engines):
+        prompts = [f"kill stream {i}" for i in range(8)]
+        refs = {
+            p: spec_reference(engines, p, 16) for p in prompts
+        }
+        router = SLORouter(make_fleet(engines, 3), seed=1)
+        gids = {
+            router.route(p, max_new_tokens=16, stop_at_eos=False): p
+            for p in prompts
+        }
+        for _ in range(2):
+            router.step()
+        moved = router.kill_engine(1)
+        out = router.run()
+        assert len(out) == len(prompts)
+        for gid, p in gids.items():
+            assert out[gid] == refs[p], p
+        assert moved >= 1  # the kill actually rebalanced live work
+
+    def test_kill_rehomes_warm_groups(self, engines):
+        router = SLORouter(make_fleet(engines, 3), seed=0)
+        router.route("warm it", max_new_tokens=2, prefix="grp-07/sys")
+        home = router._placements[0][0]
+        router.run()
+        router.kill_engine(home)
+        assert any(
+            "grp-07/sys" in router._warm[i]
+            for i in router.live_engines()
+        )
+        gid = router.route("after kill", max_new_tokens=2,
+                           prefix="grp-07/sys")
+        assert router._placements[gid][0] in router.live_engines()
+        router.run()
+
+    def test_kill_last_engine_refuses_routing(self, engines):
+        router = SLORouter(make_fleet(engines, 1), seed=0)
+        router.kill_engine(0)
+        with pytest.raises(RuntimeError):
+            router.route("nowhere to go", max_new_tokens=2)
+
+
+# ---- loadgen prefix groups (satellite) ---------------------------------
+
+
+class TestLoadgenPrefixGroups:
+    def test_weights_normalized_and_tenant_shifted(self):
+        from tpuslo.cli.loadgen import prefix_group_weights
+
+        for tenant_idx in range(4):
+            w = prefix_group_weights(tenant_idx, 4)
+            assert len(w) == 4
+            assert abs(sum(w) - 1.0) < 1e-9
+            # each tenant's heaviest group is its own shifted slot
+            assert max(range(4), key=lambda g: w[g]) == tenant_idx % 4
+
+    def test_invalid_group_count_raises(self):
+        from tpuslo.cli.loadgen import prefix_group_weights
+
+        with pytest.raises(ValueError):
+            prefix_group_weights(0, 0)
+
+    def test_synthesize_distributes_over_groups(self):
+        from tpuslo.cli.loadgen import synthesize_requests
+
+        reqs = synthesize_requests(
+            rps=20.0, duration_s=20.0, seed=7, tenants=4,
+            prefix_rate=1.0, prefix_groups=8,
+        )
+        groups = {r["prefix_group"] for r in reqs if r.get("prefix_group")}
+        assert len(groups) == 8
+        assert all(g.startswith("grp-") for g in groups)
+
+    def test_single_group_keeps_legacy_per_tenant_prefix(self):
+        from tpuslo.cli.loadgen import synthesize_requests
+
+        reqs = synthesize_requests(
+            rps=5.0, duration_s=10.0, seed=7, tenants=2,
+            prefix_rate=1.0, prefix_groups=1,
+        )
+        for r in reqs:
+            if r.get("prefix_group"):
+                assert r["prefix_group"].endswith("/sys")
+                assert not r["prefix_group"].startswith("grp-")
+
+
+# ---- metrics bridge (satellite) ----------------------------------------
+
+
+class TestFrontDoorMetricsBridge:
+    def test_observer_contract_and_series(self, engines):
+        prometheus_client = pytest.importorskip("prometheus_client")
+        from tpuslo.metrics.registry import AgentMetrics
+
+        metrics = AgentMetrics(
+            registry=prometheus_client.CollectorRegistry()
+        )
+        obs = metrics.frontdoor_observer(engine="0")
+        # full FrontDoorObserver surface, including the new resumed()
+        for hook in ("admitted", "shed", "preempted", "resumed",
+                     "completed"):
+            assert hasattr(FrontDoorObserver, hook)
+        burn = BurnEngine()
+        burn.demote_tenant("lowly")
+        fd = make_frontdoor(
+            engines, paged=True, burn_engine=burn, observer=obs,
+        )
+        for i in range(2):
+            fd.submit(f"metrics low {i}", tenant="lowly",
+                      max_new_tokens=20, stop_at_eos=False)
+        for _ in range(2):
+            fd.step()
+        fd.submit("metrics vip", tenant="vip", max_new_tokens=6,
+                  stop_at_eos=False)
+        fd.run()
+
+        def value(metric, **labels):
+            for family in metric.collect():
+                for sample in family.samples:
+                    if sample.name.endswith("_total") and all(
+                        sample.labels.get(k) == v
+                        for k, v in labels.items()
+                    ):
+                        return sample.value
+            return 0.0
+
+        assert value(metrics.frontdoor_admitted, tenant="lowly") >= 2
+        assert value(
+            metrics.frontdoor_preemptions, tenant="lowly"
+        ) >= 1
+        assert value(metrics.frontdoor_resumes, tenant="lowly") >= 1
+        assert value(
+            metrics.frontdoor_completed_tokens, tenant="vip"
+        ) >= 6.0
+
+    def test_shed_series_labelled_by_reason(self, engines):
+        prometheus_client = pytest.importorskip("prometheus_client")
+        from tpuslo.metrics.registry import AgentMetrics
+
+        metrics = AgentMetrics(
+            registry=prometheus_client.CollectorRegistry()
+        )
+        obs = metrics.frontdoor_observer(engine="1")
+        fd = make_frontdoor(
+            engines, max_slots=1, max_queue=1, observer=obs,
+        )
+        fd.submit("occupy", max_new_tokens=12, stop_at_eos=False)
+        fd.step()
+        fd.submit("queued", max_new_tokens=2)
+        assert fd.submit("refused", max_new_tokens=2) is None
+        found = False
+        for family in metrics.frontdoor_shed.collect():
+            for sample in family.samples:
+                if (
+                    sample.name.endswith("_total")
+                    and sample.labels.get("reason") == "queue_full"
+                    and sample.labels.get("engine") == "1"
+                    and sample.value >= 1
+                ):
+                    found = True
+        assert found
+        fd.run()

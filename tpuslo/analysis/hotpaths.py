@@ -105,6 +105,13 @@ HOT_FUNCTIONS: tuple[tuple[str, str], ...] = (
     ("tpuslo/models/frontdoor.py", "FrontDoorEngine._fill_slots"),
     ("tpuslo/models/frontdoor.py", "FrontDoorEngine._admit"),
     ("tpuslo/models/frontdoor.py", "FrontDoorEngine._admit_batch"),
+    # Serving scale-out router (ISSUE 16): placement runs once per
+    # request at fleet arrival rate — the scored policy reads queue
+    # depths and the warm mirror, never device state, and a stray
+    # logging call here delays every admission behind it.
+    ("tpuslo/models/router.py", "SLORouter.route"),
+    ("tpuslo/models/router.py", "SLORouter._score_engine"),
+    ("tpuslo/models/router.py", "SLORouter._pick_engine"),
     # Device-plane ledger (ISSUE 14): the fold runs over every span of
     # a capture (thousands per trace) and inside gates/benches; the
     # per-dispatch ledger note runs once per serving dispatch inside
@@ -152,6 +159,10 @@ HOT_DATACLASSES: tuple[tuple[str, str], ...] = (
     # Front-door slot/queue records (ISSUE 12): allocated per request,
     # scanned per round boundary by the scheduler.
     ("tpuslo/models/frontdoor.py", "FrontDoorRequest"),
+    # Paged park record (ISSUE 16): one per preemption in paged mode;
+    # router placement record: one per request at arrival rate.
+    ("tpuslo/models/frontdoor.py", "_PagedParked"),
+    ("tpuslo/models/router.py", "RouterDecision"),
     # Device-plane ledger records (ISSUE 14): one per module launch.
     ("tpuslo/deviceplane/ledger.py", "LaunchRecord"),
     ("tpuslo/deviceplane/ledger.py", "DeviceWindow"),
@@ -187,4 +198,9 @@ JAX_HOT_LOOPS: tuple[tuple[str, str], ...] = (
     ("tpuslo/models/speculative.py", "SpeculativeEngine.generate_batch"),
     ("tpuslo/models/frontdoor.py", "FrontDoorEngine._step"),
     ("tpuslo/models/frontdoor.py", "FrontDoorEngine._admit"),
+    # Paged park/resume (ISSUE 16): run per preemption / per resumed
+    # admission inside the serving loop — one dispatch each, with the
+    # block bookkeeping (free list, bucket choice) pure host ints.
+    ("tpuslo/models/frontdoor.py", "FrontDoorEngine._park_paged"),
+    ("tpuslo/models/frontdoor.py", "FrontDoorEngine._resume_paged"),
 )

@@ -16,6 +16,15 @@ multi-tenant population with weighted shares, and ``--prefix-rate``
 marks a fraction of each tenant's requests as sharing a per-tenant
 prompt prefix (``prefix_group``) — the signal prefix-cache-aware
 placement batches on.
+
+The router bench (ISSUE 16) needs prefix traffic an affinity router
+can actually be *wrong* about: one group per tenant makes affinity
+routing trivially easy (any stable hash wins).  ``--prefix-groups N``
+distributes each tenant's prefix hits over N distinct, fleet-wide
+groups with per-tenant weighting (:func:`prefix_group_weights`): every
+tenant leans on a different subset of the shared groups, so placement
+quality depends on tracking *which engine is warm for which group*,
+not on tenant identity.
 """
 
 from __future__ import annotations
@@ -75,6 +84,33 @@ def parse_tenant_mix(spec: str, n_tenants: int) -> list[float]:
         weights += [weights[-1]] * (n_tenants - len(weights))
     total = sum(weights)
     return [w / total for w in weights]
+
+
+def prefix_group_weights(
+    tenant_idx: int, prefix_groups: int
+) -> list[float]:
+    """Normalized per-tenant weights over the shared prefix groups.
+
+    Tenant ``t`` favors group ``t % N`` and decays harmonically over
+    the groups after it (cyclically): group ``(t + j) % N`` carries
+    weight ``1 / (1 + j)``.  Deterministic and parameter-free, so the
+    bench and the CLI agree on the mix without sharing an RNG; every
+    tenant's hot set is distinct, which is exactly what makes
+    prefix-affinity routing non-trivial to get right.
+    """
+    if prefix_groups < 1:
+        raise ValueError("--prefix-groups must be >= 1")
+    weights = [0.0] * prefix_groups
+    for j in range(prefix_groups):
+        weights[(tenant_idx + j) % prefix_groups] = 1.0 / (1 + j)
+    total = sum(weights)
+    return [w / total for w in weights]
+
+
+def prefix_group_name(group_idx: int) -> str:
+    """Fleet-wide group naming (``grp-03/sys``): groups are shared
+    ACROSS tenants, unlike the legacy per-tenant ``<tenant>/sys``."""
+    return f"grp-{group_idx:02d}/sys"
 
 
 def arrival_offsets_ms(
@@ -138,20 +174,28 @@ def synthesize_requests(
     tenants: int = 1,
     tenant_mix: str = "",
     prefix_rate: float = 0.0,
+    prefix_groups: int = 1,
 ) -> list[dict]:
     """Deterministic multi-tenant request records (offset-sorted).
 
     Each record carries the legacy trace fields plus ``tenant`` and —
     for the ``prefix_rate`` fraction of a tenant's requests —
-    ``prefix_group`` (``"<tenant>/sys"``): requests in one group share
-    a prompt prefix, the unit prefix caching snapshots once and the
-    front-door scheduler batches together.
+    ``prefix_group``: requests in one group share a prompt prefix, the
+    unit prefix caching snapshots once and the front-door scheduler
+    batches together.  With ``prefix_groups == 1`` (the default) the
+    group is the legacy per-tenant ``"<tenant>/sys"``; with ``N > 1``
+    hits spread over N fleet-wide groups (``grp-00/sys``..) under
+    :func:`prefix_group_weights` per-tenant weighting.
     """
     prompt_tokens, max_new, ttft_range = PROFILES[profile]
     rng = random.Random(seed)
     count = max(1, int(rps * duration_s))
     weights = parse_tenant_mix(tenant_mix, tenants)
     tenant_names = [f"tenant-{i:02d}" for i in range(tenants)]
+    group_weights = [
+        prefix_group_weights(t, prefix_groups) for t in range(tenants)
+    ]
+    group_names = [prefix_group_name(g) for g in range(prefix_groups)]
     offsets = arrival_offsets_ms(arrival, count, duration_s, rng)
     records = []
     for idx, offset_ms in enumerate(offsets):
@@ -169,7 +213,13 @@ def synthesize_requests(
             "stream": True,
         }
         if rng.random() < prefix_rate:
-            record["prefix_group"] = f"{tenant}/sys"
+            if prefix_groups == 1:
+                record["prefix_group"] = f"{tenant}/sys"
+            else:
+                tenant_idx = tenant_names.index(tenant)
+                record["prefix_group"] = rng.choices(
+                    group_names, weights=group_weights[tenant_idx]
+                )[0]
         records.append(record)
     return records
 
@@ -210,6 +260,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="fraction of each tenant's requests stamped with a "
         "shared prefix_group (prefix-cache-aware placement batches "
         "these onto snapshot-reusing slots)",
+    )
+    p.add_argument(
+        "--prefix-groups",
+        type=int,
+        default=1,
+        help="number of distinct fleet-wide prefix groups the "
+        "--prefix-rate hits spread over (grp-00/sys..), weighted per "
+        "tenant so every tenant leans on a different hot set; 1 keeps "
+        "the legacy per-tenant '<tenant>/sys' group",
     )
     p.add_argument(
         "--slo-out",
@@ -266,6 +325,7 @@ def main(argv: list[str] | None = None) -> int:
         tenants=args.tenants,
         tenant_mix=args.tenant_mix,
         prefix_rate=args.prefix_rate,
+        prefix_groups=args.prefix_groups,
     )
     start = datetime.fromisoformat(
         args.start.replace("Z", "+00:00")

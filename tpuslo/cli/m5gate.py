@@ -70,6 +70,17 @@ sequentially through the per-stream SpeculativeEngine, with zero
 steady-state recompiles under jitaudit, host syncs per token within
 the serving ceiling, and the burn-aware admission observable.
 
+``--router-bench`` runs the serving scale-out gate
+(``tpuslo.benchmark.router_bench``): thousands of concurrent streams
+placed by the SLORouter over N replicated paged-KV front doors in a
+virtual-time discrete-event harness — aggregate goodput must reach
+>= 0.8xN of a single identical engine on the same burst, bounded-load
+prefix affinity must beat uniform-random placement on TTFT p99 on a
+paced multi-group workload, every fleet pass must show zero
+steady-state recompiles (jitaudit), and a mid-run engine kill must
+drain parked/running slots onto siblings with zero lost requests and
+bit-exact stream parity against an uninterrupted reference.
+
 ``--deviceplane-sweep`` runs the device-plane truth gate
 (``tpuslo.deviceplane.sweep``): seeded synthetic-xprof traces with
 every real-capture join pathology (lane-split ops, anonymous warmups,
@@ -228,6 +239,34 @@ def build_parser() -> argparse.ArgumentParser:
         help="re-run the whole lane this many times if a wall-clock "
         "gate fails (the lane times real serving on a possibly-"
         "shared box; counter gates are deterministic either way)",
+    )
+    # ---- serving scale-out gate (tpuslo.models.router) ----------------
+    p.add_argument(
+        "--router-bench",
+        action="store_true",
+        help="run the serving scale-out gate instead of B5/D3/E3: "
+        "SLO-aware routing over N replicated paged-KV front doors in "
+        "virtual time — aggregate goodput >= 0.8xN of one engine, "
+        "bounded-load prefix affinity beats random placement on TTFT "
+        "p99, zero steady-state recompiles per engine, and a mid-run "
+        "engine kill loses zero requests with bit-exact stream parity",
+    )
+    p.add_argument("--router-seed", type=int, default=1337)
+    p.add_argument("--router-engines", type=int, default=4)
+    p.add_argument("--router-streams", type=int, default=1024)
+    p.add_argument("--router-slots", type=int, default=8)
+    p.add_argument("--router-k", type=int, default=3)
+    p.add_argument("--router-tokens", type=int, default=16)
+    p.add_argument("--router-tenants", type=int, default=4)
+    p.add_argument("--router-prefix-groups", type=int, default=8)
+    p.add_argument("--router-kill-streams", type=int, default=96)
+    p.add_argument(
+        "--router-retries",
+        type=int,
+        default=1,
+        help="re-run the whole lane this many times if a wall-clock "
+        "gate fails (virtual time is built from real step durations "
+        "on a possibly-shared box; counter gates are deterministic)",
     )
     # ---- device-plane truth gate (tpuslo.deviceplane) -----------------
     p.add_argument(
@@ -555,6 +594,103 @@ def render_frontdoor_markdown(report: dict) -> str:
         lines += ["", "## Failures", ""]
         lines += [f"- {f}" for f in report["failures"]]
     return "\n".join(lines) + "\n"
+
+
+def render_router_markdown(report: dict) -> str:
+    fleet = report["fleet"]
+    single = report["single"]
+    aff = report["affinity"]
+    rnd = report["random"]
+    kill = report["kill_scenario"]
+    lines = [
+        "# Serving scale-out gate (SLO router over replicated front doors)",
+        "",
+        f"**Overall: {'PASS' if report['passed'] else 'FAIL'}**",
+        "",
+        f"- seed {report['seed']}: {report['streams']} streams over "
+        f"{report['engines']} paged engines "
+        f"(block size {report['block_size']}), {report['tenants']} "
+        f"tenants, {report['prefix_groups']} prefix groups at rate "
+        f"{report['prefix_rate']:g}; {report['max_slots']} slots, "
+        f"k={report['k']}, {report['max_new_tokens']} tokens each",
+        f"- SLO (solo-calibrated): TTFT {report['slo']['ttft_ms']:g} ms, "
+        f"TPOT {report['slo']['tpot_ms']:g} ms; virtual-time harness "
+        f"(paced window {report['paced_window_s']:g}s)",
+        "",
+        "| pass | tok/s | goodput tok/s | TTFT p99 (ms) | shed |",
+        "|---|---|---|---|---|",
+        f"| fleet (burst, N={report['engines']}) "
+        f"| {fleet['tokens_per_sec']:g} "
+        f"| {fleet['goodput_tokens_per_sec']:g} "
+        f"| {fleet['ttft_p99_ms']:g} | {fleet['shed']} |",
+        f"| single engine (same burst) | {single['tokens_per_sec']:g} "
+        f"| {single['goodput_tokens_per_sec']:g} "
+        f"| {single['ttft_p99_ms']:g} | {single['shed']} |",
+        f"| affinity policy (paced) | {aff['tokens_per_sec']:g} "
+        f"| {aff['goodput_tokens_per_sec']:g} "
+        f"| {aff['ttft_p99_ms']:g} | {aff['shed']} |",
+        f"| random policy (paced) | {rnd['tokens_per_sec']:g} "
+        f"| {rnd['goodput_tokens_per_sec']:g} "
+        f"| {rnd['ttft_p99_ms']:g} | {rnd['shed']} |",
+        "",
+        f"- aggregate goodput **{report['router_goodput_ratio']:g}x** "
+        f"the single engine (floor {report['router_scaling_floor']:g}x "
+        f"= 0.8xN; throughput {report['router_throughput_ratio']:g}x)",
+        f"- affinity TTFT p99 {report['router_affinity_ttft_p99_ms']:g} "
+        f"ms vs random {report['router_random_ttft_p99_ms']:g} ms "
+        f"(hit rate {report['router_affinity_hit_rate']:.1%})",
+        f"- steady-state recompiles {report['spec_retrace_count']} "
+        f"(ceiling 0)",
+        f"- engine kill: {kill['streams']} streams, engine "
+        f"{kill['killed_engine']} killed mid-run, {kill['rebalanced']} "
+        f"rebalanced, {kill['lost_requests']} lost, "
+        f"{kill['mismatched_streams']} diverged from the uninterrupted "
+        f"reference",
+    ]
+    if report["failures"]:
+        lines += ["", "## Failures", ""]
+        lines += [f"- {f}" for f in report["failures"]]
+    return "\n".join(lines) + "\n"
+
+
+def run_router_gate(args) -> int:
+    from tpuslo.benchmark.router_bench import run_router_bench
+
+    log = lambda msg: print(f"m5gate: {msg}", file=sys.stderr)  # noqa: E731
+    report = None
+    for attempt in range(max(1, args.router_retries + 1)):
+        if attempt:
+            log("router-bench retrying (wall-clock gate failed)")
+        report = run_router_bench(
+            seed=args.router_seed,
+            engines=args.router_engines,
+            streams=args.router_streams,
+            max_slots=args.router_slots,
+            k=args.router_k,
+            max_new_tokens=args.router_tokens,
+            tenants=args.router_tenants,
+            prefix_groups=args.router_prefix_groups,
+            kill_streams=args.router_kill_streams,
+            log=log,
+        )
+        if report["passed"]:
+            break
+    if args.summary_json:
+        Path(args.summary_json).write_text(
+            json.dumps(report, indent=2, default=str) + "\n"
+        )
+    if args.summary_md:
+        Path(args.summary_md).write_text(render_router_markdown(report))
+    print(
+        f"m5gate: router-bench {'PASS' if report['passed'] else 'FAIL'}"
+        + (
+            ""
+            if report["passed"]
+            else f" ({'; '.join(report['failures'])})"
+        ),
+        file=sys.stderr,
+    )
+    return 0 if report["passed"] else 1
 
 
 def run_frontdoor_gate(args) -> int:
@@ -1083,6 +1219,8 @@ def main(argv: list[str] | None = None) -> int:
         return run_remediation_gate(args)
     if args.frontdoor_bench:
         return run_frontdoor_gate(args)
+    if args.router_bench:
+        return run_router_gate(args)
     if args.deviceplane_sweep:
         return run_deviceplane_gate(args)
     if args.fleet_sweep:

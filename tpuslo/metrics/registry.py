@@ -492,6 +492,47 @@ class AgentMetrics:
             ["verdict"],
             registry=self.registry,
         )
+        # ---- serving front door (tpuslo.models.frontdoor) -------------
+        # The engine's admission counters were internal-only (stats()
+        # dicts); these export them live through the FrontDoorObserver
+        # hooks so shed/preempt pressure shows up on the error-budget
+        # board next to the burn it causes.
+        self.frontdoor_admitted = Counter(
+            "llm_slo_frontdoor_admitted_total",
+            "Requests admitted into front-door decode slots, by "
+            "engine and tenant",
+            ["engine", "tenant"],
+            registry=self.registry,
+        )
+        self.frontdoor_shed = Counter(
+            "llm_slo_frontdoor_shed_total",
+            "Requests refused by SLO-aware admission, by engine, "
+            "tenant and reason "
+            "(queue_full/displaced/queue_full_burning)",
+            ["engine", "tenant", "reason"],
+            registry=self.registry,
+        )
+        self.frontdoor_preemptions = Counter(
+            "llm_slo_frontdoor_preemptions_total",
+            "Running slots parked to make room for higher-priority "
+            "work, by engine and tenant",
+            ["engine", "tenant"],
+            registry=self.registry,
+        )
+        self.frontdoor_resumes = Counter(
+            "llm_slo_frontdoor_resumes_total",
+            "Parked/teacher-forced streams resumed into a slot, by "
+            "engine and tenant",
+            ["engine", "tenant"],
+            registry=self.registry,
+        )
+        self.frontdoor_completed_tokens = Counter(
+            "llm_slo_frontdoor_completed_tokens_total",
+            "Tokens emitted by completed front-door requests, by "
+            "engine and tenant",
+            ["engine", "tenant"],
+            registry=self.registry,
+        )
 
     def set_enabled_signals(self, enabled: list[str]) -> None:
         enabled_set = set(enabled)
@@ -612,6 +653,13 @@ class AgentMetrics:
         """Observer adapter wiring device-plane ledger folds, serving
         dispatches, and roofline attachments to this registry."""
         return _PromDeviceplaneObserver(self)
+
+    def frontdoor_observer(self, engine: str = "0") -> "_PromFrontDoorObserver":
+        """Observer adapter wiring ONE serving front door's admission
+        lifecycle to this registry (duck-typed against
+        tpuslo.models.frontdoor.FrontDoorObserver); ``engine`` labels
+        the replica under an SLORouter fleet."""
+        return _PromFrontDoorObserver(self, engine)
 
 
 _BREAKER_STATE_VALUES = {"closed": 0, "half_open": 1, "open": 2}
@@ -979,6 +1027,41 @@ class _PromDeviceplaneObserver:
         self._m.deviceplane_roofline_verdicts.labels(
             verdict=verdict
         ).inc()
+
+
+class _PromFrontDoorObserver:
+    """Per-engine bridge from front-door admission callbacks to
+    Prometheus (the FrontDoorObserver contract: admitted/shed/
+    preempted/resumed/completed)."""
+
+    def __init__(self, metrics: AgentMetrics, engine: str):
+        self._m = metrics
+        self._engine = str(engine)
+
+    def admitted(self, tenant: str) -> None:
+        self._m.frontdoor_admitted.labels(
+            engine=self._engine, tenant=tenant
+        ).inc()
+
+    def shed(self, tenant: str, reason: str) -> None:
+        self._m.frontdoor_shed.labels(
+            engine=self._engine, tenant=tenant, reason=reason
+        ).inc()
+
+    def preempted(self, tenant: str) -> None:
+        self._m.frontdoor_preemptions.labels(
+            engine=self._engine, tenant=tenant
+        ).inc()
+
+    def resumed(self, tenant: str) -> None:
+        self._m.frontdoor_resumes.labels(
+            engine=self._engine, tenant=tenant
+        ).inc()
+
+    def completed(self, tenant: str, tokens: int) -> None:
+        self._m.frontdoor_completed_tokens.labels(
+            engine=self._engine, tenant=tenant
+        ).inc(tokens)
 
 
 class Readiness:

@@ -35,6 +35,17 @@ The toolkit's serving pieces finally compose (ROADMAP #2):
   the snapshot (suffix-only prefill; the TTFT delta is asserted in
   tests/test_frontdoor.py).
 
+* **Paged parks (ISSUE 16).**  With ``paged=True`` the engine attaches
+  per-config side pools from the paged-KV plane and preemption parks
+  only the pow2 bucket of aligned blocks the slot's frontier touched
+  (``_shared_park_blocks_fn``) instead of a full ``max_seq_len`` row —
+  preemption cost scales with blocks touched.  Decode is untouched:
+  the same fused dense round, the same jitaudit steady section, so
+  paged and dense front doors emit identical greedy token streams
+  (asserted in tests).  A drained engine materializes its paged parks
+  back into dense rows (``gather_parked_row``) so siblings under an
+  :class:`~tpuslo.models.router.SLORouter` can adopt them.
+
 Crash-safety: the engine registers with the PR 4 ``AgentRuntime``
 (:meth:`FrontDoorEngine.export_state` / ``restore_state``).  KV does
 not ride the JSON snapshot; in-flight requests are persisted as their
@@ -60,6 +71,13 @@ from tpuslo.models.batching import (
     _SHARED_INJECT_ROWS,
 )
 from tpuslo.models.llama import init_kv_cache
+from tpuslo.models.paged_kv import (
+    PagedBatchingEngine,
+    _shared_gather_row_fn,
+    _shared_park_blocks_fn,
+    _shared_resume_blocks_fn,
+    init_paged_pool,
+)
 from tpuslo.models.serve import (
     BOS,
     EOS,
@@ -145,6 +163,18 @@ class FrontDoorRequest:
         return req
 
 
+@dataclass(slots=True)
+class _PagedParked:
+    """Block-granular park record: which physical side-pool blocks
+    hold a preempted slot's KV (same indices in the target and draft
+    pools), plus the host frontier state a resume re-installs.
+    Slotted: parks/resumes happen inside the serving loop."""
+
+    phys: tuple[int, ...]
+    current: int
+    frontier: int
+
+
 class FrontDoorObserver:
     """No-op observer; the bench/agent bridge these to metrics."""
 
@@ -153,6 +183,8 @@ class FrontDoorObserver:
     def shed(self, tenant: str, reason: str) -> None: ...
 
     def preempted(self, tenant: str) -> None: ...
+
+    def resumed(self, tenant: str) -> None: ...
 
     def completed(self, tenant: str, tokens: int) -> None: ...
 
@@ -179,6 +211,10 @@ class FrontDoorEngine:
         burn_engine=None,
         observer: FrontDoorObserver | None = None,
         self_tracer=None,
+        paged: bool = False,
+        block_size: int = 32,
+        pool_blocks: int | None = None,
+        clock=None,
     ):
         if k < 1:
             raise ValueError("k must be >= 1")
@@ -245,11 +281,62 @@ class FrontDoorEngine:
         self._slots: list[FrontDoorRequest | None] = [None] * max_slots
         self._queue: list[FrontDoorRequest] = []
         self._next_id = 0
+        # Injectable monotonic clock: every request timestamp the
+        # engine writes comes from ONE callable, so a scale-out bench
+        # can drive N replicated engines on per-engine VIRTUAL clocks
+        # (discrete-event time) while production keeps perf_counter.
+        # The dispatch ledger stays on real perf_counter_ns — device
+        # wait is a physical measurement, never simulated.
+        self._clock = clock if clock is not None else time.perf_counter
         # Wall-clock anchor for burn-engine outcome timestamps: the hot
         # path never reads the wall clock (TPL120) — event time derives
-        # from perf_counter deltas against this init-time anchor.
+        # from monotonic deltas against this init-time anchor.
         self._epoch_ns = time.time_ns()
-        self._epoch_pc = time.perf_counter()
+        self._epoch_pc = self._clock()
+
+        # Paged slot mode (ISSUE 16): preemption parks only the pow2
+        # bucket of KV blocks the slot's frontier has touched into
+        # per-config side pools, instead of full (max_seq_len) rows.
+        # Decode itself stays on the dense fused round — identical
+        # token streams, identical steady sections; only the
+        # park/resume copies change cost class.
+        self.paged = bool(paged)
+        self.block_size = int(block_size)
+        self.paged_parks = 0
+        self.paged_resumes = 0
+        self.paged_fallback_parks = 0
+        if self.paged:
+            PagedBatchingEngine.validate_block_geometry(
+                target.cfg, self.block_size
+            )
+            PagedBatchingEngine.validate_block_geometry(
+                draft.cfg, self.block_size
+            )
+            if pool_blocks is None:
+                # Default: room to park two full houses of joint-depth
+                # rows, plus the reserved null block 0.
+                pool_blocks = 1 + 2 * max_slots * (
+                    self._joint_seq // self.block_size
+                )
+            self._pool_blocks = int(pool_blocks)
+            self._paged_pool_t = init_paged_pool(
+                target.cfg, self._pool_blocks, self.block_size, 1,
+                kv_dtype=target.kv_dtype,
+            )
+            self._paged_pool_d = init_paged_pool(
+                draft.cfg, self._pool_blocks, self.block_size, 1,
+                kv_dtype=draft.kv_dtype,
+            )
+            # One host free list indexes BOTH pools (a park takes the
+            # same physical ids in each); block 0 is the null block.
+            self._free_blocks: list[int] = list(
+                range(1, self._pool_blocks)
+            )
+        else:
+            self._pool_blocks = 0
+            self._paged_pool_t = None
+            self._paged_pool_d = None
+            self._free_blocks = []
 
         self.rounds = 0
         self.slot_rounds = 0
@@ -285,13 +372,23 @@ class FrontDoorEngine:
 
     def _now_ns(self) -> int:
         return self._epoch_ns + int(
-            (time.perf_counter() - self._epoch_pc) * 1e9
+            (self._clock() - self._epoch_pc) * 1e9
         )
 
     @property
     def acceptance_rate(self) -> float:
         proposed = self.slot_rounds * self.k
         return self.accepted_draft_tokens / proposed if proposed else 0.0
+
+    @property
+    def queue_depth(self) -> int:
+        """Waiting requests — the router's load signal (O(1) host)."""
+        return len(self._queue)
+
+    @property
+    def busy_slots(self) -> int:
+        """Occupied decode slots — the router's occupancy signal."""
+        return sum(1 for s in self._slots if s is not None)
 
     # ---- admission policy ---------------------------------------------
 
@@ -349,7 +446,7 @@ class FrontDoorEngine:
             max_new_tokens=max_new_tokens,
             stop_at_eos=stop_at_eos,
             prefix=prefix,
-            submitted_s=time.perf_counter(),
+            submitted_s=self._clock(),
         )
         self._next_id += 1
         if len(self._queue) >= self.max_queue:
@@ -438,12 +535,16 @@ class FrontDoorEngine:
         prefill; a fresh request ingests its prompt (prefix-cache
         aware) and emits its first token from the prefill logits.
         """
-        now_s = time.perf_counter()
+        now_s = self._clock()
         if req.parked is not None:
+            if isinstance(req.parked, _PagedParked):
+                self._resume_paged(slot, req)
+                return
             row_t, row_d, current, start = req.parked
             req.parked = None
             self._install(slot, req, row_t, row_d, current, start)
             self.resumes += 1
+            self._observer.resumed(req.tenant)
             return
 
         prefix_ids, ids = self._context_ids(req)
@@ -469,6 +570,7 @@ class FrontDoorEngine:
             # emitted prefix.  Greedy decode makes the continuation
             # identical to the uninterrupted stream.
             self.snapshot_resumes += 1
+            self._observer.resumed(req.tenant)
             context = ids + [int(t) for t in req.tokens[:-1]]
             current = int(req.tokens[-1])
             req.admitted_s = req.admitted_s or now_s
@@ -536,7 +638,7 @@ class FrontDoorEngine:
         """
         from tpuslo.models.serve import _bucket
 
-        now_s = time.perf_counter()
+        now_s = self._clock()
         all_ids: list[list[int]] = []
         for req in reqs:
             _prefix_ids, ids = self._context_ids(req)
@@ -615,10 +717,20 @@ class FrontDoorEngine:
     def _park(self, slot: int) -> None:
         """Preempt ``slot``: snapshot its KV rows + frontier and return
         the request to the queue (it resumes bit-identically via
-        re-injection when scheduled again)."""
+        re-injection when scheduled again).
+
+        Paged mode parks block-granular (cost ∝ blocks touched); a
+        full side pool falls back to the dense full-row snapshot,
+        counted in ``paged_fallback_parks`` — preemption must never
+        fail just because the park pool is contended.
+        """
         req = self._slots[slot]
         if req is None:
             return
+        if self.paged:
+            if self._park_paged(slot, req):
+                return
+            self.paged_fallback_parks += 1
         slot_idx = jnp.asarray(slot, jnp.int32)
         row_t = self._extract(self._cache_t, slot_idx)
         row_d = self._extract(self._cache_d, slot_idx)
@@ -631,6 +743,147 @@ class FrontDoorEngine:
         self._slots[slot] = None
         self._queue.append(req)
         self._observer.preempted(req.tenant)
+
+    def _park_paged(self, slot: int, req: FrontDoorRequest) -> bool:
+        """Block-granular preemption: copy only the pow2 bucket of
+        aligned blocks covering ``slot``'s frontier into the side
+        pools (one fused dispatch per cache), so a short stream's park
+        moves a few blocks, not ``max_seq_len`` positions.  Returns
+        False when the free list cannot cover the bucket (caller
+        falls back to the dense full-row park)."""
+        frontier = int(self._start[slot])
+        needed = -(-frontier // self.block_size)
+        bucket = 1
+        while bucket < needed:
+            bucket <<= 1
+        bucket = min(bucket, self._joint_seq // self.block_size)
+        if len(self._free_blocks) < bucket:
+            return False
+        phys = tuple(self._free_blocks[:bucket])
+        del self._free_blocks[:bucket]
+        phys_vec = jnp.asarray(phys, jnp.int32)
+        park_t = _shared_park_blocks_fn(
+            self.target.cfg, self.block_size, bucket
+        )
+        park_d = _shared_park_blocks_fn(
+            self.draft.cfg, self.block_size, bucket
+        )
+        self._paged_pool_t = park_t(
+            self._paged_pool_t, self._cache_t, slot, phys_vec
+        )
+        self._paged_pool_d = park_d(
+            self._paged_pool_d, self._cache_d, slot, phys_vec
+        )
+        req.parked = _PagedParked(
+            phys=phys,
+            current=int(self._current[slot]),
+            frontier=frontier,
+        )
+        req.preemptions += 1
+        self.preemptions += 1
+        self.paged_parks += 1
+        self._slots[slot] = None
+        self._queue.append(req)
+        self._observer.preempted(req.tenant)
+        return True
+
+    def _resume_paged(self, slot: int, req: FrontDoorRequest) -> None:
+        """Re-install a block-granular park into ``slot``: gather the
+        parked blocks back into the dense decode caches (one fused
+        dispatch per cache) and free them.  Positions past the parked
+        window keep stale-occupant garbage — the round kernels mask to
+        the frontier and overwrite it before it is ever attended, the
+        same discipline the dense slots already rely on."""
+        parked = req.parked
+        req.parked = None
+        bucket = len(parked.phys)
+        phys_vec = jnp.asarray(parked.phys, jnp.int32)
+        resume_t = _shared_resume_blocks_fn(
+            self.target.cfg, self.block_size, bucket
+        )
+        resume_d = _shared_resume_blocks_fn(
+            self.draft.cfg, self.block_size, bucket
+        )
+        self._cache_t = resume_t(
+            self._cache_t, self._paged_pool_t, slot, phys_vec,
+            parked.frontier,
+        )
+        self._cache_d = resume_d(
+            self._cache_d, self._paged_pool_d, slot, phys_vec,
+            parked.frontier,
+        )
+        self._free_blocks.extend(parked.phys)
+        self._tokens = self._tokens.at[slot].set(parked.current)
+        self._start[slot] = parked.frontier
+        self._current[slot] = parked.current
+        self._slots[slot] = req
+        self.resumes += 1
+        self.paged_resumes += 1
+        self._observer.resumed(req.tenant)
+
+    def _materialize_parked(self, req: FrontDoorRequest) -> None:
+        """Convert a block-granular park into the dense ``(row_t,
+        row_d, current, frontier)`` snapshot any replicated engine's
+        ``_admit`` installs directly — the cross-engine drain currency.
+        O(max_seq_len) gather per cache, but only on the rare
+        engine-death path; pad block ids hit null block 0 (zeros)."""
+        parked = req.parked
+        if not isinstance(parked, _PagedParked):
+            return
+        mb_t = self.target.cfg.max_seq_len // self.block_size
+        mb_d = self.draft.cfg.max_seq_len // self.block_size
+        pad_t = parked.phys + (0,) * (mb_t - len(parked.phys))
+        pad_d = parked.phys + (0,) * (mb_d - len(parked.phys))
+        gather_t = _shared_gather_row_fn(
+            self.target.cfg, self.block_size
+        )
+        gather_d = _shared_gather_row_fn(
+            self.draft.cfg, self.block_size
+        )
+        row_t = gather_t(
+            self._paged_pool_t,
+            jnp.asarray(pad_t, jnp.int32),
+            parked.frontier,
+        )
+        row_d = gather_d(
+            self._paged_pool_d,
+            jnp.asarray(pad_d, jnp.int32),
+            parked.frontier,
+        )
+        self._free_blocks.extend(parked.phys)
+        req.parked = (row_t, row_d, parked.current, parked.frontier)
+
+    def drain(self) -> list[FrontDoorRequest]:
+        """Kill-path evacuation: park every running slot, convert
+        block-granular parks to dense portable snapshots, and hand
+        back EVERY live request — in-flight work first (it was
+        admitted once already), then the waiting queue.  The engine
+        ends empty; nothing sheds, nothing is lost.  The router
+        re-homes the returned requests onto siblings via
+        :meth:`adopt`."""
+        for slot in range(self.max_slots):
+            if self._slots[slot] is not None:
+                self._park(slot)
+        evacuated = list(self._queue)
+        self._queue = []
+        for req in evacuated:
+            self._materialize_parked(req)
+        evacuated.sort(
+            key=lambda r: (r.parked is None, r.request_id)
+        )
+        return evacuated
+
+    def adopt(self, req: FrontDoorRequest) -> int:
+        """Take over a drained sibling's request under a FRESH local
+        id.  Replicated engines share configs, so a dense park
+        snapshot re-injects here bit-identically and an emitted-token
+        prefix teacher-forces to the same continuation.  Adoption
+        never sheds — rebalancing-under-failure must not lose
+        requests."""
+        req.request_id = self._next_id
+        self._next_id += 1
+        self._queue.append(req)
+        return req.request_id
 
     def _fill_slots(self) -> None:
         """Admit (and, under pressure, preempt) at a round boundary.
@@ -731,7 +984,7 @@ class FrontDoorEngine:
         self._cache_t, self._cache_d = cache_t, cache_d
         self._tokens = current
         self.rounds += 1
-        now_s = time.perf_counter()
+        now_s = self._clock()
         appended = 0
         with cycle.stage("retire") as retire:
             for slot, req in enumerate(self._slots):
@@ -873,6 +1126,15 @@ class FrontDoorEngine:
             "resumes": self.resumes,
             "snapshot_resumes": self.snapshot_resumes,
             "shed": dict(self.shed_by_reason),
+            "paged": {
+                "enabled": self.paged,
+                "block_size": self.block_size if self.paged else 0,
+                "pool_blocks": self._pool_blocks,
+                "free_blocks": len(self._free_blocks),
+                "parks": self.paged_parks,
+                "resumes": self.paged_resumes,
+                "fallback_parks": self.paged_fallback_parks,
+            },
             "dispatch_ledger": self.dispatch_ledger.totals(),
         }
 

@@ -342,6 +342,137 @@ def _shared_inject_block_fn(cfg, block_size: int):
     )
 
 
+# ---- block-granular park/resume (the paged front door, ISSUE 16) ----
+#
+# The dense front door parks a preempted slot by copying its FULL
+# (L, 1, max_seq_len, KV, HD) row pair out of the decode caches — a
+# preemption costs O(max_seq_len) KV traffic no matter how short the
+# stream is.  These kernels park only the blocks a slot has actually
+# touched: the frontier's block count rounds up to a power-of-two
+# bucket (one compiled shape per bucket, log2(max_blocks) variants per
+# config) and exactly that window moves between the dense cache and a
+# physical block pool, so preemption cost scales with blocks touched.
+# Zero-filled positions past the parked window are never attended —
+# the round kernels mask to the frontier, the same reason the dense
+# path tolerates stale-occupant garbage there.
+
+
+def park_slot_blocks(
+    pool: PyTree, cache: PyTree, slot, phys, cfg: LlamaConfig,
+    block_size: int, bucket: int,
+) -> PyTree:
+    """Copy the first ``bucket`` aligned blocks of ``slot``'s dense
+    cache row into the physical pool blocks listed in ``phys``
+    (``(bucket,)`` int32; pad entries point at null block 0, whose
+    garbage nothing reads).  The pool is donated, the live decode
+    cache is only read — it keeps serving the other slots."""
+    slot = jnp.asarray(slot, jnp.int32)
+    zero = jnp.asarray(0, jnp.int32)
+
+    def move(pool_leaf, cache_leaf):
+        L = cache_leaf.shape[0]
+        src = lax.dynamic_slice(
+            cache_leaf,
+            (zero, slot) + (zero,) * (cache_leaf.ndim - 2),
+            (L, 1, bucket * block_size) + cache_leaf.shape[3:],
+        )[:, 0]
+        blocks = src.reshape(
+            (L, bucket, block_size) + cache_leaf.shape[3:]
+        )
+        return pool_leaf.at[:, phys].set(blocks)
+
+    return {
+        **pool,
+        "k": jax.tree.map(move, pool["k"], cache["k"]),
+        "v": jax.tree.map(move, pool["v"], cache["v"]),
+    }
+
+
+def resume_slot_blocks(
+    cache: PyTree, pool: PyTree, slot, phys, frontier,
+    cfg: LlamaConfig, block_size: int, bucket: int,
+) -> PyTree:
+    """Re-inject a parked request's pool blocks into ``slot`` of the
+    dense decode cache (inverse of :func:`park_slot_blocks`).  The
+    cache is donated; the pool is only read — its free blocks keep
+    holding OTHER parked requests."""
+    slot = jnp.asarray(slot, jnp.int32)
+    frontier = jnp.asarray(frontier, jnp.int32)
+    zero = jnp.asarray(0, jnp.int32)
+
+    def move(cache_leaf, pool_leaf):
+        blocks = pool_leaf[:, phys]  # (L, bucket, BS, ...)
+        L = blocks.shape[0]
+        window = blocks.reshape(
+            (L, 1, bucket * block_size) + blocks.shape[3:]
+        )
+        return lax.dynamic_update_slice(
+            cache_leaf,
+            window,
+            (zero, slot) + (zero,) * (cache_leaf.ndim - 2),
+        )
+
+    return {
+        **cache,
+        "k": jax.tree.map(move, cache["k"], pool["k"]),
+        "v": jax.tree.map(move, cache["v"], pool["v"]),
+        "length": cache["length"].at[slot].set(frontier),
+    }
+
+
+def gather_parked_row(
+    pool: PyTree, phys, frontier, cfg: LlamaConfig, block_size: int,
+) -> PyTree:
+    """Reassemble a parked request's single-row dense cache from its
+    pool blocks (``phys``: ``(max_blocks,)`` int32, pad entries 0 →
+    null-block zeros).  The drain path: a dead engine's parked slots
+    leave as rows any sibling's ``_inject_row`` can install, paged or
+    dense."""
+
+    def take(pool_leaf):
+        blocks = pool_leaf[:, phys]  # (L, MB, BS, ...)
+        L = blocks.shape[0]
+        flat = blocks.reshape(
+            (L, blocks.shape[1] * block_size) + blocks.shape[3:]
+        )
+        return flat[:, None]
+
+    return {
+        "k": jax.tree.map(take, pool["k"]),
+        "v": jax.tree.map(take, pool["v"]),
+        "length": jnp.asarray(frontier, jnp.int32),
+    }
+
+
+@lru_cache(maxsize=64)
+def _shared_park_blocks_fn(cfg, block_size: int, bucket: int):
+    return jax.jit(
+        partial(
+            park_slot_blocks,
+            cfg=cfg, block_size=block_size, bucket=bucket,
+        ),
+        donate_argnums=(0,),
+    )
+
+
+@lru_cache(maxsize=64)
+def _shared_resume_blocks_fn(cfg, block_size: int, bucket: int):
+    return jax.jit(
+        partial(
+            resume_slot_blocks,
+            cfg=cfg, block_size=block_size, bucket=bucket,
+        ),
+        donate_argnums=(0,),
+    )
+
+
+@lru_cache(maxsize=32)
+def _shared_gather_row_fn(cfg, block_size: int):
+    return jax.jit(
+        partial(gather_parked_row, cfg=cfg, block_size=block_size)
+    )
+
+
 @dataclass
 class _SharedPrefix:
     """Registry entry for one shared prompt prefix's pool blocks.
