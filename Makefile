@@ -10,7 +10,8 @@ ARTIFACTS ?= artifacts
 	correlation-gate fault-smoke replay-smoke ebpf-smoke bench \
 	bench-smoke bench-columnar-smoke bench-columnar-full \
 	chaos-smoke chaos-demo chaos-telemetry-smoke \
-	chaos-telemetry-sweep crash-smoke crash-sweep obs-smoke \
+	chaos-telemetry-sweep crash-smoke crash-sweep \
+	live-chaos-smoke live-chaos-sweep obs-smoke \
 	burn-smoke burn-sweep fleet-smoke fleet-sweep \
 	federation-smoke federation-sweep \
 	remediation-smoke remediation-sweep \
@@ -179,6 +180,28 @@ chaos-telemetry-sweep:
 # marker (also slow, so tier-1 never runs it implicitly).
 crash-smoke:
 	$(PY) -m pytest tests/test_crash_runtime.py -q -m chaos
+
+# Live deployment-plane chaos (ISSUE 17): the fast 2-process lane —
+# a real agent shipping over a real livenet socket to a real cluster
+# fleetagg, agent killed -9 mid-window, supervised restart resuming
+# from the seq journal with zero lost/dup incidents and measured
+# cadence coarsening.  Same chaos pytest marker (slow, never in
+# tier-1 implicitly).
+live-chaos-smoke:
+	$(PY) -m pytest tests/test_live_procs.py -q -m chaos
+
+# Full live deployment-plane release gate: the whole supervised tree
+# (agent -> cluster -> region sockets + the front door), kill -9 of
+# every role mid-window plus one socket partition; zero lost/dup
+# incidents, warm resume, cadence coarsening at pressure >= 1, and a
+# live demote_tenant flipping the admission order — minutes, not in
+# the default m5-gate chain.
+live-chaos-sweep:
+	mkdir -p $(ARTIFACTS)/live-chaos
+	$(PY) -m tpuslo m5gate --live-chaos-sweep \
+		--live-chaos-root $(ARTIFACTS)/live-chaos \
+		--summary-json $(ARTIFACTS)/live-chaos/sweep.json \
+		--summary-md $(ARTIFACTS)/live-chaos/sweep.md
 
 # Self-observability smoke: tracer span trees + tail sampling + OTLP
 # trace payloads, the metrics HTTP server (/metrics //healthz //readyz),
@@ -383,7 +406,8 @@ m5-gate: lint racecheck-smoke jitcheck-smoke burn-smoke burn-sweep \
 		remediation-smoke remediation-sweep \
 		frontdoor-smoke frontdoor-bench \
 		router-smoke router-bench \
-		deviceplane-smoke deviceplane-sweep
+		deviceplane-smoke deviceplane-sweep \
+		crash-smoke live-chaos-smoke
 	$(PY) -m tpuslo m5gate --candidate-root $(ARTIFACTS)/m5 \
 		--scenarios "$(shell echo $(M5_SCENARIOS) | tr ' ' ',')" \
 		--summary-json $(ARTIFACTS)/m5/gate.json \

@@ -25,8 +25,9 @@ from tpuslo.cli import (
 
 class TestDispatcher:
     def test_all_binaries_registered(self):
-        # 11 reference parity + slicecorr + train + icibench + fleetagg
-        assert len(BINARIES) == 15
+        # 11 reference parity + slicecorr + train + icibench +
+        # fleetagg + frontdoor
+        assert len(BINARIES) == 16
 
     def test_unknown_binary_exit_2(self):
         assert dispatch(["warpdrive"]) == 2

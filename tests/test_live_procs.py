@@ -1,0 +1,223 @@
+"""Live deployment-plane chaos: the 2-process smoke lane (ISSUE 17).
+
+One seeded kill -9 / supervised-restart cycle through the real socket
+hop — a real ``agent --fleet-upstream tcp://…`` shipping into a real
+``fleetagg --listen`` — from the ``tpuslo.chaos.procs`` harness.  The
+full matrix (every kill target + the socket partition + the front
+door's remediation flip) runs via ``m5gate --live-chaos-sweep`` /
+``make live-chaos-sweep``.
+
+The module-level tests are marked ``chaos`` (run via ``make
+live-chaos-smoke``, an m5-gate prerequisite next to ``crash-smoke``)
+and ``slow`` (real subprocesses, real sockets, wall-clock windows —
+never in tier-1).  The helper classes in ``TestLaneHelpers`` need no
+subprocess and stay tier-1.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+import pytest
+
+from tpuslo.chaos.procs import (
+    BlackholeProxy,
+    LiveRunResult,
+    LiveSweepReport,
+    _frames_rejected,
+    _member_keys,
+    _parse_cadence,
+    run_live_smoke,
+)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_agent_kill_resumes_through_socket(tmp_path):
+    result = run_live_smoke(tmp_path / "live", seed=1337)
+    assert result.passed, result.failures
+    # The supervisor restarted the SIGKILLed agent and the restart
+    # left grep-able evidence (a second upstream banner, a journal
+    # seq strictly past the pre-kill cursor).
+    assert result.restarts >= 1
+    assert "agent" in result.restored_evidence
+    # Content-based audits: the cluster ledger has incidents, none of
+    # them duplicated across the kill, and redelivery never tore a
+    # frame.
+    assert result.cluster_incidents >= 1
+    assert result.duplicate_incident_ids == 0
+    assert result.frames_rejected == 0
+    # The loop this PR closes: the cluster's acks carried pressure
+    # >= 1 and the agent answered by merging shipments.
+    assert result.cadence["max_level"] >= 1
+    assert result.cadence["flushes"] < result.cadence["cycles"]
+
+
+class TestLaneHelpers:
+    """No-subprocess units of the lane's audit plumbing (tier-1)."""
+
+    def test_parse_cadence_aggregates_incarnations(self):
+        # One line per incarnation in the append-mode stderr; the
+        # evidence is the sum (and the max level ANY incarnation
+        # observed) — a short post-restart window at level 0 must not
+        # erase the first window's coarsening.
+        text = (
+            "agent: fleet cadence: cycles=9 flushes=3 coarsened=6 "
+            "max_level=2\n"
+            "agent: fleet cadence: cycles=4 flushes=4 coarsened=0 "
+            "max_level=0\n"
+        )
+        assert _parse_cadence(text) == {
+            "cycles": 13,
+            "flushes": 7,
+            "coarsened": 6,
+            "max_level": 2,
+        }
+        assert _parse_cadence("no cadence here") == {}
+
+    def test_frames_rejected_sums_summaries(self):
+        text = (
+            "fleetagg: live cluster clu-live: 40 frames (2 rejected), "
+            "5 incidents written (0 suppressed as dups)\n"
+            "fleetagg: live cluster clu-live: 9 frames (1 rejected), "
+            "1 incidents written (0 suppressed as dups)\n"
+        )
+        assert _frames_rejected(text) == 3
+        assert _frames_rejected("") == 0
+
+    def test_member_keys_fold_namespace_domain_node_pod(self):
+        incidents = [
+            {
+                "namespace": "tenant-a",
+                "domain": "tpu_hbm",
+                "members": [
+                    {"node": "n0", "pod": "p0"},
+                    {"node": "n0", "pod": "p0"},  # dup folds
+                    {"node": "n1", "pod": "p1"},
+                ],
+            },
+            {"namespace": "tenant-b", "domain": "dns", "members": []},
+        ]
+        assert _member_keys(incidents) == {
+            ("tenant-a", "tpu_hbm", "n0", "p0"),
+            ("tenant-a", "tpu_hbm", "n1", "p1"),
+        }
+
+    def test_sweep_report_verdict(self):
+        ok = LiveRunResult(target="agent", seed=1)
+        bad = LiveRunResult(
+            target="region", seed=2, failures=["lost 3 members"]
+        )
+        report = LiveSweepReport(runs=[ok, bad])
+        assert not report.passed
+        assert report.failures == ["region (seed 2): lost 3 members"]
+        assert LiveSweepReport(runs=[ok]).passed
+        # An empty sweep never passes: silence is not evidence.
+        assert not LiveSweepReport().passed
+
+
+class TestBlackholeProxy:
+    def _echo_server(self):
+        server = socket.socket()
+        server.bind(("127.0.0.1", 0))
+        server.listen(4)
+
+        def serve():
+            while True:
+                try:
+                    conn, _ = server.accept()
+                except OSError:
+                    return
+                try:
+                    while True:
+                        chunk = conn.recv(65536)
+                        if not chunk:
+                            break
+                        conn.sendall(chunk)
+                except OSError:
+                    pass
+                finally:
+                    conn.close()
+
+        threading.Thread(target=serve, daemon=True).start()
+        return server, server.getsockname()
+
+    def test_forwards_both_ways_when_healthy(self):
+        server, addr = self._echo_server()
+        proxy = BlackholeProxy(addr)
+        try:
+            client = socket.create_connection(
+                (proxy.host, proxy.port), timeout=5.0
+            )
+            client.sendall(b"ping")
+            assert client.recv(65536) == b"ping"
+            client.close()
+            # The echo proves both directions delivered, but each
+            # pump thread counts AFTER its sendall — on one CPU the
+            # main thread's recv can wake before the back pump gets
+            # the GIL again, so poll instead of asserting instantly.
+            deadline = time.monotonic() + 5.0
+            while (
+                proxy.forwarded_bytes < 8
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.01)
+            assert proxy.forwarded_bytes >= 8  # 4 up + 4 back
+            assert proxy.dropped_bytes == 0
+        finally:
+            proxy.close()
+            server.close()
+
+    def test_partition_tears_live_conns_and_drops_new_bytes(self):
+        server, addr = self._echo_server()
+        proxy = BlackholeProxy(addr)
+        try:
+            live = socket.create_connection(
+                (proxy.host, proxy.port), timeout=5.0
+            )
+            live.sendall(b"pre")
+            assert live.recv(65536) == b"pre"
+            proxy.partition()
+            # The in-flight connection is torn down (a real partition
+            # kills established TCP) …
+            live.settimeout(5.0)
+            assert live.recv(65536) == b""
+            live.close()
+            # … and a new connection is accepted but black-holed:
+            # bytes are read and dropped, never forwarded, never
+            # answered.
+            holed = socket.create_connection(
+                (proxy.host, proxy.port), timeout=5.0
+            )
+            holed.sendall(b"into the void")
+            holed.settimeout(1.0)
+            got_reply = True
+            try:
+                got_reply = holed.recv(65536) != b""
+            except socket.timeout:
+                got_reply = False
+            assert not got_reply
+            holed.close()
+            deadline_bytes = len(b"into the void")
+            assert proxy.dropped_bytes >= deadline_bytes
+        finally:
+            proxy.close()
+            server.close()
+
+    def test_heal_restores_forwarding_for_new_conns(self):
+        server, addr = self._echo_server()
+        proxy = BlackholeProxy(addr)
+        try:
+            proxy.partition()
+            proxy.heal()
+            client = socket.create_connection(
+                (proxy.host, proxy.port), timeout=5.0
+            )
+            client.sendall(b"back")
+            assert client.recv(65536) == b"back"
+            client.close()
+        finally:
+            proxy.close()
+            server.close()

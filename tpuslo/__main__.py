@@ -16,6 +16,7 @@ BINARIES = {
     "correlationeval": "tpuslo.cli.correlationeval",
     "m5gate": "tpuslo.cli.m5gate",
     "fleetagg": "tpuslo.cli.fleetagg",
+    "frontdoor": "tpuslo.cli.frontdoor",
     "sloctl": "tpuslo.cli.sloctl",
     "loadgen": "tpuslo.cli.loadgen",
     "schemavalidate": "tpuslo.cli.schemavalidate",

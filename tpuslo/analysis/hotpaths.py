@@ -75,6 +75,13 @@ HOT_FUNCTIONS: tuple[tuple[str, str], ...] = (
     ("tpuslo/federation/backpressure.py", "PressureController.observe"),
     ("tpuslo/federation/cluster.py", "ClusterAggregator.ingest"),
     ("tpuslo/federation/region.py", "RegionAggregator.ingest"),
+    # Live deployment plane (ISSUE 17): the socket listener's frame
+    # decoder runs per recv() chunk on every live hop; a per-frame
+    # print or json.dumps here would stall the accept loop under the
+    # same load the chaos lane partitions.  encode_frame is the
+    # sender-side slow path (one json.dumps per shipment flush, not
+    # per event) and is deliberately NOT registered.
+    ("tpuslo/livenet/framing.py", "FrameDecoder.feed"),
     # Remediation evaluate path (ISSUE 11): the decision + verify fold
     # runs once per attributed incident / per in-flight action per
     # evaluation window, inside the agent cycle the tracer budgets —

@@ -1,0 +1,1043 @@
+"""Multi-process chaos lane for the live deployment plane.
+
+The crash lane (PR 4) killed one agent against its own state dir;
+this lane stands up the **whole tree as real OS processes over real
+sockets** — node agent → cluster aggregator → region aggregator, plus
+the serving front door with its co-located remediation agent — under
+the :class:`~tpuslo.livenet.ProcessSupervisor`, then breaks it on
+purpose:
+
+* **kill -9** any process mid-window (seeded target + jitter) and let
+  the supervisor restart it with the same argv; spools, seq journals,
+  and runtime snapshots must make the restart warm.
+* **partition** the cluster → region socket behind a
+  :class:`BlackholeProxy` that accepts and silently drops bytes — the
+  sender must spool, reconnect, and replay without the region ever
+  seeing a torn frame.
+
+The audits are content-based so they survive counter resets across
+restarts:
+
+1. **Zero duplicate incidents** — incident ids are unique in both the
+   cluster's and the region's incident ledgers.
+2. **Zero lost incidents** — every (namespace, domain, node, pod)
+   member the cluster's own rollup attributed also appears in a
+   federated incident at the region: what the cluster saw, it shipped,
+   and the region kept.
+3. **Measured cadence coarsening** — the agent's final cadence line
+   shows pressure level >= 1 was observed and consecutive cycles
+   merged (flushes < cycles) under the cluster's small
+   ``--pressure-capacity``.
+4. **Warm resume** — the restarted incarnation's stderr carries the
+   runtime's "snapshot restored" evidence (aggregators, front door)
+   or a second upstream banner with a continued seq journal (agent).
+5. **Remediation end-to-end** — the front door's status ledger shows
+   a live ``demote_tenant`` flipping the admission order, surviving
+   the kill when the front door is the target.
+6. **Clean framing** — no listener ever rejected a frame.
+
+``m5gate --live-chaos-sweep`` runs :func:`run_live_sweep` (every kill
+target plus one partition run) and renders the report to
+docs/evidence; ``make live-chaos-smoke`` runs the 2-process
+:func:`run_live_smoke` as the fast pre-gate lane.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import re
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from tpuslo.livenet import ProcessSpec, ProcessSupervisor
+from tpuslo.runtime.supervisor import SupervisorConfig
+
+KILL_TARGETS = ("agent", "cluster", "region", "frontdoor")
+_POLL_S = 0.2
+
+_CADENCE_RE = re.compile(
+    r"fleet cadence: cycles=(\d+) flushes=(\d+) "
+    r"coarsened=(\d+) max_level=(\d+)"
+)
+_REJECTED_RE = re.compile(r"\((\d+) rejected\)")
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    """A port the OS just proved free; the lane hands it to a child
+    and restarts rebind the same address."""
+    with socket.socket() as sock:
+        sock.bind((host, 0))
+        return sock.getsockname()[1]
+
+
+class BlackholeProxy:
+    """TCP forwarder that can black-hole its link on command.
+
+    Healthy: accept, connect upstream, pump bytes both ways.
+    Partitioned: existing connections are torn down (the realistic
+    half — a partition kills in-flight TCP) and new connections are
+    accepted but every byte is read and dropped, never forwarded and
+    never acked — the black-hole half that forces the sender into its
+    spool.  Healing only affects NEW connections, so the upstream
+    listener never sees a byte stream with a hole in it (framing
+    stays intact; rejected-frame audits stay at zero).
+    """
+
+    def __init__(self, target: tuple[str, int], host: str = "127.0.0.1"):
+        self.target = target
+        self.dropped_bytes = 0
+        self.forwarded_bytes = 0
+        self._partitioned = False
+        self._closed = False
+        self._lock = threading.Lock()
+        self._conns: list[socket.socket] = []
+        self._listener = socket.socket()
+        self._listener.setsockopt(
+            socket.SOL_SOCKET, socket.SO_REUSEADDR, 1
+        )
+        self._listener.bind((host, 0))
+        self._listener.listen(16)
+        self.host, self.port = self._listener.getsockname()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True
+        )
+        self._accept_thread.start()
+
+    @property
+    def address(self) -> str:
+        return f"tcp://{self.host}:{self.port}"
+
+    def partition(self) -> None:
+        with self._lock:
+            self._partitioned = True
+            conns, self._conns = self._conns, []
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def heal(self) -> None:
+        with self._lock:
+            self._partitioned = False
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                client, _ = self._listener.accept()
+            except OSError:
+                return
+            client.settimeout(0.5)
+            upstream = None
+            if not self._partitioned:
+                try:
+                    upstream = socket.create_connection(
+                        self.target, timeout=2.0
+                    )
+                    upstream.settimeout(0.5)
+                except OSError:
+                    upstream = None
+            with self._lock:
+                self._conns.append(client)
+                if upstream is not None:
+                    self._conns.append(upstream)
+            threading.Thread(
+                target=self._pump, args=(client, upstream), daemon=True
+            ).start()
+            if upstream is not None:
+                threading.Thread(
+                    target=self._pump, args=(upstream, client),
+                    daemon=True,
+                ).start()
+
+    def _pump(self, src: socket.socket, dst: socket.socket | None):
+        while not self._closed:
+            try:
+                data = src.recv(65536)
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            if not data:
+                break
+            if self._partitioned or dst is None:
+                self.dropped_bytes += len(data)
+                continue
+            try:
+                dst.sendall(data)
+                self.forwarded_bytes += len(data)
+            except OSError:
+                break
+        for sock in (src, dst):
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self.partition()  # tears down any live pumps
+        with self._lock:
+            self._partitioned = False
+
+
+@dataclass
+class LiveRunResult:
+    """One chaos run's audited outcome (one kill or one partition)."""
+
+    target: str
+    seed: int
+    restarts: int = 0
+    restored_evidence: list[str] = field(default_factory=list)
+    cadence: dict[str, int] = field(default_factory=dict)
+    cluster_incidents: int = 0
+    region_incidents: int = 0
+    duplicate_incident_ids: int = 0
+    lost_members: int = 0
+    frames_rejected: int = 0
+    remediation_applied: bool = False
+    order_flipped: bool = False
+    dropped_bytes: int = 0
+    failures: list[str] = field(default_factory=list)
+
+    @property
+    def passed(self) -> bool:
+        return not self.failures
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "target": self.target,
+            "seed": self.seed,
+            "restarts": self.restarts,
+            "restored_evidence": list(self.restored_evidence),
+            "cadence": dict(self.cadence),
+            "cluster_incidents": self.cluster_incidents,
+            "region_incidents": self.region_incidents,
+            "duplicate_incident_ids": self.duplicate_incident_ids,
+            "lost_members": self.lost_members,
+            "frames_rejected": self.frames_rejected,
+            "remediation_applied": self.remediation_applied,
+            "order_flipped": self.order_flipped,
+            "dropped_bytes": self.dropped_bytes,
+            "passed": self.passed,
+            "failures": list(self.failures),
+        }
+
+
+@dataclass
+class LiveSweepReport:
+    """Aggregate verdict across kill targets + the partition run."""
+
+    runs: list[LiveRunResult] = field(default_factory=list)
+
+    @property
+    def passed(self) -> bool:
+        return bool(self.runs) and all(r.passed for r in self.runs)
+
+    @property
+    def failures(self) -> list[str]:
+        out = []
+        for run in self.runs:
+            for failure in run.failures:
+                out.append(f"{run.target} (seed {run.seed}): {failure}")
+        return out
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "passed": self.passed,
+            "failures": self.failures,
+            "runs": [r.to_dict() for r in self.runs],
+        }
+
+
+# ---- file evidence helpers ---------------------------------------------
+
+
+def _read_json_lines(path: str) -> list[dict[str, Any]]:
+    rows: list[dict[str, Any]] = []
+    try:
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(row, dict):
+                    rows.append(row)
+    except OSError:
+        pass
+    return rows
+
+
+def _last_status(path: str) -> dict[str, Any]:
+    rows = _read_json_lines(path)
+    return rows[-1] if rows else {}
+
+
+def _read_text(path: str) -> str:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return fh.read()
+    except OSError:
+        return ""
+
+
+def _member_keys(incidents: list[dict[str, Any]]) -> set[tuple]:
+    keys: set[tuple] = set()
+    for incident in incidents:
+        namespace = incident.get("namespace", "")
+        domain = incident.get("domain", "")
+        for member in incident.get("members") or []:
+            keys.add(
+                (
+                    namespace,
+                    domain,
+                    member.get("node", ""),
+                    member.get("pod", ""),
+                )
+            )
+    return keys
+
+
+def _agent_banner_count(lane: "_LiveLane") -> int:
+    """Upstream banners in the agent's (append-mode, cross-incarnation)
+    stderr — one per incarnation that reached its shipping loop.  The
+    restart waits key on this: a restarted agent that is still deep in
+    interpreter/JAX startup has neither installed its drain handler
+    nor shipped anything, and SIGTERMing it there would lose the
+    drain-time cadence evidence the audit needs."""
+    return _read_text(lane.paths["agent_stderr"]).count(
+        "agent: fleet upstream ->"
+    )
+
+
+def _agent_journal_seq(lane: "_LiveLane") -> int:
+    try:
+        with open(lane.paths["agent_journal"], encoding="utf-8") as fh:
+            cursors = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return -1
+    seq = (cursors.get("nodes") or {}).get("node-live", -1)
+    return seq if isinstance(seq, int) else -1
+
+
+def _parse_cadence(stderr_text: str) -> dict[str, int]:
+    """Aggregate cadence evidence across ALL incarnations.
+
+    The agent prints one ``fleet cadence:`` line per drain and its
+    stderr file appends across restarts, so the lane's evidence is the
+    sum of every incarnation's cycles/flushes (and the max level any
+    of them observed) — a restarted agent whose short final window
+    never saw pressure must not erase the first window's coarsening.
+    """
+    matches = _CADENCE_RE.findall(stderr_text)
+    if not matches:
+        return {}
+    out = {"cycles": 0, "flushes": 0, "coarsened": 0, "max_level": 0}
+    for cycles, flushes, coarsened, max_level in matches:
+        out["cycles"] += int(cycles)
+        out["flushes"] += int(flushes)
+        out["coarsened"] += int(coarsened)
+        out["max_level"] = max(out["max_level"], int(max_level))
+    return out
+
+
+def _frames_rejected(stdout_text: str) -> int:
+    return sum(int(n) for n in _REJECTED_RE.findall(stdout_text))
+
+
+# ---- the lane itself ---------------------------------------------------
+
+
+class _LiveLane:
+    """One topology instance: specs, waits, seeded faults, audits."""
+
+    def __init__(
+        self,
+        workdir: str,
+        seed: int,
+        include_frontdoor: bool,
+        region_via: str | None = None,
+        log: Callable[[str], None] | None = None,
+    ):
+        self.workdir = os.fspath(workdir)
+        self.rng = random.Random(seed)
+        self.log = log or (lambda msg: None)
+        # Stale ledgers from a previous sweep would satisfy every wait
+        # instantly and poison the content audits.
+        if os.path.isdir(self.workdir):
+            shutil.rmtree(self.workdir)
+        for sub in ("agent", "cluster", "region", "frontdoor"):
+            os.makedirs(os.path.join(self.workdir, sub), exist_ok=True)
+        self.env = dict(os.environ, JAX_PLATFORMS="cpu")
+        self.cluster_port = free_port()
+        self.region_port = free_port()
+        self.include_frontdoor = include_frontdoor
+        self.supervisor = ProcessSupervisor(
+            config=SupervisorConfig(
+                heartbeat_timeout_s=60.0,
+                restart_backoff_base_s=0.5,
+                flap_restarts=5,
+            ),
+            log=self.log,
+        )
+        region_upstream = region_via or (
+            f"tcp://127.0.0.1:{self.region_port}"
+        )
+        self.paths = {
+            "agent_stderr": self._p("agent", "agent.stderr.log"),
+            "agent_journal": self._p("agent", "spool", "fleet-seq.json"),
+            "cluster_status": self._p("cluster", "status.jsonl"),
+            "cluster_incidents": self._p("cluster", "incidents.jsonl"),
+            "cluster_stderr": self._p("cluster", "cluster.stderr.log"),
+            "cluster_stdout": self._p("cluster", "cluster.stdout.log"),
+            "region_status": self._p("region", "status.jsonl"),
+            "region_incidents": self._p("region", "incidents.jsonl"),
+            "region_stderr": self._p("region", "region.stderr.log"),
+            "region_stdout": self._p("region", "region.stdout.log"),
+            "frontdoor_status": self._p("frontdoor", "status.jsonl"),
+            "frontdoor_stderr": self._p(
+                "frontdoor", "frontdoor.stderr.log"
+            ),
+        }
+        self.specs = {
+            "region": ProcessSpec(
+                name="region",
+                cmd=[
+                    sys.executable, "-m", "tpuslo", "fleetagg",
+                    "--region",
+                    "--listen", f"127.0.0.1:{self.region_port}",
+                    "--region-id", "region-live",
+                    "--rollup-gap-ns", "1000000000",
+                    "--tick-s", "0.3",
+                    "--snapshot-interval-s", "0.2",
+                    "--incidents-out", self.paths["region_incidents"],
+                    "--state-out", self._p("region", "state.json"),
+                    "--status-out", self.paths["region_status"],
+                ],
+                env=self.env,
+                heartbeat_path=self.paths["region_status"],
+                stderr_path=self.paths["region_stderr"],
+                stdout_path=self.paths["region_stdout"],
+            ),
+            "cluster": ProcessSpec(
+                name="cluster",
+                cmd=[
+                    sys.executable, "-m", "tpuslo", "fleetagg",
+                    "--listen", f"127.0.0.1:{self.cluster_port}",
+                    "--cluster-id", "clu-live",
+                    "--min-confidence", "0.0",
+                    "--rollup-gap-ns", "1000000000",
+                    "--tick-s", "0.3",
+                    "--snapshot-interval-s", "0.2",
+                    "--pressure-capacity", "50",
+                    "--region-upstream", region_upstream,
+                    "--spool-dir", self._p("cluster", "spool"),
+                    "--incidents-out", self.paths["cluster_incidents"],
+                    "--state-out", self._p("cluster", "state.json"),
+                    "--status-out", self.paths["cluster_status"],
+                ],
+                env=self.env,
+                heartbeat_path=self.paths["cluster_status"],
+                stderr_path=self.paths["cluster_stderr"],
+                stdout_path=self.paths["cluster_stdout"],
+            ),
+            "agent": ProcessSpec(
+                name="agent",
+                cmd=[
+                    sys.executable, "-m", "tpuslo", "agent",
+                    "--columnar",
+                    "--scenario", "hbm_pressure",
+                    "--columnar-batch", "16",
+                    "--count", "0",
+                    "--interval-s", "0.05",
+                    "--node", "node-live",
+                    "--metrics-port", "0",
+                    "--stats-interval-cycles", "0",
+                    "--fleet-upstream",
+                    f"tcp://127.0.0.1:{self.cluster_port}",
+                    "--spool-dir", self._p("agent", "spool"),
+                ],
+                env=self.env,
+                stderr_path=self.paths["agent_stderr"],
+            ),
+            "frontdoor": ProcessSpec(
+                name="frontdoor",
+                cmd=[
+                    sys.executable, "-m", "tpuslo", "frontdoor",
+                    "--interval-s", "0.05",
+                    "--max-new-tokens", "2",
+                    "--snapshot-interval-s", "0.2",
+                    "--status-out", self.paths["frontdoor_status"],
+                    "--state-dir", self._p("frontdoor", "state"),
+                ],
+                env=self.env,
+                heartbeat_path=self.paths["frontdoor_status"],
+                stderr_path=self.paths["frontdoor_stderr"],
+            ),
+        }
+
+    def _p(self, *parts: str) -> str:
+        return os.path.join(self.workdir, *parts)
+
+    # ---- lifecycle ----------------------------------------------------
+
+    def start(self, roles: tuple[str, ...]) -> None:
+        self.roles = roles
+        for role in roles:
+            self.supervisor.start(self.specs[role])
+        self.log(f"live-chaos: started {', '.join(roles)}")
+
+    def wait_for(
+        self, cond: Callable[[], bool], what: str, timeout_s: float
+    ) -> bool:
+        """Poll ``cond`` while keeping supervision live (restarts must
+        happen DURING waits, not after them)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            self.supervisor.evaluate()
+            if cond():
+                return True
+            time.sleep(_POLL_S)
+        return False
+
+    def kill(self, target: str) -> float:
+        """Seeded kill -9 mid-window; returns the kill timestamp."""
+        time.sleep(self.rng.uniform(0.0, 0.4))
+        proc = self.supervisor.process(target)
+        kill_ts = time.time()
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(signal.SIGKILL)
+            try:
+                proc.wait(timeout=30)
+            except (OSError, subprocess.TimeoutExpired):
+                pass  # teardown best effort; audits read the files
+        self.log(f"live-chaos: kill -9 {target}")
+        return kill_ts
+
+    def stop(self) -> None:
+        """Drain in tree order so every hop's last shipment lands:
+        agent first (final pending flush), then cluster (final window
+        close + envelope + spool replay), then region (final pump),
+        front door whenever."""
+        for role in ("agent", "frontdoor", "cluster", "region"):
+            if role not in getattr(self, "roles", ()):
+                continue
+            proc = self.supervisor.process(role)
+            if proc is None or proc.poll() is not None:
+                continue
+            try:
+                proc.send_signal(signal.SIGTERM)
+                proc.wait(timeout=45)
+            except (OSError, subprocess.TimeoutExpired):
+                try:
+                    proc.kill()
+                    proc.wait(timeout=10)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass  # stop_all below escalates once more
+            if role in ("agent", "cluster"):
+                # Let the next hop ingest the drain's final frames
+                # before it, too, is told to drain.
+                time.sleep(1.0)
+        self.supervisor.stop_all(wait_s=5.0)
+
+    # ---- status shorthands --------------------------------------------
+
+    def cluster_status(self) -> dict[str, Any]:
+        return _last_status(self.paths["cluster_status"])
+
+    def region_status(self) -> dict[str, Any]:
+        return _last_status(self.paths["region_status"])
+
+    def frontdoor_rows(self) -> list[dict[str, Any]]:
+        return _read_json_lines(self.paths["frontdoor_status"])
+
+
+def _audit_tree(lane: _LiveLane, result: LiveRunResult) -> None:
+    """The content audits shared by every run shape."""
+    cluster_incidents = _read_json_lines(lane.paths["cluster_incidents"])
+    region_incidents = _read_json_lines(lane.paths["region_incidents"])
+    result.cluster_incidents = len(cluster_incidents)
+    result.region_incidents = len(region_incidents)
+
+    for name, incidents in (
+        ("cluster", cluster_incidents),
+        ("region", region_incidents),
+    ):
+        ids = [i.get("incident_id", "") for i in incidents]
+        dups = len(ids) - len(set(ids))
+        if dups:
+            result.duplicate_incident_ids += dups
+            result.failures.append(
+                f"{dups} duplicate incident id(s) in the {name} ledger"
+            )
+
+    lost = _member_keys(cluster_incidents) - _member_keys(
+        region_incidents
+    )
+    result.lost_members = len(lost)
+    if lost:
+        result.failures.append(
+            f"{len(lost)} attributed member(s) never reached the "
+            f"region: {sorted(lost)[:3]}"
+        )
+    if not cluster_incidents:
+        result.failures.append("cluster attributed no incidents")
+    if not region_incidents:
+        result.failures.append("region federated no incidents")
+
+    result.cadence = _parse_cadence(
+        _read_text(lane.paths["agent_stderr"])
+    )
+    if not result.cadence:
+        result.failures.append("agent printed no cadence line")
+    else:
+        if result.cadence["max_level"] < 1:
+            result.failures.append(
+                "agent never observed upstream pressure >= 1"
+            )
+        if result.cadence["flushes"] >= result.cadence["cycles"]:
+            result.failures.append(
+                "cadence never coarsened (flushes == cycles)"
+            )
+
+    result.frames_rejected = _frames_rejected(
+        _read_text(lane.paths["cluster_stdout"])
+    ) + _frames_rejected(_read_text(lane.paths["region_stdout"]))
+    if result.frames_rejected:
+        result.failures.append(
+            f"{result.frames_rejected} frame(s) rejected by a live "
+            "listener"
+        )
+    if lane.supervisor.flap_sheds_total:
+        result.failures.append("a process was flap-shed mid-run")
+
+
+def _audit_frontdoor(
+    lane: _LiveLane, result: LiveRunResult, killed: bool, kill_ts: float
+) -> None:
+    rows = lane.frontdoor_rows()
+    result.remediation_applied = any(
+        r.get("remediation_applied") for r in rows
+    )
+    result.order_flipped = any(r.get("order_flipped") for r in rows)
+    if not result.remediation_applied:
+        result.failures.append(
+            "front door never applied a live remediation"
+        )
+    if not result.order_flipped:
+        result.failures.append(
+            "demote_tenant never flipped the live admission order"
+        )
+    if killed:
+        post = [r for r in rows if r.get("ts", 0) > kill_ts]
+        if not any(r.get("restored") == "restored" for r in post):
+            result.failures.append(
+                "restarted front door did not resume from its snapshot"
+            )
+        if not any(
+            r.get("order_flipped") and r.get("restored") == "restored"
+            for r in post
+        ):
+            result.failures.append(
+                "the demotion did not survive the front door restart"
+            )
+        stderr = _read_text(lane.paths["frontdoor_stderr"])
+        if "runtime: snapshot restored" in stderr:
+            result.restored_evidence.append("frontdoor")
+        else:
+            result.failures.append(
+                "front door stderr carries no snapshot-restored line"
+            )
+
+
+def _audit_restart_evidence(
+    lane: _LiveLane, result: LiveRunResult, target: str
+) -> None:
+    if target in ("cluster", "region"):
+        stderr = _read_text(lane.paths[f"{target}_stderr"])
+        if "runtime: snapshot restored" in stderr:
+            result.restored_evidence.append(target)
+        else:
+            result.failures.append(
+                f"restarted {target} stderr carries no "
+                "snapshot-restored line"
+            )
+    elif target == "agent":
+        if _agent_banner_count(lane) >= 2:
+            result.restored_evidence.append("agent")
+        else:
+            result.failures.append(
+                "agent stderr shows no restarted upstream banner"
+            )
+        if _agent_journal_seq(lane) < 1:
+            result.failures.append(
+                "agent seq journal did not advance across the restart"
+            )
+
+
+def _await_pressured_shipping(lane: "_LiveLane", since_ts: float) -> bool:
+    """Hold the lane open until the restarted agent demonstrably ships
+    through upstream pressure >= 1.
+
+    Only the FINAL agent incarnation drains (kill -9 prints nothing),
+    so the cadence audit's level evidence must come from the restarted
+    loop — and a fresh agent starts at level 0 while the cluster's
+    controller decayed to 0 during the restart's interpreter startup.
+    The restarted flood rebuilds the backlog within a tick or two:
+    wait for the cluster to publish level >= 1 again, then for two more
+    journaled shipments, each acked at that level.
+    """
+    if not lane.wait_for(
+        lambda: any(
+            row.get("level", 0) >= 1 and row.get("ts", 0.0) > since_ts
+            for row in _read_json_lines(lane.paths["cluster_status"])
+        ),
+        "upstream pressure >= 1", 60.0,
+    ):
+        return False
+    seq_now = _agent_journal_seq(lane)
+    return lane.wait_for(
+        lambda: _agent_journal_seq(lane) >= seq_now + 2,
+        "pressured shipments", 60.0,
+    )
+
+
+def run_live_cycle(
+    workdir: str,
+    target: str = "cluster",
+    seed: int = 1,
+    log: Callable[[str], None] | None = None,
+) -> LiveRunResult:
+    """One full-tree run with one seeded kill -9 of ``target``."""
+    if target not in KILL_TARGETS:
+        raise ValueError(f"unknown kill target {target!r}")
+    include_frontdoor = target == "frontdoor"
+    lane = _LiveLane(
+        workdir, seed, include_frontdoor=include_frontdoor, log=log
+    )
+    result = LiveRunResult(target=target, seed=seed)
+    roles = ("region", "cluster", "agent") + (
+        ("frontdoor",) if include_frontdoor else ()
+    )
+    kill_ts = 0.0
+    try:
+        lane.start(roles)
+        if not lane.wait_for(
+            lambda: lane.cluster_status().get("shipments", 0) >= 3,
+            "cluster ingest", 90.0,
+        ):
+            result.failures.append(
+                "cluster never ingested 3 shipments (startup)"
+            )
+            return result
+        if target == "region" and not lane.wait_for(
+            lambda: lane.region_status().get("envelopes", 0) >= 1,
+            "region envelope", 90.0,
+        ):
+            result.failures.append(
+                "region never received an envelope (startup)"
+            )
+            return result
+        if include_frontdoor and not lane.wait_for(
+            lambda: any(
+                r.get("order_flipped") for r in lane.frontdoor_rows()
+            ),
+            "admission flip", 150.0,
+        ):
+            result.failures.append(
+                "front door never flipped admission before the kill"
+            )
+            return result
+
+        kill_ts = lane.kill(target)
+        if not lane.wait_for(
+            lambda: lane.supervisor.restart_count(target) >= 1,
+            "restart", 30.0,
+        ):
+            result.failures.append(
+                f"supervisor never restarted {target}"
+            )
+            return result
+
+        # Recovery: the tree must demonstrably move again.
+        if target == "frontdoor":
+            recovered = lane.wait_for(
+                lambda: any(
+                    r.get("ts", 0) > kill_ts
+                    and r.get("restored") == "restored"
+                    for r in lane.frontdoor_rows()
+                ),
+                "frontdoor resume", 120.0,
+            )
+        elif target == "region":
+            recovered = lane.wait_for(
+                lambda: lane.region_status().get("ts", 0) > kill_ts
+                and lane.region_status().get("envelopes", 0) >= 1,
+                "region resume", 90.0,
+            )
+        elif target == "cluster":
+            recovered = lane.wait_for(
+                lambda: lane.cluster_status().get("ts", 0) > kill_ts
+                and lane.cluster_status().get("shipments", 0) >= 1,
+                "cluster resume", 90.0,
+            )
+        else:
+            pre_kill_seq = _agent_journal_seq(lane)
+            recovered = lane.wait_for(
+                lambda: _agent_banner_count(lane) >= 2
+                and _agent_journal_seq(lane) >= pre_kill_seq + 2,
+                "agent resume", 90.0,
+            )
+            if recovered and not _await_pressured_shipping(
+                lane, time.time()
+            ):
+                result.failures.append(
+                    "restarted agent never shipped through "
+                    "pressure >= 1"
+                )
+        if not recovered:
+            result.failures.append(
+                f"tree did not resume after the {target} restart"
+            )
+        # Post-recovery settle: at least one federated incident must
+        # round-trip the whole tree before the drain.
+        lane.wait_for(
+            lambda: bool(
+                _read_json_lines(lane.paths["region_incidents"])
+            ),
+            "federated incident", 90.0,
+        )
+    finally:
+        lane.stop()
+
+    result.restarts = lane.supervisor.restart_count(target)
+    _audit_restart_evidence(lane, result, target)
+    _audit_tree(lane, result)
+    if include_frontdoor:
+        _audit_frontdoor(lane, result, killed=True, kill_ts=kill_ts)
+    return result
+
+
+def run_partition_cycle(
+    workdir: str,
+    seed: int = 1,
+    log: Callable[[str], None] | None = None,
+) -> LiveRunResult:
+    """Black-hole the cluster → region socket mid-run, then heal."""
+    result = LiveRunResult(target="partition", seed=seed)
+    proxy = None
+    lane = None
+    try:
+        # The proxy target needs the region port before the lane
+        # allocates it, so pre-allocate here and thread it through.
+        region_port = free_port()
+        proxy = BlackholeProxy(("127.0.0.1", region_port))
+        lane = _LiveLane(
+            workdir,
+            seed,
+            include_frontdoor=False,
+            region_via=proxy.address,
+            log=log,
+        )
+        lane.region_port = region_port
+        lane.specs["region"].cmd[
+            lane.specs["region"].cmd.index("--listen") + 1
+        ] = f"127.0.0.1:{region_port}"
+        lane.start(("region", "cluster", "agent"))
+        if not lane.wait_for(
+            lambda: lane.region_status().get("envelopes", 0) >= 1,
+            "pre-partition envelope", 120.0,
+        ):
+            result.failures.append(
+                "hop never worked before the partition"
+            )
+            return result
+
+        hold_s = lane.rng.uniform(4.0, 7.0)
+        proxy.partition()
+        if log:
+            log(f"live-chaos: partition for {hold_s:.1f}s")
+        time.sleep(hold_s)
+        proxy.heal()
+        result.dropped_bytes = proxy.dropped_bytes
+
+        pre_heal = lane.region_status().get("envelopes", 0)
+        lane.wait_for(
+            lambda: lane.region_status().get("envelopes", 0)
+            > pre_heal,
+            "post-heal envelope", 90.0,
+        )
+        lane.wait_for(
+            lambda: bool(
+                _read_json_lines(lane.paths["region_incidents"])
+            ),
+            "federated incident", 90.0,
+        )
+    finally:
+        if lane is not None:
+            lane.stop()
+        if proxy is not None:
+            proxy.close()
+
+    _audit_tree(lane, result)
+    if result.dropped_bytes <= 0:
+        result.failures.append(
+            "the partition window black-holed zero bytes"
+        )
+    stderr = _read_text(lane.paths["cluster_stderr"])
+    if (
+        "livenet: reconnected to region" not in stderr
+        and "spool" not in stderr
+    ):
+        # Spool replay after heal normally reconnects; absence of any
+        # client-side evidence means the partition never bit.
+        result.failures.append(
+            "cluster upstream client shows no reconnect/spool "
+            "evidence across the partition"
+        )
+    return result
+
+
+def run_live_sweep(
+    root: str,
+    targets: tuple[str, ...] = KILL_TARGETS,
+    seed: int = 1,
+    log: Callable[[str], None] | None = None,
+) -> LiveSweepReport:
+    """Every kill target once, then one partition run."""
+    report = LiveSweepReport()
+    for i, target in enumerate(targets):
+        result = run_live_cycle(
+            os.path.join(root, f"kill-{target}"),
+            target=target,
+            seed=seed + i,
+            log=log,
+        )
+        report.runs.append(result)
+        if log:
+            verdict = "PASS" if result.passed else "FAIL"
+            log(
+                f"live-chaos: kill {target}: {verdict} "
+                f"(restarts={result.restarts}, "
+                f"region_incidents={result.region_incidents}, "
+                f"max_level={result.cadence.get('max_level', -1)})"
+            )
+    result = run_partition_cycle(
+        os.path.join(root, "partition"), seed=seed + len(targets),
+        log=log,
+    )
+    report.runs.append(result)
+    if log:
+        verdict = "PASS" if result.passed else "FAIL"
+        log(
+            f"live-chaos: partition: {verdict} "
+            f"(dropped_bytes={result.dropped_bytes}, "
+            f"region_incidents={result.region_incidents})"
+        )
+    return report
+
+
+def run_live_smoke(
+    workdir: str,
+    seed: int = 1,
+    log: Callable[[str], None] | None = None,
+) -> LiveRunResult:
+    """The fast 2-process lane: agent → cluster, kill the agent.
+
+    No region, no front door, no JIT warm-up — this is the
+    ``make live-chaos-smoke`` pre-gate shape (~30s) proving the
+    socket hop, the seq journal resume, and cadence coarsening.
+    """
+    lane = _LiveLane(workdir, seed, include_frontdoor=False, log=log)
+    result = LiveRunResult(target="agent", seed=seed)
+    # Drop the upstream hop: a 2-process lane has no region.
+    cmd = lane.specs["cluster"].cmd
+    for flag in ("--region-upstream", "--spool-dir"):
+        idx = cmd.index(flag)
+        del cmd[idx:idx + 2]
+    kill_ts = 0.0
+    try:
+        lane.start(("cluster", "agent"))
+        if not lane.wait_for(
+            lambda: lane.cluster_status().get("shipments", 0) >= 2,
+            "cluster ingest", 90.0,
+        ):
+            result.failures.append(
+                "cluster never ingested 2 shipments (startup)"
+            )
+            return result
+        kill_ts = lane.kill("agent")
+        if not lane.wait_for(
+            lambda: lane.supervisor.restart_count("agent") >= 1,
+            "restart", 30.0,
+        ):
+            result.failures.append("supervisor never restarted agent")
+            return result
+        pre_kill_seq = _agent_journal_seq(lane)
+        if not lane.wait_for(
+            lambda: _agent_banner_count(lane) >= 2
+            and _agent_journal_seq(lane) >= pre_kill_seq + 2,
+            "agent resume", 90.0,
+        ):
+            result.failures.append(
+                "restarted agent never shipped again"
+            )
+        elif not _await_pressured_shipping(lane, time.time()):
+            result.failures.append(
+                "restarted agent never shipped through pressure >= 1"
+            )
+    finally:
+        lane.stop()
+
+    result.restarts = lane.supervisor.restart_count("agent")
+    _audit_restart_evidence(lane, result, "agent")
+
+    # The 2-process audits: dedup + cadence + clean framing (no
+    # region, so the tree-wide loss audit does not apply).
+    cluster_incidents = _read_json_lines(lane.paths["cluster_incidents"])
+    result.cluster_incidents = len(cluster_incidents)
+    ids = [i.get("incident_id", "") for i in cluster_incidents]
+    result.duplicate_incident_ids = len(ids) - len(set(ids))
+    if result.duplicate_incident_ids:
+        result.failures.append(
+            f"{result.duplicate_incident_ids} duplicate incident "
+            "id(s) in the cluster ledger"
+        )
+    if not cluster_incidents:
+        result.failures.append("cluster attributed no incidents")
+    result.cadence = _parse_cadence(
+        _read_text(lane.paths["agent_stderr"])
+    )
+    if not result.cadence:
+        result.failures.append("agent printed no cadence line")
+    elif result.cadence["max_level"] < 1:
+        result.failures.append(
+            "agent never observed upstream pressure >= 1"
+        )
+    result.frames_rejected = _frames_rejected(
+        _read_text(lane.paths["cluster_stdout"])
+    )
+    if result.frames_rejected:
+        result.failures.append(
+            f"{result.frames_rejected} frame(s) rejected by the "
+            "cluster listener"
+        )
+    return result

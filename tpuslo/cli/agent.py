@@ -1492,7 +1492,7 @@ def main(
         import numpy as np
 
         from tpuslo.columnar.gate import ColumnarGate
-        from tpuslo.columnar.schema import to_rows
+        from tpuslo.columnar.schema import concat_batches, to_rows
         from tpuslo.columnar.serialize import serialize_jsonl
         from tpuslo.ingest import GateConfig as _GateConfig
 
@@ -1517,43 +1517,154 @@ def main(
         probe_counter = metrics.probe_events
         stats_every = max(0, args.stats_interval_cycles)
         shipper = None
+        live_client = None
+        seq_journal = None
+        pressure_path = None
+        cadence = None
         shipment_seq = -1
         ship_errors = 0
         if args.fleet_upstream:
-            from tpuslo.fleet.wire import (
-                ShipmentWriter,
-                encode_shipment,
-                last_recorded_seq,
+            import os as os_mod
+
+            from tpuslo.fleet.wire import ShipmentWriter, encode_shipment
+            from tpuslo.livenet import (
+                ReconnectingClient,
+                SeqJournal,
+                ShipmentCadence,
+                parse_socket_url,
+                pressure_sidecar_path,
+                read_pressure_file,
+                resolve_resume_seq,
             )
 
-            # Probe writability up front: a missing directory or
-            # unwritable path should refuse at startup, not crash the
-            # loop at the first gated batch.
+            cadence = ShipmentCadence()
+            # The seq journal + socket spool live wherever the agent
+            # already keeps durable state; either dir works.
+            journal_dir = spool_dir or state_dir
             try:
-                with open(
-                    args.fleet_upstream, "a", encoding="utf-8"
-                ):
-                    pass
-            except OSError as exc:
-                print(
-                    "agent: cannot write fleet upstream "
-                    f"{args.fleet_upstream}: {exc}",
-                    file=sys.stderr,
-                )
+                live_address = parse_socket_url(args.fleet_upstream)
+            except ValueError as exc:
+                print(f"agent: {exc}", file=sys.stderr)
                 return 2
-            shipper = ShipmentWriter(args.fleet_upstream)
-            # The log appends across restarts and the aggregator dedups
-            # on seq: resume the node's sequence, never restart at 0.
-            shipment_seq = last_recorded_seq(
-                args.fleet_upstream, args.node
-            )
+            if live_address is not None:
+                if not journal_dir:
+                    # The socket hop has no local log to scan for seq
+                    # resume and no file to spool into: without a
+                    # durable dir a restart would reuse seqs, which
+                    # the aggregator's dedup eats as silent loss.
+                    print(
+                        "agent: tcp:// fleet upstream needs "
+                        "--spool-dir or --state-dir for the shipment "
+                        "spool and seq journal",
+                        file=sys.stderr,
+                    )
+                    return 2
+                seq_journal = SeqJournal(
+                    os_mod.path.join(journal_dir, "fleet-seq.json")
+                )
+                try:
+                    live_client = ReconnectingClient(
+                        live_address,
+                        os_mod.path.join(journal_dir, "fleet-spool"),
+                        peer="fleet",
+                        observer=metrics.livenet_observer(),
+                        log=lambda msg: print(
+                            f"agent: {msg}", file=sys.stderr
+                        ),
+                    )
+                except OSError as exc:
+                    print(
+                        f"agent: cannot open fleet spool under "
+                        f"{journal_dir}: {exc}",
+                        file=sys.stderr,
+                    )
+                    return 2
+                shipment_seq = resolve_resume_seq(
+                    args.node, journal=seq_journal
+                )
+            else:
+                # Probe writability up front: a missing directory or
+                # unwritable path should refuse at startup, not crash
+                # the loop at the first gated batch.
+                try:
+                    with open(
+                        args.fleet_upstream, "a", encoding="utf-8"
+                    ):
+                        pass
+                except OSError as exc:
+                    print(
+                        "agent: cannot write fleet upstream "
+                        f"{args.fleet_upstream}: {exc}",
+                        file=sys.stderr,
+                    )
+                    return 2
+                shipper = ShipmentWriter(args.fleet_upstream)
+                if journal_dir:
+                    # Maintained alongside the log so a later switch
+                    # to the socket transport resumes from the same
+                    # cursor (resolve_resume_seq takes the max).
+                    seq_journal = SeqJournal(
+                        os_mod.path.join(journal_dir, "fleet-seq.json")
+                    )
+                # The log appends across restarts and the aggregator
+                # dedups on seq: resume the node's sequence, never
+                # restart at 0.
+                shipment_seq = resolve_resume_seq(
+                    args.node,
+                    upstream_log=args.fleet_upstream,
+                    journal=seq_journal,
+                )
+                # The file hop's backpressure channel: fleetagg
+                # --pressure-out mirrors its level into this sidecar.
+                pressure_path = pressure_sidecar_path(
+                    args.fleet_upstream
+                )
             print(
                 f"agent: fleet upstream -> {args.fleet_upstream} "
                 f"(node {args.node}"
                 + (f", slice {args.slice_id}" if args.slice_id else "")
+                + (", live socket" if live_client is not None else "")
                 + ")",
                 file=sys.stderr,
             )
+        def _ship_upstream(out) -> None:
+            """One merged shipment over whichever transport is wired.
+
+            Socket hop journals the seq BEFORE the send: a crash in
+            between burns the seq (a harmless gap), never reuses one —
+            reuse would be eaten by the aggregator's dedup as silent
+            loss.  File hop journals AFTER the append (the log itself
+            is the durable record there).
+            """
+            nonlocal shipment_seq, ship_errors
+            shipment_seq += 1
+            envelope = encode_shipment(
+                out,
+                args.node,
+                shipment_seq,
+                transport="base64",
+                slice_id=args.slice_id,
+            )
+            try:
+                if live_client is not None:
+                    seq_journal.record(args.node, shipment_seq)
+                    live_client.send(envelope)
+                else:
+                    shipper.send("fleet", [envelope])
+                    if seq_journal is not None:
+                        seq_journal.record(args.node, shipment_seq)
+            except OSError as exc:
+                # Disk-full / rotated-away mid-run: the local sinks
+                # must still get this batch; the aggregator's seq gap
+                # shows the loss.
+                ship_errors += 1
+                if ship_errors == 1:
+                    print(
+                        "agent: fleet upstream write failed "
+                        f"({exc}); local sinks continue",
+                        file=sys.stderr,
+                    )
+
         # Sink capability is fixed for the process: local sinks take
         # pre-serialized blocks, OTLP exporters need typed records —
         # probe once instead of serializing a block per batch only to
@@ -1561,6 +1672,7 @@ def main(
         blocks_ok = writers.write_probe_block("")
         idx = 0
         emitted_total = 0
+        pending_ship: list = []
         try:
             while not args.count or idx < args.count:
                 now = datetime.now(timezone.utc)
@@ -1587,33 +1699,10 @@ def main(
                     if not len(out):
                         continue
                     emitted_total += len(out)
-                    if shipper is not None:
-                        shipment_seq += 1
-                        try:
-                            shipper.send(
-                                "fleet",
-                                [
-                                    encode_shipment(
-                                        out,
-                                        args.node,
-                                        shipment_seq,
-                                        transport="base64",
-                                        slice_id=args.slice_id,
-                                    )
-                                ],
-                            )
-                        except OSError as exc:
-                            # Disk-full / rotated-away mid-run: the
-                            # local sinks must still get this batch;
-                            # the aggregator's seq gap shows the loss.
-                            ship_errors += 1
-                            if ship_errors == 1:
-                                print(
-                                    "agent: fleet upstream write "
-                                    f"failed ({exc}); local sinks "
-                                    "continue",
-                                    file=sys.stderr,
-                                )
+                    if shipper is not None or live_client is not None:
+                        # Local sinks get every batch immediately;
+                        # the upstream flush is cadence-gated below.
+                        pending_ship.append(out)
                     if blocks_ok:
                         writers.write_probe_block(
                             serialize_jsonl(out, kind="probe")
@@ -1632,6 +1721,31 @@ def main(
                         probe_counter.labels(
                             signal=strings[code]
                         ).inc(count)
+                if shipper is not None or live_client is not None:
+                    # Fold the freshest upstream pressure level, then
+                    # ask the cadence whether this cycle flushes.  At
+                    # level 0 this is today's behavior bit-for-bit
+                    # (every cycle ships); at level >= 1 consecutive
+                    # cycles merge into one coarser shipment.
+                    if live_client is not None:
+                        cadence.observe(
+                            live_client.pressure_level
+                            if live_client.pressure_level >= 0
+                            else None
+                        )
+                    else:
+                        sig = read_pressure_file(pressure_path)
+                        cadence.observe(
+                            sig.level if sig is not None else None
+                        )
+                    if cadence.should_flush() and pending_ship:
+                        merged = (
+                            pending_ship[0]
+                            if len(pending_ship) == 1
+                            else concat_batches(pending_ship)
+                        )
+                        pending_ship = []
+                        _ship_upstream(merged)
                 idx += 1
                 if stats_every and idx % stats_every == 0:
                     _print_stats(col_gate, metrics)
@@ -1645,6 +1759,28 @@ def main(
                 f"{emitted_total} probe events emitted",
                 file=sys.stderr,
             )
+            if pending_ship:
+                # Held batches must not die with the loop: the final
+                # flush ignores the cadence stride.
+                merged = (
+                    pending_ship[0]
+                    if len(pending_ship) == 1
+                    else concat_batches(pending_ship)
+                )
+                pending_ship = []
+                _ship_upstream(merged)
+            if cadence is not None and (
+                shipper is not None or live_client is not None
+            ):
+                cstats = cadence.stats()
+                print(
+                    "agent: fleet cadence: "
+                    f"cycles={cstats['cycles']} "
+                    f"flushes={cstats['flushes']} "
+                    f"coarsened={cstats['coarsened_cycles']} "
+                    f"max_level={cstats['max_level_seen']}",
+                    file=sys.stderr,
+                )
             if shipper is not None:
                 print(
                     f"agent: fleet upstream: {shipper.shipments} "
@@ -1657,6 +1793,22 @@ def main(
                     file=sys.stderr,
                 )
                 shipper.close()
+            if live_client is not None:
+                print(
+                    "agent: fleet upstream: "
+                    f"{live_client.sent_frames} sent, "
+                    f"{live_client.spooled_frames} spooled, "
+                    f"{live_client.replayed_frames} replayed, "
+                    f"{live_client.reconnects} reconnects, "
+                    f"{live_client.pending_spooled()} pending"
+                    + (
+                        f", {ship_errors} failed writes"
+                        if ship_errors
+                        else ""
+                    ),
+                    file=sys.stderr,
+                )
+                live_client.close()
             if col_gate is not None:
                 col_gate.close()
 

@@ -41,8 +41,9 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "inputs",
-        nargs="+",
-        help="shipment logs written by `agent --fleet-upstream`",
+        nargs="*",
+        help="shipment logs written by `agent --fleet-upstream` "
+        "(omitted in live mode: --listen replaces the file hop)",
     )
     p.add_argument(
         "--shards",
@@ -111,6 +112,67 @@ def build_parser() -> argparse.ArgumentParser:
         "runs; incidents collapse with cross-cluster identity",
     )
     p.add_argument("--region-id", default="region-0")
+    # ---- live deployment plane (tpuslo.livenet) -----------------------
+    p.add_argument(
+        "--listen",
+        default="",
+        help="HOST:PORT — run live: accept shipment frames (cluster "
+        "mode) or region-envelope frames (--region mode) over the "
+        "livenet socket transport instead of reading input logs",
+    )
+    p.add_argument(
+        "--region-upstream",
+        default="",
+        help="live mode: ship region envelopes here each tick — "
+        "tcp://host:port (livenet client, spool-backed) or a JSONL "
+        "path appended per tick (the file-hop fallback)",
+    )
+    p.add_argument(
+        "--run-for-s",
+        type=float,
+        default=0.0,
+        help="live mode: stop after this many seconds (0 = run until "
+        "SIGTERM/SIGINT)",
+    )
+    p.add_argument(
+        "--tick-s",
+        type=float,
+        default=0.5,
+        help="live mode: window-close / envelope-ship / heartbeat "
+        "cadence",
+    )
+    p.add_argument(
+        "--pressure-out",
+        default="",
+        help="publish this aggregator's PressureSignal sidecar here "
+        "(each tick live, once at end of a batch run); agents on "
+        "the file hop poll it to coarsen shipment cadence",
+    )
+    p.add_argument(
+        "--pressure-capacity",
+        type=int,
+        default=5000,
+        help="PressureController capacity (events at a cluster, "
+        "incidents at a region) backing --pressure-out and live acks",
+    )
+    p.add_argument(
+        "--spool-dir",
+        default="",
+        help="durable dir for the --region-upstream socket spool and "
+        "envelope seq journal",
+    )
+    p.add_argument(
+        "--status-out",
+        default="",
+        help="live mode: per-tick status JSONL; doubles as the "
+        "supervisor's heartbeat artifact",
+    )
+    p.add_argument(
+        "--snapshot-interval-s",
+        type=float,
+        default=1.0,
+        help="live mode: StateStore snapshot cadence for --state-out",
+    )
     p.add_argument(
         "--json",
         action="store_true",
@@ -273,8 +335,502 @@ def run_region(args) -> int:
     return 0
 
 
+class _IncidentSink:
+    """Append-only incident JSONL with cross-restart id dedup.
+
+    Live aggregators append incidents the moment the rollup emits
+    them (a kill -9 between ticks loses at most the un-emitted open
+    groups, which the restored rollup state re-opens).  Incident ids
+    are content-derived, so a restored rollup re-emitting a page it
+    already wrote is suppressed here — the zero-duplicate half of the
+    chaos gate's invariant lives in this set.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self.seen: set[str] = set()
+        self.incidents: list[FleetIncident] = []
+        self.written = 0
+        self.suppressed = 0
+        self._fh = None
+        if path:
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    for line in fh:
+                        try:
+                            rid = json.loads(line).get("incident_id")
+                        except (json.JSONDecodeError, AttributeError):
+                            continue
+                        if isinstance(rid, str):
+                            self.seen.add(rid)
+            except OSError:
+                pass
+            self._fh = open(path, "a", encoding="utf-8")
+
+    def emit(self, incident: FleetIncident) -> None:
+        if incident.incident_id in self.seen:
+            self.suppressed += 1
+            return
+        self.seen.add(incident.incident_id)
+        self.incidents.append(incident)
+        self.written += 1
+        if self._fh is not None:
+            self._fh.write(
+                json.dumps(incident.to_dict(), separators=(",", ":"))
+                + "\n"
+            )
+            self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+
+
+def run_live(args) -> int:
+    """``fleetagg --listen``: the live (socket) aggregator role.
+
+    One process, either tree level: a cluster accepts shipment frames
+    from node agents and ships region envelopes upstream each tick; a
+    region (``--region --listen``) accepts envelope frames and emits
+    federated incidents.  Durability is the PR 4 runtime shape —
+    StateStore snapshots each tick, auto-restored on restart under
+    the ProcessSupervisor — and every inbound hop stays behind the
+    wire contracts' seq dedup, so a kill -9 anywhere re-delivers but
+    never duplicates.
+    """
+    import os
+    import threading
+    import time as time_mod
+
+    from tpuslo.federation.backpressure import PressureController
+    from tpuslo.livenet import (
+        LiveListener,
+        ReconnectingClient,
+        SeqJournal,
+        parse_socket_url,
+        write_pressure_file,
+    )
+    from tpuslo.metrics import AgentMetrics
+    from tpuslo.runtime import (
+        AgentRuntime,
+        DrainSignal,
+        StateStore,
+        install_drain_handler,
+    )
+
+    host, _, port_s = args.listen.rpartition(":")
+    host = host or "127.0.0.1"
+    try:
+        port = int(port_s)
+    except ValueError:
+        print(
+            f"fleetagg: --listen {args.listen!r} must be HOST:PORT",
+            file=sys.stderr,
+        )
+        return 2
+
+    role = "region" if args.region else "cluster"
+    source_id = args.region_id if args.region else (
+        args.cluster_id or "cluster-0"
+    )
+    metrics = AgentMetrics()
+    lv_observer = metrics.livenet_observer()
+    controller = PressureController(args.pressure_capacity)
+    state_lock = threading.Lock()
+    sink = _IncidentSink(args.incidents_out)
+    stats = {"frames": 0, "ticks": 0, "shipped_incidents": 0}
+
+    # ---- upstream hop (cluster role only) -----------------------------
+    upstream_client = None
+    upstream_path = ""
+    seq_journal = None
+    if args.region_upstream:
+        durable_dir = args.spool_dir or (
+            os.path.dirname(args.state_out) if args.state_out else ""
+        )
+        try:
+            upstream_addr = parse_socket_url(args.region_upstream)
+        except ValueError as exc:
+            print(f"fleetagg: {exc}", file=sys.stderr)
+            return 2
+        if upstream_addr is not None:
+            if not durable_dir:
+                print(
+                    "fleetagg: tcp:// --region-upstream needs "
+                    "--spool-dir (or --state-out) for the envelope "
+                    "spool and seq journal",
+                    file=sys.stderr,
+                )
+                return 2
+            upstream_client = ReconnectingClient(
+                upstream_addr,
+                os.path.join(durable_dir, "region-spool"),
+                peer="region",
+                observer=lv_observer,
+                log=lambda msg: print(
+                    f"fleetagg: {msg}", file=sys.stderr
+                ),
+            )
+        else:
+            upstream_path = args.region_upstream
+        if durable_dir:
+            seq_journal = SeqJournal(
+                os.path.join(durable_dir, "region-seq.json")
+            )
+    envelope_seq = (
+        seq_journal.last_recorded_seq(source_id)
+        if seq_journal is not None
+        else args.region_seq - 1
+    )
+
+    # ---- aggregation state + runtime registry -------------------------
+    store = None
+    if args.state_out:
+        store = StateStore(
+            args.state_out, interval_s=args.snapshot_interval_s
+        )
+    runtime = AgentRuntime(
+        store,
+        log=lambda msg: print(f"fleetagg: {msg}", file=sys.stderr),
+    )
+
+    if args.region:
+        from tpuslo.federation.region import RegionAggregator
+        from tpuslo.federation.wire import RegionWireError  # noqa: F401
+
+        region = RegionAggregator(
+            region_id=args.region_id,
+            rollup_gap_ns=args.rollup_gap_ns,
+            capacity_incidents=args.pressure_capacity,
+            on_incident=sink.emit,
+        )
+        runtime.register(
+            "region", region.export_state, region.restore_state
+        )
+
+        def _handle(raw: dict[str, Any]) -> None:
+            if region.ingest(raw):
+                stats["frames"] += 1
+
+        def _tick(flush: bool) -> dict[str, Any]:
+            with state_lock:
+                region.pump(flush=flush)
+                backlog = region.backlog_incidents()
+                level = region.observe_pressure()
+                line = {
+                    "role": role,
+                    "level": level,
+                    "backlog": backlog,
+                    "clusters": len(region.clusters),
+                    "envelopes": region.envelopes,
+                    "duplicate_envelopes": region.duplicate_envelopes,
+                    "node_incidents": region.ingested_incidents,
+                    "incidents_written": sink.written,
+                    "incidents_suppressed": sink.suppressed,
+                }
+            if args.pressure_out:
+                try:
+                    write_pressure_file(
+                        args.pressure_out,
+                        region.pressure.signal(source_id, backlog),
+                    )
+                except OSError:
+                    pass
+            return line
+
+    else:
+        shard_ids = [
+            f"{args.shard_prefix}-{i}" for i in range(max(1, args.shards))
+        ]
+        ring = HashRing(shard_ids)
+        shards = {
+            sid: AggregatorShard(
+                sid,
+                gate_config=GateConfig(),
+                window_ns=args.window_ns,
+                min_confidence=args.min_confidence,
+            )
+            for sid in shard_ids
+        }
+        rollup = FleetRollup(
+            gap_ns=args.rollup_gap_ns, on_incident=sink.emit
+        )
+        runtime.register(
+            "rollup", rollup.export_state, rollup.restore_state
+        )
+
+        def _export_shards() -> dict[str, Any]:
+            return {
+                sid: shard.export_state()
+                for sid, shard in shards.items()
+            }
+
+        def _restore_shards(state: dict[str, Any]) -> None:
+            # Failover re-homing, same as --restore-state: each node
+            # fragment lands on whichever shard the ring owns now.
+            restored = 0
+            for section in (state or {}).values():
+                for node, fragment in (
+                    section.get("nodes") or {}
+                ).items():
+                    slice_id = str(fragment.get("slice_id", ""))
+                    owner = ring.shard_for_node(str(node), slice_id)
+                    shards[owner].absorb_node_state(
+                        str(node), fragment
+                    )
+                    restored += 1
+            print(
+                f"fleetagg: re-homed {restored} node fragments",
+                file=sys.stderr,
+            )
+
+        runtime.register("shards", _export_shards, _restore_shards)
+
+        def _handle(raw: dict[str, Any]) -> None:
+            node = raw.get("node") if isinstance(raw, dict) else None
+            if not isinstance(node, str) or not node:
+                raise WireContractError(
+                    "not a shipment object (missing node)"
+                )
+            owner = ring.shard_for_node(
+                node, str(raw.get("slice_id") or "")
+            )
+            if shards[owner].ingest(raw):
+                stats["frames"] += 1
+
+        def _ship_envelope(
+            node_incidents: list, level: int
+        ) -> None:
+            nonlocal envelope_seq
+            from tpuslo.federation.wire import (
+                encode_region_envelope,
+                region_envelope_json_line,
+            )
+
+            marks = [
+                s.watermark_ns() for s in shards.values() if s.nodes
+            ]
+            heads = [s.fleet_head_ns() for s in shards.values()]
+            envelope_seq += 1
+            envelope = encode_region_envelope(
+                source_id,
+                envelope_seq,
+                node_incidents,
+                watermark_ns=min(marks) if marks else 0,
+                head_ns=max(heads) if heads else 0,
+                pressure_level=level,
+            )
+            if upstream_client is not None:
+                # Journal BEFORE send: a crash burns the seq (gap),
+                # never reuses one the region would eat as a dup.
+                if seq_journal is not None:
+                    seq_journal.record(source_id, envelope_seq)
+                upstream_client.send(envelope)
+            else:
+                with open(
+                    upstream_path, "a", encoding="utf-8"
+                ) as fh:
+                    fh.write(region_envelope_json_line(envelope))
+                if seq_journal is not None:
+                    seq_journal.record(source_id, envelope_seq)
+            stats["shipped_incidents"] += len(node_incidents)
+
+        def _tick(flush: bool) -> dict[str, Any]:
+            with state_lock:
+                backlog = sum(
+                    s.backlog_events() for s in shards.values()
+                )
+                level = controller.observe(backlog)
+                node_incidents = [
+                    ni
+                    for shard in shards.values()
+                    for ni in shard.close_windows(flush=flush)
+                ]
+                node_incidents.sort(key=lambda ni: ni.ts_unix_nano)
+                if args.cluster_id:
+                    for ni in node_incidents:
+                        ni.cluster = args.cluster_id
+                if args.region_upstream:
+                    # Ship every tick, incidents or not: the region's
+                    # session-close clock is min(cluster watermarks),
+                    # so a quiet cluster must still heartbeat its
+                    # watermark/head/pressure or it freezes
+                    # close_up_to for the whole tree.
+                    _ship_envelope(node_incidents, level)
+                rollup.observe(node_incidents)
+                if flush:
+                    rollup.flush()
+            if args.pressure_out:
+                try:
+                    write_pressure_file(
+                        args.pressure_out,
+                        controller.signal(source_id, backlog),
+                    )
+                except OSError:
+                    pass
+            return {
+                "role": role,
+                "level": level,
+                "backlog": backlog,
+                "shipments": stats["frames"],
+                "duplicate_shipments": sum(
+                    s.duplicate_shipments for s in shards.values()
+                ),
+                "ingested_events": sum(
+                    s.ingested_events for s in shards.values()
+                ),
+                "shipped_incidents": stats["shipped_incidents"],
+                "incidents_written": sink.written,
+                "incidents_suppressed": sink.suppressed,
+            }
+
+    # Restore AFTER every component registered its hooks; the printed
+    # line is the chaos lane's warm-resume evidence.
+    restore_outcome = runtime.restore()
+    if runtime.enabled:
+        detail = ""
+        if restore_outcome == "restored":
+            detail = (
+                f" (age {runtime.restored_age_s:.1f}s, components: "
+                f"{','.join(runtime.restored_components) or 'none'})"
+            )
+        print(
+            f"fleetagg: runtime: snapshot {restore_outcome}{detail}",
+            file=sys.stderr,
+        )
+
+    status_fh = None
+    if args.status_out:
+        status_fh = open(args.status_out, "a", encoding="utf-8")
+
+    def _heartbeat(line: dict[str, Any]) -> None:
+        if status_fh is None:
+            return
+        line["ts"] = time_mod.time()
+        line["tick"] = stats["ticks"]
+        status_fh.write(
+            json.dumps(line, separators=(",", ":")) + "\n"
+        )
+        status_fh.flush()
+
+    try:
+        listener = LiveListener(
+            _handle,
+            host=host,
+            port=port,
+            name=role,
+            pressure=lambda: controller.level
+            if not args.region
+            else region.pressure.level,
+            observer=lv_observer,
+            log=lambda msg: print(f"fleetagg: {msg}", file=sys.stderr),
+            # Peer threads ingest into the same region/shard objects
+            # the tick loop pumps and closes; sharing state_lock makes
+            # socket ingest and tick work mutually exclusive (the
+            # zero-lost-incident invariant the chaos gate audits).
+            ingest_lock=state_lock,
+        )
+    except OSError as exc:
+        print(
+            f"fleetagg: cannot listen on {args.listen}: {exc}",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"fleetagg: live {role} {source_id} listening on "
+        f"{listener.address}"
+        + (
+            f", upstream -> {args.region_upstream}"
+            if args.region_upstream
+            else ""
+        ),
+        file=sys.stderr,
+    )
+
+    restore_handlers = install_drain_handler()
+    deadline = (
+        time_mod.monotonic() + args.run_for_s
+        if args.run_for_s > 0
+        else float("inf")
+    )
+    last = {}
+    try:
+        while time_mod.monotonic() < deadline:
+            time_mod.sleep(max(0.01, args.tick_s))
+            stats["ticks"] += 1
+            last = _tick(flush=False)
+            _heartbeat(dict(last))
+            runtime.maybe_snapshot()
+    except (KeyboardInterrupt, DrainSignal):
+        pass
+    finally:
+        restore_handlers()
+        listener.close()
+        stats["ticks"] += 1
+        last = _tick(flush=True)
+        if upstream_client is not None:
+            upstream_client.replay_spool()
+        runtime.snapshot_now()
+        last["final"] = True
+        if upstream_client is not None:
+            last["spool_pending"] = upstream_client.pending_spooled()
+        _heartbeat(dict(last))
+        if status_fh is not None:
+            status_fh.close()
+        if upstream_client is not None:
+            upstream_client.close()
+        sink.close()
+    summary = dict(last)
+    summary["listener_frames"] = listener.frames_total
+    summary["frames_rejected"] = listener.frames_rejected
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(
+            f"fleetagg: live {role} {source_id}: "
+            f"{listener.frames_total} frames "
+            f"({listener.frames_rejected} rejected), "
+            f"{sink.written} incidents written "
+            f"({sink.suppressed} suppressed as dups)"
+        )
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.listen:
+        if args.inputs:
+            print(
+                "fleetagg: live mode (--listen) takes no input logs",
+                file=sys.stderr,
+            )
+            return 2
+        if args.region and args.region_upstream:
+            print(
+                "fleetagg: --region is the tree root; "
+                "--region-upstream belongs to cluster runs",
+                file=sys.stderr,
+            )
+            return 2
+        if not args.region and args.region_upstream and not args.cluster_id:
+            print(
+                "fleetagg: --region-upstream requires --cluster-id "
+                "(the envelope's per-cluster identity and seq-dedup "
+                "cursor)",
+                file=sys.stderr,
+            )
+            return 2
+        return run_live(args)
+    if not args.inputs:
+        print(
+            "fleetagg: provide input logs or --listen",
+            file=sys.stderr,
+        )
+        return 2
     if args.region:
         if args.region_out or args.cluster_id:
             print(
@@ -390,6 +946,30 @@ def main(argv: list[str] | None = None) -> int:
                     )
                     continue
                 shipments += 1
+
+    if args.pressure_out:
+        # The file hop's backpressure channel: publish the post-ingest
+        # backlog as a PressureSignal sidecar.  Point this at
+        # `<shipment-log>.pressure` and the shipping agent's next run
+        # coarsens its cadence (tpuslo.livenet.pressure).
+        from tpuslo.federation.backpressure import PressureController
+        from tpuslo.livenet import write_pressure_file
+
+        controller = PressureController(args.pressure_capacity)
+        backlog = sum(s.backlog_events() for s in shards.values())
+        controller.observe(backlog)
+        try:
+            write_pressure_file(
+                args.pressure_out,
+                controller.signal(
+                    args.cluster_id or "fleetagg", backlog
+                ),
+            )
+        except OSError as exc:
+            print(
+                f"fleetagg: cannot write {args.pressure_out}: {exc}",
+                file=sys.stderr,
+            )
 
     # End of logs == end of stream: flush every window and group.
     # Shards flush their whole history one after another, so merge the

@@ -81,6 +81,19 @@ steady-state recompiles (jitaudit), and a mid-run engine kill must
 drain parked/running slots onto siblings with zero lost requests and
 bit-exact stream parity against an uninterrupted reference.
 
+``--live-chaos-sweep`` runs the live deployment-plane gate
+(``tpuslo.chaos.procs``): the whole tree — node agent → cluster
+aggregator → region aggregator over real livenet sockets, plus the
+serving front door with its co-located remediation agent — as
+supervised OS processes; every kill target (agent, cluster, region,
+front door) is SIGKILLed mid-window and the cluster → region socket
+is black-holed once, and the run fails unless zero incidents are lost
+or duplicated across the tree, every restart resumes warm from its
+spool/seq-journal/snapshot, the agent's shipment cadence measurably
+coarsens at pressure level >= 1, no listener ever rejects a frame,
+and the live ``demote_tenant`` remediation flips the admission order
+and survives the front-door kill.
+
 ``--deviceplane-sweep`` runs the device-plane truth gate
 (``tpuslo.deviceplane.sweep``): seeded synthetic-xprof traces with
 every real-capture join pathology (lane-split ops, anonymous warmups,
@@ -374,6 +387,25 @@ def build_parser() -> argparse.ArgumentParser:
         "--federation-no-saturate",
         action="store_true",
         help="skip the forced-saturation lane",
+    )
+    # ---- live deployment-plane gate (tpuslo.chaos.procs) --------------
+    p.add_argument(
+        "--live-chaos-sweep",
+        action="store_true",
+        help="run the live deployment-plane gate instead of B5/D3/E3: "
+        "the whole tree as supervised processes over real sockets; "
+        "SIGKILL every target mid-window + one socket partition, "
+        "requiring zero lost/dup incidents, warm resume, measured "
+        "cadence coarsening at pressure >= 1, clean framing, and the "
+        "live demote_tenant remediation surviving the front-door kill",
+    )
+    p.add_argument("--live-chaos-root", default="artifacts/live-chaos")
+    p.add_argument("--live-chaos-seed", type=int, default=1)
+    p.add_argument(
+        "--live-chaos-targets",
+        default="agent,cluster,region,frontdoor",
+        help="comma-separated kill targets (the partition run always "
+        "runs after them)",
     )
     p.add_argument("--crash-root", default="artifacts/crash")
     p.add_argument("--crash-seeds", default="1,2,3,4,5")
@@ -943,6 +975,80 @@ def run_federation_gate(args) -> int:
     return 0 if report.passed else 1
 
 
+def render_live_markdown(report) -> str:
+    lines = [
+        "# Live deployment-plane gate (process tree over real sockets)",
+        "",
+        f"**Overall: {'PASS' if report.passed else 'FAIL'}**",
+        "",
+        "- topology: agent -> cluster fleetagg -> region fleetagg over "
+        "livenet frames, plus the supervised front door; every run "
+        "audits the incident ledgers content-wise (unique ids, full "
+        "member coverage at the region), the agent's cadence line, "
+        "listener rejects, and warm-resume evidence",
+        "",
+        "| run | seed | restarts | resumed | max level | flushes/"
+        "cycles | cluster inc | region inc | dup | lost | rejected | "
+        "dropped B | pass |",
+        "|---|---|---|---|---|---|---|---|---|---|---|---|---|",
+    ]
+    for run in report.runs:
+        cadence = run.cadence or {}
+        flushes = (
+            f"{cadence.get('flushes', '-')}/{cadence.get('cycles', '-')}"
+        )
+        lines.append(
+            f"| {run.target} | {run.seed} | {run.restarts} "
+            f"| {','.join(run.restored_evidence) or '-'} "
+            f"| {cadence.get('max_level', '-')} | {flushes} "
+            f"| {run.cluster_incidents} | {run.region_incidents} "
+            f"| {run.duplicate_incident_ids} | {run.lost_members} "
+            f"| {run.frames_rejected} | {run.dropped_bytes} "
+            f"| {run.passed} |"
+        )
+    flips = [
+        r for r in report.runs if r.target == "frontdoor"
+    ]
+    if flips:
+        run = flips[0]
+        lines += [
+            "",
+            f"- front door: remediation applied = "
+            f"{run.remediation_applied}, admission order flipped = "
+            f"{run.order_flipped} (and survived the kill -9)",
+        ]
+    if report.failures:
+        lines += ["", "## Failures", ""]
+        lines += [f"- {f}" for f in report.failures]
+    return "\n".join(lines) + "\n"
+
+
+def run_live_gate(args) -> int:
+    from tpuslo.chaos.procs import run_live_sweep
+
+    targets = tuple(
+        t.strip() for t in args.live_chaos_targets.split(",") if t.strip()
+    )
+    report = run_live_sweep(
+        args.live_chaos_root,
+        targets=targets,
+        seed=args.live_chaos_seed,
+        log=lambda msg: print(f"m5gate: {msg}", file=sys.stderr),
+    )
+    if args.summary_json:
+        Path(args.summary_json).write_text(
+            json.dumps(report.to_dict(), indent=2) + "\n"
+        )
+    if args.summary_md:
+        Path(args.summary_md).write_text(render_live_markdown(report))
+    print(
+        f"m5gate: live-chaos {'PASS' if report.passed else 'FAIL'}"
+        + ("" if report.passed else f" ({'; '.join(report.failures)})"),
+        file=sys.stderr,
+    )
+    return 0 if report.passed else 1
+
+
 def render_chaos_markdown(report) -> str:
     lines = [
         "# Telemetry chaos-sweep gate",
@@ -1227,6 +1333,8 @@ def main(argv: list[str] | None = None) -> int:
         return run_fleet_gate(args)
     if args.federation_sweep:
         return run_federation_gate(args)
+    if args.live_chaos_sweep:
+        return run_live_gate(args)
     if args.crash_sweep:
         return run_crash_gate(args)
     if args.chaos_sweep:

@@ -184,9 +184,13 @@ class RegionAggregator:
         self.incidents.extend(emitted)
         return emitted
 
+    def backlog_incidents(self) -> int:
+        """Buffered + open-group incidents (the pressure-loop backlog)."""
+        return len(self._pending) + self.rollup.open_groups()
+
     def observe_pressure(self) -> int:
         """Publish the region's own backlog as a downstream level."""
-        backlog = len(self._pending) + self.rollup.open_groups()
+        backlog = self.backlog_incidents()
         level = self.pressure.observe(backlog)
         self._observer.backpressure_level(self.region_id, level)
         return level

@@ -306,6 +306,16 @@ def last_recorded_seq(path: str, node: str) -> int:
     aggregator drops ``seq <= state.seq`` as duplicates — a restarted
     agent must resume its monotonic per-node sequence from the log, or
     every post-restart shipment is silently deduplicated away.
+
+    This scan is only the *file hop's* record.  The socket hop has no
+    local log, so it journals seqs in a
+    :class:`tpuslo.livenet.seqstate.SeqJournal` (same -1-when-absent
+    semantics), and
+    :func:`tpuslo.livenet.seqstate.resolve_resume_seq` takes the max
+    of both records — the one resume rule for either transport, which
+    is what lets a node switch between file and socket upstreams
+    mid-life without replaying or skipping a seq range
+    (``tests/test_livenet.py`` asserts the parity both directions).
     """
     try:
         fh = open(path, encoding="utf-8")

@@ -1,0 +1,46 @@
+"""Live deployment plane: real sockets under the existing wire contracts.
+
+``tpuslo.livenet`` carries the fleet and federation envelope formats —
+unchanged — over a length-prefixed TCP transport with spool-backed
+at-least-once delivery, ack-carried backpressure, seq-journal resume
+parity with the file hop, and a ProcessSupervisor that keeps the whole
+tree of toolkit processes alive through kill -9 and wedges.
+"""
+
+from tpuslo.livenet.framing import (
+    DEFAULT_MAX_FRAME_BYTES,
+    FrameDecoder,
+    FramingError,
+    encode_frame,
+)
+from tpuslo.livenet.client import ReconnectingClient, parse_socket_url
+from tpuslo.livenet.pressure import (
+    PRESSURE_SIDECAR_SUFFIX,
+    ShipmentCadence,
+    pressure_sidecar_path,
+    read_pressure_file,
+    write_pressure_file,
+)
+from tpuslo.livenet.seqstate import SeqJournal, resolve_resume_seq
+from tpuslo.livenet.server import LiveListener, LivenetObserver
+from tpuslo.livenet.supervise import ProcessSpec, ProcessSupervisor
+
+__all__ = [
+    "DEFAULT_MAX_FRAME_BYTES",
+    "FrameDecoder",
+    "FramingError",
+    "LiveListener",
+    "LivenetObserver",
+    "PRESSURE_SIDECAR_SUFFIX",
+    "ProcessSpec",
+    "ProcessSupervisor",
+    "ReconnectingClient",
+    "SeqJournal",
+    "ShipmentCadence",
+    "encode_frame",
+    "parse_socket_url",
+    "pressure_sidecar_path",
+    "read_pressure_file",
+    "resolve_resume_seq",
+    "write_pressure_file",
+]

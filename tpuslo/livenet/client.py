@@ -1,0 +1,223 @@
+"""ReconnectingClient: spool-backed at-least-once frame delivery.
+
+The sending half of both live hops (agent → cluster, cluster →
+region).  Delivery semantics mirror the toolkit's delivery channel:
+
+* A payload that cannot be delivered **right now** — no connection,
+  send failed, ack never arrived — lands in a
+  :class:`~tpuslo.delivery.spool.DiskSpool` and the send *succeeds*
+  from the caller's perspective: the live loop never blocks on a dead
+  upstream, it keeps journaling seqs and spooling.
+* Every successful send first drains the spool **oldest-first**, so
+  redelivery preserves seq order and the receiver's dedup cursor
+  advances instead of eating everything as stale.
+* The at-least-once edge case — the payload reached the server but
+  the connection died before the ack — re-sends that payload from the
+  spool on reconnect.  The receiver's seq dedup (shipment seq or
+  envelope seq) absorbs exactly this duplicate; that is why both wire
+  contracts carry a per-sender monotonic seq in the first place.
+
+A nack (``ok: false``) counts as *delivered*: the server saw the
+frame and refused it on contract grounds; replaying it would refuse
+again forever and dam the spool behind one poison frame.
+
+The ack's ``pressure_level`` is retained on :attr:`pressure_level` —
+the sender's live view of upstream pressure, consumed by the agent's
+shipment-cadence coarsening.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import time
+from typing import Any, Callable
+
+from tpuslo.delivery.spool import DiskSpool
+from tpuslo.livenet.framing import (
+    DEFAULT_MAX_FRAME_BYTES,
+    FrameDecoder,
+    FramingError,
+    encode_frame,
+)
+from tpuslo.livenet.server import LivenetObserver
+
+_RECV_BYTES = 65536
+
+
+def parse_socket_url(url: str) -> tuple[str, int] | None:
+    """``tcp://host:port`` → ``(host, port)``; None for plain paths.
+
+    The one switch deciding whether ``--fleet-upstream`` (and
+    ``--region-upstream``) means the file hop or the live socket.
+    """
+    if not url.startswith("tcp://"):
+        return None
+    rest = url[len("tcp://"):]
+    host, sep, port = rest.rpartition(":")
+    if not sep or not host:
+        raise ValueError(
+            f"livenet url {url!r} must look like tcp://host:port"
+        )
+    try:
+        return host, int(port)
+    except ValueError as exc:
+        raise ValueError(
+            f"livenet url {url!r} has a non-numeric port"
+        ) from exc
+
+
+class ReconnectingClient:
+    """One upstream peer: connect, frame, ack, spool, replay."""
+
+    def __init__(
+        self,
+        address: tuple[str, int],
+        spool_dir: str | os.PathLike,
+        peer: str = "upstream",
+        timeout_s: float = 5.0,
+        max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+        observer: LivenetObserver | None = None,
+        log: Callable[[str], None] | None = None,
+    ):
+        self.address = address
+        self.peer = peer
+        self.timeout_s = timeout_s
+        self._max_frame = max_frame_bytes
+        self._observer = observer or LivenetObserver()
+        self._log = log or (lambda msg: None)
+        self._spool = DiskSpool(spool_dir)
+        self._sock: socket.socket | None = None
+        self._decoder = FrameDecoder(max_frame_bytes=max_frame_bytes)
+        self._connected_once = False
+        #: Last pressure level any ack carried (-1 = never acked).
+        self.pressure_level = -1
+        self.reconnects = 0
+        self.sent_frames = 0
+        self.spooled_frames = 0
+        self.replayed_frames = 0
+        self.nacked_frames = 0
+
+    # ---- connection management ----------------------------------------
+
+    def _ensure_connected(self) -> bool:
+        if self._sock is not None:
+            return True
+        try:
+            sock = socket.create_connection(
+                self.address, timeout=self.timeout_s
+            )
+        except OSError:
+            return False
+        sock.settimeout(self.timeout_s)
+        self._sock = sock
+        self._decoder = FrameDecoder(max_frame_bytes=self._max_frame)
+        if self._connected_once:
+            self.reconnects += 1
+            self._observer.reconnected(self.peer)
+            self._log(
+                f"livenet: reconnected to {self.peer} "
+                f"({self.address[0]}:{self.address[1]})"
+            )
+        self._connected_once = True
+        return True
+
+    def _drop_connection(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    # ---- delivery -----------------------------------------------------
+
+    def _send_acked(self, payload: dict[str, Any]) -> bool:
+        """One payload over the live socket, ack awaited; False means
+        "not delivered now" (caller spools).  Raising never happens:
+        every socket failure is a spool, not an exception."""
+        if not self._ensure_connected():
+            return False
+        sock = self._sock
+        try:
+            sock.sendall(encode_frame(payload))
+            deadline = time.monotonic() + self.timeout_s
+            while time.monotonic() < deadline:
+                try:
+                    chunk = sock.recv(_RECV_BYTES)
+                except socket.timeout:
+                    break
+                except OSError:
+                    break
+                if not chunk:
+                    break
+                acks = self._decoder.feed(chunk)
+                if acks:
+                    ack = acks[-1]
+                    level = ack.get("pressure_level")
+                    if isinstance(level, int):
+                        self.pressure_level = level
+                        self._observer.pressure_level(
+                            self.peer, level
+                        )
+                    if not ack.get("ok", False):
+                        # Contract refusal: delivered-and-refused, do
+                        # not dam the spool replaying it forever.
+                        self.nacked_frames += 1
+                        self._log(
+                            f"livenet: {self.peer} refused frame: "
+                            f"{ack.get('error', 'unknown')}"
+                        )
+                    return True
+        except (OSError, FramingError):
+            pass
+        # Send or ack path failed: this connection is untrustworthy.
+        self._drop_connection()
+        return False
+
+    def send(self, payload: dict[str, Any]) -> bool:
+        """Deliver (or durably spool) one payload; True = acked live.
+
+        Replays any spool backlog first so the receiver sees seqs in
+        order.  On any failure the payload is spooled and the send
+        still *succeeds* from the loop's perspective — `OSError` from
+        the spool itself (disk full) is the only raise.
+        """
+        self.replay_spool()
+        if self._spool.pending_batches() == 0 and self._send_acked(
+            payload
+        ):
+            self.sent_frames += 1
+            return True
+        self._spool.append(payload)
+        self.spooled_frames += 1
+        return False
+
+    def replay_spool(self) -> int:
+        """Drain spooled payloads oldest-first while the peer acks."""
+        if self._spool.pending_batches() == 0:
+            return 0
+
+        def _replay_one(record: dict[str, Any]) -> None:
+            if not self._send_acked(record):
+                raise _ReplayAborted()
+
+        try:
+            replayed = self._spool.drain(_replay_one)
+        except _ReplayAborted:
+            return 0
+        if replayed:
+            self.replayed_frames += replayed
+            self._observer.spool_replayed(self.peer, replayed)
+        return replayed
+
+    def pending_spooled(self) -> int:
+        return self._spool.pending_batches()
+
+    def close(self) -> None:
+        self._drop_connection()
+        self._spool.close()
+
+
+class _ReplayAborted(Exception):
+    """Internal: stop a spool drain at the first undelivered record."""

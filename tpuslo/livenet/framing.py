@@ -1,0 +1,128 @@
+"""Length-prefixed frames for the live deployment plane (ISSUE 17).
+
+One frame carries one JSON-safe wire envelope — a ``fleet/wire.py``
+shipment (``transport="base64"``) on the agent → cluster hop, a
+``federation/wire.py`` region envelope on the cluster → region hop, or
+an ack flowing back down.  The framing layer knows nothing about
+either contract: it moves ``dict``\\ s, and the existing versioned
+encode/decode functions (with their own version gates and seq dedup)
+run unchanged on each side of the socket.
+
+Frame layout (all integers big-endian)::
+
+    +--------+---------+------------------+-----------------+
+    | magic  | version | payload length   | payload (JSON)  |
+    | 2 B    | 1 B     | 4 B              | length bytes    |
+    +--------+---------+------------------+-----------------+
+
+The contract failures a socket adds over a file hop are explicit:
+
+* **Torn frame** — a peer died mid-write.  The decoder simply keeps
+  the partial bytes buffered; the connection dying is what surfaces
+  the tear (and the spool replays the payload).  A torn frame can
+  never be *mis-parsed* as the next frame: the magic check refuses a
+  resynchronization attempt on garbage.
+* **Oversized frame** — a corrupt or hostile length prefix must not
+  make the receiver allocate gigabytes.  Anything over
+  ``max_frame_bytes`` raises :class:`FramingError` before any
+  payload byte is read.
+* **Bad magic / version** — a non-toolkit peer (or a future frame
+  format) is refused loudly, exactly like the envelope version gates.
+
+:class:`FrameDecoder.feed` is registered in the hot-path manifest: it
+runs once per ``recv`` chunk on both listener hops, and its cost must
+stay buffer arithmetic + one ``json.loads`` per complete frame.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any
+
+from tpuslo.fleet.wire import WireContractError
+
+#: ``b"LN"`` — livenet.
+FRAME_MAGIC = 0x4C4E
+FRAME_VERSION = 1
+_HEADER = struct.Struct("!HBI")
+HEADER_BYTES = _HEADER.size
+
+#: Default ceiling: a shipment of ~100k gated events in base64
+#: transport stays well under 8 MiB; anything larger is a corrupt
+#: length prefix, not a batch.
+DEFAULT_MAX_FRAME_BYTES = 8 * 1024 * 1024
+
+
+class FramingError(WireContractError):
+    """A frame violated the livenet framing contract."""
+
+
+def encode_frame(payload: dict[str, Any]) -> bytes:
+    """One JSON-safe dict → one length-prefixed frame."""
+    body = json.dumps(payload, separators=(",", ":")).encode("utf-8")
+    return _HEADER.pack(FRAME_MAGIC, FRAME_VERSION, len(body)) + body
+
+
+class FrameDecoder:
+    """Incremental frame decoder over an arbitrary chunk stream.
+
+    ``feed`` accepts whatever the socket handed over — half a length
+    prefix, three frames and a tail, one byte — buffers the remainder,
+    and returns every *complete* frame's decoded payload.  Registered
+    in the hot-path manifest (TPL120): per-chunk cost is concatenation
+    and slicing; JSON decode happens once per complete frame.
+    """
+
+    __slots__ = ("_buf", "_max_frame")
+
+    def __init__(self, max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES):
+        self._buf = b""
+        self._max_frame = max_frame_bytes
+
+    def pending_bytes(self) -> int:
+        """Buffered bytes of the (possibly torn) trailing frame."""
+        return len(self._buf)
+
+    def feed(self, chunk: bytes) -> list[dict[str, Any]]:
+        if chunk:
+            self._buf += chunk
+        frames: list[dict[str, Any]] = []
+        buf = self._buf
+        offset = 0
+        while len(buf) - offset >= HEADER_BYTES:
+            magic, version, length = _HEADER.unpack_from(buf, offset)
+            if magic != FRAME_MAGIC:
+                raise FramingError(
+                    f"bad frame magic 0x{magic:04x} "
+                    f"(expected 0x{FRAME_MAGIC:04x})"
+                )
+            if version != FRAME_VERSION:
+                raise FramingError(
+                    f"unsupported frame version {version} "
+                    f"(this build speaks {FRAME_VERSION})"
+                )
+            if length > self._max_frame:
+                raise FramingError(
+                    f"frame of {length} bytes exceeds the "
+                    f"{self._max_frame}-byte ceiling"
+                )
+            end = offset + HEADER_BYTES + length
+            if len(buf) < end:
+                break  # torn frame: keep buffering
+            body = buf[offset + HEADER_BYTES:end]
+            try:
+                payload = json.loads(body)
+            except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+                raise FramingError(
+                    f"frame payload is not valid JSON: {exc}"
+                ) from exc
+            if not isinstance(payload, dict):
+                raise FramingError(
+                    "frame payload must be a JSON object, got "
+                    f"{type(payload).__name__}"
+                )
+            frames.append(payload)
+            offset = end
+        self._buf = buf[offset:]
+        return frames

@@ -1,0 +1,150 @@
+"""Upstream pressure consumption + shipment-cadence coarsening.
+
+The federation plane publishes a
+:class:`~tpuslo.federation.backpressure.PressureSignal` when an
+aggregator's ingest backlog crosses its thresholds — but until ISSUE
+17 only the *simulator* ever consumed it; the real ``agent
+--fleet-upstream`` shipped at a fixed cadence no matter how saturated
+its cluster was.  This module closes that loop for BOTH transports:
+
+* **Socket hop** — every ack carries the aggregator's current level
+  (:class:`~tpuslo.livenet.client.ReconnectingClient.pressure_level`).
+* **File hop** — the aggregator mirrors its level into a JSON sidecar
+  next to the shipment log (``<log>.pressure``, written by ``fleetagg
+  --pressure-out``); the agent polls it each cycle.  Same signal,
+  same response, no socket required (the satellite bug fix).
+
+:class:`ShipmentCadence` is the response: at level L the agent flushes
+its accumulated gated batches upstream every ``2**min(L, 3)`` cycles
+as ONE merged shipment instead of one per cycle.  Nothing is dropped
+— events are concatenated, not sampled (sampling under pressure is
+the *aggregator's* lever, and it only ever drops status-ok rows) —
+the aggregator simply pays one decode + merge for 2/4/8 cycles of
+events.  Coarsening is measurable: ``flushes < cycles`` whenever the
+observed level held ≥ 1, which the live-chaos lane asserts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any
+
+from tpuslo.federation.backpressure import (
+    LEVEL_AGGRESSIVE,
+    LEVEL_NONE,
+    PressureSignal,
+)
+
+PRESSURE_FILE_VERSION = 1
+
+#: Sidecar suffix for the file hop's pressure back-channel.
+PRESSURE_SIDECAR_SUFFIX = ".pressure"
+
+
+def pressure_sidecar_path(upstream_log: str) -> str:
+    """The conventional sidecar path next to a shipment log."""
+    return upstream_log + PRESSURE_SIDECAR_SUFFIX
+
+
+def write_pressure_file(path: str, signal: PressureSignal) -> None:
+    """Atomically publish one pressure signal (tmp + rename)."""
+    payload: dict[str, Any] = {
+        "v": PRESSURE_FILE_VERSION,
+        "source": signal.source,
+        "level": int(signal.level),
+        "backlog_events": int(signal.backlog_events),
+        "capacity_events": int(signal.capacity_events),
+    }
+    directory = os.path.dirname(path) or "."
+    fd, tmp_path = tempfile.mkstemp(
+        prefix=".pressure-", suffix=".tmp", dir=directory
+    )
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as fh:
+            fh.write(json.dumps(payload, separators=(",", ":")))
+        os.replace(tmp_path, path)
+    except BaseException:
+        try:
+            os.unlink(tmp_path)
+        except OSError:
+            pass
+        raise
+
+
+def read_pressure_file(path: str) -> PressureSignal | None:
+    """Read a published signal; None when absent/unreadable/foreign.
+
+    Tolerant by design: a missing or torn sidecar means "no pressure
+    information", never a crashed shipping loop.
+    """
+    try:
+        with open(path, encoding="utf-8") as fh:
+            raw = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(raw, dict) or raw.get("v") != PRESSURE_FILE_VERSION:
+        return None
+    try:
+        return PressureSignal(
+            source=str(raw.get("source", "")),
+            level=int(raw["level"]),
+            backlog_events=int(raw.get("backlog_events", 0)),
+            capacity_events=int(raw.get("capacity_events", 0)),
+        )
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+class ShipmentCadence:
+    """Pressure-driven flush stride for the agent's shipping loop.
+
+    ``observe(level)`` once per cycle with the freshest upstream level;
+    ``should_flush()`` answers whether the accumulated batches go out
+    this cycle.  The stride is ``2**min(level, 3)`` — level 0 ships
+    every cycle (today's behavior, bit-for-bit), level 1 every 2nd,
+    level 3 every 8th.  A level *drop* flushes immediately: held
+    evidence must not age through a recovery.
+    """
+
+    def __init__(self):
+        self.level = LEVEL_NONE
+        self.max_level_seen = LEVEL_NONE
+        self.cycles = 0
+        self.flushes = 0
+        self.coarsened_cycles = 0
+        self._held_cycles = 0
+
+    def stride(self) -> int:
+        return 1 << min(max(self.level, LEVEL_NONE), LEVEL_AGGRESSIVE)
+
+    def observe(self, level: int | None) -> None:
+        """Fold the freshest upstream level (None = no signal)."""
+        if level is None or level < LEVEL_NONE:
+            return
+        level = min(int(level), LEVEL_AGGRESSIVE)
+        if level < self.level and self._held_cycles:
+            # Pressure released: flush what we held on the next ask.
+            self._held_cycles = max(self._held_cycles, self.stride())
+        self.level = level
+        self.max_level_seen = max(self.max_level_seen, level)
+
+    def should_flush(self) -> bool:
+        """One call per shipping cycle; True = flush accumulated now."""
+        self.cycles += 1
+        self._held_cycles += 1
+        if self._held_cycles >= self.stride():
+            self._held_cycles = 0
+            self.flushes += 1
+            return True
+        self.coarsened_cycles += 1
+        return False
+
+    def stats(self) -> dict[str, int]:
+        return {
+            "cycles": self.cycles,
+            "flushes": self.flushes,
+            "coarsened_cycles": self.coarsened_cycles,
+            "max_level_seen": self.max_level_seen,
+        }

@@ -1,0 +1,120 @@
+"""Shipment-sequence journal: one resume semantics for BOTH transports.
+
+The fleet wire contract's at-least-once story hangs on one number per
+node: the highest shipment ``seq`` this node has *recorded*.  The
+aggregator drops ``seq <= cursor`` as duplicates, so a restarted agent
+that resumes too low silently loses everything it re-ships, and one
+that resumes too high opens a gap the fleet reads as loss.
+
+The two transports record that number differently:
+
+* **File hop** — the shipment log itself is the record:
+  :func:`tpuslo.fleet.wire.last_recorded_seq` scans the appended log.
+  A shipment is *recorded* when its line is appended, whether or not
+  an aggregator ever reads it.
+* **Socket hop** — there is no local log to scan, so the
+  :class:`SeqJournal` is the record: the sender journals the seq
+  **before** handing the shipment to the socket/spool.  A crash
+  between journal and send burns that seq (a gap the receiver's
+  dedup cursor ignores); it can never cause a *reused* seq, which the
+  dedup would eat as a duplicate — silent data loss.
+
+``resolve_resume_seq`` is the one resume rule both paths share, and
+the reason a node can switch transports mid-life without replaying or
+skipping a seq range (ISSUE 17 satellite): it takes the **max** of
+every record that exists — the file log (when the upstream is a
+path) and the journal (always written when a journal dir is
+configured).  Switching file → socket resumes from the journal that
+file mode also maintained; switching socket → file resumes from the
+journal even though the fresh log scans empty.  The parity is
+asserted in ``tests/test_livenet.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any
+
+from tpuslo.fleet.wire import last_recorded_seq
+
+JOURNAL_VERSION = 1
+
+
+class SeqJournal:
+    """Atomic per-node high-water marks for shipped sequence numbers."""
+
+    def __init__(self, path: str | os.PathLike):
+        self.path = os.fspath(path)
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self._nodes: dict[str, int] = {}
+        self._load()
+
+    def _load(self) -> None:
+        try:
+            with open(self.path, encoding="utf-8") as fh:
+                raw = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            return
+        if not isinstance(raw, dict) or raw.get("v") != JOURNAL_VERSION:
+            return
+        for node, seq in (raw.get("nodes") or {}).items():
+            try:
+                self._nodes[str(node)] = int(seq)
+            except (TypeError, ValueError):
+                continue
+
+    def last_recorded_seq(self, node: str) -> int:
+        """Highest journaled seq for ``node``; -1 when never recorded
+        (the same "absent" value the file-log scan returns)."""
+        return self._nodes.get(node, -1)
+
+    def record(self, node: str, seq: int) -> None:
+        """Journal ``seq`` as recorded for ``node`` (monotonic, atomic).
+
+        Written with the same tmp-then-replace discipline as the
+        runtime StateStore: a kill -9 mid-write leaves the previous
+        complete journal, never a torn one.  May raise ``OSError``
+        (disk full) — the caller treats that like a failed log append.
+        """
+        if seq <= self._nodes.get(node, -1):
+            return
+        self._nodes[node] = int(seq)
+        payload: dict[str, Any] = {
+            "v": JOURNAL_VERSION,
+            "nodes": dict(self._nodes),
+        }
+        directory = os.path.dirname(self.path) or "."
+        fd, tmp_path = tempfile.mkstemp(
+            prefix=".seq-", suffix=".tmp", dir=directory
+        )
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as fh:
+                fh.write(json.dumps(payload, separators=(",", ":")))
+            os.replace(tmp_path, self.path)
+        except BaseException:
+            try:
+                os.unlink(tmp_path)
+            except OSError:
+                pass
+            raise
+
+
+def resolve_resume_seq(
+    node: str,
+    upstream_log: str | None = None,
+    journal: SeqJournal | None = None,
+) -> int:
+    """The seq an (re)starting node resumes AFTER: max over every
+    record that exists — identical for file-hop and socket senders.
+
+    Returns -1 when no record exists anywhere (a genuinely new node:
+    its first shipment is seq 0).
+    """
+    resume = -1
+    if upstream_log:
+        resume = max(resume, last_recorded_seq(upstream_log, node))
+    if journal is not None:
+        resume = max(resume, journal.last_recorded_seq(node))
+    return resume

@@ -1,0 +1,226 @@
+"""LiveListener: the socket side of a live aggregator (ISSUE 17).
+
+One listener per tree hop: a cluster ``fleetagg --listen`` accepts
+shipment frames from node agents; a region ``fleetagg --region
+--listen`` accepts envelope frames from clusters.  The listener is a
+plain threaded TCP accept loop — one daemon thread per peer — because
+the toolkit's aggregation work happens on the *caller's* cadence
+(window closes, pumps, snapshots), not the socket's: the handler only
+ingests into the shard/region objects (their own seq dedup makes
+redelivery safe) and everything stateful stays single-owner.
+
+Protocol: every inbound frame is answered with one ack frame::
+
+    {"ok": true,  "seq": <echoed>, "pressure_level": <0..3>}
+    {"ok": false, "seq": <echoed>, "pressure_level": L, "error": "..."}
+
+The ack is the live plane's backpressure channel — the one the file
+hop never had.  ``pressure`` is a caller-supplied callable returning
+the current :class:`~tpuslo.federation.backpressure.PressureController`
+level; every ack carries it, so a shipping agent learns the
+aggregator's pressure on every send and can coarsen its cadence
+without any extra round trip.
+
+A handler raising :class:`~tpuslo.fleet.wire.WireContractError` (or
+the framing subclass) nacks that frame and keeps the connection; a
+framing error on the *stream* (bad magic, oversized length) closes
+the connection — after garbage there is no frame boundary left to
+trust.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any, Callable
+
+from tpuslo.fleet.wire import WireContractError
+from tpuslo.livenet.framing import (
+    DEFAULT_MAX_FRAME_BYTES,
+    FrameDecoder,
+    FramingError,
+    encode_frame,
+)
+
+_RECV_BYTES = 65536
+
+
+class LivenetObserver:
+    """No-op observer; the agent/fleetagg bridge these to metrics."""
+
+    def peers(self, listener: str, connected: int) -> None: ...
+
+    def frame_rejected(self, listener: str, reason: str) -> None: ...
+
+    def reconnected(self, peer: str) -> None: ...
+
+    def spool_replayed(self, peer: str, frames: int) -> None: ...
+
+    def pressure_level(self, peer: str, level: int) -> None: ...
+
+
+class LiveListener:
+    """Threaded length-prefixed-frame listener feeding one handler."""
+
+    def __init__(
+        self,
+        handler: Callable[[dict[str, Any]], None],
+        host: str = "127.0.0.1",
+        port: int = 0,
+        name: str = "livenet",
+        pressure: Callable[[], int] | None = None,
+        max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+        observer: LivenetObserver | None = None,
+        log: Callable[[str], None] | None = None,
+        ingest_lock: threading.Lock | None = None,
+    ):
+        self._handler = handler
+        self._pressure = pressure or (lambda: 0)
+        self._max_frame = max_frame_bytes
+        self._observer = observer or LivenetObserver()
+        self._log = log or (lambda msg: None)
+        self.name = name
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(32)
+        self.host, self.port = self._sock.getsockname()[:2]
+        self._closed = threading.Event()
+        # The single-owner ingest lock: shard/region objects are not
+        # thread-safe, and two agents' frames must not interleave
+        # inside one ``ingest``.  A caller whose own loop mutates the
+        # same objects (fleetagg's tick-time window closes and pumps)
+        # passes its state lock here so socket ingest and tick work
+        # are mutually excluded, not just ingest-vs-ingest.
+        self._ingest_lock = ingest_lock or threading.Lock()
+        self._peers: set[socket.socket] = set()
+        self._peers_lock = threading.Lock()
+        self.frames_total = 0
+        self.frames_rejected = 0
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"{name}-accept",
+        )
+        self._accept_thread.start()
+
+    @property
+    def address(self) -> str:
+        return f"tcp://{self.host}:{self.port}"
+
+    @property
+    def connected_peers(self) -> int:
+        with self._peers_lock:
+            return len(self._peers)
+
+    # ---- accept / per-peer loops --------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                conn, addr = self._sock.accept()
+            except OSError:
+                return  # listener closed
+            with self._peers_lock:
+                self._peers.add(conn)
+            self._observer.peers(self.name, self.connected_peers)
+            thread = threading.Thread(
+                target=self._peer_loop, args=(conn,), daemon=True,
+                name=f"{self.name}-peer-{addr[1]}",
+            )
+            thread.start()
+
+    def _peer_loop(self, conn: socket.socket) -> None:
+        decoder = FrameDecoder(max_frame_bytes=self._max_frame)
+        try:
+            while not self._closed.is_set():
+                try:
+                    chunk = conn.recv(_RECV_BYTES)
+                except OSError:
+                    return
+                if not chunk:
+                    return  # peer closed; buffered tear discarded
+                try:
+                    frames = decoder.feed(chunk)
+                except FramingError as exc:
+                    # The stream has no trustworthy boundary left:
+                    # nack once, then drop the peer.
+                    self.frames_rejected += 1
+                    self._observer.frame_rejected(self.name, "framing")
+                    self._log(
+                        f"{self.name}: dropping peer on framing "
+                        f"error: {exc}"
+                    )
+                    self._try_send(conn, self._ack(-1, exc))
+                    return
+                for payload in frames:
+                    self.frames_total += 1
+                    seq = payload.get("seq", -1)
+                    try:
+                        with self._ingest_lock:
+                            self._handler(payload)
+                    except WireContractError as exc:
+                        self.frames_rejected += 1
+                        self._observer.frame_rejected(
+                            self.name, "contract"
+                        )
+                        if not self._try_send(
+                            conn, self._ack(seq, exc)
+                        ):
+                            return
+                        continue
+                    if not self._try_send(conn, self._ack(seq)):
+                        return
+        finally:
+            with self._peers_lock:
+                self._peers.discard(conn)
+            self._observer.peers(self.name, self.connected_peers)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _ack(self, seq: Any, error: Exception | None = None) -> bytes:
+        payload: dict[str, Any] = {
+            "ok": error is None,
+            "seq": seq,
+            "pressure_level": int(self._pressure()),
+        }
+        if error is not None:
+            payload["error"] = str(error)
+        return encode_frame(payload)
+
+    @staticmethod
+    def _try_send(conn: socket.socket, data: bytes) -> bool:
+        try:
+            conn.sendall(data)
+            return True
+        except OSError:
+            return False
+
+    # ---- lifecycle ----------------------------------------------------
+
+    def close(self) -> None:
+        self._closed.set()
+        # shutdown() wakes a thread blocked in accept(); close() alone
+        # would leave that thread holding a kernel reference to the
+        # listening socket and the port would stay bound in LISTEN.
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._accept_thread.join(timeout=5.0)
+        with self._peers_lock:
+            peers = list(self._peers)
+        for conn in peers:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass

@@ -1,0 +1,267 @@
+"""ProcessSupervisor: ProbeSupervisor semantics over OS processes.
+
+The :class:`~tpuslo.runtime.supervisor.ProbeSupervisor` turned a quiet
+BPF probe into restart/shed decisions; the live deployment plane needs
+the same discipline one level up — whole toolkit processes (node
+agents, cluster/region aggregators, the serving front door) that can
+be killed -9 or wedge without exiting.  This supervisor reuses the
+probe supervisor's config knobs and decision shape verbatim:
+
+* **Death** — ``poll()`` says the child exited.  Restart with the
+  same argv against the same state dir; the child's own runtime
+  snapshot / spool / seq journal make the restart warm.
+* **Wedge** — the child is alive but its heartbeat artifact (a status
+  or snapshot file the process touches every cycle) has gone stale
+  past the timeout.  Kill -9, then restart: a wedged front door
+  holding its slots is worse than a restarted one resuming them.
+* **Backoff + flap shed** — exponential backoff between restarts and
+  K-in-window flap detection, exactly the probe rules: a process that
+  cannot stay up must stop eating the lane, and the shed is the
+  loudest possible evidence.
+
+Stderr of every incarnation appends to one per-process file, so the
+chaos auditor can grep the restart's "snapshot restored" line across
+kills.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from tpuslo.runtime.supervisor import (
+    ACTION_FLAP_SHED,
+    ACTION_RESTARTED,
+    SupervisorConfig,
+    SupervisorEvent,
+)
+
+
+@dataclass
+class ProcessSpec:
+    """One supervised child: argv, env, and its heartbeat artifact."""
+
+    name: str
+    cmd: list[str]
+    env: dict[str, str] | None = None
+    #: File whose mtime is the liveness beat (None = poll-only).
+    heartbeat_path: str | None = None
+    stderr_path: str | None = None
+    stdout_path: str | None = None
+    #: One-shot children (an agent with --count) exit 0 when done;
+    #: that is completion, not death — never restarted.
+    restart_on_clean_exit: bool = False
+
+
+@dataclass
+class _ChildState:
+    spec: ProcessSpec
+    proc: subprocess.Popen | None = None
+    stderr_fh: Any = None
+    stdout_fh: Any = None
+    restarts: list[float] = field(default_factory=list)
+    next_restart_at: float = 0.0
+    consecutive_failures: int = 0
+    started_at: float = 0.0
+    shed: bool = False
+    completed: bool = False
+
+
+class ProcessSupervisor:
+    """Start, watch, restart, and flap-shed a set of child processes."""
+
+    def __init__(
+        self,
+        config: SupervisorConfig | None = None,
+        clock: Callable[[], float] = time.monotonic,
+        log: Callable[[str], None] | None = None,
+    ):
+        self.config = config or SupervisorConfig()
+        self._clock = clock
+        self._log = log or (lambda msg: None)
+        self._children: dict[str, _ChildState] = {}
+        self.restarts_total = 0
+        self.flap_sheds_total = 0
+        self.events: list[SupervisorEvent] = []
+
+    # ---- lifecycle ----------------------------------------------------
+
+    def start(self, spec: ProcessSpec) -> subprocess.Popen:
+        state = self._children.get(spec.name)
+        if state is None:
+            state = _ChildState(spec=spec)
+            self._children[spec.name] = state
+        state.spec = spec
+        self._spawn(state)
+        return state.proc
+
+    def _spawn(self, state: _ChildState) -> None:
+        spec = state.spec
+        if spec.stderr_path:
+            if state.stderr_fh is None:
+                state.stderr_fh = open(
+                    spec.stderr_path, "a", encoding="utf-8"
+                )
+            stderr = state.stderr_fh
+        else:
+            stderr = subprocess.DEVNULL
+        if spec.stdout_path:
+            if state.stdout_fh is None:
+                state.stdout_fh = open(
+                    spec.stdout_path, "a", encoding="utf-8"
+                )
+            stdout = state.stdout_fh
+        else:
+            stdout = subprocess.DEVNULL
+        state.proc = subprocess.Popen(
+            spec.cmd,
+            env=spec.env,
+            stdout=stdout,
+            stderr=stderr,
+        )
+        state.started_at = self._clock()
+
+    def process(self, name: str) -> subprocess.Popen | None:
+        state = self._children.get(name)
+        return state.proc if state else None
+
+    def restart_count(self, name: str) -> int:
+        state = self._children.get(name)
+        return len(state.restarts) if state else 0
+
+    def is_shed(self, name: str) -> bool:
+        state = self._children.get(name)
+        return bool(state and state.shed)
+
+    # ---- supervision --------------------------------------------------
+
+    def _heartbeat_age_s(self, state: _ChildState) -> float:
+        path = state.spec.heartbeat_path
+        if not path:
+            return 0.0
+        try:
+            mtime = os.stat(path).st_mtime
+        except OSError:
+            # No artifact yet: age from process start (startup grace
+            # is the same heartbeat timeout).
+            return self._clock() - state.started_at
+        return max(0.0, time.time() - mtime)
+
+    def evaluate(self) -> list[SupervisorEvent]:
+        """One supervision pass over every child; same decision shape
+        as :meth:`ProbeSupervisor.evaluate`."""
+        now = self._clock()
+        events: list[SupervisorEvent] = []
+        for name, state in self._children.items():
+            if state.shed or state.completed or state.proc is None:
+                continue
+            exited = state.proc.poll()
+            if exited is None:
+                if self._heartbeat_age_s(state) <= (
+                    self.config.heartbeat_timeout_s
+                ):
+                    continue
+                # Wedged: alive but silent past the timeout.
+                self._log(
+                    f"supervisor: {name} heartbeat stale; kill -9"
+                )
+                try:
+                    state.proc.send_signal(signal.SIGKILL)
+                    state.proc.wait(timeout=30)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
+            elif exited == 0 and not state.spec.restart_on_clean_exit:
+                state.completed = True
+                continue
+            if now < state.next_restart_at:
+                continue
+            window_start = now - self.config.flap_window_s
+            state.restarts = [
+                at for at in state.restarts if at >= window_start
+            ]
+            if len(state.restarts) >= self.config.flap_restarts:
+                state.shed = True
+                self.flap_sheds_total += 1
+                event = SupervisorEvent(
+                    name,
+                    ACTION_FLAP_SHED,
+                    f"{len(state.restarts)} restarts in "
+                    f"{self.config.flap_window_s:.0f}s",
+                )
+                self._log(f"supervisor: flap-shed process {name}")
+                events.append(event)
+                continue
+            state.restarts.append(now)
+            self.restarts_total += 1
+            backoff = min(
+                self.config.restart_backoff_cap_s,
+                self.config.restart_backoff_base_s
+                * (2 ** state.consecutive_failures),
+            )
+            state.next_restart_at = now + backoff
+            try:
+                self._spawn(state)
+            except OSError as exc:
+                state.consecutive_failures += 1
+                self._log(
+                    f"supervisor: restart of {name} failed: {exc}"
+                )
+                continue
+            state.consecutive_failures = 0
+            self._log(f"supervisor: restarted dead process {name}")
+            events.append(SupervisorEvent(name, ACTION_RESTARTED))
+        self.events.extend(events)
+        return events
+
+    def watch(
+        self, poll_interval_s: float = 0.2, until: Callable[[], bool] | None = None,
+        timeout_s: float = 0.0,
+    ) -> None:
+        """Run evaluate() on a cadence until ``until()`` or timeout."""
+        deadline = (
+            self._clock() + timeout_s if timeout_s > 0 else float("inf")
+        )
+        while self._clock() < deadline:
+            if until is not None and until():
+                return
+            self.evaluate()
+            time.sleep(poll_interval_s)
+
+    # ---- teardown -----------------------------------------------------
+
+    def stop_all(self, sig: int = signal.SIGTERM, wait_s: float = 10.0) -> None:
+        for state in self._children.values():
+            proc = state.proc
+            if proc is None or proc.poll() is not None:
+                continue
+            try:
+                proc.send_signal(sig)
+            except OSError:
+                continue
+        deadline = time.monotonic() + wait_s
+        for state in self._children.values():
+            proc = state.proc
+            if proc is None:
+                continue
+            remaining = max(0.1, deadline - time.monotonic())
+            try:
+                proc.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass
+        for state in self._children.values():
+            for attr in ("stderr_fh", "stdout_fh"):
+                fh = getattr(state, attr)
+                if fh is not None:
+                    try:
+                        fh.close()
+                    except OSError:
+                        pass
+                    setattr(state, attr, None)

@@ -534,6 +534,45 @@ class AgentMetrics:
             registry=self.registry,
         )
 
+        # ---- live deployment plane (tpuslo.livenet) --------------------
+        # The socket transport's health surface: a partition shows up
+        # here first — connected_peers drops, reconnects and spool
+        # replays climb on heal (docs/runbooks/live-deployment.md).
+        self.livenet_connected_peers = Gauge(
+            "llm_slo_livenet_connected_peers",
+            "Open peer connections on a live listener, by listener",
+            ["listener"],
+            registry=self.registry,
+        )
+        self.livenet_reconnects = Counter(
+            "llm_slo_livenet_reconnects_total",
+            "Upstream socket reconnections by a sending client, "
+            "by peer",
+            ["peer"],
+            registry=self.registry,
+        )
+        self.livenet_spool_replayed = Counter(
+            "llm_slo_livenet_spool_replayed_frames_total",
+            "Spooled frames redelivered upstream after an outage, "
+            "by peer",
+            ["peer"],
+            registry=self.registry,
+        )
+        self.livenet_pressure_level = Gauge(
+            "llm_slo_livenet_upstream_pressure_level",
+            "Latest ack-carried upstream pressure level (0-3) seen "
+            "by a sending client, by peer",
+            ["peer"],
+            registry=self.registry,
+        )
+        self.livenet_frames_rejected = Counter(
+            "llm_slo_livenet_frames_rejected_total",
+            "Inbound frames refused by a live listener, by listener "
+            "and reason (framing/contract)",
+            ["listener", "reason"],
+            registry=self.registry,
+        )
+
     def set_enabled_signals(self, enabled: list[str]) -> None:
         enabled_set = set(enabled)
         for signal in ALL_SIGNALS:
@@ -660,6 +699,12 @@ class AgentMetrics:
         tpuslo.models.frontdoor.FrontDoorObserver); ``engine`` labels
         the replica under an SLORouter fleet."""
         return _PromFrontDoorObserver(self, engine)
+
+    def livenet_observer(self) -> "_PromLivenetObserver":
+        """Observer adapter wiring live listeners and reconnecting
+        clients to this registry (duck-typed against
+        tpuslo.livenet.LivenetObserver)."""
+        return _PromLivenetObserver(self)
 
 
 _BREAKER_STATE_VALUES = {"closed": 0, "half_open": 1, "open": 2}
@@ -1062,6 +1107,34 @@ class _PromFrontDoorObserver:
         self._m.frontdoor_completed_tokens.labels(
             engine=self._engine, tenant=tenant
         ).inc(tokens)
+
+
+class _PromLivenetObserver:
+    """Bridge from livenet listener/client callbacks to Prometheus
+    (the LivenetObserver contract: peers/frame_rejected/reconnected/
+    spool_replayed/pressure_level)."""
+
+    def __init__(self, metrics: AgentMetrics):
+        self._m = metrics
+
+    def peers(self, listener: str, connected: int) -> None:
+        self._m.livenet_connected_peers.labels(
+            listener=listener
+        ).set(connected)
+
+    def frame_rejected(self, listener: str, reason: str) -> None:
+        self._m.livenet_frames_rejected.labels(
+            listener=listener, reason=reason
+        ).inc()
+
+    def reconnected(self, peer: str) -> None:
+        self._m.livenet_reconnects.labels(peer=peer).inc()
+
+    def spool_replayed(self, peer: str, frames: int) -> None:
+        self._m.livenet_spool_replayed.labels(peer=peer).inc(frames)
+
+    def pressure_level(self, peer: str, level: int) -> None:
+        self._m.livenet_pressure_level.labels(peer=peer).set(level)
 
 
 class Readiness:
