@@ -14,6 +14,7 @@ ARTIFACTS ?= artifacts
 	live-chaos-smoke live-chaos-sweep obs-smoke \
 	burn-smoke burn-sweep fleet-smoke fleet-sweep \
 	federation-smoke federation-sweep \
+	global-smoke global-sweep \
 	remediation-smoke remediation-sweep \
 	frontdoor-smoke frontdoor-bench \
 	router-smoke router-bench \
@@ -350,6 +351,25 @@ federation-sweep:
 		--summary-json $(ARTIFACTS)/federation/sweep.json \
 		--summary-md $(ARTIFACTS)/federation/sweep.md
 
+# Global-tier smoke: gap-tolerant cursor, global wire round trips,
+# cross-region rollup identity, partition-aware emission + registry
+# merge, WAN link/proxy chaos, and the fleetagg/sloctl global CLIs —
+# seconds, runs in m5-gate.
+global-smoke:
+	$(PY) -m pytest tests/test_global_tier.py -q -m 'not slow'
+
+# Full global-tier release gate: the WAN-chaos lanes (cross-region
+# identity under latency + one-way ack loss, the hour-dark rejoin
+# with zero lost/dup pages and bounded replay, the split-brain
+# registry-merge heal) plus the 100k-node (10 regions x 10k) ingest
+# floor through the three-tier fold
+# (see docs/runbooks/multi-region.md).
+global-sweep:
+	mkdir -p $(ARTIFACTS)/global
+	$(PY) -m tpuslo m5gate --global-sweep \
+		--summary-json $(ARTIFACTS)/global/sweep.json \
+		--summary-md $(ARTIFACTS)/global/sweep.md
+
 # Full crash-sweep release gate: seeds x kill points of SIGKILL/restart
 # audits (see docs/evidence/crash-sweep.md + docs/runbooks/crash-recovery.md).
 crash-sweep:
@@ -403,6 +423,7 @@ m5-candidate:
 m5-gate: lint racecheck-smoke jitcheck-smoke burn-smoke burn-sweep \
 		bench-columnar-smoke fleet-smoke fleet-sweep \
 		federation-smoke federation-sweep \
+		global-smoke global-sweep \
 		remediation-smoke remediation-sweep \
 		frontdoor-smoke frontdoor-bench \
 		router-smoke router-bench \

@@ -421,6 +421,11 @@ def bench_fleet(
 FEDERATION_INGEST_EVENTS_PER_SEC_FLOOR = 5_000_000
 FEDERATION_STALENESS_MS_CEILING = 30_000.0
 FEDERATION_GATE_MIN_NODES = 2000
+#: Global-tier floors: the three-tier fold must hold the same 5M
+#: events/s aggregate at bench scale (gated once the run covers at
+#: least this many total nodes across regions).
+GLOBAL_INGEST_EVENTS_PER_SEC_FLOOR = 5_000_000
+GLOBAL_GATE_MIN_NODES = 10_000
 
 
 def bench_federation(
@@ -501,6 +506,118 @@ def bench_federation(
             f"{FEDERATION_INGEST_EVENTS_PER_SEC_FLOOR:,}), staleness "
             f"{run.max_staleness_ms:.0f} ms (ceiling "
             f"{FEDERATION_STALENESS_MS_CEILING:,.0f})"
+        )
+    return result
+
+
+def bench_global(
+    regions: int = 4,
+    nodes_per_region: int = 2500,
+    clusters_per_region: int = 2,
+    shards_per_cluster: int = 2,
+    events_per_node: int = 600,
+) -> dict:
+    """Global tier: three-tier aggregate ingest + dark-region identity.
+
+    Throughput lane: ``measure_global_ingest`` at bench scale — total
+    events over the slowest region's busy time, global fold included
+    (the full 100k run belongs to ``m5gate --global-sweep``).
+    Identity lane at a fixed small topology (the dark/heal dynamics
+    are scale-free): one region dark for 20 rounds vs its no-chaos
+    baseline; the rejoin replay must lose and duplicate ZERO pages.
+    Both lanes hard-gate.
+    """
+    from tpuslo.chaos.wan import WAN_DARK, WAN_HEAL, WanEvent
+    from tpuslo.federation.simulator import (
+        GlobalSimulator,
+        global_injection_plan,
+        measure_global_ingest,
+    )
+    from tpuslo.federation.sweep import _global_keys
+
+    m = measure_global_ingest(
+        regions=regions,
+        nodes_per_region=nodes_per_region,
+        clusters_per_region=clusters_per_region,
+        shards_per_cluster=shards_per_cluster,
+        events_per_node=events_per_node,
+    )
+
+    dark_at, dark_rounds = 6, 20
+    dark_region = "region-2"
+
+    def _sim() -> "GlobalSimulator":
+        return GlobalSimulator(
+            regions=3,
+            nodes_per_region=48,
+            clusters_per_region=2,
+            shards_per_cluster=2,
+            seed=1337,
+            replay_budget=4,
+        )
+
+    base_sim = _sim()
+    plan = global_injection_plan(
+        base_sim.topology,
+        base_sim.region_ids,
+        dark_region=dark_region,
+        dark_round=dark_at,
+    )
+    rounds = dark_at + dark_rounds + 12
+    baseline = base_sim.run(rounds, plan)
+    dark_sim = _sim()
+    dark_run = dark_sim.run(
+        rounds,
+        plan,
+        wan_events=[
+            WanEvent(dark_at, dark_region, WAN_DARK),
+            WanEvent(dark_at + dark_rounds, dark_region, WAN_HEAL),
+        ],
+    )
+    before = _global_keys(baseline.incidents)
+    after = _global_keys(dark_run.incidents)
+    lost = sorted(set(before) - set(after))
+    duplicated = sorted(
+        k for k in set(after) if after.count(k) > before.count(k)
+    )
+    heal = dark_run.heal_stats.get(dark_region, {})
+    result = {
+        "global_nodes": m.nodes,
+        "global_regions": m.regions,
+        "global_shards": m.shards,
+        "global_total_events": m.total_events,
+        "global_ingest_events_per_sec": round(m.events_per_sec, 1),
+        "global_fold_ms": round(m.global_fold_ms, 2),
+        "global_slowest_region": m.slowest_region,
+        "global_dark_backlog_at_heal": int(
+            heal.get("backlog_at_heal", 0)
+        ),
+        "global_dark_replay_rounds": int(
+            heal.get("replay_rounds", -1)
+        ),
+        "global_dark_lost_pages": len(lost),
+        "global_dark_duplicated_pages": len(duplicated),
+        "global_ingest_floor": GLOBAL_INGEST_EVENTS_PER_SEC_FLOOR,
+        "global_gates_met": bool(
+            not lost
+            and not duplicated
+            and m.events_per_sec >= GLOBAL_INGEST_EVENTS_PER_SEC_FLOOR
+        ),
+    }
+    if lost or duplicated:
+        raise SystemExit(
+            f"bench_global: dark-region rejoin lost {len(lost)} / "
+            f"duplicated {len(duplicated)} page(s) — the zero-loss "
+            "WAN invariant is broken"
+        )
+    if (
+        regions * nodes_per_region >= GLOBAL_GATE_MIN_NODES
+        and m.events_per_sec < GLOBAL_INGEST_EVENTS_PER_SEC_FLOOR
+    ):
+        raise SystemExit(
+            f"bench_global: {m.events_per_sec:,.0f} events/s below "
+            f"the {GLOBAL_INGEST_EVENTS_PER_SEC_FLOOR:,} floor "
+            f"through the three-tier fold at {m.nodes} nodes"
         )
     return result
 
@@ -1651,6 +1768,24 @@ def _digest_pipeline(pipeline: dict) -> dict:
         else {}
     ) | (
         {
+            "global_ingest_events_per_sec": round(
+                glob.get("global_ingest_events_per_sec", 0.0), 1
+            ),
+            "global_fold_ms": round(
+                glob.get("global_fold_ms", 0.0), 2
+            ),
+            "global_dark_lost_pages": glob.get(
+                "global_dark_lost_pages"
+            ),
+            "global_dark_duplicated_pages": glob.get(
+                "global_dark_duplicated_pages"
+            ),
+            "global_gates_met": bool(glob.get("global_gates_met")),
+        }
+        if (glob := pipeline.get("global") or {})
+        else {}
+    ) | (
+        {
             "remediation_time_to_mitigate_p50_s": rem.get(
                 "remediation_time_to_mitigate_p50_s", 0.0
             ),
@@ -1890,6 +2025,10 @@ def main() -> int:
     # Federation plane (ISSUE 15): two-level tree aggregate ingest +
     # region-page staleness under churn, hard floors at bench scale.
     pipeline_result["federation"] = bench_federation()
+    # Global tier (ISSUE 18): three-tier aggregate ingest + the
+    # dark-region rejoin identity lane, hard-gated at zero lost/dup
+    # pages and the 5M events/s floor through the full fold.
+    pipeline_result["global"] = bench_global()
     # Auto-remediation loop (ISSUE 11): time-to-mitigate distribution
     # + false-action rate, hard-gated at precision 1.0.
     pipeline_result["remediation"] = bench_remediation()

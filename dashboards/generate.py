@@ -363,6 +363,19 @@ fleet_overview = dashboard(
             ('histogram_quantile(0.50, sum(rate(llm_slo_fleet_federation_incident_staleness_ms_bucket[5m])) by (le))', "staleness p50"),
             ('histogram_quantile(0.99, sum(rate(llm_slo_fleet_federation_incident_staleness_ms_bucket[5m])) by (le))', "staleness p99"),
         ], 18, 32, w=6, unit="ms"),
+        # --- global tier (tpuslo.federation.global_tier) -------------
+        panel("Global ingest (fleet pages/s, by region)", [
+            ('sum(rate(llm_slo_global_region_ingested_incidents_total[5m])) by (region)', "{{region}}"),
+        ], 0, 40),
+        panel("Global pages (1h, by scope — partition_scoped means a peer may hold the rest)", [
+            ('sum(increase(llm_slo_global_pages_total[1h])) by (scope)', "{{scope}}"),
+        ], 12, 40),
+        panel("Region reachability (0 = partitioned/dark)", [
+            ('llm_slo_global_region_reachable', "{{region}}"),
+        ], 0, 48),
+        panel("Duplicates absorbed (1h, by reason — seq_replay: WAN; emitted_window: peer heal)", [
+            ('sum(increase(llm_slo_global_duplicates_suppressed_total[1h])) by (reason)', "{{reason}}"),
+        ], 12, 48),
     ],
 )
 

@@ -1,0 +1,919 @@
+"""Global tier: multi-region peering with partition-tolerant incident
+identity under WAN chaos.
+
+The load-bearing invariants get direct coverage: the gap-tolerant
+cursor accepts every seq exactly once at ANY arrival order (the
+bounded replay budget makes out-of-order arrival the normal case, not
+the exception); a partition can scope pages but never wedge the
+healthy side's session closes; and two peers that paged the same
+fault from opposite sides of a partition reconcile by emitted-window
+registry merge — suppress, never re-page.  The live lane proves the
+asymmetric-failure shape end to end: a one-way WAN partition where
+frames arrive but acks vanish, so the sender replays envelopes the
+receiver already holds and the seq dedup absorbs the storm.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from tpuslo.chaos.wan import (
+    DIR_BACKWARD,
+    DIR_FORWARD,
+    WAN_ACK_LOSS,
+    WAN_DARK,
+    WAN_HEAL,
+    WAN_LATENCY,
+    WanEvent,
+    WanLink,
+    WanProxy,
+)
+from tpuslo.federation.backpressure import LEVEL_SAMPLE
+from tpuslo.federation.global_tier import (
+    BLAST_GLOBAL,
+    PAGE_SCOPE_MULTI,
+    PAGE_SCOPE_PARTITION,
+    PAGE_SCOPE_SINGLE,
+    GapTolerantCursor,
+    GlobalAggregator,
+    GlobalIncident,
+    GlobalRollup,
+    classify_global_radius,
+)
+from tpuslo.federation.simulator import (
+    GlobalSimulator,
+    global_injection_plan,
+    measure_global_ingest,
+)
+from tpuslo.federation.sweep import (
+    run_global_sweep,
+    score_global_incidents,
+)
+from tpuslo.federation.wire import (
+    GLOBAL_WIRE_VERSION,
+    GlobalWireError,
+    decode_global_envelope,
+    encode_global_envelope,
+    global_envelope_json_line,
+    parse_global_envelope_line,
+)
+from tpuslo.fleet.rollup import FleetIncident
+from tpuslo.fleet.simulator import EPOCH_NS
+from tpuslo.fleet.wire import (
+    WireContractError,
+    decode_shipment,
+    encode_shipment,
+)
+from tpuslo.livenet import LiveListener, ReconnectingClient
+
+GAP = 5_000_000_000
+
+
+def _fleet(
+    rid: str,
+    namespace: str = "tenant-a",
+    domain: str = "tpu_hbm",
+    start: int = EPOCH_NS,
+    end: int = EPOCH_NS + GAP,
+    confidence: float = 0.9,
+    blast_radius: str = "fleet",
+) -> FleetIncident:
+    return FleetIncident(
+        incident_id=f"fleet-{rid}-{domain}-{start}",
+        namespace=namespace,
+        domain=domain,
+        blast_radius=blast_radius,
+        window_start_ns=start,
+        window_end_ns=end,
+        confidence=confidence,
+        nodes=[f"{rid}-node-0"],
+        slices=[f"{rid}-slice-0"],
+        members=[],
+        region=rid,
+        clusters=["cluster-0"],
+    )
+
+
+def _env(
+    rid: str,
+    seq: int,
+    incidents: list[FleetIncident] | None = None,
+    clock: int = EPOCH_NS,
+) -> dict:
+    return encode_global_envelope(
+        region=rid,
+        seq=seq,
+        incidents=incidents or [],
+        watermark_ns=clock,
+        head_ns=clock,
+    )
+
+
+def _keys(incidents: list[GlobalIncident]) -> list[str]:
+    return sorted(
+        f"{gi.namespace}/{gi.domain}/{gi.blast_radius}"
+        for gi in incidents
+    )
+
+
+class TestGlobalWire:
+    def test_round_trip(self):
+        fi = _fleet("region-0")
+        payload = encode_global_envelope(
+            "region-0", 3, [fi],
+            watermark_ns=EPOCH_NS, head_ns=EPOCH_NS + 1,
+            pressure_level=2,
+        )
+        env = decode_global_envelope(payload)
+        assert env.region == "region-0"
+        assert env.seq == 3
+        assert env.watermark_ns == EPOCH_NS
+        assert env.head_ns == EPOCH_NS + 1
+        assert env.pressure_level == 2
+        assert [i.to_dict() for i in env.incidents] == [fi.to_dict()]
+
+    def test_jsonl_round_trip(self):
+        payload = _env("region-1", 0, [_fleet("region-1")])
+        env = parse_global_envelope_line(
+            global_envelope_json_line(payload)
+        )
+        assert env.region == "region-1"
+        assert len(env.incidents) == 1
+
+    def test_version_mismatch_refused(self):
+        payload = _env("region-0", 0)
+        payload["global_wire_version"] = GLOBAL_WIRE_VERSION + 1
+        with pytest.raises(GlobalWireError, match="global wire version"):
+            decode_global_envelope(payload)
+
+    def test_missing_region_refused(self):
+        payload = _env("region-0", 0)
+        payload["region"] = ""
+        with pytest.raises(GlobalWireError, match="region identity"):
+            decode_global_envelope(payload)
+
+    def test_bad_incident_entry_refused(self):
+        payload = _env("region-0", 0)
+        payload["incidents"] = ["not a dict"]
+        with pytest.raises(GlobalWireError, match="bad incident"):
+            decode_global_envelope(payload)
+
+
+class TestGapTolerantCursor:
+    def test_in_order_advances_watermark(self):
+        cursor = GapTolerantCursor()
+        assert [cursor.accept(i) for i in range(4)] == [True] * 4
+        assert cursor.watermark == 3
+        assert not cursor.accepted
+        assert not cursor.accept(2)
+
+    def test_out_of_order_exactly_once(self):
+        # The replay-budget arrival shape: fresh seqs overtake backlog.
+        cursor = GapTolerantCursor()
+        order = [0, 3, 1, 4, 2, 5]
+        assert [cursor.accept(s) for s in order] == [True] * 6
+        assert cursor.watermark == 5
+        assert not cursor.accepted  # gaps filled, set compacted
+        assert [cursor.accept(s) for s in order] == [False] * 6
+
+    def test_sparse_set_bounded_by_gaps(self):
+        cursor = GapTolerantCursor()
+        cursor.accept(0)
+        cursor.accept(5)
+        cursor.accept(7)
+        assert cursor.watermark == 0
+        assert cursor.accepted == {5, 7}
+
+    def test_export_restore_mid_gap(self):
+        cursor = GapTolerantCursor()
+        cursor.accept(0)
+        cursor.accept(2)
+        restored = GapTolerantCursor()
+        restored.restore_state(cursor.export_state())
+        assert not restored.accept(2)  # still a duplicate
+        assert restored.accept(1)  # gap fills, watermark compacts
+        assert restored.watermark == 2
+
+
+class TestGlobalRollup:
+    def test_cross_region_fault_pages_once_at_global_radius(self):
+        rollup = GlobalRollup(gap_ns=GAP)
+        rollup.observe(
+            [
+                _fleet("region-0"),
+                _fleet("region-1", start=EPOCH_NS + GAP // 2),
+            ]
+        )
+        pages = rollup.flush()
+        assert len(pages) == 1
+        page = pages[0]
+        assert page.blast_radius == BLAST_GLOBAL
+        assert page.regions == ["region-0", "region-1"]
+        assert len(page.members) == 2
+        assert page.scope == PAGE_SCOPE_MULTI
+        # Members carry the drill-down identity, not node evidence.
+        assert {m["region"] for m in page.members} == {
+            "region-0",
+            "region-1",
+        }
+
+    def test_distinct_tenants_never_merge(self):
+        rollup = GlobalRollup(gap_ns=GAP)
+        rollup.observe(
+            [
+                _fleet("region-0", namespace="tenant-a"),
+                _fleet("region-1", namespace="tenant-b"),
+            ]
+        )
+        pages = rollup.flush()
+        assert len(pages) == 2
+        assert {p.namespace for p in pages} == {"tenant-a", "tenant-b"}
+        assert all(p.blast_radius == "fleet" for p in pages)
+
+    def test_single_region_page_keeps_member_radius(self):
+        assert (
+            classify_global_radius(
+                [_fleet("region-0", blast_radius="slice")]
+            )
+            == "slice"
+        )
+        pages_scope = GlobalRollup(gap_ns=GAP)
+        pages_scope.observe([_fleet("region-0")])
+        page = pages_scope.flush()[0]
+        assert page.scope == PAGE_SCOPE_SINGLE
+
+    def test_emitted_window_suppresses_replayed_session(self):
+        rollup = GlobalRollup(gap_ns=GAP)
+        rollup.observe([_fleet("region-0")])
+        assert len(rollup.flush()) == 1
+        # Spool redelivery rebuilds the same session: suppressed.
+        rollup.observe([_fleet("region-0")])
+        assert rollup.flush() == []
+        assert rollup.duplicates_suppressed == 1
+
+
+class TestGlobalAggregator:
+    def _agg(self, **overrides) -> GlobalAggregator:
+        kwargs = dict(
+            rollup_gap_ns=GAP, region_stale_after_ns=3 * GAP
+        )
+        kwargs.update(overrides)
+        return GlobalAggregator(**kwargs)
+
+    def test_gap_tolerant_seq_dedup(self):
+        agg = self._agg()
+        assert agg.ingest(_env("region-0", 0))
+        assert agg.ingest(_env("region-0", 2))  # overtook seq 1
+        assert not agg.ingest(_env("region-0", 2))  # WAN replay
+        assert agg.ingest(_env("region-0", 1))  # backlog arrives late
+        assert not agg.ingest(_env("region-0", 0))
+        assert agg.duplicate_envelopes == 2
+        state = agg.regions["region-0"]
+        assert state.cursor.watermark == 2
+
+    def test_partition_scopes_pages_without_wedging_session_close(self):
+        agg = self._agg()
+        rids = ["region-0", "region-1", "region-2"]
+        for rid in rids:
+            agg.ingest(_env(rid, 0, [], EPOCH_NS))
+        # region-2 goes dark; the others keep shipping.  The fault on
+        # region-0 must still page once region-2 ages out of the min.
+        fault = _fleet("region-0", start=EPOCH_NS + GAP)
+        for tick in range(1, 7):
+            clock = EPOCH_NS + (1 + tick) * GAP
+            agg.ingest(
+                _env("region-0", tick, [fault] if tick == 1 else [], clock)
+            )
+            agg.ingest(_env("region-1", tick, [], clock))
+        assert agg.unreachable_regions() == ("region-2",)
+        # The session clock is the min over REACHABLE regions only.
+        assert agg.watermark_ns() == EPOCH_NS + 7 * GAP
+        pages = agg.pump()
+        assert len(pages) == 1
+        assert pages[0].partition_scoped
+        assert pages[0].unreachable_regions == ["region-2"]
+        assert pages[0].scope == PAGE_SCOPE_PARTITION
+
+    def test_export_restore_preserves_dedup(self):
+        agg = self._agg()
+        agg.ingest(_env("region-0", 0, [_fleet("region-0")]))
+        agg.ingest(_env("region-0", 2))
+        restored = self._agg()
+        restored.restore_state(agg.export_state())
+        assert not restored.ingest(_env("region-0", 2))
+        assert restored.ingest(_env("region-0", 1))
+        assert restored.regions["region-0"].cursor.watermark == 2
+        # The open session survived the failover too.
+        assert restored.backlog_incidents() >= 1
+
+    def test_merge_peer_suppresses_replayed_page(self):
+        # Peer B paged a fault this side never saw (the partition cut
+        # its region off).  After the heal handshake, B's registry
+        # window must suppress the replayed session here.
+        peer_b = self._agg(global_id="global-b")
+        peer_b.ingest(_env("region-2", 0, [_fleet("region-2")]))
+        assert len(peer_b.pump(flush=True)) == 1
+        peer_a = self._agg(global_id="global-a")
+        merged = peer_a.merge_peer(peer_b.export_state())
+        assert merged == 1
+        # r2's spool replays the same envelope into A post-heal.
+        assert peer_a.ingest(_env("region-2", 0, [_fleet("region-2")]))
+        assert peer_a.pump(flush=True) == []
+        assert peer_a.rollup.duplicates_suppressed == 1
+
+    def test_merge_peer_without_registry_is_noop(self):
+        peer_a = self._agg()
+        assert peer_a.merge_peer({}) == 0
+
+
+class TestWanLink:
+    def _spool(self, n: int) -> list[dict]:
+        return [{"seq": i} for i in range(n)]
+
+    def test_bounded_replay_plus_fresh_overtake(self):
+        link = WanLink("region-0", replay_budget=3)
+        picked = link.select_for_send(self._spool(10))
+        assert [p["seq"] for p in picked] == [0, 1, 2, 9]
+
+    def test_zero_budget_is_strict_oldest_first(self):
+        link = WanLink("region-0", replay_budget=0)
+        picked = link.select_for_send(self._spool(4))
+        assert [p["seq"] for p in picked] == [0, 1, 2, 3]
+
+    def test_acked_envelopes_skip_the_wire(self):
+        link = WanLink("region-0", replay_budget=2)
+        link.on_ack(0)
+        link.on_ack(1)
+        picked = link.select_for_send(self._spool(5))
+        assert [p["seq"] for p in picked] == [2, 3, 4]
+
+    def test_ack_watermark_compacts_contiguously(self):
+        link = WanLink("region-0")
+        link.on_ack(2)
+        assert link.ack_watermark == -1  # gap below: no trim yet
+        link.on_ack(0)
+        link.on_ack(1)
+        assert link.ack_watermark == 2
+        assert link.acked(2) and not link.acked(3)
+
+    def test_backward_down_loses_acks(self):
+        link = WanLink("region-0")
+        link.apply(WanEvent(0, "region-0", WAN_ACK_LOSS))
+        link.on_ack(0)
+        assert link.lost_acks == 1
+        assert not link.acked(0)  # the envelope stays spooled
+
+    def test_dark_drops_frames_and_in_flight(self):
+        link = WanLink("region-0", latency_rounds=2)
+        link.offer(0, [{"seq": 0}])
+        assert link.in_flight_seqs() == {0}
+        link.apply(WanEvent(1, "region-0", WAN_DARK))
+        assert link.in_flight_seqs() == set()  # hard cut loses it
+        link.offer(1, [{"seq": 1}])
+        assert link.dropped_frames == 1
+        link.apply(WanEvent(2, "region-0", WAN_HEAL))
+        link.offer(2, [{"seq": 1}])
+        assert link.due(3) == []  # still in flight (latency)
+        assert [p["seq"] for p in link.due(4)] == [1]
+
+    def test_latency_event_reshapes_the_link(self):
+        link = WanLink("region-0")
+        link.apply(
+            WanEvent(0, "region-0", WAN_LATENCY, latency_rounds=3)
+        )
+        link.offer(0, [{"seq": 0}])
+        assert link.due(2) == []
+        assert [p["seq"] for p in link.due(3)] == [0]
+
+    def test_unknown_action_refused(self):
+        link = WanLink("region-0")
+        with pytest.raises(ValueError, match="unknown wan action"):
+            link.apply(WanEvent(0, "region-0", "flood"))
+
+
+def _free_port() -> int:
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    return port
+
+
+def _wait_until(cond, timeout_s: float = 5.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.01)
+    raise AssertionError("condition not met within timeout")
+
+
+class TestReplayBudgetInterleaving:
+    def test_fresh_seqs_overtake_backlog_under_budget(self, tmp_path):
+        """The satellite-1 regression: with a replay budget set, each
+        send round replays at most that many spooled frames and the
+        FRESH frame still goes out live — so the receiver sees seqs
+        interleaved out of order, and only a gap-tolerant cursor can
+        absorb the stream exactly once."""
+        port = _free_port()
+        received = []
+        client = ReconnectingClient(
+            ("127.0.0.1", port),
+            tmp_path / "spool",
+            timeout_s=0.5,
+            replay_budget=1,
+        )
+        try:
+            for seq in range(3):  # upstream down: all three spool
+                assert client.send({"seq": seq}) is False
+            assert client.pending_spooled() == 3
+            listener = LiveListener(
+                received.append, port=port, pressure=lambda: 0
+            )
+            try:
+                for seq in (3, 4, 5):
+                    assert client.send({"seq": seq}) is True
+                # One backlog frame per round, fresh overtaking.
+                assert [p["seq"] for p in received] == [
+                    0, 3, 1, 4, 2, 5,
+                ]
+                assert client.replayed_frames == 3
+                assert client.pending_spooled() == 0
+                # The strict high-water-mark dedup of the lower hops
+                # would eat seqs 1 and 2 as stale; the global tier's
+                # cursor accepts every seq exactly once.
+                cursor = GapTolerantCursor()
+                assert [
+                    cursor.accept(p["seq"]) for p in received
+                ] == [True] * 6
+                assert cursor.watermark == 5
+            finally:
+                listener.close()
+        finally:
+            client.close()
+
+
+class TestWanProxyOneWayPartition:
+    def test_acks_vanish_frames_arrive_then_replay_dedups(
+        self, tmp_path
+    ):
+        """The defining asymmetric failure: the backward path drops
+        acks while frames still arrive, so the sender spools a frame
+        the receiver already holds and replays it after the heal —
+        the receiver's gap-tolerant dedup absorbs the duplicate."""
+        received = []
+        listener = LiveListener(received.append, pressure=lambda: 0)
+        proxy = WanProxy((listener.host, listener.port))
+        client = ReconnectingClient(
+            (proxy.host, proxy.port),
+            tmp_path / "spool",
+            timeout_s=0.5,
+            replay_budget=4,
+        )
+        try:
+            assert client.send({"seq": 0}) is True
+            # One-way partition: connections stay UP (neither side
+            # agrees the link is dead), only acks vanish.
+            proxy.partition(DIR_BACKWARD)
+            assert client.send({"seq": 1}) is False  # no ack: spooled
+            _wait_until(lambda: len(received) == 2)
+            assert [p["seq"] for p in received] == [0, 1]
+            assert client.pending_spooled() == 1
+            _wait_until(
+                lambda: proxy.dropped_bytes[DIR_BACKWARD] > 0
+            )
+            assert proxy.forwarded_bytes[DIR_FORWARD] > 0
+            proxy.heal(DIR_BACKWARD)
+            # The next send replays the spooled frame — a duplicate
+            # the receiver already holds — then the fresh one.
+            assert client.send({"seq": 2}) is True
+            assert [p["seq"] for p in received] == [0, 1, 1, 2]
+            assert client.replayed_frames == 1
+            assert client.pending_spooled() == 0
+            cursor = GapTolerantCursor()
+            accepted = [cursor.accept(p["seq"]) for p in received]
+            assert accepted == [True, True, False, True]
+            assert cursor.watermark == 2
+        finally:
+            client.close()
+            proxy.close()
+            listener.close()
+
+
+def _small_sim(**overrides) -> GlobalSimulator:
+    kwargs = dict(
+        regions=2,
+        nodes_per_region=48,
+        clusters_per_region=2,
+        shards_per_cluster=2,
+        seed=1337,
+    )
+    kwargs.update(overrides)
+    return GlobalSimulator(**kwargs)
+
+
+class TestGlobalSimulator:
+    def test_baseline_identity_exact(self):
+        sim = _small_sim()
+        plan = global_injection_plan(sim.topology, sim.region_ids)
+        run = sim.run(16, plan)
+        matches, precision, recall = score_global_incidents(
+            plan, run.incidents
+        )
+        assert precision == 1.0 and recall == 1.0
+        cross = next(
+            m for m in matches if m.expected_blast_radius == BLAST_GLOBAL
+        )
+        assert cross.matched_count == 1
+        assert cross.matched_regions == ["region-0", "region-1"]
+
+    def test_rank_stability_under_wan_degradation(self):
+        """Region-tier attribution must not reshuffle just because
+        the WAN between region and global degraded: link latency and
+        an ack-loss window (the sender replays envelopes the receiver
+        already holds) may delay pages, but the seq dedup means the
+        fold sees each fleet page exactly once — so every confidence,
+        and therefore the incident ranking, is bit-identical to the
+        healthy-WAN baseline."""
+        base_sim = _small_sim()
+        plan = global_injection_plan(
+            base_sim.topology, base_sim.region_ids
+        )
+        baseline = base_sim.run(16, plan)
+        degraded_sim = _small_sim(wan_latency_rounds=1)
+        degraded = degraded_sim.run(
+            16,
+            plan,
+            wan_events=[
+                WanEvent(4, "region-1", WAN_ACK_LOSS),
+                WanEvent(8, "region-1", WAN_HEAL),
+            ],
+        )
+        # Preconditions: the replay storm actually happened, and the
+        # plane stayed at/below the adaptive-sampling tier.
+        assert degraded.global_snapshot["duplicate_envelopes"] > 0
+        assert (
+            degraded.global_snapshot["pressure_level"] <= LEVEL_SAMPLE
+        )
+
+        def _ranked(incidents):
+            return [
+                (gi.namespace, gi.domain, round(gi.confidence, 4))
+                for gi in sorted(
+                    incidents,
+                    key=lambda g: (
+                        -g.confidence,
+                        g.namespace,
+                        g.domain,
+                    ),
+                )
+            ]
+
+        assert _ranked(degraded.incidents) == _ranked(
+            baseline.incidents
+        )
+
+    def test_dark_rejoin_zero_lost_zero_duplicated(self):
+        # Three regions so the dark one is NOT half of the
+        # cross-region fault — a fault spanning the dark boundary is
+        # a different contract (it pages partition_scoped and the
+        # late half suppresses), and the sweep keeps them separate
+        # the same way.
+        dark_at, dark_rounds = 6, 12
+        base_sim = _small_sim(regions=3, replay_budget=2)
+        plan = global_injection_plan(
+            base_sim.topology,
+            base_sim.region_ids,
+            dark_region="region-2",
+            dark_round=dark_at,
+        )
+        rounds = dark_at + dark_rounds + 10
+        baseline = base_sim.run(rounds, plan)
+        dark_sim = _small_sim(regions=3, replay_budget=2)
+        run = dark_sim.run(
+            rounds,
+            plan,
+            wan_events=[
+                WanEvent(dark_at, "region-2", WAN_DARK),
+                WanEvent(
+                    dark_at + dark_rounds, "region-2", WAN_HEAL
+                ),
+            ],
+        )
+        assert _keys(run.incidents) == _keys(baseline.incidents)
+        heal = run.heal_stats["region-2"]
+        assert heal["backlog_at_heal"] > 2  # the budget actually binds
+        assert 0 <= heal["replay_rounds"] <= heal["backlog_at_heal"]
+        assert heal["max_out_of_order"] > 0  # fresh overtook backlog
+        # The healthy side paged WHILE the partition was open.
+        dark_window_pages = [
+            (r, iid)
+            for r, iid, _ in run.emits
+            if dark_at <= r < dark_at + dark_rounds
+        ]
+        assert dark_window_pages
+        assert any(gi.partition_scoped for gi in run.incidents)
+
+
+class TestGlobalIngest:
+    def test_measure_global_ingest_small(self):
+        m = measure_global_ingest(
+            regions=2,
+            nodes_per_region=64,
+            clusters_per_region=2,
+            shards_per_cluster=2,
+            events_per_node=60,
+        )
+        assert m.nodes == 128
+        assert m.regions == 2
+        assert m.events_per_sec > 0
+        assert len(m.per_region_events_per_sec) == 2
+        assert m.slowest_region in m.per_region_events_per_sec
+        assert m.global_fold_ms >= 0
+
+
+class TestGlobalSweep:
+    @pytest.mark.slow
+    def test_sweep_passes_at_small_scale(self):
+        report = run_global_sweep(
+            regions=3,
+            nodes_per_region=48,
+            clusters_per_region=2,
+            shards_per_cluster=2,
+            dark_at_round=8,
+            dark_rounds=24,
+            measure_ingest_lane=False,
+        )
+        assert report.passed, report.failures
+        # The ack-loss window actually exercised the at-least-once hop.
+        assert report.wan["duplicate_envelopes"] > 0
+        assert report.wan["lost_acks"] > 0
+        assert report.dark["lost"] == []
+        assert report.dark["duplicated"] == []
+        assert report.dark["pages_during_dark"] > 0
+        assert report.splitbrain["suppressed"] >= 2
+        assert report.splitbrain["re_pages"] == 0
+
+    @pytest.mark.slow
+    def test_m5gate_global_cli_round_trip(self, tmp_path):
+        from tpuslo.cli.m5gate import main as m5gate_main
+
+        summary_json = tmp_path / "sweep.json"
+        summary_md = tmp_path / "sweep.md"
+        rc = m5gate_main(
+            [
+                "--global-sweep",
+                "--global-regions", "3",
+                "--global-nodes-per-region", "48",
+                "--global-dark-duration-rounds", "24",
+                "--global-no-ingest",
+                "--summary-json", str(summary_json),
+                "--summary-md", str(summary_md),
+            ]
+        )
+        assert rc == 0
+        report = json.loads(summary_json.read_text())
+        assert report["passed"] is True
+        md = summary_md.read_text()
+        assert "Global-tier gate" in md
+        assert "PASS" in md
+
+
+class TestGlobalCLI:
+    def _write_envelopes(self, path, payloads):
+        path.write_text(
+            "".join(global_envelope_json_line(p) for p in payloads)
+        )
+
+    def test_fleetagg_global_tier_folds_and_dedups(
+        self, tmp_path, capsys
+    ):
+        from tpuslo.cli.fleetagg import main as fleetagg_main
+
+        g0 = tmp_path / "g0.jsonl"
+        g1 = tmp_path / "g1.jsonl"
+        clock = EPOCH_NS + 8 * GAP
+        self._write_envelopes(
+            g0,
+            [
+                _env("region-0", 0, [_fleet("region-0")], clock),
+                _env("region-0", 0, [_fleet("region-0")], clock),
+            ],
+        )
+        self._write_envelopes(
+            g1, [_env("region-1", 0, [_fleet("region-1")], clock)]
+        )
+        incidents_out = tmp_path / "global.jsonl"
+        state_out = tmp_path / "gstate.json"
+        rc = fleetagg_main(
+            [
+                "--global-tier", str(g0), str(g1),
+                "--incidents-out", str(incidents_out),
+                "--state-out", str(state_out),
+                "--json",
+            ]
+        )
+        assert rc == 0
+        summary = json.loads(capsys.readouterr().out)
+        assert summary["incidents"] == 1
+        assert summary["duplicate_envelopes"] == 1
+        assert summary["regions"] == ["region-0", "region-1"]
+        page = json.loads(incidents_out.read_text().strip())
+        assert page["blast_radius"] == BLAST_GLOBAL
+        assert page["regions"] == ["region-0", "region-1"]
+        state = json.loads(state_out.read_text())
+        assert state["global"]["rollup"]["emitted_windows"]
+
+    def test_fleetagg_merge_peer_suppresses_replay(
+        self, tmp_path, capsys
+    ):
+        from tpuslo.cli.fleetagg import main as fleetagg_main
+
+        envelope = _env(
+            "region-2", 0, [_fleet("region-2")], EPOCH_NS + 8 * GAP
+        )
+        # Peer B pages the fault on its side of the partition...
+        peer_log = tmp_path / "peer.jsonl"
+        self._write_envelopes(peer_log, [envelope])
+        peer_state = tmp_path / "peer-state.json"
+        assert fleetagg_main(
+            [
+                "--global-tier", str(peer_log),
+                "--state-out", str(peer_state),
+                "--global-id", "global-b",
+            ]
+        ) == 0
+        capsys.readouterr()
+        # ...and after the heal, this side merges B's registry before
+        # replaying the same spool: suppress, never re-page.
+        replay_log = tmp_path / "replay.jsonl"
+        self._write_envelopes(replay_log, [envelope])
+        rc = fleetagg_main(
+            [
+                "--global-tier", str(replay_log),
+                "--merge-peer", str(peer_state),
+                "--json",
+            ]
+        )
+        assert rc == 0
+        out = capsys.readouterr()
+        assert "merged 1 emitted windows" in out.err
+        summary = json.loads(out.out)
+        assert summary["incidents"] == 0
+        assert summary["duplicates_suppressed"] == 1
+
+    def test_fleetagg_global_flag_conflicts(self, capsys):
+        from tpuslo.cli.fleetagg import main as fleetagg_main
+
+        rc = fleetagg_main(["x.jsonl", "--global-tier", "--region"])
+        assert rc == 2
+        assert "--global-tier" in capsys.readouterr().err
+        rc = fleetagg_main(
+            ["x.jsonl", "--global-tier", "--global-out", "g.jsonl"]
+        )
+        assert rc == 2
+        assert "--global-out" in capsys.readouterr().err
+        rc = fleetagg_main(
+            ["x.jsonl", "--merge-peer", "peer.json"]
+        )
+        assert rc == 2
+        assert "--merge-peer" in capsys.readouterr().err
+        rc = fleetagg_main(["x.jsonl", "--global-out", "g.jsonl"])
+        assert rc == 2
+        assert "--region" in capsys.readouterr().err
+
+    def test_sloctl_global_scope(self, tmp_path, capsys):
+        from tpuslo.cli.sloctl import main as sloctl_main
+
+        pages = [
+            GlobalIncident(
+                incident_id="global-tenant-b-tpu_hbm-1",
+                namespace="tenant-b",
+                domain="tpu_hbm",
+                blast_radius=BLAST_GLOBAL,
+                window_start_ns=EPOCH_NS,
+                window_end_ns=EPOCH_NS + GAP,
+                confidence=0.92,
+                regions=["region-0", "region-1"],
+                members=[
+                    {"incident_id": "f0", "region": "region-0",
+                     "clusters": ["cluster-0"]},
+                    {"incident_id": "f1", "region": "region-1",
+                     "clusters": ["cluster-2"]},
+                ],
+            ),
+            GlobalIncident(
+                incident_id="global-tenant-a-tpu_ici-2",
+                namespace="tenant-a",
+                domain="tpu_ici",
+                blast_radius="slice",
+                window_start_ns=EPOCH_NS + 2 * GAP,
+                window_end_ns=EPOCH_NS + 3 * GAP,
+                confidence=0.8,
+                regions=["region-0"],
+                members=[
+                    {"incident_id": "f2", "region": "region-0",
+                     "clusters": ["cluster-1"]},
+                ],
+                partition_scoped=True,
+                unreachable_regions=["region-1"],
+            ),
+        ]
+        path = tmp_path / "global.jsonl"
+        path.write_text(
+            "".join(
+                json.dumps(g.to_dict()) + "\n" for g in pages
+            )
+        )
+        rc = sloctl_main(
+            ["fleet", "incidents", "--incidents", str(path), "--global"]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "REGIONS" in out and "SCOPE" in out
+        assert "region-0,region-1" in out
+        assert "multi_region" in out
+        # A partition-scoped page names who was dark.
+        assert "partition_scoped !region-1" in out
+        assert "2 global incidents" in out
+        # --radius global keeps only the cross-region page.
+        sloctl_main(
+            [
+                "fleet", "incidents", "--incidents", str(path),
+                "--global", "--radius", "global",
+            ]
+        )
+        out = capsys.readouterr().out
+        assert "tpu_hbm" in out and "tpu_ici" not in out
+        # --cluster drills into member provenance; --json parity.
+        sloctl_main(
+            [
+                "fleet", "incidents", "--incidents", str(path),
+                "--global", "--cluster", "cluster-2", "--json",
+            ]
+        )
+        rows = json.loads(capsys.readouterr().out)
+        assert [r["incident_id"] for r in rows] == [
+            "global-tenant-b-tpu_hbm-1"
+        ]
+        assert rows[0]["regions"] == ["region-0", "region-1"]
+
+
+class TestShipmentBoundsRegression:
+    """The 100k-node bottleneck fix: decode_shipment's string-column
+    bounds check became a single unsigned-view max reduction.  The
+    trick only works if a negative i4 code still trips it (viewed as
+    u4 it lands >= 2**31) — pin that, or a corrupted shipment would
+    IndexError deep inside the gate instead of failing the contract."""
+
+    def _payload(self):
+        from tpuslo.schema.types import ProbeEventV1
+        from tpuslo.columnar.schema import from_rows
+
+        events = [
+            ProbeEventV1(
+                ts_unix_nano=EPOCH_NS + i * 1_000_000,
+                signal="dns_latency_ms",
+                node="node-x",
+                namespace="tenant-a",
+                pod="node-x-pod-0",
+                container="workload",
+                pid=100 + i,
+                tid=100 + i,
+                value=float(i),
+                unit="ms",
+                status="ok",
+            )
+            for i in range(4)
+        ]
+        return encode_shipment(from_rows(events), "node-x", 0)
+
+    def _corrupt(self, payload, code: int):
+        col = np.frombuffer(
+            payload["columns"]["node"], dtype=np.int32
+        ).copy()
+        col[0] = code
+        payload["columns"]["node"] = col.tobytes()
+
+    def test_negative_code_refused(self):
+        payload = self._payload()
+        self._corrupt(payload, -1)
+        with pytest.raises(WireContractError, match="outside"):
+            decode_shipment(payload)
+
+    def test_code_past_pool_refused(self):
+        payload = self._payload()
+        self._corrupt(payload, len(payload["pool"]))
+        with pytest.raises(WireContractError, match="outside"):
+            decode_shipment(payload)
+
+    def test_max_valid_code_accepted(self):
+        payload = self._payload()
+        self._corrupt(payload, len(payload["pool"]) - 1)
+        shipment = decode_shipment(payload)
+        assert shipment.events == 4

@@ -1,0 +1,337 @@
+"""WAN chaos harness: asymmetric latency, one-way partitions, dark
+regions.
+
+Two layers, one failure model:
+
+* :class:`WanProxy` — a **live** TCP forwarder layered on the PR 17
+  livenet substrate, modeled on :class:`~tpuslo.chaos.procs.BlackholeProxy`
+  but per-direction: hundreds-of-ms injected latency, and partitions
+  that can drop only the *forward* path (frames vanish, acks still
+  flow) or only the *backward* path (frames arrive, acks vanish — the
+  sender spools and later replays frames the receiver already has,
+  which is exactly the duplicate storm the seq dedup must absorb).  A
+  ``both`` partition tears existing connections down like a real WAN
+  cut; one-way partitions keep them up, because the defining property
+  of an asymmetric failure is that neither side agrees the link is
+  dead.
+* :class:`WanLink` — the **simulated-clock** twin for the seeded
+  global sweep: the same three failure shapes expressed in rounds
+  instead of seconds, so "a region dark for an hour" is sixty
+  60-second rounds, not an hour of wall time.  The link carries
+  region → global envelopes with per-round latency, tracks acks on
+  the backward path (an ack-lost envelope stays spooled region-side
+  and re-sends — at-least-once), and enforces the sender's bounded
+  replay budget: each round re-sends at most ``replay_budget`` backlog
+  envelopes *plus* the freshest one, so a rejoining region's fresh
+  incidents overtake its hour of backlog.
+
+:class:`WanEvent` schedules link state changes by round; the global
+simulator applies them, so every scenario is deterministic per seed.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+#: Link directions.  ``forward`` carries frames toward the upstream
+#: (region → global); ``backward`` carries acks downstream.
+DIR_FORWARD = "forward"
+DIR_BACKWARD = "backward"
+DIR_BOTH = "both"
+
+#: WanEvent actions.
+WAN_DARK = "dark"  # both directions down (region dark)
+WAN_ACK_LOSS = "ack_loss"  # backward down: frames arrive, acks vanish
+WAN_FRAME_LOSS = "frame_loss"  # forward down: frames vanish
+WAN_HEAL = "heal"
+WAN_LATENCY = "latency"
+
+
+class WanProxy:
+    """Per-direction TCP impairment: latency + one-way black holes.
+
+    Healthy: accept, connect upstream, pump both ways (optionally
+    delayed).  A one-way partition drops bytes in that direction only
+    while the other keeps flowing on the SAME connections; a ``both``
+    partition tears existing connections down (a hard WAN cut kills
+    in-flight TCP) and black-holes new ones.  Healing only restores
+    forwarding for bytes read after the heal — nothing buffered is
+    retroactively delivered, so the upstream never sees a torn frame.
+    """
+
+    def __init__(
+        self,
+        target: tuple[str, int],
+        host: str = "127.0.0.1",
+        latency_s: float = 0.0,
+    ):
+        self.target = target
+        self.latency_s = latency_s
+        self.dropped_bytes = {DIR_FORWARD: 0, DIR_BACKWARD: 0}
+        self.forwarded_bytes = {DIR_FORWARD: 0, DIR_BACKWARD: 0}
+        self._drop = {DIR_FORWARD: False, DIR_BACKWARD: False}
+        self._closed = False
+        self._lock = threading.Lock()
+        self._conns: list[socket.socket] = []
+        self._listener = socket.socket()
+        self._listener.setsockopt(
+            socket.SOL_SOCKET, socket.SO_REUSEADDR, 1
+        )
+        self._listener.bind((host, 0))
+        self._listener.listen(16)
+        self.host, self.port = self._listener.getsockname()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True
+        )
+        self._accept_thread.start()
+
+    @property
+    def address(self) -> str:
+        return f"tcp://{self.host}:{self.port}"
+
+    def partition(self, direction: str = DIR_BOTH) -> None:
+        if direction not in (DIR_FORWARD, DIR_BACKWARD, DIR_BOTH):
+            raise ValueError(f"unknown direction {direction!r}")
+        with self._lock:
+            if direction in (DIR_FORWARD, DIR_BOTH):
+                self._drop[DIR_FORWARD] = True
+            if direction in (DIR_BACKWARD, DIR_BOTH):
+                self._drop[DIR_BACKWARD] = True
+            conns: list[socket.socket] = []
+            if direction == DIR_BOTH:
+                # A hard cut kills in-flight TCP; an asymmetric
+                # partition must NOT — neither side agrees the link
+                # is dead, so the connections stay up.
+                conns, self._conns = self._conns, []
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def heal(self, direction: str = DIR_BOTH) -> None:
+        with self._lock:
+            if direction in (DIR_FORWARD, DIR_BOTH):
+                self._drop[DIR_FORWARD] = False
+            if direction in (DIR_BACKWARD, DIR_BOTH):
+                self._drop[DIR_BACKWARD] = False
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                client, _ = self._listener.accept()
+            except OSError:
+                return
+            client.settimeout(0.5)
+            upstream = None
+            if not (
+                self._drop[DIR_FORWARD] and self._drop[DIR_BACKWARD]
+            ):
+                try:
+                    upstream = socket.create_connection(
+                        self.target, timeout=2.0
+                    )
+                    upstream.settimeout(0.5)
+                except OSError:
+                    upstream = None
+            with self._lock:
+                self._conns.append(client)
+                if upstream is not None:
+                    self._conns.append(upstream)
+            threading.Thread(
+                target=self._pump,
+                args=(client, upstream, DIR_FORWARD),
+                daemon=True,
+            ).start()
+            if upstream is not None:
+                threading.Thread(
+                    target=self._pump,
+                    args=(upstream, client, DIR_BACKWARD),
+                    daemon=True,
+                ).start()
+
+    def _pump(
+        self,
+        src: socket.socket,
+        dst: socket.socket | None,
+        direction: str,
+    ) -> None:
+        while not self._closed:
+            try:
+                data = src.recv(65536)
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            if not data:
+                break
+            if self._drop[direction] or dst is None:
+                self.dropped_bytes[direction] += len(data)
+                continue
+            if self.latency_s > 0:
+                time.sleep(self.latency_s)
+            try:
+                dst.sendall(data)
+                self.forwarded_bytes[direction] += len(data)
+            except OSError:
+                break
+        for sock in (src, dst):
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self.partition()  # tears down any live pumps
+        with self._lock:
+            self._drop = {DIR_FORWARD: False, DIR_BACKWARD: False}
+
+
+@dataclass(frozen=True)
+class WanEvent:
+    """One scheduled WAN state change on a region's link."""
+
+    round_i: int
+    region: str
+    action: str  # dark | ack_loss | frame_loss | heal | latency
+    latency_rounds: int = 0
+
+
+@dataclass
+class WanLink:
+    """Simulated region → global link: latency, loss, bounded replay.
+
+    The link owns the sender-side delivery loop the livenet client
+    owns in production: which spooled envelopes go out this round
+    (bounded replay budget + the freshest envelope), which are in
+    flight (latency), and which are acked (backward path).  Ack
+    tracking mirrors the receiver's gap-tolerant cursor — acks arrive
+    out of order when fresh envelopes overtake the backlog — and the
+    region's spool trims only up to the *contiguous* ack watermark,
+    so an unacked envelope can never be dropped behind an acked one.
+    """
+
+    region: str
+    latency_rounds: int = 0
+    forward_up: bool = True
+    backward_up: bool = True
+    replay_budget: int = 8
+    delivered_frames: int = 0
+    dropped_frames: int = 0
+    lost_acks: int = 0
+    ack_watermark: int = -1
+    _acked: set = field(default_factory=set)
+    _in_flight: list = field(default_factory=list)
+
+    # ---- ack cursor (sender side) --------------------------------------
+
+    def acked(self, seq: int) -> bool:
+        return seq <= self.ack_watermark or seq in self._acked
+
+    def on_ack(self, seq: int) -> None:
+        """Record one ack if the backward path is up."""
+        if not self.backward_up:
+            self.lost_acks += 1
+            return
+        if self.acked(seq):
+            return
+        self._acked.add(seq)
+        while self.ack_watermark + 1 in self._acked:
+            self.ack_watermark += 1
+            self._acked.discard(self.ack_watermark)
+
+    # ---- transfer ------------------------------------------------------
+
+    def select_for_send(
+        self, spooled: list[dict[str, Any]]
+    ) -> list[dict[str, Any]]:
+        """Bounded replay + fresh overtake: what goes out this round.
+
+        ``spooled`` is the region's unacked spool (seq ascending).
+        At most ``replay_budget`` oldest backlog envelopes are
+        re-sent, and the newest envelope always rides along — an hour
+        of backlog cannot head-of-line-block a fresh page.
+        """
+        pending = [p for p in spooled if not self.acked(p["seq"])]
+        if not pending:
+            return []
+        if self.replay_budget <= 0:
+            return pending  # unbounded: strict oldest-first
+        picked = pending[: self.replay_budget]
+        if pending[-1] is not picked[-1]:
+            picked.append(pending[-1])
+        return picked
+
+    def offer(
+        self, round_i: int, payloads: list[dict[str, Any]]
+    ) -> None:
+        """Put envelopes on the wire (or drop them, if forward down)."""
+        for payload in payloads:
+            if not self.forward_up:
+                self.dropped_frames += 1
+                continue
+            self._in_flight.append(
+                (round_i + self.latency_rounds, payload)
+            )
+
+    def in_flight_seqs(self) -> set:
+        """Seqs on the wire right now (the sender's send-once guard)."""
+        return {payload["seq"] for _, payload in self._in_flight}
+
+    def due(self, round_i: int) -> list[dict[str, Any]]:
+        """Envelopes whose latency has elapsed, delivery order."""
+        ready = [
+            payload
+            for due_round, payload in self._in_flight
+            if due_round <= round_i
+        ]
+        self._in_flight = [
+            (due_round, payload)
+            for due_round, payload in self._in_flight
+            if due_round > round_i
+        ]
+        self.delivered_frames += len(ready)
+        return ready
+
+    # ---- chaos controls ------------------------------------------------
+
+    def apply(self, event: WanEvent) -> None:
+        if event.action == WAN_DARK:
+            self.forward_up = False
+            self.backward_up = False
+            self._in_flight = []  # a hard cut loses what was in flight
+        elif event.action == WAN_ACK_LOSS:
+            self.backward_up = False
+        elif event.action == WAN_FRAME_LOSS:
+            self.forward_up = False
+        elif event.action == WAN_HEAL:
+            self.forward_up = True
+            self.backward_up = True
+        elif event.action == WAN_LATENCY:
+            self.latency_rounds = max(0, int(event.latency_rounds))
+        else:
+            raise ValueError(f"unknown wan action {event.action!r}")
+
+    def snapshot(self) -> dict[str, Any]:
+        return {
+            "region": self.region,
+            "latency_rounds": self.latency_rounds,
+            "forward_up": self.forward_up,
+            "backward_up": self.backward_up,
+            "replay_budget": self.replay_budget,
+            "delivered_frames": self.delivered_frames,
+            "dropped_frames": self.dropped_frames,
+            "lost_acks": self.lost_acks,
+            "ack_watermark": self.ack_watermark,
+            "in_flight": len(self._in_flight),
+        }

@@ -18,6 +18,13 @@ Outputs:
 * ``--state-out`` — shard/node state snapshot (``sloctl fleet nodes``
   renders per-node reporting/stale status; a restarted aggregator
   absorbs it via the PR 4 runtime registry shape).
+
+One binary also hosts the two federation tiers above the cluster:
+``--region`` folds per-cluster envelope logs into fleet pages with
+cross-cluster identity (``--global-out`` ships the region→global
+envelope), and ``--global-tier`` folds per-region envelope logs into
+globally-identified pages (``sloctl fleet incidents --global``
+renders them; ``--merge-peer`` is the partition-heal handshake).
 """
 
 from __future__ import annotations
@@ -112,6 +119,47 @@ def build_parser() -> argparse.ArgumentParser:
         "runs; incidents collapse with cross-cluster identity",
     )
     p.add_argument("--region-id", default="region-0")
+    # ---- global tier (region -> global hop) ---------------------------
+    p.add_argument(
+        "--global-out",
+        default="",
+        help="--region mode: also write this region's global-envelope "
+        "JSONL (the region->global wire hop; feed it to "
+        "`fleetagg --global-tier`)",
+    )
+    p.add_argument(
+        "--global-seq",
+        type=int,
+        default=0,
+        help="per-region envelope sequence for --global-out (bump per "
+        "run; the global tier's gap-tolerant cursor accepts each "
+        "seq exactly once, in any arrival order)",
+    )
+    p.add_argument(
+        "--global-tier",
+        action="store_true",
+        help="run as the GLOBAL aggregator: inputs are global-envelope "
+        "JSONL logs written by per-region `fleetagg --region "
+        "--global-out` runs; pages gain cross-region identity and "
+        "partition scope",
+    )
+    p.add_argument("--global-id", default="global-0")
+    p.add_argument(
+        "--merge-peer",
+        default="",
+        help="--global-tier: a peer's --state-out snapshot; union its "
+        "emitted-window registry before ingesting (the partition-"
+        "heal handshake — the rejoined side suppresses pages the "
+        "peer already sent instead of re-paging)",
+    )
+    p.add_argument(
+        "--region-stale-after-ns",
+        type=int,
+        default=120_000_000_000,
+        help="--global-tier: a region whose head lags the fleet head "
+        "by more than this is unreachable — it ages out of the "
+        "session-close clock and pages emit partition-scoped",
+    )
     # ---- live deployment plane (tpuslo.livenet) -----------------------
     p.add_argument(
         "--listen",
@@ -266,6 +314,25 @@ def run_region(args) -> int:
                     )
     region.pump(flush=True)
     incidents = region.incidents
+    if args.global_out:
+        # Mirror of the cluster --region-out hop one level up: one
+        # envelope per batch run, seq supplied by the caller so the
+        # global tier's per-region cursor accepts it exactly once.
+        from tpuslo.federation.wire import (
+            encode_global_envelope,
+            global_envelope_json_line,
+        )
+
+        envelope = encode_global_envelope(
+            args.region_id,
+            args.global_seq,
+            incidents,
+            watermark_ns=region.watermark_ns(),
+            head_ns=region.head_ns(),
+            pressure_level=region.pressure.level,
+        )
+        with open(args.global_out, "w", encoding="utf-8") as fh:
+            fh.write(global_envelope_json_line(envelope))
     if args.incidents_out:
         with open(args.incidents_out, "w", encoding="utf-8") as fh:
             for incident in incidents:
@@ -330,6 +397,158 @@ def run_region(args) -> int:
                 f"[{incident.blast_radius}] tenant="
                 f"{incident.namespace} clusters="
                 f"{','.join(incident.clusters) or '-'} "
+                f"confidence={incident.confidence:.3f}"
+            )
+    return 0
+
+
+def run_global_tier(args) -> int:
+    """``fleetagg --global-tier``: envelope logs → global incidents.
+
+    Batch form of the tree root: per-region ``--global-out`` logs in
+    any order (WAN replays included — the gap-tolerant cursor accepts
+    each seq exactly once), globally-identified pages out.  A region
+    absent past ``--region-stale-after-ns`` ages out of the
+    session-close clock and the pages emit partition-scoped rather
+    than wedging the healthy side.
+    """
+    from tpuslo.federation.global_tier import GlobalAggregator
+    from tpuslo.federation.wire import GlobalWireError
+
+    agg = GlobalAggregator(
+        global_id=args.global_id,
+        rollup_gap_ns=args.rollup_gap_ns,
+        region_stale_after_ns=args.region_stale_after_ns,
+        capacity_incidents=args.pressure_capacity,
+    )
+    if args.restore_state:
+        try:
+            with open(args.restore_state, encoding="utf-8") as fh:
+                snapshot = json.load(fh)
+        except (OSError, json.JSONDecodeError) as exc:
+            print(
+                f"fleetagg: cannot restore {args.restore_state}: {exc}",
+                file=sys.stderr,
+            )
+            return 1
+        agg.restore_state(snapshot.get("global") or {})
+        print(
+            f"fleetagg: restored global state from "
+            f"{args.restore_state}",
+            file=sys.stderr,
+        )
+    if args.merge_peer:
+        try:
+            with open(args.merge_peer, encoding="utf-8") as fh:
+                peer_snapshot = json.load(fh)
+        except (OSError, json.JSONDecodeError) as exc:
+            print(
+                f"fleetagg: cannot merge {args.merge_peer}: {exc}",
+                file=sys.stderr,
+            )
+            return 1
+        merged = agg.merge_peer(peer_snapshot.get("global") or {})
+        print(
+            f"fleetagg: merged {merged} emitted windows from peer "
+            f"{args.merge_peer}",
+            file=sys.stderr,
+        )
+    rejected = 0
+    for path in args.inputs:
+        try:
+            fh = open(path, encoding="utf-8")
+        except OSError as exc:
+            print(
+                f"fleetagg: cannot read {path}: {exc.strerror or exc}",
+                file=sys.stderr,
+            )
+            return 1
+        with fh:
+            for lineno, line in enumerate(fh, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    raw = json.loads(line)
+                except json.JSONDecodeError as exc:
+                    rejected += 1
+                    print(
+                        f"fleetagg: {path}:{lineno}: rejected: {exc}",
+                        file=sys.stderr,
+                    )
+                    continue
+                try:
+                    agg.ingest(raw)
+                except GlobalWireError as exc:
+                    rejected += 1
+                    print(
+                        f"fleetagg: {path}:{lineno}: rejected: {exc}",
+                        file=sys.stderr,
+                    )
+    agg.pump(flush=True)
+    incidents = agg.incidents
+    if args.incidents_out:
+        with open(args.incidents_out, "w", encoding="utf-8") as fh:
+            for incident in incidents:
+                fh.write(
+                    json.dumps(
+                        incident.to_dict(), separators=(",", ":")
+                    )
+                    + "\n"
+                )
+    if args.state_out:
+        state = {
+            "saved_at": datetime.now(timezone.utc).isoformat(
+                timespec="seconds"
+            ),
+            "global": agg.export_state(),
+            "snapshot": agg.snapshot(),
+        }
+        with open(args.state_out, "w", encoding="utf-8") as fh:
+            json.dump(state, fh, indent=2)
+            fh.write("\n")
+    snapshot = agg.snapshot()
+    summary = {
+        "global_id": args.global_id,
+        "envelopes": agg.envelopes,
+        "duplicate_envelopes": agg.duplicate_envelopes,
+        "rejected_envelopes": rejected,
+        "regions": sorted(agg.regions),
+        "unreachable_regions": sorted(agg.unreachable_regions()),
+        "fleet_incidents": agg.ingested_incidents,
+        "incidents": len(incidents),
+        "partition_scoped": sum(
+            1 for i in incidents if i.partition_scoped
+        ),
+        "duplicates_suppressed": snapshot["duplicates_suppressed"],
+        "max_staleness_ms": snapshot["max_staleness_ms"],
+    }
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(
+            "fleetagg: global {gid}: {envelopes} envelopes "
+            "({dups} seq-dups, {rejected} rejected) from "
+            "{regions} regions -> {fleet} fleet pages -> "
+            "{incidents} global incidents "
+            "({partition} partition-scoped)".format(
+                gid=summary["global_id"],
+                envelopes=summary["envelopes"],
+                dups=summary["duplicate_envelopes"],
+                rejected=summary["rejected_envelopes"],
+                regions=len(summary["regions"]),
+                fleet=summary["fleet_incidents"],
+                incidents=summary["incidents"],
+                partition=summary["partition_scoped"],
+            )
+        )
+        for incident in incidents:
+            print(
+                f"  {incident.incident_id}: {incident.domain} "
+                f"[{incident.blast_radius}] tenant="
+                f"{incident.namespace} regions="
+                f"{','.join(incident.regions) or '-'} "
+                f"scope={incident.scope} "
                 f"confidence={incident.confidence:.3f}"
             )
     return 0
@@ -802,6 +1021,49 @@ def run_live(args) -> int:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.global_tier and args.listen:
+        print(
+            "fleetagg: --global-tier is batch-only; the live WAN hop "
+            "is the simulator's WanLink lane",
+            file=sys.stderr,
+        )
+        return 2
+    if args.global_tier:
+        if args.region or args.region_out or args.cluster_id:
+            print(
+                "fleetagg: --global-tier consumes global envelopes; "
+                "--region/--region-out/--cluster-id belong to lower "
+                "tiers",
+                file=sys.stderr,
+            )
+            return 2
+        if args.global_out:
+            print(
+                "fleetagg: --global-out belongs to --region runs "
+                "(the tree root has no upstream)",
+                file=sys.stderr,
+            )
+            return 2
+        if not args.inputs:
+            print(
+                "fleetagg: --global-tier needs global-envelope logs",
+                file=sys.stderr,
+            )
+            return 2
+        return run_global_tier(args)
+    if args.merge_peer:
+        print(
+            "fleetagg: --merge-peer belongs to --global-tier runs",
+            file=sys.stderr,
+        )
+        return 2
+    if args.global_out and not args.region:
+        print(
+            "fleetagg: --global-out belongs to --region runs (the "
+            "region->global wire hop)",
+            file=sys.stderr,
+        )
+        return 2
     if args.listen:
         if args.inputs:
             print(

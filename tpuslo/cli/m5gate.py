@@ -44,6 +44,18 @@ incidents, and — under forced ingest saturation — degrade batch
 granularity and sample low-severity rows (counted by level, bounded
 incident staleness) without ever dropping a gated fault's incident.
 
+``--global-sweep`` runs the global-tier gate
+(``tpuslo.federation.sweep.run_global_sweep``): 100k simulated nodes
+(10 regions x 10k) through the three-tier fold must sustain the
+ingest floor, collapse a cross-region fault to exactly ONE
+globally-identified page under WAN latency + one-way ack loss (the
+gap-tolerant cursor's dedup exercised, not idle), survive one region
+dark for a simulated hour — healthy side keeps paging
+partition-scoped, rejoin replays the spool within the bounded-budget
+round count, zero pages lost or duplicated — and prove the
+split-brain heal: merged emitted-window registries suppress replayed
+sessions instead of re-paging.
+
 ``--burn-sweep`` runs the error-budget burn-scenario gate
 (``tpuslo.sloengine.sweep``): seeded synthetic traffic shapes (steady,
 fast-burn, slow-burn, latency regression, flapping, tenant-isolated,
@@ -387,6 +399,66 @@ def build_parser() -> argparse.ArgumentParser:
         "--federation-no-saturate",
         action="store_true",
         help="skip the forced-saturation lane",
+    )
+    # ---- global-tier gate (tpuslo.federation.global_tier) --------------
+    p.add_argument(
+        "--global-sweep",
+        action="store_true",
+        help="run the global-tier gate instead of B5/D3/E3: 100k "
+        "simulated nodes (10 regions x 10k) must sustain the ingest "
+        "floor through the three-tier fold, collapse a cross-region "
+        "fault to exactly ONE globally-identified page under WAN "
+        "latency + ack loss (seq dedup exercised, not idle), survive "
+        "a region dark for one simulated hour with zero "
+        "lost/duplicated pages and bounded spool replay, and keep "
+        "split-brain peers from re-paging after the heal-time "
+        "emitted-window registry merge",
+    )
+    p.add_argument("--global-regions", type=int, default=4)
+    p.add_argument("--global-nodes-per-region", type=int, default=96)
+    p.add_argument("--global-seed", type=int, default=1337)
+    p.add_argument(
+        "--global-round-s",
+        type=float,
+        default=60.0,
+        help="simulated seconds per round (at 60, the default dark "
+        "duration below is one hour of event time)",
+    )
+    p.add_argument("--global-replay-budget", type=int, default=8)
+    p.add_argument(
+        "--global-wan-latency-rounds", type=int, default=2
+    )
+    p.add_argument(
+        "--global-partition-rounds",
+        type=int,
+        default=6,
+        help="length of the one-way ack-loss window (the asymmetric "
+        "partition lane: frames arrive, acks vanish)",
+    )
+    p.add_argument(
+        "--global-dark-duration-rounds",
+        type=int,
+        default=60,
+        help="rounds the dark region stays partitioned "
+        "(60 x 60s rounds = one simulated hour)",
+    )
+    p.add_argument("--global-ingest-regions", type=int, default=10)
+    p.add_argument(
+        "--global-ingest-nodes-per-region", type=int, default=10_000
+    )
+    p.add_argument(
+        "--global-min-ingest",
+        type=float,
+        default=5_000_000.0,
+        help="aggregate ingest floor in events/s through the "
+        "three-tier fold at the 100k ceiling (the global hop must "
+        "not cost throughput)",
+    )
+    p.add_argument(
+        "--global-no-ingest",
+        action="store_true",
+        help="skip the 100k ingest lane (the slow half of the gate; "
+        "the smoke target uses this)",
     )
     # ---- live deployment-plane gate (tpuslo.chaos.procs) --------------
     p.add_argument(
@@ -975,6 +1047,116 @@ def run_federation_gate(args) -> int:
     return 0 if report.passed else 1
 
 
+def render_global_markdown(report) -> str:
+    ingest = report.ingest
+    wan = report.wan
+    dark = report.dark
+    sb = report.splitbrain
+    heal = dark.get("heal_stats", {})
+    lines = [
+        "# Global-tier gate (three-tier tree under WAN chaos)",
+        "",
+        f"**Overall: {'PASS' if report.passed else 'FAIL'}**",
+        "",
+        f"- {report.regions} regions x {report.nodes_per_region} "
+        f"nodes (seed {report.seed}, {report.round_s:.0f}s rounds, "
+        f"replay budget {report.replay_budget})",
+        "- 100k ingest: "
+        + (
+            "{eps:,.0f} events/s over {nodes} nodes in {regions} "
+            "regions / {shards} shards (floor {floor:,.0f}); global "
+            "fold {fold:.1f} ms".format(
+                eps=ingest.get("events_per_sec", 0),
+                nodes=ingest.get("nodes", 0),
+                regions=ingest.get("regions", 0),
+                shards=ingest.get("shards", 0),
+                floor=report.min_ingest_events_per_sec,
+                fold=ingest.get("global_fold_ms", 0.0),
+            )
+            if ingest
+            else "(skipped)"
+        ),
+        f"- WAN identity: precision {report.precision:.3f} recall "
+        f"{report.recall:.3f} at "
+        f"{wan.get('latency_rounds', 0)}-round latency; "
+        f"{wan.get('lost_acks', 0)} acks lost and "
+        f"{wan.get('duplicate_envelopes', 0)} replayed envelopes "
+        f"absorbed by the gap-tolerant cursor",
+        "- hour dark: {region} dark {rounds} rounds, rejoined with "
+        "{backlog} spooled envelopes, replayed in {used} rounds "
+        "(bound {bound}) — lost {lost}, duplicated {dup}, "
+        "{pages} healthy-side pages while dark".format(
+            region=dark.get("dark_region", "-"),
+            rounds=report.dark_rounds,
+            backlog=heal.get("backlog_at_heal", 0),
+            used=heal.get("replay_rounds", 0),
+            bound=dark.get("replay_bound_rounds", 0),
+            lost=len(dark.get("lost", [])),
+            dup=len(dark.get("duplicated", [])),
+            pages=dark.get("pages_during_dark", 0),
+        ),
+        "- split brain: {a} page(s) on A / {b} on B during the "
+        "partition, {merged} window(s) merged on heal, {sup} "
+        "replayed session(s) suppressed, {re} re-pages".format(
+            a=len(sb.get("pages_a", [])),
+            b=len(sb.get("pages_b", [])),
+            merged=sb.get("merged_windows", 0),
+            sup=sb.get("suppressed", 0),
+            re=sb.get("re_pages", 0),
+        ),
+        "",
+        "| injection | expected radius | expected regions | matched "
+        "| radius | regions | exact |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for m in report.matches:
+        lines.append(
+            f"| {m.injection} | {m.expected_blast_radius} "
+            f"| {','.join(m.expected_regions)} | {m.matched_count} "
+            f"| {m.matched_blast_radius or '-'} "
+            f"| {','.join(m.matched_regions) or '-'} | {m.exact} |"
+        )
+    if report.failures:
+        lines += ["", "## Failures", ""]
+        lines += [f"- {f}" for f in report.failures]
+    return "\n".join(lines) + "\n"
+
+
+def run_global_gate(args) -> int:
+    from tpuslo.federation.sweep import run_global_sweep
+
+    report = run_global_sweep(
+        regions=args.global_regions,
+        nodes_per_region=args.global_nodes_per_region,
+        seed=args.global_seed,
+        round_s=args.global_round_s,
+        replay_budget=args.global_replay_budget,
+        wan_latency_rounds=args.global_wan_latency_rounds,
+        ack_loss_rounds=args.global_partition_rounds,
+        dark_rounds=args.global_dark_duration_rounds,
+        ingest_regions=args.global_ingest_regions,
+        ingest_nodes_per_region=args.global_ingest_nodes_per_region,
+        min_ingest_events_per_sec=args.global_min_ingest,
+        measure_ingest_lane=not args.global_no_ingest,
+        log=lambda msg: print(f"m5gate: {msg}", file=sys.stderr),
+    )
+    if args.summary_json:
+        Path(args.summary_json).write_text(
+            json.dumps(report.to_dict(), indent=2) + "\n"
+        )
+    if args.summary_md:
+        Path(args.summary_md).write_text(
+            render_global_markdown(report)
+        )
+    print(
+        f"m5gate: global-sweep "
+        f"{'PASS' if report.passed else 'FAIL'}"
+        + ("" if report.passed else f" ({'; '.join(report.failures)})"),
+        file=sys.stderr,
+    )
+    return 0 if report.passed else 1
+
+
 def render_live_markdown(report) -> str:
     lines = [
         "# Live deployment-plane gate (process tree over real sockets)",
@@ -1333,6 +1515,8 @@ def main(argv: list[str] | None = None) -> int:
         return run_fleet_gate(args)
     if args.federation_sweep:
         return run_federation_gate(args)
+    if args.global_sweep:
+        return run_global_gate(args)
     if args.live_chaos_sweep:
         return run_live_gate(args)
     if args.crash_sweep:

@@ -101,8 +101,17 @@ def build_parser() -> argparse.ArgumentParser:
     fl_inc.add_argument(
         "--radius",
         default="",
-        choices=["", "pod", "node", "slice", "fleet"],
+        choices=["", "pod", "node", "slice", "fleet", "global"],
         help="filter to one blast radius",
+    )
+    fl_inc.add_argument(
+        "--global",
+        dest="global_scope",
+        action="store_true",
+        help="read GLOBAL-incident JSONL (`fleetagg --global-tier` "
+        "output) instead of fleet incidents: one page per fault "
+        "domain across regions, with a REGIONS column and the "
+        "partition scope",
     )
     fl_inc.add_argument("--tenant", default="", help="filter to one tenant")
     fl_inc.add_argument(
@@ -303,6 +312,86 @@ def _render_table(rows: list[tuple[str, ...]]) -> str:
     )
 
 
+def _run_global_incidents(args) -> int:
+    """``sloctl fleet incidents --global``: the global-page table.
+
+    Rows are :class:`~tpuslo.federation.GlobalIncident` JSONL (the
+    ``fleetagg --global-tier`` output).  REGIONS is the page's member
+    span; SCOPE distinguishes a clean multi-region page from a
+    ``partition`` one (some region was unreachable at emission — the
+    peer side may hold the rest, and ``!<regions>`` names who was
+    dark).  Drill-down stays two-level: each member entry is one
+    region's fleet page, explained on that region's own logs.
+    """
+    from tpuslo.federation.global_tier import GlobalIncident
+
+    pages: list[GlobalIncident] = []
+    try:
+        with open(args.incidents, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    pages.append(
+                        GlobalIncident.from_dict(json.loads(line))
+                    )
+    except (OSError, json.JSONDecodeError) as exc:
+        print(
+            f"sloctl fleet incidents: cannot read "
+            f"{args.incidents}: {exc}",
+            file=sys.stderr,
+        )
+        return 1
+    pages = [
+        g
+        for g in pages
+        if (not args.radius or g.blast_radius == args.radius)
+        and (not args.tenant or g.namespace == args.tenant)
+        and (not args.region or args.region in g.regions)
+        and (
+            not args.cluster
+            or any(
+                args.cluster in (m.get("clusters") or [])
+                for m in g.members
+            )
+        )
+    ]
+    if args.json:
+        print(json.dumps([g.to_dict() for g in pages], indent=2))
+        return 0
+    if not pages:
+        print("(no global incidents)")
+        return 0
+    rows = [
+        (
+            "INCIDENT", "DOMAIN", "RADIUS", "TENANT", "REGIONS",
+            "SCOPE", "MEMBERS", "CONFIDENCE",
+        )
+    ]
+    for g in sorted(pages, key=lambda x: x.window_start_ns):
+        scope = g.scope
+        if g.partition_scoped and g.unreachable_regions:
+            scope += " !" + ",".join(g.unreachable_regions)
+        rows.append(
+            (
+                g.incident_id,
+                g.domain,
+                g.blast_radius,
+                g.namespace,
+                ",".join(g.regions) or "-",
+                scope,
+                str(len(g.members)),
+                f"{g.confidence:.3f}",
+            )
+        )
+    print(_render_table(rows))
+    print(
+        f"{len(pages)} global incidents — each MEMBER is one "
+        "region's fleet page; drill down with `sloctl fleet "
+        "incidents --incidents <that region's log>`"
+    )
+    return 0
+
+
 def run_fleet(args) -> int:
     from tpuslo.fleet.rollup import FleetIncident
 
@@ -314,6 +403,8 @@ def run_fleet(args) -> int:
                 file=sys.stderr,
             )
             return 1
+        if args.global_scope:
+            return _run_global_incidents(args)
         incidents: list[FleetIncident] = []
         try:
             with open(args.incidents, encoding="utf-8") as fh:

@@ -1,25 +1,29 @@
-"""Federation plane: two-level aggregator tree to 10k nodes.
+"""Federation plane: three-tier aggregator tree to 100k nodes.
 
-The PR 9 fleet plane federates one level up (ROADMAP #4, ARGUS scale):
-cluster-level shard rings roll node shipments into attributed node
-incidents, region-level aggregators collapse them into fleet pages
-with cross-cluster incident identity, and a backpressure/adaptive-
-sampling loop degrades batch granularity — never incident
-correctness — when ingest saturates.
+The PR 9 fleet plane federates upward (ROADMAP #4, an order past
+ARGUS scale): cluster-level shard rings roll node shipments into
+attributed node incidents, region-level aggregators collapse them
+into fleet pages with cross-cluster incident identity, a global tier
+peers regions into globally-identified pages that survive WAN
+partitions, and a backpressure/adaptive-sampling loop degrades batch
+granularity — never incident correctness — when ingest saturates.
 
-* :mod:`tpuslo.federation.wire` — versioned cluster→region envelope
-  (seq-deduped, watermark- and pressure-carrying).
+* :mod:`tpuslo.federation.wire` — versioned cluster→region and
+  region→global envelopes (seq-deduped, watermark- and
+  pressure-carrying).
 * :mod:`tpuslo.federation.backpressure` — leveled pressure controller
   with hysteresis + the low-severity-only adaptive sampler.
 * :mod:`tpuslo.federation.cluster` — cluster tier: shard ring reuse,
   online rebalancing with in-flight window handoff, upstream spool.
 * :mod:`tpuslo.federation.region` — region tier: cross-cluster
-  rollup, staleness ledger, failover snapshot.
-* :mod:`tpuslo.federation.simulator` — seeded 10k-node simulator
-  (template-cloned heartbeats, real fault-node path, churn schedule).
+  rollup, staleness ledger, failover snapshot, global-hop spool.
+* :mod:`tpuslo.federation.global_tier` — global tier: gap-tolerant
+  seq dedup, partition-aware emission, heal-time registry merge.
+* :mod:`tpuslo.federation.simulator` — seeded 10k-node region and
+  100k-node global simulators (template-cloned heartbeats, real
+  fault-node path, churn schedule, seeded WAN links).
 * :mod:`tpuslo.federation.sweep` — the ``m5gate --federation-sweep``
-  release gate (throughput, cross-cluster dedup, region kill,
-  graceful saturation).
+  and ``--global-sweep`` release gates.
 """
 
 from tpuslo.federation.backpressure import (
@@ -36,6 +40,14 @@ from tpuslo.federation.backpressure import (
     SampleResult,
 )
 from tpuslo.federation.cluster import ClusterAggregator
+from tpuslo.federation.global_tier import (
+    BLAST_GLOBAL,
+    GapTolerantCursor,
+    GlobalAggregator,
+    GlobalIncident,
+    GlobalObserver,
+    GlobalRollup,
+)
 from tpuslo.federation.region import (
     FederationObserver,
     RegionAggregator,
@@ -46,22 +58,40 @@ from tpuslo.federation.simulator import (
     FederationRunResult,
     FederationSimulator,
     FederationTopology,
+    GlobalFaultInjection,
+    GlobalIngestMeasurement,
+    GlobalRunResult,
+    GlobalSimulator,
     build_churn_plan,
     federation_injection_plan,
+    global_injection_plan,
+    measure_global_ingest,
 )
 from tpuslo.federation.sweep import (
     FederationSweepReport,
+    GlobalIncidentMatch,
+    GlobalSweepReport,
     run_federation_sweep,
+    run_global_sweep,
+    score_global_incidents,
 )
 from tpuslo.federation.wire import (
+    GLOBAL_WIRE_VERSION,
     REGION_WIRE_VERSION,
+    GlobalEnvelope,
+    GlobalWireError,
     RegionEnvelope,
     RegionWireError,
+    decode_global_envelope,
     decode_region_envelope,
+    encode_global_envelope,
     encode_region_envelope,
+    global_envelope_json_line,
+    load_global_envelopes,
     load_region_envelopes,
     node_incident_from_wire,
     node_incident_to_wire,
+    parse_global_envelope_line,
     parse_region_envelope_line,
     region_envelope_json_line,
 )
@@ -79,6 +109,12 @@ __all__ = [
     "PressureSignal",
     "SampleResult",
     "ClusterAggregator",
+    "BLAST_GLOBAL",
+    "GapTolerantCursor",
+    "GlobalAggregator",
+    "GlobalIncident",
+    "GlobalObserver",
+    "GlobalRollup",
     "FederationObserver",
     "RegionAggregator",
     "ChurnEvent",
@@ -86,18 +122,36 @@ __all__ = [
     "FederationRunResult",
     "FederationSimulator",
     "FederationTopology",
+    "GlobalFaultInjection",
+    "GlobalIngestMeasurement",
+    "GlobalRunResult",
+    "GlobalSimulator",
     "build_churn_plan",
     "federation_injection_plan",
+    "global_injection_plan",
+    "measure_global_ingest",
     "FederationSweepReport",
+    "GlobalIncidentMatch",
+    "GlobalSweepReport",
     "run_federation_sweep",
+    "run_global_sweep",
+    "score_global_incidents",
+    "GLOBAL_WIRE_VERSION",
     "REGION_WIRE_VERSION",
+    "GlobalEnvelope",
+    "GlobalWireError",
     "RegionEnvelope",
     "RegionWireError",
+    "decode_global_envelope",
     "decode_region_envelope",
+    "encode_global_envelope",
     "encode_region_envelope",
+    "global_envelope_json_line",
+    "load_global_envelopes",
     "load_region_envelopes",
     "node_incident_from_wire",
     "node_incident_to_wire",
+    "parse_global_envelope_line",
     "parse_region_envelope_line",
     "region_envelope_json_line",
 ]

@@ -1,0 +1,717 @@
+"""Global tier: multi-region peering with partition-tolerant identity.
+
+The third (top) tier of the federation tree.  Regions ship their
+:class:`~tpuslo.fleet.rollup.FleetIncident` pages inside
+:class:`~tpuslo.federation.wire.GlobalEnvelope` frames; the
+:class:`GlobalAggregator` folds them so that the same fault domain ×
+blast radius spanning regions pages ONCE globally, with per-region
+member provenance (each member is a whole fleet page, one drill-down
+away from its node evidence).  Three properties distinguish this hop
+from the hops below it, all forced by WAN realism:
+
+* **Gap-tolerant seq dedup.**  The lower hops dedup on a strict
+  per-sender high-water mark because delivery there is ordered: the
+  spool replays oldest-first before anything fresh goes out.  Over a
+  WAN that ordering is the failure mode — a region rejoining after an
+  hour dark would head-of-line-block its fresh incidents behind 3600
+  spooled envelopes.  The livenet client therefore replays under a
+  bounded budget and lets fresh envelopes overtake the backlog, which
+  means the global cursor sees seqs out of order.
+  :class:`GapTolerantCursor` accepts each seq exactly once at any
+  arrival order and still compacts to a contiguous watermark.
+* **Partition-aware emission.**  The session-close clock is the min
+  watermark over *reachable* regions only; a region whose head has
+  fallen ``region_stale_after_ns`` behind the global head ages out of
+  the min, so an asymmetric partition can never wedge the healthy
+  side's session closes.  Pages emitted while any region is dark are
+  stamped ``partition_scoped`` with the unreachable set — the page is
+  honest about what it could not see.
+* **Heal-time registry merge.**  Two global peers that paged the same
+  fault from opposite sides of a partition reconcile by merging
+  emitted-window registries (:meth:`GlobalAggregator.merge_peer`):
+  after the merge, replayed envelopes from the other side's regions
+  rebuild rollup groups that the registry then suppresses — the
+  rejoined side suppresses rather than re-pages, the same
+  gap-tolerant window-overlap rule that makes region failover
+  exactly-once one level down.
+
+Everything here runs on the event clock (``head_ns`` / ``watermark``
+from envelopes), never wall time, so an hour-dark rejoin is a seeded
+simulation, not a slow test.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable
+
+from tpuslo.federation.backpressure import PressureController
+from tpuslo.federation.wire import (
+    GlobalEnvelope,
+    decode_global_envelope,
+)
+from tpuslo.fleet.rollup import BLAST_RADII, FleetIncident
+
+#: Blast radius one past BLAST_FLEET: members span multiple regions.
+BLAST_GLOBAL = "global"
+
+#: Page scopes (the ``llm_slo_global_pages_total`` label values).
+PAGE_SCOPE_SINGLE = "single_region"
+PAGE_SCOPE_MULTI = "multi_region"
+PAGE_SCOPE_PARTITION = "partition_scoped"
+
+#: Duplicate-suppression reasons (metrics label values).
+DUP_SEQ_REPLAY = "seq_replay"
+DUP_EMITTED_WINDOW = "emitted_window"
+
+
+class GlobalObserver:
+    """Duck-typed metrics bridge (AgentMetrics.global_observer)."""
+
+    def global_ingested(self, region: str, incidents: int) -> None: ...
+
+    def global_page(self, scope: str) -> None: ...
+
+    def global_duplicate(self, reason: str) -> None: ...
+
+    def region_reachable(self, region: str, reachable: int) -> None: ...
+
+
+@dataclass(slots=True)
+class GapTolerantCursor:
+    """At-least-once dedup that survives out-of-order redelivery.
+
+    ``accept(seq)`` is True exactly once per seq regardless of arrival
+    order: seqs at or below the contiguous ``watermark`` are
+    duplicates, seqs above it are remembered in a sparse accepted set
+    that compacts back into the watermark as gaps fill.  The set is
+    bounded by the sender's in-flight window (spool backlog), not by
+    history — a fully replayed hour of backlog collapses to one
+    integer.
+    """
+
+    watermark: int = -1
+    accepted: set[int] = field(default_factory=set)
+
+    def seen(self, seq: int) -> bool:
+        return seq <= self.watermark or seq in self.accepted
+
+    def accept(self, seq: int) -> bool:
+        if seq <= self.watermark or seq in self.accepted:
+            return False
+        self.accepted.add(seq)
+        while self.watermark + 1 in self.accepted:
+            self.watermark += 1
+            self.accepted.discard(self.watermark)
+        return True
+
+    def export_state(self) -> dict[str, Any]:
+        return {
+            "watermark": self.watermark,
+            "accepted": sorted(self.accepted),
+        }
+
+    def restore_state(self, state: dict[str, Any]) -> None:
+        self.watermark = int(state.get("watermark", -1))
+        self.accepted = {int(s) for s in state.get("accepted") or []}
+
+
+@dataclass(slots=True)
+class GlobalIncident:
+    """One global page with per-region fleet-page provenance."""
+
+    incident_id: str
+    namespace: str
+    domain: str
+    #: Max member radius, escalated to ``global`` when members span
+    #: more than one region.
+    blast_radius: str
+    window_start_ns: int
+    window_end_ns: int
+    confidence: float
+    regions: list[str]
+    #: Per-region member pages (:meth:`FleetIncident.summary_dict`).
+    members: list[dict[str, Any]]
+    #: True when any region was unreachable at emission time: the page
+    #: may be one side of a partition and a peer may hold the rest.
+    partition_scoped: bool = False
+    unreachable_regions: list[str] = field(default_factory=list)
+
+    @property
+    def scope(self) -> str:
+        if self.partition_scoped:
+            return PAGE_SCOPE_PARTITION
+        if len(self.regions) > 1:
+            return PAGE_SCOPE_MULTI
+        return PAGE_SCOPE_SINGLE
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "incident_id": self.incident_id,
+            "namespace": self.namespace,
+            "domain": self.domain,
+            "blast_radius": self.blast_radius,
+            "window_start_ns": self.window_start_ns,
+            "window_end_ns": self.window_end_ns,
+            "confidence": round(self.confidence, 4),
+            "regions": list(self.regions),
+            "members": [dict(m) for m in self.members],
+            "partition_scoped": self.partition_scoped,
+            "unreachable_regions": list(self.unreachable_regions),
+        }
+
+    @classmethod
+    def from_dict(cls, raw: dict[str, Any]) -> "GlobalIncident":
+        return cls(
+            incident_id=str(raw.get("incident_id", "")),
+            namespace=str(raw.get("namespace", "")),
+            domain=str(raw.get("domain", "")),
+            blast_radius=str(raw.get("blast_radius", "")),
+            window_start_ns=int(raw.get("window_start_ns", 0)),
+            window_end_ns=int(raw.get("window_end_ns", 0)),
+            confidence=float(raw.get("confidence", 0.0)),
+            regions=[str(r) for r in raw.get("regions") or []],
+            members=[dict(m) for m in raw.get("members") or []],
+            partition_scoped=bool(raw.get("partition_scoped", False)),
+            unreachable_regions=[
+                str(r) for r in raw.get("unreachable_regions") or []
+            ],
+        )
+
+
+def classify_global_radius(members: Iterable[FleetIncident]) -> str:
+    """Max member radius; ``global`` once members span regions."""
+    regions: set[str] = set()
+    worst = 0
+    for m in members:
+        if m.region:
+            regions.add(m.region)
+        try:
+            worst = max(worst, BLAST_RADII.index(m.blast_radius))
+        except ValueError:
+            pass
+    if len(regions) > 1:
+        return BLAST_GLOBAL
+    return BLAST_RADII[worst]
+
+
+@dataclass(slots=True)
+class _GlobalGroup:
+    """One open (namespace, domain) global session window."""
+
+    namespace: str
+    domain: str
+    start_ns: int
+    last_ns: int
+    members: dict[str, FleetIncident]  # keyed (region:incident_id)
+
+
+class GlobalRollup:
+    """Session-window fold of fleet pages into global pages.
+
+    Same discipline as :class:`~tpuslo.fleet.rollup.FleetRollup` one
+    level down — (namespace, domain) session key, gap-tolerant joins,
+    idempotent emission through an emitted-window registry — but the
+    unit folded is a whole fleet page (an interval, not an instant),
+    so joins test interval overlap within ``gap_ns``.  The registry is
+    additionally *mergeable*: :meth:`merge_emitted_windows` unions a
+    peer's registry in, which is how two sides of a healed partition
+    agree on what has already paged.
+    """
+
+    def __init__(
+        self,
+        gap_ns: int = 5_000_000_000,
+        on_incident: Callable[[GlobalIncident], None] | None = None,
+        observer: GlobalObserver | None = None,
+    ):
+        self.gap_ns = max(1, int(gap_ns))
+        self._groups: dict[tuple[str, str], list[_GlobalGroup]] = {}
+        self._emitted_windows: dict[
+            tuple[str, str], list[tuple[int, int]]
+        ] = {}
+        self._on_incident = on_incident
+        self._observer = observer or GlobalObserver()
+        self.incidents_emitted = 0
+        self.duplicates_suppressed = 0
+        self.members_folded = 0
+
+    # ---- ingest -------------------------------------------------------
+
+    def observe(
+        self,
+        incidents: Iterable[FleetIncident],
+        unreachable: tuple[str, ...] = (),
+    ) -> list[GlobalIncident]:
+        """Fold fleet pages; returns sessions closed by arrival order."""
+        emitted: list[GlobalIncident] = []
+        for fi in incidents:
+            key = (fi.namespace, fi.domain)
+            sessions = self._groups.setdefault(key, [])
+            lo = fi.window_start_ns
+            hi = fi.window_end_ns
+            joinable = [
+                g
+                for g in sessions
+                if lo <= g.last_ns + self.gap_ns
+                and hi >= g.start_ns - self.gap_ns
+            ]
+            if joinable:
+                group = joinable[0]
+                for other in joinable[1:]:  # member bridges sessions
+                    for mk, m in other.members.items():
+                        prior = group.members.get(mk)
+                        if (
+                            prior is None
+                            or m.confidence > prior.confidence
+                        ):
+                            group.members[mk] = m
+                    group.start_ns = min(group.start_ns, other.start_ns)
+                    group.last_ns = max(group.last_ns, other.last_ns)
+                    sessions.remove(other)
+            else:
+                # Forward gap: sessions quiet relative to the new
+                # arrival close now; sessions LATER than it stay open
+                # (a replayed straggler must not close a live session).
+                for stale in [
+                    g for g in sessions if g.last_ns + self.gap_ns < lo
+                ]:
+                    emitted.extend(
+                        self._emit(key, stale, unreachable)
+                    )
+                sessions = self._groups.setdefault(key, [])
+                group = _GlobalGroup(
+                    namespace=fi.namespace,
+                    domain=fi.domain,
+                    start_ns=lo,
+                    last_ns=hi,
+                    members={},
+                )
+                sessions.append(group)
+            member_key = f"{fi.region}:{fi.incident_id}"
+            prior = group.members.get(member_key)
+            if prior is None or fi.confidence > prior.confidence:
+                group.members[member_key] = fi
+            group.start_ns = min(group.start_ns, lo)
+            group.last_ns = max(group.last_ns, hi)
+            self.members_folded += 1
+        return emitted
+
+    def close_up_to(
+        self,
+        watermark_ns: int,
+        unreachable: tuple[str, ...] = (),
+    ) -> list[GlobalIncident]:
+        """Emit every session whose quiet period the watermark passed."""
+        emitted: list[GlobalIncident] = []
+        for key in list(self._groups):
+            for group in list(self._groups.get(key, ())):
+                if group.last_ns + self.gap_ns <= watermark_ns:
+                    emitted.extend(self._emit(key, group, unreachable))
+        return emitted
+
+    def flush(
+        self, unreachable: tuple[str, ...] = ()
+    ) -> list[GlobalIncident]:
+        """Emit every open session (end of stream / drain path)."""
+        emitted: list[GlobalIncident] = []
+        for key in list(self._groups):
+            for group in list(self._groups.get(key, ())):
+                emitted.extend(self._emit(key, group, unreachable))
+        return emitted
+
+    def open_groups(self) -> int:
+        return sum(len(s) for s in self._groups.values())
+
+    # ---- emission -----------------------------------------------------
+
+    def _emit(
+        self,
+        key: tuple[str, str],
+        group: _GlobalGroup,
+        unreachable: tuple[str, ...],
+    ) -> list[GlobalIncident]:
+        sessions = self._groups.get(key)
+        if sessions is not None:
+            try:
+                sessions.remove(group)
+            except ValueError:
+                pass
+            if not sessions:
+                del self._groups[key]
+        members = sorted(
+            group.members.values(),
+            key=lambda m: (m.region, m.incident_id),
+        )
+        if not members:
+            return []
+        # Replay (spool redelivery, peer heal) rebuilt a session
+        # already paged — by this aggregator or by a merged peer:
+        # suppress.  Gap-tolerant window overlap, not id equality,
+        # because two sides of a partition derive different start_ns
+        # for the same fault.
+        emitted_key = (group.namespace, group.domain)
+        for rec_start, rec_end in self._emitted_windows.get(
+            emitted_key, ()
+        ):
+            if (
+                group.start_ns <= rec_end + self.gap_ns
+                and group.last_ns >= rec_start - self.gap_ns
+            ):
+                self.duplicates_suppressed += 1
+                self._observer.global_duplicate(DUP_EMITTED_WINDOW)
+                return []
+        self._emitted_windows.setdefault(emitted_key, []).append(
+            (group.start_ns, group.last_ns)
+        )
+        incident = GlobalIncident(
+            incident_id=(
+                f"global-{group.namespace}-{group.domain}-"
+                f"{group.start_ns}"
+            ),
+            namespace=group.namespace,
+            domain=group.domain,
+            blast_radius=classify_global_radius(members),
+            window_start_ns=group.start_ns,
+            window_end_ns=group.last_ns,
+            confidence=max(m.confidence for m in members),
+            regions=sorted({m.region for m in members if m.region}),
+            members=[m.summary_dict() for m in members],
+            partition_scoped=bool(unreachable),
+            unreachable_regions=sorted(unreachable),
+        )
+        self.incidents_emitted += 1
+        self._observer.global_page(incident.scope)
+        if self._on_incident is not None:
+            self._on_incident(incident)
+        return [incident]
+
+    # ---- failover snapshot / peer merge ------------------------------
+
+    def export_emitted_windows(self) -> list[list[Any]]:
+        return [
+            [ns, domain, start, end]
+            for (ns, domain), windows in sorted(
+                self._emitted_windows.items()
+            )
+            for start, end in windows
+        ]
+
+    def merge_emitted_windows(self, rows: Iterable[Iterable[Any]]) -> int:
+        """Union a peer's emitted-window registry in; returns adds.
+
+        The heal handshake: after a partition, each side hands the
+        other its registry; windows the peer paged suppress this
+        side's replayed sessions exactly like locally-paged ones.
+        """
+        merged = 0
+        for ns, domain, start, end in rows:
+            key = (str(ns), str(domain))
+            window = (int(start), int(end))
+            windows = self._emitted_windows.setdefault(key, [])
+            if window not in windows:
+                windows.append(window)
+                merged += 1
+        return merged
+
+    def export_state(self) -> dict[str, Any]:
+        return {
+            "gap_ns": self.gap_ns,
+            "emitted_windows": self.export_emitted_windows(),
+            "incidents_emitted": self.incidents_emitted,
+            "groups": [
+                {
+                    "namespace": g.namespace,
+                    "domain": g.domain,
+                    "start_ns": g.start_ns,
+                    "last_ns": g.last_ns,
+                    "members": [
+                        m.to_dict() for m in g.members.values()
+                    ],
+                }
+                for sessions in self._groups.values()
+                for g in sessions
+            ],
+        }
+
+    def restore_state(self, state: dict[str, Any]) -> None:
+        self.gap_ns = int(state.get("gap_ns", self.gap_ns))
+        self._emitted_windows = {}
+        self.merge_emitted_windows(state.get("emitted_windows") or [])
+        self.incidents_emitted = int(state.get("incidents_emitted", 0))
+        self._groups = {}
+        for raw in state.get("groups") or []:
+            members = [
+                FleetIncident.from_dict(m)
+                for m in raw.get("members") or []
+            ]
+            group = _GlobalGroup(
+                namespace=str(raw["namespace"]),
+                domain=str(raw["domain"]),
+                start_ns=int(raw["start_ns"]),
+                last_ns=int(raw["last_ns"]),
+                members={
+                    f"{m.region}:{m.incident_id}": m for m in members
+                },
+            )
+            self._groups.setdefault(
+                (group.namespace, group.domain), []
+            ).append(group)
+
+
+@dataclass(slots=True)
+class _RegionState:
+    """Per-region ingest cursor at the global tier."""
+
+    cursor: GapTolerantCursor = field(
+        default_factory=GapTolerantCursor
+    )
+    watermark_ns: int = 0
+    head_ns: int = 0
+    envelopes: int = 0
+    incidents: int = 0
+    pressure_level: int = 0
+
+
+class GlobalAggregator:
+    """Top of the tree: global envelopes in, global pages out."""
+
+    def __init__(
+        self,
+        global_id: str = "global-0",
+        rollup_gap_ns: int = 5_000_000_000,
+        region_stale_after_ns: int = 120_000_000_000,
+        capacity_incidents: int = 8192,
+        observer: GlobalObserver | None = None,
+        on_incident: Callable[[GlobalIncident], None] | None = None,
+    ):
+        self.global_id = global_id
+        self.region_stale_after_ns = int(region_stale_after_ns)
+        self._observer = observer or GlobalObserver()
+        self.rollup = GlobalRollup(
+            gap_ns=rollup_gap_ns,
+            on_incident=on_incident,
+            observer=self._observer,
+        )
+        self.regions: dict[str, _RegionState] = {}
+        self._pending: list[FleetIncident] = []
+        self.pressure = PressureController(capacity_incidents)
+        self.incidents: list[GlobalIncident] = []
+        self.envelopes = 0
+        self.duplicate_envelopes = 0
+        self.ingested_incidents = 0
+        self.max_staleness_ms = 0.0
+
+    # ---- ingest --------------------------------------------------------
+
+    def ingest(
+        self, payload: dict[str, Any] | GlobalEnvelope
+    ) -> bool:
+        """Accept one envelope; False when dropped as a seq duplicate.
+
+        Dedup is gap-tolerant per region: a rejoining region's spool
+        replay interleaves with its fresh envelopes (the bounded
+        replay budget), so seqs arrive out of order and each must be
+        accepted exactly once.
+        """
+        if not isinstance(payload, GlobalEnvelope):
+            # Peek the header before paying the per-incident decode:
+            # WAN replays are mostly duplicates.
+            peek_region = payload.get("region")
+            state = (
+                self.regions.get(peek_region)
+                if isinstance(peek_region, str)
+                else None
+            )
+            if state is not None:
+                try:
+                    if state.cursor.seen(int(payload["seq"])):
+                        self.duplicate_envelopes += 1
+                        self._observer.global_duplicate(DUP_SEQ_REPLAY)
+                        return False
+                except (KeyError, TypeError, ValueError):
+                    pass
+            payload = decode_global_envelope(payload)
+        state = self.regions.get(payload.region)
+        if state is None:
+            state = _RegionState()
+            self.regions[payload.region] = state
+        if not state.cursor.accept(payload.seq):
+            self.duplicate_envelopes += 1
+            self._observer.global_duplicate(DUP_SEQ_REPLAY)
+            return False
+        state.envelopes += 1
+        state.incidents += len(payload.incidents)
+        state.pressure_level = payload.pressure_level
+        if payload.watermark_ns > state.watermark_ns:
+            state.watermark_ns = payload.watermark_ns
+        if payload.head_ns > state.head_ns:
+            state.head_ns = payload.head_ns
+        self._pending.extend(payload.incidents)
+        self.envelopes += 1
+        self.ingested_incidents += len(payload.incidents)
+        self._observer.global_ingested(
+            payload.region, len(payload.incidents)
+        )
+        return True
+
+    # ---- reachability + watermarks -------------------------------------
+
+    def head_ns(self) -> int:
+        heads = [s.head_ns for s in self.regions.values()]
+        return max(heads) if heads else 0
+
+    def unreachable_regions(self) -> tuple[str, ...]:
+        """Regions whose head has aged past the staleness bound.
+
+        A dark region stops advancing its head while the others keep
+        shipping; once the spread exceeds ``region_stale_after_ns``
+        the region ages out of the session-close min — the structural
+        guarantee that a partition cannot wedge the healthy side.
+        """
+        head = self.head_ns()
+        stale = tuple(
+            sorted(
+                rid
+                for rid, s in self.regions.items()
+                if head - s.head_ns > self.region_stale_after_ns
+            )
+        )
+        for rid in self.regions:
+            self._observer.region_reachable(
+                rid, 0 if rid in stale else 1
+            )
+        return stale
+
+    def watermark_ns(self) -> int:
+        """Min watermark over reachable regions: the session clock."""
+        stale = set(self.unreachable_regions())
+        marks = [
+            s.watermark_ns
+            for rid, s in self.regions.items()
+            if s.watermark_ns and rid not in stale
+        ]
+        return min(marks) if marks else 0
+
+    # ---- rollup --------------------------------------------------------
+
+    def pump(self, flush: bool = False) -> list[GlobalIncident]:
+        """Fold buffered fleet pages; close quiet global sessions."""
+        unreachable = self.unreachable_regions()
+        self._pending.sort(key=lambda fi: fi.window_start_ns)
+        emitted = list(
+            self.rollup.observe(self._pending, unreachable)
+        )
+        self._pending = []
+        if flush:
+            emitted.extend(self.rollup.flush(unreachable))
+        else:
+            watermark = self.watermark_ns()
+            if watermark:
+                emitted.extend(
+                    self.rollup.close_up_to(watermark, unreachable)
+                )
+        head = self.head_ns()
+        for incident in emitted:
+            staleness_ms = max(
+                0.0, (head - incident.window_end_ns) / 1e6
+            )
+            if staleness_ms > self.max_staleness_ms:
+                self.max_staleness_ms = staleness_ms
+        self.incidents.extend(emitted)
+        return emitted
+
+    def backlog_incidents(self) -> int:
+        return len(self._pending) + self.rollup.open_groups()
+
+    def observe_pressure(self) -> int:
+        return self.pressure.observe(self.backlog_incidents())
+
+    # ---- reporting / failover / peer heal ------------------------------
+
+    def snapshot(self) -> dict[str, Any]:
+        stale = set(self.unreachable_regions())
+        return {
+            "global_id": self.global_id,
+            "regions": {
+                rid: {
+                    "seq_watermark": s.cursor.watermark,
+                    "out_of_order_accepted": len(s.cursor.accepted),
+                    "watermark_ns": s.watermark_ns,
+                    "head_ns": s.head_ns,
+                    "envelopes": s.envelopes,
+                    "incidents": s.incidents,
+                    "pressure_level": s.pressure_level,
+                    "reachable": rid not in stale,
+                }
+                for rid, s in sorted(self.regions.items())
+            },
+            "envelopes": self.envelopes,
+            "duplicate_envelopes": self.duplicate_envelopes,
+            "ingested_incidents": self.ingested_incidents,
+            "incidents_emitted": self.rollup.incidents_emitted,
+            "duplicates_suppressed": self.rollup.duplicates_suppressed,
+            "open_groups": self.rollup.open_groups(),
+            "max_staleness_ms": round(self.max_staleness_ms, 3),
+            "pressure_level": self.pressure.level,
+        }
+
+    def export_state(self) -> dict[str, Any]:
+        return {
+            "global_id": self.global_id,
+            "rollup": self.rollup.export_state(),
+            "regions": {
+                rid: {
+                    "cursor": s.cursor.export_state(),
+                    "watermark_ns": s.watermark_ns,
+                    "head_ns": s.head_ns,
+                    "envelopes": s.envelopes,
+                    "incidents": s.incidents,
+                    "pressure_level": s.pressure_level,
+                }
+                for rid, s in self.regions.items()
+            },
+            "pending": [fi.to_dict() for fi in self._pending],
+            "pressure": self.pressure.export_state(),
+            "max_staleness_ms": self.max_staleness_ms,
+        }
+
+    def restore_state(self, state: dict[str, Any]) -> None:
+        self.global_id = str(state.get("global_id", self.global_id))
+        if state.get("rollup"):
+            self.rollup.restore_state(state["rollup"])
+        self.regions = {}
+        for rid, raw in (state.get("regions") or {}).items():
+            rs = _RegionState(
+                watermark_ns=int(raw.get("watermark_ns", 0)),
+                head_ns=int(raw.get("head_ns", 0)),
+                envelopes=int(raw.get("envelopes", 0)),
+                incidents=int(raw.get("incidents", 0)),
+                pressure_level=int(raw.get("pressure_level", 0)),
+            )
+            if raw.get("cursor"):
+                rs.cursor.restore_state(raw["cursor"])
+            self.regions[str(rid)] = rs
+        self._pending = [
+            FleetIncident.from_dict(raw)
+            for raw in (state.get("pending") or [])
+        ]
+        if state.get("pressure"):
+            self.pressure.restore_state(state["pressure"])
+        self.max_staleness_ms = float(
+            state.get("max_staleness_ms", 0.0)
+        )
+
+    def merge_peer(self, peer_state: dict[str, Any]) -> int:
+        """Union a healed peer's emitted-window registry; returns adds.
+
+        The partition-heal handshake: each side calls this with the
+        other's :meth:`export_state` (only the registry is taken —
+        seq cursors stay per-link, open groups stay per-side).  After
+        the merge, a fault the peer already paged suppresses here even
+        when this side's replayed envelopes rebuild its session.
+        """
+        rollup_state = peer_state.get("rollup") or {}
+        return self.rollup.merge_emitted_windows(
+            rollup_state.get("emitted_windows") or []
+        )

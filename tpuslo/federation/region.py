@@ -35,10 +35,18 @@ from tpuslo.federation.backpressure import PressureController
 from tpuslo.federation.wire import (
     RegionEnvelope,
     decode_region_envelope,
+    encode_global_envelope,
     node_incident_from_wire,
     node_incident_to_wire,
 )
 from tpuslo.fleet.rollup import FleetIncident, FleetRollup, NodeIncident
+
+#: Bound on the region's global-envelope re-send spool.  Sized for the
+#: marquee WAN outage — an hour dark at one envelope per second — with
+#: headroom; older envelopes fall off first (their incidents were
+#: emitted long ago and the global registry would suppress them
+#: anyway).
+MAX_SPOOLED_GLOBAL_ENVELOPES = 4096
 
 
 class FederationObserver:
@@ -93,6 +101,13 @@ class RegionAggregator:
         self.duplicate_envelopes = 0
         self.ingested_incidents = 0
         self.max_staleness_ms = 0.0
+        # Region → global hop: incidents pumped since the last ship,
+        # the monotonic envelope seq, and the bounded re-send spool
+        # (the at-least-once half of the WAN contract — the global
+        # tier's gap-tolerant cursor is the exactly-once half).
+        self._unshipped_global: list[FleetIncident] = []
+        self._global_seq = -1
+        self._global_spool: list[dict[str, Any]] = []
 
     # ---- ingest --------------------------------------------------------
 
@@ -182,7 +197,54 @@ class RegionAggregator:
                 self.max_staleness_ms = staleness_ms
             self._observer.incident_staleness_ms(staleness_ms)
         self.incidents.extend(emitted)
+        self._unshipped_global.extend(emitted)
         return emitted
+
+    # ---- global hop (region → global tier) -----------------------------
+
+    def ship_global(self) -> dict[str, Any]:
+        """Package incidents pumped since the last ship as one envelope.
+
+        Ships every call even when no incidents closed — the envelope
+        carries the region's watermark and head, and the global tier
+        needs both to advance its session-close clock and to judge
+        this region reachable.  The encoded payload is also appended
+        to the bounded re-send spool, so a WAN outage replays from
+        here (``resend_global_since``) once the link heals.
+        """
+        self._global_seq += 1
+        payload = encode_global_envelope(
+            region=self.region_id,
+            seq=self._global_seq,
+            incidents=self._unshipped_global,
+            watermark_ns=self.watermark_ns(),
+            head_ns=self.head_ns(),
+            pressure_level=self.pressure.level,
+        )
+        self._unshipped_global = []
+        self._global_spool.append(payload)
+        if len(self._global_spool) > MAX_SPOOLED_GLOBAL_ENVELOPES:
+            del self._global_spool[
+                : len(self._global_spool)
+                - MAX_SPOOLED_GLOBAL_ENVELOPES
+            ]
+        return payload
+
+    def resend_global_since(self, seq: int) -> list[dict[str, Any]]:
+        """Spooled global envelopes with seq > the given cursor."""
+        return [
+            payload
+            for payload in self._global_spool
+            if payload["seq"] > seq
+        ]
+
+    def ack_global_up_to(self, seq: int) -> None:
+        """Drop spooled envelopes the global tier has acknowledged."""
+        self._global_spool = [
+            payload
+            for payload in self._global_spool
+            if payload["seq"] > seq
+        ]
 
     def backlog_incidents(self) -> int:
         """Buffered + open-group incidents (the pressure-loop backlog)."""
@@ -240,6 +302,11 @@ class RegionAggregator:
             ],
             "pressure": self.pressure.export_state(),
             "max_staleness_ms": self.max_staleness_ms,
+            "global_seq": self._global_seq,
+            "global_spool": [dict(p) for p in self._global_spool],
+            "unshipped_global": [
+                fi.to_dict() for fi in self._unshipped_global
+            ],
         }
 
     def restore_state(self, state: dict[str, Any]) -> None:
@@ -266,3 +333,11 @@ class RegionAggregator:
         self.max_staleness_ms = float(
             state.get("max_staleness_ms", 0.0)
         )
+        self._global_seq = int(state.get("global_seq", -1))
+        self._global_spool = [
+            dict(p) for p in state.get("global_spool") or []
+        ]
+        self._unshipped_global = [
+            FleetIncident.from_dict(raw)
+            for raw in state.get("unshipped_global") or []
+        ]

@@ -40,10 +40,18 @@ from typing import Any, Callable
 
 import numpy as np
 
+from tpuslo.attribution.mapper import map_fault_label
 from tpuslo.chaos.telemetry import ChaosScenario, ChaosStream
+from tpuslo.chaos.wan import WAN_HEAL, WanEvent, WanLink
 from tpuslo.columnar.gate import ColumnarGate
 from tpuslo.columnar.schema import from_rows
 from tpuslo.federation.cluster import ClusterAggregator
+from tpuslo.federation.global_tier import (
+    BLAST_GLOBAL,
+    GlobalAggregator,
+    GlobalIncident,
+    GlobalObserver,
+)
 from tpuslo.federation.region import FederationObserver, RegionAggregator
 from tpuslo.fleet.aggregator import FleetObserver
 from tpuslo.fleet.rollup import FleetIncident
@@ -291,6 +299,7 @@ class FederationSimulator:
         node_dedup_window: int = 4096,
         observer: FederationObserver | None = None,
         fleet_observer: FleetObserver | None = None,
+        region_id: str = "region-0",
     ):
         self.topology = topology
         self.seed = seed
@@ -314,7 +323,7 @@ class FederationSimulator:
                 fleet_observer=fleet_observer,
             )
         self.region = RegionAggregator(
-            region_id="region-0",
+            region_id=region_id,
             rollup_gap_ns=rollup_gap_ns,
             capacity_incidents=region_capacity_incidents,
             observer=self.observer,
@@ -508,13 +517,82 @@ class FederationSimulator:
                     accepted += 1
         self.region = fresh
         return {
-            "killed": "region-0",
+            "killed": fresh.region_id,
             "restored_clusters": len(fresh.clusters),
             "resent_envelopes": resent,
             "accepted_resends": accepted,
         }
 
     # ---- correctness lane ----------------------------------------------
+
+    def step(
+        self,
+        round_i: int,
+        injections: list[FaultInjection],
+        churn_events: tuple[ChurnEvent, ...] = (),
+        on_envelopes_landed: Callable[[], None] | None = None,
+    ) -> list[FleetIncident]:
+        """Drive one simulated round; returns the incidents it paged.
+
+        The per-round body of :meth:`run`, factored out so a global
+        simulator can interleave many regions on one simulated clock
+        (each region steps, then ships its global envelope over its
+        WAN link).  ``on_envelopes_landed`` fires after the round's
+        cluster envelopes reached the region but before pressure
+        propagation — the point where :meth:`run` injects the region
+        kill.
+        """
+        topo = self.topology
+        self._apply_churn(list(churn_events))
+        active: dict[tuple[int, int], FaultInjection] = {}
+        fault_nodes: set[int] = set()
+        for injection in injections:
+            if (
+                injection.at_round
+                <= round_i
+                < injection.at_round + injection.duration_rounds
+            ):
+                for pair in injection.affected(topo):
+                    active[pair] = injection
+                    fault_nodes.add(pair[0])
+        levels = {
+            cid: cluster.effective_level()
+            for cid, cluster in self.clusters.items()
+        }
+        for node_i in sorted(self._alive):
+            if node_i in fault_nodes:
+                # Fault evidence never coarsens: a pressured agent
+                # flushes anomalous batches at full cadence.
+                self._ship_fault_node(node_i, round_i, active)
+                continue
+            cid = topo.cluster_of_node(node_i)
+            cadence = self.heartbeat_every << min(levels[cid], 2)
+            if (round_i + node_i) % cadence == 0:
+                self.clusters[cid].ingest(
+                    self._hb_payload(node_i, round_i)
+                )
+        for cluster in self.clusters.values():
+            cluster.observe_pressure()
+            self.region.ingest(cluster.close_and_ship())
+        if on_envelopes_landed is not None:
+            on_envelopes_landed()
+        region_level = self.region.observe_pressure()
+        level_now = region_level
+        for cid, cluster in self.clusters.items():
+            cluster.set_upstream_pressure(region_level)
+            level_now = max(level_now, cluster.effective_level())
+        self.max_level_seen = max(self.max_level_seen, level_now)
+        emitted = self.region.pump()
+        self.incidents.extend(emitted)
+        return emitted
+
+    def finish(self) -> list[FleetIncident]:
+        """End of stream: flush every cluster and the region rollup."""
+        for cluster in self.clusters.values():
+            self.region.ingest(cluster.close_and_ship(flush=True))
+        emitted = self.region.pump(flush=True)
+        self.incidents.extend(emitted)
+        return emitted
 
     def run(
         self,
@@ -532,7 +610,6 @@ class FederationSimulator:
         round, and ``kill_region_at`` restores the region from the
         *stale* pre-round snapshot exactly like a real crash would.
         """
-        topo = self.topology
         churn_by_round: dict[int, list[ChurnEvent]] = {}
         for event in churn or []:
             churn_by_round.setdefault(event.round_i, []).append(event)
@@ -556,66 +633,39 @@ class FederationSimulator:
             if runtime is not None:
                 last_snapshot = runtime.export_components()
                 runtime.snapshot_now()
-            self._apply_churn(churn_by_round.get(round_i, ()))
-            active: dict[tuple[int, int], FaultInjection] = {}
-            fault_nodes: set[int] = set()
-            for injection in injections:
-                if (
-                    injection.at_round
-                    <= round_i
-                    < injection.at_round + injection.duration_rounds
-                ):
-                    for pair in injection.affected(topo):
-                        active[pair] = injection
-                        fault_nodes.add(pair[0])
-            levels = {
-                cid: cluster.effective_level()
-                for cid, cluster in self.clusters.items()
-            }
-            for node_i in sorted(self._alive):
-                if node_i in fault_nodes:
-                    # Fault evidence never coarsens: a pressured agent
-                    # flushes anomalous batches at full cadence.
-                    self._ship_fault_node(node_i, round_i, active)
-                    continue
-                cid = topo.cluster_of_node(node_i)
-                cadence = self.heartbeat_every << min(levels[cid], 2)
-                if (round_i + node_i) % cadence == 0:
-                    self.clusters[cid].ingest(
-                        self._hb_payload(node_i, round_i)
-                    )
-            for cluster in self.clusters.values():
-                cluster.observe_pressure()
-                self.region.ingest(cluster.close_and_ship())
+
+            on_envelopes_landed = None
             if kill_region_at is not None and round_i == kill_region_at:
                 # Kill AFTER the round's envelopes landed: everything
                 # the dying region ingested since the round-start
                 # snapshot exists only in its memory, so the restore is
                 # genuinely stale and the spool re-send must cover it.
-                exported = (
-                    last_snapshot.get("federation/region")
-                    if last_snapshot
-                    else None
-                )
-                failover = self.kill_region(exported)
-                if log:
-                    log(
-                        "region failover: restored "
-                        f"{failover['restored_clusters']} cluster "
-                        f"cursors, re-sent "
-                        f"{failover['resent_envelopes']} envelopes "
-                        f"({failover['accepted_resends']} accepted)"
+                def on_envelopes_landed(
+                    snap: dict[str, Any] = last_snapshot,
+                ) -> None:
+                    nonlocal failover
+                    exported = (
+                        snap.get("federation/region")
+                        if snap
+                        else None
                     )
-            region_level = self.region.observe_pressure()
-            level_now = region_level
-            for cid, cluster in self.clusters.items():
-                cluster.set_upstream_pressure(region_level)
-                level_now = max(level_now, cluster.effective_level())
-            self.max_level_seen = max(self.max_level_seen, level_now)
-            self.incidents.extend(self.region.pump())
-        for cluster in self.clusters.values():
-            self.region.ingest(cluster.close_and_ship(flush=True))
-        self.incidents.extend(self.region.pump(flush=True))
+                    failover = self.kill_region(exported)
+                    if log:
+                        log(
+                            "region failover: restored "
+                            f"{failover['restored_clusters']} cluster "
+                            f"cursors, re-sent "
+                            f"{failover['resent_envelopes']} envelopes "
+                            f"({failover['accepted_resends']} accepted)"
+                        )
+
+            self.step(
+                round_i,
+                injections,
+                tuple(churn_by_round.get(round_i, ())),
+                on_envelopes_landed=on_envelopes_landed,
+            )
+        self.finish()
         sampled: dict[int, int] = {}
         observations: dict[int, int] = {}
         for cluster in self.clusters.values():
@@ -719,3 +769,463 @@ class FederationSimulator:
             region_incidents=len(self.incidents),
             max_staleness_ms=self.region.max_staleness_ms,
         )
+
+
+# ---------------------------------------------------------------------------
+# Global tier: N regions peered over seeded WAN links.
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class GlobalFaultInjection:
+    """One fault in the global ground truth: a (namespace, domain)
+    probe hitting one or more regions at the same simulated instant.
+
+    Exactly one global page per entry is the contract the sweep
+    scores — a multi-region entry must fold to ONE page whose members
+    span its regions, never one page per region.
+    """
+
+    name: str
+    label: str
+    namespace: str
+    scope: str  # pod | node | slice | fleet (within each region)
+    at_round: int
+    regions: tuple[str, ...]
+    duration_rounds: int = 2
+    target: Any = 0
+
+    @property
+    def domain(self) -> str:
+        return map_fault_label(self.label)
+
+    def regional(self, region_id: str) -> FaultInjection:
+        """The per-region injection this probe plants in one region."""
+        return FaultInjection(
+            name=f"{self.name}@{region_id}",
+            label=self.label,
+            namespace=self.namespace,
+            scope=self.scope,
+            at_round=self.at_round,
+            duration_rounds=self.duration_rounds,
+            target=self.target,
+        )
+
+    def expected_blast_radius(self) -> str:
+        if len(set(self.regions)) > 1:
+            return BLAST_GLOBAL
+        return self.regional(self.regions[0]).expected_blast_radius()
+
+
+def global_injection_plan(
+    topology: FederationTopology,
+    region_ids: list[str],
+    start_round: int = 2,
+    dark_region: str | None = None,
+    dark_round: int | None = None,
+) -> list[GlobalFaultInjection]:
+    """The canonical global sweep plan.
+
+    Distinct (namespace, domain) per entry — ground truth is exactly
+    one global page each — plus the tier-specific probes: the
+    cross-REGION fault (one domain hitting two regions at the same
+    instant must page once, the identity contract this tier exists
+    for) and the cross-tenant concurrency probe now flavored across
+    regions (same domain, same instant, two tenants in two regions —
+    exactly two pages).  When ``dark_region`` is set, two more land:
+    a healthy-region fault mid-darkness (the healthy side must page
+    it while the partition is open — session closes never wedge) and
+    a fault INSIDE the dark region (its page rides the spool and must
+    arrive after heal exactly once, never lost).
+    """
+    if len(region_ids) < 2:
+        raise ValueError("global plan needs at least two regions")
+    t_a, t_b = topology.tenants[0], topology.tenants[1]
+    slices = topology.slices()
+    nodes = topology.nodes
+    r = start_round
+    n = len(region_ids)
+
+    def node_in_slice(slice_i: int, offset: int) -> int:
+        return min(
+            nodes - 1,
+            topology.first_node_of_slice(slice_i % slices) + offset,
+        )
+
+    plan = [
+        GlobalFaultInjection(
+            name="r0-node-mem", label="memory_pressure",
+            namespace=t_a, scope="node", at_round=r,
+            regions=(region_ids[0],), target=node_in_slice(1, 2),
+        ),
+        GlobalFaultInjection(
+            name="r1-slice-ici", label="ici_drop",
+            namespace=t_a, scope="slice", at_round=r + 2,
+            regions=(region_ids[1],), target=0,
+        ),
+        # Cross-region identity probe: ONE page, members in both.
+        GlobalFaultInjection(
+            name="xr-hbm", label="hbm_pressure",
+            namespace=t_b, scope="fleet", at_round=r + 4,
+            regions=(region_ids[0], region_ids[1]),
+            target=tuple(range(min(2, slices))),
+        ),
+        # Cross-tenant probe, cross-region flavored: two pages.
+        GlobalFaultInjection(
+            name="xt-dns-a", label="dns_latency",
+            namespace=t_a, scope="node", at_round=r + 6,
+            regions=(region_ids[2 % n],), target=node_in_slice(0, 3),
+        ),
+        GlobalFaultInjection(
+            name="xt-dns-b", label="dns_latency",
+            namespace=t_b, scope="node", at_round=r + 6,
+            regions=(region_ids[3 % n],), target=node_in_slice(1, 4),
+        ),
+    ]
+    if dark_region is not None:
+        dr = dark_round if dark_round is not None else r + 8
+        healthy = next(
+            rid for rid in region_ids if rid != dark_region
+        )
+        plan.append(
+            GlobalFaultInjection(
+                name="mid-dcn", label="dcn_degradation",
+                namespace=t_a, scope="node", at_round=dr + 6,
+                regions=(healthy,), target=node_in_slice(2, 5),
+            )
+        )
+        plan.append(
+            GlobalFaultInjection(
+                name="dark-pod-cpu", label="cpu_throttle",
+                namespace=t_b, scope="pod", at_round=dr + 10,
+                regions=(dark_region,),
+                target=(
+                    node_in_slice(0, 1),
+                    topology.tenant_pods(t_b)[0],
+                ),
+            )
+        )
+    return plan
+
+
+@dataclass
+class GlobalRunResult:
+    """Outcome of one global correctness-lane run."""
+
+    incidents: list[GlobalIncident]
+    plan: list[GlobalFaultInjection]
+    rounds: int
+    drain_rounds_used: int
+    global_snapshot: dict[str, Any] = field(default_factory=dict)
+    link_snapshots: dict[str, dict[str, Any]] = field(
+        default_factory=dict
+    )
+    region_snapshots: dict[str, dict[str, Any]] = field(
+        default_factory=dict
+    )
+    #: Per healed region: heal_round, backlog_at_heal, replay_rounds
+    #: (rounds from heal to spool fully drained), max_out_of_order
+    #: (peak size of the global cursor's sparse accepted set — > 0 is
+    #: the proof that fresh envelopes overtook the backlog).
+    heal_stats: dict[str, dict[str, Any]] = field(default_factory=dict)
+    #: Every page in emission order: (round, incident_id, scope).
+    emits: list[tuple[int, str, str]] = field(default_factory=list)
+
+
+@dataclass
+class GlobalIngestMeasurement:
+    """Outcome of the 100k-node global throughput lane."""
+
+    nodes: int
+    regions: int
+    clusters: int
+    shards: int
+    total_events: int
+    events_per_sec: float
+    slowest_region: str
+    per_region_events_per_sec: dict[str, float]
+    global_fold_ms: float
+    global_incidents: int
+
+
+class GlobalSimulator:
+    """N federated regions peered over seeded WAN links, one box.
+
+    Each region is a full :class:`FederationSimulator` (clusters +
+    region aggregator) on a shared simulated clock; every round each
+    region steps, ships its region→global envelope, and its
+    :class:`~tpuslo.chaos.wan.WanLink` decides what actually crosses
+    the WAN (latency, one-way loss, dark, bounded replay budget with
+    fresh overtake).  ``round_s`` defaults to 60 so "a region dark
+    for an hour" is sixty rounds of event time, not an hour of wall
+    time — everything downstream (windows, gaps, staleness bounds)
+    scales off the same round length.
+    """
+
+    def __init__(
+        self,
+        regions: int = 4,
+        nodes_per_region: int = 96,
+        clusters_per_region: int = 2,
+        shards_per_cluster: int = 2,
+        seed: int = 1337,
+        round_s: float = 60.0,
+        replay_budget: int = 8,
+        wan_latency_rounds: int = 0,
+        region_stale_after_rounds: int = 3,
+        chaos_intensity: float = 0.0,
+        observer: GlobalObserver | None = None,
+        federation_observer: FederationObserver | None = None,
+    ):
+        if regions < 2:
+            raise ValueError("global tier needs at least two regions")
+        self.seed = seed
+        self.round_s = round_s
+        self.round_ns = int(round_s * 1e9)
+        self.region_ids = [f"region-{i}" for i in range(regions)]
+        self.topology = FederationTopology.for_nodes(
+            nodes_per_region, clusters=clusters_per_region
+        )
+        self.sims: dict[str, FederationSimulator] = {}
+        for i, rid in enumerate(self.region_ids):
+            self.sims[rid] = FederationSimulator(
+                self.topology,
+                shards_per_cluster=shards_per_cluster,
+                seed=seed + 101 * i,
+                chaos_intensity=chaos_intensity,
+                round_s=round_s,
+                window_ns=2 * self.round_ns,
+                rollup_gap_ns=5 * self.round_ns,
+                stale_after_ns=8 * self.round_ns,
+                observer=federation_observer,
+                region_id=rid,
+            )
+        self.links = {
+            rid: WanLink(
+                rid,
+                latency_rounds=wan_latency_rounds,
+                replay_budget=replay_budget,
+            )
+            for rid in self.region_ids
+        }
+        self.global_agg = GlobalAggregator(
+            rollup_gap_ns=5 * self.round_ns,
+            region_stale_after_ns=(
+                region_stale_after_rounds * self.round_ns
+            ),
+            observer=observer,
+        )
+        self.emits: list[tuple[int, str, str]] = []
+        self.heal_stats: dict[str, dict[str, Any]] = {}
+        self._healing: dict[str, int] = {}
+
+    # ---- WAN transfer --------------------------------------------------
+
+    def _unacked(self, rid: str) -> list[dict[str, Any]]:
+        link = self.links[rid]
+        return [
+            p
+            for p in self.sims[rid].region.resend_global_since(
+                link.ack_watermark
+            )
+            if not link.acked(p["seq"])
+        ]
+
+    def _transfer(self, round_i: int) -> None:
+        """One WAN tick: regions offer, links deliver, acks trim."""
+        for rid in self.region_ids:
+            link = self.links[rid]
+            in_flight = link.in_flight_seqs()
+            candidates = [
+                p
+                for p in self._unacked(rid)
+                if p["seq"] not in in_flight
+            ]
+            link.offer(round_i, link.select_for_send(candidates))
+        for rid, link in self.links.items():
+            for payload in link.due(round_i):
+                self.global_agg.ingest(payload)
+                # The receiver acks duplicates too — an ack only says
+                # "I hold this seq", which is as true the second time.
+                link.on_ack(payload["seq"])
+            self.sims[rid].region.ack_global_up_to(link.ack_watermark)
+            state = self.global_agg.regions.get(rid)
+            stats = self.heal_stats.get(rid)
+            if state is not None and stats is not None:
+                stats["max_out_of_order"] = max(
+                    stats["max_out_of_order"],
+                    len(state.cursor.accepted),
+                )
+
+    def _pump_global(self, round_i: int) -> list[GlobalIncident]:
+        emitted = self.global_agg.pump()
+        for gi in emitted:
+            self.emits.append((round_i, gi.incident_id, gi.scope))
+        for rid, heal_round in list(self._healing.items()):
+            if (
+                not self._unacked(rid)
+                and not self.links[rid].in_flight_seqs()
+            ):
+                self.heal_stats[rid]["replay_rounds"] = (
+                    round_i - heal_round
+                )
+                del self._healing[rid]
+        return emitted
+
+    # ---- correctness lane ----------------------------------------------
+
+    def run(
+        self,
+        rounds: int,
+        plan: list[GlobalFaultInjection],
+        wan_events: list[WanEvent] | None = None,
+        drain_rounds: int = 32,
+    ) -> GlobalRunResult:
+        """Drive every region + the WAN + the global tier in lockstep."""
+        per_region: dict[str, list[FaultInjection]] = {
+            rid: [] for rid in self.region_ids
+        }
+        for injection in plan:
+            for rid in injection.regions:
+                if rid not in per_region:
+                    raise ValueError(f"unknown region {rid!r}")
+                per_region[rid].append(injection.regional(rid))
+        events_by_round: dict[int, list[WanEvent]] = {}
+        for event in wan_events or []:
+            events_by_round.setdefault(event.round_i, []).append(
+                event
+            )
+        for round_i in range(rounds):
+            for event in events_by_round.get(round_i, ()):
+                link = self.links[event.region]
+                was_down = not (
+                    link.forward_up and link.backward_up
+                )
+                link.apply(event)
+                if event.action == WAN_HEAL and was_down:
+                    self._healing[event.region] = round_i
+                    self.heal_stats[event.region] = {
+                        "heal_round": round_i,
+                        "backlog_at_heal": len(
+                            self._unacked(event.region)
+                        ),
+                        "replay_rounds": -1,
+                        "max_out_of_order": 0,
+                    }
+            for rid, sim in self.sims.items():
+                # The region itself is healthy while its WAN is dark:
+                # clusters keep shipping, the region keeps paging,
+                # and every page lands in the global-hop spool.
+                sim.step(round_i, per_region[rid])
+                sim.region.ship_global()
+            self._transfer(round_i)
+            self._pump_global(round_i)
+        # End of stream: flush the regions, ship the remainder, then
+        # keep ticking the links until every spool drains (the drain
+        # only converges once the chaos schedule has healed them).
+        for sim in self.sims.values():
+            sim.finish()
+            sim.region.ship_global()
+        used = 0
+        for extra in range(max(1, drain_rounds)):
+            round_i = rounds + extra
+            used = extra + 1
+            self._transfer(round_i)
+            self._pump_global(round_i)
+            if all(
+                not self._unacked(rid)
+                and not link.in_flight_seqs()
+                for rid, link in self.links.items()
+            ):
+                break
+        for gi in self.global_agg.pump(flush=True):
+            self.emits.append((rounds + used, gi.incident_id, gi.scope))
+        return GlobalRunResult(
+            incidents=list(self.global_agg.incidents),
+            plan=list(plan),
+            rounds=rounds,
+            drain_rounds_used=used,
+            global_snapshot=self.global_agg.snapshot(),
+            link_snapshots={
+                rid: link.snapshot()
+                for rid, link in self.links.items()
+            },
+            region_snapshots={
+                rid: sim.region.snapshot()
+                for rid, sim in self.sims.items()
+            },
+            heal_stats=dict(self.heal_stats),
+            emits=list(self.emits),
+        )
+
+
+def measure_global_ingest(
+    regions: int = 10,
+    nodes_per_region: int = 10_000,
+    clusters_per_region: int = 4,
+    shards_per_cluster: int = 4,
+    events_per_node: int = 600,
+    seed: int = 1337,
+) -> GlobalIngestMeasurement:
+    """The 100k-node lane: ten 10k-node regions plus the global hop.
+
+    Each region is measured with the PR 15 discipline (total events
+    over the slowest SHARD's busy time — the wall time its parallel
+    shard ring would take), and regions deploy in parallel too, so
+    the global figure divides the grand total by the slowest
+    REGION's busy time.  The region→global hop is timed separately
+    as fold latency.  Regions run sequentially in-process and are
+    released as they finish — the harness never holds ten 10k-node
+    trees in memory at once.
+    """
+    topology = FederationTopology.for_nodes(
+        nodes_per_region, clusters=clusters_per_region
+    )
+    agg = GlobalAggregator()
+    total_events = 0
+    shard_count = 0
+    busiest_ns = 0
+    slowest = ""
+    per_region: dict[str, float] = {}
+    fold_ns = 0
+    for i in range(regions):
+        rid = f"region-{i}"
+        sim = FederationSimulator(
+            topology,
+            shards_per_cluster=shards_per_cluster,
+            seed=seed + 101 * i,
+            region_id=rid,
+        )
+        m = sim.measure_ingest(events_per_node)
+        total_events += m.total_events
+        shard_count += m.shards
+        per_region[rid] = round(m.events_per_sec, 1)
+        region_busy_ns = (
+            int(m.total_events / m.events_per_sec * 1e9)
+            if m.events_per_sec
+            else 0
+        )
+        if region_busy_ns > busiest_ns:
+            busiest_ns = region_busy_ns
+            slowest = rid
+        t0 = time.perf_counter_ns()
+        agg.ingest(sim.region.ship_global())
+        fold_ns += time.perf_counter_ns() - t0
+        del sim
+    t0 = time.perf_counter_ns()
+    agg.pump(flush=True)
+    fold_ns += time.perf_counter_ns() - t0
+    return GlobalIngestMeasurement(
+        nodes=regions * nodes_per_region,
+        regions=regions,
+        clusters=regions * clusters_per_region,
+        shards=shard_count,
+        total_events=total_events,
+        events_per_sec=(
+            total_events / (busiest_ns / 1e9) if busiest_ns else 0.0
+        ),
+        slowest_region=slowest,
+        per_region_events_per_sec=per_region,
+        global_fold_ms=round(fold_ns / 1e6, 3),
+        global_incidents=len(agg.incidents),
+    )

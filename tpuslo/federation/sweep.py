@@ -1,6 +1,8 @@
-"""Federation-sweep release gate: 10k nodes, churn, kill, saturation.
+"""Federation release gates: the 10k-node region sweep and the
+100k-node global sweep.
 
-Four contracts, one seeded run (``tpuslo m5gate --federation-sweep``):
+Four region contracts, one seeded run (``tpuslo m5gate
+--federation-sweep``):
 
 1. **Aggregate ingest throughput** — 10k simulated nodes over the
    two-level tree must sustain at least the PR 9 single-level floor
@@ -22,22 +24,63 @@ Four contracts, one seeded run (``tpuslo m5gate --federation-sweep``):
    tier, sampled rows counted by level), while STILL paging every
    injected fault exactly once and keeping incident staleness under
    the ceiling — resolution degrades, correctness never.
+
+And four GLOBAL contracts, one seeded WAN-chaos run (``tpuslo m5gate
+--global-sweep``):
+
+1. **100k-node aggregate ingest** — ten 10k-node regions deployed in
+   parallel must sustain the same ≥ 5M events/s floor through the
+   three-tier tree, with the region→global fold timed separately.
+2. **Cross-region identity under WAN degradation** — with
+   hundreds-of-ms link latency and a one-way ack-loss window (frames
+   arrive, acks vanish, the sender replays what the receiver already
+   holds), every injected fault pages exactly once globally; the
+   cross-region fault pages ONCE at ``global`` radius with members
+   from both regions, and the seq-replay dedup is shown actually
+   firing.
+3. **Hour-dark rejoin** — one region's WAN link dark for an hour of
+   simulated time, then healed: the incident set equals the
+   no-chaos baseline exactly (zero lost, zero duplicate pages), the
+   spool replays within the bounded replay budget, fresh envelopes
+   overtake the backlog, and the healthy side keeps paging WHILE the
+   partition is open — an asymmetric partition never wedges session
+   closes.
+4. **Split-brain heal** — two global peers page the same fault from
+   opposite sides of a partition (both honestly ``partition_scoped``),
+   then reconcile by emitted-window registry merge: the rejoined
+   side's replay is suppressed, never re-paged.
 """
 
 from __future__ import annotations
 
+import math
 import os
 import tempfile
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+from tpuslo.chaos.wan import (
+    WAN_ACK_LOSS,
+    WAN_DARK,
+    WAN_HEAL,
+    WanEvent,
+)
 from tpuslo.federation.backpressure import LEVEL_SAMPLE
+from tpuslo.federation.global_tier import (
+    GlobalAggregator,
+    GlobalIncident,
+)
 from tpuslo.federation.simulator import (
     FederationSimulator,
     FederationTopology,
+    GlobalFaultInjection,
+    GlobalSimulator,
     build_churn_plan,
     federation_injection_plan,
+    global_injection_plan,
+    measure_global_ingest,
 )
+from tpuslo.federation.wire import encode_global_envelope
 from tpuslo.fleet.rollup import FleetIncident
 from tpuslo.fleet.sweep import IncidentMatch, score_incidents
 
@@ -378,3 +421,538 @@ def run_federation_sweep(
                 f"{max_staleness_ms:.0f} ms ceiling"
             )
     return report
+
+
+# ---------------------------------------------------------------------------
+# Global sweep: the 100k-node WAN-chaos gate.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class GlobalIncidentMatch:
+    """One plan entry scored against the emitted global pages."""
+
+    injection: str
+    expected_regions: list[str]
+    expected_blast_radius: str
+    matched_count: int = 0
+    matched_regions: list[str] = field(default_factory=list)
+    matched_blast_radius: str = ""
+    exact: bool = False
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "injection": self.injection,
+            "expected_regions": list(self.expected_regions),
+            "expected_blast_radius": self.expected_blast_radius,
+            "matched_count": self.matched_count,
+            "matched_regions": list(self.matched_regions),
+            "matched_blast_radius": self.matched_blast_radius,
+            "exact": self.exact,
+        }
+
+
+def score_global_incidents(
+    plan: list[GlobalFaultInjection],
+    incidents: list[GlobalIncident],
+) -> tuple[list[GlobalIncidentMatch], float, float]:
+    """Exactly-one-page-per-injection, with region provenance.
+
+    ``exact`` demands the single matched page carries the expected
+    blast radius AND exactly the injected region set — a
+    cross-region fault that paged per-region (two pages) or a page
+    missing one side's members both fail.
+    """
+    claimed: set[int] = set()
+    matches: list[GlobalIncidentMatch] = []
+    for injection in plan:
+        hits = [
+            (i, gi)
+            for i, gi in enumerate(incidents)
+            if gi.namespace == injection.namespace
+            and gi.domain == injection.domain
+        ]
+        match = GlobalIncidentMatch(
+            injection=injection.name,
+            expected_regions=sorted(set(injection.regions)),
+            expected_blast_radius=injection.expected_blast_radius(),
+            matched_count=len(hits),
+        )
+        if hits:
+            claimed.update(i for i, _ in hits)
+            gi = hits[0][1]
+            match.matched_regions = list(gi.regions)
+            match.matched_blast_radius = gi.blast_radius
+            match.exact = (
+                len(hits) == 1
+                and gi.blast_radius == match.expected_blast_radius
+                and gi.regions == match.expected_regions
+            )
+        matches.append(match)
+    spurious = len(incidents) - len(claimed)
+    split_extras = sum(
+        max(0, m.matched_count - 1) for m in matches
+    )
+    exact = sum(1 for m in matches if m.exact)
+    precision = exact / max(1, exact + spurious + split_extras)
+    recall = exact / max(1, len(plan))
+    return matches, precision, recall
+
+
+def _global_keys(incidents: list[GlobalIncident]) -> list[str]:
+    """Rejoin-comparable identity (namespace/domain/blast radius)."""
+    return sorted(
+        f"{gi.namespace}/{gi.domain}/{gi.blast_radius}"
+        for gi in incidents
+    )
+
+
+@dataclass
+class GlobalSweepReport:
+    """Gate verdict for one global WAN-chaos sweep."""
+
+    regions: int
+    nodes_per_region: int
+    seed: int
+    round_s: float
+    replay_budget: int
+    wan_latency_rounds: int
+    dark_rounds: int
+    min_ingest_events_per_sec: float
+    ingest: dict[str, Any] = field(default_factory=dict)
+    matches: list[GlobalIncidentMatch] = field(default_factory=list)
+    incidents: list[dict[str, Any]] = field(default_factory=list)
+    precision: float = 0.0
+    recall: float = 0.0
+    wan: dict[str, Any] = field(default_factory=dict)
+    dark: dict[str, Any] = field(default_factory=dict)
+    splitbrain: dict[str, Any] = field(default_factory=dict)
+    failures: list[str] = field(default_factory=list)
+
+    @property
+    def passed(self) -> bool:
+        return not self.failures
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "regions": self.regions,
+            "nodes_per_region": self.nodes_per_region,
+            "seed": self.seed,
+            "round_s": self.round_s,
+            "replay_budget": self.replay_budget,
+            "wan_latency_rounds": self.wan_latency_rounds,
+            "dark_rounds": self.dark_rounds,
+            "min_ingest_events_per_sec": (
+                self.min_ingest_events_per_sec
+            ),
+            "ingest": dict(self.ingest),
+            "matches": [m.to_dict() for m in self.matches],
+            "incidents": list(self.incidents),
+            "precision": round(self.precision, 4),
+            "recall": round(self.recall, 4),
+            "wan": dict(self.wan),
+            "dark": dict(self.dark),
+            "splitbrain": dict(self.splitbrain),
+            "passed": self.passed,
+            "failures": list(self.failures),
+        }
+
+
+def run_global_sweep(
+    regions: int = 4,
+    nodes_per_region: int = 96,
+    clusters_per_region: int = 2,
+    shards_per_cluster: int = 2,
+    seed: int = 1337,
+    round_s: float = 60.0,
+    replay_budget: int = 8,
+    wan_latency_rounds: int = 2,
+    ack_loss_rounds: int = 6,
+    dark_at_round: int = 10,
+    dark_rounds: int = 60,
+    ingest_regions: int = 10,
+    ingest_nodes_per_region: int = 10_000,
+    ingest_clusters_per_region: int = 4,
+    ingest_shards_per_cluster: int = 4,
+    events_per_node: int = 600,
+    min_ingest_events_per_sec: float = 5_000_000.0,
+    measure_ingest_lane: bool = True,
+    observer=None,
+    log: Callable[[str], None] | None = None,
+) -> GlobalSweepReport:
+    """Run all four global contracts; deterministic per seed."""
+    report = GlobalSweepReport(
+        regions=regions,
+        nodes_per_region=nodes_per_region,
+        seed=seed,
+        round_s=round_s,
+        replay_budget=replay_budget,
+        wan_latency_rounds=wan_latency_rounds,
+        dark_rounds=dark_rounds,
+        min_ingest_events_per_sec=min_ingest_events_per_sec,
+    )
+
+    def _sim(**overrides: Any) -> GlobalSimulator:
+        kwargs: dict[str, Any] = dict(
+            regions=regions,
+            nodes_per_region=nodes_per_region,
+            clusters_per_region=clusters_per_region,
+            shards_per_cluster=shards_per_cluster,
+            seed=seed,
+            round_s=round_s,
+            replay_budget=replay_budget,
+            observer=observer,
+        )
+        kwargs.update(overrides)
+        return GlobalSimulator(**kwargs)
+
+    # ---- phase 1: 100k-node aggregate ingest --------------------------
+    if measure_ingest_lane:
+        measurement = measure_global_ingest(
+            regions=ingest_regions,
+            nodes_per_region=ingest_nodes_per_region,
+            clusters_per_region=ingest_clusters_per_region,
+            shards_per_cluster=ingest_shards_per_cluster,
+            events_per_node=events_per_node,
+            seed=seed,
+        )
+        report.ingest = {
+            "nodes": measurement.nodes,
+            "regions": measurement.regions,
+            "clusters": measurement.clusters,
+            "shards": measurement.shards,
+            "total_events": measurement.total_events,
+            "events_per_sec": round(measurement.events_per_sec),
+            "slowest_region": measurement.slowest_region,
+            "per_region_events_per_sec": dict(
+                measurement.per_region_events_per_sec
+            ),
+            "global_fold_ms": measurement.global_fold_ms,
+        }
+        if log:
+            log(
+                f"ingest: {measurement.events_per_sec / 1e6:.2f}M "
+                f"events/s aggregate over {measurement.nodes} nodes "
+                f"in {measurement.regions} regions "
+                f"({measurement.shards} shards), global fold "
+                f"{measurement.global_fold_ms:.1f} ms"
+            )
+        if measurement.events_per_sec < min_ingest_events_per_sec:
+            report.failures.append(
+                f"aggregate ingest {measurement.events_per_sec:,.0f} "
+                f"events/s below the "
+                f"{min_ingest_events_per_sec:,.0f} floor at "
+                f"{measurement.nodes} nodes"
+            )
+
+    # ---- phase 2: cross-region identity under WAN degradation ---------
+    wan_sim = _sim(wan_latency_rounds=wan_latency_rounds)
+    plan = global_injection_plan(wan_sim.topology, wan_sim.region_ids)
+    lossy = wan_sim.region_ids[1]
+    wan_events = [
+        WanEvent(4, lossy, WAN_ACK_LOSS),
+        WanEvent(4 + ack_loss_rounds, lossy, WAN_HEAL),
+    ]
+    wan_run = wan_sim.run(20, plan, wan_events=wan_events)
+    matches, precision, recall = score_global_incidents(
+        plan, wan_run.incidents
+    )
+    report.matches = matches
+    report.incidents = [gi.to_dict() for gi in wan_run.incidents]
+    report.precision = precision
+    report.recall = recall
+    dup_envelopes = wan_run.global_snapshot["duplicate_envelopes"]
+    lost_acks = wan_run.link_snapshots[lossy]["lost_acks"]
+    report.wan = {
+        "latency_rounds": wan_latency_rounds,
+        "ack_loss_region": lossy,
+        "ack_loss_rounds": ack_loss_rounds,
+        "lost_acks": lost_acks,
+        "duplicate_envelopes": dup_envelopes,
+        "links": dict(wan_run.link_snapshots),
+    }
+    if log:
+        log(
+            f"wan: {len(wan_run.incidents)} pages for {len(plan)} "
+            f"injections at {wan_latency_rounds}-round latency "
+            f"({lost_acks} acks lost, {dup_envelopes} replayed "
+            f"envelopes deduped) — precision {precision:.3f} "
+            f"recall {recall:.3f}"
+        )
+    if precision < 1.0 or recall < 1.0:
+        detail = "; ".join(
+            f"{m.injection}: matched {m.matched_count} "
+            f"(regions {m.matched_regions or 'none'}, expected "
+            f"{m.expected_regions})"
+            for m in matches
+            if not m.exact
+        )
+        report.failures.append(
+            f"cross-region identity not exact under WAN degradation "
+            f"(precision {precision:.3f}, recall {recall:.3f}): "
+            f"{detail or 'spurious pages'}"
+        )
+    if lost_acks <= 0 or dup_envelopes <= 0:
+        report.failures.append(
+            "ack-loss window produced no replayed envelopes "
+            f"(lost_acks={lost_acks}, "
+            f"duplicate_envelopes={dup_envelopes}) — the "
+            "at-least-once hop went unexercised"
+        )
+
+    # ---- phase 3: hour-dark rejoin ------------------------------------
+    dark_region = f"region-{min(2, regions - 1)}"
+    baseline_sim = _sim()
+    dark_plan = global_injection_plan(
+        baseline_sim.topology,
+        baseline_sim.region_ids,
+        dark_region=dark_region,
+        dark_round=dark_at_round,
+    )
+    rounds = dark_at_round + dark_rounds + 16
+    baseline = baseline_sim.run(rounds, dark_plan)
+    dark_sim = _sim()
+    heal_round = dark_at_round + dark_rounds
+    dark_run = dark_sim.run(
+        rounds,
+        dark_plan,
+        wan_events=[
+            WanEvent(dark_at_round, dark_region, WAN_DARK),
+            WanEvent(heal_round, dark_region, WAN_HEAL),
+        ],
+    )
+    before = _global_keys(baseline.incidents)
+    after = _global_keys(dark_run.incidents)
+    lost = sorted(set(before) - set(after))
+    duplicated = sorted(
+        k for k in set(after) if after.count(k) > before.count(k)
+    )
+    heal = dark_run.heal_stats.get(dark_region, {})
+    backlog = int(heal.get("backlog_at_heal", 0))
+    replay_rounds = int(heal.get("replay_rounds", -1))
+    # Budget + 1 envelopes drain per round (the fresh one rides
+    # along); latency and the pump cadence add constant slack.
+    replay_bound = (
+        math.ceil(backlog / max(1, replay_budget + 1))
+        + wan_latency_rounds
+        + 3
+    )
+    healthy_during_dark = [
+        (round_i, incident_id)
+        for round_i, incident_id, _ in dark_run.emits
+        if dark_at_round <= round_i < heal_round
+    ]
+    report.dark = {
+        "dark_region": dark_region,
+        "dark_at_round": dark_at_round,
+        "heal_round": heal_round,
+        "heal_stats": dict(heal),
+        "replay_bound_rounds": replay_bound,
+        "lost": lost,
+        "duplicated": duplicated,
+        "pages_during_dark": len(healthy_during_dark),
+        "partition_scoped_pages": sum(
+            1 for gi in dark_run.incidents if gi.partition_scoped
+        ),
+        "drain_rounds_used": dark_run.drain_rounds_used,
+    }
+    if log:
+        log(
+            f"dark: {dark_region} dark {dark_rounds} rounds "
+            f"({dark_rounds * round_s:.0f}s), rejoined with "
+            f"{backlog} spooled envelopes, replayed in "
+            f"{replay_rounds} rounds (bound {replay_bound}) — lost "
+            f"{len(lost)}, duplicated {len(duplicated)}, "
+            f"{len(healthy_during_dark)} pages while dark"
+        )
+    if lost:
+        report.failures.append(
+            "hour-dark rejoin lost pages: " + ", ".join(lost)
+        )
+    if duplicated:
+        report.failures.append(
+            "hour-dark rejoin duplicated pages: "
+            + ", ".join(duplicated)
+        )
+    if replay_rounds < 0 or replay_rounds > replay_bound:
+        report.failures.append(
+            f"rejoin replay took {replay_rounds} rounds for "
+            f"{backlog} spooled envelopes — above the "
+            f"{replay_bound}-round budget bound"
+        )
+    if int(heal.get("max_out_of_order", 0)) <= 0:
+        report.failures.append(
+            "rejoin replay never reordered — fresh envelopes did "
+            "not overtake the backlog, so the bounded replay budget "
+            "is not doing its job"
+        )
+    if not healthy_during_dark:
+        report.failures.append(
+            "no pages emitted while the partition was open — the "
+            "dark region wedged the healthy side's session closes"
+        )
+
+    # ---- phase 4: split-brain heal ------------------------------------
+    report.splitbrain = _run_splitbrain(seed=seed, log=log)
+    for failure in report.splitbrain.pop("failures"):
+        report.failures.append(failure)
+    return report
+
+
+def _run_splitbrain(
+    seed: int = 1337, log: Callable[[str], None] | None = None
+) -> dict[str, Any]:
+    """Two global peers, one fault, opposite partition sides.
+
+    Driven at the wire level: four regions ship to peer A until a
+    partition routes r2/r3 to peer B.  Two faults land during the
+    partition: a SHARED one hitting r0 (A's side) and r2 (B's side)
+    simultaneously — each peer pages its half ``partition_scoped`` —
+    and a B-ONLY one hitting r2 alone, which A never hears about.
+    On heal the peers merge emitted-window registries and A replays
+    r2's spool.  The shared fault's rebuilt session is suppressed by
+    A's own registry; the b-only fault's rebuilt session can ONLY be
+    suppressed by the window the merge brought over — that is the
+    merge contract's proof.
+    """
+    gap = 5_000_000_000
+    t0 = 1_700_000_000_000_000_000
+    rids = [f"region-{i}" for i in range(4)]
+
+    def _fleet(
+        rid: str, namespace: str, domain: str, start: int, end: int
+    ) -> FleetIncident:
+        return FleetIncident(
+            incident_id=f"fleet-{rid}-{domain}-{start}",
+            namespace=namespace,
+            domain=domain,
+            blast_radius="fleet",
+            window_start_ns=start,
+            window_end_ns=end,
+            confidence=0.9,
+            nodes=[f"{rid}-node-0"],
+            slices=[f"{rid}-slice-0"],
+            members=[],
+            region=rid,
+            clusters=["cluster-0"],
+        )
+
+    def _env(
+        rid: str,
+        seq: int,
+        incidents: list[FleetIncident],
+        clock: int,
+    ) -> dict[str, Any]:
+        return encode_global_envelope(
+            region=rid,
+            seq=seq,
+            incidents=incidents,
+            watermark_ns=clock,
+            head_ns=clock,
+        )
+
+    stale_ns = 3 * gap
+    peer_a = GlobalAggregator(
+        global_id="global-a",
+        rollup_gap_ns=gap,
+        region_stale_after_ns=stale_ns,
+    )
+    peer_b = GlobalAggregator(
+        global_id="global-b",
+        rollup_gap_ns=gap,
+        region_stale_after_ns=stale_ns,
+    )
+    # Pre-partition: every region known to both peers.
+    for peer in (peer_a, peer_b):
+        for rid in rids:
+            peer.ingest(_env(rid, 0, [], t0))
+    # Partition; the shared fault hits r0 (A side) and r2 (B side),
+    # the b-only fault hits r2 alone.  Spool retention on the B
+    # side: r2 keeps what it ships to B, because after the heal it
+    # replays the same envelopes to A.
+    fault_start = t0 + 2 * gap
+    fault_end = fault_start + gap
+    r2_spool: list[dict[str, Any]] = []
+    a_incidents: list[FleetIncident] = [
+        _fleet(rids[0], "tenant-a", "tpu_hbm", fault_start, fault_end)
+    ]
+    b_incidents: list[FleetIncident] = [
+        _fleet(rids[2], "tenant-a", "tpu_hbm", fault_start, fault_end),
+        _fleet(rids[2], "tenant-b", "tpu_ici", fault_start, fault_end),
+    ]
+    # Heads advance on each side until the other side ages stale and
+    # the sessions close against the reachable-only watermark.
+    for tick in range(1, 8):
+        clock = t0 + (2 + tick) * gap
+        peer_a.ingest(
+            _env(rids[0], tick, a_incidents if tick == 1 else [], clock)
+        )
+        peer_a.ingest(_env(rids[1], tick, [], clock))
+        r2_env = _env(
+            rids[2], tick, b_incidents if tick == 1 else [], clock
+        )
+        r2_spool.append(r2_env)
+        peer_b.ingest(r2_env)
+        peer_b.ingest(_env(rids[3], tick, [], clock))
+        peer_a.pump()
+        peer_b.pump()
+    pages_a = list(peer_a.incidents)
+    pages_b = list(peer_b.incidents)
+    failures: list[str] = []
+    if len(pages_a) != 1 or len(pages_b) != 2:
+        failures.append(
+            f"split-brain sides paged {len(pages_a)}/{len(pages_b)} "
+            "(expected 1 on A: shared; 2 on B: shared + b-only)"
+        )
+    for side, pages in (("a", pages_a), ("b", pages_b)):
+        if pages and not pages[0].partition_scoped:
+            failures.append(
+                f"split-brain page on side {side} not stamped "
+                "partition_scoped — the page lies about what it "
+                "could not see"
+            )
+    # Heal: registry merge + spool replay into A, then fresh
+    # envelopes advance every head so the rebuilt session closes.
+    merged = peer_a.merge_peer(peer_b.export_state())
+    replayed = sum(
+        1 for payload in r2_spool if peer_a.ingest(payload)
+    )
+    clock = t0 + 12 * gap
+    for rid in rids:
+        peer_a.ingest(_env(rid, 20, [], clock))
+    pages_before_heal = len(peer_a.incidents)
+    peer_a.pump()
+    re_pages = len(peer_a.incidents) - pages_before_heal
+    suppressed = peer_a.rollup.duplicates_suppressed
+    if log:
+        log(
+            f"split-brain: both peers paged partition_scoped, heal "
+            f"merged {merged} registry window(s), replayed "
+            f"{replayed} envelope(s), {suppressed} rebuilt "
+            f"session(s) suppressed, {re_pages} re-pages"
+        )
+    if re_pages:
+        failures.append(
+            f"split-brain heal re-paged {re_pages} time(s) after "
+            "registry merge"
+        )
+    if merged < 1:
+        failures.append(
+            "registry merge brought over no new windows — the "
+            "b-only fault's page never crossed the heal handshake"
+        )
+    if suppressed < 2:
+        failures.append(
+            f"split-brain heal suppressed {suppressed} session(s), "
+            "expected 2 (shared via own registry, b-only via the "
+            "merged peer window) — the merge path is unproven"
+        )
+    return {
+        "pages_a": [gi.to_dict() for gi in pages_a],
+        "pages_b": [gi.to_dict() for gi in pages_b],
+        "merged_windows": merged,
+        "replayed_envelopes": replayed,
+        "suppressed": suppressed,
+        "re_pages": re_pages,
+        "failures": failures,
+    }

@@ -1,4 +1,4 @@
-"""Region wire contract v1: cluster aggregator → region envelope.
+"""Region + global wire contracts: the federation tree's upper hops.
 
 The federation tree's second hop.  Node agents ship *events* to their
 cluster's aggregator shards over the fleet wire (``fleet/wire.py``);
@@ -30,11 +30,14 @@ import json
 from dataclasses import dataclass, field
 from typing import Any
 
-from tpuslo.fleet.rollup import NodeIncident
+from tpuslo.fleet.rollup import FleetIncident, NodeIncident
 from tpuslo.fleet.wire import WireContractError
 
 #: Region wire schema version; bumped on incompatible envelope changes.
 REGION_WIRE_VERSION = 1
+
+#: Global wire schema version (region → global hop).
+GLOBAL_WIRE_VERSION = 1
 
 
 class RegionWireError(WireContractError):
@@ -193,4 +196,122 @@ def load_region_envelopes(path: str) -> list[RegionEnvelope]:
             line = line.strip()
             if line:
                 out.append(parse_region_envelope_line(line))
+    return out
+
+
+# ---- global hop (region aggregator → global tier) ----------------------
+
+
+class GlobalWireError(WireContractError):
+    """An envelope that violates the global wire contract."""
+
+
+@dataclass(slots=True)
+class GlobalEnvelope:
+    """One decoded region → global transfer.
+
+    The third hop carries *fleet incidents* — already collapsed to one
+    page per (namespace, domain, session) inside the region — so an
+    envelope is tiny even when it summarizes 10k nodes.  The seq is
+    per-region monotonic and the dedup key for WAN replay: a region
+    rejoining after a partition re-sends its whole spool, and because
+    a bounded replay budget lets FRESH envelopes overtake the backlog,
+    the global tier's cursor must be gap-tolerant (accept out-of-order
+    seqs once, never twice) rather than a strict high-water mark.
+    """
+
+    region: str
+    seq: int
+    incidents: list[FleetIncident]
+    #: The sending region's cross-cluster watermark: the global tier's
+    #: session-close clock (min over reachable regions).
+    watermark_ns: int = 0
+    #: The region's newest observed event timestamp.
+    head_ns: int = 0
+    #: Sender's degradation level when this envelope was built.
+    pressure_level: int = 0
+
+
+def encode_global_envelope(
+    region: str,
+    seq: int,
+    incidents: list[FleetIncident],
+    watermark_ns: int = 0,
+    head_ns: int = 0,
+    pressure_level: int = 0,
+) -> dict[str, Any]:
+    """Region rollup state → wire payload dict (JSON-safe)."""
+    return {
+        "global_wire_version": GLOBAL_WIRE_VERSION,
+        "region": region,
+        "seq": int(seq),
+        "watermark_ns": int(watermark_ns),
+        "head_ns": int(head_ns),
+        "pressure_level": int(pressure_level),
+        "incidents": [i.to_dict() for i in incidents],
+    }
+
+
+def decode_global_envelope(payload: dict[str, Any]) -> GlobalEnvelope:
+    """Wire payload dict → :class:`GlobalEnvelope`; loud on breaks."""
+    if not isinstance(payload, dict):
+        raise GlobalWireError(
+            f"envelope must be an object, got {type(payload).__name__}"
+        )
+    version = payload.get("global_wire_version")
+    if version != GLOBAL_WIRE_VERSION:
+        raise GlobalWireError(
+            f"global wire version {version!r} != {GLOBAL_WIRE_VERSION}"
+        )
+    region = payload.get("region")
+    if not isinstance(region, str) or not region:
+        raise GlobalWireError("envelope missing region identity")
+    try:
+        seq = int(payload["seq"])
+        watermark_ns = int(payload.get("watermark_ns", 0))
+        head_ns = int(payload.get("head_ns", 0))
+        pressure_level = int(payload.get("pressure_level", 0))
+    except (KeyError, TypeError, ValueError) as exc:
+        raise GlobalWireError(f"bad envelope header: {exc}") from exc
+    raw_incidents = payload.get("incidents")
+    if not isinstance(raw_incidents, list):
+        raise GlobalWireError("envelope missing incidents list")
+    try:
+        incidents = [
+            FleetIncident.from_dict(raw) for raw in raw_incidents
+        ]
+    except (AttributeError, TypeError, ValueError) as exc:
+        raise GlobalWireError(f"bad incident entry: {exc}") from exc
+    return GlobalEnvelope(
+        region=region,
+        seq=seq,
+        incidents=incidents,
+        watermark_ns=watermark_ns,
+        head_ns=head_ns,
+        pressure_level=pressure_level,
+    )
+
+
+def global_envelope_json_line(payload: dict[str, Any]) -> str:
+    """One JSONL line for an encoded global envelope."""
+    return json.dumps(payload, separators=(",", ":")) + "\n"
+
+
+def parse_global_envelope_line(line: str) -> GlobalEnvelope:
+    """Inverse of :func:`global_envelope_json_line` (decode included)."""
+    try:
+        payload = json.loads(line)
+    except json.JSONDecodeError as exc:
+        raise GlobalWireError(f"bad envelope line: {exc}") from exc
+    return decode_global_envelope(payload)
+
+
+def load_global_envelopes(path: str) -> list[GlobalEnvelope]:
+    """Read a global envelope log; loud on contract drift."""
+    out: list[GlobalEnvelope] = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                out.append(parse_global_envelope_line(line))
     return out

@@ -140,6 +140,25 @@ class FleetIncident:
             out["clusters"] = list(self.clusters)
         return out
 
+    def summary_dict(self) -> dict[str, Any]:
+        """Compact per-region member entry for a global page.
+
+        The global tier folds whole fleet pages, so its provenance
+        block carries the page identity and shape — not the per-node
+        members, which stay one drill-down away (``sloctl explain``
+        on the region's own incident).
+        """
+        return {
+            "incident_id": self.incident_id,
+            "region": self.region,
+            "blast_radius": self.blast_radius,
+            "confidence": round(self.confidence, 4),
+            "nodes": len(self.nodes),
+            "clusters": list(self.clusters),
+            "window_start_ns": self.window_start_ns,
+            "window_end_ns": self.window_end_ns,
+        }
+
     @classmethod
     def from_dict(cls, raw: dict[str, Any]) -> "FleetIncident":
         return cls(

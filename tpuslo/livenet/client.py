@@ -21,6 +21,18 @@ A nack (``ok: false``) counts as *delivered*: the server saw the
 frame and refused it on contract grounds; replaying it would refuse
 again forever and dam the spool behind one poison frame.
 
+``replay_budget`` bounds how much backlog each send round replays.
+At the default 0 the legacy contract holds: the spool drains fully
+before anything fresh goes out, so the receiver sees seqs in strict
+order (what the strict-cursor hops below the global tier require).
+With a positive budget — the WAN hop — at most that many spooled
+frames replay per round and the fresh payload then goes out LIVE
+even while backlog remains: a region rejoining after an hour dark
+cannot head-of-line-block its fresh incidents behind 3600 spooled
+envelopes.  The receiver consequently sees seqs out of order, which
+is exactly what the global tier's gap-tolerant cursor exists to
+absorb; do not set a budget when sending to a strict-cursor hop.
+
 The ack's ``pressure_level`` is retained on :attr:`pressure_level` —
 the sender's live view of upstream pressure, consumed by the agent's
 shipment-cadence coarsening.
@@ -77,12 +89,16 @@ class ReconnectingClient:
         peer: str = "upstream",
         timeout_s: float = 5.0,
         max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+        replay_budget: int = 0,
         observer: LivenetObserver | None = None,
         log: Callable[[str], None] | None = None,
     ):
         self.address = address
         self.peer = peer
         self.timeout_s = timeout_s
+        #: Max spooled frames replayed per send round; 0 = unbounded
+        #: (strict oldest-first ordering, the pre-WAN contract).
+        self.replay_budget = max(0, int(replay_budget))
         self._max_frame = max_frame_bytes
         self._observer = observer or LivenetObserver()
         self._log = log or (lambda msg: None)
@@ -178,15 +194,20 @@ class ReconnectingClient:
     def send(self, payload: dict[str, Any]) -> bool:
         """Deliver (or durably spool) one payload; True = acked live.
 
-        Replays any spool backlog first so the receiver sees seqs in
-        order.  On any failure the payload is spooled and the send
-        still *succeeds* from the loop's perspective — `OSError` from
-        the spool itself (disk full) is the only raise.
+        Replays spool backlog first (bounded by ``replay_budget``)
+        so the receiver sees the oldest seqs early.  With a budget
+        set, a fresh payload goes out live even while backlog
+        remains — fresh overtakes, the gap-tolerant receiver dedups.
+        On any failure the payload is spooled and the send still
+        *succeeds* from the loop's perspective — `OSError` from the
+        spool itself (disk full) is the only raise.
         """
         self.replay_spool()
-        if self._spool.pending_batches() == 0 and self._send_acked(
-            payload
-        ):
+        backlog_ok = (
+            self._spool.pending_batches() == 0
+            or self.replay_budget > 0
+        )
+        if backlog_ok and self._send_acked(payload):
             self.sent_frames += 1
             return True
         self._spool.append(payload)
@@ -194,18 +215,31 @@ class ReconnectingClient:
         return False
 
     def replay_spool(self) -> int:
-        """Drain spooled payloads oldest-first while the peer acks."""
+        """Drain spooled payloads oldest-first while the peer acks.
+
+        A positive ``replay_budget`` stops the drain after that many
+        records; the partially-drained segment stays on disk and its
+        already-replayed head re-sends next round — the receiver's
+        seq dedup absorbs the overlap (at-least-once, as everywhere
+        on this hop).
+        """
         if self._spool.pending_batches() == 0:
             return 0
+        budget = self.replay_budget
+        replayed_box = [0]
 
         def _replay_one(record: dict[str, Any]) -> None:
+            if budget > 0 and replayed_box[0] >= budget:
+                raise _ReplayBudgetExhausted()
             if not self._send_acked(record):
                 raise _ReplayAborted()
+            replayed_box[0] += 1
 
         try:
-            replayed = self._spool.drain(_replay_one)
-        except _ReplayAborted:
-            return 0
+            self._spool.drain(_replay_one)
+        except (_ReplayAborted, _ReplayBudgetExhausted):
+            pass
+        replayed = replayed_box[0]
         if replayed:
             self.replayed_frames += replayed
             self._observer.spool_replayed(self.peer, replayed)
@@ -221,3 +255,7 @@ class ReconnectingClient:
 
 class _ReplayAborted(Exception):
     """Internal: stop a spool drain at the first undelivered record."""
+
+
+class _ReplayBudgetExhausted(Exception):
+    """Internal: stop a spool drain when the replay budget is spent."""

@@ -376,6 +376,39 @@ class AgentMetrics:
             ),
             registry=self.registry,
         )
+        # ---- global tier (tpuslo.federation.global_tier) -------------
+        self.global_region_ingested = Counter(
+            "llm_slo_global_region_ingested_incidents_total",
+            "Fleet pages ingested by the global tier, per source "
+            "region (the region->global envelope hop)",
+            ["region"],
+            registry=self.registry,
+        )
+        self.global_pages = Counter(
+            "llm_slo_global_pages_total",
+            "Global incidents emitted, by scope (single_region / "
+            "multi_region / partition_scoped — the last means some "
+            "region was unreachable and a peer may hold the rest)",
+            ["scope"],
+            registry=self.registry,
+        )
+        self.global_duplicates_suppressed = Counter(
+            "llm_slo_global_duplicates_suppressed_total",
+            "Duplicates the global tier absorbed, by reason "
+            "(seq_replay: WAN replay of an already-accepted "
+            "envelope; emitted_window: a healed peer already paged "
+            "this session window)",
+            ["reason"],
+            registry=self.registry,
+        )
+        self.global_region_reachable = Gauge(
+            "llm_slo_global_region_reachable",
+            "1 while the region's stream head is within the "
+            "staleness bound of the fleet head, 0 once it has aged "
+            "out (partitioned or dark)",
+            ["region"],
+            registry=self.registry,
+        )
         # ---- auto-remediation series (tpuslo.remediation) ------------
         self.remediation_actions_applied = Counter(
             "llm_slo_agent_remediation_actions_applied_total",
@@ -683,6 +716,12 @@ class AgentMetrics:
         tpuslo.federation.FederationObserver)."""
         return _PromFederationObserver(self)
 
+    def global_observer(self) -> "_PromGlobalObserver":
+        """Observer adapter wiring the global tier (gap-tolerant
+        dedup, partition-aware emission) to this registry (duck-typed
+        against tpuslo.federation.GlobalObserver)."""
+        return _PromGlobalObserver(self)
+
     def remediation_observer(self) -> "_PromRemediationObserver":
         """Observer adapter wiring a RemediationEngine to this registry
         (duck-typed against tpuslo.remediation.RemediationObserver)."""
@@ -894,6 +933,46 @@ class _PromFederationObserver:
 
     def incident_staleness_ms(self, ms: float) -> None:
         self._m.federation_incident_staleness_ms.observe(ms)
+
+
+class _PromGlobalObserver:
+    """Bridge from global-tier callbacks to Prometheus.
+
+    Per-region children are cached like the federation observer's;
+    ``region_reachable`` fires for every region on every watermark
+    read, so the gauge child lookup is the hot one.
+    """
+
+    def __init__(self, metrics: AgentMetrics):
+        self._m = metrics
+        self._ingest_children: dict[str, object] = {}
+        self._reachable_children: dict[str, object] = {}
+
+    def global_ingested(self, region: str, incidents: int) -> None:
+        child = self._ingest_children.get(region)
+        if child is None:
+            child = self._m.global_region_ingested.labels(
+                region=region
+            )
+            self._ingest_children[region] = child
+        child.inc(incidents)
+
+    def global_page(self, scope: str) -> None:
+        self._m.global_pages.labels(scope=scope).inc()
+
+    def global_duplicate(self, reason: str) -> None:
+        self._m.global_duplicates_suppressed.labels(
+            reason=reason
+        ).inc()
+
+    def region_reachable(self, region: str, reachable: int) -> None:
+        child = self._reachable_children.get(region)
+        if child is None:
+            child = self._m.global_region_reachable.labels(
+                region=region
+            )
+            self._reachable_children[region] = child
+        child.set(reachable)
 
 
 class _PromTraceObserver:
