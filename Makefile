@@ -15,6 +15,7 @@ ARTIFACTS ?= artifacts
 	burn-smoke burn-sweep fleet-smoke fleet-sweep \
 	federation-smoke federation-sweep \
 	global-smoke global-sweep \
+	peer-smoke peer-sweep \
 	remediation-smoke remediation-sweep \
 	frontdoor-smoke frontdoor-bench \
 	router-smoke router-bench \
@@ -370,6 +371,24 @@ global-sweep:
 		--summary-json $(ARTIFACTS)/global/sweep.json \
 		--summary-md $(ARTIFACTS)/global/sweep.md
 
+# Peer-mesh smoke: gossip lattice fold, bully election + epoch fence,
+# commit-then-page outbox, deferred re-stamp, livemesh sockets and the
+# fleetagg --peer CLIs — seconds, runs in m5-gate.
+peer-smoke:
+	$(PY) -m pytest tests/test_global_peer.py -q -m 'not slow'
+
+# Full peer-mesh release gate: the symmetric-root chaos lanes (leader's
+# whole peering domain dark mid-sweep -> bounded-round election, zero
+# lost/dup pages; split-brain where BOTH sides elect healing by gossip
+# alone; a deposed root returning from an hour dark fenced at its stale
+# epoch) plus the 100k-node ingest floor
+# (see docs/runbooks/multi-region.md).
+peer-sweep:
+	mkdir -p $(ARTIFACTS)/peer
+	$(PY) -m tpuslo m5gate --peer-sweep \
+		--summary-json $(ARTIFACTS)/peer/sweep.json \
+		--summary-md $(ARTIFACTS)/peer/sweep.md
+
 # Full crash-sweep release gate: seeds x kill points of SIGKILL/restart
 # audits (see docs/evidence/crash-sweep.md + docs/runbooks/crash-recovery.md).
 crash-sweep:
@@ -424,6 +443,7 @@ m5-gate: lint racecheck-smoke jitcheck-smoke burn-smoke burn-sweep \
 		bench-columnar-smoke fleet-smoke fleet-sweep \
 		federation-smoke federation-sweep \
 		global-smoke global-sweep \
+		peer-smoke peer-sweep \
 		remediation-smoke remediation-sweep \
 		frontdoor-smoke frontdoor-bench \
 		router-smoke router-bench \

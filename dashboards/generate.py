@@ -376,6 +376,19 @@ fleet_overview = dashboard(
         panel("Duplicates absorbed (1h, by reason — seq_replay: WAN; emitted_window: peer heal)", [
             ('sum(increase(llm_slo_global_duplicates_suppressed_total[1h])) by (reason)', "{{reason}}"),
         ], 12, 48),
+        # --- peer mesh (symmetric global root) ------------------------
+        panel("Leader election epoch (a step = a handover; divergence = split brain)", [
+            ('llm_slo_global_peer_epoch', "{{peer}}"),
+        ], 0, 56),
+        panel("Leadership takes (1h, by peer)", [
+            ('sum(increase(llm_slo_global_peer_elections_total[1h])) by (peer)', "{{peer}}"),
+        ], 12, 56, w=6),
+        panel("Gossip rounds/s (anti-entropy cadence, by peer)", [
+            ('sum(rate(llm_slo_global_peer_gossip_rounds_total[5m])) by (peer)', "{{peer}}"),
+        ], 18, 56, w=6),
+        panel("Peer reachability (0 = off the mesh; the bully rule elects past it)", [
+            ('llm_slo_global_peer_reachable', "{{peer}}"),
+        ], 0, 64),
     ],
 )
 

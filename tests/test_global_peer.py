@@ -1,0 +1,764 @@
+"""Symmetric global peer mesh: gossip reconciliation and root leader
+election under WAN chaos.
+
+The invariants that keep the mesh honest get direct coverage:
+
+* **Commit-then-page.**  A leader's closed session parks in the
+  outbox with its registry row withdrawn; the page only releases once
+  another peer gossips the EXACT row back, so a leader killed at any
+  point of the race leaves zero lost and zero duplicate pages.
+* **Epoch fencing.**  Every page carries the epoch that stamped it; a
+  deposed root's stale announcement is rejected AND counted, and the
+  rejection does not seal the window — sealing without a held page
+  would suppress the successor's rebuild into a lost incident.
+* **Deferred re-stamp.**  Pages dropped at a fence park in
+  ``deferred``; retaking leadership re-stamps them at the new epoch
+  (Raft's "re-replicate prior-term entries at your own term") unless
+  the registry meanwhile covers their window.
+* **Replication-fenced acks.**  A region seq is only ackable once a
+  second peer's gossiped cursors cover it — acking sooner would let a
+  leader that died pre-emission strand the only copy of evidence.
+* **Mid-compaction cursor restore.**  A gap-tolerant cursor state
+  exported mid-compaction (accepted seqs at or below the watermark)
+  must restore without re-accepting a delivered seq.
+
+The live lane drives the same machine over real sockets: a three-node
+mesh elects, pages, and confirms through ``LivePeerNode``; a WanProxy
+one-way ack-loss partition during an in-flight election forces the
+claim to spread while the claimant's own gossip goes unacked, and the
+per-sender gossip cursors absorb the replay storm after the heal.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import time
+
+import pytest
+
+from tpuslo.chaos.wan import DIR_BACKWARD, WanProxy
+from tpuslo.federation.global_tier import GapTolerantCursor, GlobalPeer
+from tpuslo.federation.livemesh import LivePeerNode
+from tpuslo.federation.sweep import run_peer_sweep
+from tpuslo.federation.wire import (
+    PEER_WIRE_VERSION,
+    PeerWireError,
+    decode_peer_envelope,
+    encode_global_envelope,
+    global_envelope_json_line,
+    parse_peer_envelope_line,
+    peer_envelope_json_line,
+)
+from tpuslo.fleet.rollup import FleetIncident
+from tpuslo.fleet.simulator import EPOCH_NS
+from tpuslo.fleet.wire import WireContractError
+from tpuslo.livenet import ReconnectingClient
+
+GAP = 5_000_000_000
+#: Short liveness horizon so election tests fit in a few event-clock
+#: hops (the default is three simulated minutes).
+STALE = 10 * GAP
+
+
+def _fleet(
+    rid: str,
+    i: int = 0,
+    namespace: str = "tenant-a",
+    domain: str = "dcn_degradation",
+) -> FleetIncident:
+    start = EPOCH_NS + i * 10 * GAP
+    return FleetIncident(
+        incident_id=f"fleet-{rid}-{i}",
+        namespace=namespace,
+        domain=domain,
+        blast_radius="pod",
+        window_start_ns=start,
+        window_end_ns=start + 2_000_000_000,
+        confidence=0.9,
+        nodes=[f"{rid}-node-{i}"],
+        slices=[f"{rid}-slice-0"],
+        members=[],
+        region=rid,
+        clusters=[f"{rid}-c0"],
+    )
+
+
+def _env(
+    rid: str,
+    seq: int,
+    incidents: list[FleetIncident] | None = None,
+    clock: int = EPOCH_NS + 40 * GAP,
+) -> dict:
+    return encode_global_envelope(
+        region=rid,
+        seq=seq,
+        incidents=incidents or [],
+        watermark_ns=clock,
+        head_ns=clock,
+    )
+
+
+def _mesh(n: int = 3, **kwargs) -> dict[str, GlobalPeer]:
+    ids = [f"global-{i}" for i in range(n)]
+    kwargs.setdefault("peer_stale_after_ns", STALE)
+    return {pid: GlobalPeer(pid, ids, **kwargs) for pid in ids}
+
+
+def _round(
+    peers: dict[str, GlobalPeer], now_ns: int, skip: set[str] = frozenset()
+) -> None:
+    """One synchronous anti-entropy round among the non-skipped peers.
+
+    All envelopes are built before any is delivered — the same
+    no-peeking semantics as a real round where everything is in
+    flight at once.
+    """
+    batch = []
+    for pid, peer in peers.items():
+        if pid in skip:
+            continue
+        peer.begin_gossip_round()
+        for other in peers:
+            if other != pid and other not in skip:
+                batch.append((other, peer.gossip_out(other, now_ns)))
+    for to, envelope in batch:
+        peers[to].gossip_in(envelope, now_ns)
+
+
+def _wait_until(cond, timeout_s: float = 10.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    while not cond() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert cond(), "condition not reached before deadline"
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TestGapTolerantCursorRestore:
+    def test_mid_compaction_state_cannot_double_accept(self):
+        """Accepted seqs at or below the watermark (a mid-compaction
+        export, or a state assembled by a peer from gossip) must fold
+        away on restore — accept() returning True for a delivered seq
+        is the exact duplicate this cursor exists to prevent."""
+        cursor = GapTolerantCursor()
+        cursor.restore_state({"watermark": 3, "accepted": [1, 2, 4, 5]})
+        assert cursor.watermark == 5
+        assert cursor.accepted == set()
+        assert cursor.accept(4) is False
+        assert cursor.accept(5) is False
+        assert cursor.accept(6) is True
+
+    def test_contiguous_run_folds_into_watermark(self):
+        cursor = GapTolerantCursor()
+        cursor.restore_state({"watermark": -1, "accepted": [0, 1, 2, 5]})
+        assert cursor.watermark == 2
+        assert cursor.accepted == {5}
+        assert cursor.accept(0) is False
+        assert cursor.accept(5) is False
+        assert cursor.accept(3) is True
+
+    def test_export_restore_round_trip_preserves_dedup(self):
+        cursor = GapTolerantCursor()
+        for seq in (0, 2, 3, 7):
+            assert cursor.accept(seq) is True
+        restored = GapTolerantCursor()
+        restored.restore_state(
+            json.loads(json.dumps(cursor.export_state()))
+        )
+        assert restored.watermark == cursor.watermark
+        assert restored.accepted == cursor.accepted
+        assert restored.accept(7) is False
+        assert restored.accept(1) is True  # fills the gap...
+        assert restored.watermark == 3  # ...and compacts through it
+
+
+class TestCommitThenPage:
+    def test_solo_mesh_releases_immediately(self):
+        peer = GlobalPeer("global-0", ["global-0"])
+        assert peer.is_leader
+        assert peer.ingest(_env("region-a", 0, [_fleet("region-a")]))
+        stamped = peer.pump(flush=True)
+        assert len(stamped) == 1
+        assert stamped[0]["epoch"] == 0
+        assert stamped[0]["peer"] == "global-0"
+        # Nothing to wait for: the solo outbox settles in the same call.
+        assert peer.outbox == []
+        assert [p["incident_id"] for p in peer.take_released()] == [
+            stamped[0]["incident_id"]
+        ]
+
+    def test_leader_parks_until_row_gossiped_back(self):
+        peers = _mesh(3)
+        leader = peers["global-0"]
+        follower = peers["global-1"]
+        assert leader.ingest(_env("region-a", 0, [_fleet("region-a")]))
+        stamped = leader.pump(flush=True)
+        assert len(stamped) == 1
+        page = stamped[0]
+        # Parked, not emitted: the row is withdrawn with it.
+        assert leader.take_released() == []
+        assert len(leader.outbox) == 1
+        assert not leader.agg.rollup.window_registered(
+            page["namespace"], page["domain"],
+            page["window_start_ns"], page["window_end_ns"],
+        )
+        now = EPOCH_NS + 50 * GAP
+        _round(peers, now)
+        # Round 1: the announcement landed — the follower holds the
+        # page AND its row (acceptance folds them together).
+        assert [p["incident_id"] for p in follower.pages] == [
+            page["incident_id"]
+        ]
+        assert follower.take_released() == []  # held, not re-emitted
+        assert leader.take_released() == []  # row not yet echoed
+        # Round 2: the row gossips back and the original releases.
+        _round(peers, now + GAP)
+        released = leader.take_released()
+        assert [p["incident_id"] for p in released] == [
+            page["incident_id"]
+        ]
+        assert leader.outbox == []
+        assert leader.pages_released == 1
+        assert leader.agg.rollup.window_registered(
+            page["namespace"], page["domain"],
+            page["window_start_ns"], page["window_end_ns"],
+        )
+        # Union across the mesh: one page, one id, stamped (0, g0).
+        ids = [p["incident_id"] for peer in peers.values()
+               for p in peer.pages]
+        assert ids.count(page["incident_id"]) == len(peers)
+        assert all(
+            (p["epoch"], p["peer"]) == (0, "global-0")
+            for peer in peers.values() for p in peer.pages
+        )
+
+    def test_spool_replay_rebuild_suppressed_by_outbox(self):
+        """With the row withdrawn, a replayed spool rebuilding the
+        same session slips past the rollup's suppression — the parked
+        page itself must be the dedup fence until release."""
+        peers = _mesh(2)
+        leader = peers["global-0"]
+        assert leader.ingest(_env("region-a", 0, [_fleet("region-a")]))
+        assert len(leader.pump(flush=True)) == 1
+        # The region replays the same fault under a fresh seq (its own
+        # spool was never acked: the replication fence held it).
+        assert leader.ingest(_env("region-a", 1, [_fleet("region-a")]))
+        assert leader.pump(flush=True) == []
+        assert leader.outbox_suppressed == 1
+        assert len(leader.outbox) == 1
+
+    def test_follower_reconcile_trims_provably_paged_pending(self):
+        peers = _mesh(2)
+        follower = peers["global-1"]
+        assert not follower.is_leader
+        incident = _fleet("region-b")
+        assert follower.ingest(_env("region-b", 0, [incident]))
+        # The leader's released row arrives by registry merge...
+        follower.agg.rollup.merge_emitted_windows(
+            [[incident.namespace, incident.domain,
+              incident.window_start_ns, incident.window_end_ns]]
+        )
+        follower.reconcile()
+        # ...and the buffered member is provably paged: drop it.
+        assert follower.pending_trimmed >= 1
+
+
+class TestReplicationFencedAcks:
+    def test_ack_fenced_until_a_peer_covers_the_seq(self):
+        peers = _mesh(2)
+        leader = peers["global-0"]
+        assert leader.ingest(_env("region-a", 0, [_fleet("region-a")]))
+        assert leader.ingest(_env("region-a", 1, []))
+        # Held locally only: acking now could strand the evidence.
+        assert leader.ackable_seq("region-a") == -1
+        now = EPOCH_NS + 50 * GAP
+        _round(peers, now)  # the relay rides gossip out...
+        _round(peers, now + GAP)  # ...and the covering cursors return
+        assert leader.ackable_seq("region-a") == 1
+        # Covered everywhere: the relay spool trims to nothing.
+        assert leader.snapshot()["relay_spooled"] == 0
+
+    def test_solo_peer_acks_at_its_own_watermark(self):
+        peer = GlobalPeer("global-0", ["global-0"])
+        assert peer.ingest(_env("region-a", 0, []))
+        assert peer.ackable_seq("region-a") == 0
+
+
+class TestElectionAndFencing:
+    def test_bully_lowest_rank_live_leads_epoch_fenced(self):
+        peers = _mesh(3)
+        now = EPOCH_NS + 50 * GAP
+        _round(peers, now)
+        assert all(p.leader_id == "global-0" for p in peers.values())
+        assert all(p.epoch == 0 for p in peers.values())
+        # The root goes dark for a full liveness horizon.
+        later = now + STALE + GAP
+        g1, g2 = peers["global-1"], peers["global-2"]
+        assert g1.election_tick(later) is True
+        assert g1.epoch == 1 and g1.is_leader
+        assert g1.elections == 1
+        _round(peers, later, skip={"global-0"})
+        assert g2.leader_id == "global-1" and g2.epoch == 1
+        # The claim seen, g2 never contests: g1 outranks it.
+        assert g2.election_tick(later) is False
+        assert g1.election_tick(later) is False  # already leading
+
+    def test_equal_epoch_tie_breaks_to_lower_rank(self):
+        """Both halves of a split elect at the same epoch; on heal the
+        bully rule's pick (the lower rank) wins on every peer."""
+        peers = _mesh(3)
+        now = EPOCH_NS + 50 * GAP
+        _round(peers, now)
+        later = now + STALE + GAP
+        g1, g2 = peers["global-1"], peers["global-2"]
+        # g0 vanished and the g1|g2 link is down too: both elect.
+        assert g1.election_tick(later) is True
+        assert g2.election_tick(later) is True
+        assert g1.epoch == g2.epoch == 1
+        # Heal: one gossip exchange converges both on g1.
+        g2.gossip_in(g1.gossip_out("global-2", later), later)
+        g1.gossip_in(g2.gossip_out("global-1", later), later)
+        assert g1.leader_id == g2.leader_id == "global-1"
+        assert g1.is_leader and not g2.is_leader
+
+    def test_deposed_root_stale_page_rejected_and_counted(self):
+        peers = _mesh(3)
+        g0, g1, g2 = peers.values()
+        now = EPOCH_NS + 50 * GAP
+        _round(peers, now)
+        # The root closes a session; its page parks at epoch 0.
+        assert g0.ingest(_env("region-a", 0, [_fleet("region-a")]))
+        [page] = g0.pump(flush=True)
+        assert page["epoch"] == 0
+        # Partitioned before the announcement spreads, the survivors
+        # elect past it.
+        later = now + STALE + GAP
+        assert g1.election_tick(later) is True
+        _round(peers, later, skip={"global-0"})
+        # Heal: the deposed root's announcement arrives at epoch 0
+        # against a mesh at epoch 1 — rejected, counted, and the
+        # window is NOT sealed (no held page may mean no row).
+        before = g1.stale_epoch_rejections
+        g1.gossip_in(g0.gossip_out("global-1", later), later)
+        assert g1.stale_epoch_rejections == before + 1
+        assert page["incident_id"] not in {
+            p["incident_id"] for p in g1.pages
+        }
+        assert not g1.agg.rollup.window_registered(
+            page["namespace"], page["domain"],
+            page["window_start_ns"], page["window_end_ns"],
+        )
+        # The return gossip deposes g0: the parked page is dropped to
+        # deferred, never released at the stale epoch.
+        g0.gossip_in(g1.gossip_out("global-0", later), later)
+        assert g0.epoch == 1 and g0.leader_id == "global-1"
+        assert g0.stale_pages_dropped == 1
+        assert len(g0.deferred) == 1
+        assert g0.outbox == []
+        assert g0.take_released() == []
+        assert g0.pages_released == 0
+
+    def test_retaking_leadership_restamps_deferred_evidence(self):
+        """A fenced page may hold the only copy of its evidence (the
+        origin's cursors deduped the envelopes away); winning an
+        election re-enters it into the outbox at the new epoch."""
+        peers = _mesh(3)
+        g0, g1, g2 = peers.values()
+        now = EPOCH_NS + 50 * GAP
+        _round(peers, now)
+        assert g0.ingest(_env("region-a", 0, [_fleet("region-a")]))
+        [page] = g0.pump(flush=True)
+        later = now + STALE + GAP
+        assert g1.election_tick(later) is True
+        _round(peers, later, skip={"global-0"})
+        g0.gossip_in(g1.gossip_out("global-0", later), later)
+        assert len(g0.deferred) == 1
+        # Now the survivors go dark and g0 is the last peer standing:
+        # it retakes at an epoch past everything seen.
+        final = later + STALE + GAP
+        assert g0.election_tick(final) is True
+        assert g0.epoch == 2
+        assert g0.pages_restamped == 1
+        assert g0.deferred == []
+        assert [p["epoch"] for p in g0.outbox] == [2]
+        # The restamped announcement is acceptable again: one gossip
+        # round-trip with a healed peer confirms and releases it.
+        g1.gossip_in(g0.gossip_out("global-1", final), final)
+        g0.gossip_in(g1.gossip_out("global-0", final), final)
+        released = g0.take_released()
+        assert [p["incident_id"] for p in released] == [
+            page["incident_id"]
+        ]
+        assert released[0]["epoch"] == 2
+        # Zero lost, zero duplicate across the whole ordeal.
+        ids = [p["incident_id"] for peer in peers.values()
+               for p in peer.pages]
+        assert ids.count(page["incident_id"]) == 2  # g0's + g1's copy
+
+    def test_rank_and_stamps_stable_across_handover(self):
+        """Ranks derive from sorted membership, not construction
+        order; released pages keep their original (epoch, peer)
+        attribution across a handover while new pages carry the new
+        leader's stamp."""
+        ids = ["global-0", "global-1", "global-2"]
+        shuffled = {
+            "global-0": ["global-2", "global-0", "global-1"],
+            "global-1": ["global-1", "global-2", "global-0"],
+            "global-2": ids,
+        }
+        peers = {
+            pid: GlobalPeer(pid, members, peer_stale_after_ns=STALE)
+            for pid, members in shuffled.items()
+        }
+        assert [peers[pid].rank for pid in ids] == [0, 1, 2]
+        assert all(p.peer_ids == ids for p in peers.values())
+        now = EPOCH_NS + 50 * GAP
+        g0, g1 = peers["global-0"], peers["global-1"]
+        assert g0.ingest(_env("region-a", 0, [_fleet("region-a", 0)]))
+        g0.pump(flush=True)
+        _round(peers, now)
+        _round(peers, now + GAP)
+        [first] = g0.take_released()
+        # Handover: g0 dark, g1 takes, and a NEW fault pages under the
+        # new authority.
+        later = now + STALE + 2 * GAP
+        assert g1.election_tick(later) is True
+        assert g1.ingest(_env("region-b", 0, [_fleet("region-b", 4)]))
+        g1.pump(flush=True)
+        _round(peers, later, skip={"global-0"})
+        _round(peers, later + GAP, skip={"global-0"})
+        [second] = g1.take_released()
+        assert (first["epoch"], first["peer"]) == (0, "global-0")
+        assert (second["epoch"], second["peer"]) == (1, "global-1")
+        # The survivor holds both attributions, unrewritten.
+        stamps = {
+            p["incident_id"]: (p["epoch"], p["peer"])
+            for p in peers["global-2"].pages
+        }
+        assert stamps[first["incident_id"]] == (0, "global-0")
+        assert stamps[second["incident_id"]] == (1, "global-1")
+
+
+class TestPeerWire:
+    def test_envelope_json_round_trip(self):
+        peers = _mesh(2)
+        leader = peers["global-0"]
+        assert leader.ingest(_env("region-a", 0, [_fleet("region-a")]))
+        leader.pump(flush=True)
+        payload = leader.gossip_out("global-1", EPOCH_NS + 50 * GAP)
+        env = parse_peer_envelope_line(peer_envelope_json_line(payload))
+        assert env.peer == "global-0"
+        assert env.seq == 0
+        assert env.epoch == 0
+        assert env.leader == "global-0"
+        assert "region-a" in env.cursors
+        assert len(env.envelopes) == 1  # the relay delta
+        assert len(env.pages) == 1  # the parked announcement
+        assert env.alive["global-0"] == EPOCH_NS + 50 * GAP
+
+    def test_contract_breaks_are_loud_and_nackable(self):
+        with pytest.raises(PeerWireError):
+            decode_peer_envelope(
+                {"peer_wire_version": PEER_WIRE_VERSION + 1, "peer": "x"}
+            )
+        with pytest.raises(PeerWireError):
+            decode_peer_envelope({"peer_wire_version": PEER_WIRE_VERSION})
+        # The live listener nacks WireContractError subclasses — a bad
+        # peer frame must ride the same path as a bad shipment.
+        assert issubclass(PeerWireError, WireContractError)
+
+    def test_gossip_in_rejects_non_members_and_self(self):
+        peers = _mesh(2)
+        stranger = GlobalPeer(
+            "global-9", ["global-0", "global-1", "global-9"]
+        )
+        envelope = stranger.gossip_out("global-0", EPOCH_NS)
+        with pytest.raises(PeerWireError):
+            peers["global-0"].gossip_in(envelope, EPOCH_NS)
+        own = peers["global-0"].gossip_out("global-1", EPOCH_NS)
+        with pytest.raises(PeerWireError):
+            peers["global-0"].gossip_in(own, EPOCH_NS)
+
+
+def _live_mesh(tmp_path, ids, addressed=None, proxied=None, stale=STALE):
+    """Build a live mesh with real listeners on pre-picked ports.
+
+    ``addressed`` limits which peers get nodes (the rest stay
+    membership-only: dark, but still ranked); ``proxied`` maps
+    ``(from_pid, to_pid)`` to a substitute address.
+    """
+    addressed = addressed or ids
+    proxied = proxied or {}
+    ports = {pid: _free_port() for pid in addressed}
+    addrs = {pid: f"tcp://127.0.0.1:{ports[pid]}" for pid in addressed}
+    nodes = {}
+    for pid in addressed:
+        peer_addrs = {
+            other: proxied.get((pid, other), addrs[other])
+            for other in addressed
+            if other != pid
+        }
+        nodes[pid] = LivePeerNode(
+            pid,
+            peer_addrs,
+            tmp_path / pid,
+            peer_ids=ids,
+            port=ports[pid],
+            peer_stale_after_ns=stale,
+            client_timeout_s=0.5,
+        )
+    return nodes
+
+
+class TestLivePeerMesh:
+    def test_three_node_mesh_pages_once_over_sockets(self, tmp_path):
+        ids = ["global-0", "global-1", "global-2"]
+        nodes = _live_mesh(tmp_path, ids)
+        region = ReconnectingClient(
+            (nodes["global-0"].listener.host,
+             nodes["global-0"].listener.port),
+            tmp_path / "region-spool",
+            timeout_s=2.0,
+        )
+        try:
+            for i in range(3):
+                assert region.send(
+                    _env("region-a", i, [_fleet("region-a", i)])
+                )
+            _wait_until(
+                lambda: nodes["global-0"].frames_ingested == 3
+            )
+            # The region's ack already names the mesh authority.
+            assert region.remote_info["peer"] == "global-0"
+            assert region.remote_info["leader"] == "global-0"
+            released = []
+            now = EPOCH_NS + 50 * GAP
+            for r in range(6):
+                time.sleep(0.1)
+                for pid in ids:
+                    released += [
+                        (pid, p["incident_id"])
+                        for p in nodes[pid].tick(
+                            now + r * GAP, flush=(r == 0)
+                        )
+                    ]
+            assert len(released) == 3
+            assert all(pid == "global-0" for pid, _ in released)
+            assert len({iid for _, iid in released}) == 3
+            snap = nodes["global-0"].snapshot()
+            assert snap["outbox"] == 0
+            assert snap["epoch"] == 0
+            # Followers hold every page; nobody re-emitted.
+            assert nodes["global-1"].snapshot()["pages"] == 3
+            assert nodes["global-2"].snapshot()["pages_emitted"] == 0
+        finally:
+            region.close()
+            for node in nodes.values():
+                node.close()
+
+    def test_one_way_ack_loss_during_in_flight_election(self, tmp_path):
+        """The defining WAN failure mid-election: the new claimant's
+        gossip to one survivor arrives but the acks vanish, so the
+        claim spreads while the claimant spools and replays the same
+        rounds — the per-sender gossip cursor absorbs the storm, the
+        mesh converges on one leader, and the fault injected during
+        the chaos still pages exactly once."""
+        ids = ["global-0", "global-1", "global-2"]
+        g2_port = _free_port()
+        proxy = WanProxy(("127.0.0.1", g2_port))
+        nodes = {}
+        try:
+            ports = {"global-1": _free_port(), "global-2": g2_port}
+            addrs = {
+                pid: f"tcp://127.0.0.1:{port}"
+                for pid, port in ports.items()
+            }
+            # global-0 never comes up: membership-only, rank 0, dark.
+            nodes["global-1"] = LivePeerNode(
+                "global-1",
+                {"global-2": f"tcp://{proxy.host}:{proxy.port}"},
+                tmp_path / "g1",
+                peer_ids=ids,
+                port=ports["global-1"],
+                peer_stale_after_ns=STALE,
+                client_timeout_s=0.5,
+            )
+            nodes["global-2"] = LivePeerNode(
+                "global-2",
+                {"global-1": addrs["global-1"]},
+                tmp_path / "g2",
+                peer_ids=ids,
+                port=ports["global-2"],
+                peer_stale_after_ns=STALE,
+                client_timeout_s=0.5,
+            )
+            now = EPOCH_NS + 50 * GAP
+            for r in range(2):
+                for node in nodes.values():
+                    node.tick(now + r * GAP)
+                time.sleep(0.1)
+            # Acks from global-2 back to global-1 vanish; frames still
+            # arrive.  The election fires into this.
+            proxy.partition(DIR_BACKWARD)
+            nodes["global-1"]._handle(
+                _env("region-a", 0, [_fleet("region-a")])
+            )
+            released = []
+            later = now + STALE + 2 * GAP
+            for r in range(4):
+                for node in nodes.values():
+                    released += node.tick(
+                        later + r * GAP, flush=(r == 0)
+                    )
+                time.sleep(0.1)
+            g1 = nodes["global-1"].snapshot()
+            assert g1["is_leader"] and g1["epoch"] >= 1
+            # The claim crossed despite the ack loss...
+            _wait_until(
+                lambda: nodes["global-2"].snapshot()["leader"]
+                == "global-1"
+            )
+            # ...while the unacked rounds piled into the spool.
+            assert g1["clients"]["global-2"]["spooled"] > 0
+            proxy.heal(DIR_BACKWARD)
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                for node in nodes.values():
+                    released += node.tick(later + 10 * GAP)
+                snap = nodes["global-1"].snapshot()
+                if (
+                    snap["outbox"] == 0
+                    and snap["pages_released"] == 1
+                    and snap["clients"]["global-2"]["spooled"] == 0
+                ):
+                    break
+                time.sleep(0.1)
+            g1 = nodes["global-1"].snapshot()
+            g2 = nodes["global-2"].snapshot()
+            assert g1["pages_released"] == 1
+            assert g1["outbox"] == 0
+            assert g1["clients"]["global-2"]["spooled"] == 0
+            assert g2["leader"] == "global-1"
+            assert g2["epoch"] == g1["epoch"]
+            # Replayed rounds were absorbed, not re-folded.
+            assert g2["peers"]["global-1"]["duplicates"] > 0
+            # Exactly one page mesh-wide, stamped by the new leader.
+            assert len(released) == 1
+            assert released[0]["peer"] == "global-1"
+            assert g2["pages"] == 1 and g2["pages_emitted"] == 0
+        finally:
+            proxy.close()
+            for node in nodes.values():
+                node.close()
+
+
+class TestPeerCLI:
+    def test_fleetagg_peer_batch_rounds_converge(self, tmp_path, capsys):
+        """Iterated ``fleetagg --peer`` runs exchanging gossip files
+        ARE the anti-entropy loop: the leader parks and reports its
+        outbox honestly, the follower folds page + row together, and
+        the next leader run confirms and releases."""
+        from tpuslo.cli.fleetagg import main as fleetagg_main
+
+        region_log = tmp_path / "region-a.jsonl"
+        region_log.write_text(
+            "".join(
+                global_envelope_json_line(
+                    _env("region-a", i, [_fleet("region-a", i)])
+                )
+                for i in range(3)
+            )
+        )
+        mesh = "global-0,global-1,global-2"
+        state_a = tmp_path / "a-state.json"
+        state_b = tmp_path / "b-state.json"
+        gossip_a = tmp_path / "a-gossip.jsonl"
+        gossip_b = tmp_path / "b-gossip.jsonl"
+        assert fleetagg_main([
+            "--peer", "--global-id", "global-0", "--peer-ids", mesh,
+            "--state-out", str(state_a),
+            "--peer-gossip-out", str(gossip_a),
+            "--json", str(region_log),
+        ]) == 0
+        round1 = json.loads(capsys.readouterr().out)
+        assert round1["is_leader"] is True
+        assert round1["pages_released"] == 0
+        assert round1["outbox_unconfirmed"] == 3
+        assert fleetagg_main([
+            "--peer", "--global-id", "global-1", "--peer-ids", mesh,
+            "--state-out", str(state_b),
+            "--peer-gossip-out", str(gossip_b),
+            "--json", str(gossip_a),
+        ]) == 0
+        follower = json.loads(capsys.readouterr().out)
+        assert follower["is_leader"] is False
+        assert follower["pages"] == 3
+        incidents_out = tmp_path / "pages.jsonl"
+        assert fleetagg_main([
+            "--peer", "--global-id", "global-0", "--peer-ids", mesh,
+            "--restore-state", str(state_a),
+            "--state-out", str(state_a),
+            "--incidents-out", str(incidents_out),
+            "--json", str(gossip_b),
+        ]) == 0
+        confirmed = json.loads(capsys.readouterr().out)
+        assert confirmed["pages_released"] == 3
+        assert confirmed["outbox_unconfirmed"] == 0
+        pages = [
+            json.loads(line)
+            for line in incidents_out.read_text().splitlines()
+        ]
+        assert len(pages) == 3
+        assert all(
+            (p["epoch"], p["peer"]) == (0, "global-0") for p in pages
+        )
+
+    def test_fleetagg_peer_flag_conflicts(self, capsys):
+        from tpuslo.cli.fleetagg import main as fleetagg_main
+
+        rc = fleetagg_main(["--peer", "--global-tier", "x.jsonl"])
+        assert rc == 2
+        assert "--peer" in capsys.readouterr().err
+        rc = fleetagg_main(
+            ["--peer", "--peer-upstream", "g1=tcp://h:1", "x.jsonl"]
+        )
+        assert rc == 2
+        assert "live-only" in capsys.readouterr().err
+        rc = fleetagg_main(["--peer-ids", "a,b", "x.jsonl"])
+        assert rc == 2
+        assert "--peer" in capsys.readouterr().err
+
+
+class TestPeerSweepSmall:
+    def test_small_sweep_passes_all_lanes(self):
+        report = run_peer_sweep(
+            peers=3,
+            nodes_per_region=24,
+            measure_ingest_lane=False,
+        )
+        assert report.passed, report.failures
+        handover = report.handover
+        assert (
+            handover["first_successor_round"]
+            <= handover["kill_round"] + handover["election_bound_rounds"]
+        )
+        assert handover["lost"] == [] and handover["duplicated"] == []
+        assert report.splitbrain["sides_elected"] == {
+            "a": True, "b": True,
+        }
+        assert len(set(
+            report.splitbrain["final_leaders"].values()
+        )) == 1
+        assert report.deposed["stale_emits"] == []
+        assert report.deposed["stale_pages_dropped"] >= 1
+
+    def test_sweep_rejects_degenerate_mesh(self):
+        with pytest.raises(ValueError):
+            run_peer_sweep(peers=2)

@@ -128,6 +128,15 @@ HOT_FUNCTIONS: tuple[tuple[str, str], ...] = (
     ("tpuslo/deviceplane/ledger.py", "build_ledger"),
     ("tpuslo/deviceplane/ledger.py", "_contained_ops"),
     ("tpuslo/deviceplane/dispatch.py", "DispatchLedger.note"),
+    # Global peer mesh (ISSUE 19): the gossip fold runs once per
+    # received envelope at mesh fan-in rate, the election tick and
+    # envelope build run every round for every remote — all three read
+    # only the in-memory peer views and the event clock passed in; a
+    # wall-clock read or serialization call here skews the liveness
+    # horizon for every peer behind it.
+    ("tpuslo/federation/global_tier.py", "GlobalPeer.gossip_in"),
+    ("tpuslo/federation/global_tier.py", "GlobalPeer.gossip_out"),
+    ("tpuslo/federation/global_tier.py", "GlobalPeer.election_tick"),
 )
 
 #: (repo-relative module path, dataclass name) pairs that are allocated
@@ -174,6 +183,12 @@ HOT_DATACLASSES: tuple[tuple[str, str], ...] = (
     ("tpuslo/deviceplane/ledger.py", "LaunchRecord"),
     ("tpuslo/deviceplane/ledger.py", "DeviceWindow"),
     ("tpuslo/deviceplane/ledger.py", "CompileEvent"),
+    # Peer-mesh containers (ISSUE 19): one envelope per remote per
+    # gossip round; one view per peer folded on every receive; the
+    # gap-tolerant cursor advances per envelope.
+    ("tpuslo/federation/wire.py", "PeerEnvelope"),
+    ("tpuslo/federation/global_tier.py", "_PeerView"),
+    ("tpuslo/federation/global_tier.py", "GapTolerantCursor"),
 )
 
 #: The JAX plane the TPL16x trace-discipline rules govern: every file

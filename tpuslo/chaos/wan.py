@@ -335,3 +335,94 @@ class WanLink:
             "ack_watermark": self.ack_watermark,
             "in_flight": len(self._in_flight),
         }
+
+
+# ---- peer-mesh chaos (global aggregator ↔ global aggregator) -----------
+
+#: PeerWanEvent actions (per *directed* peer pair, so asymmetric
+#: partitions — A hears B, B never hears A — are first-class).
+PEER_DARK = "dark"
+PEER_HEAL = "heal"
+
+
+@dataclass(frozen=True)
+class PeerWanEvent:
+    """One scheduled state change on a directed peer gossip path.
+
+    ``src == "*"`` or ``dst == "*"`` wildcards a whole row/column of
+    the mesh, which is how "peer P falls off the WAN" is written:
+    dark every path into and out of P.
+    """
+
+    round_i: int
+    src: str
+    dst: str
+    action: str  # dark | heal
+
+    def matches(self, src: str, dst: str) -> bool:
+        return (self.src in ("*", src)) and (self.dst in ("*", dst))
+
+
+def peer_dark_events(
+    round_i: int,
+    peer: str,
+    heal_round: int | None = None,
+) -> list[PeerWanEvent]:
+    """Peer ``peer`` falls off the mesh (both directions, all pairs)."""
+    events = [
+        PeerWanEvent(round_i, peer, "*", PEER_DARK),
+        PeerWanEvent(round_i, "*", peer, PEER_DARK),
+    ]
+    if heal_round is not None:
+        events.append(PeerWanEvent(heal_round, peer, "*", PEER_HEAL))
+        events.append(PeerWanEvent(heal_round, "*", peer, PEER_HEAL))
+    return events
+
+
+def root_dark_events(
+    round_i: int,
+    root_peer: str,
+    root_region: str,
+    heal_round: int | None = None,
+) -> tuple[list[WanEvent], list[PeerWanEvent]]:
+    """The tentpole scenario: the ROOT's own peering domain goes dark.
+
+    The root peer vanishes from the mesh AND its co-located region's
+    WAN link cuts at the same round — the failure PR 18's single-root
+    design could not survive.  Returns (region events, peer events)
+    for :class:`~tpuslo.federation.simulator.PeerMeshSimulator`.
+    """
+    region_events = [WanEvent(round_i, root_region, WAN_DARK)]
+    if heal_round is not None:
+        region_events.append(WanEvent(heal_round, root_region, WAN_HEAL))
+    return region_events, peer_dark_events(round_i, root_peer, heal_round)
+
+
+def split_mesh_events(
+    round_i: int,
+    side_a: list[str],
+    side_b: list[str],
+    heal_round: int | None = None,
+    one_way: bool = False,
+) -> list[PeerWanEvent]:
+    """Split the mesh into two sides that each keep internal gossip.
+
+    Symmetric by default (neither side hears the other — both sides
+    elect); ``one_way`` darkens only the b→a direction, the WAN's
+    favorite asymmetric failure: A's frames reach B, B's never come
+    back, so A still counts B live via transitive silence while B
+    watches A age out.
+    """
+    events: list[PeerWanEvent] = []
+    for a in side_a:
+        for b in side_b:
+            events.append(PeerWanEvent(round_i, b, a, PEER_DARK))
+            if not one_way:
+                events.append(PeerWanEvent(round_i, a, b, PEER_DARK))
+            if heal_round is not None:
+                events.append(PeerWanEvent(heal_round, b, a, PEER_HEAL))
+                if not one_way:
+                    events.append(
+                        PeerWanEvent(heal_round, a, b, PEER_HEAL)
+                    )
+    return events

@@ -25,6 +25,13 @@ cross-cluster identity (``--global-out`` ships the region→global
 envelope), and ``--global-tier`` folds per-region envelope logs into
 globally-identified pages (``sloctl fleet incidents --global``
 renders them; ``--merge-peer`` is the partition-heal handshake).
+
+The global tier also runs as a symmetric N-peer mesh (``--peer``):
+peers gossip mergeable emitted-window registries and elect one root
+by stable rank, epoch-fenced.  Batch runs exchange
+``--peer-gossip-out`` files as anti-entropy rounds; ``--peer
+--listen`` is the live mesh front door, accepting region envelopes
+and peer gossip on one socket.
 """
 
 from __future__ import annotations
@@ -147,10 +154,53 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--merge-peer",
         default="",
-        help="--global-tier: a peer's --state-out snapshot; union its "
-        "emitted-window registry before ingesting (the partition-"
-        "heal handshake — the rejoined side suppresses pages the "
-        "peer already sent instead of re-paging)",
+        help="--global-tier/--peer: a peer's --state-out snapshot; "
+        "union its emitted-window registry before ingesting (the "
+        "one-shot partition-heal handshake — under --peer this is "
+        "just one round of the gossip fold without liveness)",
+    )
+    # ---- symmetric global peer mesh (gossip + election) ---------------
+    p.add_argument(
+        "--peer",
+        action="store_true",
+        help="run as ONE peer of the symmetric global mesh: inputs "
+        "are global-envelope JSONL logs (this peer's home regions) "
+        "and/or peer-envelope JSONL gossip logs written by other "
+        "peers' --peer-gossip-out; with --listen the process is the "
+        "live mesh front door (region frames + gossip frames on one "
+        "socket)",
+    )
+    p.add_argument(
+        "--peer-ids",
+        default="",
+        help="comma-separated full mesh membership (sorted order = "
+        "stable election rank); defaults to just --global-id — a "
+        "mesh of one behaves exactly like --global-tier",
+    )
+    p.add_argument(
+        "--peer-gossip-out",
+        default="",
+        help="batch --peer: write one outbound peer envelope per "
+        "remote peer as JSONL (feed it to the other peers' next "
+        "batch run — the file-hop form of an anti-entropy round; "
+        "supersedes the one-shot --merge-peer handshake)",
+    )
+    p.add_argument(
+        "--peer-upstream",
+        action="append",
+        default=[],
+        metavar="PEER=tcp://HOST:PORT",
+        help="live --peer: one remote mesh peer's front door "
+        "(repeatable); each gets a spool-backed gossip client "
+        "under --spool-dir",
+    )
+    p.add_argument(
+        "--peer-stale-after-ns",
+        type=int,
+        default=180_000_000_000,
+        help="--peer: a mesh peer unheard (directly or transitively) "
+        "for longer than this is presumed dead and the bully rule "
+        "elects past it",
     )
     p.add_argument(
         "--region-stale-after-ns",
@@ -551,6 +601,443 @@ def run_global_tier(args) -> int:
                 f"scope={incident.scope} "
                 f"confidence={incident.confidence:.3f}"
             )
+    return 0
+
+
+def _mesh_membership(args) -> list[str]:
+    ids = {p.strip() for p in args.peer_ids.split(",") if p.strip()}
+    for entry in args.peer_upstream:
+        pid = entry.partition("=")[0].strip()
+        if pid:
+            ids.add(pid)
+    ids.add(args.global_id)
+    return sorted(ids)
+
+
+def run_peer(args) -> int:
+    """``fleetagg --peer``: one batch round of a symmetric mesh peer.
+
+    The batch form of the anti-entropy protocol: global-envelope logs
+    (this peer's home regions) and peer-envelope gossip logs (other
+    peers' ``--peer-gossip-out``) fold in, one election tick and one
+    pump run on the event clock, and ``--peer-gossip-out`` writes the
+    next round's outbound envelopes.  Iterating runs across peers IS
+    the gossip loop on the file hop — it converges for the same
+    lattice-merge reasons the live mesh does.  Pages a mesh of more
+    than one cannot confirm yet stay honestly in the outbox (reported,
+    not emitted); the next round's gossip releases them.
+    """
+    from tpuslo.federation.global_tier import GlobalPeer
+    from tpuslo.federation.wire import peer_envelope_json_line
+
+    membership = _mesh_membership(args)
+    peer = GlobalPeer(
+        args.global_id,
+        membership,
+        rollup_gap_ns=args.rollup_gap_ns,
+        region_stale_after_ns=args.region_stale_after_ns,
+        peer_stale_after_ns=args.peer_stale_after_ns,
+        capacity_incidents=args.pressure_capacity,
+    )
+    if args.restore_state:
+        try:
+            with open(args.restore_state, encoding="utf-8") as fh:
+                snapshot = json.load(fh)
+        except (OSError, json.JSONDecodeError) as exc:
+            print(
+                f"fleetagg: cannot restore {args.restore_state}: {exc}",
+                file=sys.stderr,
+            )
+            return 1
+        if snapshot.get("peer"):
+            peer.restore_state(snapshot["peer"])
+        else:
+            # A plain --global-tier snapshot restores the agg half.
+            peer.agg.restore_state(snapshot.get("global") or {})
+        print(
+            f"fleetagg: restored peer state from {args.restore_state}",
+            file=sys.stderr,
+        )
+    if args.merge_peer:
+        try:
+            with open(args.merge_peer, encoding="utf-8") as fh:
+                peer_snapshot = json.load(fh)
+        except (OSError, json.JSONDecodeError) as exc:
+            print(
+                f"fleetagg: cannot merge {args.merge_peer}: {exc}",
+                file=sys.stderr,
+            )
+            return 1
+        merged = peer.merge_peer(
+            peer_snapshot.get("peer")
+            or peer_snapshot.get("global")
+            or {}
+        )
+        print(
+            f"fleetagg: merged {merged} emitted windows from peer "
+            f"{args.merge_peer}",
+            file=sys.stderr,
+        )
+    rejected = 0
+    gossip_frames = 0
+    for path in args.inputs:
+        try:
+            fh = open(path, encoding="utf-8")
+        except OSError as exc:
+            print(
+                f"fleetagg: cannot read {path}: {exc.strerror or exc}",
+                file=sys.stderr,
+            )
+            return 1
+        with fh:
+            for lineno, line in enumerate(fh, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    raw = json.loads(line)
+                except json.JSONDecodeError as exc:
+                    rejected += 1
+                    print(
+                        f"fleetagg: {path}:{lineno}: rejected: {exc}",
+                        file=sys.stderr,
+                    )
+                    continue
+                try:
+                    if "peer_wire_version" in raw:
+                        peer.gossip_in(raw)
+                        gossip_frames += 1
+                    else:
+                        peer.ingest(raw)
+                except WireContractError as exc:
+                    rejected += 1
+                    print(
+                        f"fleetagg: {path}:{lineno}: rejected: {exc}",
+                        file=sys.stderr,
+                    )
+    # Event clock only: the freshest stream head anyone reported is
+    # "now" for liveness and the election.
+    now_ns = peer.agg.head_ns()
+    for view in peer.views.values():
+        if view.head_ns > now_ns:
+            now_ns = view.head_ns
+    took = peer.election_tick(now_ns)
+    if took:
+        print(
+            f"fleetagg: peer {peer.peer_id} took leadership at "
+            f"epoch {peer.epoch}",
+            file=sys.stderr,
+        )
+    peer.pump(flush=True)
+    peer.reconcile()
+    if args.peer_gossip_out:
+        with open(args.peer_gossip_out, "w", encoding="utf-8") as fh:
+            for pid in membership:
+                if pid == peer.peer_id:
+                    continue
+                fh.write(
+                    peer_envelope_json_line(
+                        peer.gossip_out(pid, now_ns)
+                    )
+                )
+        peer.begin_gossip_round()
+    if args.incidents_out:
+        with open(args.incidents_out, "w", encoding="utf-8") as fh:
+            for page in peer.pages:
+                fh.write(
+                    json.dumps(page, separators=(",", ":")) + "\n"
+                )
+    if args.state_out:
+        state = {
+            "saved_at": datetime.now(timezone.utc).isoformat(
+                timespec="seconds"
+            ),
+            "peer": peer.export_state(),
+            "global": peer.agg.export_state(),
+            "snapshot": peer.snapshot(),
+        }
+        with open(args.state_out, "w", encoding="utf-8") as fh:
+            json.dump(state, fh, indent=2)
+            fh.write("\n")
+    snap = peer.snapshot()
+    summary = {
+        "peer_id": peer.peer_id,
+        "mesh": membership,
+        "rank": peer.rank,
+        "epoch": peer.epoch,
+        "leader": peer.leader_id,
+        "is_leader": peer.is_leader,
+        "elections": peer.elections,
+        "envelopes": peer.agg.envelopes,
+        "duplicate_envelopes": peer.agg.duplicate_envelopes,
+        "rejected_frames": rejected,
+        "gossip_frames": gossip_frames,
+        "gossip_duplicates": peer.gossip_duplicates,
+        "registry_merged": peer.registry_merged,
+        "regions": sorted(peer.agg.regions),
+        "unreachable_regions": sorted(peer.agg.unreachable_regions()),
+        "pages": len(peer.pages),
+        "pages_released": peer.pages_released,
+        "outbox_unconfirmed": len(peer.outbox),
+        "deferred": len(peer.deferred),
+        "stale_epoch_rejections": peer.stale_epoch_rejections,
+        "stale_pages_dropped": peer.stale_pages_dropped,
+        "duplicates_suppressed": snap["agg"]["duplicates_suppressed"],
+    }
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(
+            "fleetagg: peer {pid} (rank {rank}) epoch {epoch} "
+            "leader={leader}: {envelopes} envelopes, "
+            "{gossip} gossip frames -> {pages} pages held "
+            "({released} released, {outbox} awaiting confirmation, "
+            "{rej} stale-epoch rejections)".format(
+                pid=summary["peer_id"],
+                rank=summary["rank"],
+                epoch=summary["epoch"],
+                leader=summary["leader"],
+                envelopes=summary["envelopes"],
+                gossip=summary["gossip_frames"],
+                pages=summary["pages"],
+                released=summary["pages_released"],
+                outbox=summary["outbox_unconfirmed"],
+                rej=summary["stale_epoch_rejections"],
+            )
+        )
+        for page in peer.pages:
+            print(
+                f"  {page.get('incident_id')}: {page.get('domain')} "
+                f"[{page.get('blast_radius')}] tenant="
+                f"{page.get('namespace')} "
+                f"epoch={page.get('epoch')} peer={page.get('peer')} "
+                f"scope={page.get('scope')}"
+            )
+    return 0
+
+
+def run_peer_live(args) -> int:
+    """``fleetagg --peer --listen``: the live mesh front door.
+
+    One socket accepts both frame kinds — region global-envelopes and
+    mesh peer-envelopes — and one spool-backed client per
+    ``--peer-upstream`` carries gossip out every tick.  Election,
+    pump and anti-entropy all run on the tick cadence; released pages
+    append to ``--incidents-out`` the moment their window row gossips
+    back (the commit-then-page fence).
+    """
+    import os
+    import time as time_mod
+
+    from tpuslo.federation.livemesh import LivePeerNode
+    from tpuslo.metrics import AgentMetrics
+    from tpuslo.runtime import DrainSignal, install_drain_handler
+
+    host, _, port_s = args.listen.rpartition(":")
+    host = host or "127.0.0.1"
+    try:
+        port = int(port_s)
+    except ValueError:
+        print(
+            f"fleetagg: --listen {args.listen!r} must be HOST:PORT",
+            file=sys.stderr,
+        )
+        return 2
+    peer_addrs: dict[str, str] = {}
+    for entry in args.peer_upstream:
+        pid, sep, url = entry.partition("=")
+        if not sep or not pid.strip() or not url.strip():
+            print(
+                f"fleetagg: --peer-upstream {entry!r} must be "
+                "PEER=tcp://HOST:PORT",
+                file=sys.stderr,
+            )
+            return 2
+        peer_addrs[pid.strip()] = url.strip()
+    if peer_addrs and not args.spool_dir:
+        print(
+            "fleetagg: --peer-upstream needs --spool-dir for the "
+            "gossip spools",
+            file=sys.stderr,
+        )
+        return 2
+
+    metrics = AgentMetrics()
+    membership = _mesh_membership(args)
+    sink_path = args.incidents_out
+    sink_seen: set[str] = set()
+    sink_written = [0]
+    sink_fh = None
+    if sink_path:
+        try:
+            with open(sink_path, encoding="utf-8") as fh:
+                for line in fh:
+                    try:
+                        rid = json.loads(line).get("incident_id")
+                    except (json.JSONDecodeError, AttributeError):
+                        continue
+                    if isinstance(rid, str):
+                        sink_seen.add(rid)
+        except OSError:
+            pass
+        sink_fh = open(sink_path, "a", encoding="utf-8")
+
+    def _sink_page(page: dict[str, Any]) -> None:
+        rid = str(page.get("incident_id", ""))
+        if rid in sink_seen:
+            return
+        sink_seen.add(rid)
+        sink_written[0] += 1
+        if sink_fh is not None:
+            sink_fh.write(
+                json.dumps(page, separators=(",", ":")) + "\n"
+            )
+            sink_fh.flush()
+
+    try:
+        node = LivePeerNode(
+            args.global_id,
+            peer_addrs,
+            args.spool_dir or ".",
+            peer_ids=membership,
+            host=host,
+            port=port,
+            rollup_gap_ns=args.rollup_gap_ns,
+            region_stale_after_ns=args.region_stale_after_ns,
+            peer_stale_after_ns=args.peer_stale_after_ns,
+            capacity_incidents=args.pressure_capacity,
+            observer=metrics.global_observer(),
+            livenet_observer=metrics.livenet_observer(),
+            log=lambda msg: print(f"fleetagg: {msg}", file=sys.stderr),
+        )
+    except (OSError, ValueError) as exc:
+        print(
+            f"fleetagg: cannot start peer node: {exc}",
+            file=sys.stderr,
+        )
+        return 1
+    if args.restore_state:
+        try:
+            with open(args.restore_state, encoding="utf-8") as fh:
+                snapshot = json.load(fh)
+            node.restore_state(snapshot.get("peer") or {})
+            print(
+                f"fleetagg: restored peer state from "
+                f"{args.restore_state}",
+                file=sys.stderr,
+            )
+        except (OSError, json.JSONDecodeError) as exc:
+            print(
+                f"fleetagg: cannot restore {args.restore_state}: "
+                f"{exc}",
+                file=sys.stderr,
+            )
+    print(
+        f"fleetagg: live peer {args.global_id} (mesh "
+        f"{','.join(membership)}) listening on {node.address}",
+        file=sys.stderr,
+    )
+    status_fh = None
+    if args.status_out:
+        status_fh = open(args.status_out, "a", encoding="utf-8")
+    ticks = [0]
+
+    def _heartbeat() -> None:
+        if status_fh is None:
+            return
+        snap = node.snapshot()
+        line = {
+            "role": "peer",
+            "ts": time_mod.time(),
+            "tick": ticks[0],
+            "epoch": snap["epoch"],
+            "leader": snap["leader"],
+            "is_leader": snap["is_leader"],
+            "pages": snap["pages"],
+            "outbox": snap["outbox"],
+            "pages_written": sink_written[0],
+        }
+        status_fh.write(
+            json.dumps(line, separators=(",", ":")) + "\n"
+        )
+        status_fh.flush()
+
+    restore_handlers = install_drain_handler()
+    deadline = (
+        time_mod.monotonic() + args.run_for_s
+        if args.run_for_s > 0
+        else float("inf")
+    )
+    try:
+        while time_mod.monotonic() < deadline:
+            time_mod.sleep(max(0.01, args.tick_s))
+            ticks[0] += 1
+            for page in node.tick(time_mod.time_ns()):
+                _sink_page(page)
+            _heartbeat()
+    except (KeyboardInterrupt, DrainSignal):
+        pass
+    finally:
+        restore_handlers()
+        ticks[0] += 1
+        for page in node.tick(time_mod.time_ns(), flush=True):
+            _sink_page(page)
+        if args.state_out:
+            state = {
+                "saved_at": datetime.now(timezone.utc).isoformat(
+                    timespec="seconds"
+                ),
+                "peer": node.export_state(),
+                "snapshot": node.snapshot(),
+            }
+            try:
+                with open(
+                    args.state_out, "w", encoding="utf-8"
+                ) as fh:
+                    json.dump(state, fh, indent=2)
+                    fh.write("\n")
+            except OSError as exc:
+                print(
+                    f"fleetagg: cannot write {args.state_out}: {exc}",
+                    file=sys.stderr,
+                )
+        _heartbeat()
+        if status_fh is not None:
+            status_fh.close()
+        node.close()
+        if sink_fh is not None:
+            sink_fh.close()
+    snap = node.snapshot()
+    summary = {
+        "peer_id": args.global_id,
+        "epoch": snap["epoch"],
+        "leader": snap["leader"],
+        "elections": snap["elections"],
+        "listener_frames": snap["listener_frames"],
+        "frames_rejected": snap["frames_rejected"],
+        "gossip_frames": snap["gossip_frames"],
+        "pages": snap["pages"],
+        "pages_written": sink_written[0],
+        "outbox_unconfirmed": snap["outbox"],
+        "stale_epoch_rejections": snap["stale_epoch_rejections"],
+    }
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(
+            "fleetagg: live peer {pid}: epoch {epoch} "
+            "leader={leader}, {frames} frames ({gossip} gossip), "
+            "{written} pages written, {outbox} awaiting "
+            "confirmation".format(
+                pid=summary["peer_id"],
+                epoch=summary["epoch"],
+                leader=summary["leader"],
+                frames=summary["listener_frames"],
+                gossip=summary["gossip_frames"],
+                written=summary["pages_written"],
+                outbox=summary["outbox_unconfirmed"],
+            )
+        )
     return 0
 
 
@@ -1021,10 +1508,54 @@ def run_live(args) -> int:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.peer:
+        if args.global_tier or args.region or args.cluster_id:
+            print(
+                "fleetagg: --peer is its own tier; drop "
+                "--global-tier/--region/--cluster-id",
+                file=sys.stderr,
+            )
+            return 2
+        if args.listen:
+            if args.inputs:
+                print(
+                    "fleetagg: live mode (--listen) takes no input "
+                    "logs",
+                    file=sys.stderr,
+                )
+                return 2
+            return run_peer_live(args)
+        if args.peer_upstream:
+            print(
+                "fleetagg: --peer-upstream is live-only; batch "
+                "rounds exchange --peer-gossip-out files",
+                file=sys.stderr,
+            )
+            return 2
+        if not (
+            args.inputs
+            or args.restore_state
+            or args.merge_peer
+            or args.peer_gossip_out
+        ):
+            print(
+                "fleetagg: --peer needs envelope/gossip logs (or "
+                "state to restore/merge)",
+                file=sys.stderr,
+            )
+            return 2
+        return run_peer(args)
+    if args.peer_ids or args.peer_upstream or args.peer_gossip_out:
+        print(
+            "fleetagg: --peer-ids/--peer-upstream/--peer-gossip-out "
+            "belong to --peer runs",
+            file=sys.stderr,
+        )
+        return 2
     if args.global_tier and args.listen:
         print(
-            "fleetagg: --global-tier is batch-only; the live WAN hop "
-            "is the simulator's WanLink lane",
+            "fleetagg: --global-tier is batch-only; the live mesh "
+            "front door is --peer --listen",
             file=sys.stderr,
         )
         return 2
@@ -1053,7 +1584,8 @@ def main(argv: list[str] | None = None) -> int:
         return run_global_tier(args)
     if args.merge_peer:
         print(
-            "fleetagg: --merge-peer belongs to --global-tier runs",
+            "fleetagg: --merge-peer belongs to --global-tier or "
+            "--peer runs",
             file=sys.stderr,
         )
         return 2

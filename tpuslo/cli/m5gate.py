@@ -460,6 +460,50 @@ def build_parser() -> argparse.ArgumentParser:
         help="skip the 100k ingest lane (the slow half of the gate; "
         "the smoke target uses this)",
     )
+    # ---- peer-mesh gate (tpuslo.federation symmetric root) -------------
+    p.add_argument(
+        "--peer-sweep",
+        action="store_true",
+        help="run the symmetric-peer-mesh gate instead of B5/D3/E3: "
+        "N global aggregators gossiping over the 100k-node "
+        "simulator; killing the leader's whole peering domain "
+        "mid-sweep must elect a new root within bounded gossip "
+        "rounds with zero lost/duplicate pages, a split-brain where "
+        "BOTH sides elect must heal by gossip alone, and a deposed "
+        "root returning from an hour dark must emit nothing at its "
+        "stale epoch (rejections counted, evidence re-stamped)",
+    )
+    p.add_argument(
+        "--peer-count",
+        type=int,
+        default=3,
+        help="mesh size for the handover and deposed-root lanes "
+        "(the split-brain lane always runs five so both halves can "
+        "confirm commits internally)",
+    )
+    p.add_argument(
+        "--root-dark-rounds",
+        type=int,
+        default=12,
+        help="rounds the leader's peering domain stays dark in the "
+        "handover lane",
+    )
+    p.add_argument(
+        "--peer-deposed-dark-rounds",
+        type=int,
+        default=60,
+        help="rounds the deposed root sits in its own partition "
+        "(60 x 60s rounds = one simulated hour)",
+    )
+    p.add_argument(
+        "--peer-gossip-latency-rounds", type=int, default=1
+    )
+    p.add_argument(
+        "--peer-no-ingest",
+        action="store_true",
+        help="skip the 100k ingest lane (the slow half of the gate; "
+        "the smoke target uses this)",
+    )
     # ---- live deployment-plane gate (tpuslo.chaos.procs) --------------
     p.add_argument(
         "--live-chaos-sweep",
@@ -1157,6 +1201,125 @@ def run_global_gate(args) -> int:
     return 0 if report.passed else 1
 
 
+def render_peer_markdown(report) -> str:
+    ingest = report.ingest
+    ho = report.handover
+    sb = report.splitbrain
+    dp = report.deposed
+    lines = [
+        "# Peer-mesh gate (symmetric global root under WAN chaos)",
+        "",
+        f"**Overall: {'PASS' if report.passed else 'FAIL'}**",
+        "",
+        f"- {report.peers} mesh peers over {report.regions} regions "
+        f"x {report.nodes_per_region} nodes (seed {report.seed}, "
+        f"{report.round_s:.0f}s rounds, gossip latency "
+        f"{report.gossip_latency_rounds} round(s))",
+        "- 100k ingest: "
+        + (
+            "{eps:,.0f} events/s over {nodes} nodes in {regions} "
+            "regions (floor {floor:,.0f}); global fold "
+            "{fold:.1f} ms".format(
+                eps=ingest.get("events_per_sec", 0),
+                nodes=ingest.get("nodes", 0),
+                regions=ingest.get("regions", 0),
+                floor=report.min_ingest_events_per_sec,
+                fold=ingest.get("global_fold_ms", 0.0),
+            )
+            if ingest
+            else "(skipped)"
+        ),
+        "- handover: root dark at round {kill}, successor at round "
+        "{take} (bound {bound}), {pages} page(s) while dark, "
+        "{failovers} region failovers — lost {lost}, duplicated "
+        "{dup}, split {split}".format(
+            kill=ho.get("kill_round", "-"),
+            take=ho.get("first_successor_round", "-"),
+            bound=ho.get("kill_round", 0)
+            + ho.get("election_bound_rounds", 0),
+            pages=ho.get("pages_during_dark", 0),
+            failovers=ho.get("failovers", 0),
+            lost=len(ho.get("lost", [])),
+            dup=len(ho.get("duplicated", [])),
+            split=len(ho.get("split", [])),
+        ),
+        "- split brain: sides elected a={a} b={b}, {sup} replayed "
+        "session(s) suppressed across the heal, converged on "
+        "{leaders} at epoch(s) {epochs} — lost {lost}, duplicated "
+        "{dup}".format(
+            a=(sb.get("sides_elected") or {}).get("a"),
+            b=(sb.get("sides_elected") or {}).get("b"),
+            sup=sb.get("replays_suppressed", 0),
+            leaders=sorted(set((sb.get("final_leaders") or {}).values())),
+            epochs=sorted(set((sb.get("final_epochs") or {}).values())),
+            lost=len(sb.get("lost", [])),
+            dup=len(sb.get("duplicated", [])),
+        ),
+        "- deposed root: {rounds} rounds dark, {fenced} stale "
+        "page(s) fenced at heal ({restamped} re-stamped under the "
+        "won-back epoch), {rej} stale-epoch rejection(s) counted on "
+        "the survivors, {emits} stale emission(s) — lost {lost}, "
+        "duplicated {dup}".format(
+            rounds=dp.get("dark_rounds", 0),
+            fenced=dp.get("stale_pages_dropped", 0),
+            restamped=dp.get("pages_restamped", 0),
+            rej=dp.get("stale_epoch_rejections", 0),
+            emits=len(dp.get("stale_emits", [])),
+            lost=len(dp.get("lost", [])),
+            dup=len(dp.get("duplicated", [])),
+        ),
+        "",
+        "| lane | baseline clusters | chaos clusters | elections |",
+        "|---|---|---|---|",
+    ]
+    for label, lane in (
+        ("handover", ho), ("split-brain", sb), ("deposed-root", dp)
+    ):
+        lines.append(
+            f"| {label} | {lane.get('baseline_clusters', '-')} "
+            f"| {lane.get('chaos_clusters', '-')} "
+            f"| {len(lane.get('elections', []))} |"
+        )
+    if report.failures:
+        lines += ["", "## Failures", ""]
+        lines += [f"- {f}" for f in report.failures]
+    return "\n".join(lines) + "\n"
+
+
+def run_peer_gate(args) -> int:
+    from tpuslo.federation.sweep import run_peer_sweep
+
+    report = run_peer_sweep(
+        peers=args.peer_count,
+        regions=args.global_regions,
+        nodes_per_region=args.global_nodes_per_region,
+        seed=args.global_seed,
+        round_s=args.global_round_s,
+        replay_budget=args.global_replay_budget,
+        gossip_latency_rounds=args.peer_gossip_latency_rounds,
+        root_dark_rounds=args.root_dark_rounds,
+        deposed_dark_rounds=args.peer_deposed_dark_rounds,
+        ingest_regions=args.global_ingest_regions,
+        ingest_nodes_per_region=args.global_ingest_nodes_per_region,
+        min_ingest_events_per_sec=args.global_min_ingest,
+        measure_ingest_lane=not args.peer_no_ingest,
+        log=lambda msg: print(f"m5gate: {msg}", file=sys.stderr),
+    )
+    if args.summary_json:
+        Path(args.summary_json).write_text(
+            json.dumps(report.to_dict(), indent=2) + "\n"
+        )
+    if args.summary_md:
+        Path(args.summary_md).write_text(render_peer_markdown(report))
+    print(
+        f"m5gate: peer-sweep "
+        f"{'PASS' if report.passed else 'FAIL'}"
+        + ("" if report.passed else f" ({'; '.join(report.failures)})"),
+        file=sys.stderr,
+    )
+    return 0 if report.passed else 1
+
+
 def render_live_markdown(report) -> str:
     lines = [
         "# Live deployment-plane gate (process tree over real sockets)",
@@ -1517,6 +1680,8 @@ def main(argv: list[str] | None = None) -> int:
         return run_federation_gate(args)
     if args.global_sweep:
         return run_global_gate(args)
+    if args.peer_sweep:
+        return run_peer_gate(args)
     if args.live_chaos_sweep:
         return run_live_gate(args)
     if args.crash_sweep:

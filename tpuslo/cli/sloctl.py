@@ -322,18 +322,28 @@ def _run_global_incidents(args) -> int:
     peer side may hold the rest, and ``!<regions>`` names who was
     dark).  Drill-down stays two-level: each member entry is one
     region's fleet page, explained on that region's own logs.
+
+    Mesh output (``fleetagg --peer``) stamps each page with the
+    election epoch and emitting peer; an EMITTED column renders both
+    so a failover's handover point is visible straight from the log.
     """
     from tpuslo.federation.global_tier import GlobalIncident
 
     pages: list[GlobalIncident] = []
+    stamps: dict[str, tuple[int, str]] = {}
     try:
         with open(args.incidents, encoding="utf-8") as fh:
             for line in fh:
                 line = line.strip()
                 if line:
-                    pages.append(
-                        GlobalIncident.from_dict(json.loads(line))
-                    )
+                    raw = json.loads(line)
+                    page = GlobalIncident.from_dict(raw)
+                    pages.append(page)
+                    if "epoch" in raw or "peer" in raw:
+                        stamps[page.incident_id] = (
+                            int(raw.get("epoch", 0)),
+                            str(raw.get("peer", "")),
+                        )
     except (OSError, json.JSONDecodeError) as exc:
         print(
             f"sloctl fleet incidents: cannot read "
@@ -361,28 +371,31 @@ def _run_global_incidents(args) -> int:
     if not pages:
         print("(no global incidents)")
         return 0
-    rows = [
-        (
-            "INCIDENT", "DOMAIN", "RADIUS", "TENANT", "REGIONS",
-            "SCOPE", "MEMBERS", "CONFIDENCE",
-        )
+    header = [
+        "INCIDENT", "DOMAIN", "RADIUS", "TENANT", "REGIONS",
+        "SCOPE", "MEMBERS", "CONFIDENCE",
     ]
+    if stamps:
+        header.append("EMITTED")
+    rows = [tuple(header)]
     for g in sorted(pages, key=lambda x: x.window_start_ns):
         scope = g.scope
         if g.partition_scoped and g.unreachable_regions:
             scope += " !" + ",".join(g.unreachable_regions)
-        rows.append(
-            (
-                g.incident_id,
-                g.domain,
-                g.blast_radius,
-                g.namespace,
-                ",".join(g.regions) or "-",
-                scope,
-                str(len(g.members)),
-                f"{g.confidence:.3f}",
-            )
-        )
+        row = [
+            g.incident_id,
+            g.domain,
+            g.blast_radius,
+            g.namespace,
+            ",".join(g.regions) or "-",
+            scope,
+            str(len(g.members)),
+            f"{g.confidence:.3f}",
+        ]
+        if stamps:
+            epoch, peer = stamps.get(g.incident_id, (0, ""))
+            row.append(f"e{epoch}@{peer or '-'}")
+        rows.append(tuple(row))
     print(_render_table(rows))
     print(
         f"{len(pages)} global incidents — each MEMBER is one "

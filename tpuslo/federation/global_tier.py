@@ -48,7 +48,11 @@ from typing import Any, Callable, Iterable
 from tpuslo.federation.backpressure import PressureController
 from tpuslo.federation.wire import (
     GlobalEnvelope,
+    PeerEnvelope,
+    PeerWireError,
     decode_global_envelope,
+    decode_peer_envelope,
+    encode_peer_envelope,
 )
 from tpuslo.fleet.rollup import BLAST_RADII, FleetIncident
 
@@ -75,6 +79,14 @@ class GlobalObserver:
     def global_duplicate(self, reason: str) -> None: ...
 
     def region_reachable(self, region: str, reachable: int) -> None: ...
+
+    def peer_epoch(self, peer: str, epoch: int) -> None: ...
+
+    def peer_election(self, peer: str) -> None: ...
+
+    def peer_gossip_round(self, peer: str) -> None: ...
+
+    def peer_reachable(self, peer: str, reachable: int) -> None: ...
 
 
 @dataclass(slots=True)
@@ -105,6 +117,22 @@ class GapTolerantCursor:
             self.accepted.discard(self.watermark)
         return True
 
+    def _compact(self) -> None:
+        """Re-establish the invariant: accepted strictly above the
+        watermark, no contiguous run left unfolded.
+
+        A state exported mid-compaction (or assembled by a peer from
+        gossip) may hold accepted seqs at or below the watermark, or a
+        contiguous run just above it; without folding them back in,
+        ``accept(watermark + 1)`` would return True for a seq already
+        delivered — a duplicate, the one thing this cursor exists to
+        prevent.
+        """
+        self.accepted = {s for s in self.accepted if s > self.watermark}
+        while self.watermark + 1 in self.accepted:
+            self.watermark += 1
+            self.accepted.discard(self.watermark)
+
     def export_state(self) -> dict[str, Any]:
         return {
             "watermark": self.watermark,
@@ -114,6 +142,7 @@ class GapTolerantCursor:
     def restore_state(self, state: dict[str, Any]) -> None:
         self.watermark = int(state.get("watermark", -1))
         self.accepted = {int(s) for s in state.get("accepted") or []}
+        self._compact()
 
 
 @dataclass(slots=True)
@@ -397,6 +426,23 @@ class GlobalRollup:
             for start, end in windows
         ]
 
+    def window_registered(
+        self, namespace: str, domain: str, start_ns: int, end_ns: int
+    ) -> bool:
+        """True when ``[start_ns, end_ns]`` overlaps a paged window
+        (within ``gap_ns``) — the same test :meth:`_emit` suppresses
+        on, exposed so mesh followers can trim buffered members the
+        leader already paged without building sessions first."""
+        for rec_start, rec_end in self._emitted_windows.get(
+            (namespace, domain), ()
+        ):
+            if (
+                start_ns <= rec_end + self.gap_ns
+                and end_ns >= rec_start - self.gap_ns
+            ):
+                return True
+        return False
+
     def merge_emitted_windows(self, rows: Iterable[Iterable[Any]]) -> int:
         """Union a peer's emitted-window registry in; returns adds.
 
@@ -413,6 +459,30 @@ class GlobalRollup:
                 windows.append(window)
                 merged += 1
         return merged
+
+    def withdraw_window(
+        self, namespace: str, domain: str, start_ns: int, end_ns: int
+    ) -> bool:
+        """Remove one exact registry row; returns True if present.
+
+        The mesh commit protocol parks a freshly closed session in the
+        peer outbox and must keep its window *out* of the gossiped
+        registry until the page is confirmed — a row with no released
+        page behind it would suppress the successor's rebuild and lose
+        the incident outright.  Release re-registers the row via
+        :meth:`merge_emitted_windows`.
+        """
+        windows = self._emitted_windows.get((namespace, domain))
+        if not windows:
+            return False
+        row = (int(start_ns), int(end_ns))
+        try:
+            windows.remove(row)
+        except ValueError:
+            return False
+        if not windows:
+            del self._emitted_windows[(namespace, domain)]
+        return True
 
     def export_state(self) -> dict[str, Any]:
         return {
@@ -624,6 +694,31 @@ class GlobalAggregator:
     def backlog_incidents(self) -> int:
         return len(self._pending) + self.rollup.open_groups()
 
+    def discard_pending_registered(self) -> int:
+        """Drop buffered fleet pages whose window the registry already
+        covers; returns the count dropped.
+
+        Mesh followers never pump — pumping would emit pages from a
+        non-leader — so their ``_pending`` buffer only drains here:
+        once gossip merges the leader's registry rows, every buffered
+        member the leader paged is provably a would-be suppression and
+        can be dropped without building its session.  What survives is
+        exactly the evidence a follower would need if elected.
+        """
+        kept = [
+            fi
+            for fi in self._pending
+            if not self.rollup.window_registered(
+                fi.namespace,
+                fi.domain,
+                fi.window_start_ns,
+                fi.window_end_ns,
+            )
+        ]
+        dropped = len(self._pending) - len(kept)
+        self._pending = kept
+        return dropped
+
     def observe_pressure(self) -> int:
         return self.pressure.observe(self.backlog_incidents())
 
@@ -710,8 +805,866 @@ class GlobalAggregator:
         seq cursors stay per-link, open groups stay per-side).  After
         the merge, a fault the peer already paged suppresses here even
         when this side's replayed envelopes rebuild its session.
+        Inside a mesh this same registry fold runs continuously every
+        gossip round (:meth:`GlobalPeer.gossip_in`); the one-shot form
+        survives as the manual recovery tool.
         """
         rollup_state = peer_state.get("rollup") or {}
         return self.rollup.merge_emitted_windows(
             rollup_state.get("emitted_windows") or []
         )
+
+
+# ---- symmetric peer mesh -----------------------------------------------
+
+
+@dataclass(slots=True)
+class _PeerView:
+    """One peer's last-gossiped state as seen from this peer.
+
+    Everything here folds monotonically (max for clocks and epochs,
+    union for windows, cursor states replaced by strictly-newer ones
+    via the seq dedup), so a view is safe to update from gossip
+    arriving in any order over a lossy mesh.
+    """
+
+    #: Event-clock time fresh gossip was last accepted from (or about,
+    #: via transitive liveness) this peer; -1 = never heard.
+    last_heard_ns: int = -1
+    epoch: int = -1
+    leader: str = ""
+    head_ns: int = 0
+    #: Their per-region cursor states (accepted kept as a set for the
+    #: O(1) replication-fence cover test).
+    cursors: dict[str, dict[str, Any]] = field(default_factory=dict)
+    reach: dict[str, int] = field(default_factory=dict)
+    #: Their emitted-window registry rows as (ns, domain, lo, hi)
+    #: tuples — drives announcement back-off and anti-entropy deltas.
+    windows: set[tuple[str, str, int, int]] = field(default_factory=set)
+    #: Inbound gossip dedup (per-sender seq, gap-tolerant because the
+    #: peer spool replays under the same bounded budget).
+    gossip_cursor: GapTolerantCursor = field(
+        default_factory=GapTolerantCursor
+    )
+    envelopes: int = 0
+    duplicates: int = 0
+
+
+def _cursor_covers(state: dict[str, Any] | None, seq: int) -> bool:
+    """Does an exported cursor state cover ``seq``?"""
+    if state is None:
+        return False
+    if seq <= state.get("watermark", -1):
+        return True
+    accepted = state.get("accepted")
+    return bool(accepted) and seq in accepted
+
+
+class GlobalPeer:
+    """One symmetric global aggregator in an N-peer mesh.
+
+    Wraps a :class:`GlobalAggregator` with the three things a mesh
+    needs that a single root does not:
+
+    * **Anti-entropy gossip.**  Every round each peer sends every
+      other peer its registry rows, per-region cursors, reachability
+      and liveness views, plus a budget-bounded delta of region
+      envelopes the receiver's cursors don't cover
+      (:meth:`gossip_out`); the receiving fold (:meth:`gossip_in`) is
+      a pure lattice merge, so the mesh converges regardless of loss
+      or ordering and ``--merge-peer`` degenerates to one round of it.
+    * **Bully election by stable peer rank, epoch-fenced.**  Rank is
+      the peer's index in the sorted mesh membership; the lowest-rank
+      peer believed live must be the leader (:meth:`election_tick`).
+      Taking leadership bumps the epoch past every epoch this peer has
+      seen; claims propagate by gossip (higher epoch wins, ties break
+      by rank).  Every emitted page is stamped with its epoch, and
+      :meth:`gossip_in` rejects — and counts — page announcements from
+      a lower epoch, so a deposed root returning from an hour-dark
+      partition cannot land a stale page.  Its *windows* still merge
+      unconditionally: authority is fenced, dedup facts are not.
+    * **Replication-fenced region acks.**  A region's spooled envelope
+      may only be acked once some *other* peer's gossiped cursor also
+      covers its seq (:meth:`ackable_seq`) — otherwise a leader that
+      acked and died pre-emission would strand evidence nowhere.
+      Accepted envelopes are retained in a bounded relay spool and
+      ride gossip until every peer covers them.
+
+    Only the leader pumps the rollup; followers buffer members and
+    trim them against the gossiped registry, staying one
+    :meth:`pump` call away from taking over with zero lost evidence.
+    """
+
+    def __init__(
+        self,
+        peer_id: str,
+        peer_ids: Iterable[str],
+        rollup_gap_ns: int = 5_000_000_000,
+        region_stale_after_ns: int = 120_000_000_000,
+        peer_stale_after_ns: int = 180_000_000_000,
+        relay_budget: int = 8,
+        relay_spool_cap: int = 4096,
+        page_budget: int = 32,
+        capacity_incidents: int = 8192,
+        observer: GlobalObserver | None = None,
+        on_page: Callable[[dict[str, Any]], None] | None = None,
+    ):
+        self.peer_id = str(peer_id)
+        self.peer_ids = sorted({str(p) for p in peer_ids} | {self.peer_id})
+        self.rank = self.peer_ids.index(self.peer_id)
+        self.peer_stale_after_ns = int(peer_stale_after_ns)
+        self.relay_budget = max(1, int(relay_budget))
+        self.relay_spool_cap = max(1, int(relay_spool_cap))
+        self.page_budget = max(1, int(page_budget))
+        self._observer = observer or GlobalObserver()
+        self._on_page = on_page
+        self.agg = GlobalAggregator(
+            global_id=self.peer_id,
+            rollup_gap_ns=rollup_gap_ns,
+            region_stale_after_ns=region_stale_after_ns,
+            capacity_incidents=capacity_incidents,
+            observer=self._observer,
+        )
+        self.epoch = 0
+        self.leader_id = self.peer_ids[0]
+        self.elections = 0
+        self.views: dict[str, _PeerView] = {
+            pid: _PeerView() for pid in self.peer_ids if pid != self.peer_id
+        }
+        #: Shared page log: own released emissions plus accepted
+        #: announcements.
+        self.pages: list[dict[str, Any]] = []
+        self._page_ids: set[str] = set()
+        #: Commit-then-page outbox: own pages awaiting replication of
+        #: their window at ≥1 other peer before they count as emitted.
+        self.outbox: list[dict[str, Any]] = []
+        #: Pages dropped at an epoch fence, kept aside because this
+        #: peer may be the only holder of their evidence (its agg
+        #: seq-deduped the envelopes away).  A later leadership take
+        #: re-stamps them at the new epoch unless the registry covers
+        #: their window by then — Raft's "re-replicate prior-term
+        #: entries at your own term", in page form.
+        self.deferred: list[dict[str, Any]] = []
+        self._fresh_released: list[dict[str, Any]] = []
+        #: Accepted region envelopes retained for anti-entropy relay
+        #: (region -> seq -> raw payload), trimmed once every peer's
+        #: cursors cover them, capped by ``relay_spool_cap``.
+        self._relay: dict[str, dict[int, dict[str, Any]]] = {}
+        self._relay_count = 0
+        self._ack_frontier: dict[str, int] = {}
+        self._seq_to: dict[str, int] = {
+            pid: -1 for pid in self.peer_ids if pid != self.peer_id
+        }
+        self.gossip_rounds = 0
+        self.gossip_in_total = 0
+        self.gossip_duplicates = 0
+        self.stale_epoch_rejections = 0
+        self.stale_pages_dropped = 0
+        self.outbox_suppressed = 0
+        self.pages_restamped = 0
+        self.pages_released = 0
+        self.registry_merged = 0
+        self.relayed_in = 0
+        self.relay_dropped = 0
+        self.pending_trimmed = 0
+
+    # ---- identity ------------------------------------------------------
+
+    @property
+    def is_leader(self) -> bool:
+        return self.leader_id == self.peer_id
+
+    def _rank_of(self, pid: str) -> int:
+        try:
+            return self.peer_ids.index(pid)
+        except ValueError:
+            return len(self.peer_ids)
+
+    def _max_epoch_seen(self) -> int:
+        worst = self.epoch
+        for view in self.views.values():
+            if view.epoch > worst:
+                worst = view.epoch
+        return worst
+
+    # ---- region ingest (home-peer hop) ---------------------------------
+
+    def ingest(self, payload: dict[str, Any] | GlobalEnvelope) -> bool:
+        """Accept one region envelope; retain the raw payload for
+        anti-entropy relay while any peer's cursors lack its seq."""
+        raw = payload if isinstance(payload, dict) else None
+        accepted = self.agg.ingest(payload)
+        if raw is not None:
+            region = raw.get("region")
+            try:
+                seq = int(raw["seq"])
+            except (KeyError, TypeError, ValueError):
+                seq = -1
+            if isinstance(region, str) and region and seq >= 0:
+                # Duplicates re-retain too: a dropped relay entry can
+                # only be rebuilt from the region's own replay, which
+                # the cursor has already deduped.
+                self._retain_relay(region, seq, raw)
+        return accepted
+
+    def _retain_relay(
+        self, region: str, seq: int, raw: dict[str, Any]
+    ) -> None:
+        if seq <= self._ack_frontier.get(region, -1):
+            return
+        entries = self._relay.setdefault(region, {})
+        if seq in entries:
+            return
+        entries[seq] = raw
+        self._relay_count += 1
+        while self._relay_count > self.relay_spool_cap:
+            # Cap: evict the globally-oldest seq; the region's spool
+            # still holds it (the fence has not acked it) and replay
+            # re-retains it here.
+            victim_region = min(
+                (r for r, e in self._relay.items() if e),
+                key=lambda r: min(self._relay[r]),
+            )
+            victim_seq = min(self._relay[victim_region])
+            del self._relay[victim_region][victim_seq]
+            self._relay_count -= 1
+            self.relay_dropped += 1
+
+    def _trim_relay(self) -> None:
+        """Drop relay entries every peer's gossiped cursors cover."""
+        if not self.views:
+            return
+        for region in list(self._relay):
+            entries = self._relay[region]
+            for seq in sorted(entries):
+                if all(
+                    _cursor_covers(v.cursors.get(region), seq)
+                    for v in self.views.values()
+                ):
+                    del entries[seq]
+                    self._relay_count -= 1
+                else:
+                    break
+            if not entries:
+                del self._relay[region]
+
+    # ---- replication-fenced region acks --------------------------------
+
+    def ackable_seq(self, region: str) -> int:
+        """Highest region seq safe to ack back to the region.
+
+        Contiguous frontier that advances only while this peer holds
+        the seq AND (in a multi-peer mesh) at least one *other* peer's
+        gossiped cursors cover it — acking sooner would let a leader
+        that dies pre-emission strand the only copy of fault evidence
+        in no one's spool.
+        """
+        frontier = self._ack_frontier.get(region, -1)
+        own = self.agg.regions.get(region)
+        if own is None:
+            return frontier
+        solo = not self.views
+        while True:
+            nxt = frontier + 1
+            if not (
+                nxt <= own.cursor.watermark or nxt in own.cursor.accepted
+            ):
+                break
+            if not solo and not any(
+                _cursor_covers(v.cursors.get(region), nxt)
+                for v in self.views.values()
+            ):
+                break
+            frontier = nxt
+        self._ack_frontier[region] = frontier
+        return frontier
+
+    # ---- election ------------------------------------------------------
+
+    def live_peers(self, now_ns: int) -> list[str]:
+        """Mesh members believed live at ``now_ns`` (self included).
+
+        A never-heard peer counts as heard at 0 — startup grace of one
+        staleness window, so a cold mesh doesn't stampede into
+        elections before the first gossip round lands.
+        """
+        live = [self.peer_id]
+        for pid, view in self.views.items():
+            reachable = (
+                now_ns - max(view.last_heard_ns, 0)
+                <= self.peer_stale_after_ns
+            )
+            self._observer.peer_reachable(pid, 1 if reachable else 0)
+            if reachable:
+                live.append(pid)
+        return sorted(live)
+
+    def election_tick(self, now_ns: int) -> bool:
+        """Bully step: the lowest-rank live peer must lead.
+
+        Returns True when this peer takes leadership (epoch bumped
+        past everything seen, so a deposed root's pages fence out).
+        Followers never adopt a leader here — only a gossiped claim at
+        a higher epoch changes their mind — which keeps the transition
+        explicit and epoch-ordered.
+        """
+        live = self.live_peers(now_ns)
+        expected = min(live, key=self._rank_of)
+        if expected != self.peer_id or self.is_leader:
+            return False
+        self.epoch = self._max_epoch_seen() + 1
+        self.leader_id = self.peer_id
+        self.elections += 1
+        self._observer.peer_election(self.peer_id)
+        self._observer.peer_epoch(self.peer_id, self.epoch)
+        # Re-stamp deferred pages at the authority just won: their
+        # evidence may exist nowhere else (this agg seq-deduped the
+        # envelopes), so unless some peer's row meanwhile covers the
+        # window, the page re-enters the outbox under the new epoch.
+        parked, self.deferred = self.deferred, []
+        for page in parked:
+            if self.agg.rollup.window_registered(
+                page["namespace"],
+                page["domain"],
+                page["window_start_ns"],
+                page["window_end_ns"],
+            ) or self._overlaps_outbox(page):
+                continue
+            restamped = dict(page)
+            restamped["epoch"] = self.epoch
+            self.outbox.append(restamped)
+            self.pages_restamped += 1
+        return True
+
+    # ---- gossip --------------------------------------------------------
+
+    def begin_gossip_round(self) -> None:
+        """Count one anti-entropy round (once per round, not per peer)."""
+        self.gossip_rounds += 1
+        self._observer.peer_gossip_round(self.peer_id)
+
+    def gossip_out(self, to_peer: str, now_ns: int) -> dict[str, Any]:
+        """Build one peer envelope for ``to_peer`` (encoded payload).
+
+        The delta is receiver-relative: relay entries their cursors
+        don't cover (budget oldest + the freshest riding along, same
+        fresh-overtakes-backlog rule as the WAN hop) and own-emitted
+        pages their registry doesn't know.  Because deltas are
+        recomputed from the receiver's last-gossiped state each round,
+        a lost envelope costs one round, never convergence.
+        """
+        if to_peer not in self._seq_to:
+            raise ValueError(f"unknown peer {to_peer!r}")
+        self._seq_to[to_peer] += 1
+        view = self.views[to_peer]
+        relays: list[dict[str, Any]] = []
+        for region in sorted(self._relay):
+            entries = self._relay[region]
+            missing = [
+                seq
+                for seq in sorted(entries)
+                if not _cursor_covers(view.cursors.get(region), seq)
+            ]
+            if not missing:
+                continue
+            picked = missing[: self.relay_budget]
+            if missing[-1] not in picked:
+                picked.append(missing[-1])
+            relays.extend(entries[seq] for seq in picked)
+        announce: list[dict[str, Any]] = []
+        for page in self.pages + self.outbox:
+            covered = False
+            for ns, domain, lo, hi in view.windows:
+                if (
+                    ns == page["namespace"]
+                    and domain == page["domain"]
+                    and page["window_start_ns"]
+                    <= hi + self.agg.rollup.gap_ns
+                    and page["window_end_ns"]
+                    >= lo - self.agg.rollup.gap_ns
+                ):
+                    covered = True
+                    break
+            if not covered:
+                announce.append(page)
+        if len(announce) > self.page_budget:
+            announce = (
+                announce[: self.page_budget - 1] + [announce[-1]]
+            )
+        alive = {self.peer_id: int(now_ns)}
+        for pid, v in self.views.items():
+            if v.last_heard_ns >= 0:
+                alive[pid] = v.last_heard_ns
+        return encode_peer_envelope(
+            peer=self.peer_id,
+            seq=self._seq_to[to_peer],
+            epoch=self.epoch,
+            leader=self.leader_id,
+            head_ns=self.agg.head_ns(),
+            emitted_windows=self.agg.rollup.export_emitted_windows(),
+            cursors={
+                rid: s.cursor.export_state()
+                for rid, s in self.agg.regions.items()
+            },
+            reach={
+                rid: s.head_ns for rid, s in self.agg.regions.items()
+            },
+            alive=alive,
+            envelopes=relays,
+            pages=announce,
+        )
+
+    def gossip_in(
+        self,
+        payload: dict[str, Any] | PeerEnvelope,
+        now_ns: int | None = None,
+    ) -> bool:
+        """Fold one peer envelope in; False when a seq duplicate.
+
+        Order matters only for authority: epoch adoption runs before
+        the page fold so a just-learned higher epoch fences the same
+        envelope's stale announcements.  Registry rows merge
+        unconditionally — dedup facts carry no authority.
+        """
+        env = (
+            payload
+            if isinstance(payload, PeerEnvelope)
+            else decode_peer_envelope(payload)
+        )
+        if env.peer == self.peer_id or env.peer not in self.views:
+            raise PeerWireError(
+                f"peer {env.peer!r} is not mesh member of {self.peer_id!r}"
+            )
+        view = self.views[env.peer]
+        if not view.gossip_cursor.accept(env.seq):
+            view.duplicates += 1
+            self.gossip_duplicates += 1
+            return False
+        if now_ns is None:
+            now_ns = max(self.agg.head_ns(), env.head_ns)
+        view.envelopes += 1
+        self.gossip_in_total += 1
+        view.last_heard_ns = max(view.last_heard_ns, int(now_ns))
+        view.epoch = max(view.epoch, env.epoch)
+        view.leader = env.leader
+        view.head_ns = max(view.head_ns, env.head_ns)
+        view.cursors = {
+            region: {
+                "watermark": state["watermark"],
+                "accepted": set(state.get("accepted") or ()),
+            }
+            for region, state in env.cursors.items()
+        }
+        view.reach = dict(env.reach)
+        view.windows = {
+            (row[0], row[1], row[2], row[3])
+            for row in env.emitted_windows
+        }
+        # Transitive liveness: the sender vouches for when IT heard
+        # each peer, so a one-way partition cannot fake a death as
+        # long as any path exists.
+        for pid, heard_ns in env.alive.items():
+            other = self.views.get(pid)
+            if other is not None and heard_ns > other.last_heard_ns:
+                other.last_heard_ns = heard_ns
+        # Authority: higher epoch always wins; same epoch with a
+        # conflicting claim breaks toward the lower rank (the one the
+        # bully rule would have picked).
+        if env.epoch > self.epoch:
+            self.epoch = env.epoch
+            self.leader_id = env.leader or env.peer
+            self._observer.peer_epoch(self.peer_id, self.epoch)
+        elif (
+            env.epoch == self.epoch
+            and env.leader
+            and env.leader != self.leader_id
+            and self._rank_of(env.leader) < self._rank_of(self.leader_id)
+        ):
+            self.leader_id = env.leader
+        self._fold_registry(env.emitted_windows)
+        for page in env.pages:
+            self._fold_page(page)
+        for raw in env.envelopes:
+            region = raw.get("region")
+            try:
+                seq = int(raw["seq"])
+            except (KeyError, TypeError, ValueError):
+                seq = -1
+            if self.agg.ingest(raw):
+                self.relayed_in += 1
+                if isinstance(region, str) and region and seq >= 0:
+                    self._retain_relay(region, seq, raw)
+        self._trim_relay()
+        self._outbox_check()
+        if not self.is_leader:
+            self.pending_trimmed += self.agg.discard_pending_registered()
+        return True
+
+    def _fold_registry(self, rows: Iterable[Iterable[Any]]) -> int:
+        merged = self.agg.rollup.merge_emitted_windows(rows)
+        self.registry_merged += merged
+        return merged
+
+    def _fold_page(self, page: dict[str, Any]) -> bool:
+        """Accept one page announcement; epoch-fenced.
+
+        A page below this peer's epoch is the one thing the mesh must
+        refuse: it is a deposed root asserting authority it lost.
+        Rejections are counted, never silent — and crucially they do
+        NOT fold the page's window into the registry: an announcement
+        may race an election (pumped at epoch N, delivered after N+1
+        spread), and sealing its window while refusing the page would
+        suppress the new leader's rebuild with no released page behind
+        it — a lost incident.  Acceptance folds window and page
+        together, so a row in any registry always has a held page
+        behind it.  Windows of *released* pages still arrive
+        unconditionally as registry rows in the same envelope.
+        """
+        if str(page.get("peer", "")) == self.peer_id:
+            # Echo of an own page bounced back through the mesh — it
+            # is either parked in the outbox (release decides its
+            # fate) or already released; accepting the echo would mark
+            # the id held and starve the release path.
+            return False
+        try:
+            page_epoch = int(page.get("epoch", -1))
+        except (TypeError, ValueError):
+            page_epoch = -1
+        if page_epoch < self.epoch:
+            self.stale_epoch_rejections += 1
+            self._observer.global_duplicate(DUP_EMITTED_WINDOW)
+            return False
+        incident_id = str(page.get("incident_id", ""))
+        if not incident_id:
+            return False
+        self._fold_registry(
+            [
+                [
+                    page.get("namespace", ""),
+                    page.get("domain", ""),
+                    int(page.get("window_start_ns", 0)),
+                    int(page.get("window_end_ns", 0)),
+                ]
+            ]
+        )
+        if incident_id in self._page_ids:
+            return False
+        self._page_ids.add(incident_id)
+        self.pages.append(dict(page))
+        return True
+
+    # ---- emission (leader only) ----------------------------------------
+
+    def pump(self, flush: bool = False) -> list[dict[str, Any]]:
+        """Close quiet sessions — leader only; commit-then-page.
+
+        Closed sessions are stamped ``(epoch, peer)`` and parked in
+        the outbox, not the shared log: a page only *counts* once at
+        least one other peer gossips its window row back
+        (:meth:`_outbox_check`).  Registration is atomic with release —
+        the window the rollup recorded at close is withdrawn here and
+        only re-enters the registry when the page is released (or when
+        a receiver accepts the announcement), so an unconfirmed page
+        dropped at an epoch fence never leaves behind a row that would
+        suppress the successor's rebuild.  The asymmetry this buys is
+        exact — a leader killed one round after closing a session
+        either got the announcement accepted somewhere (that peer holds
+        the page and its row suppresses every rebuild) or it did not
+        (the unconfirmed page dies unreleased and the successor pages
+        the rebuild as the one true emission) — zero lost, zero
+        duplicate, whichever side of the race the kill lands on.  A
+        follower calling this is a no-op by construction.
+        """
+        if not self.is_leader:
+            return []
+        stamped: list[dict[str, Any]] = []
+        for incident in self.agg.pump(flush=flush):
+            page = incident.to_dict()
+            page["epoch"] = self.epoch
+            page["peer"] = self.peer_id
+            self.agg.rollup.withdraw_window(
+                page["namespace"],
+                page["domain"],
+                page["window_start_ns"],
+                page["window_end_ns"],
+            )
+            # With the row withdrawn, a spool replay rebuilding the
+            # same session slips past the rollup's own suppression —
+            # the outbox takes over as the dedup fence until release.
+            if self._overlaps_outbox(page):
+                self.outbox_suppressed += 1
+                self._observer.global_duplicate(DUP_EMITTED_WINDOW)
+                continue
+            self.outbox.append(page)
+            stamped.append(page)
+        if not self.views:
+            self._outbox_check()  # solo mesh: nothing to wait for
+        return stamped
+
+    def _overlaps_outbox(self, page: dict[str, Any]) -> bool:
+        gap_ns = self.agg.rollup.gap_ns
+        for parked in self.outbox:
+            if (
+                parked["namespace"] == page["namespace"]
+                and parked["domain"] == page["domain"]
+                and page["window_start_ns"]
+                <= parked["window_end_ns"] + gap_ns
+                and page["window_end_ns"]
+                >= parked["window_start_ns"] - gap_ns
+            ):
+                return True
+        return False
+
+    def _window_confirmed(self, page: dict[str, Any]) -> bool:
+        """Has some other peer gossiped back this page's EXACT row?
+
+        Exact-row membership, not overlap: a successor's rebuild of
+        the same session can produce a byte-identical window span, and
+        an overlap test would let a deposed leader mistake the
+        rebuild's row for replication of its own stale page and
+        release a duplicate.  Rows propagate verbatim, so exact match
+        is the true "my announcement landed" signal.
+        """
+        row = (
+            page["namespace"],
+            page["domain"],
+            page["window_start_ns"],
+            page["window_end_ns"],
+        )
+        for view in self.views.values():
+            if row in view.windows:
+                return True
+        return False
+
+    def _outbox_check(self) -> None:
+        """Release confirmed outbox pages; drop superseded ones.
+
+        The drop pass runs per-page *before* the confirmation check: a
+        page whose epoch fell behind the mesh epoch (or whose epoch it
+        matches while the leadership tie resolved to another peer)
+        must never release on the back of the new leader's rows.  The
+        fault it described is not lost: either a receiver accepted the
+        announcement pre-fence (it holds the page and its row) or no
+        row exists anywhere and the new leader pages the rebuild from
+        the replication-fenced spools.
+        """
+        if not self.outbox:
+            return
+        kept: list[dict[str, Any]] = []
+        for page in self.outbox:
+            page_epoch = int(page.get("epoch", -1))
+            if page_epoch < self.epoch or (
+                page_epoch == self.epoch and not self.is_leader
+            ):
+                self.stale_pages_dropped += 1
+                self._observer.global_duplicate(DUP_EMITTED_WINDOW)
+                self.deferred.append(page)
+                continue
+            if self.views and not self._window_confirmed(page):
+                kept.append(page)
+                continue
+            incident_id = str(page.get("incident_id", ""))
+            if incident_id and incident_id not in self._page_ids:
+                self._page_ids.add(incident_id)
+                self.agg.rollup.merge_emitted_windows(
+                    [
+                        [
+                            page["namespace"],
+                            page["domain"],
+                            page["window_start_ns"],
+                            page["window_end_ns"],
+                        ]
+                    ]
+                )
+                self.pages.append(page)
+                self._fresh_released.append(page)
+                self.pages_released += 1
+                if self._on_page is not None:
+                    self._on_page(page)
+        self.outbox = kept
+
+    def reconcile(self) -> None:
+        """Run the quiescent half of a gossip round by hand: trim the
+        relay spool, settle the outbox against the current views, and
+        (as a follower) drop provably-paged pending members.
+
+        :meth:`gossip_in` does all of this per envelope; a batch
+        ``fleetagg --peer`` run calls it once after ingesting its
+        input logs so confirmations already present in the gossip
+        files release the matching outbox pages in the same run.
+        """
+        self._trim_relay()
+        self._outbox_check()
+        if not self.is_leader:
+            self.pending_trimmed += self.agg.discard_pending_registered()
+
+    def take_released(self) -> list[dict[str, Any]]:
+        """Drain pages released since the last call (emission order)."""
+        released, self._fresh_released = self._fresh_released, []
+        return released
+
+    def emitted_pages(self) -> list[dict[str, Any]]:
+        """Pages this peer itself emitted (its slice of the union)."""
+        return [p for p in self.pages if p.get("peer") == self.peer_id]
+
+    # ---- one-shot alias ------------------------------------------------
+
+    def merge_peer(self, peer_state: dict[str, Any]) -> int:
+        """One-shot ``--merge-peer`` alias over the gossip fold.
+
+        Takes either a :meth:`GlobalAggregator.export_state` dict or a
+        :meth:`export_state` dict and runs the same registry fold a
+        gossip round would — the manual handshake is now just one
+        round of anti-entropy without the liveness update.
+        """
+        if "agg" in peer_state:
+            peer_state = peer_state.get("agg") or {}
+        rollup_state = peer_state.get("rollup") or {}
+        merged = self._fold_registry(
+            rollup_state.get("emitted_windows") or []
+        )
+        if not self.is_leader:
+            self.pending_trimmed += self.agg.discard_pending_registered()
+        return merged
+
+    # ---- reporting / persistence ---------------------------------------
+
+    def snapshot(self) -> dict[str, Any]:
+        return {
+            "peer_id": self.peer_id,
+            "rank": self.rank,
+            "epoch": self.epoch,
+            "leader": self.leader_id,
+            "is_leader": self.is_leader,
+            "elections": self.elections,
+            "stale_epoch_rejections": self.stale_epoch_rejections,
+            "stale_pages_dropped": self.stale_pages_dropped,
+            "outbox_suppressed": self.outbox_suppressed,
+            "pages_restamped": self.pages_restamped,
+            "pages_released": self.pages_released,
+            "gossip_rounds": self.gossip_rounds,
+            "gossip_in_total": self.gossip_in_total,
+            "gossip_duplicates": self.gossip_duplicates,
+            "registry_merged": self.registry_merged,
+            "relayed_in": self.relayed_in,
+            "relay_spooled": self._relay_count,
+            "relay_dropped": self.relay_dropped,
+            "pending_trimmed": self.pending_trimmed,
+            "pages": len(self.pages),
+            "pages_emitted": len(self.emitted_pages()),
+            "outbox": len(self.outbox),
+            "deferred": len(self.deferred),
+            "peers": {
+                pid: {
+                    "last_heard_ns": v.last_heard_ns,
+                    "epoch": v.epoch,
+                    "leader": v.leader,
+                    "envelopes": v.envelopes,
+                    "duplicates": v.duplicates,
+                }
+                for pid, v in sorted(self.views.items())
+            },
+            "agg": self.agg.snapshot(),
+        }
+
+    def export_state(self) -> dict[str, Any]:
+        return {
+            "peer_id": self.peer_id,
+            "peer_ids": list(self.peer_ids),
+            "epoch": self.epoch,
+            "leader": self.leader_id,
+            "elections": self.elections,
+            "stale_epoch_rejections": self.stale_epoch_rejections,
+            "stale_pages_dropped": self.stale_pages_dropped,
+            "outbox_suppressed": self.outbox_suppressed,
+            "pages_restamped": self.pages_restamped,
+            "pages_released": self.pages_released,
+            "pages": [dict(p) for p in self.pages],
+            "outbox": [dict(p) for p in self.outbox],
+            "deferred": [dict(p) for p in self.deferred],
+            "seq_to": dict(self._seq_to),
+            "views": {
+                pid: {
+                    "last_heard_ns": v.last_heard_ns,
+                    "epoch": v.epoch,
+                    "leader": v.leader,
+                    "head_ns": v.head_ns,
+                    "cursors": {
+                        region: {
+                            "watermark": s["watermark"],
+                            "accepted": sorted(s["accepted"]),
+                        }
+                        for region, s in v.cursors.items()
+                    },
+                    "windows": [list(w) for w in sorted(v.windows)],
+                    "gossip_cursor": v.gossip_cursor.export_state(),
+                }
+                for pid, v in self.views.items()
+            },
+            "relay": {
+                region: {str(seq): raw for seq, raw in entries.items()}
+                for region, entries in self._relay.items()
+            },
+            "ack_frontier": dict(self._ack_frontier),
+            "agg": self.agg.export_state(),
+        }
+
+    def restore_state(self, state: dict[str, Any]) -> None:
+        self.epoch = int(state.get("epoch", 0))
+        self.leader_id = str(state.get("leader", self.peer_ids[0]))
+        self.elections = int(state.get("elections", 0))
+        self.stale_epoch_rejections = int(
+            state.get("stale_epoch_rejections", 0)
+        )
+        self.stale_pages_dropped = int(
+            state.get("stale_pages_dropped", 0)
+        )
+        self.outbox_suppressed = int(state.get("outbox_suppressed", 0))
+        self.pages_restamped = int(state.get("pages_restamped", 0))
+        self.pages_released = int(state.get("pages_released", 0))
+        self.pages = [dict(p) for p in state.get("pages") or []]
+        self.outbox = [dict(p) for p in state.get("outbox") or []]
+        self.deferred = [dict(p) for p in state.get("deferred") or []]
+        self._page_ids = {
+            str(p.get("incident_id", "")) for p in self.pages
+        }
+        for pid, seq in (state.get("seq_to") or {}).items():
+            if pid in self._seq_to:
+                self._seq_to[pid] = int(seq)
+        for pid, raw in (state.get("views") or {}).items():
+            view = self.views.get(pid)
+            if view is None:
+                continue
+            view.last_heard_ns = int(raw.get("last_heard_ns", -1))
+            view.epoch = int(raw.get("epoch", -1))
+            view.leader = str(raw.get("leader", ""))
+            view.head_ns = int(raw.get("head_ns", 0))
+            view.cursors = {
+                str(region): {
+                    "watermark": int(s.get("watermark", -1)),
+                    "accepted": {
+                        int(x) for x in s.get("accepted") or ()
+                    },
+                }
+                for region, s in (raw.get("cursors") or {}).items()
+            }
+            view.windows = {
+                (str(w[0]), str(w[1]), int(w[2]), int(w[3]))
+                for w in raw.get("windows") or []
+            }
+            if raw.get("gossip_cursor"):
+                view.gossip_cursor.restore_state(raw["gossip_cursor"])
+        self._relay = {}
+        self._relay_count = 0
+        for region, entries in (state.get("relay") or {}).items():
+            bucket = {
+                int(seq): dict(raw) for seq, raw in entries.items()
+            }
+            self._relay[str(region)] = bucket
+            self._relay_count += len(bucket)
+        self._ack_frontier = {
+            str(region): int(seq)
+            for region, seq in (state.get("ack_frontier") or {}).items()
+        }
+        if state.get("agg"):
+            self.agg.restore_state(state["agg"])

@@ -1,0 +1,182 @@
+"""LivePeerNode: one mesh peer on the live (socket) deployment plane.
+
+The live form of :class:`~tpuslo.federation.global_tier.GlobalPeer`:
+one :class:`~tpuslo.livenet.LiveListener` front door accepting BOTH
+frame kinds — region global-envelopes (``global_wire_version``) from
+downstream regions and peer envelopes (``peer_wire_version``) from
+the rest of the mesh — and one spool-backed
+:class:`~tpuslo.livenet.ReconnectingClient` per remote peer carrying
+the gossip out.  Both ride the same length-prefixed framing and ack
+protocol as every other livenet hop; a peer envelope that fails its
+wire contract nacks exactly like a malformed shipment.
+
+Two live-only touches:
+
+* Every ack this node sends carries ``peer_info`` (its election epoch
+  and believed leader), so a deposed root that reconnects after a
+  partition learns it was superseded on its first delivery — one
+  round-trip, before any gossip envelope makes it back.
+* The gossip cadence is the caller's ``tick`` (the fleetagg loop), on
+  the wall-clock-fed event clock ``now_ns`` the caller passes in —
+  the mesh state machine itself stays wall-clock-free.
+
+Gossip clients run with a replay budget: a gossip envelope is a
+snapshot-delta recomputed per round, so replaying a deep spool of
+stale rounds is pure waste — the budget lets fresh rounds overtake
+and the per-sender gap-tolerant gossip cursor absorbs the reorder.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable
+
+from tpuslo.federation.global_tier import GlobalObserver, GlobalPeer
+from tpuslo.livenet.client import ReconnectingClient, parse_socket_url
+from tpuslo.livenet.server import LiveListener, LivenetObserver
+
+#: Spooled gossip rounds replayed per send round on the peer channel.
+GOSSIP_REPLAY_BUDGET = 4
+
+
+class LivePeerNode:
+    """GlobalPeer + livenet wiring: listen, ingest, gossip, elect."""
+
+    def __init__(
+        self,
+        peer_id: str,
+        peer_addrs: dict[str, str],
+        spool_dir: str | os.PathLike,
+        peer_ids: list[str] | None = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        rollup_gap_ns: int = 5_000_000_000,
+        region_stale_after_ns: int = 120_000_000_000,
+        peer_stale_after_ns: int = 180_000_000_000,
+        relay_budget: int = 8,
+        capacity_incidents: int = 8192,
+        client_timeout_s: float = 5.0,
+        observer: GlobalObserver | None = None,
+        livenet_observer: LivenetObserver | None = None,
+        on_page: Callable[[dict[str, Any]], None] | None = None,
+        log: Callable[[str], None] | None = None,
+    ):
+        self._log = log or (lambda msg: None)
+        self._lock = threading.Lock()
+        # Membership may exceed the addressed peers: a member without
+        # an address still ranks in the bully order and is reachable
+        # transitively through whoever does address it.
+        self.peer = GlobalPeer(
+            peer_id,
+            list(peer_addrs) + list(peer_ids or ()) + [peer_id],
+            rollup_gap_ns=rollup_gap_ns,
+            region_stale_after_ns=region_stale_after_ns,
+            peer_stale_after_ns=peer_stale_after_ns,
+            relay_budget=relay_budget,
+            capacity_incidents=capacity_incidents,
+            observer=observer,
+            on_page=on_page,
+        )
+        self.frames_ingested = 0
+        self.gossip_frames = 0
+        self.listener = LiveListener(
+            self._handle,
+            host=host,
+            port=port,
+            name=f"peer-{peer_id}",
+            pressure=lambda: self.peer.agg.pressure.level,
+            observer=livenet_observer,
+            log=self._log,
+            ingest_lock=self._lock,
+            ack_info=lambda: {
+                "peer": self.peer.peer_id,
+                "epoch": self.peer.epoch,
+                "leader": self.peer.leader_id,
+            },
+        )
+        self.clients: dict[str, ReconnectingClient] = {}
+        for pid, url in sorted(peer_addrs.items()):
+            if pid == peer_id:
+                continue
+            addr = parse_socket_url(url)
+            if addr is None:
+                raise ValueError(
+                    f"peer {pid!r} address {url!r} must be "
+                    "tcp://host:port"
+                )
+            self.clients[pid] = ReconnectingClient(
+                addr,
+                os.path.join(os.fspath(spool_dir), f"gossip-{pid}"),
+                peer=pid,
+                timeout_s=client_timeout_s,
+                replay_budget=GOSSIP_REPLAY_BUDGET,
+                observer=livenet_observer,
+                log=self._log,
+            )
+
+    @property
+    def address(self) -> str:
+        return self.listener.address
+
+    # ---- inbound -------------------------------------------------------
+
+    def _handle(self, payload: dict[str, Any]) -> None:
+        """Route one frame by wire kind; contract errors nack."""
+        if "peer_wire_version" in payload:
+            # The listener's ingest lock is already held.
+            self.peer.gossip_in(payload)
+            self.gossip_frames += 1
+        else:
+            if self.peer.ingest(payload):
+                self.frames_ingested += 1
+
+    # ---- the caller's cadence ------------------------------------------
+
+    def tick(
+        self, now_ns: int, flush: bool = False
+    ) -> list[dict[str, Any]]:
+        """One mesh round: elect, pump, gossip out; returns released
+        pages (emission order) so the caller can sink them."""
+        with self._lock:
+            self.peer.election_tick(now_ns)
+            self.peer.pump(flush=flush)
+            self.peer.begin_gossip_round()
+            envelopes = {
+                pid: self.peer.gossip_out(pid, now_ns)
+                for pid in self.clients
+            }
+            released = self.peer.take_released()
+        for pid, envelope in envelopes.items():
+            self.clients[pid].send(envelope)
+        return released
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            snap = self.peer.snapshot()
+        snap["listener_frames"] = self.listener.frames_total
+        snap["frames_rejected"] = self.listener.frames_rejected
+        snap["gossip_frames"] = self.gossip_frames
+        snap["clients"] = {
+            pid: {
+                "sent": client.sent_frames,
+                "spooled": client.pending_spooled(),
+                "reconnects": client.reconnects,
+                "remote_info": dict(client.remote_info),
+            }
+            for pid, client in self.clients.items()
+        }
+        return snap
+
+    def export_state(self) -> dict[str, Any]:
+        with self._lock:
+            return self.peer.export_state()
+
+    def restore_state(self, state: dict[str, Any]) -> None:
+        with self._lock:
+            self.peer.restore_state(state)
+
+    def close(self) -> None:
+        self.listener.close()
+        for client in self.clients.values():
+            client.close()

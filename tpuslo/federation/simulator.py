@@ -42,7 +42,14 @@ import numpy as np
 
 from tpuslo.attribution.mapper import map_fault_label
 from tpuslo.chaos.telemetry import ChaosScenario, ChaosStream
-from tpuslo.chaos.wan import WAN_HEAL, WanEvent, WanLink
+from tpuslo.chaos.wan import (
+    PEER_DARK,
+    PEER_HEAL,
+    WAN_HEAL,
+    PeerWanEvent,
+    WanEvent,
+    WanLink,
+)
 from tpuslo.columnar.gate import ColumnarGate
 from tpuslo.columnar.schema import from_rows
 from tpuslo.federation.cluster import ClusterAggregator
@@ -51,6 +58,7 @@ from tpuslo.federation.global_tier import (
     GlobalAggregator,
     GlobalIncident,
     GlobalObserver,
+    GlobalPeer,
 )
 from tpuslo.federation.region import FederationObserver, RegionAggregator
 from tpuslo.fleet.aggregator import FleetObserver
@@ -1229,3 +1237,440 @@ def measure_global_ingest(
         global_fold_ms=round(fold_ns / 1e6, 3),
         global_incidents=len(agg.incidents),
     )
+
+
+# ---------------------------------------------------------------------------
+# Peer mesh: N symmetric global aggregators gossiping over the WAN.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PeerMeshRunResult:
+    """Outcome of one peer-mesh correctness-lane run."""
+
+    #: The union page log in emission order: (round, page dict).  A
+    #: page dict is a :meth:`GlobalIncident.to_dict` plus the mesh
+    #: stamps (``epoch``, ``peer``).
+    pages: list[tuple[int, dict[str, Any]]]
+    plan: list[GlobalFaultInjection]
+    rounds: int
+    drain_rounds_used: int
+    peer_snapshots: dict[str, dict[str, Any]] = field(
+        default_factory=dict
+    )
+    link_snapshots: dict[str, dict[str, Any]] = field(
+        default_factory=dict
+    )
+    #: Every leadership take: (round, peer, epoch).
+    elections: list[tuple[int, str, int]] = field(default_factory=list)
+    #: Every region re-home: (round, region, old upstream, new one).
+    failovers: list[tuple[int, str, str, str]] = field(
+        default_factory=list
+    )
+    #: Every page in emission order: (round, id, scope, peer, epoch).
+    emits: list[tuple[int, str, str, str, int]] = field(
+        default_factory=list
+    )
+    #: Leader as believed by each peer at the end of the run.
+    final_leaders: dict[str, str] = field(default_factory=dict)
+    final_epochs: dict[str, int] = field(default_factory=dict)
+
+
+class PeerMeshSimulator:
+    """N regions, P symmetric global peers, gossip + elections, one box.
+
+    The :class:`GlobalSimulator` scenario with its single root
+    replaced by a mesh: every region keeps one upstream peer (spool +
+    bounded replay over a :class:`~tpuslo.chaos.wan.WanLink`, exactly
+    the PR 18 hop) and fails over to the believed leader when its
+    upstream stays unreachable; every ordered peer pair has its own
+    directed gossip link so asymmetric mesh partitions are
+    first-class.  Three event schedules drive chaos in lockstep:
+
+    * region WAN events (:class:`WanEvent`) — the region ↔ upstream
+      links, as in the global sweep;
+    * peer events (:class:`PeerWanEvent`) — directed gossip paths
+      between peers (dark/heal, wildcardable);
+    * reach events ``(round, region, peer, "dark"|"heal")`` — which
+      peers a region could even connect to, the piece that puts a
+      region on one *side* of a split-brain.
+
+    Regions ack only up to the replication fence
+    (:meth:`GlobalPeer.ackable_seq`), so killing any peer —
+    leader included — after an ack can never strand the only copy of
+    fault evidence.
+    """
+
+    def __init__(
+        self,
+        peers: int = 3,
+        regions: int = 4,
+        nodes_per_region: int = 96,
+        clusters_per_region: int = 2,
+        shards_per_cluster: int = 2,
+        seed: int = 1337,
+        round_s: float = 60.0,
+        replay_budget: int = 8,
+        wan_latency_rounds: int = 0,
+        gossip_latency_rounds: int = 1,
+        region_stale_after_rounds: int = 3,
+        peer_stale_after_rounds: int = 3,
+        failover_after_rounds: int = 2,
+        chaos_intensity: float = 0.0,
+        observer: GlobalObserver | None = None,
+        federation_observer: FederationObserver | None = None,
+    ):
+        if peers < 2:
+            raise ValueError("a peer mesh needs at least two peers")
+        if regions < 2:
+            raise ValueError("global tier needs at least two regions")
+        self.seed = seed
+        self.round_s = round_s
+        self.round_ns = int(round_s * 1e9)
+        self.peer_ids = [f"global-{i}" for i in range(peers)]
+        self.region_ids = [f"region-{i}" for i in range(regions)]
+        self.topology = FederationTopology.for_nodes(
+            nodes_per_region, clusters=clusters_per_region
+        )
+        self.sims: dict[str, FederationSimulator] = {}
+        for i, rid in enumerate(self.region_ids):
+            self.sims[rid] = FederationSimulator(
+                self.topology,
+                shards_per_cluster=shards_per_cluster,
+                seed=seed + 101 * i,
+                chaos_intensity=chaos_intensity,
+                round_s=round_s,
+                window_ns=2 * self.round_ns,
+                rollup_gap_ns=5 * self.round_ns,
+                stale_after_ns=8 * self.round_ns,
+                observer=federation_observer,
+                region_id=rid,
+            )
+        self.peers: dict[str, GlobalPeer] = {
+            pid: GlobalPeer(
+                pid,
+                self.peer_ids,
+                rollup_gap_ns=5 * self.round_ns,
+                region_stale_after_ns=(
+                    region_stale_after_rounds * self.round_ns
+                ),
+                peer_stale_after_ns=(
+                    peer_stale_after_rounds * self.round_ns
+                ),
+                relay_budget=replay_budget,
+                observer=observer,
+            )
+            for pid in self.peer_ids
+        }
+        self.replay_budget = replay_budget
+        self.wan_latency_rounds = wan_latency_rounds
+        self.failover_after_rounds = max(1, int(failover_after_rounds))
+        #: Region upstream assignment; everyone starts on the rank-0
+        #: leader, exactly the PR 18 single-root wiring.
+        self.upstream: dict[str, str] = {
+            rid: self.peer_ids[0] for rid in self.region_ids
+        }
+        self.links: dict[str, WanLink] = {
+            rid: WanLink(
+                rid,
+                latency_rounds=wan_latency_rounds,
+                replay_budget=replay_budget,
+            )
+            for rid in self.region_ids
+        }
+        self.gossip_links: dict[tuple[str, str], WanLink] = {
+            (src, dst): WanLink(
+                f"{src}->{dst}",
+                latency_rounds=gossip_latency_rounds,
+                replay_budget=replay_budget,
+            )
+            for src in self.peer_ids
+            for dst in self.peer_ids
+            if src != dst
+        }
+        self._region_reach: dict[str, set[str]] = {
+            rid: set(self.peer_ids) for rid in self.region_ids
+        }
+        self._unreachable_rounds: dict[str, int] = {
+            rid: 0 for rid in self.region_ids
+        }
+        self.pages: list[tuple[int, dict[str, Any]]] = []
+        self.emits: list[tuple[int, str, str, str, int]] = []
+        self.elections: list[tuple[int, str, int]] = []
+        self.failovers: list[tuple[int, str, str, str]] = []
+
+    # ---- clocks + routing ----------------------------------------------
+
+    def now_ns(self, round_i: int) -> int:
+        """The mesh's liveness clock (round-anchored event time)."""
+        return (round_i + 1) * self.round_ns
+
+    def _upstream_reachable(self, rid: str) -> bool:
+        return self.upstream[rid] in self._region_reach[rid]
+
+    def _believed_leader(self, rid: str) -> str | None:
+        """Failover target: among peers this region can still reach,
+        prefer a live leadership claim (highest epoch, then rank),
+        else the lowest-rank reachable peer — the same choice the
+        bully rule will converge on."""
+        reachable = [
+            pid
+            for pid in self.peer_ids
+            if pid in self._region_reach[rid]
+        ]
+        if not reachable:
+            return None
+        claims = [
+            pid for pid in reachable if self.peers[pid].is_leader
+        ]
+        if claims:
+            return max(
+                claims,
+                key=lambda pid: (
+                    self.peers[pid].epoch,
+                    -self.peer_ids.index(pid),
+                ),
+            )
+        return reachable[0]
+
+    # ---- region → upstream transfer ------------------------------------
+
+    def _unacked(self, rid: str) -> list[dict[str, Any]]:
+        link = self.links[rid]
+        return [
+            p
+            for p in self.sims[rid].region.resend_global_since(
+                link.ack_watermark
+            )
+            if not link.acked(p["seq"])
+        ]
+
+    def _transfer(self, round_i: int) -> None:
+        for rid in self.region_ids:
+            link = self.links[rid]
+            in_flight = link.in_flight_seqs()
+            candidates = [
+                p
+                for p in self._unacked(rid)
+                if p["seq"] not in in_flight
+            ]
+            link.offer(round_i, link.select_for_send(candidates))
+        for rid in self.region_ids:
+            link = self.links[rid]
+            pid = self.upstream[rid]
+            peer = self.peers[pid]
+            delivered = link.due(round_i)
+            if not self._upstream_reachable(rid):
+                link.dropped_frames += len(delivered)
+                continue
+            for payload in delivered:
+                peer.ingest(payload)
+            # Acks stop at the replication fence: the region's spool
+            # may only trim seqs some OTHER peer also covers, so a
+            # freshly-acked leader dying cannot strand evidence.
+            frontier = peer.ackable_seq(rid)
+            for seq in range(link.ack_watermark + 1, frontier + 1):
+                link.on_ack(seq)
+            self.sims[rid].region.ack_global_up_to(link.ack_watermark)
+
+    def _maybe_failover(self, round_i: int) -> None:
+        for rid in self.region_ids:
+            link = self.links[rid]
+            link_down = not (link.forward_up and link.backward_up)
+            if self._upstream_reachable(rid) and not link_down:
+                self._unreachable_rounds[rid] = 0
+                continue
+            if link_down and not self._region_reach[rid]:
+                # The region's own WAN is dark: nowhere to go.
+                self._unreachable_rounds[rid] = 0
+                continue
+            self._unreachable_rounds[rid] += 1
+            if self._unreachable_rounds[rid] < self.failover_after_rounds:
+                continue
+            target = self._believed_leader(rid)
+            if target is None or target == self.upstream[rid]:
+                continue
+            # Re-home: fresh link, spool replays everything unacked —
+            # the ReconnectingClient resume, one level up.
+            self.failovers.append(
+                (round_i, rid, self.upstream[rid], target)
+            )
+            self.upstream[rid] = target
+            self.links[rid] = WanLink(
+                rid,
+                latency_rounds=self.wan_latency_rounds,
+                replay_budget=self.replay_budget,
+            )
+            self._unreachable_rounds[rid] = 0
+
+    # ---- mesh gossip + election + emission -----------------------------
+
+    def _gossip(self, round_i: int) -> None:
+        now = self.now_ns(round_i)
+        sending: set[str] = set()
+        for (src, dst), link in self.gossip_links.items():
+            if link.forward_up:
+                sending.add(src)
+                link.offer(
+                    round_i, [self.peers[src].gossip_out(dst, now)]
+                )
+        for src in sending:
+            self.peers[src].begin_gossip_round()
+        for (src, dst), link in self.gossip_links.items():
+            for payload in link.due(round_i):
+                self.peers[dst].gossip_in(payload, now)
+
+    def _elect(self, round_i: int) -> None:
+        now = self.now_ns(round_i)
+        for pid in self.peer_ids:
+            if self.peers[pid].election_tick(now):
+                self.elections.append(
+                    (round_i, pid, self.peers[pid].epoch)
+                )
+
+    def _pump(self, flush: bool = False) -> None:
+        for pid in self.peer_ids:
+            self.peers[pid].pump(flush=flush)
+
+    def _collect(self, round_i: int) -> None:
+        """Log pages whose replication confirmed this round."""
+        for pid in self.peer_ids:
+            for page in self.peers[pid].take_released():
+                scope = GlobalIncident.from_dict(page).scope
+                self.pages.append((round_i, page))
+                self.emits.append(
+                    (
+                        round_i,
+                        page["incident_id"],
+                        scope,
+                        pid,
+                        page["epoch"],
+                    )
+                )
+
+    # ---- correctness lane ----------------------------------------------
+
+    def run(
+        self,
+        rounds: int,
+        plan: list[GlobalFaultInjection],
+        region_events: list[WanEvent] | None = None,
+        peer_events: list[PeerWanEvent] | None = None,
+        reach_events: (
+            list[tuple[int, str, str, str]] | None
+        ) = None,
+        drain_rounds: int = 48,
+        settle_rounds: int | None = None,
+    ) -> PeerMeshRunResult:
+        """Drive regions + WAN + mesh gossip + elections in lockstep."""
+        per_region: dict[str, list[FaultInjection]] = {
+            rid: [] for rid in self.region_ids
+        }
+        for injection in plan:
+            for rid in injection.regions:
+                if rid not in per_region:
+                    raise ValueError(f"unknown region {rid!r}")
+                per_region[rid].append(injection.regional(rid))
+        region_by_round: dict[int, list[WanEvent]] = {}
+        for event in region_events or []:
+            region_by_round.setdefault(event.round_i, []).append(event)
+        peer_by_round: dict[int, list[PeerWanEvent]] = {}
+        for pevent in peer_events or []:
+            peer_by_round.setdefault(pevent.round_i, []).append(pevent)
+        reach_by_round: dict[int, list[tuple[str, str, str]]] = {}
+        for r_round, rid, pid, action in reach_events or []:
+            reach_by_round.setdefault(r_round, []).append(
+                (rid, pid, action)
+            )
+
+        def apply_events(round_i: int) -> None:
+            for event in region_by_round.get(round_i, ()):
+                self.links[event.region].apply(event)
+            for pevent in peer_by_round.get(round_i, ()):
+                for (src, dst), link in self.gossip_links.items():
+                    if not pevent.matches(src, dst):
+                        continue
+                    if pevent.action == PEER_DARK:
+                        link.forward_up = False
+                        link._in_flight = []
+                    elif pevent.action == PEER_HEAL:
+                        link.forward_up = True
+                    else:
+                        raise ValueError(
+                            f"unknown peer action {pevent.action!r}"
+                        )
+            for rid, pid, action in reach_by_round.get(round_i, ()):
+                if action == "dark":
+                    self._region_reach[rid].discard(pid)
+                elif action == "heal":
+                    self._region_reach[rid].add(pid)
+                else:
+                    raise ValueError(f"unknown reach action {action!r}")
+
+        def tick(round_i: int, flush: bool = False) -> None:
+            self._transfer(round_i)
+            self._maybe_failover(round_i)
+            # Pump BEFORE gossip so a page closed this round rides
+            # this round's announcements toward its confirmation.
+            self._pump(flush=flush)
+            self._gossip(round_i)
+            self._elect(round_i)
+            self._collect(round_i)
+
+        for round_i in range(rounds):
+            apply_events(round_i)
+            for rid, sim in self.sims.items():
+                sim.step(round_i, per_region[rid])
+                sim.region.ship_global()
+            tick(round_i)
+        for sim in self.sims.values():
+            sim.finish()
+            sim.region.ship_global()
+        used = 0
+        for extra in range(max(1, drain_rounds)):
+            round_i = rounds + extra
+            used = extra + 1
+            apply_events(round_i)
+            tick(round_i)
+            if all(
+                not self._unacked(rid)
+                and not self.links[rid].in_flight_seqs()
+                for rid in self.region_ids
+            ):
+                break
+        # Post-drain settle: flush the leaders' rollups, then keep
+        # gossiping so outbox confirmations, registries, epochs and
+        # liveness all converge before the books close.
+        if settle_rounds is None:
+            settle_rounds = 6 + 2 * max(
+                link.latency_rounds
+                for link in self.gossip_links.values()
+            )
+        for extra in range(settle_rounds):
+            round_i = rounds + used + extra
+            apply_events(round_i)
+            tick(round_i, flush=(extra == 0))
+        return PeerMeshRunResult(
+            pages=list(self.pages),
+            plan=list(plan),
+            rounds=rounds,
+            drain_rounds_used=used,
+            peer_snapshots={
+                pid: peer.snapshot()
+                for pid, peer in self.peers.items()
+            },
+            link_snapshots={
+                rid: link.snapshot()
+                for rid, link in self.links.items()
+            },
+            elections=list(self.elections),
+            failovers=list(self.failovers),
+            emits=list(self.emits),
+            final_leaders={
+                pid: peer.leader_id
+                for pid, peer in self.peers.items()
+            },
+            final_epochs={
+                pid: peer.epoch for pid, peer in self.peers.items()
+            },
+        )

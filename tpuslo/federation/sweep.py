@@ -64,6 +64,9 @@ from tpuslo.chaos.wan import (
     WAN_DARK,
     WAN_HEAL,
     WanEvent,
+    peer_dark_events,
+    root_dark_events,
+    split_mesh_events,
 )
 from tpuslo.federation.backpressure import LEVEL_SAMPLE
 from tpuslo.federation.global_tier import (
@@ -75,6 +78,7 @@ from tpuslo.federation.simulator import (
     FederationTopology,
     GlobalFaultInjection,
     GlobalSimulator,
+    PeerMeshSimulator,
     build_churn_plan,
     federation_injection_plan,
     global_injection_plan,
@@ -956,3 +960,674 @@ def _run_splitbrain(
         "re_pages": re_pages,
         "failures": failures,
     }
+
+
+# ---------------------------------------------------------------------------
+# Peer-mesh sweep: election + gossip correctness under WAN chaos
+# ---------------------------------------------------------------------------
+
+
+def _cluster_union_pages(
+    pages: list[tuple[int, dict[str, Any]]], gap_ns: int
+) -> list[dict[str, Any]]:
+    """Cluster the union page log by (namespace, domain, window).
+
+    Two pages land in one cluster when they describe the same fault:
+    same namespace and domain, windows overlapping within ``gap_ns`` —
+    the mesh dedup rule itself, applied post-hoc as the audit.  A
+    correct run has exactly one distinct incident id per cluster:
+    a second id is a duplicate page across the handover, a missing
+    cluster (vs the baseline) is a lost one.
+    """
+    clusters: list[dict[str, Any]] = []
+    for _, page in pages:
+        key = (page["namespace"], page["domain"])
+        lo = int(page["window_start_ns"])
+        hi = int(page["window_end_ns"])
+        placed = False
+        for cluster in clusters:
+            if (
+                cluster["key"] == key
+                and lo <= cluster["hi"] + gap_ns
+                and hi >= cluster["lo"] - gap_ns
+            ):
+                cluster["lo"] = min(cluster["lo"], lo)
+                cluster["hi"] = max(cluster["hi"], hi)
+                cluster["ids"].add(page["incident_id"])
+                placed = True
+                break
+        if not placed:
+            clusters.append(
+                {"key": key, "lo": lo, "hi": hi,
+                 "ids": {page["incident_id"]}}
+            )
+    return clusters
+
+
+def _audit_union(
+    label: str,
+    baseline_clusters: list[dict[str, Any]],
+    chaos_clusters: list[dict[str, Any]],
+    failures: list[str],
+) -> dict[str, Any]:
+    """Zero-lost / zero-duplicate verdict for one chaos lane."""
+    base_keys = sorted(
+        "/".join(c["key"]) for c in baseline_clusters
+    )
+    chaos_keys = sorted("/".join(c["key"]) for c in chaos_clusters)
+    lost = sorted(set(base_keys) - set(chaos_keys))
+    duplicated = sorted(
+        "/".join(c["key"])
+        for c in chaos_clusters
+        if len(c["ids"]) > 1
+    )
+    if lost:
+        failures.append(
+            f"{label}: lost pages (baseline fault clusters never "
+            f"paged): {', '.join(lost)}"
+        )
+    if duplicated:
+        failures.append(
+            f"{label}: duplicate pages (two incident ids for one "
+            f"fault cluster): {', '.join(duplicated)}"
+        )
+    split = sorted(
+        k for k in set(chaos_keys)
+        if chaos_keys.count(k) > base_keys.count(k)
+    )
+    if split:
+        failures.append(
+            f"{label}: split fault clusters (same fault paged as "
+            f"disjoint windows): {', '.join(split)}"
+        )
+    return {
+        "baseline_clusters": len(baseline_clusters),
+        "chaos_clusters": len(chaos_clusters),
+        "lost": lost,
+        "duplicated": duplicated,
+        "split": split,
+    }
+
+
+@dataclass
+class PeerSweepReport:
+    """Gate verdict for one peer-mesh WAN-chaos sweep."""
+
+    peers: int
+    regions: int
+    nodes_per_region: int
+    seed: int
+    round_s: float
+    gossip_latency_rounds: int
+    root_dark_rounds: int
+    deposed_dark_rounds: int
+    min_ingest_events_per_sec: float
+    ingest: dict[str, Any] = field(default_factory=dict)
+    handover: dict[str, Any] = field(default_factory=dict)
+    splitbrain: dict[str, Any] = field(default_factory=dict)
+    deposed: dict[str, Any] = field(default_factory=dict)
+    failures: list[str] = field(default_factory=list)
+
+    @property
+    def passed(self) -> bool:
+        return not self.failures
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "peers": self.peers,
+            "regions": self.regions,
+            "nodes_per_region": self.nodes_per_region,
+            "seed": self.seed,
+            "round_s": self.round_s,
+            "gossip_latency_rounds": self.gossip_latency_rounds,
+            "root_dark_rounds": self.root_dark_rounds,
+            "deposed_dark_rounds": self.deposed_dark_rounds,
+            "min_ingest_events_per_sec": (
+                self.min_ingest_events_per_sec
+            ),
+            "ingest": dict(self.ingest),
+            "handover": dict(self.handover),
+            "splitbrain": dict(self.splitbrain),
+            "deposed": dict(self.deposed),
+            "passed": self.passed,
+            "failures": list(self.failures),
+        }
+
+
+def run_peer_sweep(
+    peers: int = 3,
+    regions: int = 4,
+    nodes_per_region: int = 96,
+    clusters_per_region: int = 2,
+    shards_per_cluster: int = 2,
+    seed: int = 1337,
+    round_s: float = 60.0,
+    replay_budget: int = 8,
+    gossip_latency_rounds: int = 1,
+    kill_round: int = 10,
+    root_dark_rounds: int = 12,
+    deposed_dark_rounds: int = 60,
+    ingest_regions: int = 10,
+    ingest_nodes_per_region: int = 10_000,
+    events_per_node: int = 600,
+    min_ingest_events_per_sec: float = 5_000_000.0,
+    measure_ingest_lane: bool = True,
+    observer=None,
+    log: Callable[[str], None] | None = None,
+) -> PeerSweepReport:
+    """Run the three peer-mesh contracts; deterministic per seed.
+
+    1. **Leader-kill handover** — the leader's region goes WAN-dark
+       and the leader drops off the mesh mid-sweep; a new root must be
+       elected within a bounded number of gossip rounds and the union
+       page log must equal the no-chaos baseline exactly: zero lost,
+       zero duplicate, including a fault injected WHILE the old root
+       is dark.
+    2. **Split-brain, both sides elect** — the rank-0 leader vanishes
+       and the remaining mesh splits into two halves that each elect a
+       root and keep paging their own regions' faults; the heal is
+       gossip-only (no ``--merge-peer``), must converge on a single
+       leader, and every session replayed across the healed split must
+       be suppressed by window overlap.
+    3. **Deposed root returns from an hour dark** — the old root and
+       its region sit in their own partition for an hour of simulated
+       time while the survivors elect; on heal the deposed root's
+       unconfirmed pages are fenced (dropped + counted, rejections
+       counted on the survivors), and every fault still pages exactly
+       once mesh-wide.
+    """
+    if peers < 3:
+        raise ValueError("the peer sweep needs at least three peers")
+    report = PeerSweepReport(
+        peers=peers,
+        regions=regions,
+        nodes_per_region=nodes_per_region,
+        seed=seed,
+        round_s=round_s,
+        gossip_latency_rounds=gossip_latency_rounds,
+        root_dark_rounds=root_dark_rounds,
+        deposed_dark_rounds=deposed_dark_rounds,
+        min_ingest_events_per_sec=min_ingest_events_per_sec,
+    )
+
+    def _mesh(mesh_peers: int) -> PeerMeshSimulator:
+        return PeerMeshSimulator(
+            peers=mesh_peers,
+            regions=regions,
+            nodes_per_region=nodes_per_region,
+            clusters_per_region=clusters_per_region,
+            shards_per_cluster=shards_per_cluster,
+            seed=seed,
+            round_s=round_s,
+            replay_budget=replay_budget,
+            gossip_latency_rounds=gossip_latency_rounds,
+            observer=observer,
+        )
+
+    gap_ns = int(5 * round_s * 1e9)
+    # The election bound: failover detection + liveness staleness +
+    # one gossip round-trip, plus one round of slack.
+    election_bound = 3 + 2 + 2 * gossip_latency_rounds + 1
+
+    # ---- lane 0: 100k-node aggregate ingest ---------------------------
+    if measure_ingest_lane:
+        measurement = measure_global_ingest(
+            regions=ingest_regions,
+            nodes_per_region=ingest_nodes_per_region,
+            events_per_node=events_per_node,
+            seed=seed,
+        )
+        report.ingest = {
+            "nodes": measurement.nodes,
+            "regions": measurement.regions,
+            "total_events": measurement.total_events,
+            "events_per_sec": round(measurement.events_per_sec),
+            "global_fold_ms": measurement.global_fold_ms,
+        }
+        if log:
+            log(
+                f"ingest: {measurement.events_per_sec / 1e6:.2f}M "
+                f"events/s aggregate over {measurement.nodes} nodes "
+                f"feeding the mesh"
+            )
+        if measurement.events_per_sec < min_ingest_events_per_sec:
+            report.failures.append(
+                f"aggregate ingest {measurement.events_per_sec:,.0f} "
+                f"events/s below the "
+                f"{min_ingest_events_per_sec:,.0f} floor at "
+                f"{measurement.nodes} nodes"
+            )
+
+    # ---- lane 1: leader-kill handover ---------------------------------
+    heal_round = kill_round + root_dark_rounds
+    rounds = heal_round + 12
+    baseline_mesh = _mesh(peers)
+    plan = global_injection_plan(
+        baseline_mesh.topology,
+        baseline_mesh.region_ids,
+        dark_region=baseline_mesh.region_ids[0],
+        dark_round=kill_round,
+    )
+    baseline = baseline_mesh.run(rounds, plan)
+    baseline_clusters = _cluster_union_pages(baseline.pages, gap_ns)
+
+    chaos_mesh = _mesh(peers)
+    old_root = chaos_mesh.peer_ids[0]
+    region_events, peer_events = root_dark_events(
+        kill_round,
+        old_root,
+        chaos_mesh.region_ids[0],
+        heal_round=heal_round,
+    )
+    reach_events = [
+        (kill_round, rid, old_root, "dark")
+        for rid in chaos_mesh.region_ids
+    ] + [
+        (heal_round, rid, old_root, "heal")
+        for rid in chaos_mesh.region_ids
+    ]
+    handover = chaos_mesh.run(
+        rounds,
+        plan,
+        region_events=region_events,
+        peer_events=peer_events,
+        reach_events=reach_events,
+    )
+    chaos_clusters = _cluster_union_pages(handover.pages, gap_ns)
+    takes = [
+        (round_i, pid, epoch)
+        for round_i, pid, epoch in handover.elections
+        if pid != old_root
+    ]
+    first_take = takes[0][0] if takes else -1
+    pages_during_dark = [
+        incident_id
+        for round_i, incident_id, _, pid, _ in handover.emits
+        if kill_round <= round_i < heal_round and pid != old_root
+    ]
+    report.handover = _audit_union(
+        "handover", baseline_clusters, chaos_clusters, report.failures
+    )
+    report.handover.update(
+        {
+            "kill_round": kill_round,
+            "heal_round": heal_round,
+            "election_bound_rounds": election_bound,
+            "elections": list(handover.elections),
+            "first_successor_round": first_take,
+            "failovers": len(handover.failovers),
+            "pages_during_dark": len(pages_during_dark),
+            "final_leaders": dict(handover.final_leaders),
+            "final_epochs": dict(handover.final_epochs),
+        }
+    )
+    if log:
+        log(
+            f"handover: root dark at {kill_round}, successor elected "
+            f"at round {first_take} (bound "
+            f"{kill_round + election_bound}), "
+            f"{len(pages_during_dark)} pages while dark, "
+            f"{len(chaos_clusters)} fault clusters "
+            f"(baseline {len(baseline_clusters)})"
+        )
+    if not takes:
+        report.failures.append(
+            "handover: no successor election after the leader's "
+            "region went dark"
+        )
+    elif first_take > kill_round + election_bound:
+        report.failures.append(
+            f"handover: successor elected at round {first_take}, "
+            f"past the bounded-gossip-round limit "
+            f"{kill_round + election_bound}"
+        )
+    if not pages_during_dark:
+        report.failures.append(
+            "handover: no pages emitted while the old root was dark "
+            "— the mesh wedged instead of failing over"
+        )
+    if len(set(handover.final_leaders.values())) != 1:
+        report.failures.append(
+            f"handover: mesh did not converge on one leader "
+            f"({handover.final_leaders})"
+        )
+    if len(set(handover.final_epochs.values())) != 1:
+        report.failures.append(
+            f"handover: mesh did not converge on one epoch "
+            f"({handover.final_epochs})"
+        )
+
+    # ---- lane 2: split-brain, both sides elect ------------------------
+    report.splitbrain = _run_peer_splitbrain(
+        regions=regions,
+        nodes_per_region=nodes_per_region,
+        clusters_per_region=clusters_per_region,
+        shards_per_cluster=shards_per_cluster,
+        seed=seed,
+        round_s=round_s,
+        replay_budget=replay_budget,
+        gossip_latency_rounds=gossip_latency_rounds,
+        gap_ns=gap_ns,
+        observer=observer,
+        log=log,
+    )
+    for failure in report.splitbrain.pop("failures"):
+        report.failures.append(failure)
+
+    # ---- lane 3: deposed root returns from an hour dark ---------------
+    report.deposed = _run_deposed_root(
+        peers=peers,
+        regions=regions,
+        nodes_per_region=nodes_per_region,
+        clusters_per_region=clusters_per_region,
+        shards_per_cluster=shards_per_cluster,
+        seed=seed,
+        round_s=round_s,
+        replay_budget=replay_budget,
+        gossip_latency_rounds=gossip_latency_rounds,
+        kill_round=kill_round,
+        dark_rounds=deposed_dark_rounds,
+        gap_ns=gap_ns,
+        observer=observer,
+        log=log,
+    )
+    for failure in report.deposed.pop("failures"):
+        report.failures.append(failure)
+    return report
+
+
+def _run_peer_splitbrain(
+    regions: int,
+    nodes_per_region: int,
+    clusters_per_region: int,
+    shards_per_cluster: int,
+    seed: int,
+    round_s: float,
+    replay_budget: int,
+    gossip_latency_rounds: int,
+    gap_ns: int,
+    observer=None,
+    log: Callable[[str], None] | None = None,
+) -> dict[str, Any]:
+    """Five peers; rank 0 vanishes and the rest split 2 | 2.
+
+    Both halves are big enough to confirm commits internally, so BOTH
+    elect — at the SAME epoch (each side saw only epoch 0), which is
+    exactly the conflict the rank tiebreak and the equal-epoch outbox
+    fence exist for.  Regions 0/1 ride side A, regions 2/3 side B;
+    the injection plan lands faults on both sides while the split is
+    open.  The heal is gossip-only: convergence to one leader, every
+    cross-side replayed session suppressed by window overlap, zero
+    lost, zero duplicate.
+    """
+    sb_peers = 5
+    split_round, split_rounds = 8, 14
+    heal_round = split_round + split_rounds
+    rounds = heal_round + 10
+
+    def _mesh() -> PeerMeshSimulator:
+        return PeerMeshSimulator(
+            peers=sb_peers,
+            regions=regions,
+            nodes_per_region=nodes_per_region,
+            clusters_per_region=clusters_per_region,
+            shards_per_cluster=shards_per_cluster,
+            seed=seed,
+            round_s=round_s,
+            replay_budget=replay_budget,
+            gossip_latency_rounds=gossip_latency_rounds,
+            observer=observer,
+        )
+
+    baseline_mesh = _mesh()
+    plan = global_injection_plan(
+        baseline_mesh.topology,
+        baseline_mesh.region_ids,
+        start_round=split_round + 2,
+    )
+    baseline = baseline_mesh.run(rounds, plan)
+    baseline_clusters = _cluster_union_pages(baseline.pages, gap_ns)
+
+    mesh = _mesh()
+    dead_root = mesh.peer_ids[0]
+    side_a = mesh.peer_ids[1:3]
+    side_b = mesh.peer_ids[3:5]
+    peer_events = peer_dark_events(
+        split_round, dead_root, heal_round=heal_round
+    ) + split_mesh_events(
+        split_round, side_a, side_b, heal_round=heal_round
+    )
+    a_regions = mesh.region_ids[: regions // 2]
+    b_regions = mesh.region_ids[regions // 2 :]
+    reach_events: list[tuple[int, str, str, str]] = []
+    for rid in mesh.region_ids:
+        reach_events.append((split_round, rid, dead_root, "dark"))
+        reach_events.append((heal_round, rid, dead_root, "heal"))
+    for rid in a_regions:
+        for pid in side_b:
+            reach_events.append((split_round, rid, pid, "dark"))
+            reach_events.append((heal_round, rid, pid, "heal"))
+    for rid in b_regions:
+        for pid in side_a:
+            reach_events.append((split_round, rid, pid, "dark"))
+            reach_events.append((heal_round, rid, pid, "heal"))
+    run = mesh.run(
+        rounds,
+        plan,
+        peer_events=peer_events,
+        reach_events=reach_events,
+    )
+    clusters = _cluster_union_pages(run.pages, gap_ns)
+    failures: list[str] = []
+    audit = _audit_union(
+        "split-brain", baseline_clusters, clusters, failures
+    )
+    split_takes = [
+        (round_i, pid, epoch)
+        for round_i, pid, epoch in run.elections
+        if split_round <= round_i < heal_round
+    ]
+    sides_elected = {
+        "a": any(pid in side_a for _, pid, _ in split_takes),
+        "b": any(pid in side_b for _, pid, _ in split_takes),
+    }
+    suppressed = sum(
+        snap["agg"]["duplicates_suppressed"] + snap["pending_trimmed"]
+        for snap in run.peer_snapshots.values()
+    )
+    audit.update(
+        {
+            "split_round": split_round,
+            "heal_round": heal_round,
+            "elections": list(run.elections),
+            "sides_elected": dict(sides_elected),
+            "replays_suppressed": suppressed,
+            "final_leaders": dict(run.final_leaders),
+            "final_epochs": dict(run.final_epochs),
+            "failures": failures,
+        }
+    )
+    if log:
+        log(
+            f"split-brain: sides elected "
+            f"a={sides_elected['a']} b={sides_elected['b']}, "
+            f"{suppressed} replayed sessions suppressed, converged "
+            f"on {sorted(set(run.final_leaders.values()))} at epochs "
+            f"{sorted(set(run.final_epochs.values()))}"
+        )
+    if not (sides_elected["a"] and sides_elected["b"]):
+        failures.append(
+            f"split-brain: both sides must elect during the split "
+            f"(a={sides_elected['a']}, b={sides_elected['b']})"
+        )
+    if len(set(run.final_leaders.values())) != 1:
+        failures.append(
+            f"split-brain: gossip-only heal did not converge on one "
+            f"leader ({run.final_leaders})"
+        )
+    if len(set(run.final_epochs.values())) != 1:
+        failures.append(
+            f"split-brain: epochs did not converge "
+            f"({run.final_epochs})"
+        )
+    if suppressed < 1:
+        failures.append(
+            "split-brain: no replayed session was suppressed across "
+            "the heal — the window-overlap rule went unexercised"
+        )
+    return audit
+
+
+def _run_deposed_root(
+    peers: int,
+    regions: int,
+    nodes_per_region: int,
+    clusters_per_region: int,
+    shards_per_cluster: int,
+    seed: int,
+    round_s: float,
+    replay_budget: int,
+    gossip_latency_rounds: int,
+    kill_round: int,
+    dark_rounds: int,
+    gap_ns: int,
+    observer=None,
+    log: Callable[[str], None] | None = None,
+) -> dict[str, Any]:
+    """The old root and its region alone in the dark for an hour.
+
+    The deposed root keeps leading its one-region side at epoch 0 and
+    parks every page it closes — a minority side can never confirm, so
+    nothing releases.  On heal it must emit nothing at the stale
+    epoch: its parked pages are fenced (dropped and counted, never
+    delivered late), the survivors count the announcement rejections,
+    and each such fault still pages exactly once mesh-wide — either
+    the survivors' rebuild or, when the deposed root's aggregator
+    holds the only copy of the evidence, a re-stamp under the epoch it
+    legitimately wins back.
+    """
+    heal_round = kill_round + dark_rounds
+    rounds = heal_round + 16
+
+    def _mesh() -> PeerMeshSimulator:
+        return PeerMeshSimulator(
+            peers=peers,
+            regions=regions,
+            nodes_per_region=nodes_per_region,
+            clusters_per_region=clusters_per_region,
+            shards_per_cluster=shards_per_cluster,
+            seed=seed,
+            round_s=round_s,
+            replay_budget=replay_budget,
+            gossip_latency_rounds=gossip_latency_rounds,
+            observer=observer,
+        )
+
+    baseline_mesh = _mesh()
+    dark_region = baseline_mesh.region_ids[0]
+    plan = global_injection_plan(
+        baseline_mesh.topology,
+        baseline_mesh.region_ids,
+        dark_region=dark_region,
+        dark_round=kill_round,
+    )
+    baseline = baseline_mesh.run(rounds, plan)
+    baseline_clusters = _cluster_union_pages(baseline.pages, gap_ns)
+
+    mesh = _mesh()
+    old_root = mesh.peer_ids[0]
+    survivors = mesh.peer_ids[1:]
+    peer_events = peer_dark_events(
+        kill_round, old_root, heal_round=heal_round
+    )
+    reach_events: list[tuple[int, str, str, str]] = []
+    # The dark region stays homed on the old root — they share the
+    # partition — while every other region loses it.
+    for pid in survivors:
+        reach_events.append((kill_round, dark_region, pid, "dark"))
+        reach_events.append((heal_round, dark_region, pid, "heal"))
+    for rid in mesh.region_ids[1:]:
+        reach_events.append((kill_round, rid, old_root, "dark"))
+        reach_events.append((heal_round, rid, old_root, "heal"))
+    run = mesh.run(
+        rounds,
+        plan,
+        peer_events=peer_events,
+        reach_events=reach_events,
+    )
+    clusters = _cluster_union_pages(run.pages, gap_ns)
+    failures: list[str] = []
+    audit = _audit_union(
+        "deposed-root", baseline_clusters, clusters, failures
+    )
+    root_snap = run.peer_snapshots[old_root]
+    stale_dropped = root_snap["stale_pages_dropped"]
+    restamped = root_snap["pages_restamped"]
+    rejections = sum(
+        run.peer_snapshots[pid]["stale_epoch_rejections"]
+        for pid in survivors
+    )
+    stale_emits = [
+        (round_i, incident_id, epoch)
+        for round_i, incident_id, _, pid, epoch in run.emits
+        if pid == old_root and round_i >= kill_round and epoch == 0
+    ]
+    survivor_takes = [
+        (round_i, pid, epoch)
+        for round_i, pid, epoch in run.elections
+        if pid != old_root
+    ]
+    audit.update(
+        {
+            "kill_round": kill_round,
+            "heal_round": heal_round,
+            "dark_rounds": dark_rounds,
+            "elections": list(run.elections),
+            "stale_pages_dropped": stale_dropped,
+            "pages_restamped": restamped,
+            "stale_epoch_rejections": rejections,
+            "stale_emits": stale_emits,
+            "final_leaders": dict(run.final_leaders),
+            "final_epochs": dict(run.final_epochs),
+            "failures": failures,
+        }
+    )
+    if log:
+        log(
+            f"deposed-root: {dark_rounds} rounds dark "
+            f"({dark_rounds * round_s:.0f}s), {stale_dropped} stale "
+            f"pages fenced at heal ({restamped} re-stamped under the "
+            f"won-back epoch), {rejections} announcement rejections "
+            f"counted on the survivors"
+        )
+    if not survivor_takes:
+        failures.append(
+            "deposed-root: survivors never elected while the root "
+            "was dark"
+        )
+    if stale_emits:
+        failures.append(
+            f"deposed-root: the returning root released "
+            f"{len(stale_emits)} page(s) at its stale epoch: "
+            f"{stale_emits}"
+        )
+    if stale_dropped < 1:
+        failures.append(
+            "deposed-root: no stale page was fenced at heal — the "
+            "dark side either emitted nothing or released "
+            "unconfirmed pages"
+        )
+    if rejections < 1:
+        failures.append(
+            "deposed-root: survivors counted no stale-epoch "
+            "rejections — the fence fired silently or not at all"
+        )
+    if len(set(run.final_leaders.values())) != 1 or len(
+        set(run.final_epochs.values())
+    ) != 1:
+        failures.append(
+            f"deposed-root: mesh did not re-converge "
+            f"(leaders {run.final_leaders}, epochs "
+            f"{run.final_epochs})"
+        )
+    return audit

@@ -39,6 +39,9 @@ REGION_WIRE_VERSION = 1
 #: Global wire schema version (region → global hop).
 GLOBAL_WIRE_VERSION = 1
 
+#: Peer wire schema version (global aggregator ↔ global aggregator).
+PEER_WIRE_VERSION = 1
+
 
 class RegionWireError(WireContractError):
     """An envelope that violates the region wire contract."""
@@ -314,4 +317,215 @@ def load_global_envelopes(path: str) -> list[GlobalEnvelope]:
             line = line.strip()
             if line:
                 out.append(parse_global_envelope_line(line))
+    return out
+
+
+# ---- peer hop (global aggregator ↔ global aggregator gossip) -----------
+
+
+class PeerWireError(WireContractError):
+    """An envelope that violates the peer wire contract."""
+
+
+@dataclass(slots=True)
+class PeerEnvelope:
+    """One decoded peer → peer anti-entropy gossip round.
+
+    Peers are symmetric: every global aggregator in the mesh sends one
+    of these to every other peer each gossip round, and the fold is a
+    pure lattice merge — registries union, cursors and liveness fold
+    with max — so the mesh converges regardless of delivery order or
+    loss.  The ``seq`` is per (sender, receiver) monotonic and dedups
+    spool replay after an ack-loss partition, same role the region and
+    global seqs play one hop down.  Authority (who emits) travels as
+    ``(epoch, leader)``: higher epoch wins, and page announcements
+    carry their emission epoch so a deposed root's stale pages are
+    rejected and counted instead of folded.
+    """
+
+    peer: str
+    seq: int
+    #: Sender's current election epoch (monotonic across the mesh).
+    epoch: int = 0
+    #: Who the sender believes is the emitting root.
+    leader: str = ""
+    #: The sender's newest observed event timestamp.
+    head_ns: int = 0
+    #: The sender's emitted-window registry rows
+    #: (``[namespace, domain, start_ns, end_ns]``) — the dedup facts.
+    emitted_windows: list[list[Any]] = field(default_factory=list)
+    #: The sender's gap-tolerant per-region cursor states
+    #: (``region -> {"watermark": int, "accepted": [int, ...]}``):
+    #: the replication fence for region acks.
+    cursors: dict[str, dict[str, Any]] = field(default_factory=dict)
+    #: The sender's per-region reachability view (``region -> head_ns``).
+    reach: dict[str, int] = field(default_factory=dict)
+    #: Transitive liveness: when the sender last heard each peer
+    #: (``peer -> event-clock ns``); folded with max at the receiver so
+    #: liveness survives one-way partitions.
+    alive: dict[str, int] = field(default_factory=dict)
+    #: Anti-entropy delta: raw region→global envelope payloads the
+    #: receiver's last-gossiped cursors do not cover (budget-bounded,
+    #: oldest-first with the freshest riding along).
+    envelopes: list[dict[str, Any]] = field(default_factory=list)
+    #: Page announcements: raw emitted global pages, each carrying the
+    #: ``epoch`` it was emitted under (receivers fence on it).
+    pages: list[dict[str, Any]] = field(default_factory=list)
+
+
+def encode_peer_envelope(
+    peer: str,
+    seq: int,
+    epoch: int = 0,
+    leader: str = "",
+    head_ns: int = 0,
+    emitted_windows: list[list[Any]] | None = None,
+    cursors: dict[str, dict[str, Any]] | None = None,
+    reach: dict[str, int] | None = None,
+    alive: dict[str, int] | None = None,
+    envelopes: list[dict[str, Any]] | None = None,
+    pages: list[dict[str, Any]] | None = None,
+) -> dict[str, Any]:
+    """Peer gossip state → wire payload dict (JSON-safe)."""
+    return {
+        "peer_wire_version": PEER_WIRE_VERSION,
+        "peer": peer,
+        "seq": int(seq),
+        "epoch": int(epoch),
+        "leader": str(leader),
+        "head_ns": int(head_ns),
+        "emitted_windows": [
+            [str(row[0]), str(row[1]), int(row[2]), int(row[3])]
+            for row in (emitted_windows or [])
+        ],
+        "cursors": {
+            str(region): {
+                "watermark": int(state.get("watermark", -1)),
+                "accepted": [int(s) for s in state.get("accepted") or []],
+            }
+            for region, state in (cursors or {}).items()
+        },
+        "reach": {
+            str(region): int(head) for region, head in (reach or {}).items()
+        },
+        "alive": {
+            str(pid): int(ts) for pid, ts in (alive or {}).items()
+        },
+        "envelopes": list(envelopes or []),
+        "pages": list(pages or []),
+    }
+
+
+def decode_peer_envelope(payload: dict[str, Any]) -> PeerEnvelope:
+    """Wire payload dict → :class:`PeerEnvelope`; loud on breaks.
+
+    Relayed region envelopes and page announcements stay raw dicts —
+    they are validated by the same downstream decoders that handle
+    first-hand copies (``decode_global_envelope``, the rollup fold), so
+    a relay cannot launder a contract break past the mesh.
+    """
+    if not isinstance(payload, dict):
+        raise PeerWireError(
+            f"envelope must be an object, got {type(payload).__name__}"
+        )
+    version = payload.get("peer_wire_version")
+    if version != PEER_WIRE_VERSION:
+        raise PeerWireError(
+            f"peer wire version {version!r} != {PEER_WIRE_VERSION}"
+        )
+    peer = payload.get("peer")
+    if not isinstance(peer, str) or not peer:
+        raise PeerWireError("envelope missing peer identity")
+    try:
+        seq = int(payload["seq"])
+        epoch = int(payload.get("epoch", 0))
+        leader = str(payload.get("leader", ""))
+        head_ns = int(payload.get("head_ns", 0))
+    except (KeyError, TypeError, ValueError) as exc:
+        raise PeerWireError(f"bad envelope header: {exc}") from exc
+    windows: list[list[Any]] = []
+    for row in payload.get("emitted_windows") or []:
+        try:
+            windows.append(
+                [str(row[0]), str(row[1]), int(row[2]), int(row[3])]
+            )
+        except (IndexError, TypeError, ValueError) as exc:
+            raise PeerWireError(f"bad emitted window {row!r}: {exc}") from exc
+    cursors: dict[str, dict[str, Any]] = {}
+    for region, state in (payload.get("cursors") or {}).items():
+        if not isinstance(state, dict):
+            raise PeerWireError(f"bad cursor state for {region!r}")
+        try:
+            cursors[str(region)] = {
+                "watermark": int(state.get("watermark", -1)),
+                "accepted": [int(s) for s in state.get("accepted") or []],
+            }
+        except (TypeError, ValueError) as exc:
+            raise PeerWireError(
+                f"bad cursor state for {region!r}: {exc}"
+            ) from exc
+    try:
+        reach = {
+            str(region): int(head)
+            for region, head in (payload.get("reach") or {}).items()
+        }
+        alive = {
+            str(pid): int(ts)
+            for pid, ts in (payload.get("alive") or {}).items()
+        }
+    except (TypeError, ValueError) as exc:
+        raise PeerWireError(f"bad reach/alive map: {exc}") from exc
+    raw_envelopes = payload.get("envelopes")
+    if raw_envelopes is None:
+        raw_envelopes = []
+    if not isinstance(raw_envelopes, list):
+        raise PeerWireError("envelopes must be a list")
+    raw_pages = payload.get("pages")
+    if raw_pages is None:
+        raw_pages = []
+    if not isinstance(raw_pages, list):
+        raise PeerWireError("pages must be a list")
+    for entry in raw_envelopes:
+        if not isinstance(entry, dict):
+            raise PeerWireError("relayed envelope must be an object")
+    for entry in raw_pages:
+        if not isinstance(entry, dict):
+            raise PeerWireError("page announcement must be an object")
+    return PeerEnvelope(
+        peer=peer,
+        seq=seq,
+        epoch=epoch,
+        leader=leader,
+        head_ns=head_ns,
+        emitted_windows=windows,
+        cursors=cursors,
+        reach=reach,
+        alive=alive,
+        envelopes=list(raw_envelopes),
+        pages=list(raw_pages),
+    )
+
+
+def peer_envelope_json_line(payload: dict[str, Any]) -> str:
+    """One JSONL line for an encoded peer envelope."""
+    return json.dumps(payload, separators=(",", ":")) + "\n"
+
+
+def parse_peer_envelope_line(line: str) -> PeerEnvelope:
+    """Inverse of :func:`peer_envelope_json_line` (decode included)."""
+    try:
+        payload = json.loads(line)
+    except json.JSONDecodeError as exc:
+        raise PeerWireError(f"bad envelope line: {exc}") from exc
+    return decode_peer_envelope(payload)
+
+
+def load_peer_envelopes(path: str) -> list[PeerEnvelope]:
+    """Read a peer gossip log; loud on contract drift."""
+    out: list[PeerEnvelope] = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                out.append(parse_peer_envelope_line(line))
     return out

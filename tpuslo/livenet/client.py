@@ -108,6 +108,10 @@ class ReconnectingClient:
         self._connected_once = False
         #: Last pressure level any ack carried (-1 = never acked).
         self.pressure_level = -1
+        #: Last ``peer_info`` dict any ack carried (mesh front doors
+        #: advertise their election epoch + believed leader here) —
+        #: empty until an enriched ack arrives.
+        self.remote_info: dict[str, Any] = {}
         self.reconnects = 0
         self.sent_frames = 0
         self.spooled_frames = 0
@@ -176,6 +180,9 @@ class ReconnectingClient:
                         self._observer.pressure_level(
                             self.peer, level
                         )
+                    info = ack.get("peer_info")
+                    if isinstance(info, dict):
+                        self.remote_info = info
                     if not ack.get("ok", False):
                         # Contract refusal: delivered-and-refused, do
                         # not dam the spool replaying it forever.

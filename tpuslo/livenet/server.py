@@ -73,9 +73,16 @@ class LiveListener:
         observer: LivenetObserver | None = None,
         log: Callable[[str], None] | None = None,
         ingest_lock: threading.Lock | None = None,
+        ack_info: Callable[[], dict[str, Any]] | None = None,
     ):
         self._handler = handler
         self._pressure = pressure or (lambda: 0)
+        #: Optional ack enrichment: a dict merged into every ack as
+        #: ``peer_info``.  The mesh front door advertises its election
+        #: epoch and believed leader here, so a deposed root learns it
+        #: was superseded on its FIRST delivery after a heal — one
+        #: round-trip, before any gossip envelope makes it back.
+        self._ack_info = ack_info
         self._max_frame = max_frame_bytes
         self._observer = observer or LivenetObserver()
         self._log = log or (lambda msg: None)
@@ -185,6 +192,8 @@ class LiveListener:
             "seq": seq,
             "pressure_level": int(self._pressure()),
         }
+        if self._ack_info is not None:
+            payload["peer_info"] = dict(self._ack_info())
         if error is not None:
             payload["error"] = str(error)
         return encode_frame(payload)

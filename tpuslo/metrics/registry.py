@@ -409,6 +409,38 @@ class AgentMetrics:
             ["region"],
             registry=self.registry,
         )
+        # ---- global peer mesh (symmetric root, PR 19) -----------------
+        self.global_peer_epoch = Gauge(
+            "llm_slo_global_peer_epoch",
+            "This peer's election epoch — the fence every emitted "
+            "page carries; a deposed root's pages at a lower epoch "
+            "are rejected mesh-wide",
+            ["peer"],
+            registry=self.registry,
+        )
+        self.global_peer_elections = Counter(
+            "llm_slo_global_peer_elections_total",
+            "Leadership takes by this peer (bully by stable rank "
+            "over gossiped liveness; each take bumps the epoch past "
+            "everything seen)",
+            ["peer"],
+            registry=self.registry,
+        )
+        self.global_peer_gossip_rounds = Counter(
+            "llm_slo_global_peer_gossip_rounds_total",
+            "Anti-entropy gossip rounds this peer initiated (one per "
+            "round, not per remote peer)",
+            ["peer"],
+            registry=self.registry,
+        )
+        self.global_peer_reachable = Gauge(
+            "llm_slo_global_peer_reachable",
+            "1 while the remote mesh peer was heard (directly or "
+            "transitively) within the peer staleness bound, 0 once "
+            "it has aged out — the liveness the bully rule elects on",
+            ["peer"],
+            registry=self.registry,
+        )
         # ---- auto-remediation series (tpuslo.remediation) ------------
         self.remediation_actions_applied = Counter(
             "llm_slo_agent_remediation_actions_applied_total",
@@ -947,6 +979,7 @@ class _PromGlobalObserver:
         self._m = metrics
         self._ingest_children: dict[str, object] = {}
         self._reachable_children: dict[str, object] = {}
+        self._peer_reach_children: dict[str, object] = {}
 
     def global_ingested(self, region: str, incidents: int) -> None:
         child = self._ingest_children.get(region)
@@ -972,6 +1005,24 @@ class _PromGlobalObserver:
                 region=region
             )
             self._reachable_children[region] = child
+        child.set(reachable)
+
+    # ---- peer mesh (symmetric root) --------------------------------
+
+    def peer_epoch(self, peer: str, epoch: int) -> None:
+        self._m.global_peer_epoch.labels(peer=peer).set(epoch)
+
+    def peer_election(self, peer: str) -> None:
+        self._m.global_peer_elections.labels(peer=peer).inc()
+
+    def peer_gossip_round(self, peer: str) -> None:
+        self._m.global_peer_gossip_rounds.labels(peer=peer).inc()
+
+    def peer_reachable(self, peer: str, reachable: int) -> None:
+        child = self._peer_reach_children.get(peer)
+        if child is None:
+            child = self._m.global_peer_reachable.labels(peer=peer)
+            self._peer_reach_children[peer] = child
         child.set(reachable)
 
 
