@@ -20,6 +20,7 @@ ARTIFACTS ?= artifacts
 	frontdoor-smoke frontdoor-bench \
 	router-smoke router-bench \
 	deviceplane-smoke deviceplane-sweep \
+	profiler-smoke profiler-sweep \
 	metrics-drift m5-candidate m5-gate helm-lint dashboards clean
 
 all: native test
@@ -312,6 +313,25 @@ deviceplane-sweep:
 		--summary-json $(ARTIFACTS)/deviceplane/sweep.json \
 		--summary-md $(ARTIFACTS)/deviceplane/sweep.md
 
+# Continuous-profiler smoke: overhead governor (forced-slow degrade,
+# headroom re-engage, eviction windows never dropped), per-window
+# ledger parity vs one spliced full capture, probe-payload contracts,
+# and state round trips — seconds, runs in m5-gate.
+profiler-smoke:
+	$(PY) -m pytest tests/test_profiler.py -q -m 'not slow'
+
+# Full continuous-profiler release gate: seeded capture windows under
+# the measured-overhead budget (EMA <= 3% of cycle budget), governor
+# degrade/force/re-engage evidence, per-window substantive join
+# >= 0.9 with the raw rate reported alongside, window/full-capture
+# bucket parity, and the injected preemption window attributed to
+# tpu_preemption (see docs/runbooks/continuous-profiling.md).
+profiler-sweep:
+	mkdir -p $(ARTIFACTS)/profiler
+	JAX_PLATFORMS=$${JAX_PLATFORMS:-cpu} $(PY) -m tpuslo m5gate --profiler-sweep \
+		--summary-json $(ARTIFACTS)/profiler/sweep.json \
+		--summary-md $(ARTIFACTS)/profiler/sweep.md
+
 # Fleet observability-plane smoke: wire contract round trips, hash-ring
 # placement, rollup merge invariants (no cross-tenant/cross-domain),
 # aggregator seq-dedup + failover absorb, and a small seeded simulator
@@ -438,7 +458,7 @@ m5-candidate:
 # that acts imprecisely, a serving front door that loses to
 # per-stream serving, or a router tier that loses requests or
 # scaling across an engine kill, before the statistical gates even
-# run (ISSUEs 6 + 7 + 8 + 9 + 10 + 11 + 12 + 15 + 16).
+# run (ISSUEs 6 + 7 + 8 + 9 + 10 + 11 + 12 + 15 + 16 + 20).
 m5-gate: lint racecheck-smoke jitcheck-smoke burn-smoke burn-sweep \
 		bench-columnar-smoke fleet-smoke fleet-sweep \
 		federation-smoke federation-sweep \
@@ -448,6 +468,7 @@ m5-gate: lint racecheck-smoke jitcheck-smoke burn-smoke burn-sweep \
 		frontdoor-smoke frontdoor-bench \
 		router-smoke router-bench \
 		deviceplane-smoke deviceplane-sweep \
+		profiler-smoke profiler-sweep \
 		crash-smoke live-chaos-smoke
 	$(PY) -m tpuslo m5gate --candidate-root $(ARTIFACTS)/m5 \
 		--scenarios "$(shell echo $(M5_SCENARIOS) | tr ' ' ',')" \

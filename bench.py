@@ -1547,6 +1547,12 @@ SPEC_HOST_SYNCS_PER_TOKEN_CEILING = 0.45
 DEVICEPLANE_MIN_JOIN_RATE = 0.9
 DEVICEPLANE_MAX_UNEXPLAINED_SHARE = 0.1
 
+# Continuous-profiler floors (ISSUE 20): the stride-gated capture loop
+# must stay inside its measured-overhead budget, and every window's
+# ledger must hold the substantive join bar on the seeded lane.
+PROFILER_MAX_OVERHEAD_PCT = 3.0
+PROFILER_MIN_WINDOW_JOIN_RATE = 0.9
+
 
 def _gate_deviceplane(serving_digest: dict) -> None:
     rate = serving_digest.get("deviceplane_join_rate")
@@ -1564,6 +1570,25 @@ def _gate_deviceplane(serving_digest: dict) -> None:
             f"{DEVICEPLANE_MAX_UNEXPLAINED_SHARE} on the seeded "
             "synthetic lane — device time is leaking out of the "
             "ledger buckets; see docs/runbooks/device-plane.md"
+        )
+
+
+def _gate_profiler(serving_digest: dict) -> None:
+    overhead = serving_digest.get("profiler_overhead_pct")
+    if overhead is not None and overhead > PROFILER_MAX_OVERHEAD_PCT:
+        raise SystemExit(
+            f"bench: continuous-profiler overhead {overhead}% > "
+            f"{PROFILER_MAX_OVERHEAD_PCT}% of cycle budget on the "
+            "seeded lane — capture+parse+fold got slower; run "
+            "m5gate --profiler-sweep for the governor evidence"
+        )
+    join = serving_digest.get("profiler_min_window_join_rate")
+    if join is not None and join < PROFILER_MIN_WINDOW_JOIN_RATE:
+        raise SystemExit(
+            f"bench: continuous-profiler window substantive join "
+            f"{join} < {PROFILER_MIN_WINDOW_JOIN_RATE} on the seeded "
+            "lane — a per-window join tier regressed; see "
+            "docs/runbooks/continuous-profiling.md"
         )
 
 
@@ -1648,6 +1673,13 @@ def _digest_serving(serving: dict) -> dict:
         d["deviceplane_unexplained_share"] = deviceplane.get(
             "unexplained_share"
         )
+    profiler = serving.get("profiler") or {}
+    if profiler.get("overhead_ema_pct") is not None:
+        d["profiler_overhead_pct"] = profiler["overhead_ema_pct"]
+        d["profiler_min_window_join_rate"] = profiler.get(
+            "min_substantive_join_rate"
+        )
+        d["profiler_raw_join_rate"] = profiler.get("mean_raw_join_rate")
     for key in ("error", "tpu_error"):
         if serving.get(key):
             d[key] = str(serving[key])[:120]
@@ -1993,6 +2025,7 @@ def build_result(
     }
     _gate_trace_discipline(compact["serving"])
     _gate_deviceplane(compact["serving"])
+    _gate_profiler(compact["serving"])
     if serving_result.get("backend") == "tpu":
         # The live serving digest IS the TPU evidence; stamp it so the
         # artifact says so even without an embedded capture.

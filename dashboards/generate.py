@@ -147,6 +147,25 @@ kernel_correlation = dashboard(
         panel("Roofline verdicts on serving attributions", [
             ('sum(rate(llm_slo_deviceplane_roofline_verdicts_total[5m])) by (verdict)', "{{verdict}}"),
         ], 12, 48),
+        # --- continuous profiler (tpuslo.deviceplane.profiler) -------
+        panel("Profiler capture windows (/s by kind)", [
+            ('sum(rate(llm_slo_profiler_windows_total[5m])) by (kind)', "{{kind}}"),
+        ], 0, 56),
+        panel("Profiler capture overhead (EMA %, governor budget 3%)", [
+            ('llm_slo_profiler_capture_overhead_pct', "overhead EMA (%)"),
+        ], 12, 56, kind="stat"),
+        panel("Profiler window idle gap p95/p99 (ms)", [
+            ('histogram_quantile(0.95, sum(rate(llm_slo_profiler_idle_gap_ms_bucket[5m])) by (le))', "idle gap p95 (ms)"),
+            ('histogram_quantile(0.99, sum(rate(llm_slo_profiler_idle_gap_ms_bucket[5m])) by (le))', "idle gap p99 (ms)"),
+        ], 0, 64, unit="ms"),
+        panel("Profiler governor (transitions /s + current stride)", [
+            ('sum(rate(llm_slo_profiler_governor_transitions_total[5m])) by (transition)', "{{transition}}"),
+            ('llm_slo_profiler_stride_cycles', "stride (cycles)"),
+        ], 12, 64),
+        panel("Profiler window MFU (%) / unexplained share", [
+            ('llm_slo_profiler_window_mfu_pct', "window MFU (%)"),
+            ('llm_slo_profiler_window_unexplained_share', "unexplained share"),
+        ], 0, 72, w=24),
     ],
 )
 

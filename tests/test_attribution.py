@@ -135,7 +135,7 @@ class TestBayesian:
 
     def test_likelihood_table_covers_all_domains_and_signals(self):
         table = attribution.default_likelihoods()
-        assert len(table) == 21
+        assert len(table) == 22
         for row in table.values():
             assert set(row) == set(attribution.ALL_DOMAINS)
             for p in row.values():

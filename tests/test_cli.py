@@ -187,8 +187,10 @@ class TestAgentCLI:
         kinds = {l["kind"] for l in lines}
         assert kinds == {"slo", "probe"}
         probes = [l for l in lines if l["kind"] == "probe"]
-        # default config signal_set covers 18 of the 21 signals
-        # (the three counters are opt-in, mirroring the reference default)
+        # default config signal_set covers 18 of the 23 signals (the
+        # three counters are opt-in, mirroring the reference default;
+        # the two profiler window signals are emitted only by the
+        # continuous profiler, never by the synthetic generator)
         assert len(probes) == 4 * 18
         tpu_probes = [p for p in probes if "tpu" in p]
         assert tpu_probes and tpu_probes[0]["tpu"]["chip"]

@@ -1,0 +1,439 @@
+"""Continuous device profiler (ISSUE 20): governor, parity, emission.
+
+The load-bearing invariants:
+
+* the overhead governor degrades the stride when measured capture cost
+  (amortised over the stride) sustains above the 3% budget, re-engages
+  the base stride on sustained headroom, and NEVER drops a window that
+  carries an eviction notice — degradation trades frequency, not
+  eviction evidence;
+* per-window ledger bucket deltas sum exactly to one big
+  ``build_ledger`` over the spliced capture (``concat_window_docs``)
+  — the windowing itself must not create or destroy device time;
+* every emitted probe payload is contract-valid against
+  ``SCHEMA_PROBE_EVENT`` and carries the same values the attribution
+  map sees (one source);
+* both join rates ride every window, raw strictly below tiered on the
+  seeded lane (the 0.556 lesson);
+* export_state/restore_state round-trips the governor and window ring.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tpuslo.deviceplane.ledger import build_ledger
+from tpuslo.deviceplane.profiler import (
+    MAX_OVERHEAD_PCT,
+    MIN_WINDOW_SUBSTANTIVE_JOIN,
+    ContinuousProfiler,
+    ProfilerReport,
+    concat_window_docs,
+    run_profiler_sweep,
+    seeded_cost_model,
+)
+from tpuslo.otel.xla_spans import parse_trace_events
+from tpuslo.schema import SCHEMA_PROBE_EVENT, is_valid, validate
+from tpuslo.signals import constants as sig
+
+
+def make_profiler(**kw):
+    defaults = dict(
+        source="synthetic",
+        seed=1337,
+        cycle_budget_ms=1000.0,
+        stride_cycles=2,
+        grace_cycles=2,
+        window_steps=6,
+        history=64,
+        node="test-host",
+        pod="test-pod",
+    )
+    defaults.update(kw)
+    return ContinuousProfiler(**defaults)
+
+
+# ---- stride / capture cadence ------------------------------------------
+
+
+class TestCadence:
+    def test_tick_captures_on_stride(self):
+        prof = make_profiler(stride_cycles=3)
+        results = [prof.tick() for _ in range(9)]
+        windows = [w for w in results if w is not None]
+        assert len(windows) == 3
+        assert [w.cycle for w in windows] == [3, 6, 9]
+        assert [w.index for w in windows] == [0, 1, 2]
+
+    def test_windows_are_deterministic_per_index(self):
+        a = make_profiler(stride_cycles=1)
+        b = make_profiler(stride_cycles=1)
+        wa = [a.tick() for _ in range(4)]
+        wb = [b.tick() for _ in range(4)]
+        for x, y in zip(wa, wb):
+            assert x.idle_gap_ms == y.idle_gap_ms
+            assert x.window_ms == y.window_ms
+            assert x.launches == y.launches
+
+    def test_history_ring_trims(self):
+        prof = make_profiler(stride_cycles=1, history=3)
+        for _ in range(8):
+            prof.tick()
+        kept = prof.windows()
+        assert len(kept) == 3
+        assert [w.index for w in kept] == [5, 6, 7]
+
+
+# ---- the overhead governor ---------------------------------------------
+
+
+class TestGovernor:
+    def test_forced_slow_capture_degrades_stride(self):
+        # cost_fn pins the measured cost at 400ms: amortised over a
+        # 2-cycle stride against a 1000ms cycle budget that is 20%,
+        # far over the 3% budget -> stride must lengthen.
+        prof = make_profiler(
+            stride_cycles=2,
+            grace_cycles=2,
+            max_stride_cycles=16,
+            cost_fn=lambda _ms: 400.0,
+        )
+        for _ in range(40):
+            prof.tick()
+            if prof.degraded:
+                break
+        assert prof.degraded
+        assert prof.stride_cycles > prof.base_stride_cycles
+        assert prof.degradations >= 1
+
+    def test_stride_caps_at_max(self):
+        prof = make_profiler(
+            stride_cycles=2,
+            grace_cycles=1,
+            max_stride_cycles=8,
+            cost_fn=lambda _ms: 900.0,
+        )
+        for _ in range(200):
+            prof.tick()
+        assert prof.stride_cycles == 8
+
+    def test_sustained_headroom_reengages(self):
+        cost = {"ms": 400.0}
+        prof = make_profiler(
+            stride_cycles=2,
+            grace_cycles=2,
+            max_stride_cycles=16,
+            cost_fn=lambda _ms: cost["ms"],
+        )
+        for _ in range(40):
+            prof.tick()
+            if prof.degraded:
+                break
+        assert prof.degraded
+        # Headroom restored: EMA decays below half budget over the
+        # cool streak and the base stride re-engages.
+        cost["ms"] = 1.0
+        for _ in range(600):
+            prof.tick()
+            if not prof.degraded:
+                break
+        assert not prof.degraded
+        assert prof.stride_cycles == prof.base_stride_cycles
+        assert prof.reengagements >= 1
+
+    def test_eviction_notice_forces_capture_while_degraded(self):
+        # The invariant the whole governor defends: degradation trades
+        # capture FREQUENCY, never an eviction-bearing window.
+        prof = make_profiler(
+            stride_cycles=2,
+            grace_cycles=2,
+            max_stride_cycles=16,
+            cost_fn=lambda _ms: 400.0,
+        )
+        for _ in range(40):
+            prof.tick()
+            if prof.degraded:
+                break
+        assert prof.degraded
+        prof.notice_eviction()
+        window = prof.tick()
+        assert window is not None
+        assert window.forced is True
+        assert window.eviction_events >= 1
+        assert prof.windows_forced == 1
+        assert prof.eviction_windows >= 1
+
+    def test_eviction_notice_rides_next_stride_capture_when_due(self):
+        prof = make_profiler(stride_cycles=1)
+        prof.notice_eviction(2)
+        window = prof.tick()
+        # Capture was already due, so the notice rides rather than
+        # forcing: not flagged forced, but the events still land.
+        assert window is not None
+        assert window.forced is False
+        assert window.eviction_events == 2
+
+    def test_overhead_ema_tracks_amortised_cost(self):
+        prof = make_profiler(stride_cycles=4, cost_fn=lambda _ms: 40.0)
+        for _ in range(4):
+            prof.tick()
+        # 40ms once per 4 cycles of 1000ms budget = 1% amortised.
+        assert prof.overhead_ema_pct == pytest.approx(1.0)
+        assert not prof.degraded
+
+
+# ---- per-window / full-capture ledger parity ---------------------------
+
+
+class TestLedgerParity:
+    def test_window_buckets_sum_to_spliced_capture(self):
+        # Orphan helpers stay out of this lane: in a spliced trace a
+        # later window's head-of-trace orphans sit after earlier step
+        # frames and the frame tier legitimately claims them.
+        prof = make_profiler(stride_cycles=1, synthetic_orphan_helpers=0)
+        docs, compile_lists = [], []
+        per_window: dict[str, float] = {}
+        total_us = 0.0
+        for _ in range(5):
+            w = prof.tick()
+            doc, compiles = prof.window_trace_doc(w.index)
+            docs.append(doc)
+            compile_lists.append(compiles)
+            ledger = build_ledger(
+                parse_trace_events(doc, include_ops=True), compiles
+            )
+            for bucket, us in ledger.buckets_us.items():
+                per_window[bucket] = per_window.get(bucket, 0.0) + us
+            total_us += ledger.total_us
+        spliced_doc, spliced_compiles = concat_window_docs(
+            docs, compile_lists
+        )
+        full = build_ledger(
+            parse_trace_events(spliced_doc, include_ops=True),
+            spliced_compiles,
+        )
+        assert total_us == pytest.approx(full.total_us, abs=0.5)
+        for bucket, us in full.buckets_us.items():
+            assert per_window.get(bucket, 0.0) == pytest.approx(
+                us, abs=0.5
+            ), bucket
+
+    def test_concat_preserves_event_count_and_order(self):
+        prof = make_profiler(stride_cycles=1, synthetic_orphan_helpers=0)
+        docs = []
+        for _ in range(3):
+            w = prof.tick()
+            doc, _ = prof.window_trace_doc(w.index)
+            docs.append(doc)
+        spliced, _ = concat_window_docs(docs)
+        xs = [e for e in spliced["traceEvents"] if e.get("ph") == "X"]
+        n_source = sum(
+            sum(1 for e in d["traceEvents"] if e.get("ph") == "X")
+            for d in docs
+        )
+        assert len(xs) == n_source
+        # The splice leaves no artificial inter-window seams: windows
+        # abut exactly where the previous window's last span ended.
+        firsts, lasts = [], []
+        cursor = 0
+        for d in docs:
+            n = sum(1 for e in d["traceEvents"] if e.get("ph") == "X")
+            chunk = xs[cursor:cursor + n]
+            firsts.append(min(float(e["ts"]) for e in chunk))
+            lasts.append(
+                max(float(e["ts"]) + float(e.get("dur", 0)) for e in chunk)
+            )
+            cursor += n
+        for prev_end, next_start in zip(lasts, firsts[1:]):
+            assert next_start == pytest.approx(prev_end, abs=1e-6)
+
+
+# ---- emission: contract validity and single-sourcing -------------------
+
+
+class TestEmission:
+    def test_probe_payloads_are_contract_valid(self):
+        prof = make_profiler(
+            stride_cycles=1,
+            slice_id="v5e-8-slice0",
+            host_index=1,
+        )
+        window = prof.tick()
+        payloads = prof.probe_payloads(window)
+        assert len(payloads) == 4
+        for payload in payloads:
+            assert is_valid(payload, SCHEMA_PROBE_EVENT)
+            validate(payload, SCHEMA_PROBE_EVENT)
+        assert {p["signal"] for p in payloads} == {
+            sig.SIGNAL_DEVICE_IDLE_GAP_MS,
+            sig.SIGNAL_DEVICE_EVICTION_EVENTS,
+            sig.SIGNAL_DEVICE_UNEXPLAINED_SHARE,
+            sig.SIGNAL_DEVICE_MFU_PCT,
+        }
+        by_sig = {p["signal"]: p for p in payloads}
+        tpu = by_sig[sig.SIGNAL_DEVICE_IDLE_GAP_MS]["tpu"]
+        assert tpu["chip"] == "accel0"
+        assert tpu["slice_id"] == "v5e-8-slice0"
+        assert tpu["host_index"] == 1
+
+    def test_payloads_and_attribution_map_share_values(self):
+        prof = make_profiler(stride_cycles=1)
+        window = prof.tick()
+        by_sig = {
+            p["signal"]: p["value"] for p in prof.probe_payloads(window)
+        }
+        for name, value in prof.window_signal_values(window).items():
+            assert by_sig[name] == pytest.approx(value, abs=1e-4)
+
+    def test_both_join_rates_ride_every_window(self):
+        prof = make_profiler(stride_cycles=1)
+        for _ in range(4):
+            window = prof.tick()
+            assert 0.0 <= window.raw_join_rate <= 1.0
+            # Seeded lane: helpers/warmups carry no exact identity, so
+            # raw sits strictly below tiered — if they ever collapse
+            # together the single-sourcing broke (the 0.556 lesson).
+            assert window.raw_join_rate < window.substantive_join_rate
+            assert (
+                window.substantive_join_rate
+                >= MIN_WINDOW_SUBSTANTIVE_JOIN
+            )
+
+    def test_preemption_window_carries_gap_and_eviction(self):
+        prof = make_profiler(
+            stride_cycles=1,
+            synthetic_preempt_window=2,
+            synthetic_preempt_gap_ms=250.0,
+        )
+        windows = [prof.tick() for _ in range(4)]
+        hit = windows[2]
+        assert hit.eviction_events == 1
+        clean_max = max(
+            w.idle_gap_ms for w in windows if w.eviction_events == 0
+        )
+        assert hit.idle_gap_ms > clean_max + 100.0
+
+    def test_roofline_verdict_attaches_with_cost_model(self):
+        step_bytes, step_flops, step_dur = seeded_cost_model()
+        prof = make_profiler(
+            stride_cycles=1,
+            bytes_per_step=step_bytes,
+            flops_per_step=step_flops,
+            step_dur_us=step_dur,
+        )
+        window = prof.tick()
+        assert window.verdict == "memory_bound"
+        assert window.mfu_pct > 0.0
+        block = prof.window_roofline(window.index)
+        assert block["verdict"] == "memory_bound"
+        assert block["achieved_gb_per_sec"] > 0.0
+
+    def test_no_cost_model_means_no_invented_mfu(self):
+        prof = make_profiler(stride_cycles=1)
+        window = prof.tick()
+        assert window.mfu_pct == -1.0
+        assert window.verdict == ""
+        assert prof.window_roofline(window.index) == {}
+        # The emitted payload clamps to 0.0 (the schema floor), never
+        # a made-up positive MFU.
+        by_sig = {
+            p["signal"]: p["value"] for p in prof.probe_payloads(window)
+        }
+        assert by_sig[sig.SIGNAL_DEVICE_MFU_PCT] == 0.0
+
+
+# ---- state round-trip ---------------------------------------------------
+
+
+class TestStateRoundTrip:
+    def test_export_restore_round_trip(self):
+        prof = make_profiler(
+            stride_cycles=2,
+            grace_cycles=2,
+            max_stride_cycles=16,
+            cost_fn=lambda _ms: 400.0,
+        )
+        for _ in range(40):
+            prof.tick()
+            if prof.degraded:
+                break
+        prof.notice_eviction()
+        prof.tick()
+        state = prof.export_state()
+
+        fresh = make_profiler(
+            stride_cycles=2, grace_cycles=2, max_stride_cycles=16
+        )
+        fresh.restore_state(state)
+        assert fresh.stats() == prof.stats()
+        assert fresh.export_state()["window_index"] == state["window_index"]
+        restored = fresh.windows()
+        assert [w.to_dict() for w in restored] == state["windows"]
+        # The restored profiler resumes the stride where it left off.
+        assert fresh.stride_cycles == prof.stride_cycles
+        assert fresh.degraded == prof.degraded
+
+    def test_restore_ignores_garbage(self):
+        prof = make_profiler()
+        prof.restore_state(None)
+        prof.restore_state({"windows": [{"index": "bogus"}]})
+        assert prof.windows() == []
+        assert prof.stats()["cycle"] == 0
+
+
+# ---- config / construction ---------------------------------------------
+
+
+class TestConstruction:
+    def test_unknown_source_rejected(self):
+        with pytest.raises(ValueError):
+            ContinuousProfiler(source="perfetto")
+
+    def test_xprof_source_needs_log_dir_and_work(self):
+        with pytest.raises(ValueError):
+            ContinuousProfiler(source="xprof")
+        with pytest.raises(ValueError):
+            ContinuousProfiler(source="xprof", log_dir="/tmp/x")
+
+    def test_seeded_cost_model_is_memory_bound_regime(self):
+        step_bytes, step_flops, (lo, hi) = seeded_cost_model()
+        assert step_bytes > 0 and step_flops > 0
+        assert 0 < lo < hi
+
+
+# ---- the seeded sweep gate ---------------------------------------------
+
+
+class TestProfilerSweep:
+    def test_sweep_passes_at_default_seed(self):
+        report = run_profiler_sweep(seed=1337, cycles=12, parity_windows=3)
+        assert report.passed, report.failures
+        assert (
+            report.overhead["overhead_ema_pct"] <= MAX_OVERHEAD_PCT
+        )
+        assert (
+            report.joins["min_substantive_join_rate"]
+            >= MIN_WINDOW_SUBSTANTIVE_JOIN
+        )
+        assert report.governor["degradations"] >= 1
+        assert report.governor["reengagements"] >= 1
+        assert report.governor["forced_capture_evictions"] >= 1
+        assert report.parity["worst_bucket_drift_us"] <= 0.5
+        assert report.preemption["top_domain"] == "tpu_preemption"
+
+    def test_report_dict_shape(self):
+        report = ProfilerReport(seed=7)
+        assert report.passed
+        report.failures.append("x")
+        data = report.to_dict()
+        assert data["passed"] is False
+        assert set(data) == {
+            "seed",
+            "passed",
+            "overhead",
+            "governor",
+            "joins",
+            "parity",
+            "preemption",
+            "failures",
+        }

@@ -26,11 +26,11 @@ def make_sample(fault="baseline", idx=0):
 class TestConstants:
     def test_signal_counts(self):
         assert len(signals.CPU_SIGNALS) == 12
-        assert len(signals.TPU_SIGNALS) == 9
-        assert len(signals.ALL_SIGNALS) == 21
+        assert len(signals.TPU_SIGNALS) == 11
+        assert len(signals.ALL_SIGNALS) == 23
 
     def test_mode_signal_sets(self):
-        assert len(signals.supported_signals_for_mode(signals.CAPABILITY_TPU_FULL)) == 21
+        assert len(signals.supported_signals_for_mode(signals.CAPABILITY_TPU_FULL)) == 23
         assert len(signals.supported_signals_for_mode(signals.CAPABILITY_CORE_FULL)) == 12
         assert signals.supported_signals_for_mode(signals.CAPABILITY_BCC_DEGRADED) == [
             "dns_latency_ms",
@@ -41,7 +41,7 @@ class TestConstants:
         order = signals.disable_order()
         assert sorted(order) == sorted(signals.ALL_SIGNALS)
         # All TPU signals shed before any kernel probe.
-        assert set(order[:9]) == set(signals.TPU_SIGNALS)
+        assert set(order[:11]) == set(signals.TPU_SIGNALS)
 
     def test_thresholds_and_units_complete(self):
         for name in signals.ALL_SIGNALS:

@@ -103,6 +103,10 @@ SIGNAL_ELEVATION_THRESHOLDS: dict[str, float] = {
     "dcn_transfer_latency_ms": 25,
     "device_idle_gap_ms": 25,
     "device_eviction_events_total": 1,
+    "device_unexplained_share": 0.10,
+    # device_mfu_pct is deliberately ABSENT: MFU is low-is-bad and the
+    # elevation machinery is high-is-bad monotone; the profiler's
+    # roofline verdict carries its interpretation instead.
 }
 
 # Error thresholds (same sync contract): together with the warning
@@ -131,6 +135,7 @@ SIGNAL_ERROR_THRESHOLDS: dict[str, float] = {
     "dcn_transfer_latency_ms": 80,
     "device_idle_gap_ms": 100,
     "device_eviction_events_total": 3,
+    "device_unexplained_share": 0.25,
 }
 
 # Counter-valued signals: an exact 0.0 is a legitimate healthy reading.
@@ -267,7 +272,9 @@ def default_priors() -> dict[str, float]:
 
 
 def default_likelihoods() -> dict[str, dict[str, float]]:
-    """P(signal elevated | domain) for all 21 signals × 15 domains.
+    """P(signal elevated | domain) for every thresholded signal × 15
+    domains (``device_mfu_pct`` stays out: informational, no elevation
+    semantics).
 
     CPU-signal columns over the original eight domains follow the
     reference table (``bayesian.go:67-190``); TPU columns/rows are
@@ -369,6 +376,17 @@ def default_likelihoods() -> dict[str, dict[str, float]]:
             dns=0.03, egress=0.03, cpu=0.03, mem=0.03, pthr=0.03, perr=0.03,
             retr=0.03, ici=0.03, dcn=0.03, hbm=0.03, xla=0.03, offload=0.03,
             preempt=0.95, noisy=0.03, unknown=0.03,
+        ),
+        # Ledger unexplained share (continuous-profiler windows): a
+        # capture cut mid-eviction leaves un-joinable launch fragments,
+        # and a recompile storm floods the window with anonymous
+        # first-execution launches; kept deliberately conservative —
+        # it mostly indicts the OBSERVER (join ladder), so it should
+        # tilt, never drive, an attribution.
+        "device_unexplained_share": _row(
+            dns=0.05, egress=0.05, cpu=0.05, mem=0.05, pthr=0.05, perr=0.05,
+            retr=0.05, ici=0.05, dcn=0.05, hbm=0.05, xla=0.25, offload=0.05,
+            preempt=0.35, noisy=0.10, unknown=0.30,
         ),
     }
 

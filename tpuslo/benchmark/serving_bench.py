@@ -1362,6 +1362,61 @@ def _deviceplane_lane(seed: int = 1337) -> dict[str, Any]:
     return summary
 
 
+def _profiler_lane(seed: int = 1337, cycles: int = 8) -> dict[str, Any]:
+    """Seeded continuous-profiler lane (platform-independent).
+
+    Ticks a stride-1 profiler over the seeded synthetic-xprof stream
+    and publishes the ISSUE 20 acceptance bars: the measured capture
+    overhead EMA (gated <= 3% of the cycle budget by bench) and the
+    per-window substantive join rate (gated >= 0.9), with the raw
+    exact-identity rate reported alongside off the same ledger.
+    """
+    from tpuslo.deviceplane.profiler import (
+        ContinuousProfiler,
+        seeded_cost_model,
+    )
+
+    step_bytes, step_flops, step_dur = seeded_cost_model()
+    prof = ContinuousProfiler(
+        source="synthetic",
+        seed=seed,
+        stride_cycles=1,
+        window_steps=8,
+        history=cycles,
+        bytes_per_step=step_bytes,
+        flops_per_step=step_flops,
+        step_dur_us=step_dur,
+        node="bench-host",
+    )
+    windows = [w for _ in range(cycles) if (w := prof.tick()) is not None]
+    return {
+        "seed": seed,
+        "windows": len(windows),
+        "overhead_ema_pct": round(prof.overhead_ema_pct, 4),
+        "overhead_budget_pct": prof.overhead_budget_pct,
+        "mean_capture_cost_ms": round(
+            sum(w.capture_cost_ms for w in windows)
+            / max(len(windows), 1),
+            3,
+        ),
+        "min_substantive_join_rate": round(
+            min(
+                (w.substantive_join_rate for w in windows), default=0.0
+            ),
+            4,
+        ),
+        "mean_raw_join_rate": round(
+            sum(w.raw_join_rate for w in windows) / max(len(windows), 1),
+            4,
+        ),
+        "degradations": prof.degradations,
+        "mean_idle_gap_ms": round(
+            sum(w.idle_gap_ms for w in windows) / max(len(windows), 1),
+            3,
+        ),
+    }
+
+
 def run(
     platform: str = "auto",
     model: str = "auto",
@@ -1561,6 +1616,9 @@ def run(
 
     # --- device-plane ledger on the seeded synthetic-xprof lane --------
     out["deviceplane"] = _additive_lane(_deviceplane_lane)
+
+    # --- continuous profiler on the same seeded lane -------------------
+    out["profiler"] = _additive_lane(_profiler_lane)
 
     try:
         stats = dev.memory_stats() or {}

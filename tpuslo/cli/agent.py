@@ -92,6 +92,39 @@ def build_parser() -> argparse.ArgumentParser:
         "this JSONL log, which `tpuslo fleetagg` consumes; requires "
         "--columnar",
     )
+    p.add_argument(
+        "--profile-device",
+        action="store_true",
+        help="continuous device profiler: stride-gated capture "
+        "windows (live xprof or the seeded synthetic lane) folded "
+        "through the device-plane ledger under a measured-overhead "
+        "governor, emitting per-window device signals into the "
+        "columnar spine; requires --columnar (knobs: the `profiler:` "
+        "config section)",
+    )
+    p.add_argument(
+        "--profiler-source",
+        default="",
+        choices=["", "synthetic", "xprof"],
+        help="capture lane override (default: profiler.source config; "
+        "xprof needs importable jax and falls back to synthetic with "
+        "a note when unavailable)",
+    )
+    p.add_argument(
+        "--profiler-stride",
+        type=int,
+        default=0,
+        help="capture every N columnar cycles "
+        "(0 = profiler.stride_cycles config)",
+    )
+    p.add_argument(
+        "--profiler-preempt-window",
+        type=int,
+        default=-1,
+        help="synthetic lane only: inject a preemption-sized idle gap "
+        "and its eviction notice into this capture window (seeded "
+        "e2e evidence; -1 disables)",
+    )
     # Multi-host identity for the ring loop's TPU events: a DaemonSet
     # agent knows which slice/host it runs on; SliceJoiner joins
     # per-host streams on exactly this identity.
@@ -429,6 +462,16 @@ def main(
             file=sys.stderr,
         )
         return 2
+    if args.profile_device and not args.columnar:
+        # Profiler windows are emitted as probe events on the columnar
+        # spine — the row loop has no batch to fold them into.
+        # Refusing loudly beats a profiler that silently never ticks.
+        print(
+            "agent: --profile-device emits capture windows into the "
+            "columnar spine; add --columnar",
+            file=sys.stderr,
+        )
+        return 2
     if args.chaos_telemetry > 0 and args.probe_source == "ring":
         # Ring events arrive one at a time from the kernel; the chaos
         # stream's reorder/dup buffering only makes sense on the
@@ -733,6 +776,74 @@ def main(
                 WebhookSink(hook),
                 observer=metrics.delivery_observer("webhook"),
             )
+
+    # ---- continuous device profiler (tpuslo.deviceplane.profiler) ----
+    profiler = None
+    profiler_attributor = None
+    if args.profile_device or (cfg.profiler.enabled and args.columnar):
+        from tpuslo.deviceplane.profiler import (
+            ContinuousProfiler,
+            seeded_cost_model,
+        )
+
+        prof_cfg = cfg.profiler
+        prof_source = args.profiler_source or prof_cfg.source
+        step_bytes, step_flops, step_dur = seeded_cost_model()
+        prof_kwargs = dict(
+            stride_cycles=args.profiler_stride or prof_cfg.stride_cycles,
+            max_stride_cycles=prof_cfg.max_stride_cycles,
+            window_steps=prof_cfg.window_steps,
+            overhead_budget_pct=prof_cfg.overhead_budget_pct,
+            cycle_budget_ms=prof_cfg.cycle_budget_ms,
+            ema_alpha=prof_cfg.ema_alpha,
+            grace_cycles=prof_cfg.grace_cycles,
+            history=prof_cfg.history,
+            bytes_per_step=step_bytes,
+            flops_per_step=step_flops,
+            step_dur_us=step_dur,
+            node=args.node,
+            namespace=args.namespace,
+            pod=f"{args.workload}-agent",
+            chip=args.tpu_chip,
+            slice_id=args.slice_id or cfg.tpu.slice_id,
+            host_index=(
+                args.host_index if args.slice_id else cfg.tpu.host_index
+            ),
+            log_dir=prof_cfg.log_dir,
+            synthetic_preempt_window=args.profiler_preempt_window,
+            observer=metrics.profiler_observer(),
+        )
+        try:
+            profiler = ContinuousProfiler(source=prof_source, **prof_kwargs)
+        except (RuntimeError, ValueError) as exc:
+            if prof_source == "xprof":
+                # No live jax workload to bracket (or jax missing):
+                # drop to the seeded lane so the loop still carries
+                # device windows — loudly, so nobody mistakes the
+                # synthetic stream for on-chip truth.
+                print(
+                    f"agent: profiler xprof lane unavailable ({exc}); "
+                    "falling back to the seeded synthetic lane",
+                    file=sys.stderr,
+                )
+                prof_source = "synthetic"
+                profiler = ContinuousProfiler(
+                    source=prof_source, **prof_kwargs
+                )
+            else:
+                raise
+        profiler_attributor = attribution.BayesianAttributor()
+        runtime.register(
+            "profiler", profiler.export_state, profiler.restore_state
+        )
+        print(
+            "agent: continuous profiler on "
+            f"(source={prof_source}, "
+            f"stride={profiler.stride_cycles} cycle(s), "
+            f"budget {profiler.overhead_budget_pct:g}% of "
+            f"{profiler.cycle_budget_ms:g}ms)",
+            file=sys.stderr,
+        )
 
     def _all_channels():
         return writers.delivery_channels + [
@@ -1492,7 +1603,11 @@ def main(
         import numpy as np
 
         from tpuslo.columnar.gate import ColumnarGate
-        from tpuslo.columnar.schema import concat_batches, to_rows
+        from tpuslo.columnar.schema import (
+            concat_batches,
+            from_payloads,
+            to_rows,
+        )
         from tpuslo.columnar.serialize import serialize_jsonl
         from tpuslo.ingest import GateConfig as _GateConfig
 
@@ -1673,6 +1788,83 @@ def main(
         idx = 0
         emitted_total = 0
         pending_ship: list = []
+        profiler_incidents = 0
+
+        def _profiler_incident(window) -> None:
+            """Eviction-carrying windows page like any kernel signal:
+            attribute the window's device signals, attach the window's
+            roofline verdict, and chain the whole capture into the
+            incident's provenance."""
+            nonlocal profiler_incidents
+            if window.eviction_events <= 0 or profiler_attributor is None:
+                return
+            values = profiler.window_signal_values(window)
+            posteriors = profiler_attributor.attribute(values)
+            if not posteriors:
+                return
+            top = posteriors[0]
+            profiler_incidents += 1
+            incident_id = f"profiler-w{window.index}-{window.ts_unix_nano}"
+            print(
+                f"agent: profiler incident {incident_id}: "
+                f"{top.domain} (confidence {top.posterior:.3f}), "
+                f"idle gap {window.idle_gap_ms:.3f} ms, "
+                f"{window.eviction_events} eviction(s)"
+                + (
+                    f", window verdict {window.verdict}"
+                    if window.verdict
+                    else ""
+                ),
+                file=sys.stderr,
+            )
+            if provenance_log is None:
+                return
+            from tpuslo.obs import EvidenceEvent, ProvenanceRecord
+            from tpuslo.obs.provenance import probe_event_id
+
+            rec = ProvenanceRecord(
+                incident_id=incident_id,
+                recorded_at=datetime.now(timezone.utc).isoformat(),
+                cycle=idx,
+                predicted_fault_domain=top.domain,
+                confidence=top.posterior,
+                posterior={
+                    post.domain: post.posterior
+                    for post in posteriors[:5]
+                },
+                events=[
+                    EvidenceEvent(
+                        event_id=probe_event_id(
+                            name, window.ts_unix_nano
+                        ),
+                        signal=name,
+                        value=value,
+                        # The profiler's signals are born joined: the
+                        # window's ledger fold IS the correlation, so
+                        # the per-event confidence is the window's
+                        # substantive join rate.
+                        tier="profiler_window",
+                        confidence=window.substantive_join_rate,
+                    )
+                    for name, value in values.items()
+                ],
+                correlation={
+                    "matched": window.launches,
+                    "total": window.launches,
+                    "window_ms": round(window.window_ms, 3),
+                    "best_tier": "identity",
+                },
+                profiler=window.to_dict(),
+            )
+            if window.verdict:
+                rec.roofline = profiler.window_roofline(
+                    window.index
+                ) or {
+                    "verdict": window.verdict,
+                    "mfu_pct": window.mfu_pct,
+                    "detail": window.verdict_detail,
+                }
+            provenance_log.record(rec)
         try:
             while not args.count or idx < args.count:
                 now = datetime.now(timezone.utc)
@@ -1695,6 +1887,35 @@ def main(
                     outgoing = [result.admitted, result.late]
                 else:
                     outgoing = [batch]
+                if profiler is not None:
+                    # On-chip truth rides the same spine as every
+                    # kernel signal: the window's probe payloads go
+                    # through the identical gate admission and writer
+                    # path as the synthetic batch above.
+                    window = profiler.tick()
+                    if window is not None:
+                        pbatch, rejects = from_payloads(
+                            profiler.probe_payloads(window)
+                        )
+                        if rejects:
+                            # A contract-invalid payload here is a
+                            # profiler bug, not bad data — surface it.
+                            print(
+                                "agent: profiler window "
+                                f"#{window.index} produced "
+                                f"{len(rejects)} contract-invalid "
+                                "probe payload(s); dropped",
+                                file=sys.stderr,
+                            )
+                        if len(pbatch):
+                            if col_gate is not None:
+                                presult = col_gate.admit_batch(pbatch)
+                                outgoing.extend(
+                                    [presult.admitted, presult.late]
+                                )
+                            else:
+                                outgoing.append(pbatch)
+                        _profiler_incident(window)
                 for out in outgoing:
                     if not len(out):
                         continue
@@ -1759,6 +1980,20 @@ def main(
                 f"{emitted_total} probe events emitted",
                 file=sys.stderr,
             )
+            if profiler is not None:
+                pstats = profiler.stats()
+                print(
+                    "agent: profiler: "
+                    f"{pstats['windows_captured']} window(s) "
+                    f"({pstats['windows_forced']} forced, "
+                    f"{pstats['eviction_windows']} with evictions), "
+                    f"{pstats['degradations']} degradation(s), "
+                    f"{pstats['reengagements']} reengagement(s), "
+                    f"overhead EMA {pstats['overhead_ema_pct']:.4f}% "
+                    f"of {pstats['overhead_budget_pct']:g}% budget, "
+                    f"{profiler_incidents} incident(s)",
+                    file=sys.stderr,
+                )
             if pending_ship:
                 # Held batches must not die with the loop: the final
                 # flush ignores the cadence stride.

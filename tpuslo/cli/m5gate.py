@@ -117,6 +117,17 @@ roofline verdict (decode memory-bound, prefill compute-bound); and the
 calibrated heldout suite with the two device-plane fault domains
 (tpu_preemption, host_noisy_neighbor) must hold macro-F1 >= 0.96 at
 full-domain noise sigma 1.0.
+
+``--profiler-sweep`` runs the continuous-profiler gate
+(``tpuslo.deviceplane.profiler``): seeded capture windows folded
+through the same ledger must hold the measured-overhead budget (EMA
+<= 3% of cycle budget), the governor must degrade under forced-slow
+capture without ever dropping an eviction-bearing window and
+re-engage on sustained headroom, every window must hold substantive
+join >= 0.9 with the raw exact-identity rate reported alongside,
+per-window bucket sums must match one ledger over the spliced full
+capture, and the injected preemption window must attribute to
+``tpu_preemption``.
 """
 
 from __future__ import annotations
@@ -314,6 +325,23 @@ def build_parser() -> argparse.ArgumentParser:
         "roofline lanes still run, including the one shared "
         "calibrated fit)",
     )
+    # ---- continuous-profiler gate (tpuslo.deviceplane.profiler) -------
+    p.add_argument(
+        "--profiler-sweep",
+        action="store_true",
+        help="run the continuous-profiler gate instead of B5/D3/E3: "
+        "seeded capture windows through the ledger must hold the "
+        "measured-overhead budget (EMA <= 3% of cycle budget), the "
+        "governor must degrade under forced-slow capture, never drop "
+        "an eviction-bearing window, and re-engage on headroom; "
+        "per-window substantive join >= 0.9 with the raw rate "
+        "reported alongside; per-window buckets must sum to the "
+        "spliced full-capture ledger; and the injected preemption "
+        "window must attribute to tpu_preemption",
+    )
+    p.add_argument("--profiler-seed", type=int, default=1337)
+    p.add_argument("--profiler-cycles", type=int, default=24)
+    p.add_argument("--profiler-parity-windows", type=int, default=5)
     # ---- fleet observability-plane gate (tpuslo.fleet) ----------------
     p.add_argument(
         "--fleet-sweep",
@@ -1559,6 +1587,100 @@ def run_deviceplane_gate(args) -> int:
     return 0 if report.passed else 1
 
 
+def render_profiler_markdown(report) -> str:
+    lines = [
+        "# Continuous-profiler gate (overhead + governor + joins + "
+        "parity + preemption)",
+        "",
+        f"**Overall: {'PASS' if report.passed else 'FAIL'}**",
+        "",
+        f"- seed: {report.seed}",
+        "",
+        "## Overhead",
+        "",
+        f"- EMA {report.overhead.get('overhead_ema_pct', 0)}% of "
+        f"{report.overhead.get('budget_pct', 0)}% budget over "
+        f"{report.overhead.get('windows', 0)} windows "
+        f"(mean capture cost "
+        f"{report.overhead.get('mean_capture_cost_ms', 0)} ms)",
+        "",
+        "## Governor",
+        "",
+        f"- degraded at cycle "
+        f"{report.governor.get('degraded_at_cycle')}, stride -> "
+        f"{report.governor.get('stride_after_degrade')}; forced "
+        "eviction capture carried "
+        f"{report.governor.get('forced_capture_evictions', 0)} "
+        "eviction(s); re-engaged after "
+        f"{report.governor.get('reengaged_after_cycles')} cycle(s) "
+        f"({report.governor.get('degradations', 0)} degradation(s), "
+        f"{report.governor.get('reengagements', 0)} reengagement(s))",
+        "",
+        "## Joins (per window)",
+        "",
+        f"- min substantive "
+        f"{report.joins.get('min_substantive_join_rate', 0)} "
+        f"(floor {report.joins.get('floor', 0)}); mean raw "
+        f"{report.joins.get('mean_raw_join_rate', 0)} reported "
+        "alongside",
+        "",
+        "## Window/full-capture parity",
+        "",
+        f"- worst bucket drift "
+        f"{report.parity.get('worst_bucket_drift_us', 0)} us "
+        f"({report.parity.get('worst_bucket', '?')}) over "
+        f"{report.parity.get('windows', 0)} windows; total drift "
+        f"{report.parity.get('total_drift_us', 0)} us",
+        "",
+        "## Preemption e2e",
+        "",
+        f"- window #{report.preemption.get('window_index', '?')}: "
+        f"idle gap {report.preemption.get('idle_gap_ms', 0)} ms vs "
+        f"baseline {report.preemption.get('baseline_max_idle_gap_ms', 0)} "
+        f"ms -> {report.preemption.get('top_domain', '?')} "
+        f"(posterior {report.preemption.get('posterior', 0)}), "
+        f"window verdict {report.preemption.get('verdict', '?')}",
+    ]
+    if report.failures:
+        lines += ["", "## Failures", ""]
+        lines += [f"- {f}" for f in report.failures]
+    return "\n".join(lines) + "\n"
+
+
+def run_profiler_gate(args) -> int:
+    from tpuslo.deviceplane.profiler import run_profiler_sweep
+
+    report = run_profiler_sweep(
+        seed=args.profiler_seed,
+        cycles=args.profiler_cycles,
+        parity_windows=args.profiler_parity_windows,
+    )
+    if args.summary_json:
+        Path(args.summary_json).write_text(
+            json.dumps(report.to_dict(), indent=2) + "\n"
+        )
+    if args.summary_md:
+        Path(args.summary_md).write_text(render_profiler_markdown(report))
+    print(
+        "m5gate: profiler overhead EMA "
+        f"{report.overhead.get('overhead_ema_pct', 0)}% of "
+        f"{report.overhead.get('budget_pct', 0)}% budget; min "
+        "substantive join "
+        f"{report.joins.get('min_substantive_join_rate', 0)}; parity "
+        f"drift {report.parity.get('worst_bucket_drift_us', 0)}us; "
+        "preemption -> "
+        f"{report.preemption.get('top_domain', '?')} "
+        f"({report.preemption.get('posterior', 0)})",
+        file=sys.stderr,
+    )
+    print(
+        f"m5gate: profiler-sweep {'PASS' if report.passed else 'FAIL'}"
+        + ("" if report.passed else f" ({'; '.join(report.failures)})"),
+        file=sys.stderr,
+    )
+    return 0 if report.passed else 1
+
+
 def render_markdown(summary: releasegate.Summary) -> str:
     lines = [
         "# M5 release gate summary",
@@ -1674,6 +1796,8 @@ def main(argv: list[str] | None = None) -> int:
         return run_router_gate(args)
     if args.deviceplane_sweep:
         return run_deviceplane_gate(args)
+    if args.profiler_sweep:
+        return run_profiler_gate(args)
     if args.fleet_sweep:
         return run_fleet_gate(args)
     if args.federation_sweep:

@@ -178,6 +178,27 @@ def build_parser() -> argparse.ArgumentParser:
     )
     rem_list.add_argument("--json", action="store_true")
 
+    prof = sub.add_parser(
+        "profiler",
+        help="continuous-profiler capture windows from the agent's "
+        "durable state snapshot (idle gap, MFU, unexplained share, "
+        "join rates, governor state)",
+    )
+    prof.add_argument("--config", default="")
+    prof.add_argument(
+        "--state",
+        default="",
+        help="agent state snapshot path (default "
+        "<runtime.state_dir>/agent-state.json)",
+    )
+    prof.add_argument(
+        "--last",
+        type=int,
+        default=0,
+        help="show only the most recent N windows (0 = all retained)",
+    )
+    prof.add_argument("--json", action="store_true")
+
     bu = sub.add_parser(
         "budget",
         help="per-tenant error-budget / burn-rate table from the "
@@ -655,6 +676,107 @@ def run_remediation(args) -> int:
     return 0
 
 
+def run_profiler(args) -> int:
+    import os
+
+    cfg = resolve_config(args.config)
+    path = args.state
+    if not path and cfg.runtime.state_dir:
+        path = os.path.join(cfg.runtime.state_dir, "agent-state.json")
+    if not path:
+        print(
+            "sloctl profiler: no state path — pass --state or set "
+            "runtime.state_dir",
+            file=sys.stderr,
+        )
+        return 1
+    try:
+        with open(path, encoding="utf-8") as fh:
+            snapshot = json.load(fh)
+    except OSError as exc:
+        print(
+            f"sloctl profiler: cannot read {path}: "
+            f"{exc.strerror or exc}",
+            file=sys.stderr,
+        )
+        return 1
+    except json.JSONDecodeError:
+        print(f"sloctl profiler: corrupt snapshot {path}", file=sys.stderr)
+        return 1
+    section = (snapshot.get("components") or {}).get("profiler")
+    if not isinstance(section, dict):
+        print(
+            f"sloctl profiler: snapshot {path} has no profiler "
+            "section — is the profiler enabled (config profiler: / "
+            "agent --profile-device)?",
+            file=sys.stderr,
+        )
+        return 1
+    windows = [
+        w for w in (section.get("windows") or []) if isinstance(w, dict)
+    ]
+    if args.last > 0:
+        windows = windows[-args.last :]
+    if args.json:
+        print(json.dumps(section | {"windows": windows}, indent=2))
+        return 0
+    print(
+        "profiler: source={source} windows={captured} "
+        "(forced={forced}, evictions={ev}) "
+        "degradations={deg} reengagements={re} stride={stride} "
+        "overhead EMA {ema:.4f}% of {budget:g}% budget{state}".format(
+            source=section.get("source", "?"),
+            captured=section.get("windows_captured", 0),
+            forced=section.get("windows_forced", 0),
+            ev=section.get("eviction_windows", 0),
+            deg=section.get("degradations", 0),
+            re=section.get("reengagements", 0),
+            stride=section.get("stride_cycles", "?"),
+            ema=float(section.get("overhead_ema_pct", 0.0)),
+            budget=float(section.get("overhead_budget_pct", 0.0)),
+            state=" [DEGRADED]" if section.get("degraded") else "",
+        )
+    )
+    if not windows:
+        print("(no capture windows retained)")
+        return 0
+    rows = [
+        (
+            "WINDOW", "CYCLE", "IDLE-GAP-MS", "EVICT", "UNEXPL",
+            "MFU%", "RAW", "SUBST", "VERDICT", "STRIDE", "FLAGS",
+        )
+    ]
+    for w in windows:
+        mfu = float(w.get("mfu_pct", -1.0))
+        flags = "".join(
+            (
+                "D" if w.get("degraded") else "",
+                "F" if w.get("forced") else "",
+            )
+        )
+        rows.append(
+            (
+                str(w.get("index", "?")),
+                str(w.get("cycle", "?")),
+                f"{float(w.get('idle_gap_ms', 0.0)):.3f}",
+                str(w.get("eviction_events", 0)),
+                f"{float(w.get('unexplained_share', 0.0)):.3f}",
+                f"{mfu:.2f}" if mfu >= 0 else "-",
+                f"{float(w.get('raw_join_rate', 0.0)):.3f}",
+                f"{float(w.get('substantive_join_rate', 0.0)):.3f}",
+                str(w.get("verdict") or "-"),
+                str(w.get("stride_cycles", "?")),
+                flags or "-",
+            )
+        )
+    print(_render_table(rows))
+    print(
+        f"{len(windows)} window(s) retained — eviction windows page; "
+        "drill down with `sloctl explain <incident>`"
+    )
+    return 0
+
+
 def _render_budget_table(statuses, tenant_filter: str = "") -> str:
     """Fixed-width per-(tenant, objective) budget table."""
     rows = [
@@ -819,6 +941,8 @@ def main(argv: list[str] | None = None) -> int:
         return run_fleet(args)
     if args.command == "remediation":
         return run_remediation(args)
+    if args.command == "profiler":
+        return run_profiler(args)
     return run_cdgate(args)
 
 

@@ -213,6 +213,21 @@ class ProbeManager:
                     )
                 )
                 continue
+            if name in (
+                sig.SIGNAL_DEVICE_UNEXPLAINED_SHARE,
+                sig.SIGNAL_DEVICE_MFU_PCT,
+            ):
+                plans.append(
+                    ProbePlan(
+                        signal=name,
+                        kind="sampler",
+                        status="sampler",
+                        detail="sampled per capture window by the "
+                        "continuous profiler "
+                        "(tpuslo/deviceplane/profiler.py)",
+                    )
+                )
+                continue
             if name in _KERNEL_OBJECTS:
                 obj = _KERNEL_OBJECTS[name]
                 plan = ProbePlan(signal=name, object_file=obj, kind="auto")

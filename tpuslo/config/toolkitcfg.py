@@ -147,6 +147,40 @@ class ObservabilityConfig:
 
 
 @dataclass
+class ProfilerConfig:
+    """Continuous device profiler knobs
+    (``tpuslo.deviceplane.profiler``).
+
+    ``enabled`` flips to True whenever a ``profiler:`` section is
+    present in the config file (presence-implies-on, like
+    ``observability:``); an explicit ``enabled: false`` still wins.
+    The agent CLI's ``--profile-device`` flag overrides everything.
+    """
+
+    enabled: bool = False
+    #: Capture source: "synthetic" (seeded CI lane) or "xprof" (real
+    #: ``jax.profiler`` capture; needs JAX and a workload to bracket).
+    source: str = "synthetic"
+    #: Capture every N agent cycles (the governor doubles this under
+    #: overhead pressure, up to ``max_stride_cycles``).
+    stride_cycles: int = 5
+    max_stride_cycles: int = 40
+    #: Serving steps per synthetic capture window.
+    window_steps: int = 8
+    #: Measured capture+parse budget as percent of the cycle budget,
+    #: amortised over the stride.
+    overhead_budget_pct: float = 3.0
+    #: Assumed serving-loop cycle budget for the overhead accounting.
+    cycle_budget_ms: float = 1000.0
+    ema_alpha: float = 0.1
+    grace_cycles: int = 3
+    #: Recent windows kept for sloctl / the state snapshot.
+    history: int = 32
+    #: Profiler log dir for the xprof lane (trace files land here).
+    log_dir: str = ""
+
+
+@dataclass
 class SLOConfig:
     """Error-budget / burn-rate engine knobs (``tpuslo.sloengine``).
 
@@ -257,6 +291,7 @@ class ToolkitConfig:
     observability: ObservabilityConfig = field(
         default_factory=ObservabilityConfig
     )
+    profiler: ProfilerConfig = field(default_factory=ProfilerConfig)
     slo: SLOConfig = field(default_factory=SLOConfig)
     remediation: RemediationConfig = field(
         default_factory=RemediationConfig
@@ -326,6 +361,19 @@ class ToolkitConfig:
                 "slow_cycle_ms": self.observability.slow_cycle_ms,
                 "max_overhead_pct": self.observability.max_overhead_pct,
                 "provenance_path": self.observability.provenance_path,
+            },
+            "profiler": {
+                "enabled": self.profiler.enabled,
+                "source": self.profiler.source,
+                "stride_cycles": self.profiler.stride_cycles,
+                "max_stride_cycles": self.profiler.max_stride_cycles,
+                "window_steps": self.profiler.window_steps,
+                "overhead_budget_pct": self.profiler.overhead_budget_pct,
+                "cycle_budget_ms": self.profiler.cycle_budget_ms,
+                "ema_alpha": self.profiler.ema_alpha,
+                "grace_cycles": self.profiler.grace_cycles,
+                "history": self.profiler.history,
+                "log_dir": self.profiler.log_dir,
             },
             "slo": {
                 "enabled": self.slo.enabled,
@@ -547,6 +595,28 @@ def load_config(path: str) -> ToolkitConfig:
                 "slow_cycle_ms": float,
                 "max_overhead_pct": float,
                 "provenance_path": str,
+            },
+        )
+    if "profiler" in raw:
+        # Presence of the section turns the continuous profiler on
+        # (the operator described it); an explicit ``enabled: false``
+        # still wins.
+        cfg.profiler.enabled = True
+        _merge_section(
+            cfg.profiler,
+            raw.get("profiler") or {},
+            {
+                "enabled": bool,
+                "source": str,
+                "stride_cycles": int,
+                "max_stride_cycles": int,
+                "window_steps": int,
+                "overhead_budget_pct": float,
+                "cycle_budget_ms": float,
+                "ema_alpha": float,
+                "grace_cycles": int,
+                "history": int,
+                "log_dir": str,
             },
         )
     if "slo" in raw:

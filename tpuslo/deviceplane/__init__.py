@@ -16,7 +16,11 @@ time and attaches an actionable verdict to it (ROADMAP #3 / ISSUE 14):
   ``xla_spans.parse_trace_events`` path) so the ledger is gated
   off-chip;
 * :mod:`tpuslo.deviceplane.sweep` — the release gate
-  (``m5gate --deviceplane-sweep``).
+  (``m5gate --deviceplane-sweep``);
+* :mod:`tpuslo.deviceplane.profiler` — the continuous profiler
+  (ISSUE 20): stride-gated live capture windows folded through the
+  ledger under a measured-overhead governor, emitting per-window
+  device signals onto the probe spine (``m5gate --profiler-sweep``).
 """
 
 from tpuslo.deviceplane.dispatch import DispatchLedger
@@ -43,6 +47,13 @@ from tpuslo.deviceplane.roofline import (
     decode_step_cost,
     roofline_verdict,
 )
+from tpuslo.deviceplane.profiler import (
+    ContinuousProfiler,
+    ProfilerReport,
+    ProfilerWindow,
+    concat_window_docs,
+    run_profiler_sweep,
+)
 from tpuslo.deviceplane.sweep import DeviceplaneReport, run_deviceplane_sweep
 from tpuslo.deviceplane.synthetic import synthesize_xprof_trace
 
@@ -57,17 +68,22 @@ __all__ = [
     "TIER_IDENTITY",
     "TIER_LANE_WINDOW",
     "CompileEvent",
+    "ContinuousProfiler",
     "DeviceLedger",
     "DeviceWindow",
     "DeviceplaneReport",
     "DispatchLedger",
     "LaunchRecord",
+    "ProfilerReport",
+    "ProfilerWindow",
     "VERDICT_COMPUTE_BOUND",
     "VERDICT_MEMORY_BOUND",
     "attach_roofline",
     "build_ledger",
+    "concat_window_docs",
     "decode_step_cost",
     "roofline_verdict",
     "run_deviceplane_sweep",
+    "run_profiler_sweep",
     "synthesize_xprof_trace",
 ]

@@ -310,9 +310,16 @@ def build_template_payloads(
     from tpuslo.collector.synthetic import RawSample
     from tpuslo.columnar.generate import columns_from_samples
     from tpuslo.signals import constants as sig
+    from tpuslo.signals.generator import PROFILER_ONLY_SIGNALS
     from tpuslo.signals.metadata import Metadata
 
-    n_signals = len(sig.ALL_SIGNALS)
+    # Profiler-only signals never come from a fault profile (no RNG
+    # draw exists for them — the live profiler is their only source),
+    # so the dense template ships every generator-emitted signal.
+    template_signals = [
+        s for s in sig.ALL_SIGNALS if s not in PROFILER_ONLY_SIGNALS
+    ]
+    n_signals = len(template_signals)
     n_samples = max(1, events_per_node // n_signals)
     start = datetime(2026, 1, 1, tzinfo=timezone.utc)
     samples = [
@@ -342,7 +349,7 @@ def build_template_payloads(
         slice_id="slice-template",
         host_index=0,
     )
-    template = columns_from_samples(samples, meta, sig.ALL_SIGNALS)
+    template = columns_from_samples(samples, meta, template_signals)
     base = encode_shipment(template, "node-template", 0)
     # Pure lookups — the template metadata interned these already.
     node_code = template.pool.intern("node-template")

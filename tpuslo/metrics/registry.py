@@ -557,6 +557,57 @@ class AgentMetrics:
             ["verdict"],
             registry=self.registry,
         )
+        # ---- continuous profiler (tpuslo.deviceplane.profiler) --------
+        self.profiler_windows = Counter(
+            "llm_slo_profiler_windows_total",
+            "Profiler capture windows folded through the ledger, by "
+            "kind (captured = every window; forced = taken mid-stride "
+            "on an eviction notice; eviction = windows carrying at "
+            "least one eviction event)",
+            ["kind"],
+            registry=self.registry,
+        )
+        self.profiler_capture_overhead_pct = Gauge(
+            "llm_slo_profiler_capture_overhead_pct",
+            "Measured capture+parse+fold cost as percent of the cycle "
+            "budget, amortized over the stride (EMA; the governor "
+            "degrades to a longer stride past its budget)",
+            registry=self.registry,
+        )
+        self.profiler_governor_transitions = Counter(
+            "llm_slo_profiler_governor_transitions_total",
+            "Overhead-governor state changes, by transition (degraded "
+            "= stride lengthened past the overhead budget; reengaged "
+            "= base stride restored on sustained headroom)",
+            ["transition"],
+            registry=self.registry,
+        )
+        self.profiler_stride_cycles = Gauge(
+            "llm_slo_profiler_stride_cycles",
+            "Current capture stride in agent cycles (base when "
+            "healthy, doubled per degradation up to the cap)",
+            registry=self.registry,
+        )
+        self.profiler_idle_gap_ms = Histogram(
+            "llm_slo_profiler_idle_gap_ms",
+            "Per-window device idle gap from the profiler's ledger "
+            "fold (preemptions surface here as outlier windows)",
+            buckets=(0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000),
+            registry=self.registry,
+        )
+        self.profiler_window_mfu_pct = Gauge(
+            "llm_slo_profiler_window_mfu_pct",
+            "Roofline MFU of the most recent capture window (-1 when "
+            "the window carried no cost model)",
+            registry=self.registry,
+        )
+        self.profiler_window_unexplained_share = Gauge(
+            "llm_slo_profiler_window_unexplained_share",
+            "Unexplained device-time share of the most recent capture "
+            "window (same fold the device_unexplained_share probe "
+            "signal is emitted from)",
+            registry=self.registry,
+        )
         # ---- serving front door (tpuslo.models.frontdoor) -------------
         # The engine's admission counters were internal-only (stats()
         # dicts); these export them live through the FrontDoorObserver
@@ -758,6 +809,11 @@ class AgentMetrics:
         """Observer adapter wiring a RemediationEngine to this registry
         (duck-typed against tpuslo.remediation.RemediationObserver)."""
         return _PromRemediationObserver(self)
+
+    def profiler_observer(self) -> "_PromProfilerObserver":
+        """Observer for the continuous device profiler
+        (``ContinuousProfiler(observer=...)``)."""
+        return _PromProfilerObserver(self)
 
     def deviceplane_observer(self) -> "_PromDeviceplaneObserver":
         """Observer adapter wiring device-plane ledger folds, serving
@@ -1202,6 +1258,42 @@ class _PromDeviceplaneObserver:
         self._m.deviceplane_roofline_verdicts.labels(
             verdict=verdict
         ).inc()
+
+
+class _PromProfilerObserver:
+    """Bridge from continuous-profiler callbacks to Prometheus
+    (the profiler observer contract: window/degraded/reengaged)."""
+
+    def __init__(self, metrics: AgentMetrics):
+        self._m = metrics
+
+    def window(self, window, ema_pct: float) -> None:
+        """Publish one :class:`ProfilerWindow` fold plus the
+        governor's current overhead EMA."""
+        self._m.profiler_windows.labels(kind="captured").inc()
+        if window.forced:
+            self._m.profiler_windows.labels(kind="forced").inc()
+        if window.eviction_events > 0:
+            self._m.profiler_windows.labels(kind="eviction").inc()
+        self._m.profiler_capture_overhead_pct.set(ema_pct)
+        self._m.profiler_stride_cycles.set(window.stride_cycles)
+        self._m.profiler_idle_gap_ms.observe(window.idle_gap_ms)
+        self._m.profiler_window_mfu_pct.set(window.mfu_pct)
+        self._m.profiler_window_unexplained_share.set(
+            window.unexplained_share
+        )
+
+    def degraded(self, stride: int) -> None:
+        self._m.profiler_governor_transitions.labels(
+            transition="degraded"
+        ).inc()
+        self._m.profiler_stride_cycles.set(stride)
+
+    def reengaged(self, stride: int) -> None:
+        self._m.profiler_governor_transitions.labels(
+            transition="reengaged"
+        ).inc()
+        self._m.profiler_stride_cycles.set(stride)
 
 
 class _PromFrontDoorObserver:

@@ -94,6 +94,11 @@ class ProvenanceRecord:
     #: this incident (tpuslo.deviceplane.roofline block: memory- vs
     #: compute-bound, achieved vs peak bandwidth/MFU).
     roofline: dict[str, Any] = field(default_factory=dict)
+    #: Continuous-profiler capture window that fed this incident
+    #: (``ProfilerWindow.to_dict()``: idle gap, eviction count,
+    #: unexplained share, MFU, join rates, governor state) — present
+    #: only when the incident was raised off a profiler window.
+    profiler: dict[str, Any] = field(default_factory=dict)
     #: Auto-remediation actions taken on this incident, in decision
     #: order (``RemediationEngine`` action-record dicts: action id,
     #: kind, target, phase, verify verdict, rollback detail).  The
@@ -120,6 +125,7 @@ class ProvenanceRecord:
             "members": [dict(m) for m in self.members],
             "blast_radius": self.blast_radius,
             "roofline": dict(self.roofline),
+            "profiler": dict(self.profiler),
             "remediation": [dict(r) for r in self.remediation],
         }
 
@@ -161,6 +167,7 @@ class ProvenanceRecord:
             ],
             blast_radius=str(raw.get("blast_radius", "")),
             roofline=dict(raw.get("roofline") or {}),
+            profiler=dict(raw.get("profiler") or {}),
             remediation=[
                 dict(r)
                 for r in (raw.get("remediation") or [])
@@ -328,6 +335,37 @@ def format_chain(rec: ProvenanceRecord) -> str:
         detail = roof.get("detail", "")
         if detail:
             lines.append(f"    {detail}")
+
+    if rec.profiler:
+        prof = rec.profiler
+        lines.append(
+            "  profiler window #{index} (cycle {cycle}): idle gap "
+            "{gap:.3f} ms, {ev} eviction(s), unexplained "
+            "{unexpl:.3f}, MFU {mfu:.2f}%".format(
+                index=prof.get("index", "?"),
+                cycle=prof.get("cycle", "?"),
+                gap=float(prof.get("idle_gap_ms", 0.0)),
+                ev=int(prof.get("eviction_events", 0)),
+                unexpl=float(prof.get("unexplained_share", 0.0)),
+                mfu=float(prof.get("mfu_pct", -1.0)),
+            )
+        )
+        lines.append(
+            "    joins: raw {raw:.3f} / substantive {sub:.3f}; "
+            "stride {stride} cycle(s){deg}{forced}".format(
+                raw=float(prof.get("raw_join_rate", 0.0)),
+                sub=float(prof.get("substantive_join_rate", 0.0)),
+                stride=prof.get("stride_cycles", "?"),
+                deg=" [DEGRADED]" if prof.get("degraded") else "",
+                forced=" [forced capture]" if prof.get("forced") else "",
+            )
+        )
+        verdict_detail = prof.get("verdict_detail", "")
+        if prof.get("verdict"):
+            lines.append(
+                f"    window verdict: {prof.get('verdict')}"
+                + (f" — {verdict_detail}" if verdict_detail else "")
+            )
 
     if rec.burning:
         for burn in rec.burning:

@@ -52,6 +52,20 @@ SIGNAL_DEVICE_IDLE_GAP_MS = "device_idle_gap_ms"
 # Per-window count of device preemption/eviction notices (maintenance
 # events, device re-init after the runtime lost the chip).
 SIGNAL_DEVICE_EVICTION_EVENTS = "device_eviction_events_total"
+# Fraction of the profiler window's device time the ledger's tier
+# ladder could NOT explain (tpuslo/deviceplane/ledger.py's honest
+# remainder).  A creeping share means the join ladder is losing
+# launches — capture truncation, a new anonymous program, or a lane
+# the ledger has never seen.  Sampled per capture window by the
+# continuous profiler (tpuslo/deviceplane/profiler.py); the synthetic
+# fault generator never fabricates it (see Generator.set_signals).
+SIGNAL_DEVICE_UNEXPLAINED_SHARE = "device_unexplained_share"
+# Model-FLOP utilisation of the window's serving program against the
+# chip's compute roof, from the roofline fold over the ledger's joined
+# launches.  LOW is bad (and on memory-bound decode, meaningless — the
+# attached roofline verdict carries the interpretation), so it takes
+# no place in the high-is-bad warn/error ladder: informational only.
+SIGNAL_DEVICE_MFU_PCT = "device_mfu_pct"
 
 CPU_SIGNALS: tuple[str, ...] = (
     SIGNAL_DNS_LATENCY_MS,
@@ -78,6 +92,8 @@ TPU_SIGNALS: tuple[str, ...] = (
     SIGNAL_DCN_TRANSFER_MS,
     SIGNAL_DEVICE_IDLE_GAP_MS,
     SIGNAL_DEVICE_EVICTION_EVENTS,
+    SIGNAL_DEVICE_UNEXPLAINED_SHARE,
+    SIGNAL_DEVICE_MFU_PCT,
 )
 
 ALL_SIGNALS: tuple[str, ...] = CPU_SIGNALS + TPU_SIGNALS
@@ -109,6 +125,11 @@ _BCC_SIGNAL_SET: tuple[str, ...] = (
 HIGH_COST_DISABLE_ORDER: tuple[str, ...] = (
     # The device-plane ledger signals are sampled (no probe cost), but
     # producing them requires an xprof/ledger pass — shed that first.
+    # The continuous-profiler window signals sit at the very front:
+    # they ride the same capture the profiler's own overhead governor
+    # already degrades, so they are the cheapest depth to give back.
+    SIGNAL_DEVICE_UNEXPLAINED_SHARE,
+    SIGNAL_DEVICE_MFU_PCT,
     SIGNAL_DEVICE_IDLE_GAP_MS,
     SIGNAL_DEVICE_EVICTION_EVENTS,
     SIGNAL_DCN_TRANSFER_MS,

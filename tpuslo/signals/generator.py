@@ -46,6 +46,12 @@ SIGNAL_THRESHOLDS: dict[str, tuple[float, float]] = {
     sig.SIGNAL_DCN_TRANSFER_MS: (25, 80),
     sig.SIGNAL_DEVICE_IDLE_GAP_MS: (25, 100),
     sig.SIGNAL_DEVICE_EVICTION_EVENTS: (1, 3),
+    sig.SIGNAL_DEVICE_UNEXPLAINED_SHARE: (0.10, 0.25),
+    # MFU is LOW-is-bad and often meaningless (memory-bound decode);
+    # the high-is-bad ladder must never fire on it, so both thresholds
+    # sit above the 100% ceiling and the status is always "ok".  The
+    # profiler's roofline verdict carries the interpretation.
+    sig.SIGNAL_DEVICE_MFU_PCT: (101.0, 101.0),
 }
 
 SIGNAL_UNITS: dict[str, str] = {
@@ -70,7 +76,24 @@ SIGNAL_UNITS: dict[str, str] = {
     sig.SIGNAL_DCN_TRANSFER_MS: "ms",
     sig.SIGNAL_DEVICE_IDLE_GAP_MS: "ms",
     sig.SIGNAL_DEVICE_EVICTION_EVENTS: "count",
+    sig.SIGNAL_DEVICE_UNEXPLAINED_SHARE: "ratio",
+    sig.SIGNAL_DEVICE_MFU_PCT: "pct",
 }
+
+# Signals only the continuous profiler's capture windows can source:
+# the synthetic fault generator has no per-request story for them (they
+# are per-WINDOW ledger folds), and — load-bearing — adding them to
+# ``_BASE_PROFILE``/``_FAULT_OVERRIDES`` would insert RNG draws into
+# ``calibrate.corrupt``'s sequential stream and re-roll every
+# calibrated likelihood floor.  ``Generator.set_signals`` filters them
+# out of the enabled set so both fan-out paths (row and columnar)
+# never look them up in a fault profile.
+PROFILER_ONLY_SIGNALS = frozenset(
+    {
+        sig.SIGNAL_DEVICE_UNEXPLAINED_SHARE,
+        sig.SIGNAL_DEVICE_MFU_PCT,
+    }
+)
 
 # Signals that carry a network flow tuple.
 _CONN_TUPLE_SIGNALS = frozenset(
@@ -318,7 +341,10 @@ class Generator:
 
     def set_signals(self, signal_set: Iterable[str]) -> None:
         """Replace enabled probes at runtime, filtered by capability."""
-        allowed = set(sig.supported_signals_for_mode(self._mode))
+        allowed = (
+            set(sig.supported_signals_for_mode(self._mode))
+            - PROFILER_ONLY_SIGNALS
+        )
         requested = set(signal_set)
         with self._lock:
             self._enabled = (requested & allowed) if requested else allowed
